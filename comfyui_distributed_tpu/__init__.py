@@ -1,0 +1,31 @@
+"""comfyui_distributed_tpu — a TPU-native distributed diffusion framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+robertvoy/ComfyUI-Distributed (reference: /root/reference): parallel
+workflow replication with seed offsetting and result collection,
+distributed tile-based upscaling, worker lifecycle management, fault
+tolerance (heartbeat / timeout / requeue), media sync, and a JSON
+config system — but built TPU-first:
+
+- Inside a pod slice, "workers" are mesh axis indices, not processes;
+  the collector is an ICI all-gather (reference: nodes/collector.py),
+  and tile distribution is a sharded array axis under shard_map
+  (reference: upscale/job_store.py + api/usdu_routes.py HTTP queue).
+- Across hosts / heterogeneous participants, an elastic HTTP tier with
+  the reference's canonical envelopes, heartbeats, and requeue
+  semantics is retained (reference: api/*, upscale/worker_comms.py).
+- Compute is JAX: UNet/DiT/VAE in bfloat16 on the MXU, samplers as
+  lax.scan loops, Pallas kernels for attention.
+
+Subpackages:
+    utils     — config, logging, tracing, network, async bridge, codecs
+    parallel  — mesh/topology, collective collector, sharding rules
+    ops       — tile math, samplers, attention kernels, conditioning
+    models    — UNet / DiT / VAE / text encoder model zoo
+    graph     — workflow graph (prompt) executor + node registry
+    jobs      — job store, models, timeouts (elastic tier state)
+    api       — aiohttp control plane (master/worker HTTP+WS API)
+    workers   — host process lifecycle, detection, monitoring
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""TPU compute ops: tile math, samplers, attention, conditioning."""

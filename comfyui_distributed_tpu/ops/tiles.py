@@ -1,0 +1,253 @@
+"""Tile grid math for distributed upscaling — pure jnp, static shapes.
+
+Re-designs the reference's tile pipeline (upscale/tile_ops.py:
+calculate_tiles / extract_tile_with_padding / create_tile_mask /
+blend_tile) for XLA: the tile grid is computed statically in Python
+(shapes must be trace-time constants), extraction is a vmapped
+dynamic_slice over a reflect-padded image, and blending is an
+order-independent feathered weighted average so tiles can be produced
+by any participant in any order with a numerically equivalent result
+(identical up to float accumulation order, ~1 ULP).
+
+Uniform tiles are the only mode: every tile has the same static shape
+(the reference's `force_uniform_tiles=True` path), which is both the
+XLA-friendly choice and the reference's default. Non-uniform tiles
+(dynamic per-tile shapes) are intentionally unsupported on the fast
+path — edge tiles are handled by clamping tile origins so the last
+row/column overlaps its neighbor instead of shrinking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TileGrid:
+    """Static description of a tiling of an image plane."""
+
+    image_h: int
+    image_w: int
+    tile_h: int
+    tile_w: int
+    padding: int
+    rows: int
+    cols: int
+    # [T, 2] int32 (y, x) origins of the *unpadded* tile regions.
+    positions: tuple[tuple[int, int], ...]
+
+    @property
+    def num_tiles(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def padded_h(self) -> int:
+        return self.tile_h + 2 * self.padding
+
+    @property
+    def padded_w(self) -> int:
+        return self.tile_w + 2 * self.padding
+
+    def positions_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.positions, dtype=jnp.int32)
+
+
+def calculate_tiles(
+    image_h: int,
+    image_w: int,
+    tile_h: int,
+    tile_w: int,
+    padding: int = 32,
+) -> TileGrid:
+    """Ceil-grid tiling with clamped origins (uniform tile shapes).
+
+    Parity with reference upscale/tile_ops.py `calculate_tiles` (ceil
+    grid) but instead of shrinking edge tiles, the last row/column is
+    shifted left/up so every tile is exactly (tile_h, tile_w).
+    """
+    tile_h = min(tile_h, image_h)
+    tile_w = min(tile_w, image_w)
+    rows = max(1, math.ceil(image_h / tile_h))
+    cols = max(1, math.ceil(image_w / tile_w))
+    positions = []
+    for r in range(rows):
+        y = min(r * tile_h, image_h - tile_h)
+        for c in range(cols):
+            x = min(c * tile_w, image_w - tile_w)
+            positions.append((y, x))
+    return TileGrid(
+        image_h=image_h,
+        image_w=image_w,
+        tile_h=tile_h,
+        tile_w=tile_w,
+        padding=padding,
+        rows=rows,
+        cols=cols,
+        positions=tuple(positions),
+    )
+
+
+def pad_image_for_grid(images: jax.Array, grid: TileGrid) -> jax.Array:
+    """Reflect-pad [B, H, W, C] so padded tile extraction never clips."""
+    p = grid.padding
+    if p == 0:
+        return images
+    return jnp.pad(images, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+
+
+@partial(jax.jit, static_argnames=("tile_h", "tile_w"))
+def _extract_one(
+    padded: jax.Array, y: jax.Array, x: jax.Array, tile_h: int, tile_w: int
+) -> jax.Array:
+    return jax.lax.dynamic_slice(
+        padded,
+        (0, y, x, 0),
+        (padded.shape[0], tile_h, tile_w, padded.shape[3]),
+    )
+
+
+def extract_tiles(images: jax.Array, grid: TileGrid) -> jax.Array:
+    """[B, H, W, C] → [T, B, th+2p, tw+2p, C] padded tiles.
+
+    Positions index the padded image, so the padded tile is centered on
+    the unpadded region (reference extract_tile_with_padding semantics).
+    """
+    padded = pad_image_for_grid(images, grid)
+    pos = grid.positions_array()
+    return jax.vmap(
+        lambda p: _extract_one(padded, p[0], p[1], grid.padded_h, grid.padded_w)
+    )(pos)
+
+
+@lru_cache(maxsize=64)
+def _feather_mask_np(padded_h: int, padded_w: int, padding: int) -> np.ndarray:
+    def ramp(n: int, pad: int) -> np.ndarray:
+        w = np.ones(n, dtype=np.float64)
+        if pad > 0:
+            t = (np.arange(pad) + 0.5) / pad  # 0..1 across the ring
+            edge = 0.5 - 0.5 * np.cos(np.pi * t)
+            w[:pad] = np.maximum(edge, 1e-4)
+            w[-pad:] = np.maximum(edge[::-1], 1e-4)
+        return w
+
+    return np.outer(ramp(padded_h, padding), ramp(padded_w, padding))
+
+
+def feather_mask(grid: TileGrid, dtype=jnp.float32) -> jnp.ndarray:
+    """[th+2p, tw+2p] feathering weights, 1.0 in the core, smooth
+    raised-cosine falloff across the padding ring.
+
+    Replaces the reference's Gaussian-blurred rectangle mask
+    (upscale/tile_ops.py `create_tile_mask`): the raised cosine is
+    separable, needs no conv, and sums smoothly where tiles overlap.
+    Every weight is strictly positive so the normalising weight map
+    never divides by zero. Cached per (shape, padding).
+    """
+    return jnp.asarray(
+        _feather_mask_np(grid.padded_h, grid.padded_w, grid.padding), dtype=dtype
+    )
+
+
+def blend_tiles(tiles: jax.Array, grid: TileGrid) -> jax.Array:
+    """[T, B, th+2p, tw+2p, C] processed tiles → [B, H, W, C] blended.
+
+    Order-independent (up to float accumulation order): weighted
+    accumulation into a padded canvas plus a weight map, then normalize
+    and crop. Which participant produced which tile doesn't matter —
+    the property the reference has to engineer with sorted sequential
+    blending (upscale/modes/static.py:521-553).
+    """
+    batch, channels = int(tiles.shape[1]), int(tiles.shape[4])
+    p = grid.padding
+    ph, pw = grid.image_h + 2 * p, grid.image_w + 2 * p
+    mask = feather_mask(grid, dtype=tiles.dtype)[None, :, :, None]
+    pos = grid.positions_array()
+
+    canvas = jnp.zeros((batch, ph, pw, channels), dtype=jnp.float32)
+    weights = jnp.zeros((1, ph, pw, 1), dtype=jnp.float32)
+
+    def body(carry, inputs):
+        canvas, weights = carry
+        tile, yx = inputs
+        weighted = (tile * mask).astype(jnp.float32)
+        canvas = jax.lax.dynamic_update_slice(
+            canvas,
+            jax.lax.dynamic_slice(
+                canvas, (0, yx[0], yx[1], 0),
+                (batch, grid.padded_h, grid.padded_w, channels),
+            )
+            + weighted,
+            (0, yx[0], yx[1], 0),
+        )
+        weights = jax.lax.dynamic_update_slice(
+            weights,
+            jax.lax.dynamic_slice(
+                weights, (0, yx[0], yx[1], 0), (1, grid.padded_h, grid.padded_w, 1)
+            )
+            + mask.astype(jnp.float32),
+            (0, yx[0], yx[1], 0),
+        )
+        return (canvas, weights), None
+
+    (canvas, weights), _ = jax.lax.scan(body, (canvas, weights), (tiles, pos))
+    blended = canvas / jnp.maximum(weights, 1e-8)
+    return blended[:, p : p + grid.image_h, p : p + grid.image_w, :].astype(
+        tiles.dtype
+    )
+
+
+class IncrementalCanvas:
+    """Alpha-composite tiles one at a time onto a canvas padded once.
+
+    The elastic-tier blend path, where tiles arrive incrementally over
+    HTTP (reference upscale/tile_ops.py `blend_tile`): pad the base
+    image once, composite each arriving tile into the padded canvas
+    with the cached feather mask, crop once at the end — O(tile) work
+    per tile instead of O(image).
+    """
+
+    def __init__(self, images: jax.Array, grid: TileGrid):
+        self.grid = grid
+        self.padded = pad_image_for_grid(images, grid)
+        self._mask = feather_mask(grid, dtype=images.dtype)[None, :, :, None]
+
+    def blend(self, tile: jax.Array, y, x) -> None:
+        """Composite one [B, th+2p, tw+2p, C] tile at unpadded origin (y, x)."""
+        region = jax.lax.dynamic_slice(
+            self.padded,
+            (0, y, x, 0),
+            (self.padded.shape[0], self.grid.padded_h, self.grid.padded_w,
+             self.padded.shape[3]),
+        )
+        blended = region * (1.0 - self._mask) + tile * self._mask
+        self.padded = jax.lax.dynamic_update_slice(self.padded, blended, (0, y, x, 0))
+
+    def result(self) -> jax.Array:
+        p = self.grid.padding
+        return self.padded[
+            :, p : p + self.grid.image_h, p : p + self.grid.image_w, :
+        ]
+
+
+def blend_single_tile(
+    canvas: jax.Array, tile: jax.Array, y: int, x: int, grid: TileGrid
+) -> jax.Array:
+    """One-shot convenience wrapper over IncrementalCanvas (prefer the
+    class when blending many tiles — it pads the canvas only once)."""
+    inc = IncrementalCanvas(canvas, grid)
+    inc.blend(tile, y, x)
+    return inc.result()
+
+
+def upscale_nearest(images: jax.Array, scale: int) -> jax.Array:
+    """Cheap integer-factor spatial upscale [B,H,W,C] used before tiled
+    re-diffusion (the reference delegates this to an upscale model or
+    PIL resize; lanczos/bicubic live in ops/resize.py)."""
+    b, h, w, c = images.shape
+    return jax.image.resize(images, (b, h * scale, w * scale, c), method="nearest")

@@ -1,0 +1,16 @@
+"""SPMD parallelism layer: mesh/topology, sharding rules, collectives.
+
+This layer replaces the reference's process-per-GPU + HTTP fabric for
+all participants that live inside one pod slice. A "worker" here is an
+index along the mesh's data axis; dispatch is sharding; collection is
+an all-gather over ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    data_axis_size,
+    describe_topology,
+    local_device_count,
+)
+from .seeds import fold_seed_for_participant, participant_keys  # noqa: F401

@@ -1,0 +1,63 @@
+"""Collective result collection — the in-slice collector.
+
+The reference's DistributedCollector moves every worker's images to the
+master as base64 PNG over HTTP (nodes/collector.py:84-119). Inside a
+pod slice that entire path collapses into an all-gather over ICI: each
+participant's batch lives sharded along the data axis, and "collection"
+is materialising the global array (ordered master-first by construction
+— participant 0 is the master's mesh index).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import DATA_AXIS
+
+
+def all_gather_batch(x: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
+    """Inside shard_map: gather every participant's batch, concatenated
+    along the leading axis in participant order."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def psum_scalar(x: jax.Array, axis_name: str = DATA_AXIS) -> jax.Array:
+    return jax.lax.psum(x, axis_name)
+
+
+def host_collect(sharded: jax.Array) -> np.ndarray:
+    """Materialise a (possibly sharded) global array on the host.
+
+    Single-process: device_get handles cross-device gathering over ICI.
+    Multi-process meshes require fully-addressable arrays; callers on
+    multihost meshes should keep outputs replicated or use
+    multihost_utils.process_allgather (gated: not needed single-host).
+    """
+    if not sharded.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(sharded, tiled=True))
+    return np.asarray(jax.device_get(sharded))
+
+
+def reorder_participant_first(
+    batches: dict[int, Any], enabled_order: list[int]
+) -> list[Any]:
+    """Deterministic ordering for the elastic (HTTP) tier: master (index
+    0) first, then enabled workers in configured order, then stragglers
+    sorted — parity with nodes/collector.py:193-236."""
+    ordered: list[Any] = []
+    seen: set[int] = set()
+    for idx in [0, *enabled_order]:
+        if idx in batches and idx not in seen:
+            ordered.append(batches[idx])
+            seen.add(idx)
+    for idx in sorted(batches):
+        if idx not in seen:
+            ordered.append(batches[idx])
+            seen.add(idx)
+    return ordered

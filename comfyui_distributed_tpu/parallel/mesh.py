@@ -1,0 +1,134 @@
+"""Device mesh construction and TPU topology enumeration.
+
+The TPU-native replacement for the reference's worker registry of
+CUDA devices (reference workers/detection.py + api/worker_routes.py
+`_get_cuda_info`): participants inside a slice are logical indices
+along the mesh's "data" axis, and model sharding (tensor / FSDP) uses
+the "model" axis. Multi-host pods extend the same mesh over DCN via
+jax.distributed initialization.
+
+Axis conventions used throughout the framework:
+    data   — seed/batch replication axis (one "worker" per index)
+    model  — tensor/FSDP sharding axis within a participant
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.exceptions import MeshError
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: axis name → size (-1 = infer remainder)."""
+
+    axes: dict[str, int]
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dict(self.axes)
+        unknown = [name for name, size in sizes.items() if size == -1]
+        if len(unknown) > 1:
+            raise MeshError(f"at most one -1 axis allowed, got {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if known == 0 or n_devices % known != 0:
+                raise MeshError(
+                    f"cannot infer axis {unknown[0]}: {n_devices} devices not divisible by {known}"
+                )
+            sizes[unknown[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise MeshError(
+                f"mesh {sizes} does not cover {n_devices} devices"
+            )
+        return sizes
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def build_mesh(
+    spec: MeshSpec | dict[str, int] | None = None,
+    devices: Sequence[Any] | None = None,
+) -> Mesh:
+    """Build a named mesh over the given (default: all) devices.
+
+    Default layout is a pure data mesh — every chip is one participant,
+    the TPU analog of the reference's one-worker-per-GPU auto-populate
+    (reference web/masterDetection.js:36-104, done UI-side there;
+    runtime-side here).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if not devices:
+        raise MeshError("no devices available")
+    if spec is None:
+        spec = MeshSpec({DATA_AXIS: -1, MODEL_AXIS: 1})
+    elif isinstance(spec, dict):
+        spec = MeshSpec(dict(spec))
+    sizes = spec.resolve(len(devices))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes[n] for n in names)
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return int(mesh.shape.get(DATA_AXIS, 1))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Shard the leading (batch) axis across participants."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def describe_topology() -> dict[str, Any]:
+    """Enumerate local accelerator topology for the control plane.
+
+    The TPU replacement for the reference's `/distributed/system_info`
+    CUDA enumeration (api/worker_routes.py:237-274): chip ids, platform,
+    coords, process index, and any chip-visibility pinning.
+    """
+    devices = jax.devices()
+    local = jax.local_devices()
+    info: dict[str, Any] = {
+        "platform": devices[0].platform if devices else "none",
+        "device_count": len(devices),
+        "local_device_count": len(local),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "visible_chips": os.environ.get("TPU_VISIBLE_CHIPS"),
+        "devices": [],
+    }
+    for dev in local:
+        entry: dict[str, Any] = {
+            "id": dev.id,
+            "platform": dev.platform,
+            "process_index": dev.process_index,
+        }
+        for attr in ("coords", "core_on_chip", "device_kind", "memory_stats"):
+            try:
+                value = getattr(dev, attr, None)
+                value = value() if callable(value) else value
+            except Exception:
+                value = None
+            if value is not None:
+                entry[attr] = value
+        info["devices"].append(entry)
+    return info

@@ -1,0 +1,51 @@
+"""Deterministic per-participant seed derivation.
+
+The reference offsets an integer seed per worker (`seed + worker_index
++ 1`, nodes/utilities.py:52-75) via prompt rewriting. TPU-native, the
+same contract is a pure function of (base seed, participant index):
+`jax.random.fold_in` gives statistically independent streams and works
+both outside jit (per-participant dispatch) and inside shard_map (the
+participant index comes from `lax.axis_index`).
+
+Two derivations are provided:
+- `offset_seed`: exact integer-offset parity with the reference, for
+  the HTTP tier where remote workers receive a plain integer seed.
+- `fold_seed_for_participant` / `participant_keys`: the mesh tier's
+  fold_in derivation (preferred: no birthday-adjacent stream overlap
+  when users sweep base seeds).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS
+
+MAX_SEED = 2**63 - 1
+
+
+def offset_seed(base_seed: int, participant_index: int) -> int:
+    """Reference-parity integer seed: master keeps base, worker i gets
+    base + i + 1 (wrapping at the 63-bit boundary)."""
+    if participant_index <= 0:
+        return int(base_seed) % (MAX_SEED + 1)
+    return (int(base_seed) + participant_index) % (MAX_SEED + 1)
+
+
+def fold_seed_for_participant(key: jax.Array, participant_index) -> jax.Array:
+    """Derive one participant's PRNG key; traceable under jit/shard_map."""
+    return jax.random.fold_in(key, participant_index)
+
+
+def participant_keys(key: jax.Array, n_participants: int) -> jax.Array:
+    """[n, 2] stacked keys for all participants — shard axis 0 over the
+    data axis and each chip picks up its own stream."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n_participants)
+    )
+
+
+def local_participant_key(key: jax.Array) -> jax.Array:
+    """Inside shard_map over the data axis: this chip's key."""
+    return jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))

@@ -1,0 +1,1 @@
+"""Foundation utilities (L1). Everything above depends on this layer."""

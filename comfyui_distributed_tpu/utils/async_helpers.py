@@ -1,0 +1,137 @@
+"""Sync ↔ async bridging.
+
+The graph executor runs nodes on a plain worker thread (compute must
+not block the control-plane event loop, and jitted JAX dispatch is
+synchronous), while all distributed state (job queues, HTTP) lives on
+one asyncio loop. `run_async_in_server_loop` is the keystone bridging
+the two — behavior parity with reference utils/async_helpers.py:13-54
+(run_coroutine_threadsafe + bounded wait + cancellation on timeout).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Awaitable, Optional
+
+from .exceptions import DistributedError
+
+_server_loop: Optional[asyncio.AbstractEventLoop] = None
+_loop_lock = threading.Lock()
+
+
+def set_server_loop(loop: Optional[asyncio.AbstractEventLoop]) -> None:
+    """Register the control-plane event loop (called by the runtime at boot)."""
+    global _server_loop
+    with _loop_lock:
+        _server_loop = loop
+
+
+def get_server_loop() -> Optional[asyncio.AbstractEventLoop]:
+    with _loop_lock:
+        return _server_loop
+
+
+def run_async_in_server_loop(
+    coroutine: Awaitable[Any], timeout: float | None = None
+) -> Any:
+    """Run `coroutine` on the registered server loop from a sync thread.
+
+    Falls back to `asyncio.run` when no loop is registered (hermetic
+    tests, standalone CLI use). Raises TimeoutError on expiry after
+    cancelling the remote task so it doesn't leak.
+    """
+    loop = get_server_loop()
+    if loop is None or not loop.is_running():
+        return asyncio.run(_fallback_run(coroutine, timeout))
+    if _running_on(loop):
+        raise DistributedError(
+            "run_async_in_server_loop called from the server loop itself; "
+            "this would deadlock — await the coroutine directly instead"
+        )
+    future = asyncio.run_coroutine_threadsafe(coroutine, loop)
+    try:
+        return future.result(timeout=timeout)
+    except concurrent.futures.TimeoutError:
+        future.cancel()
+        raise TimeoutError(f"async operation timed out after {timeout}s") from None
+
+
+async def _with_timeout(coroutine: Awaitable[Any], timeout: float | None) -> Any:
+    if timeout is None:
+        return await coroutine
+    return await asyncio.wait_for(coroutine, timeout)
+
+
+async def _fallback_run(coroutine: Awaitable[Any], timeout: float | None) -> Any:
+    """asyncio.run wrapper for the no-server-loop case: any pooled HTTP
+    session created on this transient loop is closed before the loop
+    dies, so fallback calls don't leak connectors."""
+    try:
+        return await _with_timeout(coroutine, timeout)
+    finally:
+        from .network import close_client_session
+
+        await close_client_session()
+
+
+def _running_on(loop: asyncio.AbstractEventLoop) -> bool:
+    try:
+        return asyncio.get_running_loop() is loop
+    except RuntimeError:
+        return False
+
+
+class ServerLoopThread:
+    """Own an asyncio loop on a daemon thread (the control-plane loop).
+
+    The reference piggybacks on ComfyUI's PromptServer loop; our runtime
+    owns its own. `start()` registers the loop globally so
+    run_async_in_server_loop works from any compute thread.
+    """
+
+    def __init__(self, name: str = "cdt-server-loop"):
+        self._name = name
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        if self._loop is None:
+            raise DistributedError("server loop not started")
+        return self._loop
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, name=self._name, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+        set_server_loop(self._loop)
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._started.set()
+        self._loop.run_forever()
+        # Drain pending tasks on shutdown.
+        pending = asyncio.all_tasks(self._loop)
+        for task in pending:
+            task.cancel()
+        if pending:
+            self._loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if get_server_loop() is self._loop:
+            set_server_loop(None)
+        self._thread = None
+        self._loop = None

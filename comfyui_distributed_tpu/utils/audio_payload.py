@@ -1,0 +1,65 @@
+"""Audio payload envelope for the HTTP tier.
+
+Waveforms travel as base64 raw float32 bytes with an explicit
+shape/dtype/sample_rate envelope and a hard size cap — parity with
+reference utils/audio_payload.py:16-103. Canonical audio layout is
+[B, C, S] float32 with samples last (concat axis = -1, matching the
+collector's audio combine).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import numpy as np
+
+from .constants import MAX_AUDIO_PAYLOAD_BYTES
+from .exceptions import DistributedError
+
+
+def encode_audio_payload(waveform, sample_rate: int) -> dict[str, Any]:
+    arr = np.ascontiguousarray(np.asarray(waveform, dtype=np.float32))
+    raw = arr.tobytes()
+    if len(raw) > MAX_AUDIO_PAYLOAD_BYTES:
+        raise DistributedError(
+            f"audio payload {len(raw)} bytes exceeds cap {MAX_AUDIO_PAYLOAD_BYTES}"
+        )
+    return {
+        "data": base64.b64encode(raw).decode("ascii"),
+        "shape": list(arr.shape),
+        "dtype": "float32",
+        "sample_rate": int(sample_rate),
+    }
+
+
+def decode_audio_payload(payload: dict[str, Any]) -> tuple[np.ndarray, int]:
+    if not isinstance(payload, dict):
+        raise DistributedError("audio payload must be a dict")
+    for key in ("data", "shape", "dtype", "sample_rate"):
+        if key not in payload:
+            raise DistributedError(f"audio payload missing '{key}'")
+    if payload["dtype"] != "float32":
+        raise DistributedError(f"unsupported audio dtype {payload['dtype']!r}")
+    raw = base64.b64decode(payload["data"])
+    if len(raw) > MAX_AUDIO_PAYLOAD_BYTES:
+        raise DistributedError("audio payload exceeds size cap")
+    shape = tuple(int(d) for d in payload["shape"])
+    expected = int(np.prod(shape)) * 4 if shape else 0
+    if expected != len(raw):
+        raise DistributedError(
+            f"audio payload size mismatch: shape {shape} wants {expected} bytes, got {len(raw)}"
+        )
+    arr = np.frombuffer(raw, dtype=np.float32).reshape(shape).copy()
+    return arr, int(payload["sample_rate"])
+
+
+def combine_audio(payloads: list[tuple[np.ndarray, int]]) -> tuple[np.ndarray, int]:
+    """Concatenate waveforms along the samples axis (dim=-1)."""
+    if not payloads:
+        raise DistributedError("no audio to combine")
+    rates = {rate for _, rate in payloads}
+    if len(rates) != 1:
+        raise DistributedError(f"mismatched sample rates: {sorted(rates)}")
+    arrays = [arr for arr, _ in payloads]
+    return np.concatenate(arrays, axis=-1), rates.pop()

@@ -1,0 +1,90 @@
+"""Central timeouts, intervals, and env-var overrides.
+
+Parity with reference utils/constants.py (all knobs kept, names adapted
+to the TPU runtime). Every value can be overridden by an environment
+variable so deployments can tune without code changes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# --- roles ---------------------------------------------------------------
+# Worker processes are launched with this env var set; it suppresses
+# master-side startup behavior (auto-launch, signal cleanup).
+# Reference: distributed.py:48 (COMFYUI_IS_WORKER).
+WORKER_ENV_FLAG = "CDT_IS_WORKER"
+MASTER_PID_ENV = "CDT_MASTER_PID"
+# Chip pinning for process-per-chip compatibility mode (the TPU analog of
+# CUDA_VISIBLE_DEVICES in workers/process/lifecycle.py:33).
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+
+# --- heartbeat / liveness ------------------------------------------------
+# Reference utils/constants.py:43-47 (COMFYUI_HEARTBEAT_*).
+HEARTBEAT_INTERVAL_SECONDS = _env_float("CDT_HEARTBEAT_INTERVAL", 5.0)
+HEARTBEAT_TIMEOUT_SECONDS = _env_float("CDT_HEARTBEAT_TIMEOUT", 60.0)
+# The collector waits in slices of timeout/20 so interrupts propagate fast.
+COLLECTOR_WAIT_SLICES = _env_int("CDT_COLLECTOR_WAIT_SLICES", 20)
+
+# --- payloads ------------------------------------------------------------
+# Reference upscale/job_store.py:12 (COMFYUI_MAX_PAYLOAD_SIZE 50MB) and
+# utils/constants.py:43 (MAX_BATCH=20 tiles per flush).
+MAX_PAYLOAD_SIZE = _env_int("CDT_MAX_PAYLOAD_SIZE", 50 * 1024 * 1024)
+PAYLOAD_HEADROOM = 1024 * 1024
+MAX_TILE_BATCH = _env_int("CDT_MAX_BATCH", 20)
+MAX_AUDIO_PAYLOAD_BYTES = _env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
+
+# --- orchestration concurrency ------------------------------------------
+# Reference api/queue_orchestration.py semaphores (probe=8/prep=4/media=2)
+# and utils/constants.py COMFYUI_ORCHESTRATION_* env overrides.
+PROBE_CONCURRENCY = _env_int("CDT_ORCHESTRATION_PROBE_CONCURRENCY", 8)
+PREP_CONCURRENCY = _env_int("CDT_ORCHESTRATION_PREP_CONCURRENCY", 4)
+MEDIA_SYNC_CONCURRENCY = _env_int("CDT_ORCHESTRATION_MEDIA_CONCURRENCY", 2)
+MEDIA_SYNC_TIMEOUT_SECONDS = _env_float("CDT_MEDIA_SYNC_TIMEOUT", 120.0)
+
+# --- probes / retries ----------------------------------------------------
+PROBE_TIMEOUT_SECONDS = _env_float("CDT_PROBE_TIMEOUT", 5.0)
+DISPATCH_TIMEOUT_SECONDS = _env_float("CDT_DISPATCH_TIMEOUT", 30.0)
+REQUEST_RETRY_COUNT = _env_int("CDT_REQUEST_RETRIES", 5)
+REQUEST_RETRY_BACKOFF = _env_float("CDT_REQUEST_BACKOFF", 0.5)
+WORK_PULL_RETRY_COUNT = _env_int("CDT_WORK_PULL_RETRIES", 10)
+WORK_PULL_RETRY_CAP_SECONDS = _env_float("CDT_WORK_PULL_RETRY_CAP", 30.0)
+
+# --- job init races ------------------------------------------------------
+# Grace period a result-submission endpoint waits for the master-side queue
+# to be created (reference api/job_routes.py:314-333), and the worker-side
+# job-ready poll (reference upscale/modes/static.py:33-47).
+JOB_INIT_GRACE_SECONDS = _env_float("CDT_JOB_INIT_GRACE", 10.0)
+JOB_READY_POLL_ATTEMPTS = _env_int("CDT_JOB_READY_POLLS", 20)
+JOB_READY_POLL_INTERVAL = _env_float("CDT_JOB_READY_POLL_INTERVAL", 1.0)
+QUEUE_POLL_INTERVAL_SECONDS = _env_float("CDT_QUEUE_POLL_INTERVAL", 0.1)
+
+# --- worker lifecycle ----------------------------------------------------
+AUTO_LAUNCH_DELAY_SECONDS = _env_float("CDT_AUTO_LAUNCH_DELAY", 2.0)
+MONITOR_POLL_INTERVAL_SECONDS = _env_float("CDT_MONITOR_POLL_INTERVAL", 2.0)
+WORKER_LAUNCH_GRACE_SECONDS = _env_float("CDT_LAUNCH_GRACE", 90.0)
+TUNNEL_START_TIMEOUT = _env_float("CDT_TUNNEL_START_TIMEOUT", 30.0)
+
+# --- network -------------------------------------------------------------
+DEFAULT_MASTER_PORT = _env_int("CDT_MASTER_PORT", 8188)
+FIRST_WORKER_PORT = _env_int("CDT_FIRST_WORKER_PORT", 8189)
+CONNECTION_POOL_LIMIT = _env_int("CDT_CONN_POOL_LIMIT", 100)
+CONNECTION_POOL_PER_HOST = _env_int("CDT_CONN_POOL_PER_HOST", 30)
+
+# --- debug ---------------------------------------------------------------
+DEBUG_FLAG_TTL_SECONDS = 5.0

@@ -1,0 +1,59 @@
+"""Error hierarchy for the distributed runtime.
+
+Parity with reference utils/exceptions.py, extended with mesh/compile
+errors that only exist in the TPU runtime.
+"""
+
+from __future__ import annotations
+
+
+class DistributedError(Exception):
+    """Base class for all framework errors."""
+
+
+class WorkerError(DistributedError):
+    """A worker failed or returned an invalid response."""
+
+    def __init__(self, message: str, worker_id: str | int | None = None):
+        super().__init__(message)
+        self.worker_id = worker_id
+
+
+class WorkerTimeoutError(WorkerError):
+    """A worker missed its heartbeat/response deadline."""
+
+
+class WorkerNotAvailableError(WorkerError):
+    """A worker could not be reached at dispatch/probe time."""
+
+
+class JobQueueError(DistributedError):
+    """Job queue state is missing or inconsistent."""
+
+
+class TileCollectionError(DistributedError):
+    """Collecting tile/image results failed irrecoverably."""
+
+
+class ProcessError(DistributedError):
+    """Worker process launch/termination failed."""
+
+
+class TunnelError(DistributedError):
+    """Tunnel management failed."""
+
+
+class PromptValidationError(DistributedError):
+    """A workflow graph failed validation before execution."""
+
+    def __init__(self, message: str, node_errors: dict | None = None):
+        super().__init__(message)
+        self.node_errors = node_errors or {}
+
+
+class MeshError(DistributedError):
+    """TPU mesh construction or sharding layout failed."""
+
+
+class CompileError(DistributedError):
+    """A jitted computation failed to trace/compile."""

@@ -1,0 +1,94 @@
+"""Image tensor codecs for the HTTP tier.
+
+Arrays are channel-last float [B, H, W, C] in [0, 1] (the framework's
+canonical image layout — matches both the reference's torch layout and
+TPU-friendly NHWC). Conversion to PIL/PNG happens only at the HTTP
+boundary; inside a slice images stay on device. Parity: reference
+utils/image.py + the base64 PNG data-URL envelope of
+nodes/collector.py:84-119.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+from PIL import Image
+
+DATA_URL_PREFIX = "data:image/png;base64,"
+
+
+def ensure_numpy(tensor) -> np.ndarray:
+    """Accept jnp/np/torch-like arrays; return contiguous float32 numpy."""
+    if hasattr(tensor, "detach"):  # torch tensor
+        tensor = tensor.detach().cpu().numpy()
+    arr = np.asarray(tensor, dtype=np.float32)
+    return np.ascontiguousarray(arr)
+
+
+def array_to_pil(image) -> Image.Image:
+    """[H, W, C] float in [0,1] → PIL RGB(A) image."""
+    arr = ensure_numpy(image)
+    if arr.ndim == 4:
+        if arr.shape[0] != 1:
+            raise ValueError(f"expected single image, got batch {arr.shape}")
+        arr = arr[0]
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    arr = np.clip(arr, 0.0, 1.0)
+    u8 = (arr * 255.0 + 0.5).astype(np.uint8)
+    if u8.shape[-1] == 1:
+        return Image.fromarray(u8[..., 0], mode="L")
+    mode = "RGBA" if u8.shape[-1] == 4 else "RGB"
+    return Image.fromarray(u8, mode=mode)
+
+
+def pil_to_array(img: Image.Image) -> np.ndarray:
+    """PIL image → [H, W, C] float32 in [0,1]."""
+    if img.mode not in ("RGB", "RGBA", "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr
+
+
+def encode_png(image, compress_level: int = 0) -> bytes:
+    """One image → PNG bytes. compress_level=0 trades size for speed on
+    the hot collector path, like the reference."""
+    buf = io.BytesIO()
+    array_to_pil(image).save(buf, format="PNG", compress_level=compress_level)
+    return buf.getvalue()
+
+
+def decode_png(data: bytes) -> np.ndarray:
+    with Image.open(io.BytesIO(data)) as img:
+        img.load()
+        return pil_to_array(img)
+
+
+def encode_image_data_url(image, compress_level: int = 0) -> str:
+    return DATA_URL_PREFIX + base64.b64encode(
+        encode_png(image, compress_level)
+    ).decode("ascii")
+
+
+def decode_image_data_url(data_url: str) -> np.ndarray:
+    payload = data_url
+    if payload.startswith("data:"):
+        _, _, payload = payload.partition(",")
+    return decode_png(base64.b64decode(payload))
+
+
+def batch_to_list(batch) -> list[np.ndarray]:
+    arr = ensure_numpy(batch)
+    if arr.ndim == 3:
+        arr = arr[None]
+    return [arr[i] for i in range(arr.shape[0])]
+
+
+def list_to_batch(images: list[np.ndarray]) -> np.ndarray:
+    if not images:
+        return np.zeros((0, 64, 64, 3), dtype=np.float32)
+    return np.stack([ensure_numpy(i) for i in images], axis=0)

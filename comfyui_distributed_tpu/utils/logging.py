@@ -1,0 +1,50 @@
+"""Logging with a hot-reloadable debug flag.
+
+Parity with reference utils/logging.py: `log` always prints,
+`debug_log` only when the config file's debug flag is on; the flag is
+re-read from disk with a short TTL cache so toggling debug in the UI
+takes effect on running processes without restarts.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable
+
+from .constants import DEBUG_FLAG_TTL_SECONDS
+
+PREFIX = "[Distributed-TPU]"
+
+_debug_cache: dict[str, Any] = {"value": False, "checked_at": 0.0}
+# Injectable so tests and the config module can supply the flag source
+# without import cycles (config imports logging).
+_debug_flag_reader: Callable[[], bool] | None = None
+
+
+def set_debug_flag_reader(reader: Callable[[], bool] | None) -> None:
+    """Install the function used to read the persistent debug flag."""
+    global _debug_flag_reader
+    _debug_flag_reader = reader
+    _debug_cache["checked_at"] = 0.0
+
+
+def is_debug_enabled(now: float | None = None) -> bool:
+    now = time.monotonic() if now is None else now
+    if now - _debug_cache["checked_at"] >= DEBUG_FLAG_TTL_SECONDS:
+        _debug_cache["checked_at"] = now
+        if _debug_flag_reader is not None:
+            try:
+                _debug_cache["value"] = bool(_debug_flag_reader())
+            except Exception:
+                pass
+    return bool(_debug_cache["value"])
+
+
+def log(message: str) -> None:
+    print(f"{PREFIX} {message}", file=sys.stdout, flush=True)
+
+
+def debug_log(message: str) -> None:
+    if is_debug_enabled():
+        print(f"{PREFIX}[DEBUG] {message}", file=sys.stdout, flush=True)

@@ -1,0 +1,28 @@
+"""Per-execution trace logging.
+
+Every distributed queue execution gets a trace id `exec_<ms>_<uuid6>`
+threaded from the entry point through orchestration, dispatch, and
+collection, so one grep reconstructs the lifecycle of one job across
+master and worker logs. Parity: reference utils/trace_logger.py +
+api/queue_orchestration.py:38-39.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from .logging import debug_log, log
+
+
+def generate_trace_id(node_hint: str | None = None) -> str:
+    base = f"exec_{int(time.time() * 1000)}_{uuid.uuid4().hex[:6]}"
+    return f"{base}_{node_hint}" if node_hint else base
+
+
+def trace_info(trace_id: str, message: str) -> None:
+    log(f"[exec:{trace_id}] {message}")
+
+
+def trace_debug(trace_id: str, message: str) -> None:
+    debug_log(f"[exec:{trace_id}] {message}")

@@ -1,0 +1,95 @@
+"""Mesh construction, seed folding, and the collective collector on a
+virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.parallel import collective, mesh as meshmod, seeds
+from comfyui_distributed_tpu.utils.exceptions import MeshError
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_build_default_mesh():
+    m = meshmod.build_mesh()
+    assert meshmod.data_axis_size(m) == 8
+    assert m.shape[meshmod.MODEL_AXIS] == 1
+
+
+def test_mesh_spec_infer_and_errors():
+    m = meshmod.build_mesh({"data": 2, "model": -1})
+    assert m.shape["model"] == 4
+    with pytest.raises(MeshError):
+        meshmod.MeshSpec({"data": 3, "model": -1}).resolve(8)
+    with pytest.raises(MeshError):
+        meshmod.MeshSpec({"data": -1, "model": -1}).resolve(8)
+    with pytest.raises(MeshError):
+        meshmod.MeshSpec({"data": 5}).resolve(8)
+
+
+def test_offset_seed_reference_parity():
+    # master (index 0) keeps the base seed; worker i gets base + i + 1
+    # matching reference nodes/utilities.py:52-75 where worker_index is
+    # 0-based and the node adds (index + 1).
+    assert seeds.offset_seed(100, 0) == 100
+    assert seeds.offset_seed(100, 1) == 101
+    assert seeds.offset_seed(100, 3) == 103
+    assert seeds.offset_seed(seeds.MAX_SEED, 1) == 0
+
+
+def test_participant_keys_distinct_and_deterministic():
+    key = jax.random.key(42)
+    ks = seeds.participant_keys(key, 8)
+    raw = np.asarray(jax.random.key_data(ks))
+    assert raw.shape[0] == 8
+    assert len({tuple(r) for r in raw}) == 8
+    ks2 = seeds.participant_keys(jax.random.key(42), 8)
+    np.testing.assert_array_equal(raw, np.asarray(jax.random.key_data(ks2)))
+
+
+def test_shard_map_collector_gathers_in_participant_order():
+    m = meshmod.build_mesh({"data": 8})
+
+    def per_chip(_):
+        idx = jax.lax.axis_index(meshmod.DATA_AXIS)
+        mine = jnp.full((1, 4), idx, dtype=jnp.float32)
+        # The collector: every chip contributes its batch, the gathered
+        # result is replicated (out_specs=P()) in participant order.
+        return collective.all_gather_batch(mine)
+
+    out = jax.jit(
+        jax.shard_map(
+            per_chip,
+            mesh=m,
+            in_specs=jax.sharding.PartitionSpec(),
+            out_specs=jax.sharding.PartitionSpec(),
+            check_vma=False,
+        )
+    )(jnp.zeros((1,)))
+    gathered = collective.host_collect(out)
+    assert gathered.shape == (8, 4)
+    np.testing.assert_array_equal(gathered[:, 0], np.arange(8, dtype=np.float32))
+
+
+def test_reorder_participant_first():
+    batches = {3: "w3", 0: "master", 7: "w7", 1: "w1"}
+    ordered = collective.reorder_participant_first(batches, enabled_order=[1, 3])
+    assert ordered == ["master", "w1", "w3", "w7"]
+
+
+def test_fsdp_specs():
+    from comfyui_distributed_tpu.parallel import sharding
+
+    m = meshmod.build_mesh({"data": 2, "model": 4})
+    spec = sharding.fsdp_spec_for((128, 256), 4)
+    assert spec == jax.sharding.PartitionSpec(None, "model")
+    assert sharding.fsdp_spec_for((3,), 4) == jax.sharding.PartitionSpec()
+    params = {"w": np.ones((16, 8), np.float32), "b": np.ones((3,), np.float32)}
+    placed = sharding.shard_params(params, m)
+    assert placed["w"].sharding.spec == jax.sharding.PartitionSpec("model", None)
+    total = collective.host_collect(placed["w"]).sum()
+    assert total == 16 * 8

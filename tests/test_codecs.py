@@ -1,0 +1,60 @@
+"""Image/audio codec round-trips (HTTP-tier envelopes)."""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.utils import audio_payload, image
+from comfyui_distributed_tpu.utils.exceptions import DistributedError
+
+
+def test_png_roundtrip_exact_u8():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(32, 48, 3)).astype(np.float32) / 255.0
+    out = image.decode_png(image.encode_png(img))
+    assert out.shape == (32, 48, 3)
+    np.testing.assert_allclose(out, img, atol=1 / 255 / 2)
+
+
+def test_data_url_roundtrip():
+    img = np.zeros((8, 8, 3), dtype=np.float32)
+    img[2:4, 3:6, 0] = 1.0
+    url = image.encode_image_data_url(img)
+    assert url.startswith(image.DATA_URL_PREFIX)
+    out = image.decode_image_data_url(url)
+    np.testing.assert_allclose(out, img, atol=1 / 255)
+
+
+def test_batch_list_roundtrip():
+    batch = np.random.default_rng(1).random((3, 4, 4, 3)).astype(np.float32)
+    imgs = image.batch_to_list(batch)
+    assert len(imgs) == 3
+    np.testing.assert_array_equal(image.list_to_batch(imgs), batch)
+
+
+def test_audio_roundtrip():
+    wave = np.random.default_rng(2).standard_normal((1, 2, 1000)).astype(np.float32)
+    payload = audio_payload.encode_audio_payload(wave, 44100)
+    out, rate = audio_payload.decode_audio_payload(payload)
+    assert rate == 44100
+    np.testing.assert_array_equal(out, wave)
+
+
+def test_audio_rejects_bad_envelope():
+    wave = np.zeros((1, 1, 10), dtype=np.float32)
+    payload = audio_payload.encode_audio_payload(wave, 16000)
+    bad = dict(payload)
+    bad["shape"] = [1, 1, 99]
+    with pytest.raises(DistributedError):
+        audio_payload.decode_audio_payload(bad)
+    with pytest.raises(DistributedError):
+        audio_payload.decode_audio_payload({"data": "xx"})
+
+
+def test_audio_combine_concat_last_axis():
+    a = np.ones((1, 2, 5), dtype=np.float32)
+    b = np.zeros((1, 2, 3), dtype=np.float32)
+    combined, rate = audio_payload.combine_audio([(a, 8000), (b, 8000)])
+    assert combined.shape == (1, 2, 8)
+    assert rate == 8000
+    with pytest.raises(DistributedError):
+        audio_payload.combine_audio([(a, 8000), (b, 16000)])

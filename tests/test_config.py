@@ -1,0 +1,83 @@
+"""Config system: defaults merge, atomic save, mtime cache, transaction.
+
+Mirrors the coverage of reference tests/test_config.py against our
+re-designed implementation.
+"""
+
+import asyncio
+import json
+import os
+
+from comfyui_distributed_tpu.utils import config as cfg
+
+
+def test_defaults_when_missing(tmp_config_path):
+    loaded = cfg.load_config()
+    assert loaded["settings"]["debug"] is False
+    assert loaded["workers"] == []
+    assert loaded["mesh"]["axes"]["data"] == -1
+
+
+def test_merge_preserves_unknown_keys(tmp_config_path):
+    with open(tmp_config_path, "w") as fh:
+        json.dump(
+            {
+                "settings": {"debug": True, "my_custom_flag": 7},
+                "frontier": {"x": 1},
+            },
+            fh,
+        )
+    loaded = cfg.load_config()
+    assert loaded["settings"]["debug"] is True
+    assert loaded["settings"]["my_custom_flag"] == 7
+    assert loaded["frontier"] == {"x": 1}
+    # defaults still present
+    assert "worker_timeout_seconds" in loaded["settings"]
+
+
+def test_save_and_reload_roundtrip(tmp_config_path):
+    config = cfg.load_config()
+    config["workers"].append(
+        {"id": "w0", "name": "chip0", "type": "mesh", "tpu_chips": [1], "enabled": True}
+    )
+    cfg.save_config(config)
+    # no tmp litter
+    directory = os.path.dirname(tmp_config_path)
+    assert not [f for f in os.listdir(directory) if f.endswith(".tmp")]
+    again = cfg.load_config()
+    assert again["workers"][0]["id"] == "w0"
+    assert cfg.get_enabled_workers()[0]["name"] == "chip0"
+
+
+def test_mtime_cache_returns_copy(tmp_config_path):
+    first = cfg.load_config()
+    first["settings"]["debug"] = True  # mutate the returned copy
+    second = cfg.load_config()
+    assert second["settings"]["debug"] is False
+
+
+def test_transaction_persists_only_on_change(tmp_config_path):
+    async def scenario():
+        async with cfg.config_transaction() as config:
+            config["settings"]["debug"] = True
+        assert os.path.exists(tmp_config_path)
+        mtime = os.path.getmtime(tmp_config_path)
+        async with cfg.config_transaction() as config:
+            pass  # no mutation → no write
+        assert os.path.getmtime(tmp_config_path) == mtime
+
+    asyncio.run(scenario())
+
+
+def test_worker_timeout_fallbacks(tmp_config_path):
+    assert cfg.get_worker_timeout_seconds() == 60.0
+    config = cfg.load_config()
+    config["settings"]["worker_timeout_seconds"] = "nonsense"
+    cfg.save_config(config)
+    assert cfg.get_worker_timeout_seconds() == 60.0
+    config["settings"]["worker_timeout_seconds"] = -5
+    cfg.save_config(config)
+    assert cfg.get_worker_timeout_seconds() == 60.0
+    config["settings"]["worker_timeout_seconds"] = 120
+    cfg.save_config(config)
+    assert cfg.get_worker_timeout_seconds() == 120.0

@@ -1,0 +1,62 @@
+"""URL building / host normalization — parity with reference
+tests/test_network_helpers.py scenarios plus cloud-scheme heuristics."""
+
+from comfyui_distributed_tpu.utils import network as net
+
+
+def test_normalize_host():
+    assert net.normalize_host("http://10.0.0.2:8188/") == "10.0.0.2:8188"
+    assert net.normalize_host("https://foo.example.com") == "foo.example.com"
+    assert net.normalize_host("  localhost ") == "localhost"
+
+
+def test_split_host_port():
+    assert net.split_host_port("10.0.0.2:8188") == ("10.0.0.2", 8188)
+    assert net.split_host_port("myhost", 80) == ("myhost", 80)
+    assert net.split_host_port("[::1]:9000") == ("::1", 9000)
+    assert net.split_host_port("bad:port:xx", 7) == ("bad:port:xx", 7)
+
+
+def test_worker_url_local_http():
+    url = net.build_worker_url({"host": "192.168.1.5", "port": 8189, "type": "local"})
+    assert url == "http://192.168.1.5:8189"
+
+
+def test_worker_url_cloud_https():
+    url = net.build_worker_url({"host": "pod.example.io", "port": 443, "type": "cloud"})
+    assert url == "https://pod.example.io"
+
+
+def test_worker_url_runpod_proxy():
+    url = net.build_worker_url(
+        {"host": "abc-8188.proxy.runpod.net", "port": 0, "type": "remote"}
+    )
+    assert url.startswith("https://abc-8188.proxy.runpod.net")
+
+
+def test_worker_url_tunnel():
+    url = net.build_worker_url(
+        {"host": "rain-bow.trycloudflare.com", "port": 0, "type": "remote"},
+        "/distributed/heartbeat",
+    )
+    assert url == "https://rain-bow.trycloudflare.com/distributed/heartbeat"
+
+
+def test_master_callback_local_loopback():
+    url = net.build_master_callback_url(
+        {"type": "local", "host": "whatever.external.ip"}, "1.2.3.4", 8188, "/x"
+    )
+    assert url == "http://127.0.0.1:8188/x"
+
+
+def test_master_callback_remote_uses_master_host():
+    url = net.build_master_callback_url(
+        {"type": "remote", "host": "8.8.8.8"}, "34.1.2.3", 8188, "/x"
+    )
+    assert url == "http://34.1.2.3:8188/x"
+
+
+def test_is_private_host():
+    assert net.is_private_host("192.168.0.4:8188")
+    assert net.is_private_host("localhost")
+    assert not net.is_private_host("34.1.2.3")
