@@ -1,0 +1,86 @@
+"""Benchmark harness.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Primary metric: seed-parallel txt2img throughput (images/sec) across
+all available chips — the reference's headline capability ("generate
+multiple images in the time it takes to generate one", reference
+README.md:84-85). vs_baseline compares against the single-chip
+sequential rate measured in the same run, i.e. the parallel-scaling
+factor the reference achieves by adding GPU workers.
+
+Runs on whatever jax.devices() provides (one real TPU chip under the
+driver; CPU fallback works too, with BENCH_TINY=1 for quick checks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if os.environ.get("BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.models import pipeline as pl
+    from comfyui_distributed_tpu.parallel import build_mesh
+    from comfyui_distributed_tpu.parallel.generation import txt2img_parallel
+
+    n_dev = len(jax.devices())
+    model = "tiny-unet" if tiny else "sd15"
+    size = 64 if tiny else 512
+    steps = 4 if tiny else 20
+
+    bundle = pl.load_pipeline(model, seed=0)
+    mesh = build_mesh({"data": n_dev, "model": 1})
+
+    def run(seed: int):
+        out = txt2img_parallel(
+            bundle, mesh, "benchmark prompt", height=size, width=size,
+            steps=steps, seed=seed,
+        )
+        jax.block_until_ready(out)
+        return out
+
+    # warmup/compile
+    run(0)
+    t0 = time.perf_counter()
+    iters = 3
+    for i in range(iters):
+        run(i + 1)
+    elapsed = time.perf_counter() - t0
+    imgs_per_sec = (n_dev * iters) / elapsed
+
+    # single-image sequential rate on one chip for the scaling factor
+    single = pl.txt2img(
+        bundle, "benchmark prompt", height=size, width=size, steps=steps, seed=0
+    )
+    jax.block_until_ready(single)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = pl.txt2img(
+            bundle, "benchmark prompt", height=size, width=size, steps=steps,
+            seed=i + 1,
+        )
+        jax.block_until_ready(out)
+    single_rate = iters / (time.perf_counter() - t0)
+
+    result = {
+        "metric": f"txt2img imgs/sec ({model} {size}px {steps} steps, {n_dev} chip(s))",
+        "value": round(imgs_per_sec, 4),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / max(single_rate, 1e-9), 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Model zoo: latent-diffusion UNets, video DiT, VAE, text encoder.
+
+The reference delegates all model compute to ComfyUI/PyTorch
+(reference upscale/tile_ops.py:168 imports common_ksampler/VAEEncode/
+VAEDecode); this package is the from-scratch JAX substrate those
+capabilities run on here. All models are flax.linen modules designed
+mesh-first: bfloat16 compute on the MXU, channel-last NHWC layouts,
+shapes static under jit, and parameter trees whose largest axes
+divide cleanly for FSDP sharding.
+
+Families (configs in registry.py):
+    sd15  — 4-ch latent UNet, 768-d text context  (SD1.5 class)
+    sdxl  — 4-ch latent UNet, 2048-d context, deeper transformers
+    wan   — video DiT (3D patches, AdaLN, RoPE) in 1.3B/14B configs
+    vae   — KL autoencoder (8x spatial, 4-ch latents)
+    te    — CLIP-class causal text transformer
+Each family also ships a `tiny` config for hermetic CPU tests.
+"""
+
+from .registry import MODEL_REGISTRY, create_model, get_config  # noqa: F401

@@ -1,0 +1,188 @@
+"""Video diffusion transformer (WAN class), flax.linen.
+
+The model family behind the reference's WAN t2v/i2v workflows
+(reference workflows/distributed-wan*.json), rebuilt as a TPU-native
+DiT: 3D patchification of [B, F, H, W, C] video latents, joint
+spatio-temporal self-attention (sequence-parallel-ready token layout),
+cross-attention to text, AdaLN-zero timestep modulation, rotary
+position embeddings. Sized by config: wan-1.3b-class runs seed-parallel
+on a v5e-8; wan-14b-class FSDP-shards across a v5p-16 (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import timestep_embedding
+from ..ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class DiTConfig:
+    in_channels: int = 16
+    patch_size: tuple[int, int, int] = (1, 2, 2)  # (frames, h, w)
+    hidden_dim: int = 1536
+    depth: int = 30
+    heads: int = 12
+    context_dim: int = 4096
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _rope_freqs(dim: int, length: int, theta: float = 10000.0) -> np.ndarray:
+    inv = 1.0 / (theta ** (np.arange(0, dim, 2) / dim))
+    t = np.arange(length)
+    freqs = np.outer(t, inv)
+    return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1)  # [L, dim/2, 2]
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
+    """x: [B, N, H, D]; freqs: [N, D/2, 2]."""
+    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, 2)
+    cos = freqs[None, :, None, :, 0]
+    sin = freqs[None, :, None, :, 1]
+    out = jnp.stack(
+        [
+            xf[..., 0] * cos - xf[..., 1] * sin,
+            xf[..., 0] * sin + xf[..., 1] * cos,
+        ],
+        axis=-1,
+    )
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class _AdaLNBlock(nn.Module):
+    heads: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, cond: jax.Array, context: jax.Array, freqs: jax.Array
+    ) -> jax.Array:
+        dim = x.shape[-1]
+        head_dim = dim // self.heads
+        # 6-way modulation, zero-init so blocks start as identity
+        mod = nn.Dense(
+            6 * dim, dtype=jnp.float32, kernel_init=nn.initializers.zeros,
+            name="ada_mod",
+        )(nn.silu(cond.astype(jnp.float32)))
+        sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod[:, None, :], 6, axis=-1)
+
+        h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        h = (h * (1 + sc1) + sh1).astype(self.dtype)
+        b, n, _ = h.shape
+        q = nn.Dense(dim, dtype=self.dtype, name="q")(h).reshape(
+            b, n, self.heads, head_dim
+        )
+        k = nn.Dense(dim, dtype=self.dtype, name="k")(h).reshape(
+            b, n, self.heads, head_dim
+        )
+        v = nn.Dense(dim, dtype=self.dtype, name="v")(h).reshape(
+            b, n, self.heads, head_dim
+        )
+        q = apply_rope(q, freqs)
+        k = apply_rope(k, freqs)
+        attn = dot_product_attention(q, k, v).reshape(b, n, dim)
+        x = x + g1 * nn.Dense(dim, dtype=self.dtype, name="attn_proj")(attn)
+
+        # cross-attention to text (un-modulated, WAN-style)
+        h = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32)).astype(self.dtype)
+        m = context.shape[1]
+        qc = nn.Dense(dim, dtype=self.dtype, name="xq")(h).reshape(
+            b, n, self.heads, head_dim
+        )
+        kc = nn.Dense(dim, dtype=self.dtype, name="xk")(context).reshape(
+            b, m, self.heads, head_dim
+        )
+        vc = nn.Dense(dim, dtype=self.dtype, name="xv")(context).reshape(
+            b, m, self.heads, head_dim
+        )
+        xattn = dot_product_attention(qc, kc, vc).reshape(b, n, dim)
+        x = x + nn.Dense(dim, dtype=self.dtype, name="xattn_proj")(xattn)
+
+        h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        h = (h * (1 + sc2) + sh2).astype(self.dtype)
+        h = nn.Dense(dim * 4, dtype=self.dtype, name="mlp_fc1")(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(dim, dtype=self.dtype, name="mlp_fc2")(h)
+        return x + g2 * h
+
+
+class VideoDiT(nn.Module):
+    config: DiTConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,          # [B, F, H, W, C] noisy video latents
+        timesteps: jax.Array,  # [B]
+        context: jax.Array,    # [B, T, context_dim]
+    ) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b, f, hh, ww, c = x.shape
+        pf, ph, pw = cfg.patch_size
+        assert f % pf == 0 and hh % ph == 0 and ww % pw == 0, "patch misalign"
+        gf, gh, gw = f // pf, hh // ph, ww // pw
+        n = gf * gh * gw
+
+        # 3D patchify → tokens
+        tokens = x.reshape(b, gf, pf, gh, ph, gw, pw, c)
+        tokens = tokens.transpose(0, 1, 3, 5, 2, 4, 6, 7).reshape(
+            b, n, pf * ph * pw * c
+        )
+        tokens = nn.Dense(cfg.hidden_dim, dtype=dt, name="patch_embed")(
+            tokens.astype(dt)
+        )
+
+        cond = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="t_embed_0")(
+            timestep_embedding(timesteps, 256)
+        )
+        cond = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="t_embed_1")(
+            nn.silu(cond)
+        )
+
+        context = nn.Dense(cfg.hidden_dim, dtype=dt, name="context_proj")(
+            context.astype(dt)
+        )
+
+        head_dim = cfg.hidden_dim // cfg.heads
+        freqs = jnp.asarray(_rope_freqs(head_dim, n), dtype=jnp.float32)
+
+        for i in range(cfg.depth):
+            tokens = _AdaLNBlock(cfg.heads, dt, name=f"block_{i}")(
+                tokens, cond, context, freqs
+            )
+
+        # final AdaLN + unpatchify, zero-init output
+        mod = nn.Dense(
+            2 * cfg.hidden_dim, dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros, name="final_mod",
+        )(nn.silu(cond))
+        shift, scale = jnp.split(mod[:, None, :], 2, axis=-1)
+        h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
+            tokens.astype(jnp.float32)
+        )
+        h = h * (1 + scale) + shift
+        out = nn.Dense(
+            pf * ph * pw * cfg.in_channels,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros,
+            name="final_proj",
+        )(h)
+        out = out.reshape(b, gf, gh, gw, pf, ph, pw, cfg.in_channels)
+        out = out.transpose(0, 1, 4, 2, 5, 3, 6, 7).reshape(b, f, hh, ww, cfg.in_channels)
+        return out

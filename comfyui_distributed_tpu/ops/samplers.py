@@ -1,0 +1,243 @@
+"""Diffusion samplers and noise schedules — lax.scan step loops.
+
+The TPU-native replacement for the k-diffusion samplers the reference
+reaches through ComfyUI's `common_ksampler` (reference
+upscale/tile_ops.py:239-287 passes sampler_name/scheduler/cfg/denoise
+straight through). Same user-facing knobs (sampler name, scheduler,
+steps, cfg, denoise), implemented as scanned, jit-compilable loops:
+the whole sampling trajectory compiles to one XLA program — no host
+round-trip per step.
+
+Model contract: `model_fn(x, sigma_batch, cond) -> eps` (noise
+prediction, VP parameterisation with c_in = 1/sqrt(sigma^2+1), the
+SD-family convention). `denoised(x, sigma) = x - sigma * eps`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+ModelFn = Callable[[jax.Array, jax.Array, Any], jax.Array]
+
+SAMPLER_NAMES = ("euler", "euler_ancestral", "heun", "dpmpp_2m", "ddim")
+SCHEDULER_NAMES = ("karras", "normal", "simple", "exponential")
+
+
+# --- schedules -----------------------------------------------------------
+
+def _vp_sigmas(n_training: int = 1000):
+    """SD-style scaled-linear beta schedule → per-timestep sigmas.
+
+    Computed in numpy so schedules are concrete at trace time — they
+    are compile-time constants of the sampling program, never traced.
+    """
+    import numpy as np
+
+    betas = np.linspace(0.00085**0.5, 0.012**0.5, n_training) ** 2
+    alphas_cumprod = np.cumprod(1.0 - betas)
+    return np.sqrt((1 - alphas_cumprod) / alphas_cumprod)
+
+
+def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
+    """[steps+1] descending sigma schedule ending at 0.
+
+    `denoise < 1` truncates to the tail of the schedule (img2img /
+    tile re-diffusion strength, parity with the reference's `denoise`
+    input on USDU).
+    """
+    import numpy as np
+
+    all_sigmas = _vp_sigmas()
+    sigma_max = float(all_sigmas[-1])
+    sigma_min = float(all_sigmas[0])
+    total_steps = steps
+    if denoise < 1.0:
+        total_steps = max(int(steps / max(denoise, 1e-4)), steps)
+
+    if scheduler == "karras":
+        rho = 7.0
+        ramp = np.linspace(0, 1, total_steps)
+        min_r, max_r = sigma_min ** (1 / rho), sigma_max ** (1 / rho)
+        sigmas = (max_r + ramp * (min_r - max_r)) ** rho
+    elif scheduler == "exponential":
+        sigmas = np.exp(np.linspace(np.log(sigma_max), np.log(sigma_min), total_steps))
+    elif scheduler in ("normal", "simple"):
+        idx = np.linspace(len(all_sigmas) - 1, 0, total_steps)
+        sigmas = all_sigmas[idx.astype(np.int64)]
+    else:
+        raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
+
+    sigmas = sigmas[-steps:] if denoise < 1.0 else sigmas
+    return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
+
+
+def sigma_to_timestep(sigma: jax.Array) -> jax.Array:
+    """Nearest training timestep for a sigma (for timestep-conditioned
+    models); differentiable-free lookup."""
+    import numpy as np
+
+    log_all = jnp.asarray(np.log(_vp_sigmas()), dtype=jnp.float32)
+    return jnp.argmin(
+        jnp.abs(jnp.log(jnp.maximum(sigma, 1e-10))[..., None] - log_all),
+        axis=-1,
+    ).astype(jnp.float32)
+
+
+# --- CFG wrapper ---------------------------------------------------------
+
+def cfg_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
+    """Classifier-free guidance: cond is (positive, negative) pair.
+
+    Batches the two passes into one model call (2B batch) — on TPU one
+    big MXU matmul beats two small ones.
+    """
+    if cfg_scale == 1.0:
+        def passthrough(x, sigma, cond):
+            pos, _ = cond
+            return model_fn(x, sigma, pos)
+        return passthrough
+
+    def guided(x, sigma, cond):
+        pos, neg = cond
+        x2 = jnp.concatenate([x, x], axis=0)
+        s2 = jnp.concatenate([sigma, sigma], axis=0)
+        c2 = jax.tree_util.tree_map(
+            lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
+        )
+        eps2 = model_fn(x2, s2, c2)
+        eps_pos, eps_neg = jnp.split(eps2, 2, axis=0)
+        return eps_neg + cfg_scale * (eps_pos - eps_neg)
+
+    return guided
+
+
+def _denoised(model_fn: ModelFn, x, sigma, cond):
+    """x0 prediction from the eps model at scalar sigma."""
+    sig_batch = jnp.broadcast_to(sigma, (x.shape[0],))
+    eps = model_fn(x, sig_batch, cond)
+    return x - sigma * eps
+
+
+# --- samplers ------------------------------------------------------------
+
+def sample(
+    model_fn: ModelFn,
+    x_init: jax.Array,
+    sigmas: jnp.ndarray,
+    cond: Any,
+    sampler: str = "euler",
+    noise_key: jax.Array | None = None,
+) -> jax.Array:
+    """Run a full sampling trajectory. x_init must already be scaled by
+    sigmas[0] (pure noise for txt2img; noised latents for img2img)."""
+    if sampler == "euler":
+        return _sample_euler(model_fn, x_init, sigmas, cond)
+    if sampler == "heun":
+        return _sample_heun(model_fn, x_init, sigmas, cond)
+    if sampler == "dpmpp_2m":
+        return _sample_dpmpp_2m(model_fn, x_init, sigmas, cond)
+    if sampler == "ddim":
+        return _sample_euler(model_fn, x_init, sigmas, cond)  # eta=0 DDIM ≡ euler in sigma space
+    if sampler == "euler_ancestral":
+        if noise_key is None:
+            raise ValueError("euler_ancestral requires noise_key")
+        return _sample_euler_ancestral(model_fn, x_init, sigmas, cond, noise_key)
+    raise ValueError(f"unknown sampler {sampler!r}; use {SAMPLER_NAMES}")
+
+
+def _sample_euler(model_fn, x, sigmas, cond):
+    def step(x, sig_pair):
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        return x + d * (sigma_next - sigma), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def _sample_euler_ancestral(model_fn, x, sigmas, cond, key):
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        sigma_up = jnp.minimum(
+            sigma_next,
+            jnp.sqrt(
+                jnp.maximum(
+                    sigma_next**2 * (sigma**2 - sigma_next**2) / jnp.maximum(sigma**2, 1e-10),
+                    0.0,
+                )
+            ),
+        )
+        sigma_down = jnp.sqrt(jnp.maximum(sigma_next**2 - sigma_up**2, 0.0))
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x = x + d * (sigma_down - sigma)
+        key, sub = jax.random.split(key)
+        noise = jax.random.normal(sub, x.shape, x.dtype)
+        x = x + noise * sigma_up
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
+    return x
+
+
+def _sample_heun(model_fn, x, sigmas, cond):
+    def step(x, sig_pair):
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x_euler = x + d * (sigma_next - sigma)
+
+        def correct(_):
+            den2 = _denoised(model_fn, x_euler, sigma_next, cond)
+            d2 = (x_euler - den2) / jnp.maximum(sigma_next, 1e-10)
+            return x + 0.5 * (d + d2) * (sigma_next - sigma)
+
+        x = jax.lax.cond(sigma_next > 0, correct, lambda _: x_euler, None)
+        return x, None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def _sample_dpmpp_2m(model_fn, x, sigmas, cond):
+    """DPM-Solver++(2M): second-order multistep in log-sigma time."""
+
+    def t_of(sigma):
+        return -jnp.log(jnp.maximum(sigma, 1e-10))
+
+    def step(carry, inp):
+        x, old_den, have_old = carry
+        sigma, sigma_next, sigma_prev = inp
+        den = _denoised(model_fn, x, sigma, cond)
+
+        t, t_next = t_of(sigma), t_of(sigma_next)
+        h = t_next - t
+
+        def first_order(_):
+            return (sigma_next / sigma) * x - jnp.expm1(-h) * den
+
+        def second_order(_):
+            h_last = t - t_of(sigma_prev)
+            r = h_last / h
+            den_d = (1 + 1 / (2 * r)) * den - (1 / (2 * r)) * old_den
+            return (sigma_next / sigma) * x - jnp.expm1(-h) * den_d
+
+        use_second = jnp.logical_and(have_old, sigma_next > 0)
+        x_next = jax.lax.cond(use_second, second_order, first_order, None)
+        # final step to sigma=0 returns the denoised sample exactly
+        x_next = jnp.where(sigma_next > 0, x_next, den)
+        return (x_next, den, jnp.asarray(True)), None
+
+    sigma_prevs = jnp.concatenate([sigmas[:1], sigmas[:-1]])
+    inputs = jnp.stack([sigmas[:-1], sigmas[1:], sigma_prevs[:-1]], axis=-1)
+    init = (x, jnp.zeros_like(x), jnp.asarray(False))
+    (x, _, _), _ = jax.lax.scan(step, init, inputs)
+    return x

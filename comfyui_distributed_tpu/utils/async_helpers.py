@@ -67,9 +67,12 @@ async def _with_timeout(coroutine: Awaitable[Any], timeout: float | None) -> Any
 async def _fallback_run(coroutine: Awaitable[Any], timeout: float | None) -> Any:
     """asyncio.run wrapper for the no-server-loop case: any pooled HTTP
     session created on this transient loop is closed before the loop
-    dies, so fallback calls don't leak connectors."""
+    dies, so fallback calls don't leak connectors. Timeouts surface as
+    builtin TimeoutError matching the server-loop path's contract."""
     try:
         return await _with_timeout(coroutine, timeout)
+    except asyncio.TimeoutError:
+        raise TimeoutError(f"async operation timed out after {timeout}s") from None
     finally:
         from .network import close_client_session
 
@@ -112,26 +115,32 @@ class ServerLoopThread:
         set_server_loop(self._loop)
 
     def _run(self) -> None:
-        self._loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self._loop)
+        # Work on a local reference: stop() may null self._loop after a
+        # bounded join while this thread is still draining.
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
         self._started.set()
-        self._loop.run_forever()
+        loop.run_forever()
         # Drain pending tasks on shutdown.
-        pending = asyncio.all_tasks(self._loop)
+        pending = asyncio.all_tasks(loop)
         for task in pending:
             task.cancel()
         if pending:
-            self._loop.run_until_complete(
-                asyncio.gather(*pending, return_exceptions=True)
-            )
-        self._loop.close()
+            loop.run_until_complete(asyncio.gather(*pending, return_exceptions=True))
+        loop.close()
 
     def stop(self) -> None:
-        if self._loop is not None and self._loop.is_running():
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-        if get_server_loop() is self._loop:
+        loop, thread = self._loop, self._thread
+        if loop is not None and loop.is_running():
+            loop.call_soon_threadsafe(loop.stop)
+        if thread is not None:
+            thread.join(timeout=10)
+            if thread.is_alive():
+                # Drain is still running; leave state so a later stop()
+                # can retry instead of starting a second loop over it.
+                return
+        if get_server_loop() is loop:
             set_server_loop(None)
         self._thread = None
         self._loop = None

@@ -104,7 +104,11 @@ class _Cache:
 
 
 _cache = _Cache()
-_config_async_lock: asyncio.Lock | None = None
+# Transaction mutex: a threading.Lock (acquired via executor so the event
+# loop never blocks) rather than an asyncio.Lock — transactions may run on
+# different event loops (server loop vs asyncio.run fallbacks on compute
+# threads), and an asyncio.Lock binds to whichever loop first awaits it.
+_txn_lock = threading.Lock()
 
 
 def load_config(path: str | None = None) -> dict[str, Any]:
@@ -165,27 +169,24 @@ def save_config(config: dict[str, Any], path: str | None = None) -> None:
         _cache.data = _merge_defaults(DEFAULT_CONFIG, config)
 
 
-def _get_async_lock() -> asyncio.Lock:
-    global _config_async_lock
-    if _config_async_lock is None:
-        _config_async_lock = asyncio.Lock()
-    return _config_async_lock
-
-
 @contextlib.asynccontextmanager
 async def config_transaction(path: str | None = None) -> AsyncIterator[dict[str, Any]]:
-    """Async-locked read-modify-write; persists only if mutated.
+    """Locked read-modify-write; persists only if mutated.
 
     Usage:
         async with config_transaction() as cfg:
             cfg["settings"]["debug"] = True
     """
-    async with _get_async_lock():
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, _txn_lock.acquire)
+    try:
         config = load_config(path)
         snapshot = copy.deepcopy(config)
         yield config
         if config != snapshot:
             save_config(config, path)
+    finally:
+        _txn_lock.release()
 
 
 # --- convenience accessors ----------------------------------------------

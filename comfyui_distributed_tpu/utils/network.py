@@ -110,6 +110,22 @@ def is_private_host(host: str) -> bool:
         return False
 
 
+_LOOPBACK_HOSTS = {"", "localhost", "127.0.0.1", "::1", "0.0.0.0"}
+
+
+def is_loopback_host(host: str) -> bool:
+    """True only for this-machine addresses (NOT arbitrary private LAN
+    IPs — a 192.168.x worker is a different box and must call back to
+    the master's real address)."""
+    name, _ = split_host_port(host)
+    return name in _LOOPBACK_HOSTS
+
+
+def _fmt_host(name: str) -> str:
+    """Re-bracket bare IPv6 addresses for URL assembly."""
+    return f"[{name}]" if ":" in name and not name.startswith("[") else name
+
+
 def _wants_https(host: str, port: int | None, worker_type: str) -> bool:
     if worker_type in ("cloud", "remote_https"):
         return True
@@ -134,9 +150,9 @@ def build_worker_url(worker: dict[str, Any], path: str = "") -> str:
     https = _wants_https(host, explicit_port or None, worker_type)
     scheme = "https" if https else "http"
     if https and explicit_port in (443, 0):
-        base = f"{scheme}://{name}"
+        base = f"{scheme}://{_fmt_host(name)}"
     else:
-        base = f"{scheme}://{name}:{explicit_port or DEFAULT_MASTER_PORT}"
+        base = f"{scheme}://{_fmt_host(name)}:{explicit_port or DEFAULT_MASTER_PORT}"
     return f"{base}{path}" if path.startswith("/") or not path else f"{base}/{path}"
 
 
@@ -147,9 +163,9 @@ def build_master_url(master_host: str, master_port: int, path: str = "") -> str:
     https = _wants_https(host, port, "remote")
     scheme = "https" if https else "http"
     if https and port in (443, 0):
-        base = f"{scheme}://{name}"
+        base = f"{scheme}://{_fmt_host(name)}"
     else:
-        base = f"{scheme}://{name}:{port}"
+        base = f"{scheme}://{_fmt_host(name)}:{port}"
     return f"{base}{path}"
 
 
@@ -158,12 +174,13 @@ def build_master_callback_url(
 ) -> str:
     """URL a worker should use to call back to the master.
 
-    Local workers always call back over loopback regardless of the
-    advertised master host (reference utils/network.py:139-201) — the
-    advertised host may be a tunnel or external IP unreachable from
-    the same box.
+    Same-machine workers (type local/mesh, or loopback hosts) always
+    call back over loopback regardless of the advertised master host
+    (reference utils/network.py:139-201) — the advertised host may be
+    a tunnel or external IP unreachable from the same box. Workers on
+    other machines (including private LAN IPs) get the real master URL.
     """
-    if worker.get("type") in ("local", "mesh") or is_private_host(
+    if worker.get("type") in ("local", "mesh") or is_loopback_host(
         str(worker.get("host", ""))
     ):
         return f"http://127.0.0.1:{master_port}{path}"

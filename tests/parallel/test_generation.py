@@ -1,0 +1,48 @@
+"""Seed-parallel generation on the virtual 8-device mesh: every
+participant produces a distinct image; ordering is participant-first;
+the result equals a single-device replay of the same folded keys."""
+
+import jax
+import numpy as np
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.parallel import build_mesh
+from comfyui_distributed_tpu.parallel.collective import host_collect
+from comfyui_distributed_tpu.parallel.generation import txt2img_parallel
+
+
+def test_parallel_generation_distinct_and_deterministic():
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    mesh = build_mesh({"data": 8})
+    out = txt2img_parallel(
+        bundle, mesh, "a tree", height=32, width=32, steps=2, seed=5
+    )
+    imgs = host_collect(out)
+    assert imgs.shape == (8, 32, 32, 3)
+    assert np.isfinite(imgs).all()
+    # independent seeds ⇒ distinct images
+    assert len({imgs[i].tobytes() for i in range(8)}) == 8
+    # deterministic across runs
+    again = host_collect(
+        txt2img_parallel(bundle, mesh, "a tree", height=32, width=32, steps=2, seed=5)
+    )
+    np.testing.assert_array_equal(imgs, again)
+
+
+def test_parallel_matches_smaller_mesh_prefix():
+    """Participant i's image depends only on (seed, i) — a 4-wide mesh
+    must reproduce the first 4 images of the 8-wide mesh (elastic
+    scaling invariant: adding workers never changes existing outputs)."""
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    out8 = host_collect(
+        txt2img_parallel(
+            bundle, build_mesh({"data": 8}), "p", height=32, width=32, steps=2, seed=3
+        )
+    )
+    mesh4 = build_mesh({"data": 4}, devices=jax.devices()[:4])
+    out4 = host_collect(
+        txt2img_parallel(
+            bundle, mesh4, "p", height=32, width=32, steps=2, seed=3
+        )
+    )
+    np.testing.assert_allclose(out4, out8[:4], atol=1e-6)
