@@ -1,0 +1,157 @@
+"""Graph validation and execution.
+
+Standalone replacement for the ComfyUI executor the reference rides on
+(reference utils/async_helpers.py:108-140 validates via ComfyUI's
+execution.validate_prompt then enqueues into its prompt queue). Here:
+`validate_prompt` gives the same node-error summarization contract and
+`GraphExecutor.execute` runs the graph topologically with per-run
+result caching on a compute thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import threading
+from typing import Any, Optional
+
+from ..utils.exceptions import PromptValidationError
+from .prompt import Prompt, is_link
+from .registry import NODE_REGISTRY, get_node_class
+
+
+@dataclasses.dataclass
+class ExecutionContext:
+    """Everything a node can reach at run time."""
+
+    mesh: Any = None                     # jax.sharding.Mesh or None
+    participant: Any = None              # graph.prompt.ParticipantInfo
+    config: dict[str, Any] | None = None
+    server: Any = None                   # api server state (elastic tier)
+    interrupt_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    # caches shared across nodes in one process
+    pipelines: dict[str, Any] = dataclasses.field(default_factory=dict)
+    extras: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def check_interrupted(self) -> None:
+        if self.interrupt_event.is_set():
+            raise InterruptedError("execution interrupted")
+
+
+def validate_prompt(prompt: Prompt) -> None:
+    """Validate a prompt graph; raises PromptValidationError carrying
+    per-node error summaries (parity with the reference's
+    PromptValidationError surface)."""
+    node_errors: dict[str, list[str]] = {}
+    if not isinstance(prompt, dict) or not prompt:
+        raise PromptValidationError("prompt must be a non-empty dict", {})
+
+    for node_id, node in prompt.items():
+        errors: list[str] = []
+        if not isinstance(node, dict) or "class_type" not in node:
+            node_errors[str(node_id)] = ["node must be a dict with class_type"]
+            continue
+        class_type = node["class_type"]
+        if class_type not in NODE_REGISTRY:
+            errors.append(f"unknown class_type {class_type!r}")
+            node_errors[str(node_id)] = errors
+            continue
+        schema = get_node_class(class_type).INPUT_TYPES()
+        inputs = node.get("inputs", {})
+        for name, spec in schema.get("required", {}).items():
+            if name not in inputs:
+                if _spec_default(spec) is None:
+                    errors.append(f"missing required input {name!r}")
+        for name, value in inputs.items():
+            if is_link(value):
+                if value[0] not in prompt:
+                    errors.append(f"input {name!r} links to missing node {value[0]!r}")
+        if errors:
+            node_errors[str(node_id)] = errors
+
+    if node_errors:
+        summary = "; ".join(
+            f"node {nid}: {', '.join(errs)}" for nid, errs in sorted(node_errors.items())
+        )
+        raise PromptValidationError(f"invalid prompt: {summary}", node_errors)
+
+    _toposort(prompt)  # raises on cycles
+
+
+def _spec_default(spec: Any) -> Any:
+    if isinstance(spec, (tuple, list)) and len(spec) > 1 and isinstance(spec[1], dict):
+        return spec[1].get("default")
+    return None
+
+
+def _toposort(prompt: Prompt) -> list[str]:
+    order: list[str] = []
+    state: dict[str, int] = {}  # 0=unvisited 1=visiting 2=done
+
+    def visit(node_id: str, chain: list[str]) -> None:
+        s = state.get(node_id, 0)
+        if s == 2:
+            return
+        if s == 1:
+            cycle = " -> ".join(chain + [node_id])
+            raise PromptValidationError(f"cycle in prompt graph: {cycle}", {})
+        state[node_id] = 1
+        for value in prompt[node_id].get("inputs", {}).values():
+            if is_link(value) and value[0] in prompt:
+                visit(value[0], chain + [node_id])
+        state[node_id] = 2
+        order.append(node_id)
+
+    for node_id in sorted(prompt):
+        visit(node_id, [])
+    return order
+
+
+class GraphExecutor:
+    """Execute a validated prompt graph."""
+
+    def __init__(self, context: Optional[ExecutionContext] = None):
+        self.context = context or ExecutionContext()
+
+    def execute(self, prompt: Prompt) -> dict[str, Any]:
+        """Run the graph; returns {node_id: output} for OUTPUT_NODE nodes."""
+        validate_prompt(prompt)
+        order = _toposort(prompt)
+        results: dict[str, tuple] = {}
+        outputs: dict[str, Any] = {}
+
+        for node_id in order:
+            self.context.check_interrupted()
+            node_def = prompt[node_id]
+            cls = get_node_class(node_def["class_type"])
+            instance = cls()
+            schema = cls.INPUT_TYPES()
+            kwargs: dict[str, Any] = {}
+
+            # defaults first, then literal/link inputs
+            for section in ("required", "optional"):
+                for name, spec in schema.get(section, {}).items():
+                    default = _spec_default(spec)
+                    if default is not None:
+                        kwargs[name] = default
+            for name, value in node_def.get("inputs", {}).items():
+                if is_link(value):
+                    src_id, out_idx = value
+                    kwargs[name] = results[src_id][out_idx]
+                else:
+                    kwargs[name] = value
+
+            fn = getattr(instance, cls.FUNCTION)
+            if "context" in inspect.signature(fn).parameters:
+                kwargs["context"] = self.context
+            result = fn(**kwargs)
+            if result is None:
+                result = ()
+            if not isinstance(result, tuple):
+                result = (result,)
+            results[node_id] = result
+            if getattr(cls, "OUTPUT_NODE", False):
+                outputs[node_id] = result
+        return outputs

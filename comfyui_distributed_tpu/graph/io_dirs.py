@@ -1,0 +1,46 @@
+"""Input/output directory resolution for media nodes.
+
+The reference delegates to ComfyUI's folder_paths; here directories
+come from config (settings.output_dir / settings.input_dir) with
+sane defaults under the repo/package root, overridable by env.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.exceptions import DistributedError
+
+
+def _base_dir() -> str:
+    return os.environ.get("CDT_DATA_DIR", os.path.join(os.getcwd(), "data"))
+
+
+def get_output_dir(context=None) -> str:
+    cfg = getattr(context, "config", None) or {}
+    return (
+        os.environ.get("CDT_OUTPUT_DIR")
+        or cfg.get("settings", {}).get("output_dir")
+        or os.path.join(_base_dir(), "output")
+    )
+
+
+def get_input_dir(context=None) -> str:
+    cfg = getattr(context, "config", None) or {}
+    return (
+        os.environ.get("CDT_INPUT_DIR")
+        or cfg.get("settings", {}).get("input_dir")
+        or os.path.join(_base_dir(), "input")
+    )
+
+
+def resolve_input_path(name: str, context=None) -> str:
+    """Find a media file by name: absolute paths pass through; bare
+    names resolve against the input dir. Rejects path escapes."""
+    if os.path.isabs(name):
+        return name
+    base = get_input_dir(context)
+    path = os.path.normpath(os.path.join(base, name))
+    if not path.startswith(os.path.normpath(base) + os.sep) and path != os.path.normpath(base):
+        raise DistributedError(f"input path {name!r} escapes input dir")
+    return path

@@ -1,0 +1,360 @@
+"""Core workflow nodes (checkpoint → encode → sample → decode → save).
+
+The minimum node set the reference's bundled workflows assume from
+ComfyUI (reference workflows/*.json: CheckpointLoaderSimple,
+CLIPTextEncode, EmptyLatentImage, KSampler, VAEDecode/Encode,
+SaveImage/PreviewImage, LoadImage, ImageScale). Data contracts:
+
+    MODEL / CLIP / VAE — views over a models.pipeline.PipelineBundle
+    CONDITIONING       — jnp array [B, T, context_dim]
+    LATENT             — {"samples": [B, h, w, C]} dict (ComfyUI parity)
+    IMAGE              — [B, H, W, C] float array in [0, 1]
+
+A `SeedSpec` flows out of DistributedSeed in mesh-parallel runs: it
+tells KSampler to generate one sample per mesh participant in a single
+SPMD program instead of replaying the graph N times (the TPU-native
+collapse of the reference's prompt replication).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import pipeline as pl
+from ..ops import samplers as smp
+from ..parallel.generation import txt2img_parallel
+from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..utils import image as img_utils
+from ..utils.logging import log
+from .registry import register_node
+
+
+@dataclasses.dataclass(frozen=True)
+class SeedSpec:
+    """A seed plus how to spread it across participants."""
+
+    base_seed: int
+    per_participant: bool = False  # True ⇒ fold over the mesh data axis
+    worker_index: int = -1         # elastic tier: fixed offset applied
+
+
+def resolve_seed(seed: Any) -> SeedSpec:
+    if isinstance(seed, SeedSpec):
+        return seed
+    return SeedSpec(base_seed=int(seed))
+
+
+def _get_bundle(context, model_name: str) -> pl.PipelineBundle:
+    if model_name not in context.pipelines:
+        log(f"loading pipeline {model_name!r}")
+        context.pipelines[model_name] = pl.load_pipeline(model_name)
+    return context.pipelines[model_name]
+
+
+@register_node
+class CheckpointLoaderSimple:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"ckpt_name": ("STRING", {"default": "tiny-unet"})}}
+
+    RETURN_TYPES = ("MODEL", "CLIP", "VAE")
+    FUNCTION = "load"
+
+    def load(self, ckpt_name: str, context=None):
+        # strip file extensions so ComfyUI workflow values map to registry names
+        name = os.path.splitext(str(ckpt_name))[0]
+        bundle = _get_bundle(context, name)
+        return (bundle, bundle, bundle)
+
+
+@register_node
+class CLIPTextEncode:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "text": ("STRING", {"default": ""}),
+                "clip": ("CLIP",),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "encode"
+
+    def encode(self, text: str, clip: pl.PipelineBundle, context=None):
+        return (pl.encode_text(clip, [str(text)]),)
+
+
+@register_node
+class EmptyLatentImage:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 512}),
+                "height": ("INT", {"default": 512}),
+                "batch_size": ("INT", {"default": 1}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "generate"
+
+    def generate(self, width: int, height: int, batch_size: int, context=None):
+        # latent geometry fixed at the SD 8x factor; KSampler rescales
+        # against the bundle's actual latent_scale if it differs
+        return (
+            {
+                "samples": jnp.zeros(
+                    (int(batch_size), int(height) // 8, int(width) // 8, 4)
+                ),
+                "width": int(width),
+                "height": int(height),
+            },
+        )
+
+
+@register_node
+class KSampler:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "seed": ("INT", {"default": 0}),
+                "steps": ("INT", {"default": 20}),
+                "cfg": ("FLOAT", {"default": 7.0}),
+                "sampler_name": ("STRING", {"default": "euler"}),
+                "scheduler": ("STRING", {"default": "karras"}),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "latent_image": ("LATENT",),
+                "denoise": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "sample"
+
+    def sample(
+        self,
+        model: pl.PipelineBundle,
+        seed,
+        steps: int,
+        cfg: float,
+        sampler_name: str,
+        scheduler: str,
+        positive,
+        negative,
+        latent_image: dict,
+        denoise: float = 1.0,
+        context=None,
+    ):
+        spec = resolve_seed(seed)
+        bundle = model
+        latents = latent_image["samples"]
+        # honor requested pixel geometry when the bundle's VAE factor
+        # differs from the nominal 8x used by EmptyLatentImage
+        if bundle.latent_scale != 8 and "width" in latent_image:
+            lh = latent_image["height"] // bundle.latent_scale
+            lw = latent_image["width"] // bundle.latent_scale
+            if (latents.shape[1], latents.shape[2]) != (lh, lw):
+                latents = jnp.zeros(
+                    (latents.shape[0], lh, lw, bundle.latent_channels)
+                )
+
+        mesh = getattr(context, "mesh", None) if context is not None else None
+        if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
+            return (self._sample_mesh_parallel(
+                bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
+                positive, negative, latents, denoise,
+            ),)
+
+        effective_seed = spec.base_seed + (
+            spec.worker_index + 1 if spec.worker_index >= 0 else 0
+        )
+        out = pl.img2img_latents(
+            bundle,
+            latents,
+            positive,
+            negative,
+            steps=int(steps),
+            sampler=sampler_name,
+            scheduler=scheduler,
+            cfg_scale=float(cfg),
+            denoise=float(denoise),
+            seed=int(effective_seed),
+        )
+        return ({"samples": out},)
+
+    @staticmethod
+    def _sample_mesh_parallel(
+        bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
+        positive, negative, latents, denoise,
+    ) -> dict:
+        """One SPMD program: every participant samples its folded seed.
+        Output batch = participants x input batch, participant-major,
+        sharded over the data axis (the collector materialises it)."""
+        from ..parallel.seeds import participant_keys
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = data_axis_size(mesh)
+        keys = participant_keys(jax.random.key(spec.base_seed), n)
+        keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
+        params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
+        pos = jax.device_put(positive, NamedSharding(mesh, P()))
+        neg = jax.device_put(negative, NamedSharding(mesh, P()))
+        base = jax.device_put(latents, NamedSharding(mesh, P()))
+
+        sigmas = smp.get_sigmas(scheduler, int(steps), denoise=float(denoise))
+
+        def per_chip(keys_shard, params, pos, neg, base):
+            key = keys_shard[0]
+            noise_key, anc_key = jax.random.split(key)
+            x = base + jax.random.normal(noise_key, base.shape) * sigmas[0]
+            model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
+            return smp.sample(model_fn, x, sigmas, (pos, neg), sampler_name, anc_key)
+
+        out = jax.jit(
+            jax.shard_map(
+                per_chip,
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS), P(), P(), P(), P()),
+                out_specs=P(DATA_AXIS),
+                check_vma=False,
+            )
+        )(keys, params, pos, neg, base)
+        return {"samples": out, "participant_major": True}
+
+
+@register_node
+class VAEDecode:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"samples": ("LATENT",), "vae": ("VAE",)}}
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "decode"
+
+    def decode(self, samples: dict, vae: pl.PipelineBundle, context=None):
+        imgs = vae.vae.apply(vae.params["vae"], samples["samples"], method="decode")
+        return (imgs,)
+
+
+@register_node
+class VAEEncode:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"pixels": ("IMAGE",), "vae": ("VAE",)}}
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "encode"
+
+    def encode(self, pixels, vae: pl.PipelineBundle, context=None):
+        z = vae.vae.apply(vae.params["vae"], pixels, method="encode")
+        return ({"samples": z},)
+
+
+@register_node
+class ImageScale:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "upscale_method": ("STRING", {"default": "bilinear"}),
+                "width": ("INT", {"default": 1024}),
+                "height": ("INT", {"default": 1024}),
+                "crop": ("STRING", {"default": "disabled"}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "scale"
+
+    _METHODS = {
+        "nearest-exact": "nearest",
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "bicubic": "cubic",
+        "lanczos": "lanczos3",
+        "area": "linear",
+    }
+
+    def scale(self, image, upscale_method, width, height, crop="disabled", context=None):
+        b, _, _, c = image.shape
+        method = self._METHODS.get(str(upscale_method), "linear")
+        out = jax.image.resize(
+            image, (b, int(height), int(width), c), method=method
+        )
+        return (jnp.clip(out, 0.0, 1.0),)
+
+
+@register_node
+class LoadImage:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("STRING", {"default": ""})}}
+
+    RETURN_TYPES = ("IMAGE", "MASK")
+    FUNCTION = "load"
+
+    def load(self, image: str, context=None):
+        from .io_dirs import resolve_input_path
+
+        path = resolve_input_path(str(image), context)
+        arr = img_utils.pil_to_array(__import__("PIL.Image", fromlist=["Image"]).open(path))
+        rgb = arr[..., :3]
+        mask = arr[..., 3] if arr.shape[-1] == 4 else np.ones(arr.shape[:2], np.float32)
+        return (jnp.asarray(rgb)[None], jnp.asarray(mask)[None])
+
+
+@register_node
+class SaveImage:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE",),
+                "filename_prefix": ("STRING", {"default": "output"}),
+            }
+        }
+
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    OUTPUT_NODE = True
+
+    def save(self, images, filename_prefix="output", context=None):
+        from .io_dirs import get_output_dir
+
+        out_dir = get_output_dir(context)
+        os.makedirs(out_dir, exist_ok=True)
+        saved = []
+        arr = img_utils.ensure_numpy(images)
+        for i in range(arr.shape[0]):
+            name = f"{filename_prefix}_{i:05d}.png"
+            path = os.path.join(out_dir, name)
+            with open(path, "wb") as fh:
+                fh.write(img_utils.encode_png(arr[i], compress_level=4))
+            saved.append(name)
+        return ({"ui": {"images": saved}, "images": images},)
+
+
+@register_node
+class PreviewImage(SaveImage):
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"images": ("IMAGE",)}}
+
+    FUNCTION = "preview"
+    OUTPUT_NODE = True
+
+    def preview(self, images, context=None):
+        # terminal sink; nothing persisted (worker-side pruned graphs end here)
+        return ({"ui": {"images": []}, "images": images},)

@@ -1,0 +1,150 @@
+"""UltimateSDUpscaleDistributed node.
+
+Facade over ops/upscale.py mirroring the reference's node surface
+(reference nodes/distributed_upscale.py): image + model/conditioning/
+vae + sampling knobs + tile geometry in, upscaled image out. Mode
+routing (reference _determine_processing_mode):
+
+- mesh participants available → static tile sharding over ICI
+  (ops/upscale.upscale_mesh) — one SPMD program;
+- no participants → local scan over tiles;
+- elastic HTTP workers → master/worker tile-queue loops
+  (graph/usdu_elastic.py) with heartbeats and requeue.
+
+The 4n+1 video-batch constraint of WAN-style models is validated here
+like the reference does (reference nodes/distributed_upscale.py:131-142).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..models import pipeline as pl
+from ..ops import upscale as upscale_ops
+from ..parallel.mesh import data_axis_size
+from ..utils.logging import log
+from .registry import register_node
+
+
+@register_node
+class UltimateSDUpscaleDistributed:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "model": ("MODEL",),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "vae": ("VAE",),
+                "seed": ("INT", {"default": 0}),
+                "steps": ("INT", {"default": 20}),
+                "cfg": ("FLOAT", {"default": 7.0}),
+                "sampler_name": ("STRING", {"default": "euler"}),
+                "scheduler": ("STRING", {"default": "karras"}),
+                "denoise": ("FLOAT", {"default": 0.35}),
+                "upscale_by": ("FLOAT", {"default": 2.0}),
+                "tile_width": ("INT", {"default": 512}),
+                "tile_height": ("INT", {"default": 512}),
+                "tile_padding": ("INT", {"default": 32}),
+            },
+            "optional": {
+                "upscale_method": ("STRING", {"default": "bicubic"}),
+                "force_uniform_tiles": ("BOOLEAN", {"default": True}),
+                "dynamic_threshold": ("INT", {"default": 8}),
+            },
+            "hidden": {
+                "is_worker": ("BOOLEAN", {"default": False}),
+                "worker_id": ("STRING", {"default": ""}),
+                "master_url": ("STRING", {"default": ""}),
+                "job_id": ("STRING", {"default": ""}),
+            },
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "run"
+
+    # IS_CHANGED = nan in the reference forces re-execution every queue;
+    # our executor has no cross-run cache yet, so every run re-executes.
+
+    def run(
+        self,
+        image,
+        model: pl.PipelineBundle,
+        positive,
+        negative,
+        vae,
+        seed=0,
+        steps=20,
+        cfg=7.0,
+        sampler_name="euler",
+        scheduler="karras",
+        denoise=0.35,
+        upscale_by=2.0,
+        tile_width=512,
+        tile_height=512,
+        tile_padding=32,
+        upscale_method="bicubic",
+        force_uniform_tiles=True,
+        dynamic_threshold=8,
+        is_worker=False,
+        worker_id="",
+        master_url="",
+        job_id="",
+        enabled_worker_ids=None,
+        context=None,
+        **_extra: Any,
+    ):
+        from ..ops.samplers import SAMPLER_NAMES
+
+        seed = getattr(seed, "base_seed", seed)  # accept SeedSpec links
+        if sampler_name not in SAMPLER_NAMES:
+            raise ValueError(f"unknown sampler {sampler_name!r}")
+        batch = int(image.shape[0])
+        if batch > 1 and (batch - 1) % 4 != 0:
+            # WAN-family video models require 4n+1 frame batches
+            log(f"USDU: batch {batch} is not 4n+1; video models may reject it")
+
+        tile = min(int(tile_width), int(tile_height))
+        mesh = getattr(context, "mesh", None) if context is not None else None
+        enabled = enabled_worker_ids or []
+
+        if is_worker:
+            from .usdu_elastic import run_worker_loop
+
+            run_worker_loop(
+                bundle=model, image=image, pos=positive, neg=negative,
+                job_id=job_id, worker_id=worker_id, master_url=master_url,
+                upscale_by=float(upscale_by), tile=tile,
+                padding=int(tile_padding), steps=int(steps),
+                sampler=sampler_name, scheduler=scheduler, cfg=float(cfg),
+                denoise=float(denoise), seed=int(seed),
+                upscale_method=upscale_method, context=context,
+            )
+            return (image,)
+
+        if enabled and getattr(context, "server", None) is not None:
+            from .usdu_elastic import run_master_elastic
+
+            return (
+                run_master_elastic(
+                    bundle=model, image=image, pos=positive, neg=negative,
+                    job_id=job_id, enabled_worker_ids=list(enabled),
+                    mesh=mesh, upscale_by=float(upscale_by), tile=tile,
+                    padding=int(tile_padding), steps=int(steps),
+                    sampler=sampler_name, scheduler=scheduler,
+                    cfg=float(cfg), denoise=float(denoise), seed=int(seed),
+                    upscale_method=upscale_method, context=context,
+                ),
+            )
+
+        out = upscale_ops.run_upscale(
+            bundle=model, image=image, pos=positive, neg=negative, mesh=mesh,
+            upscale_by=float(upscale_by), tile=tile, padding=int(tile_padding),
+            steps=int(steps), sampler=sampler_name, scheduler=scheduler,
+            cfg=float(cfg), denoise=float(denoise), seed=int(seed),
+            upscale_method=upscale_method,
+        )
+        return (out,)

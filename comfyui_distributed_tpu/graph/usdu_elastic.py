@@ -1,0 +1,384 @@
+"""Elastic-tier USDU: master/worker tile-queue loops over HTTP.
+
+The cross-host protocol of the reference (reference
+upscale/modes/static.py + upscale/worker_comms.py), for participants
+that are NOT part of the local mesh (other hosts, heterogeneous
+boxes, cloud pods):
+
+  worker: poll job ready → pull tile id → process → submit (size-aware
+          flushes, heartbeat per tile) → final flush
+  master: init queue → pull/process/blend locally while draining worker
+          results → on drain, collection phase with heartbeat-timeout
+          requeue (busy-probe grace) → local fallback for requeued
+          tiles → blend
+
+Because per-tile noise keys fold the global tile index
+(ops/upscale.py), a tile re-run after requeue is bit-identical — no
+seam drift from fault recovery.
+
+The worker side talks through a WorkClient so hermetic tests can
+script the exchange without sockets (the reference's fake-comms test
+pattern, reference tests/test_static_mode.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import pipeline as pl
+from ..ops import samplers as smp
+from ..ops import tiles as tile_ops
+from ..ops import upscale as upscale_ops
+from ..utils import image as img_utils
+from ..utils.async_helpers import run_async_in_server_loop
+from ..utils.constants import (
+    JOB_READY_POLL_ATTEMPTS,
+    JOB_READY_POLL_INTERVAL,
+    MAX_PAYLOAD_SIZE,
+    MAX_TILE_BATCH,
+    PAYLOAD_HEADROOM,
+    QUEUE_POLL_INTERVAL_SECONDS,
+    REQUEST_RETRY_BACKOFF,
+    WORK_PULL_RETRY_CAP_SECONDS,
+    WORK_PULL_RETRY_COUNT,
+)
+from ..utils.exceptions import WorkerError
+from ..utils.logging import debug_log, log
+from ..utils.network import build_worker_url, get_client_session, probe_worker
+
+
+# --------------------------------------------------------------------------
+# worker side
+# --------------------------------------------------------------------------
+
+
+class HTTPWorkClient:
+    """Worker → master RPCs (reference upscale/worker_comms.py)."""
+
+    def __init__(self, master_url: str, job_id: str, worker_id: str):
+        self.master_url = master_url
+        self.job_id = job_id
+        self.worker_id = worker_id
+
+    async def _post(self, path: str, payload: dict) -> dict:
+        session = await get_client_session()
+        async with session.post(f"{self.master_url}{path}", json=payload) as resp:
+            if resp.status != 200:
+                raise WorkerError(f"{path} -> HTTP {resp.status}", self.worker_id)
+            return await resp.json()
+
+    def poll_ready(self) -> bool:
+        async def poll():
+            for _ in range(JOB_READY_POLL_ATTEMPTS):
+                try:
+                    out = await self._post(
+                        "/distributed/job_status",
+                        {"job_id": self.job_id, "worker_id": self.worker_id},
+                    )
+                    if out.get("ready"):
+                        return True
+                except Exception:
+                    pass
+                await asyncio.sleep(JOB_READY_POLL_INTERVAL)
+            return False
+
+        return run_async_in_server_loop(poll(), timeout=None)
+
+    def request_tile(self) -> Optional[dict]:
+        """Pull next work item; None when drained. Retries with capped
+        backoff (reference worker_comms retry ×10, 30 s cap)."""
+
+        async def pull():
+            delay = REQUEST_RETRY_BACKOFF
+            for attempt in range(WORK_PULL_RETRY_COUNT):
+                try:
+                    return await self._post(
+                        "/distributed/request_image",
+                        {"job_id": self.job_id, "worker_id": self.worker_id},
+                    )
+                except Exception as exc:  # noqa: BLE001 - retried
+                    debug_log(f"request_tile retry {attempt}: {exc}")
+                    await asyncio.sleep(min(delay, WORK_PULL_RETRY_CAP_SECONDS))
+                    delay *= 2
+            return None
+
+        out = run_async_in_server_loop(pull(), timeout=None)
+        if out is None or out.get("tile_idx") is None:
+            return None
+        return out
+
+    def submit_tiles(self, entries: list[dict], is_final: bool) -> None:
+        async def send():
+            await self._post(
+                "/distributed/submit_tiles",
+                {
+                    "job_id": self.job_id,
+                    "worker_id": self.worker_id,
+                    "tiles": entries,
+                    "is_final_flush": is_final,
+                },
+            )
+
+        run_async_in_server_loop(send(), timeout=300)
+
+    def heartbeat(self) -> None:
+        async def beat():
+            try:
+                await self._post(
+                    "/distributed/heartbeat",
+                    {"job_id": self.job_id, "worker_id": self.worker_id},
+                )
+            except Exception as exc:  # noqa: BLE001 - heartbeats best-effort
+                debug_log(f"heartbeat failed: {exc}")
+
+        run_async_in_server_loop(beat(), timeout=30)
+
+
+def _flush_threshold_bytes() -> int:
+    return MAX_PAYLOAD_SIZE - PAYLOAD_HEADROOM
+
+
+def run_worker_loop(
+    bundle: pl.PipelineBundle,
+    image,
+    pos,
+    neg,
+    job_id: str,
+    worker_id: str,
+    master_url: str,
+    upscale_by: float,
+    tile: int,
+    padding: int,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg: float,
+    denoise: float,
+    seed: int,
+    upscale_method: str = "bicubic",
+    context=None,
+    client: Any = None,
+) -> None:
+    """Pull tiles until the master's queue drains, flushing results in
+    size-aware batches with a heartbeat per processed tile."""
+    client = client or HTTPWorkClient(master_url, job_id, worker_id)
+    if not client.poll_ready():
+        raise WorkerError(f"job {job_id} never became ready", worker_id)
+
+    b, h, w, c = image.shape
+    out_h, out_w, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding)
+    method = {"bicubic": "cubic", "bilinear": "linear", "nearest": "nearest",
+              "lanczos": "lanczos3"}.get(upscale_method, "cubic")
+    upscaled = jnp.clip(
+        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    )
+    extracted = tile_ops.extract_tiles(upscaled, grid)
+    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    key = jax.random.key(seed)
+
+    pending: list[dict] = []
+    pending_bytes = 0
+
+    def flush(is_final: bool) -> None:
+        nonlocal pending, pending_bytes
+        if pending or is_final:
+            client.submit_tiles(pending, is_final)
+        pending, pending_bytes = [], 0
+
+    while True:
+        if context is not None:
+            context.check_interrupted()
+        work = client.request_tile()
+        if work is None:
+            break
+        tile_idx = int(work["tile_idx"])
+        tkey = jax.random.fold_in(key, tile_idx)
+        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        arr = img_utils.ensure_numpy(result)
+        for batch_idx in range(arr.shape[0]):
+            encoded = img_utils.encode_image_data_url(arr[batch_idx])
+            y, x = grid.positions[tile_idx]
+            entry = {
+                "tile_idx": tile_idx,
+                "batch_idx": batch_idx,
+                "global_idx": tile_idx * arr.shape[0] + batch_idx,
+                "x": int(x),
+                "y": int(y),
+                "extracted_w": grid.padded_w,
+                "extracted_h": grid.padded_h,
+                "image": encoded,
+            }
+            pending.append(entry)
+            pending_bytes += len(encoded)
+        client.heartbeat()
+        if len(pending) >= MAX_TILE_BATCH or pending_bytes >= _flush_threshold_bytes():
+            flush(is_final=False)
+    flush(is_final=True)
+
+
+def _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise):
+    sigmas = smp.get_sigmas(scheduler, int(steps), denoise=float(denoise))
+
+    @jax.jit
+    def process(params, tile, key, pos, neg):
+        z = bundle.vae.apply(params["vae"], tile, method="encode")
+        noise_key, anc_key = jax.random.split(key)
+        x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
+        model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
+        z_out = smp.sample(model_fn, x, sigmas, (pos, neg), sampler, anc_key)
+        return bundle.vae.apply(params["vae"], z_out, method="decode")
+
+    return process
+
+
+# --------------------------------------------------------------------------
+# master side
+# --------------------------------------------------------------------------
+
+
+def run_master_elastic(
+    bundle: pl.PipelineBundle,
+    image,
+    pos,
+    neg,
+    job_id: str,
+    enabled_worker_ids: list[str],
+    mesh=None,
+    upscale_by: float = 2.0,
+    tile: int = 512,
+    padding: int = 32,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg: float = 7.0,
+    denoise: float = 0.35,
+    seed: int = 0,
+    upscale_method: str = "bicubic",
+    context=None,
+):
+    """Master participates in the tile queue and collects worker tiles.
+
+    Returns the blended [B, H, W, C] image. Fault tolerance: stale
+    workers' tiles are requeued (busy-probe grace) and re-run locally.
+    """
+    from ..utils.config import get_worker_timeout_seconds
+
+    server = context.server
+    store = server.job_store
+    b, h, w, c = image.shape
+    out_h, out_w, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding)
+    method = {"bicubic": "cubic", "bilinear": "linear", "nearest": "nearest",
+              "lanczos": "lanczos3"}.get(upscale_method, "cubic")
+    upscaled = jnp.clip(
+        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    )
+    extracted = tile_ops.extract_tiles(upscaled, grid)
+    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    key = jax.random.key(seed)
+
+    run_async_in_server_loop(
+        store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
+    )
+    canvas = tile_ops.IncrementalCanvas(upscaled, grid)
+    done_tiles: set[int] = set()
+    timeout = get_worker_timeout_seconds()
+
+    def blend_local(tile_idx: int, result) -> None:
+        y, x = grid.positions[tile_idx]
+        canvas.blend(result, y, x)
+        done_tiles.add(tile_idx)
+
+    def drain_results() -> None:
+        async def drain():
+            job = await store.get_tile_job(job_id)
+            items = []
+            while job is not None and not job.results.empty():
+                items.append(job.results.get_nowait())
+            return items
+
+        for tile_idx, payload in run_async_in_server_loop(drain(), timeout=30):
+            if tile_idx in done_tiles:
+                continue
+            batch = [
+                img_utils.decode_image_data_url(e["image"])
+                for e in sorted(payload, key=lambda e: e["batch_idx"])
+            ]
+            blend_local(tile_idx, jnp.asarray(np.stack(batch, axis=0)))
+
+    async def probe_busy(worker_id: str) -> bool:
+        config = getattr(context, "config", None) or {}
+        worker = next(
+            (w for w in config.get("workers", []) if str(w.get("id")) == worker_id),
+            None,
+        )
+        if worker is None:
+            return False
+        result = await probe_worker(build_worker_url(worker))
+        return bool(result["online"] and (result["queue_remaining"] or 0) > 0)
+
+    # --- main pull/process loop ---
+    empty_pulls = 0
+    while empty_pulls < 2:
+        if context is not None:
+            context.check_interrupted()
+        tile_idx = run_async_in_server_loop(
+            store.pull_task(job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS),
+            timeout=30,
+        )
+        if tile_idx is None:
+            empty_pulls += 1
+            drain_results()
+            continue
+        empty_pulls = 0
+        tkey = jax.random.fold_in(key, tile_idx)
+        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        run_async_in_server_loop(
+            store.submit_result(
+                job_id, "master", tile_idx,
+                None,  # master blends directly; no payload retained
+            ),
+            timeout=30,
+        )
+        blend_local(tile_idx, result)
+        drain_results()
+
+    # --- collection phase ---
+    deadline = time.monotonic() + timeout * max(1, len(enabled_worker_ids))
+    while len(done_tiles) < grid.num_tiles:
+        if context is not None:
+            context.check_interrupted()
+        drain_results()
+        if len(done_tiles) >= grid.num_tiles:
+            break
+        requeued = run_async_in_server_loop(
+            store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
+        )
+        for tile_idx in requeued:
+            if tile_idx in done_tiles:
+                continue
+            tkey = jax.random.fold_in(key, tile_idx)
+            result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+            run_async_in_server_loop(
+                store.submit_result(job_id, "master", tile_idx, None), timeout=30
+            )
+            blend_local(tile_idx, result)
+        if len(done_tiles) >= grid.num_tiles:
+            break
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(grid.num_tiles)) - done_tiles)
+            log(f"USDU: deadline hit; locally processing {len(missing)} tile(s)")
+            for tile_idx in missing:
+                tkey = jax.random.fold_in(key, tile_idx)
+                result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+                blend_local(tile_idx, result)
+            break
+        time.sleep(QUEUE_POLL_INTERVAL_SECONDS)
+
+    run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=30)
+    return canvas.result()

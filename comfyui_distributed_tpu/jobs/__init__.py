@@ -1,0 +1,12 @@
+"""Elastic-tier job state: queues, heartbeats, timeout/requeue.
+
+Only the cross-host (HTTP/DCN) tier needs this machinery — inside a
+mesh, work distribution is sharding and failure is slice-restart. The
+semantics mirror the reference's job layer (upscale/job_models.py,
+upscale/job_store.py, upscale/job_timeout.py) with one structural fix:
+state lives in an owned JobStore object instead of being monkey-patched
+onto a global server instance.
+"""
+
+from .models import CollectorJob, ImageJob, TileJob  # noqa: F401
+from .store import JobStore  # noqa: F401

@@ -1,0 +1,57 @@
+"""Job state dataclasses.
+
+Parity with reference upscale/job_models.py (TileJobState /
+ImageJobState) plus the collector queue state the reference keeps in
+ad-hoc dicts on PromptServer (reference api/queue_orchestration.py:42-61).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any
+
+
+@dataclasses.dataclass
+class CollectorJob:
+    """Per-job image gathering state (parallel generation)."""
+
+    job_id: str
+    queue: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+    # worker_id → number of items received
+    received: dict[str, int] = dataclasses.field(default_factory=dict)
+    # worker_id → True once its is_last item arrived
+    finished_workers: set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class TileJob:
+    """Static-mode USDU: a queue of tile indices for one upscale job."""
+
+    job_id: str
+    total_tasks: int
+    pending: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    results: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    # global tile index → result payload (master-side dedup/blend input)
+    completed: dict[int, Any] = dataclasses.field(default_factory=dict)
+    # worker_id → last heartbeat monotonic time
+    worker_status: dict[str, float] = dataclasses.field(default_factory=dict)
+    # worker_id → set of task ids currently assigned (for requeue)
+    assigned: dict[str, set[int]] = dataclasses.field(default_factory=dict)
+    finished_workers: set[str] = dataclasses.field(default_factory=set)
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+    # batched static mode: one task id covers the whole image batch
+    batched: bool = True
+
+    def heartbeat(self, worker_id: str) -> None:
+        self.worker_status[worker_id] = time.monotonic()
+
+
+@dataclasses.dataclass
+class ImageJob(TileJob):
+    """Dynamic-mode USDU: queue of whole-image indices (video batches).
+    Same lifecycle as TileJob; `batched` is meaningless here."""
+
+    batched: bool = False

@@ -1,0 +1,219 @@
+"""The JobStore: lock-guarded registry of in-flight distributed jobs.
+
+Semantics from reference upscale/job_store.py + api/queue_orchestration.py:
+- queues are created either by orchestration (before dispatch) or
+  lazily by the first arriving result within a grace window — both
+  orders happen in practice (the init race the reference guards with a
+  10 s wait in job_complete, reference api/job_routes.py:314-333);
+- pulls pop one task id; completions are recorded idempotently
+  (duplicate submissions from a requeued-then-recovered worker are
+  dropped);
+- timeout scanning snapshots under the lock but probes outside it
+  (reference upscale/job_timeout.py:53-108), then requeues the
+  incomplete tasks of dead workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Awaitable, Callable, Optional
+
+from ..utils.exceptions import JobQueueError
+from ..utils.logging import debug_log, log
+from .models import CollectorJob, ImageJob, TileJob
+
+
+class JobStore:
+    def __init__(self) -> None:
+        self.lock = asyncio.Lock()
+        self.collectors: dict[str, CollectorJob] = {}
+        self.tile_jobs: dict[str, TileJob] = {}
+
+    # --- collector jobs ---------------------------------------------------
+
+    async def ensure_collector(self, job_id: str) -> CollectorJob:
+        async with self.lock:
+            job = self.collectors.get(job_id)
+            if job is None:
+                job = CollectorJob(job_id=job_id)
+                self.collectors[job_id] = job
+            return job
+
+    async def wait_for_collector(
+        self, job_id: str, grace_seconds: float
+    ) -> Optional[CollectorJob]:
+        """Result-submission side: wait up to grace for the queue to be
+        created by orchestration; create it ourselves at deadline (the
+        master may still be validating its own prompt)."""
+        deadline = time.monotonic() + grace_seconds
+        while True:
+            async with self.lock:
+                job = self.collectors.get(job_id)
+            if job is not None:
+                return job
+            if time.monotonic() >= deadline:
+                return await self.ensure_collector(job_id)
+            await asyncio.sleep(0.1)
+
+    async def put_collector_result(self, job_id: str, item: dict[str, Any]) -> None:
+        job = await self.ensure_collector(job_id)
+        worker_id = str(item.get("worker_id", ""))
+        job.received[worker_id] = job.received.get(worker_id, 0) + 1
+        if item.get("is_last"):
+            job.finished_workers.add(worker_id)
+        await job.queue.put(item)
+
+    async def cleanup_collector(self, job_id: str) -> None:
+        async with self.lock:
+            self.collectors.pop(job_id, None)
+
+    # --- tile/image jobs ----------------------------------------------------
+
+    async def init_tile_job(
+        self, job_id: str, task_ids: list[int], batched: bool = True,
+        kind: str = "tile",
+    ) -> TileJob:
+        async with self.lock:
+            if job_id in self.tile_jobs:
+                return self.tile_jobs[job_id]
+            cls = TileJob if kind == "tile" else ImageJob
+            job = cls(job_id=job_id, total_tasks=len(task_ids), batched=batched)
+            for tid in task_ids:
+                job.pending.put_nowait(tid)
+            self.tile_jobs[job_id] = job
+            return job
+
+    async def get_tile_job(self, job_id: str) -> Optional[TileJob]:
+        async with self.lock:
+            return self.tile_jobs.get(job_id)
+
+    async def wait_for_tile_job(
+        self, job_id: str, grace_seconds: float
+    ) -> Optional[TileJob]:
+        deadline = time.monotonic() + grace_seconds
+        while True:
+            job = await self.get_tile_job(job_id)
+            if job is not None:
+                return job
+            if time.monotonic() >= deadline:
+                return None
+            await asyncio.sleep(0.1)
+
+    async def pull_task(
+        self, job_id: str, worker_id: str, timeout: float = 0.1
+    ) -> Optional[int]:
+        """Pop the next pending task id for a worker (None = drained).
+        Records assignment + heartbeat for requeue bookkeeping."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            raise JobQueueError(f"no such job {job_id!r}")
+        try:
+            task_id = await asyncio.wait_for(job.pending.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        async with self.lock:
+            job.heartbeat(worker_id)
+            job.assigned.setdefault(worker_id, set()).add(task_id)
+        return task_id
+
+    async def submit_result(
+        self, job_id: str, worker_id: str, task_id: int, payload: Any
+    ) -> bool:
+        """Record one completed task; False if duplicate (already done)."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            raise JobQueueError(f"no such job {job_id!r}")
+        async with self.lock:
+            job.heartbeat(worker_id)
+            job.assigned.get(worker_id, set()).discard(task_id)
+            if task_id in job.completed:
+                debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
+                return False
+            job.completed[task_id] = payload
+        await job.results.put((task_id, payload))
+        return True
+
+    async def mark_worker_done(self, job_id: str, worker_id: str) -> None:
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return
+        async with self.lock:
+            job.finished_workers.add(worker_id)
+
+    async def heartbeat(self, job_id: str, worker_id: str) -> bool:
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return False
+        async with self.lock:
+            job.heartbeat(worker_id)
+        return True
+
+    async def remaining(self, job_id: str) -> int:
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return 0
+        return job.pending.qsize()
+
+    async def is_complete(self, job_id: str) -> bool:
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return False
+        async with self.lock:
+            return len(job.completed) >= job.total_tasks
+
+    async def cleanup_tile_job(self, job_id: str) -> None:
+        async with self.lock:
+            self.tile_jobs.pop(job_id, None)
+
+    # --- timeout / requeue --------------------------------------------------
+
+    async def requeue_timed_out(
+        self,
+        job_id: str,
+        timeout_seconds: float,
+        probe_busy: Optional[Callable[[str], Awaitable[bool]]] = None,
+    ) -> list[int]:
+        """Requeue tasks assigned to workers whose heartbeat is stale.
+
+        Snapshot under the lock; probe each stale worker OUTSIDE the
+        lock (a worker mid-sample can't heartbeat — if the probe says
+        it's busy, refresh its heartbeat instead of requeueing: the
+        reference's busy-probe grace, upscale/job_timeout.py:82-104).
+        """
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return []
+        now = time.monotonic()
+        async with self.lock:
+            stale = [
+                wid
+                for wid, beat in job.worker_status.items()
+                if now - beat > timeout_seconds
+                and wid not in job.finished_workers
+                and job.assigned.get(wid)
+            ]
+        requeued: list[int] = []
+        for wid in stale:
+            busy = False
+            if probe_busy is not None:
+                try:
+                    busy = await probe_busy(wid)
+                except Exception:
+                    busy = False
+            async with self.lock:
+                if busy:
+                    job.heartbeat(wid)
+                    debug_log(f"worker {wid} busy on probe; heartbeat grace")
+                    continue
+                tasks = job.assigned.pop(wid, set())
+                incomplete = [t for t in tasks if t not in job.completed]
+                for tid in incomplete:
+                    job.pending.put_nowait(tid)
+                requeued.extend(incomplete)
+                if incomplete:
+                    log(
+                        f"requeued {len(incomplete)} task(s) from timed-out "
+                        f"worker {wid} on job {job_id}"
+                    )
+        return requeued

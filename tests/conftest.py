@@ -36,6 +36,18 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture()
+def server_loop():
+    """A real control-plane loop thread (production shape): asyncio
+    state like JobStore queues binds to exactly one loop."""
+    from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+    thread = ServerLoopThread()
+    thread.start()
+    yield thread
+    thread.stop()
+
+
+@pytest.fixture()
 def tmp_config_path(tmp_path, monkeypatch):
     """Point the config system at a throwaway file."""
     path = tmp_path / "tpu_config.json"

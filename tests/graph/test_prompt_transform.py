@@ -1,0 +1,124 @@
+"""Prompt indexing, pruning, delegate prep, job ids, overrides —
+covering the scenarios of reference tests/test_prompt_transform.py
+against our re-designed implementation."""
+
+import copy
+
+from comfyui_distributed_tpu.graph import prompt as pt
+
+
+def _workflow():
+    """txt2img + collector + save, with a side branch only the master needs."""
+    return {
+        "1": {"class_type": "CheckpointLoaderSimple", "inputs": {"ckpt_name": "tiny-unet"}},
+        "2": {"class_type": "CLIPTextEncode", "inputs": {"text": "cat", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode", "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "EmptyLatentImage", "inputs": {"width": 64, "height": 64, "batch_size": 1}},
+        "5": {"class_type": "DistributedSeed", "inputs": {"seed": 42}},
+        "6": {
+            "class_type": "KSampler",
+            "inputs": {
+                "model": ["1", 0], "seed": ["5", 0], "steps": 2, "cfg": 5.0,
+                "sampler_name": "euler", "scheduler": "karras",
+                "positive": ["2", 0], "negative": ["3", 0],
+                "latent_image": ["4", 0], "denoise": 1.0,
+            },
+        },
+        "7": {"class_type": "VAEDecode", "inputs": {"samples": ["6", 0], "vae": ["1", 2]}},
+        "8": {"class_type": "DistributedCollector", "inputs": {"images": ["7", 0]}},
+        "9": {"class_type": "SaveImage", "inputs": {"images": ["8", 0], "filename_prefix": "out"}},
+    }
+
+
+def test_index_lookup_and_closures():
+    p = _workflow()
+    idx = pt.PromptIndex(p)
+    assert idx.nodes_of_class("DistributedCollector") == ["8"]
+    assert idx.has_distributed_nodes()
+    up = idx.upstream_closure("8")
+    assert up == frozenset({"1", "2", "3", "4", "5", "6", "7", "8"})
+    down = idx.downstream_closure("8")
+    assert down == frozenset({"8", "9"})
+
+
+def test_prune_for_worker_drops_downstream_adds_sink():
+    p = _workflow()
+    pruned = pt.prune_prompt_for_worker(p)
+    assert "9" not in pruned  # SaveImage is master-only
+    assert "8" in pruned and "1" in pruned
+    sinks = [n for n in pruned.values() if n["class_type"] == "PreviewImage"]
+    assert len(sinks) == 1
+    assert sinks[0]["inputs"]["images"] == ["8", 0]
+    # original untouched
+    assert "9" in p
+
+
+def test_prune_without_distributed_nodes_is_identity():
+    p = {"1": {"class_type": "EmptyLatentImage", "inputs": {}}}
+    assert pt.prune_prompt_for_worker(p) == p
+
+
+def test_delegate_master_keeps_downstream_with_placeholder():
+    p = _workflow()
+    delegate = pt.prepare_delegate_master_prompt(p)
+    assert set(delegate) >= {"8", "9"}
+    assert "6" not in delegate  # no sampling on a delegate master
+    placeholders = [
+        nid for nid, n in delegate.items()
+        if n["class_type"] == "DistributedEmptyImage"
+    ]
+    assert len(placeholders) == 1
+    assert delegate["8"]["inputs"]["images"] == [placeholders[0], 0]
+
+
+def test_job_id_map_unique_per_node():
+    p = _workflow()
+    p["10"] = {"class_type": "UltimateSDUpscaleDistributed", "inputs": {}}
+    ids = pt.generate_job_id_map(p)
+    assert set(ids) == {"8", "10"}
+    assert ids["8"] != ids["10"]
+    assert ids["8"].endswith("_8")
+
+
+def test_overrides_master_vs_worker():
+    p = _workflow()
+    master = pt.apply_participant_overrides(
+        p, pt.ParticipantInfo(is_worker=False, job_ids={"8": "jobA"},
+                              enabled_worker_ids=["w1", "w2"]),
+    )
+    assert master["5"]["inputs"]["is_worker"] is False
+    assert master["8"]["inputs"]["job_id"] == "jobA"
+    assert master["8"]["inputs"]["enabled_worker_ids"] == ["w1", "w2"]
+
+    worker = pt.apply_participant_overrides(
+        p,
+        pt.ParticipantInfo(
+            is_worker=True, worker_index=1, worker_id="w2",
+            master_url="http://127.0.0.1:8188", job_ids={"8": "jobA"},
+        ),
+    )
+    assert worker["5"]["inputs"]["worker_index"] == 1
+    assert worker["8"]["inputs"]["master_url"] == "http://127.0.0.1:8188"
+    # non-distributed nodes untouched
+    assert worker["6"]["inputs"] == p["6"]["inputs"]
+
+
+def test_distributed_value_override_coercion():
+    p = {
+        "1": {
+            "class_type": "DistributedValue",
+            "inputs": {"value": "10", "overrides": {"_type": "INT", "2": "99", "1": "bad"}},
+        }
+    }
+    w2 = pt.apply_participant_overrides(
+        p, pt.ParticipantInfo(is_worker=True, worker_index=1, worker_id="w2")
+    )
+    assert w2["1"]["inputs"]["value"] == 99
+    # coercion failure keeps the base value
+    w1 = pt.apply_participant_overrides(
+        p, pt.ParticipantInfo(is_worker=True, worker_index=0, worker_id="w1")
+    )
+    assert w1["1"]["inputs"]["value"] == "10"
+    # master untouched
+    m = pt.apply_participant_overrides(p, pt.ParticipantInfo(is_worker=False))
+    assert m["1"]["inputs"]["value"] == "10"

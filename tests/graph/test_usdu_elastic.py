@@ -1,0 +1,170 @@
+"""Elastic-tier USDU loops, hermetic (scripted client, real JobStore,
+real asyncio queues — the reference's fake-comms test pattern)."""
+
+import threading
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph import ExecutionContext
+from comfyui_distributed_tpu.graph.usdu_elastic import (
+    run_master_elastic,
+    run_worker_loop,
+)
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import upscale as up
+from comfyui_distributed_tpu.utils.async_helpers import run_async_in_server_loop
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return pl.load_pipeline("tiny-unet", seed=0)
+
+
+class ScriptedClient:
+    """Replays a fixed tile sequence; records submissions/heartbeats."""
+
+    def __init__(self, tile_ids):
+        self.tile_ids = list(tile_ids)
+        self.submitted = []
+        self.flushes = []
+        self.heartbeats = 0
+        self.ready_polls = 0
+
+    def poll_ready(self):
+        self.ready_polls += 1
+        return True
+
+    def request_tile(self):
+        if not self.tile_ids:
+            return None
+        return {"tile_idx": self.tile_ids.pop(0), "estimated_remaining": len(self.tile_ids)}
+
+    def submit_tiles(self, entries, is_final):
+        self.submitted.extend(entries)
+        self.flushes.append((len(entries), is_final))
+
+    def heartbeat(self):
+        self.heartbeats += 1
+
+
+def test_worker_loop_processes_scripted_tiles(bundle):
+    img = jnp.asarray(np.random.default_rng(0).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    client = ScriptedClient([0, 2, 3])
+    run_worker_loop(
+        bundle, img, pos, neg, job_id="j", worker_id="w1",
+        master_url="", upscale_by=2.0, tile=64, padding=16, steps=1,
+        sampler="euler", scheduler="karras", cfg=1.0, denoise=0.3, seed=4,
+        client=client,
+    )
+    assert client.heartbeats == 3
+    assert {e["tile_idx"] for e in client.submitted} == {0, 2, 3}
+    assert client.flushes[-1][1] is True  # final flush marked
+    entry = client.submitted[0]
+    assert entry["image"].startswith("data:image/png;base64,")
+    assert entry["extracted_w"] == entry["extracted_h"]
+
+
+def test_master_elastic_with_live_worker_submissions(bundle, server_loop):
+    """Master runs its loop while a thread plays a worker that pulls
+    from the same store and submits PNG results."""
+    img = jnp.asarray(np.random.default_rng(1).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    store = JobStore()
+    server = types.SimpleNamespace(job_store=store)
+    ctx = ExecutionContext(server=server, config={"workers": []})
+
+    from comfyui_distributed_tpu.graph.usdu_elastic import _jit_tile_processor
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+    from comfyui_distributed_tpu.utils import image as img_utils
+    import jax
+
+    _, _, grid = up.plan_grid(64, 64, 2.0, 64, 16)
+    assert grid.num_tiles == 4
+
+    def worker_thread():
+        # identical preprocessing to the master
+        upscaled = jnp.clip(
+            jax.image.resize(img, (1, 128, 128, 3), method="cubic"), 0, 1
+        )
+        extracted = tile_ops.extract_tiles(upscaled, grid)
+        process = _jit_tile_processor(bundle, 1, "euler", "karras", 1.0, 0.3)
+        key = jax.random.key(9)
+        while True:
+            tile_idx = run_async_in_server_loop(
+                store.pull_task("job1", "w1", timeout=0.5)
+            )
+            if tile_idx is None:
+                break
+            tkey = jax.random.fold_in(key, tile_idx)
+            result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+            arr = img_utils.ensure_numpy(result)
+            payload = [
+                {"batch_idx": i, "image": img_utils.encode_image_data_url(arr[i])}
+                for i in range(arr.shape[0])
+            ]
+            run_async_in_server_loop(
+                store.submit_result("job1", "w1", tile_idx, payload)
+            )
+
+    # let the master create the job, then the worker joins
+    t = threading.Thread(target=worker_thread, daemon=True)
+
+    orig_init = store.init_tile_job
+
+    async def init_and_start(*args, **kwargs):
+        job = await orig_init(*args, **kwargs)
+        if not t.is_alive():
+            t.start()
+        return job
+
+    store.init_tile_job = init_and_start
+
+    out = run_master_elastic(
+        bundle, img, pos, neg, job_id="job1", enabled_worker_ids=["w1"],
+        upscale_by=2.0, tile=64, padding=16, steps=1, sampler="euler",
+        scheduler="karras", cfg=1.0, denoise=0.3, seed=9, context=ctx,
+    )
+    t.join(timeout=30)
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_master_elastic_requeues_dead_worker(bundle, monkeypatch, server_loop):
+    """A worker pulls a tile and dies; the master's timeout path must
+    requeue and locally complete it."""
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+
+    monkeypatch.setattr(cfg_mod, "get_worker_timeout_seconds", lambda path=None: 0.5)
+    import comfyui_distributed_tpu.graph.usdu_elastic as elastic
+
+    img = jnp.asarray(np.random.default_rng(2).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    store = JobStore()
+    server = types.SimpleNamespace(job_store=store)
+    ctx = ExecutionContext(server=server, config={"workers": []})
+
+    orig_init = store.init_tile_job
+
+    async def init_then_steal(*args, **kwargs):
+        job = await orig_init(*args, **kwargs)
+        # dead worker grabs a tile and never returns it
+        await store.pull_task("job2", "zombie", timeout=1)
+        return job
+
+    store.init_tile_job = init_then_steal
+
+    out = run_master_elastic(
+        bundle, img, pos, neg, job_id="job2", enabled_worker_ids=["zombie"],
+        upscale_by=2.0, tile=64, padding=16, steps=1, sampler="euler",
+        scheduler="karras", cfg=1.0, denoise=0.3, seed=2, context=ctx,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
