@@ -1,0 +1,64 @@
+"""CLI entry point: run a master or worker server.
+
+    python -m comfyui_distributed_tpu --port 8188            # master
+    python -m comfyui_distributed_tpu --port 8189 --worker   # worker
+
+The same process serves both roles (role decided per-prompt by hidden
+inputs, reference distributed.py pattern); --worker only suppresses
+master-side startup behavior (auto-launch, signal-driven worker
+cleanup) and enables the master-pid watchdog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
+    parser.add_argument("--port", type=int, default=8188)
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--config", type=str, default=None)
+    parser.add_argument(
+        "--platform", type=str, default=None,
+        help="force a jax platform (e.g. cpu for smoke tests)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.worker:
+        os.environ.setdefault("CDT_IS_WORKER", "1")
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from .api.server import DistributedServer
+    from .workers.monitor import start_master_watchdog
+    from .workers.startup import delayed_auto_launch, register_signals
+
+    server = DistributedServer(
+        port=args.port, is_worker=args.worker, config_path=args.config
+    )
+
+    async def run():
+        await server.start()
+        register_signals(asyncio.get_running_loop(), args.config)
+        if not server.is_worker:
+            delayed_auto_launch(args.config)
+        else:
+            start_master_watchdog()
+        # run until the loop is stopped by a signal handler
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except (KeyboardInterrupt, RuntimeError):
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""HTTP/WebSocket control plane.
+
+The reference registers ~25 aiohttp routes + 1 WebSocket on ComfyUI's
+PromptServer (reference api/__init__.py); this package is the
+standalone equivalent: a DistributedServer owning the event loop, the
+prompt queue + executor worker, the JobStore, and every
+/distributed/* route plus the ComfyUI-compatible /prompt surface that
+probes and dispatch rely on.
+"""
+
+from .server import DistributedServer  # noqa: F401

@@ -1,0 +1,179 @@
+"""Config routes: typed schema validation + field patches.
+
+Parity with reference api/config_routes.py: bulk GET/POST with a
+CONFIG_SCHEMA type/validator table, per-worker / master / setting
+patch endpoints, and a queue_status poll.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from aiohttp import web
+
+from ..utils import config as config_mod
+
+# field → (type, validator) for settings patches
+CONFIG_SCHEMA: dict[str, tuple[type, Callable[[Any], bool]]] = {
+    "debug": (bool, lambda v: True),
+    "auto_launch_workers": (bool, lambda v: True),
+    "stop_workers_on_master_exit": (bool, lambda v: True),
+    "master_delegate_only": (bool, lambda v: True),
+    "websocket_orchestration": (bool, lambda v: True),
+    "worker_timeout_seconds": ((int, float), lambda v: v > 0),
+    "probe_concurrency": (int, lambda v: 1 <= v <= 64),
+    "prep_concurrency": (int, lambda v: 1 <= v <= 64),
+    "media_sync_concurrency": (int, lambda v: 1 <= v <= 64),
+    "output_dir": (str, lambda v: True),
+    "input_dir": (str, lambda v: True),
+}
+
+WORKER_FIELDS: dict[str, type] = {
+    "id": str,
+    "name": str,
+    "type": str,
+    "host": str,
+    "port": int,
+    "tpu_chips": list,
+    "enabled": bool,
+    "extra_args": str,
+}
+
+
+def register(app: web.Application, server) -> None:
+    routes = ConfigRoutes(server)
+    app.router.add_get("/distributed/config", routes.get_config)
+    app.router.add_post("/distributed/config", routes.post_config)
+    app.router.add_post("/distributed/config/setting", routes.patch_setting)
+    app.router.add_post("/distributed/config/worker", routes.patch_worker)
+    app.router.add_post("/distributed/config/master", routes.patch_master)
+    app.router.add_delete(
+        "/distributed/config/worker/{worker_id}", routes.delete_worker
+    )
+    app.router.add_get("/distributed/queue_status/{job_id}", routes.queue_status)
+
+
+class ConfigRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def get_config(self, request: web.Request) -> web.Response:
+        return web.json_response(config_mod.load_config(self.server.config_path))
+
+    async def post_config(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "config must be an object"}, status=400)
+        async with config_mod.config_transaction(self.server.config_path) as cfg:
+            for key, value in body.items():
+                cfg[key] = value
+        return web.json_response({"status": "ok"})
+
+    async def patch_setting(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        name, value = body.get("name"), body.get("value")
+        if name not in CONFIG_SCHEMA:
+            return web.json_response({"error": f"unknown setting {name!r}"}, status=400)
+        expected, validator = CONFIG_SCHEMA[name]
+        type_ok = isinstance(value, expected) and not (
+            expected is not bool and isinstance(value, bool)
+        )
+        if not type_ok:
+            return web.json_response(
+                {"error": f"setting {name!r} expects {expected}"}, status=400
+            )
+        if not validator(value):
+            return web.json_response({"error": f"invalid value for {name!r}"}, status=400)
+        async with config_mod.config_transaction(self.server.config_path) as cfg:
+            cfg.setdefault("settings", {})[name] = value
+        return web.json_response({"status": "ok"})
+
+    async def patch_worker(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        worker_id = str(body.get("id", ""))
+        if not worker_id:
+            return web.json_response({"error": "worker id required"}, status=400)
+        for key, value in body.items():
+            if key not in WORKER_FIELDS:
+                return web.json_response({"error": f"unknown field {key!r}"}, status=400)
+            if not isinstance(value, WORKER_FIELDS[key]) and not (
+                WORKER_FIELDS[key] is int and isinstance(value, int)
+            ):
+                return web.json_response(
+                    {"error": f"field {key!r} expects {WORKER_FIELDS[key].__name__}"},
+                    status=400,
+                )
+        async with config_mod.config_transaction(self.server.config_path) as cfg:
+            workers = cfg.setdefault("workers", [])
+            existing = next(
+                (w for w in workers if str(w.get("id")) == worker_id), None
+            )
+            if existing is None:
+                entry = dict(config_mod.WORKER_TEMPLATE)
+                entry.update(body)
+                # port conflicts: same host+port as another worker
+                for w in workers:
+                    if (
+                        w.get("host") == entry.get("host")
+                        and w.get("port") == entry.get("port")
+                        and entry.get("port")
+                    ):
+                        return web.json_response(
+                            {"error": "host:port already in use"}, status=409
+                        )
+                workers.append(entry)
+            else:
+                existing.update(body)
+        return web.json_response({"status": "ok"})
+
+    async def delete_worker(self, request: web.Request) -> web.Response:
+        worker_id = request.match_info["worker_id"]
+        async with config_mod.config_transaction(self.server.config_path) as cfg:
+            before = len(cfg.get("workers", []))
+            cfg["workers"] = [
+                w for w in cfg.get("workers", []) if str(w.get("id")) != worker_id
+            ]
+            removed = before - len(cfg["workers"])
+        if not removed:
+            return web.json_response({"error": "no such worker"}, status=404)
+        return web.json_response({"status": "ok"})
+
+    async def patch_master(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        async with config_mod.config_transaction(self.server.config_path) as cfg:
+            cfg.setdefault("master", {}).update(
+                {k: v for k, v in body.items() if k in ("host", "tpu_chips")}
+            )
+        return web.json_response({"status": "ok"})
+
+    async def queue_status(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        store = self.server.job_store
+        collector = store.collectors.get(job_id)
+        tile_job = store.tile_jobs.get(job_id)
+        return web.json_response(
+            {
+                "exists": collector is not None or tile_job is not None,
+                "collector": collector is not None and {
+                    "received": collector.received,
+                    "finished_workers": sorted(collector.finished_workers),
+                } or None,
+                "tile_job": tile_job is not None and {
+                    "total": tile_job.total_tasks,
+                    "completed": len(tile_job.completed),
+                } or None,
+                "queue_remaining": self.server.queue_remaining,
+            }
+        )

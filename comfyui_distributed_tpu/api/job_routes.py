@@ -1,0 +1,218 @@
+"""Job routes: orchestration entry + collector result ingestion.
+
+Route parity with reference api/job_routes.py:
+    POST /distributed/queue         — REST orchestration entry
+    POST /distributed/job_complete  — canonical collector envelope
+    POST /distributed/prepare_job   — pre-create a collector queue
+    POST /distributed/clear_memory  — drop caches / free device memory
+    POST /distributed/check_file    — media-sync hash check
+    GET  /distributed/load_image    — serve an input image
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any
+
+from aiohttp import web
+
+from ..utils import audio_payload as audio_utils
+from ..utils import image as img_utils
+from ..utils.constants import JOB_INIT_GRACE_SECONDS
+from ..utils.exceptions import PromptValidationError
+from ..utils.logging import debug_log, log
+from .queue_request import QueueRequestError, parse_queue_request_payload
+
+
+def register(app: web.Application, server) -> None:
+    routes = JobRoutes(server)
+    app.router.add_post("/distributed/queue", routes.queue)
+    app.router.add_post("/distributed/job_complete", routes.job_complete)
+    app.router.add_post("/distributed/prepare_job", routes.prepare_job)
+    app.router.add_post("/distributed/clear_memory", routes.clear_memory)
+    app.router.add_post("/distributed/check_file", routes.check_file)
+    app.router.add_get("/distributed/load_image", routes.load_image)
+    app.router.add_post("/upload/image", routes.upload_image)
+
+
+class JobRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def queue(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        try:
+            payload = parse_queue_request_payload(body)
+        except QueueRequestError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+
+        from .orchestration.queue_orchestration import (
+            orchestrate_distributed_execution,
+        )
+
+        try:
+            result = await orchestrate_distributed_execution(self.server, payload)
+        except PromptValidationError as exc:
+            return web.json_response(
+                {"error": str(exc), "node_errors": exc.node_errors}, status=400
+            )
+        return web.json_response(result)
+
+    async def job_complete(self, request: web.Request) -> web.Response:
+        """Canonical envelope {job_id, worker_id, batch_idx, image
+        (base64 PNG data URL), is_last, audio?} — one request per image
+        (reference api/job_routes.py:273-343)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+
+        errors = _validate_envelope(body)
+        if errors:
+            return web.json_response({"error": "; ".join(errors)}, status=400)
+
+        try:
+            tensor = img_utils.decode_image_data_url(body["image"])
+        except Exception as exc:  # noqa: BLE001 - boundary validation
+            return web.json_response(
+                {"error": f"undecodable image: {exc}"}, status=400
+            )
+        audio = None
+        if body.get("audio") is not None:
+            try:
+                audio = audio_utils.decode_audio_payload(body["audio"])
+            except Exception as exc:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"undecodable audio: {exc}"}, status=400
+                )
+
+        job = await self.server.job_store.wait_for_collector(
+            body["job_id"], JOB_INIT_GRACE_SECONDS
+        )
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        await self.server.job_store.put_collector_result(
+            body["job_id"],
+            {
+                "tensor": tensor,
+                "worker_id": str(body["worker_id"]),
+                "batch_idx": int(body["batch_idx"]),
+                "is_last": bool(body.get("is_last", False)),
+                "empty": bool(body.get("empty", False)),
+                "audio": audio,
+            },
+        )
+        return web.json_response({"status": "ok"})
+
+    async def prepare_job(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        job_id = body.get("job_id")
+        if not job_id:
+            return web.json_response({"error": "missing job_id"}, status=400)
+        await self.server.job_store.ensure_collector(str(job_id))
+        return web.json_response({"status": "ok"})
+
+    async def clear_memory(self, request: web.Request) -> web.Response:
+        """Drop pipeline caches and device buffers (the TPU analog of
+        the reference's unload-models + cuda empty_cache)."""
+        self.server.execution_context.pipelines.clear()
+        import gc
+
+        gc.collect()
+        try:
+            import jax
+
+            jax.clear_caches()
+        except Exception as exc:  # noqa: BLE001 - best effort
+            debug_log(f"clear_caches failed: {exc}")
+        log("cleared pipeline caches and compilation caches")
+        return web.json_response({"status": "ok"})
+
+    async def check_file(self, request: web.Request) -> web.Response:
+        """{'filename': ..., 'md5'?: ...} → exists/hash-match (media sync)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        name = body.get("filename")
+        if not name:
+            return web.json_response({"error": "missing filename"}, status=400)
+        from ..graph.io_dirs import get_input_dir, resolve_input_path
+
+        try:
+            path = resolve_input_path(str(name), None)
+        except Exception:
+            return web.json_response({"exists": False})
+        if not os.path.isfile(path):
+            return web.json_response({"exists": False})
+        response: dict[str, Any] = {"exists": True}
+        expected = body.get("md5")
+        if expected:
+            digest = hashlib.md5()
+            with open(path, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    digest.update(chunk)
+            response["md5"] = digest.hexdigest()
+            response["matches"] = digest.hexdigest() == expected
+        return web.json_response(response)
+
+    async def load_image(self, request: web.Request) -> web.Response:
+        name = request.query.get("filename", "")
+        from ..graph.io_dirs import resolve_input_path
+
+        try:
+            path = resolve_input_path(name, None)
+        except Exception:
+            return web.json_response({"error": "bad path"}, status=400)
+        if not os.path.isfile(path):
+            return web.json_response({"error": "not found"}, status=404)
+        return web.FileResponse(path)
+
+    async def upload_image(self, request: web.Request) -> web.Response:
+        """Multipart upload into the input dir (media sync target —
+        ComfyUI /upload/image parity)."""
+        from ..graph.io_dirs import get_input_dir
+
+        reader = await request.multipart()
+        saved = []
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            if part.name in ("image", "file"):
+                filename = os.path.basename(part.filename or "upload.bin")
+                target_dir = get_input_dir(None)
+                os.makedirs(target_dir, exist_ok=True)
+                target = os.path.join(target_dir, filename)
+                with open(target, "wb") as fh:
+                    while True:
+                        chunk = await part.read_chunk()
+                        if not chunk:
+                            break
+                        fh.write(chunk)
+                saved.append(filename)
+        return web.json_response({"name": saved[0] if saved else None, "saved": saved})
+
+
+def _validate_envelope(body: Any) -> list[str]:
+    errors = []
+    if not isinstance(body, dict):
+        return ["body must be an object"]
+    for field in ("job_id", "worker_id", "batch_idx", "image"):
+        if field not in body:
+            errors.append(f"missing {field!r}")
+    if "batch_idx" in body:
+        try:
+            int(body["batch_idx"])
+        except (TypeError, ValueError):
+            errors.append("batch_idx must be an int")
+    if "image" in body and not isinstance(body["image"], str):
+        errors.append("image must be a base64 data-URL string")
+    return errors

@@ -1,0 +1,1 @@
+"""Orchestration (L5): worker selection, dispatch, prompt prep, media sync."""

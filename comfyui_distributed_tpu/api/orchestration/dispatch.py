@@ -1,0 +1,142 @@
+"""Worker probing, selection, and prompt dispatch.
+
+Parity with reference api/orchestration/dispatch.py: concurrent
+bounded probes that drop offline workers, HTTP dispatch via the plain
+/prompt queue API or WS dispatch_prompt/dispatch_ack, and least-busy
+selection (idle workers round-robin via a module counter, else minimum
+queue depth).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+from typing import Any, Optional
+
+import aiohttp
+
+from ...utils.constants import DISPATCH_TIMEOUT_SECONDS, PROBE_CONCURRENCY
+from ...utils.exceptions import WorkerNotAvailableError
+from ...utils.logging import debug_log, log
+from ...utils.network import build_worker_url, get_client_session, probe_worker
+
+# round-robin cursor for idle-worker selection
+_least_busy_rr = itertools.count()
+
+
+async def probe_workers(
+    workers: list[dict[str, Any]], concurrency: int = PROBE_CONCURRENCY
+) -> list[tuple[dict[str, Any], dict[str, Any]]]:
+    """Probe all workers concurrently (bounded); returns
+    [(worker, probe_result)] in input order."""
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(worker):
+        async with sem:
+            return worker, await probe_worker(build_worker_url(worker))
+
+    return list(await asyncio.gather(*(one(w) for w in workers)))
+
+
+async def select_active_workers(
+    workers: list[dict[str, Any]], concurrency: int = PROBE_CONCURRENCY
+) -> list[dict[str, Any]]:
+    """Enabled workers that answered the probe; offline ones are
+    skipped with a log (reference dispatch.py:144-191)."""
+    results = await probe_workers([w for w in workers if w.get("enabled")], concurrency)
+    active = []
+    for worker, probe in results:
+        if probe["online"]:
+            active.append(worker)
+        else:
+            log(f"worker {worker.get('id')} offline; skipping")
+    return active
+
+
+async def select_least_busy_worker(
+    workers: list[dict[str, Any]],
+) -> Optional[dict[str, Any]]:
+    """Load-balanced single placement: pick an idle worker round-robin;
+    if none idle, minimum queue depth (reference dispatch.py:225-268)."""
+    results = await probe_workers(workers)
+    online = [(w, p) for w, p in results if p["online"]]
+    if not online:
+        return None
+    idle = [(w, p) for w, p in online if (p["queue_remaining"] or 0) == 0]
+    if idle:
+        return idle[next(_least_busy_rr) % len(idle)][0]
+    return min(online, key=lambda wp: wp[1]["queue_remaining"] or 0)[0]
+
+
+async def dispatch_worker_prompt(
+    worker: dict[str, Any],
+    prompt: dict[str, Any],
+    prompt_id: str,
+    use_websocket: bool = True,
+    extra_data: dict[str, Any] | None = None,
+) -> None:
+    """Send a rewritten prompt to one worker; raises
+    WorkerNotAvailableError on failure. WS path waits for the ack
+    (reference dispatch.py:62-141)."""
+    if use_websocket:
+        try:
+            await _dispatch_ws(worker, prompt, prompt_id, extra_data)
+            return
+        except Exception as exc:  # noqa: BLE001 - falls back to HTTP
+            debug_log(f"WS dispatch to {worker.get('id')} failed ({exc}); trying HTTP")
+    await _dispatch_http(worker, prompt, prompt_id, extra_data)
+
+
+async def _dispatch_http(worker, prompt, prompt_id, extra_data) -> None:
+    session = await get_client_session()
+    url = build_worker_url(worker, "/prompt")
+    payload = {"prompt": prompt, "prompt_id": prompt_id}
+    if extra_data:
+        payload["extra_data"] = extra_data
+    try:
+        async with session.post(
+            url, json=payload,
+            timeout=aiohttp.ClientTimeout(total=DISPATCH_TIMEOUT_SECONDS),
+        ) as resp:
+            if resp.status != 200:
+                text = await resp.text()
+                raise WorkerNotAvailableError(
+                    f"dispatch to {worker.get('id')} failed: HTTP {resp.status} {text[:200]}",
+                    worker.get("id"),
+                )
+    except aiohttp.ClientError as exc:
+        raise WorkerNotAvailableError(
+            f"dispatch to {worker.get('id')} failed: {exc}", worker.get("id")
+        ) from exc
+
+
+async def _dispatch_ws(worker, prompt, prompt_id, extra_data) -> None:
+    session = await get_client_session()
+    url = build_worker_url(worker, "/distributed/worker_ws").replace(
+        "http://", "ws://"
+    ).replace("https://", "wss://")
+    async with session.ws_connect(
+        url, timeout=aiohttp.ClientWSTimeout(ws_close=DISPATCH_TIMEOUT_SECONDS)
+    ) as ws:
+        await ws.send_json(
+            {
+                "type": "dispatch_prompt",
+                "prompt": prompt,
+                "prompt_id": prompt_id,
+                "extra_data": extra_data or {},
+            }
+        )
+        async with asyncio.timeout(DISPATCH_TIMEOUT_SECONDS):
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                data = json.loads(msg.data)
+                if data.get("type") == "dispatch_ack" and data.get("prompt_id") == prompt_id:
+                    if not data.get("ok"):
+                        raise WorkerNotAvailableError(
+                            f"worker rejected prompt: {data.get('error')}",
+                            worker.get("id"),
+                        )
+                    return
+        raise WorkerNotAvailableError("no dispatch_ack received", worker.get("id"))

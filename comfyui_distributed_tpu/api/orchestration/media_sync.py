@@ -1,0 +1,127 @@
+"""Media file sync: get referenced inputs onto workers before dispatch.
+
+Parity with reference api/orchestration/media_sync.py: scan prompt
+inputs for media references (image/video/audio/file keys or media
+extensions), md5-check each file against the worker
+(/distributed/check_file), upload missing/stale ones via the worker's
+/upload/image endpoint, and convert path separators per the worker's
+/distributed/system_info.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import re
+from typing import Any
+
+import aiohttp
+
+from ...utils.constants import MEDIA_SYNC_TIMEOUT_SECONDS
+from ...utils.logging import debug_log, log
+from ...utils.network import build_worker_url, get_client_session
+
+MEDIA_INPUT_KEYS = ("image", "video", "audio", "file", "filename")
+MEDIA_EXT_RE = re.compile(
+    r"\.(png|jpg|jpeg|webp|gif|bmp|mp4|webm|mov|avi|wav|mp3|flac|ogg|safetensors)$",
+    re.IGNORECASE,
+)
+
+
+def find_media_references(prompt: dict[str, Any]) -> list[tuple[str, str, str]]:
+    """[(node_id, input_key, filename)] for inputs that look like media."""
+    refs = []
+    for node_id, node in prompt.items():
+        for key, value in node.get("inputs", {}).items():
+            if not isinstance(value, str) or not value:
+                continue
+            if key in MEDIA_INPUT_KEYS or MEDIA_EXT_RE.search(value):
+                refs.append((node_id, key, value))
+    return refs
+
+
+def _md5(path: str) -> str:
+    digest = hashlib.md5()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+async def _worker_path_separator(worker: dict[str, Any]) -> str:
+    try:
+        session = await get_client_session()
+        url = build_worker_url(worker, "/distributed/system_info")
+        async with session.get(url, timeout=aiohttp.ClientTimeout(total=10)) as resp:
+            if resp.status == 200:
+                data = await resp.json()
+                return data.get("path_separator", os.sep)
+    except Exception:
+        pass
+    return os.sep
+
+
+async def _check_file(worker, filename: str, md5: str) -> bool:
+    session = await get_client_session()
+    url = build_worker_url(worker, "/distributed/check_file")
+    try:
+        async with session.post(
+            url, json={"filename": filename, "md5": md5},
+            timeout=aiohttp.ClientTimeout(total=15),
+        ) as resp:
+            if resp.status != 200:
+                return False
+            data = await resp.json()
+            return bool(data.get("exists") and data.get("matches", True))
+    except Exception:
+        return False
+
+
+async def _upload_file(worker, path: str, filename: str) -> bool:
+    session = await get_client_session()
+    url = build_worker_url(worker, "/upload/image")
+    form = aiohttp.FormData()
+    with open(path, "rb") as fh:
+        form.add_field("image", fh.read(), filename=os.path.basename(filename))
+    try:
+        async with session.post(
+            url, data=form, timeout=aiohttp.ClientTimeout(total=MEDIA_SYNC_TIMEOUT_SECONDS)
+        ) as resp:
+            return resp.status == 200
+    except Exception as exc:
+        debug_log(f"upload of {filename} to {worker.get('id')} failed: {exc}")
+        return False
+
+
+async def sync_worker_media(
+    worker: dict[str, Any],
+    prompt: dict[str, Any],
+    input_dir: str,
+    timeout: float = MEDIA_SYNC_TIMEOUT_SECONDS,
+) -> dict[str, Any]:
+    """Sync every referenced media file to `worker`; rewrites prompt
+    paths in place for separator differences. Returns the prompt."""
+    refs = find_media_references(prompt)
+    if not refs:
+        return prompt
+    sep = await _worker_path_separator(worker)
+
+    async def sync_one(node_id: str, key: str, filename: str) -> None:
+        local = filename if os.path.isabs(filename) else os.path.join(input_dir, filename)
+        if not os.path.isfile(local):
+            debug_log(f"media ref {filename} not found locally; skipping sync")
+            return
+        digest = _md5(local)
+        if not await _check_file(worker, filename, digest):
+            ok = await _upload_file(worker, local, filename)
+            if ok:
+                log(f"synced {filename} to worker {worker.get('id')}")
+            else:
+                log(f"FAILED to sync {filename} to worker {worker.get('id')}")
+        if sep != os.sep:
+            prompt[node_id]["inputs"][key] = filename.replace(os.sep, sep)
+
+    async with asyncio.timeout(timeout):
+        await asyncio.gather(*(sync_one(*ref) for ref in refs))
+    return prompt

@@ -1,0 +1,162 @@
+"""The distributed queue pipeline: one request → all participants running.
+
+Parity with reference api/queue_orchestration.py
+orchestrate_distributed_execution (its 200-418): load config → resolve
+and probe requested workers → optional load-balanced single placement
+→ job-id map + collector queue init → per-participant prompt rewrite
+(bounded concurrency prep: prune, overrides, media sync) → dispatch
+fan-out → queue the master's own prompt (possibly delegate-pruned).
+
+The mesh difference: participants of type "mesh" are chips driven
+in-process — they are NOT dispatched over HTTP; the master's own
+execution covers them via SPMD (KSampler's per-participant path), so
+this pipeline only fans out to genuinely remote/process workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ...graph.prompt import (
+    ParticipantInfo,
+    PromptIndex,
+    apply_participant_overrides,
+    generate_job_id_map,
+    prepare_delegate_master_prompt,
+    prune_prompt_for_worker,
+)
+from ...utils import config as config_mod
+from ...utils.exceptions import WorkerNotAvailableError
+from ...utils.logging import log
+from ...utils.network import build_master_callback_url
+from ...utils.trace_logger import generate_trace_id, trace_info
+from ..queue_request import QueueRequestPayload
+from .dispatch import (
+    dispatch_worker_prompt,
+    select_active_workers,
+    select_least_busy_worker,
+)
+from .media_sync import sync_worker_media
+
+
+async def orchestrate_distributed_execution(
+    server, payload: QueueRequestPayload
+) -> dict[str, Any]:
+    trace_id = payload.trace_id or generate_trace_id()
+    config = config_mod.load_config(server.config_path)
+    settings = config.get("settings", {})
+
+    # resolve requested workers against config
+    configured = {str(w.get("id")): w for w in config.get("workers", [])}
+    requested = [configured[w] for w in payload.worker_ids if w in configured]
+    remote = [w for w in requested if w.get("type") != "mesh"]
+
+    index = PromptIndex(payload.prompt)
+    trace_info(trace_id, f"orchestrating: {len(remote)} remote worker(s) requested")
+
+    active = await select_active_workers(
+        remote, settings.get("probe_concurrency", 8)
+    )
+
+    # --- load-balanced single placement ---
+    if payload.extra.get("load_balance") and active:
+        target = await select_least_busy_worker(active)
+        if target is not None:
+            job_ids = generate_job_id_map(payload.prompt, index)
+            participant = ParticipantInfo(
+                is_worker=True,
+                worker_index=0,
+                worker_id=str(target.get("id")),
+                master_url=_callback_url(server, target, config),
+                job_ids=job_ids,
+                enabled_worker_ids=[str(target.get("id"))],
+            )
+            worker_prompt = apply_participant_overrides(
+                prune_prompt_for_worker(payload.prompt, index), participant
+            )
+            await dispatch_worker_prompt(
+                target, worker_prompt, f"{trace_id}_lb",
+                settings.get("websocket_orchestration", True),
+            )
+            trace_info(trace_id, f"load-balanced to worker {target.get('id')}")
+            return {
+                "status": "dispatched",
+                "trace_id": trace_id,
+                "mode": "load_balance",
+                "worker": target.get("id"),
+            }
+
+    # --- full fan-out ---
+    job_ids = generate_job_id_map(payload.prompt, index)
+    for job_id in job_ids.values():
+        await server.job_store.ensure_collector(job_id)
+
+    enabled_ids = [str(w.get("id")) for w in active]
+    prep_sem = asyncio.Semaphore(settings.get("prep_concurrency", 4))
+    media_sem = asyncio.Semaphore(settings.get("media_sync_concurrency", 2))
+
+    from ...graph.io_dirs import get_input_dir
+
+    input_dir = get_input_dir(None)
+
+    async def prepare_and_dispatch(position: int, worker: dict[str, Any]):
+        async with prep_sem:
+            participant = ParticipantInfo(
+                is_worker=True,
+                worker_index=position,
+                worker_id=str(worker.get("id")),
+                master_url=_callback_url(server, worker, config),
+                job_ids=job_ids,
+                enabled_worker_ids=enabled_ids,
+            )
+            worker_prompt = apply_participant_overrides(
+                prune_prompt_for_worker(payload.prompt, index), participant
+            )
+            async with media_sem:
+                try:
+                    await sync_worker_media(worker, worker_prompt, input_dir)
+                except Exception as exc:  # noqa: BLE001 - sync best effort
+                    log(f"media sync to {worker.get('id')} failed: {exc}")
+            await dispatch_worker_prompt(
+                worker, worker_prompt, f"{trace_id}_w{position}",
+                settings.get("websocket_orchestration", True),
+            )
+
+    results = await asyncio.gather(
+        *(prepare_and_dispatch(i, w) for i, w in enumerate(active)),
+        return_exceptions=True,
+    )
+    dispatched = []
+    for worker, result in zip(active, results):
+        if isinstance(result, Exception):
+            log(f"dispatch to {worker.get('id')} failed: {result}")
+        else:
+            dispatched.append(str(worker.get("id")))
+
+    # --- master's own prompt ---
+    master_participant = ParticipantInfo(
+        is_worker=False, job_ids=job_ids, enabled_worker_ids=dispatched
+    )
+    master_prompt = apply_participant_overrides(payload.prompt, master_participant)
+    delegate = settings.get("master_delegate_only", False)
+    if delegate and dispatched:
+        master_prompt = prepare_delegate_master_prompt(master_prompt)
+        trace_info(trace_id, "delegate mode: master pruned to collector downstream")
+    elif delegate:
+        trace_info(trace_id, "delegate mode requested but no workers online; master participates")
+
+    job = server.queue_prompt(master_prompt, f"{trace_id}_master", payload.extra)
+    trace_info(trace_id, f"dispatched to {dispatched}; master queued {job.prompt_id}")
+    return {
+        "status": "queued",
+        "trace_id": trace_id,
+        "prompt_id": job.prompt_id,
+        "workers": dispatched,
+        "job_ids": job_ids,
+    }
+
+
+def _callback_url(server, worker: dict[str, Any], config: dict[str, Any]) -> str:
+    master_host = config.get("master", {}).get("host", "")
+    return build_master_callback_url(worker, master_host, server.port)

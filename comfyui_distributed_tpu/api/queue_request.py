@@ -1,0 +1,62 @@
+"""Queue-request payload parsing/validation.
+
+Contract parity with reference api/queue_request.py + api/schemas.py:
+accepts {"prompt" | "workflow": {...}, "workers" | "worker_ids":
+[...], "client_id": str, "job_id"?: str, ...}; strict errors name the
+offending field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ..utils.exceptions import DistributedError
+
+
+class QueueRequestError(DistributedError):
+    pass
+
+
+@dataclasses.dataclass
+class QueueRequestPayload:
+    prompt: dict[str, Any]
+    client_id: str
+    worker_ids: list[str]
+    trace_id: str | None = None
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
+    if not isinstance(body, dict):
+        raise QueueRequestError("request body must be a JSON object")
+
+    prompt = body.get("prompt")
+    if prompt is None and isinstance(body.get("workflow"), dict):
+        prompt = body["workflow"].get("prompt", body["workflow"])
+    if not isinstance(prompt, dict) or not prompt:
+        raise QueueRequestError("missing or empty 'prompt'")
+
+    client_id = body.get("client_id")
+    if not isinstance(client_id, str) or not client_id:
+        raise QueueRequestError("'client_id' is required")
+
+    workers = body.get("workers", body.get("worker_ids", []))
+    if workers is None:
+        workers = []
+    if not isinstance(workers, list) or not all(
+        isinstance(w, (str, int)) for w in workers
+    ):
+        raise QueueRequestError("'workers' must be a list of ids")
+
+    return QueueRequestPayload(
+        prompt=prompt,
+        client_id=client_id,
+        worker_ids=[str(w) for w in workers],
+        trace_id=body.get("trace_id") or None,
+        extra={
+            k: v
+            for k, v in body.items()
+            if k not in ("prompt", "workflow", "client_id", "workers", "worker_ids")
+        },
+    )

@@ -1,0 +1,230 @@
+"""DistributedServer: the runtime hub of one master/worker process.
+
+Owns what the reference borrows from ComfyUI's PromptServer (reference
+SURVEY: queues/locks monkey-patched onto server.PromptServer.instance):
+
+- the aiohttp application with /prompt + /distributed/* routes,
+- the prompt queue, consumed by a dedicated executor thread running
+  GraphExecutor (compute never blocks the loop),
+- the JobStore (collector queues, tile jobs),
+- role identity (master vs worker, from env or constructor).
+
+The same server runs on master and workers; role is decided per-prompt
+by the hidden inputs injected during prompt rewriting, exactly like
+the reference (reference distributed.py:48, prompt_transform.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import queue as thread_queue
+import threading
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..graph import ExecutionContext, GraphExecutor
+from ..jobs import JobStore
+from ..utils import config as config_mod
+from ..utils.async_helpers import set_server_loop
+from ..utils.constants import DEFAULT_MASTER_PORT, WORKER_ENV_FLAG
+from ..utils.exceptions import PromptValidationError
+from ..utils.logging import debug_log, log
+
+
+class PromptJob:
+    def __init__(self, prompt_id: str, prompt: dict, extra: dict | None = None):
+        self.prompt_id = prompt_id
+        self.prompt = prompt
+        self.extra = extra or {}
+        self.done = threading.Event()
+        self.outputs: dict[str, Any] | None = None
+        self.error: str | None = None
+
+
+class DistributedServer:
+    def __init__(
+        self,
+        port: int = DEFAULT_MASTER_PORT,
+        is_worker: Optional[bool] = None,
+        mesh: Any = None,
+        config_path: str | None = None,
+    ):
+        self.port = port
+        self.is_worker = (
+            is_worker
+            if is_worker is not None
+            else os.environ.get(WORKER_ENV_FLAG) == "1"
+        )
+        self.mesh = mesh
+        self.config_path = config_path
+        self.job_store = JobStore()
+        self.app = web.Application(client_max_size=256 * 1024 * 1024)
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+
+        self._prompt_queue: "thread_queue.Queue[Optional[PromptJob]]" = (
+            thread_queue.Queue()
+        )
+        self._executing = threading.Event()
+        self._executor_thread: Optional[threading.Thread] = None
+        self._history: dict[str, PromptJob] = {}
+        self._interrupt = threading.Event()
+        self.execution_context = ExecutionContext(mesh=mesh)
+        # in-memory log ring for the log endpoints
+        self.log_buffer: list[str] = []
+
+        self._register_routes()
+
+    # --- config ----------------------------------------------------------
+
+    @property
+    def config(self) -> dict[str, Any]:
+        return config_mod.load_config(self.config_path)
+
+    # --- routes ----------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        from . import config_routes, job_routes, usdu_routes, worker_routes
+
+        self.app.router.add_get("/prompt", self.handle_get_prompt)
+        self.app.router.add_post("/prompt", self.handle_post_prompt)
+        self.app.router.add_post("/interrupt", self.handle_interrupt)
+        self.app.router.add_get("/history/{prompt_id}", self.handle_history)
+        job_routes.register(self.app, self)
+        usdu_routes.register(self.app, self)
+        config_routes.register(self.app, self)
+        worker_routes.register(self.app, self)
+
+    # --- prompt queue ----------------------------------------------------
+
+    @property
+    def queue_remaining(self) -> int:
+        return self._prompt_queue.qsize() + (1 if self._executing.is_set() else 0)
+
+    async def handle_get_prompt(self, request: web.Request) -> web.Response:
+        # ComfyUI-compatible probe shape (reference utils/network.py:108-136
+        # reads exec_info.queue_remaining as the busy-ness metric).
+        return web.json_response(
+            {"exec_info": {"queue_remaining": self.queue_remaining}}
+        )
+
+    async def handle_post_prompt(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        prompt = body.get("prompt")
+        if not isinstance(prompt, dict):
+            return web.json_response({"error": "missing prompt"}, status=400)
+        prompt_id = body.get("prompt_id") or f"prompt_{len(self._history)}_{os.getpid()}"
+        try:
+            job = self.queue_prompt(prompt, prompt_id, body.get("extra_data"))
+        except PromptValidationError as exc:
+            return web.json_response(
+                {"error": str(exc), "node_errors": exc.node_errors}, status=400
+            )
+        return web.json_response({"prompt_id": job.prompt_id, "number": 0})
+
+    async def handle_interrupt(self, request: web.Request) -> web.Response:
+        self.interrupt()
+        return web.json_response({"interrupted": True})
+
+    async def handle_history(self, request: web.Request) -> web.Response:
+        prompt_id = request.match_info["prompt_id"]
+        job = self._history.get(prompt_id)
+        if job is None:
+            return web.json_response({}, status=404)
+        return web.json_response(
+            {
+                "prompt_id": prompt_id,
+                "done": job.done.is_set(),
+                "error": job.error,
+                "outputs": _jsonable_outputs(job.outputs),
+            }
+        )
+
+    def queue_prompt(
+        self, prompt: dict, prompt_id: str, extra: dict | None = None
+    ) -> PromptJob:
+        """Validate then enqueue (reference utils/async_helpers.py
+        queue_prompt_payload contract: validation errors surface to the
+        caller, not the executor)."""
+        from ..graph import validate_prompt
+
+        validate_prompt(prompt)
+        job = PromptJob(prompt_id, prompt, extra)
+        self._history[prompt_id] = job
+        self._prompt_queue.put(job)
+        return job
+
+    def interrupt(self) -> None:
+        self._interrupt.set()
+        self.execution_context.interrupt_event.set()
+
+    # --- executor thread --------------------------------------------------
+
+    def _executor_loop(self) -> None:
+        while True:
+            job = self._prompt_queue.get()
+            if job is None:
+                return
+            self._executing.set()
+            self._interrupt.clear()
+            ctx = ExecutionContext(
+                mesh=self.mesh,
+                config=self.config,
+                server=self,
+                interrupt_event=self._interrupt,
+                pipelines=self.execution_context.pipelines,
+            )
+            try:
+                debug_log(f"executing prompt {job.prompt_id}")
+                job.outputs = GraphExecutor(ctx).execute(job.prompt)
+            except Exception as exc:  # noqa: BLE001 - reported to client
+                job.error = f"{type(exc).__name__}: {exc}"
+                log(f"prompt {job.prompt_id} failed: {job.error}")
+            finally:
+                self._executing.clear()
+                job.done.set()
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        """Start HTTP listener + executor thread on the running loop."""
+        self.loop = asyncio.get_running_loop()
+        set_server_loop(self.loop)
+        self._executor_thread = threading.Thread(
+            target=self._executor_loop, name="cdt-executor", daemon=True
+        )
+        self._executor_thread.start()
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, "0.0.0.0", self.port)
+        await self._site.start()
+        role = "worker" if self.is_worker else "master"
+        log(f"{role} server listening on :{self.port}")
+
+    async def stop(self) -> None:
+        self._prompt_queue.put(None)
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self._executor_thread is not None:
+            self._executor_thread.join(timeout=10)
+        if self.loop is not None:
+            set_server_loop(None)
+
+
+def _jsonable_outputs(outputs: dict | None) -> dict:
+    if not outputs:
+        return {}
+    out: dict[str, Any] = {}
+    for node_id, result in outputs.items():
+        entry: dict[str, Any] = {}
+        for item in result if isinstance(result, tuple) else (result,):
+            if isinstance(item, dict) and "ui" in item:
+                entry.update(item["ui"])
+        out[node_id] = entry
+    return out

@@ -1,0 +1,153 @@
+"""USDU routes: the tile/image work-queue protocol endpoints.
+
+Parity with reference api/usdu_routes.py:
+    POST /distributed/heartbeat      — per-tile worker liveness
+    POST /distributed/request_image  — pull next tile/image index
+    POST /distributed/submit_tiles   — push processed tiles (batched)
+    POST /distributed/submit_image   — push a whole processed image
+    POST /distributed/job_status     — ready/progress poll
+
+Transport note: the reference ships tiles as multipart PNG parts with
+a JSON metadata field; here tiles travel as JSON entries with base64
+PNG data-URLs. Same size-aware chunking semantics (client side), one
+fewer parser; the /distributed/submit_image endpoint accepts both
+JSON and multipart for compatibility.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from aiohttp import web
+
+from ..utils.constants import JOB_INIT_GRACE_SECONDS, QUEUE_POLL_INTERVAL_SECONDS
+from ..utils.logging import debug_log
+
+
+def register(app: web.Application, server) -> None:
+    routes = UsduRoutes(server)
+    app.router.add_post("/distributed/heartbeat", routes.heartbeat)
+    app.router.add_post("/distributed/request_image", routes.request_image)
+    app.router.add_post("/distributed/submit_tiles", routes.submit_tiles)
+    app.router.add_post("/distributed/submit_image", routes.submit_image)
+    app.router.add_post("/distributed/job_status", routes.job_status)
+
+
+async def _json(request: web.Request) -> Any:
+    try:
+        return await request.json()
+    except Exception:
+        return None
+
+
+class UsduRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def heartbeat(self, request: web.Request) -> web.Response:
+        body = await _json(request)
+        if not body or "job_id" not in body or "worker_id" not in body:
+            return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        ok = await self.server.job_store.heartbeat(
+            str(body["job_id"]), str(body["worker_id"])
+        )
+        return web.json_response({"status": "ok" if ok else "unknown_job"})
+
+    async def request_image(self, request: web.Request) -> web.Response:
+        """Pull one work item. Response: {tile_idx|image_idx|None,
+        estimated_remaining, batched_static}."""
+        body = await _json(request)
+        if not body or "job_id" not in body or "worker_id" not in body:
+            return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
+        job = await self.server.job_store.wait_for_tile_job(
+            job_id, JOB_INIT_GRACE_SECONDS
+        )
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        task_id = await self.server.job_store.pull_task(
+            job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
+        )
+        remaining = await self.server.job_store.remaining(job_id)
+        key = "tile_idx" if job.batched or type(job).__name__ == "TileJob" else "image_idx"
+        return web.json_response(
+            {
+                key: task_id,
+                "estimated_remaining": remaining,
+                "batched_static": job.batched,
+            }
+        )
+
+    async def submit_tiles(self, request: web.Request) -> web.Response:
+        """{job_id, worker_id, tiles: [entry...], is_final_flush} where
+        entry = {tile_idx, batch_idx, global_idx, x, y, extracted_w/h,
+        image: dataURL}. Entries are grouped per tile_idx into one
+        result payload each."""
+        body = await _json(request)
+        if not body or "job_id" not in body or "worker_id" not in body:
+            return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
+        tiles = body.get("tiles", [])
+        if not isinstance(tiles, list):
+            return web.json_response({"error": "tiles must be a list"}, status=400)
+
+        store = self.server.job_store
+        job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+
+        grouped: dict[int, list[dict]] = {}
+        for entry in tiles:
+            if not isinstance(entry, dict) or "tile_idx" not in entry or "image" not in entry:
+                return web.json_response({"error": "bad tile entry"}, status=400)
+            grouped.setdefault(int(entry["tile_idx"]), []).append(entry)
+        accepted = 0
+        for tile_idx, payload in grouped.items():
+            if await store.submit_result(job_id, worker_id, tile_idx, payload):
+                accepted += 1
+        if body.get("is_final_flush"):
+            await store.mark_worker_done(job_id, worker_id)
+        debug_log(
+            f"submit_tiles job={job_id} worker={worker_id} "
+            f"tiles={len(grouped)} accepted={accepted}"
+        )
+        return web.json_response({"status": "ok", "accepted": accepted})
+
+    async def submit_image(self, request: web.Request) -> web.Response:
+        """Dynamic mode: one whole processed image. JSON body:
+        {job_id, worker_id, image_idx, image: dataURL, is_last}."""
+        body = await _json(request)
+        if not body or "job_id" not in body or "worker_id" not in body:
+            return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
+        if "image_idx" not in body or "image" not in body:
+            return web.json_response({"error": "image_idx and image required"}, status=400)
+        store = self.server.job_store
+        job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        await store.submit_result(
+            job_id, worker_id, int(body["image_idx"]),
+            [{"batch_idx": 0, "image": body["image"], "whole_image": True}],
+        )
+        if body.get("is_last"):
+            await store.mark_worker_done(job_id, worker_id)
+        return web.json_response({"status": "ok"})
+
+    async def job_status(self, request: web.Request) -> web.Response:
+        body = await _json(request)
+        if not body or "job_id" not in body:
+            return web.json_response({"error": "job_id required"}, status=400)
+        job = await self.server.job_store.get_tile_job(str(body["job_id"]))
+        if job is None:
+            # also a ready-poll target for collector jobs
+            collector = self.server.job_store.collectors.get(str(body["job_id"]))
+            return web.json_response({"ready": collector is not None})
+        return web.json_response(
+            {
+                "ready": True,
+                "total": job.total_tasks,
+                "completed": len(job.completed),
+                "remaining": job.pending.qsize(),
+            }
+        )

@@ -1,0 +1,224 @@
+"""Worker routes: WS dispatch, lifecycle, logs, host/topology info.
+
+Parity with reference api/worker_routes.py (695 LoC there):
+    WS   /distributed/worker_ws      — dispatch_prompt/dispatch_ack
+    POST /distributed/launch_worker  — spawn a local worker process
+    POST /distributed/stop_worker    — stop a managed worker
+    GET  /distributed/managed        — managed process table
+    GET  /distributed/worker_log/{n} — tail a worker's log file
+    GET  /distributed/master_log     — in-memory master log ring
+    GET  /distributed/network_info   — candidate IPs, private ranked
+    GET  /distributed/system_info    — machine id, path sep, TPU topology
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from ..utils.logging import debug_log, log
+
+
+def register(app: web.Application, server) -> None:
+    routes = WorkerRoutes(server)
+    app.router.add_get("/distributed/worker_ws", routes.worker_ws)
+    app.router.add_post("/distributed/launch_worker", routes.launch_worker)
+    app.router.add_post("/distributed/stop_worker", routes.stop_worker)
+    app.router.add_get("/distributed/managed", routes.managed)
+    app.router.add_get("/distributed/worker_log/{name}", routes.worker_log)
+    app.router.add_get("/distributed/master_log", routes.master_log)
+    app.router.add_get("/distributed/network_info", routes.network_info)
+    app.router.add_get("/distributed/system_info", routes.system_info)
+
+
+class WorkerRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    # --- websocket dispatch ------------------------------------------------
+
+    async def worker_ws(self, request: web.Request) -> web.WebSocketResponse:
+        """Server side of WS orchestration (reference
+        api/worker_routes.py:43-112): the master connects and sends
+        {type: dispatch_prompt, prompt, prompt_id}; we enqueue and ack
+        {type: dispatch_ack, prompt_id, ok}."""
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                continue
+            try:
+                data = json.loads(msg.data)
+            except json.JSONDecodeError:
+                await ws.send_json({"type": "error", "error": "invalid json"})
+                continue
+            if data.get("type") == "dispatch_prompt":
+                prompt_id = data.get("prompt_id", "")
+                try:
+                    self.server.queue_prompt(
+                        data.get("prompt", {}), prompt_id, data.get("extra_data")
+                    )
+                    await ws.send_json(
+                        {"type": "dispatch_ack", "prompt_id": prompt_id, "ok": True}
+                    )
+                except Exception as exc:  # noqa: BLE001 - reported over WS
+                    await ws.send_json(
+                        {
+                            "type": "dispatch_ack",
+                            "prompt_id": prompt_id,
+                            "ok": False,
+                            "error": str(exc),
+                        }
+                    )
+            elif data.get("type") == "ping":
+                await ws.send_json(
+                    {"type": "pong", "queue_remaining": self.server.queue_remaining}
+                )
+        return ws
+
+    # --- lifecycle ---------------------------------------------------------
+
+    async def launch_worker(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        worker_id = str(body.get("worker_id", ""))
+        if not worker_id:
+            return web.json_response({"error": "worker_id required"}, status=400)
+        worker = next(
+            (
+                w
+                for w in self.server.config.get("workers", [])
+                if str(w.get("id")) == worker_id
+            ),
+            None,
+        )
+        if worker is None:
+            return web.json_response({"error": "no such worker"}, status=404)
+
+        from ..workers import get_worker_manager
+
+        manager = get_worker_manager()
+        try:
+            info = await _run_blocking(
+                manager.launch_worker, worker, self.server.config_path
+            )
+        except Exception as exc:  # noqa: BLE001 - reported to client
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response({"status": "ok", **info})
+
+    async def stop_worker(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        worker_id = str(body.get("worker_id", ""))
+        from ..workers import get_worker_manager
+
+        manager = get_worker_manager()
+        stopped = await _run_blocking(
+            manager.stop_worker, worker_id, self.server.config_path
+        )
+        return web.json_response({"status": "ok", "stopped": stopped})
+
+    async def managed(self, request: web.Request) -> web.Response:
+        from ..workers import get_worker_manager
+
+        return web.json_response(
+            {"managed": get_worker_manager().managed_processes(self.server.config_path)}
+        )
+
+    # --- logs --------------------------------------------------------------
+
+    async def worker_log(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        tail = int(request.query.get("tail", 200))
+        from ..workers.process_manager import worker_log_path
+
+        path = worker_log_path(name)
+        if not os.path.isfile(path):
+            return web.json_response({"error": "no log"}, status=404)
+        lines = _tail_file(path, tail)
+        return web.json_response({"name": name, "lines": lines})
+
+    async def master_log(self, request: web.Request) -> web.Response:
+        tail = int(request.query.get("tail", 200))
+        return web.json_response({"lines": self.server.log_buffer[-tail:]})
+
+    # --- host info ----------------------------------------------------------
+
+    async def network_info(self, request: web.Request) -> web.Response:
+        """Candidate IPs for reaching this host, private IPs ranked
+        first (reference api/worker_routes.py network_info)."""
+        candidates: list[str] = []
+        try:
+            hostname = socket.gethostname()
+            for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+                addr = info[4][0]
+                if addr not in candidates:
+                    candidates.append(addr)
+        except OSError:
+            pass
+        # UDP-connect trick: the OS picks the outbound interface
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("10.255.255.255", 1))
+                addr = s.getsockname()[0]
+                if addr not in candidates:
+                    candidates.insert(0, addr)
+        except OSError:
+            pass
+        from ..utils.network import is_private_host
+
+        ranked = sorted(
+            (a for a in candidates if a != "127.0.0.1"),
+            key=lambda a: (not is_private_host(a), a),
+        )
+        return web.json_response(
+            {"candidates": ranked or candidates, "recommended": (ranked or ["127.0.0.1"])[0]}
+        )
+
+    async def system_info(self, request: web.Request) -> web.Response:
+        """Machine identity + accelerator topology (the reference
+        reports CUDA devices via nvidia-smi; we report the jax device
+        mesh — reference api/worker_routes.py:237-274)."""
+        from ..workers.detection import get_machine_id, is_docker
+
+        info: dict[str, Any] = {
+            "machine_id": get_machine_id(),
+            "path_separator": os.sep,
+            "platform": os.name,
+            "docker": is_docker(),
+            "is_worker": self.server.is_worker,
+        }
+        try:
+            from ..parallel.mesh import describe_topology
+
+            info["topology"] = describe_topology()
+        except Exception as exc:  # noqa: BLE001 - best effort
+            info["topology"] = {"error": str(exc)}
+        return web.json_response(info)
+
+
+async def _run_blocking(fn, *args):
+    import asyncio
+
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+
+def _tail_file(path: str, n_lines: int) -> list[str]:
+    """Tail-read a potentially large file without loading it whole."""
+    avg = 200
+    with open(path, "rb") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        window = min(size, max(4096, n_lines * avg))
+        fh.seek(size - window)
+        data = fh.read().decode("utf-8", errors="replace")
+    lines = data.splitlines()
+    return lines[-n_lines:]

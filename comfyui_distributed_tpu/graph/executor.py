@@ -68,6 +68,20 @@ def validate_prompt(prompt: Prompt) -> None:
             if is_link(value):
                 if value[0] not in prompt:
                     errors.append(f"input {name!r} links to missing node {value[0]!r}")
+                else:
+                    src = prompt[value[0]]
+                    src_cls = (
+                        NODE_REGISTRY.get(src.get("class_type", ""))
+                        if isinstance(src, dict)
+                        else None
+                    )
+                    if src_cls is not None:
+                        n_outputs = len(getattr(src_cls, "RETURN_TYPES", ()))
+                        if value[1] >= n_outputs:
+                            errors.append(
+                                f"input {name!r} links to output {value[1]} of "
+                                f"node {value[0]!r} which has {n_outputs} output(s)"
+                            )
         if errors:
             node_errors[str(node_id)] = errors
 

@@ -335,10 +335,21 @@ class SaveImage:
 
         out_dir = get_output_dir(context)
         os.makedirs(out_dir, exist_ok=True)
+        # resume numbering after existing files so runs never clobber
+        # each other (ComfyUI counter-scan behavior)
+        existing = [
+            f for f in os.listdir(out_dir)
+            if f.startswith(f"{filename_prefix}_") and f.endswith(".png")
+        ]
+        start = 0
+        for f in existing:
+            stem = f[len(filename_prefix) + 1 : -4]
+            if stem.isdigit():
+                start = max(start, int(stem) + 1)
         saved = []
         arr = img_utils.ensure_numpy(images)
         for i in range(arr.shape[0]):
-            name = f"{filename_prefix}_{i:05d}.png"
+            name = f"{filename_prefix}_{start + i:05d}.png"
             path = os.path.join(out_dir, name)
             with open(path, "wb") as fh:
                 fh.write(img_utils.encode_png(arr[i], compress_level=4))

@@ -275,8 +275,31 @@ class DistributedCollector:
 
         async def send_all():
             session = await get_client_session()
-            for idx in range(batch):
+            if batch == 0:
+                # An empty batch still needs an is_last envelope or the
+                # master waits a full timeout for this worker. The 1px
+                # placeholder satisfies envelope validation; "empty"
+                # tells the collector to discard the tensor.
                 envelope: dict[str, Any] = {
+                    "job_id": job_id,
+                    "worker_id": worker_id,
+                    "batch_idx": 0,
+                    "image": img_utils.encode_image_data_url(
+                        np.zeros((1, 1, 3), np.float32)
+                    ),
+                    "is_last": True,
+                    "empty": True,
+                }
+                if audio is not None:
+                    envelope["audio"] = audio_utils.encode_audio_payload(
+                        audio["waveform"], audio["sample_rate"]
+                    )
+                await self._post_with_retry(
+                    session, f"{master_url}/distributed/job_complete", envelope
+                )
+                return
+            for idx in range(batch):
+                envelope = {
                     "job_id": job_id,
                     "worker_id": worker_id,
                     "batch_idx": idx,
@@ -338,11 +361,13 @@ class DistributedCollector:
         per_worker: dict[str, list[tuple[int, np.ndarray]]] = {}
         for item in collected:
             wid = str(item["worker_id"])
+            if item.get("audio") is not None:
+                audio_parts.append(item["audio"])
+            if item.get("empty"):
+                continue  # zero-batch marker: worker finished, no images
             per_worker.setdefault(wid, []).append(
                 (int(item.get("batch_idx", 0)), item["tensor"])
             )
-            if item.get("audio") is not None:
-                audio_parts.append(item["audio"])
         next_straggler = len(enabled_worker_ids) + 1
         for wid in sorted(per_worker, key=lambda w: order.get(w, 10**6)):
             imgs = [t for _, t in sorted(per_worker[wid], key=lambda p: p[0])]
@@ -353,12 +378,20 @@ class DistributedCollector:
             batches[idx] = np.stack(imgs, axis=0)
 
         ordered = reorder_participant_first(batches, list(range(1, next_straggler)))
-        sizes = {a.shape[1:] for a in ordered if a.size}
+        nonempty = [a for a in ordered if a.size]
+        sizes = {a.shape[1:] for a in nonempty}
         if len(sizes) > 1:
-            log(f"collector: mismatched image sizes {sizes}; keeping master size")
-            target = ordered[0].shape[1:]
-            ordered = [a for a in ordered if a.shape[1:] == target]
-        combined = np.concatenate([a for a in ordered if a.size], axis=0)
+            # keep the majority/first NON-empty size (the master batch may
+            # be an empty delegate placeholder whose nominal size is moot)
+            target = nonempty[0].shape[1:]
+            log(f"collector: mismatched image sizes {sizes}; keeping {target}")
+            nonempty = [a for a in nonempty if a.shape[1:] == target]
+        if nonempty:
+            combined = np.concatenate(nonempty, axis=0)
+        else:
+            # every participant returned empty (or all workers dropped):
+            # surface the master's (possibly zero-batch) images unchanged
+            combined = mesh_collected
 
         combined_audio = None
         if audio_parts:

@@ -107,7 +107,8 @@ class UltimateSDUpscaleDistributed:
             # WAN-family video models require 4n+1 frame batches
             log(f"USDU: batch {batch} is not 4n+1; video models may reject it")
 
-        tile = min(int(tile_width), int(tile_height))
+        tile = int(tile_width)
+        tile_h = int(tile_height)
         mesh = getattr(context, "mesh", None) if context is not None else None
         enabled = enabled_worker_ids or []
 
@@ -117,7 +118,7 @@ class UltimateSDUpscaleDistributed:
             run_worker_loop(
                 bundle=model, image=image, pos=positive, neg=negative,
                 job_id=job_id, worker_id=worker_id, master_url=master_url,
-                upscale_by=float(upscale_by), tile=tile,
+                upscale_by=float(upscale_by), tile=tile, tile_h=tile_h,
                 padding=int(tile_padding), steps=int(steps),
                 sampler=sampler_name, scheduler=scheduler, cfg=float(cfg),
                 denoise=float(denoise), seed=int(seed),
@@ -133,7 +134,7 @@ class UltimateSDUpscaleDistributed:
                     bundle=model, image=image, pos=positive, neg=negative,
                     job_id=job_id, enabled_worker_ids=list(enabled),
                     mesh=mesh, upscale_by=float(upscale_by), tile=tile,
-                    padding=int(tile_padding), steps=int(steps),
+                    tile_h=tile_h, padding=int(tile_padding), steps=int(steps),
                     sampler=sampler_name, scheduler=scheduler,
                     cfg=float(cfg), denoise=float(denoise), seed=int(seed),
                     upscale_method=upscale_method, context=context,
@@ -142,7 +143,8 @@ class UltimateSDUpscaleDistributed:
 
         out = upscale_ops.run_upscale(
             bundle=model, image=image, pos=positive, neg=negative, mesh=mesh,
-            upscale_by=float(upscale_by), tile=tile, padding=int(tile_padding),
+            upscale_by=float(upscale_by), tile=tile, tile_h=tile_h,
+            padding=int(tile_padding),
             steps=int(steps), sampler=sampler_name, scheduler=scheduler,
             cfg=float(cfg), denoise=float(denoise), seed=int(seed),
             upscale_method=upscale_method,

@@ -162,6 +162,7 @@ def run_worker_loop(
     denoise: float,
     seed: int,
     upscale_method: str = "bicubic",
+    tile_h: int | None = None,
     context=None,
     client: Any = None,
 ) -> None:
@@ -171,14 +172,9 @@ def run_worker_loop(
     if not client.poll_ready():
         raise WorkerError(f"job {job_id} never became ready", worker_id)
 
-    b, h, w, c = image.shape
-    out_h, out_w, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding)
-    method = {"bicubic": "cubic", "bilinear": "linear", "nearest": "nearest",
-              "lanczos": "lanczos3"}.get(upscale_method, "cubic")
-    upscaled = jnp.clip(
-        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    extracted = tile_ops.extract_tiles(upscaled, grid)
     process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
 
@@ -260,6 +256,7 @@ def run_master_elastic(
     denoise: float = 0.35,
     seed: int = 0,
     upscale_method: str = "bicubic",
+    tile_h: int | None = None,
     context=None,
 ):
     """Master participates in the tile queue and collects worker tiles.
@@ -271,14 +268,9 @@ def run_master_elastic(
 
     server = context.server
     store = server.job_store
-    b, h, w, c = image.shape
-    out_h, out_w, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding)
-    method = {"bicubic": "cubic", "bilinear": "linear", "nearest": "nearest",
-              "lanczos": "lanczos3"}.get(upscale_method, "cubic")
-    upscaled = jnp.clip(
-        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    extracted = tile_ops.extract_tiles(upscaled, grid)
     process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
 
@@ -359,15 +351,28 @@ def run_master_elastic(
         requeued = run_async_in_server_loop(
             store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
         )
-        for tile_idx in requeued:
-            if tile_idx in done_tiles:
-                continue
-            tkey = jax.random.fold_in(key, tile_idx)
-            result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
-            run_async_in_server_loop(
-                store.submit_result(job_id, "master", tile_idx, None), timeout=30
-            )
-            blend_local(tile_idx, result)
+        if requeued:
+            # Requeued ids are back in the pending queue; claim them
+            # through the same pull path workers use so each tile is
+            # processed exactly once (a surviving worker may grab some
+            # before we do).
+            while True:
+                tile_idx = run_async_in_server_loop(
+                    store.pull_task(
+                        job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS
+                    ),
+                    timeout=30,
+                )
+                if tile_idx is None:
+                    break
+                if tile_idx in done_tiles:
+                    continue
+                tkey = jax.random.fold_in(key, tile_idx)
+                result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+                run_async_in_server_loop(
+                    store.submit_result(job_id, "master", tile_idx, None), timeout=30
+                )
+                blend_local(tile_idx, result)
         if len(done_tiles) >= grid.num_tiles:
             break
         if time.monotonic() > deadline:

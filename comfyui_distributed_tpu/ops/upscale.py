@@ -31,18 +31,58 @@ from . import samplers as smp
 from . import tiles as tile_ops
 
 
+# jax.image.resize method names for the user-facing upscale_method knob
+RESIZE_METHODS = {
+    "bicubic": "cubic",
+    "bilinear": "linear",
+    "nearest": "nearest",
+    "nearest-exact": "nearest",
+    "lanczos": "lanczos3",
+    "area": "linear",
+}
+
+
 def plan_grid(
-    image_h: int, image_w: int, upscale_by: float, tile: int, padding: int
+    image_h: int,
+    image_w: int,
+    upscale_by: float,
+    tile_w: int,
+    padding: int,
+    tile_h: int | None = None,
 ) -> tuple[int, int, tile_ops.TileGrid]:
     """Target size + tile grid for an upscale run. Tile geometry is
     clamped to the image and snapped to the VAE factor (8) so latent
-    shapes stay integral."""
+    shapes stay integral. Non-square tiles supported (tile_h defaults
+    to tile_w)."""
     out_h = int(round(image_h * upscale_by / 8)) * 8
     out_w = int(round(image_w * upscale_by / 8)) * 8
-    tile = max(64, (tile // 8) * 8)
+    tile_h = tile_h if tile_h is not None else tile_w
+    tile_w = max(64, (int(tile_w) // 8) * 8)
+    tile_h = max(64, (int(tile_h) // 8) * 8)
     padding = max(8, (padding // 8) * 8)
-    grid = tile_ops.calculate_tiles(out_h, out_w, tile, tile, padding)
+    grid = tile_ops.calculate_tiles(out_h, out_w, tile_h, tile_w, padding)
     return out_h, out_w, grid
+
+
+def prepare_upscaled_tiles(
+    image: jax.Array,
+    upscale_by: float,
+    tile_w: int,
+    padding: int,
+    upscale_method: str = "bicubic",
+    tile_h: int | None = None,
+) -> tuple[jax.Array, tile_ops.TileGrid, jax.Array]:
+    """Shared preamble for every USDU path (local / mesh / elastic
+    master / elastic worker): resize, clip, extract. All participants
+    MUST use this same function — bit-identical tile inputs are what
+    makes cross-participant requeue seamless."""
+    b, h, w, c = image.shape
+    out_h, out_w, grid = plan_grid(h, w, upscale_by, tile_w, padding, tile_h)
+    method = RESIZE_METHODS.get(upscale_method, "cubic")
+    upscaled = jnp.clip(
+        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    )
+    return upscaled, grid, tile_ops.extract_tiles(upscaled, grid)
 
 
 def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise):
@@ -132,10 +172,13 @@ def upscale_mesh(
     extracted = tile_ops.extract_tiles(upscaled, grid)  # [T, B, th, tw, C]
     t = grid.num_tiles
     per_chip = -(-t // n)  # ceil
-    pad = per_chip * n - t
-    if pad:
-        extracted = jnp.concatenate([extracted, extracted[:pad]], axis=0)
-    global_idx = jnp.arange(per_chip * n)
+    total = per_chip * n
+    if total > t:
+        # wrap-around padding: works even when t < n (tiny images on
+        # wide meshes); padded duplicates are sliced off after gather
+        reps = -(-total // t)
+        extracted = jnp.concatenate([extracted] * reps, axis=0)[:total]
+    global_idx = jnp.arange(total)
 
     def per_chip_fn(tiles_shard, idx_shard, params, pos, neg):
         def body(_, inp):
@@ -172,15 +215,12 @@ def run_upscale(
     denoise: float = 0.35,
     seed: int = 0,
     upscale_method: str = "bicubic",
+    tile_h: int | None = None,
 ) -> jax.Array:
     """Full upscale: resize then tile-rediffuse. Routes to the mesh
     path when a multi-participant mesh is available."""
-    b, h, w, c = image.shape
-    out_h, out_w, grid = plan_grid(h, w, upscale_by, tile, padding)
-    method = {"bicubic": "cubic", "bilinear": "linear", "nearest": "nearest",
-              "lanczos": "lanczos3"}.get(upscale_method, "cubic")
-    upscaled = jnp.clip(
-        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+    upscaled, grid, _ = prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
     )
     key = jax.random.key(seed)
     if mesh is not None and data_axis_size(mesh) > 1:

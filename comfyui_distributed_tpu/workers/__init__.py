@@ -1,0 +1,3 @@
+"""Worker process lifecycle: launch, monitor, persistence, detection."""
+
+from .process_manager import WorkerProcessManager, get_worker_manager  # noqa: F401

@@ -1,0 +1,62 @@
+"""Environment detection: machine identity, container/cloud detection,
+same-host checks.
+
+Parity with reference workers/detection.py: machine id from the MAC
+uuid, docker detection via cgroup/.dockerenv, same-physical-host by
+comparing machine ids over the worker API.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+from ..utils.network import build_worker_url, get_client_session
+
+
+def get_machine_id() -> str:
+    return f"{uuid.getnode():012x}"
+
+
+def is_docker() -> bool:
+    if os.path.exists("/.dockerenv"):
+        return True
+    try:
+        with open("/proc/1/cgroup", "r", encoding="utf-8") as fh:
+            content = fh.read()
+        return "docker" in content or "containerd" in content or "kubepods" in content
+    except OSError:
+        return False
+
+
+def is_cloud_environment() -> bool:
+    return bool(
+        os.environ.get("RUNPOD_POD_ID")
+        or os.environ.get("KUBERNETES_SERVICE_HOST")
+        or os.environ.get("CDT_CLOUD")
+    )
+
+
+def is_local_worker(worker: dict[str, Any]) -> bool:
+    if worker.get("type") in ("local", "mesh"):
+        return True
+    from ..utils.network import is_loopback_host
+
+    return is_loopback_host(str(worker.get("host", "")))
+
+
+async def is_same_physical_host(worker: dict[str, Any]) -> bool:
+    """Compare the remote worker's machine id with ours over its API."""
+    if is_local_worker(worker):
+        return True
+    try:
+        session = await get_client_session()
+        url = build_worker_url(worker, "/distributed/system_info")
+        async with session.get(url) as resp:
+            if resp.status != 200:
+                return False
+            data = await resp.json()
+            return data.get("machine_id") == get_machine_id()
+    except Exception:
+        return False

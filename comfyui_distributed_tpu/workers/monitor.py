@@ -1,0 +1,92 @@
+"""Worker watchdog: kill the worker when the master dies.
+
+Standalone-usable module (reference workers/worker_monitor.py is a
+separate script): polls the master PID every few seconds and
+terminates the wrapped worker process when it disappears, so orphaned
+workers don't keep chips allocated after a master crash.
+
+Used two ways: in-process (a worker started with CDT_MASTER_PID spawns
+a daemon thread via `start_master_watchdog`) or as a wrapper process
+(`python -m comfyui_distributed_tpu.workers.monitor -- <cmd...>`).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ..utils.constants import MASTER_PID_ENV, MONITOR_POLL_INTERVAL_SECONDS
+from ..utils.logging import log
+from .process_manager import is_process_alive
+
+
+def start_master_watchdog(on_dead=None) -> threading.Thread | None:
+    """If CDT_MASTER_PID is set, watch it and exit when it dies."""
+    master_pid = os.environ.get(MASTER_PID_ENV)
+    if not master_pid:
+        return None
+    pid = int(master_pid)
+
+    def watch():
+        while True:
+            if not is_process_alive(pid):
+                log(f"master pid {pid} gone; shutting down worker")
+                if on_dead is not None:
+                    on_dead()
+                os._exit(0)
+            time.sleep(MONITOR_POLL_INTERVAL_SECONDS)
+
+    thread = threading.Thread(target=watch, name="cdt-master-watchdog", daemon=True)
+    thread.start()
+    return thread
+
+
+def monitor_and_run(command: list[str], master_pid: int) -> int:
+    """Wrapper-process mode: spawn the real worker, poll the master,
+    kill the worker tree when the master dies."""
+    proc = subprocess.Popen(command)
+
+    def forward(signum, _frame):
+        try:
+            proc.send_signal(signum)
+        except ProcessLookupError:
+            pass
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, forward)
+
+    while True:
+        ret = proc.poll()
+        if ret is not None:
+            return ret
+        if not is_process_alive(master_pid):
+            log(f"master pid {master_pid} gone; terminating worker {proc.pid}")
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            return 0
+        time.sleep(MONITOR_POLL_INTERVAL_SECONDS)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if "--" in argv:
+        split = argv.index("--")
+        command = argv[split + 1:]
+    else:
+        command = argv
+    master_pid = int(os.environ.get(MASTER_PID_ENV, "0"))
+    if not command or not master_pid:
+        print("usage: CDT_MASTER_PID=<pid> monitor -- <command...>", file=sys.stderr)
+        return 2
+    return monitor_and_run(command, master_pid)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,215 @@
+"""Worker process lifecycle management.
+
+The reference's WorkerProcessManager subsystem (reference
+workers/process_manager.py + workers/process/*): build a launch
+command, spawn with per-worker env (chip pinning, role flag, master
+pid), log to per-worker files, persist PIDs into config
+managed_processes for restore-on-restart, and stop via process-tree
+kill. TPU adaptations: chip pinning via TPU_VISIBLE_CHIPS instead of
+CUDA_VISIBLE_DEVICES; workers run `python -m comfyui_distributed_tpu
+--port N --worker`.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+import psutil
+
+from ..utils import config as config_mod
+from ..utils.constants import MASTER_PID_ENV, TPU_VISIBLE_CHIPS_ENV, WORKER_ENV_FLAG
+from ..utils.exceptions import ProcessError
+from ..utils.logging import debug_log, log
+
+FORBIDDEN_ARG_CHARS = set(";&|`$<>\n\r")
+
+
+def logs_dir() -> str:
+    return os.environ.get(
+        "CDT_LOG_DIR", os.path.join(os.getcwd(), "logs", "workers")
+    )
+
+
+def worker_log_path(name: str) -> str:
+    date = datetime.date.today().isoformat()
+    safe = "".join(c for c in name if c.isalnum() or c in "-_") or "worker"
+    return os.path.join(logs_dir(), f"{safe}_{date}.log")
+
+
+def get_python_executable() -> str:
+    return sys.executable or "python3"
+
+
+def is_process_alive(pid: int) -> bool:
+    try:
+        proc = psutil.Process(pid)
+        return proc.is_running() and proc.status() != psutil.STATUS_ZOMBIE
+    except (psutil.NoSuchProcess, ValueError):
+        return False
+
+
+def sanitize_extra_args(extra: str) -> list[str]:
+    """Split user-provided extra CLI args, refusing shell metacharacters
+    (reference workers/process/launch_builder.py sanitization)."""
+    if not extra:
+        return []
+    if any(c in FORBIDDEN_ARG_CHARS for c in extra):
+        raise ProcessError(f"forbidden characters in extra_args: {extra!r}")
+    return shlex.split(extra)
+
+
+class WorkerProcessManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    # --- launch -----------------------------------------------------------
+
+    def build_launch_command(self, worker: dict[str, Any]) -> list[str]:
+        cmd = [
+            get_python_executable(),
+            "-m",
+            "comfyui_distributed_tpu",
+            "--port",
+            str(worker.get("port") or 8189),
+            "--worker",
+        ]
+        cmd += sanitize_extra_args(str(worker.get("extra_args", "") or ""))
+        return cmd
+
+    def launch_worker(
+        self, worker: dict[str, Any], config_path: str | None = None
+    ) -> dict[str, Any]:
+        worker_id = str(worker.get("id") or worker.get("name") or "worker")
+        with self._lock:
+            managed = self.managed_processes(config_path)
+            existing = managed.get(worker_id)
+            if existing and is_process_alive(int(existing.get("pid", -1))):
+                raise ProcessError(
+                    f"worker {worker_id} already running (pid {existing['pid']})"
+                )
+
+            env = dict(os.environ)
+            env[WORKER_ENV_FLAG] = "1"
+            env[MASTER_PID_ENV] = str(os.getpid())
+            chips = worker.get("tpu_chips") or []
+            if chips:
+                env[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chips)
+            cmd = self.build_launch_command(worker)
+
+            os.makedirs(logs_dir(), exist_ok=True)
+            log_path = worker_log_path(worker.get("name") or worker_id)
+            log_file = open(log_path, "ab")
+            log(f"launching worker {worker_id}: {' '.join(cmd)} (log: {log_path})")
+            proc = subprocess.Popen(
+                cmd,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                env=env,
+                start_new_session=True,
+            )
+            log_file.close()
+            self._procs[worker_id] = proc
+            self._persist(worker_id, proc.pid, config_path)
+            return {"worker_id": worker_id, "pid": proc.pid, "log": log_path}
+
+    # --- stop -------------------------------------------------------------
+
+    def stop_worker(
+        self, worker_id: str, config_path: str | None = None
+    ) -> bool:
+        managed = self.managed_processes(config_path)
+        entry = managed.get(worker_id)
+        pid = entry.get("pid") if entry else None
+        stopped = False
+        if pid is not None:
+            stopped = self._kill_tree(int(pid))
+        with self._lock:
+            self._procs.pop(worker_id, None)
+        self._unpersist(worker_id, config_path)
+        return stopped
+
+    def stop_all(self, config_path: str | None = None) -> int:
+        count = 0
+        for worker_id in list(self.managed_processes(config_path)):
+            if self.stop_worker(worker_id, config_path):
+                count += 1
+        return count
+
+    @staticmethod
+    def _kill_tree(pid: int) -> bool:
+        """Terminate a process and its children: TERM, grace, KILL
+        (reference workers/process/lifecycle.py tree-kill)."""
+        try:
+            root = psutil.Process(pid)
+        except psutil.NoSuchProcess:
+            return False
+        procs = [root] + root.children(recursive=True)
+        for p in procs:
+            try:
+                p.terminate()
+            except psutil.NoSuchProcess:
+                pass
+        _, alive = psutil.wait_procs(procs, timeout=5)
+        for p in alive:
+            try:
+                p.kill()
+            except psutil.NoSuchProcess:
+                pass
+        debug_log(f"killed process tree of pid {pid}")
+        return True
+
+    # --- persistence -------------------------------------------------------
+
+    def managed_processes(self, config_path: str | None = None) -> dict[str, Any]:
+        return dict(
+            config_mod.load_config(config_path).get("managed_processes", {})
+        )
+
+    def _persist(self, worker_id: str, pid: int, config_path: str | None) -> None:
+        config = config_mod.load_config(config_path)
+        config.setdefault("managed_processes", {})[worker_id] = {
+            "pid": pid,
+            "started_at": time.time(),
+        }
+        config_mod.save_config(config, config_path)
+
+    def _unpersist(self, worker_id: str, config_path: str | None) -> None:
+        config = config_mod.load_config(config_path)
+        if worker_id in config.get("managed_processes", {}):
+            del config["managed_processes"][worker_id]
+            config_mod.save_config(config, config_path)
+
+    def clear_stale(self, config_path: str | None = None) -> list[str]:
+        """Drop managed entries whose PIDs are dead (master restart
+        recovery, reference workers/process/persistence.py)."""
+        stale = []
+        config = config_mod.load_config(config_path)
+        managed = config.get("managed_processes", {})
+        for worker_id, entry in list(managed.items()):
+            if not is_process_alive(int(entry.get("pid", -1))):
+                stale.append(worker_id)
+                del managed[worker_id]
+        if stale:
+            config_mod.save_config(config, config_path)
+        return stale
+
+
+_manager: Optional[WorkerProcessManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_worker_manager() -> WorkerProcessManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = WorkerProcessManager()
+        return _manager

@@ -1,0 +1,87 @@
+"""Master startup/shutdown: auto-launch, signal cleanup, stale-PID
+recovery.
+
+Parity with reference workers/startup.py: a delayed auto-launch of
+enabled local workers (skipped on worker processes), async signal
+handlers for graceful cleanup, and an atexit fallback that stops
+managed workers when configured to.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import os
+import signal
+import threading
+from typing import Any
+
+from ..utils import config as config_mod
+from ..utils.constants import AUTO_LAUNCH_DELAY_SECONDS, WORKER_ENV_FLAG
+from ..utils.logging import log
+from .process_manager import get_worker_manager
+
+_cleanup_done = threading.Event()
+
+
+def is_worker_process() -> bool:
+    return os.environ.get(WORKER_ENV_FLAG) == "1"
+
+
+def delayed_auto_launch(config_path: str | None = None) -> threading.Timer | None:
+    """After a short delay (server must be up first), clear stale PID
+    records and launch enabled local workers if auto_launch is on."""
+    if is_worker_process():
+        return None
+
+    def launch():
+        manager = get_worker_manager()
+        stale = manager.clear_stale(config_path)
+        if stale:
+            log(f"cleared stale managed workers: {stale}")
+        config = config_mod.load_config(config_path)
+        if not config.get("settings", {}).get("auto_launch_workers"):
+            return
+        for worker in config.get("workers", []):
+            if not worker.get("enabled") or worker.get("type") not in ("local",):
+                continue
+            try:
+                manager.launch_worker(worker, config_path)
+            except Exception as exc:  # noqa: BLE001 - continue others
+                log(f"auto-launch of {worker.get('id')} failed: {exc}")
+
+    timer = threading.Timer(AUTO_LAUNCH_DELAY_SECONDS, launch)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def sync_cleanup(config_path: str | None = None) -> None:
+    """Stop managed workers if configured (atexit / signal path)."""
+    if _cleanup_done.is_set() or is_worker_process():
+        return
+    _cleanup_done.set()
+    config = config_mod.load_config(config_path)
+    if config.get("settings", {}).get("stop_workers_on_master_exit", True):
+        stopped = get_worker_manager().stop_all(config_path)
+        if stopped:
+            log(f"stopped {stopped} managed worker(s) on exit")
+
+
+def register_signals(loop: asyncio.AbstractEventLoop, config_path: str | None = None):
+    """SIGINT/SIGTERM/SIGHUP → cleanup then stop the loop; atexit as
+    fallback for abnormal paths."""
+    if is_worker_process():
+        return
+
+    def handler():
+        sync_cleanup(config_path)
+        loop.stop()
+
+    for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+        try:
+            loop.add_signal_handler(sig, handler)
+        except (NotImplementedError, RuntimeError):
+            # non-unix or nested loop: atexit still covers us
+            pass
+    atexit.register(sync_cleanup, config_path)
