@@ -17,3 +17,5 @@ from .registry import NODE_REGISTRY, register_node  # noqa: F401
 from . import nodes_core  # noqa: F401,E402
 from . import nodes_distributed  # noqa: F401,E402
 from . import nodes_upscale  # noqa: F401,E402
+from . import nodes_video  # noqa: F401,E402
+from . import nodes_audio  # noqa: F401,E402

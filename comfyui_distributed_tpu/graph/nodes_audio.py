@@ -1,0 +1,78 @@
+"""Audio workflow nodes.
+
+AUDIO contract: {"waveform": [B, C, S] float32, "sample_rate": int} —
+the shape the collector's audio combine and the AudioBatchDivider
+already speak (reference collector audio path, nodes/collector.py
+_combine_audio).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils import audio_payload as audio_utils
+from .registry import register_node
+
+
+@register_node
+class LoadAudio:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"audio": ("STRING", {"default": ""})}}
+
+    RETURN_TYPES = ("AUDIO",)
+    FUNCTION = "load"
+
+    def load(self, audio: str, context=None):
+        from .io_dirs import resolve_input_path
+
+        path = resolve_input_path(str(audio), context)
+        if path.endswith(".npz"):
+            data = np.load(path)
+            wave = np.asarray(data["waveform"], np.float32)
+            rate = int(data["sample_rate"])
+        else:
+            import wave as wave_mod
+
+            with wave_mod.open(path, "rb") as wf:
+                rate = wf.getframerate()
+                n = wf.getnframes()
+                raw = wf.readframes(n)
+                width = wf.getsampwidth()
+                channels = wf.getnchannels()
+            dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+            pcm = np.frombuffer(raw, dtype=dtype).astype(np.float32)
+            pcm /= float(np.iinfo(dtype).max)
+            wave = pcm.reshape(-1, channels).T[None]  # [1, C, S]
+        return ({"waveform": wave, "sample_rate": rate},)
+
+
+@register_node
+class SaveAudio:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "audio": ("AUDIO",),
+                "filename_prefix": ("STRING", {"default": "audio"}),
+            }
+        }
+
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    OUTPUT_NODE = True
+
+    def save(self, audio: dict, filename_prefix="audio", context=None):
+        from .io_dirs import get_output_dir
+
+        out_dir = get_output_dir(context)
+        os.makedirs(out_dir, exist_ok=True)
+        name = f"{filename_prefix}.npz"
+        np.savez(
+            os.path.join(out_dir, name),
+            waveform=np.asarray(audio["waveform"], np.float32),
+            sample_rate=audio["sample_rate"],
+        )
+        return ({"ui": {"audio": [name]}, "audio": audio},)

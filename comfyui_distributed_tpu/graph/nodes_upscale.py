@@ -112,32 +112,43 @@ class UltimateSDUpscaleDistributed:
         mesh = getattr(context, "mesh", None) if context is not None else None
         enabled = enabled_worker_ids or []
 
-        if is_worker:
-            from .usdu_elastic import run_worker_loop
+        # Mode selection, decided identically on master and workers from
+        # shared inputs (reference _determine_processing_mode): dynamic
+        # (whole-image queue) for large video batches, static (tile
+        # queue) otherwise.
+        dynamic = batch > 1 and batch >= int(dynamic_threshold)
+        common = dict(
+            bundle=model, image=image, pos=positive, neg=negative,
+            upscale_by=float(upscale_by), tile=tile, tile_h=tile_h,
+            padding=int(tile_padding), steps=int(steps),
+            sampler=sampler_name, scheduler=scheduler, cfg=float(cfg),
+            denoise=float(denoise), seed=int(seed),
+            upscale_method=upscale_method, context=context,
+        )
 
-            run_worker_loop(
-                bundle=model, image=image, pos=positive, neg=negative,
+        if is_worker:
+            from .usdu_elastic import run_worker_dynamic, run_worker_loop
+
+            worker_fn = run_worker_dynamic if dynamic else run_worker_loop
+            worker_fn(
                 job_id=job_id, worker_id=worker_id, master_url=master_url,
-                upscale_by=float(upscale_by), tile=tile, tile_h=tile_h,
-                padding=int(tile_padding), steps=int(steps),
-                sampler=sampler_name, scheduler=scheduler, cfg=float(cfg),
-                denoise=float(denoise), seed=int(seed),
-                upscale_method=upscale_method, context=context,
+                **common,
             )
             return (image,)
 
         if enabled and getattr(context, "server", None) is not None:
-            from .usdu_elastic import run_master_elastic
+            from .usdu_elastic import run_master_dynamic, run_master_elastic
 
+            if dynamic:
+                return (
+                    run_master_dynamic(
+                        job_id=job_id, enabled_worker_ids=list(enabled), **common
+                    ),
+                )
             return (
                 run_master_elastic(
-                    bundle=model, image=image, pos=positive, neg=negative,
                     job_id=job_id, enabled_worker_ids=list(enabled),
-                    mesh=mesh, upscale_by=float(upscale_by), tile=tile,
-                    tile_h=tile_h, padding=int(tile_padding), steps=int(steps),
-                    sampler=sampler_name, scheduler=scheduler,
-                    cfg=float(cfg), denoise=float(denoise), seed=int(seed),
-                    upscale_method=upscale_method, context=context,
+                    mesh=mesh, **common,
                 ),
             )
 

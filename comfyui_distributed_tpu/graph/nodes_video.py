@@ -1,0 +1,203 @@
+"""Video workflow nodes (WAN-class t2v).
+
+The node surface for the reference's WAN workflows (reference
+workflows/distributed-wan.json drives WAN through ComfyUI loaders +
+KSampler + VHS video combine): a video checkpoint loader, an empty
+video latent, a flow-matching video sampler that goes seed-parallel
+across the mesh when fed a per-participant SeedSpec, a frame decoder,
+and a frame-sequence saver.
+
+VIDEO_LATENT contract: {"samples": [B, F, h, w, C]}.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ..models import video_pipeline as vp
+from ..ops import samplers as smp
+from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..utils import image as img_utils
+from ..utils.logging import log
+from .nodes_core import SeedSpec, resolve_seed
+from .registry import register_node
+
+
+def _get_video_bundle(context, model_name: str) -> vp.VideoPipelineBundle:
+    cache_key = f"video:{model_name}"
+    if cache_key not in context.pipelines:
+        log(f"loading video pipeline {model_name!r}")
+        context.pipelines[cache_key] = vp.load_video_pipeline(model_name)
+    return context.pipelines[cache_key]
+
+
+@register_node
+class VideoCheckpointLoader:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"ckpt_name": ("STRING", {"default": "tiny-dit"})}}
+
+    RETURN_TYPES = ("MODEL", "CLIP", "VAE")
+    FUNCTION = "load"
+
+    def load(self, ckpt_name: str, context=None):
+        name = os.path.splitext(str(ckpt_name))[0]
+        bundle = _get_video_bundle(context, name)
+        return (bundle, bundle, bundle)
+
+
+@register_node
+class VideoCLIPTextEncode:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"text": ("STRING", {"default": ""}), "clip": ("CLIP",)}}
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "encode"
+
+    def encode(self, text, clip, context=None):
+        return (vp.encode_video_text(clip, [str(text)]),)
+
+
+@register_node
+class EmptyVideoLatent:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 256}),
+                "height": ("INT", {"default": 256}),
+                "frames": ("INT", {"default": 16}),
+                "batch_size": ("INT", {"default": 1}),
+            }
+        }
+
+    RETURN_TYPES = ("VIDEO_LATENT",)
+    FUNCTION = "generate"
+
+    def generate(self, width, height, frames, batch_size=1, context=None):
+        return (
+            {
+                "samples": None,  # allocated by the sampler (needs model dims)
+                "width": int(width),
+                "height": int(height),
+                "frames": int(frames),
+                "batch_size": int(batch_size),
+            },
+        )
+
+
+@register_node
+class VideoFlowSampler:
+    """Flow-matching t2v sampler. With a per-participant SeedSpec on a
+    mesh, all participants sample concurrently in one SPMD program and
+    the output batch is participant-major."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "seed": ("INT", {"default": 0}),
+                "steps": ("INT", {"default": 20}),
+                "cfg": ("FLOAT", {"default": 5.0}),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "latent": ("VIDEO_LATENT",),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "sample"
+
+    def sample(self, model, seed, steps, cfg, positive, negative, latent,
+               context=None):
+        spec = resolve_seed(seed)
+        bundle: vp.VideoPipelineBundle = model
+        mesh = getattr(context, "mesh", None) if context is not None else None
+        frames = int(latent.get("frames", 16))
+        height = int(latent.get("height", 256))
+        width = int(latent.get("width", 256))
+
+        if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
+            out = self._parallel_with_cond(
+                bundle, mesh, positive, negative, frames, height, width,
+                int(steps), float(cfg), spec.base_seed,
+            )
+            b, f = out.shape[0], out.shape[1]
+            return (out.reshape((b * f,) + out.shape[2:]),)
+
+        effective_seed = spec.base_seed + (
+            spec.worker_index + 1 if spec.worker_index >= 0 else 0
+        )
+        out = vp._t2v_jit(
+            vp._Static(bundle), bundle.params, positive, negative,
+            jax.random.key(int(effective_seed)), frames, height, width,
+            int(steps), float(cfg), positive.shape[0],
+        )
+        b, f = out.shape[0], out.shape[1]
+        return (out.reshape((b * f,) + out.shape[2:]),)
+
+    @staticmethod
+    def _parallel_with_cond(
+        bundle, mesh, pos, neg, frames, height, width, steps, cfg, seed
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.seeds import participant_keys
+
+        n = data_axis_size(mesh)
+        keys = participant_keys(jax.random.key(seed), n)
+        keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
+        params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
+        return vp._t2v_parallel_jit(
+            vp._Static(bundle), vp._Static(mesh), params, keys,
+            jax.device_put(pos, NamedSharding(mesh, P())),
+            jax.device_put(neg, NamedSharding(mesh, P())),
+            frames, height, width, steps, float(cfg),
+        )
+
+
+@register_node
+class SaveVideoFrames:
+    """Persist a frame sequence as numbered PNGs + a manifest (the
+    VHS-video-combine role in reference workflows, minus containers —
+    ffmpeg is not in the image, so frames + manifest is the portable
+    output)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE",),
+                "filename_prefix": ("STRING", {"default": "video"}),
+                "fps": ("INT", {"default": 8}),
+            }
+        }
+
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    OUTPUT_NODE = True
+
+    def save(self, images, filename_prefix="video", fps=8, context=None):
+        import json
+
+        from .io_dirs import get_output_dir
+
+        out_dir = get_output_dir(context)
+        os.makedirs(out_dir, exist_ok=True)
+        arr = img_utils.ensure_numpy(images)
+        saved = []
+        for i in range(arr.shape[0]):
+            name = f"{filename_prefix}_{i:05d}.png"
+            with open(os.path.join(out_dir, name), "wb") as fh:
+                fh.write(img_utils.encode_png(arr[i], compress_level=4))
+            saved.append(name)
+        manifest = {"frames": saved, "fps": int(fps)}
+        with open(
+            os.path.join(out_dir, f"{filename_prefix}_manifest.json"), "w"
+        ) as fh:
+            json.dump(manifest, fh)
+        return ({"ui": {"images": saved, "fps": fps}, "images": images},)

@@ -109,7 +109,9 @@ class HTTPWorkClient:
             return None
 
         out = run_async_in_server_loop(pull(), timeout=None)
-        if out is None or out.get("tile_idx") is None:
+        if out is None:
+            return None
+        if out.get("tile_idx") is None and out.get("image_idx") is None:
             return None
         return out
 
@@ -122,6 +124,23 @@ class HTTPWorkClient:
                     "worker_id": self.worker_id,
                     "tiles": entries,
                     "is_final_flush": is_final,
+                },
+            )
+
+        run_async_in_server_loop(send(), timeout=300)
+
+    def submit_image(self, image_idx: int, data_url: str, is_last: bool) -> None:
+        """Dynamic mode: push one whole processed frame."""
+
+        async def send():
+            await self._post(
+                "/distributed/submit_image",
+                {
+                    "job_id": self.job_id,
+                    "worker_id": self.worker_id,
+                    "image_idx": image_idx,
+                    "image": data_url,
+                    "is_last": is_last,
                 },
             )
 
@@ -387,3 +406,203 @@ def run_master_elastic(
 
     run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=30)
     return canvas.result()
+
+
+# --------------------------------------------------------------------------
+# dynamic (image-queue) mode — large video batches
+# --------------------------------------------------------------------------
+
+
+def _process_whole_image(
+    bundle, image_1, pos, neg, grid, process, key, batch_index: int
+):
+    """Upscale one [1, H, W, C] frame through all its tiles locally.
+
+    Per-tile keys fold (batch_index, tile_idx) so dynamic mode is
+    deterministic per frame regardless of which participant claims it
+    (reference upscale/modes/dynamic.py processes a whole image's tiles
+    on whichever participant pulled its index).
+    """
+    extracted = tile_ops.extract_tiles(image_1, grid)
+    canvas = tile_ops.IncrementalCanvas(image_1, grid)
+    frame_key = jax.random.fold_in(key, batch_index)
+    for tile_idx in range(grid.num_tiles):
+        tkey = jax.random.fold_in(frame_key, tile_idx)
+        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        y, x = grid.positions[tile_idx]
+        canvas.blend(result, y, x)
+    return canvas.result()
+
+
+def run_worker_dynamic(
+    bundle: pl.PipelineBundle,
+    image,
+    pos,
+    neg,
+    job_id: str,
+    worker_id: str,
+    master_url: str,
+    upscale_by: float,
+    tile: int,
+    padding: int,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg: float,
+    denoise: float,
+    seed: int,
+    upscale_method: str = "bicubic",
+    tile_h: int | None = None,
+    context=None,
+    client: Any = None,
+) -> None:
+    """Pull whole-image indices; process all tiles locally; submit the
+    finished frame (reference upscale/modes/dynamic.py:213-313)."""
+    client = client or HTTPWorkClient(master_url, job_id, worker_id)
+    if not client.poll_ready():
+        raise WorkerError(f"job {job_id} never became ready", worker_id)
+    upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
+    )
+    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    key = jax.random.key(seed)
+
+    while True:
+        if context is not None:
+            context.check_interrupted()
+        work = client.request_tile()
+        if work is None:
+            break
+        # dynamic jobs return image_idx; HTTPWorkClient.request_tile
+        # normalizes on 'tile_idx' absence, so re-read the raw field
+        image_idx = int(work.get("image_idx", work.get("tile_idx")))
+        frame = upscaled[image_idx : image_idx + 1]
+        out = _process_whole_image(
+            bundle, frame, pos, neg, grid, process, key, image_idx
+        )
+        arr = img_utils.ensure_numpy(out)[0]
+        client.submit_image(
+            image_idx,
+            img_utils.encode_image_data_url(arr),
+            is_last=int(work.get("estimated_remaining", 0)) == 0,
+        )
+        client.heartbeat()
+
+
+def run_master_dynamic(
+    bundle: pl.PipelineBundle,
+    image,
+    pos,
+    neg,
+    job_id: str,
+    enabled_worker_ids: list[str],
+    upscale_by: float = 2.0,
+    tile: int = 512,
+    padding: int = 32,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg: float = 7.0,
+    denoise: float = 0.35,
+    seed: int = 0,
+    upscale_method: str = "bicubic",
+    tile_h: int | None = None,
+    context=None,
+):
+    """Image-queue master loop: master participates in pulls, drains
+    worker frames between images, requeues timed-out workers, and
+    assembles the output batch in frame order (reference
+    upscale/modes/dynamic.py:22-211)."""
+    from ..utils.config import get_worker_timeout_seconds
+
+    store = context.server.job_store
+    batch = int(image.shape[0])
+    upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
+    )
+    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    key = jax.random.key(seed)
+    timeout = get_worker_timeout_seconds()
+
+    run_async_in_server_loop(
+        store.init_tile_job(job_id, list(range(batch)), batched=False, kind="image"),
+        timeout=30,
+    )
+    frames: dict[int, np.ndarray] = {}
+
+    def drain() -> None:
+        async def pop_all():
+            job = await store.get_tile_job(job_id)
+            items = []
+            while job is not None and not job.results.empty():
+                items.append(job.results.get_nowait())
+            return items
+
+        for image_idx, payload in run_async_in_server_loop(pop_all(), timeout=30):
+            if image_idx in frames:
+                continue
+            frames[image_idx] = img_utils.decode_image_data_url(payload[0]["image"])
+
+    async def probe_busy(worker_id: str) -> bool:
+        config = getattr(context, "config", None) or {}
+        worker = next(
+            (w for w in config.get("workers", []) if str(w.get("id")) == worker_id),
+            None,
+        )
+        if worker is None:
+            return False
+        result = await probe_worker(build_worker_url(worker))
+        return bool(result["online"] and (result["queue_remaining"] or 0) > 0)
+
+    def claim_and_process() -> bool:
+        image_idx = run_async_in_server_loop(
+            store.pull_task(job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS),
+            timeout=30,
+        )
+        if image_idx is None:
+            return False
+        out = _process_whole_image(
+            bundle, upscaled[image_idx : image_idx + 1], pos, neg, grid,
+            process, key, image_idx,
+        )
+        frames[image_idx] = img_utils.ensure_numpy(out)[0]
+        run_async_in_server_loop(
+            store.submit_result(job_id, "master", image_idx, None), timeout=30
+        )
+        drain()
+        return True
+
+    while claim_and_process():
+        if context is not None:
+            context.check_interrupted()
+
+    deadline = time.monotonic() + timeout * max(1, len(enabled_worker_ids))
+    while len(frames) < batch:
+        if context is not None:
+            context.check_interrupted()
+        drain()
+        if len(frames) >= batch:
+            break
+        requeued = run_async_in_server_loop(
+            store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
+        )
+        if requeued:
+            while claim_and_process():
+                pass
+        if len(frames) >= batch:
+            break
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(batch)) - set(frames))
+            log(f"USDU dynamic: deadline hit; processing {len(missing)} frame(s) locally")
+            for image_idx in missing:
+                out = _process_whole_image(
+                    bundle, upscaled[image_idx : image_idx + 1], pos, neg,
+                    grid, process, key, image_idx,
+                )
+                frames[image_idx] = img_utils.ensure_numpy(out)[0]
+            break
+        time.sleep(QUEUE_POLL_INTERVAL_SECONDS)
+
+    run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=30)
+    stacked = np.stack([frames[i] for i in range(batch)], axis=0)
+    return jnp.asarray(stacked)

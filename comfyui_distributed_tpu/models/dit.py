@@ -32,6 +32,11 @@ class DiTConfig:
     heads: int = 12
     context_dim: int = 4096
     dtype: str = "bfloat16"
+    # Context/sequence parallelism: when set, the model is being called
+    # inside shard_map with the FRAME axis sharded along this mesh axis;
+    # self-attention runs as ring attention over the full sequence and
+    # RoPE positions are offset by the shard index.
+    seq_axis: str | None = None
 
     @property
     def compute_dtype(self):
@@ -63,6 +68,7 @@ def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
 class _AdaLNBlock(nn.Module):
     heads: int
     dtype: jnp.dtype
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(
@@ -93,7 +99,12 @@ class _AdaLNBlock(nn.Module):
         )
         q = apply_rope(q, freqs)
         k = apply_rope(k, freqs)
-        attn = dot_product_attention(q, k, v).reshape(b, n, dim)
+        if self.seq_axis is not None:
+            from ..ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, self.seq_axis).reshape(b, n, dim)
+        else:
+            attn = dot_product_attention(q, k, v).reshape(b, n, dim)
         x = x + g1 * nn.Dense(dim, dtype=self.dtype, name="attn_proj")(attn)
 
         # cross-attention to text (un-modulated, WAN-style)
@@ -160,12 +171,24 @@ class VideoDiT(nn.Module):
         )
 
         head_dim = cfg.hidden_dim // cfg.heads
-        freqs = jnp.asarray(_rope_freqs(head_dim, n), dtype=jnp.float32)
+        if cfg.seq_axis is not None:
+            # sharded sequence: local tokens are a contiguous chunk; the
+            # RoPE table covers the GLOBAL sequence and each shard slices
+            # its window by ring position
+            axis_size = jax.lax.psum(1, cfg.seq_axis)
+            global_n = n * axis_size
+            full = jnp.asarray(_rope_freqs(head_dim, global_n), dtype=jnp.float32)
+            offset = jax.lax.axis_index(cfg.seq_axis) * n
+            freqs = jax.lax.dynamic_slice(
+                full, (offset, 0, 0), (n, full.shape[1], full.shape[2])
+            )
+        else:
+            freqs = jnp.asarray(_rope_freqs(head_dim, n), dtype=jnp.float32)
 
         for i in range(cfg.depth):
-            tokens = _AdaLNBlock(cfg.heads, dt, name=f"block_{i}")(
-                tokens, cond, context, freqs
-            )
+            tokens = _AdaLNBlock(
+                cfg.heads, dt, seq_axis=cfg.seq_axis, name=f"block_{i}"
+            )(tokens, cond, context, freqs)
 
         # final AdaLN + unpatchify, zero-init output
         mod = nn.Dense(

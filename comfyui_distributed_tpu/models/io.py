@@ -1,0 +1,110 @@
+"""Model weight IO: safetensors checkpoints + orbax run state.
+
+The reference free-rides on ComfyUI's checkpoint loaders (GGUF/
+safetensors); here:
+
+- `save_params` / `load_params` — flat safetensors round-trip of a
+  flax param pytree ('/'-joined keys), the interchange format for
+  bringing real weights in;
+- `save_run_state` / `load_run_state` — orbax checkpointing of
+  arbitrarily sharded pytrees for resumable long runs (checkpoint/
+  resume is absent in the reference, SURVEY §5) — sharded params are
+  saved from and restored onto their mesh placement.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def flatten_params(params: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    flat: dict[str, np.ndarray] = {}
+
+    def walk(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for key, value in node.items():
+                walk(value, f"{path}/{key}" if path else str(key))
+        else:
+            flat[path] = np.asarray(node)
+
+    walk(params, prefix)
+    return flat
+
+
+def unflatten_params(flat: dict[str, np.ndarray]) -> dict[str, Any]:
+    tree: dict[str, Any] = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_params(params: Any, path: str) -> None:
+    from safetensors.numpy import save_file
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    flat = flatten_params(jax.device_get(params))
+    save_file(flat, path)
+
+
+def load_params(path: str) -> dict[str, Any]:
+    from safetensors.numpy import load_file
+
+    return unflatten_params(load_file(path))
+
+
+def load_params_into(template: Any, path: str, strict: bool = True) -> Any:
+    """Load a checkpoint shaped like `template`; mismatched/missing
+    entries raise (strict) or keep the template value."""
+    loaded = load_params(path)
+    flat_t = flatten_params(jax.device_get(template))
+    flat_l = flatten_params(loaded)
+    merged: dict[str, np.ndarray] = {}
+    problems: list[str] = []
+    for key, tval in flat_t.items():
+        lval = flat_l.get(key)
+        if lval is None:
+            problems.append(f"missing {key}")
+            merged[key] = tval
+        elif tuple(lval.shape) != tuple(tval.shape):
+            problems.append(f"shape mismatch {key}: {lval.shape} vs {tval.shape}")
+            merged[key] = tval
+        else:
+            merged[key] = lval.astype(tval.dtype)
+    extra = set(flat_l) - set(flat_t)
+    if extra:
+        problems.append(f"unused keys: {sorted(extra)[:5]}...")
+    if problems and strict:
+        raise ValueError("checkpoint mismatch: " + "; ".join(problems[:10]))
+    return unflatten_params(merged)
+
+
+# --- orbax run state ------------------------------------------------------
+
+def save_run_state(state: Any, directory: str, step: int) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    with ocp.CheckpointManager(path) as manager:
+        manager.save(step, args=ocp.args.StandardSave(state))
+        manager.wait_until_finished()
+
+
+def load_run_state(template: Any, directory: str, step: int | None = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    with ocp.CheckpointManager(path) as manager:
+        step = manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {directory}")
+        return manager.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )

@@ -68,9 +68,21 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     },
     # --- VAEs ---
     "vae-sd": {"family": "vae", "config": VAEConfig()},
+    # 16-channel latent VAE matching the WAN-class DiT latent space
+    "vae-video": {
+        "family": "vae",
+        "config": VAEConfig(latent_channels=16, scaling_factor=1.0),
+    },
     "tiny-vae": {
         "family": "vae",
         "config": VAEConfig(base_channels=16, channel_mult=(1, 2), num_res_blocks=1),
+    },
+    "tiny-vae-video": {
+        "family": "vae",
+        "config": VAEConfig(
+            base_channels=16, channel_mult=(1, 2), num_res_blocks=1,
+            latent_channels=16, scaling_factor=1.0,
+        ),
     },
     # --- text encoders ---
     "clip-l": {"family": "text_encoder", "config": TextEncoderConfig()},

@@ -1,0 +1,211 @@
+"""Video generation pipeline (WAN-class t2v / i2v).
+
+The model family behind the reference's WAN workflows (reference
+workflows/distributed-wan*.json), end to end: text → video frames.
+Latents are [B, F, h, w, C]; the image VAE decodes frames via vmap
+over the frame axis (temporal-compression VAEs slot in behind the
+same decode_frames interface).
+
+Distribution:
+- seed-parallel: one video per mesh participant (t2v_parallel), the
+  reference's Image-Batch-Divider fan-out collapsed into SPMD;
+- context-parallel: frames sharded + ring attention for videos whose
+  sequence exceeds one chip (parallel/sequence.py) — beyond-reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import samplers as smp
+from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..parallel.seeds import participant_keys
+from .pipeline import _Static, encode_text
+from .registry import create_model, get_config
+from .text_encoder import Tokenizer
+
+
+@dataclasses.dataclass
+class VideoPipelineBundle:
+    model_name: str
+    dit: Any
+    vae: Any
+    text_encoder: Any
+    params: dict[str, Any]
+    tokenizer: Tokenizer
+    latent_channels: int
+    latent_scale: int
+    flow_shift: float = 3.0
+
+
+def load_video_pipeline(
+    model_name: str = "tiny-dit",
+    vae_name: str | None = None,
+    te_name: str | None = None,
+    seed: int = 0,
+) -> VideoPipelineBundle:
+    tiny = model_name.startswith("tiny")
+    vae_name = vae_name or ("tiny-vae-video" if tiny else "vae-video")
+    te_name = te_name or ("tiny-te" if tiny else "clip-l")
+
+    dit = create_model(model_name)
+    vae = create_model(vae_name)
+    te = create_model(te_name)
+    dit_cfg = get_config(model_name)
+    te_cfg = get_config(te_name)
+    vae_cfg = get_config(vae_name)
+
+    root = jax.random.key(seed)
+    k_dit, k_vae, k_te = jax.random.split(root, 3)
+    lat = jnp.zeros((1, 4, 8, 8, dit_cfg.in_channels))
+    ctx = jnp.zeros((1, te_cfg.max_length, dit_cfg.context_dim))
+    dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx)
+    vae_params = vae.init(k_vae, jnp.zeros((1, 32, 32, 3)))
+    te_params = te.init(k_te, jnp.zeros((1, te_cfg.max_length), jnp.int32))
+
+    return VideoPipelineBundle(
+        model_name=model_name,
+        dit=dit,
+        vae=vae,
+        text_encoder=te,
+        params={"unet": dit_params, "vae": vae_params, "te": te_params},
+        tokenizer=Tokenizer(max_length=te_cfg.max_length),
+        latent_channels=dit_cfg.in_channels,
+        latent_scale=vae_cfg.downscale,
+    )
+
+
+def encode_video_text(bundle: VideoPipelineBundle, texts: list[str]) -> jax.Array:
+    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
+    hidden, _ = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    ctx_dim = get_config(bundle.model_name).context_dim
+    if hidden.shape[-1] < ctx_dim:
+        hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
+    elif hidden.shape[-1] > ctx_dim:
+        hidden = hidden[..., :ctx_dim]
+    return hidden
+
+
+def decode_frames(bundle: VideoPipelineBundle, latents: jax.Array) -> jax.Array:
+    """[B, F, h, w, C] latents → [B, F, H, W, 3] frames (per-frame VAE)."""
+    b, f = latents.shape[:2]
+    flat = latents.reshape((b * f,) + latents.shape[2:])
+    frames = bundle.vae.apply(bundle.params["vae"], flat, method="decode")
+    return frames.reshape((b, f) + frames.shape[1:])
+
+
+def _video_model_fn(bundle: VideoPipelineBundle, params):
+    def model_fn(x, t_batch, context):
+        return bundle.dit.apply(params["unet"], x, t_batch, context).astype(x.dtype)
+
+    return model_fn
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "bundle_static", "frames", "height", "width", "steps", "cfg_scale",
+        "batch",
+    ),
+)
+def _t2v_jit(
+    bundle_static, params, pos, neg, key,
+    frames: int, height: int, width: int, steps: int, cfg_scale: float,
+    batch: int,
+):
+    bundle = bundle_static.value
+    lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
+    timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
+    x = jax.random.normal(
+        key, (batch, frames, lh, lw, bundle.latent_channels)
+    )
+    model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
+    latents = smp.sample_flow(model, x, timesteps, (pos, neg))
+    return decode_frames(bundle, latents)
+
+
+def t2v(
+    bundle: VideoPipelineBundle,
+    prompt: str,
+    negative_prompt: str = "",
+    frames: int = 16,
+    height: int = 256,
+    width: int = 256,
+    steps: int = 20,
+    cfg_scale: float = 5.0,
+    seed: int = 0,
+    batch: int = 1,
+) -> jax.Array:
+    """Text→video; returns [batch, frames, H, W, 3] in [0,1]."""
+    pos = encode_video_text(bundle, [prompt] * batch)
+    neg = encode_video_text(bundle, [negative_prompt] * batch)
+    return _t2v_jit(
+        _Static(bundle), bundle.params, pos, neg, jax.random.key(seed),
+        frames, height, width, steps, float(cfg_scale), batch,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "bundle_static", "mesh_static", "frames", "height", "width", "steps",
+        "cfg_scale",
+    ),
+)
+def _t2v_parallel_jit(
+    bundle_static, mesh_static, params, keys, pos, neg,
+    frames: int, height: int, width: int, steps: int, cfg_scale: float,
+):
+    bundle = bundle_static.value
+    mesh = mesh_static.value
+    lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
+    timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
+
+    def per_chip(keys_shard, params, pos, neg):
+        key = keys_shard[0]
+        x = jax.random.normal(key, (1, frames, lh, lw, bundle.latent_channels))
+        model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
+        latents = smp.sample_flow(model, x, timesteps, (pos, neg))
+        return decode_frames(bundle, latents)
+
+    return jax.shard_map(
+        per_chip,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(), P(), P()),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )(keys, params, pos, neg)
+
+
+def t2v_parallel(
+    bundle: VideoPipelineBundle,
+    mesh,
+    prompt: str,
+    negative_prompt: str = "",
+    frames: int = 16,
+    height: int = 256,
+    width: int = 256,
+    steps: int = 20,
+    cfg_scale: float = 5.0,
+    seed: int = 0,
+) -> jax.Array:
+    """One video per mesh participant from independent folded seeds;
+    returns [n_participants, frames, H, W, 3] participant-major."""
+    n = data_axis_size(mesh)
+    keys = participant_keys(jax.random.key(seed), n)
+    keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
+    pos = encode_video_text(bundle, [prompt])
+    neg = encode_video_text(bundle, [negative_prompt])
+    params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
+    return _t2v_parallel_jit(
+        _Static(bundle), _Static(mesh), params, keys,
+        jax.device_put(pos, NamedSharding(mesh, P())),
+        jax.device_put(neg, NamedSharding(mesh, P())),
+        frames, height, width, steps, float(cfg_scale),
+    )

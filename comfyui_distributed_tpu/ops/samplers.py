@@ -241,3 +241,59 @@ def _sample_dpmpp_2m(model_fn, x, sigmas, cond):
     init = (x, jnp.zeros_like(x), jnp.asarray(False))
     (x, _, _), _ = jax.lax.scan(step, init, inputs)
     return x
+
+
+# --- flow matching (rectified flow, WAN/DiT video family) -----------------
+
+def get_flow_timesteps(steps: int, shift: float = 3.0) -> jnp.ndarray:
+    """[steps+1] descending t in [1, 0] with timestep shift (video
+    models sample with shifted sigmas: t' = s*t / (1 + (s-1)*t))."""
+    import numpy as np
+
+    t = np.linspace(1.0, 0.0, steps + 1)
+    t = shift * t / (1.0 + (shift - 1.0) * t)
+    return jnp.asarray(t, dtype=jnp.float32)
+
+
+def sample_flow(
+    model_fn: ModelFn,
+    x: jax.Array,
+    timesteps: jnp.ndarray,
+    cond: Any,
+) -> jax.Array:
+    """Euler ODE for velocity-prediction flow matching: x1 = noise at
+    t=1, data at t=0; model predicts v = dx/dt; x_{t-dt} = x + v*dt
+    with dt negative. `model_fn(x, t_batch*1000, cond) -> v` (the 1000x
+    matches DiT timestep-embedding conventions)."""
+
+    def step(x, t_pair):
+        t, t_next = t_pair
+        t_batch = jnp.broadcast_to(t * 1000.0, (x.shape[0],))
+        v = model_fn(x, t_batch, cond)
+        return x + v * (t_next - t), None
+
+    pairs = jnp.stack([timesteps[:-1], timesteps[1:]], axis=-1)
+    x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def cfg_flow_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
+    """CFG for velocity models (same batched-pass trick as cfg_model)."""
+    if cfg_scale == 1.0:
+        def passthrough(x, t, cond):
+            pos, _ = cond
+            return model_fn(x, t, pos)
+        return passthrough
+
+    def guided(x, t, cond):
+        pos, neg = cond
+        x2 = jnp.concatenate([x, x], axis=0)
+        t2 = jnp.concatenate([t, t], axis=0)
+        c2 = jax.tree_util.tree_map(
+            lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
+        )
+        v2 = model_fn(x2, t2, c2)
+        v_pos, v_neg = jnp.split(v2, 2, axis=0)
+        return v_neg + cfg_scale * (v_pos - v_neg)
+
+    return guided

@@ -1,0 +1,66 @@
+"""Context (sequence) parallelism for video models.
+
+Shards the FRAME axis of [B, F, H, W, C] video latents across the
+mesh's data axis and runs the DiT with ring attention (ops/
+ring_attention.py), so sequences longer than one chip's memory are
+first-class — the capability gap called out in SURVEY §5 (the
+reference can only split frame batches across independent workers,
+changing results; this is exact).
+
+The same params serve sharded and unsharded calls: seq_axis only
+changes how attention is computed, not the parameter tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.dit import DiTConfig, VideoDiT
+from .mesh import DATA_AXIS, data_axis_size
+
+
+@partial(jax.jit, static_argnames=("config", "mesh_static", "axis"))
+def _cp_forward_jit(config, mesh_static, axis, params, x, t, context):
+    mesh = mesh_static.value
+    sharded_cfg = dataclasses.replace(config, seq_axis=axis)
+    model = VideoDiT(sharded_cfg)
+
+    def per_chip(params, x_shard, t, context):
+        return model.apply(params, x_shard, t, context)
+
+    return jax.shard_map(
+        per_chip,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(), P()),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )(params, x, t, context)
+
+
+def video_forward_context_parallel(
+    config: DiTConfig,
+    params: Any,
+    x: jax.Array,          # [B, F, H, W, C], F divisible by mesh axis
+    timesteps: jax.Array,
+    context: jax.Array,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+) -> jax.Array:
+    """Exact DiT forward with the frame axis sharded over `axis`."""
+    n = int(mesh.shape[axis])
+    f = x.shape[1]
+    if f % (n * config.patch_size[0]) != 0:
+        raise ValueError(
+            f"frame count {f} must divide mesh axis {axis}={n} x patch {config.patch_size[0]}"
+        )
+    from ..models.pipeline import _Static
+
+    x = jax.device_put(x, NamedSharding(mesh, P(None, axis)))
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    return _cp_forward_jit(config, _Static(mesh), axis, params, x, timesteps, context)
