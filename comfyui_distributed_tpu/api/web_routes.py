@@ -1,0 +1,49 @@
+"""Serve the control-panel web UI (reference web/ sidebar equivalent,
+standalone: the master serves it at / since there is no ComfyUI
+frontend to embed into)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from aiohttp import web
+
+WEB_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "web")
+
+
+def _workflow_dirs() -> list[str]:
+    package_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return [
+        os.environ.get("CDT_WORKFLOW_DIR", ""),
+        os.path.join(package_root, "workflows"),
+        os.path.join(os.getcwd(), "workflows"),
+    ]
+
+
+def register(app: web.Application, server) -> None:
+    async def index(request: web.Request) -> web.Response:
+        return web.FileResponse(os.path.join(WEB_DIR, "index.html"))
+
+    async def list_workflows(request: web.Request) -> web.Response:
+        names: list[str] = []
+        for directory in _workflow_dirs():
+            if directory and os.path.isdir(directory):
+                names.extend(
+                    f for f in sorted(os.listdir(directory)) if f.endswith(".json")
+                )
+        return web.json_response({"workflows": sorted(set(names))})
+
+    async def get_workflow(request: web.Request) -> web.Response:
+        name = os.path.basename(request.match_info["name"])
+        for directory in _workflow_dirs():
+            path = os.path.join(directory, name) if directory else ""
+            if path and os.path.isfile(path):
+                with open(path, "r", encoding="utf-8") as fh:
+                    return web.json_response(json.load(fh))
+        return web.json_response({"error": "not found"}, status=404)
+
+    app.router.add_get("/", index)
+    app.router.add_static("/web/", WEB_DIR, show_index=False)
+    app.router.add_get("/distributed/workflows", list_workflows)
+    app.router.add_get("/distributed/workflows/{name}", get_workflow)

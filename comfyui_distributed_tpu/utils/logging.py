@@ -8,6 +8,7 @@ takes effect on running processes without restarts.
 
 from __future__ import annotations
 
+import collections
 import sys
 import time
 from typing import Any, Callable
@@ -15,6 +16,10 @@ from typing import Any, Callable
 from .constants import DEBUG_FLAG_TTL_SECONDS
 
 PREFIX = "[Distributed-TPU]"
+
+# In-memory ring exposed by the master-log API endpoint (the reference
+# keeps an `app.logger` buffer for the same purpose).
+LOG_RING: collections.deque[str] = collections.deque(maxlen=1000)
 
 _debug_cache: dict[str, Any] = {"value": False, "checked_at": 0.0}
 # Injectable so tests and the config module can supply the flag source
@@ -42,9 +47,13 @@ def is_debug_enabled(now: float | None = None) -> bool:
 
 
 def log(message: str) -> None:
-    print(f"{PREFIX} {message}", file=sys.stdout, flush=True)
+    line = f"{PREFIX} {message}"
+    LOG_RING.append(line)
+    print(line, file=sys.stdout, flush=True)
 
 
 def debug_log(message: str) -> None:
     if is_debug_enabled():
-        print(f"{PREFIX}[DEBUG] {message}", file=sys.stdout, flush=True)
+        line = f"{PREFIX}[DEBUG] {message}"
+        LOG_RING.append(line)
+        print(line, file=sys.stdout, flush=True)

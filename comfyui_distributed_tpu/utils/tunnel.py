@@ -1,0 +1,183 @@
+"""Cloudflare quick-tunnel management.
+
+Parity with reference utils/cloudflare/ (tunnel/state/binary/
+process_reader): an async-locked start/stop/status manager that spawns
+`cloudflared tunnel --url http://127.0.0.1:<port>`, a reader thread
+that regexes the public trycloudflare URL from stderr/stdout, state
+persisted in config (restored across restarts, stale PIDs cleared),
+and the master.host swap to the tunnel URL + restore on stop.
+
+Binary resolution: CDT_CLOUDFLARED_PATH env or config tunnel.binary,
+else PATH lookup. Auto-download from GitHub releases (the reference's
+behavior) is gated behind CDT_TUNNEL_AUTODOWNLOAD=1 since production
+images are often egress-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import shutil
+import subprocess
+import threading
+import time
+from typing import Any, Optional
+
+from . import config as config_mod
+from .constants import TUNNEL_START_TIMEOUT
+from .exceptions import TunnelError
+from .logging import debug_log, log
+
+TUNNEL_URL_RE = re.compile(r"https://[a-z0-9-]+\.trycloudflare\.com")
+DOWNLOAD_URL = (
+    "https://github.com/cloudflare/cloudflared/releases/latest/download/"
+    "cloudflared-linux-amd64"
+)
+
+
+def resolve_binary(config: dict[str, Any]) -> Optional[str]:
+    candidates = [
+        os.environ.get("CDT_CLOUDFLARED_PATH"),
+        config.get("tunnel", {}).get("binary"),
+        shutil.which("cloudflared"),
+    ]
+    for path in candidates:
+        if path and os.path.isfile(path) and os.access(path, os.X_OK):
+            return path
+    return None
+
+
+class TunnelManager:
+    def __init__(self, config_path: str | None = None):
+        self.config_path = config_path
+        self._lock = asyncio.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._url: Optional[str] = None
+        self._url_event = threading.Event()
+        self._saved_master_host: Optional[str] = None
+
+    # --- state ------------------------------------------------------------
+
+    def status(self) -> dict[str, Any]:
+        running = self._proc is not None and self._proc.poll() is None
+        return {
+            "running": running,
+            "url": self._url if running else None,
+            "pid": self._proc.pid if running else None,
+        }
+
+    async def restore_from_config(self) -> None:
+        """Clear stale persisted tunnel state on boot (a previous
+        master's tunnel process does not survive it)."""
+        async with config_mod.config_transaction(self.config_path) as cfg:
+            state = cfg.get("tunnel", {})
+            pid = state.get("pid")
+            if pid is not None:
+                from ..workers.process_manager import is_process_alive
+
+                if not is_process_alive(int(pid)):
+                    state.pop("pid", None)
+                    state.pop("url", None)
+                    debug_log("cleared stale tunnel state")
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def start(self, port: int) -> str:
+        async with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return self._url or ""
+            config = config_mod.load_config(self.config_path)
+            binary = resolve_binary(config)
+            if binary is None:
+                binary = self._maybe_download()
+            if binary is None:
+                raise TunnelError(
+                    "cloudflared binary not found; set CDT_CLOUDFLARED_PATH "
+                    "or install cloudflared (auto-download requires "
+                    "CDT_TUNNEL_AUTODOWNLOAD=1 and network egress)"
+                )
+            self._url = None
+            self._url_event.clear()
+            self._proc = subprocess.Popen(
+                [binary, "tunnel", "--url", f"http://127.0.0.1:{port}"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            threading.Thread(
+                target=self._read_output, name="cdt-tunnel-reader", daemon=True
+            ).start()
+
+            found = await asyncio.get_running_loop().run_in_executor(
+                None, self._url_event.wait, TUNNEL_START_TIMEOUT
+            )
+            if not found or not self._url:
+                await self._terminate()
+                raise TunnelError(
+                    f"tunnel URL not seen within {TUNNEL_START_TIMEOUT}s"
+                )
+
+            async with config_mod.config_transaction(self.config_path) as cfg:
+                self._saved_master_host = cfg.get("master", {}).get("host", "")
+                cfg.setdefault("tunnel", {}).update(
+                    {"url": self._url, "pid": self._proc.pid}
+                )
+                cfg.setdefault("master", {})["host"] = self._url
+            log(f"tunnel up: {self._url}")
+            return self._url
+
+    async def stop(self) -> bool:
+        async with self._lock:
+            stopped = await self._terminate()
+            async with config_mod.config_transaction(self.config_path) as cfg:
+                cfg.get("tunnel", {}).pop("url", None)
+                cfg.get("tunnel", {}).pop("pid", None)
+                if self._saved_master_host is not None:
+                    cfg.setdefault("master", {})["host"] = self._saved_master_host
+            self._saved_master_host = None
+            self._url = None
+            return stopped
+
+    async def _terminate(self) -> bool:
+        if self._proc is None:
+            return False
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._proc.wait, 10
+                )
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+        return True
+
+    # --- internals -----------------------------------------------------------
+
+    def _read_output(self) -> None:
+        proc = self._proc
+        if proc is None or proc.stdout is None:
+            return
+        for raw in iter(proc.stdout.readline, b""):
+            line = raw.decode("utf-8", errors="replace")
+            match = TUNNEL_URL_RE.search(line)
+            if match and not self._url_event.is_set():
+                self._url = match.group(0)
+                self._url_event.set()
+
+    def _maybe_download(self) -> Optional[str]:
+        if os.environ.get("CDT_TUNNEL_AUTODOWNLOAD") != "1":
+            return None
+        target = os.path.join(os.path.expanduser("~"), ".cdt", "cloudflared")
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        try:
+            import urllib.request
+
+            log(f"downloading cloudflared from {DOWNLOAD_URL}")
+            urllib.request.urlretrieve(DOWNLOAD_URL, target)  # noqa: S310
+            os.chmod(target, 0o755)
+            return target
+        except Exception as exc:  # noqa: BLE001 - env without egress
+            log(f"cloudflared download failed: {exc}")
+            return None

@@ -1,0 +1,450 @@
+/* Control panel for the distributed TPU runtime.
+ *
+ * Standalone build of the reference's sidebar extension (reference
+ * web/main.js + workerLifecycle.js + workerSettings.js + apiClient.js):
+ * adaptive status polling (1s while anything is busy/launching, 5s
+ * idle), worker CRUD against the config API, launch/stop with a
+ * launching grace window, log modal with auto-refresh, tunnel
+ * controls, and workflow submission to /distributed/queue.
+ */
+
+"use strict";
+
+const POLL_ACTIVE_MS = 1000;
+const POLL_IDLE_MS = 5000;
+const LAUNCH_GRACE_MS = 90000;
+
+const state = {
+  config: null,
+  workerStatus: new Map(), // id -> {online, queueRemaining, launchingSince}
+  pollTimer: null,
+  logTimer: null,
+  anythingBusy: false,
+};
+
+// ---------- API client with retry/backoff ----------
+
+async function api(path, options = {}, retries = 2) {
+  for (let attempt = 0; ; attempt++) {
+    try {
+      const resp = await fetch(path, {
+        headers: { "Content-Type": "application/json" },
+        ...options,
+      });
+      const body = await resp.json().catch(() => ({}));
+      if (!resp.ok) throw new Error(body.error || `HTTP ${resp.status}`);
+      return body;
+    } catch (err) {
+      if (attempt >= retries) throw err;
+      await new Promise((r) => setTimeout(r, 300 * 2 ** attempt));
+    }
+  }
+}
+
+function workerUrl(worker, path) {
+  const scheme =
+    worker.type === "cloud" || Number(worker.port) === 443 ? "https" : "http";
+  const host = worker.host || "127.0.0.1";
+  const port = worker.port ? `:${worker.port}` : "";
+  return `${scheme}://${host}${port}${path}`;
+}
+
+async function probeWorker(worker) {
+  try {
+    const resp = await fetch(workerUrl(worker, "/prompt"), {
+      signal: AbortSignal.timeout(4000),
+    });
+    if (!resp.ok) return { online: false };
+    const body = await resp.json();
+    const remaining = body?.exec_info?.queue_remaining;
+    if (remaining === undefined) return { online: false };
+    return { online: true, queueRemaining: remaining };
+  } catch {
+    return { online: false };
+  }
+}
+
+// ---------- status polling ----------
+
+async function refreshStatus() {
+  try {
+    const master = await api("/prompt");
+    setDot("master-dot", master.exec_info.queue_remaining > 0 ? "busy" : "online");
+    document.getElementById("master-summary").textContent =
+      `queue: ${master.exec_info.queue_remaining}`;
+    state.anythingBusy = master.exec_info.queue_remaining > 0;
+  } catch {
+    setDot("master-dot", "offline");
+    document.getElementById("master-summary").textContent = "unreachable";
+  }
+
+  const workers = state.config?.workers || [];
+  await Promise.all(
+    workers.map(async (w) => {
+      const prev = state.workerStatus.get(w.id) || {};
+      const probe = await probeWorker(w);
+      const launching =
+        prev.launchingSince && Date.now() - prev.launchingSince < LAUNCH_GRACE_MS;
+      if (probe.online) prev.launchingSince = null;
+      state.workerStatus.set(w.id, { ...prev, ...probe, launching: launching && !probe.online });
+      if (probe.online && probe.queueRemaining > 0) state.anythingBusy = true;
+    })
+  );
+  renderWorkers();
+  schedulePoll();
+}
+
+function schedulePoll() {
+  clearTimeout(state.pollTimer);
+  state.pollTimer = setTimeout(
+    refreshStatus,
+    state.anythingBusy ? POLL_ACTIVE_MS : POLL_IDLE_MS
+  );
+}
+
+function setDot(id, cls) {
+  const el = document.getElementById(id);
+  el.className = `dot ${cls}`;
+}
+
+// ---------- rendering ----------
+
+function renderWorkers() {
+  const container = document.getElementById("workers");
+  container.innerHTML = "";
+  for (const worker of state.config?.workers || []) {
+    const status = state.workerStatus.get(worker.id) || {};
+    const card = document.createElement("div");
+    card.className = "worker-card";
+    const dotCls = status.online
+      ? status.queueRemaining > 0 ? "busy" : "online"
+      : status.launching ? "busy" : "offline";
+    const statusText = status.online
+      ? `online · queue ${status.queueRemaining}`
+      : status.launching ? "launching…" : "offline";
+    card.innerHTML = `
+      <div>
+        <span class="dot ${dotCls}"></span>
+        <strong>${escapeHtml(worker.name || worker.id)}</strong>
+        <span class="meta">${escapeHtml(worker.type)} · ${escapeHtml(worker.host || "local")}:${worker.port}
+          ${worker.tpu_chips?.length ? "· chips " + worker.tpu_chips.join(",") : ""}
+          · ${statusText}</span>
+      </div>
+      <div class="controls">
+        <label class="small toggle"><input type="checkbox" data-enable="${worker.id}"
+          ${worker.enabled ? "checked" : ""}> on</label>
+        ${worker.type === "local"
+          ? `<button class="small" data-launch="${worker.id}">launch</button>
+             <button class="small" data-stop="${worker.id}">stop</button>`
+          : ""}
+        <button class="small" data-log="${worker.id}">log</button>
+        <button class="small" data-edit="${worker.id}">edit</button>
+        <button class="small" data-delete="${worker.id}">✕</button>
+      </div>`;
+    container.appendChild(card);
+  }
+}
+
+function escapeHtml(value) {
+  return String(value ?? "").replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  })[c]);
+}
+
+function renderSettings() {
+  const grid = document.createElement("div");
+  grid.className = "settings-grid";
+  const settings = state.config?.settings || {};
+  const editable = [
+    ["debug", "checkbox"],
+    ["auto_launch_workers", "checkbox"],
+    ["stop_workers_on_master_exit", "checkbox"],
+    ["master_delegate_only", "checkbox"],
+    ["websocket_orchestration", "checkbox"],
+    ["worker_timeout_seconds", "number"],
+  ];
+  for (const [name, kind] of editable) {
+    const label = document.createElement("label");
+    label.textContent = name.replaceAll("_", " ");
+    const input = document.createElement("input");
+    input.type = kind;
+    if (kind === "checkbox") input.checked = !!settings[name];
+    else input.value = settings[name] ?? "";
+    input.addEventListener("change", async () => {
+      const value = kind === "checkbox" ? input.checked : Number(input.value);
+      try {
+        await api("/distributed/config/setting", {
+          method: "POST",
+          body: JSON.stringify({ name, value }),
+        });
+        state.config.settings[name] = value;
+      } catch (err) {
+        alert(`save failed: ${err.message}`);
+      }
+    });
+    grid.append(label, input);
+  }
+  const container = document.getElementById("settings");
+  container.innerHTML = "";
+  container.appendChild(grid);
+}
+
+async function renderTopology() {
+  try {
+    const info = await api("/distributed/system_info");
+    const topo = info.topology || {};
+    const container = document.getElementById("topology");
+    const chips = (topo.devices || [])
+      .map((d) => `<span class="chip">${escapeHtml(d.platform)}:${d.id}</span>`)
+      .join("");
+    container.innerHTML =
+      `platform <b>${escapeHtml(topo.platform)}</b> · ` +
+      `${topo.local_device_count}/${topo.device_count} local chips · ` +
+      `host ${escapeHtml(info.machine_id)}<br>${chips}`;
+  } catch {
+    document.getElementById("topology").textContent = "unavailable";
+  }
+}
+
+// ---------- worker CRUD ----------
+
+function nextWorkerDefaults() {
+  const workers = state.config?.workers || [];
+  const ports = workers.map((w) => Number(w.port)).filter(Boolean);
+  const port = Math.max(8188, ...ports) + 1;
+  const usedChips = new Set(workers.flatMap((w) => w.tpu_chips || []));
+  const chips = (state.topoChips || []).filter((c) => !usedChips.has(c));
+  return { port, chip: chips.length ? [chips[0]] : [] };
+}
+
+function workerForm(existing) {
+  const worker = existing || {
+    id: `w${Date.now() % 100000}`,
+    name: "",
+    type: "local",
+    host: "127.0.0.1",
+    ...(() => { const d = nextWorkerDefaults(); return { port: d.port, tpu_chips: d.chip }; })(),
+    enabled: true,
+    extra_args: "",
+  };
+  const fields = ["id", "name", "type", "host", "port", "extra_args"];
+  const html = fields
+    .map(
+      (f) => `<div class="row"><label style="width:90px">${f}</label>
+        <input type="text" id="wf-${f}" value="${escapeHtml(worker[f] ?? "")}"></div>`
+    )
+    .join("") +
+    `<div class="row"><label style="width:90px">tpu_chips</label>
+      <input type="text" id="wf-tpu_chips" value="${(worker.tpu_chips || []).join(",")}"></div>
+     <div class="row"><button class="primary" id="wf-save">Save</button></div>`;
+  showModal(existing ? `Edit ${worker.id}` : "Add worker", html);
+  document.getElementById("wf-save").addEventListener("click", async () => {
+    const body = { enabled: worker.enabled };
+    for (const f of fields) {
+      let value = document.getElementById(`wf-${f}`).value;
+      if (f === "port") value = Number(value) || 0;
+      body[f] = value;
+    }
+    body.tpu_chips = document
+      .getElementById("wf-tpu_chips")
+      .value.split(",").map((s) => Number(s.trim())).filter((n) => !isNaN(n));
+    try {
+      await api("/distributed/config/worker", {
+        method: "POST",
+        body: JSON.stringify(body),
+      });
+      hideModal();
+      await loadConfig();
+    } catch (err) {
+      alert(`save failed: ${err.message}`);
+    }
+  });
+}
+
+// ---------- modal ----------
+
+function showModal(title, html) {
+  document.getElementById("modal-title").textContent = title;
+  document.getElementById("modal-content").innerHTML = html;
+  document.getElementById("modal").classList.remove("hidden");
+}
+
+function hideModal() {
+  document.getElementById("modal").classList.add("hidden");
+  clearInterval(state.logTimer);
+}
+
+async function showWorkerLog(workerId) {
+  const worker = state.config.workers.find((w) => w.id === workerId);
+  const refresh = async () => {
+    try {
+      const body = await api(
+        `/distributed/worker_log/${encodeURIComponent(worker.name || worker.id)}?tail=200`
+      );
+      document.getElementById("modal-content").innerHTML =
+        `<pre class="log">${escapeHtml(body.lines.join("\n"))}</pre>`;
+    } catch (err) {
+      document.getElementById("modal-content").innerHTML =
+        `<pre class="log">no log: ${escapeHtml(err.message)}</pre>`;
+    }
+  };
+  showModal(`Log — ${worker.name || worker.id}`, "<pre class='log'>loading…</pre>");
+  await refresh();
+  state.logTimer = setInterval(refresh, 2000);
+}
+
+// ---------- actions ----------
+
+async function loadConfig() {
+  state.config = await api("/distributed/config");
+  renderWorkers();
+  renderSettings();
+}
+
+async function queueWorkflow() {
+  const resultEl = document.getElementById("queue-result");
+  let prompt;
+  try {
+    prompt = JSON.parse(document.getElementById("workflow-json").value);
+  } catch {
+    resultEl.textContent = "invalid JSON";
+    return;
+  }
+  const enabledWorkers = (state.config?.workers || [])
+    .filter((w) => w.enabled)
+    .map((w) => w.id);
+  try {
+    const body = await api("/distributed/queue", {
+      method: "POST",
+      body: JSON.stringify({
+        prompt: prompt.prompt || prompt,
+        client_id: "panel",
+        workers: enabledWorkers,
+        load_balance: document.getElementById("load-balance").checked,
+      }),
+    });
+    resultEl.textContent = `queued ${body.prompt_id} → workers [${body.workers}]`;
+    state.anythingBusy = true;
+    schedulePoll();
+  } catch (err) {
+    resultEl.textContent = `queue failed: ${err.message}`;
+  }
+}
+
+async function refreshMasterLog() {
+  try {
+    const body = await api("/distributed/master_log?tail=100");
+    document.getElementById("master-log").textContent = body.lines.join("\n");
+  } catch { /* panel works without logs */ }
+}
+
+async function loadExamples() {
+  try {
+    const body = await api("/distributed/workflows");
+    const select = document.getElementById("example-select");
+    for (const name of body.workflows || []) {
+      const opt = document.createElement("option");
+      opt.value = name;
+      opt.textContent = name;
+      select.appendChild(opt);
+    }
+    select.addEventListener("change", async () => {
+      if (!select.value) return;
+      const wf = await api(`/distributed/workflows/${encodeURIComponent(select.value)}`);
+      document.getElementById("workflow-json").value = JSON.stringify(wf, null, 2);
+    });
+  } catch { /* optional */ }
+}
+
+// ---------- wiring ----------
+
+document.addEventListener("click", async (event) => {
+  const t = event.target;
+  if (t.dataset.launch) {
+    const status = state.workerStatus.get(t.dataset.launch) || {};
+    status.launchingSince = Date.now();
+    state.workerStatus.set(t.dataset.launch, status);
+    try {
+      await api("/distributed/launch_worker", {
+        method: "POST",
+        body: JSON.stringify({ worker_id: t.dataset.launch }),
+      });
+    } catch (err) { alert(`launch failed: ${err.message}`); }
+    refreshStatus();
+  } else if (t.dataset.stop) {
+    await api("/distributed/stop_worker", {
+      method: "POST",
+      body: JSON.stringify({ worker_id: t.dataset.stop }),
+    }).catch((err) => alert(err.message));
+    refreshStatus();
+  } else if (t.dataset.log) {
+    showWorkerLog(t.dataset.log);
+  } else if (t.dataset.edit) {
+    workerForm(state.config.workers.find((w) => w.id === t.dataset.edit));
+  } else if (t.dataset.delete) {
+    if (confirm(`Delete worker ${t.dataset.delete}?`)) {
+      await api(`/distributed/config/worker/${t.dataset.delete}`, { method: "DELETE" });
+      await loadConfig();
+    }
+  }
+});
+
+document.addEventListener("change", async (event) => {
+  const t = event.target;
+  if (t.dataset.enable) {
+    await api("/distributed/config/worker", {
+      method: "POST",
+      body: JSON.stringify({ id: t.dataset.enable, enabled: t.checked }),
+    }).catch((err) => alert(err.message));
+    await loadConfig();
+  }
+});
+
+document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
+document.getElementById("modal-close").addEventListener("click", hideModal);
+document.getElementById("queue-btn").addEventListener("click", queueWorkflow);
+document.getElementById("interrupt-all").addEventListener("click", async () => {
+  await api("/interrupt", { method: "POST" }).catch(() => {});
+  for (const w of state.config?.workers || []) {
+    fetch(workerUrl(w, "/interrupt"), { method: "POST" }).catch(() => {});
+  }
+});
+document.getElementById("clear-memory").addEventListener("click", async () => {
+  await api("/distributed/clear_memory", { method: "POST" }).catch(() => {});
+  for (const w of state.config?.workers || []) {
+    fetch(workerUrl(w, "/distributed/clear_memory"), { method: "POST" }).catch(() => {});
+  }
+});
+document.getElementById("tunnel-toggle").addEventListener("click", async () => {
+  const btn = document.getElementById("tunnel-toggle");
+  const urlEl = document.getElementById("tunnel-url");
+  const status = await api("/distributed/tunnel/status");
+  try {
+    if (status.running) {
+      await api("/distributed/tunnel/stop", { method: "POST" });
+      btn.textContent = "Start tunnel";
+      urlEl.textContent = "";
+    } else {
+      btn.textContent = "starting…";
+      const body = await api("/distributed/tunnel/start", { method: "POST" });
+      btn.textContent = "Stop tunnel";
+      urlEl.textContent = body.url;
+    }
+  } catch (err) {
+    btn.textContent = "Start tunnel";
+    alert(`tunnel: ${err.message}`);
+  }
+});
+
+(async function init() {
+  await loadConfig().catch(() => {});
+  await renderTopology();
+  try {
+    const info = await api("/distributed/system_info");
+    state.topoChips = (info.topology?.devices || []).map((d) => d.id);
+  } catch { state.topoChips = []; }
+  await loadExamples();
+  refreshStatus();
+  setInterval(refreshMasterLog, 3000);
+  refreshMasterLog();
+})();

@@ -1,0 +1,66 @@
+"""Tunnel manager with a fake cloudflared binary: URL capture, config
+state swap/restore, stale-state recovery."""
+
+import asyncio
+import os
+import stat
+
+import pytest
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils.exceptions import TunnelError
+from comfyui_distributed_tpu.utils.tunnel import TunnelManager
+
+
+@pytest.fixture()
+def fake_cloudflared(tmp_path, monkeypatch):
+    script = tmp_path / "cloudflared"
+    script.write_text(
+        "#!/bin/sh\n"
+        "echo 'INF Starting tunnel'\n"
+        "echo 'INF +  https://brave-otter-demo.trycloudflare.com  +'\n"
+        "exec sleep 60\n"
+    )
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("CDT_CLOUDFLARED_PATH", str(script))
+    return str(script)
+
+
+def test_tunnel_start_stop(tmp_config_path, fake_cloudflared):
+    manager = TunnelManager()
+
+    async def scenario():
+        url = await manager.start(8188)
+        assert url == "https://brave-otter-demo.trycloudflare.com"
+        assert manager.status()["running"] is True
+        cfg = cfg_mod.load_config()
+        assert cfg["master"]["host"] == url
+        assert cfg["tunnel"]["url"] == url
+
+        stopped = await manager.stop()
+        assert stopped is True
+        cfg = cfg_mod.load_config()
+        assert cfg["master"]["host"] == ""  # restored
+        assert "url" not in cfg["tunnel"]
+        assert manager.status()["running"] is False
+
+    asyncio.run(scenario())
+
+
+def test_tunnel_missing_binary(tmp_config_path, monkeypatch):
+    monkeypatch.delenv("CDT_CLOUDFLARED_PATH", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    manager = TunnelManager()
+    with pytest.raises(TunnelError):
+        asyncio.run(manager.start(8188))
+
+
+def test_stale_state_cleared(tmp_config_path):
+    cfg = cfg_mod.load_config()
+    cfg["tunnel"] = {"url": "https://old.trycloudflare.com", "pid": 999999}
+    cfg_mod.save_config(cfg)
+    manager = TunnelManager()
+    asyncio.run(manager.restore_from_config())
+    cfg = cfg_mod.load_config()
+    assert "pid" not in cfg["tunnel"]
+    assert "url" not in cfg["tunnel"]
