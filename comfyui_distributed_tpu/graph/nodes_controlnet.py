@@ -1,0 +1,115 @@
+"""ControlNet nodes (loader + apply), ComfyUI-shaped.
+
+Covers the ControlNet-tile role in the reference's upscale workflow
+(reference workflows/*.json ControlNetLoader/ControlNetApply); the
+hint rides in the Conditioning structure and is cropped per tile by
+the USDU pipeline (ops/conditioning.crop_to_tile).
+"""
+
+from __future__ import annotations
+
+from ..models.controlnet import load_controlnet
+from ..models.registry import get_config
+from ..ops.conditioning import Conditioning, as_conditioning
+from .registry import register_node
+
+
+@register_node
+class ControlNetLoader:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"control_net_name": ("STRING", {"default": "tile"})},
+            "optional": {"model": ("MODEL", {"default": None})},
+        }
+
+    RETURN_TYPES = ("CONTROL_NET",)
+    FUNCTION = "load"
+
+    def load(self, control_net_name: str, model=None, context=None):
+        model_channels, downscale = 320, 8
+        if model is not None:
+            try:
+                unet_cfg = get_config(model.model_name)
+                model_channels = unet_cfg.model_channels
+                downscale = model.latent_scale
+            except (KeyError, AttributeError):
+                pass
+        cache_key = f"controlnet:{control_net_name}:{model_channels}:{downscale}"
+        cache = getattr(context, "pipelines", {}) if context is not None else {}
+        if cache_key not in cache:
+            cache[cache_key] = load_controlnet(
+                str(control_net_name), model_channels, downscale
+            )
+        return (cache[cache_key],)
+
+
+@register_node
+class ControlNetApply:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "control_net": ("CONTROL_NET",),
+                "image": ("IMAGE",),
+                "strength": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "apply"
+
+    def apply(self, conditioning, control_net, image, strength=1.0, context=None):
+        cond = as_conditioning(conditioning).clone()
+        cond.control_hint = image
+        cond.control_strength = float(strength)
+        cond.control_params = control_net.params
+        cond.control_module = control_net.module
+        return (cond,)
+
+
+@register_node
+class ConditioningSetArea:
+    """Restrict a conditioning entry to a pixel-space region (reference
+    crop_cond area handling)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "width": ("INT", {"default": 512}),
+                "height": ("INT", {"default": 512}),
+                "x": ("INT", {"default": 0}),
+                "y": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "set_area"
+
+    def set_area(self, conditioning, width, height, x, y, context=None):
+        cond = as_conditioning(conditioning).clone()
+        cond.area = (int(height), int(width), int(y), int(x))
+        return (cond,)
+
+
+@register_node
+class ConditioningSetMask:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "mask": ("MASK",),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "set_mask"
+
+    def set_mask(self, conditioning, mask, context=None):
+        cond = as_conditioning(conditioning).clone()
+        cond.mask = mask
+        return (cond,)

@@ -194,8 +194,11 @@ def run_worker_loop(
     _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    pos = upscale_ops.prep_cond_for_tiles(pos, grid)
+    neg = upscale_ops.prep_cond_for_tiles(neg, grid)
+    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
+    positions = grid.positions_array()
 
     pending: list[dict] = []
     pending_bytes = 0
@@ -214,7 +217,9 @@ def run_worker_loop(
             break
         tile_idx = int(work["tile_idx"])
         tkey = jax.random.fold_in(key, tile_idx)
-        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        result = process(
+            bundle.params, extracted[tile_idx], tkey, pos, neg, positions[tile_idx]
+        )
         arr = img_utils.ensure_numpy(result)
         for batch_idx in range(arr.shape[0]):
             encoded = img_utils.encode_image_data_url(arr[batch_idx])
@@ -237,16 +242,21 @@ def run_worker_loop(
     flush(is_final=True)
 
 
-def _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise):
+def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise):
+    """fn(params, tile, key, pos, neg, yx): pos/neg must be prepped via
+    ops.upscale.prep_cond_for_tiles (per-tile hint/mask windows are
+    sliced at yx inside)."""
     sigmas = smp.get_sigmas(scheduler, int(steps), denoise=float(denoise))
 
     @jax.jit
-    def process(params, tile, key, pos, neg):
+    def process(params, tile, key, pos, neg, yx):
+        pos_t = upscale_ops.tile_cond(pos, yx[0], yx[1], grid)
+        neg_t = upscale_ops.tile_cond(neg, yx[0], yx[1], grid)
         z = bundle.vae.apply(params["vae"], tile, method="encode")
         noise_key, anc_key = jax.random.split(key)
         x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
-        z_out = smp.sample(model_fn, x, sigmas, (pos, neg), sampler, anc_key)
+        z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
         return bundle.vae.apply(params["vae"], z_out, method="decode")
 
     return process
@@ -290,8 +300,11 @@ def run_master_elastic(
     upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    pos = upscale_ops.prep_cond_for_tiles(pos, grid)
+    neg = upscale_ops.prep_cond_for_tiles(neg, grid)
+    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
+    positions = grid.positions_array()
 
     run_async_in_server_loop(
         store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
@@ -348,7 +361,9 @@ def run_master_elastic(
             continue
         empty_pulls = 0
         tkey = jax.random.fold_in(key, tile_idx)
-        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        result = process(
+            bundle.params, extracted[tile_idx], tkey, pos, neg, positions[tile_idx]
+        )
         run_async_in_server_loop(
             store.submit_result(
                 job_id, "master", tile_idx,
@@ -387,7 +402,10 @@ def run_master_elastic(
                 if tile_idx in done_tiles:
                     continue
                 tkey = jax.random.fold_in(key, tile_idx)
-                result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+                result = process(
+                    bundle.params, extracted[tile_idx], tkey, pos, neg,
+                    positions[tile_idx],
+                )
                 run_async_in_server_loop(
                     store.submit_result(job_id, "master", tile_idx, None), timeout=30
                 )
@@ -399,7 +417,10 @@ def run_master_elastic(
             log(f"USDU: deadline hit; locally processing {len(missing)} tile(s)")
             for tile_idx in missing:
                 tkey = jax.random.fold_in(key, tile_idx)
-                result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+                result = process(
+                    bundle.params, extracted[tile_idx], tkey, pos, neg,
+                    positions[tile_idx],
+                )
                 blend_local(tile_idx, result)
             break
         time.sleep(QUEUE_POLL_INTERVAL_SECONDS)
@@ -415,7 +436,7 @@ def run_master_elastic(
 
 def _process_whole_image(
     bundle, image_1, pos, neg, grid, process, key, batch_index: int
-):
+):  # pos/neg prepped via prep_cond_for_tiles
     """Upscale one [1, H, W, C] frame through all its tiles locally.
 
     Per-tile keys fold (batch_index, tile_idx) so dynamic mode is
@@ -426,9 +447,12 @@ def _process_whole_image(
     extracted = tile_ops.extract_tiles(image_1, grid)
     canvas = tile_ops.IncrementalCanvas(image_1, grid)
     frame_key = jax.random.fold_in(key, batch_index)
+    positions = grid.positions_array()
     for tile_idx in range(grid.num_tiles):
         tkey = jax.random.fold_in(frame_key, tile_idx)
-        result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+        result = process(
+            bundle.params, extracted[tile_idx], tkey, pos, neg, positions[tile_idx]
+        )
         y, x = grid.positions[tile_idx]
         canvas.blend(result, y, x)
     return canvas.result()
@@ -464,7 +488,9 @@ def run_worker_dynamic(
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    pos = upscale_ops.prep_cond_for_tiles(pos, grid)
+    neg = upscale_ops.prep_cond_for_tiles(neg, grid)
+    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
 
     while True:
@@ -520,7 +546,9 @@ def run_master_dynamic(
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h
     )
-    process = _jit_tile_processor(bundle, steps, sampler, scheduler, cfg, denoise)
+    pos = upscale_ops.prep_cond_for_tiles(pos, grid)
+    neg = upscale_ops.prep_cond_for_tiles(neg, grid)
+    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
     key = jax.random.key(seed)
     timeout = get_worker_timeout_seconds()
 

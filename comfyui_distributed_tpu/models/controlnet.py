@@ -1,0 +1,78 @@
+"""ControlNet-style hint conditioning.
+
+The role ControlNet tile plays in the reference's upscale workflow
+(reference workflows image upscale uses a ControlNet-tile model; hint
+cropping parity in utils/usdu_utils.py crop_cond): a pixel-space hint
+image is encoded by a conv stack to a latent-resolution residual that
+is injected into the UNet after its input conv, scaled by strength.
+Zero-initialised output so an untrained ControlNet is a no-op — the
+standard ControlNet trick, and what makes random-init tests exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlNetConfig:
+    hint_channels: int = 3
+    model_channels: int = 320   # must match the target UNet
+    downscale: int = 8          # must match the VAE spatial factor
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class ControlNetEncoder(nn.Module):
+    config: ControlNetConfig
+
+    @nn.compact
+    def __call__(self, hint: jax.Array) -> jax.Array:
+        """[B, H, W, hint_ch] in [0,1] → [B, H/8, W/8, model_channels]."""
+        cfg = self.config
+        dt = cfg.compute_dtype
+        h = (hint.astype(dt) * 2.0 - 1.0)
+        ch = 16
+        levels = max(0, int(cfg.downscale).bit_length() - 1)  # log2(downscale)
+        h = nn.Conv(ch, (3, 3), dtype=dt, name="conv_in")(h)
+        h = nn.silu(h)
+        for i in range(levels):
+            ch = min(ch * 2, cfg.model_channels)
+            h = nn.Conv(ch, (3, 3), strides=(2, 2), dtype=dt, name=f"down_{i}")(h)
+            h = nn.silu(h)
+        h = nn.Conv(ch, (3, 3), dtype=dt, name="mid")(h)
+        h = nn.silu(h)
+        return nn.Conv(
+            cfg.model_channels, (3, 3), dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros, name="conv_out",
+        )(h.astype(jnp.float32))
+
+
+@dataclasses.dataclass
+class ControlNetBundle:
+    """Loader product: module + params (the CONTROL_NET node type)."""
+
+    name: str
+    module: ControlNetEncoder
+    params: dict
+
+    def encode(self, hint: jax.Array) -> jax.Array:
+        return self.module.apply(self.params, hint)
+
+
+def load_controlnet(
+    name: str = "tile", model_channels: int = 320, downscale: int = 8, seed: int = 0
+) -> ControlNetBundle:
+    cfg = ControlNetConfig(model_channels=model_channels, downscale=downscale)
+    module = ControlNetEncoder(cfg)
+    params = module.init(
+        jax.random.key(seed), jnp.zeros((1, downscale * 8, downscale * 8, 3))
+    )
+    return ControlNetBundle(name=name, module=module, params=params)

@@ -113,14 +113,31 @@ def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
 # --- model fn (VP eps parameterisation) ----------------------------------
 
 def _make_model_fn(bundle: PipelineBundle, params):
-    def model_fn(x, sigma_batch, context):
+    from ..ops.conditioning import Conditioning
+
+    def model_fn(x, sigma_batch, cond):
         c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(
             (-1,) + (1,) * (x.ndim - 1)
         )
         t = smp.sigma_to_timestep(sigma_batch)
-        return bundle.unet.apply(params["unet"], x * c_in, t, context).astype(
-            x.dtype
-        )
+        context = cond.context if isinstance(cond, Conditioning) else cond
+        control = None
+        if (
+            isinstance(cond, Conditioning)
+            and cond.control_hint is not None
+            and cond.control_module is not None
+        ):
+            feats = cond.control_module.apply(cond.control_params, cond.control_hint)
+            lh, lw = x.shape[1], x.shape[2]
+            if feats.shape[1] != lh or feats.shape[2] != lw:
+                feats = jax.image.resize(
+                    feats, (feats.shape[0], lh, lw, feats.shape[3]), method="linear"
+                )
+            if feats.shape[0] == 1 and x.shape[0] > 1:
+                feats = jnp.broadcast_to(feats, (x.shape[0],) + feats.shape[1:])
+            control = feats * cond.control_strength
+        out = bundle.unet.apply(params["unet"], x * c_in, t, context, control=control)
+        return out.astype(x.dtype)
 
     return model_fn
 

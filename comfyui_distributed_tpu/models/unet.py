@@ -58,6 +58,7 @@ class UNet(nn.Module):
         timesteps: jax.Array,    # [B]
         context: jax.Array,      # [B, T, context_dim] text tokens
         y: Optional[jax.Array] = None,  # [B, adm_in_channels] pooled cond
+        control: Optional[jax.Array] = None,  # [B, H, W, model_channels]
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -78,6 +79,10 @@ class UNet(nn.Module):
         x = x.astype(dt)
 
         h = nn.Conv(ch, (3, 3), dtype=dt, name="input_conv")(x)
+        if control is not None:
+            # ControlNet residual injection (hint encoder output at
+            # latent resolution, zero-init ⇒ identity when untrained)
+            h = h + control.astype(dt)
         skips = [h]
 
         # --- down path ---

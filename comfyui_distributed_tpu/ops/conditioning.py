@@ -1,0 +1,179 @@
+"""Structured conditioning: text context + spatial extras, and the
+per-tile cropping USDU needs.
+
+Parity with reference upscale/conditioning.py + utils/usdu_utils.py
+(clone_conditioning / crop_cond): conditioning travels as a list of
+(context, extras) pairs where extras may carry spatial payloads —
+ControlNet hints, area restrictions, masks. Tile processing crops
+every spatial payload to the tile's region so a tile sees exactly the
+conditioning a full-image pass would apply there.
+
+All crops are static-shape (tile geometry is trace-time constant),
+keeping the tile pipeline jit-friendly — the property SURVEY §7.3
+flags as the hard part of conditioning parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class Conditioning:
+    """One conditioning entry.
+
+    context: [B, T, D] text tokens.
+    control_hint: [B, H, W, C] pixel-space hint (ControlNet), optional.
+    control_strength: scalar weight of the hint.
+    area: (h, w, y, x) pixel-space restriction, optional.
+    mask: [B, H, W] soft restriction, optional.
+    """
+
+    context: jax.Array
+    control_hint: Optional[jax.Array] = None
+    control_strength: float = 1.0
+    area: Optional[tuple[int, int, int, int]] = None
+    mask: Optional[jax.Array] = None
+    # ControlNet: encoder weights travel as pytree leaves; the module
+    # itself is static metadata (hashable flax dataclass).
+    control_params: Optional[dict] = None
+    control_module: Any = None
+
+    def clone(self) -> "Conditioning":
+        # arrays are immutable in JAX; a shallow copy is a deep clone
+        return dataclasses.replace(self)
+
+
+def as_conditioning(value: Any) -> Conditioning:
+    """Accept either a bare context array (the common txt2img case) or
+    a Conditioning."""
+    if isinstance(value, Conditioning):
+        return value
+    return Conditioning(context=value)
+
+
+def crop_to_tile(
+    cond: Conditioning,
+    y: int,
+    x: int,
+    tile_h: int,
+    tile_w: int,
+    image_h: int,
+    image_w: int,
+) -> Conditioning:
+    """Crop spatial payloads to a padded-tile region at origin (y, x).
+
+    Text context passes through (it is not spatial); ControlNet hints
+    and masks are sliced to the tile window (hints are assumed to be
+    at image resolution — resolution-mismatched hints are resized
+    first, like the reference's hint preprocessing); area restrictions
+    are intersected with the tile and re-expressed in tile-local
+    coordinates, dropping to None when they vanish.
+    """
+    out = cond.clone()
+    if cond.control_hint is not None:
+        hint = cond.control_hint
+        if hint.shape[1] != image_h or hint.shape[2] != image_w:
+            hint = jax.image.resize(
+                hint, (hint.shape[0], image_h, image_w, hint.shape[3]),
+                method="linear",
+            )
+        # pad like the image pipeline pads, then static-slice the window
+        pad_y0 = max(0, -y)
+        pad_x0 = max(0, -x)
+        pad_y1 = max(0, y + tile_h - image_h)
+        pad_x1 = max(0, x + tile_w - image_w)
+        if pad_y0 or pad_x0 or pad_y1 or pad_x1:
+            hint = jnp.pad(
+                hint,
+                ((0, 0), (pad_y0, pad_y1), (pad_x0, pad_x1), (0, 0)),
+                mode="edge",
+            )
+        out.control_hint = jax.lax.dynamic_slice(
+            hint,
+            (0, y + pad_y0, x + pad_x0, 0),
+            (hint.shape[0], tile_h, tile_w, hint.shape[3]),
+        )
+    if cond.mask is not None:
+        mask = cond.mask
+        if mask.shape[1] != image_h or mask.shape[2] != image_w:
+            mask = jax.image.resize(
+                mask, (mask.shape[0], image_h, image_w), method="linear"
+            )
+        mask = jnp.pad(
+            mask,
+            ((0, 0), (max(0, -y), max(0, y + tile_h - image_h)),
+             (max(0, -x), max(0, x + tile_w - image_w))),
+            mode="edge",
+        )
+        out.mask = jax.lax.dynamic_slice(
+            mask, (0, max(y, 0), max(x, 0)), (mask.shape[0], tile_h, tile_w)
+        )
+    if cond.area is not None:
+        ah, aw, ay, ax = cond.area
+        # intersect [ay, ay+ah) x [ax, ax+aw) with the tile window
+        top = max(ay, y)
+        left = max(ax, x)
+        bottom = min(ay + ah, y + tile_h)
+        right = min(ax + aw, x + tile_w)
+        if bottom <= top or right <= left:
+            out.area = None
+            # a vanished area means this entry contributes nothing here;
+            # zero its strength rather than dropping the entry (shapes
+            # must stay static across tiles)
+            out.control_strength = 0.0
+        else:
+            out.area = (bottom - top, right - left, top - y, left - x)
+    return out
+
+
+def slice_batch(cond: Conditioning, start: int, size: int) -> Conditioning:
+    """Per-batch-index slicing (reference tile_ops _slice_conditioning):
+    when a tile batch covers a sub-range of the image batch, every
+    batched payload follows."""
+    out = cond.clone()
+
+    def cut(arr):
+        if arr is None or arr.shape[0] == 1:
+            return arr  # broadcastable singleton stays
+        return jax.lax.dynamic_slice_in_dim(arr, start, size, axis=0)
+
+    out.context = cut(cond.context)
+    out.control_hint = cut(cond.control_hint)
+    out.mask = cut(cond.mask)
+    return out
+
+
+# --- pytree registration --------------------------------------------------
+# Conditioning flows through jit/shard_map/CFG batching; arrays are
+# leaves, static geometry (area, strength) is aux data. control_params
+# ride as leaves so ControlNet weights shard/replicate with the rest.
+
+import jax.tree_util as _jtu
+
+
+def _cond_flatten(cond: Conditioning):
+    children = (cond.context, cond.control_hint, cond.mask, cond.control_params)
+    aux = (cond.control_strength, cond.area, cond.control_module)
+    return children, aux
+
+
+def _cond_unflatten(aux, children):
+    context, control_hint, mask, control_params = children
+    control_strength, area, control_module = aux
+    return Conditioning(
+        context=context,
+        control_hint=control_hint,
+        control_strength=control_strength,
+        area=area,
+        mask=mask,
+        control_params=control_params,
+        control_module=control_module,
+    )
+
+
+_jtu.register_pytree_node(Conditioning, _cond_flatten, _cond_unflatten)

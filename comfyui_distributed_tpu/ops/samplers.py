@@ -102,13 +102,22 @@ def cfg_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
 
     def guided(x, sigma, cond):
         pos, neg = cond
-        x2 = jnp.concatenate([x, x], axis=0)
-        s2 = jnp.concatenate([sigma, sigma], axis=0)
-        c2 = jax.tree_util.tree_map(
-            lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
-        )
-        eps2 = model_fn(x2, s2, c2)
-        eps_pos, eps_neg = jnp.split(eps2, 2, axis=0)
+        same_structure = jax.tree_util.tree_structure(
+            pos
+        ) == jax.tree_util.tree_structure(neg)
+        if same_structure:
+            x2 = jnp.concatenate([x, x], axis=0)
+            s2 = jnp.concatenate([sigma, sigma], axis=0)
+            c2 = jax.tree_util.tree_map(
+                lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
+            )
+            eps2 = model_fn(x2, s2, c2)
+            eps_pos, eps_neg = jnp.split(eps2, 2, axis=0)
+        else:
+            # structurally different conditioning (e.g. ControlNet hint
+            # only on the positive side): two passes
+            eps_pos = model_fn(x, sigma, pos)
+            eps_neg = model_fn(x, sigma, neg)
         return eps_neg + cfg_scale * (eps_pos - eps_neg)
 
     return guided

@@ -85,16 +85,71 @@ def prepare_upscaled_tiles(
     return upscaled, grid, tile_ops.extract_tiles(upscaled, grid)
 
 
+def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
+    """Resize any ControlNet hint / mask to the upscaled image and pad
+    by the grid padding, so per-tile windows can be sliced at the same
+    origins the image tiles use (reference crop_cond preprocessing)."""
+    from .conditioning import as_conditioning
+
+    c = as_conditioning(cond).clone()
+    p = grid.padding
+    if c.control_hint is not None:
+        hint = c.control_hint
+        if hint.shape[1] != grid.image_h or hint.shape[2] != grid.image_w:
+            hint = jax.image.resize(
+                hint,
+                (hint.shape[0], grid.image_h, grid.image_w, hint.shape[3]),
+                method="linear",
+            )
+        c.control_hint = jnp.pad(
+            hint, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
+        )
+    if c.mask is not None:
+        mask = c.mask
+        if mask.shape[1] != grid.image_h or mask.shape[2] != grid.image_w:
+            mask = jax.image.resize(
+                mask, (mask.shape[0], grid.image_h, grid.image_w), method="linear"
+            )
+        c.mask = jnp.pad(mask, ((0, 0), (p, p), (p, p)), mode="reflect")
+    return c
+
+
+def tile_cond(cond, y, x, grid: tile_ops.TileGrid):
+    """Slice a tile's window out of conditioning prepped by
+    prep_cond_for_tiles; (y, x) may be traced (scan body)."""
+    from .conditioning import Conditioning
+
+    if not isinstance(cond, Conditioning):
+        return cond
+    c = cond.clone()
+    if c.control_hint is not None:
+        c.control_hint = jax.lax.dynamic_slice(
+            c.control_hint,
+            (0, y, x, 0),
+            (c.control_hint.shape[0], grid.padded_h, grid.padded_w,
+             c.control_hint.shape[3]),
+        )
+    if c.mask is not None:
+        c.mask = jax.lax.dynamic_slice(
+            c.mask, (0, y, x), (c.mask.shape[0], grid.padded_h, grid.padded_w)
+        )
+    return c
+
+
 def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise):
-    """Returns fn(tile_batch [B,th,tw,C], key) → processed tile batch."""
+    """Returns fn(params, tile, key, pos, neg, yx) → processed tiles.
+    pos/neg must already be prepped via prep_cond_for_tiles; yx is the
+    tile origin [2] (traced ok)."""
     sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
 
-    def fn(params, tile, key, pos, neg):
+    def fn(params, tile, key, pos, neg, yx):
+        pos_t = tile_cond(pos, yx[0], yx[1], grid)
+        neg_t = tile_cond(neg, yx[0], yx[1], grid)
         z = bundle.vae.apply(params["vae"], tile, method="encode")
         noise_key, anc_key = jax.random.split(key)
         x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
-        z_out = smp.sample(model_fn, x, sigmas, (pos, neg), sampler, anc_key)
+        z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
         return bundle.vae.apply(params["vae"], z_out, method="decode")
 
     return fn
@@ -124,15 +179,18 @@ def upscale_single(
     """All tiles processed on the local device via lax.scan."""
     bundle = bundle_static.value
     extracted = tile_ops.extract_tiles(upscaled, grid)  # [T, B, th, tw, C]
+    pos = prep_cond_for_tiles(pos, grid)
+    neg = prep_cond_for_tiles(neg, grid)
     process = _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise)
     tile_indices = jnp.arange(grid.num_tiles)
+    positions = grid.positions_array()
 
     def body(_, inp):
-        tile, gidx = inp
+        tile, gidx, yx = inp
         tkey = jax.random.fold_in(key, gidx)
-        return None, process(params, tile, tkey, pos, neg)
+        return None, process(params, tile, tkey, pos, neg, yx)
 
-    _, processed = jax.lax.scan(body, None, (extracted, tile_indices))
+    _, processed = jax.lax.scan(body, None, (extracted, tile_indices, positions))
     return tile_ops.blend_tiles(processed, grid)
 
 
@@ -167,35 +225,39 @@ def upscale_mesh(
     bundle = bundle_static.value
     mesh = mesh_static.value
     n = data_axis_size(mesh)
+    pos = prep_cond_for_tiles(pos, grid)
+    neg = prep_cond_for_tiles(neg, grid)
     process = _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise)
 
     extracted = tile_ops.extract_tiles(upscaled, grid)  # [T, B, th, tw, C]
     t = grid.num_tiles
     per_chip = -(-t // n)  # ceil
     total = per_chip * n
+    positions = grid.positions_array()
     if total > t:
         # wrap-around padding: works even when t < n (tiny images on
         # wide meshes); padded duplicates are sliced off after gather
         reps = -(-total // t)
         extracted = jnp.concatenate([extracted] * reps, axis=0)[:total]
+        positions = jnp.concatenate([positions] * reps, axis=0)[:total]
     global_idx = jnp.arange(total)
 
-    def per_chip_fn(tiles_shard, idx_shard, params, pos, neg):
+    def per_chip_fn(tiles_shard, idx_shard, yx_shard, params, pos, neg):
         def body(_, inp):
-            tile, gidx = inp
+            tile, gidx, yx = inp
             tkey = jax.random.fold_in(key, gidx % t)  # padded dups share keys
-            return None, process(params, tile, tkey, pos, neg)
+            return None, process(params, tile, tkey, pos, neg, yx)
 
-        _, processed = jax.lax.scan(body, None, (tiles_shard, idx_shard))
+        _, processed = jax.lax.scan(body, None, (tiles_shard, idx_shard, yx_shard))
         return jax.lax.all_gather(processed, DATA_AXIS, axis=0, tiled=True)
 
     gathered = jax.shard_map(
         per_chip_fn,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
         out_specs=P(),
         check_vma=False,
-    )(extracted, global_idx, params, pos, neg)
+    )(extracted, global_idx, positions, params, pos, neg)
     return tile_ops.blend_tiles(gathered[:t], grid)
 
 

@@ -94,7 +94,7 @@ def test_master_elastic_with_live_worker_submissions(bundle, server_loop):
             jax.image.resize(img, (1, 128, 128, 3), method="cubic"), 0, 1
         )
         extracted = tile_ops.extract_tiles(upscaled, grid)
-        process = _jit_tile_processor(bundle, 1, "euler", "karras", 1.0, 0.3)
+        process = _jit_tile_processor(bundle, grid, 1, "euler", "karras", 1.0, 0.3)
         key = jax.random.key(9)
         while True:
             tile_idx = run_async_in_server_loop(
@@ -103,7 +103,10 @@ def test_master_elastic_with_live_worker_submissions(bundle, server_loop):
             if tile_idx is None:
                 break
             tkey = jax.random.fold_in(key, tile_idx)
-            result = process(bundle.params, extracted[tile_idx], tkey, pos, neg)
+            result = process(
+                bundle.params, extracted[tile_idx], tkey, pos, neg,
+                grid.positions_array()[tile_idx],
+            )
             arr = img_utils.ensure_numpy(result)
             payload = [
                 {"batch_idx": i, "image": img_utils.encode_image_data_url(arr[i])}

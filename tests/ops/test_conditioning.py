@@ -1,0 +1,103 @@
+"""Conditioning structure: pytree behavior, tile cropping parity, and
+ControlNet integration through txt2img and tiled upscale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models.controlnet import load_controlnet
+from comfyui_distributed_tpu.ops import tiles as tile_ops
+from comfyui_distributed_tpu.ops import upscale as up
+from comfyui_distributed_tpu.ops.conditioning import (
+    Conditioning,
+    as_conditioning,
+    crop_to_tile,
+    slice_batch,
+)
+
+
+def test_conditioning_is_pytree():
+    cond = Conditioning(
+        context=jnp.ones((1, 4, 8)),
+        control_hint=jnp.ones((1, 16, 16, 3)),
+        control_strength=0.5,
+        area=(8, 8, 0, 0),
+    )
+    leaves = jax.tree_util.tree_leaves(cond)
+    assert len(leaves) == 2  # context + hint
+    mapped = jax.tree_util.tree_map(lambda a: a * 2, cond)
+    assert isinstance(mapped, Conditioning)
+    assert mapped.control_strength == 0.5 and mapped.area == (8, 8, 0, 0)
+    np.testing.assert_array_equal(np.asarray(mapped.context), 2.0)
+
+
+def test_crop_to_tile_hint_and_area():
+    hint = jnp.arange(32 * 32, dtype=jnp.float32).reshape(1, 32, 32, 1)
+    cond = Conditioning(
+        context=jnp.zeros((1, 2, 4)), control_hint=hint, area=(16, 16, 8, 8)
+    )
+    cropped = crop_to_tile(cond, y=8, x=8, tile_h=16, tile_w=16,
+                           image_h=32, image_w=32)
+    np.testing.assert_array_equal(
+        np.asarray(cropped.control_hint[0, :, :, 0]),
+        np.asarray(hint[0, 8:24, 8:24, 0]),
+    )
+    assert cropped.area == (16, 16, 0, 0)  # tile-local coords
+    # area fully outside the tile zeroes the entry's strength
+    gone = crop_to_tile(cond, y=0, x=0, tile_h=8, tile_w=8,
+                        image_h=32, image_w=32)
+    assert gone.area is None and gone.control_strength == 0.0
+
+
+def test_slice_batch_follows_all_payloads():
+    cond = Conditioning(
+        context=jnp.arange(4 * 2 * 3, dtype=jnp.float32).reshape(4, 2, 3),
+        control_hint=jnp.arange(4 * 4 * 4 * 1, dtype=jnp.float32).reshape(4, 4, 4, 1),
+    )
+    cut = slice_batch(cond, 1, 2)
+    assert cut.context.shape == (2, 2, 3)
+    np.testing.assert_array_equal(np.asarray(cut.context), np.asarray(cond.context[1:3]))
+    assert cut.control_hint.shape == (2, 4, 4, 1)
+
+
+def test_zero_init_controlnet_is_identity_on_txt2img():
+    """Untrained ControlNet (zero-init output conv) must not change the
+    sample — the wiring test that catches plumbing bugs."""
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    cn = load_controlnet("tile", model_channels=32, downscale=4)
+    pos_plain = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    hint = jnp.ones((1, 32, 32, 3)) * 0.5
+    pos_cn = Conditioning(
+        context=pos_plain, control_hint=hint, control_strength=1.0,
+        control_params=cn.params, control_module=cn.module,
+    )
+    base = pl.img2img_latents(
+        bundle, jnp.zeros((1, 8, 8, 4)), pos_plain, neg, steps=2, denoise=1.0, seed=1
+    )
+    with_cn = pl.img2img_latents(
+        bundle, jnp.zeros((1, 8, 8, 4)), pos_cn, neg, steps=2, denoise=1.0, seed=1
+    )
+    np.testing.assert_allclose(np.asarray(base), np.asarray(with_cn), atol=1e-6)
+
+
+def test_upscale_with_controlnet_hint_runs_and_matches_mesh():
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    cn = load_controlnet("tile", model_channels=32, downscale=4)
+    img = jnp.asarray(np.random.default_rng(0).random((1, 64, 64, 3)), jnp.float32)
+    pos = Conditioning(
+        context=pl.encode_text(bundle, ["p"]), control_hint=img,
+        control_strength=1.0, control_params=cn.params, control_module=cn.module,
+    )
+    neg = as_conditioning(pl.encode_text(bundle, [""]))
+    kwargs = dict(upscale_by=2.0, tile=64, padding=16, steps=1, denoise=0.3, seed=2)
+    single = up.run_upscale(bundle, img, pos, neg, mesh=None, **kwargs)
+    assert single.shape == (1, 128, 128, 3)
+    mesh = build_mesh({"data": 8})
+    sharded = up.run_upscale(bundle, img, pos, neg, mesh=mesh, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(single), np.asarray(sharded), atol=2e-2, rtol=0
+    )
