@@ -1,0 +1,103 @@
+// Native host-side data plane for the HTTP (elastic) tier.
+//
+// The reference has no native code (SURVEY §2: GPU compute delegated to
+// torch); this framework's device compute is XLA, but the HTTP tier
+// moves every image/tile through host-side u8<->f32 conversion and
+// feathered compositing — pure-Python/numpy hot paths worth native
+// treatment. Compiled on demand by native/__init__.py (g++ -O3) with a
+// numpy fallback when no toolchain is present.
+//
+// ABI: plain C functions over contiguous row-major buffers.
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// u8 [n] -> f32 [n] scaled to [0, 1]
+void u8_to_f32(const uint8_t* src, float* dst, size_t n) {
+    // true division, not reciprocal-multiply: bit-exact with numpy's
+    // `arr / 255.0` (a 1-ULP difference here would break image-hash
+    // dedup between native and fallback hosts)
+    for (size_t i = 0; i < n; ++i) {
+        dst[i] = static_cast<float>(src[i]) / 255.0f;
+    }
+}
+
+// f32 [n] in [0, 1] -> u8 [n] with round-half-up and clamping
+void f32_to_u8(const float* src, uint8_t* dst, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+        float v = src[i];
+        v = v < 0.0f ? 0.0f : (v > 1.0f ? 1.0f : v);
+        dst[i] = static_cast<uint8_t>(v * 255.0f + 0.5f);
+    }
+}
+
+// Alpha-composite one padded tile into a padded canvas, in place.
+//   canvas: [B, CH, CW, C]   tile: [B, TH, TW, C]   mask: [TH, TW]
+//   origin (y, x) in canvas coords; caller guarantees bounds.
+void feathered_blend(
+    float* canvas, const float* tile, const float* mask,
+    int64_t b, int64_t th, int64_t tw, int64_t c,
+    int64_t ch, int64_t cw, int64_t y, int64_t x) {
+    for (int64_t bi = 0; bi < b; ++bi) {
+        float* cbase = canvas + bi * ch * cw * c;
+        const float* tbase = tile + bi * th * tw * c;
+        for (int64_t row = 0; row < th; ++row) {
+            float* crow = cbase + ((y + row) * cw + x) * c;
+            const float* trow = tbase + row * tw * c;
+            const float* mrow = mask + row * tw;
+            for (int64_t col = 0; col < tw; ++col) {
+                const float m = mrow[col];
+                const float inv = 1.0f - m;
+                for (int64_t ci = 0; ci < c; ++ci) {
+                    const int64_t idx = col * c + ci;
+                    crow[idx] = crow[idx] * inv + trow[idx] * m;
+                }
+            }
+        }
+    }
+}
+
+// Weighted accumulation variant (order-independent blending):
+// canvas += tile * mask; weights += mask. Shapes as above, weights [CH, CW].
+void weighted_accumulate(
+    float* canvas, float* weights, const float* tile, const float* mask,
+    int64_t b, int64_t th, int64_t tw, int64_t c,
+    int64_t ch, int64_t cw, int64_t y, int64_t x) {
+    for (int64_t bi = 0; bi < b; ++bi) {
+        float* cbase = canvas + bi * ch * cw * c;
+        const float* tbase = tile + bi * th * tw * c;
+        for (int64_t row = 0; row < th; ++row) {
+            float* crow = cbase + ((y + row) * cw + x) * c;
+            const float* trow = tbase + row * tw * c;
+            const float* mrow = mask + row * tw;
+            for (int64_t col = 0; col < tw; ++col) {
+                const float m = mrow[col];
+                for (int64_t ci = 0; ci < c; ++ci) {
+                    const int64_t idx = col * c + ci;
+                    crow[idx] += trow[idx] * m;
+                }
+            }
+        }
+    }
+    for (int64_t row = 0; row < th; ++row) {
+        float* wrow = weights + (y + row) * cw + x;
+        const float* mrow = mask + row * tw;
+        for (int64_t col = 0; col < tw; ++col) {
+            wrow[col] += mrow[col];
+        }
+    }
+}
+
+// FNV-1a 64-bit content hash (fast change detection for media sync).
+uint64_t fnv1a64(const uint8_t* data, size_t n) {
+    uint64_t h = 1469598103934665603ull;
+    for (size_t i = 0; i < n; ++i) {
+        h ^= data[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+}  // extern "C"

@@ -36,8 +36,9 @@ def array_to_pil(image) -> Image.Image:
         arr = arr[0]
     if arr.ndim == 2:
         arr = arr[..., None]
-    arr = np.clip(arr, 0.0, 1.0)
-    u8 = (arr * 255.0 + 0.5).astype(np.uint8)
+    from ..native import f32_to_u8
+
+    u8 = f32_to_u8(arr)
     if u8.shape[-1] == 1:
         return Image.fromarray(u8[..., 0], mode="L")
     mode = "RGBA" if u8.shape[-1] == 4 else "RGB"
@@ -46,9 +47,11 @@ def array_to_pil(image) -> Image.Image:
 
 def pil_to_array(img: Image.Image) -> np.ndarray:
     """PIL image → [H, W, C] float32 in [0,1]."""
+    from ..native import u8_to_f32
+
     if img.mode not in ("RGB", "RGBA", "L"):
         img = img.convert("RGB")
-    arr = np.asarray(img, dtype=np.float32) / 255.0
+    arr = u8_to_f32(np.asarray(img, dtype=np.uint8))
     if arr.ndim == 2:
         arr = arr[..., None]
     return arr
