@@ -37,7 +37,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from .api.server import DistributedServer
     from .workers.monitor import start_master_watchdog
-    from .workers.startup import delayed_auto_launch, register_signals
+    from .workers.startup import (
+        auto_populate_workers,
+        delayed_auto_launch,
+        register_signals,
+    )
 
     server = DistributedServer(
         port=args.port, is_worker=args.worker, config_path=args.config
@@ -47,6 +51,7 @@ def main(argv: list[str] | None = None) -> int:
         await server.start()
         register_signals(asyncio.get_running_loop(), args.config)
         if not server.is_worker:
+            auto_populate_workers(args.config)
             delayed_auto_launch(args.config)
         else:
             start_master_watchdog()

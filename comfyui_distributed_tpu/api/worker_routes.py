@@ -31,6 +31,7 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/managed", routes.managed)
     app.router.add_get("/distributed/worker_log/{name}", routes.worker_log)
     app.router.add_get("/distributed/master_log", routes.master_log)
+    app.router.add_get("/distributed/remote_log/{worker_id}", routes.remote_log)
     app.router.add_get("/distributed/network_info", routes.network_info)
     app.router.add_get("/distributed/system_info", routes.system_info)
 
@@ -149,6 +150,32 @@ class WorkerRoutes:
     async def master_log(self, request: web.Request) -> web.Response:
         tail = int(request.query.get("tail", 200))
         return web.json_response({"lines": self.server.log_buffer[-tail:]})
+
+    async def remote_log(self, request: web.Request) -> web.Response:
+        """Proxy a remote worker's in-memory log so the panel can show
+        logs of workers on other hosts (reference remote-log endpoint,
+        api/worker_routes.py log proxying)."""
+        worker_id = request.match_info["worker_id"]
+        tail = request.query.get("tail", "200")
+        worker = next(
+            (
+                w
+                for w in self.server.config.get("workers", [])
+                if str(w.get("id")) == worker_id
+            ),
+            None,
+        )
+        if worker is None:
+            return web.json_response({"error": "no such worker"}, status=404)
+        from ..utils.network import build_worker_url, get_client_session
+
+        try:
+            session = await get_client_session()
+            url = build_worker_url(worker, f"/distributed/master_log?tail={tail}")
+            async with session.get(url) as resp:
+                return web.json_response(await resp.json(), status=resp.status)
+        except Exception as exc:  # noqa: BLE001 - proxied errors surface
+            return web.json_response({"error": str(exc)}, status=502)
 
     # --- host info ----------------------------------------------------------
 

@@ -28,6 +28,52 @@ def is_worker_process() -> bool:
     return os.environ.get(WORKER_ENV_FLAG) == "1"
 
 
+def auto_populate_workers(config_path: str | None = None) -> list[dict[str, Any]]:
+    """First-run convenience: create one local worker entry per spare
+    local chip (everything but the master's chip 0), ports 8189+.
+
+    The reference does this from the browser (reference
+    web/masterDetection.js auto-populate, flag
+    has_auto_populated_workers); runtime-side here so headless
+    deployments get it too. Runs once — the flag persists in config.
+    """
+    if is_worker_process():
+        return []
+    created: list[dict[str, Any]] = []
+    config = config_mod.load_config(config_path)
+    if config.get("settings", {}).get("has_auto_populated_workers"):
+        return []
+    try:
+        import jax
+
+        chips = [d.id for d in jax.local_devices()]
+    except Exception:
+        chips = []
+    master_chips = set(config.get("master", {}).get("tpu_chips", [0]))
+    spare = [c for c in chips if c not in master_chips]
+    port = 8189
+    for chip in spare:
+        created.append(
+            {
+                "id": f"chip{chip}",
+                "name": f"chip{chip}",
+                "type": "local",
+                "host": "127.0.0.1",
+                "port": port,
+                "tpu_chips": [chip],
+                "enabled": False,
+                "extra_args": "",
+            }
+        )
+        port += 1
+    config.setdefault("workers", []).extend(created)
+    config.setdefault("settings", {})["has_auto_populated_workers"] = True
+    config_mod.save_config(config, config_path)
+    if created:
+        log(f"auto-populated {len(created)} worker(s) for spare chips {spare}")
+    return created
+
+
 def delayed_auto_launch(config_path: str | None = None) -> threading.Timer | None:
     """After a short delay (server must be up first), clear stale PID
     records and launch enabled local workers if auto_launch is on."""

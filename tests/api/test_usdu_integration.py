@@ -1,0 +1,129 @@
+"""Elastic USDU over real HTTP: master + worker servers run the tiled
+upscale workflow through /distributed/queue — tile queue, submit_tiles,
+heartbeats, and blend all over sockets."""
+
+import asyncio
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils import config as config_mod
+from comfyui_distributed_tpu.utils import image as img_utils
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _usdu_prompt():
+    return {
+        "1": {"class_type": "CheckpointLoaderSimple", "inputs": {"ckpt_name": "tiny-unet"}},
+        "2": {"class_type": "CLIPTextEncode", "inputs": {"text": "detail", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode", "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "LoadImage", "inputs": {"image": "usdu_src.png"}},
+        "5": {
+            "class_type": "UltimateSDUpscaleDistributed",
+            "inputs": {
+                "image": ["4", 0], "model": ["1", 0], "positive": ["2", 0],
+                "negative": ["3", 0], "vae": ["1", 2], "seed": 3, "steps": 1,
+                "cfg": 1.0, "sampler_name": "euler", "scheduler": "karras",
+                "denoise": 0.3, "upscale_by": 2.0, "tile_width": 64,
+                "tile_height": 64, "tile_padding": 16,
+            },
+        },
+        "6": {"class_type": "SaveImage", "inputs": {"images": ["5", 0], "filename_prefix": "usdu_out"}},
+    }
+
+
+@pytest.fixture()
+def usdu_cluster(tmp_config_path, tmp_path, monkeypatch):
+    data_dir = tmp_path / "data"
+    (data_dir / "input").mkdir(parents=True)
+    monkeypatch.setenv("CDT_DATA_DIR", str(data_dir))
+    # shared input image (same filesystem ⇒ media sync md5 short-circuit)
+    src = np.random.default_rng(0).random((64, 64, 3)).astype(np.float32)
+    with open(data_dir / "input" / "usdu_src.png", "wb") as fh:
+        fh.write(img_utils.encode_png(src))
+
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    master_port, worker_port = _free_port(), _free_port()
+    config = config_mod.load_config()
+    config["workers"] = [
+        {"id": "w1", "name": "worker1", "type": "remote", "host": "127.0.0.1",
+         "port": worker_port, "enabled": True, "tpu_chips": [], "extra_args": ""}
+    ]
+    config["master"]["host"] = "127.0.0.1"
+    config_mod.save_config(config)
+
+    master = DistributedServer(port=master_port, is_worker=False)
+    worker = DistributedServer(port=worker_port, is_worker=True)
+
+    async def boot():
+        await master.start()
+        await worker.start()
+
+    asyncio.run_coroutine_threadsafe(boot(), loop_thread.loop).result(timeout=30)
+    yield master, worker, master_port, data_dir
+
+    async def teardown():
+        await master.stop()
+        await worker.stop()
+
+    asyncio.run_coroutine_threadsafe(teardown(), loop_thread.loop).result(timeout=30)
+    loop_thread.stop()
+
+
+def test_usdu_elastic_over_http(usdu_cluster):
+    master, worker, master_port, data_dir = usdu_cluster
+    result = _post(
+        f"http://127.0.0.1:{master_port}/distributed/queue",
+        {"prompt": _usdu_prompt(), "client_id": "t", "workers": ["w1"]},
+    )
+    assert result["workers"] == ["w1"]
+    prompt_id = result["prompt_id"]
+
+    deadline = time.time() + 300
+    history = {}
+    while time.time() < deadline:
+        history = _get(f"http://127.0.0.1:{master_port}/history/{prompt_id}")
+        if history.get("done"):
+            break
+        time.sleep(1)
+    assert history.get("done"), f"never finished: {history}"
+    assert history.get("error") is None, history["error"]
+
+    job = master._history[prompt_id]
+    images = np.asarray(list(job.outputs.values())[0][0]["images"])
+    assert images.shape == (1, 128, 128, 3)
+    assert np.isfinite(images).all()
+    # output file landed
+    out_files = os.listdir(data_dir / "output")
+    assert any(f.startswith("usdu_out") for f in out_files)
+    # the worker really participated: its tile submissions were recorded
+    # (master logs record requeue only on failure; check the job went
+    # through the store by confirming worker server executed a prompt)
+    assert worker._history, "worker never received a dispatched prompt"

@@ -1,0 +1,92 @@
+"""Worker lifecycle: launch command building, arg sanitization, PID
+persistence, stale recovery, auto-populate, monitor helpers."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils.exceptions import ProcessError
+from comfyui_distributed_tpu.workers import detection
+from comfyui_distributed_tpu.workers import process_manager as pm
+from comfyui_distributed_tpu.workers import startup
+
+
+def test_build_launch_command():
+    manager = pm.WorkerProcessManager()
+    cmd = manager.build_launch_command(
+        {"id": "w1", "port": 8190, "extra_args": "--platform cpu"}
+    )
+    assert cmd[1:] == [
+        "-m", "comfyui_distributed_tpu", "--port", "8190", "--worker",
+        "--platform", "cpu",
+    ]
+
+
+def test_extra_args_sanitized():
+    with pytest.raises(ProcessError):
+        pm.sanitize_extra_args("--foo; rm -rf /")
+    with pytest.raises(ProcessError):
+        pm.sanitize_extra_args("$(evil)")
+    assert pm.sanitize_extra_args('--a "b c"') == ["--a", "b c"]
+    assert pm.sanitize_extra_args("") == []
+
+
+def test_is_process_alive():
+    assert pm.is_process_alive(os.getpid())
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    time.sleep(0.1)
+    assert not pm.is_process_alive(999999)
+
+
+def test_pid_persistence_and_stale_recovery(tmp_config_path):
+    manager = pm.WorkerProcessManager()
+    manager._persist("w1", 999999, None)  # dead pid
+    assert "w1" in manager.managed_processes()
+    stale = manager.clear_stale()
+    assert stale == ["w1"]
+    assert "w1" not in manager.managed_processes()
+
+
+def test_launch_and_stop_real_process(tmp_config_path, tmp_path, monkeypatch):
+    """Launch a real (sleep) process through the manager and tree-kill it."""
+    monkeypatch.setenv("CDT_LOG_DIR", str(tmp_path / "logs"))
+    manager = pm.WorkerProcessManager()
+    monkeypatch.setattr(
+        manager, "build_launch_command",
+        lambda worker: [sys.executable, "-c", "import time; time.sleep(60)"],
+    )
+    info = manager.launch_worker({"id": "w2", "name": "w2", "port": 0})
+    assert pm.is_process_alive(info["pid"])
+    assert "w2" in manager.managed_processes()
+    # duplicate launch refused while alive
+    with pytest.raises(ProcessError):
+        manager.launch_worker({"id": "w2", "name": "w2", "port": 0})
+    assert manager.stop_worker("w2") is True
+    time.sleep(0.2)
+    assert not pm.is_process_alive(info["pid"])
+    assert "w2" not in manager.managed_processes()
+
+
+def test_auto_populate_once(tmp_config_path):
+    created = startup.auto_populate_workers()
+    # 8 virtual chips, chip 0 reserved for the master
+    assert [w["tpu_chips"] for w in created] == [[c] for c in range(1, 8)]
+    assert all(not w["enabled"] for w in created)
+    cfg = cfg_mod.load_config()
+    assert len(cfg["workers"]) == 7
+    assert cfg["settings"]["has_auto_populated_workers"] is True
+    # second call is a no-op
+    assert startup.auto_populate_workers() == []
+    assert len(cfg_mod.load_config()["workers"]) == 7
+
+
+def test_detection_helpers():
+    assert len(detection.get_machine_id()) == 12
+    assert detection.is_local_worker({"type": "local"})
+    assert detection.is_local_worker({"type": "remote", "host": "127.0.0.1"})
+    assert not detection.is_local_worker({"type": "remote", "host": "10.1.2.3"})
