@@ -1,0 +1,69 @@
+"""Worker probing/selection logic (reference tests/
+test_dispatch_selection.py scenarios): offline skipping, idle
+round-robin, min-queue fallback."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.api.orchestration import dispatch
+from comfyui_distributed_tpu.utils import network
+
+
+@pytest.fixture()
+def probe_map(monkeypatch):
+    """Patch probe_worker with a scripted availability map keyed by host."""
+    results: dict[str, dict] = {}
+
+    async def fake_probe(url_base, timeout=None):
+        for key, value in results.items():
+            if key in url_base:
+                return value
+        return {"online": False, "queue_remaining": None}
+
+    monkeypatch.setattr(dispatch, "probe_worker", fake_probe)
+    return results
+
+
+def _worker(wid, host):
+    return {"id": wid, "host": host, "port": 8189, "type": "remote", "enabled": True}
+
+
+def test_select_active_skips_offline(probe_map):
+    probe_map["host-a"] = {"online": True, "queue_remaining": 0}
+    probe_map["host-b"] = {"online": False, "queue_remaining": None}
+    workers = [_worker("a", "host-a"), _worker("b", "host-b")]
+    active = asyncio.run(dispatch.select_active_workers(workers))
+    assert [w["id"] for w in active] == ["a"]
+
+
+def test_select_active_respects_enabled_flag(probe_map):
+    probe_map["host-a"] = {"online": True, "queue_remaining": 0}
+    workers = [dict(_worker("a", "host-a"), enabled=False)]
+    assert asyncio.run(dispatch.select_active_workers(workers)) == []
+
+
+def test_least_busy_round_robins_idle(probe_map):
+    probe_map["host-a"] = {"online": True, "queue_remaining": 0}
+    probe_map["host-b"] = {"online": True, "queue_remaining": 0}
+    workers = [_worker("a", "host-a"), _worker("b", "host-b")]
+    picks = [
+        asyncio.run(dispatch.select_least_busy_worker(workers))["id"]
+        for _ in range(4)
+    ]
+    # alternates between the two idle workers
+    assert set(picks) == {"a", "b"}
+    assert picks[0] != picks[1]
+
+
+def test_least_busy_min_queue_when_none_idle(probe_map):
+    probe_map["host-a"] = {"online": True, "queue_remaining": 5}
+    probe_map["host-b"] = {"online": True, "queue_remaining": 2}
+    workers = [_worker("a", "host-a"), _worker("b", "host-b")]
+    pick = asyncio.run(dispatch.select_least_busy_worker(workers))
+    assert pick["id"] == "b"
+
+
+def test_least_busy_none_when_all_offline(probe_map):
+    workers = [_worker("a", "host-a")]
+    assert asyncio.run(dispatch.select_least_busy_worker(workers)) is None

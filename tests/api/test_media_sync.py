@@ -1,0 +1,77 @@
+"""Media sync: reference discovery, hash checking, upload decisions,
+path-separator rewriting (reference tests/test_media_sync.py)."""
+
+import asyncio
+import os
+
+import pytest
+
+from comfyui_distributed_tpu.api.orchestration import media_sync
+
+
+def test_find_media_references():
+    prompt = {
+        "1": {"class_type": "LoadImage", "inputs": {"image": "photo.png"}},
+        "2": {"class_type": "KSampler", "inputs": {"seed": 5, "model": ["1", 0]}},
+        "3": {"class_type": "X", "inputs": {"some_path": "clip.mp4"}},
+        "4": {"class_type": "Y", "inputs": {"text": "not a file"}},
+        "5": {"class_type": "Z", "inputs": {"audio": "voice.wav"}},
+    }
+    refs = media_sync.find_media_references(prompt)
+    found = {(nid, key) for nid, key, _ in refs}
+    assert ("1", "image") in found
+    assert ("3", "some_path") in found  # extension match
+    assert ("5", "audio") in found
+    assert ("4", "text") not in found
+    assert ("2", "seed") not in found
+
+
+def test_sync_uploads_missing_and_skips_matching(tmp_path, monkeypatch):
+    input_dir = tmp_path
+    (input_dir / "a.png").write_bytes(b"aaa")
+    (input_dir / "b.png").write_bytes(b"bbb")
+
+    checked, uploaded = [], []
+
+    async def fake_check(worker, filename, md5):
+        checked.append(filename)
+        return filename == "a.png"  # a matches remotely, b doesn't
+
+    async def fake_upload(worker, path, filename):
+        uploaded.append(filename)
+        return True
+
+    async def fake_sep(worker):
+        return os.sep
+
+    monkeypatch.setattr(media_sync, "_check_file", fake_check)
+    monkeypatch.setattr(media_sync, "_upload_file", fake_upload)
+    monkeypatch.setattr(media_sync, "_worker_path_separator", fake_sep)
+
+    prompt = {
+        "1": {"class_type": "LoadImage", "inputs": {"image": "a.png"}},
+        "2": {"class_type": "LoadImage", "inputs": {"image": "b.png"}},
+        "3": {"class_type": "LoadImage", "inputs": {"image": "missing.png"}},
+    }
+    asyncio.run(media_sync.sync_worker_media({"id": "w"}, prompt, str(input_dir)))
+    assert sorted(checked) == ["a.png", "b.png"]
+    assert uploaded == ["b.png"]  # only the stale one
+
+
+def test_path_separator_rewrite(tmp_path, monkeypatch):
+    (tmp_path / "sub").mkdir()
+    rel = os.path.join("sub", "img.png")
+    (tmp_path / rel).write_bytes(b"x")
+
+    async def fake_check(worker, filename, md5):
+        return True
+
+    async def fake_sep(worker):
+        return "\\"  # windows worker
+
+    monkeypatch.setattr(media_sync, "_check_file", fake_check)
+    monkeypatch.setattr(media_sync, "_worker_path_separator", fake_sep)
+
+    prompt = {"1": {"class_type": "LoadImage", "inputs": {"image": rel}}}
+    asyncio.run(media_sync.sync_worker_media({"id": "w"}, prompt, str(tmp_path)))
+    assert prompt["1"]["inputs"]["image"] == "sub\\img.png"

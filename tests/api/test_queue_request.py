@@ -1,0 +1,53 @@
+"""Queue-request payload parsing (reference tests/test_queue_request.py)."""
+
+import pytest
+
+from comfyui_distributed_tpu.api.queue_request import (
+    QueueRequestError,
+    parse_queue_request_payload,
+)
+
+
+def test_minimal_valid():
+    payload = parse_queue_request_payload(
+        {"prompt": {"1": {"class_type": "X", "inputs": {}}}, "client_id": "c"}
+    )
+    assert payload.worker_ids == []
+    assert payload.trace_id is None
+
+
+def test_workflow_fallback_and_alias():
+    payload = parse_queue_request_payload(
+        {
+            "workflow": {"prompt": {"1": {"class_type": "X", "inputs": {}}}},
+            "client_id": "c",
+            "worker_ids": ["w1", 2],
+        }
+    )
+    assert "1" in payload.prompt
+    assert payload.worker_ids == ["w1", "2"]
+
+
+def test_extras_preserved():
+    payload = parse_queue_request_payload(
+        {"prompt": {"1": {}}, "client_id": "c", "load_balance": True, "foo": 1}
+    )
+    assert payload.extra == {"load_balance": True, "foo": 1}
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        None,
+        [],
+        {},
+        {"prompt": {}, "client_id": "c"},
+        {"prompt": {"1": {}}},
+        {"prompt": {"1": {}}, "client_id": ""},
+        {"prompt": {"1": {}}, "client_id": "c", "workers": "notalist"},
+        {"prompt": {"1": {}}, "client_id": "c", "workers": [{"x": 1}]},
+    ],
+)
+def test_invalid_payloads(body):
+    with pytest.raises(QueueRequestError):
+        parse_queue_request_payload(body)

@@ -1,0 +1,74 @@
+"""Worker-side payload flushing: size-aware chunking and interrupt
+propagation in the USDU worker loop."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph import ExecutionContext
+from comfyui_distributed_tpu.graph.usdu_elastic import run_worker_loop
+from comfyui_distributed_tpu.models import pipeline as pl
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return pl.load_pipeline("tiny-unet", seed=0)
+
+
+class RecordingClient:
+    def __init__(self, tile_ids):
+        self.tile_ids = list(tile_ids)
+        self.flushes = []
+
+    def poll_ready(self):
+        return True
+
+    def request_tile(self):
+        if not self.tile_ids:
+            return None
+        return {"tile_idx": self.tile_ids.pop(0)}
+
+    def submit_tiles(self, entries, is_final):
+        self.flushes.append((list(entries), is_final))
+
+    def heartbeat(self):
+        pass
+
+
+def test_flush_batches_on_max_batch(bundle, monkeypatch):
+    """MAX_TILE_BATCH forces intermediate flushes before the final one."""
+    import comfyui_distributed_tpu.graph.usdu_elastic as elastic
+
+    monkeypatch.setattr(elastic, "MAX_TILE_BATCH", 2)
+    img = jnp.asarray(np.random.default_rng(0).random((1, 96, 96, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    client = RecordingClient([0, 1, 2, 3])  # 2x upscale of 96 → 4 tiles of 96px
+    run_worker_loop(
+        bundle, img, pos, neg, job_id="f", worker_id="w", master_url="",
+        upscale_by=2.0, tile=96, padding=16, steps=1, sampler="euler",
+        scheduler="karras", cfg=1.0, denoise=0.3, seed=0, client=client,
+    )
+    # 4 tiles with flush threshold 2: two intermediate + one final flush
+    assert [len(e) for e, _ in client.flushes] == [2, 2, 0]
+    assert [f for _, f in client.flushes] == [False, False, True]
+
+
+def test_interrupt_stops_worker_loop(bundle):
+    img = jnp.asarray(np.random.default_rng(1).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    ctx = ExecutionContext()
+    ctx.interrupt_event.set()
+    client = RecordingClient([0, 1, 2, 3])
+    with pytest.raises(InterruptedError):
+        run_worker_loop(
+            bundle, img, pos, neg, job_id="i", worker_id="w", master_url="",
+            upscale_by=2.0, tile=64, padding=16, steps=1, sampler="euler",
+            scheduler="karras", cfg=1.0, denoise=0.3, seed=0,
+            context=ctx, client=client,
+        )
+    # no tiles processed after the interrupt
+    assert client.flushes == []

@@ -1,0 +1,118 @@
+"""JobStore semantics: init races, idempotent completion, requeue with
+busy-probe grace (reference tests/test_job_timeout.py scenarios)."""
+
+import asyncio
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.utils.exceptions import JobQueueError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_collector_grace_creates_queue_at_deadline():
+    store = JobStore()
+
+    async def scenario():
+        job = await store.wait_for_collector("j1", grace_seconds=0.2)
+        assert job is not None
+        # second wait returns the same object immediately
+        again = await store.wait_for_collector("j1", grace_seconds=0)
+        assert again is job
+
+    run(scenario())
+
+
+def test_collector_receives_and_tracks_finishers():
+    store = JobStore()
+
+    async def scenario():
+        await store.put_collector_result(
+            "j", {"worker_id": "w1", "batch_idx": 0, "is_last": False}
+        )
+        await store.put_collector_result(
+            "j", {"worker_id": "w1", "batch_idx": 1, "is_last": True}
+        )
+        job = await store.ensure_collector("j")
+        assert job.received == {"w1": 2}
+        assert job.finished_workers == {"w1"}
+        assert job.queue.qsize() == 2
+
+    run(scenario())
+
+
+def test_tile_job_pull_submit_dedup():
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1, 2])
+        first = await store.pull_task("t", "w1")
+        assert first == 0
+        assert await store.remaining("t") == 2
+        assert await store.submit_result("t", "w1", first, "payload") is True
+        # duplicate submission dropped
+        assert await store.submit_result("t", "w2", first, "other") is False
+        assert not await store.is_complete("t")
+        for _ in range(2):
+            task = await store.pull_task("t", "w1")
+            await store.submit_result("t", "w1", task, "p")
+        assert await store.is_complete("t")
+        # drained queue returns None, not an exception
+        assert await store.pull_task("t", "w1", timeout=0.05) is None
+
+    run(scenario())
+
+
+def test_pull_unknown_job_raises():
+    store = JobStore()
+    with pytest.raises(JobQueueError):
+        run(store.pull_task("nope", "w"))
+
+
+def test_requeue_timed_out_with_busy_grace():
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1, 2, 3])
+        # two workers each grab tasks
+        a1 = await store.pull_task("t", "busy-w")
+        b1 = await store.pull_task("t", "dead-w")
+        # both go stale
+        job = await store.get_tile_job("t")
+        job.worker_status["busy-w"] = time.monotonic() - 100
+        job.worker_status["dead-w"] = time.monotonic() - 100
+
+        async def probe(worker_id):
+            return worker_id == "busy-w"  # busy-w is mid-sample
+
+        requeued = await store.requeue_timed_out("t", 1.0, probe)
+        assert requeued == [b1]          # only the dead worker's task
+        assert await store.remaining("t") == 3  # 2 untouched + 1 requeued
+        # busy worker got heartbeat grace, still assigned
+        assert a1 in job.assigned["busy-w"]
+        # finished workers never requeue
+        await store.mark_worker_done("t", "busy-w")
+        job.worker_status["busy-w"] = time.monotonic() - 100
+        assert await store.requeue_timed_out("t", 1.0, probe) == []
+
+    run(scenario())
+
+
+def test_completed_tasks_not_requeued():
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1])
+        t0 = await store.pull_task("t", "w")
+        t1 = await store.pull_task("t", "w")
+        await store.submit_result("t", "w", t0, "p")
+        job = await store.get_tile_job("t")
+        job.worker_status["w"] = time.monotonic() - 100
+        requeued = await store.requeue_timed_out("t", 1.0, None)
+        assert requeued == [t1]  # completed t0 stays done
+
+    run(scenario())
