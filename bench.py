@@ -20,16 +20,46 @@ import os
 import time
 
 
+def _probe_accelerator(timeout_s: float) -> str:
+    """Probe backend init in a subprocess: a hung/unreachable TPU
+    tunnel would otherwise hang the whole bench (backend init is not
+    interruptible in-process). Returns 'ok' | 'failed' | 'timeout'."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return "ok" if proc.returncode == 0 and b"ok" in proc.stdout else "failed"
+    except subprocess.TimeoutExpired:
+        return "timeout"
+
+
 def _init_jax():
+    import sys
+
     import jax
 
     if os.environ.get("BENCH_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.devices()
-    except RuntimeError:
-        # accelerator backend unavailable (e.g. TPU tunnel down): CPU keeps
-        # the harness alive and the driver still records a number
+        return jax
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
+    # probe_timeout <= 0 disables the probe (trusted-healthy host: skip
+    # the duplicate backend init the probe subprocess costs)
+    status = "ok" if probe_timeout <= 0 else _probe_accelerator(probe_timeout)
+    if status != "ok":
+        reason = (
+            f"unresponsive after {probe_timeout:.0f}s"
+            if status == "timeout"
+            else "failed to initialize"
+        )
+        print(
+            f"accelerator backend {reason}; benchmarking tiny config on CPU",
+            file=sys.stderr, flush=True,
+        )
+        os.environ.setdefault("BENCH_TINY", "1")
         jax.config.update("jax_platforms", "cpu")
     return jax
 

@@ -29,6 +29,7 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             transformer_depth=(1, 1, 1, 0),
             context_dim=768,
             num_heads=8,
+            remat=True,
         ),
     },
     "sdxl": {
@@ -40,6 +41,7 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             context_dim=2048,
             num_heads=20,
             adm_in_channels=2816,
+            remat=True,
         ),
     },
     "tiny-unet": {

@@ -42,6 +42,9 @@ class UNetConfig:
     # SDXL-style pooled text + size conditioning vector (0 = disabled)
     adm_in_channels: int = 0
     dtype: str = "bfloat16"
+    # rematerialise attention blocks: trades recompute for HBM, the
+    # standard lever for big latents on 16GB chips
+    remat: bool = False
 
     @property
     def compute_dtype(self):
@@ -63,6 +66,11 @@ class UNet(nn.Module):
         cfg = self.config
         dt = cfg.compute_dtype
         ch = cfg.model_channels
+        SpatialT = (
+            nn.remat(SpatialTransformer, static_argnums=())
+            if cfg.remat
+            else SpatialTransformer
+        )
 
         emb = nn.Dense(ch * 4, dtype=dt, name="time_embed_0")(
             timestep_embedding(timesteps, ch).astype(dt)
@@ -91,7 +99,7 @@ class UNet(nn.Module):
             for i in range(cfg.num_res_blocks):
                 h = ResBlock(out_ch, dt, name=f"down_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:
-                    h = SpatialTransformer(
+                    h = SpatialT(
                         cfg.num_heads,
                         out_ch // cfg.num_heads,
                         cfg.transformer_depth[level],
@@ -107,7 +115,7 @@ class UNet(nn.Module):
         mid_ch = ch * cfg.channel_mult[-1]
         mid_depth = max(cfg.transformer_depth[-1], 1)
         h = ResBlock(mid_ch, dt, name="mid_res_0")(h, emb)
-        h = SpatialTransformer(
+        h = SpatialT(
             cfg.num_heads, mid_ch // cfg.num_heads, mid_depth, dt, name="mid_attn"
         )(h, context)
         h = ResBlock(mid_ch, dt, name="mid_res_1")(h, emb)
@@ -119,7 +127,7 @@ class UNet(nn.Module):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
                 h = ResBlock(out_ch, dt, name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:
-                    h = SpatialTransformer(
+                    h = SpatialT(
                         cfg.num_heads,
                         out_ch // cfg.num_heads,
                         cfg.transformer_depth[level],

@@ -1,0 +1,124 @@
+"""Delegate (orchestrator-only) master over real HTTP: the master
+dispatches but doesn't render; the collector output contains only the
+worker's image. Also verifies auto-fallback when no worker is given."""
+
+import asyncio
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils import config as config_mod
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _prompt():
+    return {
+        "1": {"class_type": "CheckpointLoaderSimple", "inputs": {"ckpt_name": "tiny-unet"}},
+        "2": {"class_type": "CLIPTextEncode", "inputs": {"text": "d", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode", "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "EmptyLatentImage", "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+        "5": {"class_type": "DistributedSeed", "inputs": {"seed": 21}},
+        "6": {"class_type": "KSampler", "inputs": {
+            "model": ["1", 0], "seed": ["5", 0], "steps": 1, "cfg": 1.0,
+            "sampler_name": "euler", "scheduler": "karras",
+            "positive": ["2", 0], "negative": ["3", 0],
+            "latent_image": ["4", 0], "denoise": 1.0}},
+        "7": {"class_type": "VAEDecode", "inputs": {"samples": ["6", 0], "vae": ["1", 2]}},
+        "8": {"class_type": "DistributedCollector", "inputs": {"images": ["7", 0]}},
+        "9": {"class_type": "PreviewImage", "inputs": {"images": ["8", 0]}},
+    }
+
+
+@pytest.fixture()
+def delegate_cluster(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    master_port, worker_port = _free_port(), _free_port()
+    config = config_mod.load_config()
+    config["workers"] = [
+        {"id": "w1", "name": "w1", "type": "remote", "host": "127.0.0.1",
+         "port": worker_port, "enabled": True, "tpu_chips": [], "extra_args": ""}
+    ]
+    config["master"]["host"] = "127.0.0.1"
+    config["settings"]["master_delegate_only"] = True
+    config_mod.save_config(config)
+
+    master = DistributedServer(port=master_port, is_worker=False)
+    worker = DistributedServer(port=worker_port, is_worker=True)
+
+    async def boot():
+        await master.start()
+        await worker.start()
+
+    asyncio.run_coroutine_threadsafe(boot(), loop_thread.loop).result(timeout=30)
+    yield master, master_port
+
+    async def teardown():
+        await master.stop()
+        await worker.stop()
+
+    asyncio.run_coroutine_threadsafe(teardown(), loop_thread.loop).result(timeout=30)
+    loop_thread.stop()
+
+
+def _wait_done(master_port, prompt_id, timeout=180):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        history = _get(f"http://127.0.0.1:{master_port}/history/{prompt_id}")
+        if history.get("done"):
+            return history
+        time.sleep(0.5)
+    raise AssertionError("prompt never finished")
+
+
+def test_delegate_master_collects_only_worker_images(delegate_cluster):
+    master, master_port = delegate_cluster
+    result = _post(
+        f"http://127.0.0.1:{master_port}/distributed/queue",
+        {"prompt": _prompt(), "client_id": "t", "workers": ["w1"]},
+    )
+    history = _wait_done(master_port, result["prompt_id"])
+    assert history["error"] is None, history["error"]
+    job = master._history[result["prompt_id"]]
+    images = np.asarray(list(job.outputs.values())[0][0]["images"])
+    # delegate master contributed no image; only the worker's arrived
+    assert images.shape == (1, 32, 32, 3)
+
+
+def test_delegate_falls_back_when_no_workers(delegate_cluster):
+    master, master_port = delegate_cluster
+    result = _post(
+        f"http://127.0.0.1:{master_port}/distributed/queue",
+        {"prompt": _prompt(), "client_id": "t", "workers": []},
+    )
+    history = _wait_done(master_port, result["prompt_id"])
+    assert history["error"] is None, history["error"]
+    job = master._history[result["prompt_id"]]
+    images = np.asarray(list(job.outputs.values())[0][0]["images"])
+    # master participated (fallback) and produced its own image
+    assert images.shape == (1, 32, 32, 3)

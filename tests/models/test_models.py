@@ -86,3 +86,27 @@ def test_tiny_dit_forward():
     out = dit.apply(params, x, jnp.array([100.0]), ctx)
     assert out.shape == x.shape
     np.testing.assert_array_equal(np.asarray(out), 0.0)  # zero-init final
+
+
+def test_remat_parity():
+    """remat=True must not change params or outputs (only memory)."""
+    import dataclasses
+
+    from comfyui_distributed_tpu.models.unet import UNet
+
+    base_cfg = get_config("tiny-unet")
+    cfg_r = dataclasses.replace(base_cfg, remat=True)
+    unet_a, unet_b = UNet(base_cfg), UNet(cfg_r)
+    x = jnp.ones((1, 16, 16, 4))
+    t = jnp.array([7.0])
+    ctx = jnp.ones((1, 8, base_cfg.context_dim))
+    params = unet_a.init(jax.random.key(0), x, t, ctx)
+    params_r = unet_b.init(jax.random.key(0), x, t, ctx)
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(params_r)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out_a = unet_a.apply(params, x, t, ctx)
+    out_b = unet_b.apply(params, x, t, ctx)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
