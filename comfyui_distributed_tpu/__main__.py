@@ -35,6 +35,11 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    # join the pod's shared JAX runtime when configured (no-op otherwise)
+    from .parallel.multihost import maybe_init_multihost
+
+    maybe_init_multihost()
+
     from .api.server import DistributedServer
     from .workers.monitor import start_master_watchdog
     from .workers.startup import (

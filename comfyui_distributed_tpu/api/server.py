@@ -41,6 +41,7 @@ class PromptJob:
         self.done = threading.Event()
         self.outputs: dict[str, Any] | None = None
         self.error: str | None = None
+        self.timings: dict[str, float] = {}
 
 
 class DistributedServer:
@@ -156,6 +157,7 @@ class DistributedServer:
                 "done": job.done.is_set(),
                 "error": job.error,
                 "outputs": _jsonable_outputs(job.outputs),
+                "timings": job.timings,
             }
         )
 
@@ -195,7 +197,9 @@ class DistributedServer:
             )
             try:
                 debug_log(f"executing prompt {job.prompt_id}")
-                job.outputs = GraphExecutor(ctx).execute(job.prompt)
+                executor = GraphExecutor(ctx)
+                job.outputs = executor.execute(job.prompt)
+                job.timings = executor.last_timings
             except Exception as exc:  # noqa: BLE001 - reported to client
                 job.error = f"{type(exc).__name__}: {exc}"
                 log(f"prompt {job.prompt_id} failed: {job.error}")

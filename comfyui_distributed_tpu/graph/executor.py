@@ -128,13 +128,19 @@ class GraphExecutor:
 
     def __init__(self, context: Optional[ExecutionContext] = None):
         self.context = context or ExecutionContext()
+        # per-node wall times of the last execution (observability the
+        # reference lacks — SURVEY §5 "no timing/profiler integration")
+        self.last_timings: dict[str, float] = {}
 
     def execute(self, prompt: Prompt) -> dict[str, Any]:
         """Run the graph; returns {node_id: output} for OUTPUT_NODE nodes."""
+        import time
+
         validate_prompt(prompt)
         order = _toposort(prompt)
         results: dict[str, tuple] = {}
         outputs: dict[str, Any] = {}
+        self.last_timings = {}
 
         for node_id in order:
             self.context.check_interrupted()
@@ -160,7 +166,9 @@ class GraphExecutor:
             fn = getattr(instance, cls.FUNCTION)
             if "context" in inspect.signature(fn).parameters:
                 kwargs["context"] = self.context
+            started = time.perf_counter()
             result = fn(**kwargs)
+            self.last_timings[node_id] = round(time.perf_counter() - started, 4)
             if result is None:
                 result = ()
             if not isinstance(result, tuple):

@@ -209,3 +209,61 @@ def t2v_parallel(
         jax.device_put(neg, NamedSharding(mesh, P())),
         frames, height, width, steps, float(cfg_scale),
     )
+
+
+# --- image-to-video -------------------------------------------------------
+
+def encode_frames(bundle: VideoPipelineBundle, frames: jax.Array) -> jax.Array:
+    """[B, F, H, W, 3] → [B, F, h, w, C] per-frame VAE encode."""
+    b, f = frames.shape[:2]
+    flat = frames.reshape((b * f,) + frames.shape[2:])
+    z = bundle.vae.apply(bundle.params["vae"], flat, method="encode")
+    return z.reshape((b, f) + z.shape[1:])
+
+
+@partial(
+    jax.jit,
+    static_argnames=("bundle_static", "frames", "steps", "cfg_scale"),
+)
+def _i2v_jit(
+    bundle_static, params, ref_latent, pos, neg, key,
+    frames: int, steps: int, cfg_scale: float,
+):
+    bundle = bundle_static.value
+    b = ref_latent.shape[0]
+    lh, lw, c = ref_latent.shape[2], ref_latent.shape[3], ref_latent.shape[4]
+    timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
+    noise_key, _ = jax.random.split(key)
+    noise = jax.random.normal(noise_key, (b, frames, lh, lw, c))
+    # known region = frame 0 carries the reference latent
+    known = jnp.concatenate(
+        [ref_latent, jnp.zeros((b, frames - 1, lh, lw, c))], axis=1
+    )
+    mask = jnp.zeros((1, frames, 1, 1, 1)).at[:, 0].set(1.0)
+    model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
+    latents = smp.sample_flow_masked(
+        model, noise, timesteps, (pos, neg), known, mask, noise
+    )
+    return decode_frames(bundle, latents)
+
+
+def i2v(
+    bundle: VideoPipelineBundle,
+    image: jax.Array,            # [B, H, W, 3] first frame
+    prompt: str,
+    negative_prompt: str = "",
+    frames: int = 16,
+    steps: int = 20,
+    cfg_scale: float = 5.0,
+    seed: int = 0,
+) -> jax.Array:
+    """Image-to-video: frame 0 is clamped to the input image's latent
+    along the flow path; returns [B, frames, H, W, 3] (the WAN i2v
+    workflow role, reference workflows/distributed-wan i2v variant)."""
+    ref = encode_frames(bundle, image[:, None])  # [B, 1, h, w, C]
+    pos = encode_video_text(bundle, [prompt])
+    neg = encode_video_text(bundle, [negative_prompt])
+    return _i2v_jit(
+        _Static(bundle), bundle.params, ref, pos, neg,
+        jax.random.key(seed), frames, steps, float(cfg_scale),
+    )

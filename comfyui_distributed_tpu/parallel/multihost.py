@@ -1,0 +1,79 @@
+"""Multi-host (pod) initialization over DCN.
+
+The reference scales across hosts by running independent ComfyUI
+processes and shipping PNGs over HTTP; a TPU pod instead joins all
+hosts into one JAX runtime: `jax.distributed.initialize` connects
+processes over DCN, after which `jax.devices()` spans the pod and the
+same mesh/sharding code paths drive ICI within a host and DCN across
+hosts. The elastic HTTP tier then treats the whole pod as ONE
+participant.
+
+Configuration via env (set by the pod launcher) or explicit args:
+    CDT_COORDINATOR        host:port of process 0
+    CDT_NUM_PROCESSES      total process count
+    CDT_PROCESS_ID         this process's index
+On Cloud TPU pods, bare `jax.distributed.initialize()` autodetects
+from the TPU metadata; that path is used when no env/args are given
+but CDT_MULTIHOST=1 is set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import log
+
+_initialized = False
+
+
+def maybe_init_multihost(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the distributed runtime if configured; returns True
+    when multi-host mode is active. Safe to call more than once."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("CDT_COORDINATOR")
+    num_str = os.environ.get("CDT_NUM_PROCESSES")
+    pid_str = os.environ.get("CDT_PROCESS_ID")
+    num_processes = num_processes if num_processes is not None else (
+        int(num_str) if num_str else None
+    )
+    process_id = process_id if process_id is not None else (
+        int(pid_str) if pid_str else None
+    )
+
+    import jax
+
+    if coordinator and num_processes is not None and process_id is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+        log(
+            f"multi-host runtime up: process {process_id}/{num_processes} "
+            f"via {coordinator}; {jax.device_count()} global device(s)"
+        )
+        return True
+    if os.environ.get("CDT_MULTIHOST") == "1":
+        # Cloud TPU pod autodetection path
+        jax.distributed.initialize()
+        _initialized = True
+        log(
+            f"multi-host runtime up (autodetected): process "
+            f"{jax.process_index()}/{jax.process_count()}"
+        )
+        return True
+    return False
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

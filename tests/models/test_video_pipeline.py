@@ -52,3 +52,29 @@ def test_video_workflow_in_graph():
     images = np.asarray(list(outputs.values())[0][0]["images"])
     # 8 participants x 4 frames, flattened to an IMAGE batch
     assert images.shape == (32, 32, 32, 3)
+
+
+def test_i2v_clamps_first_frame():
+    bundle = vp.load_video_pipeline("tiny-dit", seed=0)
+    img = np.random.default_rng(4).random((1, 32, 32, 3)).astype(np.float32)
+    out = vp.i2v(bundle, vp.jnp.asarray(img), "pan right", frames=4, steps=2, seed=1)
+    assert out.shape == (1, 4, 32, 32, 3)
+    arr = np.asarray(out)
+    assert np.isfinite(arr).all()
+    # frame 0 decodes the clamped reference latent: it must be much
+    # closer to the VAE round-trip of the input than later frames are
+    ref_rt = np.asarray(
+        vp.decode_frames(bundle, vp.encode_frames(bundle, vp.jnp.asarray(img)[:, None]))
+    )[0, 0]
+    d0 = np.abs(arr[0, 0] - ref_rt).mean()
+    d3 = np.abs(arr[0, 3] - ref_rt).mean()
+    assert d0 < d3
+
+
+def test_multihost_noop_without_config(monkeypatch):
+    from comfyui_distributed_tpu.parallel import multihost
+
+    for var in ("CDT_COORDINATOR", "CDT_NUM_PROCESSES", "CDT_PROCESS_ID", "CDT_MULTIHOST"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost.maybe_init_multihost() is False
+    assert multihost.is_multihost() is False
