@@ -369,3 +369,65 @@ class PreviewImage(SaveImage):
     def preview(self, images, context=None):
         # terminal sink; nothing persisted (worker-side pruned graphs end here)
         return ({"ui": {"images": []}, "images": images},)
+
+
+@register_node
+class UpscaleModelLoader:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"model_name": ("STRING", {"default": "4x-generic"})}}
+
+    RETURN_TYPES = ("UPSCALE_MODEL",)
+    FUNCTION = "load"
+
+    def load(self, model_name: str, context=None):
+        from ..models.upscaler import load_upscale_model
+
+        cache_key = f"upscaler:{model_name}"
+        cache = getattr(context, "pipelines", {}) if context is not None else {}
+        if cache_key not in cache:
+            cache[cache_key] = load_upscale_model(str(model_name))
+        return (cache[cache_key],)
+
+
+@register_node
+class ImageUpscaleWithModel:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "upscale_model": ("UPSCALE_MODEL",),
+                "image": ("IMAGE",),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "upscale"
+
+    def upscale(self, upscale_model, image, context=None):
+        return (upscale_model.upscale(image),)
+
+
+@register_node
+class VAEDecodeTiled(VAEDecode):
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "vae": ("VAE",),
+                "tile_size": ("INT", {"default": 512}),
+            }
+        }
+
+    FUNCTION = "decode_tiled"
+
+    def decode_tiled(self, samples, vae, tile_size=512, context=None):
+        from ..ops.tiled_vae import decode_tiled
+
+        latent_tile = max(16, int(tile_size) // vae.latent_scale)
+        out = decode_tiled(
+            pl._Static(vae), vae.params["vae"], samples["samples"],
+            tile=latent_tile, overlap=max(4, latent_tile // 8),
+        )
+        return (out,)

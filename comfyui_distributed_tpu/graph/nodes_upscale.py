@@ -54,6 +54,7 @@ class UltimateSDUpscaleDistributed:
                 "upscale_method": ("STRING", {"default": "bicubic"}),
                 "force_uniform_tiles": ("BOOLEAN", {"default": True}),
                 "dynamic_threshold": ("INT", {"default": 8}),
+                "upscale_model": ("UPSCALE_MODEL", {"default": None}),
             },
             "hidden": {
                 "is_worker": ("BOOLEAN", {"default": False}),
@@ -89,6 +90,7 @@ class UltimateSDUpscaleDistributed:
         upscale_method="bicubic",
         force_uniform_tiles=True,
         dynamic_threshold=8,
+        upscale_model=None,
         is_worker=False,
         worker_id="",
         master_url="",
@@ -111,6 +113,21 @@ class UltimateSDUpscaleDistributed:
         tile_h = int(tile_height)
         mesh = getattr(context, "mesh", None) if context is not None else None
         enabled = enabled_worker_ids or []
+
+        if upscale_model is not None:
+            # model-based pre-upscale to the exact target, then tiles
+            # refine at 1x (reference USDU upscale_model semantics).
+            # Deterministic per model name, so every participant
+            # reproduces the identical pre-upscaled image.
+            b, h, w, c = image.shape
+            target_h = int(round(h * float(upscale_by) / 8)) * 8
+            target_w = int(round(w * float(upscale_by) / 8)) * 8
+            image = upscale_model.upscale(image)
+            if image.shape[1] != target_h or image.shape[2] != target_w:
+                image = jax.image.resize(
+                    image, (b, target_h, target_w, c), method="cubic"
+                )
+            upscale_by = 1.0
 
         # Mode selection, decided identically on master and workers from
         # shared inputs (reference _determine_processing_mode): dynamic

@@ -110,6 +110,23 @@ def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
     return hidden
 
 
+def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
+    """Prompts → Conditioning with pooled vector (SDXL-class adm
+    conditioning: pooled text is part of the UNet's label embedding)."""
+    from ..ops.conditioning import Conditioning
+
+    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
+    hidden, pooled = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    from .registry import get_config
+
+    ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
+    if hidden.shape[-1] < ctx_dim:
+        hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
+    elif hidden.shape[-1] > ctx_dim:
+        hidden = hidden[..., :ctx_dim]
+    return Conditioning(context=hidden, pooled=pooled)
+
+
 # --- model fn (VP eps parameterisation) ----------------------------------
 
 def _make_model_fn(bundle: PipelineBundle, params):
@@ -136,7 +153,20 @@ def _make_model_fn(bundle: PipelineBundle, params):
             if feats.shape[0] == 1 and x.shape[0] > 1:
                 feats = jnp.broadcast_to(feats, (x.shape[0],) + feats.shape[1:])
             control = feats * cond.control_strength
-        out = bundle.unet.apply(params["unet"], x * c_in, t, context, control=control)
+        y = None
+        adm = getattr(get_config(bundle.model_name), "adm_in_channels", 0)
+        if adm and isinstance(cond, Conditioning) and cond.pooled is not None:
+            pooled = cond.pooled
+            if pooled.shape[-1] < adm:
+                pooled = jnp.pad(pooled, ((0, 0), (0, adm - pooled.shape[-1])))
+            elif pooled.shape[-1] > adm:
+                pooled = pooled[..., :adm]
+            if pooled.shape[0] != x.shape[0]:
+                pooled = jnp.broadcast_to(pooled[:1], (x.shape[0], pooled.shape[-1]))
+            y = pooled
+        out = bundle.unet.apply(
+            params["unet"], x * c_in, t, context, y=y, control=control
+        )
         return out.astype(x.dtype)
 
     return model_fn

@@ -55,6 +55,19 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             num_heads=2,
         ),
     },
+    # tiny SDXL-shaped variant: pooled (adm) conditioning path
+    "tiny-unet-adm": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=32,
+            channel_mult=(1, 2),
+            num_res_blocks=1,
+            transformer_depth=(1, 1),
+            context_dim=64,
+            num_heads=2,
+            adm_in_channels=32,
+        ),
+    },
     # --- video DiT backbones ---
     "wan-1.3b": {
         "family": "dit",

@@ -1,0 +1,94 @@
+"""Feed-forward super-resolution model (ESRGAN class).
+
+The reference's upscale workflows run an upscale model before tiled
+re-diffusion (ComfyUI UpscaleModelLoader + ImageUpscaleWithModel);
+this is the JAX equivalent: an RRDB-lite residual conv net with
+pixel-shuffle upsampling. Residual-to-bilinear output with zero-init
+final conv, so a random-init model reproduces bilinear resize exactly
+— distributed behavior stays testable without trained weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class UpscalerConfig:
+    scale: int = 4
+    channels: int = 64
+    num_blocks: int = 6
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class _ResidualBlock(nn.Module):
+    channels: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.channels, (3, 3), dtype=self.dtype, name="conv1")(x)
+        h = nn.leaky_relu(h, 0.2)
+        h = nn.Conv(self.channels, (3, 3), dtype=self.dtype, name="conv2")(h)
+        return x + 0.2 * h
+
+
+class SuperResolver(nn.Module):
+    config: UpscalerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """[B, H, W, 3] in [0,1] → [B, H*scale, W*scale, 3]."""
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b, h, w, c = x.shape
+        base = jax.image.resize(
+            x, (b, h * cfg.scale, w * cfg.scale, c), method="linear"
+        )
+        feat = nn.Conv(cfg.channels, (3, 3), dtype=dt, name="head")(
+            x.astype(dt) * 2.0 - 1.0
+        )
+        for i in range(cfg.num_blocks):
+            feat = _ResidualBlock(cfg.channels, dt, name=f"block_{i}")(feat)
+        # pixel-shuffle upsample
+        feat = nn.Conv(
+            c * cfg.scale * cfg.scale, (3, 3), dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros, name="tail",
+        )(feat.astype(jnp.float32))
+        feat = feat.reshape(b, h, w, cfg.scale, cfg.scale, c)
+        residual = feat.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, h * cfg.scale, w * cfg.scale, c
+        )
+        return jnp.clip(base + residual, 0.0, 1.0)
+
+
+@dataclasses.dataclass
+class UpscaleModelBundle:
+    name: str
+    module: SuperResolver
+    params: dict
+    scale: int
+
+    def upscale(self, image: jax.Array) -> jax.Array:
+        return self.module.apply(self.params, image)
+
+
+def load_upscale_model(name: str = "4x-generic", seed: int = 0) -> UpscaleModelBundle:
+    scale = 4
+    if name and name[0].isdigit() and "x" in name:
+        try:
+            scale = int(name.split("x")[0])
+        except ValueError:
+            scale = 4
+    cfg = UpscalerConfig(scale=scale)
+    module = SuperResolver(cfg)
+    params = module.init(jax.random.key(seed), jnp.zeros((1, 16, 16, 3)))
+    return UpscaleModelBundle(name=name, module=module, params=params, scale=scale)

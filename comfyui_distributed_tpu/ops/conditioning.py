@@ -42,6 +42,8 @@ class Conditioning:
     # itself is static metadata (hashable flax dataclass).
     control_params: Optional[dict] = None
     control_module: Any = None
+    # pooled text vector (SDXL adm conditioning), [B, width]
+    pooled: Optional[jax.Array] = None
 
     def clone(self) -> "Conditioning":
         # arrays are immutable in JAX; a shallow copy is a deep clone
@@ -157,13 +159,16 @@ import jax.tree_util as _jtu
 
 
 def _cond_flatten(cond: Conditioning):
-    children = (cond.context, cond.control_hint, cond.mask, cond.control_params)
+    children = (
+        cond.context, cond.control_hint, cond.mask, cond.control_params,
+        cond.pooled,
+    )
     aux = (cond.control_strength, cond.area, cond.control_module)
     return children, aux
 
 
 def _cond_unflatten(aux, children):
-    context, control_hint, mask, control_params = children
+    context, control_hint, mask, control_params, pooled = children
     control_strength, area, control_module = aux
     return Conditioning(
         context=context,
@@ -173,6 +178,7 @@ def _cond_unflatten(aux, children):
         mask=mask,
         control_params=control_params,
         control_module=control_module,
+        pooled=pooled,
     )
 
 

@@ -99,3 +99,23 @@ def test_node_mode_selection(bundle):
         tile_padding=16, context=ExecutionContext(),
     )
     assert out.shape == (1, 128, 128, 3)
+
+
+def test_node_with_upscale_model(bundle):
+    from comfyui_distributed_tpu.graph.nodes_upscale import (
+        UltimateSDUpscaleDistributed,
+    )
+    from comfyui_distributed_tpu.models.upscaler import load_upscale_model
+
+    node = UltimateSDUpscaleDistributed()
+    img = jnp.asarray(np.random.default_rng(3).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    (out,) = node.run(
+        image=img, model=bundle, positive=pos, negative=neg, vae=bundle,
+        seed=1, steps=1, cfg=1.0, sampler_name="euler", scheduler="karras",
+        denoise=0.3, upscale_by=2.0, tile_width=64, tile_height=64,
+        tile_padding=16, upscale_model=load_upscale_model("2x-test"),
+        context=ExecutionContext(),
+    )
+    assert out.shape == (1, 128, 128, 3)

@@ -78,3 +78,47 @@ def test_upscale_nearest():
     up = tiles.upscale_nearest(img, 2)
     assert up.shape == (1, 4, 4, 1)
     assert float(up[0, 0, 0, 0]) == 0.0 and float(up[0, 3, 3, 0]) == 3.0
+
+
+def test_tiled_vae_decode_shapes_and_rough_stats():
+    """Tiled decode matches full-decode shape; statistics stay in the
+    same regime (exact equality is impossible: GroupNorm stats are
+    per-tile — the inherent tiled-VAE approximation). The small-input
+    fast path must be exact."""
+    from comfyui_distributed_tpu.models import pipeline as pl
+    from comfyui_distributed_tpu.ops.tiled_vae import decode_tiled, encode_tiled
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    z = jnp.asarray(np.random.default_rng(5).random((1, 24, 24, 4)), jnp.float32)
+    full = bundle.vae.apply(bundle.params["vae"], z, method="decode")
+    tiled = decode_tiled(pl._Static(bundle), bundle.params["vae"], z,
+                         tile=16, overlap=4)
+    assert tiled.shape == full.shape
+    assert np.isfinite(np.asarray(tiled)).all()
+    assert abs(float(tiled.mean()) - float(full.mean())) < 0.2
+
+    # small input takes the exact single-pass fast path
+    z_small = z[:, :12, :12, :]
+    exact = decode_tiled(pl._Static(bundle), bundle.params["vae"], z_small,
+                         tile=16, overlap=4)
+    ref = bundle.vae.apply(bundle.params["vae"], z_small, method="decode")
+    np.testing.assert_allclose(np.asarray(exact), np.asarray(ref), atol=2e-2)  # jit vs eager bf16 fusion tolerance
+
+    px = jnp.asarray(np.random.default_rng(6).random((1, 96, 96, 3)), jnp.float32)
+    enc_full = bundle.vae.apply(bundle.params["vae"], px, method="encode")
+    enc_tiled = encode_tiled(pl._Static(bundle), bundle.params["vae"], px,
+                             tile=64, overlap=16)
+    assert enc_tiled.shape == enc_full.shape
+
+
+def test_upscale_model_random_init_is_bilinear():
+    from comfyui_distributed_tpu.models.upscaler import load_upscale_model
+    import jax
+
+    model = load_upscale_model("2x-test")
+    assert model.scale == 2
+    img = jnp.asarray(np.random.default_rng(7).random((1, 16, 16, 3)), jnp.float32)
+    out = model.upscale(img)
+    assert out.shape == (1, 32, 32, 3)
+    ref = jnp.clip(jax.image.resize(img, (1, 32, 32, 3), method="linear"), 0, 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
