@@ -194,6 +194,7 @@ class DistributedServer:
                 server=self,
                 interrupt_event=self._interrupt,
                 pipelines=self.execution_context.pipelines,
+                extras=self.execution_context.extras,  # node cache persists
             )
             try:
                 debug_log(f"executing prompt {job.prompt_id}")

@@ -133,7 +133,15 @@ class GraphExecutor:
         self.last_timings: dict[str, float] = {}
 
     def execute(self, prompt: Prompt) -> dict[str, Any]:
-        """Run the graph; returns {node_id: output} for OUTPUT_NODE nodes."""
+        """Run the graph; returns {node_id: output} for OUTPUT_NODE nodes.
+
+        Nodes re-execute only when their literal inputs or any upstream
+        node changed since the previous run on this context (ComfyUI's
+        incremental-execution behavior). Distributed/gather nodes and
+        output sinks always re-run — the reference forces the same via
+        IS_CHANGED = nan on its distributed nodes.
+        """
+        import json
         import time
 
         validate_prompt(prompt)
@@ -141,6 +149,10 @@ class GraphExecutor:
         results: dict[str, tuple] = {}
         outputs: dict[str, Any] = {}
         self.last_timings = {}
+        cache: dict[str, tuple[str, tuple]] = self.context.extras.setdefault(
+            "node_cache", {}
+        )
+        content_keys: dict[str, str] = {}
 
         for node_id in order:
             self.context.check_interrupted()
@@ -149,6 +161,29 @@ class GraphExecutor:
             instance = cls()
             schema = cls.INPUT_TYPES()
             kwargs: dict[str, Any] = {}
+
+            # content key: class + literal inputs + upstream keys
+            literals = {
+                k: v for k, v in node_def.get("inputs", {}).items()
+                if not is_link(v)
+            }
+            upstream_keys = sorted(
+                content_keys.get(v[0], "?")
+                for v in node_def.get("inputs", {}).values()
+                if is_link(v)
+            )
+            content_keys[node_id] = json.dumps(
+                [node_def["class_type"], literals, upstream_keys],
+                sort_keys=True, default=str,
+            )
+            cacheable = not getattr(cls, "OUTPUT_NODE", False) and not getattr(
+                cls, "NEVER_CACHE", False
+            )
+            cached = cache.get(node_id) if cacheable else None
+            if cached is not None and cached[0] == content_keys[node_id]:
+                results[node_id] = cached[1]
+                self.last_timings[node_id] = 0.0
+                continue
 
             # defaults first, then literal/link inputs
             for section in ("required", "optional"):
@@ -174,6 +209,8 @@ class GraphExecutor:
             if not isinstance(result, tuple):
                 result = (result,)
             results[node_id] = result
+            if cacheable:
+                cache[node_id] = (content_keys[node_id], result)
             if getattr(cls, "OUTPUT_NODE", False):
                 outputs[node_id] = result
         return outputs

@@ -24,6 +24,7 @@ class LoadAudio:
 
     RETURN_TYPES = ("AUDIO",)
     FUNCTION = "load"
+    NEVER_CACHE = True  # backing file can change between runs
 
     def load(self, audio: str, context=None):
         from .io_dirs import resolve_input_path

@@ -304,6 +304,7 @@ class LoadImage:
 
     RETURN_TYPES = ("IMAGE", "MASK")
     FUNCTION = "load"
+    NEVER_CACHE = True  # backing file can change between runs
 
     def load(self, image: str, context=None):
         from .io_dirs import resolve_input_path

@@ -244,6 +244,7 @@ class DistributedCollector:
 
     RETURN_TYPES = ("IMAGE", "AUDIO")
     FUNCTION = "run"
+    NEVER_CACHE = True  # network gather; reference forces re-exec
 
     def run(
         self,

@@ -66,9 +66,9 @@ class UltimateSDUpscaleDistributed:
 
     RETURN_TYPES = ("IMAGE",)
     FUNCTION = "run"
-
-    # IS_CHANGED = nan in the reference forces re-execution every queue;
-    # our executor has no cross-run cache yet, so every run re-executes.
+    # IS_CHANGED = nan parity: the reference forces re-execution every
+    # queue; NEVER_CACHE opts out of the executor's cross-run cache.
+    NEVER_CACHE = True
 
     def run(
         self,

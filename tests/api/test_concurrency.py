@@ -1,0 +1,111 @@
+"""Server robustness under concurrent queue submissions: all requests
+complete, order is FIFO on one executor, no cross-job state leaks."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _prompt(seed):
+    return {
+        "1": {"class_type": "CheckpointLoaderSimple", "inputs": {"ckpt_name": "tiny-unet"}},
+        "2": {"class_type": "CLIPTextEncode", "inputs": {"text": f"s{seed}", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode", "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "EmptyLatentImage", "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+        "5": {"class_type": "DistributedSeed", "inputs": {"seed": seed}},
+        "6": {"class_type": "KSampler", "inputs": {
+            "model": ["1", 0], "seed": ["5", 0], "steps": 1, "cfg": 1.0,
+            "sampler_name": "euler", "scheduler": "karras",
+            "positive": ["2", 0], "negative": ["3", 0],
+            "latent_image": ["4", 0], "denoise": 1.0}},
+        "7": {"class_type": "VAEDecode", "inputs": {"samples": ["6", 0], "vae": ["1", 2]}},
+        "8": {"class_type": "PreviewImage", "inputs": {"images": ["7", 0]}},
+    }
+
+
+@pytest.fixture()
+def solo_master(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    master = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(master.start(), loop_thread.loop).result(30)
+    yield master, port
+    asyncio.run_coroutine_threadsafe(master.stop(), loop_thread.loop).result(30)
+    loop_thread.stop()
+
+
+def test_concurrent_submissions_all_complete(solo_master):
+    master, port = solo_master
+    prompt_ids, errors = [], []
+    lock = threading.Lock()
+
+    def submit(i):
+        try:
+            out = _post(
+                f"http://127.0.0.1:{port}/prompt",
+                {"prompt": _prompt(i), "prompt_id": f"cc_{i}"},
+            )
+            with lock:
+                prompt_ids.append(out["prompt_id"])
+        except Exception as exc:  # noqa: BLE001
+            with lock:
+                errors.append(str(exc))
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert len(prompt_ids) == 6
+
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        done = [
+            _get(f"http://127.0.0.1:{port}/history/{pid}").get("done")
+            for pid in prompt_ids
+        ]
+        if all(done):
+            break
+        time.sleep(0.5)
+    assert all(done), f"not all finished: {done}"
+    for pid in prompt_ids:
+        history = _get(f"http://127.0.0.1:{port}/history/{pid}")
+        assert history["error"] is None, (pid, history["error"])
+
+    # different seeds/prompts ⇒ different images (no cross-job leakage)
+    images = [
+        np.asarray(list(master._history[pid].outputs.values())[0][0]["images"])
+        for pid in prompt_ids
+    ]
+    assert len({img.tobytes() for img in images}) == len(images)

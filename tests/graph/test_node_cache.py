@@ -1,0 +1,109 @@
+"""Cross-run node caching: unchanged subgraphs skip re-execution;
+input changes invalidate downstream; distributed nodes never cache."""
+
+import numpy as np
+
+from comfyui_distributed_tpu.graph import ExecutionContext, GraphExecutor
+from comfyui_distributed_tpu.graph.registry import register_node
+
+
+@register_node
+class _CountingNode:
+    CALLS = 0
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"value": ("INT", {"default": 1})}}
+
+    RETURN_TYPES = ("INT",)
+    FUNCTION = "run"
+
+    def run(self, value, context=None):
+        _CountingNode.CALLS += 1
+        return (int(value) * 2,)
+
+
+@register_node
+class _CountingSink:
+    CALLS = 0
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"value": ("INT",)}}
+
+    RETURN_TYPES = ()
+    FUNCTION = "run"
+    OUTPUT_NODE = True
+
+    def run(self, value, context=None):
+        _CountingSink.CALLS += 1
+        return ({"ui": {"value": value}},)
+
+
+def _prompt(value=3):
+    return {
+        "1": {"class_type": "_CountingNode", "inputs": {"value": value}},
+        "2": {"class_type": "_CountingSink", "inputs": {"value": ["1", 0]}},
+    }
+
+
+def test_unchanged_node_cached_across_runs():
+    _CountingNode.CALLS = 0
+    _CountingSink.CALLS = 0
+    ctx = ExecutionContext()
+    executor = GraphExecutor(ctx)
+    out1 = executor.execute(_prompt())
+    out2 = executor.execute(_prompt())
+    assert _CountingNode.CALLS == 1          # cached second time
+    assert _CountingSink.CALLS == 2          # output sinks always run
+    assert executor.last_timings["1"] == 0.0
+    assert out1["2"][0]["ui"]["value"] == out2["2"][0]["ui"]["value"] == 6
+
+
+def test_literal_change_invalidates():
+    _CountingNode.CALLS = 0
+    ctx = ExecutionContext()
+    executor = GraphExecutor(ctx)
+    executor.execute(_prompt(3))
+    executor.execute(_prompt(4))
+    assert _CountingNode.CALLS == 2
+
+
+def test_upstream_change_invalidates_downstream():
+    @register_node
+    class _CountingMid:
+        CALLS = 0
+
+        @classmethod
+        def INPUT_TYPES(cls):
+            return {"required": {"value": ("INT",)}}
+
+        RETURN_TYPES = ("INT",)
+        FUNCTION = "run"
+
+        def run(self, value, context=None):
+            _CountingMid.CALLS += 1
+            return (value + 1,)
+
+    prompt = {
+        "1": {"class_type": "_CountingNode", "inputs": {"value": 3}},
+        "m": {"class_type": "_CountingMid", "inputs": {"value": ["1", 0]}},
+        "2": {"class_type": "_CountingSink", "inputs": {"value": ["m", 0]}},
+    }
+    ctx = ExecutionContext()
+    executor = GraphExecutor(ctx)
+    executor.execute(prompt)
+    assert _CountingMid.CALLS == 1
+    prompt2 = {**prompt, "1": {"class_type": "_CountingNode", "inputs": {"value": 9}}}
+    executor.execute(prompt2)
+    assert _CountingMid.CALLS == 2  # upstream change rippled down
+
+
+def test_distributed_nodes_never_cache():
+    from comfyui_distributed_tpu.graph.nodes_distributed import DistributedCollector
+    from comfyui_distributed_tpu.graph.nodes_upscale import (
+        UltimateSDUpscaleDistributed,
+    )
+
+    assert DistributedCollector.NEVER_CACHE is True
+    assert UltimateSDUpscaleDistributed.NEVER_CACHE is True
