@@ -27,7 +27,6 @@ from ...graph.prompt import (
     prune_prompt_for_worker,
 )
 from ...utils import config as config_mod
-from ...utils.exceptions import WorkerNotAvailableError
 from ...utils.logging import log
 from ...utils.network import build_master_callback_url
 from ...utils.trace_logger import generate_trace_id, trace_info

@@ -20,7 +20,7 @@ from typing import Any
 
 from aiohttp import WSMsgType, web
 
-from ..utils.logging import debug_log, log
+from ..utils.logging import log
 
 
 def register(app: web.Application, server) -> None:

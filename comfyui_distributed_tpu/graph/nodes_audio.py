@@ -12,7 +12,6 @@ import os
 
 import numpy as np
 
-from ..utils import audio_payload as audio_utils
 from .registry import register_node
 
 

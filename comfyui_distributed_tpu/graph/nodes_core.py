@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +28,6 @@ import numpy as np
 
 from ..models import pipeline as pl
 from ..ops import samplers as smp
-from ..parallel.generation import txt2img_parallel
 from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..utils import image as img_utils
 from ..utils.logging import log

@@ -18,7 +18,7 @@ DistributedEmptyImage, DistributedCollector. Roles:
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ import jax
 
 from ..models import pipeline as pl
 from ..ops import upscale as upscale_ops
-from ..parallel.mesh import data_axis_size
 from ..utils.logging import log
 from .registry import register_node
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 import copy
 import time
 import uuid
-from typing import Any, Callable, Iterable
+from typing import Any, Callable
 
 Prompt = dict[str, dict[str, Any]]
 

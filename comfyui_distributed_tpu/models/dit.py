@@ -12,7 +12,6 @@ on a v5e-8; wan-14b-class FSDP-shards across a v5p-16 (BASELINE.md).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import flax.linen as nn
 import jax

@@ -26,7 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import samplers as smp
 from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..parallel.seeds import participant_keys
-from .pipeline import _Static, encode_text
+from .pipeline import _Static
 from .registry import create_model, get_config
 from .text_encoder import Tokenizer
 

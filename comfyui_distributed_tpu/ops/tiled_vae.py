@@ -17,7 +17,6 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-import jax.numpy as jnp
 
 from . import tiles as tile_ops
 

@@ -13,10 +13,8 @@ batch axis). No prompt rewriting, no HTTP, no base64.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

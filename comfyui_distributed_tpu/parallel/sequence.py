@@ -18,11 +18,10 @@ from functools import partial
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.dit import DiTConfig, VideoDiT
-from .mesh import DATA_AXIS, data_axis_size
+from .mesh import DATA_AXIS
 
 
 @partial(jax.jit, static_argnames=("config", "mesh_static", "axis"))

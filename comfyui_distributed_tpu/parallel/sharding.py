@@ -10,7 +10,7 @@ all-gathers/reduce-scatters.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import numpy as np

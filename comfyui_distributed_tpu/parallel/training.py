@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import pipeline as pl
 from ..ops import samplers as smp
 from .mesh import DATA_AXIS
-from .sharding import param_specs, shard_params
+from .sharding import shard_params
 
 
 @dataclasses.dataclass

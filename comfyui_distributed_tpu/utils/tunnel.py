@@ -21,7 +21,6 @@ import re
 import shutil
 import subprocess
 import threading
-import time
 from typing import Any, Optional
 
 from . import config as config_mod

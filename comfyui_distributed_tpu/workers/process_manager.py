@@ -15,7 +15,6 @@ from __future__ import annotations
 import datetime
 import os
 import shlex
-import signal
 import subprocess
 import sys
 import threading
