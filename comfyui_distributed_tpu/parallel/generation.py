@@ -15,7 +15,6 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import pipeline as pl

@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models import pipeline as pl
 from ..ops import samplers as smp
 from .mesh import DATA_AXIS
 from .sharding import shard_params
