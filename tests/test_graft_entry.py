@@ -1,0 +1,41 @@
+"""Driver contract: dryrun_multichip executes a sharded train step on
+the virtual mesh, and every bundled workflow validates against the
+node registry (schema drift guard)."""
+
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_8():
+    import sys
+
+    sys.path.insert(0, REPO_ROOT)
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(8)  # asserts finite loss internally
+
+
+def test_dryrun_multichip_odd_count():
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(1)
+
+
+@pytest.mark.parametrize(
+    "name",
+    sorted(
+        f
+        for f in os.listdir(os.path.join(REPO_ROOT, "workflows"))
+        if f.endswith(".json")
+    ),
+)
+def test_bundled_workflows_validate(name):
+    from comfyui_distributed_tpu.graph import validate_prompt
+
+    with open(os.path.join(REPO_ROOT, "workflows", name)) as fh:
+        prompt = json.load(fh)
+    validate_prompt(prompt)
