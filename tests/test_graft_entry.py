@@ -25,6 +25,25 @@ def test_dryrun_multichip_odd_count():
     graft.dryrun_multichip(1)
 
 
+def test_dryrun_multichip_clean_env_subprocess():
+    """The driver-environment contract: with NO XLA_FLAGS and NO
+    JAX_PLATFORMS in the env (and a possibly-wedged TPU plugin
+    present), dryrun_multichip must pin the CPU backend itself and
+    provision its own virtual devices (round-1 regression: rc=124)."""
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=280,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip(8)" in proc.stdout
+
+
 @pytest.mark.parametrize(
     "name",
     sorted(
