@@ -1,0 +1,201 @@
+"""CLIP byte-level BPE tokenizer (reference-exact semantics).
+
+The reference free-rides on ComfyUI's bundled CLIP tokenizer for all
+text conditioning (reference workflows' CLIPTextEncode nodes); here
+the algorithm is implemented natively and the vocab is a committed
+asset.
+
+Semantics mirror the canonical CLIP tokenizer in its no-ftfy
+configuration (the one transformers falls back to when ftfy is not
+installed): control-char removal + whitespace cleanup + NFC
+normalization + lowercasing (accents kept, punctuation kept attached),
+then the CLIP pre-tokenization regex, GPT-2 byte→unicode encoding, and
+greedy rank-ordered BPE merges with a ``</w>`` end-of-word suffix.
+Parity is enforced by tests/models/test_clip_bpe.py, which runs
+``transformers.CLIPTokenizer`` over the same vocab files and asserts
+identical ids.
+
+Vocab files: standard CLIP pair ``vocab.json`` + ``merges.txt``
+(gzipped variants supported). The committed fallback pair under
+``models/assets/clip_vocab/`` has CLIP's exact id layout (512 byte
+units, 48894 merges, BOS=49406, EOS=49407) but merges trained on
+build-host prose — dropping in OpenAI's real files (same format) via
+``CDT_CLIP_VOCAB`` gives exact CLIP ids with no code change.
+"""
+
+from __future__ import annotations
+
+import functools
+import gzip
+import json
+import os
+import unicodedata
+
+import regex
+
+_ASSET_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "assets", "clip_vocab")
+
+# CLIP's pre-tokenization pattern (case-insensitive).
+_PATTERN = regex.compile(
+    r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|"""
+    r"""[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+""",
+    regex.IGNORECASE,
+)
+
+# CLIP caps the merge table at 49152-256-2 entries regardless of file length.
+_MAX_MERGES = 49152 - 256 - 2
+
+
+@functools.lru_cache
+def bytes_to_unicode() -> dict[int, str]:
+    """GPT-2/CLIP reversible byte→printable-unicode table."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(2**8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2**8 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rt", encoding="utf-8")
+    return open(path, encoding="utf-8")
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF
+        or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF
+        or 0x2A700 <= cp <= 0x2B73F
+        or 0x2B740 <= cp <= 0x2B81F
+        or 0x2B820 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF
+        or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
+def clean_text(text: str) -> str:
+    """CLIP's no-ftfy normalization: strip control chars, space out CJK,
+    NFC-normalize, collapse whitespace, lowercase (accents kept)."""
+    out = []
+    for ch in text:
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or _is_control(ch):
+            continue
+        if ch.isspace() or unicodedata.category(ch) == "Zs":
+            out.append(" ")
+        elif _is_cjk(cp):
+            out.append(f" {ch} ")
+        else:
+            out.append(ch)
+    text = unicodedata.normalize("NFC", "".join(out))
+    return " ".join(text.lower().split())
+
+
+class ClipBPE:
+    """Encoder over a CLIP-format vocab.json + merges.txt pair."""
+
+    def __init__(self, vocab_dir: str | None = None):
+        vocab_dir = vocab_dir or _ASSET_DIR
+        self.vocab_dir = vocab_dir
+        with _open_maybe_gz(os.path.join(vocab_dir, "vocab.json")) as fh:
+            self.encoder: dict[str, int] = json.load(fh)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with _open_maybe_gz(os.path.join(vocab_dir, "merges.txt")) as fh:
+            lines = fh.read().strip().split("\n")
+        merges = [
+            tuple(ln.split()) for ln in lines[1 : _MAX_MERGES + 1]
+        ]  # line 0 is the "#version" header
+        self.bpe_ranks: dict[tuple[str, str], int] = {
+            m: i for i, m in enumerate(merges)
+        }
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.bos_id = self.encoder["<|startoftext|>"]
+        self.eos_id = self.encoder["<|endoftext|>"]
+        # specials pass through BPE unsplit (canonical CLIP cache seed)
+        self._cache: dict[str, str] = {
+            "<|startoftext|>": "<|startoftext|>",
+            "<|endoftext|>": "<|endoftext|>",
+        }
+
+    def _bpe(self, token: str) -> str:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        if len(word) == 1:
+            self._cache[token] = word[0]
+            return word[0]
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            bigram = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    merged.extend(word[i:])
+                    break
+                merged.extend(word[i:j])
+                i = j
+                if word[i] == first and i < len(word) - 1 and word[i + 1] == second:
+                    merged.append(first + second)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        result = " ".join(word)
+        self._cache[token] = result
+        return result
+
+    def encode_text(self, text: str) -> list[int]:
+        """Text → BPE ids (no specials, no padding)."""
+        ids: list[int] = []
+        for token in _PATTERN.findall(clean_text(text)):
+            mapped = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
+            for piece in self._bpe(mapped).split(" "):
+                ids.append(self.encoder.get(piece, self.eos_id))
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        text = "".join(
+            self.decoder.get(i, "") for i in ids
+            if i not in (self.bos_id, self.eos_id)
+        )
+        data = bytearray(
+            self.byte_decoder[c] for c in text if c in self.byte_decoder
+        )
+        return data.decode("utf-8", errors="replace").replace("</w>", " ").strip()
+
+
+@functools.lru_cache(maxsize=4)
+def _get_bpe_cached(vocab_dir: str) -> ClipBPE:
+    return ClipBPE(vocab_dir)
+
+
+def get_bpe(vocab_dir: str | None = None) -> ClipBPE:
+    # env var resolved here, outside the cache key, so setting
+    # CDT_CLIP_VOCAB between pipeline builds takes effect
+    resolved = vocab_dir or os.environ.get("CDT_CLIP_VOCAB") or _ASSET_DIR
+    return _get_bpe_cached(resolved)

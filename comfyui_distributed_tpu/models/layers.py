@@ -86,9 +86,12 @@ class GEGLU(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        # half order + exact gelu match the SD checkpoint convention
+        # (value half first, gate half second) so real ff.net.0.proj
+        # weights load without permutation
         x = nn.Dense(self.dim_out * 2, dtype=self.dtype)(x)
-        gate, val = jnp.split(x, 2, axis=-1)
-        return val * nn.gelu(gate)
+        val, gate = jnp.split(x, 2, axis=-1)
+        return val * nn.gelu(gate, approximate=False)
 
 
 class FeedForward(nn.Module):
@@ -111,14 +114,16 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        # eps=1e-5 matches torch LayerNorm (flax default is 1e-6) so
+        # real SD weights reproduce reference activations
         x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn1")(
-            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         )
         x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn2")(
-            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype), context
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype), context
         )
         x = x + FeedForward(dtype=self.dtype, name="ff")(
-            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         )
         return x
 
@@ -175,8 +180,12 @@ class Downsample(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        # symmetric (1,1) padding = the SD UNet downsample convention
+        # (torch Conv2d padding=1); flax SAME would pad (0,1) and
+        # misalign real checkpoint weights
         return nn.Conv(
-            x.shape[-1], (3, 3), strides=(2, 2), dtype=self.dtype, name="op"
+            x.shape[-1], (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+            dtype=self.dtype, name="op",
         )(x)
 
 

@@ -40,12 +40,17 @@ def load_pipeline(
     vae_name: str | None = None,
     te_name: str | None = None,
     seed: int = 0,
+    checkpoint: str | None = None,
 ) -> PipelineBundle:
-    """Build a pipeline with deterministic random-init weights.
+    """Build a pipeline; load real weights when a checkpoint resolves.
 
-    Weight loading from safetensors checkpoints plugs in here once
-    real weights are provided; the distributed machinery upstream is
-    weight-agnostic.
+    Checkpoint resolution order: explicit `checkpoint` arg, then
+    `CDT_CHECKPOINT_DIR/<model_name>.{safetensors,ckpt}` (the dir env
+    var may also point directly at a file). Single-file SD layout
+    (model.diffusion_model / first_stage_model / cond_stage_model) is
+    mapped key-by-key into the flax trees (models/sd_checkpoint.py).
+    Without a checkpoint the weights are deterministic random init —
+    the distributed machinery upstream is weight-agnostic.
     """
     tiny = model_name.startswith("tiny")
     vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
@@ -76,6 +81,22 @@ def load_pipeline(
     tokens = jnp.zeros((1, te_cfg.max_length), jnp.int32)
     te_params = te.init(k_te, tokens)
 
+    from . import sd_checkpoint as sdc
+
+    ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
+    if ckpt_path:
+        from ..utils.logging import log
+
+        log(f"loading checkpoint {ckpt_path} for {model_name}")
+        state_dict = sdc.read_checkpoint(ckpt_path)
+        mapped, _problems = sdc.load_sd_weights(
+            state_dict, unet_cfg, vae_cfg, te_cfg,
+            {"unet": unet_params, "vae": vae_params, "te": te_params},
+        )
+        unet_params = mapped["unet"]
+        vae_params = mapped["vae"]
+        te_params = mapped["te"]
+
     return PipelineBundle(
         model_name=model_name,
         unet=unet,
@@ -99,7 +120,9 @@ def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
     here when dual-encoder checkpoints are supported.
     """
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
-    hidden, _pooled = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    hidden, _pooled = bundle.text_encoder.apply(
+        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
+    )
     from .registry import get_config
 
     ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
@@ -116,7 +139,9 @@ def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
     from ..ops.conditioning import Conditioning
 
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
-    hidden, pooled = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    hidden, pooled = bundle.text_encoder.apply(
+        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
+    )
     from .registry import get_config
 
     ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])

@@ -39,7 +39,7 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             channel_mult=(1, 2, 4),
             transformer_depth=(0, 2, 10),
             context_dim=2048,
-            num_heads=20,
+            head_dim=64,  # SDXL num_head_channels convention
             adm_in_channels=2816,
             remat=True,
         ),

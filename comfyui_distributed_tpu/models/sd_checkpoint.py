@@ -1,0 +1,449 @@
+"""SD-checkpoint key mapping: original LDM/SD state dicts → flax trees.
+
+The capability the reference gets for free from ComfyUI's
+CheckpointLoaderSimple (reference upscale/tile_ops.py:168 imports
+ComfyUI's loaders): a single-file SD checkpoint — the
+`model.diffusion_model.* / first_stage_model.* / cond_stage_model.*`
+layout — loads into this framework's UNet/VAE/TextEncoder flax trees.
+
+Design: each architecture has an explicit, enumerable *key schedule* —
+a deterministic function config → [(sd_key, flax_path, kind)] — so the
+mapping is testable without any checkpoint present (tests invert the
+schedule to synthesize a checkpoint and round-trip it). Transforms:
+
+    conv    torch [O,I,kh,kw]  → flax [kh,kw,I,O]
+    linear  torch [O,I]        → flax [I,O]
+    proj    conv1x1 OR linear  → flax dense [I,O] (detected by ndim —
+            SD1.5 spatial-transformer proj_in/out are 1x1 convs,
+            SDXL's are linears)
+    norm    weight/bias        → scale/bias (direct)
+    direct  as-is (embeddings, position tables)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable
+
+import numpy as np
+
+# (sd_key_prefix, flax_path_prefix, kind); each entry expands to the
+# weight (+bias where the kind carries one) parameter pair.
+Entry = tuple[str, str, str]
+
+_NORM = "norm"
+_CONV = "conv"
+_LINEAR = "linear"
+_LINEAR_NOBIAS = "linear_nobias"
+_PROJ = "proj"
+_DIRECT = "direct"
+
+
+# --- key schedules --------------------------------------------------------
+
+def _resblock(sd: str, fx: str, has_skip: bool) -> list[Entry]:
+    entries = [
+        (f"{sd}.in_layers.0", f"{fx}/norm1/GroupNorm_0", _NORM),
+        (f"{sd}.in_layers.2", f"{fx}/conv1", _CONV),
+        (f"{sd}.emb_layers.1", f"{fx}/emb_proj", _LINEAR),
+        (f"{sd}.out_layers.0", f"{fx}/norm2/GroupNorm_0", _NORM),
+        (f"{sd}.out_layers.3", f"{fx}/conv2", _CONV),
+    ]
+    if has_skip:
+        entries.append((f"{sd}.skip_connection", f"{fx}/skip", _CONV))
+    return entries
+
+
+def _spatial_transformer(sd: str, fx: str, depth: int) -> list[Entry]:
+    entries = [
+        (f"{sd}.norm", f"{fx}/norm/GroupNorm_0", _NORM),
+        (f"{sd}.proj_in", f"{fx}/proj_in", _PROJ),
+    ]
+    for i in range(depth):
+        tb, fb = f"{sd}.transformer_blocks.{i}", f"{fx}/block_{i}"
+        entries += [
+            (f"{tb}.norm1", f"{fb}/LayerNorm_0", _NORM),
+            (f"{tb}.attn1.to_q", f"{fb}/attn1/to_q", _LINEAR_NOBIAS),
+            (f"{tb}.attn1.to_k", f"{fb}/attn1/to_k", _LINEAR_NOBIAS),
+            (f"{tb}.attn1.to_v", f"{fb}/attn1/to_v", _LINEAR_NOBIAS),
+            (f"{tb}.attn1.to_out.0", f"{fb}/attn1/to_out", _LINEAR),
+            (f"{tb}.norm2", f"{fb}/LayerNorm_1", _NORM),
+            (f"{tb}.attn2.to_q", f"{fb}/attn2/to_q", _LINEAR_NOBIAS),
+            (f"{tb}.attn2.to_k", f"{fb}/attn2/to_k", _LINEAR_NOBIAS),
+            (f"{tb}.attn2.to_v", f"{fb}/attn2/to_v", _LINEAR_NOBIAS),
+            (f"{tb}.attn2.to_out.0", f"{fb}/attn2/to_out", _LINEAR),
+            (f"{tb}.norm3", f"{fb}/LayerNorm_2", _NORM),
+            (f"{tb}.ff.net.0.proj", f"{fb}/ff/GEGLU_0/Dense_0", _LINEAR),
+            (f"{tb}.ff.net.2", f"{fb}/ff/Dense_0", _LINEAR),
+        ]
+    entries.append((f"{sd}.proj_out", f"{fx}/proj_out", _PROJ))
+    return entries
+
+
+def unet_schedule(cfg) -> list[Entry]:
+    """SD UNet (`model.diffusion_model.*`) → UNet flax tree.
+
+    Reproduces the input/middle/output_blocks numbering of the original
+    openai-guided-diffusion layout used by every SD1.x/SDXL checkpoint.
+    """
+    p = "model.diffusion_model"
+    ch = cfg.model_channels
+    entries: list[Entry] = [
+        (f"{p}.time_embed.0", "time_embed_0", _LINEAR),
+        (f"{p}.time_embed.2", "time_embed_2", _LINEAR),
+    ]
+    if cfg.adm_in_channels:
+        entries += [
+            (f"{p}.label_emb.0.0", "label_embed_0", _LINEAR),
+            (f"{p}.label_emb.0.2", "label_embed_2", _LINEAR),
+        ]
+    entries.append((f"{p}.input_blocks.0.0", "input_conv", _CONV))
+
+    # down path
+    n = 1
+    in_ch = ch
+    for level, mult in enumerate(cfg.channel_mult):
+        out_ch = ch * mult
+        for i in range(cfg.num_res_blocks):
+            sd = f"{p}.input_blocks.{n}.0"
+            entries += _resblock(sd, f"down_{level}_res_{i}", in_ch != out_ch)
+            if cfg.transformer_depth[level] > 0:
+                entries += _spatial_transformer(
+                    f"{p}.input_blocks.{n}.1",
+                    f"down_{level}_attn_{i}",
+                    cfg.transformer_depth[level],
+                )
+            in_ch = out_ch
+            n += 1
+        if level != len(cfg.channel_mult) - 1:
+            entries.append((f"{p}.input_blocks.{n}.0.op", f"down_{level}_ds/op", _CONV))
+            n += 1
+
+    # middle
+    mid_depth = max(cfg.transformer_depth[-1], 1)
+    entries += _resblock(f"{p}.middle_block.0", "mid_res_0", False)
+    entries += _spatial_transformer(f"{p}.middle_block.1", "mid_attn", mid_depth)
+    entries += _resblock(f"{p}.middle_block.2", "mid_res_1", False)
+
+    # up path — skip-concat means every ResBlock has a channel change,
+    # hence a skip_connection, except where concat(in)+skip == out
+    n = 0
+    skip_chs = [ch]
+    for level, mult in enumerate(cfg.channel_mult):
+        for _ in range(cfg.num_res_blocks):
+            skip_chs.append(ch * mult)
+        if level != len(cfg.channel_mult) - 1:
+            skip_chs.append(ch * mult)
+    h_ch = ch * cfg.channel_mult[-1]
+    for level, mult in reversed(list(enumerate(cfg.channel_mult))):
+        out_ch = ch * mult
+        for i in range(cfg.num_res_blocks + 1):
+            concat_ch = h_ch + skip_chs.pop()
+            sd = f"{p}.output_blocks.{n}.0"
+            entries += _resblock(sd, f"up_{level}_res_{i}", concat_ch != out_ch)
+            has_attn = cfg.transformer_depth[level] > 0
+            if has_attn:
+                entries += _spatial_transformer(
+                    f"{p}.output_blocks.{n}.1",
+                    f"up_{level}_attn_{i}",
+                    cfg.transformer_depth[level],
+                )
+            if level != 0 and i == cfg.num_res_blocks:
+                idx = 2 if has_attn else 1
+                entries.append(
+                    (f"{p}.output_blocks.{n}.{idx}.conv", f"up_{level}_us/conv", _CONV)
+                )
+            h_ch = out_ch
+            n += 1
+
+    entries += [
+        (f"{p}.out.0", "out_norm/GroupNorm_0", _NORM),
+        (f"{p}.out.2", "out_conv", _CONV),
+    ]
+    return entries
+
+
+def _vae_resblock(sd: str, fx: str, has_skip: bool) -> list[Entry]:
+    entries = [
+        (f"{sd}.norm1", f"{fx}/norm1/GroupNorm_0", _NORM),
+        (f"{sd}.conv1", f"{fx}/conv1", _CONV),
+        (f"{sd}.norm2", f"{fx}/norm2/GroupNorm_0", _NORM),
+        (f"{sd}.conv2", f"{fx}/conv2", _CONV),
+    ]
+    if has_skip:
+        entries.append((f"{sd}.nin_shortcut", f"{fx}/skip", _CONV))
+    return entries
+
+
+def _vae_mid(sd: str, fx: str) -> list[Entry]:
+    return (
+        _vae_resblock(f"{sd}.block_1", f"{fx}/mid_res_0", False)
+        + [
+            (f"{sd}.attn_1.norm", f"{fx}/mid_attn/norm/GroupNorm_0", _NORM),
+            (f"{sd}.attn_1.q", f"{fx}/mid_attn/q", _PROJ),
+            (f"{sd}.attn_1.k", f"{fx}/mid_attn/k", _PROJ),
+            (f"{sd}.attn_1.v", f"{fx}/mid_attn/v", _PROJ),
+            (f"{sd}.attn_1.proj_out", f"{fx}/mid_attn/proj", _PROJ),
+        ]
+        + _vae_resblock(f"{sd}.block_2", f"{fx}/mid_res_1", False)
+    )
+
+
+def vae_schedule(cfg) -> list[Entry]:
+    """SD AutoencoderKL (`first_stage_model.*`) → VAE flax tree."""
+    p = "first_stage_model"
+    bc = cfg.base_channels
+    entries: list[Entry] = [(f"{p}.encoder.conv_in", "encoder/conv_in", _CONV)]
+
+    in_ch = bc
+    for level, mult in enumerate(cfg.channel_mult):
+        out_ch = bc * mult
+        for i in range(cfg.num_res_blocks):
+            entries += _vae_resblock(
+                f"{p}.encoder.down.{level}.block.{i}",
+                f"encoder/down_{level}_res_{i}",
+                in_ch != out_ch,
+            )
+            in_ch = out_ch
+        if level != len(cfg.channel_mult) - 1:
+            entries.append(
+                (
+                    f"{p}.encoder.down.{level}.downsample.conv",
+                    f"encoder/down_{level}_ds",
+                    _CONV,
+                )
+            )
+    entries += _vae_mid(f"{p}.encoder.mid", "encoder")
+    entries += [
+        (f"{p}.encoder.norm_out", "encoder/norm_out/GroupNorm_0", _NORM),
+        (f"{p}.encoder.conv_out", "encoder/conv_out", _CONV),
+        (f"{p}.quant_conv", "quant_conv", _CONV),
+        (f"{p}.post_quant_conv", "post_quant_conv", _CONV),
+        (f"{p}.decoder.conv_in", "decoder/conv_in", _CONV),
+    ]
+    entries += _vae_mid(f"{p}.decoder.mid", "decoder")
+    top_ch = bc * cfg.channel_mult[-1]
+    in_ch = top_ch
+    for level, mult in reversed(list(enumerate(cfg.channel_mult))):
+        out_ch = bc * mult
+        for i in range(cfg.num_res_blocks + 1):
+            entries += _vae_resblock(
+                f"{p}.decoder.up.{level}.block.{i}",
+                f"decoder/up_{level}_res_{i}",
+                in_ch != out_ch,
+            )
+            in_ch = out_ch
+        if level != 0:
+            entries.append(
+                (
+                    f"{p}.decoder.up.{level}.upsample.conv",
+                    f"decoder/up_{level}_us",
+                    _CONV,
+                )
+            )
+    entries += [
+        (f"{p}.decoder.norm_out", "decoder/norm_out/GroupNorm_0", _NORM),
+        (f"{p}.decoder.conv_out", "decoder/conv_out", _CONV),
+    ]
+    return entries
+
+
+def text_encoder_schedule(cfg) -> list[Entry]:
+    """CLIP text transformer (`cond_stage_model.transformer.text_model.*`)
+    → TextEncoder flax tree."""
+    p = "cond_stage_model.transformer.text_model"
+    entries: list[Entry] = [
+        (f"{p}.embeddings.token_embedding", "token_embedding", "embedding"),
+        (f"{p}.embeddings.position_embedding", "position_embedding", "position"),
+    ]
+    for i in range(cfg.layers):
+        sd, fx = f"{p}.encoder.layers.{i}", f"block_{i}"
+        entries += [
+            (f"{sd}.layer_norm1", f"{fx}/LayerNorm_0", _NORM),
+            (f"{sd}.self_attn.q_proj", f"{fx}/q", _LINEAR),
+            (f"{sd}.self_attn.k_proj", f"{fx}/k", _LINEAR),
+            (f"{sd}.self_attn.v_proj", f"{fx}/v", _LINEAR),
+            (f"{sd}.self_attn.out_proj", f"{fx}/proj", _LINEAR),
+            (f"{sd}.layer_norm2", f"{fx}/LayerNorm_1", _NORM),
+            (f"{sd}.mlp.fc1", f"{fx}/fc1", _LINEAR),
+            (f"{sd}.mlp.fc2", f"{fx}/fc2", _LINEAR),
+        ]
+    entries.append((f"{p}.final_layer_norm", "final_ln", _NORM))
+    return entries
+
+
+# --- conversion -----------------------------------------------------------
+
+def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
+    """Entry list → per-tensor (sd_key, flax_path, transform)."""
+    out: list[tuple[str, str, str]] = []
+    for sd, fx, kind in entries:
+        if kind == _NORM:
+            out.append((f"{sd}.weight", f"{fx}/scale", "id"))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind == _CONV:
+            out.append((f"{sd}.weight", f"{fx}/kernel", "conv"))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind == _LINEAR:
+            out.append((f"{sd}.weight", f"{fx}/kernel", "linear"))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind == _LINEAR_NOBIAS:
+            out.append((f"{sd}.weight", f"{fx}/kernel", "linear"))
+        elif kind == _PROJ:
+            out.append((f"{sd}.weight", f"{fx}/kernel", "proj"))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind == "embedding":
+            out.append((f"{sd}.weight", f"{fx}/embedding", "id"))
+        elif kind == "position":
+            out.append((f"{sd}.weight", fx, "id"))
+        else:  # pragma: no cover
+            raise ValueError(f"unknown kind {kind}")
+    return out
+
+
+def _transform(value: np.ndarray, how: str) -> np.ndarray:
+    if how == "conv":
+        return np.transpose(value, (2, 3, 1, 0))
+    if how == "linear":
+        return np.transpose(value, (1, 0))
+    if how == "proj":
+        if value.ndim == 4:  # conv 1x1 → dense
+            return np.transpose(value[:, :, 0, 0], (1, 0))
+        return np.transpose(value, (1, 0))
+    return value
+
+
+def _inverse_transform(value: np.ndarray, how: str) -> np.ndarray:
+    if how == "conv":
+        return np.transpose(value, (3, 2, 0, 1))
+    if how in ("linear", "proj"):
+        return np.transpose(value, (1, 0))
+    return value
+
+
+def convert_state_dict(
+    state_dict: dict[str, np.ndarray], entries: Iterable[Entry]
+) -> tuple[dict[str, np.ndarray], list[str]]:
+    """SD state dict → flat flax param dict ('/'-joined paths) under the
+    'params' root, plus the list of sd keys the schedule expected but
+    the checkpoint lacks."""
+    flat: dict[str, np.ndarray] = {}
+    missing: list[str] = []
+    for sd_key, fx_path, how in _expand(entries):
+        value = state_dict.get(sd_key)
+        if value is None:
+            missing.append(sd_key)
+            continue
+        flat[f"params/{fx_path}"] = _transform(np.asarray(value), how)
+    return flat, missing
+
+
+def synthesize_state_dict(
+    flat_params: dict[str, np.ndarray], entries: Iterable[Entry]
+) -> dict[str, np.ndarray]:
+    """Inverse of convert_state_dict for tests: flax tree → SD-format
+    state dict with torch layouts."""
+    out: dict[str, np.ndarray] = {}
+    for sd_key, fx_path, how in _expand(entries):
+        value = flat_params.get(f"params/{fx_path}")
+        if value is None:
+            raise KeyError(f"flax template lacks {fx_path} (for {sd_key})")
+        out[sd_key] = _inverse_transform(np.asarray(value), how)
+    return out
+
+
+# --- loading --------------------------------------------------------------
+
+def read_checkpoint(path: str) -> dict[str, np.ndarray]:
+    """Read a single-file SD checkpoint (.safetensors or torch .ckpt)."""
+    if path.endswith(".safetensors"):
+        # framework="pt": numpy can't materialize bfloat16 tensors,
+        # which bf16 fine-tune checkpoints commonly carry
+        import torch
+        from safetensors import safe_open
+
+        out: dict[str, np.ndarray] = {}
+        with safe_open(path, framework="pt") as fh:
+            for key in fh.keys():
+                t = fh.get_tensor(key)
+                if t.dtype == torch.bfloat16:
+                    t = t.float()
+                out[key] = t.numpy()
+        return out
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    if "state_dict" in raw:
+        raw = raw["state_dict"]
+    return {k: v.float().numpy() for k, v in raw.items() if hasattr(v, "numpy")}
+
+
+def find_checkpoint(model_name: str) -> str | None:
+    """Resolve a checkpoint file for `model_name` from
+    CDT_CHECKPOINT_DIR. The var may also point directly at a file, in
+    which case it applies only when its stem matches `model_name` —
+    otherwise a second model loaded in the same process would get the
+    wrong weights forced onto it. Arbitrary filenames go through the
+    explicit `checkpoint=` argument of load_pipeline instead."""
+    root = os.environ.get("CDT_CHECKPOINT_DIR")
+    if not root:
+        return None
+    if os.path.isfile(root):
+        stem = os.path.splitext(os.path.basename(root))[0]
+        return root if stem == model_name else None
+    for ext in (".safetensors", ".ckpt"):
+        candidate = os.path.join(root, model_name + ext)
+        if os.path.exists(candidate):
+            return candidate
+    return None
+
+
+def load_sd_weights(
+    state_dict: dict[str, np.ndarray],
+    unet_cfg,
+    vae_cfg,
+    te_cfg,
+    templates: dict[str, Any],
+    strict: bool = True,
+) -> tuple[dict[str, Any], list[str]]:
+    """Map a full SD checkpoint onto {'unet','vae','te'} param trees.
+
+    `templates` carries the random-init trees; every template leaf must
+    be covered by the checkpoint with a matching shape (strict) or is
+    kept at its init value (non-strict). Returns (trees, problems).
+    """
+    from .io import flatten_params, unflatten_params
+    import jax
+
+    schedules = {
+        "unet": unet_schedule(unet_cfg),
+        "vae": vae_schedule(vae_cfg),
+        "te": text_encoder_schedule(te_cfg),
+    }
+    result: dict[str, Any] = {}
+    problems: list[str] = []
+    for part, entries in schedules.items():
+        template_flat = flatten_params(jax.device_get(templates[part]))
+        converted, missing = convert_state_dict(state_dict, entries)
+        problems += [f"{part}: checkpoint lacks {k}" for k in missing]
+        merged: dict[str, np.ndarray] = {}
+        for key, tval in template_flat.items():
+            cval = converted.get(key)
+            if cval is None:
+                problems.append(f"{part}: schedule lacks {key}")
+                merged[key] = tval
+            elif tuple(cval.shape) != tuple(tval.shape):
+                problems.append(
+                    f"{part}: shape mismatch {key}: "
+                    f"ckpt {cval.shape} vs model {tval.shape}"
+                )
+                merged[key] = tval
+            else:
+                merged[key] = cval.astype(tval.dtype)
+        result[part] = unflatten_params(merged)
+    if problems and strict:
+        raise ValueError(
+            f"checkpoint mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return result, problems

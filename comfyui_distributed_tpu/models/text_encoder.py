@@ -5,24 +5,22 @@ workflows assume (reference workflows/*.json CLIPTextEncode nodes).
 The transformer is architecture-faithful (token+position embeddings,
 pre-LN causal blocks, final LN; pooled output = EOS token state).
 
-Tokenizer: the runtime has no network egress to fetch BPE vocab
-files, so the default tokenizer is a deterministic byte-level scheme
-(stable across hosts — the property the distributed tier needs so
-master and workers agree on conditioning for identical prompts). A
-real BPE vocab can be dropped in via `Tokenizer(vocab_path=...)`.
+Tokenizer: real CLIP byte-level BPE (models/clip_bpe.py) over the
+committed vocab assets — deterministic across hosts, the property the
+distributed tier needs so master and workers agree on conditioning
+for identical prompts. OpenAI's exact CLIP vocab drops in via
+`CDT_CLIP_VOCAB` or `Tokenizer(vocab_path=...)`.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 from typing import Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,35 +38,38 @@ class TextEncoderConfig:
 
 
 class Tokenizer:
-    """Byte-level tokenizer with BOS/EOS, fixed-length padded output."""
+    """CLIP BPE tokenizer with BOS/EOS, fixed-length padded output.
 
+    CLIP conventions throughout: `<bos> tokens[:max-2] <eos>`, padded
+    with the EOS id (CLIP's pad token is endoftext), ids identical on
+    every host that shares the committed vocab assets.
+    """
+
+    # CLIP id layout (the committed vocab reproduces it exactly; a
+    # custom vocab may move them — instances use the vocab's own ids).
     BOS = 49406
     EOS = 49407
 
     def __init__(self, max_length: int = 77, vocab_path: Optional[str] = None):
+        from .clip_bpe import get_bpe
+
         self.max_length = max_length
-        self.vocab_path = vocab_path  # reserved for real BPE vocab
+        self.bpe = get_bpe(vocab_path)
+        self.bos_id = self.bpe.bos_id
+        self.eos_id = self.bpe.eos_id
 
     def encode(self, text: str) -> np.ndarray:
-        # Bytes offset by 1 (0 = pad); words salted with a stable hash so
-        # different words with shared prefixes diverge like BPE merges do.
-        ids: list[int] = [self.BOS]
-        for word in text.strip().lower().split():
-            digest = hashlib.sha256(word.encode("utf-8")).digest()
-            word_id = 256 + int.from_bytes(digest[:4], "big") % 49000
-            ids.append(word_id)
-            if len(ids) >= self.max_length - 1:
-                break
-        ids.append(self.EOS)
-        ids = ids[: self.max_length]
-        out = np.full((self.max_length,), 0, dtype=np.int32)
+        body = self.bpe.encode_text(text)[: self.max_length - 2]
+        ids = [self.bos_id] + body + [self.eos_id]
+        out = np.full((self.max_length,), self.eos_id, dtype=np.int32)
         out[: len(ids)] = ids
-        # pad positions carry EOS id like CLIP's padding convention
-        out[len(ids):] = self.EOS
         return out
 
     def encode_batch(self, texts: list[str]) -> np.ndarray:
         return np.stack([self.encode(t) for t in texts], axis=0)
+
+    def decode(self, ids) -> str:
+        return self.bpe.decode(list(map(int, ids)))
 
 
 class _CausalBlock(nn.Module):
@@ -79,7 +80,8 @@ class _CausalBlock(nn.Module):
     def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
         width = x.shape[-1]
         head_dim = width // self.heads
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        # eps=1e-5 matches torch/CLIP-L (flax default is 1e-6)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         b, n, _ = h.shape
         q = nn.Dense(width, dtype=self.dtype, name="q")(h)
         k = nn.Dense(width, dtype=self.dtype, name="k")(h)
@@ -96,9 +98,11 @@ class _CausalBlock(nn.Module):
         out = jnp.einsum("bhnm,bmhd->bnhd", probs, v).reshape(b, n, width)
         x = x + nn.Dense(width, dtype=self.dtype, name="proj")(out)
 
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         h = nn.Dense(width * 4, dtype=self.dtype, name="fc1")(h)
-        h = nn.gelu(h, approximate=True)
+        # CLIP's quick_gelu — required for real CLIP-L weights to
+        # reproduce reference activations
+        h = h * jax.nn.sigmoid(1.702 * h)
         h = nn.Dense(width, dtype=self.dtype, name="fc2")(h)
         return x + h
 
@@ -107,8 +111,15 @@ class TextEncoder(nn.Module):
     config: TextEncoderConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
-        """[B, T] int tokens → (hidden [B, T, width], pooled [B, width])."""
+    def __call__(
+        self, tokens: jax.Array, eos_id: int | None = None
+    ) -> tuple[jax.Array, jax.Array]:
+        """[B, T] int tokens → (hidden [B, T, width], pooled [B, width]).
+
+        `eos_id` selects the pooled position (first EOS occurrence);
+        defaults to the CLIP layout id — pass the active tokenizer's
+        eos_id when a custom vocab moves it.
+        """
         cfg = self.config
         dt = cfg.compute_dtype
         b, t = tokens.shape
@@ -122,8 +133,12 @@ class TextEncoder(nn.Module):
         causal = jnp.tril(jnp.ones((t, t), dtype=bool))
         for i in range(cfg.layers):
             x = _CausalBlock(cfg.heads, dt, name=f"block_{i}")(x, causal)
-        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x.astype(jnp.float32))
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")(
+            x.astype(jnp.float32)
+        )
         # pooled = state at first EOS position per sequence
-        eos_pos = jnp.argmax((tokens == Tokenizer.EOS).astype(jnp.int32), axis=1)
+        if eos_id is None:
+            eos_id = Tokenizer.EOS
+        eos_pos = jnp.argmax((tokens == eos_id).astype(jnp.int32), axis=1)
         pooled = x[jnp.arange(b), eos_pos]
         return x, pooled

@@ -39,6 +39,10 @@ class UNetConfig:
     transformer_depth: Sequence[int] = (1, 1, 1, 0)
     context_dim: int = 768
     num_heads: int = 8
+    # fixed per-head width (SDXL's num_head_channels=64 convention):
+    # when set, each level uses out_ch // head_dim heads, overriding
+    # num_heads — required for real SDXL attention semantics
+    head_dim: Optional[int] = None
     # SDXL-style pooled text + size conditioning vector (0 = disabled)
     adm_in_channels: int = 0
     dtype: str = "bfloat16"
@@ -72,6 +76,11 @@ class UNet(nn.Module):
             else SpatialTransformer
         )
 
+        def head_split(width: int) -> tuple[int, int]:
+            if cfg.head_dim:
+                return width // cfg.head_dim, cfg.head_dim
+            return cfg.num_heads, width // cfg.num_heads
+
         emb = nn.Dense(ch * 4, dtype=dt, name="time_embed_0")(
             timestep_embedding(timesteps, ch).astype(dt)
         )
@@ -99,9 +108,10 @@ class UNet(nn.Module):
             for i in range(cfg.num_res_blocks):
                 h = ResBlock(out_ch, dt, name=f"down_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:
+                    heads, hdim = head_split(out_ch)
                     h = SpatialT(
-                        cfg.num_heads,
-                        out_ch // cfg.num_heads,
+                        heads,
+                        hdim,
                         cfg.transformer_depth[level],
                         dt,
                         name=f"down_{level}_attn_{i}",
@@ -115,8 +125,9 @@ class UNet(nn.Module):
         mid_ch = ch * cfg.channel_mult[-1]
         mid_depth = max(cfg.transformer_depth[-1], 1)
         h = ResBlock(mid_ch, dt, name="mid_res_0")(h, emb)
+        mid_heads, mid_hdim = head_split(mid_ch)
         h = SpatialT(
-            cfg.num_heads, mid_ch // cfg.num_heads, mid_depth, dt, name="mid_attn"
+            mid_heads, mid_hdim, mid_depth, dt, name="mid_attn"
         )(h, context)
         h = ResBlock(mid_ch, dt, name="mid_res_1")(h, emb)
 
@@ -127,9 +138,10 @@ class UNet(nn.Module):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
                 h = ResBlock(out_ch, dt, name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:
+                    heads, hdim = head_split(out_ch)
                     h = SpatialT(
-                        cfg.num_heads,
-                        out_ch // cfg.num_heads,
+                        heads,
+                        hdim,
                         cfg.transformer_depth[level],
                         dt,
                         name=f"up_{level}_attn_{i}",

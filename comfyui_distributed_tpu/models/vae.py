@@ -45,10 +45,10 @@ class _VAEResBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = GroupNorm32(name="norm1")(x)
+        h = GroupNorm32(epsilon=1e-6, name="norm1")(x)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), dtype=self.dtype, name="conv1")(h)
-        h = GroupNorm32(name="norm2")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm2")(h)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), dtype=self.dtype, name="conv2")(h)
         if x.shape[-1] != self.out_channels:
@@ -62,7 +62,7 @@ class _MidAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, hh, ww, c = x.shape
-        h = GroupNorm32(name="norm")(x)
+        h = GroupNorm32(epsilon=1e-6, name="norm")(x)
         tokens = h.reshape(b, hh * ww, c)
         q = nn.Dense(c, dtype=self.dtype, name="q")(tokens)
         k = nn.Dense(c, dtype=self.dtype, name="k")(tokens)
@@ -94,7 +94,7 @@ class Encoder(nn.Module):
         h = _VAEResBlock(h.shape[-1], dt, name="mid_res_0")(h)
         h = _MidAttention(dt, name="mid_attn")(h)
         h = _VAEResBlock(h.shape[-1], dt, name="mid_res_1")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm_out")(h)
         h = nn.silu(h)
         # mean + logvar
         return nn.Conv(
@@ -123,7 +123,7 @@ class Decoder(nn.Module):
                 b, hh, ww, c = h.shape
                 h = jax.image.resize(h, (b, hh * 2, ww * 2, c), method="nearest")
                 h = nn.Conv(c, (3, 3), dtype=dt, name=f"up_{level}_us")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm_out")(h)
         h = nn.silu(h)
         return nn.Conv(cfg.in_channels, (3, 3), dtype=jnp.float32, name="conv_out")(
             h.astype(jnp.float32)
@@ -140,11 +140,21 @@ class VAE(nn.Module):
     def setup(self):
         self.encoder = Encoder(self.config)
         self.decoder = Decoder(self.config)
+        # 1x1 moment/latent projections from the SD AutoencoderKL
+        # (quant_conv / post_quant_conv) so real checkpoints map 1:1
+        self.quant_conv = nn.Conv(
+            2 * self.config.latent_channels, (1, 1), dtype=jnp.float32,
+            name="quant_conv",
+        )
+        self.post_quant_conv = nn.Conv(
+            self.config.latent_channels, (1, 1), dtype=jnp.float32,
+            name="post_quant_conv",
+        )
 
     def encode(self, x: jax.Array, rng: jax.Array | None = None) -> jax.Array:
         """[B,H,W,3] in [0,1] → [B,H/8,W/8,4] scaled latents (mean; pass
         rng to sample from the posterior instead)."""
-        moments = self.encoder(x * 2.0 - 1.0)
+        moments = self.quant_conv(self.encoder(x * 2.0 - 1.0))
         mean, logvar = jnp.split(moments, 2, axis=-1)
         if rng is not None:
             std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
@@ -153,7 +163,7 @@ class VAE(nn.Module):
 
     def decode(self, z: jax.Array) -> jax.Array:
         """[B,h,w,4] scaled latents → [B,H,W,3] images in [0,1]."""
-        x = self.decoder(z / self.config.scaling_factor)
+        x = self.decoder(self.post_quant_conv(z / self.config.scaling_factor))
         return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)
 
     def __call__(self, x: jax.Array) -> jax.Array:

@@ -1,0 +1,186 @@
+"""Train the committed fallback CLIP-format BPE vocab.
+
+The runtime has zero network egress, so OpenAI's CLIP BPE vocab
+(bpe_simple_vocab_16e6) cannot be fetched. This script trains a
+byte-level BPE with CLIP's exact structure (GPT-2 byte alphabet,
+``</w>`` end-of-word suffix, CLIP pre-tokenization regex) on English
+prose available on the build host, then emits the canonical CLIP file
+pair — ``vocab.json`` + ``merges.txt`` — where the vocab is derived
+from the merge list exactly the way OpenAI's vocab is:
+
+    [256 byte units] + [256 byte units + '</w>'] + [one token per
+    merge, in rank order] + ['<|startoftext|>', '<|endoftext|>']
+
+Dropping in the real CLIP files (same format) at
+``models/assets/clip_vocab/`` or via ``CDT_CLIP_VOCAB`` swaps in exact
+CLIP tokenization with no code change.
+
+Usage: python scripts/train_fallback_vocab.py [--out DIR] [--vocab-size N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import tempfile
+
+CLIP_PATTERN = (
+    r"(?i)<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|"
+    r"[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+"
+)
+
+CORPUS_ROOTS = (
+    "/opt/venv/lib/python3.12/site-packages",
+    "/usr/share/doc",
+    "/usr/lib/python3.12",
+)
+CORPUS_EXTS = (".md", ".rst", ".txt")
+
+
+def bytes_to_unicode() -> dict[int, str]:
+    """GPT-2/CLIP byte→printable-unicode table (order matters: it
+    defines vocab ids 0..255)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(2**8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2**8 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+def collect_corpus(max_bytes: int = 64_000_000) -> list[str]:
+    files: list[str] = []
+    total = 0
+    for root in CORPUS_ROOTS:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in ("node_modules",)]
+            for name in sorted(filenames):
+                upper = name.upper()
+                if not name.endswith(CORPUS_EXTS):
+                    continue
+                if "LICENSE" in upper or "COPYING" in upper:
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                if size < 2000 or size > 4_000_000:
+                    continue
+                files.append(path)
+                total += size
+                if total > max_bytes:
+                    return files
+    return files
+
+
+def train_merges(corpus_files: list[str], vocab_size: int) -> list[tuple[str, str]]:
+    from tokenizers import Regex, Tokenizer, models, normalizers, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(end_of_word_suffix="</w>"))
+    tok.normalizer = normalizers.Sequence(
+        [normalizers.NFC(), normalizers.Lowercase()]
+    )
+    tok.pre_tokenizer = pre_tokenizers.Sequence(
+        [
+            pre_tokenizers.Split(Regex(CLIP_PATTERN), behavior="isolated"),
+            pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+        ]
+    )
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        min_frequency=2,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        end_of_word_suffix="</w>",
+        show_progress=False,
+    )
+
+    def read_lines():
+        for path in corpus_files:
+            try:
+                with open(path, encoding="utf-8", errors="ignore") as fh:
+                    yield fh.read()
+            except OSError:
+                continue
+
+    tok.train_from_iterator(read_lines(), trainer)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tok.model.save(tmp)
+        with open(os.path.join(tmp, "merges.txt"), encoding="utf-8") as fh:
+            lines = [ln for ln in fh.read().splitlines() if ln and not ln.startswith("#")]
+    return [tuple(ln.split(" ")) for ln in lines]  # type: ignore[misc]
+
+
+def build_vocab(merges: list[tuple[str, str]], total_size: int = 49408) -> dict[str, int]:
+    byte_units = list(bytes_to_unicode().values())
+    tokens = byte_units + [u + "</w>" for u in byte_units]
+    tokens += [a + b for a, b in merges]
+    # pad so the specials land at CLIP's exact ids (49406/49407) even
+    # when the corpus yields fewer merges than CLIP's 48894
+    while len(tokens) < total_size - 2:
+        tokens.append(f"<|unused{len(tokens)}|>")
+    tokens += ["<|startoftext|>", "<|endoftext|>"]
+    vocab: dict[str, int] = {}
+    for token in tokens:
+        if token not in vocab:  # merges can re-derive a byte unit
+            vocab[token] = len(vocab)
+    assert len(vocab) == total_size, len(vocab)
+    return vocab
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "comfyui_distributed_tpu", "models", "assets", "clip_vocab",
+        ),
+    )
+    # 49408 total = 512 byte units + 48894 merges + 2 specials (CLIP's
+    # exact layout); the trainer may stop earlier on a small corpus.
+    ap.add_argument("--vocab-size", type=int, default=49406)
+    args = ap.parse_args()
+
+    corpus = collect_corpus()
+    print(f"corpus: {len(corpus)} files")
+    merges = train_merges(corpus, args.vocab_size)
+    # drop merges whose product collides with a byte unit (id reuse)
+    seen: set[str] = set()
+    byte_units = set(bytes_to_unicode().values())
+    byte_units |= {u + "</w>" for u in byte_units}
+    clean: list[tuple[str, str]] = []
+    for a, b in merges:
+        prod = a + b
+        if prod in byte_units or prod in seen:
+            continue
+        seen.add(prod)
+        clean.append((a, b))
+    # CLIP's merge table is exactly 49152-256-2 = 48894 entries; the
+    # transformers reader hard-caps at that count, so so do we.
+    clean = clean[:48894]
+    vocab = build_vocab(clean)
+    print(f"merges: {len(clean)}, vocab: {len(vocab)}")
+
+    os.makedirs(args.out, exist_ok=True)
+    with gzip.open(os.path.join(args.out, "vocab.json.gz"), "wt", encoding="utf-8") as fh:
+        json.dump(vocab, fh, ensure_ascii=False)
+    with gzip.open(os.path.join(args.out, "merges.txt.gz"), "wt", encoding="utf-8") as fh:
+        fh.write("#version: 0.2\n")
+        for a, b in clean:
+            fh.write(f"{a} {b}\n")
+    print(f"wrote {args.out}/vocab.json.gz + merges.txt.gz")
+
+
+if __name__ == "__main__":
+    main()

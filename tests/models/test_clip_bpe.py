@@ -1,0 +1,126 @@
+"""CLIP BPE tokenizer: golden parity against transformers' reference
+implementation over the SAME committed vocab files, plus roundtrip and
+layout invariants. This is the guarantee that dropping in OpenAI's
+real vocab.json/merges.txt yields exact CLIP tokenization."""
+
+import gzip
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+ASSET_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "comfyui_distributed_tpu", "models", "assets", "clip_vocab",
+)
+
+PROMPTS = [
+    "a photograph of a mountain lake at dawn",
+    "A PHOTOGRAPH of a Mountain Lake at Dawn!!",
+    "blurry, low quality",
+    "",
+    "  leading and trailing   whitespace  ",
+    "hyphenated-word and under_scores and CamelCase",
+    "masterpiece, best quality, 8k uhd, dslr, soft lighting, film grain",
+    "it's a dog's breakfast; they're won't can't",
+    "numbers 12345 and 3.14159 and v2.1",
+    "unicode: café naïve über straße",
+    "emoji \U0001f600 and symbols © ® ™",
+    "newline\nand\ttab characters",
+    "<|startoftext|> special markers <|endoftext|>",
+    "a very long prompt " * 30,
+    "中文字符 mixed with english",
+]
+
+
+@pytest.fixture(scope="module")
+def bpe():
+    from comfyui_distributed_tpu.models.clip_bpe import ClipBPE
+
+    return ClipBPE(ASSET_DIR)
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(tmp_path_factory):
+    """transformers.CLIPTokenizer reading the same (gunzipped) files."""
+    tmp = tmp_path_factory.mktemp("clip_vocab")
+    for name in ("vocab.json", "merges.txt"):
+        with gzip.open(os.path.join(ASSET_DIR, name + ".gz"), "rb") as src:
+            with open(tmp / name, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+    from transformers import CLIPTokenizer
+
+    return CLIPTokenizer(str(tmp / "vocab.json"), str(tmp / "merges.txt"))
+
+
+def test_vocab_layout(bpe):
+    assert len(bpe.encoder) == 49408
+    assert bpe.bos_id == 49406
+    assert bpe.eos_id == 49407
+    # first 256 entries are the byte alphabet
+    from comfyui_distributed_tpu.models.clip_bpe import bytes_to_unicode
+
+    units = list(bytes_to_unicode().values())
+    for i, unit in enumerate(units):
+        assert bpe.encoder[unit] == i
+        assert bpe.encoder[unit + "</w>"] == 256 + i
+
+
+@pytest.mark.parametrize("prompt", PROMPTS)
+def test_parity_with_transformers(bpe, hf_tokenizer, prompt):
+    ours = bpe.encode_text(prompt)
+    theirs = hf_tokenizer(prompt, add_special_tokens=False)["input_ids"]
+    assert ours == theirs, f"mismatch for {prompt!r}"
+
+
+def test_padded_encode_matches_transformers(hf_tokenizer):
+    from comfyui_distributed_tpu.models.text_encoder import Tokenizer
+
+    tok = Tokenizer(max_length=77, vocab_path=ASSET_DIR)
+    for prompt in PROMPTS:
+        ours = tok.encode(prompt)
+        theirs = hf_tokenizer(
+            prompt, padding="max_length", max_length=77, truncation=True
+        )["input_ids"]
+        assert ours.tolist() == theirs, f"mismatch for {prompt!r}"
+
+
+def test_roundtrip(bpe):
+    text = "a photograph of a mountain lake at dawn"
+    assert bpe.decode(bpe.encode_text(text)) == text
+
+
+def test_subword_structure(bpe):
+    """Real BPE property the old hash scheme lacked: unseen words
+    decompose into multiple subword ids, all decodable."""
+    ids = bpe.encode_text("xqzvbrella")
+    assert len(ids) > 1
+    assert bpe.decode(ids) == "xqzvbrella"
+
+
+def test_no_collisions_distinct_words(bpe):
+    a = bpe.encode_text("mountain")
+    b = bpe.encode_text("fountain")
+    assert a != b
+
+
+def test_default_tokenizer_uses_committed_vocab():
+    from comfyui_distributed_tpu.models.text_encoder import Tokenizer
+
+    tok = Tokenizer()
+    enc = tok.encode("hello world")
+    assert enc.shape == (77,)
+    assert enc[0] == tok.bos_id == 49406
+    assert enc[-1] == tok.eos_id == 49407
+    assert tok.decode(enc) == "hello world"
+
+
+def test_encode_batch_deterministic():
+    from comfyui_distributed_tpu.models.text_encoder import Tokenizer
+
+    tok = Tokenizer()
+    x = tok.encode_batch(["a dog", "a cat"])
+    y = tok.encode_batch(["a dog", "a cat"])
+    np.testing.assert_array_equal(x, y)
+    assert x.shape == (2, 77)
