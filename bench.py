@@ -1,32 +1,76 @@
 """Benchmark harness.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Primary metric: distributed tiled-upscale throughput in tiles/sec/chip
 (the BASELINE.md headline: USDU 4K-upscale tiles/sec/chip), measured by
-running the USDU compute core over all available chips; vs_baseline is
-the parallel-scaling factor against the same-shape single-chip run
-(the capability the reference's qualitative claims describe: "speed
-scaling as you add more GPUs").
+running the USDU compute core over all available chips.
 
-Env knobs: BENCH_TINY=1 (small model/shapes for smoke runs),
-BENCH_CPU=1 (force CPU backend), BENCH_METRIC=txt2img|usdu.
+Honesty rules (round-1 verdict items):
+- `vs_baseline` is a *measured parallel-scaling factor*. With >1 real
+  chips it is multi-chip rate / single-chip rate on the hardware; with
+  1 chip it is measured on an 8-device virtual CPU mesh in a
+  subprocess (tiny model) and labeled via `scaling_source`. It is
+  null when no scaling measurement succeeded — never a run compared
+  to itself.
+- `mfu` reports model-FLOPs utilization from XLA's cost analysis and
+  the chip's peak bf16 FLOPs (null when the peak is unknown, e.g. CPU).
+- `environment` marks probe failures explicitly (`tpu` vs
+  `cpu_fallback`) so a red TPU can't read as a perf datum;
+  `fallback: true` accompanies any CPU-tiny number.
+
+Env knobs: BENCH_TINY=1 (small model/shapes), BENCH_CPU=1 (force CPU),
+BENCH_METRIC=usdu|txt2img, BENCH_PROBE_TIMEOUT (s, <=0 skips probe),
+BENCH_SCALING_TIMEOUT (s for the virtual-mesh subprocess).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+
+# peak dense bf16 FLOPs/s per chip by device_kind substring
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    if device.platform not in ("tpu", "axon"):
+        return None
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _cost_flops(jitted, *args) -> float | None:
+    """XLA-estimated FLOPs of one call (per whole program)."""
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
 
 
 def _probe_accelerator(timeout_s: float) -> str:
     """Probe backend init in a subprocess: a hung/unreachable TPU
     tunnel would otherwise hang the whole bench (backend init is not
     interruptible in-process). Returns 'ok' | 'failed' | 'timeout'."""
-    import subprocess
-    import sys
-
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
@@ -37,14 +81,13 @@ def _probe_accelerator(timeout_s: float) -> str:
         return "timeout"
 
 
-def _init_jax():
-    import sys
-
+def _init_jax() -> tuple:
+    """Returns (jax, environment_tag)."""
     import jax
 
-    if os.environ.get("BENCH_CPU") == "1":
+    if os.environ.get("BENCH_CPU") == "1" or os.environ.get("BENCH_MODE"):
         jax.config.update("jax_platforms", "cpu")
-        return jax
+        return jax, "cpu"
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     # probe_timeout <= 0 disables the probe (trusted-healthy host: skip
     # the duplicate backend init the probe subprocess costs)
@@ -61,7 +104,17 @@ def _init_jax():
         )
         os.environ.setdefault("BENCH_TINY", "1")
         jax.config.update("jax_platforms", "cpu")
-    return jax
+        return jax, "cpu_fallback"
+    return jax, "accelerator"
+
+
+def _rate(fn, n_items: int, iters: int = 3) -> float:
+    """items/sec of fn(seed) after one compile call."""
+    fn(0)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        fn(i + 1)
+    return n_items * iters / (time.perf_counter() - t0)
 
 
 def bench_usdu(jax, tiny: bool) -> dict:
@@ -95,35 +148,47 @@ def bench_usdu(jax, tiny: bool) -> dict:
         out = up.run_upscale(bundle, img, pos, neg, mesh=mesh, seed=seed, **kwargs)
         jax.block_until_ready(out)
 
-    run(0)  # compile
-    iters = 3
-    t0 = time.perf_counter()
-    for i in range(iters):
-        run(i + 1)
-    elapsed = time.perf_counter() - t0
-    tiles_per_sec = grid.num_tiles * iters / elapsed
-    tiles_per_sec_chip = tiles_per_sec / n_dev
+    rate = _rate(run, grid.num_tiles)
+    rate_per_chip = rate / n_dev
 
-    # single-chip reference rate for the scaling factor
-    def run_single(seed):
-        out = up.run_upscale(bundle, img, pos, neg, mesh=None, seed=seed, **kwargs)
-        jax.block_until_ready(out)
-
-    run_single(0)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        run_single(i + 1)
-    single_rate = grid.num_tiles * iters / (time.perf_counter() - t0)
-
-    return {
+    result = {
         "metric": (
             f"USDU tiles/sec/chip ({model}, {src}->{2*src}px, "
             f"{tile}px tiles, {steps} steps, {n_dev} chip(s))"
         ),
-        "value": round(tiles_per_sec_chip, 4),
+        "value": round(rate_per_chip, 4),
         "unit": "tiles/sec/chip",
-        "vs_baseline": round(tiles_per_sec / max(single_rate, 1e-9), 3),
+        "vs_baseline": None,
+        "scaling_source": None,
     }
+
+    if n_dev > 1:
+        # real multi-chip scaling vs a single-chip run of the same shape
+        def run_single(seed):
+            out = up.run_upscale(
+                bundle, img, pos, neg, mesh=None, seed=seed, **kwargs
+            )
+            jax.block_until_ready(out)
+
+        single_rate = _rate(run_single, grid.num_tiles)
+        result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
+        result["scaling_source"] = f"measured_{n_dev}chip"
+
+    # MFU from XLA cost analysis of the end-to-end program
+    peak = _peak_flops(jax.devices()[0])
+    if peak is not None:
+        from comfyui_distributed_tpu.ops.upscale import _jitted_for_flops
+
+        flops = _jitted_for_flops(bundle, img, pos, neg, mesh, **kwargs)
+        if flops:
+            result["mfu"] = round(
+                (flops * rate / grid.num_tiles) / (n_dev * peak), 4
+            )
+        else:
+            result["mfu"] = None
+    else:
+        result["mfu"] = None
+    return result
 
 
 def bench_txt2img(jax, tiny: bool) -> dict:
@@ -145,36 +210,125 @@ def bench_txt2img(jax, tiny: bool) -> dict:
         )
         jax.block_until_ready(out)
 
-    run(0)
-    iters = 3
-    t0 = time.perf_counter()
-    for i in range(iters):
-        run(i + 1)
-    imgs_per_sec = n_dev * iters / (time.perf_counter() - t0)
+    rate = _rate(run, n_dev)
 
-    single = pl.txt2img(bundle, "benchmark prompt", height=size, width=size,
-                        steps=steps, seed=0)
-    jax.block_until_ready(single)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = pl.txt2img(bundle, "benchmark prompt", height=size, width=size,
-                         steps=steps, seed=i + 1)
-        jax.block_until_ready(out)
-    single_rate = iters / (time.perf_counter() - t0)
-
-    return {
+    result = {
         "metric": f"txt2img imgs/sec ({model} {size}px {steps} steps, {n_dev} chip(s))",
-        "value": round(imgs_per_sec, 4),
+        "value": round(rate, 4),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / max(single_rate, 1e-9), 3),
+        "vs_baseline": None,
+        "scaling_source": None,
+        "mfu": None,
     }
+    if n_dev > 1:
+        def run_single(seed):
+            out = pl.txt2img(
+                bundle, "benchmark prompt", height=size, width=size,
+                steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        single_rate = _rate(run_single, 1)
+        result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
+        result["scaling_source"] = f"measured_{n_dev}chip"
+    return result
+
+
+def _virtual8_scaling() -> dict:
+    """Child mode: tiny USDU on an 8-device virtual CPU mesh vs one
+    device; prints {"scaling": r, "n_cores": c}."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.models import pipeline as pl
+    from comfyui_distributed_tpu.ops import upscale as up
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    n_dev = len(jax.devices())
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    src, tile_px, padding, steps = 64, 64, 16, 2
+    img = jnp.linspace(0, 1, src * src * 3).reshape(1, src, src, 3).astype(jnp.float32)
+    pos = pl.encode_text(bundle, ["benchmark"])
+    neg = pl.encode_text(bundle, [""])
+    _, _, grid = up.plan_grid(src, src, 2.0, tile_px, padding)
+    kwargs = dict(
+        upscale_by=2.0, tile=tile_px, padding=padding, steps=steps,
+        sampler="euler", scheduler="karras", cfg=7.0, denoise=0.35,
+    )
+    mesh = build_mesh({"data": n_dev})
+
+    def run_multi(seed):
+        out = up.run_upscale(bundle, img, pos, neg, mesh=mesh, seed=seed, **kwargs)
+        jax.block_until_ready(out)
+
+    def run_single(seed):
+        out = up.run_upscale(bundle, img, pos, neg, mesh=None, seed=seed, **kwargs)
+        jax.block_until_ready(out)
+
+    multi = _rate(run_multi, grid.num_tiles)
+    single = _rate(run_single, grid.num_tiles)
+    print(json.dumps({
+        "scaling": round(multi / max(single, 1e-9), 3),
+        "n_devices": n_dev,
+        "n_cores": os.cpu_count(),
+    }))
+
+
+def _measure_virtual8_scaling() -> dict | None:
+    """Parent side: run the virtual-mesh scaling probe in a subprocess
+    (needs its own XLA_FLAGS before backend init)."""
+    timeout_s = float(os.environ.get("BENCH_SCALING_TIMEOUT", 900))
+    if timeout_s <= 0:
+        return None
+    env = dict(os.environ)
+    env["BENCH_MODE"] = "virtual8"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            timeout=timeout_s, capture_output=True, text=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
 
 
 def main() -> None:
-    jax = _init_jax()
+    if os.environ.get("BENCH_MODE") == "virtual8":
+        _virtual8_scaling()
+        return
+
+    jax, environment = _init_jax()
     tiny = os.environ.get("BENCH_TINY") == "1"
     which = os.environ.get("BENCH_METRIC", "usdu")
     result = bench_usdu(jax, tiny) if which == "usdu" else bench_txt2img(jax, tiny)
+
+    result["environment"] = environment
+    result["fallback"] = environment == "cpu_fallback"
+    if result.get("vs_baseline") is None:
+        # 1 chip (or probe fallback): measure scaling on the virtual
+        # CPU mesh so the factor is a real multi-device measurement
+        scaling = _measure_virtual8_scaling()
+        if scaling:
+            result["vs_baseline"] = scaling["scaling"]
+            result["scaling_source"] = (
+                f"virtual8_cpu_mesh({scaling.get('n_cores')}core)"
+            )
     print(json.dumps(result))
 
 

@@ -299,3 +299,49 @@ def run_upscale(
         pl._Static(bundle), bundle.params, upscaled, pos, neg, key, grid,
         int(steps), sampler, scheduler, float(cfg), float(denoise),
     )
+
+
+def _jitted_for_flops(
+    bundle: pl.PipelineBundle,
+    image: jax.Array,
+    pos: jax.Array,
+    neg: jax.Array,
+    mesh: Any = None,
+    upscale_by: float = 2.0,
+    tile: int = 512,
+    padding: int = 32,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg: float = 7.0,
+    denoise: float = 0.35,
+    upscale_method: str = "bicubic",
+    tile_h: int | None = None,
+) -> float | None:
+    """XLA-estimated FLOPs of ONE full upscale program with these args
+    (whole mesh, all tiles) — the numerator of the bench's MFU. Returns
+    None when the backend exposes no cost analysis."""
+    upscaled, grid, _ = prepare_upscaled_tiles(
+        image, upscale_by, tile, padding, upscale_method, tile_h
+    )
+    key = jax.random.key(0)
+    try:
+        if mesh is not None and data_axis_size(mesh) > 1:
+            lowered = upscale_mesh.lower(
+                pl._Static(bundle), pl._Static(mesh), bundle.params, upscaled,
+                pos, neg, key, grid, int(steps), sampler, scheduler,
+                float(cfg), float(denoise),
+            )
+        else:
+            lowered = upscale_single.lower(
+                pl._Static(bundle), bundle.params, upscaled, pos, neg, key,
+                grid, int(steps), sampler, scheduler, float(cfg),
+                float(denoise),
+            )
+        analysis = lowered.compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
