@@ -193,7 +193,14 @@ class Upsample(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, out_hw: Optional[tuple[int, int]] = None
+    ) -> jax.Array:
+        # out_hw overrides the 2x default so the up path can land
+        # exactly on the skip connection's spatial dims when the
+        # latent isn't divisible by 2^depth (e.g. 4x4 latents through
+        # three downsamples: 4→2→1, back up 1→2→4)
         b, h, w, c = x.shape
-        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        th, tw = out_hw if out_hw is not None else (h * 2, w * 2)
+        x = jax.image.resize(x, (b, th, tw, c), method="nearest")
         return nn.Conv(c, (3, 3), dtype=self.dtype, name="conv")(x)

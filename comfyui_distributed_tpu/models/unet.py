@@ -147,7 +147,10 @@ class UNet(nn.Module):
                         name=f"up_{level}_attn_{i}",
                     )(h, context)
             if level != 0:
-                h = Upsample(dt, name=f"up_{level}_us")(h)
+                # land exactly on the next skip's spatial dims (small /
+                # odd latents don't round-trip through stride-2 convs)
+                target = skips[-1].shape[1:3]
+                h = Upsample(dt, name=f"up_{level}_us")(h, target)
 
         h = GroupNorm32(name="out_norm")(h)
         h = nn.silu(h)

@@ -44,6 +44,23 @@ class Conditioning:
     control_module: Any = None
     # pooled text vector (SDXL adm conditioning), [B, width]
     pooled: Optional[jax.Array] = None
+    # GLIGEN position conditioning (reference usdu_utils.crop_gligen):
+    # embs [N, D] paired with static latent-unit boxes (h, w, y, x).
+    # Boxes whose intersection with a tile vanishes are marked inactive
+    # rather than dropped — shapes stay static across tiles.
+    gligen_embs: Optional[jax.Array] = None
+    gligen_boxes: Optional[tuple[tuple[int, int, int, int], ...]] = None
+    gligen_active: Optional[tuple[bool, ...]] = None
+    # Flux-Kontext-style reference latents (reference
+    # crop_reference_latents): list of [B, h_lat, w_lat, C] arrays,
+    # windowed to each tile's latent region.
+    reference_latents: Optional[list] = None
+    # Named spatial model patches (the TPU-native analog of the
+    # reference's crop_model_patch context manager for DiffSynth/
+    # ZImage transformer patches): pixel-space [B, H, W, C] arrays
+    # cropped to each tile exactly like ControlNet hints, consumed by
+    # whichever backbone module registered them.
+    model_patches: Optional[dict] = None
 
     def clone(self) -> "Conditioning":
         # arrays are immutable in JAX; a shallow copy is a deep clone
@@ -130,6 +147,78 @@ def crop_to_tile(
             out.control_strength = 0.0
         else:
             out.area = (bottom - top, right - left, top - y, left - x)
+    if cond.gligen_boxes is not None:
+        # reference crop_gligen: latent boxes → pixel space (×8),
+        # intersect with the tile window, re-origin, back to latent
+        # units. Non-intersecting boxes go inactive, not dropped.
+        boxes = []
+        active = []
+        for idx, (bh, bw, by, bx) in enumerate(cond.gligen_boxes):
+            x1, y1 = bx * 8, by * 8
+            x2, y2 = x1 + bw * 8, y1 + bh * 8
+            ix1, iy1 = max(x1, x), max(y1, y)
+            ix2, iy2 = min(x2, x + tile_w), min(y2, y + tile_h)
+            if ix1 >= ix2 or iy1 >= iy2:
+                boxes.append((0, 0, 0, 0))
+                active.append(False)
+                continue
+            ix1, ix2 = ix1 - x, ix2 - x
+            iy1, iy2 = iy1 - y, iy2 - y
+            boxes.append(
+                ((iy2 - iy1) // 8, (ix2 - ix1) // 8, iy1 // 8, ix1 // 8)
+            )
+            active.append(True)
+        out.gligen_boxes = tuple(boxes)
+        out.gligen_active = tuple(active)
+    if cond.reference_latents is not None:
+        # reference crop_reference_latents: resize each latent to the
+        # canvas latent grid, window the tile's latent region, resize
+        # to the tile latent size
+        k = 8
+        canvas = (image_h // k, image_w // k)
+        t_lat = (max(1, tile_h // k), max(1, tile_w // k))
+        cropped = []
+        for lat in cond.reference_latents:
+            b, _, _, c = lat.shape
+            if lat.shape[1:3] != canvas:
+                lat = jax.image.resize(
+                    lat, (b, canvas[0], canvas[1], c), method="linear"
+                )
+            y0, x0 = max(0, y) // k, max(0, x) // k
+            y1 = min(canvas[0], (y + tile_h) // k)
+            x1 = min(canvas[1], (x + tile_w) // k)
+            window = lat[:, y0:max(y1, y0 + 1), x0:max(x1, x0 + 1), :]
+            cropped.append(
+                jax.image.resize(
+                    window, (b, t_lat[0], t_lat[1], c), method="linear"
+                )
+            )
+        out.reference_latents = cropped
+    if cond.model_patches is not None:
+        # TPU-native analog of the reference's crop_model_patch: any
+        # spatial patch windows to the tile like a ControlNet hint
+        patched = {}
+        for name, patch in cond.model_patches.items():
+            p = patch
+            if p.shape[1] != image_h or p.shape[2] != image_w:
+                p = jax.image.resize(
+                    p, (p.shape[0], image_h, image_w, p.shape[3]),
+                    method="linear",
+                )
+            pad_y0, pad_x0 = max(0, -y), max(0, -x)
+            pad_y1 = max(0, y + tile_h - image_h)
+            pad_x1 = max(0, x + tile_w - image_w)
+            if pad_y0 or pad_x0 or pad_y1 or pad_x1:
+                p = jnp.pad(
+                    p,
+                    ((0, 0), (pad_y0, pad_y1), (pad_x0, pad_x1), (0, 0)),
+                    mode="edge",
+                )
+            patched[name] = jax.lax.dynamic_slice(
+                p, (0, y + pad_y0, x + pad_x0, 0),
+                (p.shape[0], tile_h, tile_w, p.shape[3]),
+            )
+        out.model_patches = patched
     return out
 
 
@@ -147,6 +236,10 @@ def slice_batch(cond: Conditioning, start: int, size: int) -> Conditioning:
     out.context = cut(cond.context)
     out.control_hint = cut(cond.control_hint)
     out.mask = cut(cond.mask)
+    if cond.reference_latents is not None:
+        out.reference_latents = [cut(lat) for lat in cond.reference_latents]
+    if cond.model_patches is not None:
+        out.model_patches = {k: cut(v) for k, v in cond.model_patches.items()}
     return out
 
 
@@ -161,15 +254,21 @@ import jax.tree_util as _jtu
 def _cond_flatten(cond: Conditioning):
     children = (
         cond.context, cond.control_hint, cond.mask, cond.control_params,
-        cond.pooled,
+        cond.pooled, cond.gligen_embs, cond.reference_latents,
+        cond.model_patches,
     )
-    aux = (cond.control_strength, cond.area, cond.control_module)
+    aux = (
+        cond.control_strength, cond.area, cond.control_module,
+        cond.gligen_boxes, cond.gligen_active,
+    )
     return children, aux
 
 
 def _cond_unflatten(aux, children):
-    context, control_hint, mask, control_params, pooled = children
-    control_strength, area, control_module = aux
+    (context, control_hint, mask, control_params, pooled, gligen_embs,
+     reference_latents, model_patches) = children
+    (control_strength, area, control_module, gligen_boxes,
+     gligen_active) = aux
     return Conditioning(
         context=context,
         control_hint=control_hint,
@@ -179,6 +278,11 @@ def _cond_unflatten(aux, children):
         control_params=control_params,
         control_module=control_module,
         pooled=pooled,
+        gligen_embs=gligen_embs,
+        gligen_boxes=gligen_boxes,
+        gligen_active=gligen_active,
+        reference_latents=reference_latents,
+        model_patches=model_patches,
     )
 
 

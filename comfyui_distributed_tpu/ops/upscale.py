@@ -111,6 +111,32 @@ def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
                 mask, (mask.shape[0], grid.image_h, grid.image_w), method="linear"
             )
         c.mask = jnp.pad(mask, ((0, 0), (p, p), (p, p)), mode="reflect")
+    if c.model_patches is not None:
+        patched = {}
+        for name, patch in c.model_patches.items():
+            if patch.shape[1] != grid.image_h or patch.shape[2] != grid.image_w:
+                patch = jax.image.resize(
+                    patch,
+                    (patch.shape[0], grid.image_h, grid.image_w, patch.shape[3]),
+                    method="linear",
+                )
+            patched[name] = jnp.pad(
+                patch, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
+            )
+        c.model_patches = patched
+    if c.reference_latents is not None:
+        # resize to the padded-canvas latent grid so per-tile latent
+        # windows slice at origin//8 (padding is a multiple of 8 in
+        # the supported configs)
+        k = 8
+        lat_h = (grid.image_h + 2 * p) // k
+        lat_w = (grid.image_w + 2 * p) // k
+        c.reference_latents = [
+            jax.image.resize(
+                lat, (lat.shape[0], lat_h, lat_w, lat.shape[3]), method="linear"
+            )
+            for lat in c.reference_latents
+        ]
     return c
 
 
@@ -133,6 +159,23 @@ def tile_cond(cond, y, x, grid: tile_ops.TileGrid):
         c.mask = jax.lax.dynamic_slice(
             c.mask, (0, y, x), (c.mask.shape[0], grid.padded_h, grid.padded_w)
         )
+    if c.model_patches is not None:
+        c.model_patches = {
+            name: jax.lax.dynamic_slice(
+                patch, (0, y, x, 0),
+                (patch.shape[0], grid.padded_h, grid.padded_w, patch.shape[3]),
+            )
+            for name, patch in c.model_patches.items()
+        }
+    if c.reference_latents is not None:
+        k = 8
+        th, tw = max(1, grid.padded_h // k), max(1, grid.padded_w // k)
+        c.reference_latents = [
+            jax.lax.dynamic_slice(
+                lat, (0, y // k, x // k, 0), (lat.shape[0], th, tw, lat.shape[3])
+            )
+            for lat in c.reference_latents
+        ]
     return c
 
 

@@ -110,3 +110,31 @@ def test_remat_parity():
     out_a = unet_a.apply(params, x, t, ctx)
     out_b = unet_b.apply(params, x, t, ctx)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-6)
+
+
+def test_unet_small_and_odd_latents():
+    """Latents not divisible by 2^depth must round-trip the U (the
+    32px-input crash found in the round-2 verify drive: 4x4 latents
+    through three downsamples)."""
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.models.unet import UNet, UNetConfig
+
+    cfg = UNetConfig(
+        model_channels=8,
+        channel_mult=(1, 2, 4, 4),
+        num_res_blocks=1,
+        transformer_depth=(1, 1, 1, 0),
+        context_dim=16,
+        num_heads=2,
+        dtype="float32",
+    )
+    model = UNet(cfg)
+    ctx = jnp.zeros((1, 4, 16))
+    t = jnp.zeros((1,))
+    for size in (4, 5):
+        x = jnp.zeros((1, size, size, cfg.in_channels))
+        params = model.init(jax.random.key(0), x, t, ctx)
+        out = model.apply(params, x, t, ctx)
+        assert out.shape == x.shape

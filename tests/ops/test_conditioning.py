@@ -132,3 +132,144 @@ def test_pooled_adm_conditioning_path():
         bundle, latents, pos_zero, neg_zero, steps=2, denoise=1.0, seed=3
     )
     assert not np.array_equal(np.asarray(out), np.asarray(out_zero))
+
+
+# --- round-2 parity tail: GLIGEN / reference_latents / model patches ------
+
+def _mk(ctx_batch=1):
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+    return Conditioning(context=jnp.zeros((ctx_batch, 4, 8)))
+
+
+def test_gligen_box_window_math():
+    """Reference crop_gligen parity: latent boxes scale x8 to pixels,
+    intersect with the tile, re-origin, and return to latent units."""
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.conditioning import crop_to_tile
+
+    cond = _mk()
+    # box: 16x16 latent units at (y=4, x=8) => pixels (32,64)-(160,192)
+    cond.gligen_embs = jnp.ones((2, 8))
+    cond.gligen_boxes = ((16, 16, 4, 8), (4, 4, 60, 60))
+    out = crop_to_tile(cond, y=64, x=64, tile_h=128, tile_w=128,
+                       image_h=512, image_w=512)
+    # intersection with tile (64..192, 64..192): y 64..160, x 64..192
+    # tile-local: y 0..96, x 0..192->128... x2=min(192,192)=192-64=128
+    assert out.gligen_active == (True, False)
+    h, w, y, x = out.gligen_boxes[0]
+    assert (h, w, y, x) == (96 // 8, 128 // 8, 0, 0)
+    # second box at latent (60,60) => pixels 480.. outside the tile
+    assert out.gligen_boxes[1] == (0, 0, 0, 0)
+
+
+def test_gligen_box_fully_inside():
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.conditioning import crop_to_tile
+
+    cond = _mk()
+    cond.gligen_embs = jnp.ones((1, 8))
+    cond.gligen_boxes = ((8, 8, 12, 12),)  # pixels (96,96)-(160,160)
+    out = crop_to_tile(cond, y=64, x=64, tile_h=128, tile_w=128,
+                       image_h=512, image_w=512)
+    assert out.gligen_active == (True,)
+    # tile-local pixel box (32,32)-(96,96) => latent (8,8) at (4,4)
+    assert out.gligen_boxes[0] == (8, 8, 4, 4)
+
+
+def test_reference_latents_windowed_to_tile():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.conditioning import crop_to_tile
+
+    cond = _mk()
+    # canvas 256px -> latent 32; distinctive gradient to verify window
+    lat = jnp.arange(32 * 32, dtype=jnp.float32).reshape(1, 32, 32, 1)
+    cond.reference_latents = [lat]
+    out = crop_to_tile(cond, y=64, x=128, tile_h=64, tile_w=64,
+                       image_h=256, image_w=256)
+    got = out.reference_latents[0]
+    assert got.shape == (1, 8, 8, 1)
+    expect = np.asarray(lat)[:, 8:16, 16:24, :]
+    np.testing.assert_allclose(np.asarray(got), expect, atol=1e-5)
+
+
+def test_reference_latents_resized_when_mismatched():
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.conditioning import crop_to_tile
+
+    cond = _mk()
+    cond.reference_latents = [jnp.ones((1, 16, 16, 4))]  # not canvas-sized
+    out = crop_to_tile(cond, y=0, x=0, tile_h=128, tile_w=128,
+                       image_h=512, image_w=512)
+    assert out.reference_latents[0].shape == (1, 16, 16, 4)
+
+
+def test_model_patches_crop_like_hints():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.conditioning import crop_to_tile
+
+    cond = _mk()
+    patch = jnp.arange(64 * 64, dtype=jnp.float32).reshape(1, 64, 64, 1)
+    cond.model_patches = {"diffsynth_hint": patch}
+    out = crop_to_tile(cond, y=16, x=32, tile_h=16, tile_w=16,
+                       image_h=64, image_w=64)
+    got = out.model_patches["diffsynth_hint"]
+    assert got.shape == (1, 16, 16, 1)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(patch)[:, 16:32, 32:48, :]
+    )
+
+
+def test_slice_batch_covers_new_payloads():
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.conditioning import Conditioning, slice_batch
+
+    cond = Conditioning(
+        context=jnp.zeros((4, 4, 8)),
+        reference_latents=[jnp.zeros((4, 8, 8, 4))],
+        model_patches={"p": jnp.zeros((4, 16, 16, 1))},
+    )
+    out = slice_batch(cond, 1, 2)
+    assert out.context.shape[0] == 2
+    assert out.reference_latents[0].shape[0] == 2
+    assert out.model_patches["p"].shape[0] == 2
+
+
+def test_traced_tile_cond_reference_latents_and_patches():
+    """The mesh/scan path: prep pads to the canvas+padding grid, then
+    traced origins slice constant-size windows."""
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+    from comfyui_distributed_tpu.ops import upscale as up
+    from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+    grid = tile_ops.calculate_tiles(128, 128, 64, 16)
+    cond = Conditioning(
+        context=jnp.zeros((1, 4, 8)),
+        reference_latents=[jnp.zeros((1, 16, 16, 4))],
+        model_patches={"p": jnp.zeros((1, 128, 128, 1))},
+    )
+    prepped = up.prep_cond_for_tiles(cond, grid)
+    k = 8
+    assert prepped.model_patches["p"].shape[1] == 128 + 2 * grid.padding
+    assert prepped.reference_latents[0].shape[1] == (128 + 2 * grid.padding) // k
+
+    def slice_at(y, x):
+        c = up.tile_cond(prepped, y, x, grid)
+        return c.reference_latents[0], c.model_patches["p"]
+
+    lat, patch = jax.jit(slice_at)(jnp.int32(16), jnp.int32(64))
+    assert lat.shape == (1, grid.padded_h // k, grid.padded_w // k, 4)
+    assert patch.shape == (1, grid.padded_h, grid.padded_w, 1)
