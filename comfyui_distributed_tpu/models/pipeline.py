@@ -23,16 +23,18 @@ from .text_encoder import Tokenizer
 
 @dataclasses.dataclass
 class PipelineBundle:
-    """A checkpoint: diffusion backbone + VAE + text encoder + params."""
+    """A checkpoint: diffusion backbone + VAE + text encoder(s) + params."""
 
     model_name: str
     unet: Any
     vae: Any
     text_encoder: Any
-    params: dict[str, Any]          # {"unet": ..., "vae": ..., "te": ...}
+    params: dict[str, Any]          # {"unet", "vae", "te"[, "te2"]}
     tokenizer: Tokenizer
     latent_channels: int = 4
     latent_scale: int = 8           # spatial down factor of the VAE
+    # SDXL-class second encoder (context concat + pooled source)
+    text_encoder_2: Any = None
 
 
 def load_pipeline(
@@ -52,9 +54,17 @@ def load_pipeline(
     Without a checkpoint the weights are deterministic random init —
     the distributed machinery upstream is weight-agnostic.
     """
+    from .registry import DUAL_TEXT_ENCODERS
+
     tiny = model_name.startswith("tiny")
+    dual = DUAL_TEXT_ENCODERS.get(model_name)
     vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
-    te_name = te_name or ("tiny-te" if tiny else "clip-l")
+    if dual:
+        te_name = te_name or dual[0]
+        te2_name = dual[1]
+    else:
+        te_name = te_name or ("tiny-te" if tiny else "clip-l")
+        te2_name = None
 
     unet = create_model(model_name)
     vae = create_model(vae_name)
@@ -81,6 +91,14 @@ def load_pipeline(
     tokens = jnp.zeros((1, te_cfg.max_length), jnp.int32)
     te_params = te.init(k_te, tokens)
 
+    te2 = None
+    te2_params = None
+    if te2_name:
+        te2 = create_model(te2_name)
+        te2_cfg = get_config(te2_name)
+        tokens2 = jnp.zeros((1, te2_cfg.max_length), jnp.int32)
+        te2_params = te2.init(jax.random.fold_in(k_te, 2), tokens2)
+
     from . import sd_checkpoint as sdc
 
     ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
@@ -89,40 +107,57 @@ def load_pipeline(
 
         log(f"loading checkpoint {ckpt_path} for {model_name}")
         state_dict = sdc.read_checkpoint(ckpt_path)
+        templates = {"unet": unet_params, "vae": vae_params, "te": te_params}
+        if te2_params is not None:
+            templates["te2"] = te2_params
         mapped, _problems = sdc.load_sd_weights(
-            state_dict, unet_cfg, vae_cfg, te_cfg,
-            {"unet": unet_params, "vae": vae_params, "te": te_params},
+            state_dict, unet_cfg, vae_cfg, te_cfg, templates,
+            te2_cfg=get_config(te2_name) if te2_name else None,
         )
         unet_params = mapped["unet"]
         vae_params = mapped["vae"]
         te_params = mapped["te"]
+        te2_params = mapped.get("te2", te2_params)
 
+    params = {"unet": unet_params, "vae": vae_params, "te": te_params}
+    if te2_params is not None:
+        params["te2"] = te2_params
     return PipelineBundle(
         model_name=model_name,
         unet=unet,
         vae=vae,
         text_encoder=te,
-        params={"unet": unet_params, "vae": vae_params, "te": te_params},
+        params=params,
         tokenizer=Tokenizer(max_length=te_cfg.max_length),
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
+        text_encoder_2=te2,
     )
 
 
 # --- conditioning --------------------------------------------------------
 
-def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
-    """Prompts → [B, T, context_dim] context.
+def _encode_raw(bundle: PipelineBundle, texts: list[str]):
+    """Prompts → (hidden [B, T, D], pooled [B, P]).
 
-    When the encoder width and the backbone's context_dim differ (e.g.
-    SDXL's 2048-d context fed by multiple encoders), the hidden states
-    are zero-padded/truncated to fit; a second encoder concat slots in
-    here when dual-encoder checkpoints are supported.
+    Dual-encoder bundles (SDXL layout): context is the channel concat
+    of both encoders' hidden states and pooled comes from the second
+    (projected) encoder — the real SDXL conditioning, replacing the
+    round-1 zero-pad hack. Single-encoder bundles pad/truncate to the
+    backbone's context_dim only when they genuinely mismatch.
     """
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
-    hidden, _pooled = bundle.text_encoder.apply(
+    hidden, pooled = bundle.text_encoder.apply(
         bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
     )
+    if bundle.text_encoder_2 is not None:
+        hidden2, pooled2 = bundle.text_encoder_2.apply(
+            bundle.params["te2"], tokens, eos_id=bundle.tokenizer.eos_id
+        )
+        hidden = jnp.concatenate(
+            [hidden.astype(jnp.float32), hidden2.astype(jnp.float32)], axis=-1
+        )
+        pooled = pooled2
     from .registry import get_config
 
     ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
@@ -130,6 +165,12 @@ def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
         hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
     elif hidden.shape[-1] > ctx_dim:
         hidden = hidden[..., :ctx_dim]
+    return hidden, pooled
+
+
+def encode_text(bundle: PipelineBundle, texts: list[str]) -> jax.Array:
+    """Prompts → [B, T, context_dim] context."""
+    hidden, _pooled = _encode_raw(bundle, texts)
     return hidden
 
 
@@ -138,17 +179,7 @@ def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
     conditioning: pooled text is part of the UNet's label embedding)."""
     from ..ops.conditioning import Conditioning
 
-    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
-    hidden, pooled = bundle.text_encoder.apply(
-        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
-    )
-    from .registry import get_config
-
-    ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
-    if hidden.shape[-1] < ctx_dim:
-        hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
-    elif hidden.shape[-1] > ctx_dim:
-        hidden = hidden[..., :ctx_dim]
+    hidden, pooled = _encode_raw(bundle, texts)
     return Conditioning(context=hidden, pooled=pooled)
 
 
@@ -182,7 +213,29 @@ def _make_model_fn(bundle: PipelineBundle, params):
         adm = getattr(get_config(bundle.model_name), "adm_in_channels", 0)
         if adm and isinstance(cond, Conditioning) and cond.pooled is not None:
             pooled = cond.pooled
-            if pooled.shape[-1] < adm:
+            size_dims = adm - pooled.shape[-1]
+            if size_dims == 6 * 256:
+                # real SDXL adm layout: pooled text + six 256-d Fourier
+                # size embeddings (orig_h, orig_w, crop_t, crop_l,
+                # target_h, target_w) — crops 0, sizes from the latent
+                from .layers import timestep_embedding
+
+                h_px = x.shape[1] * bundle.latent_scale
+                w_px = x.shape[2] * bundle.latent_scale
+                vals = jnp.asarray(
+                    [h_px, w_px, 0.0, 0.0, h_px, w_px], jnp.float32
+                )
+                size_emb = timestep_embedding(vals, 256).reshape(1, -1)
+                pooled = jnp.concatenate(
+                    [
+                        pooled.astype(jnp.float32),
+                        jnp.broadcast_to(
+                            size_emb, (pooled.shape[0], size_emb.shape[-1])
+                        ),
+                    ],
+                    axis=-1,
+                )
+            elif pooled.shape[-1] < adm:
                 pooled = jnp.pad(pooled, ((0, 0), (0, adm - pooled.shape[-1])))
             elif pooled.shape[-1] > adm:
                 pooled = pooled[..., :adm]

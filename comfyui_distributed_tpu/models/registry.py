@@ -55,7 +55,8 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             num_heads=2,
         ),
     },
-    # tiny SDXL-shaped variant: pooled (adm) conditioning path
+    # tiny SDXL-shaped variant: dual text encoders + pooled/size adm
+    # conditioning (context 64+96, adm = 96 pooled + 6x256 size embs)
     "tiny-unet-adm": {
         "family": "unet",
         "config": UNetConfig(
@@ -63,9 +64,9 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             channel_mult=(1, 2),
             num_res_blocks=1,
             transformer_depth=(1, 1),
-            context_dim=64,
+            context_dim=160,
             num_heads=2,
-            adm_in_channels=32,
+            adm_in_channels=96 + 6 * 256,
         ),
     },
     # --- video DiT backbones ---
@@ -101,10 +102,44 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     },
     # --- text encoders ---
     "clip-l": {"family": "text_encoder", "config": TextEncoderConfig()},
+    # SDXL pair: CLIP-L penultimate + OpenCLIP bigG penultimate w/
+    # text projection (pooled source)
+    "clip-l-sdxl": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(penultimate_hidden=True),
+    },
+    "clip-g": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(
+            width=1280, layers=32, heads=20, activation="gelu",
+            penultimate_hidden=True, proj_dim=1280,
+        ),
+    },
     "tiny-te": {
         "family": "text_encoder",
         "config": TextEncoderConfig(width=64, layers=2, heads=2, max_length=16),
     },
+    # tiny SDXL-shaped dual pair (concat width 64+96=160)
+    "tiny-te-l": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(
+            width=64, layers=2, heads=2, max_length=16, penultimate_hidden=True
+        ),
+    },
+    "tiny-te-g": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(
+            width=96, layers=2, heads=2, max_length=16, activation="gelu",
+            penultimate_hidden=True, proj_dim=96,
+        ),
+    },
+}
+
+# Models whose conditioning comes from TWO encoders (SDXL layout):
+# context = concat(hidden_1, hidden_2); pooled = projected pooled_2.
+DUAL_TEXT_ENCODERS: dict[str, tuple[str, str]] = {
+    "sdxl": ("clip-l-sdxl", "clip-g"),
+    "tiny-unet-adm": ("tiny-te-l", "tiny-te-g"),
 }
 
 _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {

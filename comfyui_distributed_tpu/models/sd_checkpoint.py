@@ -248,10 +248,15 @@ def vae_schedule(cfg) -> list[Entry]:
     return entries
 
 
-def text_encoder_schedule(cfg) -> list[Entry]:
-    """CLIP text transformer (`cond_stage_model.transformer.text_model.*`)
-    → TextEncoder flax tree."""
-    p = "cond_stage_model.transformer.text_model"
+def text_encoder_schedule(
+    cfg, prefix: str = "cond_stage_model.transformer.text_model"
+) -> list[Entry]:
+    """HF-layout CLIP text transformer → TextEncoder flax tree.
+
+    `prefix` is `cond_stage_model.transformer.text_model` in SD1.x
+    single-file checkpoints and `conditioner.embedders.0.transformer.
+    text_model` for SDXL's CLIP-L half."""
+    p = prefix
     entries: list[Entry] = [
         (f"{p}.embeddings.token_embedding", "token_embedding", "embedding"),
         (f"{p}.embeddings.position_embedding", "position_embedding", "position"),
@@ -269,6 +274,36 @@ def text_encoder_schedule(cfg) -> list[Entry]:
             (f"{sd}.mlp.fc2", f"{fx}/fc2", _LINEAR),
         ]
     entries.append((f"{p}.final_layer_norm", "final_ln", _NORM))
+    if cfg.proj_dim is not None:
+        entries.append((f"{p}.text_projection", "text_projection", "param_bare"))
+    return entries
+
+
+def open_clip_schedule(
+    cfg, prefix: str = "conditioner.embedders.1.model"
+) -> list[Entry]:
+    """OpenCLIP-layout text transformer (SDXL's bigG half) →
+    TextEncoder flax tree. Differs from the HF layout: bare-parameter
+    positional embedding / text_projection, fused qkv in_proj, and
+    resblock naming."""
+    p = prefix
+    entries: list[Entry] = [
+        (f"{p}.token_embedding", "token_embedding", "embedding"),
+        (f"{p}.positional_embedding", "position_embedding", "param_bare"),
+    ]
+    for i in range(cfg.layers):
+        sd, fx = f"{p}.transformer.resblocks.{i}", f"block_{i}"
+        entries += [
+            (f"{sd}.ln_1", f"{fx}/LayerNorm_0", _NORM),
+            (f"{sd}.attn.in_proj", f"{fx}", "fused_qkv"),
+            (f"{sd}.attn.out_proj", f"{fx}/proj", _LINEAR),
+            (f"{sd}.ln_2", f"{fx}/LayerNorm_1", _NORM),
+            (f"{sd}.mlp.c_fc", f"{fx}/fc1", _LINEAR),
+            (f"{sd}.mlp.c_proj", f"{fx}/fc2", _LINEAR),
+        ]
+    entries.append((f"{p}.ln_final", "final_ln", _NORM))
+    if cfg.proj_dim is not None:
+        entries.append((f"{p}.text_projection", "text_projection", "param_bare"))
     return entries
 
 
@@ -296,6 +331,14 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
             out.append((f"{sd}.weight", f"{fx}/embedding", "id"))
         elif kind == "position":
             out.append((f"{sd}.weight", fx, "id"))
+        elif kind == "param_bare":  # bare nn.Parameter, no .weight suffix
+            out.append((sd, fx, "id"))
+        elif kind == "fused_qkv":
+            # OpenCLIP in_proj: one [3W, W] weight / [3W] bias → the
+            # three q/k/v Dense params
+            for slot, name in enumerate(("q", "k", "v")):
+                out.append((f"{sd}_weight", f"{fx}/{name}/kernel", f"qkv{slot}_w"))
+                out.append((f"{sd}_bias", f"{fx}/{name}/bias", f"qkv{slot}_b"))
         else:  # pragma: no cover
             raise ValueError(f"unknown kind {kind}")
     return out
@@ -310,6 +353,11 @@ def _transform(value: np.ndarray, how: str) -> np.ndarray:
         if value.ndim == 4:  # conv 1x1 → dense
             return np.transpose(value[:, :, 0, 0], (1, 0))
         return np.transpose(value, (1, 0))
+    if how.startswith("qkv"):
+        slot = int(how[3])
+        third = value.shape[0] // 3
+        part = value[slot * third : (slot + 1) * third]
+        return np.transpose(part, (1, 0)) if how.endswith("_w") else part
     return value
 
 
@@ -344,11 +392,20 @@ def synthesize_state_dict(
     """Inverse of convert_state_dict for tests: flax tree → SD-format
     state dict with torch layouts."""
     out: dict[str, np.ndarray] = {}
+    fused: dict[str, list] = {}
     for sd_key, fx_path, how in _expand(entries):
         value = flat_params.get(f"params/{fx_path}")
         if value is None:
             raise KeyError(f"flax template lacks {fx_path} (for {sd_key})")
-        out[sd_key] = _inverse_transform(np.asarray(value), how)
+        value = np.asarray(value)
+        if how.startswith("qkv"):
+            slot = int(how[3])
+            part = np.transpose(value, (1, 0)) if how.endswith("_w") else value
+            fused.setdefault(sd_key, [None, None, None])[slot] = part
+        else:
+            out[sd_key] = _inverse_transform(value, how)
+    for sd_key, parts in fused.items():
+        out[sd_key] = np.concatenate(parts, axis=0)
     return out
 
 
@@ -405,6 +462,7 @@ def load_sd_weights(
     te_cfg,
     templates: dict[str, Any],
     strict: bool = True,
+    te2_cfg: Any = None,
 ) -> tuple[dict[str, Any], list[str]]:
     """Map a full SD checkpoint onto {'unet','vae','te'} param trees.
 
@@ -415,11 +473,19 @@ def load_sd_weights(
     from .io import flatten_params, unflatten_params
     import jax
 
+    sdxl_layout = any(k.startswith("conditioner.embedders.") for k in state_dict)
+    te_prefix = (
+        "conditioner.embedders.0.transformer.text_model"
+        if sdxl_layout
+        else "cond_stage_model.transformer.text_model"
+    )
     schedules = {
         "unet": unet_schedule(unet_cfg),
         "vae": vae_schedule(vae_cfg),
-        "te": text_encoder_schedule(te_cfg),
+        "te": text_encoder_schedule(te_cfg, prefix=te_prefix),
     }
+    if "te2" in templates:
+        schedules["te2"] = open_clip_schedule(te2_cfg)
     result: dict[str, Any] = {}
     problems: list[str] = []
     for part, entries in schedules.items():

@@ -31,6 +31,13 @@ class TextEncoderConfig:
     layers: int = 12
     heads: int = 12
     dtype: str = "bfloat16"
+    # "quick_gelu" = OpenAI CLIP-L (SD1.x); "gelu" = OpenCLIP bigG (SDXL)
+    activation: str = "quick_gelu"
+    # SDXL encoders expose the PENULTIMATE block's hidden states (no
+    # final LN) as the context; pooled always comes from the full stack
+    penultimate_hidden: bool = False
+    # OpenCLIP text_projection: pooled = eos_state @ W [width, proj_dim]
+    proj_dim: Optional[int] = None
 
     @property
     def compute_dtype(self):
@@ -75,6 +82,7 @@ class Tokenizer:
 class _CausalBlock(nn.Module):
     heads: int
     dtype: jnp.dtype
+    activation: str = "quick_gelu"
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
@@ -100,9 +108,12 @@ class _CausalBlock(nn.Module):
 
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         h = nn.Dense(width * 4, dtype=self.dtype, name="fc1")(h)
-        # CLIP's quick_gelu — required for real CLIP-L weights to
-        # reproduce reference activations
-        h = h * jax.nn.sigmoid(1.702 * h)
+        if self.activation == "quick_gelu":
+            # OpenAI CLIP — required for real CLIP-L weights to
+            # reproduce reference activations
+            h = h * jax.nn.sigmoid(1.702 * h)
+        else:  # OpenCLIP (SDXL bigG) uses exact gelu
+            h = nn.gelu(h, approximate=False)
         h = nn.Dense(width, dtype=self.dtype, name="fc2")(h)
         return x + h
 
@@ -131,14 +142,32 @@ class TextEncoder(nn.Module):
         )
         x = (tok_emb + pos_emb[None, :t, :]).astype(dt)
         causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+        penultimate = None
         for i in range(cfg.layers):
-            x = _CausalBlock(cfg.heads, dt, name=f"block_{i}")(x, causal)
+            if cfg.penultimate_hidden and i == cfg.layers - 1:
+                penultimate = x
+            x = _CausalBlock(
+                cfg.heads, dt, cfg.activation, name=f"block_{i}"
+            )(x, causal)
         x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")(
             x.astype(jnp.float32)
         )
-        # pooled = state at first EOS position per sequence
+        # pooled = state at first EOS position per sequence (from the
+        # FULL stack + final LN, even when hidden is penultimate)
         if eos_id is None:
             eos_id = Tokenizer.EOS
         eos_pos = jnp.argmax((tokens == eos_id).astype(jnp.int32), axis=1)
         pooled = x[jnp.arange(b), eos_pos]
-        return x, pooled
+        if cfg.proj_dim is not None:
+            proj = self.param(
+                "text_projection",
+                nn.initializers.normal(cfg.width ** -0.5),
+                (cfg.width, cfg.proj_dim),
+            )
+            pooled = pooled @ proj.astype(pooled.dtype)
+        hidden = (
+            penultimate.astype(jnp.float32)
+            if cfg.penultimate_hidden
+            else x
+        )
+        return hidden, pooled

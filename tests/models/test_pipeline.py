@@ -45,3 +45,21 @@ def test_img2img_latents_partial_denoise():
     assert np.isfinite(np.asarray(out)).all()
     # low denoise keeps output in the latents' neighborhood, not noise-scale
     assert float(jnp.abs(out).mean()) < 5.0
+
+
+def test_dual_encoder_context_concat():
+    """SDXL layout: context = concat of both encoders' penultimate
+    hidden states (no zero padding), pooled from the projected second
+    encoder."""
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.models import pipeline as pl
+
+    bundle = pl.load_pipeline("tiny-unet-adm", seed=0)
+    assert bundle.text_encoder_2 is not None
+    cond = pl.encode_text_pooled(bundle, ["a castle on a hill"])
+    # tiny-te-l width 64 + tiny-te-g width 96 = context 160
+    assert cond.context.shape[-1] == 160
+    assert cond.pooled.shape == (1, 96)
+    # concat halves differ from zero-pad: second half must be nonzero
+    assert float(jnp.abs(cond.context[..., 64:]).max()) > 0

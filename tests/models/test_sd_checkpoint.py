@@ -253,3 +253,53 @@ def test_sd15_eval_shape_template_covered():
     dangling = flax_paths - set(flat)
     assert not missing, sorted(missing)[:8]
     assert not dangling, sorted(dangling)[:8]
+
+
+def test_open_clip_schedule_roundtrip():
+    """SDXL's bigG half: fused-qkv split + bare params round-trip."""
+    cfg, params = _template("tiny-te-g", "te")
+    flat = flatten_params(jax.device_get(params))
+    entries = sdc.open_clip_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, entries)
+    assert any(k.endswith(".attn.in_proj_weight") for k in state_dict)
+    assert "conditioner.embedders.1.model.positional_embedding" in state_dict
+    assert "conditioner.embedders.1.model.text_projection" in state_dict
+    converted, missing = sdc.convert_state_dict(state_dict, entries)
+    assert not missing
+    assert set(converted) == set(flat)
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+
+def test_sdxl_text_prefix_detected():
+    """A checkpoint with conditioner.embedders.* keys maps the CLIP-L
+    half from the SDXL prefix and the bigG half from open_clip."""
+    te_cfg, te_p = _template("tiny-te-l", "te")
+    te2_cfg, te2_p = _template("tiny-te-g", "te")
+    unet_cfg, unet_p = _template("tiny-unet", "unet")
+    vae_cfg, vae_p = _template("tiny-vae", "vae")
+
+    state_dict = {}
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(unet_p)), sdc.unet_schedule(unet_cfg)))
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(vae_p)), sdc.vae_schedule(vae_cfg)))
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(te_p)),
+        sdc.text_encoder_schedule(
+            te_cfg, prefix="conditioner.embedders.0.transformer.text_model"
+        ),
+    ))
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(te2_p)), sdc.open_clip_schedule(te2_cfg)))
+
+    out, problems = sdc.load_sd_weights(
+        state_dict, unet_cfg, vae_cfg, te_cfg,
+        {"unet": unet_p, "vae": vae_p, "te": te_p, "te2": te2_p},
+        te2_cfg=te2_cfg,
+    )
+    assert problems == []
+    got = flatten_params(out["te2"])
+    want = flatten_params(jax.device_get(te2_p))
+    for key in want:
+        np.testing.assert_array_equal(got[key], want[key], err_msg=key)

@@ -109,7 +109,9 @@ def test_pooled_adm_conditioning_path():
     bundle = pl.load_pipeline("tiny-unet-adm", seed=0)
     pos = pl.encode_text_pooled(bundle, ["a castle"])
     neg = pl.encode_text_pooled(bundle, [""])
-    assert pos.pooled is not None and pos.pooled.shape == (1, 64)
+    # dual-encoder bundle: pooled comes from the projected second
+    # encoder (tiny-te-g, proj_dim=96)
+    assert pos.pooled is not None and pos.pooled.shape == (1, 96)
     # the zero-init output conv hides every internal signal; randomise
     # it so the adm path's effect is observable at the output
     params = jax.tree_util.tree_map(lambda a: a, bundle.params)
