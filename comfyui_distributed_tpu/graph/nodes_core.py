@@ -277,21 +277,10 @@ class ImageScale:
     RETURN_TYPES = ("IMAGE",)
     FUNCTION = "scale"
 
-    _METHODS = {
-        "nearest-exact": "nearest",
-        "nearest": "nearest",
-        "bilinear": "linear",
-        "bicubic": "cubic",
-        "lanczos": "lanczos3",
-        "area": "linear",
-    }
-
     def scale(self, image, upscale_method, width, height, crop="disabled", context=None):
-        b, _, _, c = image.shape
-        method = self._METHODS.get(str(upscale_method), "linear")
-        out = jax.image.resize(
-            image, (b, int(height), int(width), c), method=method
-        )
+        from ..ops.upscale import resize_image
+
+        out = resize_image(image, int(height), int(width), str(upscale_method))
         return (jnp.clip(out, 0.0, 1.0),)
 
 

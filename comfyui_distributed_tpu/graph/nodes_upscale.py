@@ -103,6 +103,17 @@ class UltimateSDUpscaleDistributed:
         seed = getattr(seed, "base_seed", seed)  # accept SeedSpec links
         if sampler_name not in SAMPLER_NAMES:
             raise ValueError(f"unknown sampler {sampler_name!r}")
+        if not force_uniform_tiles:
+            # Loud rejection, not silent acceptance: non-uniform tiles
+            # produce per-tile shapes, which defeat XLA compilation
+            # caching (a fresh compile per tile geometry). The uniform
+            # grid covers the same canvas by overlapping edge tiles
+            # instead — see docs/distributed-modes.md.
+            raise ValueError(
+                "force_uniform_tiles=False is not supported on TPU: "
+                "non-uniform tile shapes force per-tile recompilation. "
+                "Uniform tiles cover the full canvas via overlap."
+            )
         batch = int(image.shape[0])
         if batch > 1 and (batch - 1) % 4 != 0:
             # WAN-family video models require 4n+1 frame batches

@@ -149,7 +149,7 @@ def sample(
     if sampler == "dpmpp_2m":
         return _sample_dpmpp_2m(model_fn, x_init, sigmas, cond)
     if sampler == "ddim":
-        return _sample_euler(model_fn, x_init, sigmas, cond)  # eta=0 DDIM ≡ euler in sigma space
+        return _sample_ddim(model_fn, x_init, sigmas, cond)
     if sampler == "euler_ancestral":
         if noise_key is None:
             raise ValueError("euler_ancestral requires noise_key")
@@ -163,6 +163,25 @@ def _sample_euler(model_fn, x, sigmas, cond):
         den = _denoised(model_fn, x, sigma, cond)
         d = (x - den) / jnp.maximum(sigma, 1e-10)
         return x + d * (sigma_next - sigma), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def _sample_ddim(model_fn, x, sigmas, cond):
+    """Deterministic (eta=0) DDIM, written in its own form:
+    x_{t-1} = x0_hat + sigma_next * eps_hat. In the sigma-space eps
+    parameterisation this is algebraically identical to the Euler step
+    (x + (x-x0)/sigma * (sigma_next-sigma)) — the name is kept as a
+    first-class sampler so the equivalence is explicit, not a silent
+    alias."""
+
+    def step(x, sig_pair):
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        eps = (x - den) / jnp.maximum(sigma, 1e-10)
+        return den + sigma_next * eps, None
 
     pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
     x, _ = jax.lax.scan(step, x, pairs)

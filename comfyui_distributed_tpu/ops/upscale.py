@@ -31,15 +31,57 @@ from . import samplers as smp
 from . import tiles as tile_ops
 
 
-# jax.image.resize method names for the user-facing upscale_method knob
+# jax.image.resize method names for the user-facing upscale_method
+# knob; "area" has no jax.image equivalent and gets an exact adaptive
+# box-average implementation below (torch F.interpolate mode='area'
+# semantics)
 RESIZE_METHODS = {
     "bicubic": "cubic",
     "bilinear": "linear",
     "nearest": "nearest",
     "nearest-exact": "nearest",
     "lanczos": "lanczos3",
-    "area": "linear",
 }
+
+
+def _area_weights(n_out: int, n_in: int) -> jnp.ndarray:
+    """[n_out, n_in] row-stochastic box weights: output cell i averages
+    input cells overlapping [i*n_in/n_out, (i+1)*n_in/n_out) with
+    fractional edge coverage — exact adaptive-average-pool semantics."""
+    import numpy as np
+
+    scale = n_in / n_out
+    w = np.zeros((n_out, n_in), dtype=np.float32)
+    for i in range(n_out):
+        lo, hi = i * scale, (i + 1) * scale
+        j0, j1 = int(np.floor(lo)), int(np.ceil(hi))
+        for j in range(j0, min(j1, n_in)):
+            cover = min(hi, j + 1) - max(lo, j)
+            if cover > 0:
+                w[i, j] = cover
+        w[i] /= max(w[i].sum(), 1e-12)
+    return jnp.asarray(w)
+
+
+def area_resize(image: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """[B, H, W, C] → [B, out_h, out_w, C] by exact box averaging —
+    two dense matmuls, MXU-friendly."""
+    wh = _area_weights(out_h, image.shape[1])
+    ww = _area_weights(out_w, image.shape[2])
+    return jnp.einsum(
+        "oh,bhwc,pw->bopc", wh, image.astype(jnp.float32), ww
+    )
+
+
+def resize_image(
+    image: jax.Array, out_h: int, out_w: int, method_name: str
+) -> jax.Array:
+    """Route a user-facing resize-method name to the right kernel."""
+    if method_name == "area":
+        return area_resize(image, out_h, out_w)
+    method = RESIZE_METHODS.get(method_name, "cubic")
+    b, _, _, c = image.shape
+    return jax.image.resize(image, (b, out_h, out_w, c), method=method)
 
 
 def plan_grid(
@@ -78,9 +120,8 @@ def prepare_upscaled_tiles(
     makes cross-participant requeue seamless."""
     b, h, w, c = image.shape
     out_h, out_w, grid = plan_grid(h, w, upscale_by, tile_w, padding, tile_h)
-    method = RESIZE_METHODS.get(upscale_method, "cubic")
     upscaled = jnp.clip(
-        jax.image.resize(image, (b, out_h, out_w, c), method=method), 0.0, 1.0
+        resize_image(image, out_h, out_w, upscale_method), 0.0, 1.0
     )
     return upscaled, grid, tile_ops.extract_tiles(upscaled, grid)
 

@@ -56,3 +56,78 @@ def test_mesh_matches_single(bundle):
     # and the mesh result is deterministic
     again = up.run_upscale(bundle, img, pos, neg, mesh=mesh, **kwargs)
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(again))
+
+
+# --- round-2 honest knobs -------------------------------------------------
+
+def test_area_resize_exact_box_average():
+    """area = adaptive box averaging (torch F.interpolate mode='area'
+    semantics), not a linear alias: integer downscale equals the plain
+    block mean exactly."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.upscale import area_resize
+
+    img = jnp.arange(1 * 8 * 8 * 2, dtype=jnp.float32).reshape(1, 8, 8, 2)
+    out = area_resize(img, 4, 4)
+    expect = np.asarray(img).reshape(1, 4, 2, 4, 2, 2).mean(axis=(2, 4))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_area_resize_fractional_factors():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.upscale import area_resize
+
+    img = jnp.ones((1, 7, 5, 3))
+    out = area_resize(img, 3, 2)
+    assert out.shape == (1, 3, 2, 3)
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)  # mean-preserving
+
+
+def test_resize_image_routes_area():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.upscale import area_resize, resize_image
+
+    img = jnp.arange(1 * 6 * 6 * 1, dtype=jnp.float32).reshape(1, 6, 6, 1)
+    np.testing.assert_allclose(
+        np.asarray(resize_image(img, 3, 3, "area")),
+        np.asarray(area_resize(img, 3, 3)),
+    )
+
+
+def test_ddim_matches_euler_exactly():
+    """The documented eta=0 equivalence, verified numerically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops import samplers as smp
+
+    def model_fn(x, sigma, cond):
+        return 0.1 * x + 0.01 * jnp.tanh(x)
+
+    x = jax.random.normal(jax.random.key(0), (2, 4, 4, 3))
+    sigmas = smp.get_sigmas("karras", 6)
+    a = smp.sample(model_fn, x, sigmas, None, "ddim")
+    b = smp.sample(model_fn, x, sigmas, None, "euler")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_force_uniform_tiles_false_rejected():
+    import pytest
+
+    from comfyui_distributed_tpu.graph.nodes_upscale import (
+        UltimateSDUpscaleDistributed,
+    )
+
+    node = UltimateSDUpscaleDistributed()
+    with pytest.raises(ValueError, match="force_uniform_tiles"):
+        node.run(
+            image=None, model=None, positive=None, negative=None, vae=None,
+            force_uniform_tiles=False,
+        )
