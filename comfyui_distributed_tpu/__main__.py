@@ -20,6 +20,13 @@ import sys
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     parser.add_argument("--port", type=int, default=8188)
+    parser.add_argument(
+        "--host", type=str, default=None,
+        help="bind address (default 127.0.0.1, or CDT_HOST; pass "
+             "0.0.0.0 to accept LAN/remote masters and workers — the "
+             "/distributed/* surface has no auth, so binding wide is "
+             "an explicit opt-in)",
+    )
     parser.add_argument("--worker", action="store_true")
     parser.add_argument("--config", type=str, default=None)
     parser.add_argument(
@@ -49,7 +56,8 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     server = DistributedServer(
-        port=args.port, is_worker=args.worker, config_path=args.config
+        port=args.port, is_worker=args.worker, config_path=args.config,
+        host=args.host,
     )
 
     async def run():

@@ -51,8 +51,15 @@ class DistributedServer:
         is_worker: Optional[bool] = None,
         mesh: Any = None,
         config_path: str | None = None,
+        host: str | None = None,
     ):
         self.port = port
+        # Default loopback: the /distributed/* surface carries
+        # process-launch and config-write endpoints with no auth, so
+        # LAN exposure (0.0.0.0) is an explicit opt-in via --host or
+        # CDT_HOST (the reference inherits the same default from
+        # ComfyUI's --listen behavior)
+        self.host = host or os.environ.get("CDT_HOST") or "127.0.0.1"
         self.is_worker = (
             is_worker
             if is_worker is not None
@@ -220,10 +227,10 @@ class DistributedServer:
         self._executor_thread.start()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        self._site = web.TCPSite(self._runner, "0.0.0.0", self.port)
+        self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()
         role = "worker" if self.is_worker else "master"
-        log(f"{role} server listening on :{self.port}")
+        log(f"{role} server listening on {self.host}:{self.port}")
 
     async def stop(self) -> None:
         self._prompt_queue.put(None)

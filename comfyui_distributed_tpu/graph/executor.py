@@ -213,4 +213,9 @@ class GraphExecutor:
                 cache[node_id] = (content_keys[node_id], result)
             if getattr(cls, "OUTPUT_NODE", False):
                 outputs[node_id] = result
+        # evict cache entries for node ids absent from this prompt:
+        # without this a long-lived server accumulates stale results
+        # (large tensors) for every node id any past prompt ever used
+        for stale_id in set(cache) - set(prompt):
+            del cache[stale_id]
         return outputs

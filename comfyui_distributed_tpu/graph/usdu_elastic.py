@@ -309,7 +309,9 @@ def run_master_elastic(
     run_async_in_server_loop(
         store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
     )
-    canvas = tile_ops.IncrementalCanvas(upscaled, grid)
+    # HTTP-tier tiles arrive host-side; the native feathered-blend
+    # canvas avoids a device round-trip per tile
+    canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 

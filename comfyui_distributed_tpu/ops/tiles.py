@@ -235,6 +235,44 @@ class IncrementalCanvas:
         ]
 
 
+class HostIncrementalCanvas:
+    """numpy/native twin of IncrementalCanvas for the HTTP tier.
+
+    Elastic-tier tiles arrive host-side (decoded from PNG envelopes),
+    so compositing on the host via the native feathered-blend kernel
+    (native/blendlib.cpp) avoids a device round-trip per tile; the
+    canvas moves to device once, in result(). Bit-equal in math to
+    IncrementalCanvas (same feather mask, same lerp) — pinned by test.
+    """
+
+    def __init__(self, images: jax.Array, grid: TileGrid):
+        import numpy as np
+
+        self.grid = grid
+        self.padded = np.ascontiguousarray(
+            np.asarray(pad_image_for_grid(images, grid), dtype=np.float32)
+        )
+        self._mask = np.asarray(
+            feather_mask(grid, dtype=jnp.float32), dtype=np.float32
+        )
+
+    def blend(self, tile, y, x) -> None:
+        import numpy as np
+
+        from ..native import feathered_blend_inplace
+
+        feathered_blend_inplace(
+            self.padded, np.asarray(tile, dtype=np.float32), self._mask,
+            int(y), int(x),
+        )
+
+    def result(self) -> jax.Array:
+        p = self.grid.padding
+        return jnp.asarray(
+            self.padded[:, p : p + self.grid.image_h, p : p + self.grid.image_w, :]
+        )
+
+
 def blend_single_tile(
     canvas: jax.Array, tile: jax.Array, y: int, x: int, grid: TileGrid
 ) -> jax.Array:
@@ -248,6 +286,6 @@ def blend_single_tile(
 def upscale_nearest(images: jax.Array, scale: int) -> jax.Array:
     """Cheap integer-factor spatial upscale [B,H,W,C] used before tiled
     re-diffusion (the reference delegates this to an upscale model or
-    PIL resize; lanczos/bicubic live in ops/resize.py)."""
+    PIL resize; lanczos/bicubic/area live in ops/upscale.resize_image)."""
     b, h, w, c = images.shape
     return jax.image.resize(images, (b, h * scale, w * scale, c), method="nearest")

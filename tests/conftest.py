@@ -49,8 +49,6 @@ _SLOW_PATHS = (
     "tests/api/test_usdu_integration.py",
     "tests/api/test_concurrency.py",
     "tests/api/test_delegate_mode.py",
-    "tests/api/test_distributed_exec.py",
-    "tests/api/test_server_routes.py",
 )
 
 

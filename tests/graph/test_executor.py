@@ -98,3 +98,27 @@ def test_batch_divider_in_graph():
     }
     outputs = GraphExecutor(ExecutionContext()).execute(prompt)
     assert list(outputs.values())[0][0]["images"].shape[0] == 0
+
+
+def test_node_cache_evicts_absent_node_ids():
+    """Cross-run cache entries for node ids not in the current prompt
+    are pruned (long-lived servers must not accumulate stale tensors)."""
+    from comfyui_distributed_tpu.graph.executor import ExecutionContext, GraphExecutor
+
+    ctx = ExecutionContext()
+    ex = GraphExecutor(ctx)
+    p1 = {
+        "1": {"class_type": "DistributedEmptyImage", "inputs": {}},
+        "2": {"class_type": "ImageScale",
+              "inputs": {"image": ["1", 0], "upscale_method": "nearest",
+                          "width": 4, "height": 4}},
+    }
+    ex.execute(p1)
+    cache = ctx.extras["node_cache"]
+    assert set(cache) <= {"1", "2"} and cache
+    p2 = {
+        "9": {"class_type": "DistributedEmptyImage", "inputs": {}},
+    }
+    ex.execute(p2)
+    assert "2" not in ctx.extras["node_cache"]
+    assert set(ctx.extras["node_cache"]) <= {"9"}

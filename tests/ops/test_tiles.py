@@ -122,3 +122,27 @@ def test_upscale_model_random_init_is_bilinear():
     assert out.shape == (1, 32, 32, 3)
     ref = jnp.clip(jax.image.resize(img, (1, 32, 32, 3), method="linear"), 0, 1)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_host_canvas_matches_jax_canvas():
+    """The native/host blend path must be math-identical to the jax
+    IncrementalCanvas (the elastic tier swaps between them)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+
+    grid = tile_ops.calculate_tiles(96, 96, 48, 8)
+    base = jax.random.uniform(jax.random.key(0), (1, 96, 96, 3))
+    jc = tile_ops.IncrementalCanvas(base, grid)
+    hc = tile_ops.HostIncrementalCanvas(base, grid)
+    for idx, (y, x) in enumerate(grid.positions):
+        tile = jax.random.uniform(
+            jax.random.key(idx + 1), (1, grid.padded_h, grid.padded_w, 3)
+        )
+        jc.blend(tile, y, x)
+        hc.blend(tile, y, x)
+    np.testing.assert_allclose(
+        np.asarray(jc.result()), np.asarray(hc.result()), atol=1e-6
+    )
