@@ -26,7 +26,9 @@ BLOCK_K = 128
 
 def _on_tpu() -> bool:
     try:
-        return jax.devices()[0].platform == "tpu"
+        # "axon" is the hosted TPU plugin's platform name; it runs the
+        # same Mosaic/Pallas lowering as the upstream "tpu" platform
+        return jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
         return False
 
@@ -38,25 +40,43 @@ def dot_product_attention(
 
     `force_flash` overrides backend routing (tests run the Pallas
     kernel in interpret mode on CPU to pin numerics).
+
+    Head dims that aren't lane-aligned (SD1.5 uses 40/80/160) are
+    zero-padded to the 128 lane width before the kernel — the MXU pads
+    those lanes anyway, so this costs nothing extra — with the softmax
+    scale pinned to the ORIGINAL head dim and the output sliced back.
     """
     use_flash = _flash_eligible(q, k) if force_flash is None else force_flash
     if use_flash:
         interpret = not _on_tpu()
+        d = q.shape[3]
+        if d % 128 != 0:
+            pad = -d % 128
+            widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+            out = flash_attention(
+                jnp.pad(q, widths), jnp.pad(k, widths), jnp.pad(v, widths),
+                scale=1.0 / math.sqrt(d), interpret=interpret,
+            )
+            return out[..., :d]
         return flash_attention(q, k, v, interpret=interpret)
     return jax.nn.dot_product_attention(q, k, v)
 
 
 def _flash_eligible(q: jax.Array, k: jax.Array) -> bool:
+    import os
+
+    if os.environ.get("CDT_FLASH") == "0":  # kill switch
+        return False
     if not _on_tpu():
         return False
     n, m = q.shape[1], k.shape[1]
-    d = q.shape[3]
-    return n % BLOCK_Q == 0 and m % BLOCK_K == 0 and d % 128 == 0 and n >= BLOCK_Q
+    return n % BLOCK_Q == 0 and m % BLOCK_K == 0 and n >= BLOCK_Q
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "scale"))
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, interpret: bool = False
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    scale: float | None = None, interpret: bool = False,
 ) -> jax.Array:
     """Tiled online-softmax attention (Pallas).
 
@@ -68,7 +88,8 @@ def flash_attention(
 
     b, n, h, d = q.shape
     m = k.shape[1]
-    scale = 1.0 / math.sqrt(d)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
 
     # Fold batch and heads; kernel works on [N, D] per (bh, qblock).
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, n, d)

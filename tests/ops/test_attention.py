@@ -40,3 +40,23 @@ def test_dispatch_falls_back_off_tpu():
     q = jnp.ones((1, 64, 2, 32))
     out = attn.dot_product_attention(q, q, q)
     assert out.shape == q.shape
+
+
+def test_flash_pads_unaligned_head_dim():
+    """SD head dims (40/64/80) aren't 128-lane aligned; the padded
+    flash path must match reference attention exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.attention import dot_product_attention
+
+    for d in (40, 64, 80):
+        q = jax.random.normal(jax.random.key(0), (1, 128, 2, d))
+        k = jax.random.normal(jax.random.key(1), (1, 128, 2, d))
+        v = jax.random.normal(jax.random.key(2), (1, 128, 2, d))
+        flash = dot_product_attention(q, k, v, force_flash=True)
+        ref = jax.nn.dot_product_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(ref), atol=2e-5
+        )
