@@ -1,0 +1,92 @@
+"""Real 2-process multihost: two CPU processes join one JAX runtime
+over localhost DCN via parallel/multihost.maybe_init_multihost, the
+global device count spans both, and a cross-process psum produces the
+correct value on each host (VERDICT round-1 next-step 10)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["CDT_TEST_REPO"])
+from comfyui_distributed_tpu.parallel.multihost import maybe_init_multihost, is_multihost
+
+assert maybe_init_multihost() is True
+assert is_multihost() is True
+pid = jax.process_index()
+# 2 processes x 2 local devices = 4 global devices
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+assert jax.local_device_count() == 2, jax.local_device_count()
+
+# one cross-process collective: psum over the global data axis
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("data",))
+local = jnp.arange(2, dtype=jnp.float32) + 10.0 * pid  # distinct per host
+
+def f(x):
+    return jax.lax.psum(x, "data")
+
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local, (4,)
+)
+out = jax.jit(
+    jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+)(arr)
+# global shards: [0, 1] (pid 0) + [10, 11] (pid 1) -> psum = 22
+assert float(out[0]) == 22.0, out
+print(f"MULTIHOST_OK pid={pid}")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_collective():
+    # bounded by the communicate(timeout=500) below
+    port = _free_port()
+    env_base = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = []
+    for pid in range(2):
+        env = dict(env_base)
+        env["CDT_TEST_REPO"] = REPO_ROOT
+        env["CDT_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["CDT_NUM_PROCESSES"] = "2"
+        env["CDT_PROCESS_ID"] = str(pid)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["JAX_PLATFORMS"] = "cpu"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _CHILD],
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    outs = []
+    for proc in procs:
+        try:
+            out, err = proc.communicate(timeout=500)
+        except subprocess.TimeoutExpired:
+            for p2 in procs:
+                p2.kill()
+            pytest.fail("multihost processes timed out")
+        outs.append((proc.returncode, out, err))
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
+        assert f"MULTIHOST_OK pid={pid}" in out
