@@ -352,8 +352,159 @@ async function loadExamples() {
       if (!select.value) return;
       const wf = await api(`/distributed/workflows/${encodeURIComponent(select.value)}`);
       document.getElementById("workflow-json").value = JSON.stringify(wf, null, 2);
+      renderWorkflowNodes();
     });
   } catch { /* optional */ }
+}
+
+// ---------- workflow node widgets ----------
+// Parity with the reference's graph-embedded widget UIs
+// (web/distributedValue.js, web/image_batch_divider.js): the panel
+// reads the pasted workflow, renders per-worker value inputs for every
+// DistributedValue node and an output-count control for every batch
+// divider, and writes changes back into the workflow JSON.
+
+const VALUE_TYPES = ["STRING", "INT", "FLOAT", "BOOLEAN"];
+const MAX_DIVIDER_OUTPUTS = 10;
+
+function currentWorkflow() {
+  try {
+    const parsed = JSON.parse(document.getElementById("workflow-json").value);
+    return parsed.prompt || parsed;
+  } catch {
+    return null;
+  }
+}
+
+function patchWorkflowNode(nodeId, patch) {
+  const textarea = document.getElementById("workflow-json");
+  let parsed;
+  try {
+    parsed = JSON.parse(textarea.value);
+  } catch {
+    return;
+  }
+  const prompt = parsed.prompt || parsed;
+  if (!prompt[nodeId]) return;
+  prompt[nodeId].inputs = { ...prompt[nodeId].inputs, ...patch };
+  textarea.value = JSON.stringify(parsed, null, 2);
+}
+
+function enabledWorkers() {
+  return (state.config?.workers || []).filter((w) => w.enabled);
+}
+
+function renderWorkflowNodes() {
+  const container = document.getElementById("workflow-nodes");
+  const prompt = currentWorkflow();
+  if (!prompt) {
+    container.textContent =
+      "paste a workflow to configure per-worker values and batch dividers";
+    return;
+  }
+  container.innerHTML = "";
+  container.classList.remove("mono");
+  let any = false;
+
+  for (const [nodeId, node] of Object.entries(prompt)) {
+    if (node.class_type === "DistributedValue") {
+      any = true;
+      const overrides = node.inputs?.overrides || {};
+      const block = document.createElement("div");
+      block.className = "node-widget";
+      const typeOptions = VALUE_TYPES.map(
+        (t) =>
+          `<option ${t === (overrides._type || "STRING") ? "selected" : ""}>${t}</option>`
+      ).join("");
+      const workerRows = enabledWorkers()
+        .map(
+          (w, idx) => `<div class="row">
+            <label style="width:140px">${escapeHtml(w.name || w.id)} (#${idx + 1})</label>
+            <input type="text" data-dv-node="${escapeHtml(nodeId)}" data-dv-slot="${idx + 1}"
+              value="${escapeHtml(overrides[String(idx + 1)] ?? "")}"
+              placeholder="master value"></div>`
+        )
+        .join("");
+      block.innerHTML = `
+        <div class="row"><strong>DistributedValue #${escapeHtml(nodeId)}</strong>
+          <span class="meta">master value: ${escapeHtml(node.inputs?.value ?? "")}</span>
+          <select data-dv-type="${escapeHtml(nodeId)}">${typeOptions}</select></div>
+        ${workerRows ||
+          '<div class="meta">no enabled workers — values apply per enabled worker</div>'}`;
+      container.appendChild(block);
+    }
+    if (
+      node.class_type === "ImageBatchDivider" ||
+      node.class_type === "AudioBatchDivider"
+    ) {
+      any = true;
+      const divideBy = Number(node.inputs?.divide_by ?? 2);
+      const block = document.createElement("div");
+      block.className = "node-widget";
+      block.innerHTML = `
+        <div class="row"><strong>${escapeHtml(node.class_type)} #${escapeHtml(nodeId)}</strong>
+          <label>outputs <input type="number" min="1" max="${MAX_DIVIDER_OUTPUTS}"
+            value="${divideBy}" data-divider-node="${escapeHtml(nodeId)}"
+            style="width:60px"></label>
+          <span class="meta" id="divider-used-${escapeHtml(nodeId)}">
+            ${divideBy} of ${MAX_DIVIDER_OUTPUTS} outputs carry data</span></div>`;
+      container.appendChild(block);
+    }
+  }
+  if (!any) {
+    container.classList.add("mono");
+    container.textContent =
+      "no DistributedValue / batch-divider nodes in this workflow";
+  }
+}
+
+function collectDistributedValueOverrides(nodeId) {
+  const overrides = {};
+  const typeSel = document.querySelector(`select[data-dv-type="${nodeId}"]`);
+  overrides._type = typeSel ? typeSel.value : "STRING";
+  for (const input of document.querySelectorAll(
+    `input[data-dv-node="${nodeId}"]`
+  )) {
+    if (input.value !== "") overrides[input.dataset.dvSlot] = input.value;
+  }
+  return overrides;
+}
+
+// ---------- master detection (reference web/masterDetection.js) ----------
+
+async function renderNetworkInfo() {
+  const container = document.getElementById("network-info");
+  try {
+    const info = await api("/distributed/network_info");
+    const master = state.config?.master || {};
+    const autoCount = (state.config?.workers || []).filter(
+      (w) => w.auto_populated
+    ).length;
+    container.innerHTML =
+      `recommended master IP: <b>${escapeHtml(info.recommended)}</b> ` +
+      `<button class="small" id="use-recommended-ip">use as master host</button>` +
+      `<br>current master host: ${escapeHtml(master.host || "(unset)")}` +
+      `<br>candidates: ${(info.candidates || []).map(escapeHtml).join(", ")}` +
+      (autoCount
+        ? `<br>${autoCount} worker(s) auto-populated for spare chips`
+        : "");
+    const btn = document.getElementById("use-recommended-ip");
+    if (btn)
+      btn.addEventListener("click", async () => {
+        try {
+          await api("/distributed/config/master", {
+            method: "POST",
+            body: JSON.stringify({ host: info.recommended }),
+          });
+          await loadConfig();
+          renderNetworkInfo();
+        } catch (err) {
+          alert(`save failed: ${err.message}`);
+        }
+      });
+  } catch {
+    container.textContent = "network info unavailable";
+  }
 }
 
 // ---------- wiring ----------
@@ -397,8 +548,30 @@ document.addEventListener("change", async (event) => {
       body: JSON.stringify({ id: t.dataset.enable, enabled: t.checked }),
     }).catch((err) => alert(err.message));
     await loadConfig();
+    renderWorkflowNodes(); // per-worker widget rows follow enablement
+  } else if (t.dataset.dvNode || t.dataset.dvType) {
+    const nodeId = t.dataset.dvNode || t.dataset.dvType;
+    patchWorkflowNode(nodeId, {
+      overrides: collectDistributedValueOverrides(nodeId),
+    });
+  } else if (t.dataset.dividerNode) {
+    const nodeId = t.dataset.dividerNode;
+    const parts = Math.max(
+      1, Math.min(Number(t.value) || 1, MAX_DIVIDER_OUTPUTS)
+    );
+    patchWorkflowNode(nodeId, { divide_by: parts });
+    const used = document.getElementById(`divider-used-${nodeId}`);
+    if (used)
+      used.textContent = `${parts} of ${MAX_DIVIDER_OUTPUTS} outputs carry data`;
   }
 });
+
+document
+  .getElementById("workflow-json")
+  .addEventListener("input", () => {
+    clearTimeout(state.nodesTimer);
+    state.nodesTimer = setTimeout(renderWorkflowNodes, 400);
+  });
 
 document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
 document.getElementById("modal-close").addEventListener("click", hideModal);
@@ -445,6 +618,7 @@ document.getElementById("tunnel-toggle").addEventListener("click", async () => {
   } catch { state.topoChips = []; }
   await loadExamples();
   refreshStatus();
+  renderNetworkInfo();
   setInterval(refreshMasterLog, 3000);
   refreshMasterLog();
 })();

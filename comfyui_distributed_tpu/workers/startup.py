@@ -63,6 +63,8 @@ def auto_populate_workers(config_path: str | None = None) -> list[dict[str, Any]
                 "tpu_chips": [chip],
                 "enabled": False,
                 "extra_args": "",
+                # surfaced by the control panel's Network section
+                "auto_populated": True,
             }
         )
         port += 1

@@ -51,3 +51,38 @@ def test_extras_preserved():
 def test_invalid_payloads(body):
     with pytest.raises(QueueRequestError):
         parse_queue_request_payload(body)
+
+
+def test_panel_js_references_only_registered_routes():
+    """Drift guard: every /distributed/* path the control panel calls
+    must exist in the API surface (the reference's apiClient drifts are
+    a classic failure mode)."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    app_js = open(
+        os.path.join(root, "comfyui_distributed_tpu", "web", "app.js")
+    ).read()
+    called = set(re.findall(r'"(/distributed/[a-z_/]+)', app_js))
+    called |= {
+        p.split("${")[0].rstrip("/")
+        for p in re.findall(r"`(/distributed/[a-z_/${}]+)`", app_js)
+    }
+
+    registered = set()
+    pattern = re.compile(r'add_(?:get|post|delete|put)\("(/distributed/[^"]+)"')
+    api_dir = os.path.join(root, "comfyui_distributed_tpu", "api")
+    for name in os.listdir(api_dir):
+        if name.endswith(".py"):
+            registered |= set(
+                pattern.findall(open(os.path.join(api_dir, name)).read())
+            )
+    # normalize parametrized routes to their static prefix
+    prefixes = {r.split("{")[0].rstrip("/") for r in registered}
+    missing = [
+        c for c in called
+        if c not in prefixes and not any(c.startswith(p + "/") or c == p for p in prefixes)
+    ]
+    assert not missing, f"panel calls unregistered routes: {missing}"
+
