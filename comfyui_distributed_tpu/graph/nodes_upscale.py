@@ -51,6 +51,8 @@ class UltimateSDUpscaleDistributed:
             },
             "optional": {
                 "upscale_method": ("STRING", {"default": "bicubic"}),
+                "mask_blur": ("INT", {"default": 8}),
+                "tiled_decode": ("BOOLEAN", {"default": False}),
                 "force_uniform_tiles": ("BOOLEAN", {"default": True}),
                 "dynamic_threshold": ("INT", {"default": 8}),
                 "upscale_model": ("UPSCALE_MODEL", {"default": None}),
@@ -87,6 +89,8 @@ class UltimateSDUpscaleDistributed:
         tile_height=512,
         tile_padding=32,
         upscale_method="bicubic",
+        mask_blur=8,
+        tiled_decode=False,
         force_uniform_tiles=True,
         dynamic_threshold=8,
         upscale_model=None,
@@ -151,6 +155,7 @@ class UltimateSDUpscaleDistributed:
             sampler=sampler_name, scheduler=scheduler, cfg=float(cfg),
             denoise=float(denoise), seed=int(seed),
             upscale_method=upscale_method, context=context,
+            mask_blur=int(mask_blur), tiled_decode=bool(tiled_decode),
         )
 
         if is_worker:
@@ -186,5 +191,6 @@ class UltimateSDUpscaleDistributed:
             steps=int(steps), sampler=sampler_name, scheduler=scheduler,
             cfg=float(cfg), denoise=float(denoise), seed=int(seed),
             upscale_method=upscale_method,
+            mask_blur=int(mask_blur), tiled_decode=bool(tiled_decode),
         )
         return (out,)

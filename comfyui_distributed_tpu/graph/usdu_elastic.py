@@ -181,6 +181,8 @@ def run_worker_loop(
     denoise: float,
     seed: int,
     upscale_method: str = "bicubic",
+    mask_blur: int = 0,
+    tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
     client: Any = None,
@@ -192,11 +194,14 @@ def run_worker_loop(
         raise WorkerError(f"job {job_id} never became ready", worker_id)
 
     _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
+        image, upscale_by, tile, padding, upscale_method, tile_h,
+        mask_blur=mask_blur,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
-    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _jit_tile_processor(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
     key = jax.random.key(seed)
     positions = grid.positions_array()
 
@@ -242,7 +247,8 @@ def run_worker_loop(
     flush(is_final=True)
 
 
-def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise):
+def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
+                        tiled_decode=False):
     """fn(params, tile, key, pos, neg, yx): pos/neg must be prepped via
     ops.upscale.prep_cond_for_tiles (per-tile hint/mask windows are
     sliced at yx inside)."""
@@ -257,6 +263,10 @@ def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise):
         x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
         z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
+        if tiled_decode:
+            from ..ops.tiled_vae import decode_tiled
+
+            return decode_tiled(pl._Static(bundle), params["vae"], z_out)
         return bundle.vae.apply(params["vae"], z_out, method="decode")
 
     return process
@@ -285,6 +295,8 @@ def run_master_elastic(
     denoise: float = 0.35,
     seed: int = 0,
     upscale_method: str = "bicubic",
+    mask_blur: int = 0,
+    tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
 ):
@@ -298,11 +310,14 @@ def run_master_elastic(
     server = context.server
     store = server.job_store
     upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
+        image, upscale_by, tile, padding, upscale_method, tile_h,
+        mask_blur=mask_blur,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
-    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _jit_tile_processor(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
     key = jax.random.key(seed)
     positions = grid.positions_array()
 
@@ -478,6 +493,8 @@ def run_worker_dynamic(
     denoise: float,
     seed: int,
     upscale_method: str = "bicubic",
+    mask_blur: int = 0,
+    tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
     client: Any = None,
@@ -488,11 +505,14 @@ def run_worker_dynamic(
     if not client.poll_ready():
         raise WorkerError(f"job {job_id} never became ready", worker_id)
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
+        image, upscale_by, tile, padding, upscale_method, tile_h,
+        mask_blur=mask_blur,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
-    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _jit_tile_processor(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
     key = jax.random.key(seed)
 
     while True:
@@ -534,6 +554,8 @@ def run_master_dynamic(
     denoise: float = 0.35,
     seed: int = 0,
     upscale_method: str = "bicubic",
+    mask_blur: int = 0,
+    tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
 ):
@@ -546,11 +568,14 @@ def run_master_dynamic(
     store = context.server.job_store
     batch = int(image.shape[0])
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
+        image, upscale_by, tile, padding, upscale_method, tile_h,
+        mask_blur=mask_blur,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
-    process = _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _jit_tile_processor(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
     key = jax.random.key(seed)
     timeout = get_worker_timeout_seconds()
 

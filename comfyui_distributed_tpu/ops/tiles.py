@@ -41,6 +41,15 @@ class TileGrid:
     cols: int
     # [T, 2] int32 (y, x) origins of the *unpadded* tile regions.
     positions: tuple[tuple[int, int], ...]
+    # feather-ramp width in pixels (reference USDU `mask_blur`);
+    # 0 = full padding width. Clamped to the padding ring.
+    mask_blur: int = 0
+
+    @property
+    def feather(self) -> int:
+        if self.mask_blur > 0:
+            return min(self.mask_blur, self.padding)
+        return self.padding
 
     @property
     def num_tiles(self) -> int:
@@ -64,6 +73,7 @@ def calculate_tiles(
     tile_h: int,
     tile_w: int,
     padding: int = 32,
+    mask_blur: int = 0,
 ) -> TileGrid:
     """Ceil-grid tiling with clamped origins (uniform tile shapes).
 
@@ -90,6 +100,7 @@ def calculate_tiles(
         rows=rows,
         cols=cols,
         positions=tuple(positions),
+        mask_blur=mask_blur,
     )
 
 
@@ -147,10 +158,12 @@ def feather_mask(grid: TileGrid, dtype=jnp.float32) -> jnp.ndarray:
     (upscale/tile_ops.py `create_tile_mask`): the raised cosine is
     separable, needs no conv, and sums smoothly where tiles overlap.
     Every weight is strictly positive so the normalising weight map
-    never divides by zero. Cached per (shape, padding).
+    never divides by zero. Cached per (shape, feather width). The ramp
+    width follows `grid.mask_blur` (reference USDU `mask_blur` knob)
+    clamped to the padding ring; 0 = the full padding width.
     """
     return jnp.asarray(
-        _feather_mask_np(grid.padded_h, grid.padded_w, grid.padding), dtype=dtype
+        _feather_mask_np(grid.padded_h, grid.padded_w, grid.feather), dtype=dtype
     )
 
 

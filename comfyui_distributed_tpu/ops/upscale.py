@@ -91,6 +91,7 @@ def plan_grid(
     tile_w: int,
     padding: int,
     tile_h: int | None = None,
+    mask_blur: int = 0,
 ) -> tuple[int, int, tile_ops.TileGrid]:
     """Target size + tile grid for an upscale run. Tile geometry is
     clamped to the image and snapped to the VAE factor (8) so latent
@@ -102,7 +103,9 @@ def plan_grid(
     tile_w = max(64, (int(tile_w) // 8) * 8)
     tile_h = max(64, (int(tile_h) // 8) * 8)
     padding = max(8, (padding // 8) * 8)
-    grid = tile_ops.calculate_tiles(out_h, out_w, tile_h, tile_w, padding)
+    grid = tile_ops.calculate_tiles(
+        out_h, out_w, tile_h, tile_w, padding, mask_blur=mask_blur
+    )
     return out_h, out_w, grid
 
 
@@ -113,13 +116,16 @@ def prepare_upscaled_tiles(
     padding: int,
     upscale_method: str = "bicubic",
     tile_h: int | None = None,
+    mask_blur: int = 0,
 ) -> tuple[jax.Array, tile_ops.TileGrid, jax.Array]:
     """Shared preamble for every USDU path (local / mesh / elastic
     master / elastic worker): resize, clip, extract. All participants
     MUST use this same function — bit-identical tile inputs are what
     makes cross-participant requeue seamless."""
     b, h, w, c = image.shape
-    out_h, out_w, grid = plan_grid(h, w, upscale_by, tile_w, padding, tile_h)
+    out_h, out_w, grid = plan_grid(
+        h, w, upscale_by, tile_w, padding, tile_h, mask_blur=mask_blur
+    )
     upscaled = jnp.clip(
         resize_image(image, out_h, out_w, upscale_method), 0.0, 1.0
     )
@@ -220,7 +226,8 @@ def tile_cond(cond, y, x, grid: tile_ops.TileGrid):
     return c
 
 
-def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise):
+def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise,
+                     tiled_decode=False):
     """Returns fn(params, tile, key, pos, neg, yx) → processed tiles.
     pos/neg must already be prepped via prep_cond_for_tiles; yx is the
     tile origin [2] (traced ok)."""
@@ -234,6 +241,10 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise):
         x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
         z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
+        if tiled_decode:
+            from .tiled_vae import decode_tiled
+
+            return decode_tiled(pl._Static(bundle), params["vae"], z_out)
         return bundle.vae.apply(params["vae"], z_out, method="decode")
 
     return fn
@@ -243,7 +254,7 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise):
     jax.jit,
     static_argnames=(
         "bundle_static", "grid", "steps", "sampler", "scheduler", "cfg",
-        "denoise",
+        "denoise", "tiled_decode",
     ),
 )
 def upscale_single(
@@ -259,13 +270,16 @@ def upscale_single(
     scheduler: str,
     cfg: float,
     denoise: float,
+    tiled_decode: bool = False,
 ):
     """All tiles processed on the local device via lax.scan."""
     bundle = bundle_static.value
     extracted = tile_ops.extract_tiles(upscaled, grid)  # [T, B, th, tw, C]
     pos = prep_cond_for_tiles(pos, grid)
     neg = prep_cond_for_tiles(neg, grid)
-    process = _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _process_tile_fn(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
     tile_indices = jnp.arange(grid.num_tiles)
     positions = grid.positions_array()
 
@@ -282,7 +296,7 @@ def upscale_single(
     jax.jit,
     static_argnames=(
         "bundle_static", "mesh_static", "grid", "steps", "sampler",
-        "scheduler", "cfg", "denoise",
+        "scheduler", "cfg", "denoise", "tiled_decode",
     ),
 )
 def upscale_mesh(
@@ -299,6 +313,7 @@ def upscale_mesh(
     scheduler: str,
     cfg: float,
     denoise: float,
+    tiled_decode: bool = False,
 ):
     """Tile axis sharded over the mesh data axis; all-gather + blend.
 
@@ -311,7 +326,9 @@ def upscale_mesh(
     n = data_axis_size(mesh)
     pos = prep_cond_for_tiles(pos, grid)
     neg = prep_cond_for_tiles(neg, grid)
-    process = _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise)
+    process = _process_tile_fn(
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+    )
 
     extracted = tile_ops.extract_tiles(upscaled, grid)  # [T, B, th, tw, C]
     t = grid.num_tiles
@@ -362,11 +379,14 @@ def run_upscale(
     seed: int = 0,
     upscale_method: str = "bicubic",
     tile_h: int | None = None,
+    mask_blur: int = 0,
+    tiled_decode: bool = False,
 ) -> jax.Array:
     """Full upscale: resize then tile-rediffuse. Routes to the mesh
     path when a multi-participant mesh is available."""
     upscaled, grid, _ = prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
+        image, upscale_by, tile, padding, upscale_method, tile_h,
+        mask_blur=mask_blur,
     )
     key = jax.random.key(seed)
     if mesh is not None and data_axis_size(mesh) > 1:
@@ -377,11 +397,12 @@ def run_upscale(
         return upscale_mesh(
             pl._Static(bundle), pl._Static(mesh), params, upscaled, pos_p,
             neg_p, key, grid, int(steps), sampler, scheduler, float(cfg),
-            float(denoise),
+            float(denoise), bool(tiled_decode),
         )
     return upscale_single(
         pl._Static(bundle), bundle.params, upscaled, pos, neg, key, grid,
         int(steps), sampler, scheduler, float(cfg), float(denoise),
+        bool(tiled_decode),
     )
 
 
