@@ -22,8 +22,14 @@ import jax.numpy as jnp
 
 ModelFn = Callable[[jax.Array, jax.Array, Any], jax.Array]
 
-SAMPLER_NAMES = ("euler", "euler_ancestral", "heun", "dpmpp_2m", "ddim")
-SCHEDULER_NAMES = ("karras", "normal", "simple", "exponential")
+SAMPLER_NAMES = (
+    "euler", "euler_ancestral", "heun", "dpm_2", "dpm_2_ancestral", "lms",
+    "dpmpp_2s_ancestral", "dpmpp_sde", "dpmpp_2m", "dpmpp_2m_sde", "ddim",
+    "lcm",
+)
+SCHEDULER_NAMES = (
+    "karras", "normal", "simple", "exponential", "sgm_uniform", "ddim_uniform",
+)
 
 
 # --- schedules -----------------------------------------------------------
@@ -67,6 +73,20 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
     elif scheduler in ("normal", "simple"):
         idx = np.linspace(len(all_sigmas) - 1, 0, total_steps)
         sigmas = all_sigmas[idx.astype(np.int64)]
+    elif scheduler == "sgm_uniform":
+        # uniform timestep spacing with the final (smallest) timestep
+        # excluded before the terminal zero — the SGM convention
+        idx = np.linspace(len(all_sigmas) - 1, 0, total_steps + 1)[:-1]
+        sigmas = all_sigmas[idx.astype(np.int64)]
+    elif scheduler == "ddim_uniform":
+        # uniform timestep stride anchored at the TOP of the schedule
+        # (the DDIM convention): always starts at sigma_max
+        n = len(all_sigmas)
+        ss = n / max(total_steps, 1)
+        idx = np.asarray(
+            [n - 1 - int(i * ss) for i in range(total_steps)], dtype=np.int64
+        )
+        sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
     else:
         raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
 
@@ -142,18 +162,28 @@ def sample(
 ) -> jax.Array:
     """Run a full sampling trajectory. x_init must already be scaled by
     sigmas[0] (pure noise for txt2img; noised latents for img2img)."""
-    if sampler == "euler":
-        return _sample_euler(model_fn, x_init, sigmas, cond)
-    if sampler == "heun":
-        return _sample_heun(model_fn, x_init, sigmas, cond)
-    if sampler == "dpmpp_2m":
-        return _sample_dpmpp_2m(model_fn, x_init, sigmas, cond)
-    if sampler == "ddim":
-        return _sample_ddim(model_fn, x_init, sigmas, cond)
-    if sampler == "euler_ancestral":
+    deterministic = {
+        "euler": _sample_euler,
+        "heun": _sample_heun,
+        "dpm_2": _sample_dpm_2,
+        "lms": _sample_lms,
+        "dpmpp_2m": _sample_dpmpp_2m,
+        "ddim": _sample_ddim,
+    }
+    stochastic = {
+        "euler_ancestral": _sample_euler_ancestral,
+        "dpm_2_ancestral": _sample_dpm_2_ancestral,
+        "dpmpp_2s_ancestral": _sample_dpmpp_2s_ancestral,
+        "dpmpp_sde": _sample_dpmpp_sde,
+        "dpmpp_2m_sde": _sample_dpmpp_2m_sde,
+        "lcm": _sample_lcm,
+    }
+    if sampler in deterministic:
+        return deterministic[sampler](model_fn, x_init, sigmas, cond)
+    if sampler in stochastic:
         if noise_key is None:
-            raise ValueError("euler_ancestral requires noise_key")
-        return _sample_euler_ancestral(model_fn, x_init, sigmas, cond, noise_key)
+            raise ValueError(f"{sampler} requires noise_key")
+        return stochastic[sampler](model_fn, x_init, sigmas, cond, noise_key)
     raise ValueError(f"unknown sampler {sampler!r}; use {SAMPLER_NAMES}")
 
 
@@ -166,6 +196,281 @@ def _sample_euler(model_fn, x, sigmas, cond):
 
     pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
     x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def _ancestral_split(sigma, sigma_next, eta=1.0):
+    """(sigma_down, sigma_up) for an ancestral step (k-diffusion
+    get_ancestral_step)."""
+    sigma_up = jnp.minimum(
+        sigma_next,
+        eta * jnp.sqrt(
+            jnp.maximum(
+                sigma_next**2
+                * (sigma**2 - sigma_next**2)
+                / jnp.maximum(sigma**2, 1e-10),
+                0.0,
+            )
+        ),
+    )
+    sigma_down = jnp.sqrt(jnp.maximum(sigma_next**2 - sigma_up**2, 0.0))
+    return sigma_down, sigma_up
+
+
+def _sample_dpm_2(model_fn, x, sigmas, cond):
+    """DPM-Solver-2: midpoint evaluation at the geometric mean sigma;
+    the final step (sigma_next == 0) degrades to Euler."""
+
+    def step(x, sig_pair):
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x_euler = x + d * (sigma_next - sigma)
+
+        def second(_):
+            sigma_mid = jnp.exp(
+                0.5 * (jnp.log(jnp.maximum(sigma, 1e-10))
+                       + jnp.log(jnp.maximum(sigma_next, 1e-10)))
+            )
+            x_2 = x + d * (sigma_mid - sigma)
+            den_2 = _denoised(
+                model_fn, x_2, jnp.maximum(sigma_mid, 1e-10), cond
+            )
+            d_2 = (x_2 - den_2) / jnp.maximum(sigma_mid, 1e-10)
+            return x + d_2 * (sigma_next - sigma)
+
+        # cond (not where): skips the second model eval on the
+        # terminal step entirely
+        return jax.lax.cond(sigma_next > 0, second, lambda _: x_euler, None), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    x, _ = jax.lax.scan(step, x, pairs)
+    return x
+
+
+def _sample_dpm_2_ancestral(model_fn, x, sigmas, cond, key):
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x_euler = x + d * (sigma_down - sigma)
+
+        def second(_):
+            sigma_mid = jnp.exp(
+                0.5 * (jnp.log(jnp.maximum(sigma, 1e-10))
+                       + jnp.log(jnp.maximum(sigma_down, 1e-10)))
+            )
+            x_2 = x + d * (sigma_mid - sigma)
+            den_2 = _denoised(
+                model_fn, x_2, jnp.maximum(sigma_mid, 1e-10), cond
+            )
+            d_2 = (x_2 - den_2) / jnp.maximum(sigma_mid, 1e-10)
+            return x + d_2 * (sigma_down - sigma)
+
+        x = jax.lax.cond(sigma_down > 0, second, lambda _: x_euler, None)
+        key, sub = jax.random.split(key)
+        x = x + jax.random.normal(sub, x.shape, x.dtype) * sigma_up
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
+    return x
+
+
+def _lms_coefficients(sigmas_np, order: int = 4):
+    """[steps, order] Adams-Bashforth-style coefficients: exact
+    integrals of the Lagrange basis over each [sigma_i, sigma_{i+1}]
+    (k-diffusion linear_multistep_coeff), computed in numpy at trace
+    time. Column j weights the derivative from j steps ago; columns
+    beyond the available history are zero."""
+    import numpy as np
+
+    steps = len(sigmas_np) - 1
+    coeffs = np.zeros((steps, order), dtype=np.float64)
+    for i in range(steps):
+        cur_order = min(i + 1, order)
+        for j in range(cur_order):
+            # Lagrange basis over nodes sigmas[i-j'] for j'=0..cur_order-1
+            nodes = [sigmas_np[i - k] for k in range(cur_order)]
+            poly = np.poly1d([1.0])
+            for k in range(cur_order):
+                if k == j:
+                    continue
+                poly *= np.poly1d(
+                    [1.0, -nodes[k]]
+                ) / (nodes[j] - nodes[k])
+            integral = poly.integ()
+            coeffs[i, j] = integral(sigmas_np[i + 1]) - integral(sigmas_np[i])
+    return coeffs
+
+
+def _sample_lms(model_fn, x, sigmas, cond, order: int = 4):
+    """Linear multistep (order 4) with exact per-step coefficients."""
+    import numpy as np
+
+    coeffs = jnp.asarray(
+        _lms_coefficients(np.asarray(sigmas, dtype=np.float64), order),
+        dtype=jnp.float32,
+    )
+
+    def step(carry, inputs):
+        x, history = carry  # history: [order, ...] newest-first
+        sigma, coeff_row = inputs
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        history = jnp.concatenate([d[None], history[:-1]], axis=0)
+        x = x + jnp.tensordot(coeff_row, history, axes=1)
+        return (x, history), None
+
+    history = jnp.zeros((order,) + x.shape, x.dtype)
+    (x, _), _ = jax.lax.scan(step, (x, history), (sigmas[:-1], coeffs))
+    return x
+
+
+def _sample_dpmpp_2s_ancestral(model_fn, x, sigmas, cond, key):
+    """DPM-Solver++(2S) ancestral (k-diffusion formulas in
+    lambda = -log sigma space)."""
+
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
+        den = _denoised(model_fn, x, sigma, cond)
+        # euler fallback for the terminal step
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x_euler = x + d * (sigma_down - sigma)
+
+        def second(_):
+            t = -jnp.log(jnp.maximum(sigma, 1e-10))
+            t_next = -jnp.log(jnp.maximum(sigma_down, 1e-10))
+            h = t_next - t
+            s_mid = t + 0.5 * h
+            sig_mid = jnp.exp(-s_mid)
+            x_2 = (sig_mid / jnp.maximum(sigma, 1e-10)) * x - jnp.expm1(
+                -0.5 * h
+            ) * den
+            den_2 = _denoised(
+                model_fn, x_2, jnp.maximum(sig_mid, 1e-10), cond
+            )
+            return (
+                jnp.maximum(sigma_down, 1e-10) / jnp.maximum(sigma, 1e-10)
+            ) * x - jnp.expm1(-h) * den_2
+
+        x = jax.lax.cond(sigma_down > 0, second, lambda _: x_euler, None)
+        key, sub = jax.random.split(key)
+        x = x + jax.random.normal(sub, x.shape, x.dtype) * sigma_up
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
+    return x
+
+
+def _sample_dpmpp_sde(model_fn, x, sigmas, cond, key, eta: float = 1.0):
+    """DPM-Solver++ SDE (r=1/2): two model evaluations and two noise
+    injections per step; terminal step is Euler."""
+    r = 0.5
+
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        d = (x - den) / jnp.maximum(sigma, 1e-10)
+        x_euler = x + d * (sigma_next - sigma)
+        key, sub1, sub2 = jax.random.split(key, 3)
+
+        def second(_):
+            t = -jnp.log(jnp.maximum(sigma, 1e-10))
+            t_next = -jnp.log(jnp.maximum(sigma_next, 1e-10))
+            h = t_next - t
+            s_mid = t + h * r
+            sig_mid = jnp.exp(-s_mid)
+
+            # sub-step 1 to sigma(s_mid), with its own ancestral split
+            sd_1, su_1 = _ancestral_split(sigma, sig_mid, eta)
+            t_d1 = -jnp.log(jnp.maximum(sd_1, 1e-10))
+            x_2 = (jnp.maximum(sd_1, 1e-10) / jnp.maximum(sigma, 1e-10)) * x \
+                - jnp.expm1(t - t_d1) * den
+            x_2 = x_2 + jax.random.normal(sub1, x.shape, x.dtype) * su_1
+            den_2 = _denoised(
+                model_fn, x_2, jnp.maximum(sig_mid, 1e-10), cond
+            )
+
+            # sub-step 2 to sigma_next
+            sd_2, su_2 = _ancestral_split(sigma, sigma_next, eta)
+            t_d2 = -jnp.log(jnp.maximum(sd_2, 1e-10))
+            fac = 1.0 / (2.0 * r)
+            den_mix = (1.0 - fac) * den + fac * den_2
+            x_solver = (
+                jnp.maximum(sd_2, 1e-10) / jnp.maximum(sigma, 1e-10)
+            ) * x - jnp.expm1(t - t_d2) * den_mix
+            return x_solver + jax.random.normal(sub2, x.shape, x.dtype) * su_2
+
+        x = jax.lax.cond(sigma_next > 0, second, lambda _: x_euler, None)
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
+    return x
+
+
+def _sample_dpmpp_2m_sde(model_fn, x, sigmas, cond, key, eta: float = 1.0):
+    """DPM-Solver++(2M) SDE, midpoint variant: one model evaluation per
+    step with a second-order correction from the previous denoised."""
+
+    def step(carry, sig_pair):
+        x, old_den, h_last, key = carry
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+
+        t = -jnp.log(jnp.maximum(sigma, 1e-10))
+        t_next = -jnp.log(jnp.maximum(sigma_next, 1e-10))
+        h = t_next - t
+        eta_h = eta * h
+        x_solver = (
+            jnp.maximum(sigma_next, 1e-10) / jnp.maximum(sigma, 1e-10)
+        ) * jnp.exp(-eta_h) * x - jnp.expm1(-h - eta_h) * den
+        # midpoint second-order correction (skipped on the first step
+        # via h_last == 0)
+        r = h_last / jnp.maximum(h, 1e-10)
+        # k-diffusion midpoint term: 0.5 * -expm1(-h-eta_h) * (1/r) *
+        # (den - old_den); expm1(-h-eta_h) < 0, so the negation matters
+        corr = -0.5 * jnp.expm1(-h - eta_h) * (
+            1.0 / jnp.maximum(r, 1e-10)
+        ) * (den - old_den)
+        x_solver = x_solver + jnp.where(h_last > 0, corr, 0.0)
+        key, sub = jax.random.split(key)
+        noise = jax.random.normal(sub, x.shape, x.dtype)
+        x_solver = x_solver + noise * jnp.maximum(sigma_next, 0.0) * jnp.sqrt(
+            jnp.maximum(-jnp.expm1(-2.0 * eta_h), 0.0)
+        )
+        x = jnp.where(sigma_next > 0, x_solver, den)
+        return (x, den, h, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _, _, _), _ = jax.lax.scan(
+        step, (x, jnp.zeros_like(x), jnp.zeros(()), key), pairs
+    )
+    return x
+
+
+def _sample_lcm(model_fn, x, sigmas, cond, key):
+    """LCM sampling: jump to the denoised estimate, re-noise to the
+    next sigma."""
+
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        key, sub = jax.random.split(key)
+        noise = jax.random.normal(sub, x.shape, x.dtype)
+        x = jnp.where(sigma_next > 0, den + sigma_next * noise, den)
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
     return x
 
 
@@ -193,16 +498,7 @@ def _sample_euler_ancestral(model_fn, x, sigmas, cond, key):
         x, key = carry
         sigma, sigma_next = sig_pair
         den = _denoised(model_fn, x, sigma, cond)
-        sigma_up = jnp.minimum(
-            sigma_next,
-            jnp.sqrt(
-                jnp.maximum(
-                    sigma_next**2 * (sigma**2 - sigma_next**2) / jnp.maximum(sigma**2, 1e-10),
-                    0.0,
-                )
-            ),
-        )
-        sigma_down = jnp.sqrt(jnp.maximum(sigma_next**2 - sigma_up**2, 0.0))
+        sigma_down, sigma_up = _ancestral_split(sigma, sigma_next)
         d = (x - den) / jnp.maximum(sigma, 1e-10)
         x = x + d * (sigma_down - sigma)
         key, sub = jax.random.split(key)
