@@ -1,0 +1,291 @@
+"""GGUF checkpoint reader (quantized single-file models).
+
+The reference loads GGUF-quantized UNets through ComfyUI's GGUF
+loader ecosystem; this is the native equivalent: parse the GGUF v2/v3
+container and dequantize the common block formats to float32 numpy,
+yielding the same state-dict shape `sd_checkpoint.py` maps into flax
+trees. Tensor names in diffusion GGUF files are the original state-
+dict names, so the existing key schedules apply unchanged.
+
+Supported tensor types: F32, F16, Q8_0, Q4_0, Q4_1, Q5_0, Q5_1.
+K-quants (Q*_K) raise with a clear message rather than misread.
+
+A writer for the same subset (`write_gguf`) exists so round-trip tests
+don't need binary fixtures; it is also handy for exporting.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32 = 0, 1, 2, 3, 4, 5
+_T_F32, _T_BOOL, _T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = (
+    6, 7, 8, 9, 10, 11, 12
+)
+
+# tensor (ggml) types
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q5_0, GGML_Q5_1 = 6, 7
+GGML_Q8_0 = 8
+
+_BLOCK = 32  # elements per quant block for the supported formats
+
+_TYPE_NAMES = {
+    GGML_F32: "F32", GGML_F16: "F16", GGML_Q4_0: "Q4_0",
+    GGML_Q4_1: "Q4_1", GGML_Q5_0: "Q5_0", GGML_Q5_1: "Q5_1",
+    GGML_Q8_0: "Q8_0",
+}
+
+_BLOCK_BYTES = {
+    GGML_Q4_0: 2 + 16,
+    GGML_Q4_1: 2 + 2 + 16,
+    GGML_Q5_0: 2 + 4 + 16,
+    GGML_Q5_1: 2 + 2 + 4 + 16,
+    GGML_Q8_0: 2 + 32,
+}
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        out = self.data[self.pos : self.pos + n]
+        if len(out) != n:
+            raise ValueError("truncated GGUF file")
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def string(self) -> str:
+        return self.take(self.u64()).decode("utf-8")
+
+    def value(self, vtype: int) -> Any:
+        fmt = {
+            _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+            _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+            _T_I64: "<q", _T_F64: "<d",
+        }.get(vtype)
+        if fmt is not None:
+            return struct.unpack(fmt, self.take(struct.calcsize(fmt)))[0]
+        if vtype == _T_BOOL:
+            return bool(self.take(1)[0])
+        if vtype == _T_STRING:
+            return self.string()
+        if vtype == _T_ARRAY:
+            etype = self.u32()
+            count = self.u64()
+            return [self.value(etype) for _ in range(count)]
+        raise ValueError(f"unknown GGUF metadata type {vtype}")
+
+
+def _dequant(raw: np.ndarray, gtype: int, n_elements: int) -> np.ndarray:
+    """raw uint8 block data → float32 [n_elements]."""
+    if gtype == GGML_Q8_0:
+        blocks = raw.reshape(-1, 2 + 32)
+        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        q = blocks[:, 2:].copy().view(np.int8).astype(np.float32)
+        out = (d * q).reshape(-1)
+    elif gtype in (GGML_Q4_0, GGML_Q4_1):
+        has_m = gtype == GGML_Q4_1
+        bb = _BLOCK_BYTES[gtype]
+        blocks = raw.reshape(-1, bb)
+        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        off = 2
+        if has_m:
+            m = blocks[:, 2:4].copy().view(np.float16).astype(np.float32)
+            off = 4
+        qs = blocks[:, off:]
+        lo = (qs & 0x0F).astype(np.float32)
+        hi = (qs >> 4).astype(np.float32)
+        q = np.concatenate([lo, hi], axis=1)  # [B, 32]
+        if has_m:
+            out = (d * q + m).reshape(-1)
+        else:
+            out = (d * (q - 8.0)).reshape(-1)
+    elif gtype in (GGML_Q5_0, GGML_Q5_1):
+        has_m = gtype == GGML_Q5_1
+        bb = _BLOCK_BYTES[gtype]
+        blocks = raw.reshape(-1, bb)
+        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        off = 2
+        if has_m:
+            m = blocks[:, 2:4].copy().view(np.float16).astype(np.float32)
+            off = 4
+        qh = blocks[:, off : off + 4].copy().view(np.uint32)[:, 0]
+        qs = blocks[:, off + 4 :]
+        lo = (qs & 0x0F).astype(np.uint8)
+        hi = (qs >> 4).astype(np.uint8)
+        bit = np.arange(16, dtype=np.uint32)
+        lo_h = ((qh[:, None] >> bit) & 1).astype(np.uint8) << 4
+        hi_h = ((qh[:, None] >> (bit + 16)) & 1).astype(np.uint8) << 4
+        q = np.concatenate([lo | lo_h, hi | hi_h], axis=1).astype(np.float32)
+        if has_m:
+            out = (d * q + m).reshape(-1)
+        else:
+            out = (d * (q - 16.0)).reshape(-1)
+    else:  # pragma: no cover
+        raise ValueError(f"unsupported ggml type {gtype}")
+    return out[:n_elements]
+
+
+def read_gguf(path: str) -> dict[str, np.ndarray]:
+    """Read a GGUF file → {tensor_name: float32/float16 ndarray}."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    r = _Reader(data)
+    if r.take(4) != GGUF_MAGIC:
+        raise ValueError(f"{path}: not a GGUF file")
+    version = r.u32()
+    if version not in (2, 3):
+        raise ValueError(f"{path}: unsupported GGUF version {version}")
+    tensor_count = r.u64()
+    kv_count = r.u64()
+
+    metadata: dict[str, Any] = {}
+    for _ in range(kv_count):
+        key = r.string()
+        vtype = r.u32()
+        metadata[key] = r.value(vtype)
+    alignment = int(metadata.get("general.alignment", 32))
+
+    infos = []
+    for _ in range(tensor_count):
+        name = r.string()
+        n_dims = r.u32()
+        # ggml dims: ne[0] is innermost/contiguous → numpy shape reversed
+        dims = [r.u64() for _ in range(n_dims)]
+        gtype = r.u32()
+        offset = r.u64()
+        infos.append((name, dims, gtype, offset))
+
+    base = (r.pos + alignment - 1) // alignment * alignment
+    out: dict[str, np.ndarray] = {}
+    for name, dims, gtype, offset in infos:
+        n = int(np.prod(dims)) if dims else 1
+        shape = tuple(reversed(dims))
+        start = base + offset
+        if gtype == GGML_F32:
+            arr = np.frombuffer(data, np.float32, count=n, offset=start).copy()
+        elif gtype == GGML_F16:
+            arr = np.frombuffer(data, np.float16, count=n, offset=start)
+            arr = arr.astype(np.float32)
+        elif gtype in _BLOCK_BYTES:
+            n_blocks = -(-n // _BLOCK)
+            nbytes = n_blocks * _BLOCK_BYTES[gtype]
+            raw = np.frombuffer(data, np.uint8, count=nbytes, offset=start)
+            arr = _dequant(raw, gtype, n)
+        else:
+            raise ValueError(
+                f"{path}: tensor {name!r} uses unsupported ggml type "
+                f"{gtype} (supported: {sorted(_TYPE_NAMES.values())})"
+            )
+        out[name] = arr.reshape(shape)
+    return out
+
+
+# --- writer (tests / export) ---------------------------------------------
+
+def _quantize(arr: np.ndarray, gtype: int) -> bytes:
+    flat = arr.astype(np.float32).reshape(-1)
+    pad = (-len(flat)) % _BLOCK
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(-1, _BLOCK)
+    out = bytearray()
+    for block in blocks:
+        if gtype == GGML_Q8_0:
+            d = float(np.abs(block).max()) / 127.0 or 1e-12
+            q = np.clip(np.round(block / d), -127, 127).astype(np.int8)
+            out += np.float16(d).tobytes() + q.tobytes()
+        elif gtype == GGML_Q4_0:
+            amax_idx = int(np.abs(block).argmax())
+            d = float(block[amax_idx]) / -8.0 or 1e-12
+            q = np.clip(np.round(block / d) + 8, 0, 15).astype(np.uint8)
+            packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)
+            out += np.float16(d).tobytes() + packed.tobytes()
+        elif gtype == GGML_Q5_0:
+            amax_idx = int(np.abs(block).argmax())
+            d = float(block[amax_idx]) / -16.0 or 1e-12
+            q = np.clip(np.round(block / d) + 16, 0, 31).astype(np.uint8)
+            qh = 0
+            for i in range(16):
+                qh |= int(q[i] >> 4) << i
+                qh |= int(q[i + 16] >> 4) << (i + 16)
+            packed = ((q[:16] & 0xF) | ((q[16:] & 0xF) << 4)).astype(np.uint8)
+            out += (
+                np.float16(d).tobytes()
+                + struct.pack("<I", qh)
+                + packed.tobytes()
+            )
+        else:  # pragma: no cover
+            raise ValueError(f"writer does not support ggml type {gtype}")
+    return bytes(out)
+
+
+def write_gguf(
+    path: str,
+    tensors: dict[str, tuple[np.ndarray, int]],
+    metadata: dict[str, Any] | None = None,
+    alignment: int = 32,
+) -> None:
+    """Write {name: (array, ggml_type)} to a GGUF v3 file."""
+    def enc_string(s: str) -> bytes:
+        raw = s.encode("utf-8")
+        return struct.pack("<Q", len(raw)) + raw
+
+    meta = {"general.alignment": alignment, **(metadata or {})}
+    head = bytearray()
+    head += GGUF_MAGIC
+    head += struct.pack("<I", 3)
+    head += struct.pack("<Q", len(tensors))
+    head += struct.pack("<Q", len(meta))
+    for key, value in meta.items():
+        head += enc_string(key)
+        if isinstance(value, bool):
+            head += struct.pack("<I", _T_BOOL) + struct.pack("<B", value)
+        elif isinstance(value, int):
+            head += struct.pack("<I", _T_U32) + struct.pack("<I", value)
+        elif isinstance(value, float):
+            head += struct.pack("<I", _T_F32) + struct.pack("<f", value)
+        else:
+            head += struct.pack("<I", _T_STRING) + enc_string(str(value))
+
+    blobs = []
+    offset = 0
+    for name, (arr, gtype) in tensors.items():
+        if gtype == GGML_F32:
+            blob = arr.astype(np.float32).tobytes()
+        elif gtype == GGML_F16:
+            blob = arr.astype(np.float16).tobytes()
+        else:
+            blob = _quantize(arr, gtype)
+        head += enc_string(name)
+        dims = list(reversed(arr.shape))  # numpy → ggml dim order
+        head += struct.pack("<I", len(dims))
+        for dim in dims:
+            head += struct.pack("<Q", dim)
+        head += struct.pack("<I", gtype)
+        head += struct.pack("<Q", offset)
+        padded = (len(blob) + alignment - 1) // alignment * alignment
+        blobs.append(blob + b"\x00" * (padded - len(blob)))
+        offset += padded
+
+    base_pad = (-len(head)) % alignment
+    with open(path, "wb") as fh:
+        fh.write(bytes(head) + b"\x00" * base_pad)
+        for blob in blobs:
+            fh.write(blob)

@@ -412,7 +412,12 @@ def synthesize_state_dict(
 # --- loading --------------------------------------------------------------
 
 def read_checkpoint(path: str) -> dict[str, np.ndarray]:
-    """Read a single-file SD checkpoint (.safetensors or torch .ckpt)."""
+    """Read a single-file SD checkpoint (.safetensors, torch .ckpt, or
+    quantized .gguf)."""
+    if path.endswith(".gguf"):
+        from .gguf import read_gguf
+
+        return read_gguf(path)
     if path.endswith(".safetensors"):
         # framework="pt": numpy can't materialize bfloat16 tensors,
         # which bf16 fine-tune checkpoints commonly carry
@@ -448,7 +453,7 @@ def find_checkpoint(model_name: str) -> str | None:
     if os.path.isfile(root):
         stem = os.path.splitext(os.path.basename(root))[0]
         return root if stem == model_name else None
-    for ext in (".safetensors", ".ckpt"):
+    for ext in (".safetensors", ".ckpt", ".gguf"):
         candidate = os.path.join(root, model_name + ext)
         if os.path.exists(candidate):
             return candidate
