@@ -73,6 +73,66 @@ class CheckpointLoaderSimple:
 
 
 @register_node
+class LoraLoader:
+    """Merge a kohya-format LoRA into the model + text-encoder weights
+    (ComfyUI LoraLoader parity; the reference free-rides on ComfyUI
+    for this). LoRA files resolve from CDT_LORA_DIR (or an absolute
+    path). Merging clones the bundle so other graph branches keep the
+    unpatched weights."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "clip": ("CLIP",),
+                "lora_name": ("STRING", {"default": ""}),
+                "strength_model": ("FLOAT", {"default": 1.0}),
+                "strength_clip": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL", "CLIP")
+    FUNCTION = "load_lora"
+
+    def load_lora(self, model: pl.PipelineBundle, clip, lora_name,
+                  strength_model=1.0, strength_clip=1.0, context=None):
+        from ..models import get_config
+        from ..models.lora import apply_lora, read_lora
+
+        path = str(lora_name)
+        if not os.path.isabs(path):
+            root = os.environ.get("CDT_LORA_DIR", "")
+            candidate = os.path.join(root, path) if root else path
+            if not os.path.exists(candidate) and not candidate.endswith(
+                ".safetensors"
+            ):
+                candidate += ".safetensors"
+            path = candidate
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"LoRA not found: {path}")
+
+        lora_sd = read_lora(path)
+        te_name = "tiny-te" if model.model_name.startswith("tiny") else "clip-l"
+        patched, unmatched = apply_lora(
+            {"unet": model.params["unet"], "te": model.params["te"]},
+            lora_sd,
+            get_config(model.model_name),
+            get_config(te_name),
+            strength=float(strength_model),
+            te_strength=float(strength_clip),
+        )
+        if unmatched:
+            log(f"LoRA {os.path.basename(path)}: {len(unmatched)} "
+                f"unmatched module(s), e.g. {unmatched[:3]}")
+        new_params = dict(model.params)
+        new_params["unet"] = patched["unet"]
+        new_params["te"] = patched["te"]
+        bundle = dataclasses.replace(model, params=new_params)
+        return (bundle, bundle)
+
+
+@register_node
 class CLIPTextEncode:
     @classmethod
     def INPUT_TYPES(cls):

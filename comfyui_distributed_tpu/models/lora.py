@@ -1,0 +1,142 @@
+"""LoRA loading + application onto flax param trees.
+
+The reference applies LoRAs through ComfyUI's LoraLoader; here the
+standard kohya-format safetensors layout —
+
+    lora_unet_<sd_path_with_underscores>.lora_down.weight  [r, I]
+    lora_unet_<...>.lora_up.weight                         [O, r]
+    lora_unet_<...>.alpha                                  scalar
+    lora_te_text_model_<...> / lora_te1_* / lora_te2_*     (text enc)
+
+— is mapped onto the same flax paths the checkpoint schedules use.
+The kohya name of a target is derived FROM the schedule (sd key with
+dots→underscores), so there is exactly one naming source of truth and
+no ambiguity when parsing underscored names back.
+
+Application: W' = W + strength * (alpha / rank) * (up @ down), merged
+into the kernel ([I, O] layout: delta = down.T @ up.T). Merging keeps
+the sampling path identical (no runtime adapter branches) — the
+ComfyUI model-patch semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .sd_checkpoint import (
+    _LINEAR,
+    _LINEAR_NOBIAS,
+    _PROJ,
+    text_encoder_schedule,
+    unet_schedule,
+)
+
+
+def _kohya_name(sd_key: str) -> str | None:
+    """sd schedule key → kohya LoRA module name (None if not a LoRA
+    target family)."""
+    if sd_key.startswith("model.diffusion_model."):
+        stem = sd_key[len("model.diffusion_model."):]
+        return "lora_unet_" + stem.replace(".", "_")
+    if sd_key.startswith("cond_stage_model.transformer."):
+        stem = sd_key[len("cond_stage_model.transformer."):]
+        return "lora_te_" + stem.replace(".", "_")
+    return None
+
+
+def lora_target_map(unet_cfg, te_cfg=None) -> dict[str, tuple[str, str]]:
+    """{kohya_module_name: (part, flax_kernel_path)} for every linear/
+    projection weight a LoRA can target."""
+    targets: dict[str, tuple[str, str]] = {}
+    schedules = [("unet", unet_schedule(unet_cfg))]
+    if te_cfg is not None:
+        schedules.append(("te", text_encoder_schedule(te_cfg)))
+    for part, entries in schedules:
+        for sd, fx, kind in entries:
+            if kind not in (_LINEAR, _LINEAR_NOBIAS, _PROJ):
+                continue
+            name = _kohya_name(f"{sd}.weight")
+            if name is None:
+                continue
+            targets[name.removesuffix("_weight")] = (
+                part, f"params/{fx}/kernel"
+            )
+    return targets
+
+
+def read_lora(path: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    return load_file(path)
+
+
+def parse_lora(state_dict: dict[str, np.ndarray]) -> dict[str, dict]:
+    """Group flat LoRA keys → {module: {down, up, alpha}}."""
+    modules: dict[str, dict] = {}
+    for key, value in state_dict.items():
+        if key.endswith(".lora_down.weight"):
+            modules.setdefault(key[: -len(".lora_down.weight")], {})["down"] = value
+        elif key.endswith(".lora_up.weight"):
+            modules.setdefault(key[: -len(".lora_up.weight")], {})["up"] = value
+        elif key.endswith(".alpha"):
+            modules.setdefault(key[: -len(".alpha")], {})["alpha"] = float(value)
+    return modules
+
+
+def apply_lora(
+    params_by_part: dict[str, Any],
+    lora_sd: dict[str, np.ndarray],
+    unet_cfg,
+    te_cfg=None,
+    strength: float = 1.0,
+    te_strength: float | None = None,
+) -> tuple[dict[str, Any], list[str]]:
+    """Merge a LoRA into {'unet': tree, 'te': tree} param trees.
+
+    Returns (new trees, unmatched module names). Unmatched modules are
+    reported, not fatal — partial LoRAs (unet-only, te-only) are
+    normal.
+    """
+    import jax
+
+    from .io import flatten_params, unflatten_params
+
+    te_strength = strength if te_strength is None else te_strength
+    targets = lora_target_map(unet_cfg, te_cfg)
+    modules = parse_lora(lora_sd)
+
+    flats = {
+        part: flatten_params(jax.device_get(tree))
+        for part, tree in params_by_part.items()
+    }
+    unmatched: list[str] = []
+    for name, payload in modules.items():
+        target = targets.get(name)
+        if target is None or "down" not in payload or "up" not in payload:
+            unmatched.append(name)
+            continue
+        part, path = target
+        flat = flats.get(part)
+        if flat is None or path not in flat:
+            unmatched.append(name)
+            continue
+        down = np.asarray(payload["down"], np.float32)
+        up = np.asarray(payload["up"], np.float32)
+        if down.ndim == 4:  # conv1x1-style LoRA on projection layers
+            down = down[:, :, 0, 0]
+            up = up[:, :, 0, 0]
+        rank = down.shape[0]
+        alpha = float(payload.get("alpha", rank))
+        s = strength if part == "unet" else te_strength
+        delta = (alpha / rank) * (down.T @ up.T)  # [I, O] kernel layout
+        kernel = np.asarray(flat[path], np.float32)
+        if delta.shape != kernel.shape:
+            unmatched.append(name)
+            continue
+        flat[path] = (kernel + s * delta).astype(flat[path].dtype)
+    return (
+        {part: unflatten_params(flat) for part, flat in flats.items()},
+        unmatched,
+    )

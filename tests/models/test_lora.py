@@ -1,0 +1,118 @@
+"""LoRA mapping + application: kohya names derived from the checkpoint
+schedules, exact merge math, node-level flow."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from comfyui_distributed_tpu.models import get_config
+from comfyui_distributed_tpu.models import lora as lora_mod
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models.io import flatten_params
+
+
+def test_target_map_covers_attention_and_ff():
+    targets = lora_mod.lora_target_map(
+        get_config("sd15"), get_config("clip-l")
+    )
+    # canonical kohya names for SD1.5
+    assert (
+        "lora_unet_input_blocks_1_1_transformer_blocks_0_attn1_to_q"
+        in targets
+    )
+    assert (
+        "lora_unet_output_blocks_11_1_transformer_blocks_0_ff_net_0_proj"
+        in targets
+    )
+    assert "lora_te_text_model_encoder_layers_0_self_attn_q_proj" in targets
+    part, path = targets[
+        "lora_unet_input_blocks_1_1_transformer_blocks_0_attn1_to_q"
+    ]
+    assert part == "unet"
+    assert path.endswith("/attn1/to_q/kernel")
+
+
+def _make_lora(kernel_shape, rank=4, alpha=2.0, seed=0):
+    rng = np.random.default_rng(seed)
+    i, o = kernel_shape
+    down = rng.normal(size=(rank, i)).astype(np.float32)
+    up = rng.normal(size=(o, rank)).astype(np.float32)
+    return down, up, alpha
+
+
+def test_apply_lora_exact_math():
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    unet_cfg = get_config("tiny-unet")
+    te_cfg = get_config("tiny-te")
+    targets = lora_mod.lora_target_map(unet_cfg, te_cfg)
+    name = "lora_unet_input_blocks_1_1_transformer_blocks_0_attn1_to_q"
+    assert name in targets
+    part, path = targets[name]
+    flat = flatten_params(jax.device_get(bundle.params[part]))
+    kernel = np.asarray(flat[path], np.float32)
+    down, up, alpha = _make_lora(kernel.shape)
+
+    sd = {
+        f"{name}.lora_down.weight": down,
+        f"{name}.lora_up.weight": up,
+        f"{name}.alpha": np.float32(alpha),
+        "lora_unet_nonexistent_module.lora_down.weight": down,
+        "lora_unet_nonexistent_module.lora_up.weight": up,
+    }
+    patched, unmatched = lora_mod.apply_lora(
+        {"unet": bundle.params["unet"], "te": bundle.params["te"]},
+        sd, unet_cfg, te_cfg, strength=0.5,
+    )
+    assert unmatched == ["lora_unet_nonexistent_module"]
+    got = flatten_params(patched["unet"])[path]
+    expect = kernel + 0.5 * (alpha / 4.0) * (down.T @ up.T)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+    # untouched layers stay identical
+    other = "params/input_conv/kernel"
+    np.testing.assert_array_equal(
+        flatten_params(patched["unet"])[other], flat[other]
+    )
+
+
+def test_lora_loader_node(tmp_path, monkeypatch):
+    from safetensors.numpy import save_file
+
+    from comfyui_distributed_tpu.graph.nodes_core import LoraLoader
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    targets = lora_mod.lora_target_map(
+        get_config("tiny-unet"), get_config("tiny-te")
+    )
+    name = next(n for n, (p, _) in targets.items() if p == "unet")
+    part, path = targets[name]
+    kernel = np.asarray(
+        flatten_params(jax.device_get(bundle.params["unet"]))[path]
+    )
+    down, up, alpha = _make_lora(kernel.shape, seed=2)
+    save_file(
+        {
+            f"{name}.lora_down.weight": down,
+            f"{name}.lora_up.weight": up,
+            f"{name}.alpha": np.asarray(alpha, np.float32),
+        },
+        str(tmp_path / "style.safetensors"),
+    )
+    monkeypatch.setenv("CDT_LORA_DIR", str(tmp_path))
+
+    node = LoraLoader()
+    new_model, new_clip = node.load_lora(bundle, bundle, "style", 1.0, 1.0)
+    got = flatten_params(jax.device_get(new_model.params["unet"]))[path]
+    assert np.abs(got - kernel).max() > 0  # patched
+    # original bundle untouched (clone semantics)
+    orig = flatten_params(jax.device_get(bundle.params["unet"]))[path]
+    np.testing.assert_array_equal(orig, kernel)
+    assert new_model is new_clip
+
+
+def test_lora_loader_missing_file():
+    from comfyui_distributed_tpu.graph.nodes_core import LoraLoader
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    with pytest.raises(FileNotFoundError):
+        LoraLoader().load_lora(bundle, bundle, "/nonexistent/x.safetensors")
