@@ -99,6 +99,14 @@ class LoraLoader:
                   strength_model=1.0, strength_clip=1.0, context=None):
         from ..models import get_config
         from ..models.lora import apply_lora, read_lora
+        from ..models.registry import DUAL_TEXT_ENCODERS, MODEL_REGISTRY
+
+        family = MODEL_REGISTRY.get(model.model_name, {}).get("family")
+        if family != "unet":
+            raise ValueError(
+                "LoRA merging is only supported for UNet-family "
+                f"checkpoints; {model.model_name!r} is family {family!r}"
+            )
 
         path = str(lora_name)
         if not os.path.isabs(path):
@@ -113,23 +121,46 @@ class LoraLoader:
             raise FileNotFoundError(f"LoRA not found: {path}")
 
         lora_sd = read_lora(path)
-        te_name = "tiny-te" if model.model_name.startswith("tiny") else "clip-l"
+        # UNet weights come from the MODEL input, text-encoder weights
+        # from the CLIP input — the two may be different bundles
+        # (ComfyUI semantics: each output patches its own input). The
+        # bundle records the encoder registry names it was built with;
+        # the name heuristics only cover bundles from older callers.
+        te_name = clip.te_name
+        te2_name = clip.te2_name
+        if te_name is None:
+            dual = DUAL_TEXT_ENCODERS.get(clip.model_name)
+            if dual:
+                te_name, te2_name = dual
+            else:
+                te_name = ("tiny-te" if clip.model_name.startswith("tiny")
+                           else "clip-l")
+        parts = {"unet": model.params["unet"], "te": clip.params["te"]}
+        has_te2 = te2_name is not None and "te2" in clip.params
+        if has_te2:
+            parts["te2"] = clip.params["te2"]
         patched, unmatched = apply_lora(
-            {"unet": model.params["unet"], "te": model.params["te"]},
+            parts,
             lora_sd,
             get_config(model.model_name),
             get_config(te_name),
+            te2_cfg=get_config(te2_name) if has_te2 else None,
             strength=float(strength_model),
             te_strength=float(strength_clip),
         )
         if unmatched:
             log(f"LoRA {os.path.basename(path)}: {len(unmatched)} "
                 f"unmatched module(s), e.g. {unmatched[:3]}")
-        new_params = dict(model.params)
-        new_params["unet"] = patched["unet"]
-        new_params["te"] = patched["te"]
-        bundle = dataclasses.replace(model, params=new_params)
-        return (bundle, bundle)
+        model_params = dict(model.params)
+        model_params["unet"] = patched["unet"]
+        clip_params = dict(clip.params)
+        clip_params["te"] = patched["te"]
+        if has_te2:
+            clip_params["te2"] = patched["te2"]
+        return (
+            dataclasses.replace(model, params=model_params),
+            dataclasses.replace(clip, params=clip_params),
+        )
 
 
 @register_node

@@ -6,17 +6,20 @@ standard kohya-format safetensors layout —
     lora_unet_<sd_path_with_underscores>.lora_down.weight  [r, I]
     lora_unet_<...>.lora_up.weight                         [O, r]
     lora_unet_<...>.alpha                                  scalar
-    lora_te_text_model_<...> / lora_te1_* / lora_te2_*     (text enc)
+    lora_te_* (SD1.x) / lora_te1_* + lora_te2_* (SDXL)     (text enc)
 
 — is mapped onto the same flax paths the checkpoint schedules use.
-The kohya name of a target is derived FROM the schedule (sd key with
-dots→underscores), so there is exactly one naming source of truth and
-no ambiguity when parsing underscored names back.
+UNet kohya names are derived FROM the schedule (sd key with
+dots→underscores) so there is exactly one naming source of truth;
+text-encoder names are generated in the HF layout kohya uses for BOTH
+SDXL encoders (its te2 keys say `text_model_encoder_layers_…` even
+though the checkpoint stores that encoder in the OpenCLIP layout).
 
 Application: W' = W + strength * (alpha / rank) * (up @ down), merged
 into the kernel ([I, O] layout: delta = down.T @ up.T). Merging keeps
 the sampling path identical (no runtime adapter branches) — the
-ComfyUI model-patch semantics.
+ComfyUI model-patch semantics. Only targeted leaves are pulled to host
+and replaced; every other leaf stays device-resident.
 """
 
 from __future__ import annotations
@@ -29,40 +32,75 @@ from .sd_checkpoint import (
     _LINEAR,
     _LINEAR_NOBIAS,
     _PROJ,
-    text_encoder_schedule,
     unet_schedule,
 )
 
 
-def _kohya_name(sd_key: str) -> str | None:
+def _kohya_unet_name(sd_key: str) -> str | None:
     """sd schedule key → kohya LoRA module name (None if not a LoRA
     target family)."""
     if sd_key.startswith("model.diffusion_model."):
         stem = sd_key[len("model.diffusion_model."):]
         return "lora_unet_" + stem.replace(".", "_")
-    if sd_key.startswith("cond_stage_model.transformer."):
-        stem = sd_key[len("cond_stage_model.transformer."):]
-        return "lora_te_" + stem.replace(".", "_")
     return None
 
 
-def lora_target_map(unet_cfg, te_cfg=None) -> dict[str, tuple[str, str]]:
-    """{kohya_module_name: (part, flax_kernel_path)} for every linear/
-    projection weight a LoRA can target."""
+# kohya module suffix → flax Dense name inside a text-encoder block
+_TE_MODULES = (
+    ("self_attn_q_proj", "q"),
+    ("self_attn_k_proj", "k"),
+    ("self_attn_v_proj", "v"),
+    ("self_attn_out_proj", "proj"),
+    ("mlp_fc1", "fc1"),
+    ("mlp_fc2", "fc2"),
+)
+
+
+def _te_targets(cfg, kohya_prefix: str, part: str) -> dict[str, tuple[str, str]]:
+    """Kohya names for one CLIP text transformer. Generated directly
+    (not from the checkpoint schedule) because kohya's naming is fixed
+    to the HF layout regardless of checkpoint prefix or on-disk layout
+    — this also makes SDXL's `conditioner.embedders.*` prefixes a
+    non-issue."""
     targets: dict[str, tuple[str, str]] = {}
-    schedules = [("unet", unet_schedule(unet_cfg))]
+    for i in range(cfg.layers):
+        for suffix, dense in _TE_MODULES:
+            name = f"{kohya_prefix}_text_model_encoder_layers_{i}_{suffix}"
+            targets[name] = (part, f"params/block_{i}/{dense}/kernel")
+    return targets
+
+
+def lora_target_map(
+    unet_cfg, te_cfg=None, te2_cfg=None
+) -> dict[str, tuple[str, str]]:
+    """{kohya_module_name: (part, flax_kernel_path)} for every linear/
+    projection weight a LoRA can target.
+
+    Raises ValueError for non-UNet backbone configs (DiT etc.) — LoRA
+    merging is only implemented for the UNet family.
+    """
+    from .unet import UNetConfig
+
+    if not isinstance(unet_cfg, UNetConfig):
+        raise ValueError(
+            "LoRA merging is only supported for UNet-family models "
+            f"(got config {type(unet_cfg).__name__})"
+        )
+    targets: dict[str, tuple[str, str]] = {}
+    for sd, fx, kind in unet_schedule(unet_cfg):
+        if kind not in (_LINEAR, _LINEAR_NOBIAS, _PROJ):
+            continue
+        name = _kohya_unet_name(sd)
+        if name is None:
+            continue
+        targets[name] = ("unet", f"params/{fx}/kernel")
     if te_cfg is not None:
-        schedules.append(("te", text_encoder_schedule(te_cfg)))
-    for part, entries in schedules:
-        for sd, fx, kind in entries:
-            if kind not in (_LINEAR, _LINEAR_NOBIAS, _PROJ):
-                continue
-            name = _kohya_name(f"{sd}.weight")
-            if name is None:
-                continue
-            targets[name.removesuffix("_weight")] = (
-                part, f"params/{fx}/kernel"
-            )
+        # SD1.x tools emit lora_te_*, SDXL tools lora_te1_* for the
+        # CLIP-L half; accept both for the primary encoder.
+        targets.update(_te_targets(te_cfg, "lora_te", "te"))
+        targets.update(_te_targets(te_cfg, "lora_te1", "te"))
+    if te2_cfg is not None:
+        targets.update(_te_targets(te2_cfg, "lora_te2", "te2"))
     return targets
 
 
@@ -85,32 +123,48 @@ def parse_lora(state_dict: dict[str, np.ndarray]) -> dict[str, dict]:
     return modules
 
 
+def _flatten_leaves(tree: Any, out: dict[str, Any], path: str = "") -> None:
+    """Flatten to {path: leaf} keeping leaves as-is (device arrays stay
+    on device — no tree-wide host copy)."""
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            _flatten_leaves(value, out, f"{path}/{key}" if path else str(key))
+    else:
+        out[path] = tree
+
+
 def apply_lora(
     params_by_part: dict[str, Any],
     lora_sd: dict[str, np.ndarray],
     unet_cfg,
     te_cfg=None,
+    te2_cfg=None,
     strength: float = 1.0,
     te_strength: float | None = None,
 ) -> tuple[dict[str, Any], list[str]]:
-    """Merge a LoRA into {'unet': tree, 'te': tree} param trees.
+    """Merge a LoRA into {'unet': tree, 'te': tree[, 'te2': tree]}.
 
     Returns (new trees, unmatched module names). Unmatched modules are
     reported, not fatal — partial LoRAs (unet-only, te-only) are
-    normal.
+    normal. Parts whose trees are untouched are returned as the same
+    object; patched parts are rebuilt with only the targeted kernels
+    replaced (device-put back), so a few-layer LoRA neither copies nor
+    re-uploads the full weight set.
     """
-    import jax
+    import jax.numpy as jnp
 
-    from .io import flatten_params, unflatten_params
+    from .io import unflatten_params
 
     te_strength = strength if te_strength is None else te_strength
-    targets = lora_target_map(unet_cfg, te_cfg)
+    targets = lora_target_map(unet_cfg, te_cfg, te2_cfg)
     modules = parse_lora(lora_sd)
 
-    flats = {
-        part: flatten_params(jax.device_get(tree))
-        for part, tree in params_by_part.items()
-    }
+    flats: dict[str, dict[str, Any]] = {}
+    for part, tree in params_by_part.items():
+        flat: dict[str, Any] = {}
+        _flatten_leaves(tree, flat)
+        flats[part] = flat
+    touched: set[str] = set()
     unmatched: list[str] = []
     for name, payload in modules.items():
         target = targets.get(name)
@@ -131,12 +185,18 @@ def apply_lora(
         alpha = float(payload.get("alpha", rank))
         s = strength if part == "unet" else te_strength
         delta = (alpha / rank) * (down.T @ up.T)  # [I, O] kernel layout
-        kernel = np.asarray(flat[path], np.float32)
+        kernel = np.asarray(flat[path], np.float32)  # single-leaf fetch
         if delta.shape != kernel.shape:
             unmatched.append(name)
             continue
-        flat[path] = (kernel + s * delta).astype(flat[path].dtype)
+        dtype = flat[path].dtype
+        flat[path] = jnp.asarray(kernel + s * delta, dtype=dtype)
+        touched.add(part)
     return (
-        {part: unflatten_params(flat) for part, flat in flats.items()},
+        {
+            part: unflatten_params(flat) if part in touched
+            else params_by_part[part]
+            for part, flat in flats.items()
+        },
         unmatched,
     )

@@ -35,6 +35,10 @@ class PipelineBundle:
     latent_scale: int = 8           # spatial down factor of the VAE
     # SDXL-class second encoder (context concat + pooled source)
     text_encoder_2: Any = None
+    # registry names the encoders were built from (LoRA mapping needs
+    # the real configs, not a guess from model_name)
+    te_name: str | None = None
+    te2_name: str | None = None
 
 
 def load_pipeline(
@@ -132,6 +136,8 @@ def load_pipeline(
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
         text_encoder_2=te2,
+        te_name=te_name,
+        te2_name=te2_name,
     )
 
 

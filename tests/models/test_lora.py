@@ -107,7 +107,114 @@ def test_lora_loader_node(tmp_path, monkeypatch):
     # original bundle untouched (clone semantics)
     orig = flatten_params(jax.device_get(bundle.params["unet"]))[path]
     np.testing.assert_array_equal(orig, kernel)
-    assert new_model is new_clip
+    # MODEL output keeps its own (unpatched) te; CLIP output keeps its
+    # own (unpatched) unet
+    assert new_model.params["te"] is bundle.params["te"]
+    assert new_clip.params["unet"] is bundle.params["unet"]
+
+
+def test_sdxl_te1_te2_targets():
+    """Kohya SDXL LoRAs use lora_te1_/lora_te2_ with HF naming for both
+    encoders; te2 maps into the OpenCLIP-loaded flax tree."""
+    targets = lora_mod.lora_target_map(
+        get_config("sdxl"), get_config("clip-l-sdxl"), get_config("clip-g")
+    )
+    assert targets["lora_te1_text_model_encoder_layers_0_self_attn_q_proj"] == (
+        "te", "params/block_0/q/kernel"
+    )
+    assert targets["lora_te2_text_model_encoder_layers_0_mlp_fc1"] == (
+        "te2", "params/block_0/fc1/kernel"
+    )
+    # lora_te_ (SD1.x-style) still resolves to the primary encoder
+    assert targets["lora_te_text_model_encoder_layers_0_mlp_fc2"] == (
+        "te", "params/block_0/fc2/kernel"
+    )
+
+
+def test_apply_lora_te2_and_untouched_part_identity():
+    """A te2-only LoRA patches te2, reports nothing unmatched, and
+    returns the untouched unet/te trees as the same objects."""
+    te2_cfg = get_config("tiny-te")
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.models import create_model
+
+    te2 = create_model("tiny-te")
+    te2_params = te2.init(
+        jax.random.key(0), jnp.zeros((1, te2_cfg.max_length), jnp.int32)
+    )
+    unet_cfg = get_config("tiny-unet")
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    path = "params/block_0/q/kernel"
+    kernel = np.asarray(flatten_params(jax.device_get(te2_params))[path])
+    down, up, alpha = _make_lora(kernel.shape, seed=3)
+    sd = {
+        "lora_te2_text_model_encoder_layers_0_self_attn_q_proj"
+        ".lora_down.weight": down,
+        "lora_te2_text_model_encoder_layers_0_self_attn_q_proj"
+        ".lora_up.weight": up,
+    }
+    patched, unmatched = lora_mod.apply_lora(
+        {
+            "unet": bundle.params["unet"],
+            "te": bundle.params["te"],
+            "te2": te2_params,
+        },
+        sd, unet_cfg, get_config("tiny-te"), te2_cfg=te2_cfg,
+        strength=1.0, te_strength=0.5,
+    )
+    assert unmatched == []
+    got = flatten_params(jax.device_get(patched["te2"]))[path]
+    # no alpha key → alpha defaults to rank → scale = te_strength
+    expect = kernel + 0.5 * (down.T @ up.T)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+    # untouched parts come back as the very same tree objects
+    assert patched["unet"] is bundle.params["unet"]
+    assert patched["te"] is bundle.params["te"]
+
+
+def test_lora_loader_separate_clip_bundle(tmp_path, monkeypatch):
+    """The CLIP output must be patched from the CLIP input's bundle,
+    not the MODEL input's."""
+    from safetensors.numpy import save_file
+
+    from comfyui_distributed_tpu.graph.nodes_core import LoraLoader
+
+    model_bundle = pl.load_pipeline("tiny-unet", seed=0)
+    clip_bundle = pl.load_pipeline("tiny-unet", seed=7)
+    path = "params/block_0/q/kernel"
+    clip_kernel = np.asarray(
+        flatten_params(jax.device_get(clip_bundle.params["te"]))[path]
+    )
+    down, up, alpha = _make_lora(clip_kernel.shape, seed=4)
+    save_file(
+        {
+            "lora_te_text_model_encoder_layers_0_self_attn_q_proj"
+            ".lora_down.weight": down,
+            "lora_te_text_model_encoder_layers_0_self_attn_q_proj"
+            ".lora_up.weight": up,
+            "lora_te_text_model_encoder_layers_0_self_attn_q_proj"
+            ".alpha": np.asarray(alpha, np.float32),
+        },
+        str(tmp_path / "te_only.safetensors"),
+    )
+    monkeypatch.setenv("CDT_LORA_DIR", str(tmp_path))
+    new_model, new_clip = LoraLoader().load_lora(
+        model_bundle, clip_bundle, "te_only", 1.0, 1.0
+    )
+    got = flatten_params(jax.device_get(new_clip.params["te"]))[path]
+    expect = clip_kernel + (alpha / 4.0) * (down.T @ up.T)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+    # the MODEL input's own te is not what was patched
+    assert new_model.params["te"] is model_bundle.params["te"]
+
+
+def test_lora_loader_rejects_non_unet():
+    from comfyui_distributed_tpu.graph.nodes_core import LoraLoader
+
+    bundle = pl.load_pipeline("tiny-dit", seed=0)
+    with pytest.raises(ValueError, match="UNet-family"):
+        LoraLoader().load_lora(bundle, bundle, "/nonexistent/x.safetensors")
 
 
 def test_lora_loader_missing_file():
