@@ -99,14 +99,7 @@ class LoraLoader:
                   strength_model=1.0, strength_clip=1.0, context=None):
         from ..models import get_config
         from ..models.lora import apply_lora, read_lora
-        from ..models.registry import DUAL_TEXT_ENCODERS, MODEL_REGISTRY
-
-        family = MODEL_REGISTRY.get(model.model_name, {}).get("family")
-        if family != "unet":
-            raise ValueError(
-                "LoRA merging is only supported for UNet-family "
-                f"checkpoints; {model.model_name!r} is family {family!r}"
-            )
+        from ..models.registry import DUAL_TEXT_ENCODERS
 
         path = str(lora_name)
         if not os.path.isabs(path):

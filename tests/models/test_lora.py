@@ -209,12 +209,19 @@ def test_lora_loader_separate_clip_bundle(tmp_path, monkeypatch):
     assert new_model.params["te"] is model_bundle.params["te"]
 
 
-def test_lora_loader_rejects_non_unet():
+def test_lora_loader_rejects_non_unet(tmp_path):
+    from safetensors.numpy import save_file
+
     from comfyui_distributed_tpu.graph.nodes_core import LoraLoader
 
+    lora_path = tmp_path / "x.safetensors"
+    save_file(
+        {"lora_unet_foo.lora_down.weight": np.zeros((2, 2), np.float32)},
+        str(lora_path),
+    )
     bundle = pl.load_pipeline("tiny-dit", seed=0)
     with pytest.raises(ValueError, match="UNet-family"):
-        LoraLoader().load_lora(bundle, bundle, "/nonexistent/x.safetensors")
+        LoraLoader().load_lora(bundle, bundle, str(lora_path))
 
 
 def test_lora_loader_missing_file():
