@@ -309,13 +309,19 @@ def _run_child(
         env=env, start_new_session=True,
     )
     try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
+        stdout, stderr = proc.communicate(
+            timeout=timeout_s if timeout_s > 0 else None
+        )
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait()
+        # collect whatever the child managed to write — the diagnostics
+        # that explain which phase blew the budget
+        stdout, stderr = proc.communicate()
+        if stderr:
+            sys.stderr.write(stderr)
         print(
             f"bench child exceeded {timeout_s:.0f}s budget "
             f"(env {extra_env.get('BENCH_MODE', '?')})",

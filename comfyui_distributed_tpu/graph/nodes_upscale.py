@@ -107,17 +107,10 @@ class UltimateSDUpscaleDistributed:
         seed = getattr(seed, "base_seed", seed)  # accept SeedSpec links
         if sampler_name not in SAMPLER_NAMES:
             raise ValueError(f"unknown sampler {sampler_name!r}")
-        if not force_uniform_tiles:
-            # Loud rejection, not silent acceptance: non-uniform tiles
-            # produce per-tile shapes, which defeat XLA compilation
-            # caching (a fresh compile per tile geometry). The uniform
-            # grid covers the same canvas by overlapping edge tiles
-            # instead — see docs/distributed-modes.md.
-            raise ValueError(
-                "force_uniform_tiles=False is not supported on TPU: "
-                "non-uniform tile shapes force per-tile recompilation. "
-                "Uniform tiles cover the full canvas via overlap."
-            )
+        # force_uniform_tiles=False keeps the reference's non-uniform
+        # seam positions (reference upscale/tile_ops.py:73-78) but with
+        # static tile shapes: edge tiles overhang into an edge-extended
+        # canvas strip that blending crops (ops/tiles.py module doc).
         batch = int(image.shape[0])
         if batch > 1 and (batch - 1) % 4 != 0:
             # WAN-family video models require 4n+1 frame batches
@@ -156,6 +149,7 @@ class UltimateSDUpscaleDistributed:
             denoise=float(denoise), seed=int(seed),
             upscale_method=upscale_method, context=context,
             mask_blur=int(mask_blur), tiled_decode=bool(tiled_decode),
+            uniform=bool(force_uniform_tiles),
         )
 
         if is_worker:
@@ -192,5 +186,6 @@ class UltimateSDUpscaleDistributed:
             cfg=float(cfg), denoise=float(denoise), seed=int(seed),
             upscale_method=upscale_method,
             mask_blur=int(mask_blur), tiled_decode=bool(tiled_decode),
+            uniform=bool(force_uniform_tiles),
         )
         return (out,)

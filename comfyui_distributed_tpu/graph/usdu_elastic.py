@@ -182,6 +182,7 @@ def run_worker_loop(
     seed: int,
     upscale_method: str = "bicubic",
     mask_blur: int = 0,
+    uniform: bool = True,
     tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
@@ -195,7 +196,7 @@ def run_worker_loop(
 
     _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
-        mask_blur=mask_blur,
+        mask_blur=mask_blur, uniform=uniform,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
@@ -296,6 +297,7 @@ def run_master_elastic(
     seed: int = 0,
     upscale_method: str = "bicubic",
     mask_blur: int = 0,
+    uniform: bool = True,
     tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
@@ -311,7 +313,7 @@ def run_master_elastic(
     store = server.job_store
     upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
-        mask_blur=mask_blur,
+        mask_blur=mask_blur, uniform=uniform,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
@@ -494,6 +496,7 @@ def run_worker_dynamic(
     seed: int,
     upscale_method: str = "bicubic",
     mask_blur: int = 0,
+    uniform: bool = True,
     tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
@@ -506,7 +509,7 @@ def run_worker_dynamic(
         raise WorkerError(f"job {job_id} never became ready", worker_id)
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
-        mask_blur=mask_blur,
+        mask_blur=mask_blur, uniform=uniform,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
@@ -555,6 +558,7 @@ def run_master_dynamic(
     seed: int = 0,
     upscale_method: str = "bicubic",
     mask_blur: int = 0,
+    uniform: bool = True,
     tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
@@ -569,7 +573,7 @@ def run_master_dynamic(
     batch = int(image.shape[0])
     upscaled, grid, _ = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
-        mask_blur=mask_blur,
+        mask_blur=mask_blur, uniform=uniform,
     )
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)

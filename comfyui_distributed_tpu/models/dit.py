@@ -2,11 +2,24 @@
 
 The model family behind the reference's WAN t2v/i2v workflows
 (reference workflows/distributed-wan*.json), rebuilt as a TPU-native
-DiT: 3D patchification of [B, F, H, W, C] video latents, joint
-spatio-temporal self-attention (sequence-parallel-ready token layout),
-cross-attention to text, AdaLN-zero timestep modulation, rotary
-position embeddings. Sized by config: wan-1.3b-class runs seed-parallel
-on a v5e-8; wan-14b-class FSDP-shards across a v5p-16 (BASELINE.md).
+DiT that is *checkpoint-faithful* to the original WAN 2.x layout:
+3D patchification of [B, F, H, W, C] video latents, joint
+spatio-temporal self-attention with 3D rotary embeddings (frequency
+budget split across frame/height/width like WAN's rope_params),
+RMS-normed Q/K, cross-attention to text, learned per-block AdaLN
+modulation added to a shared 6-way timestep projection, and a
+modulated output head. Real `blocks.N.*` WAN state dicts map onto this
+tree via `sd_checkpoint.wan_schedule`.
+
+Sized by config: wan-1.3b-class runs seed-parallel on a v5e-8;
+wan-14b-class FSDP-shards across a v5p-16 (BASELINE.md).
+
+Sequence parallelism: with `seq_axis` set the model is being called
+inside shard_map with the FRAME axis sharded along that mesh axis;
+self-attention runs as ring attention over the full sequence and the
+rope grid uses each shard's global frame offset. The parameter tree is
+identical either way — the same params serve sharded and unsharded
+calls.
 """
 
 from __future__ import annotations
@@ -25,32 +38,72 @@ from ..ops.attention import dot_product_attention
 @dataclasses.dataclass(frozen=True)
 class DiTConfig:
     in_channels: int = 16
+    out_channels: int | None = None  # defaults to in_channels
     patch_size: tuple[int, int, int] = (1, 2, 2)  # (frames, h, w)
     hidden_dim: int = 1536
+    ffn_dim: int | None = None  # defaults to 4*hidden_dim; WAN uses ~5.8x
     depth: int = 30
     heads: int = 12
     context_dim: int = 4096
+    freq_dim: int = 256  # sinusoidal timestep embedding width (WAN: 256)
     dtype: str = "bfloat16"
     # Context/sequence parallelism: when set, the model is being called
     # inside shard_map with the FRAME axis sharded along this mesh axis;
     # self-attention runs as ring attention over the full sequence and
-    # RoPE positions are offset by the shard index.
+    # rope positions are offset by the shard index.
     seq_axis: str | None = None
 
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
 
+    @property
+    def ffn_width(self) -> int:
+        return self.ffn_dim if self.ffn_dim is not None else 4 * self.hidden_dim
 
-def _rope_freqs(dim: int, length: int, theta: float = 10000.0) -> np.ndarray:
+    @property
+    def out_width(self) -> int:
+        return self.out_channels if self.out_channels is not None else self.in_channels
+
+
+def _axis_freqs(dim: int, length: int, theta: float = 10000.0) -> np.ndarray:
+    """[length, dim/2, 2] cos/sin table for one rope axis."""
     inv = 1.0 / (theta ** (np.arange(0, dim, 2) / dim))
     t = np.arange(length)
     freqs = np.outer(t, inv)
-    return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1)  # [L, dim/2, 2]
+    return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1)
+
+
+def rope_split(head_dim: int) -> tuple[int, int, int]:
+    """Frequency-pair budget per (frame, h, w) axis — WAN's rope_params
+    split: of the d/2 complex pairs, h and w each get (d/2)//3 and the
+    frame axis gets the remainder."""
+    pairs = head_dim // 2
+    kh = kw = pairs // 3
+    kt = pairs - 2 * kh
+    return kt, kh, kw
+
+
+def rope_freqs_3d(head_dim: int, grid: tuple[int, int, int]) -> np.ndarray:
+    """[N, head_dim/2, 2] rope table for a (gf, gh, gw) token grid in
+    row-major (f, h, w) order. (Sharded frame axes build the table over
+    the global frame count and slice their window by ring position —
+    VideoDiT.__call__.)"""
+    gf, gh, gw = grid
+    kt, kh, kw = rope_split(head_dim)
+    tf = _axis_freqs(2 * kt, gf)
+    th = _axis_freqs(2 * kh, gh)
+    tw = _axis_freqs(2 * kw, gw)
+    parts = [
+        np.broadcast_to(tf[:, None, None], (gf, gh, gw, kt, 2)),
+        np.broadcast_to(th[None, :, None], (gf, gh, gw, kh, 2)),
+        np.broadcast_to(tw[None, None, :], (gf, gh, gw, kw, 2)),
+    ]
+    return np.concatenate(parts, axis=3).reshape(gf * gh * gw, head_dim // 2, 2)
 
 
 def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
-    """x: [B, N, H, D]; freqs: [N, D/2, 2]."""
+    """x: [B, N, H, D]; freqs: [N, D/2, 2] (adjacent-pair rotation)."""
     xf = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, 2)
     cos = freqs[None, :, None, :, 0]
     sin = freqs[None, :, None, :, 1]
@@ -64,71 +117,84 @@ def apply_rope(x: jax.Array, freqs: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
-class _AdaLNBlock(nn.Module):
+class _WanBlock(nn.Module):
+    """One WAN transformer block.
+
+    Submodule names mirror the original state-dict keys (self_attn_q ↔
+    blocks.N.self_attn.q, ...) so the key schedule in
+    sd_checkpoint.wan_schedule stays a straight rename."""
+
     heads: int
+    ffn_width: int
     dtype: jnp.dtype
     seq_axis: str | None = None
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, cond: jax.Array, context: jax.Array, freqs: jax.Array
+        self, x: jax.Array, e6: jax.Array, context: jax.Array, freqs: jax.Array
     ) -> jax.Array:
         dim = x.shape[-1]
         head_dim = dim // self.heads
-        # 6-way modulation, zero-init so blocks start as identity
-        mod = nn.Dense(
-            6 * dim, dtype=jnp.float32, kernel_init=nn.initializers.zeros,
-            name="ada_mod",
-        )(nn.silu(cond.astype(jnp.float32)))
-        sh1, sc1, g1, sh2, sc2, g2 = jnp.split(mod[:, None, :], 6, axis=-1)
+        b, n, _ = x.shape
 
-        h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
-            x.astype(jnp.float32)
+        # learned per-block modulation added to the shared 6-way
+        # timestep projection (WAN blocks.N.modulation)
+        modulation = self.param(
+            "modulation",
+            nn.initializers.normal(stddev=dim**-0.5),
+            (1, 6, dim),
+            jnp.float32,
         )
+        e = modulation + e6.astype(jnp.float32)  # [B, 6, dim]
+        sh1, sc1, g1, sh2, sc2, g2 = [e[:, i][:, None, :] for i in range(6)]
+
+        # --- self-attention (modulated, rope, rms q/k norm) ---
+        h = nn.LayerNorm(
+            use_bias=False, use_scale=False, dtype=jnp.float32, name="norm1"
+        )(x.astype(jnp.float32))
         h = (h * (1 + sc1) + sh1).astype(self.dtype)
-        b, n, _ = h.shape
-        q = nn.Dense(dim, dtype=self.dtype, name="q")(h).reshape(
-            b, n, self.heads, head_dim
-        )
-        k = nn.Dense(dim, dtype=self.dtype, name="k")(h).reshape(
-            b, n, self.heads, head_dim
-        )
-        v = nn.Dense(dim, dtype=self.dtype, name="v")(h).reshape(
-            b, n, self.heads, head_dim
-        )
-        q = apply_rope(q, freqs)
-        k = apply_rope(k, freqs)
+        q = nn.Dense(dim, dtype=self.dtype, name="self_attn_q")(h)
+        k = nn.Dense(dim, dtype=self.dtype, name="self_attn_k")(h)
+        v = nn.Dense(dim, dtype=self.dtype, name="self_attn_v")(h)
+        q = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="self_attn_norm_q")(q)
+        k = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="self_attn_norm_k")(k)
+        q = apply_rope(q.astype(self.dtype).reshape(b, n, self.heads, head_dim), freqs)
+        k = apply_rope(k.astype(self.dtype).reshape(b, n, self.heads, head_dim), freqs)
+        v = v.reshape(b, n, self.heads, head_dim)
         if self.seq_axis is not None:
             from ..ops.ring_attention import ring_attention
 
             attn = ring_attention(q, k, v, self.seq_axis).reshape(b, n, dim)
         else:
             attn = dot_product_attention(q, k, v).reshape(b, n, dim)
-        x = x + g1 * nn.Dense(dim, dtype=self.dtype, name="attn_proj")(attn)
+        y = nn.Dense(dim, dtype=self.dtype, name="self_attn_o")(attn)
+        x = (x.astype(jnp.float32) + y.astype(jnp.float32) * g1).astype(x.dtype)
 
-        # cross-attention to text (un-modulated, WAN-style)
-        h = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32)).astype(self.dtype)
-        m = context.shape[1]
-        qc = nn.Dense(dim, dtype=self.dtype, name="xq")(h).reshape(
-            b, n, self.heads, head_dim
-        )
-        kc = nn.Dense(dim, dtype=self.dtype, name="xk")(context).reshape(
-            b, m, self.heads, head_dim
-        )
-        vc = nn.Dense(dim, dtype=self.dtype, name="xv")(context).reshape(
-            b, m, self.heads, head_dim
-        )
-        xattn = dot_product_attention(qc, kc, vc).reshape(b, n, dim)
-        x = x + nn.Dense(dim, dtype=self.dtype, name="xattn_proj")(xattn)
-
-        h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
+        # --- cross-attention to text (un-modulated, affine-normed) ---
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(
             x.astype(jnp.float32)
-        )
+        ).astype(self.dtype)
+        m = context.shape[1]
+        qc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_q")(h)
+        kc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_k")(context)
+        vc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_v")(context)
+        qc = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_q")(qc)
+        kc = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_k")(kc)
+        qc = qc.astype(self.dtype).reshape(b, n, self.heads, head_dim)
+        kc = kc.astype(self.dtype).reshape(b, m, self.heads, head_dim)
+        vc = vc.reshape(b, m, self.heads, head_dim)
+        xattn = dot_product_attention(qc, kc, vc).reshape(b, n, dim)
+        x = x + nn.Dense(dim, dtype=self.dtype, name="cross_attn_o")(xattn)
+
+        # --- feed-forward (modulated) ---
+        h = nn.LayerNorm(
+            use_bias=False, use_scale=False, dtype=jnp.float32, name="norm2"
+        )(x.astype(jnp.float32))
         h = (h * (1 + sc2) + sh2).astype(self.dtype)
-        h = nn.Dense(dim * 4, dtype=self.dtype, name="mlp_fc1")(h)
+        h = nn.Dense(self.ffn_width, dtype=self.dtype, name="ffn_0")(h)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(dim, dtype=self.dtype, name="mlp_fc2")(h)
-        return x + g2 * h
+        y = nn.Dense(dim, dtype=self.dtype, name="ffn_2")(h)
+        return (x.astype(jnp.float32) + y.astype(jnp.float32) * g2).astype(x.dtype)
 
 
 class VideoDiT(nn.Module):
@@ -149,7 +215,8 @@ class VideoDiT(nn.Module):
         gf, gh, gw = f // pf, hh // ph, ww // pw
         n = gf * gh * gw
 
-        # 3D patchify → tokens
+        # 3D patchify → tokens; flatten order (pf, ph, pw, c) matches the
+        # conv3d kernel transform in sd_checkpoint (patch_embedding)
         tokens = x.reshape(b, gf, pf, gh, ph, gw, pw, c)
         tokens = tokens.transpose(0, 1, 3, 5, 2, 4, 6, 7).reshape(
             b, n, pf * ph * pw * c
@@ -158,53 +225,66 @@ class VideoDiT(nn.Module):
             tokens.astype(dt)
         )
 
-        cond = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="t_embed_0")(
-            timestep_embedding(timesteps, 256)
+        # timestep MLP (WAN time_embedding) + shared 6-way projection
+        # (WAN time_projection); blocks add their learned modulation
+        e_t = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="time_embed_0")(
+            timestep_embedding(timesteps, cfg.freq_dim)
         )
-        cond = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="t_embed_1")(
-            nn.silu(cond)
+        e_t = nn.Dense(cfg.hidden_dim, dtype=jnp.float32, name="time_embed_2")(
+            nn.silu(e_t)
         )
+        e6 = nn.Dense(6 * cfg.hidden_dim, dtype=jnp.float32, name="time_proj")(
+            nn.silu(e_t)
+        ).reshape(b, 6, cfg.hidden_dim)
 
-        context = nn.Dense(cfg.hidden_dim, dtype=dt, name="context_proj")(
+        # text MLP (WAN text_embedding)
+        context = nn.Dense(cfg.hidden_dim, dtype=dt, name="text_embed_0")(
             context.astype(dt)
+        )
+        context = nn.Dense(cfg.hidden_dim, dtype=dt, name="text_embed_2")(
+            nn.gelu(context, approximate=True)
         )
 
         head_dim = cfg.hidden_dim // cfg.heads
         if cfg.seq_axis is not None:
-            # sharded sequence: local tokens are a contiguous chunk; the
-            # RoPE table covers the GLOBAL sequence and each shard slices
-            # its window by ring position
+            # sharded frame axis: local tokens are a contiguous frame
+            # window; the rope table covers the GLOBAL frame count and
+            # each shard takes its window by ring position
             axis_size = jax.lax.psum(1, cfg.seq_axis)
-            global_n = n * axis_size
-            full = jnp.asarray(_rope_freqs(head_dim, global_n), dtype=jnp.float32)
-            offset = jax.lax.axis_index(cfg.seq_axis) * n
-            freqs = jax.lax.dynamic_slice(
-                full, (offset, 0, 0), (n, full.shape[1], full.shape[2])
-            )
+            shard = jax.lax.axis_index(cfg.seq_axis)
+            full = jnp.asarray(
+                rope_freqs_3d(head_dim, (gf * axis_size, gh, gw)), jnp.float32
+            ).reshape(gf * axis_size, gh * gw, head_dim // 2, 2)
+            freqs = jax.lax.dynamic_slice_in_dim(full, shard * gf, gf, axis=0)
+            freqs = freqs.reshape(n, head_dim // 2, 2)
         else:
-            freqs = jnp.asarray(_rope_freqs(head_dim, n), dtype=jnp.float32)
+            freqs = jnp.asarray(rope_freqs_3d(head_dim, (gf, gh, gw)), jnp.float32)
 
         for i in range(cfg.depth):
-            tokens = _AdaLNBlock(
-                cfg.heads, dt, seq_axis=cfg.seq_axis, name=f"block_{i}"
-            )(tokens, cond, context, freqs)
+            tokens = _WanBlock(
+                cfg.heads, cfg.ffn_width, dt, seq_axis=cfg.seq_axis,
+                name=f"block_{i}",
+            )(tokens, e6, context, freqs)
 
-        # final AdaLN + unpatchify, zero-init output
-        mod = nn.Dense(
-            2 * cfg.hidden_dim, dtype=jnp.float32,
-            kernel_init=nn.initializers.zeros, name="final_mod",
-        )(nn.silu(cond))
-        shift, scale = jnp.split(mod[:, None, :], 2, axis=-1)
+        # modulated output head (WAN head: norm → Linear, with a learned
+        # 2-way modulation added to the raw timestep embedding)
+        head_mod = self.param(
+            "head_modulation",
+            nn.initializers.normal(stddev=cfg.hidden_dim**-0.5),
+            (1, 2, cfg.hidden_dim),
+            jnp.float32,
+        )
+        e2 = head_mod + e_t[:, None, :]
+        shift, scale = e2[:, 0][:, None, :], e2[:, 1][:, None, :]
         h = nn.LayerNorm(use_bias=False, use_scale=False, dtype=jnp.float32)(
             tokens.astype(jnp.float32)
         )
         h = h * (1 + scale) + shift
         out = nn.Dense(
-            pf * ph * pw * cfg.in_channels,
-            dtype=jnp.float32,
-            kernel_init=nn.initializers.zeros,
-            name="final_proj",
+            pf * ph * pw * cfg.out_width, dtype=jnp.float32, name="head"
         )(h)
-        out = out.reshape(b, gf, gh, gw, pf, ph, pw, cfg.in_channels)
-        out = out.transpose(0, 1, 4, 2, 5, 3, 6, 7).reshape(b, f, hh, ww, cfg.in_channels)
+        out = out.reshape(b, gf, gh, gw, pf, ph, pw, cfg.out_width)
+        out = out.transpose(0, 1, 4, 2, 5, 3, 6, 7).reshape(
+            b, f, hh, ww, cfg.out_width
+        )
         return out

@@ -69,14 +69,18 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             adm_in_channels=96 + 6 * 256,
         ),
     },
-    # --- video DiT backbones ---
+    # --- video DiT backbones (WAN 2.x checkpoint-faithful dims) ---
     "wan-1.3b": {
         "family": "dit",
-        "config": DiTConfig(hidden_dim=1536, depth=30, heads=12, context_dim=4096),
+        "config": DiTConfig(
+            hidden_dim=1536, ffn_dim=8960, depth=30, heads=12, context_dim=4096
+        ),
     },
     "wan-14b": {
         "family": "dit",
-        "config": DiTConfig(hidden_dim=5120, depth=40, heads=40, context_dim=4096),
+        "config": DiTConfig(
+            hidden_dim=5120, ffn_dim=13824, depth=40, heads=40, context_dim=4096
+        ),
     },
     "tiny-dit": {
         "family": "dit",

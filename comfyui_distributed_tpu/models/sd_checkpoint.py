@@ -307,6 +307,47 @@ def open_clip_schedule(
     return entries
 
 
+def wan_schedule(cfg, prefix: str = "") -> list[Entry]:
+    """WAN 2.x video DiT state dict (`blocks.N.*`, `patch_embedding`,
+    `time_embedding`, `time_projection`, `text_embedding`, `head.*`) →
+    VideoDiT flax tree (models/dit.py). The capability the reference
+    gets from ComfyUI's WAN loader (reference workflows/distributed-wan*.json
+    rely on CheckpointLoaderSimple/UNETLoader).
+
+    `prefix` handles ComfyUI-repacked checkpoints that nest the DiT
+    under `model.diffusion_model.` — pass it with the trailing dot.
+    """
+    p = prefix
+    pf, ph, pw = cfg.patch_size
+    conv3d = f"conv3d:{pf}:{ph}:{pw}:{cfg.in_channels}"
+    entries: list[Entry] = [
+        (f"{p}patch_embedding", "patch_embed", conv3d),
+        (f"{p}text_embedding.0", "text_embed_0", _LINEAR),
+        (f"{p}text_embedding.2", "text_embed_2", _LINEAR),
+        (f"{p}time_embedding.0", "time_embed_0", _LINEAR),
+        (f"{p}time_embedding.2", "time_embed_2", _LINEAR),
+        (f"{p}time_projection.1", "time_proj", _LINEAR),
+    ]
+    for i in range(cfg.depth):
+        sd, fx = f"{p}blocks.{i}", f"block_{i}"
+        for attn in ("self_attn", "cross_attn"):
+            for leaf in ("q", "k", "v", "o"):
+                entries.append((f"{sd}.{attn}.{leaf}", f"{fx}/{attn}_{leaf}", _LINEAR))
+            for leaf in ("norm_q", "norm_k"):
+                entries.append((f"{sd}.{attn}.{leaf}", f"{fx}/{attn}_{leaf}", "rms"))
+        entries += [
+            (f"{sd}.norm3", f"{fx}/norm3", _NORM),
+            (f"{sd}.ffn.0", f"{fx}/ffn_0", _LINEAR),
+            (f"{sd}.ffn.2", f"{fx}/ffn_2", _LINEAR),
+            (f"{sd}.modulation", f"{fx}/modulation", "param_bare"),
+        ]
+    entries += [
+        (f"{p}head.head", "head", _LINEAR),
+        (f"{p}head.modulation", "head_modulation", "param_bare"),
+    ]
+    return entries
+
+
 # --- conversion -----------------------------------------------------------
 
 def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
@@ -333,6 +374,11 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
             out.append((f"{sd}.weight", fx, "id"))
         elif kind == "param_bare":  # bare nn.Parameter, no .weight suffix
             out.append((sd, fx, "id"))
+        elif kind == "rms":  # RMSNorm: weight only → scale
+            out.append((f"{sd}.weight", f"{fx}/scale", "id"))
+        elif kind.startswith("conv3d"):  # 3D patch conv → patchify dense
+            out.append((f"{sd}.weight", f"{fx}/kernel", kind))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
         elif kind == "fused_qkv":
             # OpenCLIP in_proj: one [3W, W] weight / [3W] bias → the
             # three q/k/v Dense params
@@ -358,6 +404,11 @@ def _transform(value: np.ndarray, how: str) -> np.ndarray:
         third = value.shape[0] // 3
         part = value[slot * third : (slot + 1) * third]
         return np.transpose(part, (1, 0)) if how.endswith("_w") else part
+    if how.startswith("conv3d"):
+        # torch Conv3d [O, C, pf, ph, pw] → patchify Dense
+        # [pf*ph*pw*C, O]: row order must match the DiT's
+        # (pf, ph, pw, c) token flatten order
+        return np.transpose(value, (2, 3, 4, 1, 0)).reshape(-1, value.shape[0])
     return value
 
 
@@ -366,6 +417,12 @@ def _inverse_transform(value: np.ndarray, how: str) -> np.ndarray:
         return np.transpose(value, (3, 2, 0, 1))
     if how in ("linear", "proj"):
         return np.transpose(value, (1, 0))
+    if how.startswith("conv3d"):
+        pf, ph, pw, cin = (int(x) for x in how.split(":")[1:])
+        out = value.shape[-1]
+        return np.transpose(
+            value.reshape(pf, ph, pw, cin, out), (4, 3, 0, 1, 2)
+        )
     return value
 
 
@@ -458,6 +515,52 @@ def find_checkpoint(model_name: str) -> str | None:
         if os.path.exists(candidate):
             return candidate
     return None
+
+
+def load_wan_weights(
+    state_dict: dict[str, np.ndarray],
+    dit_cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map a WAN DiT state dict onto the VideoDiT param tree.
+
+    Accepts both the original bare layout (`blocks.0....`) and
+    ComfyUI-repacked files (`model.diffusion_model.blocks.0....`).
+    Returns (params, problems); template leaves the checkpoint lacks
+    are kept at init (or raise when strict).
+    """
+    from .io import flatten_params, unflatten_params
+    import jax
+
+    prefix = (
+        "model.diffusion_model."
+        if any(k.startswith("model.diffusion_model.blocks.") for k in state_dict)
+        else ""
+    )
+    entries = wan_schedule(dit_cfg, prefix=prefix)
+    template_flat = flatten_params(jax.device_get(template))
+    converted, missing = convert_state_dict(state_dict, entries)
+    problems = [f"dit: checkpoint lacks {k}" for k in missing]
+    merged: dict[str, np.ndarray] = {}
+    for key, tval in template_flat.items():
+        cval = converted.get(key)
+        if cval is None:
+            problems.append(f"dit: schedule lacks {key}")
+            merged[key] = tval
+        elif tuple(cval.shape) != tuple(tval.shape):
+            problems.append(
+                f"dit: shape mismatch {key}: ckpt {cval.shape} vs model {tval.shape}"
+            )
+            merged[key] = tval
+        else:
+            merged[key] = cval.astype(tval.dtype)
+    if problems and strict:
+        raise ValueError(
+            f"WAN checkpoint mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return unflatten_params(merged), problems
 
 
 def load_sd_weights(

@@ -49,7 +49,16 @@ def load_video_pipeline(
     vae_name: str | None = None,
     te_name: str | None = None,
     seed: int = 0,
+    checkpoint: str | None = None,
 ) -> VideoPipelineBundle:
+    """Build a video pipeline; load real DiT weights when a checkpoint
+    resolves (explicit `checkpoint` arg, then
+    `CDT_CHECKPOINT_DIR/<model_name>.{safetensors,ckpt,gguf}`). WAN 2.x
+    DiT state dicts — original `blocks.N.*` layout or ComfyUI-repacked
+    `model.diffusion_model.*` — map key-by-key into the VideoDiT tree
+    (sd_checkpoint.wan_schedule). The VAE/text-encoder stay init-seeded
+    (WAN's causal-3D VAE and UMT5 are separate checkpoint files; slot
+    them in via models/io.py when present)."""
     tiny = model_name.startswith("tiny")
     vae_name = vae_name or ("tiny-vae-video" if tiny else "vae-video")
     te_name = te_name or ("tiny-te" if tiny else "clip-l")
@@ -68,6 +77,18 @@ def load_video_pipeline(
     dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx)
     vae_params = vae.init(k_vae, jnp.zeros((1, 32, 32, 3)))
     te_params = te.init(k_te, jnp.zeros((1, te_cfg.max_length), jnp.int32))
+
+    from . import sd_checkpoint as sdc
+
+    ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
+    if ckpt_path:
+        from ..utils.logging import log
+
+        log(f"loading WAN checkpoint {ckpt_path} for {model_name}")
+        state_dict = sdc.read_checkpoint(ckpt_path)
+        dit_params, _problems = sdc.load_wan_weights(
+            state_dict, dit_cfg, dit_params
+        )
 
     return VideoPipelineBundle(
         model_name=model_name,

@@ -9,12 +9,19 @@ order-independent feathered weighted average so tiles can be produced
 by any participant in any order with a numerically equivalent result
 (identical up to float accumulation order, ~1 ULP).
 
-Uniform tiles are the only mode: every tile has the same static shape
-(the reference's `force_uniform_tiles=True` path), which is both the
-XLA-friendly choice and the reference's default. Non-uniform tiles
-(dynamic per-tile shapes) are intentionally unsupported on the fast
-path — edge tiles are handled by clamping tile origins so the last
-row/column overlaps its neighbor instead of shrinking.
+Every tile has the same static shape in BOTH grid modes — the TPU
+re-design of the reference's uniform/non-uniform choice
+(upscale/tile_ops.py:73-78):
+
+- uniform (`force_uniform_tiles=True`, default): edge-tile origins are
+  clamped so the last row/column overlaps its neighbor instead of
+  shrinking.
+- non-uniform (`force_uniform_tiles=False`): tile origins stay on the
+  plain ceil grid (the reference's smaller-edge-tile boundaries), and
+  instead of shrinking the edge tiles — dynamic shapes, poison for XLA
+  — the canvas is edge-extended to full grid coverage; the out-of-image
+  strip edge tiles produce is cropped away after blending. Same seam
+  positions as the reference, same static shapes as the uniform path.
 """
 
 from __future__ import annotations
@@ -44,6 +51,10 @@ class TileGrid:
     # feather-ramp width in pixels (reference USDU `mask_blur`);
     # 0 = full padding width. Clamped to the padding ring.
     mask_blur: int = 0
+    # False = ceil-grid origins without clamping (reference
+    # force_uniform_tiles=False seam positions); edge tiles then extend
+    # past the image into an edge-padded strip that blending crops.
+    uniform: bool = True
 
     @property
     def feather(self) -> int:
@@ -54,6 +65,16 @@ class TileGrid:
     @property
     def num_tiles(self) -> int:
         return self.rows * self.cols
+
+    @property
+    def coverage_h(self) -> int:
+        """Canvas height the grid actually covers (≥ image_h when
+        non-uniform edge tiles overhang the image)."""
+        return max(self.image_h, max(y for y, _ in self.positions) + self.tile_h)
+
+    @property
+    def coverage_w(self) -> int:
+        return max(self.image_w, max(x for _, x in self.positions) + self.tile_w)
 
     @property
     def padded_h(self) -> int:
@@ -74,12 +95,15 @@ def calculate_tiles(
     tile_w: int,
     padding: int = 32,
     mask_blur: int = 0,
+    uniform: bool = True,
 ) -> TileGrid:
-    """Ceil-grid tiling with clamped origins (uniform tile shapes).
+    """Ceil-grid tiling, every tile exactly (tile_h, tile_w).
 
     Parity with reference upscale/tile_ops.py `calculate_tiles` (ceil
-    grid) but instead of shrinking edge tiles, the last row/column is
-    shifted left/up so every tile is exactly (tile_h, tile_w).
+    grid). uniform=True shifts the last row/column left/up so it
+    overlaps its neighbor; uniform=False keeps the reference's
+    non-uniform seam positions (plain r*tile_h origins) with edge
+    tiles overhanging into an edge-extended canvas strip.
     """
     tile_h = min(tile_h, image_h)
     tile_w = min(tile_w, image_w)
@@ -87,9 +111,9 @@ def calculate_tiles(
     cols = max(1, math.ceil(image_w / tile_w))
     positions = []
     for r in range(rows):
-        y = min(r * tile_h, image_h - tile_h)
+        y = r * tile_h if not uniform else min(r * tile_h, image_h - tile_h)
         for c in range(cols):
-            x = min(c * tile_w, image_w - tile_w)
+            x = c * tile_w if not uniform else min(c * tile_w, image_w - tile_w)
             positions.append((y, x))
     return TileGrid(
         image_h=image_h,
@@ -101,15 +125,30 @@ def calculate_tiles(
         cols=cols,
         positions=tuple(positions),
         mask_blur=mask_blur,
+        uniform=uniform,
     )
 
 
 def pad_image_for_grid(images: jax.Array, grid: TileGrid) -> jax.Array:
-    """Reflect-pad [B, H, W, C] so padded tile extraction never clips."""
+    """Pad [B, H, W, C] so padded tile extraction never clips: a
+    reflect ring of `padding`, plus (non-uniform grids) edge-replicated
+    bottom/right strips out to the grid's coverage."""
     p = grid.padding
-    if p == 0:
+    extra_h = grid.coverage_h - grid.image_h
+    extra_w = grid.coverage_w - grid.image_w
+    if p == 0 and extra_h == 0 and extra_w == 0:
         return images
-    return jnp.pad(images, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+    out = images
+    # Edge-extend FIRST so the overhang strip replicates the true image
+    # edge; reflect-padding first would make the strip copy a reflected
+    # interior row instead.
+    if extra_h or extra_w:
+        out = jnp.pad(
+            out, ((0, 0), (0, extra_h), (0, extra_w), (0, 0)), mode="edge"
+        )
+    if p > 0:
+        out = jnp.pad(out, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+    return out
 
 
 @partial(jax.jit, static_argnames=("tile_h", "tile_w"))
@@ -193,7 +232,7 @@ def blend_tiles(tiles: jax.Array, grid: TileGrid) -> jax.Array:
 def _blend_tiles_segment(tiles: jax.Array, grid: TileGrid) -> jax.Array:
     batch, channels = int(tiles.shape[1]), int(tiles.shape[4])
     p = grid.padding
-    ph, pw = grid.image_h + 2 * p, grid.image_w + 2 * p
+    ph, pw = grid.coverage_h + 2 * p, grid.coverage_w + 2 * p
     th, tw = grid.padded_h, grid.padded_w
     area = th * tw
 
@@ -228,7 +267,7 @@ def _blend_tiles_segment(tiles: jax.Array, grid: TileGrid) -> jax.Array:
 def _blend_tiles_scan(tiles: jax.Array, grid: TileGrid) -> jax.Array:
     batch, channels = int(tiles.shape[1]), int(tiles.shape[4])
     p = grid.padding
-    ph, pw = grid.image_h + 2 * p, grid.image_w + 2 * p
+    ph, pw = grid.coverage_h + 2 * p, grid.coverage_w + 2 * p
     mask = feather_mask(grid, dtype=tiles.dtype)[None, :, :, None]
     pos = grid.positions_array()
 

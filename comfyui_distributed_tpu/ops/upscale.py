@@ -92,6 +92,7 @@ def plan_grid(
     padding: int,
     tile_h: int | None = None,
     mask_blur: int = 0,
+    uniform: bool = True,
 ) -> tuple[int, int, tile_ops.TileGrid]:
     """Target size + tile grid for an upscale run. Tile geometry is
     clamped to the image and snapped to the VAE factor (8) so latent
@@ -104,7 +105,8 @@ def plan_grid(
     tile_h = max(64, (int(tile_h) // 8) * 8)
     padding = max(8, (padding // 8) * 8)
     grid = tile_ops.calculate_tiles(
-        out_h, out_w, tile_h, tile_w, padding, mask_blur=mask_blur
+        out_h, out_w, tile_h, tile_w, padding, mask_blur=mask_blur,
+        uniform=uniform,
     )
     return out_h, out_w, grid
 
@@ -117,6 +119,7 @@ def prepare_upscaled_tiles(
     upscale_method: str = "bicubic",
     tile_h: int | None = None,
     mask_blur: int = 0,
+    uniform: bool = True,
 ) -> tuple[jax.Array, tile_ops.TileGrid, jax.Array]:
     """Shared preamble for every USDU path (local / mesh / elastic
     master / elastic worker): resize, clip, extract. All participants
@@ -124,12 +127,31 @@ def prepare_upscaled_tiles(
     makes cross-participant requeue seamless."""
     b, h, w, c = image.shape
     out_h, out_w, grid = plan_grid(
-        h, w, upscale_by, tile_w, padding, tile_h, mask_blur=mask_blur
+        h, w, upscale_by, tile_w, padding, tile_h, mask_blur=mask_blur,
+        uniform=uniform,
     )
     upscaled = jnp.clip(
         resize_image(image, out_h, out_w, upscale_method), 0.0, 1.0
     )
     return upscaled, grid, tile_ops.extract_tiles(upscaled, grid)
+
+
+def _pad_plane_for_grid(arr: jax.Array, grid: tile_ops.TileGrid) -> jax.Array:
+    """Reflect-pad a [B, H, W(, C)] plane by the grid padding plus the
+    coverage overhang (non-uniform grids) — the conditioning twin of
+    tile_ops.pad_image_for_grid."""
+    p = grid.padding
+    extra_h = grid.coverage_h - grid.image_h
+    extra_w = grid.coverage_w - grid.image_w
+    tail = ((0, 0),) * (arr.ndim - 3)
+    out = arr
+    # edge-extend before the reflect ring (tile_ops.pad_image_for_grid
+    # ordering) so the overhang replicates the true plane edge
+    if extra_h or extra_w:
+        out = jnp.pad(
+            out, ((0, 0), (0, extra_h), (0, extra_w)) + tail, mode="edge"
+        )
+    return jnp.pad(out, ((0, 0), (p, p), (p, p)) + tail, mode="reflect")
 
 
 def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
@@ -148,16 +170,14 @@ def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
                 (hint.shape[0], grid.image_h, grid.image_w, hint.shape[3]),
                 method="linear",
             )
-        c.control_hint = jnp.pad(
-            hint, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
-        )
+        c.control_hint = _pad_plane_for_grid(hint, grid)
     if c.mask is not None:
         mask = c.mask
         if mask.shape[1] != grid.image_h or mask.shape[2] != grid.image_w:
             mask = jax.image.resize(
                 mask, (mask.shape[0], grid.image_h, grid.image_w), method="linear"
             )
-        c.mask = jnp.pad(mask, ((0, 0), (p, p), (p, p)), mode="reflect")
+        c.mask = _pad_plane_for_grid(mask, grid)
     if c.model_patches is not None:
         patched = {}
         for name, patch in c.model_patches.items():
@@ -167,17 +187,15 @@ def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
                     (patch.shape[0], grid.image_h, grid.image_w, patch.shape[3]),
                     method="linear",
                 )
-            patched[name] = jnp.pad(
-                patch, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
-            )
+            patched[name] = _pad_plane_for_grid(patch, grid)
         c.model_patches = patched
     if c.reference_latents is not None:
         # resize to the padded-canvas latent grid so per-tile latent
         # windows slice at origin//8 (padding is a multiple of 8 in
         # the supported configs)
         k = 8
-        lat_h = (grid.image_h + 2 * p) // k
-        lat_w = (grid.image_w + 2 * p) // k
+        lat_h = (grid.coverage_h + 2 * p) // k
+        lat_w = (grid.coverage_w + 2 * p) // k
         c.reference_latents = [
             jax.image.resize(
                 lat, (lat.shape[0], lat_h, lat_w, lat.shape[3]), method="linear"
@@ -381,12 +399,13 @@ def run_upscale(
     tile_h: int | None = None,
     mask_blur: int = 0,
     tiled_decode: bool = False,
+    uniform: bool = True,
 ) -> jax.Array:
     """Full upscale: resize then tile-rediffuse. Routes to the mesh
     path when a multi-participant mesh is available."""
     upscaled, grid, _ = prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
-        mask_blur=mask_blur,
+        mask_blur=mask_blur, uniform=uniform,
     )
     key = jax.random.key(seed)
     if mesh is not None and data_axis_size(mesh) > 1:

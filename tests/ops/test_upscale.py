@@ -118,19 +118,82 @@ def test_ddim_matches_euler_exactly():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_force_uniform_tiles_false_rejected():
-    import pytest
+def test_nonuniform_grid_seam_positions_and_coverage():
+    """force_uniform_tiles=False parity: origins stay on the plain ceil
+    grid (the reference's non-uniform seam positions,
+    upscale/tile_ops.py:73-78) and the coverage extends past the image
+    for the overhanging edge tiles."""
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
 
-    from comfyui_distributed_tpu.graph.nodes_upscale import (
-        UltimateSDUpscaleDistributed,
+    grid = tile_ops.calculate_tiles(96, 160, 64, 64, 16, uniform=False)
+    assert grid.positions == (
+        (0, 0), (0, 64), (0, 128), (64, 0), (64, 64), (64, 128),
+    )
+    assert (grid.coverage_h, grid.coverage_w) == (128, 192)
+    # the uniform twin clamps instead
+    uni = tile_ops.calculate_tiles(96, 160, 64, 64, 16)
+    assert uni.positions[-1] == (32, 96)
+    assert (uni.coverage_h, uni.coverage_w) == (96, 160)
+
+
+def test_nonuniform_overhang_replicates_true_edge():
+    """The coverage overhang must copy the image's real edge row/col
+    (edge-extend BEFORE the reflect ring), not a reflected interior
+    pixel — the overhang feeds the edge tile's diffusion context."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+
+    h, w, p = 80, 80, 16
+    grid = tile_ops.calculate_tiles(h, w, 64, 64, p, uniform=False)
+    rng = np.random.default_rng(9)
+    img = jnp.asarray(rng.uniform(size=(1, h, w, 3)), jnp.float32)
+    padded = np.asarray(tile_ops.pad_image_for_grid(img, grid))
+    # rows p+h .. p+coverage_h must all equal the last image row
+    strip = padded[:, p + h : p + grid.coverage_h, p : p + w, :]
+    np.testing.assert_array_equal(
+        strip, np.broadcast_to(np.asarray(img)[:, -1:, :, :], strip.shape)
     )
 
-    node = UltimateSDUpscaleDistributed()
-    with pytest.raises(ValueError, match="force_uniform_tiles"):
-        node.run(
-            image=None, model=None, positive=None, negative=None, vae=None,
-            force_uniform_tiles=False,
-        )
+
+def test_nonuniform_extract_blend_roundtrip():
+    """Extract → blend identity on a gradient image with a non-uniform
+    grid: the overhang strip is cropped and the image reconstructs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+
+    h, w = 80, 112  # not multiples of 64 → real overhang
+    grid = tile_ops.calculate_tiles(h, w, 64, 64, 16, uniform=False)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    img = jnp.asarray(
+        np.stack([yy / h, xx / w, (yy + xx) / (h + w)], -1), jnp.float32
+    )[None]
+    tiles = tile_ops.extract_tiles(img, grid)
+    out = tile_ops.blend_tiles(tiles, grid)
+    assert out.shape == img.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-5)
+
+
+def test_nonuniform_incremental_canvas_matches_batch_blend():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+
+    h, w = 80, 112
+    grid = tile_ops.calculate_tiles(h, w, 64, 64, 16, uniform=False)
+    rng = np.random.default_rng(5)
+    img = jnp.asarray(rng.uniform(size=(1, h, w, 3)), jnp.float32)
+    tiles = tile_ops.extract_tiles(img, grid)
+    inc = tile_ops.IncrementalCanvas(jnp.zeros_like(img), grid)
+    for i, (y, x) in enumerate(grid.positions):
+        inc.blend(tiles[i], y, x)
+    np.testing.assert_allclose(
+        np.asarray(inc.result()), np.asarray(img), atol=1e-4
+    )
 
 
 def test_mask_blur_narrows_feather():

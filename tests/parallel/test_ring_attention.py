@@ -39,8 +39,17 @@ def test_ring_attention_matches_full():
 
 
 def test_context_parallel_dit_matches_single_device():
-    cfg = get_config("tiny-dit")
-    dit = create_model("tiny-dit")
+    """Sharded-vs-single structural equivalence, pinned at f32 (in
+    bf16 the ring's online-softmax accumulation order diverges from
+    the fused attention by bf16 rounding, which is noise, not
+    structure — the WAN head passes real signal so that noise is
+    visible, unlike the old zero-init head)."""
+    import dataclasses
+
+    from comfyui_distributed_tpu.models.dit import VideoDiT
+
+    cfg = dataclasses.replace(get_config("tiny-dit"), dtype="float32")
+    dit = VideoDiT(cfg)
     mesh = build_mesh({"data": 8})
 
     x = jax.random.normal(jax.random.key(1), (1, 8, 4, 4, cfg.in_channels))
@@ -51,7 +60,7 @@ def test_context_parallel_dit_matches_single_device():
     single = dit.apply(params, x, t, ctx)
     sharded = video_forward_context_parallel(cfg, params, x, t, ctx, mesh)
     np.testing.assert_allclose(
-        host_collect(sharded), np.asarray(single), atol=3e-4, rtol=1e-3
+        host_collect(sharded), np.asarray(single), atol=3e-5, rtol=1e-4
     )
 
 
