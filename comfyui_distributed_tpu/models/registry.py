@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from .dit import DiTConfig, VideoDiT
+from .t5_encoder import T5Encoder, T5EncoderConfig
 from .text_encoder import TextEncoder, TextEncoderConfig
 from .unet import UNet, UNetConfig
 from .vae import VAE, VAEConfig
@@ -137,6 +138,22 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             penultimate_hidden=True, proj_dim=96,
         ),
     },
+    # --- T5-class encoders (WAN conditioning; UMT5-XXL dims) ---
+    "umt5-xxl": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            d_model=4096, d_ff=10240, layers=24, heads=64, d_kv=64,
+        ),
+    },
+    # tiny variant: vocab covers the CLIP-BPE fallback id space so the
+    # placeholder tokenizer can't index out of the embedding table
+    "tiny-t5": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            vocab_size=49408, d_model=64, d_ff=128, layers=2, heads=2,
+            d_kv=32, max_length=16,
+        ),
+    },
 }
 
 # Models whose conditioning comes from TWO encoders (SDXL layout):
@@ -151,21 +168,26 @@ _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
     "dit": lambda cfg: VideoDiT(cfg),
     "vae": lambda cfg: VAE(cfg),
     "text_encoder": lambda cfg: TextEncoder(cfg),
+    "t5_encoder": lambda cfg: T5Encoder(cfg),
 }
 
 
-def get_config(name: str) -> Any:
+def model_family(name: str) -> str:
+    return _entry(name)["family"]
+
+
+def _entry(name: str) -> dict[str, Any]:
     if name not in MODEL_REGISTRY:
         raise KeyError(
             f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
         )
-    return MODEL_REGISTRY[name]["config"]
+    return MODEL_REGISTRY[name]
+
+
+def get_config(name: str) -> Any:
+    return _entry(name)["config"]
 
 
 def create_model(name: str) -> Any:
-    entry = MODEL_REGISTRY[name] if name in MODEL_REGISTRY else None
-    if entry is None:
-        raise KeyError(
-            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
-        )
+    entry = _entry(name)
     return _CONSTRUCTORS[entry["family"]](entry["config"])

@@ -348,6 +348,89 @@ def wan_schedule(cfg, prefix: str = "") -> list[Entry]:
     return entries
 
 
+def t5_encoder_schedule(cfg, prefix: str = "") -> list[Entry]:
+    """UMT5 encoder state dict (HF layout: `shared`, `encoder.block.N.
+    layer.{0,1}.*`, per-layer relative_attention_bias) → T5Encoder flax
+    tree (models/t5_encoder.py). The text-encoder checkpoint the
+    reference's WAN workflows load through ComfyUI's CLIPLoader."""
+    p = prefix
+    entries: list[Entry] = [
+        (f"{p}shared", "token_embed", "embedding"),
+    ]
+    for i in range(cfg.layers):
+        sd = f"{p}encoder.block.{i}"
+        fx = f"block_{i}"
+        entries += [
+            (f"{sd}.layer.0.layer_norm", f"{fx}/attn_norm", "rms"),
+            (f"{sd}.layer.0.SelfAttention.q", f"{fx}/q", _LINEAR_NOBIAS),
+            (f"{sd}.layer.0.SelfAttention.k", f"{fx}/k", _LINEAR_NOBIAS),
+            (f"{sd}.layer.0.SelfAttention.v", f"{fx}/v", _LINEAR_NOBIAS),
+            (f"{sd}.layer.0.SelfAttention.o", f"{fx}/o", _LINEAR_NOBIAS),
+            (
+                f"{sd}.layer.0.SelfAttention.relative_attention_bias",
+                f"{fx}/rel_bias",
+                "embedding",
+            ),
+            (f"{sd}.layer.1.layer_norm", f"{fx}/ffn_norm", "rms"),
+            (f"{sd}.layer.1.DenseReluDense.wi_0", f"{fx}/wi_0", _LINEAR_NOBIAS),
+            (f"{sd}.layer.1.DenseReluDense.wi_1", f"{fx}/wi_1", _LINEAR_NOBIAS),
+            (f"{sd}.layer.1.DenseReluDense.wo", f"{fx}/wo", _LINEAR_NOBIAS),
+        ]
+    entries.append((f"{p}encoder.final_layer_norm", "final_norm", "rms"))
+    return entries
+
+
+def _merge_into_template(
+    state_dict: dict[str, np.ndarray],
+    entries: Iterable[Entry],
+    template: Any,
+    part: str,
+) -> tuple[Any, list[str]]:
+    """Convert `state_dict` through `entries` and merge onto the
+    template tree: every template leaf takes the converted value when
+    present with a matching shape, else keeps its init value and a
+    problem line is recorded. The one merge loop all loaders share."""
+    from .io import flatten_params, unflatten_params
+    import jax
+
+    template_flat = flatten_params(jax.device_get(template))
+    converted, missing = convert_state_dict(state_dict, entries)
+    problems = [f"{part}: checkpoint lacks {k}" for k in missing]
+    merged: dict[str, np.ndarray] = {}
+    for key, tval in template_flat.items():
+        cval = converted.get(key)
+        if cval is None:
+            problems.append(f"{part}: schedule lacks {key}")
+            merged[key] = tval
+        elif tuple(cval.shape) != tuple(tval.shape):
+            problems.append(
+                f"{part}: shape mismatch {key}: "
+                f"ckpt {cval.shape} vs model {tval.shape}"
+            )
+            merged[key] = tval
+        else:
+            merged[key] = cval.astype(tval.dtype)
+    return unflatten_params(merged), problems
+
+
+def load_t5_weights(
+    state_dict: dict[str, np.ndarray],
+    te_cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map a UMT5 encoder state dict onto the T5Encoder param tree."""
+    params, problems = _merge_into_template(
+        state_dict, t5_encoder_schedule(te_cfg), template, "t5"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"T5 checkpoint mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return params, problems
+
+
 # --- conversion -----------------------------------------------------------
 
 def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
@@ -530,37 +613,20 @@ def load_wan_weights(
     Returns (params, problems); template leaves the checkpoint lacks
     are kept at init (or raise when strict).
     """
-    from .io import flatten_params, unflatten_params
-    import jax
-
     prefix = (
         "model.diffusion_model."
         if any(k.startswith("model.diffusion_model.blocks.") for k in state_dict)
         else ""
     )
-    entries = wan_schedule(dit_cfg, prefix=prefix)
-    template_flat = flatten_params(jax.device_get(template))
-    converted, missing = convert_state_dict(state_dict, entries)
-    problems = [f"dit: checkpoint lacks {k}" for k in missing]
-    merged: dict[str, np.ndarray] = {}
-    for key, tval in template_flat.items():
-        cval = converted.get(key)
-        if cval is None:
-            problems.append(f"dit: schedule lacks {key}")
-            merged[key] = tval
-        elif tuple(cval.shape) != tuple(tval.shape):
-            problems.append(
-                f"dit: shape mismatch {key}: ckpt {cval.shape} vs model {tval.shape}"
-            )
-            merged[key] = tval
-        else:
-            merged[key] = cval.astype(tval.dtype)
+    params, problems = _merge_into_template(
+        state_dict, wan_schedule(dit_cfg, prefix=prefix), template, "dit"
+    )
     if problems and strict:
         raise ValueError(
             f"WAN checkpoint mapping failed ({len(problems)} problems): "
             + "; ".join(problems[:12])
         )
-    return unflatten_params(merged), problems
+    return params, problems
 
 
 def load_sd_weights(
@@ -578,9 +644,6 @@ def load_sd_weights(
     be covered by the checkpoint with a matching shape (strict) or is
     kept at its init value (non-strict). Returns (trees, problems).
     """
-    from .io import flatten_params, unflatten_params
-    import jax
-
     sdxl_layout = any(k.startswith("conditioner.embedders.") for k in state_dict)
     te_prefix = (
         "conditioner.embedders.0.transformer.text_model"
@@ -597,24 +660,10 @@ def load_sd_weights(
     result: dict[str, Any] = {}
     problems: list[str] = []
     for part, entries in schedules.items():
-        template_flat = flatten_params(jax.device_get(templates[part]))
-        converted, missing = convert_state_dict(state_dict, entries)
-        problems += [f"{part}: checkpoint lacks {k}" for k in missing]
-        merged: dict[str, np.ndarray] = {}
-        for key, tval in template_flat.items():
-            cval = converted.get(key)
-            if cval is None:
-                problems.append(f"{part}: schedule lacks {key}")
-                merged[key] = tval
-            elif tuple(cval.shape) != tuple(tval.shape):
-                problems.append(
-                    f"{part}: shape mismatch {key}: "
-                    f"ckpt {cval.shape} vs model {tval.shape}"
-                )
-                merged[key] = tval
-            else:
-                merged[key] = cval.astype(tval.dtype)
-        result[part] = unflatten_params(merged)
+        result[part], part_problems = _merge_into_template(
+            state_dict, entries, templates[part], part
+        )
+        problems += part_problems
     if problems and strict:
         raise ValueError(
             f"checkpoint mapping failed ({len(problems)} problems): "

@@ -1,0 +1,199 @@
+"""UMT5-class text encoder (WAN's conditioning model), flax.linen.
+
+The reference's WAN workflows condition on UMT5-XXL embeddings via
+ComfyUI's CLIPLoader (reference workflows/distributed-wan*.json load a
+umt5 text-encoder checkpoint). This is the architecture-faithful
+encoder half: relative-position-bias attention (per-layer bias, the
+UMT5 variant), RMS pre-norms, gated-GELU feed-forward, no biases
+anywhere, and T5's unscaled attention logits. Real `encoder.block.N.*`
+state dicts map onto this tree via sd_checkpoint.t5_encoder_schedule.
+
+Tokenization: UMT5 uses a SentencePiece vocab, which is a separate
+asset. When `CDT_T5_SPM` points at a real spm model (loaded through
+transformers' T5 tokenizer), prompts tokenize faithfully; without it
+the pipeline falls back to the committed CLIP BPE ids — deterministic
+across hosts (what the distributed tier needs) but only meaningful
+with random-init weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class T5EncoderConfig:
+    vocab_size: int = 256384  # umt5 sentencepiece vocab
+    d_model: int = 4096
+    d_kv: int = 64
+    d_ff: int = 10240
+    layers: int = 24
+    heads: int = 64
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    max_length: int = 512
+    pad_id: int = 0
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def relative_position_buckets(
+    length: int, num_buckets: int = 32, max_distance: int = 128
+) -> np.ndarray:
+    """[L, L] int32 bidirectional T5 bucket table (query rows, key
+    cols), computed trace-time in numpy — static for a fixed length."""
+    ctx = np.arange(length)[:, None]
+    mem = np.arange(length)[None, :]
+    rel = mem - ctx  # key pos - query pos
+    half = num_buckets // 2
+    out = np.where(rel > 0, half, 0).astype(np.int64)
+    rp = np.abs(rel)
+    max_exact = half // 2
+    is_small = rp < max_exact
+    # log-spaced buckets out to max_distance
+    with np.errstate(divide="ignore"):
+        large = max_exact + (
+            np.log(np.maximum(rp, 1) / max_exact)
+            / np.log(max_distance / max_exact)
+            * (half - max_exact)
+        ).astype(np.int64)
+    large = np.minimum(large, half - 1)
+    out += np.where(is_small, rp, large)
+    return out.astype(np.int32)
+
+
+class _T5Block(nn.Module):
+    config: T5EncoderConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, buckets: jax.Array, key_mask: jax.Array
+    ) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b, n, _ = x.shape
+        inner = cfg.heads * cfg.d_kv
+
+        # --- self-attention (pre-RMS, unscaled logits, per-layer
+        # relative position bias: the UMT5 distinction) ---
+        h = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="attn_norm")(
+            x.astype(jnp.float32)
+        ).astype(dt)
+        q = nn.Dense(inner, use_bias=False, dtype=dt, name="q")(h)
+        k = nn.Dense(inner, use_bias=False, dtype=dt, name="k")(h)
+        v = nn.Dense(inner, use_bias=False, dtype=dt, name="v")(h)
+        q = q.reshape(b, n, cfg.heads, cfg.d_kv)
+        k = k.reshape(b, n, cfg.heads, cfg.d_kv)
+        v = v.reshape(b, n, cfg.heads, cfg.d_kv)
+        rel_bias = nn.Embed(
+            cfg.rel_buckets, cfg.heads, dtype=jnp.float32, name="rel_bias"
+        )(buckets)  # [N, N, H]
+        scores = jnp.einsum(
+            "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
+        )  # T5: no 1/sqrt(d) scaling (folded into init)
+        scores = scores + rel_bias.transpose(2, 0, 1)[None]
+        scores = jnp.where(key_mask[:, None, None, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhnm,bmhd->bnhd", probs, v.astype(jnp.float32))
+        x = x + nn.Dense(
+            cfg.d_model, use_bias=False, dtype=dt, name="o"
+        )(attn.reshape(b, n, inner).astype(dt))
+
+        # --- gated-GELU feed-forward ---
+        h = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="ffn_norm")(
+            x.astype(jnp.float32)
+        ).astype(dt)
+        gate = nn.gelu(
+            nn.Dense(cfg.d_ff, use_bias=False, dtype=dt, name="wi_0")(h),
+            approximate=True,
+        )
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=dt, name="wi_1")(h)
+        return x + nn.Dense(
+            cfg.d_model, use_bias=False, dtype=dt, name="wo"
+        )(gate * up)
+
+
+class T5Encoder(nn.Module):
+    """Returns (hidden [B, N, d_model], pooled [B, d_model]) — pooled is
+    the mask-weighted mean, the usual T5 sentence embedding; WAN uses
+    the hidden states only."""
+
+    config: T5EncoderConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        b, n = tokens.shape
+        key_mask = tokens != cfg.pad_id
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
+            name="token_embed",
+        )(tokens)
+        buckets = jnp.asarray(
+            relative_position_buckets(
+                n, cfg.rel_buckets, cfg.rel_max_distance
+            )
+        )
+        for i in range(cfg.layers):
+            x = _T5Block(cfg, name=f"block_{i}")(x, buckets, key_mask)
+        hidden = nn.RMSNorm(
+            epsilon=1e-6, dtype=jnp.float32, name="final_norm"
+        )(x.astype(jnp.float32))
+        denom = jnp.maximum(key_mask.sum(axis=1, keepdims=True), 1)
+        pooled = (hidden * key_mask[:, :, None]).sum(axis=1) / denom
+        return hidden, pooled
+
+
+class T5Tokenizer:
+    """SentencePiece-faithful when a real spm asset is available
+    (`CDT_T5_SPM` or `spm_path`); otherwise falls back to the committed
+    CLIP BPE (deterministic ids, placeholder semantics — see module
+    doc). Output is fixed-length, 0-padded (T5 pad id), with T5's
+    closing </s> (id 1) when the spm path is active."""
+
+    EOS = 1
+
+    def __init__(self, max_length: int = 512, spm_path: Optional[str] = None):
+        self.max_length = max_length
+        self._spm = None
+        path = spm_path or os.environ.get("CDT_T5_SPM")
+        if path:
+            if not os.path.exists(path):
+                # an explicitly configured vocab must not silently
+                # degrade to placeholder ids — garbage conditioning
+                # with real weights is worse than a loud failure
+                raise FileNotFoundError(
+                    f"T5 sentencepiece vocab not found: {path!r} "
+                    "(CDT_T5_SPM / spm_path)"
+                )
+            from transformers import T5TokenizerFast
+
+            self._spm = T5TokenizerFast(vocab_file=path)
+
+    def encode(self, text: str) -> np.ndarray:
+        out = np.zeros((self.max_length,), dtype=np.int32)
+        if self._spm is not None:
+            ids = self._spm.encode(text)
+            if len(ids) > self.max_length:
+                # keep the terminal </s> under truncation (T5 contract)
+                ids = ids[: self.max_length - 1] + [self.EOS]
+        else:
+            from .clip_bpe import get_bpe
+
+            body = get_bpe(None).encode_text(text)[: self.max_length - 1]
+            ids = body + [self.EOS]
+        out[: len(ids)] = ids
+        return out
+
+    def encode_batch(self, texts: list[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts], axis=0)

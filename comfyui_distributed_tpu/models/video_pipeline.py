@@ -27,7 +27,8 @@ from ..ops import samplers as smp
 from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..parallel.seeds import participant_keys
 from .pipeline import _Static
-from .registry import create_model, get_config
+from .registry import create_model, get_config, model_family
+from .t5_encoder import T5Tokenizer
 from .text_encoder import Tokenizer
 
 
@@ -56,9 +57,10 @@ def load_video_pipeline(
     `CDT_CHECKPOINT_DIR/<model_name>.{safetensors,ckpt,gguf}`). WAN 2.x
     DiT state dicts — original `blocks.N.*` layout or ComfyUI-repacked
     `model.diffusion_model.*` — map key-by-key into the VideoDiT tree
-    (sd_checkpoint.wan_schedule). The VAE/text-encoder stay init-seeded
-    (WAN's causal-3D VAE and UMT5 are separate checkpoint files; slot
-    them in via models/io.py when present)."""
+    (sd_checkpoint.wan_schedule). A T5-family encoder (te_name=
+    "umt5-xxl") likewise loads its own checkpoint file when one
+    resolves by encoder name; the VAE stays init-seeded (WAN's
+    causal-3D VAE is a separate asset — slot in via models/io.py)."""
     tiny = model_name.startswith("tiny")
     vae_name = vae_name or ("tiny-vae-video" if tiny else "vae-video")
     te_name = te_name or ("tiny-te" if tiny else "clip-l")
@@ -90,13 +92,28 @@ def load_video_pipeline(
             state_dict, dit_cfg, dit_params
         )
 
+    # T5-family encoder: its own checkpoint file (the reference loads
+    # umt5 separately through CLIPLoader) resolves by encoder name
+    if model_family(te_name) == "t5_encoder":
+        te_ckpt = sdc.find_checkpoint(te_name)
+        if te_ckpt:
+            from ..utils.logging import log
+
+            log(f"loading T5 encoder checkpoint {te_ckpt} for {te_name}")
+            te_params, _ = sdc.load_t5_weights(
+                sdc.read_checkpoint(te_ckpt), te_cfg, te_params
+            )
+        tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
+    else:
+        tokenizer = Tokenizer(max_length=te_cfg.max_length)
+
     return VideoPipelineBundle(
         model_name=model_name,
         dit=dit,
         vae=vae,
         text_encoder=te,
         params={"unet": dit_params, "vae": vae_params, "te": te_params},
-        tokenizer=Tokenizer(max_length=te_cfg.max_length),
+        tokenizer=tokenizer,
         latent_channels=dit_cfg.in_channels,
         latent_scale=vae_cfg.downscale,
     )

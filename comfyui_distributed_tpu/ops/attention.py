@@ -19,9 +19,12 @@ import jax
 import jax.numpy as jnp
 
 # Flash kernel tiling. Block sizes keep the (Bq x D) @ (D x Bk) matmuls on
-# MXU-friendly 128 boundaries.
-BLOCK_Q = 128
-BLOCK_K = 128
+# MXU-friendly 128 boundaries. Env-tunable (CDT_FLASH_BQ / CDT_FLASH_BK)
+# so the block sweep can re-run on real hardware without edits.
+import os as _os
+
+BLOCK_Q = int(_os.environ.get("CDT_FLASH_BQ", 128))
+BLOCK_K = int(_os.environ.get("CDT_FLASH_BK", 128))
 
 
 def _on_tpu() -> bool:
@@ -80,14 +83,26 @@ def flash_attention(
 ) -> jax.Array:
     """Tiled online-softmax attention (Pallas).
 
-    Grid: (batch*heads, N/BLOCK_Q); each program streams K/V blocks,
-    maintaining running max/denominator so the full [N, M] score matrix
-    never materialises in VMEM.
+    Grid: (batch*heads, N/BLOCK_Q, M/BLOCK_K) with K/V STREAMED one
+    (BLOCK_K, D) block per grid step — VMEM holds one K and one V block
+    at a time regardless of sequence length (long-video sequences
+    would blow VMEM if the whole K/V were block-resident). The online
+    max/denominator/accumulator live in VMEM scratch carried across
+    the innermost (sequential, "arbitrary") grid dimension; the output
+    block is written on the last K step.
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, n, h, d = q.shape
     m = k.shape[1]
+    if n % BLOCK_Q != 0 or m % BLOCK_K != 0:
+        # fail loudly: a zero-length inner grid would silently return
+        # an UNWRITTEN output buffer (the finalize step never fires)
+        raise ValueError(
+            f"flash_attention needs N%{BLOCK_Q}==0 and M%{BLOCK_K}==0, "
+            f"got N={n}, M={m}; route via dot_product_attention instead"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
@@ -98,45 +113,53 @@ def flash_attention(
 
     num_k_blocks = m // BLOCK_K
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
-        qb = q_ref[0].astype(jnp.float32) * scale  # [BLOCK_Q, D]
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref):
+        ki = pl.program_id(2)
 
-        def body(i, carry):
-            acc, row_max, row_sum = carry
-            kb = jax.lax.dynamic_slice(
-                k_ref[0], (i * BLOCK_K, 0), (BLOCK_K, d)
-            ).astype(jnp.float32)
-            vb = jax.lax.dynamic_slice(
-                v_ref[0], (i * BLOCK_K, 0), (BLOCK_K, d)
-            ).astype(jnp.float32)
-            scores = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
-            new_max = jnp.maximum(row_max, scores.max(axis=-1, keepdims=True))
-            correction = jnp.exp(row_max - new_max)
-            p = jnp.exp(scores - new_max)
-            acc = acc * correction + jnp.dot(
-                p, vb, preferred_element_type=jnp.float32
-            )
-            row_sum = row_sum * correction + p.sum(axis=-1, keepdims=True)
-            return acc, new_max, row_sum
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            max_ref[...] = jnp.full_like(max_ref, -jnp.inf)
+            sum_ref[...] = jnp.zeros_like(sum_ref)
 
-        acc = jnp.zeros((BLOCK_Q, d), jnp.float32)
-        row_max = jnp.full((BLOCK_Q, 1), -jnp.inf, jnp.float32)
-        row_sum = jnp.zeros((BLOCK_Q, 1), jnp.float32)
-        acc, row_max, row_sum = jax.lax.fori_loop(
-            0, num_k_blocks, body, (acc, row_max, row_sum)
+        qb = q_ref[0].astype(jnp.float32) * scale   # [BLOCK_Q, D]
+        kb = k_ref[0].astype(jnp.float32)           # [BLOCK_K, D]
+        vb = v_ref[0].astype(jnp.float32)
+        scores = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+        row_max = max_ref[...]
+        new_max = jnp.maximum(row_max, scores.max(axis=-1, keepdims=True))
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max)
+        acc_ref[...] = acc_ref[...] * correction + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32
         )
-        o_ref[0] = (acc / row_sum).astype(o_ref.dtype)
+        sum_ref[...] = sum_ref[...] * correction + p.sum(
+            axis=-1, keepdims=True
+        )
+        max_ref[...] = new_max
+
+        @pl.when(ki == num_k_blocks - 1)
+        def _finalize():
+            o_ref[0] = (acc_ref[...] / sum_ref[...]).astype(o_ref.dtype)
 
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, n // BLOCK_Q),
+        grid=(b * h, n // BLOCK_Q, num_k_blocks),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, m, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, m, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, n, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, d), jnp.float32),  # acc
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running max
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
 

@@ -1,0 +1,128 @@
+"""UMT5-class encoder: bucket-table semantics, forward invariances,
+checkpoint schedule round-trip + real-key pins (same strategy as
+test_sd_checkpoint.py / test_wan_checkpoint.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import create_model, get_config
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+from comfyui_distributed_tpu.models.t5_encoder import (
+    T5Tokenizer,
+    relative_position_buckets,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_bucket_table_pins_t5_semantics():
+    """Exact values from the T5 bidirectional bucket formula
+    (num_buckets=32 → half=16, max_exact=8, log-spaced to 128)."""
+    t = relative_position_buckets(256, 32, 128)
+    assert t[0, 0] == 0                    # rel 0
+    assert t[1, 0] == 1                    # key 1 before query → rp 1
+    assert t[0, 1] == 17                   # key 1 after query → 16 + 1
+    assert t[100, 0] == 15                 # rp 100 (behind): log bucket
+    assert t[0, 100] == 31                 # rp 100 (ahead)
+    assert t[0, 255] == 31                 # clamped at max
+    assert t.max() == 31 and t.min() == 0
+
+
+def test_forward_shapes_and_mask_invariance():
+    """Pad tokens (id 0) must not influence non-pad positions: the same
+    prompt with extra trailing padding produces identical hidden states
+    at the shared positions."""
+    model = create_model("tiny-t5")
+    cfg = get_config("tiny-t5")
+    short = np.zeros((1, 8), np.int32)
+    short[0, :3] = [5, 7, 1]
+    long = np.zeros((1, cfg.max_length), np.int32)
+    long[0, :3] = [5, 7, 1]
+
+    params = model.init(jax.random.key(0), jnp.asarray(long))
+    h_long, pooled = model.apply(params, jnp.asarray(long))
+    h_short, _ = model.apply(params, jnp.asarray(short))
+    assert h_long.shape == (1, cfg.max_length, cfg.d_model)
+    assert pooled.shape == (1, cfg.d_model)
+    np.testing.assert_allclose(
+        np.asarray(h_short[0, :3]), np.asarray(h_long[0, :3]),
+        atol=2e-2,  # bf16 compute
+    )
+
+
+def test_t5_schedule_roundtrip_exact():
+    model = create_model("tiny-t5")
+    cfg = get_config("tiny-t5")
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, cfg.max_length), jnp.int32)
+    )
+    flat = flatten_params(jax.device_get(params))
+    entries = sdc.t5_encoder_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, entries)
+    converted, missing = sdc.convert_state_dict(state_dict, entries)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+    out, problems = sdc.load_t5_weights(state_dict, cfg, params)
+    assert problems == []
+    got = flatten_params(out)
+    np.testing.assert_array_equal(
+        got["params/block_0/q/kernel"], flat["params/block_0/q/kernel"]
+    )
+    with pytest.raises(ValueError, match="T5 checkpoint mapping failed"):
+        sdc.load_t5_weights({}, cfg, params)
+
+
+# Genuine key names from the public UMT5 encoder (HF) layout.
+UMT5_KNOWN_KEYS = [
+    "shared.weight",
+    "encoder.block.0.layer.0.SelfAttention.q.weight",
+    "encoder.block.0.layer.0.SelfAttention.o.weight",
+    "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+    "encoder.block.0.layer.0.layer_norm.weight",
+    "encoder.block.0.layer.1.DenseReluDense.wi_0.weight",
+    "encoder.block.0.layer.1.DenseReluDense.wi_1.weight",
+    "encoder.block.0.layer.1.DenseReluDense.wo.weight",
+    "encoder.block.23.layer.1.layer_norm.weight",
+    "encoder.final_layer_norm.weight",
+]
+
+
+def test_umt5_schedule_covers_real_key_names():
+    cfg = get_config("umt5-xxl")
+    keys = {k for k, _f, _h in sdc._expand(sdc.t5_encoder_schedule(cfg))}
+    missing = [k for k in UMT5_KNOWN_KEYS if k not in keys]
+    assert not missing, missing
+    # 10 tensors per block x 24 blocks + shared + final norm
+    assert len(keys) == 10 * 24 + 2, len(keys)
+
+
+def test_t5_tokenizer_fallback_deterministic():
+    tok = T5Tokenizer(max_length=16)
+    a = tok.encode("a photo of a cat")
+    b = tok.encode("a photo of a cat")
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (16,)
+    assert a.dtype == np.int32
+    assert (a[a != 0] > 0).all()
+
+
+def test_video_pipeline_with_t5_encoder():
+    from comfyui_distributed_tpu.models.video_pipeline import (
+        encode_video_text,
+        load_video_pipeline,
+    )
+
+    bundle = load_video_pipeline("tiny-dit", te_name="tiny-t5")
+    ctx = encode_video_text(bundle, ["a red cube"])
+    cfg = get_config("tiny-dit")
+    assert ctx.shape[0] == 1 and ctx.shape[-1] == cfg.context_dim
+    assert np.isfinite(np.asarray(ctx)).all()
