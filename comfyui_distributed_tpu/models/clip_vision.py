@@ -1,0 +1,145 @@
+"""CLIP ViT image encoder (WAN i2v's image-conditioning model).
+
+The reference's WAN i2v workflow feeds the first frame through
+ComfyUI's CLIPVisionLoader/CLIPVisionEncode (reference
+workflows/distributed-wan i2v variant); WAN conditions on ViT-H/14
+PENULTIMATE hidden states (257 patch+class tokens, width 1280). This
+is that tower, HF CLIPVisionModel layout-faithful so real
+clip-vision checkpoints map key-by-key
+(sd_checkpoint.clip_vision_schedule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# CLIP preprocessing constants (OpenAI/open_clip convention)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    width: int = 1280
+    layers: int = 32
+    heads: int = 16
+    mlp_ratio: float = 4.0
+    dtype: str = "bfloat16"
+    # WAN consumes the penultimate layer's hidden states (skip the last
+    # block, no post LN); False returns the post-LN final hidden states
+    penultimate_hidden: bool = True
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def tokens(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+
+class _ViTBlock(nn.Module):
+    heads: int
+    mlp_dim: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from ..ops.attention import dot_product_attention
+
+        b, n, width = x.shape
+        head_dim = width // self.heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="LayerNorm_0")(
+            x.astype(jnp.float32)
+        ).astype(self.dtype)
+        q = nn.Dense(width, dtype=self.dtype, name="q")(h)
+        k = nn.Dense(width, dtype=self.dtype, name="k")(h)
+        v = nn.Dense(width, dtype=self.dtype, name="v")(h)
+        attn = dot_product_attention(
+            q.reshape(b, n, self.heads, head_dim),
+            k.reshape(b, n, self.heads, head_dim),
+            v.reshape(b, n, self.heads, head_dim),
+        ).reshape(b, n, width)
+        x = x + nn.Dense(width, dtype=self.dtype, name="proj")(attn)
+        h = nn.LayerNorm(dtype=jnp.float32, name="LayerNorm_1")(
+            x.astype(jnp.float32)
+        ).astype(self.dtype)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h, approximate=False)
+        return x + nn.Dense(width, dtype=self.dtype, name="fc2")(h)
+
+
+class ClipVisionEncoder(nn.Module):
+    """[B, H, W, 3] image in [0, 1] → [B, tokens, width] hidden states
+    (class token first, HF ordering)."""
+
+    config: ClipVisionConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b = images.shape[0]
+        if images.shape[1] != cfg.image_size or images.shape[2] != cfg.image_size:
+            images = jax.image.resize(
+                images,
+                (b, cfg.image_size, cfg.image_size, images.shape[3]),
+                method="cubic",
+            )
+        mean = jnp.asarray(CLIP_MEAN, images.dtype)
+        std = jnp.asarray(CLIP_STD, images.dtype)
+        x = (images - mean) / std
+
+        patches = nn.Conv(
+            cfg.width,
+            (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            use_bias=False,
+            dtype=dt,
+            name="patch_embedding",
+        )(x.astype(dt))
+        patches = patches.reshape(b, -1, cfg.width)
+
+        cls = self.param(
+            "class_embedding", nn.initializers.normal(0.02), (cfg.width,),
+            jnp.float32,
+        )
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(dt), (b, 1, cfg.width)), patches],
+            axis=1,
+        )
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.tokens, cfg.width),
+            jnp.float32,
+        )
+        tokens = tokens + pos.astype(dt)[None]
+        tokens = nn.LayerNorm(dtype=jnp.float32, name="pre_ln")(
+            tokens.astype(jnp.float32)
+        ).astype(dt)
+
+        mlp_dim = int(cfg.width * cfg.mlp_ratio)
+        depth = cfg.layers - 1 if cfg.penultimate_hidden else cfg.layers
+        for i in range(depth):
+            tokens = _ViTBlock(
+                cfg.heads, mlp_dim, dt, name=f"block_{i}"
+            )(tokens)
+        if cfg.penultimate_hidden:
+            # WAN consumes the raw penultimate hidden states — no
+            # final block, no post LN
+            return tokens
+        # run the last block + post LN (standard CLIP pooled path)
+        tokens = _ViTBlock(
+            cfg.heads, mlp_dim, dt, name=f"block_{cfg.layers - 1}"
+        )(tokens)
+        return nn.LayerNorm(dtype=jnp.float32, name="post_ln")(
+            tokens.astype(jnp.float32)
+        ).astype(dt)

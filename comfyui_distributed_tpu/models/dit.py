@@ -46,6 +46,12 @@ class DiTConfig:
     heads: int = 12
     context_dim: int = 4096
     freq_dim: int = 256  # sinusoidal timestep embedding width (WAN: 256)
+    # WAN i2v: image cross-attention branch (k_img/v_img) over CLIP
+    # ViT-H penultimate tokens projected through img_emb; the latent
+    # input carries [noise 16 | mask 4 | conditioning latent 16] = 36
+    # channels (set in_channels accordingly in i2v configs)
+    i2v: bool = False
+    img_dim: int = 1280  # CLIP ViT-H width
     dtype: str = "bfloat16"
     # Context/sequence parallelism: when set, the model is being called
     # inside shard_map with the FRAME axis sharded along this mesh axis;
@@ -128,10 +134,16 @@ class _WanBlock(nn.Module):
     ffn_width: int
     dtype: jnp.dtype
     seq_axis: str | None = None
+    i2v: bool = False
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, e6: jax.Array, context: jax.Array, freqs: jax.Array
+        self,
+        x: jax.Array,
+        e6: jax.Array,
+        context: jax.Array,
+        freqs: jax.Array,
+        context_img: jax.Array | None = None,
     ) -> jax.Array:
         dim = x.shape[-1]
         head_dim = dim // self.heads
@@ -184,6 +196,22 @@ class _WanBlock(nn.Module):
         kc = kc.astype(self.dtype).reshape(b, m, self.heads, head_dim)
         vc = vc.reshape(b, m, self.heads, head_dim)
         xattn = dot_product_attention(qc, kc, vc).reshape(b, n, dim)
+        if self.i2v and context_img is not None:
+            # WAN i2v: a second K/V pair over image tokens, summed with
+            # the text attention before the output projection
+            mi = context_img.shape[1]
+            ki = nn.Dense(dim, dtype=self.dtype, name="cross_attn_k_img")(
+                context_img
+            )
+            vi = nn.Dense(dim, dtype=self.dtype, name="cross_attn_v_img")(
+                context_img
+            )
+            ki = nn.RMSNorm(
+                epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_k_img"
+            )(ki)
+            ki = ki.astype(self.dtype).reshape(b, mi, self.heads, head_dim)
+            vi = vi.reshape(b, mi, self.heads, head_dim)
+            xattn = xattn + dot_product_attention(qc, ki, vi).reshape(b, n, dim)
         x = x + nn.Dense(dim, dtype=self.dtype, name="cross_attn_o")(xattn)
 
         # --- feed-forward (modulated) ---
@@ -206,6 +234,7 @@ class VideoDiT(nn.Module):
         x: jax.Array,          # [B, F, H, W, C] noisy video latents
         timesteps: jax.Array,  # [B]
         context: jax.Array,    # [B, T, context_dim]
+        image_embeds: jax.Array | None = None,  # i2v: [B, 257, img_dim]
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -245,6 +274,20 @@ class VideoDiT(nn.Module):
             nn.gelu(context, approximate=True)
         )
 
+        # i2v image tokens: CLIP penultimate states → hidden (WAN
+        # img_emb MLPProj: LN, Linear, GELU, Linear, LN)
+        context_img = None
+        if cfg.i2v and image_embeds is not None:
+            h_img = nn.LayerNorm(dtype=jnp.float32, name="img_emb_norm_in")(
+                image_embeds.astype(jnp.float32)
+            ).astype(dt)
+            h_img = nn.Dense(cfg.img_dim, dtype=dt, name="img_emb_fc1")(h_img)
+            h_img = nn.gelu(h_img, approximate=False)
+            h_img = nn.Dense(cfg.hidden_dim, dtype=dt, name="img_emb_fc2")(h_img)
+            context_img = nn.LayerNorm(
+                dtype=jnp.float32, name="img_emb_norm_out"
+            )(h_img.astype(jnp.float32)).astype(dt)
+
         head_dim = cfg.hidden_dim // cfg.heads
         if cfg.seq_axis is not None:
             # sharded frame axis: local tokens are a contiguous frame
@@ -263,8 +306,8 @@ class VideoDiT(nn.Module):
         for i in range(cfg.depth):
             tokens = _WanBlock(
                 cfg.heads, cfg.ffn_width, dt, seq_axis=cfg.seq_axis,
-                name=f"block_{i}",
-            )(tokens, e6, context, freqs)
+                i2v=cfg.i2v, name=f"block_{i}",
+            )(tokens, e6, context, freqs, context_img)
 
         # modulated output head (WAN head: norm → Linear, with a learned
         # 2-way modulation added to the raw timestep embedding)

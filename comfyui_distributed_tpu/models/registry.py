@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+from .clip_vision import ClipVisionConfig, ClipVisionEncoder
 from .dit import DiTConfig, VideoDiT
 from .t5_encoder import T5Encoder, T5EncoderConfig
 from .text_encoder import TextEncoder, TextEncoderConfig
@@ -87,6 +88,23 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
         "family": "dit",
         "config": DiTConfig(hidden_dim=64, depth=2, heads=2, context_dim=64),
     },
+    # i2v variants: [noise 16 | mask 4 | cond latent 16] = 36 input
+    # channels, 16 output; image cross-attention branch over CLIP
+    # ViT-H penultimate tokens (WAN 2.x i2v checkpoint layout)
+    "wan-14b-i2v": {
+        "family": "dit",
+        "config": DiTConfig(
+            hidden_dim=5120, ffn_dim=13824, depth=40, heads=40,
+            context_dim=4096, in_channels=36, out_channels=16, i2v=True,
+        ),
+    },
+    "tiny-dit-i2v": {
+        "family": "dit",
+        "config": DiTConfig(
+            hidden_dim=64, depth=2, heads=2, context_dim=64,
+            in_channels=36, out_channels=16, i2v=True, img_dim=48,
+        ),
+    },
     # --- VAEs ---
     "vae-sd": {"family": "vae", "config": VAEConfig()},
     # 16-channel latent VAE matching the WAN-class DiT latent space
@@ -154,6 +172,17 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             d_kv=32, max_length=16,
         ),
     },
+    # --- CLIP vision towers (WAN i2v image conditioning; ViT-H/14) ---
+    "clip-vision-h": {
+        "family": "clip_vision",
+        "config": ClipVisionConfig(),
+    },
+    "tiny-clip-vision": {
+        "family": "clip_vision",
+        "config": ClipVisionConfig(
+            image_size=32, patch_size=8, width=48, layers=3, heads=2,
+        ),
+    },
 }
 
 # Models whose conditioning comes from TWO encoders (SDXL layout):
@@ -169,6 +198,7 @@ _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
     "vae": lambda cfg: VAE(cfg),
     "text_encoder": lambda cfg: TextEncoder(cfg),
     "t5_encoder": lambda cfg: T5Encoder(cfg),
+    "clip_vision": lambda cfg: ClipVisionEncoder(cfg),
 }
 
 

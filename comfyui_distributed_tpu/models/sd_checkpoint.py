@@ -335,17 +335,79 @@ def wan_schedule(cfg, prefix: str = "") -> list[Entry]:
                 entries.append((f"{sd}.{attn}.{leaf}", f"{fx}/{attn}_{leaf}", _LINEAR))
             for leaf in ("norm_q", "norm_k"):
                 entries.append((f"{sd}.{attn}.{leaf}", f"{fx}/{attn}_{leaf}", "rms"))
+        if getattr(cfg, "i2v", False):
+            entries += [
+                (f"{sd}.cross_attn.k_img", f"{fx}/cross_attn_k_img", _LINEAR),
+                (f"{sd}.cross_attn.v_img", f"{fx}/cross_attn_v_img", _LINEAR),
+                (f"{sd}.cross_attn.norm_k_img", f"{fx}/cross_attn_norm_k_img", "rms"),
+            ]
         entries += [
             (f"{sd}.norm3", f"{fx}/norm3", _NORM),
             (f"{sd}.ffn.0", f"{fx}/ffn_0", _LINEAR),
             (f"{sd}.ffn.2", f"{fx}/ffn_2", _LINEAR),
             (f"{sd}.modulation", f"{fx}/modulation", "param_bare"),
         ]
+    if getattr(cfg, "i2v", False):
+        entries += [
+            (f"{p}img_emb.proj.0", "img_emb_norm_in", _NORM),
+            (f"{p}img_emb.proj.1", "img_emb_fc1", _LINEAR),
+            (f"{p}img_emb.proj.3", "img_emb_fc2", _LINEAR),
+            (f"{p}img_emb.proj.4", "img_emb_norm_out", _NORM),
+        ]
     entries += [
         (f"{p}head.head", "head", _LINEAR),
         (f"{p}head.modulation", "head_modulation", "param_bare"),
     ]
     return entries
+
+
+def clip_vision_schedule(cfg, prefix: str = "vision_model") -> list[Entry]:
+    """HF CLIPVisionModel state dict → ClipVisionEncoder flax tree
+    (models/clip_vision.py). Penultimate configs skip the last block
+    and post LN (those checkpoint keys are simply unused). Note the
+    genuine HF key spelling `pre_layrnorm`."""
+    p = prefix
+    entries: list[Entry] = [
+        (f"{p}.embeddings.class_embedding", "class_embedding", "param_bare"),
+        (f"{p}.embeddings.patch_embedding", "patch_embedding", "conv_nobias"),
+        (f"{p}.embeddings.position_embedding", "position_embedding", "position"),
+        (f"{p}.pre_layrnorm", "pre_ln", _NORM),
+    ]
+    depth = cfg.layers - 1 if cfg.penultimate_hidden else cfg.layers
+    for i in range(depth):
+        sd, fx = f"{p}.encoder.layers.{i}", f"block_{i}"
+        entries += [
+            (f"{sd}.layer_norm1", f"{fx}/LayerNorm_0", _NORM),
+            (f"{sd}.self_attn.q_proj", f"{fx}/q", _LINEAR),
+            (f"{sd}.self_attn.k_proj", f"{fx}/k", _LINEAR),
+            (f"{sd}.self_attn.v_proj", f"{fx}/v", _LINEAR),
+            (f"{sd}.self_attn.out_proj", f"{fx}/proj", _LINEAR),
+            (f"{sd}.layer_norm2", f"{fx}/LayerNorm_1", _NORM),
+            (f"{sd}.mlp.fc1", f"{fx}/fc1", _LINEAR),
+            (f"{sd}.mlp.fc2", f"{fx}/fc2", _LINEAR),
+        ]
+    if not cfg.penultimate_hidden:
+        entries.append((f"{p}.post_layernorm", "post_ln", _NORM))
+    return entries
+
+
+def load_clip_vision_weights(
+    state_dict: dict[str, np.ndarray],
+    cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map an HF CLIPVisionModel state dict onto the ClipVisionEncoder
+    param tree."""
+    params, problems = _merge_into_template(
+        state_dict, clip_vision_schedule(cfg), template, "clip_vision"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"CLIP-vision checkpoint mapping failed ({len(problems)} "
+            "problems): " + "; ".join(problems[:12])
+        )
+    return params, problems
 
 
 def t5_encoder_schedule(cfg, prefix: str = "") -> list[Entry]:
@@ -443,6 +505,8 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
         elif kind == _CONV:
             out.append((f"{sd}.weight", f"{fx}/kernel", "conv"))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind == "conv_nobias":
+            out.append((f"{sd}.weight", f"{fx}/kernel", "conv"))
         elif kind == _LINEAR:
             out.append((f"{sd}.weight", f"{fx}/kernel", "linear"))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))

@@ -43,6 +43,8 @@ class VideoPipelineBundle:
     latent_channels: int
     latent_scale: int
     flow_shift: float = 3.0
+    # i2v: CLIP vision tower for image conditioning (WAN i2v layout)
+    clip_vision: Any = None
 
 
 def load_video_pipeline(
@@ -61,6 +63,8 @@ def load_video_pipeline(
     "umt5-xxl") likewise loads its own checkpoint file when one
     resolves by encoder name; the VAE stays init-seeded (WAN's
     causal-3D VAE is a separate asset — slot in via models/io.py)."""
+    from . import sd_checkpoint as sdc
+
     tiny = model_name.startswith("tiny")
     vae_name = vae_name or ("tiny-vae-video" if tiny else "vae-video")
     te_name = te_name or ("tiny-te" if tiny else "clip-l")
@@ -76,11 +80,31 @@ def load_video_pipeline(
     k_dit, k_vae, k_te = jax.random.split(root, 3)
     lat = jnp.zeros((1, 4, 8, 8, dit_cfg.in_channels))
     ctx = jnp.zeros((1, te_cfg.max_length, dit_cfg.context_dim))
-    dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx)
+    i2v = getattr(dit_cfg, "i2v", False)
+    clip_vision = None
+    cv_params = None
+    if i2v:
+        cv_name = "tiny-clip-vision" if tiny else "clip-vision-h"
+        clip_vision = create_model(cv_name)
+        cv_cfg = get_config(cv_name)
+        cv_params = clip_vision.init(
+            jax.random.fold_in(k_te, 7),
+            jnp.zeros((1, cv_cfg.image_size, cv_cfg.image_size, 3)),
+        )
+        cv_ckpt = sdc.find_checkpoint(cv_name)
+        if cv_ckpt:
+            from ..utils.logging import log
+
+            log(f"loading CLIP-vision checkpoint {cv_ckpt} for {cv_name}")
+            cv_params, _ = sdc.load_clip_vision_weights(
+                sdc.read_checkpoint(cv_ckpt), cv_cfg, cv_params
+            )
+        embeds = jnp.zeros((1, cv_cfg.tokens, dit_cfg.img_dim))
+        dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx, embeds)
+    else:
+        dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx)
     vae_params = vae.init(k_vae, jnp.zeros((1, 32, 32, 3)))
     te_params = te.init(k_te, jnp.zeros((1, te_cfg.max_length), jnp.int32))
-
-    from . import sd_checkpoint as sdc
 
     ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
     if ckpt_path:
@@ -107,15 +131,19 @@ def load_video_pipeline(
     else:
         tokenizer = Tokenizer(max_length=te_cfg.max_length)
 
+    params = {"unet": dit_params, "vae": vae_params, "te": te_params}
+    if cv_params is not None:
+        params["clip_vision"] = cv_params
     return VideoPipelineBundle(
         model_name=model_name,
         dit=dit,
         vae=vae,
         text_encoder=te,
-        params={"unet": dit_params, "vae": vae_params, "te": te_params},
+        params=params,
         tokenizer=tokenizer,
-        latent_channels=dit_cfg.in_channels,
+        latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
+        clip_vision=clip_vision,
     )
 
 
@@ -285,6 +313,51 @@ def _i2v_jit(
     return decode_frames(bundle, latents)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("bundle_static", "frames", "steps", "cfg_scale"),
+)
+def _i2v_native_jit(
+    bundle_static, params, ref_latent, image_embeds, pos, neg, key,
+    frames: int, steps: int, cfg_scale: float,
+):
+    """WAN-i2v-layout sampling: the model input is
+    [noise 16 | mask 4 | conditioning latent 16] per frame, with image
+    cross-attention over CLIP tokens (models/dit.py i2v branch)."""
+    bundle = bundle_static.value
+    b = ref_latent.shape[0]
+    lh, lw, c = ref_latent.shape[2], ref_latent.shape[3], ref_latent.shape[4]
+    timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
+    noise = jax.random.normal(key, (b, frames, lh, lw, c))
+    # conditioning channels: 4-channel frame mask (1 = given) + cond
+    # latent (frame 0 = reference, rest zero), fixed across steps
+    y = jnp.concatenate(
+        [ref_latent, jnp.zeros((b, frames - 1, lh, lw, c))], axis=1
+    )
+    mask = jnp.zeros((b, frames, lh, lw, 4)).at[:, 0].set(1.0)
+    cond_channels = jnp.concatenate([mask, y], axis=-1)
+
+    def model_fn(x, t_batch, context):
+        # the CFG wrapper doubles the batch (pos|neg); the image
+        # conditioning is identical for both halves
+        reps = x.shape[0] // cond_channels.shape[0]
+        cc = jnp.tile(cond_channels, (reps, 1, 1, 1, 1))
+        emb = jnp.tile(image_embeds, (reps, 1, 1))
+        inp = jnp.concatenate([x, cc], axis=-1)
+        return bundle.dit.apply(
+            params["unet"], inp, t_batch, context, emb
+        ).astype(x.dtype)
+
+    model = smp.cfg_flow_model(model_fn, cfg_scale)
+    latents = smp.sample_flow(model, noise, timesteps, (pos, neg))
+    return decode_frames(bundle, latents)
+
+
+def encode_image_embeds(bundle: VideoPipelineBundle, image: jax.Array) -> jax.Array:
+    """[B, H, W, 3] → CLIP penultimate tokens [B, T, width] (i2v only)."""
+    return bundle.clip_vision.apply(bundle.params["clip_vision"], image)
+
+
 def i2v(
     bundle: VideoPipelineBundle,
     image: jax.Array,            # [B, H, W, 3] first frame
@@ -295,12 +368,24 @@ def i2v(
     cfg_scale: float = 5.0,
     seed: int = 0,
 ) -> jax.Array:
-    """Image-to-video: frame 0 is clamped to the input image's latent
-    along the flow path; returns [B, frames, H, W, 3] (the WAN i2v
-    workflow role, reference workflows/distributed-wan i2v variant)."""
+    """Image-to-video; returns [B, frames, H, W, 3] (the WAN i2v
+    workflow role, reference workflows/distributed-wan i2v variant).
+
+    i2v-layout models (cfg.i2v) run the native WAN conditioning:
+    channel-concat mask + reference latent, plus CLIP-token image
+    cross-attention. Other video models fall back to clamping frame 0
+    to the reference latent along the flow path (masked flow)."""
     ref = encode_frames(bundle, image[:, None])  # [B, 1, h, w, C]
-    pos = encode_video_text(bundle, [prompt])
-    neg = encode_video_text(bundle, [negative_prompt])
+    b = int(image.shape[0])
+    pos = encode_video_text(bundle, [prompt] * b)
+    neg = encode_video_text(bundle, [negative_prompt] * b)
+    cfg = get_config(bundle.model_name)
+    if getattr(cfg, "i2v", False):
+        embeds = encode_image_embeds(bundle, image)
+        return _i2v_native_jit(
+            _Static(bundle), bundle.params, ref, embeds, pos, neg,
+            jax.random.key(seed), frames, steps, float(cfg_scale),
+        )
     return _i2v_jit(
         _Static(bundle), bundle.params, ref, pos, neg,
         jax.random.key(seed), frames, steps, float(cfg_scale),

@@ -1,0 +1,116 @@
+"""CLIP vision tower + WAN i2v branch: forward shapes, schedule
+round-trips, real-key pins, and the native i2v sampling path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import create_model, get_config
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+
+pytestmark = pytest.mark.slow
+
+
+def test_clip_vision_forward_tokens():
+    model = create_model("tiny-clip-vision")
+    cfg = get_config("tiny-clip-vision")
+    img = jnp.asarray(
+        np.random.default_rng(0).uniform(size=(2, cfg.image_size, cfg.image_size, 3)),
+        jnp.float32,
+    )
+    params = model.init(jax.random.key(0), img)
+    out = model.apply(params, img)
+    assert out.shape == (2, cfg.tokens, cfg.width)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # arbitrary input size is resized to the tower's native resolution
+    out2 = model.apply(params, jnp.zeros((1, 64, 48, 3)))
+    assert out2.shape == (1, cfg.tokens, cfg.width)
+
+
+def test_clip_vision_schedule_roundtrip_exact():
+    model = create_model("tiny-clip-vision")
+    cfg = get_config("tiny-clip-vision")
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )
+    flat = flatten_params(jax.device_get(params))
+    entries = sdc.clip_vision_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, entries)
+    converted, missing = sdc.convert_state_dict(state_dict, entries)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+
+# Genuine key names from the public HF CLIPVisionModel layout (note the
+# real `pre_layrnorm` spelling).
+CLIP_VISION_KNOWN_KEYS = [
+    "vision_model.embeddings.class_embedding",
+    "vision_model.embeddings.patch_embedding.weight",
+    "vision_model.embeddings.position_embedding.weight",
+    "vision_model.pre_layrnorm.weight",
+    "vision_model.encoder.layers.0.self_attn.q_proj.weight",
+    "vision_model.encoder.layers.0.self_attn.out_proj.bias",
+    "vision_model.encoder.layers.0.mlp.fc1.weight",
+    "vision_model.encoder.layers.30.layer_norm2.weight",
+]
+
+
+def test_clip_vision_h_schedule_covers_real_key_names():
+    cfg = get_config("clip-vision-h")
+    keys = {k for k, _f, _h in sdc._expand(sdc.clip_vision_schedule(cfg))}
+    missing = [k for k in CLIP_VISION_KNOWN_KEYS if k not in keys]
+    assert not missing, missing
+    # penultimate: the last block (31) and post LN are not in the tree
+    assert not any(".layers.31." in k for k in keys)
+    assert "vision_model.post_layernorm.weight" not in keys
+
+
+def test_wan_i2v_schedule_roundtrip_and_keys():
+    model = create_model("tiny-dit-i2v")
+    cfg = get_config("tiny-dit-i2v")
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, 2, 8, 8, cfg.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 8, cfg.context_dim)),
+        jnp.zeros((1, 17, cfg.img_dim)),
+    )
+    flat = flatten_params(jax.device_get(params))
+    entries = sdc.wan_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, entries)
+    assert "blocks.0.cross_attn.k_img.weight" in state_dict
+    assert "blocks.0.cross_attn.norm_k_img.weight" in state_dict
+    assert "img_emb.proj.0.weight" in state_dict
+    assert "img_emb.proj.3.weight" in state_dict
+    converted, missing = sdc.convert_state_dict(state_dict, entries)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+
+
+def test_i2v_native_path_runs():
+    """End-to-end native i2v: CLIP tokens + channel-concat conditioning
+    through sampling and decode."""
+    from comfyui_distributed_tpu.models.video_pipeline import (
+        i2v,
+        load_video_pipeline,
+    )
+
+    bundle = load_video_pipeline("tiny-dit-i2v")
+    assert bundle.clip_vision is not None
+    assert "clip_vision" in bundle.params
+    img = jnp.asarray(
+        np.random.default_rng(1).uniform(size=(1, 32, 32, 3)), jnp.float32
+    )
+    out = i2v(bundle, img, "a rolling wave", frames=4, steps=2)
+    assert out.shape[:2] == (1, 4)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
