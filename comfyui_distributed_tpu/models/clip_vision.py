@@ -88,11 +88,22 @@ class ClipVisionEncoder(nn.Module):
         dt = cfg.compute_dtype
         b = images.shape[0]
         if images.shape[1] != cfg.image_size or images.shape[2] != cfg.image_size:
-            images = jax.image.resize(
-                images,
-                (b, cfg.image_size, cfg.image_size, images.shape[3]),
-                method="cubic",
+            # reference CLIP preprocessing: scale the SHORT side to the
+            # target then center-crop — aspect-preserving (a straight
+            # resize would anisotropically stretch non-square frames)
+            h, w = images.shape[1], images.shape[2]
+            scale = cfg.image_size / min(h, w)
+            nh, nw = max(cfg.image_size, round(h * scale)), max(
+                cfg.image_size, round(w * scale)
             )
+            images = jax.image.resize(
+                images, (b, nh, nw, images.shape[3]), method="cubic"
+            )
+            top = (nh - cfg.image_size) // 2
+            left = (nw - cfg.image_size) // 2
+            images = images[
+                :, top : top + cfg.image_size, left : left + cfg.image_size, :
+            ]
         mean = jnp.asarray(CLIP_MEAN, images.dtype)
         std = jnp.asarray(CLIP_STD, images.dtype)
         x = (images - mean) / std

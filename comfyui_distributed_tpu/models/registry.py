@@ -20,6 +20,7 @@ from .t5_encoder import T5Encoder, T5EncoderConfig
 from .text_encoder import TextEncoder, TextEncoderConfig
 from .unet import UNet, UNetConfig
 from .vae import VAE, VAEConfig
+from .video_vae import VideoVAE, VideoVAEConfig
 
 MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     # --- UNet diffusion backbones ---
@@ -107,10 +108,36 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     },
     # --- VAEs ---
     "vae-sd": {"family": "vae", "config": VAEConfig()},
-    # 16-channel latent VAE matching the WAN-class DiT latent space
+    # 16-channel latent 2D VAE (per-frame fallback for the WAN-class
+    # DiT latent space; the real WAN VAE is wan-vae below)
     "vae-video": {
         "family": "vae",
         "config": VAEConfig(latent_channels=16, scaling_factor=1.0),
+    },
+    # causal 3D WAN VAE: 8x spatial / 4x temporal, 4n+1 frame contract.
+    # latents_mean/std are the fixed per-channel constants the official
+    # Wan2.1 wrapper normalizes with before the DiT.
+    "wan-vae": {
+        "family": "video_vae",
+        "config": VideoVAEConfig(
+            latents_mean=(
+                -0.7571, -0.7089, -0.9113, 0.1075, -0.1745, 0.9653,
+                -0.1517, 1.5508, 0.4134, -0.0715, 0.5517, -0.3632,
+                -0.1922, -0.9497, 0.2503, -0.2921,
+            ),
+            latents_std=(
+                2.8184, 1.4541, 2.3275, 2.5017, 2.3632, 2.0435,
+                3.3086, 3.0723, 2.0365, 1.9887, 2.6244, 2.0905,
+                2.3852, 1.4049, 2.5648, 2.7630,
+            ),
+        ),
+    },
+    "tiny-video-vae-3d": {
+        "family": "video_vae",
+        "config": VideoVAEConfig(
+            base_dim=16, dim_mult=(1, 2), num_res_blocks=1,
+            temporal_down=(True,),
+        ),
     },
     "tiny-vae": {
         "family": "vae",
@@ -199,6 +226,7 @@ _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
     "text_encoder": lambda cfg: TextEncoder(cfg),
     "t5_encoder": lambda cfg: T5Encoder(cfg),
     "clip_vision": lambda cfg: ClipVisionEncoder(cfg),
+    "video_vae": lambda cfg: VideoVAE(cfg),
 }
 
 

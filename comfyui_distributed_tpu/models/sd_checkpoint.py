@@ -361,6 +361,117 @@ def wan_schedule(cfg, prefix: str = "") -> list[Entry]:
     return entries
 
 
+def _wan_vae_resblock(sd: str, fx: str, in_dim: int, out_dim: int) -> list[Entry]:
+    entries = [
+        (f"{sd}.residual.0", f"{fx}/residual_0", "gamma3"),
+        (f"{sd}.residual.2", f"{fx}/residual_2/conv", "causal3"),
+        (f"{sd}.residual.3", f"{fx}/residual_3", "gamma3"),
+        (f"{sd}.residual.6", f"{fx}/residual_6/conv", "causal3"),
+    ]
+    if in_dim != out_dim:
+        entries.append((f"{sd}.shortcut", f"{fx}/shortcut/conv", "causal3"))
+    return entries
+
+
+def _wan_vae_attn(sd: str, fx: str) -> list[Entry]:
+    return [
+        (f"{sd}.norm", f"{fx}/norm", "gamma2"),
+        (f"{sd}.to_qkv", f"{fx}/to_qkv", _CONV),
+        (f"{sd}.proj", f"{fx}/proj", _CONV),
+    ]
+
+
+def wan_vae_schedule(cfg) -> list[Entry]:
+    """Official Wan2.1 VAE state dict → VideoVAE flax tree
+    (models/video_vae.py). Mirrors the original's flattened Sequential
+    indices: `encoder.downsamples.N` / `decoder.upsamples.N` run over
+    resblocks and resamples in construction order; RMS gammas are bare
+    `.gamma` params with trailing singleton dims."""
+    entries: list[Entry] = []
+
+    # --- encoder ---
+    enc_dims = [cfg.base_dim * m for m in (1,) + tuple(cfg.dim_mult)]
+    entries.append(("encoder.conv1", "encoder/conv1/conv", "causal3"))
+    idx = 0
+    in_dim = enc_dims[0]
+    for level in range(len(cfg.dim_mult)):
+        out_dim = enc_dims[level + 1]
+        for _ in range(cfg.num_res_blocks):
+            entries += _wan_vae_resblock(
+                f"encoder.downsamples.{idx}", f"encoder/down_{idx}",
+                in_dim, out_dim,
+            )
+            in_dim = out_dim
+            idx += 1
+        if level != len(cfg.dim_mult) - 1:
+            sd, fx = f"encoder.downsamples.{idx}", f"encoder/down_{idx}"
+            entries.append((f"{sd}.resample.1", f"{fx}/resample_1", _CONV))
+            if cfg.temporal_down[level]:
+                entries.append((f"{sd}.time_conv", f"{fx}/time_conv/conv", "causal3"))
+            idx += 1
+    top = enc_dims[-1]
+    entries += _wan_vae_resblock("encoder.middle.0", "encoder/middle_0", top, top)
+    entries += _wan_vae_attn("encoder.middle.1", "encoder/middle_1")
+    entries += _wan_vae_resblock("encoder.middle.2", "encoder/middle_2", top, top)
+    entries += [
+        ("encoder.head.0", "encoder/head_0", "gamma3"),
+        ("encoder.head.2", "encoder/head_2/conv", "causal3"),
+        ("conv1", "conv1_q/conv", "causal3"),
+        ("conv2", "conv2_q/conv", "causal3"),
+    ]
+
+    # --- decoder ---
+    rev = tuple(reversed(cfg.dim_mult))
+    dec_dims = [cfg.base_dim * m for m in (rev[0],) + rev]
+    temporal_up = tuple(reversed(cfg.temporal_down))
+    entries.append(("decoder.conv1", "decoder/conv1/conv", "causal3"))
+    top = dec_dims[0]
+    entries += _wan_vae_resblock("decoder.middle.0", "decoder/middle_0", top, top)
+    entries += _wan_vae_attn("decoder.middle.1", "decoder/middle_1")
+    entries += _wan_vae_resblock("decoder.middle.2", "decoder/middle_2", top, top)
+    idx = 0
+    in_dim = dec_dims[0]
+    for level in range(len(cfg.dim_mult)):
+        out_dim = dec_dims[level + 1]
+        for _ in range(cfg.num_res_blocks + 1):
+            entries += _wan_vae_resblock(
+                f"decoder.upsamples.{idx}", f"decoder/up_{idx}",
+                in_dim, out_dim,
+            )
+            in_dim = out_dim
+            idx += 1
+        if level != len(cfg.dim_mult) - 1:
+            sd, fx = f"decoder.upsamples.{idx}", f"decoder/up_{idx}"
+            entries.append((f"{sd}.resample.1", f"{fx}/resample_1", _CONV))
+            if temporal_up[level]:
+                entries.append((f"{sd}.time_conv", f"{fx}/time_conv/conv", "causal3"))
+            idx += 1
+            in_dim = out_dim // 2  # upsample halves channels
+    entries += [
+        ("decoder.head.0", "decoder/head_0", "gamma3"),
+        ("decoder.head.2", "decoder/head_2/conv", "causal3"),
+    ]
+    return entries
+
+
+def load_wan_vae_weights(
+    state_dict: dict[str, np.ndarray],
+    cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map an official Wan VAE state dict onto the VideoVAE tree."""
+    params, problems = _merge_into_template(
+        state_dict, wan_vae_schedule(cfg), template, "video_vae"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"WAN VAE checkpoint mapping failed ({len(problems)} "
+            "problems): " + "; ".join(problems[:12])
+        )
+    return params, problems
+
+
 def clip_vision_schedule(cfg, prefix: str = "vision_model") -> list[Entry]:
     """HF CLIPVisionModel state dict → ClipVisionEncoder flax tree
     (models/clip_vision.py). Penultimate configs skip the last block
@@ -523,6 +634,11 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
             out.append((sd, fx, "id"))
         elif kind == "rms":  # RMSNorm: weight only → scale
             out.append((f"{sd}.weight", f"{fx}/scale", "id"))
+        elif kind == "causal3":  # Conv3d (causal wrapper): weight+bias
+            out.append((f"{sd}.weight", f"{fx}/kernel", "conv3d_k"))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind in ("gamma3", "gamma2"):  # bare RMS gamma w/ 1-dims
+            out.append((f"{sd}.gamma", f"{fx}/scale", kind))
         elif kind.startswith("conv3d"):  # 3D patch conv → patchify dense
             out.append((f"{sd}.weight", f"{fx}/kernel", kind))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))
@@ -551,6 +667,10 @@ def _transform(value: np.ndarray, how: str) -> np.ndarray:
         third = value.shape[0] // 3
         part = value[slot * third : (slot + 1) * third]
         return np.transpose(part, (1, 0)) if how.endswith("_w") else part
+    if how == "conv3d_k":  # torch Conv3d → flax Conv kernel
+        return np.transpose(value, (2, 3, 4, 1, 0))
+    if how in ("gamma3", "gamma2"):  # [C,1,1(,1)] → [C]
+        return value.reshape(-1)
     if how.startswith("conv3d"):
         # torch Conv3d [O, C, pf, ph, pw] → patchify Dense
         # [pf*ph*pw*C, O]: row order must match the DiT's
@@ -564,6 +684,12 @@ def _inverse_transform(value: np.ndarray, how: str) -> np.ndarray:
         return np.transpose(value, (3, 2, 0, 1))
     if how in ("linear", "proj"):
         return np.transpose(value, (1, 0))
+    if how == "conv3d_k":
+        return np.transpose(value, (4, 3, 0, 1, 2))
+    if how == "gamma3":
+        return value.reshape(-1, 1, 1, 1)
+    if how == "gamma2":
+        return value.reshape(-1, 1, 1)
     if how.startswith("conv3d"):
         pf, ph, pw, cin = (int(x) for x in how.split(":")[1:])
         out = value.shape[-1]

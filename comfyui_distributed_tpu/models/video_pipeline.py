@@ -45,6 +45,19 @@ class VideoPipelineBundle:
     flow_shift: float = 3.0
     # i2v: CLIP vision tower for image conditioning (WAN i2v layout)
     clip_vision: Any = None
+    # 1 for per-frame 2D VAEs; the WAN causal VAE compresses 4x with
+    # the 4n+1 pixel-frame contract
+    temporal_scale: int = 1
+
+    def latent_frames(self, frames: int) -> int:
+        if self.temporal_scale == 1:
+            return frames
+        if (frames - 1) % self.temporal_scale != 0:
+            raise ValueError(
+                f"frame count {frames} must be {self.temporal_scale}n+1 "
+                "for this VAE (WAN causal contract)"
+            )
+        return (frames - 1) // self.temporal_scale + 1
 
 
 def load_video_pipeline(
@@ -60,9 +73,10 @@ def load_video_pipeline(
     DiT state dicts — original `blocks.N.*` layout or ComfyUI-repacked
     `model.diffusion_model.*` — map key-by-key into the VideoDiT tree
     (sd_checkpoint.wan_schedule). A T5-family encoder (te_name=
-    "umt5-xxl") likewise loads its own checkpoint file when one
-    resolves by encoder name; the VAE stays init-seeded (WAN's
-    causal-3D VAE is a separate asset — slot in via models/io.py)."""
+    "umt5-xxl") and a video-VAE family VAE (vae_name="wan-vae")
+    likewise load their own checkpoint files when they resolve by
+    name — the full real-weight WAN stack is DiT + umt5-xxl +
+    wan-vae (+ clip-vision-h for i2v)."""
     from . import sd_checkpoint as sdc
 
     tiny = model_name.startswith("tiny")
@@ -103,7 +117,20 @@ def load_video_pipeline(
         dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx, embeds)
     else:
         dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx)
-    vae_params = vae.init(k_vae, jnp.zeros((1, 32, 32, 3)))
+    video_vae = model_family(vae_name) == "video_vae"
+    if video_vae:
+        tds = vae_cfg.temporal_downscale
+        vae_params = vae.init(k_vae, jnp.zeros((1, tds + 1, 32, 32, 3)))
+        vae_ckpt = sdc.find_checkpoint(vae_name)
+        if vae_ckpt:
+            from ..utils.logging import log
+
+            log(f"loading WAN VAE checkpoint {vae_ckpt} for {vae_name}")
+            vae_params, _ = sdc.load_wan_vae_weights(
+                sdc.read_checkpoint(vae_ckpt), vae_cfg, vae_params
+            )
+    else:
+        vae_params = vae.init(k_vae, jnp.zeros((1, 32, 32, 3)))
     te_params = te.init(k_te, jnp.zeros((1, te_cfg.max_length), jnp.int32))
 
     ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
@@ -144,6 +171,9 @@ def load_video_pipeline(
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
         clip_vision=clip_vision,
+        temporal_scale=(
+            vae_cfg.temporal_downscale if video_vae else 1
+        ),
     )
 
 
@@ -159,7 +189,11 @@ def encode_video_text(bundle: VideoPipelineBundle, texts: list[str]) -> jax.Arra
 
 
 def decode_frames(bundle: VideoPipelineBundle, latents: jax.Array) -> jax.Array:
-    """[B, F, h, w, C] latents → [B, F, H, W, 3] frames (per-frame VAE)."""
+    """[B, F_lat, h, w, C] latents → [B, F, H, W, 3] frames. Per-frame
+    2D VAEs decode frame-wise (F == F_lat); the causal 3D VAE expands
+    time 4x (F = 4(F_lat - 1) + 1)."""
+    if bundle.temporal_scale != 1:
+        return bundle.vae.apply(bundle.params["vae"], latents, method="decode")
     b, f = latents.shape[:2]
     flat = latents.reshape((b * f,) + latents.shape[2:])
     frames = bundle.vae.apply(bundle.params["vae"], flat, method="decode")
@@ -188,8 +222,9 @@ def _t2v_jit(
     bundle = bundle_static.value
     lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
     timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
+    lf = bundle.latent_frames(frames)
     x = jax.random.normal(
-        key, (batch, frames, lh, lw, bundle.latent_channels)
+        key, (batch, lf, lh, lw, bundle.latent_channels)
     )
     model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
     latents = smp.sample_flow(model, x, timesteps, (pos, neg))
@@ -235,7 +270,8 @@ def _t2v_parallel_jit(
 
     def per_chip(keys_shard, params, pos, neg):
         key = keys_shard[0]
-        x = jax.random.normal(key, (1, frames, lh, lw, bundle.latent_channels))
+        lf = bundle.latent_frames(frames)
+        x = jax.random.normal(key, (1, lf, lh, lw, bundle.latent_channels))
         model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
         latents = smp.sample_flow(model, x, timesteps, (pos, neg))
         return decode_frames(bundle, latents)
@@ -280,7 +316,10 @@ def t2v_parallel(
 # --- image-to-video -------------------------------------------------------
 
 def encode_frames(bundle: VideoPipelineBundle, frames: jax.Array) -> jax.Array:
-    """[B, F, H, W, 3] → [B, F, h, w, C] per-frame VAE encode."""
+    """[B, F, H, W, 3] → [B, F_lat, h, w, C] VAE encode (per-frame for
+    2D VAEs; 4x temporal compression for the causal 3D VAE)."""
+    if bundle.temporal_scale != 1:
+        return bundle.vae.apply(bundle.params["vae"], frames, method="encode")
     b, f = frames.shape[:2]
     flat = frames.reshape((b * f,) + frames.shape[2:])
     z = bundle.vae.apply(bundle.params["vae"], flat, method="encode")
@@ -300,12 +339,13 @@ def _i2v_jit(
     lh, lw, c = ref_latent.shape[2], ref_latent.shape[3], ref_latent.shape[4]
     timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
     noise_key, _ = jax.random.split(key)
-    noise = jax.random.normal(noise_key, (b, frames, lh, lw, c))
-    # known region = frame 0 carries the reference latent
+    lf = bundle.latent_frames(frames)
+    noise = jax.random.normal(noise_key, (b, lf, lh, lw, c))
+    # known region = latent frame 0 carries the reference latent
     known = jnp.concatenate(
-        [ref_latent, jnp.zeros((b, frames - 1, lh, lw, c))], axis=1
+        [ref_latent, jnp.zeros((b, lf - 1, lh, lw, c))], axis=1
     )
-    mask = jnp.zeros((1, frames, 1, 1, 1)).at[:, 0].set(1.0)
+    mask = jnp.zeros((1, lf, 1, 1, 1)).at[:, 0].set(1.0)
     model = smp.cfg_flow_model(_video_model_fn(bundle, params), cfg_scale)
     latents = smp.sample_flow_masked(
         model, noise, timesteps, (pos, neg), known, mask, noise
@@ -318,23 +358,23 @@ def _i2v_jit(
     static_argnames=("bundle_static", "frames", "steps", "cfg_scale"),
 )
 def _i2v_native_jit(
-    bundle_static, params, ref_latent, image_embeds, pos, neg, key,
+    bundle_static, params, y, image_embeds, pos, neg, key,
     frames: int, steps: int, cfg_scale: float,
 ):
     """WAN-i2v-layout sampling: the model input is
     [noise 16 | mask 4 | conditioning latent 16] per frame, with image
-    cross-attention over CLIP tokens (models/dit.py i2v branch)."""
+    cross-attention over CLIP tokens (models/dit.py i2v branch).
+
+    `y` is the VAE encoding of the full padded PIXEL clip (reference
+    first frame + mid-gray blanks), matching the reference WAN i2v
+    conditioning — NOT zero latents, which are off the VAE manifold."""
     bundle = bundle_static.value
-    b = ref_latent.shape[0]
-    lh, lw, c = ref_latent.shape[2], ref_latent.shape[3], ref_latent.shape[4]
+    b, lf, lh, lw, c = y.shape
     timesteps = smp.get_flow_timesteps(steps, bundle.flow_shift)
-    noise = jax.random.normal(key, (b, frames, lh, lw, c))
-    # conditioning channels: 4-channel frame mask (1 = given) + cond
-    # latent (frame 0 = reference, rest zero), fixed across steps
-    y = jnp.concatenate(
-        [ref_latent, jnp.zeros((b, frames - 1, lh, lw, c))], axis=1
-    )
-    mask = jnp.zeros((b, frames, lh, lw, 4)).at[:, 0].set(1.0)
+    noise = jax.random.normal(key, (b, lf, lh, lw, c))
+    # conditioning channels: 4-channel latent-frame mask (1 = given) +
+    # the padded-clip encoding, fixed across steps
+    mask = jnp.zeros((b, lf, lh, lw, 4)).at[:, 0].set(1.0)
     cond_channels = jnp.concatenate([mask, y], axis=-1)
 
     def model_fn(x, t_batch, context):
@@ -382,8 +422,17 @@ def i2v(
     cfg = get_config(bundle.model_name)
     if getattr(cfg, "i2v", False):
         embeds = encode_image_embeds(bundle, image)
+        # conditioning latent = encoding of the padded PIXEL clip
+        # (reference frame + mid-gray blanks), the reference WAN i2v
+        # construction
+        blanks = jnp.full(
+            (image.shape[0], frames - 1) + image.shape[1:], 0.5, image.dtype
+        )
+        y = encode_frames(
+            bundle, jnp.concatenate([image[:, None], blanks], axis=1)
+        )
         return _i2v_native_jit(
-            _Static(bundle), bundle.params, ref, embeds, pos, neg,
+            _Static(bundle), bundle.params, y, embeds, pos, neg,
             jax.random.key(seed), frames, steps, float(cfg_scale),
         )
     return _i2v_jit(

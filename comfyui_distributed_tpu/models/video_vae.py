@@ -1,0 +1,313 @@
+"""Causal 3D video VAE (WAN class), flax.linen.
+
+The temporal-compression VAE behind the reference's WAN workflows
+(loaded there via ComfyUI's VAELoader; reference
+workflows/distributed-wan*.json): 3D *causal* convolutions (temporal
+pads look backward only), 8x spatial and 4x temporal compression with
+the WAN frame contract `T_latent = (T - 1) / 4 + 1` (the 4n+1 batch
+rule the reference's USDU node validates), RMS-normed residual blocks,
+single-head spatial mid attention, and 16 latent channels matching the
+WAN DiT.
+
+The module tree mirrors the official Wan2.1 VAE state dict
+(`encoder.downsamples.N.residual.*`, `decoder.upsamples.N.*`,
+`middle.{0,1,2}`, `head.{0,2}`, quant convs `conv1`/`conv2`) so real
+checkpoints map key-by-key via sd_checkpoint.wan_vae_schedule.
+
+Whole-clip processing: the streaming feature-cache of the original is
+an inference-memory optimization; zero temporal front-pads over the
+full clip compute the same function the cache computes chunk-by-chunk.
+Temporal upsampling interleaves time_conv channel pairs and drops one
+leading frame per stage, the exact inverse of the stride-2 causal
+downsample on 4n+1 clips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoVAEConfig:
+    base_dim: int = 96
+    z_dim: int = 16
+    dim_mult: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    # which encoder levels also downsample time (WAN: last two of the
+    # three resample stages); decoder mirrors in reverse
+    temporal_down: tuple[bool, ...] = (False, True, True)
+    # per-channel latent normalization (the WAN wrapper's mean/std
+    # vectors); None = identity. Supply alongside real weights.
+    latents_mean: tuple[float, ...] | None = None
+    latents_std: tuple[float, ...] | None = None
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.dim_mult) - 1)
+
+    @property
+    def temporal_downscale(self) -> int:
+        return 2 ** sum(self.temporal_down)
+
+    @property
+    def latent_channels(self) -> int:
+        return self.z_dim
+
+
+class _CausalConv3d(nn.Module):
+    """Conv3d whose temporal receptive field looks backward only:
+    front-pad (kt-1) zeros, valid temporally, SAME spatially."""
+
+    features: int
+    kernel: tuple[int, int, int] = (3, 3, 3)
+    strides: tuple[int, int, int] = (1, 1, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kt, kh, kw = self.kernel
+        pads = (
+            (0, 0),
+            (kt - 1, 0),
+            (kh // 2, kh // 2),
+            (kw // 2, kw // 2),
+            (0, 0),
+        )
+        x = jnp.pad(x, pads)
+        return nn.Conv(
+            self.features, self.kernel, strides=self.strides,
+            padding="VALID", dtype=self.dtype, name="conv",
+        )(x)
+
+
+class _RMSNormChannels(nn.Module):
+    """WAN VAE RMS_norm: F.normalize over the channel dim * sqrt(C) *
+    per-channel gamma."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        gamma = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        unit = xf * jax.lax.rsqrt(
+            jnp.sum(xf * xf, axis=-1, keepdims=True) + 1e-12
+        )
+        return unit * jnp.sqrt(jnp.asarray(x.shape[-1], jnp.float32)) * gamma
+
+
+class _ResBlock3d(nn.Module):
+    """WAN ResidualBlock: RMS → SiLU → causal conv → RMS → SiLU →
+    causal conv, 1x1x1 causal shortcut on channel change. Child names
+    match the Sequential indices of the original (residual.0/2/3/6)."""
+
+    out_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = _RMSNormChannels(name="residual_0")(x)
+        h = _CausalConv3d(self.out_dim, dtype=self.dtype, name="residual_2")(
+            nn.silu(h).astype(self.dtype)
+        )
+        h = _RMSNormChannels(name="residual_3")(h)
+        h = _CausalConv3d(self.out_dim, dtype=self.dtype, name="residual_6")(
+            nn.silu(h).astype(self.dtype)
+        )
+        if x.shape[-1] != self.out_dim:
+            x = _CausalConv3d(
+                self.out_dim, kernel=(1, 1, 1), dtype=self.dtype,
+                name="shortcut",
+            )(x)
+        return x + h
+
+
+class _SpatialAttention(nn.Module):
+    """WAN AttentionBlock: single-head per-frame spatial attention with
+    1x1 conv qkv/proj."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, f, hh, ww, c = x.shape
+        h = _RMSNormChannels(name="norm")(x)
+        qkv = nn.Conv(3 * c, (1, 1), dtype=jnp.float32, name="to_qkv")(
+            h.reshape(b * f, hh, ww, c)
+        ).reshape(b * f, hh * ww, 3, c)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = jax.nn.softmax(
+            jnp.einsum("bnc,bmc->bnm", q, k) / jnp.sqrt(float(c)), axis=-1
+        )
+        out = jnp.einsum("bnm,bmc->bnc", attn, v).reshape(b * f, hh, ww, c)
+        out = nn.Conv(c, (1, 1), dtype=jnp.float32, name="proj")(out)
+        return x + out.reshape(b, f, hh, ww, c)
+
+
+class _Downsample(nn.Module):
+    """WAN Resample (downsample2d/3d): zero-pad right/bottom + stride-2
+    spatial conv; 3d adds a stride-2 causal temporal conv first-class."""
+
+    dim: int
+    temporal: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, f, hh, ww, c = x.shape
+        if self.temporal:
+            x = _CausalConv3d(
+                self.dim, kernel=(3, 1, 1), strides=(2, 1, 1),
+                dtype=self.dtype, name="time_conv",
+            )(x)
+            f = x.shape[1]
+        flat = x.reshape(b * f, hh, ww, c)
+        flat = jnp.pad(flat, ((0, 0), (0, 1), (0, 1), (0, 0)))
+        flat = nn.Conv(
+            self.dim, (3, 3), strides=(2, 2), padding="VALID",
+            dtype=self.dtype, name="resample_1",
+        )(flat)
+        return flat.reshape((b, f) + flat.shape[1:])
+
+
+class _Upsample(nn.Module):
+    """WAN Resample (upsample2d/3d): 2x nearest spatial + conv to
+    dim//2; 3d first doubles time via a 2C time_conv whose channel
+    pairs interleave into frames (one leading frame dropped — the
+    exact inverse of the causal stride-2 downsample)."""
+
+    dim: int
+    temporal: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, f, hh, ww, c = x.shape
+        if self.temporal:
+            t = _CausalConv3d(
+                self.dim * 2, kernel=(3, 1, 1), dtype=self.dtype,
+                name="time_conv",
+            )(x)
+            t = t.reshape(b, f, hh, ww, 2, self.dim)
+            x = t.transpose(0, 1, 4, 2, 3, 5).reshape(
+                b, 2 * f, hh, ww, self.dim
+            )[:, 1:]
+            f = x.shape[1]
+            c = self.dim
+        flat = x.reshape(b * f, hh, ww, c)
+        flat = jax.image.resize(
+            flat, (b * f, hh * 2, ww * 2, c), method="nearest"
+        )
+        flat = nn.Conv(
+            self.dim // 2, (3, 3), dtype=self.dtype, name="resample_1",
+        )(flat)
+        return flat.reshape((b, f) + flat.shape[1:])
+
+
+class VideoEncoder(nn.Module):
+    config: VideoVAEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        dims = [cfg.base_dim * m for m in (1,) + tuple(cfg.dim_mult)]
+        x = _CausalConv3d(dims[0], dtype=dt, name="conv1")(x.astype(dt))
+        idx = 0
+        for level in range(len(cfg.dim_mult)):
+            out_dim = dims[level + 1]
+            for _ in range(cfg.num_res_blocks):
+                x = _ResBlock3d(out_dim, dtype=dt, name=f"down_{idx}")(x)
+                idx += 1
+            if level != len(cfg.dim_mult) - 1:
+                x = _Downsample(
+                    out_dim, temporal=cfg.temporal_down[level], dtype=dt,
+                    name=f"down_{idx}",
+                )(x)
+                idx += 1
+        x = _ResBlock3d(dims[-1], dtype=dt, name="middle_0")(x)
+        x = _SpatialAttention(name="middle_1")(x)
+        x = _ResBlock3d(dims[-1], dtype=dt, name="middle_2")(x)
+        x = _RMSNormChannels(name="head_0")(x)
+        return _CausalConv3d(
+            2 * cfg.z_dim, dtype=jnp.float32, name="head_2"
+        )(nn.silu(x).astype(jnp.float32))
+
+
+class VideoDecoder(nn.Module):
+    config: VideoVAEConfig
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        rev = tuple(reversed(cfg.dim_mult))
+        dims = [cfg.base_dim * m for m in (rev[0],) + rev]
+        temporal_up = tuple(reversed(cfg.temporal_down))
+        x = _CausalConv3d(dims[0], dtype=dt, name="conv1")(z.astype(dt))
+        x = _ResBlock3d(dims[0], dtype=dt, name="middle_0")(x)
+        x = _SpatialAttention(name="middle_1")(x)
+        x = _ResBlock3d(dims[0], dtype=dt, name="middle_2")(x)
+        idx = 0
+        for level in range(len(cfg.dim_mult)):
+            out_dim = dims[level + 1]
+            for _ in range(cfg.num_res_blocks + 1):
+                x = _ResBlock3d(out_dim, dtype=dt, name=f"up_{idx}")(x)
+                idx += 1
+            if level != len(cfg.dim_mult) - 1:
+                x = _Upsample(
+                    out_dim, temporal=temporal_up[level], dtype=dt,
+                    name=f"up_{idx}",
+                )(x)
+                idx += 1
+        x = _RMSNormChannels(name="head_0")(x)
+        return _CausalConv3d(3, dtype=jnp.float32, name="head_2")(
+            nn.silu(x).astype(jnp.float32)
+        )
+
+
+class VideoVAE(nn.Module):
+    """encode: [B, F, H, W, 3] (F = 4n+1) → [B, (F-1)/4+1, H/8, W/8, z];
+    decode inverts. Latents are mean-of-gaussian (deterministic) with
+    optional per-channel normalization."""
+
+    config: VideoVAEConfig
+
+    def setup(self):
+        cfg = self.config
+        self.encoder = VideoEncoder(cfg)
+        self.decoder = VideoDecoder(cfg)
+        # WAN quant convs (1x1x1)
+        self.conv1 = _CausalConv3d(2 * cfg.z_dim, kernel=(1, 1, 1), name="conv1_q")
+        self.conv2 = _CausalConv3d(cfg.z_dim, kernel=(1, 1, 1), name="conv2_q")
+
+    def _norm(self, z: jax.Array, inverse: bool) -> jax.Array:
+        cfg = self.config
+        if cfg.latents_mean is None or cfg.latents_std is None:
+            return z
+        mean = jnp.asarray(cfg.latents_mean, z.dtype)
+        std = jnp.asarray(cfg.latents_std, z.dtype)
+        return z * std + mean if inverse else (z - mean) / std
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        if (x.shape[1] - 1) % self.config.temporal_downscale != 0:
+            raise ValueError(
+                f"frame count {x.shape[1]} must be "
+                f"{self.config.temporal_downscale}n+1 (WAN causal contract)"
+            )
+        moments = self.conv1(self.encoder(x * 2.0 - 1.0))
+        mean = moments[..., : self.config.z_dim]
+        return self._norm(mean, inverse=False)
+
+    def decode(self, z: jax.Array) -> jax.Array:
+        z = self._norm(z, inverse=True)
+        x = self.decoder(self.conv2(z))
+        return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return self.decode(self.encode(x))

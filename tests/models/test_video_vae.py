@@ -1,0 +1,138 @@
+"""Causal 3D video VAE: shape contracts, causality, schedule
+round-trip + real-key pins, pipeline integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import create_model, get_config
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+
+pytestmark = pytest.mark.slow
+
+
+def _tiny():
+    model = create_model("tiny-video-vae-3d")
+    cfg = get_config("tiny-video-vae-3d")
+    x = jnp.zeros((1, cfg.temporal_downscale + 1, 16, 16, 3))
+    params = model.init(jax.random.key(0), x)
+    return model, cfg, params
+
+
+def test_shape_contract_round_trip():
+    """encode: F = tn+1 → (F-1)/t + 1 latent frames, H/downscale
+    spatial; decode inverts exactly."""
+    model, cfg, params = _tiny()
+    t = cfg.temporal_downscale
+    for n in (1, 3):
+        f = t * n + 1
+        x = jnp.asarray(
+            np.random.default_rng(n).uniform(size=(1, f, 16, 16, 3)),
+            jnp.float32,
+        )
+        z = model.apply(params, x, method="encode")
+        assert z.shape == (1, n + 1, 16 // cfg.downscale, 16 // cfg.downscale,
+                           cfg.z_dim)
+        y = model.apply(params, z, method="decode")
+        assert y.shape == x.shape
+        assert np.isfinite(np.asarray(y)).all()
+
+
+def test_frame_contract_rejected():
+    model, cfg, params = _tiny()
+    bad = jnp.zeros((1, cfg.temporal_downscale, 16, 16, 3))
+    with pytest.raises(ValueError, match="causal contract"):
+        model.apply(params, bad, method="encode")
+
+
+def test_temporal_causality():
+    """Changing a LATER frame must not change EARLIER latent frames
+    (the whole point of causal convolutions)."""
+    model, cfg, params = _tiny()
+    t = cfg.temporal_downscale
+    f = 2 * t + 1
+    rng = np.random.default_rng(3)
+    x = np.asarray(rng.uniform(size=(1, f, 16, 16, 3)), np.float32)
+    x2 = x.copy()
+    x2[:, -1] += 0.5  # perturb only the last frame
+    z1 = np.asarray(model.apply(params, jnp.asarray(x), method="encode"))
+    z2 = np.asarray(model.apply(params, jnp.asarray(x2), method="encode"))
+    # the first latent frame depends only on pixel frame 0
+    np.testing.assert_allclose(z1[:, 0], z2[:, 0], atol=1e-5)
+    assert np.abs(z1[:, -1] - z2[:, -1]).max() > 1e-4  # it did change
+
+
+def test_wan_vae_schedule_roundtrip_exact():
+    model, cfg, params = _tiny()
+    flat = flatten_params(jax.device_get(params))
+    entries = sdc.wan_vae_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, entries)
+    converted, missing = sdc.convert_state_dict(state_dict, entries)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:8],
+        sorted(set(converted) - set(flat))[:8],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+    out, problems = sdc.load_wan_vae_weights(state_dict, cfg, params)
+    assert problems == []
+    with pytest.raises(ValueError, match="WAN VAE checkpoint mapping failed"):
+        sdc.load_wan_vae_weights({}, cfg, params)
+
+
+# Genuine key names from the official Wan2.1 VAE state dict layout
+# (flattened Sequential indices; bare .gamma RMS params).
+WAN_VAE_KNOWN_KEYS = [
+    "encoder.conv1.weight",
+    "encoder.downsamples.0.residual.0.gamma",
+    "encoder.downsamples.0.residual.2.weight",
+    "encoder.downsamples.0.residual.6.bias",
+    "encoder.downsamples.3.residual.0.gamma",  # level-1 first resblock
+    "encoder.middle.1.norm.gamma",
+    "encoder.middle.1.to_qkv.weight",
+    "encoder.head.0.gamma",
+    "encoder.head.2.weight",
+    "conv1.weight",
+    "conv2.weight",
+    "decoder.conv1.weight",
+    "decoder.middle.0.residual.2.weight",
+    "decoder.upsamples.0.residual.0.gamma",
+    "decoder.head.2.bias",
+]
+
+
+def test_wan_vae_full_config_covers_real_key_names():
+    cfg = get_config("wan-vae")
+    keys = {k for k, _f, _h in sdc._expand(sdc.wan_vae_schedule(cfg))}
+    missing = [k for k in WAN_VAE_KNOWN_KEYS if k not in keys]
+    assert not missing, missing
+    # full config: downsample stages at indices 2, 5, 8 with time_conv
+    # on the temporal levels only (WAN: levels 1 and 2)
+    assert "encoder.downsamples.2.resample.1.weight" in keys
+    assert "encoder.downsamples.2.time_conv.weight" not in keys
+    assert "encoder.downsamples.5.time_conv.weight" in keys
+    assert "encoder.downsamples.8.time_conv.weight" in keys
+    # decoder: 15 modules (3 res + resample per level, 3 res at last)
+    assert "decoder.upsamples.14.residual.2.weight" in keys
+    assert "decoder.upsamples.15.residual.2.weight" not in keys
+
+
+def test_pipeline_with_3d_vae():
+    """t2v through the causal VAE: 4n+1 pixel frames sampled in
+    compressed latent time."""
+    from comfyui_distributed_tpu.models.video_pipeline import (
+        load_video_pipeline,
+        t2v,
+    )
+
+    bundle = load_video_pipeline("tiny-dit", vae_name="tiny-video-vae-3d")
+    assert bundle.temporal_scale == 2
+    out = t2v(bundle, "drifting clouds", frames=5, height=32, width=32, steps=2)
+    assert out.shape[:2] == (1, 5)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    with pytest.raises(ValueError, match="causal contract"):
+        t2v(bundle, "x", frames=4, height=32, width=32, steps=2)
