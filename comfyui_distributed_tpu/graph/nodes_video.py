@@ -70,7 +70,7 @@ class EmptyVideoLatent:
             "required": {
                 "width": ("INT", {"default": 256}),
                 "height": ("INT", {"default": 256}),
-                "frames": ("INT", {"default": 16}),
+                "frames": ("INT", {"default": 17}),
                 "batch_size": ("INT", {"default": 1}),
             }
         }
@@ -118,7 +118,7 @@ class VideoFlowSampler:
         spec = resolve_seed(seed)
         bundle: vp.VideoPipelineBundle = model
         mesh = getattr(context, "mesh", None) if context is not None else None
-        frames = int(latent.get("frames", 16))
+        frames = int(latent.get("frames", 17))
         height = int(latent.get("height", 256))
         width = int(latent.get("width", 256))
 

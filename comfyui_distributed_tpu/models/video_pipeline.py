@@ -80,7 +80,12 @@ def load_video_pipeline(
     from . import sd_checkpoint as sdc
 
     tiny = model_name.startswith("tiny")
-    vae_name = vae_name or ("tiny-vae-video" if tiny else "vae-video")
+    # non-tiny video models default to the causal WAN VAE (the real
+    # stack); tiny tests keep the cheap per-frame 2D VAE. The text
+    # encoder defaults to CLIP-L for init cost — pass te_name=
+    # "umt5-xxl" for the full real-weight WAN stack (a random-init
+    # UMT5-XXL is ~6B params, pointless without its checkpoint).
+    vae_name = vae_name or ("tiny-vae-video" if tiny else "wan-vae")
     te_name = te_name or ("tiny-te" if tiny else "clip-l")
 
     dit = create_model(model_name)
@@ -235,7 +240,7 @@ def t2v(
     bundle: VideoPipelineBundle,
     prompt: str,
     negative_prompt: str = "",
-    frames: int = 16,
+    frames: int = 17,
     height: int = 256,
     width: int = 256,
     steps: int = 20,
@@ -290,7 +295,7 @@ def t2v_parallel(
     mesh,
     prompt: str,
     negative_prompt: str = "",
-    frames: int = 16,
+    frames: int = 17,
     height: int = 256,
     width: int = 256,
     steps: int = 20,
@@ -403,7 +408,7 @@ def i2v(
     image: jax.Array,            # [B, H, W, 3] first frame
     prompt: str,
     negative_prompt: str = "",
-    frames: int = 16,
+    frames: int = 17,
     steps: int = 20,
     cfg_scale: float = 5.0,
     seed: int = 0,

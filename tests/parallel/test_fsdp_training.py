@@ -1,0 +1,67 @@
+"""FSDP train step on the virtual mesh: UNet (the dryrun path) and
+video DiT (the BASELINE wan-14b-FSDP configuration, tiny-sized)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from comfyui_distributed_tpu.models import create_model, get_config
+from comfyui_distributed_tpu.parallel import build_mesh
+from comfyui_distributed_tpu.parallel.training import make_train_step
+
+def _batch(rng, latents_shape, ctx_shape):
+    return {
+        "latents": jnp.asarray(rng.standard_normal(latents_shape), jnp.float32),
+        "t": jnp.full((latents_shape[0],), 100.0),
+        "context": jnp.asarray(rng.standard_normal(ctx_shape), jnp.float32),
+        "noise": jnp.asarray(rng.standard_normal(latents_shape), jnp.float32),
+    }
+
+
+def test_dit_fsdp_train_step():
+    """One DP x FSDP step of the video DiT: finite loss, updated
+    params, parameters actually sharded over the model axis."""
+    mesh = build_mesh({"data": 4, "model": 2})
+    model = create_model("tiny-dit")
+    cfg = get_config("tiny-dit")
+    b = 4  # one sample per data-parallel group
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, (b, 2, 8, 8, cfg.in_channels), (b, 8, cfg.context_dim))
+    params = model.init(
+        jax.random.key(0), batch["latents"], batch["t"], batch["context"]
+    )
+
+    step = make_train_step(model, mesh)
+    new_params, loss = step(params, batch)
+    assert np.isfinite(float(loss))
+
+    flat_old = jax.tree_util.tree_leaves(params)
+    flat_new = jax.tree_util.tree_leaves(new_params)
+    changed = any(
+        np.abs(np.asarray(o, np.float32) - np.asarray(n, np.float32)).max() > 0
+        for o, n in zip(flat_old, flat_new)
+    )
+    assert changed
+
+    # at least one large parameter is genuinely sharded on "model"
+    sharded = [
+        leaf for leaf in flat_new
+        if hasattr(leaf, "sharding")
+        and "model" in getattr(leaf.sharding, "spec", ())
+    ]
+    assert sharded, "no parameter carries a model-axis sharding"
+
+
+def test_unet_fsdp_two_steps_progress():
+    mesh = build_mesh({"data": 4, "model": 2})
+    model = create_model("tiny-unet")
+    cfg = get_config("tiny-unet")
+    rng = np.random.default_rng(1)
+    batch = _batch(rng, (4, 8, 8, cfg.in_channels), (4, 8, cfg.context_dim))
+    params = model.init(
+        jax.random.key(0), batch["latents"], batch["t"], batch["context"]
+    )
+    step = make_train_step(model, mesh)
+    p1, l1 = step(params, batch)
+    p2, l2 = step(p1, batch)
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+    assert float(l2) != float(l1)  # params moved between steps
