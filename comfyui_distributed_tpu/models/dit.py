@@ -168,8 +168,8 @@ class _WanBlock(nn.Module):
         q = nn.Dense(dim, dtype=self.dtype, name="self_attn_q")(h)
         k = nn.Dense(dim, dtype=self.dtype, name="self_attn_k")(h)
         v = nn.Dense(dim, dtype=self.dtype, name="self_attn_v")(h)
-        q = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="self_attn_norm_q")(q)
-        k = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="self_attn_norm_k")(k)
+        q = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="self_attn_norm_q")(q)
+        k = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="self_attn_norm_k")(k)
         q = apply_rope(q.astype(self.dtype).reshape(b, n, self.heads, head_dim), freqs)
         k = apply_rope(k.astype(self.dtype).reshape(b, n, self.heads, head_dim), freqs)
         v = v.reshape(b, n, self.heads, head_dim)
@@ -190,8 +190,8 @@ class _WanBlock(nn.Module):
         qc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_q")(h)
         kc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_k")(context)
         vc = nn.Dense(dim, dtype=self.dtype, name="cross_attn_v")(context)
-        qc = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_q")(qc)
-        kc = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_k")(kc)
+        qc = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="cross_attn_norm_q")(qc)
+        kc = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="cross_attn_norm_k")(kc)
         qc = qc.astype(self.dtype).reshape(b, n, self.heads, head_dim)
         kc = kc.astype(self.dtype).reshape(b, m, self.heads, head_dim)
         vc = vc.reshape(b, m, self.heads, head_dim)
@@ -207,7 +207,7 @@ class _WanBlock(nn.Module):
                 context_img
             )
             ki = nn.RMSNorm(
-                epsilon=1e-5, dtype=jnp.float32, name="cross_attn_norm_k_img"
+                epsilon=1e-6, dtype=jnp.float32, name="cross_attn_norm_k_img"
             )(ki)
             ki = ki.astype(self.dtype).reshape(b, mi, self.heads, head_dim)
             vi = vi.reshape(b, mi, self.heads, head_dim)
@@ -278,14 +278,14 @@ class VideoDiT(nn.Module):
         # img_emb MLPProj: LN, Linear, GELU, Linear, LN)
         context_img = None
         if cfg.i2v and image_embeds is not None:
-            h_img = nn.LayerNorm(dtype=jnp.float32, name="img_emb_norm_in")(
+            h_img = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="img_emb_norm_in")(
                 image_embeds.astype(jnp.float32)
             ).astype(dt)
             h_img = nn.Dense(cfg.img_dim, dtype=dt, name="img_emb_fc1")(h_img)
             h_img = nn.gelu(h_img, approximate=False)
             h_img = nn.Dense(cfg.hidden_dim, dtype=dt, name="img_emb_fc2")(h_img)
             context_img = nn.LayerNorm(
-                dtype=jnp.float32, name="img_emb_norm_out"
+                epsilon=1e-5, dtype=jnp.float32, name="img_emb_norm_out"
             )(h_img.astype(jnp.float32)).astype(dt)
 
         head_dim = cfg.hidden_dim // cfg.heads

@@ -420,7 +420,6 @@ def i2v(
     channel-concat mask + reference latent, plus CLIP-token image
     cross-attention. Other video models fall back to clamping frame 0
     to the reference latent along the flow path (masked flow)."""
-    ref = encode_frames(bundle, image[:, None])  # [B, 1, h, w, C]
     b = int(image.shape[0])
     pos = encode_video_text(bundle, [prompt] * b)
     neg = encode_video_text(bundle, [negative_prompt] * b)
@@ -440,6 +439,7 @@ def i2v(
             _Static(bundle), bundle.params, y, embeds, pos, neg,
             jax.random.key(seed), frames, steps, float(cfg_scale),
         )
+    ref = encode_frames(bundle, image[:, None])  # [B, 1, h, w, C]
     return _i2v_jit(
         _Static(bundle), bundle.params, ref, pos, neg,
         jax.random.key(seed), frames, steps, float(cfg_scale),

@@ -14,12 +14,17 @@ The module tree mirrors the official Wan2.1 VAE state dict
 `middle.{0,1,2}`, `head.{0,2}`, quant convs `conv1`/`conv2`) so real
 checkpoints map key-by-key via sd_checkpoint.wan_vae_schedule.
 
-Whole-clip processing: the streaming feature-cache of the original is
-an inference-memory optimization; zero temporal front-pads over the
-full clip compute the same function the cache computes chunk-by-chunk.
-Temporal upsampling interleaves time_conv channel pairs and drops one
-leading frame per stage, the exact inverse of the stride-2 causal
-downsample on 4n+1 clips.
+Whole-clip processing: for the plain causal convolutions, zero
+temporal front-pads over the full clip compute the same function the
+original's streaming feature-cache computes chunk-by-chunk (the cache
+merely carries the previous chunk's trailing frames).  The Resample
+time convs are the exception — their first chunk is *cached, not
+convolved* — so the clip-boundary semantics are reproduced
+explicitly: in downsample3d, frame 0 bypasses the temporal conv
+(identity) and windows start at [x0,x1,x2]; in upsample3d, z0 is
+emitted un-doubled and never enters a conv window (its slot reads as
+zeros — the original marks the first chunk 'Rep' and later prepends
+zeros, never z0).
 """
 
 from __future__ import annotations
@@ -151,7 +156,10 @@ class _SpatialAttention(nn.Module):
 
 class _Downsample(nn.Module):
     """WAN Resample (downsample2d/3d): zero-pad right/bottom + stride-2
-    spatial conv; 3d adds a stride-2 causal temporal conv first-class."""
+    spatial conv; 3d then applies a stride-2 causal temporal conv whose
+    first output frame is the cache-bypass identity (the original's
+    streaming path only caches the first chunk, it never convolves
+    it)."""
 
     dim: int
     temporal: bool
@@ -160,26 +168,30 @@ class _Downsample(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, f, hh, ww, c = x.shape
-        if self.temporal:
-            x = _CausalConv3d(
-                self.dim, kernel=(3, 1, 1), strides=(2, 1, 1),
-                dtype=self.dtype, name="time_conv",
-            )(x)
-            f = x.shape[1]
         flat = x.reshape(b * f, hh, ww, c)
         flat = jnp.pad(flat, ((0, 0), (0, 1), (0, 1), (0, 0)))
         flat = nn.Conv(
             self.dim, (3, 3), strides=(2, 2), padding="VALID",
             dtype=self.dtype, name="resample_1",
         )(flat)
-        return flat.reshape((b, f) + flat.shape[1:])
+        x = flat.reshape((b, f) + flat.shape[1:])
+        if self.temporal:
+            y = _CausalConv3d(
+                self.dim, kernel=(3, 1, 1), strides=(2, 1, 1),
+                dtype=self.dtype, name="time_conv",
+            )(x)
+            # Drop the [0,0,x0] window; frame 0 passes through untouched.
+            x = jnp.concatenate([x[:, :1], y[:, 1:]], axis=1)
+        return x
 
 
 class _Upsample(nn.Module):
     """WAN Resample (upsample2d/3d): 2x nearest spatial + conv to
-    dim//2; 3d first doubles time via a 2C time_conv whose channel
-    pairs interleave into frames (one leading frame dropped — the
-    exact inverse of the causal stride-2 downsample)."""
+    dim//2; 3d first doubles frames 1..L-1 via a 2C time_conv whose
+    channel pairs interleave into frame pairs, while z0 is emitted
+    un-doubled and excluded from every conv window (the original's
+    'Rep' cache marker: the first chunk passes through untouched and
+    later windows see zeros in its slot, never z0)."""
 
     dim: int
     temporal: bool
@@ -192,11 +204,14 @@ class _Upsample(nn.Module):
             t = _CausalConv3d(
                 self.dim * 2, kernel=(3, 1, 1), dtype=self.dtype,
                 name="time_conv",
-            )(x)
-            t = t.reshape(b, f, hh, ww, 2, self.dim)
-            x = t.transpose(0, 1, 4, 2, 3, 5).reshape(
-                b, 2 * f, hh, ww, self.dim
-            )[:, 1:]
+            )(x.at[:, 0].set(0.0))
+            t = t[:, 1:]  # the z0 window produces no frames
+            t = t.reshape(b, f - 1, hh, ww, 2, self.dim)
+            doubled = t.transpose(0, 1, 4, 2, 3, 5).reshape(
+                b, 2 * (f - 1), hh, ww, self.dim
+            )
+            x = jnp.concatenate([x[:, :1].astype(doubled.dtype), doubled],
+                                axis=1)
             f = x.shape[1]
             c = self.dim
         flat = x.reshape(b * f, hh, ww, c)

@@ -64,6 +64,90 @@ def test_temporal_causality():
     assert np.abs(z1[:, -1] - z2[:, -1]).max() > 1e-4  # it did change
 
 
+def test_downsample_frame0_bypasses_time_conv():
+    """Wan2.1 Resample downsample3d streaming semantics: the first
+    chunk is only *cached*, never convolved — so frame 0 of the
+    temporal stage is the spatially-downsampled frame 0 verbatim, and
+    later frames come from windows [x0,x1,x2], [x2,x3,x4], ..."""
+    from comfyui_distributed_tpu.models.video_vae import _Downsample
+
+    mod = _Downsample(dim=4, temporal=True)
+    x = jnp.asarray(
+        np.random.default_rng(0).uniform(size=(1, 5, 8, 8, 4)), jnp.float32
+    )
+    params = mod.init(jax.random.key(0), x)
+    out = np.asarray(mod.apply(params, x))
+    assert out.shape == (1, 3, 4, 4, 4)
+
+    # Zero the temporal conv: convolved frames collapse to zero while
+    # the cache-bypass frame 0 keeps the spatial conv output.
+    zeroed = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zeroed["params"]["resample_1"] = params["params"]["resample_1"]
+    out_z = np.asarray(mod.apply(zeroed, x))
+    np.testing.assert_allclose(out_z[:, 0], out[:, 0], atol=1e-6)
+    assert np.abs(out_z[:, 0]).max() > 1e-4
+    np.testing.assert_allclose(out_z[:, 1:], 0.0, atol=1e-7)
+
+
+def test_downsample_spatial_conv_runs_before_time_conv():
+    """downsample3d applies the stride-2 spatial conv first; the
+    temporal conv then sees spatially-reduced frames, so out[1]
+    depends on pixel frames 0-2 and out[2] on frames 2-4 only."""
+    from comfyui_distributed_tpu.models.video_vae import _Downsample
+
+    mod = _Downsample(dim=4, temporal=True)
+    rng = np.random.default_rng(1)
+    x = np.asarray(rng.uniform(size=(1, 5, 8, 8, 4)), np.float32)
+    params = mod.init(jax.random.key(1), jnp.asarray(x))
+    base = np.asarray(mod.apply(params, jnp.asarray(x)))
+    x2 = x.copy()
+    x2[:, 1] += 0.25  # frame 1 is only in window [x0,x1,x2]
+    out = np.asarray(mod.apply(params, jnp.asarray(x2)))
+    np.testing.assert_allclose(out[:, 0], base[:, 0], atol=1e-6)
+    assert np.abs(out[:, 1] - base[:, 1]).max() > 1e-4
+    np.testing.assert_allclose(out[:, 2], base[:, 2], atol=1e-6)
+
+
+def test_upsample_rep_boundary_z0_undoubled_and_excluded():
+    """Wan2.1 Resample upsample3d 'Rep' semantics: z0 passes through
+    un-doubled and never enters a time_conv window — perturbing z0
+    changes ONLY output frame 0."""
+    from comfyui_distributed_tpu.models.video_vae import _Upsample
+
+    mod = _Upsample(dim=4, temporal=True)
+    rng = np.random.default_rng(2)
+    z = np.asarray(rng.uniform(size=(1, 3, 4, 4, 4)), np.float32)
+    params = mod.init(jax.random.key(2), jnp.asarray(z))
+    base = np.asarray(mod.apply(params, jnp.asarray(z)))
+    assert base.shape == (1, 5, 8, 8, 2)  # 1 + 2*(L-1) frames
+
+    z2 = z.copy()
+    z2[:, 0] += 0.5
+    out = np.asarray(mod.apply(params, jnp.asarray(z2)))
+    assert np.abs(out[:, 0] - base[:, 0]).max() > 1e-4
+    np.testing.assert_allclose(out[:, 1:], base[:, 1:], atol=1e-6)
+
+
+def test_upsample_z1_windows_match_zero_padded_causal_conv():
+    """Frames 1.. come from causal windows over [0, 0, z1, z2, ...]:
+    zeroing the time_conv collapses every doubled frame to the
+    (spatially upsampled) bias while frame 0 keeps z0's content."""
+    from comfyui_distributed_tpu.models.video_vae import _Upsample
+
+    mod = _Upsample(dim=4, temporal=True)
+    z = jnp.asarray(
+        np.random.default_rng(3).uniform(size=(1, 3, 4, 4, 4)), jnp.float32
+    )
+    params = mod.init(jax.random.key(3), z)
+    zeroed = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zeroed["params"]["resample_1"] = params["params"]["resample_1"]
+    out = np.asarray(mod.apply(zeroed, z))
+    # all doubled frames identical (pure bias through the spatial conv)
+    for i in range(2, 5):
+        np.testing.assert_allclose(out[:, i], out[:, 1], atol=1e-6)
+    assert np.abs(out[:, 0] - out[:, 1]).max() > 1e-4  # z0 content survives
+
+
 def test_wan_vae_schedule_roundtrip_exact():
     model, cfg, params = _tiny()
     flat = flatten_params(jax.device_get(params))
