@@ -1,0 +1,311 @@
+"""Pin checkpoint key schedules against committed key+shape manifests.
+
+The manifests (tests/models/manifests/*.json, generated once by
+scripts/gen_reference_manifests.py) enumerate the published
+checkpoints' consumable state-dict layout from the TORCH side — the
+original implementations' module construction — independently of the
+flax trees and schedule code.  These tests derive each schedule's
+(sd_key → torch shape) mapping via jax.eval_shape on the real-size
+models and assert exact two-way agreement: a single renamed key or
+wrong shape in a schedule fails here (the round-trip tests in
+test_sd_checkpoint.py cannot catch that class of bug — an error there
+reproduces identically in the synthesized checkpoint).
+
+This replaces the loader guarantees the reference inherits for free
+from ComfyUI's checkpoint code (reference upscale/tile_ops.py:168).
+"""
+
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from comfyui_distributed_tpu.models import create_model, get_config
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+
+pytestmark = pytest.mark.slow
+
+MANIFEST_DIR = os.path.join(os.path.dirname(__file__), "manifests")
+
+
+def _manifest(name: str) -> dict[str, tuple[int, ...]]:
+    with open(os.path.join(MANIFEST_DIR, f"{name}.json")) as fh:
+        return {k: tuple(v) for k, v in json.load(fh).items()}
+
+
+@functools.lru_cache(maxsize=None)
+def _flax_shapes(model_name: str) -> dict[str, tuple[int, ...]]:
+    """Flat flax param path → shape for the real-size model, via
+    eval_shape (no weight memory is allocated)."""
+    cfg = get_config(model_name)
+    key = jax.random.key(0)
+    fam_inputs = {
+        "unet": lambda: (
+            jnp.zeros((1, 8, 8, cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 77, cfg.context_dim)),
+        ),
+        "dit": lambda: (
+            jnp.zeros((1, 1, 4, 4, cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 16, cfg.context_dim)),
+        )
+        if not getattr(cfg, "i2v", False)
+        else (
+            jnp.zeros((1, 1, 4, 4, cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 16, cfg.context_dim)),
+            jnp.zeros((1, 257, cfg.img_dim)),
+        ),
+        "vae": lambda: (jnp.zeros((1, 8, 8, cfg.in_channels)),),
+        "text_encoder": lambda: (
+            jnp.zeros((1, cfg.max_length), jnp.int32),
+        ),
+        "t5_encoder": lambda: (jnp.zeros((1, 8), jnp.int32),),
+        "video_vae": lambda: (
+            jnp.zeros((1, cfg.temporal_downscale + 1, 16, 16, 3)),
+        ),
+    }
+    from comfyui_distributed_tpu.models.registry import model_family
+
+    args = fam_inputs[model_family(model_name)]()
+    tree = jax.eval_shape(lambda k: create_model(model_name).init(k, *args), key)
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in leaves:
+        name = "/".join(
+            p.key if hasattr(p, "key") else str(p) for p in path
+        )
+        out[name] = tuple(leaf.shape)
+    return out
+
+
+def _sd_shape(flax_shape: tuple[int, ...], how: str) -> tuple[int, ...]:
+    """Forward-map a flax param shape to its torch state-dict shape —
+    the shape-level mirror of sd_checkpoint._inverse_transform."""
+    s = flax_shape
+    if how == "conv":  # [kh,kw,I,O] → [O,I,kh,kw]
+        return (s[3], s[2], s[0], s[1])
+    if how == "linear":  # [I,O] → [O,I]
+        return (s[1], s[0])
+    if how == "proj":  # dense [I,O]; torch side may be 1x1 conv
+        return (s[1], s[0])  # compared modulo trailing (1, 1)
+    if how == "conv3d_k":  # [kt,kh,kw,I,O] → [O,I,kt,kh,kw]
+        return (s[4], s[3], s[0], s[1], s[2])
+    if how == "gamma3":
+        return (s[0], 1, 1, 1)
+    if how == "gamma2":
+        return (s[0], 1, 1)
+    if how.startswith("conv3d:"):
+        pf, ph, pw, cin = (int(x) for x in how.split(":")[1:])
+        return (s[-1], cin, pf, ph, pw)
+    if how.startswith("qkv"):  # fused in_proj: [I,O] → [3O,I] / [O] → [3O]
+        if how.endswith("_w"):
+            return (3 * s[1], s[0])
+        return (3 * s[0],)
+    return s  # id
+
+
+def _schedule_sd_shapes(
+    entries, model_name: str
+) -> dict[str, tuple[int, ...]]:
+    shapes = _flax_shapes(model_name)
+    out: dict[str, tuple[int, ...]] = {}
+    for sd_key, fx_path, how in sdc._expand(entries):
+        flax_shape = shapes.get(f"params/{fx_path}")
+        assert flax_shape is not None, f"schedule names missing flax param {fx_path}"
+        out[sd_key] = _sd_shape(flax_shape, how)
+    return out
+
+
+def _assert_matches(
+    derived: dict[str, tuple[int, ...]],
+    manifest: dict[str, tuple[int, ...]],
+    proj_conv_keys: bool,
+) -> None:
+    missing = sorted(set(manifest) - set(derived))
+    extra = sorted(set(derived) - set(manifest))
+    assert not missing, f"schedule misses {len(missing)} real keys: {missing[:8]}"
+    assert not extra, f"schedule names {len(extra)} unreal keys: {extra[:8]}"
+    bad = []
+    for key, want in manifest.items():
+        got = derived[key]
+        if got != want:
+            # 'proj' entries are dense on the flax side; SD1.x packs
+            # them as 1x1 convs — identical modulo trailing (1, 1)
+            if proj_conv_keys and want == got + (1, 1):
+                continue
+            bad.append((key, got, want))
+    assert not bad, f"{len(bad)} shape mismatches: {bad[:8]}"
+
+
+# --- SD1.5 -----------------------------------------------------------------
+
+def test_sd15_unet_schedule_matches_manifest():
+    manifest = _manifest("sd15")
+    sub = {k: v for k, v in manifest.items() if k.startswith("model.diffusion_model.")}
+    derived = _schedule_sd_shapes(
+        sdc.unet_schedule(get_config("sd15")), "sd15"
+    )
+    _assert_matches(derived, sub, proj_conv_keys=True)
+
+
+def test_sd15_vae_schedule_matches_manifest():
+    manifest = _manifest("sd15")
+    sub = {k: v for k, v in manifest.items() if k.startswith("first_stage_model.")}
+    derived = _schedule_sd_shapes(sdc.vae_schedule(get_config("vae-sd")), "vae-sd")
+    _assert_matches(derived, sub, proj_conv_keys=True)
+
+
+def test_sd15_text_encoder_schedule_matches_manifest():
+    manifest = _manifest("sd15")
+    sub = {k: v for k, v in manifest.items() if k.startswith("cond_stage_model.")}
+    derived = _schedule_sd_shapes(
+        sdc.text_encoder_schedule(get_config("clip-l")), "clip-l"
+    )
+    _assert_matches(derived, sub, proj_conv_keys=False)
+
+
+# --- SDXL ------------------------------------------------------------------
+
+def test_sdxl_unet_schedule_matches_manifest():
+    manifest = _manifest("sdxl")
+    sub = {k: v for k, v in manifest.items() if k.startswith("model.diffusion_model.")}
+    derived = _schedule_sd_shapes(sdc.unet_schedule(get_config("sdxl")), "sdxl")
+    _assert_matches(derived, sub, proj_conv_keys=True)
+
+
+def test_sdxl_clip_l_schedule_matches_manifest():
+    manifest = _manifest("sdxl")
+    prefix = "conditioner.embedders.0.transformer.text_model"
+    sub = {k: v for k, v in manifest.items() if k.startswith(prefix)}
+    derived = _schedule_sd_shapes(
+        sdc.text_encoder_schedule(get_config("clip-l-sdxl"), prefix=prefix),
+        "clip-l-sdxl",
+    )
+    _assert_matches(derived, sub, proj_conv_keys=False)
+
+
+def test_sdxl_open_clip_schedule_matches_manifest():
+    manifest = _manifest("sdxl")
+    prefix = "conditioner.embedders.1.model"
+    sub = {k: v for k, v in manifest.items() if k.startswith(prefix)}
+    derived = _schedule_sd_shapes(
+        sdc.open_clip_schedule(get_config("clip-g"), prefix=prefix), "clip-g"
+    )
+    _assert_matches(derived, sub, proj_conv_keys=False)
+
+
+# --- WAN -------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "model_name,manifest_name",
+    [
+        ("wan-1.3b", "wan21_1_3b_dit"),
+        ("wan-14b", "wan21_14b_dit"),
+        ("wan-14b-i2v", "wan21_14b_i2v_dit"),
+    ],
+)
+def test_wan_dit_schedule_matches_manifest(model_name, manifest_name):
+    derived = _schedule_sd_shapes(
+        sdc.wan_schedule(get_config(model_name)), model_name
+    )
+    _assert_matches(derived, _manifest(manifest_name), proj_conv_keys=False)
+
+
+def test_wan_vae_schedule_matches_manifest():
+    derived = _schedule_sd_shapes(
+        sdc.wan_vae_schedule(get_config("wan-vae")), "wan-vae"
+    )
+    _assert_matches(derived, _manifest("wan21_vae"), proj_conv_keys=False)
+
+
+def test_umt5_schedule_matches_manifest():
+    derived = _schedule_sd_shapes(
+        sdc.t5_encoder_schedule(get_config("umt5-xxl")), "umt5-xxl"
+    )
+    _assert_matches(derived, _manifest("umt5_xxl_encoder"), proj_conv_keys=False)
+
+
+# --- hand-pinned anchors ---------------------------------------------------
+
+# Strategic keys with shapes as published by checkpoint inspectors —
+# typed in by hand, NOT generated, so a shared bug between the
+# generator and the schedules still fails here.
+HAND_PINNED = {
+    "sd15": {
+        "model.diffusion_model.input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight": (320, 768),
+        "model.diffusion_model.input_blocks.0.0.weight": (320, 4, 3, 3),
+        "model.diffusion_model.middle_block.1.proj_in.weight": (1280, 1280, 1, 1),
+        "model.diffusion_model.output_blocks.2.1.conv.weight": (1280, 1280, 3, 3),
+        "model.diffusion_model.out.2.weight": (4, 320, 3, 3),
+        "first_stage_model.encoder.mid.attn_1.q.weight": (512, 512, 1, 1),
+        "first_stage_model.decoder.up.1.upsample.conv.weight": (256, 256, 3, 3),
+        "first_stage_model.post_quant_conv.weight": (4, 4, 1, 1),
+        "cond_stage_model.transformer.text_model.embeddings.token_embedding.weight": (49408, 768),
+        "cond_stage_model.transformer.text_model.encoder.layers.11.mlp.fc1.weight": (3072, 768),
+    },
+    "sdxl": {
+        "model.diffusion_model.label_emb.0.0.weight": (1280, 2816),
+        "model.diffusion_model.input_blocks.4.1.proj_in.weight": (640, 640),
+        "model.diffusion_model.input_blocks.7.1.transformer_blocks.9.attn2.to_k.weight": (1280, 2048),
+        "model.diffusion_model.middle_block.1.transformer_blocks.0.ff.net.0.proj.weight": (10240, 1280),
+        "model.diffusion_model.output_blocks.5.2.conv.weight": (640, 640, 3, 3),
+        "conditioner.embedders.1.model.transformer.resblocks.31.attn.in_proj_weight": (3840, 1280),
+        "conditioner.embedders.1.model.text_projection": (1280, 1280),
+        "conditioner.embedders.1.model.positional_embedding": (77, 1280),
+    },
+    "wan21_1_3b_dit": {
+        "patch_embedding.weight": (1536, 16, 1, 2, 2),
+        "blocks.29.ffn.0.weight": (8960, 1536),
+        "blocks.0.modulation": (1, 6, 1536),
+        "time_projection.1.weight": (9216, 1536),
+        "head.head.weight": (64, 1536),
+        "head.modulation": (1, 2, 1536),
+    },
+    "wan21_14b_i2v_dit": {
+        # MLPProj: Linear(1280, 1280) then Linear(1280, 5120)
+        "img_emb.proj.1.weight": (1280, 1280),
+        "img_emb.proj.3.weight": (5120, 1280),
+        "img_emb.proj.4.weight": (5120,),
+        "blocks.0.cross_attn.k_img.weight": (5120, 5120),
+        "patch_embedding.weight": (5120, 36, 1, 2, 2),
+    },
+    "wan21_vae": {
+        "encoder.conv1.weight": (96, 3, 3, 3, 3),
+        "encoder.downsamples.5.time_conv.weight": (192, 192, 3, 1, 1),
+        "decoder.upsamples.3.time_conv.weight": (768, 384, 3, 1, 1),
+        "decoder.upsamples.11.resample.1.weight": (96, 192, 3, 3),
+        "conv2.weight": (16, 16, 1, 1, 1),
+        "decoder.head.2.weight": (3, 96, 3, 3, 3),
+    },
+    "umt5_xxl_encoder": {
+        "shared.weight": (256384, 4096),
+        "encoder.block.23.layer.0.SelfAttention.relative_attention_bias.weight": (32, 64),
+        "encoder.block.0.layer.1.DenseReluDense.wi_0.weight": (10240, 4096),
+    },
+}
+
+
+@pytest.mark.parametrize("name", sorted(HAND_PINNED))
+def test_manifests_contain_hand_pinned_published_shapes(name):
+    manifest = _manifest(name)
+    for key, shape in HAND_PINNED[name].items():
+        assert key in manifest, f"manifest {name} lacks published key {key}"
+        assert manifest[key] == shape, (key, manifest[key], shape)
+
+
+def test_deliberate_rename_fails():
+    """The guarantee the round-trip tests lack: a one-key rename in a
+    schedule must fail the manifest comparison."""
+    entries = sdc.wan_vae_schedule(get_config("wan-vae"))
+    renamed = [
+        ("encoder.conv1_RENAMED", fx, kind) if sd == "encoder.conv1" else (sd, fx, kind)
+        for sd, fx, kind in entries
+    ]
+    derived = _schedule_sd_shapes(renamed, "wan-vae")
+    with pytest.raises(AssertionError):
+        _assert_matches(derived, _manifest("wan21_vae"), proj_conv_keys=False)
