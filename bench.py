@@ -19,9 +19,22 @@ Honesty rules (round-1 verdict items):
   `cpu_fallback`) so a red TPU can't read as a perf datum;
   `fallback: true` accompanies any CPU-tiny number.
 
+Round-3 verdict items folded in:
+- probe child stderr/stdout tails are PERSISTED into the bench JSON
+  (`probe` key) so a red chip produces evidence, not silence;
+- the probe retries on a timeout ladder (BENCH_PROBE_TIMEOUT, then
+  3x it — hosted-plugin cold init can legitimately exceed 10 min);
+- when the virtual scaling mesh has fewer physical cores than devices,
+  `vs_baseline` is null with a `scaling_note` (time-slicing one core
+  can only show overhead, not scaling);
+- `flash_compiled` records whether the Pallas flash kernel
+  lowers+compiles on the real accelerator backend;
+- BENCH_METRIC=video measures WAN t2v frames/sec/chip (+ seed-parallel
+  scaling), making BASELINE.md's video rows measurable.
+
 Env knobs: BENCH_TINY=1 (small model/shapes), BENCH_CPU=1 (force CPU),
-BENCH_METRIC=usdu|txt2img, BENCH_PROBE_TIMEOUT (s, <=0 skips probe),
-BENCH_SCALING_TIMEOUT (s for the virtual-mesh subprocess).
+BENCH_METRIC=usdu|txt2img|video, BENCH_PROBE_TIMEOUT (s, <=0 skips
+probe), BENCH_SCALING_TIMEOUT (s for the virtual-mesh subprocess).
 """
 
 from __future__ import annotations
@@ -67,18 +80,73 @@ def _cost_flops(jitted, *args) -> float | None:
         return None
 
 
-def _probe_accelerator(timeout_s: float) -> str:
+# Probe attempts (status + diagnostics tails) for the final JSON —
+# the forensic record a red chip must leave behind.
+_PROBE_ATTEMPTS: list[dict] = []
+
+_PROBE_CODE = (
+    "import jax, logging; logging.basicConfig(level=logging.INFO); "
+    "ds = jax.devices(); "
+    "print('probe-ok', [(d.platform, d.device_kind) for d in ds], flush=True)"
+)
+
+
+def _decode_tail(raw, limit: int) -> str:
+    if raw is None:
+        return ""
+    if isinstance(raw, bytes):
+        raw = raw.decode(errors="replace")
+    return raw[-limit:].strip()
+
+
+def _probe_accelerator(timeout_s: float) -> tuple[str, str]:
     """Probe backend init in a subprocess: a hung/unreachable TPU
     tunnel would otherwise hang the whole bench (backend init is not
-    interruptible in-process). Returns 'ok' | 'failed' | 'timeout'."""
+    interruptible in-process). Returns ('ok'|'failed'|'timeout',
+    diagnostics-tail) — the child's output is kept, not discarded."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c", _PROBE_CODE],
             timeout=timeout_s, capture_output=True,
         )
-        return "ok" if proc.returncode == 0 and b"ok" in proc.stdout else "failed"
-    except subprocess.TimeoutExpired:
-        return "timeout"
+        diag = (
+            _decode_tail(proc.stdout, 512)
+            + ("\n" if proc.stderr else "")
+            + _decode_tail(proc.stderr, 2048)
+        ).strip()
+        status = (
+            "ok"
+            if proc.returncode == 0 and b"probe-ok" in proc.stdout
+            else "failed"
+        )
+        return status, diag
+    except subprocess.TimeoutExpired as exc:
+        diag = (
+            _decode_tail(exc.stdout, 512)
+            + ("\n" if exc.stderr else "")
+            + _decode_tail(exc.stderr, 2048)
+        ).strip()
+        return "timeout", diag
+
+
+def _probe_ladder(base_timeout: float) -> str:
+    """Retry the probe on a timeout ladder (base, then 3x — hosted
+    plugin cold init can legitimately exceed 10 min). Every attempt's
+    status + diagnostics tail is recorded for the bench JSON."""
+    status = "failed"
+    for i, timeout_s in enumerate((base_timeout, base_timeout * 3)):
+        t0 = time.perf_counter()
+        status, diag = _probe_accelerator(timeout_s)
+        _PROBE_ATTEMPTS.append({
+            "attempt": i + 1,
+            "timeout_s": round(timeout_s, 1),
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "status": status,
+            "diagnostics": diag,
+        })
+        if status == "ok":
+            break
+    return status
 
 
 def _init_jax() -> tuple:
@@ -99,7 +167,7 @@ def _init_jax() -> tuple:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     # probe_timeout <= 0 disables the probe (trusted-healthy host: skip
     # the duplicate backend init the probe subprocess costs)
-    status = "ok" if probe_timeout <= 0 else _probe_accelerator(probe_timeout)
+    status = "ok" if probe_timeout <= 0 else _probe_ladder(probe_timeout)
     if status != "ok":
         _warn_probe_failure(status, probe_timeout)
         os.environ.setdefault("BENCH_TINY", "1")
@@ -247,9 +315,91 @@ def bench_txt2img(jax, tiny: bool) -> dict:
     return result
 
 
+def bench_video(jax, tiny: bool) -> dict:
+    """WAN-class t2v throughput in frames/sec/chip — the video rows of
+    BASELINE.md's config matrix (8-chip ICI, parallel seeds)."""
+    from comfyui_distributed_tpu.models import video_pipeline as vp
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    n_dev = len(jax.devices())
+    model = os.environ.get("BENCH_MODEL") or ("tiny-dit" if tiny else "wan-1.3b")
+    vae = "tiny-video-vae-3d" if tiny else "wan-vae"
+    frames = int(os.environ.get("BENCH_FRAMES") or (5 if tiny else 33))
+    size = int(os.environ.get("BENCH_SRC") or (32 if tiny else 256))
+    steps = int(os.environ.get("BENCH_STEPS") or (2 if tiny else 20))
+    bundle = vp.load_video_pipeline(model, vae_name=vae)
+
+    if n_dev > 1:
+        mesh = build_mesh({"data": n_dev})
+
+        def run(seed):
+            out = vp.t2v_parallel(
+                bundle, mesh, "benchmark", frames=frames, height=size,
+                width=size, steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        rate = _rate(run, frames * n_dev)
+    else:
+        def run(seed):
+            out = vp.t2v(
+                bundle, "benchmark", frames=frames, height=size,
+                width=size, steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        rate = _rate(run, frames)
+
+    result = {
+        "metric": (
+            f"WAN t2v frames/sec/chip ({model}, {frames}f {size}px "
+            f"{steps} steps, {n_dev} chip(s))"
+        ),
+        "value": round(rate / n_dev, 4),
+        "unit": "frames/sec/chip",
+        "vs_baseline": None,
+        "scaling_source": None,
+        "mfu": None,
+    }
+    if n_dev > 1:
+        def run_single(seed):
+            out = vp.t2v(
+                bundle, "benchmark", frames=frames, height=size,
+                width=size, steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        single_rate = _rate(run_single, frames)
+        result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
+        result["scaling_source"] = f"measured_{n_dev}chip"
+    return result
+
+
+def _flash_compile_check(jax) -> dict | None:
+    """Lower + compile the Pallas flash kernel for the active backend
+    (accelerators only — CPU runs it in interpret mode by design).
+    Records pass/fail + the compiler's error tail in the bench JSON."""
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        return None
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.ops.attention import flash_attention
+
+    try:
+        q = jnp.zeros((1, 256, 4, 64), jnp.bfloat16)
+        flash_attention.lower(q, q, q).compile()
+        return {"flash_compiled": True}
+    except Exception as exc:  # noqa: BLE001 - recorded, not raised
+        return {
+            "flash_compiled": False,
+            "flash_error": f"{type(exc).__name__}: {exc}"[-600:],
+        }
+
+
 def _virtual8_scaling() -> dict:
-    """Child mode: tiny USDU on an 8-device virtual CPU mesh vs one
-    device; prints {"scaling": r, "n_cores": c}."""
+    """Child mode: tiny USDU (or t2v, per BENCH_METRIC) on an 8-device
+    virtual CPU mesh vs one device; prints {"scaling": r, "n_cores": c}."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -261,6 +411,36 @@ def _virtual8_scaling() -> dict:
     from comfyui_distributed_tpu.parallel import build_mesh
 
     n_dev = len(jax.devices())
+
+    if os.environ.get("BENCH_METRIC") == "video":
+        from comfyui_distributed_tpu.models import video_pipeline as vp
+
+        bundle = vp.load_video_pipeline("tiny-dit", vae_name="tiny-video-vae-3d")
+        mesh = build_mesh({"data": n_dev})
+        frames, size, steps = 5, 32, 2
+
+        def run_multi(seed):
+            out = vp.t2v_parallel(
+                bundle, mesh, "benchmark", frames=frames, height=size,
+                width=size, steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        def run_single(seed):
+            out = vp.t2v(
+                bundle, "benchmark", frames=frames, height=size,
+                width=size, steps=steps, seed=seed,
+            )
+            jax.block_until_ready(out)
+
+        multi = _rate(run_multi, frames * n_dev)
+        single = _rate(run_single, frames)
+        print(json.dumps({
+            "scaling": round(multi / max(single, 1e-9), 3),
+            "n_devices": n_dev,
+            "n_cores": os.cpu_count(),
+        }))
+        return
     bundle = pl.load_pipeline("tiny-unet", seed=0)
     src, tile_px, padding, steps = 64, 64, 16, 2
     img = jnp.linspace(0, 1, src * src * 3).reshape(1, src, src, 3).astype(jnp.float32)
@@ -346,6 +526,10 @@ def _measure_virtual8_scaling() -> dict | None:
     timeout_s = float(os.environ.get("BENCH_SCALING_TIMEOUT", 900))
     if timeout_s <= 0:
         return None
+    n_cores = os.cpu_count() or 0
+    if n_cores < 8:
+        # don't burn minutes measuring a number main() would null out
+        return {"scaling": None, "n_devices": 8, "n_cores": n_cores}
     extra = {"BENCH_MODE": "virtual8", "JAX_PLATFORMS": "cpu"}
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -378,7 +562,7 @@ def main() -> None:
             probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
             status = (
                 "ok" if probe_timeout <= 0
-                else _probe_accelerator(probe_timeout)
+                else _probe_ladder(probe_timeout)
             )
         if status == "ok":
             # children must not re-probe: the parent just did
@@ -388,17 +572,29 @@ def main() -> None:
             st2 = None
             if result is None:
                 budget2 = float(os.environ.get("BENCH_BUDGET2_S", 1200))
-                reduced = dict(
-                    child_base,
-                    BENCH_MODEL="sd15", BENCH_SRC="512", BENCH_STEPS="8",
-                ) if os.environ.get("BENCH_METRIC", "usdu") == "usdu" else dict(
-                    child_base, BENCH_MODEL="sd15", BENCH_SRC="256",
-                    BENCH_STEPS="8",
-                )
+                metric = os.environ.get("BENCH_METRIC", "usdu")
+                if metric == "usdu":
+                    reduced = dict(
+                        child_base,
+                        BENCH_MODEL="sd15", BENCH_SRC="512", BENCH_STEPS="8",
+                    )
+                elif metric == "video":
+                    reduced = dict(
+                        child_base,
+                        BENCH_MODEL="wan-1.3b", BENCH_SRC="128",
+                        BENCH_FRAMES="9", BENCH_STEPS="4",
+                    )
+                else:
+                    reduced = dict(
+                        child_base, BENCH_MODEL="sd15", BENCH_SRC="256",
+                        BENCH_STEPS="8",
+                    )
                 result, st2 = _run_child(reduced, budget2)
                 if result is not None:
                     result["attempt"] = "reduced_budget"
             if result is not None:
+                if _PROBE_ATTEMPTS:
+                    result["probe"] = _PROBE_ATTEMPTS
                 print(json.dumps(result))
                 return
             # both accelerator attempts died: tiny CPU run, explicitly
@@ -416,7 +612,12 @@ def main() -> None:
     jax, environment = _init_jax()
     tiny = os.environ.get("BENCH_TINY") == "1"
     which = os.environ.get("BENCH_METRIC", "usdu")
-    bench = bench_usdu if which == "usdu" else bench_txt2img
+    bench = {
+        "usdu": bench_usdu,
+        "txt2img": bench_txt2img,
+        "video": bench_video,
+    }.get(which, bench_usdu)
+    flash_info = _flash_compile_check(jax) if environment == "accelerator" else None
     try:
         result = bench(jax, tiny)
     except Exception as exc:
@@ -435,6 +636,8 @@ def main() -> None:
 
     result["environment"] = environment
     result["fallback"] = environment == "cpu_fallback"
+    if flash_info:
+        result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):
         result["attempt"] = os.environ["BENCH_ATTEMPT"]
     if result.get("vs_baseline") is None:
@@ -442,10 +645,21 @@ def main() -> None:
         # CPU mesh so the factor is a real multi-device measurement
         scaling = _measure_virtual8_scaling()
         if scaling:
-            result["vs_baseline"] = scaling["scaling"]
-            result["scaling_source"] = (
-                f"virtual8_cpu_mesh({scaling.get('n_cores')}core)"
-            )
+            n_cores = scaling.get("n_cores") or 0
+            n_mesh = scaling.get("n_devices", 8)
+            if n_cores < n_mesh:
+                # time-slicing a wide mesh onto fewer cores can only
+                # show overhead — report no number rather than a
+                # misleading one (round-2 verdict item 6)
+                result["scaling_note"] = (
+                    f"virtual {n_mesh}-device mesh on {n_cores} physical "
+                    "core(s): scaling not measurable"
+                )
+            else:
+                result["vs_baseline"] = scaling["scaling"]
+                result["scaling_source"] = f"virtual8_cpu_mesh({n_cores}core)"
+    if _PROBE_ATTEMPTS:
+        result["probe"] = _PROBE_ATTEMPTS
     print(json.dumps(result))
 
 

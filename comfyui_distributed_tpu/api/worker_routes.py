@@ -28,6 +28,9 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/worker_ws", routes.worker_ws)
     app.router.add_post("/distributed/launch_worker", routes.launch_worker)
     app.router.add_post("/distributed/stop_worker", routes.stop_worker)
+    app.router.add_post(
+        "/distributed/worker/clear_launching", routes.clear_launching
+    )
     app.router.add_get("/distributed/managed", routes.managed)
     app.router.add_get("/distributed/worker_log/{name}", routes.worker_log)
     app.router.add_get("/distributed/master_log", routes.master_log)
@@ -126,6 +129,33 @@ class WorkerRoutes:
             manager.stop_worker, worker_id, self.server.config_path
         )
         return web.json_response({"status": "ok", "stopped": stopped})
+
+    async def clear_launching(self, request: web.Request) -> web.Response:
+        """Clear a managed worker's 'launching' marker once it is
+        confirmed up (reference api/worker_routes.py
+        /distributed/worker/clear_launching) so a crashed launch
+        cannot wedge the panel's grace state."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        worker_id = str(body.get("worker_id", "")).strip()
+        if not worker_id:
+            return web.json_response({"error": "worker_id required"}, status=400)
+        known = any(
+            str(w.get("id")) == worker_id
+            for w in self.server.config.get("workers", [])
+        )
+        if not known:
+            return web.json_response({"error": "no such worker"}, status=404)
+        from ..workers import get_worker_manager
+
+        cleared = await _run_blocking(
+            get_worker_manager().clear_launching,
+            worker_id,
+            self.server.config_path,
+        )
+        return web.json_response({"status": "success", "cleared": cleared})
 
     async def managed(self, request: web.Request) -> web.Response:
         from ..workers import get_worker_manager

@@ -19,8 +19,11 @@ Vocab files: standard CLIP pair ``vocab.json`` + ``merges.txt``
 (gzipped variants supported). The committed fallback pair under
 ``models/assets/clip_vocab/`` has CLIP's exact id layout (512 byte
 units, 48894 merges, BOS=49406, EOS=49407) but merges trained on
-build-host prose — dropping in OpenAI's real files (same format) via
-``CDT_CLIP_VOCAB`` gives exact CLIP ids with no code change.
+build-host prose (this build environment has no network egress, so the
+real table cannot be fetched from here). ``scripts/fetch_clip_vocab.py``
+installs OpenAI's published table (pinned hash + canonical-token-id
+validation) in one command; ``ClipBPE.is_canonical`` reports which pair
+is active and ``get_bpe`` warns loudly when serving the stand-in.
 """
 
 from __future__ import annotations
@@ -169,6 +172,22 @@ class ClipBPE:
         self._cache[token] = result
         return result
 
+    @functools.cached_property
+    def is_canonical(self) -> bool:
+        """True when this vocab behaves as OpenAI's published CLIP
+        vocabulary — checked against token ids from the official CLIP
+        notebook (`tokenize("hello world!")` → 3306/1002/256). The
+        committed prose-trained stand-in reports False; the operator
+        installs the real table via scripts/fetch_clip_vocab.py."""
+        try:
+            return (
+                self.encode_text("hello world!") == [3306, 1002, 256]
+                and self.encode_text("a photo of a cat")
+                == [320, 1125, 539, 320, 2368]
+            )
+        except Exception:
+            return False
+
     def encode_text(self, text: str) -> list[int]:
         """Text → BPE ids (no specials, no padding)."""
         ids: list[int] = []
@@ -191,7 +210,19 @@ class ClipBPE:
 
 @functools.lru_cache(maxsize=4)
 def _get_bpe_cached(vocab_dir: str) -> ClipBPE:
-    return ClipBPE(vocab_dir)
+    bpe = ClipBPE(vocab_dir)
+    if not bpe.is_canonical:
+        import logging
+
+        logging.getLogger("cdt.clip_bpe").warning(
+            "CLIP vocab at %s is NOT OpenAI's published table (canonical "
+            "token-id check failed): real SD/SDXL checkpoints will "
+            "receive wrong token ids and produce wrong images. Install "
+            "the exact vocab with scripts/fetch_clip_vocab.py or point "
+            "CDT_CLIP_VOCAB at OpenAI's vocab.json/merges.txt pair.",
+            vocab_dir,
+        )
+    return bpe
 
 
 def get_bpe(vocab_dir: str | None = None) -> ClipBPE:

@@ -85,7 +85,15 @@ async function refreshStatus() {
       const probe = await probeWorker(w);
       const launching =
         prev.launchingSince && Date.now() - prev.launchingSince < LAUNCH_GRACE_MS;
-      if (probe.online) prev.launchingSince = null;
+      if (probe.online && prev.launchingSince) {
+        prev.launchingSince = null;
+        // tell the server the launch completed so the persisted
+        // 'launching' marker can't wedge a later grace window
+        api("/distributed/worker/clear_launching", {
+          method: "POST",
+          body: JSON.stringify({ worker_id: w.id }),
+        }).catch(() => {});
+      }
       state.workerStatus.set(w.id, { ...prev, ...probe, launching: launching && !probe.online });
       if (probe.online && probe.queueRemaining > 0) state.anythingBusy = true;
     })

@@ -178,8 +178,26 @@ class WorkerProcessManager:
         config.setdefault("managed_processes", {})[worker_id] = {
             "pid": pid,
             "started_at": time.time(),
+            # cleared via clear_launching once the worker is confirmed
+            # up; a crashed launch otherwise leaves the flag for the
+            # panel's grace-window logic to expire
+            "launching": True,
         }
         config_mod.save_config(config, config_path)
+
+    def clear_launching(
+        self, worker_id: str, config_path: str | None = None
+    ) -> bool:
+        """Drop the 'launching' marker once the worker is confirmed
+        running (reference api/worker_routes.py clear_launching_state);
+        returns whether a marker was cleared."""
+        config = config_mod.load_config(config_path)
+        entry = config.get("managed_processes", {}).get(worker_id)
+        if entry is None or "launching" not in entry:
+            return False
+        del entry["launching"]
+        config_mod.save_config(config, config_path)
+        return True
 
     def _unpersist(self, worker_id: str, config_path: str | None) -> None:
         config = config_mod.load_config(config_path)

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Fetch and install OpenAI's real CLIP BPE vocabulary.
+
+The committed fallback under models/assets/clip_vocab/ reproduces
+CLIP's exact id *layout* (512 byte units, 48894 merge slots, BOS 49406,
+EOS 49407) but its merge table was trained on build-host prose: real SD
+checkpoints need OpenAI's published merges to receive the token ids
+they were trained with.  The build environment for this repo has no
+network egress, so the real table cannot be committed from here; this
+script is the operator's one-command path to exact-CLIP tokenization.
+
+Sources (either works; both carry the identical table):
+  - openai/CLIP's `bpe_simple_vocab_16e6.txt.gz` (GitHub), converted to
+    the standard vocab.json + merges.txt pair with CLIP's own
+    construction rule, or
+  - HuggingFace `openai/clip-vit-base-patch32` `vocab.json`/`merges.txt`
+    (already in the target format).
+
+A local copy can be installed with --from-bpe/--from-vocab-dir for
+air-gapped hosts.
+
+The installed pair is verified SEMANTICALLY before being accepted:
+canonical prompts must produce the published CLIP token ids (e.g.
+`tokenize("hello world!")` → [49406, 3306, 1002, 256, 49407] in the
+official CLIP notebook).  This is a stronger guarantee than a file
+hash — any file that passes is, behaviorally, the CLIP vocabulary.
+The known sha256 of the official txt.gz is additionally checked when
+fetching from GitHub (skip with --no-verify-hash if OpenAI re-uploads).
+
+Usage:
+    python scripts/fetch_clip_vocab.py              # fetch + install
+    python scripts/fetch_clip_vocab.py --from-bpe /path/bpe_simple_vocab_16e6.txt.gz
+    python scripts/fetch_clip_vocab.py --from-vocab-dir /path/with/vocab.json+merges.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from comfyui_distributed_tpu.models.clip_bpe import (  # noqa: E402
+    _MAX_MERGES,
+    ClipBPE,
+    bytes_to_unicode,
+)
+
+ASSET_DIR = os.path.join(
+    REPO, "comfyui_distributed_tpu", "models", "assets", "clip_vocab"
+)
+
+BPE_URL = "https://github.com/openai/CLIP/raw/main/clip/bpe_simple_vocab_16e6.txt.gz"
+# sha256 of the official file as distributed by openai/CLIP
+BPE_SHA256 = "924691ac288e54409236115652ad4aa250f48203de50a9e4722a6ecd48d6804a"
+HF_BASE = "https://huggingface.co/openai/clip-vit-base-patch32/resolve/main"
+
+# Published CLIP token ids (official CLIP notebook / transformers docs);
+# the gate a candidate vocab must pass before installation.
+CANONICAL_IDS = {
+    "hello world!": [49406, 3306, 1002, 256, 49407],
+    "a photo of a cat": [49406, 320, 1125, 539, 320, 2368, 49407],
+    "a photo of a dog": [49406, 320, 1125, 539, 320, 1929, 49407],
+}
+
+
+def convert_bpe_txt(raw: bytes) -> tuple[dict[str, int], list[str]]:
+    """openai/CLIP `bpe_simple_vocab_16e6.txt.gz` bytes → (vocab dict,
+    merge lines).  Reproduces the construction in CLIP's
+    SimpleTokenizer.__init__: 256 byte units, their `</w>` variants,
+    one token per merge (capped at 48894), then the two specials."""
+    text = gzip.decompress(raw).decode("utf-8")
+    lines = text.split("\n")
+    merge_lines = [ln for ln in lines[1 : _MAX_MERGES + 1] if ln.strip()]
+    units = list(bytes_to_unicode().values())
+    tokens = units + [u + "</w>" for u in units]
+    for ln in merge_lines:
+        tokens.append("".join(ln.split()))
+    tokens += ["<|startoftext|>", "<|endoftext|>"]
+    vocab = {tok: i for i, tok in enumerate(tokens)}
+    if len(vocab) != len(tokens):
+        raise ValueError("merge table produced duplicate tokens")
+    return vocab, merge_lines
+
+
+def write_pair(vocab: dict[str, int], merges: list[str], out_dir: str) -> None:
+    """Write the standard (gzipped) vocab.json + merges.txt pair."""
+    os.makedirs(out_dir, exist_ok=True)
+    with gzip.open(
+        os.path.join(out_dir, "vocab.json.gz"), "wt", encoding="utf-8"
+    ) as fh:
+        json.dump(vocab, fh, ensure_ascii=False)
+    with gzip.open(
+        os.path.join(out_dir, "merges.txt.gz"), "wt", encoding="utf-8"
+    ) as fh:
+        fh.write("#version: 0.2\n")
+        fh.write("\n".join(merges))
+        fh.write("\n")
+
+
+def validate(vocab_dir: str) -> list[str]:
+    """Return a list of validation failures (empty = behaviorally CLIP)."""
+    bpe = ClipBPE(vocab_dir)
+    problems = []
+    if len(bpe.encoder) != 49408:
+        problems.append(f"vocab size {len(bpe.encoder)} != 49408")
+    if bpe.bos_id != 49406 or bpe.eos_id != 49407:
+        problems.append(f"specials at {bpe.bos_id}/{bpe.eos_id}, want 49406/49407")
+    for prompt, want in CANONICAL_IDS.items():
+        got = [bpe.bos_id] + bpe.encode_text(prompt) + [bpe.eos_id]
+        if got != want:
+            problems.append(f"{prompt!r}: got {got}, want {want}")
+    return problems
+
+
+def _fetch(url: str) -> bytes:
+    print(f"fetching {url} ...")
+    with urllib.request.urlopen(url, timeout=120) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--from-bpe", help="local bpe_simple_vocab_16e6.txt.gz")
+    ap.add_argument(
+        "--from-vocab-dir", help="local dir with vocab.json[.gz] + merges.txt[.gz]"
+    )
+    ap.add_argument("--source", choices=("github", "hf"), default="github")
+    ap.add_argument("--no-verify-hash", action="store_true")
+    ap.add_argument("--dest", default=ASSET_DIR)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        if args.from_vocab_dir:
+            for name in ("vocab.json", "merges.txt"):
+                src = args.from_vocab_dir
+                for cand in (f"{name}.gz", name):
+                    p = os.path.join(src, cand)
+                    if os.path.exists(p):
+                        shutil.copy(p, os.path.join(tmp, cand))
+                        break
+                else:
+                    print(f"error: {src} lacks {name}[.gz]", file=sys.stderr)
+                    return 1
+        elif args.from_bpe or args.source == "github":
+            raw = (
+                open(args.from_bpe, "rb").read()
+                if args.from_bpe
+                else _fetch(BPE_URL)
+            )
+            digest = hashlib.sha256(raw).hexdigest()
+            if digest != BPE_SHA256:
+                msg = f"sha256 {digest} != pinned {BPE_SHA256}"
+                if args.no_verify_hash or args.from_bpe:
+                    print(f"warning: {msg} (continuing; semantic check gates)")
+                else:
+                    print(f"error: {msg} (--no-verify-hash to override; the "
+                          "semantic id check below still gates installation)",
+                          file=sys.stderr)
+                    return 1
+            vocab, merges = convert_bpe_txt(raw)
+            write_pair(vocab, merges, tmp)
+        else:  # hf
+            for name in ("vocab.json", "merges.txt"):
+                data = _fetch(f"{HF_BASE}/{name}")
+                with open(os.path.join(tmp, name), "wb") as fh:
+                    fh.write(data)
+
+        problems = validate(tmp)
+        if problems:
+            print("candidate vocab FAILED canonical-id validation:",
+                  file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+
+        os.makedirs(args.dest, exist_ok=True)
+        # clear every stale variant first: _open_maybe_gz prefers .gz,
+        # so a leftover stand-in .gz would shadow newly installed
+        # plain files (and vice versa)
+        for name in ("vocab.json", "merges.txt"):
+            for cand in (name, f"{name}.gz"):
+                p = os.path.join(args.dest, cand)
+                if os.path.exists(p):
+                    os.remove(p)
+        for name in os.listdir(tmp):
+            shutil.copy(os.path.join(tmp, name), os.path.join(args.dest, name))
+    print(f"installed exact CLIP vocab into {args.dest}")
+    print("(restart any running servers; get_bpe() caches per-directory)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,85 @@
+"""Worker lifecycle routes: clear_launching endpoint contract
+(reference api/worker_routes.py /distributed/worker/clear_launching —
+the panel's launch-grace escape hatch)."""
+
+import asyncio
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils import config as config_mod
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+from comfyui_distributed_tpu.workers import process_manager as pm
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url: str, payload: dict, timeout=10) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read() or b"{}")
+
+
+@pytest.fixture()
+def master(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    config = config_mod.load_config()
+    config["workers"] = [
+        {
+            "id": "w1", "name": "worker1", "type": "local",
+            "host": "127.0.0.1", "port": _free_port(), "enabled": True,
+            "tpu_chips": [], "extra_args": "",
+        }
+    ]
+    config_mod.save_config(config)
+    server = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(server.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield server, port
+    asyncio.run_coroutine_threadsafe(server.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def test_clear_launching_route(master):
+    _server, port = master
+    base = f"http://127.0.0.1:{port}/distributed/worker/clear_launching"
+
+    # persist a launching marker as launch_worker would
+    pm.get_worker_manager()._persist("w1", 999999, None)
+    assert config_mod.load_config()["managed_processes"]["w1"]["launching"]
+
+    status, body = _post(base, {"worker_id": "w1"})
+    assert status == 200
+    assert body["status"] == "success" and body["cleared"] is True
+    assert (
+        "launching"
+        not in config_mod.load_config()["managed_processes"]["w1"]
+    )
+
+    # idempotent second call
+    status, body = _post(base, {"worker_id": "w1"})
+    assert status == 200 and body["cleared"] is False
+
+    # validation: unknown worker → 404, missing id → 400
+    assert _post(base, {"worker_id": "nope"})[0] == 404
+    assert _post(base, {})[0] == 400

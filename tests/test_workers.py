@@ -72,6 +72,21 @@ def test_launch_and_stop_real_process(tmp_config_path, tmp_path, monkeypatch):
     assert "w2" not in manager.managed_processes()
 
 
+def test_clear_launching_marker(tmp_config_path):
+    """_persist marks a fresh launch; clear_launching drops exactly
+    that marker (reference /distributed/worker/clear_launching) and is
+    idempotent."""
+    manager = pm.WorkerProcessManager()
+    manager._persist("w1", os.getpid(), None)
+    assert manager.managed_processes()["w1"]["launching"] is True
+    assert manager.clear_launching("w1") is True
+    entry = manager.managed_processes()["w1"]
+    assert "launching" not in entry
+    assert entry["pid"] == os.getpid()  # rest of the record intact
+    assert manager.clear_launching("w1") is False  # idempotent
+    assert manager.clear_launching("missing") is False
+
+
 def test_auto_populate_once(tmp_config_path):
     created = startup.auto_populate_workers()
     # 8 virtual chips, chip 0 reserved for the master
