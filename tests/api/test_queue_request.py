@@ -71,7 +71,9 @@ def test_panel_js_references_only_registered_routes():
     }
 
     registered = set()
-    pattern = re.compile(r'add_(?:get|post|delete|put)\("(/distributed/[^"]+)"')
+    # \s* spans newlines: registrations may be wrapped by the formatter
+    # (e.g. add_post(\n    "/distributed/...", handler))
+    pattern = re.compile(r'add_(?:get|post|delete|put)\(\s*"(/distributed/[^"]+)"')
     api_dir = os.path.join(root, "comfyui_distributed_tpu", "api")
     for name in os.listdir(api_dir):
         if name.endswith(".py"):
