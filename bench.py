@@ -1,40 +1,50 @@
 """Benchmark harness.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints one JSON line per landed measurement; the LAST line is the
+round's datum (the driver parses the last JSON line of the tail).
 
 Primary metric: distributed tiled-upscale throughput in tiles/sec/chip
 (the BASELINE.md headline: USDU 4K-upscale tiles/sec/chip), measured by
 running the USDU compute core over all available chips.
 
-Honesty rules (round-1 verdict items):
-- `vs_baseline` is a *measured parallel-scaling factor*. With >1 real
-  chips it is multi-chip rate / single-chip rate on the hardware; with
-  1 chip it is measured on an 8-device virtual CPU mesh in a
-  subprocess (tiny model) and labeled via `scaling_source`. It is
-  null when no scaling measurement succeeded — never a run compared
-  to itself.
-- `mfu` reports model-FLOPs utilization from XLA's cost analysis and
-  the chip's peak bf16 FLOPs (null when the peak is unknown, e.g. CPU).
-- `environment` marks probe failures explicitly (`tpu` vs
-  `cpu_fallback`) so a red TPU can't read as a perf datum;
-  `fallback: true` accompanies any CPU-tiny number.
+Constitutional rule (round-3 verdict item 1): the harness must emit a
+perf datum before any external wall budget can kill it, under ANY chip
+behavior. Orchestration order:
 
-Round-3 verdict items folded in:
-- probe child stderr/stdout tails are PERSISTED into the bench JSON
-  (`probe` key) so a red chip produces evidence, not silence;
-- the probe retries on a timeout ladder (BENCH_PROBE_TIMEOUT, then
-  3x it — hosted-plugin cold init can legitimately exceed 10 min);
-- when the virtual scaling mesh has fewer physical cores than devices,
-  `vs_baseline` is null with a `scaling_note` (time-slicing one core
-  can only show overhead, not scaling);
-- `flash_compiled` records whether the Pallas flash kernel
-  lowers+compiles on the real accelerator backend;
-- BENCH_METRIC=video measures WAN t2v frames/sec/chip (+ seed-parallel
-  scaling), making BASELINE.md's video rows measurable.
+  1. tiny-CPU bench in a budgeted subprocess — its JSON line prints
+     the moment it lands (rounds 1-2 prove it fits any sane budget);
+  2. ONE accelerator probe (default 600 s, no retry ladder — the 3x
+     ladder cost round 3 its entire datum);
+  3. if the probe passes: full-config accelerator child, then a
+     reduced-but-real config if the full one blows its budget;
+  4. virtual-8-CPU-mesh scaling measurement, patched into the best
+     result so single-chip numbers still carry a measured scaling
+     factor — and the enriched line is re-printed.
+
+A global wall clock (BENCH_WALL_S, default 1500 s) is enforced by
+SIGALRM: on expiry the parent kills its children, re-prints the
+best-so-far JSON (or a diagnostic JSON carrying the probe/timeline
+forensics if nothing landed), and exits 0.
+
+Honesty rules (round-1 verdict items, unchanged):
+- `vs_baseline` is a *measured* scaling factor (multi-chip/single-chip
+  on hardware, or tiny model on a virtual 8-device CPU mesh, labeled
+  via `scaling_source`); null when no scaling measurement succeeded.
+- `mfu` is model-FLOPs utilization from XLA cost analysis vs the
+  chip's peak bf16 FLOPs (null when the peak is unknown, e.g. CPU).
+- `environment`/`fallback` mark CPU-tiny numbers explicitly so a red
+  TPU can't read as a perf datum.
+
+Diagnostics: every probe attempt's stdout/stderr tail is persisted
+under `probe`; the phase ledger under `timeline`; bench children print
+phase markers ("bench phase: load|compile|time") to stderr so a child
+killed mid-phase names the phase that blew the budget.
 
 Env knobs: BENCH_TINY=1 (small model/shapes), BENCH_CPU=1 (force CPU),
 BENCH_METRIC=usdu|txt2img|video, BENCH_PROBE_TIMEOUT (s, <=0 skips
-probe), BENCH_SCALING_TIMEOUT (s for the virtual-mesh subprocess).
+probe), BENCH_SCALING_TIMEOUT (s, <=0 skips), BENCH_WALL_S (<=0
+disables the wall clock), BENCH_BUDGET_S / BENCH_BUDGET2_S (full /
+reduced accelerator child caps), BENCH_TINY_BUDGET_S.
 """
 
 from __future__ import annotations
@@ -80,15 +90,26 @@ def _cost_flops(jitted, *args) -> float | None:
         return None
 
 
-# Probe attempts (status + diagnostics tails) for the final JSON —
-# the forensic record a red chip must leave behind.
+# ---------------------------------------------------------------------------
+# Forensics shared with the SIGALRM handler: best result so far, probe
+# attempts, and the phase ledger. A red chip must leave evidence.
+
+_BEST: dict | None = None
 _PROBE_ATTEMPTS: list[dict] = []
+_TIMELINE: list[dict] = []
+_LIVE_CHILDREN: list = []  # Popen objects (own sessions) to kill on expiry
 
 _PROBE_CODE = (
     "import jax, logging; logging.basicConfig(level=logging.INFO); "
     "ds = jax.devices(); "
     "print('probe-ok', [(d.platform, d.device_kind) for d in ds], flush=True)"
 )
+
+
+def _phase(name: str) -> None:
+    """Child-side phase marker: lands in the parent's stderr relay even
+    when the child is killed mid-phase, so a timeout names its phase."""
+    print(f"bench phase: {name}", file=sys.stderr, flush=True)
 
 
 def _decode_tail(raw, limit: int) -> str:
@@ -99,11 +120,15 @@ def _decode_tail(raw, limit: int) -> str:
     return raw[-limit:].strip()
 
 
-def _probe_accelerator(timeout_s: float) -> tuple[str, str]:
-    """Probe backend init in a subprocess: a hung/unreachable TPU
-    tunnel would otherwise hang the whole bench (backend init is not
-    interruptible in-process). Returns ('ok'|'failed'|'timeout',
-    diagnostics-tail) — the child's output is kept, not discarded."""
+def _probe_accelerator(timeout_s: float) -> str:
+    """ONE probe of backend init in a subprocess: a hung/unreachable
+    TPU tunnel would otherwise hang the whole bench (backend init is
+    not interruptible in-process). No retry ladder — a second, longer
+    attempt is exactly what starved round 3 of any datum; a fast
+    deterministic failure would be re-run for no benefit either.
+    Returns 'ok' | 'failed' | 'timeout'; diagnostics are recorded in
+    _PROBE_ATTEMPTS either way."""
+    t0 = time.perf_counter()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],
@@ -119,38 +144,25 @@ def _probe_accelerator(timeout_s: float) -> tuple[str, str]:
             if proc.returncode == 0 and b"probe-ok" in proc.stdout
             else "failed"
         )
-        return status, diag
     except subprocess.TimeoutExpired as exc:
         diag = (
             _decode_tail(exc.stdout, 512)
             + ("\n" if exc.stderr else "")
             + _decode_tail(exc.stderr, 2048)
         ).strip()
-        return "timeout", diag
-
-
-def _probe_ladder(base_timeout: float) -> str:
-    """Retry the probe on a timeout ladder (base, then 3x — hosted
-    plugin cold init can legitimately exceed 10 min). Every attempt's
-    status + diagnostics tail is recorded for the bench JSON."""
-    status = "failed"
-    for i, timeout_s in enumerate((base_timeout, base_timeout * 3)):
-        t0 = time.perf_counter()
-        status, diag = _probe_accelerator(timeout_s)
-        _PROBE_ATTEMPTS.append({
-            "attempt": i + 1,
-            "timeout_s": round(timeout_s, 1),
-            "elapsed_s": round(time.perf_counter() - t0, 1),
-            "status": status,
-            "diagnostics": diag,
-        })
-        if status == "ok":
-            break
+        status = "timeout"
+    _PROBE_ATTEMPTS.append({
+        "timeout_s": round(timeout_s, 1),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+        "status": status,
+        "diagnostics": diag,
+    })
     return status
 
 
 def _init_jax() -> tuple:
-    """Returns (jax, environment_tag)."""
+    """Returns (jax, environment_tag). Used by measurement processes
+    (children, or a direct BENCH_TINY/BENCH_CPU invocation)."""
     import jax
 
     if (
@@ -165,9 +177,9 @@ def _init_jax() -> tuple:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
         return jax, "accelerator"
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
-    # probe_timeout <= 0 disables the probe (trusted-healthy host: skip
-    # the duplicate backend init the probe subprocess costs)
-    status = "ok" if probe_timeout <= 0 else _probe_ladder(probe_timeout)
+    # probe_timeout <= 0 disables the probe (orchestrated children and
+    # trusted-healthy hosts: skip the duplicate backend init it costs)
+    status = "ok" if probe_timeout <= 0 else _probe_accelerator(probe_timeout)
     if status != "ok":
         _warn_probe_failure(status, probe_timeout)
         os.environ.setdefault("BENCH_TINY", "1")
@@ -189,7 +201,9 @@ def _warn_probe_failure(status: str, probe_timeout: float) -> None:
 
 def _rate(fn, n_items: int, iters: int = 3) -> float:
     """items/sec of fn(seed) after one compile call."""
+    _phase("compile")
     fn(0)
+    _phase("time")
     t0 = time.perf_counter()
     for i in range(iters):
         fn(i + 1)
@@ -213,6 +227,7 @@ def bench_usdu(jax, tiny: bool) -> dict:
     padding = 16 if tiny else 32
     steps = int(os.environ.get("BENCH_STEPS") or (2 if tiny else 20))
 
+    _phase(f"load ({model})")
     bundle = pl.load_pipeline(model, seed=0)
     img = jnp.linspace(0, 1, src * src * 3).reshape(1, src, src, 3).astype(jnp.float32)
     pos = pl.encode_text(bundle, ["benchmark"])
@@ -260,6 +275,7 @@ def bench_usdu(jax, tiny: bool) -> dict:
     if peak is not None:
         from comfyui_distributed_tpu.ops.upscale import _jitted_for_flops
 
+        _phase("mfu cost-analysis")
         flops = _jitted_for_flops(bundle, img, pos, neg, mesh, **kwargs)
         if flops:
             result["mfu"] = round(
@@ -281,6 +297,7 @@ def bench_txt2img(jax, tiny: bool) -> dict:
     model = os.environ.get("BENCH_MODEL") or ("tiny-unet" if tiny else "sd15")
     size = int(os.environ.get("BENCH_SRC") or (64 if tiny else 512))
     steps = int(os.environ.get("BENCH_STEPS") or (2 if tiny else 20))
+    _phase(f"load ({model})")
     bundle = pl.load_pipeline(model, seed=0)
     mesh = build_mesh({"data": n_dev})
 
@@ -327,6 +344,7 @@ def bench_video(jax, tiny: bool) -> dict:
     frames = int(os.environ.get("BENCH_FRAMES") or (5 if tiny else 33))
     size = int(os.environ.get("BENCH_SRC") or (32 if tiny else 256))
     steps = int(os.environ.get("BENCH_STEPS") or (2 if tiny else 20))
+    _phase(f"load ({model})")
     bundle = vp.load_video_pipeline(model, vae_name=vae)
 
     if n_dev > 1:
@@ -378,7 +396,11 @@ def bench_video(jax, tiny: bool) -> dict:
 def _flash_compile_check(jax) -> dict | None:
     """Lower + compile the Pallas flash kernel for the active backend
     (accelerators only — CPU runs it in interpret mode by design).
-    Records pass/fail + the compiler's error tail in the bench JSON."""
+    Records pass/fail + the compiler's error tail in the bench JSON.
+    Head dim 128: the serving dispatcher pads head dims to a multiple
+    of 128 before calling flash_attention, so d=64 is a config
+    production never runs (and may trip TPU lane alignment for a
+    spurious verdict)."""
     dev = jax.devices()[0]
     if dev.platform not in ("tpu", "axon"):
         return None
@@ -387,7 +409,7 @@ def _flash_compile_check(jax) -> dict | None:
     from comfyui_distributed_tpu.ops.attention import flash_attention
 
     try:
-        q = jnp.zeros((1, 256, 4, 64), jnp.bfloat16)
+        q = jnp.zeros((1, 256, 4, 128), jnp.bfloat16)
         flash_attention.lower(q, q, q).compile()
         return {"flash_compiled": True}
     except Exception as exc:  # noqa: BLE001 - recorded, not raised
@@ -397,7 +419,7 @@ def _flash_compile_check(jax) -> dict | None:
         }
 
 
-def _virtual8_scaling() -> dict:
+def _virtual8_scaling() -> None:
     """Child mode: tiny USDU (or t2v, per BENCH_METRIC) on an 8-device
     virtual CPU mesh vs one device; prints {"scaling": r, "n_cores": c}."""
     import jax
@@ -488,6 +510,7 @@ def _run_child(
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, start_new_session=True,
     )
+    _LIVE_CHILDREN.append(proc)
     try:
         stdout, stderr = proc.communicate(
             timeout=timeout_s if timeout_s > 0 else None
@@ -498,18 +521,23 @@ def _run_child(
         except ProcessLookupError:
             pass
         # collect whatever the child managed to write — the diagnostics
-        # that explain which phase blew the budget
+        # (including its last "bench phase:" marker) that explain which
+        # phase blew the budget
         stdout, stderr = proc.communicate()
         if stderr:
             sys.stderr.write(stderr)
+            sys.stderr.flush()
         print(
             f"bench child exceeded {timeout_s:.0f}s budget "
             f"(env {extra_env.get('BENCH_MODE', '?')})",
             file=sys.stderr, flush=True,
         )
         return None, "timeout"
+    finally:
+        _LIVE_CHILDREN.remove(proc)
     if stderr:
         sys.stderr.write(stderr)
+        sys.stderr.flush()
     if proc.returncode != 0:
         return None, "error"
     for line in reversed(stdout.strip().splitlines()):
@@ -520,17 +548,20 @@ def _run_child(
     return None, "error"
 
 
-def _measure_virtual8_scaling() -> dict | None:
+def _measure_virtual8_scaling(timeout_s: float) -> dict | None:
     """Parent side: run the virtual-mesh scaling probe in a subprocess
     (needs its own XLA_FLAGS before backend init)."""
-    timeout_s = float(os.environ.get("BENCH_SCALING_TIMEOUT", 900))
     if timeout_s <= 0:
         return None
     n_cores = os.cpu_count() or 0
     if n_cores < 8:
-        # don't burn minutes measuring a number main() would null out
+        # don't burn minutes measuring a number we would null out
         return {"scaling": None, "n_devices": 8, "n_cores": n_cores}
-    extra = {"BENCH_MODE": "virtual8", "JAX_PLATFORMS": "cpu"}
+    extra = {
+        "BENCH_MODE": "virtual8",
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_PROBE_TIMEOUT": "0",
+    }
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         extra["XLA_FLAGS"] = (
@@ -540,74 +571,218 @@ def _measure_virtual8_scaling() -> dict | None:
     return result
 
 
-def main() -> None:
-    if os.environ.get("BENCH_MODE") == "virtual8":
-        _virtual8_scaling()
+def _apply_scaling(result: dict, scaling: dict | None) -> None:
+    """Patch a virtual-mesh scaling measurement into a single-chip (or
+    CPU-tiny) result, honoring the cores-vs-devices honesty rule."""
+    if not scaling or result.get("vs_baseline") is not None:
         return
+    n_cores = scaling.get("n_cores") or 0
+    n_mesh = scaling.get("n_devices", 8)
+    if n_cores < n_mesh or scaling.get("scaling") is None:
+        # time-slicing a wide mesh onto fewer cores can only show
+        # overhead — report no number rather than a misleading one
+        result["scaling_note"] = (
+            f"virtual {n_mesh}-device mesh on {n_cores} physical "
+            "core(s): scaling not measurable"
+        )
+    else:
+        result["vs_baseline"] = scaling["scaling"]
+        result["scaling_source"] = f"virtual8_cpu_mesh({n_cores}core)"
 
-    # Budget ladder (parent only, accelerator only): full config, then
-    # a reduced-but-real config, then the tiny CPU fallback. Keeps one
-    # slow compile phase from turning the whole bench into rc=124.
-    if (
-        os.environ.get("BENCH_MODE") != "child"
-        and os.environ.get("BENCH_CPU") != "1"
-        and os.environ.get("BENCH_TINY") != "1"
-    ):
-        if os.environ.get("BENCH_PLATFORM"):
-            # explicit platform override: the children will run on that
-            # platform, so probing the default backend is meaningless
-            status = "ok"
-            probe_timeout = 0.0
+
+def _emit(result: dict) -> None:
+    """Print a datum line and remember it as best-so-far. The driver
+    parses the LAST JSON line, so later (better/enriched) lines win."""
+    global _BEST
+    out = dict(result)
+    if _PROBE_ATTEMPTS:
+        out["probe"] = _PROBE_ATTEMPTS
+    if _TIMELINE:
+        out["timeline"] = list(_TIMELINE)
+    _BEST = out
+    print(json.dumps(out), flush=True)
+
+
+def _install_wall_clock() -> float:
+    """SIGALRM backstop: whatever state the bench is in when the wall
+    budget expires, kill the children and leave a parseable JSON line
+    (best-so-far, or a forensic diagnostic if nothing landed)."""
+    import signal
+
+    wall = float(os.environ.get("BENCH_WALL_S", 1500))
+    if wall <= 0:
+        return float("inf")
+
+    def _on_alarm(signum, frame):
+        for proc in list(_LIVE_CHILDREN):
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+        _TIMELINE.append({"phase": "wall_expired", "at_s": round(wall, 1)})
+        if _BEST is not None:
+            out = dict(_BEST, wall_exceeded=True, timeline=list(_TIMELINE))
+            if _PROBE_ATTEMPTS:
+                # probe attempts recorded after the last _emit would
+                # otherwise vanish from the final line
+                out["probe"] = _PROBE_ATTEMPTS
         else:
-            probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
-            status = (
-                "ok" if probe_timeout <= 0
-                else _probe_ladder(probe_timeout)
+            out = {
+                "metric": "bench wall budget exceeded before any datum",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "wall_exceeded": True,
+                "probe": _PROBE_ATTEMPTS,
+                "timeline": list(_TIMELINE),
+            }
+        print(json.dumps(out), flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(int(wall))
+    return wall
+
+
+def _orchestrate() -> None:
+    """Parent flow: guaranteed tiny datum -> one probe -> accelerator
+    children -> scaling enrichment, all inside the wall clock."""
+    wall = _install_wall_clock()
+    t0 = time.perf_counter()
+
+    def remaining() -> float:
+        return wall - (time.perf_counter() - t0)
+
+    def record(phase: str, status: str) -> None:
+        _TIMELINE.append({
+            "phase": phase,
+            "status": status,
+            "at_s": round(time.perf_counter() - t0, 1),
+        })
+
+    # -- Phase 1: tiny-CPU datum, printed the moment it lands ---------
+    tiny_budget = float(os.environ.get("BENCH_TINY_BUDGET_S", 420))
+    child_common = {
+        "BENCH_MODE": "child",
+        "BENCH_PROBE_TIMEOUT": "0",    # the parent owns probing
+        "BENCH_SCALING_TIMEOUT": "0",  # the parent owns scaling
+    }
+    tiny_result, status = _run_child(
+        dict(child_common, BENCH_CPU="1", BENCH_TINY="1",
+             BENCH_ATTEMPT="tiny_cpu_first"),
+        min(tiny_budget, max(remaining() - 60, 60)),
+    )
+    record("tiny_cpu", status)
+    if tiny_result is not None:
+        _emit(tiny_result)
+
+    # -- Phase 2: ONE accelerator probe -------------------------------
+    best_accel: dict | None = None
+    if os.environ.get("BENCH_PLATFORM"):
+        probe_status = "ok"  # children will run the forced platform
+        record("probe", "skipped_platform_override")
+    else:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
+        probe_timeout = min(probe_timeout, max(remaining() - 120, 30))
+        if probe_timeout <= 0:
+            probe_status = "ok"
+            record("probe", "skipped_by_env")
+        else:
+            probe_status = _probe_accelerator(probe_timeout)
+            record("probe", probe_status)
+
+    # -- Phase 3: accelerator children (full, then reduced) -----------
+    scaling_reserve = 360 if (os.cpu_count() or 0) >= 8 else 30
+    child_statuses: list[str] = []
+    if probe_status == "ok":
+        budget = min(
+            float(os.environ.get("BENCH_BUDGET_S", 2400)),
+            remaining() - scaling_reserve,
+        )
+        if budget > 120:
+            best_accel, st = _run_child(dict(child_common), budget)
+            child_statuses.append(st)
+            record("accelerator_full", st)
+        if best_accel is None:
+            budget2 = min(
+                float(os.environ.get("BENCH_BUDGET2_S", 1200)),
+                remaining() - scaling_reserve,
             )
-        if status == "ok":
-            # children must not re-probe: the parent just did
-            child_base = {"BENCH_MODE": "child", "BENCH_PROBE_TIMEOUT": "0"}
-            budget = float(os.environ.get("BENCH_BUDGET_S", 2400))
-            result, st1 = _run_child(dict(child_base), budget)
-            st2 = None
-            if result is None:
-                budget2 = float(os.environ.get("BENCH_BUDGET2_S", 1200))
+            if budget2 > 120:
                 metric = os.environ.get("BENCH_METRIC", "usdu")
                 if metric == "usdu":
                     reduced = dict(
-                        child_base,
+                        child_common,
                         BENCH_MODEL="sd15", BENCH_SRC="512", BENCH_STEPS="8",
                     )
                 elif metric == "video":
                     reduced = dict(
-                        child_base,
+                        child_common,
                         BENCH_MODEL="wan-1.3b", BENCH_SRC="128",
                         BENCH_FRAMES="9", BENCH_STEPS="4",
                     )
                 else:
                     reduced = dict(
-                        child_base, BENCH_MODEL="sd15", BENCH_SRC="256",
+                        child_common, BENCH_MODEL="sd15", BENCH_SRC="256",
                         BENCH_STEPS="8",
                     )
-                result, st2 = _run_child(reduced, budget2)
-                if result is not None:
-                    result["attempt"] = "reduced_budget"
-            if result is not None:
-                if _PROBE_ATTEMPTS:
-                    result["probe"] = _PROBE_ATTEMPTS
-                print(json.dumps(result))
-                return
-            # both accelerator attempts died: tiny CPU run, explicitly
-            # marked with how they died (budget vs crash)
-            how = "crashed" if "error" in (st1, st2) else "budget_exceeded"
-            os.environ["BENCH_TINY"] = "1"
-            os.environ["BENCH_CPU"] = "1"
-            os.environ["BENCH_ATTEMPT"] = f"tiny_cpu_child_{how}"
-        else:
-            _warn_probe_failure(status, probe_timeout)
-            os.environ["BENCH_TINY"] = "1"
-            os.environ["BENCH_CPU"] = "1"
-            os.environ["BENCH_ATTEMPT"] = "tiny_cpu_probe_failed"
+                best_accel, st = _run_child(reduced, budget2)
+                child_statuses.append(st)
+                record("accelerator_reduced", st)
+                if best_accel is not None:
+                    best_accel["attempt"] = "reduced_budget"
+        if best_accel is not None:
+            _emit(best_accel)
+        elif tiny_result is not None:
+            if not child_statuses:
+                how = "no_accel_budget"  # gates closed; no child ran
+            elif "error" in child_statuses:
+                how = "child_crashed"
+            else:
+                how = "child_budget_exceeded"
+            tiny_result["attempt"] = f"tiny_cpu_{how}"
+    else:
+        _warn_probe_failure(
+            probe_status, _PROBE_ATTEMPTS[-1]["timeout_s"] if _PROBE_ATTEMPTS else 0
+        )
+        if tiny_result is not None:
+            tiny_result["attempt"] = "tiny_cpu_probe_" + probe_status
+
+    # -- Phase 4: scaling enrichment (virtual 8-device CPU mesh) ------
+    target = best_accel if best_accel is not None else tiny_result
+    if target is not None and target.get("vs_baseline") is None:
+        scaling_budget = min(
+            float(os.environ.get("BENCH_SCALING_TIMEOUT", 900)),
+            remaining() - 30,
+        )
+        scaling = _measure_virtual8_scaling(scaling_budget)
+        record("virtual8_scaling", "ok" if scaling else "none")
+        _apply_scaling(target, scaling)
+        _emit(target)
+    if _BEST is None:
+        # every phase died: leave the forensics as a parseable line
+        _emit({
+            "metric": "no bench phase produced a datum",
+            "value": None,
+            "unit": None,
+            "vs_baseline": None,
+        })
+
+
+def main() -> None:
+    if os.environ.get("BENCH_MODE") == "virtual8":
+        _virtual8_scaling()
+        return
+
+    # Orchestrate (parent only): children and explicit BENCH_CPU/TINY
+    # invocations fall through to the direct measurement path below.
+    if (
+        os.environ.get("BENCH_MODE") != "child"
+        and os.environ.get("BENCH_CPU") != "1"
+        and os.environ.get("BENCH_TINY") != "1"
+    ):
+        _orchestrate()
+        return
 
     jax, environment = _init_jax()
     tiny = os.environ.get("BENCH_TINY") == "1"
@@ -642,22 +817,13 @@ def main() -> None:
         result["attempt"] = os.environ["BENCH_ATTEMPT"]
     if result.get("vs_baseline") is None:
         # 1 chip (or probe fallback): measure scaling on the virtual
-        # CPU mesh so the factor is a real multi-device measurement
-        scaling = _measure_virtual8_scaling()
-        if scaling:
-            n_cores = scaling.get("n_cores") or 0
-            n_mesh = scaling.get("n_devices", 8)
-            if n_cores < n_mesh:
-                # time-slicing a wide mesh onto fewer cores can only
-                # show overhead — report no number rather than a
-                # misleading one (round-2 verdict item 6)
-                result["scaling_note"] = (
-                    f"virtual {n_mesh}-device mesh on {n_cores} physical "
-                    "core(s): scaling not measurable"
-                )
-            else:
-                result["vs_baseline"] = scaling["scaling"]
-                result["scaling_source"] = f"virtual8_cpu_mesh({n_cores}core)"
+        # CPU mesh so the factor is a real multi-device measurement.
+        # Orchestrated children run with BENCH_SCALING_TIMEOUT=0 (the
+        # parent measures scaling once and patches it in).
+        scaling = _measure_virtual8_scaling(
+            float(os.environ.get("BENCH_SCALING_TIMEOUT", 900))
+        )
+        _apply_scaling(result, scaling)
     if _PROBE_ATTEMPTS:
         result["probe"] = _PROBE_ATTEMPTS
     print(json.dumps(result))
