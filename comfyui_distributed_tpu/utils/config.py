@@ -169,6 +169,22 @@ def save_config(config: dict[str, Any], path: str | None = None) -> None:
         _cache.data = _merge_defaults(DEFAULT_CONFIG, config)
 
 
+@contextlib.contextmanager
+def locked_config(path: str | None = None):
+    """Synchronous locked read-modify-write on the SAME mutex as
+    config_transaction; persists only if mutated. For sync callers on
+    executor threads (e.g. the worker process manager's PID
+    persistence) — a private lock there would not exclude the async
+    transaction path and load/save interleavings could drop writes.
+    """
+    with _txn_lock:
+        config = load_config(path)
+        snapshot = copy.deepcopy(config)
+        yield config
+        if config != snapshot:
+            save_config(config, path)
+
+
 @contextlib.asynccontextmanager
 async def config_transaction(path: str | None = None) -> AsyncIterator[dict[str, Any]]:
     """Locked read-modify-write; persists only if mutated.

@@ -173,17 +173,21 @@ class WorkerProcessManager:
             config_mod.load_config(config_path).get("managed_processes", {})
         )
 
+    # Persistence writes go through config_mod.locked_config — the
+    # SAME mutex as the async config_transaction used by the config
+    # routes, so a launch's _persist cannot interleave with a panel
+    # settings save and lose either write.
+
     def _persist(self, worker_id: str, pid: int, config_path: str | None) -> None:
-        config = config_mod.load_config(config_path)
-        config.setdefault("managed_processes", {})[worker_id] = {
-            "pid": pid,
-            "started_at": time.time(),
-            # cleared via clear_launching once the worker is confirmed
-            # up; a crashed launch otherwise leaves the flag for the
-            # panel's grace-window logic to expire
-            "launching": True,
-        }
-        config_mod.save_config(config, config_path)
+        with config_mod.locked_config(config_path) as config:
+            config.setdefault("managed_processes", {})[worker_id] = {
+                "pid": pid,
+                "started_at": time.time(),
+                # cleared via clear_launching once the worker is
+                # confirmed up; a crashed launch otherwise leaves the
+                # flag for the panel's grace-window logic to expire
+                "launching": True,
+            }
 
     def clear_launching(
         self, worker_id: str, config_path: str | None = None
@@ -191,32 +195,27 @@ class WorkerProcessManager:
         """Drop the 'launching' marker once the worker is confirmed
         running (reference api/worker_routes.py clear_launching_state);
         returns whether a marker was cleared."""
-        config = config_mod.load_config(config_path)
-        entry = config.get("managed_processes", {}).get(worker_id)
-        if entry is None or "launching" not in entry:
-            return False
-        del entry["launching"]
-        config_mod.save_config(config, config_path)
-        return True
+        with config_mod.locked_config(config_path) as config:
+            entry = config.get("managed_processes", {}).get(worker_id)
+            if entry is None or "launching" not in entry:
+                return False
+            del entry["launching"]
+            return True
 
     def _unpersist(self, worker_id: str, config_path: str | None) -> None:
-        config = config_mod.load_config(config_path)
-        if worker_id in config.get("managed_processes", {}):
-            del config["managed_processes"][worker_id]
-            config_mod.save_config(config, config_path)
+        with config_mod.locked_config(config_path) as config:
+            config.get("managed_processes", {}).pop(worker_id, None)
 
     def clear_stale(self, config_path: str | None = None) -> list[str]:
         """Drop managed entries whose PIDs are dead (master restart
         recovery, reference workers/process/persistence.py)."""
         stale = []
-        config = config_mod.load_config(config_path)
-        managed = config.get("managed_processes", {})
-        for worker_id, entry in list(managed.items()):
-            if not is_process_alive(int(entry.get("pid", -1))):
-                stale.append(worker_id)
-                del managed[worker_id]
-        if stale:
-            config_mod.save_config(config, config_path)
+        with config_mod.locked_config(config_path) as config:
+            managed = config.get("managed_processes", {})
+            for worker_id, entry in list(managed.items()):
+                if not is_process_alive(int(entry.get("pid", -1))):
+                    stale.append(worker_id)
+                    del managed[worker_id]
         return stale
 
 

@@ -69,6 +69,53 @@ def test_transaction_persists_only_on_change(tmp_config_path):
     asyncio.run(scenario())
 
 
+def test_locked_config_shares_transaction_mutex(tmp_config_path):
+    """Sync writers (worker PID persistence) and the async transaction
+    path must exclude each other — same mutex, same
+    persist-only-on-change semantics."""
+    import threading
+
+    with cfg.locked_config() as config:
+        config["managed_processes"] = {"w1": {"pid": 1}}
+    assert cfg.load_config()["managed_processes"] == {"w1": {"pid": 1}}
+    mtime = os.path.getmtime(tmp_config_path)
+    with cfg.locked_config():
+        pass  # no mutation -> no write
+    assert os.path.getmtime(tmp_config_path) == mtime
+
+    # mutual exclusion with the async transaction: sync side holds the
+    # mutex; the async transaction must not complete until it releases
+    entered = threading.Event()
+    release = threading.Event()
+    order = []
+
+    def sync_side():
+        with cfg.locked_config() as config:
+            entered.set()
+            release.wait(timeout=5)
+            config["settings"]["debug"] = True
+            order.append("sync")
+
+    thread = threading.Thread(target=sync_side)
+    thread.start()
+    entered.wait(timeout=5)
+
+    async def async_side():
+        async with cfg.config_transaction() as config:
+            order.append("async")
+            config["settings"]["debug"] = False
+
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        fut = pool.submit(asyncio.run, async_side())
+        release.set()
+        fut.result(timeout=10)
+    thread.join(timeout=5)
+    assert order == ["sync", "async"], "transaction ran inside the sync lock"
+    assert cfg.load_config()["settings"]["debug"] is False
+
+
 def test_worker_timeout_fallbacks(tmp_config_path):
     assert cfg.get_worker_timeout_seconds() == 60.0
     config = cfg.load_config()

@@ -52,6 +52,30 @@ def test_pid_persistence_and_stale_recovery(tmp_config_path):
     assert "w1" not in manager.managed_processes()
 
 
+def test_concurrent_persist_does_not_lose_writers(tmp_config_path):
+    """Config read-modify-write cycles run on executor threads; without
+    the shared config lock, two concurrent _persist calls can load the
+    same snapshot and the second save erases the first's entry."""
+    import threading
+
+    manager = pm.WorkerProcessManager()
+    barrier = threading.Barrier(8)
+
+    def persist(i):
+        barrier.wait()
+        manager._persist(f"w{i}", 100000 + i, None)
+        manager.clear_launching(f"w{i}")
+
+    threads = [threading.Thread(target=persist, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    managed = manager.managed_processes()
+    assert sorted(managed) == [f"w{i}" for i in range(8)]
+    assert all("launching" not in e for e in managed.values())
+
+
 def test_launch_and_stop_real_process(tmp_config_path, tmp_path, monkeypatch):
     """Launch a real (sleep) process through the manager and tree-kill it."""
     monkeypatch.setenv("CDT_LOG_DIR", str(tmp_path / "logs"))
