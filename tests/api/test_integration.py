@@ -103,6 +103,10 @@ def test_probe_surface(cluster):
     assert out == {"exec_info": {"queue_remaining": 0}}
     out = _get(f"http://127.0.0.1:{worker_port}/distributed/system_info")
     assert "machine_id" in out and out["is_worker"] is True
+    # tokenizer-fidelity surface: with the committed stand-in vocab the
+    # flag is False; with OpenAI's table installed it is True — either
+    # way it must be a bool, not buried in a log line
+    assert out["clip_vocab_canonical"] in (True, False)
 
 
 def test_distributed_queue_end_to_end(cluster):
