@@ -1,92 +1,70 @@
-/* Control panel for the distributed TPU runtime.
+/* Control panel for the distributed TPU runtime — entry module.
  *
  * Standalone build of the reference's sidebar extension (reference
- * web/main.js + workerLifecycle.js + workerSettings.js + apiClient.js):
- * adaptive status polling (1s while anything is busy/launching, 5s
- * idle), worker CRUD against the config API, launch/stop with a
- * launching grace window, log modal with auto-refresh, tunnel
- * controls, and workflow submission to /distributed/queue.
+ * web/main.js): adaptive status polling (1s while anything is
+ * busy/launching, 5s idle), worker CRUD against the config API,
+ * launch/stop with a launching grace window, log modal with
+ * auto-refresh, tunnel controls, workflow submission to
+ * /distributed/queue, and the tokenizer-fidelity banner.
+ *
+ * Pure logic lives in modules/ (urlUtils, apiClient, state, widgets,
+ * render) — tested by web/tests/ without a browser. This file is only
+ * wiring: event listeners, timers, and DOM lookups.
  */
 
 "use strict";
 
-const POLL_ACTIVE_MS = 1000;
-const POLL_IDLE_MS = 5000;
-const LAUNCH_GRACE_MS = 90000;
-
-const state = {
-  config: null,
-  workerStatus: new Map(), // id -> {online, queueRemaining, launchingSince}
-  pollTimer: null,
-  logTimer: null,
-  anythingBusy: false,
-};
-
-// ---------- API client with retry/backoff ----------
-
-async function api(path, options = {}, retries = 2) {
-  for (let attempt = 0; ; attempt++) {
-    try {
-      const resp = await fetch(path, {
-        headers: { "Content-Type": "application/json" },
-        ...options,
-      });
-      const body = await resp.json().catch(() => ({}));
-      if (!resp.ok) throw new Error(body.error || `HTTP ${resp.status}`);
-      return body;
-    } catch (err) {
-      if (attempt >= retries) throw err;
-      await new Promise((r) => setTimeout(r, 300 * 2 ** attempt));
-    }
-  }
-}
-
-function workerUrl(worker, path) {
-  const scheme =
-    worker.type === "cloud" || Number(worker.port) === 443 ? "https" : "http";
-  const host = worker.host || "127.0.0.1";
-  const port = worker.port ? `:${worker.port}` : "";
-  return `${scheme}://${host}${port}${path}`;
-}
-
-async function probeWorker(worker) {
-  try {
-    const resp = await fetch(workerUrl(worker, "/prompt"), {
-      signal: AbortSignal.timeout(4000),
-    });
-    if (!resp.ok) return { online: false };
-    const body = await resp.json();
-    const remaining = body?.exec_info?.queue_remaining;
-    if (remaining === undefined) return { online: false };
-    return { online: true, queueRemaining: remaining };
-  } catch {
-    return { online: false };
-  }
-}
+import { api, probeWorker } from "./modules/apiClient.js";
+import {
+  POLL_ACTIVE_MS,
+  POLL_IDLE_MS,
+  computeAnythingBusy,
+  enabledWorkers,
+  pruneWorkerStatus,
+  reduceWorkerStatus,
+  state,
+} from "./modules/state.js";
+import {
+  clampDividerParts,
+  collectOverrides,
+  MAX_DIVIDER_OUTPUTS,
+  nextWorkerDefaults,
+  parseChipList,
+  parseWorkflowText,
+  patchWorkflowText,
+} from "./modules/widgets.js";
+import {
+  renderVocabBanner,
+  renderWorkers,
+  renderWorkflowNodes,
+} from "./modules/render.js";
+import { escapeHtml, workerUrl } from "./modules/urlUtils.js";
 
 // ---------- status polling ----------
 
 async function refreshStatus() {
+  let masterQueue = 0;
   try {
     const master = await api("/prompt");
-    setDot("master-dot", master.exec_info.queue_remaining > 0 ? "busy" : "online");
+    masterQueue = master.exec_info.queue_remaining;
+    setDot("master-dot", masterQueue > 0 ? "busy" : "online");
     document.getElementById("master-summary").textContent =
-      `queue: ${master.exec_info.queue_remaining}`;
-    state.anythingBusy = master.exec_info.queue_remaining > 0;
+      `queue: ${masterQueue}`;
   } catch {
     setDot("master-dot", "offline");
     document.getElementById("master-summary").textContent = "unreachable";
   }
 
   const workers = state.config?.workers || [];
+  pruneWorkerStatus(state.workerStatus, workers);
   await Promise.all(
     workers.map(async (w) => {
-      const prev = state.workerStatus.get(w.id) || {};
+      const prev = state.workerStatus.get(w.id);
       const probe = await probeWorker(w);
-      const launching =
-        prev.launchingSince && Date.now() - prev.launchingSince < LAUNCH_GRACE_MS;
-      if (probe.online && prev.launchingSince) {
-        prev.launchingSince = null;
+      const { status, clearLaunching } = reduceWorkerStatus(
+        prev, probe, Date.now()
+      );
+      if (clearLaunching) {
         // tell the server the launch completed so the persisted
         // 'launching' marker can't wedge a later grace window
         api("/distributed/worker/clear_launching", {
@@ -94,11 +72,15 @@ async function refreshStatus() {
           body: JSON.stringify({ worker_id: w.id }),
         }).catch(() => {});
       }
-      state.workerStatus.set(w.id, { ...prev, ...probe, launching: launching && !probe.online });
-      if (probe.online && probe.queueRemaining > 0) state.anythingBusy = true;
+      state.workerStatus.set(w.id, status);
     })
   );
-  renderWorkers();
+  state.anythingBusy = computeAnythingBusy(
+    masterQueue, state.workerStatus.values()
+  );
+  renderWorkers(
+    document.getElementById("workers"), state.config, state.workerStatus
+  );
   schedulePoll();
 }
 
@@ -115,49 +97,7 @@ function setDot(id, cls) {
   el.className = `dot ${cls}`;
 }
 
-// ---------- rendering ----------
-
-function renderWorkers() {
-  const container = document.getElementById("workers");
-  container.innerHTML = "";
-  for (const worker of state.config?.workers || []) {
-    const status = state.workerStatus.get(worker.id) || {};
-    const card = document.createElement("div");
-    card.className = "worker-card";
-    const dotCls = status.online
-      ? status.queueRemaining > 0 ? "busy" : "online"
-      : status.launching ? "busy" : "offline";
-    const statusText = status.online
-      ? `online · queue ${status.queueRemaining}`
-      : status.launching ? "launching…" : "offline";
-    card.innerHTML = `
-      <div>
-        <span class="dot ${dotCls}"></span>
-        <strong>${escapeHtml(worker.name || worker.id)}</strong>
-        <span class="meta">${escapeHtml(worker.type)} · ${escapeHtml(worker.host || "local")}:${worker.port}
-          ${worker.tpu_chips?.length ? "· chips " + worker.tpu_chips.join(",") : ""}
-          · ${statusText}</span>
-      </div>
-      <div class="controls">
-        <label class="small toggle"><input type="checkbox" data-enable="${worker.id}"
-          ${worker.enabled ? "checked" : ""}> on</label>
-        ${worker.type === "local"
-          ? `<button class="small" data-launch="${worker.id}">launch</button>
-             <button class="small" data-stop="${worker.id}">stop</button>`
-          : ""}
-        <button class="small" data-log="${worker.id}">log</button>
-        <button class="small" data-edit="${worker.id}">edit</button>
-        <button class="small" data-delete="${worker.id}">✕</button>
-      </div>`;
-    container.appendChild(card);
-  }
-}
-
-function escapeHtml(value) {
-  return String(value ?? "").replace(/[&<>"']/g, (c) => ({
-    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
-  })[c]);
-}
+// ---------- settings / topology ----------
 
 function renderSettings() {
   const grid = document.createElement("div");
@@ -200,6 +140,7 @@ function renderSettings() {
 async function renderTopology() {
   try {
     const info = await api("/distributed/system_info");
+    state.topoChips = (info.topology?.devices || []).map((d) => d.id);
     const topo = info.topology || {};
     const container = document.getElementById("topology");
     const chips = (topo.devices || [])
@@ -209,6 +150,17 @@ async function renderTopology() {
       `platform <b>${escapeHtml(topo.platform)}</b> · ` +
       `${topo.local_device_count}/${topo.device_count} local chips · ` +
       `host ${escapeHtml(info.machine_id)}<br>${chips}`;
+    renderVocabBanner(
+      document.getElementById("vocab-banner"),
+      info,
+      state.vocabBannerDismissed,
+      () => {
+        state.vocabBannerDismissed = true;
+        renderVocabBanner(
+          document.getElementById("vocab-banner"), info, true, () => {}
+        );
+      }
+    );
   } catch {
     document.getElementById("topology").textContent = "unavailable";
   }
@@ -216,22 +168,16 @@ async function renderTopology() {
 
 // ---------- worker CRUD ----------
 
-function nextWorkerDefaults() {
-  const workers = state.config?.workers || [];
-  const ports = workers.map((w) => Number(w.port)).filter(Boolean);
-  const port = Math.max(8188, ...ports) + 1;
-  const usedChips = new Set(workers.flatMap((w) => w.tpu_chips || []));
-  const chips = (state.topoChips || []).filter((c) => !usedChips.has(c));
-  return { port, chip: chips.length ? [chips[0]] : [] };
-}
-
 function workerForm(existing) {
   const worker = existing || {
     id: `w${Date.now() % 100000}`,
     name: "",
     type: "local",
     host: "127.0.0.1",
-    ...(() => { const d = nextWorkerDefaults(); return { port: d.port, tpu_chips: d.chip }; })(),
+    ...(() => {
+      const d = nextWorkerDefaults(state.config?.workers, state.topoChips);
+      return { port: d.port, tpu_chips: d.chip };
+    })(),
     enabled: true,
     extra_args: "",
   };
@@ -253,9 +199,9 @@ function workerForm(existing) {
       if (f === "port") value = Number(value) || 0;
       body[f] = value;
     }
-    body.tpu_chips = document
-      .getElementById("wf-tpu_chips")
-      .value.split(",").map((s) => Number(s.trim())).filter((n) => !isNaN(n));
+    body.tpu_chips = parseChipList(
+      document.getElementById("wf-tpu_chips").value
+    );
     try {
       await api("/distributed/config/worker", {
         method: "POST",
@@ -305,29 +251,36 @@ async function showWorkerLog(workerId) {
 
 async function loadConfig() {
   state.config = await api("/distributed/config");
-  renderWorkers();
+  renderWorkers(
+    document.getElementById("workers"), state.config, state.workerStatus
+  );
   renderSettings();
+}
+
+function refreshWorkflowNodes() {
+  renderWorkflowNodes(
+    document.getElementById("workflow-nodes"),
+    parseWorkflowText(document.getElementById("workflow-json").value),
+    enabledWorkers(state.config)
+  );
 }
 
 async function queueWorkflow() {
   const resultEl = document.getElementById("queue-result");
-  let prompt;
-  try {
-    prompt = JSON.parse(document.getElementById("workflow-json").value);
-  } catch {
+  const prompt = parseWorkflowText(
+    document.getElementById("workflow-json").value
+  );
+  if (!prompt) {
     resultEl.textContent = "invalid JSON";
     return;
   }
-  const enabledWorkers = (state.config?.workers || [])
-    .filter((w) => w.enabled)
-    .map((w) => w.id);
   try {
     const body = await api("/distributed/queue", {
       method: "POST",
       body: JSON.stringify({
-        prompt: prompt.prompt || prompt,
+        prompt,
         client_id: "panel",
-        workers: enabledWorkers,
+        workers: enabledWorkers(state.config).map((w) => w.id),
         load_balance: document.getElementById("load-balance").checked,
       }),
     });
@@ -360,122 +313,9 @@ async function loadExamples() {
       if (!select.value) return;
       const wf = await api(`/distributed/workflows/${encodeURIComponent(select.value)}`);
       document.getElementById("workflow-json").value = JSON.stringify(wf, null, 2);
-      renderWorkflowNodes();
+      refreshWorkflowNodes();
     });
   } catch { /* optional */ }
-}
-
-// ---------- workflow node widgets ----------
-// Parity with the reference's graph-embedded widget UIs
-// (web/distributedValue.js, web/image_batch_divider.js): the panel
-// reads the pasted workflow, renders per-worker value inputs for every
-// DistributedValue node and an output-count control for every batch
-// divider, and writes changes back into the workflow JSON.
-
-const VALUE_TYPES = ["STRING", "INT", "FLOAT", "BOOLEAN"];
-const MAX_DIVIDER_OUTPUTS = 10;
-
-function currentWorkflow() {
-  try {
-    const parsed = JSON.parse(document.getElementById("workflow-json").value);
-    return parsed.prompt || parsed;
-  } catch {
-    return null;
-  }
-}
-
-function patchWorkflowNode(nodeId, patch) {
-  const textarea = document.getElementById("workflow-json");
-  let parsed;
-  try {
-    parsed = JSON.parse(textarea.value);
-  } catch {
-    return;
-  }
-  const prompt = parsed.prompt || parsed;
-  if (!prompt[nodeId]) return;
-  prompt[nodeId].inputs = { ...prompt[nodeId].inputs, ...patch };
-  textarea.value = JSON.stringify(parsed, null, 2);
-}
-
-function enabledWorkers() {
-  return (state.config?.workers || []).filter((w) => w.enabled);
-}
-
-function renderWorkflowNodes() {
-  const container = document.getElementById("workflow-nodes");
-  const prompt = currentWorkflow();
-  if (!prompt) {
-    container.textContent =
-      "paste a workflow to configure per-worker values and batch dividers";
-    return;
-  }
-  container.innerHTML = "";
-  container.classList.remove("mono");
-  let any = false;
-
-  for (const [nodeId, node] of Object.entries(prompt)) {
-    if (node.class_type === "DistributedValue") {
-      any = true;
-      const overrides = node.inputs?.overrides || {};
-      const block = document.createElement("div");
-      block.className = "node-widget";
-      const typeOptions = VALUE_TYPES.map(
-        (t) =>
-          `<option ${t === (overrides._type || "STRING") ? "selected" : ""}>${t}</option>`
-      ).join("");
-      const workerRows = enabledWorkers()
-        .map(
-          (w, idx) => `<div class="row">
-            <label style="width:140px">${escapeHtml(w.name || w.id)} (#${idx + 1})</label>
-            <input type="text" data-dv-node="${escapeHtml(nodeId)}" data-dv-slot="${idx + 1}"
-              value="${escapeHtml(overrides[String(idx + 1)] ?? "")}"
-              placeholder="master value"></div>`
-        )
-        .join("");
-      block.innerHTML = `
-        <div class="row"><strong>DistributedValue #${escapeHtml(nodeId)}</strong>
-          <span class="meta">master value: ${escapeHtml(node.inputs?.value ?? "")}</span>
-          <select data-dv-type="${escapeHtml(nodeId)}">${typeOptions}</select></div>
-        ${workerRows ||
-          '<div class="meta">no enabled workers — values apply per enabled worker</div>'}`;
-      container.appendChild(block);
-    }
-    if (
-      node.class_type === "ImageBatchDivider" ||
-      node.class_type === "AudioBatchDivider"
-    ) {
-      any = true;
-      const divideBy = Number(node.inputs?.divide_by ?? 2);
-      const block = document.createElement("div");
-      block.className = "node-widget";
-      block.innerHTML = `
-        <div class="row"><strong>${escapeHtml(node.class_type)} #${escapeHtml(nodeId)}</strong>
-          <label>outputs <input type="number" min="1" max="${MAX_DIVIDER_OUTPUTS}"
-            value="${divideBy}" data-divider-node="${escapeHtml(nodeId)}"
-            style="width:60px"></label>
-          <span class="meta" id="divider-used-${escapeHtml(nodeId)}">
-            ${divideBy} of ${MAX_DIVIDER_OUTPUTS} outputs carry data</span></div>`;
-      container.appendChild(block);
-    }
-  }
-  if (!any) {
-    container.classList.add("mono");
-    container.textContent =
-      "no DistributedValue / batch-divider nodes in this workflow";
-  }
-}
-
-function collectDistributedValueOverrides(nodeId) {
-  const overrides = {};
-  const typeSel = document.querySelector(`select[data-dv-type="${nodeId}"]`);
-  overrides._type = typeSel ? typeSel.value : "STRING";
-  for (const input of document.querySelectorAll(
-    `input[data-dv-node="${nodeId}"]`
-  )) {
-    if (input.value !== "") overrides[input.dataset.dvSlot] = input.value;
-  }
-  return overrides;
 }
 
 // ---------- master detection (reference web/masterDetection.js) ----------
@@ -556,18 +396,24 @@ document.addEventListener("change", async (event) => {
       body: JSON.stringify({ id: t.dataset.enable, enabled: t.checked }),
     }).catch((err) => alert(err.message));
     await loadConfig();
-    renderWorkflowNodes(); // per-worker widget rows follow enablement
+    refreshWorkflowNodes(); // per-worker widget rows follow enablement
   } else if (t.dataset.dvNode || t.dataset.dvType) {
     const nodeId = t.dataset.dvNode || t.dataset.dvType;
-    patchWorkflowNode(nodeId, {
-      overrides: collectDistributedValueOverrides(nodeId),
+    const typeSel = document.querySelector(`select[data-dv-type="${nodeId}"]`);
+    const rows = [...document.querySelectorAll(
+      `input[data-dv-node="${nodeId}"]`
+    )].map((input) => ({ slot: input.dataset.dvSlot, value: input.value }));
+    const textarea = document.getElementById("workflow-json");
+    const patched = patchWorkflowText(textarea.value, nodeId, {
+      overrides: collectOverrides(typeSel ? typeSel.value : "STRING", rows),
     });
+    if (patched !== null) textarea.value = patched;
   } else if (t.dataset.dividerNode) {
     const nodeId = t.dataset.dividerNode;
-    const parts = Math.max(
-      1, Math.min(Number(t.value) || 1, MAX_DIVIDER_OUTPUTS)
-    );
-    patchWorkflowNode(nodeId, { divide_by: parts });
+    const parts = clampDividerParts(t.value);
+    const textarea = document.getElementById("workflow-json");
+    const patched = patchWorkflowText(textarea.value, nodeId, { divide_by: parts });
+    if (patched !== null) textarea.value = patched;
     const used = document.getElementById(`divider-used-${nodeId}`);
     if (used)
       used.textContent = `${parts} of ${MAX_DIVIDER_OUTPUTS} outputs carry data`;
@@ -578,7 +424,7 @@ document
   .getElementById("workflow-json")
   .addEventListener("input", () => {
     clearTimeout(state.nodesTimer);
-    state.nodesTimer = setTimeout(renderWorkflowNodes, 400);
+    state.nodesTimer = setTimeout(refreshWorkflowNodes, 400);
   });
 
 document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
@@ -620,10 +466,6 @@ document.getElementById("tunnel-toggle").addEventListener("click", async () => {
 (async function init() {
   await loadConfig().catch(() => {});
   await renderTopology();
-  try {
-    const info = await api("/distributed/system_info");
-    state.topoChips = (info.topology?.devices || []).map((d) => d.id);
-  } catch { state.topoChips = []; }
   await loadExamples();
   refreshStatus();
   renderNetworkInfo();

@@ -1,0 +1,61 @@
+/* API client with retry/backoff + worker probing.
+ *
+ * Counterpart of the reference's web/apiClient.js. The fetch function
+ * is injectable so the retry loop and probe validation are testable
+ * without a browser (reference web/tests/apiClient.test.js mocks
+ * global fetch the same way).
+ */
+
+"use strict";
+
+import { workerUrl } from "./urlUtils.js";
+
+const deps = {
+  fetch: (...args) => fetch(...args),
+  delay: (ms) => new Promise((r) => setTimeout(r, ms)),
+};
+
+/** Test hook: override fetch/delay; returns the previous values. */
+export function setApiDeps(overrides) {
+  const prev = { ...deps };
+  Object.assign(deps, overrides);
+  return prev;
+}
+
+export async function api(path, options = {}, retries = 2) {
+  for (let attempt = 0; ; attempt++) {
+    try {
+      const resp = await deps.fetch(path, {
+        headers: { "Content-Type": "application/json" },
+        ...options,
+      });
+      const body = await resp.json().catch(() => ({}));
+      if (!resp.ok) throw new Error(body.error || `HTTP ${resp.status}`);
+      return body;
+    } catch (err) {
+      if (attempt >= retries) throw err;
+      await deps.delay(300 * 2 ** attempt);
+    }
+  }
+}
+
+/** Pure validation of a /prompt probe body: a worker is only "online"
+ * when the response carries the exec_info.queue_remaining contract
+ * (reference web/apiClient.js probeWorker validation). */
+export function parseProbeBody(body) {
+  const remaining = body?.exec_info?.queue_remaining;
+  if (remaining === undefined || remaining === null) return { online: false };
+  return { online: true, queueRemaining: Number(remaining) };
+}
+
+export async function probeWorker(worker, timeoutMs = 4000) {
+  try {
+    const resp = await deps.fetch(workerUrl(worker, "/prompt"), {
+      signal: AbortSignal.timeout(timeoutMs),
+    });
+    if (!resp.ok) return { online: false };
+    return parseProbeBody(await resp.json());
+  } catch {
+    return { online: false };
+  }
+}

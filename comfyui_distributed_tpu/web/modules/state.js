@@ -1,0 +1,71 @@
+/* Panel state + the worker-status reduction.
+ *
+ * Counterpart of the reference's web/stateManager.js +
+ * workerLifecycle.js state machine. The status transition on each
+ * probe result is a pure function (`reduceWorkerStatus`) so the
+ * launch-grace / clear-launching flow is testable without timers or a
+ * DOM.
+ */
+
+"use strict";
+
+export const POLL_ACTIVE_MS = 1000;
+export const POLL_IDLE_MS = 5000;
+export const LAUNCH_GRACE_MS = 90000;
+
+export const state = {
+  config: null,
+  workerStatus: new Map(), // id -> {online, queueRemaining, launching, launchingSince}
+  pollTimer: null,
+  logTimer: null,
+  nodesTimer: null,
+  anythingBusy: false,
+  topoChips: [],
+  vocabBannerDismissed: false,
+};
+
+/** One step of the per-worker status machine.
+ *
+ * Returns { status, clearLaunching }: the next status record, and
+ * whether the server's persisted 'launching' marker should be cleared
+ * (the worker came up inside its grace window — reference
+ * web/workerLifecycle.js launch grace + clear_launching call).
+ */
+export function reduceWorkerStatus(prev, probe, now, graceMs = LAUNCH_GRACE_MS) {
+  prev = prev || {};
+  const inGrace =
+    !!prev.launchingSince && now - prev.launchingSince < graceMs;
+  const clearLaunching = !!(probe.online && prev.launchingSince);
+  const status = {
+    ...prev,
+    ...probe,
+    launchingSince: clearLaunching ? null : prev.launchingSince,
+    launching: inGrace && !probe.online,
+  };
+  return { status, clearLaunching };
+}
+
+/** Whether any participant has work queued (drives the 1s/5s adaptive
+ * poll cadence, reference web/main.js status-poll lifecycle). */
+export function computeAnythingBusy(masterQueueRemaining, statuses) {
+  if (masterQueueRemaining > 0) return true;
+  for (const s of statuses) {
+    if (s && s.online && s.queueRemaining > 0) return true;
+  }
+  return false;
+}
+
+export function enabledWorkers(config) {
+  return ((config || {}).workers || []).filter((w) => w.enabled);
+}
+
+/** Drop status entries for workers no longer in the config — a
+ * deleted worker's stale {online, queueRemaining} record is never
+ * re-probed and would otherwise pin the adaptive poll at its fast
+ * cadence forever. */
+export function pruneWorkerStatus(statusMap, workers) {
+  const known = new Set((workers || []).map((w) => w.id));
+  for (const id of [...statusMap.keys()]) {
+    if (!known.has(id)) statusMap.delete(id);
+  }
+}

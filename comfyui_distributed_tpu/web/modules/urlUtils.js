@@ -1,0 +1,22 @@
+/* URL + HTML-escaping helpers (pure functions).
+ *
+ * Counterpart of the reference's web/urlUtils.js: scheme heuristics
+ * (https for cloud workers and port 443), host/port assembly, and the
+ * escaping used by every innerHTML template in the panel.
+ */
+
+"use strict";
+
+export function workerUrl(worker, path) {
+  const scheme =
+    worker.type === "cloud" || Number(worker.port) === 443 ? "https" : "http";
+  const host = worker.host || "127.0.0.1";
+  const port = worker.port ? `:${worker.port}` : "";
+  return `${scheme}://${host}${port}${path}`;
+}
+
+export function escapeHtml(value) {
+  return String(value ?? "").replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  })[c]);
+}

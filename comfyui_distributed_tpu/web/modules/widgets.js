@@ -1,0 +1,97 @@
+/* Workflow-node widget logic (pure functions).
+ *
+ * Counterpart of the reference's web/distributedValue.js +
+ * web/image_batch_divider.js widget math: per-worker override
+ * collection, divider output clamping, workflow JSON patching, and
+ * new-worker defaults (port/chip auto-pick, reference
+ * web/workerSettings.js).
+ */
+
+"use strict";
+
+export const VALUE_TYPES = ["STRING", "INT", "FLOAT", "BOOLEAN"];
+export const MAX_DIVIDER_OUTPUTS = 10;
+
+/** Parse a pasted workflow (optionally wrapped in {prompt: ...});
+ * null when the JSON is invalid. */
+export function parseWorkflowText(text) {
+  try {
+    const parsed = JSON.parse(text);
+    return parsed.prompt || parsed;
+  } catch {
+    return null;
+  }
+}
+
+/** Merge an inputs patch into one node of the workflow text, returning
+ * the re-serialized text (null when the text/nodeId is invalid). */
+export function patchWorkflowText(text, nodeId, patch) {
+  let parsed;
+  try {
+    parsed = JSON.parse(text);
+  } catch {
+    return null;
+  }
+  const prompt = parsed.prompt || parsed;
+  if (!prompt[nodeId]) return null;
+  prompt[nodeId].inputs = { ...prompt[nodeId].inputs, ...patch };
+  return JSON.stringify(parsed, null, 2);
+}
+
+/** Assemble a DistributedValue overrides map from widget rows:
+ * [{slot, value}] -> {"_type": t, "1": v, ...}, empty values omitted
+ * (reference web/distributedValue.js collection; slots are 1-indexed
+ * by enabled-worker position). */
+export function collectOverrides(type, rows) {
+  const overrides = { _type: VALUE_TYPES.includes(type) ? type : "STRING" };
+  for (const { slot, value } of rows) {
+    if (value !== "" && value !== undefined && value !== null) {
+      overrides[String(slot)] = value;
+    }
+  }
+  return overrides;
+}
+
+/** Clamp a divider output count to [1, MAX_DIVIDER_OUTPUTS]
+ * (reference web/image_batch_divider.js divide_by widget). */
+export function clampDividerParts(value) {
+  return Math.max(1, Math.min(Number(value) || 1, MAX_DIVIDER_OUTPUTS));
+}
+
+/** Defaults for a new worker: next free port above the current
+ * maximum (>= 8189) and the first unclaimed TPU chip (reference
+ * web/workerSettings.js CUDA/port auto-pick). */
+export function nextWorkerDefaults(workers, topoChips) {
+  workers = workers || [];
+  const ports = workers.map((w) => Number(w.port)).filter(Boolean);
+  const port = Math.max(8188, ...ports) + 1;
+  const usedChips = new Set(workers.flatMap((w) => w.tpu_chips || []));
+  const chips = (topoChips || []).filter((c) => !usedChips.has(c));
+  return { port, chip: chips.length ? [chips[0]] : [] };
+}
+
+/** Parse a comma-separated chip list from the worker form. */
+export function parseChipList(text) {
+  return String(text || "")
+    .split(",")
+    .filter((s) => s.trim() !== "")
+    .map((s) => Number(s.trim()))
+    .filter((n) => Number.isFinite(n));
+}
+
+/** Scan a workflow for panel-configurable nodes. Returns
+ * [{nodeId, kind: "value"|"divider", node}] in stable key order. */
+export function findWidgetNodes(prompt) {
+  const found = [];
+  for (const [nodeId, node] of Object.entries(prompt || {})) {
+    if (node.class_type === "DistributedValue") {
+      found.push({ nodeId, kind: "value", node });
+    } else if (
+      node.class_type === "ImageBatchDivider" ||
+      node.class_type === "AudioBatchDivider"
+    ) {
+      found.push({ nodeId, kind: "divider", node });
+    }
+  }
+  return found;
+}

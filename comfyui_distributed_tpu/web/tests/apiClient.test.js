@@ -1,0 +1,134 @@
+/* API client retry loop + probe validation (reference
+ * web/tests/apiClient.test.js mocks fetch the same way). */
+
+"use strict";
+
+import { assert, assertEqual, assertThrows, test } from "./harness.js";
+import {
+  api,
+  parseProbeBody,
+  probeWorker,
+  setApiDeps,
+} from "../modules/apiClient.js";
+
+function jsonResponse(body, ok = true, status = 200) {
+  return { ok, status, json: async () => body };
+}
+
+async function withDeps(overrides, fn) {
+  const prev = setApiDeps({ delay: async () => {}, ...overrides });
+  try {
+    return await fn();
+  } finally {
+    setApiDeps(prev);
+  }
+}
+
+test("api: retries transient failures with backoff then succeeds", async () => {
+  let calls = 0;
+  const result = await withDeps(
+    {
+      fetch: async () => {
+        calls++;
+        if (calls < 3) throw new Error("ECONNREFUSED");
+        return jsonResponse({ fine: true });
+      },
+    },
+    () => api("/distributed/config")
+  );
+  assertEqual(result, { fine: true });
+  assertEqual(calls, 3, "two retries then success");
+});
+
+test("api: gives up after the retry budget", async () => {
+  let calls = 0;
+  await withDeps(
+    {
+      fetch: async () => {
+        calls++;
+        throw new Error("down");
+      },
+    },
+    () => assertThrows(() => api("/x", {}, 2))
+  );
+  assertEqual(calls, 3, "initial attempt + 2 retries");
+});
+
+test("api: non-ok response surfaces the server's error field", async () => {
+  await withDeps(
+    { fetch: async () => jsonResponse({ error: "bad worker" }, false, 400) },
+    () =>
+      assertThrows(async () => {
+        try {
+          await api("/x", {}, 0);
+        } catch (err) {
+          assertEqual(err.message, "bad worker");
+          throw err;
+        }
+      })
+  );
+});
+
+test("api: non-ok without a body falls back to HTTP status", async () => {
+  await withDeps(
+    {
+      fetch: async () => ({
+        ok: false,
+        status: 503,
+        json: async () => { throw new Error("not json"); },
+      }),
+    },
+    () =>
+      assertThrows(async () => {
+        try {
+          await api("/x", {}, 0);
+        } catch (err) {
+          assertEqual(err.message, "HTTP 503");
+          throw err;
+        }
+      })
+  );
+});
+
+test("parseProbeBody: requires the exec_info.queue_remaining contract", () => {
+  assertEqual(parseProbeBody({ exec_info: { queue_remaining: 0 } }), {
+    online: true,
+    queueRemaining: 0,
+  });
+  assertEqual(parseProbeBody({ exec_info: { queue_remaining: 3 } }), {
+    online: true,
+    queueRemaining: 3,
+  });
+  assertEqual(parseProbeBody({}), { online: false });
+  assertEqual(parseProbeBody(null), { online: false });
+  assertEqual(parseProbeBody({ exec_info: {} }), { online: false });
+});
+
+test("probeWorker: offline on fetch failure, online on contract", async () => {
+  const offline = await withDeps(
+    { fetch: async () => { throw new Error("refused"); } },
+    () => probeWorker({ type: "local", host: "h", port: 1 })
+  );
+  assertEqual(offline, { online: false });
+
+  let requested = null;
+  const online = await withDeps(
+    {
+      fetch: async (url) => {
+        requested = url;
+        return jsonResponse({ exec_info: { queue_remaining: 2 } });
+      },
+    },
+    () => probeWorker({ type: "local", host: "h", port: 8189 })
+  );
+  assertEqual(online, { online: true, queueRemaining: 2 });
+  assertEqual(requested, "http://h:8189/prompt", "probes the /prompt surface");
+});
+
+test("probeWorker: non-ok probe response is offline", async () => {
+  const result = await withDeps(
+    { fetch: async () => jsonResponse({}, false, 500) },
+    () => probeWorker({ type: "local", host: "h", port: 1 })
+  );
+  assert(!result.online);
+});

@@ -1,0 +1,58 @@
+/* Minimal dual-environment test harness.
+ *
+ * The image this framework ships in has no node/npm (verified: no JS
+ * runtime at all), so the suite can't depend on vitest like the
+ * reference's web/tests do. This harness is plain ES modules: run it
+ * with `node web/tests/run-node.mjs` wherever node exists, or open
+ * web/tests/runner.html in any browser.
+ */
+
+"use strict";
+
+export const registry = [];
+
+export function test(name, fn) {
+  registry.push({ name, fn });
+}
+
+export function assert(cond, msg) {
+  if (!cond) throw new Error(msg || "assertion failed");
+}
+
+export function assertEqual(actual, expected, msg) {
+  const a = JSON.stringify(actual);
+  const b = JSON.stringify(expected);
+  if (a !== b) {
+    throw new Error(`${msg || "not equal"}: ${a} !== ${b}`);
+  }
+}
+
+export function assertIncludes(haystack, needle, msg) {
+  if (!String(haystack).includes(needle)) {
+    throw new Error(`${msg || "missing substring"}: ${needle}`);
+  }
+}
+
+export async function assertThrows(fn, msg) {
+  try {
+    await fn();
+  } catch {
+    return;
+  }
+  throw new Error(msg || "expected an exception");
+}
+
+export async function runAll(log = console.log) {
+  let failed = 0;
+  for (const { name, fn } of registry) {
+    try {
+      await fn();
+      log(`ok - ${name}`);
+    } catch (err) {
+      failed++;
+      log(`FAIL - ${name}: ${err.message}`);
+    }
+  }
+  log(`# ${registry.length - failed}/${registry.length} passed`);
+  return failed;
+}

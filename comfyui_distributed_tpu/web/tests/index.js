@@ -1,0 +1,13 @@
+/* Imports every test module (registration side effects) and re-exports
+ * the runner. Entry points: run-node.mjs (node) and runner.html
+ * (any browser). */
+
+"use strict";
+
+import "./urlUtils.test.js";
+import "./apiClient.test.js";
+import "./state.test.js";
+import "./widgets.test.js";
+import "./render.test.js";
+
+export { registry, runAll } from "./harness.js";

@@ -1,0 +1,87 @@
+/* Template builders: worker cards, widget blocks, and the
+ * tokenizer-fidelity banner (pure string functions, no DOM). */
+
+"use strict";
+
+import { assert, assertEqual, assertIncludes, test } from "./harness.js";
+import {
+  dividerNodeHtml,
+  valueNodeHtml,
+  vocabBannerHtml,
+  workerCardHtml,
+  workerStatusParts,
+} from "../modules/render.js";
+
+test("workerStatusParts: online / busy / launching / offline", () => {
+  assertEqual(workerStatusParts({ online: true, queueRemaining: 0 }), {
+    dotCls: "online",
+    statusText: "online · queue 0",
+  });
+  assertEqual(workerStatusParts({ online: true, queueRemaining: 2 }).dotCls, "busy");
+  assertEqual(workerStatusParts({ launching: true }), {
+    dotCls: "busy",
+    statusText: "launching…",
+  });
+  assertEqual(workerStatusParts({}), { dotCls: "offline", statusText: "offline" });
+});
+
+test("workerCardHtml: local workers get launch/stop, remotes don't", () => {
+  const local = workerCardHtml(
+    { id: "w1", name: "alpha", type: "local", host: "127.0.0.1", port: 8189 },
+    {}
+  );
+  assertIncludes(local, 'data-launch="w1"');
+  assertIncludes(local, 'data-stop="w1"');
+  const remote = workerCardHtml(
+    { id: "w2", name: "beta", type: "remote", host: "10.0.0.9", port: 8188 },
+    {}
+  );
+  assert(!remote.includes("data-launch"), "remote card has no launch button");
+  assertIncludes(remote, 'data-log="w2"');
+});
+
+test("workerCardHtml escapes hostile names", () => {
+  const html = workerCardHtml(
+    { id: "w1", name: "<img src=x>", type: "local", port: 1 }, {}
+  );
+  assert(!html.includes("<img"), "name must be escaped");
+  assertIncludes(html, "&lt;img");
+});
+
+test("valueNodeHtml: one row per enabled worker, selected type, slots 1-indexed", () => {
+  const html = valueNodeHtml(
+    "12",
+    { inputs: { value: "seed", overrides: { _type: "INT", "2": "99" } } },
+    [{ id: "a", name: "A" }, { id: "b", name: "B" }]
+  );
+  assertIncludes(html, '<option selected>INT</option>');
+  assertIncludes(html, 'data-dv-slot="1"');
+  assertIncludes(html, 'data-dv-slot="2"');
+  assertIncludes(html, 'value="99"', "existing override round-trips");
+});
+
+test("valueNodeHtml: no enabled workers shows the hint row", () => {
+  assertIncludes(
+    valueNodeHtml("1", { inputs: {} }, []),
+    "no enabled workers"
+  );
+});
+
+test("dividerNodeHtml shows the current divide_by and bounds", () => {
+  const html = dividerNodeHtml("3", {
+    class_type: "ImageBatchDivider",
+    inputs: { divide_by: 4 },
+  });
+  assertIncludes(html, 'value="4"');
+  assertIncludes(html, 'max="10"');
+  assertIncludes(html, "4 of 10 outputs carry data");
+});
+
+test("vocabBannerHtml: only a non-canonical vocab raises the banner", () => {
+  assertEqual(vocabBannerHtml({ clip_vocab_canonical: true }), "");
+  assertEqual(vocabBannerHtml({}), "", "unknown state stays quiet");
+  assertEqual(vocabBannerHtml(null), "");
+  const html = vocabBannerHtml({ clip_vocab_canonical: false });
+  assertIncludes(html, "fetch_clip_vocab.py");
+  assertIncludes(html, 'id="vocab-banner-dismiss"');
+});

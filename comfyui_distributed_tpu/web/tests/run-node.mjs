@@ -1,0 +1,8 @@
+/* Node entry point: `node comfyui_distributed_tpu/web/tests/run-node.mjs`
+ * (or `bash scripts/test-web.sh`, which skips gracefully when the
+ * image has no node). Exits non-zero on any failure. */
+
+import { runAll } from "./index.js";
+
+const failed = await runAll();
+process.exit(failed ? 1 : 0);

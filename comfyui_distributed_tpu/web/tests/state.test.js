@@ -1,0 +1,95 @@
+/* Worker-status reduction: the launch-grace / clear-launching flow
+ * (reference web/workerLifecycle.js 90s launching grace). */
+
+"use strict";
+
+import { assert, assertEqual, test } from "./harness.js";
+import {
+  computeAnythingBusy,
+  enabledWorkers,
+  pruneWorkerStatus,
+  reduceWorkerStatus,
+} from "../modules/state.js";
+
+const T0 = 1_000_000;
+
+test("reduce: offline probe inside the grace window shows launching", () => {
+  const { status, clearLaunching } = reduceWorkerStatus(
+    { launchingSince: T0 }, { online: false }, T0 + 30_000, 90_000
+  );
+  assert(status.launching, "still inside 90s grace");
+  assert(!clearLaunching);
+  assertEqual(status.launchingSince, T0, "grace window keeps its anchor");
+});
+
+test("reduce: grace expiry falls back to plain offline", () => {
+  const { status, clearLaunching } = reduceWorkerStatus(
+    { launchingSince: T0 }, { online: false }, T0 + 90_001, 90_000
+  );
+  assert(!status.launching, "grace expired");
+  assert(!clearLaunching);
+});
+
+test("reduce: worker coming up inside grace clears the server marker", () => {
+  const { status, clearLaunching } = reduceWorkerStatus(
+    { launchingSince: T0 }, { online: true, queueRemaining: 0 }, T0 + 5_000
+  );
+  assert(clearLaunching, "must POST clear_launching exactly once");
+  assertEqual(status.launchingSince, null, "anchor dropped after arrival");
+  assert(status.online && !status.launching);
+});
+
+test("reduce: online worker without a pending launch stays quiet", () => {
+  const { status, clearLaunching } = reduceWorkerStatus(
+    { online: true, queueRemaining: 1 }, { online: true, queueRemaining: 0 }, T0
+  );
+  assert(!clearLaunching, "no marker to clear");
+  assertEqual(status.queueRemaining, 0, "probe result wins");
+});
+
+test("reduce: first probe with no prior state", () => {
+  const { status, clearLaunching } = reduceWorkerStatus(
+    undefined, { online: false }, T0
+  );
+  assert(!status.launching && !clearLaunching);
+});
+
+test("computeAnythingBusy: master queue or any busy worker", () => {
+  assert(computeAnythingBusy(1, []));
+  assert(!computeAnythingBusy(0, []));
+  assert(
+    computeAnythingBusy(0, [
+      { online: false },
+      { online: true, queueRemaining: 2 },
+    ])
+  );
+  assert(
+    !computeAnythingBusy(0, [
+      { online: true, queueRemaining: 0 },
+      { online: false, queueRemaining: 9 }, // offline queue doesn't count
+    ])
+  );
+});
+
+test("pruneWorkerStatus drops deleted workers' stale entries", () => {
+  const statuses = new Map([
+    ["a", { online: true, queueRemaining: 3 }],
+    ["gone", { online: true, queueRemaining: 9 }],
+  ]);
+  pruneWorkerStatus(statuses, [{ id: "a" }]);
+  assertEqual([...statuses.keys()], ["a"]);
+  // a deleted busy worker must not pin the fast poll cadence
+  assert(!computeAnythingBusy(0, [...statuses.values()].filter((s) => s.queueRemaining === 9)));
+  pruneWorkerStatus(statuses, undefined);
+  assertEqual(statuses.size, 0);
+});
+
+test("enabledWorkers filters and tolerates missing config", () => {
+  assertEqual(enabledWorkers(null), []);
+  assertEqual(
+    enabledWorkers({
+      workers: [{ id: "a", enabled: true }, { id: "b", enabled: false }],
+    }).map((w) => w.id),
+    ["a"]
+  );
+});

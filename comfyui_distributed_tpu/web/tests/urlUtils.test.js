@@ -1,0 +1,47 @@
+/* URL building + escaping (reference web/tests/urlUtils.test.js). */
+
+"use strict";
+
+import { assertEqual, test } from "./harness.js";
+import { escapeHtml, workerUrl } from "../modules/urlUtils.js";
+
+test("workerUrl: local http with port", () => {
+  assertEqual(
+    workerUrl({ type: "local", host: "127.0.0.1", port: 8189 }, "/prompt"),
+    "http://127.0.0.1:8189/prompt"
+  );
+});
+
+test("workerUrl: remote host defaults to http", () => {
+  assertEqual(
+    workerUrl({ type: "remote", host: "10.0.0.7", port: 8188 }, "/x"),
+    "http://10.0.0.7:8188/x"
+  );
+});
+
+test("workerUrl: cloud worker uses https", () => {
+  assertEqual(
+    workerUrl({ type: "cloud", host: "pod.example.com", port: 8443 }, "/p"),
+    "https://pod.example.com:8443/p"
+  );
+});
+
+test("workerUrl: port 443 implies https", () => {
+  assertEqual(
+    workerUrl({ type: "remote", host: "h", port: 443 }, "/p"),
+    "https://h:443/p"
+  );
+});
+
+test("workerUrl: missing host falls back to loopback, no port omits colon", () => {
+  assertEqual(workerUrl({ type: "local" }, "/p"), "http://127.0.0.1/p");
+});
+
+test("escapeHtml escapes the five specials and stringifies", () => {
+  assertEqual(
+    escapeHtml(`<b a="1" b='2'>&`),
+    "&lt;b a=&quot;1&quot; b=&#39;2&#39;&gt;&amp;"
+  );
+  assertEqual(escapeHtml(null), "");
+  assertEqual(escapeHtml(42), "42");
+});

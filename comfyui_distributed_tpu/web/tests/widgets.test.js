@@ -1,0 +1,96 @@
+/* Workflow widget math: override collection, divider clamping, JSON
+ * patching, worker defaults (reference web/tests: distributedValue +
+ * image_batch_divider + workerSettings coverage). */
+
+"use strict";
+
+import { assertEqual, test } from "./harness.js";
+import {
+  clampDividerParts,
+  collectOverrides,
+  findWidgetNodes,
+  nextWorkerDefaults,
+  parseChipList,
+  parseWorkflowText,
+  patchWorkflowText,
+} from "../modules/widgets.js";
+
+test("parseWorkflowText: bare and {prompt:...}-wrapped graphs", () => {
+  const graph = { "1": { class_type: "KSampler", inputs: {} } };
+  assertEqual(parseWorkflowText(JSON.stringify(graph)), graph);
+  assertEqual(parseWorkflowText(JSON.stringify({ prompt: graph })), graph);
+  assertEqual(parseWorkflowText("not json"), null);
+});
+
+test("patchWorkflowText merges inputs and preserves the wrapper", () => {
+  const text = JSON.stringify({
+    prompt: { "7": { class_type: "DistributedValue", inputs: { value: "x" } } },
+  });
+  const patched = patchWorkflowText(text, "7", { overrides: { _type: "INT" } });
+  const parsed = JSON.parse(patched);
+  assertEqual(parsed.prompt["7"].inputs, {
+    value: "x",
+    overrides: { _type: "INT" },
+  });
+});
+
+test("patchWorkflowText: unknown node or bad JSON returns null", () => {
+  assertEqual(patchWorkflowText("{}", "9", { a: 1 }), null);
+  assertEqual(patchWorkflowText("garbage", "9", { a: 1 }), null);
+});
+
+test("collectOverrides: 1-indexed slots, empties omitted, type guarded", () => {
+  assertEqual(
+    collectOverrides("INT", [
+      { slot: 1, value: "5" },
+      { slot: 2, value: "" },
+      { slot: 3, value: "7" },
+    ]),
+    { _type: "INT", "1": "5", "3": "7" }
+  );
+  assertEqual(collectOverrides("BOGUS", []), { _type: "STRING" });
+});
+
+test("clampDividerParts: [1, 10] with junk tolerated", () => {
+  assertEqual(clampDividerParts(0), 1);
+  assertEqual(clampDividerParts(4), 4);
+  assertEqual(clampDividerParts(99), 10);
+  assertEqual(clampDividerParts("abc"), 1);
+  assertEqual(clampDividerParts(""), 1);
+});
+
+test("nextWorkerDefaults: next port above max, first unclaimed chip", () => {
+  const workers = [
+    { port: 8189, tpu_chips: [0] },
+    { port: 8191, tpu_chips: [1] },
+  ];
+  assertEqual(nextWorkerDefaults(workers, [0, 1, 2, 3]), {
+    port: 8192,
+    chip: [2],
+  });
+});
+
+test("nextWorkerDefaults: empty config starts at 8189, no chips known", () => {
+  assertEqual(nextWorkerDefaults([], []), { port: 8189, chip: [] });
+  assertEqual(nextWorkerDefaults(undefined, undefined), { port: 8189, chip: [] });
+});
+
+test("parseChipList tolerates spaces, junk, and empties", () => {
+  assertEqual(parseChipList("0,1, 2"), [0, 1, 2]);
+  assertEqual(parseChipList(""), []);
+  assertEqual(parseChipList("a,1,"), [1]);
+});
+
+test("findWidgetNodes picks value + divider nodes only", () => {
+  const prompt = {
+    "1": { class_type: "KSampler" },
+    "2": { class_type: "DistributedValue", inputs: {} },
+    "3": { class_type: "ImageBatchDivider", inputs: { divide_by: 3 } },
+    "4": { class_type: "AudioBatchDivider", inputs: {} },
+  };
+  assertEqual(
+    findWidgetNodes(prompt).map(({ nodeId, kind }) => [nodeId, kind]),
+    [["2", "value"], ["3", "divider"], ["4", "divider"]]
+  );
+  assertEqual(findWidgetNodes(null), []);
+});

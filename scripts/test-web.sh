@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Web (JS) test suite. The suite is plain ES modules with its own tiny
+# harness (web/tests/harness.js) because this image ships no JS
+# runtime; on machines with node it runs headlessly, elsewhere open
+# comfyui_distributed_tpu/web/tests/runner.html in any browser.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if command -v node >/dev/null 2>&1; then
+  exec node comfyui_distributed_tpu/web/tests/run-node.mjs
+fi
+echo "skip: no JS runtime (node) on this machine."
+echo "open comfyui_distributed_tpu/web/tests/runner.html in a browser to run the suite."
+exit 0

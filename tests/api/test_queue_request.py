@@ -61,9 +61,13 @@ def test_panel_js_references_only_registered_routes():
     import re
 
     root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    app_js = open(
-        os.path.join(root, "comfyui_distributed_tpu", "web", "app.js")
-    ).read()
+    web_dir = os.path.join(root, "comfyui_distributed_tpu", "web")
+    app_js = ""
+    for sub in ("", "modules"):
+        folder = os.path.join(web_dir, sub)
+        for name in sorted(os.listdir(folder)):
+            if name.endswith(".js"):
+                app_js += open(os.path.join(folder, name)).read()
     called = set(re.findall(r'"(/distributed/[a-z_/]+)', app_js))
     called |= {
         p.split("${")[0].rstrip("/")

@@ -1,0 +1,281 @@
+"""Structural validation of the web panel's JS modules.
+
+This image ships no JS runtime (no node, no browser, no embeddable
+engine — verified), so the web test suite (web/tests/, run via
+`scripts/test-web.sh` under node, or web/tests/runner.html in any
+browser) cannot execute in CI here. These tests are the CI-side
+integrity net instead: a small JS lexer strips strings / template
+literals / comments / regex literals and checks delimiter balance
+(catches truncation and quoting bugs), the import graph is
+cross-checked against actual exports (catches renamed/missing
+symbols — the classic modular-split failure), and every DOM id the
+wiring references must exist in index.html or be created dynamically.
+
+Reference parallel: the reference runs web/tests/ under vitest in CI
+(reference vitest.config.js, .github/workflows/publish_action.yml);
+this is the equivalent drift net for an image without node.
+"""
+
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+WEB_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "comfyui_distributed_tpu",
+    "web",
+)
+
+
+def _js_files():
+    found = []
+    for root, _dirs, names in os.walk(WEB_DIR):
+        for name in names:
+            if name.endswith((".js", ".mjs")):
+                found.append(os.path.join(root, name))
+    return sorted(found)
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+# --- a tiny JS lexer: blank out strings/comments/regex ---------------------
+
+_REGEX_PRECEDERS = set("=([{,;:!&|?+-*%^~<>")
+
+
+def strip_js_literals(src: str) -> str:
+    """Replace the contents of strings, template literals, comments and
+    regex literals with spaces, preserving length and structural
+    delimiters outside them. Template ${...} interiors are preserved
+    (they are code)."""
+    out = list(src)
+    i = 0
+    n = len(src)
+    # stack entries: "`"=template text, "${"=template expression hole
+    template_stack: list[str] = []
+    last_sig = ""  # last significant (non-space) char emitted as code
+
+    def blank(j):
+        if out[j] not in "\n":
+            out[j] = " "
+
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            while i < n and src[i] != "\n":
+                blank(i)
+                i += 1
+            continue
+        if c == "/" and nxt == "*":
+            blank(i); blank(i + 1)
+            i += 2
+            while i < n and not (src[i] == "*" and i + 1 < n and src[i + 1] == "/"):
+                blank(i)
+                i += 1
+            if i < n:
+                blank(i); blank(i + 1)
+                i += 2
+            continue
+        if c in "'\"":
+            quote = c
+            i += 1
+            while i < n and src[i] != quote:
+                if src[i] == "\\":
+                    blank(i)
+                    i += 1
+                if i < n:
+                    blank(i)
+                    i += 1
+            i += 1
+            continue
+        if c == "`":
+            template_stack.append("`")
+            i += 1
+            while i < n and template_stack and template_stack[-1] == "`":
+                if src[i] == "\\":
+                    blank(i); i += 1
+                    if i < n:
+                        blank(i); i += 1
+                    continue
+                if src[i] == "`":
+                    template_stack.pop()
+                    i += 1
+                    break
+                if src[i] == "$" and i + 1 < n and src[i + 1] == "{":
+                    # expression hole: leave `${` visible, recurse via
+                    # the main loop by pushing a hole marker
+                    template_stack.append("${")
+                    i += 2
+                    break
+                blank(i)
+                i += 1
+            continue
+        if c == "}" and template_stack and template_stack[-1] == "${":
+            # end of template hole: resume blanking template text
+            template_stack.pop()
+            i += 1
+            # continue blanking the template text until ` or next hole
+            while i < n and template_stack and template_stack[-1] == "`":
+                if src[i] == "\\":
+                    blank(i); i += 1
+                    if i < n:
+                        blank(i); i += 1
+                    continue
+                if src[i] == "`":
+                    template_stack.pop()
+                    i += 1
+                    break
+                if src[i] == "$" and i + 1 < n and src[i + 1] == "{":
+                    template_stack.append("${")
+                    i += 2
+                    break
+                blank(i)
+                i += 1
+            continue
+        if c == "/" and last_sig and (
+            last_sig in _REGEX_PRECEDERS or last_sig == "n"
+            and re.search(r"\breturn$", "".join(out[max(0, i - 8):i]).strip() or "")
+        ):
+            # regex literal (heuristic: '/' can't be division here)
+            blank(i)
+            i += 1
+            in_class = False
+            while i < n:
+                ch = src[i]
+                if ch == "\\":
+                    blank(i); i += 1
+                    if i < n:
+                        blank(i); i += 1
+                    continue
+                if ch == "[":
+                    in_class = True
+                elif ch == "]":
+                    in_class = False
+                elif ch == "/" and not in_class:
+                    blank(i)
+                    i += 1
+                    while i < n and src[i].isalpha():  # flags
+                        blank(i)
+                        i += 1
+                    break
+                blank(i)
+                i += 1
+            continue
+        if not c.isspace():
+            last_sig = c
+        i += 1
+    return "".join(out)
+
+
+def test_lexer_selftest():
+    """The stripper itself must handle the constructs the panel uses."""
+    src = r'''s = "a{b" + `t${x ? "}" : "{"}u` + /[&<>"']{2}/g + y / 2; // {'''
+    stripped = strip_js_literals(src)
+    assert stripped.count("{") == stripped.count("}"), stripped
+    assert '"a{b"' not in stripped
+    assert "[&" not in stripped, "regex literal must be blanked"
+    assert "/ 2" in stripped, "division must survive"
+    src2 = "/* {{{ */ const a = {b: 1};"
+    assert strip_js_literals(src2).count("{") == 1
+
+
+@pytest.mark.parametrize("path", _js_files(), ids=lambda p: os.path.relpath(p, WEB_DIR))
+def test_balanced_delimiters(path):
+    stripped = strip_js_literals(_read(path))
+    stack = []
+    pairs = {")": "(", "]": "[", "}": "{"}
+    for lineno, line in enumerate(stripped.splitlines(), 1):
+        for ch in line:
+            if ch in "([{":
+                stack.append((ch, lineno))
+            elif ch in ")]}":
+                assert stack, f"{path}:{lineno}: unmatched {ch}"
+                opener, where = stack.pop()
+                assert opener == pairs[ch], (
+                    f"{path}:{lineno}: {ch} closes {opener} from line {where}"
+                )
+    assert not stack, f"{path}: unclosed {stack[-3:]}"
+
+
+# --- import graph ----------------------------------------------------------
+
+_IMPORT_RE = re.compile(
+    r'import\s*(?:{([^}]*)}\s*from\s*)?["\'](\./[^"\']+|\.\./[^"\']+)["\']'
+)
+_EXPORT_RE = re.compile(
+    r"export\s+(?:async\s+)?(?:function|const|let|class)\s+([A-Za-z_$][\w$]*)"
+)
+_EXPORT_LIST_RE = re.compile(r"export\s*{([^}]*)}")
+
+
+def _exports_of(path, seen=None):
+    seen = seen or set()
+    if path in seen:
+        return set()
+    seen.add(path)
+    src = _read(path)
+    names = set(_EXPORT_RE.findall(src))
+    for group in _EXPORT_LIST_RE.findall(src):
+        for item in group.split(","):
+            item = item.strip()
+            if item:
+                names.add(item.split(" as ")[-1].strip())
+    return names
+
+
+def test_imports_resolve_and_names_exist():
+    for path in _js_files():
+        src = _read(path)
+        for names, rel in _IMPORT_RE.findall(src):
+            target = os.path.normpath(os.path.join(os.path.dirname(path), rel))
+            assert os.path.exists(target), f"{path}: import of missing {rel}"
+            if not names:
+                continue
+            exported = _exports_of(target)
+            for name in names.split(","):
+                name = name.strip()
+                if not name:
+                    continue
+                name = name.split(" as ")[0].strip()
+                assert name in exported, (
+                    f"{path}: imports {name!r} which {rel} does not export "
+                    f"(exports: {sorted(exported)})"
+                )
+
+
+def test_every_test_module_is_registered():
+    tests_dir = os.path.join(WEB_DIR, "tests")
+    index = _read(os.path.join(tests_dir, "index.js"))
+    for name in os.listdir(tests_dir):
+        if name.endswith(".test.js"):
+            assert f"./{name}" in index, f"web/tests/index.js must import {name}"
+
+
+# --- DOM id drift ----------------------------------------------------------
+
+# ids created at runtime (modal form fields, per-node widgets, banner)
+_DYNAMIC_ID_PREFIXES = (
+    "wf-", "divider-used-", "use-recommended-ip", "vocab-banner-dismiss",
+)
+
+
+def test_dom_ids_exist_in_index_html():
+    html = _read(os.path.join(WEB_DIR, "index.html"))
+    static_ids = set(re.findall(r'id="([^"]+)"', html))
+    for path in _js_files():
+        if os.sep + "tests" + os.sep in path:
+            continue
+        for ref in re.findall(r'getElementById\(\s*"([^"$]+)"\s*\)', _read(path)):
+            if ref.startswith(_DYNAMIC_ID_PREFIXES):
+                continue
+            assert ref in static_ids, (
+                f"{os.path.relpath(path, WEB_DIR)} references #{ref} "
+                "which index.html does not define"
+            )
