@@ -49,6 +49,7 @@ _SLOW_PATHS = (
     "tests/api/test_usdu_integration.py",
     "tests/api/test_concurrency.py",
     "tests/api/test_delegate_mode.py",
+    "tests/golden",
 )
 
 
