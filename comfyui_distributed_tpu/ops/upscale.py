@@ -27,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import pipeline as pl
 from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..utils.constants import TILE_SCAN_BATCH
 from . import samplers as smp
 from . import tiles as tile_ops
 
@@ -268,11 +269,62 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise,
     return fn
 
 
+def _wraparound_pad(arrs, total: int):
+    """Pad leading axes to `total` by wrapping — duplicates later share
+    folded keys (idx % t) so they compute identical results and the
+    surplus is sliced off."""
+    t = arrs[0].shape[0]
+    reps = -(-total // t)
+    return [jnp.concatenate([a] * reps, axis=0)[:total] for a in arrs]
+
+
+def _scan_tiles(one, extracted, keys, positions, tile_batch: int):
+    """Scan the tile axis in groups of `tile_batch`, vmapping
+    one(tile, key, yx) across each group. K=1 is the reference scan;
+    K>1 turns the batch-1 UNet/VAE convs into batch-K programs — the
+    MXU-idiomatic shape (one tile's batch-1 matmuls leave most of the
+    systolic array idle). A remainder of num % K tiles runs as one
+    smaller vmapped group (a second compiled shape) rather than as
+    full-cost wraparound duplicates. Results are tile-batch-
+    independent: keys are folded from GLOBAL tile indices by the
+    caller, grouping only changes how many tiles share one dispatch."""
+    num = extracted.shape[0]
+    k = max(1, min(tile_batch, num))
+    if k == 1:
+        def body(_, inp):
+            return None, one(*inp)
+
+        _, out = jax.lax.scan(body, None, (extracted, keys, positions))
+        return out
+
+    n_full = num // k
+    split = n_full * k
+    outs = []
+    if n_full:
+        grouped = (
+            extracted[:split].reshape(n_full, k, *extracted.shape[1:]),
+            # keep trailing dims: legacy uint32 PRNGKeys are [T, 2]
+            keys[:split].reshape(n_full, k, *keys.shape[1:]),
+            positions[:split].reshape(n_full, k, *positions.shape[1:]),
+        )
+
+        def body(_, inp):
+            return None, jax.vmap(one)(*inp)
+
+        _, full = jax.lax.scan(body, None, grouped)
+        outs.append(full.reshape(split, *full.shape[2:]))
+    if split < num:
+        outs.append(
+            jax.vmap(one)(extracted[split:], keys[split:], positions[split:])
+        )
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "bundle_static", "grid", "steps", "sampler", "scheduler", "cfg",
-        "denoise", "tiled_decode",
+        "denoise", "tiled_decode", "tile_batch",
     ),
 )
 def upscale_single(
@@ -289,6 +341,7 @@ def upscale_single(
     cfg: float,
     denoise: float,
     tiled_decode: bool = False,
+    tile_batch: int = 1,
 ):
     """All tiles processed on the local device via lax.scan."""
     bundle = bundle_static.value
@@ -298,15 +351,16 @@ def upscale_single(
     process = _process_tile_fn(
         bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
     )
-    tile_indices = jnp.arange(grid.num_tiles)
-    positions = grid.positions_array()
+    keys = jax.vmap(lambda g: jax.random.fold_in(key, g))(
+        jnp.arange(grid.num_tiles)
+    )
 
-    def body(_, inp):
-        tile, gidx, yx = inp
-        tkey = jax.random.fold_in(key, gidx)
-        return None, process(params, tile, tkey, pos, neg, yx)
+    def one(tile, tkey, yx):
+        return process(params, tile, tkey, pos, neg, yx)
 
-    _, processed = jax.lax.scan(body, None, (extracted, tile_indices, positions))
+    processed = _scan_tiles(
+        one, extracted, keys, grid.positions_array(), tile_batch
+    )
     return tile_ops.blend_tiles(processed, grid)
 
 
@@ -314,7 +368,7 @@ def upscale_single(
     jax.jit,
     static_argnames=(
         "bundle_static", "mesh_static", "grid", "steps", "sampler",
-        "scheduler", "cfg", "denoise", "tiled_decode",
+        "scheduler", "cfg", "denoise", "tiled_decode", "tile_batch",
     ),
 )
 def upscale_mesh(
@@ -332,12 +386,15 @@ def upscale_mesh(
     cfg: float,
     denoise: float,
     tiled_decode: bool = False,
+    tile_batch: int = 1,
 ):
     """Tile axis sharded over the mesh data axis; all-gather + blend.
 
     Static sharding (every chip gets ceil(T/n) tiles) is the TPU fast
     path — the reference's dynamic work-stealing only pays off for
     heterogeneous participants, which inside a slice don't exist.
+    tile_batch groups each chip's scan the same way as the local path
+    (the per-chip program is _scan_tiles with num_tiles=shard size).
     """
     bundle = bundle_static.value
     mesh = mesh_static.value
@@ -356,18 +413,17 @@ def upscale_mesh(
     if total > t:
         # wrap-around padding: works even when t < n (tiny images on
         # wide meshes); padded duplicates are sliced off after gather
-        reps = -(-total // t)
-        extracted = jnp.concatenate([extracted] * reps, axis=0)[:total]
-        positions = jnp.concatenate([positions] * reps, axis=0)[:total]
+        extracted, positions = _wraparound_pad([extracted, positions], total)
     global_idx = jnp.arange(total)
 
     def per_chip_fn(tiles_shard, idx_shard, yx_shard, params, pos, neg):
-        def body(_, inp):
-            tile, gidx, yx = inp
-            tkey = jax.random.fold_in(key, gidx % t)  # padded dups share keys
-            return None, process(params, tile, tkey, pos, neg, yx)
+        # padded dups share keys: fold the GLOBAL tile index mod t
+        keys = jax.vmap(lambda g: jax.random.fold_in(key, g % t))(idx_shard)
 
-        _, processed = jax.lax.scan(body, None, (tiles_shard, idx_shard, yx_shard))
+        def one(tile, tkey, yx):
+            return process(params, tile, tkey, pos, neg, yx)
+
+        processed = _scan_tiles(one, tiles_shard, keys, yx_shard, tile_batch)
         return jax.lax.all_gather(processed, DATA_AXIS, axis=0, tiled=True)
 
     gathered = jax.shard_map(
@@ -400,9 +456,19 @@ def run_upscale(
     mask_blur: int = 0,
     tiled_decode: bool = False,
     uniform: bool = True,
+    tile_batch: int | None = None,
 ) -> jax.Array:
     """Full upscale: resize then tile-rediffuse. Routes to the mesh
-    path when a multi-participant mesh is available."""
+    path when a multi-participant mesh is available.
+
+    tile_batch (or env CDT_TILE_BATCH, default 1) groups the tile scan
+    so the diffusion runs batch-K programs — on TPU, batch-1 convs
+    leave most of the MXU idle; K=4-8 amortizes dispatch and fills the
+    systolic array. K=1 preserves the committed golden numerics
+    bit-for-bit; batched grouping is allclose but not bit-identical
+    (batched conv reduction order differs)."""
+    if tile_batch is None:
+        tile_batch = TILE_SCAN_BATCH
     upscaled, grid, _ = prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
         mask_blur=mask_blur, uniform=uniform,
@@ -416,12 +482,12 @@ def run_upscale(
         return upscale_mesh(
             pl._Static(bundle), pl._Static(mesh), params, upscaled, pos_p,
             neg_p, key, grid, int(steps), sampler, scheduler, float(cfg),
-            float(denoise), bool(tiled_decode),
+            float(denoise), bool(tiled_decode), int(tile_batch),
         )
     return upscale_single(
         pl._Static(bundle), bundle.params, upscaled, pos, neg, key, grid,
         int(steps), sampler, scheduler, float(cfg), float(denoise),
-        bool(tiled_decode),
+        bool(tiled_decode), int(tile_batch),
     )
 
 
@@ -441,10 +507,15 @@ def _jitted_for_flops(
     denoise: float = 0.35,
     upscale_method: str = "bicubic",
     tile_h: int | None = None,
+    tile_batch: int | None = None,
 ) -> float | None:
     """XLA-estimated FLOPs of ONE full upscale program with these args
     (whole mesh, all tiles) — the numerator of the bench's MFU. Returns
-    None when the backend exposes no cost analysis."""
+    None when the backend exposes no cost analysis. tile_batch resolves
+    exactly like run_upscale so the program costed is the program the
+    bench times."""
+    if tile_batch is None:
+        tile_batch = TILE_SCAN_BATCH
     upscaled, grid, _ = prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h
     )
@@ -454,13 +525,13 @@ def _jitted_for_flops(
             lowered = upscale_mesh.lower(
                 pl._Static(bundle), pl._Static(mesh), bundle.params, upscaled,
                 pos, neg, key, grid, int(steps), sampler, scheduler,
-                float(cfg), float(denoise),
+                float(cfg), float(denoise), tile_batch=int(tile_batch),
             )
         else:
             lowered = upscale_single.lower(
                 pl._Static(bundle), bundle.params, upscaled, pos, neg, key,
                 grid, int(steps), sampler, scheduler, float(cfg),
-                float(denoise),
+                float(denoise), tile_batch=int(tile_batch),
             )
         analysis = lowered.compile().cost_analysis()
         if isinstance(analysis, list):

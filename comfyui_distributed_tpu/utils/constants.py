@@ -47,6 +47,10 @@ COLLECTOR_WAIT_SLICES = _env_int("CDT_COLLECTOR_WAIT_SLICES", 20)
 MAX_PAYLOAD_SIZE = _env_int("CDT_MAX_PAYLOAD_SIZE", 50 * 1024 * 1024)
 PAYLOAD_HEADROOM = 1024 * 1024
 MAX_TILE_BATCH = _env_int("CDT_MAX_BATCH", 20)
+# Tiles diffused per scan step in the USDU compute core (batch-K UNet/
+# VAE programs; MXU utilization knob). 1 = reference numerics
+# (bit-identical to the committed goldens); >1 is allclose.
+TILE_SCAN_BATCH = _env_int("CDT_TILE_BATCH", 1)
 MAX_AUDIO_PAYLOAD_BYTES = _env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
 
 # --- orchestration concurrency ------------------------------------------

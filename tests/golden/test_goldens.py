@@ -36,9 +36,12 @@ def test_pipelines_match_goldens():
     )
     env = dict(os.environ)
     # pin the exact client the goldens were generated under: 1-device
-    # CPU, no inherited multi-device XLA_FLAGS from conftest
+    # CPU, no inherited multi-device XLA_FLAGS from conftest, no
+    # numerics-shifting perf knobs from the caller's shell
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CDT_TILE_BATCH", None)
+    env.pop("CDT_BLEND", None)
     proc = subprocess.run(
         [sys.executable, _SCRIPT, "--check"],
         capture_output=True, text=True, timeout=1200, cwd=_REPO, env=env,

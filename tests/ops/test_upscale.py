@@ -46,7 +46,7 @@ def test_mesh_matches_single(bundle):
     pos = pl.encode_text(bundle, ["p"])
     neg = pl.encode_text(bundle, [""])
     kwargs = dict(upscale_by=2.0, tile=64, padding=16, steps=2,
-                  denoise=0.4, seed=7)
+                  denoise=0.4, seed=7, tile_batch=1)  # K=1: bit-parity property
     single = up.run_upscale(bundle, img, pos, neg, mesh=None, **kwargs)
     mesh = build_mesh({"data": 8})
     sharded = up.run_upscale(bundle, img, pos, neg, mesh=mesh, **kwargs)
@@ -56,6 +56,67 @@ def test_mesh_matches_single(bundle):
     # and the mesh result is deterministic
     again = up.run_upscale(bundle, img, pos, neg, mesh=mesh, **kwargs)
     np.testing.assert_array_equal(np.asarray(sharded), np.asarray(again))
+
+
+def test_tile_batch_matches_unbatched(bundle):
+    """Grouping the tile scan (CDT_TILE_BATCH) must not change the
+    image beyond batched-conv reduction-order noise: same folded
+    per-tile keys, same blend. K=3 on a 4-tile grid exercises the
+    wraparound remainder group; K larger than the grid clamps."""
+    img = _image()
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    kwargs = dict(upscale_by=2.0, tile=64, padding=16, steps=2,
+                  denoise=0.4, seed=7)
+    base = np.asarray(
+        up.run_upscale(bundle, img, pos, neg, mesh=None, tile_batch=1, **kwargs)
+    )
+    for k in (3, 99):
+        batched = np.asarray(
+            up.run_upscale(
+                bundle, img, pos, neg, mesh=None, tile_batch=k, **kwargs
+            )
+        )
+        np.testing.assert_allclose(base, batched, atol=2e-2, rtol=0)
+
+
+def test_tile_batch_accepts_legacy_prngkey(bundle):
+    """Direct callers may pass a legacy uint32 PRNGKey ([2]-shaped);
+    the grouped keys reshape must preserve trailing dims."""
+    import jax
+
+    img = _image()
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    upscaled, grid, _ = up.prepare_upscaled_tiles(img, 2.0, 64, 16)
+    out = up.upscale_single(
+        pl._Static(bundle), bundle.params, upscaled, pos, neg,
+        jax.random.PRNGKey(7), grid, 2, "euler", "karras", 7.0, 0.4,
+        False, 3,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_tile_batch_mesh_matches_single(bundle):
+    img = _image()
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    kwargs = dict(upscale_by=2.0, tile=64, padding=16, steps=2,
+                  denoise=0.4, seed=7)
+    single = up.run_upscale(
+        bundle, img, pos, neg, mesh=None, tile_batch=1, **kwargs
+    )
+    # 2 chips × k=2 over the 4-tile grid: each chip runs one group of 2
+    import jax
+
+    mesh = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    sharded = up.run_upscale(
+        bundle, img, pos, neg, mesh=mesh, tile_batch=2, **kwargs
+    )
+    np.testing.assert_allclose(
+        np.asarray(single), np.asarray(sharded), atol=2e-2, rtol=0
+    )
 
 
 # --- round-2 honest knobs -------------------------------------------------
