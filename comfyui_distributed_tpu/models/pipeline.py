@@ -251,6 +251,15 @@ def _make_model_fn(bundle: PipelineBundle, params):
         out = bundle.unet.apply(
             params["unet"], x * c_in, t, context, y=y, control=control
         )
+        if getattr(get_config(bundle.model_name), "parameterization", "eps") == "v":
+            # SD2.x-768-class velocity prediction. With the VP scalings
+            # (c_skip = 1/(sigma^2+1), c_out = -sigma/sqrt(sigma^2+1)):
+            #   denoised = x/(sigma^2+1) - v*sigma/sqrt(sigma^2+1)
+            # Converted exactly to the sampler's eps contract
+            # (denoised = x - sigma*eps):
+            #   eps = x*sigma/(sigma^2+1) + v/sqrt(sigma^2+1)
+            sig = sigma_batch.reshape((-1,) + (1,) * (x.ndim - 1))
+            out = x * (sig / (sig**2 + 1.0)) + out / jnp.sqrt(sig**2 + 1.0)
         return out.astype(x.dtype)
 
     return model_fn

@@ -58,6 +58,20 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             num_heads=2,
         ),
     },
+    # tiny v-prediction variant (SD2.x-768-class parameterization):
+    # exercises the v->eps conversion through every sampler path
+    "tiny-unet-v": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=32,
+            channel_mult=(1, 2),
+            num_res_blocks=1,
+            transformer_depth=(1, 1),
+            context_dim=64,
+            num_heads=2,
+            parameterization="v",
+        ),
+    },
     # tiny SDXL-shaped variant: dual text encoders + pooled/size adm
     # conditioning (context 64+96, adm = 96 pooled + 6x256 size embs)
     "tiny-unet-adm": {

@@ -45,6 +45,10 @@ class UNetConfig:
     head_dim: Optional[int] = None
     # SDXL-style pooled text + size conditioning vector (0 = disabled)
     adm_in_channels: int = 0
+    # what the network predicts: "eps" (noise; SD1.x/SDXL base) or "v"
+    # (velocity; SD2.x-768 and v-pred finetunes). The pipeline converts
+    # v outputs to the sampler's eps contract exactly.
+    parameterization: str = "eps"
     dtype: str = "bfloat16"
     # rematerialise attention blocks: trades recompute for HBM, the
     # standard lever for big latents on 16GB chips

@@ -99,6 +99,21 @@ function setDot(id, cls) {
 
 // ---------- settings / topology ----------
 
+async function saveSetting(name, value) {
+  await api("/distributed/config/setting", {
+    method: "POST",
+    body: JSON.stringify({ name, value }),
+  });
+  if (state.config?.settings) state.config.settings[name] = value;
+}
+
+/** Header toggle mirrors the inverse of delegate-only mode (reference
+ * web/main.js master-participation toggle). */
+function syncMasterToggle() {
+  document.getElementById("master-participates").checked =
+    !state.config?.settings?.master_delegate_only;
+}
+
 function renderSettings() {
   const grid = document.createElement("div");
   grid.className = "settings-grid";
@@ -121,11 +136,8 @@ function renderSettings() {
     input.addEventListener("change", async () => {
       const value = kind === "checkbox" ? input.checked : Number(input.value);
       try {
-        await api("/distributed/config/setting", {
-          method: "POST",
-          body: JSON.stringify({ name, value }),
-        });
-        state.config.settings[name] = value;
+        await saveSetting(name, value);
+        if (name === "master_delegate_only") syncMasterToggle();
       } catch (err) {
         alert(`save failed: ${err.message}`);
       }
@@ -255,6 +267,7 @@ async function loadConfig() {
     document.getElementById("workers"), state.config, state.workerStatus
   );
   renderSettings();
+  syncMasterToggle();
 }
 
 function refreshWorkflowNodes() {
@@ -427,6 +440,17 @@ document
     state.nodesTimer = setTimeout(refreshWorkflowNodes, 400);
   });
 
+document
+  .getElementById("master-participates")
+  .addEventListener("change", async (event) => {
+    try {
+      await saveSetting("master_delegate_only", !event.target.checked);
+      renderSettings(); // keep the settings-grid checkbox in sync
+    } catch (err) {
+      event.target.checked = !event.target.checked; // revert on failure
+      alert(`save failed: ${err.message}`);
+    }
+  });
 document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
 document.getElementById("modal-close").addEventListener("click", hideModal);
 document.getElementById("queue-btn").addEventListener("click", queueWorkflow);

@@ -63,3 +63,45 @@ def test_dual_encoder_context_concat():
     assert cond.pooled.shape == (1, 96)
     # concat halves differ from zero-pad: second half must be nonzero
     assert float(jnp.abs(cond.context[..., 64:]).max()) > 0
+
+
+def test_v_parameterization_exact_conversion():
+    """tiny-unet-v shares weights with tiny-unet (identical module, same
+    init seed); its model_fn must equal the exact v->eps transform of
+    the raw network output: eps = x*s/(s^2+1) + v/sqrt(s^2+1)."""
+    import jax
+
+    eps_bundle = pl.load_pipeline("tiny-unet", seed=0)
+    v_bundle = pl.load_pipeline("tiny-unet-v", seed=0)
+    # same module tree + same init key => identical weights
+    chex_eq = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(
+            lambda a, b: bool((a == b).all()),
+            eps_bundle.params["unet"], v_bundle.params["unet"],
+        )
+    )
+    assert chex_eq, "tiny-unet-v must share tiny-unet's init weights"
+
+    raw_fn = pl._make_model_fn(eps_bundle, eps_bundle.params)   # eps: raw net
+    v_fn = pl._make_model_fn(v_bundle, v_bundle.params)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)), jnp.float32)
+    sigma = jnp.asarray([3.0, 0.5], jnp.float32)
+    ctx = jnp.asarray(rng.standard_normal((2, 7, 64)), jnp.float32)
+
+    raw = np.asarray(raw_fn(x, sigma, ctx), np.float32)
+    got = np.asarray(v_fn(x, sigma, ctx), np.float32)
+    s = np.asarray(sigma, np.float32).reshape(-1, 1, 1, 1)
+    want = np.asarray(x, np.float32) * (s / (s**2 + 1)) + raw / np.sqrt(s**2 + 1)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=0)  # bf16 net output
+
+
+def test_v_parameterization_txt2img_runs():
+    bundle = pl.load_pipeline("tiny-unet-v", seed=0)
+    img = np.asarray(
+        pl.txt2img(bundle, "v-pred", height=32, width=32, steps=2, seed=3)
+    )
+    assert img.shape == (1, 32, 32, 3)
+    assert np.isfinite(img).all()
+    assert (img >= 0).all() and (img <= 1).all()
