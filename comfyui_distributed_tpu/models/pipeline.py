@@ -58,7 +58,7 @@ def load_pipeline(
     Without a checkpoint the weights are deterministic random init —
     the distributed machinery upstream is weight-agnostic.
     """
-    from .registry import DUAL_TEXT_ENCODERS
+    from .registry import DEFAULT_TEXT_ENCODERS, DUAL_TEXT_ENCODERS
 
     tiny = model_name.startswith("tiny")
     dual = DUAL_TEXT_ENCODERS.get(model_name)
@@ -67,7 +67,9 @@ def load_pipeline(
         te_name = te_name or dual[0]
         te2_name = dual[1]
     else:
-        te_name = te_name or ("tiny-te" if tiny else "clip-l")
+        te_name = te_name or DEFAULT_TEXT_ENCODERS.get(model_name) or (
+            "tiny-te" if tiny else "clip-l"
+        )
         te2_name = None
 
     unet = create_model(model_name)

@@ -47,6 +47,32 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             remat=True,
         ),
     },
+    # SD2.1-768-v: SD1.x topology with OpenCLIP-H conditioning
+    # (context 1024), num_head_channels=64, velocity prediction
+    "sd21": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=320,
+            channel_mult=(1, 2, 4, 4),
+            transformer_depth=(1, 1, 1, 0),
+            context_dim=1024,
+            head_dim=64,
+            parameterization="v",
+            remat=True,
+        ),
+    },
+    # SD2.1-base (512px): same network, epsilon prediction
+    "sd21-base": {
+        "family": "unet",
+        "config": UNetConfig(
+            model_channels=320,
+            channel_mult=(1, 2, 4, 4),
+            transformer_depth=(1, 1, 1, 0),
+            context_dim=1024,
+            head_dim=64,
+            remat=True,
+        ),
+    },
     "tiny-unet": {
         "family": "unet",
         "config": UNetConfig(
@@ -179,6 +205,15 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             penultimate_hidden=True, proj_dim=1280,
         ),
     },
+    # OpenCLIP ViT-H/14 text tower (SD2.x conditioning; packed under
+    # cond_stage_model.model.* in SD2 single-file checkpoints)
+    "clip-h": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(
+            width=1024, layers=24, heads=16, activation="gelu",
+            penultimate_hidden=True, proj_dim=1024,
+        ),
+    },
     "tiny-te": {
         "family": "text_encoder",
         "config": TextEncoderConfig(width=64, layers=2, heads=2, max_length=16),
@@ -231,6 +266,12 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
 DUAL_TEXT_ENCODERS: dict[str, tuple[str, str]] = {
     "sdxl": ("clip-l-sdxl", "clip-g"),
     "tiny-unet-adm": ("tiny-te-l", "tiny-te-g"),
+}
+
+# Single-encoder models whose default differs from the CLIP-L fallback.
+DEFAULT_TEXT_ENCODERS: dict[str, str] = {
+    "sd21": "clip-h",
+    "sd21-base": "clip-h",
 }
 
 _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {

@@ -835,15 +835,25 @@ def load_sd_weights(
     kept at its init value (non-strict). Returns (trees, problems).
     """
     sdxl_layout = any(k.startswith("conditioner.embedders.") for k in state_dict)
-    te_prefix = (
-        "conditioner.embedders.0.transformer.text_model"
-        if sdxl_layout
-        else "cond_stage_model.transformer.text_model"
+    # SD2.x packs an OpenCLIP text tower under cond_stage_model.model.*
+    # (bare positional embedding, fused in_proj) — a third layout next
+    # to SD1.x's HF-CLIP and SDXL's conditioner.embedders.*
+    sd2_layout = not sdxl_layout and any(
+        k.startswith("cond_stage_model.model.") for k in state_dict
     )
+    if sd2_layout:
+        te_entries = open_clip_schedule(te_cfg, prefix="cond_stage_model.model")
+    else:
+        te_prefix = (
+            "conditioner.embedders.0.transformer.text_model"
+            if sdxl_layout
+            else "cond_stage_model.transformer.text_model"
+        )
+        te_entries = text_encoder_schedule(te_cfg, prefix=te_prefix)
     schedules = {
         "unet": unet_schedule(unet_cfg),
         "vae": vae_schedule(vae_cfg),
-        "te": text_encoder_schedule(te_cfg, prefix=te_prefix),
+        "te": te_entries,
     }
     if "te2" in templates:
         schedules["te2"] = open_clip_schedule(te2_cfg)

@@ -491,9 +491,26 @@ def build_all() -> dict[str, Manifest]:
     )
     sdxl.update(open_clip_text_manifest())
 
+    # SD2.1 (768-v and base share the layout): SD1.x UNet topology with
+    # context 1024 + linear transformer projections, SD VAE, OpenCLIP
+    # ViT-H text tower under cond_stage_model.model.*
+    sd21: Manifest = {}
+    sd21.update(
+        unet_manifest(
+            320, (1, 2, 4, 4), 2, (1, 1, 1, 0), 1024, adm=0, use_linear=True
+        )
+    )
+    sd21.update(vae_manifest())
+    sd21.update(
+        open_clip_text_manifest(
+            prefix="cond_stage_model.model", width=1024, layers=24
+        )
+    )
+
     return {
         "sd15": sd15,
         "sdxl": sdxl,
+        "sd21": sd21,
         "wan21_1_3b_dit": wan_dit_manifest(1536, 8960, 30),
         "wan21_14b_dit": wan_dit_manifest(5120, 13824, 40),
         "wan21_14b_i2v_dit": wan_dit_manifest(

@@ -199,6 +199,27 @@ def test_sdxl_open_clip_schedule_matches_manifest():
     _assert_matches(derived, sub, proj_conv_keys=False)
 
 
+# --- SD2.1 -----------------------------------------------------------------
+
+def test_sd21_unet_schedule_matches_manifest():
+    manifest = _manifest("sd21")
+    sub = {k: v for k, v in manifest.items() if k.startswith("model.diffusion_model.")}
+    derived = _schedule_sd_shapes(sdc.unet_schedule(get_config("sd21")), "sd21")
+    # SD2 uses linear transformer projections (like SDXL): no (1,1)
+    # conv-tail tolerance
+    _assert_matches(derived, sub, proj_conv_keys=False)
+
+
+def test_sd21_open_clip_schedule_matches_manifest():
+    manifest = _manifest("sd21")
+    prefix = "cond_stage_model.model"
+    sub = {k: v for k, v in manifest.items() if k.startswith(prefix)}
+    derived = _schedule_sd_shapes(
+        sdc.open_clip_schedule(get_config("clip-h"), prefix=prefix), "clip-h"
+    )
+    _assert_matches(derived, sub, proj_conv_keys=False)
+
+
 # --- WAN -------------------------------------------------------------------
 
 @pytest.mark.parametrize(
@@ -257,6 +278,19 @@ HAND_PINNED = {
         "conditioner.embedders.1.model.transformer.resblocks.31.attn.in_proj_weight": (3840, 1280),
         "conditioner.embedders.1.model.text_projection": (1280, 1280),
         "conditioner.embedders.1.model.positional_embedding": (77, 1280),
+    },
+    "sd21": {
+        # v2-1_768-ema-pruned as listed by checkpoint inspectors:
+        # linear transformer projections (2-D), OpenCLIP-H context 1024
+        "model.diffusion_model.input_blocks.1.1.proj_in.weight": (320, 320),
+        "model.diffusion_model.input_blocks.1.1.transformer_blocks.0.attn2.to_k.weight": (320, 1024),
+        "model.diffusion_model.middle_block.1.proj_in.weight": (1280, 1280),
+        "model.diffusion_model.out.2.weight": (4, 320, 3, 3),
+        "cond_stage_model.model.token_embedding.weight": (49408, 1024),
+        "cond_stage_model.model.positional_embedding": (77, 1024),
+        "cond_stage_model.model.transformer.resblocks.23.attn.in_proj_weight": (3072, 1024),
+        "cond_stage_model.model.text_projection": (1024, 1024),
+        "cond_stage_model.model.ln_final.weight": (1024,),
     },
     "wan21_1_3b_dit": {
         "patch_embedding.weight": (1536, 16, 1, 2, 2),

@@ -303,3 +303,32 @@ def test_sdxl_text_prefix_detected():
     want = flatten_params(jax.device_get(te2_p))
     for key in want:
         np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+
+
+def test_sd2_openclip_te_prefix_detected():
+    """A checkpoint with cond_stage_model.model.* keys (SD2.x layout:
+    OpenCLIP tower, bare positional embedding, fused in_proj) maps the
+    text encoder through open_clip_schedule, not the HF-CLIP prefix."""
+    te_cfg, te_p = _template("tiny-te-g", "te")  # OpenCLIP-shaped tiny TE
+    unet_cfg, unet_p = _template("tiny-unet", "unet")
+    vae_cfg, vae_p = _template("tiny-vae", "vae")
+
+    state_dict = {}
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(unet_p)), sdc.unet_schedule(unet_cfg)))
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(vae_p)), sdc.vae_schedule(vae_cfg)))
+    state_dict.update(sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(te_p)),
+        sdc.open_clip_schedule(te_cfg, prefix="cond_stage_model.model"),
+    ))
+
+    out, problems = sdc.load_sd_weights(
+        state_dict, unet_cfg, vae_cfg, te_cfg,
+        {"unet": unet_p, "vae": vae_p, "te": te_p},
+    )
+    assert problems == []
+    got = flatten_params(out["te"])
+    want = flatten_params(jax.device_get(te_p))
+    for key in want:
+        np.testing.assert_array_equal(got[key], want[key], err_msg=key)
