@@ -35,6 +35,9 @@ class PipelineBundle:
     latent_scale: int = 8           # spatial down factor of the VAE
     # SDXL-class second encoder (context concat + pooled source)
     text_encoder_2: Any = None
+    # second encoder's tokenizer: OpenCLIP towers pad with 0, CLIP-L
+    # with EOS, so the dual path tokenizes per encoder (None = share)
+    tokenizer_2: Tokenizer | None = None
     # registry names the encoders were built from (LoRA mapping needs
     # the real configs, not a guess from model_name)
     te_name: str | None = None
@@ -134,10 +137,19 @@ def load_pipeline(
         vae=vae,
         text_encoder=te,
         params=params,
-        tokenizer=Tokenizer(max_length=te_cfg.max_length),
+        tokenizer=Tokenizer(
+            max_length=te_cfg.max_length, pad_id=te_cfg.pad_token_id
+        ),
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
         text_encoder_2=te2,
+        tokenizer_2=(
+            Tokenizer(
+                max_length=te2_cfg.max_length, pad_id=te2_cfg.pad_token_id
+            )
+            if te2_name
+            else None
+        ),
         te_name=te_name,
         te2_name=te2_name,
     )
@@ -159,8 +171,10 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
         bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
     )
     if bundle.text_encoder_2 is not None:
+        tok2 = bundle.tokenizer_2 or bundle.tokenizer
+        tokens2 = jnp.asarray(tok2.encode_batch(texts))
         hidden2, pooled2 = bundle.text_encoder_2.apply(
-            bundle.params["te2"], tokens, eos_id=bundle.tokenizer.eos_id
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
         )
         hidden = jnp.concatenate(
             [hidden.astype(jnp.float32), hidden2.astype(jnp.float32)], axis=-1

@@ -203,15 +203,19 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
         "config": TextEncoderConfig(
             width=1280, layers=32, heads=20, activation="gelu",
             penultimate_hidden=True, proj_dim=1280,
+            pad_token_id=0,  # open_clip.tokenize pads with 0, not EOS
         ),
     },
     # OpenCLIP ViT-H/14 text tower (SD2.x conditioning; packed under
-    # cond_stage_model.model.* in SD2 single-file checkpoints)
+    # cond_stage_model.model.* in SD2 single-file checkpoints).
+    # final_ln_on_hidden: SD2 norms the penultimate context (ComfyUI
+    # SD2ClipHModel layer_norm_hidden_state=True); SDXL's bigG doesn't.
     "clip-h": {
         "family": "text_encoder",
         "config": TextEncoderConfig(
             width=1024, layers=24, heads=16, activation="gelu",
             penultimate_hidden=True, proj_dim=1024,
+            final_ln_on_hidden=True, pad_token_id=0,
         ),
     },
     "tiny-te": {
@@ -229,7 +233,7 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
         "family": "text_encoder",
         "config": TextEncoderConfig(
             width=96, layers=2, heads=2, max_length=16, activation="gelu",
-            penultimate_hidden=True, proj_dim=96,
+            penultimate_hidden=True, proj_dim=96, pad_token_id=0,
         ),
     },
     # --- T5-class encoders (WAN conditioning; UMT5-XXL dims) ---

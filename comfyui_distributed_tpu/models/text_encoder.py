@@ -36,6 +36,15 @@ class TextEncoderConfig:
     # SDXL encoders expose the PENULTIMATE block's hidden states (no
     # final LN) as the context; pooled always comes from the full stack
     penultimate_hidden: bool = False
+    # SD2 (OpenCLIP-H) applies the model's final LayerNorm to the
+    # penultimate hidden before it becomes cross-attention context
+    # (ComfyUI SD2ClipHModel layer_norm_hidden_state=True); SDXL's
+    # encoders do not. Ignored unless penultimate_hidden.
+    final_ln_on_hidden: bool = False
+    # Token id used to pad after EOS. None = pad with EOS (OpenAI
+    # CLIP-L convention, SD1.x/SDXL clip-l); OpenCLIP towers (SDXL
+    # bigG, SD2 ViT-H) pad with 0 (open_clip.tokenize).
+    pad_token_id: Optional[int] = None
     # OpenCLIP text_projection: pooled = eos_state @ W [width, proj_dim]
     proj_dim: Optional[int] = None
 
@@ -47,9 +56,11 @@ class TextEncoderConfig:
 class Tokenizer:
     """CLIP BPE tokenizer with BOS/EOS, fixed-length padded output.
 
-    CLIP conventions throughout: `<bos> tokens[:max-2] <eos>`, padded
-    with the EOS id (CLIP's pad token is endoftext), ids identical on
-    every host that shares the committed vocab assets.
+    Layout: `<bos> tokens[:max-2] <eos>` then padding. The pad token
+    is per-encoder: the CLIP-L convention (default, pad_id=None) pads
+    with the EOS id; OpenCLIP towers (SDXL bigG, SD2 ViT-H) pad with
+    0, matching open_clip.tokenize. Ids are identical on every host
+    that shares the committed vocab assets.
     """
 
     # CLIP id layout (the committed vocab reproduces it exactly; a
@@ -57,18 +68,25 @@ class Tokenizer:
     BOS = 49406
     EOS = 49407
 
-    def __init__(self, max_length: int = 77, vocab_path: Optional[str] = None):
+    def __init__(
+        self,
+        max_length: int = 77,
+        vocab_path: Optional[str] = None,
+        pad_id: Optional[int] = None,
+    ):
         from .clip_bpe import get_bpe
 
         self.max_length = max_length
         self.bpe = get_bpe(vocab_path)
         self.bos_id = self.bpe.bos_id
         self.eos_id = self.bpe.eos_id
+        # None = CLIP-L convention (pad with EOS); OpenCLIP pads with 0
+        self.pad_id = self.eos_id if pad_id is None else pad_id
 
     def encode(self, text: str) -> np.ndarray:
         body = self.bpe.encode_text(text)[: self.max_length - 2]
         ids = [self.bos_id] + body + [self.eos_id]
-        out = np.full((self.max_length,), self.eos_id, dtype=np.int32)
+        out = np.full((self.max_length,), self.pad_id, dtype=np.int32)
         out[: len(ids)] = ids
         return out
 
@@ -149,9 +167,8 @@ class TextEncoder(nn.Module):
             x = _CausalBlock(
                 cfg.heads, dt, cfg.activation, name=f"block_{i}"
             )(x, causal)
-        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")(
-            x.astype(jnp.float32)
-        )
+        final_ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")
+        x = final_ln(x.astype(jnp.float32))
         # pooled = state at first EOS position per sequence (from the
         # FULL stack + final LN, even when hidden is penultimate)
         if eos_id is None:
@@ -165,9 +182,12 @@ class TextEncoder(nn.Module):
                 (cfg.width, cfg.proj_dim),
             )
             pooled = pooled @ proj.astype(pooled.dtype)
-        hidden = (
-            penultimate.astype(jnp.float32)
-            if cfg.penultimate_hidden
-            else x
-        )
+        if cfg.penultimate_hidden:
+            hidden = penultimate.astype(jnp.float32)
+            if cfg.final_ln_on_hidden:
+                # SD2 semantics: the model's final LN (shared params)
+                # is applied to the penultimate state used as context
+                hidden = final_ln(hidden)
+        else:
+            hidden = x
         return hidden, pooled

@@ -161,7 +161,10 @@ def load_video_pipeline(
             )
         tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
     else:
-        tokenizer = Tokenizer(max_length=te_cfg.max_length)
+        tokenizer = Tokenizer(
+            max_length=te_cfg.max_length,
+            pad_id=getattr(te_cfg, "pad_token_id", None),
+        )
 
     params = {"unet": dit_params, "vae": vae_params, "te": te_params}
     if cv_params is not None:

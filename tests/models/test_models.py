@@ -141,3 +141,64 @@ def test_unet_small_and_odd_latents():
         params = model.init(jax.random.key(0), x, t, ctx)
         out = model.apply(params, x, t, ctx)
         assert out.shape == x.shape
+
+
+def test_tokenizer_openclip_pad_semantics():
+    """OpenCLIP towers (SDXL bigG, SD2 ViT-H) pad with 0 after EOS
+    (open_clip.tokenize); the CLIP-L default pads with EOS."""
+    clip_l = Tokenizer(max_length=12)
+    open_clip = Tokenizer(max_length=12, pad_id=0)
+    a = clip_l.encode("hi")
+    b = open_clip.encode("hi")
+    eos_pos = int(np.argmax(a == clip_l.eos_id))
+    np.testing.assert_array_equal(a[:eos_pos + 1], b[:eos_pos + 1])
+    assert (a[eos_pos + 1:] == clip_l.eos_id).all()
+    assert (b[eos_pos + 1:] == 0).all()
+
+
+def test_final_ln_on_hidden_matches_manual_norm():
+    """SD2 semantics: the penultimate context is passed through the
+    model's final LayerNorm (shared params). The flag must not change
+    the param tree, and the normed hidden must equal a by-hand
+    LayerNorm of the un-normed hidden using final_ln's scale/bias."""
+    import dataclasses
+
+    from comfyui_distributed_tpu.models.text_encoder import (
+        TextEncoder, TextEncoderConfig,
+    )
+
+    base = TextEncoderConfig(
+        width=64, layers=2, heads=2, max_length=16, activation="gelu",
+        penultimate_hidden=True, proj_dim=64, pad_token_id=0,
+    )
+    sd2 = dataclasses.replace(base, final_ln_on_hidden=True)
+    tok = Tokenizer(max_length=16, pad_id=0)
+    tokens = jnp.asarray(tok.encode_batch(["hello world"]))
+
+    te_raw = TextEncoder(base)
+    te_sd2 = TextEncoder(sd2)
+    params = te_raw.init(jax.random.key(0), tokens)
+    hidden_raw, pooled_raw = te_raw.apply(params, tokens, eos_id=tok.eos_id)
+    # identical param structure: sd2 config must accept the same tree
+    hidden_sd2, pooled_sd2 = te_sd2.apply(params, tokens, eos_id=tok.eos_id)
+
+    ln = params["params"]["final_ln"]
+    x = np.asarray(hidden_raw, np.float64)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5)
+    want = want * np.asarray(ln["scale"]) + np.asarray(ln["bias"])
+    np.testing.assert_allclose(np.asarray(hidden_sd2), want, atol=1e-4, rtol=0)
+    # pooled path is untouched by the flag
+    np.testing.assert_array_equal(np.asarray(pooled_raw), np.asarray(pooled_sd2))
+
+
+def test_dual_encoder_pad_ids_differ():
+    """SDXL-layout bundles tokenize per encoder: CLIP-L half pads with
+    EOS, the OpenCLIP half with 0."""
+    from comfyui_distributed_tpu.models import pipeline as pl
+
+    bundle = pl.load_pipeline("tiny-unet-adm", seed=0)
+    assert bundle.tokenizer.pad_id == bundle.tokenizer.eos_id
+    assert bundle.tokenizer_2 is not None
+    assert bundle.tokenizer_2.pad_id == 0
