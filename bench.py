@@ -32,6 +32,9 @@ Honesty rules (round-1 verdict items, unchanged):
   via `scaling_source`); null when no scaling measurement succeeded.
 - `mfu` is model-FLOPs utilization from XLA cost analysis vs the
   chip's peak bf16 FLOPs (null when the peak is unknown, e.g. CPU).
+  FLOPs are composed from scan-free per-tile components (VAE encode +
+  N model evals + decode) because XLA counts a lax.scan body once —
+  costing the whole nested-scan program undercounts by ~tiles*steps.
 - `environment`/`fallback` mark CPU-tiny numbers explicitly so a red
   TPU can't read as a perf datum.
 
@@ -44,7 +47,9 @@ Env knobs: BENCH_TINY=1 (small model/shapes), BENCH_CPU=1 (force CPU),
 BENCH_METRIC=usdu|txt2img|video, BENCH_PROBE_TIMEOUT (s, <=0 skips
 probe), BENCH_SCALING_TIMEOUT (s, <=0 skips), BENCH_WALL_S (<=0
 disables the wall clock), BENCH_BUDGET_S / BENCH_BUDGET2_S (full /
-reduced accelerator child caps), BENCH_TINY_BUDGET_S.
+reduced accelerator child caps), BENCH_TINY_BUDGET_S,
+BENCH_TILE_BATCH (USDU tile grouping; default 1 on CPU, 4 on
+accelerators).
 """
 
 from __future__ import annotations
@@ -76,18 +81,6 @@ def _peak_flops(device) -> float | None:
         if sub in kind:
             return peak
     return None
-
-
-def _cost_flops(jitted, *args) -> float | None:
-    """XLA-estimated FLOPs of one call (per whole program)."""
-    try:
-        analysis = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
 
 
 # ---------------------------------------------------------------------------
@@ -233,9 +226,16 @@ def bench_usdu(jax, tiny: bool) -> dict:
     pos = pl.encode_text(bundle, ["benchmark"])
     neg = pl.encode_text(bundle, [""])
     _, _, grid = up.plan_grid(src, src, 2.0, tile, padding)
+    # batch-K tile grouping: K=1 on CPU keeps the tiny datum comparable
+    # to the r1-r4 trendline; accelerators default to K=4 — batch-1
+    # convs leave most of the MXU idle (see BENCH_NOTES.md)
+    tile_batch = int(os.environ.get("BENCH_TILE_BATCH") or 0)
+    if tile_batch <= 0:
+        tile_batch = 1 if jax.devices()[0].platform == "cpu" else 4
     kwargs = dict(
         upscale_by=2.0, tile=tile, padding=padding, steps=steps,
         sampler="euler", scheduler="karras", cfg=7.0, denoise=0.35,
+        tile_batch=tile_batch,
     )
 
     mesh = build_mesh({"data": n_dev}) if n_dev > 1 else None
@@ -250,7 +250,9 @@ def bench_usdu(jax, tiny: bool) -> dict:
     result = {
         "metric": (
             f"USDU tiles/sec/chip ({model}, {src}->{2*src}px, "
-            f"{tile}px tiles, {steps} steps, {n_dev} chip(s))"
+            f"{tile}px tiles, {steps} steps, {n_dev} chip(s)"
+            + (f", tile_batch={tile_batch}" if tile_batch != 1 else "")
+            + ")"
         ),
         "value": round(rate_per_chip, 4),
         "unit": "tiles/sec/chip",
@@ -270,7 +272,8 @@ def bench_usdu(jax, tiny: bool) -> dict:
         result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
         result["scaling_source"] = f"measured_{n_dev}chip"
 
-    # MFU from XLA cost analysis of the end-to-end program
+    # MFU numerator: analytic FLOPs composed from scan-free per-tile
+    # components (XLA cost analysis can't see scan trip counts)
     peak = _peak_flops(jax.devices()[0])
     if peak is not None:
         from comfyui_distributed_tpu.ops.upscale import _jitted_for_flops

@@ -187,6 +187,22 @@ def sample(
     raise ValueError(f"unknown sampler {sampler!r}; use {SAMPLER_NAMES}")
 
 
+# Samplers whose step does a second (correction) model eval on every
+# sigma pair except the last (the lax.cond on sigma_next == 0). Keep in
+# sync with the implementations above when adding a sampler.
+_SECOND_ORDER = {
+    "heun", "dpm_2", "dpm_2_ancestral", "dpmpp_2s_ancestral", "dpmpp_sde",
+}
+
+
+def model_evals_per_scan(sampler: str, n_pairs: int) -> int:
+    """CFG model evaluations sample() performs over n_pairs sigma pairs
+    — the step multiplier of the analytic FLOPs estimate in
+    ops/upscale._jitted_for_flops (XLA cost analysis counts a lax.scan
+    body once, so trip counts must be composed outside the HLO)."""
+    return 2 * n_pairs - 1 if sampler in _SECOND_ORDER else n_pairs
+
+
 def _sample_euler(model_fn, x, sigmas, cond):
     def step(x, sig_pair):
         sigma, sigma_next = sig_pair

@@ -18,6 +18,7 @@ the property that makes elastic requeue safe.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Any
 
@@ -30,6 +31,8 @@ from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..utils.constants import TILE_SCAN_BATCH
 from . import samplers as smp
 from . import tiles as tile_ops
+
+_log = logging.getLogger("cdt.upscale")
 
 
 # jax.image.resize method names for the user-facing upscale_method
@@ -491,6 +494,19 @@ def run_upscale(
     )
 
 
+def _xla_flops(fn, *args) -> float | None:
+    """XLA-estimated FLOPs of one jit(fn)(*args) call."""
+    try:
+        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        _log.warning("XLA cost analysis failed", exc_info=True)
+        return None
+
+
 def _jitted_for_flops(
     bundle: pl.PipelineBundle,
     image: jax.Array,
@@ -508,35 +524,79 @@ def _jitted_for_flops(
     upscale_method: str = "bicubic",
     tile_h: int | None = None,
     tile_batch: int | None = None,
+    tiled_decode: bool = False,
 ) -> float | None:
     """XLA-estimated FLOPs of ONE full upscale program with these args
-    (whole mesh, all tiles) — the numerator of the bench's MFU. Returns
-    None when the backend exposes no cost analysis. tile_batch resolves
-    exactly like run_upscale so the program costed is the program the
-    bench times."""
-    if tile_batch is None:
-        tile_batch = TILE_SCAN_BATCH
-    upscaled, grid, _ = prepare_upscaled_tiles(
-        image, upscale_by, tile, padding, upscale_method, tile_h
-    )
-    key = jax.random.key(0)
+    (whole mesh, all tiles) — the numerator of the bench's MFU.
+
+    XLA's cost_analysis counts a lax.scan body ONCE (the trip count is
+    not in the HLO metadata), and the timed program nests two scans
+    (tile groups x sampler steps) — costing it whole undercounts by
+    ~tiles*steps. The estimate is therefore composed from scan-free
+    components: VAE encode + N CFG model evals + VAE decode, costed on
+    one tile and multiplied by the tile count the program actually
+    executes (including the mesh tier's wrap-around padding). FLOPs
+    metadata is linear in batch, so tile_batch grouping cannot change
+    the total (the argument is accepted for run_upscale signature
+    parity); blend / resize / cond-prep are omitted (<1% of the work).
+    Returns None when the backend exposes no cost analysis."""
+    del tile_batch, upscale_method
     try:
-        if mesh is not None and data_axis_size(mesh) > 1:
-            lowered = upscale_mesh.lower(
-                pl._Static(bundle), pl._Static(mesh), bundle.params, upscaled,
-                pos, neg, key, grid, int(steps), sampler, scheduler,
-                float(cfg), float(denoise), tile_batch=int(tile_batch),
-            )
-        else:
-            lowered = upscale_single.lower(
-                pl._Static(bundle), bundle.params, upscaled, pos, neg, key,
-                grid, int(steps), sampler, scheduler, float(cfg),
-                float(denoise), tile_batch=int(tile_batch),
-            )
-        analysis = lowered.compile().cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
+        b, h, w, c = image.shape
+        _, _, grid = plan_grid(h, w, upscale_by, tile, padding, tile_h)
+        sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
+        n_pairs = int(sigmas.shape[0]) - 1
+        evals = smp.model_evals_per_scan(sampler, n_pairs)
+        n_chips = data_axis_size(mesh) if mesh is not None else 1
+        t = grid.num_tiles
+        total_tiles = (-(-t // n_chips)) * n_chips
+
+        # shape-only: one padded tile as run_upscale's extract_tiles
+        # would produce it — no resize/extraction is materialized here
+        tiles1 = jnp.zeros(
+            (1, b, grid.padded_h, grid.padded_w, c), image.dtype
+        )
+        params = bundle.params
+        pos_p = prep_cond_for_tiles(pos, grid)
+        neg_p = prep_cond_for_tiles(neg, grid)
+
+        def enc_fn(params, tiles):
+            return jax.vmap(
+                lambda tl: bundle.vae.apply(params["vae"], tl, method="encode")
+            )(tiles)
+
+        z_spec = jax.eval_shape(enc_fn, params, tiles1)
+        z1 = jnp.zeros(z_spec.shape, z_spec.dtype)
+
+        def eval_fn(params, z, pos, neg):
+            model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
+            pos_t = tile_cond(pos, jnp.int32(0), jnp.int32(0), grid)
+            neg_t = tile_cond(neg, jnp.int32(0), jnp.int32(0), grid)
+            return jax.vmap(
+                lambda zt: model_fn(
+                    zt,
+                    jnp.broadcast_to(sigmas[0], (zt.shape[0],)),
+                    (pos_t, neg_t),
+                )
+            )(z)
+
+        def dec_fn(params, z):
+            if tiled_decode:
+                from .tiled_vae import decode_tiled
+
+                return jax.vmap(
+                    lambda zt: decode_tiled(pl._Static(bundle), params["vae"], zt)
+                )(z)
+            return jax.vmap(
+                lambda zt: bundle.vae.apply(params["vae"], zt, method="decode")
+            )(z)
+
+        enc = _xla_flops(enc_fn, params, tiles1)
+        ev = _xla_flops(eval_fn, params, z1, pos_p, neg_p)
+        dec = _xla_flops(dec_fn, params, z1)
+        if enc is None or ev is None or dec is None:
+            return None
+        return float(total_tiles) * (enc + evals * ev + dec)
     except Exception:
+        _log.warning("FLOPs estimate failed", exc_info=True)
         return None
