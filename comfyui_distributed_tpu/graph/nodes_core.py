@@ -171,7 +171,10 @@ class CLIPTextEncode:
     FUNCTION = "encode"
 
     def encode(self, text: str, clip: pl.PipelineBundle, context=None):
-        return (pl.encode_text(clip, [str(text)]),)
+        # Conditioning carrying the pooled vector: SDXL-class adm and
+        # Flux-class vector_in models consume it; families without
+        # pooled conditioning ignore the field (pipeline._make_model_fn)
+        return (pl.encode_text_pooled(clip, [str(text)]),)
 
 
 @register_node
@@ -242,12 +245,20 @@ class KSampler:
         spec = resolve_seed(seed)
         bundle = model
         latents = latent_image["samples"]
-        # honor requested pixel geometry when the bundle's VAE factor
-        # differs from the nominal 8x used by EmptyLatentImage
-        if bundle.latent_scale != 8 and "width" in latent_image:
+        # honor requested pixel geometry / channel count when the
+        # bundle's VAE differs from the nominal 8x 4-channel layout
+        # EmptyLatentImage assumes (Flux-class VAEs are 8x but 16ch)
+        if "width" in latent_image and (
+            bundle.latent_scale != 8
+            or latents.shape[-1] != bundle.latent_channels
+        ):
             lh = latent_image["height"] // bundle.latent_scale
             lw = latent_image["width"] // bundle.latent_scale
-            if (latents.shape[1], latents.shape[2]) != (lh, lw):
+            if (
+                latents.shape[1],
+                latents.shape[2],
+                latents.shape[3],
+            ) != (lh, lw, bundle.latent_channels):
                 latents = jnp.zeros(
                     (latents.shape[0], lh, lw, bundle.latent_channels)
                 )
@@ -295,12 +306,18 @@ class KSampler:
         neg = jax.device_put(negative, NamedSharding(mesh, P()))
         base = jax.device_put(latents, NamedSharding(mesh, P()))
 
-        sigmas = smp.get_sigmas(scheduler, int(steps), denoise=float(denoise))
+        param, shift = pl.model_schedule_info(bundle)
+        sigmas = smp.get_model_sigmas(
+            param, scheduler, int(steps), denoise=float(denoise),
+            flow_shift=shift,
+        )
 
         def per_chip(keys_shard, params, pos, neg, base):
             key = keys_shard[0]
             noise_key, anc_key = jax.random.split(key)
-            x = base + jax.random.normal(noise_key, base.shape) * sigmas[0]
+            x = smp.noise_latents(
+                param, base, jax.random.normal(noise_key, base.shape), sigmas[0]
+            )
             model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
             return smp.sample(model_fn, x, sigmas, (pos, neg), sampler_name, anc_key)
 

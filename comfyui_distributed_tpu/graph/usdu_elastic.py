@@ -253,7 +253,10 @@ def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
     """fn(params, tile, key, pos, neg, yx): pos/neg must be prepped via
     ops.upscale.prep_cond_for_tiles (per-tile hint/mask windows are
     sliced at yx inside)."""
-    sigmas = smp.get_sigmas(scheduler, int(steps), denoise=float(denoise))
+    param, shift = pl.model_schedule_info(bundle)
+    sigmas = smp.get_model_sigmas(
+        param, scheduler, int(steps), denoise=float(denoise), flow_shift=shift
+    )
 
     @jax.jit
     def process(params, tile, key, pos, neg, yx):
@@ -261,7 +264,9 @@ def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
         neg_t = upscale_ops.tile_cond(neg, yx[0], yx[1], grid)
         z = bundle.vae.apply(params["vae"], tile, method="encode")
         noise_key, anc_key = jax.random.split(key)
-        x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
+        x = smp.noise_latents(
+            param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
+        )
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
         z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
         if tiled_decode:

@@ -61,12 +61,27 @@ def load_pipeline(
     Without a checkpoint the weights are deterministic random init —
     the distributed machinery upstream is weight-agnostic.
     """
-    from .registry import DEFAULT_TEXT_ENCODERS, DUAL_TEXT_ENCODERS
+    from .registry import (
+        DEFAULT_TEXT_ENCODERS,
+        DUAL_TEXT_ENCODERS,
+        HIDDEN_POOLED_ENCODERS,
+        model_family,
+    )
 
     tiny = model_name.startswith("tiny")
+    family = model_family(model_name)
     dual = DUAL_TEXT_ENCODERS.get(model_name)
-    vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
-    if dual:
+    hidden_pooled = HIDDEN_POOLED_ENCODERS.get(model_name)
+    if family == "mmdit":
+        vae_name = vae_name or ("tiny-vae-flux" if tiny else "vae-flux")
+    else:
+        vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
+    if hidden_pooled:
+        # Flux layout: hidden states from a T5-class encoder, pooled
+        # vector from a CLIP-class encoder
+        te_name = te_name or hidden_pooled[0]
+        te2_name = hidden_pooled[1]
+    elif dual:
         te_name = te_name or dual[0]
         te2_name = dual[1]
     else:
@@ -90,9 +105,13 @@ def load_pipeline(
     lat = jnp.zeros((1, 16, 16, vae_cfg.latent_channels))
     ctx = jnp.zeros((1, te_cfg.max_length, unet_cfg.context_dim))
     ts = jnp.zeros((1,))
-    if hasattr(unet_cfg, "patch_size"):  # video DiT
+    if family == "dit":  # video DiT
         lat5 = jnp.zeros((1, 4, 16, 16, unet_cfg.in_channels))
         unet_params = unet.init(k_unet, lat5, ts, ctx)
+    elif family == "mmdit":
+        unet_params = unet.init(
+            k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.vec_dim))
+        )
     else:
         unet_params = unet.init(k_unet, lat, ts, ctx)
     img = jnp.zeros((1, 32, 32, 3))
@@ -122,11 +141,21 @@ def load_pipeline(
         mapped, _problems = sdc.load_sd_weights(
             state_dict, unet_cfg, vae_cfg, te_cfg, templates,
             te2_cfg=get_config(te2_name) if te2_name else None,
+            family=family,
         )
         unet_params = mapped["unet"]
         vae_params = mapped["vae"]
         te_params = mapped["te"]
         te2_params = mapped.get("te2", te2_params)
+
+    if family == "mmdit":
+        from .t5_encoder import T5Tokenizer
+
+        tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
+    else:
+        tokenizer = Tokenizer(
+            max_length=te_cfg.max_length, pad_id=te_cfg.pad_token_id
+        )
 
     params = {"unet": unet_params, "vae": vae_params, "te": te_params}
     if te2_params is not None:
@@ -137,9 +166,7 @@ def load_pipeline(
         vae=vae,
         text_encoder=te,
         params=params,
-        tokenizer=Tokenizer(
-            max_length=te_cfg.max_length, pad_id=te_cfg.pad_token_id
-        ),
+        tokenizer=tokenizer,
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
         text_encoder_2=te2,
@@ -166,6 +193,28 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
     round-1 zero-pad hack. Single-encoder bundles pad/truncate to the
     backbone's context_dim only when they genuinely mismatch.
     """
+    from .registry import model_family
+
+    if model_family(bundle.model_name) == "mmdit":
+        # Flux layout: T5 hidden states are the context; the pooled
+        # vector comes from the CLIP encoder — no concat, no padding.
+        # Both encoders (and their distinct tokenizers) are mandatory
+        # for this family; a T5 tokenizer feeding the CLIP tower would
+        # be silently wrong, so no fallback exists.
+        if bundle.text_encoder_2 is None or bundle.tokenizer_2 is None:
+            raise ValueError(
+                f"{bundle.model_name}: mmdit bundles need text_encoder_2/"
+                "tokenizer_2 (CLIP pooled source)"
+            )
+        tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
+        hidden, _ = bundle.text_encoder.apply(bundle.params["te"], tokens)
+        tok2 = bundle.tokenizer_2
+        tokens2 = jnp.asarray(tok2.encode_batch(texts))
+        _, pooled = bundle.text_encoder_2.apply(
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
+        )
+        return hidden, pooled
+
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
     hidden, pooled = bundle.text_encoder.apply(
         bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
@@ -205,16 +254,24 @@ def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
     return Conditioning(context=hidden, pooled=pooled)
 
 
-# --- model fn (VP eps parameterisation) ----------------------------------
+# --- model fn (VP eps / v / rectified-flow parameterisations) ------------
+
+def model_schedule_info(bundle: PipelineBundle) -> tuple[str, float]:
+    """(parameterization, flow_shift) of the bundle's backbone — the
+    knobs that pick the sigma schedule and img2img noising rule
+    (ops/samplers.get_model_sigmas / noise_latents). Flow-matching
+    families (Flux class) carry parameterization == "flow"."""
+    cfg = get_config(bundle.model_name)
+    return (
+        getattr(cfg, "parameterization", "eps"),
+        getattr(cfg, "flow_shift", 3.0),
+    )
+
 
 def _make_model_fn(bundle: PipelineBundle, params):
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
-        c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(
-            (-1,) + (1,) * (x.ndim - 1)
-        )
-        t = smp.sigma_to_timestep(sigma_batch)
         context = cond.context if isinstance(cond, Conditioning) else cond
         control = None
         if (
@@ -264,6 +321,18 @@ def _make_model_fn(bundle: PipelineBundle, params):
             if pooled.shape[0] != x.shape[0]:
                 pooled = jnp.broadcast_to(pooled[:1], (x.shape[0], pooled.shape[-1]))
             y = pooled
+        if getattr(get_config(bundle.model_name), "parameterization", "eps") == "flow":
+            # rectified flow (Flux class): t IS sigma, no input scaling,
+            # and the velocity prediction equals eps under the sampler
+            # contract denoised = x - sigma*eps
+            out = bundle.unet.apply(
+                params["unet"], x, sigma_batch, context, y=y, control=control
+            )
+            return out.astype(x.dtype)
+        c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(
+            (-1,) + (1,) * (x.ndim - 1)
+        )
+        t = smp.sigma_to_timestep(sigma_batch)
         out = bundle.unet.apply(
             params["unet"], x * c_in, t, context, y=y, control=control
         )
@@ -306,7 +375,8 @@ def _txt2img_jit(
 ):
     bundle = bundle_static.value
     lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
-    sigmas = smp.get_sigmas(scheduler, steps)
+    param, shift = model_schedule_info(bundle)
+    sigmas = smp.get_model_sigmas(param, scheduler, steps, flow_shift=shift)
     key, noise_key, anc_key = jax.random.split(key, 3)
     x = jax.random.normal(
         noise_key, (batch, lh, lw, bundle.latent_channels)
@@ -345,8 +415,10 @@ def txt2img(
     batch: int = 1,
 ) -> jax.Array:
     """Full text→image generation; returns [batch, H, W, 3] in [0,1]."""
-    pos = encode_text(bundle, [prompt] * batch)
-    neg = encode_text(bundle, [negative_prompt] * batch)
+    # pooled conditioning rides along for SDXL-adm / Flux-vector models
+    # (families without pooled conditioning ignore the field)
+    pos = encode_text_pooled(bundle, [prompt] * batch)
+    neg = encode_text_pooled(bundle, [negative_prompt] * batch)
     key = jax.random.key(seed)
     return _txt2img_jit(
         _Static(bundle),
@@ -384,9 +456,14 @@ def _img2img_jit(
     denoise: float,
 ):
     bundle = bundle_static.value
-    sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
+    param, shift = model_schedule_info(bundle)
+    sigmas = smp.get_model_sigmas(
+        param, scheduler, steps, denoise=denoise, flow_shift=shift
+    )
     noise_key, anc_key = jax.random.split(key)
-    x = latents + jax.random.normal(noise_key, latents.shape) * sigmas[0]
+    x = smp.noise_latents(
+        param, latents, jax.random.normal(noise_key, latents.shape), sigmas[0]
+    )
     model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
     return smp.sample(model, x, sigmas, (context_pos, context_neg), sampler, anc_key)
 

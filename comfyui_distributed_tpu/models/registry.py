@@ -16,6 +16,7 @@ from typing import Any, Callable
 
 from .clip_vision import ClipVisionConfig, ClipVisionEncoder
 from .dit import DiTConfig, VideoDiT
+from .mmdit import MMDiT, MMDiTConfig
 from .t5_encoder import T5Encoder, T5EncoderConfig
 from .text_encoder import TextEncoder, TextEncoderConfig
 from .unet import UNet, UNetConfig
@@ -112,6 +113,29 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             adm_in_channels=96 + 6 * 256,
         ),
     },
+    # --- image MMDiT backbones (Flux checkpoint-faithful dims) ---
+    # guidance-distilled dev config; flow_shift 3.0 ~= the published
+    # dynamic shift at 1MP resolution
+    "flux-dev": {
+        "family": "mmdit",
+        "config": MMDiTConfig(remat=True),
+    },
+    # timestep-distilled schnell: no guidance embedding, unshifted
+    # schedule, 1-4 steps typical
+    "flux-schnell": {
+        "family": "mmdit",
+        "config": MMDiTConfig(
+            guidance_embed=False, flow_shift=1.0, remat=True
+        ),
+    },
+    "tiny-flux": {
+        "family": "mmdit",
+        "config": MMDiTConfig(
+            hidden_dim=32, double_depth=1, single_depth=1, heads=2,
+            axes_dim=(4, 6, 6), context_dim=64, vec_dim=64,
+            flow_shift=1.0,
+        ),
+    },
     # --- video DiT backbones (WAN 2.x checkpoint-faithful dims) ---
     "wan-1.3b": {
         "family": "dit",
@@ -179,6 +203,23 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             temporal_down=(True,),
         ),
     },
+    # Flux-class 16-channel AE: (mean - shift) * scale boundary, no
+    # 1x1 quant convs in the published layout
+    "vae-flux": {
+        "family": "vae",
+        "config": VAEConfig(
+            latent_channels=16, scaling_factor=0.3611, shift_factor=0.1159,
+            use_quant_conv=False,
+        ),
+    },
+    "tiny-vae-flux": {
+        "family": "vae",
+        "config": VAEConfig(
+            base_channels=16, channel_mult=(1, 2), num_res_blocks=1,
+            latent_channels=16, scaling_factor=0.3611, shift_factor=0.1159,
+            use_quant_conv=False,
+        ),
+    },
     "tiny-vae": {
         "family": "vae",
         "config": VAEConfig(base_channels=16, channel_mult=(1, 2), num_res_blocks=1),
@@ -243,6 +284,24 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             d_model=4096, d_ff=10240, layers=24, heads=64, d_kv=64,
         ),
     },
+    # classic T5 v1.1 XXL (the Flux text encoder): stack-shared
+    # relative-position bias, sentencepiece vocab 32128
+    "t5-xxl": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            vocab_size=32128, d_model=4096, d_ff=10240, layers=24,
+            heads=64, d_kv=64, per_layer_rel_bias=False,
+        ),
+    },
+    # tiny shared-bias variant (Flux layout) for hermetic tests; vocab
+    # covers the CLIP-BPE fallback id space like tiny-t5
+    "tiny-t5-shared": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            vocab_size=49408, d_model=64, d_ff=128, layers=2, heads=2,
+            d_kv=32, max_length=16, per_layer_rel_bias=False,
+        ),
+    },
     # tiny variant: vocab covers the CLIP-BPE fallback id space so the
     # placeholder tokenizer can't index out of the embedding table
     "tiny-t5": {
@@ -278,9 +337,19 @@ DEFAULT_TEXT_ENCODERS: dict[str, str] = {
     "sd21-base": "clip-h",
 }
 
+# Flux-layout conditioning: hidden states from a T5-class encoder,
+# pooled vector from a CLIP-class encoder — no concat, no padding
+# (models/pipeline._encode_raw).
+HIDDEN_POOLED_ENCODERS: dict[str, tuple[str, str]] = {
+    "flux-dev": ("t5-xxl", "clip-l"),
+    "flux-schnell": ("t5-xxl", "clip-l"),
+    "tiny-flux": ("tiny-t5-shared", "tiny-te"),
+}
+
 _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
     "unet": lambda cfg: UNet(cfg),
     "dit": lambda cfg: VideoDiT(cfg),
+    "mmdit": lambda cfg: MMDiT(cfg),
     "vae": lambda cfg: VAE(cfg),
     "text_encoder": lambda cfg: TextEncoder(cfg),
     "t5_encoder": lambda cfg: T5Encoder(cfg),

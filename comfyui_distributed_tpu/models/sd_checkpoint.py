@@ -189,18 +189,22 @@ def _vae_mid(sd: str, fx: str) -> list[Entry]:
     )
 
 
-def vae_schedule(cfg) -> list[Entry]:
-    """SD AutoencoderKL (`first_stage_model.*`) → VAE flax tree."""
-    p = "first_stage_model"
+def vae_schedule(cfg, prefix: str = "first_stage_model") -> list[Entry]:
+    """SD AutoencoderKL (`first_stage_model.*`) → VAE flax tree.
+
+    `prefix=""` handles standalone AE files (Flux ae.safetensors: bare
+    `encoder.*`/`decoder.*` keys); `use_quant_conv=False` configs
+    (Flux layout) skip the 1x1 quant convs."""
+    p = f"{prefix}." if prefix else ""
     bc = cfg.base_channels
-    entries: list[Entry] = [(f"{p}.encoder.conv_in", "encoder/conv_in", _CONV)]
+    entries: list[Entry] = [(f"{p}encoder.conv_in", "encoder/conv_in", _CONV)]
 
     in_ch = bc
     for level, mult in enumerate(cfg.channel_mult):
         out_ch = bc * mult
         for i in range(cfg.num_res_blocks):
             entries += _vae_resblock(
-                f"{p}.encoder.down.{level}.block.{i}",
+                f"{p}encoder.down.{level}.block.{i}",
                 f"encoder/down_{level}_res_{i}",
                 in_ch != out_ch,
             )
@@ -208,27 +212,30 @@ def vae_schedule(cfg) -> list[Entry]:
         if level != len(cfg.channel_mult) - 1:
             entries.append(
                 (
-                    f"{p}.encoder.down.{level}.downsample.conv",
+                    f"{p}encoder.down.{level}.downsample.conv",
                     f"encoder/down_{level}_ds",
                     _CONV,
                 )
             )
-    entries += _vae_mid(f"{p}.encoder.mid", "encoder")
+    entries += _vae_mid(f"{p}encoder.mid", "encoder")
     entries += [
-        (f"{p}.encoder.norm_out", "encoder/norm_out/GroupNorm_0", _NORM),
-        (f"{p}.encoder.conv_out", "encoder/conv_out", _CONV),
-        (f"{p}.quant_conv", "quant_conv", _CONV),
-        (f"{p}.post_quant_conv", "post_quant_conv", _CONV),
-        (f"{p}.decoder.conv_in", "decoder/conv_in", _CONV),
+        (f"{p}encoder.norm_out", "encoder/norm_out/GroupNorm_0", _NORM),
+        (f"{p}encoder.conv_out", "encoder/conv_out", _CONV),
     ]
-    entries += _vae_mid(f"{p}.decoder.mid", "decoder")
+    if getattr(cfg, "use_quant_conv", True):
+        entries += [
+            (f"{p}quant_conv", "quant_conv", _CONV),
+            (f"{p}post_quant_conv", "post_quant_conv", _CONV),
+        ]
+    entries.append((f"{p}decoder.conv_in", "decoder/conv_in", _CONV))
+    entries += _vae_mid(f"{p}decoder.mid", "decoder")
     top_ch = bc * cfg.channel_mult[-1]
     in_ch = top_ch
     for level, mult in reversed(list(enumerate(cfg.channel_mult))):
         out_ch = bc * mult
         for i in range(cfg.num_res_blocks + 1):
             entries += _vae_resblock(
-                f"{p}.decoder.up.{level}.block.{i}",
+                f"{p}decoder.up.{level}.block.{i}",
                 f"decoder/up_{level}_res_{i}",
                 in_ch != out_ch,
             )
@@ -236,14 +243,14 @@ def vae_schedule(cfg) -> list[Entry]:
         if level != 0:
             entries.append(
                 (
-                    f"{p}.decoder.up.{level}.upsample.conv",
+                    f"{p}decoder.up.{level}.upsample.conv",
                     f"decoder/up_{level}_us",
                     _CONV,
                 )
             )
     entries += [
-        (f"{p}.decoder.norm_out", "decoder/norm_out/GroupNorm_0", _NORM),
-        (f"{p}.decoder.conv_out", "decoder/conv_out", _CONV),
+        (f"{p}decoder.norm_out", "decoder/norm_out/GroupNorm_0", _NORM),
+        (f"{p}decoder.conv_out", "decoder/conv_out", _CONV),
     ]
     return entries
 
@@ -527,9 +534,20 @@ def t5_encoder_schedule(cfg, prefix: str = "") -> list[Entry]:
     tree (models/t5_encoder.py). The text-encoder checkpoint the
     reference's WAN workflows load through ComfyUI's CLIPLoader."""
     p = prefix
+    per_layer_bias = getattr(cfg, "per_layer_rel_bias", True)
     entries: list[Entry] = [
         (f"{p}shared", "token_embed", "embedding"),
     ]
+    if not per_layer_bias:
+        # classic T5 v1.1 (the Flux text encoder): layer 0's table is
+        # shared by the whole stack → one top-level flax param
+        entries.append(
+            (
+                f"{p}encoder.block.0.layer.0.SelfAttention.relative_attention_bias",
+                "rel_bias",
+                "embedding",
+            )
+        )
     for i in range(cfg.layers):
         sd = f"{p}encoder.block.{i}"
         fx = f"block_{i}"
@@ -539,11 +557,16 @@ def t5_encoder_schedule(cfg, prefix: str = "") -> list[Entry]:
             (f"{sd}.layer.0.SelfAttention.k", f"{fx}/k", _LINEAR_NOBIAS),
             (f"{sd}.layer.0.SelfAttention.v", f"{fx}/v", _LINEAR_NOBIAS),
             (f"{sd}.layer.0.SelfAttention.o", f"{fx}/o", _LINEAR_NOBIAS),
-            (
-                f"{sd}.layer.0.SelfAttention.relative_attention_bias",
-                f"{fx}/rel_bias",
-                "embedding",
-            ),
+        ]
+        if per_layer_bias:
+            entries.append(
+                (
+                    f"{sd}.layer.0.SelfAttention.relative_attention_bias",
+                    f"{fx}/rel_bias",
+                    "embedding",
+                )
+            )
+        entries += [
             (f"{sd}.layer.1.layer_norm", f"{fx}/ffn_norm", "rms"),
             (f"{sd}.layer.1.DenseReluDense.wi_0", f"{fx}/wi_0", _LINEAR_NOBIAS),
             (f"{sd}.layer.1.DenseReluDense.wi_1", f"{fx}/wi_1", _LINEAR_NOBIAS),
@@ -551,6 +574,133 @@ def t5_encoder_schedule(cfg, prefix: str = "") -> list[Entry]:
         ]
     entries.append((f"{p}encoder.final_layer_norm", "final_norm", "rms"))
     return entries
+
+
+def flux_schedule(cfg, prefix: str = "") -> list[Entry]:
+    """Flux state dict (`double_blocks.N.*`, `single_blocks.N.*`,
+    `img_in`, `txt_in`, `time_in`, `vector_in`, `guidance_in`,
+    `final_layer.*`) → MMDiT flax tree (models/mmdit.py). The
+    capability the reference gets from ComfyUI's UNETLoader for Flux
+    checkpoints.
+
+    `prefix` handles repacked single-file checkpoints that nest the
+    transformer under `model.diffusion_model.` (pass with the trailing
+    dot); published flux1-*.safetensors use bare keys."""
+    p = prefix
+    entries: list[Entry] = [
+        (f"{p}img_in", "img_in", _LINEAR),
+        (f"{p}txt_in", "txt_in", _LINEAR),
+        (f"{p}time_in.in_layer", "time_in/in_layer", _LINEAR),
+        (f"{p}time_in.out_layer", "time_in/out_layer", _LINEAR),
+        (f"{p}vector_in.in_layer", "vector_in/in_layer", _LINEAR),
+        (f"{p}vector_in.out_layer", "vector_in/out_layer", _LINEAR),
+    ]
+    if cfg.guidance_embed:
+        entries += [
+            (f"{p}guidance_in.in_layer", "guidance_in/in_layer", _LINEAR),
+            (f"{p}guidance_in.out_layer", "guidance_in/out_layer", _LINEAR),
+        ]
+    for i in range(cfg.double_depth):
+        sd, fx = f"{p}double_blocks.{i}", f"double_blocks_{i}"
+        for s in ("img", "txt"):
+            entries += [
+                (f"{sd}.{s}_mod.lin", f"{fx}/{s}_mod_lin", _LINEAR),
+                (f"{sd}.{s}_attn.qkv", f"{fx}/{s}_attn_qkv", _LINEAR),
+                (
+                    f"{sd}.{s}_attn.norm.query_norm",
+                    f"{fx}/{s}_attn_norm_q",
+                    "rms_scale",
+                ),
+                (
+                    f"{sd}.{s}_attn.norm.key_norm",
+                    f"{fx}/{s}_attn_norm_k",
+                    "rms_scale",
+                ),
+                (f"{sd}.{s}_attn.proj", f"{fx}/{s}_attn_proj", _LINEAR),
+                (f"{sd}.{s}_mlp.0", f"{fx}/{s}_mlp_0", _LINEAR),
+                (f"{sd}.{s}_mlp.2", f"{fx}/{s}_mlp_2", _LINEAR),
+            ]
+    for i in range(cfg.single_depth):
+        sd, fx = f"{p}single_blocks.{i}", f"single_blocks_{i}"
+        entries += [
+            (f"{sd}.modulation.lin", f"{fx}/modulation_lin", _LINEAR),
+            (f"{sd}.linear1", f"{fx}/linear1", _LINEAR),
+            (f"{sd}.linear2", f"{fx}/linear2", _LINEAR),
+            (f"{sd}.norm.query_norm", f"{fx}/norm_q", "rms_scale"),
+            (f"{sd}.norm.key_norm", f"{fx}/norm_k", "rms_scale"),
+        ]
+    entries += [
+        (f"{p}final_layer.adaLN_modulation.1", "final_layer_adaLN_lin", _LINEAR),
+        (f"{p}final_layer.linear", "final_layer_linear", _LINEAR),
+    ]
+    return entries
+
+
+def load_flux_weights(
+    state_dict: dict[str, np.ndarray],
+    unet_cfg,
+    vae_cfg,
+    te_cfg,
+    templates: dict[str, Any],
+    strict: bool = True,
+    te2_cfg: Any = None,
+) -> tuple[dict[str, Any], list[str]]:
+    """Flux-class checkpoint(s) → {'unet','vae','te','te2'} trees.
+
+    Published Flux weights ship as SEPARATE files (transformer +
+    ae.safetensors + t5xxl + clip_l), so this loader maps whichever
+    parts the state dict carries and leaves the rest at init —
+    problems are recorded (and strict raises) only for parts that are
+    present. Layouts per part: transformer bare or under
+    `model.diffusion_model.`; AE bare (`encoder.*`) or under
+    `first_stage_model.`; T5 and CLIP in their HF layouts."""
+    unet_prefix = (
+        "model.diffusion_model."
+        if any(k.startswith("model.diffusion_model.double_blocks.") for k in state_dict)
+        else ""
+    )
+    parts: dict[str, list[Entry]] = {}
+    if any(k.startswith(f"{unet_prefix}double_blocks.") for k in state_dict):
+        parts["unet"] = flux_schedule(unet_cfg, prefix=unet_prefix)
+    if any(k.startswith("first_stage_model.") for k in state_dict):
+        parts["vae"] = vae_schedule(vae_cfg)
+    elif any(k.startswith("encoder.conv_in") for k in state_dict):
+        parts["vae"] = vae_schedule(vae_cfg, prefix="")
+    if any("layer.0.SelfAttention.q.weight" in k for k in state_dict):
+        t5_prefix = next(
+            (
+                k[: k.index("encoder.block.")]
+                for k in state_dict
+                if "encoder.block.0.layer.0.SelfAttention.q.weight" in k
+            ),
+            "",
+        )
+        parts["te"] = t5_encoder_schedule(te_cfg, prefix=t5_prefix)
+    if te2_cfg is not None and any(
+        "text_model.encoder.layers.0" in k for k in state_dict
+    ):
+        clip_prefix = next(
+            k[: k.index("text_model.encoder.layers.0")] + "text_model"
+            for k in state_dict
+            if "text_model.encoder.layers.0" in k
+        )
+        parts["te2"] = text_encoder_schedule(te2_cfg, prefix=clip_prefix)
+
+    result = dict(templates)
+    problems: list[str] = []
+    for part, entries in parts.items():
+        result[part], part_problems = _merge_into_template(
+            state_dict, entries, templates[part], part
+        )
+        problems += part_problems
+    if not parts:
+        problems.append("flux: no mappable part found in checkpoint")
+    if problems and strict:
+        raise ValueError(
+            f"flux checkpoint mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return result, problems
 
 
 def _merge_into_template(
@@ -634,6 +784,8 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
             out.append((sd, fx, "id"))
         elif kind == "rms":  # RMSNorm: weight only → scale
             out.append((f"{sd}.weight", f"{fx}/scale", "id"))
+        elif kind == "rms_scale":  # RMSNorm stored as .scale (Flux QKNorm)
+            out.append((f"{sd}.scale", f"{fx}/scale", "id"))
         elif kind == "causal3":  # Conv3d (causal wrapper): weight+bias
             out.append((f"{sd}.weight", f"{fx}/kernel", "conv3d_k"))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))
@@ -827,6 +979,7 @@ def load_sd_weights(
     templates: dict[str, Any],
     strict: bool = True,
     te2_cfg: Any = None,
+    family: str | None = None,
 ) -> tuple[dict[str, Any], list[str]]:
     """Map a full SD checkpoint onto {'unet','vae','te'} param trees.
 
@@ -834,6 +987,11 @@ def load_sd_weights(
     be covered by the checkpoint with a matching shape (strict) or is
     kept at its init value (non-strict). Returns (trees, problems).
     """
+    if family == "mmdit":
+        return load_flux_weights(
+            state_dict, unet_cfg, vae_cfg, te_cfg, templates,
+            strict=strict, te2_cfg=te2_cfg,
+        )
     sdxl_layout = any(k.startswith("conditioner.embedders.") for k in state_dict)
     # SD2.x packs an OpenCLIP text tower under cond_stage_model.model.*
     # (bare positional embedding, fused in_proj) — a third layout next

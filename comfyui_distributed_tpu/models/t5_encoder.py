@@ -40,6 +40,10 @@ class T5EncoderConfig:
     rel_max_distance: int = 128
     max_length: int = 512
     pad_id: int = 0
+    # UMT5 (WAN) gives every layer its own relative-position bias
+    # table; classic T5 v1.1 (the Flux text encoder) shares layer 0's
+    # table across the stack
+    per_layer_rel_bias: bool = True
     dtype: str = "bfloat16"
 
     @property
@@ -77,15 +81,20 @@ class _T5Block(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, buckets: jax.Array, key_mask: jax.Array
+        self,
+        x: jax.Array,
+        buckets: jax.Array,
+        key_mask: jax.Array,
+        shared_bias: jax.Array | None = None,
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
         b, n, _ = x.shape
         inner = cfg.heads * cfg.d_kv
 
-        # --- self-attention (pre-RMS, unscaled logits, per-layer
-        # relative position bias: the UMT5 distinction) ---
+        # --- self-attention (pre-RMS, unscaled logits; per-layer
+        # relative position bias is the UMT5 distinction — classic T5
+        # passes the stack-shared table in via shared_bias) ---
         h = nn.RMSNorm(epsilon=1e-6, dtype=jnp.float32, name="attn_norm")(
             x.astype(jnp.float32)
         ).astype(dt)
@@ -95,9 +104,12 @@ class _T5Block(nn.Module):
         q = q.reshape(b, n, cfg.heads, cfg.d_kv)
         k = k.reshape(b, n, cfg.heads, cfg.d_kv)
         v = v.reshape(b, n, cfg.heads, cfg.d_kv)
-        rel_bias = nn.Embed(
-            cfg.rel_buckets, cfg.heads, dtype=jnp.float32, name="rel_bias"
-        )(buckets)  # [N, N, H]
+        if shared_bias is not None:
+            rel_bias = shared_bias
+        else:
+            rel_bias = nn.Embed(
+                cfg.rel_buckets, cfg.heads, dtype=jnp.float32, name="rel_bias"
+            )(buckets)  # [N, N, H]
         scores = jnp.einsum(
             "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
         )  # T5: no 1/sqrt(d) scaling (folded into init)
@@ -144,8 +156,15 @@ class T5Encoder(nn.Module):
                 n, cfg.rel_buckets, cfg.rel_max_distance
             )
         )
+        shared_bias = None
+        if not cfg.per_layer_rel_bias:
+            shared_bias = nn.Embed(
+                cfg.rel_buckets, cfg.heads, dtype=jnp.float32, name="rel_bias"
+            )(buckets)
         for i in range(cfg.layers):
-            x = _T5Block(cfg, name=f"block_{i}")(x, buckets, key_mask)
+            x = _T5Block(cfg, name=f"block_{i}")(
+                x, buckets, key_mask, shared_bias
+            )
         hidden = nn.RMSNorm(
             epsilon=1e-6, dtype=jnp.float32, name="final_norm"
         )(x.astype(jnp.float32))

@@ -28,6 +28,10 @@ class VAEConfig:
     channel_mult: Sequence[int] = (1, 2, 4, 4)
     num_res_blocks: int = 2
     scaling_factor: float = 0.18215
+    # Flux-class AE boundary: z = (mean - shift) * scale; the published
+    # flux autoencoder also drops the SD 1x1 quant/post_quant convs
+    shift_factor: float = 0.0
+    use_quant_conv: bool = True
     dtype: str = "bfloat16"
 
     @property
@@ -141,29 +145,36 @@ class VAE(nn.Module):
         self.encoder = Encoder(self.config)
         self.decoder = Decoder(self.config)
         # 1x1 moment/latent projections from the SD AutoencoderKL
-        # (quant_conv / post_quant_conv) so real checkpoints map 1:1
-        self.quant_conv = nn.Conv(
-            2 * self.config.latent_channels, (1, 1), dtype=jnp.float32,
-            name="quant_conv",
-        )
-        self.post_quant_conv = nn.Conv(
-            self.config.latent_channels, (1, 1), dtype=jnp.float32,
-            name="post_quant_conv",
-        )
+        # (quant_conv / post_quant_conv) so real checkpoints map 1:1;
+        # Flux-class AEs ship without them
+        if self.config.use_quant_conv:
+            self.quant_conv = nn.Conv(
+                2 * self.config.latent_channels, (1, 1), dtype=jnp.float32,
+                name="quant_conv",
+            )
+            self.post_quant_conv = nn.Conv(
+                self.config.latent_channels, (1, 1), dtype=jnp.float32,
+                name="post_quant_conv",
+            )
 
     def encode(self, x: jax.Array, rng: jax.Array | None = None) -> jax.Array:
-        """[B,H,W,3] in [0,1] → [B,H/8,W/8,4] scaled latents (mean; pass
+        """[B,H,W,3] in [0,1] → [B,H/8,W/8,C] scaled latents (mean; pass
         rng to sample from the posterior instead)."""
-        moments = self.quant_conv(self.encoder(x * 2.0 - 1.0))
+        moments = self.encoder(x * 2.0 - 1.0)
+        if self.config.use_quant_conv:
+            moments = self.quant_conv(moments)
         mean, logvar = jnp.split(moments, 2, axis=-1)
         if rng is not None:
             std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
             mean = mean + std * jax.random.normal(rng, mean.shape)
-        return mean * self.config.scaling_factor
+        return (mean - self.config.shift_factor) * self.config.scaling_factor
 
     def decode(self, z: jax.Array) -> jax.Array:
-        """[B,h,w,4] scaled latents → [B,H,W,3] images in [0,1]."""
-        x = self.decoder(self.post_quant_conv(z / self.config.scaling_factor))
+        """[B,h,w,C] scaled latents → [B,H,W,3] images in [0,1]."""
+        z = z / self.config.scaling_factor + self.config.shift_factor
+        if self.config.use_quant_conv:
+            z = self.post_quant_conv(z)
+        x = self.decoder(z)
         return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)
 
     def __call__(self, x: jax.Array) -> jax.Array:

@@ -94,6 +94,55 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
     return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
 
 
+def get_flow_sigmas(
+    steps: int, denoise: float = 1.0, shift: float = 3.0
+) -> jnp.ndarray:
+    """[steps+1] descending rectified-flow sigmas with timestep shift
+    (t' = s*t / (1 + (s-1)*t)). sigma IS the flow time: x_t =
+    (1-sigma)*x0 + sigma*noise, and the model's velocity prediction is
+    exactly eps under the sampler contract denoised = x - sigma*eps.
+    `denoise < 1` truncates to the schedule tail like get_sigmas."""
+    import numpy as np
+
+    total = steps
+    if denoise < 1.0:
+        total = max(int(steps / max(denoise, 1e-4)), steps)
+    t = np.linspace(1.0, 0.0, total + 1)
+    t = shift * t / (1.0 + (shift - 1.0) * t)
+    return jnp.asarray(t[-(steps + 1):], dtype=jnp.float32)
+
+
+def get_model_sigmas(
+    parameterization: str,
+    scheduler: str,
+    steps: int,
+    denoise: float = 1.0,
+    flow_shift: float = 3.0,
+) -> jnp.ndarray:
+    """Family-aware sigma schedule: flow-matching models (Flux class)
+    ignore the VP scheduler table and use the shifted rectified-flow
+    grid — parity with the reference stack, where the model's sampling
+    object owns the schedule and the scheduler knob only shapes
+    VP-model spacing."""
+    if parameterization == "flow":
+        return get_flow_sigmas(steps, denoise=denoise, shift=flow_shift)
+    return get_sigmas(scheduler, steps, denoise=denoise)
+
+
+def noise_latents(
+    parameterization: str,
+    latents: jax.Array,
+    noise: jax.Array,
+    sigma0: jax.Array,
+) -> jax.Array:
+    """img2img/tile noising to the schedule start: VP families add
+    scaled noise (x = z + sigma*n); rectified flow interpolates
+    (x = (1-sigma)*z + sigma*n)."""
+    if parameterization == "flow":
+        return (1.0 - sigma0) * latents + sigma0 * noise
+    return latents + noise * sigma0
+
+
 def sigma_to_timestep(sigma: jax.Array) -> jax.Array:
     """Nearest training timestep for a sigma (for timestep-conditioned
     models); differentiable-free lookup."""

@@ -253,14 +253,19 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise,
     """Returns fn(params, tile, key, pos, neg, yx) → processed tiles.
     pos/neg must already be prepped via prep_cond_for_tiles; yx is the
     tile origin [2] (traced ok)."""
-    sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
+    param, shift = pl.model_schedule_info(bundle)
+    sigmas = smp.get_model_sigmas(
+        param, scheduler, steps, denoise=denoise, flow_shift=shift
+    )
 
     def fn(params, tile, key, pos, neg, yx):
         pos_t = tile_cond(pos, yx[0], yx[1], grid)
         neg_t = tile_cond(neg, yx[0], yx[1], grid)
         z = bundle.vae.apply(params["vae"], tile, method="encode")
         noise_key, anc_key = jax.random.split(key)
-        x = z + jax.random.normal(noise_key, z.shape) * sigmas[0]
+        x = smp.noise_latents(
+            param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
+        )
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
         z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
         if tiled_decode:
@@ -544,7 +549,10 @@ def _jitted_for_flops(
     try:
         b, h, w, c = image.shape
         _, _, grid = plan_grid(h, w, upscale_by, tile, padding, tile_h)
-        sigmas = smp.get_sigmas(scheduler, steps, denoise=denoise)
+        param, shift = pl.model_schedule_info(bundle)
+        sigmas = smp.get_model_sigmas(
+            param, scheduler, steps, denoise=denoise, flow_shift=shift
+        )
         n_pairs = int(sigmas.shape[0]) - 1
         evals = smp.model_evals_per_scan(sampler, n_pairs)
         n_chips = data_axis_size(mesh) if mesh is not None else 1

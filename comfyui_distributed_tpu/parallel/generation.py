@@ -47,7 +47,8 @@ def _parallel_txt2img_jit(
 ):
     bundle = bundle_static.value
     mesh = mesh_static.value
-    sigmas = smp.get_sigmas(scheduler, steps)
+    param, shift = pl.model_schedule_info(bundle)
+    sigmas = smp.get_model_sigmas(param, scheduler, steps, flow_shift=shift)
     lh, lw = height // bundle.latent_scale, width // bundle.latent_scale
     chans = bundle.latent_channels
 
@@ -92,8 +93,9 @@ def txt2img_parallel(
     keys = participant_keys(jax.random.key(seed), n)
     keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
 
-    pos = pl.encode_text(bundle, [prompt] * batch_per_device)
-    neg = pl.encode_text(bundle, [negative_prompt] * batch_per_device)
+    # pooled conditioning rides along for SDXL-adm / Flux-vector models
+    pos = pl.encode_text_pooled(bundle, [prompt] * batch_per_device)
+    neg = pl.encode_text_pooled(bundle, [negative_prompt] * batch_per_device)
     params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
     pos = jax.device_put(pos, NamedSharding(mesh, P()))
     neg = jax.device_put(neg, NamedSharding(mesh, P()))

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Generate checkpoint key+shape manifests for the published model
-families this framework loads (SD1.5, SDXL-base, Wan2.1, UMT5-XXL).
+families this framework loads (SD1.5, SDXL-base, SD2.1, Wan2.1,
+UMT5-XXL, Flux).
 
 These manifests pin sd_checkpoint.py's key schedules against *reality*
 — the state-dict layout of the published checkpoints — instead of
@@ -215,6 +216,7 @@ def vae_manifest(
     nres: int = 2,
     z: int = 4,
     img_ch: int = 3,
+    quant_convs: bool = True,
 ) -> Manifest:
     m: Manifest = {}
     p = "first_stage_model"
@@ -231,8 +233,9 @@ def vae_manifest(
     _vae_mid(m, f"{p}.encoder.mid", top)
     _norm(m, f"{p}.encoder.norm_out", top)
     _conv(m, f"{p}.encoder.conv_out", 2 * z, top, 3)
-    _conv(m, f"{p}.quant_conv", 2 * z, 2 * z, 1)
-    _conv(m, f"{p}.post_quant_conv", z, z, 1)
+    if quant_convs:
+        _conv(m, f"{p}.quant_conv", 2 * z, 2 * z, 1)
+        _conv(m, f"{p}.post_quant_conv", z, z, 1)
 
     _conv(m, f"{p}.decoder.conv_in", top, z, 3)
     _vae_mid(m, f"{p}.decoder.mid", top)
@@ -444,6 +447,7 @@ def umt5_encoder_manifest(
     d_kv: int = 64,
     vocab: int = 256384,
     buckets: int = 32,
+    per_layer_bias: bool = True,
 ) -> Manifest:
     m: Manifest = {}
     inner = heads * d_kv
@@ -454,17 +458,78 @@ def umt5_encoder_manifest(
         for leaf in ("q", "k", "v"):
             m[f"{sd}.layer.0.SelfAttention.{leaf}.weight"] = [inner, d_model]
         m[f"{sd}.layer.0.SelfAttention.o.weight"] = [d_model, inner]
-        # UMT5: per-layer relative position bias (vanilla T5 has it on
-        # block 0 only — this is the umt5 signature)
-        m[f"{sd}.layer.0.SelfAttention.relative_attention_bias.weight"] = [
-            buckets, heads,
-        ]
+        # UMT5: per-layer relative position bias; vanilla T5 v1.1 (the
+        # Flux text encoder) has it on block 0 only
+        if per_layer_bias or i == 0:
+            m[f"{sd}.layer.0.SelfAttention.relative_attention_bias.weight"] = [
+                buckets, heads,
+            ]
         m[f"{sd}.layer.1.layer_norm.weight"] = [d_model]
         m[f"{sd}.layer.1.DenseReluDense.wi_0.weight"] = [d_ff, d_model]
         m[f"{sd}.layer.1.DenseReluDense.wi_1.weight"] = [d_ff, d_model]
         m[f"{sd}.layer.1.DenseReluDense.wo.weight"] = [d_model, d_ff]
     m["encoder.final_layer_norm.weight"] = [d_model]
     return m
+
+
+# --- Flux image MMDiT (black-forest-labs flux layout) ----------------------
+
+def flux_dit_manifest(
+    hidden: int = 3072,
+    double: int = 19,
+    single: int = 38,
+    heads: int = 24,
+    ctx: int = 4096,
+    vec: int = 768,
+    mlp_ratio: float = 4.0,
+    in_dim: int = 64,        # 16 latent channels x 2x2 patch
+    time_dim: int = 256,
+    guidance: bool = True,
+) -> Manifest:
+    """flux1-dev/schnell.safetensors transformer keys, following the
+    original module construction (flux/model.py Flux + modules/layers):
+    MLPEmbedders, 19 DoubleStreamBlocks, 38 SingleStreamBlocks,
+    LastLayer. Per-head RMS q/k norms are stored as `.scale` (not
+    `.weight`)."""
+    m: Manifest = {}
+    mlp = int(hidden * mlp_ratio)
+    hd = hidden // heads
+    _linear(m, "img_in", hidden, in_dim)
+    _linear(m, "txt_in", hidden, ctx)
+    _linear(m, "time_in.in_layer", hidden, time_dim)
+    _linear(m, "time_in.out_layer", hidden, hidden)
+    _linear(m, "vector_in.in_layer", hidden, vec)
+    _linear(m, "vector_in.out_layer", hidden, hidden)
+    if guidance:
+        _linear(m, "guidance_in.in_layer", hidden, time_dim)
+        _linear(m, "guidance_in.out_layer", hidden, hidden)
+    for i in range(double):
+        sd = f"double_blocks.{i}"
+        for s in ("img", "txt"):
+            _linear(m, f"{sd}.{s}_mod.lin", 6 * hidden, hidden)
+            _linear(m, f"{sd}.{s}_attn.qkv", 3 * hidden, hidden)
+            m[f"{sd}.{s}_attn.norm.query_norm.scale"] = [hd]
+            m[f"{sd}.{s}_attn.norm.key_norm.scale"] = [hd]
+            _linear(m, f"{sd}.{s}_attn.proj", hidden, hidden)
+            _linear(m, f"{sd}.{s}_mlp.0", mlp, hidden)
+            _linear(m, f"{sd}.{s}_mlp.2", hidden, mlp)
+    for i in range(single):
+        sd = f"single_blocks.{i}"
+        _linear(m, f"{sd}.modulation.lin", 3 * hidden, hidden)
+        _linear(m, f"{sd}.linear1", 3 * hidden + mlp, hidden)
+        _linear(m, f"{sd}.linear2", hidden, hidden + mlp)
+        m[f"{sd}.norm.query_norm.scale"] = [hd]
+        m[f"{sd}.norm.key_norm.scale"] = [hd]
+    _linear(m, "final_layer.adaLN_modulation.1", 2 * hidden, hidden)
+    _linear(m, "final_layer.linear", in_dim, hidden)
+    return m
+
+
+def flux_ae_manifest() -> Manifest:
+    """ae.safetensors: SD AutoencoderKL architecture with 16-channel
+    latents, BARE encoder./decoder. keys, and no 1x1 quant convs."""
+    nested = vae_manifest(z=16, quant_convs=False)
+    return {k.split(".", 1)[1]: v for k, v in nested.items()}
 
 
 # --- assembly --------------------------------------------------------------
@@ -518,6 +583,12 @@ def build_all() -> dict[str, Manifest]:
         ),
         "wan21_vae": wan_vae_manifest(),
         "umt5_xxl_encoder": umt5_encoder_manifest(),
+        "flux1_dev": flux_dit_manifest(guidance=True),
+        "flux1_schnell": flux_dit_manifest(guidance=False),
+        "flux_ae": flux_ae_manifest(),
+        "t5_xxl_encoder": umt5_encoder_manifest(
+            vocab=32128, per_layer_bias=False
+        ),
     }
 
 

@@ -1,0 +1,40 @@
+"""Every bundled workflow must validate against the node registry.
+
+The reference ships example graphs (reference workflows/*.json) that
+its CI keeps loadable implicitly through ComfyUI; here the drift guard
+is explicit — class names, required inputs, link arity, acyclicity,
+and model names are all checked without executing anything."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from comfyui_distributed_tpu.graph.executor import validate_prompt
+from comfyui_distributed_tpu.models.registry import MODEL_REGISTRY
+
+pytestmark = pytest.mark.fast
+
+WORKFLOW_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "workflows",
+)
+WORKFLOWS = sorted(glob.glob(os.path.join(WORKFLOW_DIR, "*.json")))
+
+
+def test_workflows_present():
+    assert len(WORKFLOWS) >= 6
+
+
+@pytest.mark.parametrize(
+    "path", WORKFLOWS, ids=[os.path.basename(p) for p in WORKFLOWS]
+)
+def test_bundled_workflow_validates(path):
+    with open(path) as fh:
+        prompt = json.load(fh)
+    validate_prompt(prompt)  # raises on any structural problem
+    for node in prompt.values():
+        name = (node.get("inputs") or {}).get("ckpt_name")
+        if name is not None:
+            assert name in MODEL_REGISTRY, f"unknown model {name!r} in {path}"

@@ -60,6 +60,12 @@ def _flax_shapes(model_name: str) -> dict[str, tuple[int, ...]]:
             jnp.zeros((1, 16, cfg.context_dim)),
             jnp.zeros((1, 257, cfg.img_dim)),
         ),
+        "mmdit": lambda: (
+            jnp.zeros((1, 8, 8, cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 16, cfg.context_dim)),
+            jnp.zeros((1, cfg.vec_dim)),
+        ),
         "vae": lambda: (jnp.zeros((1, 8, 8, cfg.in_channels)),),
         "text_encoder": lambda: (
             jnp.zeros((1, cfg.max_length), jnp.int32),
@@ -244,6 +250,40 @@ def test_wan_vae_schedule_matches_manifest():
     _assert_matches(derived, _manifest("wan21_vae"), proj_conv_keys=False)
 
 
+# --- Flux ------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "model_name,manifest_name",
+    [("flux-dev", "flux1_dev"), ("flux-schnell", "flux1_schnell")],
+)
+def test_flux_schedule_matches_manifest(model_name, manifest_name):
+    derived = _schedule_sd_shapes(
+        sdc.flux_schedule(get_config(model_name)), model_name
+    )
+    _assert_matches(derived, _manifest(manifest_name), proj_conv_keys=False)
+
+
+def test_flux_ae_schedule_matches_manifest():
+    derived = _schedule_sd_shapes(
+        sdc.vae_schedule(get_config("vae-flux"), prefix=""), "vae-flux"
+    )
+    _assert_matches(derived, _manifest("flux_ae"), proj_conv_keys=True)
+
+
+def test_t5_v11_schedule_matches_manifest():
+    """Classic T5 v1.1 (Flux): rel bias on block 0 only — the schedule
+    must not name per-layer bias keys the real file lacks."""
+    manifest = _manifest("t5_xxl_encoder")
+    assert (
+        "encoder.block.23.layer.0.SelfAttention.relative_attention_bias.weight"
+        not in manifest
+    )
+    derived = _schedule_sd_shapes(
+        sdc.t5_encoder_schedule(get_config("t5-xxl")), "t5-xxl"
+    )
+    _assert_matches(derived, manifest, proj_conv_keys=False)
+
+
 def test_umt5_schedule_matches_manifest():
     derived = _schedule_sd_shapes(
         sdc.t5_encoder_schedule(get_config("umt5-xxl")), "umt5-xxl"
@@ -320,6 +360,33 @@ HAND_PINNED = {
         "shared.weight": (256384, 4096),
         "encoder.block.23.layer.0.SelfAttention.relative_attention_bias.weight": (32, 64),
         "encoder.block.0.layer.1.DenseReluDense.wi_0.weight": (10240, 4096),
+    },
+    "flux1_dev": {
+        # flux1-dev.safetensors as listed by checkpoint inspectors
+        "img_in.weight": (3072, 64),
+        "txt_in.weight": (3072, 4096),
+        "time_in.in_layer.weight": (3072, 256),
+        "guidance_in.in_layer.weight": (3072, 256),
+        "vector_in.in_layer.weight": (3072, 768),
+        "double_blocks.0.img_attn.qkv.weight": (9216, 3072),
+        "double_blocks.18.txt_mlp.0.weight": (12288, 3072),
+        "double_blocks.0.img_attn.norm.query_norm.scale": (128,),
+        "single_blocks.37.linear1.weight": (21504, 3072),
+        "single_blocks.0.linear2.weight": (3072, 15360),
+        "final_layer.linear.weight": (64, 3072),
+        "final_layer.adaLN_modulation.1.weight": (6144, 3072),
+    },
+    "flux_ae": {
+        # ae.safetensors: bare keys, 16ch moments, no quant convs
+        "encoder.conv_in.weight": (128, 3, 3, 3),
+        "encoder.conv_out.weight": (32, 512, 3, 3),
+        "decoder.conv_in.weight": (512, 16, 3, 3),
+        "decoder.conv_out.weight": (3, 128, 3, 3),
+    },
+    "t5_xxl_encoder": {
+        "shared.weight": (32128, 4096),
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": (32, 64),
+        "encoder.block.23.layer.1.DenseReluDense.wo.weight": (4096, 10240),
     },
 }
 

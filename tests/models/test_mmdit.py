@@ -1,0 +1,173 @@
+"""Flux-class MMDiT family: flow schedule, conditioning layout,
+end-to-end tiny generation, and checkpoint-schedule round-trips.
+
+Parity target: the reference serves Flux models through ComfyUI's
+model zoo (UNETLoader + DualCLIPLoader; its conditioning utilities
+special-case Flux reference latents — reference utils/usdu_utils.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+from comfyui_distributed_tpu.models.registry import get_config
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return pl.load_pipeline("tiny-flux", seed=0)
+
+
+def test_flow_sigma_schedule_properties():
+    s = np.asarray(smp.get_flow_sigmas(4))
+    assert s.shape == (5,)
+    assert s[0] == pytest.approx(1.0)  # full denoise starts at pure noise
+    assert s[-1] == 0.0
+    assert np.all(np.diff(s) < 0)
+    # shift pushes mass toward high sigma
+    shifted = np.asarray(smp.get_flow_sigmas(4, shift=3.0))
+    assert shifted[2] > np.asarray(smp.get_flow_sigmas(4, shift=1.0))[2]
+    # denoise truncation starts near the denoise fraction (USDU parity)
+    s2 = np.asarray(smp.get_flow_sigmas(4, denoise=0.5, shift=1.0))
+    assert s2.shape == (5,)
+    assert s2[0] == pytest.approx(0.5, abs=0.13)
+
+
+def test_model_sigmas_dispatch():
+    flow = smp.get_model_sigmas("flow", "karras", 4, flow_shift=1.0)
+    np.testing.assert_allclose(
+        np.asarray(flow), np.asarray(smp.get_flow_sigmas(4, shift=1.0))
+    )
+    vp = smp.get_model_sigmas("eps", "karras", 4)
+    np.testing.assert_allclose(
+        np.asarray(vp), np.asarray(smp.get_sigmas("karras", 4))
+    )
+
+
+def test_noise_latents_interpolates_for_flow():
+    z = jnp.ones((1, 2, 2, 1))
+    n = jnp.zeros_like(z)
+    s = jnp.float32(0.25)
+    np.testing.assert_allclose(
+        np.asarray(smp.noise_latents("flow", z, n, s)), 0.75
+    )
+    np.testing.assert_allclose(
+        np.asarray(smp.noise_latents("eps", z, n, s)), 1.0
+    )
+
+
+def test_bundle_layout(bundle):
+    """Flux conditioning: T5 hidden context + CLIP pooled vector."""
+    assert bundle.latent_channels == 16
+    cond = pl.encode_text_pooled(bundle, ["a prompt"])
+    cfg = get_config("tiny-flux")
+    assert cond.context.shape[-1] == cfg.context_dim
+    assert cond.pooled is not None
+    assert cond.pooled.shape[-1] == cfg.vec_dim
+
+
+def test_txt2img_tiny_flux(bundle):
+    img = pl.txt2img(
+        bundle, "a prompt", height=32, width=32, steps=2, cfg_scale=1.0,
+        sampler="euler", seed=0,
+    )
+    assert img.shape == (1, 32, 32, 3)
+    assert np.isfinite(np.asarray(img)).all()
+    img2 = pl.txt2img(
+        bundle, "a prompt", height=32, width=32, steps=2, cfg_scale=1.0,
+        sampler="euler", seed=1,
+    )
+    assert not np.array_equal(np.asarray(img), np.asarray(img2))
+
+
+def test_usdu_on_flux(bundle):
+    """The tile re-diffusion core runs the flow family end to end
+    (interpolation noising + flow sigmas inside the tile scan)."""
+    from comfyui_distributed_tpu.ops import upscale as up
+
+    rng = np.random.default_rng(5)
+    img = jnp.asarray(rng.random((1, 64, 64, 3)), dtype=jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    out = up.run_upscale(
+        bundle, img, pos, neg, mesh=None, upscale_by=2.0, tile=64,
+        padding=16, steps=2, denoise=0.4, seed=3,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_flux_schedule_roundtrip_exact(bundle):
+    """Every MMDiT template leaf is covered by the flux key schedule,
+    bit-exactly, through the synthesize → convert round trip."""
+    cfg = get_config("tiny-flux")
+    flat = flatten_params(jax.device_get(bundle.params["unet"]))
+    schedule = sdc.flux_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, schedule)
+    converted, missing = sdc.convert_state_dict(state_dict, schedule)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+
+def test_load_flux_weights_transformer_only(bundle):
+    """A bare flux1-*.safetensors (transformer only) maps the unet and
+    leaves VAE/text encoders at init without complaint — published
+    Flux weights ship as separate files."""
+    cfg = get_config("tiny-flux")
+    flat = flatten_params(jax.device_get(bundle.params["unet"]))
+    state_dict = sdc.synthesize_state_dict(flat, sdc.flux_schedule(cfg))
+    templates = {
+        "unet": bundle.params["unet"],
+        "vae": bundle.params["vae"],
+        "te": bundle.params["te"],
+        "te2": bundle.params["te2"],
+    }
+    out, problems = sdc.load_sd_weights(
+        state_dict, cfg, get_config("tiny-vae-flux"),
+        get_config("tiny-t5-shared"), templates,
+        te2_cfg=get_config("tiny-te"), family="mmdit",
+    )
+    assert problems == []
+    got = flatten_params(out["unet"])
+    for key, want in flat.items():
+        np.testing.assert_array_equal(got[key], np.asarray(want), err_msg=key)
+    # untouched parts stay at init
+    np.testing.assert_array_equal(
+        flatten_params(out["vae"])[
+            sorted(flatten_params(out["vae"]))[0]
+        ],
+        flatten_params(jax.device_get(bundle.params["vae"]))[
+            sorted(flatten_params(jax.device_get(bundle.params["vae"])))[0]
+        ],
+    )
+
+
+def test_t5_shared_rel_bias_tree():
+    """tiny-t5-shared (Flux T5 v1.1 layout): one top-level rel_bias,
+    none inside blocks — and the schedule maps it."""
+    from comfyui_distributed_tpu.models.registry import create_model
+
+    cfg = get_config("tiny-t5-shared")
+    te = create_model("tiny-t5-shared")
+    params = te.init(
+        jax.random.key(0), jnp.zeros((1, cfg.max_length), jnp.int32)
+    )
+    flat = flatten_params(jax.device_get(params))
+    assert "params/rel_bias/embedding" in flat
+    assert not any("block_0/rel_bias" in k for k in flat)
+    schedule = sdc.t5_encoder_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, schedule)
+    converted, missing = sdc.convert_state_dict(state_dict, schedule)
+    assert not missing and set(converted) == set(flat)
