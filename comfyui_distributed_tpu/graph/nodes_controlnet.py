@@ -96,6 +96,31 @@ class ConditioningSetArea:
 
 
 @register_node
+class FluxGuidance:
+    """Set the distilled guidance scale a Flux-class model embeds
+    (ComfyUI FluxGuidance parity). This is the correct guidance knob
+    for guidance-distilled models — true CFG (the cfg input) doubles
+    model evals and was not what flux-dev trained on."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "guidance": ("FLOAT", {"default": 3.5}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "append"
+
+    def append(self, conditioning, guidance, context=None):
+        cond = as_conditioning(conditioning).clone()
+        cond.guidance = float(guidance)
+        return (cond,)
+
+
+@register_node
 class ConditioningSetMask:
     @classmethod
     def INPUT_TYPES(cls):

@@ -319,7 +319,10 @@ class KSampler:
                 param, base, jax.random.normal(noise_key, base.shape), sigmas[0]
             )
             model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
-            return smp.sample(model_fn, x, sigmas, (pos, neg), sampler_name, anc_key)
+            return smp.sample(
+                model_fn, x, sigmas, (pos, neg), sampler_name, anc_key,
+                flow=(param == "flow"),
+            )
 
         out = jax.jit(
             jax.shard_map(

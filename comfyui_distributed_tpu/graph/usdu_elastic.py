@@ -268,7 +268,10 @@ def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
             param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
         )
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
-        z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
+        z_out = smp.sample(
+            model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key,
+            flow=(param == "flow"),
+        )
         if tiled_decode:
             from ..ops.tiled_vae import decode_tiled
 

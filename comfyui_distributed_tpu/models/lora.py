@@ -32,6 +32,7 @@ from .sd_checkpoint import (
     _LINEAR,
     _LINEAR_NOBIAS,
     _PROJ,
+    flux_schedule,
     unet_schedule,
 )
 
@@ -76,15 +77,32 @@ def lora_target_map(
     """{kohya_module_name: (part, flax_kernel_path)} for every linear/
     projection weight a LoRA can target.
 
-    Raises ValueError for non-UNet backbone configs (DiT etc.) — LoRA
-    merging is only implemented for the UNet family.
+    Raises ValueError for unsupported backbone configs (video DiT) —
+    LoRA merging is implemented for the UNet and MMDiT (Flux) families.
     """
+    from .mmdit import MMDiTConfig
     from .unet import UNetConfig
 
+    if isinstance(unet_cfg, MMDiTConfig):
+        # Flux kohya layout: bare transformer keys, underscored
+        # (lora_unet_double_blocks_0_img_attn_qkv). Text-encoder LoRAs
+        # target the CLIP tower as lora_te1_* — T5 is not a LoRA
+        # target in the kohya flux trainers, so te_cfg (the T5 config)
+        # is ignored and te2_cfg (CLIP, part 'te2') takes lora_te1.
+        targets: dict[str, tuple[str, str]] = {}
+        for sd, fx, kind in flux_schedule(unet_cfg):
+            if kind not in (_LINEAR, _LINEAR_NOBIAS, _PROJ):
+                continue
+            targets["lora_unet_" + sd.replace(".", "_")] = (
+                "unet", f"params/{fx}/kernel",
+            )
+        if te2_cfg is not None:
+            targets.update(_te_targets(te2_cfg, "lora_te1", "te2"))
+        return targets
     if not isinstance(unet_cfg, UNetConfig):
         raise ValueError(
-            "LoRA merging is only supported for UNet-family models "
-            f"(got config {type(unet_cfg).__name__})"
+            "LoRA merging is only supported for UNet- and MMDiT-family "
+            f"models (got config {type(unet_cfg).__name__})"
         )
     targets: dict[str, tuple[str, str]] = {}
     for sd, fx, kind in unet_schedule(unet_cfg):

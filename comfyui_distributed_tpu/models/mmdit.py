@@ -23,10 +23,14 @@ Flax submodule names mirror the original state-dict keys
 the key schedule in sd_checkpoint stays a straight rename.
 
 The model predicts rectified-flow velocity v = noise - x0; with the
-sampler eps contract (denoised = x - sigma*eps) v IS eps, so the whole
-k-diffusion sampler set applies unchanged — models/pipeline.py selects
-the flow sigma schedule and interpolation noising via
-`parameterization == "flow"`.
+sampler eps contract (denoised = x - sigma*eps) v IS eps, so the
+deterministic k-diffusion samplers (euler, ddim, heun, dpmpp_2m, ...)
+apply unchanged — models/pipeline.py selects the flow sigma schedule
+and interpolation noising via `parameterization == "flow"`. Stochastic
+renoising is a different story: the VE rule (x += noise*sigma_up) is
+off the flow marginal x_t = (1-s)x0 + s*n, so ops/samplers.sample
+routes euler_ancestral to an RF-correct rule and rejects the other
+stochastic samplers for flow models.
 """
 
 from __future__ import annotations
@@ -275,13 +279,19 @@ class MMDiT(nn.Module):
         timesteps: jax.Array,   # [B] flow time in [0, 1]
         context: jax.Array,     # [B, T, context_dim] T5 hidden states
         y: jax.Array | None = None,        # [B, vec_dim] CLIP pooled
-        control: jax.Array | None = None,  # unsupported (Flux ControlNet
+        control: jax.Array | None = None,  # rejected (Flux ControlNet
         #                                    is a separate architecture)
         guidance: jax.Array | None = None,  # [B] distilled guidance
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
-        del control
+        if control is not None:
+            # silent no-op would waste the caller's ControlNet compute
+            # and produce an uncontrolled image with no explanation
+            raise ValueError(
+                "Flux-class MMDiT has no ControlNet input path "
+                "(Flux ControlNets are a separate architecture)"
+            )
         b, hh, ww, c = x.shape
         p = cfg.patch_size
         assert hh % p == 0 and ww % p == 0, "patch misalign"

@@ -272,6 +272,10 @@ def _make_model_fn(bundle: PipelineBundle, params):
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
+        is_flow = (
+            getattr(get_config(bundle.model_name), "parameterization", "eps")
+            == "flow"
+        )
         context = cond.context if isinstance(cond, Conditioning) else cond
         control = None
         if (
@@ -279,6 +283,12 @@ def _make_model_fn(bundle: PipelineBundle, params):
             and cond.control_hint is not None
             and cond.control_module is not None
         ):
+            if is_flow:
+                raise ValueError(
+                    "ControlNet conditioning is not supported for "
+                    "Flux-class models (Flux ControlNets are a separate "
+                    "architecture)"
+                )
             feats = cond.control_module.apply(cond.control_params, cond.control_hint)
             lh, lw = x.shape[1], x.shape[2]
             if feats.shape[1] != lh or feats.shape[2] != lw:
@@ -321,12 +331,17 @@ def _make_model_fn(bundle: PipelineBundle, params):
             if pooled.shape[0] != x.shape[0]:
                 pooled = jnp.broadcast_to(pooled[:1], (x.shape[0], pooled.shape[-1]))
             y = pooled
-        if getattr(get_config(bundle.model_name), "parameterization", "eps") == "flow":
+        if is_flow:
             # rectified flow (Flux class): t IS sigma, no input scaling,
             # and the velocity prediction equals eps under the sampler
-            # contract denoised = x - sigma*eps
+            # contract denoised = x - sigma*eps. The distilled guidance
+            # scale comes from the conditioning (FluxGuidance node);
+            # None falls back to the config default inside the model.
+            g = None
+            if isinstance(cond, Conditioning) and cond.guidance is not None:
+                g = jnp.full((x.shape[0],), float(cond.guidance), jnp.float32)
             out = bundle.unet.apply(
-                params["unet"], x, sigma_batch, context, y=y, control=control
+                params["unet"], x, sigma_batch, context, y=y, guidance=g
             )
             return out.astype(x.dtype)
         c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(
@@ -383,7 +398,8 @@ def _txt2img_jit(
     ) * sigmas[0]
     model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
     latents = smp.sample(
-        model, x, sigmas, (context_pos, context_neg), sampler, anc_key
+        model, x, sigmas, (context_pos, context_neg), sampler, anc_key,
+        flow=(param == "flow"),
     )
     return bundle.vae.apply(params["vae"], latents, method="decode")
 
@@ -465,7 +481,10 @@ def _img2img_jit(
         param, latents, jax.random.normal(noise_key, latents.shape), sigmas[0]
     )
     model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
-    return smp.sample(model, x, sigmas, (context_pos, context_neg), sampler, anc_key)
+    return smp.sample(
+        model, x, sigmas, (context_pos, context_neg), sampler, anc_key,
+        flow=(param == "flow"),
+    )
 
 
 def img2img_latents(

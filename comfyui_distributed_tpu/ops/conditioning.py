@@ -55,6 +55,9 @@ class Conditioning:
     # crop_reference_latents): list of [B, h_lat, w_lat, C] arrays,
     # windowed to each tile's latent region.
     reference_latents: Optional[list] = None
+    # Flux-class distilled guidance scale (the FluxGuidance node);
+    # None = the model config's default
+    guidance: Optional[float] = None
     # Named spatial model patches (the TPU-native analog of the
     # reference's crop_model_patch context manager for DiffSynth/
     # ZImage transformer patches): pixel-space [B, H, W, C] arrays
@@ -259,7 +262,7 @@ def _cond_flatten(cond: Conditioning):
     )
     aux = (
         cond.control_strength, cond.area, cond.control_module,
-        cond.gligen_boxes, cond.gligen_active,
+        cond.gligen_boxes, cond.gligen_active, cond.guidance,
     )
     return children, aux
 
@@ -268,7 +271,7 @@ def _cond_unflatten(aux, children):
     (context, control_hint, mask, control_params, pooled, gligen_embs,
      reference_latents, model_patches) = children
     (control_strength, area, control_module, gligen_boxes,
-     gligen_active) = aux
+     gligen_active, guidance) = aux
     return Conditioning(
         context=context,
         control_hint=control_hint,
@@ -281,6 +284,7 @@ def _cond_unflatten(aux, children):
         gligen_embs=gligen_embs,
         gligen_boxes=gligen_boxes,
         gligen_active=gligen_active,
+        guidance=guidance,
         reference_latents=reference_latents,
         model_patches=model_patches,
     )

@@ -208,9 +208,17 @@ def sample(
     cond: Any,
     sampler: str = "euler",
     noise_key: jax.Array | None = None,
+    flow: bool = False,
 ) -> jax.Array:
     """Run a full sampling trajectory. x_init must already be scaled by
-    sigmas[0] (pure noise for txt2img; noised latents for img2img)."""
+    sigmas[0] (pure noise for txt2img; noised latents for img2img).
+
+    `flow=True` (rectified-flow models, Flux class): deterministic
+    samplers apply unchanged (velocity == eps under the denoised
+    contract), euler_ancestral routes to the RF-correct renoise rule,
+    and the remaining stochastic samplers are rejected — their VE
+    renoising (x += noise*sigma_up) puts the latent off the flow
+    marginal x_t = (1-sigma)x0 + sigma*n the model was trained on."""
     deterministic = {
         "euler": _sample_euler,
         "heun": _sample_heun,
@@ -232,6 +240,17 @@ def sample(
     if sampler in stochastic:
         if noise_key is None:
             raise ValueError(f"{sampler} requires noise_key")
+        if flow:
+            if sampler != "euler_ancestral":
+                raise ValueError(
+                    f"{sampler!r} renoises with the VE rule, which is "
+                    "invalid for rectified-flow models; use a "
+                    "deterministic sampler (euler, ddim, dpmpp_2m, ...) "
+                    "or euler_ancestral"
+                )
+            return _sample_euler_ancestral_rf(
+                model_fn, x_init, sigmas, cond, noise_key
+            )
         return stochastic[sampler](model_fn, x_init, sigmas, cond, noise_key)
     raise ValueError(f"unknown sampler {sampler!r}; use {SAMPLER_NAMES}")
 
@@ -569,6 +588,40 @@ def _sample_euler_ancestral(model_fn, x, sigmas, cond, key):
         key, sub = jax.random.split(key)
         noise = jax.random.normal(sub, x.shape, x.dtype)
         x = x + noise * sigma_up
+        return (x, key), None
+
+    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)
+    (x, _), _ = jax.lax.scan(step, (x, key), pairs)
+    return x
+
+
+def _sample_euler_ancestral_rf(model_fn, x, sigmas, cond, key, eta=1.0):
+    """Ancestral Euler for rectified flow. Under x_t = (1-s)x0 + s*n
+    the VE renoise rule (x += noise*sigma_up) leaves the latent off the
+    flow marginal; the RF rule downsteps to sigma_down, rescales the
+    signal by alpha_next/alpha_down, and renoises with the coefficient
+    that restores exactly the (1-s_next, s_next) marginal."""
+
+    def step(carry, sig_pair):
+        x, key = carry
+        sigma, sigma_next = sig_pair
+        den = _denoised(model_fn, x, sigma, cond)
+        down_ratio = 1.0 + (sigma_next / jnp.maximum(sigma, 1e-10) - 1.0) * eta
+        sigma_down = sigma_next * down_ratio
+        alpha_next = 1.0 - sigma_next
+        alpha_down = jnp.maximum(1.0 - sigma_down, 1e-10)
+        renoise = jnp.sqrt(
+            jnp.maximum(
+                sigma_next**2 - sigma_down**2 * (alpha_next / alpha_down) ** 2,
+                0.0,
+            )
+        )
+        r = sigma_down / jnp.maximum(sigma, 1e-10)
+        x_det = r * x + (1.0 - r) * den
+        key, sub = jax.random.split(key)
+        noise = jax.random.normal(sub, x.shape, x.dtype)
+        x_st = (alpha_next / alpha_down) * x_det + noise * renoise
+        x = jnp.where(sigma_next > 0, x_st, den)
         return (x, key), None
 
     pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=-1)

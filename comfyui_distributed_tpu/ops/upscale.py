@@ -267,7 +267,10 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise,
             param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
         )
         model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
-        z_out = smp.sample(model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key)
+        z_out = smp.sample(
+            model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key,
+            flow=(param == "flow"),
+        )
         if tiled_decode:
             from .tiled_vae import decode_tiled
 

@@ -59,7 +59,10 @@ def _parallel_txt2img_jit(
             noise_key, (batch_per_device, lh, lw, chans)
         ) * sigmas[0]
         model = smp.cfg_model(pl._make_model_fn(bundle, params), cfg_scale)
-        latents = smp.sample(model, x, sigmas, (pos, neg), sampler, anc_key)
+        latents = smp.sample(
+            model, x, sigmas, (pos, neg), sampler, anc_key,
+            flow=(param == "flow"),
+        )
         return bundle.vae.apply(params["vae"], latents, method="decode")
 
     return jax.shard_map(

@@ -209,6 +209,41 @@ def test_lora_loader_separate_clip_bundle(tmp_path, monkeypatch):
     assert new_model.params["te"] is model_bundle.params["te"]
 
 
+def test_flux_lora_targets_and_apply():
+    """Flux kohya layout: bare underscored transformer keys, CLIP
+    tower as lora_te1 (part te2), T5 not a target."""
+    bundle = pl.load_pipeline("tiny-flux", seed=0)
+    unet_cfg = get_config("tiny-flux")
+    targets = lora_mod.lora_target_map(
+        unet_cfg, get_config("tiny-t5-shared"), te2_cfg=get_config("tiny-te")
+    )
+    assert "lora_unet_double_blocks_0_img_attn_qkv" in targets
+    assert "lora_unet_single_blocks_0_linear1" in targets
+    assert "lora_unet_final_layer_linear" in targets
+    te1 = "lora_te1_text_model_encoder_layers_0_self_attn_q_proj"
+    assert targets[te1][0] == "te2"
+    assert not any(k.startswith("lora_te_") for k in targets)
+
+    name = "lora_unet_double_blocks_0_img_attn_qkv"
+    part, path = targets[name]
+    flat = flatten_params(jax.device_get(bundle.params[part]))
+    kernel = np.asarray(flat[path], np.float32)
+    down, up, alpha = _make_lora(kernel.shape)
+    sd = {
+        f"{name}.lora_down.weight": down,
+        f"{name}.lora_up.weight": up,
+        f"{name}.alpha": np.float32(alpha),
+    }
+    patched, unmatched = lora_mod.apply_lora(
+        {"unet": bundle.params["unet"]}, sd, unet_cfg, strength=0.5
+    )
+    assert unmatched == []
+    got = np.asarray(flatten_params(patched["unet"])[path], np.float32)
+    rank = down.shape[0]
+    want = kernel + 0.5 * (alpha / rank) * (down.T @ up.T)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 def test_lora_loader_rejects_non_unet(tmp_path):
     from safetensors.numpy import save_file
 
@@ -220,7 +255,7 @@ def test_lora_loader_rejects_non_unet(tmp_path):
         str(lora_path),
     )
     bundle = pl.load_pipeline("tiny-dit", seed=0)
-    with pytest.raises(ValueError, match="UNet-family"):
+    with pytest.raises(ValueError, match="family"):
         LoraLoader().load_lora(bundle, bundle, str(lora_path))
 
 

@@ -87,6 +87,63 @@ def test_txt2img_tiny_flux(bundle):
     assert not np.array_equal(np.asarray(img), np.asarray(img2))
 
 
+def test_flow_sampler_guard(bundle):
+    """euler_ancestral routes to the RF renoise rule; the other
+    stochastic samplers' VE renoising is rejected for flow models."""
+    img = pl.txt2img(
+        bundle, "p", height=32, width=32, steps=2, cfg_scale=1.0,
+        sampler="euler_ancestral", seed=0,
+    )
+    assert np.isfinite(np.asarray(img)).all()
+    with pytest.raises(ValueError, match="rectified-flow"):
+        pl.txt2img(
+            bundle, "p", height=32, width=32, steps=2, cfg_scale=1.0,
+            sampler="dpmpp_sde", seed=0,
+        )
+
+
+def test_flux_guidance_conditioning(bundle):
+    """The FluxGuidance knob reaches the distilled-guidance embedding:
+    different scales produce different predictions."""
+    import dataclasses
+
+    cond = pl.encode_text_pooled(bundle, ["p"])
+    model_fn = pl._make_model_fn(bundle, bundle.params)
+    z = jnp.full((1, 4, 4, 16), 0.1)
+    s = jnp.full((1,), 0.5)
+    low = model_fn(z, s, dataclasses.replace(cond, guidance=1.0))
+    high = model_fn(z, s, dataclasses.replace(cond, guidance=4.0))
+    default = model_fn(z, s, cond)
+    assert not np.allclose(np.asarray(low), np.asarray(high))
+    assert np.isfinite(np.asarray(default)).all()
+
+
+def test_flux_rejects_controlnet(bundle):
+    z = jnp.zeros((1, 4, 4, 16))
+    t = jnp.zeros((1,))
+    ctx = jnp.zeros((1, 4, 64))
+    with pytest.raises(ValueError, match="ControlNet"):
+        bundle.unet.apply(
+            bundle.params["unet"], z, t, ctx, control=jnp.zeros((1, 4, 4, 16))
+        )
+
+
+def test_ksampler_rebuilds_latents_for_flux(bundle):
+    """EmptyLatentImage emits nominal 8x 4-channel latents; KSampler
+    must rebuild them to the bundle's actual latent geometry (Flux:
+    16 channels) instead of feeding 4ch latents into img_in."""
+    from comfyui_distributed_tpu.graph.nodes_core import KSampler
+
+    latent = {"samples": jnp.zeros((1, 4, 4, 4)), "width": 32, "height": 32}
+    pos = pl.encode_text_pooled(bundle, ["p"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    (out,) = KSampler().sample(
+        bundle, 0, 2, 1.0, "euler", "simple", pos, neg, latent
+    )
+    lh = 32 // bundle.latent_scale
+    assert out["samples"].shape == (1, lh, lh, bundle.latent_channels)
+
+
 def test_usdu_on_flux(bundle):
     """The tile re-diffusion core runs the flow family end to end
     (interpolation noising + flow sigmas inside the tile scan)."""
