@@ -80,6 +80,17 @@ def compute_goldens() -> dict[str, np.ndarray]:
             steps=2, seed=42,
         )
     )
+
+    # rectified-flow family (Flux class): flow sigmas + interpolation
+    # noising + T5-context/CLIP-pooled conditioning end to end
+    fbundle = pl.load_pipeline("tiny-flux", seed=0)
+    out["flux_txt2img_32"] = np.asarray(
+        pl.txt2img(
+            fbundle, "a golden flux image", height=32, width=32,
+            steps=2, seed=99, cfg_scale=1.0, sampler="euler",
+            scheduler="simple",
+        )
+    )
     return out
 
 
