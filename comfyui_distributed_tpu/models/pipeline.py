@@ -38,10 +38,14 @@ class PipelineBundle:
     # second encoder's tokenizer: OpenCLIP towers pad with 0, CLIP-L
     # with EOS, so the dual path tokenizes per encoder (None = share)
     tokenizer_2: Tokenizer | None = None
+    # SD3-class third encoder (T5; CLIP-L/G are te/te2)
+    text_encoder_3: Any = None
+    tokenizer_3: Any = None
     # registry names the encoders were built from (LoRA mapping needs
     # the real configs, not a guess from model_name)
     te_name: str | None = None
     te2_name: str | None = None
+    te3_name: str | None = None
 
 
 def load_pipeline(
@@ -65,6 +69,7 @@ def load_pipeline(
         DEFAULT_TEXT_ENCODERS,
         DUAL_TEXT_ENCODERS,
         HIDDEN_POOLED_ENCODERS,
+        TRIPLE_TEXT_ENCODERS,
         model_family,
     )
 
@@ -72,11 +77,20 @@ def load_pipeline(
     family = model_family(model_name)
     dual = DUAL_TEXT_ENCODERS.get(model_name)
     hidden_pooled = HIDDEN_POOLED_ENCODERS.get(model_name)
+    triple = TRIPLE_TEXT_ENCODERS.get(model_name)
     if family == "mmdit":
         vae_name = vae_name or ("tiny-vae-flux" if tiny else "vae-flux")
+    elif family == "sd3":
+        vae_name = vae_name or ("tiny-vae-sd3" if tiny else "vae-sd3")
     else:
         vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
-    if hidden_pooled:
+    te3_name = None
+    if triple:
+        # SD3 layout: CLIP-L + CLIP-G + T5
+        te_name = te_name or triple[0]
+        te2_name = triple[1]
+        te3_name = triple[2]
+    elif hidden_pooled:
         # Flux layout: hidden states from a T5-class encoder, pooled
         # vector from a CLIP-class encoder
         te_name = te_name or hidden_pooled[0]
@@ -108,9 +122,9 @@ def load_pipeline(
     if family == "dit":  # video DiT
         lat5 = jnp.zeros((1, 4, 16, 16, unet_cfg.in_channels))
         unet_params = unet.init(k_unet, lat5, ts, ctx)
-    elif family == "mmdit":
+    elif family in ("mmdit", "sd3"):
         unet_params = unet.init(
-            k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.vec_dim))
+            k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.adm_in_channels))
         )
     else:
         unet_params = unet.init(k_unet, lat, ts, ctx)
@@ -126,6 +140,13 @@ def load_pipeline(
         te2_cfg = get_config(te2_name)
         tokens2 = jnp.zeros((1, te2_cfg.max_length), jnp.int32)
         te2_params = te2.init(jax.random.fold_in(k_te, 2), tokens2)
+    te3 = None
+    te3_params = None
+    if te3_name:
+        te3 = create_model(te3_name)
+        te3_cfg = get_config(te3_name)
+        tokens3 = jnp.zeros((1, te3_cfg.max_length), jnp.int32)
+        te3_params = te3.init(jax.random.fold_in(k_te, 3), tokens3)
 
     from . import sd_checkpoint as sdc
 
@@ -138,19 +159,23 @@ def load_pipeline(
         templates = {"unet": unet_params, "vae": vae_params, "te": te_params}
         if te2_params is not None:
             templates["te2"] = te2_params
+        if te3_params is not None:
+            templates["te3"] = te3_params
         mapped, _problems = sdc.load_sd_weights(
             state_dict, unet_cfg, vae_cfg, te_cfg, templates,
             te2_cfg=get_config(te2_name) if te2_name else None,
+            te3_cfg=get_config(te3_name) if te3_name else None,
             family=family,
         )
         unet_params = mapped["unet"]
         vae_params = mapped["vae"]
         te_params = mapped["te"]
         te2_params = mapped.get("te2", te2_params)
+        te3_params = mapped.get("te3", te3_params)
+
+    from .t5_encoder import T5Tokenizer
 
     if family == "mmdit":
-        from .t5_encoder import T5Tokenizer
-
         tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
     else:
         tokenizer = Tokenizer(
@@ -160,6 +185,8 @@ def load_pipeline(
     params = {"unet": unet_params, "vae": vae_params, "te": te_params}
     if te2_params is not None:
         params["te2"] = te2_params
+    if te3_params is not None:
+        params["te3"] = te3_params
     return PipelineBundle(
         model_name=model_name,
         unet=unet,
@@ -177,8 +204,13 @@ def load_pipeline(
             if te2_name
             else None
         ),
+        text_encoder_3=te3,
+        tokenizer_3=(
+            T5Tokenizer(max_length=te3_cfg.max_length) if te3_name else None
+        ),
         te_name=te_name,
         te2_name=te2_name,
+        te3_name=te3_name,
     )
 
 
@@ -194,6 +226,42 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
     backbone's context_dim only when they genuinely mismatch.
     """
     from .registry import model_family
+
+    if model_family(bundle.model_name) == "sd3":
+        # SD3 layout: CLIP-L/G penultimate states concatenated on
+        # features, zero-padded to the T5 width, sequence-concatenated
+        # with T5 states; pooled = CLIP-L pooled ++ CLIP-G pooled.
+        if bundle.text_encoder_2 is None or bundle.text_encoder_3 is None:
+            raise ValueError(
+                f"{bundle.model_name}: sd3 bundles need all three text "
+                "encoders (CLIP-L, CLIP-G, T5)"
+            )
+        tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
+        h_l, p_l = bundle.text_encoder.apply(
+            bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
+        )
+        tok2 = bundle.tokenizer_2
+        tokens2 = jnp.asarray(tok2.encode_batch(texts))
+        h_g, p_g = bundle.text_encoder_2.apply(
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
+        )
+        tokens3 = jnp.asarray(bundle.tokenizer_3.encode_batch(texts))
+        h_t5, _ = bundle.text_encoder_3.apply(bundle.params["te3"], tokens3)
+        clip_ctx = jnp.concatenate(
+            [h_l.astype(jnp.float32), h_g.astype(jnp.float32)], axis=-1
+        )
+        width = h_t5.shape[-1]
+        if clip_ctx.shape[-1] < width:
+            clip_ctx = jnp.pad(
+                clip_ctx, ((0, 0), (0, 0), (0, width - clip_ctx.shape[-1]))
+            )
+        hidden = jnp.concatenate(
+            [clip_ctx, h_t5.astype(jnp.float32)], axis=1
+        )
+        pooled = jnp.concatenate(
+            [p_l.astype(jnp.float32), p_g.astype(jnp.float32)], axis=-1
+        )
+        return hidden, pooled
 
     if model_family(bundle.model_name) == "mmdit":
         # Flux layout: T5 hidden states are the context; the pooled

@@ -17,6 +17,7 @@ from typing import Any, Callable
 from .clip_vision import ClipVisionConfig, ClipVisionEncoder
 from .dit import DiTConfig, VideoDiT
 from .mmdit import MMDiT, MMDiTConfig
+from .sd3 import SD3Config, SD3MMDiT
 from .t5_encoder import T5Encoder, T5EncoderConfig
 from .text_encoder import TextEncoder, TextEncoderConfig
 from .unet import UNet, UNetConfig
@@ -136,6 +137,29 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             flow_shift=1.0,
         ),
     },
+    # --- SD3-class image MMDiT (joint blocks, learned pos table) ---
+    # SD3-medium (2B): depth 24 -> hidden 1536, no QK norm
+    "sd3-medium": {
+        "family": "sd3",
+        "config": SD3Config(depth=24, remat=True),
+    },
+    # SD3.5-large (8B): depth 38, hidden 2432, per-head RMS QK norm
+    "sd35-large": {
+        "family": "sd3",
+        "config": SD3Config(
+            depth=38, hidden_dim=2432, heads=38, qk_norm=True, remat=True
+        ),
+    },
+    # tiny: context 160 = tiny CLIP-L(64) ++ CLIP-G(96) = T5 width;
+    # pos table covers USDU's padded 96px tiles (latent 48 / patch 2)
+    "tiny-sd3": {
+        "family": "sd3",
+        "config": SD3Config(
+            depth=2, hidden_dim=32, heads=2, context_dim=160,
+            pooled_dim=160, pos_embed_max=32, qk_norm=True,
+            flow_shift=1.0,
+        ),
+    },
     # --- video DiT backbones (WAN 2.x checkpoint-faithful dims) ---
     "wan-1.3b": {
         "family": "dit",
@@ -220,6 +244,22 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             use_quant_conv=False,
         ),
     },
+    # SD3-class 16ch AE: scale 1.5305, shift 0.0609, no quant convs
+    "vae-sd3": {
+        "family": "vae",
+        "config": VAEConfig(
+            latent_channels=16, scaling_factor=1.5305, shift_factor=0.0609,
+            use_quant_conv=False,
+        ),
+    },
+    "tiny-vae-sd3": {
+        "family": "vae",
+        "config": VAEConfig(
+            base_channels=16, channel_mult=(1, 2), num_res_blocks=1,
+            latent_channels=16, scaling_factor=1.5305, shift_factor=0.0609,
+            use_quant_conv=False,
+        ),
+    },
     "tiny-vae": {
         "family": "vae",
         "config": VAEConfig(base_channels=16, channel_mult=(1, 2), num_res_blocks=1),
@@ -238,6 +278,12 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     "clip-l-sdxl": {
         "family": "text_encoder",
         "config": TextEncoderConfig(penultimate_hidden=True),
+    },
+    # SD3's CLIP-L half: penultimate hidden + PROJECTED pooled (the
+    # files bundle CLIPTextModelWithProjection with a 768x768 table)
+    "clip-l-sd3": {
+        "family": "text_encoder",
+        "config": TextEncoderConfig(penultimate_hidden=True, proj_dim=768),
     },
     "clip-g": {
         "family": "text_encoder",
@@ -293,6 +339,23 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             heads=64, d_kv=64, per_layer_rel_bias=False,
         ),
     },
+    # SD3's T5 slot: same weights, 77-token padding (the reference
+    # stack pads T5 to 77 for SD3; Flux uses the long padding)
+    "t5-xxl-sd3": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            vocab_size=32128, d_model=4096, d_ff=10240, layers=24,
+            heads=64, d_kv=64, per_layer_rel_bias=False, max_length=77,
+        ),
+    },
+    # tiny T5 at the tiny-SD3 context width (160 = tiny CLIP concat)
+    "tiny-t5-sd3": {
+        "family": "t5_encoder",
+        "config": T5EncoderConfig(
+            vocab_size=49408, d_model=160, d_ff=320, layers=2, heads=2,
+            d_kv=32, max_length=16, per_layer_rel_bias=False,
+        ),
+    },
     # tiny shared-bias variant (Flux layout) for hermetic tests; vocab
     # covers the CLIP-BPE fallback id space like tiny-t5
     "tiny-t5-shared": {
@@ -346,10 +409,20 @@ HIDDEN_POOLED_ENCODERS: dict[str, tuple[str, str]] = {
     "tiny-flux": ("tiny-t5-shared", "tiny-te"),
 }
 
+# SD3-layout conditioning: (CLIP-L, CLIP-G, T5) — CLIP hiddens concat
+# on features, zero-pad to the T5 width, sequence-concat with T5;
+# pooled = CLIP-L pooled ++ CLIP-G pooled (models/pipeline._encode_raw).
+TRIPLE_TEXT_ENCODERS: dict[str, tuple[str, str, str]] = {
+    "sd3-medium": ("clip-l-sd3", "clip-g", "t5-xxl-sd3"),
+    "sd35-large": ("clip-l-sd3", "clip-g", "t5-xxl-sd3"),
+    "tiny-sd3": ("tiny-te-l", "tiny-te-g", "tiny-t5-sd3"),
+}
+
 _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {
     "unet": lambda cfg: UNet(cfg),
     "dit": lambda cfg: VideoDiT(cfg),
     "mmdit": lambda cfg: MMDiT(cfg),
+    "sd3": lambda cfg: SD3MMDiT(cfg),
     "vae": lambda cfg: VAE(cfg),
     "text_encoder": lambda cfg: TextEncoder(cfg),
     "t5_encoder": lambda cfg: T5Encoder(cfg),

@@ -1,0 +1,277 @@
+"""SD3-class image MMDiT (Stable Diffusion 3 / 3.5), flax.linen.
+
+The second rectified-flow image family the reference serves through
+ComfyUI's model zoo. Architecturally a sibling of Flux
+(models/mmdit.py) with the original MMDiT design choices, kept
+checkpoint-faithful to the published `model.diffusion_model.*` layout:
+
+- 2x2 patchify via a stride-2 conv (`x_embedder.proj`) instead of a
+  token linear; a LEARNED position table (`pos_embed`,
+  [1, max*max, hidden]) center-cropped to the latent grid instead of
+  rope;
+- N "joint blocks", each an (x_block, context_block) pair with
+  separate adaLN modulation/attention/MLP params and one joint
+  attention over [context; x]; the FINAL block's context side is
+  `pre_only` (qkv + 2-way adaLN, no proj/MLP) and its context output
+  is discarded;
+- optional per-head RMS Q/K norm (`attn.ln_q/ln_k` — the SD3.5
+  addition; SD3-medium ships without);
+- conditioning: CLIP-L + CLIP-G penultimate states concatenated on
+  features, zero-padded to the T5 width, then sequence-concatenated
+  with T5-XXL states; the modulation vector is timestep MLP + pooled
+  (CLIP-L ++ CLIP-G) MLP.
+
+Rectified flow exactly as the Flux family: velocity == eps under the
+sampler contract, flow sigma schedule + interpolation noising selected
+by `parameterization == "flow"` (models/pipeline.py, ops/samplers.py).
+
+Flax submodule names mirror the original state-dict keys
+(joint_blocks_N/x_attn_qkv ↔ joint_blocks.N.x_block.attn.qkv, ...) so
+sd_checkpoint.sd3_schedule stays a straight rename.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .layers import timestep_embedding
+from ..ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class SD3Config:
+    in_channels: int = 16
+    patch_size: int = 2
+    depth: int = 24                # joint blocks; hidden = 64 * depth
+    hidden_dim: int | None = None  # default 64 * depth (the SD3 rule)
+    heads: int | None = None       # default depth (head_dim 64)
+    context_dim: int = 4096        # T5 width == padded CLIP width
+    pooled_dim: int = 2048         # CLIP-L (768) ++ CLIP-G (1280)
+    mlp_ratio: float = 4.0
+    freq_dim: int = 256
+    pos_embed_max: int = 192       # learned table is [max*max, hidden]
+    qk_norm: bool = False          # SD3.5: per-head RMS ln_q/ln_k
+    parameterization: str = "flow"
+    flow_shift: float = 3.0        # the published SD3 sampling shift
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def width(self) -> int:
+        return self.hidden_dim if self.hidden_dim is not None else 64 * self.depth
+
+    @property
+    def n_heads(self) -> int:
+        return self.heads if self.heads is not None else self.depth
+
+    @property
+    def mlp_width(self) -> int:
+        return int(self.width * self.mlp_ratio)
+
+    @property
+    def adm_in_channels(self) -> int:
+        """Hooks the pooled-text plumbing in pipeline._make_model_fn."""
+        return self.pooled_dim
+
+
+def _modulation(vec: jax.Array, n: int, width: int, name: str) -> list[jax.Array]:
+    """silu(vec) → Dense(n*width) → n [B, 1, width] chunks (maps
+    <name>.adaLN_modulation.1)."""
+    out = nn.Dense(n * width, dtype=jnp.float32, name=f"{name}_mod_lin")(
+        nn.silu(vec.astype(jnp.float32))
+    )
+    return [out[:, None, i * width:(i + 1) * width] for i in range(n)]
+
+
+class _JointBlock(nn.Module):
+    """One SD3 joint block: context/x streams with separate params and
+    one joint attention, text tokens first. `pre_only` marks the final
+    block's context side (qkv + 2-way adaLN only, output discarded)."""
+
+    heads: int
+    mlp_width: int
+    dtype: jnp.dtype
+    qk_norm: bool
+    pre_only: bool
+
+    @nn.compact
+    def __call__(
+        self,
+        ctx: jax.Array,     # [B, Nc, H]
+        x: jax.Array,       # [B, Nx, H]
+        vec: jax.Array,     # [B, H]
+    ) -> tuple[jax.Array | None, jax.Array]:
+        dim = x.shape[-1]
+        hd = dim // self.heads
+        b, nx, _ = x.shape
+        nc = ctx.shape[1]
+
+        def qkv(h_in, n, name):
+            proj = nn.Dense(3 * dim, dtype=self.dtype, name=f"{name}_attn_qkv")(
+                h_in
+            )
+            q, k, v = jnp.split(proj, 3, axis=-1)
+            q = q.reshape(b, n, self.heads, hd)
+            k = k.reshape(b, n, self.heads, hd)
+            v = v.reshape(b, n, self.heads, hd)
+            if self.qk_norm:
+                q = nn.RMSNorm(
+                    epsilon=1e-6, dtype=jnp.float32, name=f"{name}_attn_ln_q"
+                )(q).astype(self.dtype)
+                k = nn.RMSNorm(
+                    epsilon=1e-6, dtype=jnp.float32, name=f"{name}_attn_ln_k"
+                )(k).astype(self.dtype)
+            return q, k, v
+
+        def pre(h_in, sh, sc, name):
+            h = nn.LayerNorm(
+                use_bias=False, use_scale=False, dtype=jnp.float32,
+                name=f"{name}_norm1",
+            )(h_in.astype(jnp.float32))
+            return ((h * (1 + sc) + sh)).astype(self.dtype)
+
+        if self.pre_only:
+            c_sh1, c_sc1 = _modulation(vec, 2, dim, "ctx")
+        else:
+            c_sh1, c_sc1, c_g1, c_sh2, c_sc2, c_g2 = _modulation(
+                vec, 6, dim, "ctx"
+            )
+        x_sh1, x_sc1, x_g1, x_sh2, x_sc2, x_g2 = _modulation(vec, 6, dim, "x")
+
+        cq, ck, cv = qkv(pre(ctx, c_sh1, c_sc1, "ctx"), nc, "ctx")
+        xq, xk, xv = qkv(pre(x, x_sh1, x_sc1, "x"), nx, "x")
+
+        q = jnp.concatenate([cq, xq], axis=1)
+        k = jnp.concatenate([ck, xk], axis=1)
+        v = jnp.concatenate([cv, xv], axis=1)
+        attn = dot_product_attention(q, k, v).reshape(b, nc + nx, dim)
+        c_attn, x_attn = attn[:, :nc], attn[:, nc:]
+
+        def post(h_in, a, g1, sh2, sc2, g2, name):
+            h_in = (
+                h_in.astype(jnp.float32)
+                + nn.Dense(dim, dtype=self.dtype, name=f"{name}_attn_proj")(
+                    a
+                ).astype(jnp.float32) * g1
+            )
+            h = nn.LayerNorm(
+                use_bias=False, use_scale=False, dtype=jnp.float32,
+                name=f"{name}_norm2",
+            )(h_in)
+            h = (h * (1 + sc2) + sh2).astype(self.dtype)
+            h = nn.Dense(self.mlp_width, dtype=self.dtype, name=f"{name}_mlp_fc1")(h)
+            h = nn.gelu(h, approximate=True)
+            y = nn.Dense(dim, dtype=self.dtype, name=f"{name}_mlp_fc2")(h)
+            return (h_in + y.astype(jnp.float32) * g2).astype(self.dtype)
+
+        x = post(x, x_attn, x_g1, x_sh2, x_sc2, x_g2, "x")
+        if self.pre_only:
+            return None, x
+        ctx = post(ctx, c_attn, c_g1, c_sh2, c_sc2, c_g2, "ctx")
+        return ctx, x
+
+
+class SD3MMDiT(nn.Module):
+    config: SD3Config
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,           # [B, h, w, C] noisy latents (NHWC)
+        timesteps: jax.Array,   # [B] flow time in [0, 1]
+        context: jax.Array,     # [B, T, context_dim]
+        y: jax.Array | None = None,        # [B, pooled_dim]
+        control: jax.Array | None = None,  # rejected (no SD3 ControlNet path)
+        guidance: jax.Array | None = None,  # accepted, unused (CFG family)
+    ) -> jax.Array:
+        cfg = self.config
+        dt = cfg.compute_dtype
+        del guidance  # SD3 is CFG-guided; no distilled-guidance embedding
+        if control is not None:
+            raise ValueError(
+                "SD3-class MMDiT has no ControlNet input path"
+            )
+        b, hh, ww, c = x.shape
+        p = cfg.patch_size
+        assert hh % p == 0 and ww % p == 0, "patch misalign"
+        gh, gw = hh // p, ww // p
+        nx = gh * gw
+        dim = cfg.width
+
+        # stride-p conv patchify as a dense over (c, ph, pw)-flattened
+        # patches — matches the x_embedder.proj conv kernel transform
+        tokens = x.reshape(b, gh, p, gw, p, c)
+        tokens = tokens.transpose(0, 1, 3, 5, 2, 4).reshape(b, nx, c * p * p)
+        img = nn.Dense(dim, dtype=dt, name="x_embedder_proj")(
+            tokens.astype(dt)
+        )
+
+        # learned position table, center-cropped to the latent grid
+        # (the SD3 cropped_pos_embed rule)
+        m = cfg.pos_embed_max
+        assert gh <= m and gw <= m, "latent grid exceeds pos_embed_max"
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=dim**-0.5),
+            (1, m * m, dim),
+            jnp.float32,
+        )
+        top = (m - gh) // 2
+        left = (m - gw) // 2
+        pos2d = pos.reshape(m, m, dim)[top:top + gh, left:left + gw]
+        img = img + pos2d.reshape(1, nx, dim).astype(dt)
+
+        ctx = nn.Dense(dim, dtype=dt, name="context_embedder")(
+            context.astype(dt)
+        )
+
+        vec = nn.Dense(dim, dtype=jnp.float32, name="t_embedder_mlp_0")(
+            timestep_embedding(
+                timesteps.astype(jnp.float32) * 1000.0, cfg.freq_dim
+            )
+        )
+        vec = nn.Dense(dim, dtype=jnp.float32, name="t_embedder_mlp_2")(
+            nn.silu(vec)
+        )
+        if y is None:
+            y = jnp.zeros((b, cfg.pooled_dim), jnp.float32)
+        yv = nn.Dense(dim, dtype=jnp.float32, name="y_embedder_mlp_0")(
+            y.astype(jnp.float32)
+        )
+        vec = vec + nn.Dense(dim, dtype=jnp.float32, name="y_embedder_mlp_2")(
+            nn.silu(yv)
+        )
+
+        block_cls = (
+            nn.remat(_JointBlock, static_argnums=()) if cfg.remat else _JointBlock
+        )
+        for i in range(cfg.depth):
+            pre_only = i == cfg.depth - 1
+            ctx_out, img = block_cls(
+                cfg.n_heads, cfg.mlp_width, dt, cfg.qk_norm, pre_only,
+                name=f"joint_blocks_{i}",
+            )(ctx, img, vec)
+            if not pre_only:
+                ctx = ctx_out
+
+        sh, sc = _modulation(vec, 2, dim, "final_layer_adaLN")
+        # reuse the Flux chunk order (shift, scale): x*(1+scale)+shift
+        h = nn.LayerNorm(
+            use_bias=False, use_scale=False, dtype=jnp.float32
+        )(img.astype(jnp.float32))
+        h = h * (1 + sc) + sh
+        out = nn.Dense(c * p * p, dtype=jnp.float32, name="final_layer_linear")(h)
+        # unpatchify in DiT order (p, p, c) — unlike Flux's (c, ph, pw),
+        # SD3's final_layer.linear emits 'nhw(pqc)' columns; mixing the
+        # orders would permute every 2x2 patch of real checkpoints
+        out = out.reshape(b, gh, gw, p, p, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5).reshape(b, hh, ww, c)
+        return out

@@ -256,13 +256,18 @@ def vae_schedule(cfg, prefix: str = "first_stage_model") -> list[Entry]:
 
 
 def text_encoder_schedule(
-    cfg, prefix: str = "cond_stage_model.transformer.text_model"
+    cfg,
+    prefix: str = "cond_stage_model.transformer.text_model",
+    projection_layout: str = "bare",
 ) -> list[Entry]:
     """HF-layout CLIP text transformer → TextEncoder flax tree.
 
     `prefix` is `cond_stage_model.transformer.text_model` in SD1.x
     single-file checkpoints and `conditioner.embedders.0.transformer.
-    text_model` for SDXL's CLIP-L half."""
+    text_model` for SDXL's CLIP-L half. `projection_layout="linear"`
+    reads text_projection as an nn.Linear (.weight, transposed) — the
+    HF CLIPTextModelWithProjection packing SD3 files use — instead of
+    the bare parameter."""
     p = prefix
     entries: list[Entry] = [
         (f"{p}.embeddings.token_embedding", "token_embedding", "embedding"),
@@ -282,7 +287,17 @@ def text_encoder_schedule(
         ]
     entries.append((f"{p}.final_layer_norm", "final_ln", _NORM))
     if cfg.proj_dim is not None:
-        entries.append((f"{p}.text_projection", "text_projection", "param_bare"))
+        if projection_layout == "linear":
+            # HF CLIPTextModelWithProjection: text_projection is a
+            # SIBLING of text_model, not nested inside it
+            base = p[: -len(".text_model")] if p.endswith(".text_model") else p
+            entries.append(
+                (f"{base}.text_projection", "text_projection", "bare_linear_w")
+            )
+        else:
+            entries.append(
+                (f"{p}.text_projection", "text_projection", "param_bare")
+            )
     return entries
 
 
@@ -636,6 +651,125 @@ def flux_schedule(cfg, prefix: str = "") -> list[Entry]:
     return entries
 
 
+def sd3_schedule(cfg, prefix: str = "model.diffusion_model.") -> list[Entry]:
+    """SD3/SD3.5 MMDiT state dict (`joint_blocks.N.{context_block,
+    x_block}.*`, `x_embedder.proj`, `pos_embed`, `context_embedder`,
+    `t_embedder`/`y_embedder` MLPs, `final_layer.*`) → SD3MMDiT flax
+    tree (models/sd3.py). The final block's context side is pre_only:
+    qkv + a 2-way adaLN, no proj/MLP. SD3.5 configs add per-head RMS
+    ln_q/ln_k."""
+    p = prefix
+    conv2d = f"conv2d:{cfg.patch_size}:{cfg.in_channels}"
+    entries: list[Entry] = [
+        (f"{p}x_embedder.proj", "x_embedder_proj", conv2d),
+        (f"{p}pos_embed", "pos_embed", "param_bare"),
+        (f"{p}context_embedder", "context_embedder", _LINEAR),
+        (f"{p}t_embedder.mlp.0", "t_embedder_mlp_0", _LINEAR),
+        (f"{p}t_embedder.mlp.2", "t_embedder_mlp_2", _LINEAR),
+        (f"{p}y_embedder.mlp.0", "y_embedder_mlp_0", _LINEAR),
+        (f"{p}y_embedder.mlp.2", "y_embedder_mlp_2", _LINEAR),
+    ]
+    for i in range(cfg.depth):
+        sd, fx = f"{p}joint_blocks.{i}", f"joint_blocks_{i}"
+        pre_only = i == cfg.depth - 1
+        for tb, fb in (("context_block", "ctx"), ("x_block", "x")):
+            entries.append(
+                (f"{sd}.{tb}.attn.qkv", f"{fx}/{fb}_attn_qkv", _LINEAR)
+            )
+            if cfg.qk_norm:
+                entries += [
+                    (f"{sd}.{tb}.attn.ln_q", f"{fx}/{fb}_attn_ln_q", "rms"),
+                    (f"{sd}.{tb}.attn.ln_k", f"{fx}/{fb}_attn_ln_k", "rms"),
+                ]
+            entries.append(
+                (
+                    f"{sd}.{tb}.adaLN_modulation.1",
+                    f"{fx}/{fb}_mod_lin",
+                    _LINEAR,
+                )
+            )
+            if tb == "context_block" and pre_only:
+                continue
+            entries += [
+                (f"{sd}.{tb}.attn.proj", f"{fx}/{fb}_attn_proj", _LINEAR),
+                (f"{sd}.{tb}.mlp.fc1", f"{fx}/{fb}_mlp_fc1", _LINEAR),
+                (f"{sd}.{tb}.mlp.fc2", f"{fx}/{fb}_mlp_fc2", _LINEAR),
+            ]
+    entries += [
+        (
+            f"{p}final_layer.adaLN_modulation.1",
+            "final_layer_adaLN_mod_lin",
+            _LINEAR,
+        ),
+        (f"{p}final_layer.linear", "final_layer_linear", _LINEAR),
+    ]
+    return entries
+
+
+def load_sd3_weights(
+    state_dict: dict[str, np.ndarray],
+    unet_cfg,
+    vae_cfg,
+    te_cfg,
+    templates: dict[str, Any],
+    strict: bool = True,
+    te2_cfg: Any = None,
+    te3_cfg: Any = None,
+) -> tuple[dict[str, Any], list[str]]:
+    """SD3/SD3.5 checkpoint(s) → {'unet','vae','te','te2','te3'}.
+
+    Single-file layout: `model.diffusion_model.*` +
+    `first_stage_model.*` and — in the `*_incl_clips*` variants —
+    `text_encoders.{clip_l,clip_g,t5xxl}.transformer.*` (HF packing:
+    text_projection is an nn.Linear). Maps whichever parts are present
+    and leaves the rest at init."""
+    parts: dict[str, list[Entry]] = {}
+    if any(
+        k.startswith("model.diffusion_model.joint_blocks.") for k in state_dict
+    ):
+        parts["unet"] = sd3_schedule(unet_cfg)
+    elif any(k.startswith("joint_blocks.") for k in state_dict):
+        parts["unet"] = sd3_schedule(unet_cfg, prefix="")
+    if any(k.startswith("first_stage_model.") for k in state_dict):
+        parts["vae"] = vae_schedule(vae_cfg)
+    if te_cfg is not None and any(
+        k.startswith("text_encoders.clip_l.") for k in state_dict
+    ):
+        parts["te"] = text_encoder_schedule(
+            te_cfg, prefix="text_encoders.clip_l.transformer.text_model",
+            projection_layout="linear",
+        )
+    if te2_cfg is not None and any(
+        k.startswith("text_encoders.clip_g.") for k in state_dict
+    ):
+        parts["te2"] = text_encoder_schedule(
+            te2_cfg, prefix="text_encoders.clip_g.transformer.text_model",
+            projection_layout="linear",
+        )
+    if te3_cfg is not None and any(
+        k.startswith("text_encoders.t5xxl.") for k in state_dict
+    ):
+        parts["te3"] = t5_encoder_schedule(
+            te3_cfg, prefix="text_encoders.t5xxl.transformer."
+        )
+
+    result = dict(templates)
+    problems: list[str] = []
+    for part, entries in parts.items():
+        result[part], part_problems = _merge_into_template(
+            state_dict, entries, templates[part], part
+        )
+        problems += part_problems
+    if not parts:
+        problems.append("sd3: no mappable part found in checkpoint")
+    if problems and strict:
+        raise ValueError(
+            f"sd3 checkpoint mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return result, problems
+
+
 def load_flux_weights(
     state_dict: dict[str, np.ndarray],
     unet_cfg,
@@ -786,12 +920,17 @@ def _expand(entries: Iterable[Entry]) -> list[tuple[str, str, str]]:
             out.append((f"{sd}.weight", f"{fx}/scale", "id"))
         elif kind == "rms_scale":  # RMSNorm stored as .scale (Flux QKNorm)
             out.append((f"{sd}.scale", f"{fx}/scale", "id"))
+        elif kind == "bare_linear_w":  # nn.Linear weight → bare [I,O] param
+            out.append((f"{sd}.weight", fx, "linear"))
         elif kind == "causal3":  # Conv3d (causal wrapper): weight+bias
             out.append((f"{sd}.weight", f"{fx}/kernel", "conv3d_k"))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))
         elif kind in ("gamma3", "gamma2"):  # bare RMS gamma w/ 1-dims
             out.append((f"{sd}.gamma", f"{fx}/scale", kind))
         elif kind.startswith("conv3d"):  # 3D patch conv → patchify dense
+            out.append((f"{sd}.weight", f"{fx}/kernel", kind))
+            out.append((f"{sd}.bias", f"{fx}/bias", "id"))
+        elif kind.startswith("conv2d"):  # 2D patch conv → patchify dense
             out.append((f"{sd}.weight", f"{fx}/kernel", kind))
             out.append((f"{sd}.bias", f"{fx}/bias", "id"))
         elif kind == "fused_qkv":
@@ -828,6 +967,10 @@ def _transform(value: np.ndarray, how: str) -> np.ndarray:
         # [pf*ph*pw*C, O]: row order must match the DiT's
         # (pf, ph, pw, c) token flatten order
         return np.transpose(value, (2, 3, 4, 1, 0)).reshape(-1, value.shape[0])
+    if how.startswith("conv2d"):
+        # torch Conv2d [O, C, ph, pw] → patchify Dense [C*ph*pw, O]:
+        # row order matches the SD3 MMDiT (c, ph, pw) token flatten
+        return np.transpose(value, (1, 2, 3, 0)).reshape(-1, value.shape[0])
     return value
 
 
@@ -848,6 +991,10 @@ def _inverse_transform(value: np.ndarray, how: str) -> np.ndarray:
         return np.transpose(
             value.reshape(pf, ph, pw, cin, out), (4, 3, 0, 1, 2)
         )
+    if how.startswith("conv2d"):
+        p, cin = (int(x) for x in how.split(":")[1:])
+        out = value.shape[-1]
+        return np.transpose(value.reshape(cin, p, p, out), (3, 0, 1, 2))
     return value
 
 
@@ -979,6 +1126,7 @@ def load_sd_weights(
     templates: dict[str, Any],
     strict: bool = True,
     te2_cfg: Any = None,
+    te3_cfg: Any = None,
     family: str | None = None,
 ) -> tuple[dict[str, Any], list[str]]:
     """Map a full SD checkpoint onto {'unet','vae','te'} param trees.
@@ -991,6 +1139,11 @@ def load_sd_weights(
         return load_flux_weights(
             state_dict, unet_cfg, vae_cfg, te_cfg, templates,
             strict=strict, te2_cfg=te2_cfg,
+        )
+    if family == "sd3":
+        return load_sd3_weights(
+            state_dict, unet_cfg, vae_cfg, te_cfg, templates,
+            strict=strict, te2_cfg=te2_cfg, te3_cfg=te3_cfg,
         )
     sdxl_layout = any(k.startswith("conditioner.embedders.") for k in state_dict)
     # SD2.x packs an OpenCLIP text tower under cond_stage_model.model.*

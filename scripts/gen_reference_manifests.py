@@ -525,6 +525,56 @@ def flux_dit_manifest(
     return m
 
 
+def sd3_dit_manifest(
+    depth: int = 24,
+    hidden: int | None = None,
+    heads: int | None = None,
+    qk_norm: bool = False,
+    ctx: int = 4096,
+    pooled: int = 2048,
+    pos_max: int = 192,
+    in_ch: int = 16,
+    p: int = 2,
+    time_dim: int = 256,
+) -> Manifest:
+    """SD3/SD3.5 MMDiT under model.diffusion_model.* (the single-file
+    layout), following the original mmdit.py construction: conv
+    patchify, learned pos table, joint_blocks with a pre_only final
+    context side, SD3.5's per-head ln_q/ln_k when qk_norm."""
+    hidden = hidden if hidden is not None else 64 * depth
+    heads = heads if heads is not None else depth
+    hd = hidden // heads
+    mlp = 4 * hidden
+    pfx = "model.diffusion_model."
+    m: Manifest = {}
+    m[f"{pfx}x_embedder.proj.weight"] = [hidden, in_ch, p, p]
+    m[f"{pfx}x_embedder.proj.bias"] = [hidden]
+    m[f"{pfx}pos_embed"] = [1, pos_max * pos_max, hidden]
+    _linear(m, f"{pfx}context_embedder", hidden, ctx)
+    _linear(m, f"{pfx}t_embedder.mlp.0", hidden, time_dim)
+    _linear(m, f"{pfx}t_embedder.mlp.2", hidden, hidden)
+    _linear(m, f"{pfx}y_embedder.mlp.0", hidden, pooled)
+    _linear(m, f"{pfx}y_embedder.mlp.2", hidden, hidden)
+    for i in range(depth):
+        sd = f"{pfx}joint_blocks.{i}"
+        pre = i == depth - 1
+        for tb in ("context_block", "x_block"):
+            _linear(m, f"{sd}.{tb}.attn.qkv", 3 * hidden, hidden)
+            if qk_norm:
+                m[f"{sd}.{tb}.attn.ln_q.weight"] = [hd]
+                m[f"{sd}.{tb}.attn.ln_k.weight"] = [hd]
+            n_mod = 2 if (pre and tb == "context_block") else 6
+            _linear(m, f"{sd}.{tb}.adaLN_modulation.1", n_mod * hidden, hidden)
+            if pre and tb == "context_block":
+                continue
+            _linear(m, f"{sd}.{tb}.attn.proj", hidden, hidden)
+            _linear(m, f"{sd}.{tb}.mlp.fc1", mlp, hidden)
+            _linear(m, f"{sd}.{tb}.mlp.fc2", hidden, mlp)
+    _linear(m, f"{pfx}final_layer.adaLN_modulation.1", 2 * hidden, hidden)
+    _linear(m, f"{pfx}final_layer.linear", p * p * in_ch, hidden)
+    return m
+
+
 def flux_ae_manifest() -> Manifest:
     """ae.safetensors: SD AutoencoderKL architecture with 16-channel
     latents, BARE encoder./decoder. keys, and no 1x1 quant convs."""
@@ -589,6 +639,11 @@ def build_all() -> dict[str, Manifest]:
         "t5_xxl_encoder": umt5_encoder_manifest(
             vocab=32128, per_layer_bias=False
         ),
+        "sd3_medium_dit": sd3_dit_manifest(depth=24, qk_norm=False),
+        "sd35_large_dit": sd3_dit_manifest(
+            depth=38, hidden=2432, heads=38, qk_norm=True
+        ),
+        "sd3_vae": vae_manifest(z=16, quant_convs=False),
     }
 
 

@@ -66,6 +66,12 @@ def _flax_shapes(model_name: str) -> dict[str, tuple[int, ...]]:
             jnp.zeros((1, 16, cfg.context_dim)),
             jnp.zeros((1, cfg.vec_dim)),
         ),
+        "sd3": lambda: (
+            jnp.zeros((1, 8, 8, cfg.in_channels)),
+            jnp.zeros((1,)),
+            jnp.zeros((1, 16, cfg.context_dim)),
+            jnp.zeros((1, cfg.pooled_dim)),
+        ),
         "vae": lambda: (jnp.zeros((1, 8, 8, cfg.in_channels)),),
         "text_encoder": lambda: (
             jnp.zeros((1, cfg.max_length), jnp.int32),
@@ -108,6 +114,9 @@ def _sd_shape(flax_shape: tuple[int, ...], how: str) -> tuple[int, ...]:
     if how.startswith("conv3d:"):
         pf, ph, pw, cin = (int(x) for x in how.split(":")[1:])
         return (s[-1], cin, pf, ph, pw)
+    if how.startswith("conv2d:"):
+        p, cin = (int(x) for x in how.split(":")[1:])
+        return (s[-1], cin, p, p)
     if how.startswith("qkv"):  # fused in_proj: [I,O] → [3O,I] / [O] → [3O]
         if how.endswith("_w"):
             return (3 * s[1], s[0])
@@ -250,6 +259,26 @@ def test_wan_vae_schedule_matches_manifest():
     _assert_matches(derived, _manifest("wan21_vae"), proj_conv_keys=False)
 
 
+# --- SD3 / SD3.5 -----------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "model_name,manifest_name",
+    [("sd3-medium", "sd3_medium_dit"), ("sd35-large", "sd35_large_dit")],
+)
+def test_sd3_schedule_matches_manifest(model_name, manifest_name):
+    derived = _schedule_sd_shapes(
+        sdc.sd3_schedule(get_config(model_name)), model_name
+    )
+    _assert_matches(derived, _manifest(manifest_name), proj_conv_keys=False)
+
+
+def test_sd3_vae_schedule_matches_manifest():
+    derived = _schedule_sd_shapes(
+        sdc.vae_schedule(get_config("vae-sd3")), "vae-sd3"
+    )
+    _assert_matches(derived, _manifest("sd3_vae"), proj_conv_keys=True)
+
+
 # --- Flux ------------------------------------------------------------------
 
 @pytest.mark.parametrize(
@@ -387,6 +416,24 @@ HAND_PINNED = {
         "shared.weight": (32128, 4096),
         "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight": (32, 64),
         "encoder.block.23.layer.1.DenseReluDense.wo.weight": (4096, 10240),
+    },
+    "sd35_large_dit": {
+        # sd3.5_large.safetensors as listed by checkpoint inspectors
+        "model.diffusion_model.x_embedder.proj.weight": (2432, 16, 2, 2),
+        "model.diffusion_model.pos_embed": (1, 36864, 2432),
+        "model.diffusion_model.context_embedder.weight": (2432, 4096),
+        "model.diffusion_model.y_embedder.mlp.0.weight": (2432, 2048),
+        "model.diffusion_model.t_embedder.mlp.0.weight": (2432, 256),
+        "model.diffusion_model.joint_blocks.0.x_block.attn.qkv.weight": (7296, 2432),
+        "model.diffusion_model.joint_blocks.0.x_block.attn.ln_q.weight": (64,),
+        "model.diffusion_model.joint_blocks.37.context_block.adaLN_modulation.1.weight": (4864, 2432),
+        "model.diffusion_model.final_layer.linear.weight": (64, 2432),
+    },
+    "sd3_medium_dit": {
+        "model.diffusion_model.x_embedder.proj.weight": (1536, 16, 2, 2),
+        "model.diffusion_model.pos_embed": (1, 36864, 1536),
+        "model.diffusion_model.joint_blocks.0.x_block.attn.qkv.weight": (4608, 1536),
+        "model.diffusion_model.final_layer.linear.weight": (64, 1536),
     },
 }
 

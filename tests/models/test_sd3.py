@@ -1,0 +1,185 @@
+"""SD3/SD3.5 family: triple-encoder conditioning, flow generation,
+pre_only final block, and checkpoint-schedule round-trips.
+
+Parity target: the reference serves SD3-class models through ComfyUI's
+model zoo (CheckpointLoaderSimple on the single-file sd3*/sd3.5*
+checkpoints with bundled text encoders)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+from comfyui_distributed_tpu.models.registry import get_config
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return pl.load_pipeline("tiny-sd3", seed=0)
+
+
+def test_conditioning_layout(bundle):
+    """CLIP-L ++ CLIP-G on features (zero-padded to the T5 width),
+    sequence-concat with T5; pooled = pooled_l ++ pooled_g."""
+    cfg = get_config("tiny-sd3")
+    cond = pl.encode_text_pooled(bundle, ["a prompt"])
+    clip_len = bundle.tokenizer.max_length
+    t5_len = bundle.tokenizer_3.max_length
+    assert cond.context.shape == (1, clip_len + t5_len, cfg.context_dim)
+    assert cond.pooled.shape == (1, cfg.pooled_dim)
+    # the pad region of the CLIP half is exactly zero
+    l_w = get_config("tiny-te-l").width
+    g_w = get_config("tiny-te-g").width
+    pad = np.asarray(cond.context[:, :clip_len, l_w + g_w:])
+    assert pad.shape[-1] == cfg.context_dim - l_w - g_w
+    np.testing.assert_array_equal(pad, 0.0)
+
+
+def test_txt2img_tiny_sd3(bundle):
+    img = pl.txt2img(
+        bundle, "a prompt", height=32, width=32, steps=2, cfg_scale=4.0,
+        sampler="euler", seed=0,
+    )
+    assert img.shape == (1, 32, 32, 3)
+    assert np.isfinite(np.asarray(img)).all()
+    img2 = pl.txt2img(
+        bundle, "a prompt", height=32, width=32, steps=2, cfg_scale=4.0,
+        sampler="euler", seed=1,
+    )
+    assert not np.array_equal(np.asarray(img), np.asarray(img2))
+
+
+def test_usdu_on_sd3(bundle):
+    from comfyui_distributed_tpu.ops import upscale as up
+
+    rng = np.random.default_rng(11)
+    img = jnp.asarray(rng.random((1, 64, 64, 3)), dtype=jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    out = up.run_upscale(
+        bundle, img, pos, neg, mesh=None, upscale_by=2.0, tile=64,
+        padding=16, steps=2, denoise=0.4, seed=3,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_pre_only_final_block(bundle):
+    """The last joint block's context side has qkv + 2-way adaLN only
+    — no proj/MLP params — and its modulation is 2*hidden wide."""
+    cfg = get_config("tiny-sd3")
+    flat = flatten_params(jax.device_get(bundle.params["unet"]))
+    last = f"joint_blocks_{cfg.depth - 1}"
+    assert f"params/{last}/ctx_attn_qkv/kernel" in flat
+    assert f"params/{last}/ctx_attn_proj/kernel" not in flat
+    assert f"params/{last}/ctx_mlp_fc1/kernel" not in flat
+    assert flat[f"params/{last}/ctx_mod_lin/kernel"].shape == (
+        cfg.width, 2 * cfg.width,
+    )
+    assert flat[f"params/{last}/x_mod_lin/kernel"].shape == (
+        cfg.width, 6 * cfg.width,
+    )
+
+
+def test_sd3_schedule_roundtrip_exact(bundle):
+    cfg = get_config("tiny-sd3")
+    flat = flatten_params(jax.device_get(bundle.params["unet"]))
+    schedule = sdc.sd3_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, schedule)
+    converted, missing = sdc.convert_state_dict(state_dict, schedule)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+
+def test_hf_projection_is_sibling_of_text_model():
+    """CLIPTextModelWithProjection packs text_projection BESIDE
+    text_model — a nested key would fail every real incl_clips file."""
+    entries = sdc.text_encoder_schedule(
+        get_config("tiny-te-g"),
+        prefix="text_encoders.clip_g.transformer.text_model",
+        projection_layout="linear",
+    )
+    keys = [sd for sd, _, _ in entries]
+    assert "text_encoders.clip_g.transformer.text_projection" in keys
+    assert "text_encoders.clip_g.transformer.text_model.text_projection" not in keys
+
+
+def test_full_size_encoder_configs():
+    """SD3 uses PROJECTED CLIP-L pooled and 77-token T5 padding."""
+    assert get_config("clip-l-sd3").proj_dim == 768
+    assert get_config("t5-xxl-sd3").max_length == 77
+
+
+def test_load_sd3_weights_single_file(bundle):
+    """A synthesized *_incl_clips-style single file (transformer + AE +
+    all three encoders under text_encoders.*) maps every part."""
+    unet_cfg = get_config("tiny-sd3")
+    state_dict = {}
+    state_dict.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(bundle.params["unet"])),
+            sdc.sd3_schedule(unet_cfg),
+        )
+    )
+    state_dict.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(bundle.params["vae"])),
+            sdc.vae_schedule(get_config("tiny-vae-sd3")),
+        )
+    )
+    state_dict.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(bundle.params["te"])),
+            sdc.text_encoder_schedule(
+                get_config("tiny-te-l"),
+                prefix="text_encoders.clip_l.transformer.text_model",
+                projection_layout="linear",
+            ),
+        )
+    )
+    state_dict.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(bundle.params["te2"])),
+            sdc.text_encoder_schedule(
+                get_config("tiny-te-g"),
+                prefix="text_encoders.clip_g.transformer.text_model",
+                projection_layout="linear",
+            ),
+        )
+    )
+    state_dict.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(bundle.params["te3"])),
+            sdc.t5_encoder_schedule(
+                get_config("tiny-t5-sd3"),
+                prefix="text_encoders.t5xxl.transformer.",
+            ),
+        )
+    )
+    templates = {
+        part: bundle.params[part] for part in ("unet", "vae", "te", "te2", "te3")
+    }
+    out, problems = sdc.load_sd_weights(
+        state_dict, unet_cfg, get_config("tiny-vae-sd3"),
+        get_config("tiny-te-l"), templates,
+        te2_cfg=get_config("tiny-te-g"), te3_cfg=get_config("tiny-t5-sd3"),
+        family="sd3",
+    )
+    assert problems == []
+    for part in ("unet", "vae", "te", "te2", "te3"):
+        got = flatten_params(out[part])
+        want = flatten_params(jax.device_get(bundle.params[part]))
+        for key in want:
+            np.testing.assert_array_equal(
+                got[key], np.asarray(want[key]), err_msg=f"{part}:{key}"
+            )
