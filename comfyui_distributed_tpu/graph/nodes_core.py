@@ -263,11 +263,22 @@ class KSampler:
                     (latents.shape[0], lh, lw, bundle.latent_channels)
                 )
 
+        noise_mask = latent_image.get("noise_mask")
+        if noise_mask is not None:
+            # normalize any MASK layout ([H,W], [B,H,W], [B,H,W,1]) to
+            # the latents' [B, lh, lw, 1]
+            nm = jnp.asarray(noise_mask)
+            if nm.ndim == 4:
+                nm = nm[..., 0]
+            noise_mask = _mask_to_latent(
+                nm, latents.shape[1], latents.shape[2]
+            )
+
         mesh = getattr(context, "mesh", None) if context is not None else None
         if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
             return (self._sample_mesh_parallel(
                 bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
-                positive, negative, latents, denoise,
+                positive, negative, latents, denoise, noise_mask,
             ),)
 
         effective_seed = spec.base_seed + (
@@ -284,13 +295,14 @@ class KSampler:
             cfg_scale=float(cfg),
             denoise=float(denoise),
             seed=int(effective_seed),
+            noise_mask=noise_mask,
         )
         return ({"samples": out},)
 
     @staticmethod
     def _sample_mesh_parallel(
         bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
-        positive, negative, latents, denoise,
+        positive, negative, latents, denoise, noise_mask=None,
     ) -> dict:
         """One SPMD program: every participant samples its folded seed.
         Output batch = participants x input batch, participant-major,
@@ -305,6 +317,14 @@ class KSampler:
         pos = jax.device_put(positive, NamedSharding(mesh, P()))
         neg = jax.device_put(negative, NamedSharding(mesh, P()))
         base = jax.device_put(latents, NamedSharding(mesh, P()))
+        mask = (
+            jax.device_put(
+                jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0),
+                NamedSharding(mesh, P()),
+            )
+            if noise_mask is not None
+            else None
+        )
 
         param, shift = pl.model_schedule_info(bundle)
         sigmas = smp.get_model_sigmas(
@@ -312,27 +332,39 @@ class KSampler:
             flow_shift=shift,
         )
 
-        def per_chip(keys_shard, params, pos, neg, base):
+        def per_chip(keys_shard, params, pos, neg, base, *maybe_mask):
+            mask_arr = maybe_mask[0] if maybe_mask else None
             key = keys_shard[0]
             noise_key, anc_key = jax.random.split(key)
-            x = smp.noise_latents(
-                param, base, jax.random.normal(noise_key, base.shape), sigmas[0]
-            )
+            noise = jax.random.normal(noise_key, base.shape)
+            x = smp.noise_latents(param, base, noise, sigmas[0])
             model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
-            return smp.sample(
+            if mask_arr is not None:
+                model_fn = smp.masked_inpaint_model(
+                    model_fn, param, base, noise, mask_arr
+                )
+
+            out = smp.sample(
                 model_fn, x, sigmas, (pos, neg), sampler_name, anc_key,
                 flow=(param == "flow"),
             )
+            if mask_arr is not None:
+                out = out * mask_arr + base * (1.0 - mask_arr)
+            return out
 
+        extra = () if mask is None else (mask,)
+        in_specs = [P(DATA_AXIS), P(), P(), P(), P()] + (
+            [P()] if mask is not None else []
+        )
         out = jax.jit(
             jax.shard_map(
                 per_chip,
                 mesh=mesh,
-                in_specs=(P(DATA_AXIS), P(), P(), P(), P()),
+                in_specs=tuple(in_specs),
                 out_specs=P(DATA_AXIS),
                 check_vma=False,
             )
-        )(keys, params, pos, neg, base)
+        )(keys, params, pos, neg, base, *extra)
         return {"samples": out, "participant_major": True}
 
 
@@ -362,6 +394,85 @@ class VAEEncode:
     def encode(self, pixels, vae: pl.PipelineBundle, context=None):
         z = vae.vae.apply(vae.params["vae"], pixels, method="encode")
         return ({"samples": z},)
+
+
+def _mask_to_latent(mask, lh: int, lw: int) -> jax.Array:
+    """MASK ([B,H,W] or [H,W], 1 = regenerate) → [B, lh, lw, 1]."""
+    m = jnp.asarray(mask, jnp.float32)
+    if m.ndim == 2:
+        m = m[None]
+    if m.shape[1:] != (lh, lw):
+        m = jax.image.resize(m, (m.shape[0], lh, lw), method="linear")
+    return jnp.clip(m, 0.0, 1.0)[..., None]
+
+
+@register_node
+class VAEEncodeForInpaint:
+    """Encode pixels for inpainting (reference-substrate ComfyUI node):
+    the masked region is neutralized to mid-gray before encoding, the
+    mask is grown by `grow_mask_by` pixels of context and attached at
+    latent resolution as the latent's noise_mask (1 = regenerate;
+    consumed by KSampler's pinned-region sampling)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "pixels": ("IMAGE",),
+                "vae": ("VAE",),
+                "mask": ("MASK",),
+            },
+            "optional": {"grow_mask_by": ("INT", {"default": 6})},
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "encode"
+
+    def encode(self, pixels, vae: pl.PipelineBundle, mask, grow_mask_by=6,
+               context=None):
+        b, h, w, _ = pixels.shape
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        if m.shape[1:] != (h, w):
+            m = jax.image.resize(m, (m.shape[0], h, w), method="linear")
+        g = int(grow_mask_by)
+        if g > 0:
+            m = jax.lax.reduce_window(
+                m, -jnp.inf, jax.lax.max,
+                (1, 2 * g + 1, 2 * g + 1), (1, 1, 1), "SAME",
+            )
+        m = jnp.clip(m, 0.0, 1.0)
+        hard = (m > 0.5).astype(pixels.dtype)[..., None]
+        neutral = pixels * (1.0 - hard) + 0.5 * hard
+        z = vae.vae.apply(vae.params["vae"], neutral, method="encode")
+        return (
+            {
+                "samples": z,
+                "noise_mask": _mask_to_latent(m, z.shape[1], z.shape[2]),
+                "width": int(w),
+                "height": int(h),
+            },
+        )
+
+
+@register_node
+class SetLatentNoiseMask:
+    """Attach an inpainting mask to existing latents (reference
+    substrate: ComfyUI SetLatentNoiseMask)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"samples": ("LATENT",), "mask": ("MASK",)}}
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "set_mask"
+
+    def set_mask(self, samples: dict, mask, context=None):
+        z = samples["samples"]
+        out = dict(samples)
+        out["noise_mask"] = _mask_to_latent(mask, z.shape[1], z.shape[2])
+        return (out,)
 
 
 @register_node

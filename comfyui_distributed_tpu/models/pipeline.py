@@ -538,6 +538,7 @@ def _img2img_jit(
     scheduler: str,
     cfg_scale: float,
     denoise: float,
+    noise_mask=None,
 ):
     bundle = bundle_static.value
     param, shift = model_schedule_info(bundle)
@@ -545,14 +546,22 @@ def _img2img_jit(
         param, scheduler, steps, denoise=denoise, flow_shift=shift
     )
     noise_key, anc_key = jax.random.split(key)
-    x = smp.noise_latents(
-        param, latents, jax.random.normal(noise_key, latents.shape), sigmas[0]
-    )
+    noise = jax.random.normal(noise_key, latents.shape)
+    x = smp.noise_latents(param, latents, noise, sigmas[0])
     model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
-    return smp.sample(
+    if noise_mask is not None:
+        # inpainting (reference-substrate SetLatentNoiseMask /
+        # VAEEncodeForInpaint semantics)
+        mask = jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0)
+        model = smp.masked_inpaint_model(model, param, latents, noise, mask)
+
+    out = smp.sample(
         model, x, sigmas, (context_pos, context_neg), sampler, anc_key,
         flow=(param == "flow"),
     )
+    if noise_mask is not None:
+        out = out * mask + latents * (1.0 - mask)
+    return out
 
 
 def img2img_latents(
@@ -566,9 +575,14 @@ def img2img_latents(
     cfg_scale: float = 7.0,
     denoise: float = 0.5,
     seed: int = 0,
+    noise_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Latent-space img2img (the tile re-diffusion core of USDU):
-    noise to sigma[denoise], sample back down. Returns latents."""
+    noise to sigma[denoise], sample back down. Returns latents.
+
+    `noise_mask` ([B, lh, lw, 1], 1 = regenerate) enables inpainting:
+    the unmasked region is pinned to the original latents re-noised to
+    each step's sigma and restored exactly afterwards."""
     key = jax.random.key(seed)
     return _img2img_jit(
         _Static(bundle),
@@ -582,4 +596,5 @@ def img2img_latents(
         scheduler,
         float(cfg_scale),
         float(denoise),
+        noise_mask=noise_mask,
     )

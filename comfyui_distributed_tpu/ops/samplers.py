@@ -143,6 +143,31 @@ def noise_latents(
     return latents + noise * sigma0
 
 
+def masked_inpaint_model(
+    model_fn: "ModelFn",
+    parameterization: str,
+    latents: jax.Array,
+    noise: jax.Array,
+    mask: jax.Array,
+) -> "ModelFn":
+    """Inpainting wrapper shared by the single-device and mesh KSampler
+    paths: before every model eval the UNMASKED region (mask 0) is
+    pinned to the original `latents` re-noised to the current sigma
+    with the SAME noise the trajectory started from, so only the
+    masked region (mask 1 = regenerate) evolves. Callers composite
+    `out * mask + latents * (1 - mask)` after sampling to restore the
+    unmasked region exactly. NOTE the polarity is the ComfyUI
+    noise_mask convention (1 = regenerate) — the video outpainting
+    helper sample_flow_masked uses the opposite (1 = known)."""
+
+    def wrapped(x, sigma_batch, cond):
+        sig = sigma_batch.reshape((-1,) + (1,) * (x.ndim - 1))
+        ref = noise_latents(parameterization, latents, noise, sig)
+        return model_fn(x * mask + ref * (1.0 - mask), sigma_batch, cond)
+
+    return wrapped
+
+
 def sigma_to_timestep(sigma: jax.Array) -> jax.Array:
     """Nearest training timestep for a sigma (for timestep-conditioned
     models); differentiable-free lookup."""
