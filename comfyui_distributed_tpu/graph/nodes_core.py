@@ -265,21 +265,21 @@ class KSampler:
 
         noise_mask = latent_image.get("noise_mask")
         if noise_mask is not None:
-            # normalize any MASK layout ([H,W], [B,H,W], [B,H,W,1]) to
-            # the latents' [B, lh, lw, 1]
-            nm = jnp.asarray(noise_mask)
-            if nm.ndim == 4:
-                nm = nm[..., 0]
             noise_mask = _mask_to_latent(
-                nm, latents.shape[1], latents.shape[2]
+                noise_mask, latents.shape[1], latents.shape[2]
             )
+        # ComfyUI common_ksampler parity: the output latent dict keeps
+        # the input's extras (noise_mask, width/height), so chained
+        # inpaint passes (base + refine) stay masked
+        extras = {k: v for k, v in latent_image.items() if k != "samples"}
 
         mesh = getattr(context, "mesh", None) if context is not None else None
         if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
-            return (self._sample_mesh_parallel(
+            result = self._sample_mesh_parallel(
                 bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
                 positive, negative, latents, denoise, noise_mask,
-            ),)
+            )
+            return ({**extras, **result},)
 
         effective_seed = spec.base_seed + (
             spec.worker_index + 1 if spec.worker_index >= 0 else 0
@@ -297,7 +297,7 @@ class KSampler:
             seed=int(effective_seed),
             noise_mask=noise_mask,
         )
-        return ({"samples": out},)
+        return ({**extras, "samples": out},)
 
     @staticmethod
     def _sample_mesh_parallel(
@@ -397,8 +397,11 @@ class VAEEncode:
 
 
 def _mask_to_latent(mask, lh: int, lw: int) -> jax.Array:
-    """MASK ([B,H,W] or [H,W], 1 = regenerate) → [B, lh, lw, 1]."""
+    """MASK ([H,W], [B,H,W] or [B,H,W,1]; 1 = regenerate) →
+    [B, lh, lw, 1]."""
     m = jnp.asarray(mask, jnp.float32)
+    if m.ndim == 4:
+        m = m[..., 0]
     if m.ndim == 2:
         m = m[None]
     if m.shape[1:] != (lh, lw):
@@ -454,6 +457,61 @@ class VAEEncodeForInpaint:
                 "height": int(h),
             },
         )
+
+
+@register_node
+class ImagePadForOutpaint:
+    """Pad an image for outpainting (reference-substrate ComfyUI
+    node): extends the canvas with edge-replicated pixels and emits
+    the matching MASK — 1 over the new region, with a squared
+    feathering ramp reaching `feathering` pixels into the original
+    image so the inpaint transition blends."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "left": ("INT", {"default": 0}),
+                "top": ("INT", {"default": 0}),
+                "right": ("INT", {"default": 0}),
+                "bottom": ("INT", {"default": 0}),
+                "feathering": ("INT", {"default": 40}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE", "MASK")
+    FUNCTION = "expand"
+
+    def expand(self, image, left=0, top=0, right=0, bottom=0,
+               feathering=40, context=None):
+        lf, tp, rt, bt = int(left), int(top), int(right), int(bottom)
+        fe = int(feathering)
+        padded = jnp.pad(
+            image, ((0, 0), (tp, bt), (lf, rt), (0, 0)), mode="edge"
+        )
+        b, h, w, _ = padded.shape
+        mask = np.ones((h, w), np.float32)
+        y0, y1 = tp, h - bt
+        x0, x1 = lf, w - rt
+        inner = np.zeros((y1 - y0, x1 - x0), np.float32)
+        if fe > 0:
+            # distance of each original pixel to the nearest NEW edge
+            yy = np.arange(y1 - y0, dtype=np.float32)[:, None]
+            xx = np.arange(x1 - x0, dtype=np.float32)[None, :]
+            d = np.full(inner.shape, np.inf, np.float32)
+            if tp:
+                d = np.minimum(d, yy)
+            if bt:
+                d = np.minimum(d, (y1 - y0 - 1) - yy)
+            if lf:
+                d = np.minimum(d, xx)
+            if rt:
+                d = np.minimum(d, (x1 - x0 - 1) - xx)
+            ramp = np.clip((fe - d) / fe, 0.0, 1.0)
+            inner = (ramp**2).astype(np.float32)
+        mask[y0:y1, x0:x1] = inner
+        return (padded, jnp.broadcast_to(jnp.asarray(mask)[None], (b, h, w)))
 
 
 @register_node

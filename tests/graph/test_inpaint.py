@@ -75,6 +75,51 @@ def test_set_latent_noise_mask():
     )
     assert out["noise_mask"].shape == (1, 8, 8, 1)
     np.testing.assert_allclose(np.asarray(out["noise_mask"]), 1.0, atol=1e-6)
+    # a mask round-tripped through another latent's noise_mask
+    # ([B,H,W,1]) must normalize too
+    (out2,) = SetLatentNoiseMask().set_mask(
+        {"samples": z}, jnp.ones((1, 64, 64, 1))
+    )
+    assert out2["noise_mask"].shape == (1, 8, 8, 1)
+
+
+def test_chained_inpaint_keeps_mask(bundle):
+    """base + refine pattern: the KSampler output latent dict carries
+    noise_mask forward so a second pass stays masked (common_ksampler
+    parity)."""
+    rng = np.random.default_rng(2)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, :, 4:] = 1.0
+    latent = {"samples": z, "noise_mask": jnp.asarray(mask)[..., None]}
+    pos, neg = _cond(bundle)
+    (first,) = KSampler().sample(
+        bundle, 3, 2, 1.0, "euler", "karras", pos, neg, latent, denoise=1.0
+    )
+    assert "noise_mask" in first
+    (second,) = KSampler().sample(
+        bundle, 4, 2, 1.0, "euler", "karras", pos, neg, first, denoise=0.5
+    )
+    got = np.asarray(second["samples"])
+    np.testing.assert_array_equal(got[:, :, :4], np.asarray(z)[:, :, :4])
+
+
+def test_image_pad_for_outpaint():
+    from comfyui_distributed_tpu.graph.nodes_core import ImagePadForOutpaint
+
+    img = jnp.full((1, 32, 32, 3), 0.5)
+    (padded, mask) = ImagePadForOutpaint().expand(
+        img, left=0, top=0, right=16, bottom=0, feathering=8
+    )
+    assert padded.shape == (1, 32, 48, 3)
+    assert mask.shape == (1, 32, 48)
+    m = np.asarray(mask)
+    np.testing.assert_array_equal(m[:, :, 32:], 1.0)  # new region
+    np.testing.assert_array_equal(m[:, :, :24], 0.0)  # deep original
+    # feather ramp rises toward the new edge
+    assert 0.0 < m[0, 16, 28] < m[0, 16, 31] <= 1.0
+    # edge-replicated padding
+    np.testing.assert_array_equal(np.asarray(padded)[:, :, 32:], 0.5)
 
 
 def test_mesh_inpaint_preserves_unmasked(bundle):
