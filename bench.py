@@ -332,6 +332,14 @@ def bench_txt2img(jax, tiny: bool) -> dict:
         single_rate = _rate(run_single, 1)
         result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
         result["scaling_source"] = f"measured_{n_dev}chip"
+
+    peak = _peak_flops(jax.devices()[0])
+    if peak is not None:
+        _phase("mfu cost-analysis")
+        flops = pl.txt2img_flops(bundle, height=size, width=size, steps=steps)
+        if flops:
+            # flops = one 1-image program; rate is imgs/sec pod-wide
+            result["mfu"] = round((flops * rate) / (n_dev * peak), 4)
     return result
 
 
@@ -393,6 +401,18 @@ def bench_video(jax, tiny: bool) -> dict:
         single_rate = _rate(run_single, frames)
         result["vs_baseline"] = round(rate / max(single_rate, 1e-9), 3)
         result["scaling_source"] = f"measured_{n_dev}chip"
+
+    peak = _peak_flops(jax.devices()[0])
+    if peak is not None:
+        _phase("mfu cost-analysis")
+        flops = vp.t2v_flops(
+            bundle, frames=frames, height=size, width=size, steps=steps
+        )
+        if flops:
+            # per-frame FLOPs x pod-wide frames/sec
+            result["mfu"] = round(
+                ((flops / frames) * rate) / (n_dev * peak), 4
+            )
     return result
 
 

@@ -1,0 +1,25 @@
+"""XLA cost-analysis helper shared by the MFU numerators
+(ops/upscale._jitted_for_flops, models/pipeline.txt2img_flops,
+models/video_pipeline.t2v_flops)."""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+_log = logging.getLogger("cdt.costs")
+
+
+def xla_flops(fn, *args) -> float | None:
+    """XLA-estimated FLOPs of one jit(fn)(*args) call; None (logged)
+    when the backend exposes no cost analysis or lowering fails."""
+    try:
+        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        _log.warning("XLA cost analysis failed", exc_info=True)
+        return None

@@ -31,6 +31,7 @@ from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..utils.constants import TILE_SCAN_BATCH
 from . import samplers as smp
 from . import tiles as tile_ops
+from .costs import xla_flops as _xla_flops
 
 _log = logging.getLogger("cdt.upscale")
 
@@ -500,19 +501,6 @@ def run_upscale(
         int(steps), sampler, scheduler, float(cfg), float(denoise),
         bool(tiled_decode), int(tile_batch),
     )
-
-
-def _xla_flops(fn, *args) -> float | None:
-    """XLA-estimated FLOPs of one jit(fn)(*args) call."""
-    try:
-        analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        _log.warning("XLA cost analysis failed", exc_info=True)
-        return None
 
 
 def _jitted_for_flops(

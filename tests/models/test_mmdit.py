@@ -161,6 +161,27 @@ def test_usdu_on_flux(bundle):
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_usdu_mesh_matches_single_on_flux(bundle):
+    """Tile sharding over 8 chips is numerically equivalent to the
+    local scan for the flow family too — folded per-tile keys and the
+    interpolation noising are participant-independent."""
+    from comfyui_distributed_tpu.ops import upscale as up
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    rng = np.random.default_rng(9)
+    img = jnp.asarray(rng.random((1, 64, 64, 3)), dtype=jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    kwargs = dict(upscale_by=2.0, tile=64, padding=16, steps=2,
+                  denoise=0.4, seed=7, tile_batch=1)
+    single = up.run_upscale(bundle, img, pos, neg, mesh=None, **kwargs)
+    mesh = build_mesh({"data": 8})
+    sharded = up.run_upscale(bundle, img, pos, neg, mesh=mesh, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(single), np.asarray(sharded), atol=2e-2, rtol=0
+    )
+
+
 def test_flux_schedule_roundtrip_exact(bundle):
     """Every MMDiT template leaf is covered by the flux key schedule,
     bit-exactly, through the synthesize → convert round trip."""
