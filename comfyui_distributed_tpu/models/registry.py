@@ -143,7 +143,10 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
         "family": "sd3",
         "config": SD3Config(depth=24, remat=True),
     },
-    # SD3.5-large (8B): depth 38, hidden 2432, per-head RMS QK norm
+    # SD3.5-large (8B): depth 38, hidden 2432, per-head RMS QK norm.
+    # (SD3.5-MEDIUM is not modeled: its x_blocks add a second
+    # dual-attention branch with a 9-way adaLN — a distinct layout,
+    # not a config of this one.)
     "sd35-large": {
         "family": "sd3",
         "config": SD3Config(
