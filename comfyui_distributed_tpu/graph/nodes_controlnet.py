@@ -121,6 +121,33 @@ class FluxGuidance:
 
 
 @register_node
+class ReferenceLatent:
+    """Attach reference latents to conditioning (Flux-Kontext editing;
+    ComfyUI ReferenceLatent parity). USDU windows them per tile
+    (reference crop_reference_latents) and the Flux MMDiT consumes
+    them as extra image-stream tokens."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "latent": ("LATENT",),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "append"
+
+    def append(self, conditioning, latent, context=None):
+        cond = as_conditioning(conditioning).clone()
+        refs = list(cond.reference_latents or [])
+        refs.append(latent["samples"])
+        cond.reference_latents = refs
+        return (cond,)
+
+
+@register_node
 class ConditioningSetMask:
     @classmethod
     def INPUT_TYPES(cls):

@@ -100,28 +100,38 @@ def rope_freqs_image(
     gh: int,
     gw: int,
     theta: float = 10000.0,
+    ref_grids: tuple[tuple[int, int], ...] = (),
 ) -> np.ndarray:
-    """[txt_len + gh*gw, head_dim/2, 2] cos/sin table: text tokens at
-    position 0 of every axis (identity rotation), image tokens at
-    (0, y, x) — the Flux position-id convention."""
+    """[txt_len + gh*gw + sum(ref), head_dim/2, 2] cos/sin table: text
+    tokens at position 0 of every axis (identity rotation), image
+    tokens at (0, y, x), and each reference-latent grid at
+    (1 + ref_index, y, x) — the Flux / Flux-Kontext position-id
+    convention (reference images are offset along the first axis)."""
     k0, kh, kw = axes_dim[0] // 2, axes_dim[1] // 2, axes_dim[2] // 2
-    th = _axis_freqs(2 * kh, gh, theta)
-    tw = _axis_freqs(2 * kw, gw, theta)
-    ident0 = np.stack([np.ones(k0), np.zeros(k0)], axis=-1)  # pos-0 rotation
-    img = np.concatenate(
-        [
-            np.broadcast_to(ident0[None, None], (gh, gw, k0, 2)),
-            np.broadcast_to(th[:, None], (gh, gw, kh, 2)),
-            np.broadcast_to(tw[None, :], (gh, gw, kw, 2)),
-        ],
-        axis=2,
-    ).reshape(gh * gw, -1, 2)
+    t0 = _axis_freqs(2 * k0, len(ref_grids) + 1, theta)
+
+    def grid(g_h: int, g_w: int, idx0: int) -> np.ndarray:
+        th = _axis_freqs(2 * kh, g_h, theta)
+        tw = _axis_freqs(2 * kw, g_w, theta)
+        return np.concatenate(
+            [
+                np.broadcast_to(t0[idx0][None, None], (g_h, g_w, k0, 2)),
+                np.broadcast_to(th[:, None], (g_h, g_w, kh, 2)),
+                np.broadcast_to(tw[None, :], (g_h, g_w, kw, 2)),
+            ],
+            axis=2,
+        ).reshape(g_h * g_w, -1, 2)
+
+    img = grid(gh, gw, 0)
     pairs = img.shape[1]
     txt = np.broadcast_to(
         np.stack([np.ones(pairs), np.zeros(pairs)], axis=-1)[None],
         (txt_len, pairs, 2),
     )
-    return np.concatenate([txt, img], axis=0)
+    sections = [txt, img] + [
+        grid(rh, rw, i + 1) for i, (rh, rw) in enumerate(ref_grids)
+    ]
+    return np.concatenate(sections, axis=0)
 
 
 class _MLPEmbedder(nn.Module):
@@ -282,6 +292,7 @@ class MMDiT(nn.Module):
         control: jax.Array | None = None,  # rejected (Flux ControlNet
         #                                    is a separate architecture)
         guidance: jax.Array | None = None,  # [B] distilled guidance
+        ref_latents: list | None = None,   # Kontext: [B, h, w, C] each
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -299,13 +310,40 @@ class MMDiT(nn.Module):
         gh, gw = hh // p, ww // p
         ni = gh * gw
 
+        def patchify(arr):
+            bb, ah, aw, ac = arr.shape
+            assert ah % p == 0 and aw % p == 0, "ref patch misalign"
+            t = arr.reshape(bb, ah // p, p, aw // p, p, ac)
+            return t.transpose(0, 1, 3, 5, 2, 4).reshape(
+                bb, (ah // p) * (aw // p), ac * p * p
+            )
+
         # 2x2 patchify; flatten order (c, ph, pw) matches the original
         # rearrange 'b c (h ph) (w pw) -> b (h w) (c ph pw)'
-        tokens = x.reshape(b, gh, p, gw, p, c)
-        tokens = tokens.transpose(0, 1, 3, 5, 2, 4).reshape(b, ni, c * p * p)
-        img = nn.Dense(cfg.hidden_dim, dtype=dt, name="img_in")(
-            tokens.astype(dt)
-        )
+        img_in = nn.Dense(cfg.hidden_dim, dtype=dt, name="img_in")
+        img = img_in(patchify(x).astype(dt))
+        ref_grids: tuple = ()
+        if ref_latents:
+            # Flux-Kontext editing: reference latents ride as extra
+            # image-stream tokens (same img_in projection, first rope
+            # axis offset per reference); only the main image's tokens
+            # are unpatchified at the output
+            refs = []
+            grids = []
+            for r in ref_latents:
+                # edge-pad odd ref grids to the patch multiple (the
+                # parity behavior; the main latent stays strict)
+                ph_pad = (-r.shape[1]) % p
+                pw_pad = (-r.shape[2]) % p
+                if ph_pad or pw_pad:
+                    r = jnp.pad(
+                        r, ((0, 0), (0, ph_pad), (0, pw_pad), (0, 0)),
+                        mode="edge",
+                    )
+                grids.append((r.shape[1] // p, r.shape[2] // p))
+                refs.append(img_in(patchify(r).astype(dt)))
+            ref_grids = tuple(grids)
+            img = jnp.concatenate([img] + refs, axis=1)
         txt = nn.Dense(cfg.hidden_dim, dtype=dt, name="txt_in")(
             context.astype(dt)
         )
@@ -329,7 +367,10 @@ class MMDiT(nn.Module):
         vec = vec + _MLPEmbedder(cfg.hidden_dim, name="vector_in")(y)
 
         freqs = jnp.asarray(
-            rope_freqs_image(cfg.axes_dim, nt, gh, gw, cfg.theta), jnp.float32
+            rope_freqs_image(
+                cfg.axes_dim, nt, gh, gw, cfg.theta, ref_grids=ref_grids
+            ),
+            jnp.float32,
         )
 
         double_cls = (
@@ -347,7 +388,7 @@ class MMDiT(nn.Module):
             stream = single_cls(
                 cfg.heads, cfg.mlp_width, dt, name=f"single_blocks_{i}"
             )(stream, vec, freqs)
-        img = stream[:, nt:]
+        img = stream[:, nt:nt + ni]  # reference tokens are dropped
 
         # final layer: adaLN (shift, scale) then linear to patch pixels
         sh, sc = _modulation(vec, 2, cfg.hidden_dim, "final_layer_adaLN")

@@ -366,6 +366,17 @@ def _make_model_fn(bundle: PipelineBundle, params):
             if feats.shape[0] == 1 and x.shape[0] > 1:
                 feats = jnp.broadcast_to(feats, (x.shape[0],) + feats.shape[1:])
             control = feats * cond.control_strength
+        if (
+            not is_flow
+            and isinstance(cond, Conditioning)
+            and cond.reference_latents
+        ):
+            # loud like the SD3 module's own rejection — a silent drop
+            # reads as the feature working
+            raise ValueError(
+                "reference latents are a Flux-Kontext capability; this "
+                "model family has no reference token path"
+            )
         y = None
         adm = getattr(get_config(bundle.model_name), "adm_in_channels", 0)
         if adm and isinstance(cond, Conditioning) and cond.pooled is not None:
@@ -408,8 +419,17 @@ def _make_model_fn(bundle: PipelineBundle, params):
             g = None
             if isinstance(cond, Conditioning) and cond.guidance is not None:
                 g = jnp.full((x.shape[0],), float(cond.guidance), jnp.float32)
+            kwargs = {}
+            if isinstance(cond, Conditioning) and cond.reference_latents:
+                # Flux-Kontext editing: reference latents join the
+                # image token stream (models/mmdit.py); SD3-class
+                # models reject them explicitly
+                kwargs["ref_latents"] = [
+                    r.astype(x.dtype) for r in cond.reference_latents
+                ]
             out = bundle.unet.apply(
-                params["unet"], x, sigma_batch, context, y=y, guidance=g
+                params["unet"], x, sigma_batch, context, y=y, guidance=g,
+                **kwargs,
             )
             return out.astype(x.dtype)
         c_in = (1.0 / jnp.sqrt(sigma_batch**2 + 1.0)).reshape(

@@ -191,6 +191,7 @@ class SD3MMDiT(nn.Module):
         y: jax.Array | None = None,        # [B, pooled_dim]
         control: jax.Array | None = None,  # rejected (no SD3 ControlNet path)
         guidance: jax.Array | None = None,  # accepted, unused (CFG family)
+        ref_latents: list | None = None,   # rejected (Kontext is Flux-only)
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -198,6 +199,11 @@ class SD3MMDiT(nn.Module):
         if control is not None:
             raise ValueError(
                 "SD3-class MMDiT has no ControlNet input path"
+            )
+        if ref_latents:
+            raise ValueError(
+                "reference latents are a Flux-Kontext capability; "
+                "SD3-class MMDiT has no reference token path"
             )
         b, hh, ww, c = x.shape
         p = cfg.patch_size

@@ -195,18 +195,28 @@ def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
             patched[name] = _pad_plane_for_grid(patch, grid)
         c.model_patches = patched
     if c.reference_latents is not None:
-        # resize to the padded-canvas latent grid so per-tile latent
-        # windows slice at origin//8 (padding is a multiple of 8 in
-        # the supported configs)
+        # same convention as the image planes above: resize to the
+        # CANVAS latent grid, then edge-pad by the grid padding (in
+        # latent units), so a tile's latent window at (y//8, x//8)
+        # covers exactly the image region the tile covers — squeezing
+        # the ref into the padded canvas instead would shift and
+        # shrink every tile's reference crop
         k = 8
-        lat_h = (grid.coverage_h + 2 * p) // k
-        lat_w = (grid.coverage_w + 2 * p) // k
-        c.reference_latents = [
-            jax.image.resize(
-                lat, (lat.shape[0], lat_h, lat_w, lat.shape[3]), method="linear"
+        pk = p // k
+        cov_h, cov_w = grid.coverage_h // k, grid.coverage_w // k
+        prepped = []
+        for lat in c.reference_latents:
+            if lat.shape[1:3] != (cov_h, cov_w):
+                lat = jax.image.resize(
+                    lat, (lat.shape[0], cov_h, cov_w, lat.shape[3]),
+                    method="linear",
+                )
+            prepped.append(
+                jnp.pad(
+                    lat, ((0, 0), (pk, pk), (pk, pk), (0, 0)), mode="edge"
+                )
             )
-            for lat in c.reference_latents
-        ]
+        c.reference_latents = prepped
     return c
 
 

@@ -161,6 +161,57 @@ def test_usdu_on_flux(bundle):
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_reference_latents_condition_the_output(bundle):
+    """Flux-Kontext: reference latents join the image token stream and
+    change the prediction; output shape stays the main image's."""
+    import dataclasses
+
+    cond = pl.encode_text_pooled(bundle, ["p"])
+    model_fn = pl._make_model_fn(bundle, bundle.params)
+    z = jnp.full((1, 4, 4, 16), 0.1)
+    s = jnp.full((1,), 0.5)
+    base = model_fn(z, s, cond)
+    ref = jnp.linspace(0, 1, 4 * 4 * 16).reshape(1, 4, 4, 16)
+    with_ref = model_fn(
+        z, s, dataclasses.replace(cond, reference_latents=[ref])
+    )
+    assert with_ref.shape == base.shape
+    assert not np.allclose(np.asarray(base), np.asarray(with_ref))
+    # a second, different reference shifts it again (distinct rope ids)
+    ref2 = jnp.flip(ref, axis=1)
+    with_two = model_fn(
+        z, s, dataclasses.replace(cond, reference_latents=[ref, ref2])
+    )
+    assert not np.allclose(np.asarray(with_ref), np.asarray(with_two))
+    # odd-sized reference grids edge-pad to the patch multiple
+    odd = jnp.ones((1, 5, 3, 16))
+    out_odd = model_fn(
+        z, s, dataclasses.replace(cond, reference_latents=[odd])
+    )
+    assert out_odd.shape == base.shape
+
+
+def test_usdu_on_flux_with_reference_latents(bundle):
+    """The USDU tile path windows reference latents per tile
+    (reference crop_reference_latents) and the model consumes them."""
+    from comfyui_distributed_tpu.ops import upscale as up
+    from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+    rng = np.random.default_rng(13)
+    img = jnp.asarray(rng.random((1, 64, 64, 3)), dtype=jnp.float32)
+    ref = jnp.asarray(rng.random((1, 16, 16, 16)), dtype=jnp.float32)
+    pos = Conditioning(
+        context=pl.encode_text(bundle, ["p"]), reference_latents=[ref]
+    )
+    neg = pl.encode_text(bundle, [""])
+    out = up.run_upscale(
+        bundle, img, pos, neg, mesh=None, upscale_by=2.0, tile=64,
+        padding=16, steps=2, denoise=0.4, seed=3,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_usdu_mesh_matches_single_on_flux(bundle):
     """Tile sharding over 8 chips is numerically equivalent to the
     local scan for the flow family too — folded per-tile keys and the

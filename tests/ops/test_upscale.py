@@ -39,6 +39,36 @@ def test_single_upscale_shapes(bundle):
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_prep_ref_latents_alignment():
+    """Reference latents follow the image-plane convention (canvas
+    grid + edge padding, no squeeze), so a tile's latent window covers
+    exactly the image region the tile covers."""
+    from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+    _, _, grid = up.plan_grid(64, 64, 2.0, 64, 16)
+    k = 8
+    pk = grid.padding // k
+    cov = (grid.coverage_h // k, grid.coverage_w // k)
+    ref = jnp.arange(cov[0] * cov[1], dtype=jnp.float32).reshape(
+        1, cov[0], cov[1], 1
+    )
+    cond = Conditioning(context=jnp.zeros((1, 4, 8)), reference_latents=[ref])
+    prepped = up.prep_cond_for_tiles(cond, grid)
+    padded = prepped.reference_latents[0]
+    assert padded.shape[1:3] == (cov[0] + 2 * pk, cov[1] + 2 * pk)
+    # canvas content is padded, never rescaled
+    np.testing.assert_array_equal(
+        np.asarray(padded[:, pk:-pk, pk:-pk]), np.asarray(ref)
+    )
+    w = up.tile_cond(prepped, 0, 0, grid).reference_latents[0]
+    th, tw = grid.padded_h // k, grid.padded_w // k
+    assert w.shape[1:3] == (th, tw)
+    np.testing.assert_array_equal(
+        np.asarray(w[:, pk:, pk:]),
+        np.asarray(ref[:, : th - pk, : tw - pk]),
+    )
+
+
 def test_flops_estimate_composition(bundle):
     """MFU-numerator invariants. XLA cost analysis counts a lax.scan
     body once, so the estimate must be composed from scan-free parts:
