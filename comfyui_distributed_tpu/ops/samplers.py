@@ -28,7 +28,8 @@ SAMPLER_NAMES = (
     "lcm",
 )
 SCHEDULER_NAMES = (
-    "karras", "normal", "simple", "exponential", "sgm_uniform", "ddim_uniform",
+    "karras", "normal", "simple", "exponential", "sgm_uniform",
+    "ddim_uniform", "beta", "kl_optimal",
 )
 
 
@@ -87,6 +88,35 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
             [n - 1 - int(i * ss) for i in range(total_steps)], dtype=np.int64
         )
         sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
+    elif scheduler == "beta":
+        # timesteps at Beta(0.6, 0.6) quantiles: dense at both schedule
+        # ends, sparse in the middle
+        try:
+            from scipy.stats import beta as _beta_dist
+        except ImportError as exc:  # pragma: no cover - env-dependent
+            raise ValueError(
+                "the 'beta' scheduler requires scipy, which is not "
+                "installed; pick another scheduler"
+            ) from exc
+
+        n = len(all_sigmas)
+        ts = 1.0 - np.linspace(0.0, 1.0, total_steps, endpoint=False)
+        idx = np.rint(_beta_dist.ppf(ts, 0.6, 0.6) * (n - 1)).astype(np.int64)
+        # strictly decreasing indices: quantile rounding can collide
+        # (the reference dedupes; the fixed steps+1 scan length here
+        # needs distinct sigmas instead — equal neighbors would break
+        # multistep solvers)
+        for i in range(1, len(idx)):
+            if idx[i] >= idx[i - 1]:
+                idx[i] = idx[i - 1] - 1
+        sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
+    elif scheduler == "kl_optimal":
+        # arctan-interpolated sigma spacing ("Align Your Steps"
+        # KL-optimal closed form)
+        r = np.linspace(0.0, 1.0, total_steps)
+        sigmas = np.tan(
+            r * np.arctan(sigma_min) + (1.0 - r) * np.arctan(sigma_max)
+        )
     else:
         raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
 
@@ -692,8 +722,10 @@ def _sample_dpmpp_2m(model_fn, x, sigmas, cond):
             return (sigma_next / sigma) * x - jnp.expm1(-h) * den
 
         def second_order(_):
+            # clamps guard degenerate schedules with equal adjacent
+            # sigmas (h_last == 0 would make 1/(2r) inf -> NaN)
             h_last = t - t_of(sigma_prev)
-            r = h_last / h
+            r = jnp.maximum(h_last, 1e-10) / jnp.maximum(h, 1e-10)
             den_d = (1 + 1 / (2 * r)) * den - (1 / (2 * r)) * old_den
             return (sigma_next / sigma) * x - jnp.expm1(-h) * den_d
 

@@ -22,7 +22,16 @@ def ideal_model(x, sigma_batch, cond):
     return (x - MU) / jnp.maximum(sig, 1e-6)
 
 
-@pytest.mark.parametrize("scheduler", ["karras", "normal", "exponential"])
+def test_beta_schedule_has_no_duplicate_sigmas():
+    """Quantile rounding can collide at high step counts; duplicates
+    would NaN multistep solvers (the reference dedupes)."""
+    sigmas = np.asarray(smp.get_sigmas("beta", 150))[:-1]
+    assert (np.diff(sigmas) < 0).all()
+
+
+@pytest.mark.parametrize(
+    "scheduler", ["karras", "normal", "exponential", "beta", "kl_optimal"]
+)
 def test_schedules_monotone_terminated(scheduler):
     sigmas = np.asarray(smp.get_sigmas(scheduler, 12))
     assert sigmas.shape == (13,)
