@@ -194,7 +194,9 @@ class EmptyLatentImage:
 
     def generate(self, width: int, height: int, batch_size: int, context=None):
         # latent geometry fixed at the SD 8x factor; KSampler rescales
-        # against the bundle's actual latent_scale if it differs
+        # PLACEHOLDER latents (the "empty" marker) against the bundle's
+        # actual latent layout if it differs — real content (VAEEncode,
+        # chained samplers, LatentUpscale) is never rebuilt
         return (
             {
                 "samples": jnp.zeros(
@@ -202,6 +204,7 @@ class EmptyLatentImage:
                 ),
                 "width": int(width),
                 "height": int(height),
+                "empty": True,
             },
         )
 
@@ -247,8 +250,10 @@ class KSampler:
         latents = latent_image["samples"]
         # honor requested pixel geometry / channel count when the
         # bundle's VAE differs from the nominal 8x 4-channel layout
-        # EmptyLatentImage assumes (Flux-class VAEs are 8x but 16ch)
-        if "width" in latent_image and (
+        # EmptyLatentImage assumes (Flux-class VAEs are 8x but 16ch).
+        # Only PLACEHOLDER latents rebuild — real content from chained
+        # samplers / VAEEncode / LatentUpscale must never be replaced
+        if latent_image.get("empty") and "width" in latent_image and (
             bundle.latent_scale != 8
             or latents.shape[-1] != bundle.latent_channels
         ):
@@ -270,8 +275,12 @@ class KSampler:
             )
         # ComfyUI common_ksampler parity: the output latent dict keeps
         # the input's extras (noise_mask, width/height), so chained
-        # inpaint passes (base + refine) stay masked
-        extras = {k: v for k, v in latent_image.items() if k != "samples"}
+        # inpaint passes (base + refine) stay masked. The "empty"
+        # placeholder marker does NOT propagate — the output is content
+        extras = {
+            k: v for k, v in latent_image.items()
+            if k not in ("samples", "empty")
+        }
 
         mesh = getattr(context, "mesh", None) if context is not None else None
         if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
@@ -555,6 +564,92 @@ class ImageScale:
 
         out = resize_image(image, int(height), int(width), str(upscale_method))
         return (jnp.clip(out, 0.0, 1.0),)
+
+
+@register_node
+class LatentUpscale:
+    """Resize latents to a target pixel size (the hi-res-fix substrate;
+    ComfyUI LatentUpscale parity — latent grid = pixels/8 by the node
+    convention, independent of the bundle's actual VAE factor)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "upscale_method": ("STRING", {"default": "nearest-exact"}),
+                "width": ("INT", {"default": 1024}),
+                "height": ("INT", {"default": 1024}),
+                "crop": ("STRING", {"default": "disabled"}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "upscale"
+
+    def upscale(self, samples: dict, upscale_method="nearest-exact",
+                width=1024, height=1024, crop="disabled", context=None):
+        from ..ops.upscale import resize_image
+
+        z = samples["samples"]
+        mask = samples.get("noise_mask")
+        if mask is not None:
+            mask = _mask_to_latent(mask, z.shape[1], z.shape[2])
+        lh = max(1, int(height) // 8)
+        lw = max(1, int(width) // 8)
+        if str(crop) == "center":
+            # ComfyUI common_upscale parity: crop the source to the
+            # target aspect around the center before resizing
+            h, w = z.shape[1], z.shape[2]
+            new_aspect = lw / lh
+            if w / h > new_aspect:
+                cw = max(1, round(h * new_aspect))
+                x0 = (w - cw) // 2
+                z = z[:, :, x0:x0 + cw]
+                if mask is not None:
+                    mask = mask[:, :, x0:x0 + cw]
+            elif w / h < new_aspect:
+                ch = max(1, round(w / new_aspect))
+                y0 = (h - ch) // 2
+                z = z[:, y0:y0 + ch]
+                if mask is not None:
+                    mask = mask[:, y0:y0 + ch]
+        elif str(crop) != "disabled":
+            raise ValueError(f"unknown crop mode {crop!r}; use disabled|center")
+        out = dict(samples)
+        out["samples"] = resize_image(z, lh, lw, str(upscale_method))
+        out["width"] = lw * 8
+        out["height"] = lh * 8
+        if mask is not None:
+            out["noise_mask"] = _mask_to_latent(mask, lh, lw)
+        return (out,)
+
+
+@register_node
+class LatentUpscaleBy:
+    """Scale latents by a factor (ComfyUI LatentUpscaleBy parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "upscale_method": ("STRING", {"default": "nearest-exact"}),
+                "scale_by": ("FLOAT", {"default": 1.5}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "upscale"
+
+    def upscale(self, samples: dict, upscale_method="nearest-exact",
+                scale_by=1.5, context=None):
+        z = samples["samples"]
+        lh = max(1, int(round(z.shape[1] * float(scale_by))))
+        lw = max(1, int(round(z.shape[2] * float(scale_by))))
+        return LatentUpscale().upscale(
+            samples, upscale_method, width=lw * 8, height=lh * 8
+        )
 
 
 @register_node

@@ -105,10 +105,17 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
         # strictly decreasing indices: quantile rounding can collide
         # (the reference dedupes; the fixed steps+1 scan length here
         # needs distinct sigmas instead — equal neighbors would break
-        # multistep solvers)
+        # multistep solvers). Downward nudges can cascade below 0 when
+        # many low quantiles round to 0, so a bottom-up pass bumps
+        # those back, preserving strictness whenever total_steps <= n.
         for i in range(1, len(idx)):
             if idx[i] >= idx[i - 1]:
                 idx[i] = idx[i - 1] - 1
+        floor = 0
+        for i in range(len(idx) - 1, -1, -1):
+            if idx[i] < floor:
+                idx[i] = floor
+            floor = idx[i] + 1
         sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
     elif scheduler == "kl_optimal":
         # arctan-interpolated sigma spacing ("Align Your Steps"

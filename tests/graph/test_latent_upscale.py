@@ -1,0 +1,72 @@
+"""Hi-res-fix substrate: LatentUpscale / LatentUpscaleBy (ComfyUI
+parity nodes the reference's users chain between two KSamplers)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    KSampler,
+    LatentUpscale,
+    LatentUpscaleBy,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+def test_latent_upscale_shapes_and_mask():
+    z = jnp.zeros((1, 8, 8, 4))
+    mask = jnp.ones((1, 8, 8, 1))
+    (out,) = LatentUpscale().upscale(
+        {"samples": z, "noise_mask": mask}, "nearest-exact", 128, 128
+    )
+    assert out["samples"].shape == (1, 16, 16, 4)
+    assert out["noise_mask"].shape == (1, 16, 16, 1)
+    assert out["width"] == 128 and out["height"] == 128
+
+
+def test_latent_upscale_center_crop():
+    """crop='center' trims the source to the target aspect around the
+    center before resizing (common_upscale parity)."""
+    cols = jnp.broadcast_to(
+        jnp.arange(16.0)[None, None, :, None], (1, 8, 16, 4)
+    )
+    (out,) = LatentUpscale().upscale(
+        {"samples": cols}, "nearest-exact", 64, 64, crop="center"
+    )
+    got = np.asarray(out["samples"])
+    assert got.shape == (1, 8, 8, 4)
+    # columns come from the CENTER window (4..11), not a squeeze of 0..15
+    assert got.min() >= 4.0 and got.max() <= 11.0
+    with pytest.raises(ValueError, match="crop"):
+        LatentUpscale().upscale(
+            {"samples": cols}, "nearest-exact", 64, 64, crop="sideways"
+        )
+
+
+def test_latent_upscale_by_factor():
+    z = jnp.linspace(0, 1, 8 * 8 * 4).reshape(1, 8, 8, 4)
+    (out,) = LatentUpscaleBy().upscale({"samples": z}, "bilinear", 1.5)
+    assert out["samples"].shape == (1, 12, 12, 4)
+    assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+def test_hires_fix_chain():
+    """txt2img pass -> latent upscale -> refine pass, the canonical
+    hi-res-fix graph."""
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    pos = pl.encode_text_pooled(bundle, ["p"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    base = {"samples": jnp.zeros((1, 4, 4, 4)), "width": 32, "height": 32}
+    (first,) = KSampler().sample(
+        bundle, 3, 2, 1.0, "euler", "karras", pos, neg, base, denoise=1.0
+    )
+    (up,) = LatentUpscaleBy().upscale(first, "nearest-exact", 2.0)
+    assert up["samples"].shape == (1, 8, 8, 4)
+    (second,) = KSampler().sample(
+        bundle, 4, 2, 1.0, "euler", "karras", pos, neg, up, denoise=0.5
+    )
+    arr = np.asarray(second["samples"])
+    assert arr.shape == (1, 8, 8, 4)
+    assert np.isfinite(arr).all()

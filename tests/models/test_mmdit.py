@@ -134,7 +134,10 @@ def test_ksampler_rebuilds_latents_for_flux(bundle):
     16 channels) instead of feeding 4ch latents into img_in."""
     from comfyui_distributed_tpu.graph.nodes_core import KSampler
 
-    latent = {"samples": jnp.zeros((1, 4, 4, 4)), "width": 32, "height": 32}
+    latent = {
+        "samples": jnp.zeros((1, 4, 4, 4)), "width": 32, "height": 32,
+        "empty": True,
+    }
     pos = pl.encode_text_pooled(bundle, ["p"])
     neg = pl.encode_text_pooled(bundle, [""])
     (out,) = KSampler().sample(

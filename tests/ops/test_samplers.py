@@ -22,10 +22,12 @@ def ideal_model(x, sigma_batch, cond):
     return (x - MU) / jnp.maximum(sig, 1e-6)
 
 
-def test_beta_schedule_has_no_duplicate_sigmas():
-    """Quantile rounding can collide at high step counts; duplicates
-    would NaN multistep solvers (the reference dedupes)."""
-    sigmas = np.asarray(smp.get_sigmas("beta", 150))[:-1]
+@pytest.mark.parametrize("steps", [150, 250, 300])
+def test_beta_schedule_has_no_duplicate_sigmas(steps):
+    """Quantile rounding can collide at high step counts, and the
+    downward nudge can cascade below index 0; duplicates would NaN
+    multistep solvers (the reference dedupes)."""
+    sigmas = np.asarray(smp.get_sigmas("beta", steps))[:-1]
     assert (np.diff(sigmas) < 0).all()
 
 
