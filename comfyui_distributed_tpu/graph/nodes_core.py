@@ -589,18 +589,39 @@ class LatentUpscale:
 
     def upscale(self, samples: dict, upscale_method="nearest-exact",
                 width=1024, height=1024, crop="disabled", context=None):
-        from ..ops.upscale import resize_image
+        from ..ops.upscale import RESIZE_METHODS, resize_image
 
+        method = str(upscale_method)
+        if method != "area" and method not in RESIZE_METHODS:
+            raise ValueError(
+                f"unknown upscale_method {method!r}; use "
+                f"{sorted(RESIZE_METHODS) + ['area']}"
+            )
         z = samples["samples"]
         mask = samples.get("noise_mask")
-        if mask is not None:
-            mask = _mask_to_latent(mask, z.shape[1], z.shape[2])
-        lh = max(1, int(height) // 8)
-        lw = max(1, int(width) // 8)
+        h, w = z.shape[1], z.shape[2]
+        width, height = int(width), int(height)
+        # ComfyUI convention: a 0 dimension preserves the aspect ratio
+        # (0/0 = pass-through)
+        if width == 0 and height == 0:
+            lh, lw = h, w
+        elif width == 0:
+            lh = max(1, height // 8)
+            lw = max(1, round(w * lh / h))
+        elif height == 0:
+            lw = max(1, width // 8)
+            lh = max(1, round(h * lw / w))
+        else:
+            lh = max(1, height // 8)
+            lw = max(1, width // 8)
         if str(crop) == "center":
+            # the crop path slices mask and latents together, so the
+            # mask normalizes to the source grid first (the no-crop
+            # path resizes it once, directly to the target)
+            if mask is not None:
+                mask = _mask_to_latent(mask, h, w)
             # ComfyUI common_upscale parity: crop the source to the
             # target aspect around the center before resizing
-            h, w = z.shape[1], z.shape[2]
             new_aspect = lw / lh
             if w / h > new_aspect:
                 cw = max(1, round(h * new_aspect))
@@ -617,7 +638,7 @@ class LatentUpscale:
         elif str(crop) != "disabled":
             raise ValueError(f"unknown crop mode {crop!r}; use disabled|center")
         out = dict(samples)
-        out["samples"] = resize_image(z, lh, lw, str(upscale_method))
+        out["samples"] = resize_image(z, lh, lw, method)
         out["width"] = lw * 8
         out["height"] = lh * 8
         if mask is not None:

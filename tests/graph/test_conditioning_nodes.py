@@ -1,0 +1,34 @@
+"""Conditioning-mutating nodes: FluxGuidance and ReferenceLatent
+(clone semantics — graph branches must not see each other's edits)."""
+
+import jax.numpy as jnp
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_controlnet import (
+    FluxGuidance,
+    ReferenceLatent,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_flux_guidance_sets_scale():
+    ctx = jnp.zeros((1, 4, 8))
+    (c,) = FluxGuidance().append(ctx, 2.5)
+    assert c.guidance == 2.5
+    # restamping yields a new value without mutating the input
+    (c2,) = FluxGuidance().append(c, 4.0)
+    assert c2.guidance == 4.0
+    assert c.guidance == 2.5
+
+
+def test_reference_latent_appends_without_mutation():
+    ctx = jnp.zeros((1, 4, 8))
+    (c1,) = ReferenceLatent().append(ctx, {"samples": jnp.ones((1, 4, 4, 16))})
+    assert len(c1.reference_latents) == 1
+    (c2,) = ReferenceLatent().append(
+        c1, {"samples": jnp.zeros((1, 2, 2, 16))}
+    )
+    assert len(c2.reference_latents) == 2
+    assert len(c1.reference_latents) == 1  # clone, not shared list
+    assert c2.reference_latents[0] is c1.reference_latents[0]

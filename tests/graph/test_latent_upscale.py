@@ -45,6 +45,17 @@ def test_latent_upscale_center_crop():
         )
 
 
+def test_latent_upscale_zero_dim_preserves_aspect():
+    """ComfyUI convention: width/height 0 = keep aspect; 0/0 = noop."""
+    z = jnp.zeros((1, 12, 8, 4))  # 96x64 px at the 8x convention
+    (out,) = LatentUpscale().upscale({"samples": z}, "bilinear", 0, 192)
+    assert out["samples"].shape == (1, 24, 16, 4)
+    (noop,) = LatentUpscale().upscale({"samples": z}, "bilinear", 0, 0)
+    assert noop["samples"].shape == (1, 12, 8, 4)
+    with pytest.raises(ValueError, match="upscale_method"):
+        LatentUpscale().upscale({"samples": z}, "nearset-exact", 64, 64)
+
+
 def test_latent_upscale_by_factor():
     z = jnp.linspace(0, 1, 8 * 8 * 4).reshape(1, 8, 8, 4)
     (out,) = LatentUpscaleBy().upscale({"samples": z}, "bilinear", 1.5)
