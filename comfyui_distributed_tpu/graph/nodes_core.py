@@ -560,9 +560,16 @@ class ImageScale:
     FUNCTION = "scale"
 
     def scale(self, image, upscale_method, width, height, crop="disabled", context=None):
-        from ..ops.upscale import resize_image
+        from ..ops import upscale as up_ops
 
-        out = resize_image(image, int(height), int(width), str(upscale_method))
+        height, width = up_ops.resolve_resize_dims(
+            image.shape[1], image.shape[2], int(width), int(height)
+        )
+        if str(crop) == "center":
+            (image,) = up_ops.center_crop_to_aspect([image], height, width)
+        elif str(crop) != "disabled":
+            raise ValueError(f"unknown crop mode {crop!r}; use disabled|center")
+        out = up_ops.resize_image(image, height, width, str(upscale_method))
         return (jnp.clip(out, 0.0, 1.0),)
 
 
@@ -589,56 +596,29 @@ class LatentUpscale:
 
     def upscale(self, samples: dict, upscale_method="nearest-exact",
                 width=1024, height=1024, crop="disabled", context=None):
-        from ..ops.upscale import RESIZE_METHODS, resize_image
+        from ..ops import upscale as up_ops
 
-        method = str(upscale_method)
-        if method != "area" and method not in RESIZE_METHODS:
-            raise ValueError(
-                f"unknown upscale_method {method!r}; use "
-                f"{sorted(RESIZE_METHODS) + ['area']}"
-            )
         z = samples["samples"]
         mask = samples.get("noise_mask")
         h, w = z.shape[1], z.shape[2]
-        width, height = int(width), int(height)
-        # ComfyUI convention: a 0 dimension preserves the aspect ratio
-        # (0/0 = pass-through)
-        if width == 0 and height == 0:
-            lh, lw = h, w
-        elif width == 0:
-            lh = max(1, height // 8)
-            lw = max(1, round(w * lh / h))
-        elif height == 0:
-            lw = max(1, width // 8)
-            lh = max(1, round(h * lw / w))
-        else:
-            lh = max(1, height // 8)
-            lw = max(1, width // 8)
+        # latent cells = pixels // 8 (the node convention); 0 stays 0
+        # so resolve_resize_dims applies the preserve-aspect rule
+        lh, lw = up_ops.resolve_resize_dims(
+            h, w, int(width) // 8, int(height) // 8
+        )
         if str(crop) == "center":
             # the crop path slices mask and latents together, so the
             # mask normalizes to the source grid first (the no-crop
             # path resizes it once, directly to the target)
             if mask is not None:
                 mask = _mask_to_latent(mask, h, w)
-            # ComfyUI common_upscale parity: crop the source to the
-            # target aspect around the center before resizing
-            new_aspect = lw / lh
-            if w / h > new_aspect:
-                cw = max(1, round(h * new_aspect))
-                x0 = (w - cw) // 2
-                z = z[:, :, x0:x0 + cw]
-                if mask is not None:
-                    mask = mask[:, :, x0:x0 + cw]
-            elif w / h < new_aspect:
-                ch = max(1, round(w / new_aspect))
-                y0 = (h - ch) // 2
-                z = z[:, y0:y0 + ch]
-                if mask is not None:
-                    mask = mask[:, y0:y0 + ch]
+                z, mask = up_ops.center_crop_to_aspect([z, mask], lh, lw)
+            else:
+                (z,) = up_ops.center_crop_to_aspect([z], lh, lw)
         elif str(crop) != "disabled":
             raise ValueError(f"unknown crop mode {crop!r}; use disabled|center")
         out = dict(samples)
-        out["samples"] = resize_image(z, lh, lw, method)
+        out["samples"] = up_ops.resize_image(z, lh, lw, str(upscale_method))
         out["width"] = lw * 8
         out["height"] = lh * 8
         if mask is not None:

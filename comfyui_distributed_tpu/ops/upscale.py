@@ -81,12 +81,54 @@ def area_resize(image: jax.Array, out_h: int, out_w: int) -> jax.Array:
 def resize_image(
     image: jax.Array, out_h: int, out_w: int, method_name: str
 ) -> jax.Array:
-    """Route a user-facing resize-method name to the right kernel."""
+    """Route a user-facing resize-method name to the right kernel.
+    Unknown names raise (a typo silently coerced to bicubic rings on
+    latents where the user chose nearest-exact on purpose); identical
+    target dims return the input untouched."""
+    if method_name != "area" and method_name not in RESIZE_METHODS:
+        raise ValueError(
+            f"unknown upscale_method {method_name!r}; use "
+            f"{sorted(RESIZE_METHODS) + ['area']}"
+        )
+    if (image.shape[1], image.shape[2]) == (out_h, out_w):
+        return image
     if method_name == "area":
         return area_resize(image, out_h, out_w)
-    method = RESIZE_METHODS.get(method_name, "cubic")
     b, _, _, c = image.shape
-    return jax.image.resize(image, (b, out_h, out_w, c), method=method)
+    return jax.image.resize(
+        image, (b, out_h, out_w, c), method=RESIZE_METHODS[method_name]
+    )
+
+
+def resolve_resize_dims(
+    h: int, w: int, target_w: int, target_h: int
+) -> tuple[int, int]:
+    """(out_h, out_w) under the ComfyUI common_upscale convention: a 0
+    target dimension preserves the source aspect (0/0 = identity)."""
+    if target_w == 0 and target_h == 0:
+        return h, w
+    if target_w == 0:
+        return target_h, max(1, round(w * target_h / h))
+    if target_h == 0:
+        return max(1, round(h * target_w / w)), target_w
+    return target_h, target_w
+
+
+def center_crop_to_aspect(arrs: list, out_h: int, out_w: int) -> list:
+    """Center-crop [B, H, W, ...] planes to the (out_h, out_w) aspect
+    (the common_upscale crop='center' rule); all planes share the
+    leading spatial geometry and are sliced identically."""
+    h, w = arrs[0].shape[1], arrs[0].shape[2]
+    new_aspect = out_w / out_h
+    if w / h > new_aspect:
+        cw = max(1, round(h * new_aspect))
+        x0 = (w - cw) // 2
+        return [a[:, :, x0:x0 + cw] for a in arrs]
+    if w / h < new_aspect:
+        ch = max(1, round(w / new_aspect))
+        y0 = (h - ch) // 2
+        return [a[:, y0:y0 + ch] for a in arrs]
+    return list(arrs)
 
 
 def plan_grid(

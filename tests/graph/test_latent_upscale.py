@@ -63,6 +63,25 @@ def test_latent_upscale_by_factor():
     assert np.isfinite(np.asarray(out["samples"])).all()
 
 
+def test_image_scale_aspect_and_crop():
+    """ImageScale follows the same conventions: 0-dim keeps aspect,
+    center crop trims to the target aspect, bad methods raise."""
+    from comfyui_distributed_tpu.graph.nodes_core import ImageScale
+
+    img = jnp.broadcast_to(
+        jnp.arange(16.0)[None, None, :, None] / 15.0, (1, 8, 16, 3)
+    )
+    (out,) = ImageScale().scale(img, "nearest", 0, 64)
+    assert out.shape == (1, 64, 128, 3)
+    (c,) = ImageScale().scale(img, "nearest", 64, 64, crop="center")
+    assert c.shape == (1, 64, 64, 3)
+    arr = np.asarray(c)
+    assert arr.min() >= 4.0 / 15.0 - 1e-6
+    assert arr.max() <= 11.0 / 15.0 + 1e-6
+    with pytest.raises(ValueError, match="upscale_method"):
+        ImageScale().scale(img, "nearset", 64, 64)
+
+
 def test_hires_fix_chain():
     """txt2img pass -> latent upscale -> refine pass, the canonical
     hi-res-fix graph."""
