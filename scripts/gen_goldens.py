@@ -91,6 +91,17 @@ def compute_goldens() -> dict[str, np.ndarray]:
             scheduler="simple",
         )
     )
+
+    # SD3 family: joint blocks + triple CLIP-L/G + T5 conditioning +
+    # true CFG on the flow schedule
+    sbundle = pl.load_pipeline("tiny-sd3", seed=0)
+    out["sd3_txt2img_32"] = np.asarray(
+        pl.txt2img(
+            sbundle, "a golden sd3 image", height=32, width=32,
+            steps=2, seed=77, cfg_scale=4.0, sampler="euler",
+            scheduler="simple",
+        )
+    )
     return out
 
 
