@@ -274,6 +274,22 @@ class WorkerRoutes:
         except Exception as exc:  # noqa: BLE001 - best effort
             info["clip_vocab_canonical"] = None
             info["clip_vocab_error"] = str(exc)
+        # Same fidelity surface for the T5 side: Flux/SD3/WAN condition
+        # through sentencepiece vocabs; without CDT_T5_SPM the fallback
+        # CLIP-BPE ids are deterministic placeholders (and get folded
+        # into the embedding range — models/t5_encoder.py).
+        try:
+            from ..models.t5_encoder import T5Tokenizer
+
+            # actual tokenizer state, like the CLIP branch: a
+            # default-constructed tokenizer is canonical iff CDT_T5_SPM
+            # names a loadable sentencepiece asset
+            info["t5_vocab_canonical"] = await _run_blocking(
+                lambda: T5Tokenizer(max_length=1).is_canonical
+            )
+        except Exception as exc:  # noqa: BLE001 - best effort
+            info["t5_vocab_canonical"] = None
+            info["t5_vocab_error"] = str(exc)
         return web.json_response(info)
 
 

@@ -448,20 +448,31 @@ class VAEEncodeForInpaint:
             m = m[None]
         if m.shape[1:] != (h, w):
             m = jax.image.resize(m, (m.shape[0], h, w), method="linear")
-        g = int(grow_mask_by)
-        if g > 0:
-            m = jax.lax.reduce_window(
-                m, -jnp.inf, jax.lax.max,
-                (1, 2 * g + 1, 2 * g + 1), (1, 1, 1), "SAME",
-            )
         m = jnp.clip(m, 0.0, 1.0)
-        hard = (m > 0.5).astype(pixels.dtype)[..., None]
-        neutral = pixels * (1.0 - hard) + 0.5 * hard
+        # Pixels are neutralized with the UN-grown rounded mask; only
+        # the emitted noise_mask is dilated, with a g x g max window
+        # (~radius g/2) — the reference-stack kernel. Growing the
+        # gray-filled region too would erase usable context around the
+        # mask boundary (ADVICE r4).
+        hard = (m > 0.5).astype(jnp.float32)
+        g = int(grow_mask_by)
+        grown = hard
+        if g > 0:
+            # reference convs with padding=ceil((g-1)/2) then crops to
+            # [:h,:w]: output pixel i covers [i-ceil((g-1)/2),
+            # i+floor((g-1)/2)] — for even g that's one extra pixel
+            # toward -y/-x, which SAME padding would mirror
+            lo, hi = (g - 1 + 1) // 2, (g - 1) // 2
+            grown = jax.lax.reduce_window(
+                hard, -jnp.inf, jax.lax.max, (1, g, g), (1, 1, 1),
+                ((0, 0), (lo, hi), (lo, hi)),
+            )
+        neutral = pixels * (1.0 - hard[..., None]) + 0.5 * hard[..., None]
         z = vae.vae.apply(vae.params["vae"], neutral, method="encode")
         return (
             {
                 "samples": z,
-                "noise_mask": _mask_to_latent(m, z.shape[1], z.shape[2]),
+                "noise_mask": _mask_to_latent(grown, z.shape[1], z.shape[2]),
                 "width": int(w),
                 "height": int(h),
             },

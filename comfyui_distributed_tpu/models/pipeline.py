@@ -176,7 +176,9 @@ def load_pipeline(
     from .t5_encoder import T5Tokenizer
 
     if family == "mmdit":
-        tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
+        tokenizer = T5Tokenizer(
+            max_length=te_cfg.max_length, vocab_size=te_cfg.vocab_size
+        )
     else:
         tokenizer = Tokenizer(
             max_length=te_cfg.max_length, pad_id=te_cfg.pad_token_id
@@ -206,7 +208,11 @@ def load_pipeline(
         ),
         text_encoder_3=te3,
         tokenizer_3=(
-            T5Tokenizer(max_length=te3_cfg.max_length) if te3_name else None
+            T5Tokenizer(
+                max_length=te3_cfg.max_length, vocab_size=te3_cfg.vocab_size
+            )
+            if te3_name
+            else None
         ),
         te_name=te_name,
         te2_name=te2_name,

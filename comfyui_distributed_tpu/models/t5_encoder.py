@@ -181,9 +181,22 @@ class T5Tokenizer:
     closing </s> (id 1) when the spm path is active."""
 
     EOS = 1
+    #: the CLIP-BPE fallback emits ids in [0, 49408) — larger than the
+    #: real T5 sentencepiece vocab (32128). XLA gather silently clamps
+    #: out-of-range ids, which would corrupt conditioning without a
+    #: trace; pass the model's ``vocab_size`` so the fallback can remap
+    #: deterministically and warn loudly instead.
+    BPE_ID_SPACE = 49408
 
-    def __init__(self, max_length: int = 512, spm_path: Optional[str] = None):
+    def __init__(
+        self,
+        max_length: int = 512,
+        spm_path: Optional[str] = None,
+        vocab_size: Optional[int] = None,
+    ):
         self.max_length = max_length
+        self.vocab_size = vocab_size
+        self._warned_overflow = False
         self._spm = None
         path = spm_path or os.environ.get("CDT_T5_SPM")
         if path:
@@ -199,6 +212,12 @@ class T5Tokenizer:
 
             self._spm = T5TokenizerFast(vocab_file=path)
 
+    @property
+    def is_canonical(self) -> bool:
+        """True when a real sentencepiece vocab backs tokenization
+        (mirrors ``ClipBPE.is_canonical`` for system_info surfacing)."""
+        return self._spm is not None
+
     def encode(self, text: str) -> np.ndarray:
         out = np.zeros((self.max_length,), dtype=np.int32)
         if self._spm is not None:
@@ -211,8 +230,33 @@ class T5Tokenizer:
 
             body = get_bpe(None).encode_text(text)[: self.max_length - 1]
             ids = body + [self.EOS]
+            ids = self._fold_into_vocab(ids)
         out[: len(ids)] = ids
         return out
+
+    def _fold_into_vocab(self, ids: list[int]) -> list[int]:
+        """Fallback ids that exceed the model's embedding table would be
+        silently clamped by XLA gather — remap them deterministically
+        into [2, vocab_size) (skipping pad=0 / eos=1 so the key mask and
+        the T5 contract stay intact) and warn loudly once."""
+        vs = self.vocab_size
+        if vs is None or vs >= self.BPE_ID_SPACE:
+            return ids
+        if not self._warned_overflow and any(i >= vs for i in ids):
+            import logging
+
+            logging.getLogger("cdt.t5_encoder").warning(
+                "T5 fallback tokenizer (no CDT_T5_SPM) emits CLIP-BPE ids "
+                "up to %d but this encoder's vocab_size is %d; "
+                "out-of-range ids are being folded into the valid range. "
+                "Conditioning is NOT faithful to real checkpoints — point "
+                "CDT_T5_SPM at the model's sentencepiece vocab.",
+                self.BPE_ID_SPACE - 1,
+                vs,
+            )
+            self._warned_overflow = True
+        span = max(vs - 2, 1)
+        return [i if i < vs else 2 + (i - 2) % span for i in ids]
 
     def encode_batch(self, texts: list[str]) -> np.ndarray:
         return np.stack([self.encode(t) for t in texts], axis=0)

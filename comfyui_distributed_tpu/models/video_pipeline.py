@@ -159,7 +159,9 @@ def load_video_pipeline(
             te_params, _ = sdc.load_t5_weights(
                 sdc.read_checkpoint(te_ckpt), te_cfg, te_params
             )
-        tokenizer = T5Tokenizer(max_length=te_cfg.max_length)
+        tokenizer = T5Tokenizer(
+            max_length=te_cfg.max_length, vocab_size=te_cfg.vocab_size
+        )
     else:
         tokenizer = Tokenizer(
             max_length=te_cfg.max_length,

@@ -57,12 +57,24 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
     """
     import numpy as np
 
-    all_sigmas = _vp_sigmas()
-    sigma_max = float(all_sigmas[-1])
-    sigma_min = float(all_sigmas[0])
     total_steps = steps
     if denoise < 1.0:
         total_steps = max(int(steps / max(denoise, 1e-4)), steps)
+    sigmas = _spaced_from_table(_vp_sigmas(), scheduler, total_steps)
+    sigmas = sigmas[-steps:] if denoise < 1.0 else sigmas
+    return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
+
+
+def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
+    """Descending [total_steps] sigma spacing over an ascending sigma
+    table — the scheduler dispatch shared by the VP and flow families
+    (in the reference stack the model's sampling object owns the table
+    and the scheduler knob shapes spacing through it for BOTH families).
+    """
+    import numpy as np
+
+    sigma_max = float(all_sigmas[-1])
+    sigma_min = float(all_sigmas[0])
 
     if scheduler == "karras":
         rho = 7.0
@@ -127,26 +139,48 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
     else:
         raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
 
-    sigmas = sigmas[-steps:] if denoise < 1.0 else sigmas
-    return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
+    return sigmas
+
+
+def _flow_sigma_table(shift: float, n_training: int = 1000):
+    """Ascending flow sigma table sigma(t) = s*t / (1 + (s-1)*t) for
+    t in {1/n, ..., 1} — the flow analog of _vp_sigmas (the reference
+    stack's flow model_sampling exposes the same discretized table)."""
+    import numpy as np
+
+    t = np.arange(1, n_training + 1, dtype=np.float64) / n_training
+    return shift * t / (1.0 + (shift - 1.0) * t)
 
 
 def get_flow_sigmas(
-    steps: int, denoise: float = 1.0, shift: float = 3.0
+    steps: int,
+    denoise: float = 1.0,
+    shift: float = 3.0,
+    scheduler: str = "simple",
 ) -> jnp.ndarray:
     """[steps+1] descending rectified-flow sigmas with timestep shift
     (t' = s*t / (1 + (s-1)*t)). sigma IS the flow time: x_t =
     (1-sigma)*x0 + sigma*noise, and the model's velocity prediction is
     exactly eps under the sampler contract denoised = x - sigma*eps.
-    `denoise < 1` truncates to the schedule tail like get_sigmas."""
+    `denoise < 1` truncates to the schedule tail like get_sigmas.
+
+    The scheduler knob shapes spacing here too (ADVICE r4): 'simple' /
+    'normal' keep the exact uniform-t-through-the-shift-map grid (the
+    Flux default); every other scheduler applies its spacing over the
+    shifted flow sigma table, mirroring how the reference computes
+    beta/sgm_uniform/karras through the model's sampling object."""
     import numpy as np
 
     total = steps
     if denoise < 1.0:
         total = max(int(steps / max(denoise, 1e-4)), steps)
-    t = np.linspace(1.0, 0.0, total + 1)
-    t = shift * t / (1.0 + (shift - 1.0) * t)
-    return jnp.asarray(t[-(steps + 1):], dtype=jnp.float32)
+    if scheduler in ("normal", "simple"):
+        t = np.linspace(1.0, 0.0, total + 1)
+        t = shift * t / (1.0 + (shift - 1.0) * t)
+        return jnp.asarray(t[-(steps + 1):], dtype=jnp.float32)
+    sigmas = _spaced_from_table(_flow_sigma_table(shift), scheduler, total)
+    sigmas = sigmas[-steps:] if denoise < 1.0 else sigmas
+    return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
 
 
 def get_model_sigmas(
@@ -157,12 +191,15 @@ def get_model_sigmas(
     flow_shift: float = 3.0,
 ) -> jnp.ndarray:
     """Family-aware sigma schedule: flow-matching models (Flux class)
-    ignore the VP scheduler table and use the shifted rectified-flow
-    grid — parity with the reference stack, where the model's sampling
-    object owns the schedule and the scheduler knob only shapes
-    VP-model spacing."""
+    use the shifted rectified-flow grid as their sigma table; the
+    scheduler knob shapes spacing for BOTH families (parity with the
+    reference stack, where spacing is computed through the model's
+    sampling object — a Flux user selecting scheduler='beta' gets beta
+    spacing over flow sigmas, not a silently ignored knob)."""
     if parameterization == "flow":
-        return get_flow_sigmas(steps, denoise=denoise, shift=flow_shift)
+        return get_flow_sigmas(
+            steps, denoise=denoise, shift=flow_shift, scheduler=scheduler
+        )
     return get_sigmas(scheduler, steps, denoise=denoise)
 
 

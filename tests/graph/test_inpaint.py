@@ -68,6 +68,29 @@ def test_vae_encode_for_inpaint(bundle):
     assert nm.sum() > (8 // bundle.latent_scale) ** 2
 
 
+def test_grow_mask_dilates_noise_mask_only(bundle):
+    """grow_mask_by must not enlarge the gray-neutralized pixel region
+    (reference neutralizes with the un-grown rounded mask and dilates
+    only the emitted noise_mask, g x g kernel — ADVICE r4): the encoded
+    samples are identical across grow settings, the noise_mask is not."""
+    rng = np.random.default_rng(3)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    mask = np.zeros((32, 32), np.float32)
+    mask[12:20, 12:20] = 1.0
+    (l0,) = VAEEncodeForInpaint().encode(
+        img, bundle, jnp.asarray(mask), grow_mask_by=0
+    )
+    (l6,) = VAEEncodeForInpaint().encode(
+        img, bundle, jnp.asarray(mask), grow_mask_by=6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(l0["samples"]), np.asarray(l6["samples"])
+    )
+    assert np.asarray(l6["noise_mask"]).sum() > np.asarray(
+        l0["noise_mask"]
+    ).sum()
+
+
 def test_set_latent_noise_mask():
     z = jnp.zeros((1, 8, 8, 4))
     (out,) = SetLatentNoiseMask().set_mask(

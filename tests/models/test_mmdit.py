@@ -41,7 +41,7 @@ def test_flow_sigma_schedule_properties():
 
 
 def test_model_sigmas_dispatch():
-    flow = smp.get_model_sigmas("flow", "karras", 4, flow_shift=1.0)
+    flow = smp.get_model_sigmas("flow", "simple", 4, flow_shift=1.0)
     np.testing.assert_allclose(
         np.asarray(flow), np.asarray(smp.get_flow_sigmas(4, shift=1.0))
     )
@@ -49,6 +49,34 @@ def test_model_sigmas_dispatch():
     np.testing.assert_allclose(
         np.asarray(vp), np.asarray(smp.get_sigmas("karras", 4))
     )
+
+
+def test_flow_scheduler_knob_shapes_spacing():
+    """scheduler='beta'/'karras' on a flow model must shape the sigma
+    grid (ADVICE r4: the reference computes scheduler spacing through
+    the model's sampling object for flow families too), not be silently
+    ignored."""
+    simple = np.asarray(smp.get_model_sigmas("flow", "simple", 8, flow_shift=3.0))
+    # sgm_uniform is excluded from the inequality check: uniform index
+    # spacing over the flow table IS uniform t through the shift map,
+    # so it legitimately coincides with the simple grid
+    for name in ("karras", "exponential", "beta", "kl_optimal", "sgm_uniform"):
+        s = np.asarray(smp.get_model_sigmas("flow", name, 8, flow_shift=3.0))
+        assert s.shape == simple.shape
+        assert s[-1] == 0.0
+        assert np.all(np.diff(s) < 0), name
+        assert s[0] <= 1.0 + 1e-6  # flow sigmas live in [0, 1]
+        if name != "sgm_uniform":
+            assert not np.allclose(s, simple), name
+    # shift still matters under a non-default scheduler
+    a = np.asarray(smp.get_model_sigmas("flow", "karras", 8, flow_shift=1.0))
+    b = np.asarray(smp.get_model_sigmas("flow", "karras", 8, flow_shift=3.0))
+    assert not np.allclose(a, b)
+    # denoise truncation behaves like the VP path
+    t = np.asarray(
+        smp.get_model_sigmas("flow", "karras", 4, denoise=0.5, flow_shift=1.0)
+    )
+    assert t.shape == (5,) and t[0] < 0.75
 
 
 def test_noise_latents_interpolates_for_flow():

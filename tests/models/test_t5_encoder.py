@@ -115,6 +115,43 @@ def test_t5_tokenizer_fallback_deterministic():
     assert (a[a != 0] > 0).all()
 
 
+def test_t5_tokenizer_fallback_folds_into_small_vocab(caplog):
+    """The real t5-xxl embedding table (32128) is smaller than the
+    CLIP-BPE fallback id space (49408); XLA gather would silently clamp
+    out-of-range ids, so the tokenizer must fold them into range
+    deterministically and warn loudly (ADVICE r4, medium)."""
+    import logging
+
+    vocab = get_config("t5-xxl").vocab_size
+    tok = T5Tokenizer(max_length=16, vocab_size=vocab)
+    text = "driving thru the canyon"  # "thru" → id ≥ 32128 in this vocab
+    unfolded = T5Tokenizer(max_length=16).encode(text)
+    assert (unfolded >= vocab).any(), "fixture must exercise overflow"
+    with caplog.at_level(logging.WARNING, logger="cdt.t5_encoder"):
+        folded = tok.encode(text)
+    assert (folded < vocab).all()
+    # pad mask unchanged: folded ids never land on pad(0)/eos(1)
+    np.testing.assert_array_equal(folded == 0, unfolded == 0)
+    assert folded[unfolded == 1].tolist() == unfolded[unfolded == 1].tolist()
+    # in-range ids pass through untouched
+    keep = (unfolded < vocab) & (unfolded != 0)
+    np.testing.assert_array_equal(folded[keep], unfolded[keep])
+    # deterministic across instances
+    np.testing.assert_array_equal(
+        folded, T5Tokenizer(max_length=16, vocab_size=vocab).encode(text)
+    )
+    assert any("folded into the valid range" in r.message for r in caplog.records)
+    assert not tok.is_canonical
+
+
+def test_t5_tokenizer_large_vocab_never_folds():
+    cfg = get_config("umt5-xxl")
+    text = "driving thru the canyon"
+    a = T5Tokenizer(max_length=16, vocab_size=cfg.vocab_size).encode(text)
+    b = T5Tokenizer(max_length=16).encode(text)
+    np.testing.assert_array_equal(a, b)
+
+
 def test_video_pipeline_with_t5_encoder():
     from comfyui_distributed_tpu.models.video_pipeline import (
         encode_video_text,
