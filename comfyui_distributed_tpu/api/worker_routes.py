@@ -279,13 +279,12 @@ class WorkerRoutes:
         # CLIP-BPE ids are deterministic placeholders (and get folded
         # into the embedding range — models/t5_encoder.py).
         try:
-            from ..models.t5_encoder import T5Tokenizer
+            from ..models.t5_encoder import t5_vocab_canonical
 
-            # actual tokenizer state, like the CLIP branch: a
-            # default-constructed tokenizer is canonical iff CDT_T5_SPM
-            # names a loadable sentencepiece asset
+            # actual tokenizer state, like the CLIP branch (and cached
+            # like it — this endpoint is panel-polled)
             info["t5_vocab_canonical"] = await _run_blocking(
-                lambda: T5Tokenizer(max_length=1).is_canonical
+                t5_vocab_canonical
             )
         except Exception as exc:  # noqa: BLE001 - best effort
             info["t5_vocab_canonical"] = None

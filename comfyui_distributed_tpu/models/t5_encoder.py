@@ -173,6 +173,28 @@ class T5Encoder(nn.Module):
         return hidden, pooled
 
 
+def t5_vocab_canonical() -> bool:
+    """Whether default-constructed T5 tokenizers are sentencepiece-
+    backed (CDT_T5_SPM names a loadable asset). Cached per resolved
+    path — polled by /distributed/system_info, which must not re-parse
+    the spm file per request (mirrors clip_bpe's memoized singleton)."""
+    return _t5_canonical_cached(os.environ.get("CDT_T5_SPM") or "")
+
+
+def _t5_canonical_cached(path: str) -> bool:
+    if path not in _T5_CANONICAL_CACHE:
+        if not path:
+            _T5_CANONICAL_CACHE[path] = False
+        else:
+            _T5_CANONICAL_CACHE[path] = T5Tokenizer(
+                max_length=1, spm_path=path
+            ).is_canonical
+    return _T5_CANONICAL_CACHE[path]
+
+
+_T5_CANONICAL_CACHE: dict[str, bool] = {}
+
+
 class T5Tokenizer:
     """SentencePiece-faithful when a real spm asset is available
     (`CDT_T5_SPM` or `spm_path`); otherwise falls back to the committed
@@ -211,6 +233,17 @@ class T5Tokenizer:
             from transformers import T5TokenizerFast
 
             self._spm = T5TokenizerFast(vocab_file=path)
+            spm_vocab = int(self._spm.vocab_size)
+            if vocab_size is not None and spm_vocab > vocab_size:
+                # a real vocab paired with a smaller embedding table is
+                # a misconfiguration, not a degraded fallback — folding
+                # real ids would corrupt real weights, so fail loudly
+                raise ValueError(
+                    f"sentencepiece vocab at {path!r} has {spm_vocab} "
+                    f"ids but this encoder's embedding table holds only "
+                    f"{vocab_size}; wrong vocab for this model "
+                    "(e.g. a umt5 asset on a t5-xxl Flux/SD3 encoder)"
+                )
 
     @property
     def is_canonical(self) -> bool:

@@ -103,17 +103,9 @@ def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
     elif scheduler == "beta":
         # timesteps at Beta(0.6, 0.6) quantiles: dense at both schedule
         # ends, sparse in the middle
-        try:
-            from scipy.stats import beta as _beta_dist
-        except ImportError as exc:  # pragma: no cover - env-dependent
-            raise ValueError(
-                "the 'beta' scheduler requires scipy, which is not "
-                "installed; pick another scheduler"
-            ) from exc
-
         n = len(all_sigmas)
         ts = 1.0 - np.linspace(0.0, 1.0, total_steps, endpoint=False)
-        idx = np.rint(_beta_dist.ppf(ts, 0.6, 0.6) * (n - 1)).astype(np.int64)
+        idx = np.rint(_beta_ppf(ts, 0.6, 0.6) * (n - 1)).astype(np.int64)
         # strictly decreasing indices: quantile rounding can collide
         # (the reference dedupes; the fixed steps+1 scan length here
         # needs distinct sigmas instead — equal neighbors would break
@@ -140,6 +132,27 @@ def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
         raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
 
     return sigmas
+
+
+def _beta_ppf(q, a: float, b: float, iters: int = 60):
+    """Beta(a, b) quantile function via bisection on the regularized
+    incomplete beta CDF (jax.scipy.special.betainc) — dependency-free
+    (the reference stack reaches scipy.stats.beta.ppf for this; scipy
+    is an optional install here, so the sampler stack must not need
+    it). float32 betainc + 60 halvings ≈ 1e-7 quantile precision,
+    far inside the rint-to-1000-buckets tolerance downstream."""
+    import numpy as np
+    from jax.scipy.special import betainc
+
+    q = np.asarray(q, np.float64)
+    lo = np.zeros_like(q)
+    hi = np.ones_like(q)
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        cdf = np.asarray(betainc(a, b, mid), np.float64)
+        lo = np.where(cdf < q, mid, lo)
+        hi = np.where(cdf < q, hi, mid)
+    return 0.5 * (lo + hi)
 
 
 def _flow_sigma_table(shift: float, n_training: int = 1000):

@@ -144,6 +144,38 @@ def test_t5_tokenizer_fallback_folds_into_small_vocab(caplog):
     assert not tok.is_canonical
 
 
+def test_t5_spm_vocab_larger_than_embedding_raises(tmp_path, monkeypatch):
+    """A REAL sentencepiece vocab paired with a smaller embedding table
+    is a misconfiguration (e.g. a umt5 asset on a t5-xxl encoder):
+    folding real ids would corrupt real weights, so construction must
+    fail loudly instead."""
+
+    class StubSpm:
+        vocab_size = 256384
+
+        def __init__(self, vocab_file):
+            pass
+
+    import transformers
+
+    monkeypatch.setattr(transformers, "T5TokenizerFast", StubSpm)
+    spm = tmp_path / "umt5.model"
+    spm.write_bytes(b"stub")
+    with pytest.raises(ValueError, match="wrong vocab for this model"):
+        T5Tokenizer(max_length=16, spm_path=str(spm), vocab_size=32128)
+    # matching table accepted
+    tok = T5Tokenizer(max_length=16, spm_path=str(spm), vocab_size=256384)
+    assert tok.is_canonical
+
+
+def test_t5_vocab_canonical_helper_cached(monkeypatch):
+    from comfyui_distributed_tpu.models import t5_encoder as t5e
+
+    monkeypatch.delenv("CDT_T5_SPM", raising=False)
+    assert t5e.t5_vocab_canonical() is False
+    assert "" in t5e._T5_CANONICAL_CACHE
+
+
 def test_t5_tokenizer_large_vocab_never_folds():
     cfg = get_config("umt5-xxl")
     text = "driving thru the canyon"

@@ -31,6 +31,38 @@ def test_beta_schedule_has_no_duplicate_sigmas(steps):
     assert (np.diff(sigmas) < 0).all()
 
 
+def test_beta_ppf_matches_scipy():
+    """The scipy-free bisection PPF must agree with scipy's reference
+    implementation well inside the rint-to-1000-buckets tolerance."""
+    scipy_stats = pytest.importorskip("scipy.stats")
+    q = np.linspace(0.0, 1.0, 97)
+    got = smp._beta_ppf(q, 0.6, 0.6)
+    want = scipy_stats.beta.ppf(q, 0.6, 0.6)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_beta_scheduler_needs_no_scipy(monkeypatch):
+    """VERDICT r4 item 6: all 8 schedulers must be dependency-clean —
+    the beta schedule computes with scipy entirely absent."""
+    import builtins
+    import sys
+
+    for mod in list(sys.modules):
+        if mod == "scipy" or mod.startswith("scipy."):
+            monkeypatch.delitem(sys.modules, mod)
+    real_import = builtins.__import__
+
+    def no_scipy(name, *args, **kwargs):
+        if name == "scipy" or name.startswith("scipy."):
+            raise ImportError(f"scipy blocked in test: {name}")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_scipy)
+    sigmas = np.asarray(smp.get_sigmas("beta", 12))
+    assert sigmas.shape == (13,)
+    assert (np.diff(sigmas[:-1]) < 0).all()
+
+
 @pytest.mark.parametrize(
     "scheduler", ["karras", "normal", "exponential", "beta", "kl_optimal"]
 )
