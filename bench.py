@@ -49,7 +49,10 @@ probe), BENCH_SCALING_TIMEOUT (s, <=0 skips), BENCH_WALL_S (<=0
 disables the wall clock), BENCH_BUDGET_S / BENCH_BUDGET2_S (full /
 reduced accelerator child caps), BENCH_TINY_BUDGET_S,
 BENCH_TILE_BATCH (USDU tile grouping; default 1 on CPU, 4 on
-accelerators).
+accelerators), BENCH_TERM_GRACE_S (SIGTERM->SIGKILL harvest window on
+probe timeout), BENCH_PROBE_PLATFORM (pin the probe child's backend
+via the config API — the env var is overridden by hosted plugins).
+Run the staged probe alone with BENCH_MODE=probe (see _probe_child).
 """
 
 from __future__ import annotations
@@ -92,11 +95,123 @@ _PROBE_ATTEMPTS: list[dict] = []
 _TIMELINE: list[dict] = []
 _LIVE_CHILDREN: list = []  # Popen objects (own sessions) to kill on expiry
 
-_PROBE_CODE = (
-    "import jax, logging; logging.basicConfig(level=logging.INFO); "
-    "ds = jax.devices(); "
-    "print('probe-ok', [(d.platform, d.device_kind) for d in ds], flush=True)"
-)
+def _probe_child() -> None:
+    """BENCH_MODE=probe child: staged backend init with forensics.
+
+    Four rounds of probe timeouts produced exactly two generic warnings
+    and no clue whether the hang was plugin import, PJRT client init,
+    device enumeration, or the first compile (VERDICT r4 weak #1). This
+    child (a) phase-marks every stage to stderr, (b) arms
+    faulthandler.dump_traceback_later at deadline-10s so a hang prints
+    the exact Python line it is stuck on, (c) dumps all thread stacks
+    on the parent's SIGTERM, and (d) turns plugin verbosity up so the
+    TPU runtime's own init logging lands in the captured stderr."""
+    import faulthandler
+    import signal
+
+    faulthandler.enable()  # SIGSEGV/SIGABRT native-crash stacks
+    faulthandler.register(signal.SIGTERM, all_threads=True, chain=False)
+    deadline = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "600"))
+    grace = float(os.environ.get("BENCH_TERM_GRACE_S", 15))
+    if deadline > 20:
+        # fires ~10s before the parent's kill: the hang names its line
+        faulthandler.dump_traceback_later(deadline - 10, exit=False)
+    # self-destruct: SIGTERM is reduced to a stack-dump no-op above and
+    # Python-level cleanup can't run while a native call is hung, so an
+    # orphaned child (parent SIGKILLed before its own cleanup) would
+    # spin forever holding the single-client TPU lock. SIGALRM's
+    # default disposition is a kernel-level terminate that fires even
+    # inside a blocked native call; it only triggers if the parent's
+    # SIGKILL never arrived.
+    signal.alarm(int(deadline + grace + 5))
+
+    t0 = time.perf_counter()
+
+    def mark(stage: str, detail: str = "") -> None:
+        print(
+            f"probe phase: {stage} at {time.perf_counter() - t0:.1f}s"
+            + (f" | {detail}" if detail else ""),
+            file=sys.stderr, flush=True,
+        )
+
+    # plugin/runtime verbosity into the captured stderr (harmless on
+    # backends that ignore them)
+    for var, val in (
+        ("TPU_MIN_LOG_LEVEL", "0"),
+        ("TPU_STDERR_LOG_LEVEL", "0"),
+        ("TF_CPP_MIN_LOG_LEVEL", "0"),
+        ("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge"),
+    ):
+        os.environ.setdefault(var, val)
+    relevant = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(("JAX_", "TPU_", "PJRT_", "XLA_", "LIBTPU", "TF_CPP"))
+    }
+    mark("env", json.dumps(relevant))
+
+    import importlib.metadata as md
+    vers = {}
+    for dist in ("jax", "jaxlib", "libtpu", "libtpu-nightly"):
+        try:
+            vers[dist] = md.version(dist)
+        except md.PackageNotFoundError:
+            pass
+    try:
+        plugins = [
+            f"{ep.name}={ep.value}"
+            for ep in md.entry_points(group="jax_plugins")
+        ]
+    except Exception as exc:  # noqa: BLE001 - forensics only
+        plugins = [f"entry-point enumeration failed: {exc}"]
+    mark("versions", json.dumps({"dists": vers, "jax_plugins": plugins}))
+
+    import logging
+    logging.basicConfig(level=logging.DEBUG)
+    if os.environ.get("BENCH_PROBE_HANG") == "1":
+        # test hook: a deterministic "hung backend" so the parent's
+        # SIGTERM->dump->SIGKILL escalation is exercised hermetically
+        mark("test hang hook")
+        while True:
+            time.sleep(3600)
+    mark("import jax")
+    import jax
+    mark("jax imported", jax.__version__)
+    if os.environ.get("BENCH_PROBE_PLATFORM"):
+        # pin a backend via the config API — the hosted TPU plugin
+        # overrides the JAX_PLATFORMS env var during registration, so
+        # this is the only reliable host-side pin (operator runbook)
+        jax.config.update(
+            "jax_platforms", os.environ["BENCH_PROBE_PLATFORM"]
+        )
+        mark("platform pinned", os.environ["BENCH_PROBE_PLATFORM"])
+    # jax.devices() covers plugin registration + PJRT client creation +
+    # device enumeration; the watchdog traceback splits them if it hangs
+    mark("backend init (plugin discovery + PJRT client + jax.devices)")
+    ds = jax.devices()
+    mark(
+        "devices",
+        json.dumps([(d.platform, str(d.device_kind)) for d in ds]),
+    )
+    mark("tiny op (first compile)")
+    import jax.numpy as jnp
+    out = jnp.add(1, 1)
+    out.block_until_ready()
+    mark("tiny op done", str(int(out)))
+    faulthandler.cancel_dump_traceback_later()
+    print(
+        "probe-ok",
+        [(d.platform, str(d.device_kind)) for d in ds],
+        flush=True,
+    )
+
+
+def _probe_phase_ledger(stderr_text: str) -> list[str]:
+    """Extract the child's staged phase markers for the bench JSON."""
+    return [
+        line.split("probe phase: ", 1)[1].strip()[:400]
+        for line in stderr_text.splitlines()
+        if "probe phase: " in line
+    ]
 
 
 def _phase(name: str) -> None:
@@ -119,37 +234,72 @@ def _probe_accelerator(timeout_s: float) -> str:
     not interruptible in-process). No retry ladder — a second, longer
     attempt is exactly what starved round 3 of any datum; a fast
     deterministic failure would be re-run for no benefit either.
-    Returns 'ok' | 'failed' | 'timeout'; diagnostics are recorded in
-    _PROBE_ATTEMPTS either way."""
+
+    The child is the staged BENCH_MODE=probe mode (phase markers +
+    faulthandler watchdog). On timeout the parent escalates gently:
+    SIGTERM first — the child's registered faulthandler dumps every
+    thread's stack to stderr — and SIGKILL only if the dump doesn't
+    flush within 15s. Returns 'ok' | 'failed' | 'timeout'; diagnostics
+    (including the staged phase ledger and any stack dump) are recorded
+    in _PROBE_ATTEMPTS either way."""
+    import signal
+
     t0 = time.perf_counter()
+    env = dict(
+        os.environ,
+        BENCH_MODE="probe",
+        BENCH_PROBE_DEADLINE_S=str(timeout_s),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env, start_new_session=True,
+    )
+    _LIVE_CHILDREN.append(proc)
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
-            timeout=timeout_s, capture_output=True,
-        )
-        diag = (
-            _decode_tail(proc.stdout, 512)
-            + ("\n" if proc.stderr else "")
-            + _decode_tail(proc.stderr, 2048)
-        ).strip()
-        status = (
-            "ok"
-            if proc.returncode == 0 and b"probe-ok" in proc.stdout
-            else "failed"
-        )
-    except subprocess.TimeoutExpired as exc:
-        diag = (
-            _decode_tail(exc.stdout, 512)
-            + ("\n" if exc.stderr else "")
-            + _decode_tail(exc.stderr, 2048)
-        ).strip()
-        status = "timeout"
-    _PROBE_ATTEMPTS.append({
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout_s)
+            status = (
+                "ok"
+                if proc.returncode == 0 and b"probe-ok" in stdout
+                else "failed"
+            )
+        except subprocess.TimeoutExpired:
+            status = "timeout"
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                # give faulthandler time to write the all-thread dump
+                stdout, stderr = proc.communicate(
+                    timeout=float(os.environ.get("BENCH_TERM_GRACE_S", 15))
+                )
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+                stdout, stderr = proc.communicate()
+    finally:
+        _LIVE_CHILDREN.remove(proc)
+    stderr_text = _decode_tail(stderr, 16384)
+    diag = (_decode_tail(stdout, 512) + "\n" + stderr_text).strip()
+    attempt = {
         "timeout_s": round(timeout_s, 1),
         "elapsed_s": round(time.perf_counter() - t0, 1),
         "status": status,
-        "diagnostics": diag,
-    })
+        "phases": _probe_phase_ledger(stderr_text),
+        "diagnostics": diag if status != "ok" else diag[-2048:],
+    }
+    if status != "ok" and "Current thread" not in diag and "Thread 0x" not in diag:
+        attempt["note"] = (
+            "no faulthandler stack dump captured — the hang is likely "
+            "in native code the Python-level dump cannot see, or the "
+            "child died before arming; see phases for the last stage "
+            "reached"
+        )
+    _PROBE_ATTEMPTS.append(attempt)
     return status
 
 
@@ -793,6 +943,9 @@ def _orchestrate() -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_MODE") == "probe":
+        _probe_child()
+        return
     if os.environ.get("BENCH_MODE") == "virtual8":
         _virtual8_scaling()
         return

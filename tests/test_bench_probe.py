@@ -1,0 +1,92 @@
+"""Accelerator-probe forensics (VERDICT r4 item 1): the staged probe
+child must name the exact stage — and, on a hang, the exact Python
+line — that a timeout died in, so a dark chip leaves evidence instead
+of two generic warnings."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.fast
+def test_probe_phase_ledger_parses():
+    bench = _load_bench()
+    stderr = (
+        "DEBUG:something unrelated\n"
+        "probe phase: env at 0.0s | {\"JAX_PLATFORMS\": \"cpu\"}\n"
+        "probe phase: import jax at 0.1s\n"
+        "noise line\n"
+        "probe phase: devices at 2.0s | [[\"cpu\", \"cpu\"]]\n"
+    )
+    phases = bench._probe_phase_ledger(stderr)
+    assert len(phases) == 3
+    assert phases[0].startswith("env at 0.0s")
+    assert phases[-1].startswith("devices at 2.0s")
+
+
+@pytest.mark.slow
+def test_probe_child_ok_on_cpu():
+    """The staged child reaches every phase and prints probe-ok when
+    the backend is healthy (CPU pinned via the config API — the env
+    var is overridden by hosted TPU plugins)."""
+    env = dict(
+        os.environ,
+        BENCH_MODE="probe",
+        BENCH_PROBE_PLATFORM="cpu",
+        BENCH_PROBE_DEADLINE_S="120",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "probe-ok" in proc.stdout
+    for stage in (
+        "probe phase: env",
+        "probe phase: versions",
+        "probe phase: import jax",
+        "probe phase: platform pinned",
+        "probe phase: devices",
+        "probe phase: tiny op done",
+    ):
+        assert stage in proc.stderr, stage
+    # the env dump carries the vars an operator needs to see
+    assert "JAX_PLATFORMS" in proc.stderr
+
+
+@pytest.mark.slow
+def test_probe_timeout_harvests_stack_dump():
+    """On a hang the parent escalates SIGTERM -> SIGKILL and the
+    recorded attempt carries the staged ledger plus a faulthandler
+    stack dump naming the hung line (the r4 probe died silently)."""
+    bench = _load_bench()
+    os.environ["BENCH_PROBE_HANG"] = "1"
+    os.environ["BENCH_TERM_GRACE_S"] = "5"
+    try:
+        status = bench._probe_accelerator(6)
+    finally:
+        del os.environ["BENCH_PROBE_HANG"]
+        del os.environ["BENCH_TERM_GRACE_S"]
+    attempt = bench._PROBE_ATTEMPTS[-1]
+    assert status == "timeout"
+    assert attempt["status"] == "timeout"
+    assert any(p.startswith("test hang hook") for p in attempt["phases"])
+    # the SIGTERM-registered faulthandler names the hung frame
+    assert "thread 0x" in attempt["diagnostics"].lower()
+    assert "in _probe_child" in attempt["diagnostics"]
+    json.dumps(attempt)  # must be JSON-serializable for BENCH_r05.json
