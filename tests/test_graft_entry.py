@@ -45,6 +45,21 @@ def test_dryrun_multichip_clean_env_subprocess():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip(8)" in proc.stdout
+    # throughput ledger (VERDICT r4 item 3): the tail must carry a
+    # parseable per-phase timing line for the round-over-round table
+    tail = [
+        line for line in proc.stdout.splitlines() if ": timings " in line
+    ]
+    assert tail, proc.stdout[-1500:]
+    timings = json.loads(tail[-1].split(": timings ", 1)[1])
+    for key in (
+        "train_first_s", "train_again_s", "generate_first_s",
+        "generate_again_s", "usdu_single_s", "usdu_sharded_s",
+        "usdu_sharded_again_s", "usdu_batched_s", "total_s",
+    ):
+        assert key in timings and timings[key] >= 0, key
+    # cached re-execution must be faster than compile+run
+    assert timings["train_again_s"] < timings["train_first_s"]
 
 
 @pytest.mark.parametrize(
