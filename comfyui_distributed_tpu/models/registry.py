@@ -143,14 +143,31 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
         "family": "sd3",
         "config": SD3Config(depth=24, remat=True),
     },
-    # SD3.5-large (8B): depth 38, hidden 2432, per-head RMS QK norm.
-    # (SD3.5-MEDIUM is not modeled: its x_blocks add a second
-    # dual-attention branch with a 9-way adaLN — a distinct layout,
-    # not a config of this one.)
+    # SD3.5-large (8B): depth 38, hidden 2432, per-head RMS QK norm
     "sd35-large": {
         "family": "sd3",
         "config": SD3Config(
             depth=38, hidden_dim=2432, heads=38, qk_norm=True, remat=True
+        ),
+    },
+    # SD3.5-medium (2.5B, MMDiT-X): depth 24 -> hidden 1536, QK norm,
+    # 384-wide learned pos table, and a second image-only attention
+    # branch (attn2, 9-way adaLN) in the first 13 x_blocks
+    "sd35-medium": {
+        "family": "sd3",
+        "config": SD3Config(
+            depth=24, qk_norm=True, pos_embed_max=384,
+            dual_attn_blocks=13, remat=True,
+        ),
+    },
+    # tiny MMDiT-X: one dual-attention block + one plain, for hermetic
+    # forward/schedule/golden coverage of the attn2 branch
+    "tiny-sd35m": {
+        "family": "sd3",
+        "config": SD3Config(
+            depth=2, hidden_dim=32, heads=2, context_dim=160,
+            pooled_dim=160, pos_embed_max=32, qk_norm=True,
+            dual_attn_blocks=1, flow_shift=1.0,
         ),
     },
     # tiny: context 160 = tiny CLIP-L(64) ++ CLIP-G(96) = T5 width;
@@ -418,7 +435,9 @@ HIDDEN_POOLED_ENCODERS: dict[str, tuple[str, str]] = {
 TRIPLE_TEXT_ENCODERS: dict[str, tuple[str, str, str]] = {
     "sd3-medium": ("clip-l-sd3", "clip-g", "t5-xxl-sd3"),
     "sd35-large": ("clip-l-sd3", "clip-g", "t5-xxl-sd3"),
+    "sd35-medium": ("clip-l-sd3", "clip-g", "t5-xxl-sd3"),
     "tiny-sd3": ("tiny-te-l", "tiny-te-g", "tiny-t5-sd3"),
+    "tiny-sd35m": ("tiny-te-l", "tiny-te-g", "tiny-t5-sd3"),
 }
 
 _CONSTRUCTORS: dict[str, Callable[[Any], Any]] = {

@@ -55,6 +55,11 @@ class SD3Config:
     freq_dim: int = 256
     pos_embed_max: int = 192       # learned table is [max*max, hidden]
     qk_norm: bool = False          # SD3.5: per-head RMS ln_q/ln_k
+    # SD3.5-medium (MMDiT-X): the first N x_blocks carry a SECOND,
+    # image-only self-attention branch (`x_block.attn2.*`) and a 9-way
+    # adaLN (the published x_block_self_attn_layers list is the
+    # contiguous range 0..12, so an int prefix count captures it)
+    dual_attn_blocks: int = 0
     parameterization: str = "flow"
     flow_shift: float = 3.0        # the published SD3 sampling shift
     dtype: str = "bfloat16"
@@ -101,6 +106,7 @@ class _JointBlock(nn.Module):
     dtype: jnp.dtype
     qk_norm: bool
     pre_only: bool
+    dual_attn: bool = False  # MMDiT-X x-side self-attention branch
 
     @nn.compact
     def __call__(
@@ -144,7 +150,16 @@ class _JointBlock(nn.Module):
             c_sh1, c_sc1, c_g1, c_sh2, c_sc2, c_g2 = _modulation(
                 vec, 6, dim, "ctx"
             )
-        x_sh1, x_sc1, x_g1, x_sh2, x_sc2, x_g2 = _modulation(vec, 6, dim, "x")
+        if self.dual_attn:
+            # MMDiT-X chunk order: (msa, mlp, msa2) shift/scale/gate
+            (
+                x_sh1, x_sc1, x_g1, x_sh2, x_sc2, x_g2,
+                x2_sh, x2_sc, x2_g,
+            ) = _modulation(vec, 9, dim, "x")
+        else:
+            x_sh1, x_sc1, x_g1, x_sh2, x_sc2, x_g2 = _modulation(
+                vec, 6, dim, "x"
+            )
 
         cq, ck, cv = qkv(pre(ctx, c_sh1, c_sc1, "ctx"), nc, "ctx")
         xq, xk, xv = qkv(pre(x, x_sh1, x_sc1, "x"), nx, "x")
@@ -155,13 +170,26 @@ class _JointBlock(nn.Module):
         attn = dot_product_attention(q, k, v).reshape(b, nc + nx, dim)
         c_attn, x_attn = attn[:, :nc], attn[:, nc:]
 
-        def post(h_in, a, g1, sh2, sc2, g2, name):
+        x2_attn = None
+        if self.dual_attn:
+            # image-only self-attention on the same pre-norm input,
+            # separately modulated (x_block.attn2.* in the checkpoint)
+            q2, k2, v2 = qkv(pre(x, x2_sh, x2_sc, "x2"), nx, "x2")
+            x2_attn = dot_product_attention(q2, k2, v2).reshape(b, nx, dim)
+
+        def post(h_in, a, g1, sh2, sc2, g2, name, a2=None, g2a=None):
             h_in = (
                 h_in.astype(jnp.float32)
                 + nn.Dense(dim, dtype=self.dtype, name=f"{name}_attn_proj")(
                     a
                 ).astype(jnp.float32) * g1
             )
+            if a2 is not None:
+                # MMDiT-X: the second attention's residual lands
+                # between the joint-attn residual and the MLP
+                h_in = h_in + nn.Dense(
+                    dim, dtype=self.dtype, name=f"{name}2_attn_proj"
+                )(a2).astype(jnp.float32) * g2a
             h = nn.LayerNorm(
                 use_bias=False, use_scale=False, dtype=jnp.float32,
                 name=f"{name}_norm2",
@@ -172,7 +200,10 @@ class _JointBlock(nn.Module):
             y = nn.Dense(dim, dtype=self.dtype, name=f"{name}_mlp_fc2")(h)
             return (h_in + y.astype(jnp.float32) * g2).astype(self.dtype)
 
-        x = post(x, x_attn, x_g1, x_sh2, x_sc2, x_g2, "x")
+        x = post(
+            x, x_attn, x_g1, x_sh2, x_sc2, x_g2, "x",
+            a2=x2_attn, g2a=(x2_g if self.dual_attn else None),
+        )
         if self.pre_only:
             return None, x
         ctx = post(ctx, c_attn, c_g1, c_sh2, c_sc2, c_g2, "ctx")
@@ -263,6 +294,7 @@ class SD3MMDiT(nn.Module):
             pre_only = i == cfg.depth - 1
             ctx_out, img = block_cls(
                 cfg.n_heads, cfg.mlp_width, dt, cfg.qk_norm, pre_only,
+                i < cfg.dual_attn_blocks,
                 name=f"joint_blocks_{i}",
             )(ctx, img, vec)
             if not pre_only:

@@ -695,6 +695,20 @@ def sd3_schedule(cfg, prefix: str = "model.diffusion_model.") -> list[Entry]:
                 (f"{sd}.{tb}.mlp.fc1", f"{fx}/{fb}_mlp_fc1", _LINEAR),
                 (f"{sd}.{tb}.mlp.fc2", f"{fx}/{fb}_mlp_fc2", _LINEAR),
             ]
+            # MMDiT-X (SD3.5-medium): the first dual_attn_blocks
+            # x_blocks carry a second image-only attention (attn2.*;
+            # the block's adaLN linear above is 9-way instead of 6-way
+            # — same key, wider tensor)
+            if tb == "x_block" and i < getattr(cfg, "dual_attn_blocks", 0):
+                entries += [
+                    (f"{sd}.x_block.attn2.qkv", f"{fx}/x2_attn_qkv", _LINEAR),
+                    (f"{sd}.x_block.attn2.proj", f"{fx}/x2_attn_proj", _LINEAR),
+                ]
+                if cfg.qk_norm:
+                    entries += [
+                        (f"{sd}.x_block.attn2.ln_q", f"{fx}/x2_attn_ln_q", "rms"),
+                        (f"{sd}.x_block.attn2.ln_k", f"{fx}/x2_attn_ln_k", "rms"),
+                    ]
     entries += [
         (
             f"{p}final_layer.adaLN_modulation.1",

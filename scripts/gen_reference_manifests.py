@@ -536,11 +536,14 @@ def sd3_dit_manifest(
     in_ch: int = 16,
     p: int = 2,
     time_dim: int = 256,
+    dual_attn_blocks: int = 0,
 ) -> Manifest:
     """SD3/SD3.5 MMDiT under model.diffusion_model.* (the single-file
     layout), following the original mmdit.py construction: conv
     patchify, learned pos table, joint_blocks with a pre_only final
-    context side, SD3.5's per-head ln_q/ln_k when qk_norm."""
+    context side, SD3.5's per-head ln_q/ln_k when qk_norm, and
+    SD3.5-medium's MMDiT-X attn2 branch (9-way x adaLN) in the first
+    dual_attn_blocks x_blocks."""
     hidden = hidden if hidden is not None else 64 * depth
     heads = heads if heads is not None else depth
     hd = hidden // heads
@@ -558,18 +561,30 @@ def sd3_dit_manifest(
     for i in range(depth):
         sd = f"{pfx}joint_blocks.{i}"
         pre = i == depth - 1
+        dual = i < dual_attn_blocks
         for tb in ("context_block", "x_block"):
             _linear(m, f"{sd}.{tb}.attn.qkv", 3 * hidden, hidden)
             if qk_norm:
                 m[f"{sd}.{tb}.attn.ln_q.weight"] = [hd]
                 m[f"{sd}.{tb}.attn.ln_k.weight"] = [hd]
-            n_mod = 2 if (pre and tb == "context_block") else 6
+            if pre and tb == "context_block":
+                n_mod = 2
+            elif dual and tb == "x_block":
+                n_mod = 9
+            else:
+                n_mod = 6
             _linear(m, f"{sd}.{tb}.adaLN_modulation.1", n_mod * hidden, hidden)
             if pre and tb == "context_block":
                 continue
             _linear(m, f"{sd}.{tb}.attn.proj", hidden, hidden)
             _linear(m, f"{sd}.{tb}.mlp.fc1", mlp, hidden)
             _linear(m, f"{sd}.{tb}.mlp.fc2", hidden, mlp)
+            if dual and tb == "x_block":
+                _linear(m, f"{sd}.x_block.attn2.qkv", 3 * hidden, hidden)
+                _linear(m, f"{sd}.x_block.attn2.proj", hidden, hidden)
+                if qk_norm:
+                    m[f"{sd}.x_block.attn2.ln_q.weight"] = [hd]
+                    m[f"{sd}.x_block.attn2.ln_k.weight"] = [hd]
     _linear(m, f"{pfx}final_layer.adaLN_modulation.1", 2 * hidden, hidden)
     _linear(m, f"{pfx}final_layer.linear", p * p * in_ch, hidden)
     return m
@@ -642,6 +657,9 @@ def build_all() -> dict[str, Manifest]:
         "sd3_medium_dit": sd3_dit_manifest(depth=24, qk_norm=False),
         "sd35_large_dit": sd3_dit_manifest(
             depth=38, hidden=2432, heads=38, qk_norm=True
+        ),
+        "sd35_medium_dit": sd3_dit_manifest(
+            depth=24, qk_norm=True, pos_max=384, dual_attn_blocks=13
         ),
         "sd3_vae": vae_manifest(z=16, quant_convs=False),
     }

@@ -263,7 +263,11 @@ def test_wan_vae_schedule_matches_manifest():
 
 @pytest.mark.parametrize(
     "model_name,manifest_name",
-    [("sd3-medium", "sd3_medium_dit"), ("sd35-large", "sd35_large_dit")],
+    [
+        ("sd3-medium", "sd3_medium_dit"),
+        ("sd35-large", "sd35_large_dit"),
+        ("sd35-medium", "sd35_medium_dit"),
+    ],
 )
 def test_sd3_schedule_matches_manifest(model_name, manifest_name):
     derived = _schedule_sd_shapes(
@@ -433,6 +437,18 @@ HAND_PINNED = {
         "model.diffusion_model.x_embedder.proj.weight": (1536, 16, 2, 2),
         "model.diffusion_model.pos_embed": (1, 36864, 1536),
         "model.diffusion_model.joint_blocks.0.x_block.attn.qkv.weight": (4608, 1536),
+        "model.diffusion_model.final_layer.linear.weight": (64, 1536),
+    },
+    "sd35_medium_dit": {
+        # sd3.5_medium.safetensors (MMDiT-X) as listed by checkpoint
+        # inspectors: 384-wide learned pos table, attn2 branch with a
+        # 9-way x adaLN in blocks 0-12, per-head qk RMS everywhere
+        "model.diffusion_model.x_embedder.proj.weight": (1536, 16, 2, 2),
+        "model.diffusion_model.pos_embed": (1, 147456, 1536),
+        "model.diffusion_model.joint_blocks.0.x_block.attn2.qkv.weight": (4608, 1536),
+        "model.diffusion_model.joint_blocks.0.x_block.attn2.ln_q.weight": (64,),
+        "model.diffusion_model.joint_blocks.0.x_block.adaLN_modulation.1.weight": (13824, 1536),
+        "model.diffusion_model.joint_blocks.13.x_block.adaLN_modulation.1.weight": (9216, 1536),
         "model.diffusion_model.final_layer.linear.weight": (64, 1536),
     },
 }

@@ -101,6 +101,86 @@ def test_sd3_schedule_roundtrip_exact(bundle):
         np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
 
 
+@pytest.fixture(scope="module")
+def bundle_x():
+    """tiny MMDiT-X (SD3.5-medium layout): block 0 dual-attention,
+    block 1 plain + pre_only final."""
+    return pl.load_pipeline("tiny-sd35m", seed=0)
+
+
+def test_mmditx_dual_attention_structure(bundle_x):
+    cfg = get_config("tiny-sd35m")
+    flat = flatten_params(jax.device_get(bundle_x.params["unet"]))
+    # dual block: 9-way x adaLN + a second image-only attention with
+    # its own qk-norm (x_block.attn2.* in the published checkpoint)
+    assert flat["params/joint_blocks_0/x_mod_lin/kernel"].shape == (
+        cfg.width, 9 * cfg.width,
+    )
+    for key in ("x2_attn_qkv", "x2_attn_proj", "x2_attn_ln_q", "x2_attn_ln_k"):
+        assert any(
+            k.startswith(f"params/joint_blocks_0/{key}/") for k in flat
+        ), key
+    # plain block: 6-way adaLN, no attn2
+    assert flat["params/joint_blocks_1/x_mod_lin/kernel"].shape == (
+        cfg.width, 6 * cfg.width,
+    )
+    assert not any("joint_blocks_1/x2_" in k for k in flat)
+
+
+def test_txt2img_tiny_sd35m(bundle_x):
+    img = pl.txt2img(
+        bundle_x, "a prompt", height=32, width=32, steps=2, cfg_scale=4.0,
+        sampler="euler", seed=0,
+    )
+    assert img.shape == (1, 32, 32, 3)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_sd35m_schedule_roundtrip_exact(bundle_x):
+    cfg = get_config("tiny-sd35m")
+    flat = flatten_params(jax.device_get(bundle_x.params["unet"]))
+    schedule = sdc.sd3_schedule(cfg)
+    state_dict = sdc.synthesize_state_dict(flat, schedule)
+    converted, missing = sdc.convert_state_dict(state_dict, schedule)
+    assert not missing
+    assert set(converted) == set(flat), (
+        sorted(set(flat) - set(converted))[:5],
+        sorted(set(converted) - set(flat))[:5],
+    )
+    for key in flat:
+        np.testing.assert_array_equal(converted[key], flat[key], err_msg=key)
+
+
+# Genuine key names from the published SD3.5-medium (MMDiT-X) layout.
+SD35M_KNOWN_KEYS = [
+    "model.diffusion_model.joint_blocks.0.x_block.attn2.qkv.weight",
+    "model.diffusion_model.joint_blocks.0.x_block.attn2.qkv.bias",
+    "model.diffusion_model.joint_blocks.0.x_block.attn2.proj.weight",
+    "model.diffusion_model.joint_blocks.0.x_block.attn2.ln_q.weight",
+    "model.diffusion_model.joint_blocks.0.x_block.attn2.ln_k.weight",
+    "model.diffusion_model.joint_blocks.0.x_block.attn.ln_q.weight",
+    "model.diffusion_model.joint_blocks.0.x_block.adaLN_modulation.1.weight",
+]
+
+
+def test_sd35_medium_schedule_covers_real_keys():
+    cfg = get_config("sd35-medium")
+    assert cfg.depth == 24 and cfg.dual_attn_blocks == 13
+    assert cfg.width == 1536 and cfg.pos_embed_max == 384
+    keys = {k for k, _f, _h in sdc._expand(sdc.sd3_schedule(cfg))}
+    missing = [k for k in SD35M_KNOWN_KEYS if k not in keys]
+    assert not missing, missing
+    # attn2 exists exactly for blocks 0..12
+    assert (
+        "model.diffusion_model.joint_blocks.12.x_block.attn2.qkv.weight"
+        in keys
+    )
+    assert (
+        "model.diffusion_model.joint_blocks.13.x_block.attn2.qkv.weight"
+        not in keys
+    )
+
+
 def test_hf_projection_is_sibling_of_text_model():
     """CLIPTextModelWithProjection packs text_projection BESIDE
     text_model — a nested key would fail every real incl_clips file."""
