@@ -52,6 +52,19 @@ _SLOW_PATHS = (
     "tests/golden",
 )
 
+# Middle tier (r4 VERDICT item 4): the end-to-end paths that should run
+# per-commit without paying the ~hour full suite — 2-server HTTP E2E,
+# USDU-elastic-over-HTTP, 2-process DCN multihost, and the --quick
+# golden freeze. `pytest -m "fast or integration"` targets <10 min on a
+# 1-core box. These files also stay in the slow tier (the full suite is
+# unchanged); they simply gain the extra marker.
+_INTEGRATION_PATHS = (
+    "tests/api/test_integration.py",
+    "tests/api/test_usdu_integration.py",
+    "tests/parallel/test_multihost.py",
+    "tests/golden/test_goldens_quick.py",
+)
+
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
@@ -60,6 +73,10 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.fast)
+        if any(
+            rel == p or rel.startswith(p + "/") for p in _INTEGRATION_PATHS
+        ):
+            item.add_marker(pytest.mark.integration)
 
 
 @pytest.fixture()

@@ -1,8 +1,9 @@
 """End-to-end numeric freeze against committed goldens.
 
-tests/golden/goldens.npz pins the outputs of the three canonical
-pipelines on tiny models — txt2img (UNet+CLIP+VAE+sampler), USDU tiled
-upscale (plan/extract/diffuse/blend), t2v (DiT+causal-3D-VAE) —
+tests/golden/goldens.npz pins the outputs of every canonical pipeline
+on tiny models — txt2img, USDU tiled upscale, t2v, Flux/SD3 rectified
+flow, inpaint/outpaint, hi-res-fix, Kontext editing, v-prediction, and
+the beta/kl_optimal schedules (see scripts/gen_goldens.py) —
 generated once by scripts/gen_goldens.py and committed. Any refactor
 of samplers / schedulers / VAE / tokenizer / blend that shifts
 end-to-end numerics fails here loudly: the substitute for the implicit
@@ -44,7 +45,7 @@ def test_pipelines_match_goldens():
     env.pop("CDT_BLEND", None)
     proc = subprocess.run(
         [sys.executable, _SCRIPT, "--check"],
-        capture_output=True, text=True, timeout=1200, cwd=_REPO, env=env,
+        capture_output=True, text=True, timeout=1800, cwd=_REPO, env=env,
     )
     sys.stdout.write(proc.stdout)
     assert proc.returncode == 0, (
