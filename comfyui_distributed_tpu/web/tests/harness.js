@@ -42,6 +42,23 @@ export async function assertThrows(fn, msg) {
   throw new Error(msg || "expected an exception");
 }
 
+/** Load a shared JSON test-vector file (web/tests/vectors/<name>.json)
+ * under either runtime: node reads from disk, the browser runner
+ * fetches relative to this module (runner.html is served over http —
+ * ES modules don't load from file:// anyway). The SAME files are
+ * structurally validated and mirror-executed by the Python CI net
+ * (tests/test_web_js.py), so a node-less CI and an operator box with
+ * node check identical behavior. */
+export async function loadVectors(name) {
+  const url = new URL(`./vectors/${name}.json`, import.meta.url);
+  if (typeof window === "undefined") {
+    const { readFile } = await import("node:fs/promises");
+    return JSON.parse(await readFile(url, "utf-8"));
+  }
+  const resp = await fetch(url);
+  return resp.json();
+}
+
 export async function runAll(log = console.log) {
   let failed = 0;
   for (const { name, fn } of registry) {

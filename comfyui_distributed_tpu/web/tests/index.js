@@ -9,5 +9,6 @@ import "./apiClient.test.js";
 import "./state.test.js";
 import "./widgets.test.js";
 import "./render.test.js";
+import "./vectors.test.js";
 
 export { registry, runAll } from "./harness.js";

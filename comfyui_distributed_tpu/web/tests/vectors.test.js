@@ -1,0 +1,29 @@
+/* Shared-vector execution: every case in web/tests/vectors/*.json is
+ * run against the real module functions. The same JSON files are
+ * mirror-executed in Python by tests/test_web_js.py, so the expected
+ * outputs here are independently validated even on CI images with no
+ * JS runtime (reference parallel: web/tests under vitest in the
+ * reference's CI). */
+
+"use strict";
+
+import { assertEqual, loadVectors, test } from "./harness.js";
+import * as urlUtils from "../modules/urlUtils.js";
+import * as widgets from "../modules/widgets.js";
+
+const MODULES = { urlUtils, widgets };
+export const VECTOR_FILES = ["urlUtils", "widgets"];
+
+for (const name of VECTOR_FILES) {
+  test(`vectors: ${name}`, async () => {
+    const spec = await loadVectors(name);
+    const mod = MODULES[spec.module];
+    if (!mod) throw new Error(`unknown vector module ${spec.module}`);
+    if (!spec.cases.length) throw new Error(`${name}: empty vector file`);
+    for (const [i, c] of spec.cases.entries()) {
+      let got = mod[c.fn](...c.args);
+      if (c.parseResult && got !== null) got = JSON.parse(got);
+      assertEqual(got, c.want, `${name}[${i}] ${c.fn}`);
+    }
+  });
+}
