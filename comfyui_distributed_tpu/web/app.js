@@ -28,15 +28,19 @@ import {
   clampDividerParts,
   collectOverrides,
   MAX_DIVIDER_OUTPUTS,
-  nextWorkerDefaults,
+  newWorkerTemplate,
   parseChipList,
   parseWorkflowText,
   patchWorkflowText,
 } from "./modules/widgets.js";
 import {
+  networkInfoHtml,
   renderVocabBanner,
   renderWorkers,
   renderWorkflowNodes,
+  topologyHtml,
+  WORKER_FORM_FIELDS,
+  workerFormHtml,
 } from "./modules/render.js";
 import { escapeHtml, workerUrl } from "./modules/urlUtils.js";
 
@@ -153,15 +157,7 @@ async function renderTopology() {
   try {
     const info = await api("/distributed/system_info");
     state.topoChips = (info.topology?.devices || []).map((d) => d.id);
-    const topo = info.topology || {};
-    const container = document.getElementById("topology");
-    const chips = (topo.devices || [])
-      .map((d) => `<span class="chip">${escapeHtml(d.platform)}:${d.id}</span>`)
-      .join("");
-    container.innerHTML =
-      `platform <b>${escapeHtml(topo.platform)}</b> · ` +
-      `${topo.local_device_count}/${topo.device_count} local chips · ` +
-      `host ${escapeHtml(info.machine_id)}<br>${chips}`;
+    document.getElementById("topology").innerHTML = topologyHtml(info);
     renderVocabBanner(
       document.getElementById("vocab-banner"),
       info,
@@ -181,32 +177,15 @@ async function renderTopology() {
 // ---------- worker CRUD ----------
 
 function workerForm(existing) {
-  const worker = existing || {
-    id: `w${Date.now() % 100000}`,
-    name: "",
-    type: "local",
-    host: "127.0.0.1",
-    ...(() => {
-      const d = nextWorkerDefaults(state.config?.workers, state.topoChips);
-      return { port: d.port, tpu_chips: d.chip };
-    })(),
-    enabled: true,
-    extra_args: "",
-  };
-  const fields = ["id", "name", "type", "host", "port", "extra_args"];
-  const html = fields
-    .map(
-      (f) => `<div class="row"><label style="width:90px">${f}</label>
-        <input type="text" id="wf-${f}" value="${escapeHtml(worker[f] ?? "")}"></div>`
-    )
-    .join("") +
-    `<div class="row"><label style="width:90px">tpu_chips</label>
-      <input type="text" id="wf-tpu_chips" value="${(worker.tpu_chips || []).join(",")}"></div>
-     <div class="row"><button class="primary" id="wf-save">Save</button></div>`;
-  showModal(existing ? `Edit ${worker.id}` : "Add worker", html);
+  const worker = existing || newWorkerTemplate(
+    state.config?.workers, state.topoChips, Date.now() % 100000
+  );
+  showModal(
+    existing ? `Edit ${worker.id}` : "Add worker", workerFormHtml(worker)
+  );
   document.getElementById("wf-save").addEventListener("click", async () => {
     const body = { enabled: worker.enabled };
-    for (const f of fields) {
+    for (const f of WORKER_FORM_FIELDS) {
       let value = document.getElementById(`wf-${f}`).value;
       if (f === "port") value = Number(value) || 0;
       body[f] = value;
@@ -337,18 +316,12 @@ async function renderNetworkInfo() {
   const container = document.getElementById("network-info");
   try {
     const info = await api("/distributed/network_info");
-    const master = state.config?.master || {};
     const autoCount = (state.config?.workers || []).filter(
       (w) => w.auto_populated
     ).length;
-    container.innerHTML =
-      `recommended master IP: <b>${escapeHtml(info.recommended)}</b> ` +
-      `<button class="small" id="use-recommended-ip">use as master host</button>` +
-      `<br>current master host: ${escapeHtml(master.host || "(unset)")}` +
-      `<br>candidates: ${(info.candidates || []).map(escapeHtml).join(", ")}` +
-      (autoCount
-        ? `<br>${autoCount} worker(s) auto-populated for spare chips`
-        : "");
+    container.innerHTML = networkInfoHtml(
+      info, state.config?.master?.host, autoCount
+    );
     const btn = document.getElementById("use-recommended-ip");
     if (btn)
       btn.addEventListener("click", async () => {

@@ -78,19 +78,74 @@ export function dividerNodeHtml(nodeId, node) {
             ${divideBy} of ${MAX_DIVIDER_OUTPUTS} outputs carry data</span></div>`;
 }
 
-/** Tokenizer-fidelity warning (round-3 verdict item 5): shown when
- * /distributed/system_info reports clip_vocab_canonical=false — the
- * committed stand-in vocab produces wrong token ids for real SD/SDXL
- * checkpoints until scripts/fetch_clip_vocab.py installs OpenAI's
- * table. Returns "" when the vocab is canonical or state unknown. */
+/** Tokenizer-fidelity warning (round-3 verdict item 5; T5 added round
+ * 5): shown when /distributed/system_info reports
+ * clip_vocab_canonical=false and/or t5_vocab_canonical=false — the
+ * committed stand-in vocab (CLIP) / fallback ids (T5) produce wrong
+ * conditioning for real checkpoints until the exact assets are
+ * installed. Returns "" when both are canonical or state unknown. */
 export function vocabBannerHtml(info) {
-  if (!info || info.clip_vocab_canonical !== false) return "";
-  return `
-    <span><b>CLIP vocab is a stand-in:</b> real SD/SDXL checkpoints will
+  if (!info) return "";
+  const clipBad = info.clip_vocab_canonical === false;
+  const t5Bad = info.t5_vocab_canonical === false;
+  if (!clipBad && !t5Bad) return "";
+  const parts = [];
+  if (clipBad) {
+    parts.push(`<b>CLIP vocab is a stand-in:</b> real SD/SDXL checkpoints will
     produce wrong images. Run <code>python scripts/fetch_clip_vocab.py</code>
     on this host (or set <code>CDT_CLIP_VOCAB</code>) to install OpenAI's
-    published table.</span>
+    published table.`);
+  }
+  if (t5Bad) {
+    parts.push(`<b>T5 vocab not configured:</b> Flux/SD3/WAN conditioning
+    falls back to placeholder ids. Point <code>CDT_T5_SPM</code> at the
+    model's sentencepiece vocab for real-checkpoint fidelity.`);
+  }
+  return `
+    <span>${parts.join("<br>")}</span>
     <button class="small" id="vocab-banner-dismiss">dismiss</button>`;
+}
+
+/** Topology summary line (pure; app.js renderTopology applies it). */
+export function topologyHtml(info) {
+  const topo = info.topology || {};
+  const chips = (topo.devices || [])
+    .map((d) => `<span class="chip">${escapeHtml(d.platform)}:${d.id}</span>`)
+    .join("");
+  return (
+    `platform <b>${escapeHtml(topo.platform)}</b> · ` +
+    `${topo.local_device_count}/${topo.device_count} local chips · ` +
+    `host ${escapeHtml(info.machine_id)}<br>${chips}`
+  );
+}
+
+/** Master-detection block (reference web/masterDetection.js). */
+export function networkInfoHtml(info, masterHost, autoCount) {
+  return (
+    `recommended master IP: <b>${escapeHtml(info.recommended)}</b> ` +
+    `<button class="small" id="use-recommended-ip">use as master host</button>` +
+    `<br>current master host: ${escapeHtml(masterHost || "(unset)")}` +
+    `<br>candidates: ${(info.candidates || []).map(escapeHtml).join(", ")}` +
+    (autoCount
+      ? `<br>${autoCount} worker(s) auto-populated for spare chips`
+      : "")
+  );
+}
+
+/** Add/edit worker modal body (pure; app.js workerForm applies it and
+ * attaches the save handler). */
+export const WORKER_FORM_FIELDS = ["id", "name", "type", "host", "port", "extra_args"];
+
+export function workerFormHtml(worker) {
+  return (
+    WORKER_FORM_FIELDS.map(
+      (f) => `<div class="row"><label style="width:90px">${f}</label>
+        <input type="text" id="wf-${f}" value="${escapeHtml(worker[f] ?? "")}"></div>`
+    ).join("") +
+    `<div class="row"><label style="width:90px">tpu_chips</label>
+      <input type="text" id="wf-tpu_chips" value="${(worker.tpu_chips || []).join(",")}"></div>
+     <div class="row"><button class="primary" id="wf-save">Save</button></div>`
+  );
 }
 
 // ---------- DOM appliers (the only innerHTML writes) ----------

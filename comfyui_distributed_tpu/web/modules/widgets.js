@@ -70,6 +70,22 @@ export function nextWorkerDefaults(workers, topoChips) {
   return { port, chip: chips.length ? [chips[0]] : [] };
 }
 
+/** Default object for a brand-new worker (pure; the caller supplies
+ * the id suffix so tests stay deterministic). */
+export function newWorkerTemplate(workers, topoChips, idSuffix) {
+  const d = nextWorkerDefaults(workers, topoChips);
+  return {
+    id: `w${idSuffix}`,
+    name: "",
+    type: "local",
+    host: "127.0.0.1",
+    port: d.port,
+    tpu_chips: d.chip,
+    enabled: true,
+    extra_args: "",
+  };
+}
+
 /** Parse a comma-separated chip list from the worker form. */
 export function parseChipList(text) {
   return String(text || "")

@@ -6,10 +6,14 @@
 import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
   dividerNodeHtml,
+  networkInfoHtml,
+  topologyHtml,
   valueNodeHtml,
   vocabBannerHtml,
   workerCardHtml,
+  workerFormHtml,
   workerStatusParts,
+  WORKER_FORM_FIELDS,
 } from "../modules/render.js";
 
 test("workerStatusParts: online / busy / launching / offline", () => {
@@ -84,4 +88,59 @@ test("vocabBannerHtml: only a non-canonical vocab raises the banner", () => {
   const html = vocabBannerHtml({ clip_vocab_canonical: false });
   assertIncludes(html, "fetch_clip_vocab.py");
   assertIncludes(html, 'id="vocab-banner-dismiss"');
+});
+
+test("vocabBannerHtml: T5 fallback raises its own banner line", () => {
+  assertEqual(
+    vocabBannerHtml({ clip_vocab_canonical: true, t5_vocab_canonical: true }),
+    ""
+  );
+  const t5Only = vocabBannerHtml({
+    clip_vocab_canonical: true, t5_vocab_canonical: false,
+  });
+  assertIncludes(t5Only, "CDT_T5_SPM");
+  assert(!t5Only.includes("fetch_clip_vocab"), "clip line absent");
+  const both = vocabBannerHtml({
+    clip_vocab_canonical: false, t5_vocab_canonical: false,
+  });
+  assertIncludes(both, "CDT_T5_SPM");
+  assertIncludes(both, "fetch_clip_vocab.py");
+});
+
+test("topologyHtml summarizes platform, counts, host and chips", () => {
+  const html = topologyHtml({
+    machine_id: "host-1",
+    topology: {
+      platform: "tpu", device_count: 8, local_device_count: 4,
+      devices: [{ platform: "tpu", id: 0 }, { platform: "tpu", id: 1 }],
+    },
+  });
+  assertIncludes(html, "platform <b>tpu</b>");
+  assertIncludes(html, "4/8 local chips");
+  assertIncludes(html, "host host-1");
+  assertIncludes(html, '<span class="chip">tpu:0</span>');
+});
+
+test("networkInfoHtml: recommended IP, master host fallback, auto count", () => {
+  const html = networkInfoHtml(
+    { recommended: "10.0.0.5", candidates: ["10.0.0.5", "192.168.1.2"] },
+    undefined, 2
+  );
+  assertIncludes(html, "<b>10.0.0.5</b>");
+  assertIncludes(html, 'id="use-recommended-ip"');
+  assertIncludes(html, "current master host: (unset)");
+  assertIncludes(html, "2 worker(s) auto-populated");
+  const none = networkInfoHtml({ recommended: "h", candidates: [] }, "m", 0);
+  assert(!none.includes("auto-populated"), "no auto row when count is 0");
+});
+
+test("workerFormHtml: one input per field + chips + save button", () => {
+  const html = workerFormHtml({
+    id: "w9", name: "n", type: "local", host: "127.0.0.1", port: 8191,
+    tpu_chips: [0, 2], extra_args: "",
+  });
+  for (const f of WORKER_FORM_FIELDS) assertIncludes(html, `id="wf-${f}"`);
+  assertIncludes(html, 'id="wf-tpu_chips"');
+  assertIncludes(html, 'value="0,2"');
+  assertIncludes(html, 'id="wf-save"');
 });

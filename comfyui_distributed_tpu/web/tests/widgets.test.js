@@ -9,6 +9,7 @@ import {
   clampDividerParts,
   collectOverrides,
   findWidgetNodes,
+  newWorkerTemplate,
   nextWorkerDefaults,
   parseChipList,
   parseWorkflowText,
@@ -73,6 +74,16 @@ test("nextWorkerDefaults: next port above max, first unclaimed chip", () => {
 test("nextWorkerDefaults: empty config starts at 8189, no chips known", () => {
   assertEqual(nextWorkerDefaults([], []), { port: 8189, chip: [] });
   assertEqual(nextWorkerDefaults(undefined, undefined), { port: 8189, chip: [] });
+});
+
+test("newWorkerTemplate: deterministic defaults from config + topology", () => {
+  assertEqual(
+    newWorkerTemplate([{ port: 8189, tpu_chips: [0] }], [0, 1], 42),
+    {
+      id: "w42", name: "", type: "local", host: "127.0.0.1",
+      port: 8190, tpu_chips: [1], enabled: true, extra_args: "",
+    }
+  );
 });
 
 test("parseChipList tolerates spaces, junk, and empties", () => {
