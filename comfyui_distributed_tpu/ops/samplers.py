@@ -149,7 +149,11 @@ def _beta_ppf(q, a: float, b: float, iters: int = 60):
     hi = np.ones_like(q)
     for _ in range(iters):
         mid = 0.5 * (lo + hi)
-        cdf = np.asarray(betainc(a, b, mid), np.float64)
+        # schedules must stay concrete at trace time (module contract):
+        # inputs here are concrete numpy, so force eager evaluation
+        # even when a caller builds the schedule inside a jit trace
+        with jax.ensure_compile_time_eval():
+            cdf = np.asarray(betainc(a, b, mid), np.float64)
         lo = np.where(cdf < q, mid, lo)
         hi = np.where(cdf < q, hi, mid)
     return 0.5 * (lo + hi)
