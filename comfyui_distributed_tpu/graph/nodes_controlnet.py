@@ -121,6 +121,67 @@ class FluxGuidance:
 
 
 @register_node
+class SkipLayerGuidanceSD3:
+    """Skip-layer guidance for SD3.5-class models (ComfyUI
+    SkipLayerGuidanceSD3 parity): during the [start_percent,
+    end_percent] window the guidance result gains
+    scale * (cond - cond_with_listed_joint_blocks_skipped). Returns a
+    patched MODEL (new bundle instance — one extra compile, then the
+    whole trajectory is still a single XLA program: the window gate is
+    arithmetic, the skip set is a compile-time constant)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "layers": ("STRING", {"default": "7, 8, 9"}),
+                "scale": ("FLOAT", {"default": 3.0}),
+                "start_percent": ("FLOAT", {"default": 0.01}),
+                "end_percent": ("FLOAT", {"default": 0.15}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "skip_guidance"
+
+    def skip_guidance(self, model, layers="7, 8, 9", scale=3.0,
+                      start_percent=0.01, end_percent=0.15, context=None):
+        import dataclasses
+
+        from ..models import pipeline as pl
+        from ..models.registry import model_family
+
+        if model_family(model.model_name) != "sd3":
+            raise ValueError(
+                "SkipLayerGuidanceSD3 applies to SD3-class MMDiT models; "
+                f"{model.model_name!r} is not one"
+            )
+        depth = get_config(model.model_name).depth
+        layer_tuple = tuple(sorted({
+            int(part) for part in str(layers).split(",") if part.strip()
+        }))
+        bad = [i for i in layer_tuple if not 0 <= i < depth]
+        if bad:
+            raise ValueError(
+                f"skip layers {bad} out of range for depth-{depth} model"
+            )
+        if not layer_tuple or float(scale) == 0.0:
+            return (model,)
+        return (
+            dataclasses.replace(
+                model,
+                slg=pl.SLGSpec(
+                    layers=layer_tuple,
+                    scale=float(scale),
+                    start_percent=float(start_percent),
+                    end_percent=float(end_percent),
+                ),
+            ),
+        )
+
+
+@register_node
 class ReferenceLatent:
     """Attach reference latents to conditioning (Flux-Kontext editing;
     ComfyUI ReferenceLatent parity). USDU windows them per tile

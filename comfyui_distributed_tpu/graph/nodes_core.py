@@ -347,7 +347,7 @@ class KSampler:
             noise_key, anc_key = jax.random.split(key)
             noise = jax.random.normal(noise_key, base.shape)
             x = smp.noise_latents(param, base, noise, sigmas[0])
-            model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
+            model_fn = pl.guided_model(bundle, params, float(cfg))
             if mask_arr is not None:
                 model_fn = smp.masked_inpaint_model(
                     model_fn, param, base, noise, mask_arr

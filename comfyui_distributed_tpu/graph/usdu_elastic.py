@@ -267,7 +267,7 @@ def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
         x = smp.noise_latents(
             param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
         )
-        model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), float(cfg))
+        model_fn = pl.guided_model(bundle, params, float(cfg))
         z_out = smp.sample(
             model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key,
             flow=(param == "flow"),

@@ -46,6 +46,22 @@ class PipelineBundle:
     te_name: str | None = None
     te2_name: str | None = None
     te3_name: str | None = None
+    # skip-layer guidance (SD3.5): set by the SkipLayerGuidanceSD3
+    # node via dataclasses.replace — a new bundle instance, so the
+    # jitted samplers recompile for the patched model exactly once
+    slg: "SLGSpec | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SLGSpec:
+    """Skip-layer guidance parameters (reference SkipLayerGuidanceDiT:
+    scale * (cond - cond_with_layers_skipped) over a sampling-progress
+    window)."""
+
+    layers: tuple
+    scale: float = 3.0
+    start_percent: float = 0.01
+    end_percent: float = 0.15
 
 
 def load_pipeline(
@@ -342,7 +358,7 @@ def model_schedule_info(bundle: PipelineBundle) -> tuple[str, float]:
     )
 
 
-def _make_model_fn(bundle: PipelineBundle, params):
+def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
@@ -433,6 +449,10 @@ def _make_model_fn(bundle: PipelineBundle, params):
                 kwargs["ref_latents"] = [
                     r.astype(x.dtype) for r in cond.reference_latents
                 ]
+            if skip_layers:
+                # skip-layer guidance pass (SD3-class only; the node
+                # guards the family)
+                kwargs["skip_layers"] = tuple(skip_layers)
             out = bundle.unet.apply(
                 params["unet"], x, sigma_batch, context, y=y, guidance=g,
                 **kwargs,
@@ -457,6 +477,25 @@ def _make_model_fn(bundle: PipelineBundle, params):
         return out.astype(x.dtype)
 
     return model_fn
+
+
+def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
+    """The guidance composition every sampling path shares: CFG, plus
+    skip-layer guidance when the bundle carries an SLGSpec (set by the
+    SkipLayerGuidanceSD3 node)."""
+    base_fn = _make_model_fn(bundle, params)
+    slg = getattr(bundle, "slg", None)
+    if not slg:
+        return smp.cfg_model(base_fn, cfg_scale)
+    param, shift = model_schedule_info(bundle)
+    return smp.slg_cfg_model(
+        base_fn,
+        _make_model_fn(bundle, params, skip_layers=slg.layers),
+        cfg_scale,
+        slg.scale,
+        smp.percent_to_sigma(slg.start_percent, param, shift),
+        smp.percent_to_sigma(slg.end_percent, param, shift),
+    )
 
 
 # --- generation ----------------------------------------------------------
@@ -490,7 +529,7 @@ def _txt2img_jit(
     x = jax.random.normal(
         noise_key, (batch, lh, lw, bundle.latent_channels)
     ) * sigmas[0]
-    model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
+    model = guided_model(bundle, params, cfg_scale)
     latents = smp.sample(
         model, x, sigmas, (context_pos, context_neg), sampler, anc_key,
         flow=(param == "flow"),
@@ -529,7 +568,7 @@ def txt2img_flops(
         params = bundle.params
 
         def eval_fn(params, z, pos, neg):
-            model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
+            model = guided_model(bundle, params, cfg_scale)
             return model(
                 z, jnp.broadcast_to(sigmas[0], (z.shape[0],)), (pos, neg)
             )
@@ -625,7 +664,7 @@ def _img2img_jit(
     noise_key, anc_key = jax.random.split(key)
     noise = jax.random.normal(noise_key, latents.shape)
     x = smp.noise_latents(param, latents, noise, sigmas[0])
-    model = smp.cfg_model(_make_model_fn(bundle, params), cfg_scale)
+    model = guided_model(bundle, params, cfg_scale)
     if noise_mask is not None:
         # inpainting (reference-substrate SetLatentNoiseMask /
         # VAEEncodeForInpaint semantics)

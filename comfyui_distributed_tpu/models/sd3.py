@@ -223,6 +223,7 @@ class SD3MMDiT(nn.Module):
         control: jax.Array | None = None,  # rejected (no SD3 ControlNet path)
         guidance: jax.Array | None = None,  # accepted, unused (CFG family)
         ref_latents: list | None = None,   # rejected (Kontext is Flux-only)
+        skip_layers: tuple = (),           # SLG: joint blocks to bypass
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -291,6 +292,11 @@ class SD3MMDiT(nn.Module):
             nn.remat(_JointBlock, static_argnums=()) if cfg.remat else _JointBlock
         )
         for i in range(cfg.depth):
+            if i in skip_layers:
+                # skip-layer guidance: the whole joint block is
+                # bypassed (static python control flow — skip sets are
+                # compile-time constants, one program per set)
+                continue
             pre_only = i == cfg.depth - 1
             ctx_out, img = block_cls(
                 cfg.n_heads, cfg.mlp_width, dt, cfg.qk_norm, pre_only,

@@ -271,39 +271,88 @@ def sigma_to_timestep(sigma: jax.Array) -> jax.Array:
     ).astype(jnp.float32)
 
 
+def percent_to_sigma(
+    percent: float, parameterization: str = "eps", shift: float = 3.0
+) -> float:
+    """Sampling-progress percent (0 = schedule start / sigma_max,
+    1 = end) → sigma, per model family — the reference stack's
+    model_sampling.percent_to_sigma, used to gate sigma-ranged model
+    patches (skip-layer guidance)."""
+    p = float(percent)
+    if p <= 0.0:
+        return float("inf")
+    if p >= 1.0:
+        return 0.0
+    if parameterization == "flow":
+        t = 1.0 - p
+        return float(shift * t / (1.0 + (shift - 1.0) * t))
+    table = _vp_sigmas()
+    return float(table[round((1.0 - p) * (len(table) - 1))])
+
+
 # --- CFG wrapper ---------------------------------------------------------
 
-def cfg_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
-    """Classifier-free guidance: cond is (positive, negative) pair.
-
-    Batches the two passes into one model call (2B batch) — on TPU one
-    big MXU matmul beats two small ones.
-    """
+def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond):
+    """One CFG evaluation: returns (eps_pos, guided_eps). Batches the
+    cond/uncond passes into one model call (2B batch) — on TPU one big
+    MXU matmul beats two small ones. Shared by cfg_model and
+    slg_cfg_model (which also needs the bare eps_pos)."""
+    pos, neg = cond
     if cfg_scale == 1.0:
-        def passthrough(x, sigma, cond):
-            pos, _ = cond
-            return model_fn(x, sigma, pos)
-        return passthrough
+        eps_pos = model_fn(x, sigma, pos)
+        return eps_pos, eps_pos
+    same_structure = jax.tree_util.tree_structure(
+        pos
+    ) == jax.tree_util.tree_structure(neg)
+    if same_structure:
+        x2 = jnp.concatenate([x, x], axis=0)
+        s2 = jnp.concatenate([sigma, sigma], axis=0)
+        c2 = jax.tree_util.tree_map(
+            lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
+        )
+        eps2 = model_fn(x2, s2, c2)
+        eps_pos, eps_neg = jnp.split(eps2, 2, axis=0)
+    else:
+        # structurally different conditioning (e.g. ControlNet hint
+        # only on the positive side): two passes
+        eps_pos = model_fn(x, sigma, pos)
+        eps_neg = model_fn(x, sigma, neg)
+    return eps_pos, eps_neg + cfg_scale * (eps_pos - eps_neg)
+
+
+def cfg_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
+    """Classifier-free guidance: cond is (positive, negative) pair."""
 
     def guided(x, sigma, cond):
-        pos, neg = cond
-        same_structure = jax.tree_util.tree_structure(
-            pos
-        ) == jax.tree_util.tree_structure(neg)
-        if same_structure:
-            x2 = jnp.concatenate([x, x], axis=0)
-            s2 = jnp.concatenate([sigma, sigma], axis=0)
-            c2 = jax.tree_util.tree_map(
-                lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
-            )
-            eps2 = model_fn(x2, s2, c2)
-            eps_pos, eps_neg = jnp.split(eps2, 2, axis=0)
-        else:
-            # structurally different conditioning (e.g. ControlNet hint
-            # only on the positive side): two passes
-            eps_pos = model_fn(x, sigma, pos)
-            eps_neg = model_fn(x, sigma, neg)
-        return eps_neg + cfg_scale * (eps_pos - eps_neg)
+        _eps_pos, out = _cfg_eval(model_fn, cfg_scale, x, sigma, cond)
+        return out
+
+    return guided
+
+
+def slg_cfg_model(
+    model_fn: ModelFn,
+    skip_model_fn: ModelFn,
+    cfg_scale: float,
+    slg_scale: float,
+    sigma_start: float,
+    sigma_end: float,
+) -> ModelFn:
+    """CFG plus SD3.5 skip-layer guidance: the result gains
+    slg_scale * (cond - cond_with_skipped_layers) while sigma is in
+    [sigma_end, sigma_start] (the reference's SkipLayerGuidanceDiT
+    patch, composed in eps space under this framework's sampler
+    contract). The gate is arithmetic, not control flow, so the whole
+    trajectory still compiles to one XLA program."""
+
+    def guided(x, sigma, cond):
+        pos, _neg = cond
+        eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond)
+        eps_skip = skip_model_fn(x, sigma, pos)
+        gate = (
+            (sigma >= sigma_end) & (sigma <= sigma_start)
+        ).astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+        return base + gate * slg_scale * (eps_pos - eps_skip)
 
     return guided
 

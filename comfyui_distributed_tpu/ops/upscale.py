@@ -319,7 +319,7 @@ def _process_tile_fn(bundle, grid, steps, sampler, scheduler, cfg, denoise,
         x = smp.noise_latents(
             param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
         )
-        model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
+        model_fn = pl.guided_model(bundle, params, cfg)
         z_out = smp.sample(
             model_fn, x, sigmas, (pos_t, neg_t), sampler, anc_key,
             flow=(param == "flow"),
@@ -620,7 +620,7 @@ def _jitted_for_flops(
         z1 = jnp.zeros(z_spec.shape, z_spec.dtype)
 
         def eval_fn(params, z, pos, neg):
-            model_fn = smp.cfg_model(pl._make_model_fn(bundle, params), cfg)
+            model_fn = pl.guided_model(bundle, params, cfg)
             pos_t = tile_cond(pos, jnp.int32(0), jnp.int32(0), grid)
             neg_t = tile_cond(neg, jnp.int32(0), jnp.int32(0), grid)
             return jax.vmap(

@@ -58,7 +58,7 @@ def _parallel_txt2img_jit(
         x = jax.random.normal(
             noise_key, (batch_per_device, lh, lw, chans)
         ) * sigmas[0]
-        model = smp.cfg_model(pl._make_model_fn(bundle, params), cfg_scale)
+        model = pl.guided_model(bundle, params, cfg_scale)
         latents = smp.sample(
             model, x, sigmas, (pos, neg), sampler, anc_key,
             flow=(param == "flow"),

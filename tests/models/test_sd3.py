@@ -181,6 +181,118 @@ def test_sd35_medium_schedule_covers_real_keys():
     )
 
 
+def test_skip_layer_guidance(bundle_x):
+    """SLG (reference SkipLayerGuidanceSD3): a patched bundle changes
+    the output inside its percent window, leaves it bit-identical when
+    the window covers no schedule sigma, and the node validates family
+    and layer range."""
+    from comfyui_distributed_tpu.graph.nodes_controlnet import (
+        SkipLayerGuidanceSD3,
+    )
+
+    base = np.asarray(
+        pl.txt2img(
+            bundle_x, "p", height=32, width=32, steps=2, cfg_scale=4.0,
+            seed=0,
+        )
+    )
+    (patched,) = SkipLayerGuidanceSD3().skip_guidance(
+        bundle_x, layers="0", scale=3.0, start_percent=0.0, end_percent=0.5
+    )
+    slg = np.asarray(
+        pl.txt2img(
+            patched, "p", height=32, width=32, steps=2, cfg_scale=4.0,
+            seed=0,
+        )
+    )
+    assert not np.allclose(base, slg)
+    assert np.isfinite(slg).all()
+
+    # a window past the schedule's sigmas still runs (the skip pass is
+    # unconditional, the gate is arithmetic) and stays finite; exact
+    # equality with the unpatched program is NOT asserted at this
+    # level — two differently-fused XLA programs legitimately differ
+    # in float rounding (see test_slg_gate_semantics for the exact
+    # gating contract)
+    (inactive,) = SkipLayerGuidanceSD3().skip_guidance(
+        bundle_x, layers="0", scale=3.0, start_percent=0.99,
+        end_percent=1.0,
+    )
+    out_inactive = np.asarray(
+        pl.txt2img(
+            inactive, "p", height=32, width=32, steps=2, cfg_scale=4.0,
+            seed=0,
+        )
+    )
+    np.testing.assert_allclose(base, out_inactive, atol=5e-2)
+
+    # empty layer list / zero scale are no-op passthroughs
+    (noop,) = SkipLayerGuidanceSD3().skip_guidance(bundle_x, layers="")
+    assert noop is bundle_x
+    (noop2,) = SkipLayerGuidanceSD3().skip_guidance(
+        bundle_x, layers="0", scale=0.0
+    )
+    assert noop2 is bundle_x
+
+    with pytest.raises(ValueError, match="out of range"):
+        SkipLayerGuidanceSD3().skip_guidance(bundle_x, layers="99")
+    with pytest.raises(ValueError, match="SD3-class"):
+        SkipLayerGuidanceSD3().skip_guidance(
+            pl.load_pipeline("tiny-unet"), layers="0"
+        )
+
+
+def test_slg_gate_semantics():
+    """Exact gating contract of slg_cfg_model on a toy model (eager
+    arithmetic — no cross-program XLA rounding): inside the sigma
+    window the correction applies, outside the result equals plain
+    CFG bit-for-bit."""
+    from comfyui_distributed_tpu.ops import samplers as smp
+
+    def model(x, sigma, cond):
+        return x * cond
+
+    def skip_model(x, sigma, cond):
+        return x * cond + 1.0
+
+    x = jnp.ones((2, 4))
+    # batch-major conditioning so the CFG batcher can concatenate
+    cond = (jnp.full((2, 1), 2.0), jnp.full((2, 1), 0.5))
+    guided = smp.slg_cfg_model(
+        model, skip_model, cfg_scale=4.0, slg_scale=3.0,
+        sigma_start=0.8, sigma_end=0.2,
+    )
+    plain = smp.cfg_model(model, 4.0)
+    sig_in = jnp.full((2,), 0.5)   # inside [0.2, 0.8]
+    sig_out = jnp.full((2,), 0.9)  # outside
+    np.testing.assert_array_equal(
+        np.asarray(guided(x, sig_out, cond)),
+        np.asarray(plain(x, sig_out, cond)),
+    )
+    # inside: plain + slg_scale * (cond - skip) = plain + 3 * (-1)
+    np.testing.assert_allclose(
+        np.asarray(guided(x, sig_in, cond)),
+        np.asarray(plain(x, sig_in, cond)) - 3.0,
+        rtol=1e-6,
+    )
+
+
+def test_percent_to_sigma_families():
+    from comfyui_distributed_tpu.ops import samplers as smp
+
+    # flow: percent walks the shifted grid from sigma_max=1 to 0
+    assert smp.percent_to_sigma(0.0, "flow", 3.0) == float("inf")
+    assert smp.percent_to_sigma(1.0, "flow", 3.0) == 0.0
+    mid = smp.percent_to_sigma(0.5, "flow", 1.0)
+    assert mid == pytest.approx(0.5)
+    # shift pushes the same percent to a higher sigma
+    assert smp.percent_to_sigma(0.5, "flow", 3.0) > mid
+    # VP: endpoints map to the table's extremes
+    hi = smp.percent_to_sigma(0.001, "eps")
+    lo = smp.percent_to_sigma(0.999, "eps")
+    assert hi > 10 and lo < 0.1
+
+
 def test_hf_projection_is_sibling_of_text_model():
     """CLIPTextModelWithProjection packs text_projection BESIDE
     text_model — a nested key would fail every real incl_clips file."""
