@@ -166,6 +166,13 @@ class SkipLayerGuidanceSD3:
             raise ValueError(
                 f"skip layers {bad} out of range for depth-{depth} model"
             )
+        if float(start_percent) > float(end_percent):
+            # a reversed window would be a silent no-op that still pays
+            # the skip-pass compile; reject it loudly
+            raise ValueError(
+                f"start_percent ({start_percent}) must be <= end_percent "
+                f"({end_percent})"
+            )
         if not layer_tuple or float(scale) == 0.0:
             return (model,)
         return (

@@ -342,17 +342,25 @@ def slg_cfg_model(
     slg_scale * (cond - cond_with_skipped_layers) while sigma is in
     [sigma_end, sigma_start] (the reference's SkipLayerGuidanceDiT
     patch, composed in eps space under this framework's sampler
-    contract). The gate is arithmetic, not control flow, so the whole
-    trajectory still compiles to one XLA program."""
+    contract). The window check is a lax.cond, so the trajectory is
+    still one XLA program AND off-window steps skip the extra forward
+    at runtime (XLA conditionals execute only the taken branch) — with
+    the default [0.01, 0.15] window that saves the ~50%-per-step skip
+    pass on most steps. The gate uses sigma[0]: every sampler step
+    broadcasts one scalar sigma across the batch."""
 
     def guided(x, sigma, cond):
         pos, _neg = cond
         eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond)
-        eps_skip = skip_model_fn(x, sigma, pos)
-        gate = (
-            (sigma >= sigma_end) & (sigma <= sigma_start)
-        ).astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
-        return base + gate * slg_scale * (eps_pos - eps_skip)
+
+        def correction(_):
+            eps_skip = skip_model_fn(x, sigma, pos)
+            return slg_scale * (eps_pos - eps_skip)
+
+        active = (sigma[0] >= sigma_end) & (sigma[0] <= sigma_start)
+        return base + jax.lax.cond(
+            active, correction, lambda _: jnp.zeros_like(eps_pos), None
+        )
 
     return guided
 

@@ -7,12 +7,27 @@
 
 "use strict";
 
-import { assertEqual, loadVectors, test } from "./harness.js";
+import { loadVectors, test } from "./harness.js";
+import * as state from "../modules/state.js";
 import * as urlUtils from "../modules/urlUtils.js";
 import * as widgets from "../modules/widgets.js";
 
-const MODULES = { urlUtils, widgets };
-export const VECTOR_FILES = ["urlUtils", "widgets"];
+const MODULES = { state, urlUtils, widgets };
+export const VECTOR_FILES = ["state", "urlUtils", "widgets"];
+
+/** Key-sorted stringify: object comparison must not depend on key
+ * insertion order (the JSON file's order vs the function's spread
+ * order are both implementation details). */
+function stable(value) {
+  if (Array.isArray(value)) return `[${value.map(stable).join(",")}]`;
+  if (value && typeof value === "object") {
+    const keys = Object.keys(value)
+      .filter((k) => value[k] !== undefined)
+      .sort();
+    return `{${keys.map((k) => `${JSON.stringify(k)}:${stable(value[k])}`).join(",")}}`;
+  }
+  return JSON.stringify(value) ?? "undefined";
+}
 
 for (const name of VECTOR_FILES) {
   test(`vectors: ${name}`, async () => {
@@ -23,7 +38,11 @@ for (const name of VECTOR_FILES) {
     for (const [i, c] of spec.cases.entries()) {
       let got = mod[c.fn](...c.args);
       if (c.parseResult && got !== null) got = JSON.parse(got);
-      assertEqual(got, c.want, `${name}[${i}] ${c.fn}`);
+      const a = stable(got);
+      const b = stable(c.want);
+      if (a !== b) {
+        throw new Error(`${name}[${i}] ${c.fn}: ${a} !== ${b}`);
+      }
     }
   });
 }

@@ -236,6 +236,10 @@ def test_skip_layer_guidance(bundle_x):
 
     with pytest.raises(ValueError, match="out of range"):
         SkipLayerGuidanceSD3().skip_guidance(bundle_x, layers="99")
+    with pytest.raises(ValueError, match="must be <="):
+        SkipLayerGuidanceSD3().skip_guidance(
+            bundle_x, layers="0", start_percent=0.5, end_percent=0.1
+        )
     with pytest.raises(ValueError, match="SD3-class"):
         SkipLayerGuidanceSD3().skip_guidance(
             pl.load_pipeline("tiny-unet"), layers="0"

@@ -406,6 +406,44 @@ def _mirror_findWidgetNodes(prompt):
     return found
 
 
+_LAUNCH_GRACE_MS = 90000
+
+
+def _mirror_reduceWorkerStatus(prev, probe, now, grace_ms=_LAUNCH_GRACE_MS):
+    prev = prev or {}
+    since = prev.get("launchingSince")
+    in_grace = _js_truthy(since) and (now - since) < grace_ms
+    clear = bool(_js_truthy(probe.get("online")) and _js_truthy(since))
+    status = {**prev, **probe}
+    if clear:
+        status["launchingSince"] = None
+    elif "launchingSince" in prev:
+        status["launchingSince"] = since
+    else:
+        # JS spread leaves the key undefined -> dropped by stringify
+        status.pop("launchingSince", None)
+    status["launching"] = bool(in_grace and not _js_truthy(probe.get("online")))
+    return {"status": status, "clearLaunching": clear}
+
+
+def _mirror_computeAnythingBusy(master_queue_remaining, statuses):
+    if master_queue_remaining > 0:
+        return True
+    return any(
+        s
+        and _js_truthy(s.get("online"))
+        and (s.get("queueRemaining") or 0) > 0
+        for s in statuses
+    )
+
+
+def _mirror_enabledWorkers(config):
+    return [
+        w for w in ((config or {}).get("workers") or [])
+        if _js_truthy(w.get("enabled"))
+    ]
+
+
 _MIRRORS = {
     "workerUrl": _mirror_workerUrl,
     "escapeHtml": _mirror_escapeHtml,
@@ -416,6 +454,9 @@ _MIRRORS = {
     "parseWorkflowText": _mirror_parseWorkflowText,
     "patchWorkflowText": _mirror_patchWorkflowText,
     "findWidgetNodes": _mirror_findWidgetNodes,
+    "reduceWorkerStatus": _mirror_reduceWorkerStatus,
+    "computeAnythingBusy": _mirror_computeAnythingBusy,
+    "enabledWorkers": _mirror_enabledWorkers,
 }
 
 
