@@ -166,6 +166,11 @@ class SkipLayerGuidanceSD3:
             raise ValueError(
                 f"skip layers {bad} out of range for depth-{depth} model"
             )
+        if not layer_tuple or float(scale) == 0.0:
+            # muted node (scale 0 / no layers): plain passthrough, no
+            # further validation — existing workflows may park junk in
+            # the window fields while SLG is disabled
+            return (model,)
         if float(start_percent) > float(end_percent):
             # a reversed window would be a silent no-op that still pays
             # the skip-pass compile; reject it loudly
@@ -173,8 +178,6 @@ class SkipLayerGuidanceSD3:
                 f"start_percent ({start_percent}) must be <= end_percent "
                 f"({end_percent})"
             )
-        if not layer_tuple or float(scale) == 0.0:
-            return (model,)
         return (
             dataclasses.replace(
                 model,

@@ -247,46 +247,18 @@ class KSampler:
     ):
         spec = resolve_seed(seed)
         bundle = model
-        latents = latent_image["samples"]
-        # honor requested pixel geometry / channel count when the
-        # bundle's VAE differs from the nominal 8x 4-channel layout
-        # EmptyLatentImage assumes (Flux-class VAEs are 8x but 16ch).
-        # Only PLACEHOLDER latents rebuild — real content from chained
-        # samplers / VAEEncode / LatentUpscale must never be replaced
-        if latent_image.get("empty") and "width" in latent_image and (
-            bundle.latent_scale != 8
-            or latents.shape[-1] != bundle.latent_channels
-        ):
-            lh = latent_image["height"] // bundle.latent_scale
-            lw = latent_image["width"] // bundle.latent_scale
-            if (
-                latents.shape[1],
-                latents.shape[2],
-                latents.shape[3],
-            ) != (lh, lw, bundle.latent_channels):
-                latents = jnp.zeros(
-                    (latents.shape[0], lh, lw, bundle.latent_channels)
-                )
-
-        noise_mask = latent_image.get("noise_mask")
-        if noise_mask is not None:
-            noise_mask = _mask_to_latent(
-                noise_mask, latents.shape[1], latents.shape[2]
-            )
-        # ComfyUI common_ksampler parity: the output latent dict keeps
-        # the input's extras (noise_mask, width/height), so chained
-        # inpaint passes (base + refine) stay masked. The "empty"
-        # placeholder marker does NOT propagate — the output is content
-        extras = {
-            k: v for k, v in latent_image.items()
-            if k not in ("samples", "empty")
-        }
+        latents, noise_mask, extras = _prep_latents(bundle, latent_image)
 
         mesh = getattr(context, "mesh", None) if context is not None else None
         if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
-            result = self._sample_mesh_parallel(
-                bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
-                positive, negative, latents, denoise, noise_mask,
+            param, shift = pl.model_schedule_info(bundle)
+            sigmas = smp.get_model_sigmas(
+                param, scheduler, int(steps), denoise=float(denoise),
+                flow_shift=shift,
+            )
+            result = _sample_mesh(
+                bundle, mesh, spec, sigmas, cfg, sampler_name,
+                positive, negative, latents, noise_mask,
             )
             return ({**extras, **result},)
 
@@ -308,73 +280,228 @@ class KSampler:
         )
         return ({**extras, "samples": out},)
 
-    @staticmethod
-    def _sample_mesh_parallel(
-        bundle, mesh, spec, steps, cfg, sampler_name, scheduler,
-        positive, negative, latents, denoise, noise_mask=None,
-    ) -> dict:
-        """One SPMD program: every participant samples its folded seed.
-        Output batch = participants x input batch, participant-major,
-        sharded over the data axis (the collector materialises it)."""
-        from ..parallel.seeds import participant_keys
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        n = data_axis_size(mesh)
-        keys = participant_keys(jax.random.key(spec.base_seed), n)
-        keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
-        params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
-        pos = jax.device_put(positive, NamedSharding(mesh, P()))
-        neg = jax.device_put(negative, NamedSharding(mesh, P()))
-        base = jax.device_put(latents, NamedSharding(mesh, P()))
-        mask = (
-            jax.device_put(
-                jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0),
-                NamedSharding(mesh, P()),
+def _prep_latents(bundle, latent_image: dict):
+    """Shared KSampler/KSamplerAdvanced input normalization: rebuild
+    PLACEHOLDER latents to the bundle's real latent layout (honor the
+    requested pixel geometry / channel count when the bundle's VAE
+    differs from the nominal 8x 4-channel layout EmptyLatentImage
+    assumes — Flux-class VAEs are 8x but 16ch; real content from
+    chained samplers / VAEEncode / LatentUpscale is never replaced),
+    normalize the noise_mask to latent resolution, and collect the
+    extras the output dict must carry forward (ComfyUI common_ksampler
+    parity: chained inpaint passes stay masked; the 'empty' marker does
+    NOT propagate)."""
+    latents = latent_image["samples"]
+    if latent_image.get("empty") and "width" in latent_image and (
+        bundle.latent_scale != 8
+        or latents.shape[-1] != bundle.latent_channels
+    ):
+        lh = latent_image["height"] // bundle.latent_scale
+        lw = latent_image["width"] // bundle.latent_scale
+        if (
+            latents.shape[1],
+            latents.shape[2],
+            latents.shape[3],
+        ) != (lh, lw, bundle.latent_channels):
+            latents = jnp.zeros(
+                (latents.shape[0], lh, lw, bundle.latent_channels)
             )
-            if noise_mask is not None
-            else None
+    noise_mask = latent_image.get("noise_mask")
+    if noise_mask is not None:
+        noise_mask = _mask_to_latent(
+            noise_mask, latents.shape[1], latents.shape[2]
         )
+    extras = {
+        k: v for k, v in latent_image.items()
+        if k not in ("samples", "empty")
+    }
+    return latents, noise_mask, extras
 
-        param, shift = pl.model_schedule_info(bundle)
-        sigmas = smp.get_model_sigmas(
-            param, scheduler, int(steps), denoise=float(denoise),
-            flow_shift=shift,
+
+def _sample_mesh(
+    bundle, mesh, spec, sigmas, cfg, sampler_name,
+    positive, negative, latents, noise_mask=None, add_noise=True,
+) -> dict:
+    """One SPMD program: every participant samples its folded seed over
+    the given sigma grid. Output batch = participants x input batch,
+    participant-major, sharded over the data axis (the collector
+    materialises it). Shared by KSampler (full/denoise-truncated grid)
+    and KSamplerAdvanced (windowed grid, optional no-noise)."""
+    from ..parallel.seeds import participant_keys
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = data_axis_size(mesh)
+    keys = participant_keys(jax.random.key(spec.base_seed), n)
+    keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
+    params = jax.device_put(bundle.params, NamedSharding(mesh, P()))
+    pos = jax.device_put(positive, NamedSharding(mesh, P()))
+    neg = jax.device_put(negative, NamedSharding(mesh, P()))
+    base = jax.device_put(latents, NamedSharding(mesh, P()))
+    mask = (
+        jax.device_put(
+            jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0),
+            NamedSharding(mesh, P()),
         )
+        if noise_mask is not None
+        else None
+    )
 
-        def per_chip(keys_shard, params, pos, neg, base, *maybe_mask):
-            mask_arr = maybe_mask[0] if maybe_mask else None
-            key = keys_shard[0]
-            noise_key, anc_key = jax.random.split(key)
-            noise = jax.random.normal(noise_key, base.shape)
-            x = smp.noise_latents(param, base, noise, sigmas[0])
-            model_fn = pl.guided_model(bundle, params, float(cfg))
-            if mask_arr is not None:
-                model_fn = smp.masked_inpaint_model(
-                    model_fn, param, base, noise, mask_arr
-                )
+    param, _shift = pl.model_schedule_info(bundle)
 
-            out = smp.sample(
-                model_fn, x, sigmas, (pos, neg), sampler_name, anc_key,
-                flow=(param == "flow"),
+    def per_chip(keys_shard, params, pos, neg, base, *maybe_mask):
+        mask_arr = maybe_mask[0] if maybe_mask else None
+        key = keys_shard[0]
+        noise_key, anc_key = jax.random.split(key)
+        # no-noise passes pin masked regions with ZERO noise (ComfyUI
+        # disable_noise semantics — see pipeline._advanced_jit)
+        noise = (
+            jax.random.normal(noise_key, base.shape)
+            if add_noise
+            else jnp.zeros_like(base)
+        )
+        x = (
+            smp.noise_latents(param, base, noise, sigmas[0])
+            if add_noise
+            else base
+        )
+        model_fn = pl.guided_model(bundle, params, float(cfg))
+        if mask_arr is not None:
+            model_fn = smp.masked_inpaint_model(
+                model_fn, param, base, noise, mask_arr
             )
-            if mask_arr is not None:
-                out = out * mask_arr + base * (1.0 - mask_arr)
-            return out
 
-        extra = () if mask is None else (mask,)
-        in_specs = [P(DATA_AXIS), P(), P(), P(), P()] + (
-            [P()] if mask is not None else []
+        out = smp.sample(
+            model_fn, x, sigmas, (pos, neg), sampler_name, anc_key,
+            flow=(param == "flow"),
         )
-        out = jax.jit(
-            jax.shard_map(
-                per_chip,
-                mesh=mesh,
-                in_specs=tuple(in_specs),
-                out_specs=P(DATA_AXIS),
-                check_vma=False,
+        if mask_arr is not None:
+            out = out * mask_arr + base * (1.0 - mask_arr)
+        return out
+
+    extra = () if mask is None else (mask,)
+    in_specs = [P(DATA_AXIS), P(), P(), P(), P()] + (
+        [P()] if mask is not None else []
+    )
+    out = jax.jit(
+        jax.shard_map(
+            per_chip,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+    )(keys, params, pos, neg, base, *extra)
+    return {"samples": out, "participant_major": True}
+
+
+@register_node
+class KSamplerAdvanced:
+    """Windowed-schedule sampler (ComfyUI KSamplerAdvanced parity):
+    sample steps [start_at_step, end_at_step] of the full schedule,
+    optionally without adding noise (the refine pass of a two-pass
+    workflow consuming a leftover-noise latent) and optionally leaving
+    leftover noise for a later pass
+    (return_with_leftover_noise="enable")."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "add_noise": ("STRING", {"default": "enable"}),
+                "noise_seed": ("INT", {"default": 0}),
+                "steps": ("INT", {"default": 20}),
+                "cfg": ("FLOAT", {"default": 7.0}),
+                "sampler_name": ("STRING", {"default": "euler"}),
+                "scheduler": ("STRING", {"default": "karras"}),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "latent_image": ("LATENT",),
+                "start_at_step": ("INT", {"default": 0}),
+                "end_at_step": ("INT", {"default": 10000}),
+                "return_with_leftover_noise": ("STRING", {"default": "disable"}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "sample"
+
+    def sample(
+        self,
+        model: pl.PipelineBundle,
+        add_noise,
+        noise_seed,
+        steps: int,
+        cfg: float,
+        sampler_name: str,
+        scheduler: str,
+        positive,
+        negative,
+        latent_image: dict,
+        start_at_step: int = 0,
+        end_at_step: int = 10000,
+        return_with_leftover_noise="disable",
+        context=None,
+    ):
+        def flag(value, name):
+            value = str(value)
+            if value not in ("enable", "disable"):
+                raise ValueError(f"{name} must be 'enable' or 'disable'")
+            return value == "enable"
+
+        do_noise = flag(add_noise, "add_noise")
+        force_full = not flag(
+            return_with_leftover_noise, "return_with_leftover_noise"
+        )
+        spec = resolve_seed(noise_seed)
+        bundle = model
+        latents, noise_mask, extras = _prep_latents(bundle, latent_image)
+
+        mesh = getattr(context, "mesh", None) if context is not None else None
+        # mesh fan-out only when noise IS added: participant diversity
+        # comes from per-chip folded noise keys. A no-noise refine pass
+        # is deterministic in its input — replicating it across chips
+        # would stack identical copies and square the batch; the
+        # single-device path below processes the (participant-major)
+        # input batch in one batched program instead.
+        if (
+            spec.per_participant
+            and mesh is not None
+            and data_axis_size(mesh) > 1
+            and do_noise
+        ):
+            param, shift = pl.model_schedule_info(bundle)
+            sigmas = pl.advanced_window_sigmas(
+                param, scheduler, int(steps), int(start_at_step),
+                int(end_at_step), force_full, shift,
             )
-        )(keys, params, pos, neg, base, *extra)
-        return {"samples": out, "participant_major": True}
+            result = _sample_mesh(
+                bundle, mesh, spec, sigmas, cfg, sampler_name,
+                positive, negative, latents, noise_mask,
+            )
+            return ({**extras, **result},)
+
+        effective_seed = spec.base_seed + (
+            spec.worker_index + 1 if spec.worker_index >= 0 else 0
+        )
+        out = pl.img2img_latents_advanced(
+            bundle,
+            latents,
+            positive,
+            negative,
+            steps=int(steps),
+            sampler=sampler_name,
+            scheduler=scheduler,
+            cfg_scale=float(cfg),
+            seed=int(effective_seed),
+            start_at_step=int(start_at_step),
+            end_at_step=int(end_at_step),
+            add_noise=do_noise,
+            force_full_denoise=force_full,
+            noise_mask=noise_mask,
+        )
+        return ({**extras, "samples": out},)
 
 
 @register_node

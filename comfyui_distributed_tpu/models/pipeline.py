@@ -367,6 +367,18 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
             == "flow"
         )
         context = cond.context if isinstance(cond, Conditioning) else cond
+        if (
+            context.shape[0] != x.shape[0]
+            and x.shape[0] % context.shape[0] == 0
+        ):
+            # conditioning broadcast across a larger latent batch
+            # (ComfyUI semantics — e.g. a participant-major batch from
+            # a mesh pass refined with one prompt). jnp.repeat keeps
+            # the CFG concat layout aligned: [pos;neg] doubling of x
+            # pairs with [pos*k;neg*k]
+            context = jnp.repeat(
+                context, x.shape[0] // context.shape[0], axis=0
+            )
         control = None
         if (
             isinstance(cond, Conditioning)
@@ -429,8 +441,15 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
                 pooled = jnp.pad(pooled, ((0, 0), (0, adm - pooled.shape[-1])))
             elif pooled.shape[-1] > adm:
                 pooled = pooled[..., :adm]
-            if pooled.shape[0] != x.shape[0]:
-                pooled = jnp.broadcast_to(pooled[:1], (x.shape[0], pooled.shape[-1]))
+            if (
+                pooled.shape[0] != x.shape[0]
+                and x.shape[0] % pooled.shape[0] == 0
+            ):
+                # repeat, not pooled[:1]-broadcast: under the CFG
+                # concat the second half is the NEGATIVE pooled vector
+                pooled = jnp.repeat(
+                    pooled, x.shape[0] // pooled.shape[0], axis=0
+                )
             y = pooled
         if is_flow:
             # rectified flow (Flux class): t IS sigma, no input scaling,
@@ -664,20 +683,150 @@ def _img2img_jit(
     noise_key, anc_key = jax.random.split(key)
     noise = jax.random.normal(noise_key, latents.shape)
     x = smp.noise_latents(param, latents, noise, sigmas[0])
+    return _masked_sample(
+        bundle, params, cfg_scale, param, latents, noise, x, sigmas,
+        (context_pos, context_neg), sampler, anc_key, noise_mask,
+    )
+
+
+def advanced_window_sigmas(
+    parameterization: str,
+    scheduler: str,
+    steps: int,
+    start_at_step: int,
+    end_at_step: int,
+    force_full_denoise: bool,
+    shift: float,
+) -> jnp.ndarray:
+    """KSamplerAdvanced's schedule slice (ComfyUI common_ksampler with
+    start_step/last_step/force_full_denoise): the full [steps+1] grid
+    windowed to [start, end], with the final sigma forced to 0 when the
+    caller wants full denoise despite stopping early."""
+    full = smp.get_model_sigmas(
+        parameterization, scheduler, int(steps), flow_shift=shift
+    )
+    start = min(max(int(start_at_step), 0), int(steps))
+    end = min(max(int(end_at_step), start), int(steps))
+    window = full[start:end + 1]
+    if force_full_denoise and window.shape[0] > 1:
+        window = window.at[-1].set(0.0)
+    return window
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "bundle_static", "steps", "sampler", "scheduler", "cfg_scale",
+        "start_at_step", "end_at_step", "add_noise", "force_full_denoise",
+    ),
+)
+def _advanced_jit(
+    bundle_static,
+    params,
+    latents,
+    context_pos,
+    context_neg,
+    key,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg_scale: float,
+    start_at_step: int,
+    end_at_step: int,
+    add_noise: bool,
+    force_full_denoise: bool,
+    noise_mask=None,
+):
+    bundle = bundle_static.value
+    param, shift = model_schedule_info(bundle)
+    window = advanced_window_sigmas(
+        param, scheduler, steps, start_at_step, end_at_step,
+        force_full_denoise, shift,
+    )
+    noise_key, anc_key = jax.random.split(key)
+    # add_noise=False (the refine pass of a two-pass workflow): the
+    # trajectory starts from the latents as-is AND the masked-region
+    # pin uses ZERO noise — ComfyUI's disable_noise semantics; pinning
+    # with a fresh Gaussian the trajectory never saw would corrupt the
+    # preserved-region context at every step
+    noise = (
+        jax.random.normal(noise_key, latents.shape)
+        if add_noise
+        else jnp.zeros_like(latents)
+    )
+    x = (
+        smp.noise_latents(param, latents, noise, window[0])
+        if add_noise
+        else latents
+    )
+    if window.shape[0] < 2:
+        # empty step window: nothing to sample
+        return x
+    return _masked_sample(
+        bundle, params, cfg_scale, param, latents, noise, x, window,
+        (context_pos, context_neg), sampler, anc_key, noise_mask,
+    )
+
+
+def _masked_sample(
+    bundle, params, cfg_scale, param, latents, noise, x, sigmas, cond,
+    sampler, anc_key, noise_mask,
+):
+    """Guidance + optional masked-inpaint wrap + trajectory + mask
+    composite — the sampling core shared by _img2img_jit and
+    _advanced_jit (one place to maintain the inpaint pin semantics)."""
     model = guided_model(bundle, params, cfg_scale)
     if noise_mask is not None:
         # inpainting (reference-substrate SetLatentNoiseMask /
         # VAEEncodeForInpaint semantics)
         mask = jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0)
         model = smp.masked_inpaint_model(model, param, latents, noise, mask)
-
     out = smp.sample(
-        model, x, sigmas, (context_pos, context_neg), sampler, anc_key,
-        flow=(param == "flow"),
+        model, x, sigmas, cond, sampler, anc_key, flow=(param == "flow")
     )
     if noise_mask is not None:
         out = out * mask + latents * (1.0 - mask)
     return out
+
+
+def img2img_latents_advanced(
+    bundle: PipelineBundle,
+    latents: jax.Array,
+    context_pos: jax.Array,
+    context_neg: jax.Array,
+    steps: int = 20,
+    sampler: str = "euler",
+    scheduler: str = "karras",
+    cfg_scale: float = 7.0,
+    seed: int = 0,
+    start_at_step: int = 0,
+    end_at_step: int = 10000,
+    add_noise: bool = True,
+    force_full_denoise: bool = True,
+    noise_mask: jax.Array | None = None,
+) -> jax.Array:
+    """KSamplerAdvanced core: sample a [start_at_step, end_at_step]
+    window of the full schedule, optionally without adding noise (the
+    second pass of a two-pass workflow) and optionally leaving leftover
+    noise (force_full_denoise=False)."""
+    key = jax.random.key(seed)
+    return _advanced_jit(
+        _Static(bundle),
+        bundle.params,
+        latents,
+        context_pos,
+        context_neg,
+        key,
+        int(steps),
+        sampler,
+        scheduler,
+        float(cfg_scale),
+        int(start_at_step),
+        int(end_at_step),
+        bool(add_noise),
+        bool(force_full_denoise),
+        noise_mask=noise_mask,
+    )
 
 
 def img2img_latents(

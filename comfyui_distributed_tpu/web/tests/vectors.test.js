@@ -17,7 +17,9 @@ export const VECTOR_FILES = ["state", "urlUtils", "widgets"];
 
 /** Key-sorted stringify: object comparison must not depend on key
  * insertion order (the JSON file's order vs the function's spread
- * order are both implementation details). */
+ * order are both implementation details). Dropping undefined-valued
+ * keys matches the JSON.stringify semantics the harness's assertEqual
+ * always had — this comparator only adds order-insensitivity. */
 function stable(value) {
   if (Array.isArray(value)) return `[${value.map(stable).join(",")}]`;
   if (value && typeof value === "object") {

@@ -1,0 +1,201 @@
+"""KSamplerAdvanced (windowed-schedule sampler): schedule slicing,
+two-pass composition, no-noise refine pass, leftover noise, masked
+sampling, and the per-participant mesh path — the ComfyUI node
+two-pass workflows depend on."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    EmptyLatentImage,
+    KSampler,
+    KSamplerAdvanced,
+    SeedSpec,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    """tiny-unet with the zero-initialized leaves perturbed: the
+    SD-faithful zero-init out_conv makes a random-init model emit
+    eps == 0 exactly, which would let every schedule window produce
+    the same (unmoved) latents and trivialize trajectory tests."""
+    import jax
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def _cond(bundle):
+    return (
+        pl.encode_text_pooled(bundle, ["p"]),
+        pl.encode_text_pooled(bundle, [""]),
+    )
+
+
+def test_advanced_window_sigmas_slices_full_grid():
+    full = np.asarray(smp.get_sigmas("karras", 8))
+    w = np.asarray(
+        pl.advanced_window_sigmas("eps", "karras", 8, 2, 5, False, 3.0)
+    )
+    np.testing.assert_array_equal(w, full[2:6])
+    # force_full_denoise pins the final sigma to 0 despite stopping early
+    wf = np.asarray(
+        pl.advanced_window_sigmas("eps", "karras", 8, 2, 5, True, 3.0)
+    )
+    np.testing.assert_array_equal(wf[:-1], full[2:5])
+    assert wf[-1] == 0.0
+    # out-of-range clamps; end >= steps reaches the terminal 0
+    w2 = np.asarray(
+        pl.advanced_window_sigmas("eps", "karras", 8, 0, 10000, False, 3.0)
+    )
+    np.testing.assert_array_equal(w2, full)
+
+
+def test_two_pass_composition_matches_single(bundle):
+    """pass1 (leftover noise, steps 0..2) + pass2 (no added noise,
+    steps 2..4) walks the same euler trajectory as one full 4-step
+    KSampler run; cross-program XLA rounding bounds the comparison."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (single,) = KSampler().sample(
+        bundle, 5, 4, 7.0, "euler", "karras", pos, neg, el, denoise=1.0
+    )
+    (p1,) = KSamplerAdvanced().sample(
+        bundle, "enable", 5, 4, 7.0, "euler", "karras", pos, neg, el,
+        start_at_step=0, end_at_step=2,
+        return_with_leftover_noise="enable",
+    )
+    (p2,) = KSamplerAdvanced().sample(
+        bundle, "disable", 5, 4, 7.0, "euler", "karras", pos, neg, p1,
+        start_at_step=2, end_at_step=4,
+        return_with_leftover_noise="disable",
+    )
+    np.testing.assert_allclose(
+        np.asarray(p2["samples"]), np.asarray(single["samples"]), atol=5e-2
+    )
+    # the intermediate latent is a different point on the trajectory
+    # (still carries sigma[2]-level noise), not the finished sample
+    assert not np.array_equal(
+        np.asarray(p1["samples"]), np.asarray(single["samples"])
+    )
+    # and the trajectory genuinely moves latents (the fixture undoes
+    # the zero-init eps degeneracy)
+    assert not np.array_equal(
+        np.asarray(p1["samples"]), np.asarray(p2["samples"])
+    )
+
+
+def test_no_noise_empty_window_is_identity(bundle):
+    rng = np.random.default_rng(4)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    pos, neg = _cond(bundle)
+    (out,) = KSamplerAdvanced().sample(
+        bundle, "disable", 1, 4, 7.0, "euler", "karras", pos, neg,
+        {"samples": z}, start_at_step=2, end_at_step=2,
+    )
+    np.testing.assert_array_equal(np.asarray(out["samples"]), np.asarray(z))
+
+
+def test_flag_validation(bundle):
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    with pytest.raises(ValueError, match="add_noise"):
+        KSamplerAdvanced().sample(
+            bundle, "yes", 1, 2, 7.0, "euler", "karras", pos, neg, el
+        )
+    with pytest.raises(ValueError, match="return_with_leftover_noise"):
+        KSamplerAdvanced().sample(
+            bundle, "enable", 1, 2, 7.0, "euler", "karras", pos, neg, el,
+            return_with_leftover_noise="maybe",
+        )
+
+
+def test_masked_advanced_keeps_unmasked_region(bundle):
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, :, 4:] = 1.0
+    latent = {"samples": z, "noise_mask": jnp.asarray(mask)[..., None]}
+    pos, neg = _cond(bundle)
+    (out,) = KSamplerAdvanced().sample(
+        bundle, "enable", 3, 4, 7.0, "euler", "karras", pos, neg, latent,
+        start_at_step=0, end_at_step=4,
+    )
+    got = np.asarray(out["samples"])
+    np.testing.assert_array_equal(got[:, :, :4], np.asarray(z)[:, :, :4])
+    assert not np.allclose(got[:, :, 4:], np.asarray(z)[:, :, 4:])
+    assert "noise_mask" in out  # extras propagate for chained passes
+
+
+def test_mesh_parallel_advanced(bundle):
+    """SeedSpec + mesh: the advanced sampler runs the same SPMD
+    participant fan-out as KSampler, on its windowed schedule."""
+    from types import SimpleNamespace
+
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 8})
+    ctx = SimpleNamespace(mesh=mesh)
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (out,) = KSamplerAdvanced().sample(
+        bundle, "enable", SeedSpec(base_seed=9, per_participant=True),
+        4, 7.0, "euler", "karras", pos, neg, el,
+        start_at_step=0, end_at_step=4, context=ctx,
+    )
+    got = np.asarray(out["samples"])
+    assert got.shape[0] == 8
+    assert out.get("participant_major")
+    sums = {round(float(got[i].sum()), 4) for i in range(8)}
+    assert len(sums) == 8  # distinct participants
+
+    # chained refine pass WITHOUT noise must not fan out again: a
+    # deterministic pass replicated across chips would stack identical
+    # copies and square the batch — it runs as one batched program
+    (refined,) = KSamplerAdvanced().sample(
+        bundle, "disable", SeedSpec(base_seed=9, per_participant=True),
+        4, 7.0, "euler", "karras", pos, neg, out,
+        start_at_step=2, end_at_step=4, context=ctx,
+    )
+    ref = np.asarray(refined["samples"])
+    assert ref.shape[0] == 8  # same batch, not 64
+    ref_sums = {round(float(ref[i].sum()), 4) for i in range(8)}
+    assert len(ref_sums) == 8  # diversity preserved
+
+
+def test_no_noise_masked_pin_uses_zero_noise(bundle):
+    """add_noise=disable + noise_mask: the preserved region is pinned
+    to the ORIGINAL latents (zero pin noise — ComfyUI disable_noise),
+    and survives bit-exactly."""
+    rng = np.random.default_rng(8)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, 4:] = 1.0
+    latent = {"samples": z, "noise_mask": jnp.asarray(mask)[..., None]}
+    pos, neg = _cond(bundle)
+    (out,) = KSamplerAdvanced().sample(
+        bundle, "disable", 3, 4, 7.0, "euler", "karras", pos, neg, latent,
+        start_at_step=2, end_at_step=4,
+    )
+    got = np.asarray(out["samples"])
+    np.testing.assert_array_equal(got[:, :4], np.asarray(z)[:, :4])
+    assert not np.array_equal(got[:, 4:], np.asarray(z)[:, 4:])
