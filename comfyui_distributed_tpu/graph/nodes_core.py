@@ -178,6 +178,38 @@ class CLIPTextEncode:
 
 
 @register_node
+class CLIPSetLastLayer:
+    """Clip-skip (ComfyUI CLIPSetLastLayer parity): stop the CLIP
+    tower stop_at_clip_layer blocks from the end when producing the
+    conditioning context (-1 = the full stack, -2 = the classic
+    "clip skip 2", ...). Applies to every CLIP tower in the bundle;
+    T5-class towers are unaffected. The pooled vector always comes
+    from the full stack (reference semantics)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP",),
+                "stop_at_clip_layer": ("INT", {"default": -1}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP",)
+    FUNCTION = "set_last_layer"
+
+    def set_last_layer(self, clip: pl.PipelineBundle,
+                       stop_at_clip_layer=-1, context=None):
+        stop = int(stop_at_clip_layer)
+        if stop >= 0:
+            raise ValueError(
+                "stop_at_clip_layer counts from the end and must be "
+                "negative (-1 = last layer)"
+            )
+        return (dataclasses.replace(clip, clip_skip=-stop - 1),)
+
+
+@register_node
 class EmptyLatentImage:
     @classmethod
     def INPUT_TYPES(cls):
@@ -321,13 +353,15 @@ def _prep_latents(bundle, latent_image: dict):
 
 def _sample_mesh(
     bundle, mesh, spec, sigmas, cfg, sampler_name,
-    positive, negative, latents, noise_mask=None, add_noise=True,
+    positive, negative, latents, noise_mask=None,
 ) -> dict:
     """One SPMD program: every participant samples its folded seed over
     the given sigma grid. Output batch = participants x input batch,
     participant-major, sharded over the data axis (the collector
     materialises it). Shared by KSampler (full/denoise-truncated grid)
-    and KSamplerAdvanced (windowed grid, optional no-noise)."""
+    and KSamplerAdvanced (windowed grid). Always noise-adding: a
+    no-noise pass is deterministic in its input, so the nodes route it
+    to the single-device batched path instead of fanning out."""
     from ..parallel.seeds import participant_keys
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -353,18 +387,8 @@ def _sample_mesh(
         mask_arr = maybe_mask[0] if maybe_mask else None
         key = keys_shard[0]
         noise_key, anc_key = jax.random.split(key)
-        # no-noise passes pin masked regions with ZERO noise (ComfyUI
-        # disable_noise semantics — see pipeline._advanced_jit)
-        noise = (
-            jax.random.normal(noise_key, base.shape)
-            if add_noise
-            else jnp.zeros_like(base)
-        )
-        x = (
-            smp.noise_latents(param, base, noise, sigmas[0])
-            if add_noise
-            else base
-        )
+        noise = jax.random.normal(noise_key, base.shape)
+        x = smp.noise_latents(param, base, noise, sigmas[0])
         model_fn = pl.guided_model(bundle, params, float(cfg))
         if mask_arr is not None:
             model_fn = smp.masked_inpaint_model(

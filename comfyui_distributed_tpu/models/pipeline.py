@@ -50,6 +50,10 @@ class PipelineBundle:
     # node via dataclasses.replace — a new bundle instance, so the
     # jitted samplers recompile for the patched model exactly once
     slg: "SLGSpec | None" = None
+    # clip-skip (CLIPSetLastLayer): how many final CLIP blocks to
+    # exclude from the hidden/context output; None = each tower's
+    # configured default. Applies to CLIP towers only (T5 unaffected)
+    clip_skip: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -260,12 +264,14 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
             )
         tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
         h_l, p_l = bundle.text_encoder.apply(
-            bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
+            bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id,
+            skip_last=bundle.clip_skip,
         )
         tok2 = bundle.tokenizer_2
         tokens2 = jnp.asarray(tok2.encode_batch(texts))
         h_g, p_g = bundle.text_encoder_2.apply(
-            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
+            skip_last=bundle.clip_skip,
         )
         tokens3 = jnp.asarray(bundle.tokenizer_3.encode_batch(texts))
         h_t5, _ = bundle.text_encoder_3.apply(bundle.params["te3"], tokens3)
@@ -301,19 +307,22 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
         tok2 = bundle.tokenizer_2
         tokens2 = jnp.asarray(tok2.encode_batch(texts))
         _, pooled = bundle.text_encoder_2.apply(
-            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
+            skip_last=bundle.clip_skip,
         )
         return hidden, pooled
 
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
     hidden, pooled = bundle.text_encoder.apply(
-        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id
+        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id,
+        skip_last=bundle.clip_skip,
     )
     if bundle.text_encoder_2 is not None:
         tok2 = bundle.tokenizer_2 or bundle.tokenizer
         tokens2 = jnp.asarray(tok2.encode_batch(texts))
         hidden2, pooled2 = bundle.text_encoder_2.apply(
-            bundle.params["te2"], tokens2, eos_id=tok2.eos_id
+            bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
+            skip_last=bundle.clip_skip,
         )
         hidden = jnp.concatenate(
             [hidden.astype(jnp.float32), hidden2.astype(jnp.float32)], axis=-1
@@ -760,7 +769,11 @@ def _advanced_jit(
         else latents
     )
     if window.shape[0] < 2:
-        # empty step window: nothing to sample
+        # empty step window: nothing to sample — but the mask contract
+        # (preserved region survives intact) still holds
+        if noise_mask is not None:
+            mask = jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0)
+            return x * mask + latents * (1.0 - mask)
         return x
     return _masked_sample(
         bundle, params, cfg_scale, param, latents, noise, x, window,

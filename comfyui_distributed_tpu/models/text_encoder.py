@@ -141,17 +141,36 @@ class TextEncoder(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jax.Array, eos_id: int | None = None
+        self,
+        tokens: jax.Array,
+        eos_id: int | None = None,
+        skip_last: int | None = None,
     ) -> tuple[jax.Array, jax.Array]:
         """[B, T] int tokens → (hidden [B, T, width], pooled [B, width]).
 
         `eos_id` selects the pooled position (first EOS occurrence);
         defaults to the CLIP layout id — pass the active tokenizer's
         eos_id when a custom vocab moves it.
+
+        `skip_last` (clip-skip, the CLIPSetLastLayer knob) overrides
+        how many final blocks are excluded from the HIDDEN output:
+        None = the model's configured default (1 when
+        penultimate_hidden, else 0), 0 = full stack. The pooled vector
+        always comes from the full stack + final LN + projection
+        (ComfyUI semantics). For natively-full-stack models the final
+        LN is applied to the intermediate state
+        (layer_norm_hidden_state=True, the SD1 clip model); configured
+        penultimate models keep their final_ln_on_hidden setting.
         """
         cfg = self.config
         dt = cfg.compute_dtype
         b, t = tokens.shape
+        default_skip = 1 if cfg.penultimate_hidden else 0
+        skip = default_skip if skip_last is None else max(int(skip_last), 0)
+        if skip >= cfg.layers:
+            raise ValueError(
+                f"clip_skip {skip} too deep for a {cfg.layers}-layer encoder"
+            )
         tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding")(tokens)
         pos_emb = self.param(
             "position_embedding",
@@ -160,17 +179,18 @@ class TextEncoder(nn.Module):
         )
         x = (tok_emb + pos_emb[None, :t, :]).astype(dt)
         causal = jnp.tril(jnp.ones((t, t), dtype=bool))
-        penultimate = None
+        intermediate = None
         for i in range(cfg.layers):
-            if cfg.penultimate_hidden and i == cfg.layers - 1:
-                penultimate = x
+            if skip and i == cfg.layers - skip:
+                intermediate = x
             x = _CausalBlock(
                 cfg.heads, dt, cfg.activation, name=f"block_{i}"
             )(x, causal)
         final_ln = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_ln")
-        x = final_ln(x.astype(jnp.float32))
+        pre_ln = x.astype(jnp.float32)
+        x = final_ln(pre_ln)
         # pooled = state at first EOS position per sequence (from the
-        # FULL stack + final LN, even when hidden is penultimate)
+        # FULL stack + final LN, even when hidden is intermediate)
         if eos_id is None:
             eos_id = Tokenizer.EOS
         eos_pos = jnp.argmax((tokens == eos_id).astype(jnp.int32), axis=1)
@@ -182,12 +202,19 @@ class TextEncoder(nn.Module):
                 (cfg.width, cfg.proj_dim),
             )
             pooled = pooled @ proj.astype(pooled.dtype)
-        if cfg.penultimate_hidden:
-            hidden = penultimate.astype(jnp.float32)
-            if cfg.final_ln_on_hidden:
-                # SD2 semantics: the model's final LN (shared params)
-                # is applied to the penultimate state used as context
+        apply_ln = cfg.final_ln_on_hidden if cfg.penultimate_hidden else True
+        if skip:
+            hidden = intermediate.astype(jnp.float32)
+            if apply_ln:
+                # the model's final LN (shared params) is applied to
+                # the intermediate state used as context (SD1/SD2
+                # semantics; SDXL's encoders set final_ln_on_hidden
+                # False and keep the raw state)
                 hidden = final_ln(hidden)
         else:
-            hidden = x
+            # skip=0 honors the same LN setting: a no-LN tower (SDXL
+            # bigG/L) forced to the last layer returns the PRE-LN
+            # state — ComfyUI's layer_norm_hidden_state=False at
+            # intermediate_output = num_layers - 1
+            hidden = x if apply_ln else pre_ln
         return hidden, pooled

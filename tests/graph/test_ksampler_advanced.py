@@ -115,6 +115,25 @@ def test_no_noise_empty_window_is_identity(bundle):
     np.testing.assert_array_equal(np.asarray(out["samples"]), np.asarray(z))
 
 
+def test_empty_window_with_mask_preserves_region(bundle):
+    """start == end with add_noise=enable and a noise_mask: no steps
+    run, but the mask contract still holds — the preserved region
+    comes back intact, not noised."""
+    rng = np.random.default_rng(6)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, 4:] = 1.0
+    latent = {"samples": z, "noise_mask": jnp.asarray(mask)[..., None]}
+    pos, neg = _cond(bundle)
+    (out,) = KSamplerAdvanced().sample(
+        bundle, "enable", 3, 4, 7.0, "euler", "karras", pos, neg, latent,
+        start_at_step=1, end_at_step=1,
+    )
+    got = np.asarray(out["samples"])
+    np.testing.assert_array_equal(got[:, :4], np.asarray(z)[:, :4])
+    assert not np.array_equal(got[:, 4:], np.asarray(z)[:, 4:])  # noised
+
+
 def test_flag_validation(bundle):
     (el,) = EmptyLatentImage().generate(32, 32, 1)
     pos, neg = _cond(bundle)

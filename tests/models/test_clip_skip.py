@@ -1,0 +1,111 @@
+"""Clip-skip (CLIPSetLastLayer): encoder-level skip_last semantics and
+the node-level bundle patch (reference: ComfyUI's clip.clip_layer /
+CLIPSetLastLayer, the classic "clip skip 2" knob)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models.registry import get_config
+from comfyui_distributed_tpu.models.text_encoder import (
+    TextEncoder,
+    TextEncoderConfig,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def _enc(cfg):
+    model = TextEncoder(cfg)
+    tokens = jnp.asarray(
+        np.array([[5, 7, 9, 2, 0, 0, 0, 0]], np.int32)
+    )
+    params = model.init(jax.random.key(0), tokens)
+    return model, params, tokens
+
+
+def test_skip_default_matches_legacy_behavior():
+    """skip_last=None reproduces the configured default exactly: full
+    stack for SD1-style configs, penultimate for SDXL-style."""
+    cfg = TextEncoderConfig(width=32, layers=3, heads=2, max_length=8)
+    model, params, tokens = _enc(cfg)
+    h_none, p_none = model.apply(params, tokens)
+    h_zero, p_zero = model.apply(params, tokens, skip_last=0)
+    np.testing.assert_array_equal(np.asarray(h_none), np.asarray(h_zero))
+    np.testing.assert_array_equal(np.asarray(p_none), np.asarray(p_zero))
+
+    pen = dataclasses.replace(cfg, penultimate_hidden=True)
+    model2, params2, _ = _enc(pen)
+    h_def, _ = model2.apply(params2, tokens)
+    h_one, _ = model2.apply(params2, tokens, skip_last=1)
+    np.testing.assert_array_equal(np.asarray(h_def), np.asarray(h_one))
+
+
+def test_skip_changes_hidden_not_pooled():
+    cfg = TextEncoderConfig(width=32, layers=3, heads=2, max_length=8)
+    model, params, tokens = _enc(cfg)
+    h0, p0 = model.apply(params, tokens)
+    h2, p2 = model.apply(params, tokens, skip_last=2)
+    assert not np.array_equal(np.asarray(h0), np.asarray(h2))
+    # pooled always comes from the full stack (reference semantics)
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p2))
+
+
+def test_skip_zero_on_penultimate_config_uses_full_stack():
+    """CLIPSetLastLayer(-1) on a penultimate-default tower forces the
+    full stack, honoring the tower's LN setting: an SD2-style tower
+    (final_ln_on_hidden=True) matches a non-penultimate config over
+    the same params; an SDXL-style tower (False) returns the PRE-LN
+    last-layer state (ComfyUI layer_norm_hidden_state=False)."""
+    pen_ln = TextEncoderConfig(
+        width=32, layers=3, heads=2, max_length=8,
+        penultimate_hidden=True, final_ln_on_hidden=True,
+    )
+    model, params, tokens = _enc(pen_ln)
+    h_full, _ = model.apply(params, tokens, skip_last=0)
+    plain = TextEncoder(
+        dataclasses.replace(
+            pen_ln, penultimate_hidden=False, final_ln_on_hidden=False
+        )
+    )
+    h_plain, _ = plain.apply(params, tokens)
+    np.testing.assert_array_equal(np.asarray(h_full), np.asarray(h_plain))
+
+    # no-LN tower: skip=0 differs from the post-LN full stack and from
+    # its own penultimate default
+    pen_raw = dataclasses.replace(pen_ln, final_ln_on_hidden=False)
+    model2, params2, _ = _enc(pen_raw)
+    h_raw, _ = model2.apply(params2, tokens, skip_last=0)
+    h_def, _ = model2.apply(params2, tokens)
+    assert not np.array_equal(np.asarray(h_raw), np.asarray(h_plain))
+    assert not np.array_equal(np.asarray(h_raw), np.asarray(h_def))
+
+
+def test_skip_too_deep_raises():
+    cfg = TextEncoderConfig(width=32, layers=3, heads=2, max_length=8)
+    model, params, tokens = _enc(cfg)
+    with pytest.raises(ValueError, match="too deep"):
+        model.apply(params, tokens, skip_last=3)
+
+
+def test_clip_set_last_layer_node():
+    from comfyui_distributed_tpu.graph.nodes_core import CLIPSetLastLayer
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    base = np.asarray(pl.encode_text(bundle, ["a prompt"]))
+    (skipped,) = CLIPSetLastLayer().set_last_layer(bundle, -2)
+    assert skipped.clip_skip == 1
+    ctx = np.asarray(pl.encode_text(skipped, ["a prompt"]))
+    assert not np.array_equal(base, ctx)
+    # -1 = full stack: identical to the tiny-unet default (full-stack
+    # tower)
+    (full,) = CLIPSetLastLayer().set_last_layer(bundle, -1)
+    np.testing.assert_array_equal(
+        base, np.asarray(pl.encode_text(full, ["a prompt"]))
+    )
+    with pytest.raises(ValueError, match="negative"):
+        CLIPSetLastLayer().set_last_layer(bundle, 1)
