@@ -529,6 +529,26 @@ class KSamplerAdvanced:
 
 
 @register_node
+class VAELoader:
+    """Load a standalone VAE (ComfyUI VAELoader parity): a registry
+    VAE name (vae-sd, vae-flux, vae-sd3, ...) whose real weights
+    resolve through CDT_CHECKPOINT_DIR/<name>.{safetensors,ckpt} —
+    standalone bare-key files and full-checkpoint first_stage_model
+    layouts both map. The output plugs into any VAE input, replacing
+    the checkpoint's bundled VAE."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"vae_name": ("STRING", {"default": "vae-sd"})}}
+
+    RETURN_TYPES = ("VAE",)
+    FUNCTION = "load_vae"
+
+    def load_vae(self, vae_name: str, context=None):
+        return (pl.load_vae(str(vae_name)),)
+
+
+@register_node
 class VAEDecode:
     @classmethod
     def INPUT_TYPES(cls):

@@ -107,6 +107,19 @@ class UltimateSDUpscaleDistributed:
         seed = getattr(seed, "base_seed", seed)  # accept SeedSpec links
         if sampler_name not in SAMPLER_NAMES:
             raise ValueError(f"unknown sampler {sampler_name!r}")
+        if vae is not None and vae.vae is not model.vae:
+            # a standalone VAE (VAELoader) replaces the checkpoint's
+            # bundled one for the tile encode/decode — ops/upscale
+            # reads the VAE off the model bundle, so graft it on
+            import dataclasses
+
+            model = dataclasses.replace(
+                model,
+                vae=vae.vae,
+                params={**model.params, "vae": vae.params["vae"]},
+                latent_channels=vae.latent_channels,
+                latent_scale=vae.latent_scale,
+            )
         # force_uniform_tiles=False keeps the reference's non-uniform
         # seam positions (reference upscale/tile_ops.py:73-78) but with
         # static tile shapes: edge tiles overhang into an edge-extended

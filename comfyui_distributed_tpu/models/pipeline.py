@@ -56,6 +56,57 @@ class PipelineBundle:
     clip_skip: int | None = None
 
 
+@dataclasses.dataclass
+class VAEBundle:
+    """A standalone VAE (the VAELoader node's output): satisfies the
+    attribute protocol the VAE-consuming nodes use (`.vae`,
+    `.params["vae"]`, `.latent_channels`, `.latent_scale`) so it can
+    replace a checkpoint's bundled VAE anywhere one is accepted."""
+
+    vae: Any
+    params: dict[str, Any]
+    latent_channels: int
+    latent_scale: int
+
+
+def load_vae(
+    vae_name: str = "vae-sd",
+    checkpoint: str | None = None,
+    seed: int = 0,
+) -> VAEBundle:
+    """Build a standalone VAE; load real weights when a checkpoint
+    resolves (explicit arg or CDT_CHECKPOINT_DIR/<vae_name>.*).
+    Standalone VAE files ship bare `encoder./decoder.` keys (e.g.
+    vae-ft-mse, Flux ae.safetensors); full checkpoints carry
+    `first_stage_model.*` — both layouts map."""
+    from . import sd_checkpoint as sdc
+    from .registry import model_family
+
+    if model_family(vae_name) != "vae":
+        raise ValueError(
+            f"{vae_name!r} is not an image-VAE config "
+            f"(family {model_family(vae_name)!r}); use a vae-* registry "
+            "name"
+        )
+    cfg = get_config(vae_name)
+    vae = create_model(vae_name)
+    params = vae.init(jax.random.key(seed), jnp.zeros((1, 32, 32, 3)))
+    ckpt = checkpoint or sdc.find_checkpoint(vae_name)
+    if ckpt:
+        from ..utils.logging import log
+
+        log(f"loading VAE checkpoint {ckpt} for {vae_name}")
+        params, _problems = sdc.load_vae_weights(
+            sdc.read_checkpoint(ckpt), cfg, params
+        )
+    return VAEBundle(
+        vae=vae,
+        params={"vae": params},
+        latent_channels=cfg.latent_channels,
+        latent_scale=cfg.downscale,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SLGSpec:
     """Skip-layer guidance parameters (reference SkipLayerGuidanceDiT:

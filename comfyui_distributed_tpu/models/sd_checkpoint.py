@@ -476,6 +476,32 @@ def wan_vae_schedule(cfg) -> list[Entry]:
     return entries
 
 
+def load_vae_weights(
+    state_dict: dict[str, np.ndarray],
+    cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map a standalone image-VAE state dict onto the VAE tree. Both
+    published layouts sniff automatically: bare `encoder./decoder.`
+    keys (standalone files — vae-ft-mse, Flux ae.safetensors) and a
+    full checkpoint's `first_stage_model.*`."""
+    prefix = (
+        "first_stage_model"
+        if any(k.startswith("first_stage_model.") for k in state_dict)
+        else ""
+    )
+    params, problems = _merge_into_template(
+        state_dict, vae_schedule(cfg, prefix=prefix), template, "vae"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"VAE checkpoint mapping failed ({len(problems)} "
+            "problems): " + "; ".join(problems[:12])
+        )
+    return params, problems
+
+
 def load_wan_vae_weights(
     state_dict: dict[str, np.ndarray],
     cfg,

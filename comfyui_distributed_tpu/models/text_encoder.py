@@ -168,9 +168,11 @@ class TextEncoder(nn.Module):
         default_skip = 1 if cfg.penultimate_hidden else 0
         skip = default_skip if skip_last is None else max(int(skip_last), 0)
         if skip >= cfg.layers:
-            raise ValueError(
-                f"clip_skip {skip} too deep for a {cfg.layers}-layer encoder"
-            )
+            # reference semantics (SDClipModel.clip_layer): a skip
+            # deeper than this tower falls back to the last layer —
+            # dual-tower bundles have different depths and a value
+            # valid for the deeper tower must not reject the shallower
+            skip = default_skip
         tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding")(tokens)
         pos_emb = self.param(
             "position_embedding",

@@ -85,11 +85,15 @@ def test_skip_zero_on_penultimate_config_uses_full_stack():
     assert not np.array_equal(np.asarray(h_raw), np.asarray(h_def))
 
 
-def test_skip_too_deep_raises():
+def test_skip_too_deep_falls_back_to_default():
+    """ComfyUI clamps a too-deep clip_skip to the tower's last layer
+    (dual-tower bundles have different depths; a value valid for the
+    deeper tower must not reject the shallower one)."""
     cfg = TextEncoderConfig(width=32, layers=3, heads=2, max_length=8)
     model, params, tokens = _enc(cfg)
-    with pytest.raises(ValueError, match="too deep"):
-        model.apply(params, tokens, skip_last=3)
+    h_deep, _ = model.apply(params, tokens, skip_last=3)
+    h_def, _ = model.apply(params, tokens)
+    np.testing.assert_array_equal(np.asarray(h_deep), np.asarray(h_def))
 
 
 def test_clip_set_last_layer_node():
