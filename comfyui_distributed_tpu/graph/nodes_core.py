@@ -545,7 +545,9 @@ class VAELoader:
     FUNCTION = "load_vae"
 
     def load_vae(self, vae_name: str, context=None):
-        return (pl.load_vae(str(vae_name)),)
+        # real ComfyUI workflows carry filenames ("vae-sd.safetensors")
+        # — resolve by stem like CheckpointLoaderSimple
+        return (pl.load_vae(os.path.splitext(str(vae_name))[0]),)
 
 
 @register_node

@@ -221,6 +221,7 @@ def load_pipeline(
 
     from . import sd_checkpoint as sdc
 
+    ckpt_supplied: set[str] = set()
     ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
     if ckpt_path:
         from ..utils.logging import log
@@ -243,6 +244,49 @@ def load_pipeline(
         te_params = mapped["te"]
         te2_params = mapped.get("te2", te2_params)
         te3_params = mapped.get("te3", te3_params)
+        # which encoder parts the FILE actually carried (per published
+        # layout prefixes) — a fine-tuned checkpoint's own encoders
+        # must not be clobbered by a same-named standalone file below
+        _te_markers = {
+            "te": (
+                "cond_stage_model.", "conditioner.embedders.0.",
+                "text_encoders.clip_l.",
+            ),
+            "te2": ("conditioner.embedders.1.", "text_encoders.clip_g."),
+            "te3": ("text_encoders.t5xxl.",),
+        }
+        for part, markers in _te_markers.items():
+            if any(k.startswith(markers) for k in state_dict):
+                ckpt_supplied.add(part)
+
+    # Separate-file text encoders (the real Flux/SD3 distribution
+    # format: t5xxl_fp16.safetensors / clip_l.safetensors / ... — what
+    # ComfyUI's CLIPLoader family consumes): a file resolving under the
+    # ENCODER's registry name fills encoders the main checkpoint did
+    # NOT supply (checkpoint-bundled fine-tuned encoders win).
+    def _load_te_file(name, params_, part):
+        if not name or params_ is None or part in ckpt_supplied:
+            return params_
+        ckpt_ = sdc.find_checkpoint(name)
+        if not ckpt_:
+            return params_
+        from ..utils.logging import log
+
+        log(f"loading text-encoder checkpoint {ckpt_} for {name}")
+        sd_dict = sdc.read_checkpoint(ckpt_)
+        if model_family(name) == "t5_encoder":
+            out, _problems = sdc.load_t5_weights(
+                sd_dict, get_config(name), params_
+            )
+        else:
+            out, _problems = sdc.load_clip_te_weights(
+                sd_dict, get_config(name), params_
+            )
+        return out
+
+    te_params = _load_te_file(te_name, te_params, "te")
+    te2_params = _load_te_file(te2_name, te2_params, "te2")
+    te3_params = _load_te_file(te3_name, te3_params, "te3")
 
     from .t5_encoder import T5Tokenizer
 

@@ -289,11 +289,17 @@ def text_encoder_schedule(
     if cfg.proj_dim is not None:
         if projection_layout == "linear":
             # HF CLIPTextModelWithProjection: text_projection is a
-            # SIBLING of text_model, not nested inside it
-            base = p[: -len(".text_model")] if p.endswith(".text_model") else p
-            entries.append(
-                (f"{base}.text_projection", "text_projection", "bare_linear_w")
-            )
+            # SIBLING of text_model, not nested inside it (for a
+            # standalone file with bare `text_model.*` keys the
+            # sibling sits at the root)
+            if p.endswith(".text_model"):
+                base = p[: -len(".text_model")]
+            elif p == "text_model":
+                base = ""
+            else:
+                base = p
+            key = f"{base}.text_projection" if base else "text_projection"
+            entries.append((key, "text_projection", "bare_linear_w"))
         else:
             entries.append(
                 (f"{p}.text_projection", "text_projection", "param_bare")
@@ -474,6 +480,52 @@ def wan_vae_schedule(cfg) -> list[Entry]:
         ("decoder.head.2", "decoder/head_2/conv", "causal3"),
     ]
     return entries
+
+
+def load_clip_te_weights(
+    state_dict: dict[str, np.ndarray],
+    cfg,
+    template: Any,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Standalone CLIP text-encoder file → TextEncoder flax tree.
+
+    The published separate-file releases (clip_l.safetensors /
+    clip_g.safetensors, the files ComfyUI's CLIPLoader /
+    DualCLIPLoader / TripleCLIPLoader consume) ship the HF layout with
+    bare `text_model.*` keys and — for with-projection towers — a
+    root-level sibling `text_projection.weight` (nn.Linear packing) or
+    a bare `text_projection` parameter; both are detected."""
+    if not any(k.startswith("text_model.") for k in state_dict):
+        raise ValueError(
+            "unrecognized standalone CLIP layout: expected bare "
+            "text_model.* keys (HF packing); got e.g. "
+            + ", ".join(sorted(state_dict)[:3])
+        )
+    entries = text_encoder_schedule(
+        cfg, prefix="text_model", projection_layout="linear"
+    )
+    if (
+        cfg.proj_dim is not None
+        and "text_projection.weight" not in state_dict
+        and "text_projection" in state_dict
+    ):
+        # rarer packing: a root-level bare projection parameter
+        entries = [
+            ("text_projection", fx, "param_bare")
+            if fx == "text_projection"
+            else (sd, fx, how)
+            for sd, fx, how in entries
+        ]
+    params, problems = _merge_into_template(
+        state_dict, entries, template, "te"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"CLIP text-encoder checkpoint mapping failed "
+            f"({len(problems)} problems): " + "; ".join(problems[:12])
+        )
+    return params, problems
 
 
 def load_vae_weights(

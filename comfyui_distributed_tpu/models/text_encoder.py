@@ -169,10 +169,11 @@ class TextEncoder(nn.Module):
         skip = default_skip if skip_last is None else max(int(skip_last), 0)
         if skip >= cfg.layers:
             # reference semantics (SDClipModel.clip_layer): a skip
-            # deeper than this tower falls back to the last layer —
-            # dual-tower bundles have different depths and a value
-            # valid for the deeper tower must not reject the shallower
-            skip = default_skip
+            # deeper than this tower falls back to the LAST layer
+            # (skip 0, not the tower's penultimate default) — dual-
+            # tower bundles have different depths and a value valid
+            # for the deeper tower must not reject the shallower
+            skip = 0
         tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding")(tokens)
         pos_emb = self.param(
             "position_embedding",

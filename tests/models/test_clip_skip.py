@@ -85,15 +85,28 @@ def test_skip_zero_on_penultimate_config_uses_full_stack():
     assert not np.array_equal(np.asarray(h_raw), np.asarray(h_def))
 
 
-def test_skip_too_deep_falls_back_to_default():
-    """ComfyUI clamps a too-deep clip_skip to the tower's last layer
-    (dual-tower bundles have different depths; a value valid for the
-    deeper tower must not reject the shallower one)."""
+def test_skip_too_deep_falls_back_to_last_layer():
+    """ComfyUI clamps a too-deep clip_skip to the tower's LAST layer
+    (skip 0), not its penultimate default — dual-tower bundles have
+    different depths; a value valid for the deeper tower must not
+    reject (or silently re-default) the shallower one."""
     cfg = TextEncoderConfig(width=32, layers=3, heads=2, max_length=8)
     model, params, tokens = _enc(cfg)
     h_deep, _ = model.apply(params, tokens, skip_last=3)
-    h_def, _ = model.apply(params, tokens)
-    np.testing.assert_array_equal(np.asarray(h_deep), np.asarray(h_def))
+    h_last, _ = model.apply(params, tokens, skip_last=0)
+    np.testing.assert_array_equal(np.asarray(h_deep), np.asarray(h_last))
+
+    # penultimate tower: too-deep is LAST layer, not the penultimate
+    # default (the reference's 'last', verified distinct)
+    pen = dataclasses.replace(
+        cfg, penultimate_hidden=True, final_ln_on_hidden=True
+    )
+    model2, params2, _ = _enc(pen)
+    h_deep2, _ = model2.apply(params2, tokens, skip_last=5)
+    h_last2, _ = model2.apply(params2, tokens, skip_last=0)
+    h_def2, _ = model2.apply(params2, tokens)
+    np.testing.assert_array_equal(np.asarray(h_deep2), np.asarray(h_last2))
+    assert not np.array_equal(np.asarray(h_deep2), np.asarray(h_def2))
 
 
 def test_clip_set_last_layer_node():

@@ -1,0 +1,154 @@
+"""Separate-file text encoders (the real Flux/SD3 distribution
+format; what ComfyUI's CLIPLoader family consumes): standalone HF
+clip_l/clip_g layouts and t5xxl files resolve per encoder name and
+override the bundle's weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+from comfyui_distributed_tpu.models.registry import create_model, get_config
+
+pytestmark = pytest.mark.slow
+
+
+def _save(path, state_dict):
+    import safetensors.numpy as st
+
+    st.save_file(
+        {k: np.ascontiguousarray(v) for k, v in state_dict.items()},
+        str(path),
+    )
+
+
+def _donor_te(name, seed):
+    cfg = get_config(name)
+    model = create_model(name)
+    params = model.init(
+        jax.random.key(seed), jnp.zeros((1, cfg.max_length), jnp.int32)
+    )
+    return cfg, flatten_params(jax.device_get(params)), params
+
+
+def test_load_clip_te_weights_hf_sibling_projection():
+    """clip_g-style standalone file: bare text_model.* keys + root
+    text_projection.weight (nn.Linear packing) round-trips exactly."""
+    cfg, flat, params = _donor_te("tiny-te-g", seed=5)
+    state_dict = sdc.synthesize_state_dict(
+        flat,
+        sdc.text_encoder_schedule(
+            cfg, prefix="text_model", projection_layout="linear"
+        ),
+    )
+    assert "text_projection.weight" in state_dict  # root-level sibling
+    _cfg2, _flat2, template = _donor_te("tiny-te-g", seed=0)
+    out, problems = sdc.load_clip_te_weights(state_dict, cfg, template)
+    assert problems == []
+    got = flatten_params(jax.device_get(out))
+    for key in flat:
+        np.testing.assert_array_equal(got[key], flat[key], err_msg=key)
+
+
+def test_load_clip_te_weights_rejects_unknown_layout():
+    cfg, _flat, template = _donor_te("tiny-te", seed=0)
+    with pytest.raises(ValueError, match="unrecognized standalone CLIP"):
+        sdc.load_clip_te_weights({"model.blocks.0.w": np.zeros(2)}, cfg, template)
+
+
+def test_checkpoint_bundled_te_beats_standalone_file(tmp_path, monkeypatch):
+    """A fine-tuned checkpoint's own text encoder must NOT be
+    clobbered by a same-named standalone encoder file in the same
+    directory (the base-CLIP-overwrites-finetune failure mode)."""
+    donor = pl.load_pipeline("tiny-unet", seed=21)
+    cfg_u = get_config("tiny-unet")
+    full_sd = {}
+    full_sd.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(donor.params["unet"])),
+            sdc.unet_schedule(cfg_u),
+        )
+    )
+    full_sd.update(
+        sdc.synthesize_state_dict(
+            flatten_params(jax.device_get(donor.params["vae"])),
+            sdc.vae_schedule(get_config("tiny-vae")),
+        )
+    )
+    te_flat = flatten_params(jax.device_get(donor.params["te"]))
+    full_sd.update(
+        sdc.synthesize_state_dict(
+            te_flat,
+            sdc.text_encoder_schedule(
+                get_config("tiny-te"),
+                prefix="cond_stage_model.transformer.text_model",
+            ),
+        )
+    )
+    _save(tmp_path / "tiny-unet.safetensors", full_sd)
+
+    # a DIFFERENT standalone encoder under the te's registry name
+    cfg_te, other_flat, _ = _donor_te("tiny-te", seed=77)
+    _save(
+        tmp_path / "tiny-te.safetensors",
+        sdc.synthesize_state_dict(
+            other_flat,
+            sdc.text_encoder_schedule(
+                cfg_te, prefix="text_model", projection_layout="linear"
+            ),
+        ),
+    )
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    got = flatten_params(jax.device_get(bundle.params["te"]))
+    for key in te_flat:
+        np.testing.assert_array_equal(got[key], te_flat[key], err_msg=key)
+
+
+def test_load_pipeline_resolves_separate_te_files(tmp_path, monkeypatch):
+    """CDT_CHECKPOINT_DIR holding per-encoder files (tiny-te-l /
+    tiny-te-g / tiny-t5-sd3 stems) loads them into an SD3 bundle —
+    end to end through load_pipeline."""
+    # donor weights, saved in the published standalone layouts
+    cfg_l, flat_l, _ = _donor_te("tiny-te-l", seed=11)
+    _save(
+        tmp_path / "tiny-te-l.safetensors",
+        sdc.synthesize_state_dict(
+            flat_l,
+            sdc.text_encoder_schedule(
+                cfg_l, prefix="text_model", projection_layout="linear"
+            ),
+        ),
+    )
+    cfg_t5, flat_t5, _ = _donor_te("tiny-t5-sd3", seed=13)
+    _save(
+        tmp_path / "tiny-t5-sd3.safetensors",
+        sdc.synthesize_state_dict(
+            flat_t5, sdc.t5_encoder_schedule(cfg_t5)
+        ),
+    )
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+    bundle = pl.load_pipeline("tiny-sd3", seed=0)
+    got_l = flatten_params(jax.device_get(bundle.params["te"]))
+    for key in flat_l:
+        np.testing.assert_array_equal(got_l[key], flat_l[key], err_msg=key)
+    got_t5 = flatten_params(jax.device_get(bundle.params["te3"]))
+    for key in flat_t5:
+        np.testing.assert_array_equal(got_t5[key], flat_t5[key], err_msg=key)
+    # te2 had no file: stays at its deterministic init
+    ref = pl.load_pipeline("tiny-sd3", seed=0)  # monkeypatched dir, no te2 file
+    np.testing.assert_array_equal(
+        np.asarray(
+            flatten_params(jax.device_get(bundle.params["te2"]))[
+                "params/token_embedding/embedding"
+            ]
+        ),
+        np.asarray(
+            flatten_params(jax.device_get(ref.params["te2"]))[
+                "params/token_embedding/embedding"
+            ]
+        ),
+    )
