@@ -244,20 +244,31 @@ def load_pipeline(
         te_params = mapped["te"]
         te2_params = mapped.get("te2", te2_params)
         te3_params = mapped.get("te3", te3_params)
-        # which encoder parts the FILE actually carried (per published
-        # layout prefixes) — a fine-tuned checkpoint's own encoders
-        # must not be clobbered by a same-named standalone file below
-        _te_markers = {
-            "te": (
-                "cond_stage_model.", "conditioner.embedders.0.",
-                "text_encoders.clip_l.",
-            ),
-            "te2": ("conditioner.embedders.1.", "text_encoders.clip_g."),
-            "te3": ("text_encoders.t5xxl.",),
-        }
-        for part, markers in _te_markers.items():
-            if any(k.startswith(markers) for k in state_dict):
-                ckpt_supplied.add(part)
+        # which encoder parts the FILE actually carried — a fine-tuned
+        # checkpoint's own encoders must not be clobbered by a
+        # same-named standalone file below. Detection mirrors each
+        # family loader's own part sniffing: for mmdit (Flux) te is
+        # the T5 and te2 the CLIP (load_flux_weights); the SD/SDXL/SD3
+        # layouts use their published key prefixes.
+        if family == "mmdit":
+            if any("layer.0.SelfAttention.q.weight" in k for k in state_dict):
+                ckpt_supplied.add("te")
+            if any("text_model.encoder.layers.0" in k for k in state_dict):
+                ckpt_supplied.add("te2")
+        else:
+            _te_markers = {
+                "te": (
+                    "cond_stage_model.", "conditioner.embedders.0.",
+                    "text_encoders.clip_l.",
+                ),
+                "te2": (
+                    "conditioner.embedders.1.", "text_encoders.clip_g.",
+                ),
+                "te3": ("text_encoders.t5xxl.",),
+            }
+            for part, markers in _te_markers.items():
+                if any(k.startswith(markers) for k in state_dict):
+                    ckpt_supplied.add(part)
 
     # Separate-file text encoders (the real Flux/SD3 distribution
     # format: t5xxl_fp16.safetensors / clip_l.safetensors / ... — what

@@ -167,13 +167,17 @@ class TextEncoder(nn.Module):
         b, t = tokens.shape
         default_skip = 1 if cfg.penultimate_hidden else 0
         skip = default_skip if skip_last is None else max(int(skip_last), 0)
+        force_post_ln = False
         if skip >= cfg.layers:
             # reference semantics (SDClipModel.clip_layer): a skip
-            # deeper than this tower falls back to the LAST layer
-            # (skip 0, not the tower's penultimate default) — dual-
-            # tower bundles have different depths and a value valid
-            # for the deeper tower must not reject the shallower
+            # deeper than this tower falls back to layer='last', whose
+            # output is POST final_layer_norm regardless of
+            # layer_norm_hidden_state (unlike an explicit skip 0,
+            # which is the pre-LN intermediate for no-LN towers) —
+            # dual-tower bundles have different depths and a value
+            # valid for the deeper tower must not reject the shallower
             skip = 0
+            force_post_ln = True
         tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding")(tokens)
         pos_emb = self.param(
             "position_embedding",
@@ -218,6 +222,7 @@ class TextEncoder(nn.Module):
             # skip=0 honors the same LN setting: a no-LN tower (SDXL
             # bigG/L) forced to the last layer returns the PRE-LN
             # state — ComfyUI's layer_norm_hidden_state=False at
-            # intermediate_output = num_layers - 1
-            hidden = x if apply_ln else pre_ln
+            # intermediate_output = num_layers - 1. The too-deep
+            # fallback is the exception (post-LN 'last', above).
+            hidden = x if (apply_ln or force_post_ln) else pre_ln
         return hidden, pooled

@@ -108,6 +108,21 @@ def test_skip_too_deep_falls_back_to_last_layer():
     np.testing.assert_array_equal(np.asarray(h_deep2), np.asarray(h_last2))
     assert not np.array_equal(np.asarray(h_deep2), np.asarray(h_def2))
 
+    # no-LN tower (SDXL-style): too-deep = reference 'last' = POST
+    # final LN — distinct from the explicit skip 0, which is pre-LN
+    raw = dataclasses.replace(pen, final_ln_on_hidden=False)
+    model3, params3, _ = _enc(raw)
+    h_deep3, _ = model3.apply(params3, tokens, skip_last=5)
+    h_zero3, _ = model3.apply(params3, tokens, skip_last=0)
+    plain3 = TextEncoder(
+        dataclasses.replace(
+            raw, penultimate_hidden=False, final_ln_on_hidden=False
+        )
+    )
+    h_post3, _ = plain3.apply(params3, tokens)  # full stack post-LN
+    np.testing.assert_array_equal(np.asarray(h_deep3), np.asarray(h_post3))
+    assert not np.array_equal(np.asarray(h_deep3), np.asarray(h_zero3))
+
 
 def test_clip_set_last_layer_node():
     from comfyui_distributed_tpu.graph.nodes_core import CLIPSetLastLayer

@@ -108,6 +108,50 @@ def test_checkpoint_bundled_te_beats_standalone_file(tmp_path, monkeypatch):
         np.testing.assert_array_equal(got[key], te_flat[key], err_msg=key)
 
 
+def test_flux_part_detection_uses_mmdit_layout(tmp_path, monkeypatch):
+    """For the mmdit (Flux) family te is the T5 and te2 the CLIP — the
+    checkpoint-supplied detection must mirror load_flux_weights' own
+    sniffing, not the SD-layout prefixes: a flux checkpoint bundling
+    the T5 keeps it against a same-named standalone file, while the
+    absent CLIP tower fills from its standalone file."""
+    cfg_t5, ckpt_t5_flat, _ = _donor_te("tiny-t5-shared", seed=31)
+    _save(
+        tmp_path / "tiny-flux.safetensors",
+        sdc.synthesize_state_dict(
+            ckpt_t5_flat, sdc.t5_encoder_schedule(cfg_t5)
+        ),
+    )
+    _cfg2, other_t5_flat, _ = _donor_te("tiny-t5-shared", seed=32)
+    _save(
+        tmp_path / "tiny-t5-shared.safetensors",
+        sdc.synthesize_state_dict(
+            other_t5_flat, sdc.t5_encoder_schedule(cfg_t5)
+        ),
+    )
+    cfg_clip, clip_flat, _ = _donor_te("tiny-te", seed=33)
+    _save(
+        tmp_path / "tiny-te.safetensors",
+        sdc.synthesize_state_dict(
+            clip_flat,
+            sdc.text_encoder_schedule(
+                cfg_clip, prefix="text_model", projection_layout="linear"
+            ),
+        ),
+    )
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+    bundle = pl.load_pipeline("tiny-flux", seed=0)
+    got_te = flatten_params(jax.device_get(bundle.params["te"]))
+    for key in ckpt_t5_flat:  # checkpoint's T5 wins over standalone
+        np.testing.assert_array_equal(
+            got_te[key], ckpt_t5_flat[key], err_msg=key
+        )
+    got_te2 = flatten_params(jax.device_get(bundle.params["te2"]))
+    for key in clip_flat:  # absent CLIP fills from its standalone file
+        np.testing.assert_array_equal(
+            got_te2[key], clip_flat[key], err_msg=key
+        )
+
+
 def test_load_pipeline_resolves_separate_te_files(tmp_path, monkeypatch):
     """CDT_CHECKPOINT_DIR holding per-encoder files (tiny-te-l /
     tiny-te-g / tiny-t5-sd3 stems) loads them into an SD3 bundle —
