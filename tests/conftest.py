@@ -11,8 +11,13 @@ import time of this conftest (pytest imports conftest before test
 modules).
 """
 
+import faulthandler
 import os
 import sys
+
+# a native crash anywhere in the suite (or at interpreter teardown)
+# must name its location instead of dying silently
+faulthandler.enable()
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
