@@ -178,6 +178,92 @@ class CLIPTextEncode:
 
 
 @register_node
+class ConditioningConcat:
+    """Concatenate two conditionings along the TOKEN axis (ComfyUI
+    ConditioningConcat parity): the model cross-attends over both
+    prompts' tokens in one pass. Everything else (pooled, hints,
+    masks) rides from conditioning_to."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_to": ("CONDITIONING",),
+                "conditioning_from": ("CONDITIONING",),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "concat"
+
+    def concat(self, conditioning_to, conditioning_from, context=None):
+        from ..ops.conditioning import as_conditioning
+
+        to_c = as_conditioning(conditioning_to).clone()
+        from_c = as_conditioning(conditioning_from)
+        to_c.context = jnp.concatenate(
+            [to_c.context, from_c.context], axis=1
+        )
+        return (to_c,)
+
+
+@register_node
+class ImageBatch:
+    """Batch-concatenate two images (ComfyUI ImageBatch parity): the
+    second image resizes to the first's geometry when they differ."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"image1": ("IMAGE",), "image2": ("IMAGE",)}
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "batch"
+
+    def batch(self, image1, image2, context=None):
+        if image1.shape[1:3] != image2.shape[1:3]:
+            # reference semantics: center-crop to the target aspect,
+            # THEN bilinear-resize (common_upscale 'center') — a raw
+            # stretch would squash aspect-mismatched frames
+            from ..ops import upscale as up_ops
+
+            h, w = image1.shape[1], image1.shape[2]
+            (image2,) = up_ops.center_crop_to_aspect([image2], h, w)
+            image2 = up_ops.resize_image(image2, h, w, "bilinear")
+        return (jnp.concatenate([image1, image2], axis=0),)
+
+
+@register_node
+class RepeatLatentBatch:
+    """Repeat latents along the batch axis (ComfyUI RepeatLatentBatch
+    parity); the noise_mask repeats with them."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "amount": ("INT", {"default": 1}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "repeat"
+
+    def repeat(self, samples: dict, amount=1, context=None):
+        n = max(1, int(amount))
+        out = dict(samples)
+        out["samples"] = jnp.concatenate([samples["samples"]] * n, axis=0)
+        mask = samples.get("noise_mask")
+        if mask is not None and getattr(mask, "ndim", 0) >= 3 and (
+            mask.shape[0] == samples["samples"].shape[0]
+        ):
+            out["noise_mask"] = jnp.concatenate([mask] * n, axis=0)
+        return (out,)
+
+
+@register_node
 class CLIPSetLastLayer:
     """Clip-skip (ComfyUI CLIPSetLastLayer parity): stop the CLIP
     tower stop_at_clip_layer blocks from the end when producing the

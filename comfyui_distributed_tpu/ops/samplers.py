@@ -301,10 +301,7 @@ def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond):
     if cfg_scale == 1.0:
         eps_pos = model_fn(x, sigma, pos)
         return eps_pos, eps_pos
-    same_structure = jax.tree_util.tree_structure(
-        pos
-    ) == jax.tree_util.tree_structure(neg)
-    if same_structure:
+    if _conds_batchable(pos, neg):
         x2 = jnp.concatenate([x, x], axis=0)
         s2 = jnp.concatenate([sigma, sigma], axis=0)
         c2 = jax.tree_util.tree_map(
@@ -894,6 +891,24 @@ def sample_flow(
     return x
 
 
+def _conds_batchable(pos, neg) -> bool:
+    """Whether cond/uncond can ride one 2B-batched model pass: same
+    tree structure AND same leaf shapes (token-concatenated positives
+    vs a plain negative differ on the token axis — those need two
+    passes)."""
+    if jax.tree_util.tree_structure(pos) != jax.tree_util.tree_structure(
+        neg
+    ):
+        return False
+    return [
+        getattr(leaf, "shape", None)
+        for leaf in jax.tree_util.tree_leaves(pos)
+    ] == [
+        getattr(leaf, "shape", None)
+        for leaf in jax.tree_util.tree_leaves(neg)
+    ]
+
+
 def cfg_flow_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
     """CFG for velocity models (same batched-pass trick as cfg_model)."""
     if cfg_scale == 1.0:
@@ -904,13 +919,17 @@ def cfg_flow_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
 
     def guided(x, t, cond):
         pos, neg = cond
-        x2 = jnp.concatenate([x, x], axis=0)
-        t2 = jnp.concatenate([t, t], axis=0)
-        c2 = jax.tree_util.tree_map(
-            lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
-        )
-        v2 = model_fn(x2, t2, c2)
-        v_pos, v_neg = jnp.split(v2, 2, axis=0)
+        if _conds_batchable(pos, neg):
+            x2 = jnp.concatenate([x, x], axis=0)
+            t2 = jnp.concatenate([t, t], axis=0)
+            c2 = jax.tree_util.tree_map(
+                lambda p, n: jnp.concatenate([p, n], axis=0), pos, neg
+            )
+            v2 = model_fn(x2, t2, c2)
+            v_pos, v_neg = jnp.split(v2, 2, axis=0)
+        else:
+            v_pos = model_fn(x, t, pos)
+            v_neg = model_fn(x, t, neg)
         return v_neg + cfg_scale * (v_pos - v_neg)
 
     return guided

@@ -1,0 +1,81 @@
+"""Batch/concat utility nodes (ConditioningConcat, ImageBatch,
+RepeatLatentBatch — ComfyUI substrate parity) and their end-to-end
+compatibility with the sampler."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    ConditioningConcat,
+    ImageBatch,
+    KSampler,
+    RepeatLatentBatch,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return pl.load_pipeline("tiny-unet", seed=0)
+
+
+def test_conditioning_concat_token_axis(bundle):
+    a = pl.encode_text_pooled(bundle, ["first prompt"])
+    b = pl.encode_text_pooled(bundle, ["second prompt"])
+    (c,) = ConditioningConcat().concat(a, b)
+    assert c.context.shape[1] == a.context.shape[1] + b.context.shape[1]
+    np.testing.assert_array_equal(
+        np.asarray(c.context[:, : a.context.shape[1]]), np.asarray(a.context)
+    )
+    # clone semantics: the input is untouched
+    assert a.context.shape[1] != c.context.shape[1]
+    # pooled rides from conditioning_to
+    np.testing.assert_array_equal(np.asarray(c.pooled), np.asarray(a.pooled))
+    # the concatenated conditioning samples end to end
+    neg = pl.encode_text_pooled(bundle, [""])
+    (out,) = KSampler().sample(
+        bundle, 1, 2, 7.0, "euler", "karras", c, neg,
+        {"samples": jnp.zeros((1, 8, 8, 4))}, denoise=1.0,
+    )
+    assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+def test_image_batch_resizes_second():
+    a = jnp.full((1, 32, 32, 3), 0.25)
+    b = jnp.full((2, 16, 16, 3), 0.75)
+    (out,) = ImageBatch().batch(a, b)
+    assert out.shape == (3, 32, 32, 3)
+    np.testing.assert_allclose(np.asarray(out[0]), 0.25)
+    np.testing.assert_allclose(np.asarray(out[1:]), 0.75, atol=1e-6)
+
+
+def test_image_batch_center_crops_aspect_mismatch():
+    """Aspect mismatch center-crops before resizing (reference
+    common_upscale 'center'), never stretches: marker stripes outside
+    the central crop must vanish."""
+    a = jnp.zeros((1, 16, 16, 3))
+    wide = np.zeros((1, 16, 32, 3), np.float32)
+    wide[:, :, :8] = 1.0  # stripe in the crop-discarded left margin
+    (out,) = ImageBatch().batch(a, jnp.asarray(wide))
+    assert out.shape == (2, 16, 16, 3)
+    # the central 16 columns of the wide image are all zero
+    np.testing.assert_allclose(np.asarray(out[1]), 0.0, atol=1e-6)
+
+
+def test_repeat_latent_batch():
+    z = jnp.arange(2 * 4 * 4 * 4, dtype=jnp.float32).reshape(2, 4, 4, 4)
+    mask = jnp.ones((2, 4, 4, 1))
+    (out,) = RepeatLatentBatch().repeat(
+        {"samples": z, "noise_mask": mask}, amount=3
+    )
+    assert out["samples"].shape == (6, 4, 4, 4)
+    np.testing.assert_array_equal(
+        np.asarray(out["samples"][2:4]), np.asarray(z)
+    )
+    assert out["noise_mask"].shape == (6, 4, 4, 1)
+    # amount < 1 clamps to a no-op copy
+    (one,) = RepeatLatentBatch().repeat({"samples": z}, amount=0)
+    assert one["samples"].shape == z.shape
