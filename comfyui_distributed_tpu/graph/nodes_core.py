@@ -235,6 +235,97 @@ class ImageBatch:
 
 
 @register_node
+class ImageCrop:
+    """Crop a pixel region (ComfyUI ImageCrop parity): x/y clamp into
+    the frame, width/height clamp to the remaining extent."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "width": ("INT", {"default": 512}),
+                "height": ("INT", {"default": 512}),
+                "x": ("INT", {"default": 0}),
+                "y": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "crop"
+
+    def crop(self, image, width=512, height=512, x=0, y=0, context=None):
+        h, w = image.shape[1], image.shape[2]
+        x0 = min(max(int(x), 0), w - 1)
+        y0 = min(max(int(y), 0), h - 1)
+        x1 = min(x0 + max(int(width), 1), w)
+        y1 = min(y0 + max(int(height), 1), h)
+        return (image[:, y0:y1, x0:x1, :],)
+
+
+@register_node
+class LatentComposite:
+    """Paste one latent into another at a pixel offset (ComfyUI
+    LatentComposite parity): offsets are pixels, converted to latent
+    cells by the nominal 8x node convention; `feather` blends a linear
+    ramp that many pixels into the pasted region's interior edges."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples_to": ("LATENT",),
+                "samples_from": ("LATENT",),
+                "x": ("INT", {"default": 0}),
+                "y": ("INT", {"default": 0}),
+                "feather": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "composite"
+
+    def composite(self, samples_to: dict, samples_from: dict, x=0, y=0,
+                  feather=0, context=None):
+        dst = samples_to["samples"]
+        src = samples_from["samples"]
+        lx = max(int(x), 0) // 8
+        ly = max(int(y), 0) // 8
+        fe = max(int(feather), 0) // 8
+        h = min(src.shape[1], dst.shape[1] - ly)
+        w = min(src.shape[2], dst.shape[2] - lx)
+        out = dict(samples_to)
+        if h <= 0 or w <= 0:
+            return (out,)
+        region = src[:, :h, :w, :]
+        if fe > 0:
+            # linear ramp into the pasted interior; an edge flush with
+            # the destination border keeps full weight (the reference
+            # skips the ramp there). Opposing edges MULTIPLY (the
+            # reference composes each edge's factor), so a region
+            # narrower than 2*fe blends weaker than either ramp alone
+            ramp_y = jnp.ones((h,), jnp.float32)
+            ramp_x = jnp.ones((w,), jnp.float32)
+            idx_y = jnp.arange(h, dtype=jnp.float32)
+            idx_x = jnp.arange(w, dtype=jnp.float32)
+            if ly > 0:
+                ramp_y = ramp_y * jnp.clip((idx_y + 1) / fe, 0.0, 1.0)
+            if ly + h < dst.shape[1]:
+                ramp_y = ramp_y * jnp.clip((h - idx_y) / fe, 0.0, 1.0)
+            if lx > 0:
+                ramp_x = ramp_x * jnp.clip((idx_x + 1) / fe, 0.0, 1.0)
+            if lx + w < dst.shape[2]:
+                ramp_x = ramp_x * jnp.clip((w - idx_x) / fe, 0.0, 1.0)
+            mask = (ramp_y[:, None] * ramp_x[None, :])[None, :, :, None]
+        else:
+            mask = 1.0
+        patch = dst[:, ly:ly + h, lx:lx + w, :]
+        blended = region * mask + patch * (1.0 - mask)
+        out["samples"] = dst.at[:, ly:ly + h, lx:lx + w, :].set(blended)
+        return (out,)
+
+
+@register_node
 class RepeatLatentBatch:
     """Repeat latents along the batch axis (ComfyUI RepeatLatentBatch
     parity); the noise_mask repeats with them."""

@@ -25,13 +25,14 @@ def bundle():
 def test_conditioning_concat_token_axis(bundle):
     a = pl.encode_text_pooled(bundle, ["first prompt"])
     b = pl.encode_text_pooled(bundle, ["second prompt"])
+    a_tokens_before = a.context.shape[1]
     (c,) = ConditioningConcat().concat(a, b)
-    assert c.context.shape[1] == a.context.shape[1] + b.context.shape[1]
+    assert c.context.shape[1] == a_tokens_before + b.context.shape[1]
     np.testing.assert_array_equal(
-        np.asarray(c.context[:, : a.context.shape[1]]), np.asarray(a.context)
+        np.asarray(c.context[:, :a_tokens_before]), np.asarray(a.context)
     )
-    # clone semantics: the input is untouched
-    assert a.context.shape[1] != c.context.shape[1]
+    # clone semantics: the input object is untouched
+    assert a.context.shape[1] == a_tokens_before
     # pooled rides from conditioning_to
     np.testing.assert_array_equal(np.asarray(c.pooled), np.asarray(a.pooled))
     # the concatenated conditioning samples end to end
@@ -63,6 +64,41 @@ def test_image_batch_center_crops_aspect_mismatch():
     assert out.shape == (2, 16, 16, 3)
     # the central 16 columns of the wide image are all zero
     np.testing.assert_allclose(np.asarray(out[1]), 0.0, atol=1e-6)
+
+
+def test_image_crop_clamps():
+    from comfyui_distributed_tpu.graph.nodes_core import ImageCrop
+
+    img = jnp.arange(1 * 16 * 16 * 3, dtype=jnp.float32).reshape(1, 16, 16, 3)
+    (out,) = ImageCrop().crop(img, width=8, height=4, x=6, y=2)
+    assert out.shape == (1, 4, 8, 3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(img[:, 2:6, 6:14]))
+    # out-of-range request clamps to the frame
+    (edge,) = ImageCrop().crop(img, width=100, height=100, x=12, y=12)
+    assert edge.shape == (1, 4, 4, 3)
+
+
+def test_latent_composite_paste_and_feather():
+    from comfyui_distributed_tpu.graph.nodes_core import LatentComposite
+
+    dst = {"samples": jnp.zeros((1, 8, 8, 4))}
+    src = {"samples": jnp.ones((1, 4, 4, 4))}
+    (out,) = LatentComposite().composite(dst, src, x=16, y=16, feather=0)
+    got = np.asarray(out["samples"])
+    np.testing.assert_array_equal(got[:, 2:6, 2:6], 1.0)  # pasted
+    np.testing.assert_array_equal(got[:, :2, :], 0.0)     # untouched
+    # feather ramps the interior edges instead of a hard seam
+    (fe,) = LatentComposite().composite(dst, src, x=16, y=16, feather=16)
+    gf = np.asarray(fe["samples"])
+    assert 0.0 < gf[0, 2, 3, 0] < 1.0  # ramped top edge
+    assert gf[0, 3, 3, 0] > gf[0, 2, 3, 0]  # ramp rises inward
+    # a paste flush with the border keeps full weight on that edge
+    (fl,) = LatentComposite().composite(dst, src, x=0, y=0, feather=16)
+    gl = np.asarray(fl["samples"])
+    np.testing.assert_allclose(gl[0, 0, 0], 1.0)
+    # fully out-of-range paste is a no-op
+    (off,) = LatentComposite().composite(dst, src, x=640, y=0)
+    np.testing.assert_array_equal(np.asarray(off["samples"]), 0.0)
 
 
 def test_repeat_latent_batch():
