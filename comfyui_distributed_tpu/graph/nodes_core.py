@@ -935,6 +935,46 @@ class ImageScale:
 
 
 @register_node
+class ImageScaleBy:
+    """Scale an image by a factor (ComfyUI ImageScaleBy parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "upscale_method": ("STRING", {"default": "bilinear"}),
+                "scale_by": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "scale"
+
+    def scale(self, image, upscale_method="bilinear", scale_by=1.0,
+              context=None):
+        from ..ops import upscale as up_ops
+
+        h, w = up_ops.scale_dims(image.shape[1], image.shape[2], scale_by)
+        return ImageScale().scale(image, upscale_method, w, h)
+
+
+@register_node
+class ImageInvert:
+    """Invert pixel values (ComfyUI ImageInvert parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("IMAGE",)}}
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "invert"
+
+    def invert(self, image, context=None):
+        return (1.0 - image,)
+
+
+@register_node
 class LatentUpscale:
     """Resize latents to a target pixel size (the hi-res-fix substrate;
     ComfyUI LatentUpscale parity — latent grid = pixels/8 by the node
@@ -1006,9 +1046,10 @@ class LatentUpscaleBy:
 
     def upscale(self, samples: dict, upscale_method="nearest-exact",
                 scale_by=1.5, context=None):
+        from ..ops import upscale as up_ops
+
         z = samples["samples"]
-        lh = max(1, int(round(z.shape[1] * float(scale_by))))
-        lw = max(1, int(round(z.shape[2] * float(scale_by))))
+        lh, lw = up_ops.scale_dims(z.shape[1], z.shape[2], scale_by)
         return LatentUpscale().upscale(
             samples, upscale_method, width=lw * 8, height=lh * 8
         )

@@ -114,6 +114,15 @@ def resolve_resize_dims(
     return target_h, target_w
 
 
+def scale_dims(h: int, w: int, factor: float) -> tuple[int, int]:
+    """(out_h, out_w) for a by-factor resize (the *UpscaleBy nodes):
+    round-to-nearest, floored at 1 — one place for the convention."""
+    return (
+        max(1, int(round(h * float(factor)))),
+        max(1, int(round(w * float(factor)))),
+    )
+
+
 def center_crop_to_aspect(arrs: list, out_h: int, out_w: int) -> list:
     """Center-crop [B, H, W, ...] planes to the (out_h, out_w) aspect
     (the common_upscale crop='center' rule); all planes share the

@@ -101,6 +101,20 @@ def test_latent_composite_paste_and_feather():
     np.testing.assert_array_equal(np.asarray(off["samples"]), 0.0)
 
 
+def test_image_scale_by_and_invert():
+    from comfyui_distributed_tpu.graph.nodes_core import (
+        ImageInvert,
+        ImageScaleBy,
+    )
+
+    img = jnp.full((1, 16, 16, 3), 0.25)
+    (up,) = ImageScaleBy().scale(img, "bilinear", 1.5)
+    assert up.shape == (1, 24, 24, 3)
+    np.testing.assert_allclose(np.asarray(up), 0.25, atol=1e-6)
+    (inv,) = ImageInvert().invert(img)
+    np.testing.assert_allclose(np.asarray(inv), 0.75, atol=1e-6)
+
+
 def test_repeat_latent_batch():
     z = jnp.arange(2 * 4 * 4 * 4, dtype=jnp.float32).reshape(2, 4, 4, 4)
     mask = jnp.ones((2, 4, 4, 1))
