@@ -1,0 +1,250 @@
+"""Mask node set (ComfyUI substrate parity: SolidMask, InvertMask,
+CropMask, MaskToImage, ImageToMask, MaskComposite, FeatherMask,
+GrowMask, ImageCompositeMasked, LatentCompositeMasked).
+
+Numeric oracles are independent numpy re-derivations of the host
+stack's loop semantics (per-column feather ramps, iterated 3x3 grey
+morphology), not calls into the implementation under test.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_mask import (
+    CropMask,
+    FeatherMask,
+    GrowMask,
+    ImageCompositeMasked,
+    ImageToMask,
+    InvertMask,
+    LatentCompositeMasked,
+    MaskComposite,
+    MaskToImage,
+    SolidMask,
+    as_mask,
+    composite,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def test_solid_and_invert():
+    (m,) = SolidMask().solid(value=0.25, width=8, height=4)
+    assert m.shape == (1, 4, 8)
+    np.testing.assert_allclose(np.asarray(m), 0.25)
+    (inv,) = InvertMask().invert(m)
+    np.testing.assert_allclose(np.asarray(inv), 0.75)
+
+
+def test_as_mask_normalizes_rank():
+    assert as_mask(np.zeros((4, 6))).shape == (1, 4, 6)
+    assert as_mask(np.zeros((2, 4, 6))).shape == (2, 4, 6)
+    assert as_mask(np.zeros((2, 4, 6, 1))).shape == (2, 4, 6)
+
+
+def test_crop_mask_clamps():
+    m = jnp.arange(64, dtype=jnp.float32).reshape(1, 8, 8) / 64.0
+    (c,) = CropMask().crop(m, x=5, y=6, width=10, height=10)
+    assert c.shape == (1, 2, 3)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(m)[:, 6:, 5:])
+
+
+def test_mask_image_roundtrip():
+    m = jnp.linspace(0, 1, 12).reshape(1, 3, 4)
+    (img,) = MaskToImage().mask_to_image(m)
+    assert img.shape == (1, 3, 4, 3)
+    (back,) = ImageToMask().image_to_mask(img, channel="green")
+    np.testing.assert_allclose(np.asarray(back), np.asarray(m))
+    with pytest.raises(ValueError):
+        ImageToMask().image_to_mask(img, channel="alpha")
+    with pytest.raises(ValueError):
+        ImageToMask().image_to_mask(img, channel="luma")
+
+
+@pytest.mark.parametrize(
+    "op,dest_v,src_v,expect",
+    [
+        ("multiply", 0.5, 0.5, 0.25),
+        ("add", 0.75, 0.75, 1.0),      # clamps at 1.0
+        ("subtract", 0.25, 0.75, 0.0),  # clamps at 0.0
+        ("and", 1.0, 1.0, 1.0),
+        ("and", 1.0, 0.0, 0.0),
+        ("or", 1.0, 0.0, 1.0),
+        ("xor", 1.0, 0.0, 1.0),
+        ("xor", 1.0, 1.0, 0.0),
+    ],
+)
+def test_mask_composite_ops_full_overlap(op, dest_v, src_v, expect):
+    dest = jnp.full((1, 4, 4), dest_v)
+    src = jnp.full((1, 4, 4), src_v)
+    (out,) = MaskComposite().combine(dest, src, operation=op)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_mask_composite_offset_keeps_outside():
+    dest = jnp.zeros((1, 6, 6))
+    src = jnp.ones((1, 3, 3))
+    (out,) = MaskComposite().combine(dest, src, x=4, y=4, operation="add")
+    arr = np.asarray(out)
+    # only the 2x2 clipped overlap changes
+    assert arr[:, 4:, 4:].min() == 1.0
+    assert arr.sum() == 4.0
+
+
+def test_mask_composite_rejects_unknown_op():
+    m = jnp.zeros((1, 2, 2))
+    with pytest.raises(ValueError):
+        MaskComposite().combine(m, m, operation="divide")
+
+
+def test_feather_matches_loop_semantics():
+    h, w, left, top, right, bottom = 7, 9, 3, 2, 4, 0
+    base = np.random.default_rng(0).random((1, h, w)).astype(np.float32)
+    expected = base.copy()
+    for x in range(left):
+        expected[:, :, x] *= (x + 1) / left
+    for x in range(right):
+        expected[:, :, -x - 1] *= (x + 1) / right
+    for y in range(top):
+        expected[:, y, :] *= (y + 1) / top
+    for y in range(bottom):
+        expected[:, -y - 1, :] *= (y + 1) / bottom
+    (out,) = FeatherMask().feather(
+        jnp.asarray(base), left=left, top=top, right=right, bottom=bottom
+    )
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def _np_morph(mask: np.ndarray, n: int, tapered: bool) -> np.ndarray:
+    """Oracle: iterated 3x3 grey morphology with edge-clamped borders."""
+    grow = n > 0
+    m = mask.copy()
+    for _ in range(abs(n)):
+        pad = np.pad(m, ((0, 0), (1, 1), (1, 1)), mode="edge")
+        out = np.empty_like(m)
+        h, w = m.shape[1:]
+        for y in range(h):
+            for x in range(w):
+                win = pad[:, y : y + 3, x : x + 3]
+                if tapered:
+                    vals = np.stack(
+                        [win[:, 1, 1], win[:, 0, 1], win[:, 2, 1],
+                         win[:, 1, 0], win[:, 1, 2]]
+                    )
+                else:
+                    vals = win.reshape(win.shape[0], -1).T
+                out[:, y, x] = vals.max(0) if grow else vals.min(0)
+        m = out
+    return m
+
+
+@pytest.mark.parametrize("expand", [2, -1])
+@pytest.mark.parametrize("tapered", [True, False])
+def test_grow_mask_matches_morphology_oracle(expand, tapered):
+    rng = np.random.default_rng(1)
+    base = (rng.random((2, 9, 11)) > 0.6).astype(np.float32)
+    (out,) = GrowMask().expand_mask(
+        jnp.asarray(base), expand=expand, tapered_corners=tapered
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), _np_morph(base, expand, tapered), rtol=1e-6
+    )
+
+
+def test_grow_mask_diamond_vs_square():
+    base = np.zeros((1, 7, 7), np.float32)
+    base[0, 3, 3] = 1.0
+    (diamond,) = GrowMask().expand_mask(jnp.asarray(base), expand=2,
+                                        tapered_corners=True)
+    (square,) = GrowMask().expand_mask(jnp.asarray(base), expand=2,
+                                       tapered_corners=False)
+    d, s = np.asarray(diamond), np.asarray(square)
+    assert d[0, 1, 1] == 0.0 and s[0, 1, 1] == 1.0  # corner of the 5x5
+    assert d[0, 1, 3] == 1.0 and d[0, 3, 1] == 1.0  # diamond tips
+
+
+def test_image_composite_masked_blend_and_clip():
+    dest = jnp.zeros((1, 8, 8, 3))
+    src = jnp.ones((1, 4, 4, 3))
+    mask = jnp.full((1, 4, 4), 0.5)
+    (out,) = ImageCompositeMasked().composite(
+        dest, src, x=6, y=6, mask=mask
+    )
+    arr = np.asarray(out)
+    np.testing.assert_allclose(arr[0, 6:, 6:], 0.5)
+    assert arr[0, :6].max() == 0.0 and arr[0, :, :6].max() == 0.0
+
+
+def test_image_composite_negative_offset():
+    dest = jnp.zeros((1, 6, 6, 1))
+    src = jnp.ones((1, 4, 4, 1))
+    (out,) = ImageCompositeMasked().composite(dest, src, x=-2, y=-2)
+    arr = np.asarray(out)[..., 0]
+    assert arr[0, :2, :2].min() == 1.0  # bottom-right quarter of src lands
+    assert arr[0, 2:, :].max() == 0.0 and arr[0, :, 2:].max() == 0.0
+
+
+def test_image_composite_resize_source():
+    dest = jnp.zeros((1, 8, 8, 3))
+    src = jnp.ones((1, 2, 2, 3))
+    (out,) = ImageCompositeMasked().composite(
+        dest, src, resize_source=True
+    )
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_composite_batch_broadcast():
+    dest = jnp.zeros((3, 4, 4, 2))
+    src = jnp.ones((1, 4, 4, 2))
+    out = composite(dest, src, 0, 0)
+    assert out.shape == (3, 4, 4, 2)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_composite_batched_mask_over_singleton_images():
+    dest = jnp.zeros((1, 4, 4, 3))
+    src = jnp.ones((1, 4, 4, 3))
+    mask = jnp.stack([jnp.zeros((4, 4)), jnp.ones((4, 4))])
+    (out,) = ImageCompositeMasked().composite(dest, src, mask=mask)
+    arr = np.asarray(out)
+    assert arr.shape == (2, 4, 4, 3)
+    np.testing.assert_allclose(arr[0], 0.0)
+    np.testing.assert_allclose(arr[1], 1.0)
+
+
+def test_feather_oversized_width_clamps_to_extent():
+    m = jnp.ones((1, 4, 4))
+    (out,) = FeatherMask().feather(m, left=8)
+    # left clamps to width 4: columns scale (i+1)/4, reaching 1.0
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 0], [0.25, 0.5, 0.75, 1.0], rtol=1e-6
+    )
+
+
+def test_grow_mask_traced_size_constant_in_expand():
+    import jax
+
+    base = jnp.zeros((1, 8, 8))
+
+    def run(expand):
+        return jax.make_jaxpr(
+            lambda m: GrowMask().expand_mask(m, expand=expand)[0]
+        )(base)
+
+    # fori_loop keeps the op count flat as expand grows
+    assert len(str(run(64)).splitlines()) == len(str(run(2)).splitlines())
+
+
+def test_latent_composite_masked_pixel_units():
+    dest = {"samples": jnp.zeros((1, 8, 8, 4))}
+    src = {"samples": jnp.ones((1, 4, 4, 4))}
+    # x=16 px → 2 latent cells
+    (out,) = LatentCompositeMasked().composite(dest, src, x=16, y=16)
+    arr = np.asarray(out["samples"])
+    np.testing.assert_allclose(arr[0, 2:6, 2:6], 1.0)
+    assert arr[0, :2].max() == 0.0
+    # untouched keys survive
+    dest2 = {"samples": jnp.zeros((1, 4, 4, 4)), "width": 32}
+    (out2,) = LatentCompositeMasked().composite(dest2, src, x=0, y=0)
+    assert out2["width"] == 32
