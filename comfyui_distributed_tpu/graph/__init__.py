@@ -21,3 +21,4 @@ from . import nodes_video  # noqa: F401,E402
 from . import nodes_audio  # noqa: F401,E402
 from . import nodes_controlnet  # noqa: F401,E402
 from . import nodes_mask  # noqa: F401,E402
+from . import nodes_custom_sampling  # noqa: F401,E402

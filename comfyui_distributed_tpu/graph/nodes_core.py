@@ -42,6 +42,15 @@ class SeedSpec:
     per_participant: bool = False  # True ⇒ fold over the mesh data axis
     worker_index: int = -1         # elastic tier: fixed offset applied
 
+    def effective_seed(self) -> int:
+        """The single-device seed: base plus the elastic-tier worker
+        offset (reference DistributedSeed's seed + worker_index + 1;
+        master / non-worker runs use the base seed unchanged). The one
+        place the offset rule lives — every sampler node calls this."""
+        return self.base_seed + (
+            self.worker_index + 1 if self.worker_index >= 0 else 0
+        )
+
 
 def resolve_seed(seed: Any) -> SeedSpec:
     if isinstance(seed, SeedSpec):
@@ -471,9 +480,7 @@ class KSampler:
             )
             return ({**extras, **result},)
 
-        effective_seed = spec.base_seed + (
-            spec.worker_index + 1 if spec.worker_index >= 0 else 0
-        )
+        effective_seed = spec.effective_seed()
         out = pl.img2img_latents(
             bundle,
             latents,
@@ -683,9 +690,7 @@ class KSamplerAdvanced:
             )
             return ({**extras, **result},)
 
-        effective_seed = spec.base_seed + (
-            spec.worker_index + 1 if spec.worker_index >= 0 else 0
-        )
+        effective_seed = spec.effective_seed()
         out = pl.img2img_latents_advanced(
             bundle,
             latents,

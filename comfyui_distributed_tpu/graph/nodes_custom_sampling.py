@@ -1,0 +1,466 @@
+"""Custom-sampling node cluster (ComfyUI custom_sampling parity).
+
+The standard shape of published Flux/SD3 workflows: the monolithic
+KSampler is decomposed into NOISE / GUIDER / SAMPLER / SIGMAS values
+produced by small nodes and consumed by SamplerCustom(-Advanced).
+The reference free-rides on ComfyUI for this entire surface
+(reference upscale/tile_ops.py:168 imports ComfyUI's samplers);
+here it is built on ops/samplers + models/pipeline.
+
+TPU notes: the sigma grid is a compile-time constant of the sampling
+program (static tuple through the jit boundary, see
+pipeline._custom_sigmas_jit), so every sampler — including the
+numpy-coefficient multistep ones — compiles to the same single-scan
+XLA program the KSampler path uses. DistributedSeed flowing into
+RandomNoise's noise_seed keeps the mesh fan-out path: one SPMD
+program sampling per-participant folded seeds (nodes_core._sample_mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import pipeline as pl
+from ..ops import samplers as smp
+from ..parallel.mesh import data_axis_size
+from .nodes_core import SeedSpec, _prep_latents, _sample_mesh, resolve_seed
+from .registry import register_node
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerSpec:
+    """A SAMPLER value: which trajectory solver to run."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseSpec:
+    """A NOISE value: where the initial noise comes from.
+
+    seed carries a SeedSpec so DistributedSeed flows through RandomNoise
+    unchanged (per-participant folding happens at the sampler node).
+    add_noise=False is DisableNoise: the trajectory starts from the
+    latents as-is (refine passes over leftover-noise latents).
+    """
+
+    seed: SeedSpec
+    add_noise: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class GuiderSpec:
+    """A GUIDER value: model + conditioning + guidance composition.
+
+    negative=None is BasicGuider (single-cond, cfg 1.0: exactly one
+    model eval per step); otherwise CFG over (positive, negative).
+    """
+
+    bundle: Any
+    positive: Any
+    negative: Any = None
+    cfg: float = 1.0
+
+
+def _terminal_zero(sigmas: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(
+        np.concatenate([sigmas.astype(np.float32), np.zeros((1,), np.float32)])
+    )
+
+
+@register_node
+class KSamplerSelect:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"sampler_name": ("STRING", {"default": "euler"})}}
+
+    RETURN_TYPES = ("SAMPLER",)
+    FUNCTION = "get_sampler"
+
+    def get_sampler(self, sampler_name: str, context=None):
+        name = str(sampler_name)
+        if name not in smp.SAMPLER_NAMES:
+            raise ValueError(
+                f"unknown sampler {name!r}; use {smp.SAMPLER_NAMES}"
+            )
+        return (SamplerSpec(name),)
+
+
+@register_node
+class BasicScheduler:
+    """Model-aware sigma schedule (ComfyUI BasicScheduler parity):
+    family-correct grid (VP table or shifted rectified-flow), shaped by
+    the scheduler knob; denoise < 1 truncates to the schedule tail
+    (total steps scale up so the tail still has `steps` points);
+    denoise == 0 yields an empty grid (the ComfyUI convention for
+    "no sampling")."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "scheduler": ("STRING", {"default": "normal"}),
+                "steps": ("INT", {"default": 20}),
+                "denoise": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, model, scheduler="normal", steps=20, denoise=1.0,
+                   context=None):
+        if float(denoise) <= 0.0:
+            return (jnp.zeros((0,), jnp.float32),)
+        param, shift = pl.model_schedule_info(model)
+        return (
+            smp.get_model_sigmas(
+                param, str(scheduler), int(steps),
+                denoise=float(denoise), flow_shift=shift,
+            ),
+        )
+
+
+@register_node
+class KarrasScheduler:
+    """Model-free Karras rho-spaced grid (ComfyUI KarrasScheduler
+    parity) with the terminal zero appended."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "steps": ("INT", {"default": 20}),
+                "sigma_max": ("FLOAT", {"default": 14.614642}),
+                "sigma_min": ("FLOAT", {"default": 0.0291675}),
+                "rho": ("FLOAT", {"default": 7.0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, steps=20, sigma_max=14.614642, sigma_min=0.0291675,
+                   rho=7.0, context=None):
+        return (
+            _terminal_zero(
+                smp.karras_sigmas(
+                    float(sigma_min), float(sigma_max), int(steps),
+                    rho=float(rho),
+                )
+            ),
+        )
+
+
+@register_node
+class ExponentialScheduler:
+    """Model-free log-uniform grid (ComfyUI ExponentialScheduler
+    parity) with the terminal zero appended."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "steps": ("INT", {"default": 20}),
+                "sigma_max": ("FLOAT", {"default": 14.614642}),
+                "sigma_min": ("FLOAT", {"default": 0.0291675}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, steps=20, sigma_max=14.614642, sigma_min=0.0291675,
+                   context=None):
+        return (
+            _terminal_zero(
+                smp.exponential_sigmas(
+                    float(sigma_min), float(sigma_max), int(steps)
+                )
+            ),
+        )
+
+
+@register_node
+class SplitSigmas:
+    """Split a schedule at a step boundary (ComfyUI SplitSigmas
+    parity): high = sigmas[:step+1], low = sigmas[step:] — the shared
+    point appears in both halves so chained SamplerCustomAdvanced
+    passes resume exactly where the first stopped."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "sigmas": ("SIGMAS",),
+                "step": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS", "SIGMAS")
+    RETURN_NAMES = ("high_sigmas", "low_sigmas")
+    FUNCTION = "split"
+
+    def split(self, sigmas, step=0, context=None):
+        s = int(step)
+        return (sigmas[: s + 1], sigmas[s:])
+
+
+@register_node
+class SplitSigmasDenoise:
+    """Split a schedule by denoise fraction (ComfyUI SplitSigmasDenoise
+    parity): the low half keeps the last round(steps*denoise) steps."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "sigmas": ("SIGMAS",),
+                "denoise": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS", "SIGMAS")
+    RETURN_NAMES = ("high_sigmas", "low_sigmas")
+    FUNCTION = "split"
+
+    def split(self, sigmas, denoise=1.0, context=None):
+        steps = max(int(sigmas.shape[0]) - 1, 0)
+        # round half-up, not int(): a workflow ported from the
+        # reference stack must resume its refine pass at the same step
+        kept = int(steps * max(0.0, min(1.0, float(denoise))) + 0.5)
+        s = steps - kept
+        return (sigmas[: s + 1], sigmas[s:])
+
+
+@register_node
+class FlipSigmas:
+    """Reverse a schedule for unsampling/noising workflows (ComfyUI
+    FlipSigmas parity); a leading zero becomes 1e-4 so the first step
+    has a nonzero sigma to start from."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"sigmas": ("SIGMAS",)}}
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "flip"
+
+    def flip(self, sigmas, context=None):
+        if int(sigmas.shape[0]) == 0:
+            return (sigmas,)
+        flipped = jnp.flip(sigmas, axis=0)
+        return (
+            jnp.where(
+                jnp.arange(flipped.shape[0]) == 0,
+                jnp.maximum(flipped, 1e-4),
+                flipped,
+            ),
+        )
+
+
+@register_node
+class RandomNoise:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"noise_seed": ("INT", {"default": 0})}}
+
+    RETURN_TYPES = ("NOISE",)
+    FUNCTION = "get_noise"
+
+    def get_noise(self, noise_seed, context=None):
+        return (NoiseSpec(seed=resolve_seed(noise_seed), add_noise=True),)
+
+
+@register_node
+class DisableNoise:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {}}
+
+    RETURN_TYPES = ("NOISE",)
+    FUNCTION = "get_noise"
+
+    def get_noise(self, context=None):
+        return (NoiseSpec(seed=SeedSpec(0), add_noise=False),)
+
+
+@register_node
+class BasicGuider:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "conditioning": ("CONDITIONING",),
+            }
+        }
+
+    RETURN_TYPES = ("GUIDER",)
+    FUNCTION = "get_guider"
+
+    def get_guider(self, model, conditioning, context=None):
+        return (GuiderSpec(bundle=model, positive=conditioning),)
+
+
+@register_node
+class CFGGuider:
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "cfg": ("FLOAT", {"default": 8.0}),
+            }
+        }
+
+    RETURN_TYPES = ("GUIDER",)
+    FUNCTION = "get_guider"
+
+    def get_guider(self, model, positive, negative, cfg=8.0, context=None):
+        return (
+            GuiderSpec(
+                bundle=model, positive=positive, negative=negative,
+                cfg=float(cfg),
+            ),
+        )
+
+
+def _run_custom(
+    noise: NoiseSpec,
+    guider: GuiderSpec,
+    sampler: SamplerSpec,
+    sigmas,
+    latent_image: dict,
+    context,
+) -> tuple[dict, dict]:
+    """Shared SamplerCustom / SamplerCustomAdvanced core. Routes the
+    per-participant-seed + noise-adding case through the one-SPMD-
+    program mesh path (nodes_core._sample_mesh); everything else
+    through pipeline.sample_custom_sigmas. Both paths honor the
+    two-output contract: when the grid stops above sigma 0, the second
+    output is the model's x0 prediction at the final point (the mesh
+    path computes it with one extra guided eval over the gathered
+    participant-major batch)."""
+    bundle = guider.bundle
+    latents, noise_mask, extras = _prep_latents(bundle, latent_image)
+    if int(sigmas.shape[0]) == 0:
+        out = {**extras, "samples": latents}
+        return out, dict(out)
+    positive = guider.positive
+    negative = guider.negative if guider.negative is not None else positive
+    cfg = guider.cfg if guider.negative is not None else 1.0
+    spec = noise.seed
+
+    mesh = getattr(context, "mesh", None) if context is not None else None
+    if (
+        noise.add_noise
+        and spec.per_participant
+        and mesh is not None
+        and data_axis_size(mesh) > 1
+    ):
+        result = _sample_mesh(
+            bundle, mesh, spec, jnp.asarray(sigmas, jnp.float32), cfg,
+            sampler.name, positive, negative, latents, noise_mask,
+        )
+        out = {**extras, **result}
+        final_sigma = float(np.asarray(sigmas)[-1])
+        if final_sigma == 0.0:
+            return out, dict(out)
+        denoised = pl.denoised_prediction(
+            bundle, result["samples"], positive, negative, cfg, final_sigma
+        )
+        if noise_mask is not None:
+            mask = jnp.clip(noise_mask.astype(jnp.float32), 0.0, 1.0)
+            denoised = denoised * mask + latents * (1.0 - mask)
+        return out, {**out, "samples": denoised}
+
+    effective_seed = spec.effective_seed()
+    out_l, denoised_l = pl.sample_custom_sigmas(
+        bundle,
+        latents,
+        positive,
+        negative,
+        sigmas,
+        sampler=sampler.name,
+        cfg_scale=cfg,
+        seed=int(effective_seed),
+        add_noise=noise.add_noise,
+        noise_mask=noise_mask,
+    )
+    return ({**extras, "samples": out_l}, {**extras, "samples": denoised_l})
+
+
+@register_node
+class SamplerCustom:
+    """Explicit-schedule sampler (ComfyUI SamplerCustom parity): the
+    KSampler knobs, but sampler and sigma grid arrive as values.
+    Outputs (output, denoised_output) — they differ only when the grid
+    stops above sigma 0 (leftover-noise two-stage workflows)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "add_noise": ("BOOLEAN", {"default": True}),
+                "noise_seed": ("INT", {"default": 0}),
+                "cfg": ("FLOAT", {"default": 8.0}),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "sampler": ("SAMPLER",),
+                "sigmas": ("SIGMAS",),
+                "latent_image": ("LATENT",),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT", "LATENT")
+    RETURN_NAMES = ("output", "denoised_output")
+    FUNCTION = "sample"
+
+    def sample(self, model, add_noise, noise_seed, cfg, positive, negative,
+               sampler, sigmas, latent_image, context=None):
+        noise = NoiseSpec(
+            seed=resolve_seed(noise_seed), add_noise=bool(add_noise)
+        )
+        guider = GuiderSpec(
+            bundle=model, positive=positive, negative=negative,
+            cfg=float(cfg),
+        )
+        return _run_custom(noise, guider, sampler, sigmas, latent_image,
+                           context)
+
+
+@register_node
+class SamplerCustomAdvanced:
+    """Fully decomposed sampler (ComfyUI SamplerCustomAdvanced parity):
+    NOISE + GUIDER + SAMPLER + SIGMAS in, (output, denoised_output)
+    out. The standard Flux workflow terminal node."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "noise": ("NOISE",),
+                "guider": ("GUIDER",),
+                "sampler": ("SAMPLER",),
+                "sigmas": ("SIGMAS",),
+                "latent_image": ("LATENT",),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT", "LATENT")
+    RETURN_NAMES = ("output", "denoised_output")
+    FUNCTION = "sample"
+
+    def sample(self, noise, guider, sampler, sigmas, latent_image,
+               context=None):
+        return _run_custom(noise, guider, sampler, sigmas, latent_image,
+                           context)

@@ -130,9 +130,7 @@ class VideoFlowSampler:
             b, f = out.shape[0], out.shape[1]
             return (out.reshape((b * f,) + out.shape[2:]),)
 
-        effective_seed = spec.base_seed + (
-            spec.worker_index + 1 if spec.worker_index >= 0 else 0
-        )
+        effective_seed = spec.effective_seed()
         out = vp._t2v_jit(
             vp._Static(bundle), bundle.params, positive, negative,
             jax.random.key(int(effective_seed)), frames, height, width,

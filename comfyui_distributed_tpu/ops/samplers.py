@@ -65,6 +65,26 @@ def get_sigmas(scheduler: str, steps: int, denoise: float = 1.0) -> jnp.ndarray:
     return jnp.asarray(np.concatenate([sigmas, np.zeros((1,))]), dtype=jnp.float32)
 
 
+def karras_sigmas(
+    sigma_min: float, sigma_max: float, steps: int, rho: float = 7.0
+):
+    """Descending Karras rho-ramp grid (no terminal zero) — shared by
+    the 'karras' scheduler branch and the KarrasScheduler node."""
+    import numpy as np
+
+    ramp = np.linspace(0, 1, steps)
+    min_r, max_r = sigma_min ** (1 / rho), sigma_max ** (1 / rho)
+    return (max_r + ramp * (min_r - max_r)) ** rho
+
+
+def exponential_sigmas(sigma_min: float, sigma_max: float, steps: int):
+    """Descending log-uniform grid (no terminal zero) — shared by the
+    'exponential' scheduler branch and the ExponentialScheduler node."""
+    import numpy as np
+
+    return np.exp(np.linspace(np.log(sigma_max), np.log(sigma_min), steps))
+
+
 def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
     """Descending [total_steps] sigma spacing over an ascending sigma
     table — the scheduler dispatch shared by the VP and flow families
@@ -77,12 +97,9 @@ def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
     sigma_min = float(all_sigmas[0])
 
     if scheduler == "karras":
-        rho = 7.0
-        ramp = np.linspace(0, 1, total_steps)
-        min_r, max_r = sigma_min ** (1 / rho), sigma_max ** (1 / rho)
-        sigmas = (max_r + ramp * (min_r - max_r)) ** rho
+        sigmas = karras_sigmas(sigma_min, sigma_max, total_steps)
     elif scheduler == "exponential":
-        sigmas = np.exp(np.linspace(np.log(sigma_max), np.log(sigma_min), total_steps))
+        sigmas = exponential_sigmas(sigma_min, sigma_max, total_steps)
     elif scheduler in ("normal", "simple"):
         idx = np.linspace(len(all_sigmas) - 1, 0, total_steps)
         sigmas = all_sigmas[idx.astype(np.int64)]

@@ -1,0 +1,311 @@
+"""Custom-sampling cluster (KSamplerSelect / schedulers / noise /
+guiders / SamplerCustom(-Advanced) / sigma utilities): the decomposed
+sampling surface standard Flux/SD3 workflows are built from."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    EmptyLatentImage,
+    KSampler,
+    SeedSpec,
+)
+from comfyui_distributed_tpu.graph.nodes_custom_sampling import (
+    BasicGuider,
+    BasicScheduler,
+    CFGGuider,
+    DisableNoise,
+    ExponentialScheduler,
+    FlipSigmas,
+    KarrasScheduler,
+    KSamplerSelect,
+    RandomNoise,
+    SamplerCustom,
+    SamplerCustomAdvanced,
+    SplitSigmas,
+    SplitSigmasDenoise,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    """tiny-unet with zero-init leaves perturbed (see
+    test_ksampler_advanced.bundle: zero-init out_conv ⇒ eps == 0 ⇒
+    trajectories never move, trivializing every comparison)."""
+    import jax
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def _cond(bundle):
+    return (
+        pl.encode_text_pooled(bundle, ["p"]),
+        pl.encode_text_pooled(bundle, [""]),
+    )
+
+
+# --- schedulers / sigma utilities (fast math, no model) ------------------
+
+def test_basic_scheduler_matches_model_sigmas(bundle):
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 6, 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(sig), np.asarray(smp.get_sigmas("karras", 6))
+    )
+
+
+def test_basic_scheduler_denoise_zero_is_empty(bundle):
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 6, 0.0)
+    assert sig.shape == (0,)
+
+
+def test_karras_scheduler_formula():
+    (sig,) = KarrasScheduler().get_sigmas(5, 10.0, 0.1, 7.0)
+    s = np.asarray(sig)
+    assert s.shape == (6,)
+    assert s[0] == pytest.approx(10.0)
+    assert s[4] == pytest.approx(0.1)
+    assert s[5] == 0.0
+    assert np.all(np.diff(s) < 0)
+
+
+def test_exponential_scheduler_log_spacing():
+    (sig,) = ExponentialScheduler().get_sigmas(4, 8.0, 1.0)
+    s = np.asarray(sig)
+    np.testing.assert_allclose(
+        s[:-1], np.exp(np.linspace(np.log(8.0), np.log(1.0), 4)), rtol=1e-6
+    )
+    assert s[-1] == 0.0
+
+
+def test_split_sigmas_shares_boundary_point():
+    sig = jnp.asarray(np.linspace(10.0, 0.0, 9), jnp.float32)
+    high, low = SplitSigmas().split(sig, 3)
+    assert high.shape == (4,)
+    assert low.shape == (6,)
+    assert float(high[-1]) == float(low[0])
+
+
+def test_split_sigmas_denoise():
+    sig = jnp.asarray(np.linspace(10.0, 0.0, 11), jnp.float32)  # 10 steps
+    high, low = SplitSigmasDenoise().split(sig, 0.3)  # keep last 3 steps
+    assert low.shape == (4,)
+    assert high.shape == (8,)
+    assert float(high[-1]) == float(low[0])
+    # fractional step counts round half-up (0.35 * 10 -> 4 kept steps),
+    # matching the reference stack's resume point
+    high, low = SplitSigmasDenoise().split(sig, 0.35)
+    assert low.shape == (5,)
+    assert high.shape == (7,)
+
+
+def test_flip_sigmas_bumps_leading_zero():
+    sig = jnp.asarray([10.0, 5.0, 0.0], jnp.float32)
+    (flipped,) = FlipSigmas().flip(sig)
+    f = np.asarray(flipped)
+    assert f[0] == pytest.approx(1e-4)
+    np.testing.assert_array_equal(f[1:], [5.0, 10.0])
+    (empty,) = FlipSigmas().flip(jnp.zeros((0,), jnp.float32))
+    assert empty.shape == (0,)
+
+
+def test_ksampler_select_validates():
+    (s,) = KSamplerSelect().get_sampler("euler")
+    assert s.name == "euler"
+    with pytest.raises(ValueError, match="unknown sampler"):
+        KSamplerSelect().get_sampler("nope")
+
+
+# --- sampling parity -----------------------------------------------------
+
+def test_sampler_custom_matches_ksampler(bundle):
+    """SamplerCustom fed KSampler's exact schedule walks the same
+    trajectory (same seed → same noise → same euler steps)."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (single,) = KSampler().sample(
+        bundle, 5, 4, 7.0, "euler", "karras", pos, neg, el, denoise=1.0
+    )
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    out, denoised = SamplerCustom().sample(
+        bundle, True, 5, 7.0, pos, neg, samp, sig, el
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["samples"]), np.asarray(single["samples"]), atol=1e-5
+    )
+    # grid ends at 0 ⇒ the two outputs coincide
+    np.testing.assert_array_equal(
+        np.asarray(out["samples"]), np.asarray(denoised["samples"])
+    )
+
+
+def test_two_stage_split_matches_single(bundle):
+    """RandomNoise + high half, then DisableNoise + low half (the
+    SplitSigmas refine pattern) equals one full run."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    single, _ = SamplerCustom().sample(
+        bundle, True, 5, 7.0, pos, neg, samp, sig, el
+    )
+    high, low = SplitSigmas().split(sig, 2)
+    (noise,) = RandomNoise().get_noise(5)
+    (guider,) = CFGGuider().get_guider(bundle, pos, neg, 7.0)
+    stage1, stage1_denoised = SamplerCustomAdvanced().sample(
+        noise, guider, samp, high, el
+    )
+    # leftover noise ⇒ the denoised prediction is a different array
+    assert not np.array_equal(
+        np.asarray(stage1["samples"]), np.asarray(stage1_denoised["samples"])
+    )
+    (no_noise,) = DisableNoise().get_noise()
+    stage2, _ = SamplerCustomAdvanced().sample(
+        no_noise, guider, samp, low, stage1
+    )
+    np.testing.assert_allclose(
+        np.asarray(stage2["samples"]), np.asarray(single["samples"]),
+        atol=5e-2,
+    )
+
+
+def test_basic_guider_is_cfg_one(bundle):
+    """BasicGuider (single cond) equals CFGGuider at cfg=1.0 — one
+    model eval per step, the Flux-style guidance shape."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (sig,) = BasicScheduler().get_sigmas(bundle, "normal", 3, 1.0)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    (noise,) = RandomNoise().get_noise(7)
+    (basic,) = BasicGuider().get_guider(bundle, pos)
+    (cfg1,) = CFGGuider().get_guider(bundle, pos, neg, 1.0)
+    out_b, _ = SamplerCustomAdvanced().sample(noise, basic, samp, sig, el)
+    out_c, _ = SamplerCustomAdvanced().sample(noise, cfg1, samp, sig, el)
+    np.testing.assert_allclose(
+        np.asarray(out_b["samples"]), np.asarray(out_c["samples"]), atol=1e-5
+    )
+
+
+def test_empty_sigmas_is_identity(bundle):
+    rng = np.random.default_rng(4)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    pos, _ = _cond(bundle)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    (noise,) = RandomNoise().get_noise(1)
+    (guider,) = BasicGuider().get_guider(bundle, pos)
+    out, denoised = SamplerCustomAdvanced().sample(
+        noise, guider, samp, jnp.zeros((0,), jnp.float32), {"samples": z}
+    )
+    np.testing.assert_array_equal(np.asarray(out["samples"]), np.asarray(z))
+    np.testing.assert_array_equal(
+        np.asarray(denoised["samples"]), np.asarray(z)
+    )
+
+
+def test_masked_custom_keeps_unmasked_region(bundle):
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.normal(size=(1, 8, 8, 4)), jnp.float32)
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, :, 4:] = 1.0
+    latent = {"samples": z, "noise_mask": jnp.asarray(mask)[..., None]}
+    pos, neg = _cond(bundle)
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    out, _ = SamplerCustom().sample(
+        bundle, True, 3, 7.0, pos, neg, samp, sig, latent
+    )
+    got = np.asarray(out["samples"])
+    np.testing.assert_array_equal(got[:, :, :4], np.asarray(z)[:, :, :4])
+    assert not np.allclose(got[:, :, 4:], np.asarray(z)[:, :, 4:])
+    assert "noise_mask" in out  # extras propagate
+
+
+def test_mesh_parallel_custom(bundle):
+    """DistributedSeed → RandomNoise → SamplerCustomAdvanced fans out
+    one SPMD program with per-participant folded seeds."""
+    from types import SimpleNamespace
+
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 8})
+    ctx = SimpleNamespace(mesh=mesh)
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    (noise,) = RandomNoise().get_noise(
+        SeedSpec(base_seed=9, per_participant=True)
+    )
+    (guider,) = CFGGuider().get_guider(bundle, pos, neg, 7.0)
+    out, denoised = SamplerCustomAdvanced().sample(
+        noise, guider, samp, sig, el, context=ctx
+    )
+    got = np.asarray(out["samples"])
+    assert got.shape[0] == 8
+    assert out.get("participant_major")
+    sums = {round(float(got[i].sum()), 4) for i in range(8)}
+    assert len(sums) == 8  # distinct participants
+    # grid ends at 0 ⇒ mesh path's shared denoised output is exact
+    np.testing.assert_array_equal(
+        got, np.asarray(denoised["samples"])
+    )
+
+    # leftover-noise grid on the mesh path: denoised_output must be
+    # the x0 prediction, not a copy of the noisy output
+    high, _low = SplitSigmas().split(sig, 2)
+    out_h, den_h = SamplerCustomAdvanced().sample(
+        noise, guider, samp, high, el, context=ctx
+    )
+    oh, dh = np.asarray(out_h["samples"]), np.asarray(den_h["samples"])
+    assert oh.shape == dh.shape == (8,) + oh.shape[1:]
+    assert not np.array_equal(oh, dh)
+    np.testing.assert_allclose(
+        dh,
+        np.asarray(
+            pl.denoised_prediction(
+                bundle, out_h["samples"], pos, neg, 7.0, float(high[-1])
+            )
+        ),
+        atol=1e-5,
+    )
+
+
+def test_denoised_prediction_matches_inline_branch(bundle):
+    """pipeline.denoised_prediction (the mesh path's extra eval) and
+    _custom_sigmas_jit's inline denoised branch compute the same x0."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    pos, neg = _cond(bundle)
+    (sig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    high, _ = SplitSigmas().split(sig, 2)
+    (samp,) = KSamplerSelect().get_sampler("euler")
+    out, denoised = SamplerCustom().sample(
+        bundle, True, 5, 7.0, pos, neg, samp, high, el
+    )
+    np.testing.assert_allclose(
+        np.asarray(denoised["samples"]),
+        np.asarray(
+            pl.denoised_prediction(
+                bundle, out["samples"], pos, neg, 7.0, float(high[-1])
+            )
+        ),
+        atol=1e-5,
+    )
