@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ..models.controlnet import load_controlnet
 from ..models.registry import get_config
-from ..ops.conditioning import Conditioning, as_conditioning
+from ..ops.conditioning import Conditioning, as_conditioning, map_conditioning
 from .registry import register_node
 
 
@@ -61,18 +61,69 @@ class ControlNetApply:
     FUNCTION = "apply"
 
     def apply(self, conditioning, control_net, image, strength=1.0, context=None):
-        cond = as_conditioning(conditioning).clone()
-        cond.control_hint = image
-        cond.control_strength = float(strength)
-        cond.control_params = control_net.params
-        cond.control_module = control_net.module
-        return (cond,)
+        def patch(cond):
+            cond.control_hint = image
+            cond.control_strength = float(strength)
+            cond.control_params = control_net.params
+            cond.control_module = control_net.module
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
+
+
+@register_node
+class ControlNetApplyAdvanced:
+    """Scheduled ControlNet application (ComfyUI ControlNetApplyAdvanced
+    parity): the hint applies to BOTH the positive and negative
+    conditioning, weighted by strength, and only while sampling
+    progress is inside [start_percent, end_percent) — the window gate
+    rides on the conditioning (Conditioning.control_range) and is
+    resolved against the model's schedule at sampling time."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "control_net": ("CONTROL_NET",),
+                "image": ("IMAGE",),
+                "strength": ("FLOAT", {"default": 1.0}),
+                "start_percent": ("FLOAT", {"default": 0.0}),
+                "end_percent": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING", "CONDITIONING")
+    RETURN_NAMES = ("positive", "negative")
+    FUNCTION = "apply"
+
+    def apply(self, positive, negative, control_net, image, strength=1.0,
+              start_percent=0.0, end_percent=1.0, context=None):
+        if float(strength) == 0.0:
+            return (positive, negative)
+
+        def patch(cond):
+            cond.control_hint = image
+            cond.control_strength = float(strength)
+            cond.control_params = control_net.params
+            cond.control_module = control_net.module
+            cond.control_range = (float(start_percent), float(end_percent))
+            return cond
+
+        return (
+            map_conditioning(positive, patch),
+            map_conditioning(negative, patch),
+        )
 
 
 @register_node
 class ConditioningSetArea:
-    """Restrict a conditioning entry to a pixel-space region (reference
-    crop_cond area handling)."""
+    """Restrict conditioning to a pixel-space region (ComfyUI
+    ConditioningSetArea parity): the entry's prediction is evaluated on
+    the area crop and composited by `strength` against overlapping
+    entries during sampling (samplers.composite_eps); USDU tile
+    cropping intersects the same area per tile."""
 
     @classmethod
     def INPUT_TYPES(cls):
@@ -83,16 +134,158 @@ class ConditioningSetArea:
                 "height": ("INT", {"default": 512}),
                 "x": ("INT", {"default": 0}),
                 "y": ("INT", {"default": 0}),
+                "strength": ("FLOAT", {"default": 1.0}),
             }
         }
 
     RETURN_TYPES = ("CONDITIONING",)
     FUNCTION = "set_area"
 
-    def set_area(self, conditioning, width, height, x, y, context=None):
-        cond = as_conditioning(conditioning).clone()
-        cond.area = (int(height), int(width), int(y), int(x))
-        return (cond,)
+    def set_area(self, conditioning, width, height, x, y, strength=1.0,
+                 context=None):
+        def patch(cond):
+            cond.area = (int(height), int(width), int(y), int(x))
+            cond.strength = float(strength)
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
+
+
+@register_node
+class ConditioningCombine:
+    """Combine two CONDITIONING values into a multi-entry list (ComfyUI
+    ConditioningCombine parity): each entry keeps its own area / mask /
+    strength / timestep window and the sampler composites their
+    predictions (samplers.composite_eps) — the regional-prompting
+    substrate."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_1": ("CONDITIONING",),
+                "conditioning_2": ("CONDITIONING",),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "combine"
+
+    def combine(self, conditioning_1, conditioning_2, context=None):
+        def entries(v):
+            if isinstance(v, (list, tuple)):
+                return [as_conditioning(e) for e in v]
+            return [as_conditioning(v)]
+
+        return (entries(conditioning_1) + entries(conditioning_2),)
+
+
+@register_node
+class ConditioningAverage:
+    """Weighted token-space interpolation (ComfyUI ConditioningAverage
+    parity): context and pooled lerp toward conditioning_to by
+    conditioning_to_strength; every other payload rides from the `to`
+    side. Applies per entry of a multi-entry `to`, pairing with the
+    first `from` entry (reference behavior)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning_to": ("CONDITIONING",),
+                "conditioning_from": ("CONDITIONING",),
+                "conditioning_to_strength": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "average"
+
+    def average(self, conditioning_to, conditioning_from,
+                conditioning_to_strength=1.0, context=None):
+        import jax.numpy as jnp
+
+        w = float(conditioning_to_strength)
+        src = conditioning_from
+        if isinstance(src, (list, tuple)):
+            src = src[0]
+        src = as_conditioning(src)
+
+        def lerp(a, b):
+            # token axes may differ (77 vs concat): `from` conforms to
+            # `to`'s length — padded with zeros when shorter, TRUNCATED
+            # when longer (reference behavior; the output always keeps
+            # conditioning_to's shape)
+            t = a.shape[1]
+            if b.shape[1] < t:
+                b = jnp.pad(b, ((0, 0), (0, t - b.shape[1]), (0, 0)))
+            elif b.shape[1] > t:
+                b = b[:, :t]
+            return a * w + b * (1.0 - w)
+
+        def patch(cond):
+            cond.context = lerp(cond.context, src.context)
+            if cond.pooled is not None and src.pooled is not None:
+                cond.pooled = cond.pooled * w + src.pooled * (1.0 - w)
+            return cond
+
+        return (map_conditioning(conditioning_to, patch),)
+
+
+@register_node
+class ConditioningZeroOut:
+    """Zero the context and pooled payloads (ComfyUI ConditioningZeroOut
+    parity — the Flux-style 'no negative' input)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"conditioning": ("CONDITIONING",)}}
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "zero_out"
+
+    def zero_out(self, conditioning, context=None):
+        import jax.numpy as jnp
+
+        def patch(cond):
+            cond.context = jnp.zeros_like(cond.context)
+            if cond.pooled is not None:
+                cond.pooled = jnp.zeros_like(cond.pooled)
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
+
+
+@register_node
+class ConditioningSetTimestepRange:
+    """Gate conditioning to a sampling-progress window (ComfyUI
+    ConditioningSetTimestepRange parity): the entry contributes only
+    while percent is in [start, end). Combined entries with
+    complementary windows are the reference stack's SD3 negative
+    recipe."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "start": ("FLOAT", {"default": 0.0}),
+                "end": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "set_range"
+
+    def set_range(self, conditioning, start=0.0, end=1.0, context=None):
+        if not 0.0 <= float(start) <= 1.0 or not 0.0 <= float(end) <= 1.0:
+            raise ValueError("start/end must be sampling percents in [0, 1]")
+
+        def patch(cond):
+            cond.timestep_range = (float(start), float(end))
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
 
 
 @register_node
@@ -115,9 +308,11 @@ class FluxGuidance:
     FUNCTION = "append"
 
     def append(self, conditioning, guidance, context=None):
-        cond = as_conditioning(conditioning).clone()
-        cond.guidance = float(guidance)
-        return (cond,)
+        def patch(cond):
+            cond.guidance = float(guidance)
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
 
 
 @register_node
@@ -211,11 +406,13 @@ class ReferenceLatent:
     FUNCTION = "append"
 
     def append(self, conditioning, latent, context=None):
-        cond = as_conditioning(conditioning).clone()
-        refs = list(cond.reference_latents or [])
-        refs.append(latent["samples"])
-        cond.reference_latents = refs
-        return (cond,)
+        def patch(cond):
+            refs = list(cond.reference_latents or [])
+            refs.append(latent["samples"])
+            cond.reference_latents = refs
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
 
 
 @register_node
@@ -233,6 +430,8 @@ class ConditioningSetMask:
     FUNCTION = "set_mask"
 
     def set_mask(self, conditioning, mask, context=None):
-        cond = as_conditioning(conditioning).clone()
-        cond.mask = mask
-        return (cond,)
+        def patch(cond):
+            cond.mask = mask
+            return cond
+
+        return (map_conditioning(conditioning, patch),)

@@ -187,6 +187,47 @@ class CLIPTextEncode:
 
 
 @register_node
+class CLIPTextEncodeSDXL:
+    """SDXL dual-prompt encoding (ComfyUI CLIPTextEncodeSDXL parity):
+    text_l feeds the CLIP-L tower, text_g the CLIP-G tower, and the
+    six size ints ride on the conditioning as the adm Fourier size
+    embeddings (orig h/w, crop t/l, target h/w) — overriding the
+    KSampler default of zero crops + latent-derived sizes."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP",),
+                "width": ("INT", {"default": 1024}),
+                "height": ("INT", {"default": 1024}),
+                "crop_w": ("INT", {"default": 0}),
+                "crop_h": ("INT", {"default": 0}),
+                "target_width": ("INT", {"default": 1024}),
+                "target_height": ("INT", {"default": 1024}),
+                "text_g": ("STRING", {"default": ""}),
+                "text_l": ("STRING", {"default": ""}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "encode"
+
+    def encode(self, clip: pl.PipelineBundle, width=1024, height=1024,
+               crop_w=0, crop_h=0, target_width=1024, target_height=1024,
+               text_g="", text_l="", context=None):
+        size_cond = (
+            int(height), int(width), int(crop_h), int(crop_w),
+            int(target_height), int(target_width),
+        )
+        return (
+            pl.encode_text_pooled_sdxl(
+                clip, [str(text_g)], [str(text_l)], size_cond=size_cond
+            ),
+        )
+
+
+@register_node
 class ConditioningConcat:
     """Concatenate two conditionings along the TOKEN axis (ComfyUI
     ConditioningConcat parity): the model cross-attends over both
@@ -206,14 +247,20 @@ class ConditioningConcat:
     FUNCTION = "concat"
 
     def concat(self, conditioning_to, conditioning_from, context=None):
-        from ..ops.conditioning import as_conditioning
+        from ..ops.conditioning import as_conditioning, map_conditioning
 
-        to_c = as_conditioning(conditioning_to).clone()
-        from_c = as_conditioning(conditioning_from)
-        to_c.context = jnp.concatenate(
-            [to_c.context, from_c.context], axis=1
-        )
-        return (to_c,)
+        src = conditioning_from
+        if isinstance(src, (list, tuple)):
+            src = src[0]  # reference behavior: first `from` entry
+        from_c = as_conditioning(src)
+
+        def patch(to_c):
+            to_c.context = jnp.concatenate(
+                [to_c.context, from_c.context], axis=1
+            )
+            return to_c
+
+        return (map_conditioning(conditioning_to, patch),)
 
 
 @register_node

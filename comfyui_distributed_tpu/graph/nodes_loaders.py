@@ -1,0 +1,299 @@
+"""Standalone component loaders + model-sampling patch nodes.
+
+The real Flux/SD3 distribution format ships the diffusion transformer,
+text encoders, and VAE as separate files; published workflows load
+them with UNETLoader / CLIPLoader / DualCLIPLoader / TripleCLIPLoader
+and patch schedule shape with the ModelSampling* nodes. The reference
+free-rides on ComfyUI for this whole surface (SURVEY §2: zero model
+code of its own); here it is built on models/pipeline.load_unet /
+load_clip and per-bundle schedule overrides (PipelineBundle
+.flow_shift_override / .parameterization_override — a replaced bundle
+recompiles the jitted samplers exactly once, the jit-friendly analog
+of ComfyUI's model_sampling object patch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+import jax.numpy as jnp
+
+from ..models import pipeline as pl
+from .registry import register_node
+
+
+def _stem(name: str) -> str:
+    """Workflow values carry filenames ('clip_l.safetensors'); registry
+    names are stems. Underscores normalize to the registry's hyphens
+    only when the literal name is unknown."""
+    from ..models.registry import MODEL_REGISTRY
+
+    base = os.path.splitext(str(name))[0]
+    if base in MODEL_REGISTRY:
+        return base
+    hyphenated = base.replace("_", "-")
+    return hyphenated if hyphenated in MODEL_REGISTRY else base
+
+
+@register_node
+class UNETLoader:
+    """Load a diffusion backbone only (ComfyUI UNETLoader parity).
+    weight_dtype accepts the ComfyUI values; on TPU the compute dtype
+    is the XLA program's concern, so anything but 'default' is a
+    no-op recorded for workflow compatibility."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "unet_name": ("STRING", {"default": "tiny-unet"}),
+                "weight_dtype": ("STRING", {"default": "default"}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "load_unet"
+
+    def load_unet(self, unet_name, weight_dtype="default", context=None):
+        name = _stem(unet_name)
+        cache_key = f"unet:{name}"
+        cache = getattr(context, "pipelines", {}) if context is not None else {}
+        if cache_key not in cache:
+            cache[cache_key] = pl.load_unet(name)
+        return (cache[cache_key],)
+
+
+def _load_clip_cached(names: list[str], layout: str, context):
+    cache_key = f"clip:{layout}:" + ",".join(names)
+    cache = getattr(context, "pipelines", {}) if context is not None else {}
+    if cache_key not in cache:
+        cache[cache_key] = pl.load_clip(names, layout=layout)
+    return cache[cache_key]
+
+
+# ComfyUI type values → load_clip layout names
+_CLIP_TYPE_MAP = {
+    "stable_diffusion": "sd",
+    "sdxl": "sdxl",
+    "flux": "flux",
+    "sd3": "sd3",
+}
+
+
+@register_node
+class CLIPLoader:
+    """Load a single text encoder (ComfyUI CLIPLoader parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name": ("STRING", {"default": "clip-l"}),
+                "type": ("STRING", {"default": "stable_diffusion"}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP",)
+    FUNCTION = "load_clip"
+
+    def load_clip(self, clip_name, type="stable_diffusion", context=None):
+        if str(type) != "stable_diffusion":
+            raise ValueError(
+                "CLIPLoader loads one encoder; type must be "
+                "'stable_diffusion' (use DualCLIPLoader/TripleCLIPLoader "
+                "for sdxl/flux/sd3 layouts)"
+            )
+        return (_load_clip_cached([_stem(clip_name)], "sd", context),)
+
+
+@register_node
+class DualCLIPLoader:
+    """Load two text encoders (ComfyUI DualCLIPLoader parity):
+    type sdxl (CLIP-L + CLIP-G), flux (CLIP + T5, either order), or
+    sd3 (CLIP-L + CLIP-G, T5-less low-memory mode)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name1": ("STRING", {"default": "clip-l"}),
+                "clip_name2": ("STRING", {"default": "clip-g"}),
+                "type": ("STRING", {"default": "sdxl"}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP",)
+    FUNCTION = "load_clip"
+
+    def load_clip(self, clip_name1, clip_name2, type="sdxl", context=None):
+        layout = _CLIP_TYPE_MAP.get(str(type))
+        if layout is None or layout == "sd":
+            raise ValueError(
+                f"DualCLIPLoader type must be sdxl, flux, or sd3; "
+                f"got {type!r}"
+            )
+        names = [_stem(clip_name1), _stem(clip_name2)]
+        return (_load_clip_cached(names, layout, context),)
+
+
+@register_node
+class TripleCLIPLoader:
+    """Load the full SD3 encoder set (ComfyUI TripleCLIPLoader parity:
+    CLIP-L + CLIP-G + T5; the T5 is sniffed by family, so argument
+    order beyond the two CLIPs doesn't matter)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name1": ("STRING", {"default": "clip-l-sd3"}),
+                "clip_name2": ("STRING", {"default": "clip-g"}),
+                "clip_name3": ("STRING", {"default": "t5-xxl-sd3"}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP",)
+    FUNCTION = "load_clip"
+
+    def load_clip(self, clip_name1, clip_name2, clip_name3, context=None):
+        names = [_stem(clip_name1), _stem(clip_name2), _stem(clip_name3)]
+        return (_load_clip_cached(names, "sd3", context),)
+
+
+@register_node
+class EmptySD3LatentImage:
+    """16-channel empty latents (ComfyUI EmptySD3LatentImage parity —
+    the SD3/Flux workflow starting point). Carries the same PLACEHOLDER
+    marker EmptyLatentImage uses, so KSampler still rebuilds against
+    the actual bundle's latent layout if it differs."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 1024}),
+                "height": ("INT", {"default": 1024}),
+                "batch_size": ("INT", {"default": 1}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "generate"
+
+    def generate(self, width=1024, height=1024, batch_size=1, context=None):
+        return (
+            {
+                "samples": jnp.zeros(
+                    (int(batch_size), int(height) // 8, int(width) // 8, 16)
+                ),
+                "width": int(width),
+                "height": int(height),
+                "empty": True,
+            },
+        )
+
+
+@register_node
+class ModelSamplingDiscrete:
+    """Override the VP parameterization (ComfyUI ModelSamplingDiscrete
+    parity): eps or v_prediction. zsnr rescaling is not implemented —
+    it errors rather than silently sampling the wrong schedule."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "sampling": ("STRING", {"default": "eps"}),
+                "zsnr": ("BOOLEAN", {"default": False}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, sampling="eps", zsnr=False, context=None):
+        mapping = {"eps": "eps", "v_prediction": "v"}
+        if str(sampling) not in mapping:
+            raise ValueError(
+                f"sampling must be one of {sorted(mapping)}; got {sampling!r}"
+            )
+        if zsnr:
+            raise ValueError(
+                "zsnr rescaling is not implemented in this framework"
+            )
+        return (
+            dataclasses.replace(
+                model, parameterization_override=mapping[str(sampling)]
+            ),
+        )
+
+
+def _require_flow(model, node: str):
+    if pl.model_schedule_info(model)[0] != "flow":
+        raise ValueError(
+            f"{node} patches flow-matching models (Flux/SD3 class); "
+            f"{model.model_name!r} is not one"
+        )
+
+
+@register_node
+class ModelSamplingSD3:
+    """Set the rectified-flow shift (ComfyUI ModelSamplingSD3 parity;
+    also the AuraFlow-style plain-shift knob)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "shift": ("FLOAT", {"default": 3.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, shift=3.0, context=None):
+        _require_flow(model, "ModelSamplingSD3")
+        return (
+            dataclasses.replace(model, flow_shift_override=float(shift)),
+        )
+
+
+@register_node
+class ModelSamplingFlux:
+    """Resolution-dependent flow shift (ComfyUI ModelSamplingFlux
+    parity): mu interpolates linearly in image-token count between
+    base_shift at 256 tokens and max_shift at 4096, and the effective
+    multiplicative shift is exp(mu) — Flux's time_shift(mu, t) equals
+    the shifted-sigma form sigma' = s*t/(1+(s-1)t) with s = e^mu."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "max_shift": ("FLOAT", {"default": 1.15}),
+                "base_shift": ("FLOAT", {"default": 0.5}),
+                "width": ("INT", {"default": 1024}),
+                "height": ("INT", {"default": 1024}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, max_shift=1.15, base_shift=0.5, width=1024,
+              height=1024, context=None):
+        _require_flow(model, "ModelSamplingFlux")
+        # image tokens at the 2x2-patch latent grid (pixels/16 per side)
+        seq = (int(width) // 16) * (int(height) // 16)
+        mu = float(base_shift) + (float(max_shift) - float(base_shift)) * (
+            (seq - 256) / (4096 - 256)
+        )
+        return (
+            dataclasses.replace(model, flow_shift_override=math.exp(mu)),
+        )

@@ -54,6 +54,11 @@ class PipelineBundle:
     # exclude from the hidden/context output; None = each tower's
     # configured default. Applies to CLIP towers only (T5 unaffected)
     clip_skip: int | None = None
+    # ModelSampling* node overrides (ComfyUI patches the model's
+    # sampling object; here a replaced bundle recompiles the jitted
+    # samplers exactly once). None = the registry config's values.
+    flow_shift_override: float | None = None
+    parameterization_override: str | None = None
 
 
 @dataclasses.dataclass
@@ -149,12 +154,7 @@ def load_pipeline(
     dual = DUAL_TEXT_ENCODERS.get(model_name)
     hidden_pooled = HIDDEN_POOLED_ENCODERS.get(model_name)
     triple = TRIPLE_TEXT_ENCODERS.get(model_name)
-    if family == "mmdit":
-        vae_name = vae_name or ("tiny-vae-flux" if tiny else "vae-flux")
-    elif family == "sd3":
-        vae_name = vae_name or ("tiny-vae-sd3" if tiny else "vae-sd3")
-    else:
-        vae_name = vae_name or ("tiny-vae" if tiny else "vae-sd")
+    vae_name = vae_name or _family_vae_name(model_name, family)
     te3_name = None
     if triple:
         # SD3 layout: CLIP-L + CLIP-G + T5
@@ -278,22 +278,7 @@ def load_pipeline(
     def _load_te_file(name, params_, part):
         if not name or params_ is None or part in ckpt_supplied:
             return params_
-        ckpt_ = sdc.find_checkpoint(name)
-        if not ckpt_:
-            return params_
-        from ..utils.logging import log
-
-        log(f"loading text-encoder checkpoint {ckpt_} for {name}")
-        sd_dict = sdc.read_checkpoint(ckpt_)
-        if model_family(name) == "t5_encoder":
-            out, _problems = sdc.load_t5_weights(
-                sd_dict, get_config(name), params_
-            )
-        else:
-            out, _problems = sdc.load_clip_te_weights(
-                sd_dict, get_config(name), params_
-            )
-        return out
+        return _load_te_checkpoint(name, params_)
 
     te_params = _load_te_file(te_name, te_params, "te")
     te2_params = _load_te_file(te2_name, te2_params, "te2")
@@ -346,6 +331,229 @@ def load_pipeline(
     )
 
 
+def _load_te_checkpoint(name: str, params_):
+    """Fill a text-encoder param tree from a separate-file checkpoint
+    resolving under the encoder's registry name (no-op when none
+    does). Shared by load_pipeline and load_clip."""
+    from . import sd_checkpoint as sdc
+    from .registry import model_family
+
+    ckpt_ = sdc.find_checkpoint(name)
+    if not ckpt_:
+        return params_
+    from ..utils.logging import log
+
+    log(f"loading text-encoder checkpoint {ckpt_} for {name}")
+    sd_dict = sdc.read_checkpoint(ckpt_)
+    if model_family(name) == "t5_encoder":
+        out, _problems = sdc.load_t5_weights(sd_dict, get_config(name), params_)
+    else:
+        out, _problems = sdc.load_clip_te_weights(
+            sd_dict, get_config(name), params_
+        )
+    return out
+
+
+def _family_vae_name(model_name: str, family: str) -> str:
+    """The default VAE registry name for a diffusion family (the
+    latent-geometry source shared by load_pipeline and load_unet)."""
+    tiny = model_name.startswith("tiny")
+    if family == "mmdit":
+        return "tiny-vae-flux" if tiny else "vae-flux"
+    if family == "sd3":
+        return "tiny-vae-sd3" if tiny else "vae-sd3"
+    return "tiny-vae" if tiny else "vae-sd"
+
+
+def load_unet(
+    model_name: str,
+    seed: int = 0,
+    checkpoint: str | None = None,
+) -> PipelineBundle:
+    """Diffusion-backbone-only bundle (the ComfyUI UNETLoader: real
+    Flux/SD3.5 distributions ship the transformer as its own file and
+    load text encoders / VAE separately). The bundle carries no VAE or
+    text encoders — wire VAELoader / CLIPLoader outputs alongside it;
+    latent geometry comes from the family's default VAE config.
+    Checkpoint resolution mirrors load_pipeline
+    (CDT_CHECKPOINT_DIR/<model_name>.*); both bare diffusion-file keys
+    and model.diffusion_model.-nested layouts map
+    (sd_checkpoint.load_diffusion_weights)."""
+    from . import sd_checkpoint as sdc
+    from .registry import model_family
+
+    family = model_family(model_name)
+    if family not in ("unet", "mmdit", "sd3"):
+        raise ValueError(
+            f"{model_name!r} (family {family!r}) is not an image diffusion "
+            "backbone; UNETLoader loads unet/mmdit/sd3 models"
+        )
+    unet = create_model(model_name)
+    unet_cfg = get_config(model_name)
+    vae_cfg = get_config(_family_vae_name(model_name, family))
+
+    lat = jnp.zeros((1, 16, 16, vae_cfg.latent_channels))
+    ctx = jnp.zeros((1, 8, unet_cfg.context_dim))
+    ts = jnp.zeros((1,))
+    k_unet = jax.random.key(seed)
+    if family in ("mmdit", "sd3"):
+        unet_params = unet.init(
+            k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.adm_in_channels))
+        )
+    else:
+        unet_params = unet.init(k_unet, lat, ts, ctx)
+
+    ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
+    if ckpt_path:
+        from ..utils.logging import log
+
+        log(f"loading diffusion-model checkpoint {ckpt_path} for {model_name}")
+        unet_params, _problems = sdc.load_diffusion_weights(
+            sdc.read_checkpoint(ckpt_path), unet_cfg, unet_params, family
+        )
+    return PipelineBundle(
+        model_name=model_name,
+        unet=unet,
+        vae=None,
+        text_encoder=None,
+        params={"unet": unet_params},
+        tokenizer=None,
+        latent_channels=vae_cfg.latent_channels,
+        latent_scale=vae_cfg.downscale,
+    )
+
+
+def _order_clip_towers(names: list[str]) -> list[str]:
+    """(CLIP-L, CLIP-G) ordering for the sdxl/sd3 layouts, sniffed by
+    tower width (G is the wider 1280-d tower) — the reference stack
+    identifies towers from the weights, so ported workflows pass the
+    two names in either order. Equal widths keep the given order."""
+    if len(names) == 2:
+        w0 = getattr(get_config(names[0]), "width", 0)
+        w1 = getattr(get_config(names[1]), "width", 0)
+        if w0 > w1:
+            return [names[1], names[0]]
+    return list(names)
+
+
+# CLIP-loader layouts → the representative diffusion family whose
+# conditioning composition _encode_raw applies (the bundle's own
+# encoders do the work; the name only picks the branch).
+_CLIP_LAYOUT_FAMILIES = {
+    "sd": None,      # default branch: single tower / SDXL-style concat
+    "sdxl": None,
+    "flux": ("tiny-flux", "flux-dev"),
+    "sd3": ("tiny-sd3", "sd3-medium"),
+}
+
+
+def load_clip(
+    te_names: list[str],
+    layout: str = "sd",
+    seed: int = 0,
+) -> PipelineBundle:
+    """Text-encoder-only bundle (the ComfyUI CLIPLoader /
+    DualCLIPLoader / TripleCLIPLoader family): encoders resolve by
+    registry name, real weights load from separate-file checkpoints
+    when they resolve (CDT_CHECKPOINT_DIR/<te_name>.*), and `layout`
+    picks the conditioning composition:
+
+      sd    — one CLIP tower (hidden + pooled)
+      sdxl  — CLIP-L + CLIP-G: feature concat, pooled from G
+      flux  — T5 hidden states + CLIP pooled (encoder order is
+              sniffed by family, so either argument order works)
+      sd3   — CLIP-L + CLIP-G [+ T5]: the SD3 composition; without a
+              T5 the CLIP sequence zero-pads to the backbone width
+              (the reference stack's low-memory SD3 mode)
+    """
+    from .registry import model_family
+    from .t5_encoder import T5Tokenizer
+
+    names = [str(n) for n in te_names]
+    expected = {"sd": 1, "sdxl": 2, "flux": 2, "sd3": (2, 3)}
+    if layout not in expected:
+        raise ValueError(
+            f"unknown CLIP layout {layout!r}; use {sorted(expected)}"
+        )
+    want = expected[layout]
+    ok = len(names) in want if isinstance(want, tuple) else len(names) == want
+    if not ok:
+        raise ValueError(
+            f"layout {layout!r} takes {want} encoder name(s), got {names}"
+        )
+
+    t5s = [n for n in names if model_family(n) == "t5_encoder"]
+    clips = [n for n in names if model_family(n) != "t5_encoder"]
+    if layout == "flux":
+        if len(t5s) != 1 or len(clips) != 1:
+            raise ValueError(
+                f"flux layout needs one T5-family and one CLIP-family "
+                f"encoder, got {names}"
+            )
+        ordered = [t5s[0], clips[0]]          # te = T5, te2 = CLIP
+    elif layout == "sd3":
+        if len(clips) != 2 or len(t5s) > 1:
+            raise ValueError(
+                f"sd3 layout needs two CLIP-family encoders and at most "
+                f"one T5, got {names}"
+            )
+        ordered = _order_clip_towers(clips) + t5s  # te = L, te2 = G [, T5]
+    else:
+        if t5s:
+            raise ValueError(
+                f"layout {layout!r} takes CLIP-family encoders only, "
+                f"got {names}"
+            )
+        ordered = (
+            _order_clip_towers(names) if layout == "sdxl" else names
+        )
+
+    rep_family = _CLIP_LAYOUT_FAMILIES[layout]
+    if rep_family is None:
+        bundle_name = ordered[0]
+    else:
+        tiny = all(n.startswith("tiny") for n in ordered)
+        bundle_name = rep_family[0] if tiny else rep_family[1]
+
+    encoders, tokenizers, params = [], [], {}
+    root = jax.random.key(seed)
+    for i, name in enumerate(ordered):
+        cfg = get_config(name)
+        enc = create_model(name)
+        tokens = jnp.zeros((1, cfg.max_length), jnp.int32)
+        p = enc.init(jax.random.fold_in(root, i), tokens)
+        p = _load_te_checkpoint(name, p)
+        encoders.append(enc)
+        if model_family(name) == "t5_encoder":
+            tokenizers.append(
+                T5Tokenizer(max_length=cfg.max_length, vocab_size=cfg.vocab_size)
+            )
+        else:
+            tokenizers.append(
+                Tokenizer(max_length=cfg.max_length, pad_id=cfg.pad_token_id)
+            )
+        params["te" if i == 0 else f"te{i + 1}"] = p
+
+    def slot(seq, i):
+        return seq[i] if len(seq) > i else None
+
+    return PipelineBundle(
+        model_name=bundle_name,
+        unet=None,
+        vae=None,
+        text_encoder=encoders[0],
+        params=params,
+        tokenizer=tokenizers[0],
+        text_encoder_2=slot(encoders, 1),
+        tokenizer_2=slot(tokenizers, 1),
+        text_encoder_3=slot(encoders, 2),
+        tokenizer_3=slot(tokenizers, 2),
+        te_name=ordered[0],
+        te2_name=slot(ordered, 1),
+        te3_name=slot(ordered, 2),
+    )
+
+
 # --- conditioning --------------------------------------------------------
 
 def _encode_raw(bundle: PipelineBundle, texts: list[str]):
@@ -363,10 +571,13 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
         # SD3 layout: CLIP-L/G penultimate states concatenated on
         # features, zero-padded to the T5 width, sequence-concatenated
         # with T5 states; pooled = CLIP-L pooled ++ CLIP-G pooled.
-        if bundle.text_encoder_2 is None or bundle.text_encoder_3 is None:
+        # A missing T5 (DualCLIPLoader type=sd3 — the reference
+        # stack's low-memory SD3 mode) keeps the CLIP-only sequence,
+        # padded to the backbone's context width.
+        if bundle.text_encoder_2 is None:
             raise ValueError(
-                f"{bundle.model_name}: sd3 bundles need all three text "
-                "encoders (CLIP-L, CLIP-G, T5)"
+                f"{bundle.model_name}: sd3 bundles need at least the two "
+                "CLIP encoders (CLIP-L, CLIP-G)"
             )
         tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
         h_l, p_l = bundle.text_encoder.apply(
@@ -379,18 +590,29 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
             bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
             skip_last=bundle.clip_skip,
         )
-        tokens3 = jnp.asarray(bundle.tokenizer_3.encode_batch(texts))
-        h_t5, _ = bundle.text_encoder_3.apply(bundle.params["te3"], tokens3)
         clip_ctx = jnp.concatenate(
             [h_l.astype(jnp.float32), h_g.astype(jnp.float32)], axis=-1
         )
-        width = h_t5.shape[-1]
+        if bundle.text_encoder_3 is not None:
+            tokens3 = jnp.asarray(bundle.tokenizer_3.encode_batch(texts))
+            h_t5, _ = bundle.text_encoder_3.apply(
+                bundle.params["te3"], tokens3
+            )
+            width = h_t5.shape[-1]
+        else:
+            h_t5 = None
+            width = getattr(
+                get_config(bundle.model_name), "context_dim",
+                clip_ctx.shape[-1],
+            )
         if clip_ctx.shape[-1] < width:
             clip_ctx = jnp.pad(
                 clip_ctx, ((0, 0), (0, 0), (0, width - clip_ctx.shape[-1]))
             )
-        hidden = jnp.concatenate(
-            [clip_ctx, h_t5.astype(jnp.float32)], axis=1
+        hidden = (
+            jnp.concatenate([clip_ctx, h_t5.astype(jnp.float32)], axis=1)
+            if h_t5 is not None
+            else clip_ctx
         )
         pooled = jnp.concatenate(
             [p_l.astype(jnp.float32), p_g.astype(jnp.float32)], axis=-1
@@ -434,8 +656,6 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
             [hidden.astype(jnp.float32), hidden2.astype(jnp.float32)], axis=-1
         )
         pooled = pooled2
-    from .registry import get_config
-
     ctx_dim = getattr(get_config(bundle.model_name), "context_dim", hidden.shape[-1])
     if hidden.shape[-1] < ctx_dim:
         hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1])))
@@ -459,28 +679,73 @@ def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
     return Conditioning(context=hidden, pooled=pooled)
 
 
+def encode_text_pooled_sdxl(
+    bundle: PipelineBundle,
+    texts_g: list[str],
+    texts_l: list[str],
+    size_cond: tuple | None = None,
+):
+    """Per-tower SDXL encoding (CLIPTextEncodeSDXL parity): text_l
+    feeds the CLIP-L tower, text_g the CLIP-G tower; context is the
+    feature concat, pooled comes from the projected G tower, and
+    size_cond carries the six adm size ints. With identical prompts
+    this reduces exactly to encode_text_pooled on a dual bundle."""
+    from ..ops.conditioning import Conditioning
+
+    if bundle.text_encoder_2 is None:
+        raise ValueError(
+            f"{bundle.model_name}: CLIPTextEncodeSDXL needs a dual-tower "
+            "(SDXL-layout) CLIP bundle"
+        )
+    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts_l))
+    h_l, _p_l = bundle.text_encoder.apply(
+        bundle.params["te"], tokens, eos_id=bundle.tokenizer.eos_id,
+        skip_last=bundle.clip_skip,
+    )
+    tok2 = bundle.tokenizer_2 or bundle.tokenizer
+    tokens2 = jnp.asarray(tok2.encode_batch(texts_g))
+    h_g, p_g = bundle.text_encoder_2.apply(
+        bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
+        skip_last=bundle.clip_skip,
+    )
+    hidden = jnp.concatenate(
+        [h_l.astype(jnp.float32), h_g.astype(jnp.float32)], axis=-1
+    )
+    ctx_dim = getattr(
+        get_config(bundle.model_name), "context_dim", hidden.shape[-1]
+    )
+    if hidden.shape[-1] < ctx_dim:
+        hidden = jnp.pad(
+            hidden, ((0, 0), (0, 0), (0, ctx_dim - hidden.shape[-1]))
+        )
+    elif hidden.shape[-1] > ctx_dim:
+        hidden = hidden[..., :ctx_dim]
+    return Conditioning(context=hidden, pooled=p_g, size_cond=size_cond)
+
+
 # --- model fn (VP eps / v / rectified-flow parameterisations) ------------
 
 def model_schedule_info(bundle: PipelineBundle) -> tuple[str, float]:
     """(parameterization, flow_shift) of the bundle's backbone — the
     knobs that pick the sigma schedule and img2img noising rule
     (ops/samplers.get_model_sigmas / noise_latents). Flow-matching
-    families (Flux class) carry parameterization == "flow"."""
+    families (Flux class) carry parameterization == "flow". The
+    ModelSampling* nodes override either knob per bundle."""
     cfg = get_config(bundle.model_name)
-    return (
-        getattr(cfg, "parameterization", "eps"),
-        getattr(cfg, "flow_shift", 3.0),
+    param = bundle.parameterization_override or getattr(
+        cfg, "parameterization", "eps"
     )
+    shift = bundle.flow_shift_override
+    if shift is None:
+        shift = getattr(cfg, "flow_shift", 3.0)
+    return (param, float(shift))
 
 
 def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
-        is_flow = (
-            getattr(get_config(bundle.model_name), "parameterization", "eps")
-            == "flow"
-        )
+        is_flow = model_schedule_info(bundle)[0] == "flow"
         context = cond.context if isinstance(cond, Conditioning) else cond
         if (
             context.shape[0] != x.shape[0]
@@ -515,6 +780,16 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
             if feats.shape[0] == 1 and x.shape[0] > 1:
                 feats = jnp.broadcast_to(feats, (x.shape[0],) + feats.shape[1:])
             control = feats * cond.control_strength
+            if cond.control_range is not None:
+                # ControlNetApplyAdvanced scheduling window: arithmetic
+                # gate on the per-step scalar sigma keeps the
+                # trajectory one XLA program
+                p2s = percent_converter(bundle)
+                sig_hi = p2s(float(cond.control_range[0]))
+                sig_lo = p2s(float(cond.control_range[1]))
+                s0 = sigma_batch[0]
+                gate = ((s0 <= sig_hi) & (s0 > sig_lo)).astype(control.dtype)
+                control = control * gate
         if (
             not is_flow
             and isinstance(cond, Conditioning)
@@ -534,14 +809,21 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
             if size_dims == 6 * 256:
                 # real SDXL adm layout: pooled text + six 256-d Fourier
                 # size embeddings (orig_h, orig_w, crop_t, crop_l,
-                # target_h, target_w) — crops 0, sizes from the latent
+                # target_h, target_w) — the CLIPTextEncodeSDXL node
+                # overrides them via cond.size_cond; the default is
+                # crops 0 with sizes from the latent
                 from .layers import timestep_embedding
 
-                h_px = x.shape[1] * bundle.latent_scale
-                w_px = x.shape[2] * bundle.latent_scale
-                vals = jnp.asarray(
-                    [h_px, w_px, 0.0, 0.0, h_px, w_px], jnp.float32
-                )
+                if cond.size_cond is not None:
+                    vals = jnp.asarray(
+                        [float(v) for v in cond.size_cond], jnp.float32
+                    )
+                else:
+                    h_px = x.shape[1] * bundle.latent_scale
+                    w_px = x.shape[2] * bundle.latent_scale
+                    vals = jnp.asarray(
+                        [h_px, w_px, 0.0, 0.0, h_px, w_px], jnp.float32
+                    )
                 size_emb = timestep_embedding(vals, 256).reshape(1, -1)
                 pooled = jnp.concatenate(
                     [
@@ -599,7 +881,7 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
         out = bundle.unet.apply(
             params["unet"], x * c_in, t, context, y=y, control=control
         )
-        if getattr(get_config(bundle.model_name), "parameterization", "eps") == "v":
+        if model_schedule_info(bundle)[0] == "v":
             # SD2.x-768-class velocity prediction. With the VP scalings
             # (c_skip = 1/(sigma^2+1), c_out = -sigma/sqrt(sigma^2+1)):
             #   denoised = x/(sigma^2+1) - v*sigma/sqrt(sigma^2+1)
@@ -613,22 +895,36 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
     return model_fn
 
 
+def percent_converter(bundle: PipelineBundle):
+    """The bundle-aware sampling-progress-percent → sigma converter
+    (timestep-window gates of multi-entry conditioning and scheduled
+    ControlNet hints)."""
+    param, shift = model_schedule_info(bundle)
+
+    def p2s(percent: float) -> float:
+        return smp.percent_to_sigma(percent, param, shift)
+
+    return p2s
+
+
 def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
-    """The guidance composition every sampling path shares: CFG, plus
-    skip-layer guidance when the bundle carries an SLGSpec (set by the
+    """The guidance composition every sampling path shares: CFG (with
+    multi-entry conditioning composition), plus skip-layer guidance
+    when the bundle carries an SLGSpec (set by the
     SkipLayerGuidanceSD3 node)."""
     base_fn = _make_model_fn(bundle, params)
+    p2s = percent_converter(bundle)
     slg = getattr(bundle, "slg", None)
     if not slg:
-        return smp.cfg_model(base_fn, cfg_scale)
-    param, shift = model_schedule_info(bundle)
+        return smp.cfg_model(base_fn, cfg_scale, p2s=p2s)
     return smp.slg_cfg_model(
         base_fn,
         _make_model_fn(bundle, params, skip_layers=slg.layers),
         cfg_scale,
         slg.scale,
-        smp.percent_to_sigma(slg.start_percent, param, shift),
-        smp.percent_to_sigma(slg.end_percent, param, shift),
+        p2s(slg.start_percent),
+        p2s(slg.end_percent),
+        p2s=p2s,
     )
 
 

@@ -1210,6 +1210,46 @@ def load_wan_weights(
     return params, problems
 
 
+def load_diffusion_weights(
+    state_dict: dict[str, np.ndarray],
+    unet_cfg,
+    template: Any,
+    family: str,
+    strict: bool = True,
+) -> tuple[Any, list[str]]:
+    """Map a diffusion-model-only file onto the backbone param tree —
+    the ComfyUI UNETLoader format (diffusion_models/ folder: published
+    flux1-*.safetensors, sd3.5 transformer repacks, extracted SD
+    UNets). Both key layouts load: bare keys and keys nested under
+    `model.diffusion_model.` (the single-file-checkpoint interior)."""
+    prefixed = any(k.startswith("model.diffusion_model.") for k in state_dict)
+    if family == "mmdit":
+        entries = flux_schedule(
+            unet_cfg, prefix="model.diffusion_model." if prefixed else ""
+        )
+    elif family == "sd3":
+        entries = sd3_schedule(
+            unet_cfg, prefix="model.diffusion_model." if prefixed else ""
+        )
+    else:
+        # unet_schedule hard-codes the single-file prefix; bare
+        # separate-file keys gain it instead of forking the schedule
+        if not prefixed:
+            state_dict = {
+                f"model.diffusion_model.{k}": v for k, v in state_dict.items()
+            }
+        entries = unet_schedule(unet_cfg)
+    params, problems = _merge_into_template(
+        state_dict, entries, template, "unet"
+    )
+    if problems and strict:
+        raise ValueError(
+            f"diffusion-model mapping failed ({len(problems)} problems): "
+            + "; ".join(problems[:12])
+        )
+    return params, problems
+
+
 def load_sd_weights(
     state_dict: dict[str, np.ndarray],
     unet_cfg,

@@ -58,6 +58,20 @@ class Conditioning:
     # Flux-class distilled guidance scale (the FluxGuidance node);
     # None = the model config's default
     guidance: Optional[float] = None
+    # SDXL size conditioning override (CLIPTextEncodeSDXL): six ints
+    # (orig_h, orig_w, crop_t, crop_l, target_h, target_w) feeding the
+    # Fourier size embeddings of the adm vector; None = derive from
+    # the latent geometry with zero crops (the KSampler default)
+    size_cond: Optional[tuple] = None
+    # entry weight in multi-cond composition (ConditioningSetArea /
+    # SetMask strength — NOT the ControlNet hint strength above)
+    strength: float = 1.0
+    # sampling-progress window (ConditioningSetTimestepRange): the
+    # entry contributes only while percent is in [start, end)
+    timestep_range: Optional[tuple] = None
+    # ControlNet scheduling window (ControlNetApplyAdvanced
+    # start_percent/end_percent): the hint is gated to this window
+    control_range: Optional[tuple] = None
     # Named spatial model patches (the TPU-native analog of the
     # reference's crop_model_patch context manager for DiffSynth/
     # ZImage transformer patches): pixel-space [B, H, W, C] arrays
@@ -76,6 +90,16 @@ def as_conditioning(value: Any) -> Conditioning:
     if isinstance(value, Conditioning):
         return value
     return Conditioning(context=value)
+
+
+def map_conditioning(value: Any, fn) -> Any:
+    """Apply an entry transform across a CONDITIONING value — a single
+    entry, or the list ConditioningCombine produces (the reference
+    stack applies modifier nodes to every entry of a list). `fn`
+    receives a cloned Conditioning and returns the modified entry."""
+    if isinstance(value, (list, tuple)):
+        return [fn(as_conditioning(v).clone()) for v in value]
+    return fn(as_conditioning(value).clone())
 
 
 def crop_to_tile(
@@ -263,6 +287,8 @@ def _cond_flatten(cond: Conditioning):
     aux = (
         cond.control_strength, cond.area, cond.control_module,
         cond.gligen_boxes, cond.gligen_active, cond.guidance,
+        cond.size_cond, cond.strength, cond.timestep_range,
+        cond.control_range,
     )
     return children, aux
 
@@ -271,7 +297,8 @@ def _cond_unflatten(aux, children):
     (context, control_hint, mask, control_params, pooled, gligen_embs,
      reference_latents, model_patches) = children
     (control_strength, area, control_module, gligen_boxes,
-     gligen_active, guidance) = aux
+     gligen_active, guidance, size_cond, strength, timestep_range,
+     control_range) = aux
     return Conditioning(
         context=context,
         control_hint=control_hint,
@@ -285,6 +312,10 @@ def _cond_unflatten(aux, children):
         gligen_boxes=gligen_boxes,
         gligen_active=gligen_active,
         guidance=guidance,
+        size_cond=size_cond,
+        strength=strength,
+        timestep_range=timestep_range,
+        control_range=control_range,
         reference_latents=reference_latents,
         model_patches=model_patches,
     )

@@ -307,14 +307,112 @@ def percent_to_sigma(
     return float(table[round((1.0 - p) * (len(table) - 1))])
 
 
+# --- multi-cond composition ----------------------------------------------
+
+def _as_entries(cond) -> list:
+    """A CONDITIONING value as a list of entries (ConditioningCombine
+    produces lists; everything else is a single entry)."""
+    if isinstance(cond, (list, tuple)):
+        return list(cond)
+    return [cond]
+
+
+def _needs_composite(cond) -> bool:
+    """True when a CONDITIONING value needs the per-entry composition
+    path: multiple entries, or spatial/schedule restrictions on one."""
+    entries = _as_entries(cond)
+    if len(entries) > 1:
+        return True
+    e = entries[0]
+    return (
+        getattr(e, "area", None) is not None
+        or getattr(e, "mask", None) is not None
+        or getattr(e, "timestep_range", None) is not None
+    )
+
+
+def _default_p2s(percent: float) -> float:
+    return percent_to_sigma(percent, "eps", 3.0)
+
+
+def composite_eps(model_fn: ModelFn, x, sigma, cond, p2s=_default_p2s):
+    """Multi-entry conditioning composition (the reference stack's
+    calc_cond_batch semantics): each entry's prediction applies over
+    its area (latent units = pixels//8, evaluated on the CROP — a
+    static shape per entry), weighted by strength x mask x
+    timestep-window gate, accumulated and normalized by total weight.
+    Uncovered cells contribute zero eps (denoised = x there), matching
+    the reference's division-by-count behavior. The timestep gate is
+    arithmetic on sigma[0] (one scalar per step), so the trajectory
+    stays one XLA program."""
+    entries = _as_entries(cond)
+    acc = jnp.zeros_like(x)
+    count = jnp.zeros(x.shape[:-1] + (1,), x.dtype)
+    for e in entries:
+        weight = float(getattr(e, "strength", 1.0))
+        gate = None
+        rng = getattr(e, "timestep_range", None)
+        if rng is not None:
+            sig_hi = p2s(float(rng[0]))
+            sig_lo = p2s(float(rng[1]))
+            s0 = sigma[0]
+            gate = ((s0 <= sig_hi) & (s0 > sig_lo)).astype(x.dtype)
+        mask = getattr(e, "mask", None)
+        if mask is not None:
+            m = jnp.asarray(mask, x.dtype)
+            if m.ndim == 4:
+                m = m[..., 0]
+            if m.ndim == 2:
+                m = m[None]
+            if m.shape[1:] != x.shape[1:3]:
+                m = jax.image.resize(
+                    m, (m.shape[0], x.shape[1], x.shape[2]), method="linear"
+                )
+            wmap = jnp.clip(m, 0.0, 1.0)[..., None] * weight
+        else:
+            wmap = jnp.full(x.shape[:-1] + (1,), weight, x.dtype)
+        if gate is not None:
+            wmap = wmap * gate
+        area = getattr(e, "area", None)
+        if area is not None:
+            ah, aw, ay, ax = (int(v) // 8 for v in area)
+            # clamp origin INTO the latent too: an off-frame origin
+            # would slice a zero-size crop and crash the model trace
+            ay = min(max(ay, 0), x.shape[1] - 1)
+            ax = min(max(ax, 0), x.shape[2] - 1)
+            ah = max(1, min(ah, x.shape[1] - ay))
+            aw = max(1, min(aw, x.shape[2] - ax))
+            x_c = x[:, ay:ay + ah, ax:ax + aw, :]
+            eps_c = model_fn(x_c, sigma, e)
+            w_c = jnp.broadcast_to(
+                wmap, x.shape[:-1] + (1,)
+            )[:, ay:ay + ah, ax:ax + aw, :]
+            acc = acc.at[:, ay:ay + ah, ax:ax + aw, :].add(eps_c * w_c)
+            count = count.at[:, ay:ay + ah, ax:ax + aw, :].add(w_c)
+        else:
+            eps = model_fn(x, sigma, e)
+            acc = acc + eps * wmap
+            count = count + jnp.broadcast_to(wmap, count.shape)
+    return acc / jnp.maximum(count, 1e-9)
+
+
 # --- CFG wrapper ---------------------------------------------------------
 
-def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond):
+def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond,
+              p2s=_default_p2s):
     """One CFG evaluation: returns (eps_pos, guided_eps). Batches the
     cond/uncond passes into one model call (2B batch) — on TPU one big
     MXU matmul beats two small ones. Shared by cfg_model and
-    slg_cfg_model (which also needs the bare eps_pos)."""
+    slg_cfg_model (which also needs the bare eps_pos). Multi-entry or
+    area/mask/timestep-restricted conditioning takes the per-entry
+    composition path instead of the 2B batch."""
     pos, neg = cond
+    if _needs_composite(pos) or _needs_composite(neg):
+        eps_pos = composite_eps(model_fn, x, sigma, pos, p2s)
+        if cfg_scale == 1.0:
+            return eps_pos, eps_pos
+        eps_neg = composite_eps(model_fn, x, sigma, neg, p2s)
+        return eps_pos, eps_neg + cfg_scale * (eps_pos - eps_neg)
     if cfg_scale == 1.0:
         eps_pos = model_fn(x, sigma, pos)
         return eps_pos, eps_pos
@@ -334,11 +432,15 @@ def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond):
     return eps_pos, eps_neg + cfg_scale * (eps_pos - eps_neg)
 
 
-def cfg_model(model_fn: ModelFn, cfg_scale: float) -> ModelFn:
-    """Classifier-free guidance: cond is (positive, negative) pair."""
+def cfg_model(model_fn: ModelFn, cfg_scale: float,
+              p2s=_default_p2s) -> ModelFn:
+    """Classifier-free guidance: cond is (positive, negative) pair.
+    `p2s` converts sampling-progress percent → sigma for the
+    timestep-window gates of multi-entry conditioning (pass the
+    bundle-aware converter; the default assumes the VP table)."""
 
     def guided(x, sigma, cond):
-        _eps_pos, out = _cfg_eval(model_fn, cfg_scale, x, sigma, cond)
+        _eps_pos, out = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
         return out
 
     return guided
@@ -351,6 +453,7 @@ def slg_cfg_model(
     slg_scale: float,
     sigma_start: float,
     sigma_end: float,
+    p2s=_default_p2s,
 ) -> ModelFn:
     """CFG plus SD3.5 skip-layer guidance: the result gains
     slg_scale * (cond - cond_with_skipped_layers) while sigma is in
@@ -365,10 +468,13 @@ def slg_cfg_model(
 
     def guided(x, sigma, cond):
         pos, _neg = cond
-        eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond)
+        eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
 
         def correction(_):
-            eps_skip = skip_model_fn(x, sigma, pos)
+            if _needs_composite(pos):
+                eps_skip = composite_eps(skip_model_fn, x, sigma, pos, p2s)
+            else:
+                eps_skip = skip_model_fn(x, sigma, pos)
             return slg_scale * (eps_pos - eps_skip)
 
         active = (sigma[0] >= sigma_end) & (sigma[0] <= sigma_start)
@@ -912,7 +1018,15 @@ def _conds_batchable(pos, neg) -> bool:
     """Whether cond/uncond can ride one 2B-batched model pass: same
     tree structure AND same leaf shapes (token-concatenated positives
     vs a plain negative differ on the token axis — those need two
-    passes)."""
+    passes). Conditioning carrying ControlNet weights is never
+    batchable: control_params are pytree leaves, and the 2B tree_map
+    concat would concatenate the NETWORK WEIGHTS of the two sides
+    (ControlNetApplyAdvanced sets identical structures on both)."""
+    if (
+        getattr(pos, "control_params", None) is not None
+        or getattr(neg, "control_params", None) is not None
+    ):
+        return False
     if jax.tree_util.tree_structure(pos) != jax.tree_util.tree_structure(
         neg
     ):

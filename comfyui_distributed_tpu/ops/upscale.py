@@ -213,10 +213,23 @@ def _pad_plane_for_grid(arr: jax.Array, grid: tile_ops.TileGrid) -> jax.Array:
 def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
     """Resize any ControlNet hint / mask to the upscaled image and pad
     by the grid padding, so per-tile windows can be sliced at the same
-    origins the image tiles use (reference crop_cond preprocessing)."""
+    origins the image tiles use (reference crop_cond preprocessing).
+    Multi-entry conditioning (ConditioningCombine) preps per entry;
+    area restrictions are rejected here — tile origins are traced in
+    the mesh USDU scan, so a static area intersection per tile is
+    impossible and applying the full-image area to a tile crop would
+    be silently wrong coordinates."""
     from .conditioning import as_conditioning
 
+    if isinstance(cond, (list, tuple)):
+        return [prep_cond_for_tiles(c, grid) for c in cond]
     c = as_conditioning(cond).clone()
+    if c.area is not None:
+        raise ValueError(
+            "area-restricted conditioning is not supported by the USDU "
+            "tile path; remove the ConditioningSetArea restriction for "
+            "upscaling"
+        )
     p = grid.padding
     if c.control_hint is not None:
         hint = c.control_hint
@@ -276,6 +289,8 @@ def tile_cond(cond, y, x, grid: tile_ops.TileGrid):
     prep_cond_for_tiles; (y, x) may be traced (scan body)."""
     from .conditioning import Conditioning
 
+    if isinstance(cond, (list, tuple)):
+        return [tile_cond(c, y, x, grid) for c in cond]
     if not isinstance(cond, Conditioning):
         return cond
     c = cond.clone()

@@ -1,0 +1,236 @@
+"""Conditioning combinator nodes (Combine / Average / ZeroOut /
+SetTimestepRange / SetArea strength) and ControlNetApplyAdvanced —
+the regional-prompting + scheduled-control surface, driven through
+real KSampler runs on the tiny model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_controlnet import (
+    ConditioningAverage,
+    ConditioningCombine,
+    ConditioningSetArea,
+    ConditioningSetTimestepRange,
+    ConditioningZeroOut,
+    ControlNetApply,
+    ControlNetApplyAdvanced,
+    ControlNetLoader,
+)
+from comfyui_distributed_tpu.graph.nodes_core import (
+    EmptyLatentImage,
+    KSampler,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def _run(bundle, pos, neg, seed=5, steps=2):
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    (out,) = KSampler().sample(
+        bundle, seed, steps, 7.0, "euler", "karras", pos, neg, el
+    )
+    return np.asarray(out["samples"])
+
+
+def test_combine_produces_entry_list(bundle):
+    a = pl.encode_text_pooled(bundle, ["forest"])
+    b = pl.encode_text_pooled(bundle, ["city"])
+    (combined,) = ConditioningCombine().combine(a, b)
+    assert isinstance(combined, list) and len(combined) == 2
+    # nested combine flattens
+    (three,) = ConditioningCombine().combine(combined, a)
+    assert len(three) == 3
+
+
+def test_regional_areas_change_output(bundle):
+    a = pl.encode_text_pooled(bundle, ["forest"])
+    b = pl.encode_text_pooled(bundle, ["city"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    (left,) = ConditioningSetArea().set_area(a, 16, 32, 0, 0, 1.0)
+    (right,) = ConditioningSetArea().set_area(b, 16, 32, 16, 0, 1.0)
+    (combined,) = ConditioningCombine().combine(left, right)
+    regional = _run(bundle, combined, neg)
+    plain = _run(bundle, a, neg)
+    assert regional.shape == plain.shape
+    assert not np.allclose(regional, plain)
+
+
+def test_full_window_timestep_range_matches_plain(bundle):
+    """A [0, 1] window is always active: composed through a single
+    always-on entry, the prediction equals the direct model eval.
+    Compared at the single-eval level with identical program structure
+    — the bf16 compute dtype makes cross-structure trajectory
+    comparisons rounding-noisy."""
+    from comfyui_distributed_tpu.ops import samplers as smp
+
+    neg = pl.encode_text_pooled(bundle, ["ugly"])
+    (ranged,) = ConditioningSetTimestepRange().set_range(neg, 0.0, 1.0)
+    base_fn = pl._make_model_fn(bundle, bundle.params)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1, 16, 16, 4)), jnp.float32)
+    for sigma in (10.0, 0.05):
+        sig = jnp.asarray([sigma])
+        direct = np.asarray(base_fn(x, sig, neg))
+        composed = np.asarray(
+            smp.composite_eps(
+                base_fn, x, sig, ranged, pl.percent_converter(bundle)
+            )
+        )
+        np.testing.assert_allclose(composed, direct, atol=1e-6)
+
+
+def test_timestep_split_negative_differs(bundle):
+    """The SD3 negative recipe: real negative early, zeroed negative
+    late — must differ from the plain negative run."""
+    pos = pl.encode_text_pooled(bundle, ["forest"])
+    neg = pl.encode_text_pooled(bundle, ["ugly"])
+    (zeroed,) = ConditioningZeroOut().zero_out(neg)
+    (early,) = ConditioningSetTimestepRange().set_range(neg, 0.0, 0.3)
+    (late,) = ConditioningSetTimestepRange().set_range(zeroed, 0.3, 1.0)
+    (split,) = ConditioningCombine().combine(early, late)
+    assert not np.allclose(_run(bundle, pos, split), _run(bundle, pos, neg))
+
+
+def test_zero_out_zeros_payloads(bundle):
+    cond = pl.encode_text_pooled(bundle, ["x"])
+    (z,) = ConditioningZeroOut().zero_out(cond)
+    assert not np.any(np.asarray(z.context))
+    assert not np.any(np.asarray(z.pooled))
+
+
+def test_average_lerps(bundle):
+    a = pl.encode_text_pooled(bundle, ["forest"])
+    b = pl.encode_text_pooled(bundle, ["city"])
+    (half,) = ConditioningAverage().average(a, b, 0.5)
+    np.testing.assert_allclose(
+        np.asarray(half.context),
+        0.5 * np.asarray(a.context) + 0.5 * np.asarray(b.context),
+        atol=1e-6,
+    )
+    (all_a,) = ConditioningAverage().average(a, b, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(all_a.context), np.asarray(a.context), atol=1e-6
+    )
+
+
+def test_average_conforms_from_to_to_shape(bundle):
+    """`from` truncates to `to`'s token length (reference behavior):
+    the output always keeps conditioning_to's shape."""
+    from comfyui_distributed_tpu.graph.nodes_core import ConditioningConcat
+
+    a = pl.encode_text_pooled(bundle, ["short"])
+    b = pl.encode_text_pooled(bundle, ["other"])
+    (long_b,) = ConditioningConcat().concat(b, b)  # 2x token length
+    (out,) = ConditioningAverage().average(a, long_b, 0.5)
+    assert out.context.shape == a.context.shape
+    t = a.context.shape[1]
+    np.testing.assert_allclose(
+        np.asarray(out.context),
+        0.5 * np.asarray(a.context) + 0.5 * np.asarray(long_b.context)[:, :t],
+        atol=1e-6,
+    )
+    # and padding when `from` is shorter
+    (out2,) = ConditioningAverage().average(long_b, a, 0.5)
+    assert out2.context.shape == long_b.context.shape
+
+
+def test_controlnet_advanced_applies_to_both_sides(bundle):
+    (cn,) = ControlNetLoader().load(
+        "tile", model=bundle, context=type("C", (), {"pipelines": {}})()
+    )
+    pos = pl.encode_text_pooled(bundle, ["forest"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    hint = jnp.ones((1, 32, 32, 3)) * 0.5
+    p2, n2 = ControlNetApplyAdvanced().apply(pos, neg, cn, hint, 0.8, 0.0, 1.0)
+    assert p2.control_hint is not None and n2.control_hint is not None
+    assert p2.control_range == (0.0, 1.0)
+    # strength 0 short-circuits to passthrough
+    p3, n3 = ControlNetApplyAdvanced().apply(pos, neg, cn, hint, 0.0)
+    assert p3 is pos and n3 is neg
+
+
+def test_controlnet_window_gates_model_evals(bundle):
+    """The [start, end) window gates the hint per model eval: inside
+    the window the prediction matches a full-window hint, outside it
+    matches a closed-window (never-active) hint. Comparisons are
+    between IDENTICALLY-structured programs — the bf16 compute dtype
+    makes cross-structure (batched-CFG vs two-pass) comparisons noisy
+    by amplified rounding, and the "tile" ControlNet's output conv is
+    zero-init, so the fixture perturbs it to make the hint real."""
+    import dataclasses
+    import jax
+
+    ctx = type("C", (), {"pipelines": {}})()
+    (cn,) = ControlNetLoader().load("tile", model=bundle, context=ctx)
+    rng = np.random.default_rng(7)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    cn = dataclasses.replace(
+        cn, params=jax.tree_util.tree_map(fix, cn.params)
+    )
+    pos = pl.encode_text_pooled(bundle, ["forest"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    hint = jnp.ones((1, 32, 32, 3)) * 0.5
+    m = pl.guided_model(bundle, bundle.params, 7.0)
+    rng2 = np.random.default_rng(0)
+    x = jnp.asarray(rng2.normal(size=(1, 16, 16, 4)), jnp.float32)
+
+    def eps_at(sigma, start, end):
+        p, n = ControlNetApplyAdvanced().apply(
+            pos, neg, cn, hint, 1.0, start, end
+        )
+        return np.asarray(m(x, jnp.asarray([sigma]), (p, n)))
+
+    hi, lo = 10.0, 0.05  # early vs late sampling sigmas
+    full_hi, full_lo = eps_at(hi, 0.0, 1.0), eps_at(lo, 0.0, 1.0)
+    off_hi, off_lo = eps_at(hi, 0.5, 0.5), eps_at(lo, 0.5, 0.5)
+    # the hint genuinely changes predictions
+    assert not np.allclose(full_hi, off_hi)
+    # first-half window: active early (== full), inactive late (== off)
+    early_hi, early_lo = eps_at(hi, 0.0, 0.5), eps_at(lo, 0.0, 0.5)
+    np.testing.assert_allclose(early_hi, full_hi, atol=1e-6)
+    np.testing.assert_allclose(early_lo, off_lo, atol=1e-6)
+    # and the closed window differs from full at low sigma too (the
+    # full window is still applying the hint there)
+    assert not np.allclose(off_lo, full_lo)
+
+
+def test_usdu_rejects_area_conditioning(bundle):
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+    from comfyui_distributed_tpu.ops import upscale as up
+
+    pos = pl.encode_text_pooled(bundle, ["x"])
+    (area,) = ConditioningSetArea().set_area(pos, 16, 16, 0, 0, 1.0)
+    grid = tile_ops.calculate_tiles(64, 64, 32, 4)
+    with pytest.raises(ValueError, match="area-restricted"):
+        up.prep_cond_for_tiles(area, grid)

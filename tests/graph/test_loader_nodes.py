@@ -1,0 +1,155 @@
+"""Loader + model-sampling nodes: the separate-file workflow surface
+(UNETLoader / CLIPLoader family / EmptySD3LatentImage / ModelSampling*)
+and a fully assembled Flux-style workflow through the executor."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph import ExecutionContext, GraphExecutor
+from comfyui_distributed_tpu.graph.nodes_loaders import (
+    CLIPLoader,
+    DualCLIPLoader,
+    EmptySD3LatentImage,
+    ModelSamplingDiscrete,
+    ModelSamplingFlux,
+    ModelSamplingSD3,
+    TripleCLIPLoader,
+    UNETLoader,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+def _ctx():
+    return ExecutionContext()
+
+
+def test_unet_loader_caches_and_strips_extension():
+    ctx = _ctx()
+    (a,) = UNETLoader().load_unet("tiny-unet.safetensors", context=ctx)
+    (b,) = UNETLoader().load_unet("tiny-unet", context=ctx)
+    assert a is b  # cached under the stem
+    assert a.vae is None and set(a.params) == {"unet"}
+
+
+def test_clip_loader_type_validation():
+    with pytest.raises(ValueError, match="stable_diffusion"):
+        CLIPLoader().load_clip("tiny-te", type="flux", context=_ctx())
+    with pytest.raises(ValueError, match="sdxl, flux, or sd3"):
+        DualCLIPLoader().load_clip(
+            "tiny-te", "tiny-te-g", type="stable_diffusion", context=_ctx()
+        )
+
+
+def test_dual_clip_loader_flux_underscore_names():
+    """Workflow values carry filenames with underscores; the stems
+    normalize onto registry hyphens."""
+    (c,) = DualCLIPLoader().load_clip(
+        "tiny_te.safetensors", "tiny_t5_shared.safetensors", type="flux",
+        context=_ctx(),
+    )
+    assert c.te_name == "tiny-t5-shared"
+    assert c.te2_name == "tiny-te"
+
+
+def test_triple_clip_loader_sd3():
+    (c,) = TripleCLIPLoader().load_clip(
+        "tiny-te-l", "tiny-te-g", "tiny-t5-sd3", context=_ctx()
+    )
+    cond = pl.encode_text_pooled(c, ["x"])
+    assert cond.pooled is not None
+    assert c.te3_name == "tiny-t5-sd3"
+
+
+def test_empty_sd3_latent_is_16ch_placeholder():
+    (lat,) = EmptySD3LatentImage().generate(64, 32, 2)
+    assert lat["samples"].shape == (2, 4, 8, 16)
+    assert lat["empty"] and lat["width"] == 64 and lat["height"] == 32
+
+
+def test_model_sampling_discrete_overrides_parameterization():
+    b = pl.load_unet("tiny-unet")
+    assert pl.model_schedule_info(b)[0] == "eps"
+    (v,) = ModelSamplingDiscrete().patch(b, "v_prediction", False)
+    assert pl.model_schedule_info(v)[0] == "v"
+    # the original bundle is untouched (replace, not mutate)
+    assert pl.model_schedule_info(b)[0] == "eps"
+    with pytest.raises(ValueError, match="sampling must be"):
+        ModelSamplingDiscrete().patch(b, "lcm", False)
+    with pytest.raises(ValueError, match="zsnr"):
+        ModelSamplingDiscrete().patch(b, "eps", True)
+
+
+def test_model_sampling_sd3_sets_shift():
+    b = pl.load_unet("tiny-sd3")
+    (patched,) = ModelSamplingSD3().patch(b, shift=5.0)
+    assert pl.model_schedule_info(patched) == ("flow", 5.0)
+    # shift reshapes the sigma grid
+    base = np.asarray(smp.get_model_sigmas("flow", "normal", 4,
+                                           flow_shift=3.0))
+    new = np.asarray(smp.get_model_sigmas("flow", "normal", 4,
+                                          flow_shift=5.0))
+    assert not np.allclose(base, new)
+    with pytest.raises(ValueError, match="flow-matching"):
+        ModelSamplingSD3().patch(pl.load_unet("tiny-unet"), shift=5.0)
+
+
+def test_model_sampling_flux_resolution_dependent():
+    b = pl.load_unet("tiny-flux")
+    (at_256,) = ModelSamplingFlux().patch(b, 1.15, 0.5, 256, 256)
+    # 256x256 → 256 tokens → mu = base_shift → shift = e^0.5
+    assert pl.model_schedule_info(at_256)[1] == pytest.approx(
+        np.exp(0.5), rel=1e-6
+    )
+    (at_1024,) = ModelSamplingFlux().patch(b, 1.15, 0.5, 1024, 1024)
+    # 1024x1024 → 4096 tokens → mu = max_shift
+    assert pl.model_schedule_info(at_1024)[1] == pytest.approx(
+        np.exp(1.15), rel=1e-6
+    )
+
+
+def test_assembled_flux_workflow_through_executor():
+    """UNETLoader + DualCLIPLoader + VAELoader + ModelSamplingFlux +
+    custom sampling — the published-Flux-workflow shape — runs end to
+    end through the graph executor."""
+    prompt = {
+        "u": {"class_type": "UNETLoader",
+              "inputs": {"unet_name": "tiny-flux"}},
+        "c": {"class_type": "DualCLIPLoader",
+              "inputs": {"clip_name1": "tiny-te",
+                         "clip_name2": "tiny-t5-shared", "type": "flux"}},
+        "v": {"class_type": "VAELoader",
+              "inputs": {"vae_name": "tiny-vae-flux"}},
+        "ms": {"class_type": "ModelSamplingFlux",
+               "inputs": {"model": ["u", 0], "max_shift": 1.15,
+                          "base_shift": 0.5, "width": 32, "height": 32}},
+        "p": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "x", "clip": ["c", 0]}},
+        "g": {"class_type": "FluxGuidance",
+              "inputs": {"conditioning": ["p", 0], "guidance": 3.5}},
+        "el": {"class_type": "EmptySD3LatentImage",
+               "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+        "no": {"class_type": "RandomNoise", "inputs": {"noise_seed": 3}},
+        "gd": {"class_type": "BasicGuider",
+               "inputs": {"model": ["ms", 0], "conditioning": ["g", 0]}},
+        "sm": {"class_type": "KSamplerSelect",
+               "inputs": {"sampler_name": "euler"}},
+        "sg": {"class_type": "BasicScheduler",
+               "inputs": {"model": ["ms", 0], "scheduler": "simple",
+                          "steps": 2, "denoise": 1.0}},
+        "ks": {"class_type": "SamplerCustomAdvanced",
+               "inputs": {"noise": ["no", 0], "guider": ["gd", 0],
+                          "sampler": ["sm", 0], "sigmas": ["sg", 0],
+                          "latent_image": ["el", 0]}},
+        "d": {"class_type": "VAEDecode",
+              "inputs": {"samples": ["ks", 0], "vae": ["v", 0]}},
+        "o": {"class_type": "PreviewImage", "inputs": {"images": ["d", 0]}},
+    }
+    outs = GraphExecutor(_ctx()).execute(prompt)
+    img = np.asarray(outs["o"][0]["images"])
+    assert img.shape == (1, 32, 32, 3)
+    assert np.all(np.isfinite(img))

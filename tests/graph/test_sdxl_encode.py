@@ -1,0 +1,95 @@
+"""CLIPTextEncodeSDXL: per-tower prompts + adm size-conditioning
+override (the SDXL workflow surface the reference inherits from
+ComfyUI)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    CLIPTextEncodeSDXL,
+    EmptyLatentImage,
+    KSampler,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax
+
+    b = pl.load_pipeline("tiny-unet-adm", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def test_same_prompts_reduce_to_plain_encode(bundle):
+    """With text_g == text_l the dual-tower encode equals
+    encode_text_pooled on the same bundle (same towers, same texts)."""
+    (cond,) = CLIPTextEncodeSDXL().encode(
+        bundle, 1024, 1024, 0, 0, 1024, 1024, "a cat", "a cat"
+    )
+    plain = pl.encode_text_pooled(bundle, ["a cat"])
+    np.testing.assert_allclose(
+        np.asarray(cond.context), np.asarray(plain.context), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(cond.pooled), np.asarray(plain.pooled), atol=1e-6
+    )
+    assert cond.size_cond == (1024, 1024, 0, 0, 1024, 1024)
+
+
+def test_per_tower_prompts_differ(bundle):
+    (ab,) = CLIPTextEncodeSDXL().encode(
+        bundle, 1024, 1024, 0, 0, 1024, 1024, "a", "b"
+    )
+    (aa,) = CLIPTextEncodeSDXL().encode(
+        bundle, 1024, 1024, 0, 0, 1024, 1024, "a", "a"
+    )
+    assert not np.allclose(np.asarray(ab.context), np.asarray(aa.context))
+
+
+def test_requires_dual_tower():
+    single = pl.load_clip(["tiny-te"], layout="sd")
+    with pytest.raises(ValueError, match="dual-tower"):
+        CLIPTextEncodeSDXL().encode(single, 1024, 1024, 0, 0, 1024, 1024,
+                                    "x", "x")
+
+
+def test_size_cond_feeds_the_adm_vector(bundle):
+    """KSampler output changes with the size ints, and explicitly
+    passing the default (latent sizes, zero crops) reproduces the
+    no-override output exactly."""
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    neg = pl.encode_text_pooled(bundle, [""])
+
+    def run(cond):
+        (out,) = KSampler().sample(
+            bundle, 5, 2, 7.0, "euler", "karras", cond, neg, el, denoise=1.0
+        )
+        return np.asarray(out["samples"])
+
+    plain = pl.encode_text_pooled(bundle, ["a cat"])
+    base = run(plain)
+    (explicit_default,) = CLIPTextEncodeSDXL().encode(
+        bundle, 32, 32, 0, 0, 32, 32, "a cat", "a cat"
+    )
+    np.testing.assert_allclose(run(explicit_default), base, atol=1e-6)
+    (cropped,) = CLIPTextEncodeSDXL().encode(
+        bundle, 64, 64, 16, 16, 32, 32, "a cat", "a cat"
+    )
+    assert not np.allclose(run(cropped), base)

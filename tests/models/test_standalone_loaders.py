@@ -1,0 +1,195 @@
+"""Standalone-component loaders (pipeline.load_unet / load_clip): the
+separate-file distribution format real Flux/SD3 stacks use — diffusion
+transformer, text encoders, and VAE each in their own file."""
+
+import jax
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.models import sd_checkpoint as sdc
+from comfyui_distributed_tpu.models.io import flatten_params
+from comfyui_distributed_tpu.models.registry import get_config
+
+
+# --- load_clip layouts ---------------------------------------------------
+
+def test_clip_sd_single_tower():
+    c = pl.load_clip(["tiny-te"], layout="sd")
+    cond = pl.encode_text_pooled(c, ["hello"])
+    w = get_config("tiny-te").width
+    assert cond.context.shape[-1] == w
+    assert cond.pooled.shape[-1] == w
+    assert c.te_name == "tiny-te"
+
+
+def test_clip_sdxl_concat_layout():
+    c = pl.load_clip(["tiny-te-l", "tiny-te-g"], layout="sdxl")
+    cond = pl.encode_text_pooled(c, ["hello"])
+    dl = get_config("tiny-te-l").width
+    dg = get_config("tiny-te-g").width
+    assert cond.context.shape[-1] == dl + dg
+    # pooled comes from the projected G tower
+    assert cond.pooled.shape[-1] == get_config("tiny-te-g").proj_dim
+
+
+def test_clip_sdxl_order_sniffed_by_width():
+    """ComfyUI-ported workflows pass L/G in either order; the wider
+    tower (G) is identified by width and takes the te2 role."""
+    a = pl.load_clip(["tiny-te-l", "tiny-te-g"], layout="sdxl")
+    b = pl.load_clip(["tiny-te-g", "tiny-te-l"], layout="sdxl")
+    assert a.te_name == b.te_name == "tiny-te-l"
+    assert a.te2_name == b.te2_name == "tiny-te-g"
+    c = pl.load_clip(["tiny-te-g", "tiny-te-l", "tiny-t5-sd3"], layout="sd3")
+    assert c.te_name == "tiny-te-l" and c.te2_name == "tiny-te-g"
+
+
+def test_clip_flux_order_sniffed():
+    """T5 and CLIP are identified by family, so either argument order
+    produces the same bundle layout (te = T5 hidden source)."""
+    a = pl.load_clip(["tiny-t5-shared", "tiny-te"], layout="flux")
+    b = pl.load_clip(["tiny-te", "tiny-t5-shared"], layout="flux")
+    assert a.te_name == b.te_name == "tiny-t5-shared"
+    assert a.te2_name == b.te2_name == "tiny-te"
+    cond = pl.encode_text_pooled(a, ["hello"])
+    assert cond.context.shape[-1] == get_config("tiny-t5-shared").d_model
+
+
+def test_clip_sd3_with_and_without_t5():
+    full = pl.load_clip(
+        ["tiny-te-l", "tiny-te-g", "tiny-t5-sd3"], layout="sd3"
+    )
+    cond_full = pl.encode_text_pooled(full, ["hello"])
+    dual = pl.load_clip(["tiny-te-l", "tiny-te-g"], layout="sd3")
+    cond_dual = pl.encode_text_pooled(dual, ["hello"])
+    # T5-less mode keeps the CLIP sequence only (no T5 seq concat)
+    assert cond_dual.context.shape[1] < cond_full.context.shape[1]
+    # both pad the feature axis to the same backbone width
+    assert cond_dual.context.shape[-1] == cond_full.context.shape[-1]
+    # pooled = L ++ G either way
+    np.testing.assert_array_equal(
+        cond_full.pooled.shape, cond_dual.pooled.shape
+    )
+
+
+def test_clip_layout_validation():
+    with pytest.raises(ValueError, match="unknown CLIP layout"):
+        pl.load_clip(["tiny-te"], layout="nope")
+    with pytest.raises(ValueError, match="encoder name"):
+        pl.load_clip(["tiny-te"], layout="sdxl")
+    with pytest.raises(ValueError, match="CLIP-family encoders only"):
+        pl.load_clip(["tiny-t5-shared", "tiny-te-g"], layout="sdxl")
+    with pytest.raises(ValueError, match="one T5-family and one CLIP"):
+        pl.load_clip(["tiny-te-l", "tiny-te-g"], layout="flux")
+
+
+def test_clip_loads_separate_file_weights(tmp_path, monkeypatch):
+    """A CLIP encoder file under the encoder's registry name feeds the
+    bundle (the clip_l.safetensors distribution format)."""
+    from safetensors.numpy import save_file
+
+    cfg = get_config("tiny-te")
+    from comfyui_distributed_tpu.models.registry import create_model
+    import jax.numpy as jnp
+
+    te = create_model("tiny-te")
+    p = te.init(jax.random.key(9), jnp.zeros((1, cfg.max_length), jnp.int32))
+    # the standalone clip_l.safetensors layout: bare text_model.* keys
+    synth = sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(p)),
+        sdc.text_encoder_schedule(cfg, prefix="text_model"),
+    )
+    rng = np.random.default_rng(3)
+    synth = {
+        k: (v + rng.normal(0, 0.01, v.shape)).astype(np.float32)
+        for k, v in synth.items()
+    }
+    save_file(synth, str(tmp_path / "tiny-te.safetensors"))
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+
+    c = pl.load_clip(["tiny-te"], layout="sd")
+    got = flatten_params(jax.device_get(c.params["te"]))
+    key = "params/token_embedding/embedding"
+    expect = synth["text_model.embeddings.token_embedding.weight"]
+    np.testing.assert_allclose(got[key], expect, rtol=1e-6)
+
+
+# --- load_unet -----------------------------------------------------------
+
+def test_unet_only_bundle_geometry():
+    b = pl.load_unet("tiny-flux")
+    assert b.vae is None and b.text_encoder is None
+    assert b.latent_channels == get_config("tiny-vae-flux").latent_channels
+    assert b.latent_scale == get_config("tiny-vae-flux").downscale
+    assert set(b.params) == {"unet"}
+
+
+def test_unet_rejects_non_diffusion_names():
+    with pytest.raises(ValueError, match="not an image diffusion"):
+        pl.load_unet("tiny-te")
+
+
+@pytest.mark.parametrize("prefixed", [False, True])
+def test_unet_reads_bare_and_nested_diffusion_files(
+    tmp_path, monkeypatch, prefixed
+):
+    """load_diffusion_weights maps both published bare-key diffusion
+    files and model.diffusion_model.-nested repacks onto the backbone
+    tree (here: the flux schedule, whose published files are bare)."""
+    from safetensors.numpy import save_file
+    import jax.numpy as jnp
+
+    cfg = get_config("tiny-flux")
+    init = pl.load_unet("tiny-flux", seed=1)
+    synth = sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(init.params["unet"])),
+        sdc.flux_schedule(cfg),
+    )
+    rng = np.random.default_rng(5)
+    synth = {
+        k: (v + rng.normal(0, 0.01, v.shape)).astype(np.float32)
+        for k, v in synth.items()
+    }
+    if prefixed:
+        synth = {f"model.diffusion_model.{k}": v for k, v in synth.items()}
+    save_file(synth, str(tmp_path / "tiny-flux.safetensors"))
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+
+    b = pl.load_unet("tiny-flux")
+    got = flatten_params(jax.device_get(b.params["unet"]))
+    key = "params/img_in/kernel"
+    src = "img_in.weight" if not prefixed else (
+        "model.diffusion_model.img_in.weight"
+    )
+    np.testing.assert_allclose(
+        got[key], np.transpose(synth[src], (1, 0)), rtol=1e-6
+    )
+
+
+def test_unet_reads_bare_sd_unet_file(tmp_path, monkeypatch):
+    """Extracted SD UNets ship bare keys (no model.diffusion_model.);
+    the loader re-prefixes them onto the single-file schedule."""
+    from safetensors.numpy import save_file
+
+    cfg = get_config("tiny-unet")
+    init = pl.load_unet("tiny-unet", seed=1)
+    synth = sdc.synthesize_state_dict(
+        flatten_params(jax.device_get(init.params["unet"])),
+        sdc.unet_schedule(cfg),
+    )
+    prefix = "model.diffusion_model."
+    bare = {k[len(prefix):]: v for k, v in synth.items()}
+    rng = np.random.default_rng(6)
+    bare = {
+        k: (v + rng.normal(0, 0.01, v.shape)).astype(np.float32)
+        for k, v in bare.items()
+    }
+    save_file(bare, str(tmp_path / "tiny-unet.safetensors"))
+    monkeypatch.setenv("CDT_CHECKPOINT_DIR", str(tmp_path))
+
+    b = pl.load_unet("tiny-unet")
+    got = flatten_params(jax.device_get(b.params["unet"]))
+    expect = sdc._transform(bare["input_blocks.0.0.weight"], "conv")
+    np.testing.assert_allclose(
+        got["params/input_conv/kernel"], expect, rtol=1e-6
+    )

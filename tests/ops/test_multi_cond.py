@@ -1,0 +1,118 @@
+"""Multi-entry conditioning composition (samplers.composite_eps):
+the calc_cond_batch semantics behind ConditioningCombine / SetArea /
+SetMask / SetTimestepRange — verified against a stub model so the
+spatial/weight math is exact."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.ops import samplers as smp
+from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+pytestmark = pytest.mark.slow
+
+
+def _entry(value, **kw):
+    """A conditioning entry whose stub-model prediction is `value`
+    everywhere (the context array carries the value in [0,0,0])."""
+    return Conditioning(
+        context=jnp.full((1, 1, 1), float(value)), **kw
+    )
+
+
+def _stub_model(x, sigma, cond):
+    # per-batch-element value so the 2B-concat CFG fast path (pos and
+    # neg stacked on the batch axis) keeps each half's own prediction
+    vals = jnp.asarray(cond.context)[:, 0, 0]
+    if vals.shape[0] != x.shape[0]:
+        vals = jnp.broadcast_to(vals[:1], (x.shape[0],))
+    return jnp.ones_like(x) * vals.reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+X = jnp.zeros((1, 8, 8, 4))
+SIGMA = jnp.asarray([5.0])
+
+
+def test_single_full_entry_is_identity():
+    out = smp.composite_eps(_stub_model, X, SIGMA, _entry(3.0))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_areas_compose_regionally():
+    """Left half from entry A, right half from entry B (areas in
+    pixels, latent = //8)."""
+    a = _entry(1.0, area=(64, 32, 0, 0))    # (h, w, y, x) pixels
+    b = _entry(2.0, area=(64, 32, 0, 32))
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a, b]))
+    np.testing.assert_allclose(out[:, :, :4], 1.0)
+    np.testing.assert_allclose(out[:, :, 4:], 2.0)
+
+
+def test_overlap_normalizes_by_weight():
+    """Full-frame entries average; strength weights the mean."""
+    a = _entry(1.0, strength=1.0)
+    b = _entry(4.0, strength=3.0)
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a, b]))
+    np.testing.assert_allclose(out, (1.0 * 1 + 4.0 * 3) / 4, rtol=1e-6)
+
+
+def test_uncovered_region_gets_zero_eps():
+    a = _entry(5.0, area=(32, 64, 0, 0))  # top half only
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a]))
+    np.testing.assert_allclose(out[:, :4], 5.0)
+    np.testing.assert_allclose(out[:, 4:], 0.0)
+
+
+def test_off_frame_area_origin_is_clamped():
+    """An area whose origin lands at/past the latent edge must not
+    slice a zero-size crop (which would crash the model trace)."""
+    a = _entry(3.0, area=(64, 512, 0, 512))  # x=512px = cell 64 = edge
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a]))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[:, :, -1], 3.0)  # clamped to last col
+
+
+def test_mask_weights_spatially():
+    mask = np.zeros((1, 8, 8), np.float32)
+    mask[:, :, 4:] = 1.0
+    a = _entry(2.0, mask=jnp.asarray(mask))
+    b = _entry(6.0)
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a, b]))
+    np.testing.assert_allclose(out[:, :, :4], 6.0)      # mask 0 ⇒ b only
+    np.testing.assert_allclose(out[:, :, 4:], 4.0)      # equal-weight mean
+
+
+def test_timestep_window_gates_by_sigma():
+    """Entry active only for the first half of sampling: contributes at
+    high sigma, drops out at low sigma."""
+    early = _entry(10.0, timestep_range=(0.0, 0.5))
+    base = _entry(2.0)
+    hi = np.asarray(
+        smp.composite_eps(_stub_model, X, jnp.asarray([10.0]), [early, base])
+    )
+    np.testing.assert_allclose(hi, 6.0)  # both active: mean(10, 2)
+    lo = np.asarray(
+        smp.composite_eps(_stub_model, X, jnp.asarray([0.05]), [early, base])
+    )
+    np.testing.assert_allclose(lo, 2.0)  # window closed: base only
+
+
+def test_cfg_eval_routes_lists_through_composition():
+    pos = [_entry(1.0, area=(64, 32, 0, 0)), _entry(2.0, area=(64, 32, 0, 32))]
+    neg = _entry(0.0)
+    _eps_pos, guided = smp._cfg_eval(
+        _stub_model, 2.0, X, SIGMA, (pos, neg)
+    )
+    out = np.asarray(guided)  # eps_neg + 2*(eps_pos - eps_neg) = 2*eps_pos
+    np.testing.assert_allclose(out[:, :, :4], 2.0)
+    np.testing.assert_allclose(out[:, :, 4:], 4.0)
+
+
+def test_single_unrestricted_keeps_batched_fast_path():
+    """No areas/masks/windows ⇒ the 2B-batched CFG path still runs
+    (same numbers as composition, one model call)."""
+    pos = _entry(3.0)
+    neg = _entry(1.0)
+    _eps, guided = smp._cfg_eval(_stub_model, 2.0, X, SIGMA, (pos, neg))
+    np.testing.assert_allclose(np.asarray(guided), 1.0 + 2.0 * (3.0 - 1.0))
