@@ -104,13 +104,11 @@ class LoraLoader:
     RETURN_TYPES = ("MODEL", "CLIP")
     FUNCTION = "load_lora"
 
-    def load_lora(self, model: pl.PipelineBundle, clip, lora_name,
-                  strength_model=1.0, strength_clip=1.0, context=None):
-        from ..models import get_config
-        from ..models.lora import apply_lora, read_lora
-        from ..models.registry import DUAL_TEXT_ENCODERS
-
-        path = str(lora_name)
+    @staticmethod
+    def _resolve_lora_path(name: str) -> str:
+        """LoRA file resolution shared with LoraLoaderModelOnly:
+        absolute path, or CDT_LORA_DIR/<name>[.safetensors]."""
+        path = str(name)
         if not os.path.isabs(path):
             root = os.environ.get("CDT_LORA_DIR", "")
             candidate = os.path.join(root, path) if root else path
@@ -121,7 +119,15 @@ class LoraLoader:
             path = candidate
         if not os.path.exists(path):
             raise FileNotFoundError(f"LoRA not found: {path}")
+        return path
 
+    def load_lora(self, model: pl.PipelineBundle, clip, lora_name,
+                  strength_model=1.0, strength_clip=1.0, context=None):
+        from ..models import get_config
+        from ..models.lora import apply_lora, read_lora
+        from ..models.registry import DUAL_TEXT_ENCODERS
+
+        path = self._resolve_lora_path(str(lora_name))
         lora_sd = read_lora(path)
         # UNet weights come from the MODEL input, text-encoder weights
         # from the CLIP input — the two may be different bundles
@@ -1218,6 +1224,282 @@ class ImageUpscaleWithModel:
 
     def upscale(self, upscale_model, image, context=None):
         return (upscale_model.upscale(image),)
+
+
+@register_node
+class VAEEncodeTiled(VAEEncode):
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "pixels": ("IMAGE",),
+                "vae": ("VAE",),
+                "tile_size": ("INT", {"default": 512}),
+            }
+        }
+
+    FUNCTION = "encode_tiled"
+
+    def encode_tiled(self, pixels, vae, tile_size=512, context=None):
+        from ..ops.tiled_vae import encode_tiled
+
+        pixel_tile = max(64, int(tile_size))
+        z = encode_tiled(
+            pl._Static(vae), vae.params["vae"], pixels,
+            tile=pixel_tile, overlap=max(16, pixel_tile // 8),
+        )
+        return ({"samples": z},)
+
+
+@register_node
+class LatentFromBatch:
+    """Slice a contiguous run out of a latent batch (ComfyUI
+    LatentFromBatch parity); the noise_mask follows when it is
+    per-sample."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "batch_index": ("INT", {"default": 0}),
+                "length": ("INT", {"default": 1}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "frombatch"
+
+    def frombatch(self, samples: dict, batch_index=0, length=1, context=None):
+        z = samples["samples"]
+        b = z.shape[0]
+        i0 = min(max(int(batch_index), 0), b - 1)
+        i1 = min(i0 + max(int(length), 1), b)
+        out = dict(samples)
+        out["samples"] = z[i0:i1]
+        mask = samples.get("noise_mask")
+        if mask is not None and getattr(mask, "ndim", 0) >= 3 and (
+            mask.shape[0] == b
+        ):
+            out["noise_mask"] = mask[i0:i1]
+        return (out,)
+
+
+@register_node
+class LatentBatch:
+    """Batch-concatenate two latents (ComfyUI LatentBatch parity): the
+    second resizes to the first's spatial grid when they differ."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples1": ("LATENT",),
+                "samples2": ("LATENT",),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "batch"
+
+    def batch(self, samples1: dict, samples2: dict, context=None):
+        from ..ops import upscale as up_ops
+
+        z1, z2 = samples1["samples"], samples2["samples"]
+        if z1.shape[1:3] != z2.shape[1:3]:
+            z2 = up_ops.resize_image(z2, z1.shape[1], z1.shape[2], "bilinear")
+        out = dict(samples1)
+        out["samples"] = jnp.concatenate([z1, z2], axis=0)
+        out.pop("noise_mask", None)  # per-sample masks no longer align
+        return (out,)
+
+
+def _gaussian_blur(image, radius: int, sigma: float):
+    """Separable Gaussian blur with reflect padding — shared by
+    ImageBlur and ImageSharpen (reference-substrate kernel shape)."""
+    r = max(1, int(radius))
+    xs = np.arange(-r, r + 1, dtype=np.float32)
+    k = np.exp(-(xs**2) / (2.0 * max(float(sigma), 1e-6) ** 2))
+    k /= k.sum()
+    kern = jnp.asarray(k)
+    img = jnp.pad(image, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
+    # depthwise separable conv via dot over the window axis
+    img = jax.vmap(
+        lambda c: jax.lax.conv_general_dilated(
+            c[..., None],
+            kern.reshape(1, -1, 1, 1),
+            (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[..., 0],
+        in_axes=-1, out_axes=-1,
+    )(img)
+    img = jax.vmap(
+        lambda c: jax.lax.conv_general_dilated(
+            c[..., None],
+            kern.reshape(-1, 1, 1, 1),
+            (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[..., 0],
+        in_axes=-1, out_axes=-1,
+    )(img)
+    return img
+
+
+@register_node
+class ImageBlur:
+    """Gaussian blur (ComfyUI ImageBlur parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "blur_radius": ("INT", {"default": 1}),
+                "sigma": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "blur"
+
+    def blur(self, image, blur_radius=1, sigma=1.0, context=None):
+        if int(blur_radius) <= 0:
+            return (image,)
+        return (_gaussian_blur(image, blur_radius, sigma),)
+
+
+@register_node
+class ImageSharpen:
+    """Unsharp-mask sharpening (ComfyUI ImageSharpen parity):
+    img + alpha * (img - blur)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "sharpen_radius": ("INT", {"default": 1}),
+                "sigma": ("FLOAT", {"default": 1.0}),
+                "alpha": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "sharpen"
+
+    def sharpen(self, image, sharpen_radius=1, sigma=1.0, alpha=1.0,
+                context=None):
+        if int(sharpen_radius) <= 0:
+            return (image,)
+        blurred = _gaussian_blur(image, sharpen_radius, sigma)
+        return (
+            jnp.clip(image + float(alpha) * (image - blurred), 0.0, 1.0),
+        )
+
+
+@register_node
+class LoraLoaderModelOnly:
+    """LoRA merge into the diffusion weights only (ComfyUI
+    LoraLoaderModelOnly parity) — for UNETLoader bundles that carry no
+    text encoders. Text-encoder modules in the file are reported as
+    unmatched, not fatal (partial-LoRA semantics)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "lora_name": ("STRING", {"default": ""}),
+                "strength_model": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "load_lora_model_only"
+
+    def load_lora_model_only(self, model: pl.PipelineBundle, lora_name,
+                             strength_model=1.0, context=None):
+        from ..models import get_config
+        from ..models.lora import apply_lora, read_lora
+
+        path = LoraLoader._resolve_lora_path(str(lora_name))
+        lora_sd = read_lora(path)
+        patched, unmatched = apply_lora(
+            {"unet": model.params["unet"]},
+            lora_sd,
+            get_config(model.model_name),
+            strength=float(strength_model),
+        )
+        if unmatched:
+            log(f"LoRA {os.path.basename(path)}: {len(unmatched)} "
+                f"unmatched module(s), e.g. {unmatched[:3]}")
+        model_params = dict(model.params)
+        model_params["unet"] = patched["unet"]
+        return (dataclasses.replace(model, params=model_params),)
+
+
+@register_node
+class InpaintModelConditioning:
+    """Conditioning assembly for inpaint-specialized checkpoints
+    (ComfyUI InpaintModelConditioning parity; sd15-inpaint-class
+    9-channel UNets): the masked-out pixels are neutralized and
+    encoded as the concat channels (mask ++ masked-image latents,
+    joined to the model input at every step), the original pixels
+    encode as the starting latents, and the mask optionally rides as
+    the latent noise_mask."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "vae": ("VAE",),
+                "pixels": ("IMAGE",),
+                "mask": ("MASK",),
+            },
+            "optional": {"noise_mask": ("BOOLEAN", {"default": True})},
+        }
+
+    RETURN_TYPES = ("CONDITIONING", "CONDITIONING", "LATENT")
+    RETURN_NAMES = ("positive", "negative", "latent")
+    FUNCTION = "encode"
+
+    def encode(self, positive, negative, vae: pl.PipelineBundle, pixels,
+               mask, noise_mask=True, context=None):
+        from ..ops.conditioning import map_conditioning
+
+        b, h, w, _ = pixels.shape
+        # MASK contract: [H,W], [B,H,W] or [B,H,W,1] (same preamble as
+        # _mask_to_latent)
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 4:
+            m = m[..., 0]
+        if m.ndim == 2:
+            m = m[None]
+        if m.shape[1:] != (h, w):
+            m = jax.image.resize(m, (m.shape[0], h, w), method="linear")
+        m = jnp.clip(m, 0.0, 1.0)
+        hard = (m > 0.5).astype(jnp.float32)
+        # reference pixel neutralization: (p - 0.5) * keep + 0.5
+        neutral = (pixels - 0.5) * (1.0 - hard[..., None]) + 0.5
+        z_orig = vae.vae.apply(vae.params["vae"], pixels, method="encode")
+        z_masked = vae.vae.apply(vae.params["vae"], neutral, method="encode")
+        mask_lat = _mask_to_latent(m, z_orig.shape[1], z_orig.shape[2])
+        concat = jnp.concatenate([mask_lat, z_masked], axis=-1)
+
+        def patch(cond):
+            cond.concat_latent = concat
+            return cond
+
+        latent = {"samples": z_orig, "width": int(w), "height": int(h)}
+        if noise_mask:
+            latent["noise_mask"] = mask_lat
+        return (
+            map_conditioning(positive, patch),
+            map_conditioning(negative, patch),
+            latent,
+        )
 
 
 @register_node

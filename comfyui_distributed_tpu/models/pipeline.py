@@ -198,7 +198,9 @@ def load_pipeline(
             k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.adm_in_channels))
         )
     else:
-        unet_params = unet.init(k_unet, lat, ts, ctx)
+        unet_params = unet.init(
+            k_unet, _unet_init_latents(unet_cfg, lat.shape[-1]), ts, ctx
+        )
     img = jnp.zeros((1, 32, 32, 3))
     vae_params = vae.init(k_vae, img)
     tokens = jnp.zeros((1, te_cfg.max_length), jnp.int32)
@@ -331,6 +333,14 @@ def load_pipeline(
     )
 
 
+def _unet_init_latents(unet_cfg, latent_channels: int):
+    """Dummy latents for UNet-family init, honoring in_channels-widened
+    inpaint configs (9 = 4 + mask + masked-image latents). Shared by
+    load_pipeline and load_unet."""
+    in_ch = getattr(unet_cfg, "in_channels", latent_channels)
+    return jnp.zeros((1, 16, 16, in_ch))
+
+
 def _load_te_checkpoint(name: str, params_):
     """Fill a text-encoder param tree from a separate-file checkpoint
     resolving under the encoder's registry name (no-op when none
@@ -401,7 +411,9 @@ def load_unet(
             k_unet, lat, ts, ctx, y=jnp.zeros((1, unet_cfg.adm_in_channels))
         )
     else:
-        unet_params = unet.init(k_unet, lat, ts, ctx)
+        unet_params = unet.init(
+            k_unet, _unet_init_latents(unet_cfg, lat.shape[-1]), ts, ctx
+        )
 
     ckpt_path = checkpoint or sdc.find_checkpoint(model_name)
     if ckpt_path:
@@ -791,6 +803,16 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
                 gate = ((s0 <= sig_hi) & (s0 > sig_lo)).astype(control.dtype)
                 control = control * gate
         if (
+            is_flow
+            and isinstance(cond, Conditioning)
+            and cond.concat_latent is not None
+        ):
+            raise ValueError(
+                "concat-channel inpaint conditioning "
+                "(InpaintModelConditioning) applies to SD-class inpaint "
+                "UNets; flow-family models have no c_concat input"
+            )
+        if (
             not is_flow
             and isinstance(cond, Conditioning)
             and cond.reference_latents
@@ -878,8 +900,27 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
             (-1,) + (1,) * (x.ndim - 1)
         )
         t = smp.sigma_to_timestep(sigma_batch)
+        x_in = x * c_in
+        if isinstance(cond, Conditioning) and cond.concat_latent is not None:
+            # inpaint-model channels join AFTER the VP input scaling
+            # (reference c_concat convention: only the noisy latents
+            # are scaled). The backbone must be an in_channels-widened
+            # config (sd15-inpaint class) — a 4-channel model fails its
+            # input conv shape check loudly.
+            extra = cond.concat_latent.astype(x_in.dtype)
+            if extra.shape[0] != x_in.shape[0]:
+                extra = jnp.repeat(
+                    extra, x_in.shape[0] // extra.shape[0], axis=0
+                )
+            if extra.shape[1:3] != x_in.shape[1:3]:
+                extra = jax.image.resize(
+                    extra,
+                    (extra.shape[0],) + x_in.shape[1:3] + (extra.shape[3],),
+                    method="linear",
+                )
+            x_in = jnp.concatenate([x_in, extra], axis=-1)
         out = bundle.unet.apply(
-            params["unet"], x * c_in, t, context, y=y, control=control
+            params["unet"], x_in, t, context, y=y, control=control
         )
         if model_schedule_info(bundle)[0] == "v":
             # SD2.x-768-class velocity prediction. With the VP scalings

@@ -37,6 +37,21 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
             remat=True,
         ),
     },
+    # SD1.5 inpainting UNet (runwayml sd-v1-5-inpainting layout):
+    # input = concat(noisy latents 4, mask 1, masked-image latents 4)
+    # — the InpaintModelConditioning node assembles the extra channels
+    "sd15-inpaint": {
+        "family": "unet",
+        "config": UNetConfig(
+            in_channels=9,
+            model_channels=320,
+            channel_mult=(1, 2, 4, 4),
+            transformer_depth=(1, 1, 1, 0),
+            context_dim=768,
+            num_heads=8,
+            remat=True,
+        ),
+    },
     "sdxl": {
         "family": "unet",
         "config": UNetConfig(
@@ -78,6 +93,20 @@ MODEL_REGISTRY: dict[str, dict[str, Any]] = {
     "tiny-unet": {
         "family": "unet",
         "config": UNetConfig(
+            model_channels=32,
+            channel_mult=(1, 2),
+            num_res_blocks=1,
+            transformer_depth=(1, 1),
+            context_dim=64,
+            num_heads=2,
+        ),
+    },
+    # tiny inpaint-model variant (9-channel input): exercises the
+    # concat-conditioning path of InpaintModelConditioning
+    "tiny-unet-inpaint": {
+        "family": "unet",
+        "config": UNetConfig(
+            in_channels=9,
             model_channels=32,
             channel_mult=(1, 2),
             num_res_blocks=1,

@@ -66,6 +66,12 @@ class Conditioning:
     # entry weight in multi-cond composition (ConditioningSetArea /
     # SetMask strength — NOT the ControlNet hint strength above)
     strength: float = 1.0
+    # inpaint-model concat channels (InpaintModelConditioning):
+    # [B, h_lat, w_lat, 1 + C] = mask ++ masked-image latents, joined
+    # to the model input AFTER the VP input scaling (the reference
+    # stack's c_concat convention); requires an in_channels-widened
+    # backbone (sd15-inpaint class)
+    concat_latent: Optional[jax.Array] = None
     # sampling-progress window (ConditioningSetTimestepRange): the
     # entry contributes only while percent is in [start, end)
     timestep_range: Optional[tuple] = None
@@ -263,6 +269,7 @@ def slice_batch(cond: Conditioning, start: int, size: int) -> Conditioning:
     out.context = cut(cond.context)
     out.control_hint = cut(cond.control_hint)
     out.mask = cut(cond.mask)
+    out.concat_latent = cut(cond.concat_latent)
     if cond.reference_latents is not None:
         out.reference_latents = [cut(lat) for lat in cond.reference_latents]
     if cond.model_patches is not None:
@@ -282,7 +289,7 @@ def _cond_flatten(cond: Conditioning):
     children = (
         cond.context, cond.control_hint, cond.mask, cond.control_params,
         cond.pooled, cond.gligen_embs, cond.reference_latents,
-        cond.model_patches,
+        cond.model_patches, cond.concat_latent,
     )
     aux = (
         cond.control_strength, cond.area, cond.control_module,
@@ -295,7 +302,7 @@ def _cond_flatten(cond: Conditioning):
 
 def _cond_unflatten(aux, children):
     (context, control_hint, mask, control_params, pooled, gligen_embs,
-     reference_latents, model_patches) = children
+     reference_latents, model_patches, concat_latent) = children
     (control_strength, area, control_module, gligen_boxes,
      gligen_active, guidance, size_cond, strength, timestep_range,
      control_range) = aux
@@ -316,6 +323,7 @@ def _cond_unflatten(aux, children):
         strength=strength,
         timestep_range=timestep_range,
         control_range=control_range,
+        concat_latent=concat_latent,
         reference_latents=reference_latents,
         model_patches=model_patches,
     )

@@ -383,7 +383,24 @@ def composite_eps(model_fn: ModelFn, x, sigma, cond, p2s=_default_p2s):
             ah = max(1, min(ah, x.shape[1] - ay))
             aw = max(1, min(aw, x.shape[2] - ax))
             x_c = x[:, ay:ay + ah, ax:ax + aw, :]
-            eps_c = model_fn(x_c, sigma, e)
+            e_c = e
+            if getattr(e, "concat_latent", None) is not None and (
+                e.concat_latent.shape[1:3] == x.shape[1:3]
+            ):
+                # spatial payloads follow the crop — the model would
+                # otherwise squash the full-image plane into the window
+                e_c = e.clone()
+                e_c.concat_latent = e.concat_latent[
+                    :, ay:ay + ah, ax:ax + aw, :
+                ]
+            if getattr(e, "control_hint", None) is not None:
+                # hints are pixel-space: crop the matching pixel window
+                e_c = e_c.clone() if e_c is e else e_c
+                k = max(1, e.control_hint.shape[1] // x.shape[1])
+                e_c.control_hint = e.control_hint[
+                    :, ay * k:(ay + ah) * k, ax * k:(ax + aw) * k, :
+                ]
+            eps_c = model_fn(x_c, sigma, e_c)
             w_c = jnp.broadcast_to(
                 wmap, x.shape[:-1] + (1,)
             )[:, ay:ay + ah, ax:ax + aw, :]

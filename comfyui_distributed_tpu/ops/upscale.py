@@ -230,6 +230,17 @@ def prep_cond_for_tiles(cond, grid: tile_ops.TileGrid):
             "tile path; remove the ConditioningSetArea restriction for "
             "upscaling"
         )
+    if c.concat_latent is not None:
+        # tile origins are traced; windowing the inpaint concat plane
+        # per tile needs the same canvas prep as reference_latents but
+        # at the BUNDLE's latent scale, which this grid doesn't know —
+        # reject loudly rather than let the model squash the full plane
+        raise ValueError(
+            "inpaint-model concat conditioning (InpaintModelConditioning)"
+            " is not supported by the USDU tile path; use the standard "
+            "inpaint flow (VAEEncodeForInpaint / SetLatentNoiseMask) for "
+            "tiled upscaling"
+        )
     p = grid.padding
     if c.control_hint is not None:
         hint = c.control_hint

@@ -1,0 +1,228 @@
+"""Utility nodes: VAEEncodeTiled, LatentFromBatch/LatentBatch,
+ImageBlur/ImageSharpen, LoraLoaderModelOnly, and the inpaint-model
+conditioning path (InpaintModelConditioning + 9-channel UNet)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import (
+    ImageBlur,
+    ImageSharpen,
+    InpaintModelConditioning,
+    KSampler,
+    LatentBatch,
+    LatentFromBatch,
+    VAEEncode,
+    VAEEncodeTiled,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def inpaint_bundle():
+    import jax
+
+    b = pl.load_pipeline("tiny-unet-inpaint", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def test_latent_from_batch_slices_with_mask():
+    z = jnp.arange(4 * 8 * 8 * 4, dtype=jnp.float32).reshape(4, 8, 8, 4)
+    mask = jnp.ones((4, 8, 8, 1))
+    (out,) = LatentFromBatch().frombatch(
+        {"samples": z, "noise_mask": mask}, 1, 2
+    )
+    assert out["samples"].shape == (2, 8, 8, 4)
+    np.testing.assert_array_equal(np.asarray(out["samples"]), np.asarray(z[1:3]))
+    assert out["noise_mask"].shape[0] == 2
+    # out-of-range clamps
+    (tail,) = LatentFromBatch().frombatch({"samples": z}, 10, 5)
+    assert tail["samples"].shape[0] == 1
+
+
+def test_latent_batch_resizes_second():
+    z1 = jnp.zeros((1, 8, 8, 4))
+    z2 = jnp.ones((2, 4, 4, 4))
+    (out,) = LatentBatch().batch({"samples": z1}, {"samples": z2})
+    assert out["samples"].shape == (3, 8, 8, 4)
+    np.testing.assert_allclose(np.asarray(out["samples"][1:]), 1.0, atol=1e-5)
+
+
+def test_blur_preserves_mean_and_smooths():
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    (bl,) = ImageBlur().blur(img, 3, 2.0)
+    assert bl.shape == img.shape
+    # normalized kernel + reflect padding ⇒ mean approximately kept
+    assert abs(float(bl.mean()) - float(img.mean())) < 1e-3
+    # high-frequency energy drops
+    def energy(a):
+        return float(jnp.abs(jnp.diff(a, axis=1)).mean())
+    assert energy(bl) < energy(img)
+    # radius 0 is identity
+    (same,) = ImageBlur().blur(img, 0, 2.0)
+    assert same is img
+
+
+def test_sharpen_increases_contrast():
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.uniform(0.2, 0.8, size=(1, 32, 32, 3)), jnp.float32)
+    (sh,) = ImageSharpen().sharpen(img, 2, 1.0, 1.0)
+    def energy(a):
+        return float(jnp.abs(jnp.diff(a, axis=1)).mean())
+    assert energy(sh) > energy(img)
+    assert float(sh.min()) >= 0.0 and float(sh.max()) <= 1.0
+
+
+def test_vae_encode_tiled_matches_full(inpaint_bundle):
+    """Tiled encode equals full encode away from tile seams (exact in
+    tile cores; feathered at boundaries)."""
+    rng = np.random.default_rng(2)
+    img = jnp.asarray(rng.uniform(size=(1, 128, 128, 3)), jnp.float32)
+    (full,) = VAEEncode().encode(img, inpaint_bundle)
+    (tiled,) = VAEEncodeTiled().encode_tiled(img, inpaint_bundle, 64)
+    a, b = np.asarray(full["samples"]), np.asarray(tiled["samples"])
+    assert a.shape == b.shape
+    # agreement over most of the plane (seam feathering differs)
+    close = np.isclose(a, b, atol=0.15).mean()
+    assert close > 0.8
+
+
+def test_inpaint_model_conditioning_shapes(inpaint_bundle):
+    rng = np.random.default_rng(3)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    mask = np.zeros((1, 32, 32), np.float32)
+    mask[:, 8:24, 8:24] = 1.0
+    p = pl.encode_text_pooled(inpaint_bundle, ["fill"])
+    n = pl.encode_text_pooled(inpaint_bundle, [""])
+    p2, n2, lat = InpaintModelConditioning().encode(
+        p, n, inpaint_bundle, img, jnp.asarray(mask)
+    )
+    # concat = mask (1) + masked-image latents (C)
+    assert p2.concat_latent.shape[-1] == 1 + inpaint_bundle.latent_channels
+    assert n2.concat_latent is not None
+    assert "noise_mask" in lat
+    # noise_mask=False omits the latent mask but keeps the concat
+    _p3, _n3, lat2 = InpaintModelConditioning().encode(
+        p, n, inpaint_bundle, img, jnp.asarray(mask), noise_mask=False
+    )
+    assert "noise_mask" not in lat2
+
+
+def test_inpaint_conditioning_accepts_4d_mask(inpaint_bundle):
+    """[B,H,W,1] MASK inputs (the codebase MASK contract) normalize
+    like everywhere else instead of crashing the resize."""
+    rng = np.random.default_rng(9)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    mask4d = jnp.ones((1, 16, 16, 1))
+    p = pl.encode_text_pooled(inpaint_bundle, ["x"])
+    n = pl.encode_text_pooled(inpaint_bundle, [""])
+    p2, _n2, lat = InpaintModelConditioning().encode(
+        p, n, inpaint_bundle, img, mask4d
+    )
+    assert p2.concat_latent.shape[-1] == 1 + inpaint_bundle.latent_channels
+    assert lat["samples"].shape[1:3] == p2.concat_latent.shape[1:3]
+
+
+def test_usdu_rejects_concat_conditioning(inpaint_bundle):
+    from comfyui_distributed_tpu.ops import tiles as tile_ops
+    from comfyui_distributed_tpu.ops import upscale as up
+
+    cond = pl.encode_text_pooled(inpaint_bundle, ["x"])
+    cond.concat_latent = jnp.zeros((1, 8, 8, 5))
+    grid = tile_ops.calculate_tiles(64, 64, 32, 4)
+    with pytest.raises(ValueError, match="concat conditioning"):
+        up.prep_cond_for_tiles(cond, grid)
+
+
+def test_inpaint_model_samples_nine_channels(inpaint_bundle):
+    """The 9-channel UNet consumes concat conditioning through a full
+    KSampler run; the unmasked region is pinned by the noise_mask."""
+    rng = np.random.default_rng(4)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    mask = np.zeros((1, 32, 32), np.float32)
+    mask[:, 16:] = 1.0
+    p = pl.encode_text_pooled(inpaint_bundle, ["fill"])
+    n = pl.encode_text_pooled(inpaint_bundle, [""])
+    p2, n2, lat = InpaintModelConditioning().encode(
+        p, n, inpaint_bundle, img, jnp.asarray(mask)
+    )
+    orig = np.asarray(lat["samples"])
+    (out,) = KSampler().sample(
+        inpaint_bundle, 3, 2, 7.0, "euler", "karras", p2, n2, lat
+    )
+    got = np.asarray(out["samples"])
+    assert got.shape == orig.shape
+    # the bilinear latent-mask resize feathers the boundary row; the
+    # interior of the preserved region is pinned exactly
+    np.testing.assert_array_equal(got[:, :7], orig[:, :7])
+    assert not np.array_equal(got[:, 9:], orig[:, 9:])
+
+
+def test_concat_conditioning_rejected_on_flow_models():
+    b = pl.load_pipeline("tiny-flux", seed=0)
+    cond = pl.encode_text_pooled(b, ["x"])
+    cond.concat_latent = jnp.zeros((1, 8, 8, 5))
+    neg = pl.encode_text_pooled(b, [""])
+    with pytest.raises(ValueError, match="flow-family"):
+        pl.img2img_latents(
+            b, jnp.zeros((1, 8, 8, 16)), cond, neg, steps=1,
+            sampler="euler", scheduler="normal", cfg_scale=1.0,
+        )
+
+
+def test_lora_loader_model_only(tmp_path, monkeypatch):
+    """Model-only LoRA patches the UNet of a text-encoder-less bundle
+    (UNETLoader product)."""
+    from safetensors.numpy import save_file
+
+    from comfyui_distributed_tpu.graph.nodes_core import LoraLoaderModelOnly
+    from comfyui_distributed_tpu.models.io import flatten_params
+    from comfyui_distributed_tpu.models.lora import lora_target_map
+    from comfyui_distributed_tpu.models.registry import get_config
+    import jax
+
+    b = pl.load_unet("tiny-unet")
+    targets = lora_target_map(get_config("tiny-unet"))
+    # pick one targeted unet module and build a rank-2 LoRA for it
+    name, (part, path) = next(
+        (n, t) for n, t in targets.items() if t[0] == "unet"
+    )
+    flat = flatten_params(jax.device_get(b.params["unet"]))
+    kernel = flat[path]
+    rng = np.random.default_rng(5)
+    down = rng.normal(0, 0.1, (2, kernel.shape[0])).astype(np.float32)
+    up = rng.normal(0, 0.1, (kernel.shape[1], 2)).astype(np.float32)
+    save_file(
+        {
+            f"{name}.lora_down.weight": down,
+            f"{name}.lora_up.weight": up,
+        },
+        str(tmp_path / "test-lora.safetensors"),
+    )
+    monkeypatch.setenv("CDT_LORA_DIR", str(tmp_path))
+    (patched,) = LoraLoaderModelOnly().load_lora_model_only(
+        b, "test-lora", 1.0
+    )
+    new_flat = flatten_params(jax.device_get(patched.params["unet"]))
+    assert not np.array_equal(new_flat[path], kernel)
+    # original untouched
+    np.testing.assert_array_equal(
+        flatten_params(jax.device_get(b.params["unet"]))[path], kernel
+    )

@@ -98,6 +98,24 @@ def test_timestep_window_gates_by_sigma():
     np.testing.assert_allclose(lo, 2.0)  # window closed: base only
 
 
+def test_area_crops_spatial_payloads():
+    """An area-restricted entry's concat_latent and control_hint are
+    CROPPED to the window, not squashed — the stub model returns its
+    concat channel mean so misalignment would shift the value."""
+    concat = jnp.zeros((1, 8, 8, 2)).at[:, :, 4:, :].set(8.0)
+
+    def probe_model(x, sigma, cond):
+        c = cond.concat_latent
+        assert c.shape[1:3] == x.shape[1:3]  # cropped, not full-plane
+        return jnp.full_like(x, float(c.mean()))
+
+    # right-half area: the crop of concat is all 8.0
+    e = _entry(0.0, area=(64, 32, 0, 32))
+    e.concat_latent = concat
+    out = np.asarray(smp.composite_eps(probe_model, X, SIGMA, [e]))
+    np.testing.assert_allclose(out[:, :, 4:], 8.0)
+
+
 def test_cfg_eval_routes_lists_through_composition():
     pos = [_entry(1.0, area=(64, 32, 0, 0)), _entry(2.0, area=(64, 32, 0, 32))]
     neg = _entry(0.0)
