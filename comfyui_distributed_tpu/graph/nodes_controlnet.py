@@ -152,6 +152,44 @@ class ConditioningSetArea:
 
 
 @register_node
+class ConditioningSetAreaPercentage:
+    """Area restriction in frame fractions (ComfyUI
+    ConditioningSetAreaPercentage parity): the fractions ride on the
+    conditioning as a ('percentage', h, w, y, x) area and resolve
+    against the ACTUAL frame where it is known — at trace time in the
+    sampler's composition (latent shape is concrete there) and against
+    image dims in tile cropping (ops/conditioning.resolve_area)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "width": ("FLOAT", {"default": 1.0}),
+                "height": ("FLOAT", {"default": 1.0}),
+                "x": ("FLOAT", {"default": 0.0}),
+                "y": ("FLOAT", {"default": 0.0}),
+                "strength": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "set_area"
+
+    def set_area(self, conditioning, width=1.0, height=1.0, x=0.0, y=0.0,
+                 strength=1.0, context=None):
+        def patch(cond):
+            cond.area = (
+                "percentage", float(height), float(width), float(y),
+                float(x),
+            )
+            cond.strength = float(strength)
+            return cond
+
+        return (map_conditioning(conditioning, patch),)
+
+
+@register_node
 class ConditioningCombine:
     """Combine two CONDITIONING values into a multi-entry list (ComfyUI
     ConditioningCombine parity): each entry keeps its own area / mask /
@@ -351,6 +389,11 @@ class SkipLayerGuidanceSD3:
             raise ValueError(
                 "SkipLayerGuidanceSD3 applies to SD3-class MMDiT models; "
                 f"{model.model_name!r} is not one"
+            )
+        if getattr(model, "cfg_rescale", None) is not None:
+            raise ValueError(
+                "SkipLayerGuidanceSD3 cannot combine with RescaleCFG on "
+                "the same model"
             )
         depth = get_config(model.model_name).depth
         layer_tuple = tuple(sorted({

@@ -187,6 +187,45 @@ class ExponentialScheduler:
 
 
 @register_node
+class SDTurboScheduler:
+    """Turbo/LCM-style few-step schedule (ComfyUI SDTurboScheduler
+    parity): `steps` sigmas picked from the top of the training table,
+    offset by (1 - denoise) * 1000 timesteps, with the terminal zero."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "steps": ("INT", {"default": 1}),
+                "denoise": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, model, steps=1, denoise=1.0, context=None):
+        param, _shift = pl.model_schedule_info(model)
+        if param == "flow":
+            raise ValueError(
+                "SDTurboScheduler indexes the VP training table; use "
+                "BasicScheduler for flow-family models"
+            )
+        n = int(steps)
+        if not 1 <= n <= 10:
+            raise ValueError("SDTurboScheduler takes 1-10 steps")
+        # the reference convention: timesteps 999, 899, ..., 99 (one
+        # per denoising decade), windowed by (1 - denoise) decades
+        start = 10 - int(10 * max(0.0, min(1.0, float(denoise))))
+        decades = [999 - 100 * i for i in range(10)]
+        chosen = decades[start:start + n]
+        table = smp._vp_sigmas()  # ascending, index = timestep
+        sigmas = np.asarray([table[i] for i in chosen], np.float32)
+        return (_terminal_zero(sigmas),)
+
+
+@register_node
 class SplitSigmas:
     """Split a schedule at a step boundary (ComfyUI SplitSigmas
     parity): high = sigmas[:step+1], low = sigmas[step:] — the shared

@@ -196,6 +196,39 @@ class EmptySD3LatentImage:
 
 
 @register_node
+class RescaleCFG:
+    """Std-rescaled guidance (ComfyUI RescaleCFG parity): the guided
+    x0 prediction rescales to the cond-only prediction's per-sample
+    std, lerped by `multiplier` — the standard companion to
+    v-prediction/zero-terminal-SNR finetunes. Implemented as a bundle
+    patch composed in pipeline.guided_model; combining with
+    SkipLayerGuidanceSD3 is rejected (the two patches both own the
+    guidance composition)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "multiplier": ("FLOAT", {"default": 0.7}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, multiplier=0.7, context=None):
+        if getattr(model, "slg", None) is not None:
+            raise ValueError(
+                "RescaleCFG cannot combine with SkipLayerGuidanceSD3 on "
+                "the same model"
+            )
+        return (
+            dataclasses.replace(model, cfg_rescale=float(multiplier)),
+        )
+
+
+@register_node
 class ModelSamplingDiscrete:
     """Override the VP parameterization (ComfyUI ModelSamplingDiscrete
     parity): eps or v_prediction. zsnr rescaling is not implemented —

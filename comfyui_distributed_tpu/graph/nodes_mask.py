@@ -443,3 +443,73 @@ class LatentCompositeMasked:
             multiplier=8, resize_source=bool(resize_source),
         )
         return (out,)
+
+
+@register_node
+class ThresholdMask:
+    """Binarize a mask at a threshold (ComfyUI ThresholdMask parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "mask": ("MASK",),
+                "value": ("FLOAT", {"default": 0.5}),
+            }
+        }
+
+    RETURN_TYPES = ("MASK",)
+    FUNCTION = "image_to_mask"
+
+    def image_to_mask(self, mask, value=0.5, context=None):
+        return ((as_mask(mask) > float(value)).astype(jnp.float32),)
+
+
+@register_node
+class JoinImageWithAlpha:
+    """Attach a mask as the image's alpha channel (ComfyUI
+    JoinImageWithAlpha parity): alpha = 1 - mask (MASK selects the
+    transparent region)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"image": ("IMAGE",), "alpha": ("MASK",)}
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "join_image_with_alpha"
+
+    def join_image_with_alpha(self, image, alpha, context=None):
+        m = as_mask(alpha)
+        if m.shape[1:] != image.shape[1:3]:
+            m = jax.image.resize(
+                m, (m.shape[0],) + image.shape[1:3], method="linear"
+            )
+        rgb = image[..., :3]
+        m, rgb = _broadcast_batch(m, rgb)
+        return (
+            jnp.concatenate([rgb, (1.0 - m)[..., None]], axis=-1),
+        )
+
+
+@register_node
+class SplitImageWithAlpha:
+    """Split an RGBA image into RGB + MASK (ComfyUI SplitImageWithAlpha
+    parity; mask = 1 - alpha). Alpha-less images yield an all-zero
+    mask."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {"required": {"image": ("IMAGE",)}}
+
+    RETURN_TYPES = ("IMAGE", "MASK")
+    FUNCTION = "split_image_with_alpha"
+
+    def split_image_with_alpha(self, image, context=None):
+        rgb = image[..., :3]
+        if image.shape[-1] > 3:
+            mask = 1.0 - image[..., 3]
+        else:
+            mask = jnp.zeros(image.shape[:3], jnp.float32)
+        return (rgb, mask)

@@ -159,6 +159,65 @@ class VideoFlowSampler:
 
 
 @register_node
+class WanImageToVideo:
+    """Image→video (the reference's WAN i2v workflow role; ComfyUI
+    WanImageToVideo parity in spirit — prompts ride as strings because
+    the WAN text encoder lives in the video bundle). i2v-layout models
+    run the native conditioning (channel-concat mask + reference
+    latent + CLIP-vision tokens); other video models fall back to
+    clamping frame 0 along the flow path. Seed fan-out across
+    participants rides the elastic tier (per-worker seed offsets), not
+    the mesh: the i2v conditioning batch is per-reference-image."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "image": ("IMAGE",),
+                "prompt": ("STRING", {"default": ""}),
+                "negative_prompt": ("STRING", {"default": ""}),
+                "frames": ("INT", {"default": 17}),
+                "steps": ("INT", {"default": 20}),
+                "cfg": ("FLOAT", {"default": 5.0}),
+                "seed": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "generate"
+
+    def generate(self, model, image, prompt="", negative_prompt="",
+                 frames=17, steps=20, cfg=5.0, seed=0, context=None):
+        from ..models.registry import get_config
+
+        spec = resolve_seed(seed)
+        bundle: vp.VideoPipelineBundle = model
+        n_frames = int(frames)
+        if getattr(get_config(bundle.model_name), "i2v", False) and (
+            n_frames % 4 != 1
+        ):
+            # the WAN causal-VAE stride constraint (reference 4n+1
+            # batch validation); the non-i2v fallback has no stride
+            raise ValueError(
+                f"frame count must be 4n+1 for i2v-layout models; "
+                f"got {n_frames}"
+            )
+        out = vp.i2v(
+            bundle,
+            image,
+            str(prompt),
+            negative_prompt=str(negative_prompt),
+            frames=n_frames,
+            steps=int(steps),
+            cfg_scale=float(cfg),
+            seed=int(spec.effective_seed()),
+        )
+        b, f = out.shape[0], out.shape[1]
+        return (out.reshape((b * f,) + out.shape[2:]),)
+
+
+@register_node
 class SaveVideoFrames:
     """Persist a frame sequence as numbered PNGs + a manifest (the
     VHS-video-combine role in reference workflows, minus containers —

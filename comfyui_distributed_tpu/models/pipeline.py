@@ -59,6 +59,9 @@ class PipelineBundle:
     # samplers exactly once). None = the registry config's values.
     flow_shift_override: float | None = None
     parameterization_override: str | None = None
+    # RescaleCFG patch: std-rescale multiplier of the guided x0
+    # prediction (None = plain CFG)
+    cfg_rescale: float | None = None
 
 
 @dataclasses.dataclass
@@ -956,6 +959,10 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     base_fn = _make_model_fn(bundle, params)
     p2s = percent_converter(bundle)
     slg = getattr(bundle, "slg", None)
+    if bundle.cfg_rescale is not None and not slg:
+        return smp.rescale_cfg_model(
+            base_fn, cfg_scale, float(bundle.cfg_rescale), p2s=p2s
+        )
     if not slg:
         return smp.cfg_model(base_fn, cfg_scale, p2s=p2s)
     return smp.slg_cfg_model(

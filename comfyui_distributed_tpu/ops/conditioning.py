@@ -98,6 +98,24 @@ def as_conditioning(value: Any) -> Conditioning:
     return Conditioning(context=value)
 
 
+def resolve_area(area, image_h: int, image_w: int):
+    """Area → pixel ints against an actual frame. Fractional areas
+    (ConditioningSetAreaPercentage's ('percentage', h, w, y, x) marker
+    — the reference stack's convention) resolve at use time, where the
+    frame is known; pixel areas pass through."""
+    if area is None:
+        return None
+    if area[0] == "percentage":
+        _tag, fh, fw, fy, fx = area
+        return (
+            int(float(fh) * image_h),
+            int(float(fw) * image_w),
+            int(float(fy) * image_h),
+            int(float(fx) * image_w),
+        )
+    return area
+
+
 def map_conditioning(value: Any, fn) -> Any:
     """Apply an entry transform across a CONDITIONING value — a single
     entry, or the list ConditioningCombine produces (the reference
@@ -166,7 +184,7 @@ def crop_to_tile(
             mask, (0, max(y, 0), max(x, 0)), (mask.shape[0], tile_h, tile_w)
         )
     if cond.area is not None:
-        ah, aw, ay, ax = cond.area
+        ah, aw, ay, ax = resolve_area(cond.area, image_h, image_w)
         # intersect [ay, ay+ah) x [ax, ax+aw) with the tile window
         top = max(ay, y)
         left = max(ax, x)

@@ -375,7 +375,17 @@ def composite_eps(model_fn: ModelFn, x, sigma, cond, p2s=_default_p2s):
             wmap = wmap * gate
         area = getattr(e, "area", None)
         if area is not None:
-            ah, aw, ay, ax = (int(v) // 8 for v in area)
+            if area[0] == "percentage":
+                # frame fractions resolve against the latent at trace
+                # time (x.shape is concrete here) — the reference
+                # stack's ConditioningSetAreaPercentage semantics
+                _tag, fh, fw, fy, fx = area
+                ah = int(float(fh) * x.shape[1])
+                aw = int(float(fw) * x.shape[2])
+                ay = int(float(fy) * x.shape[1])
+                ax = int(float(fx) * x.shape[2])
+            else:
+                ah, aw, ay, ax = (int(v) // 8 for v in area)
             # clamp origin INTO the latent too: an off-frame origin
             # would slice a zero-size crop and crash the model trace
             ay = min(max(ay, 0), x.shape[1] - 1)
@@ -459,6 +469,45 @@ def cfg_model(model_fn: ModelFn, cfg_scale: float,
     def guided(x, sigma, cond):
         _eps_pos, out = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
         return out
+
+    return guided
+
+
+def rescale_cfg_model(
+    model_fn: ModelFn,
+    cfg_scale: float,
+    multiplier: float,
+    p2s=_default_p2s,
+) -> ModelFn:
+    """CFG with std rescaling (the reference stack's RescaleCFG patch,
+    Lin et al. "Common Diffusion Noise Schedules..." §3.4). The
+    rescale is computed on the V-PREDICTION transform of the two
+    denoised outputs — exactly the reference composition, where the
+    per-sample stds are taken in v space (std(v) differs from
+    std(x0) by the spatially varying x-term, so an x0-space rescale
+    would diverge from reference output) — then converted back to the
+    sampler's eps contract (denoised = x - sigma*eps)."""
+
+    def guided(x, sigma, cond):
+        eps_pos, eps_cfg = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
+        sig = sigma.reshape((-1,) + (1,) * (x.ndim - 1))
+        x0_pos = x - sig * eps_pos
+        x0_cfg = x - sig * eps_cfg
+        # reference transform: xs = x/(s^2+1); v = (xs - (x - x0)) *
+        # sqrt(s^2+1)/s. Affine in x0 with a shared offset, so applying
+        # CFG before or after the transform is equivalent.
+        xs = x / (sig * sig + 1.0)
+        scale = jnp.sqrt(sig * sig + 1.0) / jnp.maximum(sig, 1e-10)
+        v_pos = (xs - (x - x0_pos)) * scale
+        v_cfg = (xs - (x - x0_cfg)) * scale
+        axes = tuple(range(1, x.ndim))
+        ro_pos = jnp.std(v_pos, axis=axes, keepdims=True)
+        ro_cfg = jnp.maximum(jnp.std(v_cfg, axis=axes, keepdims=True), 1e-8)
+        v_rescaled = v_cfg * (ro_pos / ro_cfg)
+        v_final = multiplier * v_rescaled + (1.0 - multiplier) * v_cfg
+        # inverse transform back to denoised, then to eps
+        x0 = x - (xs - v_final * sig / jnp.sqrt(sig * sig + 1.0))
+        return (x - x0) / jnp.maximum(sig, 1e-10)
 
     return guided
 

@@ -209,6 +209,104 @@ def compute_goldens(quick: bool = False) -> dict[str, np.ndarray]:
             scheduler="simple",
         )
     )
+
+    # --- round-5 surfaces ------------------------------------------------
+
+    from comfyui_distributed_tpu.graph.nodes_controlnet import (
+        ConditioningCombine,
+        ConditioningSetArea,
+        ConditioningSetTimestepRange,
+        ConditioningZeroOut,
+    )
+    from comfyui_distributed_tpu.graph.nodes_core import (
+        CLIPTextEncodeSDXL,
+        ImageSharpen,
+        InpaintModelConditioning,
+    )
+    from comfyui_distributed_tpu.graph.nodes_custom_sampling import (
+        BasicScheduler,
+        CFGGuider,
+        DisableNoise,
+        KSamplerSelect,
+        RandomNoise,
+        SamplerCustomAdvanced,
+        SplitSigmas,
+    )
+
+    # custom-sampling two-stage split: stage-1 leftover-noise output,
+    # its x0 prediction (the denoised extra eval), and the stage-2
+    # resume — freezes the static-sigma-tuple jit path end to end
+    (cel,) = EmptyLatentImage().generate(32, 32, 1)
+    (csig,) = BasicScheduler().get_sigmas(bundle, "karras", 4, 1.0)
+    high, low = SplitSigmas().split(csig, 2)
+    (csamp,) = KSamplerSelect().get_sampler("euler")
+    (cnoise,) = RandomNoise().get_noise(5)
+    (cguider,) = CFGGuider().get_guider(bundle, pos_p, neg_p, 7.0)
+    s1, s1_den = SamplerCustomAdvanced().sample(
+        cnoise, cguider, csamp, high, cel
+    )
+    (cno,) = DisableNoise().get_noise()
+    s2, _ = SamplerCustomAdvanced().sample(cno, cguider, csamp, low, s1)
+    out["custom_stage1_32"] = np.asarray(s1["samples"])
+    out["custom_stage1_denoised_32"] = np.asarray(s1_den["samples"])
+    out["custom_stage2_32"] = np.asarray(s2["samples"])
+
+    # regional conditioning: two areas + a timestep-split negative
+    # through one KSampler run (composite_eps + window gates)
+    pos_b = pl.encode_text_pooled(bundle, ["golden region two"])
+    (area_a,) = ConditioningSetArea().set_area(pos_p, 16, 32, 0, 0, 1.0)
+    (area_b,) = ConditioningSetArea().set_area(pos_b, 16, 32, 16, 0, 1.2)
+    (regional,) = ConditioningCombine().combine(area_a, area_b)
+    (zeroed,) = ConditioningZeroOut().zero_out(neg_p)
+    (neg_early,) = ConditioningSetTimestepRange().set_range(neg_p, 0.0, 0.5)
+    (neg_late,) = ConditioningSetTimestepRange().set_range(zeroed, 0.5, 1.0)
+    (neg_split,) = ConditioningCombine().combine(neg_early, neg_late)
+    (rlat,) = KSampler().sample(
+        bundle, 13, 2, 7.0, "euler", "karras", regional, neg_split, cel,
+        denoise=1.0,
+    )
+    out["regional_latent_32"] = np.asarray(rlat["samples"])
+
+    # SDXL dual-prompt + size conditioning (adm Fourier embeddings)
+    abundle = pl.load_pipeline("tiny-unet-adm", seed=0)
+    (sdxl_cond,) = CLIPTextEncodeSDXL().encode(
+        abundle, 64, 64, 8, 8, 32, 32, "golden castle", "golden stone"
+    )
+    aneg = pl.encode_text_pooled(abundle, [""])
+    (alat,) = KSampler().sample(
+        abundle, 17, 2, 7.0, "euler", "karras", sdxl_cond, aneg, cel,
+        denoise=1.0,
+    )
+    out["sdxl_sizecond_latent_32"] = np.asarray(alat["samples"])
+
+    # inpaint-model conditioning: 9-channel UNet + concat channels
+    ibundle = pl.load_pipeline("tiny-unet-inpaint", seed=0)
+    ipos = pl.encode_text_pooled(ibundle, ["golden fill"])
+    ineg = pl.encode_text_pooled(ibundle, [""])
+    ip, ineg2, ilat9 = InpaintModelConditioning().encode(
+        ipos, ineg, ibundle, pix, jnp.asarray(imask)
+    )
+    (ilat9s,) = KSampler().sample(
+        ibundle, 19, 2, 7.0, "euler", "karras", ip, ineg2, ilat9,
+        denoise=1.0,
+    )
+    out["inpaint_model_latent_32"] = np.asarray(ilat9s["samples"])
+
+    # ModelSamplingFlux resolution shift reshapes the flow grid
+    import dataclasses as _dc
+
+    shifted = _dc.replace(fbundle, flow_shift_override=2.5)
+    out["flux_shift25_txt2img_32"] = np.asarray(
+        pl.txt2img(
+            shifted, "a golden shifted flux image", height=32, width=32,
+            steps=2, seed=99, cfg_scale=1.0, sampler="euler",
+            scheduler="simple",
+        )
+    )
+
+    # image filter kernels (separable Gaussian + unsharp mask)
+    (sharp,) = ImageSharpen().sharpen(pix, 2, 1.0, 0.8)
+    out["sharpen_32"] = np.asarray(sharp)
     return out
 
 

@@ -1,0 +1,147 @@
+"""RescaleCFG, SDTurboScheduler, ThresholdMask, alpha split/join,
+ConditioningSetAreaPercentage — the round-5 second widening batch."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_controlnet import (
+    ConditioningSetAreaPercentage,
+    SkipLayerGuidanceSD3,
+)
+from comfyui_distributed_tpu.graph.nodes_core import (
+    EmptyLatentImage,
+    KSampler,
+)
+from comfyui_distributed_tpu.graph.nodes_custom_sampling import (
+    SDTurboScheduler,
+)
+from comfyui_distributed_tpu.graph.nodes_loaders import RescaleCFG
+from comfyui_distributed_tpu.graph.nodes_mask import (
+    JoinImageWithAlpha,
+    SplitImageWithAlpha,
+    ThresholdMask,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(123)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+def test_rescale_cfg_changes_sampling(bundle):
+    pos = pl.encode_text_pooled(bundle, ["forest"])
+    neg = pl.encode_text_pooled(bundle, [""])
+    (el,) = EmptyLatentImage().generate(32, 32, 1)
+    (base,) = KSampler().sample(
+        bundle, 5, 2, 7.0, "euler", "karras", pos, neg, el
+    )
+    (patched,) = RescaleCFG().patch(bundle, 0.7)
+    (rescaled,) = KSampler().sample(
+        patched, 5, 2, 7.0, "euler", "karras", pos, neg, el
+    )
+    assert not np.allclose(
+        np.asarray(base["samples"]), np.asarray(rescaled["samples"])
+    )
+    # multiplier 0 keeps plain-CFG MATH; identical program structure ⇒
+    # results equal (the lerp reduces to x0_cfg exactly)
+    (zero,) = RescaleCFG().patch(bundle, 0.0)
+    m_plain = smp.rescale_cfg_model(
+        pl._make_model_fn(bundle, bundle.params), 7.0, 0.0
+    )
+    m_cfg = smp.cfg_model(pl._make_model_fn(bundle, bundle.params), 7.0)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(1, 16, 16, 4)), jnp.float32)
+    sig = jnp.asarray([5.0])
+    np.testing.assert_allclose(
+        np.asarray(m_plain(x, sig, (pos, neg))),
+        np.asarray(m_cfg(x, sig, (pos, neg))),
+        atol=2e-2,  # eps-space round trip through x0 at bf16 compute
+    )
+    assert zero.cfg_rescale == 0.0
+
+
+def test_rescale_cfg_slg_exclusive():
+    s3 = pl.load_pipeline("tiny-sd3", seed=0)
+    (slg,) = SkipLayerGuidanceSD3().skip_guidance(s3, "0", 3.0, 0.0, 0.2)
+    with pytest.raises(ValueError, match="SkipLayerGuidanceSD3"):
+        RescaleCFG().patch(slg, 0.7)
+    (rescaled,) = RescaleCFG().patch(s3, 0.7)
+    with pytest.raises(ValueError, match="RescaleCFG"):
+        SkipLayerGuidanceSD3().skip_guidance(rescaled, "0", 3.0, 0.0, 0.2)
+
+
+def test_sd_turbo_scheduler_decades(bundle):
+    (sig,) = SDTurboScheduler().get_sigmas(bundle, 2, 1.0)
+    table = smp._vp_sigmas()
+    np.testing.assert_allclose(
+        np.asarray(sig), [table[999], table[899], 0.0], rtol=1e-6
+    )
+    # denoise 0.5 starts five decades in
+    (sig2,) = SDTurboScheduler().get_sigmas(bundle, 1, 0.5)
+    np.testing.assert_allclose(np.asarray(sig2), [table[499], 0.0], rtol=1e-6)
+    with pytest.raises(ValueError, match="1-10"):
+        SDTurboScheduler().get_sigmas(bundle, 11, 1.0)
+    flux = pl.load_pipeline("tiny-flux", seed=0)
+    with pytest.raises(ValueError, match="flow-family"):
+        SDTurboScheduler().get_sigmas(flux, 1, 1.0)
+
+
+def test_threshold_mask():
+    m = jnp.asarray([[0.2, 0.5, 0.8]])[None]
+    (out,) = ThresholdMask().image_to_mask(m, 0.5)
+    np.testing.assert_array_equal(np.asarray(out), [[[0.0, 0.0, 1.0]]])
+
+
+def test_alpha_join_split_roundtrip():
+    rng = np.random.default_rng(0)
+    rgb = jnp.asarray(rng.uniform(size=(1, 8, 8, 3)), jnp.float32)
+    mask = jnp.asarray(rng.uniform(size=(1, 8, 8)), jnp.float32)
+    (rgba,) = JoinImageWithAlpha().join_image_with_alpha(rgb, mask)
+    assert rgba.shape == (1, 8, 8, 4)
+    out_rgb, out_mask = SplitImageWithAlpha().split_image_with_alpha(rgba)
+    np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(rgb))
+    np.testing.assert_allclose(
+        np.asarray(out_mask), np.asarray(mask), atol=1e-6
+    )
+    # alpha-less input: zero mask
+    _, m0 = SplitImageWithAlpha().split_image_with_alpha(rgb)
+    assert not np.any(np.asarray(m0))
+
+
+def test_area_percentage_carries_fractions(bundle):
+    """Fractions ride as the ('percentage', ...) marker and resolve
+    against the ACTUAL frame wherever it is known — no canvas-size
+    inputs (reference workflows don't carry any)."""
+    from comfyui_distributed_tpu.ops.conditioning import resolve_area
+
+    cond = pl.encode_text_pooled(bundle, ["x"])
+    (out,) = ConditioningSetAreaPercentage().set_area(
+        cond, 0.5, 0.25, 0.5, 0.0, 0.9
+    )
+    assert out.area == ("percentage", 0.25, 0.5, 0.0, 0.5)
+    assert out.strength == 0.9
+    # resolution against a 1024x512 frame
+    assert resolve_area(out.area, 512, 1024) == (128, 512, 0, 512)
+    # pixel areas pass through untouched
+    assert resolve_area((8, 8, 0, 0), 512, 1024) == (8, 8, 0, 0)

@@ -1,0 +1,50 @@
+"""WanImageToVideo node: the reference's WAN i2v workflow role at the
+node layer (native i2v conditioning for i2v-layout models, frame-0
+clamp fallback otherwise)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph import ExecutionContext
+from comfyui_distributed_tpu.graph.nodes_video import (
+    VideoCheckpointLoader,
+    WanImageToVideo,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_i2v_node_generates_frames():
+    ctx = ExecutionContext()
+    (bundle, _clip, _vae) = VideoCheckpointLoader().load("tiny-dit-i2v", context=ctx)
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    (frames,) = WanImageToVideo().generate(
+        bundle, img, "pan right", frames=5, steps=2, cfg=5.0, seed=3,
+        context=ctx,
+    )
+    assert frames.shape == (5, 32, 32, 3)
+    assert np.all(np.isfinite(np.asarray(frames)))
+
+
+def test_i2v_node_validates_stride_for_i2v_models():
+    ctx = ExecutionContext()
+    (bundle, _c, _v) = VideoCheckpointLoader().load("tiny-dit-i2v", context=ctx)
+    img = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="4n\\+1"):
+        WanImageToVideo().generate(bundle, img, "x", frames=6, steps=1,
+                                   context=ctx)
+
+
+def test_i2v_node_fallback_allows_any_frames():
+    """Non-i2v-layout video models take the frame-0 clamp fallback,
+    which has no causal-VAE stride constraint."""
+    ctx = ExecutionContext()
+    (bundle, _c, _v) = VideoCheckpointLoader().load("tiny-dit", context=ctx)
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.uniform(size=(1, 32, 32, 3)), jnp.float32)
+    (frames,) = WanImageToVideo().generate(
+        bundle, img, "x", frames=4, steps=1, cfg=1.0, seed=0, context=ctx
+    )
+    assert frames.shape[0] == 4

@@ -73,6 +73,17 @@ def test_off_frame_area_origin_is_clamped():
     np.testing.assert_allclose(out[:, :, -1], 3.0)  # clamped to last col
 
 
+def test_percentage_area_resolves_against_latent():
+    """('percentage', ...) areas resolve at trace time against the
+    actual latent shape — a half-width fraction covers exactly half of
+    ANY canvas."""
+    a = _entry(1.0, area=("percentage", 1.0, 0.5, 0.0, 0.0))
+    b = _entry(2.0, area=("percentage", 1.0, 0.5, 0.0, 0.5))
+    out = np.asarray(smp.composite_eps(_stub_model, X, SIGMA, [a, b]))
+    np.testing.assert_allclose(out[:, :, :4], 1.0)
+    np.testing.assert_allclose(out[:, :, 4:], 2.0)
+
+
 def test_mask_weights_spatially():
     mask = np.zeros((1, 8, 8), np.float32)
     mask[:, :, 4:] = 1.0

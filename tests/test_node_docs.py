@@ -1,0 +1,24 @@
+"""docs/nodes.md must match the live node registry (generated doc —
+the drift guard that keeps the node reference honest). Runs the
+generator in a SUBPROCESS: other tests register throwaway node classes
+into the in-process registry, which would pollute an in-process
+comparison."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_node_docs_current():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_node_docs.py"),
+         "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"docs/nodes.md is stale; run scripts/gen_node_docs.py\n"
+        f"{proc.stdout}{proc.stderr}"
+    )
