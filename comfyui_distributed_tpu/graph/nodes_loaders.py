@@ -195,6 +195,78 @@ class EmptySD3LatentImage:
         )
 
 
+def _patch_freeu(model, b1, b2, s1, s2, v2: bool):
+    from ..models.registry import model_family
+    from ..models.unet import UNet
+
+    if model_family(model.model_name) != "unet":
+        raise ValueError(
+            "FreeU patches SD-class UNets (skip-connection joins); "
+            f"{model.model_name!r} is not one"
+        )
+    # patch the LIVE module's config (keeps any earlier config-level
+    # patches), not the registry's pristine copy
+    cfg = dataclasses.replace(
+        model.unet.config,
+        freeu=(float(b1), float(b2), float(s1), float(s2), bool(v2)),
+    )
+    # same weights, new module: the patch adds no parameters, so the
+    # existing param tree applies unchanged and the jitted samplers
+    # recompile exactly once for the patched bundle
+    return dataclasses.replace(model, unet=UNet(cfg))
+
+
+@register_node
+class FreeU:
+    """FreeU backbone/skip re-weighting (ComfyUI FreeU parity): at the
+    model_channels*4 / *2 up-path joins, the first half of the
+    backbone channels scales by b1/b2 and the skip's low-frequency
+    Fourier box scales by s1/s2."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "b1": ("FLOAT", {"default": 1.1}),
+                "b2": ("FLOAT", {"default": 1.2}),
+                "s1": ("FLOAT", {"default": 0.9}),
+                "s2": ("FLOAT", {"default": 0.2}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, b1=1.1, b2=1.2, s1=0.9, s2=0.2, context=None):
+        return (_patch_freeu(model, b1, b2, s1, s2, v2=False),)
+
+
+@register_node
+class FreeU_V2:
+    """FreeU v2 (ComfyUI FreeU_V2 parity): the backbone scale adapts
+    per pixel via the normalized hidden-mean map instead of a
+    constant."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "b1": ("FLOAT", {"default": 1.3}),
+                "b2": ("FLOAT", {"default": 1.4}),
+                "s1": ("FLOAT", {"default": 0.9}),
+                "s2": ("FLOAT", {"default": 0.2}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, b1=1.3, b2=1.4, s1=0.9, s2=0.2, context=None):
+        return (_patch_freeu(model, b1, b2, s1, s2, v2=True),)
+
+
 @register_node
 class RescaleCFG:
     """Std-rescaled guidance (ComfyUI RescaleCFG parity): the guided

@@ -192,6 +192,18 @@ class WanImageToVideo:
         from ..models.registry import get_config
 
         spec = resolve_seed(seed)
+        mesh = getattr(context, "mesh", None) if context is not None else None
+        if spec.per_participant and mesh is not None and (
+            data_axis_size(mesh) > 1
+        ):
+            # loud like the codebase's other unsupported combinations —
+            # silently collapsing to one seed would read as fan-out
+            raise ValueError(
+                "WanImageToVideo does not fan out per-participant seeds "
+                "on a mesh (the i2v conditioning batch is per reference "
+                "image); distribute i2v via the elastic tier's "
+                "per-worker seed offsets instead"
+            )
         bundle: vp.VideoPipelineBundle = model
         n_frames = int(frames)
         if getattr(get_config(bundle.model_name), "i2v", False) and (

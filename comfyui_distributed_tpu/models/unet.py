@@ -53,10 +53,60 @@ class UNetConfig:
     # rematerialise attention blocks: trades recompute for HBM, the
     # standard lever for big latents on 16GB chips
     remat: bool = False
+    # FreeU patch (the FreeU / FreeU_V2 nodes): (b1, b2, s1, s2, v2)
+    # — backbone-half scaling + Fourier low-pass skip scaling at the
+    # model_channels*4 / *2 up-path joins. None = unpatched. Carried
+    # on the config so the patched module recompiles exactly once and
+    # adds zero cost when absent.
+    freeu: Optional[tuple] = None
 
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
+
+
+def _fourier_lowpass_scale(x: jax.Array, threshold: int, scale) -> jax.Array:
+    """Scale the centered low-frequency box of a [B, H, W, C] plane
+    (the reference stack's Fourier_filter: fft2 → shift → scale the
+    (2*threshold)^2 center → inverse). Computed in float32 — FFT of a
+    bf16 plane would quantize the whole spectrum."""
+    xf = jnp.fft.fftn(x.astype(jnp.float32), axes=(1, 2))
+    xf = jnp.fft.fftshift(xf, axes=(1, 2))
+    b, hh, ww, c = x.shape
+    crow, ccol = hh // 2, ww // 2
+    mask = jnp.ones((1, hh, ww, 1), jnp.float32)
+    y0, y1 = max(0, crow - threshold), min(hh, crow + threshold)
+    x0, x1 = max(0, ccol - threshold), min(ww, ccol + threshold)
+    mask = mask.at[:, y0:y1, x0:x1, :].set(scale)
+    xf = xf * mask
+    xf = jnp.fft.ifftshift(xf, axes=(1, 2))
+    return jnp.fft.ifftn(xf, axes=(1, 2)).real.astype(x.dtype)
+
+
+def _apply_freeu(cfg, ch: int, h: jax.Array, skip: jax.Array):
+    """FreeU at one up-path join: backbone half-channel scaling (b) +
+    Fourier low-pass scaling of the skip (s), keyed on the backbone
+    width exactly like the reference patch (model_channels*4 → b1/s1,
+    model_channels*2 → b2/s2). v2 scales adaptively by the normalized
+    per-pixel hidden mean instead of a constant."""
+    b1, b2, s1, s2, v2 = cfg.freeu
+    scale_map = {ch * 4: (b1, s1), ch * 2: (b2, s2)}
+    pair = scale_map.get(h.shape[-1])
+    if pair is None:
+        return h, skip
+    b, s = pair
+    half = h.shape[-1] // 2
+    if v2:
+        hidden_mean = jnp.mean(h.astype(jnp.float32), axis=-1, keepdims=True)
+        hmax = jnp.max(hidden_mean, axis=(1, 2), keepdims=True)
+        hmin = jnp.min(hidden_mean, axis=(1, 2), keepdims=True)
+        hidden_mean = (hidden_mean - hmin) / jnp.maximum(hmax - hmin, 1e-8)
+        factor = ((b - 1.0) * hidden_mean + 1.0).astype(h.dtype)
+    else:
+        factor = jnp.asarray(b, h.dtype)
+    h = jnp.concatenate([h[..., :half] * factor, h[..., half:]], axis=-1)
+    skip = _fourier_lowpass_scale(skip, 1, s)
+    return h, skip
 
 
 class UNet(nn.Module):
@@ -139,7 +189,10 @@ class UNet(nn.Module):
         for level, mult in reversed(list(enumerate(cfg.channel_mult))):
             out_ch = ch * mult
             for i in range(cfg.num_res_blocks + 1):
-                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                skip = skips.pop()
+                if cfg.freeu is not None:
+                    h, skip = _apply_freeu(cfg, ch, h, skip)
+                h = jnp.concatenate([h, skip], axis=-1)
                 h = ResBlock(out_ch, dt, name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:
                     heads, hdim = head_split(out_ch)

@@ -375,15 +375,13 @@ def composite_eps(model_fn: ModelFn, x, sigma, cond, p2s=_default_p2s):
             wmap = wmap * gate
         area = getattr(e, "area", None)
         if area is not None:
+            from .conditioning import resolve_area
+
             if area[0] == "percentage":
                 # frame fractions resolve against the latent at trace
                 # time (x.shape is concrete here) — the reference
                 # stack's ConditioningSetAreaPercentage semantics
-                _tag, fh, fw, fy, fx = area
-                ah = int(float(fh) * x.shape[1])
-                aw = int(float(fw) * x.shape[2])
-                ay = int(float(fy) * x.shape[1])
-                ax = int(float(fx) * x.shape[2])
+                ah, aw, ay, ax = resolve_area(area, x.shape[1], x.shape[2])
             else:
                 ah, aw, ay, ax = (int(v) // 8 for v in area)
             # clamp origin INTO the latent too: an off-frame origin

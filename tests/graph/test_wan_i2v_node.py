@@ -37,6 +37,26 @@ def test_i2v_node_validates_stride_for_i2v_models():
                                    context=ctx)
 
 
+def test_i2v_node_rejects_mesh_fanout():
+    """A per-participant SeedSpec on a mesh errors loudly instead of
+    silently collapsing to one seed (fan-out for i2v rides the elastic
+    tier)."""
+    from types import SimpleNamespace
+
+    from comfyui_distributed_tpu.graph.nodes_core import SeedSpec
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    ctx = ExecutionContext()
+    (bundle, _c, _v) = VideoCheckpointLoader().load("tiny-dit-i2v", context=ctx)
+    mesh_ctx = SimpleNamespace(mesh=build_mesh({"data": 8}))
+    with pytest.raises(ValueError, match="elastic tier"):
+        WanImageToVideo().generate(
+            bundle, jnp.zeros((1, 32, 32, 3)), "x", frames=5, steps=1,
+            seed=SeedSpec(base_seed=1, per_participant=True),
+            context=mesh_ctx,
+        )
+
+
 def test_i2v_node_fallback_allows_any_frames():
     """Non-i2v-layout video models take the frame-0 clamp fallback,
     which has no causal-VAE stride constraint."""
