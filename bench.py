@@ -51,7 +51,11 @@ reduced accelerator child caps), BENCH_TINY_BUDGET_S,
 BENCH_TILE_BATCH (USDU tile grouping; default 1 on CPU, 4 on
 accelerators), BENCH_TERM_GRACE_S (SIGTERM->SIGKILL harvest window on
 probe timeout), BENCH_PROBE_PLATFORM (pin the probe child's backend
-via the config API — the env var is overridden by hosted plugins).
+via the config API — the env var is overridden by hosted plugins),
+CDT_PARAMS_DTYPE (weight storage dtype; the orchestrator sets
+bfloat16 for accelerator children — halves HBM, the fix for the
+18.5G/15.75G SDXL OOM — and pins f32 for the golden-comparable tiny
+CPU child).
 Run the staged probe alone with BENCH_MODE=probe (see _probe_child).
 """
 
@@ -665,6 +669,18 @@ def _virtual8_scaling() -> None:
     }))
 
 
+_LAST_CHILD_STDERR = ""
+
+
+def _stderr_mentions_oom() -> bool:
+    """True if the most recent bench child's stderr shows an HBM/RAM
+    exhaustion (XLA surfaces these as RESOURCE_EXHAUSTED / 'Ran out of
+    memory'). Drives the targeted K=1 retry: only a memory crash is
+    worth re-running at smaller tile grouping."""
+    s = _LAST_CHILD_STDERR.lower()
+    return "resource_exhausted" in s or "out of memory" in s
+
+
 def _run_child(
     extra_env: dict, timeout_s: float
 ) -> tuple[dict | None, str]:
@@ -700,6 +716,7 @@ def _run_child(
         if stderr:
             sys.stderr.write(stderr)
             sys.stderr.flush()
+        globals()["_LAST_CHILD_STDERR"] = stderr or ""
         print(
             f"bench child exceeded {timeout_s:.0f}s budget "
             f"(env {extra_env.get('BENCH_MODE', '?')})",
@@ -708,6 +725,7 @@ def _run_child(
         return None, "timeout"
     finally:
         _LIVE_CHILDREN.remove(proc)
+    globals()["_LAST_CHILD_STDERR"] = stderr or ""
     if stderr:
         sys.stderr.write(stderr)
         sys.stderr.flush()
@@ -842,7 +860,10 @@ def _orchestrate() -> None:
     }
     tiny_result, status = _run_child(
         dict(child_common, BENCH_CPU="1", BENCH_TINY="1",
-             BENCH_ATTEMPT="tiny_cpu_first"),
+             BENCH_ATTEMPT="tiny_cpu_first",
+             # pinned f32 even if the operator exported a param dtype:
+             # the tiny datum must stay comparable to the f32 goldens
+             CDT_PARAMS_DTYPE=""),
         min(tiny_budget, max(remaining() - 60, 60)),
     )
     record("tiny_cpu", status)
@@ -868,35 +889,70 @@ def _orchestrate() -> None:
     scaling_reserve = 360 if (os.cpu_count() or 0) >= 8 else 30
     child_statuses: list[str] = []
     if probe_status == "ok":
+        # accelerator children store weights in bf16 (the models
+        # already compute in bf16): SDXL f32 weights alone are ~10.3G
+        # of a 16G chip's HBM — measured OOM at 18.5/15.75G with f32.
+        # The tiny CPU child above keeps f32 (golden-comparable).
+        accel_common = dict(
+            child_common,
+            CDT_PARAMS_DTYPE=os.environ.get("CDT_PARAMS_DTYPE", "bfloat16"),
+        )
         budget = min(
             float(os.environ.get("BENCH_BUDGET_S", 2400)),
             remaining() - scaling_reserve,
         )
+        metric = os.environ.get("BENCH_METRIC", "usdu")
+        full_oom = False
         if budget > 120:
-            best_accel, st = _run_child(dict(child_common), budget)
+            best_accel, st = _run_child(dict(accel_common), budget)
             child_statuses.append(st)
             record("accelerator_full", st)
+            # K=1 only helps a config that CRASHED on memory: a timeout
+            # means the config is too SLOW (K=1 is slower still), and a
+            # non-OOM error fails identically at any K — both should
+            # hand their budget to the reduced rung instead
+            full_oom = st == "error" and _stderr_mentions_oom()
+        if (
+            best_accel is None
+            and full_oom
+            and metric == "usdu"  # only bench_usdu reads BENCH_TILE_BATCH
+            and "BENCH_TILE_BATCH" not in os.environ
+        ):
+            # OOM rung: the same full config at tile grouping 1 —
+            # activation memory scales with K, and a 4x-grouped SDXL
+            # tile program is the likeliest thing to blow HBM
+            budget_k1 = min(
+                float(os.environ.get("BENCH_BUDGET_S", 2400)),
+                remaining() - scaling_reserve,
+            )
+            if budget_k1 > 120:
+                best_accel, st = _run_child(
+                    dict(accel_common, BENCH_TILE_BATCH="1"), budget_k1
+                )
+                child_statuses.append(st)
+                record("accelerator_k1", st)
+                if best_accel is not None:
+                    best_accel["attempt"] = "tile_batch_1"
         if best_accel is None:
             budget2 = min(
                 float(os.environ.get("BENCH_BUDGET2_S", 1200)),
                 remaining() - scaling_reserve,
             )
             if budget2 > 120:
-                metric = os.environ.get("BENCH_METRIC", "usdu")
                 if metric == "usdu":
                     reduced = dict(
-                        child_common,
+                        accel_common,
                         BENCH_MODEL="sd15", BENCH_SRC="512", BENCH_STEPS="8",
                     )
                 elif metric == "video":
                     reduced = dict(
-                        child_common,
+                        accel_common,
                         BENCH_MODEL="wan-1.3b", BENCH_SRC="128",
                         BENCH_FRAMES="9", BENCH_STEPS="4",
                     )
                 else:
                     reduced = dict(
-                        child_common, BENCH_MODEL="sd15", BENCH_SRC="256",
+                        accel_common, BENCH_MODEL="sd15", BENCH_SRC="256",
                         BENCH_STEPS="8",
                     )
                 best_accel, st = _run_child(reduced, budget2)
@@ -972,7 +1028,14 @@ def main() -> None:
     try:
         result = bench(jax, tiny)
     except Exception as exc:
-        if os.environ.get("CDT_FLASH") == "0":
+        oom = "out of memory" in str(exc).lower() or (
+            "resource_exhausted" in str(exc).lower()
+        )
+        if os.environ.get("CDT_FLASH") == "0" or oom:
+            # OOM is not a flash problem: fail fast so the
+            # orchestrator's memory rungs (tile_batch=1, reduced
+            # model) get the remaining budget instead of a doomed
+            # same-shape retry
             raise
         # the Pallas flash path is the newest compile surface; if it
         # fails on this backend, disable it and retry once rather than

@@ -17,6 +17,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .pipeline import maybe_cast_params
+
 
 @dataclasses.dataclass(frozen=True)
 class ControlNetConfig:
@@ -75,4 +77,6 @@ def load_controlnet(
     params = module.init(
         jax.random.key(seed), jnp.zeros((1, downscale * 8, downscale * 8, 3))
     )
-    return ControlNetBundle(name=name, module=module, params=params)
+    return ControlNetBundle(
+        name=name, module=module, params=maybe_cast_params(params)
+    )

@@ -10,6 +10,7 @@ calls these; the distributed layers shard their inputs.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -19,6 +20,43 @@ import jax.numpy as jnp
 from ..ops import samplers as smp
 from .registry import create_model, get_config
 from .text_encoder import Tokenizer
+
+
+def maybe_cast_params(tree):
+    """CDT_PARAMS_DTYPE=bfloat16 stores floating-point weights in bf16
+    (halves HBM — the big lever for real checkpoints on 16G chips; the
+    models already COMPUTE in bf16, so only the storage precision
+    changes). Unset keeps float32: CPU golden numerics are pinned at
+    f32 weights. Applied by every model/VAE/TE/ControlNet/upscaler
+    loader at bundle-build time.
+
+    Takes OWNERSHIP of the tree: each source buffer is freed as soon
+    as its cast completes, so the transient peak stays at the f32
+    footprint instead of f32+bf16 — the difference between fitting
+    and OOMing an SDXL load on a 16G chip. Callers must not reuse the
+    input tree afterwards (every loader discards it immediately)."""
+    want = os.environ.get("CDT_PARAMS_DTYPE", "")
+    if not want:
+        return tree
+    dt = jnp.dtype(want)
+
+    def cast(x):
+        if (
+            hasattr(x, "dtype")
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and x.dtype != dt
+        ):
+            y = x.astype(dt)
+            if isinstance(x, jax.Array):
+                try:
+                    y.block_until_ready()
+                    x.delete()
+                except Exception:
+                    pass
+            return y
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
 
 
 @dataclasses.dataclass
@@ -109,7 +147,7 @@ def load_vae(
         )
     return VAEBundle(
         vae=vae,
-        params={"vae": params},
+        params=maybe_cast_params({"vae": params}),
         latent_channels=cfg.latent_channels,
         latent_scale=cfg.downscale,
     )
@@ -305,6 +343,7 @@ def load_pipeline(
         params["te2"] = te2_params
     if te3_params is not None:
         params["te3"] = te3_params
+    params = maybe_cast_params(params)
     return PipelineBundle(
         model_name=model_name,
         unet=unet,
@@ -431,7 +470,7 @@ def load_unet(
         unet=unet,
         vae=None,
         text_encoder=None,
-        params={"unet": unet_params},
+        params=maybe_cast_params({"unet": unet_params}),
         tokenizer=None,
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
@@ -557,7 +596,7 @@ def load_clip(
         unet=None,
         vae=None,
         text_encoder=encoders[0],
-        params=params,
+        params=maybe_cast_params(params),
         tokenizer=tokenizers[0],
         text_encoder_2=slot(encoders, 1),
         tokenizer_2=slot(tokenizers, 1),

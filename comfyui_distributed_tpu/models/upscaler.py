@@ -16,6 +16,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .pipeline import maybe_cast_params
+
 
 @dataclasses.dataclass(frozen=True)
 class UpscalerConfig:
@@ -91,4 +93,6 @@ def load_upscale_model(name: str = "4x-generic", seed: int = 0) -> UpscaleModelB
     cfg = UpscalerConfig(scale=scale)
     module = SuperResolver(cfg)
     params = module.init(jax.random.key(seed), jnp.zeros((1, 16, 16, 3)))
-    return UpscaleModelBundle(name=name, module=module, params=params, scale=scale)
+    return UpscaleModelBundle(
+        name=name, module=module, params=maybe_cast_params(params), scale=scale
+    )

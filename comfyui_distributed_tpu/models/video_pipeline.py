@@ -26,7 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import samplers as smp
 from ..parallel.mesh import DATA_AXIS, data_axis_size
 from ..parallel.seeds import participant_keys
-from .pipeline import _Static
+from .pipeline import _Static, maybe_cast_params
 from .registry import create_model, get_config, model_family
 from .t5_encoder import T5Tokenizer
 from .text_encoder import Tokenizer
@@ -176,7 +176,7 @@ def load_video_pipeline(
         dit=dit,
         vae=vae,
         text_encoder=te,
-        params=params,
+        params=maybe_cast_params(params),
         tokenizer=tokenizer,
         latent_channels=vae_cfg.latent_channels,
         latent_scale=vae_cfg.downscale,
