@@ -23,3 +23,4 @@ from . import nodes_controlnet  # noqa: F401,E402
 from . import nodes_mask  # noqa: F401,E402
 from . import nodes_custom_sampling  # noqa: F401,E402
 from . import nodes_loaders  # noqa: F401,E402
+from . import nodes_transform  # noqa: F401,E402

@@ -1129,7 +1129,15 @@ class LoadImage:
         path = resolve_input_path(str(image), context)
         arr = img_utils.pil_to_array(__import__("PIL.Image", fromlist=["Image"]).open(path))
         rgb = arr[..., :3]
-        mask = arr[..., 3] if arr.shape[-1] == 4 else np.ones(arr.shape[:2], np.float32)
+        # mask = 1 - alpha (the ComfyUI convention the bundled inpaint
+        # workflow depends on: transparent hole -> 1 -> regenerate,
+        # matching the noise_mask polarity); no alpha -> all zeros
+        # (nothing to regenerate)
+        mask = (
+            1.0 - arr[..., 3]
+            if arr.shape[-1] == 4
+            else np.zeros(arr.shape[:2], np.float32)
+        )
         return (jnp.asarray(rgb)[None], jnp.asarray(mask)[None])
 
 

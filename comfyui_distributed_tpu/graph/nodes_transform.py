@@ -1,0 +1,362 @@
+"""Geometry and blend nodes for images and latents.
+
+The ComfyUI-substrate transform set the reference's workflows assume
+(the reference itself ships no compute nodes — SURVEY §2: it rides on
+ComfyUI's node base). Flips/rotations are pure jnp index permutations
+(XLA lowers them to layout changes, no data movement until fused);
+blends are elementwise and fuse into whatever consumes them.
+
+Conventions shared with nodes_core: IMAGE is [B, H, W, C] float32 in
+[0, 1]; LATENT is {"samples": [B, h, w, C]} with pixel offsets
+converted by the nominal 8x node convention; MASK is [B, H, W].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_node
+
+_FLIP_METHODS = ("x-axis: vertically", "y-axis: horizontally")
+_ROTATIONS = ("none", "90 degrees", "180 degrees", "270 degrees")
+
+
+def _flip(arr, method: str):
+    # vertical flip mirrors rows (H axis); horizontal mirrors columns
+    if str(method).startswith("x"):
+        return arr[:, ::-1, :, ...]
+    if str(method).startswith("y"):
+        return arr[:, :, ::-1, ...]
+    raise ValueError(f"unknown flip_method {method!r}; use {_FLIP_METHODS}")
+
+
+def _rotate(arr, rotation: str):
+    """Clockwise rotation in 90-degree steps (the node convention:
+    '90 degrees' turns the top edge to the right edge). jnp.rot90 is
+    counter-clockwise, so k = -quarters over the (H, W) axes."""
+    rot = str(rotation)
+    if rot not in _ROTATIONS:
+        raise ValueError(f"unknown rotation {rotation!r}; use {_ROTATIONS}")
+    quarters = _ROTATIONS.index(rot)
+    if quarters == 0:
+        return arr
+    return jnp.rot90(arr, k=-quarters, axes=(1, 2))
+
+
+@register_node
+class LatentFlip:
+    """Mirror a latent (ComfyUI LatentFlip parity): 'x-axis:
+    vertically' reverses rows, 'y-axis: horizontally' reverses
+    columns. Works in latent space, so the decoded image mirrors the
+    same way (VAEs here are translation-equivariant convs)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "flip_method": ("STRING", {"default": _FLIP_METHODS[0]}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "flip"
+
+    def flip(self, samples: dict, flip_method=_FLIP_METHODS[0], context=None):
+        out = dict(samples)
+        out["samples"] = _flip(samples["samples"], flip_method)
+        if samples.get("noise_mask") is not None:
+            out["noise_mask"] = _flip(samples["noise_mask"], flip_method)
+        return (out,)
+
+
+@register_node
+class LatentRotate:
+    """Rotate a latent clockwise in quarter turns (ComfyUI
+    LatentRotate parity). Non-square latents swap their spatial
+    extent on 90/270."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "rotation": ("STRING", {"default": "none"}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "rotate"
+
+    def rotate(self, samples: dict, rotation="none", context=None):
+        out = dict(samples)
+        out["samples"] = _rotate(samples["samples"], rotation)
+        if samples.get("noise_mask") is not None:
+            out["noise_mask"] = _rotate(samples["noise_mask"], rotation)
+        return (out,)
+
+
+@register_node
+class LatentCrop:
+    """Crop a latent region addressed in pixels (ComfyUI LatentCrop
+    parity): x/y/width/height are pixel values converted to latent
+    cells by the nominal 8x convention, clamped into the frame the
+    same way ImageCrop clamps."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "width": ("INT", {"default": 512}),
+                "height": ("INT", {"default": 512}),
+                "x": ("INT", {"default": 0}),
+                "y": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "crop"
+
+    def crop(self, samples: dict, width=512, height=512, x=0, y=0,
+             context=None):
+        z = samples["samples"]
+        h, w = z.shape[1], z.shape[2]
+        x0 = min(max(int(x) // 8, 0), w - 1)
+        y0 = min(max(int(y) // 8, 0), h - 1)
+        x1 = min(x0 + max(int(width) // 8, 1), w)
+        y1 = min(y0 + max(int(height) // 8, 1), h)
+        out = dict(samples)
+        out["samples"] = z[:, y0:y1, x0:x1, :]
+        if samples.get("noise_mask") is not None:
+            out["noise_mask"] = samples["noise_mask"][:, y0:y1, x0:x1, :]
+        return (out,)
+
+
+@register_node
+class LatentBlend:
+    """Linear interpolation of two latents (ComfyUI LatentBlend
+    parity): blend_factor weights samples1, (1 - factor) samples2.
+    Shapes must match — latents have no canonical resampling."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples1": ("LATENT",),
+                "samples2": ("LATENT",),
+                "blend_factor": ("FLOAT", {"default": 0.5}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "blend"
+
+    def blend(self, samples1: dict, samples2: dict, blend_factor=0.5,
+              context=None):
+        a, b = samples1["samples"], samples2["samples"]
+        if a.shape != b.shape:
+            raise ValueError(
+                f"LatentBlend needs matching shapes, got {a.shape} vs "
+                f"{b.shape}"
+            )
+        f = float(blend_factor)
+        out = dict(samples1)
+        out["samples"] = a * f + b * (1.0 - f)
+        return (out,)
+
+
+@register_node
+class ImageFlip:
+    """Mirror an image ('x-axis: vertically' | 'y-axis:
+    horizontally')."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "flip_method": ("STRING", {"default": _FLIP_METHODS[0]}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "flip"
+
+    def flip(self, image, flip_method=_FLIP_METHODS[0], context=None):
+        return (_flip(image, flip_method),)
+
+
+@register_node
+class ImageRotate:
+    """Rotate an image clockwise in quarter turns."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "rotation": ("STRING", {"default": "none"}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "rotate"
+
+    def rotate(self, image, rotation="none", context=None):
+        return (_rotate(image, rotation),)
+
+
+_BLEND_MODES = (
+    "normal", "multiply", "screen", "overlay", "soft_light", "difference"
+)
+
+
+@register_node
+class ImageBlend:
+    """Photoshop-style blend of two images (ComfyUI ImageBlend
+    parity): compute the mode's composite of (image1, image2), then
+    lerp image1 toward it by blend_factor. image2 is center-crop +
+    bilinear resized to image1's geometry when shapes differ (the
+    same 'center' upscale convention ImageBatch uses)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image1": ("IMAGE",),
+                "image2": ("IMAGE",),
+                "blend_factor": ("FLOAT", {"default": 0.5}),
+                "blend_mode": ("STRING", {"default": "normal"}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "blend"
+
+    def blend(self, image1, image2, blend_factor=0.5, blend_mode="normal",
+              context=None):
+        mode = str(blend_mode)
+        if mode not in _BLEND_MODES:
+            raise ValueError(
+                f"unknown blend_mode {mode!r}; use {_BLEND_MODES}"
+            )
+        if image1.shape[1:3] != image2.shape[1:3]:
+            from ..ops import upscale as up_ops
+
+            h, w = image1.shape[1], image1.shape[2]
+            (image2,) = up_ops.center_crop_to_aspect([image2], h, w)
+            image2 = up_ops.resize_image(image2, h, w, "bilinear")
+        a, b = image1, image2
+        if mode == "normal":
+            mixed = b
+        elif mode == "multiply":
+            mixed = a * b
+        elif mode == "screen":
+            mixed = 1.0 - (1.0 - a) * (1.0 - b)
+        elif mode == "overlay":
+            mixed = jnp.where(
+                a <= 0.5, 2.0 * a * b, 1.0 - 2.0 * (1.0 - a) * (1.0 - b)
+            )
+        elif mode == "soft_light":
+            # the W3C/Photoshop piecewise form the reference stack uses
+            d = jnp.where(
+                a <= 0.25,
+                ((16.0 * a - 12.0) * a + 4.0) * a,
+                jnp.sqrt(jnp.maximum(a, 0.0)),
+            )
+            mixed = jnp.where(
+                b <= 0.5,
+                a - (1.0 - 2.0 * b) * a * (1.0 - a),
+                a + (2.0 * b - 1.0) * (d - a),
+            )
+        else:  # difference
+            mixed = jnp.abs(a - b)
+        f = float(blend_factor)
+        return (jnp.clip(a * (1.0 - f) + mixed * f, 0.0, 1.0),)
+
+
+@register_node
+class EmptyImage:
+    """Solid-color image batch (ComfyUI EmptyImage parity): color is
+    a packed 0xRRGGBB int, channels scaled to [0, 1]."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "width": ("INT", {"default": 512}),
+                "height": ("INT", {"default": 512}),
+                "batch_size": ("INT", {"default": 1}),
+                "color": ("INT", {"default": 0}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "generate"
+
+    def generate(self, width=512, height=512, batch_size=1, color=0,
+                 context=None):
+        c = int(color)
+        rgb = jnp.asarray(
+            [(c >> 16) & 0xFF, (c >> 8) & 0xFF, c & 0xFF], jnp.float32
+        ) / 255.0
+        return (
+            jnp.broadcast_to(
+                rgb, (int(batch_size), int(height), int(width), 3)
+            ),
+        )
+
+
+@register_node
+class LoadImageMask:
+    """Load one channel of an image file as a MASK (ComfyUI
+    LoadImageMask parity): channel in {alpha, red, green, blue}.
+    Alpha is INVERTED (mask = 1 - alpha: the transparent hole is the
+    region to regenerate, matching LoadImage's mask output and the
+    noise_mask polarity); a file without alpha yields all zeros.
+    Missing color channels raise, like ImageToMask — a grayscale file
+    has no green plane to silently substitute."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("STRING", {"default": ""}),
+                "channel": ("STRING", {"default": "alpha"}),
+            }
+        }
+
+    RETURN_TYPES = ("MASK",)
+    FUNCTION = "load"
+    NEVER_CACHE = True  # backing file can change between runs
+
+    def load(self, image: str, channel="alpha", context=None):
+        from PIL import Image
+
+        from ..utils import image as img_utils
+        from .io_dirs import resolve_input_path
+
+        chans = {"red": 0, "green": 1, "blue": 2, "alpha": 3}
+        ch = str(channel)
+        if ch not in chans:
+            raise ValueError(
+                f"unknown channel {ch!r}; use {tuple(chans)}"
+            )
+        path = resolve_input_path(str(image), context)
+        arr = img_utils.pil_to_array(Image.open(path))
+        idx = chans[ch]
+        if ch == "alpha":
+            mask = (
+                1.0 - arr[..., 3]
+                if arr.shape[-1] == 4
+                else np.zeros(arr.shape[:2], np.float32)
+            )
+        elif idx >= arr.shape[-1]:
+            raise ValueError(
+                f"image has {arr.shape[-1]} channel(s); no {ch!r} plane"
+            )
+        else:
+            mask = arr[..., idx]
+        return (jnp.asarray(mask)[None],)

@@ -1,0 +1,204 @@
+"""Geometry/blend nodes: LatentFlip/Rotate/Crop/Blend, ImageFlip/
+Rotate/Blend, EmptyImage, LoadImageMask."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_transform import (
+    EmptyImage,
+    ImageBlend,
+    ImageFlip,
+    ImageRotate,
+    LatentBlend,
+    LatentCrop,
+    LatentFlip,
+    LatentRotate,
+    LoadImageMask,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def _latent(b=1, h=8, w=6, c=4):
+    z = jnp.arange(b * h * w * c, dtype=jnp.float32).reshape(b, h, w, c)
+    return {"samples": z}
+
+
+def test_latent_flip_vertical_reverses_rows():
+    lat = _latent()
+    (out,) = LatentFlip().flip(lat, "x-axis: vertically")
+    np.testing.assert_array_equal(
+        np.asarray(out["samples"]), np.asarray(lat["samples"])[:, ::-1]
+    )
+
+
+def test_latent_flip_horizontal_reverses_cols_and_mask():
+    lat = _latent()
+    lat["noise_mask"] = jnp.arange(48, dtype=jnp.float32).reshape(1, 8, 6, 1)
+    (out,) = LatentFlip().flip(lat, "y-axis: horizontally")
+    np.testing.assert_array_equal(
+        np.asarray(out["samples"]), np.asarray(lat["samples"])[:, :, ::-1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["noise_mask"]),
+        np.asarray(lat["noise_mask"])[:, :, ::-1],
+    )
+
+
+def test_latent_flip_rejects_unknown_method():
+    with pytest.raises(ValueError):
+        LatentFlip().flip(_latent(), "diagonal")
+
+
+def test_latent_rotate_quarter_turns():
+    lat = _latent()
+    (out90,) = LatentRotate().rotate(lat, "90 degrees")
+    # clockwise: the top row becomes the right column
+    assert out90["samples"].shape == (1, 6, 8, 4)
+    ref = np.rot90(np.asarray(lat["samples"]), k=-1, axes=(1, 2))
+    np.testing.assert_array_equal(np.asarray(out90["samples"]), ref)
+    (out360,) = LatentRotate().rotate(
+        *LatentRotate().rotate(lat, "180 degrees"), "180 degrees"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out360["samples"]), np.asarray(lat["samples"])
+    )
+    (outnone,) = LatentRotate().rotate(lat, "none")
+    assert outnone["samples"] is lat["samples"]
+
+
+def test_latent_crop_pixel_to_cell_conversion():
+    lat = _latent(h=8, w=8)
+    (out,) = LatentCrop().crop(lat, width=32, height=16, x=16, y=8)
+    # 32/16/16/8 px -> 4/2/2/1 cells
+    np.testing.assert_array_equal(
+        np.asarray(out["samples"]),
+        np.asarray(lat["samples"])[:, 1:3, 2:6, :],
+    )
+
+
+def test_latent_blend_lerps_and_validates():
+    a, b = _latent(), _latent()
+    b["samples"] = jnp.ones_like(b["samples"])
+    (out,) = LatentBlend().blend(a, b, blend_factor=0.25)
+    ref = np.asarray(a["samples"]) * 0.25 + np.asarray(b["samples"]) * 0.75
+    np.testing.assert_allclose(np.asarray(out["samples"]), ref, rtol=1e-6)
+    with pytest.raises(ValueError):
+        LatentBlend().blend(a, _latent(h=4))
+
+
+def test_image_flip_rotate():
+    img = jnp.arange(2 * 4 * 6 * 3, dtype=jnp.float32).reshape(2, 4, 6, 3)
+    (v,) = ImageFlip().flip(img, "x-axis: vertically")
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(img)[:, ::-1])
+    (r,) = ImageRotate().rotate(img, "270 degrees")
+    np.testing.assert_array_equal(
+        np.asarray(r), np.rot90(np.asarray(img), k=-3, axes=(1, 2))
+    )
+
+
+@pytest.mark.parametrize(
+    "mode,expect",
+    [
+        ("normal", 0.75),
+        ("multiply", 0.5 * 0.75),
+        ("screen", 1.0 - 0.5 * 0.25),
+        ("overlay", 2.0 * 0.5 * 0.75),  # a == 0.5 takes the low branch
+        ("difference", 0.25),
+    ],
+)
+def test_image_blend_modes_full_factor(mode, expect):
+    a = jnp.full((1, 2, 2, 3), 0.5)
+    b = jnp.full((1, 2, 2, 3), 0.75)
+    (out,) = ImageBlend().blend(a, b, blend_factor=1.0, blend_mode=mode)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_image_blend_soft_light_identity_at_half():
+    # b == 0.5 leaves a unchanged in the W3C piecewise form
+    a = jnp.asarray(np.linspace(0, 1, 12, dtype=np.float32)).reshape(
+        1, 2, 2, 3
+    )
+    b = jnp.full((1, 2, 2, 3), 0.5)
+    (out,) = ImageBlend().blend(a, b, blend_factor=1.0,
+                                blend_mode="soft_light")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a), atol=1e-6)
+
+
+def test_image_blend_factor_zero_keeps_first():
+    a = jnp.full((1, 2, 2, 3), 0.3)
+    b = jnp.full((1, 2, 2, 3), 0.9)
+    (out,) = ImageBlend().blend(a, b, blend_factor=0.0,
+                                blend_mode="difference")
+    np.testing.assert_allclose(np.asarray(out), 0.3, rtol=1e-6)
+
+
+def test_image_blend_rejects_unknown_mode():
+    a = jnp.zeros((1, 2, 2, 3))
+    with pytest.raises(ValueError):
+        ImageBlend().blend(a, a, blend_mode="dissolve")
+
+
+def test_empty_image_color_unpack():
+    (out,) = EmptyImage().generate(width=4, height=3, batch_size=2,
+                                   color=0xFF8000)
+    assert out.shape == (2, 3, 4, 3)
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 0, 0], [1.0, 128 / 255.0, 0.0], rtol=1e-6
+    )
+
+
+def test_load_image_mask_channels(tmp_path):
+    from PIL import Image
+
+    arr = np.zeros((4, 4, 4), np.uint8)
+    arr[..., 0] = 255  # red
+    arr[..., 3] = 128  # alpha
+    p = tmp_path / "m.png"
+    Image.fromarray(arr, "RGBA").save(p)
+    (red,) = LoadImageMask().load(str(p), "red")
+    assert red.shape == (1, 4, 4)
+    np.testing.assert_allclose(np.asarray(red), 1.0, rtol=1e-3)
+    # alpha is inverted: transparent = 1 = regenerate
+    (alpha,) = LoadImageMask().load(str(p), "alpha")
+    np.testing.assert_allclose(
+        np.asarray(alpha), 1.0 - 128 / 255.0, rtol=1e-2
+    )
+    with pytest.raises(ValueError):
+        LoadImageMask().load(str(p), "luma")
+
+
+def test_load_image_mask_no_alpha_and_missing_channel(tmp_path):
+    from PIL import Image
+
+    rgb = np.full((4, 4, 3), 200, np.uint8)
+    p = tmp_path / "rgb.png"
+    Image.fromarray(rgb, "RGB").save(p)
+    (alpha,) = LoadImageMask().load(str(p), "alpha")
+    np.testing.assert_allclose(np.asarray(alpha), 0.0)  # nothing to redo
+    gray = np.full((4, 4), 100, np.uint8)
+    pg = tmp_path / "l.png"
+    Image.fromarray(gray, "L").save(pg)
+    with pytest.raises(ValueError):
+        LoadImageMask().load(str(pg), "green")
+
+
+def test_load_image_alpha_inversion(tmp_path):
+    from PIL import Image
+
+    from comfyui_distributed_tpu.graph.nodes_core import LoadImage
+
+    arr = np.zeros((4, 4, 4), np.uint8)
+    arr[:, :2, 3] = 255  # left half opaque
+    p = tmp_path / "rgba.png"
+    Image.fromarray(arr, "RGBA").save(p)
+    _img, mask = LoadImage().load(str(p))
+    m = np.asarray(mask)[0]
+    np.testing.assert_allclose(m[:, :2], 0.0)  # opaque -> keep
+    np.testing.assert_allclose(m[:, 2:], 1.0)  # transparent -> regenerate
+    # no alpha -> zeros
+    rgb = tmp_path / "rgb2.png"
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8), "RGB").save(rgb)
+    _img2, mask2 = LoadImage().load(str(rgb))
+    np.testing.assert_allclose(np.asarray(mask2), 0.0)
