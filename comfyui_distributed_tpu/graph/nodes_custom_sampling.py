@@ -187,6 +187,74 @@ class ExponentialScheduler:
 
 
 @register_node
+class PolyexponentialScheduler:
+    """Model-free poly-exponential grid (ComfyUI
+    PolyexponentialScheduler parity): a log-space ramp warped by rho
+    (rho=1 is exactly ExponentialScheduler), with the terminal zero
+    appended."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "steps": ("INT", {"default": 20}),
+                "sigma_max": ("FLOAT", {"default": 14.614642}),
+                "sigma_min": ("FLOAT", {"default": 0.0291675}),
+                "rho": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, steps=20, sigma_max=14.614642, sigma_min=0.0291675,
+                   rho=1.0, context=None):
+        return (
+            _terminal_zero(
+                smp.polyexponential_sigmas(
+                    float(sigma_min), float(sigma_max), int(steps),
+                    rho=float(rho),
+                )
+            ),
+        )
+
+
+@register_node
+class BetaSamplingScheduler:
+    """Beta-quantile spacing over the MODEL's sigma table (ComfyUI
+    BetaSamplingScheduler parity): like scheduler='beta' but with
+    alpha/beta exposed (0.6/0.6 is the scheduler default — dense at
+    both schedule ends). Family-aware: flow models space over their
+    shifted flow table, VP models over the training table."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "steps": ("INT", {"default": 20}),
+                "alpha": ("FLOAT", {"default": 0.6}),
+                "beta": ("FLOAT", {"default": 0.6}),
+            }
+        }
+
+    RETURN_TYPES = ("SIGMAS",)
+    FUNCTION = "get_sigmas"
+
+    def get_sigmas(self, model, steps=20, alpha=0.6, beta=0.6, context=None):
+        param, shift = pl.model_schedule_info(model)
+        table = (
+            smp._flow_sigma_table(shift)
+            if param == "flow"
+            else smp._vp_sigmas()
+        )
+        sigmas = smp.beta_spaced_sigmas(
+            np.asarray(table), int(steps), float(alpha), float(beta)
+        )
+        return (_terminal_zero(np.asarray(sigmas, np.float32)),)
+
+
+@register_node
 class SDTurboScheduler:
     """Turbo/LCM-style few-step schedule (ComfyUI SDTurboScheduler
     parity): `steps` sigmas picked from the top of the training table,
@@ -368,6 +436,55 @@ class CFGGuider:
             GuiderSpec(
                 bundle=model, positive=positive, negative=negative,
                 cfg=float(cfg),
+            ),
+        )
+
+
+@register_node
+class DualCFGGuider:
+    """Dual-conditioning CFG (ComfyUI DualCFGGuider role): one
+    3B-batched model eval per step composing cond1/cond2/negative.
+    style='regular' (default) guides cond2 against negative at
+    cfg_cond2_negative and adds cfg_conds * (eps1 - eps2) on top;
+    style='nested' guides cond1 against cond2 first, then the result
+    against negative (exact formulas: smp.dual_cfg_model). The dual
+    composition rides on the bundle like the SLG and RescaleCFG
+    patches, so every sampling path dispatches it."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "cond1": ("CONDITIONING",),
+                "cond2": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "cfg_conds": ("FLOAT", {"default": 8.0}),
+                "cfg_cond2_negative": ("FLOAT", {"default": 8.0}),
+                "style": ("STRING", {"default": "regular"}),
+            }
+        }
+
+    RETURN_TYPES = ("GUIDER",)
+    FUNCTION = "get_guider"
+
+    def get_guider(self, model, cond1, cond2, negative, cfg_conds=8.0,
+                   cfg_cond2_negative=8.0, style="regular", context=None):
+        if str(style) not in ("regular", "nested"):
+            raise ValueError(
+                f"unknown style {style!r}; use 'regular' or 'nested'"
+            )
+        bundle = dataclasses.replace(
+            model,
+            dual_cfg=pl.DualCFGSpec(
+                cfg_cond2_negative=float(cfg_cond2_negative),
+                nested=(str(style) == "nested"),
+            ),
+        )
+        return (
+            GuiderSpec(
+                bundle=bundle, positive=(cond1, cond2), negative=negative,
+                cfg=float(cfg_conds),
             ),
         )
 

@@ -100,6 +100,10 @@ class PipelineBundle:
     # RescaleCFG patch: std-rescale multiplier of the guided x0
     # prediction (None = plain CFG)
     cfg_rescale: float | None = None
+    # DualCFGGuider: when set, sampling positives must be the 2-tuple
+    # (cond1, cond2) and guided_model dispatches smp.dual_cfg_model
+    # (the outer cfg knob is cfg_conds). None = single-cond CFG.
+    dual_cfg: "DualCFGSpec | None" = None
 
 
 @dataclasses.dataclass
@@ -151,6 +155,16 @@ def load_vae(
         latent_channels=cfg.latent_channels,
         latent_scale=cfg.downscale,
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class DualCFGSpec:
+    """DualCFGGuider parameters riding on the bundle (the outer
+    cfg_conds travels as the sampler's cfg knob; see
+    smp.dual_cfg_model for the regular/nested formulas)."""
+
+    cfg_cond2_negative: float
+    nested: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -995,9 +1009,20 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     multi-entry conditioning composition), plus skip-layer guidance
     when the bundle carries an SLGSpec (set by the
     SkipLayerGuidanceSD3 node)."""
+    slg = getattr(bundle, "slg", None)
+    dual = getattr(bundle, "dual_cfg", None)
+    if dual is not None and (slg is not None or bundle.cfg_rescale is not None):
+        raise ValueError(
+            "DualCFGGuider cannot combine with skip-layer guidance "
+            "or RescaleCFG on the same model"
+        )
     base_fn = _make_model_fn(bundle, params)
     p2s = percent_converter(bundle)
-    slg = getattr(bundle, "slg", None)
+    if dual is not None:
+        return smp.dual_cfg_model(
+            base_fn, cfg_scale, float(dual.cfg_cond2_negative),
+            p2s=p2s, nested=bool(dual.nested),
+        )
     if bundle.cfg_rescale is not None and not slg:
         return smp.rescale_cfg_model(
             base_fn, cfg_scale, float(bundle.cfg_rescale), p2s=p2s

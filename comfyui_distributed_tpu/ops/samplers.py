@@ -85,6 +85,20 @@ def exponential_sigmas(sigma_min: float, sigma_max: float, steps: int):
     return np.exp(np.linspace(np.log(sigma_max), np.log(sigma_min), steps))
 
 
+def polyexponential_sigmas(
+    sigma_min: float, sigma_max: float, steps: int, rho: float = 1.0
+):
+    """Descending poly-exponential grid (the PolyexponentialScheduler
+    node): a log-space ramp warped by rho. rho=1 reduces exactly to
+    exponential_sigmas; rho>1 spends more steps near sigma_min."""
+    import numpy as np
+
+    ramp = np.linspace(1.0, 0.0, steps) ** rho
+    return np.exp(
+        ramp * (np.log(sigma_max) - np.log(sigma_min)) + np.log(sigma_min)
+    )
+
+
 def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
     """Descending [total_steps] sigma spacing over an ascending sigma
     table — the scheduler dispatch shared by the VP and flow families
@@ -118,26 +132,7 @@ def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
         )
         sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
     elif scheduler == "beta":
-        # timesteps at Beta(0.6, 0.6) quantiles: dense at both schedule
-        # ends, sparse in the middle
-        n = len(all_sigmas)
-        ts = 1.0 - np.linspace(0.0, 1.0, total_steps, endpoint=False)
-        idx = np.rint(_beta_ppf(ts, 0.6, 0.6) * (n - 1)).astype(np.int64)
-        # strictly decreasing indices: quantile rounding can collide
-        # (the reference dedupes; the fixed steps+1 scan length here
-        # needs distinct sigmas instead — equal neighbors would break
-        # multistep solvers). Downward nudges can cascade below 0 when
-        # many low quantiles round to 0, so a bottom-up pass bumps
-        # those back, preserving strictness whenever total_steps <= n.
-        for i in range(1, len(idx)):
-            if idx[i] >= idx[i - 1]:
-                idx[i] = idx[i - 1] - 1
-        floor = 0
-        for i in range(len(idx) - 1, -1, -1):
-            if idx[i] < floor:
-                idx[i] = floor
-            floor = idx[i] + 1
-        sigmas = all_sigmas[np.clip(idx, 0, n - 1)]
+        sigmas = beta_spaced_sigmas(all_sigmas, total_steps)
     elif scheduler == "kl_optimal":
         # arctan-interpolated sigma spacing ("Align Your Steps"
         # KL-optimal closed form)
@@ -149,6 +144,37 @@ def _spaced_from_table(all_sigmas, scheduler: str, total_steps: int):
         raise ValueError(f"unknown scheduler {scheduler!r}; use {SCHEDULER_NAMES}")
 
     return sigmas
+
+
+def beta_spaced_sigmas(
+    all_sigmas, total_steps: int, alpha: float = 0.6, beta: float = 0.6
+):
+    """Timesteps at Beta(alpha, beta) quantiles over an ascending
+    sigma table — dense at both schedule ends, sparse in the middle
+    at the 0.6/0.6 default. Shared by the 'beta' scheduler branch and
+    the BetaSamplingScheduler node (which exposes alpha/beta)."""
+    import numpy as np
+
+    n = len(all_sigmas)
+    ts = 1.0 - np.linspace(0.0, 1.0, total_steps, endpoint=False)
+    idx = np.rint(
+        _beta_ppf(ts, float(alpha), float(beta)) * (n - 1)
+    ).astype(np.int64)
+    # strictly decreasing indices: quantile rounding can collide
+    # (the reference dedupes; the fixed steps+1 scan length here
+    # needs distinct sigmas instead — equal neighbors would break
+    # multistep solvers). Downward nudges can cascade below 0 when
+    # many low quantiles round to 0, so a bottom-up pass bumps
+    # those back, preserving strictness whenever total_steps <= n.
+    for i in range(1, len(idx)):
+        if idx[i] >= idx[i - 1]:
+            idx[i] = idx[i - 1] - 1
+    floor = 0
+    for i in range(len(idx) - 1, -1, -1):
+        if idx[i] < floor:
+            idx[i] = floor
+        floor = idx[i] + 1
+    return all_sigmas[np.clip(idx, 0, n - 1)]
 
 
 def _beta_ppf(q, a: float, b: float, iters: int = 60):
@@ -467,6 +493,70 @@ def cfg_model(model_fn: ModelFn, cfg_scale: float,
     def guided(x, sigma, cond):
         _eps_pos, out = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
         return out
+
+    return guided
+
+
+def dual_cfg_model(
+    model_fn: ModelFn,
+    cfg_conds: float,
+    cfg_cond2_negative: float,
+    p2s=_default_p2s,
+    nested: bool = False,
+) -> ModelFn:
+    """Dual-conditioning CFG (the DualCFGGuider node): cond is
+    ((cond1, cond2), negative). Formulas spelled out because no
+    reference source is vendored here to diff against:
+
+    regular (default):
+        mid = neg + cfg_cond2_negative * (eps2 - neg)
+        out = mid + cfg_conds * (eps1 - eps2)
+    nested:
+        inner = eps2 + cfg_conds * (eps1 - eps2)
+        out   = neg + cfg_cond2_negative * (inner - neg)
+
+    Useful invariants (pinned by tests): regular with cond2 == negative
+    reduces to plain CFG over (cond1, negative) at cfg_conds; nested
+    with cfg_conds == 1 reduces to plain CFG over (cond1, negative) at
+    cfg_cond2_negative (and short-circuits to that 2B program).
+    Otherwise the three conds run as ONE 3B-batched model call when
+    structurally compatible — one big MXU matmul beats three small
+    ones (same rationale as _cfg_eval's 2B batch)."""
+
+    def guided(x, sigma, cond):
+        (pos1, pos2), neg = cond
+        if nested and cfg_conds == 1.0:
+            # inner == eps1: plain CFG, skip the cond2 eval entirely
+            _e, out = _cfg_eval(
+                model_fn, cfg_cond2_negative, x, sigma, (pos1, neg), p2s
+            )
+            return out
+        comp = any(_needs_composite(c) for c in (pos1, pos2, neg))
+        if (
+            not comp
+            and _conds_batchable(pos1, pos2)
+            and _conds_batchable(pos2, neg)
+            and _conds_batchable(pos1, neg)
+        ):
+            x3 = jnp.concatenate([x, x, x], axis=0)
+            s3 = jnp.concatenate([sigma, sigma, sigma], axis=0)
+            c3 = jax.tree_util.tree_map(
+                lambda a, b, c: jnp.concatenate([a, b, c], axis=0),
+                pos1, pos2, neg,
+            )
+            e1, e2, en = jnp.split(model_fn(x3, s3, c3), 3, axis=0)
+        else:
+            def _eps(c):
+                if _needs_composite(c):
+                    return composite_eps(model_fn, x, sigma, c, p2s)
+                return model_fn(x, sigma, c)
+
+            e1, e2, en = _eps(pos1), _eps(pos2), _eps(neg)
+        if nested:
+            inner = e2 + cfg_conds * (e1 - e2)
+            return en + cfg_cond2_negative * (inner - en)
+        mid = en + cfg_cond2_negative * (e2 - en)
+        return mid + cfg_conds * (e1 - e2)
 
     return guided
 
