@@ -402,3 +402,112 @@ class ModelSamplingFlux:
         return (
             dataclasses.replace(model, flow_shift_override=math.exp(mu)),
         )
+
+
+@register_node
+class CLIPVisionLoader:
+    """Load a standalone CLIP-vision tower (ComfyUI CLIPVisionLoader
+    parity): a registry name (clip-vision-h, tiny-clip-vision) whose
+    real weights resolve through CDT_CHECKPOINT_DIR, exactly like the
+    WAN i2v bundled path (models/clip_vision.load_clip_vision)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_name": ("STRING", {"default": "clip-vision-h"}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP_VISION",)
+    FUNCTION = "load_clip"
+
+    def load_clip(self, clip_name: str, context=None):
+        from ..models.clip_vision import load_clip_vision
+
+        name = _stem(clip_name)
+        cache_key = f"clip_vision:{name}"
+        cache = getattr(context, "pipelines", {}) if context is not None else {}
+        if cache_key not in cache:
+            cache[cache_key] = load_clip_vision(name)
+        return (cache[cache_key],)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionOutput:
+    """A CLIP_VISION_OUTPUT value: hidden-state tokens [B, T, width],
+    class token first. Deliberately NO `pooled`/`image_embeds`
+    accessor: the default towers run penultimate_hidden=True (no
+    final block, post-LN, or projection — clip_vision.py), so a raw
+    class token would be a plausible-but-wrong stand-in for the CLIP
+    pooled embedding. Add the projected path before exposing one."""
+
+    tokens: object
+
+
+@register_node
+class CLIPVisionEncode:
+    """Encode an image batch through a CLIP-vision tower (ComfyUI
+    CLIPVisionEncode parity). The tower preprocesses internally
+    (short-side scale + center crop + CLIP normalization — see
+    ClipVisionEncoder.__call__), which matches the 'center' crop
+    convention; crop='none' is rejected rather than silently behaving
+    like center."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip_vision": ("CLIP_VISION",),
+                "image": ("IMAGE",),
+                "crop": ("STRING", {"default": "center"}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP_VISION_OUTPUT",)
+    FUNCTION = "encode"
+
+    def encode(self, clip_vision, image, crop="center", context=None):
+        if str(crop) != "center":
+            raise ValueError(
+                "only crop='center' is implemented (the tower's "
+                "preprocessing is short-side scale + center crop)"
+            )
+        return (ClipVisionOutput(tokens=clip_vision.encode(image)),)
+
+
+@register_node(name="unCLIPConditioning")
+class UnCLIPConditioning:
+    """Attach CLIP-vision image embeds to conditioning (ComfyUI
+    unCLIPConditioning shape). NOTE: no registered backbone has an
+    unCLIP adm head yet, so sampling with this conditioning raises at
+    trace time (ops/samplers._reject_unsupported_cond) instead of
+    silently dropping the image condition — the node exists so
+    unCLIP workflows load and fail with a clear message, and so the
+    conditioning plumbing is ready when an unCLIP backbone lands."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "conditioning": ("CONDITIONING",),
+                "clip_vision_output": ("CLIP_VISION_OUTPUT",),
+                "strength": ("FLOAT", {"default": 1.0}),
+                "noise_augmentation": ("FLOAT", {"default": 0.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "apply_adm"
+
+    def apply_adm(self, conditioning, clip_vision_output, strength=1.0,
+                  noise_augmentation=0.0, context=None):
+        from ..ops.conditioning import map_conditioning
+
+        def patch(cond):
+            cond.unclip_embeds = clip_vision_output.tokens
+            cond.unclip_strength = float(strength)
+            cond.unclip_noise_aug = float(noise_augmentation)
+            return cond
+
+        return (map_conditioning(conditioning, patch),)

@@ -154,3 +154,54 @@ class ClipVisionEncoder(nn.Module):
         return nn.LayerNorm(dtype=jnp.float32, name="post_ln")(
             tokens.astype(jnp.float32)
         ).astype(dt)
+
+
+@dataclasses.dataclass
+class ClipVisionBundle:
+    """A standalone CLIP-vision tower (the CLIPVisionLoader node's
+    output): `.encode(images)` returns the hidden-state tokens
+    [B, T, width] (class token first; penultimate layer for the
+    WAN-style configs)."""
+
+    name: str
+    module: ClipVisionEncoder
+    params: object
+
+    def encode(self, images: jax.Array) -> jax.Array:
+        return self.module.apply(self.params, images)
+
+
+def build_clip_vision(name: str, key):
+    """create + init + real-weight merge for a registry CLIP-vision
+    tower (weights through CDT_CHECKPOINT_DIR/<name>.{safetensors,
+    ckpt}). The ONE shared build path: the standalone CLIPVisionLoader
+    and the bundled i2v path (video_pipeline.load_video_pipeline) both
+    call this, so loading fixes land in both. Returns
+    (module, cfg, params)."""
+    from . import sd_checkpoint as sdc
+    from .registry import create_model, get_config
+
+    module = create_model(name)
+    cfg = get_config(name)
+    params = module.init(
+        key, jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    )
+    ckpt = sdc.find_checkpoint(name)
+    if ckpt:
+        from ..utils.logging import log
+
+        log(f"loading CLIP-vision checkpoint {ckpt} for {name}")
+        params, _ = sdc.load_clip_vision_weights(
+            sdc.read_checkpoint(ckpt), cfg, params
+        )
+    return module, cfg, params
+
+
+def load_clip_vision(name: str = "clip-vision-h", seed: int = 0) -> ClipVisionBundle:
+    """Standalone tower for the CLIPVisionLoader node."""
+    from .pipeline import maybe_cast_params
+
+    module, _cfg, params = build_clip_vision(name, jax.random.key(seed))
+    return ClipVisionBundle(
+        name=name, module=module, params=maybe_cast_params(params)
+    )

@@ -103,21 +103,12 @@ def load_video_pipeline(
     clip_vision = None
     cv_params = None
     if i2v:
-        cv_name = "tiny-clip-vision" if tiny else "clip-vision-h"
-        clip_vision = create_model(cv_name)
-        cv_cfg = get_config(cv_name)
-        cv_params = clip_vision.init(
-            jax.random.fold_in(k_te, 7),
-            jnp.zeros((1, cv_cfg.image_size, cv_cfg.image_size, 3)),
-        )
-        cv_ckpt = sdc.find_checkpoint(cv_name)
-        if cv_ckpt:
-            from ..utils.logging import log
+        from .clip_vision import build_clip_vision
 
-            log(f"loading CLIP-vision checkpoint {cv_ckpt} for {cv_name}")
-            cv_params, _ = sdc.load_clip_vision_weights(
-                sdc.read_checkpoint(cv_ckpt), cv_cfg, cv_params
-            )
+        cv_name = "tiny-clip-vision" if tiny else "clip-vision-h"
+        clip_vision, cv_cfg, cv_params = build_clip_vision(
+            cv_name, jax.random.fold_in(k_te, 7)
+        )
         embeds = jnp.zeros((1, cv_cfg.tokens, dit_cfg.img_dim))
         dit_params = dit.init(k_dit, lat, jnp.zeros((1,)), ctx, embeds)
     else:

@@ -84,6 +84,14 @@ class Conditioning:
     # cropped to each tile exactly like ControlNet hints, consumed by
     # whichever backbone module registered them.
     model_patches: Optional[dict] = None
+    # unCLIP image conditioning (the unCLIPConditioning node): CLIP
+    # vision tokens [B, T, W] + strength + noise augmentation level.
+    # No registered backbone has an unCLIP adm head yet, so sampling
+    # REJECTS entries carrying this (loud-failure policy: a silently
+    # dropped image condition would render the wrong picture).
+    unclip_embeds: Optional[jax.Array] = None
+    unclip_strength: float = 1.0
+    unclip_noise_aug: float = 0.0
 
     def clone(self) -> "Conditioning":
         # arrays are immutable in JAX; a shallow copy is a deep clone
@@ -307,23 +315,24 @@ def _cond_flatten(cond: Conditioning):
     children = (
         cond.context, cond.control_hint, cond.mask, cond.control_params,
         cond.pooled, cond.gligen_embs, cond.reference_latents,
-        cond.model_patches, cond.concat_latent,
+        cond.model_patches, cond.concat_latent, cond.unclip_embeds,
     )
     aux = (
         cond.control_strength, cond.area, cond.control_module,
         cond.gligen_boxes, cond.gligen_active, cond.guidance,
         cond.size_cond, cond.strength, cond.timestep_range,
-        cond.control_range,
+        cond.control_range, cond.unclip_strength, cond.unclip_noise_aug,
     )
     return children, aux
 
 
 def _cond_unflatten(aux, children):
     (context, control_hint, mask, control_params, pooled, gligen_embs,
-     reference_latents, model_patches, concat_latent) = children
+     reference_latents, model_patches, concat_latent,
+     unclip_embeds) = children
     (control_strength, area, control_module, gligen_boxes,
      gligen_active, guidance, size_cond, strength, timestep_range,
-     control_range) = aux
+     control_range, unclip_strength, unclip_noise_aug) = aux
     return Conditioning(
         context=context,
         control_hint=control_hint,
@@ -344,6 +353,9 @@ def _cond_unflatten(aux, children):
         concat_latent=concat_latent,
         reference_latents=reference_latents,
         model_patches=model_patches,
+        unclip_embeds=unclip_embeds,
+        unclip_strength=unclip_strength,
+        unclip_noise_aug=unclip_noise_aug,
     )
 
 

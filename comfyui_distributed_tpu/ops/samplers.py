@@ -449,6 +449,22 @@ def composite_eps(model_fn: ModelFn, x, sigma, cond, p2s=_default_p2s):
 
 # --- CFG wrapper ---------------------------------------------------------
 
+def _reject_unsupported_cond(*conds) -> None:
+    """Trace-time guard: conditioning features no registered backbone
+    consumes must fail loudly, not drop silently (a rendered image
+    missing its image-condition looks 'plausible but wrong')."""
+    for cond in conds:
+        entries = cond if isinstance(cond, (list, tuple)) else [cond]
+        for e in entries:
+            if getattr(e, "unclip_embeds", None) is not None:
+                raise ValueError(
+                    "unCLIP image conditioning (unCLIPConditioning node) "
+                    "reached a model without an unCLIP adm head — no "
+                    "registered backbone consumes it yet; remove the "
+                    "node or use an i2v-native path (WAN i2v)"
+                )
+
+
 def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond,
               p2s=_default_p2s):
     """One CFG evaluation: returns (eps_pos, guided_eps). Batches the
@@ -458,6 +474,7 @@ def _cfg_eval(model_fn: ModelFn, cfg_scale: float, x, sigma, cond,
     area/mask/timestep-restricted conditioning takes the per-entry
     composition path instead of the 2B batch."""
     pos, neg = cond
+    _reject_unsupported_cond(pos, neg)
     if _needs_composite(pos) or _needs_composite(neg):
         eps_pos = composite_eps(model_fn, x, sigma, pos, p2s)
         if cfg_scale == 1.0:
@@ -525,6 +542,7 @@ def dual_cfg_model(
 
     def guided(x, sigma, cond):
         (pos1, pos2), neg = cond
+        _reject_unsupported_cond(pos1, pos2, neg)
         if nested and cfg_conds == 1.0:
             # inner == eps1: plain CFG, skip the cond2 eval entirely
             _e, out = _cfg_eval(

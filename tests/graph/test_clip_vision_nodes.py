@@ -1,0 +1,95 @@
+"""CLIPVisionLoader / CLIPVisionEncode / unCLIPConditioning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_loaders import (
+    CLIPVisionEncode,
+    CLIPVisionLoader,
+    ClipVisionOutput,
+    UnCLIPConditioning,
+)
+from comfyui_distributed_tpu.ops.conditioning import Conditioning
+
+
+@pytest.mark.fast
+def test_unclip_conditioning_attaches_fields():
+    cond = Conditioning(context=jnp.zeros((1, 4, 8)))
+    out_tokens = jnp.ones((1, 17, 48))
+    (patched,) = UnCLIPConditioning().apply_adm(
+        cond, ClipVisionOutput(tokens=out_tokens), strength=0.5,
+        noise_augmentation=0.1,
+    )
+    assert patched.unclip_strength == 0.5
+    assert patched.unclip_noise_aug == 0.1
+    np.testing.assert_array_equal(
+        np.asarray(patched.unclip_embeds), np.asarray(out_tokens)
+    )
+    # the original is untouched (map_conditioning clones)
+    assert cond.unclip_embeds is None
+
+
+@pytest.mark.fast
+def test_unclip_fields_survive_pytree_roundtrip():
+    c = Conditioning(
+        context=jnp.zeros((1, 4, 8)),
+        unclip_embeds=jnp.ones((1, 17, 48)),
+        unclip_strength=0.25,
+        unclip_noise_aug=0.5,
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(c)
+    c2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert c2.unclip_strength == 0.25
+    assert c2.unclip_noise_aug == 0.5
+    np.testing.assert_array_equal(
+        np.asarray(c2.unclip_embeds), np.asarray(c.unclip_embeds)
+    )
+
+
+@pytest.mark.fast
+def test_unclip_conditioning_rejected_at_sampling():
+    from comfyui_distributed_tpu.ops import samplers as smp
+
+    model_fn = lambda x, sigma, cond: x  # noqa: E731
+    guided = smp.cfg_model(model_fn, 2.0)
+    x = jnp.zeros((1, 2, 2, 1))
+    sig = jnp.ones((1,))
+    pos = Conditioning(
+        context=jnp.zeros((1, 4, 8)), unclip_embeds=jnp.ones((1, 3, 8))
+    )
+    neg = Conditioning(context=jnp.zeros((1, 4, 8)))
+    with pytest.raises(ValueError, match="unCLIP"):
+        guided(x, sig, (pos, neg))
+
+
+@pytest.mark.fast
+def test_clip_vision_encode_rejects_non_center_crop():
+    class _Stub:
+        def encode(self, img):  # pragma: no cover - never reached
+            return img
+
+    with pytest.raises(ValueError):
+        CLIPVisionEncode().encode(_Stub(), jnp.zeros((1, 8, 8, 3)),
+                                  crop="none")
+
+
+@pytest.mark.slow
+def test_clip_vision_loader_encode_end_to_end():
+    (bundle,) = CLIPVisionLoader().load_clip("tiny-clip-vision")
+    img = jnp.linspace(0, 1, 2 * 40 * 24 * 3, dtype=jnp.float32).reshape(
+        2, 40, 24, 3
+    )
+    (out,) = CLIPVisionEncode().encode(bundle, img)
+    toks = np.asarray(out.tokens)
+    assert toks.shape[0] == 2 and toks.ndim == 3
+    assert np.isfinite(toks).all()
+    # caching: same context dict returns the same bundle object
+    class _Ctx:
+        pipelines = {}
+
+    ctx = _Ctx()
+    (b1,) = CLIPVisionLoader().load_clip("tiny-clip-vision", context=ctx)
+    (b2,) = CLIPVisionLoader().load_clip("tiny-clip-vision", context=ctx)
+    assert b1 is b2
