@@ -390,11 +390,7 @@ class SkipLayerGuidanceSD3:
                 "SkipLayerGuidanceSD3 applies to SD3-class MMDiT models; "
                 f"{model.model_name!r} is not one"
             )
-        if getattr(model, "cfg_rescale", None) is not None:
-            raise ValueError(
-                "SkipLayerGuidanceSD3 cannot combine with RescaleCFG on "
-                "the same model"
-            )
+        pl.reject_existing_guidance_patches(model, "SkipLayerGuidanceSD3")
         depth = get_config(model.model_name).depth
         layer_tuple = tuple(sorted({
             int(part) for part in str(layers).split(",") if part.strip()

@@ -474,6 +474,7 @@ class DualCFGGuider:
             raise ValueError(
                 f"unknown style {style!r}; use 'regular' or 'nested'"
             )
+        pl.reject_existing_guidance_patches(model, "DualCFGGuider")
         bundle = dataclasses.replace(
             model,
             dual_cfg=pl.DualCFGSpec(

@@ -268,6 +268,43 @@ class FreeU_V2:
 
 
 @register_node
+class PerturbedAttentionGuidance:
+    """PAG model patch (ComfyUI PerturbedAttentionGuidance parity,
+    Ahn et al. 2024): each step gains scale * (cond - cond with the
+    middle-block self-attention replaced by identity). UNet family
+    only — DiT-class models use SkipLayerGuidance instead."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "scale": ("FLOAT", {"default": 3.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, scale=3.0, context=None):
+        from ..models.registry import model_family
+
+        family = model_family(model.model_name)
+        if family != "unet":
+            raise ValueError(
+                f"PerturbedAttentionGuidance patches UNet self-attention; "
+                f"{model.model_name!r} is {family}-family (use "
+                "SkipLayerGuidanceSD3 for DiT-class models)"
+            )
+        pl.reject_existing_guidance_patches(
+            model, "PerturbedAttentionGuidance"
+        )
+        return (
+            dataclasses.replace(model, pag=pl.PAGSpec(scale=float(scale))),
+        )
+
+
+@register_node
 class RescaleCFG:
     """Std-rescaled guidance (ComfyUI RescaleCFG parity): the guided
     x0 prediction rescales to the cond-only prediction's per-sample
@@ -290,11 +327,7 @@ class RescaleCFG:
     FUNCTION = "patch"
 
     def patch(self, model, multiplier=0.7, context=None):
-        if getattr(model, "slg", None) is not None:
-            raise ValueError(
-                "RescaleCFG cannot combine with SkipLayerGuidanceSD3 on "
-                "the same model"
-            )
+        pl.reject_existing_guidance_patches(model, "RescaleCFG")
         return (
             dataclasses.replace(model, cfg_rescale=float(multiplier)),
         )

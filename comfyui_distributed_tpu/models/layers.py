@@ -54,11 +54,15 @@ class AttentionBlock(nn.Module):
     """Multi-head attention over flattened tokens.
 
     Self-attention when `context` is None, cross-attention otherwise.
+    identity_self=True replaces the self-attention matrix with
+    identity (out_i = v_i — the PAG perturbation, Ahn et al. 2024);
+    the q/k projections become dead code XLA eliminates.
     """
 
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    identity_self: bool = False
 
     @nn.compact
     def __call__(
@@ -75,7 +79,10 @@ class AttentionBlock(nn.Module):
         q = q.reshape(b, n, self.num_heads, self.head_dim)
         k = k.reshape(b, m, self.num_heads, self.head_dim)
         v = v.reshape(b, m, self.num_heads, self.head_dim)
-        out = dot_product_attention(q, k, v)
+        if self.identity_self and context is None:
+            out = v
+        else:
+            out = dot_product_attention(q, k, v)
         out = out.reshape(b, n, inner)
         return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
 
@@ -106,17 +113,23 @@ class FeedForward(nn.Module):
 
 
 class TransformerBlock(nn.Module):
-    """Self-attn → cross-attn → FF with pre-LayerNorm (SD-style)."""
+    """Self-attn → cross-attn → FF with pre-LayerNorm (SD-style).
+    pag=True runs attn1 as identity attention (the PAG perturbed
+    pass) — parameters are shared with the normal pass."""
 
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    pag: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
         # eps=1e-5 matches torch LayerNorm (flax default is 1e-6) so
         # real SD weights reproduce reference activations
-        x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn1")(
+        x = x + AttentionBlock(
+            self.num_heads, self.head_dim, self.dtype,
+            identity_self=self.pag, name="attn1",
+        )(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         )
         x = x + AttentionBlock(self.num_heads, self.head_dim, self.dtype, name="attn2")(
@@ -135,6 +148,7 @@ class SpatialTransformer(nn.Module):
     head_dim: int
     depth: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    pag: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
@@ -145,7 +159,8 @@ class SpatialTransformer(nn.Module):
         x = x.reshape(b, h * w, c)
         for i in range(self.depth):
             x = TransformerBlock(
-                self.num_heads, self.head_dim, self.dtype, name=f"block_{i}"
+                self.num_heads, self.head_dim, self.dtype,
+                pag=self.pag, name=f"block_{i}",
             )(x, context)
         x = x.reshape(b, h, w, c)
         x = nn.Dense(c, dtype=self.dtype, name="proj_out")(x)

@@ -104,6 +104,9 @@ class PipelineBundle:
     # (cond1, cond2) and guided_model dispatches smp.dual_cfg_model
     # (the outer cfg knob is cfg_conds). None = single-cond CFG.
     dual_cfg: "DualCFGSpec | None" = None
+    # PerturbedAttentionGuidance patch (UNet family only; the node
+    # guards the family). None = no PAG pass.
+    pag: "PAGSpec | None" = None
 
 
 @dataclasses.dataclass
@@ -155,6 +158,16 @@ def load_vae(
         latent_channels=cfg.latent_channels,
         latent_scale=cfg.downscale,
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class PAGSpec:
+    """Perturbed-attention guidance (PerturbedAttentionGuidance node):
+    the guided result gains scale * (cond - cond_with_identity_attn),
+    where the perturbed pass runs the middle-block self-attention as
+    identity (models/unet.py pag flag)."""
+
+    scale: float = 3.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -809,7 +822,10 @@ def model_schedule_info(bundle: PipelineBundle) -> tuple[str, float]:
     return (param, float(shift))
 
 
-def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
+def _make_model_fn(
+    bundle: PipelineBundle, params, skip_layers: tuple = (),
+    pag: bool = False,
+):
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
@@ -975,8 +991,10 @@ def _make_model_fn(bundle: PipelineBundle, params, skip_layers: tuple = ()):
                     method="linear",
                 )
             x_in = jnp.concatenate([x_in, extra], axis=-1)
+        unet_kwargs = {"pag": True} if pag else {}
         out = bundle.unet.apply(
-            params["unet"], x_in, t, context, y=y, control=control
+            params["unet"], x_in, t, context, y=y, control=control,
+            **unet_kwargs,
         )
         if model_schedule_info(bundle)[0] == "v":
             # SD2.x-768-class velocity prediction. With the VP scalings
@@ -1004,6 +1022,38 @@ def percent_converter(bundle: PipelineBundle):
     return p2s
 
 
+def reject_existing_guidance_patches(bundle, node_name: str) -> None:
+    """Patch-time exclusivity shared by the guidance patch nodes (SLG,
+    RescaleCFG, DualCFGGuider, PAG): their compositions are mutually
+    ambiguous, so the SECOND patch node fails at graph-build time
+    naming both nodes (guided_model re-checks at sample time as the
+    backstop for hand-built bundles)."""
+    existing = [
+        name
+        for name, active in (
+            ("SkipLayerGuidanceSD3", getattr(bundle, "slg", None) is not None),
+            (
+                "RescaleCFG",
+                getattr(bundle, "cfg_rescale", None) is not None,
+            ),
+            (
+                "DualCFGGuider",
+                getattr(bundle, "dual_cfg", None) is not None,
+            ),
+            (
+                "PerturbedAttentionGuidance",
+                getattr(bundle, "pag", None) is not None,
+            ),
+        )
+        if active
+    ]
+    if existing:
+        raise ValueError(
+            f"{node_name} cannot combine with {existing[0]} on the "
+            "same model"
+        )
+
+
 def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     """The guidance composition every sampling path shares: CFG (with
     multi-entry conditioning composition), plus skip-layer guidance
@@ -1011,10 +1061,20 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     SkipLayerGuidanceSD3 node)."""
     slg = getattr(bundle, "slg", None)
     dual = getattr(bundle, "dual_cfg", None)
-    if dual is not None and (slg is not None or bundle.cfg_rescale is not None):
+    pag = getattr(bundle, "pag", None)
+    patches = [
+        name
+        for name, active in (
+            ("DualCFGGuider", dual is not None),
+            ("SkipLayerGuidance", slg is not None),
+            ("RescaleCFG", bundle.cfg_rescale is not None),
+            ("PerturbedAttentionGuidance", pag is not None),
+        )
+        if active
+    ]
+    if len(patches) > 1:
         raise ValueError(
-            "DualCFGGuider cannot combine with skip-layer guidance "
-            "or RescaleCFG on the same model"
+            f"guidance patches cannot combine on one model: {patches}"
         )
     base_fn = _make_model_fn(bundle, params)
     p2s = percent_converter(bundle)
@@ -1023,7 +1083,15 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
             base_fn, cfg_scale, float(dual.cfg_cond2_negative),
             p2s=p2s, nested=bool(dual.nested),
         )
-    if bundle.cfg_rescale is not None and not slg:
+    if pag is not None:
+        return smp.pag_cfg_model(
+            base_fn,
+            _make_model_fn(bundle, params, pag=True),
+            cfg_scale,
+            float(pag.scale),
+            p2s=p2s,
+        )
+    if bundle.cfg_rescale is not None:
         return smp.rescale_cfg_model(
             base_fn, cfg_scale, float(bundle.cfg_rescale), p2s=p2s
         )

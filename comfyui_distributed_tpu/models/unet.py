@@ -120,6 +120,9 @@ class UNet(nn.Module):
         context: jax.Array,      # [B, T, context_dim] text tokens
         y: Optional[jax.Array] = None,  # [B, adm_in_channels] pooled cond
         control: Optional[jax.Array] = None,  # [B, H, W, model_channels]
+        pag: bool = False,  # identity self-attention in the middle
+        # block (the PAG perturbed pass; ComfyUI's simple-PAG patches
+        # exactly the middle-block attn1)
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -181,7 +184,7 @@ class UNet(nn.Module):
         h = ResBlock(mid_ch, dt, name="mid_res_0")(h, emb)
         mid_heads, mid_hdim = head_split(mid_ch)
         h = SpatialT(
-            mid_heads, mid_hdim, mid_depth, dt, name="mid_attn"
+            mid_heads, mid_hdim, mid_depth, dt, pag=pag, name="mid_attn"
         )(h, context)
         h = ResBlock(mid_ch, dt, name="mid_res_1")(h, emb)
 

@@ -643,15 +643,48 @@ def slg_cfg_model(
         eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
 
         def correction(_):
-            if _needs_composite(pos):
-                eps_skip = composite_eps(skip_model_fn, x, sigma, pos, p2s)
-            else:
-                eps_skip = skip_model_fn(x, sigma, pos)
-            return slg_scale * (eps_pos - eps_skip)
+            return _perturbed_delta(
+                skip_model_fn, x, sigma, pos, eps_pos, slg_scale, p2s
+            )
 
         active = (sigma[0] >= sigma_end) & (sigma[0] <= sigma_start)
         return base + jax.lax.cond(
             active, correction, lambda _: jnp.zeros_like(eps_pos), None
+        )
+
+    return guided
+
+
+def _perturbed_delta(pert_model_fn, x, sigma, pos, eps_pos, scale, p2s):
+    """scale * (eps_pos - eps_perturbed): the guidance-delta body
+    shared by skip-layer guidance and PAG — one composite-aware
+    perturbed forward against the positive conditioning."""
+    if _needs_composite(pos):
+        eps_pert = composite_eps(pert_model_fn, x, sigma, pos, p2s)
+    else:
+        eps_pert = pert_model_fn(x, sigma, pos)
+    return scale * (eps_pos - eps_pert)
+
+
+def pag_cfg_model(
+    model_fn: ModelFn,
+    pag_model_fn: ModelFn,
+    cfg_scale: float,
+    pag_scale: float,
+    p2s=_default_p2s,
+) -> ModelFn:
+    """CFG plus perturbed-attention guidance (PAG, Ahn et al. 2024 —
+    the reference stack's PerturbedAttentionGuidance patch): the
+    result gains pag_scale * (cond - cond_with_identity_attn), where
+    the perturbed pass replaces the middle-block self-attention matrix
+    with identity (out = V; models/unet.py pag flag). One extra
+    positive-cond forward per step, parameters shared."""
+
+    def guided(x, sigma, cond):
+        pos, _neg = cond
+        eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
+        return base + _perturbed_delta(
+            pag_model_fn, x, sigma, pos, eps_pos, pag_scale, p2s
         )
 
     return guided

@@ -1,0 +1,135 @@
+"""PerturbedAttentionGuidance: identity self-attention perturbation,
+the pag_cfg_model composition, and the node's family guard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import KSampler
+from comfyui_distributed_tpu.graph.nodes_loaders import (
+    PerturbedAttentionGuidance,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+from comfyui_distributed_tpu.ops import samplers as smp
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(11)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+@pytest.mark.fast
+def test_identity_attention_is_projected_v():
+    from comfyui_distributed_tpu.models.layers import AttentionBlock
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 6, 8)).astype(np.float32)
+    )
+    blk = AttentionBlock(2, 4, jnp.float32, identity_self=True)
+    params = blk.init(jax.random.key(0), x)
+    out = blk.apply(params, x)
+    # manual: out = to_out(to_v(x)) with no attention mixing
+    v = x @ params["params"]["to_v"]["kernel"]
+    ref = (
+        v @ params["params"]["to_out"]["kernel"]
+        + params["params"]["to_out"]["bias"]
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # normal attention differs (mixing across tokens)
+    normal = AttentionBlock(2, 4, jnp.float32).apply(params, x)
+    assert not np.allclose(np.asarray(normal), np.asarray(out), atol=1e-4)
+
+
+@pytest.mark.fast
+def test_pag_cfg_model_math():
+    base = lambda x, sigma, cond: cond  # noqa: E731
+    pert = lambda x, sigma, cond: cond * 0.5  # noqa: E731
+    x = jnp.zeros((1, 2, 2, 1))
+    sig = jnp.ones((1,))
+    pos = jnp.full_like(x, 2.0)
+    neg = jnp.full_like(x, 1.0)
+    guided = smp.pag_cfg_model(base, pert, 3.0, 2.0)
+    out = guided(x, sig, (pos, neg))
+    cfg = 1.0 + 3.0 * (2.0 - 1.0)  # 4.0
+    expect = cfg + 2.0 * (2.0 - 1.0)  # + scale*(eps_pos - eps_pert)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_pag_zero_scale_equals_plain_cfg(bundle):
+    pos = pl.encode_text(bundle, ["a castle"])
+    neg = pl.encode_text(bundle, [""])
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(1, 8, 8, 4)).astype(np.float32)
+    )
+    sig = jnp.full((1,), 5.0)
+    g_plain = pl.guided_model(bundle, bundle.params, 4.0)
+    (patched,) = PerturbedAttentionGuidance().patch(bundle, scale=0.0)
+    g_pag = pl.guided_model(patched, patched.params, 4.0)
+    np.testing.assert_allclose(
+        np.asarray(g_pag(x, sig, (pos, neg))),
+        np.asarray(g_plain(x, sig, (pos, neg))),
+        atol=1e-5,
+    )
+    # nonzero scale changes the prediction
+    (p2,) = PerturbedAttentionGuidance().patch(bundle, scale=3.0)
+    g2 = pl.guided_model(p2, p2.params, 4.0)
+    assert not np.allclose(
+        np.asarray(g2(x, sig, (pos, neg))),
+        np.asarray(g_plain(x, sig, (pos, neg))),
+        atol=1e-5,
+    )
+
+
+def test_pag_ksampler_end_to_end(bundle):
+    (patched,) = PerturbedAttentionGuidance().patch(bundle, scale=2.5)
+    latent = {"samples": jnp.zeros((1, 8, 8, 4))}
+    pos = pl.encode_text(bundle, ["a castle"])
+    neg = pl.encode_text(bundle, [""])
+    (out,) = KSampler().sample(
+        patched, 3, 2, 4.0, "euler", "karras", pos, neg, latent
+    )
+    arr = np.asarray(out["samples"])
+    assert np.isfinite(arr).all()
+
+
+@pytest.mark.fast
+def test_pag_rejects_dit_families_and_combos():
+    b = object.__new__(pl.PipelineBundle)
+    b.model_name = "tiny-sd3"
+    with pytest.raises(ValueError, match="DiT"):
+        PerturbedAttentionGuidance().patch(b)
+    # combos rejected at guided_model
+    b2 = object.__new__(pl.PipelineBundle)
+    b2.model_name = "tiny-unet"
+    b2.cfg_rescale = 0.7
+    b2.slg = None
+    b2.dual_cfg = None
+    b2.pag = pl.PAGSpec(scale=1.0)
+    with pytest.raises(ValueError, match="combine"):
+        pl.guided_model(b2, {}, 1.0)
+    # patch-time rejection: the second patch node fails at graph build
+    b3 = object.__new__(pl.PipelineBundle)
+    b3.model_name = "tiny-unet"
+    b3.slg = None
+    b3.cfg_rescale = 0.7
+    b3.dual_cfg = None
+    b3.pag = None
+    with pytest.raises(ValueError, match="RescaleCFG"):
+        PerturbedAttentionGuidance().patch(b3)
