@@ -1323,34 +1323,12 @@ class LatentBatch:
 
 
 def _gaussian_blur(image, radius: int, sigma: float):
-    """Separable Gaussian blur with reflect padding — shared by
-    ImageBlur and ImageSharpen (reference-substrate kernel shape)."""
-    r = max(1, int(radius))
-    xs = np.arange(-r, r + 1, dtype=np.float32)
-    k = np.exp(-(xs**2) / (2.0 * max(float(sigma), 1e-6) ** 2))
-    k /= k.sum()
-    kern = jnp.asarray(k)
-    img = jnp.pad(image, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
-    # depthwise separable conv via dot over the window axis
-    img = jax.vmap(
-        lambda c: jax.lax.conv_general_dilated(
-            c[..., None],
-            kern.reshape(1, -1, 1, 1),
-            (1, 1), "VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )[..., 0],
-        in_axes=-1, out_axes=-1,
-    )(img)
-    img = jax.vmap(
-        lambda c: jax.lax.conv_general_dilated(
-            c[..., None],
-            kern.reshape(-1, 1, 1, 1),
-            (1, 1), "VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )[..., 0],
-        in_axes=-1, out_axes=-1,
-    )(img)
-    return img
+    """Shared separable Gaussian kernel (ops/filters.gaussian_blur):
+    ImageBlur / ImageSharpen here, the SAG degraded pass in
+    ops/samplers."""
+    from ..ops.filters import gaussian_blur
+
+    return gaussian_blur(image, radius, sigma)
 
 
 @register_node

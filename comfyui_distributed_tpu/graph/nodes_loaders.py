@@ -305,6 +305,47 @@ class PerturbedAttentionGuidance:
 
 
 @register_node
+class SelfAttentionGuidance:
+    """SAG model patch (ComfyUI SelfAttentionGuidance parity, Hong et
+    al. 2023): gaussian-blur the uncond x0 estimate where the
+    middle-block self-attention concentrates, re-noise, and guide away
+    from the degraded prediction (ops/samplers.sag_cfg_model). UNet
+    family only."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "scale": ("FLOAT", {"default": 0.5}),
+                "blur_sigma": ("FLOAT", {"default": 2.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "patch"
+
+    def patch(self, model, scale=0.5, blur_sigma=2.0, context=None):
+        from ..models.registry import model_family
+
+        family = model_family(model.model_name)
+        if family != "unet":
+            raise ValueError(
+                f"SelfAttentionGuidance captures UNet middle-block "
+                f"attention; {model.model_name!r} is {family}-family"
+            )
+        pl.reject_existing_guidance_patches(model, "SelfAttentionGuidance")
+        return (
+            dataclasses.replace(
+                model,
+                sag=pl.SAGSpec(
+                    scale=float(scale), blur_sigma=float(blur_sigma)
+                ),
+            ),
+        )
+
+
+@register_node
 class RescaleCFG:
     """Std-rescaled guidance (ComfyUI RescaleCFG parity): the guided
     x0 prediction rescales to the cond-only prediction's per-sample

@@ -63,6 +63,9 @@ class AttentionBlock(nn.Module):
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     identity_self: bool = False
+    sow_attn: bool = False  # sow softmax probs (SAG capture pass):
+    # explicit scores instead of the flash kernel — one mid-block
+    # eval at 1/64 the latent tokens, so materializing is cheap
 
     @nn.compact
     def __call__(
@@ -81,6 +84,16 @@ class AttentionBlock(nn.Module):
         v = v.reshape(b, m, self.num_heads, self.head_dim)
         if self.identity_self and context is None:
             out = v
+        elif self.sow_attn and context is None:
+            scores = jnp.einsum(
+                "bnhd,bmhd->bhnm", q.astype(jnp.float32),
+                k.astype(jnp.float32),
+            ) * (1.0 / math.sqrt(self.head_dim))
+            probs = jax.nn.softmax(scores, axis=-1)
+            self.sow("intermediates", "attn_probs", probs)
+            out = jnp.einsum(
+                "bhnm,bmhd->bnhd", probs.astype(self.dtype), v
+            )
         else:
             out = dot_product_attention(q, k, v)
         out = out.reshape(b, n, inner)
@@ -121,6 +134,7 @@ class TransformerBlock(nn.Module):
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     pag: bool = False
+    sow_attn: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
@@ -128,7 +142,7 @@ class TransformerBlock(nn.Module):
         # real SD weights reproduce reference activations
         x = x + AttentionBlock(
             self.num_heads, self.head_dim, self.dtype,
-            identity_self=self.pag, name="attn1",
+            identity_self=self.pag, sow_attn=self.sow_attn, name="attn1",
         )(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         )
@@ -149,6 +163,7 @@ class SpatialTransformer(nn.Module):
     depth: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     pag: bool = False
+    sow_attn: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
@@ -160,7 +175,10 @@ class SpatialTransformer(nn.Module):
         for i in range(self.depth):
             x = TransformerBlock(
                 self.num_heads, self.head_dim, self.dtype,
-                pag=self.pag, name=f"block_{i}",
+                pag=self.pag,
+                # ComfyUI's SAG captures block 0 of the middle stack
+                sow_attn=self.sow_attn and i == 0,
+                name=f"block_{i}",
             )(x, context)
         x = x.reshape(b, h, w, c)
         x = nn.Dense(c, dtype=self.dtype, name="proj_out")(x)

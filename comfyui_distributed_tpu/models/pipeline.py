@@ -107,6 +107,8 @@ class PipelineBundle:
     # PerturbedAttentionGuidance patch (UNet family only; the node
     # guards the family). None = no PAG pass.
     pag: "PAGSpec | None" = None
+    # SelfAttentionGuidance patch (UNet family only). None = no SAG.
+    sag: "SAGSpec | None" = None
 
 
 @dataclasses.dataclass
@@ -168,6 +170,17 @@ class PAGSpec:
     identity (models/unet.py pag flag)."""
 
     scale: float = 3.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SAGSpec:
+    """Self-attention guidance (SelfAttentionGuidance node, Hong et
+    al. 2023): blur the uncond x0 estimate where the middle-block
+    self-attention concentrates, re-noise, and guide away from that
+    degraded prediction."""
+
+    scale: float = 0.5
+    blur_sigma: float = 2.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -824,8 +837,11 @@ def model_schedule_info(bundle: PipelineBundle) -> tuple[str, float]:
 
 def _make_model_fn(
     bundle: PipelineBundle, params, skip_layers: tuple = (),
-    pag: bool = False,
+    pag: bool = False, sag_capture: bool = False,
 ):
+    """sag_capture=True changes the RETURN CONTRACT: model_fn yields
+    (eps, attn_probs, (mid_h, mid_w)) — the SAG capture pass. Only
+    smp.sag_cfg_model consumes that form."""
     from ..ops.conditioning import Conditioning
 
     def model_fn(x, sigma_batch, cond):
@@ -992,10 +1008,19 @@ def _make_model_fn(
                 )
             x_in = jnp.concatenate([x_in, extra], axis=-1)
         unet_kwargs = {"pag": True} if pag else {}
-        out = bundle.unet.apply(
-            params["unet"], x_in, t, context, y=y, control=control,
-            **unet_kwargs,
-        )
+        probs = None
+        if sag_capture:
+            out, mut = bundle.unet.apply(
+                params["unet"], x_in, t, context, y=y, control=control,
+                sag_capture=True, mutable=["intermediates"],
+                **unet_kwargs,
+            )
+            probs = jax.tree_util.tree_leaves(mut)[0]
+        else:
+            out = bundle.unet.apply(
+                params["unet"], x_in, t, context, y=y, control=control,
+                **unet_kwargs,
+            )
         if model_schedule_info(bundle)[0] == "v":
             # SD2.x-768-class velocity prediction. With the VP scalings
             # (c_skip = 1/(sigma^2+1), c_out = -sigma/sqrt(sigma^2+1)):
@@ -1005,6 +1030,16 @@ def _make_model_fn(
             #   eps = x*sigma/(sigma^2+1) + v/sqrt(sigma^2+1)
             sig = sigma_batch.reshape((-1,) + (1,) * (x.ndim - 1))
             out = x * (sig / (sig**2 + 1.0)) + out / jnp.sqrt(sig**2 + 1.0)
+        if sag_capture:
+            levels = len(get_config(bundle.model_name).channel_mult)
+            # per-level ceil-div: Downsample is a stride-2 pad-1 conv,
+            # so each level yields ceil(H/2) — a single floor division
+            # disagrees whenever an intermediate dim is odd
+            mid_h, mid_w = x.shape[1], x.shape[2]
+            for _ in range(levels - 1):
+                mid_h = (mid_h + 1) // 2
+                mid_w = (mid_w + 1) // 2
+            return out.astype(x.dtype), probs, (mid_h, mid_w)
         return out.astype(x.dtype)
 
     return model_fn
@@ -1044,6 +1079,10 @@ def reject_existing_guidance_patches(bundle, node_name: str) -> None:
                 "PerturbedAttentionGuidance",
                 getattr(bundle, "pag", None) is not None,
             ),
+            (
+                "SelfAttentionGuidance",
+                getattr(bundle, "sag", None) is not None,
+            ),
         )
         if active
     ]
@@ -1062,6 +1101,7 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     slg = getattr(bundle, "slg", None)
     dual = getattr(bundle, "dual_cfg", None)
     pag = getattr(bundle, "pag", None)
+    sag = getattr(bundle, "sag", None)
     patches = [
         name
         for name, active in (
@@ -1069,6 +1109,7 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
             ("SkipLayerGuidance", slg is not None),
             ("RescaleCFG", bundle.cfg_rescale is not None),
             ("PerturbedAttentionGuidance", pag is not None),
+            ("SelfAttentionGuidance", sag is not None),
         )
         if active
     ]
@@ -1089,6 +1130,15 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
             _make_model_fn(bundle, params, pag=True),
             cfg_scale,
             float(pag.scale),
+            p2s=p2s,
+        )
+    if sag is not None:
+        return smp.sag_cfg_model(
+            base_fn,
+            _make_model_fn(bundle, params, sag_capture=True),
+            cfg_scale,
+            float(sag.scale),
+            float(sag.blur_sigma),
             p2s=p2s,
         )
     if bundle.cfg_rescale is not None:

@@ -123,6 +123,9 @@ class UNet(nn.Module):
         pag: bool = False,  # identity self-attention in the middle
         # block (the PAG perturbed pass; ComfyUI's simple-PAG patches
         # exactly the middle-block attn1)
+        sag_capture: bool = False,  # sow the middle-block attn1
+        # softmax probs (SAG capture pass); apply with
+        # mutable=["intermediates"] to harvest them
     ) -> jax.Array:
         cfg = self.config
         dt = cfg.compute_dtype
@@ -183,8 +186,13 @@ class UNet(nn.Module):
         mid_depth = max(cfg.transformer_depth[-1], 1)
         h = ResBlock(mid_ch, dt, name="mid_res_0")(h, emb)
         mid_heads, mid_hdim = head_split(mid_ch)
-        h = SpatialT(
-            mid_heads, mid_hdim, mid_depth, dt, pag=pag, name="mid_attn"
+        # capture bypasses remat for the mid block only: sown
+        # intermediates don't survive nn.remat, and the mid block's
+        # activations are 1/64 of the latent tokens anyway
+        MidT = SpatialTransformer if sag_capture else SpatialT
+        h = MidT(
+            mid_heads, mid_hdim, mid_depth, dt, pag=pag,
+            sow_attn=sag_capture, name="mid_attn",
         )(h, context)
         h = ResBlock(mid_ch, dt, name="mid_res_1")(h, emb)
 

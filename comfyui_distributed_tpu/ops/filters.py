@@ -1,0 +1,43 @@
+"""Image-space filter kernels shared across layers.
+
+gaussian_blur backs the ImageBlur/ImageSharpen nodes (graph layer)
+and the SAG degraded-input construction (ops/samplers.sag_cfg_model) —
+one implementation so kernel-shape fixes land everywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gaussian_blur(image: jax.Array, radius: int, sigma: float) -> jax.Array:
+    """Separable Gaussian blur with reflect padding over [B, H, W, C]
+    (reference-substrate kernel shape: window 2*radius+1)."""
+    r = max(1, int(radius))
+    xs = np.arange(-r, r + 1, dtype=np.float32)
+    k = np.exp(-(xs**2) / (2.0 * max(float(sigma), 1e-6) ** 2))
+    k /= k.sum()
+    kern = jnp.asarray(k)
+    img = jnp.pad(image, ((0, 0), (r, r), (r, r), (0, 0)), mode="reflect")
+    # depthwise separable conv via dot over the window axis
+    img = jax.vmap(
+        lambda c: jax.lax.conv_general_dilated(
+            c[..., None],
+            kern.reshape(1, -1, 1, 1),
+            (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[..., 0],
+        in_axes=-1, out_axes=-1,
+    )(img)
+    img = jax.vmap(
+        lambda c: jax.lax.conv_general_dilated(
+            c[..., None],
+            kern.reshape(-1, 1, 1, 1),
+            (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[..., 0],
+        in_axes=-1, out_axes=-1,
+    )(img)
+    return img

@@ -690,6 +690,62 @@ def pag_cfg_model(
     return guided
 
 
+def sag_cfg_model(
+    model_fn: ModelFn,
+    capture_fn,
+    cfg_scale: float,
+    sag_scale: float,
+    blur_sigma: float,
+    p2s=_default_p2s,
+) -> ModelFn:
+    """CFG plus self-attention guidance (SAG, Hong et al. 2023 — the
+    reference stack's SelfAttentionGuidance patch). Per step:
+
+      1. capture pass (capture_fn, the sag_capture model_fn form):
+         eps_uncond + the middle-block attn1 softmax probs;
+      2. salience mask: attention each mid token RECEIVES (mean over
+         heads, summed over queries) > 1.0 — the uniform-attention
+         level — upscaled nearest to the latent grid;
+      3. degraded input: gaussian-blur (radius 4, sigma blur_sigma)
+         the uncond x0 estimate where salient, re-noise with the same
+         noise component (x - x0);
+      4. out = cfg + sag_scale * (eps_uncond - eps_degraded) — the
+         paper's guide-away-from-degraded, composed in eps space
+         (denoised = x - sigma*eps makes it equivalent to the x0
+         form out_x0 = cfg_x0 + s * sigma * (eps_d - eps_u)).
+
+    Four model evals per step: the capture pass is separate so the
+    CFG 2B batch stays intact (the reference reuses its uncond eval
+    and pays an attention-capture hook instead)."""
+    from .filters import gaussian_blur
+
+    def guided(x, sigma, cond):
+        pos, neg = cond
+        if _needs_composite(neg):
+            raise ValueError(
+                "SelfAttentionGuidance needs a single negative "
+                "conditioning entry (the degraded pass re-evaluates "
+                "the uncond prediction)"
+            )
+        eps_pos, base = _cfg_eval(model_fn, cfg_scale, x, sigma, cond, p2s)
+        eps_u, probs, (mid_h, mid_w) = capture_fn(x, sigma, neg)
+        sig = sigma.reshape((-1,) + (1,) * (x.ndim - 1))
+        u_x0 = x - sig * eps_u
+        received = probs.mean(axis=1).sum(axis=1)  # [B, mid_tokens]
+        mask = (received > 1.0).astype(x.dtype)
+        mask = mask.reshape(mask.shape[0], mid_h, mid_w)
+        mask = jax.image.resize(
+            mask, (mask.shape[0], x.shape[1], x.shape[2]), method="nearest"
+        )[..., None]
+        blurred = gaussian_blur(u_x0, 4, blur_sigma)
+        degraded_x0 = blurred * mask + u_x0 * (1.0 - mask)
+        degraded_x = degraded_x0 + (x - u_x0)
+        eps_d = model_fn(degraded_x, sigma, neg)
+        return base + sag_scale * (eps_u - eps_d)
+
+    return guided
+
+
 def _denoised(model_fn: ModelFn, x, sigma, cond):
     """x0 prediction from the eps model at scalar sigma."""
     sig_batch = jnp.broadcast_to(sigma, (x.shape[0],))

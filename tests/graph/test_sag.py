@@ -1,0 +1,150 @@
+"""SelfAttentionGuidance: attention capture, degraded-pass math, node
+guards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_core import KSampler
+from comfyui_distributed_tpu.graph.nodes_loaders import (
+    SelfAttentionGuidance,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(21)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    return b
+
+
+@pytest.mark.fast
+def test_attention_capture_sows_probs():
+    from comfyui_distributed_tpu.models.layers import AttentionBlock
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 6, 8)).astype(np.float32)
+    )
+    blk = AttentionBlock(2, 4, jnp.float32, sow_attn=True)
+    params = blk.init(jax.random.key(0), x)
+    out, mut = blk.apply(params, x, mutable=["intermediates"])
+    probs = jax.tree_util.tree_leaves(mut)[0]
+    assert probs.shape == (2, 2, 6, 6)  # [B, heads, N, N]
+    # rows are probability distributions
+    np.testing.assert_allclose(
+        np.asarray(probs.sum(axis=-1)), 1.0, atol=1e-5
+    )
+    # capture path numerics match the normal path
+    normal = AttentionBlock(2, 4, jnp.float32).apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(normal), atol=1e-5
+    )
+
+
+def test_sag_capture_model_fn_contract(bundle):
+    cap = pl._make_model_fn(bundle, bundle.params, sag_capture=True)
+    neg = pl.encode_text(bundle, [""])
+    x = jnp.asarray(
+        np.random.default_rng(5).normal(size=(1, 8, 8, 4)).astype(np.float32)
+    )
+    sig = jnp.full((1,), 5.0)
+    eps, probs, (mh, mw) = cap(x, sig, neg)
+    assert eps.shape == x.shape
+    assert probs.shape[0] == 1 and probs.ndim == 4
+    assert probs.shape[2] == probs.shape[3] == mh * mw
+    # and matches the normal model_fn's eps
+    base = pl._make_model_fn(bundle, bundle.params)
+    np.testing.assert_allclose(
+        np.asarray(eps), np.asarray(base(x, sig, neg)), atol=2e-3
+    )
+
+
+def test_sag_capture_odd_latent_dims(bundle):
+    """Downsample yields ceil(H/2) per level; the mid-grid derivation
+    must match it for odd latent dims (a 520px image gives a 65-cell
+    latent side)."""
+    cap = pl._make_model_fn(bundle, bundle.params, sag_capture=True)
+    neg = pl.encode_text(bundle, [""])
+    x = jnp.asarray(
+        np.random.default_rng(9).normal(size=(1, 9, 11, 4)).astype(
+            np.float32
+        )
+    )
+    sig = jnp.full((1,), 5.0)
+    _eps, probs, (mh, mw) = cap(x, sig, neg)
+    assert probs.shape[2] == probs.shape[3] == mh * mw
+    # and the full guided path runs on the same odd shape
+    pos = pl.encode_text(bundle, ["a castle"])
+    (patched,) = SelfAttentionGuidance().patch(bundle, scale=0.7)
+    g = pl.guided_model(patched, patched.params, 4.0)
+    assert np.isfinite(np.asarray(g(x, sig, (pos, neg)))).all()
+
+
+def test_sag_zero_scale_equals_plain_cfg(bundle):
+    pos = pl.encode_text(bundle, ["a castle"])
+    neg = pl.encode_text(bundle, [""])
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(1, 8, 8, 4)).astype(np.float32)
+    )
+    sig = jnp.full((1,), 5.0)
+    g_plain = pl.guided_model(bundle, bundle.params, 4.0)
+    (patched,) = SelfAttentionGuidance().patch(bundle, scale=0.0)
+    g_sag = pl.guided_model(patched, patched.params, 4.0)
+    np.testing.assert_allclose(
+        np.asarray(g_sag(x, sig, (pos, neg))),
+        np.asarray(g_plain(x, sig, (pos, neg))),
+        atol=1e-4,
+    )
+    (p2,) = SelfAttentionGuidance().patch(bundle, scale=1.5)
+    g2 = pl.guided_model(p2, p2.params, 4.0)
+    assert not np.allclose(
+        np.asarray(g2(x, sig, (pos, neg))),
+        np.asarray(g_plain(x, sig, (pos, neg))),
+        atol=1e-4,
+    )
+
+
+def test_sag_ksampler_end_to_end(bundle):
+    (patched,) = SelfAttentionGuidance().patch(
+        bundle, scale=0.8, blur_sigma=2.0
+    )
+    latent = {"samples": jnp.zeros((1, 8, 8, 4))}
+    pos = pl.encode_text(bundle, ["a castle"])
+    neg = pl.encode_text(bundle, [""])
+    (out,) = KSampler().sample(
+        patched, 3, 2, 4.0, "euler", "karras", pos, neg, latent
+    )
+    assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+@pytest.mark.fast
+def test_sag_node_guards():
+    b = object.__new__(pl.PipelineBundle)
+    b.model_name = "tiny-flux"
+    with pytest.raises(ValueError, match="family"):
+        SelfAttentionGuidance().patch(b)
+    b2 = object.__new__(pl.PipelineBundle)
+    b2.model_name = "tiny-unet"
+    b2.slg = None
+    b2.cfg_rescale = None
+    b2.dual_cfg = None
+    b2.pag = pl.PAGSpec()
+    b2.sag = None
+    with pytest.raises(ValueError, match="PerturbedAttentionGuidance"):
+        SelfAttentionGuidance().patch(b2)
