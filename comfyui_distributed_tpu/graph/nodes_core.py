@@ -193,6 +193,35 @@ class CLIPTextEncode:
 
 
 @register_node
+class CLIPTextEncodeFlux:
+    """Flux dual-prompt encoding (ComfyUI CLIPTextEncodeFlux parity):
+    t5xxl text feeds the T5 context, clip_l text the CLIP pooled
+    vector, and guidance rides on the conditioning exactly like the
+    FluxGuidance node writes it (pipeline.encode_text_pooled_flux)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip": ("CLIP",),
+                "clip_l": ("STRING", {"default": ""}),
+                "t5xxl": ("STRING", {"default": ""}),
+                "guidance": ("FLOAT", {"default": 3.5}),
+            }
+        }
+
+    RETURN_TYPES = ("CONDITIONING",)
+    FUNCTION = "encode"
+
+    def encode(self, clip, clip_l="", t5xxl="", guidance=3.5, context=None):
+        return (
+            pl.encode_text_pooled_flux(
+                clip, [str(t5xxl)], [str(clip_l)], guidance=float(guidance)
+            ),
+        )
+
+
+@register_node
 class CLIPTextEncodeSDXL:
     """SDXL dual-prompt encoding (ComfyUI CLIPTextEncodeSDXL parity):
     text_l feeds the CLIP-L tower, text_g the CLIP-G tower, and the

@@ -585,3 +585,94 @@ class UnCLIPConditioning:
             return cond
 
         return (map_conditioning(conditioning, patch),)
+
+
+def _merge_trees(t1, t2, ratio: float, what: str):
+    """ratio * t1 + (1 - ratio) * t2 over matching param trees (the
+    ComfyUI merge convention: ratio 1.0 = pure model1). Mismatched
+    architectures fail on treedef/shape, loudly."""
+    import jax
+
+    d1 = jax.tree_util.tree_structure(t1)
+    d2 = jax.tree_util.tree_structure(t2)
+    if d1 != d2:
+        raise ValueError(
+            f"{what}: param trees differ — merging needs two checkpoints "
+            "of the same architecture"
+        )
+    r = float(ratio)
+
+    def lerp(a, b):
+        if a.shape != b.shape:
+            raise ValueError(
+                f"{what}: shape mismatch {a.shape} vs {b.shape}"
+            )
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return (a.astype(jnp.float32) * r
+                    + b.astype(jnp.float32) * (1.0 - r)).astype(a.dtype)
+        return a
+
+    return jax.tree_util.tree_map(lerp, t1, t2)
+
+
+@register_node
+class ModelMergeSimple:
+    """Weighted average of two diffusion backbones (ComfyUI
+    ModelMergeSimple parity): ratio weights model1. The merged bundle
+    keeps model1's config/patches — only the unet params blend."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model1": ("MODEL",),
+                "model2": ("MODEL",),
+                "ratio": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("MODEL",)
+    FUNCTION = "merge"
+
+    def merge(self, model1, model2, ratio=1.0, context=None):
+        merged = _merge_trees(
+            model1.params["unet"], model2.params["unet"], ratio,
+            "ModelMergeSimple",
+        )
+        params = dict(model1.params)
+        params["unet"] = merged
+        return (dataclasses.replace(model1, params=params),)
+
+
+@register_node
+class CLIPMergeSimple:
+    """Weighted average of two text-encoder stacks (ComfyUI
+    CLIPMergeSimple parity): every te/te2/te3 part present in clip1
+    blends with clip2's matching part."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "clip1": ("CLIP",),
+                "clip2": ("CLIP",),
+                "ratio": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("CLIP",)
+    FUNCTION = "merge"
+
+    def merge(self, clip1, clip2, ratio=1.0, context=None):
+        params = dict(clip1.params)
+        for part in ("te", "te2", "te3"):
+            if part in clip1.params:
+                if part not in clip2.params:
+                    raise ValueError(
+                        f"CLIPMergeSimple: clip2 has no {part!r} part"
+                    )
+                params[part] = _merge_trees(
+                    clip1.params[part], clip2.params[part], ratio,
+                    f"CLIPMergeSimple[{part}]",
+                )
+        return (dataclasses.replace(clip1, params=params),)

@@ -360,3 +360,137 @@ class LoadImageMask:
         else:
             mask = arr[..., idx]
         return (jnp.asarray(mask)[None],)
+
+
+def _latent_pair(samples1: dict, samples2: dict):
+    a, b = samples1["samples"], samples2["samples"]
+    if a.shape != b.shape:
+        raise ValueError(
+            f"latent math needs matching shapes, got {a.shape} vs {b.shape}"
+        )
+    return a, b
+
+
+@register_node
+class LatentAdd:
+    """Elementwise latent sum (ComfyUI LatentAdd parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"samples1": ("LATENT",), "samples2": ("LATENT",)}
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "op"
+
+    def op(self, samples1: dict, samples2: dict, context=None):
+        a, b = _latent_pair(samples1, samples2)
+        return ({**samples1, "samples": a + b},)
+
+
+@register_node
+class LatentSubtract:
+    """Elementwise latent difference (ComfyUI LatentSubtract parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {"samples1": ("LATENT",), "samples2": ("LATENT",)}
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "op"
+
+    def op(self, samples1: dict, samples2: dict, context=None):
+        a, b = _latent_pair(samples1, samples2)
+        return ({**samples1, "samples": a - b},)
+
+
+@register_node
+class LatentMultiply:
+    """Scale a latent by a scalar (ComfyUI LatentMultiply parity)."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "multiplier": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "op"
+
+    def op(self, samples: dict, multiplier=1.0, context=None):
+        return (
+            {**samples, "samples": samples["samples"] * float(multiplier)},
+        )
+
+
+@register_node
+class LatentInterpolate:
+    """Norm-preserving latent interpolation (ComfyUI LatentInterpolate
+    parity): lerp the direction vectors, then restore the lerped
+    magnitude — a plain lerp of two unit-scale latents shrinks toward
+    the origin at ratio 0.5."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples1": ("LATENT",),
+                "samples2": ("LATENT",),
+                "ratio": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "op"
+
+    def op(self, samples1: dict, samples2: dict, ratio=1.0, context=None):
+        a, b = _latent_pair(samples1, samples2)
+        r = float(ratio)
+        axes = tuple(range(1, a.ndim))
+        na = jnp.sqrt(jnp.sum(a * a, axis=axes, keepdims=True))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axes, keepdims=True))
+        da = a / jnp.maximum(na, 1e-8)
+        db = b / jnp.maximum(nb, 1e-8)
+        mixed = da * r + db * (1.0 - r)
+        nm = jnp.sqrt(jnp.sum(mixed * mixed, axis=axes, keepdims=True))
+        out = mixed / jnp.maximum(nm, 1e-8) * (na * r + nb * (1.0 - r))
+        return ({**samples1, "samples": out},)
+
+
+@register_node
+class ImageQuantize:
+    """Reduce an image to N levels per channel (ComfyUI ImageQuantize
+    role). dither='none' only — error-diffusion dithers are inherently
+    sequential per pixel (a poor fit for one XLA program) and are
+    rejected rather than silently approximated."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "image": ("IMAGE",),
+                "colors": ("INT", {"default": 256}),
+                "dither": ("STRING", {"default": "none"}),
+            }
+        }
+
+    RETURN_TYPES = ("IMAGE",)
+    FUNCTION = "quantize"
+
+    def quantize(self, image, colors=256, dither="none", context=None):
+        if str(dither) != "none":
+            raise ValueError(
+                "only dither='none' is implemented (error-diffusion "
+                "dithering is sequential per pixel)"
+            )
+        n = int(colors)
+        if not 2 <= n <= 256:
+            raise ValueError("colors must be in [2, 256]")
+        levels = n - 1
+        return (jnp.round(jnp.clip(image, 0.0, 1.0) * levels) / levels,)

@@ -714,25 +714,7 @@ def _encode_raw(bundle: PipelineBundle, texts: list[str]):
         return hidden, pooled
 
     if model_family(bundle.model_name) == "mmdit":
-        # Flux layout: T5 hidden states are the context; the pooled
-        # vector comes from the CLIP encoder — no concat, no padding.
-        # Both encoders (and their distinct tokenizers) are mandatory
-        # for this family; a T5 tokenizer feeding the CLIP tower would
-        # be silently wrong, so no fallback exists.
-        if bundle.text_encoder_2 is None or bundle.tokenizer_2 is None:
-            raise ValueError(
-                f"{bundle.model_name}: mmdit bundles need text_encoder_2/"
-                "tokenizer_2 (CLIP pooled source)"
-            )
-        tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
-        hidden, _ = bundle.text_encoder.apply(bundle.params["te"], tokens)
-        tok2 = bundle.tokenizer_2
-        tokens2 = jnp.asarray(tok2.encode_batch(texts))
-        _, pooled = bundle.text_encoder_2.apply(
-            bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
-            skip_last=bundle.clip_skip,
-        )
-        return hidden, pooled
+        return _encode_flux_parts(bundle, texts, texts)
 
     tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts))
     hidden, pooled = bundle.text_encoder.apply(
@@ -771,6 +753,59 @@ def encode_text_pooled(bundle: PipelineBundle, texts: list[str]):
 
     hidden, pooled = _encode_raw(bundle, texts)
     return Conditioning(context=hidden, pooled=pooled)
+
+
+def _encode_flux_parts(
+    bundle: PipelineBundle, texts_t5: list[str], texts_clip: list[str]
+):
+    """Flux layout (mmdit): T5 hidden states are the context; the
+    pooled vector comes from the CLIP encoder — no concat, no padding.
+    Both encoders (and their distinct tokenizers) are mandatory for
+    this family; a T5 tokenizer feeding the CLIP tower would be
+    silently wrong, so no fallback exists. Shared by _encode_raw
+    (same text to both towers) and CLIPTextEncodeFlux (per-tower
+    prompts)."""
+    if bundle.text_encoder_2 is None or bundle.tokenizer_2 is None:
+        raise ValueError(
+            f"{bundle.model_name}: mmdit bundles need text_encoder_2/"
+            "tokenizer_2 (CLIP pooled source)"
+        )
+    tokens = jnp.asarray(bundle.tokenizer.encode_batch(texts_t5))
+    hidden, _ = bundle.text_encoder.apply(bundle.params["te"], tokens)
+    tok2 = bundle.tokenizer_2
+    tokens2 = jnp.asarray(tok2.encode_batch(texts_clip))
+    _, pooled = bundle.text_encoder_2.apply(
+        bundle.params["te2"], tokens2, eos_id=tok2.eos_id,
+        skip_last=bundle.clip_skip,
+    )
+    return hidden, pooled
+
+
+def encode_text_pooled_flux(
+    bundle: PipelineBundle,
+    texts_t5: list[str],
+    texts_clip: list[str],
+    guidance: float | None = None,
+):
+    """Per-tower Flux encoding (CLIPTextEncodeFlux parity): t5xxl text
+    feeds the T5 context, clip_l text the CLIP pooled vector, and the
+    distilled guidance rides on the conditioning (same slot the
+    FluxGuidance node writes). With identical prompts and
+    guidance=None this reduces exactly to encode_text_pooled on an
+    mmdit bundle."""
+    from ..ops.conditioning import Conditioning
+    from .registry import model_family
+
+    if model_family(bundle.model_name) != "mmdit":
+        raise ValueError(
+            f"{bundle.model_name}: CLIPTextEncodeFlux needs a Flux-layout "
+            "(mmdit) bundle"
+        )
+    hidden, pooled = _encode_flux_parts(bundle, texts_t5, texts_clip)
+    return Conditioning(
+        context=hidden, pooled=pooled,
+        guidance=None if guidance is None else float(guidance),
+    )
 
 
 def encode_text_pooled_sdxl(

@@ -1,0 +1,129 @@
+"""Latent math, ImageQuantize, ModelMerge/CLIPMerge, and
+CLIPTextEncodeFlux."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.nodes_loaders import (
+    CLIPMergeSimple,
+    ModelMergeSimple,
+)
+from comfyui_distributed_tpu.graph.nodes_transform import (
+    ImageQuantize,
+    LatentAdd,
+    LatentInterpolate,
+    LatentMultiply,
+    LatentSubtract,
+)
+from comfyui_distributed_tpu.models import pipeline as pl
+
+
+def _lat(val, shape=(1, 4, 4, 4)):
+    return {"samples": jnp.full(shape, float(val))}
+
+
+@pytest.mark.fast
+def test_latent_add_subtract_multiply():
+    (s,) = LatentAdd().op(_lat(2.0), _lat(3.0))
+    np.testing.assert_allclose(np.asarray(s["samples"]), 5.0)
+    (d,) = LatentSubtract().op(_lat(2.0), _lat(3.0))
+    np.testing.assert_allclose(np.asarray(d["samples"]), -1.0)
+    (m,) = LatentMultiply().op(_lat(2.0), multiplier=1.5)
+    np.testing.assert_allclose(np.asarray(m["samples"]), 3.0)
+    with pytest.raises(ValueError):
+        LatentAdd().op(_lat(1.0), _lat(1.0, shape=(1, 2, 2, 4)))
+
+
+@pytest.mark.fast
+def test_latent_interpolate_preserves_magnitude():
+    rng = np.random.default_rng(0)
+    a = {"samples": jnp.asarray(rng.normal(size=(2, 4, 4, 4)).astype(np.float32))}
+    b = {"samples": jnp.asarray(rng.normal(size=(2, 4, 4, 4)).astype(np.float32))}
+    (out,) = LatentInterpolate().op(a, b, ratio=0.5)
+    axes = (1, 2, 3)
+    na = np.linalg.norm(np.asarray(a["samples"]).reshape(2, -1), axis=1)
+    nb = np.linalg.norm(np.asarray(b["samples"]).reshape(2, -1), axis=1)
+    no = np.linalg.norm(np.asarray(out["samples"]).reshape(2, -1), axis=1)
+    np.testing.assert_allclose(no, 0.5 * na + 0.5 * nb, rtol=1e-5)
+    # endpoints are exact
+    (e1,) = LatentInterpolate().op(a, b, ratio=1.0)
+    np.testing.assert_allclose(
+        np.asarray(e1["samples"]), np.asarray(a["samples"]), rtol=1e-5
+    )
+
+
+@pytest.mark.fast
+def test_image_quantize():
+    img = jnp.asarray(np.linspace(0, 1, 12, dtype=np.float32)).reshape(
+        1, 2, 2, 3
+    )
+    (out,) = ImageQuantize().quantize(img, colors=2)
+    assert set(np.unique(np.asarray(out))) <= {0.0, 1.0}
+    (out8,) = ImageQuantize().quantize(img, colors=9)
+    np.testing.assert_allclose(
+        np.asarray(out8), np.round(np.asarray(img) * 8) / 8, atol=1e-6
+    )
+    with pytest.raises(ValueError):
+        ImageQuantize().quantize(img, dither="floyd")
+    with pytest.raises(ValueError):
+        ImageQuantize().quantize(img, colors=1)
+
+
+@pytest.mark.slow
+def test_model_and_clip_merge():
+    b1 = pl.load_pipeline("tiny-unet", seed=0)
+    b2 = pl.load_pipeline("tiny-unet", seed=7)
+    (merged,) = ModelMergeSimple().merge(b1, b2, ratio=0.25)
+    l1 = jax.tree_util.tree_leaves(b1.params["unet"])
+    l2 = jax.tree_util.tree_leaves(b2.params["unet"])
+    lm = jax.tree_util.tree_leaves(merged.params["unet"])
+    for a, b, m in zip(l1, l2, lm):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            np.testing.assert_allclose(
+                np.asarray(m),
+                np.asarray(a) * 0.25 + np.asarray(b) * 0.75,
+                atol=1e-5,
+            )
+    # non-unet params stay model1's
+    assert merged.params["vae"] is b1.params["vae"]
+    (cm,) = CLIPMergeSimple().merge(b1, b2, ratio=0.5)
+    t1 = jax.tree_util.tree_leaves(b1.params["te"])[0]
+    t2 = jax.tree_util.tree_leaves(b2.params["te"])[0]
+    tm = jax.tree_util.tree_leaves(cm.params["te"])[0]
+    np.testing.assert_allclose(
+        np.asarray(tm), (np.asarray(t1) + np.asarray(t2)) / 2.0, atol=1e-5
+    )
+    # architecture mismatch is loud
+    b3 = pl.load_pipeline("tiny-sd3", seed=0)
+    with pytest.raises(ValueError):
+        ModelMergeSimple().merge(b1, b3)
+
+
+@pytest.mark.slow
+def test_clip_text_encode_flux_node():
+    from comfyui_distributed_tpu.graph.nodes_core import CLIPTextEncodeFlux
+
+    b = pl.load_pipeline("tiny-flux", seed=0)
+    (cond,) = CLIPTextEncodeFlux().encode(
+        b, clip_l="a cat", t5xxl="a detailed cat", guidance=4.5
+    )
+    assert cond.guidance == 4.5
+    assert cond.context.ndim == 3 and cond.pooled.ndim == 2
+    # identical prompts + no guidance reduce to encode_text_pooled
+    (same,) = CLIPTextEncodeFlux().encode(
+        b, clip_l="a cat", t5xxl="a cat", guidance=3.5
+    )
+    ref = pl.encode_text_pooled(b, ["a cat"])
+    np.testing.assert_allclose(
+        np.asarray(same.context), np.asarray(ref.context), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(same.pooled), np.asarray(ref.pooled), atol=1e-5
+    )
+    # family guard
+    b2 = object.__new__(pl.PipelineBundle)
+    b2.model_name = "tiny-unet"
+    with pytest.raises(ValueError, match="mmdit"):
+        CLIPTextEncodeFlux().encode(b2, clip_l="x", t5xxl="y")
