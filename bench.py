@@ -48,8 +48,9 @@ BENCH_METRIC=usdu|txt2img|video, BENCH_PROBE_TIMEOUT (s, <=0 skips
 probe), BENCH_SCALING_TIMEOUT (s, <=0 skips), BENCH_WALL_S (<=0
 disables the wall clock), BENCH_BUDGET_S / BENCH_BUDGET2_S (full /
 reduced accelerator child caps), BENCH_TINY_BUDGET_S,
-BENCH_TILE_BATCH (USDU tile grouping; default 1 on CPU, 4 on
-accelerators), BENCH_TERM_GRACE_S (SIGTERM->SIGKILL harvest window on
+BENCH_TILE_BATCH (USDU tile grouping; default 1 on CPU, 8 on
+accelerators — measured best on v5e, BENCH_NOTES r5 A/B),
+BENCH_TERM_GRACE_S (SIGTERM->SIGKILL harvest window on
 probe timeout), BENCH_PROBE_PLATFORM (pin the probe child's backend
 via the config API — the env var is overridden by hosted plugins),
 CDT_PARAMS_DTYPE (weight storage dtype; the orchestrator sets
@@ -396,11 +397,15 @@ def bench_usdu(jax, tiny: bool) -> dict:
     neg = pl.encode_text(bundle, [""])
     _, _, grid = up.plan_grid(src, src, 2.0, tile, padding)
     # batch-K tile grouping: K=1 on CPU keeps the tiny datum comparable
-    # to the r1-r4 trendline; accelerators default to K=4 — batch-1
-    # convs leave most of the MXU idle (see BENCH_NOTES.md)
+    # to the r1-r4 trendline; accelerators default to K=8 — batch-1
+    # convs leave most of the MXU idle (measured r5: K=8 +4% over K=1,
+    # see BENCH_NOTES.md)
     tile_batch = int(os.environ.get("BENCH_TILE_BATCH") or 0)
     if tile_batch <= 0:
-        tile_batch = 1 if jax.devices()[0].platform == "cpu" else 4
+        # measured on a v5e chip (BENCH_NOTES r5 A/B): K=8 beats K=4
+        # by 1.1% and K=1 by 4.0%; CPU stays K=1 (golden-exact,
+        # r1-r4 trendline comparability)
+        tile_batch = 1 if jax.devices()[0].platform == "cpu" else 8
     kwargs = dict(
         upscale_by=2.0, tile=tile, padding=padding, steps=steps,
         sampler="euler", scheduler="karras", cfg=7.0, denoise=0.35,
@@ -709,6 +714,10 @@ def _run_child(
 
     env = dict(os.environ)
     env.update(extra_env)
+    env.setdefault(
+        "BENCH_CHILD_DEADLINE_S",
+        str(int(timeout_s)) if timeout_s > 0 else "0",
+    )
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -887,6 +896,7 @@ def _orchestrate() -> None:
 
     # -- Phase 2: ONE accelerator probe -------------------------------
     best_accel: dict | None = None
+    probing_enabled = False
     if os.environ.get("BENCH_PLATFORM"):
         probe_status = "ok"  # children will run the forced platform
         record("probe", "skipped_platform_override")
@@ -897,6 +907,7 @@ def _orchestrate() -> None:
             probe_status = "ok"
             record("probe", "skipped_by_env")
         else:
+            probing_enabled = True
             probe_status = _probe_accelerator(probe_timeout)
             record("probe", probe_status)
 
@@ -934,7 +945,7 @@ def _orchestrate() -> None:
             and "BENCH_TILE_BATCH" not in os.environ
         ):
             # OOM rung: the same full config at tile grouping 1 —
-            # activation memory scales with K, and a 4x-grouped SDXL
+            # activation memory scales with K, and a batch-K SDXL
             # tile program is the likeliest thing to blow HBM
             budget_k1 = min(
                 float(os.environ.get("BENCH_BUDGET_S", 2400)),
@@ -948,7 +959,28 @@ def _orchestrate() -> None:
                 record("accelerator_k1", st)
                 if best_accel is not None:
                     best_accel["attempt"] = "tile_batch_1"
-        if best_accel is None:
+        if (
+            best_accel is None
+            and "timeout" in child_statuses
+            and probing_enabled
+        ):
+            # a KILLED child leaves the backend's single-client lock
+            # held server-side (measured r5: the next client hangs in
+            # PJRT init for >25 min) — re-probe cheaply before
+            # spending the reduced rung's budget on a wedged chip.
+            # Only when probing is enabled: an operator who disabled
+            # the probe (BENCH_PROBE_TIMEOUT<=0 / BENCH_PLATFORM)
+            # must not lose the reduced rung to a probe they opted
+            # out of.
+            reprobe_budget = min(90.0, remaining() - scaling_reserve - 60)
+            if reprobe_budget > 30:
+                st = _probe_accelerator(reprobe_budget)
+                record("reprobe_after_kill", st)
+                if st != "ok":
+                    probe_status = "wedged_after_kill"
+            else:
+                record("reprobe_after_kill", "skipped_budget")
+        if best_accel is None and probe_status == "ok":
             budget2 = min(
                 float(os.environ.get("BENCH_BUDGET2_S", 1200)),
                 remaining() - scaling_reserve,
@@ -1030,6 +1062,25 @@ def main() -> None:
     ):
         _orchestrate()
         return
+
+    # hang watchdog (probe child parity): backend init can block in
+    # native code indefinitely — measured r5: a bench child killed
+    # mid-run leaves the single-client chip lock held, and the NEXT
+    # child hangs in PJRT client creation with zero output. The
+    # traceback names the blocked line before the parent's kill.
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE_S", "0"))
+    if deadline > 30:
+        import faulthandler
+        import signal
+
+        faulthandler.enable()
+        faulthandler.dump_traceback_later(deadline - 15, exit=False)
+        # self-destruct (probe-child parity): SIGTERM cannot interrupt
+        # a native-blocked PJRT call — measured r5: `timeout`'s TERM
+        # left a lock-blocked child alive past its budget. SIGALRM's
+        # default disposition is a kernel-level terminate that fires
+        # even inside the blocked call.
+        signal.alarm(int(deadline + 30))
 
     jax, environment = _init_jax()
     tiny = os.environ.get("BENCH_TINY") == "1"
