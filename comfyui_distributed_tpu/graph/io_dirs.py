@@ -44,3 +44,18 @@ def resolve_input_path(name: str, context=None) -> str:
     if not path.startswith(os.path.normpath(base) + os.sep) and path != os.path.normpath(base):
         raise DistributedError(f"input path {name!r} escapes input dir")
     return path
+
+
+def next_counter(out_dir: str, prefix: str, ext: str) -> int:
+    """First free <prefix>_NNNNN.<ext> counter: max existing + 1 (the
+    ComfyUI counter-scan convention — never clobbers on gaps, unlike a
+    len() count). Shared by SaveImage and the animated savers."""
+    suffix = f".{ext}"
+    start = 0
+    for f in os.listdir(out_dir):
+        if not (f.startswith(f"{prefix}_") and f.endswith(suffix)):
+            continue
+        stem = f[len(prefix) + 1 : -len(suffix)]
+        if stem.isdigit():
+            start = max(start, int(stem) + 1)
+    return start

@@ -1192,15 +1192,9 @@ class SaveImage:
         os.makedirs(out_dir, exist_ok=True)
         # resume numbering after existing files so runs never clobber
         # each other (ComfyUI counter-scan behavior)
-        existing = [
-            f for f in os.listdir(out_dir)
-            if f.startswith(f"{filename_prefix}_") and f.endswith(".png")
-        ]
-        start = 0
-        for f in existing:
-            stem = f[len(filename_prefix) + 1 : -4]
-            if stem.isdigit():
-                start = max(start, int(stem) + 1)
+        from .io_dirs import next_counter
+
+        start = next_counter(out_dir, filename_prefix, "png")
         saved = []
         arr = img_utils.ensure_numpy(images)
         for i in range(arr.shape[0]):

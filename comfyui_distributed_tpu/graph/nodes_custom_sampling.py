@@ -490,6 +490,47 @@ class DualCFGGuider:
         )
 
 
+@register_node
+class PerpNegGuider:
+    """Perpendicular negative guidance (ComfyUI PerpNegGuider parity,
+    Armandpour et al. 2023): only the component of the negative
+    orthogonal to the positive pushes away, so a negative aligned
+    with the positive no longer cancels it. One 3B-batched eval per
+    step over (positive, negative, empty); formulas:
+    smp.perp_neg_model."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "model": ("MODEL",),
+                "positive": ("CONDITIONING",),
+                "negative": ("CONDITIONING",),
+                "empty_conditioning": ("CONDITIONING",),
+                "cfg": ("FLOAT", {"default": 8.0}),
+                "neg_scale": ("FLOAT", {"default": 1.0}),
+            }
+        }
+
+    RETURN_TYPES = ("GUIDER",)
+    FUNCTION = "get_guider"
+
+    def get_guider(self, model, positive, negative, empty_conditioning,
+                   cfg=8.0, neg_scale=1.0, context=None):
+        pl.reject_existing_guidance_patches(model, "PerpNegGuider")
+        bundle = dataclasses.replace(
+            model, perp_neg=pl.PerpNegSpec(neg_scale=float(neg_scale))
+        )
+        return (
+            GuiderSpec(
+                bundle=bundle,
+                positive=(positive, negative),
+                negative=empty_conditioning,
+                cfg=float(cfg),
+            ),
+        )
+
+
 def _run_custom(
     noise: NoiseSpec,
     guider: GuiderSpec,

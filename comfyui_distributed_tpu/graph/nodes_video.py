@@ -270,3 +270,86 @@ class SaveVideoFrames:
         ) as fh:
             json.dump(manifest, fh)
         return ({"ui": {"images": saved, "fps": fps}, "images": images},)
+
+
+def _save_animated(images, filename_prefix, fps, fmt, save_kwargs, context):
+    """Shared APNG/WEBP writer (SaveAnimatedPNG / SaveAnimatedWEBP):
+    PIL's save_all path, counter-scanned filenames like SaveImage."""
+    from ..utils import image as img_utils
+    from .io_dirs import get_output_dir, next_counter
+
+    out_dir = get_output_dir(context)
+    os.makedirs(out_dir, exist_ok=True)
+    arr = img_utils.ensure_numpy(images)
+    frames = [img_utils.array_to_pil(arr[i]) for i in range(arr.shape[0])]
+    name = (
+        f"{filename_prefix}_{next_counter(out_dir, filename_prefix, fmt):05d}"
+        f".{fmt}"
+    )
+    duration_ms = int(round(1000.0 / max(int(fps), 1)))
+    frames[0].save(
+        os.path.join(out_dir, name),
+        save_all=True,
+        append_images=frames[1:],
+        duration=duration_ms,
+        loop=0,
+        **save_kwargs,
+    )
+    return ({"ui": {"images": [name], "fps": int(fps)}, "images": images},)
+
+
+@register_node
+class SaveAnimatedPNG:
+    """Animated PNG (ComfyUI SaveAnimatedPNG parity): one APNG file,
+    all frames, loop forever."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE",),
+                "filename_prefix": ("STRING", {"default": "animated"}),
+                "fps": ("INT", {"default": 8}),
+                "compress_level": ("INT", {"default": 4}),
+            }
+        }
+
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    OUTPUT_NODE = True
+
+    def save(self, images, filename_prefix="animated", fps=8,
+             compress_level=4, context=None):
+        return _save_animated(
+            images, str(filename_prefix), fps, "png",
+            {"compress_level": int(compress_level)}, context,
+        )
+
+
+@register_node
+class SaveAnimatedWEBP:
+    """Animated WEBP (ComfyUI SaveAnimatedWEBP parity): lossy or
+    lossless, quality 0-100."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "images": ("IMAGE",),
+                "filename_prefix": ("STRING", {"default": "animated"}),
+                "fps": ("INT", {"default": 8}),
+                "lossless": ("BOOLEAN", {"default": True}),
+                "quality": ("INT", {"default": 80}),
+            }
+        }
+
+    RETURN_TYPES = ()
+    FUNCTION = "save"
+    OUTPUT_NODE = True
+
+    def save(self, images, filename_prefix="animated", fps=8,
+             lossless=True, quality=80, context=None):
+        return _save_animated(
+            images, str(filename_prefix), fps, "webp",
+            {"lossless": bool(lossless), "quality": int(quality)}, context,
+        )

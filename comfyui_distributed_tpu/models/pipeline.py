@@ -109,6 +109,8 @@ class PipelineBundle:
     pag: "PAGSpec | None" = None
     # SelfAttentionGuidance patch (UNet family only). None = no SAG.
     sag: "SAGSpec | None" = None
+    # PerpNegGuider composition. None = plain CFG.
+    perp_neg: "PerpNegSpec | None" = None
 
 
 @dataclasses.dataclass
@@ -181,6 +183,16 @@ class SAGSpec:
 
     scale: float = 0.5
     blur_sigma: float = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PerpNegSpec:
+    """PerpNegGuider parameters: only the component of the negative
+    orthogonal to the positive pushes away (smp.perp_neg_model).
+    Sampling positives must be the 2-tuple (positive, negative) and
+    the sampler's negative slot carries the EMPTY conditioning."""
+
+    neg_scale: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1118,6 +1130,10 @@ def reject_existing_guidance_patches(bundle, node_name: str) -> None:
                 "SelfAttentionGuidance",
                 getattr(bundle, "sag", None) is not None,
             ),
+            (
+                "PerpNegGuider",
+                getattr(bundle, "perp_neg", None) is not None,
+            ),
         )
         if active
     ]
@@ -1137,6 +1153,7 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
     dual = getattr(bundle, "dual_cfg", None)
     pag = getattr(bundle, "pag", None)
     sag = getattr(bundle, "sag", None)
+    perp = getattr(bundle, "perp_neg", None)
     patches = [
         name
         for name, active in (
@@ -1145,6 +1162,7 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
             ("RescaleCFG", bundle.cfg_rescale is not None),
             ("PerturbedAttentionGuidance", pag is not None),
             ("SelfAttentionGuidance", sag is not None),
+            ("PerpNegGuider", perp is not None),
         )
         if active
     ]
@@ -1166,6 +1184,10 @@ def guided_model(bundle: PipelineBundle, params, cfg_scale: float):
             cfg_scale,
             float(pag.scale),
             p2s=p2s,
+        )
+    if perp is not None:
+        return smp.perp_neg_model(
+            base_fn, cfg_scale, float(perp.neg_scale), p2s=p2s
         )
     if sag is not None:
         return smp.sag_cfg_model(

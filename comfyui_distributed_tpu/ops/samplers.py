@@ -690,6 +690,63 @@ def pag_cfg_model(
     return guided
 
 
+def perp_neg_model(
+    model_fn: ModelFn,
+    cfg_scale: float,
+    neg_scale: float,
+    p2s=_default_p2s,
+) -> ModelFn:
+    """Perpendicular negative guidance (the PerpNegGuider node;
+    Armandpour et al. 2023 "Re-imagine the Negative Prompt Algorithm").
+    cond is ((positive, negative), empty):
+
+        pos = eps(positive) - eps(empty)
+        neg = eps(negative) - eps(empty)
+        perp = neg - (<neg, pos> / |pos|^2) * pos     (per sample)
+        out  = eps(empty) + cfg_scale * (pos - neg_scale * perp)
+
+    Only the component of the negative orthogonal to the positive
+    pushes away — a negative aligned with the positive no longer
+    cancels it. Three conds run as ONE 3B-batched eval when
+    structurally compatible. The projection is per-sample (axes 1..n);
+    the reference stack computes it over the whole tensor, identical
+    at batch 1."""
+
+    def guided(x, sigma, cond):
+        (pos_c, neg_c), empty_c = cond
+        _reject_unsupported_cond(pos_c, neg_c, empty_c)
+        comp = any(_needs_composite(c) for c in (pos_c, neg_c, empty_c))
+        if (
+            not comp
+            and _conds_batchable(pos_c, neg_c)
+            and _conds_batchable(neg_c, empty_c)
+            and _conds_batchable(pos_c, empty_c)
+        ):
+            x3 = jnp.concatenate([x, x, x], axis=0)
+            s3 = jnp.concatenate([sigma, sigma, sigma], axis=0)
+            c3 = jax.tree_util.tree_map(
+                lambda a, b, c: jnp.concatenate([a, b, c], axis=0),
+                pos_c, neg_c, empty_c,
+            )
+            e_pos, e_neg, e_empty = jnp.split(model_fn(x3, s3, c3), 3, axis=0)
+        else:
+            def _eps(c):
+                if _needs_composite(c):
+                    return composite_eps(model_fn, x, sigma, c, p2s)
+                return model_fn(x, sigma, c)
+
+            e_pos, e_neg, e_empty = _eps(pos_c), _eps(neg_c), _eps(empty_c)
+        pos = e_pos - e_empty
+        neg = e_neg - e_empty
+        axes = tuple(range(1, x.ndim))
+        dot = jnp.sum(neg * pos, axis=axes, keepdims=True)
+        sq = jnp.maximum(jnp.sum(pos * pos, axis=axes, keepdims=True), 1e-12)
+        perp = neg - (dot / sq) * pos
+        return e_empty + cfg_scale * (pos - neg_scale * perp)
+
+    return guided
+
+
 def sag_cfg_model(
     model_fn: ModelFn,
     capture_fn,

@@ -1,0 +1,130 @@
+"""PerpNegGuider + smp.perp_neg_model math, and SaveAnimatedPNG/WEBP."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.ops import samplers as smp
+
+
+@pytest.mark.fast
+def test_perp_neg_math_orthogonal_negative_pushes():
+    """With eps(pos), eps(neg), eps(empty) crafted so the relative
+    negative is exactly orthogonal to the relative positive, the
+    projection removes nothing: out = empty + cfg*(pos - s*neg)."""
+    vals = {}
+
+    def model_fn(x, sigma, cond):
+        return cond
+
+    x = jnp.zeros((1, 1, 1, 2))
+    sig = jnp.ones((1,))
+    e_empty = jnp.zeros_like(x)
+    e_pos = jnp.asarray([1.0, 0.0]).reshape(1, 1, 1, 2)
+    e_neg = jnp.asarray([0.0, 2.0]).reshape(1, 1, 1, 2)  # orthogonal
+    g = smp.perp_neg_model(model_fn, 3.0, 0.5)
+    out = g(x, sig, ((e_pos, e_neg), e_empty))
+    expect = 0.0 + 3.0 * (np.asarray([1.0, 0.0]) - 0.5 * np.asarray([0.0, 2.0]))
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(2), expect, rtol=1e-6
+    )
+
+
+@pytest.mark.fast
+def test_perp_neg_aligned_negative_is_removed():
+    """A negative PARALLEL to the positive must vanish entirely (the
+    node's whole point): out reduces to plain CFG on the positive."""
+
+    def model_fn(x, sigma, cond):
+        return cond
+
+    x = jnp.zeros((1, 1, 1, 2))
+    sig = jnp.ones((1,))
+    e_empty = jnp.zeros_like(x)
+    e_pos = jnp.asarray([1.0, 1.0]).reshape(1, 1, 1, 2)
+    e_neg = 0.7 * e_pos  # perfectly aligned
+    g = smp.perp_neg_model(model_fn, 4.0, 2.0)
+    out = g(x, sig, ((e_pos, e_neg), e_empty))
+    np.testing.assert_allclose(
+        np.asarray(out), 4.0 * np.asarray(e_pos), rtol=1e-5
+    )
+
+
+@pytest.mark.slow
+def test_perp_neg_guider_end_to_end():
+    import jax
+
+    from comfyui_distributed_tpu.graph.nodes_custom_sampling import (
+        PerpNegGuider,
+        RandomNoise,
+        SamplerCustomAdvanced,
+        SamplerSpec,
+    )
+    from comfyui_distributed_tpu.models import pipeline as pl
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(31)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    pos = pl.encode_text(b, ["a castle"])
+    neg = pl.encode_text(b, ["blurry"])
+    empty = pl.encode_text(b, [""])
+    sig = smp.get_sigmas("karras", 3)
+    latent = {"samples": jnp.zeros((1, 8, 8, 4))}
+    (noise,) = RandomNoise().get_noise(5)
+    (g,) = PerpNegGuider().get_guider(
+        b, pos, neg, empty, cfg=4.0, neg_scale=1.0
+    )
+    out, _ = SamplerCustomAdvanced().sample(
+        noise, g, SamplerSpec("euler"), sig, latent
+    )
+    assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+@pytest.mark.fast
+def test_save_animated_png_webp(tmp_path, monkeypatch):
+    from PIL import Image
+
+    from comfyui_distributed_tpu.graph.nodes_video import (
+        SaveAnimatedPNG,
+        SaveAnimatedWEBP,
+    )
+
+    monkeypatch.setenv("CDT_OUTPUT_DIR", str(tmp_path))
+
+    class _Ctx:
+        config = {}
+
+    frames = jnp.stack(
+        [jnp.full((8, 8, 3), v) for v in (0.0, 0.5, 1.0)]
+    )
+    SaveAnimatedPNG().save(frames, "anim", fps=4, context=_Ctx())
+    SaveAnimatedWEBP().save(frames, "anim", fps=4, context=_Ctx())
+    png = tmp_path / "anim_00000.png"
+    webp = tmp_path / "anim_00000.webp"
+    assert png.exists() and webp.exists()
+    im = Image.open(webp)
+    assert getattr(im, "n_frames", 1) == 3
+    # counter scan: second save does not clobber
+    SaveAnimatedPNG().save(frames, "anim", fps=4, context=_Ctx())
+    assert (tmp_path / "anim_00001.png").exists()
+    # max-counter semantics: a numbering GAP must not cause a clobber
+    (tmp_path / "anim_00001.png").unlink()
+    (tmp_path / "anim_00005.png").write_bytes(b"sentinel")
+    SaveAnimatedPNG().save(frames, "anim", fps=4, context=_Ctx())
+    assert (tmp_path / "anim_00005.png").read_bytes() == b"sentinel"
+    assert (tmp_path / "anim_00006.png").exists()
+    # prefix filter: 'anim' does not count 'animated' files
+    (tmp_path / "animated_00099.webp").write_bytes(b"x")
+    SaveAnimatedWEBP().save(frames, "anim", fps=4, context=_Ctx())
+    assert (tmp_path / "anim_00001.webp").exists()
