@@ -307,6 +307,56 @@ def compute_goldens(quick: bool = False) -> dict[str, np.ndarray]:
     # image filter kernels (separable Gaussian + unsharp mask)
     (sharp,) = ImageSharpen().sharpen(pix, 2, 1.0, 0.8)
     out["sharpen_32"] = np.asarray(sharp)
+
+    # round-5 guidance compositions (PAG / SAG / PerpNeg / DualCFG):
+    # one guided-model eval each at a fixed (x, sigma) — the full
+    # trajectories route through these same guided fns. Zero-init
+    # leaves are perturbed deterministically first: with a zero
+    # out_conv, eps is identically 0 and every perturbation delta
+    # vanishes, making the pin vacuous.
+    rng_g = np.random.default_rng(123)
+
+    def _fix(leaf):
+        arr = np.asarray(leaf)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng_g.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return leaf
+
+    gb = pl.load_pipeline("tiny-unet", seed=0)
+    gb.params = dict(
+        gb.params, unet=jax.tree_util.tree_map(_fix, gb.params["unet"])
+    )
+    gpos = pl.encode_text(gb, ["golden guidance"])
+    galt = pl.encode_text(gb, ["golden alternative"])
+    gneg = pl.encode_text(gb, [""])
+    gx = jnp.asarray(
+        np.random.default_rng(77).normal(size=(1, 8, 8, 4)).astype(
+            np.float32
+        )
+    ) * 5.0
+    gsig = jnp.full((1,), 5.0)
+    pagb = _dc.replace(gb, pag=pl.PAGSpec(scale=2.0))
+    out["guided_pag_8"] = np.asarray(
+        pl.guided_model(pagb, pagb.params, 4.0)(gx, gsig, (gpos, gneg))
+    )
+    sagb = _dc.replace(gb, sag=pl.SAGSpec(scale=0.8, blur_sigma=2.0))
+    out["guided_sag_8"] = np.asarray(
+        pl.guided_model(sagb, sagb.params, 4.0)(gx, gsig, (gpos, gneg))
+    )
+    perpb = _dc.replace(gb, perp_neg=pl.PerpNegSpec(neg_scale=1.0))
+    out["guided_perpneg_8"] = np.asarray(
+        pl.guided_model(perpb, perpb.params, 4.0)(
+            gx, gsig, ((gpos, galt), gneg)
+        )
+    )
+    dualb = _dc.replace(gb, dual_cfg=pl.DualCFGSpec(cfg_cond2_negative=3.0))
+    out["guided_dualcfg_8"] = np.asarray(
+        pl.guided_model(dualb, dualb.params, 4.0)(
+            gx, gsig, ((gpos, galt), gneg)
+        )
+    )
     return out
 
 
