@@ -548,9 +548,11 @@ class KSampler:
         spec = resolve_seed(seed)
         bundle = model
         latents, noise_mask, extras = _prep_latents(bundle, latent_image)
+        fixed = bool(latent_image.get("batch_index_fixed", False))
 
         mesh = getattr(context, "mesh", None) if context is not None else None
         if spec.per_participant and mesh is not None and data_axis_size(mesh) > 1:
+            _reject_fixed_on_mesh(fixed)
             param, shift = pl.model_schedule_info(bundle)
             sigmas = smp.get_model_sigmas(
                 param, scheduler, int(steps), denoise=float(denoise),
@@ -575,6 +577,7 @@ class KSampler:
             denoise=float(denoise),
             seed=int(effective_seed),
             noise_mask=noise_mask,
+            batch_fixed_noise=fixed,
         )
         return ({**extras, "samples": out},)
 
@@ -615,6 +618,19 @@ def _prep_latents(bundle, latent_image: dict):
         if k not in ("samples", "empty")
     }
     return latents, noise_mask, extras
+
+
+def _reject_fixed_on_mesh(fixed: bool) -> None:
+    """LatentBatchSeedBehavior 'fixed' + per-participant mesh fan-out
+    is contradictory (participants exist to render DIFFERENT noise);
+    silently honoring one of the two would read as the other
+    working."""
+    if fixed:
+        raise ValueError(
+            "LatentBatchSeedBehavior 'fixed' cannot combine with "
+            "per-participant mesh fan-out (DistributedSeed); use a "
+            "plain INT seed or seed_behavior='random'"
+        )
 
 
 def _sample_mesh(
@@ -747,6 +763,7 @@ class KSamplerAdvanced:
         spec = resolve_seed(noise_seed)
         bundle = model
         latents, noise_mask, extras = _prep_latents(bundle, latent_image)
+        fixed = bool(latent_image.get("batch_index_fixed", False))
 
         mesh = getattr(context, "mesh", None) if context is not None else None
         # mesh fan-out only when noise IS added: participant diversity
@@ -761,6 +778,7 @@ class KSamplerAdvanced:
             and data_axis_size(mesh) > 1
             and do_noise
         ):
+            _reject_fixed_on_mesh(fixed)
             param, shift = pl.model_schedule_info(bundle)
             sigmas = pl.advanced_window_sigmas(
                 param, scheduler, int(steps), int(start_at_step),
@@ -788,6 +806,7 @@ class KSamplerAdvanced:
             add_noise=do_noise,
             force_full_denoise=force_full,
             noise_mask=noise_mask,
+            batch_fixed_noise=fixed,
         )
         return ({**extras, "samples": out},)
 

@@ -549,6 +549,7 @@ def _run_custom(
     participant-major batch)."""
     bundle = guider.bundle
     latents, noise_mask, extras = _prep_latents(bundle, latent_image)
+    fixed = bool(latent_image.get("batch_index_fixed", False))
     if int(sigmas.shape[0]) == 0:
         out = {**extras, "samples": latents}
         return out, dict(out)
@@ -564,6 +565,9 @@ def _run_custom(
         and mesh is not None
         and data_axis_size(mesh) > 1
     ):
+        from .nodes_core import _reject_fixed_on_mesh
+
+        _reject_fixed_on_mesh(fixed)
         result = _sample_mesh(
             bundle, mesh, spec, jnp.asarray(sigmas, jnp.float32), cfg,
             sampler.name, positive, negative, latents, noise_mask,
@@ -592,6 +596,7 @@ def _run_custom(
         seed=int(effective_seed),
         add_noise=noise.add_noise,
         noise_mask=noise_mask,
+        batch_fixed_noise=fixed,
     )
     return ({**extras, "samples": out_l}, {**extras, "samples": denoised_l})
 

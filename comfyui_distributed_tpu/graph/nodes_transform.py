@@ -494,3 +494,40 @@ class ImageQuantize:
             raise ValueError("colors must be in [2, 256]")
         levels = n - 1
         return (jnp.round(jnp.clip(image, 0.0, 1.0) * levels) / levels,)
+
+
+@register_node
+class LatentBatchSeedBehavior:
+    """Batch noise policy (ComfyUI LatentBatchSeedBehavior parity):
+    'fixed' repeats batch index 0's initial noise across the whole
+    batch (every element renders the same trajectory — seed sweeps /
+    prompt comparisons); 'random' (default) is fresh noise per
+    element. The flag rides on the LATENT dict and every sampler
+    honors it (pipeline._batch_noise); per-participant mesh fan-out
+    rejects 'fixed' loudly — participants exist to render DIFFERENT
+    noise."""
+
+    @classmethod
+    def INPUT_TYPES(cls):
+        return {
+            "required": {
+                "samples": ("LATENT",),
+                "seed_behavior": ("STRING", {"default": "fixed"}),
+            }
+        }
+
+    RETURN_TYPES = ("LATENT",)
+    FUNCTION = "op"
+
+    def op(self, samples: dict, seed_behavior="fixed", context=None):
+        mode = str(seed_behavior)
+        if mode not in ("fixed", "random"):
+            raise ValueError(
+                f"seed_behavior must be 'fixed' or 'random', got {mode!r}"
+            )
+        out = dict(samples)
+        if mode == "fixed":
+            out["batch_index_fixed"] = True
+        else:
+            out.pop("batch_index_fixed", None)
+        return (out,)

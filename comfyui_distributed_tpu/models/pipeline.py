@@ -1353,10 +1353,22 @@ def txt2img(
     )
 
 
+def _batch_noise(key, shape, fixed: bool):
+    """Initial-noise policy (LatentBatchSeedBehavior): fixed=True
+    repeats index 0's noise across the batch (ComfyUI seed_behavior
+    'fixed' — every batch element renders the same trajectory);
+    False is fresh noise per element ('random', the default)."""
+    if not fixed:
+        return jax.random.normal(key, shape)
+    one = jax.random.normal(key, (1,) + tuple(shape[1:]))
+    return jnp.broadcast_to(one, shape)
+
+
 @partial(
     jax.jit,
     static_argnames=(
-        "bundle_static", "steps", "sampler", "scheduler", "cfg_scale", "denoise"
+        "bundle_static", "steps", "sampler", "scheduler", "cfg_scale",
+        "denoise", "batch_fixed_noise",
     ),
 )
 def _img2img_jit(
@@ -1372,6 +1384,7 @@ def _img2img_jit(
     cfg_scale: float,
     denoise: float,
     noise_mask=None,
+    batch_fixed_noise: bool = False,
 ):
     bundle = bundle_static.value
     param, shift = model_schedule_info(bundle)
@@ -1379,7 +1392,7 @@ def _img2img_jit(
         param, scheduler, steps, denoise=denoise, flow_shift=shift
     )
     noise_key, anc_key = jax.random.split(key)
-    noise = jax.random.normal(noise_key, latents.shape)
+    noise = _batch_noise(noise_key, latents.shape, batch_fixed_noise)
     x = smp.noise_latents(param, latents, noise, sigmas[0])
     return _masked_sample(
         bundle, params, cfg_scale, param, latents, noise, x, sigmas,
@@ -1416,6 +1429,7 @@ def advanced_window_sigmas(
     static_argnames=(
         "bundle_static", "steps", "sampler", "scheduler", "cfg_scale",
         "start_at_step", "end_at_step", "add_noise", "force_full_denoise",
+        "batch_fixed_noise",
     ),
 )
 def _advanced_jit(
@@ -1434,6 +1448,7 @@ def _advanced_jit(
     add_noise: bool,
     force_full_denoise: bool,
     noise_mask=None,
+    batch_fixed_noise: bool = False,
 ):
     bundle = bundle_static.value
     param, shift = model_schedule_info(bundle)
@@ -1448,7 +1463,7 @@ def _advanced_jit(
     # with a fresh Gaussian the trajectory never saw would corrupt the
     # preserved-region context at every step
     noise = (
-        jax.random.normal(noise_key, latents.shape)
+        _batch_noise(noise_key, latents.shape, batch_fixed_noise)
         if add_noise
         else jnp.zeros_like(latents)
     )
@@ -1506,6 +1521,7 @@ def img2img_latents_advanced(
     add_noise: bool = True,
     force_full_denoise: bool = True,
     noise_mask: jax.Array | None = None,
+    batch_fixed_noise: bool = False,
 ) -> jax.Array:
     """KSamplerAdvanced core: sample a [start_at_step, end_at_step]
     window of the full schedule, optionally without adding noise (the
@@ -1528,6 +1544,7 @@ def img2img_latents_advanced(
         bool(add_noise),
         bool(force_full_denoise),
         noise_mask=noise_mask,
+        batch_fixed_noise=bool(batch_fixed_noise),
     )
 
 
@@ -1535,6 +1552,7 @@ def img2img_latents_advanced(
     jax.jit,
     static_argnames=(
         "bundle_static", "sigmas_t", "sampler", "cfg_scale", "add_noise",
+        "batch_fixed_noise",
     ),
 )
 def _custom_sigmas_jit(
@@ -1549,6 +1567,7 @@ def _custom_sigmas_jit(
     cfg_scale: float,
     add_noise: bool,
     noise_mask=None,
+    batch_fixed_noise: bool = False,
 ):
     """Sampling over an EXPLICIT sigma grid (the SamplerCustom /
     SamplerCustomAdvanced substrate: the schedule arrives as a SIGMAS
@@ -1566,7 +1585,7 @@ def _custom_sigmas_jit(
     sigmas = jnp.asarray(sigmas_t, jnp.float32)
     noise_key, anc_key = jax.random.split(key)
     noise = (
-        jax.random.normal(noise_key, latents.shape)
+        _batch_noise(noise_key, latents.shape, batch_fixed_noise)
         if add_noise
         else jnp.zeros_like(latents)
     )
@@ -1607,6 +1626,7 @@ def sample_custom_sigmas(
     seed: int = 0,
     add_noise: bool = True,
     noise_mask: jax.Array | None = None,
+    batch_fixed_noise: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """SamplerCustom/SamplerCustomAdvanced core: run `sampler` over an
     explicit sigma grid. Returns (output, denoised_output)."""
@@ -1626,6 +1646,7 @@ def sample_custom_sigmas(
         float(cfg_scale),
         bool(add_noise),
         noise_mask=noise_mask,
+        batch_fixed_noise=bool(batch_fixed_noise),
     )
 
 
@@ -1666,6 +1687,7 @@ def img2img_latents(
     denoise: float = 0.5,
     seed: int = 0,
     noise_mask: jax.Array | None = None,
+    batch_fixed_noise: bool = False,
 ) -> jax.Array:
     """Latent-space img2img (the tile re-diffusion core of USDU):
     noise to sigma[denoise], sample back down. Returns latents.
@@ -1687,4 +1709,5 @@ def img2img_latents(
         float(cfg_scale),
         float(denoise),
         noise_mask=noise_mask,
+        batch_fixed_noise=bool(batch_fixed_noise),
     )

@@ -202,3 +202,61 @@ def test_load_image_alpha_inversion(tmp_path):
     Image.fromarray(np.zeros((4, 4, 3), np.uint8), "RGB").save(rgb)
     _img2, mask2 = LoadImage().load(str(rgb))
     np.testing.assert_allclose(np.asarray(mask2), 0.0)
+
+
+def test_latent_batch_seed_behavior_flag():
+    from comfyui_distributed_tpu.graph.nodes_transform import (
+        LatentBatchSeedBehavior,
+    )
+
+    lat = _latent(b=3)
+    (fixed,) = LatentBatchSeedBehavior().op(lat, "fixed")
+    assert fixed["batch_index_fixed"] is True
+    (rand,) = LatentBatchSeedBehavior().op(fixed, "random")
+    assert "batch_index_fixed" not in rand
+    with pytest.raises(ValueError):
+        LatentBatchSeedBehavior().op(lat, "alternate")
+
+
+@pytest.mark.slow
+def test_fixed_batch_noise_makes_identical_batch_elements():
+    import jax
+
+    from comfyui_distributed_tpu.graph.nodes_core import KSampler
+    from comfyui_distributed_tpu.graph.nodes_transform import (
+        LatentBatchSeedBehavior,
+    )
+    from comfyui_distributed_tpu.models import pipeline as pl
+
+    b = pl.load_pipeline("tiny-unet", seed=0)
+    rng = np.random.default_rng(17)
+
+    def fix(x):
+        arr = np.asarray(x)
+        if arr.size and not np.any(arr):
+            return jnp.asarray(
+                (rng.normal(size=arr.shape) * 0.05).astype(arr.dtype)
+            )
+        return x
+
+    b.params = dict(
+        b.params, unet=jax.tree_util.tree_map(fix, b.params["unet"])
+    )
+    pos = pl.encode_text(b, ["a tree"])
+    neg = pl.encode_text(b, [""])
+    lat = {"samples": jnp.zeros((3, 8, 8, 4))}
+    (fixed_lat,) = LatentBatchSeedBehavior().op(lat, "fixed")
+    (out_f,) = KSampler().sample(
+        b, 5, 2, 4.0, "euler", "karras", pos, neg, fixed_lat
+    )
+    arr = np.asarray(out_f["samples"])
+    np.testing.assert_array_equal(arr[0], arr[1])
+    np.testing.assert_array_equal(arr[0], arr[2])
+    # flag propagates through the output latent dict
+    assert out_f.get("batch_index_fixed") is True
+    # random: elements differ
+    (out_r,) = KSampler().sample(
+        b, 5, 2, 4.0, "euler", "karras", pos, neg, lat
+    )
+    ar = np.asarray(out_r["samples"])
+    assert not np.allclose(ar[0], ar[1])
