@@ -5,6 +5,15 @@ bounded probes that drop offline workers, HTTP dispatch via the plain
 /prompt queue API or WS dispatch_prompt/dispatch_ack, and least-busy
 selection (idle workers round-robin via a module counter, else minimum
 queue depth).
+
+Resilience (resilience/health.py + policy.py): every probe/dispatch
+outcome feeds the per-worker circuit breaker. Quarantined workers are
+skipped by `select_active_workers` and rejected by
+`dispatch_worker_prompt` until their cooldown elapses, at which point
+exactly one half-open probe (the existing /prompt probe) decides
+re-admission. HTTP dispatch retries CONNECTION-level failures through
+the shared RetryPolicy; a worker that answered with a rejection is
+never re-sent the same prompt.
 """
 
 from __future__ import annotations
@@ -16,8 +25,10 @@ from typing import Any, Optional
 
 import aiohttp
 
+from ...resilience.health import get_health_registry
+from ...resilience.policy import http_policy, retry_async, transport_errors
 from ...utils.constants import DISPATCH_TIMEOUT_SECONDS, PROBE_CONCURRENCY
-from ...utils.exceptions import WorkerNotAvailableError
+from ...utils.exceptions import WorkerNotAvailableError, WorkerUnreachableError
 from ...utils.logging import debug_log, log
 from ...utils.network import build_worker_url, get_client_session, probe_worker
 
@@ -43,14 +54,34 @@ async def select_active_workers(
     workers: list[dict[str, Any]], concurrency: int = PROBE_CONCURRENCY
 ) -> list[dict[str, Any]]:
     """Enabled workers that answered the probe; offline ones are
-    skipped with a log (reference dispatch.py:144-191)."""
-    results = await probe_workers([w for w in workers if w.get("enabled")], concurrency)
+    skipped with a log (reference dispatch.py:144-191).
+
+    Circuit-breaker consult: quarantined workers are not probed at all
+    unless their cooldown elapsed, in which case this probe IS the
+    half-open probe — success re-admits them, failure re-opens the
+    circuit. Probe outcomes for dispatchable workers feed the breaker
+    too (an offline probe is a transport failure).
+    """
+    registry = get_health_registry()
+    probeable = []
+    for worker in workers:
+        if not worker.get("enabled"):
+            continue
+        wid = str(worker.get("id"))
+        if registry.allow(wid) or registry.try_half_open(wid):
+            probeable.append(worker)
+        else:
+            log(f"worker {wid} quarantined (circuit open); skipping")
+    results = await probe_workers(probeable, concurrency)
     active = []
     for worker, probe in results:
+        wid = str(worker.get("id"))
         if probe["online"]:
+            registry.record_success(wid)
             active.append(worker)
         else:
-            log(f"worker {worker.get('id')} offline; skipping")
+            registry.record_failure(wid)
+            log(f"worker {wid} offline; skipping")
     return active
 
 
@@ -58,8 +89,11 @@ async def select_least_busy_worker(
     workers: list[dict[str, Any]],
 ) -> Optional[dict[str, Any]]:
     """Load-balanced single placement: pick an idle worker round-robin;
-    if none idle, minimum queue depth (reference dispatch.py:225-268)."""
-    results = await probe_workers(workers)
+    if none idle, minimum queue depth (reference dispatch.py:225-268).
+    Quarantined workers are excluded up front."""
+    registry = get_health_registry()
+    candidates = [w for w in workers if registry.allow(str(w.get("id")))]
+    results = await probe_workers(candidates)
     online = [(w, p) for w, p in results if p["online"]]
     if not online:
         return None
@@ -78,14 +112,44 @@ async def dispatch_worker_prompt(
 ) -> None:
     """Send a rewritten prompt to one worker; raises
     WorkerNotAvailableError on failure. WS path waits for the ack
-    (reference dispatch.py:62-141)."""
-    if use_websocket:
-        try:
-            await _dispatch_ws(worker, prompt, prompt_id, extra_data)
-            return
-        except Exception as exc:  # noqa: BLE001 - falls back to HTTP
-            debug_log(f"WS dispatch to {worker.get('id')} failed ({exc}); trying HTTP")
-    await _dispatch_http(worker, prompt, prompt_id, extra_data)
+    (reference dispatch.py:62-141). Outcomes feed the circuit breaker;
+    a quarantined worker is rejected before any bytes move."""
+    registry = get_health_registry()
+    wid = str(worker.get("id"))
+    if not registry.allow(wid):
+        raise WorkerNotAvailableError(
+            f"worker {wid} is quarantined (circuit open); not dispatching", wid
+        )
+    try:
+        if use_websocket:
+            try:
+                await _dispatch_ws(worker, prompt, prompt_id, extra_data)
+                registry.record_success(wid)
+                return
+            except WorkerNotAvailableError as exc:
+                if not isinstance(exc, WorkerUnreachableError):
+                    # The worker ANSWERED with a rejection: it is alive
+                    # (transport success), and the same prompt must NOT
+                    # be re-sent over HTTP.
+                    registry.record_success(wid)
+                    raise
+                debug_log(
+                    f"WS dispatch to {worker.get('id')} unreachable ({exc}); "
+                    "trying HTTP"
+                )
+            except Exception as exc:  # noqa: BLE001 - falls back to HTTP
+                debug_log(
+                    f"WS dispatch to {worker.get('id')} failed ({exc}); trying HTTP"
+                )
+        await _dispatch_http(worker, prompt, prompt_id, extra_data)
+    except WorkerUnreachableError:
+        registry.record_failure(wid)
+        raise
+    except WorkerNotAvailableError:
+        # Rejection answer over HTTP: alive, breaker chain resets.
+        registry.record_success(wid)
+        raise
+    registry.record_success(wid)
 
 
 async def _dispatch_http(worker, prompt, prompt_id, extra_data) -> None:
@@ -94,7 +158,8 @@ async def _dispatch_http(worker, prompt, prompt_id, extra_data) -> None:
     payload = {"prompt": prompt, "prompt_id": prompt_id}
     if extra_data:
         payload["extra_data"] = extra_data
-    try:
+
+    async def attempt():
         async with session.post(
             url, json=payload,
             timeout=aiohttp.ClientTimeout(total=DISPATCH_TIMEOUT_SECONDS),
@@ -102,11 +167,22 @@ async def _dispatch_http(worker, prompt, prompt_id, extra_data) -> None:
             if resp.status != 200:
                 text = await resp.text()
                 raise WorkerNotAvailableError(
-                    f"dispatch to {worker.get('id')} failed: HTTP {resp.status} {text[:200]}",
+                    f"dispatch to {worker.get('id')} failed: "
+                    f"HTTP {resp.status} {text[:200]}",
                     worker.get("id"),
                 )
-    except aiohttp.ClientError as exc:
-        raise WorkerNotAvailableError(
+
+    try:
+        await retry_async(
+            attempt,
+            http_policy(deadline=DISPATCH_TIMEOUT_SECONDS),
+            retryable=transport_errors(),
+            label=f"dispatch:{worker.get('id')}",
+        )
+    except WorkerNotAvailableError:
+        raise  # the worker's answer (HTTP error status): not transport
+    except Exception as exc:
+        raise WorkerUnreachableError(
             f"dispatch to {worker.get('id')} failed: {exc}", worker.get("id")
         ) from exc
 
@@ -127,16 +203,30 @@ async def _dispatch_ws(worker, prompt, prompt_id, extra_data) -> None:
                 "extra_data": extra_data or {},
             }
         )
-        async with asyncio.timeout(DISPATCH_TIMEOUT_SECONDS):
+
+        async def await_ack():
             async for msg in ws:
                 if msg.type != aiohttp.WSMsgType.TEXT:
                     continue
                 data = json.loads(msg.data)
-                if data.get("type") == "dispatch_ack" and data.get("prompt_id") == prompt_id:
+                if (
+                    data.get("type") == "dispatch_ack"
+                    and data.get("prompt_id") == prompt_id
+                ):
                     if not data.get("ok"):
                         raise WorkerNotAvailableError(
                             f"worker rejected prompt: {data.get('error')}",
                             worker.get("id"),
                         )
-                    return
-        raise WorkerNotAvailableError("no dispatch_ack received", worker.get("id"))
+                    return True
+            return False
+
+        try:
+            # asyncio.wait_for (not asyncio.timeout): Python 3.10 compat
+            acked = await asyncio.wait_for(await_ack(), DISPATCH_TIMEOUT_SECONDS)
+        except asyncio.TimeoutError:
+            acked = False
+        if not acked:
+            # Connected but never answered: transport-class failure
+            # (the HTTP fallback may still get through).
+            raise WorkerUnreachableError("no dispatch_ack received", worker.get("id"))

@@ -18,6 +18,7 @@ from typing import Any
 
 import aiohttp
 
+from ...resilience.policy import http_policy, retry_async, transport_errors
 from ...utils.constants import MEDIA_SYNC_TIMEOUT_SECONDS
 from ...utils.logging import debug_log, log
 from ...utils.network import build_worker_url, get_client_session
@@ -65,7 +66,8 @@ async def _worker_path_separator(worker: dict[str, Any]) -> str:
 async def _check_file(worker, filename: str, md5: str) -> bool:
     session = await get_client_session()
     url = build_worker_url(worker, "/distributed/check_file")
-    try:
+
+    async def attempt() -> bool:
         async with session.post(
             url, json={"filename": filename, "md5": md5},
             timeout=aiohttp.ClientTimeout(total=15),
@@ -74,22 +76,41 @@ async def _check_file(worker, filename: str, md5: str) -> bool:
                 return False
             data = await resp.json()
             return bool(data.get("exists") and data.get("matches", True))
-    except Exception:
+
+    try:
+        return await retry_async(
+            attempt, http_policy(), retryable=transport_errors(),
+            label=f"check_file:{filename}",
+        )
+    except Exception:  # noqa: BLE001 - unknown == not present, upload
         return False
 
 
 async def _upload_file(worker, path: str, filename: str) -> bool:
     session = await get_client_session()
     url = build_worker_url(worker, "/upload/image")
-    form = aiohttp.FormData()
+
+    # Read once, outside the retry: a missing/unreadable local file is
+    # a permanent error, not a transient network fault to back off on.
     with open(path, "rb") as fh:
-        form.add_field("image", fh.read(), filename=os.path.basename(filename))
-    try:
+        payload = fh.read()
+
+    async def attempt() -> bool:
+        # FormData is single-use: rebuild per attempt.
+        form = aiohttp.FormData()
+        form.add_field("image", payload, filename=os.path.basename(filename))
         async with session.post(
-            url, data=form, timeout=aiohttp.ClientTimeout(total=MEDIA_SYNC_TIMEOUT_SECONDS)
+            url, data=form,
+            timeout=aiohttp.ClientTimeout(total=MEDIA_SYNC_TIMEOUT_SECONDS),
         ) as resp:
             return resp.status == 200
-    except Exception as exc:
+
+    try:
+        return await retry_async(
+            attempt, http_policy(), retryable=transport_errors(),
+            label=f"upload:{filename}",
+        )
+    except Exception as exc:  # noqa: BLE001 - sync is best-effort
         debug_log(f"upload of {filename} to {worker.get('id')} failed: {exc}")
         return False
 
@@ -122,6 +143,8 @@ async def sync_worker_media(
         if sep != os.sep:
             prompt[node_id]["inputs"][key] = filename.replace(os.sep, sep)
 
-    async with asyncio.timeout(timeout):
-        await asyncio.gather(*(sync_one(*ref) for ref in refs))
+    # asyncio.wait_for (not asyncio.timeout): Python 3.10 compat
+    await asyncio.wait_for(
+        asyncio.gather(*(sync_one(*ref) for ref in refs)), timeout
+    )
     return prompt

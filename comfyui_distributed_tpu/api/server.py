@@ -67,7 +67,17 @@ class DistributedServer:
         )
         self.mesh = mesh
         self.config_path = config_path
-        self.job_store = JobStore()
+        # JobStore picks up the env fault plan (CDT_FAULT_PLAN) so chaos
+        # runs can script store-level faults; None in normal operation.
+        from ..resilience import bind_quarantine_requeue, get_fault_injector
+        from ..resilience.health import get_health_registry
+
+        self.job_store = JobStore(fault_injector=get_fault_injector())
+        # Circuit breaker → job store: a quarantined worker's in-flight
+        # tiles go straight back to the pending queue.
+        self._unbind_health = bind_quarantine_requeue(
+            get_health_registry(), self.job_store
+        )
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner: Optional[web.AppRunner] = None
@@ -173,7 +183,16 @@ class DistributedServer:
     ) -> PromptJob:
         """Validate then enqueue (reference utils/async_helpers.py
         queue_prompt_payload contract: validation errors surface to the
-        caller, not the executor)."""
+        caller, not the executor).
+
+        Idempotent per prompt_id: a retried dispatch whose first
+        delivery actually landed (connection died after the request
+        arrived), or a WS delivery followed by the HTTP fallback, must
+        not execute the same prompt twice."""
+        existing = self._history.get(prompt_id)
+        if existing is not None:
+            debug_log(f"prompt {prompt_id} already queued; duplicate dropped")
+            return existing
         from ..graph import validate_prompt
 
         validate_prompt(prompt)
@@ -233,6 +252,7 @@ class DistributedServer:
         log(f"{role} server listening on {self.host}:{self.port}")
 
     async def stop(self) -> None:
+        self._unbind_health()
         self._prompt_queue.put(None)
         if self._runner is not None:
             await self._runner.cleanup()

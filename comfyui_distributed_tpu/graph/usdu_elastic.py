@@ -38,17 +38,19 @@ from ..ops import upscale as upscale_ops
 from ..utils import image as img_utils
 from ..utils.async_helpers import run_async_in_server_loop
 from ..utils.constants import (
-    JOB_READY_POLL_ATTEMPTS,
-    JOB_READY_POLL_INTERVAL,
     MAX_PAYLOAD_SIZE,
     MAX_TILE_BATCH,
     PAYLOAD_HEADROOM,
     QUEUE_POLL_INTERVAL_SECONDS,
-    REQUEST_RETRY_BACKOFF,
-    WORK_PULL_RETRY_CAP_SECONDS,
-    WORK_PULL_RETRY_COUNT,
 )
-from ..utils.exceptions import WorkerError
+from ..resilience.policy import (
+    http_policy,
+    poll_ready_policy,
+    retry_async,
+    transport_errors,
+    work_pull_policy,
+)
+from ..utils.exceptions import TransientServerError, WorkerError
 from ..utils.logging import debug_log, log
 from ..utils.network import build_worker_url, get_client_session, probe_worker
 
@@ -59,7 +61,14 @@ from ..utils.network import build_worker_url, get_client_session, probe_worker
 
 
 class HTTPWorkClient:
-    """Worker → master RPCs (reference upscale/worker_comms.py)."""
+    """Worker → master RPCs (reference upscale/worker_comms.py).
+
+    Every RPC retries through the shared RetryPolicy
+    (resilience/policy.py): fixed-interval for the readiness poll,
+    patient capped exponential for the work pull, and the default HTTP
+    policy for submissions (safe — the master drops duplicate results,
+    so a retried submit whose first attempt actually landed is a no-op).
+    """
 
     def __init__(self, master_url: str, job_id: str, worker_id: str):
         self.master_url = master_url
@@ -69,44 +78,52 @@ class HTTPWorkClient:
     async def _post(self, path: str, payload: dict) -> dict:
         session = await get_client_session()
         async with session.post(f"{self.master_url}{path}", json=payload) as resp:
+            if resp.status >= 500:
+                raise TransientServerError(
+                    f"{path} -> HTTP {resp.status}", self.worker_id
+                )
             if resp.status != 200:
                 raise WorkerError(f"{path} -> HTTP {resp.status}", self.worker_id)
             return await resp.json()
 
     def poll_ready(self) -> bool:
+        async def attempt():
+            out = await self._post(
+                "/distributed/job_status",
+                {"job_id": self.job_id, "worker_id": self.worker_id},
+            )
+            if not out.get("ready"):
+                raise WorkerError(f"job {self.job_id} not ready", self.worker_id)
+            return True
+
         async def poll():
-            for _ in range(JOB_READY_POLL_ATTEMPTS):
-                try:
-                    out = await self._post(
-                        "/distributed/job_status",
-                        {"job_id": self.job_id, "worker_id": self.worker_id},
-                    )
-                    if out.get("ready"):
-                        return True
-                except Exception:
-                    pass
-                await asyncio.sleep(JOB_READY_POLL_INTERVAL)
-            return False
+            try:
+                return await retry_async(
+                    attempt, poll_ready_policy(),
+                    label=f"poll_ready:{self.job_id}",
+                )
+            except Exception:  # noqa: BLE001 - not-ready maps to False
+                return False
 
         return run_async_in_server_loop(poll(), timeout=None)
 
     def request_tile(self) -> Optional[dict]:
-        """Pull next work item; None when drained. Retries with capped
-        backoff (reference worker_comms retry ×10, 30 s cap)."""
+        """Pull next work item; None when drained (or the master stayed
+        unreachable through the whole pull policy)."""
 
         async def pull():
-            delay = REQUEST_RETRY_BACKOFF
-            for attempt in range(WORK_PULL_RETRY_COUNT):
-                try:
-                    return await self._post(
+            try:
+                return await retry_async(
+                    lambda: self._post(
                         "/distributed/request_image",
                         {"job_id": self.job_id, "worker_id": self.worker_id},
-                    )
-                except Exception as exc:  # noqa: BLE001 - retried
-                    debug_log(f"request_tile retry {attempt}: {exc}")
-                    await asyncio.sleep(min(delay, WORK_PULL_RETRY_CAP_SECONDS))
-                    delay *= 2
-            return None
+                    ),
+                    work_pull_policy(),
+                    label=f"request_tile:{self.worker_id}",
+                )
+            except Exception as exc:  # noqa: BLE001 - exhausted retries
+                debug_log(f"request_tile gave up: {exc}")
+                return None
 
         out = run_async_in_server_loop(pull(), timeout=None)
         if out is None:
@@ -115,16 +132,27 @@ class HTTPWorkClient:
             return None
         return out
 
+    # Submits retry transport failures and 5xx answers only — a 4xx is
+    # the master's verdict (bad job id, malformed entry) and re-sending
+    # the same payload can't change it.
+    def _submit_retryable(self):
+        return transport_errors() + (TransientServerError,)
+
     def submit_tiles(self, entries: list[dict], is_final: bool) -> None:
         async def send():
-            await self._post(
-                "/distributed/submit_tiles",
-                {
-                    "job_id": self.job_id,
-                    "worker_id": self.worker_id,
-                    "tiles": entries,
-                    "is_final_flush": is_final,
-                },
+            await retry_async(
+                lambda: self._post(
+                    "/distributed/submit_tiles",
+                    {
+                        "job_id": self.job_id,
+                        "worker_id": self.worker_id,
+                        "tiles": entries,
+                        "is_final_flush": is_final,
+                    },
+                ),
+                http_policy(),
+                retryable=self._submit_retryable(),
+                label=f"submit_tiles:{self.worker_id}",
             )
 
         run_async_in_server_loop(send(), timeout=300)
@@ -133,15 +161,20 @@ class HTTPWorkClient:
         """Dynamic mode: push one whole processed frame."""
 
         async def send():
-            await self._post(
-                "/distributed/submit_image",
-                {
-                    "job_id": self.job_id,
-                    "worker_id": self.worker_id,
-                    "image_idx": image_idx,
-                    "image": data_url,
-                    "is_last": is_last,
-                },
+            await retry_async(
+                lambda: self._post(
+                    "/distributed/submit_image",
+                    {
+                        "job_id": self.job_id,
+                        "worker_id": self.worker_id,
+                        "image_idx": image_idx,
+                        "image": data_url,
+                        "is_last": is_last,
+                    },
+                ),
+                http_policy(),
+                retryable=self._submit_retryable(),
+                label=f"submit_image:{self.worker_id}",
             )
 
         run_async_in_server_loop(send(), timeout=300)
@@ -335,8 +368,16 @@ def run_master_elastic(
         store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
     )
     # HTTP-tier tiles arrive host-side; the native feathered-blend
-    # canvas avoids a device round-trip per tile
-    canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
+    # canvas avoids a device round-trip per tile. CDT_DETERMINISTIC_BLEND
+    # defers compositing to sorted tile order so the blended output is
+    # bit-identical regardless of which participant finished first
+    # (chaos tests assert fault-free vs fault-recovered runs equal).
+    import os as _os
+
+    if _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
+        canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
+    else:
+        canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 

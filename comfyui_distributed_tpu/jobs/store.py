@@ -5,12 +5,23 @@ Semantics from reference upscale/job_store.py + api/queue_orchestration.py:
   lazily by the first arriving result within a grace window — both
   orders happen in practice (the init race the reference guards with a
   10 s wait in job_complete, reference api/job_routes.py:314-333);
+  waiters block on a per-job future signalled at creation time (no
+  sleep-polling), bounded by the same grace deadline;
 - pulls pop one task id; completions are recorded idempotently
   (duplicate submissions from a requeued-then-recovered worker are
-  dropped);
+  dropped); an EMPTY pull still heartbeats — an idle worker draining
+  the tail must not be timed out for polling an empty queue;
 - timeout scanning snapshots under the lock but probes outside it
   (reference upscale/job_timeout.py:53-108), then requeues the
-  incomplete tasks of dead workers.
+  incomplete tasks of dead workers; a failed busy-probe gets one
+  retry before the worker is treated as dead;
+- the circuit breaker (resilience/health.py) calls
+  `requeue_worker_tasks` when a worker is quarantined, so its pulled
+  tiles go back to the queue without waiting for heartbeat staleness;
+- an optional `FaultInjector` (resilience/faults.py) wraps pull /
+  submit / heartbeat for deterministic chaos tests: `connect_error` /
+  `crash` faults raise (the RPC "never arrived"), `drop` on a
+  heartbeat op silently skips recording it.
 """
 
 from __future__ import annotations
@@ -25,10 +36,84 @@ from .models import CollectorJob, ImageJob, TileJob
 
 
 class JobStore:
-    def __init__(self) -> None:
+    def __init__(self, fault_injector: Any = None) -> None:
         self.lock = asyncio.Lock()
         self.collectors: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
+        self.fault_injector = fault_injector
+        # job_id → [(loop, future)] waiters parked until creation;
+        # woken via call_soon_threadsafe so waiters on OTHER loops
+        # (asyncio.run fallbacks on compute threads) wake safely.
+        self._collector_waiters: dict[str, list[tuple[Any, Any]]] = {}
+        self._tile_waiters: dict[str, list[tuple[Any, Any]]] = {}
+
+    # --- fault injection --------------------------------------------------
+
+    async def _fault(self, op: str, worker_id: str) -> None:
+        """Raise if the active fault plan targets `op` for this worker."""
+        if self.fault_injector is not None:
+            await self.fault_injector.check(f"store:{op}:{worker_id}")
+
+    def _heartbeat_dropped(self, worker_id: str) -> bool:
+        """True when a `drop@store:heartbeat:<id>` fault swallows this
+        heartbeat (the worker thinks it beat; the master never saw it)."""
+        if self.fault_injector is None:
+            return False
+        action = self.fault_injector.hit(f"store:heartbeat:{worker_id}")
+        return action is not None and action.kind == "drop"
+
+    def _record_heartbeat(self, job: TileJob, worker_id: str) -> None:
+        if not self._heartbeat_dropped(worker_id):
+            job.heartbeat(worker_id)
+
+    # --- creation signalling ----------------------------------------------
+
+    @staticmethod
+    def _wake(waiters: list[tuple[Any, Any]]) -> None:
+        """Resolve parked creation futures on their own loops."""
+
+        def resolve(fut):
+            if not fut.done():
+                fut.set_result(True)
+
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(resolve, fut)
+            except RuntimeError:
+                pass  # waiter's loop already closed; its wait timed out
+
+    async def _park_until_created(
+        self,
+        waiters: dict[str, list[tuple[Any, Any]]],
+        registry: dict[str, Any],
+        job_id: str,
+        grace_seconds: float,
+    ) -> Optional[Any]:
+        """Return registry[job_id] as soon as it exists, parking on the
+        creation signal up to `grace_seconds`; None if still absent at
+        the deadline. The shared body of wait_for_collector /
+        wait_for_tile_job."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        async with self.lock:
+            job = registry.get(job_id)
+            if job is not None or grace_seconds <= 0:
+                return job
+            waiters.setdefault(job_id, []).append((loop, fut))
+        try:
+            try:
+                await asyncio.wait_for(fut, grace_seconds)
+            except asyncio.TimeoutError:
+                pass
+        finally:
+            async with self.lock:
+                pending = waiters.get(job_id)
+                if pending is not None and (loop, fut) in pending:
+                    pending.remove((loop, fut))
+                    if not pending:
+                        del waiters[job_id]
+        async with self.lock:
+            return registry.get(job_id)
 
     # --- collector jobs ---------------------------------------------------
 
@@ -38,6 +123,7 @@ class JobStore:
             if job is None:
                 job = CollectorJob(job_id=job_id)
                 self.collectors[job_id] = job
+                self._wake(self._collector_waiters.pop(job_id, []))
             return job
 
     async def wait_for_collector(
@@ -45,16 +131,14 @@ class JobStore:
     ) -> Optional[CollectorJob]:
         """Result-submission side: wait up to grace for the queue to be
         created by orchestration; create it ourselves at deadline (the
-        master may still be validating its own prompt)."""
-        deadline = time.monotonic() + grace_seconds
-        while True:
-            async with self.lock:
-                job = self.collectors.get(job_id)
-            if job is not None:
-                return job
-            if time.monotonic() >= deadline:
-                return await self.ensure_collector(job_id)
-            await asyncio.sleep(0.1)
+        master may still be validating its own prompt). Blocks on the
+        creation signal, not a poll loop."""
+        job = await self._park_until_created(
+            self._collector_waiters, self.collectors, job_id, grace_seconds
+        )
+        if job is not None:
+            return job
+        return await self.ensure_collector(job_id)
 
     async def put_collector_result(self, job_id: str, item: dict[str, Any]) -> None:
         job = await self.ensure_collector(job_id)
@@ -82,6 +166,7 @@ class JobStore:
             for tid in task_ids:
                 job.pending.put_nowait(tid)
             self.tile_jobs[job_id] = job
+            self._wake(self._tile_waiters.pop(job_id, []))
             return job
 
     async def get_tile_job(self, job_id: str) -> Optional[TileJob]:
@@ -91,29 +176,32 @@ class JobStore:
     async def wait_for_tile_job(
         self, job_id: str, grace_seconds: float
     ) -> Optional[TileJob]:
-        deadline = time.monotonic() + grace_seconds
-        while True:
-            job = await self.get_tile_job(job_id)
-            if job is not None:
-                return job
-            if time.monotonic() >= deadline:
-                return None
-            await asyncio.sleep(0.1)
+        """Wait for the master to create the job, bounded by grace.
+        Event-signalled (no 0.1 s poll loop): init_tile_job resolves
+        parked waiters the moment the job exists."""
+        return await self._park_until_created(
+            self._tile_waiters, self.tile_jobs, job_id, grace_seconds
+        )
 
     async def pull_task(
         self, job_id: str, worker_id: str, timeout: float = 0.1
     ) -> Optional[int]:
         """Pop the next pending task id for a worker (None = drained).
-        Records assignment + heartbeat for requeue bookkeeping."""
+        Records assignment + heartbeat for requeue bookkeeping. An
+        empty pull ALSO heartbeats: a worker draining the queue tail
+        is alive, and timing it out would requeue its in-flight task."""
+        await self._fault("pull", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
         try:
             task_id = await asyncio.wait_for(job.pending.get(), timeout)
         except asyncio.TimeoutError:
+            async with self.lock:
+                self._record_heartbeat(job, worker_id)
             return None
         async with self.lock:
-            job.heartbeat(worker_id)
+            self._record_heartbeat(job, worker_id)
             job.assigned.setdefault(worker_id, set()).add(task_id)
         return task_id
 
@@ -121,11 +209,12 @@ class JobStore:
         self, job_id: str, worker_id: str, task_id: int, payload: Any
     ) -> bool:
         """Record one completed task; False if duplicate (already done)."""
+        await self._fault("submit", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
         async with self.lock:
-            job.heartbeat(worker_id)
+            self._record_heartbeat(job, worker_id)
             job.assigned.get(worker_id, set()).discard(task_id)
             if task_id in job.completed:
                 debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
@@ -146,7 +235,7 @@ class JobStore:
         if job is None:
             return False
         async with self.lock:
-            job.heartbeat(worker_id)
+            self._record_heartbeat(job, worker_id)
         return True
 
     async def remaining(self, job_id: str) -> int:
@@ -180,6 +269,8 @@ class JobStore:
         lock (a worker mid-sample can't heartbeat — if the probe says
         it's busy, refresh its heartbeat instead of requeueing: the
         reference's busy-probe grace, upscale/job_timeout.py:82-104).
+        A probe that raises is retried once — one transient probe
+        failure must not requeue a live worker's in-flight tiles.
         """
         job = await self.get_tile_job(job_id)
         if job is None:
@@ -197,23 +288,52 @@ class JobStore:
         for wid in stale:
             busy = False
             if probe_busy is not None:
-                try:
-                    busy = await probe_busy(wid)
-                except Exception:
-                    busy = False
+                for attempt in range(2):
+                    try:
+                        busy = await probe_busy(wid)
+                        break
+                    except Exception as exc:  # noqa: BLE001 - probe best effort
+                        busy = False
+                        log(
+                            f"busy-probe for stale worker {wid} failed "
+                            f"(attempt {attempt + 1}/2): {exc}"
+                        )
             async with self.lock:
                 if busy:
                     job.heartbeat(wid)
                     debug_log(f"worker {wid} busy on probe; heartbeat grace")
                     continue
-                tasks = job.assigned.pop(wid, set())
-                incomplete = [t for t in tasks if t not in job.completed]
-                for tid in incomplete:
-                    job.pending.put_nowait(tid)
-                requeued.extend(incomplete)
-                if incomplete:
-                    log(
-                        f"requeued {len(incomplete)} task(s) from timed-out "
-                        f"worker {wid} on job {job_id}"
-                    )
+                requeued.extend(self._requeue_worker_locked(job, wid))
         return requeued
+
+    def _requeue_worker_locked(self, job: TileJob, worker_id: str) -> list[int]:
+        """Put a worker's incomplete assigned tasks back on the queue.
+        Caller holds self.lock."""
+        tasks = job.assigned.pop(worker_id, set())
+        incomplete = sorted(t for t in tasks if t not in job.completed)
+        for tid in incomplete:
+            job.pending.put_nowait(tid)
+        if incomplete:
+            log(
+                f"requeued {len(incomplete)} task(s) from "
+                f"worker {worker_id} on job {job.job_id}"
+            )
+        return incomplete
+
+    async def requeue_worker_tasks(
+        self, worker_id: str, job_id: str | None = None
+    ) -> dict[str, list[int]]:
+        """Requeue a worker's incomplete tasks immediately (no staleness
+        check) — the circuit breaker's quarantine path. Returns
+        {job_id: [task ids]} for every affected job."""
+        out: dict[str, list[int]] = {}
+        async with self.lock:
+            if job_id is not None:
+                jobs = [j] if (j := self.tile_jobs.get(job_id)) else []
+            else:
+                jobs = list(self.tile_jobs.values())
+            for job in jobs:
+                incomplete = self._requeue_worker_locked(job, worker_id)
+                if incomplete:
+                    out[job.job_id] = incomplete
+        return out

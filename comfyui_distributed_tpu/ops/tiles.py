@@ -375,6 +375,42 @@ class HostIncrementalCanvas:
         )
 
 
+class DeterministicHostCanvas:
+    """Order-canonical twin of HostIncrementalCanvas.
+
+    Sequential feathered lerp is order-dependent where tiles overlap,
+    and in the elastic tier the blend order follows result ARRIVAL
+    order — a race. This canvas buffers every tile and composites in
+    sorted (y, x) order at `result()`, so two runs that produced
+    identical per-tile outputs produce bit-identical images no matter
+    which participant finished which tile first (the property the
+    chaos tests assert across fault-free and fault-recovered runs).
+    Costs one decoded tile set of host memory; enabled per-run via
+    CDT_DETERMINISTIC_BLEND=1.
+    """
+
+    def __init__(self, images: jax.Array, grid: TileGrid):
+        import numpy as np
+
+        self.grid = grid
+        self._base = images
+        self._tiles: dict[tuple[int, int], "np.ndarray"] = {}
+
+    def blend(self, tile, y, x) -> None:
+        import numpy as np
+
+        # (y, x) is unique per tile in the grid, so the dict also
+        # deduplicates a tile blended twice (last write wins, and
+        # identical payloads make the choice immaterial).
+        self._tiles[(int(y), int(x))] = np.asarray(tile, dtype=np.float32)
+
+    def result(self) -> jax.Array:
+        inner = HostIncrementalCanvas(self._base, self.grid)
+        for (y, x), tile in sorted(self._tiles.items()):
+            inner.blend(tile, y, x)
+        return inner.result()
+
+
 def blend_single_tile(
     canvas: jax.Array, tile: jax.Array, y: int, x: int, grid: TileGrid
 ) -> jax.Array:

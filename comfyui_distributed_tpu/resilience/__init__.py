@@ -1,0 +1,102 @@
+"""Resilience core: unified retry/backoff, worker health circuit
+breaker, deterministic fault injection.
+
+- `policy`: RetryPolicy + retry_async — the single backoff engine for
+  every cross-host RPC (dispatch, media sync, USDU work pulls).
+- `health`: per-worker state machine (healthy → suspect → quarantined
+  → probing → recovered) consulted by worker selection/dispatch.
+- `faults`: seeded FaultInjector scripted via CDT_FAULT_PLAN; wraps
+  the HTTP transport and the JobStore for deterministic chaos tests.
+- `chaos`: in-process master/worker USDU harness that runs under a
+  fault plan and checks bit-identical output against a fault-free run.
+
+See docs/resilience.md for the operator-facing story.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..utils.logging import debug_log, log
+from .faults import (
+    FaultInjected,
+    FaultInjector,
+    get_fault_injector,
+    reset_fault_injector,
+    set_fault_injector,
+)
+from .health import (
+    HealthRegistry,
+    WorkerState,
+    get_health_registry,
+    reset_health_registry,
+)
+from .policy import RetryPolicy, http_policy, retry_async, work_pull_policy
+
+__all__ = [
+    "FaultInjected",
+    "FaultInjector",
+    "HealthRegistry",
+    "RetryPolicy",
+    "WorkerState",
+    "bind_quarantine_requeue",
+    "get_fault_injector",
+    "get_health_registry",
+    "http_policy",
+    "reset_fault_injector",
+    "reset_health_registry",
+    "retry_async",
+    "set_fault_injector",
+    "work_pull_policy",
+]
+
+
+def bind_quarantine_requeue(registry: HealthRegistry, store) -> Callable[[], None]:
+    """Wire the circuit breaker to the JobStore: the moment a worker is
+    quarantined, its in-flight tiles across every active job go back
+    on the pending queue (no waiting for heartbeat staleness).
+
+    Returns an unbind callable (the server calls it on shutdown so a
+    dead server's store isn't kept alive by the global registry).
+    """
+
+    # Strong references to in-flight requeue tasks: the loop only keeps
+    # a weak ref to a Task, so a fire-and-forget create_task can be
+    # garbage-collected before it runs.
+    pending_tasks: set = set()
+
+    def on_transition(worker_id: str, old: WorkerState, new: WorkerState) -> None:
+        if new is not WorkerState.QUARANTINED:
+            return
+
+        async def requeue() -> None:
+            moved = await store.requeue_worker_tasks(worker_id)
+            if moved:
+                log(
+                    f"quarantine of {worker_id}: requeued "
+                    + ", ".join(f"{len(v)} task(s) of job {k}" for k, v in moved.items())
+                )
+
+        def done(task) -> None:
+            pending_tasks.discard(task)
+            exc = task.exception() if not task.cancelled() else None
+            if exc is not None:
+                debug_log(f"quarantine requeue for {worker_id} failed: {exc}")
+
+        try:
+            task = asyncio.get_running_loop().create_task(requeue())
+            pending_tasks.add(task)
+            task.add_done_callback(done)
+        except RuntimeError:
+            # Not on a loop (compute thread): hop to the server loop,
+            # falling back to a transient one.
+            from ..utils.async_helpers import run_async_in_server_loop
+
+            try:
+                run_async_in_server_loop(requeue(), timeout=30)
+            except Exception as exc:  # noqa: BLE001 - requeue best effort
+                debug_log(f"quarantine requeue for {worker_id} failed: {exc}")
+
+    registry.add_listener(on_transition)
+    return lambda: registry.remove_listener(on_transition)

@@ -1,0 +1,232 @@
+"""In-process chaos harness: the elastic USDU master/worker loop under
+a scripted fault plan, CPU-only and hermetic (no sockets, no model).
+
+The harness runs `run_master_elastic` against worker THREADS that pull
+from the same JobStore — the production protocol shape (the reference's
+fake-comms test pattern) — while a seeded `FaultInjector` kills
+workers mid-tile, injects latency, or drops heartbeats on a scripted
+schedule. The assertion chaos tests make is strong: the blended output
+of a faulted run is BIT-IDENTICAL to the fault-free run.
+
+Two properties make that possible:
+
+1. determinism of the work itself — per-tile noise keys fold the
+   global tile index, so a requeued tile reproduces exactly no matter
+   which participant re-runs it. The harness stubs the diffusion
+   processor with a cheap deterministic op whose outputs are exact
+   multiples of 1/255, so the PNG uint8 envelope worker tiles travel
+   in is lossless and master-local vs worker-computed tiles are
+   bit-equal;
+2. determinism of the blend — sequential feathered compositing is
+   order-dependent where tiles overlap, and arrival order is a race.
+   The harness enables CDT_DETERMINISTIC_BLEND (sorted-order deferred
+   compositing, ops/tiles.DeterministicHostCanvas) so the canvas is
+   insensitive to who finished first.
+
+Fault-plan op names exposed by the harness (see faults.py grammar):
+
+    chaos:<worker>:pull     before a worker's pull RPC
+    chaos:<worker>:pulled   after a successful pull (crash here =
+                            crash-after-pull: tile assigned, never
+                            submitted — the requeue path must cover it)
+    chaos:<worker>:submit   before a worker's submit RPC
+    store:heartbeat:<id>    JobStore heartbeat recording (drop = the
+                            master never sees the beat)
+    store:pull:<id> / store:submit:<id>   JobStore RPC surfaces
+
+Used by tests/test_chaos_usdu.py (tier-1, `-m chaos` selectable) and
+scripts/chaos_smoke.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import types
+from typing import Optional, Sequence
+from unittest import mock
+
+import numpy as np
+
+from ..utils.logging import debug_log
+from .faults import FaultAction, FaultInjected, FaultInjector
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    """Output image + what the injector actually did (tests assert the
+    scripted faults FIRED, so a passing run can't be vacuous)."""
+
+    output: np.ndarray
+    fired: list[FaultAction]
+    crashed_workers: list[str]
+
+    def fired_kinds(self) -> set[str]:
+        return {a.kind for a in self.fired}
+
+
+def _stub_process(params, tile, key, pos, neg, yx):
+    """Deterministic stand-in for the jitted VAE→sample→VAE tile
+    processor: tile content + keyed noise, snapped to the uint8 grid
+    (multiples of 1/255) so the PNG envelope is lossless and
+    master-local results are bit-equal to worker results."""
+    import jax
+    import jax.numpy as jnp
+
+    noisy = jnp.clip(tile + 0.05 * jax.random.normal(key, tile.shape), 0.0, 1.0)
+    return jnp.round(noisy * 255.0) / 255.0
+
+
+@contextlib.contextmanager
+def _ensure_server_loop():
+    """All JobStore asyncio state must live on ONE loop; start a
+    control-plane loop thread if the process doesn't have one."""
+    from ..utils.async_helpers import ServerLoopThread, get_server_loop
+
+    existing = get_server_loop()
+    if existing is not None and existing.is_running():
+        yield
+        return
+    thread = ServerLoopThread(name="cdt-chaos-loop")
+    thread.start()
+    try:
+        yield
+    finally:
+        thread.stop()
+
+
+def run_chaos_usdu(
+    seed: int = 0,
+    fault_plan: Optional[str] = None,
+    *,
+    workers: Sequence[str] = ("w1", "w2"),
+    image_hw: tuple[int, int] = (64, 64),
+    tile: int = 64,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    worker_timeout: float = 0.6,
+    job_id: str = "chaos-job",
+) -> ChaosResult:
+    """One in-process elastic USDU run under `fault_plan`; returns the
+    blended [B, H, W, C] image plus the faults that actually fired.
+    `fault_plan=None` is the fault-free reference run.
+
+    Worker threads start BEFORE the master and park on the JobStore's
+    creation signal (`wait_for_tile_job`), so they contend for tiles
+    from the first instant of the job — plans that slow the master's
+    pulls (`latency(..)@store:pull:master`) make worker participation
+    deterministic instead of a race the master usually wins.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..graph import ExecutionContext
+    from ..graph import usdu_elastic as elastic
+    from ..jobs import JobStore
+    from ..ops import upscale as upscale_ops
+    from ..utils import config as config_mod
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+    from ..utils.exceptions import JobQueueError
+
+    injector = FaultInjector(fault_plan) if fault_plan else None
+    store = JobStore(fault_injector=injector)
+    server = types.SimpleNamespace(job_store=store)
+    ctx = ExecutionContext(server=server, config={"workers": []})
+    bundle = types.SimpleNamespace(params=None)
+    crashed: list[str] = []
+
+    h, w = image_hw
+    image = jnp.asarray(
+        np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+
+    def worker_body(wid: str) -> None:
+        # Identical preprocessing to the master: per-tile determinism
+        # means the only thing identity changes is WHO computed a tile.
+        _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        key = jax.random.key(seed)
+        job = run_async_in_server_loop(
+            store.wait_for_tile_job(job_id, grace_seconds=20), timeout=30
+        )
+        if job is None:
+            return
+        try:
+            while True:
+                if injector is not None:
+                    injector.check_blocking(f"chaos:{wid}:pull")
+                tile_idx = run_async_in_server_loop(
+                    store.pull_task(job_id, wid, timeout=0.2), timeout=10
+                )
+                if tile_idx is None:
+                    break
+                if injector is not None:
+                    injector.check_blocking(f"chaos:{wid}:pulled")
+                tkey = jax.random.fold_in(key, tile_idx)
+                result = _stub_process(
+                    None, extracted[tile_idx], tkey, None, None, None
+                )
+                arr = img_utils.ensure_numpy(result)
+                payload = [
+                    {
+                        "batch_idx": i,
+                        "image": img_utils.encode_image_data_url(arr[i]),
+                    }
+                    for i in range(arr.shape[0])
+                ]
+                if injector is not None:
+                    injector.check_blocking(f"chaos:{wid}:submit")
+                run_async_in_server_loop(
+                    store.submit_result(job_id, wid, tile_idx, payload), timeout=10
+                )
+        except FaultInjected as exc:
+            # Simulated crash: the thread dies with a tile assigned and
+            # unsubmitted; the master's requeue path must recover it.
+            debug_log(f"chaos worker {wid} died: {exc}")
+            crashed.append(wid)
+        except JobQueueError:
+            pass  # master cleaned the job up while we were pulling
+
+    threads = [
+        threading.Thread(target=worker_body, args=(wid,), daemon=True)
+        for wid in workers
+    ]
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.object(
+                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+            )
+        )
+        stack.enter_context(
+            mock.patch.object(
+                config_mod, "get_worker_timeout_seconds",
+                lambda path=None: worker_timeout,
+            )
+        )
+        stack.enter_context(
+            mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
+        )
+        for t in threads:
+            t.start()
+        out = elastic.run_master_elastic(
+            bundle, image, pos, neg,
+            job_id=job_id,
+            enabled_worker_ids=list(workers),
+            upscale_by=upscale_by, tile=tile, padding=padding,
+            steps=1, sampler="euler", scheduler="karras",
+            cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+        )
+        for t in threads:
+            t.join(timeout=30)
+    return ChaosResult(
+        output=np.asarray(out),
+        fired=list(injector.fired) if injector is not None else [],
+        crashed_workers=crashed,
+    )

@@ -69,6 +69,15 @@ REQUEST_RETRY_BACKOFF = _env_float("CDT_REQUEST_BACKOFF", 0.5)
 WORK_PULL_RETRY_COUNT = _env_int("CDT_WORK_PULL_RETRIES", 10)
 WORK_PULL_RETRY_CAP_SECONDS = _env_float("CDT_WORK_PULL_RETRY_CAP", 30.0)
 
+# --- circuit breaker (resilience/health.py) -------------------------------
+# A worker becomes SUSPECT after this many consecutive transport
+# failures, QUARANTINED (circuit open: no dispatch, tiles requeued)
+# at the failure threshold, and is probed again (half-open) once the
+# cooldown elapses.
+CIRCUIT_SUSPECT_THRESHOLD = _env_int("CDT_CIRCUIT_SUSPECT_AFTER", 2)
+CIRCUIT_FAILURE_THRESHOLD = _env_int("CDT_CIRCUIT_FAILURES", 5)
+CIRCUIT_COOLDOWN_SECONDS = _env_float("CDT_CIRCUIT_COOLDOWN", 30.0)
+
 # --- job init races ------------------------------------------------------
 # Grace period a result-submission endpoint waits for the master-side queue
 # to be created (reference api/job_routes.py:314-333), and the worker-side

@@ -19,12 +19,24 @@ class WorkerError(DistributedError):
         self.worker_id = worker_id
 
 
+class TransientServerError(WorkerError):
+    """The peer answered 5xx: it's alive but momentarily failing —
+    worth retrying, unlike a 4xx rejection."""
+
+
 class WorkerTimeoutError(WorkerError):
     """A worker missed its heartbeat/response deadline."""
 
 
 class WorkerNotAvailableError(WorkerError):
-    """A worker could not be reached at dispatch/probe time."""
+    """A worker could not be used at dispatch/probe time (unreachable,
+    or it answered with a rejection)."""
+
+
+class WorkerUnreachableError(WorkerNotAvailableError):
+    """Transport-level failure: the request may never have arrived.
+    Only these count toward the circuit breaker — a worker that
+    ANSWERED (even with a rejection) is alive."""
 
 
 class JobQueueError(DistributedError):

@@ -33,23 +33,114 @@ _sessions_lock = threading.Lock()
 
 
 async def get_client_session() -> aiohttp.ClientSession:
-    """Shared pooled session for the current event loop."""
+    """Shared pooled session for the current event loop.
+
+    Under an active fault plan (CDT_FAULT_PLAN / an installed
+    injector) the session is wrapped so chaos tests can inject
+    connection errors, 5xx responses, and latency spikes at the
+    transport without touching call sites."""
     loop = asyncio.get_running_loop()
     with _sessions_lock:
         session = _sessions.get(loop)
-        if session is not None and not session.closed:
-            return session
-        connector = aiohttp.TCPConnector(
-            limit=CONNECTION_POOL_LIMIT, limit_per_host=CONNECTION_POOL_PER_HOST
+        if session is None or session.closed:
+            connector = aiohttp.TCPConnector(
+                limit=CONNECTION_POOL_LIMIT, limit_per_host=CONNECTION_POOL_PER_HOST
+            )
+            session = aiohttp.ClientSession(connector=connector)
+            _sessions[loop] = session
+            # Drop map entries for loops that are gone so the dict stays
+            # bounded; run_async_in_server_loop's fallback closes transient
+            # loops' sessions before their loop exits.
+            for stale in [l for l in _sessions if l.is_closed()]:
+                _sessions.pop(stale)
+    from ..resilience.faults import get_fault_injector
+
+    injector = get_fault_injector()
+    if injector is not None:
+        return FaultingClientSession(session, injector)
+    return session
+
+
+# --- fault-injecting transport wrapper ------------------------------------
+
+class _InjectedResponse:
+    """Minimal stand-in for an aiohttp response (injected http500/drop)."""
+
+    def __init__(self, status: int, url: str):
+        self.status = status
+        self.url = url
+
+    async def json(self) -> dict:
+        return {}
+
+    async def text(self) -> str:
+        return f"injected fault response ({self.status}) for {self.url}"
+
+    def release(self) -> None:
+        pass
+
+
+class _FaultingRequestContext:
+    """Async context manager around one request; consults the injector
+    with op `http:<METHOD>:<path>` before touching the network."""
+
+    def __init__(self, session, injector, method: str, url: str, kwargs: dict):
+        self._session = session
+        self._injector = injector
+        self._method = method
+        self._url = url
+        self._kwargs = kwargs
+        self._ctx = None
+
+    async def __aenter__(self):
+        from urllib.parse import urlsplit
+
+        path = urlsplit(str(self._url)).path or "/"
+        action = self._injector.hit(f"http:{self._method}:{path}")
+        if action is not None:
+            if action.kind == "latency":
+                await asyncio.sleep(action.arg or 0.0)
+            elif action.kind in ("connect_error", "crash"):
+                raise aiohttp.ClientConnectionError(
+                    f"injected {action.kind} at {path}"
+                )
+            elif action.kind == "http500":
+                return _InjectedResponse(500, str(self._url))
+            elif action.kind == "drop":
+                # Swallowed server-side: caller sees a generic OK with
+                # an empty body; the operation never happens.
+                return _InjectedResponse(200, str(self._url))
+        self._ctx = getattr(self._session, self._method.lower())(
+            self._url, **self._kwargs
         )
-        session = aiohttp.ClientSession(connector=connector)
-        _sessions[loop] = session
-        # Drop map entries for loops that are gone so the dict stays
-        # bounded; run_async_in_server_loop's fallback closes transient
-        # loops' sessions before their loop exits.
-        for stale in [l for l in _sessions if l.is_closed()]:
-            _sessions.pop(stale)
-        return session
+        return await self._ctx.__aenter__()
+
+    async def __aexit__(self, *exc_info):
+        if self._ctx is not None:
+            return await self._ctx.__aexit__(*exc_info)
+        return False
+
+
+class FaultingClientSession:
+    """Transparent proxy over the pooled ClientSession; GET/POST go
+    through the fault injector, everything else delegates."""
+
+    def __init__(self, session: aiohttp.ClientSession, injector):
+        self._session = session
+        self._injector = injector
+
+    def get(self, url, **kwargs):
+        return _FaultingRequestContext(
+            self._session, self._injector, "GET", url, kwargs
+        )
+
+    def post(self, url, **kwargs):
+        return _FaultingRequestContext(
+            self._session, self._injector, "POST", url, kwargs
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._session, name)
 
 
 async def close_client_session() -> None:

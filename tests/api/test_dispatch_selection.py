@@ -67,3 +67,172 @@ def test_least_busy_min_queue_when_none_idle(probe_map):
 def test_least_busy_none_when_all_offline(probe_map):
     workers = [_worker("a", "host-a")]
     assert asyncio.run(dispatch.select_least_busy_worker(workers)) is None
+
+
+# --- circuit breaker integration -----------------------------------------
+
+
+def test_five_connection_errors_quarantine_and_requeue(probe_map, monkeypatch):
+    """The acceptance scenario: 5 consecutive connection errors ->
+    quarantined (skipped without probing, dispatch refused, in-flight
+    tiles requeued) -> re-admitted after a successful half-open probe."""
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.resilience import bind_quarantine_requeue
+    from comfyui_distributed_tpu.resilience.health import (
+        HealthRegistry,
+        WorkerState,
+    )
+    from comfyui_distributed_tpu.utils.exceptions import WorkerNotAvailableError
+
+    now = [0.0]
+    registry = HealthRegistry(
+        failure_threshold=5, suspect_threshold=2, cooldown_seconds=30.0,
+        clock=lambda: now[0],
+    )
+    monkeypatch.setattr(dispatch, "get_health_registry", lambda: registry)
+    store = JobStore()
+    bind_quarantine_requeue(registry, store)
+
+    worker = _worker("flaky", "host-flaky")
+    probe_map["host-flaky"] = {"online": False, "queue_remaining": None}
+
+    async def scenario():
+        # the worker holds a tile when the breaker trips
+        await store.init_tile_job("job", [0, 1])
+        held = await store.pull_task("job", "flaky")
+
+        # 5 consecutive failed probes trip the breaker
+        for _ in range(5):
+            assert await dispatch.select_active_workers([worker]) == []
+        assert registry.state("flaky") is WorkerState.QUARANTINED
+        await asyncio.sleep(0.01)  # quarantine listener requeues
+        assert await store.remaining("job") == 2  # held tile back in queue
+
+        # quarantined: selection doesn't even probe, dispatch refuses
+        probes_before = len(probe_calls)
+        assert await dispatch.select_active_workers([worker]) == []
+        assert len(probe_calls) == probes_before  # no probe issued
+        try:
+            await dispatch.dispatch_worker_prompt(
+                worker, {}, "p1", use_websocket=False
+            )
+            raise AssertionError("dispatch to quarantined worker must fail")
+        except WorkerNotAvailableError:
+            pass
+
+        # cooldown elapses, the worker comes back: half-open probe
+        # succeeds and the worker is re-admitted
+        now[0] = 31.0
+        probe_map["host-flaky"] = {"online": True, "queue_remaining": 0}
+        active = await dispatch.select_active_workers([worker])
+        assert [w["id"] for w in active] == ["flaky"]
+        assert registry.state("flaky") is WorkerState.RECOVERED
+        assert registry.allow("flaky")
+        return held
+
+    # count actual probe calls to prove quarantined workers are skipped
+    probe_calls = []
+    inner_probe = dispatch.probe_worker
+
+    async def counting_probe(url_base, timeout=None):
+        probe_calls.append(url_base)
+        return await inner_probe(url_base)
+
+    monkeypatch.setattr(dispatch, "probe_worker", counting_probe)
+    held = asyncio.run(scenario())
+    assert held == 0
+
+
+def test_rejection_answers_do_not_trip_breaker(probe_map, monkeypatch):
+    """A worker that ANSWERS with a rejection (HTTP error status) is
+    alive: the rejection propagates but must not count toward the
+    circuit breaker, and the breaker chain resets."""
+    from comfyui_distributed_tpu.resilience.health import (
+        HealthRegistry,
+        WorkerState,
+    )
+    from comfyui_distributed_tpu.utils.exceptions import WorkerNotAvailableError
+
+    registry = HealthRegistry(
+        failure_threshold=3, suspect_threshold=2, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(dispatch, "get_health_registry", lambda: registry)
+
+    async def rejecting_http(worker, prompt, prompt_id, extra_data):
+        raise WorkerNotAvailableError("HTTP 400 bad prompt", worker.get("id"))
+
+    monkeypatch.setattr(dispatch, "_dispatch_http", rejecting_http)
+    worker = _worker("picky", "host-picky")
+
+    async def scenario():
+        for _ in range(5):
+            with pytest.raises(WorkerNotAvailableError):
+                await dispatch.dispatch_worker_prompt(
+                    worker, {}, "p", use_websocket=False
+                )
+        assert registry.state("picky") is WorkerState.HEALTHY
+        assert registry.allow("picky")
+
+    asyncio.run(scenario())
+
+
+def test_ws_rejection_is_not_resent_over_http(probe_map, monkeypatch):
+    """A dispatch_ack {ok:false} is the worker's answer: the prompt
+    must NOT be re-sent over HTTP, and the breaker does not count it."""
+    from comfyui_distributed_tpu.resilience.health import (
+        HealthRegistry,
+        WorkerState,
+    )
+    from comfyui_distributed_tpu.utils.exceptions import (
+        WorkerNotAvailableError,
+        WorkerUnreachableError,
+    )
+
+    registry = HealthRegistry(
+        failure_threshold=3, suspect_threshold=2, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(dispatch, "get_health_registry", lambda: registry)
+
+    http_calls = []
+
+    async def rejecting_ws(worker, prompt, prompt_id, extra_data):
+        raise WorkerNotAvailableError("worker rejected prompt: bad graph", "r")
+
+    async def recording_http(worker, prompt, prompt_id, extra_data):
+        http_calls.append(prompt_id)
+
+    monkeypatch.setattr(dispatch, "_dispatch_ws", rejecting_ws)
+    monkeypatch.setattr(dispatch, "_dispatch_http", recording_http)
+    worker = _worker("r", "host-r")
+
+    async def scenario():
+        with pytest.raises(WorkerNotAvailableError):
+            await dispatch.dispatch_worker_prompt(worker, {}, "p1", use_websocket=True)
+        assert http_calls == []  # rejection never re-sent
+        assert registry.state("r") is WorkerState.HEALTHY
+
+        # by contrast, an UNREACHABLE WS path does fall back to HTTP
+        async def unreachable_ws(worker, prompt, prompt_id, extra_data):
+            raise WorkerUnreachableError("no dispatch_ack received", "r")
+
+        monkeypatch.setattr(dispatch, "_dispatch_ws", unreachable_ws)
+        await dispatch.dispatch_worker_prompt(worker, {}, "p2", use_websocket=True)
+        assert http_calls == ["p2"]
+
+    asyncio.run(scenario())
+
+
+def test_least_busy_excludes_quarantined(probe_map, monkeypatch):
+    from comfyui_distributed_tpu.resilience.health import HealthRegistry
+
+    registry = HealthRegistry(
+        failure_threshold=2, suspect_threshold=1, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(dispatch, "get_health_registry", lambda: registry)
+    registry.record_failure("a")
+    registry.record_failure("a")  # quarantined
+    probe_map["host-a"] = {"online": True, "queue_remaining": 0}
+    probe_map["host-b"] = {"online": True, "queue_remaining": 3}
+    workers = [_worker("a", "host-a"), _worker("b", "host-b")]
+    pick = asyncio.run(dispatch.select_least_busy_worker(workers))
+    assert pick["id"] == "b"  # idle 'a' is invisible while quarantined

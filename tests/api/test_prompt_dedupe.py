@@ -1,0 +1,22 @@
+"""queue_prompt idempotence: a retried dispatch whose first delivery
+landed (or a WS delivery followed by the HTTP fallback) must not
+execute the same prompt twice."""
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+
+
+def test_queue_prompt_dedupes_by_prompt_id(tmp_config_path):
+    server = DistributedServer(port=0, is_worker=True)
+    prompt = {
+        "1": {
+            "class_type": "EmptyLatentImage",
+            "inputs": {"width": 32, "height": 32, "batch_size": 1},
+        }
+    }
+    first = server.queue_prompt(prompt, "dup-1")
+    again = server.queue_prompt(prompt, "dup-1")
+    assert again is first
+    assert server._prompt_queue.qsize() == 1  # enqueued exactly once
+    other = server.queue_prompt(prompt, "dup-2")
+    assert other is not first
+    assert server._prompt_queue.qsize() == 2

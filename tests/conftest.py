@@ -84,6 +84,17 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.integration)
 
 
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """The circuit-breaker registry and the fault-injector override are
+    process-global; isolate tests from each other's failure history."""
+    yield
+    from comfyui_distributed_tpu.resilience import faults, health
+
+    health.reset_health_registry()
+    faults.reset_fault_injector()
+
+
 @pytest.fixture()
 def server_loop():
     """A real control-plane loop thread (production shape): asyncio

@@ -1,0 +1,98 @@
+"""Deterministic chaos scenarios over the in-process elastic USDU loop.
+
+Each scenario runs master + worker threads against the real JobStore
+protocol under a scripted fault plan and asserts the blended output is
+BIT-IDENTICAL to the fault-free run (per-tile noise keys fold the
+global tile index, so a requeued tile reproduces exactly; see
+resilience/chaos.py for the two determinism preconditions).
+
+These are tier-1 tests: CPU-only, stubbed diffusion, a few seconds
+each. `pytest -m chaos` selects just this family.
+"""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+pytestmark = pytest.mark.chaos
+
+# Master pulls are slowed so worker threads deterministically win tiles
+# before the master drains the queue — without it the in-process master
+# usually finishes everything first and the fault never fires.
+SLOW_MASTER = "latency(0.15)@store:pull:master#1-3"
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    result = run_chaos_usdu(seed=11)
+    assert result.output.shape == (1, 128, 128, 3)
+    assert np.isfinite(result.output).all()
+    return result.output
+
+
+def test_fault_free_run_is_reproducible(baseline):
+    again = run_chaos_usdu(seed=11)
+    np.testing.assert_array_equal(baseline, again.output)
+
+
+def test_worker_crash_after_pull_recovers_bit_identical(baseline):
+    """The acceptance scenario: a worker dies right after pulling a
+    tile; the heartbeat-timeout requeue completes the upscale and the
+    output matches the fault-free run bit for bit."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+    )
+    assert "w1" in result.crashed_workers  # the fault actually fired
+    assert "crash" in result.fired_kinds()
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_both_workers_crash_master_covers_everything(baseline):
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};"
+            "crash@chaos:w1:pulled#1;crash@chaos:w2:pulled#1"
+        ),
+    )
+    assert set(result.crashed_workers) == {"w1", "w2"}
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_dropped_heartbeats_cause_requeue_and_duplicate_drop(baseline):
+    """Worker w1 stays alive but ALL its heartbeats are swallowed: the
+    master times it out and requeues; w1's late submissions are dropped
+    as duplicates. Output still bit-identical."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};"
+            "drop@store:heartbeat:w1#*;latency(0.8)@chaos:w1:submit#1"
+        ),
+        worker_timeout=0.4,
+    )
+    assert "drop" in result.fired_kinds()
+    assert result.crashed_workers == []  # alive, just invisible
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_latency_spikes_do_not_change_output(baseline):
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan="seed=11;latency(0.2)@chaos:w2:pull#1-2;latency(0.1)@store:pull:master#1",
+    )
+    assert "latency" in result.fired_kinds()
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_store_level_connection_errors_kill_worker_but_not_job(baseline):
+    """A connection error at w2's pull RPC takes that worker out (the
+    harness treats any injected transport error as fatal to the
+    thread); the job still completes identically via the survivors."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};connect_error@chaos:w2:pull#2",
+    )
+    np.testing.assert_array_equal(baseline, result.output)

@@ -116,3 +116,194 @@ def test_completed_tasks_not_requeued():
         assert requeued == [t1]  # completed t0 stays done
 
     run(scenario())
+
+
+def test_empty_pull_still_heartbeats():
+    """An idle worker draining the queue tail must not be timed out:
+    polling an EMPTY queue is proof of life."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0])
+        t0 = await store.pull_task("t", "w")
+        job = await store.get_tile_job("t")
+        # heartbeat goes stale while the worker computes...
+        job.worker_status["w"] = time.monotonic() - 100
+        # ...but it polls the (now empty) queue: that must refresh it
+        assert await store.pull_task("t", "w", timeout=0.02) is None
+        assert time.monotonic() - job.worker_status["w"] < 1.0
+        assert await store.requeue_timed_out("t", 1.0, None) == []
+        assert t0 in job.assigned["w"]
+
+    run(scenario())
+
+
+def test_probe_exception_gets_one_retry():
+    """A raising busy-probe is retried once; only after both attempts
+    fail is the worker treated as dead."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1])
+        t0 = await store.pull_task("t", "flaky-w")
+        job = await store.get_tile_job("t")
+        job.worker_status["flaky-w"] = time.monotonic() - 100
+
+        calls = []
+
+        async def probe_flaky_then_busy(worker_id):
+            calls.append(worker_id)
+            if len(calls) == 1:
+                raise ConnectionError("probe transport hiccup")
+            return True  # second attempt: actually busy
+
+        assert await store.requeue_timed_out("t", 1.0, probe_flaky_then_busy) == []
+        assert len(calls) == 2  # retried
+        assert t0 in job.assigned["flaky-w"]  # grace kept the assignment
+
+        # both attempts raise -> treated as dead, task requeued
+        job.worker_status["flaky-w"] = time.monotonic() - 100
+
+        async def probe_always_raises(worker_id):
+            raise ConnectionError("probe down")
+
+        assert await store.requeue_timed_out("t", 1.0, probe_always_raises) == [t0]
+
+    run(scenario())
+
+
+def test_wait_for_tile_job_wakes_on_creation_signal():
+    """The event-based wait returns as soon as init happens — far
+    before the grace deadline (no 0.1 s poll quantization)."""
+    store = JobStore()
+
+    async def scenario():
+        async def create_later():
+            await asyncio.sleep(0.05)
+            await store.init_tile_job("j", [0])
+
+        task = asyncio.get_running_loop().create_task(create_later())
+        start = time.monotonic()
+        job = await store.wait_for_tile_job("j", grace_seconds=5.0)
+        elapsed = time.monotonic() - start
+        await task
+        assert job is not None
+        assert elapsed < 1.0  # woke on the signal, not the deadline
+        # waiter bookkeeping cleaned up
+        assert store._tile_waiters == {}
+
+    run(scenario())
+
+
+def test_wait_for_tile_job_times_out_to_none():
+    store = JobStore()
+
+    async def scenario():
+        start = time.monotonic()
+        job = await store.wait_for_tile_job("ghost", grace_seconds=0.05)
+        assert job is None
+        assert time.monotonic() - start < 2.0
+        assert store._tile_waiters == {}
+
+    run(scenario())
+
+
+def test_wait_for_collector_wakes_on_creation_signal():
+    store = JobStore()
+
+    async def scenario():
+        async def create_later():
+            await asyncio.sleep(0.05)
+            await store.ensure_collector("c")
+
+        task = asyncio.get_running_loop().create_task(create_later())
+        start = time.monotonic()
+        job = await store.wait_for_collector("c", grace_seconds=5.0)
+        await task
+        assert job is not None
+        assert time.monotonic() - start < 1.0
+        assert store._collector_waiters == {}
+
+    run(scenario())
+
+
+def test_requeue_then_duplicate_late_submit_dropped():
+    """End-to-end requeue path: stale heartbeat -> busy-probe says dead
+    -> tasks requeued -> another worker completes them -> the original
+    worker's LATE submission is dropped as a duplicate."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1, 2])
+        t0 = await store.pull_task("t", "zombie")
+        job = await store.get_tile_job("t")
+        job.worker_status["zombie"] = time.monotonic() - 100
+
+        async def probe(worker_id):
+            return False  # not busy: really dead
+
+        assert await store.requeue_timed_out("t", 1.0, probe) == [t0]
+
+        # a healthy worker drains the queue (the requeued task is at
+        # the back of the FIFO) and completes the zombie's tile
+        claimed = None
+        while claimed != t0:
+            claimed = await store.pull_task("t", "healthy")
+            assert claimed is not None
+        assert await store.submit_result("t", "healthy", t0, "good") is True
+
+        # zombie comes back from the dead and submits its stale result
+        assert await store.submit_result("t", "zombie", t0, "stale") is False
+        assert job.completed[t0] == "good"  # first write wins
+        # the duplicate didn't double-enqueue a result payload
+        assert job.results.qsize() == 1
+
+    run(scenario())
+
+
+def test_requeue_worker_tasks_across_jobs():
+    """The circuit breaker's quarantine hook: all of a worker's
+    in-flight tasks across every job go back to pending at once."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("a", [0, 1])
+        await store.init_tile_job("b", [0])
+        ta = await store.pull_task("a", "w")
+        tb = await store.pull_task("b", "w")
+        moved = await store.requeue_worker_tasks("w")
+        assert moved == {"a": [ta], "b": [tb]}
+        assert await store.remaining("a") == 2
+        assert await store.remaining("b") == 1
+        # idempotent: nothing assigned any more
+        assert await store.requeue_worker_tasks("w") == {}
+
+    run(scenario())
+
+
+def test_store_fault_injection_drop_and_crash():
+    """JobStore honors a fault plan: dropped heartbeats are never
+    recorded; a crash fault surfaces as an exception at the RPC."""
+    from comfyui_distributed_tpu.resilience.faults import (
+        FaultInjected,
+        FaultInjector,
+    )
+
+    store = JobStore(
+        fault_injector=FaultInjector(
+            "drop@store:heartbeat:wdrop#*;crash@store:pull:wdead#1"
+        )
+    )
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1])
+        await store.pull_task("t", "wdrop")
+        job = await store.get_tile_job("t")
+        assert "wdrop" not in job.worker_status  # heartbeat swallowed
+        with pytest.raises(FaultInjected):
+            await store.pull_task("t", "wdead")
+        # fault consumed; next pull works and heartbeats normally
+        assert await store.pull_task("t", "wdead") == 1
+        assert "wdead" in job.worker_status
+
+    run(scenario())

@@ -1,0 +1,133 @@
+"""Fault plan grammar + injector determinism + the transport wrap."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.resilience.faults import (
+    FaultInjected,
+    FaultInjector,
+    FaultPlanError,
+    get_fault_injector,
+    parse_fault_plan,
+    reset_fault_injector,
+    set_fault_injector,
+)
+
+
+def test_parse_plan_rules():
+    seed, rules = parse_fault_plan(
+        "seed=42;crash@chaos:w1:pulled#2;latency(0.5)@heartbeat#1-3,7;"
+        "drop@store:heartbeat:w2#*;connect_error@request_image%0.25"
+    )
+    assert seed == 42
+    assert [r.kind for r in rules] == ["crash", "latency", "drop", "connect_error"]
+    assert rules[0].occurrences == frozenset({2})
+    assert rules[1].arg == 0.5
+    assert rules[1].occurrences == frozenset({1, 2, 3, 7})
+    assert rules[2].all_matches
+    assert rules[3].probability == 0.25
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "explode@foo#1",          # unknown fault kind
+        "crash@",                  # empty pattern
+        "crash",                   # no pattern at all
+        "seed=abc",                # bad seed
+        "latency(x)@foo",          # bad arg
+        "crash@foo#1-x",           # bad range
+    ],
+)
+def test_parse_rejects_bad_plans(bad):
+    with pytest.raises(FaultPlanError):
+        parse_fault_plan(bad)
+
+
+def test_occurrence_schedule_counts_per_rule():
+    inj = FaultInjector("crash@op:x#2,4")
+    hits = [inj.hit("op:x") for _ in range(5)]
+    assert [h.kind if h else None for h in hits] == [
+        None, "crash", None, "crash", None,
+    ]
+
+
+def test_default_schedule_fires_once():
+    inj = FaultInjector("connect_error@op:y")
+    assert inj.hit("op:y") is not None
+    assert inj.hit("op:y") is None
+
+
+def test_substring_and_glob_matching():
+    inj = FaultInjector("crash@request_image#*")
+    assert inj.hit("http:POST:/distributed/request_image") is not None
+    assert inj.hit("http:POST:/distributed/submit_tiles") is None
+    glob = FaultInjector("crash@http:*:/distributed/*#*")
+    assert glob.hit("http:GET:/distributed/job_status") is not None
+    assert glob.hit("store:pull:w1") is None
+
+
+def test_probabilistic_rules_are_seed_deterministic():
+    a = FaultInjector("seed=9;connect_error@op%0.5")
+    b = FaultInjector("seed=9;connect_error@op%0.5")
+    seq_a = [a.hit("op") is not None for _ in range(32)]
+    seq_b = [b.hit("op") is not None for _ in range(32)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)  # actually probabilistic
+
+
+def test_check_blocking_raises_for_error_kinds():
+    inj = FaultInjector("crash@site#1")
+    with pytest.raises(FaultInjected):
+        inj.check_blocking("site")
+    # occurrence consumed; next call passes
+    assert inj.check_blocking("site") is None
+
+
+def test_check_async_applies_latency_and_returns_drop():
+    async def scenario():
+        inj = FaultInjector("latency(0.01)@a#1;drop@b#1")
+        action = await inj.check("a")
+        assert action.kind == "latency"
+        action = await inj.check("b")
+        assert action.kind == "drop"  # returned, not raised
+
+    asyncio.run(scenario())
+
+
+def test_global_injector_env_roundtrip(monkeypatch):
+    reset_fault_injector()
+    monkeypatch.delenv("CDT_FAULT_PLAN", raising=False)
+    assert get_fault_injector() is None
+    monkeypatch.setenv("CDT_FAULT_PLAN", "crash@x#1")
+    inj = get_fault_injector()
+    assert inj is not None and inj.rules[0].kind == "crash"
+    assert get_fault_injector() is inj  # cached for the same plan
+    override = FaultInjector("drop@y#1")
+    set_fault_injector(override)
+    assert get_fault_injector() is override
+    reset_fault_injector()
+    monkeypatch.delenv("CDT_FAULT_PLAN", raising=False)
+    assert get_fault_injector() is None
+
+
+def test_transport_wrap_injects_connect_error_and_500(monkeypatch):
+    """probe_worker through the faulting session: first probe hits an
+    injected connection error, second an injected 500 — both map to
+    offline results instead of raising."""
+    from comfyui_distributed_tpu.utils import network
+
+    set_fault_injector(
+        FaultInjector("connect_error@http:GET:/prompt#1;http500@http:GET:/prompt#2")
+    )
+
+    async def scenario():
+        first = await network.probe_worker("http://127.0.0.1:9")
+        second = await network.probe_worker("http://127.0.0.1:9")
+        await network.close_client_session()  # transient loop hygiene
+        return first, second
+
+    first, second = asyncio.run(scenario())
+    assert first == {"online": False, "queue_remaining": None}
+    assert second == {"online": False, "queue_remaining": None}

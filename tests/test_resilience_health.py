@@ -1,0 +1,155 @@
+"""Circuit breaker state machine: healthy → suspect → quarantined →
+probing (half-open) → recovered, plus the quarantine-requeue binding."""
+
+import asyncio
+
+from comfyui_distributed_tpu.resilience import bind_quarantine_requeue
+from comfyui_distributed_tpu.resilience.health import (
+    HealthRegistry,
+    WorkerState,
+)
+
+
+def make_registry(now):
+    """Registry with an adjustable clock: now is a 1-element list."""
+    return HealthRegistry(
+        failure_threshold=5, suspect_threshold=2, cooldown_seconds=30.0,
+        clock=lambda: now[0],
+    )
+
+
+def test_failure_escalation_to_quarantine():
+    now = [0.0]
+    reg = make_registry(now)
+    assert reg.state("w") is WorkerState.HEALTHY
+    reg.record_failure("w")
+    assert reg.state("w") is WorkerState.HEALTHY  # 1 < suspect threshold
+    reg.record_failure("w")
+    assert reg.state("w") is WorkerState.SUSPECT
+    assert reg.allow("w")  # suspect still dispatchable
+    for _ in range(3):
+        reg.record_failure("w")
+    assert reg.state("w") is WorkerState.QUARANTINED  # 5th consecutive
+    assert not reg.allow("w")
+
+
+def test_success_resets_consecutive_count():
+    now = [0.0]
+    reg = make_registry(now)
+    for _ in range(4):
+        reg.record_failure("w")
+    reg.record_success("w")
+    assert reg.state("w") is WorkerState.HEALTHY
+    for _ in range(4):
+        reg.record_failure("w")
+    assert reg.state("w") is WorkerState.SUSPECT  # count restarted
+
+
+def test_half_open_probe_cycle():
+    now = [0.0]
+    reg = make_registry(now)
+    for _ in range(5):
+        reg.record_failure("w")
+    assert reg.state("w") is WorkerState.QUARANTINED
+
+    # cooldown not elapsed: no probe, still not dispatchable
+    assert not reg.try_half_open("w")
+    assert not reg.allow("w")
+
+    now[0] = 31.0
+    assert reg.try_half_open("w")
+    assert reg.state("w") is WorkerState.PROBING
+    # only ONE prober wins the half-open slot
+    assert not reg.try_half_open("w")
+    assert not reg.allow("w")  # probing workers get no prompts either
+
+    reg.record_success("w")
+    assert reg.state("w") is WorkerState.RECOVERED
+    assert reg.allow("w")
+    reg.record_success("w")
+    assert reg.state("w") is WorkerState.HEALTHY
+
+
+def test_failed_probe_reopens_with_fresh_cooldown():
+    now = [0.0]
+    reg = make_registry(now)
+    for _ in range(5):
+        reg.record_failure("w")
+    now[0] = 31.0
+    assert reg.try_half_open("w")
+    reg.record_failure("w")
+    assert reg.state("w") is WorkerState.QUARANTINED
+    # fresh cooldown from the failed probe, not the original trip
+    now[0] = 45.0
+    assert not reg.try_half_open("w")
+    now[0] = 62.0
+    assert reg.try_half_open("w")
+
+
+def test_stale_probe_lease_is_reclaimed():
+    """A prober cancelled between winning the half-open slot and
+    recording an outcome must not wedge the worker in PROBING: after
+    one cooldown the lease expires and another prober may claim it."""
+    now = [0.0]
+    reg = make_registry(now)
+    for _ in range(5):
+        reg.record_failure("w")
+    now[0] = 31.0
+    assert reg.try_half_open("w")  # prober wins... then is cancelled
+    assert not reg.try_half_open("w")  # lease held
+    now[0] = 62.0
+    assert reg.try_half_open("w")  # lease expired: reclaimed
+    reg.record_success("w")
+    assert reg.state("w") is WorkerState.RECOVERED
+
+
+def test_listeners_fire_on_transition_only():
+    now = [0.0]
+    reg = make_registry(now)
+    events = []
+    reg.add_listener(lambda wid, old, new: events.append((wid, old, new)))
+    reg.record_failure("w")  # healthy -> healthy: no event
+    reg.record_failure("w")  # healthy -> suspect
+    reg.record_failure("w")  # suspect -> suspect: no event
+    assert events == [("w", WorkerState.HEALTHY, WorkerState.SUSPECT)]
+
+
+def test_quarantine_requeues_inflight_tiles():
+    """The acceptance path: worker trips the breaker; its pulled tiles
+    go back on the queue without waiting for heartbeat staleness."""
+    from comfyui_distributed_tpu.jobs import JobStore
+
+    now = [0.0]
+    reg = make_registry(now)
+    store = JobStore()
+    unbind = bind_quarantine_requeue(reg, store)
+
+    async def scenario():
+        await store.init_tile_job("j", [0, 1, 2, 3])
+        t0 = await store.pull_task("j", "bad-w")
+        t1 = await store.pull_task("j", "bad-w")
+        assert await store.remaining("j") == 2
+        for _ in range(5):
+            reg.record_failure("bad-w")  # listener schedules the requeue
+        await asyncio.sleep(0.01)  # let the requeue task run
+        assert await store.remaining("j") == 4
+        job = await store.get_tile_job("j")
+        assert "bad-w" not in job.assigned
+        return t0, t1
+
+    t0, t1 = asyncio.run(scenario())
+    assert {t0, t1} == {0, 1}
+    unbind()
+    # after unbind, transitions no longer touch the store
+    reg.reset()
+
+
+def test_snapshot_shape():
+    now = [0.0]
+    reg = make_registry(now)
+    reg.record_failure("a")
+    reg.record_success("b")
+    snap = reg.snapshot()
+    assert snap["a"]["state"] == "healthy"
+    assert snap["a"]["consecutive_failures"] == 1
+    assert snap["b"]["total_successes"] == 1

@@ -1,0 +1,154 @@
+"""RetryPolicy / retry_async: backoff shape, jitter determinism,
+deadline budgets, and retryable-exception filtering."""
+
+import asyncio
+import random
+
+import pytest
+
+from comfyui_distributed_tpu.resilience.policy import (
+    RetryPolicy,
+    http_policy,
+    poll_ready_policy,
+    retry_async,
+    work_pull_policy,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_delays_exponential_and_capped():
+    policy = RetryPolicy(base_delay=1.0, multiplier=2.0, max_delay=5.0, jitter=0.0)
+    assert [policy.delay_for(a) for a in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_jitter_is_bounded_and_seed_deterministic():
+    policy = RetryPolicy(base_delay=1.0, multiplier=1.0, max_delay=1.0, jitter=0.25)
+    a = [policy.delay_for(0, random.Random(7)) for _ in range(10)]
+    b = [policy.delay_for(0, random.Random(7)) for _ in range(10)]
+    assert a == b  # same seed, same jitter sequence
+    assert all(0.75 <= d <= 1.25 for d in a)
+
+
+def test_retry_async_retries_then_succeeds():
+    calls = []
+
+    async def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    async def no_sleep(_):
+        pass
+
+    out = run(
+        retry_async(
+            flaky, RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0),
+            sleep=no_sleep,
+        )
+    )
+    assert out == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_async_reraises_last_error_on_exhaustion():
+    async def always_fails():
+        raise ConnectionError("still down")
+
+    async def no_sleep(_):
+        pass
+
+    with pytest.raises(ConnectionError, match="still down"):
+        run(
+            retry_async(
+                always_fails,
+                RetryPolicy(max_attempts=3, base_delay=0.01, jitter=0.0),
+                sleep=no_sleep,
+            )
+        )
+
+
+def test_non_retryable_raises_immediately():
+    calls = []
+
+    async def rejects():
+        calls.append(1)
+        raise ValueError("semantic rejection")
+
+    async def no_sleep(_):
+        pass
+
+    with pytest.raises(ValueError):
+        run(
+            retry_async(
+                rejects,
+                RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0),
+                retryable=(ConnectionError,),
+                sleep=no_sleep,
+            )
+        )
+    assert len(calls) == 1  # no retries for non-transport failures
+
+
+def test_deadline_stops_before_overshooting():
+    """A retry whose backoff would exceed the overall budget is not
+    attempted; the last real failure propagates."""
+    calls = []
+    fake_now = [0.0]
+
+    async def fails():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    async def advancing_sleep(d):
+        fake_now[0] += d
+
+    policy = RetryPolicy(
+        max_attempts=10, base_delay=1.0, multiplier=2.0, max_delay=60.0,
+        jitter=0.0, deadline=5.0,
+    )
+    with pytest.raises(ConnectionError):
+        run(
+            retry_async(
+                fails, policy, sleep=advancing_sleep, clock=lambda: fake_now[0]
+            )
+        )
+    # delays 1+2 fit in 5s; the next (4s) would overshoot -> 3 attempts
+    assert len(calls) == 3
+
+
+def test_on_retry_callback_sees_each_failure():
+    seen = []
+
+    async def flaky():
+        if len(seen) < 2:
+            raise ConnectionError("x")
+        return True
+
+    async def no_sleep(_):
+        pass
+
+    run(
+        retry_async(
+            flaky, RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0),
+            on_retry=lambda attempt, exc, delay: seen.append((attempt, str(exc))),
+            sleep=no_sleep,
+        )
+    )
+    assert [a for a, _ in seen] == [0, 1]
+
+
+def test_canonical_policies_read_env_knobs(monkeypatch):
+    from comfyui_distributed_tpu.utils import constants
+
+    monkeypatch.setattr(constants, "REQUEST_RETRY_COUNT", 7)
+    monkeypatch.setattr(constants, "WORK_PULL_RETRY_COUNT", 11)
+    monkeypatch.setattr(constants, "JOB_READY_POLL_ATTEMPTS", 13)
+    assert http_policy().max_attempts == 7
+    assert work_pull_policy().max_attempts == 11
+    ready = poll_ready_policy()
+    assert ready.max_attempts == 13
+    assert ready.multiplier == 1.0 and ready.jitter == 0.0  # fixed interval
