@@ -163,6 +163,8 @@ class ConfigRoutes:
         store = self.server.job_store
         collector = store.collectors.get(job_id)
         tile_job = store.tile_jobs.get(job_id)
+        from ..resilience.health import get_health_registry
+
         return web.json_response(
             {
                 "exists": collector is not None or tile_job is not None,
@@ -173,7 +175,9 @@ class ConfigRoutes:
                 "tile_job": tile_job is not None and {
                     "total": tile_job.total_tasks,
                     "completed": len(tile_job.completed),
+                    **store.tile_job_stats(tile_job),
                 } or None,
                 "queue_remaining": self.server.queue_remaining,
+                "breakers": get_health_registry().snapshot(),
             }
         )

@@ -17,12 +17,14 @@ from typing import Any
 
 from aiohttp import web
 
+from ..telemetry.instruments import collector_results_total
 from ..utils import audio_payload as audio_utils
 from ..utils import image as img_utils
 from ..utils.constants import JOB_INIT_GRACE_SECONDS
 from ..utils.exceptions import PromptValidationError
 from ..utils.logging import debug_log, log
 from .queue_request import QueueRequestError, parse_queue_request_payload
+from .telemetry_routes import rpc_span
 
 
 def register(app: web.Application, server) -> None:
@@ -90,22 +92,28 @@ class JobRoutes:
                     {"error": f"undecodable audio: {exc}"}, status=400
                 )
 
-        job = await self.server.job_store.wait_for_collector(
-            body["job_id"], JOB_INIT_GRACE_SECONDS
-        )
-        if job is None:
-            return web.json_response({"error": "no such job"}, status=404)
-        await self.server.job_store.put_collector_result(
-            body["job_id"],
-            {
-                "tensor": tensor,
-                "worker_id": str(body["worker_id"]),
-                "batch_idx": int(body["batch_idx"]),
-                "is_last": bool(body.get("is_last", False)),
-                "empty": bool(body.get("empty", False)),
-                "audio": audio,
-            },
-        )
+        with rpc_span(
+            request, "rpc.job_complete",
+            worker_id=str(body["worker_id"]), job_id=str(body["job_id"]),
+            batch_idx=int(body["batch_idx"]),
+        ):
+            job = await self.server.job_store.wait_for_collector(
+                body["job_id"], JOB_INIT_GRACE_SECONDS
+            )
+            if job is None:
+                return web.json_response({"error": "no such job"}, status=404)
+            await self.server.job_store.put_collector_result(
+                body["job_id"],
+                {
+                    "tensor": tensor,
+                    "worker_id": str(body["worker_id"]),
+                    "batch_idx": int(body["batch_idx"]),
+                    "is_last": bool(body.get("is_last", False)),
+                    "empty": bool(body.get("empty", False)),
+                    "audio": audio,
+                },
+            )
+            collector_results_total().inc(worker_id=str(body["worker_id"]))
         return web.json_response({"status": "ok"})
 
     async def prepare_job(self, request: web.Request) -> web.Response:

@@ -21,12 +21,15 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import time
 from typing import Any, Optional
 
 import aiohttp
 
 from ...resilience.health import get_health_registry
 from ...resilience.policy import http_policy, retry_async, transport_errors
+from ...telemetry import TRACE_HEADER, current_trace_id, get_tracer
+from ...telemetry.instruments import dispatch_seconds
 from ...utils.constants import DISPATCH_TIMEOUT_SECONDS, PROBE_CONCURRENCY
 from ...utils.exceptions import WorkerNotAvailableError, WorkerUnreachableError
 from ...utils.logging import debug_log, log
@@ -120,35 +123,49 @@ async def dispatch_worker_prompt(
         raise WorkerNotAvailableError(
             f"worker {wid} is quarantined (circuit open); not dispatching", wid
         )
-    try:
-        if use_websocket:
-            try:
-                await _dispatch_ws(worker, prompt, prompt_id, extra_data)
-                registry.record_success(wid)
-                return
-            except WorkerNotAvailableError as exc:
-                if not isinstance(exc, WorkerUnreachableError):
-                    # The worker ANSWERED with a rejection: it is alive
-                    # (transport success), and the same prompt must NOT
-                    # be re-sent over HTTP.
+    started = time.monotonic()
+    # Pessimistic default: cancellation or an unexpected exception must
+    # not record an "ok" latency sample — only the success paths below
+    # flip it.
+    outcome = "error"
+    with get_tracer().span("dispatch", worker_id=wid, prompt_id=prompt_id):
+        try:
+            if use_websocket:
+                try:
+                    await _dispatch_ws(worker, prompt, prompt_id, extra_data)
                     registry.record_success(wid)
-                    raise
-                debug_log(
-                    f"WS dispatch to {worker.get('id')} unreachable ({exc}); "
-                    "trying HTTP"
-                )
-            except Exception as exc:  # noqa: BLE001 - falls back to HTTP
-                debug_log(
-                    f"WS dispatch to {worker.get('id')} failed ({exc}); trying HTTP"
-                )
-        await _dispatch_http(worker, prompt, prompt_id, extra_data)
-    except WorkerUnreachableError:
-        registry.record_failure(wid)
-        raise
-    except WorkerNotAvailableError:
-        # Rejection answer over HTTP: alive, breaker chain resets.
-        registry.record_success(wid)
-        raise
+                    outcome = "ok"
+                    return
+                except WorkerNotAvailableError as exc:
+                    if not isinstance(exc, WorkerUnreachableError):
+                        # The worker ANSWERED with a rejection: it is alive
+                        # (transport success), and the same prompt must NOT
+                        # be re-sent over HTTP. The outer except arm below
+                        # records the breaker success exactly once.
+                        raise
+                    debug_log(
+                        f"WS dispatch to {worker.get('id')} unreachable ({exc}); "
+                        "trying HTTP"
+                    )
+                except Exception as exc:  # noqa: BLE001 - falls back to HTTP
+                    debug_log(
+                        f"WS dispatch to {worker.get('id')} failed ({exc}); trying HTTP"
+                    )
+            await _dispatch_http(worker, prompt, prompt_id, extra_data)
+            outcome = "ok"
+        except WorkerUnreachableError:
+            registry.record_failure(wid)
+            outcome = "unreachable"
+            raise
+        except WorkerNotAvailableError:
+            # Rejection answer over HTTP: alive, breaker chain resets.
+            registry.record_success(wid)
+            outcome = "rejected"
+            raise
+        finally:
+            dispatch_seconds().observe(
+                time.monotonic() - started, worker_id=wid, outcome=outcome
+            )
     registry.record_success(wid)
 
 
@@ -158,10 +175,14 @@ async def _dispatch_http(worker, prompt, prompt_id, extra_data) -> None:
     payload = {"prompt": prompt, "prompt_id": prompt_id}
     if extra_data:
         payload["extra_data"] = extra_data
+    # Trace propagation: the worker's executor joins this execution's
+    # span tree via the header (api/server.handle_post_prompt).
+    trace_id = current_trace_id()
+    headers = {TRACE_HEADER: trace_id} if trace_id else {}
 
     async def attempt():
         async with session.post(
-            url, json=payload,
+            url, json=payload, headers=headers,
             timeout=aiohttp.ClientTimeout(total=DISPATCH_TIMEOUT_SECONDS),
         ) as resp:
             if resp.status != 200:
@@ -201,6 +222,7 @@ async def _dispatch_ws(worker, prompt, prompt_id, extra_data) -> None:
                 "prompt": prompt,
                 "prompt_id": prompt_id,
                 "extra_data": extra_data or {},
+                "trace_id": current_trace_id(),
             }
         )
 

@@ -14,11 +14,13 @@ import asyncio
 import hashlib
 import os
 import re
+import time
 from typing import Any
 
 import aiohttp
 
 from ...resilience.policy import http_policy, retry_async, transport_errors
+from ...telemetry.instruments import media_sync_seconds, media_sync_uploads_total
 from ...utils.constants import MEDIA_SYNC_TIMEOUT_SECONDS
 from ...utils.logging import debug_log, log
 from ...utils.network import build_worker_url, get_client_session
@@ -126,6 +128,8 @@ async def sync_worker_media(
     refs = find_media_references(prompt)
     if not refs:
         return prompt
+    worker_id = str(worker.get("id"))
+    started = time.monotonic()
     sep = await _worker_path_separator(worker)
 
     async def sync_one(node_id: str, key: str, filename: str) -> None:
@@ -136,6 +140,9 @@ async def sync_worker_media(
         digest = _md5(local)
         if not await _check_file(worker, filename, digest):
             ok = await _upload_file(worker, local, filename)
+            media_sync_uploads_total().inc(
+                worker_id=worker_id, outcome="ok" if ok else "failed"
+            )
             if ok:
                 log(f"synced {filename} to worker {worker.get('id')}")
             else:
@@ -143,8 +150,13 @@ async def sync_worker_media(
         if sep != os.sep:
             prompt[node_id]["inputs"][key] = filename.replace(os.sep, sep)
 
-    # asyncio.wait_for (not asyncio.timeout): Python 3.10 compat
-    await asyncio.wait_for(
-        asyncio.gather(*(sync_one(*ref) for ref in refs)), timeout
-    )
+    try:
+        # asyncio.wait_for (not asyncio.timeout): Python 3.10 compat
+        await asyncio.wait_for(
+            asyncio.gather(*(sync_one(*ref) for ref in refs)), timeout
+        )
+    finally:
+        media_sync_seconds().observe(
+            time.monotonic() - started, worker_id=worker_id
+        )
     return prompt

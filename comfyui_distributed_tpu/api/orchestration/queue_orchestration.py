@@ -26,6 +26,8 @@ from ...graph.prompt import (
     prepare_delegate_master_prompt,
     prune_prompt_for_worker,
 )
+from ...telemetry import get_tracer
+from ...telemetry.instruments import orchestrations_total
 from ...utils import config as config_mod
 from ...utils.logging import log
 from ...utils.network import build_master_callback_url
@@ -43,6 +45,19 @@ async def orchestrate_distributed_execution(
     server, payload: QueueRequestPayload
 ) -> dict[str, Any]:
     trace_id = payload.trace_id or generate_trace_id()
+    # Root span of the whole distributed execution: everything later —
+    # dispatches, worker executions (joined via the X-CDT-Trace-Id
+    # header), tile pulls, collector ingestion — parents into this tree.
+    with get_tracer().span(
+        "queue_orchestration", trace_id=trace_id, client_id=payload.client_id
+    ):
+        return await _orchestrate(server, payload, trace_id)
+
+
+async def _orchestrate(
+    server, payload: QueueRequestPayload, trace_id: str
+) -> dict[str, Any]:
+    tracer = get_tracer()
     config = config_mod.load_config(server.config_path)
     settings = config.get("settings", {})
 
@@ -54,9 +69,10 @@ async def orchestrate_distributed_execution(
     index = PromptIndex(payload.prompt)
     trace_info(trace_id, f"orchestrating: {len(remote)} remote worker(s) requested")
 
-    active = await select_active_workers(
-        remote, settings.get("probe_concurrency", 8)
-    )
+    with tracer.span("probe_workers", requested=len(remote)):
+        active = await select_active_workers(
+            remote, settings.get("probe_concurrency", 8)
+        )
 
     # --- load-balanced single placement ---
     if payload.extra.get("load_balance") and active:
@@ -79,6 +95,7 @@ async def orchestrate_distributed_execution(
                 settings.get("websocket_orchestration", True),
             )
             trace_info(trace_id, f"load-balanced to worker {target.get('id')}")
+            orchestrations_total().inc(mode="load_balance")
             return {
                 "status": "dispatched",
                 "trace_id": trace_id,
@@ -113,10 +130,18 @@ async def orchestrate_distributed_execution(
                 prune_prompt_for_worker(payload.prompt, index), participant
             )
             async with media_sem:
-                try:
-                    await sync_worker_media(worker, worker_prompt, input_dir)
-                except Exception as exc:  # noqa: BLE001 - sync best effort
-                    log(f"media sync to {worker.get('id')} failed: {exc}")
+                with tracer.span(
+                    "media_sync", trace_id=trace_id,
+                    worker_id=str(worker.get("id")),
+                ) as sync_span:
+                    try:
+                        await sync_worker_media(worker, worker_prompt, input_dir)
+                    except Exception as exc:  # noqa: BLE001 - sync best effort
+                        # swallowed (dispatch proceeds), but the trace
+                        # must still show the sync failed
+                        sync_span.status = "error"
+                        sync_span.attrs["error"] = f"{type(exc).__name__}: {exc}"
+                        log(f"media sync to {worker.get('id')} failed: {exc}")
             await dispatch_worker_prompt(
                 worker, worker_prompt, f"{trace_id}_w{position}",
                 settings.get("websocket_orchestration", True),
@@ -145,8 +170,11 @@ async def orchestrate_distributed_execution(
     elif delegate:
         trace_info(trace_id, "delegate mode requested but no workers online; master participates")
 
-    job = server.queue_prompt(master_prompt, f"{trace_id}_master", payload.extra)
+    job = server.queue_prompt(
+        master_prompt, f"{trace_id}_master", payload.extra, trace_id=trace_id
+    )
     trace_info(trace_id, f"dispatched to {dispatched}; master queued {job.prompt_id}")
+    orchestrations_total().inc(mode="fan_out")
     return {
         "status": "queued",
         "trace_id": trace_id,

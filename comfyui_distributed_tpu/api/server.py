@@ -34,10 +34,20 @@ from ..utils.logging import debug_log, log
 
 
 class PromptJob:
-    def __init__(self, prompt_id: str, prompt: dict, extra: dict | None = None):
+    def __init__(
+        self,
+        prompt_id: str,
+        prompt: dict,
+        extra: dict | None = None,
+        trace_id: str | None = None,
+    ):
         self.prompt_id = prompt_id
         self.prompt = prompt
         self.extra = extra or {}
+        # Execution joins this trace (master queue / propagated via the
+        # X-CDT-Trace-Id dispatch header); prompt_id is the fallback so
+        # standalone executions still get a span tree.
+        self.trace_id = trace_id or prompt_id
         self.done = threading.Event()
         self.outputs: dict[str, Any] | None = None
         self.error: str | None = None
@@ -78,6 +88,10 @@ class DistributedServer:
         self._unbind_health = bind_quarantine_requeue(
             get_health_registry(), self.job_store
         )
+        # Live-state gauge collectors are bound in start() — a server
+        # constructed but never started must not leave a collector
+        # (holding a strong reference to it) in the global registry.
+        self._unbind_telemetry: Any = lambda: None
         self.app = web.Application(client_max_size=256 * 1024 * 1024)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._runner: Optional[web.AppRunner] = None
@@ -112,6 +126,7 @@ class DistributedServer:
         from . import (
             config_routes,
             job_routes,
+            telemetry_routes,
             tunnel_routes,
             usdu_routes,
             web_routes,
@@ -123,6 +138,7 @@ class DistributedServer:
         self.app.router.add_post("/interrupt", self.handle_interrupt)
         self.app.router.add_get("/history/{prompt_id}", self.handle_history)
         job_routes.register(self.app, self)
+        telemetry_routes.register(self.app, self)
         usdu_routes.register(self.app, self)
         config_routes.register(self.app, self)
         worker_routes.register(self.app, self)
@@ -151,8 +167,13 @@ class DistributedServer:
         if not isinstance(prompt, dict):
             return web.json_response({"error": "missing prompt"}, status=400)
         prompt_id = body.get("prompt_id") or f"prompt_{len(self._history)}_{os.getpid()}"
+        from ..telemetry import TRACE_HEADER
+
+        trace_id = request.headers.get(TRACE_HEADER) or None
         try:
-            job = self.queue_prompt(prompt, prompt_id, body.get("extra_data"))
+            job = self.queue_prompt(
+                prompt, prompt_id, body.get("extra_data"), trace_id=trace_id
+            )
         except PromptValidationError as exc:
             return web.json_response(
                 {"error": str(exc), "node_errors": exc.node_errors}, status=400
@@ -179,7 +200,11 @@ class DistributedServer:
         )
 
     def queue_prompt(
-        self, prompt: dict, prompt_id: str, extra: dict | None = None
+        self,
+        prompt: dict,
+        prompt_id: str,
+        extra: dict | None = None,
+        trace_id: str | None = None,
     ) -> PromptJob:
         """Validate then enqueue (reference utils/async_helpers.py
         queue_prompt_payload contract: validation errors surface to the
@@ -196,7 +221,7 @@ class DistributedServer:
         from ..graph import validate_prompt
 
         validate_prompt(prompt)
-        job = PromptJob(prompt_id, prompt, extra)
+        job = PromptJob(prompt_id, prompt, extra, trace_id=trace_id)
         self._history[prompt_id] = job
         self._prompt_queue.put(job)
         return job
@@ -222,17 +247,53 @@ class DistributedServer:
                 pipelines=self.execution_context.pipelines,
                 extras=self.execution_context.extras,  # node cache persists
             )
+            from ..telemetry import get_tracer
+
+            tracer = get_tracer()
+            # The compute thread joins the prompt's trace so every span
+            # opened during execution (tile pulls, sampler stages)
+            # attaches to the distributed execution's tree.
+            token = tracer.activate(job.trace_id)
             try:
                 debug_log(f"executing prompt {job.prompt_id}")
-                executor = GraphExecutor(ctx)
-                job.outputs = executor.execute(job.prompt)
-                job.timings = executor.last_timings
+                with tracer.span(
+                    "execute_prompt",
+                    prompt_id=job.prompt_id,
+                    role="worker" if self.is_worker else "master",
+                ):
+                    executor = GraphExecutor(ctx)
+                    job.outputs = executor.execute(job.prompt)
+                    job.timings = executor.last_timings
             except Exception as exc:  # noqa: BLE001 - reported to client
                 job.error = f"{type(exc).__name__}: {exc}"
                 log(f"prompt {job.prompt_id} failed: {job.error}")
             finally:
+                tracer.deactivate(token)
+                self._export_trace(job.trace_id)
                 self._executing.clear()
                 job.done.set()
+
+    def _export_trace(self, trace_id: str) -> None:
+        """Write the trace's spans as JSONL when CDT_TRACE_EXPORT_DIR is
+        set (one file per execution per process — a master and a
+        co-hosted managed worker share the inherited dir, so the role
+        and pid keep their exports from overwriting each other;
+        `cat <trace>.*.jsonl | perf_report /dev/stdin` merges them)."""
+        export_dir = os.environ.get("CDT_TRACE_EXPORT_DIR")
+        if not export_dir:
+            return
+        from ..telemetry import get_tracer
+
+        try:
+            os.makedirs(export_dir, exist_ok=True)
+            safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in trace_id)
+            role = "worker" if self.is_worker else "master"
+            get_tracer().write_jsonl(
+                trace_id,
+                os.path.join(export_dir, f"{safe}.{role}-{os.getpid()}.jsonl"),
+            )
+        except Exception as exc:  # noqa: BLE001 - export is best effort
+            debug_log(f"trace export for {trace_id} failed: {exc}")
 
     # --- lifecycle --------------------------------------------------------
 
@@ -240,6 +301,11 @@ class DistributedServer:
         """Start HTTP listener + executor thread on the running loop."""
         self.loop = asyncio.get_running_loop()
         set_server_loop(self.loop)
+        # Live-state gauges (queue depths, breaker states) are filled
+        # at /distributed/metrics scrape time from this server.
+        from ..telemetry import bind_server_collectors
+
+        self._unbind_telemetry = bind_server_collectors(self)
         self._executor_thread = threading.Thread(
             target=self._executor_loop, name="cdt-executor", daemon=True
         )
@@ -253,6 +319,7 @@ class DistributedServer:
 
     async def stop(self) -> None:
         self._unbind_health()
+        self._unbind_telemetry()
         self._prompt_queue.put(None)
         if self._runner is not None:
             await self._runner.cleanup()

@@ -1,0 +1,72 @@
+"""Telemetry routes: Prometheus scrape + per-execution span trees.
+
+    GET /distributed/metrics            — Prometheus text exposition
+    GET /distributed/trace/{trace_id}   — span tree JSON for one execution
+    GET /distributed/traces             — trace ids currently retained
+
+The metrics body is the process-global registry (counters/histograms
+pushed by the instrumented layers, live-state gauges filled at scrape
+time by the server's collectors — telemetry/instruments.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from aiohttp import web
+
+from ..telemetry import TRACE_HEADER, get_metrics_registry, get_tracer
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+@contextlib.contextmanager
+def rpc_span(request: web.Request, name: str, **attrs: Any):
+    """Attach a server-side span for one inbound RPC to the trace the
+    X-CDT-Trace-Id header names; no-op (yields None) when the caller
+    didn't propagate a trace. Shared by every route module that
+    receives trace-propagated worker RPCs (usdu_routes, job_routes)."""
+    trace_id = request.headers.get(TRACE_HEADER)
+    if not trace_id:
+        yield None
+        return
+    with get_tracer().span(name, trace_id=trace_id, **attrs) as span:
+        yield span
+
+
+def register(app: web.Application, server) -> None:
+    routes = TelemetryRoutes(server)
+    app.router.add_get("/distributed/metrics", routes.metrics)
+    app.router.add_get("/distributed/trace/{trace_id}", routes.trace)
+    app.router.add_get("/distributed/traces", routes.traces)
+
+
+class TelemetryRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        body = get_metrics_registry().render()
+        return web.Response(
+            body=body.encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
+
+    async def trace(self, request: web.Request) -> web.Response:
+        trace_id = request.match_info["trace_id"]
+        tracer = get_tracer()
+        spans = tracer.spans(trace_id)
+        if not spans:
+            return web.json_response({"error": "no such trace"}, status=404)
+        return web.json_response(
+            {
+                "trace_id": trace_id,
+                "span_count": len(spans),
+                "tree": tracer.tree(trace_id, spans),
+            }
+        )
+
+    async def traces(self, request: web.Request) -> web.Response:
+        tracer = get_tracer()
+        return web.json_response({"traces": tracer.trace_ids()})

@@ -22,6 +22,7 @@ from aiohttp import web
 
 from ..utils.constants import JOB_INIT_GRACE_SECONDS, QUEUE_POLL_INTERVAL_SECONDS
 from ..utils.logging import debug_log
+from .telemetry_routes import rpc_span
 
 
 def register(app: web.Application, server) -> None:
@@ -60,15 +61,20 @@ class UsduRoutes:
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
         job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
-        job = await self.server.job_store.wait_for_tile_job(
-            job_id, JOB_INIT_GRACE_SECONDS
-        )
-        if job is None:
-            return web.json_response({"error": "no such job"}, status=404)
-        task_id = await self.server.job_store.pull_task(
-            job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
-        )
-        remaining = await self.server.job_store.remaining(job_id)
+        with rpc_span(
+            request, "rpc.request_image", worker_id=worker_id, job_id=job_id
+        ) as span:
+            job = await self.server.job_store.wait_for_tile_job(
+                job_id, JOB_INIT_GRACE_SECONDS
+            )
+            if job is None:
+                return web.json_response({"error": "no such job"}, status=404)
+            task_id = await self.server.job_store.pull_task(
+                job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
+            )
+            remaining = await self.server.job_store.remaining(job_id)
+            if span is not None and task_id is not None:
+                span.attrs["tile_idx"] = int(task_id)
         key = "tile_idx" if job.batched or type(job).__name__ == "TileJob" else "image_idx"
         return web.json_response(
             {
@@ -92,21 +98,27 @@ class UsduRoutes:
             return web.json_response({"error": "tiles must be a list"}, status=400)
 
         store = self.server.job_store
-        job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
-        if job is None:
-            return web.json_response({"error": "no such job"}, status=404)
+        with rpc_span(
+            request, "rpc.submit_tiles", worker_id=worker_id, job_id=job_id
+        ) as span:
+            job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
+            if job is None:
+                return web.json_response({"error": "no such job"}, status=404)
 
-        grouped: dict[int, list[dict]] = {}
-        for entry in tiles:
-            if not isinstance(entry, dict) or "tile_idx" not in entry or "image" not in entry:
-                return web.json_response({"error": "bad tile entry"}, status=400)
-            grouped.setdefault(int(entry["tile_idx"]), []).append(entry)
-        accepted = 0
-        for tile_idx, payload in grouped.items():
-            if await store.submit_result(job_id, worker_id, tile_idx, payload):
-                accepted += 1
-        if body.get("is_final_flush"):
-            await store.mark_worker_done(job_id, worker_id)
+            grouped: dict[int, list[dict]] = {}
+            for entry in tiles:
+                if not isinstance(entry, dict) or "tile_idx" not in entry or "image" not in entry:
+                    return web.json_response({"error": "bad tile entry"}, status=400)
+                grouped.setdefault(int(entry["tile_idx"]), []).append(entry)
+            accepted = 0
+            for tile_idx, payload in grouped.items():
+                if await store.submit_result(job_id, worker_id, tile_idx, payload):
+                    accepted += 1
+            if body.get("is_final_flush"):
+                await store.mark_worker_done(job_id, worker_id)
+            if span is not None:
+                span.attrs["tiles"] = sorted(grouped)
+                span.attrs["accepted"] = accepted
         debug_log(
             f"submit_tiles job={job_id} worker={worker_id} "
             f"tiles={len(grouped)} accepted={accepted}"
@@ -123,15 +135,19 @@ class UsduRoutes:
         if "image_idx" not in body or "image" not in body:
             return web.json_response({"error": "image_idx and image required"}, status=400)
         store = self.server.job_store
-        job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
-        if job is None:
-            return web.json_response({"error": "no such job"}, status=404)
-        await store.submit_result(
-            job_id, worker_id, int(body["image_idx"]),
-            [{"batch_idx": 0, "image": body["image"], "whole_image": True}],
-        )
-        if body.get("is_last"):
-            await store.mark_worker_done(job_id, worker_id)
+        with rpc_span(
+            request, "rpc.submit_image", worker_id=worker_id, job_id=job_id,
+            image_idx=int(body["image_idx"]),
+        ):
+            job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
+            if job is None:
+                return web.json_response({"error": "no such job"}, status=404)
+            await store.submit_result(
+                job_id, worker_id, int(body["image_idx"]),
+                [{"batch_idx": 0, "image": body["image"], "whole_image": True}],
+            )
+            if body.get("is_last"):
+                await store.mark_worker_done(job_id, worker_id)
         return web.json_response({"status": "ok"})
 
     async def job_status(self, request: web.Request) -> web.Response:

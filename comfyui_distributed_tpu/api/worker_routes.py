@@ -64,7 +64,10 @@ class WorkerRoutes:
                 prompt_id = data.get("prompt_id", "")
                 try:
                     self.server.queue_prompt(
-                        data.get("prompt", {}), prompt_id, data.get("extra_data")
+                        data.get("prompt", {}),
+                        prompt_id,
+                        data.get("extra_data"),
+                        trace_id=data.get("trace_id") or None,
                     )
                     await ws.send_json(
                         {"type": "dispatch_ack", "prompt_id": prompt_id, "ok": True}
@@ -252,6 +255,20 @@ class WorkerRoutes:
             "platform": os.name,
             "docker": is_docker(),
             "is_worker": self.server.is_worker,
+        }
+        # Live telemetry snapshot for the control panel: queue depths,
+        # in-flight tiles, and breaker states without making the panel
+        # parse the Prometheus text surface.
+        from ..resilience.health import get_health_registry
+
+        stats = await self.server.job_store.stats()
+        info["status"] = {
+            "queue_remaining": self.server.queue_remaining,
+            "tile_jobs": stats["tile_jobs"],
+            "collector_jobs": stats["collectors"],
+            "tile_queue_depth": stats["queue_depth"],
+            "in_flight_tiles": stats["in_flight"],
+            "breakers": get_health_registry().snapshot(),
         }
         try:
             from ..parallel.mesh import describe_topology

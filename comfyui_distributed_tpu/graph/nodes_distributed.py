@@ -273,6 +273,12 @@ class DistributedCollector:
     def _send_to_master(self, images, audio, worker_id, master_url, job_id):
         arr = img_utils.ensure_numpy(images)
         batch = arr.shape[0]
+        # Capture the active trace on the executor thread: send_all runs
+        # on the server loop, where the context is not set.
+        from ..telemetry import TRACE_HEADER, current_trace_id
+
+        trace_id = current_trace_id()
+        headers = {TRACE_HEADER: trace_id} if trace_id else {}
 
         async def send_all():
             session = await get_client_session()
@@ -296,7 +302,8 @@ class DistributedCollector:
                         audio["waveform"], audio["sample_rate"]
                     )
                 await self._post_with_retry(
-                    session, f"{master_url}/distributed/job_complete", envelope
+                    session, f"{master_url}/distributed/job_complete", envelope,
+                    headers,
                 )
                 return
             for idx in range(batch):
@@ -312,17 +319,18 @@ class DistributedCollector:
                         audio["waveform"], audio["sample_rate"]
                     )
                 await self._post_with_retry(
-                    session, f"{master_url}/distributed/job_complete", envelope
+                    session, f"{master_url}/distributed/job_complete", envelope,
+                    headers,
                 )
 
         run_async_in_server_loop(send_all(), timeout=300)
 
     @staticmethod
-    async def _post_with_retry(session, url, payload):
+    async def _post_with_retry(session, url, payload, headers=None):
         last_exc: Exception | None = None
         for attempt in range(REQUEST_RETRY_COUNT):
             try:
-                async with session.post(url, json=payload) as resp:
+                async with session.post(url, json=payload, headers=headers or {}) as resp:
                     if resp.status == 200:
                         return
                     last_exc = RuntimeError(f"HTTP {resp.status}")

@@ -24,6 +24,7 @@ pattern, reference tests/test_static_mode.py).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from typing import Any, Optional
 
@@ -50,9 +51,38 @@ from ..resilience.policy import (
     transport_errors,
     work_pull_policy,
 )
+from ..telemetry import TRACE_HEADER, current_trace_id, get_tracer
+from ..telemetry.instruments import tile_stage_seconds, tiles_processed_total
 from ..utils.exceptions import TransientServerError, WorkerError
 from ..utils.logging import debug_log, log
 from ..utils.network import build_worker_url, get_client_session, probe_worker
+
+
+@contextlib.contextmanager
+def _stage(stage: str, role: str, tile_idx: int | None = None):
+    """Span + latency histogram around one per-tile pipeline stage
+    (pull | sample | encode | submit | decode | blend). The span clock
+    is the tracer's (injectable, deterministic in chaos runs); the
+    histogram always uses the wall monotonic clock.
+
+    A pull that drains empty (caller sets ``outcome="empty"`` on the
+    yielded span) is excluded from the histogram: empty polls last the
+    full poll timeout by construction and would drag the pull stage's
+    p95 toward the timeout instead of the real dequeue latency (the
+    store's pulls_total{outcome="empty"} counter tracks them)."""
+    attrs: dict[str, Any] = {"stage": stage, "role": role}
+    if tile_idx is not None:
+        attrs["tile_idx"] = int(tile_idx)
+    started = time.monotonic()
+    span = None
+    try:
+        with get_tracer().span(f"tile.{stage}", **attrs) as span:
+            yield span
+    finally:
+        if span is None or span.attrs.get("outcome") != "empty":
+            tile_stage_seconds().observe(
+                time.monotonic() - started, stage=stage, role=role
+            )
 
 
 # --------------------------------------------------------------------------
@@ -74,10 +104,17 @@ class HTTPWorkClient:
         self.master_url = master_url
         self.job_id = job_id
         self.worker_id = worker_id
+        # Captured at construction (on the executor thread, where the
+        # dispatched prompt's trace is active); RPCs run on the server
+        # loop where that context is NOT set.
+        self.trace_id = current_trace_id()
 
     async def _post(self, path: str, payload: dict) -> dict:
         session = await get_client_session()
-        async with session.post(f"{self.master_url}{path}", json=payload) as resp:
+        headers = {TRACE_HEADER: self.trace_id} if self.trace_id else {}
+        async with session.post(
+            f"{self.master_url}{path}", json=payload, headers=headers
+        ) as resp:
             if resp.status >= 500:
                 raise TransientServerError(
                     f"{path} -> HTTP {resp.status}", self.worker_id
@@ -245,36 +282,46 @@ def run_worker_loop(
     def flush(is_final: bool) -> None:
         nonlocal pending, pending_bytes
         if pending or is_final:
-            client.submit_tiles(pending, is_final)
+            with _stage("submit", "worker"):
+                client.submit_tiles(pending, is_final)
         pending, pending_bytes = [], 0
 
     while True:
         if context is not None:
             context.check_interrupted()
-        work = client.request_tile()
+        with _stage("pull", "worker") as pull_span:
+            work = client.request_tile()
+            if work is None:
+                pull_span.attrs["outcome"] = "empty"
+            else:
+                pull_span.attrs["tile_idx"] = int(work["tile_idx"])
         if work is None:
             break
         tile_idx = int(work["tile_idx"])
         tkey = jax.random.fold_in(key, tile_idx)
-        result = process(
-            bundle.params, extracted[tile_idx], tkey, pos, neg, positions[tile_idx]
-        )
-        arr = img_utils.ensure_numpy(result)
-        for batch_idx in range(arr.shape[0]):
-            encoded = img_utils.encode_image_data_url(arr[batch_idx])
-            y, x = grid.positions[tile_idx]
-            entry = {
-                "tile_idx": tile_idx,
-                "batch_idx": batch_idx,
-                "global_idx": tile_idx * arr.shape[0] + batch_idx,
-                "x": int(x),
-                "y": int(y),
-                "extracted_w": grid.padded_w,
-                "extracted_h": grid.padded_h,
-                "image": encoded,
-            }
-            pending.append(entry)
-            pending_bytes += len(encoded)
+        with _stage("sample", "worker", tile_idx):
+            result = process(
+                bundle.params, extracted[tile_idx], tkey, pos, neg,
+                positions[tile_idx],
+            )
+        with _stage("encode", "worker", tile_idx):
+            arr = img_utils.ensure_numpy(result)
+            for batch_idx in range(arr.shape[0]):
+                encoded = img_utils.encode_image_data_url(arr[batch_idx])
+                y, x = grid.positions[tile_idx]
+                entry = {
+                    "tile_idx": tile_idx,
+                    "batch_idx": batch_idx,
+                    "global_idx": tile_idx * arr.shape[0] + batch_idx,
+                    "x": int(x),
+                    "y": int(y),
+                    "extracted_w": grid.padded_w,
+                    "extracted_h": grid.padded_h,
+                    "image": encoded,
+                }
+                pending.append(entry)
+                pending_bytes += len(encoded)
+        tiles_processed_total().inc(role="worker")
         client.heartbeat()
         if len(pending) >= MAX_TILE_BATCH or pending_bytes >= _flush_threshold_bytes():
             flush(is_final=False)
@@ -382,9 +429,10 @@ def run_master_elastic(
     timeout = get_worker_timeout_seconds()
 
     def blend_local(tile_idx: int, result) -> None:
-        y, x = grid.positions[tile_idx]
-        canvas.blend(result, y, x)
-        done_tiles.add(tile_idx)
+        with _stage("blend", "master", tile_idx):
+            y, x = grid.positions[tile_idx]
+            canvas.blend(result, y, x)
+            done_tiles.add(tile_idx)
 
     def drain_results() -> None:
         async def drain():
@@ -397,10 +445,11 @@ def run_master_elastic(
         for tile_idx, payload in run_async_in_server_loop(drain(), timeout=30):
             if tile_idx in done_tiles:
                 continue
-            batch = [
-                img_utils.decode_image_data_url(e["image"])
-                for e in sorted(payload, key=lambda e: e["batch_idx"])
-            ]
+            with _stage("decode", "master", tile_idx):
+                batch = [
+                    img_utils.decode_image_data_url(e["image"])
+                    for e in sorted(payload, key=lambda e: e["batch_idx"])
+                ]
             blend_local(tile_idx, jnp.asarray(np.stack(batch, axis=0)))
 
     async def probe_busy(worker_id: str) -> bool:
@@ -419,19 +468,26 @@ def run_master_elastic(
     while empty_pulls < 2:
         if context is not None:
             context.check_interrupted()
-        tile_idx = run_async_in_server_loop(
-            store.pull_task(job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS),
-            timeout=30,
-        )
+        with _stage("pull", "master") as pull_span:
+            tile_idx = run_async_in_server_loop(
+                store.pull_task(job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS),
+                timeout=30,
+            )
+            if tile_idx is None:
+                pull_span.attrs["outcome"] = "empty"
+            else:
+                pull_span.attrs["tile_idx"] = int(tile_idx)
         if tile_idx is None:
             empty_pulls += 1
             drain_results()
             continue
         empty_pulls = 0
         tkey = jax.random.fold_in(key, tile_idx)
-        result = process(
-            bundle.params, extracted[tile_idx], tkey, pos, neg, positions[tile_idx]
-        )
+        with _stage("sample", "master", tile_idx):
+            result = process(
+                bundle.params, extracted[tile_idx], tkey, pos, neg,
+                positions[tile_idx],
+            )
         run_async_in_server_loop(
             store.submit_result(
                 job_id, "master", tile_idx,
@@ -439,6 +495,7 @@ def run_master_elastic(
             ),
             timeout=30,
         )
+        tiles_processed_total().inc(role="master")
         blend_local(tile_idx, result)
         drain_results()
 
@@ -459,24 +516,31 @@ def run_master_elastic(
             # processed exactly once (a surviving worker may grab some
             # before we do).
             while True:
-                tile_idx = run_async_in_server_loop(
-                    store.pull_task(
-                        job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS
-                    ),
-                    timeout=30,
-                )
+                with _stage("pull", "master") as pull_span:
+                    tile_idx = run_async_in_server_loop(
+                        store.pull_task(
+                            job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS
+                        ),
+                        timeout=30,
+                    )
+                    if tile_idx is None:
+                        pull_span.attrs["outcome"] = "empty"
+                    else:
+                        pull_span.attrs["tile_idx"] = int(tile_idx)
                 if tile_idx is None:
                     break
                 if tile_idx in done_tiles:
                     continue
                 tkey = jax.random.fold_in(key, tile_idx)
-                result = process(
-                    bundle.params, extracted[tile_idx], tkey, pos, neg,
-                    positions[tile_idx],
-                )
+                with _stage("sample", "master", tile_idx):
+                    result = process(
+                        bundle.params, extracted[tile_idx], tkey, pos, neg,
+                        positions[tile_idx],
+                    )
                 run_async_in_server_loop(
                     store.submit_result(job_id, "master", tile_idx, None), timeout=30
                 )
+                tiles_processed_total().inc(role="master")
                 blend_local(tile_idx, result)
         if len(done_tiles) >= grid.num_tiles:
             break
@@ -485,10 +549,12 @@ def run_master_elastic(
             log(f"USDU: deadline hit; locally processing {len(missing)} tile(s)")
             for tile_idx in missing:
                 tkey = jax.random.fold_in(key, tile_idx)
-                result = process(
-                    bundle.params, extracted[tile_idx], tkey, pos, neg,
-                    positions[tile_idx],
-                )
+                with _stage("sample", "master", tile_idx):
+                    result = process(
+                        bundle.params, extracted[tile_idx], tkey, pos, neg,
+                        positions[tile_idx],
+                    )
+                tiles_processed_total().inc(role="master")
                 blend_local(tile_idx, result)
             break
         time.sleep(QUEUE_POLL_INTERVAL_SECONDS)

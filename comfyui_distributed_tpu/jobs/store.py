@@ -30,6 +30,7 @@ import asyncio
 import time
 from typing import Any, Awaitable, Callable, Optional
 
+from ..telemetry import instruments
 from ..utils.exceptions import JobQueueError
 from ..utils.logging import debug_log, log
 from .models import CollectorJob, ImageJob, TileJob
@@ -65,6 +66,7 @@ class JobStore:
     def _record_heartbeat(self, job: TileJob, worker_id: str) -> None:
         if not self._heartbeat_dropped(worker_id):
             job.heartbeat(worker_id)
+            instruments.store_heartbeats_total().inc(worker_id=worker_id)
 
     # --- creation signalling ----------------------------------------------
 
@@ -199,10 +201,12 @@ class JobStore:
         except asyncio.TimeoutError:
             async with self.lock:
                 self._record_heartbeat(job, worker_id)
+            instruments.store_pulls_total().inc(worker_id=worker_id, outcome="empty")
             return None
         async with self.lock:
             self._record_heartbeat(job, worker_id)
             job.assigned.setdefault(worker_id, set()).add(task_id)
+        instruments.store_pulls_total().inc(worker_id=worker_id, outcome="task")
         return task_id
 
     async def submit_result(
@@ -218,8 +222,14 @@ class JobStore:
             job.assigned.get(worker_id, set()).discard(task_id)
             if task_id in job.completed:
                 debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
+                instruments.store_submits_total().inc(
+                    worker_id=worker_id, outcome="duplicate"
+                )
                 return False
             job.completed[task_id] = payload
+        instruments.store_submits_total().inc(
+            worker_id=worker_id, outcome="accepted"
+        )
         await job.results.put((task_id, payload))
         return True
 
@@ -306,7 +316,9 @@ class JobStore:
                 requeued.extend(self._requeue_worker_locked(job, wid))
         return requeued
 
-    def _requeue_worker_locked(self, job: TileJob, worker_id: str) -> list[int]:
+    def _requeue_worker_locked(
+        self, job: TileJob, worker_id: str, reason: str = "timeout"
+    ) -> list[int]:
         """Put a worker's incomplete assigned tasks back on the queue.
         Caller holds self.lock."""
         tasks = job.assigned.pop(worker_id, set())
@@ -314,6 +326,9 @@ class JobStore:
         for tid in incomplete:
             job.pending.put_nowait(tid)
         if incomplete:
+            instruments.store_requeued_tasks_total().inc(
+                len(incomplete), worker_id=worker_id, reason=reason
+            )
             log(
                 f"requeued {len(incomplete)} task(s) from "
                 f"worker {worker_id} on job {job.job_id}"
@@ -333,7 +348,45 @@ class JobStore:
             else:
                 jobs = list(self.tile_jobs.values())
             for job in jobs:
-                incomplete = self._requeue_worker_locked(job, worker_id)
+                incomplete = self._requeue_worker_locked(
+                    job, worker_id, reason="quarantine"
+                )
                 if incomplete:
                     out[job.job_id] = incomplete
         return out
+
+    # --- observability --------------------------------------------------------
+
+    @staticmethod
+    def tile_job_stats(job: TileJob) -> dict[str, int]:
+        """Live pending/in-flight counts for one job — the single
+        definition shared by the metrics collector and the status
+        endpoints (config_routes.queue_status)."""
+        in_flight = 0
+        for tasks in list(job.assigned.values()):
+            in_flight += len([t for t in list(tasks) if t not in job.completed])
+        return {"pending": job.pending.qsize(), "in_flight": in_flight}
+
+    def stats_unlocked(self) -> dict[str, int]:
+        """Best-effort live counts WITHOUT taking the asyncio lock —
+        safe to call from sync scrape-time collectors (dict iteration
+        over a snapshot; the numbers may be one mutation stale)."""
+        tile_jobs = list(self.tile_jobs.values())
+        in_flight = 0
+        queue_depth = 0
+        for job in tile_jobs:
+            per_job = self.tile_job_stats(job)
+            queue_depth += per_job["pending"]
+            in_flight += per_job["in_flight"]
+        return {
+            "tile_jobs": len(tile_jobs),
+            "collectors": len(self.collectors),
+            "queue_depth": queue_depth,
+            "in_flight": in_flight,
+        }
+
+    async def stats(self) -> dict[str, int]:
+        """Consistent counts for status endpoints (same shape as
+        `stats_unlocked`, taken under the lock)."""
+        async with self.lock:
+            return self.stats_unlocked()

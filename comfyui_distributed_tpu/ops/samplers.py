@@ -177,26 +177,84 @@ def beta_spaced_sigmas(
     return all_sigmas[np.clip(idx, 0, n - 1)]
 
 
+def _betainc_np(a: float, b: float, x):
+    """Regularized incomplete beta I_x(a, b) in pure numpy float64
+    (Lentz continued fraction, Numerical Recipes 6.4). Schedules must
+    stay concrete at trace time (module contract) and jax's betainc
+    cannot be forced eager inside an outer jit on every jax version
+    (its ufunc/while_loop internals leak tracers out of
+    ensure_compile_time_eval on 0.4.37), so the sampler stack computes
+    the CDF host-side with no jax involvement at all."""
+    import math
+
+    import numpy as np
+
+    def betacf(aa: float, bb: float, xx: float) -> float:
+        tiny, eps = 1e-30, 3e-16
+        qab, qap, qam = aa + bb, aa + 1.0, aa - 1.0
+        c = 1.0
+        d = 1.0 - qab * xx / qap
+        if abs(d) < tiny:
+            d = tiny
+        d = 1.0 / d
+        h = d
+        for m in range(1, 200):
+            m2 = 2 * m
+            num = m * (bb - m) * xx / ((qam + m2) * (aa + m2))
+            d = 1.0 + num * d
+            if abs(d) < tiny:
+                d = tiny
+            c = 1.0 + num / c
+            if abs(c) < tiny:
+                c = tiny
+            d = 1.0 / d
+            h *= d * c
+            num = -(aa + m) * (qab + m) * xx / ((aa + m2) * (qap + m2))
+            d = 1.0 + num * d
+            if abs(d) < tiny:
+                d = tiny
+            c = 1.0 + num / c
+            if abs(c) < tiny:
+                c = tiny
+            d = 1.0 / d
+            delta = d * c
+            h *= delta
+            if abs(delta - 1.0) < eps:
+                break
+        return h
+
+    ln_beta = math.lgamma(a) + math.lgamma(b) - math.lgamma(a + b)
+
+    def one(xx: float) -> float:
+        if xx <= 0.0:
+            return 0.0
+        if xx >= 1.0:
+            return 1.0
+        front = math.exp(
+            a * math.log(xx) + b * math.log1p(-xx) - ln_beta
+        )
+        if xx < (a + 1.0) / (a + b + 2.0):
+            return front * betacf(a, b, xx) / a
+        return 1.0 - front * betacf(b, a, 1.0 - xx) / b
+
+    return np.vectorize(one, otypes=[np.float64])(np.asarray(x, np.float64))
+
+
 def _beta_ppf(q, a: float, b: float, iters: int = 60):
     """Beta(a, b) quantile function via bisection on the regularized
-    incomplete beta CDF (jax.scipy.special.betainc) — dependency-free
-    (the reference stack reaches scipy.stats.beta.ppf for this; scipy
-    is an optional install here, so the sampler stack must not need
-    it). float32 betainc + 60 halvings ≈ 1e-7 quantile precision,
-    far inside the rint-to-1000-buckets tolerance downstream."""
+    incomplete beta CDF — dependency-free (the reference stack reaches
+    scipy.stats.beta.ppf for this; scipy is an optional install here,
+    so the sampler stack must not need it). float64 CDF + 60 halvings
+    ≈ 1e-7 quantile precision, far inside the rint-to-1000-buckets
+    tolerance downstream."""
     import numpy as np
-    from jax.scipy.special import betainc
 
     q = np.asarray(q, np.float64)
     lo = np.zeros_like(q)
     hi = np.ones_like(q)
     for _ in range(iters):
         mid = 0.5 * (lo + hi)
-        # schedules must stay concrete at trace time (module contract):
-        # inputs here are concrete numpy, so force eager evaluation
-        # even when a caller builds the schedule inside a jit trace
-        with jax.ensure_compile_time_eval():
-            cdf = np.asarray(betainc(a, b, mid), np.float64)
+        cdf = _betainc_np(a, b, mid)
         lo = np.where(cdf < q, mid, lo)
         hi = np.where(cdf < q, hi, mid)
     return 0.5 * (lo + hi)

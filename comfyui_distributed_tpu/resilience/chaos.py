@@ -50,8 +50,25 @@ from unittest import mock
 
 import numpy as np
 
+from ..telemetry import Tracer, get_tracer, set_tracer
 from ..utils.logging import debug_log
 from .faults import FaultAction, FaultInjected, FaultInjector
+
+
+class FakeClock:
+    """Deterministic monotonic clock for trace timestamps: every call
+    advances by a fixed step, so span durations in a chaos trace are a
+    pure function of the span SEQUENCE, not wall time."""
+
+    def __init__(self, step: float = 0.001):
+        self._step = step
+        self._now = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        with self._lock:
+            self._now += self._step
+            return self._now
 
 
 @dataclasses.dataclass
@@ -62,6 +79,7 @@ class ChaosResult:
     output: np.ndarray
     fired: list[FaultAction]
     crashed_workers: list[str]
+    trace_id: str = ""
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -108,10 +126,16 @@ def run_chaos_usdu(
     upscale_by: float = 2.0,
     worker_timeout: float = 0.6,
     job_id: str = "chaos-job",
+    trace_jsonl: Optional[str] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
     `fault_plan=None` is the fault-free reference run.
+
+    The whole run executes under a fake-clock tracer (one span tree,
+    trace id `exec_chaos_<seed>`): master and worker tile stages are
+    recorded deterministically. `trace_jsonl` exports the spans to
+    that path for scripts/perf_report.py.
 
     Worker threads start BEFORE the master and park on the JobStore's
     creation signal (`wait_for_tile_job`), so they contend for tiles
@@ -137,6 +161,8 @@ def run_chaos_usdu(
     ctx = ExecutionContext(server=server, config={"workers": []})
     bundle = types.SimpleNamespace(params=None)
     crashed: list[str] = []
+    trace_id = f"exec_chaos_{seed}"
+    chaos_tracer = Tracer(clock=FakeClock())
 
     h, w = image_hw
     image = jnp.asarray(
@@ -156,21 +182,33 @@ def run_chaos_usdu(
         )
         if job is None:
             return
+        # Worker threads join the run's trace so their tile stages land
+        # in the same span tree the master's stages do.
+        tracer = get_tracer()
+        token = tracer.activate(trace_id)
         try:
             while True:
                 if injector is not None:
                     injector.check_blocking(f"chaos:{wid}:pull")
-                tile_idx = run_async_in_server_loop(
-                    store.pull_task(job_id, wid, timeout=0.2), timeout=10
-                )
+                with tracer.span(
+                    "tile.pull", stage="pull", role="worker", worker_id=wid
+                ) as pull_span:
+                    tile_idx = run_async_in_server_loop(
+                        store.pull_task(job_id, wid, timeout=0.2), timeout=10
+                    )
                 if tile_idx is None:
                     break
+                pull_span.attrs["tile_idx"] = int(tile_idx)
                 if injector is not None:
                     injector.check_blocking(f"chaos:{wid}:pulled")
-                tkey = jax.random.fold_in(key, tile_idx)
-                result = _stub_process(
-                    None, extracted[tile_idx], tkey, None, None, None
-                )
+                with tracer.span(
+                    "tile.sample", stage="sample", role="worker",
+                    worker_id=wid, tile_idx=int(tile_idx),
+                ):
+                    tkey = jax.random.fold_in(key, tile_idx)
+                    result = _stub_process(
+                        None, extracted[tile_idx], tkey, None, None, None
+                    )
                 arr = img_utils.ensure_numpy(result)
                 payload = [
                     {
@@ -181,9 +219,14 @@ def run_chaos_usdu(
                 ]
                 if injector is not None:
                     injector.check_blocking(f"chaos:{wid}:submit")
-                run_async_in_server_loop(
-                    store.submit_result(job_id, wid, tile_idx, payload), timeout=10
-                )
+                with tracer.span(
+                    "tile.submit", stage="submit", role="worker",
+                    worker_id=wid, tile_idx=int(tile_idx),
+                ):
+                    run_async_in_server_loop(
+                        store.submit_result(job_id, wid, tile_idx, payload),
+                        timeout=10,
+                    )
         except FaultInjected as exc:
             # Simulated crash: the thread dies with a tile assigned and
             # unsubmitted; the master's requeue path must recover it.
@@ -191,42 +234,60 @@ def run_chaos_usdu(
             crashed.append(wid)
         except JobQueueError:
             pass  # master cleaned the job up while we were pulling
+        finally:
+            tracer.deactivate(token)
 
     threads = [
         threading.Thread(target=worker_body, args=(wid,), daemon=True)
         for wid in workers
     ]
 
-    with contextlib.ExitStack() as stack:
-        stack.enter_context(_ensure_server_loop())
-        stack.enter_context(
-            mock.patch.object(
-                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+    previous_tracer = get_tracer()
+    set_tracer(chaos_tracer)
+    try:
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(_ensure_server_loop())
+            stack.enter_context(
+                mock.patch.object(
+                    elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+                )
             )
-        )
-        stack.enter_context(
-            mock.patch.object(
-                config_mod, "get_worker_timeout_seconds",
-                lambda path=None: worker_timeout,
+            stack.enter_context(
+                mock.patch.object(
+                    config_mod, "get_worker_timeout_seconds",
+                    lambda path=None: worker_timeout,
+                )
             )
-        )
-        stack.enter_context(
-            mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
-        )
-        for t in threads:
-            t.start()
-        out = elastic.run_master_elastic(
-            bundle, image, pos, neg,
-            job_id=job_id,
-            enabled_worker_ids=list(workers),
-            upscale_by=upscale_by, tile=tile, padding=padding,
-            steps=1, sampler="euler", scheduler="karras",
-            cfg=1.0, denoise=0.3, seed=seed, context=ctx,
-        )
-        for t in threads:
-            t.join(timeout=30)
+            stack.enter_context(
+                mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
+            )
+            token = chaos_tracer.activate(trace_id)
+            try:
+                with chaos_tracer.span(
+                    "chaos_usdu", trace_id=trace_id, seed=seed,
+                    fault_plan=fault_plan or "",
+                ):
+                    for t in threads:
+                        t.start()
+                    out = elastic.run_master_elastic(
+                        bundle, image, pos, neg,
+                        job_id=job_id,
+                        enabled_worker_ids=list(workers),
+                        upscale_by=upscale_by, tile=tile, padding=padding,
+                        steps=1, sampler="euler", scheduler="karras",
+                        cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+                    )
+                    for t in threads:
+                        t.join(timeout=30)
+            finally:
+                chaos_tracer.deactivate(token)
+        if trace_jsonl:
+            chaos_tracer.write_jsonl(trace_id, trace_jsonl)
+    finally:
+        set_tracer(previous_tracer)
     return ChaosResult(
         output=np.asarray(out),
         fired=list(injector.fired) if injector is not None else [],
         crashed_workers=crashed,
+        trace_id=trace_id,
     )

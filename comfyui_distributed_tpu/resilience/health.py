@@ -117,6 +117,11 @@ class HealthRegistry:
         never propagated into the transport path."""
         if old is new:
             return
+        from ..telemetry import instruments
+
+        instruments.breaker_transitions_total().inc(
+            worker_id=worker_id, from_state=old.value, to_state=new.value
+        )
         with self._lock:
             listeners = list(self._listeners)
         for listener in listeners:

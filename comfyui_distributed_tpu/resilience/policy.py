@@ -110,6 +110,14 @@ async def retry_async(
                     f"after {attempt + 1} attempt(s)"
                 )
                 break
+            # Retry visibility: one counter labelled by the operation
+            # part of the label ("dispatch:w1" → op="dispatch"), so
+            # dashboards see retry pressure without per-target series.
+            from ..telemetry import instruments
+
+            instruments.retries_total().inc(
+                op=label.split(":", 1)[0] if label else "unlabeled"
+            )
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             debug_log(

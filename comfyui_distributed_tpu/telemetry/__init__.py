@@ -1,0 +1,55 @@
+"""Telemetry core: metrics registry, span tracing, canonical instruments.
+
+The observability subsystem the ROADMAP's perf work hangs off:
+
+- `metrics`: zero-dependency Counter/Gauge/Histogram registry with
+  Prometheus text exposition, served by `/distributed/metrics`;
+- `tracing`: span trees keyed by the existing ``exec_*`` trace ids,
+  propagated master→worker via the ``X-CDT-Trace-Id`` header and
+  served by `/distributed/trace/{trace_id}`; JSONL export feeds
+  `scripts/perf_report.py`;
+- `instruments`: every metric name/label vocabulary in one place,
+  plus `bind_server_collectors` for live-state gauges.
+
+All clocks are injectable so tier-1 tests run deterministically on
+CPU. See docs/observability.md for the operator-facing story.
+"""
+
+from __future__ import annotations
+
+from .instruments import BREAKER_STATE_CODES, bind_server_collectors
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_metrics_registry,
+    reset_metrics_registry,
+)
+from .tracing import (
+    TRACE_HEADER,
+    Span,
+    Tracer,
+    current_trace_id,
+    get_tracer,
+    reset_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "BREAKER_STATE_CODES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "TRACE_HEADER",
+    "Tracer",
+    "bind_server_collectors",
+    "current_trace_id",
+    "get_metrics_registry",
+    "get_tracer",
+    "reset_metrics_registry",
+    "reset_tracer",
+    "set_tracer",
+]

@@ -1,0 +1,246 @@
+"""Canonical instrument definitions: every metric name in one place.
+
+Call sites fetch instruments through these accessors instead of naming
+strings inline, so the name/label vocabulary stays consistent (and one
+test can enforce the ``cdt_`` + snake_case conventions over the whole
+set — tests/test_telemetry_metrics.py).
+
+Accessors are get-or-create against the CURRENT global registry, so a
+test that resets the registry gets fresh instruments transparently.
+
+Live-state gauges (queue depths, breaker states) are scrape-time
+collectors bound per server via `bind_server_collectors`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .metrics import Counter, Gauge, Histogram, get_metrics_registry
+
+# Breaker states in gauge encoding (docs/observability.md documents it).
+BREAKER_STATE_CODES = {
+    "healthy": 0,
+    "suspect": 1,
+    "quarantined": 2,
+    "probing": 3,
+    "recovered": 4,
+}
+
+# Short buckets for store-level ops (sub-ms .. 1s).
+STORE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0)
+
+
+# --- job store ------------------------------------------------------------
+
+def store_pulls_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_store_pulls_total",
+        "Tile/image pull RPCs against the JobStore by outcome (task|empty)",
+        ("worker_id", "outcome"),
+    )
+
+
+def store_submits_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_store_submits_total",
+        "Result submissions by outcome (accepted|duplicate)",
+        ("worker_id", "outcome"),
+    )
+
+
+def store_heartbeats_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_store_heartbeats_total",
+        "Heartbeats recorded per worker (explicit + piggybacked)",
+        ("worker_id",),
+    )
+
+
+def store_requeued_tasks_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_store_requeued_tasks_total",
+        "Tasks returned to the pending queue by reason (timeout|quarantine)",
+        ("worker_id", "reason"),
+    )
+
+
+# --- dispatch / orchestration --------------------------------------------
+
+def dispatch_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_dispatch_seconds",
+        "Prompt dispatch latency per worker by outcome "
+        "(ok|rejected|unreachable|error)",
+        ("worker_id", "outcome"),
+    )
+
+
+def orchestrations_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_orchestrations_total",
+        "Distributed queue orchestrations by mode (fan_out|load_balance)",
+        ("mode",),
+    )
+
+
+def media_sync_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_media_sync_seconds",
+        "Media sync duration per worker",
+        ("worker_id",),
+    )
+
+
+def media_sync_uploads_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_media_sync_uploads_total",
+        "Media files uploaded to workers by outcome (ok|failed)",
+        ("worker_id", "outcome"),
+    )
+
+
+def collector_results_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_collector_results_total",
+        "Images accepted into collector queues per worker",
+        ("worker_id",),
+    )
+
+
+# --- resilience -----------------------------------------------------------
+
+def retries_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_retries_total",
+        "Retry attempts by retry_async, labelled by operation",
+        ("op",),
+    )
+
+
+def breaker_transitions_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_worker_breaker_transitions_total",
+        "Circuit-breaker state transitions per worker",
+        ("worker_id", "from_state", "to_state"),
+    )
+
+
+def breaker_state() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_worker_breaker_state",
+        "Circuit-breaker state per worker "
+        "(0=healthy 1=suspect 2=quarantined 3=probing 4=recovered)",
+        ("worker_id",),
+    )
+
+
+# --- USDU tile pipeline ---------------------------------------------------
+
+def tile_stage_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_tile_stage_seconds",
+        "Per-tile stage latency (pull|sample|encode|submit|decode|blend) "
+        "by role (master|worker)",
+        ("stage", "role"),
+    )
+
+
+def tiles_processed_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_tiles_processed_total",
+        "Tiles fully processed per role",
+        ("role",),
+    )
+
+
+# --- queue / live state (scrape-time collectors) --------------------------
+# The `server` label (e.g. "master:8188", "worker:8189") keeps the
+# series of multiple DistributedServers in one process apart — a
+# co-hosted master+worker pair (or an integration test) shares the
+# process-global registry, and unlabeled gauges would report whichever
+# server's collector ran last.
+
+def prompt_queue_depth() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_prompt_queue_depth",
+        "Prompts queued (including the one executing) per server",
+        ("server",),
+    )
+
+
+def tile_jobs_active() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_tile_jobs_active",
+        "Tile/image jobs currently registered per server",
+        ("server",),
+    )
+
+
+def tile_queue_depth() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_tile_queue_depth",
+        "Pending tasks across all tile/image jobs per server",
+        ("server",),
+    )
+
+
+def tiles_in_flight() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_tiles_in_flight",
+        "Tasks pulled by a worker but not yet completed, per server",
+        ("server",),
+    )
+
+
+def collector_jobs_active() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_collector_jobs_active",
+        "Collector queues currently registered per server",
+        ("server",),
+    )
+
+
+_LIVE_GAUGES = (
+    prompt_queue_depth,
+    tile_jobs_active,
+    tile_queue_depth,
+    tiles_in_flight,
+    collector_jobs_active,
+)
+
+
+def bind_server_collectors(server) -> Callable[[], None]:
+    """Register scrape-time collectors mirroring one server's live
+    state (prompt queue, JobStore, breaker registry) into gauges.
+    Returns an unbind callable (the server calls it on stop) that also
+    drops the server's gauge series from the scrape."""
+    from ..resilience.health import get_health_registry
+
+    label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
+
+    def collect() -> None:
+        prompt_queue_depth().set(server.queue_remaining, server=label)
+        stats = server.job_store.stats_unlocked()
+        tile_jobs_active().set(stats["tile_jobs"], server=label)
+        tile_queue_depth().set(stats["queue_depth"], server=label)
+        tiles_in_flight().set(stats["in_flight"], server=label)
+        collector_jobs_active().set(stats["collectors"], server=label)
+        gauge = breaker_state()
+        # Clear-then-refill: a worker removed from the registry
+        # (config delete / reset) must drop its series, not freeze at
+        # its last state forever.
+        gauge.clear()
+        for worker_id, health in get_health_registry().snapshot().items():
+            gauge.set(
+                BREAKER_STATE_CODES.get(health["state"], -1), worker_id=worker_id
+            )
+
+    unregister = get_metrics_registry().register_collector(collect)
+
+    def unbind() -> None:
+        unregister()
+        for accessor in _LIVE_GAUGES:
+            accessor().remove(server=label)
+
+    return unbind

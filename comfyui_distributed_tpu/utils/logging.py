@@ -21,7 +21,18 @@ PREFIX = "[Distributed-TPU]"
 # keeps an `app.logger` buffer for the same purpose).
 LOG_RING: collections.deque[str] = collections.deque(maxlen=1000)
 
-_debug_cache: dict[str, Any] = {"value": False, "checked_at": 0.0}
+# Reader failures escalate the effective TTL (exponential, capped) so a
+# persistently broken flag source is retried occasionally instead of on
+# every TTL tick, and is logged ONCE per breakage instead of silently
+# swallowed forever.
+_MAX_BACKOFF_MULTIPLIER = 64.0
+
+_debug_cache: dict[str, Any] = {
+    "value": False,
+    "checked_at": 0.0,
+    "backoff": 1.0,        # multiplier on DEBUG_FLAG_TTL_SECONDS
+    "error_logged": False,
+}
 # Injectable so tests and the config module can supply the flag source
 # without import cycles (config imports logging).
 _debug_flag_reader: Callable[[], bool] | None = None
@@ -32,17 +43,31 @@ def set_debug_flag_reader(reader: Callable[[], bool] | None) -> None:
     global _debug_flag_reader
     _debug_flag_reader = reader
     _debug_cache["checked_at"] = 0.0
+    _debug_cache["backoff"] = 1.0
+    _debug_cache["error_logged"] = False
 
 
 def is_debug_enabled(now: float | None = None) -> bool:
     now = time.monotonic() if now is None else now
-    if now - _debug_cache["checked_at"] >= DEBUG_FLAG_TTL_SECONDS:
+    ttl = DEBUG_FLAG_TTL_SECONDS * _debug_cache["backoff"]
+    if now - _debug_cache["checked_at"] >= ttl:
         _debug_cache["checked_at"] = now
         if _debug_flag_reader is not None:
             try:
                 _debug_cache["value"] = bool(_debug_flag_reader())
-            except Exception:
-                pass
+                _debug_cache["backoff"] = 1.0
+                _debug_cache["error_logged"] = False
+            except Exception as exc:  # noqa: BLE001 - flag source broken
+                if not _debug_cache["error_logged"]:
+                    log(
+                        "debug-flag reader failed "
+                        f"({type(exc).__name__}: {exc}); keeping last value "
+                        "and backing off"
+                    )
+                    _debug_cache["error_logged"] = True
+                _debug_cache["backoff"] = min(
+                    _debug_cache["backoff"] * 2.0, _MAX_BACKOFF_MULTIPLIER
+                )
     return bool(_debug_cache["value"])
 
 

@@ -1,10 +1,15 @@
-"""Per-execution trace logging.
+"""Per-execution trace ids + log-line bridge into span tracing.
 
 Every distributed queue execution gets a trace id `exec_<ms>_<uuid6>`
 threaded from the entry point through orchestration, dispatch, and
-collection, so one grep reconstructs the lifecycle of one job across
-master and worker logs. Parity: reference utils/trace_logger.py +
-api/queue_orchestration.py:38-39.
+collection. Historically the only consumer was grep (one id
+reconstructs a job across master and worker logs); the telemetry
+subsystem (telemetry/tracing.py) subsumes that: the same id keys a
+span TREE served by /distributed/trace/{trace_id}, and `trace_info` /
+`trace_debug` ALSO attach their message as a span event on that trace,
+so the narrative log lines land inside the structured timeline.
+
+Parity: reference utils/trace_logger.py + api/queue_orchestration.py:38-39.
 """
 
 from __future__ import annotations
@@ -20,9 +25,29 @@ def generate_trace_id(node_hint: str | None = None) -> str:
     return f"{base}_{node_hint}" if node_hint else base
 
 
+def _span_event(trace_id: str, message: str, level: str) -> None:
+    """Mirror the log line as an event on the trace's span tree (the
+    active span if this context is inside one, else the root)."""
+    from ..telemetry import get_tracer
+
+    tracer = get_tracer()
+    if tracer.root_span_id(trace_id) is None:
+        return  # no spans for this trace yet; stay log-only
+    if tracer.current_trace_id() == trace_id:
+        tracer.event("log", message=message, level=level)
+    else:
+        token = tracer.activate(trace_id)
+        try:
+            tracer.event("log", message=message, level=level)
+        finally:
+            tracer.deactivate(token)
+
+
 def trace_info(trace_id: str, message: str) -> None:
     log(f"[exec:{trace_id}] {message}")
+    _span_event(trace_id, message, "info")
 
 
 def trace_debug(trace_id: str, message: str) -> None:
     debug_log(f"[exec:{trace_id}] {message}")
+    _span_event(trace_id, message, "debug")

@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""Per-stage latency breakdown from a trace JSONL export.
+
+Input: one span per line, as written by `Tracer.write_jsonl`
+(telemetry/tracing.py) — by the chaos harness (`run_chaos_usdu(...,
+trace_jsonl=...)`), or by a live server with CDT_TRACE_EXPORT_DIR set.
+
+Output: a per-span-name latency table (count / total / mean / p50 /
+p95 / max) and, for spans carrying a `tile_idx` attribute, the
+reconstructed per-tile lifecycle (which stages each tile went through,
+in span-clock order, and which tiles are missing stages).
+
+Stdlib only; importable (tests call `build_report` / `tile_lifecycle`
+directly) and runnable:
+
+    python scripts/perf_report.py trace.jsonl [--trace TRACE_ID] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+# A tile's lifecycle is complete when SOMEONE sampled it and the
+# master blended it. That is the invariant of every completion path:
+# master-computed (pull→sample→blend), worker-computed (worker
+# pull→sample[→encode/submit], master decode→blend), requeue recovery
+# (the successful attempt closes it), and the deadline fallback (the
+# master samples un-pulled tiles directly). Per-tile submit spans are
+# optional — the production worker flushes submits in batches without
+# a tile_idx, while the chaos harness records them per tile.
+REQUIRED_ANY_ROLE = "sample"
+REQUIRED_MASTER = "blend"
+
+
+def load_spans(path: str) -> list[dict[str, Any]]:
+    spans = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line_no, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise SystemExit(f"{path}:{line_no}: bad JSON line: {exc}")
+    return spans
+
+
+def _percentile(sorted_values: list[float], fraction: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, int(round(fraction * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Aggregate span durations per name → latency stats."""
+    by_name: dict[str, list[float]] = {}
+    unfinished = 0
+    for span in spans:
+        duration = span.get("duration")
+        if duration is None:
+            unfinished += 1
+            continue
+        by_name.setdefault(span["name"], []).append(float(duration))
+    stages = {}
+    for name, durations in sorted(by_name.items()):
+        durations.sort()
+        stages[name] = {
+            "count": len(durations),
+            "total": sum(durations),
+            "mean": sum(durations) / len(durations),
+            "p50": _percentile(durations, 0.50),
+            "p95": _percentile(durations, 0.95),
+            "max": durations[-1],
+        }
+    return {
+        "span_count": len(spans),
+        "unfinished_spans": unfinished,
+        "stages": stages,
+    }
+
+
+def tile_lifecycle(spans: list[dict[str, Any]]) -> dict[int, list[dict[str, Any]]]:
+    """Group tile-stage spans by tile index, ordered by span start."""
+    tiles: dict[int, list[dict[str, Any]]] = {}
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        tile_idx = attrs.get("tile_idx")
+        stage = attrs.get("stage")
+        if tile_idx is None or stage is None:
+            continue
+        tiles.setdefault(int(tile_idx), []).append(
+            {
+                "stage": stage,
+                "role": attrs.get("role", "?"),
+                "worker_id": attrs.get("worker_id"),
+                "start": span.get("start"),
+                "duration": span.get("duration"),
+                "status": span.get("status"),
+            }
+        )
+    for stages in tiles.values():
+        stages.sort(key=lambda s: (s["start"] is None, s["start"]))
+    return dict(sorted(tiles.items()))
+
+
+def incomplete_tiles(tiles: dict[int, list[dict[str, Any]]]) -> dict[int, str]:
+    """Tiles whose recorded stages never completed: no participant
+    sampled them, or the master never blended them (requeued tiles
+    legitimately show extra abandoned attempts — one successful
+    attempt closes the lifecycle)."""
+    problems: dict[int, str] = {}
+    for tile_idx, stages in tiles.items():
+        seen: dict[str, set[str]] = {}
+        for stage in stages:
+            seen.setdefault(stage["role"], set()).add(stage["stage"])
+        sampled = any(REQUIRED_ANY_ROLE in st for st in seen.values())
+        blended = REQUIRED_MASTER in seen.get("master", set())
+        if not (sampled and blended):
+            problems[tile_idx] = (
+                "stages seen: "
+                + "; ".join(
+                    f"{role}={sorted(st)}" for role, st in sorted(seen.items())
+                )
+            )
+    return problems
+
+
+def render_text(report: dict[str, Any], tiles, problems) -> str:
+    lines = []
+    lines.append(
+        f"spans: {report['span_count']} "
+        f"(unfinished: {report['unfinished_spans']})"
+    )
+    lines.append("")
+    header = (
+        f"{'span':28} {'count':>6} {'total_s':>10} {'mean_s':>10} "
+        f"{'p50_s':>10} {'p95_s':>10} {'max_s':>10}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, stats in report["stages"].items():
+        lines.append(
+            f"{name:28} {stats['count']:>6} {stats['total']:>10.4f} "
+            f"{stats['mean']:>10.4f} {stats['p50']:>10.4f} "
+            f"{stats['p95']:>10.4f} {stats['max']:>10.4f}"
+        )
+    if tiles:
+        lines.append("")
+        lines.append(f"tile lifecycles: {len(tiles)} tile(s)")
+        for tile_idx, stages in tiles.items():
+            flow = " -> ".join(
+                f"{s['stage']}[{s['role']}"
+                + (f":{s['worker_id']}" if s.get("worker_id") else "")
+                + "]"
+                for s in stages
+            )
+            lines.append(f"  tile {tile_idx:>3}: {flow}")
+        if problems:
+            lines.append("")
+            lines.append(f"INCOMPLETE tiles ({len(problems)}):")
+            for tile_idx, detail in problems.items():
+                lines.append(f"  tile {tile_idx}: {detail}")
+        else:
+            lines.append("  all tile lifecycles complete")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="trace JSONL file (one span per line)")
+    parser.add_argument(
+        "--trace", default=None, help="only spans of this trace id"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        spans = load_spans(args.path)
+    except OSError as exc:
+        print(f"cannot read {args.path}: {exc}", file=sys.stderr)
+        return 1
+    if args.trace:
+        spans = [s for s in spans if s.get("trace_id") == args.trace]
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+    report = build_report(spans)
+    tiles = tile_lifecycle(spans)
+    problems = incomplete_tiles(tiles)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "report": report,
+                    "tiles": {str(k): v for k, v in tiles.items()},
+                    "incomplete": {str(k): v for k, v in problems.items()},
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(render_text(report, tiles, problems))
+    return 2 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,195 @@
+"""End-to-end trace-id propagation (satellite of the telemetry PR):
+
+master `queue_orchestration` → HTTP dispatch with the X-CDT-Trace-Id
+header → worker /prompt executor → tile pull RPCs → collector
+ingestion, asserting ONE connected span tree per execution.
+
+Master and worker are real DistributedServers on loopback sockets
+sharing this process (so the process-global tracer sees both sides of
+every hop — exactly what a single-host multi-process deployment's
+per-host tracer would see for its own spans)."""
+
+import asyncio
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.graph.usdu_elastic import HTTPWorkClient
+from comfyui_distributed_tpu.telemetry import get_tracer
+from comfyui_distributed_tpu.utils import config as config_mod
+from comfyui_distributed_tpu.utils import image as img_utils
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+TRACE_ID = "exec_e2e_000_propagation"
+
+PROMPT = {
+    "1": {
+        "class_type": "EmptyLatentImage",
+        "inputs": {"width": 32, "height": 32, "batch_size": 1},
+    }
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(url: str, payload: dict, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture()
+def cluster(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    master_port, worker_port = _free_port(), _free_port()
+
+    config = config_mod.load_config()
+    config["workers"] = [
+        {
+            "id": "w1", "name": "worker1", "type": "local",
+            "host": "127.0.0.1", "port": worker_port, "enabled": True,
+            "tpu_chips": [], "extra_args": "",
+        }
+    ]
+    # HTTP dispatch (the header-carrying path under test)
+    config.setdefault("settings", {})["websocket_orchestration"] = False
+    config_mod.save_config(config)
+
+    master = DistributedServer(port=master_port, is_worker=False)
+    worker = DistributedServer(port=worker_port, is_worker=True)
+    for srv in (master, worker):
+        asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+            timeout=30
+        )
+    yield master, worker, master_port, worker_port, loop_thread
+    for srv in (master, worker):
+        asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+            timeout=30
+        )
+    loop_thread.stop()
+
+
+def _span_index(spans):
+    return {s["span_id"]: s for s in spans}
+
+
+def _connected_to_root(span, index, root_id):
+    seen = set()
+    while span is not None and span["span_id"] not in seen:
+        if span["span_id"] == root_id:
+            return True
+        seen.add(span["span_id"])
+        span = index.get(span["parent_id"]) if span["parent_id"] else None
+    return False
+
+
+def test_trace_propagates_master_to_worker_to_tile_pull_and_collector(cluster):
+    master, worker, master_port, worker_port, loop_thread = cluster
+    tracer = get_tracer()
+
+    # --- 1. orchestration entry with a caller-supplied trace id ---
+    status, result = _post(
+        f"http://127.0.0.1:{master_port}/distributed/queue",
+        {
+            "prompt": PROMPT,
+            "workers": ["w1"],
+            "client_id": "e2e",
+            "trace_id": TRACE_ID,
+        },
+    )
+    assert status == 200
+    assert result["trace_id"] == TRACE_ID
+    assert result["workers"] == ["w1"]
+
+    # master's own execution + the worker's dispatched execution finish
+    master_job = master._history[f"{TRACE_ID}_master"]
+    assert master_job.done.wait(timeout=30)
+    worker_job = worker._history[f"{TRACE_ID}_w0"]
+    assert worker_job.done.wait(timeout=30)
+    assert worker_job.error is None
+    # the dispatch header carried the trace id into the worker's job
+    assert worker_job.trace_id == TRACE_ID
+
+    # --- 2. tile-pull leg: worker-side client → master RPC endpoints ---
+    asyncio.run_coroutine_threadsafe(
+        master.job_store.init_tile_job("e2e-job", [0, 1]), loop_thread.loop
+    ).result(timeout=10)
+
+    token = tracer.activate(TRACE_ID)
+    try:
+        client = HTTPWorkClient(
+            f"http://127.0.0.1:{master_port}", "e2e-job", "w1"
+        )
+        assert client.trace_id == TRACE_ID  # captured from the context
+        work = client.request_tile()
+        assert work is not None and work["tile_idx"] in (0, 1)
+        client.heartbeat()
+    finally:
+        tracer.deactivate(token)
+
+    # --- 3. collector leg: job_complete with the propagated header ---
+    image = img_utils.encode_image_data_url(
+        np.zeros((4, 4, 3), dtype=np.float32)
+    )
+    asyncio.run_coroutine_threadsafe(
+        master.job_store.ensure_collector("e2e-collect"), loop_thread.loop
+    ).result(timeout=10)
+    status, _body = _post(
+        f"http://127.0.0.1:{master_port}/distributed/job_complete",
+        {
+            "job_id": "e2e-collect", "worker_id": "w1", "batch_idx": 0,
+            "image": image, "is_last": True,
+        },
+        headers={"X-CDT-Trace-Id": TRACE_ID},
+    )
+    assert status == 200
+
+    # --- the assertion: ONE connected span tree for the execution ---
+    spans = tracer.spans(TRACE_ID)
+    names = {s["name"] for s in spans}
+    assert "queue_orchestration" in names        # master orchestration root
+    assert "dispatch" in names                   # master → worker dispatch
+    assert "execute_prompt" in names             # joined via /prompt header
+    assert "rpc.request_image" in names          # tile pull leg
+    assert "rpc.job_complete" in names           # collector leg
+
+    # both roles executed under the SAME trace
+    exec_roles = {
+        s["attrs"].get("role") for s in spans if s["name"] == "execute_prompt"
+    }
+    assert exec_roles == {"master", "worker"}
+
+    # every span reaches the orchestration root by parent links
+    index = _span_index(spans)
+    root_id = tracer.root_span_id(TRACE_ID)
+    root = index[root_id]
+    assert root["name"] == "queue_orchestration"
+    for span in spans:
+        assert _connected_to_root(span, index, root_id), span["name"]
+
+    # and the HTTP surface serves it as a single tree
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{master_port}/distributed/trace/{TRACE_ID}",
+        timeout=10,
+    ) as resp:
+        data = json.loads(resp.read())
+    assert data["span_count"] == len(spans)
+    assert len(data["tree"]) == 1
+    assert data["tree"][0]["name"] == "queue_orchestration"
+
+    # the pull RPC recorded which tile it handed out
+    pull_spans = [s for s in spans if s["name"] == "rpc.request_image"]
+    assert any("tile_idx" in s["attrs"] for s in pull_spans)

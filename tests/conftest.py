@@ -86,13 +86,17 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _reset_resilience_state():
-    """The circuit-breaker registry and the fault-injector override are
-    process-global; isolate tests from each other's failure history."""
+    """The circuit-breaker registry, the fault-injector override, and
+    the telemetry registries are process-global; isolate tests from
+    each other's failure history and metric/span accumulation."""
     yield
     from comfyui_distributed_tpu.resilience import faults, health
+    from comfyui_distributed_tpu import telemetry
 
     health.reset_health_registry()
     faults.reset_fault_injector()
+    telemetry.reset_metrics_registry()
+    telemetry.reset_tracer()
 
 
 @pytest.fixture()

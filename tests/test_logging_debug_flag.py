@@ -1,0 +1,104 @@
+"""Satellite fix: is_debug_enabled must not silently swallow a broken
+debug-flag reader forever — it logs the failure once and backs off
+exponentially instead of re-raising the same hidden error every TTL."""
+
+import pytest
+
+from comfyui_distributed_tpu.utils import logging as log_mod
+from comfyui_distributed_tpu.utils.constants import DEBUG_FLAG_TTL_SECONDS
+
+
+BASE = 1_000_000.0  # far from the module's real monotonic timestamps
+
+
+@pytest.fixture(autouse=True)
+def _restore_reader():
+    log_mod._debug_cache.update(
+        value=False, checked_at=-1e9, backoff=1.0, error_logged=False
+    )
+    yield
+    log_mod.set_debug_flag_reader(None)
+    log_mod._debug_cache.update(
+        value=False, checked_at=0.0, backoff=1.0, error_logged=False
+    )
+
+
+def _drain_ring():
+    log_mod.LOG_RING.clear()
+
+
+def test_reader_failure_logged_once_and_backs_off():
+    calls = []
+
+    def broken_reader():
+        calls.append(1)
+        raise OSError("config unreadable")
+
+    log_mod.set_debug_flag_reader(broken_reader)
+    _drain_ring()
+
+    now = BASE
+    log_mod.is_debug_enabled(now)  # first read: fails, logs once
+    assert len(calls) == 1
+    failure_lines = [l for l in log_mod.LOG_RING if "debug-flag reader failed" in l]
+    assert len(failure_lines) == 1
+    assert "OSError" in failure_lines[0]
+
+    # within the doubled TTL the reader is NOT retried (backoff)
+    log_mod.is_debug_enabled(now + DEBUG_FLAG_TTL_SECONDS)
+    assert len(calls) == 1
+
+    # after the backoff elapses it retries — but does not log again
+    log_mod.is_debug_enabled(now + 2 * DEBUG_FLAG_TTL_SECONDS + 0.1)
+    assert len(calls) == 2
+    failure_lines = [l for l in log_mod.LOG_RING if "debug-flag reader failed" in l]
+    assert len(failure_lines) == 1
+
+
+def test_backoff_is_capped():
+    def broken_reader():
+        raise RuntimeError("still broken")
+
+    log_mod.set_debug_flag_reader(broken_reader)
+    now = BASE
+    for _ in range(20):  # escalate far past the cap
+        now += 1000 * DEBUG_FLAG_TTL_SECONDS
+        log_mod.is_debug_enabled(now)
+    assert log_mod._debug_cache["backoff"] == log_mod._MAX_BACKOFF_MULTIPLIER
+
+
+def test_recovery_resets_backoff_and_relogs_next_breakage():
+    state = {"fail": True}
+
+    def flaky_reader():
+        if state["fail"]:
+            raise OSError("down")
+        return True
+
+    log_mod.set_debug_flag_reader(flaky_reader)
+    _drain_ring()
+    now = BASE
+    log_mod.is_debug_enabled(now)  # fail → backoff 2x, logged
+    state["fail"] = False
+    now += 2 * DEBUG_FLAG_TTL_SECONDS + 0.1
+    assert log_mod.is_debug_enabled(now) is True  # recovered, value read
+    assert log_mod._debug_cache["backoff"] == 1.0
+
+    # a NEW breakage after recovery is logged again (once)
+    state["fail"] = True
+    now += DEBUG_FLAG_TTL_SECONDS + 0.1
+    log_mod.is_debug_enabled(now)
+    failure_lines = [l for l in log_mod.LOG_RING if "debug-flag reader failed" in l]
+    assert len(failure_lines) == 2
+
+    # the cached value survives the breakage (last good value wins)
+    assert log_mod.is_debug_enabled(now) is True
+
+
+def test_reader_value_still_hot_reloads():
+    state = {"value": False}
+    log_mod.set_debug_flag_reader(lambda: state["value"])
+    now = BASE
+    assert log_mod.is_debug_enabled(now) is False
+    state["value"] = True
+    assert log_mod.is_debug_enabled(now + DEBUG_FLAG_TTL_SECONDS + 0.1) is True

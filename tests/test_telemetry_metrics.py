@@ -1,0 +1,182 @@
+"""Metrics registry: instrument semantics, Prometheus exposition
+validity, and the cdt_ naming conventions over the canonical
+instrument set (telemetry/instruments.py)."""
+
+import inspect
+import re
+import threading
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry import (
+    get_metrics_registry,
+    reset_metrics_registry,
+)
+from comfyui_distributed_tpu.telemetry import instruments
+from comfyui_distributed_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+@pytest.fixture()
+def registry():
+    return MetricsRegistry()
+
+
+# --- counters -------------------------------------------------------------
+
+def test_counter_inc_and_labels(registry):
+    c = registry.counter("cdt_x_total", "help", ("worker_id",))
+    c.inc(worker_id="w1")
+    c.inc(2, worker_id="w1")
+    c.inc(worker_id="w2")
+    assert c.value(worker_id="w1") == 3
+    assert c.value(worker_id="w2") == 1
+
+
+def test_counter_rejects_negative_and_bad_labels(registry):
+    c = registry.counter("cdt_x_total", "help", ("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="x")
+    with pytest.raises(ValueError):
+        c.inc(b="x")  # wrong label name
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+
+
+def test_get_or_create_is_idempotent_but_type_safe(registry):
+    c1 = registry.counter("cdt_x_total", "help", ("a",))
+    c2 = registry.counter("cdt_x_total", "help", ("a",))
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        registry.gauge("cdt_x_total", "help", ("a",))
+    with pytest.raises(ValueError):
+        registry.counter("cdt_x_total", "help", ("b",))
+
+
+# --- gauges ---------------------------------------------------------------
+
+def test_gauge_set_inc_dec(registry):
+    g = registry.gauge("cdt_depth", "help")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+
+
+# --- histograms -----------------------------------------------------------
+
+def test_histogram_buckets_cumulative(registry):
+    h = registry.histogram("cdt_lat_seconds", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = registry.render()
+    assert 'cdt_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'cdt_lat_seconds_bucket{le="1"} 3' in text
+    assert 'cdt_lat_seconds_bucket{le="10"} 4' in text
+    assert 'cdt_lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "cdt_lat_seconds_count 5" in text
+    assert h.count() == 5
+
+
+# --- exposition -----------------------------------------------------------
+
+def test_render_format_and_escaping(registry):
+    c = registry.counter("cdt_esc_total", "has \"quotes\"", ("name",))
+    c.inc(name='va"l\nue\\x')
+    text = registry.render()
+    lines = text.strip().splitlines()
+    assert "# HELP cdt_esc_total" in lines[0]
+    assert lines[1] == "# TYPE cdt_esc_total counter"
+    assert lines[2] == 'cdt_esc_total{name="va\\"l\\nue\\\\x"} 1'
+    assert text.endswith("\n")
+
+
+def test_collectors_run_at_scrape_and_errors_are_contained(registry):
+    g = registry.gauge("cdt_live", "help")
+    calls = []
+
+    def good():
+        calls.append(1)
+        g.set(len(calls))
+
+    def broken():
+        raise RuntimeError("boom")
+
+    unregister = registry.register_collector(good)
+    registry.register_collector(broken)
+    text = registry.render()
+    assert "cdt_live 1" in text
+    text = registry.render()
+    assert "cdt_live 2" in text
+    unregister()
+    registry.render()
+    assert len(calls) == 2
+
+
+def test_thread_safety_under_contention(registry):
+    c = registry.counter("cdt_contended_total", "help")
+
+    def bump():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+
+
+# --- global registry ------------------------------------------------------
+
+def test_global_registry_reset():
+    r1 = get_metrics_registry()
+    assert get_metrics_registry() is r1
+    reset_metrics_registry()
+    assert get_metrics_registry() is not r1
+
+
+# --- naming conventions over the canonical instrument set -----------------
+
+_NAME_CONVENTION = re.compile(r"^cdt_[a-z0-9_]+$")
+_LABEL_CONVENTION = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _instrument_accessors():
+    for name, fn in inspect.getmembers(instruments, inspect.isfunction):
+        if (
+            name.startswith("_")
+            or name == "bind_server_collectors"
+            or fn.__module__ != instruments.__name__
+        ):
+            continue
+        sig = inspect.signature(fn)
+        if len(sig.parameters) == 0:
+            yield name, fn
+
+
+def test_every_instrument_follows_naming_conventions():
+    found = []
+    for accessor_name, fn in _instrument_accessors():
+        metric = fn()
+        found.append(metric.name)
+        assert _NAME_CONVENTION.match(metric.name), (accessor_name, metric.name)
+        for label in metric.labelnames:
+            assert _LABEL_CONVENTION.match(label), (metric.name, label)
+        if isinstance(metric, Counter):
+            assert metric.name.endswith("_total"), metric.name
+        if isinstance(metric, Histogram):
+            assert metric.name.endswith("_seconds"), metric.name
+        if isinstance(metric, Gauge):
+            assert not metric.name.endswith("_total"), metric.name
+        assert metric.help, f"{metric.name} needs help text"
+    # the canonical set actually covers the instrumented layers
+    assert "cdt_store_pulls_total" in found
+    assert "cdt_tile_stage_seconds" in found
+    assert "cdt_worker_breaker_state" in found
+    assert "cdt_retries_total" in found

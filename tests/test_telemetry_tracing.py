@@ -1,0 +1,164 @@
+"""Span tracing: nesting, cross-thread trace joining, parenting to the
+trace root, bounded retention, JSONL export, deterministic fake clock."""
+
+import json
+import threading
+
+from comfyui_distributed_tpu.telemetry import Tracer, get_tracer, reset_tracer
+from comfyui_distributed_tpu.resilience.chaos import FakeClock
+
+
+def test_span_nesting_builds_parent_chain():
+    tracer = Tracer()
+    with tracer.span("root", trace_id="t1") as root:
+        with tracer.span("child") as child:
+            with tracer.span("grandchild") as grandchild:
+                pass
+    assert child.trace_id == "t1"
+    assert child.parent_id == root.span_id
+    assert grandchild.parent_id == child.span_id
+    tree = tracer.tree("t1")
+    assert len(tree) == 1
+    assert tree[0]["name"] == "root"
+    assert tree[0]["children"][0]["children"][0]["name"] == "grandchild"
+
+
+def test_orphan_spans_parent_to_trace_root():
+    """A span created with only a trace id (e.g. a server-side RPC span
+    built from the propagated header) connects to the existing root."""
+    tracer = Tracer()
+    with tracer.span("root", trace_id="t1") as root:
+        pass
+    with tracer.span("rpc", trace_id="t1") as rpc:
+        pass
+    assert rpc.parent_id == root.span_id
+    assert len(tracer.tree("t1")) == 1
+
+
+def test_activate_joins_trace_across_threads():
+    tracer = Tracer()
+    with tracer.span("root", trace_id="t1") as root:
+        done = threading.Event()
+
+        def worker():
+            token = tracer.activate("t1")
+            try:
+                with tracer.span("thread_work"):
+                    pass
+            finally:
+                tracer.deactivate(token)
+                done.set()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert done.is_set()
+    spans = tracer.spans("t1")
+    thread_span = next(s for s in spans if s["name"] == "thread_work")
+    assert thread_span["parent_id"] == root.span_id
+
+
+def test_error_status_and_duration():
+    clock = FakeClock(step=1.0)
+    tracer = Tracer(clock=clock)
+    try:
+        with tracer.span("boom", trace_id="t1"):
+            raise ValueError("nope")
+    except ValueError:
+        pass
+    (span,) = tracer.spans("t1")
+    assert span["status"] == "error"
+    assert span["attrs"]["error"].startswith("ValueError")
+    assert span["duration"] == 1.0  # fake clock: start→end is one step
+
+
+def test_events_attach_to_active_span():
+    tracer = Tracer()
+    with tracer.span("root", trace_id="t1"):
+        tracer.event("log", message="hello")
+    (span,) = tracer.spans("t1")
+    assert span["events"][0]["name"] == "log"
+    assert span["events"][0]["attrs"]["message"] == "hello"
+
+
+def test_trace_eviction_bound():
+    tracer = Tracer(max_traces=3)
+    for i in range(5):
+        with tracer.span("s", trace_id=f"t{i}"):
+            pass
+    assert tracer.trace_ids() == ["t2", "t3", "t4"]
+    assert tracer.spans("t0") == []
+
+
+def test_eviction_is_lru_not_insertion_order():
+    """An in-flight execution that keeps producing spans must survive a
+    burst of short traces (or hostile trace-id headers) — eviction
+    drops the least-recently-USED trace, not the oldest-created."""
+    tracer = Tracer(max_traces=3)
+    with tracer.span("root", trace_id="active"):
+        pass
+    for i in range(10):
+        with tracer.span("s", trace_id=f"burst{i}"):
+            pass
+        # the active trace keeps appending spans between bursts
+        with tracer.span("tile", trace_id="active"):
+            pass
+    assert "active" in tracer.trace_ids()
+    active = tracer.spans("active")
+    assert len(active) == 11  # nothing lost to eviction
+    # and the root survived, so the tree stays singly-rooted
+    assert len(tracer.tree("active")) == 1
+
+
+def test_jsonl_export_round_trip(tmp_path):
+    tracer = Tracer(clock=FakeClock())
+    with tracer.span("root", trace_id="t1", kind="test"):
+        with tracer.span("child"):
+            pass
+    path = tmp_path / "trace.jsonl"
+    written = tracer.write_jsonl("t1", str(path))
+    assert written == 2
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert {l["name"] for l in lines} == {"root", "child"}
+    assert all(l["trace_id"] == "t1" for l in lines)
+    assert all(l["end"] is not None for l in lines)
+
+
+def test_fake_clock_spans_are_deterministic():
+    def run():
+        tracer = Tracer(clock=FakeClock())
+        with tracer.span("a", trace_id="t"):
+            with tracer.span("b"):
+                pass
+            with tracer.span("c"):
+                pass
+        return [
+            (s["name"], s["start"], s["end"]) for s in tracer.spans("t")
+        ]
+
+    assert run() == run()
+
+
+def test_global_tracer_reset():
+    t1 = get_tracer()
+    assert get_tracer() is t1
+    reset_tracer()
+    assert get_tracer() is not t1
+
+
+def test_trace_logger_mirrors_into_spans():
+    """trace_info attaches its message as an event on the trace's span
+    tree (the subsumption contract of utils/trace_logger.py)."""
+    from comfyui_distributed_tpu.utils.trace_logger import trace_info
+
+    tracer = get_tracer()
+    with tracer.span("root", trace_id="exec_test_1"):
+        pass
+    trace_info("exec_test_1", "dispatched")
+    (span,) = tracer.spans("exec_test_1")
+    assert any(
+        e["attrs"].get("message") == "dispatched" for e in span["events"]
+    )
+    # a trace with no spans stays log-only (no crash, nothing recorded)
+    trace_info("exec_never_spanned", "message")
+    assert tracer.spans("exec_never_spanned") == []
