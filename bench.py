@@ -323,10 +323,35 @@ def _probe_accelerator(timeout_s: float) -> str:
     return status
 
 
+def _install_runtime_monitoring() -> None:
+    """Register the jax.monitoring compile/cache listeners BEFORE the
+    first program compiles, so the runtime snapshot stamped into the
+    bench datum (telemetry/runtime.py) counts every compile."""
+    try:
+        from comfyui_distributed_tpu.telemetry.runtime import (
+            install_jax_monitoring,
+        )
+
+        install_jax_monitoring()
+    except Exception:  # noqa: BLE001 - profiling context is best effort
+        pass
+
+
+def _runtime_snapshot() -> dict | None:
+    try:
+        from comfyui_distributed_tpu.telemetry.runtime import runtime_snapshot
+
+        return runtime_snapshot()
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _init_jax() -> tuple:
     """Returns (jax, environment_tag). Used by measurement processes
     (children, or a direct BENCH_TINY/BENCH_CPU invocation)."""
     import jax
+
+    _install_runtime_monitoring()
 
     if (
         os.environ.get("BENCH_CPU") == "1"
@@ -1116,6 +1141,12 @@ def main() -> None:
 
     result["environment"] = environment
     result["fallback"] = environment == "cpu_fallback"
+    # JAX runtime profiling context (compiles, cache hits, HBM, RSS):
+    # a throughput datum without it can't distinguish "slow kernel"
+    # from "recompiled every iteration" after the fact.
+    runtime = _runtime_snapshot()
+    if runtime is not None:
+        result["runtime"] = runtime
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

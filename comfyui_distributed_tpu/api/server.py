@@ -88,6 +88,22 @@ class DistributedServer:
         self._unbind_health = bind_quarantine_requeue(
             get_health_registry(), self.job_store
         )
+        # Straggler & stall watchdog: consumes the store's per-worker
+        # pull→submit latencies, pushes stragglers into the breaker as
+        # SUSPECT, and speculatively re-enqueues stalled in-flight
+        # tiles. CDT_WATCHDOG=0 disables it COMPLETELY — no latency
+        # sink, no thread, no final verdict pass on stop — so an
+        # operator who opted out (e.g. a legitimately heterogeneous
+        # fleet) never sees watchdog-driven suspect transitions. The
+        # object always exists so routes/tests can inspect it.
+        from ..telemetry import Watchdog
+
+        self._watchdog_enabled = os.environ.get("CDT_WATCHDOG", "1") != "0"
+        self.watchdog = Watchdog(
+            store=self.job_store, health=get_health_registry()
+        )
+        if self._watchdog_enabled:
+            self.job_store.latency_sink = self.watchdog.record_latency
         # Live-state gauge collectors are bound in start() — a server
         # constructed but never started must not leave a collector
         # (holding a strong reference to it) in the global registry.
@@ -306,6 +322,8 @@ class DistributedServer:
         from ..telemetry import bind_server_collectors
 
         self._unbind_telemetry = bind_server_collectors(self)
+        if self._watchdog_enabled:
+            self.watchdog.start()
         self._executor_thread = threading.Thread(
             target=self._executor_loop, name="cdt-executor", daemon=True
         )
@@ -318,6 +336,14 @@ class DistributedServer:
         log(f"{role} server listening on {self.host}:{self.port}")
 
     async def stop(self) -> None:
+        # Join the watchdog thread OFF the loop: a speculation pass in
+        # flight blocks that thread on a coroutine scheduled on THIS
+        # loop, so joining inline would deadlock until the join timeout
+        # (the executor keeps the loop free to run the coroutine).
+        if self._watchdog_enabled:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.watchdog.stop
+            )
         self._unbind_health()
         self._unbind_telemetry()
         self._prompt_queue.put(None)

@@ -1,24 +1,41 @@
-"""Telemetry routes: Prometheus scrape + per-execution span trees.
+"""Telemetry routes: Prometheus scrape, span trees, live event stream.
 
     GET /distributed/metrics            — Prometheus text exposition
     GET /distributed/trace/{trace_id}   — span tree JSON for one execution
-    GET /distributed/traces             — trace ids currently retained
+    GET /distributed/traces             — paginated trace-id listing
+    GET /distributed/events             — WebSocket live event stream
 
 The metrics body is the process-global registry (counters/histograms
 pushed by the instrumented layers, live-state gauges filled at scrape
-time by the server's collectors — telemetry/instruments.py).
+time by the server's collectors — telemetry/instruments.py, and JAX
+runtime gauges from telemetry/runtime.py).
+
+The event stream pushes `metric_delta`, `span_open`/`span_close`,
+`health_transition`, and watchdog verdict events as JSON text frames
+(one event per frame; schema in docs/observability.md). Clients filter
+server-side with `?types=a,b,c` so an unfiltered metric firehose is
+opt-in, not default-on.
 """
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
+import json
 from typing import Any
 
 from aiohttp import web
 
-from ..telemetry import TRACE_HEADER, get_metrics_registry, get_tracer
+from ..telemetry import (
+    TRACE_HEADER,
+    get_event_bus,
+    get_metrics_registry,
+    get_tracer,
+)
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+DEFAULT_TRACE_PAGE = 50
 
 
 @contextlib.contextmanager
@@ -40,6 +57,7 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/metrics", routes.metrics)
     app.router.add_get("/distributed/trace/{trace_id}", routes.trace)
     app.router.add_get("/distributed/traces", routes.traces)
+    app.router.add_get("/distributed/events", routes.events)
 
 
 class TelemetryRoutes:
@@ -68,5 +86,100 @@ class TelemetryRoutes:
         )
 
     async def traces(self, request: web.Request) -> web.Response:
+        """Paginated listing, most-recently-active first. The page size
+        is clamped to the tracer's retention bound — the listing can
+        never hand out more ids than retention keeps alive."""
         tracer = get_tracer()
-        return web.json_response({"traces": tracer.trace_ids()})
+        try:
+            limit = int(request.query.get("limit", DEFAULT_TRACE_PAGE))
+            offset = int(request.query.get("offset", 0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "limit/offset must be integers"}, status=400
+            )
+        if limit <= 0 or offset < 0:
+            return web.json_response(
+                {"error": "limit must be > 0 and offset >= 0"}, status=400
+            )
+        limit = min(limit, tracer.max_traces)
+        ids = tracer.trace_ids()
+        ids.reverse()  # storage order is LRU: last = most recently active
+        return web.json_response(
+            {
+                "traces": ids[offset : offset + limit],
+                "total": len(ids),
+                "limit": limit,
+                "offset": offset,
+            }
+        )
+
+    async def events(self, request: web.Request) -> web.StreamResponse:
+        """Live event stream over WebSocket. `?types=a,b,c` filters
+        bus-side; every connection starts with a `hello` frame carrying
+        a state snapshot (health + store depths) so consumers don't
+        need a separate poll to initialize."""
+        types_param = request.query.get("types")
+        types = (
+            {t.strip() for t in types_param.split(",") if t.strip()}
+            if types_param
+            else None
+        )
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        bus = get_event_bus()
+        sub = bus.subscribe(types=types)
+        from ..resilience.health import get_health_registry
+
+        hello = {
+            "type": "hello",
+            "seq": None,
+            "ts": None,
+            "data": {
+                "server": (
+                    f"{'worker' if self.server.is_worker else 'master'}:"
+                    f"{self.server.port}"
+                ),
+                "subscribed": sorted(types) if types else "all",
+                "health": get_health_registry().snapshot(),
+                "store": self.server.job_store.stats_unlocked(),
+            },
+        }
+        receiver = asyncio.ensure_future(ws.receive())
+        getter: asyncio.Future | None = None
+        reported_drops = 0
+        try:
+            await ws.send_str(json.dumps(hello, default=str))
+            while True:
+                getter = asyncio.ensure_future(sub.get())
+                done, _pending = await asyncio.wait(
+                    {getter, receiver}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if receiver in done:
+                    break  # client closed (or sent anything; stream is one-way)
+                event = getter.result()
+                getter = None
+                if sub.dropped > reported_drops:
+                    # connection-local notice: schema-uniform frame
+                    # shape, but no bus seq/ts (it never rode the bus)
+                    await ws.send_str(
+                        json.dumps(
+                            {
+                                "type": "events_dropped",
+                                "seq": None,
+                                "ts": None,
+                                "data": {"count": sub.dropped - reported_drops},
+                            }
+                        )
+                    )
+                    reported_drops = sub.dropped
+                await ws.send_str(json.dumps(event, default=str))
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away mid-send / server shutting down
+        finally:
+            bus.unsubscribe(sub)
+            if getter is not None:
+                getter.cancel()
+            receiver.cancel()
+            with contextlib.suppress(Exception):
+                await ws.close()
+        return ws

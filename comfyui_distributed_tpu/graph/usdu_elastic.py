@@ -510,11 +510,15 @@ def run_master_elastic(
         requeued = run_async_in_server_loop(
             store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
         )
-        if requeued:
-            # Requeued ids are back in the pending queue; claim them
-            # through the same pull path workers use so each tile is
-            # processed exactly once (a surviving worker may grab some
-            # before we do).
+        # The pending queue can refill behind our back: heartbeat
+        # requeues (above) AND the watchdog's speculative re-dispatch
+        # of stalled in-flight tiles both route recovery through it.
+        pending_now = run_async_in_server_loop(store.remaining(job_id), timeout=30)
+        if requeued or pending_now:
+            # Requeued/speculated ids are back in the pending queue;
+            # claim them through the same pull path workers use so a
+            # surviving worker may still grab some before we do
+            # (first result wins; duplicates drop in the store).
             while True:
                 with _stage("pull", "master") as pull_span:
                     tile_idx = run_async_in_server_loop(
@@ -760,7 +764,10 @@ def run_master_dynamic(
         requeued = run_async_in_server_loop(
             store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
         )
-        if requeued:
+        # heartbeat requeues or watchdog speculation may have refilled
+        # the pending queue; claim through the shared pull path
+        pending_now = run_async_in_server_loop(store.remaining(job_id), timeout=30)
+        if requeued or pending_now:
             while claim_and_process():
                 pass
         if len(frames) >= batch:

@@ -40,6 +40,14 @@ class TileJob:
     worker_status: dict[str, float] = dataclasses.field(default_factory=dict)
     # worker_id → set of task ids currently assigned (for requeue)
     assigned: dict[str, set[int]] = dataclasses.field(default_factory=dict)
+    # (worker_id, task_id) → assignment monotonic time; the pull→submit
+    # latency the watchdog's straggler detection consumes
+    assigned_at: dict[tuple[str, int], float] = dataclasses.field(
+        default_factory=dict
+    )
+    # task ids already speculatively re-enqueued by the stall watchdog
+    # (each tail tile is speculated at most once per stall)
+    speculated: set[int] = dataclasses.field(default_factory=set)
     finished_workers: set[str] = dataclasses.field(default_factory=set)
     created_at: float = dataclasses.field(default_factory=time.monotonic)
     # batched static mode: one task id covers the whole image batch

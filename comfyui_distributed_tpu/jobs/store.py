@@ -42,6 +42,10 @@ class JobStore:
         self.collectors: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
         self.fault_injector = fault_injector
+        # Optional (worker_id, seconds) callback fed every completed
+        # task's pull→submit latency — the watchdog's straggler signal
+        # (the server wires this to Watchdog.record_latency).
+        self.latency_sink: Optional[Callable[[str, float], None]] = None
         # job_id → [(loop, future)] waiters parked until creation;
         # woken via call_soon_threadsafe so waiters on OTHER loops
         # (asyncio.run fallbacks on compute threads) wake safely.
@@ -206,13 +210,16 @@ class JobStore:
         async with self.lock:
             self._record_heartbeat(job, worker_id)
             job.assigned.setdefault(worker_id, set()).add(task_id)
+            job.assigned_at[(worker_id, task_id)] = time.monotonic()
         instruments.store_pulls_total().inc(worker_id=worker_id, outcome="task")
         return task_id
 
     async def submit_result(
         self, job_id: str, worker_id: str, task_id: int, payload: Any
     ) -> bool:
-        """Record one completed task; False if duplicate (already done)."""
+        """Record one completed task; False if duplicate (already done
+        — a requeued-then-recovered worker's late submission, or the
+        losing side of a speculative race: first result wins)."""
         await self._fault("submit", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
@@ -220,13 +227,28 @@ class JobStore:
         async with self.lock:
             self._record_heartbeat(job, worker_id)
             job.assigned.get(worker_id, set()).discard(task_id)
-            if task_id in job.completed:
-                debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
-                instruments.store_submits_total().inc(
-                    worker_id=worker_id, outcome="duplicate"
-                )
-                return False
-            job.completed[task_id] = payload
+            started = job.assigned_at.pop((worker_id, task_id), None)
+            duplicate = task_id in job.completed
+            if not duplicate:
+                job.completed[task_id] = payload
+        if started is not None:
+            # duplicates still carry a real latency measurement: the
+            # losing worker DID the work, and its speed is exactly what
+            # the straggler detector needs to see
+            elapsed = time.monotonic() - started
+            instruments.worker_tile_seconds().observe(elapsed, worker_id=worker_id)
+            sink = self.latency_sink
+            if sink is not None:
+                try:
+                    sink(worker_id, elapsed)
+                except Exception as exc:  # noqa: BLE001 - observability only
+                    debug_log(f"latency sink failed for {worker_id}: {exc}")
+        if duplicate:
+            debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
+            instruments.store_submits_total().inc(
+                worker_id=worker_id, outcome="duplicate"
+            )
+            return False
         instruments.store_submits_total().inc(
             worker_id=worker_id, outcome="accepted"
         )
@@ -322,6 +344,8 @@ class JobStore:
         """Put a worker's incomplete assigned tasks back on the queue.
         Caller holds self.lock."""
         tasks = job.assigned.pop(worker_id, set())
+        for tid in tasks:
+            job.assigned_at.pop((worker_id, tid), None)
         incomplete = sorted(t for t in tasks if t not in job.completed)
         for tid in incomplete:
             job.pending.put_nowait(tid)
@@ -354,6 +378,43 @@ class JobStore:
                 if incomplete:
                     out[job.job_id] = incomplete
         return out
+
+    async def speculate_in_flight(self, job_id: str) -> list[int]:
+        """Speculative re-dispatch (the watchdog's stall recovery, the
+        MapReduce backup-task move): re-enqueue COPIES of every
+        in-flight incomplete task WITHOUT revoking the original
+        assignment. Whichever attempt submits first is recorded; the
+        loser drops as a duplicate, and per-tile noise keys make both
+        attempts bit-identical, so the race cannot change the output.
+        Each task is speculated at most once (job.speculated)."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return []
+        per_worker: dict[str, list[int]] = {}
+        async with self.lock:
+            for wid, tasks in sorted(job.assigned.items()):
+                for tid in sorted(tasks):
+                    if tid in job.completed or tid in job.speculated:
+                        continue
+                    job.speculated.add(tid)
+                    job.pending.put_nowait(tid)
+                    per_worker.setdefault(wid, []).append(tid)
+        speculated = sorted(t for tids in per_worker.values() for t in tids)
+        if speculated:
+            for wid, tids in per_worker.items():
+                instruments.store_requeued_tasks_total().inc(
+                    len(tids), worker_id=wid, reason="speculative"
+                )
+            from ..telemetry.events import get_event_bus
+
+            get_event_bus().publish(
+                "speculative_requeue", job_id=job_id, task_ids=speculated
+            )
+            log(
+                f"speculatively re-enqueued {len(speculated)} in-flight "
+                f"task(s) on job {job_id}: {speculated}"
+            )
+        return speculated
 
     # --- observability --------------------------------------------------------
 

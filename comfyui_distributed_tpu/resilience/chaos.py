@@ -80,6 +80,11 @@ class ChaosResult:
     fired: list[FaultAction]
     crashed_workers: list[str]
     trace_id: str = ""
+    # watchdog verdicts (populated when run_chaos_usdu(watchdog=...)):
+    stragglers: list[str] = dataclasses.field(default_factory=list)
+    stalls: list[str] = dataclasses.field(default_factory=list)
+    speculated: dict[str, list[int]] = dataclasses.field(default_factory=dict)
+    health: dict[str, dict] = dataclasses.field(default_factory=dict)
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -127,6 +132,7 @@ def run_chaos_usdu(
     worker_timeout: float = 0.6,
     job_id: str = "chaos-job",
     trace_jsonl: Optional[str] = None,
+    watchdog: Optional[dict] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -142,6 +148,15 @@ def run_chaos_usdu(
     from the first instant of the job — plans that slow the master's
     pulls (`latency(..)@store:pull:master`) make worker participation
     deterministic instead of a race the master usually wins.
+
+    `watchdog`: pass a dict of Watchdog overrides (may be empty) to run
+    a live straggler/stall monitor over the harness store — fed by the
+    store's latency sink, pushing stragglers into a PRIVATE
+    HealthRegistry and speculating stalled in-flight tiles through the
+    real requeue path. Verdicts land in ChaosResult.stragglers /
+    .stalls / .speculated / .health. The harness defaults are tight
+    (50 ms interval, 300 ms stall window, min_samples=1) so sub-second
+    chaos plans trigger real detections.
     """
     import jax
     import jax.numpy as jnp
@@ -157,6 +172,20 @@ def run_chaos_usdu(
 
     injector = FaultInjector(fault_plan) if fault_plan else None
     store = JobStore(fault_injector=injector)
+    wd = None
+    wd_health = None
+    if watchdog is not None:
+        from ..telemetry.watchdog import Watchdog
+        from .health import HealthRegistry
+
+        wd_health = HealthRegistry()
+        wd_kwargs = dict(
+            interval=0.05, stall_seconds=0.3, min_samples=1,
+            straggler_factor=4.0,
+        )
+        wd_kwargs.update(watchdog)
+        wd = Watchdog(store=store, health=wd_health, **wd_kwargs)
+        store.latency_sink = wd.record_latency
     server = types.SimpleNamespace(job_store=store)
     ctx = ExecutionContext(server=server, config={"workers": []})
     bundle = types.SimpleNamespace(params=None)
@@ -247,6 +276,11 @@ def run_chaos_usdu(
     try:
         with contextlib.ExitStack() as stack:
             stack.enter_context(_ensure_server_loop())
+            if wd is not None:
+                # start after the loop exists (speculation round-trips
+                # through it); stop (LIFO) before the loop shuts down
+                wd.start()
+                stack.callback(wd.stop)
             stack.enter_context(
                 mock.patch.object(
                     elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
@@ -290,4 +324,8 @@ def run_chaos_usdu(
         fired=list(injector.fired) if injector is not None else [],
         crashed_workers=crashed,
         trace_id=trace_id,
+        stragglers=list(wd.stragglers_flagged) if wd is not None else [],
+        stalls=list(wd.stalls_detected) if wd is not None else [],
+        speculated=dict(wd.speculated) if wd is not None else {},
+        health=wd_health.snapshot() if wd_health is not None else {},
     )

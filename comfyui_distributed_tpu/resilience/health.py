@@ -118,9 +118,18 @@ class HealthRegistry:
         if old is new:
             return
         from ..telemetry import instruments
+        from ..telemetry.events import get_event_bus
 
         instruments.breaker_transitions_total().inc(
             worker_id=worker_id, from_state=old.value, to_state=new.value
+        )
+        # Live stream: health transitions are the events the control
+        # panel (and the watchdog's consumers) care about most.
+        get_event_bus().publish(
+            "health_transition",
+            worker_id=worker_id,
+            from_state=old.value,
+            to_state=new.value,
         )
         with self._lock:
             listeners = list(self._listeners)
@@ -220,6 +229,24 @@ class HealthRegistry:
                 f"worker {worker_id} quarantined after {failures} consecutive "
                 f"failure(s); circuit open for {self.cooldown_seconds:.0f}s"
             )
+        self._fire(worker_id, old, new)
+        return new
+
+    def mark_suspect(self, worker_id: str) -> WorkerState:
+        """Externally-observed degradation (the watchdog's straggler
+        verdict): demote a dispatchable worker to SUSPECT without
+        touching its failure counters — latency is a symptom, not a
+        transport failure, so it must not accumulate toward quarantine.
+        QUARANTINED/PROBING workers are left alone (the breaker already
+        acted); an already-SUSPECT worker is a no-op."""
+        with self._lock:
+            health = self._ensure(worker_id)
+            old = health.state
+            if old in (WorkerState.HEALTHY, WorkerState.RECOVERED):
+                health.state = WorkerState.SUSPECT
+            new = health.state
+        if new is WorkerState.SUSPECT and old is not WorkerState.SUSPECT:
+            log(f"worker {worker_id} marked suspect (watchdog straggler)")
         self._fire(worker_id, old, new)
         return new
 

@@ -1,15 +1,23 @@
-"""Telemetry core: metrics registry, span tracing, canonical instruments.
+"""Telemetry core: metrics, tracing, live events, watchdog, runtime.
 
 The observability subsystem the ROADMAP's perf work hangs off:
 
 - `metrics`: zero-dependency Counter/Gauge/Histogram registry with
-  Prometheus text exposition, served by `/distributed/metrics`;
+  Prometheus text exposition (cardinality-capped per metric), served
+  by `/distributed/metrics`;
 - `tracing`: span trees keyed by the existing ``exec_*`` trace ids,
   propagated master→worker via the ``X-CDT-Trace-Id`` header and
   served by `/distributed/trace/{trace_id}`; JSONL export feeds
   `scripts/perf_report.py`;
 - `instruments`: every metric name/label vocabulary in one place,
-  plus `bind_server_collectors` for live-state gauges.
+  plus `bind_server_collectors` for live-state gauges;
+- `events`: push-based event bus (metric deltas, span open/close,
+  health transitions, watchdog verdicts) streamed by the
+  `GET /distributed/events` WebSocket;
+- `watchdog`: straggler & stall detector feeding breaker suspect
+  transitions and speculative tail-tile re-dispatch;
+- `runtime`: JAX compile/cache/HBM/host-RSS collectors on the scrape,
+  stamped into bench output via `runtime_snapshot`.
 
 All clocks are injectable so tier-1 tests run deterministically on
 CPU. See docs/observability.md for the operator-facing story.
@@ -35,20 +43,26 @@ from .tracing import (
     reset_tracer,
     set_tracer,
 )
+from .events import EventBus, get_event_bus, reset_event_bus
+from .watchdog import Watchdog
 
 __all__ = [
     "BREAKER_STATE_CODES",
     "Counter",
+    "EventBus",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Span",
     "TRACE_HEADER",
     "Tracer",
+    "Watchdog",
     "bind_server_collectors",
     "current_trace_id",
+    "get_event_bus",
     "get_metrics_registry",
     "get_tracer",
+    "reset_event_bus",
     "reset_metrics_registry",
     "reset_tracer",
     "set_tracer",

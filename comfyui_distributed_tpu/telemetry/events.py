@@ -1,0 +1,237 @@
+"""Live observability event bus: push-based deltas for subscribers.
+
+PR 2 made the orchestrator inspectable but pull-based — the control
+panel polls `/prompt`, Prometheus polls `/distributed/metrics`, and
+nothing watches the span stream. This module is the push side: a
+process-global, thread-safe `EventBus` that fans out
+
+- ``metric_delta``       — every Counter/Gauge/Histogram mutation
+                           (forwarded from telemetry.metrics),
+- ``span_open`` / ``span_close`` — span lifecycle (telemetry.tracing),
+- ``health_transition``  — circuit-breaker state changes
+                           (resilience.health),
+- ``straggler_detected`` / ``stall_detected`` /
+  ``speculative_requeue`` — watchdog verdicts (telemetry.watchdog),
+
+to asyncio subscribers, each holding a bounded queue on its own event
+loop. `GET /distributed/events` (api/telemetry_routes.py) serves the
+stream over WebSocket; docs/observability.md documents the wire schema.
+
+Design constraints:
+
+- **zero cost without subscribers**: `publish` is one lock-free
+  subscriber-count check when nobody is listening, so the metric and
+  span hot paths pay nothing in normal operation;
+- **publishers never block**: events are handed to subscriber loops
+  via `call_soon_threadsafe`; a slow consumer's queue drops its OLDEST
+  events (the consumer learns via the subscription's `dropped` count)
+  instead of backpressuring the pipeline;
+- **no feedback loops**: the forwarding hooks are reentrancy-guarded,
+  so an event-bus internal that increments a metric can never recurse
+  into another publish.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from ..utils.constants import EVENT_QUEUE_SIZE
+
+
+class Subscription:
+    """One consumer's bounded event queue, bound to the asyncio loop
+    that called `EventBus.subscribe`. `get()` awaits the next event;
+    `dropped` counts events discarded because the queue was full."""
+
+    __slots__ = ("loop", "queue", "types", "dropped", "closed")
+
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        maxsize: int,
+        types: Optional[frozenset[str]],
+    ) -> None:
+        self.loop = loop
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self.types = types
+        self.dropped = 0
+        self.closed = False
+
+    def wants(self, event_type: str) -> bool:
+        return self.types is None or event_type in self.types
+
+    def _offer(self, event: dict[str, Any]) -> None:
+        """Runs ON the subscriber's loop: drop-oldest on overflow."""
+        if self.closed:
+            return
+        while self.queue.full():
+            try:
+                self.queue.get_nowait()
+                self.dropped += 1
+            except asyncio.QueueEmpty:  # pragma: no cover - race guard
+                break
+        self.queue.put_nowait(event)
+
+    async def get(self) -> dict[str, Any]:
+        return await self.queue.get()
+
+
+class EventBus:
+    """Thread-safe pub/sub fan-out with per-subscriber bounded queues."""
+
+    def __init__(self, clock=time.time) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._subs: list[Subscription] = []
+        self._seq = 0
+        self.published = 0  # plain ints: bus internals must not publish
+
+    @property
+    def subscriber_count(self) -> int:
+        # unlocked read of a list length: the no-subscriber fast path
+        # must not contend with the publish path
+        return len(self._subs)
+
+    def subscribe(
+        self,
+        types: Optional[Iterable[str]] = None,
+        maxsize: Optional[int] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+    ) -> Subscription:
+        """Register a consumer on the CURRENT running loop (or `loop`).
+        `types` filters bus-side so unwanted events never hit the
+        queue; None subscribes to everything."""
+        loop = loop or asyncio.get_running_loop()
+        sub = Subscription(
+            loop,
+            maxsize if maxsize is not None else EVENT_QUEUE_SIZE,
+            frozenset(types) if types is not None else None,
+        )
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        sub.closed = True
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def publish(self, event_type: str, **data: Any) -> None:
+        """Fan one event out to every matching subscriber; callable
+        from any thread; never raises, never blocks."""
+        if not self._subs:
+            return
+        with self._lock:
+            self._seq += 1
+            event = {
+                "type": event_type,
+                "seq": self._seq,
+                "ts": self._clock(),
+                "data": data,
+            }
+            targets = [s for s in self._subs if s.wants(event_type)]
+            if targets:
+                self.published += 1
+        dead: list[Subscription] = []
+        for sub in targets:
+            try:
+                sub.loop.call_soon_threadsafe(sub._offer, event)
+            except RuntimeError:
+                dead.append(sub)  # loop closed under the subscriber
+        for sub in dead:
+            self.unsubscribe(sub)
+
+
+# --- forwarding hooks (metrics / spans → bus) ------------------------------
+
+_suppress = threading.local()
+
+
+def _forward_metric(kind, name, labelnames, labelvalues, value) -> None:
+    """telemetry.metrics mutation listener → ``metric_delta`` events.
+    `value` is the increment for counters, the new value for gauges,
+    and the observation for histograms."""
+    bus = get_event_bus()
+    if not bus.subscriber_count or getattr(_suppress, "active", False):
+        return
+    _suppress.active = True
+    try:
+        bus.publish(
+            "metric_delta",
+            metric=name,
+            kind=kind,
+            labels=dict(zip(labelnames, labelvalues)),
+            value=value,
+        )
+    finally:
+        _suppress.active = False
+
+
+def _forward_span(phase: str, span) -> None:
+    """telemetry.tracing span listener → span_open / span_close."""
+    bus = get_event_bus()
+    if not bus.subscriber_count or getattr(_suppress, "active", False):
+        return
+    _suppress.active = True
+    try:
+        payload = {
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "name": span.name,
+            "start": span.start,
+            "attrs": dict(span.attrs),
+        }
+        if phase == "close":
+            payload["end"] = span.end
+            payload["duration"] = span.duration
+            payload["status"] = span.status
+        bus.publish(f"span_{phase}", **payload)
+    finally:
+        _suppress.active = False
+
+
+def install_forwarding() -> None:
+    """Idempotently wire the metrics registry and tracer mutation hooks
+    into the bus (module import of telemetry.events does this once).
+    The hooks survive registry/tracer resets — they always resolve the
+    CURRENT global bus."""
+    from . import metrics, tracing
+
+    metrics.set_mutation_listener(_forward_metric)
+    tracing.set_span_listener(_forward_span)
+
+
+# --- global bus ------------------------------------------------------------
+
+_bus: EventBus | None = None
+_bus_lock = threading.Lock()
+
+
+def get_event_bus() -> EventBus:
+    # Lock-free fast path: this runs on EVERY metric mutation and span
+    # open/close via the forwarding hooks, so the instrumented hot
+    # paths must not serialize on a global mutex (module-global reads
+    # are atomic; the lock only guards one-time creation).
+    global _bus
+    bus = _bus
+    if bus is not None:
+        return bus
+    with _bus_lock:
+        if _bus is None:
+            _bus = EventBus()
+        return _bus
+
+
+def reset_event_bus() -> None:
+    """Drop the global bus (tests); forwarding hooks re-resolve."""
+    global _bus
+    with _bus_lock:
+        _bus = None
+
+
+install_forwarding()

@@ -135,6 +135,80 @@ def breaker_state() -> Gauge:
     )
 
 
+# --- watchdog (telemetry/watchdog.py) -------------------------------------
+
+def worker_tile_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_worker_tile_seconds",
+        "Pull-to-submit latency per worker (the straggler-detection "
+        "signal; cardinality-capped per the registry's series bound)",
+        ("worker_id",),
+    )
+
+
+def watchdog_stragglers_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_watchdog_stragglers_total",
+        "Workers flagged as stragglers (rolling-median tile latency "
+        "above k x the global rolling median)",
+        ("worker_id",),
+    )
+
+
+def watchdog_stalls_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_watchdog_stalls_total",
+        "Stalled executions detected (no completion progress for the "
+        "stall window while tiles were in flight)",
+    )
+
+
+# --- JAX runtime health (telemetry/runtime.py) ----------------------------
+
+def jax_compiles() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_jax_compiles",
+        "Backend compiles observed since process start (jax.monitoring)",
+    )
+
+
+def jax_compile_time_seconds() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_jax_compile_time_seconds",
+        "Cumulative backend compile time since process start",
+    )
+
+
+def jax_cache_hits() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_jax_cache_hits",
+        "Compilation-cache hits since process start",
+    )
+
+
+def jax_cache_misses() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_jax_cache_misses",
+        "Compilation-cache misses since process start",
+    )
+
+
+def device_memory_bytes() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_device_memory_bytes",
+        "Accelerator memory stats per device (bytes_in_use, "
+        "peak_bytes_in_use, bytes_limit, ... from device.memory_stats)",
+        ("device", "stat"),
+    )
+
+
+def host_rss_bytes() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_host_rss_bytes",
+        "Resident set size of this process",
+    )
+
+
 # --- USDU tile pipeline ---------------------------------------------------
 
 def tile_stage_seconds() -> Histogram:
@@ -216,6 +290,11 @@ def bind_server_collectors(server) -> Callable[[], None]:
     Returns an unbind callable (the server calls it on stop) that also
     drops the server's gauge series from the scrape."""
     from ..resilience.health import get_health_registry
+    from .runtime import ensure_runtime_collectors
+
+    # JAX runtime gauges (compiles, cache hits, HBM, host RSS) ride the
+    # same scrape; process-global, bound once per registry.
+    ensure_runtime_collectors()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
 

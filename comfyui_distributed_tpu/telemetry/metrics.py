@@ -28,12 +28,47 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import re
 import threading
 from typing import Callable, Iterable, Optional, Sequence
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Label value every series beyond a metric's cardinality cap collapses
+# into (one synthetic series per metric, not one per hostile value).
+OVERFLOW_LABEL_VALUE = "_overflow"
+
+# Distinct label sets allowed per metric before overflow collapsing
+# kicks in. Worker ids / stage names are small vocabularies in healthy
+# operation; the cap exists so a worker-id churn storm (or a hostile
+# caller spraying ids at an RPC surface) can't grow the registry — and
+# every Prometheus scrape — without bound.
+DEFAULT_MAX_SERIES = 128
+
+
+def _env_max_series() -> int:
+    try:
+        value = int(os.environ.get("CDT_METRIC_MAX_SERIES", DEFAULT_MAX_SERIES))
+    except (TypeError, ValueError):
+        return DEFAULT_MAX_SERIES
+    return value if value > 0 else DEFAULT_MAX_SERIES
+
+
+# Mutation listener: the live event bus (telemetry/events.py) installs
+# one callback here that forwards every Counter/Gauge/Histogram update
+# as a `metric_delta` event. Kept as a plain module global so the hot
+# path pays a single None-check when nothing is listening.
+_mutation_listener: Optional[Callable] = None
+
+
+def set_mutation_listener(fn: Optional[Callable]) -> None:
+    """Install the (kind, name, labelnames, labelvalues, value) mutation
+    callback; None uninstalls. Listener errors are swallowed — pushing
+    telemetry must never break the instrumented path."""
+    global _mutation_listener
+    _mutation_listener = fn
 
 # Default latency buckets: 1ms .. 60s, roughly log-spaced — wide enough
 # for both sub-ms store ops and multi-second dispatch/tile timings.
@@ -77,7 +112,14 @@ class _Metric:
 
     kind = "untyped"
 
-    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str] = (),
+        max_series: Optional[int] = None,
+        on_overflow: Optional[Callable[[str], None]] = None,
+    ):
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         for label in labelnames:
@@ -86,6 +128,9 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self.max_series = max_series if max_series is not None else _env_max_series()
+        self._on_overflow = on_overflow
+        self._overflow_logged = False
         self._lock = threading.Lock()
 
     def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
@@ -95,6 +140,44 @@ class _Metric:
                 f"{tuple(sorted(labels))}"
             )
         return tuple(str(labels[name]) for name in self.labelnames)
+
+    def _bounded_key(
+        self, key: tuple[str, ...], container: dict
+    ) -> tuple[str, ...]:
+        """Cap distinct label sets per metric: a NEW series beyond
+        `max_series` collapses into the `_overflow` series instead of
+        growing the registry (caller holds self._lock)."""
+        if not self.labelnames or key in container or len(container) < self.max_series:
+            return key
+        if self._on_overflow is not None:
+            try:
+                self._on_overflow(self.name)
+            except Exception:  # noqa: BLE001 - accounting must not break writes
+                pass
+        if not self._overflow_logged:
+            self._overflow_logged = True
+            try:
+                from ..utils.logging import log
+
+                log(
+                    f"metric {self.name} hit its series cap "
+                    f"({self.max_series}); further label sets collapse "
+                    f"into {OVERFLOW_LABEL_VALUE!r} "
+                    "(cdt_metric_series_overflow_total counts them)"
+                )
+            except Exception:  # noqa: BLE001 - logging is best effort
+                pass
+        return (OVERFLOW_LABEL_VALUE,) * len(self.labelnames)
+
+    def _notify(self, labelvalues: tuple[str, ...], value: float) -> None:
+        """Forward one mutation to the event-bus listener (no-op when
+        none installed); called OUTSIDE the metric lock."""
+        listener = _mutation_listener
+        if listener is not None:
+            try:
+                listener(self.kind, self.name, self.labelnames, labelvalues, value)
+            except Exception:  # noqa: BLE001 - telemetry must not break writes
+                pass
 
     def samples(self) -> Iterable[str]:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -109,8 +192,8 @@ class _Metric:
 class Counter(_Metric):
     kind = "counter"
 
-    def __init__(self, name, help, labelnames=()):
-        super().__init__(name, help, labelnames)
+    def __init__(self, name, help, labelnames=(), **kwargs):
+        super().__init__(name, help, labelnames, **kwargs)
         self._values: dict[tuple[str, ...], float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -118,7 +201,9 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = self._key(labels)
         with self._lock:
+            key = self._bounded_key(key, self._values)
             self._values[key] = self._values.get(key, 0.0) + amount
+        self._notify(key, amount)
 
     def value(self, **labels: str) -> float:
         key = self._key(labels)
@@ -138,19 +223,24 @@ class Counter(_Metric):
 class Gauge(_Metric):
     kind = "gauge"
 
-    def __init__(self, name, help, labelnames=()):
-        super().__init__(name, help, labelnames)
+    def __init__(self, name, help, labelnames=(), **kwargs):
+        super().__init__(name, help, labelnames, **kwargs)
         self._values: dict[tuple[str, ...], float] = {}
 
     def set(self, value: float, **labels: str) -> None:
         key = self._key(labels)
         with self._lock:
+            key = self._bounded_key(key, self._values)
             self._values[key] = float(value)
+        self._notify(key, float(value))
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = self._key(labels)
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            key = self._bounded_key(key, self._values)
+            value = self._values.get(key, 0.0) + amount
+            self._values[key] = value
+        self._notify(key, value)
 
     def dec(self, amount: float = 1.0, **labels: str) -> None:
         self.inc(-amount, **labels)
@@ -185,8 +275,10 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help, labelnames=(), buckets=DEFAULT_TIME_BUCKETS):
-        super().__init__(name, help, labelnames)
+    def __init__(
+        self, name, help, labelnames=(), buckets=DEFAULT_TIME_BUCKETS, **kwargs
+    ):
+        super().__init__(name, help, labelnames, **kwargs)
         bounds = sorted(float(b) for b in buckets)
         if not bounds:
             raise ValueError(f"{name}: histogram needs at least one bucket")
@@ -198,6 +290,7 @@ class Histogram(_Metric):
         key = self._key(labels)
         value = float(value)
         with self._lock:
+            key = self._bounded_key(key, self._series)
             series = self._series.get(key)
             if series is None:
                 series = [[0] * len(self.bounds), 0.0, 0]
@@ -207,6 +300,7 @@ class Histogram(_Metric):
                 series[0][idx] += 1
             series[1] += value
             series[2] += 1
+        self._notify(key, value)
 
     def count(self, **labels: str) -> int:
         key = self._key(labels)
@@ -238,10 +332,27 @@ class Histogram(_Metric):
 class MetricsRegistry:
     """Name-indexed instrument registry + scrape-time collectors."""
 
+    OVERFLOW_COUNTER_NAME = "cdt_metric_series_overflow_total"
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], None]] = []
+        # Warning counter for cardinality-cap hits; created eagerly so
+        # it exists (at 0 series) before any storm, and with a cap
+        # bounded by metric-name count (and no on_overflow: the
+        # accounting metric must not recurse into itself).
+        self._overflow_counter = Counter(
+            self.OVERFLOW_COUNTER_NAME,
+            "Mutations collapsed into a metric's _overflow series "
+            "because the per-metric label-set cap was hit",
+            ("metric",),
+            max_series=4096,
+        )
+        self._metrics[self.OVERFLOW_COUNTER_NAME] = self._overflow_counter
+
+    def _record_overflow(self, name: str) -> None:
+        self._overflow_counter.inc(metric=name)
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):
         with self._lock:
@@ -255,6 +366,7 @@ class MetricsRegistry:
                         "type or label set"
                     )
                 return existing
+            kwargs.setdefault("on_overflow", self._record_overflow)
             metric = cls(name, help, labelnames, **kwargs)
             self._metrics[name] = metric
             return metric

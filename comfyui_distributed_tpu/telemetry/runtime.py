@@ -1,0 +1,191 @@
+"""JAX runtime health: compile activity, cache hits, HBM, host RSS.
+
+Scrape-time collectors that put the *runtime* next to the *protocol*
+on `/distributed/metrics`: a latency regression means nothing without
+knowing whether the process was recompiling, missing the compilation
+cache, or running the chip's HBM to the edge. The same snapshot is
+stamped into `bench.py` output so every BENCH round carries its
+profiling context.
+
+Three sources, all optional and all failure-isolated:
+
+- **jax.monitoring** — `install_jax_monitoring()` registers listeners
+  for the backend-compile duration event and the compilation-cache
+  hit/miss events. Installed once per process (idempotent), as early
+  as possible (server start, bench init) so compiles are counted from
+  the first program.
+- **device.memory_stats()** — per-device HBM gauges
+  (`bytes_in_use`, `peak_bytes_in_use`, `bytes_limit`, ...). Only
+  consulted when jax is ALREADY imported: a metrics scrape must never
+  be the thing that triggers backend init on a dark chip
+  (docs/operator-runbook.md §4b). `CDT_RUNTIME_DEVICE_STATS=0`
+  disables device enumeration at scrape entirely.
+- **psutil** — host RSS of this process.
+
+`ensure_runtime_collectors()` binds the scrape collector to the
+CURRENT global registry (re-binding transparently after a test reset);
+`runtime_snapshot()` returns the same numbers as a plain dict for
+bench stamping.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any
+
+from . import instruments
+from .metrics import MetricsRegistry, get_metrics_registry
+
+# Monotonic process-lifetime tallies filled by the jax.monitoring
+# listeners; plain floats/ints guarded by a lock (listener callbacks
+# can fire from compile threads).
+_tallies_lock = threading.Lock()
+_tallies = {
+    "compiles": 0,
+    "compile_time_s": 0.0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+}
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_monitoring_installed = False
+_bound_registry: MetricsRegistry | None = None
+_bind_lock = threading.Lock()
+
+
+def install_jax_monitoring() -> bool:
+    """Register jax.monitoring listeners for compile + cache events;
+    idempotent; returns False when the API is unavailable."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 - jax absent or too old
+        return False
+
+    def on_event(event: str, **kwargs: Any) -> None:
+        with _tallies_lock:
+            if event == _CACHE_HIT_EVENT:
+                _tallies["cache_hits"] += 1
+            elif event == _CACHE_MISS_EVENT:
+                _tallies["cache_misses"] += 1
+
+    def on_duration(event: str, duration: float, **kwargs: Any) -> None:
+        if event == _BACKEND_COMPILE_EVENT:
+            with _tallies_lock:
+                _tallies["compiles"] += 1
+                _tallies["compile_time_s"] += float(duration)
+
+    try:
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:  # noqa: BLE001 - listener API drift
+        return False
+    _monitoring_installed = True
+    return True
+
+
+def _host_rss_bytes() -> int | None:
+    try:
+        import psutil
+
+        return int(psutil.Process().memory_info().rss)
+    except Exception:  # noqa: BLE001 - psutil optional
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux (peak, not current — close enough
+            # for a fallback gauge)
+            return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def _device_memory() -> list[dict[str, Any]]:
+    """Per-device memory stats, ONLY if jax is already initialized in
+    this process (never trigger backend init from a scrape)."""
+    if os.environ.get("CDT_RUNTIME_DEVICE_STATS", "1") == "0":
+        return []
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 - backend not ready
+        return []
+    out = []
+    for device in devices:
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:  # noqa: BLE001 - CPU devices often raise
+            stats = {}
+        out.append(
+            {
+                "id": f"{device.platform}:{getattr(device, 'id', '?')}",
+                "kind": str(getattr(device, "device_kind", "?")),
+                "platform": device.platform,
+                "memory": {k: v for k, v in stats.items() if isinstance(v, (int, float))},
+            }
+        )
+    return out
+
+
+def collect_runtime_gauges() -> None:
+    """Scrape-time collector body: refresh the cdt_jax_* / host gauges
+    from the monitoring tallies and live device state."""
+    with _tallies_lock:
+        snap = dict(_tallies)
+    instruments.jax_compiles().set(snap["compiles"])
+    instruments.jax_compile_time_seconds().set(snap["compile_time_s"])
+    instruments.jax_cache_hits().set(snap["cache_hits"])
+    instruments.jax_cache_misses().set(snap["cache_misses"])
+    rss = _host_rss_bytes()
+    if rss is not None:
+        instruments.host_rss_bytes().set(rss)
+    gauge = instruments.device_memory_bytes()
+    gauge.clear()  # devices can disappear (tunnel drop); don't freeze stale series
+    for device in _device_memory():
+        for stat, value in device["memory"].items():
+            gauge.set(value, device=device["id"], stat=stat)
+
+
+def ensure_runtime_collectors() -> None:
+    """Bind `collect_runtime_gauges` to the current global registry
+    (idempotent per registry — a test reset re-binds on next call) and
+    make sure the jax.monitoring listeners are installed."""
+    global _bound_registry
+    install_jax_monitoring()
+    registry = get_metrics_registry()
+    with _bind_lock:
+        if _bound_registry is registry:
+            return
+        registry.register_collector(collect_runtime_gauges)
+        _bound_registry = registry
+
+
+def runtime_snapshot() -> dict[str, Any]:
+    """The same runtime health numbers as a plain dict — stamped into
+    bench.py's JSON datum so BENCH rounds carry profiling context."""
+    with _tallies_lock:
+        out: dict[str, Any] = dict(_tallies)
+    out["compile_time_s"] = round(out["compile_time_s"], 3)
+    rss = _host_rss_bytes()
+    if rss is not None:
+        out["host_rss_bytes"] = rss
+    devices = _device_memory()
+    if devices:
+        out["devices"] = devices
+    return out
+
+
+def reset_runtime_tallies() -> None:
+    """Zero the monitoring tallies (tests)."""
+    with _tallies_lock:
+        for key in _tallies:
+            _tallies[key] = 0 if key != "compile_time_s" else 0.0

@@ -47,6 +47,26 @@ _current: contextvars.ContextVar[Optional[tuple[str, Optional[str]]]] = (
     contextvars.ContextVar("cdt_current_span", default=None)
 )
 
+# Span lifecycle listener: the live event bus (telemetry/events.py)
+# installs one callback that forwards span open/close as stream events.
+_span_listener: Optional[Callable[[str, "Span"], None]] = None
+
+
+def set_span_listener(fn: Optional[Callable[[str, "Span"], None]]) -> None:
+    """Install the (phase, span) lifecycle callback (phase is "open" or
+    "close"); None uninstalls. Errors are swallowed."""
+    global _span_listener
+    _span_listener = fn
+
+
+def _notify_span(phase: str, span: "Span") -> None:
+    listener = _span_listener
+    if listener is not None:
+        try:
+            listener(phase, span)
+        except Exception:  # noqa: BLE001 - telemetry must not break tracing
+            pass
+
 
 class Span:
     __slots__ = (
@@ -197,6 +217,7 @@ class Tracer:
             attrs=attrs,
         )
         self._store(span)
+        _notify_span("open", span)
         return span
 
     def end_span(self, span: Span, status: str = "ok") -> None:
@@ -206,6 +227,7 @@ class Tracer:
             # whose failure is swallowed by a best-effort except arm)
             if span.status == "ok":
                 span.status = status
+            _notify_span("close", span)
 
     @contextlib.contextmanager
     def span(

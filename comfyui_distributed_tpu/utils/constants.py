@@ -78,6 +78,25 @@ CIRCUIT_SUSPECT_THRESHOLD = _env_int("CDT_CIRCUIT_SUSPECT_AFTER", 2)
 CIRCUIT_FAILURE_THRESHOLD = _env_int("CDT_CIRCUIT_FAILURES", 5)
 CIRCUIT_COOLDOWN_SECONDS = _env_float("CDT_CIRCUIT_COOLDOWN", 30.0)
 
+# --- watchdog (telemetry/watchdog.py) -------------------------------------
+# The straggler & stall detector: a worker whose rolling-median tile
+# latency exceeds STRAGGLER_FACTOR x the global rolling median (with at
+# least MIN_SAMPLES completions in its window) is flagged suspect; a
+# job with no completion progress for STALL seconds gets its in-flight
+# tail tiles speculatively re-enqueued. CDT_WATCHDOG=0 disables the
+# server's background monitor thread entirely.
+WATCHDOG_INTERVAL_SECONDS = _env_float("CDT_WATCHDOG_INTERVAL", 2.0)
+WATCHDOG_STRAGGLER_FACTOR = _env_float("CDT_WATCHDOG_STRAGGLER_FACTOR", 4.0)
+WATCHDOG_MIN_SAMPLES = _env_int("CDT_WATCHDOG_MIN_SAMPLES", 3)
+WATCHDOG_STALL_SECONDS = _env_float("CDT_WATCHDOG_STALL_SECONDS", 30.0)
+WATCHDOG_LATENCY_WINDOW = _env_int("CDT_WATCHDOG_LATENCY_WINDOW", 64)
+
+# --- live event stream (telemetry/events.py) ------------------------------
+# Per-subscriber bounded queue size for /distributed/events; a consumer
+# slower than the event rate loses its OLDEST events (drop-oldest) and
+# is told how many via the subscription's dropped count.
+EVENT_QUEUE_SIZE = _env_int("CDT_EVENT_QUEUE_SIZE", 512)
+
 # --- job init races ------------------------------------------------------
 # Grace period a result-submission endpoint waits for the master-side queue
 # to be created (reference api/job_routes.py:314-333), and the worker-side

@@ -16,14 +16,18 @@
 
 import { api, probeWorker } from "./modules/apiClient.js";
 import {
-  POLL_ACTIVE_MS,
-  POLL_IDLE_MS,
   computeAnythingBusy,
   enabledWorkers,
+  pollDelay,
   pruneWorkerStatus,
   reduceWorkerStatus,
   state,
 } from "./modules/state.js";
+import {
+  connectEvents,
+  EVENT_TYPES,
+  reduceLiveStatus,
+} from "./modules/events.js";
 import {
   clampDividerParts,
   collectOverrides,
@@ -92,13 +96,54 @@ function schedulePoll() {
   clearTimeout(state.pollTimer);
   state.pollTimer = setTimeout(
     refreshStatus,
-    state.anythingBusy ? POLL_ACTIVE_MS : POLL_IDLE_MS
+    pollDelay(state.anythingBusy, state.eventsConnected)
   );
 }
 
 function setDot(id, cls) {
   const el = document.getElementById(id);
   el.className = `dot ${cls}`;
+}
+
+// ---------- live event stream (replaces the fast poll while open) ----------
+
+function renderLiveEvents() {
+  const { connected, events } = state.liveStatus;
+  setDot("events-dot", connected ? "online" : "offline");
+  document.getElementById("events-summary").textContent = connected
+    ? "streaming"
+    : "polling fallback";
+  const container = document.getElementById("live-events");
+  if (!events.length) {
+    container.textContent = "waiting for events…";
+    return;
+  }
+  container.innerHTML = events
+    .map((e) => `<div>${escapeHtml(e.label)}</div>`)
+    .join("");
+}
+
+function startEventStream() {
+  const proto = location.protocol === "https:" ? "wss" : "ws";
+  const types = EVENT_TYPES.join(",");
+  connectEvents({
+    url: `${proto}://${location.host}/distributed/events?types=${types}`,
+    onEvent: (event) => {
+      state.liveStatus = reduceLiveStatus(state.liveStatus, event);
+      renderLiveEvents();
+      if (event.type === "health_transition") {
+        // a breaker just moved; reflect it in the worker list now
+        // instead of waiting for the idle poll tick
+        refreshStatus();
+      }
+    },
+    onStatus: (connected) => {
+      state.eventsConnected = connected;
+      state.liveStatus = { ...state.liveStatus, connected };
+      renderLiveEvents();
+      schedulePoll(); // cadence follows the stream state
+    },
+  });
 }
 
 // ---------- settings / topology ----------
@@ -465,6 +510,7 @@ document.getElementById("tunnel-toggle").addEventListener("click", async () => {
   await renderTopology();
   await loadExamples();
   refreshStatus();
+  startEventStream();
   renderNetworkInfo();
   setInterval(refreshMasterLog, 3000);
   refreshMasterLog();

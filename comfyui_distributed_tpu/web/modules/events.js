@@ -1,0 +1,125 @@
+/* Live event-stream consumer for GET /distributed/events.
+ *
+ * The push counterpart of the panel's adaptive status polling: while a
+ * WebSocket to /distributed/events is open, the panel stops fast-poll
+ * spinning and reacts to pushed health/watchdog/metric events instead;
+ * on disconnect it falls back to the poll loop and retries with
+ * backoff.
+ *
+ * Pure logic (reduceLiveStatus, eventLabel, nextRetryDelay) is
+ * separated from the socket wiring (connectEvents) so the reduction is
+ * testable without a browser, matching the modules/ convention.
+ */
+
+"use strict";
+
+export const EVENT_TYPES = [
+  "health_transition",
+  "straggler_detected",
+  "stall_detected",
+  "speculative_requeue",
+];
+
+export const MAX_LIVE_EVENTS = 20;
+export const RETRY_BASE_MS = 2000;
+export const RETRY_MAX_MS = 30000;
+
+/** One step of the live-status reduction: fold a decoded event into
+ * {connected, breakers, events} (events = newest-first ring of
+ * display-ready entries, capped at MAX_LIVE_EVENTS). */
+export function reduceLiveStatus(prev, event) {
+  const next = {
+    connected: true,
+    breakers: { ...(prev?.breakers || {}) },
+    events: [...(prev?.events || [])],
+  };
+  if (event.type === "hello") {
+    for (const [id, h] of Object.entries(event.data?.health || {})) {
+      next.breakers[id] = h.state;
+    }
+    return next;
+  }
+  if (event.type === "health_transition") {
+    next.breakers[event.data.worker_id] = event.data.to_state;
+  }
+  const label = eventLabel(event);
+  if (label) {
+    next.events.unshift({ ts: event.ts, label });
+    next.events.length = Math.min(next.events.length, MAX_LIVE_EVENTS);
+  }
+  return next;
+}
+
+/** Human line for one stream event; null = not display-worthy
+ * (metric deltas and span noise stay off the panel). */
+export function eventLabel(event) {
+  const d = event.data || {};
+  switch (event.type) {
+    case "health_transition":
+      return `worker ${d.worker_id}: ${d.from_state} → ${d.to_state}`;
+    case "straggler_detected":
+      return `straggler: ${d.worker_id} (median ${Number(
+        d.median_seconds
+      ).toFixed(2)}s vs ${Number(d.global_median_seconds).toFixed(2)}s)`;
+    case "stall_detected":
+      return `stall: job ${d.job_id} quiet ${Number(d.quiet_seconds).toFixed(
+        1
+      )}s (${d.in_flight} in flight)`;
+    case "speculative_requeue":
+      return `speculative re-dispatch: job ${d.job_id} tiles [${(
+        d.task_ids || []
+      ).join(", ")}]`;
+    case "events_dropped":
+      return `stream dropped ${d.count} event(s) (slow consumer)`;
+    default:
+      return null;
+  }
+}
+
+/** Exponential reconnect backoff, capped. */
+export function nextRetryDelay(attempt, base = RETRY_BASE_MS, max = RETRY_MAX_MS) {
+  return Math.min(max, base * 2 ** Math.max(0, attempt));
+}
+
+/** Open (and keep reopening) the event stream. `handlers`:
+ *   onEvent(event)  — each decoded event (including hello)
+ *   onStatus(bool)  — connected / disconnected transitions
+ * `WebSocketImpl` is injectable for tests. Returns a close function. */
+export function connectEvents(
+  { url, onEvent, onStatus, WebSocketImpl, setTimeoutImpl } = {}
+) {
+  const WS = WebSocketImpl || globalThis.WebSocket;
+  const later = setTimeoutImpl || ((fn, ms) => setTimeout(fn, ms));
+  let closed = false;
+  let attempt = 0;
+  let socket = null;
+
+  function open() {
+    if (closed || !WS) return;
+    socket = new WS(url);
+    socket.onopen = () => {
+      attempt = 0;
+      if (onStatus) onStatus(true);
+    };
+    socket.onmessage = (msg) => {
+      let event;
+      try {
+        event = JSON.parse(msg.data);
+      } catch {
+        return; // tolerate a malformed frame; the stream continues
+      }
+      if (onEvent) onEvent(event);
+    };
+    socket.onclose = () => {
+      if (onStatus) onStatus(false);
+      if (!closed) later(open, nextRetryDelay(attempt++));
+    };
+    socket.onerror = () => {};
+  }
+
+  open();
+  return () => {
+    closed = true;
+    if (socket) socket.close();
+  };
+}

@@ -11,6 +11,7 @@
 
 export const POLL_ACTIVE_MS = 1000;
 export const POLL_IDLE_MS = 5000;
+export const POLL_STREAM_IDLE_MS = 15000;
 export const LAUNCH_GRACE_MS = 90000;
 
 export const state = {
@@ -22,7 +23,22 @@ export const state = {
   anythingBusy: false,
   topoChips: [],
   vocabBannerDismissed: false,
+  // live /distributed/events stream: while connected, pushed events
+  // replace the fast poll cadence (pollDelay below)
+  eventsConnected: false,
+  liveStatus: { connected: false, breakers: {}, events: [] },
 };
+
+/** Poll cadence selection. Busy keeps the 1 s fast poll either way —
+ * queue depth / progress are poll-only signals the stream does not
+ * carry. What the stream replaces is the IDLE heartbeat: health
+ * transitions and watchdog verdicts are pushed (and trigger an
+ * immediate refresh), so an idle panel with a live stream polls at a
+ * much slower keepalive cadence. */
+export function pollDelay(anythingBusy, eventsConnected) {
+  if (anythingBusy) return POLL_ACTIVE_MS;
+  return eventsConnected ? POLL_STREAM_IDLE_MS : POLL_IDLE_MS;
+}
 
 /** One step of the per-worker status machine.
  *

@@ -1,0 +1,128 @@
+/* Live event-stream consumer: status reduction, labels, reconnect
+ * backoff, and the injectable-WebSocket wiring. */
+
+"use strict";
+
+import { assert, assertEqual, test } from "./harness.js";
+import {
+  connectEvents,
+  eventLabel,
+  MAX_LIVE_EVENTS,
+  nextRetryDelay,
+  reduceLiveStatus,
+} from "../modules/events.js";
+import {
+  pollDelay,
+  POLL_ACTIVE_MS,
+  POLL_IDLE_MS,
+  POLL_STREAM_IDLE_MS,
+} from "../modules/state.js";
+
+test("reduce: hello snapshot seeds breaker states", () => {
+  const next = reduceLiveStatus(null, {
+    type: "hello",
+    data: { health: { w1: { state: "suspect" }, w2: { state: "healthy" } } },
+  });
+  assertEqual(next.breakers, { w1: "suspect", w2: "healthy" });
+  assertEqual(next.events, [], "hello is not a display event");
+});
+
+test("reduce: health transition updates breakers and prepends an event", () => {
+  const prev = { connected: true, breakers: { w1: "healthy" }, events: [] };
+  const next = reduceLiveStatus(prev, {
+    type: "health_transition",
+    ts: 1,
+    data: { worker_id: "w1", from_state: "healthy", to_state: "suspect" },
+  });
+  assertEqual(next.breakers.w1, "suspect");
+  assertEqual(next.events.length, 1);
+  assert(next.events[0].label.includes("w1"), next.events[0].label);
+});
+
+test("reduce: the event ring is capped newest-first", () => {
+  let status = null;
+  for (let i = 0; i < MAX_LIVE_EVENTS + 5; i++) {
+    status = reduceLiveStatus(status, {
+      type: "stall_detected",
+      ts: i,
+      data: { job_id: `j${i}`, quiet_seconds: 1, in_flight: 2 },
+    });
+  }
+  assertEqual(status.events.length, MAX_LIVE_EVENTS);
+  assert(status.events[0].label.includes(`j${MAX_LIVE_EVENTS + 4}`), "newest first");
+});
+
+test("labels: watchdog verdicts render, metric deltas stay silent", () => {
+  assert(
+    eventLabel({
+      type: "straggler_detected",
+      data: { worker_id: "w1", median_seconds: 0.5, global_median_seconds: 0.01 },
+    }).includes("straggler")
+  );
+  assert(
+    eventLabel({
+      type: "speculative_requeue",
+      data: { job_id: "j", task_ids: [3, 4] },
+    }).includes("[3, 4]")
+  );
+  assertEqual(eventLabel({ type: "metric_delta", data: {} }), null);
+  assertEqual(eventLabel({ type: "span_close", data: {} }), null);
+});
+
+test("backoff: exponential and capped", () => {
+  assertEqual(nextRetryDelay(0, 1000, 8000), 1000);
+  assertEqual(nextRetryDelay(1, 1000, 8000), 2000);
+  assertEqual(nextRetryDelay(10, 1000, 8000), 8000);
+});
+
+test("poll cadence: the stream stretches the idle poll, never the busy one", () => {
+  assertEqual(pollDelay(true, false), POLL_ACTIVE_MS);
+  assertEqual(pollDelay(true, true), POLL_ACTIVE_MS, "progress is poll-only");
+  assertEqual(pollDelay(false, false), POLL_IDLE_MS);
+  assertEqual(
+    pollDelay(false, true),
+    POLL_STREAM_IDLE_MS,
+    "pushed health events replace the idle heartbeat"
+  );
+});
+
+test("connectEvents: decodes frames, reports status, reconnects", () => {
+  const sockets = [];
+  class FakeWS {
+    constructor(url) {
+      this.url = url;
+      sockets.push(this);
+    }
+    close() {
+      if (this.onclose) this.onclose();
+    }
+  }
+  const seen = [];
+  const statuses = [];
+  const timers = [];
+  const stop = connectEvents({
+    url: "ws://x/distributed/events",
+    WebSocketImpl: FakeWS,
+    setTimeoutImpl: (fn, ms) => timers.push({ fn, ms }),
+    onEvent: (e) => seen.push(e),
+    onStatus: (s) => statuses.push(s),
+  });
+  assertEqual(sockets.length, 1);
+  sockets[0].onopen();
+  sockets[0].onmessage({ data: '{"type":"hello","data":{}}' });
+  sockets[0].onmessage({ data: "not json" }); // tolerated
+  sockets[0].onmessage({
+    data: '{"type":"health_transition","data":{"worker_id":"w1"}}',
+  });
+  assertEqual(seen.length, 2);
+  assertEqual(statuses, [true]);
+  // server drop → disconnected status + a scheduled reconnect
+  sockets[0].onclose();
+  assertEqual(statuses, [true, false]);
+  assertEqual(timers.length, 1);
+  timers[0].fn();
+  assertEqual(sockets.length, 2, "reconnect opened a new socket");
+  stop(); // closing the handle closes the socket without reconnecting
+  sockets[1].onclose();
+  assertEqual(timers.length, 1, "no reconnect after explicit stop");
+});

@@ -7,6 +7,7 @@
 import "./urlUtils.test.js";
 import "./apiClient.test.js";
 import "./state.test.js";
+import "./events.test.js";
 import "./widgets.test.js";
 import "./render.test.js";
 import "./vectors.test.js";

@@ -6,14 +6,21 @@ Input: one span per line, as written by `Tracer.write_jsonl`
 trace_jsonl=...)`), or by a live server with CDT_TRACE_EXPORT_DIR set.
 
 Output: a per-span-name latency table (count / total / mean / p50 /
-p95 / max) and, for spans carrying a `tile_idx` attribute, the
+p95 / p99 / max) and, for spans carrying a `tile_idx` attribute, the
 reconstructed per-tile lifecycle (which stages each tile went through,
 in span-clock order, and which tiles are missing stages).
 
+`--compare OLD.jsonl` turns the report into a regression gate: the
+per-stage p95 of the new trace is checked against the old one and the
+process exits 3 when any shared stage regressed by more than
+`--regress-pct` percent (default 25) — the bench/CI hook for "did this
+PR make a stage slower".
+
 Stdlib only; importable (tests call `build_report` / `tile_lifecycle`
-directly) and runnable:
+/ `compare_reports` directly) and runnable:
 
     python scripts/perf_report.py trace.jsonl [--trace TRACE_ID] [--json]
+    python scripts/perf_report.py new.jsonl --compare old.jsonl [--regress-pct 25]
 """
 
 from __future__ import annotations
@@ -75,6 +82,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
             "mean": sum(durations) / len(durations),
             "p50": _percentile(durations, 0.50),
             "p95": _percentile(durations, 0.95),
+            "p99": _percentile(durations, 0.99),
             "max": durations[-1],
         }
     return {
@@ -130,6 +138,47 @@ def incomplete_tiles(tiles: dict[int, list[dict[str, Any]]]) -> dict[int, str]:
     return problems
 
 
+def compare_reports(
+    old_report: dict[str, Any],
+    new_report: dict[str, Any],
+    regress_pct: float,
+) -> list[dict[str, Any]]:
+    """Per-stage p95 regressions of `new_report` vs `old_report`:
+    stages present in BOTH whose new p95 exceeds the old by more than
+    `regress_pct` percent. Stages that only exist on one side are
+    skipped (new instrumentation is not a regression)."""
+    regressions = []
+    for name, new_stats in new_report["stages"].items():
+        old_stats = old_report["stages"].get(name)
+        if old_stats is None or old_stats["p95"] <= 0:
+            continue
+        delta_pct = (new_stats["p95"] / old_stats["p95"] - 1.0) * 100.0
+        if delta_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": name,
+                    "old_p95": old_stats["p95"],
+                    "new_p95": new_stats["p95"],
+                    "delta_pct": delta_pct,
+                }
+            )
+    return regressions
+
+
+def render_comparison(
+    regressions: list[dict[str, Any]], regress_pct: float
+) -> str:
+    if not regressions:
+        return f"p95 comparison: no stage regressed more than {regress_pct:g}%"
+    lines = [f"p95 REGRESSIONS (> {regress_pct:g}%):"]
+    for item in regressions:
+        lines.append(
+            f"  {item['stage']:28} {item['old_p95']:.4f}s -> "
+            f"{item['new_p95']:.4f}s (+{item['delta_pct']:.1f}%)"
+        )
+    return "\n".join(lines)
+
+
 def render_text(report: dict[str, Any], tiles, problems) -> str:
     lines = []
     lines.append(
@@ -139,7 +188,7 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
     lines.append("")
     header = (
         f"{'span':28} {'count':>6} {'total_s':>10} {'mean_s':>10} "
-        f"{'p50_s':>10} {'p95_s':>10} {'max_s':>10}"
+        f"{'p50_s':>10} {'p95_s':>10} {'p99_s':>10} {'max_s':>10}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -147,7 +196,8 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
         lines.append(
             f"{name:28} {stats['count']:>6} {stats['total']:>10.4f} "
             f"{stats['mean']:>10.4f} {stats['p50']:>10.4f} "
-            f"{stats['p95']:>10.4f} {stats['max']:>10.4f}"
+            f"{stats['p95']:>10.4f} {stats['p99']:>10.4f} "
+            f"{stats['max']:>10.4f}"
         )
     if tiles:
         lines.append("")
@@ -179,6 +229,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    parser.add_argument(
+        "--compare",
+        default=None,
+        metavar="OLD.jsonl",
+        help="baseline trace JSONL; exit 3 on per-stage p95 regression",
+    )
+    parser.add_argument(
+        "--regress-pct",
+        type=float,
+        default=25.0,
+        help="p95 regression threshold in percent for --compare (default 25)",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -194,20 +256,34 @@ def main(argv: list[str] | None = None) -> int:
     report = build_report(spans)
     tiles = tile_lifecycle(spans)
     problems = incomplete_tiles(tiles)
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "report": report,
-                    "tiles": {str(k): v for k, v in tiles.items()},
-                    "incomplete": {str(k): v for k, v in problems.items()},
-                },
-                indent=2,
-                sort_keys=True,
-            )
+
+    regressions = None
+    if args.compare:
+        try:
+            old_spans = load_spans(args.compare)
+        except OSError as exc:
+            print(f"cannot read {args.compare}: {exc}", file=sys.stderr)
+            return 1
+        regressions = compare_reports(
+            build_report(old_spans), report, args.regress_pct
         )
+
+    if args.json:
+        payload = {
+            "report": report,
+            "tiles": {str(k): v for k, v in tiles.items()},
+            "incomplete": {str(k): v for k, v in problems.items()},
+        }
+        if regressions is not None:
+            payload["regressions"] = regressions
+        print(json.dumps(payload, indent=2, sort_keys=True))
     else:
         print(render_text(report, tiles, problems))
+        if regressions is not None:
+            print()
+            print(render_comparison(regressions, args.regress_pct))
+    if regressions:
+        return 3
     return 2 if problems else 0
 
 

@@ -1,0 +1,206 @@
+"""GET /distributed/events end-to-end: the live stream over a real
+WebSocket (aiohttp client), hello snapshot, type filtering, metric
+deltas, health transitions, and the paginated /distributed/traces."""
+
+import asyncio
+import json
+import socket
+import urllib.request
+
+import aiohttp
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.resilience.health import get_health_registry
+from comfyui_distributed_tpu.telemetry import get_tracer
+from comfyui_distributed_tpu.telemetry.instruments import tiles_processed_total
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def server(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port, loop_thread
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def _run_on(loop_thread, coro, timeout=30):
+    return asyncio.run_coroutine_threadsafe(coro, loop_thread.loop).result(timeout)
+
+
+async def _recv_json(ws, timeout=10):
+    msg = await ws.receive(timeout=timeout)
+    assert msg.type == aiohttp.WSMsgType.TEXT, msg
+    return json.loads(msg.data)
+
+
+def test_event_stream_hello_metric_and_health(server):
+    srv, port, loop_thread = server
+
+    async def scenario():
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                f"http://127.0.0.1:{port}/distributed/events"
+                "?types=metric_delta,health_transition"
+            ) as ws:
+                hello = await _recv_json(ws)
+                assert hello["type"] == "hello"
+                assert hello["data"]["server"] == f"master:{port}"
+                assert hello["data"]["subscribed"] == [
+                    "health_transition", "metric_delta",
+                ]
+                assert "store" in hello["data"]
+
+                # a metric mutation streams as a delta
+                tiles_processed_total().inc(role="master")
+                event = await _recv_json(ws)
+                assert event["type"] == "metric_delta"
+                assert event["data"]["metric"] == "cdt_tiles_processed_total"
+                assert event["data"]["labels"] == {"role": "master"}
+
+                # a breaker transition streams too (preceded by the
+                # transition COUNTER's own metric_delta — drain to it)
+                registry = get_health_registry()
+                registry.record_failure("wx")
+                registry.record_failure("wx")  # healthy → suspect
+                for _ in range(5):
+                    event = await _recv_json(ws)
+                    if event["type"] == "health_transition":
+                        break
+                    assert event["type"] == "metric_delta"
+                assert event["type"] == "health_transition"
+                assert event["data"]["worker_id"] == "wx"
+                assert event["data"]["to_state"] == "suspect"
+
+    _run_on(loop_thread, scenario())
+
+
+def test_event_stream_filters_out_unwanted_types(server):
+    _srv, port, loop_thread = server
+
+    async def scenario():
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                f"http://127.0.0.1:{port}/distributed/events"
+                "?types=health_transition"
+            ) as ws:
+                await _recv_json(ws)  # hello
+                # noise the filter must drop, then the wanted event
+                tiles_processed_total().inc(role="worker")
+                with get_tracer().span("noise", trace_id="exec_f_1"):
+                    pass
+                registry = get_health_registry()
+                registry.record_failure("wf")
+                registry.record_failure("wf")
+                event = await _recv_json(ws)
+                assert event["type"] == "health_transition"
+
+    _run_on(loop_thread, scenario())
+
+
+def test_event_stream_span_events_carry_the_trace(server):
+    _srv, port, loop_thread = server
+
+    async def scenario():
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                f"http://127.0.0.1:{port}/distributed/events?types=span_close"
+            ) as ws:
+                await _recv_json(ws)  # hello
+                with get_tracer().span(
+                    "tile.sample", trace_id="exec_ws_1", stage="sample"
+                ):
+                    pass
+                event = await _recv_json(ws)
+                assert event["data"]["trace_id"] == "exec_ws_1"
+                assert event["data"]["name"] == "tile.sample"
+                assert event["data"]["attrs"]["stage"] == "sample"
+                assert event["data"]["duration"] is not None
+
+    _run_on(loop_thread, scenario())
+
+
+def test_stream_disconnect_unsubscribes(server):
+    _srv, port, loop_thread = server
+    from comfyui_distributed_tpu.telemetry import get_event_bus
+
+    async def scenario():
+        bus = get_event_bus()
+        before = bus.subscriber_count
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                f"http://127.0.0.1:{port}/distributed/events"
+            ) as ws:
+                await _recv_json(ws)  # hello
+                assert bus.subscriber_count == before + 1
+        # closed: the server-side subscription must be released
+        for _ in range(50):
+            if bus.subscriber_count == before:
+                break
+            await asyncio.sleep(0.05)
+        assert bus.subscriber_count == before
+
+    _run_on(loop_thread, scenario())
+
+
+# --- /distributed/traces pagination ---------------------------------------
+
+def _get(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def test_traces_listing_is_paginated_newest_first(server):
+    _srv, port, _loop = server
+    tracer = get_tracer()
+    for i in range(7):
+        with tracer.span("root", trace_id=f"exec_page_{i}"):
+            pass
+
+    status, body = _get(
+        f"http://127.0.0.1:{port}/distributed/traces?limit=3"
+    )
+    assert status == 200
+    assert body["total"] == 7
+    assert body["traces"] == ["exec_page_6", "exec_page_5", "exec_page_4"]
+
+    _status, body = _get(
+        f"http://127.0.0.1:{port}/distributed/traces?limit=3&offset=5"
+    )
+    assert body["traces"] == ["exec_page_1", "exec_page_0"]
+    assert body["offset"] == 5
+
+    # limit is clamped to the tracer's retention bound
+    _status, body = _get(
+        f"http://127.0.0.1:{port}/distributed/traces?limit=999999"
+    )
+    assert body["limit"] <= tracer.max_traces
+
+
+def test_traces_listing_rejects_bad_pagination(server):
+    _srv, port, _loop = server
+    import urllib.error
+
+    for query in ("limit=0", "limit=-2", "offset=-1", "limit=abc"):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/distributed/traces?{query}", timeout=10
+            )
+            raise AssertionError(f"expected 400 for {query}")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400, query

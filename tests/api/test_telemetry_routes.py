@@ -90,6 +90,16 @@ def test_metrics_endpoint_serves_prometheus_text(server):
     assert f'cdt_tile_jobs_active{{server="master:{port}"}} 1' in body
     # pulled tile was completed
     assert f'cdt_tiles_in_flight{{server="master:{port}"}} 0' in body
+    # JAX runtime health rides the same scrape (telemetry/runtime.py):
+    # compile/cache gauges always render; jax is initialized in this
+    # process (conftest), so the compile counter is a real number
+    assert "# TYPE cdt_jax_compiles gauge" in body
+    assert "cdt_jax_cache_hits" in body
+    assert "cdt_jax_cache_misses" in body
+    assert "cdt_jax_compile_time_seconds" in body
+    assert "cdt_host_rss_bytes" in body
+    # per-worker pull→submit latency histogram (watchdog signal)
+    assert 'cdt_worker_tile_seconds_count{worker_id="w1"} 1' in body
 
 
 def test_trace_endpoint_serves_span_tree(server):

@@ -87,6 +87,72 @@ def test_latency_spikes_do_not_change_output(baseline):
     np.testing.assert_array_equal(baseline, result.output)
 
 
+def test_straggler_worker_detected_suspected_and_speculated(baseline):
+    """The PR-3 acceptance scenario: w1 silently slows to far beyond
+    10x the median tile latency (no crash, no missed heartbeat — the
+    worker_timeout is far above the injected latency, so PR 1's
+    heartbeat requeue can NOT be the recovery path). The watchdog must
+    (a) flag w1 as a straggler, (b) transition it to suspect in the
+    HealthRegistry, (c) detect the stalled tail and speculatively
+    re-dispatch the in-flight orphan, and the final canvas must still
+    be bit-identical to the no-fault run (first result wins; duplicate
+    submissions drop).
+
+    Determinism construction (no wall-clock races): w2 crash-holds a
+    tile, so the job CANNOT complete without the watchdog speculating
+    it (the 10s worker_timeout and 20s master deadline are far beyond
+    the test's horizon) — the stall verdict has unbounded headroom.
+    And because the job stays open until w1's in-flight tiles land,
+    every one of w1's slow submits is recorded as a latency sample
+    before cleanup — the straggler verdict can't race the shutdown
+    (the watchdog's stop() runs a final straggler pass either way)."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};latency(0.4)@chaos:w1:pulled#*;"
+            "crash@chaos:w2:pulled#1"
+        ),
+        worker_timeout=10.0,  # heartbeat requeue never fires
+        watchdog={},
+    )
+    assert "latency" in result.fired_kinds()
+    assert result.crashed_workers == ["w2"]
+    assert "w1" in result.stragglers, result.stragglers
+    assert result.health.get("w1", {}).get("state") == "suspect", result.health
+    # the quiet tail triggered speculation of w1's in-flight tile(s)
+    assert result.stalls, "stall never detected"
+    assert any(result.speculated.values()), result.speculated
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_stall_speculation_recovers_a_crashed_worker_before_timeout(baseline):
+    """w1 crashes after pulling a tile, with a worker timeout so large
+    the heartbeat-staleness requeue would take 10s — the watchdog's
+    stall detector speculates the orphaned tile within ~0.3s instead,
+    and the output is still bit-identical."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+        worker_timeout=10.0,
+        watchdog={},
+    )
+    assert "w1" in result.crashed_workers
+    assert result.stalls, "stall never detected"
+    speculated = [t for tids in result.speculated.values() for t in tids]
+    assert speculated, "no speculative re-dispatch happened"
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_watchdog_stays_quiet_on_a_healthy_run(baseline):
+    """No faults: the monitor must not invent stragglers or stalls
+    (and must not perturb the output)."""
+    result = run_chaos_usdu(seed=11, watchdog={})
+    assert result.stragglers == []
+    assert result.stalls == []
+    assert result.speculated == {}
+    np.testing.assert_array_equal(baseline, result.output)
+
+
 def test_store_level_connection_errors_kill_worker_but_not_job(baseline):
     """A connection error at w2's pull RPC takes that worker out (the
     harness treats any injected transport error as fatal to the

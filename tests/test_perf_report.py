@@ -120,6 +120,90 @@ def test_cli_renders_report(chaos_trace):
     assert set(data["tiles"]) == {"0", "1", "2", "3"}
 
 
+def _span(name, duration, idx=0):
+    return {
+        "trace_id": "t", "span_id": f"s{name}{idx}{duration}", "parent_id": None,
+        "name": name, "start": 0.0, "end": duration, "duration": duration,
+        "attrs": {}, "events": [], "status": "ok",
+    }
+
+
+def _write_jsonl(path, spans):
+    with open(path, "w", encoding="utf-8") as fh:
+        for span in spans:
+            fh.write(json.dumps(span) + "\n")
+
+
+def test_report_includes_p99_column(chaos_trace):
+    _result, path = chaos_trace
+    report = perf_report.build_report(perf_report.load_spans(path))
+    for stats in report["stages"].values():
+        assert "p99" in stats
+        assert stats["p50"] <= stats["p95"] <= stats["p99"] <= stats["max"]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_report.py"), path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "p99_s" in proc.stdout
+
+
+def test_compare_flags_p95_regressions_only():
+    old = perf_report.build_report(
+        [_span("tile.sample", 0.1, i) for i in range(10)]
+        + [_span("tile.pull", 0.01, i) for i in range(10)]
+    )
+    new = perf_report.build_report(
+        [_span("tile.sample", 0.2, i) for i in range(10)]   # +100%
+        + [_span("tile.pull", 0.011, i) for i in range(10)]  # +10%
+        + [_span("tile.freshly_added", 9.0)]                 # no baseline
+    )
+    regressions = perf_report.compare_reports(old, new, regress_pct=25.0)
+    assert [r["stage"] for r in regressions] == ["tile.sample"]
+    assert regressions[0]["delta_pct"] == pytest.approx(100.0)
+    # a looser gate passes everything
+    assert perf_report.compare_reports(old, new, regress_pct=150.0) == []
+
+
+def test_cli_compare_exits_nonzero_on_regression(tmp_path):
+    old_path = str(tmp_path / "old.jsonl")
+    new_path = str(tmp_path / "new.jsonl")
+    _write_jsonl(old_path, [_span("tile.sample", 0.1, i) for i in range(5)])
+    _write_jsonl(new_path, [_span("tile.sample", 0.5, i) for i in range(5)])
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            new_path, "--compare", old_path, "--regress-pct", "25",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "REGRESSIONS" in proc.stdout
+    assert "tile.sample" in proc.stdout
+
+    # same trace compared against itself: clean exit
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            new_path, "--compare", new_path,
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no stage regressed" in proc.stdout
+
+    # --json carries the regression list for machine consumers
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            new_path, "--compare", old_path, "--json",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3
+    data = json.loads(proc.stdout)
+    assert data["regressions"][0]["stage"] == "tile.sample"
+
+
 def test_cli_fails_on_missing_or_empty_input(tmp_path):
     proc = subprocess.run(
         [

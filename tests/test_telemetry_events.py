@@ -1,0 +1,192 @@
+"""Event bus: pub/sub fan-out, type filtering, drop-oldest overflow,
+and the forwarding hooks from metrics / tracing / health."""
+
+import asyncio
+import threading
+
+from comfyui_distributed_tpu.resilience.health import get_health_registry
+from comfyui_distributed_tpu.telemetry import get_event_bus, get_tracer
+from comfyui_distributed_tpu.telemetry.events import EventBus
+from comfyui_distributed_tpu.telemetry.instruments import store_pulls_total
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --- bus semantics ---------------------------------------------------------
+
+def test_publish_without_subscribers_is_a_cheap_noop():
+    bus = EventBus()
+    bus.publish("anything", x=1)  # must not raise, must not count
+    assert bus.published == 0
+
+
+def test_subscriber_receives_typed_events_in_order():
+    async def main():
+        bus = EventBus(clock=lambda: 123.0)
+        sub = bus.subscribe()
+        bus.publish("a", n=1)
+        bus.publish("b", n=2)
+        await asyncio.sleep(0)
+        first = await sub.get()
+        second = await sub.get()
+        assert [first["type"], second["type"]] == ["a", "b"]
+        assert first["seq"] < second["seq"]
+        assert first["ts"] == 123.0
+        assert first["data"] == {"n": 1}
+
+    run(main())
+
+
+def test_type_filter_is_bus_side():
+    async def main():
+        bus = EventBus()
+        sub = bus.subscribe(types=["wanted"])
+        bus.publish("noise", n=1)
+        bus.publish("wanted", n=2)
+        await asyncio.sleep(0)
+        event = await sub.get()
+        assert event["type"] == "wanted"
+        assert sub.queue.empty()
+
+    run(main())
+
+
+def test_overflow_drops_oldest_and_counts():
+    async def main():
+        bus = EventBus()
+        sub = bus.subscribe(maxsize=3)
+        for i in range(10):
+            bus.publish("e", i=i)
+        await asyncio.sleep(0)
+        kept = []
+        while not sub.queue.empty():
+            kept.append((await sub.get())["data"]["i"])
+        assert kept == [7, 8, 9], "drop-OLDEST: the tail survives"
+        assert sub.dropped == 7
+
+    run(main())
+
+
+def test_unsubscribe_stops_delivery():
+    async def main():
+        bus = EventBus()
+        sub = bus.subscribe()
+        bus.unsubscribe(sub)
+        bus.publish("e")
+        await asyncio.sleep(0)
+        assert sub.queue.empty()
+        assert bus.subscriber_count == 0
+
+    run(main())
+
+
+def test_publish_is_thread_safe_across_threads():
+    async def main():
+        bus = EventBus()
+        sub = bus.subscribe(maxsize=10000)
+
+        def blast():
+            for i in range(200):
+                bus.publish("t", i=i)
+
+        threads = [threading.Thread(target=blast) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # let the call_soon_threadsafe callbacks drain
+        for _ in range(20):
+            await asyncio.sleep(0.01)
+            if sub.queue.qsize() == 800:
+                break
+        assert sub.queue.qsize() + sub.dropped == 800
+        seqs = []
+        while not sub.queue.empty():
+            seqs.append((await sub.get())["seq"])
+        assert seqs == sorted(seqs), "per-bus seq is monotonic"
+
+    run(main())
+
+
+# --- forwarding hooks ------------------------------------------------------
+
+def test_metric_mutations_stream_as_metric_delta():
+    async def main():
+        bus = get_event_bus()
+        sub = bus.subscribe(types=["metric_delta"])
+        store_pulls_total().inc(worker_id="w1", outcome="task")
+        await asyncio.sleep(0.01)
+        event = await asyncio.wait_for(sub.get(), 2)
+        assert event["data"]["metric"] == "cdt_store_pulls_total"
+        assert event["data"]["kind"] == "counter"
+        assert event["data"]["labels"] == {"worker_id": "w1", "outcome": "task"}
+        assert event["data"]["value"] == 1.0
+        bus.unsubscribe(sub)
+
+    run(main())
+
+
+def test_span_lifecycle_streams_open_and_close():
+    async def main():
+        bus = get_event_bus()
+        sub = bus.subscribe(types=["span_open", "span_close"])
+        with get_tracer().span("tile.sample", trace_id="exec_ev_1", stage="sample"):
+            pass
+        await asyncio.sleep(0.01)
+        opened = await asyncio.wait_for(sub.get(), 2)
+        closed = await asyncio.wait_for(sub.get(), 2)
+        assert opened["type"] == "span_open"
+        assert closed["type"] == "span_close"
+        assert opened["data"]["trace_id"] == "exec_ev_1"
+        assert closed["data"]["span_id"] == opened["data"]["span_id"]
+        assert closed["data"]["duration"] is not None
+        assert closed["data"]["status"] == "ok"
+        bus.unsubscribe(sub)
+
+    run(main())
+
+
+def test_health_transitions_stream():
+    async def main():
+        bus = get_event_bus()
+        sub = bus.subscribe(types=["health_transition"])
+        registry = get_health_registry()
+        registry.record_failure("w7")
+        registry.record_failure("w7")  # → suspect
+        await asyncio.sleep(0.01)
+        event = await asyncio.wait_for(sub.get(), 2)
+        assert event["data"] == {
+            "worker_id": "w7",
+            "from_state": "healthy",
+            "to_state": "suspect",
+        }
+        bus.unsubscribe(sub)
+
+    run(main())
+
+
+def test_mark_suspect_fires_a_transition_event():
+    async def main():
+        bus = get_event_bus()
+        sub = bus.subscribe(types=["health_transition"])
+        registry = get_health_registry()
+        assert registry.mark_suspect("w8").value == "suspect"
+        # idempotent: second call is a no-op, no second event
+        registry.mark_suspect("w8")
+        await asyncio.sleep(0.01)
+        event = await asyncio.wait_for(sub.get(), 2)
+        assert event["data"]["to_state"] == "suspect"
+        assert sub.queue.empty()
+        bus.unsubscribe(sub)
+
+    run(main())
+
+
+def test_mark_suspect_leaves_quarantined_workers_alone():
+    registry = get_health_registry()
+    for _ in range(5):
+        registry.record_failure("w9")
+    assert registry.state("w9").value == "quarantined"
+    assert registry.mark_suspect("w9").value == "quarantined"

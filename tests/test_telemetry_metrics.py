@@ -132,6 +132,61 @@ def test_thread_safety_under_contention(registry):
     assert c.value() == 8000
 
 
+# --- cardinality caps ------------------------------------------------------
+
+def test_label_churn_is_bounded_by_the_series_cap(registry):
+    """A worker-id churn storm cannot grow a metric (and the scrape)
+    without bound: series beyond the cap collapse into `_overflow` and
+    the registry's warning counter records every collapsed write."""
+    c = registry.counter("cdt_churn_total", "help", ("worker_id",))
+    c.max_series = 10
+    for i in range(1000):
+        c.inc(worker_id=f"w{i}")
+    with c._lock:
+        assert len(c._values) == 11  # 10 real series + _overflow
+    assert c.value(worker_id="_overflow") == 990
+    # established series keep counting normally after the cap is hit
+    c.inc(worker_id="w3")
+    assert c.value(worker_id="w3") == 2
+    overflow = registry.get(MetricsRegistry.OVERFLOW_COUNTER_NAME)
+    assert overflow.value(metric="cdt_churn_total") == 990
+    text = registry.render()
+    assert 'cdt_churn_total{worker_id="_overflow"} 990' in text
+
+
+def test_histogram_and_gauge_series_are_capped_too(registry):
+    h = registry.histogram("cdt_cap_seconds", "help", ("worker_id",), buckets=(1.0,))
+    h.max_series = 3
+    g = registry.gauge("cdt_cap_depth", "help", ("worker_id",))
+    g.max_series = 3
+    for i in range(20):
+        h.observe(0.5, worker_id=f"w{i}")
+        g.set(i, worker_id=f"w{i}")
+    with h._lock:
+        assert len(h._series) == 4
+    with g._lock:
+        assert len(g._values) == 4
+    assert h.count(worker_id="_overflow") == 17
+    overflow = registry.get(MetricsRegistry.OVERFLOW_COUNTER_NAME)
+    assert overflow.value(metric="cdt_cap_seconds") == 17
+    assert overflow.value(metric="cdt_cap_depth") == 17
+
+
+def test_unlabelled_metrics_are_never_capped(registry):
+    c = registry.counter("cdt_plain_total", "help")
+    c.max_series = 1
+    for _ in range(5):
+        c.inc()
+    assert c.value() == 5
+
+
+def test_series_cap_env_override(monkeypatch):
+    monkeypatch.setenv("CDT_METRIC_MAX_SERIES", "7")
+    registry = MetricsRegistry()
+    c = registry.counter("cdt_env_cap_total", "help", ("worker_id",))
+    assert c.max_series == 7
+
+
 # --- global registry ------------------------------------------------------
 
 def test_global_registry_reset():

@@ -1,0 +1,256 @@
+"""Watchdog under a fake stepping clock: straggler flagged at
+k x rolling median, stall detected after T quiet seconds, speculative
+re-dispatch through the JobStore's requeue path."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.resilience.health import HealthRegistry
+from comfyui_distributed_tpu.telemetry import Watchdog
+from comfyui_distributed_tpu.telemetry.instruments import (
+    watchdog_stalls_total,
+    watchdog_stragglers_total,
+    worker_tile_seconds,
+)
+
+
+class SteppingClock:
+    """Manual clock: tests advance it explicitly."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+    def __call__(self) -> float:
+        return self.now
+
+
+# --- straggler detection ---------------------------------------------------
+
+def _feed(wd, worker_id, latencies):
+    for value in latencies:
+        wd.record_latency(worker_id, value)
+
+
+def test_straggler_flagged_at_k_times_median():
+    health = HealthRegistry()
+    wd = Watchdog(
+        health=health, clock=SteppingClock(),
+        straggler_factor=4.0, min_samples=3,
+    )
+    _feed(wd, "fast1", [0.01] * 5)
+    _feed(wd, "fast2", [0.012] * 5)
+    # global median ~0.01; 0.03 is 3x (under k=4) — NOT a straggler
+    _feed(wd, "slowish", [0.03] * 5)
+    assert wd.check_stragglers() == []
+    # 0.1 is 10x the median — flagged, exactly once, and pushed suspect
+    _feed(wd, "laggard", [0.1] * 5)
+    assert wd.check_stragglers() == ["laggard"]
+    assert wd.check_stragglers() == []  # sticky: no re-flag while slow
+    assert health.state("laggard").value == "suspect"
+    assert health.state("slowish").value == "healthy"
+    assert watchdog_stragglers_total().value(worker_id="laggard") == 1
+
+
+def test_straggler_needs_min_samples():
+    wd = Watchdog(clock=SteppingClock(), straggler_factor=2.0, min_samples=3)
+    _feed(wd, "fast", [0.01] * 6)
+    _feed(wd, "slow", [1.0] * 2)  # one short of min_samples
+    assert wd.check_stragglers() == []
+    wd.record_latency("slow", 1.0)
+    assert wd.check_stragglers() == ["slow"]
+
+
+def test_straggler_unflags_when_latency_recovers():
+    wd = Watchdog(clock=SteppingClock(), straggler_factor=3.0, min_samples=2, window=4)
+    for fast in ("fast1", "fast2", "fast3"):
+        _feed(wd, fast, [0.01] * 4)
+    _feed(wd, "slow", [0.5] * 4)
+    assert wd.check_stragglers() == ["slow"]
+    # the rolling window forgets: four fast tiles displace the slow ones
+    _feed(wd, "slow", [0.01] * 4)
+    assert wd.check_stragglers() == []
+    assert "slow" not in wd._current_stragglers
+    # a relapse is flagged AGAIN (history keeps both verdicts)
+    _feed(wd, "slow", [0.5] * 4)
+    assert wd.check_stragglers() == ["slow"]
+    assert list(wd.stragglers_flagged) == ["slow", "slow"]
+
+
+def test_no_verdict_without_peers_or_samples():
+    wd = Watchdog(clock=SteppingClock(), straggler_factor=2.0, min_samples=1)
+    assert wd.check_stragglers() == []  # no samples at all
+    _feed(wd, "only", [5.0] * 10)
+    # a lone worker IS the global median; nothing to compare against
+    assert wd.check_stragglers() == []
+
+
+# --- stall detection + speculative re-dispatch -----------------------------
+
+@pytest.fixture()
+def stalled_store(server_loop):
+    """A tile job with two tasks in flight (pulled, never submitted)
+    and one already completed."""
+    store = JobStore()
+
+    async def setup():
+        await store.init_tile_job("job-w", [0, 1, 2])
+        assert await store.pull_task("job-w", "w1", timeout=0.05) == 0
+        assert await store.pull_task("job-w", "w2", timeout=0.05) == 1
+        assert await store.pull_task("job-w", "w2", timeout=0.05) == 2
+        await store.submit_result("job-w", "w2", 1, None)
+
+    asyncio.run_coroutine_threadsafe(setup(), server_loop.loop).result(10)
+    return store
+
+
+def _sync_speculate(store, server_loop):
+    def speculate(job_id):
+        return asyncio.run_coroutine_threadsafe(
+            store.speculate_in_flight(job_id), server_loop.loop
+        ).result(10)
+
+    return speculate
+
+
+def test_stall_detected_after_quiet_window(stalled_store, server_loop):
+    clock = SteppingClock()
+    wd = Watchdog(
+        store=stalled_store, clock=clock, stall_seconds=5.0,
+        speculate=_sync_speculate(stalled_store, server_loop),
+    )
+    assert wd.check_stalls() == []  # first sight: baseline snapshot
+    clock.advance(4.9)
+    assert wd.check_stalls() == []  # quiet, but under T
+    clock.advance(0.2)
+    assert wd.check_stalls() == ["job-w"]
+    assert wd.speculated == {"job-w": [0, 2]}
+    assert watchdog_stalls_total().value() == 1
+    job = stalled_store.tile_jobs["job-w"]
+    assert job.pending.qsize() == 2, "in-flight tail re-enqueued"
+    assert job.speculated == {0, 2}
+
+
+def test_progress_resets_the_stall_timer(stalled_store, server_loop):
+    clock = SteppingClock()
+    wd = Watchdog(
+        store=stalled_store, clock=clock, stall_seconds=5.0,
+        speculate=_sync_speculate(stalled_store, server_loop),
+    )
+    wd.check_stalls()
+    clock.advance(4.0)
+    # progress: w1 submits its tile — the snapshot changes
+    asyncio.run_coroutine_threadsafe(
+        stalled_store.submit_result("job-w", "w1", 0, None), server_loop.loop
+    ).result(10)
+    assert wd.check_stalls() == []
+    clock.advance(4.0)
+    assert wd.check_stalls() == [], "timer restarted at the progress point"
+    clock.advance(1.5)
+    assert wd.check_stalls() == ["job-w"]
+    assert wd.speculated["job-w"] == [2], "only the still-in-flight task"
+
+
+def test_speculation_is_once_per_task_and_first_result_wins(
+    stalled_store, server_loop
+):
+    clock = SteppingClock()
+    wd = Watchdog(
+        store=stalled_store, clock=clock, stall_seconds=1.0,
+        speculate=_sync_speculate(stalled_store, server_loop),
+    )
+    wd.check_stalls()
+    clock.advance(1.1)
+    assert wd.check_stalls() == ["job-w"]
+
+    async def race():
+        # the master claims a speculated copy of task 0 and submits first
+        task = await stalled_store.pull_task("job-w", "master", timeout=0.05)
+        assert task in (0, 2)
+        assert await stalled_store.submit_result("job-w", "master", task, None)
+        # the original holder's late submission drops as a duplicate
+        assert not await stalled_store.submit_result(
+            "job-w", "w1" if task == 0 else "w2", task, None
+        )
+        return task
+
+    asyncio.run_coroutine_threadsafe(race(), server_loop.loop).result(10)
+    # a second stall window cannot re-speculate the same tasks
+    clock.advance(2.0)
+    wd.check_stalls()
+    clock.advance(2.0)
+    wd.check_stalls()
+    assert wd.speculated["job-w"] == [0, 2], "no task speculated twice"
+
+
+def test_complete_jobs_are_ignored(server_loop):
+    store = JobStore()
+
+    async def setup():
+        await store.init_tile_job("done", [0])
+        await store.pull_task("done", "w1", timeout=0.05)
+        await store.submit_result("done", "w1", 0, None)
+
+    asyncio.run_coroutine_threadsafe(setup(), server_loop.loop).result(10)
+    clock = SteppingClock()
+    wd = Watchdog(store=store, clock=clock, stall_seconds=1.0)
+    wd.check_stalls()
+    clock.advance(10)
+    assert wd.check_stalls() == []
+    assert wd.speculated == {}
+
+
+def test_latency_windows_are_bounded_under_worker_churn():
+    """Worker-id churn can't grow the watchdog's window dict: least-
+    recently-updated workers are evicted at the cap (mirrors the
+    metrics registry's CDT_METRIC_MAX_SERIES bound)."""
+    wd = Watchdog(clock=SteppingClock())
+    wd.max_workers = 10
+    for i in range(500):
+        wd.record_latency(f"w{i}", 0.01)
+    assert len(wd._latencies) == 10
+    assert "w499" in wd._latencies and "w0" not in wd._latencies
+    # updating an existing worker refreshes it instead of evicting
+    wd.record_latency("w495", 0.02)
+    wd.record_latency("brand-new", 0.01)
+    assert "w495" in wd._latencies and "brand-new" in wd._latencies
+
+
+# --- latency plumbing ------------------------------------------------------
+
+def test_store_feeds_latency_sink_and_histogram(server_loop):
+    store = JobStore()
+    seen = []
+    store.latency_sink = lambda wid, s: seen.append((wid, s))
+
+    async def flow():
+        await store.init_tile_job("job-l", [0])
+        await store.pull_task("job-l", "w1", timeout=0.05)
+        await store.submit_result("job-l", "w1", 0, None)
+
+    asyncio.run_coroutine_threadsafe(flow(), server_loop.loop).result(10)
+    assert len(seen) == 1
+    worker_id, elapsed = seen[0]
+    assert worker_id == "w1" and elapsed >= 0
+    assert worker_tile_seconds().count(worker_id="w1") == 1
+
+
+def test_thread_lifecycle_runs_steps():
+    import threading
+
+    ticked = threading.Event()
+
+    class TickingWatchdog(Watchdog):
+        def step(self):
+            ticked.set()
+            return super().step()
+
+    wd = TickingWatchdog(interval=0.01)
+    wd.start()
+    assert ticked.wait(5), "background thread never ran a step"
+    wd.stop()
+    assert wd._thread is None
