@@ -165,6 +165,30 @@ class ConfigRoutes:
         tile_job = store.tile_jobs.get(job_id)
         from ..resilience.health import get_health_registry
 
+        # Scheduler control-plane view: lane depths, per-tenant deficit
+        # counters, and the placement policy's current worker weights —
+        # the saturation triage numbers an operator polls alongside the
+        # job's own progress (docs/operator-runbook.md).
+        scheduler = getattr(self.server, "scheduler", None)
+        sched_view = None
+        if scheduler is not None:
+            admission = scheduler.queue.snapshot()
+            sched_view = {
+                "state": admission["state"],
+                "active": admission["active"],
+                "queued": admission["queued"],
+                "lanes": {
+                    lane["name"]: {
+                        "depth": lane["depth"],
+                        "max_depth": lane["max_depth"],
+                        "tenants": lane["tenants"],
+                    }
+                    for lane in admission["lanes"]
+                },
+                "tenant_weights": admission["tenant_weights"],
+                "worker_weights": scheduler.placement.weights(),
+            }
+
         return web.json_response(
             {
                 "exists": collector is not None or tile_job is not None,
@@ -179,5 +203,6 @@ class ConfigRoutes:
                 } or None,
                 "queue_remaining": self.server.queue_remaining,
                 "breakers": get_health_registry().snapshot(),
+                "scheduler": sched_view,
             }
         )

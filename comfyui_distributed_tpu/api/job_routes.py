@@ -52,17 +52,97 @@ class JobRoutes:
         except QueueRequestError as exc:
             return web.json_response({"error": str(exc)}, status=400)
 
+        import asyncio
+
+        from ..scheduler import AdmissionClosed, SchedulerSaturated
+        from ..telemetry import get_tracer
+        from ..utils.constants import SCHED_GRANT_TIMEOUT_SECONDS
+        from ..utils.trace_logger import generate_trace_id
         from .orchestration.queue_orchestration import (
             orchestrate_distributed_execution,
         )
 
+        scheduler = getattr(self.server, "scheduler", None)
+        ticket = None
+        if scheduler is not None:
+            # The trace id is fixed here (not in orchestration) so the
+            # sched.wait span and the execution share one span tree —
+            # perf_report's queue-wait column pairs them.
+            payload.trace_id = payload.trace_id or generate_trace_id()
+            try:
+                ticket = scheduler.submit_payload(payload)
+            except SchedulerSaturated as exc:
+                return web.json_response(
+                    {"error": str(exc), "lane": exc.lane},
+                    status=429,
+                    headers={"Retry-After": str(int(exc.retry_after))},
+                )
+            except AdmissionClosed as exc:
+                return web.json_response(
+                    {"error": str(exc)},
+                    status=503,
+                    headers={"Retry-After": str(int(exc.retry_after))},
+                )
+        # Every exit below — grant timeout, validation error, client
+        # disconnect (CancelledError out of the wait or orchestration),
+        # even a grant racing the timeout — must hand the ticket back:
+        # still-queued tickets are withdrawn, granted ones release
+        # their slot. Leaking either would permanently consume one of
+        # the max_active grant slots.
         try:
-            result = await orchestrate_distributed_execution(self.server, payload)
-        except PromptValidationError as exc:
-            return web.json_response(
-                {"error": str(exc), "node_errors": exc.node_errors}, status=400
-            )
-        return web.json_response(result)
+            if ticket is not None:
+                try:
+                    with get_tracer().span(
+                        "sched.wait",
+                        trace_id=payload.trace_id,
+                        lane=ticket.lane,
+                        tenant=ticket.tenant,
+                        ticket_id=ticket.ticket_id,
+                    ):
+                        await asyncio.wait_for(
+                            ticket.granted(), SCHED_GRANT_TIMEOUT_SECONDS
+                        )
+                except asyncio.TimeoutError:
+                    return web.json_response(
+                        {
+                            "error": "grant wait expired; scheduler saturated",
+                            "lane": ticket.lane,
+                        },
+                        status=429,
+                        headers={
+                            "Retry-After": str(
+                                int(
+                                    scheduler.queue.estimate_retry_after(
+                                        ticket.lane
+                                    )
+                                )
+                            )
+                        },
+                    )
+
+            try:
+                result = await orchestrate_distributed_execution(
+                    self.server, payload
+                )
+            except PromptValidationError as exc:
+                return web.json_response(
+                    {"error": str(exc), "node_errors": exc.node_errors},
+                    status=400,
+                )
+            if ticket is not None:
+                result["scheduler"] = {
+                    "ticket_id": ticket.ticket_id,
+                    "tenant": ticket.tenant,
+                    "lane": ticket.lane,
+                    "queue_wait_seconds": ticket.queue_wait_seconds,
+                }
+            return web.json_response(result)
+        finally:
+            if ticket is not None:
+                if ticket.state == "queued":
+                    scheduler.queue.cancel(ticket)
+                else:
+                    scheduler.queue.release(ticket)  # no-op unless granted
 
     async def job_complete(self, request: web.Request) -> web.Response:
         """Canonical envelope {job_id, worker_id, batch_idx, image

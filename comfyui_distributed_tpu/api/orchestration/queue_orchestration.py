@@ -26,8 +26,10 @@ from ...graph.prompt import (
     prepare_delegate_master_prompt,
     prune_prompt_for_worker,
 )
+from ...resilience.health import get_health_registry
 from ...telemetry import get_tracer
 from ...telemetry.instruments import orchestrations_total
+from ...utils.exceptions import WorkerNotAvailableError
 from ...utils import config as config_mod
 from ...utils.logging import log
 from ...utils.network import build_master_callback_url
@@ -155,6 +157,14 @@ async def _orchestrate(
     for worker, result in zip(active, results):
         if isinstance(result, Exception):
             log(f"dispatch to {worker.get('id')} failed: {result}")
+            # Partial-failure contract: one worker failing prep/dispatch
+            # mid-fanout must not hide from the circuit breaker. The
+            # dispatch layer already recorded WorkerNotAvailableError
+            # outcomes (including the alive-but-rejecting case, which
+            # must NOT count as a failure); anything else — a prompt
+            # rewrite or media-sync prep crash — is recorded here.
+            if not isinstance(result, WorkerNotAvailableError):
+                get_health_registry().record_failure(str(worker.get("id")))
         else:
             dispatched.append(str(worker.get("id")))
 

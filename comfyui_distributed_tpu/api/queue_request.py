@@ -4,6 +4,11 @@ Contract parity with reference api/queue_request.py + api/schemas.py:
 accepts {"prompt" | "workflow": {...}, "workers" | "worker_ids":
 [...], "client_id": str, "job_id"?: str, ...}; strict errors name the
 offending field.
+
+Scheduler additions: an optional `tenant` (fair-share accounting key;
+defaults to "default") and `lane` (admission priority class; unknown
+lanes fall back server-side) thread the multi-tenant control plane
+through the payload — see scheduler/queue.py and docs/scheduler.md.
 """
 
 from __future__ import annotations
@@ -18,12 +23,17 @@ class QueueRequestError(DistributedError):
     pass
 
 
+DEFAULT_TENANT = "default"
+
+
 @dataclasses.dataclass
 class QueueRequestPayload:
     prompt: dict[str, Any]
     client_id: str
     worker_ids: list[str]
     trace_id: str | None = None
+    tenant: str = DEFAULT_TENANT
+    lane: str | None = None
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -49,14 +59,33 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
     ):
         raise QueueRequestError("'workers' must be a list of ids")
 
+    tenant = body.get("tenant", DEFAULT_TENANT)
+    if not isinstance(tenant, str) or not tenant:
+        raise QueueRequestError("'tenant' must be a non-empty string")
+
+    lane = body.get("lane")
+    if lane is not None and (not isinstance(lane, str) or not lane):
+        raise QueueRequestError("'lane' must be a non-empty string")
+
     return QueueRequestPayload(
         prompt=prompt,
         client_id=client_id,
         worker_ids=[str(w) for w in workers],
         trace_id=body.get("trace_id") or None,
+        tenant=tenant,
+        lane=lane,
         extra={
             k: v
             for k, v in body.items()
-            if k not in ("prompt", "workflow", "client_id", "workers", "worker_ids")
+            if k
+            not in (
+                "prompt",
+                "workflow",
+                "client_id",
+                "workers",
+                "worker_ids",
+                "tenant",
+                "lane",
+            )
         },
     )

@@ -1,0 +1,78 @@
+"""Scheduler control-plane routes.
+
+    GET  /distributed/scheduler/status        — lanes, deficits, weights
+    POST /distributed/scheduler/pause         — withhold grants
+    POST /distributed/scheduler/resume        — reopen grants/admission
+    POST /distributed/scheduler/drain         — close admission
+    POST /distributed/scheduler/reprioritize  — move a ticket / retune
+                                                a tenant weight
+
+The admission gate itself lives in the queue route
+(job_routes.JobRoutes.queue): a full lane answers 429 + Retry-After
+there; these routes only *drive* the state machine and expose it.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def register(app: web.Application, server) -> None:
+    routes = SchedulerRoutes(server)
+    app.router.add_get("/distributed/scheduler/status", routes.status)
+    app.router.add_post("/distributed/scheduler/pause", routes.pause)
+    app.router.add_post("/distributed/scheduler/resume", routes.resume)
+    app.router.add_post("/distributed/scheduler/drain", routes.drain)
+    app.router.add_post(
+        "/distributed/scheduler/reprioritize", routes.reprioritize
+    )
+
+
+class SchedulerRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    @property
+    def scheduler(self):
+        return self.server.scheduler
+
+    async def status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.scheduler.status())
+
+    async def pause(self, request: web.Request) -> web.Response:
+        return web.json_response({"state": self.scheduler.pause().value})
+
+    async def resume(self, request: web.Request) -> web.Response:
+        return web.json_response({"state": self.scheduler.resume().value})
+
+    async def drain(self, request: web.Request) -> web.Response:
+        return web.json_response({"state": self.scheduler.drain().value})
+
+    async def reprioritize(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"error": "body must be an object"}, status=400
+            )
+        if not any(k in body for k in ("ticket_id", "tenant")):
+            return web.json_response(
+                {"error": "need 'ticket_id'+'lane' and/or 'tenant'+'weight'"},
+                status=400,
+            )
+        try:
+            result = self.scheduler.reprioritize(
+                ticket_id=body.get("ticket_id"),
+                lane=body.get("lane"),
+                tenant=body.get("tenant"),
+                weight=body.get("weight"),
+            )
+        except (TypeError, ValueError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        if body.get("ticket_id") is not None and not result["moved"]:
+            return web.json_response(
+                dict(result, error="no such queued ticket"), status=404
+            )
+        return web.json_response(result)

@@ -102,8 +102,24 @@ class DistributedServer:
         self.watchdog = Watchdog(
             store=self.job_store, health=get_health_registry()
         )
+        # Scheduler control plane: admission lanes + fair share sit in
+        # front of orchestration (job_routes.queue gates on it), and
+        # the placement policy steers the job store's pull path —
+        # speed-weighted batches, tail trimming. Both consume the
+        # store's pull→submit latency stream, so the sink fans out.
+        from ..scheduler import SchedulerControl
+
+        self.scheduler = SchedulerControl(health=get_health_registry())
+        self.job_store.placement = self.scheduler.placement
+        sinks = [self.scheduler.placement.record_latency]
         if self._watchdog_enabled:
-            self.job_store.latency_sink = self.watchdog.record_latency
+            sinks.append(self.watchdog.record_latency)
+
+        def _latency_fan_out(worker_id: str, seconds: float) -> None:
+            for sink in sinks:
+                sink(worker_id, seconds)
+
+        self.job_store.latency_sink = _latency_fan_out
         # Live-state gauge collectors are bound in start() — a server
         # constructed but never started must not leave a collector
         # (holding a strong reference to it) in the global registry.
@@ -142,6 +158,7 @@ class DistributedServer:
         from . import (
             config_routes,
             job_routes,
+            scheduler_routes,
             telemetry_routes,
             tunnel_routes,
             usdu_routes,
@@ -154,6 +171,7 @@ class DistributedServer:
         self.app.router.add_post("/interrupt", self.handle_interrupt)
         self.app.router.add_get("/history/{prompt_id}", self.handle_history)
         job_routes.register(self.app, self)
+        scheduler_routes.register(self.app, self)
         telemetry_routes.register(self.app, self)
         usdu_routes.register(self.app, self)
         config_routes.register(self.app, self)

@@ -55,12 +55,20 @@ class UsduRoutes:
         return web.json_response({"status": "ok" if ok else "unknown_job"})
 
     async def request_image(self, request: web.Request) -> web.Response:
-        """Pull one work item. Response: {tile_idx|image_idx|None,
-        estimated_remaining, batched_static}."""
+        """Pull work. Response: {tile_idx|image_idx|None,
+        estimated_remaining, batched_static}. A request carrying
+        `batch_max` > 1 opts into speed-weighted batch pulls: the
+        placement policy sizes the batch for this worker and the
+        response adds `tile_idxs` (first element == tile_idx, so
+        single-pull clients are unaffected)."""
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
         job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
+        try:
+            batch_max = max(1, int(body.get("batch_max", 1)))
+        except (TypeError, ValueError):
+            batch_max = 1
         with rpc_span(
             request, "rpc.request_image", worker_id=worker_id, job_id=job_id
         ) as span:
@@ -69,20 +77,31 @@ class UsduRoutes:
             )
             if job is None:
                 return web.json_response({"error": "no such job"}, status=404)
-            task_id = await self.server.job_store.pull_task(
-                job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
-            )
+            if batch_max > 1:
+                task_ids = await self.server.job_store.pull_tasks(
+                    job_id, worker_id,
+                    timeout=QUEUE_POLL_INTERVAL_SECONDS, limit=batch_max,
+                )
+                task_id = task_ids[0] if task_ids else None
+            else:
+                task_id = await self.server.job_store.pull_task(
+                    job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
+                )
+                task_ids = [task_id] if task_id is not None else []
             remaining = await self.server.job_store.remaining(job_id)
             if span is not None and task_id is not None:
                 span.attrs["tile_idx"] = int(task_id)
+                if len(task_ids) > 1:
+                    span.attrs["batch"] = [int(t) for t in task_ids]
         key = "tile_idx" if job.batched or type(job).__name__ == "TileJob" else "image_idx"
-        return web.json_response(
-            {
-                key: task_id,
-                "estimated_remaining": remaining,
-                "batched_static": job.batched,
-            }
-        )
+        response = {
+            key: task_id,
+            "estimated_remaining": remaining,
+            "batched_static": job.batched,
+        }
+        if batch_max > 1:
+            response["tile_idxs"] = task_ids
+        return web.json_response(response)
 
     async def submit_tiles(self, request: web.Request) -> web.Response:
         """{job_id, worker_id, tiles: [entry...], is_final_flush} where
@@ -110,10 +129,10 @@ class UsduRoutes:
                 if not isinstance(entry, dict) or "tile_idx" not in entry or "image" not in entry:
                     return web.json_response({"error": "bad tile entry"}, status=400)
                 grouped.setdefault(int(entry["tile_idx"]), []).append(entry)
-            accepted = 0
-            for tile_idx, payload in grouped.items():
-                if await store.submit_result(job_id, worker_id, tile_idx, payload):
-                    accepted += 1
+            # flush-aware submission: one request = one flush, so the
+            # store amortizes the interval across its tiles instead of
+            # logging near-zero latencies for tiles 2..k
+            accepted = await store.submit_flush(job_id, worker_id, grouped)
             if body.get("is_final_flush"):
                 await store.mark_worker_done(job_id, worker_id)
             if span is not None:

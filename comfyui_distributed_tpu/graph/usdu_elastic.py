@@ -43,6 +43,7 @@ from ..utils.constants import (
     MAX_TILE_BATCH,
     PAYLOAD_HEADROOM,
     QUEUE_POLL_INTERVAL_SECONDS,
+    SCHED_MAX_PULL_BATCH,
 )
 from ..resilience.policy import (
     http_policy,
@@ -144,17 +145,20 @@ class HTTPWorkClient:
 
         return run_async_in_server_loop(poll(), timeout=None)
 
-    def request_tile(self) -> Optional[dict]:
+    def request_tile(self, batch_max: int = 1) -> Optional[dict]:
         """Pull next work item; None when drained (or the master stayed
-        unreachable through the whole pull policy)."""
+        unreachable through the whole pull policy). `batch_max` > 1
+        opts into the master's speed-weighted batch pulls — the
+        response then carries `tile_idxs` (placement-sized, ≤
+        batch_max) alongside the compatible single `tile_idx`."""
 
         async def pull():
+            payload = {"job_id": self.job_id, "worker_id": self.worker_id}
+            if batch_max > 1:
+                payload["batch_max"] = int(batch_max)
             try:
                 return await retry_async(
-                    lambda: self._post(
-                        "/distributed/request_image",
-                        {"job_id": self.job_id, "worker_id": self.worker_id},
-                    ),
+                    lambda: self._post("/distributed/request_image", payload),
                     work_pull_policy(),
                     label=f"request_tile:{self.worker_id}",
                 )
@@ -233,6 +237,27 @@ def _flush_threshold_bytes() -> int:
     return MAX_PAYLOAD_SIZE - PAYLOAD_HEADROOM
 
 
+def _make_pull(client: Any):
+    """Zero-arg pull callable for the worker loop, resolved ONCE per
+    client: batched grants when the client's request_tile accepts
+    batch_max, plain otherwise (scripted test clients predate it). The
+    capability check reads the signature — catching TypeError from the
+    call itself would mask a real client bug AND double-pull work the
+    master already assigned."""
+    import inspect
+
+    try:
+        params = inspect.signature(client.request_tile).parameters
+        supports_batch = "batch_max" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+    except (TypeError, ValueError):
+        supports_batch = True  # unintrospectable callable: assume current API
+    if supports_batch:
+        return lambda: client.request_tile(batch_max=SCHED_MAX_PULL_BATCH)
+    return client.request_tile
+
+
 def run_worker_loop(
     bundle: pl.PipelineBundle,
     image,
@@ -286,45 +311,64 @@ def run_worker_loop(
                 client.submit_tiles(pending, is_final)
         pending, pending_bytes = [], 0
 
+    # Adaptive pull batches: the master's placement policy sizes each
+    # grant by this worker's measured speed (scheduler/placement.py),
+    # replacing the fixed per-pull split — a fast worker amortizes the
+    # pull RPC over several tiles, a slow one stays at one so a requeue
+    # never orphans a big claim. A master without the batch field
+    # answers with a single tile_idx and the loop degrades to the
+    # historical one-at-a-time pull.
+    pull_work = _make_pull(client)
     while True:
         if context is not None:
             context.check_interrupted()
         with _stage("pull", "worker") as pull_span:
-            work = client.request_tile()
+            work = pull_work()
             if work is None:
                 pull_span.attrs["outcome"] = "empty"
             else:
                 pull_span.attrs["tile_idx"] = int(work["tile_idx"])
+                if work.get("tile_idxs"):
+                    pull_span.attrs["batch"] = [
+                        int(t) for t in work["tile_idxs"]
+                    ]
         if work is None:
             break
-        tile_idx = int(work["tile_idx"])
-        tkey = jax.random.fold_in(key, tile_idx)
-        with _stage("sample", "worker", tile_idx):
-            result = process(
-                bundle.params, extracted[tile_idx], tkey, pos, neg,
-                positions[tile_idx],
-            )
-        with _stage("encode", "worker", tile_idx):
-            arr = img_utils.ensure_numpy(result)
-            for batch_idx in range(arr.shape[0]):
-                encoded = img_utils.encode_image_data_url(arr[batch_idx])
-                y, x = grid.positions[tile_idx]
-                entry = {
-                    "tile_idx": tile_idx,
-                    "batch_idx": batch_idx,
-                    "global_idx": tile_idx * arr.shape[0] + batch_idx,
-                    "x": int(x),
-                    "y": int(y),
-                    "extracted_w": grid.padded_w,
-                    "extracted_h": grid.padded_h,
-                    "image": encoded,
-                }
-                pending.append(entry)
-                pending_bytes += len(encoded)
-        tiles_processed_total().inc(role="worker")
-        client.heartbeat()
-        if len(pending) >= MAX_TILE_BATCH or pending_bytes >= _flush_threshold_bytes():
-            flush(is_final=False)
+        batch = work.get("tile_idxs") or [work["tile_idx"]]
+        for tile_idx in batch:
+            if context is not None:
+                context.check_interrupted()
+            tile_idx = int(tile_idx)
+            tkey = jax.random.fold_in(key, tile_idx)
+            with _stage("sample", "worker", tile_idx):
+                result = process(
+                    bundle.params, extracted[tile_idx], tkey, pos, neg,
+                    positions[tile_idx],
+                )
+            with _stage("encode", "worker", tile_idx):
+                arr = img_utils.ensure_numpy(result)
+                for batch_idx in range(arr.shape[0]):
+                    encoded = img_utils.encode_image_data_url(arr[batch_idx])
+                    y, x = grid.positions[tile_idx]
+                    entry = {
+                        "tile_idx": tile_idx,
+                        "batch_idx": batch_idx,
+                        "global_idx": tile_idx * arr.shape[0] + batch_idx,
+                        "x": int(x),
+                        "y": int(y),
+                        "extracted_w": grid.padded_w,
+                        "extracted_h": grid.padded_h,
+                        "image": encoded,
+                    }
+                    pending.append(entry)
+                    pending_bytes += len(encoded)
+            tiles_processed_total().inc(role="worker")
+            client.heartbeat()
+            if (
+                len(pending) >= MAX_TILE_BATCH
+                or pending_bytes >= _flush_threshold_bytes()
+            ):
+                flush(is_final=False)
     flush(is_final=True)
 
 

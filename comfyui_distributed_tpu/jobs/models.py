@@ -45,6 +45,10 @@ class TileJob:
     assigned_at: dict[tuple[str, int], float] = dataclasses.field(
         default_factory=dict
     )
+    # worker_id → monotonic time of its last accepted/duplicate submit;
+    # bounds the service-time measurement for tiles pulled in a batch
+    # (see JobStore.submit_result)
+    last_submit: dict[str, float] = dataclasses.field(default_factory=dict)
     # task ids already speculatively re-enqueued by the stall watchdog
     # (each tail tile is speculated at most once per stall)
     speculated: set[int] = dataclasses.field(default_factory=set)

@@ -44,8 +44,14 @@ class JobStore:
         self.fault_injector = fault_injector
         # Optional (worker_id, seconds) callback fed every completed
         # task's pull→submit latency — the watchdog's straggler signal
-        # (the server wires this to Watchdog.record_latency).
+        # and the placement policy's speed model (the server wires this
+        # to a fan-out over both).
         self.latency_sink: Optional[Callable[[str, float], None]] = None
+        # Optional placement hook (scheduler/placement.PlacementPolicy):
+        # consulted by pull_task (may_pull → tail trimming) and
+        # pull_tasks (batch_size → speed-weighted batches). None keeps
+        # the historical uniform single-tile pull exactly.
+        self.placement: Any = None
         # job_id → [(loop, future)] waiters parked until creation;
         # woken via call_soon_threadsafe so waiters on OTHER loops
         # (asyncio.run fallbacks on compute threads) wake safely.
@@ -189,17 +195,44 @@ class JobStore:
             self._tile_waiters, self.tile_jobs, job_id, grace_seconds
         )
 
+    def _may_pull(self, job: TileJob, worker_id: str) -> bool:
+        """Placement consult (tail trimming). Advisory: any policy
+        error fails open — a broken policy must not stall the queue."""
+        placement = self.placement
+        if placement is None:
+            return True
+        try:
+            return bool(placement.may_pull(worker_id, job.pending.qsize()))
+        except Exception as exc:  # noqa: BLE001 - placement is advisory
+            debug_log(f"placement may_pull({worker_id}) failed: {exc}")
+            return True
+
+    def _record_assignment_locked(self, job: TileJob, worker_id: str, task_id: int) -> None:
+        """Caller holds self.lock."""
+        job.assigned.setdefault(worker_id, set()).add(task_id)
+        job.assigned_at[(worker_id, task_id)] = time.monotonic()
+
     async def pull_task(
         self, job_id: str, worker_id: str, timeout: float = 0.1
     ) -> Optional[int]:
         """Pop the next pending task id for a worker (None = drained).
         Records assignment + heartbeat for requeue bookkeeping. An
         empty pull ALSO heartbeats: a worker draining the queue tail
-        is alive, and timing it out would requeue its in-flight task."""
+        is alive, and timing it out would requeue its in-flight task.
+        A placement-trimmed pull reads exactly like a drained queue —
+        the worker flushes and exits while faster participants finish
+        the tail."""
         await self._fault("pull", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
+        if not self._may_pull(job, worker_id):
+            async with self.lock:
+                self._record_heartbeat(job, worker_id)
+            instruments.store_pulls_total().inc(
+                worker_id=worker_id, outcome="trimmed"
+            )
+            return None
         try:
             task_id = await asyncio.wait_for(job.pending.get(), timeout)
         except asyncio.TimeoutError:
@@ -209,33 +242,95 @@ class JobStore:
             return None
         async with self.lock:
             self._record_heartbeat(job, worker_id)
-            job.assigned.setdefault(worker_id, set()).add(task_id)
-            job.assigned_at[(worker_id, task_id)] = time.monotonic()
+            self._record_assignment_locked(job, worker_id, task_id)
         instruments.store_pulls_total().inc(worker_id=worker_id, outcome="task")
         return task_id
 
+    async def pull_tasks(
+        self,
+        job_id: str,
+        worker_id: str,
+        timeout: float = 0.1,
+        limit: Optional[int] = None,
+    ) -> list[int]:
+        """Speed-weighted batch pull: the first task waits up to
+        `timeout` (exactly pull_task); additional pending tasks are
+        claimed without waiting, up to the placement policy's batch
+        size for this worker (and the caller's `limit`). Without a
+        placement policy the batch is 1 — byte-identical behavior to
+        the historical single pull."""
+        first = await self.pull_task(job_id, worker_id, timeout)
+        if first is None:
+            return []
+        tasks = [first]
+        placement = self.placement
+        size = 1
+        job = await self.get_tile_job(job_id)
+        if placement is not None and job is not None:
+            try:
+                size = int(placement.batch_size(worker_id, job.pending.qsize() + 1))
+            except Exception as exc:  # noqa: BLE001 - placement is advisory
+                debug_log(f"placement batch_size({worker_id}) failed: {exc}")
+                size = 1
+        if limit is not None:
+            size = min(size, int(limit))
+        if job is not None and size > 1:
+            async with self.lock:
+                while len(tasks) < size:
+                    try:
+                        task_id = job.pending.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    self._record_assignment_locked(job, worker_id, task_id)
+                    instruments.store_pulls_total().inc(
+                        worker_id=worker_id, outcome="task"
+                    )
+                    tasks.append(task_id)
+        return tasks
+
     async def submit_result(
-        self, job_id: str, worker_id: str, task_id: int, payload: Any
+        self,
+        job_id: str,
+        worker_id: str,
+        task_id: int,
+        payload: Any,
+        service_seconds: Optional[float] = None,
     ) -> bool:
         """Record one completed task; False if duplicate (already done
         — a requeued-then-recovered worker's late submission, or the
-        losing side of a speculative race: first result wins)."""
+        losing side of a speculative race: first result wins).
+        `service_seconds` overrides the measured latency for tiles that
+        traveled in a flushed batch (see `submit_flush`)."""
         await self._fault("submit", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
+        now = time.monotonic()
         async with self.lock:
             self._record_heartbeat(job, worker_id)
             job.assigned.get(worker_id, set()).discard(task_id)
             started = job.assigned_at.pop((worker_id, task_id), None)
+            # Batched pulls assign several tiles at once; a tile's
+            # SERVICE time is measured from whichever came later — its
+            # assignment or the worker's previous submission — so the
+            # time a tile sat in the worker's local batch doesn't read
+            # as slowness (the watchdog and placement weights both
+            # consume this stream).
+            prev_done = job.last_submit.get(worker_id)
+            job.last_submit[worker_id] = now
             duplicate = task_id in job.completed
             if not duplicate:
                 job.completed[task_id] = payload
-        if started is not None:
+        if started is not None or service_seconds is not None:
             # duplicates still carry a real latency measurement: the
             # losing worker DID the work, and its speed is exactly what
             # the straggler detector needs to see
-            elapsed = time.monotonic() - started
+            if service_seconds is not None:
+                elapsed = service_seconds
+            else:
+                if prev_done is not None:
+                    started = max(started, prev_done)
+                elapsed = now - started
             instruments.worker_tile_seconds().observe(elapsed, worker_id=worker_id)
             sink = self.latency_sink
             if sink is not None:
@@ -254,6 +349,42 @@ class JobStore:
         )
         await job.results.put((task_id, payload))
         return True
+
+    async def submit_flush(
+        self, job_id: str, worker_id: str, grouped: dict[int, Any]
+    ) -> int:
+        """Record a FLUSH: several tiles that traveled in one submit
+        request (the production worker batches up to CDT_MAX_BATCH
+        tiles per /distributed/submit_tiles). Per-tile service time is
+        the flush interval — since the worker's previous submit, or its
+        earliest assignment in the flush — divided evenly: recording
+        the per-entry arrival gaps instead would log k-1 near-zero
+        latencies per flush and poison the straggler median and the
+        placement speed EWMA. Returns the number of accepted tiles."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            raise JobQueueError(f"no such job {job_id!r}")
+        now = time.monotonic()
+        async with self.lock:
+            prev_done = job.last_submit.get(worker_id)
+            starteds = [
+                job.assigned_at.get((worker_id, int(t))) for t in grouped
+            ]
+        starteds = [s for s in starteds if s is not None]
+        share: Optional[float] = None
+        if starteds:
+            base = min(starteds)
+            if prev_done is not None:
+                base = max(base, prev_done)
+            share = max(now - base, 1e-6) / len(grouped)
+        accepted = 0
+        for task_id, payload in grouped.items():
+            if await self.submit_result(
+                job_id, worker_id, int(task_id), payload,
+                service_seconds=share,
+            ):
+                accepted += 1
+        return accepted
 
     async def mark_worker_done(self, job_id: str, worker_id: str) -> None:
         job = await self.get_tile_job(job_id)

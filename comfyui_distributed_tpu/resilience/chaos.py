@@ -85,6 +85,10 @@ class ChaosResult:
     stalls: list[str] = dataclasses.field(default_factory=list)
     speculated: dict[str, list[int]] = dataclasses.field(default_factory=dict)
     health: dict[str, dict] = dataclasses.field(default_factory=dict)
+    # accepted (first-wins) submissions per participant, master included
+    tiles_by_worker: dict[str, int] = dataclasses.field(default_factory=dict)
+    # placement snapshot (populated when run_chaos_usdu(placement=...))
+    placement: dict = dataclasses.field(default_factory=dict)
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -133,6 +137,7 @@ def run_chaos_usdu(
     job_id: str = "chaos-job",
     trace_jsonl: Optional[str] = None,
     watchdog: Optional[dict] = None,
+    placement: Optional[dict] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -157,6 +162,18 @@ def run_chaos_usdu(
     .stalls / .speculated / .health. The harness defaults are tight
     (50 ms interval, 300 ms stall window, min_samples=1) so sub-second
     chaos plans trigger real detections.
+
+    `placement`: pass a dict of PlacementPolicy overrides (may be
+    empty) to run cost-aware weighted placement over the harness store
+    — worker threads then pull speed-sized BATCHES through
+    `JobStore.pull_tasks`, the policy's EWMA is fed by the same latency
+    sink, and tail pulls from slow/suspect workers are trimmed. The
+    harness defaults (min_samples=1, base_batch=2, max_batch=4,
+    tail_tiles=1) make a sub-second run develop real weights. Accepted
+    submissions per participant land in ChaosResult.tiles_by_worker and
+    the policy snapshot in ChaosResult.placement — chaos tests assert a
+    straggler receives measurably fewer tiles while the canvas stays
+    bit-identical (placement must change WHO, never WHAT).
     """
     import jax
     import jax.numpy as jnp
@@ -174,6 +191,7 @@ def run_chaos_usdu(
     store = JobStore(fault_injector=injector)
     wd = None
     wd_health = None
+    latency_sinks = []
     if watchdog is not None:
         from ..telemetry.watchdog import Watchdog
         from .health import HealthRegistry
@@ -185,7 +203,23 @@ def run_chaos_usdu(
         )
         wd_kwargs.update(watchdog)
         wd = Watchdog(store=store, health=wd_health, **wd_kwargs)
-        store.latency_sink = wd.record_latency
+        latency_sinks.append(wd.record_latency)
+    policy = None
+    if placement is not None:
+        from ..scheduler.placement import PlacementPolicy
+
+        pl_kwargs = dict(
+            min_samples=1, base_batch=2, max_batch=4, tail_tiles=1,
+            health=wd_health,
+        )
+        pl_kwargs.update(placement)
+        policy = PlacementPolicy(**pl_kwargs)
+        store.placement = policy
+        latency_sinks.append(policy.record_latency)
+    if latency_sinks:
+        store.latency_sink = lambda wid, sec: [
+            sink(wid, sec) for sink in latency_sinks
+        ]
     server = types.SimpleNamespace(job_store=store)
     ctx = ExecutionContext(server=server, config={"workers": []})
     bundle = types.SimpleNamespace(params=None)
@@ -198,6 +232,8 @@ def run_chaos_usdu(
         np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
     )
     pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+
+    accepted_by_worker: dict[str, int] = {wid: 0 for wid in workers}
 
     def worker_body(wid: str) -> None:
         # Identical preprocessing to the master: per-tile determinism
@@ -219,43 +255,51 @@ def run_chaos_usdu(
             while True:
                 if injector is not None:
                     injector.check_blocking(f"chaos:{wid}:pull")
+                # pull_tasks = the production batch path: singleton
+                # batches without a placement policy (byte-identical to
+                # the historical pull), speed-sized grants with one.
                 with tracer.span(
                     "tile.pull", stage="pull", role="worker", worker_id=wid
                 ) as pull_span:
-                    tile_idx = run_async_in_server_loop(
-                        store.pull_task(job_id, wid, timeout=0.2), timeout=10
+                    batch = run_async_in_server_loop(
+                        store.pull_tasks(job_id, wid, timeout=0.2), timeout=10
                     )
-                if tile_idx is None:
+                if not batch:
                     break
-                pull_span.attrs["tile_idx"] = int(tile_idx)
-                if injector is not None:
-                    injector.check_blocking(f"chaos:{wid}:pulled")
-                with tracer.span(
-                    "tile.sample", stage="sample", role="worker",
-                    worker_id=wid, tile_idx=int(tile_idx),
-                ):
-                    tkey = jax.random.fold_in(key, tile_idx)
-                    result = _stub_process(
-                        None, extracted[tile_idx], tkey, None, None, None
-                    )
-                arr = img_utils.ensure_numpy(result)
-                payload = [
-                    {
-                        "batch_idx": i,
-                        "image": img_utils.encode_image_data_url(arr[i]),
-                    }
-                    for i in range(arr.shape[0])
-                ]
-                if injector is not None:
-                    injector.check_blocking(f"chaos:{wid}:submit")
-                with tracer.span(
-                    "tile.submit", stage="submit", role="worker",
-                    worker_id=wid, tile_idx=int(tile_idx),
-                ):
-                    run_async_in_server_loop(
-                        store.submit_result(job_id, wid, tile_idx, payload),
-                        timeout=10,
-                    )
+                pull_span.attrs["tile_idx"] = int(batch[0])
+                if len(batch) > 1:
+                    pull_span.attrs["batch"] = [int(t) for t in batch]
+                for tile_idx in batch:
+                    if injector is not None:
+                        injector.check_blocking(f"chaos:{wid}:pulled")
+                    with tracer.span(
+                        "tile.sample", stage="sample", role="worker",
+                        worker_id=wid, tile_idx=int(tile_idx),
+                    ):
+                        tkey = jax.random.fold_in(key, tile_idx)
+                        result = _stub_process(
+                            None, extracted[tile_idx], tkey, None, None, None
+                        )
+                    arr = img_utils.ensure_numpy(result)
+                    payload = [
+                        {
+                            "batch_idx": i,
+                            "image": img_utils.encode_image_data_url(arr[i]),
+                        }
+                        for i in range(arr.shape[0])
+                    ]
+                    if injector is not None:
+                        injector.check_blocking(f"chaos:{wid}:submit")
+                    with tracer.span(
+                        "tile.submit", stage="submit", role="worker",
+                        worker_id=wid, tile_idx=int(tile_idx),
+                    ):
+                        accepted = run_async_in_server_loop(
+                            store.submit_result(job_id, wid, tile_idx, payload),
+                            timeout=10,
+                        )
+                    if accepted:
+                        accepted_by_worker[wid] += 1
         except FaultInjected as exc:
             # Simulated crash: the thread dies with a tile assigned and
             # unsubmitted; the master's requeue path must recover it.
@@ -319,6 +363,12 @@ def run_chaos_usdu(
             chaos_tracer.write_jsonl(trace_id, trace_jsonl)
     finally:
         set_tracer(previous_tracer)
+    # every tile is accepted exactly once (first result wins), so the
+    # master's share is the remainder (plan_grid: geometry only, no
+    # second resize/extract pass)
+    _, _, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding, None)
+    tiles_by_worker = dict(accepted_by_worker)
+    tiles_by_worker["master"] = grid.num_tiles - sum(accepted_by_worker.values())
     return ChaosResult(
         output=np.asarray(out),
         fired=list(injector.fired) if injector is not None else [],
@@ -328,4 +378,6 @@ def run_chaos_usdu(
         stalls=list(wd.stalls_detected) if wd is not None else [],
         speculated=dict(wd.speculated) if wd is not None else {},
         health=wd_health.snapshot() if wd_health is not None else {},
+        tiles_by_worker=tiles_by_worker,
+        placement=policy.snapshot() if policy is not None else {},
     )

@@ -1,0 +1,40 @@
+"""Scheduler control plane: admission, fair-share queueing, placement.
+
+The layer between the HTTP API and the job store that turns the
+passive first-come-first-served pipeline into an actively managed one:
+
+- `queue.AdmissionQueue` — multi-lane admission with priority classes,
+  per-tenant deficit-round-robin fair share, bounded depth with
+  explicit backpressure, and pause/resume/drain controls;
+- `placement.PlacementPolicy` — cost-aware work assignment: per-worker
+  throughput weights (EWMA over the store's pull→submit latencies)
+  plus analytic tile-FLOP estimates size each worker's pull batch and
+  trim the job tail away from suspect/slow workers;
+- `control.SchedulerControl` — the state machine the
+  `/distributed/scheduler/*` routes drive, and the single object a
+  `DistributedServer` owns.
+
+Determinism invariant: placement may change WHO computes a tile, never
+the blended result (per-tile noise keys + the deterministic canvas);
+the chaos suite asserts bit-identical canvases under weighted
+placement.
+"""
+
+from .control import SchedulerControl, SchedulerState
+from .placement import PlacementPolicy
+from .queue import (
+    AdmissionClosed,
+    AdmissionQueue,
+    SchedulerSaturated,
+    Ticket,
+)
+
+__all__ = [
+    "AdmissionClosed",
+    "AdmissionQueue",
+    "PlacementPolicy",
+    "SchedulerControl",
+    "SchedulerSaturated",
+    "SchedulerState",
+    "Ticket",
+]

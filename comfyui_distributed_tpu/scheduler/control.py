@@ -1,0 +1,128 @@
+"""SchedulerControl: the one object a DistributedServer owns.
+
+Couples the admission queue (fair-share grant order, backpressure,
+pause/resume/drain) with the placement policy (worker speed weights,
+batch sizing, tail trimming) and maps request payloads onto tenants,
+lanes, and costs. The `/distributed/scheduler/*` routes
+(api/scheduler_routes.py) and the queue route's admission gate
+(api/job_routes.py) talk to this, never to the internals directly.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Callable, Optional
+
+from ..telemetry.events import get_event_bus
+from ..utils.logging import log
+from .placement import PlacementPolicy
+from .queue import AdmissionQueue, Ticket
+
+
+class SchedulerState(enum.Enum):
+    RUNNING = "running"
+    PAUSED = "paused"
+    DRAINING = "draining"
+
+
+class SchedulerControl:
+    def __init__(
+        self,
+        health: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+        queue: Optional[AdmissionQueue] = None,
+        placement: Optional[PlacementPolicy] = None,
+    ) -> None:
+        self.queue = queue or AdmissionQueue(clock=clock)
+        self.placement = placement or PlacementPolicy(health=health)
+
+    # --- payload mapping --------------------------------------------------
+
+    def submit_payload(self, payload: Any) -> Ticket:
+        """Admit one parsed QueueRequestPayload. Cost is the request's
+        estimated tile count when the client provided one
+        (`estimated_tiles` in the body), else 1 — so fair share meters
+        tile WORK, and a tenant of huge upscales can't starve a tenant
+        of small ones by request-count arithmetic."""
+        cost = 1.0
+        estimated = payload.extra.get("estimated_tiles")
+        try:
+            if estimated is not None and float(estimated) > 0:
+                cost = float(estimated)
+        except (TypeError, ValueError):
+            pass
+        return self.queue.submit(
+            tenant=payload.tenant,
+            lane=payload.lane,
+            cost=cost,
+            trace_id=payload.trace_id,
+        )
+
+    # --- state machine ----------------------------------------------------
+
+    @property
+    def state(self) -> SchedulerState:
+        return SchedulerState(self.queue.state)
+
+    def pause(self) -> SchedulerState:
+        self.queue.pause()
+        self._publish_state()
+        return self.state
+
+    def resume(self) -> SchedulerState:
+        self.queue.resume()
+        self._publish_state()
+        return self.state
+
+    def drain(self) -> SchedulerState:
+        self.queue.drain()
+        self._publish_state()
+        return self.state
+
+    def _publish_state(self) -> None:
+        get_event_bus().publish(
+            "scheduler_state",
+            state=self.queue.state,
+            active=len(self.queue.active),
+            queued=self.queue.queued(),
+        )
+
+    # --- reprioritization -------------------------------------------------
+
+    def reprioritize(
+        self,
+        ticket_id: Optional[str] = None,
+        lane: Optional[str] = None,
+        tenant: Optional[str] = None,
+        weight: Optional[float] = None,
+    ) -> dict:
+        """Two shapes: {ticket_id, lane} moves one queued request to
+        another priority class; {tenant, weight} retunes a tenant's
+        fair share live. Both may appear in one call."""
+        moved = None
+        if ticket_id is not None:
+            if not lane:
+                raise ValueError("'lane' is required to move a ticket")
+            moved = self.queue.reprioritize(ticket_id, lane)
+            if moved:
+                log(f"scheduler: ticket {ticket_id} moved to lane {lane!r}")
+        if tenant is not None:
+            if weight is None:
+                raise ValueError("'weight' is required to retune a tenant")
+            self.queue.set_weight(tenant, float(weight))
+            log(f"scheduler: tenant {tenant!r} weight set to {float(weight):g}")
+        return {
+            "moved": moved,
+            "tenant_weights": dict(self.queue.tenant_weights),
+        }
+
+    # --- observability ----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "state": self.queue.state,
+            "admission": self.queue.snapshot(),
+            "placement": self.placement.snapshot(),
+            "worker_weights": self.placement.weights(),
+        }

@@ -1,0 +1,207 @@
+"""Cost-aware work placement: who pulls how much, and who sits out.
+
+The elastic tile queue is pull-based — workers claim work at their own
+pace — which self-balances in the mean but wastes the tail: a slow or
+suspect worker that claims one of the last tiles holds the whole job's
+latency hostage (the straggler problem the watchdog *detects* after
+the fact). This policy closes the loop *before* assignment:
+
+- **throughput weights** — an EWMA over each worker's pull→submit tile
+  latencies (the same stream the watchdog consumes; the JobStore's
+  ``latency_sink`` fans out to both). A worker's *speed* is 1/EWMA,
+  normalized against the fleet mean, so weights are self-calibrating
+  across models and tile sizes;
+- **size-aware batches** — ``batch_size`` scales a worker's pull batch
+  with its relative speed (base x speed, clamped to
+  [1, CDT_SCHED_MAX_PULL_BATCH]), replacing the fixed per-pull split:
+  fast workers amortize RPC overhead over more tiles, slow workers
+  stay at 1 so a requeue never orphans a big batch. Analytic tile-FLOP
+  estimates (ops/costs.py) convert heterogeneous tile sizes into one
+  cost currency when a job carries per-task costs;
+- **tail trimming** — inside the last ``CDT_SCHED_TAIL_TILES`` pending
+  tiles, workers that are SUSPECT/QUARANTINED in the health registry
+  or slower than ``CDT_SCHED_TRIM_RATIO`` x the mean speed are denied
+  pulls (their pull reads as drained), steering the job's tail to fast
+  healthy participants. Exempt ids (the master) are never denied —
+  someone must always be able to finish the job.
+
+Thread-safe: ``record_latency`` arrives from the store's sink on
+arbitrary threads; decisions run on the server loop.
+
+Determinism: placement changes WHO computes a tile, never the result —
+per-tile noise keys and the deterministic blend canvas make the output
+independent of assignment (asserted by tests/test_chaos_usdu.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ..utils import constants
+
+
+class PlacementPolicy:
+    def __init__(
+        self,
+        health: Any = None,
+        alpha: float | None = None,
+        min_samples: int | None = None,
+        base_batch: int | None = None,
+        max_batch: int | None = None,
+        tail_tiles: int | None = None,
+        trim_ratio: float | None = None,
+        exempt: tuple[str, ...] = ("master",),
+        task_cost_flops: float | None = None,
+    ) -> None:
+        self.health = health
+        self.alpha = alpha if alpha is not None else constants.SCHED_EWMA_ALPHA
+        self.min_samples = (
+            min_samples if min_samples is not None else constants.SCHED_MIN_SAMPLES
+        )
+        self.base_batch = (
+            base_batch if base_batch is not None else constants.SCHED_BASE_PULL_BATCH
+        )
+        self.max_batch = (
+            max_batch if max_batch is not None else constants.SCHED_MAX_PULL_BATCH
+        )
+        self.tail_tiles = (
+            tail_tiles if tail_tiles is not None else constants.SCHED_TAIL_TILES
+        )
+        self.trim_ratio = (
+            trim_ratio if trim_ratio is not None else constants.SCHED_TRIM_RATIO
+        )
+        self.exempt = frozenset(exempt)
+        # One task's estimated FLOPs (ops/costs.analytic_tile_flops);
+        # informational in the snapshot and the currency batch sizing
+        # would use for heterogeneous tasks.
+        self.task_cost_flops = task_cost_flops
+        self._lock = threading.Lock()
+        self._ewma: dict[str, float] = {}
+        self._samples: dict[str, int] = {}
+        self._trimmed: dict[str, int] = {}
+
+    # --- inputs -----------------------------------------------------------
+
+    def record_latency(self, worker_id: str, seconds: float) -> None:
+        """One completed task's pull→submit latency (JobStore sink)."""
+        seconds = max(float(seconds), 1e-6)
+        with self._lock:
+            prev = self._ewma.get(worker_id)
+            self._ewma[worker_id] = (
+                seconds
+                if prev is None
+                else (1.0 - self.alpha) * prev + self.alpha * seconds
+            )
+            self._samples[worker_id] = self._samples.get(worker_id, 0) + 1
+
+    def forget(self, worker_id: str) -> None:
+        with self._lock:
+            self._ewma.pop(worker_id, None)
+            self._samples.pop(worker_id, None)
+            self._trimmed.pop(worker_id, None)
+
+    # --- model ------------------------------------------------------------
+
+    def _speeds_locked(self) -> dict[str, float]:
+        """worker → tiles/sec for workers with enough samples."""
+        return {
+            wid: 1.0 / ewma
+            for wid, ewma in self._ewma.items()
+            if self._samples.get(wid, 0) >= self.min_samples and ewma > 0
+        }
+
+    def speed_ratio(self, worker_id: str) -> float:
+        """This worker's speed relative to the fleet mean; 1.0 until
+        enough samples exist (unknown workers are assumed average, so
+        cold-start behavior is exactly the old uniform pull)."""
+        with self._lock:
+            speeds = self._speeds_locked()
+            mine = speeds.get(worker_id)
+        if mine is None or not speeds:
+            return 1.0
+        mean = sum(speeds.values()) / len(speeds)
+        if mean <= 0:
+            return 1.0
+        return mine / mean
+
+    # --- decisions --------------------------------------------------------
+
+    def batch_size(self, worker_id: str, remaining: int) -> int:
+        """How many tasks this worker's pull may claim at once."""
+        if remaining <= 0:
+            return 1
+        if remaining <= self.tail_tiles:
+            return 1  # tail tiles are precious: no batch hoarding
+        ratio = self.speed_ratio(worker_id)
+        size = int(round(ratio * self.base_batch))
+        return max(1, min(size, self.max_batch, remaining))
+
+    def _health_state(self, worker_id: str) -> Optional[str]:
+        if self.health is None:
+            return None
+        try:
+            state = self.health.state(worker_id)
+        except Exception:  # noqa: BLE001 - advisory only
+            return None
+        return getattr(state, "value", state)
+
+    def may_pull(self, worker_id: str, remaining: int) -> bool:
+        """False = this pull reads as drained (the worker finishes its
+        in-flight work and exits). Only ever False in the job tail, and
+        never for exempt participants."""
+        if worker_id in self.exempt:
+            return True
+        if remaining <= 0 or remaining > self.tail_tiles:
+            return True
+        state = self._health_state(worker_id)
+        if state in ("suspect", "quarantined", "probing"):
+            self._note_trim(worker_id)
+            return False
+        if self.speed_ratio(worker_id) < self.trim_ratio:
+            self._note_trim(worker_id)
+            return False
+        return True
+
+    def _note_trim(self, worker_id: str) -> None:
+        with self._lock:
+            self._trimmed[worker_id] = self._trimmed.get(worker_id, 0) + 1
+
+    # --- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ewma = dict(self._ewma)
+            samples = dict(self._samples)
+            trimmed = dict(self._trimmed)
+            speeds = self._speeds_locked()
+        mean = sum(speeds.values()) / len(speeds) if speeds else 0.0
+        return {
+            "workers": {
+                wid: {
+                    "ewma_tile_seconds": round(ewma[wid], 6),
+                    "samples": samples.get(wid, 0),
+                    "speed_ratio": (
+                        round(speeds[wid] / mean, 4)
+                        if wid in speeds and mean > 0
+                        else None
+                    ),
+                    "tail_trims": trimmed.get(wid, 0),
+                }
+                for wid in sorted(ewma)
+            },
+            "base_batch": self.base_batch,
+            "max_batch": self.max_batch,
+            "tail_tiles": self.tail_tiles,
+            "trim_ratio": self.trim_ratio,
+            "task_cost_flops": self.task_cost_flops,
+        }
+
+    def weights(self) -> dict[str, float]:
+        """worker → speed ratio (mean-normalized); status endpoints."""
+        with self._lock:
+            speeds = self._speeds_locked()
+        if not speeds:
+            return {}
+        mean = sum(speeds.values()) / len(speeds)
+        return {wid: round(s / mean, 4) for wid, s in sorted(speeds.items())}

@@ -163,6 +163,70 @@ def watchdog_stalls_total() -> Counter:
     )
 
 
+# --- scheduler control plane (scheduler/) ---------------------------------
+
+# Scheduler states in gauge encoding.
+SCHED_STATE_CODES = {"running": 0, "paused": 1, "draining": 2}
+
+
+def sched_admissions_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_sched_admissions_total",
+        "Admission decisions by outcome (admitted|rejected_full|"
+        "rejected_draining|cancelled)",
+        ("lane", "tenant", "outcome"),
+    )
+
+
+def sched_grants_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_sched_grants_total",
+        "Requests granted an orchestration slot per lane/tenant",
+        ("lane", "tenant"),
+    )
+
+
+def sched_wait_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_sched_wait_seconds",
+        "Queue wait from admission to grant per lane/tenant",
+        ("lane", "tenant"),
+    )
+
+
+def sched_lane_depth() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_sched_lane_depth",
+        "Requests queued (admitted, not yet granted) per lane per server",
+        ("lane", "server"),
+    )
+
+
+def sched_active() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_sched_active",
+        "Granted orchestrations currently holding a slot per server",
+        ("server",),
+    )
+
+
+def sched_state() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_sched_state",
+        "Scheduler state per server (0=running 1=paused 2=draining)",
+        ("server",),
+    )
+
+
+def sched_worker_speed_ratio() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_sched_worker_speed_ratio",
+        "Placement speed weight per worker (1.0 = fleet mean; pull "
+        "batches scale with it)",
+        ("worker_id", "server"),
+    )
+
+
 # --- JAX runtime health (telemetry/runtime.py) ----------------------------
 
 def jax_compiles() -> Gauge:
@@ -297,6 +361,10 @@ def bind_server_collectors(server) -> Callable[[], None]:
     ensure_runtime_collectors()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
+    # worker ids this server's placement policy last reported: stale
+    # series are removed per-server (a global clear would clobber a
+    # co-hosted server's series between its scrapes)
+    speed_series_seen: set[str] = set()
 
     def collect() -> None:
         prompt_queue_depth().set(server.queue_remaining, server=label)
@@ -305,6 +373,26 @@ def bind_server_collectors(server) -> Callable[[], None]:
         tile_queue_depth().set(stats["queue_depth"], server=label)
         tiles_in_flight().set(stats["in_flight"], server=label)
         collector_jobs_active().set(stats["collectors"], server=label)
+        scheduler = getattr(server, "scheduler", None)
+        if scheduler is not None:
+            queue = scheduler.queue
+            sched_state().set(
+                SCHED_STATE_CODES.get(queue.state, -1), server=label
+            )
+            sched_active().set(len(queue.active), server=label)
+            for lane_name in queue.lane_order:
+                sched_lane_depth().set(
+                    queue.lanes[lane_name].depth(), lane=lane_name, server=label
+                )
+            speed_gauge = sched_worker_speed_ratio()
+            weights = scheduler.placement.weights()
+            # dropped workers must not freeze a series
+            for worker_id in speed_series_seen - weights.keys():
+                speed_gauge.remove(worker_id=worker_id, server=label)
+            speed_series_seen.clear()
+            speed_series_seen.update(weights)
+            for worker_id, ratio in weights.items():
+                speed_gauge.set(ratio, worker_id=worker_id, server=label)
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at
@@ -321,5 +409,15 @@ def bind_server_collectors(server) -> Callable[[], None]:
         unregister()
         for accessor in _LIVE_GAUGES:
             accessor().remove(server=label)
+        scheduler = getattr(server, "scheduler", None)
+        if scheduler is not None:
+            sched_state().remove(server=label)
+            sched_active().remove(server=label)
+            for lane_name in scheduler.queue.lane_order:
+                sched_lane_depth().remove(lane=lane_name, server=label)
+            for worker_id in speed_series_seen:
+                sched_worker_speed_ratio().remove(
+                    worker_id=worker_id, server=label
+                )
 
     return unbind

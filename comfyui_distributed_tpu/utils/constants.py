@@ -91,6 +91,39 @@ WATCHDOG_MIN_SAMPLES = _env_int("CDT_WATCHDOG_MIN_SAMPLES", 3)
 WATCHDOG_STALL_SECONDS = _env_float("CDT_WATCHDOG_STALL_SECONDS", 30.0)
 WATCHDOG_LATENCY_WINDOW = _env_int("CDT_WATCHDOG_LATENCY_WINDOW", 64)
 
+# --- scheduler control plane (scheduler/) ---------------------------------
+# Admission lanes in strict priority order as "name:depth" pairs; a
+# request lands in a lane by its payload's `lane` field (default
+# CDT_SCHED_DEFAULT_LANE). A full lane answers HTTP 429 + Retry-After.
+SCHED_LANES = os.environ.get(
+    "CDT_SCHED_LANES", "interactive:64,batch:256,background:1024"
+)
+SCHED_DEFAULT_LANE = os.environ.get("CDT_SCHED_DEFAULT_LANE", "interactive")
+# Orchestrations allowed to run concurrently; queued requests wait in
+# their lane (deficit-round-robin over tenants) for a grant slot.
+SCHED_MAX_ACTIVE = _env_int("CDT_SCHED_MAX_ACTIVE", 4)
+# DRR quantum in cost units added per tenant visit; a tenant's actual
+# replenishment is quantum x its weight (CDT_SCHED_TENANT_WEIGHTS,
+# "tenantA=3,tenantB=1"; unlisted tenants weigh 1).
+SCHED_QUANTUM = _env_float("CDT_SCHED_QUANTUM", 1.0)
+SCHED_TENANT_WEIGHTS = os.environ.get("CDT_SCHED_TENANT_WEIGHTS", "")
+# How long the queue route parks a request awaiting its grant before
+# answering 429 (the client should back off and retry).
+SCHED_GRANT_TIMEOUT_SECONDS = _env_float("CDT_SCHED_GRANT_TIMEOUT", 120.0)
+# Cost-aware placement (scheduler/placement.py): per-worker EWMA over
+# pull->submit tile latencies; a worker's pull batch scales with its
+# relative speed up to MAX_PULL_BATCH (BASE_PULL_BATCH at speed 1.0).
+# Inside the last TAIL_TILES of a job, suspect/slow workers are denied
+# pulls so the tail lands on fast healthy participants.
+SCHED_EWMA_ALPHA = _env_float("CDT_SCHED_EWMA_ALPHA", 0.25)
+SCHED_MIN_SAMPLES = _env_int("CDT_SCHED_MIN_SAMPLES", 2)
+SCHED_BASE_PULL_BATCH = _env_int("CDT_SCHED_BASE_PULL_BATCH", 2)
+SCHED_MAX_PULL_BATCH = _env_int("CDT_SCHED_MAX_PULL_BATCH", 8)
+SCHED_TAIL_TILES = _env_int("CDT_SCHED_TAIL_TILES", 2)
+# A worker slower than TRIM_RATIO x the fleet's mean speed is trimmed
+# from the tail (it may still pull while the queue is deep).
+SCHED_TRIM_RATIO = _env_float("CDT_SCHED_TRIM_RATIO", 0.5)
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

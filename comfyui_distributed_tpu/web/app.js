@@ -42,6 +42,7 @@ import {
   renderVocabBanner,
   renderWorkers,
   renderWorkflowNodes,
+  schedulerHtml,
   topologyHtml,
   WORKER_FORM_FIELDS,
   workerFormHtml,
@@ -89,7 +90,30 @@ async function refreshStatus() {
   renderWorkers(
     document.getElementById("workers"), state.config, state.workerStatus
   );
+  refreshScheduler();
   schedulePoll();
+}
+
+// ---------- scheduler lane view ----------
+
+async function refreshScheduler() {
+  const container = document.getElementById("scheduler-lanes");
+  try {
+    container.innerHTML = schedulerHtml(
+      await api("/distributed/scheduler/status")
+    );
+  } catch {
+    container.textContent = "scheduler unreachable";
+  }
+}
+
+async function schedulerAction(path) {
+  try {
+    await api(path, { method: "POST" });
+  } catch (err) {
+    alert(`scheduler: ${err.message}`);
+  }
+  refreshScheduler();
 }
 
 function schedulePoll() {
@@ -469,6 +493,12 @@ document
       alert(`save failed: ${err.message}`);
     }
   });
+document.getElementById("sched-pause").addEventListener("click", () =>
+  schedulerAction("/distributed/scheduler/pause"));
+document.getElementById("sched-resume").addEventListener("click", () =>
+  schedulerAction("/distributed/scheduler/resume"));
+document.getElementById("sched-drain").addEventListener("click", () =>
+  schedulerAction("/distributed/scheduler/drain"));
 document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
 document.getElementById("modal-close").addEventListener("click", hideModal);
 document.getElementById("queue-btn").addEventListener("click", queueWorkflow);

@@ -106,6 +106,52 @@ export function vocabBannerHtml(info) {
     <button class="small" id="vocab-banner-dismiss">dismiss</button>`;
 }
 
+/** Scheduler lane view (pure; app.js refreshScheduler applies it):
+ * admission state, per-lane depth with per-tenant queue/deficit
+ * breakdown, and the placement policy's current worker speed weights
+ * (GET /distributed/scheduler/status shape). */
+export function schedulerHtml(status) {
+  if (!status || !status.admission) {
+    return '<span class="meta">scheduler status unavailable</span>';
+  }
+  const adm = status.admission;
+  const header =
+    `state <b>${escapeHtml(adm.state)}</b> · ` +
+    `active ${adm.active}/${adm.max_active} · queued ${adm.queued}`;
+  const lanes = (adm.lanes || [])
+    .map((lane) => {
+      const tenants = Object.entries(lane.tenants || {})
+        .map(
+          ([tenant, info]) =>
+            `${escapeHtml(tenant)}: ${info.queued} queued` +
+            ` (deficit ${info.deficit})`
+        )
+        .join(" · ");
+      return (
+        `<div class="row"><strong>${escapeHtml(lane.name)}</strong>` +
+        `<span class="meta">depth ${lane.depth}/${lane.max_depth}` +
+        `${tenants ? " · " + tenants : ""}</span></div>`
+      );
+    })
+    .join("");
+  const weightEntries = Object.entries(status.worker_weights || {});
+  const weights = weightEntries.length
+    ? weightEntries
+        .map(([worker, ratio]) => `${escapeHtml(worker)}=${ratio}x`)
+        .join(", ")
+    : "no samples yet";
+  const tenantWeights = Object.entries(adm.tenant_weights || {})
+    .map(([tenant, w]) => `${escapeHtml(tenant)}=${w}`)
+    .join(", ");
+  return (
+    `<div class="row">${header}</div>${lanes}` +
+    `<div class="row"><span class="meta">worker speed weights: ${weights}</span></div>` +
+    (tenantWeights
+      ? `<div class="row"><span class="meta">tenant weights: ${tenantWeights}</span></div>`
+      : "")
+  );
+}
+
 /** Topology summary line (pure; app.js renderTopology applies it). */
 export function topologyHtml(info) {
   const topo = info.topology || {};

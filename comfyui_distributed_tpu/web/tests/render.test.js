@@ -7,6 +7,7 @@ import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
   dividerNodeHtml,
   networkInfoHtml,
+  schedulerHtml,
   topologyHtml,
   valueNodeHtml,
   vocabBannerHtml,
@@ -143,4 +144,52 @@ test("workerFormHtml: one input per field + chips + save button", () => {
   assertIncludes(html, 'id="wf-tpu_chips"');
   assertIncludes(html, 'value="0,2"');
   assertIncludes(html, 'id="wf-save"');
+});
+
+test("schedulerHtml: lanes, deficits, weights, and the unavailable fallback", () => {
+  assertIncludes(schedulerHtml(null), "unavailable");
+  assertIncludes(schedulerHtml({}), "unavailable");
+  const html = schedulerHtml({
+    admission: {
+      state: "running",
+      active: 1,
+      max_active: 4,
+      queued: 3,
+      lanes: [
+        {
+          name: "interactive",
+          depth: 3,
+          max_depth: 64,
+          tenants: { "tenant-a": { queued: 2, deficit: 1.5 } },
+        },
+        { name: "batch", depth: 0, max_depth: 256, tenants: {} },
+      ],
+      tenant_weights: { "tenant-a": 3 },
+    },
+    worker_weights: { w1: 0.2, w2: 1.8 },
+  });
+  assertIncludes(html, "running");
+  assertIncludes(html, "interactive");
+  assertIncludes(html, "depth 3/64");
+  assertIncludes(html, "tenant-a: 2 queued (deficit 1.5)");
+  assertIncludes(html, "w2=1.8x");
+  assertIncludes(html, "tenant-a=3");
+});
+
+test("schedulerHtml escapes hostile tenant and worker names", () => {
+  const html = schedulerHtml({
+    admission: {
+      state: "running", active: 0, max_active: 1, queued: 1,
+      lanes: [
+        {
+          name: "interactive", depth: 1, max_depth: 8,
+          tenants: { "<img src=x>": { queued: 1, deficit: 0 } },
+        },
+      ],
+      tenant_weights: {},
+    },
+    worker_weights: { "<b>w</b>": 1.0 },
+  });
+  assert(!html.includes("<img"), "tenant name escaped");
+  assert(!html.includes("<b>w</b>"), "worker name escaped");
 });

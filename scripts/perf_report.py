@@ -41,6 +41,14 @@ from typing import Any
 REQUIRED_ANY_ROLE = "sample"
 REQUIRED_MASTER = "blend"
 
+# Scheduler queue-wait reconstruction: the admission gate opens a
+# `sched.wait` span when a request is admitted (api/job_routes.py);
+# the wait ends at the execution's FIRST tile pull (master- or
+# worker-side). Requests that never reach a tile job (pure fan-out)
+# fall back to the grant wait itself (the span's own duration).
+SCHED_WAIT_SPAN = "sched.wait"
+PULL_SPAN_NAMES = ("tile.pull", "rpc.request_image")
+
 
 def load_spans(path: str) -> list[dict[str, Any]]:
     spans = []
@@ -61,6 +69,53 @@ def _percentile(sorted_values: list[float], fraction: float) -> float:
         return 0.0
     idx = min(len(sorted_values) - 1, int(round(fraction * (len(sorted_values) - 1))))
     return sorted_values[idx]
+
+
+def queue_wait_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Admission→first-pull wait per trace, aggregated.
+
+    For every trace carrying a `sched.wait` span, the queue wait is
+    the gap between that span's start (admission) and the start of the
+    trace's first tile pull; when the trace recorded no pulls, the
+    grant wait (the sched.wait duration) stands in. None when no
+    scheduler spans exist (pre-scheduler traces stay comparable)."""
+    admits: dict[Any, dict[str, Any]] = {}
+    first_pull: dict[Any, float] = {}
+    for span in spans:
+        trace_id = span.get("trace_id")
+        start = span.get("start")
+        if start is None:
+            continue
+        if span.get("name") == SCHED_WAIT_SPAN:
+            current = admits.get(trace_id)
+            if current is None or start < current["start"]:
+                admits[trace_id] = {
+                    "start": float(start),
+                    "duration": span.get("duration"),
+                }
+        elif span.get("name") in PULL_SPAN_NAMES:
+            prev = first_pull.get(trace_id)
+            if prev is None or start < prev:
+                first_pull[trace_id] = float(start)
+    if not admits:
+        return None
+    waits: list[float] = []
+    for trace_id, admit in admits.items():
+        pull = first_pull.get(trace_id)
+        if pull is not None and pull >= admit["start"]:
+            waits.append(pull - admit["start"])
+        elif admit["duration"] is not None:
+            waits.append(float(admit["duration"]))
+    if not waits:
+        return None
+    waits.sort()
+    return {
+        "count": len(waits),
+        "mean": sum(waits) / len(waits),
+        "p50": _percentile(waits, 0.50),
+        "p95": _percentile(waits, 0.95),
+        "max": waits[-1],
+    }
 
 
 def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
@@ -89,6 +144,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "span_count": len(spans),
         "unfinished_spans": unfinished,
         "stages": stages,
+        "queue_wait": queue_wait_stats(spans),
     }
 
 
@@ -162,6 +218,22 @@ def compare_reports(
                     "delta_pct": delta_pct,
                 }
             )
+    # queue wait (admission→first pull) rides the same gate as a
+    # pseudo-stage: a scheduler change that silently doubles time-to-
+    # first-tile is exactly the regression this report exists to catch.
+    old_wait = old_report.get("queue_wait")
+    new_wait = new_report.get("queue_wait")
+    if old_wait and new_wait and old_wait["p95"] > 0:
+        delta_pct = (new_wait["p95"] / old_wait["p95"] - 1.0) * 100.0
+        if delta_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": "queue_wait",
+                    "old_p95": old_wait["p95"],
+                    "new_p95": new_wait["p95"],
+                    "delta_pct": delta_pct,
+                }
+            )
     return regressions
 
 
@@ -198,6 +270,15 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"{stats['mean']:>10.4f} {stats['p50']:>10.4f} "
             f"{stats['p95']:>10.4f} {stats['p99']:>10.4f} "
             f"{stats['max']:>10.4f}"
+        )
+    wait = report.get("queue_wait")
+    if wait:
+        lines.append("")
+        lines.append(
+            "queue wait (admission -> first pull): "
+            f"count={wait['count']} mean={wait['mean']:.4f}s "
+            f"p50={wait['p50']:.4f}s p95={wait['p95']:.4f}s "
+            f"max={wait['max']:.4f}s"
         )
     if tiles:
         lines.append("")

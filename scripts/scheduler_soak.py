@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Scheduler soak: two synthetic tenants + skewed worker speeds.
+
+The tier-1-adjacent smoke for the scheduler control plane
+(comfyui_distributed_tpu/scheduler/). Two phases:
+
+1. **fairness** — two synthetic tenants with 3:1 weights flood one
+   admission lane with single-tile requests; the grant sequence under
+   deficit-round-robin must hand out tile work 3:1 (±tolerance), and
+   the queue-wait EWMA/back-pressure counters land in the report.
+
+2. **placement** — an in-process chaos USDU run (resilience/chaos.py)
+   with a 10x straggler injected via the FaultInjector's latency
+   faults, once under uniform pull and once under cost-aware weighted
+   placement. The straggler must receive no MORE tiles weighted than
+   uniform, the placement snapshot must show its depressed speed
+   ratio, and both canvases must be bit-identical to the fault-free
+   baseline (placement changes WHO, never WHAT).
+
+Writes a JSON fairness report (CI uploads it as an artifact) and exits
+non-zero when either property fails:
+
+    python scripts/scheduler_soak.py [--out scheduler_soak.json]
+        [--requests 200] [--tolerance 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+TENANT_WEIGHTS = {"tenant-a": 3.0, "tenant-b": 1.0}
+
+STRAGGLER_PLAN = (
+    "seed=11;latency(0.2)@store:pull:master#1-8;"
+    "latency(0.35)@chaos:w1:pulled#*;latency(0.035)@chaos:w2:pulled#*"
+)
+PLACEMENT_OVERRIDES = dict(
+    base_batch=1, max_batch=4, tail_tiles=8, min_samples=1, trim_ratio=0.5
+)
+
+
+def run_fairness(requests: int, tolerance: float) -> dict:
+    """Grant `requests` single-tile requests across two backlogged
+    tenants; the realized split must match the 3:1 weights."""
+    from comfyui_distributed_tpu.scheduler import AdmissionQueue
+
+    async def scenario() -> dict:
+        queue = AdmissionQueue(
+            lanes=[("interactive", max(4 * requests, 64))],
+            max_active=1,
+            tenant_weights=dict(TENANT_WEIGHTS),
+        )
+        tickets = []
+        for _ in range(requests):
+            for tenant in TENANT_WEIGHTS:
+                tickets.append(queue.submit(tenant, "interactive", cost=1.0))
+        grant_order: list[str] = []
+        waits: list[float] = []
+        for _ in range(requests):
+            granted = [t for t in tickets if t.state == "granted"]
+            assert len(granted) == 1, "exactly one active grant expected"
+            ticket = granted[0]
+            grant_order.append(ticket.tenant)
+            waits.append(ticket.queue_wait_seconds or 0.0)
+            queue.release(ticket)
+        counts = collections.Counter(grant_order)
+        return {"counts": dict(counts), "snapshot": queue.snapshot()}
+
+    result = asyncio.run(scenario())
+    counts = result["counts"]
+    total = sum(counts.values())
+    share_a = counts.get("tenant-a", 0) / total if total else 0.0
+    target = TENANT_WEIGHTS["tenant-a"] / sum(TENANT_WEIGHTS.values())
+    ok = abs(share_a - target) <= tolerance
+    return {
+        "ok": ok,
+        "requests_granted": total,
+        "counts": counts,
+        "tenant_a_share": round(share_a, 4),
+        "target_share": round(target, 4),
+        "tolerance": tolerance,
+        "totals": result["snapshot"]["totals"],
+    }
+
+
+def run_placement() -> dict:
+    """Chaos USDU with a 10x straggler: weighted placement must not
+    hand the straggler more tiles than uniform pull, and the canvas
+    stays bit-identical to the fault-free baseline."""
+    import numpy as np
+
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+    baseline = run_chaos_usdu(seed=11, image_hw=(128, 128))
+    weighted = run_chaos_usdu(
+        seed=11,
+        image_hw=(128, 128),
+        fault_plan=STRAGGLER_PLAN,
+        placement=dict(PLACEMENT_OVERRIDES),
+        worker_timeout=10.0,
+    )
+    uniform = run_chaos_usdu(
+        seed=11,
+        image_hw=(128, 128),
+        fault_plan=STRAGGLER_PLAN,
+        worker_timeout=10.0,
+    )
+    identical = bool(
+        np.array_equal(baseline.output, weighted.output)
+        and np.array_equal(baseline.output, uniform.output)
+    )
+    w1_weighted = weighted.tiles_by_worker.get("w1", 0)
+    w1_uniform = uniform.tiles_by_worker.get("w1", 0)
+    straggler_ratio = (
+        weighted.placement.get("workers", {}).get("w1", {}).get("speed_ratio")
+    )
+    ok = (
+        identical
+        and w1_weighted <= w1_uniform
+        and (straggler_ratio is None or straggler_ratio < 1.0)
+    )
+    return {
+        "ok": ok,
+        "bit_identical": identical,
+        "tiles_weighted": weighted.tiles_by_worker,
+        "tiles_uniform": uniform.tiles_by_worker,
+        "straggler_speed_ratio": straggler_ratio,
+        "placement_snapshot": weighted.placement,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="scheduler_soak.json")
+    parser.add_argument("--requests", type=int, default=200)
+    parser.add_argument("--tolerance", type=float, default=0.10)
+    args = parser.parse_args(argv)
+
+    fairness = run_fairness(args.requests, args.tolerance)
+    placement = run_placement()
+    report = {
+        "ok": fairness["ok"] and placement["ok"],
+        "fairness": fairness,
+        "placement": placement,
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps({k: report[k] for k in ("ok",)}, indent=2))
+    print(
+        f"fairness: tenant-a share {fairness['tenant_a_share']} "
+        f"(target {fairness['target_share']} ± {fairness['tolerance']}) "
+        f"-> {'OK' if fairness['ok'] else 'FAIL'}"
+    )
+    print(
+        f"placement: straggler tiles weighted={placement['tiles_weighted'].get('w1')} "
+        f"uniform={placement['tiles_uniform'].get('w1')} "
+        f"bit_identical={placement['bit_identical']} "
+        f"-> {'OK' if placement['ok'] else 'FAIL'}"
+    )
+    print(f"report written to {args.out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

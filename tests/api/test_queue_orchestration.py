@@ -1,0 +1,216 @@
+"""Orchestration fan-out partial failure: one worker failing prep
+mid-fanout must not take down the others or the master, and the
+circuit breaker must hear about it."""
+
+import asyncio
+import json
+import types
+
+import pytest
+
+from comfyui_distributed_tpu.api.orchestration import queue_orchestration
+from comfyui_distributed_tpu.api.queue_request import parse_queue_request_payload
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.resilience.health import (
+    HealthRegistry,
+    WorkerState,
+)
+from comfyui_distributed_tpu.utils.exceptions import (
+    WorkerNotAvailableError,
+    WorkerUnreachableError,
+)
+
+
+@pytest.fixture()
+def fake_server(tmp_config_path):
+    """Minimal server shape _orchestrate touches, over a real JobStore
+    and a config file with two enabled remote workers."""
+    with open(tmp_config_path, "w") as fh:
+        json.dump(
+            {
+                "master": {"host": "127.0.0.1"},
+                "settings": {"websocket_orchestration": False},
+                "workers": [
+                    {"id": "a", "type": "remote", "host": "ha", "port": 1,
+                     "enabled": True},
+                    {"id": "b", "type": "remote", "host": "hb", "port": 2,
+                     "enabled": True},
+                ],
+            },
+            fh,
+        )
+    queued = []
+
+    def queue_prompt(prompt, prompt_id, extra=None, trace_id=None):
+        queued.append(prompt_id)
+        return types.SimpleNamespace(prompt_id=prompt_id)
+
+    server = types.SimpleNamespace(
+        job_store=JobStore(),
+        config_path=tmp_config_path,
+        port=8188,
+        queue_prompt=queue_prompt,
+    )
+    server.queued = queued
+    return server
+
+
+def _payload():
+    return parse_queue_request_payload(
+        {
+            "prompt": {"1": {"class_type": "X", "inputs": {}}},
+            "client_id": "c",
+            "workers": ["a", "b"],
+        }
+    )
+
+
+def _run_partial_failure(monkeypatch, fake_server, failure_exc):
+    """Worker 'b' fails during prepare_and_dispatch; returns
+    (result, registry, dispatch_calls)."""
+    registry = HealthRegistry(
+        failure_threshold=5, suspect_threshold=1, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(
+        queue_orchestration, "get_health_registry", lambda: registry
+    )
+
+    async def select_all(workers, concurrency):
+        return list(workers)
+
+    async def no_sync(worker, prompt, input_dir):
+        if str(worker.get("id")) == "b" and failure_exc is None:
+            raise RuntimeError("prep blew up mid-fanout")
+
+    dispatch_calls = []
+
+    async def scripted_dispatch(worker, prompt, prompt_id, use_ws, extra=None):
+        wid = str(worker.get("id"))
+        dispatch_calls.append(wid)
+        if wid == "b" and failure_exc is not None:
+            # the real dispatch layer records breaker outcomes itself
+            if isinstance(failure_exc, WorkerUnreachableError):
+                registry.record_failure(wid)
+            elif isinstance(failure_exc, WorkerNotAvailableError):
+                registry.record_success(wid)
+            raise failure_exc
+
+    monkeypatch.setattr(
+        queue_orchestration, "select_active_workers", select_all
+    )
+    monkeypatch.setattr(queue_orchestration, "sync_worker_media", no_sync)
+    monkeypatch.setattr(
+        queue_orchestration, "dispatch_worker_prompt", scripted_dispatch
+    )
+    result = asyncio.run(
+        queue_orchestration.orchestrate_distributed_execution(
+            fake_server, _payload()
+        )
+    )
+    return result, registry, dispatch_calls
+
+
+def test_prep_crash_still_dispatches_survivors_and_notifies_breaker(
+    monkeypatch, fake_server
+):
+    """Media-sync failures are swallowed by design, but a prep-path
+    crash (here: a RuntimeError out of prepare) must (a) leave the
+    other worker dispatched, (b) feed the breaker, (c) leave the
+    master's own prompt queued."""
+    registry = HealthRegistry(
+        failure_threshold=5, suspect_threshold=1, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(
+        queue_orchestration, "get_health_registry", lambda: registry
+    )
+
+    async def select_all(workers, concurrency):
+        return list(workers)
+
+    async def ok_sync(worker, prompt, input_dir):
+        return None
+
+    dispatched = []
+
+    async def crashy_dispatch(worker, prompt, prompt_id, use_ws, extra=None):
+        wid = str(worker.get("id"))
+        if wid == "b":
+            raise RuntimeError("prep blew up mid-fanout")
+        dispatched.append(wid)
+
+    monkeypatch.setattr(
+        queue_orchestration, "select_active_workers", select_all
+    )
+    monkeypatch.setattr(queue_orchestration, "sync_worker_media", ok_sync)
+    monkeypatch.setattr(
+        queue_orchestration, "dispatch_worker_prompt", crashy_dispatch
+    )
+    result = asyncio.run(
+        queue_orchestration.orchestrate_distributed_execution(
+            fake_server, _payload()
+        )
+    )
+    # survivors dispatched; the failed worker excluded from the fan-out
+    assert result["workers"] == ["a"]
+    assert dispatched == ["a"]
+    # breaker notified of the non-transport prep failure
+    assert registry.state("b") is WorkerState.SUSPECT
+    assert registry.snapshot()["b"]["consecutive_failures"] == 1
+    # master's own prompt queued regardless
+    assert fake_server.queued == [f"{result['trace_id']}_master"]
+    assert result["status"] == "queued"
+
+
+def test_unreachable_dispatch_not_double_counted(monkeypatch, fake_server):
+    """A WorkerUnreachableError out of dispatch already fed the
+    breaker inside the dispatch layer — orchestration must not count
+    it a second time."""
+    exc = WorkerUnreachableError("no route", "b")
+    result, registry, _ = _run_partial_failure(monkeypatch, fake_server, exc)
+    assert result["workers"] == ["a"]
+    assert registry.snapshot()["b"]["consecutive_failures"] == 1  # not 2
+
+
+def test_rejection_answer_never_counts_as_failure(monkeypatch, fake_server):
+    """An alive worker that ANSWERS with a rejection is excluded from
+    the fan-out but must not accrue breaker failures (it is healthy)."""
+    exc = WorkerNotAvailableError("HTTP 400 bad prompt", "b")
+    result, registry, _ = _run_partial_failure(monkeypatch, fake_server, exc)
+    assert result["workers"] == ["a"]
+    assert registry.state("b") is WorkerState.HEALTHY
+    assert registry.snapshot()["b"]["consecutive_failures"] == 0
+
+
+def test_all_workers_failing_still_queues_master(monkeypatch, fake_server):
+    registry = HealthRegistry(
+        failure_threshold=5, suspect_threshold=1, cooldown_seconds=30.0
+    )
+    monkeypatch.setattr(
+        queue_orchestration, "get_health_registry", lambda: registry
+    )
+
+    async def select_all(workers, concurrency):
+        return list(workers)
+
+    async def ok_sync(worker, prompt, input_dir):
+        return None
+
+    async def always_crash(worker, prompt, prompt_id, use_ws, extra=None):
+        raise RuntimeError("everything is down")
+
+    monkeypatch.setattr(
+        queue_orchestration, "select_active_workers", select_all
+    )
+    monkeypatch.setattr(queue_orchestration, "sync_worker_media", ok_sync)
+    monkeypatch.setattr(
+        queue_orchestration, "dispatch_worker_prompt", always_crash
+    )
+    result = asyncio.run(
+        queue_orchestration.orchestrate_distributed_execution(
+            fake_server, _payload()
+        )
+    )
+    assert result["workers"] == []
+    assert fake_server.queued  # master still runs the whole job itself
+    assert registry.snapshot()["a"]["consecutive_failures"] == 1
+    assert registry.snapshot()["b"]["consecutive_failures"] == 1

@@ -35,6 +35,42 @@ def test_extras_preserved():
     assert payload.extra == {"load_balance": True, "foo": 1}
 
 
+def test_tenant_and_lane_default_and_parse():
+    payload = parse_queue_request_payload(
+        {"prompt": {"1": {}}, "client_id": "c"}
+    )
+    assert payload.tenant == "default"
+    assert payload.lane is None
+    payload = parse_queue_request_payload(
+        {
+            "prompt": {"1": {}},
+            "client_id": "c",
+            "tenant": "acme",
+            "lane": "batch",
+            "estimated_tiles": 16,
+        }
+    )
+    assert payload.tenant == "acme"
+    assert payload.lane == "batch"
+    # scheduler fields don't leak into extras; cost hints do
+    assert "tenant" not in payload.extra and "lane" not in payload.extra
+    assert payload.extra["estimated_tiles"] == 16
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        {"prompt": {"1": {}}, "client_id": "c", "tenant": ""},
+        {"prompt": {"1": {}}, "client_id": "c", "tenant": 7},
+        {"prompt": {"1": {}}, "client_id": "c", "lane": ""},
+        {"prompt": {"1": {}}, "client_id": "c", "lane": ["interactive"]},
+    ],
+)
+def test_invalid_tenant_or_lane(body):
+    with pytest.raises(QueueRequestError):
+        parse_queue_request_payload(body)
+
+
 @pytest.mark.parametrize(
     "body",
     [

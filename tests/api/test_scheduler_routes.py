@@ -1,0 +1,222 @@
+"""Scheduler control plane over real HTTP: status/pause/resume/drain
+routes, queue-route backpressure (429 + Retry-After / 503), the
+scheduler view in queue_status, and the sched.wait span."""
+
+import asyncio
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+PROMPT = {
+    "1": {
+        "class_type": "EmptyLatentImage",
+        "inputs": {"width": 32, "height": 32, "batch_size": 1},
+    }
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _post(url, body=None, timeout=10):
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, dict(exc.headers), json.loads(exc.read())
+
+
+@pytest.fixture()
+def server(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port, loop_thread
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def test_status_reports_lanes_and_state(server):
+    srv, port, _ = server
+    status, _, body = _get(f"http://127.0.0.1:{port}/distributed/scheduler/status")
+    assert status == 200
+    assert body["state"] == "running"
+    lanes = {lane["name"] for lane in body["admission"]["lanes"]}
+    assert "interactive" in lanes
+    assert "worker_weights" in body
+    assert "placement" in body
+
+
+def test_pause_resume_drain_cycle(server):
+    srv, port, _ = server
+    base = f"http://127.0.0.1:{port}/distributed/scheduler"
+    assert _post(f"{base}/pause")[2] == {"state": "paused"}
+    assert _get(f"{base}/status")[2]["state"] == "paused"
+    assert _post(f"{base}/drain")[2] == {"state": "draining"}
+    assert _post(f"{base}/resume")[2] == {"state": "running"}
+
+
+def test_queue_end_to_end_carries_scheduler_stamp(server):
+    srv, port, _ = server
+    status, _, body = _post(
+        f"http://127.0.0.1:{port}/distributed/queue",
+        {"prompt": PROMPT, "client_id": "c1", "tenant": "acme"},
+    )
+    assert status == 200, body
+    assert body["scheduler"]["tenant"] == "acme"
+    assert body["scheduler"]["lane"] == "interactive"
+    assert body["scheduler"]["queue_wait_seconds"] is not None
+    # the slot was released on completion
+    assert len(srv.scheduler.queue.active) == 0
+    assert srv.scheduler.queue.totals["granted"] >= 1
+
+
+def test_full_lane_answers_429_with_retry_after(server):
+    """Acceptance: full lane → queue route returns 429 + Retry-After."""
+    srv, port, loop_thread = server
+
+    def fill():
+        # pause grants, then fill the interactive lane to its depth
+        srv.scheduler.queue.pause()
+        lane = srv.scheduler.queue.lanes["interactive"]
+        while lane.depth() < lane.max_depth:
+            srv.scheduler.queue.submit("filler", "interactive")
+
+    asyncio.run_coroutine_threadsafe(
+        _run_sync(fill), loop_thread.loop
+    ).result(timeout=10)
+
+    status, headers, body = _post(
+        f"http://127.0.0.1:{port}/distributed/queue",
+        {"prompt": PROMPT, "client_id": "c1"},
+    )
+    assert status == 429, body
+    assert int(headers["Retry-After"]) >= 1
+    assert body["lane"] == "interactive"
+
+
+def test_drain_answers_503_while_admission_closed(server):
+    """Acceptance: drain mode stops admission while in-flight work
+    completes; resume reopens."""
+    srv, port, _ = server
+    base = f"http://127.0.0.1:{port}/distributed"
+    _post(f"{base}/scheduler/drain")
+    status, headers, body = _post(
+        f"{base}/queue", {"prompt": PROMPT, "client_id": "c1"}
+    )
+    assert status == 503, body
+    assert int(headers["Retry-After"]) >= 1
+    _post(f"{base}/scheduler/resume")
+    status, _, body = _post(
+        f"{base}/queue", {"prompt": PROMPT, "client_id": "c1"}
+    )
+    assert status == 200, body
+
+
+def test_queue_status_exposes_scheduler_view(server):
+    srv, port, _ = server
+    status, _, body = _get(
+        f"http://127.0.0.1:{port}/distributed/queue_status/nope"
+    )
+    assert status == 200
+    sched = body["scheduler"]
+    assert sched["state"] == "running"
+    assert "interactive" in sched["lanes"]
+    assert "depth" in sched["lanes"]["interactive"]
+    assert "tenants" in sched["lanes"]["interactive"]
+    assert "tenant_weights" in sched
+    assert "worker_weights" in sched
+
+
+def test_queue_status_shows_live_deficits_and_weights(server):
+    srv, port, loop_thread = server
+
+    def seed():
+        srv.scheduler.queue.pause()
+        srv.scheduler.queue.set_weight("acme", 3.0)
+        srv.scheduler.queue.submit("acme", "interactive")
+        srv.scheduler.placement.record_latency("w-fast", 0.1)
+        srv.scheduler.placement.record_latency("w-fast", 0.1)
+        srv.scheduler.placement.record_latency("w-slow", 1.0)
+        srv.scheduler.placement.record_latency("w-slow", 1.0)
+
+    asyncio.run_coroutine_threadsafe(
+        _run_sync(seed), loop_thread.loop
+    ).result(timeout=10)
+
+    _, _, body = _get(f"http://127.0.0.1:{port}/distributed/queue_status/x")
+    sched = body["scheduler"]
+    assert sched["lanes"]["interactive"]["tenants"]["acme"]["queued"] == 1
+    assert sched["tenant_weights"]["acme"] == 3.0
+    assert sched["worker_weights"]["w-fast"] > 1.0 > sched["worker_weights"]["w-slow"]
+
+
+def test_reprioritize_route_moves_ticket_and_sets_weight(server):
+    srv, port, loop_thread = server
+
+    def seed():
+        srv.scheduler.queue.pause()
+        return srv.scheduler.queue.submit("t", "background")
+
+    ticket = asyncio.run_coroutine_threadsafe(
+        _run_sync(seed), loop_thread.loop
+    ).result(timeout=10)
+
+    base = f"http://127.0.0.1:{port}/distributed/scheduler"
+    status, _, body = _post(
+        f"{base}/reprioritize",
+        {"ticket_id": ticket.ticket_id, "lane": "interactive",
+         "tenant": "t", "weight": 2.5},
+    )
+    assert status == 200, body
+    assert body["moved"] is True
+    assert body["tenant_weights"]["t"] == 2.5
+    status, _, body = _post(
+        f"{base}/reprioritize", {"ticket_id": "tx999", "lane": "interactive"}
+    )
+    assert status == 404
+    status, _, body = _post(f"{base}/reprioritize", {})
+    assert status == 400
+
+
+def test_sched_wait_span_joins_execution_trace(server):
+    from comfyui_distributed_tpu.telemetry import get_tracer
+
+    srv, port, _ = server
+    status, _, body = _post(
+        f"http://127.0.0.1:{port}/distributed/queue",
+        {"prompt": PROMPT, "client_id": "c1", "trace_id": "exec_schedtest"},
+    )
+    assert status == 200, body
+    spans = get_tracer().spans("exec_schedtest")
+    names = {s["name"] for s in spans}
+    assert "sched.wait" in names
+    assert "queue_orchestration" in names  # same tree
+
+
+async def _run_sync(fn):
+    return fn()

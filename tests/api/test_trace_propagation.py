@@ -172,11 +172,17 @@ def test_trace_propagates_master_to_worker_to_tile_pull_and_collector(cluster):
     }
     assert exec_roles == {"master", "worker"}
 
-    # every span reaches the orchestration root by parent links
+    # every span reaches the request's root by parent links. Since the
+    # scheduler control plane, the FIRST span of an admitted request is
+    # the admission wait (sched.wait, api/job_routes.py); the
+    # orchestration root parents into it.
     index = _span_index(spans)
     root_id = tracer.root_span_id(TRACE_ID)
     root = index[root_id]
-    assert root["name"] == "queue_orchestration"
+    assert root["name"] == "sched.wait"
+    assert "queue_orchestration" in {
+        s["name"] for s in spans if s["parent_id"] == root_id
+    }
     for span in spans:
         assert _connected_to_root(span, index, root_id), span["name"]
 
@@ -188,7 +194,7 @@ def test_trace_propagates_master_to_worker_to_tile_pull_and_collector(cluster):
         data = json.loads(resp.read())
     assert data["span_count"] == len(spans)
     assert len(data["tree"]) == 1
-    assert data["tree"][0]["name"] == "queue_orchestration"
+    assert data["tree"][0]["name"] == "sched.wait"
 
     # the pull RPC recorded which tile it handed out
     pull_spans = [s for s in spans if s["name"] == "rpc.request_image"]

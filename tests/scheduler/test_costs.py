@@ -1,0 +1,79 @@
+"""Analytic tile-FLOP model + the xla_flops fallback contract
+(ops/costs.py) — the numbers the placement weights depend on."""
+
+import pytest
+
+from comfyui_distributed_tpu.ops import costs
+
+
+def test_analytic_estimate_is_positive_and_finite():
+    flops = costs.analytic_tile_flops(512, 512, steps=20)
+    assert flops > 0
+    assert flops < 1e18  # sane magnitude for a 512px tile
+
+
+def test_conv_term_scales_quadratically_with_area():
+    """Doubling both tile edges quadruples the spatial cells; with
+    attention sub-dominant at these sizes the total tracks ~4x (the
+    attention term pushes it slightly above)."""
+    small = costs.analytic_tile_flops(512, 512, steps=20)
+    large = costs.analytic_tile_flops(1024, 1024, steps=20)
+    ratio = large / small
+    assert 3.9 < ratio < 6.0, ratio
+
+
+def test_attention_term_grows_superquadratically_when_dominant():
+    """With attention at every level and no conv-heavy step count, the
+    n² self-attention term dominates: 2x edges → >4x total."""
+    kwargs = dict(
+        steps=1, guidance=False, attention_levels=(0, 1, 2, 3),
+        num_res_blocks=0, vae_channels=1,
+    )
+    small = costs.analytic_tile_flops(512, 512, **kwargs)
+    large = costs.analytic_tile_flops(1024, 1024, **kwargs)
+    assert large / small > 4.5, large / small
+
+
+def test_steps_and_guidance_scale_linearly():
+    # vae_channels=1 makes the step-independent VAE term negligible,
+    # so the diffusion term's linearity is visible exactly
+    kwargs = dict(vae_channels=1)
+    base = costs.analytic_tile_flops(256, 256, steps=10, guidance=False, **kwargs)
+    double_steps = costs.analytic_tile_flops(
+        256, 256, steps=20, guidance=False, **kwargs
+    )
+    with_cfg = costs.analytic_tile_flops(256, 256, steps=10, guidance=True, **kwargs)
+    assert double_steps / base == pytest.approx(2.0, rel=1e-3)
+    assert with_cfg / base == pytest.approx(2.0, rel=1e-3)
+    # and with the real VAE included the ratio stays below 2
+    full_base = costs.analytic_tile_flops(256, 256, steps=10, guidance=False)
+    full_double = costs.analytic_tile_flops(256, 256, steps=20, guidance=False)
+    assert 1.3 < full_double / full_base < 2.0
+
+
+def test_degenerate_sizes_clamp_instead_of_crashing():
+    assert costs.analytic_tile_flops(0, 0, steps=0) > 0
+    assert costs.analytic_tile_flops(1, 1, steps=1) > 0
+
+
+def test_xla_flops_measures_real_programs():
+    import jax.numpy as jnp
+
+    flops = costs.xla_flops(lambda a, b: a @ b, jnp.ones((64, 64)), jnp.ones((64, 64)))
+    # CPU backend exposes cost analysis; if it ever stops, None is the
+    # documented no-fallback contract (not a crash)
+    assert flops is None or flops > 0
+
+
+def test_xla_flops_fallback_on_unlowerable_function():
+    def broken(x):
+        raise RuntimeError("cannot trace this")
+
+    assert costs.xla_flops(broken, 1.0) is None  # historical contract
+    est = costs.xla_flops(
+        broken, 1.0, fallback=lambda: costs.analytic_tile_flops(512, 512)
+    )
+    assert est == pytest.approx(costs.analytic_tile_flops(512, 512))
+    assert costs.xla_flops(broken, 1.0, fallback=123.0) == 123.0
+    # a nonsense fallback (≤ 0) still answers None, never a bad number
+    assert costs.xla_flops(broken, 1.0, fallback=0.0) is None

@@ -1,0 +1,224 @@
+"""Placement policy: EWMA speed weights, batch sizing, tail trimming,
+and the JobStore pull-path integration (pull_tasks / may_pull)."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.resilience.health import HealthRegistry
+from comfyui_distributed_tpu.scheduler.placement import PlacementPolicy
+
+
+def _feed(policy, worker_id, seconds, n=4):
+    for _ in range(n):
+        policy.record_latency(worker_id, seconds)
+
+
+# --- model ----------------------------------------------------------------
+
+
+def test_cold_start_is_uniform():
+    policy = PlacementPolicy(min_samples=2, base_batch=2, max_batch=8)
+    assert policy.speed_ratio("unknown") == 1.0
+    assert policy.batch_size("unknown", remaining=20) == 2  # base
+    assert policy.may_pull("unknown", remaining=1) is True
+    assert policy.weights() == {}
+
+
+def test_weights_reflect_relative_speed():
+    policy = PlacementPolicy(min_samples=2)
+    _feed(policy, "fast", 0.1)
+    _feed(policy, "slow", 1.0)
+    weights = policy.weights()
+    # 10x latency gap → fast ≈ 1.82x mean, slow ≈ 0.18x mean
+    assert weights["fast"] > 1.5 > 0.5 > weights["slow"]
+    assert policy.speed_ratio("fast") == pytest.approx(
+        weights["fast"], rel=1e-3
+    )
+
+
+def test_min_samples_gate():
+    policy = PlacementPolicy(min_samples=3)
+    policy.record_latency("w", 9.0)
+    policy.record_latency("w", 9.0)
+    assert policy.speed_ratio("w") == 1.0  # two samples: still unknown
+    policy.record_latency("w", 9.0)
+    assert policy.speed_ratio("w") == 1.0  # only worker → it IS the mean
+
+
+def test_batch_size_scales_with_speed_and_clamps():
+    policy = PlacementPolicy(
+        min_samples=1, base_batch=2, max_batch=6, tail_tiles=2
+    )
+    _feed(policy, "fast", 0.05)
+    _feed(policy, "slow", 0.5)
+    assert policy.batch_size("fast", remaining=100) == 4  # ~1.8x * 2, <6
+    assert policy.batch_size("slow", remaining=100) == 1
+    # remaining caps the claim; the tail disables batching entirely
+    assert policy.batch_size("fast", remaining=3) == 3
+    assert policy.batch_size("fast", remaining=2) == 1
+    assert policy.batch_size("fast", remaining=0) == 1
+
+
+def test_tail_trims_slow_and_suspect_but_never_master():
+    health = HealthRegistry(
+        failure_threshold=5, suspect_threshold=1, cooldown_seconds=30.0
+    )
+    policy = PlacementPolicy(
+        health=health, min_samples=1, tail_tiles=2, trim_ratio=0.5
+    )
+    _feed(policy, "fast", 0.05)
+    _feed(policy, "slow", 5.0)
+    # deep queue: everyone pulls
+    assert policy.may_pull("slow", remaining=50) is True
+    # tail: the slow worker is trimmed, the fast one is not
+    assert policy.may_pull("slow", remaining=2) is False
+    assert policy.may_pull("fast", remaining=2) is True
+    # suspect state trims regardless of measured speed
+    health.record_failure("fast")
+    assert health.state("fast").value == "suspect"
+    assert policy.may_pull("fast", remaining=1) is False
+    # the master is exempt always — someone must finish the job
+    assert policy.may_pull("master", remaining=1) is True
+    snap = policy.snapshot()
+    assert snap["workers"]["slow"]["tail_trims"] >= 1
+    assert snap["workers"]["slow"]["speed_ratio"] < 0.5
+
+
+# --- JobStore integration -------------------------------------------------
+
+
+def test_pull_tasks_without_placement_is_single():
+    async def scenario():
+        store = JobStore()
+        await store.init_tile_job("job", list(range(6)))
+        batch = await store.pull_tasks("job", "w1", timeout=0.05)
+        assert batch == [0]
+        job = await store.get_tile_job("job")
+        assert job.assigned["w1"] == {0}
+
+    asyncio.run(scenario())
+
+
+def test_pull_tasks_batches_by_speed_and_records_assignments():
+    async def scenario():
+        store = JobStore()
+        policy = PlacementPolicy(
+            min_samples=1, base_batch=2, max_batch=6, tail_tiles=1
+        )
+        _feed(policy, "fast", 0.05)
+        _feed(policy, "slow", 0.5)
+        store.placement = policy
+        await store.init_tile_job("job", list(range(10)))
+        fast_batch = await store.pull_tasks("job", "fast", timeout=0.05)
+        assert len(fast_batch) == 4
+        slow_batch = await store.pull_tasks("job", "slow", timeout=0.05)
+        assert len(slow_batch) == 1
+        job = await store.get_tile_job("job")
+        assert job.assigned["fast"] == set(fast_batch)
+        for task_id in fast_batch:
+            assert ("fast", task_id) in job.assigned_at
+        # a caller's limit caps the policy's size
+        capped = await store.pull_tasks("job", "fast", timeout=0.05, limit=2)
+        assert len(capped) == 2
+
+    asyncio.run(scenario())
+
+
+def test_trimmed_pull_reads_as_drained_but_heartbeats():
+    async def scenario():
+        health = HealthRegistry(
+            failure_threshold=5, suspect_threshold=1, cooldown_seconds=30.0
+        )
+        store = JobStore()
+        store.placement = PlacementPolicy(
+            health=health, min_samples=1, tail_tiles=4, trim_ratio=0.5
+        )
+        health.record_failure("suspect-w")
+        await store.init_tile_job("job", [0, 1])
+        got = await store.pull_task("job", "suspect-w", timeout=0.05)
+        assert got is None  # trimmed: reads as drained
+        job = await store.get_tile_job("job")
+        assert "suspect-w" in job.worker_status  # still heartbeat
+        assert job.pending.qsize() == 2  # nothing consumed
+        # the master claims the tail regardless
+        assert await store.pull_task("job", "master", timeout=0.05) == 0
+
+    asyncio.run(scenario())
+
+
+def test_batch_service_time_measured_from_previous_submit():
+    """Tiles pulled in one batch must not charge their queue-sitting
+    time as latency: each tile's measured service time runs from the
+    previous submit, so the per-tile stream stays honest for the
+    watchdog and the placement EWMA."""
+
+    async def scenario():
+        store = JobStore()
+        policy = PlacementPolicy(min_samples=1, base_batch=4, max_batch=4)
+        store.placement = policy
+        seen: list[float] = []
+        store.latency_sink = lambda wid, sec: seen.append(sec)
+        await store.init_tile_job("job", list(range(4)))
+        batch = await store.pull_tasks("job", "w1", timeout=0.05)
+        assert len(batch) == 4
+        for task_id in batch:
+            await asyncio.sleep(0.05)
+            await store.submit_result("job", "w1", task_id, None)
+        assert len(seen) == 4
+        # every per-tile measurement is ~the 0.05s work, not cumulative
+        assert max(seen) < 0.15, seen
+
+    asyncio.run(scenario())
+
+
+def test_flushed_batch_amortizes_service_time():
+    """The production worker flushes many tiles in ONE submit request;
+    per-entry arrival gaps would read as k-1 near-zero latencies and
+    poison the straggler median + placement EWMA. submit_flush divides
+    the flush interval evenly instead."""
+
+    async def scenario():
+        store = JobStore()
+        seen: list[float] = []
+        store.latency_sink = lambda wid, sec: seen.append(sec)
+        await store.init_tile_job("job", list(range(4)))
+        for _ in range(4):
+            await store.pull_task("job", "w1", timeout=0.05)
+        await asyncio.sleep(0.2)  # the worker "processes" all 4
+        accepted = await store.submit_flush(
+            "job", "w1", {i: [{"batch_idx": 0}] for i in range(4)}
+        )
+        assert accepted == 4
+        assert len(seen) == 4
+        # every tile gets ~interval/4, none collapses to ~0
+        for sec in seen:
+            assert 0.03 < sec < 0.15, seen
+        # a duplicate with no live assignment stamp is rejected and
+        # carries no sample (same as the historical started-is-None
+        # path; a speculative-race loser DOES still measure — it holds
+        # its own assignment stamp)
+        accepted = await store.submit_flush("job", "w1", {0: [{}]})
+        assert accepted == 0
+        assert len(seen) == 4
+
+    asyncio.run(scenario())
+
+
+def test_broken_placement_fails_open():
+    class Broken:
+        def may_pull(self, *a):
+            raise RuntimeError("boom")
+
+        def batch_size(self, *a):
+            raise RuntimeError("boom")
+
+    async def scenario():
+        store = JobStore()
+        store.placement = Broken()
+        await store.init_tile_job("job", [0, 1])
+        assert await store.pull_task("job", "w1", timeout=0.05) == 0
+        assert await store.pull_tasks("job", "w1", timeout=0.05) == [1]
+
+    asyncio.run(scenario())

@@ -153,6 +153,56 @@ def test_watchdog_stays_quiet_on_a_healthy_run(baseline):
     np.testing.assert_array_equal(baseline, result.output)
 
 
+def test_weighted_placement_starves_straggler_but_not_output(baseline):
+    """The scheduler-PR acceptance scenario: w1 is a 10x straggler
+    (0.35s/tile vs w2's 0.035s). Under cost-aware weighted placement
+    (speed-EWMA batches + tail trimming) w1 must be assigned
+    measurably fewer tiles than under uniform pull, the policy must
+    show its depressed speed ratio (and at least one tail trim), and
+    the canvas must stay bit-identical to the fault-free baseline —
+    placement changes WHO computes a tile, never WHAT."""
+    plan = (
+        "seed=11;latency(0.2)@store:pull:master#1-8;"
+        "latency(0.35)@chaos:w1:pulled#*;latency(0.035)@chaos:w2:pulled#*"
+    )
+    big_baseline = run_chaos_usdu(seed=11, image_hw=(128, 128))
+    total = sum(big_baseline.tiles_by_worker.values())
+    assert total == 16  # 128→256 at tile=64/padding=16: 4x4 grid
+
+    weighted = run_chaos_usdu(
+        seed=11, image_hw=(128, 128), fault_plan=plan,
+        worker_timeout=10.0,
+        placement=dict(
+            base_batch=1, max_batch=4, tail_tiles=8,
+            min_samples=1, trim_ratio=0.5,
+        ),
+    )
+    uniform = run_chaos_usdu(
+        seed=11, image_hw=(128, 128), fault_plan=plan, worker_timeout=10.0,
+    )
+    np.testing.assert_array_equal(big_baseline.output, weighted.output)
+    np.testing.assert_array_equal(big_baseline.output, uniform.output)
+    # the straggler's share shrank under weighted placement
+    assert weighted.tiles_by_worker["w1"] < uniform.tiles_by_worker["w1"], (
+        weighted.tiles_by_worker, uniform.tiles_by_worker,
+    )
+    # and far below its uniform 1/3 share of the fleet
+    assert weighted.tiles_by_worker["w1"] <= total // 3
+    # the policy saw the slowness and acted
+    w1_model = weighted.placement["workers"]["w1"]
+    assert w1_model["speed_ratio"] < 0.5, weighted.placement
+    assert w1_model["tail_trims"] >= 1, weighted.placement
+
+
+def test_weighted_placement_is_invisible_on_a_healthy_fleet(baseline):
+    """No faults + placement enabled: output identical, nobody
+    trimmed (uniform cold-start weights keep everyone eligible)."""
+    result = run_chaos_usdu(seed=11, placement={})
+    np.testing.assert_array_equal(baseline, result.output)
+    for stats in result.placement["workers"].values():
+        assert stats["tail_trims"] == 0
+
+
 def test_store_level_connection_errors_kill_worker_but_not_job(baseline):
     """A connection error at w2's pull RPC takes that worker out (the
     harness treats any injected transport error as fatal to the

@@ -204,6 +204,80 @@ def test_cli_compare_exits_nonzero_on_regression(tmp_path):
     assert data["regressions"][0]["stage"] == "tile.sample"
 
 
+def _sched_span(trace_id, start, duration, idx=0):
+    return {
+        "trace_id": trace_id, "span_id": f"sw{trace_id}{idx}", "parent_id": None,
+        "name": "sched.wait", "start": start, "end": start + duration,
+        "duration": duration, "attrs": {"lane": "interactive"}, "events": [],
+        "status": "ok",
+    }
+
+
+def _pull_span(trace_id, start, idx=0):
+    return {
+        "trace_id": trace_id, "span_id": f"pl{trace_id}{idx}", "parent_id": None,
+        "name": "tile.pull", "start": start, "end": start + 0.01,
+        "duration": 0.01, "attrs": {"stage": "pull", "role": "master"},
+        "events": [], "status": "ok",
+    }
+
+
+def test_queue_wait_pairs_admission_with_first_pull():
+    spans = [
+        _sched_span("t1", start=0.0, duration=0.5),
+        _pull_span("t1", start=2.0),      # first pull: wait = 2.0
+        _pull_span("t1", start=5.0, idx=1),  # later pulls ignored
+        _sched_span("t2", start=1.0, duration=0.25),  # no pull → grant wait
+    ]
+    stats = perf_report.queue_wait_stats(spans)
+    assert stats["count"] == 2
+    assert stats["max"] == pytest.approx(2.0)
+    assert stats["p50"] in (pytest.approx(0.25), pytest.approx(2.0))
+    report = perf_report.build_report(spans)
+    assert report["queue_wait"]["count"] == 2
+    # pre-scheduler traces: column absent, not zero
+    assert perf_report.queue_wait_stats([_pull_span("t", 0.0)]) is None
+
+
+def test_queue_wait_rides_the_compare_gate(tmp_path):
+    old = perf_report.build_report(
+        [_sched_span("t", 0.0, 0.1), _pull_span("t", 0.1)]
+        + [_span("tile.sample", 0.1, i) for i in range(5)]
+    )
+    new = perf_report.build_report(
+        [_sched_span("t", 0.0, 0.1), _pull_span("t", 1.0)]  # 10x wait
+        + [_span("tile.sample", 0.1, i) for i in range(5)]
+    )
+    regressions = perf_report.compare_reports(old, new, regress_pct=25.0)
+    assert [r["stage"] for r in regressions] == ["queue_wait"]
+    assert regressions[0]["delta_pct"] > 100
+
+    # CLI exit code 3 through the same path
+    old_path, new_path = str(tmp_path / "o.jsonl"), str(tmp_path / "n.jsonl")
+    _write_jsonl(old_path, [_sched_span("t", 0.0, 0.1), _pull_span("t", 0.1)])
+    _write_jsonl(new_path, [_sched_span("t", 0.0, 0.1), _pull_span("t", 1.0)])
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            new_path, "--compare", old_path,
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "queue_wait" in proc.stdout
+
+
+def test_queue_wait_rendered_in_text_report(tmp_path):
+    path = str(tmp_path / "w.jsonl")
+    _write_jsonl(path, [_sched_span("t", 0.0, 0.5), _pull_span("t", 0.5)])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_report.py"), path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "queue wait (admission -> first pull)" in proc.stdout
+    assert "p95=" in proc.stdout
+
+
 def test_cli_fails_on_missing_or_empty_input(tmp_path):
     proc = subprocess.run(
         [
