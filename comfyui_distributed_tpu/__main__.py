@@ -42,6 +42,12 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    # persistent XLA compilation cache: every process after the first
+    # skips its first compiles (master and workers share the dir)
+    from .workers.startup import configure_compile_cache
+
+    configure_compile_cache()
+
     # join the pod's shared JAX runtime when configured (no-op otherwise)
     from .parallel.multihost import maybe_init_multihost
 

@@ -5,6 +5,7 @@ Parity with reference api/usdu_routes.py:
     POST /distributed/request_image  — pull next tile/image index
     POST /distributed/submit_tiles   — push processed tiles (batched)
     POST /distributed/submit_image   — push a whole processed image
+    POST /distributed/return_tiles   — hand back an interrupted grant
     POST /distributed/job_status     — ready/progress poll
 
 Transport note: the reference ships tiles as multipart PNG parts with
@@ -31,6 +32,7 @@ def register(app: web.Application, server) -> None:
     app.router.add_post("/distributed/request_image", routes.request_image)
     app.router.add_post("/distributed/submit_tiles", routes.submit_tiles)
     app.router.add_post("/distributed/submit_image", routes.submit_image)
+    app.router.add_post("/distributed/return_tiles", routes.return_tiles)
     app.router.add_post("/distributed/job_status", routes.job_status)
 
 
@@ -168,6 +170,34 @@ class UsduRoutes:
             if body.get("is_last"):
                 await store.mark_worker_done(job_id, worker_id)
         return web.json_response({"status": "ok"})
+
+    async def return_tiles(self, request: web.Request) -> web.Response:
+        """{job_id, worker_id, tile_idxs} — an interrupted worker hands
+        back the unprocessed remainder of its in-flight grant so those
+        tiles requeue immediately (graph/tile_pipeline.py interrupt
+        semantics) instead of waiting out the heartbeat timeout."""
+        body = await _json(request)
+        if not body or "job_id" not in body or "worker_id" not in body:
+            return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        idxs = body.get("tile_idxs", [])
+        try:
+            idxs = [int(t) for t in idxs] if isinstance(idxs, list) else None
+        except (TypeError, ValueError):
+            idxs = None
+        if idxs is None:
+            return web.json_response(
+                {"error": "tile_idxs must be a list of ints"}, status=400
+            )
+        with rpc_span(
+            request, "rpc.return_tiles",
+            worker_id=str(body["worker_id"]), job_id=str(body["job_id"]),
+        ) as span:
+            released = await self.server.job_store.release_tasks(
+                str(body["job_id"]), str(body["worker_id"]), idxs
+            )
+            if span is not None:
+                span.attrs["released"] = released
+        return web.json_response({"status": "ok", "released": released})
 
     async def job_status(self, request: web.Request) -> web.Response:
         body = await _json(request)

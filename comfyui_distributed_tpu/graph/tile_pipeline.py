@@ -1,0 +1,584 @@
+"""Pipelined, batched tile execution for the elastic USDU tier.
+
+The elastic hot loop used to be fully serial and batch-1: sample one
+tile, block on the host readback, PNG-encode, flush over HTTP, and only
+then touch the device again. This module decouples those stages:
+
+- **GrantSampler** — runs a placement grant (``tile_idxs``) through a
+  vmapped K-tile processor instead of per-tile ``process`` calls.
+  Batch-1 convs leave most of a TPU's 128x128 systolic array idle;
+  K=8 measured +4% tiles/s on v5e (BENCH_NOTES r5). Grant sizes are
+  padded up to a bounded set of shape buckets (powers of two plus
+  K_max — ``ops.upscale.grant_buckets``) via the wraparound-duplicate
+  trick with folded keys, so a ragged tail never triggers a fresh
+  compile mid-job.
+- **TilePipeline** — a three-stage pipeline over any grant source:
+  pull prefetch (one grant ahead), device sampling (dispatch runs
+  ahead of the I/O stage by a bounded number of batches), and host
+  readback + encode + submit flush on a dedicated I/O thread. The next
+  grant's sampling is dispatched while the previous grant's results
+  ride the tunnel back (~0.35 s RTT per readback measured r5 — time
+  that previously sat squarely between device dispatches). Heartbeats
+  flow from the I/O stage — including while a device batch is in
+  flight — rather than from per-tile compute.
+
+Determinism: batching and pipelining change WHEN and HOW MANY tiles
+share a dispatch, never the per-tile inputs — keys fold the GLOBAL
+tile index and the deterministic blend canvas is order-independent, so
+the canvas stays bit-identical to the serial path (asserted by
+tests/test_chaos_usdu.py parity scenarios).
+
+Interrupt semantics: an interrupted in-flight grant must requeue
+cleanly. Claimed-but-unsubmitted tiles are handed to the ``release``
+callback on interrupt (InterruptedError by default) so they return to
+the pending queue immediately; any other death leaves them to the
+master's heartbeat-timeout / watchdog requeue path, exactly like a
+crashed worker process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from ..telemetry import current_trace_id, get_tracer
+from ..telemetry.instruments import (
+    pipeline_batches_total,
+    pipeline_inflight,
+    pipeline_padded_tiles_total,
+    tile_stage_seconds,
+)
+from ..utils.constants import (
+    HEARTBEAT_INTERVAL_SECONDS,
+    PIPELINE_DEPTH,
+    PIPELINE_PREFETCH,
+)
+from ..utils.logging import debug_log
+
+
+@contextlib.contextmanager
+def stage_span(stage: str, role: str, tile_idx: int | None = None, **attrs):
+    """Span + latency histogram around one tile pipeline stage
+    (pull | sample | readback | encode | submit | decode | blend). The
+    span clock is the tracer's (injectable, deterministic in chaos
+    runs); the histogram always uses the wall monotonic clock.
+
+    A pull that drains empty (caller sets ``outcome="empty"`` on the
+    yielded span) is excluded from the histogram: empty polls last the
+    full poll timeout by construction and would drag the pull stage's
+    p95 toward the timeout instead of the real dequeue latency (the
+    store's pulls_total{outcome="empty"} counter tracks them)."""
+    span_attrs: dict[str, Any] = {"stage": stage, "role": role, **attrs}
+    if tile_idx is not None:
+        span_attrs["tile_idx"] = int(tile_idx)
+    started = time.monotonic()
+    span = None
+    try:
+        with get_tracer().span(f"tile.{stage}", **span_attrs) as span:
+            yield span
+    finally:
+        if span is None or span.attrs.get("outcome") != "empty":
+            tile_stage_seconds().observe(
+                time.monotonic() - started, stage=stage, role=role
+            )
+
+
+class GrantSampler:
+    """Bucketed vmapped K-tile processor over a prepared tile set.
+
+    ``process(params, tile, key, pos, neg, yx)`` is the per-tile
+    processor (jitted or not — the chaos harness substitutes a stub).
+    ``sample(idxs)`` returns the processed tiles ``[n, B, th, tw, C]``:
+    serially for ``k_max == 1`` (reference numerics, one dispatch per
+    tile) or as ONE vmapped dispatch padded to the grant bucket for
+    ``k_max > 1``. Wraparound duplicates share the folded keys of their
+    originals, so they compute identical results and the surplus is
+    sliced off — numerics never depend on the padding.
+    """
+
+    def __init__(
+        self,
+        process: Callable,
+        params: Any,
+        extracted: Any,
+        base_key: Any,
+        positions: Any,
+        pos: Any,
+        neg: Any,
+        k_max: int = 1,
+        role: str = "worker",
+    ) -> None:
+        import jax
+
+        from ..ops.upscale import grant_buckets
+
+        self.process = process
+        self.params = params
+        self.extracted = extracted
+        self.base_key = base_key
+        self.positions = positions
+        self.pos = pos
+        self.neg = neg
+        self.k_max = max(1, int(k_max))
+        self.role = role
+        self.buckets = grant_buckets(self.k_max)
+        # observability + the shape-bucket test: which compiled shapes
+        # this job actually exercised, and how much padding it cost
+        self.buckets_used: set[int] = set()
+        self.padded_tiles = 0
+        self._batched = None
+        if self.k_max > 1:
+            vmapped = jax.vmap(process, in_axes=(None, 0, 0, None, None, 0))
+            # jit the batched program only when the per-tile processor
+            # is itself a compiled function (production — it always
+            # is). Raw Python stubs (the chaos harness) stay eager:
+            # XLA's divide-by-constant rewrite perturbs the last ulp
+            # relative to the eager serial path, which would break the
+            # bit-identical parity the chaos suite asserts.
+            self._batched = (
+                jax.jit(vmapped) if hasattr(process, "lower") else vmapped
+            )
+
+    # --- helpers ----------------------------------------------------------
+
+    def chunks(self, grant: Sequence[int]) -> list[list[int]]:
+        """Split a grant into dispatch-sized chunks (<= k_max each)."""
+        grant = [int(t) for t in grant]
+        return [
+            grant[i : i + self.k_max] for i in range(0, len(grant), self.k_max)
+        ]
+
+    def _keys_for(self, idxs: Sequence[int]):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.vmap(lambda g: jax.random.fold_in(self.base_key, g))(
+            jnp.asarray(list(idxs))
+        )
+
+    # --- execution --------------------------------------------------------
+
+    def sample(self, idxs: Sequence[int]):
+        """Process ``idxs`` (one chunk, len <= k_max) -> [n, B, ...]."""
+        import jax.numpy as jnp
+
+        from ..ops.upscale import bucket_for
+
+        idxs = [int(t) for t in idxs]
+        n = len(idxs)
+        # the batches metric records the COMPILED SHAPE that ran (the
+        # runbook's recompile-storm triage reads it as "which shapes
+        # exist"), not the raw chunk size — ragged chunks pad up to
+        # their bucket before dispatch
+        if self._batched is None:
+            import jax
+
+            pipeline_batches_total().inc(n, role=self.role, bucket="1")
+            # direct fold_in (not the vmapped form): byte-identical to
+            # the historical serial loop's key derivation
+            outs = [
+                self.process(
+                    self.params,
+                    self.extracted[i],
+                    jax.random.fold_in(self.base_key, i),
+                    self.pos,
+                    self.neg,
+                    self.positions[i],
+                )
+                for i in idxs
+            ]
+            self.buckets_used.add(1)
+            return jnp.stack(outs, axis=0)
+        bucket = bucket_for(n, self.k_max)
+        reps = -(-bucket // n)
+        padded = (idxs * reps)[:bucket]
+        sel = jnp.asarray(padded)
+        tiles = jnp.take(self.extracted, sel, axis=0)
+        keys = self._keys_for(padded)
+        yxs = jnp.take(self.positions, sel, axis=0)
+        out = self._batched(self.params, tiles, keys, self.pos, self.neg, yxs)
+        self.buckets_used.add(bucket)
+        pipeline_batches_total().inc(role=self.role, bucket=str(bucket))
+        if bucket > n:
+            self.padded_tiles += bucket - n
+            pipeline_padded_tiles_total().inc(bucket - n, role=self.role)
+        return out[:n]
+
+    # --- warmup -----------------------------------------------------------
+
+    def warmup(self, buckets: Sequence[int] | None = None) -> None:
+        """Compile the tile processor ahead of the first pull (run
+        during the worker's ready-poll window, so with a warm
+        persistent cache the first grant starts sampling immediately).
+        AOT-lowers when the processor supports it; otherwise executes
+        one throwaway dispatch per shape. Failures are non-fatal — the
+        first real grant just pays the compile like before."""
+        import jax.numpy as jnp
+
+        if buckets is None:
+            # largest bucket = the steady-state grant shape; 1 = the
+            # serial path every deadline/recovery fallback uses
+            buckets = (self.buckets[-1],) if self._batched else (1,)
+        for bucket in buckets:
+            try:
+                if self._batched is not None:
+                    idxs = [0] * int(bucket)
+                    sel = jnp.asarray(idxs)
+                    args = (
+                        self.params,
+                        jnp.take(self.extracted, sel, axis=0),
+                        self._keys_for(idxs),
+                        self.pos,
+                        self.neg,
+                        jnp.take(self.positions, sel, axis=0),
+                    )
+                    fn = self._batched
+                else:
+                    args = (
+                        self.params,
+                        self.extracted[0],
+                        self._keys_for([0])[0],
+                        self.pos,
+                        self.neg,
+                        self.positions[0],
+                    )
+                    fn = self.process
+                lower = getattr(fn, "lower", None)
+                if lower is not None:
+                    lower(*args).compile()
+                else:
+                    fn(*args)
+            except Exception as exc:  # noqa: BLE001 - warmup is best effort
+                debug_log(f"tile-processor warmup (bucket {bucket}) failed: {exc}")
+
+
+class _Stop:
+    pass
+
+
+_STOP = _Stop()
+
+
+class TilePipeline:
+    """Staged executor over a grant source; see module docstring.
+
+    Callbacks:
+      pull()                -> list[int] | None   (None/[] = drained)
+      sample(idxs)          -> device result [n, B, ...] (dispatch)
+      to_host(result)       -> host ndarray (default: asks the result)
+      emit(tile_idx, arr)   per-tile encode/queue (arr is [B, h, w, C])
+      flush(final: bool)    submit pending results (thresholds inside)
+      heartbeat()           optional liveness ping (I/O stage owns it)
+      check_interrupted()   optional; raising stops the pipeline
+      release(idxs)         optional; claimed-but-unsubmitted tiles on
+                            interrupt (interrupt_types exceptions only)
+    """
+
+    def __init__(
+        self,
+        *,
+        pull: Callable[[], Optional[Sequence[int]]],
+        sample: Callable[[Sequence[int]], Any],
+        emit: Callable[[int, Any], None],
+        flush: Callable[[bool], None],
+        chunks: Callable[[Sequence[int]], list[list[int]]] | None = None,
+        to_host: Callable[[Any], Any] | None = None,
+        heartbeat: Callable[[], None] | None = None,
+        check_interrupted: Callable[[], None] | None = None,
+        release: Callable[[list[int]], None] | None = None,
+        interrupt_types: tuple = (InterruptedError,),
+        depth: int | None = None,
+        prefetch: bool | None = None,
+        threaded: bool = True,
+        role: str = "worker",
+        span_attrs: dict[str, Any] | None = None,
+        heartbeat_interval: float | None = None,
+    ) -> None:
+        self._pull = pull
+        self._sample = sample
+        self._emit = emit
+        self._flush = flush
+        self._chunks = chunks or (lambda grant: [list(grant)])
+        self._to_host = to_host or self._default_to_host
+        self._heartbeat = heartbeat
+        self._check_interrupted = check_interrupted
+        self._release = release
+        self._interrupt_types = tuple(interrupt_types)
+        self.depth = max(1, depth if depth is not None else PIPELINE_DEPTH)
+        self.threaded = bool(threaded)
+        self.prefetch = (
+            (PIPELINE_PREFETCH if prefetch is None else bool(prefetch))
+            and self.threaded
+        )
+        self.role = role
+        self.span_attrs = dict(span_attrs or {})
+        self.heartbeat_interval = (
+            heartbeat_interval
+            if heartbeat_interval is not None
+            else HEARTBEAT_INTERVAL_SECONDS
+        )
+        self._stop = threading.Event()
+        self._errors: list[BaseException] = []
+        self._error_lock = threading.Lock()
+        self._claimed: list[int] = []
+        self._emitted: set[int] = set()
+        self.batches = 0
+        self.tiles = 0
+
+    # --- plumbing ---------------------------------------------------------
+
+    @staticmethod
+    def _default_to_host(result):
+        from ..utils import image as img_utils
+
+        return img_utils.ensure_numpy(result)
+
+    def _record_error(self, exc: BaseException) -> None:
+        with self._error_lock:
+            self._errors.append(exc)
+        self._stop.set()
+
+    def _first_error(self) -> Optional[BaseException]:
+        with self._error_lock:
+            return self._errors[0] if self._errors else None
+
+    def _put(self, q: queue.Queue, item: Any) -> bool:
+        """Bounded put that stays responsive to stop; False = stopped."""
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # --- stages -----------------------------------------------------------
+
+    def _pull_grant(self) -> Optional[list[int]]:
+        with stage_span("pull", self.role, **self.span_attrs) as span:
+            grant = self._pull()
+            if not grant:
+                span.attrs["outcome"] = "empty"
+                return None
+            grant = [int(t) for t in grant]
+            span.attrs["tile_idx"] = grant[0]
+            if len(grant) > 1:
+                span.attrs["batch"] = list(grant)
+        return grant
+
+    def _puller_body(self, grant_q: queue.Queue, trace_token: Any) -> None:
+        tracer = get_tracer()
+        token = tracer.activate(trace_token) if trace_token else None
+        try:
+            while not self._stop.is_set():
+                grant = self._pull_grant()
+                if grant is None:
+                    self._put(grant_q, _STOP)
+                    return
+                self._claimed.extend(grant)
+                if not self._put(grant_q, grant):
+                    return
+        except BaseException as exc:  # noqa: BLE001 - forwarded to run()
+            self._record_error(exc)
+            with contextlib.suppress(queue.Full):
+                grant_q.put_nowait(_STOP)
+        finally:
+            if token is not None:
+                tracer.deactivate(token)
+
+    def _io_body(self, work_q: queue.Queue, trace_token: Any) -> None:
+        tracer = get_tracer()
+        token = tracer.activate(trace_token) if trace_token else None
+        try:
+            while True:
+                try:
+                    item = work_q.get(timeout=self.heartbeat_interval)
+                except queue.Empty:
+                    # drained + stopping (the STOP sentinel can be lost
+                    # to a full queue during an abort): exit
+                    if self._stop.is_set():
+                        return
+                    # the device stage is mid-batch (or the puller is
+                    # waiting on the master): keep liveness flowing so
+                    # a long compile or a big batch never reads as a
+                    # dead worker
+                    if self._heartbeat is not None:
+                        self._heartbeat()
+                    continue
+                if isinstance(item, _Stop):
+                    return
+                idxs, result = item
+                # +1: the batch just popped is dispatched-but-not-read-
+                # back — exactly what this gauge counts; qsize() alone
+                # would read 0 through a fully loaded depth-1 pipeline
+                pipeline_inflight().set(work_q.qsize() + 1, role=self.role)
+                try:
+                    self._drain_item(idxs, result)
+                finally:
+                    work_q.task_done()
+                    pipeline_inflight().set(work_q.qsize(), role=self.role)
+        except BaseException as exc:  # noqa: BLE001 - forwarded to run()
+            self._record_error(exc)
+        finally:
+            if token is not None:
+                tracer.deactivate(token)
+
+    def _drain_item(self, idxs: list[int], result: Any) -> None:
+        """Readback + per-tile encode + flush for one device batch.
+        The flush callback is consulted after EVERY tile (it applies
+        its size thresholds internally), exactly like the historical
+        serial loop — consulting it once per K-tile batch would let a
+        payload overshoot the size budget by up to K-1 tiles."""
+        with stage_span(
+            "readback", self.role, idxs[0], batch=list(idxs),
+            **self.span_attrs,
+        ):
+            host = self._to_host(result)
+        for i, tile_idx in enumerate(idxs):
+            with stage_span(
+                "encode", self.role, tile_idx, **self.span_attrs
+            ):
+                self._emit(tile_idx, host[i])
+            self._emitted.add(int(tile_idx))
+            self.tiles += 1
+            if self._heartbeat is not None:
+                self._heartbeat()
+            self._flush(False)
+
+    def _sample_chunk(self, chunk: list[int]) -> Any:
+        # the cdt_pipeline_batches_total metric is incremented by the
+        # GrantSampler (which knows the COMPILED bucket a ragged chunk
+        # padded up to); the pipeline only tracks its own batch count
+        with stage_span(
+            "sample", self.role, chunk[0], batch=list(chunk),
+            **self.span_attrs,
+        ):
+            result = self._sample(chunk)
+        self.batches += 1
+        return result
+
+    # --- main loop --------------------------------------------------------
+
+    def _run_sync(self) -> None:
+        """CDT_PIPELINE=0 fallback: the same stages, strictly serial on
+        the calling thread — the historical loop shape, batching aside."""
+        while True:
+            if self._check_interrupted is not None:
+                self._check_interrupted()
+            grant = self._pull_grant()
+            if grant is None:
+                return
+            self._claimed.extend(grant)
+            for chunk in self._chunks(grant):
+                if self._check_interrupted is not None:
+                    self._check_interrupted()
+                result = self._sample_chunk(chunk)
+                self._drain_item(list(chunk), result)
+
+    def _run_threaded(self) -> None:
+        trace_token = current_trace_id()
+        work_q: queue.Queue = queue.Queue(maxsize=self.depth)
+        io_thread = threading.Thread(
+            target=self._io_body,
+            args=(work_q, trace_token),
+            name="cdt-tile-io",
+            daemon=True,
+        )
+        io_thread.start()
+        grant_q: queue.Queue = queue.Queue(maxsize=1)
+        puller: Optional[threading.Thread] = None
+        if self.prefetch:
+            puller = threading.Thread(
+                target=self._puller_body,
+                args=(grant_q, trace_token),
+                name="cdt-tile-pull",
+                daemon=True,
+            )
+            puller.start()
+        try:
+            while True:
+                if self._check_interrupted is not None:
+                    self._check_interrupted()
+                if self._first_error() is not None:
+                    break
+                if puller is not None:
+                    try:
+                        grant = grant_q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    if isinstance(grant, _Stop):
+                        break
+                else:
+                    grant = self._pull_grant()
+                    if grant is None:
+                        break
+                    self._claimed.extend(grant)
+                for chunk in self._chunks(grant):
+                    if self._check_interrupted is not None:
+                        self._check_interrupted()
+                    if self._first_error() is not None:
+                        break
+                    result = self._sample_chunk(chunk)
+                    if not self._put(work_q, (list(chunk), result)):
+                        break
+                if self._first_error() is not None:
+                    break
+        except BaseException as exc:
+            self._record_error(exc)
+        finally:
+            self._stop.set()
+            # deliver the sentinel even when the queue is momentarily
+            # full — losing it would stall shutdown for a whole idle
+            # heartbeat interval
+            while io_thread.is_alive():
+                try:
+                    work_q.put(_STOP, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            io_thread.join(timeout=30)
+            if puller is not None:
+                puller.join(timeout=30)
+            pipeline_inflight().set(0, role=self.role)
+
+    def run(self) -> dict[str, Any]:
+        """Run the pipeline until the grant source drains; returns
+        summary stats. Raises the first stage error (a puller fault, an
+        I/O submit failure, an interrupt) after shutting the stages
+        down; on interrupt-type errors, claimed-but-unsubmitted tiles
+        are handed to ``release`` first so they requeue immediately."""
+        if self.threaded:
+            self._run_threaded()
+        else:
+            try:
+                self._run_sync()
+            except BaseException as exc:  # noqa: BLE001 - unified exit below
+                self._record_error(exc)
+
+        error = self._first_error()
+        if error is None:
+            # drained cleanly: the final flush marks this worker done
+            self._flush(True)
+            return {"batches": self.batches, "tiles": self.tiles}
+        if isinstance(error, self._interrupt_types):
+            # Graceful interrupt: ship what is already encoded (those
+            # tiles count as emitted), then hand every claimed-but-
+            # unsubmitted tile back so the master requeues it NOW
+            # instead of waiting out the heartbeat timeout. Any other
+            # death (crash, fault) leaves recovery to the master's
+            # requeue/watchdog paths, exactly like a dead process.
+            try:
+                self._flush(True)
+            except Exception as exc:  # noqa: BLE001 - best effort
+                debug_log(f"final flush after interrupt failed: {exc}")
+            if self._release is not None:
+                orphaned = sorted(set(self._claimed) - self._emitted)
+                if orphaned:
+                    try:
+                        self._release(orphaned)
+                    except Exception as exc:  # noqa: BLE001 - best effort
+                        debug_log(f"grant release after interrupt failed: {exc}")
+        raise error

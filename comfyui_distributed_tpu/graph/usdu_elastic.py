@@ -5,16 +5,21 @@ upscale/modes/static.py + upscale/worker_comms.py), for participants
 that are NOT part of the local mesh (other hosts, heterogeneous
 boxes, cloud pods):
 
-  worker: poll job ready → pull tile id → process → submit (size-aware
-          flushes, heartbeat per tile) → final flush
-  master: init queue → pull/process/blend locally while draining worker
-          results → on drain, collection phase with heartbeat-timeout
-          requeue (busy-probe grace) → local fallback for requeued
-          tiles → blend
+  worker: poll job ready (warming the tile-processor compile in the
+          background) → pipelined pull/sample/encode/submit stages
+          (graph/tile_pipeline.py): placement grants run as vmapped
+          K-tile device batches, the next grant's sampling dispatches
+          while the previous grant's results ride the tunnel back,
+          heartbeats flow from the I/O stage → final flush
+  master: init queue → pull speed-sized grants, batch-sample, blend
+          locally while draining worker results → on drain, collection
+          phase with heartbeat-timeout requeue (busy-probe grace) →
+          local fallback for requeued tiles → blend
 
 Because per-tile noise keys fold the global tile index
 (ops/upscale.py), a tile re-run after requeue is bit-identical — no
-seam drift from fault recovery.
+seam drift from fault recovery; batching/pipelining change WHO and
+WHEN, never the per-tile inputs.
 
 The worker side talks through a WorkClient so hermetic tests can
 script the exchange without sockets (the reference's fake-comms test
@@ -23,8 +28,7 @@ pattern, reference tests/test_static_mode.py).
 
 from __future__ import annotations
 
-import asyncio
-import contextlib
+import threading
 import time
 from typing import Any, Optional
 
@@ -42,8 +46,11 @@ from ..utils.constants import (
     MAX_PAYLOAD_SIZE,
     MAX_TILE_BATCH,
     PAYLOAD_HEADROOM,
+    PIPELINE_ENABLED,
     QUEUE_POLL_INTERVAL_SECONDS,
     SCHED_MAX_PULL_BATCH,
+    WARM_COMPILE,
+    tile_scan_batch,
 )
 from ..resilience.policy import (
     http_policy,
@@ -52,38 +59,12 @@ from ..resilience.policy import (
     transport_errors,
     work_pull_policy,
 )
-from ..telemetry import TRACE_HEADER, current_trace_id, get_tracer
-from ..telemetry.instruments import tile_stage_seconds, tiles_processed_total
+from ..telemetry import TRACE_HEADER, current_trace_id
+from ..telemetry.instruments import tiles_processed_total
 from ..utils.exceptions import TransientServerError, WorkerError
 from ..utils.logging import debug_log, log
 from ..utils.network import build_worker_url, get_client_session, probe_worker
-
-
-@contextlib.contextmanager
-def _stage(stage: str, role: str, tile_idx: int | None = None):
-    """Span + latency histogram around one per-tile pipeline stage
-    (pull | sample | encode | submit | decode | blend). The span clock
-    is the tracer's (injectable, deterministic in chaos runs); the
-    histogram always uses the wall monotonic clock.
-
-    A pull that drains empty (caller sets ``outcome="empty"`` on the
-    yielded span) is excluded from the histogram: empty polls last the
-    full poll timeout by construction and would drag the pull stage's
-    p95 toward the timeout instead of the real dequeue latency (the
-    store's pulls_total{outcome="empty"} counter tracks them)."""
-    attrs: dict[str, Any] = {"stage": stage, "role": role}
-    if tile_idx is not None:
-        attrs["tile_idx"] = int(tile_idx)
-    started = time.monotonic()
-    span = None
-    try:
-        with get_tracer().span(f"tile.{stage}", **attrs) as span:
-            yield span
-    finally:
-        if span is None or span.attrs.get("outcome") != "empty":
-            tile_stage_seconds().observe(
-                time.monotonic() - started, stage=stage, role=role
-            )
+from .tile_pipeline import GrantSampler, TilePipeline, stage_span as _stage
 
 
 # --------------------------------------------------------------------------
@@ -232,6 +213,28 @@ class HTTPWorkClient:
 
         run_async_in_server_loop(beat(), timeout=30)
 
+    def return_tiles(self, tile_idxs: list[int]) -> None:
+        """Hand claimed-but-unprocessed tiles back to the master (an
+        interrupted in-flight grant) so they requeue immediately
+        instead of waiting out the heartbeat timeout. Best effort: if
+        the master is unreachable, its timeout requeue still covers
+        these tiles."""
+
+        async def send():
+            try:
+                await self._post(
+                    "/distributed/return_tiles",
+                    {
+                        "job_id": self.job_id,
+                        "worker_id": self.worker_id,
+                        "tile_idxs": [int(t) for t in tile_idxs],
+                    },
+                )
+            except Exception as exc:  # noqa: BLE001 - best effort
+                debug_log(f"return_tiles failed: {exc}")
+
+        run_async_in_server_loop(send(), timeout=30)
+
 
 def _flush_threshold_bytes() -> int:
     return MAX_PAYLOAD_SIZE - PAYLOAD_HEADROOM
@@ -283,11 +286,15 @@ def run_worker_loop(
     context=None,
     client: Any = None,
 ) -> None:
-    """Pull tiles until the master's queue drains, flushing results in
-    size-aware batches with a heartbeat per processed tile."""
+    """Pull grants until the master's queue drains, through the staged
+    tile pipeline (graph/tile_pipeline.py): placement grants execute as
+    vmapped K-tile device batches (shape-bucketed so ragged tails never
+    recompile), readback/encode/submit overlap the next batch's
+    sampling, and results flush in size-aware batches with a heartbeat
+    per processed tile (plus idle heartbeats while a device batch is in
+    flight). CDT_PIPELINE=0 falls back to fully synchronous staging
+    (same callbacks, no prefetch/overlap threads)."""
     client = client or HTTPWorkClient(master_url, job_id, worker_id)
-    if not client.poll_ready():
-        raise WorkerError(f"job {job_id} never became ready", worker_id)
 
     _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
@@ -300,14 +307,67 @@ def run_worker_loop(
     )
     key = jax.random.key(seed)
     positions = grid.positions_array()
+    grant_sampler = GrantSampler(
+        process, bundle.params, extracted, key, positions, pos, neg,
+        k_max=tile_scan_batch(), role="worker",
+    )
+
+    # Warm the tile-processor compile while the ready poll waits on the
+    # master: with the persistent compilation cache hot this turns the
+    # 14-40 s first compile (BENCH_NOTES r5) into a cache load that
+    # finishes before the first grant arrives.
+    warm = None
+    if WARM_COMPILE:
+        warm = threading.Thread(
+            target=grant_sampler.warmup, name="cdt-usdu-warmup", daemon=True
+        )
+        warm.start()
+    if not client.poll_ready():
+        raise WorkerError(f"job {job_id} never became ready", worker_id)
+    if warm is not None:
+        warm.join()
 
     pending: list[dict] = []
     pending_bytes = 0
 
+    def emit(tile_idx: int, arr) -> None:
+        """One processed tile (host-side [B, h, w, C]) → pending
+        entries. Runs on the pipeline's I/O stage."""
+        nonlocal pending_bytes
+        for batch_idx in range(arr.shape[0]):
+            encoded = img_utils.encode_image_data_url(arr[batch_idx])
+            y, x = grid.positions[tile_idx]
+            pending.append(
+                {
+                    "tile_idx": tile_idx,
+                    "batch_idx": batch_idx,
+                    "global_idx": tile_idx * arr.shape[0] + batch_idx,
+                    "x": int(x),
+                    "y": int(y),
+                    "extracted_w": grid.padded_w,
+                    "extracted_h": grid.padded_h,
+                    "image": encoded,
+                }
+            )
+            pending_bytes += len(encoded)
+        tiles_processed_total().inc(role="worker")
+
     def flush(is_final: bool) -> None:
+        """Size-aware flush: ships when the payload budget or tile
+        batch fills, or unconditionally on the final flush (an empty
+        final flush still signals this worker done)."""
         nonlocal pending, pending_bytes
+        if not is_final and (
+            len(pending) < MAX_TILE_BATCH
+            and pending_bytes < _flush_threshold_bytes()
+        ):
+            return
         if pending or is_final:
-            with _stage("submit", "worker"):
+            # worker_id keys this span to the same (role, worker_id)
+            # group as the sample/readback/encode spans — perf_report's
+            # overlap column intersects per pipeline, and submit is the
+            # I/O stage the overlap mostly consists of
+            with _stage("submit", "worker", worker_id=worker_id):
                 client.submit_tiles(pending, is_final)
         pending, pending_bytes = [], 0
 
@@ -319,57 +379,32 @@ def run_worker_loop(
     # answers with a single tile_idx and the loop degrades to the
     # historical one-at-a-time pull.
     pull_work = _make_pull(client)
-    while True:
-        if context is not None:
-            context.check_interrupted()
-        with _stage("pull", "worker") as pull_span:
-            work = pull_work()
-            if work is None:
-                pull_span.attrs["outcome"] = "empty"
-            else:
-                pull_span.attrs["tile_idx"] = int(work["tile_idx"])
-                if work.get("tile_idxs"):
-                    pull_span.attrs["batch"] = [
-                        int(t) for t in work["tile_idxs"]
-                    ]
+
+    def pull() -> Optional[list[int]]:
+        work = pull_work()
         if work is None:
-            break
-        batch = work.get("tile_idxs") or [work["tile_idx"]]
-        for tile_idx in batch:
-            if context is not None:
-                context.check_interrupted()
-            tile_idx = int(tile_idx)
-            tkey = jax.random.fold_in(key, tile_idx)
-            with _stage("sample", "worker", tile_idx):
-                result = process(
-                    bundle.params, extracted[tile_idx], tkey, pos, neg,
-                    positions[tile_idx],
-                )
-            with _stage("encode", "worker", tile_idx):
-                arr = img_utils.ensure_numpy(result)
-                for batch_idx in range(arr.shape[0]):
-                    encoded = img_utils.encode_image_data_url(arr[batch_idx])
-                    y, x = grid.positions[tile_idx]
-                    entry = {
-                        "tile_idx": tile_idx,
-                        "batch_idx": batch_idx,
-                        "global_idx": tile_idx * arr.shape[0] + batch_idx,
-                        "x": int(x),
-                        "y": int(y),
-                        "extracted_w": grid.padded_w,
-                        "extracted_h": grid.padded_h,
-                        "image": encoded,
-                    }
-                    pending.append(entry)
-                    pending_bytes += len(encoded)
-            tiles_processed_total().inc(role="worker")
-            client.heartbeat()
-            if (
-                len(pending) >= MAX_TILE_BATCH
-                or pending_bytes >= _flush_threshold_bytes()
-            ):
-                flush(is_final=False)
-    flush(is_final=True)
+            return None
+        return [int(t) for t in (work.get("tile_idxs") or [work["tile_idx"]])]
+
+    pipeline = TilePipeline(
+        pull=pull,
+        sample=grant_sampler.sample,
+        chunks=grant_sampler.chunks,
+        emit=emit,
+        flush=flush,
+        heartbeat=client.heartbeat,
+        check_interrupted=(
+            context.check_interrupted if context is not None else None
+        ),
+        release=getattr(client, "return_tiles", None),
+        role="worker",
+        # per-pipeline span grouping: perf_report's overlap column
+        # intersects sample/I-O spans per (role, worker_id) so fleet
+        # parallelism never reads as pipelining in merged traces
+        span_attrs={"worker_id": worker_id} if worker_id else None,
+        threaded=PIPELINE_ENABLED,
+    )
+    pipeline.run()
 
 
 def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,
@@ -508,40 +543,57 @@ def run_master_elastic(
         return bool(result["online"] and (result["queue_remaining"] or 0) > 0)
 
     # --- main pull/process loop ---
+    # The master pulls speed-sized grants through the same placement-
+    # hooked path workers use (scheduler/placement sizes them; without
+    # a policy the batch is 1 — the historical single pull) and runs
+    # each grant through the bucketed vmapped K-tile processor. Tiles
+    # are recorded via submit_flush so the latency sink sees per-tile
+    # AMORTIZED service times, not one per-batch lump followed by
+    # near-zero gaps (the watchdog's straggler median and the placement
+    # speed EWMA both consume that stream).
+    grant_sampler = GrantSampler(
+        process, bundle.params, extracted, key, positions, pos, neg,
+        k_max=tile_scan_batch(), role="master",
+    )
     empty_pulls = 0
     while empty_pulls < 2:
         if context is not None:
             context.check_interrupted()
         with _stage("pull", "master") as pull_span:
-            tile_idx = run_async_in_server_loop(
-                store.pull_task(job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS),
+            grant = run_async_in_server_loop(
+                store.pull_tasks(
+                    job_id, "master", timeout=QUEUE_POLL_INTERVAL_SECONDS
+                ),
                 timeout=30,
             )
-            if tile_idx is None:
+            if not grant:
                 pull_span.attrs["outcome"] = "empty"
             else:
-                pull_span.attrs["tile_idx"] = int(tile_idx)
-        if tile_idx is None:
+                pull_span.attrs["tile_idx"] = int(grant[0])
+                if len(grant) > 1:
+                    pull_span.attrs["batch"] = [int(t) for t in grant]
+        if not grant:
             empty_pulls += 1
             drain_results()
             continue
         empty_pulls = 0
-        tkey = jax.random.fold_in(key, tile_idx)
-        with _stage("sample", "master", tile_idx):
-            result = process(
-                bundle.params, extracted[tile_idx], tkey, pos, neg,
-                positions[tile_idx],
+        for chunk in grant_sampler.chunks(grant):
+            if context is not None:
+                context.check_interrupted()
+            with _stage("sample", "master", chunk[0], batch=list(chunk)):
+                result = grant_sampler.sample(chunk)
+            run_async_in_server_loop(
+                store.submit_flush(
+                    job_id, "master",
+                    # master blends directly; no payload retained
+                    {int(t): None for t in chunk},
+                ),
+                timeout=30,
             )
-        run_async_in_server_loop(
-            store.submit_result(
-                job_id, "master", tile_idx,
-                None,  # master blends directly; no payload retained
-            ),
-            timeout=30,
-        )
-        tiles_processed_total().inc(role="master")
-        blend_local(tile_idx, result)
-        drain_results()
+            tiles_processed_total().inc(len(chunk), role="master")
+            for i, tile_idx in enumerate(chunk):
+                blend_local(int(tile_idx), result[i])
+            drain_results()
 
     # --- collection phase ---
     deadline = time.monotonic() + timeout * max(1, len(enabled_worker_ids))

@@ -510,6 +510,38 @@ class JobStore:
                     out[job.job_id] = incomplete
         return out
 
+    async def release_tasks(
+        self, job_id: str, worker_id: str, task_ids: list[int]
+    ) -> list[int]:
+        """Voluntarily hand back claimed-but-unprocessed tasks — the
+        graceful half of requeue: an interrupted worker returns the
+        unprocessed remainder of its in-flight grant so the tiles
+        requeue NOW instead of waiting out the heartbeat timeout. Only
+        tasks actually assigned to this worker and not yet completed go
+        back (a stale release after a speculative win is a no-op)."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return []
+        released: list[int] = []
+        async with self.lock:
+            assigned = job.assigned.get(worker_id, set())
+            for tid in sorted(int(t) for t in task_ids):
+                if tid not in assigned or tid in job.completed:
+                    continue
+                assigned.discard(tid)
+                job.assigned_at.pop((worker_id, tid), None)
+                job.pending.put_nowait(tid)
+                released.append(tid)
+        if released:
+            instruments.store_requeued_tasks_total().inc(
+                len(released), worker_id=worker_id, reason="released"
+            )
+            log(
+                f"worker {worker_id} returned {len(released)} task(s) "
+                f"on job {job_id}: {released}"
+            )
+        return released
+
     async def speculate_in_flight(self, job_id: str) -> list[int]:
         """Speculative re-dispatch (the watchdog's stall recovery, the
         MapReduce backup-task move): re-enqueue COPIES of every

@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import pipeline as pl
 from ..parallel.mesh import DATA_AXIS, data_axis_size
-from ..utils.constants import TILE_SCAN_BATCH
+from ..utils.constants import tile_scan_batch
 from . import samplers as smp
 from . import tiles as tile_ops
 from .costs import xla_flops as _xla_flops
@@ -377,6 +377,32 @@ def _wraparound_pad(arrs, total: int):
     return [jnp.concatenate([a] * reps, axis=0)[:total] for a in arrs]
 
 
+def grant_buckets(k_max: int) -> tuple[int, ...]:
+    """The bounded set of compiled tile-batch shapes for grants up to
+    `k_max`: powers of two plus k_max itself — at most
+    ceil(log2(k_max)) + 1 sizes. The elastic tier pads every ragged
+    grant up to its bucket (wraparound duplicates with folded keys,
+    surplus sliced off) so a job's worth of varying grant sizes never
+    triggers a fresh compile mid-run."""
+    k_max = max(1, int(k_max))
+    sizes = []
+    b = 1
+    while b < k_max:
+        sizes.append(b)
+        b *= 2
+    sizes.append(k_max)
+    return tuple(sizes)
+
+
+def bucket_for(n: int, k_max: int) -> int:
+    """Smallest grant bucket that fits `n` tiles (n clamped to k_max)."""
+    n = max(1, min(int(n), max(1, int(k_max))))
+    for size in grant_buckets(k_max):
+        if size >= n:
+            return size
+    return max(1, int(k_max))
+
+
 def _scan_tiles(one, extracted, keys, positions, tile_batch: int):
     """Scan the tile axis in groups of `tile_batch`, vmapping
     one(tile, key, yx) across each group. K=1 is the reference scan;
@@ -567,7 +593,7 @@ def run_upscale(
     bit-for-bit; batched grouping is allclose but not bit-identical
     (batched conv reduction order differs)."""
     if tile_batch is None:
-        tile_batch = TILE_SCAN_BATCH
+        tile_batch = tile_scan_batch()
     upscaled, grid, _ = prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
         mask_blur=mask_blur, uniform=uniform,

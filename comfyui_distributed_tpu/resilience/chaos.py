@@ -138,6 +138,9 @@ def run_chaos_usdu(
     trace_jsonl: Optional[str] = None,
     watchdog: Optional[dict] = None,
     placement: Optional[dict] = None,
+    tile_batch: int = 1,
+    pipeline: bool = True,
+    prefetch: bool = False,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -174,6 +177,17 @@ def run_chaos_usdu(
     the policy snapshot in ChaosResult.placement — chaos tests assert a
     straggler receives measurably fewer tiles while the canvas stays
     bit-identical (placement must change WHO, never WHAT).
+
+    `tile_batch`/`pipeline`/`prefetch`: the batched-pipelined data path
+    (graph/tile_pipeline.py). Worker threads ALWAYS run the production
+    TilePipeline (this harness is its chaos coverage); `pipeline=False`
+    forces the synchronous staging fallback, `tile_batch>1` runs grants
+    through the bucketed vmapped K-tile processor on master and workers
+    alike (CDT_TILE_BATCH is patched for the master loop), and
+    `prefetch=True` enables the one-grant-ahead pull stage. Defaults
+    keep claim timing deterministic (no prefetch) so scripted fault
+    schedules fire on the same tiles every run. All combinations must
+    produce the bit-identical canvas — that is the point.
     """
     import jax
     import jax.numpy as jnp
@@ -238,6 +252,8 @@ def run_chaos_usdu(
     def worker_body(wid: str) -> None:
         # Identical preprocessing to the master: per-tile determinism
         # means the only thing identity changes is WHO computed a tile.
+        from ..graph.tile_pipeline import GrantSampler, TilePipeline, stage_span
+
         _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
             image, upscale_by, tile, padding, "bicubic", None
         )
@@ -251,55 +267,84 @@ def run_chaos_usdu(
         # in the same span tree the master's stages do.
         tracer = get_tracer()
         token = tracer.activate(trace_id)
+        sampler = GrantSampler(
+            _stub_process, None, extracted, key, grid.positions_array(),
+            None, None, k_max=tile_batch, role="worker",
+        )
+        flush_pending: dict[int, list] = {}
+
+        def pull():
+            if injector is not None:
+                injector.check_blocking(f"chaos:{wid}:pull")
+            # pull_tasks = the production batch path: singleton batches
+            # without a placement policy (byte-identical to the
+            # historical pull), speed-sized grants with one.
+            return run_async_in_server_loop(
+                store.pull_tasks(job_id, wid, timeout=0.2), timeout=10
+            ) or None
+
+        def sample(chunk):
+            if injector is not None:
+                # per-tile crash point AFTER assignment, BEFORE compute
+                # (crash here = crash-after-pull: tile assigned, never
+                # submitted — the requeue path must cover it)
+                for _t in chunk:
+                    injector.check_blocking(f"chaos:{wid}:pulled")
+            return sampler.sample(chunk)
+
+        def emit(tile_idx, arr):
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+
+        def flush(is_final):
+            if not flush_pending:
+                return
+            grouped = dict(flush_pending)
+            flush_pending.clear()
+            if injector is not None:
+                for _t in sorted(grouped):
+                    injector.check_blocking(f"chaos:{wid}:submit")
+            with stage_span(
+                "submit", "worker", sorted(grouped)[0],
+                batch=sorted(grouped), worker_id=wid,
+            ):
+                accepted = run_async_in_server_loop(
+                    store.submit_flush(job_id, wid, grouped), timeout=10
+                )
+            accepted_by_worker[wid] += accepted
+
+        def heartbeat():
+            try:
+                run_async_in_server_loop(
+                    store.heartbeat(job_id, wid), timeout=10
+                )
+            except Exception:  # noqa: BLE001 - liveness is best effort
+                pass
+
+        def release(idxs):
+            run_async_in_server_loop(
+                store.release_tasks(job_id, wid, idxs), timeout=10
+            )
+
         try:
-            while True:
-                if injector is not None:
-                    injector.check_blocking(f"chaos:{wid}:pull")
-                # pull_tasks = the production batch path: singleton
-                # batches without a placement policy (byte-identical to
-                # the historical pull), speed-sized grants with one.
-                with tracer.span(
-                    "tile.pull", stage="pull", role="worker", worker_id=wid
-                ) as pull_span:
-                    batch = run_async_in_server_loop(
-                        store.pull_tasks(job_id, wid, timeout=0.2), timeout=10
-                    )
-                if not batch:
-                    break
-                pull_span.attrs["tile_idx"] = int(batch[0])
-                if len(batch) > 1:
-                    pull_span.attrs["batch"] = [int(t) for t in batch]
-                for tile_idx in batch:
-                    if injector is not None:
-                        injector.check_blocking(f"chaos:{wid}:pulled")
-                    with tracer.span(
-                        "tile.sample", stage="sample", role="worker",
-                        worker_id=wid, tile_idx=int(tile_idx),
-                    ):
-                        tkey = jax.random.fold_in(key, tile_idx)
-                        result = _stub_process(
-                            None, extracted[tile_idx], tkey, None, None, None
-                        )
-                    arr = img_utils.ensure_numpy(result)
-                    payload = [
-                        {
-                            "batch_idx": i,
-                            "image": img_utils.encode_image_data_url(arr[i]),
-                        }
-                        for i in range(arr.shape[0])
-                    ]
-                    if injector is not None:
-                        injector.check_blocking(f"chaos:{wid}:submit")
-                    with tracer.span(
-                        "tile.submit", stage="submit", role="worker",
-                        worker_id=wid, tile_idx=int(tile_idx),
-                    ):
-                        accepted = run_async_in_server_loop(
-                            store.submit_result(job_id, wid, tile_idx, payload),
-                            timeout=10,
-                        )
-                    if accepted:
-                        accepted_by_worker[wid] += 1
+            TilePipeline(
+                pull=pull,
+                sample=sample,
+                chunks=sampler.chunks,
+                emit=emit,
+                flush=flush,
+                heartbeat=heartbeat,
+                release=release,
+                role="worker",
+                span_attrs={"worker_id": wid},
+                threaded=pipeline,
+                prefetch=prefetch,
+            ).run()
         except FaultInjected as exc:
             # Simulated crash: the thread dies with a tile assigned and
             # unsubmitted; the master's requeue path must recover it.
@@ -337,7 +382,15 @@ def run_chaos_usdu(
                 )
             )
             stack.enter_context(
-                mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
+                mock.patch.dict(
+                    os.environ,
+                    {
+                        "CDT_DETERMINISTIC_BLEND": "1",
+                        # master loop + any nested tile_scan_batch()
+                        # read share the harness's batching knob
+                        "CDT_TILE_BATCH": str(max(1, int(tile_batch))),
+                    },
+                )
             )
             token = chaos_tracer.activate(trace_id)
             try:

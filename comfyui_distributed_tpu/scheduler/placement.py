@@ -128,14 +128,30 @@ class PlacementPolicy:
     # --- decisions --------------------------------------------------------
 
     def batch_size(self, worker_id: str, remaining: int) -> int:
-        """How many tasks this worker's pull may claim at once."""
+        """How many tasks this worker's pull may claim at once.
+
+        Sizes are aligned DOWN to a power of two so a speed-scaled
+        grant lands exactly on a tile-processor shape bucket the worker
+        has already compiled (ops/upscale.grant_buckets = powers of two
+        plus the executor's K_max), instead of paying wraparound
+        padding (or a fresh compile) on every oddly-sized grant. Pure
+        powers of two — NOT grant_buckets(self.max_batch) — because the
+        pull cap and the executor's CDT_TILE_BATCH are separate knobs
+        (and may even differ per worker platform): every pow2 grant is
+        a bucket under ANY K_max, either directly or after the executor
+        splits it into K_max-sized chunks whose pow2 remainders are
+        buckets too. The ragged job tail still produces sub-bucket
+        grants; the executor pads those."""
         if remaining <= 0:
             return 1
         if remaining <= self.tail_tiles:
             return 1  # tail tiles are precious: no batch hoarding
         ratio = self.speed_ratio(worker_id)
-        size = int(round(ratio * self.base_batch))
-        return max(1, min(size, self.max_batch, remaining))
+        size = max(1, min(int(round(ratio * self.base_batch)), self.max_batch))
+        aligned = 1
+        while aligned * 2 <= size:
+            aligned *= 2
+        return min(aligned, remaining)
 
     def _health_state(self, worker_id: str) -> Optional[str]:
         if self.health is None:

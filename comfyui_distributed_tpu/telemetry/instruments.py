@@ -60,7 +60,8 @@ def store_heartbeats_total() -> Counter:
 def store_requeued_tasks_total() -> Counter:
     return get_metrics_registry().counter(
         "cdt_store_requeued_tasks_total",
-        "Tasks returned to the pending queue by reason (timeout|quarantine)",
+        "Tasks returned to the pending queue by reason "
+        "(timeout|quarantine|speculative|released)",
         ("worker_id", "reason"),
     )
 
@@ -278,8 +279,8 @@ def host_rss_bytes() -> Gauge:
 def tile_stage_seconds() -> Histogram:
     return get_metrics_registry().histogram(
         "cdt_tile_stage_seconds",
-        "Per-tile stage latency (pull|sample|encode|submit|decode|blend) "
-        "by role (master|worker)",
+        "Per-tile stage latency (pull|sample|readback|encode|submit|"
+        "decode|blend) by role (master|worker)",
         ("stage", "role"),
     )
 
@@ -288,6 +289,36 @@ def tiles_processed_total() -> Counter:
     return get_metrics_registry().counter(
         "cdt_tiles_processed_total",
         "Tiles fully processed per role",
+        ("role",),
+    )
+
+
+# --- elastic tile pipeline (graph/tile_pipeline.py) ------------------------
+
+def pipeline_batches_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_pipeline_batches_total",
+        "Batched device dispatches in the elastic tile pipeline by "
+        "role and grant-chunk size",
+        ("role", "bucket"),
+    )
+
+
+def pipeline_inflight() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_pipeline_inflight",
+        "Device batches dispatched but not yet read back per role "
+        "(bounded by CDT_PIPELINE_DEPTH)",
+        ("role",),
+    )
+
+
+def pipeline_padded_tiles_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_pipeline_padded_tiles_total",
+        "Wraparound-duplicate tiles added to pad ragged grants up to a "
+        "compiled shape bucket (wasted device work, bounded by bucket "
+        "granularity)",
         ("role",),
     )
 
@@ -359,6 +390,13 @@ def bind_server_collectors(server) -> Callable[[], None]:
     # JAX runtime gauges (compiles, cache hits, HBM, host RSS) ride the
     # same scrape; process-global, bound once per registry.
     ensure_runtime_collectors()
+
+    # Touch the tile-pipeline instruments so their HELP/TYPE headers are
+    # present in the very first scrape (CI smoke asserts on them even
+    # before any tile job has run on this server).
+    pipeline_batches_total()
+    pipeline_inflight()
+    pipeline_padded_tiles_total()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale

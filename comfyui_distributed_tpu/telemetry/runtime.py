@@ -175,6 +175,15 @@ def runtime_snapshot() -> dict[str, Any]:
     with _tallies_lock:
         out: dict[str, Any] = dict(_tallies)
     out["compile_time_s"] = round(out["compile_time_s"], 3)
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            cache_dir = jax.config.jax_compilation_cache_dir
+        except Exception:  # noqa: BLE001 - config name drift
+            cache_dir = None
+        if cache_dir:
+            # the hit/miss tallies above say whether it actually helped
+            out["compile_cache_dir"] = cache_dir
     rss = _host_rss_bytes()
     if rss is not None:
         out["host_rss_bytes"] = rss

@@ -50,7 +50,28 @@ MAX_TILE_BATCH = _env_int("CDT_MAX_BATCH", 20)
 # Tiles diffused per scan step in the USDU compute core (batch-K UNet/
 # VAE programs; MXU utilization knob). 1 = reference numerics
 # (bit-identical to the committed goldens); >1 is allclose.
-TILE_SCAN_BATCH = _env_int("CDT_TILE_BATCH", 1)
+# CDT_TILE_BATCH overrides; unset defaults by platform at first use:
+# CPU stays 1 (golden-exact, r1-r5 trendline comparability),
+# accelerators get 8 (measured best on v5e — BENCH_NOTES r5 A/B:
+# K=8 is +4.0% tiles/s over K=1).
+def tile_scan_batch() -> int:
+    """Platform-aware CDT_TILE_BATCH resolution. Never triggers backend
+    init: the platform is only consulted when jax is already imported
+    (the callers are compute paths where it always is); otherwise the
+    conservative CPU default applies."""
+    explicit = _env_int("CDT_TILE_BATCH", 0)
+    if explicit > 0:
+        return explicit
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1
+    try:
+        platform = jax.default_backend()
+    except Exception:  # noqa: BLE001 - backend not ready
+        return 1
+    return 1 if platform == "cpu" else 8
 MAX_AUDIO_PAYLOAD_BYTES = _env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
 
 # --- orchestration concurrency ------------------------------------------
@@ -123,6 +144,45 @@ SCHED_TAIL_TILES = _env_int("CDT_SCHED_TAIL_TILES", 2)
 # A worker slower than TRIM_RATIO x the fleet's mean speed is trimmed
 # from the tail (it may still pull while the queue is deep).
 SCHED_TRIM_RATIO = _env_float("CDT_SCHED_TRIM_RATIO", 0.5)
+
+# --- elastic tile pipeline (graph/tile_pipeline.py) -----------------------
+# The elastic USDU worker/master data path runs as a staged pipeline:
+# pull prefetch -> device sampling -> host readback + PNG encode ->
+# submit flush. CDT_PIPELINE=0 restores the serial per-tile loop.
+PIPELINE_ENABLED = os.environ.get("CDT_PIPELINE", "1") != "0"
+# In-flight device batches the sampler may run ahead of the I/O stage
+# (queue bound). 1 keeps at most two batches materialized (one in
+# readback, one dispatched) — the bf16 HBM margin from the r5 OOM
+# finding; raise only on chips with headroom.
+PIPELINE_DEPTH = _env_int("CDT_PIPELINE_DEPTH", 1)
+# Pull prefetch: claim the next grant while the device samples the
+# current one (bounded to ONE grant ahead so a crash never orphans a
+# deep claim). 0 pulls synchronously between batches.
+PIPELINE_PREFETCH = os.environ.get("CDT_PIPELINE_PREFETCH", "1") != "0"
+# Warm the tile-processor compile during the worker's ready-poll
+# window so the first pull doesn't eat the (14-40 s on TPU, r5) first
+# compile. With the persistent compilation cache hot this is a cache
+# load, not a compile.
+WARM_COMPILE = os.environ.get("CDT_WARM_COMPILE", "1") != "0"
+
+# --- persistent XLA compilation cache -------------------------------------
+# First compiles dominate a chip session's budget (BENCH_NOTES r5:
+# 14-40 s with the flash kernel); the persistent cache makes every
+# process after the first skip them. CDT_COMPILE_CACHE_DIR overrides
+# the location; "0"/"off" disables. The default lives under the worker
+# base dir (cwd) so co-hosted master+workers share one cache.
+COMPILE_CACHE_DISABLED_VALUES = ("0", "off", "none")
+
+
+def compile_cache_dir() -> str | None:
+    """Resolved persistent-compilation-cache directory (None = off)."""
+    raw = os.environ.get("CDT_COMPILE_CACHE_DIR")
+    if raw is not None:
+        if raw.strip().lower() in COMPILE_CACHE_DISABLED_VALUES or not raw.strip():
+            return None
+        return raw
+    return os.path.join(os.getcwd(), ".cdt", "compile_cache")
+
 
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer

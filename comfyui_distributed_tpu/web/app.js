@@ -39,6 +39,8 @@ import {
 } from "./modules/widgets.js";
 import {
   networkInfoHtml,
+  parsePipelineMetrics,
+  pipelineHtml,
   renderVocabBanner,
   renderWorkers,
   renderWorkflowNodes,
@@ -91,6 +93,7 @@ async function refreshStatus() {
     document.getElementById("workers"), state.config, state.workerStatus
   );
   refreshScheduler();
+  refreshPipeline();
   schedulePoll();
 }
 
@@ -104,6 +107,20 @@ async function refreshScheduler() {
     );
   } catch {
     container.textContent = "scheduler unreachable";
+  }
+}
+
+// ---------- tile pipeline stage view ----------
+
+async function refreshPipeline() {
+  const container = document.getElementById("tile-pipeline");
+  try {
+    // the metrics route serves Prometheus text, not JSON — fetch raw
+    const resp = await fetch("/distributed/metrics");
+    if (!resp.ok) throw new Error(`HTTP ${resp.status}`);
+    container.innerHTML = pipelineHtml(parsePipelineMetrics(await resp.text()));
+  } catch {
+    container.textContent = "pipeline metrics unreachable";
   }
 }
 

@@ -152,6 +152,76 @@ export function schedulerHtml(status) {
   );
 }
 
+/** Parse the tile-pipeline + compile-cache series out of the
+ * /distributed/metrics Prometheus text (pure; no DOM). Returns
+ * { batches: {role: {bucket: n}}, inflight: {role: n},
+ *   padded: {role: n}, cache: {hits, misses} }. */
+export function parsePipelineMetrics(text) {
+  const out = { batches: {}, inflight: {}, padded: {}, cache: {} };
+  const line_re = /^(\w+)(?:\{([^}]*)\})?\s+(-?[\d.eE+]+)$/;
+  const labels = (raw) => {
+    const map = {};
+    for (const part of (raw || "").split(",")) {
+      const m = part.match(/^(\w+)="([^"]*)"$/);
+      if (m) map[m[1]] = m[2];
+    }
+    return map;
+  };
+  for (const line of (text || "").split("\n")) {
+    const m = line.trim().match(line_re);
+    if (!m) continue;
+    const [, name, rawLabels, value] = m;
+    const lbl = labels(rawLabels);
+    const num = Number(value);
+    if (name === "cdt_pipeline_batches_total") {
+      const role = lbl.role || "?";
+      out.batches[role] = out.batches[role] || {};
+      out.batches[role][lbl.bucket || "?"] = num;
+    } else if (name === "cdt_pipeline_inflight") {
+      out.inflight[lbl.role || "?"] = num;
+    } else if (name === "cdt_pipeline_padded_tiles_total") {
+      out.padded[lbl.role || "?"] = num;
+    } else if (name === "cdt_jax_cache_hits") {
+      out.cache.hits = num;
+    } else if (name === "cdt_jax_cache_misses") {
+      out.cache.misses = num;
+    }
+  }
+  return out;
+}
+
+/** Tile-pipeline stage view (pure; app.js refreshPipeline applies it):
+ * batched device dispatches per role/bucket, in-flight batches, pad
+ * waste, and the persistent compile-cache hit/miss counters. */
+export function pipelineHtml(stats) {
+  if (!stats) return '<span class="meta">pipeline status unavailable</span>';
+  const roles = Object.keys(stats.batches || {}).sort();
+  if (!roles.length && stats.cache.hits === undefined) {
+    return '<span class="meta">no pipeline activity yet</span>';
+  }
+  const rows = roles.map((role) => {
+    const buckets = stats.batches[role] || {};
+    const parts = Object.keys(buckets)
+      .sort((a, b) => Number(a) - Number(b))
+      .map((b) => `K=${escapeHtml(b)}: ${buckets[b]}`)
+      .join(" · ");
+    const inflight = stats.inflight?.[role] ?? 0;
+    const padded = stats.padded?.[role] ?? 0;
+    return (
+      `<div class="row"><strong>${escapeHtml(role)}</strong>` +
+      `<span class="meta">${parts || "no batches"} · in-flight ${inflight}` +
+      `${padded ? ` · padded ${padded}` : ""}</span></div>`
+    );
+  });
+  const cache = stats.cache || {};
+  const cacheLine =
+    cache.hits !== undefined || cache.misses !== undefined
+      ? `<div class="row"><span class="meta">compile cache: ` +
+        `${cache.hits ?? 0} hits / ${cache.misses ?? 0} misses</span></div>`
+      : "";
+  return rows.join("") + cacheLine;
+}
+
 /** Topology summary line (pure; app.js renderTopology applies it). */
 export function topologyHtml(info) {
   const topo = info.topology || {};

@@ -7,6 +7,8 @@ import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
   dividerNodeHtml,
   networkInfoHtml,
+  parsePipelineMetrics,
+  pipelineHtml,
   schedulerHtml,
   topologyHtml,
   valueNodeHtml,
@@ -192,4 +194,41 @@ test("schedulerHtml escapes hostile tenant and worker names", () => {
   });
   assert(!html.includes("<img"), "tenant name escaped");
   assert(!html.includes("<b>w</b>"), "worker name escaped");
+});
+
+test("parsePipelineMetrics pulls pipeline + cache series from text", () => {
+  const text = [
+    "# TYPE cdt_pipeline_batches_total counter",
+    'cdt_pipeline_batches_total{role="worker",bucket="8"} 12',
+    'cdt_pipeline_batches_total{role="master",bucket="2"} 3',
+    'cdt_pipeline_inflight{role="worker"} 1',
+    'cdt_pipeline_padded_tiles_total{role="worker"} 4',
+    "cdt_jax_cache_hits 7",
+    "cdt_jax_cache_misses 2",
+    "unrelated_metric 99",
+  ].join("\n");
+  const stats = parsePipelineMetrics(text);
+  assertEqual(stats.batches, { worker: { "8": 12 }, master: { "2": 3 } });
+  assertEqual(stats.inflight, { worker: 1 });
+  assertEqual(stats.padded, { worker: 4 });
+  assertEqual(stats.cache, { hits: 7, misses: 2 });
+});
+
+test("pipelineHtml renders per-role buckets and the cache line", () => {
+  const html = pipelineHtml({
+    batches: { worker: { 8: 12, 4: 1 } },
+    inflight: { worker: 1 },
+    padded: { worker: 4 },
+    cache: { hits: 7, misses: 2 },
+  });
+  assertIncludes(html, "worker");
+  assertIncludes(html, "K=4: 1");
+  assertIncludes(html, "K=8: 12");
+  assertIncludes(html, "in-flight 1");
+  assertIncludes(html, "padded 4");
+  assertIncludes(html, "compile cache: 7 hits / 2 misses");
+  assertIncludes(
+    pipelineHtml({ batches: {}, inflight: {}, padded: {}, cache: {} }),
+    "no pipeline activity"
+  );
 });

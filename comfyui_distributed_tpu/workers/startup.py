@@ -17,8 +17,12 @@ import threading
 from typing import Any
 
 from ..utils import config as config_mod
-from ..utils.constants import AUTO_LAUNCH_DELAY_SECONDS, WORKER_ENV_FLAG
-from ..utils.logging import log
+from ..utils.constants import (
+    AUTO_LAUNCH_DELAY_SECONDS,
+    WORKER_ENV_FLAG,
+    compile_cache_dir,
+)
+from ..utils.logging import debug_log, log
 from .process_manager import get_worker_manager
 
 _cleanup_done = threading.Event()
@@ -26,6 +30,40 @@ _cleanup_done = threading.Event()
 
 def is_worker_process() -> bool:
     return os.environ.get(WORKER_ENV_FLAG) == "1"
+
+
+def configure_compile_cache() -> str | None:
+    """Point JAX's persistent compilation cache at the shared on-disk
+    directory (CDT_COMPILE_CACHE_DIR; see utils/constants) so every
+    process after the first skips its first compiles — 14-40 s each on
+    TPU with the flash kernel (BENCH_NOTES r5), previously re-paid by
+    EVERY worker process. Must run before the first jit compile; safe
+    any time before backend-heavy work. Returns the cache dir in use,
+    or None when disabled/unavailable.
+
+    Thresholds are zeroed so even small/fast programs cache — the
+    elastic tier compiles one tile-processor per shape bucket and every
+    one of them is worth persisting. jax.monitoring cache hit/miss
+    events land in cdt_jax_cache_hits/misses on /distributed/metrics
+    (telemetry/runtime.py)."""
+    cache_dir = compile_cache_dir()
+    if cache_dir is None:
+        return None
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: BLE001 - knob absent on older jax
+            pass
+    except Exception as exc:  # noqa: BLE001 - cache is an optimization
+        debug_log(f"compile cache setup failed ({cache_dir}): {exc}")
+        return None
+    debug_log(f"persistent compilation cache at {cache_dir}")
+    return cache_dir
 
 
 def auto_populate_workers(config_path: str | None = None) -> list[dict[str, Any]]:

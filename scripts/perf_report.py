@@ -49,6 +49,15 @@ REQUIRED_MASTER = "blend"
 SCHED_WAIT_SPAN = "sched.wait"
 PULL_SPAN_NAMES = ("tile.pull", "rpc.request_image")
 
+# Pipeline-overlap reconstruction: the elastic tier's staged executor
+# (graph/tile_pipeline.py) dispatches the next batch's `sample` while
+# the previous batch's readback/encode/submit ride the I/O stage. The
+# overlap fraction — how much of the sample-stage wall ran concurrently
+# with I/O-stage work — is reconstructed from the span timeline of the
+# existing cdt_tile_stage_seconds spans (no new instrumentation).
+SAMPLE_STAGE = "sample"
+IO_STAGES = ("readback", "encode", "submit")
+
 
 def load_spans(path: str) -> list[dict[str, Any]]:
     spans = []
@@ -118,6 +127,71 @@ def queue_wait_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def _merge_intervals(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    merged: list[tuple[float, float]] = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def pipeline_overlap_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Fraction of sample-stage wall overlapped by the SAME pipeline's
+    I/O-stage work (readback/encode/submit), from span start/duration
+    timelines.
+
+    Spans are grouped per (role, worker_id) before intersecting:
+    participant A's submit riding concurrently with participant B's
+    sample is fleet parallelism, not pipelining — counting it would let
+    a fully serial per-worker loop read as overlapped just because the
+    fleet is busy. 0.0 = fully serial (the pre-pipeline loop shape:
+    every encode and submit sat squarely between device dispatches);
+    values toward 1.0 mean each pipeline's I/O stages ride concurrently
+    with its own sampling. None when no pipeline has both finished
+    sample and I/O spans (nothing to overlap)."""
+    sample_by: dict[tuple, list[tuple[float, float]]] = {}
+    io_by: dict[tuple, list[tuple[float, float]]] = {}
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        stage = attrs.get("stage")
+        start = span.get("start")
+        duration = span.get("duration")
+        if stage is None or start is None or duration is None:
+            continue
+        key = (attrs.get("role", "?"), attrs.get("worker_id") or "")
+        interval = (float(start), float(start) + float(duration))
+        if stage == SAMPLE_STAGE:
+            sample_by.setdefault(key, []).append(interval)
+        elif stage in IO_STAGES:
+            io_by.setdefault(key, []).append(interval)
+    sample_wall = 0.0
+    overlapped = 0.0
+    measured = False
+    for key, sample_iv in sample_by.items():
+        io_iv = io_by.get(key)
+        if not io_iv:
+            continue
+        measured = True
+        io_union = _merge_intervals(io_iv)
+        sample_wall += sum(end - start for start, end in sample_iv)
+        for s_start, s_end in sample_iv:
+            for i_start, i_end in io_union:
+                if i_start >= s_end:
+                    break
+                lo, hi = max(s_start, i_start), min(s_end, i_end)
+                if hi > lo:
+                    overlapped += hi - lo
+    if not measured:
+        return None
+    return {
+        "sample_wall": sample_wall,
+        "overlapped": overlapped,
+        "fraction": (overlapped / sample_wall) if sample_wall > 0 else 0.0,
+    }
+
+
 def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
     """Aggregate span durations per name → latency stats."""
     by_name: dict[str, list[float]] = {}
@@ -145,6 +219,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "unfinished_spans": unfinished,
         "stages": stages,
         "queue_wait": queue_wait_stats(spans),
+        "pipeline_overlap": pipeline_overlap_stats(spans),
     }
 
 
@@ -157,16 +232,20 @@ def tile_lifecycle(spans: list[dict[str, Any]]) -> dict[int, list[dict[str, Any]
         stage = attrs.get("stage")
         if tile_idx is None or stage is None:
             continue
-        tiles.setdefault(int(tile_idx), []).append(
-            {
-                "stage": stage,
-                "role": attrs.get("role", "?"),
-                "worker_id": attrs.get("worker_id"),
-                "start": span.get("start"),
-                "duration": span.get("duration"),
-                "status": span.get("status"),
-            }
-        )
+        # batched stages (pipelined grants) record one span covering
+        # several tiles via the `batch` attr — credit each of them, or
+        # the lifecycle of tiles 2..k in a batch would read incomplete
+        for idx in attrs.get("batch") or [tile_idx]:
+            tiles.setdefault(int(idx), []).append(
+                {
+                    "stage": stage,
+                    "role": attrs.get("role", "?"),
+                    "worker_id": attrs.get("worker_id"),
+                    "start": span.get("start"),
+                    "duration": span.get("duration"),
+                    "status": span.get("status"),
+                }
+            )
     for stages in tiles.values():
         stages.sort(key=lambda s: (s["start"] is None, s["start"]))
     return dict(sorted(tiles.items()))
@@ -234,6 +313,23 @@ def compare_reports(
                     "delta_pct": delta_pct,
                 }
             )
+    # pipeline overlap gates INVERTED: a DROP in the sample/IO overlap
+    # fraction means the elastic pipeline lost concurrency (I/O time
+    # moved back between device dispatches). delta_pct is the relative
+    # drop so the same threshold applies.
+    old_ov = old_report.get("pipeline_overlap")
+    new_ov = new_report.get("pipeline_overlap")
+    if old_ov and new_ov and old_ov["fraction"] > 0:
+        drop_pct = (1.0 - new_ov["fraction"] / old_ov["fraction"]) * 100.0
+        if drop_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": "pipeline_overlap",
+                    "old_p95": old_ov["fraction"],
+                    "new_p95": new_ov["fraction"],
+                    "delta_pct": drop_pct,
+                }
+            )
     return regressions
 
 
@@ -244,6 +340,12 @@ def render_comparison(
         return f"p95 comparison: no stage regressed more than {regress_pct:g}%"
     lines = [f"p95 REGRESSIONS (> {regress_pct:g}%):"]
     for item in regressions:
+        if item["stage"] == "pipeline_overlap":
+            lines.append(
+                f"  {item['stage']:28} overlap {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
         lines.append(
             f"  {item['stage']:28} {item['old_p95']:.4f}s -> "
             f"{item['new_p95']:.4f}s (+{item['delta_pct']:.1f}%)"
@@ -279,6 +381,15 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"count={wait['count']} mean={wait['mean']:.4f}s "
             f"p50={wait['p50']:.4f}s p95={wait['p95']:.4f}s "
             f"max={wait['max']:.4f}s"
+        )
+    overlap = report.get("pipeline_overlap")
+    if overlap:
+        lines.append("")
+        lines.append(
+            "pipeline overlap (sample wall concurrent with encode/"
+            f"submit): {overlap['overlapped']:.4f}s of "
+            f"{overlap['sample_wall']:.4f}s "
+            f"(fraction {overlap['fraction']:.3f})"
         )
     if tiles:
         lines.append("")

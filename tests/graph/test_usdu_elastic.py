@@ -62,7 +62,10 @@ def test_worker_loop_processes_scripted_tiles(bundle):
         sampler="euler", scheduler="karras", cfg=1.0, denoise=0.3, seed=4,
         client=client,
     )
-    assert client.heartbeats == 3
+    # at least one heartbeat per processed tile; the pipeline's I/O
+    # stage may add idle beats while a device batch (or the first
+    # compile) is in flight — that's the liveness the master relies on
+    assert client.heartbeats >= 3
     assert {e["tile_idx"] for e in client.submitted} == {0, 2, 3}
     assert client.flushes[-1][1] is True  # final flush marked
     entry = client.submitted[0]
@@ -137,6 +140,44 @@ def test_master_elastic_with_live_worker_submissions(bundle, server_loop):
     t.join(timeout=30)
     assert out.shape == (1, 128, 128, 3)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_master_batched_grants_amortize_latency_stream(
+    bundle, monkeypatch, server_loop
+):
+    """The master's own tile share runs as batched grants; the latency
+    sink (watchdog straggler signal + placement EWMA) must still see
+    one AMORTIZED per-tile sample per tile — never one per-batch lump
+    followed by near-zero flush gaps."""
+    from comfyui_distributed_tpu.scheduler.placement import PlacementPolicy
+
+    monkeypatch.setenv("CDT_TILE_BATCH", "4")
+    img = jnp.asarray(np.random.default_rng(3).random((1, 64, 64, 3)), jnp.float32)
+    pos = pl.encode_text(bundle, ["p"])
+    neg = pl.encode_text(bundle, [""])
+    store = JobStore()
+    # a placement policy makes pull_tasks grant multi-tile batches to
+    # the master (base_batch=4, no samples → uniform speed)
+    store.placement = PlacementPolicy(
+        min_samples=1, base_batch=4, max_batch=4, tail_tiles=0
+    )
+    samples: list[tuple[str, float]] = []
+    store.latency_sink = lambda wid, sec: samples.append((wid, sec))
+    server = types.SimpleNamespace(job_store=store)
+    ctx = ExecutionContext(server=server, config={"workers": []})
+
+    out = run_master_elastic(
+        bundle, img, pos, neg, job_id="job3", enabled_worker_ids=[],
+        upscale_by=2.0, tile=64, padding=16, steps=1, sampler="euler",
+        scheduler="karras", cfg=1.0, denoise=0.3, seed=3, context=ctx,
+    )
+    assert out.shape == (1, 128, 128, 3)
+    master_samples = [sec for wid, sec in samples if wid == "master"]
+    assert len(master_samples) == 4  # one per tile, not one per batch
+    # amortized evenly: a 4-tile flush records four equal shares, so
+    # the spread within one flush is ~zero (no near-zero poison gaps)
+    grouped = {round(s, 9) for s in master_samples}
+    assert len(grouped) <= 2, master_samples
 
 
 def test_master_elastic_requeues_dead_worker(bundle, monkeypatch, server_loop):

@@ -169,9 +169,14 @@ def test_weighted_placement_starves_straggler_but_not_output(baseline):
     total = sum(big_baseline.tiles_by_worker.values())
     assert total == 16  # 128→256 at tile=64/padding=16: 4x4 grid
 
+    # synchronous staging (pipeline=False) keeps the claim-rate race
+    # deterministic: with the threaded pipeline a slow worker's pulls
+    # overlap its submits, compressing the weighted-vs-uniform margin
+    # this test measures (output parity under the threaded pipeline is
+    # covered by the dedicated pipelined/batched parity tests below)
     weighted = run_chaos_usdu(
         seed=11, image_hw=(128, 128), fault_plan=plan,
-        worker_timeout=10.0,
+        worker_timeout=10.0, pipeline=False,
         placement=dict(
             base_batch=1, max_batch=4, tail_tiles=8,
             min_samples=1, trim_ratio=0.5,
@@ -179,6 +184,7 @@ def test_weighted_placement_starves_straggler_but_not_output(baseline):
     )
     uniform = run_chaos_usdu(
         seed=11, image_hw=(128, 128), fault_plan=plan, worker_timeout=10.0,
+        pipeline=False,
     )
     np.testing.assert_array_equal(big_baseline.output, weighted.output)
     np.testing.assert_array_equal(big_baseline.output, uniform.output)
@@ -201,6 +207,78 @@ def test_weighted_placement_is_invisible_on_a_healthy_fleet(baseline):
     np.testing.assert_array_equal(baseline, result.output)
     for stats in result.placement["workers"].values():
         assert stats["tail_trims"] == 0
+
+
+# --------------------------------------------------------------------------
+# batched + pipelined data path (PR-5 tentpole parity + chaos coverage)
+# --------------------------------------------------------------------------
+
+
+def test_batched_pipelined_parity_square_grid(baseline):
+    """Acceptance: the batched+pipelined elastic path (K=4 vmapped
+    grants, threaded pipeline, pull prefetch) produces a bit-identical
+    canvas to the serial per-tile baseline on an exactly-divisible
+    grid (4 tiles, K=4)."""
+    result = run_chaos_usdu(seed=11, tile_batch=4, pipeline=True, prefetch=True)
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_batched_pipelined_parity_ragged_grid():
+    """Acceptance: a ragged grid (15 tiles, K=4 — remainder chunks pad
+    to the bucket via wraparound duplicates with folded keys) is
+    bit-identical between the serial and batched+pipelined paths."""
+    serial = run_chaos_usdu(seed=7, image_hw=(96, 160), pipeline=False)
+    batched = run_chaos_usdu(
+        seed=7, image_hw=(96, 160), tile_batch=4, pipeline=True
+    )
+    np.testing.assert_array_equal(serial.output, batched.output)
+
+
+def test_crash_after_pull_with_pipelined_batched_grants(baseline):
+    """Chaos re-run with pipelining + batched grants enabled: a worker
+    crashing after pulling (part of) a grant must not orphan tiles —
+    the requeue path recovers and the canvas stays bit-identical."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+        tile_batch=4,
+        pipeline=True,
+    )
+    assert "w1" in result.crashed_workers
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_speculative_redispatch_with_pipelined_batched_grants(baseline):
+    """The watchdog's speculative re-dispatch under pipelining +
+    batched grants: a crashed worker's in-flight tile is speculated
+    long before the heartbeat timeout, and the canvas is still
+    bit-identical (first result wins, duplicates drop)."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+        worker_timeout=10.0,
+        watchdog={},
+        tile_batch=4,
+        pipeline=True,
+    )
+    assert "w1" in result.crashed_workers
+    assert result.stalls, "stall never detected"
+    assert any(result.speculated.values()), "no speculative re-dispatch"
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_prefetch_crash_requeues_prefetched_grant(baseline):
+    """With pull prefetch on, a crashing worker strands BOTH its
+    in-flight grant and the prefetched one; heartbeat-timeout requeue
+    must recover every tile bit-identically."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#2",
+        tile_batch=2,
+        pipeline=True,
+        prefetch=True,
+    )
+    np.testing.assert_array_equal(baseline, result.output)
 
 
 def test_store_level_connection_errors_kill_worker_but_not_job(baseline):

@@ -281,6 +281,31 @@ def test_requeue_worker_tasks_across_jobs():
     run(scenario())
 
 
+def test_release_tasks_returns_only_assigned_incomplete():
+    """Voluntary grant hand-back (pipeline interrupt): released tasks
+    leave the worker's assignment and requeue; completed or
+    never-assigned ids are ignored."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("r", [0, 1, 2, 3])
+        a = await store.pull_task("r", "w")
+        b = await store.pull_task("r", "w")
+        await store.submit_result("r", "w", a, None)  # completed
+        released = await store.release_tasks("r", "w", [a, b, 99])
+        assert released == [b]
+        assert await store.remaining("r") == 3  # 2 never pulled + b
+        job = await store.get_tile_job("r")
+        assert not job.assigned.get("w")
+        # the released task is claimable again
+        again = await store.pull_task("r", "other")
+        assert again in (b, 2, 3)
+        # unknown job: no-op
+        assert await store.release_tasks("nope", "w", [0]) == []
+
+    run(scenario())
+
+
 def test_store_fault_injection_drop_and_crash():
     """JobStore honors a fault plan: dropped heartbeats are never
     recorded; a crash fault surfaces as an exception at the RPC."""

@@ -278,6 +278,129 @@ def test_queue_wait_rendered_in_text_report(tmp_path):
     assert "p95=" in proc.stdout
 
 
+def _stage_span(stage, start, duration, idx=0, role="worker"):
+    return {
+        "trace_id": "t", "span_id": f"st{stage}{idx}", "parent_id": None,
+        "name": f"tile.{stage}", "start": start, "end": start + duration,
+        "duration": duration, "attrs": {"stage": stage, "role": role},
+        "events": [], "status": "ok",
+    }
+
+
+def test_pipeline_overlap_measures_sample_io_concurrency():
+    # sample [0,1] while submit rides [0.5, 1.5] → 0.5s of the 1.0s
+    # sample wall overlapped; a second sample [2,3] with no concurrent
+    # I/O adds wall but no overlap.
+    spans = [
+        _stage_span("sample", 0.0, 1.0),
+        _stage_span("submit", 0.5, 1.0),
+        _stage_span("sample", 2.0, 1.0, idx=1),
+    ]
+    stats = perf_report.pipeline_overlap_stats(spans)
+    assert stats["sample_wall"] == pytest.approx(2.0)
+    assert stats["overlapped"] == pytest.approx(0.5)
+    assert stats["fraction"] == pytest.approx(0.25)
+    # fully serial: encode/submit strictly between samples → 0.0
+    serial = perf_report.pipeline_overlap_stats(
+        [
+            _stage_span("sample", 0.0, 1.0),
+            _stage_span("encode", 1.0, 0.5),
+            _stage_span("sample", 1.5, 1.0, idx=1),
+        ]
+    )
+    assert serial["fraction"] == pytest.approx(0.0)
+    # no I/O spans at all → column absent, not zero
+    assert perf_report.pipeline_overlap_stats(
+        [_stage_span("sample", 0.0, 1.0)]
+    ) is None
+
+
+def test_pipeline_overlap_ignores_cross_worker_concurrency():
+    """Two fully serial per-worker pipelines whose stages interleave in
+    wall time: fleet parallelism must NOT read as pipeline overlap —
+    spans intersect per (role, worker_id) only."""
+    def w(stage, start, duration, wid, idx=0):
+        span = _stage_span(stage, start, duration, idx=f"{wid}{idx}")
+        span["attrs"]["worker_id"] = wid
+        return span
+
+    spans = [
+        # w1: sample [0,1], submit [1,2] (serial); w2 shifted by 0.5 so
+        # w2's sample overlaps w1's submit in wall time
+        w("sample", 0.0, 1.0, "w1"),
+        w("submit", 1.0, 1.0, "w1"),
+        w("sample", 0.5, 1.0, "w2"),
+        w("submit", 1.5, 1.0, "w2"),
+    ]
+    stats = perf_report.pipeline_overlap_stats(spans)
+    assert stats["fraction"] == pytest.approx(0.0)
+    # the same timeline attributed to ONE worker IS overlap
+    merged = [
+        w("sample", 0.0, 1.0, "w1"),
+        w("submit", 1.0, 1.0, "w1"),
+        w("sample", 0.5, 1.0, "w1", idx=1),
+        w("submit", 1.5, 1.0, "w1", idx=1),
+    ]
+    # sample2 [0.5,1.5] ∩ io-union [1,2.5] = 0.5 of 2.0 sample wall
+    assert perf_report.pipeline_overlap_stats(merged)["fraction"] == pytest.approx(0.25)
+
+
+def test_pipeline_overlap_rides_the_compare_gate(tmp_path):
+    overlapped = [
+        _stage_span("sample", 0.0, 1.0),
+        _stage_span("submit", 0.0, 1.0),
+    ]
+    serial = [
+        _stage_span("sample", 0.0, 1.0),
+        _stage_span("submit", 1.0, 1.0),
+        # keep sample p95 identical so only the overlap gate can fire
+    ]
+    old = perf_report.build_report(overlapped)
+    new = perf_report.build_report(serial)
+    regressions = perf_report.compare_reports(old, new, regress_pct=25.0)
+    assert [r["stage"] for r in regressions] == ["pipeline_overlap"]
+    assert regressions[0]["delta_pct"] == pytest.approx(100.0)
+    # overlap improving (or staying) is never a regression
+    assert perf_report.compare_reports(new, old, regress_pct=25.0) == []
+
+    old_path, new_path = str(tmp_path / "o.jsonl"), str(tmp_path / "n.jsonl")
+    _write_jsonl(old_path, overlapped)
+    _write_jsonl(new_path, serial)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            new_path, "--compare", old_path,
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "pipeline_overlap" in proc.stdout
+
+
+def test_pipeline_overlap_rendered_in_text_report(tmp_path):
+    path = str(tmp_path / "ov.jsonl")
+    _write_jsonl(
+        path,
+        [_stage_span("sample", 0.0, 1.0), _stage_span("submit", 0.5, 1.0)],
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_report.py"), path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "pipeline overlap" in proc.stdout
+    assert "fraction" in proc.stdout
+
+
+def test_batched_sample_spans_credit_every_tile_in_lifecycle():
+    span = _stage_span("sample", 0.0, 1.0)
+    span["attrs"]["batch"] = [4, 5, 6]
+    span["attrs"]["tile_idx"] = 4
+    tiles = perf_report.tile_lifecycle([span])
+    assert sorted(tiles) == [4, 5, 6]
+    for stages in tiles.values():
+        assert stages[0]["stage"] == "sample"
+
+
 def test_cli_fails_on_missing_or_empty_input(tmp_path):
     proc = subprocess.run(
         [

@@ -1,0 +1,359 @@
+"""The staged tile pipeline + bucketed grant sampler in isolation.
+
+Covers the PR-5 acceptance points that don't need the full chaos
+harness: the bounded compiled-shape set (at most ceil(log2(K))+1
+tile-processor shapes for a whole job of varying grant sizes), the
+sample/submit overlap (total wall < serial sum of stage times under an
+injected slow transport), heartbeats flowing while a device batch is
+in flight, and interrupted in-flight grants requeueing cleanly."""
+
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.tile_pipeline import (
+    GrantSampler,
+    TilePipeline,
+)
+from comfyui_distributed_tpu.ops.upscale import bucket_for, grant_buckets
+from comfyui_distributed_tpu.resilience.faults import FaultInjector
+
+
+# --------------------------------------------------------------------------
+# bucket math
+# --------------------------------------------------------------------------
+
+
+def test_grant_buckets_are_pow2_plus_kmax():
+    assert grant_buckets(1) == (1,)
+    assert grant_buckets(4) == (1, 2, 4)
+    assert grant_buckets(8) == (1, 2, 4, 8)
+    assert grant_buckets(6) == (1, 2, 4, 6)
+    for k in range(1, 33):
+        assert len(grant_buckets(k)) <= math.ceil(math.log2(k) or 1) + 1
+
+
+def test_bucket_for_rounds_up_and_clamps():
+    assert bucket_for(1, 8) == 1
+    assert bucket_for(3, 8) == 4
+    assert bucket_for(5, 8) == 8
+    assert bucket_for(99, 8) == 8
+    assert bucket_for(3, 1) == 1
+
+
+# --------------------------------------------------------------------------
+# shape buckets bound the compile count
+# --------------------------------------------------------------------------
+
+
+def _toy_tiles(n=8):
+    extracted = jnp.arange(n * 2 * 4 * 4 * 3, dtype=jnp.float32).reshape(
+        n, 2, 4, 4, 3
+    )
+    positions = jnp.zeros((n, 2), jnp.int32)
+    return extracted, positions
+
+
+def test_job_of_varying_grants_compiles_bounded_shapes():
+    """Acceptance: every grant size 1..K_max through the sampler
+    compiles at most ceil(log2(K_max))+1 distinct tile-processor
+    shapes (counted via trace side effects — jit re-traces exactly
+    once per new input shape)."""
+    k_max = 8
+    extracted, positions = _toy_tiles(k_max)
+    traces = []
+
+    @jax.jit
+    def process(params, tile, key, pos, neg, yx):
+        traces.append(tile.shape)  # fires at trace time only
+        return tile * 2.0
+
+    sampler = GrantSampler(
+        process, None, extracted, jax.random.key(0), positions, None, None,
+        k_max=k_max,
+    )
+    grant_sizes = list(range(1, k_max + 1)) + [5, 3, 7, 2, 8, 1, 6]
+    for size in grant_sizes:
+        out = sampler.sample(list(range(size)))
+        assert out.shape[0] == size
+    assert len(traces) <= math.ceil(math.log2(k_max)) + 1, traces
+    assert sampler.buckets_used <= set(grant_buckets(k_max))
+
+
+def test_ragged_grant_pads_with_wraparound_duplicates():
+    """A 3-tile grant at K=4 pads to the 4-bucket by wrapping; the
+    surplus is sliced off and the kept rows equal the serial result."""
+    extracted, positions = _toy_tiles(8)
+    sampler = GrantSampler(
+        lambda params, tile, key, pos, neg, yx: tile * 3.0,
+        None, extracted, jax.random.key(0), positions, None, None, k_max=4,
+    )
+    out = np.asarray(sampler.sample([5, 6, 7]))
+    assert out.shape[0] == 3
+    np.testing.assert_array_equal(out, np.asarray(extracted[5:8]) * 3.0)
+    assert sampler.padded_tiles == 1
+
+
+def test_grant_chunks_split_at_kmax():
+    extracted, positions = _toy_tiles(8)
+    sampler = GrantSampler(
+        lambda *a: a[1], None, extracted, jax.random.key(0), positions,
+        None, None, k_max=4,
+    )
+    assert sampler.chunks([0, 1, 2, 3, 4, 5]) == [[0, 1, 2, 3], [4, 5]]
+    serial = GrantSampler(
+        lambda *a: a[1], None, extracted, jax.random.key(0), positions,
+        None, None, k_max=1,
+    )
+    assert serial.chunks([0, 1, 2]) == [[0], [1], [2]]
+
+
+def test_warmup_precompiles_the_steady_state_bucket():
+    """Warmup (run during the worker's ready-poll window) compiles the
+    largest bucket ahead of time: the first real grant of that shape
+    triggers no new trace."""
+    extracted, positions = _toy_tiles(4)
+    traces = []
+
+    @jax.jit
+    def process(params, tile, key, pos, neg, yx):
+        traces.append(1)
+        return tile
+
+    sampler = GrantSampler(
+        process, None, extracted, jax.random.key(0), positions, None, None,
+        k_max=4,
+    )
+    sampler.warmup()
+    warmed = len(traces)
+    assert warmed >= 1
+    sampler.sample([0, 1, 2, 3])
+    assert len(traces) == warmed  # steady-state shape came from warmup
+
+
+# --------------------------------------------------------------------------
+# pipeline overlap + liveness
+# --------------------------------------------------------------------------
+
+
+def _host_result(idxs):
+    return np.zeros((len(idxs), 1, 2, 2, 3), np.float32)
+
+
+def test_pipeline_overlaps_sample_with_slow_submit():
+    """Acceptance: with a FaultInjector-injected slow transport on the
+    submit stage, the pipelined wall is measurably below the serial sum
+    of stage times — sampling of grant N overlaps the submit of grant
+    N-1."""
+    sample_s, submit_s, n_grants = 0.12, 0.12, 4
+    injector = FaultInjector(
+        "seed=0;" + f"latency({submit_s})@pipe:submit#*"
+    )
+    grants = [[i] for i in range(n_grants)]
+    flushed = []
+
+    def pull():
+        return grants.pop(0) if grants else None
+
+    def sample(chunk):
+        time.sleep(sample_s)  # the "device"
+        return _host_result(chunk)
+
+    def flush(final):
+        if flushed_pending:
+            injector.check_blocking("pipe:submit")
+            flushed.extend(flushed_pending)
+            flushed_pending.clear()
+
+    flushed_pending: list[int] = []
+
+    pipeline = TilePipeline(
+        pull=pull,
+        sample=sample,
+        emit=lambda t, arr: flushed_pending.append(t),
+        flush=flush,
+        to_host=lambda r: r,
+        role="worker",
+        threaded=True,
+        prefetch=True,
+    )
+    started = time.monotonic()
+    stats = pipeline.run()
+    wall = time.monotonic() - started
+
+    serial_sum = n_grants * (sample_s + submit_s)
+    assert stats["tiles"] == n_grants
+    assert sorted(flushed) == list(range(n_grants))
+    # generous margin (threads + CI jitter), still strictly below the
+    # serial stage-time sum — the overlap is real
+    assert wall < serial_sum - sample_s / 2, (wall, serial_sum)
+
+
+def test_heartbeats_flow_while_device_batch_in_flight():
+    """Acceptance: a long device batch must not starve liveness — the
+    I/O stage emits idle heartbeats while sampling is in flight."""
+    beats = []
+    first_emit = []
+
+    def sample(chunk):
+        time.sleep(0.5)
+        return _host_result(chunk)
+
+    grants = [[0]]
+    pipeline = TilePipeline(
+        pull=lambda: grants.pop(0) if grants else None,
+        sample=sample,
+        emit=lambda t, arr: first_emit.append(time.monotonic()),
+        flush=lambda final: None,
+        to_host=lambda r: r,
+        heartbeat=lambda: beats.append(time.monotonic()),
+        heartbeat_interval=0.05,
+        role="worker",
+        threaded=True,
+        prefetch=False,
+    )
+    pipeline.run()
+    assert first_emit
+    idle_beats = [b for b in beats if b < first_emit[0]]
+    assert len(idle_beats) >= 3, (len(idle_beats), len(beats))
+
+
+def test_sync_mode_runs_stages_inline():
+    grants = [[0, 1], [2]]
+    order = []
+    pipeline = TilePipeline(
+        pull=lambda: grants.pop(0) if grants else None,
+        sample=lambda chunk: (order.append(("sample", tuple(chunk))), _host_result(chunk))[1],
+        emit=lambda t, arr: order.append(("emit", t)),
+        flush=lambda final: order.append(("flush", final)),
+        to_host=lambda r: r,
+        role="worker",
+        threaded=False,
+    )
+    stats = pipeline.run()
+    assert stats == {"batches": 2, "tiles": 3}
+    # flush is consulted after EVERY tile (size thresholds live inside
+    # the callback) — a per-batch consult could overshoot the payload
+    # budget by K-1 tiles
+    assert order == [
+        ("sample", (0, 1)), ("emit", 0), ("flush", False),
+        ("emit", 1), ("flush", False),
+        ("sample", (2,)), ("emit", 2), ("flush", False), ("flush", True),
+    ]
+
+
+# --------------------------------------------------------------------------
+# interrupts + error propagation
+# --------------------------------------------------------------------------
+
+
+def test_interrupt_releases_unprocessed_grant_sync():
+    """An interrupted in-flight grant requeues cleanly: tiles already
+    emitted are flushed, the unprocessed remainder goes to release()."""
+    grants = [[0, 1, 2, 3]]
+    emitted, released, flushes = [], [], []
+    interrupted = threading.Event()
+
+    def check():
+        if interrupted.is_set():
+            raise InterruptedError("stop")
+
+    def emit(t, arr):
+        emitted.append(t)
+        if t == 1:
+            interrupted.set()
+
+    pipeline = TilePipeline(
+        pull=lambda: grants.pop(0) if grants else None,
+        sample=lambda chunk: _host_result(chunk),
+        chunks=lambda grant: [[t] for t in grant],
+        emit=emit,
+        flush=lambda final: flushes.append(final),
+        to_host=lambda r: r,
+        check_interrupted=check,
+        release=lambda idxs: released.extend(idxs),
+        role="worker",
+        threaded=False,
+    )
+    with pytest.raises(InterruptedError):
+        pipeline.run()
+    assert emitted == [0, 1]
+    assert released == [2, 3]
+    assert flushes[-1] is True  # pending results shipped before release
+
+
+def test_interrupt_release_requeues_into_job_store(server_loop):
+    """End to end against the real JobStore: the released remainder of
+    an interrupted grant lands back in the pending queue with its
+    assignment cleared — no orphaned tiles."""
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.utils.async_helpers import (
+        run_async_in_server_loop,
+    )
+
+    store = JobStore()
+    run_async_in_server_loop(
+        store.init_tile_job("j", list(range(4))), timeout=10
+    )
+    interrupted = threading.Event()
+
+    def pull():
+        batch = run_async_in_server_loop(
+            store.pull_tasks("j", "w1", timeout=0.1, limit=4), timeout=10
+        )
+        if batch:
+            # the interrupt lands right after the claim: the whole
+            # grant is in flight and unprocessed
+            interrupted.set()
+        return batch or None
+
+    def check():
+        if interrupted.is_set():
+            raise InterruptedError("stop")
+
+    pipeline = TilePipeline(
+        pull=pull,
+        sample=lambda chunk: _host_result(chunk),
+        chunks=lambda grant: [[t] for t in grant],
+        emit=lambda t, arr: None,
+        flush=lambda final: None,
+        to_host=lambda r: r,
+        check_interrupted=check,
+        release=lambda idxs: run_async_in_server_loop(
+            store.release_tasks("j", "w1", idxs), timeout=10
+        ),
+        role="worker",
+        threaded=False,
+    )
+    with pytest.raises(InterruptedError):
+        pipeline.run()
+    job = run_async_in_server_loop(store.get_tile_job("j"), timeout=10)
+    # the claimed-but-unprocessed grant went back: nothing assigned to
+    # the worker, every tile pending again
+    assert not job.assigned.get("w1"), job.assigned
+    assert job.pending.qsize() == 4
+
+
+def test_io_stage_error_propagates_to_caller():
+    grants = [[0], [1], [2]]
+
+    def flush(final):
+        raise RuntimeError("submit exploded")
+
+    pipeline = TilePipeline(
+        pull=lambda: grants.pop(0) if grants else None,
+        sample=lambda chunk: _host_result(chunk),
+        emit=lambda t, arr: None,
+        flush=flush,
+        to_host=lambda r: r,
+        role="worker",
+        threaded=True,
+        prefetch=True,
+    )
+    with pytest.raises(RuntimeError, match="submit exploded"):
+        pipeline.run()
