@@ -11,6 +11,7 @@ Route parity with reference api/job_routes.py:
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import os
 from typing import Any
@@ -20,6 +21,7 @@ from aiohttp import web
 from ..telemetry.instruments import collector_results_total
 from ..utils import audio_payload as audio_utils
 from ..utils import image as img_utils
+from ..utils.async_helpers import run_blocking
 from ..utils.constants import JOB_INIT_GRACE_SECONDS
 from ..utils.exceptions import PromptValidationError
 from ..utils.logging import debug_log, log
@@ -243,12 +245,17 @@ class JobRoutes:
         response: dict[str, Any] = {"exists": True}
         expected = body.get("md5")
         if expected:
-            digest = hashlib.md5()
-            with open(path, "rb") as fh:
-                for chunk in iter(lambda: fh.read(1 << 20), b""):
-                    digest.update(chunk)
-            response["md5"] = digest.hexdigest()
-            response["matches"] = digest.hexdigest() == expected
+            # digesting a multi-MB media file blocks; hash off-loop (CDT001)
+            def _digest_file() -> str:
+                digest = hashlib.md5()
+                with open(path, "rb") as fh:
+                    for chunk in iter(lambda: fh.read(1 << 20), b""):
+                        digest.update(chunk)
+                return digest.hexdigest()
+
+            hexdigest = await run_blocking(_digest_file)
+            response["md5"] = hexdigest
+            response["matches"] = hexdigest == expected
         return web.json_response(response)
 
     async def load_image(self, request: web.Request) -> web.Response:
@@ -279,12 +286,18 @@ class JobRoutes:
                 target_dir = get_input_dir(None)
                 os.makedirs(target_dir, exist_ok=True)
                 target = os.path.join(target_dir, filename)
-                with open(target, "wb") as fh:
+                # stream chunk-by-chunk with the open/write/close on the
+                # executor: bounded memory for arbitrarily large media
+                # files AND no sync file I/O on the loop (CDT001)
+                fh = await run_blocking(open, target, "wb")
+                try:
                     while True:
                         chunk = await part.read_chunk()
                         if not chunk:
                             break
-                        fh.write(chunk)
+                        await run_blocking(fh.write, chunk)
+                finally:
+                    await run_blocking(fh.close)
                 saved.append(filename)
         return web.json_response({"name": saved[0] if saved else None, "saved": saved})
 

@@ -21,6 +21,7 @@ import aiohttp
 
 from ...resilience.policy import http_policy, retry_async, transport_errors
 from ...telemetry.instruments import media_sync_seconds, media_sync_uploads_total
+from ...utils.async_helpers import run_blocking
 from ...utils.constants import MEDIA_SYNC_TIMEOUT_SECONDS
 from ...utils.logging import debug_log, log
 from ...utils.network import build_worker_url, get_client_session
@@ -94,8 +95,13 @@ async def _upload_file(worker, path: str, filename: str) -> bool:
 
     # Read once, outside the retry: a missing/unreadable local file is
     # a permanent error, not a transient network fault to back off on.
-    with open(path, "rb") as fh:
-        payload = fh.read()
+    # Executor-read — media files are multi-MB and this coroutine runs
+    # on the serving loop (CDT001).
+    def _read_payload() -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    payload = await run_blocking(_read_payload)
 
     async def attempt() -> bool:
         # FormData is single-use: rebuild per attempt.

@@ -9,6 +9,8 @@ import os
 
 from aiohttp import web
 
+from ..utils.async_helpers import run_blocking
+
 WEB_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "web")
 
 
@@ -39,8 +41,13 @@ def register(app: web.Application, server) -> None:
         for directory in _workflow_dirs():
             path = os.path.join(directory, name) if directory else ""
             if path and os.path.isfile(path):
-                with open(path, "r", encoding="utf-8") as fh:
-                    return web.json_response(json.load(fh))
+                # workflow JSON can sit on slow/network storage:
+                # read+parse off the serving loop (CDT001)
+                def _load(p: str = path):
+                    with open(p, "r", encoding="utf-8") as fh:
+                        return json.load(fh)
+
+                return web.json_response(await run_blocking(_load))
         return web.json_response({"error": "not found"}, status=404)
 
     app.router.add_get("/", index)

@@ -13,6 +13,7 @@ Parity with reference api/worker_routes.py (695 LoC there):
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import socket
@@ -20,6 +21,7 @@ from typing import Any
 
 from aiohttp import WSMsgType, web
 
+from ..utils.async_helpers import run_blocking
 from ..utils.logging import log
 
 
@@ -112,7 +114,7 @@ class WorkerRoutes:
 
         manager = get_worker_manager()
         try:
-            info = await _run_blocking(
+            info = await run_blocking(
                 manager.launch_worker, worker, self.server.config_path
             )
         except Exception as exc:  # noqa: BLE001 - reported to client
@@ -128,7 +130,7 @@ class WorkerRoutes:
         from ..workers import get_worker_manager
 
         manager = get_worker_manager()
-        stopped = await _run_blocking(
+        stopped = await run_blocking(
             manager.stop_worker, worker_id, self.server.config_path
         )
         return web.json_response({"status": "ok", "stopped": stopped})
@@ -153,7 +155,7 @@ class WorkerRoutes:
             return web.json_response({"error": "no such worker"}, status=404)
         from ..workers import get_worker_manager
 
-        cleared = await _run_blocking(
+        cleared = await run_blocking(
             get_worker_manager().clear_launching,
             worker_id,
             self.server.config_path,
@@ -177,7 +179,7 @@ class WorkerRoutes:
         path = worker_log_path(name)
         if not os.path.isfile(path):
             return web.json_response({"error": "no log"}, status=404)
-        lines = _tail_file(path, tail)
+        lines = await run_blocking(_tail_file, path, tail)
         return web.json_response({"name": name, "lines": lines})
 
     async def master_log(self, request: web.Request) -> web.Response:
@@ -218,7 +220,12 @@ class WorkerRoutes:
         candidates: list[str] = []
         try:
             hostname = socket.gethostname()
-            for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+            # getaddrinfo can hit DNS: resolve through the loop's
+            # executor so a slow resolver never stalls other requests
+            infos = await asyncio.get_running_loop().getaddrinfo(
+                hostname, None, family=socket.AF_INET
+            )
+            for info in infos:
                 addr = info[4][0]
                 if addr not in candidates:
                     candidates.append(addr)
@@ -285,7 +292,7 @@ class WorkerRoutes:
         try:
             from ..models.clip_bpe import get_bpe
 
-            info["clip_vocab_canonical"] = await _run_blocking(
+            info["clip_vocab_canonical"] = await run_blocking(
                 lambda: get_bpe().is_canonical
             )
         except Exception as exc:  # noqa: BLE001 - best effort
@@ -300,19 +307,13 @@ class WorkerRoutes:
 
             # actual tokenizer state, like the CLIP branch (and cached
             # like it — this endpoint is panel-polled)
-            info["t5_vocab_canonical"] = await _run_blocking(
+            info["t5_vocab_canonical"] = await run_blocking(
                 t5_vocab_canonical
             )
         except Exception as exc:  # noqa: BLE001 - best effort
             info["t5_vocab_canonical"] = None
             info["t5_vocab_error"] = str(exc)
         return web.json_response(info)
-
-
-async def _run_blocking(fn, *args):
-    import asyncio
-
-    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
 
 
 def _tail_file(path: str, n_lines: int) -> list[str]:

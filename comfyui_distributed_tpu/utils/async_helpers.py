@@ -33,6 +33,16 @@ def get_server_loop() -> Optional[asyncio.AbstractEventLoop]:
         return _server_loop
 
 
+async def run_blocking(fn, *args) -> Any:
+    """Run a blocking callable on the default executor from a coroutine.
+
+    The standard escape hatch for CDT001 (blocking-call-in-async): sync
+    file I/O, digests, DNS, etc. move off the serving loop through here
+    so route handlers never stall heartbeats and grants.
+    """
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+
 def run_async_in_server_loop(
     coroutine: Awaitable[Any], timeout: float | None = None
 ) -> Any:

@@ -1,0 +1,250 @@
+"""Registry of every ``CDT_*`` environment knob the codebase reads.
+
+This is the single source of truth that closes the loop between code,
+docs, and lint:
+
+- ``scripts/gen_config_docs.py`` renders it into ``docs/configuration.md``
+  (one row per knob: name, default, subsystem, effect);
+- cdt-lint checker **CDT005** statically cross-checks that every knob
+  read anywhere in the package appears here, that every entry here
+  appears in the generated doc, and that no entry is stale (declared
+  but never read).
+
+Keep entries alphabetical within their subsystem group; ``default`` is
+the *rendered* default (what an operator sees with the env var unset),
+as a string, matching the reading site's fallback.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class Knob(NamedTuple):
+    name: str
+    default: str
+    subsystem: str
+    effect: str
+
+
+KNOBS: tuple[Knob, ...] = (
+    # --- roles / process identity ---------------------------------------
+    Knob("CDT_IS_WORKER", "unset", "roles",
+         "Set on spawned worker processes; suppresses master-only startup "
+         "(auto-launch, signal cleanup) and flips `python -m` into worker mode."),
+    Knob("CDT_MASTER_PID", "unset", "roles",
+         "Master PID a worker watches; the worker exits when that process dies."),
+    Knob("CDT_HOST", "127.0.0.1", "roles",
+         "Bind address for the HTTP server (pass 0.0.0.0 to serve the LAN)."),
+    Knob("CDT_CLOUD", "unset", "roles",
+         "Forces cloud-worker detection on hosts whose metadata probe is ambiguous."),
+    # --- heartbeat / liveness -------------------------------------------
+    Knob("CDT_HEARTBEAT_INTERVAL", "5.0", "liveness",
+         "Seconds between worker heartbeats to the master job store."),
+    Knob("CDT_HEARTBEAT_TIMEOUT", "60.0", "liveness",
+         "Seconds without a heartbeat before a worker's tiles are requeued."),
+    Knob("CDT_COLLECTOR_WAIT_SLICES", "20", "liveness",
+         "The result collector waits in timeout/N slices so interrupts propagate fast."),
+    # --- payloads --------------------------------------------------------
+    Knob("CDT_MAX_PAYLOAD_SIZE", "52428800", "payloads",
+         "Maximum HTTP payload bytes accepted by the API (50 MB default)."),
+    Knob("CDT_MAX_BATCH", "20", "payloads",
+         "Maximum tiles per submit flush from a worker."),
+    Knob("CDT_MAX_AUDIO_PAYLOAD_BYTES", "268435456", "payloads",
+         "Maximum decoded audio payload bytes (256 MB default)."),
+    Knob("CDT_TILE_BATCH", "platform-aware (CPU 1, accelerators 8)", "payloads",
+         "Tiles diffused per scan step in the USDU compute core (MXU batch K); "
+         "1 is golden-exact, >1 is allclose."),
+    # --- orchestration ---------------------------------------------------
+    Knob("CDT_ORCHESTRATION_PROBE_CONCURRENCY", "8", "orchestration",
+         "Concurrent worker liveness probes during dispatch."),
+    Knob("CDT_ORCHESTRATION_PREP_CONCURRENCY", "4", "orchestration",
+         "Concurrent per-worker prompt preparations during dispatch."),
+    Knob("CDT_ORCHESTRATION_MEDIA_CONCURRENCY", "2", "orchestration",
+         "Concurrent media-sync uploads per dispatch."),
+    Knob("CDT_MEDIA_SYNC_TIMEOUT", "120.0", "orchestration",
+         "Per-file media sync upload timeout in seconds."),
+    Knob("CDT_PROBE_TIMEOUT", "5.0", "orchestration",
+         "Worker liveness probe timeout in seconds."),
+    Knob("CDT_DISPATCH_TIMEOUT", "30.0", "orchestration",
+         "Per-worker prompt dispatch timeout in seconds."),
+    Knob("CDT_REQUEST_RETRIES", "5", "orchestration",
+         "Retry attempts for idempotent master<->worker HTTP requests."),
+    Knob("CDT_REQUEST_BACKOFF", "0.5", "orchestration",
+         "Base seconds for exponential retry backoff (with jitter)."),
+    Knob("CDT_WORK_PULL_RETRIES", "10", "orchestration",
+         "Worker-side retry attempts for tile pull requests."),
+    Knob("CDT_WORK_PULL_RETRY_CAP", "30.0", "orchestration",
+         "Ceiling in seconds on the pull-retry backoff."),
+    # --- resilience ------------------------------------------------------
+    Knob("CDT_CIRCUIT_SUSPECT_AFTER", "2", "resilience",
+         "Consecutive transport failures before a worker is marked suspect."),
+    Knob("CDT_CIRCUIT_FAILURES", "5", "resilience",
+         "Failure threshold that opens the circuit (quarantine + tile requeue)."),
+    Knob("CDT_CIRCUIT_COOLDOWN", "30.0", "resilience",
+         "Seconds a quarantined worker waits before a half-open probe."),
+    Knob("CDT_FAULT_PLAN", "unset", "resilience",
+         "Seeded fault-injection plan (e.g. `seed=3;latency(0.2)@request_image%0.5`) "
+         "wrapping HTTP transport and the job store; unset = no injection."),
+    Knob("CDT_DETERMINISTIC_BLEND", "unset", "resilience",
+         "`1` forces sorted-order deferred compositing so the blended canvas is "
+         "bit-identical regardless of tile arrival order (chaos harness sets it)."),
+    # --- watchdog --------------------------------------------------------
+    Knob("CDT_WATCHDOG", "1", "watchdog",
+         "`0` disables the server's background straggler/stall monitor thread."),
+    Knob("CDT_WATCHDOG_INTERVAL", "2.0", "watchdog",
+         "Seconds between watchdog evaluation steps."),
+    Knob("CDT_WATCHDOG_STRAGGLER_FACTOR", "4.0", "watchdog",
+         "A worker whose rolling median tile latency exceeds this multiple of the "
+         "global median is flagged suspect."),
+    Knob("CDT_WATCHDOG_MIN_SAMPLES", "3", "watchdog",
+         "Minimum completions in a worker's window before straggler verdicts."),
+    Knob("CDT_WATCHDOG_STALL_SECONDS", "30.0", "watchdog",
+         "A job quiet this long with tiles in flight triggers speculative re-dispatch."),
+    Knob("CDT_WATCHDOG_LATENCY_WINDOW", "64", "watchdog",
+         "Rolling latency window length per worker."),
+    # --- scheduler -------------------------------------------------------
+    Knob("CDT_SCHED_LANES", "interactive:64,batch:256,background:1024", "scheduler",
+         "Admission lanes in strict priority order as name:depth pairs; a full "
+         "lane answers HTTP 429 + Retry-After."),
+    Knob("CDT_SCHED_DEFAULT_LANE", "interactive", "scheduler",
+         "Lane used when a queue request names none."),
+    Knob("CDT_SCHED_MAX_ACTIVE", "4", "scheduler",
+         "Orchestrations allowed to run concurrently; the rest wait in lanes."),
+    Knob("CDT_SCHED_QUANTUM", "1.0", "scheduler",
+         "Deficit-round-robin quantum (cost units) added per tenant visit."),
+    Knob("CDT_SCHED_TENANT_WEIGHTS", "empty", "scheduler",
+         "Per-tenant DRR weights as `tenantA=3,tenantB=1`; unlisted tenants weigh 1."),
+    Knob("CDT_SCHED_GRANT_TIMEOUT", "120.0", "scheduler",
+         "Seconds the queue route parks a request awaiting its grant before 429."),
+    Knob("CDT_SCHED_EWMA_ALPHA", "0.25", "scheduler",
+         "Smoothing factor for per-worker tile-latency speed EWMAs."),
+    Knob("CDT_SCHED_MIN_SAMPLES", "2", "scheduler",
+         "Samples required before a worker's speed EWMA influences placement."),
+    Knob("CDT_SCHED_BASE_PULL_BATCH", "2", "scheduler",
+         "Pull grant size for a speed-1.0 worker."),
+    Knob("CDT_SCHED_MAX_PULL_BATCH", "8", "scheduler",
+         "Ceiling on speed-scaled pull grant sizes."),
+    Knob("CDT_SCHED_TAIL_TILES", "2", "scheduler",
+         "Within this many remaining tiles, suspect/slow workers are denied pulls."),
+    Knob("CDT_SCHED_TRIM_RATIO", "0.5", "scheduler",
+         "Workers slower than this fraction of fleet mean speed are trimmed "
+         "from the job tail."),
+    # --- tile pipeline ---------------------------------------------------
+    Knob("CDT_PIPELINE", "1", "pipeline",
+         "`0` replaces the staged tile pipeline with the serial per-tile loop."),
+    Knob("CDT_PIPELINE_DEPTH", "1", "pipeline",
+         "In-flight device batches the sampler may run ahead of the I/O stage."),
+    Knob("CDT_PIPELINE_PREFETCH", "1", "pipeline",
+         "`0` disables claiming the next grant while the device samples the "
+         "current one."),
+    Knob("CDT_WARM_COMPILE", "1", "pipeline",
+         "`0` skips AOT-compiling the steady-state tile bucket during the "
+         "worker's ready-poll window."),
+    Knob("CDT_COMPILE_CACHE_DIR", "./.cdt/compile_cache", "pipeline",
+         "Persistent XLA compilation cache directory; `0`/`off`/`none` disables."),
+    # --- telemetry -------------------------------------------------------
+    Knob("CDT_METRIC_MAX_SERIES", "128", "telemetry",
+         "Per-metric label-series cap; excess series collapse into `_overflow`."),
+    Knob("CDT_EVENT_QUEUE_SIZE", "512", "telemetry",
+         "Bounded per-subscriber queue for /distributed/events (drop-oldest)."),
+    Knob("CDT_TRACE_EXPORT_DIR", "unset", "telemetry",
+         "When set, each execution's span tree is exported as JSONL here."),
+    Knob("CDT_RUNTIME_DEVICE_STATS", "1", "telemetry",
+         "`0` disables the HBM/host-RSS scrape gauges."),
+    # --- jobs ------------------------------------------------------------
+    Knob("CDT_JOB_INIT_GRACE", "10.0", "jobs",
+         "Seconds result submission waits for the master-side queue to appear."),
+    Knob("CDT_JOB_READY_POLLS", "20", "jobs",
+         "Worker-side job-ready poll attempts before giving up."),
+    Knob("CDT_JOB_READY_POLL_INTERVAL", "1.0", "jobs",
+         "Seconds between worker-side job-ready polls."),
+    Knob("CDT_QUEUE_POLL_INTERVAL", "0.1", "jobs",
+         "Master collection-loop poll interval in seconds."),
+    # --- workers ---------------------------------------------------------
+    Knob("CDT_AUTO_LAUNCH_DELAY", "2.0", "workers",
+         "Delay before auto-launching configured local workers at startup."),
+    Knob("CDT_MONITOR_POLL_INTERVAL", "2.0", "workers",
+         "Master-liveness poll interval inside worker processes."),
+    Knob("CDT_LAUNCH_GRACE", "90.0", "workers",
+         "Seconds a launched worker gets to answer probes before being declared dead."),
+    Knob("CDT_LOG_DIR", "./logs/workers", "workers",
+         "Directory for per-worker stdout/stderr log files."),
+    # --- network ---------------------------------------------------------
+    Knob("CDT_MASTER_PORT", "8188", "network",
+         "Default master HTTP port."),
+    Knob("CDT_FIRST_WORKER_PORT", "8189", "network",
+         "First port assigned to auto-launched local workers."),
+    Knob("CDT_CONN_POOL_LIMIT", "100", "network",
+         "aiohttp connection pool total limit."),
+    Knob("CDT_CONN_POOL_PER_HOST", "30", "network",
+         "aiohttp connection pool per-host limit."),
+    Knob("CDT_CONFIG_PATH", "<package>/tpu_config.json", "network",
+         "Overrides the JSON config file location."),
+    # --- tunnel ----------------------------------------------------------
+    Knob("CDT_CLOUDFLARED_PATH", "unset", "tunnel",
+         "Path to the cloudflared binary for master tunnels."),
+    Knob("CDT_TUNNEL_AUTODOWNLOAD", "unset", "tunnel",
+         "`1` permits downloading cloudflared when no binary is found."),
+    Knob("CDT_TUNNEL_START_TIMEOUT", "30.0", "tunnel",
+         "Seconds to wait for the tunnel URL before giving up."),
+    # --- models ----------------------------------------------------------
+    Knob("CDT_CHECKPOINT_DIR", "unset", "models",
+         "Root directory (or direct file path) for model checkpoints "
+         "(`<name>.{safetensors,ckpt,gguf}`)."),
+    Knob("CDT_CLIP_VOCAB", "bundled asset dir", "models",
+         "Directory holding OpenAI CLIP vocab.json/merges.txt."),
+    Knob("CDT_T5_SPM", "unset", "models",
+         "Path to a sentencepiece model for real T5 tokenization; unset uses "
+         "the committed fallback vocab."),
+    Knob("CDT_LORA_DIR", "empty", "models",
+         "Root directory for LoRA adapter files."),
+    Knob("CDT_PARAMS_DTYPE", "empty", "models",
+         "`bfloat16` stores floating-point weights in bf16 (half HBM footprint)."),
+    # --- ops -------------------------------------------------------------
+    Knob("CDT_FLASH", "unset", "ops",
+         "`0` force-disables the Pallas flash-attention kernel."),
+    Knob("CDT_FLASH_BQ", "128", "ops",
+         "Flash-attention query block size (MXU-aligned)."),
+    Knob("CDT_FLASH_BK", "128", "ops",
+         "Flash-attention key block size (MXU-aligned)."),
+    Knob("CDT_BLEND", "unset", "ops",
+         "`segment` selects segment-sum canvas blending for large grids."),
+    # --- parallel --------------------------------------------------------
+    Knob("CDT_MULTIHOST", "unset", "parallel",
+         "`1` requires multihost initialization to succeed (hard error otherwise)."),
+    Knob("CDT_COORDINATOR", "unset", "parallel",
+         "host:port of process 0 for multihost JAX initialization."),
+    Knob("CDT_NUM_PROCESSES", "unset", "parallel",
+         "Total process count for multihost initialization."),
+    Knob("CDT_PROCESS_ID", "unset", "parallel",
+         "This process's index for multihost initialization."),
+    # --- graph I/O -------------------------------------------------------
+    Knob("CDT_DATA_DIR", "./data", "graph-io",
+         "Root data directory (inputs/outputs default beneath it)."),
+    Knob("CDT_INPUT_DIR", "<data>/input", "graph-io",
+         "Input image directory."),
+    Knob("CDT_OUTPUT_DIR", "<data>/output", "graph-io",
+         "Output image directory."),
+    Knob("CDT_WORKFLOW_DIR", "empty", "graph-io",
+         "Extra directory searched for workflow JSON files."),
+    # --- native ----------------------------------------------------------
+    Knob("CDT_NATIVE_BUILD_DIR", "<package>/native/build", "native",
+         "Build directory for the optional native extension."),
+    # --- tools -----------------------------------------------------------
+    Knob("CDT_DRYRUN_PLATFORM", "cpu", "tools",
+         "JAX platform forced by the graft-entry dry run."),
+    Knob("CDT_GOLDEN_ATOL", "0.001", "tools",
+         "Absolute tolerance for golden regeneration comparisons."),
+)
+
+
+def knob_names() -> set[str]:
+    return {knob.name for knob in KNOBS}
+
+
+def by_subsystem() -> dict[str, list[Knob]]:
+    grouped: dict[str, list[Knob]] = {}
+    for knob in KNOBS:
+        grouped.setdefault(knob.subsystem, []).append(knob)
+    return {sub: sorted(entries) for sub, entries in sorted(grouped.items())}

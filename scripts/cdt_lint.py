@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""cdt-lint CLI — project-specific static analysis gate.
+
+Usage:
+    python scripts/cdt_lint.py [PATHS...] [options]
+
+Options:
+    --format text|json   output format (default text; json is the CI artifact)
+    --baseline PATH      baseline file (default tools/cdtlint/baseline.json)
+    --no-baseline        ignore the baseline entirely (audit mode)
+    --update-baseline    rewrite the baseline from the current scan.
+                         Policy: shrink-only — refuses to *grow* the
+                         baseline unless --force is also given, and every
+                         new entry lands with a TODO justification that
+                         must be edited before commit.
+    --force              allow --update-baseline to add entries
+    --select CODES       comma-separated checker codes to run (e.g. CDT001,CDT004)
+    --list-checkers      print the checker catalogue and exit
+    --verbose            also print baselined and suppressed findings
+
+Exit codes:
+    0  clean (no unbaselined findings, no stale baseline entries)
+    1  findings present / stale baseline entries / parse errors
+    2  usage or internal error
+
+Suppressions: `# cdt: noqa[CDT00X]` on the offending line (bare
+`# cdt: noqa` suppresses every checker on that line). See
+docs/static-analysis.md for the policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.cdtlint import all_checkers  # noqa: E402
+from tools.cdtlint.baseline import DEFAULT_BASELINE_PATH, Baseline  # noqa: E402
+from tools.cdtlint.runner import (  # noqa: E402
+    DEFAULT_SCAN_PATHS,
+    compute_fingerprints,
+    render_text,
+    run_lint,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cdt_lint", description="project-specific static analysis gate"
+    )
+    parser.add_argument("paths", nargs="*", help=f"scan roots (default: {DEFAULT_SCAN_PATHS})")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=os.path.join(_REPO_ROOT, DEFAULT_BASELINE_PATH))
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--update-baseline", action="store_true")
+    parser.add_argument("--force", action="store_true")
+    parser.add_argument("--select", default=None)
+    parser.add_argument("--list-checkers", action="store_true")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_checkers:
+        for info in all_checkers().values():
+            print(f"{info.code}  {info.name:<24} [{info.scope}]  {info.description}")
+        return 0
+
+    try:
+        baseline = (
+            Baseline(path=args.baseline)
+            if args.no_baseline
+            else Baseline.load(args.baseline)
+        )
+    except (ValueError, json.JSONDecodeError) as exc:
+        print(f"cdt-lint: bad baseline: {exc}", file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        unknown = select - set(all_checkers())
+        if unknown:
+            print(f"cdt-lint: unknown checker code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    result = run_lint(
+        _REPO_ROOT,
+        paths=args.paths or None,
+        baseline=baseline,
+        select=select,
+    )
+
+    if args.update_baseline:
+        new_entries = compute_fingerprints(
+            _REPO_ROOT, result.findings, already_baselined=result.baselined
+        )
+        kept = {
+            fp: entry for fp, entry in baseline.entries.items() if fp not in result.stale_baseline
+        }
+        if new_entries and not args.force:
+            print(
+                f"cdt-lint: refusing to add {len(new_entries)} new baseline entr(y/ies) "
+                "without --force (baseline policy is shrink-only); fix the findings instead",
+                file=sys.stderr,
+            )
+            return 2
+        baseline.entries = {**kept, **new_entries}
+        baseline.save()
+        print(
+            f"cdt-lint: baseline rewritten: {len(baseline.entries)} entr(y/ies) "
+            f"({len(new_entries)} added, {len(result.stale_baseline)} stale removed)"
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.as_json(), indent=2))
+    else:
+        print(render_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Generate docs/configuration.md from the env-knob registry.
+
+The registry (``comfyui_distributed_tpu/utils/knob_registry.py``) is
+the single source of truth; this script renders it. cdt-lint CDT005
+statically enforces that every knob read in code is declared there and
+that the generated doc is in sync, so a new knob lands as: read it in
+code -> add a Knob(...) entry -> run this script -> commit both.
+
+Usage:
+    python scripts/gen_config_docs.py            # rewrite docs/configuration.md
+    python scripts/gen_config_docs.py --check    # exit 1 if the doc is stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from comfyui_distributed_tpu.utils.knob_registry import KNOBS, by_subsystem  # noqa: E402
+
+DOC_PATH = os.path.join(_REPO_ROOT, "docs", "configuration.md")
+
+_SUBSYSTEM_TITLES = {
+    "roles": "Roles & process identity",
+    "liveness": "Heartbeat & liveness",
+    "payloads": "Payloads & batching",
+    "orchestration": "Orchestration & retries",
+    "resilience": "Resilience & fault injection",
+    "watchdog": "Watchdog",
+    "scheduler": "Scheduler control plane",
+    "pipeline": "Tile pipeline & compile cache",
+    "telemetry": "Telemetry",
+    "jobs": "Job store",
+    "workers": "Worker lifecycle",
+    "network": "Network & config",
+    "tunnel": "Tunnel",
+    "models": "Models",
+    "ops": "Ops / kernels",
+    "parallel": "Multihost parallelism",
+    "graph-io": "Graph I/O directories",
+    "native": "Native extension",
+    "tools": "Tools & scripts",
+}
+
+
+def render() -> str:
+    lines = [
+        "# Configuration knobs",
+        "",
+        "<!-- GENERATED FILE - do not edit by hand. -->",
+        "<!-- Source: comfyui_distributed_tpu/utils/knob_registry.py -->",
+        "<!-- Regenerate: python scripts/gen_config_docs.py -->",
+        "",
+        f"Every `CDT_*` environment variable the codebase reads — {len(KNOBS)} knobs.",
+        "Each can be set before launching the master or a worker; none require a",
+        "code change. Static analysis (cdt-lint `CDT005`, see",
+        "[static-analysis.md](static-analysis.md)) fails CI when a knob is read in",
+        "code but missing here, so this table is complete by construction.",
+        "",
+    ]
+    for subsystem, knobs in by_subsystem().items():
+        lines.append(f"## {_SUBSYSTEM_TITLES.get(subsystem, subsystem)}")
+        lines.append("")
+        lines.append("| Knob | Default | Effect |")
+        lines.append("|---|---|---|")
+        for knob in knobs:
+            lines.append(f"| `{knob.name}` | `{knob.default}` | {knob.effect} |")
+        lines.append("")
+    lines.append("See also: [operator-runbook.md](operator-runbook.md) for triage")
+    lines.append("recipes that tune these, and [observability.md](observability.md)")
+    lines.append("for the metric and event surface they influence.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true", help="verify the doc is current")
+    args = parser.parse_args(argv)
+
+    content = render()
+    if args.check:
+        try:
+            with open(DOC_PATH, "r", encoding="utf-8") as fh:
+                current = fh.read()
+        except OSError:
+            current = ""
+        if current != content:
+            print(
+                "docs/configuration.md is stale; run `python scripts/gen_config_docs.py`",
+                file=sys.stderr,
+            )
+            return 1
+        print("docs/configuration.md is current")
+        return 0
+
+    with open(DOC_PATH, "w", encoding="utf-8") as fh:
+        fh.write(content)
+    print(f"wrote {os.path.relpath(DOC_PATH, _REPO_ROOT)} ({len(KNOBS)} knobs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

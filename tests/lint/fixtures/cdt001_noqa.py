@@ -1,0 +1,12 @@
+"""CDT001 suppressed: inline noqa acknowledges a deliberate block."""
+
+import time
+
+
+async def deliberate_blocking_probe():
+    # sub-millisecond by construction; measured, documented, accepted
+    time.sleep(0.0005)  # cdt: noqa[CDT001]
+
+
+async def blanket_suppressed():
+    time.sleep(0.0005)  # cdt: noqa

@@ -1,0 +1,37 @@
+"""CDT001 true negatives: the sanctioned async patterns."""
+
+import asyncio
+import threading
+import time
+from asyncio import sleep
+
+_lock = threading.Lock()
+
+
+async def sleeps_async():
+    await asyncio.sleep(1.0)  # asyncio.sleep is fine
+    await sleep(0.1)  # `from asyncio import sleep` resolves harmless
+
+
+async def executor_wrapped_lock():
+    loop = asyncio.get_running_loop()
+    # passing the bound method UNCALLED is the sanctioned pattern
+    await loop.run_in_executor(None, _lock.acquire)
+    try:
+        pass
+    finally:
+        _lock.release()
+
+
+async def executor_wrapped_io(path):
+    def _read() -> bytes:
+        # nested sync def runs off-loop: open/time.sleep here are fine
+        time.sleep(0.0)
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    return await asyncio.get_running_loop().run_in_executor(None, _read)
+
+
+def sync_caller_may_block():
+    time.sleep(0.0)  # not async: out of scope for CDT001

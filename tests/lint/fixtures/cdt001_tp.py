@@ -1,0 +1,34 @@
+"""CDT001 true positives: blocking calls lexically inside async defs."""
+
+import subprocess
+import threading
+import time
+
+import requests
+
+_lock = threading.Lock()
+
+
+async def sleeps_on_loop():
+    time.sleep(1.0)  # finding: time.sleep
+
+
+async def sync_http():
+    return requests.get("http://example.com")  # finding: requests.get
+
+
+async def shells_out():
+    subprocess.run(["true"])  # finding: subprocess.run
+
+
+async def grabs_lock():
+    _lock.acquire()  # finding: threading lock acquire
+    try:
+        pass
+    finally:
+        _lock.release()
+
+
+async def reads_file(path):
+    with open(path) as fh:  # finding: sync open
+        return fh.read()

@@ -1,0 +1,30 @@
+"""CDT002 true negatives: correct lock usage on both sides."""
+
+import asyncio
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._tlock = threading.Lock()
+        self._alock = asyncio.Lock()
+
+    async def asyncio_lock_across_await(self, session):
+        async with self._alock:  # asyncio lock may span awaits
+            return await session.get("/state")
+
+    async def threading_lock_no_await(self):
+        with self._tlock:  # held for a pure-sync critical section: fine
+            return dict(x=1)
+
+    def sync_threading_lock(self):
+        with self._tlock:
+            return 1
+
+    def sync_probe(self):
+        return self._alock.locked()  # read-only probe is exempt
+
+
+async def plain_context_manager(span):
+    with span("stage"):  # not a lock: never flagged
+        await asyncio.sleep(0)

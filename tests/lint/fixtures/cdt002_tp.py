@@ -1,0 +1,31 @@
+"""CDT002 true positives: lock discipline violations."""
+
+import asyncio
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._tlock = threading.Lock()
+        self._alock = asyncio.Lock()
+
+    async def held_across_await(self, session):
+        with self._tlock:  # finding: threading lock held across await
+            data = await session.get("/state")
+            return data
+
+    def sync_with_on_asyncio_lock(self):
+        with self._alock:  # finding: sync `with` on asyncio lock
+            return 1
+
+    def sync_acquire_on_asyncio_lock(self):
+        self._alock.acquire()  # finding: un-awaited coroutine
+        return 2
+
+
+_module_tlock = threading.Lock()
+
+
+async def module_lock_across_await(fetch):
+    with _module_tlock:  # finding: threading lock held across await
+        return await fetch()

@@ -1,0 +1,11 @@
+"""CDT003 suppressed: deliberate trace-time constant bake."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bakes_a_table(x):
+    # the table is module-constant by design; baking it is the point
+    table = np.asarray([1.0, 2.0, 4.0])  # cdt: noqa[CDT003]
+    return x * table[0]

@@ -1,0 +1,39 @@
+"""CDT003 true negatives: sanctioned trace-time patterns."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("sigmas_t", "cfg"))
+def static_args_are_concrete(x, sigmas_t, cfg):
+    # concretizing STATIC parameters is the hoist-a-constant idiom
+    last = float(sigmas_t[-1])
+    return x * last * float(cfg)
+
+
+def make_processor(cfg):
+    @jax.jit
+    def process(x, key):
+        # closure constants are concrete at trace time
+        scale = float(cfg)
+        noise = jax.random.normal(key, x.shape)
+        return jnp.tanh(x * scale) + noise
+
+    return process
+
+
+@jax.jit
+def debug_print_is_fine(x):
+    jax.debug.print("x={x}", x=x)
+    return jnp.sum(x)
+
+
+def untraced_host_code(x):
+    # not traced: host sync, wall clock, numpy all fine here
+    arr = np.asarray(x)
+    _ = time.time()
+    return float(arr.sum())

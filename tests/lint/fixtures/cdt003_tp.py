@@ -1,0 +1,40 @@
+"""CDT003 true positives: host-sync / entropy inside traced functions."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_jit(x):
+    return np.asarray(x)  # finding: host sync
+
+
+@partial(jax.jit, static_argnames=("n",))
+def concretizes_traced_param(x, n):
+    scale = float(x)  # finding: x is a traced (non-static) parameter
+    return scale * n
+
+
+@jax.jit
+def syncs_and_prints(x):
+    print("tracing", x)  # finding: print runs once at trace time
+    y = x.block_until_ready()  # finding: host sync
+    return y.item()  # finding: concretizes
+
+
+@jax.jit
+def python_entropy(x):
+    jitter = random.random()  # finding: Python RNG freezes at trace time
+    stamp = time.time()  # finding: wall clock freezes at trace time
+    return x + jitter + stamp
+
+
+def referenced_by_vmap(x):
+    return x.tolist()  # finding: traced via jax.vmap(referenced_by_vmap)
+
+
+batched = jax.vmap(referenced_by_vmap)

@@ -1,0 +1,13 @@
+"""CDT004 suppressed: order-insensitive aggregation, justified inline.
+
+Tests mount this at a DETERMINISM_PATHS location before linting.
+"""
+
+
+def count_members(done_tiles):
+    total = 0
+    # membership counting is order-insensitive: iteration order cannot
+    # affect the integer result
+    for _ in done_tiles | {0}:  # cdt: noqa[CDT004]
+        total += 1
+    return total

@@ -1,0 +1,25 @@
+"""CDT004 true negatives: sorted iteration and explicit keys."""
+
+import glob
+import os
+
+
+def blend_sorted(done_tiles, canvas, results):
+    for idx in sorted(done_tiles | {0}):  # sorted set: deterministic
+        canvas += results[idx]
+    return canvas
+
+
+def enumerate_sorted_listing(path):
+    return [
+        (i, name)
+        for i, name in enumerate(sorted(os.listdir(path)))  # sorted listing
+    ] + sorted(glob.glob("*.png"))
+
+
+def list_iteration(tiles):
+    return [t * 2 for t in tiles]  # plain list: ordering well-defined
+
+
+def explicit_key_entropy(key, fold_in, tile_idx):
+    return fold_in(key, tile_idx)  # explicit deterministic key derivation

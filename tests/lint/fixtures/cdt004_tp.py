@@ -1,0 +1,37 @@
+"""CDT004 true positives: ordering/entropy hazards.
+
+Tests mount this at a DETERMINISM_PATHS location before linting.
+"""
+
+import glob
+import os
+import random
+
+
+def blend_in_arrival_order(done_tiles, canvas, results):
+    for idx in done_tiles | {0}:  # finding: set iteration unsorted
+        canvas += results[idx]
+    return canvas
+
+
+def iterate_set_literal():
+    return [x for x in {3, 1, 2}]  # finding: set literal in comprehension
+
+
+def list_dir_unsorted(path):
+    out = []
+    for name in os.listdir(path):  # finding: readdir order
+        out.append(name)
+    out.extend(glob.glob("*.png"))  # finding: glob order
+    return out
+
+
+def ambient_entropy(grid):
+    jitter = random.random()  # finding: global RNG
+    return jitter * len(grid)
+
+
+def clock_seed(fold_in, key):
+    import time
+
+    return fold_in(key, time.time())  # finding: wall clock as seed material

@@ -1,0 +1,20 @@
+"""CDT005 fixture: code reading knobs + declaring metrics.
+
+Mounted into a synthetic project tree by the tests; the companion
+registry fixture declares CDT_FIXTURE_DOCUMENTED (documented) and
+CDT_FIXTURE_STALE (read by nobody).
+"""
+
+import os
+
+DOCUMENTED = os.environ.get("CDT_FIXTURE_DOCUMENTED", "1")
+MISSING = os.environ.get("CDT_FIXTURE_UNDECLARED")  # finding: not in registry
+
+
+def declare_metrics(registry):
+    ok_counter = registry.counter("cdt_fixture_events_total", "fine")
+    ok_gauge = registry.gauge("cdt_fixture_depth", "fine")
+    bad_prefix = registry.counter("fixture_events_total", "finding: prefix")
+    bad_counter = registry.counter("cdt_fixture_events", "finding: no _total")
+    bad_gauge = registry.gauge("cdt_fixture_depth_total", "finding: gauge _total")
+    return ok_counter, ok_gauge, bad_prefix, bad_counter, bad_gauge

@@ -1,0 +1,5 @@
+"""CDT005 fixture: suppressed undeclared knob read (migration window)."""
+
+import os
+
+TRANSITIONAL = os.environ.get("CDT_FIXTURE_TRANSITIONAL")  # cdt: noqa[CDT005]

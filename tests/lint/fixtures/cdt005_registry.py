@@ -1,0 +1,16 @@
+"""CDT005 fixture registry (mounted as utils/knob_registry.py)."""
+
+from typing import NamedTuple
+
+
+class Knob(NamedTuple):
+    name: str
+    default: str
+    subsystem: str
+    effect: str
+
+
+KNOBS = (
+    Knob("CDT_FIXTURE_DOCUMENTED", "1", "fixtures", "a documented, read knob"),
+    Knob("CDT_FIXTURE_STALE", "0", "fixtures", "declared but read by nobody"),
+)

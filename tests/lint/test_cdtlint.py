@@ -1,0 +1,373 @@
+"""cdt-lint checker tests: per-checker fixture TP/TN/noqa coverage plus
+the baseline-drift gate (a fresh scan of the repo must match the
+committed baseline — new findings or stale entries fail tier-1)."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tools.cdtlint import Baseline, all_checkers, run_lint
+from tools.cdtlint.baseline import DEFAULT_BASELINE_PATH
+from tools.cdtlint.core import parse_noqa
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+# CDT004 only fires on the determinism-sensitive module list, so its
+# fixtures mount at one of those paths inside the synthetic tree.
+DETERMINISM_MOUNT = "comfyui_distributed_tpu/ops/tiles.py"
+
+
+def lint_fixture(tmp_path, mapping: dict[str, str], select: set[str]):
+    """Copy fixture files into a synthetic tree and lint it."""
+    for dest, fixture in mapping.items():
+        target = tmp_path / dest
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(FIXTURES, fixture), target)
+    return run_lint(str(tmp_path), paths=sorted(mapping), select=select)
+
+
+# --------------------------------------------------------------------------
+# CDT001 blocking-call-in-async
+# --------------------------------------------------------------------------
+
+def test_cdt001_true_positives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt001_tp.py"}, {"CDT001"})
+    assert all(f.code == "CDT001" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    assert "time.sleep" in messages
+    assert "requests.get" in messages
+    assert "subprocess.run" in messages
+    assert ".acquire()" in messages
+    assert "`open(...)`" in messages
+    assert len(result.findings) == 5
+
+
+def test_cdt001_true_negatives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt001_tn.py"}, {"CDT001"})
+    assert result.findings == []
+
+
+def test_cdt001_noqa_suppression(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt001_noqa.py"}, {"CDT001"})
+    assert result.findings == []
+    assert len(result.suppressed) == 2
+
+
+# --------------------------------------------------------------------------
+# CDT002 lock-discipline
+# --------------------------------------------------------------------------
+
+def test_cdt002_true_positives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt002_tp.py"}, {"CDT002"})
+    assert all(f.code == "CDT002" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    assert "held across `await`" in messages
+    assert "sync `with" in messages
+    assert ".acquire()" in messages
+    assert len(result.findings) == 4
+
+
+def test_cdt002_true_negatives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt002_tn.py"}, {"CDT002"})
+    assert result.findings == []
+
+
+def test_cdt002_noqa_suppression(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt002_noqa.py"}, {"CDT002"})
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+# --------------------------------------------------------------------------
+# CDT003 jax-tracing-hygiene
+# --------------------------------------------------------------------------
+
+def test_cdt003_true_positives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt003_tp.py"}, {"CDT003"})
+    assert all(f.code == "CDT003" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    assert "np.asarray" in messages
+    assert "traced parameter" in messages  # float() on non-static param
+    assert "print" in messages
+    assert "block_until_ready" in messages
+    assert "*.item" in messages
+    assert "random.random" in messages
+    assert "time.time" in messages
+    assert "*.tolist" in messages  # via jax.vmap(referenced_by_vmap)
+    assert len(result.findings) == 8
+
+
+def test_cdt003_true_negatives(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt003_tn.py"}, {"CDT003"})
+    assert result.findings == []
+
+
+def test_cdt003_noqa_suppression(tmp_path):
+    result = lint_fixture(tmp_path, {"pkg/mod.py": "cdt003_noqa.py"}, {"CDT003"})
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+# --------------------------------------------------------------------------
+# CDT004 determinism
+# --------------------------------------------------------------------------
+
+def test_cdt004_true_positives(tmp_path):
+    result = lint_fixture(tmp_path, {DETERMINISM_MOUNT: "cdt004_tp.py"}, {"CDT004"})
+    assert all(f.code == "CDT004" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    assert "iterates a set" in messages
+    assert "os.listdir" in messages
+    assert "glob.glob" in messages
+    assert "global RNG" in messages
+    assert "wall-clock" in messages
+    assert len(result.findings) == 6
+
+
+def test_cdt004_outside_sensitive_modules_is_silent(tmp_path):
+    # same hazards mounted OUTSIDE the determinism module list: no findings
+    result = lint_fixture(tmp_path, {"pkg/free_module.py": "cdt004_tp.py"}, {"CDT004"})
+    assert result.findings == []
+
+
+def test_cdt004_true_negatives(tmp_path):
+    result = lint_fixture(tmp_path, {DETERMINISM_MOUNT: "cdt004_tn.py"}, {"CDT004"})
+    assert result.findings == []
+
+
+def test_cdt004_noqa_suppression(tmp_path):
+    result = lint_fixture(tmp_path, {DETERMINISM_MOUNT: "cdt004_noqa.py"}, {"CDT004"})
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+# --------------------------------------------------------------------------
+# CDT005 registry-consistency (project-level)
+# --------------------------------------------------------------------------
+
+def _mount_cdt005(tmp_path, with_doc: bool = True, extra: dict[str, str] | None = None):
+    mapping = {
+        "comfyui_distributed_tpu/utils/knob_registry.py": "cdt005_registry.py",
+        "comfyui_distributed_tpu/mod.py": "cdt005_code.py",
+    }
+    mapping.update(extra or {})
+    if with_doc:
+        doc = tmp_path / "docs" / "configuration.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text("| `CDT_FIXTURE_DOCUMENTED` | `1` | a knob |\n")
+    return lint_fixture(tmp_path, mapping, {"CDT005"})
+
+
+def test_cdt005_true_positives(tmp_path):
+    result = _mount_cdt005(tmp_path)
+    assert all(f.code == "CDT005" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    # undeclared read, stale declaration, three metric-name violations
+    assert "CDT_FIXTURE_UNDECLARED" in messages
+    assert "CDT_FIXTURE_STALE" in messages
+    assert "`fixture_events_total`" in messages  # missing cdt_ prefix
+    assert "`cdt_fixture_events`" in messages  # counter without _total
+    assert "`cdt_fixture_depth_total`" in messages  # gauge with _total
+    assert len(result.findings) == 5
+
+
+def test_cdt005_true_negative_documented_knob(tmp_path):
+    result = _mount_cdt005(tmp_path)
+    # the declared+read+documented knob produces no finding
+    assert "CDT_FIXTURE_DOCUMENTED" not in "\n".join(f.message for f in result.findings)
+
+
+def test_cdt005_missing_doc_is_a_finding(tmp_path):
+    result = _mount_cdt005(tmp_path, with_doc=False)
+    assert any("does not exist" in f.message for f in result.findings)
+
+
+def test_cdt005_noqa_suppression(tmp_path):
+    result = _mount_cdt005(
+        tmp_path, extra={"comfyui_distributed_tpu/transitional.py": "cdt005_noqa.py"}
+    )
+    assert any("CDT_FIXTURE_TRANSITIONAL" in f.message for f in result.suppressed)
+    assert not any("CDT_FIXTURE_TRANSITIONAL" in f.message for f in result.findings)
+
+
+# --------------------------------------------------------------------------
+# framework: noqa parsing, baseline drift, CLI
+# --------------------------------------------------------------------------
+
+def test_parse_noqa_forms():
+    lines = [
+        "x = 1  # cdt: noqa",
+        "y = 2  # cdt: noqa[CDT001]",
+        "z = 3  # cdt: noqa[CDT001, CDT004]",
+        "w = 4  # unrelated comment",
+        "v = 5  # noqa (plain ruff-style noqa is NOT a cdt suppression)",
+    ]
+    parsed = parse_noqa(lines)
+    assert parsed[1] is None  # blanket
+    assert parsed[2] == frozenset({"CDT001"})
+    assert parsed[3] == frozenset({"CDT001", "CDT004"})
+    assert 4 not in parsed
+    assert 5 not in parsed
+
+
+def test_every_checker_registered_has_fixture_coverage():
+    codes = set(all_checkers())
+    assert codes == {"CDT001", "CDT002", "CDT003", "CDT004", "CDT005"}
+    for code in codes:
+        n = code[-3:].lstrip("0")
+        named = [f for f in os.listdir(FIXTURES) if f.startswith(f"cdt00{n}")]
+        assert named, f"no fixtures for {code}"
+
+
+def test_committed_baseline_matches_fresh_scan():
+    """Drift gate: the repo must lint clean against the committed
+    baseline — any new finding, stale entry, or parse error fails."""
+    baseline = Baseline.load(os.path.join(REPO_ROOT, DEFAULT_BASELINE_PATH))
+    result = run_lint(REPO_ROOT, baseline=baseline)
+    assert result.parse_errors == []
+    assert result.stale_baseline == []
+    assert [f.render() for f in result.findings] == []
+    # every grandfathered entry must carry a real justification
+    for fp, entry in baseline.entries.items():
+        assert entry.get("justification") and "TODO" not in entry["justification"], (
+            f"baseline entry {fp} ({entry.get('code')} at {entry.get('path')}) "
+            "has no justification"
+        )
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "cdt_lint.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+
+
+def test_cli_clean_run_exits_zero():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_format():
+    proc = _run_cli("--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert report["files_scanned"] > 100
+
+
+def test_cli_list_checkers():
+    proc = _run_cli("--list-checkers")
+    assert proc.returncode == 0
+    for code in ("CDT001", "CDT002", "CDT003", "CDT004", "CDT005"):
+        assert code in proc.stdout
+
+
+def test_cli_findings_exit_one_and_update_baseline_policy(tmp_path):
+    fixture_rel = os.path.join("tests", "lint", "fixtures", "cdt001_tp.py")
+    empty = tmp_path / "baseline.json"
+    # findings without a baseline: exit 1
+    proc = _run_cli(fixture_rel, "--select", "CDT001", "--baseline", str(empty))
+    assert proc.returncode == 1
+    assert "CDT001" in proc.stdout
+    # shrink-only policy: --update-baseline refuses to grow without --force
+    proc = _run_cli(
+        fixture_rel, "--select", "CDT001", "--baseline", str(empty), "--update-baseline"
+    )
+    assert proc.returncode == 2
+    assert "refusing" in proc.stderr
+    # --force writes it; the subsequent scan is green against it
+    proc = _run_cli(
+        fixture_rel, "--select", "CDT001", "--baseline", str(empty),
+        "--update-baseline", "--force",
+    )
+    assert proc.returncode == 0
+    data = json.loads(empty.read_text())
+    assert len(data["entries"]) == 5
+    proc = _run_cli(fixture_rel, "--select", "CDT001", "--baseline", str(empty))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_partial_scan_does_not_report_out_of_scope_baseline_as_stale(tmp_path):
+    """A scan restricted to a subset of paths/checkers must not flag
+    baseline entries it could never have re-produced as stale."""
+    for name in ("a.py", "b.py"):
+        target = tmp_path / "pkg" / name
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(FIXTURES, "cdt001_tp.py"), target)
+    # baseline everything in b.py
+    full = run_lint(str(tmp_path), paths=["pkg/b.py"], select={"CDT001"})
+    baseline = Baseline(path=str(tmp_path / "baseline.json"))
+    from tools.cdtlint.runner import compute_fingerprints
+
+    baseline.entries = compute_fingerprints(str(tmp_path), full.findings)
+    # path-scoped scan of a.py only: b.py's entries are out of scope, not stale
+    partial = run_lint(
+        str(tmp_path), paths=["pkg/a.py"], baseline=baseline, select={"CDT001"}
+    )
+    assert partial.stale_baseline == []
+    # checker-scoped scan: CDT001 entries out of scope for a CDT004-only run
+    other = run_lint(
+        str(tmp_path), paths=["pkg/a.py", "pkg/b.py"], baseline=baseline,
+        select={"CDT004"},
+    )
+    assert other.stale_baseline == []
+    # full-scope scan with everything intact: nothing stale either
+    intact = run_lint(
+        str(tmp_path), paths=["pkg/b.py"], baseline=baseline, select={"CDT001"}
+    )
+    assert intact.stale_baseline == [] and intact.findings == []
+
+
+def test_update_baseline_converges_with_duplicate_offending_lines(tmp_path):
+    """A new finding on a line textually identical to an already
+    baselined one must fingerprint at the next occurrence index, so
+    baseline + rescan converges to green instead of colliding."""
+    from tools.cdtlint.runner import compute_fingerprints
+
+    target = tmp_path / "pkg" / "mod.py"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    first = run_lint(str(tmp_path), paths=["pkg/mod.py"], select={"CDT001"})
+    baseline = Baseline(path=str(tmp_path / "baseline.json"))
+    baseline.entries = compute_fingerprints(str(tmp_path), first.findings)
+    assert len(baseline.entries) == 1
+    # add a second, textually identical offending line
+    target.write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n    time.sleep(1)\n"
+    )
+    second = run_lint(
+        str(tmp_path), paths=["pkg/mod.py"], baseline=baseline, select={"CDT001"}
+    )
+    assert len(second.baselined) == 1 and len(second.findings) == 1
+    new_entries = compute_fingerprints(
+        str(tmp_path), second.findings, already_baselined=second.baselined
+    )
+    assert set(new_entries).isdisjoint(baseline.entries)  # no collision
+    baseline.entries.update(new_entries)
+    third = run_lint(
+        str(tmp_path), paths=["pkg/mod.py"], baseline=baseline, select={"CDT001"}
+    )
+    assert third.findings == [] and third.stale_baseline == []
+
+
+def test_config_docs_generator_check_mode():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "gen_config_docs.py"), "--check"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,47 @@
+"""cdt-lint: project-specific static analysis for comfyui-distributed-tpu.
+
+Stdlib-``ast``-based (zero dependencies, mirroring the telemetry
+subsystem's ethos) checkers that enforce the concurrency, determinism,
+and JAX-tracing invariants the serving stack's correctness rests on:
+
+- CDT001 blocking-call-in-async: no event-loop blocking calls lexically
+  inside ``async def`` bodies.
+- CDT002 lock-discipline: ``threading.Lock`` never held across an
+  ``await``; ``asyncio.Lock`` never touched from sync code.
+- CDT003 jax-tracing-hygiene: no host-sync / Python-entropy operations
+  reachable inside jit/vmap-traced functions.
+- CDT004 determinism: no unsorted set / filesystem iteration or
+  wall-clock seed material in the modules backing the bit-identical
+  canvas guarantee.
+- CDT005 registry-consistency: every ``CDT_*`` env knob read in code is
+  declared in the knob registry and documented; ``cdt_*`` metric names
+  follow the declared conventions.
+
+Suppression: append ``# cdt: noqa[CDT00X]`` (or a bare ``# cdt: noqa``)
+to the offending line. Grandfathered findings live in
+``tools/cdtlint/baseline.json`` with an inline justification each; the
+CI gate fails on any finding that is neither suppressed nor baselined,
+and on stale baseline entries (so the baseline can only shrink).
+
+See docs/static-analysis.md for the checker catalogue and policy.
+"""
+
+from .core import Finding, Severity, FileContext, ProjectContext  # noqa: F401
+from .registry import all_checkers, checker, project_checker  # noqa: F401
+from .runner import DEFAULT_SCAN_PATHS, run_lint, LintResult  # noqa: F401
+from .baseline import Baseline, fingerprint  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Severity",
+    "FileContext",
+    "ProjectContext",
+    "all_checkers",
+    "checker",
+    "project_checker",
+    "run_lint",
+    "LintResult",
+    "Baseline",
+    "fingerprint",
+    "DEFAULT_SCAN_PATHS",
+]

@@ -1,0 +1,69 @@
+"""Baseline file: grandfathered findings, keyed by content fingerprint.
+
+Fingerprints deliberately exclude the line *number* (pure formatting
+moves must not churn the baseline) and include an occurrence index (two
+identical offending lines in one file baseline independently). Format:
+
+    {
+      "version": 1,
+      "entries": {
+        "<fingerprint>": {
+          "code": "CDT001", "path": "...", "line": 12,
+          "text": "<stripped source line>",
+          "justification": "why this is grandfathered rather than fixed"
+        }
+      }
+    }
+
+Policy (docs/static-analysis.md): the baseline may only shrink. The
+runner reports *stale* entries (fingerprints a fresh scan no longer
+produces) as failures so fixed findings must be removed from the file,
+and ``--update-baseline`` refuses to grow it unless forced.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_PATH = os.path.join("tools", "cdtlint", "baseline.json")
+
+
+def fingerprint(finding: Finding, line_text: str, occurrence: int) -> str:
+    payload = "\x1f".join(
+        [finding.path, finding.code, line_text.strip(), str(occurrence)]
+    )
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclass
+class Baseline:
+    path: str = DEFAULT_BASELINE_PATH
+    entries: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"baseline {path} has version {data.get('version')!r}; "
+                f"expected {BASELINE_VERSION}"
+            )
+        return cls(path=path, entries=dict(data.get("entries", {})))
+
+    def save(self) -> None:
+        data = {"version": BASELINE_VERSION, "entries": dict(sorted(self.entries.items()))}
+        with open(self.path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    def __contains__(self, fp: str) -> bool:
+        return fp in self.entries

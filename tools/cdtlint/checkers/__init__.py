@@ -1,0 +1,7 @@
+"""Import every checker module so decorators populate the registry."""
+
+from . import blocking_async  # noqa: F401  CDT001
+from . import lock_discipline  # noqa: F401  CDT002
+from . import tracing_hygiene  # noqa: F401  CDT003
+from . import determinism  # noqa: F401  CDT004
+from . import registry_consistency  # noqa: F401  CDT005

@@ -1,0 +1,143 @@
+"""CDT001: blocking calls lexically inside ``async def`` bodies.
+
+The serving stack is a single asyncio loop per process; one
+``time.sleep`` / sync HTTP request / ``threading.Lock.acquire`` in a
+coroutine stalls every job, heartbeat, and WebSocket on that loop. The
+sanctioned pattern is executor-wrapping (see
+``utils/config.config_transaction``: ``await
+loop.run_in_executor(None, _txn_lock.acquire)``) — which passes the
+callable *uncalled* and therefore does not trip this checker.
+
+Nested synchronous ``def``s inside a coroutine are exempt: they are
+routinely handed to ``run_in_executor`` / ``asyncio.to_thread`` and run
+off-loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FileContext,
+    Finding,
+    Severity,
+    call_name,
+    collect_lock_names,
+    lock_ref_name,
+    walk_scope,
+)
+from ..registry import checker
+
+# Dotted call names that block the calling thread. Matched against the
+# lexically-resolved name, so aliased imports (``from time import
+# sleep``) are matched via the bare-name entries too.
+BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "blocks the event loop; use `await asyncio.sleep(...)`",
+    "requests.get": "sync HTTP on the event loop; use the shared aiohttp session",
+    "requests.post": "sync HTTP on the event loop; use the shared aiohttp session",
+    "requests.put": "sync HTTP on the event loop; use the shared aiohttp session",
+    "requests.delete": "sync HTTP on the event loop; use the shared aiohttp session",
+    "requests.head": "sync HTTP on the event loop; use the shared aiohttp session",
+    "requests.request": "sync HTTP on the event loop; use the shared aiohttp session",
+    "urllib.request.urlopen": "sync HTTP on the event loop; use the shared aiohttp session",
+    "subprocess.run": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "subprocess.call": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "os.system": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "os.popen": "blocks until the child exits; use `asyncio.create_subprocess_exec`",
+    "socket.create_connection": "sync connect on the event loop; use loop.sock_connect / aiohttp",
+    "socket.getaddrinfo": "sync DNS on the event loop; use loop.getaddrinfo",
+    "shutil.copyfile": "sync bulk file I/O on the event loop; executor-wrap it",
+    "shutil.copytree": "sync bulk file I/O on the event loop; executor-wrap it",
+    "shutil.rmtree": "sync bulk file I/O on the event loop; executor-wrap it",
+    "open": "sync file I/O on the event loop; move the open/read/write into an "
+    "executor-wrapped sync helper",
+}
+
+# Path-style bulk I/O method names (receiver type is unresolvable
+# statically; these names are only used on pathlib.Path objects in this
+# codebase, so a method-name match is a finding).
+BLOCKING_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+
+
+def _iter_async_defs(tree: ast.Module) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _from_import_aliases(tree: ast.Module) -> dict[str, str]:
+    """``from time import sleep as zzz`` -> {"zzz": "time.sleep"} so
+    bare-name calls of blocking functions resolve to their dotted form
+    (and ``from asyncio import sleep`` resolves to the *harmless*
+    ``asyncio.sleep``, not a false positive)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return aliases
+
+
+@checker(
+    "CDT001",
+    "blocking-call-in-async",
+    "event-loop-blocking call (sleep / sync HTTP / subprocess / lock acquire) inside `async def`",
+)
+def check_blocking_async(ctx: FileContext) -> Iterator[Finding]:
+    threading_locks, _ = collect_lock_names(ctx.tree)
+    aliases = _from_import_aliases(ctx.tree)
+    for fn in _iter_async_defs(ctx.tree):
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in aliases:
+                name = aliases[name]
+            if name in BLOCKING_CALLS:
+                yield Finding(
+                    code="CDT001",
+                    message=f"`{name}(...)` in `async def {fn.name}`: {BLOCKING_CALLS[name]}",
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in BLOCKING_METHODS
+            ):
+                yield Finding(
+                    code="CDT001",
+                    message=(
+                        f"`.{node.func.attr}(...)` in `async def {fn.name}`: sync file "
+                        "I/O on the event loop; executor-wrap it"
+                    ),
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )
+                continue
+            # <threading lock>.acquire() called (not merely referenced)
+            # on the loop. Passing the bound method to an executor is
+            # an Attribute load, not a Call, and stays clean.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                and lock_ref_name(node.func.value) in threading_locks
+            ):
+                yield Finding(
+                    code="CDT001",
+                    message=(
+                        f"threading lock `.acquire()` in `async def {fn.name}` blocks the "
+                        "event loop; `await loop.run_in_executor(None, lock.acquire)` instead"
+                    ),
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )

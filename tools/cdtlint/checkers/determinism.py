@@ -1,0 +1,216 @@
+"""CDT004: ordering / entropy hygiene in bit-identical-guarantee modules.
+
+The chaos harness asserts the blended canvas is *bit-identical* no
+matter which worker produced which tile in which order. That guarantee
+dies quietly the moment an ordering-sensitive module iterates a ``set``
+(arrival-ordered float blends differ in the last ulp), lists a
+directory in readdir order, or derives seed material from the wall
+clock. This checker runs only on the modules that back the guarantee
+(see ``DETERMINISM_PATHS``) so the rest of the codebase can use sets
+freely.
+
+Checks:
+
+- iterating a set expression (literal, ``set(...)``/``frozenset(...)``
+  call, set comprehension, set-algebra binop, or a local name assigned
+  one) in a ``for`` / comprehension without wrapping it in ``sorted()``;
+- ``os.listdir`` / ``os.scandir`` / ``glob.glob`` / ``Path.iterdir`` /
+  ``.glob()`` results consumed without ``sorted()``;
+- Python global-RNG entropy (``random.random()``, bare
+  ``random.seed()``, ``np.random.*``) — all randomness here must flow
+  from explicit, threaded ``jax.random`` keys;
+- wall-clock values (``time.time()``, ``datetime.now()``) passed to
+  seed/key-deriving calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterator, Optional
+
+from ..core import FileContext, Finding, Severity, call_name, dotted_name, imported_modules
+from ..registry import checker
+
+# The modules whose ordering backs the bit-identical canvas guarantee.
+# Additions here are deliberate API: widening the net is a reviewed
+# change, not a config knob.
+DETERMINISM_PATHS = (
+    "comfyui_distributed_tpu/ops/tiles.py",
+    "comfyui_distributed_tpu/ops/upscale.py",
+    "comfyui_distributed_tpu/graph/tile_pipeline.py",
+    "comfyui_distributed_tpu/graph/usdu_elastic.py",
+    "comfyui_distributed_tpu/jobs/store.py",
+    "comfyui_distributed_tpu/resilience/chaos.py",
+)
+
+_LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
+_LISTING_METHODS = {"iterdir", "glob", "rglob"}
+
+_GLOBAL_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_SEEDY_CALL_FRAGMENTS = ("seed", "fold_in", "prngkey", "key")
+_WALL_CLOCK_CALLS = {"time.time", "time.time_ns", "datetime.now", "datetime.datetime.now"}
+
+
+def applies_to(path: str) -> bool:
+    return any(fnmatch.fnmatch(path, pat) for pat in DETERMINISM_PATHS)
+
+
+def _is_set_expr(node: ast.AST, local_sets: set[str]) -> bool:
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call) and call_name(node) in {"set", "frozenset"}:
+        return True
+    if isinstance(node, ast.Name) and node.id in local_sets:
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        # set algebra: either side syntactically a set taints the result
+        return _is_set_expr(node.left, local_sets) or _is_set_expr(node.right, local_sets)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in {"union", "intersection", "difference", "symmetric_difference"}:
+            return _is_set_expr(node.func.value, local_sets)
+    return False
+
+
+def _collect_local_sets(tree: ast.Module) -> set[str]:
+    """Names assigned a syntactic set anywhere in the file. Coarse on
+    purpose: one module, one meaning per name is the local style."""
+    names: set[str] = set()
+    non_set_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                if _is_set_expr(node.value, set()):
+                    names.add(target.id)
+                else:
+                    non_set_names.add(target.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            ann = node.annotation
+            ann_name = dotted_name(ann) if not isinstance(ann, ast.Subscript) else (
+                dotted_name(ann.value)
+            )
+            if ann_name in {"set", "Set", "typing.Set", "frozenset"}:
+                names.add(node.target.id)
+    # a name rebound to something non-set anywhere is ambiguous: drop it
+    return names - non_set_names
+
+
+def _iteration_targets(tree: ast.Module) -> Iterator[tuple[ast.AST, str]]:
+    """(iterable-expression, context-label) pairs for for-loops and
+    comprehensions."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield node.iter, "for loop"
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter, "comprehension"
+
+
+def _unwrap_enumerate(expr: ast.AST) -> ast.AST:
+    if isinstance(expr, ast.Call) and call_name(expr) in {"enumerate", "reversed", "list", "tuple"}:
+        if expr.args:
+            return _unwrap_enumerate(expr.args[0])
+    return expr
+
+
+@checker(
+    "CDT004",
+    "determinism",
+    "unsorted set/filesystem iteration and wall-clock seed material in "
+    "bit-identical-guarantee modules",
+)
+def check_determinism(ctx: FileContext) -> Iterator[Finding]:
+    if not applies_to(ctx.path):
+        return
+    local_sets = _collect_local_sets(ctx.tree)
+    # `random.*` only means the stdlib global RNG when the file itself
+    # does `import random` (a `from jax import random` alias must not
+    # false-positive on fold_in/PRNGKey).
+    has_stdlib_random = "random" in imported_modules(ctx.tree)
+    # every node lexically inside any `sorted(...)` call, computed once
+    sorted_interior: set[int] = set()
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) and call_name(n) == "sorted":
+            for inner in ast.walk(n):
+                sorted_interior.add(id(inner))
+
+    for iter_expr, label in _iteration_targets(ctx.tree):
+        expr = _unwrap_enumerate(iter_expr)
+        if isinstance(expr, ast.Call) and call_name(expr) == "sorted":
+            continue
+        if _is_set_expr(expr, local_sets):
+            yield Finding(
+                code="CDT004",
+                message=(
+                    f"{label} iterates a set without `sorted()`: iteration order is "
+                    "hash-seed dependent and breaks the bit-identical blend order"
+                ),
+                path=ctx.path,
+                line=iter_expr.lineno,
+                col=iter_expr.col_offset,
+                severity=Severity.ERROR,
+            )
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        # directory listings must be consumed through sorted(...)
+        is_listing = name in _LISTING_CALLS or (
+            isinstance(node.func, ast.Attribute) and node.func.attr in _LISTING_METHODS
+        )
+        if is_listing:
+            if id(node) not in sorted_interior:
+                yield Finding(
+                    code="CDT004",
+                    message=(
+                        f"`{name or node.func.attr}(...)` result used without `sorted()`: "
+                        "filesystem enumeration order is platform-dependent"
+                    ),
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )
+            continue
+        # Python global RNG
+        if (
+            name
+            and name.startswith(_GLOBAL_RNG_PREFIXES)
+            and (has_stdlib_random or not name.startswith("random."))
+            and not name.startswith(
+                ("random.Random", "np.random.Generator", "numpy.random.Generator",
+                 "np.random.default_rng", "numpy.random.default_rng")
+            )
+        ):
+            yield Finding(
+                code="CDT004",
+                message=(
+                    f"`{name}(...)` uses ambient global RNG state; all entropy in this "
+                    "module must flow from explicit jax.random keys"
+                ),
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                severity=Severity.ERROR,
+            )
+            continue
+        # wall clock as seed material
+        callee = (name or "").lower()
+        if any(frag in callee for frag in _SEEDY_CALL_FRAGMENTS):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Call) and call_name(arg) in _WALL_CLOCK_CALLS:
+                    yield Finding(
+                        code="CDT004",
+                        message=(
+                            f"wall-clock value fed to `{name}(...)`: seed material must "
+                            "be deterministic, not time-derived"
+                        ),
+                        path=ctx.path,
+                        line=arg.lineno,
+                        col=arg.col_offset,
+                        severity=Severity.ERROR,
+                    )

@@ -1,0 +1,135 @@
+"""CDT002: lock discipline across the thread/event-loop boundary.
+
+Two hazard shapes, both live in this codebase's mixed asyncio +
+worker-thread architecture (~20 lock sites across scheduler / jobs /
+resilience / telemetry):
+
+1. A ``threading.Lock`` held across an ``await``: while the coroutine
+   is suspended the lock stays held, so any *thread* contending for it
+   blocks for an unbounded number of loop iterations — and if a
+   same-loop coroutine contends, the loop deadlocks outright.
+
+2. An ``asyncio.Lock`` (or Condition/Semaphore) touched from a sync
+   function: ``with lock:`` / ``lock.acquire()`` without ``await``
+   either raises at runtime or silently creates an un-awaited
+   coroutine; asyncio primitives also bind to whichever loop first
+   awaits them (the exact trap ``utils/config.py`` documents dodging).
+
+Lock identity is resolved lexically per file via
+:func:`~tools.cdtlint.core.collect_lock_names` — a name must be
+*assigned* a lock factory somewhere in the file to participate, so
+plain context managers (spans, fault scopes) never false-positive.
+``.locked()`` probes are read-only and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (
+    FileContext,
+    Finding,
+    Severity,
+    collect_lock_names,
+    lock_ref_name,
+    walk_scope,
+)
+from ..registry import checker
+
+_READONLY_METHODS = {"locked"}
+
+
+def _with_item_lock(item: ast.withitem, lock_names: set[str]) -> Optional[str]:
+    expr = item.context_expr
+    # `with lock:` or `with self._lock:`
+    name = lock_ref_name(expr)
+    if name in lock_names:
+        return name
+    return None
+
+
+def _contains_await(body: list[ast.stmt]) -> Optional[ast.AST]:
+    for stmt in body:
+        for node in walk_scope(stmt, skip_nested_functions=True):
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                return node
+        if isinstance(stmt, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return stmt
+    return None
+
+
+@checker(
+    "CDT002",
+    "lock-discipline",
+    "threading.Lock held across `await`; asyncio.Lock touched from sync code",
+)
+def check_lock_discipline(ctx: FileContext) -> Iterator[Finding]:
+    threading_locks, asyncio_locks = collect_lock_names(ctx.tree)
+    if not threading_locks and not asyncio_locks:
+        return
+
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, ast.AsyncFunctionDef):
+            # hazard 1: sync `with <threading lock>:` whose body awaits
+            for node in walk_scope(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    lock = _with_item_lock(item, threading_locks)
+                    if lock is None:
+                        continue
+                    awaited = _contains_await(node.body)
+                    if awaited is not None:
+                        yield Finding(
+                            code="CDT002",
+                            message=(
+                                f"threading lock `{lock}` held across `await` in "
+                                f"`async def {fn.name}` (suspension point at line "
+                                f"{getattr(awaited, 'lineno', '?')}); release before "
+                                "awaiting, or use an asyncio.Lock owned by this loop"
+                            ),
+                            path=ctx.path,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            severity=Severity.ERROR,
+                        )
+        elif isinstance(fn, ast.FunctionDef):
+            # hazard 2: asyncio primitives from sync code
+            for node in walk_scope(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lock = _with_item_lock(item, asyncio_locks)
+                        if lock is not None:
+                            yield Finding(
+                                code="CDT002",
+                                message=(
+                                    f"sync `with {lock}:` on an asyncio lock in "
+                                    f"`def {fn.name}`; asyncio locks require "
+                                    "`async with` from a coroutine on their owning loop"
+                                ),
+                                path=ctx.path,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                severity=Severity.ERROR,
+                            )
+                elif isinstance(node, ast.Call):
+                    func = node.func
+                    if (
+                        isinstance(func, ast.Attribute)
+                        and func.attr not in _READONLY_METHODS
+                        and func.attr in {"acquire", "release", "notify", "notify_all", "wait"}
+                        and lock_ref_name(func.value) in asyncio_locks
+                    ):
+                        yield Finding(
+                            code="CDT002",
+                            message=(
+                                f"asyncio lock `.{func.attr}()` from sync "
+                                f"`def {fn.name}`; only coroutines on the owning loop "
+                                "may touch asyncio synchronization primitives"
+                            ),
+                            path=ctx.path,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            severity=Severity.ERROR,
+                        )

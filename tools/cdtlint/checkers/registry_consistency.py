@@ -1,0 +1,185 @@
+"""CDT005: env-knob registry + metric naming consistency (project-wide).
+
+Every ``CDT_*`` environment knob the code reads must be
+
+1. declared in the knob registry
+   (``comfyui_distributed_tpu/utils/knob_registry.py``) with a default,
+   subsystem, and one-line effect, and
+2. documented in the generated ``docs/configuration.md``
+   (``python scripts/gen_config_docs.py`` regenerates it).
+
+Registry entries no code reads are flagged as stale so the registry
+tracks reality in both directions. Knob *reads* are detected as
+whole-string ``CDT_[A-Z0-9_]*`` constants anywhere in scanned code —
+this deliberately sees through env-access wrappers like
+``constants._env_float("CDT_X", ...)`` that a narrow
+``os.environ.get`` matcher would miss.
+
+Metric-name half: every ``registry.counter/gauge/histogram("name",
+...)`` literal must be snake_case with the ``cdt_`` prefix; counters
+end in ``_total`` and non-counters must not (the conventions
+tests/test_telemetry_metrics.py enforces at runtime, moved to lint
+time so a bad name fails before a scrape ever happens).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from ..core import Finding, ProjectContext, Severity, call_name
+from ..registry import project_checker
+
+KNOB_REGISTRY_PATH = "comfyui_distributed_tpu/utils/knob_registry.py"
+CONFIG_DOC_PATH = "docs/configuration.md"
+
+_KNOB_RE = re.compile(r"CDT_[A-Z][A-Z0-9_]*$")
+_DOC_KNOB_RE = re.compile(r"CDT_[A-Z][A-Z0-9_]*")
+_METRIC_NAME_RE = re.compile(r"^cdt_[a-z][a-z0-9_]*$")
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _iter_knob_reads(ctx) -> Iterator[tuple[str, int]]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _KNOB_RE.fullmatch(node.value)
+        ):
+            yield node.value, node.lineno
+
+
+def _registry_knobs(ctx) -> dict[str, int]:
+    """Knob name -> declaration line, parsed from Knob(...) calls."""
+    knobs: dict[str, int] = {}
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and call_name(node) == "Knob"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            knobs[node.args[0].value] = node.lineno
+    return knobs
+
+
+@project_checker(
+    "CDT005",
+    "registry-consistency",
+    "CDT_* env knobs must be declared in the knob registry and documented; "
+    "cdt_* metric names must follow the naming conventions",
+)
+def check_registry_consistency(project: ProjectContext) -> Iterator[Finding]:
+    registry_ctx = project.get(KNOB_REGISTRY_PATH)
+    if registry_ctx is None:
+        yield Finding(
+            code="CDT005",
+            message=f"knob registry {KNOB_REGISTRY_PATH} is missing from the scan set",
+            path=KNOB_REGISTRY_PATH,
+            line=1,
+            col=0,
+            severity=Severity.ERROR,
+        )
+        return
+    declared = _registry_knobs(registry_ctx)
+
+    doc_path = os.path.join(project.root, CONFIG_DOC_PATH)
+    documented: set[str] = set()
+    doc_exists = os.path.exists(doc_path)
+    if doc_exists:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            documented = set(_DOC_KNOB_RE.findall(fh.read()))
+    else:
+        yield Finding(
+            code="CDT005",
+            message=(
+                f"{CONFIG_DOC_PATH} does not exist; run `python scripts/gen_config_docs.py`"
+            ),
+            path=KNOB_REGISTRY_PATH,
+            line=1,
+            col=0,
+            severity=Severity.ERROR,
+        )
+
+    read_sites: dict[str, tuple[str, int]] = {}
+    for ctx in project.files:
+        if ctx.path == KNOB_REGISTRY_PATH:
+            continue
+        for knob, lineno in _iter_knob_reads(ctx):
+            read_sites.setdefault(knob, (ctx.path, lineno))
+
+    for knob in sorted(read_sites):
+        path, lineno = read_sites[knob]
+        if knob not in declared:
+            yield Finding(
+                code="CDT005",
+                message=(
+                    f"env knob `{knob}` is read here but not declared in "
+                    f"{KNOB_REGISTRY_PATH}; add a Knob(...) entry and regenerate "
+                    f"{CONFIG_DOC_PATH}"
+                ),
+                path=path,
+                line=lineno,
+                col=0,
+                severity=Severity.ERROR,
+            )
+        elif doc_exists and knob not in documented:
+            yield Finding(
+                code="CDT005",
+                message=(
+                    f"env knob `{knob}` is declared but missing from {CONFIG_DOC_PATH}; "
+                    "run `python scripts/gen_config_docs.py`"
+                ),
+                path=KNOB_REGISTRY_PATH,
+                line=declared[knob],
+                col=0,
+                severity=Severity.ERROR,
+            )
+
+    for knob in sorted(set(declared) - set(read_sites)):
+        yield Finding(
+            code="CDT005",
+            message=(
+                f"registry entry `{knob}` is never read by scanned code; "
+                "remove the stale Knob(...) declaration"
+            ),
+            path=KNOB_REGISTRY_PATH,
+            line=declared[knob],
+            col=0,
+            severity=Severity.WARNING,
+        )
+
+    # ---- metric naming conventions --------------------------------------
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute) and func.attr in _METRIC_FACTORIES):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)):
+                continue
+            name = node.args[0].value
+            if not isinstance(name, str):
+                continue
+            kind = func.attr
+            problems: list[str] = []
+            if not _METRIC_NAME_RE.match(name):
+                problems.append("must be snake_case with the `cdt_` prefix")
+            if kind == "counter" and not name.endswith("_total"):
+                problems.append("counter names must end in `_total`")
+            if kind in {"gauge", "histogram"} and name.endswith("_total"):
+                problems.append(f"{kind} names must not end in `_total`")
+            for problem in problems:
+                yield Finding(
+                    code="CDT005",
+                    message=f"metric name `{name}` ({kind}): {problem}",
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )

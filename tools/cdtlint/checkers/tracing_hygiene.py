@@ -1,0 +1,217 @@
+"""CDT003: host-sync / Python-entropy operations inside traced code.
+
+``jax.jit`` / ``jax.vmap`` trace a function once with abstract values;
+anything that forces a concrete value (``.item()``, ``float()``,
+``np.asarray``, ``block_until_ready``) either crashes with a tracer
+error at first call or — worse — silently bakes a Python-side value
+into the compiled program (the tracer-leak class PR 2 fixed by hand in
+``ops/samplers.py``). Python ``random`` / wall-clock reads inside a
+traced function run once at trace time and freeze, breaking both
+correctness and the bit-identical-canvas guarantee.
+
+A function counts as *traced* when it is
+
+- decorated with ``jax.jit`` / ``jax.vmap`` / ``partial(jax.jit, ...)``
+  (any ``functools.partial`` whose first argument is a jit/vmap name), or
+- referenced by name as the first argument of a ``jax.jit(...)`` /
+  ``jax.vmap(...)`` call anywhere in the same file, or
+- a ``def`` or ``lambda`` nested inside a traced function.
+
+Escape hatches such as ``jax.debug.print`` / ``jax.debug.callback`` /
+``jax.pure_callback`` / ``io_callback`` are the sanctioned ways to
+reach the host and are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Union
+
+from ..core import FileContext, Finding, Severity, call_name, dotted_name, imported_modules
+from ..registry import checker
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# dotted call name -> why it's hostile inside a trace
+_HOST_SYNC_CALLS = {
+    "np.asarray": "forces device->host sync; use jnp inside traced code",
+    "np.array": "forces device->host sync; use jnp inside traced code",
+    "numpy.asarray": "forces device->host sync; use jnp inside traced code",
+    "numpy.array": "forces device->host sync; use jnp inside traced code",
+    "jax.device_get": "forces device->host sync inside a trace",
+    "time.time": "wall clock freezes at trace time; thread it in as an argument",
+    "time.monotonic": "wall clock freezes at trace time; thread it in as an argument",
+    "time.perf_counter": "wall clock freezes at trace time; thread it in as an argument",
+    "datetime.now": "wall clock freezes at trace time; thread it in as an argument",
+    "datetime.datetime.now": "wall clock freezes at trace time; thread it in as an argument",
+    "print": "runs once at trace time; use jax.debug.print",
+}
+
+_HOST_SYNC_METHODS = {
+    "item": "concretizes a tracer (host sync / tracer error)",
+    "tolist": "concretizes a tracer (host sync / tracer error)",
+    "block_until_ready": "host sync inside a trace",
+}
+
+_CONCRETIZING_BUILTINS = {"float", "int", "bool"}
+
+Traceable = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Decorator / call expressions that mean `this wraps into a trace`:
+    ``jax.jit``, ``jax.vmap``, ``partial(jax.jit, ...)``, and calls of
+    those (``partial(jax.jit, static_argnames=...)`` used as decorator)."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return dotted_name(node) in _JIT_WRAPPERS
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in _JIT_WRAPPERS:
+            return True
+        if fname in _PARTIAL_NAMES and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _collect_traced_names(tree: ast.Module) -> set[str]:
+    """Function names passed (directly or via partial) to jit/vmap calls."""
+    traced: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        target_args: list[ast.expr] = []
+        if fname in _JIT_WRAPPERS and node.args:
+            target_args.append(node.args[0])
+        elif fname in _PARTIAL_NAMES and len(node.args) >= 2 and _is_jit_expr(node.args[0]):
+            target_args.append(node.args[1])
+        for arg in target_args:
+            if isinstance(arg, ast.Name):
+                traced.add(arg.id)
+    return traced
+
+
+def _iter_traced_functions(tree: ast.Module) -> Iterator[Traceable]:
+    traced_names = _collect_traced_names(tree)
+    # Lambdas passed inline to jit/vmap are traced too.
+    inline_lambdas: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    inline_lambdas.add(id(arg))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                yield node
+            elif node.name in traced_names:
+                yield node
+        elif isinstance(node, ast.Lambda) and id(node) in inline_lambdas:
+            yield node
+
+
+def _static_argnames(fn: Traceable) -> set[str]:
+    """Names listed in ``static_argnames=(...)`` of a jit decorator on
+    ``fn``: those parameters are concrete Python values at trace time,
+    so concretizing them is sanctioned."""
+    static: set[str] = set()
+    decorators = fn.decorator_list if not isinstance(fn, ast.Lambda) else []
+    for dec in decorators:
+        if not (isinstance(dec, ast.Call) and _is_jit_expr(dec)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg in {"static_argnames", "static_argnums"} and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                for elt in kw.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        static.add(elt.value)
+    return static
+
+
+def _param_names(fn: Traceable) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _body_of(fn: Traceable) -> list[ast.AST]:
+    if isinstance(fn, ast.Lambda):
+        return [fn.body]
+    return list(fn.body)
+
+
+@checker(
+    "CDT003",
+    "jax-tracing-hygiene",
+    "host-sync ops and Python entropy inside jit/vmap-traced functions",
+)
+def check_tracing_hygiene(ctx: FileContext) -> Iterator[Finding]:
+    mods = imported_modules(ctx.tree)
+    python_random = "random" if "random" in mods else None
+
+    seen: set[int] = set()
+    for fn in _iter_traced_functions(ctx.tree):
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        fn_label = fn.name if not isinstance(fn, ast.Lambda) else "<lambda>"
+        # Traced (abstract) values enter through the non-static
+        # parameters; closure constants and static_argnames parameters
+        # are concrete at trace time, so float()/int() on them is the
+        # sanctioned hoist-a-constant pattern, not a tracer leak.
+        traced_params = _param_names(fn) - _static_argnames(fn)
+        stack: list[ast.AST] = _body_of(fn)
+        while stack:
+            node = stack.pop()
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            reason: Optional[str] = None
+            if name in _HOST_SYNC_CALLS:
+                reason = _HOST_SYNC_CALLS[name]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+            ):
+                name = f"*.{node.func.attr}"
+                reason = _HOST_SYNC_METHODS[node.func.attr]
+            elif (
+                name in _CONCRETIZING_BUILTINS
+                and node.args
+                and _root_name(node.args[0]) in traced_params
+            ):
+                reason = "concretizes a traced parameter (tracer error / silent constant-bake)"
+            elif (
+                python_random
+                and name
+                and name.startswith("random.")
+                and not name.startswith("random.fold_in")
+            ):
+                reason = (
+                    "Python RNG runs once at trace time and freezes; "
+                    "use jax.random with an explicit threaded key"
+                )
+            if reason:
+                yield Finding(
+                    code="CDT003",
+                    message=f"`{name}(...)` inside traced `{fn_label}`: {reason}",
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    severity=Severity.ERROR,
+                )

@@ -1,0 +1,201 @@
+"""Core datatypes and AST helpers shared by all cdt-lint checkers."""
+
+from __future__ import annotations
+
+import ast
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+class Severity(str, enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source line."""
+
+    code: str  # e.g. "CDT001"
+    message: str
+    path: str  # repo-root-relative, forward slashes
+    line: int  # 1-based
+    col: int  # 0-based, matches ast col_offset
+    severity: Severity = Severity.ERROR
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.code} [{self.severity}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "code": self.code,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": str(self.severity),
+        }
+
+
+# ``# cdt: noqa`` (blanket) or ``# cdt: noqa[CDT001]`` / ``[CDT001,CDT002]``
+_NOQA_RE = re.compile(r"#\s*cdt:\s*noqa(?:\[([A-Z0-9,\s]+)\])?", re.IGNORECASE)
+
+
+def parse_noqa(lines: list[str]) -> dict[int, Optional[frozenset[str]]]:
+    """Map 1-based line number -> suppressed codes (None = all codes)."""
+    out: dict[int, Optional[frozenset[str]]] = {}
+    for i, text in enumerate(lines, start=1):
+        if "noqa" not in text:
+            continue
+        m = _NOQA_RE.search(text)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = frozenset(c.strip().upper() for c in m.group(1).split(",") if c.strip())
+    return out
+
+
+@dataclass
+class FileContext:
+    """Parsed view of one source file handed to per-file checkers."""
+
+    path: str  # repo-root-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "FileContext":
+        tree = ast.parse(source)
+        return cls(path=path, source=source, tree=tree, lines=source.splitlines())
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+@dataclass
+class ProjectContext:
+    """Whole-scan view handed to project-level checkers (CDT005)."""
+
+    root: str  # absolute repo root
+    files: list[FileContext]
+
+    def get(self, path: str) -> Optional[FileContext]:
+        for ctx in self.files:
+            if ctx.path == path:
+                return ctx
+        return None
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` attribute/name chains; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+THREADING_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+
+ASYNCIO_LOCK_FACTORIES = {
+    "asyncio.Lock",
+    "asyncio.Condition",
+    "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+}
+
+
+def collect_lock_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """Names (bare or ``self.<attr>`` attr names) bound to lock factories.
+
+    Returns ``(threading_locks, asyncio_locks)``. Attribute assignments
+    record just the attribute name, so a later ``self._lock`` /
+    ``cls._lock`` / ``obj._lock`` use matches by attr. A name bound to
+    both kinds anywhere in the file is dropped from both sets rather
+    than guessed at.
+    """
+    threading_locks: set[str] = set()
+    asyncio_locks: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        factory = call_name(value)
+        if factory in THREADING_LOCK_FACTORIES:
+            dest = threading_locks
+        elif factory in ASYNCIO_LOCK_FACTORIES:
+            dest = asyncio_locks
+        else:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for target in targets:
+            if isinstance(target, ast.Name):
+                dest.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                dest.add(target.attr)
+    ambiguous = threading_locks & asyncio_locks
+    return threading_locks - ambiguous, asyncio_locks - ambiguous
+
+
+def lock_ref_name(node: ast.AST) -> Optional[str]:
+    """The comparable name for a lock reference: bare name or final attr."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def walk_scope(node: ast.AST, *, skip_nested_functions: bool = True) -> Iterator[ast.AST]:
+    """Walk ``node``'s body without descending into nested function
+    scopes (nested defs/lambdas run under their own rules — e.g. they
+    may be executor-submitted from an async def)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if skip_nested_functions and isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def imported_modules(tree: ast.Module) -> set[str]:
+    """Top-level module names imported as themselves (``import random``
+    -> {"random"}; ``import numpy as np`` -> {"np"} keyed by alias)."""
+    mods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mods.add(alias.asname or alias.name.split(".")[0])
+    return mods

@@ -1,0 +1,61 @@
+"""Checker registry: checkers self-register via decorators at import.
+
+Two kinds:
+
+- per-file checkers (``@checker``) get a :class:`FileContext` and yield
+  findings about that file in isolation;
+- project checkers (``@project_checker``) get the whole
+  :class:`ProjectContext` after every file parsed — for cross-file
+  invariants like the env-knob registry (CDT005).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from .core import FileContext, Finding, ProjectContext
+
+FileCheckFn = Callable[[FileContext], Iterable[Finding]]
+ProjectCheckFn = Callable[[ProjectContext], Iterable[Finding]]
+
+
+@dataclass(frozen=True)
+class CheckerInfo:
+    code: str
+    name: str
+    description: str
+    fn: Callable
+    scope: str  # "file" | "project"
+
+
+_CHECKERS: dict[str, CheckerInfo] = {}
+
+
+def _register(code: str, name: str, description: str, fn: Callable, scope: str) -> None:
+    if code in _CHECKERS:
+        raise ValueError(f"duplicate checker code {code}")
+    _CHECKERS[code] = CheckerInfo(code=code, name=name, description=description, fn=fn, scope=scope)
+
+
+def checker(code: str, name: str, description: str) -> Callable[[FileCheckFn], FileCheckFn]:
+    def deco(fn: FileCheckFn) -> FileCheckFn:
+        _register(code, name, description, fn, "file")
+        return fn
+
+    return deco
+
+
+def project_checker(code: str, name: str, description: str) -> Callable[[ProjectCheckFn], ProjectCheckFn]:
+    def deco(fn: ProjectCheckFn) -> ProjectCheckFn:
+        _register(code, name, description, fn, "project")
+        return fn
+
+    return deco
+
+
+def all_checkers() -> dict[str, CheckerInfo]:
+    # Import side effect populates the registry exactly once.
+    from . import checkers  # noqa: F401
+
+    return dict(sorted(_CHECKERS.items()))
