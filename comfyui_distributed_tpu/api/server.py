@@ -120,6 +120,20 @@ class DistributedServer:
                 sink(worker_id, seconds)
 
         self.job_store.latency_sink = _latency_fan_out
+        # Durable control plane (durability/): enabled by setting
+        # CDT_JOURNAL_DIR on a master. Construction is cheap and
+        # file-free; recovery + the write-ahead seam attach in start(),
+        # BEFORE the HTTP listener and executor thread exist, so no
+        # mutation can race the replay. Workers never journal — the
+        # master's store is the single source of coordination truth.
+        from ..durability import DurabilityManager, journal_dir_from_env
+
+        self.durability: Optional[DurabilityManager] = None
+        journal_dir = journal_dir_from_env()
+        if journal_dir and not self.is_worker:
+            self.durability = DurabilityManager(
+                journal_dir, scheduler=self.scheduler
+            )
         # Live-state gauge collectors are bound in start() — a server
         # constructed but never started must not leave a collector
         # (holding a strong reference to it) in the global registry.
@@ -335,6 +349,16 @@ class DistributedServer:
         """Start HTTP listener + executor thread on the running loop."""
         self.loop = asyncio.get_running_loop()
         set_server_loop(self.loop)
+        # Crash recovery FIRST: replay snapshot + WAL tail into the job
+        # store (in-flight tiles requeue, durable results restore),
+        # then attach the write-ahead seam so every transition from
+        # here on is journaled before it is acknowledged. Admission
+        # lanes come back PAUSED when jobs were recovered and resume on
+        # the first worker heartbeat (durability/recovery.py).
+        if self.durability is not None:
+            self.durability.recover(self.job_store, scheduler=self.scheduler)
+            self.job_store.journal_sink = self.durability.record
+            self.job_store.on_worker_seen = self.durability.note_worker_activity
         # Live-state gauges (queue depths, breaker states) are filled
         # at /distributed/metrics scrape time from this server.
         from ..telemetry import bind_server_collectors
@@ -369,6 +393,22 @@ class DistributedServer:
             await self._runner.cleanup()
         if self._executor_thread is not None:
             self._executor_thread.join(timeout=10)
+        # Journal LAST — after the HTTP listener is down and the
+        # executor has drained, so every transition acknowledged during
+        # shutdown (late worker RPCs, the in-flight prompt's cleanup)
+        # was journaled; detaching earlier would resurrect completed
+        # jobs as ghosts on the next boot. Off the loop (close joins
+        # the write-behind thread and may fsync) and non-fatal: a
+        # deferred write error must not abort shutdown.
+        if self.durability is not None:
+            self.job_store.journal_sink = None
+            self.job_store.on_worker_seen = None
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.durability.close
+                )
+            except Exception as exc:  # noqa: BLE001 - reported, not fatal
+                log(f"durability close failed during shutdown: {exc}")
         if self.loop is not None:
             set_server_loop(None)
 

@@ -4,6 +4,7 @@
     GET /distributed/trace/{trace_id}   — span tree JSON for one execution
     GET /distributed/traces             — paginated trace-id listing
     GET /distributed/events             — WebSocket live event stream
+    GET /distributed/durability         — WAL/snapshot/recovery status
 
 The metrics body is the process-global registry (counters/histograms
 pushed by the instrumented layers, live-state gauges filled at scrape
@@ -58,6 +59,7 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/trace/{trace_id}", routes.trace)
     app.router.add_get("/distributed/traces", routes.traces)
     app.router.add_get("/distributed/events", routes.events)
+    app.router.add_get("/distributed/durability", routes.durability)
 
 
 class TelemetryRoutes:
@@ -70,6 +72,18 @@ class TelemetryRoutes:
             body=body.encode("utf-8"),
             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
         )
+
+    async def durability(self, request: web.Request) -> web.Response:
+        """Durable-control-plane status: journal head/segments, last
+        snapshot lsn + age, post-recovery admission hold, and the last
+        recovery's report (docs/durability.md; runbook §4f reads this
+        first in a master-restart triage)."""
+        manager = getattr(self.server, "durability", None)
+        if manager is None:
+            return web.json_response(
+                {"enabled": False, "hint": "set CDT_JOURNAL_DIR to enable"}
+            )
+        return web.json_response(manager.status())
 
     async def trace(self, request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]

@@ -1,0 +1,32 @@
+"""Durable control plane (L2, zero-dependency).
+
+Write-ahead journal + snapshot + crash recovery under the JobStore and
+the scheduler, removing the master as the one component whose crash
+loses work. See docs/durability.md for the record schema, the
+rotation/compaction policy, and the recovery sequence.
+
+    journal.py   — append-only CRC32 WAL, segment rotation, torn-tail
+                   truncation on replay
+    state.py     — the journaled state machine (one apply_record
+                   shared by snapshot shadow and recovery replay)
+    snapshot.py  — atomic snapshot write + segment/snapshot pruning
+    recovery.py  — snapshot + WAL tail → live JobStore/scheduler
+    manager.py   — DurabilityManager: the JobStore's journal_sink
+"""
+
+from .journal import Journal, JournalCorruption, replay_journal
+from .manager import DurabilityManager, journal_dir_from_env
+from .recovery import RecoveryReport, recover, recover_state
+from .state import SnapshotVersionMismatch
+
+__all__ = [
+    "DurabilityManager",
+    "Journal",
+    "JournalCorruption",
+    "RecoveryReport",
+    "SnapshotVersionMismatch",
+    "journal_dir_from_env",
+    "recover",
+    "recover_state",
+    "replay_journal",
+]

@@ -1,0 +1,217 @@
+"""The journaled control-plane state machine.
+
+One pure ``apply_record`` shared by two consumers keeps them
+definitionally consistent:
+
+- the **snapshot shadow** — the DurabilityManager applies every record
+  it journals to an in-memory copy of this state, so a snapshot is a
+  serialization of exactly what the journal would replay to;
+- **recovery replay** — restart applies the WAL tail to the state
+  loaded from the newest snapshot.
+
+The state is plain JSON-able data (dicts/lists/strings/ints) so a
+snapshot round-trips losslessly; task ids inside ``completed`` are
+string-keyed for the same reason and normalized at materialize time.
+
+Record vocabulary (emitted by ``JobStore`` — docs/durability.md):
+
+    job_init    {job, kind, batched, tasks}
+    pull        {job, worker, tasks}
+    submit      {job, worker, task, payload}   payload null = volatile
+    requeue     {job, worker, tasks, reason}
+    speculate   {job, tasks}
+    worker_done {job, worker}
+    cleanup     {job}
+
+``prepare_for_restart`` is the recovery-time transform: in-flight
+assignments are revoked back to pending (the workers holding them died
+with — or were orphaned by — the old master), and completions whose
+payload was volatile (master-local blends that lived only in the dead
+process's canvas) are demoted to pending for recompute. Per-tile
+determinism (noise keys folding the global tile index) makes both
+recompute paths bit-identical to the uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotVersionMismatch(Exception):
+    """A snapshot written by an incompatible schema version: recovery
+    must stop loudly rather than misinterpret acknowledged state."""
+
+
+def new_state() -> dict[str, Any]:
+    return {"version": SNAPSHOT_VERSION, "last_lsn": 0, "jobs": {}, "scheduler": {}}
+
+
+def _new_job(kind: str, batched: bool, tasks: list[int]) -> dict[str, Any]:
+    return {
+        "kind": kind,
+        "batched": bool(batched),
+        "tasks": [int(t) for t in tasks],
+        "pending": [int(t) for t in tasks],
+        "assigned": {},  # worker -> [task ids] in claim order
+        "completed": {},  # str(task id) -> payload | None
+        "speculated": [],
+        "finished_workers": [],
+    }
+
+
+def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
+    """Apply one journal record. Unknown job references are ignored
+    (a record after its job's ``cleanup`` — e.g. a late release racing
+    teardown — is a no-op exactly as it is in the live store)."""
+    rtype = record.get("type")
+    jobs = state["jobs"]
+    lsn = int(record.get("lsn", 0))
+    if lsn:
+        state["last_lsn"] = max(int(state.get("last_lsn", 0)), lsn)
+    if rtype == "job_init":
+        job_id = str(record["job"])
+        if job_id not in jobs:
+            jobs[job_id] = _new_job(
+                str(record.get("kind", "tile")),
+                bool(record.get("batched", True)),
+                list(record.get("tasks", [])),
+            )
+        return
+    job = jobs.get(str(record.get("job", "")))
+    if rtype == "cleanup":
+        jobs.pop(str(record.get("job", "")), None)
+        return
+    if job is None:
+        return
+    if rtype == "pull":
+        worker = str(record["worker"])
+        claimed = job["assigned"].setdefault(worker, [])
+        for tid in record.get("tasks", []):
+            tid = int(tid)
+            if tid in job["pending"]:
+                job["pending"].remove(tid)
+            if tid not in claimed:
+                claimed.append(tid)
+    elif rtype == "submit":
+        worker = str(record.get("worker", ""))
+        tid = int(record["task"])
+        claimed = job["assigned"].get(worker)
+        if claimed and tid in claimed:
+            claimed.remove(tid)
+            if not claimed:
+                del job["assigned"][worker]
+        key = str(tid)
+        if key not in job["completed"]:  # first result wins, as in the store
+            job["completed"][key] = record.get("payload")
+    elif rtype == "requeue":
+        worker = str(record.get("worker", ""))
+        claimed = job["assigned"].get(worker, [])
+        for tid in record.get("tasks", []):
+            tid = int(tid)
+            if tid in claimed:
+                claimed.remove(tid)
+            if str(tid) not in job["completed"] and tid not in job["pending"]:
+                job["pending"].append(tid)
+        if worker in job["assigned"] and not job["assigned"][worker]:
+            del job["assigned"][worker]
+    elif rtype == "speculate":
+        for tid in record.get("tasks", []):
+            tid = int(tid)
+            if tid not in job["speculated"]:
+                job["speculated"].append(tid)
+            job["pending"].append(tid)  # a COPY rides next to the original
+    elif rtype == "worker_done":
+        worker = str(record["worker"])
+        if worker not in job["finished_workers"]:
+            job["finished_workers"].append(worker)
+    # unknown record types are ignored: a newer master may journal
+    # types an older reader doesn't know; they must not abort replay
+
+
+def replay_into(state: dict[str, Any], records: list[dict[str, Any]]) -> int:
+    """Apply records in order; returns how many were applied. Pure with
+    respect to the inputs (records are not mutated), so applying the
+    same (snapshot, records) twice yields identical states — the
+    idempotence property tests/test_durability.py enforces."""
+    for record in records:
+        apply_record(state, record)
+    return len(records)
+
+
+def prepare_for_restart(state: dict[str, Any]) -> dict[str, int]:
+    """Mutate a recovered state for a fresh master process; returns
+    counters for the recovery report.
+
+    - every in-flight assignment is revoked to pending (its worker's
+      connection to the dead master is gone; workers re-register via
+      heartbeat against the restarted process);
+    - completions with a durable payload are kept (the payload will be
+      re-enqueued for the new master's blender);
+    - volatile completions (payload null — master-local blends) are
+      demoted to pending for bit-identical recompute;
+    - speculation marks are cleared so the watchdog may speculate
+      afresh in the new process.
+
+    Requeue order is sorted for determinism (recovery must not depend
+    on the journal's interleaving of the dead process's races).
+    """
+    requeued = 0
+    restored = 0
+    for job_id in sorted(state["jobs"]):
+        job = state["jobs"][job_id]
+        back: set[int] = set()
+        for worker in sorted(job["assigned"]):
+            back.update(int(t) for t in job["assigned"][worker])
+        job["assigned"] = {}
+        durable: dict[str, Any] = {}
+        for key in sorted(job["completed"], key=int):
+            payload = job["completed"][key]
+            if payload is None:
+                back.add(int(key))
+            else:
+                durable[key] = payload
+                restored += 1
+        job["completed"] = durable
+        pending = [int(t) for t in job["pending"] if int(t) not in back]
+        already = set(pending)
+        additions = [
+            t for t in sorted(back) if t not in already and str(t) not in durable
+        ]
+        job["pending"] = pending + additions
+        job["speculated"] = []
+        requeued += len(additions)
+    return {"tasks_requeued": requeued, "tasks_restored": restored}
+
+
+def materialize(state: dict[str, Any]):
+    """Build live ``TileJob``/``ImageJob`` objects from a prepared
+    state: ``{job_id: job}`` ready to install into a ``JobStore``.
+    Durable completed payloads are re-enqueued on ``job.results`` so
+    the new master's drain loop blends them without recompute."""
+    from ..jobs.models import ImageJob, TileJob
+
+    out = {}
+    for job_id in sorted(state["jobs"]):
+        spec = state["jobs"][job_id]
+        cls = TileJob if spec.get("kind", "tile") == "tile" else ImageJob
+        job = cls(
+            job_id=job_id,
+            total_tasks=len(spec["tasks"]),
+            batched=bool(spec.get("batched", True)),
+        )
+        for tid in spec["pending"]:
+            job.pending.put_nowait(int(tid))
+        for key in sorted(spec["completed"], key=int):
+            payload = spec["completed"][key]
+            job.completed[int(key)] = payload
+            job.results.put_nowait((int(key), payload))
+        job.finished_workers = set(spec.get("finished_workers", []))
+        out[job_id] = job
+    return out
+
+
+def clone(state: dict[str, Any]) -> dict[str, Any]:
+    return copy.deepcopy(state)

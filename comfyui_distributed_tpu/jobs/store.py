@@ -21,7 +21,17 @@ Semantics from reference upscale/job_store.py + api/queue_orchestration.py:
 - an optional `FaultInjector` (resilience/faults.py) wraps pull /
   submit / heartbeat for deterministic chaos tests: `connect_error` /
   `crash` faults raise (the RPC "never arrived"), `drop` on a
-  heartbeat op silently skips recording it.
+  heartbeat op silently skips recording it;
+- every state transition (job init, pull, submit, requeue, release,
+  speculation, worker-done, cleanup) emits one typed record into the
+  optional `journal_sink` BEFORE the mutation is acknowledged — the
+  write-ahead seam the durable control plane (durability/) hangs off.
+  Emission happens under the store lock at the point the transition
+  commits, so a duplicate submission (the losing side of a speculative
+  race) journals NOTHING: exactly one authoritative completion per
+  task reaches the log, and replay reconstructs first-result-wins
+  exactly. A sink failure propagates — WAL discipline forbids
+  acknowledging state that was not made durable.
 """
 
 from __future__ import annotations
@@ -57,6 +67,19 @@ class JobStore:
         # (asyncio.run fallbacks on compute threads) wake safely.
         self._collector_waiters: dict[str, list[tuple[Any, Any]]] = {}
         self._tile_waiters: dict[str, list[tuple[Any, Any]]] = {}
+        # Write-ahead seam (durability/manager.DurabilityManager.record):
+        # called with one typed dict per committed state transition,
+        # before the transition is acknowledged. None = no journaling.
+        self.journal_sink: Optional[Callable[[dict[str, Any]], None]] = None
+        # Liveness side-channel (NOT journaled): fired on every recorded
+        # heartbeat so a recovered master can release its admission hold
+        # the moment a worker re-registers.
+        self.on_worker_seen: Optional[Callable[[str], None]] = None
+
+    def _journal(self, record: dict[str, Any]) -> None:
+        sink = self.journal_sink
+        if sink is not None:
+            sink(record)
 
     # --- fault injection --------------------------------------------------
 
@@ -77,6 +100,12 @@ class JobStore:
         if not self._heartbeat_dropped(worker_id):
             job.heartbeat(worker_id)
             instruments.store_heartbeats_total().inc(worker_id=worker_id)
+            seen = self.on_worker_seen
+            if seen is not None:
+                try:
+                    seen(worker_id)
+                except Exception as exc:  # noqa: BLE001 - liveness advisory
+                    debug_log(f"on_worker_seen({worker_id}) failed: {exc}")
 
     # --- creation signalling ----------------------------------------------
 
@@ -175,6 +204,15 @@ class JobStore:
                 return self.tile_jobs[job_id]
             cls = TileJob if kind == "tile" else ImageJob
             job = cls(job_id=job_id, total_tasks=len(task_ids), batched=batched)
+            self._journal(
+                {
+                    "type": "job_init",
+                    "job": job_id,
+                    "kind": kind,
+                    "batched": batched,
+                    "tasks": [int(t) for t in task_ids],
+                }
+            )
             for tid in task_ids:
                 job.pending.put_nowait(tid)
             self.tile_jobs[job_id] = job
@@ -207,8 +245,22 @@ class JobStore:
             debug_log(f"placement may_pull({worker_id}) failed: {exc}")
             return True
 
-    def _record_assignment_locked(self, job: TileJob, worker_id: str, task_id: int) -> None:
-        """Caller holds self.lock."""
+    def _record_assignment_locked(
+        self, job: TileJob, worker_id: str, task_id: int, journal: bool = True
+    ) -> None:
+        """Caller holds self.lock. ``journal=False`` lets a batched
+        pull claim several tasks and emit ONE `pull` record for the
+        whole grant (constant write-ahead cost per grant, not linear
+        in batch size)."""
+        if journal:
+            self._journal(
+                {
+                    "type": "pull",
+                    "job": job.job_id,
+                    "worker": worker_id,
+                    "tasks": [int(task_id)],
+                }
+            )
         job.assigned.setdefault(worker_id, set()).add(task_id)
         job.assigned_at[(worker_id, task_id)] = time.monotonic()
 
@@ -276,16 +328,32 @@ class JobStore:
             size = min(size, int(limit))
         if job is not None and size > 1:
             async with self.lock:
+                extra: list[int] = []
                 while len(tasks) < size:
                     try:
                         task_id = job.pending.get_nowait()
                     except asyncio.QueueEmpty:
                         break
-                    self._record_assignment_locked(job, worker_id, task_id)
+                    self._record_assignment_locked(
+                        job, worker_id, task_id, journal=False
+                    )
                     instruments.store_pulls_total().inc(
                         worker_id=worker_id, outcome="task"
                     )
                     tasks.append(task_id)
+                    extra.append(int(task_id))
+                if extra:
+                    # one record for the whole grant remainder, emitted
+                    # under the same lock the claims were made in —
+                    # still ahead of any acknowledgement
+                    self._journal(
+                        {
+                            "type": "pull",
+                            "job": job_id,
+                            "worker": worker_id,
+                            "tasks": extra,
+                        }
+                    )
         return tasks
 
     async def submit_result(
@@ -320,6 +388,22 @@ class JobStore:
             job.last_submit[worker_id] = now
             duplicate = task_id in job.completed
             if not duplicate:
+                # First result wins, and ONLY the winner is journaled:
+                # a submission racing its speculative re-dispatch (or a
+                # requeued worker's late duplicate) must leave exactly
+                # one authoritative completion in the write-ahead log —
+                # emitted here, under the lock, at the instant the
+                # winner is decided, so replay can never resurrect the
+                # loser (tests/test_job_store.py regression).
+                self._journal(
+                    {
+                        "type": "submit",
+                        "job": job_id,
+                        "worker": worker_id,
+                        "task": int(task_id),
+                        "payload": payload,
+                    }
+                )
                 job.completed[task_id] = payload
         if started is not None or service_seconds is not None:
             # duplicates still carry a real latency measurement: the
@@ -391,6 +475,10 @@ class JobStore:
         if job is None:
             return
         async with self.lock:
+            if worker_id not in job.finished_workers:
+                self._journal(
+                    {"type": "worker_done", "job": job_id, "worker": worker_id}
+                )
             job.finished_workers.add(worker_id)
 
     async def heartbeat(self, job_id: str, worker_id: str) -> bool:
@@ -416,7 +504,8 @@ class JobStore:
 
     async def cleanup_tile_job(self, job_id: str) -> None:
         async with self.lock:
-            self.tile_jobs.pop(job_id, None)
+            if self.tile_jobs.pop(job_id, None) is not None:
+                self._journal({"type": "cleanup", "job": job_id})
 
     # --- timeout / requeue --------------------------------------------------
 
@@ -475,9 +564,19 @@ class JobStore:
         """Put a worker's incomplete assigned tasks back on the queue.
         Caller holds self.lock."""
         tasks = job.assigned.pop(worker_id, set())
-        for tid in tasks:
+        for tid in sorted(tasks):
             job.assigned_at.pop((worker_id, tid), None)
         incomplete = sorted(t for t in tasks if t not in job.completed)
+        if incomplete:
+            self._journal(
+                {
+                    "type": "requeue",
+                    "job": job.job_id,
+                    "worker": worker_id,
+                    "tasks": incomplete,
+                    "reason": reason,
+                }
+            )
         for tid in incomplete:
             job.pending.put_nowait(tid)
         if incomplete:
@@ -525,9 +624,22 @@ class JobStore:
         released: list[int] = []
         async with self.lock:
             assigned = job.assigned.get(worker_id, set())
-            for tid in sorted(int(t) for t in task_ids):
-                if tid not in assigned or tid in job.completed:
-                    continue
+            claimable = [
+                tid
+                for tid in sorted(int(t) for t in task_ids)
+                if tid in assigned and tid not in job.completed
+            ]
+            if claimable:
+                self._journal(
+                    {
+                        "type": "requeue",
+                        "job": job_id,
+                        "worker": worker_id,
+                        "tasks": claimable,
+                        "reason": "released",
+                    }
+                )
+            for tid in claimable:
                 assigned.discard(tid)
                 job.assigned_at.pop((worker_id, tid), None)
                 job.pending.put_nowait(tid)
@@ -559,9 +671,16 @@ class JobStore:
                 for tid in sorted(tasks):
                     if tid in job.completed or tid in job.speculated:
                         continue
+                    per_worker.setdefault(wid, []).append(tid)
+            flat = sorted(t for tids in per_worker.values() for t in tids)
+            if flat:
+                self._journal(
+                    {"type": "speculate", "job": job_id, "tasks": flat}
+                )
+            for tids in per_worker.values():
+                for tid in tids:
                     job.speculated.add(tid)
                     job.pending.put_nowait(tid)
-                    per_worker.setdefault(wid, []).append(tid)
         speculated = sorted(t for tids in per_worker.values() for t in tids)
         if speculated:
             for wid, tids in per_worker.items():

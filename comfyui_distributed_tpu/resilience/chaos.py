@@ -34,8 +34,17 @@ Fault-plan op names exposed by the harness (see faults.py grammar):
                             master never sees the beat)
     store:pull:<id> / store:submit:<id>   JobStore RPC surfaces
 
-Used by tests/test_chaos_usdu.py (tier-1, `-m chaos` selectable) and
-scripts/chaos_smoke.py.
+`run_chaos_master_crash` extends the harness to the MASTER's own
+death: phase 1 runs the elastic loop with the write-ahead journal
+attached and a fault plan that kills the master mid-job (after a pull,
+or after a partial submit — `crash@store:pull:master#k` /
+`crash@store:submit:master#k`); phase 2 simulates the restarted
+process — a fresh JobStore recovered from the journal directory — and
+drains the job to completion. The acceptance assertion is the same
+bit-identical canvas the worker-crash scenarios make.
+
+Used by tests/test_chaos_usdu.py (tier-1, `-m chaos` selectable),
+scripts/chaos_smoke.py, and scripts/durability_soak.py.
 """
 
 from __future__ import annotations
@@ -141,6 +150,7 @@ def run_chaos_usdu(
     tile_batch: int = 1,
     pipeline: bool = True,
     prefetch: bool = False,
+    journal_dir: Optional[str] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -203,6 +213,14 @@ def run_chaos_usdu(
 
     injector = FaultInjector(fault_plan) if fault_plan else None
     store = JobStore(fault_injector=injector)
+    durability = None
+    if journal_dir:
+        # journaled runs (durability soak / overhead A/B): the standard
+        # scenarios with the write-ahead seam attached
+        from ..durability import DurabilityManager
+
+        durability = DurabilityManager(journal_dir)
+        store.journal_sink = durability.record
     wd = None
     wd_health = None
     latency_sinks = []
@@ -416,6 +434,8 @@ def run_chaos_usdu(
             chaos_tracer.write_jsonl(trace_id, trace_jsonl)
     finally:
         set_tracer(previous_tracer)
+        if durability is not None:
+            durability.close()
     # every tile is accepted exactly once (first result wins), so the
     # master's share is the remainder (plan_grid: geometry only, no
     # second resize/extract pass)
@@ -433,4 +453,233 @@ def run_chaos_usdu(
         health=wd_health.snapshot() if wd_health is not None else {},
         tiles_by_worker=tiles_by_worker,
         placement=policy.snapshot() if policy is not None else {},
+    )
+
+
+# --------------------------------------------------------------------------
+# kill-the-master scenarios (durable control plane acceptance)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MasterCrashResult:
+    """Outcome of a two-phase kill-the-master run: the recovered
+    canvas, what recovery found, and proof the crash actually fired."""
+
+    output: np.ndarray
+    report: dict
+    crash_error: str
+    fired: list[FaultAction]
+
+    def fired_kinds(self) -> set[str]:
+        return {a.kind for a in self.fired}
+
+
+def run_chaos_master_crash(
+    seed: int = 0,
+    crash_plan: str = "crash@store:pull:master#3",
+    *,
+    journal_dir: str,
+    workers: Sequence[str] = ("w1", "w2"),
+    image_hw: tuple[int, int] = (64, 64),
+    tile: int = 64,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    worker_timeout: float = 0.6,
+    job_id: str = "chaos-crash-job",
+    snapshot_every: int = 4,
+    fsync_every: int = 0,
+) -> MasterCrashResult:
+    """SIGKILL-the-master simulation, in process and deterministic.
+
+    Phase 1 ("the process that dies"): the elastic USDU loop runs with
+    the write-ahead journal attached (`journal_dir`) under a fault plan
+    that raises out of one of the MASTER's own store RPCs
+    (`crash@store:pull:master#k` = killed after k-1 successful pulls,
+    `crash@store:submit:master#k` = killed after a partial submit). The
+    abandoned JobStore — like the dead process's memory — is discarded;
+    worker threads are orphaned mid-flight exactly as a real master
+    SIGKILL orphans them, then drained out.
+
+    Phase 2 ("the restarted process"): a FRESH JobStore is recovered
+    from `journal_dir` (snapshot + WAL tail; in-flight and
+    master-volatile tiles requeue, durable worker payloads restore to
+    the results queue) and a fresh master loop drains the job to
+    completion with no workers.
+
+    Determinism: per-tile noise keys fold the global tile index, so
+    whichever tiles phase 2 recomputes reproduce exactly; restored
+    worker tiles travel the lossless PNG envelope; the deterministic
+    blend makes compositing order irrelevant. The caller asserts the
+    returned canvas is bit-identical to an uninterrupted run — journal
+    CONTENT races (which worker submits landed before the crash) change
+    the requeue/restore split, never the output.
+    """
+    import jax.numpy as jnp
+
+    from ..durability import DurabilityManager
+    from ..graph import ExecutionContext
+    from ..graph import usdu_elastic as elastic
+    from ..graph.tile_pipeline import GrantSampler, TilePipeline
+    from ..jobs import JobStore
+    from ..ops import upscale as upscale_ops
+    from ..utils import config as config_mod
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+    from ..utils.exceptions import JobQueueError
+
+    h, w = image_hw
+    image = jnp.asarray(
+        np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    bundle = types.SimpleNamespace(params=None)
+
+    def worker_body(store: Any, wid: str) -> None:
+        _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        import jax as _jax
+
+        key = _jax.random.key(seed)
+        job = run_async_in_server_loop(
+            store.wait_for_tile_job(job_id, grace_seconds=20), timeout=30
+        )
+        if job is None:
+            return
+        sampler = GrantSampler(
+            _stub_process, None, extracted, key, grid.positions_array(),
+            None, None, k_max=1, role="worker",
+        )
+        flush_pending: dict[int, list] = {}
+
+        def pull():
+            return run_async_in_server_loop(
+                store.pull_tasks(job_id, wid, timeout=0.2), timeout=10
+            ) or None
+
+        def emit(tile_idx, arr):
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+
+        def flush(is_final):
+            if not flush_pending:
+                return
+            grouped = dict(flush_pending)
+            flush_pending.clear()
+            run_async_in_server_loop(
+                store.submit_flush(job_id, wid, grouped), timeout=10
+            )
+
+        def heartbeat():
+            try:
+                run_async_in_server_loop(store.heartbeat(job_id, wid), timeout=10)
+            except Exception:  # noqa: BLE001 - liveness best effort
+                pass
+
+        try:
+            TilePipeline(
+                pull=pull, sample=sampler.sample, chunks=sampler.chunks,
+                emit=emit, flush=flush, heartbeat=heartbeat,
+                role="worker", span_attrs={"worker_id": wid}, threaded=False,
+            ).run()
+        except JobQueueError:
+            pass  # the dead master's job was torn down under us
+
+    def run_master(store: Any) -> Any:
+        ctx = ExecutionContext(
+            server=types.SimpleNamespace(job_store=store),
+            config={"workers": []},
+        )
+        return elastic.run_master_elastic(
+            bundle, image, pos, neg,
+            job_id=job_id,
+            enabled_worker_ids=[],
+            upscale_by=upscale_by, tile=tile, padding=padding,
+            steps=1, sampler="euler", scheduler="karras",
+            cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+        )
+
+    injector = FaultInjector(f"seed={seed};{crash_plan}")
+    crash_error = ""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.object(
+                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+            )
+        )
+        stack.enter_context(
+            mock.patch.object(
+                config_mod, "get_worker_timeout_seconds",
+                lambda path=None: worker_timeout,
+            )
+        )
+        stack.enter_context(
+            mock.patch.dict(
+                os.environ,
+                {"CDT_DETERMINISTIC_BLEND": "1", "CDT_TILE_BATCH": "1"},
+            )
+        )
+
+        # --- phase 1: the master that dies -------------------------------
+        store1 = JobStore(fault_injector=injector)
+        manager1 = DurabilityManager(
+            journal_dir, snapshot_every=snapshot_every, fsync_every=fsync_every
+        )
+        store1.journal_sink = manager1.record
+        threads = [
+            threading.Thread(
+                target=worker_body, args=(store1, wid), daemon=True
+            )
+            for wid in workers
+        ]
+        for t in threads:
+            t.start()
+        try:
+            run_master(store1)
+            raise RuntimeError(
+                f"master crash plan {crash_plan!r} never fired; the "
+                "scenario would be vacuous"
+            )
+        except FaultInjected as exc:
+            crash_error = str(exc)
+            debug_log(f"chaos master died: {exc}")
+        # The dead process takes its journal seam with it; late worker
+        # submissions against the abandoned store are lost exactly as
+        # they would be against a closed socket (recovery requeues
+        # them — bit-identical recompute either way).
+        store1.journal_sink = None
+
+        async def _teardown():
+            async with store1.lock:
+                store1.tile_jobs.pop(job_id, None)
+
+        run_async_in_server_loop(_teardown(), timeout=10)
+        for t in threads:
+            t.join(timeout=30)
+        manager1.close()
+
+        # --- phase 2: the restarted master -------------------------------
+        store2 = JobStore()
+        manager2 = DurabilityManager(
+            journal_dir, snapshot_every=snapshot_every, fsync_every=fsync_every
+        )
+        report = manager2.recover(store2)
+        store2.journal_sink = manager2.record
+        try:
+            out = run_master(store2)
+        finally:
+            manager2.close()
+
+    return MasterCrashResult(
+        output=np.asarray(out),
+        report=report.as_json(),
+        crash_error=crash_error,
+        fired=list(injector.fired),
     )

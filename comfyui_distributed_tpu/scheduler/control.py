@@ -117,6 +117,24 @@ class SchedulerControl:
             "tenant_weights": dict(self.queue.tenant_weights),
         }
 
+    # --- durability hooks (durability/manager.py) -------------------------
+
+    def export_state(self) -> dict:
+        """Sampled into every control-plane snapshot: admission
+        aggregates + placement speed model (docs/durability.md)."""
+        return {
+            "admission": self.queue.export_state(),
+            "placement": self.placement.export_state(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        admission = state.get("admission")
+        if isinstance(admission, dict):
+            self.queue.restore_state(admission)
+        placement = state.get("placement")
+        if isinstance(placement, dict):
+            self.placement.restore_state(placement)
+
     # --- observability ----------------------------------------------------
 
     def status(self) -> dict:

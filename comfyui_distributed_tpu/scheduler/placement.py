@@ -183,6 +183,33 @@ class PlacementPolicy:
         with self._lock:
             self._trimmed[worker_id] = self._trimmed.get(worker_id, 0) + 1
 
+    # --- durability hooks (durability/snapshot.py) ------------------------
+
+    def export_state(self) -> dict:
+        """Per-worker speed model (EWMA + sample counts) for the
+        control-plane snapshot: a restarted master places work with
+        learned weights immediately instead of re-learning the fleet
+        from uniform cold start."""
+        with self._lock:
+            return {
+                "ewma": {w: round(v, 9) for w, v in self._ewma.items()},
+                "samples": dict(self._samples),
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            for worker_id, value in (state.get("ewma") or {}).items():
+                try:
+                    if float(value) > 0:
+                        self._ewma[str(worker_id)] = float(value)
+                except (TypeError, ValueError):
+                    continue
+            for worker_id, count in (state.get("samples") or {}).items():
+                try:
+                    self._samples[str(worker_id)] = int(count)
+                except (TypeError, ValueError):
+                    continue
+
     # --- observability ----------------------------------------------------
 
     def snapshot(self) -> dict:

@@ -462,6 +462,51 @@ class AdmissionQueue:
                         return True
         return False
 
+    # --- durability hooks (durability/snapshot.py) ------------------------
+
+    def export_state(self) -> dict:
+        """The aggregates worth surviving a master restart: tenant DRR
+        deficits (fair-share position), live tenant weights (operator
+        retunes via /distributed/scheduler/reprioritize), and the
+        admission totals. Queued TICKETS are deliberately absent — they
+        wrap asyncio futures of HTTP requests that died with the old
+        process; their clients retry against the restarted master."""
+        return {
+            "tenant_weights": dict(self.tenant_weights),
+            "deficits": {
+                name: {t: round(d, 9) for t, d in lane.deficit.items()}
+                for name, lane in self.lanes.items()
+            },
+            "totals": dict(self.totals),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Best-effort inverse of export_state onto a fresh queue:
+        unknown lanes/keys are skipped (lane specs may change across
+        restarts), bad values are ignored — restoring advisory
+        aggregates must never be able to wedge admission."""
+        for tenant, weight in (state.get("tenant_weights") or {}).items():
+            try:
+                if float(weight) > 0:
+                    self.tenant_weights[str(tenant)] = float(weight)
+            except (TypeError, ValueError):
+                continue
+        for lane_name, deficits in (state.get("deficits") or {}).items():
+            lane = self.lanes.get(str(lane_name))
+            if lane is None or not isinstance(deficits, dict):
+                continue
+            for tenant, deficit in deficits.items():
+                try:
+                    lane.deficit[str(tenant)] = float(deficit)
+                except (TypeError, ValueError):
+                    continue
+        for key, value in (state.get("totals") or {}).items():
+            if key in self.totals:
+                try:
+                    self.totals[key] = int(value)
+                except (TypeError, ValueError):
+                    continue
+
     # --- observability ----------------------------------------------------
 
     def estimate_retry_after(self, lane: str) -> float:

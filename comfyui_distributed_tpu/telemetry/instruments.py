@@ -228,6 +228,55 @@ def sched_worker_speed_ratio() -> Gauge:
     )
 
 
+# --- durable control plane (durability/) ----------------------------------
+
+def journal_appends_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_journal_appends_total",
+        "Write-ahead-journal records appended by record type",
+        ("record",),
+    )
+
+
+def journal_fsync_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_journal_fsync_seconds",
+        "fsync latency of journal appends (CDT_JOURNAL_FSYNC policy)",
+        buckets=STORE_BUCKETS,
+    )
+
+
+def snapshots_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_snapshots_total",
+        "Control-plane snapshots written (periodic + post-recovery)",
+    )
+
+
+def snapshot_age_seconds() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_snapshot_age_seconds",
+        "Seconds since the last control-plane snapshot was written "
+        "(bounds the WAL tail a restart must replay)",
+    )
+
+
+def recovery_replayed_records() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_recovery_replayed_records",
+        "Journal records replayed beyond the snapshot by the last "
+        "recovery on this process",
+    )
+
+
+def recovery_requeued_tasks() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_recovery_requeued_tasks",
+        "In-flight/volatile tiles the last recovery requeued for "
+        "bit-identical recompute",
+    )
+
+
 # --- JAX runtime health (telemetry/runtime.py) ----------------------------
 
 def jax_compiles() -> Gauge:
@@ -398,6 +447,16 @@ def bind_server_collectors(server) -> Callable[[], None]:
     pipeline_inflight()
     pipeline_padded_tiles_total()
 
+    # Same for the durability instruments when this server journals:
+    # the web panel's durability card parses them from the first scrape.
+    if getattr(server, "durability", None) is not None:
+        journal_appends_total()
+        journal_fsync_seconds()
+        snapshots_total()
+        snapshot_age_seconds()
+        recovery_replayed_records()
+        recovery_requeued_tasks()
+
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale
     # series are removed per-server (a global clear would clobber a
@@ -431,6 +490,9 @@ def bind_server_collectors(server) -> Callable[[], None]:
             speed_series_seen.update(weights)
             for worker_id, ratio in weights.items():
                 speed_gauge.set(ratio, worker_id=worker_id, server=label)
+        durability = getattr(server, "durability", None)
+        if durability is not None:
+            durability.collect_metrics()
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at

@@ -16,12 +16,12 @@ import contextlib
 import copy
 import json
 import os
-import tempfile
 import threading
 from typing import Any, AsyncIterator
 
 from . import logging as dlog
 from .constants import HEARTBEAT_TIMEOUT_SECONDS
+from .fsio import atomic_write_json
 
 CONFIG_FILENAME = "tpu_config.json"
 
@@ -143,21 +143,10 @@ def load_config(path: str | None = None) -> dict[str, Any]:
 
 
 def save_config(config: dict[str, Any], path: str | None = None) -> None:
-    """Atomic write: tmp file in same dir + fsync + os.replace."""
+    """Atomic write via the shared crash-safe recipe (utils/fsio.py:
+    tmp + fsync + os.replace + directory fsync)."""
     path = path or get_config_path()
-    directory = os.path.dirname(path) or "."
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp_path = tempfile.mkstemp(dir=directory, prefix=".tpu_config_", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w", encoding="utf-8") as fh:
-            json.dump(config, fh, indent=2, sort_keys=False)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp_path, path)
-    except Exception:
-        with contextlib.suppress(OSError):
-            os.unlink(tmp_path)
-        raise
+    atomic_write_json(path, config, indent=2, sort_keys=False)
     with _cache.lock:
         _cache.path = path
         try:

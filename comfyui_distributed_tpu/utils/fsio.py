@@ -1,0 +1,85 @@
+"""Crash-safe filesystem primitives.
+
+The durable-control-plane invariant (docs/durability.md): a reader
+must only ever observe either the OLD complete file or the NEW complete
+file — never a truncated or interleaved state — no matter where the
+writing process is killed. The recipe is the classic one:
+
+    write tmp (same directory) -> flush -> fsync(tmp) -> os.replace
+    -> fsync(directory)
+
+The final directory fsync is the step ad-hoc writers usually skip: on
+a power cut the rename itself can be lost without it, silently rolling
+the file back to its previous version. All JSON state files in this
+repo (config, lint baseline, snapshots, soak reports) go through
+``atomic_write_json`` so the recipe lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+from typing import Any
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename/create inside it is durable.
+
+    Best effort on platforms whose filesystems don't support opening
+    directories (the write itself already succeeded; losing only the
+    rename needs a power cut at the wrong instant).
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Atomically replace ``path`` with ``data`` (tmp + fsync + rename
+    + directory fsync). The tmp file is unlinked on any failure so a
+    crashed writer never litters half-written state next to the real
+    file."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp_path = tempfile.mkstemp(
+        dir=directory, prefix=f".{os.path.basename(path)}.", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp_path, path)
+    except Exception:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp_path)
+        raise
+    if fsync:
+        fsync_dir(directory)
+
+
+def atomic_write_json(
+    path: str,
+    data: Any,
+    fsync: bool = True,
+    indent: int | None = 2,
+    sort_keys: bool = False,
+) -> None:
+    """Atomically replace ``path`` with ``data`` serialized as JSON.
+
+    Serialization happens BEFORE the tmp file is created: a
+    non-serializable payload raises without touching the filesystem at
+    all (no empty tmp, no clobbered target).
+    """
+    payload = json.dumps(data, indent=indent, sort_keys=sort_keys) + "\n"
+    atomic_write_bytes(path, payload.encode("utf-8"), fsync=fsync)

@@ -143,6 +143,21 @@ KNOBS: tuple[Knob, ...] = (
          "worker's ready-poll window."),
     Knob("CDT_COMPILE_CACHE_DIR", "./.cdt/compile_cache", "pipeline",
          "Persistent XLA compilation cache directory; `0`/`off`/`none` disables."),
+    # --- durability ------------------------------------------------------
+    Knob("CDT_JOURNAL_DIR", "unset", "durability",
+         "Directory for the control-plane write-ahead journal + snapshots; "
+         "unset disables the durable control plane entirely (master-only)."),
+    Knob("CDT_JOURNAL_FSYNC", "1", "durability",
+         "Journal fsync policy: 1 syncs every append before acknowledging "
+         "(power-cut safe), N>1 syncs every N appends, 0 is write-behind "
+         "via a dedicated writer thread (the <5% overhead mode; a SIGKILL "
+         "may lose the last in-flight records, which recovery then "
+         "recomputes bit-identically)."),
+    Knob("CDT_JOURNAL_SEGMENT_BYTES", "4194304", "durability",
+         "Journal segment size before fsync'd rotation (4 MiB default)."),
+    Knob("CDT_SNAPSHOT_EVERY", "256", "durability",
+         "Journal appends between control-plane snapshots; each snapshot "
+         "prunes the segments it supersedes."),
     # --- telemetry -------------------------------------------------------
     Knob("CDT_METRIC_MAX_SERIES", "128", "telemetry",
          "Per-metric label-series cap; excess series collapse into `_overflow`."),

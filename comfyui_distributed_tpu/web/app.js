@@ -38,6 +38,7 @@ import {
   patchWorkflowText,
 } from "./modules/widgets.js";
 import {
+  durabilityHtml,
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
@@ -94,6 +95,7 @@ async function refreshStatus() {
   );
   refreshScheduler();
   refreshPipeline();
+  refreshDurability();
   schedulePoll();
 }
 
@@ -121,6 +123,17 @@ async function refreshPipeline() {
     container.innerHTML = pipelineHtml(parsePipelineMetrics(await resp.text()));
   } catch {
     container.textContent = "pipeline metrics unreachable";
+  }
+}
+
+// ---------- durable control plane card ----------
+
+async function refreshDurability() {
+  const container = document.getElementById("durability");
+  try {
+    container.innerHTML = durabilityHtml(await api("/distributed/durability"));
+  } catch {
+    container.textContent = "durability status unreachable";
   }
 }
 

@@ -222,6 +222,48 @@ export function pipelineHtml(stats) {
   return rows.join("") + cacheLine;
 }
 
+/** Durable-control-plane card (pure; app.js refreshDurability applies
+ * it): journal head + segment count, last snapshot lsn/age, the
+ * post-recovery admission hold, and the last recovery's report — the
+ * JSON served by GET /distributed/durability. */
+export function durabilityHtml(info) {
+  if (!info) return '<span class="meta">durability status unavailable</span>';
+  if (!info.enabled) {
+    return '<span class="meta">journaling off — set CDT_JOURNAL_DIR to enable</span>';
+  }
+  const journal = info.journal || {};
+  const age =
+    info.snapshot_age_seconds == null
+      ? "never"
+      : `${Number(info.snapshot_age_seconds).toFixed(1)}s ago`;
+  const rows = [
+    `<div class="row"><strong>journal</strong><span class="meta">` +
+      `lsn ${journal.next_lsn ?? "?"} · ${info.appends ?? 0} appends · ` +
+      `${journal.closed_segments ?? 0} closed segment(s)` +
+      `${journal.write_behind ? " · write-behind" : " · write-ahead"}</span></div>`,
+    `<div class="row"><strong>snapshot</strong><span class="meta">` +
+      `lsn ${info.last_snapshot_lsn ?? 0} · ${age} · ` +
+      `every ${info.snapshot_every ?? "?"} appends</span></div>`,
+  ];
+  const rec = info.recovery || {};
+  if (rec.performed) {
+    rows.push(
+      `<div class="row"><strong>last recovery</strong><span class="meta">` +
+        `${rec.jobs_recovered ?? 0} job(s) · ` +
+        `${rec.replayed_records ?? 0} record(s) replayed · ` +
+        `${rec.tasks_requeued ?? 0} requeued · ` +
+        `${rec.tasks_restored ?? 0} restored</span></div>`
+    );
+  }
+  if (info.admission_held) {
+    rows.push(
+      `<div class="row"><span class="busy">admission PAUSED — waiting for a ` +
+        `worker heartbeat after recovery</span></div>`
+    );
+  }
+  return rows.join("");
+}
+
 /** Topology summary line (pure; app.js renderTopology applies it). */
 export function topologyHtml(info) {
   const topo = info.topology || {};

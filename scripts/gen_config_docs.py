@@ -34,6 +34,7 @@ _SUBSYSTEM_TITLES = {
     "resilience": "Resilience & fault injection",
     "watchdog": "Watchdog",
     "scheduler": "Scheduler control plane",
+    "durability": "Durable control plane",
     "pipeline": "Tile pipeline & compile cache",
     "telemetry": "Telemetry",
     "jobs": "Job store",

@@ -281,6 +281,91 @@ def test_prefetch_crash_requeues_prefetched_grant(baseline):
     np.testing.assert_array_equal(baseline, result.output)
 
 
+# --------------------------------------------------------------------------
+# kill-the-master scenarios (durable control plane acceptance)
+# --------------------------------------------------------------------------
+
+
+def test_master_killed_after_pull_recovers_bit_identical(baseline, tmp_path):
+    """Acceptance phase 1: the master is killed right after claiming
+    work (its 3rd pull RPC). Restart + journal recovery requeues the
+    in-flight/volatile tiles, restores durable worker results, and the
+    drained canvas is bit-identical to an uninterrupted run."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_master_crash
+
+    # Deterministic construction: the workers' first pulls are held
+    # back, so the master instantly claims tile 0 — and is killed at
+    # its FIRST submit RPC, i.e. after the pull was journaled but
+    # before any completion: the claimed tile is in flight at death
+    # and recovery must requeue it.
+    result = run_chaos_master_crash(
+        seed=11,
+        crash_plan=(
+            "latency(1.5)@store:pull:w1#1;latency(1.5)@store:pull:w2#1;"
+            "crash@store:submit:master#1"
+        ),
+        journal_dir=str(tmp_path / "wal"),
+    )
+    assert "crash" in result.fired_kinds()  # the master actually died
+    assert result.report["performed"]
+    assert result.report["jobs_recovered"] == 1
+    assert result.report["tasks_requeued"] >= 1  # the in-flight claim
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_master_killed_after_partial_submit_recovers_bit_identical(
+    baseline, tmp_path
+):
+    """Acceptance phase 2: the master dies mid-submit — after some of
+    its own completions were journaled but before the job finished.
+    Volatile (master-local) completions are demoted for bit-identical
+    recompute; the canvas must still match the uninterrupted run."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_master_crash
+
+    # the workers' first pulls are held back so the master
+    # deterministically performs the partial submit the scenario is
+    # named for: submit #1 lands in the journal, submit #2 is the kill
+    result = run_chaos_master_crash(
+        seed=11,
+        crash_plan=(
+            "latency(1.5)@store:pull:w1#1;latency(1.5)@store:pull:w2#1;"
+            "crash@store:submit:master#2"
+        ),
+        journal_dir=str(tmp_path / "wal"),
+    )
+    assert "crash" in result.fired_kinds()
+    assert result.report["performed"]
+    # something real was at stake: recovery either requeued in-flight
+    # tiles or restored durable worker results (typically both)
+    assert (
+        result.report["tasks_requeued"] + result.report["tasks_restored"] > 0
+    ), result.report
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_master_crash_recovery_is_idempotent(tmp_path):
+    """Replaying the same snapshot+WAL twice yields identical state —
+    a recovery interrupted by a second crash simply runs again."""
+    from comfyui_distributed_tpu.durability.recovery import (
+        verify_idempotent_replay,
+    )
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_master_crash
+
+    journal_dir = str(tmp_path / "wal")
+    # the master's slowed first pull lets workers win tiles (their
+    # durable payloads land in the journal), then its SECOND pull —
+    # which every master run is guaranteed to reach — is the kill
+    result = run_chaos_master_crash(
+        seed=11,
+        crash_plan=(
+            "latency(0.3)@store:pull:master#1;crash@store:pull:master#2"
+        ),
+        journal_dir=journal_dir,
+    )
+    assert "crash" in result.fired_kinds()
+    assert verify_idempotent_replay(journal_dir)
+
+
 def test_store_level_connection_errors_kill_worker_but_not_job(baseline):
     """A connection error at w2's pull RPC takes that worker out (the
     harness treats any injected transport error as fatal to the

@@ -1,0 +1,135 @@
+"""Crash-interleaving behavior of the shared atomic-write helper
+(utils/fsio.py) — the primitive under config saves, the lint baseline,
+and control-plane snapshots."""
+
+import json
+import os
+
+import pytest
+from unittest import mock
+
+from comfyui_distributed_tpu.utils import fsio
+
+pytestmark = pytest.mark.fast
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def test_atomic_write_json_roundtrip(tmp_path):
+    target = str(tmp_path / "state.json")
+    fsio.atomic_write_json(target, {"a": 1, "nested": [1, 2, 3]})
+    assert _read(target) == {"a": 1, "nested": [1, 2, 3]}
+    # no tmp litter
+    assert sorted(os.listdir(tmp_path)) == ["state.json"]
+
+
+def test_atomic_write_creates_parent_dirs(tmp_path):
+    target = str(tmp_path / "deep" / "er" / "state.json")
+    fsio.atomic_write_json(target, {"ok": True})
+    assert _read(target) == {"ok": True}
+
+
+def test_crash_during_tmp_write_preserves_old_file(tmp_path):
+    """Killed mid-write (before the rename): the reader must still see
+    the OLD complete file, and the half-written tmp must be gone."""
+    target = str(tmp_path / "state.json")
+    fsio.atomic_write_json(target, {"generation": 1})
+
+    real_fdopen = os.fdopen
+
+    class _ExplodingFile:
+        def __init__(self, fh):
+            self._fh = fh
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self._fh.close()
+            return False
+
+        def write(self, data):
+            self._fh.write(data[: len(data) // 2])  # half the bytes land...
+            raise OSError("simulated crash mid-write")
+
+    with mock.patch.object(
+        os, "fdopen", lambda fd, *a, **k: _ExplodingFile(real_fdopen(fd, *a, **k))
+    ):
+        with pytest.raises(OSError, match="simulated crash"):
+            fsio.atomic_write_json(target, {"generation": 2})
+    assert _read(target) == {"generation": 1}  # old file intact
+    assert sorted(os.listdir(tmp_path)) == ["state.json"]  # tmp unlinked
+
+
+def test_crash_before_rename_preserves_old_file(tmp_path):
+    """Killed after the tmp is fully written but before os.replace: old
+    file intact (a leftover tmp is tolerated — it carries a unique name
+    and never shadows the target)."""
+    target = str(tmp_path / "state.json")
+    fsio.atomic_write_json(target, {"generation": 1})
+    with mock.patch.object(
+        os, "replace", side_effect=OSError("simulated crash at rename")
+    ):
+        with pytest.raises(OSError, match="simulated crash"):
+            fsio.atomic_write_json(target, {"generation": 2})
+    assert _read(target) == {"generation": 1}
+
+
+def test_non_serializable_payload_touches_nothing(tmp_path):
+    """Serialization happens before any filesystem work: a bad payload
+    must not clobber the target or leave tmp litter."""
+    target = str(tmp_path / "state.json")
+    fsio.atomic_write_json(target, {"generation": 1})
+    with pytest.raises(TypeError):
+        fsio.atomic_write_json(target, {"bad": object()})
+    assert _read(target) == {"generation": 1}
+    assert sorted(os.listdir(tmp_path)) == ["state.json"]
+
+
+def test_interleaved_writers_last_complete_write_wins(tmp_path):
+    """Two writers racing the same target each produce a COMPLETE file;
+    the survivor is one of the two payloads, never a splice."""
+    target = str(tmp_path / "state.json")
+    fsio.atomic_write_json(target, {"writer": "a", "payload": "x" * 4096})
+    fsio.atomic_write_json(target, {"writer": "b", "payload": "y" * 4096})
+    data = _read(target)
+    assert data["writer"] == "b"
+    assert data["payload"] == "y" * 4096
+
+
+def test_fsync_dir_tolerates_odd_platforms(tmp_path):
+    fsio.fsync_dir(str(tmp_path))  # must not raise
+    fsio.fsync_dir(str(tmp_path / "does-not-exist"))  # nor here
+
+
+def test_config_save_uses_atomic_writer(tmp_path):
+    """save_config rides the shared recipe (the satellite's point: one
+    crash-safe writer, not three ad-hoc ones)."""
+    from comfyui_distributed_tpu.utils import config as config_mod
+
+    path = str(tmp_path / "tpu_config.json")
+    cfg = config_mod.load_config(path)
+    cfg["settings"]["debug"] = True
+    with mock.patch.object(
+        fsio, "atomic_write_bytes", wraps=fsio.atomic_write_bytes
+    ) as spy:
+        config_mod.save_config(cfg, path)
+    assert spy.called
+    assert config_mod.load_config(path)["settings"]["debug"] is True
+
+
+def test_lint_baseline_save_uses_atomic_writer(tmp_path):
+    from tools.cdtlint.baseline import Baseline
+
+    path = str(tmp_path / "baseline.json")
+    baseline = Baseline(path=path)
+    baseline.entries = {"abc123": {"code": "CDT001", "justification": "x"}}
+    with mock.patch.object(
+        fsio, "atomic_write_bytes", wraps=fsio.atomic_write_bytes
+    ) as spy:
+        baseline.save()
+    assert spy.called
+    assert Baseline.load(path).entries == baseline.entries

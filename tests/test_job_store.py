@@ -306,6 +306,72 @@ def test_release_tasks_returns_only_assigned_incomplete():
     run(scenario())
 
 
+def test_speculative_race_journals_exactly_one_completion():
+    """Regression (durable control plane): a tile submitted
+    concurrently with its watchdog speculative re-dispatch must journal
+    exactly ONE authoritative completion — the first result — so WAL
+    replay can never resurrect the loser. The duplicate is dropped
+    without touching the journal, and every requeue/speculation is
+    recorded before its mutation commits."""
+    store = JobStore()
+    records = []
+    store.journal_sink = records.append
+
+    async def scenario():
+        await store.init_tile_job("t", [0])
+        t0 = await store.pull_task("t", "slow-w")
+        # the stall watchdog speculates the in-flight tile; a backup
+        # participant claims the copy
+        assert await store.speculate_in_flight("t") == [t0]
+        backup = await store.pull_task("t", "backup-w")
+        assert backup == t0
+        # both finish; backup-w lands first and wins
+        assert await store.submit_result("t", "backup-w", t0, "backup") is True
+        assert await store.submit_result("t", "slow-w", t0, "slow") is False
+
+    run(scenario())
+    submits = [r for r in records if r["type"] == "submit"]
+    assert len(submits) == 1, records
+    assert submits[0]["worker"] == "backup-w"  # the winner, exactly once
+    assert submits[0]["payload"] == "backup"
+    # the speculation itself was journaled before the copy was enqueued
+    speculates = [r for r in records if r["type"] == "speculate"]
+    assert speculates == [{"type": "speculate", "job": "t", "tasks": [0]}]
+    # record order proves write-ahead discipline: speculate precedes
+    # the backup pull, which precedes the single submit
+    kinds = [r["type"] for r in records]
+    assert kinds.index("speculate") < len(kinds) - 1
+    assert kinds.count("submit") == 1
+
+
+def test_journal_sink_sees_every_transition_in_order():
+    """The full seam: init → pull → requeue → pull → submit → done →
+    cleanup, each journaled exactly once, before acknowledgement."""
+    store = JobStore()
+    records = []
+    store.journal_sink = records.append
+
+    async def scenario():
+        await store.init_tile_job("t", [0])
+        t0 = await store.pull_task("t", "w1")
+        await store.release_tasks("t", "w1", [t0])
+        again = await store.pull_task("t", "w2")
+        await store.submit_result("t", "w2", again, "p")
+        await store.mark_worker_done("t", "w2")
+        await store.mark_worker_done("t", "w2")  # idempotent: no record
+        await store.cleanup_tile_job("t")
+        await store.cleanup_tile_job("t")  # idempotent: no record
+
+    run(scenario())
+    assert [r["type"] for r in records] == [
+        "job_init", "pull", "requeue", "pull", "submit", "worker_done",
+        "cleanup",
+    ]
+    requeue = records[2]
+    assert requeue["reason"] == "released"
+    assert requeue["tasks"] == [0]
+
+
 def test_store_fault_injection_drop_and_crash():
     """JobStore honors a fault plan: dropped heartbeats are never
     recorded; a crash fault surfaces as an exception at the RPC."""

@@ -60,10 +60,15 @@ class Baseline:
         return cls(path=path, entries=dict(data.get("entries", {})))
 
     def save(self) -> None:
+        # Crash-safe via the shared atomic-write recipe: an interrupted
+        # --update-baseline must never leave a truncated baseline that
+        # the next CI run would reject as corrupt. The import stays
+        # dependency-free (utils.fsio is stdlib-only), preserving the
+        # no-pip-install property of the cdt-lint CI job.
+        from comfyui_distributed_tpu.utils.fsio import atomic_write_json
+
         data = {"version": BASELINE_VERSION, "entries": dict(sorted(self.entries.items()))}
-        with open(self.path, "w", encoding="utf-8") as fh:
-            json.dump(data, fh, indent=2, sort_keys=False)
-            fh.write("\n")
+        atomic_write_json(self.path, data, indent=2, sort_keys=False)
 
     def __contains__(self, fp: str) -> bool:
         return fp in self.entries

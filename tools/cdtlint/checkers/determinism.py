@@ -42,6 +42,11 @@ DETERMINISM_PATHS = (
     "comfyui_distributed_tpu/graph/usdu_elastic.py",
     "comfyui_distributed_tpu/jobs/store.py",
     "comfyui_distributed_tpu/resilience/chaos.py",
+    # the durable control plane: journal replay and snapshot
+    # serialization must be pure functions of on-disk bytes — readdir
+    # order, set iteration, or ambient entropy here would make
+    # recovery non-reproducible (the idempotent-replay guarantee)
+    "comfyui_distributed_tpu/durability/*.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
