@@ -350,6 +350,39 @@ def _runtime_snapshot() -> dict | None:
         return None
 
 
+def _topology_stamp() -> dict | None:
+    """Compact mesh topology for the datum (satellite of the multi-chip
+    tier): platform, chip counts, device kind, and the mesh the worker
+    tier would build from the CDT_MESH_* knobs. MULTICHIP_r* rounds
+    from different fleet shapes compare on `value` (already normalized
+    per chip) + this stamp."""
+    try:
+        from comfyui_distributed_tpu.parallel.mesh import (
+            describe_topology,
+            serving_mesh_summary,
+        )
+
+        topo = describe_topology()
+        stamp = {
+            k: topo.get(k)
+            for k in (
+                "platform",
+                "device_count",
+                "local_device_count",
+                "process_count",
+            )
+        }
+        kinds = sorted(
+            {d.get("device_kind") for d in topo.get("devices", [])} - {None}
+        )
+        if kinds:
+            stamp["device_kind"] = kinds[0] if len(kinds) == 1 else kinds
+        stamp["mesh"] = serving_mesh_summary()
+        return stamp
+    except Exception:  # noqa: BLE001 - forensics only
+        return None
+
+
 def _init_jax() -> tuple:
     """Returns (jax, environment_tag). Used by measurement processes
     (children, or a direct BENCH_TINY/BENCH_CPU invocation)."""
@@ -471,6 +504,10 @@ def bench_usdu(jax, tiny: bool) -> dict:
         ),
         "value": round(rate_per_chip, 4),
         "unit": "tiles/sec/chip",
+        # the un-normalized aggregate + the divisor, explicit, so rounds
+        # from different fleet shapes stay comparable at a glance
+        "rate_total": round(rate, 4),
+        "chips": n_dev,
         "vs_baseline": None,
         "scaling_source": None,
     }
@@ -532,6 +569,7 @@ def bench_txt2img(jax, tiny: bool) -> dict:
         "metric": f"txt2img imgs/sec ({model} {size}px {steps} steps, {n_dev} chip(s))",
         "value": round(rate, 4),
         "unit": "imgs/sec",
+        "chips": n_dev,
         "vs_baseline": None,
         "scaling_source": None,
         "mfu": None,
@@ -601,6 +639,8 @@ def bench_video(jax, tiny: bool) -> dict:
         ),
         "value": round(rate / n_dev, 4),
         "unit": "frames/sec/chip",
+        "rate_total": round(rate, 4),
+        "chips": n_dev,
         "vs_baseline": None,
         "scaling_source": None,
         "mfu": None,
@@ -1169,6 +1209,10 @@ def main() -> None:
     runtime = _runtime_snapshot()
     if runtime is not None:
         result["runtime"] = runtime
+    # mesh topology stamp: which fleet shape produced this number
+    topology = _topology_stamp()
+    if topology is not None:
+        result["topology"] = topology
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

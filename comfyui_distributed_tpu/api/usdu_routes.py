@@ -51,6 +51,10 @@ class UsduRoutes:
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        if "devices" in body:
+            self.server.job_store.note_worker_capacity(
+                str(body["worker_id"]), body["devices"]
+            )
         ok = await self.server.job_store.heartbeat(
             str(body["job_id"]), str(body["worker_id"])
         )
@@ -62,7 +66,9 @@ class UsduRoutes:
         `batch_max` > 1 opts into speed-weighted batch pulls: the
         placement policy sizes the batch for this worker and the
         response adds `tile_idxs` (first element == tile_idx, so
-        single-pull clients are unaffected)."""
+        single-pull clients are unaffected). A `devices` field
+        advertises the worker's chip count (mesh data-axis width) so
+        placement scales its grants — a 4-chip worker pulls ~4x."""
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
@@ -71,6 +77,10 @@ class UsduRoutes:
             batch_max = max(1, int(body.get("batch_max", 1)))
         except (TypeError, ValueError):
             batch_max = 1
+        # device-count-aware placement: the worker's advertised chip
+        # count (mesh data-axis width) scales its grants
+        if "devices" in body:
+            self.server.job_store.note_worker_capacity(worker_id, body["devices"])
         with rpc_span(
             request, "rpc.request_image", worker_id=worker_id, job_id=job_id
         ) as span:

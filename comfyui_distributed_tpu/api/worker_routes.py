@@ -276,11 +276,23 @@ class WorkerRoutes:
             "tile_queue_depth": stats["queue_depth"],
             "in_flight_tiles": stats["in_flight"],
             "breakers": get_health_registry().snapshot(),
+            # advertised chip counts per worker (mesh data-axis width,
+            # carried on pull/heartbeat) — the placement policy's
+            # capacity inputs, surfaced for the panel and operators
+            "worker_capacity": dict(self.server.job_store.worker_capacity),
         }
         try:
-            from ..parallel.mesh import describe_topology
+            from ..parallel.mesh import describe_topology, serving_mesh_summary
 
             info["topology"] = describe_topology()
+            # the mesh this process serves tile grants with (recorded
+            # by the elastic loop; knob-only resolution before one has
+            # run); a mesh-knob failure degrades only this key, never
+            # the device enumeration above
+            try:
+                info["topology"]["mesh"] = serving_mesh_summary()
+            except Exception as exc:  # noqa: BLE001 - best effort
+                info["topology"]["mesh"] = {"error": str(exc)}
         except Exception as exc:  # noqa: BLE001 - best effort
             info["topology"] = {"error": str(exc)}
         # Tokenizer fidelity: with the committed prose-trained stand-in

@@ -28,7 +28,7 @@ import numpy as np
 
 from ..models import pipeline as pl
 from ..ops import samplers as smp
-from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..parallel.mesh import DATA_AXIS, data_axis_size, shard_map_compat
 from ..utils import image as img_utils
 from ..utils.logging import log
 from .registry import register_node
@@ -690,12 +690,12 @@ def _sample_mesh(
         [P()] if mask is not None else []
     )
     out = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             per_chip,
             mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=P(DATA_AXIS),
-            check_vma=False,
+            check=False,
         )
     )(keys, params, pos, neg, base, *extra)
     return {"samples": out, "participant_major": True}

@@ -97,6 +97,19 @@ class GrantSampler:
     ``k_max > 1``. Wraparound duplicates share the folded keys of their
     originals, so they compute identical results and the surplus is
     sliced off — numerics never depend on the padding.
+
+    ``mesh``: a local device mesh (parallel/mesh.py) turns each
+    bucketed dispatch into a mesh-parallel one — the batch axis is
+    sharded across the mesh's data axis with ``NamedSharding``, so a
+    D-chip worker computes D tiles' worth of the bucket concurrently
+    (and the caller scales ``k_max`` by D: ``tile_scan_batch() × D``).
+    Buckets are rounded up to multiples of D so every participant holds
+    an equal slice; the extra padding rides the same wraparound-
+    duplicate/folded-key idiom, so compile counts stay bounded and
+    per-tile outputs stay bit-identical to the single-device path
+    (asserted by tests/parallel/test_mesh_tiles.py). ``collect``
+    gathers a sharded result host-side via
+    ``parallel/collective.host_collect``.
     """
 
     def __init__(
@@ -110,6 +123,7 @@ class GrantSampler:
         neg: Any,
         k_max: int = 1,
         role: str = "worker",
+        mesh: Any = None,
     ) -> None:
         import jax
 
@@ -124,7 +138,48 @@ class GrantSampler:
         self.neg = neg
         self.k_max = max(1, int(k_max))
         self.role = role
-        self.buckets = grant_buckets(self.k_max)
+        self.mesh = mesh
+        self.data_parallel = 1
+        self._data_shardings: Optional[tuple] = None
+        if mesh is not None:
+            from ..parallel.mesh import data_axis_size, mesh_summary
+            from ..telemetry.instruments import mesh_devices
+
+            self.data_parallel = max(1, data_axis_size(mesh))
+            # gauge the full mesh shape for ANY mesh — a TP-only mesh
+            # (data=1, model>1: the over-HBM sharded checkpoint) must
+            # still show up on /distributed/metrics
+            summary = mesh_summary(mesh)
+            for axis in ("data", "model"):
+                mesh_devices().set(summary[axis], role=role, axis=axis)
+            mesh_devices().set(summary["devices"], role=role, axis="total")
+            if self.data_parallel > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel.mesh import DATA_AXIS
+
+                # every dispatch must give each participant at least
+                # one tile; callers normally pass K x D already
+                self.k_max = max(self.k_max, self.data_parallel)
+                # batched tiles keep extracted's rank (leading axis
+                # becomes the bucket); shard that leading axis only
+                ndim = len(getattr(extracted, "shape", (0, 0, 0, 0)))
+                self._data_shardings = (
+                    NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1)))),
+                    NamedSharding(mesh, P(DATA_AXIS)),  # folded keys
+                    NamedSharding(mesh, P(DATA_AXIS, None)),  # yx positions
+                )
+        if self.data_parallel > 1:
+            # round every bucket up to a multiple of the data-axis
+            # width so the NamedSharding splits evenly; the set stays
+            # bounded (≤ the original bucket count) and the extra
+            # padding is wraparound duplicates, numerics-free
+            dp = self.data_parallel
+            self.buckets = tuple(
+                sorted({max(dp, -(-b // dp) * dp) for b in grant_buckets(self.k_max)})
+            )
+        else:
+            self.buckets = grant_buckets(self.k_max)
         # observability + the shape-bucket test: which compiled shapes
         # this job actually exercised, and how much padding it cost
         self.buckets_used: set[int] = set()
@@ -159,13 +214,54 @@ class GrantSampler:
             jnp.asarray(list(idxs))
         )
 
+    def _bucket_for(self, n: int) -> int:
+        """Smallest of this sampler's buckets that fits ``n`` tiles
+        (mesh-aware: buckets are pre-rounded to multiples of the
+        data-axis width)."""
+        from ..ops.upscale import bucket_for
+
+        return bucket_for(n, self.k_max, self.buckets)
+
+    def _place(self, tiles, keys, yxs):
+        """Pin the batch inputs' leading axis across the mesh's data
+        axis. Placement must be identical between warmup and sample —
+        jit caches on input shardings, so a replicated warmup would
+        compile a program sample() never runs."""
+        if self._data_shardings is None:
+            return tiles, keys, yxs
+        import jax
+
+        tile_s, key_s, yx_s = self._data_shardings
+        return (
+            jax.device_put(tiles, tile_s),
+            jax.device_put(keys, key_s),
+            jax.device_put(yxs, yx_s),
+        )
+
+    def collect(self, result):
+        """Materialise a sample() result on the host. Sharded results
+        gather via parallel/collective.host_collect (cross-device over
+        ICI, cross-process over DCN); unsharded results take the plain
+        numpy path. Wired as the TilePipeline's ``to_host`` stage."""
+        if self.data_parallel <= 1:
+            from ..utils import image as img_utils
+
+            return img_utils.ensure_numpy(result)
+        from ..parallel.collective import host_collect
+        from ..telemetry.instruments import mesh_gather_seconds
+
+        started = time.monotonic()
+        host = host_collect(result)
+        mesh_gather_seconds().observe(
+            time.monotonic() - started, role=self.role
+        )
+        return host
+
     # --- execution --------------------------------------------------------
 
     def sample(self, idxs: Sequence[int]):
         """Process ``idxs`` (one chunk, len <= k_max) -> [n, B, ...]."""
         import jax.numpy as jnp
-
-        from ..ops.upscale import bucket_for
 
         idxs = [int(t) for t in idxs]
         n = len(idxs)
@@ -192,16 +288,23 @@ class GrantSampler:
             ]
             self.buckets_used.add(1)
             return jnp.stack(outs, axis=0)
-        bucket = bucket_for(n, self.k_max)
+        bucket = self._bucket_for(n)
         reps = -(-bucket // n)
         padded = (idxs * reps)[:bucket]
         sel = jnp.asarray(padded)
         tiles = jnp.take(self.extracted, sel, axis=0)
         keys = self._keys_for(padded)
         yxs = jnp.take(self.positions, sel, axis=0)
+        tiles, keys, yxs = self._place(tiles, keys, yxs)
         out = self._batched(self.params, tiles, keys, self.pos, self.neg, yxs)
         self.buckets_used.add(bucket)
         pipeline_batches_total().inc(role=self.role, bucket=str(bucket))
+        if self.data_parallel > 1:
+            from ..telemetry.instruments import mesh_batch_share
+
+            mesh_batch_share().set(
+                bucket // self.data_parallel, role=self.role
+            )
         if bucket > n:
             self.padded_tiles += bucket - n
             pipeline_padded_tiles_total().inc(bucket - n, role=self.role)
@@ -227,14 +330,12 @@ class GrantSampler:
                 if self._batched is not None:
                     idxs = [0] * int(bucket)
                     sel = jnp.asarray(idxs)
-                    args = (
-                        self.params,
+                    tiles, keys, yxs = self._place(
                         jnp.take(self.extracted, sel, axis=0),
                         self._keys_for(idxs),
-                        self.pos,
-                        self.neg,
                         jnp.take(self.positions, sel, axis=0),
                     )
+                    args = (self.params, tiles, keys, self.pos, self.neg, yxs)
                     fn = self._batched
                 else:
                     args = (

@@ -82,10 +82,16 @@ class HTTPWorkClient:
     so a retried submit whose first attempt actually landed is a no-op).
     """
 
-    def __init__(self, master_url: str, job_id: str, worker_id: str):
+    def __init__(
+        self, master_url: str, job_id: str, worker_id: str, devices: int = 1
+    ):
         self.master_url = master_url
         self.job_id = job_id
         self.worker_id = worker_id
+        # Advertised grant capacity (the worker mesh's data-axis width):
+        # rides every pull and heartbeat so the master's placement
+        # policy scales this worker's grants by its chip count.
+        self.devices = max(1, int(devices))
         # Captured at construction (on the executor thread, where the
         # dispatched prompt's trace is active); RPCs run on the server
         # loop where that context is NOT set.
@@ -134,7 +140,11 @@ class HTTPWorkClient:
         batch_max) alongside the compatible single `tile_idx`."""
 
         async def pull():
-            payload = {"job_id": self.job_id, "worker_id": self.worker_id}
+            payload = {
+                "job_id": self.job_id,
+                "worker_id": self.worker_id,
+                "devices": self.devices,
+            }
             if batch_max > 1:
                 payload["batch_max"] = int(batch_max)
             try:
@@ -206,7 +216,11 @@ class HTTPWorkClient:
             try:
                 await self._post(
                     "/distributed/heartbeat",
-                    {"job_id": self.job_id, "worker_id": self.worker_id},
+                    {
+                        "job_id": self.job_id,
+                        "worker_id": self.worker_id,
+                        "devices": self.devices,
+                    },
                 )
             except Exception as exc:  # noqa: BLE001 - heartbeats best-effort
                 debug_log(f"heartbeat failed: {exc}")
@@ -257,7 +271,11 @@ def _make_pull(client: Any):
     except (TypeError, ValueError):
         supports_batch = True  # unintrospectable callable: assume current API
     if supports_batch:
-        return lambda: client.request_tile(batch_max=SCHED_MAX_PULL_BATCH)
+        # the pull ceiling scales with advertised capacity: a D-chip
+        # worker may claim D x the max grant (the master's placement
+        # policy sizes the actual batch; this is just the client cap)
+        cap = max(1, int(getattr(client, "devices", 1)))
+        return lambda: client.request_tile(batch_max=SCHED_MAX_PULL_BATCH * cap)
     return client.request_tile
 
 
@@ -285,6 +303,7 @@ def run_worker_loop(
     tile_h: int | None = None,
     context=None,
     client: Any = None,
+    mesh: Any = None,
 ) -> None:
     """Pull grants until the master's queue drains, through the staged
     tile pipeline (graph/tile_pipeline.py): placement grants execute as
@@ -293,8 +312,32 @@ def run_worker_loop(
     sampling, and results flush in size-aware batches with a heartbeat
     per processed tile (plus idle heartbeats while a device batch is in
     flight). CDT_PIPELINE=0 falls back to fully synchronous staging
-    (same callbacks, no prefetch/overlap threads)."""
-    client = client or HTTPWorkClient(master_url, job_id, worker_id)
+    (same callbacks, no prefetch/overlap threads).
+
+    Multi-chip: the worker builds a local device mesh (CDT_MESH_SHAPE /
+    CDT_TP_SIZE; default = all local chips on the data axis on
+    accelerators) and scales its tile batch by the data-axis width — a
+    4-chip worker dispatches K x 4 tiles per sharded batch and
+    advertises 4x grant capacity to the master's placement policy.
+    Checkpoints over the CDT_MESH_HBM_GB per-chip budget shard their
+    parameters along the model axis instead of failing to load."""
+    from ..parallel.mesh import (
+        advertised_capacity,
+        data_axis_size,
+        note_serving_mesh,
+        worker_mesh,
+    )
+    from ..parallel.sharding import maybe_shard_params, params_byte_size
+
+    params = bundle.params
+    if mesh is None:
+        mesh = worker_mesh(params_bytes=params_byte_size(params))
+    note_serving_mesh(mesh)
+    capacity = advertised_capacity(mesh)
+    client = client or HTTPWorkClient(
+        master_url, job_id, worker_id, devices=capacity
+    )
+    params = maybe_shard_params(params, mesh)
 
     _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
@@ -307,9 +350,10 @@ def run_worker_loop(
     )
     key = jax.random.key(seed)
     positions = grid.positions_array()
+    data_width = data_axis_size(mesh) if mesh is not None else 1
     grant_sampler = GrantSampler(
-        process, bundle.params, extracted, key, positions, pos, neg,
-        k_max=tile_scan_batch(), role="worker",
+        process, params, extracted, key, positions, pos, neg,
+        k_max=tile_scan_batch() * data_width, role="worker", mesh=mesh,
     )
 
     # Warm the tile-processor compile while the ready poll waits on the
@@ -390,6 +434,9 @@ def run_worker_loop(
         pull=pull,
         sample=grant_sampler.sample,
         chunks=grant_sampler.chunks,
+        # sharded batches gather host-side via host_collect; unsharded
+        # ones take the plain numpy path (identical to the default)
+        to_host=grant_sampler.collect,
         emit=emit,
         flush=flush,
         heartbeat=client.heartbeat,
@@ -551,9 +598,25 @@ def run_master_elastic(
     # AMORTIZED service times, not one per-batch lump followed by
     # near-zero gaps (the watchdog's straggler median and the placement
     # speed EWMA both consume that stream).
+    from ..parallel.mesh import data_axis_size as _data_axis_size
+    from ..parallel.mesh import note_serving_mesh as _note_serving_mesh
+
+    _note_serving_mesh(mesh)
+    master_data_width = _data_axis_size(mesh) if mesh is not None else 1
+    # the master's own chip count must reach the placement policy the
+    # same way workers' does: its submit_flush latencies are amortized
+    # D x lower, so without this per_chip_ratio("master") reads ~D x
+    # inflated and batch sizing favors a wide-but-mediocre master.
+    # worker_capacity is written only from the server loop (store.py),
+    # so hop there like every other store call in this function.
+    async def _note_master_capacity() -> None:
+        store.note_worker_capacity("master", master_data_width)
+
+    run_async_in_server_loop(_note_master_capacity())
     grant_sampler = GrantSampler(
         process, bundle.params, extracted, key, positions, pos, neg,
-        k_max=tile_scan_batch(), role="master",
+        k_max=tile_scan_batch() * master_data_width, role="master",
+        mesh=mesh,
     )
     empty_pulls = 0
     while empty_pulls < 2:
@@ -582,6 +645,9 @@ def run_master_elastic(
                 context.check_interrupted()
             with _stage("sample", "master", chunk[0], batch=list(chunk)):
                 result = grant_sampler.sample(chunk)
+                if grant_sampler.data_parallel > 1:
+                    # gather the sharded batch host-side before blending
+                    result = grant_sampler.collect(result)
             run_async_in_server_loop(
                 store.submit_flush(
                     job_id, "master",

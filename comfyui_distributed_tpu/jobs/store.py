@@ -75,6 +75,48 @@ class JobStore:
         # heartbeat so a recovered master can release its admission hold
         # the moment a worker re-registers.
         self.on_worker_seen: Optional[Callable[[str], None]] = None
+        # Advertised grant capacity per worker (mesh data-axis chip
+        # count), carried on pull/heartbeat RPCs and forwarded to the
+        # placement policy so grants scale with fleet shape. Advisory:
+        # written only from the server loop, read by status surfaces.
+        self.worker_capacity: dict[str, int] = {}
+
+    def note_worker_capacity(self, worker_id: str, devices: Any) -> None:
+        """Record a worker's advertised chip count (from the `devices`
+        field of a pull or heartbeat) and forward it to the placement
+        policy. `devices` is an UNTRUSTED RPC field that multiplies
+        server-side grant caps, so it is clamped to MAX_WORKER_DEVICES;
+        malformed values are ignored — capacity is advisory and must
+        never fail a work RPC."""
+        from ..scheduler.placement import MAX_TRACKED_WORKERS, MAX_WORKER_DEVICES
+
+        try:
+            devices = max(1, min(int(devices), MAX_WORKER_DEVICES))
+        except (TypeError, ValueError):
+            return
+        if worker_id in self.worker_capacity:
+            # pop-then-reinsert: eviction below is oldest-ADVERTISED,
+            # so an actively-heartbeating worker must move to the end
+            self.worker_capacity.pop(worker_id)
+        elif len(self.worker_capacity) >= MAX_TRACKED_WORKERS:
+            # arbitrary worker ids arrive on any heartbeat: bound the
+            # status cache by evicting the oldest-advertised entry
+            self.worker_capacity.pop(next(iter(self.worker_capacity)))
+        self.worker_capacity[worker_id] = devices
+        placement = self.placement
+        set_capacity = getattr(placement, "set_capacity", None)
+        if set_capacity is None:
+            return
+        try:
+            # dedup against the POLICY's state, not a local cache: if
+            # the policy forgot this worker (or one set failed), the
+            # next advertisement must land, not be swallowed
+            get_capacity = getattr(placement, "capacity", None)
+            if get_capacity is not None and get_capacity(worker_id) == devices:
+                return
+            set_capacity(worker_id, devices)
+        except Exception as exc:  # noqa: BLE001 - placement is advisory
+            debug_log(f"placement set_capacity({worker_id}) failed: {exc}")
 
     def _journal(self, record: dict[str, Any]) -> None:
         sink = self.journal_sink

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import samplers as smp
-from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..parallel.mesh import DATA_AXIS, data_axis_size, shard_map_compat
 from ..parallel.seeds import participant_keys
 from .pipeline import _Static, maybe_cast_params
 from .registry import create_model, get_config, model_family
@@ -333,12 +333,12 @@ def _t2v_parallel_jit(
         latents = smp.sample_flow(model, x, timesteps, (pos, neg))
         return decode_frames(bundle, latents)
 
-    return jax.shard_map(
+    return shard_map_compat(
         per_chip,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P()),
         out_specs=P(DATA_AXIS),
-        check_vma=False,
+        check=False,
     )(keys, params, pos, neg)
 
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import pipeline as pl
-from ..parallel.mesh import DATA_AXIS, data_axis_size
+from ..parallel.mesh import DATA_AXIS, data_axis_size, shard_map_compat
 from ..utils.constants import tile_scan_batch
 from . import samplers as smp
 from . import tiles as tile_ops
@@ -394,13 +394,20 @@ def grant_buckets(k_max: int) -> tuple[int, ...]:
     return tuple(sizes)
 
 
-def bucket_for(n: int, k_max: int) -> int:
-    """Smallest grant bucket that fits `n` tiles (n clamped to k_max)."""
-    n = max(1, min(int(n), max(1, int(k_max))))
-    for size in grant_buckets(k_max):
+def bucket_for(
+    n: int, k_max: int, buckets: tuple[int, ...] | None = None
+) -> int:
+    """Smallest grant bucket that fits `n` tiles (n clamped to the
+    largest bucket). `buckets` overrides the default grant_buckets
+    set — the mesh-parallel sampler passes its data-width-rounded
+    buckets so one first-fit implementation serves both tiers."""
+    if buckets is None:
+        buckets = grant_buckets(k_max)
+    n = max(1, min(int(n), buckets[-1]))
+    for size in buckets:
         if size >= n:
             return size
-    return max(1, int(k_max))
+    return buckets[-1]
 
 
 def _scan_tiles(one, extracted, keys, positions, tile_batch: int):
@@ -551,12 +558,12 @@ def upscale_mesh(
         processed = _scan_tiles(one, tiles_shard, keys, yx_shard, tile_batch)
         return jax.lax.all_gather(processed, DATA_AXIS, axis=0, tiled=True)
 
-    gathered = jax.shard_map(
+    gathered = shard_map_compat(
         per_chip_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
         out_specs=P(),
-        check_vma=False,
+        check=False,
     )(extracted, global_idx, positions, params, pos, neg)
     return tile_ops.blend_tiles(gathered[:t], grid)
 

@@ -8,9 +8,13 @@ an all-gather over ICI.
 
 from .mesh import (  # noqa: F401
     MeshSpec,
+    advertised_capacity,
     build_mesh,
     data_axis_size,
     describe_topology,
     local_device_count,
+    mesh_summary,
+    model_axis_size,
+    worker_mesh,
 )
 from .seeds import fold_seed_for_participant, participant_keys  # noqa: F401

@@ -19,7 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import pipeline as pl
 from ..ops import samplers as smp
-from .mesh import DATA_AXIS, data_axis_size
+from .mesh import DATA_AXIS, data_axis_size, shard_map_compat
 from .seeds import participant_keys
 
 
@@ -65,12 +65,12 @@ def _parallel_txt2img_jit(
         )
         return bundle.vae.apply(params["vae"], latents, method="decode")
 
-    return jax.shard_map(
+    return shard_map_compat(
         per_chip,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P()),
         out_specs=P(DATA_AXIS),
-        check_vma=False,
+        check=False,
     )(keys, params, context_pos, context_neg)
 
 

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils.exceptions import MeshError
+from ..utils.logging import debug_log
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -85,8 +86,201 @@ def build_mesh(
     return Mesh(dev_array, names)
 
 
+def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across jax versions. jax >= 0.5 exposes it at
+    the top level with ``check_vma``; 0.4.x has only
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep``. Every
+    mesh-tier call site routes through here — without the shim the
+    whole sharded path raises AttributeError on 0.4.x runtimes the
+    moment a multi-device mesh exists."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
+
+
 def data_axis_size(mesh: Mesh) -> int:
     return int(mesh.shape.get(DATA_AXIS, 1))
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    return int(mesh.shape.get(MODEL_AXIS, 1))
+
+
+def _parse_mesh_shape(raw: str | None) -> dict[str, int] | None:
+    """``CDT_MESH_SHAPE`` grammar: ``"<data>,<model>"`` (e.g. ``"4,1"``,
+    ``"-1,2"``; -1 infers the remainder) or a single ``"<data>"``.
+    Malformed values fall back to None (auto layout) rather than
+    refusing to serve."""
+    if not raw:
+        return None
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    try:
+        sizes = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if not sizes or len(sizes) > 2:
+        return None
+    if len(sizes) == 1:
+        sizes.append(1)
+    return {DATA_AXIS: sizes[0], MODEL_AXIS: sizes[1]}
+
+
+def worker_mesh(
+    params_bytes: int | None = None,
+    devices: Sequence[Any] | None = None,
+) -> Mesh | None:
+    """The production tile tier's local mesh, resolved from the
+    CDT_MESH_SHAPE / CDT_TP_SIZE knob pair (plus the CDT_MESH_HBM_GB
+    auto-TP budget rule when ``params_bytes`` is known).
+
+    Default (no knobs set): a pure data mesh over all local chips on
+    accelerator platforms — every chip services tile grants, so a
+    4-chip worker advertises 4x grant capacity. On CPU the default is
+    None (single-participant, the historical loop): forced host
+    devices are a test construction, and auto-fanning the elastic tier
+    across them would silently change the golden-exact K=1 path. CPU
+    meshes are opt-in via the knobs (the mesh-parity suite does).
+
+    Returns None when the resolved mesh would be a single participant
+    with no model sharding — callers then take the unsharded path.
+    """
+    if devices is None:
+        try:
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 - backend not available
+            return None
+    devices = list(devices)
+    if not devices:
+        return None
+    n = len(devices)
+    shape = _parse_mesh_shape(os.environ.get("CDT_MESH_SHAPE"))
+    try:
+        tp = int(os.environ.get("CDT_TP_SIZE", "0"))
+    except ValueError:
+        tp = 0
+    if tp <= 0 and params_bytes:
+        tp = auto_tp_size(params_bytes, n)
+    if shape is None:
+        if devices[0].platform == "cpu" and tp <= 1:
+            return None  # opt-in only on CPU (see docstring)
+        if n <= 1 and tp <= 1:
+            return None
+        shape = {DATA_AXIS: -1, MODEL_AXIS: max(1, tp)}
+    elif tp > 1:
+        # CDT_TP_SIZE overrides only the model entry — an explicit
+        # data pin survives unless the combination exceeds the host,
+        # in which case the data axis reverts to inferred
+        shape = dict(shape, **{MODEL_AXIS: tp})
+        if shape[DATA_AXIS] != -1 and shape[DATA_AXIS] * tp > n:
+            shape[DATA_AXIS] = -1
+    # an explicit shape smaller than the host uses the leading subset
+    # of devices (chip pinning for shared hosts); -1 axes span them all
+    explicit = math.prod(s for s in shape.values() if s != -1)
+    if all(s != -1 for s in shape.values()) and 0 < explicit < n:
+        devices = devices[:explicit]
+    try:
+        mesh = build_mesh(shape, devices)
+    except MeshError as exc:
+        # mesh knobs are advisory, like capacity: a non-divisible
+        # combination must not kill the worker before its first pull
+        debug_log(f"worker_mesh: {shape} over {len(devices)} devices: {exc}")
+        return None
+    if data_axis_size(mesh) <= 1 and model_axis_size(mesh) <= 1:
+        return None
+    return mesh
+
+
+def auto_tp_size(params_bytes: int, n_devices: int) -> int:
+    """The HBM budget rule: the smallest power-of-two model-axis size
+    (<= n_devices) whose per-chip parameter share fits CDT_MESH_HBM_GB
+    GiB. 0/unset budget disables auto-TP (returns 1) — checkpoints
+    that don't fit then fail to load exactly as before, loudly."""
+    try:
+        budget_gb = float(os.environ.get("CDT_MESH_HBM_GB", "0"))
+    except ValueError:
+        budget_gb = 0.0
+    if budget_gb <= 0 or params_bytes <= 0:
+        return 1
+    budget = budget_gb * (1 << 30)
+    # the data axis infers as n/tp, so tp must also divide n — on a
+    # 6-chip host the ladder is 1, 2, never 4 or 8
+    max_tp = 1
+    while max_tp * 2 <= n_devices and n_devices % (max_tp * 2) == 0:
+        max_tp *= 2
+    tp = 1
+    while tp < max_tp and params_bytes / tp > budget:
+        tp *= 2
+    if params_bytes / tp > budget:
+        # even the widest divisible TP is over budget: proceed (the
+        # load may still fit — the budget is a conservative rule) but
+        # say so, or an OOM here looks like the rule never fired
+        debug_log(
+            f"auto_tp_size: {params_bytes / (1 << 30):.1f} GiB / tp={tp} "
+            f"still exceeds CDT_MESH_HBM_GB={budget_gb:g} per-chip budget"
+        )
+    return tp
+
+
+def mesh_summary(mesh: Mesh | None) -> dict[str, int]:
+    """Compact mesh shape for telemetry/status surfaces."""
+    if mesh is None:
+        return {"data": 1, "model": 1, "devices": 1}
+    return {
+        "data": data_axis_size(mesh),
+        "model": model_axis_size(mesh),
+        "devices": int(mesh.size),
+    }
+
+
+_serving_mesh_summary: dict[str, int] | None = None
+# knob-only fallback cache, keyed by the knob values so env changes
+# (tests, operator retunes) invalidate it: (knobs, summary)
+_fallback_mesh_summary: tuple[tuple, dict[str, int]] | None = None
+
+
+def note_serving_mesh(mesh: Mesh | None) -> None:
+    """Record the mesh actually constructed to serve tile grants (the
+    elastic loops call this at startup). Status surfaces must report
+    THIS shape, not a knob-only ``worker_mesh()`` re-derivation — the
+    two differ exactly when the auto-TP budget rule needed
+    ``params_bytes`` (a checkpoint over budget shrinks the data axis,
+    and with it the advertised capacity)."""
+    global _serving_mesh_summary
+    _serving_mesh_summary = mesh_summary(mesh)
+
+
+def serving_mesh_summary() -> dict[str, int]:
+    """The recorded serving mesh, falling back to a knob-only
+    ``worker_mesh()`` resolution when no elastic loop has run in this
+    process yet. The fallback is cached per knob values — status
+    surfaces poll continuously and must not construct a throwaway Mesh
+    per request."""
+    if _serving_mesh_summary is not None:
+        return dict(_serving_mesh_summary)
+    global _fallback_mesh_summary
+    knobs = tuple(
+        os.environ.get(k)
+        for k in ("CDT_MESH_SHAPE", "CDT_TP_SIZE", "CDT_MESH_HBM_GB")
+    )
+    if _fallback_mesh_summary is None or _fallback_mesh_summary[0] != knobs:
+        _fallback_mesh_summary = (knobs, mesh_summary(worker_mesh()))
+    return dict(_fallback_mesh_summary[1])
+
+
+def advertised_capacity(mesh: Mesh | None) -> int:
+    """Grant capacity a worker reports to the master's placement
+    policy: the data-axis width of its mesh (chips servicing tile
+    fan-out; model-axis chips serve the same tiles, not more of them).
+    1 without a mesh — the historical single-participant worker."""
+    return data_axis_size(mesh) if mesh is not None else 1
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

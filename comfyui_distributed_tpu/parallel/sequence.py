@@ -21,7 +21,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.dit import DiTConfig, VideoDiT
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map_compat
 
 
 @partial(jax.jit, static_argnames=("config", "mesh_static", "axis"))
@@ -33,12 +33,12 @@ def _cp_forward_jit(config, mesh_static, axis, params, x, t, context):
     def per_chip(params, x_shard, t, context):
         return model.apply(params, x_shard, t, context)
 
-    return jax.shard_map(
+    return shard_map_compat(
         per_chip,
         mesh=mesh,
         in_specs=(P(), P(None, axis), P(), P()),
         out_specs=P(None, axis),
-        check_vma=False,
+        check=False,
     )(params, x, t, context)
 
 

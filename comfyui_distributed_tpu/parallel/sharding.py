@@ -57,3 +57,27 @@ def param_specs(params: Any, mesh: Mesh) -> Any:
 def replicate(tree: Any, mesh: Mesh) -> Any:
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(lambda leaf: jax.device_put(leaf, sharding), tree)
+
+
+def params_byte_size(params: Any) -> int:
+    """Total parameter bytes (as stored) — the numerator of the
+    CDT_MESH_HBM_GB auto-TP budget rule."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        size = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+        total += size * itemsize
+    return total
+
+
+def maybe_shard_params(params: Any, mesh: Mesh | None) -> Any:
+    """Shard a checkpoint's parameters along the mesh's model axis
+    (tensor parallel) when the mesh has one; otherwise return params
+    unchanged. This is how checkpoints exceeding one chip's HBM load
+    at all: each chip holds a 1/TP slice and XLA inserts the gathers
+    under the same jitted tile processor (docs/performance.md, mesh
+    section — TP outputs are allclose, not bit-identical: sharded
+    contractions change the reduction order)."""
+    if mesh is None or int(mesh.shape.get(MODEL_AXIS, 1)) <= 1:
+        return params
+    return shard_params(params, mesh)

@@ -151,6 +151,7 @@ def run_chaos_usdu(
     pipeline: bool = True,
     prefetch: bool = False,
     journal_dir: Optional[str] = None,
+    mesh_devices: int = 0,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -188,6 +189,14 @@ def run_chaos_usdu(
     straggler receives measurably fewer tiles while the canvas stays
     bit-identical (placement must change WHO, never WHAT).
 
+    `mesh_devices`: N > 1 runs master AND worker grant samplers on an
+    N-participant local device mesh (parallel/mesh.build_mesh over the
+    first N host devices — the tier-1 suite forces virtual CPU devices,
+    conftest.py): batches shard across the data axis with NamedSharding
+    and gather through host_collect, exactly the production multi-chip
+    path. The mesh-parity acceptance asserts the canvas is
+    bit-identical to the 1-device run, square and ragged grids alike.
+
     `tile_batch`/`pipeline`/`prefetch`: the batched-pipelined data path
     (graph/tile_pipeline.py). Worker threads ALWAYS run the production
     TilePipeline (this harness is its chaos coverage); `pipeline=False`
@@ -210,6 +219,22 @@ def run_chaos_usdu(
     from ..utils import image as img_utils
     from ..utils.async_helpers import run_async_in_server_loop
     from ..utils.exceptions import JobQueueError
+
+    mesh = None
+    if mesh_devices and int(mesh_devices) > 1:
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, build_mesh
+
+        local = jax.local_devices()
+        if len(local) < int(mesh_devices):
+            raise ValueError(
+                f"mesh_devices={mesh_devices} but only {len(local)} local "
+                "device(s); force more with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
+        mesh = build_mesh(
+            {DATA_AXIS: int(mesh_devices), MODEL_AXIS: 1},
+            devices=local[: int(mesh_devices)],
+        )
 
     injector = FaultInjector(fault_plan) if fault_plan else None
     store = JobStore(fault_injector=injector)
@@ -287,7 +312,7 @@ def run_chaos_usdu(
         token = tracer.activate(trace_id)
         sampler = GrantSampler(
             _stub_process, None, extracted, key, grid.positions_array(),
-            None, None, k_max=tile_batch, role="worker",
+            None, None, k_max=tile_batch, role="worker", mesh=mesh,
         )
         flush_pending: dict[int, list] = {}
 
@@ -354,6 +379,7 @@ def run_chaos_usdu(
                 pull=pull,
                 sample=sample,
                 chunks=sampler.chunks,
+                to_host=sampler.collect,
                 emit=emit,
                 flush=flush,
                 heartbeat=heartbeat,
@@ -422,6 +448,7 @@ def run_chaos_usdu(
                         bundle, image, pos, neg,
                         job_id=job_id,
                         enabled_worker_ids=list(workers),
+                        mesh=mesh,
                         upscale_by=upscale_by, tile=tile, padding=padding,
                         steps=1, sampler="euler", scheduler="karras",
                         cfg=1.0, denoise=0.3, seed=seed, context=ctx,

@@ -40,6 +40,19 @@ from typing import Any, Optional
 
 from ..utils import constants
 
+# Sanity ceiling on a worker's advertised chip count. The field rides
+# an untrusted client RPC and multiplies the server-side grant cap
+# (batch_size clamps to max_batch x capacity), so without a bound one
+# bogus worker could be granted an entire job's queue in one pull.
+# Real TPU hosts top out well below this.
+MAX_WORKER_DEVICES = 64
+
+# Bound on distinct worker ids whose capacity is tracked (and persisted
+# via export_state): capacity arrives on unauthenticated heartbeats, so
+# a client cycling worker ids must not grow master memory or durability
+# snapshots without limit. Far above any real fleet.
+MAX_TRACKED_WORKERS = 1024
+
 
 class PlacementPolicy:
     def __init__(
@@ -80,6 +93,9 @@ class PlacementPolicy:
         self._ewma: dict[str, float] = {}
         self._samples: dict[str, int] = {}
         self._trimmed: dict[str, int] = {}
+        # advertised chip counts (worker mesh data-axis width), fed by
+        # the pull/heartbeat RPCs through JobStore.note_worker_capacity
+        self._capacity: dict[str, int] = {}
 
     # --- inputs -----------------------------------------------------------
 
@@ -95,11 +111,39 @@ class PlacementPolicy:
             )
             self._samples[worker_id] = self._samples.get(worker_id, 0) + 1
 
+    def set_capacity(self, worker_id: str, devices: int) -> None:
+        """Advertised grant capacity (chip count) for a worker — the
+        data-axis width of its local mesh, carried on every pull and
+        heartbeat. Scales the pull-batch ceiling and the cold-start
+        grant size so a 4-chip worker pulls ~4x the tiles of a 1-chip
+        worker at equal per-chip speed. Clamped to MAX_WORKER_DEVICES:
+        the value originates in a client RPC and multiplies server-side
+        grant caps, so it must never be unbounded."""
+        devices = max(1, min(int(devices), MAX_WORKER_DEVICES))
+        with self._lock:
+            if (
+                worker_id not in self._capacity
+                and len(self._capacity) >= MAX_TRACKED_WORKERS
+            ):
+                # evict a worker with no latency history first (likely
+                # garbage ids), else the oldest-tracked one
+                stale = next(
+                    (w for w in self._capacity if w not in self._ewma),
+                    next(iter(self._capacity)),
+                )
+                self._capacity.pop(stale)
+            self._capacity[worker_id] = devices
+
+    def capacity(self, worker_id: str) -> int:
+        with self._lock:
+            return self._capacity.get(worker_id, 1)
+
     def forget(self, worker_id: str) -> None:
         with self._lock:
             self._ewma.pop(worker_id, None)
             self._samples.pop(worker_id, None)
             self._trimmed.pop(worker_id, None)
+            self._capacity.pop(worker_id, None)
 
     # --- model ------------------------------------------------------------
 
@@ -111,19 +155,42 @@ class PlacementPolicy:
             if self._samples.get(wid, 0) >= self.min_samples and ewma > 0
         }
 
-    def speed_ratio(self, worker_id: str) -> float:
-        """This worker's speed relative to the fleet mean; 1.0 until
-        enough samples exist (unknown workers are assumed average, so
-        cold-start behavior is exactly the old uniform pull)."""
-        with self._lock:
-            speeds = self._speeds_locked()
-            mine = speeds.get(worker_id)
+    @staticmethod
+    def _fleet_ratio(speeds: dict[str, float], worker_id: str) -> float:
+        """``speeds[worker_id]`` relative to the fleet mean; 1.0 while
+        this worker (or the fleet) lacks samples — unknown workers are
+        assumed average, so cold-start behavior is exactly the old
+        uniform pull."""
+        mine = speeds.get(worker_id)
         if mine is None or not speeds:
             return 1.0
         mean = sum(speeds.values()) / len(speeds)
         if mean <= 0:
             return 1.0
         return mine / mean
+
+    def speed_ratio(self, worker_id: str) -> float:
+        """This worker's throughput relative to the fleet mean."""
+        with self._lock:
+            speeds = self._speeds_locked()
+        return self._fleet_ratio(speeds, worker_id)
+
+    def per_chip_ratio(self, worker_id: str) -> float:
+        """Measured speed per advertised chip, normalized against the
+        fleet's per-chip mean. This is the capacity-neutral quality
+        signal: a 4-chip worker's amortized per-tile latency is ~4x
+        smaller than an equal-chip 1-chip worker's, so raw throughput
+        ratios would double-count capacity once `batch_size` multiplies
+        by it — and the job tail (grants of one tile) runs on ONE chip,
+        so tail trimming must compare chips, not fleets."""
+        with self._lock:
+            speeds = self._speeds_locked()
+            caps = dict(self._capacity)
+        per_chip = {
+            wid: speed / max(1, caps.get(wid, 1))
+            for wid, speed in speeds.items()
+        }
+        return self._fleet_ratio(per_chip, worker_id)
 
     # --- decisions --------------------------------------------------------
 
@@ -141,13 +208,29 @@ class PlacementPolicy:
         a bucket under ANY K_max, either directly or after the executor
         splits it into K_max-sized chunks whose pow2 remainders are
         buckets too. The ragged job tail still produces sub-bucket
-        grants; the executor pads those."""
+        grants; the executor pads those.
+
+        Advertised capacity multiplies both the sized grant and its
+        ceiling: a D-chip worker's per-chip speed ratio x base_batch x
+        D, clamped to max_batch x D — so a 4-chip worker pulls 4x the
+        tiles of an equal-per-chip-speed 1-chip worker from its very
+        first grant (the capacity is advertised before any latency
+        sample exists), and the measured per-chip ratio then corrects
+        for actual chip quality without double-counting capacity.
+        """
         if remaining <= 0:
             return 1
         if remaining <= self.tail_tiles:
             return 1  # tail tiles are precious: no batch hoarding
-        ratio = self.speed_ratio(worker_id)
-        size = max(1, min(int(round(ratio * self.base_batch)), self.max_batch))
+        cap = self.capacity(worker_id)
+        ratio = self.per_chip_ratio(worker_id)
+        size = max(
+            1,
+            min(
+                int(round(ratio * self.base_batch * cap)),
+                self.max_batch * cap,
+            ),
+        )
         aligned = 1
         while aligned * 2 <= size:
             aligned *= 2
@@ -174,7 +257,10 @@ class PlacementPolicy:
         if state in ("suspect", "quarantined", "probing"):
             self._note_trim(worker_id)
             return False
-        if self.speed_ratio(worker_id) < self.trim_ratio:
+        # per-chip, not throughput: a tail grant is one tile on one
+        # chip, so chip quality decides who should run it (a slow
+        # 4-chip worker must not hide behind its aggregate throughput)
+        if self.per_chip_ratio(worker_id) < self.trim_ratio:
             self._note_trim(worker_id)
             return False
         return True
@@ -194,6 +280,7 @@ class PlacementPolicy:
             return {
                 "ewma": {w: round(v, 9) for w, v in self._ewma.items()},
                 "samples": dict(self._samples),
+                "capacity": dict(self._capacity),
             }
 
     def restore_state(self, state: dict) -> None:
@@ -209,6 +296,15 @@ class PlacementPolicy:
                     self._samples[str(worker_id)] = int(count)
                 except (TypeError, ValueError):
                     continue
+            for worker_id, devices in (state.get("capacity") or {}).items():
+                if len(self._capacity) >= MAX_TRACKED_WORKERS:
+                    break
+                try:
+                    self._capacity[str(worker_id)] = max(
+                        1, min(int(devices), MAX_WORKER_DEVICES)
+                    )
+                except (TypeError, ValueError):
+                    continue
 
     # --- observability ----------------------------------------------------
 
@@ -217,12 +313,15 @@ class PlacementPolicy:
             ewma = dict(self._ewma)
             samples = dict(self._samples)
             trimmed = dict(self._trimmed)
+            capacity = dict(self._capacity)
             speeds = self._speeds_locked()
         mean = sum(speeds.values()) / len(speeds) if speeds else 0.0
         return {
             "workers": {
                 wid: {
-                    "ewma_tile_seconds": round(ewma[wid], 6),
+                    "ewma_tile_seconds": (
+                        round(ewma[wid], 6) if wid in ewma else None
+                    ),
                     "samples": samples.get(wid, 0),
                     "speed_ratio": (
                         round(speeds[wid] / mean, 4)
@@ -230,8 +329,9 @@ class PlacementPolicy:
                         else None
                     ),
                     "tail_trims": trimmed.get(wid, 0),
+                    "devices": capacity.get(wid, 1),
                 }
-                for wid in sorted(ewma)
+                for wid in sorted(set(ewma) | set(capacity))
             },
             "base_batch": self.base_batch,
             "max_batch": self.max_batch,

@@ -342,6 +342,36 @@ def tiles_processed_total() -> Counter:
     )
 
 
+# --- local device mesh (parallel/mesh.py + mesh-parallel GrantSampler) -----
+
+def mesh_devices() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_mesh_devices",
+        "Local mesh shape per role: devices along each axis "
+        "(data = tile fan-out participants, model = tensor-parallel "
+        "shards, total = chips in the mesh)",
+        ("role", "axis"),
+    )
+
+
+def mesh_batch_share() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_mesh_batch_share",
+        "Tiles each mesh participant computed in the most recent "
+        "sharded dispatch (bucket size / data-axis width)",
+        ("role",),
+    )
+
+
+def mesh_gather_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_mesh_gather_seconds",
+        "Host-side gather latency of a sharded tile batch "
+        "(parallel/collective.host_collect) per role",
+        ("role",),
+    )
+
+
 # --- elastic tile pipeline (graph/tile_pipeline.py) ------------------------
 
 def pipeline_batches_total() -> Counter:

@@ -226,6 +226,21 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_BLEND", "unset", "ops",
          "`segment` selects segment-sum canvas blending for large grids."),
     # --- parallel --------------------------------------------------------
+    Knob("CDT_MESH_SHAPE", "unset", "parallel",
+         "Local device mesh axis sizes as `data,model` (e.g. `4,1`, `-1,2`; "
+         "-1 infers the remainder). Unset auto-builds a pure data mesh over "
+         "all local chips on accelerator platforms; on CPU the mesh is "
+         "opt-in via this knob (forced host devices are a test construction)."),
+    Knob("CDT_MESH_HBM_GB", "0", "parallel",
+         "Per-chip HBM budget in GiB for the auto-tensor-parallel rule: a "
+         "checkpoint whose parameters exceed it shards along the model axis "
+         "(smallest power-of-two TP that fits) instead of failing to load; "
+         "0 disables."),
+    Knob("CDT_TP_SIZE", "unset", "parallel",
+         "Tensor-parallel (model-axis) mesh size; overrides the model entry "
+         "of CDT_MESH_SHAPE. Parameters shard along this axis via "
+         "parallel/sharding.shard_params (TP outputs are allclose, not "
+         "bit-identical)."),
     Knob("CDT_MULTIHOST", "unset", "parallel",
          "`1` requires multihost initialization to succeed (hard error otherwise)."),
     Knob("CDT_COORDINATOR", "unset", "parallel",

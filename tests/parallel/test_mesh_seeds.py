@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from comfyui_distributed_tpu.parallel import collective, mesh as meshmod, seeds
+from comfyui_distributed_tpu.parallel.mesh import shard_map_compat
 from comfyui_distributed_tpu.utils.exceptions import MeshError
 
 
@@ -62,12 +63,12 @@ def test_shard_map_collector_gathers_in_participant_order():
         return collective.all_gather_batch(mine)
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             per_chip,
             mesh=m,
             in_specs=jax.sharding.PartitionSpec(),
             out_specs=jax.sharding.PartitionSpec(),
-            check_vma=False,
+            check=False,
         )
     )(jnp.zeros((1,)))
     gathered = collective.host_collect(out)

@@ -18,6 +18,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.environ["CDT_TEST_REPO"])
 from comfyui_distributed_tpu.parallel.multihost import maybe_init_multihost, is_multihost
+from comfyui_distributed_tpu.parallel.mesh import shard_map_compat
 
 assert maybe_init_multihost() is True
 assert is_multihost() is True
@@ -41,7 +42,7 @@ arr = jax.make_array_from_process_local_data(
     NamedSharding(mesh, P("data")), local, (4,)
 )
 out = jax.jit(
-    jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+    shard_map_compat(f, mesh=mesh, in_specs=P("data"), out_specs=P())
 )(arr)
 # global shards: [0, 1] (pid 0) + [10, 11] (pid 1) -> psum = 22
 assert float(out[0]) == 22.0, out

@@ -9,6 +9,7 @@ from jax.sharding import PartitionSpec as P
 from comfyui_distributed_tpu.models import create_model, get_config
 from comfyui_distributed_tpu.ops.ring_attention import ring_attention
 from comfyui_distributed_tpu.parallel import build_mesh
+from comfyui_distributed_tpu.parallel.mesh import shard_map_compat
 from comfyui_distributed_tpu.parallel.collective import host_collect
 from comfyui_distributed_tpu.parallel.sequence import video_forward_context_parallel
 
@@ -25,12 +26,12 @@ def test_ring_attention_matches_full():
     ref = jax.nn.dot_product_attention(q, k, v)
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda a, b, c: ring_attention(a, b, c, "data"),
             mesh=mesh,
             in_specs=(P(None, "data"), P(None, "data"), P(None, "data")),
             out_specs=P(None, "data"),
-            check_vma=False,
+            check=False,
         )
     )(q, k, v)
     np.testing.assert_allclose(

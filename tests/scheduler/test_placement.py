@@ -206,6 +206,190 @@ def test_flushed_batch_amortizes_service_time():
     asyncio.run(scenario())
 
 
+# --- device-count-aware placement (multi-chip workers) --------------------
+
+
+def test_capacity_scales_batch_from_cold_start():
+    """A 4-chip worker pulls 4x the tiles of a 1-chip worker BEFORE any
+    latency sample exists: capacity is advertised on the first pull,
+    speed is learned later."""
+    policy = PlacementPolicy(min_samples=2, base_batch=2, max_batch=8)
+    policy.set_capacity("w4", 4)
+    policy.set_capacity("w1", 1)
+    assert policy.batch_size("w4", remaining=100) == 8  # 2 x 4
+    assert policy.batch_size("w1", remaining=100) == 2
+    # the ceiling scales too (max_batch x capacity): a fast 4-chip
+    # worker sizes past the 1-chip cap of 8 and pow2-aligns below the
+    # 32-tile scaled ceiling
+    fast = PlacementPolicy(min_samples=1, base_batch=4, max_batch=8)
+    fast.set_capacity("w4", 4)
+    _feed(fast, "w4", 0.01)
+    _feed(fast, "w1", 0.08)  # w4 is ALSO faster per chip
+    assert fast.batch_size("w4", remaining=1000) == 16  # > 1-chip cap of 8
+    assert fast.batch_size("w1", remaining=1000) <= 8
+
+
+def test_per_chip_ratio_does_not_double_count_capacity():
+    """A 4-chip worker's amortized per-tile latency is ~4x smaller at
+    EQUAL per-chip speed (submit_flush divides the flush interval across
+    tiles); the per-chip ratio normalizes that out so batch_size's
+    capacity multiplier is applied exactly once."""
+    policy = PlacementPolicy(min_samples=1, base_batch=2, max_batch=8)
+    policy.set_capacity("w4", 4)
+    policy.set_capacity("w1", 1)
+    _feed(policy, "w4", 0.25)  # 4 tiles/sec across 4 chips
+    _feed(policy, "w1", 1.0)   # 1 tile/sec on 1 chip — equal per chip
+    assert policy.per_chip_ratio("w4") == pytest.approx(1.0, rel=1e-6)
+    assert policy.per_chip_ratio("w1") == pytest.approx(1.0, rel=1e-6)
+    # throughput ratio still shows the aggregate gap (status surfaces)
+    assert policy.speed_ratio("w4") > 1.0 > policy.speed_ratio("w1")
+    assert policy.batch_size("w4", remaining=100) == 8
+    assert policy.batch_size("w1", remaining=100) == 2
+
+
+def test_tail_trim_compares_chips_not_fleets():
+    """A tail grant runs one tile on one chip: a worker whose aggregate
+    throughput is average only because it has 4 mediocre chips must be
+    trimmed from the tail like any other slow chip."""
+    policy = PlacementPolicy(min_samples=1, tail_tiles=2, trim_ratio=0.5)
+    policy.set_capacity("wide-slow", 4)
+    policy.set_capacity("fast", 1)
+    _feed(policy, "wide-slow", 0.5)  # 2 t/s aggregate = 0.5 t/s/chip
+    _feed(policy, "fast", 0.5)       # 2 t/s on ONE chip
+    assert policy.may_pull("fast", remaining=2) is True
+    assert policy.may_pull("wide-slow", remaining=2) is False
+
+
+def test_capacity_rides_snapshot_and_durability_state():
+    policy = PlacementPolicy(min_samples=1)
+    policy.set_capacity("w4", 4)
+    _feed(policy, "w4", 0.1)
+    assert policy.snapshot()["workers"]["w4"]["devices"] == 4
+    state = policy.export_state()
+    assert state["capacity"] == {"w4": 4}
+    restored = PlacementPolicy(min_samples=1)
+    restored.restore_state(state)
+    assert restored.capacity("w4") == 4
+    assert restored.batch_size("w4", remaining=100) >= 4
+    policy.forget("w4")
+    assert policy.capacity("w4") == 1
+
+
+def test_four_device_worker_granted_4x_tiles_under_uniform_speed():
+    """The placement-scaling acceptance: over a whole job drained by
+    alternating pulls, an equal-speed 4-device worker receives >= 3x
+    the tiles of a 1-device worker. Deterministic — claim counts are a
+    pure function of the policy model (capacity advertised through the
+    JobStore seam, exactly like the `devices` RPC field)."""
+
+    async def scenario():
+        store = JobStore()
+        policy = PlacementPolicy(
+            min_samples=2, base_batch=2, max_batch=8, tail_tiles=0
+        )
+        store.placement = policy
+        # the seam the /distributed/request_image `devices` field feeds
+        store.note_worker_capacity("w4", 4)
+        store.note_worker_capacity("w1", 1)
+        assert store.worker_capacity == {"w4": 4, "w1": 1}
+        assert policy.capacity("w4") == 4
+        await store.init_tile_job("job", list(range(40)))
+        counts = {"w4": 0, "w1": 0}
+        while True:
+            claimed = False
+            for wid in ("w1", "w4"):
+                grant = await store.pull_tasks("job", wid, timeout=0.01)
+                counts[wid] += len(grant)
+                claimed = claimed or bool(grant)
+            if not claimed:
+                return counts
+
+    counts = asyncio.run(scenario())
+    assert sum(counts.values()) == 40
+    assert counts["w4"] >= 3 * counts["w1"], counts
+
+
+def test_note_worker_capacity_ignores_garbage_and_dedupes():
+    async def scenario():
+        store = JobStore()
+        calls = []
+
+        class Spy:
+            def __init__(self):
+                self.caps = {}
+
+            def capacity(self, wid):
+                return self.caps.get(wid, 1)
+
+            def set_capacity(self, wid, devices):
+                self.caps[wid] = devices
+                calls.append((wid, devices))
+
+        spy = Spy()
+        store.placement = spy
+        store.note_worker_capacity("w", "4")
+        store.note_worker_capacity("w", 4)      # policy already has it
+        store.note_worker_capacity("w", "bogus")  # ignored
+        store.note_worker_capacity("w", 0)      # clamps to 1
+        assert calls == [("w", 4), ("w", 1)]
+        assert store.worker_capacity["w"] == 1
+        # the dedup follows the POLICY's state: after the policy
+        # forgets the worker, the same advertisement must land again
+        store.note_worker_capacity("w", 4)
+        spy.caps.clear()
+        store.note_worker_capacity("w", 4)
+        assert calls[-2:] == [("w", 4), ("w", 4)]
+        # untrusted RPC field: huge counts clamp server-side
+        store.note_worker_capacity("w", 100000)
+        assert calls[-1] == ("w", 64)
+        assert store.worker_capacity["w"] == 64
+        # re-advertising moves a worker to the end of the bounded
+        # cache, so eviction order is oldest-ADVERTISED, not
+        # oldest-inserted — churn must not evict live workers
+        store.note_worker_capacity("a", 1)
+        store.note_worker_capacity("b", 2)
+        store.note_worker_capacity("a", 1)
+        assert list(store.worker_capacity) == ["w", "b", "a"]
+
+    asyncio.run(scenario())
+
+
+def test_capacity_tracking_is_bounded():
+    """Capacity arrives on unauthenticated heartbeats: cycling worker
+    ids must not grow policy state (persisted via export_state)
+    without limit, and garbage ids are evicted before workers with
+    real latency history."""
+    from comfyui_distributed_tpu.scheduler.placement import MAX_TRACKED_WORKERS
+
+    policy = PlacementPolicy(min_samples=1)
+    policy.record_latency("real", 0.1)
+    policy.set_capacity("real", 4)
+    for i in range(MAX_TRACKED_WORKERS + 8):
+        policy.set_capacity(f"garbage-{i}", 2)
+    state = policy.export_state()
+    assert len(state["capacity"]) <= MAX_TRACKED_WORKERS
+    assert policy.capacity("real") == 4
+    # restore honors the same bound
+    fresh = PlacementPolicy()
+    fresh.restore_state(
+        {"capacity": {f"g{i}": 1 for i in range(MAX_TRACKED_WORKERS + 50)}}
+    )
+    assert len(fresh.export_state()["capacity"]) <= MAX_TRACKED_WORKERS
+
+
+def test_capacity_clamped_to_max_worker_devices():
+    """devices multiplies the server-side grant cap, so a bogus huge
+    advertisement must not let one worker hoard an entire job."""
+    from comfyui_distributed_tpu.scheduler.placement import MAX_WORKER_DEVICES
+
+    policy = PlacementPolicy(base_batch=2, max_batch=4, tail_tiles=0)
+    policy.set_capacity("w", 10**6)
+    assert policy.capacity("w") == MAX_WORKER_DEVICES
+    assert policy.batch_size("w", remaining=10**9) <= 4 * MAX_WORKER_DEVICES
+    policy.restore_state({"capacity": {"w": 10**6}})
+    assert policy.capacity("w") == MAX_WORKER_DEVICES
+
+
 def test_broken_placement_fails_open():
     class Broken:
         def may_pull(self, *a):

@@ -47,6 +47,11 @@ DETERMINISM_PATHS = (
     # order, set iteration, or ambient entropy here would make
     # recovery non-reproducible (the idempotent-replay guarantee)
     "comfyui_distributed_tpu/durability/*.py",
+    # the mesh tier is now on the production hot path (mesh-parallel
+    # GrantSampler dispatches, host_collect gathers, participant seed
+    # folding): ordering or ambient entropy here would break the
+    # N-device vs 1-device bit-identical canvas guarantee
+    "comfyui_distributed_tpu/parallel/*.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
