@@ -28,6 +28,15 @@ def main(argv: list[str] | None = None) -> int:
              "an explicit opt-in)",
     )
     parser.add_argument("--worker", action="store_true")
+    parser.add_argument(
+        "--standby", type=str, default=None, metavar="URLS",
+        help="run as a warm-standby master tailing the given active "
+             "master URL(s) (comma-separated; or CDT_STANDBY_OF). "
+             "Requires CDT_JOURNAL_DIR — the lease file there is the "
+             "takeover arbitration medium. The standby serves 503 on "
+             "work RPCs until the active's lease expires, then "
+             "promotes itself in place (docs/durability.md §failover)",
+    )
     parser.add_argument("--config", type=str, default=None)
     parser.add_argument(
         "--platform", type=str, default=None,
@@ -63,7 +72,7 @@ def main(argv: list[str] | None = None) -> int:
 
     server = DistributedServer(
         port=args.port, is_worker=args.worker, config_path=args.config,
-        host=args.host,
+        host=args.host, standby_of=args.standby,
     )
 
     async def run():

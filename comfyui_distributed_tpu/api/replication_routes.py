@@ -1,0 +1,125 @@
+"""Replication route: the active master's journal as a live stream.
+
+    GET /distributed/replicate — WebSocket, standby masters only
+
+Wire protocol (JSON text frames, one per message):
+
+    repl_hello     {epoch, head_lsn, state}   full snapshot state at
+                                              attach time (the manager
+                                              shadow, serialized under
+                                              the manager lock)
+    repl_record    {record}                   one journaled record, in
+                                              lsn order (record carries
+                                              its lsn)
+    repl_heartbeat {epoch, head_lsn}          periodic head advance so
+                                              the standby can measure
+                                              lag while the journal is
+                                              quiet
+    repl_lost      {}                         the subscription buffer
+                                              overflowed; the stream is
+                                              closed and the standby
+                                              re-syncs from a fresh
+                                              hello on reconnect
+
+The (hello, records) pair is exactly consistent: the subscription is
+registered and the snapshot serialized under one manager lock hold
+(DurabilityManager.subscribe_replica), and frames at or below the
+snapshot's lsn are deduplicated replica-side — so no record is ever
+missed or double-applied regardless of attach timing.
+
+Only an ACTIVE journaled master serves this route: a standby (not yet
+promoted) answers 409 so a misconfigured standby-of-standby chain
+fails loudly instead of replicating an empty shadow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+
+from aiohttp import web
+
+from ..utils.async_helpers import run_blocking
+from ..utils.constants import STANDBY_POLL_SECONDS
+from ..utils.logging import debug_log
+
+
+def register(app: web.Application, server) -> None:
+    routes = ReplicationRoutes(server)
+    app.router.add_get("/distributed/replicate", routes.replicate)
+
+
+class ReplicationRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def replicate(self, request: web.Request) -> web.StreamResponse:
+        manager = getattr(self.server, "durability", None)
+        standby = getattr(self.server, "standby", None)
+        if manager is None:
+            return web.json_response(
+                {"error": "journaling disabled",
+                 "hint": "set CDT_JOURNAL_DIR on the active master"},
+                status=409,
+            )
+        if standby is not None and not standby.promoted:
+            return web.json_response(
+                {"error": "standby",
+                 "hint": "this master is itself a standby; replicate "
+                         "from the active master"},
+                status=409,
+            )
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        # registered so server.stop() can close a parked stream instead
+        # of waiting out the runner's graceful-shutdown timeout
+        self.server.replication_sockets.add(ws)
+        sub = manager.subscribe_replica()
+        debug_log(
+            f"replication: standby attached at lsn {sub.head_lsn} "
+            f"(epoch {sub.epoch})"
+        )
+        try:
+            await ws.send_str(
+                json.dumps(
+                    {
+                        "type": "repl_hello",
+                        "epoch": sub.epoch,
+                        "head_lsn": sub.head_lsn,
+                        "state": sub.snapshot_state,
+                    },
+                    default=str,
+                )
+            )
+            while not ws.closed:
+                # Park off-loop on the subscription's wakeup flag; the
+                # timeout doubles as the heartbeat cadence.
+                await run_blocking(sub.wait, STANDBY_POLL_SECONDS)
+                for record in sub.pop():
+                    await ws.send_str(
+                        json.dumps(
+                            {"type": "repl_record", "record": record},
+                            default=str,
+                        )
+                    )
+                if sub.lost:
+                    await ws.send_str(json.dumps({"type": "repl_lost"}))
+                    break
+                await ws.send_str(
+                    json.dumps(
+                        {
+                            "type": "repl_heartbeat",
+                            "epoch": manager.epoch,
+                            "head_lsn": manager.head_lsn(),
+                        }
+                    )
+                )
+        except (ConnectionResetError, asyncio.CancelledError, RuntimeError):
+            pass  # standby went away mid-send / server shutting down
+        finally:
+            self.server.replication_sockets.discard(ws)
+            manager.unsubscribe_replica(sub)
+            with contextlib.suppress(Exception):
+                await ws.close()
+        return ws

@@ -62,6 +62,7 @@ class DistributedServer:
         mesh: Any = None,
         config_path: str | None = None,
         host: str | None = None,
+        standby_of: str | None = None,
     ):
         self.port = port
         # Default loopback: the /distributed/* surface carries
@@ -134,6 +135,34 @@ class DistributedServer:
             self.durability = DurabilityManager(
                 journal_dir, scheduler=self.scheduler
             )
+        # Warm-standby mode (--standby / CDT_STANDBY_OF): this master
+        # tails the active's journal stream instead of recovering from
+        # disk, and promotes itself when the active's lease expires
+        # (api/standby.py). Requires the journal dir — the lease file
+        # is the takeover arbitration medium and the promoted standby
+        # journals into the same directory.
+        from .standby import StandbyController
+
+        self.standby: Optional[StandbyController] = None
+        standby_of = standby_of or os.environ.get("CDT_STANDBY_OF", "").strip()
+        if standby_of and not self.is_worker:
+            if self.durability is None:
+                raise ValueError(
+                    "standby mode requires CDT_JOURNAL_DIR (the lease "
+                    "file and post-promotion journal live there)"
+                )
+            self.standby = StandbyController(
+                self, standby_of, journal_dir
+            )
+        # Lease renewal task handle (active masters with journaling);
+        # `deposed` flips when a standby takes the lease from under us
+        # (status surfaces report it; the journal seam enforces it).
+        self._lease_task: Optional[asyncio.Task] = None
+        self.deposed = False
+        # Open replication WebSockets (standbys tailing our journal):
+        # closed explicitly in stop() so a parked stream can't hold the
+        # runner's graceful shutdown for its full timeout.
+        self.replication_sockets: set = set()
         # Live-state gauge collectors are bound in start() — a server
         # constructed but never started must not leave a collector
         # (holding a strong reference to it) in the global registry.
@@ -172,6 +201,7 @@ class DistributedServer:
         from . import (
             config_routes,
             job_routes,
+            replication_routes,
             scheduler_routes,
             telemetry_routes,
             tunnel_routes,
@@ -192,6 +222,7 @@ class DistributedServer:
         worker_routes.register(self.app, self)
         tunnel_routes.register(self.app, self)
         web_routes.register(self.app, self)
+        replication_routes.register(self.app, self)
 
     # --- prompt queue ----------------------------------------------------
 
@@ -349,16 +380,50 @@ class DistributedServer:
         """Start HTTP listener + executor thread on the running loop."""
         self.loop = asyncio.get_running_loop()
         set_server_loop(self.loop)
-        # Crash recovery FIRST: replay snapshot + WAL tail into the job
-        # store (in-flight tiles requeue, durable results restore),
-        # then attach the write-ahead seam so every transition from
-        # here on is journaled before it is acknowledged. Admission
-        # lanes come back PAUSED when jobs were recovered and resume on
-        # the first worker heartbeat (durability/recovery.py).
-        if self.durability is not None:
+        # Push-mode grants (CDT_PUSH_GRANTS): the placement policy
+        # publishes grant_available events on every pending-queue
+        # refill so workers parked on /distributed/events wake
+        # immediately instead of pull-polling.
+        from ..utils.constants import PUSH_GRANTS_ENABLED
+
+        if PUSH_GRANTS_ENABLED and not self.is_worker:
+            self.job_store.grant_notifier = self.scheduler.placement.notify_grants
+        if self.standby is not None:
+            # Warm standby: no disk recovery, no journal seam — follow
+            # the active's replication stream and hold admission closed
+            # until promotion (usdu routes answer 503 meanwhile).
+            try:
+                self.scheduler.pause()
+            except Exception as exc:  # noqa: BLE001 - advisory
+                log(f"standby: scheduler pause failed: {exc}")
+            self.standby.start()
+        elif self.durability is not None:
+            # Active master: take the lease FIRST (epoch+1; the newest
+            # claimant on the journal dir always wins — a deposed
+            # holder is fenced by the epoch bump), then crash recovery:
+            # replay snapshot + WAL tail into the job store (in-flight
+            # tiles requeue, durable results restore), then attach the
+            # write-ahead seam so every transition from here on is
+            # journaled before it is acknowledged. Admission lanes come
+            # back PAUSED when jobs were recovered and resume on the
+            # first worker heartbeat (durability/recovery.py).
+            from ..durability import Lease
+
+            lease = Lease(
+                self.durability.directory,
+                owner=f"master:{self.host}:{self.port}:{os.getpid()}",
+            )
+            epoch = await self.loop.run_in_executor(
+                None, lambda: lease.acquire(force=True)
+            )
+            self.durability.lease = lease
             self.durability.recover(self.job_store, scheduler=self.scheduler)
             self.job_store.journal_sink = self.durability.record
             self.job_store.on_worker_seen = self.durability.note_worker_activity
+            self.job_store.set_epoch(epoch)
+            self._lease_task = self.loop.create_task(
+                self._renew_lease_loop(), name="cdt-lease-renew"
+            )
         # Live-state gauges (queue depths, breaker states) are filled
         # at /distributed/metrics scrape time from this server.
         from ..telemetry import bind_server_collectors
@@ -375,9 +440,78 @@ class DistributedServer:
         self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()
         role = "worker" if self.is_worker else "master"
+        if self.standby is not None and not self.standby.promoted:
+            role = "standby"
         log(f"{role} server listening on {self.host}:{self.port}")
 
+    # --- lease renewal / promotion ----------------------------------------
+
+    async def _renew_lease_loop(self) -> None:
+        """Renew the master lease every ttl/3 (file writes off-loop). On
+        ``LeaseLost`` — a standby took over — this master is DEPOSED:
+        renewal stops, the flag flips, and the journal seam's
+        ``FencedOut`` check guarantees no further mutation can be
+        acknowledged (the fencing-token pattern's enforcement point)."""
+        from ..durability.lease import LeaseLost
+
+        loop = asyncio.get_running_loop()
+        while True:
+            manager = self.durability
+            lease = manager.lease if manager is not None else None
+            if lease is None:
+                return
+            await asyncio.sleep(max(0.1, lease.ttl / 3.0))
+            try:
+                await loop.run_in_executor(None, lease.renew)
+            except LeaseLost as exc:
+                self.deposed = True
+                log(
+                    f"master DEPOSED: {exc}; journal appends are fenced, "
+                    "this process serves no further authoritative writes"
+                )
+                from ..telemetry.events import get_event_bus
+
+                get_event_bus().publish(
+                    "master_deposed", owner=lease.owner, port=self.port
+                )
+                return
+            except Exception as exc:  # noqa: BLE001 - renewal retries
+                debug_log(f"lease renewal failed (will retry): {exc}")
+
+    def note_promoted(self, epoch: int) -> None:
+        """Called by the StandbyController (on the server loop) right
+        after it acquired the lease and adopted the replicated state:
+        start renewing the lease like any active master, and release
+        the standby-mode admission pause when promotion found nothing
+        to hold it for (jobs recovered keep it held until the first
+        worker heartbeat, exactly like disk recovery)."""
+        if self.loop is not None:
+            self._lease_task = self.loop.create_task(
+                self._renew_lease_loop(), name="cdt-lease-renew"
+            )
+        manager = self.durability
+        if manager is not None and not manager._admission_held():
+            try:
+                self.scheduler.resume()
+            except Exception as exc:  # noqa: BLE001 - advisory
+                log(f"promotion: scheduler resume failed: {exc}")
+        log(f"server on {self.host}:{self.port} now ACTIVE (epoch {epoch})")
+
     async def stop(self) -> None:
+        import contextlib
+
+        if self.standby is not None:
+            await self.standby.stop()
+        for ws in list(self.replication_sockets):
+            with contextlib.suppress(Exception):
+                await ws.close()
+        if self._lease_task is not None:
+            self._lease_task.cancel()
+            try:
+                await self._lease_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._lease_task = None
         # Join the watchdog thread OFF the loop: a speculation pass in
         # flight blocks that thread on a coroutine scheduled on THIS
         # loop, so joining inline would deadlock until the join timeout
@@ -409,6 +543,17 @@ class DistributedServer:
                 )
             except Exception as exc:  # noqa: BLE001 - reported, not fatal
                 log(f"durability close failed during shutdown: {exc}")
+            # Clean shutdown expires our lease NOW (same epoch) so a
+            # standby — or the next restart — takes over immediately
+            # instead of waiting out the TTL. No-op if already deposed.
+            lease = self.durability.lease
+            if lease is not None:
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, lease.release
+                    )
+                except Exception as exc:  # noqa: BLE001 - best effort
+                    debug_log(f"lease release failed during shutdown: {exc}")
         if self.loop is not None:
             set_server_loop(None)
 

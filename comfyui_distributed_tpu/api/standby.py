@@ -1,0 +1,276 @@
+"""StandbyController: a warm-standby master that tails the active
+master's journal stream and takes over without a process restart.
+
+Lifecycle (docs/durability.md §failover has the diagram):
+
+1. **follow** — connect to the active master's
+   ``/distributed/replicate`` WebSocket (rotating through the
+   configured address list), adopt the hello snapshot, and apply every
+   record frame through the standby replica (the same pure
+   ``apply_record`` machine the active's snapshot shadow uses),
+   tracking lag in records and seconds;
+2. **watch the lease** — on every stream interruption, read the lease
+   file (``CDT_JOURNAL_DIR/lease.json``). While the active master
+   renews it, the standby just reconnects and keeps following;
+3. **promote** — once the lease has *expired* (the active missed
+   renewals for a full ``CDT_LEASE_TTL``), acquire it (epoch+1) and
+   run the promotion transform: ``prepare_for_restart`` semantics
+   reused end to end — in-flight grants revoked to pending for
+   bit-identical recompute, durable worker payloads re-enqueued for
+   blend — then open the journal for appends, snapshot, attach the
+   write-ahead seam, adopt the new epoch into the JobStore (fencing),
+   and start serving. Admission stays paused until the first worker
+   heartbeat, exactly like disk recovery.
+
+While unpromoted, the server's work-RPC surface answers 503
+(usdu_routes standby gate) so re-pointing workers keep retrying their
+address list until promotion lands; the scheduler is paused so no new
+jobs are admitted into a store that isn't authoritative.
+
+Split-brain: promotion is gated on the *shared* lease file, so two
+standbys can race but only one acquire wins (the loser sees
+``LeaseHeld`` and resumes following — now against the winner). A
+revived ex-active is fenced by the epoch bump on its next journal
+append (``FencedOut``), and its workers' stale-epoch RPCs are rejected
+by the promoted store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Optional
+
+from aiohttp import WSMsgType
+
+from ..durability import Lease, LeaseHeld, StandbyReplica, read_lease
+from ..utils.async_helpers import run_blocking
+from ..utils.constants import LEASE_TTL_SECONDS, STANDBY_POLL_SECONDS
+from ..utils.logging import debug_log, log
+from ..utils.network import get_client_session, parse_master_urls
+
+
+class StandbyController:
+    def __init__(
+        self,
+        server,
+        primary_urls,
+        journal_dir: str,
+        ttl: Optional[float] = None,
+        poll_seconds: Optional[float] = None,
+    ) -> None:
+        self.server = server
+        self.urls = parse_master_urls(primary_urls)
+        if not self.urls:
+            raise ValueError("standby mode requires at least one primary URL")
+        self.journal_dir = journal_dir
+        self.ttl = float(ttl) if ttl is not None else LEASE_TTL_SECONDS
+        self.poll_seconds = (
+            float(poll_seconds) if poll_seconds is not None
+            else STANDBY_POLL_SECONDS
+        )
+        self.replica = StandbyReplica()
+        self.lease = Lease(
+            journal_dir,
+            owner=f"standby:{server.host}:{server.port}:{os.getpid()}",
+            ttl=self.ttl,
+        )
+        self.promoted = False
+        self.connected = False
+        self.last_error = ""
+        self._stopped = False
+        self._task: Optional[asyncio.Task] = None
+        self._url_idx = 0
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Called from the server's start() on the running loop."""
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name="cdt-standby"
+        )
+
+    async def stop(self) -> None:
+        self._stopped = True
+        task = self._task
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    # --- the follow/promote loop ------------------------------------------
+
+    async def _run(self) -> None:
+        log(
+            f"standby: following {', '.join(self.urls)} "
+            f"(journal dir {self.journal_dir}, lease ttl {self.ttl}s)"
+        )
+        while not self._stopped and not self.promoted:
+            url = self.urls[self._url_idx % len(self.urls)]
+            try:
+                await self._follow(url)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - stream errors expected
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                debug_log(f"standby: stream from {url} failed: {self.last_error}")
+            finally:
+                self.connected = False
+            if self._stopped:
+                return
+            if await self._lease_expired():
+                if await self._promote():
+                    return
+            self._url_idx += 1
+            await asyncio.sleep(self.poll_seconds)
+
+    async def _follow(self, url: str) -> None:
+        session = await get_client_session()
+        async with session.ws_connect(
+            f"{url}/distributed/replicate", heartbeat=30
+        ) as ws:
+            async for msg in ws:
+                if self._stopped:
+                    return
+                if msg.type != WSMsgType.TEXT:
+                    break
+                try:
+                    frame = json.loads(msg.data)
+                except (TypeError, ValueError):
+                    continue
+                kind = frame.get("type")
+                if kind == "repl_hello":
+                    self.replica.reset(
+                        frame.get("state") or {},
+                        int(frame.get("head_lsn", 0)),
+                        int(frame.get("epoch", 0)),
+                    )
+                    self.connected = True
+                    debug_log(
+                        f"standby: synced from {url} at lsn "
+                        f"{self.replica.last_lsn()}"
+                    )
+                elif kind == "repl_record":
+                    record = frame.get("record")
+                    if isinstance(record, dict):
+                        self.replica.apply(record)
+                elif kind == "repl_heartbeat":
+                    self.replica.note_head(
+                        int(frame.get("head_lsn", 0)),
+                        int(frame.get("epoch", 0)),
+                    )
+                elif kind == "repl_lost":
+                    # buffer overflow on the active side: reconnect and
+                    # re-sync from a fresh hello snapshot
+                    debug_log(f"standby: stream from {url} lost; re-syncing")
+                    return
+
+    async def _lease_expired(self) -> bool:
+        """May we promote? Only once the active's lease has expired —
+        and never before the first successful sync. A missing lease
+        file while the replica has seen a journaled active (source
+        epoch > 0) is a MISCONFIGURATION, not an expiry: an active
+        with journaling on always holds a lease, so its absence here
+        means this standby's journal dir is not the active's (NFS
+        unmounted, wrong path) and promoting would start a second
+        active beside the live one. Refuse loudly instead.
+
+        And NEVER before the first successful sync: an unsynced
+        replica is ``new_state()`` — promoting it would serve zero
+        jobs and open a fresh lsn-1 journal lineage over whatever real
+        WAL lives in the directory. A standby that cannot sync (the
+        active died before its first hello) is not a takeover
+        candidate; the operator's path there is a *restarting master*
+        on the journal dir, whose disk recovery restores the jobs the
+        stream never delivered."""
+        if not self.replica.synced:
+            return False
+        state = await run_blocking(read_lease, self.journal_dir)
+        if state is None:
+            if self.replica.source_epoch > 0:
+                self.last_error = (
+                    f"no lease file in {self.journal_dir} but the "
+                    f"replication source reports epoch "
+                    f"{self.replica.source_epoch}: this journal dir is "
+                    "not the active's — refusing to promote "
+                    "(check CDT_JOURNAL_DIR)"
+                )
+                log(f"standby: {self.last_error}")
+                return False
+            return True  # synced, and no active has ever held a lease
+        return state.expires_at <= time.time()
+
+    async def _promote(self) -> bool:
+        try:
+            epoch = await run_blocking(self.lease.acquire)
+        except LeaseHeld as exc:
+            # another standby won the race; follow the new active
+            debug_log(f"standby: promotion lost the lease race: {exc}")
+            return False
+        except OSError as exc:
+            # transient lease-dir I/O (strict read): retry next poll
+            self.last_error = f"lease acquire I/O error: {exc}"
+            debug_log(f"standby: {self.last_error}")
+            return False
+        if epoch <= self.replica.source_epoch:
+            # The lease we just took does not descend from the active's
+            # epoch lineage: a takeover always lands at source_epoch+1
+            # or higher, so a lower epoch means this journal dir is not
+            # the one the replicated active arbitrates on (wrong
+            # CDT_JOURNAL_DIR). Back out — promoting here would start a
+            # second active beside a live one.
+            self.last_error = (
+                f"acquired epoch {epoch} in {self.journal_dir} but the "
+                f"replication source reports epoch "
+                f"{self.replica.source_epoch}: lease dir is not the "
+                "active's — promotion refused (check CDT_JOURNAL_DIR)"
+            )
+            log(f"standby: {self.last_error}")
+            await run_blocking(self.lease.release)
+            return False
+        server = self.server
+        manager = server.durability
+        report = manager.adopt(
+            server.job_store,
+            self.replica,
+            scheduler=server.scheduler,
+            lease=self.lease,
+        )
+        server.job_store.journal_sink = manager.record
+        server.job_store.on_worker_seen = manager.note_worker_activity
+        server.job_store.set_epoch(epoch)
+        self.promoted = True
+        server.note_promoted(epoch)
+        from ..telemetry.events import get_event_bus
+
+        get_event_bus().publish(
+            "failover",
+            epoch=epoch,
+            jobs_recovered=report.jobs_recovered,
+            tasks_requeued=report.tasks_requeued,
+            replicated_lsn=report.last_lsn,
+        )
+        log(
+            f"standby: PROMOTED to active master (epoch {epoch}); "
+            f"{report.jobs_recovered} job(s) adopted, "
+            f"{report.tasks_requeued} tile(s) requeued for recompute"
+        )
+        return True
+
+    # --- observability ----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "role": "promoted" if self.promoted else "standby",
+            "primaries": list(self.urls),
+            "connected": self.connected,
+            "promoted": self.promoted,
+            "lease": self.lease.status(),
+            "replica": self.replica.status(),
+            "last_error": self.last_error,
+        }

@@ -76,14 +76,35 @@ class TelemetryRoutes:
     async def durability(self, request: web.Request) -> web.Response:
         """Durable-control-plane status: journal head/segments, last
         snapshot lsn + age, post-recovery admission hold, and the last
-        recovery's report (docs/durability.md; runbook §4f reads this
-        first in a master-restart triage)."""
+        recovery's report (docs/durability.md; runbook §4f/§4g read
+        this first in a restart/failover triage). With the HA layer the
+        payload adds `role` (active | standby | deposed), the fencing
+        `epoch`, replication standby counts on the active, and the
+        standby's own replication lag in records and seconds."""
         manager = getattr(self.server, "durability", None)
         if manager is None:
             return web.json_response(
                 {"enabled": False, "hint": "set CDT_JOURNAL_DIR to enable"}
             )
-        return web.json_response(manager.status())
+        status = manager.status()
+        standby = getattr(self.server, "standby", None)
+        if standby is not None and not standby.promoted:
+            # this process is a warm standby: the authoritative journal
+            # lives on the active master; report the replica's view
+            status["role"] = "standby"
+            status["standby"] = standby.status()
+            replica = standby.replica.status()
+            status["epoch"] = replica["source_epoch"]
+            status["replication"] = {
+                **status.get("replication", {}),
+                "lag_records": replica["lag_records"],
+                "lag_seconds": replica["lag_seconds"],
+                "applied_lsn": replica["applied_lsn"],
+                "synced": replica["synced"],
+            }
+        elif getattr(self.server, "deposed", False):
+            status["role"] = "deposed"
+        return web.json_response(status)
 
     async def trace(self, request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]

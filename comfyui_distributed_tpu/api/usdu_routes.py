@@ -17,11 +17,12 @@ JSON and multipart for compatibility.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 from aiohttp import web
 
 from ..utils.constants import JOB_INIT_GRACE_SECONDS, QUEUE_POLL_INTERVAL_SECONDS
+from ..utils.exceptions import StaleEpoch
 from ..utils.logging import debug_log
 from .telemetry_routes import rpc_span
 
@@ -43,22 +44,72 @@ async def _json(request: web.Request) -> Any:
         return None
 
 
+def _stale_epoch_response(exc: StaleEpoch) -> web.Response:
+    """409 Conflict: the caller's fencing epoch predates a master
+    takeover. The body carries the CURRENT epoch so a live worker can
+    refresh and retry (HTTPWorkClient does exactly that); a zombie
+    ex-master's authority stays rejected no matter how often it
+    re-sends."""
+    return web.json_response(
+        {"error": "stale_epoch", "detail": str(exc), "current_epoch": exc.current},
+        status=409,
+    )
+
+
 class UsduRoutes:
     def __init__(self, server):
         self.server = server
 
+    def _standby_rejection(self) -> Optional[web.Response]:
+        """Work-RPC gate for warm standbys: until promotion, this
+        process's store is a replica, not the authority — answering a
+        pull or submit here would fork state. 503 + Retry-After keeps
+        re-pointing workers in their retry loop until promotion lands
+        (their policies treat 5xx as transient)."""
+        standby = getattr(self.server, "standby", None)
+        if standby is not None and not standby.promoted:
+            return web.json_response(
+                {
+                    "error": "standby",
+                    "detail": "this master is a warm standby (not yet "
+                              "promoted); retry against the active master "
+                              "or wait for failover",
+                },
+                status=503,
+                headers={"Retry-After": "1"},
+            )
+        return None
+
     async def heartbeat(self, request: web.Request) -> web.Response:
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
+        # fencing BEFORE any server-side state — a stale-authority
+        # client must not even adjust advisory placement capacity
+        try:
+            self.server.job_store.check_epoch(body.get("epoch"))
+        except StaleEpoch as exc:
+            return _stale_epoch_response(exc)
         if "devices" in body:
             self.server.job_store.note_worker_capacity(
                 str(body["worker_id"]), body["devices"]
             )
-        ok = await self.server.job_store.heartbeat(
-            str(body["job_id"]), str(body["worker_id"])
+        try:
+            ok = await self.server.job_store.heartbeat(
+                str(body["job_id"]), str(body["worker_id"]),
+                epoch=body.get("epoch"),
+            )
+        except StaleEpoch as exc:
+            return _stale_epoch_response(exc)
+        return web.json_response(
+            {
+                "status": "ok" if ok else "unknown_job",
+                "epoch": self.server.job_store.epoch,
+            }
         )
-        return web.json_response({"status": "ok" if ok else "unknown_job"})
 
     async def request_image(self, request: web.Request) -> web.Response:
         """Pull work. Response: {tile_idx|image_idx|None,
@@ -69,6 +120,9 @@ class UsduRoutes:
         single-pull clients are unaffected). A `devices` field
         advertises the worker's chip count (mesh data-axis width) so
         placement scales its grants — a 4-chip worker pulls ~4x."""
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
@@ -77,6 +131,12 @@ class UsduRoutes:
             batch_max = max(1, int(body.get("batch_max", 1)))
         except (TypeError, ValueError):
             batch_max = 1
+        # fencing BEFORE any server-side state — a stale-authority
+        # client must not even adjust advisory placement capacity
+        try:
+            self.server.job_store.check_epoch(body.get("epoch"))
+        except StaleEpoch as exc:
+            return _stale_epoch_response(exc)
         # device-count-aware placement: the worker's advertised chip
         # count (mesh data-axis width) scales its grants
         if "devices" in body:
@@ -89,17 +149,22 @@ class UsduRoutes:
             )
             if job is None:
                 return web.json_response({"error": "no such job"}, status=404)
-            if batch_max > 1:
-                task_ids = await self.server.job_store.pull_tasks(
-                    job_id, worker_id,
-                    timeout=QUEUE_POLL_INTERVAL_SECONDS, limit=batch_max,
-                )
-                task_id = task_ids[0] if task_ids else None
-            else:
-                task_id = await self.server.job_store.pull_task(
-                    job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS
-                )
-                task_ids = [task_id] if task_id is not None else []
+            try:
+                if batch_max > 1:
+                    task_ids = await self.server.job_store.pull_tasks(
+                        job_id, worker_id,
+                        timeout=QUEUE_POLL_INTERVAL_SECONDS, limit=batch_max,
+                        epoch=body.get("epoch"),
+                    )
+                    task_id = task_ids[0] if task_ids else None
+                else:
+                    task_id = await self.server.job_store.pull_task(
+                        job_id, worker_id, timeout=QUEUE_POLL_INTERVAL_SECONDS,
+                        epoch=body.get("epoch"),
+                    )
+                    task_ids = [task_id] if task_id is not None else []
+            except StaleEpoch as exc:
+                return _stale_epoch_response(exc)
             remaining = await self.server.job_store.remaining(job_id)
             if span is not None and task_id is not None:
                 span.attrs["tile_idx"] = int(task_id)
@@ -110,6 +175,7 @@ class UsduRoutes:
             key: task_id,
             "estimated_remaining": remaining,
             "batched_static": job.batched,
+            "epoch": self.server.job_store.epoch,
         }
         if batch_max > 1:
             response["tile_idxs"] = task_ids
@@ -120,6 +186,9 @@ class UsduRoutes:
         entry = {tile_idx, batch_idx, global_idx, x, y, extracted_w/h,
         image: dataURL}. Entries are grouped per tile_idx into one
         result payload each."""
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
@@ -144,9 +213,16 @@ class UsduRoutes:
             # flush-aware submission: one request = one flush, so the
             # store amortizes the interval across its tiles instead of
             # logging near-zero latencies for tiles 2..k
-            accepted = await store.submit_flush(job_id, worker_id, grouped)
-            if body.get("is_final_flush"):
-                await store.mark_worker_done(job_id, worker_id)
+            try:
+                accepted = await store.submit_flush(
+                    job_id, worker_id, grouped, epoch=body.get("epoch")
+                )
+                if body.get("is_final_flush"):
+                    await store.mark_worker_done(
+                        job_id, worker_id, epoch=body.get("epoch")
+                    )
+            except StaleEpoch as exc:
+                return _stale_epoch_response(exc)
             if span is not None:
                 span.attrs["tiles"] = sorted(grouped)
                 span.attrs["accepted"] = accepted
@@ -154,11 +230,16 @@ class UsduRoutes:
             f"submit_tiles job={job_id} worker={worker_id} "
             f"tiles={len(grouped)} accepted={accepted}"
         )
-        return web.json_response({"status": "ok", "accepted": accepted})
+        return web.json_response(
+            {"status": "ok", "accepted": accepted, "epoch": store.epoch}
+        )
 
     async def submit_image(self, request: web.Request) -> web.Response:
         """Dynamic mode: one whole processed image. JSON body:
         {job_id, worker_id, image_idx, image: dataURL, is_last}."""
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
@@ -173,19 +254,28 @@ class UsduRoutes:
             job = await store.wait_for_tile_job(job_id, JOB_INIT_GRACE_SECONDS)
             if job is None:
                 return web.json_response({"error": "no such job"}, status=404)
-            await store.submit_result(
-                job_id, worker_id, int(body["image_idx"]),
-                [{"batch_idx": 0, "image": body["image"], "whole_image": True}],
-            )
-            if body.get("is_last"):
-                await store.mark_worker_done(job_id, worker_id)
-        return web.json_response({"status": "ok"})
+            try:
+                await store.submit_result(
+                    job_id, worker_id, int(body["image_idx"]),
+                    [{"batch_idx": 0, "image": body["image"], "whole_image": True}],
+                    epoch=body.get("epoch"),
+                )
+                if body.get("is_last"):
+                    await store.mark_worker_done(
+                        job_id, worker_id, epoch=body.get("epoch")
+                    )
+            except StaleEpoch as exc:
+                return _stale_epoch_response(exc)
+        return web.json_response({"status": "ok", "epoch": store.epoch})
 
     async def return_tiles(self, request: web.Request) -> web.Response:
         """{job_id, worker_id, tile_idxs} — an interrupted worker hands
         back the unprocessed remainder of its in-flight grant so those
         tiles requeue immediately (graph/tile_pipeline.py interrupt
         semantics) instead of waiting out the heartbeat timeout."""
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body or "worker_id" not in body:
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
@@ -202,14 +292,21 @@ class UsduRoutes:
             request, "rpc.return_tiles",
             worker_id=str(body["worker_id"]), job_id=str(body["job_id"]),
         ) as span:
-            released = await self.server.job_store.release_tasks(
-                str(body["job_id"]), str(body["worker_id"]), idxs
-            )
+            try:
+                released = await self.server.job_store.release_tasks(
+                    str(body["job_id"]), str(body["worker_id"]), idxs,
+                    epoch=body.get("epoch"),
+                )
+            except StaleEpoch as exc:
+                return _stale_epoch_response(exc)
             if span is not None:
                 span.attrs["released"] = released
         return web.json_response({"status": "ok", "released": released})
 
     async def job_status(self, request: web.Request) -> web.Response:
+        rejection = self._standby_rejection()
+        if rejection is not None:
+            return rejection
         body = await _json(request)
         if not body or "job_id" not in body:
             return web.json_response({"error": "job_id required"}, status=400)
@@ -217,12 +314,20 @@ class UsduRoutes:
         if job is None:
             # also a ready-poll target for collector jobs
             collector = self.server.job_store.collectors.get(str(body["job_id"]))
-            return web.json_response({"ready": collector is not None})
+            return web.json_response(
+                {
+                    "ready": collector is not None,
+                    "epoch": self.server.job_store.epoch,
+                }
+            )
         return web.json_response(
             {
                 "ready": True,
                 "total": job.total_tasks,
                 "completed": len(job.completed),
                 "remaining": job.pending.qsize(),
+                # workers learn the fencing epoch from the first RPC of
+                # the job, then carry it on every mutating RPC
+                "epoch": self.server.job_store.epoch,
             }
         )

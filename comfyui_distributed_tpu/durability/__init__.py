@@ -2,30 +2,45 @@
 
 Write-ahead journal + snapshot + crash recovery under the JobStore and
 the scheduler, removing the master as the one component whose crash
-loses work. See docs/durability.md for the record schema, the
-rotation/compaction policy, and the recovery sequence.
+loses work — and, with the high-availability layer, whose crash causes
+downtime at all. See docs/durability.md for the record schema, the
+rotation/compaction policy, the recovery sequence, and the failover
+protocol (lease, epoch fencing, replication lag).
 
     journal.py   — append-only CRC32 WAL, segment rotation, torn-tail
                    truncation on replay
     state.py     — the journaled state machine (one apply_record
-                   shared by snapshot shadow and recovery replay)
+                   shared by snapshot shadow, recovery replay, and the
+                   standby replica)
     snapshot.py  — atomic snapshot write + segment/snapshot pruning
     recovery.py  — snapshot + WAL tail → live JobStore/scheduler
-    manager.py   — DurabilityManager: the JobStore's journal_sink
+    manager.py   — DurabilityManager: the JobStore's journal_sink,
+                   replication tee, and promotion adopter
+    lease.py     — epoch-numbered master lease + FencedOut fencing
+    replicate.py — replication subscriptions + the standby replica
 """
 
 from .journal import Journal, JournalCorruption, replay_journal
+from .lease import FencedOut, Lease, LeaseHeld, LeaseLost, read_lease
 from .manager import DurabilityManager, journal_dir_from_env
 from .recovery import RecoveryReport, recover, recover_state
+from .replicate import ReplicationSubscription, StandbyReplica
 from .state import SnapshotVersionMismatch
 
 __all__ = [
     "DurabilityManager",
+    "FencedOut",
     "Journal",
     "JournalCorruption",
+    "Lease",
+    "LeaseHeld",
+    "LeaseLost",
     "RecoveryReport",
+    "ReplicationSubscription",
     "SnapshotVersionMismatch",
+    "StandbyReplica",
     "journal_dir_from_env",
+    "read_lease",
     "recover",
     "recover_state",
     "replay_journal",

@@ -32,7 +32,9 @@ from . import recovery as recovery_mod
 from . import snapshot as snapshot_mod
 from . import state as state_mod
 from .journal import Journal
+from .lease import FencedOut, Lease
 from .recovery import RecoveryReport
+from .replicate import ReplicationSubscription
 
 DEFAULT_SNAPSHOT_EVERY = 256
 
@@ -76,6 +78,13 @@ class DurabilityManager:
         # triggered from the journal seam (which runs on the serving
         # loop) must not pay the write+fsync+prune there.
         self._snapshot_thread: Optional[threading.Thread] = None
+        # High-availability layer (optional): the epoch lease fencing
+        # this process's right to append, and the live replication
+        # subscriptions every journaled record is teed into (see
+        # durability/lease.py and durability/replicate.py).
+        self.lease: Optional[Lease] = None
+        self._replicas: list[ReplicationSubscription] = []
+        self.failovers = 0  # promotions performed by THIS process
 
     # --- lifecycle --------------------------------------------------------
 
@@ -115,9 +124,12 @@ class DurabilityManager:
         if snapshot_thread is not None and snapshot_thread.is_alive():
             snapshot_thread.join(timeout=60)
         with self._lock:
+            replicas, self._replicas = self._replicas, []
             if self._journal is not None:
                 self._journal.close()
                 self._journal = None
+        for sub in replicas:
+            sub.close()
 
     # --- the journal seam (JobStore.journal_sink) -------------------------
 
@@ -125,7 +137,21 @@ class DurabilityManager:
         """Append one typed mutation record; called by the JobStore
         BEFORE it acknowledges the transition. A journal failure
         propagates — WAL semantics forbid acknowledging state that was
-        not made durable."""
+        not made durable.
+
+        Fencing: when a lease is attached, every append first checks
+        ``Lease.held()`` (local-clock cheap within ttl/4, a file
+        re-read beyond). A deposed master — its lease taken by a
+        promoted standby — raises ``FencedOut`` here, BEFORE any bytes
+        land, so a zombie process cannot journal (and therefore cannot
+        acknowledge) state after takeover."""
+        lease = self.lease
+        if lease is not None and not lease.held():
+            raise FencedOut(
+                f"journal append refused: this process no longer holds "
+                f"the master lease for {self.directory} (a standby "
+                "promoted itself); the mutation was NOT journaled"
+            )
         with self._lock:
             if self._journal is None:
                 self._journal = self._open_journal(int(self._state["last_lsn"]) + 1)
@@ -141,11 +167,95 @@ class DurabilityManager:
                 # legal in replay.)
                 rec = {**rec, "payload": None}
                 lsn = self._journal.append(rec)
-            state_mod.apply_record(self._state, {**rec, "lsn": lsn})
+            sequenced = {**rec, "lsn": lsn}
+            state_mod.apply_record(self._state, sequenced)
+            self._tee_replicas_locked(sequenced)
             self._appends += 1
             self._appends_since_snapshot += 1
             if self._appends_since_snapshot >= self.snapshot_every:
                 self._snapshot_locked(asynchronous=True)
+
+    # --- replication (durability/replicate.py) ----------------------------
+
+    def subscribe_replica(self) -> ReplicationSubscription:
+        """Attach one standby: under the manager lock, serialize the
+        current shadow state and register the record tee — the
+        (snapshot, tail) pair the subscriber sees is exactly
+        consistent by construction (no record between the snapshot
+        serialization and the first teed frame)."""
+        with self._lock:
+            sub = ReplicationSubscription(
+                snapshot_state=state_mod.clone(self._state),
+                head_lsn=int(self._state["last_lsn"]),
+                epoch=self.epoch,
+            )
+            self._replicas.append(sub)
+        return sub
+
+    def unsubscribe_replica(self, sub: ReplicationSubscription) -> None:
+        sub.close()
+        with self._lock:
+            if sub in self._replicas:
+                self._replicas.remove(sub)
+
+    def _tee_replicas_locked(self, record: dict) -> None:
+        """Caller holds self._lock. Offers never block or raise; a lost
+        subscription stays registered (its consumer notices and
+        re-syncs or disconnects)."""
+        for sub in self._replicas:
+            sub.offer(record)
+
+    @property
+    def epoch(self) -> int:
+        return self.lease.epoch if self.lease is not None else 0
+
+    @property
+    def role(self) -> str:
+        return "active"
+
+    def head_lsn(self) -> int:
+        with self._lock:
+            return int(self._state["last_lsn"])
+
+    # --- promotion (standby → active) -------------------------------------
+
+    def adopt(
+        self,
+        store: Any,
+        replica: Any,
+        scheduler: Any = None,
+        lease: Optional[Lease] = None,
+    ) -> RecoveryReport:
+        """Standby promotion: the replica's replicated state becomes
+        this manager's shadow (the mirror of ``recover``, with the
+        replication stream standing in for snapshot + WAL tail).
+        Materializes live jobs into ``store``, opens the journal for
+        appends at the replicated head, snapshots immediately, and
+        holds admission paused until a worker re-registers — the
+        ``prepare_for_restart`` semantics reused end to end, so the
+        promoted standby requeues in-flight tiles and completes the
+        job bit-identically."""
+        if scheduler is not None:
+            self.scheduler = scheduler
+        if lease is not None:
+            self.lease = lease
+        state, report = replica.promote(store, scheduler=self.scheduler)
+        with self._lock:
+            self._state = state
+            self.report = report
+            self.failovers += 1
+            if self._journal is not None:
+                self._journal.close()
+            self._journal = self._open_journal(int(state["last_lsn"]) + 1)
+            if report.jobs_recovered:
+                self._paused_for_recovery = recovery_mod.pause_after_recovery(
+                    self.scheduler
+                )
+            self._snapshot_locked()
+        instruments.recovery_replayed_records().set(report.replayed_records)
+        instruments.recovery_requeued_tasks().set(report.tasks_requeued)
+        instruments.failover_total().inc(role="standby")
+        return report
 
     # --- snapshots --------------------------------------------------------
 
@@ -271,6 +381,8 @@ class DurabilityManager:
             )
             return {
                 "enabled": True,
+                "role": self.role,
+                "epoch": self.epoch,
                 "journal_dir": self.directory,
                 "journal": journal_status,
                 "appends": self._appends,
@@ -280,4 +392,9 @@ class DurabilityManager:
                 "admission_held": self._admission_held(),
                 "recovery": self.report.as_json(),
                 "jobs_tracked": len(self._state["jobs"]),
+                "replication": {
+                    "standbys": len(self._replicas),
+                    "lost": sum(1 for s in self._replicas if s.lost),
+                },
+                "failovers": self.failovers,
             }

@@ -47,6 +47,8 @@ from ..utils.constants import (
     MAX_TILE_BATCH,
     PAYLOAD_HEADROOM,
     PIPELINE_ENABLED,
+    PUSH_GRANTS_ENABLED,
+    PUSH_WAIT_SECONDS,
     QUEUE_POLL_INTERVAL_SECONDS,
     SCHED_MAX_PULL_BATCH,
     WARM_COMPILE,
@@ -63,13 +65,26 @@ from ..telemetry import TRACE_HEADER, current_trace_id
 from ..telemetry.instruments import tiles_processed_total
 from ..utils.exceptions import TransientServerError, WorkerError
 from ..utils.logging import debug_log, log
-from ..utils.network import build_worker_url, get_client_session, probe_worker
+from ..utils.network import (
+    build_worker_url,
+    get_client_session,
+    parse_master_urls,
+    probe_worker,
+)
 from .tile_pipeline import GrantSampler, TilePipeline, stage_span as _stage
 
 
 # --------------------------------------------------------------------------
 # worker side
 # --------------------------------------------------------------------------
+
+
+# Heartbeat suppression schedule after consecutive failures (satellite
+# of the failover PR): a master outage must not turn every worker into
+# a 1-failure-per-tile log/request flood while the pull path is already
+# doing the patient retrying.
+HEARTBEAT_BACKOFF_BASE_SECONDS = 1.0
+HEARTBEAT_BACKOFF_CAP_SECONDS = 30.0
 
 
 class HTTPWorkClient:
@@ -80,12 +95,29 @@ class HTTPWorkClient:
     patient capped exponential for the work pull, and the default HTTP
     policy for submissions (safe — the master drops duplicate results,
     so a retried submit whose first attempt actually landed is a no-op).
+
+    High availability:
+
+    - `master_url` may be a comma-separated address list (active first,
+      standbys after). `CDT_FAILOVER_AFTER` consecutive transport/5xx
+      failures against the current address rotate to the next — the
+      re-pointed worker's next pull/heartbeat re-advertises its
+      capacity, so the promoted master's placement policy re-learns the
+      fleet with no extra registration RPC;
+    - every RPC response carries the master's fencing `epoch`; the
+      client remembers the highest seen and stamps it on every mutating
+      RPC. A 409 `stale_epoch` rejection (our authority predates a
+      takeover) refreshes the epoch from the rejection body and lets
+      the retry policy re-send — live workers heal in one round-trip,
+      while a zombie master that REFUSES to adopt the new epoch stays
+      rejected (jobs/store.py `_check_epoch`).
     """
 
     def __init__(
         self, master_url: str, job_id: str, worker_id: str, devices: int = 1
     ):
-        self.master_url = master_url
+        self.urls = parse_master_urls(master_url) or [str(master_url)]
+        self._url_idx = 0
         self.job_id = job_id
         self.worker_id = worker_id
         # Advertised grant capacity (the worker mesh's data-axis width):
@@ -96,26 +128,104 @@ class HTTPWorkClient:
         # dispatched prompt's trace is active); RPCs run on the server
         # loop where that context is NOT set.
         self.trace_id = current_trace_id()
+        # Fencing epoch: learned from responses, monotonic, attached to
+        # every mutating RPC. None until the master reports one.
+        self.epoch: Optional[int] = None
+        self.failovers = 0
+        self._consecutive_errors = 0
+        # Heartbeat backoff state (consecutive failures → suppression
+        # window); guarded by nothing — heartbeats run on one thread
+        # (the pipeline's I/O stage).
+        self._hb_failures = 0
+        self._hb_suppressed_until = 0.0
 
-    async def _post(self, path: str, payload: dict) -> dict:
+    @property
+    def master_url(self) -> str:
+        return self.urls[self._url_idx % len(self.urls)]
+
+    def _learn_epoch(self, value) -> None:
+        try:
+            epoch = int(value)
+        except (TypeError, ValueError):
+            return
+        if epoch > 0 and (self.epoch is None or epoch > self.epoch):
+            self.epoch = epoch
+
+    def _count_error(self, op: str) -> None:
+        """One master-RPC failure: counted per operation, and after
+        CDT_FAILOVER_AFTER consecutive failures the client re-points to
+        the next address in its list (no-op with a single address)."""
+        from ..telemetry.instruments import (
+            failover_total,
+            worker_master_errors_total,
+        )
+        from ..utils.constants import FAILOVER_AFTER_ERRORS
+
+        worker_master_errors_total().inc(op=op)
+        self._consecutive_errors += 1
+        if (
+            len(self.urls) > 1
+            and self._consecutive_errors >= max(1, FAILOVER_AFTER_ERRORS)
+        ):
+            previous = self.master_url
+            self._url_idx = (self._url_idx + 1) % len(self.urls)
+            self._consecutive_errors = 0
+            self.failovers += 1
+            failover_total().inc(role="worker")
+            log(
+                f"worker {self.worker_id}: master {previous} unreachable "
+                f"({op}); re-pointing to {self.master_url}"
+            )
+
+    async def _post(self, path: str, payload: dict, op: str = "transport") -> dict:
         session = await get_client_session()
         headers = {TRACE_HEADER: self.trace_id} if self.trace_id else {}
-        async with session.post(
-            f"{self.master_url}{path}", json=payload, headers=headers
-        ) as resp:
-            if resp.status >= 500:
-                raise TransientServerError(
-                    f"{path} -> HTTP {resp.status}", self.worker_id
-                )
-            if resp.status != 200:
-                raise WorkerError(f"{path} -> HTTP {resp.status}", self.worker_id)
-            return await resp.json()
+        if self.epoch is not None:
+            payload = {**payload, "epoch": self.epoch}
+        try:
+            async with session.post(
+                f"{self.master_url}{path}", json=payload, headers=headers
+            ) as resp:
+                if resp.status == 409:
+                    # stale fencing epoch: a takeover happened. Refresh
+                    # from the rejection and let the retry policy
+                    # re-send with the new epoch (one extra round-trip).
+                    try:
+                        body = await resp.json()
+                    except Exception:  # noqa: BLE001 - non-JSON 409
+                        body = {}
+                    if body.get("error") == "stale_epoch":
+                        self._learn_epoch(body.get("current_epoch"))
+                        self._consecutive_errors = 0
+                        raise TransientServerError(
+                            f"{path} -> stale epoch (refreshed to "
+                            f"{self.epoch})", self.worker_id,
+                        )
+                    raise WorkerError(
+                        f"{path} -> HTTP {resp.status}", self.worker_id
+                    )
+                if resp.status >= 500:
+                    self._count_error(op)
+                    raise TransientServerError(
+                        f"{path} -> HTTP {resp.status}", self.worker_id
+                    )
+                if resp.status != 200:
+                    raise WorkerError(f"{path} -> HTTP {resp.status}", self.worker_id)
+                out = await resp.json()
+        except transport_errors() as exc:
+            self._count_error(op)
+            raise exc
+        self._consecutive_errors = 0
+        if isinstance(out, dict):
+            self._learn_epoch(out.get("epoch"))
+        return out
 
     def poll_ready(self) -> bool:
         async def attempt():
             out = await self._post(
                 "/distributed/job_status",
                 {"job_id": self.job_id, "worker_id": self.worker_id},
+                op="status",
             )
             if not out.get("ready"):
                 raise WorkerError(f"job {self.job_id} not ready", self.worker_id)
@@ -149,7 +259,9 @@ class HTTPWorkClient:
                 payload["batch_max"] = int(batch_max)
             try:
                 return await retry_async(
-                    lambda: self._post("/distributed/request_image", payload),
+                    lambda: self._post(
+                        "/distributed/request_image", payload, op="pull"
+                    ),
                     work_pull_policy(),
                     label=f"request_tile:{self.worker_id}",
                 )
@@ -181,6 +293,7 @@ class HTTPWorkClient:
                         "tiles": entries,
                         "is_final_flush": is_final,
                     },
+                    op="submit",
                 ),
                 http_policy(),
                 retryable=self._submit_retryable(),
@@ -203,6 +316,7 @@ class HTTPWorkClient:
                         "image": data_url,
                         "is_last": is_last,
                     },
+                    op="submit",
                 ),
                 http_policy(),
                 retryable=self._submit_retryable(),
@@ -212,6 +326,19 @@ class HTTPWorkClient:
         run_async_in_server_loop(send(), timeout=300)
 
     def heartbeat(self) -> None:
+        """Best-effort liveness beat — with exponential suppression on
+        consecutive failures: the pipeline heartbeats once per tile
+        plus idle beats, so during a master outage an unsuppressed
+        worker fleet is a log/request flood on top of the pull path's
+        own (already patient) retrying. After k consecutive failures
+        beats are skipped for min(base*2^(k-1), cap) seconds; the first
+        success resets the schedule. Failures count into
+        cdt_worker_master_errors_total and into the failover rotation
+        like any other master RPC error."""
+        now = time.monotonic()
+        if now < self._hb_suppressed_until:
+            return
+
         async def beat():
             try:
                 await self._post(
@@ -221,9 +348,23 @@ class HTTPWorkClient:
                         "worker_id": self.worker_id,
                         "devices": self.devices,
                     },
+                    op="heartbeat",
                 )
             except Exception as exc:  # noqa: BLE001 - heartbeats best-effort
-                debug_log(f"heartbeat failed: {exc}")
+                self._hb_failures += 1
+                backoff = min(
+                    HEARTBEAT_BACKOFF_BASE_SECONDS
+                    * (2.0 ** (self._hb_failures - 1)),
+                    HEARTBEAT_BACKOFF_CAP_SECONDS,
+                )
+                self._hb_suppressed_until = time.monotonic() + backoff
+                debug_log(
+                    f"heartbeat failed ({self._hb_failures} consecutive; "
+                    f"suppressing {backoff:.1f}s): {exc}"
+                )
+            else:
+                self._hb_failures = 0
+                self._hb_suppressed_until = 0.0
 
         run_async_in_server_loop(beat(), timeout=30)
 
@@ -243,11 +384,121 @@ class HTTPWorkClient:
                         "worker_id": self.worker_id,
                         "tile_idxs": [int(t) for t in tile_idxs],
                     },
+                    op="release",
                 )
             except Exception as exc:  # noqa: BLE001 - best effort
                 debug_log(f"return_tiles failed: {exc}")
 
         run_async_in_server_loop(send(), timeout=30)
+
+
+class GrantSignal:
+    """Push-mode grant wakeups (CDT_PUSH_GRANTS): the worker holds the
+    master's `/distributed/events` WebSocket (filtered to
+    `grant_available`/`job_ready`/`job_complete`) and flips a thread
+    Event whenever grants land, so the pull loop wakes the instant work
+    exists instead of discovering it on a poll boundary — that is the
+    grant-RTT cut — and parks while the queue is dry instead of burning
+    empty request_image round-trips — that is the idle-poll cut.
+
+    Strictly an ACCELERATOR over the pull protocol: grants still
+    transfer via request_image (push carries availability, never
+    assignment, so placement sizing/fencing/first-result-wins are
+    untouched), and every failure mode — WS refused, stream dropped,
+    master failed over — degrades to exactly the pull behavior. The
+    socket follows the client's failover rotation via `url_provider`.
+    """
+
+    def __init__(self, url_provider, job_id: str):
+        self.url_provider = url_provider
+        self.job_id = job_id
+        self._event = threading.Event()
+        self._stopped = threading.Event()
+        self.connected = False
+        self._complete = False
+        self._future = None
+
+    # --- worker-thread side ------------------------------------------------
+
+    def wait_for_grant(self, timeout: float) -> bool:
+        """Park until a grant_available lands (True) or `timeout`
+        passes (False); clears the flag so the next wait needs a new
+        push. Never blocks when the stream is down — pull fallback."""
+        if not self.connected:
+            return False
+        fired = self._event.wait(timeout)
+        self._event.clear()
+        return fired
+
+    @property
+    def job_complete(self) -> bool:
+        return self._complete
+
+    def start(self) -> None:
+        from ..utils.async_helpers import get_server_loop
+
+        loop = get_server_loop()
+        if loop is None or not loop.is_running():
+            return  # no loop, no stream: pure pull mode
+        import asyncio as _asyncio
+
+        self._future = _asyncio.run_coroutine_threadsafe(self._run(), loop)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        future = self._future
+        if future is not None:
+            future.cancel()
+            self._future = None
+
+    # --- server-loop side --------------------------------------------------
+
+    async def _run(self) -> None:
+        import asyncio as _asyncio
+        import json as _json
+
+        from aiohttp import WSMsgType
+
+        while not self._stopped.is_set():
+            url = self.url_provider()
+            try:
+                session = await get_client_session()
+                async with session.ws_connect(
+                    f"{url}/distributed/events"
+                    "?types=grant_available,job_ready,job_complete",
+                    heartbeat=30,
+                ) as ws:
+                    self.connected = True
+                    async for msg in ws:
+                        if self._stopped.is_set():
+                            return
+                        if msg.type != WSMsgType.TEXT:
+                            break
+                        try:
+                            frame = _json.loads(msg.data)
+                        except (TypeError, ValueError):
+                            continue
+                        data = frame.get("data") or {}
+                        if data.get("job_id") not in (None, self.job_id):
+                            continue
+                        kind = frame.get("type")
+                        if kind in ("grant_available", "job_ready"):
+                            self._event.set()
+                        elif kind == "job_complete":
+                            self._complete = True
+                            self._event.set()
+                            return
+            except _asyncio.CancelledError:
+                return
+            except Exception as exc:  # noqa: BLE001 - degrade to pull
+                debug_log(f"grant signal stream to {url} failed: {exc}")
+            finally:
+                self.connected = False
+            if self._stopped.is_set():
+                return
+            # the pull path keeps working meanwhile; reconnect follows
+            # the client's (possibly rotated) master address
+            await _asyncio.sleep(1.0)
 
 
 def _flush_threshold_bytes() -> int:
@@ -424,11 +675,31 @@ def run_worker_loop(
     # historical one-at-a-time pull.
     pull_work = _make_pull(client)
 
+    # Push-mode grants (CDT_PUSH_GRANTS): hold the master's event
+    # stream and, after an empty pull, park one PUSH_WAIT on the grant
+    # signal before concluding the queue is drained — requeued/
+    # speculated tiles reach this worker instead of defaulting to the
+    # master's local fallback, and no empty poll requests burn while
+    # the queue is dry. Scripted test clients (no master_url) and
+    # CDT_PUSH_GRANTS=0 keep the pure pull protocol.
+    push: Optional[GrantSignal] = None
+    if PUSH_GRANTS_ENABLED and getattr(client, "master_url", None):
+        push = GrantSignal(lambda: client.master_url, job_id)
+        push.start()
+
+    def _grant_ids(work: dict) -> list[int]:
+        return [int(t) for t in (work.get("tile_idxs") or [work["tile_idx"]])]
+
     def pull() -> Optional[list[int]]:
         work = pull_work()
-        if work is None:
-            return None
-        return [int(t) for t in (work.get("tile_idxs") or [work["tile_idx"]])]
+        if work is not None:
+            return _grant_ids(work)
+        if push is not None and not push.job_complete:
+            if push.wait_for_grant(PUSH_WAIT_SECONDS):
+                work = pull_work()
+                if work is not None:
+                    return _grant_ids(work)
+        return None
 
     pipeline = TilePipeline(
         pull=pull,
@@ -451,7 +722,11 @@ def run_worker_loop(
         span_attrs={"worker_id": worker_id} if worker_id else None,
         threaded=PIPELINE_ENABLED,
     )
-    pipeline.run()
+    try:
+        pipeline.run()
+    finally:
+        if push is not None:
+            push.stop()
 
 
 def _jit_tile_processor(bundle, grid, steps, sampler, scheduler, cfg, denoise,

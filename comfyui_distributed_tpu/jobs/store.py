@@ -41,7 +41,7 @@ import time
 from typing import Any, Awaitable, Callable, Optional
 
 from ..telemetry import instruments
-from ..utils.exceptions import JobQueueError
+from ..utils.exceptions import JobQueueError, StaleEpoch
 from ..utils.logging import debug_log, log
 from .models import CollectorJob, ImageJob, TileJob
 
@@ -80,6 +80,54 @@ class JobStore:
         # placement policy so grants scale with fleet shape. Advisory:
         # written only from the server loop, read by status surfaces.
         self.worker_capacity: dict[str, int] = {}
+        # Fencing epoch (the master lease's): mutating RPCs that carry
+        # an `epoch` older than this are rejected with StaleEpoch
+        # BEFORE any mutation or journal emission — pre-takeover
+        # authority (a zombie ex-master, or grants issued by one) can
+        # never interleave into this store. 0 = fencing off.
+        self.epoch = 0
+        # Push-mode grants (CDT_PUSH_GRANTS): fired with
+        # (job_id, task_count) whenever the pending queue gains work
+        # (init, requeue, release, speculation) so the scheduler can
+        # push grant_available events to parked workers instead of
+        # them pull-polling. Must be non-blocking; failures advisory.
+        self.grant_notifier: Optional[Callable[[str, int], None]] = None
+
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt the lease epoch (monotonic; a lower value is ignored)."""
+        self.epoch = max(self.epoch, int(epoch))
+
+    def check_epoch(self, epoch: Any) -> None:
+        """Public fencing gate for route handlers: raise ``StaleEpoch``
+        before they touch ANY server-side state (including advisory
+        state like worker capacity) on behalf of a stale-authority
+        client. Same semantics as the internal per-mutation check."""
+        self._check_epoch(epoch)
+
+    def _check_epoch(self, epoch: Any) -> None:
+        """Reject an RPC whose fencing epoch predates the current one.
+        `None` (a client that never learned an epoch) passes — fencing
+        gates *stale* authority, not legacy clients; the rejection is
+        raised before any mutation, so a fenced RPC journals nothing."""
+        if epoch is None or not self.epoch:
+            return
+        try:
+            epoch = int(epoch)
+        except (TypeError, ValueError):
+            return
+        if epoch < self.epoch:
+            raise StaleEpoch(
+                f"epoch {epoch} predates current epoch {self.epoch}",
+                current=self.epoch,
+            )
+
+    def _notify_grants(self, job_id: str, count: int) -> None:
+        cb = self.grant_notifier
+        if cb is not None and count > 0:
+            try:
+                cb(job_id, int(count))
+            except Exception as exc:  # noqa: BLE001 - push is advisory
+                debug_log(f"grant notifier failed for {job_id}: {exc}")
 
     def note_worker_capacity(self, worker_id: str, devices: Any) -> None:
         """Record a worker's advertised chip count (from the `devices`
@@ -259,7 +307,15 @@ class JobStore:
                 job.pending.put_nowait(tid)
             self.tile_jobs[job_id] = job
             self._wake(self._tile_waiters.pop(job_id, []))
-            return job
+        # Outside the lock: lifecycle + grant pushes are observability/
+        # wakeup signals, not state. job_ready lets push-mode workers
+        # skip the 1 s job_status poll loop; grant_available wakes
+        # parked pull loops.
+        from ..telemetry.events import get_event_bus
+
+        get_event_bus().publish("job_ready", job_id=job_id, tasks=len(task_ids))
+        self._notify_grants(job_id, len(task_ids))
+        return job
 
     async def get_tile_job(self, job_id: str) -> Optional[TileJob]:
         async with self.lock:
@@ -307,7 +363,11 @@ class JobStore:
         job.assigned_at[(worker_id, task_id)] = time.monotonic()
 
     async def pull_task(
-        self, job_id: str, worker_id: str, timeout: float = 0.1
+        self,
+        job_id: str,
+        worker_id: str,
+        timeout: float = 0.1,
+        epoch: Any = None,
     ) -> Optional[int]:
         """Pop the next pending task id for a worker (None = drained).
         Records assignment + heartbeat for requeue bookkeeping. An
@@ -316,6 +376,7 @@ class JobStore:
         A placement-trimmed pull reads exactly like a drained queue —
         the worker flushes and exits while faster participants finish
         the tail."""
+        self._check_epoch(epoch)
         await self._fault("pull", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
@@ -346,6 +407,7 @@ class JobStore:
         worker_id: str,
         timeout: float = 0.1,
         limit: Optional[int] = None,
+        epoch: Any = None,
     ) -> list[int]:
         """Speed-weighted batch pull: the first task waits up to
         `timeout` (exactly pull_task); additional pending tasks are
@@ -353,7 +415,7 @@ class JobStore:
         size for this worker (and the caller's `limit`). Without a
         placement policy the batch is 1 — byte-identical behavior to
         the historical single pull."""
-        first = await self.pull_task(job_id, worker_id, timeout)
+        first = await self.pull_task(job_id, worker_id, timeout, epoch=epoch)
         if first is None:
             return []
         tasks = [first]
@@ -405,12 +467,14 @@ class JobStore:
         task_id: int,
         payload: Any,
         service_seconds: Optional[float] = None,
+        epoch: Any = None,
     ) -> bool:
         """Record one completed task; False if duplicate (already done
         — a requeued-then-recovered worker's late submission, or the
         losing side of a speculative race: first result wins).
         `service_seconds` overrides the measured latency for tiles that
         traveled in a flushed batch (see `submit_flush`)."""
+        self._check_epoch(epoch)
         await self._fault("submit", worker_id)
         job = await self.get_tile_job(job_id)
         if job is None:
@@ -477,7 +541,11 @@ class JobStore:
         return True
 
     async def submit_flush(
-        self, job_id: str, worker_id: str, grouped: dict[int, Any]
+        self,
+        job_id: str,
+        worker_id: str,
+        grouped: dict[int, Any],
+        epoch: Any = None,
     ) -> int:
         """Record a FLUSH: several tiles that traveled in one submit
         request (the production worker batches up to CDT_MAX_BATCH
@@ -487,6 +555,7 @@ class JobStore:
         the per-entry arrival gaps instead would log k-1 near-zero
         latencies per flush and poison the straggler median and the
         placement speed EWMA. Returns the number of accepted tiles."""
+        self._check_epoch(epoch)  # once per flush; the per-tile submits inherit
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
@@ -512,7 +581,10 @@ class JobStore:
                 accepted += 1
         return accepted
 
-    async def mark_worker_done(self, job_id: str, worker_id: str) -> None:
+    async def mark_worker_done(
+        self, job_id: str, worker_id: str, epoch: Any = None
+    ) -> None:
+        self._check_epoch(epoch)
         job = await self.get_tile_job(job_id)
         if job is None:
             return
@@ -523,7 +595,10 @@ class JobStore:
                 )
             job.finished_workers.add(worker_id)
 
-    async def heartbeat(self, job_id: str, worker_id: str) -> bool:
+    async def heartbeat(
+        self, job_id: str, worker_id: str, epoch: Any = None
+    ) -> bool:
+        self._check_epoch(epoch)
         job = await self.get_tile_job(job_id)
         if job is None:
             return False
@@ -545,9 +620,17 @@ class JobStore:
             return len(job.completed) >= job.total_tasks
 
     async def cleanup_tile_job(self, job_id: str) -> None:
+        removed = False
         async with self.lock:
             if self.tile_jobs.pop(job_id, None) is not None:
                 self._journal({"type": "cleanup", "job": job_id})
+                removed = True
+        if removed:
+            # push-mode workers parked on the grant signal exit
+            # immediately instead of waiting out their idle timeout
+            from ..telemetry.events import get_event_bus
+
+            get_event_bus().publish("job_complete", job_id=job_id)
 
     # --- timeout / requeue --------------------------------------------------
 
@@ -625,6 +708,10 @@ class JobStore:
             instruments.store_requeued_tasks_total().inc(
                 len(incomplete), worker_id=worker_id, reason=reason
             )
+            # non-blocking push wakeup (the lock is held here): the
+            # requeued tiles are exactly the grants push-mode workers
+            # should race for instead of the master's local fallback
+            self._notify_grants(job.job_id, len(incomplete))
             log(
                 f"requeued {len(incomplete)} task(s) from "
                 f"worker {worker_id} on job {job.job_id}"
@@ -652,7 +739,11 @@ class JobStore:
         return out
 
     async def release_tasks(
-        self, job_id: str, worker_id: str, task_ids: list[int]
+        self,
+        job_id: str,
+        worker_id: str,
+        task_ids: list[int],
+        epoch: Any = None,
     ) -> list[int]:
         """Voluntarily hand back claimed-but-unprocessed tasks — the
         graceful half of requeue: an interrupted worker returns the
@@ -660,6 +751,7 @@ class JobStore:
         requeue NOW instead of waiting out the heartbeat timeout. Only
         tasks actually assigned to this worker and not yet completed go
         back (a stale release after a speculative win is a no-op)."""
+        self._check_epoch(epoch)
         job = await self.get_tile_job(job_id)
         if job is None:
             return []
@@ -690,6 +782,7 @@ class JobStore:
             instruments.store_requeued_tasks_total().inc(
                 len(released), worker_id=worker_id, reason="released"
             )
+            self._notify_grants(job_id, len(released))
             log(
                 f"worker {worker_id} returned {len(released)} task(s) "
                 f"on job {job_id}: {released}"
@@ -734,6 +827,7 @@ class JobStore:
             get_event_bus().publish(
                 "speculative_requeue", job_id=job_id, task_ids=speculated
             )
+            self._notify_grants(job_id, len(speculated))
             log(
                 f"speculatively re-enqueued {len(speculated)} in-flight "
                 f"task(s) on job {job_id}: {speculated}"

@@ -53,8 +53,9 @@ import contextlib
 import dataclasses
 import os
 import threading
+import time
 import types
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 from unittest import mock
 
 import numpy as np
@@ -709,4 +710,418 @@ def run_chaos_master_crash(
         report=report.as_json(),
         crash_error=crash_error,
         fired=list(injector.fired),
+    )
+
+
+# --------------------------------------------------------------------------
+# warm-standby failover scenarios (HA layer acceptance)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FailoverResult:
+    """Outcome of a kill-the-active-master + standby-promotes run."""
+
+    output: np.ndarray
+    report: dict          # the promotion's recovery report
+    crash_error: str
+    fired: list[FaultAction]
+    epochs: tuple[int, int]        # (active's epoch, promoted epoch)
+    replica: dict                  # standby replica status at promotion
+    zombie_fenced: bool            # ex-active journal append -> FencedOut
+    stale_pull_rejected: bool      # epoch-1 pull on the new store -> StaleEpoch
+    stale_submit_rejected: bool    # epoch-1 submit -> StaleEpoch
+    zombie_journaled_records: int  # journal growth from fenced attempts (0!)
+    repointed_workers: list[str]   # workers that pulled the PROMOTED store
+    # tile the harness claimed against the dying master and never
+    # submitted: its requeue-at-promotion is the non-vacuous proof the
+    # prepare_for_restart path ran (None when the queue was already dry)
+    orphan_tile: Optional[int] = None
+
+    def fired_kinds(self) -> set[str]:
+        return {a.kind for a in self.fired}
+
+
+def run_chaos_failover(
+    seed: int = 0,
+    crash_plan: str = "crash@store:pull:master#2;crash@chaos:w1:pulled#2",
+    *,
+    journal_dir: str,
+    workers: Sequence[str] = ("w1", "w2"),
+    image_hw: tuple[int, int] = (64, 64),
+    tile: int = 64,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    worker_timeout: float = 0.6,
+    job_id: str = "chaos-failover-job",
+    snapshot_every: int = 4,
+    lease_ttl: float = 0.3,
+    push_grants: bool = False,
+) -> FailoverResult:
+    """Kill-the-active-master failover, in process and deterministic.
+
+    The full HA protocol with the transports removed (the same halves
+    api/replication_routes.py + api/standby.py put on a WebSocket):
+
+    - **phase 1 (the active master that dies)**: the elastic USDU loop
+      runs with the write-ahead journal attached, holding the
+      epoch-numbered lease on `journal_dir`; a live standby replica
+      tails the journal through a ``ReplicationSubscription`` (attach-
+      consistent snapshot + record tee — the exact stream the WS route
+      serves) on its own thread. `crash_plan` kills the master mid-job
+      at a scripted store RPC (`crash@store:pull:master#k` = after a
+      pull, `crash@store:submit:master#k` = after a partial submit;
+      pass ``snapshot_every=1`` to land the crash inside the snapshot
+      cadence). A worker-crash rule (`crash@chaos:<w>:pulled#k`)
+      guarantees an in-flight orphan tile exists at takeover, so the
+      promotion's requeue path is never vacuous.
+
+    - **takeover**: surviving workers observe the dead master (their
+      next pull parks, exactly as re-pointed HTTP clients park in their
+      retry/rotation loop); the standby waits out the lease TTL, takes
+      the lease (epoch+1), drains the final teed records, and promotes:
+      ``DurabilityManager.adopt`` — `prepare_for_restart` semantics
+      end to end (in-flight tiles requeued for bit-identical recompute,
+      durable worker payloads restored), journal reopened at the
+      replicated head, immediate snapshot.
+
+    - **fencing probes** (the regression the acceptance demands): after
+      takeover the ex-active's journal seam must raise ``FencedOut``
+      and journal NOTHING; the promoted store must reject pre-takeover
+      authority (pull and submit carrying the old epoch) with
+      ``StaleEpoch`` BEFORE any mutation — both probed directly and
+      reported in the result.
+
+    - **phase 2 (the promoted master serves)**: workers re-point to the
+      promoted store (carrying the new epoch) and a fresh master loop
+      drains the job to completion — no process restart anywhere. The
+      caller asserts the canvas is bit-identical to an uninterrupted
+      run.
+
+    `push_grants=True` wires the store's grant notifier through a
+    PlacementPolicy (the production push publisher) on both stores —
+    the pushed-grant path must survive the same failover the pull
+    fallback does.
+    """
+    import jax.numpy as jnp
+
+    from ..durability import (
+        DurabilityManager,
+        FencedOut,
+        Lease,
+        StandbyReplica,
+        read_lease,
+    )
+    from ..graph import ExecutionContext
+    from ..graph import usdu_elastic as elastic
+    from ..graph.tile_pipeline import GrantSampler, TilePipeline
+    from ..jobs import JobStore
+    from ..ops import upscale as upscale_ops
+    from ..utils import config as config_mod
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+    from ..utils.exceptions import JobQueueError, StaleEpoch
+
+    h, w = image_hw
+    image = jnp.asarray(
+        np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    bundle = types.SimpleNamespace(params=None)
+
+    # Shared failover state the worker threads re-point through: the
+    # in-process analogue of HTTPWorkClient's address list + epoch.
+    crashed = threading.Event()
+    promoted = threading.Event()
+    holder: dict[str, Any] = {"store": None, "epoch": 0}
+    repointed: list[str] = []
+    repointed_lock = threading.Lock()
+
+    def worker_body(wid: str) -> None:
+        _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        import jax as _jax
+
+        key = _jax.random.key(seed)
+        job = run_async_in_server_loop(
+            holder["store"].wait_for_tile_job(job_id, grace_seconds=20),
+            timeout=30,
+        )
+        if job is None:
+            return
+        sampler = GrantSampler(
+            _stub_process, None, extracted, key, grid.positions_array(),
+            None, None, k_max=1, role="worker",
+        )
+        flush_pending: dict[int, list] = {}
+        seen_promoted = False
+
+        def pull():
+            nonlocal seen_promoted
+            while True:
+                if crashed.is_set() and not promoted.is_set():
+                    # the master is dead: the re-pointing client parks
+                    # in its rotation/retry loop until a standby
+                    # promotes (or the run is abandoned)
+                    if not promoted.wait(timeout=15):
+                        return None
+                store, epoch = holder["store"], holder["epoch"]
+                if promoted.is_set() and not seen_promoted:
+                    seen_promoted = True
+                    with repointed_lock:
+                        repointed.append(wid)
+                if injector is not None:
+                    injector.check_blocking(f"chaos:{wid}:pull")
+                try:
+                    return run_async_in_server_loop(
+                        store.pull_tasks(
+                            job_id, wid, timeout=0.2, epoch=epoch
+                        ),
+                        timeout=10,
+                    ) or None
+                except StaleEpoch:
+                    continue  # takeover mid-RPC: refresh epoch and retry
+                except (JobQueueError, FencedOut):
+                    if promoted.is_set() and store is holder["store"]:
+                        return None  # promoted store tore the job down: done
+                    continue  # dead master's store; re-point and retry
+
+        def sample(chunk):
+            if injector is not None:
+                for _t in chunk:
+                    injector.check_blocking(f"chaos:{wid}:pulled")
+            return sampler.sample(chunk)
+
+        def emit(tile_idx, arr):
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+
+        def flush(is_final):
+            if not flush_pending:
+                return
+            grouped = dict(flush_pending)
+            flush_pending.clear()
+            store, epoch = holder["store"], holder["epoch"]
+            try:
+                run_async_in_server_loop(
+                    store.submit_flush(job_id, wid, grouped, epoch=epoch),
+                    timeout=10,
+                )
+            except (StaleEpoch, FencedOut, JobQueueError):
+                # pre-takeover authority / dead store: drop the flush —
+                # the promotion requeued these tiles and their recompute
+                # is bit-identical (the whole point of the invariant)
+                pass
+
+        def heartbeat():
+            try:
+                run_async_in_server_loop(
+                    holder["store"].heartbeat(
+                        job_id, wid, epoch=holder["epoch"]
+                    ),
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001 - liveness best effort
+                pass
+
+        try:
+            TilePipeline(
+                pull=pull, sample=sample, chunks=sampler.chunks,
+                emit=emit, flush=flush, heartbeat=heartbeat,
+                role="worker", span_attrs={"worker_id": wid}, threaded=False,
+            ).run()
+        except FaultInjected as exc:
+            debug_log(f"chaos worker {wid} died: {exc}")
+        except JobQueueError:
+            pass
+
+    def run_master(store: Any) -> Any:
+        ctx = ExecutionContext(
+            server=types.SimpleNamespace(job_store=store),
+            config={"workers": []},
+        )
+        return elastic.run_master_elastic(
+            bundle, image, pos, neg,
+            job_id=job_id,
+            enabled_worker_ids=list(workers),
+            upscale_by=upscale_by, tile=tile, padding=padding,
+            steps=1, sampler="euler", scheduler="karras",
+            cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+        )
+
+    def wire_push(store: JobStore) -> None:
+        if not push_grants:
+            return
+        from ..scheduler.placement import PlacementPolicy
+
+        policy = PlacementPolicy(min_samples=1)
+        store.placement = policy
+        store.grant_notifier = policy.notify_grants
+
+    injector = FaultInjector(f"seed={seed};{crash_plan}")
+    crash_error = ""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.object(
+                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+            )
+        )
+        stack.enter_context(
+            mock.patch.object(
+                config_mod, "get_worker_timeout_seconds",
+                lambda path=None: worker_timeout,
+            )
+        )
+        stack.enter_context(
+            mock.patch.dict(
+                os.environ,
+                {"CDT_DETERMINISTIC_BLEND": "1", "CDT_TILE_BATCH": "1"},
+            )
+        )
+
+        # --- phase 1: the active master, its lease, and a live standby ---
+        store1 = JobStore(fault_injector=injector)
+        manager1 = DurabilityManager(
+            journal_dir, snapshot_every=snapshot_every, fsync_every=0
+        )
+        lease1 = Lease(journal_dir, owner="chaos-active", ttl=lease_ttl)
+        epoch1 = lease1.acquire(force=True)
+        manager1.lease = lease1
+        store1.journal_sink = manager1.record
+        store1.set_epoch(epoch1)
+        wire_push(store1)
+        holder["store"], holder["epoch"] = store1, epoch1
+
+        # the standby: attach-consistent subscription + replica tail
+        # thread (the direct wiring of the WS stream's two halves)
+        sub = manager1.subscribe_replica()
+        replica = StandbyReplica()
+        replica.reset(sub.snapshot_state, sub.head_lsn, sub.epoch)
+        tail_stop = threading.Event()
+
+        def tail_body() -> None:
+            while not tail_stop.is_set():
+                sub.wait(0.02)
+                for record in sub.pop():
+                    replica.apply(record)
+                replica.note_head(manager1.head_lsn(), epoch1)
+
+        tail = threading.Thread(target=tail_body, name="chaos-standby", daemon=True)
+        tail.start()
+
+        threads = [
+            threading.Thread(target=worker_body, args=(wid,), daemon=True)
+            for wid in workers
+        ]
+        for t in threads:
+            t.start()
+        try:
+            run_master(store1)
+            raise RuntimeError(
+                f"failover crash plan {crash_plan!r} never fired; the "
+                "scenario would be vacuous"
+            )
+        except FaultInjected as exc:
+            crash_error = str(exc)
+            debug_log(f"chaos active master died: {exc}")
+        crashed.set()
+        # Deterministic orphan: claim one tile against the dying master
+        # and never submit it — the pull journals (and replicates), so
+        # the promotion MUST requeue it. Models the grant the dead
+        # process served in its last instant.
+        orphan_tile = None
+        try:
+            orphan_tile = run_async_in_server_loop(
+                store1.pull_task(job_id, "orphan", timeout=0.2, epoch=epoch1),
+                timeout=10,
+            )
+        except Exception:  # noqa: BLE001 - queue already dry is legal
+            orphan_tile = None
+
+        # --- takeover: wait out the TTL, then promote the standby --------
+        deadline = time.monotonic() + max(5.0, lease_ttl * 20)
+        while time.monotonic() < deadline:
+            state = read_lease(journal_dir)
+            if state is None or state.expires_at <= time.time():
+                break
+            time.sleep(lease_ttl / 10)
+        lease2 = Lease(journal_dir, owner="chaos-standby", ttl=lease_ttl)
+        epoch2 = lease2.acquire()  # NOT forced: the standby promotion gate
+        # final drain: post-takeover the ex-active is fenced, so no
+        # record can land after this
+        for record in sub.pop(max_items=100000):
+            replica.apply(record)
+        tail_stop.set()
+        tail.join(timeout=10)
+        replica_status = replica.status()
+
+        store2 = JobStore()
+        manager2 = DurabilityManager(
+            journal_dir, snapshot_every=snapshot_every, fsync_every=0
+        )
+        report = manager2.adopt(store2, replica, lease=lease2)
+        store2.journal_sink = manager2.record
+        store2.set_epoch(epoch2)
+        wire_push(store2)
+
+        # --- fencing probes (regression: the zombie mutates nothing) -----
+        head_before = manager2.head_lsn()
+        zombie_fenced = False
+        try:
+            manager1.record({"type": "submit", "job": job_id, "worker": "zombie",
+                             "task": 0, "payload": None})
+        except FencedOut:
+            zombie_fenced = True
+        stale_pull_rejected = False
+        try:
+            run_async_in_server_loop(
+                store2.pull_task(job_id, "zombie", timeout=0.01, epoch=epoch1),
+                timeout=10,
+            )
+        except StaleEpoch:
+            stale_pull_rejected = True
+        stale_submit_rejected = False
+        try:
+            run_async_in_server_loop(
+                store2.submit_result(
+                    job_id, "zombie", 0, None, epoch=epoch1
+                ),
+                timeout=10,
+            )
+        except StaleEpoch:
+            stale_submit_rejected = True
+        zombie_journaled = manager2.head_lsn() - head_before
+
+        # --- phase 2: the promoted master serves; workers re-point -------
+        holder["store"], holder["epoch"] = store2, epoch2
+        promoted.set()
+        try:
+            out = run_master(store2)
+        finally:
+            for t in threads:
+                t.join(timeout=30)
+            manager2.close()
+            manager1.close()
+            lease2.release()
+
+    return FailoverResult(
+        output=np.asarray(out),
+        report=report.as_json(),
+        crash_error=crash_error,
+        fired=list(injector.fired),
+        epochs=(epoch1, epoch2),
+        replica=replica_status,
+        zombie_fenced=zombie_fenced,
+        stale_pull_rejected=stale_pull_rejected,
+        stale_submit_rejected=stale_submit_rejected,
+        zombie_journaled_records=zombie_journaled,
+        repointed_workers=sorted(repointed),
+        orphan_tile=orphan_tile,
     )

@@ -269,6 +269,29 @@ class PlacementPolicy:
         with self._lock:
             self._trimmed[worker_id] = self._trimmed.get(worker_id, 0) + 1
 
+    # --- push-mode grants (CDT_PUSH_GRANTS) -------------------------------
+
+    def notify_grants(self, job_id: str, count: int) -> None:
+        """Push-mode grant dispatch: announce that `count` tasks just
+        became pullable on `job_id`. Published as a `grant_available`
+        event on the process bus — workers holding the
+        /distributed/events WebSocket wake and pull immediately instead
+        of discovering the work on their next poll, which is what cuts
+        grant RTT (no poll-interval quantization) and idle poll volume
+        (no empty request_image round-trips while the queue is dry).
+        The JobStore fires this hook on every pending-queue refill
+        (init, timeout/quarantine requeue, voluntary release,
+        speculation); it must never block — the bus is lock-light and
+        drops to a no-op with zero subscribers."""
+        from ..telemetry import instruments
+        from ..telemetry.events import get_event_bus
+
+        count = max(0, int(count))
+        if count == 0:
+            return
+        instruments.push_grants_total().inc(count)
+        get_event_bus().publish("grant_available", job_id=job_id, tasks=count)
+
     # --- durability hooks (durability/snapshot.py) ------------------------
 
     def export_state(self) -> dict:

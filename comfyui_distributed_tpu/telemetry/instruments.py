@@ -277,6 +277,52 @@ def recovery_requeued_tasks() -> Gauge:
     )
 
 
+# --- high availability: replication, failover, push grants ----------------
+
+def replication_lag_records() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_replication_lag_records",
+        "Journal records the standby replica is behind the active "
+        "master's head (source head lsn - applied lsn)",
+    )
+
+
+def replication_lag_seconds() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_replication_lag_seconds",
+        "Staleness of the newest replication frame the standby applied",
+    )
+
+
+def failover_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_failover_total",
+        "Master failovers by role: standby = promotions performed, "
+        "worker = client re-points to another master address",
+        ("role",),
+    )
+
+
+def push_grants_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_push_grants_total",
+        "Tasks announced over pushed grant_available events "
+        "(CDT_PUSH_GRANTS; workers wake on these instead of "
+        "pull-polling)",
+    )
+
+
+def worker_master_errors_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_worker_master_errors_total",
+        "Worker->master RPC failures by operation (heartbeat|pull|"
+        "submit|transport); consecutive failures back off "
+        "exponentially so a master outage never becomes a log/request "
+        "flood",
+        ("op",),
+    )
+
+
 # --- JAX runtime health (telemetry/runtime.py) ----------------------------
 
 def jax_compiles() -> Gauge:
@@ -486,6 +532,13 @@ def bind_server_collectors(server) -> Callable[[], None]:
         snapshot_age_seconds()
         recovery_replayed_records()
         recovery_requeued_tasks()
+        failover_total()
+        push_grants_total()
+    # Standby masters report replication lag from the first scrape.
+    if getattr(server, "standby", None) is not None:
+        replication_lag_records()
+        replication_lag_seconds()
+        failover_total()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale
@@ -523,6 +576,13 @@ def bind_server_collectors(server) -> Callable[[], None]:
         durability = getattr(server, "durability", None)
         if durability is not None:
             durability.collect_metrics()
+        standby = getattr(server, "standby", None)
+        if standby is not None and not standby.promoted:
+            replica = standby.replica
+            replication_lag_records().set(replica.lag_records())
+            lag_seconds = replica.lag_seconds()
+            if lag_seconds is not None:
+                replication_lag_seconds().set(lag_seconds)
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at

@@ -184,6 +184,32 @@ def compile_cache_dir() -> str | None:
     return os.path.join(os.getcwd(), ".cdt", "compile_cache")
 
 
+# --- high availability: lease, standby, failover, push grants -------------
+# The active master holds an epoch-numbered lease file in the journal
+# dir (durability/lease.py); a warm standby promotes itself when the
+# lease has been expired this long. The TTL bounds failover time AND
+# the zombie window: a fenced ex-master can keep serving at most one
+# TTL after losing the lease before its next journal append raises.
+LEASE_TTL_SECONDS = _env_float("CDT_LEASE_TTL", 10.0)
+# Standby reconnect/lease-poll cadence while following the active
+# master's replication stream (api/standby.py).
+STANDBY_POLL_SECONDS = _env_float("CDT_STANDBY_POLL", 1.0)
+# Per-standby replication buffer (records). Overflow marks the stream
+# LOST (never drops interior records — a hole would silently desync the
+# replica) and the standby re-syncs from a fresh snapshot frame.
+STANDBY_BUFFER_RECORDS = _env_int("CDT_STANDBY_BUFFER", 4096)
+# Consecutive transport/5xx failures against one master address before
+# the worker client rotates to the next address in its list.
+FAILOVER_AFTER_ERRORS = _env_int("CDT_FAILOVER_AFTER", 2)
+# Push-mode grants: workers hold the /distributed/events WebSocket and
+# wake on pushed grant_available frames instead of pull-polling; 0
+# restores the pure pull-poll protocol (the chaos-suite fallback).
+PUSH_GRANTS_ENABLED = os.environ.get("CDT_PUSH_GRANTS", "1") != "0"
+# How long a push-mode worker parks on the grant signal after an empty
+# pull before concluding the queue is drained (one extra wait vs the
+# pull protocol's immediate exit).
+PUSH_WAIT_SECONDS = _env_float("CDT_PUSH_WAIT", 1.0)
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

@@ -43,6 +43,20 @@ class JobQueueError(DistributedError):
     """Job queue state is missing or inconsistent."""
 
 
+class StaleEpoch(DistributedError):
+    """An RPC carried a fencing epoch older than the store's current
+    one: its authority predates a master takeover (the fencing-token
+    pattern). The RPC is rejected BEFORE any mutation or journal
+    append — a zombie ex-master (or a worker still holding its grants)
+    cannot interleave pre-failover state into the promoted store. The
+    rejection carries the current epoch so live workers can refresh
+    and re-register."""
+
+    def __init__(self, message: str, current: int = 0):
+        super().__init__(message)
+        self.current = int(current)
+
+
 class TileCollectionError(DistributedError):
     """Collecting tile/image results failed irrecoverably."""
 

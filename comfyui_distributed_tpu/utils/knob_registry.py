@@ -158,6 +158,28 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_SNAPSHOT_EVERY", "256", "durability",
          "Journal appends between control-plane snapshots; each snapshot "
          "prunes the segments it supersedes."),
+    # --- high availability (failover / push grants) ----------------------
+    Knob("CDT_FAILOVER_AFTER", "2", "ha",
+         "Consecutive transport/5xx failures against one master address before "
+         "the worker client rotates to the next address in its list."),
+    Knob("CDT_LEASE_TTL", "10.0", "ha",
+         "Master lease TTL in seconds (durability/lease.py): the standby "
+         "promotes itself once the lease has been expired this long; also "
+         "bounds the zombie window before epoch fencing bites."),
+    Knob("CDT_PUSH_GRANTS", "1", "ha",
+         "`0` disables push-mode grants: workers then pull-poll instead of "
+         "waking on pushed grant_available events over /distributed/events."),
+    Knob("CDT_PUSH_WAIT", "1.0", "ha",
+         "Seconds a push-mode worker parks on the grant signal after an empty "
+         "pull before concluding the queue is drained."),
+    Knob("CDT_STANDBY_BUFFER", "4096", "ha",
+         "Per-standby replication buffer in records; overflow marks the "
+         "stream lost and the standby re-syncs from a fresh snapshot frame."),
+    Knob("CDT_STANDBY_OF", "unset", "ha",
+         "Comma-separated active-master URL list; set (or pass --standby) to "
+         "run this master as a warm standby tailing the journal stream."),
+    Knob("CDT_STANDBY_POLL", "1.0", "ha",
+         "Standby reconnect/lease-poll cadence in seconds."),
     # --- telemetry -------------------------------------------------------
     Knob("CDT_METRIC_MAX_SERIES", "128", "telemetry",
          "Per-metric label-series cap; excess series collapse into `_overflow`."),

@@ -25,6 +25,18 @@ from .constants import (
 )
 from .logging import debug_log
 
+def parse_master_urls(raw) -> list[str]:
+    """One URL or a comma-separated failover list ('active,standby').
+    Shared by the worker client (rotates on consecutive failures,
+    CDT_FAILOVER_AFTER) and the standby controller (rotates its
+    replication stream) so both sides agree on list semantics."""
+    if isinstance(raw, str):
+        urls = [u.strip().rstrip("/") for u in raw.split(",")]
+    else:
+        urls = [str(u).strip().rstrip("/") for u in raw]
+    return [u for u in urls if u]
+
+
 # One pooled session per event loop (the server loop keeps one long-lived
 # session; transient asyncio.run loops get their own and must close it
 # via close_client_session before the loop dies).

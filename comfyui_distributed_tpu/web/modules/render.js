@@ -236,7 +236,23 @@ export function durabilityHtml(info) {
     info.snapshot_age_seconds == null
       ? "never"
       : `${Number(info.snapshot_age_seconds).toFixed(1)}s ago`;
+  const repl = info.replication || {};
+  const role = info.role || "active";
+  const roleMeta =
+    role === "standby"
+      ? `epoch ${info.epoch ?? 0} · lag ${repl.lag_records ?? "?"} record(s)` +
+        (repl.lag_seconds == null
+          ? ""
+          : ` / ${Number(repl.lag_seconds).toFixed(1)}s`) +
+        ` · ${repl.synced ? "synced" : "SYNCING"}`
+      : `epoch ${info.epoch ?? 0} · ${repl.standbys ?? 0} standby(s)` +
+        (repl.lost ? ` (${repl.lost} lost)` : "") +
+        (info.failovers ? ` · ${info.failovers} failover(s)` : "");
   const rows = [
+    `<div class="row"><strong>role</strong><span class="meta">` +
+      `${escapeHtml(String(role))}${
+        role === "deposed" ? " — a standby took the lease" : ""
+      } · ${roleMeta}</span></div>`,
     `<div class="row"><strong>journal</strong><span class="meta">` +
       `lsn ${journal.next_lsn ?? "?"} · ${info.appends ?? 0} appends · ` +
       `${journal.closed_segments ?? 0} closed segment(s)` +

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Failover soak: N kill-promote-kill-back cycles + the push-vs-poll
+grant dispatch smoke.
+
+Two phases (CI job `failover-soak` runs this and uploads the JSON
+report as an artifact):
+
+1. **failover cycles** — `--cycles` in-process kill-the-active-master
+   scenarios (resilience/chaos.run_chaos_failover), rotating through
+   distinct kill points (after a pull, after a partial submit, inside
+   the snapshot cadence) and alternating push-mode grants on and off.
+   All cycles share ONE journal directory, so each promoted master is
+   the active the NEXT cycle kills — the lease epoch must climb
+   strictly across the whole ladder (the kill-promote-kill-back
+   property). Every cycle must (a) actually fire its crash, (b)
+   promote the standby without a process restart, (c) produce a canvas
+   bit-identical to the uninterrupted baseline, and (d) prove fencing:
+   the zombie's journal append raises, the promoted store rejects
+   stale-epoch RPCs, and neither journals a single record.
+
+2. **grant A/B smoke** — bench's push-vs-poll grant dispatch
+   measurement over the real HTTP surface (wave-released grants): push
+   mode must land a lower mean grant RTT and fewer idle poll requests
+   than pull mode.
+
+    python scripts/failover_soak.py [--out failover_soak.json]
+        [--cycles 6] [--skip-grant-ab]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+SEED = 11
+
+# Rotating kill points. The master always performs at least two pulls
+# (its empty_pulls<2 drain loop) and the store-side fault fires at the
+# RPC boundary regardless of queue state, so every plan is guaranteed
+# to fire on every run. snapshot_every=1 on the third plan lands the
+# crash inside the snapshot cadence (a snapshot precedes every append).
+KILL_POINTS = [
+    ("after_pull", "crash@store:pull:master#2", 4),
+    ("after_partial_submit",
+     "latency(1.0)@store:pull:w1#1;latency(1.0)@store:pull:w2#1;"
+     "crash@store:submit:master#1", 4),
+    ("during_snapshot", "crash@store:pull:master#3", 1),
+]
+
+
+def run_failover_cycles(cycles: int) -> dict:
+    import numpy as np
+
+    from comfyui_distributed_tpu.resilience.chaos import (
+        run_chaos_failover,
+        run_chaos_usdu,
+    )
+
+    baseline = run_chaos_usdu(seed=SEED).output
+    results = []
+    last_epoch = 0
+    with tempfile.TemporaryDirectory(prefix="cdt-failover-soak-") as journal_dir:
+        for cycle in range(cycles):
+            name, plan, snapshot_every = KILL_POINTS[cycle % len(KILL_POINTS)]
+            push = cycle % 2 == 1
+            started = time.perf_counter()
+            entry = {
+                "cycle": cycle,
+                "kill_point": name,
+                "push_grants": push,
+            }
+            try:
+                result = run_chaos_failover(
+                    seed=SEED,
+                    crash_plan=plan,
+                    journal_dir=journal_dir,
+                    snapshot_every=snapshot_every,
+                    push_grants=push,
+                    job_id=f"soak-failover-{cycle}",
+                )
+                identical = bool(np.array_equal(baseline, result.output))
+                epoch_climbed = result.epochs[1] > max(
+                    result.epochs[0], last_epoch
+                )
+                entry.update(
+                    {
+                        "crash_fired": "crash" in result.fired_kinds(),
+                        "epochs": list(result.epochs),
+                        "epoch_climbed": epoch_climbed,
+                        "bit_identical": identical,
+                        "zombie_fenced": result.zombie_fenced,
+                        "stale_pull_rejected": result.stale_pull_rejected,
+                        "stale_submit_rejected": result.stale_submit_rejected,
+                        "zombie_journaled_records":
+                            result.zombie_journaled_records,
+                        "tasks_requeued": result.report["tasks_requeued"],
+                        "tasks_restored": result.report["tasks_restored"],
+                        "repointed_workers": result.repointed_workers,
+                        "seconds": round(time.perf_counter() - started, 2),
+                    }
+                )
+                entry["ok"] = (
+                    entry["crash_fired"]
+                    and epoch_climbed
+                    and identical
+                    and result.zombie_fenced
+                    and result.stale_pull_rejected
+                    and result.stale_submit_rejected
+                    and result.zombie_journaled_records == 0
+                )
+                last_epoch = result.epochs[1]
+            except Exception as exc:  # noqa: BLE001 - reported per cycle
+                entry.update({"ok": False, "error": f"{type(exc).__name__}: {exc}"})
+            results.append(entry)
+            status = "ok" if entry["ok"] else "FAIL"
+            print(
+                f"cycle {cycle} [{name}, push={push}]: {status} "
+                f"(epochs {entry.get('epochs')})"
+            )
+    return {
+        "ok": all(r["ok"] for r in results),
+        "cycles": cycles,
+        "final_epoch": last_epoch,
+        "results": results,
+    }
+
+
+def run_grant_ab() -> dict:
+    import bench
+
+    ab = bench._measure_grant_ab()
+    if ab is None:
+        return {"ok": False, "error": "grant A/B did not produce a result"}
+    ok = (
+        ab["push"]["grant_rtt_ms_mean"] < ab["pull"]["grant_rtt_ms_mean"]
+        and ab["push"]["idle_polls"] <= ab["pull"]["idle_polls"]
+    )
+    return {"ok": ok, **ab}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="failover_soak.json")
+    parser.add_argument("--cycles", type=int, default=6)
+    parser.add_argument(
+        "--skip-grant-ab", action="store_true",
+        help="failover cycles only (fast smoke)",
+    )
+    args = parser.parse_args(argv)
+
+    cycles = run_failover_cycles(args.cycles)
+    grant_ab = (
+        {"ok": True, "skipped": True}
+        if args.skip_grant_ab
+        else run_grant_ab()
+    )
+    report = {
+        "ok": cycles["ok"] and grant_ab["ok"],
+        "failover_cycles": cycles,
+        "grant_ab": grant_ab,
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    passed = sum(1 for r in cycles["results"] if r.get("ok"))
+    print(
+        f"failover cycles: {passed}/{cycles['cycles']} promoted "
+        f"bit-identical with fencing (final epoch "
+        f"{cycles['final_epoch']}) -> {'OK' if cycles['ok'] else 'FAIL'}"
+    )
+    if not args.skip_grant_ab:
+        if grant_ab["ok"]:
+            print(
+                f"grant A/B: push {grant_ab['push']['grant_rtt_ms_mean']}ms "
+                f"vs pull {grant_ab['pull']['grant_rtt_ms_mean']}ms mean RTT "
+                f"({grant_ab['rtt_speedup']}x), idle polls "
+                f"{grant_ab['push']['idle_polls']} vs "
+                f"{grant_ab['pull']['idle_polls']} -> OK"
+            )
+        else:
+            print(f"grant A/B FAILED: {grant_ab}")
+    print(f"report written to {args.out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

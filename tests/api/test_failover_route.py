@@ -1,0 +1,273 @@
+"""Warm-standby failover over real HTTP: an active journaled master
+streams its WAL to a standby server (`/distributed/replicate`), the
+standby reports replication lag on `/distributed/durability` and gates
+work RPCs with 503, and when the active goes away it promotes itself —
+same process tree, no restart — adopting the in-flight job. Also
+covers the worker client's stale-epoch refresh and the push-grant
+signal end to end.
+"""
+
+import asyncio
+import json
+import socket
+import time
+import urllib.request
+from unittest import mock
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _post_json(url: str, payload: dict, timeout=10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+def _run(loop_thread, coro, timeout=30):
+    return asyncio.run_coroutine_threadsafe(coro, loop_thread.loop).result(
+        timeout=timeout
+    )
+
+
+def _wait_until(predicate, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def loop_thread():
+    thread = ServerLoopThread()
+    thread.start()
+    yield thread
+    thread.stop()
+
+
+def test_standby_follows_gates_and_promotes(
+    tmp_config_path, tmp_path, loop_thread
+):
+    env = {
+        "CDT_JOURNAL_DIR": str(tmp_path / "wal"),
+        "CDT_JOURNAL_FSYNC": "0",
+    }
+    with mock.patch.dict("os.environ", env):
+        # --- the active master: journaled, holding the lease ---------
+        port1 = _free_port()
+        active = DistributedServer(port=port1, is_worker=False)
+        _run(loop_thread, active.start())
+        standby_srv = None
+        try:
+            status, body = _get_json(
+                f"http://127.0.0.1:{port1}/distributed/durability"
+            )
+            assert body["role"] == "active"
+            assert body["epoch"] == 1
+            assert body["replication"]["standbys"] == 0
+
+            async def mutate():
+                await active.job_store.init_tile_job("job-ha", [0, 1, 2])
+                await active.job_store.pull_task("job-ha", "w1", timeout=0.05)
+
+            _run(loop_thread, mutate())
+
+            # --- the standby: follows the replication stream ---------
+            port2 = _free_port()
+            standby_srv = DistributedServer(
+                port=port2, is_worker=False,
+                standby_of=f"http://127.0.0.1:{port1}",
+            )
+            _run(loop_thread, standby_srv.start())
+            assert standby_srv.standby is not None
+            assert _wait_until(
+                lambda: standby_srv.standby.replica.synced
+                and standby_srv.standby.replica.lag_records() == 0
+            ), standby_srv.standby.status()
+
+            # the active counts its standby; the standby reports role,
+            # source epoch, and zero lag on the same route
+            status, body = _get_json(
+                f"http://127.0.0.1:{port1}/distributed/durability"
+            )
+            assert body["replication"]["standbys"] == 1
+            status, body = _get_json(
+                f"http://127.0.0.1:{port2}/distributed/durability"
+            )
+            assert body["role"] == "standby"
+            assert body["epoch"] == 1
+            assert body["replication"]["lag_records"] == 0
+            assert body["replication"]["synced"] is True
+            assert body["standby"]["connected"] is True
+
+            # replication lag instruments ride the standby's scrape
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port2}/distributed/metrics", timeout=10
+            ) as resp:
+                metrics = resp.read().decode()
+            assert "cdt_replication_lag_records" in metrics
+            assert "cdt_replication_lag_seconds" in metrics
+
+            # work RPCs answer 503 until promotion: an unpromoted
+            # standby's store is a replica, not the authority
+            status, body = _post_json(
+                f"http://127.0.0.1:{port2}/distributed/request_image",
+                {"job_id": "job-ha", "worker_id": "w1"},
+            )
+            assert status == 503
+            assert body["error"] == "standby"
+
+            # a standby refuses to serve the replication stream itself
+            # (standby-of-standby chains fail loudly)
+            status, body = _post_json(
+                f"http://127.0.0.1:{port2}/distributed/job_status",
+                {"job_id": "job-ha"},
+            )
+            assert status == 503
+
+            # --- the active dies; the standby takes over -------------
+            # stop() releases the lease (expires NOW), so promotion
+            # needs no TTL wait — the clean-shutdown fast path
+            _run(loop_thread, active.stop())
+            assert _wait_until(
+                lambda: standby_srv.standby.promoted, timeout=30
+            ), standby_srv.standby.status()
+
+            status, body = _get_json(
+                f"http://127.0.0.1:{port2}/distributed/durability"
+            )
+            assert body["role"] == "active"
+            assert body["epoch"] == 2
+            assert body["failovers"] == 1
+            assert body["recovery"]["jobs_recovered"] == 1
+            assert body["recovery"]["tasks_requeued"] == 1  # w1's claim
+
+            # the adopted job serves: the 503 gate lifted, the fencing
+            # epoch rides the response
+            status, body = _post_json(
+                f"http://127.0.0.1:{port2}/distributed/job_status",
+                {"job_id": "job-ha"},
+            )
+            assert status == 200
+            assert body["ready"] is True
+            assert body["epoch"] == 2
+
+            # a zombie-era RPC (epoch 1) is rejected with the current
+            # epoch in the body...
+            status, body = _post_json(
+                f"http://127.0.0.1:{port2}/distributed/request_image",
+                {"job_id": "job-ha", "worker_id": "w1", "epoch": 1},
+            )
+            assert status == 409
+            assert body["error"] == "stale_epoch"
+            assert body["current_epoch"] == 2
+
+            # ...and the production client heals in one refresh+retry:
+            # it arrives carrying the dead master's epoch, eats the
+            # 409, refreshes, and its retried pull lands a tile
+            from comfyui_distributed_tpu.graph.usdu_elastic import (
+                HTTPWorkClient,
+            )
+
+            client = HTTPWorkClient(
+                f"http://127.0.0.1:{port2}", "job-ha", "w1"
+            )
+            client.epoch = 1
+            work = client.request_tile()
+            assert work is not None and work.get("tile_idx") is not None
+            assert client.epoch == 2
+        finally:
+            if standby_srv is not None:
+                _run(loop_thread, standby_srv.stop())
+
+
+def test_replicate_route_rejects_when_journaling_disabled(
+    tmp_config_path, loop_thread, monkeypatch
+):
+    monkeypatch.delenv("CDT_JOURNAL_DIR", raising=False)
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    _run(loop_thread, srv.start())
+    try:
+        status, body = _get_json_allow_error(
+            f"http://127.0.0.1:{port}/distributed/replicate"
+        )
+        assert status == 409
+        assert "journaling" in body["error"]
+    finally:
+        _run(loop_thread, srv.stop())
+
+
+def _get_json_allow_error(url: str, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+def test_grant_signal_wakes_on_push_and_ends_on_job_complete(
+    tmp_config_path, tmp_path, loop_thread
+):
+    """GrantSignal end to end: a worker-side signal holding the real
+    /distributed/events WebSocket wakes when the store's pending queue
+    refills (push publisher = placement.notify_grants) and terminates
+    on job_complete — the push-mode park-instead-of-poll loop."""
+    from comfyui_distributed_tpu.graph.usdu_elastic import GrantSignal
+
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    _run(loop_thread, srv.start())
+    try:
+        store = srv.job_store
+        store.grant_notifier = srv.scheduler.placement.notify_grants
+
+        async def setup():
+            await store.init_tile_job("job-push", [0, 1])
+            # claim both so the queue reads dry
+            await store.pull_task("job-push", "holder", timeout=0.05)
+            await store.pull_task("job-push", "holder", timeout=0.05)
+
+        _run(loop_thread, setup())
+        signal = GrantSignal(
+            lambda: f"http://127.0.0.1:{port}", "job-push"
+        )
+        signal.start()
+        assert _wait_until(lambda: signal.connected, timeout=10)
+        # queue is dry: no spurious wake
+        assert signal.wait_for_grant(0.2) is False
+        # a release refills pending -> grant_available pushes through
+        _run(
+            loop_thread,
+            store.release_tasks("job-push", "holder", [0, 1]),
+        )
+        assert signal.wait_for_grant(5.0) is True
+        assert signal.job_complete is False
+        # cleanup -> job_complete ends the signal
+        _run(loop_thread, store.cleanup_tile_job("job-push"))
+        assert _wait_until(lambda: signal.job_complete, timeout=10)
+        signal.stop()
+    finally:
+        _run(loop_thread, srv.stop())

@@ -459,3 +459,121 @@ def test_store_level_connection_errors_kill_worker_but_not_job(baseline):
         fault_plan=f"seed=11;{SLOW_MASTER};connect_error@chaos:w2:pull#2",
     )
     np.testing.assert_array_equal(baseline, result.output)
+
+
+# --------------------------------------------------------------------------
+# warm-standby failover (HA layer acceptance)
+# --------------------------------------------------------------------------
+
+
+def _assert_failover_invariants(baseline, result):
+    """The acceptance bundle every failover scenario must satisfy:
+    crash fired, promotion bumped the epoch, fencing held (zombie
+    journal append raised and journaled NOTHING; stale-epoch pull AND
+    submit rejected), and the canvas is bit-identical."""
+    assert "crash" in result.fired_kinds()
+    assert result.epochs[1] == result.epochs[0] + 1
+    assert result.zombie_fenced, "ex-active journal append was not fenced"
+    assert result.stale_pull_rejected
+    assert result.stale_submit_rejected
+    assert result.zombie_journaled_records == 0
+    assert result.report["jobs_recovered"] == 1
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_failover_after_master_pull_promotes_bit_identical(
+    baseline, tmp_path
+):
+    """Kill point 1: the active master dies right at a pull RPC. The
+    live standby replica (journal stream teed under the manager lock)
+    takes the expired lease, requeues the in-flight grants —
+    including the orphan tile the dying master served in its last
+    instant — and the promoted master + re-pointed workers drain the
+    job to completion with no process restart anywhere."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_failover
+
+    result = run_chaos_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#2",
+        journal_dir=str(tmp_path / "wal"),
+    )
+    _assert_failover_invariants(baseline, result)
+    if result.orphan_tile is not None:
+        # the deterministic orphan claim proves the requeue path ran
+        assert result.report["tasks_requeued"] >= 1
+
+
+def test_failover_after_partial_submit_promotes_bit_identical(
+    baseline, tmp_path
+):
+    """Kill point 2: the active dies after journaling SOME of its own
+    completions. Volatile (master-local) completions demote for
+    recompute, durable worker payloads restore — exactly the disk
+    recovery transform, minus the disk."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_failover
+
+    # workers' first pulls held back so the master deterministically
+    # performs the partial submit the scenario is named for
+    result = run_chaos_failover(
+        seed=11,
+        crash_plan=(
+            "latency(1.0)@store:pull:w1#1;latency(1.0)@store:pull:w2#1;"
+            "crash@store:submit:master#1"
+        ),
+        journal_dir=str(tmp_path / "wal"),
+    )
+    _assert_failover_invariants(baseline, result)
+    assert result.report["tasks_requeued"] >= 1  # the demoted volatiles
+
+
+def test_failover_during_snapshot_cadence_promotes_bit_identical(
+    baseline, tmp_path
+):
+    """Kill point 3: snapshot_every=1 makes a snapshot precede every
+    append, so the crash lands inside the snapshot cadence — the
+    standby's stream (which never reads snapshots) must be unaffected
+    and promotion must still reopen the journal at the replicated
+    head."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_failover
+
+    result = run_chaos_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#3",
+        journal_dir=str(tmp_path / "wal"),
+        snapshot_every=1,
+    )
+    _assert_failover_invariants(baseline, result)
+
+
+def test_failover_with_push_grants_stays_bit_identical(baseline, tmp_path):
+    """The pushed-grant path (placement.notify_grants wired as the
+    store's grant notifier on BOTH masters) must survive the same
+    failover the pull fallback does — push carries availability, never
+    assignment, so it can change timing but never the canvas."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_failover
+
+    result = run_chaos_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#2",
+        journal_dir=str(tmp_path / "wal"),
+        push_grants=True,
+    )
+    _assert_failover_invariants(baseline, result)
+
+
+def test_failover_standby_replica_reports_sync_and_lag(tmp_path):
+    """The promoted run's replica status must show a completed sync:
+    zero record lag at promotion (every teed frame applied) and a
+    positive applied count — the same numbers the standby serves on
+    GET /distributed/durability while following."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_failover
+
+    result = run_chaos_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#2",
+        journal_dir=str(tmp_path / "wal"),
+    )
+    assert result.replica["synced"] is True
+    assert result.replica["lag_records"] == 0
+    assert result.replica["applied_lsn"] >= result.replica["applied_records"] > 0
+    assert result.replica["source_epoch"] == result.epochs[0]

@@ -1,0 +1,643 @@
+"""High-availability layer units: the epoch lease (arbitration +
+fencing), the replication stream (subscription tee + standby replica),
+promotion via DurabilityManager.adopt, and JobStore epoch fencing.
+
+The chaos-level failover scenarios (kill the active master, standby
+promotes, canvas bit-identical) live in tests/test_chaos_usdu.py; this
+file proves each protocol piece in isolation, with injectable clocks
+so no test waits out a real TTL.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.durability import (
+    DurabilityManager,
+    FencedOut,
+    Lease,
+    LeaseHeld,
+    LeaseLost,
+    ReplicationSubscription,
+    StandbyReplica,
+    read_lease,
+)
+from comfyui_distributed_tpu.durability import state as state_mod
+from comfyui_distributed_tpu.durability.lease import lease_path
+from comfyui_distributed_tpu.utils.exceptions import StaleEpoch
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+# --------------------------------------------------------------------------
+# lease: arbitration
+# --------------------------------------------------------------------------
+
+
+def test_acquire_free_lease_starts_at_epoch_one(tmp_path):
+    clock = Clock()
+    lease = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    assert lease.acquire() == 1
+    assert lease.epoch == 1
+    state = read_lease(str(tmp_path))
+    assert (state.owner, state.epoch) == ("a", 1)
+    assert state.expires_at == clock.now + 10.0
+
+
+def test_acquire_respects_live_lease_and_takes_expired_one(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    a.acquire()
+    with pytest.raises(LeaseHeld):
+        b.acquire()
+    clock.now += 11.0  # the active missed renewals for a full TTL
+    assert b.acquire() == 2  # epoch bump: the fencing token
+
+
+def test_forced_acquire_wins_over_a_live_lease(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    a.acquire()
+    # restarting-master policy: the newest claimant on the journal dir
+    # always wins; the deposed holder is fenced by the epoch bump
+    assert b.acquire(force=True) == 2
+    assert a.held(verify=True) is False
+
+
+def test_renew_extends_and_lost_lease_raises(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    a.acquire()
+    clock.now += 5.0
+    a.renew()
+    assert read_lease(str(tmp_path)).expires_at == clock.now + 10.0
+    clock.now += 11.0
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    b.acquire()
+    with pytest.raises(LeaseLost):
+        a.renew()
+    # a lost handle must not resurrect by renewing again
+    with pytest.raises(LeaseLost):
+        a.renew()
+
+
+def test_release_expires_now_so_takeover_skips_the_ttl(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    a.acquire()
+    a.release()
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    assert b.acquire() == 2  # no TTL wait: the lease file reads expired
+
+
+def test_release_never_clobbers_a_successor(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    a.acquire()
+    clock.now += 11.0
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    b.acquire()
+    a.release()  # must be a no-op: b owns the file now
+    state = read_lease(str(tmp_path))
+    assert (state.owner, state.epoch) == ("b", 2)
+    assert state.expires_at > clock.now
+
+
+def test_corrupt_lease_file_reads_as_free(tmp_path):
+    with open(lease_path(str(tmp_path)), "w", encoding="utf-8") as fh:
+        fh.write("{not json")
+    assert read_lease(str(tmp_path)) is None
+    lease = Lease(str(tmp_path), "a", ttl=10.0, clock=Clock())
+    assert lease.acquire() == 1
+
+
+def test_racing_acquires_on_expired_lease_yield_exactly_one_winner(tmp_path):
+    """Two standbys racing an expired lease must not both take epoch
+    N+1 (the same-epoch split brain): the claim mutex serializes the
+    read-modify-write cycle, so the loser re-reads the winner's fresh
+    lease and raises LeaseHeld. The patched read() widens the
+    read->write window far past thread-start skew — without the mutex
+    both claimants read the expired lease and both 'win'."""
+    clock = Clock()
+    dead = Lease(str(tmp_path), "dead", ttl=10.0, clock=clock)
+    dead.acquire()
+    clock.now += 11.0  # expired: both contenders are entitled to try
+
+    class SlowReadLease(Lease):
+        def read(self, strict=False):
+            state = super().read(strict=strict)
+            time.sleep(0.2)
+            return state
+
+    results: dict[str, object] = {}
+
+    def contend(name):
+        lease = SlowReadLease(str(tmp_path), name, ttl=10.0, clock=clock)
+        try:
+            results[name] = lease.acquire()
+        except LeaseHeld:
+            results[name] = "held"
+
+    threads = [
+        threading.Thread(target=contend, args=(n,)) for n in ("s1", "s2")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(results.values(), key=str) == [2, "held"]
+    winner = next(n for n, r in results.items() if r == 2)
+    assert read_lease(str(tmp_path)).owner == winner
+
+
+def test_leftover_claim_lock_file_never_blocks(tmp_path):
+    """The claim mutex is flock-based: a dead claimant's lock released
+    with its fd, so a leftover lease.lock FILE (no live holder) must
+    not block the next takeover — no stale-lock breaking exists to
+    race on."""
+    lock = os.path.join(str(tmp_path), "lease.lock")
+    with open(lock, "w", encoding="utf-8") as fh:
+        fh.write("corpse of a crashed claimant")
+    os.utime(lock, (1.0, 1.0))  # ancient mtime must be irrelevant
+    lease = Lease(str(tmp_path), "a", ttl=10.0, clock=Clock())
+    assert lease.acquire() == 1
+
+
+def test_transient_read_error_does_not_depose_the_holder(tmp_path):
+    """One NFS blip (EIO/ESTALE) while re-reading the lease file must
+    read as 'indeterminate', never as 'superseded': renew propagates
+    the OSError (the renewal loop retries), held() keeps its cached
+    verdict, and the next successful read carries on holding."""
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    a.acquire()
+
+    class FlakyLease(Lease):
+        flake = False
+
+        def read(self, strict=False):
+            if self.flake and strict:
+                raise OSError(5, "injected EIO")
+            return super().read(strict=strict)
+
+    flaky = FlakyLease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    flaky._epoch = a._epoch  # same holder identity
+    flaky._last_verified = clock.now
+    flaky.flake = True
+    with pytest.raises(OSError):
+        flaky.renew()
+    assert flaky._lost is False  # NOT deposed
+    # held() past the trust window keeps the cached verdict on a blip
+    clock.now += 5.0  # > ttl/4 since last verification
+    assert flaky.held() is True
+    flaky.flake = False
+    flaky.renew()  # the next good cycle proceeds normally
+    assert read_lease(str(tmp_path)).expires_at == clock.now + 10.0
+    assert flaky.held(verify=True) is True
+
+
+def test_racing_renew_and_acquire_cannot_clobber_the_new_epoch(tmp_path):
+    """The holder's renew() is also a read-modify-write: serialized
+    against a claimant's acquire(), it must observe the taken epoch
+    and raise LeaseLost instead of writing its stale epoch back."""
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=10.0, clock=clock)
+    a.acquire()
+    clock.now += 11.0
+    b = Lease(str(tmp_path), "b", ttl=10.0, clock=clock)
+    assert b.acquire() == 2
+    with pytest.raises(LeaseLost):
+        a.renew()
+    state = read_lease(str(tmp_path))
+    assert (state.owner, state.epoch) == ("b", 2)
+
+
+# --------------------------------------------------------------------------
+# lease: the fencing check
+# --------------------------------------------------------------------------
+
+
+def test_held_trusts_clock_within_quarter_ttl_then_rereads(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=8.0, clock=clock)
+    a.acquire()
+    # b takes over immediately (forced): the file no longer carries a
+    b2 = Lease(str(tmp_path), "b", ttl=8.0, clock=clock)
+    b2.acquire(force=True)
+    # within ttl/4 of a's last verification the stale cache answers...
+    clock.now += 1.0
+    assert a.held() is True  # the bounded zombie window
+    # ...beyond it the re-read notices the takeover
+    clock.now += 1.5  # 2.5 > 8/4
+    assert a.held() is False
+    assert a.epoch == 0
+
+
+def test_held_verify_bypasses_the_trust_window(tmp_path):
+    clock = Clock()
+    a = Lease(str(tmp_path), "a", ttl=8.0, clock=clock)
+    a.acquire()
+    Lease(str(tmp_path), "b", ttl=8.0, clock=clock).acquire(force=True)
+    assert a.held(verify=True) is False
+
+
+def test_fenced_manager_refuses_to_journal(tmp_path):
+    clock = Clock()
+    journal_dir = str(tmp_path / "wal")
+    os.makedirs(journal_dir)
+    manager = DurabilityManager(journal_dir, fsync_every=1)
+    lease = Lease(journal_dir, "active", ttl=8.0, clock=clock)
+    lease.acquire()
+    manager.lease = lease
+    manager.record({"type": "job_init", "job": "j", "tasks": [0]})
+    head = manager.head_lsn()
+    # a standby takes the lease; the zombie's next append must raise
+    # BEFORE any bytes land
+    Lease(journal_dir, "standby", ttl=8.0, clock=clock).acquire(force=True)
+    clock.now += 3.0  # past the ttl/4 trust window
+    with pytest.raises(FencedOut):
+        manager.record({"type": "cleanup", "job": "j"})
+    assert manager.head_lsn() == head  # journaled NOTHING
+    manager.close()
+
+
+# --------------------------------------------------------------------------
+# replication: subscription + replica
+# --------------------------------------------------------------------------
+
+
+def test_subscription_preserves_order_and_overflow_marks_lost():
+    sub = ReplicationSubscription({}, head_lsn=0, maxlen=3)
+    for lsn in (1, 2, 3):
+        sub.offer({"lsn": lsn})
+    assert [r["lsn"] for r in sub.pop()] == [1, 2, 3]
+    for lsn in (4, 5, 6, 7):  # one past maxlen
+        sub.offer({"lsn": lsn})
+    assert sub.lost is True
+    assert sub.pop() == []  # never a hole: the buffer clears entirely
+
+
+def test_subscription_wait_wakes_on_offer():
+    sub = ReplicationSubscription({}, head_lsn=0)
+    woke = []
+
+    def consumer():
+        woke.append(sub.wait(5.0))
+
+    thread = threading.Thread(target=consumer)
+    thread.start()
+    sub.offer({"lsn": 1})
+    thread.join(timeout=10)
+    assert woke == [True]
+
+
+def test_replica_applies_dedups_and_tracks_lag():
+    clock = Clock()
+    replica = StandbyReplica(clock=clock)
+    assert replica.synced is False
+    snapshot = state_mod.new_state()
+    snapshot["last_lsn"] = 5
+    replica.reset(snapshot, head_lsn=5, epoch=3)
+    assert replica.synced is True
+    assert replica.source_epoch == 3
+    # frames at or below the snapshot lsn are already covered
+    assert replica.apply({"type": "job_init", "job": "j", "tasks": [0], "lsn": 5}) is False
+    assert replica.apply({"type": "job_init", "job": "j", "tasks": [0, 1], "lsn": 6}) is True
+    assert replica.last_lsn() == 6
+    replica.note_head(9)
+    assert replica.lag_records() == 3
+    clock.now += 2.0
+    assert replica.lag_seconds() == pytest.approx(2.0)
+    status = replica.status()
+    assert status["applied_records"] == 1
+    assert status["jobs_tracked"] == 1
+
+
+def test_replica_reset_counts_resyncs_and_clones_state():
+    replica = StandbyReplica(clock=Clock())
+    snapshot = state_mod.new_state()
+    replica.reset(snapshot, head_lsn=0)
+    snapshot["jobs"]["mutated-after"] = {}  # caller's buffer, not ours
+    assert replica.status()["jobs_tracked"] == 0
+    replica.reset(state_mod.new_state(), head_lsn=0)
+    assert replica.resyncs == 1
+
+
+def test_subscribe_replica_is_attach_consistent(tmp_path):
+    """No record between the snapshot serialization and the first teed
+    frame: applying the tee on top of the hello snapshot always equals
+    the manager's shadow, whenever the attach happened."""
+    journal_dir = str(tmp_path / "wal")
+    manager = DurabilityManager(journal_dir, fsync_every=1)
+    manager.record({"type": "job_init", "job": "j", "tasks": [0, 1, 2]})
+    manager.record({"type": "pull", "job": "j", "worker": "w1", "tasks": [0]})
+    sub = manager.subscribe_replica()
+    replica = StandbyReplica(clock=Clock())
+    replica.reset(sub.snapshot_state, sub.head_lsn, sub.epoch)
+    manager.record({"type": "submit", "job": "j", "worker": "w1", "task": 0,
+                    "payload": None})
+    manager.record({"type": "pull", "job": "j", "worker": "w2", "tasks": [1]})
+    for record in sub.pop():
+        replica.apply(record)
+    assert replica.lag_records() == 0
+    # the replica's state IS the manager's shadow, byte for byte
+    assert json.dumps(replica.status()["applied_lsn"]) == json.dumps(
+        manager.head_lsn()
+    )
+    status = manager.status()
+    assert status["replication"]["standbys"] == 1
+    manager.unsubscribe_replica(sub)
+    assert manager.status()["replication"]["standbys"] == 0
+    manager.close()
+
+
+def test_adopt_promotes_replica_into_live_store(tmp_path):
+    """DurabilityManager.adopt = disk recovery with the replica
+    standing in for snapshot + WAL tail: in-flight tiles requeue,
+    durable worker payloads restore, the journal reopens at the
+    replicated head, and the promotion counts a failover."""
+    from comfyui_distributed_tpu.jobs import JobStore
+
+    journal_dir = str(tmp_path / "wal")
+    active = DurabilityManager(journal_dir, fsync_every=1)
+    active.record({"type": "job_init", "job": "j", "tasks": [0, 1, 2]})
+    sub = active.subscribe_replica()
+    replica = StandbyReplica(clock=Clock())
+    replica.reset(sub.snapshot_state, sub.head_lsn, sub.epoch)
+    active.record({"type": "pull", "job": "j", "worker": "w1", "tasks": [0, 1]})
+    active.record({"type": "submit", "job": "j", "worker": "w1", "task": 0,
+                   "payload": [{"batch_idx": 0, "image": "data:..."}]})
+    for record in sub.pop():
+        replica.apply(record)
+    active.close()
+
+    store = JobStore()
+    standby = DurabilityManager(journal_dir, fsync_every=1)
+    lease = Lease(journal_dir, "standby", ttl=8.0, clock=Clock())
+    epoch = lease.acquire()
+    report = standby.adopt(store, replica, lease=lease)
+    assert report.jobs_recovered == 1
+    assert report.tasks_requeued == 1   # tile 1: in flight, revoked
+    assert report.tasks_restored == 1   # tile 0: durable payload kept
+    job = store.tile_jobs["j"]
+    assert job.pending.qsize() == 2     # tiles 1 + 2
+    assert job.assigned == {}
+    assert standby.epoch == epoch
+    assert standby.failovers == 1
+    assert standby.head_lsn() == replica.last_lsn()
+    # the promoted journal accepts appends at the replicated head
+    standby.record({"type": "cleanup", "job": "j"})
+    assert standby.head_lsn() == replica.last_lsn() + 1
+    standby.close()
+
+
+# --------------------------------------------------------------------------
+# store-level epoch fencing
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fenced_store(tmp_path):
+    """A journaled store at epoch 5 with one job; yields (store,
+    manager) inside a running server loop."""
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.utils.async_helpers import (
+        ServerLoopThread,
+        run_async_in_server_loop,
+    )
+
+    thread = ServerLoopThread()
+    thread.start()
+    manager = DurabilityManager(str(tmp_path / "wal"), fsync_every=1)
+    store = JobStore()
+    store.journal_sink = manager.record
+    store.set_epoch(5)
+    run_async_in_server_loop(
+        store.init_tile_job("job-f", [0, 1, 2]), timeout=10
+    )
+    try:
+        yield store, manager
+    finally:
+        manager.close()
+        thread.stop()
+
+
+def _run_store(coro):
+    from comfyui_distributed_tpu.utils.async_helpers import (
+        run_async_in_server_loop,
+    )
+
+    return run_async_in_server_loop(coro, timeout=10)
+
+
+def test_stale_epoch_pull_rejected_and_journals_nothing(fenced_store):
+    store, manager = fenced_store
+    head = manager.head_lsn()
+    with pytest.raises(StaleEpoch) as excinfo:
+        _run_store(store.pull_task("job-f", "zombie", timeout=0.01, epoch=4))
+    assert excinfo.value.current == 5
+    assert manager.head_lsn() == head
+    job = store.tile_jobs["job-f"]
+    assert job.pending.qsize() == 3  # nothing assigned
+    assert job.assigned == {}
+
+
+def test_stale_epoch_submit_rejected_and_journals_nothing(fenced_store):
+    store, manager = fenced_store
+    head = manager.head_lsn()
+    with pytest.raises(StaleEpoch):
+        _run_store(store.submit_result("job-f", "zombie", 0, None, epoch=4))
+    with pytest.raises(StaleEpoch):
+        _run_store(store.submit_flush("job-f", "zombie", {0: None}, epoch=4))
+    assert manager.head_lsn() == head
+    assert store.tile_jobs["job-f"].completed == {}
+
+
+def test_stale_epoch_heartbeat_and_release_rejected(fenced_store):
+    store, _manager = fenced_store
+    with pytest.raises(StaleEpoch):
+        _run_store(store.heartbeat("job-f", "zombie", epoch=1))
+    with pytest.raises(StaleEpoch):
+        _run_store(store.release_tasks("job-f", "zombie", [0], epoch=1))
+    with pytest.raises(StaleEpoch):
+        _run_store(store.mark_worker_done("job-f", "zombie", epoch=1))
+
+
+def test_current_and_missing_epochs_pass_fencing(fenced_store):
+    store, _manager = fenced_store
+    # the current epoch passes
+    assert _run_store(
+        store.pull_task("job-f", "w1", timeout=0.05, epoch=5)
+    ) is not None
+    # None = a client that never learned an epoch (legacy): passes
+    assert _run_store(
+        store.pull_task("job-f", "w2", timeout=0.05, epoch=None)
+    ) is not None
+    # a NEWER epoch than ours passes too (we are the stale one; the
+    # client knows more than this store — reject would deadlock a
+    # half-propagated takeover)
+    assert _run_store(
+        store.heartbeat("job-f", "w1", epoch=6)
+    ) is True
+
+
+def test_set_epoch_is_monotonic():
+    from comfyui_distributed_tpu.jobs import JobStore
+
+    store = JobStore()
+    store.set_epoch(5)
+    store.set_epoch(3)  # ignored
+    assert store.epoch == 5
+    store.set_epoch(7)
+    assert store.epoch == 7
+
+
+# --------------------------------------------------------------------------
+# standby promotion guards: misconfigured journal dir
+# --------------------------------------------------------------------------
+
+
+class _DummyServer:
+    host = "127.0.0.1"
+    port = 9999
+
+
+def _make_controller(journal_dir):
+    from comfyui_distributed_tpu.api.standby import StandbyController
+
+    return StandbyController(
+        _DummyServer(), "http://active:1", str(journal_dir), ttl=10.0
+    )
+
+
+def test_standby_refuses_expiry_when_lease_file_missing_but_source_live(
+    tmp_path,
+):
+    """CDT_JOURNAL_DIR pointed at the wrong (empty) dir while the
+    replication stream has seen a journaled active: a missing lease
+    file is a misconfiguration, not an expiry — promoting would start
+    a second active beside the live one."""
+    controller = _make_controller(tmp_path)
+    controller.replica.reset(state_mod.new_state(), head_lsn=0, epoch=3)
+    assert asyncio.run(controller._lease_expired()) is False
+    assert "refusing to promote" in controller.last_error
+    # the pre-any-active case is unchanged: no lease file, no source
+    # epoch ever seen -> a synced replica may promote over the empty
+    # universe
+    fresh = _make_controller(tmp_path)
+    fresh.replica.reset(state_mod.new_state(), head_lsn=0, epoch=0)
+    assert asyncio.run(fresh._lease_expired()) is True
+
+
+def test_standby_promotion_backs_out_when_epoch_lineage_mismatches(tmp_path):
+    """Even past the expiry gate, an acquired epoch at or below the
+    replicated source epoch proves the lease dir is not the active's:
+    promotion is refused and the mis-acquired lease released."""
+    controller = _make_controller(tmp_path)
+    controller.replica.reset(state_mod.new_state(), head_lsn=0, epoch=5)
+    assert asyncio.run(controller._promote()) is False
+    assert "promotion refused" in controller.last_error
+    assert controller.promoted is False
+    # the mis-acquired lease was released (expired NOW), not held
+    state = read_lease(str(tmp_path))
+    assert state is None or state.expires_at <= state.renewed_at
+
+
+def test_standby_promotes_normally_above_source_epoch(tmp_path):
+    """The takeover lineage check must not block a legitimate
+    promotion: an expired active lease at epoch N acquires at N+1,
+    strictly above the replicated source epoch. (The controller's
+    expiry check reads wall time, so the active's lease is written in
+    wall time here.)"""
+    clock = Clock(time.time())
+    active = Lease(str(tmp_path), "active", ttl=10.0, clock=clock)
+    active.acquire()  # epoch 1, expires ~10s in the real future
+    controller = _make_controller(tmp_path)
+    controller.replica.reset(state_mod.new_state(), head_lsn=0, epoch=1)
+    assert asyncio.run(controller._lease_expired()) is False  # still live
+    # the active dies and misses renewals for a full TTL (file time)
+    from comfyui_distributed_tpu.durability.lease import LeaseState
+
+    active._write(
+        LeaseState(1, "active", time.time() - 1.0, time.time() - 11.0)
+    )
+    assert asyncio.run(controller._lease_expired()) is True
+    assert controller.lease.acquire() == 2  # lineage: source 1 -> ours 2
+
+
+def test_unsynced_standby_never_promotes_even_over_an_expired_lease(
+    tmp_path,
+):
+    """A standby that has not completed its first replication sync
+    holds new_state() — promoting it would serve zero jobs and open a
+    fresh lsn-1 lineage over the directory's real WAL. Even a present,
+    fully expired lease file must not tempt it; the recovery path for
+    an active that died before the first hello is a restarting master
+    (disk recovery), not an empty-replica takeover."""
+    clock = Clock()
+    active = Lease(str(tmp_path), "active", ttl=10.0, clock=clock)
+    active.acquire()
+    active.release()  # expired NOW: a synced standby could take over
+    controller = _make_controller(tmp_path)
+    assert controller.replica.synced is False
+    assert asyncio.run(controller._lease_expired()) is False
+    # and with no lease file at all, unsynced still never promotes
+    fresh_dir = tmp_path / "empty"
+    fresh_dir.mkdir()
+    fresh = _make_controller(fresh_dir)
+    assert asyncio.run(fresh._lease_expired()) is False
+
+
+def test_stale_epoch_rpc_does_not_touch_placement_capacity():
+    """Fencing must run before ANY server-side state, including the
+    advisory worker-capacity note: a zombie's worker advertising
+    `devices` on a stale-epoch heartbeat gets 409 and must not skew
+    grant sizing on the promoted store."""
+    from comfyui_distributed_tpu.api.usdu_routes import UsduRoutes
+    from comfyui_distributed_tpu.jobs import JobStore
+
+    class Srv:
+        pass
+
+    srv = Srv()
+    srv.job_store = JobStore()
+    srv.job_store.set_epoch(5)
+    routes = UsduRoutes(srv)
+
+    class Req:
+        async def json(self):
+            return {
+                "job_id": "j",
+                "worker_id": "zombie-w",
+                "epoch": 2,
+                "devices": 32,
+            }
+
+    resp = asyncio.run(routes.heartbeat(Req()))
+    assert resp.status == 409
+    assert "zombie-w" not in srv.job_store.worker_capacity
+    # a current-epoch heartbeat still lands its capacity note
+    class GoodReq:
+        async def json(self):
+            return {
+                "job_id": "j",
+                "worker_id": "good-w",
+                "epoch": 5,
+                "devices": 4,
+            }
+
+    resp = asyncio.run(routes.heartbeat(GoodReq()))
+    assert resp.status == 200
+    assert srv.job_store.worker_capacity.get("good-w") == 4

@@ -1,0 +1,119 @@
+"""Worker-client HA units: master address rotation, epoch learning,
+and the heartbeat failure backoff (the satellite fixing the
+full-rate debug_log/retry flood during a master outage)."""
+
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.graph.usdu_elastic import (
+    HEARTBEAT_BACKOFF_BASE_SECONDS,
+    HTTPWorkClient,
+    parse_master_urls,
+)
+from comfyui_distributed_tpu.telemetry.metrics import (
+    get_metrics_registry,
+    reset_metrics_registry,
+)
+from comfyui_distributed_tpu.utils import constants
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+@pytest.fixture()
+def loop_thread():
+    thread = ServerLoopThread()
+    thread.start()
+    yield thread
+    thread.stop()
+
+
+def test_parse_master_urls_splits_and_strips():
+    assert parse_master_urls("http://a:1, http://b:2/,") == [
+        "http://a:1", "http://b:2",
+    ]
+    assert parse_master_urls(["http://a:1/"]) == ["http://a:1"]
+
+
+def test_consecutive_errors_rotate_to_next_master(monkeypatch):
+    reset_metrics_registry()
+    monkeypatch.setattr(constants, "FAILOVER_AFTER_ERRORS", 2)
+    client = HTTPWorkClient("http://a:1,http://b:2", "j", "w1")
+    assert client.master_url == "http://a:1"
+    client._count_error("pull")
+    assert client.master_url == "http://a:1"  # one failure is a blip
+    client._count_error("pull")
+    assert client.master_url == "http://b:2"  # threshold: re-point
+    assert client.failovers == 1
+    # errors were counted per op, and the re-point as a worker failover
+    rendered = get_metrics_registry().render()
+    assert 'cdt_worker_master_errors_total{op="pull"} 2' in rendered
+    assert 'cdt_failover_total{role="worker"} 1' in rendered
+    # rotation wraps: two more failures point back at the first master
+    client._count_error("submit")
+    client._count_error("submit")
+    assert client.master_url == "http://a:1"
+
+
+def test_single_master_never_rotates(monkeypatch):
+    reset_metrics_registry()
+    monkeypatch.setattr(constants, "FAILOVER_AFTER_ERRORS", 1)
+    client = HTTPWorkClient("http://a:1", "j", "w1")
+    for _ in range(5):
+        client._count_error("heartbeat")
+    assert client.master_url == "http://a:1"
+    assert client.failovers == 0
+
+
+def test_learn_epoch_is_monotonic_and_ignores_garbage():
+    client = HTTPWorkClient("http://a:1", "j", "w1")
+    assert client.epoch is None
+    client._learn_epoch(2)
+    assert client.epoch == 2
+    client._learn_epoch(1)     # older: ignored
+    client._learn_epoch(None)  # absent: ignored
+    client._learn_epoch("x")   # garbage: ignored
+    assert client.epoch == 2
+    client._learn_epoch("3")   # takeover: adopted
+    assert client.epoch == 3
+
+
+def test_heartbeat_backoff_suppresses_the_failure_flood(loop_thread):
+    """Consecutive heartbeat failures must back off exponentially: the
+    2nd..kth beats inside the suppression window never leave the
+    process, so a dead master sees (and the log records) one attempt
+    per window instead of one per tile."""
+    client = HTTPWorkClient("http://a:1", "j", "w1")
+    calls = []
+
+    async def failing_post(path, payload, op="transport"):
+        calls.append(op)
+        raise OSError("connection refused")
+
+    client._post = failing_post
+    client.heartbeat()
+    assert calls == ["heartbeat"]
+    assert client._hb_failures == 1
+    window = client._hb_suppressed_until - time.monotonic()
+    assert 0 < window <= HEARTBEAT_BACKOFF_BASE_SECONDS
+    # inside the window: suppressed, no RPC attempted
+    client.heartbeat()
+    client.heartbeat()
+    assert calls == ["heartbeat"]
+    # window elapsed: exactly one more attempt, and the backoff doubles
+    client._hb_suppressed_until = 0.0
+    client.heartbeat()
+    assert calls == ["heartbeat", "heartbeat"]
+    assert client._hb_failures == 2
+    second_window = client._hb_suppressed_until - time.monotonic()
+    assert second_window > window
+
+    # a success resets the schedule completely
+    async def ok_post(path, payload, op="transport"):
+        calls.append("ok")
+        return {"status": "ok"}
+
+    client._post = ok_post
+    client._hb_suppressed_until = 0.0
+    client.heartbeat()
+    assert client._hb_failures == 0
+    assert client._hb_suppressed_until == 0.0

@@ -52,6 +52,14 @@ DETERMINISM_PATHS = (
     # folding): ordering or ambient entropy here would break the
     # N-device vs 1-device bit-identical canvas guarantee
     "comfyui_distributed_tpu/parallel/*.py",
+    # the promotion path: a standby's takeover transform must be a pure
+    # function of the replicated frame sequence — ambient entropy or
+    # ordering here would break the failover bit-identity guarantee
+    # (replication itself, durability/replicate.py, rides the
+    # durability/*.py glob above). Lease-expiry arithmetic against the
+    # wall-clock lease file is the one sanctioned clock read and is
+    # noqa'd at its call sites.
+    "comfyui_distributed_tpu/api/standby.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
