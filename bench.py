@@ -671,6 +671,67 @@ def bench_video(jax, tiny: bool) -> dict:
     return result
 
 
+def _measure_cancel_latency(jobs: int = 4, tiles: int = 64) -> dict | None:
+    """Cancel reclaim speed (lifecycle-armor PR satellite): time from
+    the cancel request to every pending + in-flight tile refunded, on
+    an in-process JobStore with `tiles`-deep jobs and a few claimed
+    grants — the accounting path POST /distributed/cancel/{job_id}
+    drives, minus the HTTP envelope. Stamped into the bench datum as
+    `lifecycle.cancel_latency_ms` (mean over `jobs` cancels) together
+    with the process's shed counters; returns None (never raises) when
+    the measurement can't run — losing the stamp must not cost the
+    datum."""
+    try:
+        import asyncio
+        import time as time_mod
+
+        from comfyui_distributed_tpu.jobs import JobStore
+
+        async def run_once(store: JobStore, job_id: str) -> float:
+            await store.init_tile_job(job_id, list(range(tiles)))
+            for wid in ("w1", "w2", "w3"):
+                await store.pull_tasks(job_id, wid, timeout=0.01)
+            started = time_mod.perf_counter()
+            acct = await store.cancel_job(job_id, reason="bench")
+            elapsed = (time_mod.perf_counter() - started) * 1000.0
+            assert acct is not None
+            assert (
+                acct["pending_refunded"] + acct["in_flight_refunded"] == tiles
+            ), acct
+            stats = store.stats_unlocked()
+            assert stats["in_flight"] == 0, stats
+            return elapsed
+
+        async def run_all() -> list[float]:
+            store = JobStore()
+            return [
+                await run_once(store, f"bench-cancel-{i}") for i in range(jobs)
+            ]
+
+        samples = asyncio.run(run_all())
+        shed_counts: dict[str, float] = {}
+        try:
+            from comfyui_distributed_tpu.telemetry.instruments import shed_total
+
+            counter = shed_total()
+            with counter._lock:
+                items = dict(counter._values)
+            for key, value in items.items():
+                shed_counts[key[0] if key else ""] = value
+        except Exception:
+            shed_counts = {}
+        return {
+            "cancel_latency_ms": round(sum(samples) / len(samples), 3),
+            "cancel_latency_ms_max": round(max(samples), 3),
+            "cancel_jobs": jobs,
+            "cancel_tiles_per_job": tiles,
+            "shed_total": shed_counts,
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"cancel-latency measurement failed: {exc}", file=sys.stderr)
+        return None
+
+
 def _measure_grant_ab(
     waves: int = 6,
     wave_tiles: int = 2,
@@ -1393,6 +1454,12 @@ def main() -> None:
         grant_ab = _measure_grant_ab()
         if grant_ab is not None:
             result["grant_ab"] = grant_ab
+    # lifecycle reclaim speed (cancel-request -> all tiles refunded) +
+    # shed counters, so future rounds track the armor's overheads
+    if tiny and os.environ.get("BENCH_LIFECYCLE", "1") != "0":
+        lifecycle = _measure_cancel_latency()
+        if lifecycle is not None:
+            result["lifecycle"] = lifecycle
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

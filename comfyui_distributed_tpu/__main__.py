@@ -68,6 +68,7 @@ def main(argv: list[str] | None = None) -> int:
         auto_populate_workers,
         delayed_auto_launch,
         register_signals,
+        register_worker_drain,
     )
 
     server = DistributedServer(
@@ -83,6 +84,10 @@ def main(argv: list[str] | None = None) -> int:
             delayed_auto_launch(args.config)
         else:
             start_master_watchdog()
+            # SIGTERM/SIGINT on a worker drains gracefully: finish the
+            # in-flight batch, flush encoded tiles, hand the remainder
+            # back via return_tiles, then deregister and stop
+            register_worker_drain(asyncio.get_running_loop(), server)
         # run until the loop is stopped by a signal handler
         await asyncio.Event().wait()
 

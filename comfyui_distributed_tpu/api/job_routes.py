@@ -32,6 +32,7 @@ from .telemetry_routes import rpc_span
 def register(app: web.Application, server) -> None:
     routes = JobRoutes(server)
     app.router.add_post("/distributed/queue", routes.queue)
+    app.router.add_post("/distributed/cancel/{job_id}", routes.cancel_job)
     app.router.add_post("/distributed/job_complete", routes.job_complete)
     app.router.add_post("/distributed/prepare_job", routes.prepare_job)
     app.router.add_post("/distributed/clear_memory", routes.clear_memory)
@@ -45,18 +46,34 @@ class JobRoutes:
         self.server = server
 
     async def queue(self, request: web.Request) -> web.Response:
+        import time as time_mod
+
+        arrived_at = time_mod.monotonic()
         try:
             body = await request.json()
         except Exception:
             return web.json_response({"error": "invalid json"}, status=400)
         try:
             payload = parse_queue_request_payload(body)
+            if payload.deadline_s is None:
+                # header form of the end-to-end deadline (proxies and
+                # thin clients that can't touch the JSON body)
+                from .queue_request import parse_deadline_seconds
+
+                payload.deadline_s = parse_deadline_seconds(
+                    request.headers.get("X-CDT-Deadline")
+                )
         except QueueRequestError as exc:
             return web.json_response({"error": str(exc)}, status=400)
 
         import asyncio
 
-        from ..scheduler import AdmissionClosed, SchedulerSaturated
+        from ..scheduler import (
+            AdmissionClosed,
+            DeadlineUnmeetable,
+            SchedulerOverloaded,
+            SchedulerSaturated,
+        )
         from ..telemetry import get_tracer
         from ..utils.constants import SCHED_GRANT_TIMEOUT_SECONDS
         from ..utils.trace_logger import generate_trace_id
@@ -73,6 +90,24 @@ class JobRoutes:
             payload.trace_id = payload.trace_id or generate_trace_id()
             try:
                 ticket = scheduler.submit_payload(payload)
+            except DeadlineUnmeetable as exc:
+                return web.json_response(
+                    {
+                        "error": str(exc),
+                        "lane": exc.lane,
+                        "reason": "deadline_unmeetable",
+                        "deadline_s": exc.deadline_s,
+                        "estimated_wait_seconds": round(exc.estimated_wait, 2),
+                    },
+                    status=429,
+                    headers={"Retry-After": str(int(exc.retry_after))},
+                )
+            except SchedulerOverloaded as exc:
+                return web.json_response(
+                    {"error": str(exc), "lane": exc.lane, "reason": "shed"},
+                    status=429,
+                    headers={"Retry-After": str(int(exc.retry_after))},
+                )
             except SchedulerSaturated as exc:
                 return web.json_response(
                     {"error": str(exc), "lane": exc.lane},
@@ -121,6 +156,37 @@ class JobRoutes:
                             )
                         },
                     )
+                if ticket.state == "cancelled":
+                    # withdrawn while queued (DELETE ticket route): the
+                    # parked request unwinds here instead of waiting
+                    # out the grant timeout
+                    return web.json_response(
+                        {
+                            "error": "ticket cancelled before grant",
+                            "ticket_id": ticket.ticket_id,
+                        },
+                        status=409,
+                    )
+
+            if payload.deadline_s is not None:
+                # the deadline is END-TO-END: time spent queued counts.
+                # What rides into the job record is the REMAINDER; a
+                # request that burned its whole budget waiting answers
+                # 429 without starting doomed work.
+                remaining = payload.deadline_s - (
+                    time_mod.monotonic() - arrived_at
+                )
+                if remaining <= 0:
+                    return web.json_response(
+                        {
+                            "error": "deadline expired while queued",
+                            "reason": "deadline_expired",
+                            "deadline_s": payload.deadline_s,
+                        },
+                        status=429,
+                        headers={"Retry-After": "1"},
+                    )
+                payload.deadline_s = remaining
 
             try:
                 result = await orchestrate_distributed_execution(
@@ -145,6 +211,41 @@ class JobRoutes:
                     scheduler.queue.cancel(ticket)
                 else:
                     scheduler.queue.release(ticket)  # no-op unless granted
+
+    async def cancel_job(self, request: web.Request) -> web.Response:
+        """POST /distributed/cancel/{job_id} — cooperative cancellation
+        of a RUNNING job: journals the terminal cancel record, refunds
+        every pending + in-flight tile, notifies workers over the
+        events stream (they flush what's encoded and abort between
+        batches), and settles the master loop with a terminal
+        `cancelled` status. Idempotent; 404 for unknown jobs.
+
+        Pre-admission requests are cancelled through
+        DELETE /distributed/queue/{ticket_id} instead."""
+        import time as time_mod
+
+        job_id = request.match_info["job_id"]
+        reason = "client"
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 - body optional
+            body = None
+        if isinstance(body, dict) and body.get("reason"):
+            reason = str(body["reason"])
+        started = time_mod.monotonic()
+        with rpc_span(request, "rpc.cancel_job", job_id=str(job_id)):
+            accounting = await self.server.job_store.cancel_job(
+                str(job_id), reason=reason
+            )
+        if accounting is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        accounting["status"] = "cancelled"
+        # cancel-request → all tiles refunded: the reclaim-speed number
+        # the bench stamps as cancel_latency_ms
+        accounting["cancel_latency_ms"] = round(
+            (time_mod.monotonic() - started) * 1000.0, 3
+        )
+        return web.json_response(accounting)
 
     async def job_complete(self, request: web.Request) -> web.Response:
         """Canonical envelope {job_id, worker_id, batch_idx, image

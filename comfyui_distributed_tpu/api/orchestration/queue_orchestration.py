@@ -109,6 +109,10 @@ async def _orchestrate(
     job_ids = generate_job_id_map(payload.prompt, index)
     for job_id in job_ids.values():
         await server.job_store.ensure_collector(job_id)
+        if payload.deadline_s is not None:
+            # the API→store deadline seam: the executor's later
+            # init_tile_job picks this up and arms the job's cutoff
+            server.job_store.note_job_deadline(job_id, payload.deadline_s)
 
     enabled_ids = [str(w.get("id")) for w in active]
     prep_sem = asyncio.Semaphore(settings.get("prep_concurrency", 4))

@@ -34,7 +34,34 @@ class QueueRequestPayload:
     trace_id: str | None = None
     tenant: str = DEFAULT_TENANT
     lane: str | None = None
+    # End-to-end deadline in seconds, counted from request arrival
+    # (body field `deadline_s` or the `X-CDT-Deadline` header): gates
+    # admission, rides the job record, and expires overdue work.
+    deadline_s: float | None = None
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def parse_deadline_seconds(value: Any) -> float | None:
+    """Validate one deadline value (body or header): positive finite
+    seconds, clamped to CDT_JOB_DEADLINE_MAX when that cap is set;
+    None/empty = no deadline; anything else raises."""
+    from ..utils.constants import JOB_DEADLINE_MAX_SECONDS
+
+    if value is None or value == "":
+        return None
+    try:
+        deadline = float(value)
+    except (TypeError, ValueError) as exc:
+        raise QueueRequestError(
+            "'deadline_s' must be a positive number of seconds"
+        ) from exc
+    if not deadline > 0 or deadline != deadline or deadline == float("inf"):
+        raise QueueRequestError(
+            "'deadline_s' must be a positive number of seconds"
+        )
+    if JOB_DEADLINE_MAX_SECONDS > 0:
+        deadline = min(deadline, JOB_DEADLINE_MAX_SECONDS)
+    return deadline
 
 
 def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
@@ -67,6 +94,8 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
     if lane is not None and (not isinstance(lane, str) or not lane):
         raise QueueRequestError("'lane' must be a non-empty string")
 
+    deadline_s = parse_deadline_seconds(body.get("deadline_s"))
+
     return QueueRequestPayload(
         prompt=prompt,
         client_id=client_id,
@@ -74,6 +103,7 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
         trace_id=body.get("trace_id") or None,
         tenant=tenant,
         lane=lane,
+        deadline_s=deadline_s,
         extra={
             k: v
             for k, v in body.items()
@@ -86,6 +116,7 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
                 "worker_ids",
                 "tenant",
                 "lane",
+                "deadline_s",
             )
         },
     )

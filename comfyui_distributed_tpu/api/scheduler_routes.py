@@ -26,6 +26,9 @@ def register(app: web.Application, server) -> None:
     app.router.add_post(
         "/distributed/scheduler/reprioritize", routes.reprioritize
     )
+    # pre-admission ticket cancel: abandon a QUEUED request without
+    # waiting out the grant timeout (wired to AdmissionQueue.cancel)
+    app.router.add_delete("/distributed/queue/{ticket_id}", routes.cancel_ticket)
 
 
 class SchedulerRoutes:
@@ -47,6 +50,27 @@ class SchedulerRoutes:
 
     async def drain(self, request: web.Request) -> web.Response:
         return web.json_response({"state": self.scheduler.drain().value})
+
+    async def cancel_ticket(self, request: web.Request) -> web.Response:
+        """DELETE /distributed/queue/{ticket_id}: withdraw one QUEUED
+        admission ticket. The parked queue request (if any) wakes and
+        answers 409; 404 when the ticket is unknown, already granted
+        (cancel the JOB instead), or already gone."""
+        ticket_id = request.match_info["ticket_id"]
+        cancelled = self.scheduler.queue.cancel_ticket(str(ticket_id))
+        if not cancelled:
+            return web.json_response(
+                {
+                    "error": "no such queued ticket",
+                    "detail": "unknown id, or the ticket was already "
+                              "granted (use POST /distributed/cancel/"
+                              "{job_id}) or released",
+                },
+                status=404,
+            )
+        return web.json_response(
+            {"status": "cancelled", "ticket_id": str(ticket_id)}
+        )
 
     async def reprioritize(self, request: web.Request) -> web.Response:
         try:

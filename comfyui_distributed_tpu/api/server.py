@@ -112,6 +112,16 @@ class DistributedServer:
 
         self.scheduler = SchedulerControl(health=get_health_registry())
         self.job_store.placement = self.scheduler.placement
+        # Poison pardon: when a tile is quarantined after exhausting
+        # its attempt budget, the workers whose crashes were charged to
+        # it leave the circuit breaker — one bad payload must not
+        # cascade worker quarantines across the fleet.
+        def _poison_pardon(worker_ids: list) -> None:
+            registry = get_health_registry()
+            for wid in worker_ids:
+                registry.pardon(str(wid))
+
+        self.job_store.poison_pardon = _poison_pardon
         sinks = [self.scheduler.placement.record_latency]
         if self._watchdog_enabled:
             sinks.append(self.watchdog.record_latency)
@@ -134,6 +144,12 @@ class DistributedServer:
         if journal_dir and not self.is_worker:
             self.durability = DurabilityManager(
                 journal_dir, scheduler=self.scheduler
+            )
+            # journal-append latency is the brownout controller's
+            # second overload signal (a saturated fsync path sheds
+            # low-priority lanes before the master tips over)
+            self.durability.append_latency_sink = (
+                self.scheduler.brownout.note_journal_append
             )
         # Warm-standby mode (--standby / CDT_STANDBY_OF): this master
         # tails the active's journal stream instead of recovering from

@@ -179,6 +179,16 @@ class UsduRoutes:
         }
         if batch_max > 1:
             response["tile_idxs"] = task_ids
+        # lifecycle armor: a cancelled job answers like a drained one,
+        # but says WHY so the worker aborts instead of push-parking;
+        # the remaining deadline lets workers skip sampling work whose
+        # job must already miss
+        if job.cancelled:
+            response["cancelled"] = True
+            response["cancel_reason"] = job.cancel_reason
+        deadline_remaining = job.deadline_remaining()
+        if deadline_remaining is not None:
+            response["deadline_remaining"] = round(deadline_remaining, 3)
         return web.json_response(response)
 
     async def submit_tiles(self, request: web.Request) -> web.Response:
@@ -329,5 +339,10 @@ class UsduRoutes:
                 # workers learn the fencing epoch from the first RPC of
                 # the job, then carry it on every mutating RPC
                 "epoch": self.server.job_store.epoch,
+                # lifecycle armor surfaces (panel + triage runbook §4h)
+                "cancelled": job.cancelled,
+                "cancel_reason": job.cancel_reason,
+                "quarantined_tiles": sorted(job.quarantined_tiles),
+                "deadline_remaining": job.deadline_remaining(),
             }
         )

@@ -85,6 +85,10 @@ class DurabilityManager:
         self.lease: Optional[Lease] = None
         self._replicas: list[ReplicationSubscription] = []
         self.failovers = 0  # promotions performed by THIS process
+        # Optional per-append latency feed (seconds) — the brownout
+        # controller's journal-saturation signal. Called OUTSIDE the
+        # manager lock; must never raise into the journal seam.
+        self.append_latency_sink: Optional[Callable[[float], None]] = None
 
     # --- lifecycle --------------------------------------------------------
 
@@ -152,6 +156,7 @@ class DurabilityManager:
                 f"the master lease for {self.directory} (a standby "
                 "promoted itself); the mutation was NOT journaled"
             )
+        append_started = time.monotonic()
         with self._lock:
             if self._journal is None:
                 self._journal = self._open_journal(int(self._state["last_lsn"]) + 1)
@@ -174,6 +179,12 @@ class DurabilityManager:
             self._appends_since_snapshot += 1
             if self._appends_since_snapshot >= self.snapshot_every:
                 self._snapshot_locked(asynchronous=True)
+        sink = self.append_latency_sink
+        if sink is not None:
+            try:
+                sink(time.monotonic() - append_started)
+            except Exception:  # noqa: BLE001 - observability only
+                pass
 
     # --- replication (durability/replicate.py) ----------------------------
 

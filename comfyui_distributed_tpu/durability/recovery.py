@@ -44,6 +44,7 @@ class RecoveryReport:
     last_lsn: int = 0
     truncated_bytes: int = 0
     jobs_recovered: int = 0
+    jobs_cancelled: int = 0
     tasks_requeued: int = 0
     tasks_restored: int = 0
     scheduler_restored: bool = False
@@ -84,6 +85,7 @@ def recover(
     stats = state_mod.prepare_for_restart(state)
     report.tasks_requeued = stats["tasks_requeued"]
     report.tasks_restored = stats["tasks_restored"]
+    report.jobs_cancelled = stats.get("jobs_cancelled", 0)
     jobs = state_mod.materialize(state)
     report.jobs_recovered = len(jobs)
     for job_id in sorted(jobs):

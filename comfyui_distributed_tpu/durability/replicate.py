@@ -230,6 +230,7 @@ class StandbyReplica:
         stats = state_mod.prepare_for_restart(prepared)
         report.tasks_requeued = stats["tasks_requeued"]
         report.tasks_restored = stats["tasks_restored"]
+        report.jobs_cancelled = stats.get("jobs_cancelled", 0)
         return prepared, report
 
     def promote(self, store: Any, scheduler: Any = None) -> tuple[

@@ -15,13 +15,19 @@ string-keyed for the same reason and normalized at materialize time.
 
 Record vocabulary (emitted by ``JobStore`` — docs/durability.md):
 
-    job_init    {job, kind, batched, tasks}
-    pull        {job, worker, tasks}
-    submit      {job, worker, task, payload}   payload null = volatile
-    requeue     {job, worker, tasks, reason}
-    speculate   {job, tasks}
-    worker_done {job, worker}
-    cleanup     {job}
+    job_init        {job, kind, batched, tasks, deadline_s?}
+    pull            {job, worker, tasks}
+    submit          {job, worker, task, payload}   payload null = volatile
+    requeue         {job, worker, tasks, reason}   failure-class reasons
+                    (timeout|quarantine) charge each task's attempt
+                    counter — the poison budget replays exactly
+    tile_quarantine {job, tasks}                   tasks leave the pull
+                    set for good (settled degraded)
+    cancel          {job, reason}                  terminal: pending
+                    drained, assignments revoked, later records no-op
+    speculate       {job, tasks}
+    worker_done     {job, worker}
+    cleanup         {job}
 
 ``prepare_for_restart`` is the recovery-time transform: in-flight
 assignments are revoked back to pending (the workers holding them died
@@ -49,7 +55,14 @@ def new_state() -> dict[str, Any]:
     return {"version": SNAPSHOT_VERSION, "last_lsn": 0, "jobs": {}, "scheduler": {}}
 
 
-def _new_job(kind: str, batched: bool, tasks: list[int]) -> dict[str, Any]:
+def _new_job(
+    kind: str, batched: bool, tasks: list[int],
+    deadline_s: Any = None,
+) -> dict[str, Any]:
+    try:
+        deadline_s = float(deadline_s) if deadline_s else None
+    except (TypeError, ValueError):
+        deadline_s = None
     return {
         "kind": kind,
         "batched": bool(batched),
@@ -59,6 +72,12 @@ def _new_job(kind: str, batched: bool, tasks: list[int]) -> dict[str, Any]:
         "completed": {},  # str(task id) -> payload | None
         "speculated": [],
         "finished_workers": [],
+        # --- lifecycle armor ---
+        "deadline_s": deadline_s,
+        "cancelled": False,
+        "cancel_reason": "",
+        "attempts": {},     # str(task id) -> failed delivery attempts
+        "quarantined": [],  # task ids settled degraded (poison)
     }
 
 
@@ -78,6 +97,7 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
                 str(record.get("kind", "tile")),
                 bool(record.get("batched", True)),
                 list(record.get("tasks", [])),
+                deadline_s=record.get("deadline_s"),
             )
         return
     job = jobs.get(str(record.get("job", "")))
@@ -85,6 +105,11 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
         jobs.pop(str(record.get("job", "")), None)
         return
     if job is None:
+        return
+    if job.get("cancelled") and rtype != "cancel":
+        # terminal: the live store refuses every mutation after the
+        # cancel record, so replay must too (defense in depth against
+        # a record that raced past the terminal state)
         return
     if rtype == "pull":
         worker = str(record["worker"])
@@ -106,17 +131,47 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
         key = str(tid)
         if key not in job["completed"]:  # first result wins, as in the store
             job["completed"][key] = record.get("payload")
+            # a speculated copy settling a poison-quarantined tile
+            # drops the quarantine, exactly as the live store does —
+            # the tile must count exactly once toward completion
+            quarantined = job.get("quarantined")
+            if quarantined and tid in quarantined:
+                quarantined.remove(tid)
     elif rtype == "requeue":
         worker = str(record.get("worker", ""))
         claimed = job["assigned"].get(worker, [])
+        charge = str(record.get("reason", "")) in ("timeout", "quarantine")
+        attempts = job.setdefault("attempts", {})
+        quarantined = job.setdefault("quarantined", [])
         for tid in record.get("tasks", []):
             tid = int(tid)
             if tid in claimed:
                 claimed.remove(tid)
-            if str(tid) not in job["completed"] and tid not in job["pending"]:
+            if charge:
+                attempts[str(tid)] = int(attempts.get(str(tid), 0)) + 1
+            if (
+                str(tid) not in job["completed"]
+                and tid not in job["pending"]
+                and tid not in quarantined
+            ):
                 job["pending"].append(tid)
         if worker in job["assigned"] and not job["assigned"][worker]:
             del job["assigned"][worker]
+    elif rtype == "tile_quarantine":
+        quarantined = job.setdefault("quarantined", [])
+        for tid in record.get("tasks", []):
+            tid = int(tid)
+            if tid in job["pending"]:
+                job["pending"] = [t for t in job["pending"] if t != tid]
+            if str(tid) not in job["completed"] and tid not in quarantined:
+                quarantined.append(tid)
+    elif rtype == "cancel":
+        # terminal: the whole refund happens here, so crash-after-cancel
+        # replay reaches the same drained state the live store had
+        job["cancelled"] = True
+        job["cancel_reason"] = str(record.get("reason", ""))
+        job["pending"] = []
+        job["assigned"] = {}
     elif rtype == "speculate":
         for tid in record.get("tasks", []):
             tid = int(tid)
@@ -160,8 +215,17 @@ def prepare_for_restart(state: dict[str, Any]) -> dict[str, int]:
     """
     requeued = 0
     restored = 0
+    cancelled = 0
     for job_id in sorted(state["jobs"]):
         job = state["jobs"][job_id]
+        if job.get("cancelled"):
+            # terminal: a restarted master has nothing to resume here —
+            # the cancel already refunded everything; drop the record
+            # (the dead process would have cleaned it up next).
+            del state["jobs"][job_id]
+            cancelled += 1
+            continue
+        quarantined = {int(t) for t in job.get("quarantined", [])}
         back: set[int] = set()
         for worker in sorted(job["assigned"]):
             back.update(int(t) for t in job["assigned"][worker])
@@ -175,7 +239,14 @@ def prepare_for_restart(state: dict[str, Any]) -> dict[str, int]:
                 durable[key] = payload
                 restored += 1
         job["completed"] = durable
-        pending = [int(t) for t in job["pending"] if int(t) not in back]
+        # quarantined tiles stay settled (degraded) across the restart:
+        # re-running known poison would just crash the new fleet too
+        back -= quarantined
+        pending = [
+            int(t)
+            for t in job["pending"]
+            if int(t) not in back and int(t) not in quarantined
+        ]
         already = set(pending)
         additions = [
             t for t in sorted(back) if t not in already and str(t) not in durable
@@ -183,7 +254,11 @@ def prepare_for_restart(state: dict[str, Any]) -> dict[str, int]:
         job["pending"] = pending + additions
         job["speculated"] = []
         requeued += len(additions)
-    return {"tasks_requeued": requeued, "tasks_restored": restored}
+    return {
+        "tasks_requeued": requeued,
+        "tasks_restored": restored,
+        "jobs_cancelled": cancelled,
+    }
 
 
 def materialize(state: dict[str, Any]):
@@ -209,6 +284,24 @@ def materialize(state: dict[str, Any]):
             job.completed[int(key)] = payload
             job.results.put_nowait((int(key), payload))
         job.finished_workers = set(spec.get("finished_workers", []))
+        # lifecycle armor: poison budgets and quarantines survive the
+        # restart; a journaled deadline re-arms its FULL window (the
+        # dead process's monotonic cutoff is meaningless here — the
+        # recovered job gets a fresh clock, documented in
+        # docs/resilience.md)
+        job.attempts = {
+            int(t): int(n)
+            for t, n in (spec.get("attempts") or {}).items()
+        }
+        job.quarantined_tiles = {
+            int(t) for t in spec.get("quarantined", [])
+        }
+        deadline_s = spec.get("deadline_s")
+        if deadline_s:
+            import time as _time
+
+            job.deadline_s = float(deadline_s)
+            job.deadline_at = _time.monotonic() + float(deadline_s)
         out[job_id] = job
     return out
 

@@ -131,6 +131,15 @@ class HTTPWorkClient:
         # Fencing epoch: learned from responses, monotonic, attached to
         # every mutating RPC. None until the master reports one.
         self.epoch: Optional[int] = None
+        # Lifecycle armor: flipped when the master reports the job
+        # cancelled (a pull response with `cancelled: true`); the
+        # worker loop's interrupt check reads it so an in-flight
+        # pipeline aborts between batches instead of draining grants.
+        self.job_cancelled = False
+        self.cancel_reason = ""
+        # Remaining end-to-end deadline (seconds) as of the last pull
+        # response; None = no deadline on this job.
+        self.deadline_remaining: Optional[float] = None
         self.failovers = 0
         self._consecutive_errors = 0
         # Heartbeat backoff state (consecutive failures → suppression
@@ -272,6 +281,15 @@ class HTTPWorkClient:
         out = run_async_in_server_loop(pull(), timeout=None)
         if out is None:
             return None
+        if out.get("cancelled"):
+            self.job_cancelled = True
+            self.cancel_reason = str(out.get("cancel_reason", ""))
+            return None
+        if "deadline_remaining" in out:
+            try:
+                self.deadline_remaining = float(out["deadline_remaining"])
+            except (TypeError, ValueError):
+                pass
         if out.get("tile_idx") is None and out.get("image_idx") is None:
             return None
         return out
@@ -416,6 +434,7 @@ class GrantSignal:
         self._stopped = threading.Event()
         self.connected = False
         self._complete = False
+        self._cancelled = False
         self._future = None
 
     # --- worker-thread side ------------------------------------------------
@@ -433,6 +452,14 @@ class GrantSignal:
     @property
     def job_complete(self) -> bool:
         return self._complete
+
+    @property
+    def job_cancelled(self) -> bool:
+        """A pushed ``job_cancelled`` frame arrived: the worker's
+        interrupt check aborts the pipeline between batches (flush
+        what's encoded, hand the rest back) without waiting for the
+        next pull round-trip."""
+        return self._cancelled
 
     def start(self) -> None:
         from ..utils.async_helpers import get_server_loop
@@ -465,7 +492,8 @@ class GrantSignal:
                 session = await get_client_session()
                 async with session.ws_connect(
                     f"{url}/distributed/events"
-                    "?types=grant_available,job_ready,job_complete",
+                    "?types=grant_available,job_ready,job_complete,"
+                    "job_cancelled",
                     heartbeat=30,
                 ) as ws:
                     self.connected = True
@@ -484,6 +512,11 @@ class GrantSignal:
                         kind = frame.get("type")
                         if kind in ("grant_available", "job_ready"):
                             self._event.set()
+                        elif kind == "job_cancelled":
+                            self._cancelled = True
+                            self._complete = True
+                            self._event.set()
+                            return
                         elif kind == "job_complete":
                             self._complete = True
                             self._event.set()
@@ -690,7 +723,15 @@ def run_worker_loop(
     def _grant_ids(work: dict) -> list[int]:
         return [int(t) for t in (work.get("tile_idxs") or [work["tile_idx"]])]
 
+    def _cancelled() -> bool:
+        return bool(
+            getattr(client, "job_cancelled", False)
+            or (push is not None and push.job_cancelled)
+        )
+
     def pull() -> Optional[list[int]]:
+        if _cancelled():
+            return None  # cancelled: no push-park, no further claims
         work = pull_work()
         if work is not None:
             return _grant_ids(work)
@@ -700,6 +741,21 @@ def run_worker_loop(
                 if work is not None:
                     return _grant_ids(work)
         return None
+
+    def check_abort() -> None:
+        """Interrupt seam between batches: the dispatched prompt's
+        interrupt, OR a cooperative job cancellation (pushed over the
+        events stream or learned from a pull response). Raising
+        InterruptedError routes through the pipeline's graceful path —
+        flush what's encoded, hand the claimed remainder back via
+        return_tiles — exactly the PR 5 interrupt semantics."""
+        if context is not None:
+            context.check_interrupted()
+        if _cancelled():
+            reason = getattr(client, "cancel_reason", "") or "cancelled"
+            raise InterruptedError(
+                f"job {job_id} cancelled by master ({reason})"
+            )
 
     pipeline = TilePipeline(
         pull=pull,
@@ -711,9 +767,7 @@ def run_worker_loop(
         emit=emit,
         flush=flush,
         heartbeat=client.heartbeat,
-        check_interrupted=(
-            context.check_interrupted if context is not None else None
-        ),
+        check_interrupted=check_abort,
         release=getattr(client, "return_tiles", None),
         role="worker",
         # per-pipeline span grouping: perf_report's overlap column
@@ -724,6 +778,13 @@ def run_worker_loop(
     )
     try:
         pipeline.run()
+    except InterruptedError:
+        if not _cancelled():
+            raise  # a real interrupt (SIGTERM drain / client abort)
+        # cooperative cancellation is a CLEAN exit for the worker: the
+        # pipeline already flushed what was encoded and returned the
+        # claimed remainder via return_tiles
+        log(f"worker {worker_id}: job {job_id} cancelled; aborted cleanly")
     finally:
         if push is not None:
             push.stop()
@@ -937,12 +998,39 @@ def run_master_elastic(
             drain_results()
 
     # --- collection phase ---
+    # Lifecycle-aware accounting: poison-quarantined tiles count as
+    # SETTLED (the job completes degraded, their region blended from
+    # the base image), and a terminal cancellation — client cancel or
+    # the deadline sweep — unwinds the loop instead of waiting for
+    # tiles that will never arrive.
+    from ..utils.exceptions import JobCancelled, JobPoisoned
+
+    def _lifecycle() -> dict:
+        state = run_async_in_server_loop(
+            store.job_lifecycle(job_id), timeout=30
+        )
+        return state or {
+            "cancelled": False, "cancel_reason": "", "quarantined": [],
+        }
+
     deadline = time.monotonic() + timeout * max(1, len(enabled_worker_ids))
-    while len(done_tiles) < grid.num_tiles:
+    while True:
+        # ONE lifecycle snapshot per iteration: termination reads may
+        # be up to a poll interval stale, which only delays exit by
+        # that interval — never changes the terminal outcome
+        lifecycle = _lifecycle()
+        quarantined = set(lifecycle["quarantined"])
+        if lifecycle["cancelled"] or (
+            len(done_tiles | quarantined) >= grid.num_tiles
+        ):
+            break
         if context is not None:
             context.check_interrupted()
+        # store-side sweep: an overdue deadline cancels the job even
+        # with no pull traffic left to trigger the lazy path
+        run_async_in_server_loop(store.sweep_deadlines(), timeout=30)
         drain_results()
-        if len(done_tiles) >= grid.num_tiles:
+        if len(done_tiles | quarantined) >= grid.num_tiles:
             break
         requeued = run_async_in_server_loop(
             store.requeue_timed_out(job_id, timeout, probe_busy), timeout=60
@@ -983,10 +1071,15 @@ def run_master_elastic(
                 )
                 tiles_processed_total().inc(role="master")
                 blend_local(tile_idx, result)
-        if len(done_tiles) >= grid.num_tiles:
+        if len(done_tiles | quarantined) >= grid.num_tiles:
             break
         if time.monotonic() > deadline:
-            missing = sorted(set(range(grid.num_tiles)) - done_tiles)
+            # quarantined tiles are NOT reprocessed locally: a payload
+            # that crashed every worker that touched it stays settled
+            # degraded rather than taking the master down with it
+            missing = sorted(
+                set(range(grid.num_tiles)) - done_tiles - quarantined
+            )
             log(f"USDU: deadline hit; locally processing {len(missing)} tile(s)")
             for tile_idx in missing:
                 tkey = jax.random.fold_in(key, tile_idx)
@@ -1000,7 +1093,22 @@ def run_master_elastic(
             break
         time.sleep(QUEUE_POLL_INTERVAL_SECONDS)
 
+    lifecycle = _lifecycle()
     run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=30)
+    if lifecycle["cancelled"]:
+        # terminal: every pending/in-flight tile was refunded by the
+        # cancel; the collector settles with a cancelled status instead
+        # of a partial canvas
+        raise JobCancelled(job_id, lifecycle["cancel_reason"] or "cancel")
+    poisoned = sorted(set(lifecycle["quarantined"]) - done_tiles)
+    if poisoned:
+        policy = getattr(store, "poison_policy", "degrade")
+        if policy == "fail":
+            raise JobPoisoned(job_id, poisoned)
+        log(
+            f"USDU: job {job_id} completes DEGRADED: tile(s) {poisoned} "
+            "quarantined (region blended from the base image)"
+        )
     return canvas.result()
 
 
@@ -1191,10 +1299,19 @@ def run_master_dynamic(
         if context is not None:
             context.check_interrupted()
 
+    from ..utils.exceptions import JobCancelled
+
     deadline = time.monotonic() + timeout * max(1, len(enabled_worker_ids))
     while len(frames) < batch:
         if context is not None:
             context.check_interrupted()
+        run_async_in_server_loop(store.sweep_deadlines(), timeout=30)
+        state = run_async_in_server_loop(
+            store.job_lifecycle(job_id), timeout=30
+        )
+        if state is not None and state["cancelled"]:
+            run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=30)
+            raise JobCancelled(job_id, state["cancel_reason"] or "cancel")
         drain()
         if len(frames) >= batch:
             break

@@ -56,9 +56,43 @@ class TileJob:
     created_at: float = dataclasses.field(default_factory=time.monotonic)
     # batched static mode: one task id covers the whole image batch
     batched: bool = True
+    # --- request-lifecycle armor (PR 10) ---------------------------------
+    # End-to-end deadline: seconds granted at init and the absolute
+    # monotonic cutoff derived from it (None = no deadline). The store's
+    # sweep cancels the job once the cutoff passes.
+    deadline_s: float | None = None
+    deadline_at: float | None = None
+    # Terminal cancellation (client cancel / deadline expiry): pulls
+    # read as drained, submissions drop, releases are no-ops.
+    cancelled: bool = False
+    cancel_reason: str = ""
+    # task id → failed delivery attempts (timeout/quarantine requeues);
+    # a task reaching the max-attempts budget is quarantined out of the
+    # pull set instead of requeued (poison-tile containment)
+    attempts: dict[int, int] = dataclasses.field(default_factory=dict)
+    # task id → workers whose crash charged an attempt (NOT journaled:
+    # pardon bookkeeping so a poison tile's victims leave the breaker)
+    attempt_workers: dict[int, list[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # tasks removed from the pull set after exhausting their attempt
+    # budget; the job completes degraded (or fails, per policy) with
+    # these counted as settled
+    quarantined_tiles: set[int] = dataclasses.field(default_factory=set)
 
     def heartbeat(self, worker_id: str) -> None:
         self.worker_status[worker_id] = time.monotonic()
+
+    def deadline_expired(self, now: float | None = None) -> bool:
+        if self.deadline_at is None:
+            return False
+        return (now if now is not None else time.monotonic()) > self.deadline_at
+
+    def deadline_remaining(self, now: float | None = None) -> float | None:
+        if self.deadline_at is None:
+            return None
+        now = now if now is not None else time.monotonic()
+        return max(0.0, self.deadline_at - now)
 
 
 @dataclasses.dataclass

@@ -47,11 +47,44 @@ from .models import CollectorJob, ImageJob, TileJob
 
 
 class JobStore:
-    def __init__(self, fault_injector: Any = None) -> None:
+    def __init__(
+        self,
+        fault_injector: Any = None,
+        max_attempts: Optional[int] = None,
+        poison_policy: Optional[str] = None,
+    ) -> None:
+        from ..utils import constants
+
         self.lock = asyncio.Lock()
         self.collectors: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
         self.fault_injector = fault_injector
+        # Poison-tile containment: failed delivery attempts a tile may
+        # accumulate before it is quarantined out of the pull set, and
+        # what the job does about it ("degrade" | "fail"). Injectable so
+        # chaos runs script tight budgets without env patching.
+        self.max_attempts = (
+            max_attempts
+            if max_attempts is not None
+            else constants.TILE_MAX_ATTEMPTS
+        )
+        self.poison_policy = (
+            poison_policy
+            if poison_policy is not None
+            else constants.POISON_POLICY
+        )
+        # Pardon hook: called (outside the store lock) with the worker
+        # ids whose crashes were charged to a tile that just got
+        # poison-quarantined — the server wires this to
+        # HealthRegistry.pardon so one bad payload cannot cascade
+        # breaker quarantines across the fleet.
+        self.poison_pardon: Optional[Callable[[list[str]], None]] = None
+        self._poison_notices: list[tuple[str, list[int], list[str]]] = []
+        # job_id → deadline seconds noted by orchestration BEFORE the
+        # executor's init_tile_job runs (the API-to-store deadline
+        # seam); bounded insertion-order dict, popped at init.
+        self._pending_deadlines: dict[str, float] = {}
+        self._max_pending_deadlines = 512
         # Optional (worker_id, seconds) callback fed every completed
         # task's pull→submit latency — the watchdog's straggler signal
         # and the placement policy's speed model (the server wires this
@@ -285,15 +318,41 @@ class JobStore:
 
     # --- tile/image jobs ----------------------------------------------------
 
+    def note_job_deadline(self, job_id: str, deadline_s: Any) -> None:
+        """Record a deadline (seconds from NOW) for a job that has not
+        been initialized yet — the orchestration layer knows the job-id
+        map before the executor's ``init_tile_job`` runs. Malformed or
+        non-positive values are ignored; the table is bounded (oldest
+        noted evicted) because job ids arrive from the network."""
+        try:
+            deadline_s = float(deadline_s)
+        except (TypeError, ValueError):
+            return
+        if deadline_s <= 0:
+            return
+        self._pending_deadlines.pop(job_id, None)
+        while len(self._pending_deadlines) >= self._max_pending_deadlines:
+            self._pending_deadlines.pop(next(iter(self._pending_deadlines)))
+        self._pending_deadlines[job_id] = deadline_s
+
     async def init_tile_job(
         self, job_id: str, task_ids: list[int], batched: bool = True,
-        kind: str = "tile",
+        kind: str = "tile", deadline_s: Optional[float] = None,
     ) -> TileJob:
+        from ..utils.constants import JOB_DEADLINE_DEFAULT_SECONDS
+
         async with self.lock:
             if job_id in self.tile_jobs:
                 return self.tile_jobs[job_id]
+            if deadline_s is None:
+                deadline_s = self._pending_deadlines.pop(job_id, None)
+            if deadline_s is None and JOB_DEADLINE_DEFAULT_SECONDS > 0:
+                deadline_s = JOB_DEADLINE_DEFAULT_SECONDS
             cls = TileJob if kind == "tile" else ImageJob
             job = cls(job_id=job_id, total_tasks=len(task_ids), batched=batched)
+            if deadline_s is not None and deadline_s > 0:
+                job.deadline_s = float(deadline_s)
+                job.deadline_at = time.monotonic() + float(deadline_s)
             self._journal(
                 {
                     "type": "job_init",
@@ -301,6 +360,7 @@ class JobStore:
                     "kind": kind,
                     "batched": batched,
                     "tasks": [int(t) for t in task_ids],
+                    "deadline_s": job.deadline_s,
                 }
             )
             for tid in task_ids:
@@ -381,6 +441,21 @@ class JobStore:
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
+        if job.deadline_expired() and not job.cancelled:
+            # lazy deadline sweep on the pull path: the overdue job is
+            # expired the moment ANY participant asks it for work, so
+            # workers never sample tiles whose deadline already passed
+            await self.cancel_job(job_id, reason="deadline")
+        if job.cancelled:
+            # cancelled reads exactly like drained: the worker flushes
+            # what it encoded and exits; the heartbeat keeps a live
+            # worker from being timed out over the terminal window
+            async with self.lock:
+                self._record_heartbeat(job, worker_id)
+            instruments.store_pulls_total().inc(
+                worker_id=worker_id, outcome="cancelled"
+            )
+            return None
         if not self._may_pull(job, worker_id):
             async with self.lock:
                 self._record_heartbeat(job, worker_id)
@@ -390,13 +465,26 @@ class JobStore:
             return None
         try:
             task_id = await asyncio.wait_for(job.pending.get(), timeout)
-        except asyncio.TimeoutError:
+            # a stale speculated COPY of a tile that has since been
+            # poison-quarantined may still sit in pending: skip it (and
+            # any run of them) rather than hand out known poison
+            while task_id in job.quarantined_tiles:
+                task_id = job.pending.get_nowait()
+        except (asyncio.TimeoutError, asyncio.QueueEmpty):
             async with self.lock:
                 self._record_heartbeat(job, worker_id)
             instruments.store_pulls_total().inc(worker_id=worker_id, outcome="empty")
             return None
         async with self.lock:
             self._record_heartbeat(job, worker_id)
+            if job.cancelled:
+                # raced the terminal cancel: the popped task must NOT
+                # be assigned (or journaled) after the cancel record —
+                # it is simply dropped, like the rest of the refund
+                instruments.store_pulls_total().inc(
+                    worker_id=worker_id, outcome="cancelled"
+                )
+                return None
             self._record_assignment_locked(job, worker_id, task_id)
         instruments.store_pulls_total().inc(worker_id=worker_id, outcome="task")
         return task_id
@@ -433,11 +521,13 @@ class JobStore:
         if job is not None and size > 1:
             async with self.lock:
                 extra: list[int] = []
-                while len(tasks) < size:
+                while len(tasks) < size and not job.cancelled:
                     try:
                         task_id = job.pending.get_nowait()
                     except asyncio.QueueEmpty:
                         break
+                    if task_id in job.quarantined_tiles:
+                        continue  # stale speculated copy of poison
                     self._record_assignment_locked(
                         job, worker_id, task_id, journal=False
                     )
@@ -479,9 +569,26 @@ class JobStore:
         job = await self.get_tile_job(job_id)
         if job is None:
             raise JobQueueError(f"no such job {job_id!r}")
+        if job.cancelled:
+            # a late result against a cancelled job is dropped, never
+            # journaled: the cancel record is the job's final word and
+            # replay must reach the same terminal state
+            async with self.lock:
+                self._record_heartbeat(job, worker_id)
+            instruments.store_submits_total().inc(
+                worker_id=worker_id, outcome="cancelled"
+            )
+            return False
         now = time.monotonic()
         async with self.lock:
             self._record_heartbeat(job, worker_id)
+            if job.cancelled:
+                # cancel raced in between the unlocked check and here:
+                # the terminal record must stay the job's last word
+                instruments.store_submits_total().inc(
+                    worker_id=worker_id, outcome="cancelled"
+                )
+                return False
             job.assigned.get(worker_id, set()).discard(task_id)
             started = job.assigned_at.pop((worker_id, task_id), None)
             # Batched pulls assign several tiles at once; a tile's
@@ -511,6 +618,10 @@ class JobStore:
                     }
                 )
                 job.completed[task_id] = payload
+                # a speculated copy finishing after its original was
+                # poison-quarantined settles the tile for real — drop
+                # the quarantine so accounting counts it exactly once
+                job.quarantined_tiles.discard(task_id)
         if started is not None or service_seconds is not None:
             # duplicates still carry a real latency measurement: the
             # losing worker DID the work, and its speed is exactly what
@@ -589,7 +700,7 @@ class JobStore:
         if job is None:
             return
         async with self.lock:
-            if worker_id not in job.finished_workers:
+            if worker_id not in job.finished_workers and not job.cancelled:
                 self._journal(
                     {"type": "worker_done", "job": job_id, "worker": worker_id}
                 )
@@ -617,7 +728,30 @@ class JobStore:
         if job is None:
             return False
         async with self.lock:
-            return len(job.completed) >= job.total_tasks
+            # quarantined tiles are SETTLED (degraded), not outstanding:
+            # a poison tile must not hold the job open forever
+            return (
+                len(job.completed) + len(job.quarantined_tiles)
+                >= job.total_tasks
+            )
+
+    async def job_lifecycle(self, job_id: str) -> Optional[dict[str, Any]]:
+        """Consistent lifecycle snapshot for routes and the master
+        loop: terminal flags, quarantined tiles, remaining deadline."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return None
+        async with self.lock:
+            return {
+                "cancelled": job.cancelled,
+                "cancel_reason": job.cancel_reason,
+                "quarantined": sorted(job.quarantined_tiles),
+                "deadline_s": job.deadline_s,
+                "deadline_remaining": job.deadline_remaining(),
+                "attempts": {
+                    int(t): int(n) for t, n in sorted(job.attempts.items())
+                },
+            }
 
     async def cleanup_tile_job(self, job_id: str) -> None:
         removed = False
@@ -631,6 +765,115 @@ class JobStore:
             from ..telemetry.events import get_event_bus
 
             get_event_bus().publish("job_complete", job_id=job_id)
+
+    # --- lifecycle: cooperative cancel + deadline sweep ---------------------
+
+    async def cancel_job(
+        self, job_id: str, reason: str = "client", epoch: Any = None
+    ) -> Optional[dict[str, Any]]:
+        """Terminal cancellation: journal one ``cancel`` record, then
+        refund EVERY outstanding tile — the pending queue is drained
+        and all in-flight assignments are revoked under the same lock,
+        so no assignment can leak past the terminal state. Returns the
+        refund accounting (None = no such job; idempotent on repeat).
+
+        Workers learn cooperatively: the ``job_cancelled`` event wakes
+        push-mode pipelines mid-grant (they flush what's encoded and
+        abort), and every later pull reads as drained. Late submissions
+        and releases drop without journaling, so crash-after-cancel
+        replay — and the standby replica applying the same stream —
+        reach exactly this terminal state."""
+        self._check_epoch(epoch)
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            return None
+        async with self.lock:
+            if job.cancelled:
+                return {
+                    "job_id": job_id,
+                    "reason": job.cancel_reason,
+                    "already_cancelled": True,
+                    "pending_refunded": 0,
+                    "in_flight_refunded": 0,
+                    "workers": [],
+                }
+            # write-ahead: the cancel record lands BEFORE any refund is
+            # acknowledged — a crash mid-refund replays to the same
+            # terminal state because apply_record's cancel does the
+            # whole drain itself
+            self._journal(
+                {"type": "cancel", "job": job_id, "reason": str(reason)}
+            )
+            job.cancelled = True
+            job.cancel_reason = str(reason)
+            pending_refunded = 0
+            while True:
+                try:
+                    job.pending.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                pending_refunded += 1
+            in_flight: dict[str, list[int]] = {}
+            for wid, tasks in sorted(job.assigned.items()):
+                incomplete = sorted(
+                    t for t in tasks if t not in job.completed
+                )
+                if incomplete:
+                    in_flight[wid] = incomplete
+            job.assigned.clear()
+            job.assigned_at.clear()
+            in_flight_refunded = sum(len(v) for v in in_flight.values())
+        instruments.jobs_cancelled_total().inc(reason=str(reason))
+        if pending_refunded or in_flight_refunded:
+            instruments.cancel_refunded_tiles_total().inc(
+                pending_refunded, kind="pending"
+            )
+            instruments.cancel_refunded_tiles_total().inc(
+                in_flight_refunded, kind="in_flight"
+            )
+        from ..telemetry.events import get_event_bus
+
+        get_event_bus().publish(
+            "job_cancelled",
+            job_id=job_id,
+            reason=str(reason),
+            pending_refunded=pending_refunded,
+            in_flight_refunded=in_flight_refunded,
+            workers=sorted(in_flight),
+        )
+        log(
+            f"job {job_id} cancelled ({reason}): refunded "
+            f"{pending_refunded} pending + {in_flight_refunded} in-flight "
+            f"tile(s) across {len(in_flight)} worker(s)"
+        )
+        return {
+            "job_id": job_id,
+            "reason": str(reason),
+            "already_cancelled": False,
+            "pending_refunded": pending_refunded,
+            "in_flight_refunded": in_flight_refunded,
+            "workers": sorted(in_flight),
+        }
+
+    async def sweep_deadlines(self) -> list[str]:
+        """Expire every job whose end-to-end deadline has passed (the
+        store-side sweep: the watchdog drives it periodically and the
+        master's collection loop calls it between drains, so overdue
+        jobs die even with no pull traffic). Returns the job ids
+        expired by THIS sweep."""
+        now = time.monotonic()
+        async with self.lock:
+            overdue = [
+                job_id
+                for job_id, job in self.tile_jobs.items()
+                if not job.cancelled and job.deadline_expired(now)
+            ]
+        expired = []
+        for job_id in overdue:
+            result = await self.cancel_job(job_id, reason="deadline")
+            if result is not None and not result.get("already_cancelled"):
+                expired.append(job_id)
+        return expired
 
     # --- timeout / requeue --------------------------------------------------
 
@@ -681,42 +924,112 @@ class JobStore:
                     debug_log(f"worker {wid} busy on probe; heartbeat grace")
                     continue
                 requeued.extend(self._requeue_worker_locked(job, wid))
+            self._flush_poison_notices()
         return requeued
+
+    # Requeue reasons that count as a failed delivery ATTEMPT for the
+    # poison budget: the worker holding the tile died (stale heartbeat)
+    # or was circuit-quarantined. A voluntary release or a speculative
+    # copy is not evidence the tile is poisonous.
+    _ATTEMPT_REASONS = ("timeout", "quarantine")
 
     def _requeue_worker_locked(
         self, job: TileJob, worker_id: str, reason: str = "timeout"
     ) -> list[int]:
         """Put a worker's incomplete assigned tasks back on the queue.
-        Caller holds self.lock."""
+        Caller holds self.lock (and drains ``_flush_poison_notices``
+        after releasing it). Failure-class requeues charge each tile's
+        attempt counter; a tile exhausting ``max_attempts`` is
+        QUARANTINED out of the pull set instead of requeued — one
+        poison payload must not ping-pong across the fleet forever."""
+        if job.cancelled:
+            return []  # terminal: there is nothing left to requeue
         tasks = job.assigned.pop(worker_id, set())
         for tid in sorted(tasks):
             job.assigned_at.pop((worker_id, tid), None)
         incomplete = sorted(t for t in tasks if t not in job.completed)
-        if incomplete:
+        if not incomplete:
+            return incomplete
+        self._journal(
+            {
+                "type": "requeue",
+                "job": job.job_id,
+                "worker": worker_id,
+                "tasks": incomplete,
+                "reason": reason,
+            }
+        )
+        poisoned: list[int] = []
+        if reason in self._ATTEMPT_REASONS:
+            for tid in incomplete:
+                job.attempts[tid] = job.attempts.get(tid, 0) + 1
+                job.attempt_workers.setdefault(tid, []).append(worker_id)
+                if job.attempts[tid] >= max(1, self.max_attempts):
+                    poisoned.append(tid)
+        requeued = [t for t in incomplete if t not in poisoned]
+        if poisoned:
+            # journaled AFTER the requeue record (same lock, same
+            # write-ahead window): replay sees the revocation, then the
+            # quarantine — exactly the live store's order
             self._journal(
                 {
-                    "type": "requeue",
+                    "type": "tile_quarantine",
                     "job": job.job_id,
-                    "worker": worker_id,
-                    "tasks": incomplete,
-                    "reason": reason,
+                    "tasks": [int(t) for t in poisoned],
                 }
             )
-        for tid in incomplete:
-            job.pending.put_nowait(tid)
-        if incomplete:
-            instruments.store_requeued_tasks_total().inc(
-                len(incomplete), worker_id=worker_id, reason=reason
+            job.quarantined_tiles.update(poisoned)
+            victims = sorted(
+                {
+                    w
+                    for t in poisoned
+                    for w in job.attempt_workers.get(t, [])
+                }
             )
+            self._poison_notices.append((job.job_id, poisoned, victims))
+            instruments.poison_quarantined_tiles_total().inc(len(poisoned))
+            log(
+                f"POISON: tile(s) {poisoned} on job {job.job_id} exhausted "
+                f"{self.max_attempts} attempt(s); quarantined out of the "
+                f"pull set (policy={self.poison_policy})"
+            )
+        for tid in requeued:
+            job.pending.put_nowait(tid)
+        instruments.store_requeued_tasks_total().inc(
+            len(incomplete), worker_id=worker_id, reason=reason
+        )
+        if requeued:
             # non-blocking push wakeup (the lock is held here): the
             # requeued tiles are exactly the grants push-mode workers
             # should race for instead of the master's local fallback
-            self._notify_grants(job.job_id, len(incomplete))
+            self._notify_grants(job.job_id, len(requeued))
             log(
-                f"requeued {len(incomplete)} task(s) from "
+                f"requeued {len(requeued)} task(s) from "
                 f"worker {worker_id} on job {job.job_id}"
             )
         return incomplete
+
+    def _flush_poison_notices(self) -> None:
+        """Deliver quarantine side effects OUTSIDE the store lock: the
+        pardon hook (HealthRegistry transitions fire listeners that may
+        call back into this store) and the event-bus frame."""
+        notices, self._poison_notices = self._poison_notices, []
+        for job_id, tiles, victims in notices:
+            from ..telemetry.events import get_event_bus
+
+            get_event_bus().publish(
+                "tile_quarantined",
+                job_id=job_id,
+                task_ids=[int(t) for t in tiles],
+                pardoned_workers=victims,
+            )
+            pardon = self.poison_pardon
+            if pardon is not None and victims:
+                try:
+                    pardon(victims)
+                    instruments.poison_pardons_total().inc(len(victims))
+                except Exception as exc:  # noqa: BLE001 - pardon advisory
+                    debug_log(f"poison pardon for {victims} failed: {exc}")
 
     async def requeue_worker_tasks(
         self, worker_id: str, job_id: str | None = None
@@ -736,6 +1049,7 @@ class JobStore:
                 )
                 if incomplete:
                     out[job.job_id] = incomplete
+        self._flush_poison_notices()
         return out
 
     async def release_tasks(
@@ -753,10 +1067,14 @@ class JobStore:
         back (a stale release after a speculative win is a no-op)."""
         self._check_epoch(epoch)
         job = await self.get_tile_job(job_id)
-        if job is None:
+        if job is None or job.cancelled:
+            # a cancelled job already refunded every assignment; the
+            # interrupted worker's hand-back is a no-op, not a requeue
             return []
         released: list[int] = []
         async with self.lock:
+            if job.cancelled:
+                return []
             assigned = job.assigned.get(worker_id, set())
             claimable = [
                 tid
@@ -802,6 +1120,8 @@ class JobStore:
             return []
         per_worker: dict[str, list[int]] = {}
         async with self.lock:
+            if job.cancelled:
+                return []
             for wid, tasks in sorted(job.assigned.items()):
                 for tid in sorted(tasks):
                     if tid in job.completed or tid in job.speculated:

@@ -1125,3 +1125,563 @@ def run_chaos_failover(
         repointed_workers=sorted(repointed),
         orphan_tile=orphan_tile,
     )
+
+
+# --------------------------------------------------------------------------
+# request-lifecycle scenarios (cancel / poison-tile acceptance)
+# --------------------------------------------------------------------------
+
+
+class _TrimMaster:
+    """Placement stub that trims the MASTER out of the pull set (and
+    keeps worker grants at one tile): lifecycle scenarios need the
+    poison/cancel tiles to stay with worker threads instead of being
+    instantly drained by the in-process master."""
+
+    def may_pull(self, worker_id: str, pending: int) -> bool:
+        return worker_id != "master"
+
+    def batch_size(self, worker_id: str, pending: int) -> int:
+        return 1
+
+
+@dataclasses.dataclass
+class CancelResult:
+    """Outcome of a cancel-mid-job run: the refund accounting, the
+    leak check, and the terminal-state parity evidence."""
+
+    raised: str                    # exception type the master died with
+    reason: str                    # cancel reason it carried
+    accounting: dict               # cancel_job's refund accounting
+    completed_before_cancel: int
+    stats_after: dict              # store stats right after cancel
+    state_after_cancel: dict       # manager shadow at cancel time
+    journal_jobs_after: dict       # jobs left in the journal at the end
+    replica_jobs_after: dict       # jobs left in the replica at the end
+    replica_saw_cancel: bool       # the cancel record reached the standby
+    idempotent_replay: bool
+    cancel_latency_ms: float       # cancel call -> all tiles refunded
+
+
+def run_chaos_cancel(
+    seed: int = 0,
+    *,
+    journal_dir: str,
+    workers: Sequence[str] = ("w1", "w2"),
+    image_hw: tuple[int, int] = (96, 96),
+    tile: int = 48,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    worker_timeout: float = 5.0,
+    job_id: str = "chaos-cancel-job",
+    cancel_after: int = 2,
+    tile_delay: float = 0.08,
+    reason: str = "chaos",
+) -> CancelResult:
+    """Cancel-mid-job acceptance: the elastic USDU loop runs with the
+    write-ahead journal attached and a live standby replica teed in;
+    once ``cancel_after`` tiles have completed, a canceller thread
+    fires ``JobStore.cancel_job`` — mid-flight, with tiles pending AND
+    assigned. The scenario then proves the acceptance bundle:
+
+    - the refund accounting balances (no leaked in-flight assignment
+      survives the cancel — ``stats_after``);
+    - the master loop settles with a terminal ``JobCancelled`` instead
+      of blending a partial canvas; workers' later submissions drop;
+    - the cancel round-trips the journal: the shadow state at cancel
+      time shows the job terminally drained, the standby replica
+      applied the same record, and replay is idempotent.
+
+    Workers are slowed by ``tile_delay`` per tile (and the master is
+    trimmed out of the pull set) so the cancel deterministically lands
+    while work is still in flight.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..durability import DurabilityManager, StandbyReplica
+    from ..durability import state as dstate
+    from ..durability.recovery import recover_state, verify_idempotent_replay
+    from ..graph import ExecutionContext
+    from ..graph import usdu_elastic as elastic
+    from ..graph.tile_pipeline import GrantSampler, TilePipeline
+    from ..jobs import JobStore
+    from ..ops import upscale as upscale_ops
+    from ..utils import config as config_mod
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+    from ..utils.exceptions import JobCancelled, JobQueueError
+
+    h, w = image_hw
+    image = jnp.asarray(
+        np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    bundle = types.SimpleNamespace(params=None)
+
+    store = JobStore()
+    store.placement = _TrimMaster()
+    manager = DurabilityManager(journal_dir, snapshot_every=64, fsync_every=0)
+    store.journal_sink = manager.record
+
+    # live standby: attach-consistent subscription + replica tail
+    sub = manager.subscribe_replica()
+    replica = StandbyReplica()
+    replica.reset(sub.snapshot_state, sub.head_lsn, sub.epoch)
+    tail_stop = threading.Event()
+
+    def tail_body() -> None:
+        while not tail_stop.is_set():
+            sub.wait(0.02)
+            for record in sub.pop():
+                replica.apply(record)
+
+    tail = threading.Thread(target=tail_body, name="chaos-cancel-standby", daemon=True)
+    tail.start()
+
+    def worker_body(wid: str) -> None:
+        _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        key = jax.random.key(seed)
+        job = run_async_in_server_loop(
+            store.wait_for_tile_job(job_id, grace_seconds=20), timeout=30
+        )
+        if job is None:
+            return
+        sampler = GrantSampler(
+            _stub_process, None, extracted, key, grid.positions_array(),
+            None, None, k_max=1, role="worker",
+        )
+        flush_pending: dict[int, list] = {}
+
+        def pull():
+            try:
+                return run_async_in_server_loop(
+                    store.pull_tasks(job_id, wid, timeout=0.2), timeout=10
+                ) or None
+            except JobQueueError:
+                return None
+
+        def sample(chunk):
+            time.sleep(tile_delay)  # keep work in flight at cancel time
+            return sampler.sample(chunk)
+
+        def emit(tile_idx, arr):
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+
+        def flush(is_final):
+            if not flush_pending:
+                return
+            grouped = dict(flush_pending)
+            flush_pending.clear()
+            try:
+                run_async_in_server_loop(
+                    store.submit_flush(job_id, wid, grouped), timeout=10
+                )
+            except JobQueueError:
+                pass  # cancelled + cleaned up under us
+
+        try:
+            TilePipeline(
+                pull=pull, sample=sample, chunks=sampler.chunks,
+                emit=emit, flush=flush, role="worker",
+                span_attrs={"worker_id": wid}, threaded=False,
+            ).run()
+        except JobQueueError:
+            pass
+
+    cancel_outcome: dict[str, Any] = {}
+
+    def canceller_body() -> None:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            job = run_async_in_server_loop(
+                store.get_tile_job(job_id), timeout=10
+            )
+            if job is not None and len(job.completed) >= cancel_after:
+                started = time.monotonic()
+                accounting = run_async_in_server_loop(
+                    store.cancel_job(job_id, reason=reason), timeout=10
+                )
+                cancel_outcome["latency_ms"] = (
+                    time.monotonic() - started
+                ) * 1000.0
+                cancel_outcome["accounting"] = accounting
+                cancel_outcome["completed"] = len(job.completed)
+                with manager._lock:
+                    cancel_outcome["state"] = dstate.clone(manager._state)
+                cancel_outcome["stats"] = store.stats_unlocked()
+                return
+            time.sleep(0.005)
+
+    raised = ""
+    got_reason = ""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.object(
+                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+            )
+        )
+        stack.enter_context(
+            mock.patch.object(
+                config_mod, "get_worker_timeout_seconds",
+                lambda path=None: worker_timeout,
+            )
+        )
+        stack.enter_context(
+            mock.patch.dict(
+                os.environ,
+                {"CDT_DETERMINISTIC_BLEND": "1", "CDT_TILE_BATCH": "1"},
+            )
+        )
+        ctx = ExecutionContext(
+            server=types.SimpleNamespace(job_store=store),
+            config={"workers": []},
+        )
+        threads = [
+            threading.Thread(target=worker_body, args=(wid,), daemon=True)
+            for wid in workers
+        ]
+        canceller = threading.Thread(target=canceller_body, daemon=True)
+        for t in threads:
+            t.start()
+        canceller.start()
+        try:
+            elastic.run_master_elastic(
+                bundle, image, pos, neg,
+                job_id=job_id,
+                enabled_worker_ids=list(workers),
+                upscale_by=upscale_by, tile=tile, padding=padding,
+                steps=1, sampler="euler", scheduler="karras",
+                cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+            )
+        except JobCancelled as exc:
+            raised = type(exc).__name__
+            got_reason = exc.reason
+        finally:
+            for t in threads:
+                t.join(timeout=30)
+            canceller.join(timeout=30)
+            # final drain of the replication tee, then stop the tail
+            for record in sub.pop(max_items=100000):
+                replica.apply(record)
+            tail_stop.set()
+            tail.join(timeout=10)
+            manager.close()
+
+    journal_state, _ = recover_state(journal_dir)
+    replica_state = dstate.clone(replica._state)
+    state_after_cancel = cancel_outcome.get("state", {})
+    job_at_cancel = state_after_cancel.get("jobs", {}).get(job_id, {})
+    return CancelResult(
+        raised=raised,
+        reason=got_reason,
+        accounting=cancel_outcome.get("accounting") or {},
+        completed_before_cancel=int(cancel_outcome.get("completed", 0)),
+        stats_after=cancel_outcome.get("stats") or {},
+        state_after_cancel=job_at_cancel,
+        journal_jobs_after=dict(journal_state.get("jobs", {})),
+        replica_jobs_after=dict(replica_state.get("jobs", {})),
+        replica_saw_cancel=bool(job_at_cancel.get("cancelled", False)),
+        idempotent_replay=verify_idempotent_replay(journal_dir),
+        cancel_latency_ms=float(cancel_outcome.get("latency_ms", 0.0)),
+    )
+
+
+class _PoisonCrash(RuntimeError):
+    """Simulated worker-process death on a poison payload."""
+
+
+@dataclasses.dataclass
+class PoisonResult:
+    """Outcome of a poison-tile run: quarantine evidence, breaker
+    states, and the degraded canvas."""
+
+    output: np.ndarray
+    poison_tile: int
+    poison_rect: tuple[int, int, int, int]   # y, x, h, w in output coords
+    crashed_workers: list[str]
+    attempts: dict
+    quarantined: list[int]
+    pardons: list[str]
+    health_after: dict
+    charged_states: list[str]   # breaker states observed right after each crash
+    journal_quarantined: list[int]
+
+
+def run_chaos_poison(
+    seed: int = 0,
+    *,
+    journal_dir: Optional[str] = None,
+    workers: Sequence[str] = ("w1", "w2", "w3"),
+    image_hw: tuple[int, int] = (96, 96),
+    tile: int = 48,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    worker_timeout: float = 0.4,
+    job_id: str = "chaos-poison-job",
+    poison_tile: int = 0,
+    max_attempts: int = 3,
+    poison_policy: str = "degrade",
+) -> PoisonResult:
+    """Poison-tile acceptance: tile ``poison_tile``'s payload crashes
+    EVERY worker that samples it (each crash also charges the worker's
+    circuit breaker with failure_threshold=1 — the harshest cascade
+    setting). The store must quarantine the tile after ``max_attempts``
+    crash-requeues, the job must complete DEGRADED (the quarantined
+    region blended from the base image, every other tile bit-identical
+    to a clean run), and the breaker pardon must leave NO worker
+    quarantined on account of the poison.
+
+    The master is trimmed out of the pull set (``_TrimMaster``) so the
+    poison can only travel through workers; its deadline fallback
+    covers whatever healthy tiles the dead fleet left behind —
+    explicitly skipping the quarantined one."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..graph import ExecutionContext
+    from ..graph import usdu_elastic as elastic
+    from ..graph.tile_pipeline import GrantSampler, TilePipeline
+    from ..jobs import JobStore
+    from ..ops import upscale as upscale_ops
+    from ..utils import config as config_mod
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+    from ..utils.exceptions import JobQueueError
+    from .health import HealthRegistry
+
+    h, w = image_hw
+    image = jnp.asarray(
+        np.random.default_rng(seed).random((1, h, w, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    bundle = types.SimpleNamespace(params=None)
+
+    health = HealthRegistry(failure_threshold=1, suspect_threshold=1)
+    pardons: list[str] = []
+    charged_states: list[str] = []
+    captured: dict[str, Any] = {}
+
+    store = JobStore(max_attempts=max_attempts, poison_policy=poison_policy)
+    store.placement = _TrimMaster()
+
+    def pardon(worker_ids: list) -> None:
+        # fires at quarantine time ON the server loop: snapshot the
+        # job's final attempt/quarantine books here — the job may be
+        # cleaned up before any poller can observe them
+        job_obj = store.tile_jobs.get(job_id)
+        if job_obj is not None:
+            captured["attempts"] = {
+                int(t): int(n) for t, n in dict(job_obj.attempts).items()
+            }
+            captured["quarantined"] = sorted(job_obj.quarantined_tiles)
+        for wid in worker_ids:
+            pardons.append(str(wid))
+            health.pardon(str(wid))
+
+    store.poison_pardon = pardon
+    manager = None
+    if journal_dir:
+        from ..durability import DurabilityManager
+
+        manager = DurabilityManager(journal_dir, snapshot_every=64, fsync_every=0)
+        store.journal_sink = manager.record
+
+    crashed: list[str] = []
+    crashed_lock = threading.Lock()
+
+    def worker_body(wid: str) -> None:
+        _, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        key = jax.random.key(seed)
+        job = run_async_in_server_loop(
+            store.wait_for_tile_job(job_id, grace_seconds=20), timeout=30
+        )
+        if job is None:
+            return
+        sampler = GrantSampler(
+            _stub_process, None, extracted, key, grid.positions_array(),
+            None, None, k_max=1, role="worker",
+        )
+        flush_pending: dict[int, list] = {}
+
+        def pull():
+            # persistent pull: park through empty windows so a
+            # requeued poison tile finds a live victim (a real worker
+            # process keeps polling exactly like this)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    job_obj = run_async_in_server_loop(
+                        store.get_tile_job(job_id), timeout=10
+                    )
+                    if job_obj is None or job_obj.cancelled:
+                        return None
+                    done = (
+                        len(job_obj.completed)
+                        + len(job_obj.quarantined_tiles)
+                    )
+                    if done >= job_obj.total_tasks:
+                        return None
+                    grant = run_async_in_server_loop(
+                        store.pull_tasks(job_id, wid, timeout=0.1), timeout=10
+                    )
+                except JobQueueError:
+                    return None
+                if grant:
+                    return grant
+            return None
+
+        def sample(chunk):
+            if int(poison_tile) in [int(t) for t in chunk]:
+                # the poison payload kills the worker process; the
+                # breaker observes the death as a transport failure
+                charged_states.append(
+                    health.record_failure(wid).value
+                )
+                raise _PoisonCrash(f"{wid} crashed sampling tile {poison_tile}")
+            return sampler.sample(chunk)
+
+        def emit(tile_idx, arr):
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+
+        def flush(is_final):
+            if not flush_pending:
+                return
+            grouped = dict(flush_pending)
+            flush_pending.clear()
+            try:
+                run_async_in_server_loop(
+                    store.submit_flush(job_id, wid, grouped), timeout=10
+                )
+            except JobQueueError:
+                pass
+
+        try:
+            TilePipeline(
+                pull=pull, sample=sample, chunks=sampler.chunks,
+                emit=emit, flush=flush, role="worker",
+                span_attrs={"worker_id": wid}, threaded=False,
+            ).run()
+        except _PoisonCrash as exc:
+            debug_log(f"chaos poison worker died: {exc}")
+            with crashed_lock:
+                crashed.append(wid)
+        except JobQueueError:
+            pass
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.object(
+                elastic, "_jit_tile_processor", lambda *a, **k: _stub_process
+            )
+        )
+        stack.enter_context(
+            mock.patch.object(
+                config_mod, "get_worker_timeout_seconds",
+                lambda path=None: worker_timeout,
+            )
+        )
+        stack.enter_context(
+            mock.patch.dict(
+                os.environ,
+                {"CDT_DETERMINISTIC_BLEND": "1", "CDT_TILE_BATCH": "1"},
+            )
+        )
+        ctx = ExecutionContext(
+            server=types.SimpleNamespace(job_store=store),
+            config={"workers": []},
+        )
+        threads = [
+            threading.Thread(target=worker_body, args=(wid,), daemon=True)
+            for wid in workers
+        ]
+        # monitor: fallback snapshots of the live job's books while it
+        # exists (the pardon hook takes the authoritative final one)
+        monitor_stop = threading.Event()
+
+        def monitor_body() -> None:
+            while not monitor_stop.is_set():
+                try:
+                    job_obj = run_async_in_server_loop(
+                        store.get_tile_job(job_id), timeout=10
+                    )
+                except Exception:  # noqa: BLE001 - loop shutting down
+                    return
+                if job_obj is not None:
+                    if job_obj.attempts:
+                        captured["attempts"] = {
+                            int(t): int(n)
+                            for t, n in dict(job_obj.attempts).items()
+                        }
+                    if job_obj.quarantined_tiles:
+                        captured["quarantined"] = sorted(
+                            job_obj.quarantined_tiles
+                        )
+                time.sleep(0.02)
+
+        monitor = threading.Thread(target=monitor_body, daemon=True)
+        monitor.start()
+        for t in threads:
+            t.start()
+        # ghost ids pad the master's collection deadline (timeout x N)
+        # so three crash->timeout->requeue cycles fit before its local
+        # fallback would race the quarantine
+        padded_ids = list(workers) + [f"ghost{i}" for i in range(9)]
+        try:
+            out = elastic.run_master_elastic(
+                bundle, image, pos, neg,
+                job_id=job_id,
+                enabled_worker_ids=padded_ids,
+                upscale_by=upscale_by, tile=tile, padding=padding,
+                steps=1, sampler="euler", scheduler="karras",
+                cfg=1.0, denoise=0.3, seed=seed, context=ctx,
+            )
+        finally:
+            for t in threads:
+                t.join(timeout=30)
+            monitor_stop.set()
+            monitor.join(timeout=10)
+            if manager is not None:
+                manager.close()
+
+    journal_quarantined: list[int] = []
+    if journal_dir:
+        from ..durability.recovery import recover_state
+
+        state, _ = recover_state(journal_dir)
+        job_state = state.get("jobs", {}).get(job_id, {})
+        journal_quarantined = [int(t) for t in job_state.get("quarantined", [])]
+
+    _, _, grid = upscale_ops.plan_grid(h, w, upscale_by, tile, padding, None)
+    y, x = grid.positions[int(poison_tile)]
+    rect = (int(y), int(x), int(grid.padded_h), int(grid.padded_w))
+    return PoisonResult(
+        output=np.asarray(out),
+        poison_tile=int(poison_tile),
+        poison_rect=rect,
+        crashed_workers=sorted(crashed),
+        attempts=captured.get("attempts", {}),
+        quarantined=captured.get("quarantined", []),
+        pardons=list(pardons),
+        health_after=health.snapshot(),
+        charged_states=charged_states,
+        journal_quarantined=journal_quarantined,
+    )

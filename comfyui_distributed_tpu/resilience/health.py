@@ -250,6 +250,30 @@ class HealthRegistry:
         self._fire(worker_id, old, new)
         return new
 
+    def pardon(self, worker_id: str) -> WorkerState:
+        """Exonerate a worker whose failures traced to a poison tile
+        (the payload was the problem, not the worker): clear the
+        consecutive-failure streak and restore a SUSPECT / QUARANTINED
+        / PROBING worker to HEALTHY immediately — no cooldown, no
+        half-open probe. Totals are kept (history, not guilt). The
+        JobStore's poison-quarantine path drives this through the
+        server's ``poison_pardon`` hook, so one bad payload cannot
+        cascade breaker quarantines across the fleet."""
+        with self._lock:
+            health = self._workers.get(worker_id)
+            if health is None:
+                return WorkerState.HEALTHY
+            old = health.state
+            health.consecutive_failures = 0
+            health.quarantined_at = None
+            health.probing_since = None
+            health.state = WorkerState.HEALTHY
+            new = health.state
+        if old is not new:
+            log(f"worker {worker_id} pardoned (poison tile); circuit closed")
+        self._fire(worker_id, old, new)
+        return new
+
     def try_half_open(self, worker_id: str) -> bool:
         """If quarantined and cooled down, move to PROBING and return
         True — the caller owns the single half-open probe. At most one

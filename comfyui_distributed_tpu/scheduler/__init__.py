@@ -20,11 +20,14 @@ the chaos suite asserts bit-identical canvases under weighted
 placement.
 """
 
+from .brownout import BrownoutController
 from .control import SchedulerControl, SchedulerState
 from .placement import PlacementPolicy
 from .queue import (
     AdmissionClosed,
     AdmissionQueue,
+    DeadlineUnmeetable,
+    SchedulerOverloaded,
     SchedulerSaturated,
     Ticket,
 )
@@ -32,8 +35,11 @@ from .queue import (
 __all__ = [
     "AdmissionClosed",
     "AdmissionQueue",
+    "BrownoutController",
+    "DeadlineUnmeetable",
     "PlacementPolicy",
     "SchedulerControl",
+    "SchedulerOverloaded",
     "SchedulerSaturated",
     "SchedulerState",
     "Ticket",

@@ -16,8 +16,9 @@ from typing import Any, Callable, Optional
 
 from ..telemetry.events import get_event_bus
 from ..utils.logging import log
+from .brownout import BrownoutController
 from .placement import PlacementPolicy
-from .queue import AdmissionQueue, Ticket
+from .queue import AdmissionQueue, DeadlineUnmeetable, SchedulerOverloaded, Ticket
 
 
 class SchedulerState(enum.Enum):
@@ -33,23 +34,72 @@ class SchedulerControl:
         clock: Callable[[], float] = time.monotonic,
         queue: Optional[AdmissionQueue] = None,
         placement: Optional[PlacementPolicy] = None,
+        brownout: Optional[BrownoutController] = None,
     ) -> None:
         self.queue = queue or AdmissionQueue(clock=clock)
         self.placement = placement or PlacementPolicy(health=health)
+        # Load-shed controller above the lanes: fed queue waits by the
+        # admission queue (and journal-append latencies by the server's
+        # DurabilityManager wiring); consulted before every submit.
+        self.brownout = brownout or BrownoutController(
+            self.queue.lane_order, clock=clock
+        )
+        self.queue.wait_sink = self.brownout.note_queue_wait
 
     # --- payload mapping --------------------------------------------------
+
+    def resolve_lane(self, lane: Optional[str]) -> str:
+        """The lane a payload will actually land in (unknown lanes
+        route to the lowest class, exactly as queue.submit does)."""
+        from ..utils import constants
+
+        lane_name = lane or constants.SCHED_DEFAULT_LANE
+        if lane_name not in self.queue.lanes:
+            return self.queue.lane_order[-1]
+        return lane_name
 
     def submit_payload(self, payload: Any) -> Ticket:
         """Admit one parsed QueueRequestPayload. Cost is the request's
         estimated tile count when the client provided one
         (`estimated_tiles` in the body), else 1 — so fair share meters
         tile WORK, and a tenant of huge upscales can't starve a tenant
-        of small ones by request-count arithmetic."""
+        of small ones by request-count arithmetic.
+
+        Two lifecycle gates run BEFORE the lane sees the request:
+
+        - **brownout** — a shed lane answers 429 without consuming
+          queue depth, a grant slot, or journal bandwidth;
+        - **deadline admission** — a request whose end-to-end deadline
+          is already unmeetable (estimated queue wait exceeds it)
+          answers 429 instead of burning work that must miss.
+        """
+        lane_name = self.resolve_lane(payload.lane)
+        if self.brownout.should_shed(lane_name):
+            self.brownout.record_shed(lane_name)
+            raise SchedulerOverloaded(
+                f"lane {lane_name!r} is shed (brownout level "
+                f"{self.brownout.level}); retry later or use a higher "
+                "priority lane",
+                lane=lane_name,
+                retry_after=self.queue.estimate_retry_after(lane_name),
+            )
+        deadline_s = getattr(payload, "deadline_s", None)
+        if deadline_s is not None:
+            estimated = self.queue.estimate_wait(lane_name)
+            if estimated >= float(deadline_s):
+                raise DeadlineUnmeetable(
+                    f"deadline {float(deadline_s):g}s cannot be met: "
+                    f"estimated queue wait is {estimated:.1f}s",
+                    lane=lane_name,
+                    retry_after=self.queue.estimate_retry_after(lane_name),
+                    deadline_s=float(deadline_s),
+                    estimated_wait=estimated,
+                )
         cost = 1.0
-        estimated = payload.extra.get("estimated_tiles")
+        estimated_tiles = payload.extra.get("estimated_tiles")
         try:
-            if estimated is not None and float(estimated) > 0:
-                cost = float(estimated)
+            if estimated_tiles is not None and float(estimated_tiles) > 0:
+                cost = float(estimated_tiles)
         except (TypeError, ValueError):
             pass
         return self.queue.submit(
@@ -143,4 +193,5 @@ class SchedulerControl:
             "admission": self.queue.snapshot(),
             "placement": self.placement.snapshot(),
             "worker_weights": self.placement.weights(),
+            "brownout": self.brownout.snapshot(),
         }

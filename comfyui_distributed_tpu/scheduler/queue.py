@@ -59,6 +59,27 @@ class AdmissionClosed(DistributedError):
         self.retry_after = retry_after
 
 
+class SchedulerOverloaded(SchedulerSaturated):
+    """Brownout: this lane is currently SHED by the load-shed
+    controller (scheduler/brownout.py) — rejected before consuming
+    queue depth or a grant slot. Same 429 + Retry-After contract as a
+    full lane."""
+
+
+class DeadlineUnmeetable(SchedulerSaturated):
+    """The request carried an end-to-end deadline the scheduler cannot
+    meet at admission time (estimated queue wait already exceeds it):
+    rejected with 429 instead of admitting doomed work."""
+
+    def __init__(
+        self, message: str, lane: str, retry_after: float,
+        deadline_s: float, estimated_wait: float,
+    ):
+        super().__init__(message, lane, retry_after)
+        self.deadline_s = deadline_s
+        self.estimated_wait = estimated_wait
+
+
 def parse_lane_spec(spec: str) -> list[tuple[str, int]]:
     """"interactive:64,batch:256" → [(name, depth), ...] in priority
     order; malformed entries raise so a typo'd deployment fails loud."""
@@ -261,6 +282,9 @@ class AdmissionQueue:
         self.clock = clock
         self.state = RUNNING
         self.active: dict[str, Ticket] = {}
+        # Optional per-grant queue-wait feed (the brownout controller's
+        # leading overload indicator); must never raise into _pump.
+        self.wait_sink: Optional[Callable[[float], None]] = None
         self._seq = 0
         # EWMAs feeding the Retry-After estimate and the status view.
         self._service_ewma: Optional[float] = None
@@ -355,8 +379,9 @@ class AdmissionQueue:
         return ticket
 
     def cancel(self, ticket: Ticket) -> bool:
-        """Withdraw a queued ticket (grant-wait timeout / client gone).
-        A ticket already granted cannot be cancelled — release it."""
+        """Withdraw a queued ticket (grant-wait timeout / client gone /
+        the DELETE ticket route). A ticket already granted cannot be
+        cancelled — release it."""
         if ticket.state != "queued":
             return False
         lane_state = self.lanes.get(ticket.lane)
@@ -367,7 +392,30 @@ class AdmissionQueue:
         instruments.sched_admissions_total().inc(
             lane=ticket.lane, tenant=ticket.tenant, outcome="cancelled"
         )
+        # wake a request parked on granted(): it re-checks the state
+        # and unwinds as cancelled instead of waiting out the grant
+        # timeout (the DELETE route's whole point)
+        ticket._granted.set()
         return True
+
+    def find_ticket(self, ticket_id: str) -> Optional[Ticket]:
+        """Locate a QUEUED ticket by id (granted/released tickets are
+        not findable here — cancellation of granted work goes through
+        the job-level cancel seam)."""
+        for lane_state in self.lanes.values():
+            for queue in lane_state.queues.values():
+                for ticket in queue:
+                    if ticket.ticket_id == ticket_id:
+                        return ticket
+        return None
+
+    def cancel_ticket(self, ticket_id: str) -> bool:
+        """Pre-admission abandon over HTTP: withdraw one queued ticket
+        by id (DELETE /distributed/queue/{ticket_id})."""
+        ticket = self.find_ticket(ticket_id)
+        if ticket is None:
+            return False
+        return self.cancel(ticket)
 
     # --- granting ---------------------------------------------------------
 
@@ -396,6 +444,11 @@ class AdmissionQueue:
                 if self._wait_ewma is None
                 else 0.8 * self._wait_ewma + 0.2 * wait
             )
+            if self.wait_sink is not None:
+                try:
+                    self.wait_sink(wait)
+                except Exception:  # noqa: BLE001 - observability only
+                    pass
             instruments.sched_grants_total().inc(
                 lane=ticket.lane, tenant=ticket.tenant
             )
@@ -516,6 +569,21 @@ class AdmissionQueue:
         depth = self.lanes[lane].depth() if lane in self.lanes else 0
         estimate = service * (depth + 1) / max(self.max_active, 1)
         return float(min(max(round(estimate), 1), 60))
+
+    def estimate_wait(self, lane: str) -> float:
+        """Estimated queue wait for a request admitted to `lane` NOW —
+        the deadline-admission gate's input. Unlike estimate_retry_after
+        this is unclamped and may be 0 (empty queue, free slot: no
+        wait), so short deadlines pass on an idle scheduler."""
+        if len(self.active) < self.max_active and self.queued() == 0:
+            return 0.0
+        service = self._service_ewma if self._service_ewma else 1.0
+        depth = self.lanes[lane].depth() if lane in self.lanes else 0
+        backlog = depth + max(0, len(self.active) - self.max_active + 1)
+        estimate = service * backlog / max(self.max_active, 1)
+        if self._wait_ewma is not None:
+            estimate = max(estimate, self._wait_ewma)
+        return float(estimate)
 
     def queued(self) -> int:
         return sum(lane.depth() for lane in self.lanes.values())

@@ -68,6 +68,56 @@ def store_requeued_tasks_total() -> Counter:
 
 # --- dispatch / orchestration --------------------------------------------
 
+# --- request lifecycle (deadlines / cancel / poison / brownout) -----------
+
+def jobs_cancelled_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_jobs_cancelled_total",
+        "Jobs reaching the terminal cancelled state by reason "
+        "(client|deadline|chaos|...)",
+        ("reason",),
+    )
+
+
+def cancel_refunded_tiles_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_cancel_refunded_tiles_total",
+        "Tiles refunded by job cancellation by kind (pending|in_flight)",
+        ("kind",),
+    )
+
+
+def poison_quarantined_tiles_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_poison_quarantined_tiles_total",
+        "Tiles quarantined out of the pull set after exhausting their "
+        "delivery-attempt budget",
+    )
+
+
+def poison_pardons_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_poison_pardons_total",
+        "Breaker pardons issued to workers whose failures traced to a "
+        "poison-quarantined tile",
+    )
+
+
+def shed_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_shed_total",
+        "Admissions shed by the brownout controller, by lane",
+        ("lane",),
+    )
+
+
+def brownout_level() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_brownout_level",
+        "Current brownout level (number of lowest-priority lanes shed)",
+    )
+
+
 def dispatch_seconds() -> Histogram:
     return get_metrics_registry().histogram(
         "cdt_dispatch_seconds",

@@ -237,12 +237,36 @@ class Watchdog:
                 )
         return stalled
 
+    def check_deadlines(self) -> list[str]:
+        """Drive the store's deadline sweep: jobs whose end-to-end
+        deadline passed are cancelled (reason="deadline") even when no
+        pull traffic is left to trigger the lazy path. Returns the job
+        ids expired by this pass."""
+        store = self.store
+        if store is None or not hasattr(store, "sweep_deadlines"):
+            return []
+        # cheap unlocked guard: don't round-trip the server loop unless
+        # some live job actually carries a deadline
+        if not any(
+            getattr(job, "deadline_at", None) is not None
+            for job in dict(store.tile_jobs).values()
+        ):
+            return []
+        try:
+            from ..utils.async_helpers import run_async_in_server_loop
+
+            return run_async_in_server_loop(store.sweep_deadlines(), timeout=30)
+        except Exception as exc:  # noqa: BLE001 - sweep is best effort
+            debug_log(f"watchdog deadline sweep failed: {exc}")
+            return []
+
     def step(self) -> dict[str, list]:
         """One synchronous detection pass (the thread loop body; tests
         call it directly under a fake clock)."""
         return {
             "stragglers": self.check_stragglers(),
             "stalls": self.check_stalls(),
+            "deadlines": self.check_deadlines(),
         }
 
     # --- default speculation path -----------------------------------------

@@ -145,6 +145,31 @@ SCHED_TAIL_TILES = _env_int("CDT_SCHED_TAIL_TILES", 2)
 # from the tail (it may still pull while the queue is deep).
 SCHED_TRIM_RATIO = _env_float("CDT_SCHED_TRIM_RATIO", 0.5)
 
+# --- request lifecycle armor (deadlines / cancel / poison / brownout) -----
+# Failed delivery attempts (crash/timeout requeues) a single tile may
+# accumulate before it is quarantined out of the pull set as poison —
+# a payload that crashes every worker that touches it must not cascade
+# quarantines across the fleet forever.
+TILE_MAX_ATTEMPTS = _env_int("CDT_TILE_MAX_ATTEMPTS", 3)
+# What a job does when tiles were poison-quarantined: "degrade"
+# completes the job with the quarantined region blended from the base
+# image; "fail" raises a terminal JobPoisoned error instead.
+POISON_POLICY = os.environ.get("CDT_POISON_POLICY", "degrade")
+# Default end-to-end job deadline in seconds applied when a request
+# names none (0 = no default deadline), and the cap clamped onto any
+# client-supplied deadline (0 = uncapped).
+JOB_DEADLINE_DEFAULT_SECONDS = _env_float("CDT_JOB_DEADLINE_DEFAULT", 0.0)
+JOB_DEADLINE_MAX_SECONDS = _env_float("CDT_JOB_DEADLINE_MAX", 0.0)
+# Brownout load-shed controller (scheduler/brownout.py): when queue-wait
+# p95 or journal-append p95 crosses its threshold, admission sheds one
+# more lowest-priority lane (the top lane is never shed); levels step
+# at most once per cooldown and step back down once both signals fall
+# under half their thresholds.
+SHED_WAIT_P95_SECONDS = _env_float("CDT_SHED_WAIT_P95", 20.0)
+SHED_JOURNAL_P95_SECONDS = _env_float("CDT_SHED_JOURNAL_P95", 0.25)
+SHED_WINDOW_SAMPLES = _env_int("CDT_SHED_WINDOW", 64)
+SHED_COOLDOWN_SECONDS = _env_float("CDT_SHED_COOLDOWN", 5.0)
+
 # --- elastic tile pipeline (graph/tile_pipeline.py) -----------------------
 # The elastic USDU worker/master data path runs as a staged pipeline:
 # pull prefetch -> device sampling -> host readback + PNG encode ->

@@ -43,6 +43,31 @@ class JobQueueError(DistributedError):
     """Job queue state is missing or inconsistent."""
 
 
+class JobCancelled(DistributedError):
+    """The job reached a terminal cancelled state (client cancel or
+    deadline expiry) — pending and in-flight tiles were refunded; the
+    master loop unwinds instead of blending a partial canvas."""
+
+    def __init__(self, job_id: str, reason: str = "cancel"):
+        super().__init__(f"job {job_id} cancelled ({reason})")
+        self.job_id = job_id
+        self.reason = reason
+
+
+class JobPoisoned(DistributedError):
+    """CDT_POISON_POLICY=fail and at least one tile exhausted its
+    attempt budget: the job terminates instead of completing with a
+    degraded (base-image) region."""
+
+    def __init__(self, job_id: str, tiles: list[int]):
+        super().__init__(
+            f"job {job_id} poisoned: tile(s) {sorted(tiles)} exhausted "
+            "their attempt budget"
+        )
+        self.job_id = job_id
+        self.tiles = sorted(int(t) for t in tiles)
+
+
 class StaleEpoch(DistributedError):
     """An RPC carried a fencing epoch older than the store's current
     one: its authority predates a master takeover (the fencing-token

@@ -89,6 +89,28 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_DETERMINISTIC_BLEND", "unset", "resilience",
          "`1` forces sorted-order deferred compositing so the blended canvas is "
          "bit-identical regardless of tile arrival order (chaos harness sets it)."),
+    # --- request lifecycle (deadlines / cancel / poison / brownout) ------
+    Knob("CDT_JOB_DEADLINE_DEFAULT", "0.0", "lifecycle",
+         "Default end-to-end job deadline in seconds applied when a request "
+         "names none; 0 = no default deadline."),
+    Knob("CDT_JOB_DEADLINE_MAX", "0.0", "lifecycle",
+         "Cap clamped onto any client-supplied `deadline_s`; 0 = uncapped."),
+    Knob("CDT_POISON_POLICY", "degrade", "lifecycle",
+         "`degrade` completes a job with poison-quarantined tiles blended "
+         "from the base image; `fail` raises a terminal JobPoisoned error."),
+    Knob("CDT_SHED_COOLDOWN", "5.0", "lifecycle",
+         "Seconds between brownout level steps (hysteresis against flapping)."),
+    Knob("CDT_SHED_JOURNAL_P95", "0.25", "lifecycle",
+         "Journal-append p95 seconds above which the brownout controller "
+         "sheds one more lowest-priority lane."),
+    Knob("CDT_SHED_WAIT_P95", "20.0", "lifecycle",
+         "Queue-wait p95 seconds above which the brownout controller sheds "
+         "one more lowest-priority lane (the premium lane never sheds)."),
+    Knob("CDT_SHED_WINDOW", "64", "lifecycle",
+         "Rolling sample window for the brownout controller's p95 signals."),
+    Knob("CDT_TILE_MAX_ATTEMPTS", "3", "lifecycle",
+         "Failed delivery attempts (crash/timeout requeues) a tile may "
+         "accumulate before it is quarantined out of the pull set as poison."),
     # --- watchdog --------------------------------------------------------
     Knob("CDT_WATCHDOG", "1", "watchdog",
          "`0` disables the server's background straggler/stall monitor thread."),

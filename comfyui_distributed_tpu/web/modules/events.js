@@ -18,6 +18,10 @@ export const EVENT_TYPES = [
   "straggler_detected",
   "stall_detected",
   "speculative_requeue",
+  "job_cancelled",
+  "tile_quarantined",
+  "shed",
+  "brownout_level",
 ];
 
 export const MAX_LIVE_EVENTS = 20;
@@ -69,6 +73,18 @@ export function eventLabel(event) {
       return `speculative re-dispatch: job ${d.job_id} tiles [${(
         d.task_ids || []
       ).join(", ")}]`;
+    case "job_cancelled":
+      return `cancelled: job ${d.job_id} (${d.reason}) — refunded ${
+        (d.pending_refunded || 0) + (d.in_flight_refunded || 0)
+      } tile(s)`;
+    case "tile_quarantined":
+      return `poison: job ${d.job_id} tile(s) [${(d.task_ids || []).join(
+        ", "
+      )}] quarantined`;
+    case "shed":
+      return `brownout: lane ${d.lane} shed (level ${d.level})`;
+    case "brownout_level":
+      return `brownout level ${d.direction === "up" ? "↑" : "↓"} ${d.level}`;
     case "events_dropped":
       return `stream dropped ${d.count} event(s) (slow consumer)`;
     default:

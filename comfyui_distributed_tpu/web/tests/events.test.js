@@ -69,6 +69,32 @@ test("labels: watchdog verdicts render, metric deltas stay silent", () => {
   assertEqual(eventLabel({ type: "span_close", data: {} }), null);
 });
 
+test("labels: lifecycle events (cancel / poison / brownout) render", () => {
+  assert(
+    eventLabel({
+      type: "job_cancelled",
+      data: { job_id: "j", reason: "client", pending_refunded: 3, in_flight_refunded: 2 },
+    }).includes("refunded 5 tile(s)")
+  );
+  assert(
+    eventLabel({
+      type: "tile_quarantined",
+      data: { job_id: "j", task_ids: [7] },
+    }).includes("poison")
+  );
+  assert(
+    eventLabel({ type: "shed", data: { lane: "background", level: 1 } }).includes(
+      "background"
+    )
+  );
+  assert(
+    eventLabel({
+      type: "brownout_level",
+      data: { level: 2, direction: "up" },
+    }).includes("2")
+  );
+});
+
 test("backoff: exponential and capped", () => {
   assertEqual(nextRetryDelay(0, 1000, 8000), 1000);
   assertEqual(nextRetryDelay(1, 1000, 8000), 2000);

@@ -171,3 +171,65 @@ def register_signals(loop: asyncio.AbstractEventLoop, config_path: str | None = 
             # non-unix or nested loop: atexit still covers us
             pass
     atexit.register(sync_cleanup, config_path)
+
+
+async def drain_worker(server, grace_seconds: float = 30.0) -> bool:
+    """Graceful worker drain: interrupt the in-flight execution (the
+    tile pipeline finishes its current device batch, flushes encoded
+    tiles, RETURNS the unprocessed remainder via return_tiles, and its
+    final flush marks this worker done on the master), wait up to
+    `grace_seconds` for the executor to settle, then stop the server.
+    Returns True when the executor drained inside the grace window."""
+    server.interrupt()
+    deadline = asyncio.get_running_loop().time() + max(0.0, grace_seconds)
+    drained = True
+    while server._executing.is_set():
+        if asyncio.get_running_loop().time() > deadline:
+            drained = False
+            log(
+                f"worker drain: executor still busy after {grace_seconds}s; "
+                "stopping anyway (the master's heartbeat timeout covers "
+                "whatever was left)"
+            )
+            break
+        await asyncio.sleep(0.1)
+    await server.stop()
+    return drained
+
+
+def register_worker_drain(
+    loop: asyncio.AbstractEventLoop, server, grace_seconds: float = 30.0
+):
+    """SIGTERM/SIGINT on a WORKER process: graceful drain instead of a
+    hard death. Without this, a terminated worker's in-flight grant
+    sits assigned until the master's heartbeat timeout requeues it;
+    with it, the interrupt path hands the tiles back immediately and
+    the worker deregisters via its final flush."""
+    # env flag OR the server's own role: a worker started directly
+    # (not via the process manager's env injection) still drains
+    if not (is_worker_process() or getattr(server, "is_worker", False)):
+        return
+
+    draining = threading.Event()
+
+    def handler():
+        if draining.is_set():
+            # second signal: the operator means it — stop now
+            loop.stop()
+            return
+        draining.set()
+        log("worker received SIGTERM/SIGINT: draining in-flight grant")
+
+        async def _drain_and_stop():
+            try:
+                await drain_worker(server, grace_seconds)
+            finally:
+                loop.stop()
+
+        loop.create_task(_drain_and_stop())
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, handler)
+        except (NotImplementedError, RuntimeError):
+            pass

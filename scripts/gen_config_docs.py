@@ -32,6 +32,7 @@ _SUBSYSTEM_TITLES = {
     "payloads": "Payloads & batching",
     "orchestration": "Orchestration & retries",
     "resilience": "Resilience & fault injection",
+    "lifecycle": "Request lifecycle (deadlines, cancel, poison, brownout)",
     "watchdog": "Watchdog",
     "scheduler": "Scheduler control plane",
     "durability": "Durable control plane",

@@ -1,0 +1,258 @@
+"""Lifecycle-armor HTTP surface: POST /distributed/cancel/{job_id},
+DELETE /distributed/queue/{ticket_id}, deadline parsing (body +
+X-CDT-Deadline header) and the deadline-unmeetable / shed 429s, plus
+the cancelled/deadline fields on the work-pull responses."""
+
+import asyncio
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+PROMPT = {
+    "1": {
+        "class_type": "EmptyLatentImage",
+        "inputs": {"width": 32, "height": 32, "batch_size": 1},
+    }
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _request(method, url, body=None, headers=None, timeout=15):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url,
+        data=data,
+        method=method,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, dict(exc.headers), json.loads(exc.read())
+
+
+@pytest.fixture()
+def server(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port, loop_thread
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def _on_loop(loop_thread, coro, timeout=15):
+    return asyncio.run_coroutine_threadsafe(coro, loop_thread.loop).result(
+        timeout=timeout
+    )
+
+
+# --------------------------------------------------------------------------
+# POST /distributed/cancel/{job_id}
+# --------------------------------------------------------------------------
+
+
+def test_cancel_route_refunds_and_reports_latency(server):
+    srv, port, loop_thread = server
+    _on_loop(loop_thread, srv.job_store.init_tile_job("job-a", [0, 1, 2, 3]))
+    _on_loop(loop_thread, srv.job_store.pull_task("job-a", "w1"))
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/cancel/job-a",
+        body={"reason": "test"},
+    )
+    assert status == 200
+    assert body["status"] == "cancelled"
+    assert body["reason"] == "test"
+    assert body["pending_refunded"] == 3
+    assert body["in_flight_refunded"] == 1
+    assert body["workers"] == ["w1"]
+    assert body["cancel_latency_ms"] >= 0
+    # idempotent: the second cancel reports already_cancelled
+    status, _, body = _request(
+        "POST", f"http://127.0.0.1:{port}/distributed/cancel/job-a"
+    )
+    assert status == 200 and body["already_cancelled"]
+
+
+def test_cancel_route_unknown_job_404(server):
+    _, port, _ = server
+    status, _, body = _request(
+        "POST", f"http://127.0.0.1:{port}/distributed/cancel/nope"
+    )
+    assert status == 404
+
+
+def test_cancelled_job_reads_cancelled_on_pull_and_status(server):
+    srv, port, loop_thread = server
+    _on_loop(loop_thread, srv.job_store.init_tile_job("job-b", [0, 1]))
+    _request("POST", f"http://127.0.0.1:{port}/distributed/cancel/job-b")
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/request_image",
+        body={"job_id": "job-b", "worker_id": "w9"},
+    )
+    assert status == 200
+    assert body["tile_idx"] is None
+    assert body["cancelled"] is True
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/job_status",
+        body={"job_id": "job-b"},
+    )
+    assert status == 200 and body["cancelled"] is True
+
+
+def test_deadline_remaining_rides_the_pull_response(server):
+    srv, port, loop_thread = server
+    _on_loop(
+        loop_thread,
+        srv.job_store.init_tile_job("job-c", [0, 1], deadline_s=60.0),
+    )
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/request_image",
+        body={"job_id": "job-c", "worker_id": "w1"},
+    )
+    assert status == 200
+    assert body["tile_idx"] == 0
+    assert 0 < body["deadline_remaining"] <= 60.0
+
+
+# --------------------------------------------------------------------------
+# DELETE /distributed/queue/{ticket_id}
+# --------------------------------------------------------------------------
+
+
+def test_delete_ticket_cancels_a_queued_admission(server):
+    srv, port, loop_thread = server
+    queue = srv.scheduler.queue
+
+    def stack_tickets():
+        # saturate every grant slot, then park one queued ticket
+        blockers = [
+            queue.submit(tenant="t") for _ in range(queue.max_active)
+        ]
+        parked = queue.submit(tenant="t")
+        return blockers, parked
+
+    blockers, parked = _on_loop(loop_thread, _async(stack_tickets))
+    assert parked.state == "queued"
+    status, _, body = _request(
+        "DELETE",
+        f"http://127.0.0.1:{port}/distributed/queue/{parked.ticket_id}",
+    )
+    assert status == 200 and body["status"] == "cancelled"
+    assert parked.state == "cancelled"
+    # unknown or already-granted tickets answer 404
+    status, _, _ = _request(
+        "DELETE", f"http://127.0.0.1:{port}/distributed/queue/t9999"
+    )
+    assert status == 404
+    status, _, _ = _request(
+        "DELETE",
+        f"http://127.0.0.1:{port}/distributed/queue/{blockers[0].ticket_id}",
+    )
+    assert status == 404
+
+
+async def _async_call(fn):
+    return fn()
+
+
+def _async(fn):
+    return _async_call(fn)
+
+
+# --------------------------------------------------------------------------
+# deadline parsing + admission 429s on the queue route
+# --------------------------------------------------------------------------
+
+
+def test_bad_deadline_body_is_rejected_400(server):
+    _, port, _ = server
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/queue",
+        body={"prompt": PROMPT, "client_id": "c1", "deadline_s": -5},
+    )
+    assert status == 400
+    assert "deadline_s" in body["error"]
+
+
+def test_bad_deadline_header_is_rejected_400(server):
+    _, port, _ = server
+    status, _, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/queue",
+        body={"prompt": PROMPT, "client_id": "c1"},
+        headers={"X-CDT-Deadline": "soon-ish"},
+    )
+    assert status == 400
+    assert "deadline_s" in body["error"]
+
+
+def test_unmeetable_deadline_answers_429(server):
+    srv, port, loop_thread = server
+    queue = srv.scheduler.queue
+
+    def saturate():
+        # full slots + deep backlog + a slow service EWMA: the
+        # estimated wait for a new request far exceeds any short
+        # deadline
+        for _ in range(queue.max_active + 8):
+            queue.submit(tenant="t")
+        queue._service_ewma = 120.0
+
+    _on_loop(loop_thread, _async(saturate))
+    status, headers, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/queue",
+        body={"prompt": PROMPT, "client_id": "c1", "deadline_s": 0.5},
+    )
+    assert status == 429
+    assert body["reason"] == "deadline_unmeetable"
+    assert body["deadline_s"] == 0.5
+    assert "Retry-After" in headers
+
+
+def test_shed_lane_answers_429_with_reason(server):
+    srv, port, loop_thread = server
+    brownout = srv.scheduler.brownout
+
+    def overload():
+        for _ in range(16):
+            brownout.note_queue_wait(10 * brownout.wait_p95_threshold)
+        # force past the cooldown gate regardless of wall timing
+        brownout._last_step = -10_000.0
+        brownout.evaluate()
+
+    _on_loop(loop_thread, _async(overload))
+    lane = srv.scheduler.queue.lane_order[-1]
+    status, headers, body = _request(
+        "POST",
+        f"http://127.0.0.1:{port}/distributed/queue",
+        body={"prompt": PROMPT, "client_id": "c1", "lane": lane},
+    )
+    assert status == 429
+    assert body["reason"] == "shed"
+    assert body["lane"] == lane
+    assert "Retry-After" in headers

@@ -1,0 +1,198 @@
+"""Brownout load shedding + deadline admission, driven on a fake
+clock: the ladder steps under overload (queue-wait p95 / journal p95),
+hysteresis prevents flapping, the premium lane never sheds, and the
+DELETE-ticket / deadline gates reject with the right exception types."""
+
+import pytest
+
+from comfyui_distributed_tpu.scheduler import (
+    BrownoutController,
+    DeadlineUnmeetable,
+    SchedulerControl,
+    SchedulerOverloaded,
+)
+from comfyui_distributed_tpu.scheduler.queue import AdmissionQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+LANES = [("interactive", 8), ("batch", 8), ("background", 8)]
+
+
+def make_control(clock, max_active=1, **brownout_kwargs):
+    queue = AdmissionQueue(lanes=LANES, max_active=max_active, clock=clock)
+    defaults = dict(
+        wait_p95_threshold=1.0, journal_p95_threshold=0.5, cooldown=1.0,
+    )
+    defaults.update(brownout_kwargs)
+    brownout = BrownoutController(queue.lane_order, clock=clock, **defaults)
+    return SchedulerControl(queue=queue, brownout=brownout, clock=clock)
+
+
+class Payload:
+    def __init__(self, lane=None, tenant="t", deadline_s=None, extra=None):
+        self.lane = lane
+        self.tenant = tenant
+        self.trace_id = None
+        self.deadline_s = deadline_s
+        self.extra = extra or {}
+
+
+# --------------------------------------------------------------------------
+# the ladder
+# --------------------------------------------------------------------------
+
+
+def test_ladder_steps_up_from_the_lowest_lane_and_spares_premium():
+    clock = FakeClock()
+    ctl = make_control(clock)
+    b = ctl.brownout
+
+    def overload_now():
+        # ongoing overload keeps feeding samples (premium grants never
+        # stop), so the starvation decay does not engage
+        for _ in range(8):
+            b.note_queue_wait(5.0)
+
+    overload_now()
+    clock.now = 2.0
+    assert b.should_shed("background")
+    assert not b.should_shed("batch")  # cooldown holds level at 1
+    clock.now = 4.0
+    overload_now()
+    assert b.should_shed("batch")  # second step after the cooldown
+    # the premium lane never sheds, whatever the level
+    clock.now = 5.0
+    overload_now()
+    assert not b.should_shed("interactive")
+    assert b.level == 2  # capped at lanes-1
+
+
+def test_signal_starvation_decays_the_level():
+    """Shedding stops the very traffic that feeds the p95 windows: if
+    nothing has fed the controller for 2x the cooldown, the stale
+    overload reading decays instead of latching the lane shut on an
+    idle system."""
+    clock = FakeClock()
+    ctl = make_control(clock)
+    b = ctl.brownout
+    for _ in range(8):
+        b.note_queue_wait(5.0)
+    clock.now = 2.0
+    assert b.should_shed("background")
+    # silence: no grants, no journal appends — past 2x cooldown the
+    # level steps back down and the stale samples are dropped
+    clock.now = 5.0
+    assert not b.should_shed("background")
+    assert b.level == 0
+    assert b.signals() == {"wait_p95": 0.0, "journal_p95": 0.0}
+
+
+def test_journal_latency_alone_triggers_shedding():
+    clock = FakeClock()
+    ctl = make_control(clock)
+    b = ctl.brownout
+    for _ in range(8):
+        b.note_journal_append(2.0)  # >> 0.5s threshold
+    clock.now = 2.0
+    assert b.should_shed("background")
+
+
+def test_hysteresis_steps_back_down_after_recovery():
+    clock = FakeClock()
+    ctl = make_control(clock, window=4)
+    b = ctl.brownout
+    for _ in range(4):
+        b.note_queue_wait(5.0)
+    clock.now = 2.0
+    assert b.should_shed("background")
+    # recovery: fresh fast samples push the p95 under half-threshold
+    for _ in range(4):
+        b.note_queue_wait(0.01)
+    clock.now = 4.0
+    assert not b.should_shed("background")
+    assert b.level == 0
+
+
+def test_shed_rejections_keep_premium_admitting():
+    clock = FakeClock()
+    ctl = make_control(clock)
+    for _ in range(8):
+        ctl.brownout.note_queue_wait(5.0)
+    clock.now = 2.0
+    with pytest.raises(SchedulerOverloaded):
+        ctl.submit_payload(Payload(lane="background"))
+    assert ctl.brownout.shed_counts.get("background", 0) == 1
+    ticket = ctl.submit_payload(Payload(lane="interactive"))
+    assert ticket.state == "granted"
+    # premium grant latency stayed bounded: granted instantly (no wait)
+    assert ticket.queue_wait_seconds == 0.0
+    assert "background" in ctl.status()["brownout"]["shed_lanes"]
+
+
+def test_unknown_lane_sheds_as_the_lowest_class():
+    clock = FakeClock()
+    ctl = make_control(clock)
+    for _ in range(8):
+        ctl.brownout.note_queue_wait(5.0)
+    clock.now = 2.0
+    with pytest.raises(SchedulerOverloaded):
+        ctl.submit_payload(Payload(lane="no-such-lane"))
+
+
+# --------------------------------------------------------------------------
+# deadline admission
+# --------------------------------------------------------------------------
+
+
+def test_deadline_passes_on_an_idle_scheduler():
+    clock = FakeClock()
+    ctl = make_control(clock, max_active=2)
+    ticket = ctl.submit_payload(Payload(deadline_s=0.5))
+    assert ticket.state == "granted"
+
+
+def test_unmeetable_deadline_rejected_at_admission():
+    clock = FakeClock()
+    ctl = make_control(clock, max_active=1)
+    # saturate the single slot and stack a backlog whose service EWMA
+    # makes the estimated wait large
+    first = ctl.submit_payload(Payload())
+    assert first.state == "granted"
+    for _ in range(4):
+        ctl.submit_payload(Payload())
+    clock.now = 10.0
+    ctl.queue.release(first)  # service EWMA = 10s per request
+    with pytest.raises(DeadlineUnmeetable) as err:
+        ctl.submit_payload(Payload(deadline_s=0.2))
+    assert err.value.deadline_s == 0.2
+    assert err.value.estimated_wait > 0.2
+    # the same request WITHOUT a deadline is admitted fine
+    assert ctl.submit_payload(Payload()).state in ("queued", "granted")
+
+
+# --------------------------------------------------------------------------
+# pre-admission ticket cancel
+# --------------------------------------------------------------------------
+
+
+def test_cancel_ticket_by_id_wakes_the_grant_waiter():
+    clock = FakeClock()
+    queue = AdmissionQueue(lanes=LANES, max_active=1, clock=clock)
+    blocker = queue.submit(tenant="t")  # takes the only slot
+    parked = queue.submit(tenant="t")
+    assert parked.state == "queued"
+    assert queue.cancel_ticket(parked.ticket_id)
+    assert parked.state == "cancelled"
+    # the waiter's event fired so a parked request unwinds immediately
+    assert parked._granted.is_set()
+    # unknown / already-granted ids are not cancellable
+    assert not queue.cancel_ticket("t999")
+    assert not queue.cancel_ticket(blocker.ticket_id)
+    assert queue.totals["cancelled"] == 1

@@ -1,0 +1,313 @@
+"""Request-lifecycle armor at the JobStore seam: cooperative
+cancellation (refund accounting, terminal drops), end-to-end deadlines
+(lazy expiry + sweep), poison-tile quarantine (attempt budget, pardon
+hook, degraded completion accounting), and the journal/replica parity
+of the new record types (cancel, tile_quarantine, deadline on
+job_init)."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.durability import state as dstate
+from comfyui_distributed_tpu.jobs import JobStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --------------------------------------------------------------------------
+# cooperative cancellation
+# --------------------------------------------------------------------------
+
+
+def test_cancel_refunds_pending_and_in_flight():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", list(range(8)))
+        for wid in ("w1", "w2"):
+            assert await store.pull_task("j", wid) is not None
+        acct = await store.cancel_job("j", reason="client")
+        assert acct["pending_refunded"] == 6
+        assert acct["in_flight_refunded"] == 2
+        assert acct["workers"] == ["w1", "w2"]
+        stats = await store.stats()
+        assert stats["queue_depth"] == 0
+        assert stats["in_flight"] == 0
+
+    run(body())
+
+
+def test_cancel_is_idempotent_and_terminal():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0, 1])
+        t = await store.pull_task("j", "w1")
+        first = await store.cancel_job("j")
+        assert not first["already_cancelled"]
+        again = await store.cancel_job("j")
+        assert again["already_cancelled"]
+        # terminal: pulls read drained, submits drop, releases no-op
+        assert await store.pull_task("j", "w1") is None
+        assert not await store.submit_result("j", "w1", t, None)
+        assert await store.release_tasks("j", "w1", [t]) == []
+        job = await store.get_tile_job("j")
+        assert t not in job.completed
+
+    run(body())
+
+
+def test_cancel_unknown_job_returns_none():
+    async def body():
+        store = JobStore()
+        assert await store.cancel_job("nope") is None
+
+    run(body())
+
+
+def test_cancel_record_is_journaled_before_ack():
+    async def body():
+        records = []
+        store = JobStore()
+        store.journal_sink = records.append
+        await store.init_tile_job("j", [0, 1, 2])
+        await store.pull_task("j", "w1")
+        await store.cancel_job("j", reason="deadline")
+        kinds = [r["type"] for r in records]
+        assert kinds == ["job_init", "pull", "cancel"]
+        assert records[-1] == {"type": "cancel", "job": "j", "reason": "deadline"}
+
+    run(body())
+
+
+# --------------------------------------------------------------------------
+# deadlines
+# --------------------------------------------------------------------------
+
+
+def test_deadline_expires_lazily_on_pull():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0, 1], deadline_s=0.01)
+        await asyncio.sleep(0.03)
+        assert await store.pull_task("j", "w1") is None
+        job = await store.get_tile_job("j")
+        assert job.cancelled and job.cancel_reason == "deadline"
+
+    run(body())
+
+
+def test_deadline_sweep_expires_only_overdue_jobs():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("overdue", [0], deadline_s=0.01)
+        await store.init_tile_job("fine", [0], deadline_s=60.0)
+        await store.init_tile_job("none", [0])
+        await asyncio.sleep(0.03)
+        expired = await store.sweep_deadlines()
+        assert expired == ["overdue"]
+        assert not (await store.get_tile_job("fine")).cancelled
+        assert not (await store.get_tile_job("none")).cancelled
+        # a second sweep is a no-op (already terminal)
+        assert await store.sweep_deadlines() == []
+
+    run(body())
+
+
+def test_note_job_deadline_arms_later_init():
+    async def body():
+        store = JobStore()
+        store.note_job_deadline("j", 45.0)
+        store.note_job_deadline("bogus", "not-a-number")  # ignored
+        job = await store.init_tile_job("j", [0, 1])
+        assert job.deadline_s == 45.0
+        assert job.deadline_remaining() is not None
+        # consumed: a later unrelated job does not inherit it
+        other = await store.init_tile_job("k", [0])
+        assert other.deadline_s is None
+
+    run(body())
+
+
+def test_job_init_journal_record_carries_deadline():
+    async def body():
+        records = []
+        store = JobStore()
+        store.journal_sink = records.append
+        await store.init_tile_job("j", [0], deadline_s=30.0)
+        assert records[0]["deadline_s"] == 30.0
+
+    run(body())
+
+
+# --------------------------------------------------------------------------
+# poison-tile quarantine
+# --------------------------------------------------------------------------
+
+
+def _crash_worker(store, job_id, wid):
+    """Pull one tile as `wid` then simulate its death (quarantine-path
+    requeue, the same seam the circuit breaker uses)."""
+
+    async def body():
+        tid = await store.pull_task(job_id, wid)
+        await store.requeue_worker_tasks(wid, job_id)
+        return tid
+
+    return run(body())
+
+
+def test_tile_quarantined_after_max_attempts_and_victims_pardoned():
+    store = JobStore(max_attempts=2)
+    pardoned = []
+    store.poison_pardon = pardoned.extend
+    run(store.init_tile_job("p", [0]))
+    _crash_worker(store, "p", "a")
+    _crash_worker(store, "p", "b")
+    job = run(store.get_tile_job("p"))
+    assert job.quarantined_tiles == {0}
+    assert job.attempts[0] == 2
+    assert pardoned == ["a", "b"]
+    # quarantined = settled: the job is complete (degraded)
+    assert run(store.is_complete("p"))
+    assert run(store.pull_task("p", "c")) is None  # nothing left to pull
+
+
+def test_released_tiles_do_not_charge_the_poison_budget():
+    async def body():
+        store = JobStore(max_attempts=1)
+        await store.init_tile_job("p", [0])
+        for wid in ("a", "b", "c"):
+            tid = await store.pull_task("p", wid)
+            assert tid == 0
+            # voluntary hand-back (graceful drain): NOT an attempt
+            assert await store.release_tasks("p", wid, [0]) == [0]
+        job = await store.get_tile_job("p")
+        assert job.quarantined_tiles == set()
+        assert job.attempts == {}
+
+    run(body())
+
+
+def test_late_completion_settles_a_quarantined_tile_once():
+    async def body():
+        store = JobStore(max_attempts=1)
+        await store.init_tile_job("p", [0])
+        tid = await store.pull_task("p", "a")
+        # speculated copy claimed by b BEFORE a's death poisons the tile
+        await store.speculate_in_flight("p")
+        b_tid = await store.pull_task("p", "b")
+        assert b_tid == tid
+        await store.requeue_worker_tasks("a", "p")
+        job = await store.get_tile_job("p")
+        assert job.quarantined_tiles == {0}
+        # b's late result still lands: first real completion wins and
+        # the quarantine is dropped so the tile counts exactly once
+        assert await store.submit_result("p", "b", 0, None)
+        assert job.quarantined_tiles == set()
+        assert await store.is_complete("p")
+
+    run(body())
+
+
+def test_quarantine_journal_records_replay_to_same_state():
+    records = []
+    store = JobStore(max_attempts=2)
+    store.journal_sink = records.append
+    run(store.init_tile_job("p", [0]))
+    _crash_worker(store, "p", "a")  # pulls 0, dies
+    _crash_worker(store, "p", "b")  # pulls the requeued 0, dies
+    kinds = [r["type"] for r in records]
+    assert kinds.count("tile_quarantine") == 1
+    state = dstate.new_state()
+    for record in records:
+        dstate.apply_record(state, record)
+    job = state["jobs"]["p"]
+    assert job["quarantined"] == [0]
+    assert job["attempts"] == {"0": 2}
+    assert 0 not in job["pending"]
+    # prepare_for_restart keeps the quarantine settled (no re-run)
+    stats = dstate.prepare_for_restart(state)
+    assert 0 not in state["jobs"]["p"]["pending"]
+    materialized = dstate.materialize(state)["p"]
+    assert materialized.quarantined_tiles == {0}
+    assert materialized.attempts == {0: 2}
+    assert stats["jobs_cancelled"] == 0
+
+
+# --------------------------------------------------------------------------
+# crash-after-cancel recovery + replica parity
+# --------------------------------------------------------------------------
+
+
+def test_crash_after_cancel_recovers_to_the_same_terminal_state(tmp_path):
+    from comfyui_distributed_tpu.durability import (
+        DurabilityManager,
+        StandbyReplica,
+    )
+
+    journal_dir = str(tmp_path / "wal")
+
+    async def phase1():
+        store = JobStore()
+        manager = DurabilityManager(journal_dir, fsync_every=0)
+        store.journal_sink = manager.record
+        sub = manager.subscribe_replica()
+        replica = StandbyReplica()
+        replica.reset(sub.snapshot_state, sub.head_lsn, sub.epoch)
+        await store.init_tile_job("j", [0, 1, 2], deadline_s=60.0)
+        await store.pull_task("j", "w1")
+        await store.cancel_job("j", reason="client")
+        # "crash": the store is abandoned before any cleanup record
+        for record in sub.pop(max_items=10000):
+            replica.apply(record)
+        manager.close()
+        return replica
+
+    replica = run(phase1())
+    # the replica applied the cancel: terminal drained state
+    rjob = replica._state["jobs"]["j"]
+    assert rjob["cancelled"] and rjob["pending"] == [] and rjob["assigned"] == {}
+
+    async def phase2():
+        store = JobStore()
+        manager = DurabilityManager(journal_dir, fsync_every=0)
+        report = manager.recover(store)
+        manager.close()
+        return store, report
+
+    store2, report = run(phase2())
+    # the cancelled job is NOT resurrected (nothing requeued from it)
+    assert "j" not in store2.tile_jobs
+    assert report.jobs_cancelled == 1
+    assert report.tasks_requeued == 0
+
+
+def test_recovered_job_rearms_its_deadline(tmp_path):
+    from comfyui_distributed_tpu.durability import DurabilityManager
+
+    journal_dir = str(tmp_path / "wal")
+
+    async def phase1():
+        store = JobStore()
+        manager = DurabilityManager(journal_dir, fsync_every=0)
+        store.journal_sink = manager.record
+        await store.init_tile_job("j", [0, 1], deadline_s=90.0)
+        manager.close()
+
+    run(phase1())
+
+    async def phase2():
+        store = JobStore()
+        manager = DurabilityManager(journal_dir, fsync_every=0)
+        manager.recover(store)
+        manager.close()
+        return store
+
+    store2 = run(phase2())
+    job = store2.tile_jobs["j"]
+    assert job.deadline_s == 90.0
+    assert job.deadline_at is not None
+    remaining = job.deadline_remaining()
+    assert remaining is not None and 80.0 < remaining <= 90.0

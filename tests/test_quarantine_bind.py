@@ -1,0 +1,145 @@
+"""Failure-path coverage for ``resilience.bind_quarantine_requeue``:
+the requeue-task-raises branch, the cancelled-task branch, and the
+no-running-loop fallback — previously untested seams of the
+breaker→store wiring (ISSUE 10 satellite)."""
+
+import asyncio
+import threading
+from unittest import mock
+
+import pytest
+
+from comfyui_distributed_tpu import resilience
+from comfyui_distributed_tpu.resilience import bind_quarantine_requeue
+from comfyui_distributed_tpu.resilience.health import HealthRegistry, WorkerState
+
+
+class ExplodingStore:
+    """requeue_worker_tasks raises — the done-callback must log, not
+    crash the transport path that drove the transition."""
+
+    def __init__(self, exc=RuntimeError("store on fire")):
+        self.exc = exc
+        self.calls = 0
+
+    async def requeue_worker_tasks(self, worker_id, job_id=None):
+        self.calls += 1
+        raise self.exc
+
+
+class SlowStore:
+    """requeue_worker_tasks parks until released — lets the test
+    cancel the in-flight requeue task deterministically."""
+
+    def __init__(self):
+        self.started = asyncio.Event()
+        self.release = asyncio.Event()
+        self.finished = False
+
+    async def requeue_worker_tasks(self, worker_id, job_id=None):
+        self.started.set()
+        await self.release.wait()
+        self.finished = True
+        return {}
+
+
+def _quarantine(registry: HealthRegistry, worker_id: str) -> None:
+    for _ in range(registry.failure_threshold):
+        registry.record_failure(worker_id)
+    assert registry.state(worker_id) is WorkerState.QUARANTINED
+
+
+def test_requeue_exception_is_logged_not_raised():
+    async def body():
+        registry = HealthRegistry(failure_threshold=1, suspect_threshold=1)
+        store = ExplodingStore()
+        unbind = bind_quarantine_requeue(registry, store)
+        try:
+            with mock.patch.object(resilience, "debug_log") as dbg:
+                _quarantine(registry, "w1")
+                # let the fire-and-forget task run and its done
+                # callback observe the exception
+                for _ in range(10):
+                    await asyncio.sleep(0)
+                assert store.calls == 1
+                assert any(
+                    "quarantine requeue for w1 failed" in str(c.args[0])
+                    for c in dbg.call_args_list
+                ), dbg.call_args_list
+        finally:
+            unbind()
+
+    asyncio.run(body())
+
+
+def test_cancelled_requeue_task_is_swallowed():
+    async def body():
+        registry = HealthRegistry(failure_threshold=1, suspect_threshold=1)
+        store = SlowStore()
+        unbind = bind_quarantine_requeue(registry, store)
+        try:
+            with mock.patch.object(resilience, "debug_log") as dbg:
+                _quarantine(registry, "w1")
+                await asyncio.wait_for(store.started.wait(), timeout=5)
+                # cancel the in-flight requeue task (shutdown racing a
+                # quarantine): the done callback must treat a cancelled
+                # task as "no exception", not call task.exception()
+                victim = [
+                    t
+                    for t in asyncio.all_tasks()
+                    if t is not asyncio.current_task()
+                ]
+                assert victim, "requeue task not found"
+                for t in victim:
+                    t.cancel()
+                for _ in range(10):
+                    await asyncio.sleep(0)
+                assert not store.finished
+                assert not any(
+                    "failed" in str(c.args[0]) for c in dbg.call_args_list
+                ), dbg.call_args_list
+        finally:
+            unbind()
+
+    asyncio.run(body())
+
+
+def test_no_loop_fallback_failure_is_logged(monkeypatch):
+    """Transition fired from a plain thread with no running loop AND
+    the server-loop hop failing: the RuntimeError branch must log and
+    swallow, never propagate into record_failure."""
+    registry = HealthRegistry(failure_threshold=1, suspect_threshold=1)
+    store = ExplodingStore()
+    unbind = bind_quarantine_requeue(registry, store)
+    logged = []
+    monkeypatch.setattr(resilience, "debug_log", lambda msg: logged.append(msg))
+    try:
+        errors = []
+
+        def from_thread():
+            try:
+                _quarantine(registry, "w2")
+            except Exception as exc:  # noqa: BLE001 - must not happen
+                errors.append(exc)
+
+        thread = threading.Thread(target=from_thread)
+        thread.start()
+        thread.join(timeout=10)
+        assert not errors
+        assert any("quarantine requeue for w2 failed" in m for m in logged), logged
+    finally:
+        unbind()
+
+
+def test_unbind_detaches_the_listener():
+    async def body():
+        registry = HealthRegistry(failure_threshold=1, suspect_threshold=1)
+        store = ExplodingStore()
+        unbind = bind_quarantine_requeue(registry, store)
+        unbind()
+        _quarantine(registry, "w1")
+        for _ in range(10):
+            await asyncio.sleep(0)
+        assert store.calls == 0
+
+    asyncio.run(body())

@@ -1,0 +1,105 @@
+"""Graceful worker drain on SIGTERM/SIGINT (ISSUE 10 satellite):
+``drain_worker`` interrupts the in-flight execution, waits for the
+executor to settle, and stops the server; ``register_worker_drain``
+installs the handlers only on worker processes."""
+
+import asyncio
+import threading
+
+import pytest
+
+from comfyui_distributed_tpu.workers.startup import (
+    drain_worker,
+    register_worker_drain,
+)
+
+
+class FakeServer:
+    def __init__(self):
+        self.interrupted = False
+        self.stopped = False
+        self._executing = threading.Event()
+
+    def interrupt(self):
+        self.interrupted = True
+
+    async def stop(self):
+        self.stopped = True
+
+
+def test_drain_worker_interrupts_waits_and_stops():
+    async def body():
+        server = FakeServer()
+        server._executing.set()
+
+        async def finish_soon():
+            await asyncio.sleep(0.05)
+            server._executing.clear()
+
+        asyncio.get_running_loop().create_task(finish_soon())
+        drained = await drain_worker(server, grace_seconds=5.0)
+        assert drained
+        assert server.interrupted and server.stopped
+
+    asyncio.run(body())
+
+
+def test_drain_worker_gives_up_after_grace_but_still_stops():
+    async def body():
+        server = FakeServer()
+        server._executing.set()  # never clears: a wedged execution
+        drained = await drain_worker(server, grace_seconds=0.2)
+        assert not drained
+        assert server.interrupted and server.stopped
+
+    asyncio.run(body())
+
+
+def test_register_worker_drain_is_worker_only(monkeypatch):
+    monkeypatch.delenv("CDT_IS_WORKER", raising=False)
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        calls = []
+        monkeypatch.setattr(
+            loop, "add_signal_handler", lambda *a, **k: calls.append(a)
+        )
+        register_worker_drain(loop, FakeServer())
+        assert calls == []  # master process: untouched
+
+    asyncio.run(body())
+
+
+def test_register_worker_drain_installs_handlers_on_workers(monkeypatch):
+    monkeypatch.setenv("CDT_IS_WORKER", "1")
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        installed = {}
+        monkeypatch.setattr(
+            loop,
+            "add_signal_handler",
+            lambda sig, cb: installed.setdefault(sig, cb),
+        )
+        server = FakeServer()
+        register_worker_drain(loop, server, grace_seconds=1.0)
+        import signal
+
+        assert set(installed) == {signal.SIGINT, signal.SIGTERM}
+        # first signal: drain task scheduled (interrupt + stop).
+        # loop.stop is shadowed with a recorder ONLY for the drain's
+        # duration — run_until_complete itself relies on the real stop.
+        stopped = []
+        loop.stop = lambda: stopped.append(True)
+        try:
+            installed[signal.SIGTERM]()
+            for _ in range(50):
+                await asyncio.sleep(0.01)
+                if stopped:
+                    break
+        finally:
+            del loop.stop  # un-shadow the real method
+        assert server.interrupted and server.stopped
+        assert stopped  # the loop was asked to stop after the drain
+
+    asyncio.run(body())
