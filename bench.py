@@ -118,6 +118,54 @@ _BEST: dict | None = None
 _PROBE_ATTEMPTS: list[dict] = []
 _TIMELINE: list[dict] = []
 _LIVE_CHILDREN: list = []  # Popen objects (own sessions) to kill on expiry
+# Why no probe ran (platform override / env opt-out / child mode):
+# stamped into the datum's probe block so a proberless round is
+# distinguishable from a silently-skipped one.
+_PROBE_SKIP_REASON: str | None = None
+
+
+def _probe_block() -> dict:
+    """Normalized probe diagnostics stamped into EVERY datum (BENCH_r05
+    / ROADMAP perf note: four fallback rounds were undiagnosable from
+    the JSON alone). Shape:
+
+        {outcome: ok|timeout|crash|skipped, attempts, timeout_s,
+         elapsed_s, stage_timings: {stage: seconds}, stderr_tail,
+         skip_reason?, history: [per-attempt dicts]}
+
+    `stage_timings` parses the staged child's phase ledger ("env at
+    0.0s | ...") so a timeout names how far backend init got; the
+    stderr tail carries the faulthandler stack dump when one was
+    harvested."""
+    if not _PROBE_ATTEMPTS:
+        block: dict = {"outcome": "skipped", "attempts": 0}
+        if _PROBE_SKIP_REASON:
+            block["skip_reason"] = _PROBE_SKIP_REASON
+        return block
+    last = _PROBE_ATTEMPTS[-1]
+    outcome = {"ok": "ok", "timeout": "timeout", "failed": "crash"}.get(
+        last.get("status"), str(last.get("status"))
+    )
+    stage_timings: dict[str, float] = {}
+    for entry in last.get("phases", []):
+        # "devices at 2.0s | [...]" -> ("devices", 2.0)
+        head = entry.split(" | ", 1)[0]
+        name, sep, at = head.rpartition(" at ")
+        if not sep or not at.endswith("s"):
+            continue
+        try:
+            stage_timings[name] = float(at[:-1])
+        except ValueError:
+            continue
+    return {
+        "outcome": outcome,
+        "attempts": len(_PROBE_ATTEMPTS),
+        "timeout_s": last.get("timeout_s"),
+        "elapsed_s": last.get("elapsed_s"),
+        "stage_timings": stage_timings,
+        "stderr_tail": str(last.get("diagnostics", ""))[-2048:],
+        "history": list(_PROBE_ATTEMPTS),
+    }
 
 def _probe_child() -> None:
     """BENCH_MODE=probe child: staged backend init with forensics.
@@ -402,20 +450,25 @@ def _init_jax() -> tuple:
     except Exception:  # noqa: BLE001 - cache is an optimization
         pass
 
+    global _PROBE_SKIP_REASON
     if (
         os.environ.get("BENCH_CPU") == "1"
         or os.environ.get("BENCH_MODE") == "virtual8"
     ):
         jax.config.update("jax_platforms", "cpu")
+        _PROBE_SKIP_REASON = "cpu_pinned"
         attempt = os.environ.get("BENCH_ATTEMPT", "")
         return jax, ("cpu_fallback" if attempt.startswith("tiny_cpu") else "cpu")
     if os.environ.get("BENCH_PLATFORM"):
         # explicit platform override (testing / forcing a backend)
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        _PROBE_SKIP_REASON = "platform_override"
         return jax, "accelerator"
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
     # probe_timeout <= 0 disables the probe (orchestrated children and
     # trusted-healthy hosts: skip the duplicate backend init it costs)
+    if probe_timeout <= 0:
+        _PROBE_SKIP_REASON = "disabled_by_env"
     status = "ok" if probe_timeout <= 0 else _probe_accelerator(probe_timeout)
     if status != "ok":
         _warn_probe_failure(status, probe_timeout)
@@ -1125,8 +1178,7 @@ def _emit(result: dict) -> None:
     parses the LAST JSON line, so later (better/enriched) lines win."""
     global _BEST
     out = dict(result)
-    if _PROBE_ATTEMPTS:
-        out["probe"] = _PROBE_ATTEMPTS
+    out["probe"] = _probe_block()
     if _TIMELINE:
         out["timeline"] = list(_TIMELINE)
     _BEST = out
@@ -1151,11 +1203,12 @@ def _install_wall_clock() -> float:
                 pass
         _TIMELINE.append({"phase": "wall_expired", "at_s": round(wall, 1)})
         if _BEST is not None:
-            out = dict(_BEST, wall_exceeded=True, timeline=list(_TIMELINE))
-            if _PROBE_ATTEMPTS:
-                # probe attempts recorded after the last _emit would
-                # otherwise vanish from the final line
-                out["probe"] = _PROBE_ATTEMPTS
+            # probe attempts recorded after the last _emit would
+            # otherwise vanish from the final line
+            out = dict(
+                _BEST, wall_exceeded=True, timeline=list(_TIMELINE),
+                probe=_probe_block(),
+            )
         else:
             out = {
                 "metric": "bench wall budget exceeded before any datum",
@@ -1163,7 +1216,7 @@ def _install_wall_clock() -> float:
                 "unit": None,
                 "vs_baseline": None,
                 "wall_exceeded": True,
-                "probe": _PROBE_ATTEMPTS,
+                "probe": _probe_block(),
                 "timeline": list(_TIMELINE),
             }
         print(json.dumps(out), flush=True)
@@ -1212,14 +1265,17 @@ def _orchestrate() -> None:
     # -- Phase 2: ONE accelerator probe -------------------------------
     best_accel: dict | None = None
     probing_enabled = False
+    global _PROBE_SKIP_REASON
     if os.environ.get("BENCH_PLATFORM"):
         probe_status = "ok"  # children will run the forced platform
+        _PROBE_SKIP_REASON = "platform_override"
         record("probe", "skipped_platform_override")
     else:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 600))
         probe_timeout = min(probe_timeout, max(remaining() - 120, 30))
         if probe_timeout <= 0:
             probe_status = "ok"
+            _PROBE_SKIP_REASON = "disabled_by_env"
             record("probe", "skipped_by_env")
         else:
             probing_enabled = True
@@ -1473,8 +1529,7 @@ def main() -> None:
             float(os.environ.get("BENCH_SCALING_TIMEOUT", 900))
         )
         _apply_scaling(result, scaling)
-    if _PROBE_ATTEMPTS:
-        result["probe"] = _PROBE_ATTEMPTS
+    result["probe"] = _probe_block()
     print(json.dumps(result))
 
 

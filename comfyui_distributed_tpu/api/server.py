@@ -131,6 +131,38 @@ class DistributedServer:
                 sink(worker_id, seconds)
 
         self.job_store.latency_sink = _latency_fan_out
+        # Fleet observability plane (telemetry/fleet.py + slo.py):
+        # masters aggregate worker snapshots piggybacked on the
+        # heartbeat/request_image RPCs, retain the load-bearing series,
+        # and evaluate burn-rate SLO alerts. CDT_FLEET=0 disables the
+        # whole plane (routes answer enabled=false).
+        from ..telemetry import FleetMonitor, FleetRegistry, SLOEngine
+        from ..utils.constants import FLEET_ENABLED
+
+        self.fleet: Optional[FleetRegistry] = None
+        self.slo: Optional[SLOEngine] = None
+        self._fleet_monitor: Optional[FleetMonitor] = None
+        if FLEET_ENABLED and not self.is_worker:
+            self.slo = SLOEngine()
+            self.fleet = FleetRegistry()
+            self.fleet.bind_master(
+                scheduler=self.scheduler,
+                job_store=self.job_store,
+                slo=self.slo,
+            )
+            self._fleet_monitor = FleetMonitor(self.fleet, slo=self.slo)
+            # tile pull→submit latencies feed the latency SLO through
+            # the same fan-out the watchdog and placement consume
+            slo_engine = self.slo
+            sinks.append(
+                lambda _wid, sec: slo_engine.note_latency(
+                    "tile_latency", sec
+                )
+            )
+            # departed-worker eviction: when placement or the breaker
+            # registry forgets a worker, its fleet series depart too
+            self.scheduler.placement.on_forget = self.fleet.forget_worker
+            get_health_registry().on_forget = self.fleet.forget_worker
         # Durable control plane (durability/): enabled by setting
         # CDT_JOURNAL_DIR on a master. Construction is cheap and
         # file-free; recovery + the write-ahead seam attach in start(),
@@ -147,10 +179,23 @@ class DistributedServer:
             )
             # journal-append latency is the brownout controller's
             # second overload signal (a saturated fsync path sheds
-            # low-priority lanes before the master tips over)
-            self.durability.append_latency_sink = (
-                self.scheduler.brownout.note_journal_append
-            )
+            # low-priority lanes before the master tips over) — and the
+            # journal-latency SLO's sample stream when the fleet plane
+            # is on
+            journal_sinks = [self.scheduler.brownout.note_journal_append]
+            if self.slo is not None:
+                slo_engine = self.slo
+                journal_sinks.append(
+                    lambda sec: slo_engine.note_latency(
+                        "journal_latency", sec
+                    )
+                )
+
+            def _journal_latency_fan_out(seconds: float) -> None:
+                for sink in journal_sinks:
+                    sink(seconds)
+
+            self.durability.append_latency_sink = _journal_latency_fan_out
         # Warm-standby mode (--standby / CDT_STANDBY_OF): this master
         # tails the active's journal stream instead of recovering from
         # disk, and promotes itself when the active's lease expires
@@ -447,6 +492,8 @@ class DistributedServer:
         self._unbind_telemetry = bind_server_collectors(self)
         if self._watchdog_enabled:
             self.watchdog.start()
+        if self._fleet_monitor is not None:
+            self._fleet_monitor.start()
         self._executor_thread = threading.Thread(
             target=self._executor_loop, name="cdt-executor", daemon=True
         )
@@ -536,6 +583,16 @@ class DistributedServer:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.watchdog.stop
             )
+        if self._fleet_monitor is not None:
+            # pure thread join: the monitor's step touches only the
+            # series store and the bus (non-blocking), never this loop
+            self._fleet_monitor.stop()
+        if self.fleet is not None:
+            # global-registry hooks must not outlive this server
+            from ..resilience.health import get_health_registry as _ghr
+
+            if _ghr().on_forget == self.fleet.forget_worker:
+                _ghr().on_forget = None
         self._unbind_health()
         self._unbind_telemetry()
         self._prompt_queue.put(None)

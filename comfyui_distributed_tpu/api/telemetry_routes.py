@@ -5,6 +5,9 @@
     GET /distributed/traces             — paginated trace-id listing
     GET /distributed/events             — WebSocket live event stream
     GET /distributed/durability         — WAL/snapshot/recovery status
+    GET /distributed/fleet              — fleet rollups + per-worker
+                                          drill-down (+ ?since= history)
+    GET /distributed/alerts             — SLO burn-rate alert states
 
 The metrics body is the process-global registry (counters/histograms
 pushed by the instrumented layers, live-state gauges filled at scrape
@@ -23,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import math
 from typing import Any
 
 from aiohttp import web
@@ -60,6 +64,8 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/traces", routes.traces)
     app.router.add_get("/distributed/events", routes.events)
     app.router.add_get("/distributed/durability", routes.durability)
+    app.router.add_get("/distributed/fleet", routes.fleet)
+    app.router.add_get("/distributed/alerts", routes.alerts)
 
 
 class TelemetryRoutes:
@@ -105,6 +111,59 @@ class TelemetryRoutes:
         elif getattr(self.server, "deposed", False):
             status["role"] = "deposed"
         return web.json_response(status)
+
+    async def fleet(self, request: web.Request) -> web.Response:
+        """Fleet observability rollups + per-worker drill-down
+        (docs/observability.md §Fleet). Query params:
+
+        - ``since=SECONDS`` — adds windowed history for the retained
+          series (raw 10 s tier while it covers the window, 5 min
+          rollups beyond);
+        - ``worker=ID`` — scopes drill-down + history to one worker.
+        """
+        registry = getattr(self.server, "fleet", None)
+        if registry is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "fleet plane runs on masters with CDT_FLEET=1"}
+            )
+        since_param = request.query.get("since")
+        since_s: float | None = None
+        if since_param is not None:
+            try:
+                since_s = float(since_param)
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "since must be a number of seconds"},
+                    status=400,
+                )
+            # NaN passes every comparison below and Infinity survives
+            # them — both would serialize as non-standard JSON tokens
+            # that break strict clients' JSON.parse
+            if not math.isfinite(since_s) or since_s < 0:
+                return web.json_response(
+                    {"error": "since must be a finite number >= 0"},
+                    status=400,
+                )
+        payload = registry.status(
+            since_s=since_s, worker=request.query.get("worker")
+        )
+        payload["enabled"] = True
+        return web.json_response(payload)
+
+    async def alerts(self, request: web.Request) -> web.Response:
+        """SLO burn-rate alert engine state: every spec's current burn
+        evaluation, the open alerts, and the bounded transition history
+        (runbook §4i reads this first when `alert_fired` lands)."""
+        engine = getattr(self.server, "slo", None)
+        if engine is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "SLO engine runs on masters with CDT_FLEET=1"}
+            )
+        payload = engine.status()
+        payload["enabled"] = True
+        return web.json_response(payload)
 
     async def trace(self, request: web.Request) -> web.Response:
         trace_id = request.match_info["trace_id"]

@@ -60,6 +60,18 @@ class UsduRoutes:
     def __init__(self, server):
         self.server = server
 
+    def _note_telemetry(self, worker_id: str, body: dict) -> None:
+        """Piggybacked worker telemetry snapshot (fleet plane): merged
+        AFTER fencing passed — a zombie's stale authority must not even
+        skew the fleet view — and only on masters running the
+        FleetRegistry. Advisory: a malformed snapshot is counted and
+        dropped, never an RPC error."""
+        registry = getattr(self.server, "fleet", None)
+        snapshot = body.get("telemetry")
+        if registry is None or snapshot is None:
+            return
+        registry.note_snapshot(worker_id, snapshot)
+
     def _standby_rejection(self) -> Optional[web.Response]:
         """Work-RPC gate for warm standbys: until promotion, this
         process's store is a replica, not the authority — answering a
@@ -97,6 +109,7 @@ class UsduRoutes:
             self.server.job_store.note_worker_capacity(
                 str(body["worker_id"]), body["devices"]
             )
+        self._note_telemetry(str(body["worker_id"]), body)
         try:
             ok = await self.server.job_store.heartbeat(
                 str(body["job_id"]), str(body["worker_id"]),
@@ -141,6 +154,7 @@ class UsduRoutes:
         # count (mesh data-axis width) scales its grants
         if "devices" in body:
             self.server.job_store.note_worker_capacity(worker_id, body["devices"])
+        self._note_telemetry(worker_id, body)
         with rpc_span(
             request, "rpc.request_image", worker_id=worker_id, job_id=job_id
         ) as span:

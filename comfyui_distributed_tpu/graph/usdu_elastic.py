@@ -43,6 +43,7 @@ from ..ops import upscale as upscale_ops
 from ..utils import image as img_utils
 from ..utils.async_helpers import run_async_in_server_loop
 from ..utils.constants import (
+    FLEET_SNAPSHOT_SECONDS,
     MAX_PAYLOAD_SIZE,
     MAX_TILE_BATCH,
     PAYLOAD_HEADROOM,
@@ -147,10 +148,36 @@ class HTTPWorkClient:
         # (the pipeline's I/O stage).
         self._hb_failures = 0
         self._hb_suppressed_until = 0.0
+        # Fleet telemetry piggyback: a compact versioned snapshot of
+        # this process's metrics rides at most one pull/heartbeat per
+        # CDT_FLEET_SNAPSHOT_SECONDS (telemetry/fleet.local_snapshot).
+        # <= 0 disables the piggyback entirely.
+        self._telemetry_interval = FLEET_SNAPSHOT_SECONDS
+        self._telemetry_last = 0.0
 
     @property
     def master_url(self) -> str:
         return self.urls[self._url_idx % len(self.urls)]
+
+    def _maybe_telemetry(self) -> Optional[dict]:
+        """The fleet snapshot to piggyback on this RPC, or None when
+        one rode recently (or the piggyback is disabled). Runs on the
+        single RPC-issuing thread; building the snapshot is a pure
+        metrics-registry read. Never raises — telemetry must not break
+        the work protocol."""
+        if self._telemetry_interval <= 0:
+            return None
+        now = time.monotonic()
+        if now - self._telemetry_last < self._telemetry_interval:
+            return None
+        self._telemetry_last = now
+        try:
+            from ..telemetry.fleet import local_snapshot
+
+            return local_snapshot(role="worker")
+        except Exception as exc:  # noqa: BLE001 - advisory payload only
+            debug_log(f"fleet snapshot build failed: {exc}")
+            return None
 
     def _learn_epoch(self, value) -> None:
         try:
@@ -266,6 +293,9 @@ class HTTPWorkClient:
             }
             if batch_max > 1:
                 payload["batch_max"] = int(batch_max)
+            snapshot = self._maybe_telemetry()
+            if snapshot is not None:
+                payload["telemetry"] = snapshot
             try:
                 return await retry_async(
                     lambda: self._post(
@@ -358,15 +388,17 @@ class HTTPWorkClient:
             return
 
         async def beat():
+            payload = {
+                "job_id": self.job_id,
+                "worker_id": self.worker_id,
+                "devices": self.devices,
+            }
+            snapshot = self._maybe_telemetry()
+            if snapshot is not None:
+                payload["telemetry"] = snapshot
             try:
                 await self._post(
-                    "/distributed/heartbeat",
-                    {
-                        "job_id": self.job_id,
-                        "worker_id": self.worker_id,
-                        "devices": self.devices,
-                    },
-                    op="heartbeat",
+                    "/distributed/heartbeat", payload, op="heartbeat",
                 )
             except Exception as exc:  # noqa: BLE001 - heartbeats best-effort
                 self._hb_failures += 1

@@ -99,6 +99,12 @@ class ChaosResult:
     tiles_by_worker: dict[str, int] = dataclasses.field(default_factory=dict)
     # placement snapshot (populated when run_chaos_usdu(placement=...))
     placement: dict = dataclasses.field(default_factory=dict)
+    # SLO alert transitions in order (populated when
+    # run_chaos_usdu(slo=...)): each entry is the engine's transition
+    # dict ({"type": "alert_fired"|"alert_resolved", "slo", "ts", ...})
+    alerts: list[dict] = dataclasses.field(default_factory=list)
+    # whether any alert was still open when the harness gave up waiting
+    slo_active: bool = False
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -153,6 +159,7 @@ def run_chaos_usdu(
     prefetch: bool = False,
     journal_dir: Optional[str] = None,
     mesh_devices: int = 0,
+    slo: Optional[dict] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -197,6 +204,20 @@ def run_chaos_usdu(
     and gather through host_collect, exactly the production multi-chip
     path. The mesh-parity acceptance asserts the canvas is
     bit-identical to the 1-device run, square and ragged grids alike.
+
+    `slo`: pass a dict of overrides (may be empty) to run a live
+    burn-rate SLO engine (telemetry/slo.py) over the harness store's
+    latency stream — one `tile_latency` spec with harness-tight
+    windows (threshold 0.15 s, one (1 s, 0.25 s) burn rule, objective
+    0.9, resolve hold 50 ms) so a sub-second straggler plan fires a
+    real alert. The engine steps on every latency sample; after the
+    run the harness keeps stepping (bounded) until the alert resolves
+    — no new bad samples arrive once the straggler is quarantined out
+    of the tail, so the short window drains and the alert closes.
+    Transitions land in ChaosResult.alerts (and the alert events ride
+    the process bus like production). Keys: ``threshold_s``,
+    ``objective``, ``long_s``, ``short_s``, ``burn_threshold``,
+    ``resolve_hold_s``, ``min_events``.
 
     `tile_batch`/`pipeline`/`prefetch`: the batched-pipelined data path
     (graph/tile_pipeline.py). Worker threads ALWAYS run the production
@@ -262,6 +283,43 @@ def run_chaos_usdu(
         wd_kwargs.update(watchdog)
         wd = Watchdog(store=store, health=wd_health, **wd_kwargs)
         latency_sinks.append(wd.record_latency)
+    slo_engine = None
+    if slo is not None:
+        from ..telemetry.slo import BurnRule, SLOEngine, SLOSpec
+        from ..telemetry.timeseries import SeriesStore
+
+        slo_kwargs = dict(
+            threshold_s=0.15, objective=0.9, long_s=1.0, short_s=0.25,
+            burn_threshold=1.0, resolve_hold_s=0.05, min_events=2,
+        )
+        slo_kwargs.update(slo)
+        spec = SLOSpec(
+            name="tile_latency",
+            description="chaos-harness tile pull->submit latency",
+            objective=slo_kwargs["objective"],
+            kind="latency",
+            threshold_s=slo_kwargs["threshold_s"],
+            rules=(
+                BurnRule(
+                    long_s=slo_kwargs["long_s"],
+                    short_s=slo_kwargs["short_s"],
+                    burn_threshold=slo_kwargs["burn_threshold"],
+                ),
+            ),
+            resolve_hold_s=slo_kwargs["resolve_hold_s"],
+            min_events=slo_kwargs["min_events"],
+        )
+        # fine raw buckets so sub-second windows have real resolution
+        slo_engine = SLOEngine(
+            specs=(spec,),
+            store=SeriesStore(raw_step=0.05, raw_points=4096),
+        )
+
+        def _slo_sink(_wid: str, seconds: float) -> None:
+            slo_engine.note_latency("tile_latency", seconds)
+            slo_engine.step()
+
+        latency_sinks.append(_slo_sink)
     policy = None
     if placement is not None:
         from ..scheduler.placement import PlacementPolicy
@@ -464,6 +522,19 @@ def run_chaos_usdu(
         set_tracer(previous_tracer)
         if durability is not None:
             durability.close()
+    if slo_engine is not None and slo_engine.is_active("tile_latency"):
+        # the straggler is quarantined and the job is done — no new bad
+        # samples can arrive, so continued evaluation MUST resolve the
+        # alert once the short window drains past the resolve hold.
+        # Bounded wait: a stuck alert here is a real engine bug, and
+        # the test asserts on slo_active instead of hanging.
+        deadline = time.monotonic() + 5.0
+        while (
+            slo_engine.is_active("tile_latency")
+            and time.monotonic() < deadline
+        ):
+            slo_engine.step()
+            time.sleep(0.02)
     # every tile is accepted exactly once (first result wins), so the
     # master's share is the remainder (plan_grid: geometry only, no
     # second resize/extract pass)
@@ -481,6 +552,12 @@ def run_chaos_usdu(
         health=wd_health.snapshot() if wd_health is not None else {},
         tiles_by_worker=tiles_by_worker,
         placement=policy.snapshot() if policy is not None else {},
+        alerts=list(slo_engine.history) if slo_engine is not None else [],
+        slo_active=(
+            slo_engine.is_active("tile_latency")
+            if slo_engine is not None
+            else False
+        ),
     )
 
 

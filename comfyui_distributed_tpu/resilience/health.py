@@ -100,6 +100,11 @@ class HealthRegistry:
         self._lock = threading.Lock()
         self._workers: dict[str, WorkerHealth] = {}
         self._listeners: list[TransitionListener] = []
+        # Removal seam (distinct from transition listeners): called
+        # (outside the lock) with every worker id `reset` drops, so
+        # per-worker state keyed elsewhere — the fleet registry's
+        # retained series — departs with the breaker entry.
+        self.on_forget: Callable[[str], None] | None = None
 
     # --- listeners -------------------------------------------------------
 
@@ -313,9 +318,20 @@ class HealthRegistry:
     def reset(self, worker_id: str | None = None) -> None:
         with self._lock:
             if worker_id is None:
+                forgotten = list(self._workers)
                 self._workers.clear()
             else:
-                self._workers.pop(worker_id, None)
+                forgotten = (
+                    [worker_id] if self._workers.pop(worker_id, None) else []
+                )
+        hook = self.on_forget
+        if hook is None:
+            return
+        for wid in forgotten:
+            try:
+                hook(wid)
+            except Exception as exc:  # noqa: BLE001 - advisory fan-out
+                debug_log(f"health on_forget({wid}) failed: {exc}")
 
 
 # --- global registry ------------------------------------------------------

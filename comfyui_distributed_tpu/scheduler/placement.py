@@ -96,6 +96,11 @@ class PlacementPolicy:
         # advertised chip counts (worker mesh data-axis width), fed by
         # the pull/heartbeat RPCs through JobStore.note_worker_capacity
         self._capacity: dict[str, int] = {}
+        # Departed-worker seam: called (outside the lock) with every
+        # worker id this policy forgets or evicts, so downstream
+        # consumers keyed by worker id (the fleet registry's per-worker
+        # series) drop their state in the same breath.
+        self.on_forget: Optional[Any] = None
 
     # --- inputs -----------------------------------------------------------
 
@@ -120,6 +125,7 @@ class PlacementPolicy:
         the value originates in a client RPC and multiplies server-side
         grant caps, so it must never be unbounded."""
         devices = max(1, min(int(devices), MAX_WORKER_DEVICES))
+        stale = None
         with self._lock:
             if (
                 worker_id not in self._capacity
@@ -133,6 +139,8 @@ class PlacementPolicy:
                 )
                 self._capacity.pop(stale)
             self._capacity[worker_id] = devices
+        if stale is not None:
+            self._notify_forget(stale)
 
     def capacity(self, worker_id: str) -> int:
         with self._lock:
@@ -144,6 +152,16 @@ class PlacementPolicy:
             self._samples.pop(worker_id, None)
             self._trimmed.pop(worker_id, None)
             self._capacity.pop(worker_id, None)
+        self._notify_forget(worker_id)
+
+    def _notify_forget(self, worker_id: str) -> None:
+        hook = self.on_forget
+        if hook is None:
+            return
+        try:
+            hook(worker_id)
+        except Exception:  # noqa: BLE001 - advisory fan-out only
+            pass
 
     # --- model ------------------------------------------------------------
 

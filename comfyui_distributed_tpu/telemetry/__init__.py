@@ -17,7 +17,15 @@ The observability subsystem the ROADMAP's perf work hangs off:
 - `watchdog`: straggler & stall detector feeding breaker suspect
   transitions and speculative tail-tile re-dispatch;
 - `runtime`: JAX compile/cache/HBM/host-RSS collectors on the scrape,
-  stamped into bench output via `runtime_snapshot`.
+  stamped into bench output via `runtime_snapshot`;
+- `timeseries`: bounded two-tier ring-buffer retention (10 s raw /
+  5 min rollup) for the fleet plane's windowed history;
+- `fleet`: worker snapshot production + the master's `FleetRegistry`
+  (per-worker merge, rollups, departed-worker eviction), served by
+  `GET /distributed/fleet`;
+- `slo`: declarative SLOs with multi-window burn-rate alerting —
+  `alert_fired`/`alert_resolved` bus events, `GET /distributed/alerts`,
+  and the `cdt_alert_active` scrape gauge.
 
 All clocks are injectable so tier-1 tests run deterministically on
 CPU. See docs/observability.md for the operator-facing story.
@@ -44,19 +52,30 @@ from .tracing import (
     set_tracer,
 )
 from .events import EventBus, get_event_bus, reset_event_bus
+from .fleet import FleetMonitor, FleetRegistry, local_snapshot
+from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
+from .timeseries import SeriesStore
 from .watchdog import Watchdog
 
 __all__ = [
     "BREAKER_STATE_CODES",
+    "BurnRule",
     "Counter",
     "EventBus",
+    "FleetMonitor",
+    "FleetRegistry",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SLOEngine",
+    "SLOSpec",
+    "SeriesStore",
     "Span",
     "TRACE_HEADER",
     "Tracer",
     "Watchdog",
+    "default_slos",
+    "local_snapshot",
     "bind_server_collectors",
     "current_trace_id",
     "get_event_bus",

@@ -419,6 +419,62 @@ def host_rss_bytes() -> Gauge:
     )
 
 
+# --- fleet observability plane (telemetry/fleet.py, telemetry/slo.py) -----
+
+def fleet_snapshots_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_fleet_snapshots_total",
+        "Worker telemetry snapshots received piggybacked on "
+        "heartbeat/request_image RPCs, by outcome "
+        "(accepted|bad_version|malformed)",
+        ("outcome",),
+    )
+
+
+def fleet_evictions_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_fleet_evictions_total",
+        "Workers evicted from the fleet registry by reason "
+        "(ttl|forgotten|capacity) — every eviction drops the worker's "
+        "retained series",
+        ("reason",),
+    )
+
+
+def fleet_workers() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_fleet_workers",
+        "Workers currently tracked by the fleet registry (snapshotting "
+        "within the CDT_FLEET_TTL window)",
+    )
+
+
+def fleet_series() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_fleet_series",
+        "Retained time-series count in the fleet store (bounded per "
+        "name by CDT_METRIC_MAX_SERIES)",
+    )
+
+
+def alert_active() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_alert_active",
+        "1 while the named SLO's burn-rate alert is open, 0 otherwise "
+        "(transitions also publish alert_fired/alert_resolved events)",
+        ("slo",),
+    )
+
+
+def slo_burn_rate() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_slo_burn_rate",
+        "Error-budget burn rate per SLO over each rule's LONG window "
+        "(1.0 = burning exactly at budget-exhaustion rate)",
+        ("slo", "window"),
+    )
+
+
 # --- USDU tile pipeline ---------------------------------------------------
 
 def tile_stage_seconds() -> Histogram:
@@ -589,6 +645,16 @@ def bind_server_collectors(server) -> Callable[[], None]:
         replication_lag_records()
         replication_lag_seconds()
         failover_total()
+    # Fleet plane instruments present from the first scrape on masters
+    # running the monitor (the web panel's fleet card and the CI smoke
+    # parse them before any worker has snapshotted).
+    if getattr(server, "fleet", None) is not None:
+        fleet_snapshots_total()
+        fleet_evictions_total()
+        fleet_workers()
+        fleet_series()
+        alert_active()
+        slo_burn_rate()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale
@@ -626,6 +692,27 @@ def bind_server_collectors(server) -> Callable[[], None]:
         durability = getattr(server, "durability", None)
         if durability is not None:
             durability.collect_metrics()
+        slo = getattr(server, "slo", None)
+        if slo is not None:
+            # scrape-time refresh: alert gauges reflect the CURRENT
+            # engine state even if no transition fired since the last
+            # step (and burn rates ride the scrape for dashboards)
+            active_gauge = alert_active()
+            burn_gauge = slo_burn_rate()
+            for spec_name in slo.specs:
+                active_gauge.set(
+                    1.0 if slo.is_active(spec_name) else 0.0, slo=spec_name
+                )
+                try:
+                    verdict = slo.evaluate(spec_name)
+                except Exception:  # noqa: BLE001 - scrape survives eval
+                    continue
+                for rule in verdict["rules"]:
+                    burn_gauge.set(
+                        rule["burn_long"],
+                        slo=spec_name,
+                        window=f"{int(rule['long_s'])}s",
+                    )
         standby = getattr(server, "standby", None)
         if standby is not None and not standby.promoted:
             replica = standby.replica
@@ -649,6 +736,11 @@ def bind_server_collectors(server) -> Callable[[], None]:
         unregister()
         for accessor in _LIVE_GAUGES:
             accessor().remove(server=label)
+        slo = getattr(server, "slo", None)
+        if slo is not None:
+            for spec_name in slo.specs:
+                alert_active().remove(slo=spec_name)
+            slo_burn_rate().clear()
         scheduler = getattr(server, "scheduler", None)
         if scheduler is not None:
             sched_state().remove(server=label)

@@ -308,6 +308,21 @@ class Histogram(_Metric):
             series = self._series.get(key)
             return series[2] if series else 0
 
+    def series_snapshot(self) -> dict[tuple[str, ...], dict]:
+        """Per-label-set bucket state: ``{labelvalues: {buckets, sum,
+        count}}`` (buckets are per-bound counts, not cumulative).
+        Consumed by the fleet snapshot builder (telemetry/fleet.py) to
+        derive p50/p95 without re-parsing the text exposition."""
+        with self._lock:
+            return {
+                key: {
+                    "buckets": list(counts),
+                    "sum": total,
+                    "count": count,
+                }
+                for key, (counts, total, count) in self._series.items()
+            }
+
     def samples(self) -> Iterable[str]:
         with self._lock:
             items = sorted(
@@ -327,6 +342,25 @@ class Histogram(_Metric):
             plain = _format_labels(self.labelnames, key)
             yield f"{self.name}_sum{plain} {_format_value(total)}"
             yield f"{self.name}_count{plain} {count}"
+
+
+def histogram_quantile(
+    bounds: Sequence[float], counts: Sequence[int], total: int, q: float
+) -> Optional[float]:
+    """Quantile estimate from per-bound (non-cumulative) bucket counts:
+    the smallest bucket bound whose cumulative count reaches rank
+    ``ceil(q * total)``. Observations above every bound clamp to the
+    top bound."""
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    rank = max(1, math.ceil(q * total))
+    cumulative = 0
+    for bound, count in zip(bounds, counts):
+        cumulative += count
+        if cumulative >= rank:
+            return float(bound)
+    return float(bounds[-1]) if bounds else None
 
 
 class MetricsRegistry:

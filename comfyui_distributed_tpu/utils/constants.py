@@ -235,6 +235,26 @@ PUSH_GRANTS_ENABLED = os.environ.get("CDT_PUSH_GRANTS", "1") != "0"
 # pull protocol's immediate exit).
 PUSH_WAIT_SECONDS = _env_float("CDT_PUSH_WAIT", 1.0)
 
+# --- fleet observability plane (telemetry/fleet.py, telemetry/slo.py) -----
+# Master toggle for the fleet plane: 0 disables the monitor thread,
+# master-side sampling, and SLO evaluation entirely (the routes then
+# answer with enabled=false).
+FLEET_ENABLED = os.environ.get("CDT_FLEET", "1") != "0"
+# Seconds between master-side sampling passes (fleet sweep + rollup +
+# SLO burn-rate evaluation) — also the raw-tier resolution's natural
+# cadence.
+FLEET_INTERVAL_SECONDS = _env_float("CDT_FLEET_INTERVAL", 10.0)
+# Minimum seconds between a worker's piggybacked telemetry snapshots
+# (the snapshot rides heartbeat/request_image RPCs it already sends).
+FLEET_SNAPSHOT_SECONDS = _env_float("CDT_FLEET_SNAPSHOT_SECONDS", 10.0)
+# A worker that stops snapshotting for this long is evicted from the
+# fleet view (all its per-worker series drop).
+FLEET_TTL_SECONDS = _env_float("CDT_FLEET_TTL", 120.0)
+# SLO latency targets: the tile pull->submit p95 objective and the
+# journal-append objective the burn-rate alerts evaluate against.
+SLO_TILE_P95_SECONDS = _env_float("CDT_SLO_TILE_P95", 5.0)
+SLO_JOURNAL_P95_SECONDS = _env_float("CDT_SLO_JOURNAL_P95", 0.25)
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

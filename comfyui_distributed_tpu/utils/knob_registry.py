@@ -211,6 +211,24 @@ KNOBS: tuple[Knob, ...] = (
          "When set, each execution's span tree is exported as JSONL here."),
     Knob("CDT_RUNTIME_DEVICE_STATS", "1", "telemetry",
          "`0` disables the HBM/host-RSS scrape gauges."),
+    Knob("CDT_FLEET", "1", "telemetry",
+         "`0` disables the fleet observability plane (monitor thread, "
+         "master-side sampling, SLO evaluation; routes answer enabled=false)."),
+    Knob("CDT_FLEET_INTERVAL", "10.0", "telemetry",
+         "Seconds between master-side fleet sampling passes "
+         "(sweep + rollup + SLO burn-rate evaluation)."),
+    Knob("CDT_FLEET_SNAPSHOT_SECONDS", "10.0", "telemetry",
+         "Minimum seconds between a worker's piggybacked telemetry "
+         "snapshots on heartbeat/request_image; <=0 disables the piggyback."),
+    Knob("CDT_FLEET_TTL", "120.0", "telemetry",
+         "Seconds without a snapshot before a worker is evicted from the "
+         "fleet view (all its retained series drop)."),
+    Knob("CDT_SLO_TILE_P95", "5.0", "telemetry",
+         "Tile pull-to-submit latency target the tile_latency SLO "
+         "classifies samples against (seconds)."),
+    Knob("CDT_SLO_JOURNAL_P95", "0.25", "telemetry",
+         "Journal-append latency target the journal_latency SLO "
+         "classifies samples against (seconds)."),
     # --- jobs ------------------------------------------------------------
     Knob("CDT_JOB_INIT_GRACE", "10.0", "jobs",
          "Seconds result submission waits for the master-side queue to appear."),

@@ -39,6 +39,7 @@ import {
 } from "./modules/widgets.js";
 import {
   durabilityHtml,
+  fleetHtml,
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
@@ -96,6 +97,7 @@ async function refreshStatus() {
   refreshScheduler();
   refreshPipeline();
   refreshDurability();
+  refreshFleet();
   schedulePoll();
 }
 
@@ -134,6 +136,21 @@ async function refreshDurability() {
     container.innerHTML = durabilityHtml(await api("/distributed/durability"));
   } catch {
     container.textContent = "durability status unreachable";
+  }
+}
+
+// ---------- fleet observability card ----------
+
+async function refreshFleet() {
+  const container = document.getElementById("fleet");
+  try {
+    const [fleet, alerts] = await Promise.all([
+      api("/distributed/fleet"),
+      api("/distributed/alerts").catch(() => null),
+    ]);
+    container.innerHTML = fleetHtml(fleet, alerts);
+  } catch {
+    container.textContent = "fleet status unreachable";
   }
 }
 
@@ -189,6 +206,14 @@ function startEventStream() {
         // a breaker just moved; reflect it in the worker list now
         // instead of waiting for the idle poll tick
         refreshStatus();
+      } else if (
+        event.type === "fleet_rollup" ||
+        event.type === "alert_fired" ||
+        event.type === "alert_resolved"
+      ) {
+        // the fleet card is stream-fed: each pushed rollup / alert
+        // transition refreshes it without waiting for the slow poll
+        refreshFleet();
       }
     },
     onStatus: (connected) => {

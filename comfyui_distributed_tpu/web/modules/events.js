@@ -22,6 +22,9 @@ export const EVENT_TYPES = [
   "tile_quarantined",
   "shed",
   "brownout_level",
+  "fleet_rollup",
+  "alert_fired",
+  "alert_resolved",
 ];
 
 export const MAX_LIVE_EVENTS = 20;
@@ -36,6 +39,8 @@ export function reduceLiveStatus(prev, event) {
     connected: true,
     breakers: { ...(prev?.breakers || {}) },
     events: [...(prev?.events || [])],
+    fleet: prev?.fleet || null,
+    alerts: new Set(prev?.alerts || []),
   };
   if (event.type === "hello") {
     for (const [id, h] of Object.entries(event.data?.health || {})) {
@@ -46,6 +51,11 @@ export function reduceLiveStatus(prev, event) {
   if (event.type === "health_transition") {
     next.breakers[event.data.worker_id] = event.data.to_state;
   }
+  if (event.type === "fleet_rollup") {
+    next.fleet = event.data; // latest rollup wins; the card re-renders
+  }
+  if (event.type === "alert_fired") next.alerts.add(event.data.slo);
+  if (event.type === "alert_resolved") next.alerts.delete(event.data.slo);
   const label = eventLabel(event);
   if (label) {
     next.events.unshift({ ts: event.ts, label });
@@ -85,6 +95,16 @@ export function eventLabel(event) {
       return `brownout: lane ${d.lane} shed (level ${d.level})`;
     case "brownout_level":
       return `brownout level ${d.direction === "up" ? "↑" : "↓"} ${d.level}`;
+    case "alert_fired":
+      return `SLO alert: ${d.slo} burning error budget`;
+    case "alert_resolved":
+      return `SLO alert resolved: ${d.slo}${
+        d.active_seconds == null
+          ? ""
+          : ` (open ${Number(d.active_seconds).toFixed(0)}s)`
+      }`;
+    case "fleet_rollup":
+      return null; // rendered as the fleet card, not an event line
     case "events_dropped":
       return `stream dropped ${d.count} event(s) (slow consumer)`;
     default:

@@ -222,6 +222,59 @@ export function pipelineHtml(stats) {
   return rows.join("") + cacheLine;
 }
 
+/** Fleet observability card (pure; app.js refreshFleet applies it):
+ * rollup line (workers / devices / tiles-per-second / inflight), the
+ * per-worker drill-down from GET /distributed/fleet, and the SLO
+ * alert strip from GET /distributed/alerts. Pushed `fleet_rollup` /
+ * `alert_*` events refresh the same card between polls. */
+export function fleetHtml(fleet, alerts) {
+  if (!fleet) return '<span class="meta">fleet status unavailable</span>';
+  if (fleet.enabled === false) {
+    return '<span class="meta">fleet plane off — masters with CDT_FLEET=1 serve it</span>';
+  }
+  const roll = fleet.rollup || {};
+  const header =
+    `workers <b>${roll.workers ?? 0}</b> · devices ${roll.devices ?? 0}` +
+    ` · ${Number(roll.tiles_per_s ?? 0).toFixed(2)} tiles/s` +
+    ` (${Number(roll.tiles_per_chip_s ?? 0).toFixed(2)}/chip)` +
+    ` · in-flight ${roll.inflight ?? 0}`;
+  const active = new Set(
+    (alerts && alerts.active) || roll.alerts_active || []
+  );
+  const alertLine = active.size
+    ? `<div class="row"><strong class="alert">ALERT</strong>` +
+      `<span class="meta">${[...active].map(escapeHtml).join(", ")} burning</span></div>`
+    : '<div class="row"><span class="meta">SLOs: no alerts firing</span></div>';
+  const workers = Object.entries(fleet.workers || {})
+    .sort(([a], [b]) => a.localeCompare(b))
+    .map(([id, w]) => {
+      const snap = w.snapshot || {};
+      const sample = (snap.stages || {}).sample || {};
+      const p95 =
+        sample.p95 == null ? "" : ` · sample p95 ${Number(sample.p95).toFixed(2)}s`;
+      return (
+        `<div class="row"><strong>${escapeHtml(id)}</strong>` +
+        `<span class="meta">${Number(w.tiles_per_s ?? 0).toFixed(2)} tiles/s` +
+        // snapshot fields are worker-supplied (unauthenticated RPC):
+        // numeric coercion, never raw interpolation
+        ` · ${Number(snap.devices) || 1} chip(s)${p95}` +
+        ` · seen ${Number(w.seen_ago_s ?? 0).toFixed(0)}s ago</span></div>`
+      );
+    })
+    .join("");
+  const series = fleet.series || {};
+  const seriesLine =
+    series.count === undefined
+      ? ""
+      : `<div class="row"><span class="meta">retained series: ${series.count}` +
+        `${series.overflows ? ` (${series.overflows} capped)` : ""}</span></div>`;
+  return (
+    `<div class="row">${header}</div>` + alertLine +
+    (workers || '<div class="row"><span class="meta">no worker snapshots yet</span></div>') +
+    seriesLine
+  );
+}
+
 /** Durable-control-plane card (pure; app.js refreshDurability applies
  * it): journal head + segment count, last snapshot lsn/age, the
  * post-recovery admission hold, and the last recovery's report — the

@@ -152,3 +152,38 @@ test("connectEvents: decodes frames, reports status, reconnects", () => {
   sockets[1].onclose();
   assertEqual(timers.length, 1, "no reconnect after explicit stop");
 });
+
+test("reduceLiveStatus: fleet rollups and alert transitions tracked", () => {
+  let status = reduceLiveStatus(undefined, {
+    type: "fleet_rollup",
+    data: { workers: 2, tiles_per_s: 3.0 },
+  });
+  assertEqual(status.fleet.workers, 2);
+  status = reduceLiveStatus(status, {
+    type: "alert_fired",
+    ts: 1,
+    data: { slo: "tile_latency" },
+  });
+  assert(status.alerts.has("tile_latency"), "alert tracked as active");
+  status = reduceLiveStatus(status, {
+    type: "alert_resolved",
+    ts: 2,
+    data: { slo: "tile_latency", active_seconds: 12 },
+  });
+  assert(!status.alerts.has("tile_latency"), "alert cleared on resolve");
+});
+
+test("eventLabel: alert transitions readable, fleet_rollup silent", () => {
+  assertIncludes(
+    eventLabel({ type: "alert_fired", data: { slo: "availability" } }),
+    "availability"
+  );
+  assertIncludes(
+    eventLabel({
+      type: "alert_resolved",
+      data: { slo: "availability", active_seconds: 30 },
+    }),
+    "resolved"
+  );
+  assertEqual(eventLabel({ type: "fleet_rollup", data: {} }), null);
+});

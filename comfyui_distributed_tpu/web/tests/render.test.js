@@ -6,6 +6,7 @@
 import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
   dividerNodeHtml,
+  fleetHtml,
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
@@ -231,4 +232,37 @@ test("pipelineHtml renders per-role buckets and the cache line", () => {
     pipelineHtml({ batches: {}, inflight: {}, padded: {}, cache: {} }),
     "no pipeline activity"
   );
+});
+
+test("fleetHtml: disabled / rollup + workers / alert strip", () => {
+  assertIncludes(fleetHtml(null), "unavailable");
+  assertIncludes(fleetHtml({ enabled: false }), "CDT_FLEET=1");
+  const fleet = {
+    enabled: true,
+    rollup: {
+      workers: 2, devices: 6, tiles_per_s: 3.21, tiles_per_chip_s: 0.535,
+      inflight: 1, alerts_active: [],
+    },
+    workers: {
+      w1: {
+        tiles_per_s: 2.5, seen_ago_s: 4.2,
+        snapshot: {
+          devices: 4,
+          stages: { sample: { p50: 0.1, p95: 0.42, count: 12 } },
+        },
+      },
+    },
+    series: { count: 9, overflows: 0 },
+  };
+  const html = fleetHtml(fleet, { active: [] });
+  assertIncludes(html, "workers <b>2</b>");
+  assertIncludes(html, "3.21 tiles/s");
+  assertIncludes(html, "w1");
+  assertIncludes(html, "4 chip(s)");
+  assertIncludes(html, "sample p95 0.42s");
+  assertIncludes(html, "no alerts firing");
+  assertIncludes(html, "retained series: 9");
+  const burning = fleetHtml(fleet, { active: ["tile_latency"] });
+  assertIncludes(burning, "ALERT");
+  assertIncludes(burning, "tile_latency");
 });

@@ -63,6 +63,17 @@ def configure_compile_cache() -> str | None:
         debug_log(f"compile cache setup failed ({cache_dir}): {exc}")
         return None
     debug_log(f"persistent compilation cache at {cache_dir}")
+    # Compile/cache tallies must count from the FIRST program: the
+    # fleet snapshot a worker piggybacks onto its pulls (and the bench
+    # runtime stamp) both read these jax.monitoring listeners, so
+    # install them alongside the cache — the earliest backend-adjacent
+    # moment every process passes through.
+    try:
+        from ..telemetry.runtime import install_jax_monitoring
+
+        install_jax_monitoring()
+    except Exception as exc:  # noqa: BLE001 - telemetry is best effort
+        debug_log(f"jax monitoring install failed: {exc}")
     return cache_dir
 
 

@@ -353,6 +353,64 @@ def render_comparison(
     return "\n".join(lines)
 
 
+def parse_slo_budgets(specs: list[str]) -> dict[str, float]:
+    """``stage=seconds`` pairs (stage = a span name, or `queue_wait`)."""
+    budgets: dict[str, float] = {}
+    for spec in specs:
+        stage, sep, value = spec.partition("=")
+        if not sep:
+            raise ValueError(f"--slo expects stage=seconds, got {spec!r}")
+        budgets[stage.strip()] = float(value)
+    return budgets
+
+
+def slo_violations(
+    report: dict[str, Any], budgets: dict[str, float]
+) -> list[dict[str, Any]]:
+    """Offline counterpart of the live burn-rate engine
+    (docs/observability.md §SLO): check each budgeted stage's p95 in
+    this trace against its target. A stage the trace never recorded is
+    reported as `missing` (a lifecycle that skipped the instrumented
+    path entirely should not pass silently)."""
+    out: list[dict[str, Any]] = []
+    for stage, budget in sorted(budgets.items()):
+        stats = (
+            report.get("queue_wait")
+            if stage == "queue_wait"
+            else report["stages"].get(stage)
+        )
+        if not stats:
+            out.append({"stage": stage, "budget": budget, "missing": True})
+        elif stats["p95"] > budget:
+            out.append(
+                {
+                    "stage": stage,
+                    "budget": budget,
+                    "p95": stats["p95"],
+                    "missing": False,
+                }
+            )
+    return out
+
+
+def render_slo(violations: list[dict[str, Any]]) -> str:
+    if not violations:
+        return "SLO check: every budgeted stage p95 within target"
+    lines = ["SLO VIOLATIONS:"]
+    for item in violations:
+        if item["missing"]:
+            lines.append(
+                f"  {item['stage']:28} no samples in trace "
+                f"(budget {item['budget']:g}s)"
+            )
+        else:
+            lines.append(
+                f"  {item['stage']:28} p95 {item['p95']:.4f}s > "
+                f"budget {item['budget']:g}s"
+            )
+    return "\n".join(lines)
+
+
 def render_text(report: dict[str, Any], tiles, problems) -> str:
     lines = []
     lines.append(
@@ -433,7 +491,22 @@ def main(argv: list[str] | None = None) -> int:
         default=25.0,
         help="p95 regression threshold in percent for --compare (default 25)",
     )
+    parser.add_argument(
+        "--slo",
+        action="append",
+        default=[],
+        metavar="STAGE=SECONDS",
+        help="p95 budget per stage (repeatable; stage may be `queue_wait`); "
+        "exit 4 on violation — the offline counterpart of the live "
+        "burn-rate SLO engine. A --compare regression takes exit-code "
+        "precedence (3); both verdicts are always printed/serialized",
+    )
     args = parser.parse_args(argv)
+    try:
+        slo_budgets = parse_slo_budgets(args.slo)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
 
     try:
         spans = load_spans(args.path)
@@ -460,6 +533,8 @@ def main(argv: list[str] | None = None) -> int:
             build_report(old_spans), report, args.regress_pct
         )
 
+    violations = slo_violations(report, slo_budgets) if slo_budgets else None
+
     if args.json:
         payload = {
             "report": report,
@@ -468,14 +543,21 @@ def main(argv: list[str] | None = None) -> int:
         }
         if regressions is not None:
             payload["regressions"] = regressions
+        if violations is not None:
+            payload["slo_violations"] = violations
         print(json.dumps(payload, indent=2, sort_keys=True))
     else:
         print(render_text(report, tiles, problems))
         if regressions is not None:
             print()
             print(render_comparison(regressions, args.regress_pct))
+        if violations is not None:
+            print()
+            print(render_slo(violations))
     if regressions:
         return 3
+    if violations:
+        return 4
     return 2 if problems else 0
 
 

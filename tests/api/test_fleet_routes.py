@@ -1,0 +1,225 @@
+"""GET /distributed/fleet + /distributed/alerts over real HTTP: the
+piggybacked-snapshot ingest path, windowed history, the alert engine's
+three surfaces (route, scrape gauge, bus event), and the CDT_FLEET=0
+disabled path."""
+
+import asyncio
+import json
+import socket
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.telemetry.fleet import SNAPSHOT_VERSION
+from comfyui_distributed_tpu.telemetry.slo import BurnRule, SLOEngine, SLOSpec
+from comfyui_distributed_tpu.telemetry.timeseries import SeriesStore
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+pytestmark = pytest.mark.fast
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _post_json(url: str, payload: dict, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+@pytest.fixture()
+def server(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port, loop_thread
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def test_request_image_piggyback_lands_in_fleet_route(server):
+    srv, port, loop_thread = server
+
+    async def make_job():
+        await srv.job_store.init_tile_job("job-f", [0, 1])
+
+    asyncio.run_coroutine_threadsafe(make_job(), loop_thread.loop).result(
+        timeout=10
+    )
+    status, body = _post_json(
+        f"http://127.0.0.1:{port}/distributed/request_image",
+        {
+            "job_id": "job-f",
+            "worker_id": "w-fleet",
+            "devices": 2,
+            "telemetry": {
+                "v": SNAPSHOT_VERSION,
+                "tiles_total": 7,
+                "devices": 2,
+                "inflight": 1,
+                "stages": {"sample": {"p50": 0.1, "p95": 0.3, "count": 7}},
+            },
+        },
+    )
+    assert status == 200 and body["tile_idx"] is not None
+    status, fleet = _get_json(f"http://127.0.0.1:{port}/distributed/fleet")
+    assert status == 200 and fleet["enabled"] is True
+    worker = fleet["workers"]["w-fleet"]
+    assert worker["snapshot"]["tiles_total"] == 7
+    assert fleet["rollup"]["devices"] == 2
+    # bad version is counted + dropped, never an RPC error
+    status, _ = _post_json(
+        f"http://127.0.0.1:{port}/distributed/heartbeat",
+        {"job_id": "job-f", "worker_id": "w-fleet", "telemetry": {"v": 99}},
+    )
+    assert status == 200
+    _, fleet = _get_json(f"http://127.0.0.1:{port}/distributed/fleet")
+    assert fleet["workers"]["w-fleet"]["snapshot"]["tiles_total"] == 7
+
+
+def test_fleet_since_window_and_validation(server):
+    srv, port, _loop = server
+    srv.fleet.note_snapshot(
+        "w1", {"v": SNAPSHOT_VERSION, "tiles_total": 3, "devices": 1}
+    )
+    srv._fleet_monitor.step()
+    status, body = _get_json(
+        f"http://127.0.0.1:{port}/distributed/fleet?since=600&worker=w1"
+    )
+    assert status == 200
+    assert body["since_seconds"] == 600.0
+    assert "fleet_queue_wait_p95" in body["history"]
+    assert list(body["workers"]) == ["w1"]
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get_json(f"http://127.0.0.1:{port}/distributed/fleet?since=nope")
+    assert err.value.code == 400
+
+
+def test_alert_fires_across_route_gauge_and_bus(server):
+    srv, port, loop_thread = server
+
+    # deterministic engine: fake clock, one tight rule
+    fake = types.SimpleNamespace(t=1_000_000.0)
+    clock = lambda: fake.t  # noqa: E731
+    spec = SLOSpec(
+        name="tile_latency", description="test", objective=0.9,
+        kind="latency", threshold_s=0.5,
+        rules=(BurnRule(300.0, 60.0, 2.0),),
+        resolve_hold_s=30.0, min_events=3,
+    )
+    srv.slo = SLOEngine(
+        specs=(spec,), store=SeriesStore(clock=clock), clock=clock
+    )
+
+    async def subscribe():
+        from comfyui_distributed_tpu.telemetry.events import get_event_bus
+
+        return get_event_bus().subscribe(
+            types={"alert_fired", "alert_resolved"}
+        )
+
+    sub = asyncio.run_coroutine_threadsafe(
+        subscribe(), loop_thread.loop
+    ).result(timeout=10)
+
+    for _ in range(8):
+        srv.slo.note_latency("tile_latency", 2.0)  # every sample bad
+        srv.slo.step()
+        fake.t += 10.0
+
+    # 1: the route reports the open alert
+    status, alerts = _get_json(f"http://127.0.0.1:{port}/distributed/alerts")
+    assert status == 200 and alerts["enabled"] is True
+    assert alerts["active"] == ["tile_latency"]
+    [entry] = [a for a in alerts["alerts"] if a["slo"] == "tile_latency"]
+    assert entry["active"] is True and entry["rules"][0]["burn_long"] > 2.0
+    assert alerts["history"][0]["type"] == "alert_fired"
+
+    # 2: the scrape carries the active gauge + burn rate
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/distributed/metrics", timeout=10
+    ) as resp:
+        metrics = resp.read().decode()
+    assert 'cdt_alert_active{slo="tile_latency"} 1' in metrics
+    assert 'cdt_slo_burn_rate{slo="tile_latency",window="300s"}' in metrics
+
+    # 3: the transition rode the bus
+    async def next_event():
+        return await asyncio.wait_for(sub.get(), timeout=5)
+
+    event = asyncio.run_coroutine_threadsafe(
+        next_event(), loop_thread.loop
+    ).result(timeout=10)
+    assert event["type"] == "alert_fired"
+    assert event["data"]["slo"] == "tile_latency"
+
+    # resolve: good traffic + sustained clear past the hold
+    for _ in range(10):
+        srv.slo.note_event("tile_latency", bad=False, n=10)
+        srv.slo.step()
+        fake.t += 10.0
+    status, alerts = _get_json(f"http://127.0.0.1:{port}/distributed/alerts")
+    assert alerts["active"] == []
+    event = asyncio.run_coroutine_threadsafe(
+        next_event(), loop_thread.loop
+    ).result(timeout=10)
+    assert event["type"] == "alert_resolved"
+
+
+def test_fleet_disabled_answers_enabled_false(monkeypatch, tmp_config_path):
+    monkeypatch.setenv("CDT_FLEET", "0")
+    import importlib
+
+    from comfyui_distributed_tpu.utils import constants
+
+    importlib.reload(constants)
+    try:
+        srv = DistributedServer(port=_free_port(), is_worker=False)
+        assert srv.fleet is None and srv.slo is None
+        from comfyui_distributed_tpu.api.telemetry_routes import TelemetryRoutes
+
+        routes = TelemetryRoutes(srv)
+        request = types.SimpleNamespace(query={})
+        body = json.loads(
+            asyncio.run(routes.fleet(request)).body.decode()
+        )
+        assert body["enabled"] is False
+        body = json.loads(
+            asyncio.run(routes.alerts(request)).body.decode()
+        )
+        assert body["enabled"] is False
+    finally:
+        monkeypatch.delenv("CDT_FLEET")
+        importlib.reload(constants)
+
+
+def test_worker_client_piggyback_interval():
+    from comfyui_distributed_tpu.graph.usdu_elastic import HTTPWorkClient
+
+    client = HTTPWorkClient("http://127.0.0.1:1", "job", "w1")
+    client._telemetry_interval = 1000.0
+    first = client._maybe_telemetry()
+    assert isinstance(first, dict) and first["v"] == SNAPSHOT_VERSION
+    assert client._maybe_telemetry() is None  # within the interval
+    client._telemetry_interval = 0.0
+    assert client._maybe_telemetry() is None  # disabled entirely

@@ -1,0 +1,6 @@
+"""CDT006 fixture: a literal cdt_* instrument declared OUTSIDE the
+instrument registry (finding: breaks the one-registry idiom)."""
+
+
+def rogue(registry):
+    return registry.gauge("cdt_fixture_inline", "finding: inline declaration")

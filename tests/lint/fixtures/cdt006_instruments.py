@@ -1,0 +1,12 @@
+"""CDT006 fixture registry (mounted as telemetry/instruments.py):
+declares one documented metric and one missing from the doc."""
+
+
+def fixture_ok_total(registry):
+    return registry.counter("cdt_fixture_ok_total", "documented in the doc")
+
+
+def fixture_undocumented_total(registry):
+    return registry.counter(
+        "cdt_fixture_undocumented_total", "finding: not in the doc"
+    )

@@ -1,0 +1,5 @@
+"""CDT006 fixture: suppressed inline declaration (migration window)."""
+
+
+def transitional(registry):
+    return registry.gauge("cdt_fixture_transitional", "moving soon")  # cdt: noqa[CDT006]

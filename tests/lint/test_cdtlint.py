@@ -200,6 +200,91 @@ def test_cdt005_noqa_suppression(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# CDT006 instrument-registry (project-level)
+# --------------------------------------------------------------------------
+
+def _mount_cdt006(tmp_path, with_doc: bool = True, doc_text: str | None = None,
+                  extra: dict[str, str] | None = None):
+    mapping = {
+        "comfyui_distributed_tpu/telemetry/instruments.py": "cdt006_instruments.py",
+        "comfyui_distributed_tpu/mod.py": "cdt006_inline.py",
+    }
+    mapping.update(extra or {})
+    if with_doc:
+        doc = tmp_path / "docs" / "observability.md"
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text(
+            doc_text
+            if doc_text is not None
+            else "| `cdt_fixture_ok_total` | counter | — | documented |\n"
+                 "| `cdt_fixture_ghost_total` | counter | — | undeclared |\n"
+        )
+    return lint_fixture(tmp_path, mapping, {"CDT006"})
+
+
+def test_cdt006_true_positives(tmp_path):
+    result = _mount_cdt006(tmp_path)
+    assert all(f.code == "CDT006" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    # undocumented declaration, doc ghost, inline declaration
+    assert "`cdt_fixture_undocumented_total`" in messages
+    assert "`cdt_fixture_ghost_total`" in messages
+    assert "`cdt_fixture_inline`" in messages
+    assert len(result.findings) == 3
+
+
+def test_cdt006_true_negative_documented_metric(tmp_path):
+    result = _mount_cdt006(tmp_path)
+    assert "`cdt_fixture_ok_total`" not in "\n".join(
+        f.message for f in result.findings
+    )
+
+
+def test_cdt006_histogram_suffixes_resolve_to_base(tmp_path):
+    # the doc mentioning cdt_fixture_ok_total_count (exposition suffix)
+    # must neither create a ghost nor hide the base declaration
+    result = _mount_cdt006(
+        tmp_path,
+        doc_text="`cdt_fixture_ok_total_count` and "
+                 "`cdt_fixture_undocumented_total` rows\n",
+    )
+    messages = "\n".join(f.message for f in result.findings)
+    assert "`cdt_fixture_ok_total`" not in messages
+    assert "cdt_fixture_ok_total_count" not in messages
+
+
+def test_cdt006_missing_doc_is_a_finding(tmp_path):
+    result = _mount_cdt006(tmp_path, with_doc=False)
+    assert any("does not exist" in f.message for f in result.findings)
+
+
+def test_cdt006_known_extra_not_a_ghost(tmp_path):
+    # the registry-internal overflow counter is declared outside
+    # instruments.py by construction; the doc may mention it freely
+    result = _mount_cdt006(
+        tmp_path,
+        doc_text="| `cdt_fixture_ok_total` | counter |\n"
+                 "| `cdt_metric_series_overflow_total` | counter |\n",
+    )
+    assert "cdt_metric_series_overflow_total" not in "\n".join(
+        f.message for f in result.findings
+    )
+
+
+def test_cdt006_noqa_suppression(tmp_path):
+    result = _mount_cdt006(
+        tmp_path,
+        extra={"comfyui_distributed_tpu/transitional.py": "cdt006_noqa.py"},
+    )
+    assert any(
+        "cdt_fixture_transitional" in f.message for f in result.suppressed
+    )
+    assert not any(
+        "cdt_fixture_transitional" in f.message for f in result.findings
+    )
+
+
+# --------------------------------------------------------------------------
 # framework: noqa parsing, baseline drift, CLI
 # --------------------------------------------------------------------------
 
@@ -221,7 +306,7 @@ def test_parse_noqa_forms():
 
 def test_every_checker_registered_has_fixture_coverage():
     codes = set(all_checkers())
-    assert codes == {"CDT001", "CDT002", "CDT003", "CDT004", "CDT005"}
+    assert codes == {"CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006"}
     for code in codes:
         n = code[-3:].lstrip("0")
         named = [f for f in os.listdir(FIXTURES) if f.startswith(f"cdt00{n}")]
@@ -270,7 +355,7 @@ def test_cli_json_format():
 def test_cli_list_checkers():
     proc = _run_cli("--list-checkers")
     assert proc.returncode == 0
-    for code in ("CDT001", "CDT002", "CDT003", "CDT004", "CDT005"):
+    for code in ("CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006"):
         assert code in proc.stdout
 
 

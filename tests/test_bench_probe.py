@@ -39,6 +39,58 @@ def test_probe_phase_ledger_parses():
     assert phases[-1].startswith("devices at 2.0s")
 
 
+@pytest.mark.fast
+def test_probe_block_normalizes_attempts():
+    """Every datum carries a `probe` block (outcome, stage timings,
+    stderr tail) so a CPU-fallback round is diagnosable from the JSON
+    alone — no stderr archaeology."""
+    bench = _load_bench()
+    bench._PROBE_ATTEMPTS.append(
+        {
+            "timeout_s": 6.0,
+            "elapsed_s": 6.2,
+            "status": "timeout",
+            "phases": [
+                "env at 0.0s | {\"JAX_PLATFORMS\": \"\"}",
+                "import jax at 0.1s",
+                "devices at 2.0s | [[\"tpu\"]]",
+            ],
+            "diagnostics": "Thread 0x7f: ...\n  line 99 in _probe_child",
+        }
+    )
+    block = bench._probe_block()
+    assert block["outcome"] == "timeout"
+    assert block["attempts"] == 1
+    assert block["stage_timings"] == {
+        "env": 0.0, "import jax": 0.1, "devices": 2.0
+    }
+    assert "in _probe_child" in block["stderr_tail"]
+    assert block["history"][0]["status"] == "timeout"
+    json.dumps(block)  # must serialize into the datum
+
+
+@pytest.mark.fast
+def test_probe_block_maps_failed_to_crash_and_emit_stamps_it():
+    bench = _load_bench()
+    bench._PROBE_ATTEMPTS.append(
+        {"timeout_s": 5.0, "elapsed_s": 0.4, "status": "failed",
+         "phases": [], "diagnostics": "ImportError: libtpu"}
+    )
+    assert bench._probe_block()["outcome"] == "crash"
+    bench._emit({"metric": "x", "value": 1})
+    assert bench._BEST["probe"]["outcome"] == "crash"
+    assert "libtpu" in bench._BEST["probe"]["stderr_tail"]
+
+
+@pytest.mark.fast
+def test_probe_block_skipped_carries_reason():
+    bench = _load_bench()
+    block = bench._probe_block()
+    assert block == {"outcome": "skipped", "attempts": 0}
+    bench._PROBE_SKIP_REASON = "disabled_by_env"
+    assert bench._probe_block()["skip_reason"] == "disabled_by_env"
+
+
 @pytest.mark.slow
 def test_probe_child_ok_on_cpu():
     """The staged child reaches every phase and prints probe-ok when

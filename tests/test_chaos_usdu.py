@@ -125,6 +125,44 @@ def test_straggler_worker_detected_suspected_and_speculated(baseline):
     np.testing.assert_array_equal(baseline, result.output)
 
 
+def test_straggler_fires_and_resolves_latency_slo_alert(baseline):
+    """The fleet-observability acceptance scenario: the same injected
+    straggler plan as above, with a live burn-rate SLO engine over the
+    harness latency stream. The 10x straggler's over-threshold samples
+    must FIRE the tile_latency alert while the run is hot; once the
+    watchdog quarantines the straggler (suspect -> tail-trimmed out)
+    no further bad samples arrive, the short window drains, and the
+    alert must RESOLVE — strictly after it fired. Alert plumbing
+    changes observability only: the canvas stays bit-identical."""
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};latency(0.4)@chaos:w1:pulled#*;"
+            "crash@chaos:w2:pulled#1"
+        ),
+        worker_timeout=10.0,  # heartbeat requeue never fires
+        watchdog={},
+        slo={},
+    )
+    assert "w1" in result.stragglers
+    assert result.health.get("w1", {}).get("state") == "suspect"
+    kinds = [a["type"] for a in result.alerts]
+    assert kinds == ["alert_fired", "alert_resolved"], result.alerts
+    fired, resolved = result.alerts
+    assert fired["slo"] == resolved["slo"] == "tile_latency"
+    assert resolved["ts"] > fired["ts"]
+    assert resolved["active_seconds"] > 0
+    assert not result.slo_active
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_slo_engine_stays_quiet_on_a_healthy_run(baseline):
+    result = run_chaos_usdu(seed=11, slo={})
+    assert result.alerts == []
+    assert not result.slo_active
+    np.testing.assert_array_equal(baseline, result.output)
+
+
 def test_stall_speculation_recovers_a_crashed_worker_before_timeout(baseline):
     """w1 crashes after pulling a tile, with a worker timeout so large
     the heartbeat-staleness requeue would take 10s — the watchdog's

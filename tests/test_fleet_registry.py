@@ -1,0 +1,243 @@
+"""FleetRegistry (telemetry/fleet.py): snapshot versioning, rate
+derivation, rollup semantics, TTL/forget eviction seams, the
+1024-churning-workers memory bound, master-side sampling into the SLO
+engine, and worker-side snapshot production."""
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry import instruments
+from comfyui_distributed_tpu.telemetry.fleet import (
+    MAX_TRACKED_WORKERS,
+    SNAPSHOT_VERSION,
+    FleetRegistry,
+    local_snapshot,
+)
+from comfyui_distributed_tpu.telemetry.timeseries import SeriesStore
+
+pytestmark = pytest.mark.fast
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def snap(tiles=0.0, devices=1, **extra):
+    out = {"v": SNAPSHOT_VERSION, "tiles_total": tiles, "devices": devices}
+    out.update(extra)
+    return out
+
+
+def make_registry(clock, **kwargs):
+    kwargs.setdefault("store", SeriesStore(clock=clock))
+    kwargs.setdefault("ttl", 60.0)
+    return FleetRegistry(clock=clock, **kwargs)
+
+
+def test_snapshot_version_gate():
+    clock = Clock()
+    registry = make_registry(clock)
+    assert registry.note_snapshot("w1", snap()) is True
+    assert registry.note_snapshot("w2", {"v": 99}) is False
+    assert registry.note_snapshot("w3", "not-a-dict") is False
+    assert registry.worker_ids() == ["w1"]
+    counter = instruments.fleet_snapshots_total()
+    assert counter.value(outcome="accepted") == 1
+    assert counter.value(outcome="bad_version") == 1
+    assert counter.value(outcome="malformed") == 1
+
+
+def test_rate_derived_from_successive_snapshots_on_master_clock():
+    clock = Clock()
+    registry = make_registry(clock)
+    registry.note_snapshot("w1", snap(tiles=10))
+    clock.advance(10.0)
+    registry.note_snapshot("w1", snap(tiles=30))
+    detail = registry.status()["workers"]["w1"]
+    assert detail["tiles_per_s"] == pytest.approx(2.0)
+    # a reset counter (worker restart) must not produce negative rates
+    clock.advance(10.0)
+    registry.note_snapshot("w1", snap(tiles=0))
+    assert registry.status()["workers"]["w1"]["tiles_per_s"] >= 0.0
+
+
+def test_rollup_sums_and_max_envelopes():
+    clock = Clock()
+    registry = make_registry(clock)
+    registry.note_snapshot("w1", snap(
+        tiles=0, devices=4, inflight=1,
+        stages={"sample": {"p50": 0.1, "p95": 0.5, "count": 10}},
+        jax={"compiles": 2, "cache_hits": 3, "cache_misses": 1},
+        mem={"hbm_peak_bytes": 100, "rss_bytes": 50},
+    ))
+    registry.note_snapshot("w2", snap(
+        tiles=0, devices=2, inflight=2,
+        stages={"sample": {"p50": 0.2, "p95": 0.9, "count": 5}},
+        jax={"compiles": 1, "cache_hits": 0, "cache_misses": 4},
+        mem={"hbm_peak_bytes": 300, "rss_bytes": 20},
+    ))
+    rollup = registry.rollup()
+    assert rollup["workers"] == 2
+    assert rollup["devices"] == 6
+    assert rollup["inflight"] == 3
+    assert rollup["stages"]["sample"]["p95"] == 0.9  # max envelope
+    assert rollup["stages"]["sample"]["count"] == 15
+    assert rollup["jax"]["compiles"] == 3
+    assert rollup["mem"]["hbm_peak_bytes"] == 300
+    assert rollup["mem"]["rss_max_bytes"] == 50
+
+
+def test_ttl_sweep_evicts_departed_worker_and_its_series():
+    clock = Clock()
+    registry = make_registry(clock, ttl=30.0)
+    registry.note_snapshot("w1", snap(tiles=1))
+    registry.note_snapshot("w2", snap(tiles=1))
+    clock.advance(20.0)
+    registry.note_snapshot("w2", snap(tiles=2))
+    clock.advance(20.0)  # w1 last seen 40s ago, w2 20s ago
+    assert registry.sweep() == ["w1"]
+    assert registry.worker_ids() == ["w2"]
+    assert registry.store.label_values(
+        "fleet_worker_tiles_per_s", "worker_id"
+    ) == ["w2"]
+    assert instruments.fleet_evictions_total().value(reason="ttl") == 1
+
+
+def test_forget_worker_seam_drops_series():
+    clock = Clock()
+    registry = make_registry(clock)
+    registry.note_snapshot("w1", snap(tiles=5))
+    registry.forget_worker("w1")
+    assert registry.worker_ids() == []
+    assert registry.store.series_count() == 0
+    assert instruments.fleet_evictions_total().value(reason="forgotten") == 1
+
+
+def test_placement_forget_hook_reaches_the_fleet_registry():
+    from comfyui_distributed_tpu.scheduler.placement import PlacementPolicy
+
+    clock = Clock()
+    registry = make_registry(clock)
+    policy = PlacementPolicy()
+    policy.on_forget = registry.forget_worker
+    registry.note_snapshot("w1", snap(tiles=5))
+    policy.set_capacity("w1", 2)
+    policy.forget("w1")
+    assert registry.worker_ids() == []
+    assert registry.store.series_count() == 0
+
+
+def test_health_registry_reset_hook_reaches_the_fleet_registry():
+    from comfyui_distributed_tpu.resilience.health import HealthRegistry
+
+    clock = Clock()
+    registry = make_registry(clock)
+    health = HealthRegistry()
+    health.on_forget = registry.forget_worker
+    registry.note_snapshot("w1", snap(tiles=5))
+    health.record_failure("w1")
+    health.reset("w1")
+    assert registry.worker_ids() == []
+    assert registry.store.series_count() == 0
+
+
+def test_churning_worker_ids_never_grow_master_memory():
+    """The PR 8 MAX_TRACKED_WORKERS idiom, regression-tested for the
+    fleet plane: 4x the bound in churning fake workers, each
+    snapshotting once, must neither exceed the tracking bound nor grow
+    the series store past its cardinality caps."""
+    clock = Clock()
+    store = SeriesStore(clock=clock)
+    registry = make_registry(clock, store=store)
+    for wave in range(4):
+        for i in range(MAX_TRACKED_WORKERS):
+            registry.note_snapshot(
+                f"churn-{wave}-{i}", snap(tiles=float(i))
+            )
+            clock.advance(0.001)
+    assert len(registry.worker_ids()) <= MAX_TRACKED_WORKERS
+    # per-name series stay under the CDT_METRIC_MAX_SERIES cap
+    by_name = store.counts_by_name()
+    assert by_name, "no series recorded at all"
+    assert all(count <= store.max_series for count in by_name.values())
+    # churn evicted the earlier waves (capacity reason)
+    assert instruments.fleet_evictions_total().value(reason="capacity") > 0
+    # and a second full wave leaves the footprint FLAT (no leak)
+    before = (len(registry.worker_ids()), store.series_count())
+    for i in range(MAX_TRACKED_WORKERS):
+        registry.note_snapshot(f"churn-final-{i}", snap(tiles=float(i)))
+        clock.advance(0.001)
+    after = (len(registry.worker_ids()), store.series_count())
+    assert after[0] <= before[0]
+    assert after[1] <= before[1]
+
+
+def test_master_sampling_feeds_series_and_slo_counters():
+    from comfyui_distributed_tpu.scheduler import SchedulerControl
+    from comfyui_distributed_tpu.telemetry.slo import SLOEngine
+
+    clock = Clock()
+    registry = make_registry(clock)
+    slo = SLOEngine(store=SeriesStore(clock=clock), clock=clock)
+    scheduler = SchedulerControl()
+    scheduler.brownout.note_queue_wait(1.5)
+    scheduler.queue.totals["admitted"] = 40
+    scheduler.queue.totals["rejected_full"] = 4
+    scheduler.queue.totals["rejected_draining"] = 1
+    scheduler.brownout.shed_counts["batch"] = 10
+    registry.bind_master(scheduler=scheduler, slo=slo)
+    rollup = registry.sample()
+    assert registry.store.latest("fleet_queue_wait_p95") == 1.5
+    assert registry.store.latest("fleet_shed_total") == 10.0
+    assert rollup["workers"] == 0
+    # availability adopted the cumulative counters: EVERY refused
+    # admission (shed + saturation/drain rejections) counts as bad
+    assert slo.store.latest("slo_bad_total", slo="availability") == 15.0
+    assert slo.store.latest("slo_total_total", slo="availability") == 55.0
+
+
+def test_status_windowed_history_and_worker_scope():
+    clock = Clock()
+    registry = make_registry(clock)
+    for i in range(5):
+        registry.note_snapshot("w1", snap(tiles=float(i * 10)))
+        registry.note_snapshot("w2", snap(tiles=float(i)))
+        registry.sample()
+        clock.advance(10.0)
+    status = registry.status(since_s=120.0)
+    assert "fleet_tiles_per_s" in status["history"]
+    assert set(status["history"]["workers"]) == {"w1", "w2"}
+    scoped = registry.status(since_s=120.0, worker="w1")
+    assert list(scoped["workers"]) == ["w1"]
+    assert list(scoped["history"]["workers"]) == ["w1"]
+
+
+def test_local_snapshot_reads_real_instruments():
+    instruments.tile_stage_seconds().observe(0.2, stage="sample", role="worker")
+    instruments.tile_stage_seconds().observe(0.4, stage="sample", role="worker")
+    instruments.tile_stage_seconds().observe(9.9, stage="blend", role="master")
+    instruments.tiles_processed_total().inc(2, role="worker")
+    instruments.pipeline_inflight().set(1, role="worker")
+    snapshot = local_snapshot(role="worker")
+    assert snapshot["v"] == SNAPSHOT_VERSION
+    assert snapshot["tiles_total"] == 2
+    assert snapshot["inflight"] == 1
+    sample = snapshot["stages"]["sample"]
+    assert sample["count"] == 2
+    assert sample["p95"] >= sample["p50"] > 0
+    # the master-role observation must not leak into a worker snapshot
+    assert "blend" not in snapshot["stages"]
+    assert set(snapshot["jax"]) == {
+        "compiles", "compile_time_s", "cache_hits", "cache_misses"
+    }
+    assert "hbm_peak_bytes" in snapshot["mem"]
+    # round-trips through the registry
+    clock = Clock()
+    registry = make_registry(clock)
+    assert registry.note_snapshot("w1", snapshot) is True

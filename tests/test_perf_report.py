@@ -401,6 +401,60 @@ def test_batched_sample_spans_credit_every_tile_in_lifecycle():
         assert stages[0]["stage"] == "sample"
 
 
+def _write_spans(path, spans):
+    with open(path, "w") as fh:
+        for span in spans:
+            fh.write(json.dumps(span) + "\n")
+
+
+def _raw_span(name, start, end, trace="t1", **attrs):
+    return {
+        "trace_id": trace, "span_id": f"{name}-{start}", "parent_id": None,
+        "name": name, "start": start, "end": end, "duration": end - start,
+        "attrs": attrs, "events": [], "status": "ok",
+    }
+
+
+def test_slo_gate_flags_p95_over_budget_and_missing_stage(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    _write_spans(path, [
+        _raw_span("tile.sample", 0.0, 0.5),
+        _raw_span("tile.sample", 1.0, 1.2),
+    ])
+    report = perf_report.build_report(perf_report.load_spans(path))
+    violations = perf_report.slo_violations(
+        report, {"tile.sample": 0.3, "tile.encode": 1.0}
+    )
+    assert {v["stage"]: v["missing"] for v in violations} == {
+        "tile.sample": False, "tile.encode": True,
+    }
+    assert not perf_report.slo_violations(report, {"tile.sample": 1.0})
+
+
+def test_cli_slo_exit_code_and_rendering(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    _write_spans(path, [_raw_span("tile.sample", 0.0, 0.5)])
+    base = [sys.executable, os.path.join(SCRIPTS, "perf_report.py"), path]
+    ok = subprocess.run(
+        base + ["--slo", "tile.sample=2.0"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "every budgeted stage p95 within target" in ok.stdout
+    bad = subprocess.run(
+        base + ["--slo", "tile.sample=0.1", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert bad.returncode == 4
+    payload = json.loads(bad.stdout)
+    assert payload["slo_violations"][0]["stage"] == "tile.sample"
+    malformed = subprocess.run(
+        base + ["--slo", "tile.sample"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert malformed.returncode == 1
+
+
 def test_cli_fails_on_missing_or_empty_input(tmp_path):
     proc = subprocess.run(
         [

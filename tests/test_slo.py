@@ -1,0 +1,230 @@
+"""SLO burn-rate engine (telemetry/slo.py) on a fake timeline: the
+fast/slow-window interplay, min-events guard, flap suppression, resolve
+hysteresis, latency classification, counter-source clamping, and the
+transition surfaces (bus event, gauge, history)."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry import instruments
+from comfyui_distributed_tpu.telemetry.events import get_event_bus
+from comfyui_distributed_tpu.telemetry.slo import (
+    BurnRule,
+    SLOEngine,
+    SLOSpec,
+    default_slos,
+)
+from comfyui_distributed_tpu.telemetry.timeseries import SeriesStore
+
+pytestmark = pytest.mark.fast
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def make_engine(clock, *, objective=0.9, threshold=2.0, long_s=300.0,
+                short_s=60.0, resolve_hold_s=30.0, min_events=5,
+                kind="ratio", threshold_s=None):
+    spec = SLOSpec(
+        name="t", description="test objective", objective=objective,
+        kind=kind, threshold_s=threshold_s,
+        rules=(BurnRule(long_s=long_s, short_s=short_s,
+                        burn_threshold=threshold),),
+        resolve_hold_s=resolve_hold_s, min_events=min_events,
+    )
+    store = SeriesStore(raw_step=10.0, raw_points=64, clock=clock)
+    return SLOEngine(specs=(spec,), store=store, clock=clock)
+
+
+def feed(engine, clock, steps, bad_every=0, step_s=10.0, n=1):
+    """`steps` ticks of `n` events each; every `bad_every`-th tick's
+    events are bad (0 = all good)."""
+    for i in range(steps):
+        bad = bad_every > 0 and i % bad_every == 0
+        engine.note_event("t", bad=bad, n=n)
+        engine.step()
+        clock.advance(step_s)
+
+
+def test_healthy_traffic_never_fires():
+    clock = Clock()
+    engine = make_engine(clock)
+    feed(engine, clock, steps=40, bad_every=0)
+    assert engine.evaluate("t")["firing"] is False
+    assert engine.history == type(engine.history)(maxlen=engine.history.maxlen)
+
+
+def test_sustained_burn_fires_when_both_windows_cross():
+    clock = Clock()
+    engine = make_engine(clock)  # budget 0.1, burn>=2 -> bad ratio >= 0.2
+    feed(engine, clock, steps=12, bad_every=0)      # clean baseline
+    feed(engine, clock, steps=12, bad_every=2)      # 50% bad
+    verdict = engine.evaluate("t")
+    assert verdict["firing"], verdict
+    assert engine.is_active("t")
+    assert [h["type"] for h in engine.history] == ["alert_fired"]
+
+
+def test_short_window_alone_does_not_fire():
+    """One acute blip inside an otherwise-clean long window: the short
+    window burns but the long window (significance) does not — no
+    alert. This is exactly what multi-window buys over a naive
+    threshold."""
+    clock = Clock()
+    engine = make_engine(clock, long_s=300.0, short_s=60.0)
+    feed(engine, clock, steps=24, bad_every=0, n=5)  # 120 good events
+    # an acute burst of bad events in the newest short window: the
+    # short ratio crosses, the long ratio (diluted by the clean
+    # baseline) does not
+    engine.note_event("t", bad=True, n=8)
+    engine.step()
+    verdict = engine.evaluate("t")
+    [rule] = verdict["rules"]
+    assert rule["burn_short"] >= rule["threshold"]
+    assert rule["burn_long"] < rule["threshold"]
+    assert not verdict["firing"]
+    assert not engine.is_active("t")
+
+
+def test_long_window_alone_does_not_fire_after_cause_stops():
+    """Burn long enough to scar the long window, then stop: the short
+    window recovers first and a NEW alert must not open on the stale
+    long-window reading (recency gate)."""
+    clock = Clock()
+    engine = make_engine(clock, min_events=5)
+    feed(engine, clock, steps=12, bad_every=1)  # 100% bad -> fires
+    assert engine.is_active("t")
+    # cause stops; the short window fills with good traffic while the
+    # long window still carries the scar (light traffic, so the scar
+    # stays over threshold)
+    feed(engine, clock, steps=6, bad_every=0, n=2)
+    verdict = engine.evaluate("t")
+    [rule] = verdict["rules"]
+    assert rule["burn_long"] >= rule["threshold"]  # scar still visible
+    assert rule["burn_short"] < rule["threshold"]
+    assert not verdict["firing"]
+
+
+def test_min_events_guard_on_idle_system():
+    clock = Clock()
+    engine = make_engine(clock, min_events=5)
+    # 2 events, both bad: 100% ratio but far under min_events
+    engine.note_event("t", bad=True)
+    clock.advance(10.0)
+    engine.note_event("t", bad=True)
+    engine.step()
+    assert not engine.is_active("t")
+
+
+def test_resolve_hysteresis_holds_until_sustained_clear():
+    clock = Clock()
+    engine = make_engine(clock, resolve_hold_s=30.0)
+    feed(engine, clock, steps=12, bad_every=1)
+    assert engine.is_active("t")
+    # short window still burning right after the cause stops
+    feed(engine, clock, steps=2, bad_every=0, n=10)
+    assert engine.is_active("t")
+    # clear, but not yet for resolve_hold_s
+    feed(engine, clock, steps=2, bad_every=0, n=10, step_s=10.0)
+    assert engine.is_active("t")
+    # sustained clear past the hold resolves
+    feed(engine, clock, steps=6, bad_every=0, n=10, step_s=10.0)
+    assert not engine.is_active("t")
+    assert [h["type"] for h in engine.history] == [
+        "alert_fired", "alert_resolved",
+    ]
+    assert engine.history[-1]["active_seconds"] > 0
+
+
+def test_flap_suppression_bouncing_burn_resets_the_hold():
+    """A boundary bouncing above/below threshold must not ring: every
+    re-burn resets the clear timer, so the alert stays OPEN (one alert,
+    not N) until a genuinely sustained clear."""
+    clock = Clock()
+    engine = make_engine(clock, resolve_hold_s=50.0)
+    feed(engine, clock, steps=12, bad_every=1)
+    assert engine.is_active("t")
+    for _ in range(4):  # good... then bad again, repeatedly
+        feed(engine, clock, steps=2, bad_every=0, n=10)
+        feed(engine, clock, steps=1, bad_every=1, n=10)
+    assert engine.is_active("t")
+    assert [h["type"] for h in engine.history] == ["alert_fired"]
+
+
+def test_latency_spec_classifies_against_threshold():
+    clock = Clock()
+    engine = make_engine(clock, kind="latency", threshold_s=0.5,
+                         min_events=2)
+    for _ in range(6):
+        engine.note_latency("t", 2.0)  # bad
+        engine.step()
+        clock.advance(10.0)
+    assert engine.is_active("t")
+
+
+def test_set_counts_clamps_counter_regressions():
+    clock = Clock()
+    engine = make_engine(clock)
+    engine.set_counts("t", bad=5, total=100)
+    clock.advance(10.0)
+    engine.set_counts("t", bad=0, total=3)  # source restarted
+    clock.advance(10.0)
+    # clamped: no negative deltas anywhere in the windows
+    verdict = engine.evaluate("t")
+    [rule] = verdict["rules"]
+    assert rule["burn_long"] >= 0.0 and rule["burn_short"] >= 0.0
+
+
+def test_transition_updates_gauge_and_publishes_bus_event():
+    async def run():
+        sub = get_event_bus().subscribe(
+            types={"alert_fired", "alert_resolved"}
+        )
+        clock = Clock()
+        engine = make_engine(clock)
+        feed(engine, clock, steps=12, bad_every=1)
+        assert engine.is_active("t")
+        event = await asyncio.wait_for(sub.get(), timeout=2)
+        assert event["type"] == "alert_fired"
+        assert event["data"]["slo"] == "t"
+        assert event["data"]["rules"][0]["burn_long"] > 0
+        assert instruments.alert_active().value(slo="t") == 1.0
+        feed(engine, clock, steps=10, bad_every=0, n=10)
+        event = await asyncio.wait_for(sub.get(), timeout=2)
+        assert event["type"] == "alert_resolved"
+        assert instruments.alert_active().value(slo="t") == 0.0
+
+    asyncio.run(run())
+
+
+def test_default_slos_cover_the_load_bearing_objectives():
+    names = {s.name for s in default_slos()}
+    assert names == {
+        "availability", "tile_latency", "deadline_miss", "journal_latency"
+    }
+    for spec in default_slos():
+        assert 0.0 < spec.objective < 1.0
+        assert spec.rules
+        if spec.kind == "latency":
+            assert spec.threshold_s and spec.threshold_s > 0
+
+
+def test_status_payload_shape():
+    clock = Clock()
+    engine = make_engine(clock)
+    feed(engine, clock, steps=12, bad_every=1)
+    status = engine.status()
+    assert status["active"] == ["t"]
+    [spec] = status["alerts"]
+    assert spec["slo"] == "t" and spec["active"] is True
+    assert spec["rules"][0]["long_s"] == 300.0
+    assert status["history"][0]["type"] == "alert_fired"

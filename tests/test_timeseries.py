@@ -1,0 +1,122 @@
+"""Two-tier ring-buffer time series (telemetry/timeseries.py): bucket
+aggregation, raw→rollup fallback, counter-delta semantics, the
+cardinality cap, and the departed-worker eviction seam."""
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry.timeseries import SeriesStore
+
+pytestmark = pytest.mark.fast
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@pytest.fixture()
+def clock():
+    return Clock()
+
+
+def make_store(clock, **kwargs):
+    kwargs.setdefault("raw_step", 10.0)
+    kwargs.setdefault("raw_points", 6)
+    kwargs.setdefault("rollup_step", 60.0)
+    kwargs.setdefault("rollup_points", 4)
+    return SeriesStore(clock=clock, **kwargs)
+
+
+def test_bucket_aggregates_min_max_sum_count_last(clock):
+    store = make_store(clock)
+    for value in (3.0, 1.0, 2.0):
+        store.record("g", value)
+    points = store.window("g", 100.0)
+    assert len(points) == 1
+    b = points[0]
+    assert (b["min"], b["max"], b["sum"], b["count"], b["last"]) == (
+        1.0, 3.0, 6.0, 3, 2.0
+    )
+
+
+def test_window_served_from_raw_then_rollup(clock):
+    store = make_store(clock)
+    # raw tier holds 6 x 10s buckets; fill 10 buckets so the oldest 4
+    # survive only in the 60s rollups
+    for i in range(10):
+        store.record("x", float(i))
+        clock.advance(10.0)
+    recent = store.window("x", 50.0)
+    assert all(p["count"] == 1 for p in recent)  # raw resolution
+    deep = store.window("x", 10 * 10.0 + 5)
+    # raw can't reach back 105s -> rollup tier (60s buckets, count>1)
+    assert any(p["count"] > 1 for p in deep)
+
+
+def test_counter_delta_over_window(clock):
+    store = make_store(clock)
+    total = 0.0
+    for _ in range(6):
+        total += 5.0
+        store.record("c", total)
+        clock.advance(10.0)
+    # last 30s: buckets at t-30..t-10 -> 2..3 increments of 5
+    assert store.delta("c", 30.0) in (10.0, 15.0)
+    # window longer than history: full delta minus the base bucket
+    assert store.delta("c", 10_000.0) == total - 5.0
+    assert store.delta("unknown", 30.0) == 0.0
+
+
+def test_delta_never_uses_a_rollup_bucket_overlapping_raw(clock):
+    """The burn-rate regression: with history shorter than the window,
+    the single rollup bucket CONTAINS `now` — using its `last` as the
+    window base would zero every delta."""
+    store = make_store(clock)
+    store.record("c", 1.0)
+    clock.advance(15.0)  # next raw bucket, same 60s rollup bucket
+    store.record("c", 11.0)
+    clock.advance(11.0)
+    assert store.delta("c", 1_000.0) == 10.0
+
+
+def test_series_cap_rejects_new_label_sets(clock):
+    store = make_store(clock, max_series=3)
+    for i in range(5):
+        store.record("s", 1.0, worker_id=f"w{i}")
+    assert store.series_count() == 3
+    assert store.overflows == 2
+    # established series keep recording
+    assert store.record("s", 2.0, worker_id="w0") is True
+    assert store.record("s", 2.0, worker_id="w99") is False
+
+
+def test_evict_label_drops_every_series_for_the_worker(clock):
+    store = make_store(clock)
+    store.record("a", 1.0, worker_id="w1")
+    store.record("b", 1.0, worker_id="w1")
+    store.record("a", 1.0, worker_id="w2")
+    assert store.evict_label("worker_id", "w1") == 2
+    assert store.series_count() == 1
+    assert store.label_values("a", "worker_id") == ["w2"]
+
+
+def test_label_order_never_splits_a_series(clock):
+    store = make_store(clock)
+    store.record("m", 1.0, a="1", b="2")
+    store.record("m", 2.0, b="2", a="1")
+    assert store.series_count() == 1
+    assert store.latest("m", a="1", b="2") == 2.0
+
+
+def test_backwards_clock_folds_into_newest_bucket(clock):
+    store = make_store(clock)
+    store.record("g", 1.0)
+    store.record("g", 2.0, ts=clock() - 50.0)  # stale timestamp
+    points = store.window("g", 100.0)
+    assert len(points) == 1 and points[0]["count"] == 2

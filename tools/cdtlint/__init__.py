@@ -16,6 +16,10 @@ and JAX-tracing invariants the serving stack's correctness rests on:
 - CDT005 registry-consistency: every ``CDT_*`` env knob read in code is
   declared in the knob registry and documented; ``cdt_*`` metric names
   follow the declared conventions.
+- CDT006 instrument-registry: every ``cdt_*`` instrument is declared in
+  ``telemetry/instruments.py`` (never inline at a call site) and
+  documented in docs/observability.md's catalogue — and the doc
+  mentions no undeclared metric.
 
 Suppression: append ``# cdt: noqa[CDT00X]`` (or a bare ``# cdt: noqa``)
 to the offending line. Grandfathered findings live in

@@ -5,3 +5,4 @@ from . import lock_discipline  # noqa: F401  CDT002
 from . import tracing_hygiene  # noqa: F401  CDT003
 from . import determinism  # noqa: F401  CDT004
 from . import registry_consistency  # noqa: F401  CDT005
+from . import instrument_registry  # noqa: F401  CDT006

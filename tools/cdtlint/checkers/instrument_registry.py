@@ -1,0 +1,165 @@
+"""CDT006: instrument-registry + observability-doc consistency.
+
+The CDT005 knob-registry idiom applied to metrics: every ``cdt_*``
+instrument the code can emit must be
+
+1. declared in the canonical instrument registry
+   (``comfyui_distributed_tpu/telemetry/instruments.py``) — a factory
+   call with a literal metric name anywhere else is a finding (the
+   registry is how one test/lint pass can see the whole vocabulary),
+   and
+2. documented in ``docs/observability.md`` (the operator-facing
+   catalogue).
+
+And *vice versa*: every ``cdt_*`` name the doc mentions must be
+declared by the registry — a renamed or deleted instrument must not
+leave a ghost row operators grep for. ``KNOWN_EXTRA`` lists the few
+names declared outside the registry by construction (currently the
+metrics-registry-internal overflow counter, whose name is a class
+attribute, not a literal).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from ..core import Finding, ProjectContext, Severity
+from ..registry import project_checker
+
+INSTRUMENTS_PATH = "comfyui_distributed_tpu/telemetry/instruments.py"
+OBSERVABILITY_DOC_PATH = "docs/observability.md"
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_DOC_METRIC_RE = re.compile(r"\bcdt_[a-z][a-z0-9_]*\b")
+
+# Metric names emitted by code but declared outside the instrument
+# registry by construction. Keep this list SHORT and justified: every
+# entry is a name the AST scan cannot see as a registry declaration.
+KNOWN_EXTRA = {
+    # telemetry/metrics.py MetricsRegistry.OVERFLOW_COUNTER_NAME — the
+    # cardinality-cap accounting counter is created by the registry
+    # itself (class attribute, not a literal factory arg).
+    "cdt_metric_series_overflow_total",
+}
+
+
+def _metric_declarations(ctx) -> Iterator[tuple[str, int]]:
+    """(metric name, line) for every literal registry-factory call."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute) and func.attr in _METRIC_FACTORIES
+        ):
+            continue
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        name = node.args[0].value
+        if name.startswith("cdt_"):
+            yield name, node.lineno
+
+
+@project_checker(
+    "CDT006",
+    "instrument-registry",
+    "cdt_* metrics must be declared in telemetry/instruments.py and "
+    "documented in docs/observability.md (and the doc must not mention "
+    "undeclared metrics)",
+)
+def check_instrument_registry(project: ProjectContext) -> Iterator[Finding]:
+    registry_ctx = project.get(INSTRUMENTS_PATH)
+    if registry_ctx is None:
+        yield Finding(
+            code="CDT006",
+            message=(
+                f"instrument registry {INSTRUMENTS_PATH} is missing from "
+                "the scan set"
+            ),
+            path=INSTRUMENTS_PATH,
+            line=1,
+            col=0,
+            severity=Severity.ERROR,
+        )
+        return
+    declared: dict[str, int] = {}
+    for name, lineno in _metric_declarations(registry_ctx):
+        declared.setdefault(name, lineno)
+
+    # every OTHER file declaring a literal cdt_* instrument breaks the
+    # one-registry idiom (call sites fetch accessors, never name
+    # strings inline)
+    for ctx in project.files:
+        if ctx.path == INSTRUMENTS_PATH:
+            continue
+        for name, lineno in _metric_declarations(ctx):
+            yield Finding(
+                code="CDT006",
+                message=(
+                    f"metric `{name}` is declared outside the instrument "
+                    f"registry; move the declaration into "
+                    f"{INSTRUMENTS_PATH} and fetch it via an accessor"
+                ),
+                path=ctx.path,
+                line=lineno,
+                col=0,
+                severity=Severity.ERROR,
+            )
+
+    doc_path = os.path.join(project.root, OBSERVABILITY_DOC_PATH)
+    if not os.path.exists(doc_path):
+        yield Finding(
+            code="CDT006",
+            message=(
+                f"{OBSERVABILITY_DOC_PATH} does not exist; the metric "
+                "catalogue must document every declared instrument"
+            ),
+            path=INSTRUMENTS_PATH,
+            line=1,
+            col=0,
+            severity=Severity.ERROR,
+        )
+        return
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        documented = set(_DOC_METRIC_RE.findall(fh.read()))
+    # histogram exposition suffixes in doc prose resolve to their base
+    # instrument (`cdt_x_seconds_bucket` documents `cdt_x_seconds`)
+    for name in list(documented):
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name.removesuffix(suffix)
+            if base != name and (base in declared or base in KNOWN_EXTRA):
+                documented.discard(name)
+                documented.add(base)
+
+    for name in sorted(set(declared) - documented):
+        yield Finding(
+            code="CDT006",
+            message=(
+                f"metric `{name}` is declared but missing from "
+                f"{OBSERVABILITY_DOC_PATH}; add it to the catalogue"
+            ),
+            path=INSTRUMENTS_PATH,
+            line=declared[name],
+            col=0,
+            severity=Severity.ERROR,
+        )
+    for name in sorted(documented - set(declared) - KNOWN_EXTRA):
+        yield Finding(
+            code="CDT006",
+            message=(
+                f"{OBSERVABILITY_DOC_PATH} documents `{name}` but no such "
+                f"instrument is declared in {INSTRUMENTS_PATH}; fix the "
+                "doc or restore the declaration"
+            ),
+            path=INSTRUMENTS_PATH,
+            line=1,
+            col=0,
+            severity=Severity.ERROR,
+        )
