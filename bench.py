@@ -1179,10 +1179,57 @@ def _emit(result: dict) -> None:
     global _BEST
     out = dict(result)
     out["probe"] = _probe_block()
+    incidents = _incident_stamp(out["probe"])
+    if incidents is not None:
+        out["incidents"] = incidents
     if _TIMELINE:
         out["timeline"] = list(_TIMELINE)
     _BEST = out
     print(json.dumps(out), flush=True)
+
+
+# one manual capture per process for a failed probe: the bundle trail
+# makes a CPU-fallback round diagnosable from disk, not just the datum
+_PROBE_INCIDENT_CAPTURED = False
+
+
+def _incident_stamp(probe: dict | None) -> dict | None:
+    """Bundle-trail stamp for every datum: how many incident bundles
+    CDT_INCIDENT_DIR holds and which triggers produced them. A
+    crashed/timed-out accelerator probe captures a MANUAL bundle first
+    (flight rings + knob snapshot + the probe block as context), so
+    the fallback's forensics survive on disk. Never raises — losing
+    the stamp must not cost the datum."""
+    global _PROBE_INCIDENT_CAPTURED
+    incident_dir = os.environ.get("CDT_INCIDENT_DIR", "").strip()
+    if not incident_dir:
+        return None
+    try:
+        from comfyui_distributed_tpu.telemetry.incidents import IncidentManager
+
+        manager = IncidentManager(incident_dir)
+        if (
+            not _PROBE_INCIDENT_CAPTURED
+            and probe is not None
+            and probe.get("outcome") in ("timeout", "crash")
+        ):
+            _PROBE_INCIDENT_CAPTURED = True
+            manager.capture_now(
+                key=f"bench_probe_{probe.get('outcome')}",
+                context={"probe": probe},
+            )
+        listing = manager.list_bundles()
+        triggers: dict[str, int] = {}
+        for entry in listing:
+            triggers[entry["trigger"]] = triggers.get(entry["trigger"], 0) + 1
+        return {
+            "dir": incident_dir,
+            "count": len(listing),
+            "triggers": triggers,
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"incident stamp failed: {exc}", file=sys.stderr)
+        return None
 
 
 def _install_wall_clock() -> float:
@@ -1423,6 +1470,13 @@ def _orchestrate() -> None:
 
 
 def main() -> None:
+    # Incident bundle trail (docs/observability.md §Incidents): bench
+    # rounds capture probe crashes as debug bundles and stamp the
+    # bundle count/triggers into every datum. Opt out by exporting
+    # CDT_INCIDENT_DIR= (empty); children inherit the resolved dir.
+    os.environ.setdefault(
+        "CDT_INCIDENT_DIR", os.path.join(".", ".cdt", "incidents")
+    )
     if os.environ.get("BENCH_MODE") == "probe":
         _probe_child()
         return
@@ -1530,6 +1584,9 @@ def main() -> None:
         )
         _apply_scaling(result, scaling)
     result["probe"] = _probe_block()
+    incidents = _incident_stamp(result["probe"])
+    if incidents is not None:
+        result["incidents"] = incidents
     print(json.dumps(result))
 
 

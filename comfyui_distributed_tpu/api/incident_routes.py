@@ -1,0 +1,101 @@
+"""Incident-bundle routes: list, fetch, manual capture.
+
+    GET  /distributed/incidents           — newest-first bundle listing
+                                            + manager/flight accounting
+    GET  /distributed/incidents/{id}      — one full bundle (JSON)
+    POST /distributed/incidents/capture   — manual capture (bypasses
+                                            debounce, still single-flight)
+
+Enabled on masters with ``CDT_INCIDENT_DIR`` set; otherwise every
+route answers ``enabled: false`` with a hint (the journal-dir idiom).
+File reads and the synchronous capture run off the event loop via
+``run_blocking`` — a multi-MB bundle read must not stall serving
+(cdt-lint CDT001 is the enforcement).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..utils.async_helpers import run_blocking
+
+DISABLED_HINT = {
+    "enabled": False,
+    "hint": "set CDT_INCIDENT_DIR on a master to enable incident capture",
+}
+
+
+def register(app: web.Application, server) -> None:
+    routes = IncidentRoutes(server)
+    app.router.add_get("/distributed/incidents", routes.list_incidents)
+    app.router.add_post("/distributed/incidents/capture", routes.capture)
+    app.router.add_get(
+        "/distributed/incidents/{incident_id}", routes.get_incident
+    )
+
+
+class IncidentRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    @property
+    def manager(self):
+        return getattr(self.server, "incidents", None)
+
+    async def list_incidents(self, request: web.Request) -> web.Response:
+        manager = self.manager
+        if manager is None:
+            return web.json_response(DISABLED_HINT)
+        from ..telemetry.flight import peek_flight_recorder
+
+        listing = await run_blocking(manager.list_bundles)
+        recorder = peek_flight_recorder()
+        return web.json_response(
+            {
+                "enabled": True,
+                "incidents": listing,
+                "manager": manager.status(),
+                "flight": recorder.status() if recorder is not None else None,
+            }
+        )
+
+    async def get_incident(self, request: web.Request) -> web.Response:
+        manager = self.manager
+        if manager is None:
+            return web.json_response(DISABLED_HINT, status=404)
+        incident_id = request.match_info["incident_id"]
+        bundle = await run_blocking(lambda: manager.read_bundle(incident_id))
+        if bundle is None:
+            return web.json_response(
+                {"error": f"no such incident: {incident_id}"}, status=404
+            )
+        return web.json_response(bundle)
+
+    async def capture(self, request: web.Request) -> web.Response:
+        """Operator-initiated capture. Optional JSON body:
+        ``{"key": ..., "context": {...}}`` rides into the bundle's
+        trigger section. Runs the capture synchronously off-loop and
+        answers with the written bundle's id."""
+        manager = self.manager
+        if manager is None:
+            return web.json_response(DISABLED_HINT, status=400)
+        key = ""
+        context: dict = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001 - empty/invalid body is fine
+                body = None
+            if isinstance(body, dict):
+                key = str(body.get("key", ""))
+                if isinstance(body.get("context"), dict):
+                    context = body["context"]
+        try:
+            result = await run_blocking(
+                lambda: manager.capture_now(key=key, context=context)
+            )
+        except Exception as exc:  # noqa: BLE001 - reported to the operator
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=500
+            )
+        return web.json_response({"captured": True, **result})

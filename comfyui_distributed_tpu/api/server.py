@@ -196,6 +196,27 @@ class DistributedServer:
                     sink(seconds)
 
             self.durability.append_latency_sink = _journal_latency_fan_out
+        # Incident plane (telemetry/flight.py + telemetry/incidents.py):
+        # the always-on flight recorder taps the process bus so the
+        # last window of events/spans is in memory when something
+        # breaks (CDT_FLIGHT=0 opts out); masters with CDT_INCIDENT_DIR
+        # set get an IncidentManager that captures debug bundles on
+        # alert_fired / poison quarantine / deadline expiry / failover
+        # (and POST .../capture), debounced + rate-limited + retained
+        # under bounded disk. Constructed AFTER the durability manager
+        # so bind_server wires the durability status source (the
+        # bundle's role/epoch/journal section on journaling masters).
+        # Trigger tap + writer thread attach in start(), detach in
+        # stop().
+        from ..telemetry import IncidentManager, get_flight_recorder
+        from ..utils.constants import incident_dir_from_env
+
+        self.flight = get_flight_recorder()
+        self.incidents: Optional[IncidentManager] = None
+        incident_dir = incident_dir_from_env()
+        if incident_dir and not self.is_worker:
+            self.incidents = IncidentManager(incident_dir)
+            self.incidents.bind_server(self)
         # Warm-standby mode (--standby / CDT_STANDBY_OF): this master
         # tails the active's journal stream instead of recovering from
         # disk, and promotes itself when the active's lease expires
@@ -261,6 +282,7 @@ class DistributedServer:
     def _register_routes(self) -> None:
         from . import (
             config_routes,
+            incident_routes,
             job_routes,
             replication_routes,
             scheduler_routes,
@@ -278,6 +300,7 @@ class DistributedServer:
         job_routes.register(self.app, self)
         scheduler_routes.register(self.app, self)
         telemetry_routes.register(self.app, self)
+        incident_routes.register(self.app, self)
         usdu_routes.register(self.app, self)
         config_routes.register(self.app, self)
         worker_routes.register(self.app, self)
@@ -490,6 +513,10 @@ class DistributedServer:
         from ..telemetry import bind_server_collectors
 
         self._unbind_telemetry = bind_server_collectors(self)
+        if self.incidents is not None:
+            # writer thread + trigger tap: alert_fired / quarantine /
+            # deadline / failover events become automatic captures
+            self.incidents.start()
         if self._watchdog_enabled:
             self.watchdog.start()
         if self._fleet_monitor is not None:
@@ -587,6 +614,12 @@ class DistributedServer:
             # pure thread join: the monitor's step touches only the
             # series store and the bus (non-blocking), never this loop
             self._fleet_monitor.stop()
+        if self.incidents is not None:
+            # off-loop: stop joins the writer thread, which may be
+            # mid-fsync on a capture
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.incidents.stop
+            )
         if self.fleet is not None:
             # global-registry hooks must not outlive this server
             from ..resilience.health import get_health_registry as _ghr

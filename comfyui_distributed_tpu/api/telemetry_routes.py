@@ -221,7 +221,11 @@ class TelemetryRoutes:
         ws = web.WebSocketResponse(heartbeat=30)
         await ws.prepare(request)
         bus = get_event_bus()
-        sub = bus.subscribe(types=types)
+        # named per remote so /distributed/system_info's event_bus
+        # stats attribute depth/drops to a specific consumer
+        sub = bus.subscribe(
+            types=types, name=f"ws:{request.remote or 'events'}"
+        )
         from ..resilience.health import get_health_registry
 
         hello = {

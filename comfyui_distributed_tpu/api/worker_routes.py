@@ -281,6 +281,20 @@ class WorkerRoutes:
             # capacity inputs, surfaced for the panel and operators
             "worker_capacity": dict(self.server.job_store.worker_capacity),
         }
+        # Event-bus consumer accounting: per-subscriber queue depth +
+        # cumulative drops, plus the installed synchronous taps — the
+        # flight recorder is an always-on tap, and its ring drops must
+        # be visible here, not silent (docs/observability.md §Incidents)
+        from ..telemetry import get_event_bus, peek_flight_recorder
+
+        info["status"]["event_bus"] = get_event_bus().stats()
+        recorder = peek_flight_recorder()
+        info["status"]["flight"] = (
+            recorder.status() if recorder is not None else {"installed": False}
+        )
+        incidents = getattr(self.server, "incidents", None)
+        if incidents is not None:
+            info["status"]["incidents"] = incidents.status()
         try:
             from ..parallel.mesh import describe_topology, serving_mesh_summary
 

@@ -105,6 +105,15 @@ class ChaosResult:
     alerts: list[dict] = dataclasses.field(default_factory=list)
     # whether any alert was still open when the harness gave up waiting
     slo_active: bool = False
+    # incident bundles captured during the run (populated when
+    # run_chaos_usdu(incidents=...)): the manager's newest-first
+    # listing, plus the directory for offline analysis
+    incidents: list[dict] = dataclasses.field(default_factory=list)
+    incident_dir: str = ""
+    # debounce proof: the disposition of a simulated second identical
+    # alert inside the debounce window ("debounced" when a capture
+    # happened; "" when no alert fired)
+    incident_retrigger: str = ""
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -160,6 +169,7 @@ def run_chaos_usdu(
     journal_dir: Optional[str] = None,
     mesh_devices: int = 0,
     slo: Optional[dict] = None,
+    incidents: Optional[dict] = None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -218,6 +228,20 @@ def run_chaos_usdu(
     the process bus like production). Keys: ``threshold_s``,
     ``objective``, ``long_s``, ``short_s``, ``burn_threshold``,
     ``resolve_hold_s``, ``min_events``.
+
+    `incidents`: pass ``{"dir": <path>, ...overrides}`` to run a live
+    `IncidentManager` (telemetry/incidents.py) over the run — the
+    always-on flight recorder taps the bus, a harness `FleetRegistry`
+    retains per-worker tile-rate series from the latency stream, and
+    the manager's bus tap turns the SLO engine's `alert_fired` into an
+    automatic debug-bundle capture (the production loop, end to end,
+    in one process). Overrides beyond ``dir`` are IncidentManager
+    kwargs (``debounce_s``, ``min_interval_s``, ``max_bundles``,
+    ``max_bytes``); harness defaults: debounce 60 s, no global rate
+    limit, 8 retained bundles. Captured bundles land newest-first in
+    ChaosResult.incidents (+ .incident_dir) — the chaos acceptance
+    asserts the bundle holds the firing evaluation AND the straggler's
+    fleet series while the canvas stays bit-identical.
 
     `tile_batch`/`pipeline`/`prefetch`: the batched-pipelined data path
     (graph/tile_pipeline.py). Worker threads ALWAYS run the production
@@ -320,6 +344,46 @@ def run_chaos_usdu(
             slo_engine.step()
 
         latency_sinks.append(_slo_sink)
+    incident_manager = None
+    incident_fleet = None
+    if incidents is not None:
+        from ..telemetry.fleet import S_WORKER_TILES_PER_S, FleetRegistry
+        from ..telemetry.flight import get_flight_recorder
+        from ..telemetry.incidents import IncidentManager
+
+        if not incidents.get("dir"):
+            raise ValueError("incidents requires a 'dir' key")
+        get_flight_recorder()  # tap the bus before anything publishes
+        incident_fleet = FleetRegistry()
+        inc_kwargs = dict(debounce_s=60.0, min_interval_s=0.0, max_bundles=8)
+        inc_kwargs.update(
+            {k: v for k, v in incidents.items() if k != "dir"}
+        )
+        incident_manager = IncidentManager(str(incidents["dir"]), **inc_kwargs)
+        incident_manager.sources["store"] = store.stats_unlocked
+        if wd_health is not None:
+            incident_manager.sources["health"] = wd_health.snapshot
+        if slo_engine is not None:
+            incident_manager.sources["slo"] = slo_engine.status
+        incident_manager.sources["fleet"] = (
+            lambda: incident_fleet.status(since_s=600.0)
+        )
+
+        def _fleet_sink(wid: str, seconds: float) -> None:
+            # per-worker tile-rate series on the harness registry: the
+            # straggler's slow rate is the evidence the bundle's fleet
+            # window must carry
+            incident_fleet.store.record(
+                S_WORKER_TILES_PER_S,
+                (1.0 / seconds) if seconds > 0 else 0.0,
+                worker_id=wid,
+            )
+
+        # FIRST in the fan-out: the sample that makes the SLO engine
+        # fire (and thus capture) must already be in the fleet series
+        # when the writer thread reads them — sink order is the only
+        # thing keeping that race deterministic
+        latency_sinks.insert(0, _fleet_sink)
     policy = None
     if placement is not None:
         from ..scheduler.placement import PlacementPolicy
@@ -464,6 +528,12 @@ def run_chaos_usdu(
     ]
 
     previous_tracer = get_tracer()
+    if incident_manager is not None:
+        # writer thread + bus trigger tap (alert_fired -> capture) —
+        # started HERE, immediately before the guarded try, so any
+        # raise in the remaining setup or the run itself reaches the
+        # except arm that stops it (no leaked tap/thread)
+        incident_manager.start()
     set_tracer(chaos_tracer)
     try:
         with contextlib.ExitStack() as stack:
@@ -518,6 +588,14 @@ def run_chaos_usdu(
                 chaos_tracer.deactivate(token)
         if trace_jsonl:
             chaos_tracer.write_jsonl(trace_id, trace_jsonl)
+    except BaseException:
+        # a raising run must not leak the incident plane: the bus tap
+        # would keep capturing for unrelated later activity and the
+        # writer thread would park on its queue forever (stop is
+        # idempotent — the happy path below stops it again harmlessly)
+        if incident_manager is not None:
+            incident_manager.stop()
+        raise
     finally:
         set_tracer(previous_tracer)
         if durability is not None:
@@ -535,6 +613,27 @@ def run_chaos_usdu(
         ):
             slo_engine.step()
             time.sleep(0.02)
+    incident_list: list[dict] = []
+    incident_retrigger = ""
+    if incident_manager is not None:
+        # barrier: every queued capture written before the listing (a
+        # trigger that fired in the final submit must not race)
+        incident_manager.flush(10.0)
+        if slo_engine is not None:
+            fired = [
+                a for a in slo_engine.history if a["type"] == "alert_fired"
+            ]
+            if fired:
+                # debounce proof: a second identical alert inside the
+                # window must capture NOTHING
+                incident_retrigger = incident_manager.trigger(
+                    "alert_fired",
+                    key=str(fired[0].get("slo", "")),
+                    context={"resimulated": True},
+                )
+                incident_manager.flush(5.0)
+        incident_list = incident_manager.list_bundles()
+        incident_manager.stop()
     # every tile is accepted exactly once (first result wins), so the
     # master's share is the remainder (plan_grid: geometry only, no
     # second resize/extract pass)
@@ -558,6 +657,9 @@ def run_chaos_usdu(
             if slo_engine is not None
             else False
         ),
+        incidents=incident_list,
+        incident_dir=str(incidents["dir"]) if incidents else "",
+        incident_retrigger=incident_retrigger,
     )
 
 

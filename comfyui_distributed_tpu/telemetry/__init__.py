@@ -53,6 +53,13 @@ from .tracing import (
 )
 from .events import EventBus, get_event_bus, reset_event_bus
 from .fleet import FleetMonitor, FleetRegistry, local_snapshot
+from .flight import (
+    FlightRecorder,
+    get_flight_recorder,
+    peek_flight_recorder,
+    reset_flight_recorder,
+)
+from .incidents import IncidentManager, validate_bundle
 from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
 from .timeseries import SeriesStore
 from .watchdog import Watchdog
@@ -64,7 +71,9 @@ __all__ = [
     "EventBus",
     "FleetMonitor",
     "FleetRegistry",
+    "FlightRecorder",
     "Gauge",
+    "IncidentManager",
     "Histogram",
     "MetricsRegistry",
     "SLOEngine",
@@ -79,10 +88,14 @@ __all__ = [
     "bind_server_collectors",
     "current_trace_id",
     "get_event_bus",
+    "get_flight_recorder",
     "get_metrics_registry",
     "get_tracer",
+    "peek_flight_recorder",
     "reset_event_bus",
+    "reset_flight_recorder",
     "reset_metrics_registry",
     "reset_tracer",
     "set_tracer",
+    "validate_bundle",
 ]

@@ -19,9 +19,11 @@ stream over WebSocket; docs/observability.md documents the wire schema.
 
 Design constraints:
 
-- **zero cost without subscribers**: `publish` is one lock-free
-  subscriber-count check when nobody is listening, so the metric and
-  span hot paths pay nothing in normal operation;
+- **zero cost without listeners**: `publish` is one lock-free
+  listener check when nobody is on (no queue subscriber AND no
+  synchronous tap), so the metric and span hot paths pay nothing in
+  normal operation; with only the flight-recorder tap installed
+  (telemetry/flight.py) the cost is one dict build + ring append;
 - **publishers never block**: events are handed to subscriber loops
   via `call_soon_threadsafe`; a slow consumer's queue drops its OLDEST
   events (the consumer learns via the subscription's `dropped` count)
@@ -46,19 +48,21 @@ class Subscription:
     that called `EventBus.subscribe`. `get()` awaits the next event;
     `dropped` counts events discarded because the queue was full."""
 
-    __slots__ = ("loop", "queue", "types", "dropped", "closed")
+    __slots__ = ("loop", "queue", "types", "dropped", "closed", "name")
 
     def __init__(
         self,
         loop: asyncio.AbstractEventLoop,
         maxsize: int,
         types: Optional[frozenset[str]],
+        name: str = "subscriber",
     ) -> None:
         self.loop = loop
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self.types = types
         self.dropped = 0
         self.closed = False
+        self.name = name
 
     def wants(self, event_type: str) -> bool:
         return self.types is None or event_type in self.types
@@ -86,7 +90,14 @@ class EventBus:
         self._clock = clock
         self._lock = threading.Lock()
         self._subs: list[Subscription] = []
+        # Synchronous taps: (name, callable) pairs invoked INLINE in
+        # publish (no queue, no loop hop). The flight recorder
+        # (telemetry/flight.py) and the incident trigger watcher
+        # (telemetry/incidents.py) ride here — a tap must be cheap
+        # (ring append / debounce check) and never raise.
+        self._taps: list[tuple[str, Any]] = []
         self._seq = 0
+        self._sub_seq = 0
         self.published = 0  # plain ints: bus internals must not publish
 
     @property
@@ -95,24 +106,76 @@ class EventBus:
         # must not contend with the publish path
         return len(self._subs)
 
+    @property
+    def has_listeners(self) -> bool:
+        """True when ANYTHING (queue subscriber or synchronous tap)
+        would see a published event — the forwarding hooks' fast-path
+        check, so metric/span hot paths stay free with nobody on."""
+        return bool(self._subs) or bool(self._taps)
+
     def subscribe(
         self,
         types: Optional[Iterable[str]] = None,
         maxsize: Optional[int] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
+        name: str = "subscriber",
     ) -> Subscription:
         """Register a consumer on the CURRENT running loop (or `loop`).
         `types` filters bus-side so unwanted events never hit the
-        queue; None subscribes to everything."""
+        queue; None subscribes to everything. `name` labels the
+        subscription in `stats()` (and the scrape gauges); a
+        bus-unique `#n` suffix is appended so two consumers with the
+        same name (two panel tabs from one IP) never alias each
+        other's depth/drop series."""
         loop = loop or asyncio.get_running_loop()
-        sub = Subscription(
-            loop,
-            maxsize if maxsize is not None else EVENT_QUEUE_SIZE,
-            frozenset(types) if types is not None else None,
-        )
         with self._lock:
+            self._sub_seq += 1
+            sub = Subscription(
+                loop,
+                maxsize if maxsize is not None else EVENT_QUEUE_SIZE,
+                frozenset(types) if types is not None else None,
+                name=f"{name}#{self._sub_seq}",
+            )
             self._subs.append(sub)
         return sub
+
+    def add_tap(self, fn, name: str = "tap"):
+        """Install a synchronous tap called with every published event
+        dict, from the PUBLISHING thread. Returns a zero-arg remove
+        callable. Tap errors are swallowed (a broken observer must not
+        break the pipeline it observes)."""
+        entry = (name, fn)
+        with self._lock:
+            self._taps.append(entry)
+
+        def remove() -> None:
+            with self._lock:
+                if entry in self._taps:
+                    self._taps.remove(entry)
+
+        return remove
+
+    def stats(self) -> dict[str, Any]:
+        """Per-consumer accounting for /distributed/system_info and
+        the scrape gauges: every queue subscriber's depth + cumulative
+        drops, and the installed synchronous taps. Queue depth is a
+        best-effort cross-thread read (qsize is a plain len)."""
+        with self._lock:
+            subs = list(self._subs)
+            taps = list(self._taps)
+        return {
+            "published": self.published,
+            "subscribers": [
+                {
+                    "name": sub.name,
+                    "types": sorted(sub.types) if sub.types is not None else "all",
+                    "queue_depth": sub.queue.qsize(),
+                    "dropped": sub.dropped,
+                }
+                for sub in subs
+            ],
+            "taps": [name for name, _fn in taps],
+        }
 
     def unsubscribe(self, sub: Subscription) -> None:
         sub.closed = True
@@ -121,9 +184,10 @@ class EventBus:
                 self._subs.remove(sub)
 
     def publish(self, event_type: str, **data: Any) -> None:
-        """Fan one event out to every matching subscriber; callable
-        from any thread; never raises, never blocks."""
-        if not self._subs:
+        """Fan one event out to every matching subscriber (queued) and
+        tap (inline); callable from any thread; never raises, never
+        blocks."""
+        if not self._subs and not self._taps:
             return
         with self._lock:
             self._seq += 1
@@ -134,8 +198,14 @@ class EventBus:
                 "data": data,
             }
             targets = [s for s in self._subs if s.wants(event_type)]
-            if targets:
+            taps = list(self._taps)
+            if targets or taps:
                 self.published += 1
+        for _name, tap in taps:
+            try:
+                tap(event)
+            except Exception:  # noqa: BLE001 - taps must not break publish
+                pass
         dead: list[Subscription] = []
         for sub in targets:
             try:
@@ -156,7 +226,7 @@ def _forward_metric(kind, name, labelnames, labelvalues, value) -> None:
     `value` is the increment for counters, the new value for gauges,
     and the observation for histograms."""
     bus = get_event_bus()
-    if not bus.subscriber_count or getattr(_suppress, "active", False):
+    if not bus.has_listeners or getattr(_suppress, "active", False):
         return
     _suppress.active = True
     try:
@@ -174,7 +244,7 @@ def _forward_metric(kind, name, labelnames, labelvalues, value) -> None:
 def _forward_span(phase: str, span) -> None:
     """telemetry.tracing span listener → span_open / span_close."""
     bus = get_event_bus()
-    if not bus.subscriber_count or getattr(_suppress, "active", False):
+    if not bus.has_listeners or getattr(_suppress, "active", False):
         return
     _suppress.active = True
     try:

@@ -1,0 +1,214 @@
+"""Always-on flight recorder: the black box an incident bundle reads.
+
+PR 12 gave the master burn-rate alerts, but by the time `alert_fired`
+lands the evidence is gone: bus frames are unretained past connected
+subscribers and trace spans age out of bounded retention. This module
+keeps the last window of *everything* in memory, all the time, so the
+incident manager (telemetry/incidents.py) can snapshot it AFTER a
+trigger and still hold the frames from BEFORE it — the aircraft
+flight-recorder idiom.
+
+Mechanics:
+
+- a synchronous `EventBus` tap (`EventBus.add_tap`) receives every
+  published event inline and appends it to a bounded drop-oldest ring;
+  `span_close` frames are routed to their own ring so a metric-delta
+  firehose cannot evict the span history an incident analysis needs;
+- rings are `collections.deque(maxlen=...)` under a small lock, with
+  explicit drop counters (`cdt_flight_dropped_total{stream}` mirrors
+  them at scrape time — the tap itself never touches a metric, which
+  would recurse through the forwarding hook);
+- cost model: with the recorder installed the bus is never in its
+  zero-listener fast path, so every metric mutation and span close
+  pays one event-dict build + one ring append. That is the designed
+  price of postmortem-grade observability (CDT_FLIGHT=0 refuses it);
+- `dump()` returns a JSON-able snapshot (events, spans, drop/append
+  accounting) — the `flight` section of every incident bundle.
+
+The recorder is process-global (`get_flight_recorder()`), created and
+re-installed lazily: after a test resets the event bus, the next
+`get_flight_recorder()` call re-taps the current bus.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..utils import constants
+
+# Compact span-record vocabulary kept in the span ring (a full
+# span_close payload also carries attrs — kept, they are small and
+# carry tile_idx/role/stage the critical-path analyzer needs).
+SPAN_STREAM = "spans"
+EVENT_STREAM = "events"
+
+
+class FlightRing:
+    """Bounded drop-oldest ring with append/drop accounting, safe to
+    append from any thread (the bus tap runs on publishing threads)."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=self.capacity)
+        self.appended = 0
+        self.dropped = 0
+
+    def append(self, item: Any) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self.dropped += 1
+            self._buf.append(item)
+            self.appended += 1
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+class FlightRecorder:
+    """Tails the process event bus (all types) into bounded rings."""
+
+    def __init__(
+        self,
+        event_capacity: Optional[int] = None,
+        span_capacity: Optional[int] = None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self._clock = clock
+        self.events = FlightRing(
+            event_capacity
+            if event_capacity is not None
+            else constants.FLIGHT_EVENT_CAPACITY
+        )
+        self.spans = FlightRing(
+            span_capacity
+            if span_capacity is not None
+            else constants.FLIGHT_SPAN_CAPACITY
+        )
+        self.started_at = clock()
+        self._remove_tap: Optional[Callable[[], None]] = None
+        self._tapped_bus: Any = None
+        # per-stream drop totals already mirrored into the scrape
+        # counter — lives HERE (not per collector closure) so two
+        # co-hosted servers' collectors share one high-water mark and
+        # the process-global counter never double-counts a drop
+        self.scrape_mirrored: dict[str, int] = {}
+
+    # --- bus wiring -------------------------------------------------------
+
+    def install(self, bus: Any = None) -> None:
+        """Tap `bus` (default: the current global bus). Idempotent per
+        bus; re-installing after a bus reset moves the tap to the new
+        bus (the old one is gone with its subscribers)."""
+        from .events import get_event_bus
+
+        bus = bus if bus is not None else get_event_bus()
+        if bus is self._tapped_bus:
+            return
+        self.uninstall()
+        self._remove_tap = bus.add_tap(self._tap, name="flight")
+        self._tapped_bus = bus
+
+    def uninstall(self) -> None:
+        remove, self._remove_tap = self._remove_tap, None
+        self._tapped_bus = None
+        if remove is not None:
+            remove()
+
+    @property
+    def installed(self) -> bool:
+        return self._remove_tap is not None
+
+    def _tap(self, event: dict[str, Any]) -> None:
+        """Runs inline on the PUBLISHING thread: one ring append, no
+        metrics, no locks beyond the ring's own."""
+        if event.get("type") == "span_close":
+            self.spans.append(event)
+        else:
+            self.events.append(event)
+
+    # --- surfaces ---------------------------------------------------------
+
+    def drop_totals(self) -> dict[str, int]:
+        return {
+            EVENT_STREAM: self.events.dropped,
+            SPAN_STREAM: self.spans.dropped,
+        }
+
+    def status(self) -> dict[str, Any]:
+        """Cheap accounting summary (system_info / incidents route)."""
+        return {
+            "installed": self.installed,
+            "capacity": {
+                EVENT_STREAM: self.events.capacity,
+                SPAN_STREAM: self.spans.capacity,
+            },
+            "retained": {
+                EVENT_STREAM: len(self.events),
+                SPAN_STREAM: len(self.spans),
+            },
+            "appended": {
+                EVENT_STREAM: self.events.appended,
+                SPAN_STREAM: self.spans.appended,
+            },
+            "dropped": self.drop_totals(),
+        }
+
+    def dump(self) -> dict[str, Any]:
+        """The incident bundle's `flight` section: both rings plus the
+        accounting needed to read them honestly (how much history the
+        rings dropped before the capture)."""
+        return {
+            "captured_at": self._clock(),
+            "started_at": self.started_at,
+            "events": self.events.snapshot(),
+            "spans": self.spans.snapshot(),
+            "appended": {
+                EVENT_STREAM: self.events.appended,
+                SPAN_STREAM: self.spans.appended,
+            },
+            "dropped": self.drop_totals(),
+        }
+
+
+# --- global recorder --------------------------------------------------------
+
+_recorder: FlightRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    """The process-global recorder, created on first call and
+    (re-)installed on the CURRENT event bus. Returns None when
+    CDT_FLIGHT=0 — callers treat a disabled recorder as absent."""
+    if not constants.FLIGHT_ENABLED:
+        return None
+    global _recorder
+    with _recorder_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        _recorder.install()
+        return _recorder
+
+
+def peek_flight_recorder() -> Optional[FlightRecorder]:
+    """The recorder if one exists — never creates or re-taps (scrape
+    collectors read accounting without changing wiring)."""
+    return _recorder
+
+
+def reset_flight_recorder() -> None:
+    """Drop the global recorder (tests); the next get re-creates."""
+    global _recorder
+    with _recorder_lock:
+        if _recorder is not None:
+            _recorder.uninstall()
+        _recorder = None

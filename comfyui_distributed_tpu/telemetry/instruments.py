@@ -457,6 +457,52 @@ def fleet_series() -> Gauge:
     )
 
 
+# --- incident plane (telemetry/flight.py, telemetry/incidents.py) ---------
+
+def incidents_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_incidents_total",
+        "Incident debug bundles captured, by trigger "
+        "(alert_fired|tile_quarantined|job_deadline|failover|manual)",
+        ("trigger",),
+    )
+
+
+def incident_capture_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_incident_capture_seconds",
+        "Wall time of one incident-bundle capture (gather + serialize "
+        "+ atomic write + prune) on the single-flight writer thread",
+    )
+
+
+def flight_dropped_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_flight_dropped_total",
+        "Flight-recorder ring evictions by stream (events|spans) — "
+        "history lost to the bounded window before any capture",
+        ("stream",),
+    )
+
+
+def event_subscriber_queue_depth() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_event_subscriber_queue_depth",
+        "Events queued per event-bus subscriber at scrape time "
+        "(bounded by CDT_EVENT_QUEUE_SIZE)",
+        ("subscriber",),
+    )
+
+
+def event_subscriber_dropped() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_event_subscriber_dropped",
+        "Cumulative drop-oldest evictions per event-bus subscriber "
+        "(a slow consumer loses its oldest events, never the bus)",
+        ("subscriber",),
+    )
+
+
 def alert_active() -> Gauge:
     return get_metrics_registry().gauge(
         "cdt_alert_active",
@@ -655,6 +701,16 @@ def bind_server_collectors(server) -> Callable[[], None]:
         fleet_series()
         alert_active()
         slo_burn_rate()
+    # Incident-plane instruments present from the first scrape: the
+    # flight drop counter whenever a recorder exists, the capture
+    # instruments on masters running an incident manager.
+    from .flight import peek_flight_recorder
+
+    if peek_flight_recorder() is not None:
+        flight_dropped_total()
+    if getattr(server, "incidents", None) is not None:
+        incidents_total()
+        incident_capture_seconds()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale
@@ -720,6 +776,36 @@ def bind_server_collectors(server) -> Callable[[], None]:
             lag_seconds = replica.lag_seconds()
             if lag_seconds is not None:
                 replication_lag_seconds().set(lag_seconds)
+        # Event-bus consumer accounting (the flight recorder is an
+        # always-on tap; a parked WS subscriber is a queue): depth +
+        # cumulative drops per subscriber. Clear-then-refill so a
+        # departed subscriber's series drops instead of freezing.
+        from .events import get_event_bus
+        from .flight import peek_flight_recorder as _peek_flight
+
+        bus_stats = get_event_bus().stats()
+        depth_gauge = event_subscriber_queue_depth()
+        drop_gauge = event_subscriber_dropped()
+        depth_gauge.clear()
+        drop_gauge.clear()
+        for sub_stats in bus_stats["subscribers"]:
+            depth_gauge.set(
+                sub_stats["queue_depth"], subscriber=sub_stats["name"]
+            )
+            drop_gauge.set(sub_stats["dropped"], subscriber=sub_stats["name"])
+        # flight-ring drops are plain ints on the recorder (the tap
+        # must not touch metrics — it runs inside publish); the
+        # counter mirrors them by DELTA at scrape time against the
+        # recorder's own high-water mark, shared across co-hosted
+        # servers' collectors so a drop is counted exactly once
+        recorder = _peek_flight()
+        if recorder is not None:
+            drop_counter = flight_dropped_total()
+            for stream, dropped in recorder.drop_totals().items():
+                delta = dropped - recorder.scrape_mirrored.get(stream, 0)
+                if delta > 0:
+                    drop_counter.inc(delta, stream=stream)
+                    recorder.scrape_mirrored[stream] = dropped
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at
@@ -736,6 +822,8 @@ def bind_server_collectors(server) -> Callable[[], None]:
         unregister()
         for accessor in _LIVE_GAUGES:
             accessor().remove(server=label)
+        event_subscriber_queue_depth().clear()
+        event_subscriber_dropped().clear()
         slo = getattr(server, "slo", None)
         if slo is not None:
             for spec_name in slo.specs:

@@ -261,6 +261,35 @@ SLO_JOURNAL_P95_SECONDS = _env_float("CDT_SLO_JOURNAL_P95", 0.25)
 # is told how many via the subscription's dropped count.
 EVENT_QUEUE_SIZE = _env_int("CDT_EVENT_QUEUE_SIZE", 512)
 
+# --- incident plane (telemetry/flight.py, telemetry/incidents.py) ---------
+# Always-on flight recorder: a synchronous bus tap keeps the last N
+# events and span closes in cheap drop-oldest ring buffers so an
+# incident bundle captured AFTER a trigger still holds the evidence
+# from BEFORE it. CDT_FLIGHT=0 disables the recorder entirely.
+FLIGHT_ENABLED = os.environ.get("CDT_FLIGHT", "1") != "0"
+FLIGHT_EVENT_CAPACITY = _env_int("CDT_FLIGHT_EVENTS", 2048)
+FLIGHT_SPAN_CAPACITY = _env_int("CDT_FLIGHT_SPANS", 2048)
+# Incident debug bundles: captured into CDT_INCIDENT_DIR (unset =
+# incident manager disabled, the journal-dir idiom) on alert_fired /
+# poison quarantine / deadline expiry / failover / manual POST.
+INCIDENT_DEBOUNCE_SECONDS = _env_float("CDT_INCIDENT_DEBOUNCE", 300.0)
+# Global floor between captures regardless of trigger key — an alert
+# storm across MANY distinct keys still cannot melt the disk.
+INCIDENT_MIN_INTERVAL_SECONDS = _env_float("CDT_INCIDENT_MIN_INTERVAL", 10.0)
+# Retention: prune-oldest beyond this many bundles or this many MB.
+INCIDENT_MAX_BUNDLES = _env_int("CDT_INCIDENT_MAX", 32)
+INCIDENT_MAX_MB = _env_float("CDT_INCIDENT_MAX_MB", 64.0)
+# Seconds of retained fleet history pulled into a bundle around the
+# trigger (the FleetRegistry ?since= window).
+INCIDENT_WINDOW_SECONDS = _env_float("CDT_INCIDENT_WINDOW", 600.0)
+
+
+def incident_dir_from_env() -> str | None:
+    """CDT_INCIDENT_DIR resolved at call time (tests monkeypatch the
+    env); empty/unset disables the incident manager."""
+    raw = os.environ.get("CDT_INCIDENT_DIR", "").strip()
+    return raw or None
+
 # --- job init races ------------------------------------------------------
 # Grace period a result-submission endpoint waits for the master-side queue
 # to be created (reference api/job_routes.py:314-333), and the worker-side

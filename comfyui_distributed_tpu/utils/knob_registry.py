@@ -229,6 +229,31 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_SLO_JOURNAL_P95", "0.25", "telemetry",
          "Journal-append latency target the journal_latency SLO "
          "classifies samples against (seconds)."),
+    # --- incident plane --------------------------------------------------
+    Knob("CDT_FLIGHT", "1", "incidents",
+         "`0` disables the always-on flight recorder (the bus tap that "
+         "retains recent events + span closes for incident bundles)."),
+    Knob("CDT_FLIGHT_EVENTS", "2048", "incidents",
+         "Flight-recorder event ring capacity (drop-oldest; drops counted "
+         "in cdt_flight_dropped_total)."),
+    Knob("CDT_FLIGHT_SPANS", "2048", "incidents",
+         "Flight-recorder span-close ring capacity (drop-oldest)."),
+    Knob("CDT_INCIDENT_DIR", "unset", "incidents",
+         "Directory incident debug bundles are captured into; unset "
+         "disables the incident manager (the CDT_JOURNAL_DIR idiom)."),
+    Knob("CDT_INCIDENT_DEBOUNCE", "300.0", "incidents",
+         "Seconds a trigger key (e.g. one SLO's alert) is debounced after "
+         "a capture — a re-firing alert inside the window captures nothing."),
+    Knob("CDT_INCIDENT_MIN_INTERVAL", "10.0", "incidents",
+         "Global floor in seconds between ANY two automatic captures — an "
+         "alert storm across many keys still cannot melt the disk."),
+    Knob("CDT_INCIDENT_MAX", "32", "incidents",
+         "Retained bundle count; the oldest bundles are pruned beyond it."),
+    Knob("CDT_INCIDENT_MAX_MB", "64.0", "incidents",
+         "Total on-disk bundle budget in MB; oldest pruned beyond it."),
+    Knob("CDT_INCIDENT_WINDOW", "600.0", "incidents",
+         "Seconds of retained fleet history pulled into a bundle around "
+         "the trigger (the /distributed/fleet ?since= window)."),
     # --- jobs ------------------------------------------------------------
     Knob("CDT_JOB_INIT_GRACE", "10.0", "jobs",
          "Seconds result submission waits for the master-side queue to appear."),

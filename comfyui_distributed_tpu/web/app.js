@@ -40,6 +40,7 @@ import {
 import {
   durabilityHtml,
   fleetHtml,
+  incidentsHtml,
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
@@ -98,6 +99,7 @@ async function refreshStatus() {
   refreshPipeline();
   refreshDurability();
   refreshFleet();
+  refreshIncidents();
   schedulePoll();
 }
 
@@ -151,6 +153,17 @@ async function refreshFleet() {
     container.innerHTML = fleetHtml(fleet, alerts);
   } catch {
     container.textContent = "fleet status unreachable";
+  }
+}
+
+// ---------- incidents card ----------
+
+async function refreshIncidents() {
+  const container = document.getElementById("incidents");
+  try {
+    container.innerHTML = incidentsHtml(await api("/distributed/incidents"));
+  } catch {
+    container.textContent = "incident status unreachable";
   }
 }
 
@@ -214,6 +227,9 @@ function startEventStream() {
         // the fleet card is stream-fed: each pushed rollup / alert
         // transition refreshes it without waiting for the slow poll
         refreshFleet();
+      } else if (event.type === "incident_captured") {
+        // a bundle just landed; show it without waiting for the poll
+        refreshIncidents();
       }
     },
     onStatus: (connected) => {

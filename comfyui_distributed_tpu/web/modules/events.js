@@ -25,6 +25,7 @@ export const EVENT_TYPES = [
   "fleet_rollup",
   "alert_fired",
   "alert_resolved",
+  "incident_captured",
 ];
 
 export const MAX_LIVE_EVENTS = 20;
@@ -103,6 +104,10 @@ export function eventLabel(event) {
           ? ""
           : ` (open ${Number(d.active_seconds).toFixed(0)}s)`
       }`;
+    case "incident_captured":
+      return `incident bundle captured: ${d.id} (${d.trigger}${
+        d.key ? `:${d.key}` : ""
+      })`;
     case "fleet_rollup":
       return null; // rendered as the fleet card, not an event line
     case "events_dropped":

@@ -275,6 +275,49 @@ export function fleetHtml(fleet, alerts) {
   );
 }
 
+/** Incidents card (pure; app.js refreshIncidents applies it): the
+ * newest-first bundle listing from GET /distributed/incidents plus
+ * flight-recorder accounting; pushed `incident_captured` events
+ * refresh the same card between polls. */
+export function incidentsHtml(info) {
+  if (!info) return '<span class="meta">incident status unavailable</span>';
+  if (info.enabled === false) {
+    return '<span class="meta">incident capture off — set CDT_INCIDENT_DIR to enable</span>';
+  }
+  const flight = info.flight || {};
+  const dropped = flight.dropped || {};
+  const retained = flight.retained || {};
+  const flightLine =
+    `<div class="row"><strong>flight</strong><span class="meta">` +
+    `${Number(retained.events ?? 0)} event(s) + ` +
+    `${Number(retained.spans ?? 0)} span(s) retained` +
+    `${
+      Number(dropped.events ?? 0) + Number(dropped.spans ?? 0)
+        ? ` · ${Number(dropped.events ?? 0) + Number(dropped.spans ?? 0)} dropped`
+        : ""
+    }</span></div>`;
+  const counters = (info.manager || {}).counters || {};
+  const counterLine =
+    `<div class="row"><span class="meta">captured ${counters.captured ?? 0}` +
+    ` · debounced ${counters.debounced ?? 0}` +
+    ` · rate-limited ${counters.rate_limited ?? 0}</span></div>`;
+  const bundles = (info.incidents || [])
+    .slice(0, 8)
+    .map(
+      (b) =>
+        `<div class="row"><strong>${escapeHtml(b.trigger || "?")}</strong>` +
+        `<span class="meta mono">${escapeHtml(b.id || "")}` +
+        ` · ${(Number(b.bytes ?? 0) / 1024).toFixed(1)} KiB</span></div>`
+    )
+    .join("");
+  return (
+    flightLine +
+    counterLine +
+    (bundles ||
+      '<div class="row"><span class="meta">no incident bundles captured</span></div>')
+  );
+}
+
 /** Durable-control-plane card (pure; app.js refreshDurability applies
  * it): journal head + segment count, last snapshot lsn/age, the
  * post-recovery admission hold, and the last recovery's report — the

@@ -187,3 +187,17 @@ test("eventLabel: alert transitions readable, fleet_rollup silent", () => {
   );
   assertEqual(eventLabel({ type: "fleet_rollup", data: {} }), null);
 });
+
+test("eventLabel: incident captures render with trigger and key", () => {
+  assertIncludes(
+    eventLabel({
+      type: "incident_captured",
+      data: {
+        id: "incident-0000000001000-0001-alert_fired",
+        trigger: "alert_fired",
+        key: "tile_latency",
+      },
+    }),
+    "alert_fired:tile_latency"
+  );
+});

@@ -7,6 +7,7 @@ import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
   dividerNodeHtml,
   fleetHtml,
+  incidentsHtml,
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
@@ -265,4 +266,37 @@ test("fleetHtml: disabled / rollup + workers / alert strip", () => {
   const burning = fleetHtml(fleet, { active: ["tile_latency"] });
   assertIncludes(burning, "ALERT");
   assertIncludes(burning, "tile_latency");
+});
+
+test("incidentsHtml: disabled / flight accounting / bundle rows", () => {
+  assertIncludes(incidentsHtml(null), "unavailable");
+  assertIncludes(incidentsHtml({ enabled: false }), "CDT_INCIDENT_DIR");
+  const info = {
+    enabled: true,
+    flight: {
+      retained: { events: 120, spans: 40 },
+      dropped: { events: 3, spans: 0 },
+    },
+    manager: { counters: { captured: 2, debounced: 1, rate_limited: 0 } },
+    incidents: [
+      {
+        id: "incident-0000000001000-0001-alert_fired",
+        trigger: "alert_fired",
+        ts: 1.0,
+        bytes: 2048,
+      },
+    ],
+  };
+  const html = incidentsHtml(info);
+  assertIncludes(html, "120 event(s)");
+  assertIncludes(html, "3 dropped");
+  assertIncludes(html, "captured 2");
+  assertIncludes(html, "debounced 1");
+  assertIncludes(html, "alert_fired");
+  assertIncludes(html, "incident-0000000001000-0001-alert_fired");
+  assertIncludes(html, "2.0 KiB");
+  assertIncludes(
+    incidentsHtml({ enabled: true, incidents: [] }),
+    "no incident bundles"
+  );
 });

@@ -1,0 +1,343 @@
+#!/usr/bin/env python3
+"""Offline critical-path analysis of an incident bundle (or trace).
+
+Input: an incident debug bundle written by the IncidentManager
+(telemetry/incidents.py) — or a bare trace JSONL export — with the
+producing process long dead. Output: each job's wall time attributed
+across the tile lifecycle's stages
+
+    queue_wait -> grant_rtt -> sample -> encode_submit -> blend
+
+plus `other` (wall time no instrumented stage covered), with the
+DOMINANT stage named per job and in aggregate. Attribution is exact by
+construction: a priority sweep assigns every instant of the job's wall
+window to exactly one category (compute outranks I/O outranks waiting
+when spans overlap — pipelined I/O that rides under sampling is
+correctly credited to sampling), so the per-stage seconds sum to the
+wall time to float precision.
+
+Stdlib only; importable (scripts/perf_report.py reuses
+`critical_path` for its --critical-path column; tests call the pieces
+directly) and runnable:
+
+    python scripts/incident_report.py incident-....json [--json]
+    python scripts/incident_report.py trace.jsonl [--trace TRACE_ID]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+# Stage categories in PRIORITY order (first wins where spans overlap):
+# device compute > master-side blend work > worker I/O > the pull RTT
+# > admission queue wait. `other` is the uncovered remainder.
+STAGE_PRIORITY = (
+    "sample",
+    "blend",
+    "encode_submit",
+    "grant_rtt",
+    "queue_wait",
+)
+OTHER = "other"
+
+# span -> category mapping: `attrs.stage` values from the elastic tile
+# pipeline's cdt_tile_stage_seconds spans, plus the scheduler's
+# admission-wait span and the pull RPC span names.
+_STAGE_ATTR_MAP = {
+    "sample": "sample",
+    "readback": "encode_submit",
+    "encode": "encode_submit",
+    "submit": "encode_submit",
+    "decode": "blend",
+    "blend": "blend",
+    "pull": "grant_rtt",
+}
+_NAME_MAP = {
+    "sched.wait": "queue_wait",
+    "tile.pull": "grant_rtt",
+    "rpc.request_image": "grant_rtt",
+}
+
+
+def load_document(path: str) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
+    """(bundle, spans): bundle is None for a trace JSONL. A bundle is
+    ONE JSON document (a dict) carrying bundle markers — a single-line
+    JSONL also parses whole, so the markers (not parseability) decide:
+    a one-span trace must not read as an empty bundle."""
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if text.lstrip().startswith("{"):
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and any(
+            key in doc for key in ("schema", "trigger", "flight", "trace")
+        ):
+            return doc, bundle_spans(doc)
+    spans = []
+    for line_no, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            spans.append(json.loads(line))
+        except json.JSONDecodeError as exc:
+            raise SystemExit(f"{path}:{line_no}: bad JSON line: {exc}")
+    return None, spans
+
+
+def load_spans(path: str) -> list[dict[str, Any]]:
+    """Spans from a trace JSONL (one span per line) or an incident
+    bundle JSON (trace section + flight span_close frames, de-duped)."""
+    return load_document(path)[1]
+
+
+def bundle_spans(bundle: dict[str, Any]) -> list[dict[str, Any]]:
+    """Merge the bundle's trace-section spans with the flight ring's
+    span_close frames (the ring may hold spans of OTHER jobs the trace
+    section doesn't — an incident is rarely about one job alone)."""
+    spans: list[dict[str, Any]] = []
+    seen: set[tuple] = set()
+
+    def add(span: dict[str, Any]) -> None:
+        key = (span.get("trace_id"), span.get("span_id"), span.get("start"))
+        if key in seen:
+            return
+        seen.add(key)
+        spans.append(span)
+
+    trace = bundle.get("trace") or {}
+    for span in trace.get("spans") or []:
+        if isinstance(span, dict):
+            add(span)
+    flight = bundle.get("flight") or {}
+    for frame in flight.get("spans") or []:
+        data = frame.get("data") if isinstance(frame, dict) else None
+        if isinstance(data, dict) and data.get("trace_id"):
+            add(data)
+    return spans
+
+
+def _category(span: dict[str, Any]) -> str | None:
+    attrs = span.get("attrs") or {}
+    stage = attrs.get("stage")
+    if stage in _STAGE_ATTR_MAP:
+        return _STAGE_ATTR_MAP[stage]
+    return _NAME_MAP.get(span.get("name"))
+
+
+def _finished_interval(span: dict[str, Any]) -> tuple[float, float] | None:
+    start = span.get("start")
+    end = span.get("end")
+    if end is None and span.get("duration") is not None and start is not None:
+        end = float(start) + float(span["duration"])
+    if start is None or end is None:
+        return None
+    start, end = float(start), float(end)
+    if end < start:
+        return None
+    return start, end
+
+
+def _sweep(
+    window: tuple[float, float],
+    by_category: dict[str, list[tuple[float, float]]],
+) -> dict[str, float]:
+    """Assign every instant of `window` to the highest-priority
+    category covering it; the returned seconds (including OTHER) sum
+    to the window width exactly. Sweep line with per-category active
+    counts — O(n log n) in interval count, so bundles at the retention
+    bounds (thousands of spans) analyze in milliseconds."""
+    t0, t1 = window
+    cat_index = {name: i for i, name in enumerate(STAGE_PRIORITY)}
+    # boundary -> per-category active-count delta applied AT that time
+    delta_at: dict[float, list[int]] = {}
+
+    def deltas(t: float) -> list[int]:
+        row = delta_at.get(t)
+        if row is None:
+            row = [0] * len(STAGE_PRIORITY)
+            delta_at[t] = row
+        return row
+
+    deltas(t0)
+    deltas(t1)
+    for name, intervals in by_category.items():
+        index = cat_index.get(name)
+        if index is None:
+            continue
+        for start, end in intervals:
+            start = min(max(start, t0), t1)
+            end = min(max(end, t0), t1)
+            if end <= start:
+                continue
+            deltas(start)[index] += 1
+            deltas(end)[index] -= 1
+    ordered = sorted(delta_at)
+    totals = {name: 0.0 for name in STAGE_PRIORITY}
+    totals[OTHER] = 0.0
+    active = [0] * len(STAGE_PRIORITY)
+    for left, right in zip(ordered, ordered[1:]):
+        row = delta_at[left]
+        for i, delta in enumerate(row):
+            active[i] += delta
+        if right <= left:
+            continue
+        assigned = OTHER
+        for i, name in enumerate(STAGE_PRIORITY):
+            if active[i] > 0:
+                assigned = name
+                break
+        totals[assigned] += right - left
+    return totals
+
+
+def critical_path(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Per-job (per-trace) wall-time attribution + aggregate. Jobs
+    with no finished spans are skipped (nothing to attribute)."""
+    by_trace: dict[str, list[dict[str, Any]]] = {}
+    for span in spans:
+        trace_id = span.get("trace_id")
+        if trace_id:
+            by_trace.setdefault(str(trace_id), []).append(span)
+    jobs: dict[str, Any] = {}
+    agg_totals = {name: 0.0 for name in (*STAGE_PRIORITY, OTHER)}
+    agg_wall = 0.0
+    for trace_id, trace_spans in sorted(by_trace.items()):
+        intervals: dict[str, list[tuple[float, float]]] = {}
+        t0: float | None = None
+        t1: float | None = None
+        for span in trace_spans:
+            interval = _finished_interval(span)
+            if interval is None:
+                continue
+            t0 = interval[0] if t0 is None else min(t0, interval[0])
+            t1 = interval[1] if t1 is None else max(t1, interval[1])
+            category = _category(span)
+            if category is not None:
+                intervals.setdefault(category, []).append(interval)
+        if t0 is None or t1 is None or t1 <= t0:
+            continue
+        totals = _sweep((t0, t1), intervals)
+        wall = t1 - t0
+        stages = {
+            name: {
+                "seconds": round(seconds, 6),
+                "share": round(seconds / wall, 4),
+            }
+            for name, seconds in totals.items()
+        }
+        dominant = max(totals, key=lambda n: totals[n])
+        jobs[trace_id] = {
+            "wall_s": round(wall, 6),
+            "stages": stages,
+            "dominant": dominant,
+            "dominant_share": stages[dominant]["share"],
+        }
+        agg_wall += wall
+        for name, seconds in totals.items():
+            agg_totals[name] += seconds
+    aggregate = None
+    if agg_wall > 0:
+        agg_stages = {
+            name: {
+                "seconds": round(seconds, 6),
+                "share": round(seconds / agg_wall, 4),
+            }
+            for name, seconds in agg_totals.items()
+        }
+        dominant = max(agg_totals, key=lambda n: agg_totals[n])
+        aggregate = {
+            "wall_s": round(agg_wall, 6),
+            "stages": agg_stages,
+            "dominant": dominant,
+            "dominant_share": agg_stages[dominant]["share"],
+        }
+    return {"jobs": jobs, "aggregate": aggregate}
+
+
+def render_text(report: dict[str, Any], bundle_meta: dict | None = None) -> str:
+    lines: list[str] = []
+    if bundle_meta:
+        trigger = bundle_meta.get("trigger") or {}
+        lines.append(
+            f"incident {bundle_meta.get('id', '?')} — trigger "
+            f"{trigger.get('kind', '?')}:{trigger.get('key', '')}"
+        )
+        lines.append("")
+    columns = (*STAGE_PRIORITY, OTHER)
+    header = f"{'job (trace)':32} {'wall_s':>9} {'dominant':>14}" + "".join(
+        f" {name:>14}" for name in columns
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for trace_id, job in report["jobs"].items():
+        row = (
+            f"{trace_id[:32]:32} {job['wall_s']:>9.4f} "
+            f"{job['dominant']:>14}"
+        )
+        for name in columns:
+            share = job["stages"][name]["share"]
+            row += f" {share * 100:>13.1f}%"
+        lines.append(row)
+    aggregate = report.get("aggregate")
+    if aggregate:
+        lines.append("")
+        lines.append(
+            f"aggregate: wall {aggregate['wall_s']:.4f}s, dominant stage "
+            f"{aggregate['dominant']} "
+            f"({aggregate['dominant_share'] * 100:.1f}%)"
+        )
+        for name in columns:
+            stage = aggregate["stages"][name]
+            lines.append(
+                f"  {name:14} {stage['seconds']:>10.4f}s "
+                f"({stage['share'] * 100:>5.1f}%)"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "path", help="incident bundle JSON, or trace JSONL (one span/line)"
+    )
+    parser.add_argument(
+        "--trace", default=None, help="only spans of this trace id"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    bundle_meta = None
+    try:
+        bundle, spans = load_document(args.path)
+        if bundle is not None:
+            bundle_meta = {
+                "id": bundle.get("id"), "trigger": bundle.get("trigger")
+            }
+    except OSError as exc:
+        print(f"cannot read {args.path}: {exc}", file=sys.stderr)
+        return 1
+    if args.trace:
+        spans = [s for s in spans if s.get("trace_id") == args.trace]
+    report = critical_path(spans)
+    if not report["jobs"]:
+        print("no finished spans to attribute", file=sys.stderr)
+        return 2
+    if args.json:
+        payload = dict(report)
+        if bundle_meta:
+            payload["bundle"] = bundle_meta
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(render_text(report, bundle_meta))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

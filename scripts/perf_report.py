@@ -346,9 +346,81 @@ def render_comparison(
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
             )
             continue
+        if item["stage"].startswith("critical_path:"):
+            # wall-time SHARES (unitless fractions), not p95 seconds
+            lines.append(
+                f"  {item['stage']:28} share {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (+{item['delta_pct']:.1f}%)"
+            )
+            continue
         lines.append(
             f"  {item['stage']:28} {item['old_p95']:.4f}s -> "
             f"{item['new_p95']:.4f}s (+{item['delta_pct']:.1f}%)"
+        )
+    return "\n".join(lines)
+
+
+def critical_path_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Wall-time stage attribution per job, reusing the offline
+    incident analyzer (scripts/incident_report.py) — the same code
+    path that reads a debug bundle with the process dead."""
+    import incident_report
+
+    return incident_report.critical_path(spans)
+
+
+def critical_path_regressions(
+    old_cp: dict[str, Any] | None,
+    new_cp: dict[str, Any] | None,
+    regress_pct: float,
+) -> list[dict[str, Any]]:
+    """Aggregate stage-share regressions: a stage whose share of total
+    wall time grew by more than `regress_pct` percent (relative) —
+    e.g. grant RTT creeping from 10% to 15% of wall — flagged under
+    the same gate as the p95 stages. Stages below a 5% old share are
+    skipped (noise on tiny denominators is not a regression)."""
+    old_agg = (old_cp or {}).get("aggregate")
+    new_agg = (new_cp or {}).get("aggregate")
+    if not old_agg or not new_agg:
+        return []
+    regressions = []
+    for name, new_stage in new_agg["stages"].items():
+        old_stage = old_agg["stages"].get(name)
+        if not old_stage or old_stage["share"] < 0.05:
+            continue
+        delta_pct = (new_stage["share"] / old_stage["share"] - 1.0) * 100.0
+        if delta_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": f"critical_path:{name}",
+                    # shares, not seconds — old_p95/new_p95 keep the
+                    # comparison machinery uniform, old_share/new_share
+                    # carry the honest unit for JSON consumers, and
+                    # render_comparison has a dedicated share branch
+                    "old_p95": old_stage["share"],
+                    "new_p95": new_stage["share"],
+                    "old_share": old_stage["share"],
+                    "new_share": new_stage["share"],
+                    "delta_pct": delta_pct,
+                }
+            )
+    return regressions
+
+
+def render_critical_path(cp: dict[str, Any]) -> str:
+    lines = ["critical path (dominant-stage share per job):"]
+    for trace_id, job in cp["jobs"].items():
+        lines.append(
+            f"  {trace_id[:40]:40} wall {job['wall_s']:.4f}s  "
+            f"dominant {job['dominant']} "
+            f"({job['dominant_share'] * 100:.1f}%)"
+        )
+    aggregate = cp.get("aggregate")
+    if aggregate:
+        lines.append(
+            f"  aggregate: dominant {aggregate['dominant']} "
+            f"({aggregate['dominant_share'] * 100:.1f}% of "
+            f"{aggregate['wall_s']:.4f}s)"
         )
     return "\n".join(lines)
 
@@ -492,6 +564,14 @@ def main(argv: list[str] | None = None) -> int:
         help="p95 regression threshold in percent for --compare (default 25)",
     )
     parser.add_argument(
+        "--critical-path",
+        action="store_true",
+        help="attribute each job's wall time across queue-wait/grant-"
+        "RTT/sample/encode-submit/blend (scripts/incident_report.py "
+        "analyzer) and name the dominant stage; with --compare, "
+        "aggregate stage-share regressions join the exit-3 gate",
+    )
+    parser.add_argument(
         "--slo",
         action="append",
         default=[],
@@ -522,6 +602,8 @@ def main(argv: list[str] | None = None) -> int:
     tiles = tile_lifecycle(spans)
     problems = incomplete_tiles(tiles)
 
+    critical = critical_path_report(spans) if args.critical_path else None
+
     regressions = None
     if args.compare:
         try:
@@ -532,6 +614,13 @@ def main(argv: list[str] | None = None) -> int:
         regressions = compare_reports(
             build_report(old_spans), report, args.regress_pct
         )
+        if critical is not None:
+            regressions.extend(
+                critical_path_regressions(
+                    critical_path_report(old_spans), critical,
+                    args.regress_pct,
+                )
+            )
 
     violations = slo_violations(report, slo_budgets) if slo_budgets else None
 
@@ -541,6 +630,8 @@ def main(argv: list[str] | None = None) -> int:
             "tiles": {str(k): v for k, v in tiles.items()},
             "incomplete": {str(k): v for k, v in problems.items()},
         }
+        if critical is not None:
+            payload["critical_path"] = critical
         if regressions is not None:
             payload["regressions"] = regressions
         if violations is not None:
@@ -548,6 +639,9 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(payload, indent=2, sort_keys=True))
     else:
         print(render_text(report, tiles, problems))
+        if critical is not None:
+            print()
+            print(render_critical_path(critical))
         if regressions is not None:
             print()
             print(render_comparison(regressions, args.regress_pct))

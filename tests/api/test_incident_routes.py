@@ -1,0 +1,195 @@
+"""Incident routes over real HTTP: list -> manual capture -> fetch,
+the disabled path, and the system_info event-bus/flight surfaces.
+"""
+
+import asyncio
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+pytestmark = pytest.mark.fast
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _post_json(url: str, payload: dict, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+@pytest.fixture()
+def server(tmp_config_path, tmp_path, monkeypatch):
+    monkeypatch.setenv("CDT_INCIDENT_DIR", str(tmp_path / "incidents"))
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def test_list_capture_fetch_round_trip(server):
+    srv, port = server
+    base = f"http://127.0.0.1:{port}/distributed/incidents"
+    status, listing = _get_json(base)
+    assert status == 200
+    assert listing["enabled"] is True
+    assert listing["incidents"] == []
+    assert listing["flight"]["installed"] is True
+
+    status, captured = _post_json(
+        f"{base}/capture", {"key": "ops", "context": {"why": "drill"}}
+    )
+    assert status == 200 and captured["captured"] is True
+    incident_id = captured["id"]
+
+    status, listing = _get_json(base)
+    assert [e["id"] for e in listing["incidents"]] == [incident_id]
+    assert listing["incidents"][0]["trigger"] == "manual"
+    assert listing["manager"]["counters"]["captured"] == 1
+
+    status, bundle = _get_json(f"{base}/{incident_id}")
+    assert status == 200
+    assert bundle["id"] == incident_id
+    assert bundle["trigger"]["kind"] == "manual"
+    assert bundle["trigger"]["key"] == "ops"
+    assert bundle["trigger"]["context"] == {"why": "drill"}
+    # server-bound sections landed
+    assert "store" in bundle and "health" in bundle
+    assert bundle["server"]["label"] == f"master:{port}"
+    from comfyui_distributed_tpu.telemetry.incidents import validate_bundle
+
+    assert validate_bundle(bundle) == []
+
+
+def test_unknown_and_hostile_ids_404(server):
+    srv, port = server
+    base = f"http://127.0.0.1:{port}/distributed/incidents"
+    for bad in ("incident-0000000000000-0001-ghost", "not-an-id"):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(f"{base}/{bad}")
+        assert err.value.code == 404
+
+
+def test_alert_fired_on_the_bus_auto_captures(server):
+    srv, port = server
+    from comfyui_distributed_tpu.telemetry import get_event_bus
+
+    get_event_bus().publish(
+        "alert_fired", slo="tile_latency", rules=[{"firing": True}]
+    )
+    assert srv.incidents.flush(10)
+    status, listing = _get_json(
+        f"http://127.0.0.1:{port}/distributed/incidents"
+    )
+    assert [e["trigger"] for e in listing["incidents"]] == ["alert_fired"]
+
+
+def test_metrics_scrape_carries_incident_instruments(server):
+    srv, port = server
+    srv.incidents.capture_now()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/distributed/metrics", timeout=10
+    ) as resp:
+        body = resp.read().decode()
+    assert 'cdt_incidents_total{trigger="manual"} 1' in body
+    assert "cdt_incident_capture_seconds" in body
+    assert "cdt_flight_dropped_total" in body
+    assert "cdt_event_subscriber_queue_depth" in body or (
+        "cdt_event_subscriber" in body
+    )
+
+
+def test_system_info_surfaces_event_bus_and_flight(server):
+    srv, port = server
+    status, info = _get_json(
+        f"http://127.0.0.1:{port}/distributed/system_info"
+    )
+    assert status == 200
+    bus_stats = info["status"]["event_bus"]
+    assert "flight" in bus_stats["taps"]
+    assert "incidents" in bus_stats["taps"]
+    assert isinstance(bus_stats["subscribers"], list)
+    assert info["status"]["flight"]["installed"] is True
+    assert info["status"]["incidents"]["counters"]["captured"] == 0
+
+
+def test_disabled_without_incident_dir(tmp_config_path, monkeypatch):
+    monkeypatch.delenv("CDT_INCIDENT_DIR", raising=False)
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    try:
+        assert srv.incidents is None
+        status, listing = _get_json(
+            f"http://127.0.0.1:{port}/distributed/incidents"
+        )
+        assert listing["enabled"] is False
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_json(
+                f"http://127.0.0.1:{port}/distributed/incidents/capture", {}
+            )
+        assert err.value.code == 400
+    finally:
+        asyncio.run_coroutine_threadsafe(
+            srv.stop(), loop_thread.loop
+        ).result(timeout=30)
+        loop_thread.stop()
+
+
+def test_journaling_master_bundles_carry_the_durability_section(
+    tmp_config_path, tmp_path, monkeypatch
+):
+    """The bundle-schema promise (docs/observability.md §Incidents):
+    on a journaling master the bundle holds the durability/role/epoch
+    status — the section §4j failover triage reads first. Pins the
+    construction ORDER (incident manager after durability manager)."""
+    monkeypatch.setenv("CDT_INCIDENT_DIR", str(tmp_path / "incidents"))
+    monkeypatch.setenv("CDT_JOURNAL_DIR", str(tmp_path / "journal"))
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    try:
+        assert "durability" in srv.incidents.sources
+        result = srv.incidents.capture_now(key="order-pin")
+        bundle = srv.incidents.read_bundle(result["id"])
+        assert bundle["durability"]["enabled"] is True
+        assert "role" in bundle["durability"]
+    finally:
+        asyncio.run_coroutine_threadsafe(
+            srv.stop(), loop_thread.loop
+        ).result(timeout=30)
+        loop_thread.stop()

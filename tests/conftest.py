@@ -97,6 +97,7 @@ def _reset_resilience_state():
     faults.reset_fault_injector()
     telemetry.reset_metrics_registry()
     telemetry.reset_tracer()
+    telemetry.reset_flight_recorder()
     telemetry.reset_event_bus()
 
 

@@ -615,3 +615,62 @@ def test_failover_standby_replica_reports_sync_and_lag(tmp_path):
     assert result.replica["lag_records"] == 0
     assert result.replica["applied_lsn"] >= result.replica["applied_records"] > 0
     assert result.replica["source_epoch"] == result.epochs[0]
+
+
+def test_straggler_alert_auto_captures_an_incident_bundle(baseline, tmp_path):
+    """The incident-plane acceptance scenario (docs/observability.md
+    §Incidents): the injected 10x straggler fires tile_latency, the
+    IncidentManager's bus tap auto-captures a debug bundle holding the
+    FIRING evaluation (the alert's rules ride the trigger context) and
+    the straggler's per-worker fleet series, a second identical alert
+    inside the debounce window captures NOTHING, and — the invariant
+    every chaos scenario re-proves — the canvas stays bit-identical."""
+    import json
+    import os
+
+    from comfyui_distributed_tpu.telemetry.incidents import validate_bundle
+
+    result = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};latency(0.4)@chaos:w1:pulled#*;"
+            "crash@chaos:w2:pulled#1"
+        ),
+        worker_timeout=10.0,  # heartbeat requeue never fires
+        watchdog={},
+        slo={},
+        incidents={"dir": str(tmp_path)},
+    )
+    assert [a["type"] for a in result.alerts][:1] == ["alert_fired"]
+    # exactly one bundle: the alert captured, the debounced re-fire
+    # did not
+    assert len(result.incidents) == 1, result.incidents
+    assert result.incidents[0]["trigger"] == "alert_fired"
+    assert result.incident_retrigger == "debounced"
+    bundle_path = os.path.join(
+        str(tmp_path), result.incidents[0]["id"] + ".json"
+    )
+    with open(bundle_path, "r", encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    assert validate_bundle(bundle) == []
+    # the firing SLO evaluation rode the trigger context
+    assert bundle["trigger"]["key"] == "tile_latency"
+    rules = bundle["trigger"]["context"].get("rules")
+    assert rules and any(r["firing"] for r in rules), rules
+    # the straggler's per-worker fleet series is in the bundle's window
+    workers = bundle["fleet"]["history"]["workers"]
+    assert "w1" in workers, sorted(workers)
+    assert workers["w1"]["fleet_worker_tiles_per_s"], workers["w1"]
+    # flight recorder evidence from BEFORE the trigger is retained
+    assert bundle["flight"]["enabled"] is True
+    assert bundle["flight"]["events"]
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_healthy_run_captures_no_incidents(baseline, tmp_path):
+    result = run_chaos_usdu(
+        seed=11, slo={}, incidents={"dir": str(tmp_path)}
+    )
+    assert result.incidents == []
+    assert result.incident_retrigger == ""
+    np.testing.assert_array_equal(baseline, result.output)

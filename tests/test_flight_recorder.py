@@ -1,0 +1,240 @@
+"""Flight recorder: ring bounds, drop accounting, bus-tap wiring.
+
+The always-on black box (telemetry/flight.py): every published bus
+event lands in a bounded drop-oldest ring (span closes in their own
+ring), appends/drops are counted honestly, and the recorder survives
+bus resets by re-tapping the current bus.
+"""
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry import (
+    get_event_bus,
+    get_flight_recorder,
+    get_metrics_registry,
+    get_tracer,
+    peek_flight_recorder,
+    reset_event_bus,
+    reset_flight_recorder,
+)
+from comfyui_distributed_tpu.telemetry.flight import FlightRecorder, FlightRing
+
+pytestmark = pytest.mark.fast
+
+
+def test_ring_is_bounded_drop_oldest_with_exact_accounting():
+    ring = FlightRing(capacity=4)
+    for i in range(10):
+        ring.append(i)
+    assert ring.snapshot() == [6, 7, 8, 9]
+    assert len(ring) == 4
+    assert ring.appended == 10
+    assert ring.dropped == 6
+
+
+def test_ring_capacity_floor_is_one():
+    ring = FlightRing(capacity=0)
+    ring.append("a")
+    ring.append("b")
+    assert ring.snapshot() == ["b"]
+    assert ring.dropped == 1
+
+
+def test_recorder_tails_every_event_type_and_routes_span_closes():
+    recorder = FlightRecorder(event_capacity=16, span_capacity=16)
+    recorder.install()
+    bus = get_event_bus()
+    bus.publish("job_ready", job_id="j1", tasks=4)
+    bus.publish("alert_fired", slo="tile_latency")
+    with get_tracer().span("sample_stage", trace_id="exec_t"):
+        pass
+    events = recorder.events.snapshot()
+    types = [e["type"] for e in events]
+    assert "job_ready" in types and "alert_fired" in types
+    # span_open rides the event ring; span_close has its own ring
+    assert "span_open" in types
+    spans = recorder.spans.snapshot()
+    assert [s["type"] for s in spans] == ["span_close"]
+    assert spans[0]["data"]["name"] == "sample_stage"
+    recorder.uninstall()
+
+
+def test_metric_mutations_reach_the_ring_through_the_forwarding_hook():
+    recorder = FlightRecorder(event_capacity=32, span_capacity=4)
+    recorder.install()
+    counter = get_metrics_registry().counter("cdt_test_flight_total", "t")
+    counter.inc()
+    deltas = [
+        e for e in recorder.events.snapshot() if e["type"] == "metric_delta"
+    ]
+    assert deltas and deltas[-1]["data"]["metric"] == "cdt_test_flight_total"
+    recorder.uninstall()
+
+
+def test_overflow_drops_oldest_and_dump_reports_it():
+    recorder = FlightRecorder(event_capacity=3, span_capacity=3)
+    recorder.install()
+    bus = get_event_bus()
+    for i in range(8):
+        bus.publish("tick", n=i)
+    dump = recorder.dump()
+    assert [e["data"]["n"] for e in dump["events"]] == [5, 6, 7]
+    assert dump["dropped"]["events"] == 5
+    assert dump["appended"]["events"] == 8
+    recorder.uninstall()
+
+
+def test_global_recorder_reinstalls_after_bus_reset():
+    recorder = get_flight_recorder()
+    assert recorder is not None and recorder.installed
+    get_event_bus().publish("before_reset")
+    reset_event_bus()
+    # the old bus died with its tap; the next get re-taps the new bus
+    recorder2 = get_flight_recorder()
+    assert recorder2 is recorder
+    get_event_bus().publish("after_reset")
+    types = [e["type"] for e in recorder.events.snapshot()]
+    assert "before_reset" in types and "after_reset" in types
+
+
+def test_peek_never_creates():
+    reset_flight_recorder()
+    assert peek_flight_recorder() is None
+    assert get_flight_recorder() is not None
+    assert peek_flight_recorder() is not None
+
+
+def test_cdt_flight_zero_disables(monkeypatch):
+    from comfyui_distributed_tpu.utils import constants
+
+    reset_flight_recorder()
+    monkeypatch.setattr(constants, "FLIGHT_ENABLED", False)
+    assert get_flight_recorder() is None
+    assert peek_flight_recorder() is None
+
+
+def test_bus_stats_name_the_tap_and_subscribers():
+    recorder = get_flight_recorder()
+    assert recorder is not None
+    stats = get_event_bus().stats()
+    assert "flight" in stats["taps"]
+    assert isinstance(stats["subscribers"], list)
+
+
+def test_tap_errors_never_break_publish():
+    bus = get_event_bus()
+    calls = []
+
+    def broken(event):
+        calls.append(event["type"])
+        raise RuntimeError("observer bug")
+
+    remove = bus.add_tap(broken, name="broken")
+    bus.publish("ok_event")  # must not raise
+    assert calls == ["ok_event"]
+    remove()
+    bus.publish("after_remove")
+    assert calls == ["ok_event"]
+
+
+def test_flight_drop_counter_mirrors_ring_drops_at_scrape_time():
+    """bind_server_collectors mirrors the recorder's plain-int drops
+    into cdt_flight_dropped_total by delta on every scrape."""
+    import types as types_mod
+
+    from comfyui_distributed_tpu.telemetry import bind_server_collectors
+    from comfyui_distributed_tpu.telemetry.instruments import (
+        flight_dropped_total,
+    )
+
+    reset_flight_recorder()
+    recorder = get_flight_recorder()
+    recorder.events = FlightRing(2)  # tiny ring so drops happen fast
+    bus = get_event_bus()
+    server = types_mod.SimpleNamespace(
+        is_worker=False,
+        port=1,
+        queue_remaining=0,
+        job_store=types_mod.SimpleNamespace(
+            stats_unlocked=lambda: {
+                "tile_jobs": 0, "queue_depth": 0,
+                "in_flight": 0, "collectors": 0,
+            }
+        ),
+    )
+    unbind = bind_server_collectors(server)
+    try:
+        for i in range(6):
+            bus.publish("tick", n=i)
+        # freeze the ring (the scrape's own gauge sets would publish
+        # more metric_delta events mid-scrape) so the mirrored total
+        # is exact, then scrape twice: delta once, no double count
+        recorder.uninstall()
+        dropped = recorder.events.dropped
+        assert dropped >= 4
+        get_metrics_registry().render()  # scrape -> delta mirror
+        assert flight_dropped_total().value(stream="events") == dropped
+        get_metrics_registry().render()  # second scrape: no double count
+        assert flight_dropped_total().value(stream="events") == dropped
+    finally:
+        unbind()
+
+
+def test_drop_mirror_counts_once_across_cohosted_collectors():
+    """Two servers in one process each bind a collector; the recorder
+    holds the high-water mark, so one drop is counted exactly once."""
+    import types as types_mod
+
+    from comfyui_distributed_tpu.telemetry import bind_server_collectors
+    from comfyui_distributed_tpu.telemetry.instruments import (
+        flight_dropped_total,
+    )
+
+    reset_flight_recorder()
+    recorder = get_flight_recorder()
+    recorder.events = FlightRing(2)
+    bus = get_event_bus()
+
+    def fake_server(port):
+        return types_mod.SimpleNamespace(
+            is_worker=False,
+            port=port,
+            queue_remaining=0,
+            job_store=types_mod.SimpleNamespace(
+                stats_unlocked=lambda: {
+                    "tile_jobs": 0, "queue_depth": 0,
+                    "in_flight": 0, "collectors": 0,
+                }
+            ),
+        )
+
+    unbind_a = bind_server_collectors(fake_server(1))
+    unbind_b = bind_server_collectors(fake_server(2))
+    try:
+        for i in range(6):
+            bus.publish("tick", n=i)
+        recorder.uninstall()  # freeze the ring before scraping
+        dropped = recorder.events.dropped
+        get_metrics_registry().render()  # BOTH collectors run
+        assert flight_dropped_total().value(stream="events") == dropped
+    finally:
+        unbind_a()
+        unbind_b()
+
+
+def test_subscriptions_with_the_same_name_get_unique_labels():
+    import asyncio
+
+    async def main():
+        bus = get_event_bus()
+        a = bus.subscribe(name="ws:1.2.3.4")
+        b = bus.subscribe(name="ws:1.2.3.4")
+        try:
+            names = [s["name"] for s in bus.stats()["subscribers"]]
+            assert len(set(names)) == 2, names
+            assert all(n.startswith("ws:1.2.3.4#") for n in names)
+        finally:
+            bus.unsubscribe(a)
+            bus.unsubscribe(b)
+
+    asyncio.run(main())

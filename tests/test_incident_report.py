@@ -1,0 +1,210 @@
+"""Offline critical-path analyzer (scripts/incident_report.py): exact
+wall-time attribution, bundle/JSONL input handling, and the
+perf_report --critical-path reuse + regression gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO_ROOT, "scripts")
+sys.path.insert(0, SCRIPTS)
+
+import incident_report  # noqa: E402
+import perf_report  # noqa: E402
+
+
+def _span(trace, name, start, end, stage=None, **attrs):
+    if stage is not None:
+        attrs["stage"] = stage
+    return {
+        "trace_id": trace,
+        "span_id": f"{trace}-{name}-{start}",
+        "name": name,
+        "start": start,
+        "end": end,
+        "duration": end - start,
+        "attrs": attrs,
+        "status": "ok",
+    }
+
+
+def synthetic_spans():
+    """One job: 1 s queue wait, 1 s pull, 4 s sample with 1 s of
+    encode/submit riding UNDER it (pipelined), 1 s blend, 1 s
+    uninstrumented tail. Wall = 8 s."""
+    return [
+        _span("job-a", "sched.wait", 0.0, 1.0),
+        _span("job-a", "tile.pull", 1.0, 2.0, stage="pull"),
+        _span("job-a", "tile.sample", 2.0, 6.0, stage="sample"),
+        # overlapped I/O: must be credited to sample, not double-counted
+        _span("job-a", "tile.submit", 5.0, 6.0, stage="submit"),
+        _span("job-a", "tile.blend", 6.0, 7.0, stage="blend"),
+        _span("job-a", "cleanup", 7.5, 8.0),  # uninstrumented -> other
+    ]
+
+
+def test_attribution_sums_to_wall_and_priority_resolves_overlap():
+    report = incident_report.critical_path(synthetic_spans())
+    job = report["jobs"]["job-a"]
+    assert job["wall_s"] == pytest.approx(8.0)
+    stages = {k: v["seconds"] for k, v in job["stages"].items()}
+    assert stages["queue_wait"] == pytest.approx(1.0)
+    assert stages["grant_rtt"] == pytest.approx(1.0)
+    # the submit second rides UNDER sample: sample keeps its 4 s
+    assert stages["sample"] == pytest.approx(4.0)
+    assert stages["encode_submit"] == pytest.approx(0.0)
+    assert stages["blend"] == pytest.approx(1.0)
+    assert stages["other"] == pytest.approx(1.0)
+    assert sum(stages.values()) == pytest.approx(job["wall_s"])
+    assert job["dominant"] == "sample"
+    agg = report["aggregate"]
+    assert agg["dominant"] == "sample"
+    assert sum(s["seconds"] for s in agg["stages"].values()) == (
+        pytest.approx(agg["wall_s"])
+    )
+
+
+def test_multiple_jobs_aggregate_and_unfinished_spans_skip():
+    spans = synthetic_spans() + [
+        _span("job-b", "tile.pull", 0.0, 3.0, stage="pull"),
+        _span("job-b", "tile.sample", 3.0, 4.0, stage="sample"),
+        # unfinished span: no end, no duration -> ignored
+        {"trace_id": "job-b", "span_id": "x", "name": "tile.encode",
+         "start": 4.0, "end": None, "duration": None,
+         "attrs": {"stage": "encode"}, "status": "ok"},
+    ]
+    report = incident_report.critical_path(spans)
+    assert set(report["jobs"]) == {"job-a", "job-b"}
+    assert report["jobs"]["job-b"]["dominant"] == "grant_rtt"
+    assert report["aggregate"]["wall_s"] == pytest.approx(12.0)
+
+
+def test_bundle_spans_merges_trace_and_flight_deduped():
+    trace_spans = synthetic_spans()
+    flight_frames = [
+        {"type": "span_close", "data": trace_spans[0]},  # duplicate
+        {"type": "span_close",
+         "data": _span("job-c", "tile.sample", 0.0, 2.0, stage="sample")},
+        {"type": "span_close", "data": {"no_trace": True}},  # malformed
+    ]
+    bundle = {
+        "trace": {"trace_id": "job-a", "spans": trace_spans},
+        "flight": {"spans": flight_frames},
+    }
+    spans = incident_report.bundle_spans(bundle)
+    assert len(spans) == len(trace_spans) + 1
+    report = incident_report.critical_path(spans)
+    assert set(report["jobs"]) == {"job-a", "job-c"}
+
+
+def test_cli_reads_jsonl_and_json_outputs(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    with open(path, "w") as fh:
+        for span in synthetic_spans():
+            fh.write(json.dumps(span) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "incident_report.py"),
+         str(path), "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["aggregate"]["dominant"] == "sample"
+    # text mode renders the dominant line
+    proc_text = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "incident_report.py"),
+         str(path)],
+        capture_output=True, text=True,
+    )
+    assert "dominant" in proc_text.stdout
+    assert proc_text.returncode == 0
+
+
+def test_cli_empty_input_exits_2(tmp_path):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "incident_report.py"),
+         str(path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+
+
+def test_perf_report_critical_path_column_and_gate():
+    spans = synthetic_spans()
+    cp = perf_report.critical_path_report(spans)
+    assert cp["aggregate"]["dominant"] == "sample"
+    rendered = perf_report.render_critical_path(cp)
+    assert "dominant sample" in rendered
+    # regression gate: grant_rtt share doubling is flagged
+    slow_pull = [
+        _span("job-a", "sched.wait", 0.0, 1.0),
+        _span("job-a", "tile.pull", 1.0, 6.0, stage="pull"),
+        _span("job-a", "tile.sample", 6.0, 8.0, stage="sample"),
+    ]
+    new_cp = perf_report.critical_path_report(slow_pull)
+    regressions = perf_report.critical_path_regressions(cp, new_cp, 25.0)
+    names = {r["stage"] for r in regressions}
+    assert "critical_path:grant_rtt" in names
+    # no-change comparison stays quiet
+    assert perf_report.critical_path_regressions(cp, cp, 25.0) == []
+
+
+def test_single_line_jsonl_is_not_mistaken_for_a_bundle(tmp_path):
+    """A one-span trace export parses whole as a dict — classification
+    must go by bundle markers, not parseability."""
+    span = _span("job-solo", "tile.sample", 0.0, 2.0, stage="sample")
+    path = tmp_path / "one.jsonl"
+    path.write_text(json.dumps(span) + "\n")
+    bundle, spans = incident_report.load_document(str(path))
+    assert bundle is None
+    assert spans == [span]
+    report = incident_report.critical_path(spans)
+    assert report["jobs"]["job-solo"]["dominant"] == "sample"
+
+
+def test_critical_path_regressions_render_as_shares_not_seconds():
+    cp_old = perf_report.critical_path_report(synthetic_spans())
+    slow_pull = [
+        _span("job-a", "tile.pull", 0.0, 5.0, stage="pull"),
+        _span("job-a", "tile.sample", 5.0, 7.0, stage="sample"),
+    ]
+    cp_new = perf_report.critical_path_report(slow_pull)
+    regressions = perf_report.critical_path_regressions(cp_old, cp_new, 25.0)
+    item = next(
+        r for r in regressions if r["stage"] == "critical_path:grant_rtt"
+    )
+    assert item["old_share"] == item["old_p95"]  # honest unit keys
+    rendered = perf_report.render_comparison(regressions, 25.0)
+    assert "share" in rendered
+    assert "critical_path:grant_rtt" in rendered
+    assert "s ->" not in rendered  # never formatted as seconds
+
+
+def test_sweep_scales_to_retention_bound_span_counts():
+    """The analyzer must handle a bundle at the retention bounds
+    (thousands of spans) in well under a second — the sweep is
+    O(n log n), not quadratic in segments x intervals."""
+    import time as time_mod
+
+    spans = []
+    for i in range(6000):
+        stage = ("pull", "sample", "submit", "blend")[i % 4]
+        spans.append(
+            _span("job-big", f"tile.{stage}", i * 0.01, i * 0.01 + 0.02,
+                  stage=stage)
+        )
+    started = time_mod.perf_counter()
+    report = incident_report.critical_path(spans)
+    elapsed = time_mod.perf_counter() - started
+    assert elapsed < 1.0, f"critical_path took {elapsed:.2f}s for 6k spans"
+    job = report["jobs"]["job-big"]
+    total = sum(s["seconds"] for s in job["stages"].values())
+    assert total == pytest.approx(job["wall_s"])

@@ -1,0 +1,433 @@
+"""IncidentManager: debounce/rate-limit, retention, schema, storms.
+
+All timing rides an injectable fake clock; disk is a tmp_path. The
+acceptance-critical properties: a re-firing trigger inside the
+debounce window captures NOTHING, 100 storm triggers leave bounded
+disk, captures never run on the calling thread (trigger is a queue
+put), and every written bundle validates against the schema.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.telemetry import get_event_bus
+from comfyui_distributed_tpu.telemetry.incidents import (
+    BUNDLE_SCHEMA_VERSION,
+    IncidentManager,
+    resolved_knobs,
+    validate_bundle,
+)
+
+pytestmark = pytest.mark.fast
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+@pytest.fixture()
+def manager(tmp_path):
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, debounce_s=300.0, min_interval_s=10.0,
+        max_bundles=5, max_bytes=10 * 1024 * 1024,
+    )
+    m.start()
+    yield m, clock
+    m.stop()
+
+
+def test_trigger_captures_then_same_key_debounces(manager):
+    m, clock = manager
+    assert m.trigger("alert_fired", key="tile_latency") == "queued"
+    assert m.flush(10)
+    assert len(m.list_bundles()) == 1
+    clock.advance(60)  # inside the 300 s debounce window
+    assert m.trigger("alert_fired", key="tile_latency") == "debounced"
+    assert m.flush(10)
+    assert len(m.list_bundles()) == 1
+    assert m.counters["debounced"] == 1
+    clock.advance(300)  # window expired: captures again
+    assert m.trigger("alert_fired", key="tile_latency") == "queued"
+    assert m.flush(10)
+    assert len(m.list_bundles()) == 2
+
+
+def test_distinct_keys_hit_the_global_rate_limit(manager):
+    m, clock = manager
+    assert m.trigger("alert_fired", key="a") == "queued"
+    clock.advance(5)  # under min_interval_s=10
+    assert m.trigger("alert_fired", key="b") == "rate_limited"
+    clock.advance(10)
+    assert m.trigger("alert_fired", key="b") == "queued"
+    assert m.flush(10)
+    assert len(m.list_bundles()) == 2
+    assert m.counters["rate_limited"] == 1
+
+
+def test_manual_capture_bypasses_debounce_but_is_counted(manager):
+    m, clock = manager
+    first = m.capture_now(context={"note": "one"})
+    second = m.capture_now(context={"note": "two"})
+    assert first["id"] != second["id"]
+    assert len(m.list_bundles()) == 2
+    assert m.counters["captured"] == 2
+
+
+def test_storm_of_100_triggers_leaves_bounded_disk(tmp_path):
+    """The alert-storm acceptance: 100 triggers with distinct keys at
+    one instant -> the global rate limit admits one, retention caps
+    whatever lands, disk stays bounded."""
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, debounce_s=300.0, min_interval_s=10.0,
+        max_bundles=3, max_bytes=10 * 1024 * 1024,
+    )
+    m.start()
+    try:
+        dispositions = [
+            m.trigger("alert_fired", key=f"slo-{i}") for i in range(100)
+        ]
+        assert dispositions.count("queued") == 1
+        assert dispositions.count("rate_limited") == 99
+        assert m.flush(10)
+        # now a slow storm: every 10 s another key fires; retention
+        # must hold the bundle count at max_bundles
+        for i in range(20):
+            clock.advance(10)
+            m.trigger("tile_quarantined", key=f"job-{i}")
+        assert m.flush(20)
+        bundles = m.list_bundles()
+        assert len(bundles) <= 3
+        on_disk = [
+            n for n in os.listdir(tmp_path) if n.startswith("incident-")
+        ]
+        assert len(on_disk) <= 3
+    finally:
+        m.stop()
+
+
+def test_retention_prunes_oldest_by_byte_budget(tmp_path):
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, debounce_s=0.0, min_interval_s=0.0,
+        max_bundles=100, max_bytes=1,  # one byte: only the newest survives
+    )
+    m.start()
+    try:
+        for _ in range(3):
+            clock.advance(1)
+            assert m.trigger("failover", key=str(clock.now)) == "queued"
+            assert m.flush(10)
+        bundles = m.list_bundles()
+        assert len(bundles) == 1
+        # the survivor is the NEWEST capture
+        assert bundles[0]["ts"] == pytest.approx(clock.now, abs=0.01)
+    finally:
+        m.stop()
+
+
+def test_trigger_never_blocks_the_calling_thread(manager):
+    """The no-loop-stall regression: a slow bundle source must not
+    make trigger() slow — the gather runs on the writer thread."""
+    m, clock = manager
+
+    def slow_source():
+        time.sleep(0.5)
+        return {"slow": True}
+
+    m.sources["slow"] = slow_source
+    started = time.perf_counter()
+    assert m.trigger("alert_fired", key="slowcheck") == "queued"
+    elapsed = time.perf_counter() - started
+    assert elapsed < 0.1, f"trigger blocked the caller for {elapsed:.3f}s"
+    assert m.flush(10)
+    bundle = m.read_bundle(m.list_bundles()[0]["id"])
+    assert bundle["slow"] == {"slow": True}
+
+
+def test_capture_serializes_single_flight(manager):
+    """Manual captures racing the writer thread serialize through the
+    capture lock — ids stay unique and both bundles land."""
+    m, clock = manager
+    results = []
+
+    def worker():
+        results.append(m.capture_now(context={})["id"])
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(set(results)) == 4
+    assert len(m.list_bundles()) == 4
+
+
+def test_failing_source_degrades_to_error_section(manager):
+    m, clock = manager
+
+    def broken():
+        raise RuntimeError("source exploded")
+
+    m.sources["broken"] = broken
+    result = m.capture_now()
+    bundle = m.read_bundle(result["id"])
+    assert "RuntimeError" in bundle["broken"]["error"]
+    assert validate_bundle(bundle) == []
+
+
+def test_bus_tap_maps_trigger_events(manager):
+    m, clock = manager
+    bus = get_event_bus()
+    bus.publish("alert_fired", slo="tile_latency", rules=[])
+    clock.advance(1000)
+    bus.publish(
+        "tile_quarantined", job_id="j1", task_ids=[3], pardoned_workers=[]
+    )
+    clock.advance(1000)
+    bus.publish("job_cancelled", job_id="j2", reason="deadline")
+    clock.advance(1000)
+    bus.publish("job_cancelled", job_id="j3", reason="client")  # NOT a trigger
+    bus.publish("failover", epoch=7)
+    assert m.flush(10)
+    kinds = sorted(b["trigger"] for b in m.list_bundles())
+    assert kinds == [
+        "alert_fired", "failover", "job_deadline", "tile_quarantined"
+    ]
+
+
+def test_bundle_schema_validates_and_rejects(manager):
+    m, clock = manager
+    bundle = m.read_bundle(m.capture_now()["id"])
+    assert validate_bundle(bundle) == []
+    assert bundle["schema"] == BUNDLE_SCHEMA_VERSION
+    # structural breakage is reported, not crashed on
+    broken = dict(bundle)
+    del broken["flight"]
+    broken["trigger"] = "not an object"
+    problems = validate_bundle(broken)
+    assert any("flight" in p for p in problems)
+    assert any("trigger" in p for p in problems)
+    assert validate_bundle("nonsense")
+    assert validate_bundle({**bundle, "schema": 99})
+
+
+def test_read_bundle_rejects_path_traversal(manager, tmp_path):
+    m, clock = manager
+    secret = tmp_path.parent / "secret.json"
+    secret.write_text(json.dumps({"leak": True}))
+    assert m.read_bundle("../secret") is None
+    assert m.read_bundle("incident-x/../../secret") is None
+    assert m.read_bundle("unknown") is None
+
+
+def test_resolved_knobs_reflect_env(monkeypatch):
+    monkeypatch.setenv("CDT_FLEET_INTERVAL", "42.5")
+    monkeypatch.delenv("CDT_FLEET_TTL", raising=False)
+    knobs = resolved_knobs()
+    assert knobs["CDT_FLEET_INTERVAL"] == {"value": "42.5", "set": True}
+    assert knobs["CDT_FLEET_TTL"] == {"value": "120.0", "set": False}
+
+
+def test_incident_captured_event_rides_the_bus(manager):
+    m, clock = manager
+    seen = []
+    remove = get_event_bus().add_tap(
+        lambda e: seen.append(e) if e["type"] == "incident_captured" else None
+    )
+    try:
+        result = m.capture_now(key="opcheck")
+        assert m.flush(10)
+        captured = [e for e in seen if e["type"] == "incident_captured"]
+        assert captured and captured[0]["data"]["id"] == result["id"]
+        assert captured[0]["data"]["trigger"] == "manual"
+    finally:
+        remove()
+
+
+def test_stop_drains_and_refuses_new_triggers(tmp_path):
+    clock = FakeClock()
+    m = IncidentManager(str(tmp_path), clock=clock, min_interval_s=0.0)
+    m.start()
+    assert m.trigger("failover", key="1") == "queued"
+    m.stop()
+    assert m.trigger("failover", key="2") == "closed"
+    assert len(m.list_bundles()) == 1
+
+
+def test_capture_does_not_stall_an_event_loop(tmp_path):
+    """The serving-loop regression: trigger() fired FROM a running
+    asyncio loop while a slow source drags the capture out must not
+    stall the loop's ticks — the gather runs on the writer thread."""
+    import asyncio
+
+    clock = FakeClock()
+    m = IncidentManager(str(tmp_path), clock=clock, min_interval_s=0.0)
+
+    def slow_source():
+        time.sleep(0.4)
+        return {"ok": True}
+
+    m.sources["slow"] = slow_source
+    m.start()
+    try:
+        async def main():
+            assert m.trigger("alert_fired", key="loopcheck") == "queued"
+            max_gap = 0.0
+            last = time.perf_counter()
+            for _ in range(40):
+                await asyncio.sleep(0.01)
+                now = time.perf_counter()
+                max_gap = max(max_gap, now - last)
+                last = now
+            return max_gap
+
+        max_gap = asyncio.run(main())
+        assert max_gap < 0.2, f"loop stalled {max_gap:.3f}s during capture"
+        assert m.flush(10)
+        assert len(m.list_bundles()) == 1
+    finally:
+        m.stop()
+
+
+def test_overflow_rolls_back_debounce_and_rate_limit_reservations(tmp_path):
+    """A trigger the writer queue refuses must not poison the windows:
+    the incident's NEXT trigger must still be capturable, never read
+    as debounced/rate-limited against a capture that never happened."""
+    import queue as queue_mod
+
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, debounce_s=300.0, min_interval_s=0.0,
+    )
+    # writer NOT started: the bounded queue fills and stays full
+    for i in range(4):
+        assert m.trigger("alert_fired", key=f"k{i}") == "queued"
+    assert m.trigger("alert_fired", key="k-over") == "overflow"
+    # the overflowed key is NOT debounced — it overflows again (the
+    # reservation was rolled back), and once the queue has room it
+    # captures
+    assert m.trigger("alert_fired", key="k-over") == "overflow"
+    m._queue = queue_mod.Queue()  # room again (writer still off)
+    assert m.trigger("alert_fired", key="k-over") == "queued"
+    assert m.counters["overflow"] == 2
+
+
+def test_debounce_eviction_is_least_recently_reserved(tmp_path):
+    """A key-churn storm must evict STALE debounce keys, never one
+    that was just re-reserved — re-reserving moves the key to the
+    dict's end (pop-reinsert), so eviction order is reservation
+    recency, not first insertion."""
+    import queue as queue_mod
+
+    from comfyui_distributed_tpu.telemetry.incidents import MAX_DEBOUNCE_KEYS
+
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, debounce_s=10_000.0, min_interval_s=0.0,
+    )
+    m._queue = queue_mod.Queue()  # unbounded; writer off — pure windows
+    assert m.trigger("alert_fired", key="precious") == "queued"
+    for i in range(MAX_DEBOUNCE_KEYS // 2):
+        m.trigger("tile_quarantined", key=f"churn-a-{i}")
+    # still inside the window: debounced AND moved to the dict's end
+    assert m.trigger("alert_fired", key="precious") == "debounced"
+    # enough further churn to force evictions (129 + 128 keys > the
+    # 256 bound) but with more stale churn-a victims than evictions —
+    # recency order must sacrifice THEM, never the just-touched key
+    for i in range(MAX_DEBOUNCE_KEYS // 2):
+        m.trigger("tile_quarantined", key=f"churn-b-{i}")
+    assert len(m._debounce) <= MAX_DEBOUNCE_KEYS
+    assert m.trigger("alert_fired", key="precious") == "debounced"
+
+
+def test_bundle_id_grammar_survives_seq_past_9999(manager):
+    from comfyui_distributed_tpu.telemetry.incidents import _BUNDLE_ID_RE
+
+    m, clock = manager
+    assert _BUNDLE_ID_RE.fullmatch("incident-0000000001000-10000-manual")
+    m._seq = 9999  # the next capture formats as 5 digits
+    result = m.capture_now()
+    assert "-10000-" in result["id"]
+    bundle = m.read_bundle(result["id"])
+    assert bundle is not None
+    assert validate_bundle(bundle) == []
+
+
+def test_capture_now_keeps_the_debounce_map_bounded(tmp_path, monkeypatch):
+    """Manual captures arrive on an unauthenticated POST: distinct
+    keys must not grow the debounce map past its bound."""
+    from comfyui_distributed_tpu.telemetry import incidents as incidents_mod
+
+    monkeypatch.setattr(incidents_mod, "MAX_DEBOUNCE_KEYS", 8)
+    clock = FakeClock()
+    m = IncidentManager(
+        str(tmp_path), clock=clock, min_interval_s=0.0, max_bundles=4,
+    )
+    for i in range(20):
+        m.capture_now(key=f"op-{i}")
+    assert len(m._debounce) <= 8
+
+
+def test_failed_capture_releases_its_windows(tmp_path):
+    """A capture that produced NO bundle (unwritable dir) must not
+    hold its debounce/rate-limit reservations — the re-fire captures
+    once the path is fixed."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the incident DIR should be")
+    clock = FakeClock()
+    # directory path points INSIDE a file -> atomic write fails
+    m = IncidentManager(
+        str(blocker / "incidents"), clock=clock,
+        debounce_s=300.0, min_interval_s=10.0,
+    )
+    m.start()
+    try:
+        assert m.trigger("alert_fired", key="tile_latency") == "queued"
+        assert m.flush(10)
+        assert m.counters["errors"] == 1
+        assert m.counters["captured"] == 0
+        # windows released: the SAME key re-fires as queued (not
+        # debounced), and the global floor doesn't block it either
+        assert m.trigger("alert_fired", key="tile_latency") == "queued"
+        assert m.flush(10)
+        assert m.counters["errors"] == 2
+        # manual path propagates AND rolls back
+        with pytest.raises(Exception):
+            m.capture_now(key="manual-broken")
+        assert "manual:manual-broken" not in m._debounce
+    finally:
+        m.stop()
+
+
+def test_chaos_run_that_raises_mid_setup_leaks_no_incident_tap(tmp_path):
+    """A raising chaos run must stop the incident manager: no
+    'incidents' tap left on the process bus, no parked writer."""
+    import threading as threading_mod
+
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+    with pytest.raises(Exception):
+        run_chaos_usdu(
+            seed=11,
+            incidents={"dir": str(tmp_path)},
+            # bogus PlacementPolicy kwarg -> TypeError during setup
+            placement={"definitely_not_a_kwarg": 1},
+        )
+    assert "incidents" not in get_event_bus().stats()["taps"]
+    assert not any(
+        t.name == "cdt-incident-writer" and t.is_alive()
+        for t in threading_mod.enumerate()
+    )
