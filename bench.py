@@ -785,6 +785,89 @@ def _measure_cancel_latency(jobs: int = 4, tiles: int = 64) -> dict | None:
         return None
 
 
+def _measure_mixed_small_jobs(
+    n_jobs: int = 4, steps: int = 4, k_max: int = 8
+) -> dict | None:
+    """Cross-job continuous-batching A/B (xjob-tier PR satellite):
+    `n_jobs` concurrent small (3-tile) jobs across two tenants drain
+    through the CrossJobExecutor twice — cross-job batches vs per-job
+    batches — on the in-process chaos harness (real JobStore + real
+    preemption coordinator, stub processor). Stamps the measured
+    batch-fill ratios (real vs padded device slots per dispatch),
+    tiles/sec/chip for each mode, and a bit-identity verdict (first
+    job's canvas vs its solo run) into the datum as
+    `mixed_small_jobs`, so the cross-job win lands as a measured A/B.
+    Returns None (never raises) when the measurement can't run."""
+    try:
+        import time as time_mod
+
+        from comfyui_distributed_tpu.resilience.chaos import run_chaos_xjob
+
+        jobs = [
+            {
+                "job_id": f"bench-xjob-{i}",
+                "seed": 100 + i,
+                "tenant": "tenant-a" if i % 2 == 0 else "tenant-b",
+                "lane": "batch",
+                "image_hw": (32, 96),  # 3 tiles each: ragged vs buckets
+            }
+            for i in range(n_jobs)
+        ]
+
+        def one_mode(cross_job: bool):
+            started = time_mod.perf_counter()
+            result = run_chaos_xjob(
+                seed=100, jobs=jobs, steps=steps, k_max=k_max,
+                cross_job=cross_job,
+            )
+            elapsed = time_mod.perf_counter() - started
+            tiles = result.stats["tiles"]
+            return result, {
+                "fill_ratio": round(result.fill_ratio, 4),
+                "padded_slots": result.stats["slots_padded"],
+                "real_slots": result.stats["slots_real"],
+                "dispatches": result.stats["dispatches"],
+                "tiles": tiles,
+                "elapsed_s": round(elapsed, 4),
+                # ONE host drives the harness executor, so per-chip ==
+                # per-run here; real fleets scale by topology.chips
+                "tiles_per_sec_chip": round(tiles / elapsed, 3)
+                if elapsed > 0
+                else None,
+            }
+
+        # solo baseline FIRST: it doubles as the jax dispatch warmup so
+        # neither timed mode pays first-call tracing overhead
+        solo = run_chaos_xjob(seed=100, jobs=[dict(jobs[0])], steps=steps)
+        mixed_result, mixed = one_mode(True)
+        perjob_result, perjob = one_mode(False)
+        import numpy as _np
+
+        jid = jobs[0]["job_id"]
+        bit_identical = bool(
+            _np.array_equal(solo.canvases[jid], mixed_result.canvases[jid])
+            and _np.array_equal(
+                solo.canvases[jid], perjob_result.canvases[jid]
+            )
+        )
+        return {
+            "jobs": n_jobs,
+            "tiles_per_job": 3,
+            "tenants": 2,
+            "steps": steps,
+            "k_max": k_max,
+            "cross_job": mixed,
+            "per_job": perjob,
+            "fill_ratio_gain": round(
+                mixed["fill_ratio"] - perjob["fill_ratio"], 4
+            ),
+            "bit_identical": bit_identical,
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"mixed-small-jobs measurement failed: {exc}", file=sys.stderr)
+        return None
+
+
 def _measure_grant_ab(
     waves: int = 6,
     wave_tiles: int = 2,
@@ -1570,6 +1653,13 @@ def main() -> None:
         lifecycle = _measure_cancel_latency()
         if lifecycle is not None:
             result["lifecycle"] = lifecycle
+    # cross-job continuous-batching A/B: batch-fill ratio + tiles/sec/
+    # chip for mixed small concurrent jobs vs per-job batching (the
+    # xjob tier's utilization win as a measured datum)
+    if tiny and os.environ.get("BENCH_MIXED_JOBS", "1") != "0":
+        mixed_jobs = _measure_mixed_small_jobs()
+        if mixed_jobs is not None:
+            result["mixed_small_jobs"] = mixed_jobs
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

@@ -113,6 +113,19 @@ async def _orchestrate(
             # the API→store deadline seam: the executor's later
             # init_tile_job picks this up and arms the job's cutoff
             server.job_store.note_job_deadline(job_id, payload.deadline_s)
+        # the API→store priority seam (same shape): lane/tenant stamp
+        # onto the job at init so the preemption coordinator can rank
+        # it against running work. The RESOLVED lane, not the raw
+        # field: a request with no lane lands on the default lane, and
+        # stamping '' would rank it UNRANKED — evictable by arrivals
+        # of its own admission class.
+        scheduler = getattr(server, "scheduler", None)
+        lane = (
+            scheduler.resolve_lane(payload.lane)
+            if scheduler is not None
+            else payload.lane
+        )
+        server.job_store.note_job_priority(job_id, lane, payload.tenant)
 
     enabled_ids = [str(w.get("id")) for w in active]
     prep_sem = asyncio.Semaphore(settings.get("prep_concurrency", 4))

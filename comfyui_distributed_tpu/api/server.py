@@ -17,6 +17,7 @@ the reference (reference distributed.py:48, prompt_transform.py).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import queue as thread_queue
 import threading
@@ -112,6 +113,31 @@ class DistributedServer:
 
         self.scheduler = SchedulerControl(health=get_health_registry())
         self.job_store.placement = self.scheduler.placement
+        # Step-level preemption coordinator (scheduler/preempt.py):
+        # ranks jobs by the admission queue's lane order; a premium
+        # arrival flags running lower-lane jobs for step-boundary
+        # eviction, and brownout escalation can evict shed lanes'
+        # running work (CDT_PREEMPT_BROWNOUT_LEVEL). All seams are
+        # advisory: with CDT_PREEMPT=0 or single-lane traffic this is
+        # inert.
+        from ..scheduler.preempt import PreemptionCoordinator
+
+        self.preempt = PreemptionCoordinator(
+            self.scheduler.queue.lane_order, self.job_store
+        )
+        self.job_store.preempt_policy = self.preempt
+
+        def _brownout_evict(level: int, shed_lanes: list) -> None:
+            # evaluate() runs on the server loop (admission path);
+            # schedule the eviction sweep without blocking admission
+            import asyncio as _asyncio
+
+            with contextlib.suppress(RuntimeError):
+                _asyncio.get_running_loop().create_task(
+                    self.preempt.on_brownout(level, shed_lanes)
+                )
+
+        self.scheduler.brownout.preempt_hook = _brownout_evict
         # Poison pardon: when a tile is quarantined after exhausting
         # its attempt budget, the workers whose crashes were charged to
         # it leave the circuit breaker — one bad payload must not

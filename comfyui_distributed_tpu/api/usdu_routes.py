@@ -117,12 +117,18 @@ class UsduRoutes:
             )
         except StaleEpoch as exc:
             return _stale_epoch_response(exc)
-        return web.json_response(
-            {
-                "status": "ok" if ok else "unknown_job",
-                "epoch": self.server.job_store.epoch,
-            }
-        )
+        response = {
+            "status": "ok" if ok else "unknown_job",
+            "epoch": self.server.job_store.epoch,
+        }
+        job = await self.server.job_store.get_tile_job(str(body["job_id"]))
+        if job is not None and job.preempt_requested:
+            # the heartbeat is the eviction side-channel for workers
+            # mid-batch (their next pull may be a step away): executors
+            # checkpoint + release at the next step boundary
+            response["preempt"] = True
+            response["preempt_reason"] = job.preempt_reason
+        return web.json_response(response)
 
     async def request_image(self, request: web.Request) -> web.Response:
         """Pull work. Response: {tile_idx|image_idx|None,
@@ -137,13 +143,54 @@ class UsduRoutes:
         if rejection is not None:
             return rejection
         body = await _json(request)
-        if not body or "job_id" not in body or "worker_id" not in body:
+        any_job = bool(body.get("any_job")) if body else False
+        if not body or "worker_id" not in body or (
+            "job_id" not in body and not any_job
+        ):
             return web.json_response({"error": "job_id and worker_id required"}, status=400)
-        job_id, worker_id = str(body["job_id"]), str(body["worker_id"])
+        job_id, worker_id = str(body.get("job_id", "")), str(body["worker_id"])
         try:
             batch_max = max(1, int(body.get("batch_max", 1)))
         except (TypeError, ValueError):
             batch_max = 1
+        if any_job:
+            # cross-job grant: claim across EVERY active job, most-
+            # urgent lane first (the multi-job executor's refill RPC)
+            try:
+                self.server.job_store.check_epoch(body.get("epoch"))
+            except StaleEpoch as exc:
+                return _stale_epoch_response(exc)
+            if "devices" in body:
+                self.server.job_store.note_worker_capacity(
+                    worker_id, body["devices"]
+                )
+            self._note_telemetry(worker_id, body)
+            with rpc_span(
+                request, "rpc.request_image", worker_id=worker_id,
+                job_id="*",
+            ):
+                try:
+                    grants = await self.server.job_store.pull_tasks_any(
+                        worker_id, limit=batch_max, epoch=body.get("epoch"),
+                    )
+                except StaleEpoch as exc:
+                    return _stale_epoch_response(exc)
+            return web.json_response(
+                {
+                    "grants": [
+                        {
+                            "job_id": g["job"],
+                            "tile_idxs": g["tile_idxs"],
+                            "checkpoints": {
+                                str(t): c
+                                for t, c in sorted(g["checkpoints"].items())
+                            },
+                        }
+                        for g in grants
+                    ],
+                    "epoch": self.server.job_store.epoch,
+                }
+            )
         # fencing BEFORE any server-side state — a stale-authority
         # client must not even adjust advisory placement capacity
         try:
@@ -203,6 +250,23 @@ class UsduRoutes:
         deadline_remaining = job.deadline_remaining()
         if deadline_remaining is not None:
             response["deadline_remaining"] = round(deadline_remaining, 3)
+        # --- xjob tier: step-level preemption + checkpoint resume -----
+        if job.preempt_requested:
+            # the worker should evict this job's in-flight tiles at the
+            # next step boundary (and stop claiming; this pull already
+            # read as drained via the store's preempt gate)
+            response["preempt"] = True
+            response["preempt_reason"] = job.preempt_reason
+        if task_ids:
+            checkpoints = await self.server.job_store.checkpoints_for(
+                job_id, task_ids
+            )
+            if checkpoints:
+                # preempt-released sampler state rides back with the
+                # grant so resume skips the already-denoised steps
+                response["checkpoints"] = {
+                    str(t): payload for t, payload in sorted(checkpoints.items())
+                }
         return web.json_response(response)
 
     async def submit_tiles(self, request: web.Request) -> web.Response:
@@ -312,6 +376,14 @@ class UsduRoutes:
             return web.json_response(
                 {"error": "tile_idxs must be a list of ints"}, status=400
             )
+        # xjob tier: a preempted executor attaches per-tile sampler
+        # checkpoints; the store schema-validates and budget-bounds
+        # them (malformed/oversized entries drop to recompute)
+        checkpoints = body.get("checkpoints")
+        if checkpoints is not None and not isinstance(checkpoints, dict):
+            return web.json_response(
+                {"error": "checkpoints must be a dict"}, status=400
+            )
         with rpc_span(
             request, "rpc.return_tiles",
             worker_id=str(body["worker_id"]), job_id=str(body["job_id"]),
@@ -319,7 +391,7 @@ class UsduRoutes:
             try:
                 released = await self.server.job_store.release_tasks(
                     str(body["job_id"]), str(body["worker_id"]), idxs,
-                    epoch=body.get("epoch"),
+                    epoch=body.get("epoch"), checkpoints=checkpoints,
                 )
             except StaleEpoch as exc:
                 return _stale_epoch_response(exc)
@@ -358,5 +430,10 @@ class UsduRoutes:
                 "cancel_reason": job.cancel_reason,
                 "quarantined_tiles": sorted(job.quarantined_tiles),
                 "deadline_remaining": job.deadline_remaining(),
+                # xjob tier surfaces: lane/tenant rank the job for
+                # preemption; `preempt` mirrors the pull-path flag
+                "lane": job.lane,
+                "tenant": job.tenant,
+                "preempt": job.preempt_requested,
             }
         )

@@ -57,7 +57,7 @@ def new_state() -> dict[str, Any]:
 
 def _new_job(
     kind: str, batched: bool, tasks: list[int],
-    deadline_s: Any = None,
+    deadline_s: Any = None, lane: Any = "", tenant: Any = "default",
 ) -> dict[str, Any]:
     try:
         deadline_s = float(deadline_s) if deadline_s else None
@@ -78,6 +78,11 @@ def _new_job(
         "cancel_reason": "",
         "attempts": {},     # str(task id) -> failed delivery attempts
         "quarantined": [],  # task ids settled degraded (poison)
+        # --- xjob tier: admission lane/tenant ride job_init so a
+        # recovered master can rank recovered jobs for preemption
+        # (checkpoints do NOT — they are volatile; recompute covers)
+        "lane": str(lane or ""),
+        "tenant": str(tenant or "default"),
     }
 
 
@@ -98,6 +103,8 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
                 bool(record.get("batched", True)),
                 list(record.get("tasks", [])),
                 deadline_s=record.get("deadline_s"),
+                lane=record.get("lane", ""),
+                tenant=record.get("tenant", "default"),
             )
         return
     job = jobs.get(str(record.get("job", "")))
@@ -296,6 +303,8 @@ def materialize(state: dict[str, Any]):
         job.quarantined_tiles = {
             int(t) for t in spec.get("quarantined", [])
         }
+        job.lane = str(spec.get("lane", "") or "")
+        job.tenant = str(spec.get("tenant", "default") or "default")
         deadline_s = spec.get("deadline_s")
         if deadline_s:
             import time as _time

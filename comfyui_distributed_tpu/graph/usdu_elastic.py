@@ -138,6 +138,12 @@ class HTTPWorkClient:
         # pipeline aborts between batches instead of draining grants.
         self.job_cancelled = False
         self.cancel_reason = ""
+        # Step-level preemption (xjob tier): flipped when a pull or
+        # heartbeat response carries `preempt: true` — the executor
+        # checkpoints + releases this job's in-flight tiles at the next
+        # step boundary; cleared when a response stops carrying it.
+        self.preempt_requested = False
+        self.preempt_reason = ""
         # Remaining end-to-end deadline (seconds) as of the last pull
         # response; None = no deadline on this job.
         self.deadline_remaining: Optional[float] = None
@@ -186,6 +192,13 @@ class HTTPWorkClient:
             return
         if epoch > 0 and (self.epoch is None or epoch > self.epoch):
             self.epoch = epoch
+
+    def _learn_preempt(self, out: dict) -> None:
+        """Track the master's per-job preemption flag from any RPC
+        response that carries it (pull + heartbeat); absence clears —
+        the flag is live scheduling pressure, not a latch."""
+        self.preempt_requested = bool(out.get("preempt"))
+        self.preempt_reason = str(out.get("preempt_reason", ""))
 
     def _count_error(self, op: str) -> None:
         """One master-RPC failure: counted per operation, and after
@@ -315,6 +328,7 @@ class HTTPWorkClient:
             self.job_cancelled = True
             self.cancel_reason = str(out.get("cancel_reason", ""))
             return None
+        self._learn_preempt(out)
         if "deadline_remaining" in out:
             try:
                 self.deadline_remaining = float(out["deadline_remaining"])
@@ -397,9 +411,13 @@ class HTTPWorkClient:
             if snapshot is not None:
                 payload["telemetry"] = snapshot
             try:
-                await self._post(
+                out = await self._post(
                     "/distributed/heartbeat", payload, op="heartbeat",
                 )
+                if isinstance(out, dict):
+                    # the eviction side-channel: a worker mid-batch may
+                    # be many steps from its next pull
+                    self._learn_preempt(out)
             except Exception as exc:  # noqa: BLE001 - heartbeats best-effort
                 self._hb_failures += 1
                 backoff = min(
@@ -418,23 +436,31 @@ class HTTPWorkClient:
 
         run_async_in_server_loop(beat(), timeout=30)
 
-    def return_tiles(self, tile_idxs: list[int]) -> None:
+    def return_tiles(
+        self, tile_idxs: list[int], checkpoints: Optional[dict] = None
+    ) -> None:
         """Hand claimed-but-unprocessed tiles back to the master (an
-        interrupted in-flight grant) so they requeue immediately
-        instead of waiting out the heartbeat timeout. Best effort: if
-        the master is unreachable, its timeout requeue still covers
-        these tiles."""
+        interrupted in-flight grant, or a preemption eviction) so they
+        requeue immediately instead of waiting out the heartbeat
+        timeout. ``checkpoints`` (xjob tier) attaches per-tile sampler
+        state so a re-granted tile resumes mid-trajectory. Best
+        effort: if the master is unreachable, its timeout requeue
+        still covers these tiles (recompute-from-0 stays
+        bit-identical)."""
 
         async def send():
+            payload: dict = {
+                "job_id": self.job_id,
+                "worker_id": self.worker_id,
+                "tile_idxs": [int(t) for t in tile_idxs],
+            }
+            if checkpoints:
+                payload["checkpoints"] = {
+                    str(t): c for t, c in sorted(checkpoints.items())
+                }
             try:
                 await self._post(
-                    "/distributed/return_tiles",
-                    {
-                        "job_id": self.job_id,
-                        "worker_id": self.worker_id,
-                        "tile_idxs": [int(t) for t in tile_idxs],
-                    },
-                    op="release",
+                    "/distributed/return_tiles", payload, op="release",
                 )
             except Exception as exc:  # noqa: BLE001 - best effort
                 debug_log(f"return_tiles failed: {exc}")
@@ -637,6 +663,36 @@ def run_worker_loop(
     advertises 4x grant capacity to the master's placement policy.
     Checkpoints over the CDT_MESH_HBM_GB per-chip budget shard their
     parameters along the model axis instead of failing to load."""
+    from ..utils.constants import xjob_batch_enabled
+
+    if xjob_batch_enabled():
+        from ..ops.stepwise import stepwise_supported
+
+        if stepwise_supported(sampler):
+            # cross-job continuous batching (CDT_XJOB_BATCH=1): this
+            # job registers with the process-shared executor and its
+            # tiles share device batches with every other registered
+            # job; unsupported samplers fall through to the scan tier
+            from ..ops.stepwise import StepwiseUnsupported
+            from .batch_executor import run_worker_xjob
+
+            try:
+                return run_worker_xjob(
+                    bundle, image, pos, neg, job_id, worker_id, master_url,
+                    upscale_by, tile, padding, steps, sampler, scheduler,
+                    cfg, denoise, seed, upscale_method=upscale_method,
+                    mask_blur=mask_blur, uniform=uniform,
+                    tiled_decode=tiled_decode, tile_h=tile_h,
+                    context=context, client=client, mesh=mesh,
+                )
+            except StepwiseUnsupported as exc:
+                # the stepwise factory refused (e.g. flow model +
+                # ancestral sampler) BEFORE any job state was touched:
+                # the scan tier serves the job. Any other error from a
+                # RUNNING xjob job propagates — re-running the whole
+                # job here would double-compute it.
+                debug_log(f"xjob tier unavailable for {job_id}: {exc}")
+
     from ..parallel.mesh import (
         advertised_capacity,
         data_axis_size,
@@ -889,6 +945,36 @@ def run_master_elastic(
     Returns the blended [B, H, W, C] image. Fault tolerance: stale
     workers' tiles are requeued (busy-probe grace) and re-run locally.
     """
+    from ..utils.constants import xjob_batch_enabled
+
+    if xjob_batch_enabled():
+        from ..ops.stepwise import stepwise_supported
+
+        if stepwise_supported(sampler):
+            # cross-job continuous batching (CDT_XJOB_BATCH=1): the
+            # master's own participation rides the shared executor so
+            # its tiles batch with every other registered job's
+            from ..ops.stepwise import StepwiseUnsupported
+            from .batch_executor import run_master_xjob
+
+            try:
+                return run_master_xjob(
+                    bundle, image, pos, neg, job_id, enabled_worker_ids,
+                    mesh=mesh, upscale_by=upscale_by, tile=tile,
+                    padding=padding, steps=steps, sampler=sampler,
+                    scheduler=scheduler, cfg=cfg, denoise=denoise,
+                    seed=seed, upscale_method=upscale_method,
+                    mask_blur=mask_blur, uniform=uniform,
+                    tiled_decode=tiled_decode, tile_h=tile_h,
+                    context=context,
+                )
+            except StepwiseUnsupported as exc:
+                # raised by _prep_xjob before the job inits; any error
+                # from a RUNNING xjob master propagates (the job was
+                # already initialized/cleaned — re-running would
+                # double-compute it against exited workers)
+                debug_log(f"xjob tier unavailable for {job_id}: {exc}")
+
     from ..utils.config import get_worker_timeout_seconds
 
     server = context.server

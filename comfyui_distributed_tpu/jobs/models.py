@@ -79,6 +79,25 @@ class TileJob:
     # budget; the job completes degraded (or fails, per policy) with
     # these counted as settled
     quarantined_tiles: set[int] = dataclasses.field(default_factory=set)
+    # --- cross-job batching + step-level preemption (xjob tier) ----------
+    # Admission lane / tenant this job was queued under (journaled with
+    # job_init): the preemption coordinator ranks jobs by lane and the
+    # fair-share satellite splits worker service time by owning job.
+    lane: str = ""
+    tenant: str = "default"
+    # Preemption request raised by the scheduler coordinator: pulls for
+    # this job read as drained (outcome="preempted") and executors
+    # evict its in-flight tiles at the next step boundary, requeueing
+    # them with checkpoints through release_tasks.
+    preempt_requested: bool = False
+    preempt_reason: str = ""
+    # task id -> encoded sampler checkpoint (ops/stepwise codec).
+    # VOLATILE by design: never journaled, dropped on cancel/cleanup,
+    # popped on hand-out and on submit — recovery and crashed workers
+    # recompute from step 0 (the bit-identity reference).
+    checkpoints: dict[int, Any] = dataclasses.field(default_factory=dict)
+    # decoded-size accounting for the per-job checkpoint budget
+    checkpoint_bytes: int = 0
 
     def heartbeat(self, worker_id: str) -> None:
         self.worker_status[worker_id] = time.monotonic()

@@ -85,6 +85,22 @@ class JobStore:
         # seam); bounded insertion-order dict, popped at init.
         self._pending_deadlines: dict[str, float] = {}
         self._max_pending_deadlines = 512
+        # job_id → (lane, tenant) noted by orchestration the same way
+        # (the API-to-store priority seam for the preemption
+        # coordinator); same bound discipline.
+        self._pending_priorities: dict[str, tuple[str, str]] = {}
+        # Preemption coordinator (scheduler/preempt.py): consulted
+        # AFTER init/cleanup/cancel commit (awaited outside the journal
+        # emission, inside the server loop). None = no preemption.
+        self.preempt_policy: Any = None
+        # worker_id → monotonic time of its last accepted submit to ANY
+        # job: a multi-job grant's flush interval must be measured from
+        # the worker's previous submit across jobs, not just within one
+        # job, or time spent computing job A's tiles reads as job B's
+        # service time (the cost-model split satellite). Bounded,
+        # oldest-submitted evicted; written only under self.lock.
+        self._worker_last_submit: dict[str, float] = {}
+        self._max_worker_last_submit = 1024
         # Optional (worker_id, seconds) callback fed every completed
         # task's pull→submit latency — the watchdog's straggler signal
         # and the placement policy's speed model (the server wires this
@@ -219,6 +235,30 @@ class JobStore:
         action = self.fault_injector.hit(f"store:heartbeat:{worker_id}")
         return action is not None and action.kind == "drop"
 
+    def _note_worker_submit_locked(
+        self, worker_id: str, job: TileJob, now: float
+    ) -> Optional[float]:
+        """Caller holds self.lock. Returns the worker's effective
+        previous-submit mark — the LATER of its per-job and cross-job
+        marks — then advances both to ``now``. Identical to the
+        historical per-job semantics while one job is active (the
+        pinned latency tests); honest under multi-job grants."""
+        prev_job = job.last_submit.get(worker_id)
+        prev_any = self._worker_last_submit.get(worker_id)
+        job.last_submit[worker_id] = now
+        if worker_id in self._worker_last_submit:
+            self._worker_last_submit.pop(worker_id)
+        elif len(self._worker_last_submit) >= self._max_worker_last_submit:
+            self._worker_last_submit.pop(
+                next(iter(self._worker_last_submit))
+            )
+        self._worker_last_submit[worker_id] = now
+        if prev_job is None:
+            return prev_any
+        if prev_any is None:
+            return prev_job
+        return max(prev_job, prev_any)
+
     def _record_heartbeat(self, job: TileJob, worker_id: str) -> None:
         if not self._heartbeat_dropped(worker_id):
             job.heartbeat(worker_id)
@@ -335,9 +375,22 @@ class JobStore:
             self._pending_deadlines.pop(next(iter(self._pending_deadlines)))
         self._pending_deadlines[job_id] = deadline_s
 
+    def note_job_priority(self, job_id: str, lane: Any, tenant: Any) -> None:
+        """Record the admission lane/tenant for a job that has not been
+        initialized yet (the orchestration seam, exactly like
+        ``note_job_deadline``): the later ``init_tile_job`` stamps them
+        onto the job so the preemption coordinator can rank it."""
+        lane = str(lane) if lane else ""
+        tenant = str(tenant) if tenant else "default"
+        self._pending_priorities.pop(job_id, None)
+        while len(self._pending_priorities) >= self._max_pending_deadlines:
+            self._pending_priorities.pop(next(iter(self._pending_priorities)))
+        self._pending_priorities[job_id] = (lane, tenant)
+
     async def init_tile_job(
         self, job_id: str, task_ids: list[int], batched: bool = True,
         kind: str = "tile", deadline_s: Optional[float] = None,
+        lane: Optional[str] = None, tenant: Optional[str] = None,
     ) -> TileJob:
         from ..utils.constants import JOB_DEADLINE_DEFAULT_SECONDS
 
@@ -348,8 +401,15 @@ class JobStore:
                 deadline_s = self._pending_deadlines.pop(job_id, None)
             if deadline_s is None and JOB_DEADLINE_DEFAULT_SECONDS > 0:
                 deadline_s = JOB_DEADLINE_DEFAULT_SECONDS
+            noted_lane, noted_tenant = self._pending_priorities.pop(
+                job_id, ("", "default")
+            )
+            lane = str(lane) if lane is not None else noted_lane
+            tenant = str(tenant) if tenant is not None else noted_tenant
             cls = TileJob if kind == "tile" else ImageJob
             job = cls(job_id=job_id, total_tasks=len(task_ids), batched=batched)
+            job.lane = lane
+            job.tenant = tenant or "default"
             if deadline_s is not None and deadline_s > 0:
                 job.deadline_s = float(deadline_s)
                 job.deadline_at = time.monotonic() + float(deadline_s)
@@ -361,6 +421,8 @@ class JobStore:
                     "batched": batched,
                     "tasks": [int(t) for t in task_ids],
                     "deadline_s": job.deadline_s,
+                    "lane": job.lane,
+                    "tenant": job.tenant,
                 }
             )
             for tid in task_ids:
@@ -375,6 +437,16 @@ class JobStore:
 
         get_event_bus().publish("job_ready", job_id=job_id, tasks=len(task_ids))
         self._notify_grants(job_id, len(task_ids))
+        # Preemption seam: a premium-lane arrival may evict running
+        # lower-lane work. Awaited AFTER the init committed (the
+        # coordinator re-enters the store lock); advisory — a broken
+        # policy must never fail job creation.
+        policy = self.preempt_policy
+        if policy is not None:
+            try:
+                await policy.on_job_init(job_id)
+            except Exception as exc:  # noqa: BLE001 - preemption advisory
+                debug_log(f"preempt on_job_init({job_id}) failed: {exc}")
         return job
 
     async def get_tile_job(self, job_id: str) -> Optional[TileJob]:
@@ -454,6 +526,18 @@ class JobStore:
                 self._record_heartbeat(job, worker_id)
             instruments.store_pulls_total().inc(
                 worker_id=worker_id, outcome="cancelled"
+            )
+            return None
+        if job.preempt_requested:
+            # a preempted job answers like a drained one until the
+            # premium work settles: its released tiles must not flow
+            # back to an executor mid-eviction, and workers stop
+            # claiming new tiles for it (they learn via the `preempt`
+            # field on this same response path)
+            async with self.lock:
+                self._record_heartbeat(job, worker_id)
+            instruments.store_pulls_total().inc(
+                worker_id=worker_id, outcome="preempted"
             )
             return None
         if not self._may_pull(job, worker_id):
@@ -550,6 +634,274 @@ class JobStore:
                     )
         return tasks
 
+    def _lane_rank(self, lane: str) -> int:
+        """Priority rank of an admission lane (lower = more urgent):
+        delegated to the preemption coordinator when wired (it knows
+        the scheduler's lane order); unknown/blank lanes rank last so
+        legacy jobs never outrank an explicit premium lane."""
+        policy = self.preempt_policy
+        if policy is not None:
+            try:
+                return int(policy.lane_rank(lane))
+            except Exception:  # noqa: BLE001 - advisory ranking
+                pass
+        return 1 << 20
+
+    async def pull_tasks_any(
+        self,
+        worker_id: str,
+        limit: int = 1,
+        epoch: Any = None,
+    ) -> list[dict[str, Any]]:
+        """Cross-job grant: claim up to ``limit`` tasks across EVERY
+        active job, most-urgent lane first (FIFO by creation within a
+        rank) — the multi-job pull the continuous-batching executor
+        drains. Returns ``[{"job", "tile_idxs", "checkpoints"}, ...]``;
+        one ``pull`` record journals per touched job (the existing
+        record vocabulary — replay needs no new type). Non-blocking:
+        an empty answer means nothing is claimable right now. The
+        placement policy's tail trimming still applies per job — a
+        suspect/slow worker is denied each job's tail exactly as on
+        the single-job pull path."""
+        self._check_epoch(epoch)
+        await self._fault("pull", worker_id)
+        limit = max(1, int(limit))
+        expired: list[str] = []
+        async with self.lock:
+            jobs = sorted(
+                self.tile_jobs.values(),
+                key=lambda j: (
+                    self._lane_rank(j.lane), j.created_at, j.job_id
+                ),
+            )
+            grants: list[dict[str, Any]] = []
+            for job in jobs:
+                if limit <= 0:
+                    break
+                if job.cancelled or job.preempt_requested:
+                    continue
+                if isinstance(job, ImageJob):
+                    # dynamic-mode jobs hand out IMAGE indices: granting
+                    # them as tile_idxs to a tile executor would index
+                    # tile machinery with frame numbers
+                    continue
+                if job.deadline_expired():
+                    # the lazy deadline sweep, exactly like pull_task:
+                    # overdue work must not burn device steps (the
+                    # cancel itself needs the lock — collected here,
+                    # fired below)
+                    expired.append(job.job_id)
+                    continue
+                if not self._may_pull(job, worker_id):
+                    continue
+                claimed: list[int] = []
+                while len(claimed) < limit:
+                    try:
+                        task_id = job.pending.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if task_id in job.quarantined_tiles:
+                        continue  # stale speculated copy of poison
+                    self._record_assignment_locked(
+                        job, worker_id, task_id, journal=False
+                    )
+                    instruments.store_pulls_total().inc(
+                        worker_id=worker_id, outcome="task"
+                    )
+                    claimed.append(int(task_id))
+                if not claimed:
+                    continue
+                self._record_heartbeat(job, worker_id)
+                self._journal(
+                    {
+                        "type": "pull",
+                        "job": job.job_id,
+                        "worker": worker_id,
+                        "tasks": claimed,
+                    }
+                )
+                limit -= len(claimed)
+                grants.append(
+                    {
+                        "job": job.job_id,
+                        "tile_idxs": claimed,
+                        "checkpoints": self._take_checkpoints_locked(
+                            job, claimed
+                        ),
+                    }
+                )
+        for job_id in expired:
+            await self.cancel_job(job_id, reason="deadline")
+        return grants
+
+    # --- step-level checkpoints (VOLATILE; ops/stepwise codec) -----------
+
+    @staticmethod
+    def _take_checkpoints_locked(
+        job: TileJob, task_ids: list[int]
+    ) -> dict[int, Any]:
+        """Pop retained checkpoints for tiles being handed out (caller
+        holds self.lock). Popped — not copied — so the budget frees the
+        moment a tile leaves; if the claimant dies the requeue path
+        simply recomputes from step 0 (the bit-identity reference)."""
+        from ..ops.stepwise import checkpoint_nbytes
+
+        out: dict[int, Any] = {}
+        for tid in task_ids:
+            payload = job.checkpoints.pop(int(tid), None)
+            if payload is not None:
+                out[int(tid)] = payload
+                job.checkpoint_bytes = max(
+                    0, job.checkpoint_bytes - checkpoint_nbytes(payload)
+                )
+        return out
+
+    @staticmethod
+    def _retain_checkpoints_locked(
+        job: TileJob, released: list[int], checkpoints: dict
+    ) -> None:
+        """Caller holds self.lock. Keep valid checkpoints for tiles in
+        ``released``, within the per-job byte budget; everything else
+        drops silently (recompute covers it). The payload arrives from
+        an untrusted worker RPC, so each entry is schema-validated —
+        via the METADATA-only check (``validate_checkpoint_meta``),
+        never a full b64/ndarray decode, which under this lock on the
+        serving loop would stall every other coroutine for the
+        duration of a near-cap payload. The consuming executor fully
+        decodes at adoption and drops on any error."""
+        from ..ops.stepwise import CheckpointError, validate_checkpoint_meta
+        from ..utils.constants import PREEMPT_CHECKPOINT_MB
+
+        budget = max(0, PREEMPT_CHECKPOINT_MB) * 1024 * 1024
+        allowed = set(released)
+        for raw_tid in sorted(checkpoints, key=str):
+            try:
+                tid = int(raw_tid)
+            except (TypeError, ValueError):
+                continue
+            if tid not in allowed:
+                continue
+            payload = checkpoints[raw_tid]
+            try:
+                size = validate_checkpoint_meta(payload)
+            except CheckpointError as exc:
+                debug_log(
+                    f"checkpoint for {job.job_id}:{tid} rejected: {exc}"
+                )
+                continue
+            if job.checkpoint_bytes + size > budget:
+                debug_log(
+                    f"checkpoint for {job.job_id}:{tid} dropped: per-job "
+                    f"budget {budget} bytes exhausted (recompute fallback)"
+                )
+                continue
+            job.checkpoints[tid] = payload
+            job.checkpoint_bytes += size
+
+    async def checkpoints_for(
+        self, job_id: str, task_ids: list[int]
+    ) -> dict[int, Any]:
+        """Pop the retained checkpoints for tiles just granted through
+        the single-job pull path (the route attaches them to the
+        response). Empty when none were preempt-released."""
+        job = await self.get_tile_job(job_id)
+        if job is None or not task_ids:
+            return {}
+        async with self.lock:
+            return self._take_checkpoints_locked(
+                job, [int(t) for t in task_ids]
+            )
+
+    # --- preemption (scheduler/preempt.py drives these) ------------------
+
+    async def request_preemption(
+        self, job_ids: list[str], reason: str = "manual"
+    ) -> list[str]:
+        """Flag jobs for step-level eviction: their pulls read as
+        drained and every pull/heartbeat response carries
+        ``preempt: true`` so executors checkpoint + release at the next
+        step boundary. Returns the jobs newly flagged. NOT journaled:
+        preemption is scheduling pressure, not state — a restarted
+        master re-derives it from its own queue."""
+        flagged: list[str] = []
+        async with self.lock:
+            for job_id in sorted(str(j) for j in job_ids):
+                job = self.tile_jobs.get(job_id)
+                if job is None or job.cancelled or job.preempt_requested:
+                    continue
+                job.preempt_requested = True
+                job.preempt_reason = str(reason)
+                flagged.append(job_id)
+        if flagged:
+            instruments.preempt_total().inc(len(flagged), reason=str(reason))
+            from ..telemetry.events import get_event_bus
+
+            get_event_bus().publish(
+                "preempt_requested", job_ids=flagged, reason=str(reason)
+            )
+            log(
+                f"preemption requested ({reason}) for job(s) "
+                f"{', '.join(flagged)}"
+            )
+        return flagged
+
+    async def clear_preemption(self, job_ids: list[str]) -> list[str]:
+        """Lift preemption flags (the premium work settled): cleared
+        jobs become pullable again and their released tiles — with any
+        retained checkpoints — flow back to executors."""
+        cleared: list[str] = []
+        refill: list[tuple[str, int]] = []
+        async with self.lock:
+            for job_id in sorted(str(j) for j in job_ids):
+                job = self.tile_jobs.get(job_id)
+                if job is None or not job.preempt_requested:
+                    continue
+                job.preempt_requested = False
+                job.preempt_reason = ""
+                cleared.append(job_id)
+                pending = job.pending.qsize()
+                if pending:
+                    refill.append((job_id, pending))
+        for job_id, pending in refill:
+            # push-mode wakeup: parked workers learn the job is
+            # pullable again without waiting out a poll interval
+            self._notify_grants(job_id, pending)
+        if cleared:
+            from ..telemetry.events import get_event_bus
+
+            get_event_bus().publish("preempt_cleared", job_ids=cleared)
+        return cleared
+
+    async def preempt_victims(
+        self, premium_rank: int, include_flagged: bool = False
+    ) -> list[str]:
+        """Jobs that should yield to a premium arrival of ``rank``:
+        active, ranked strictly lower (higher number), with
+        outstanding work. ``include_flagged`` also lists jobs ALREADY
+        preempt-flagged — the coordinator records those as claims of a
+        second overlapping premium, so the first premium's settle
+        cannot lift flags the second still depends on. Selection only
+        — the caller decides and calls ``request_preemption``."""
+        async with self.lock:
+            return [
+                job.job_id
+                for job in sorted(
+                    self.tile_jobs.values(),
+                    key=lambda j: (j.created_at, j.job_id),
+                )
+                if not job.cancelled
+                and (include_flagged or not job.preempt_requested)
+                and self._lane_rank(job.lane) > premium_rank
+                and (
+                    job.pending.qsize() > 0
+                    or any(
+                        t not in job.completed
+                        for tasks in job.assigned.values()
+                        for t in tasks
+                    )
+                )
+            ]
+
     async def submit_result(
         self,
         job_id: str,
@@ -596,9 +948,16 @@ class JobStore:
             # assignment or the worker's previous submission — so the
             # time a tile sat in the worker's local batch doesn't read
             # as slowness (the watchdog and placement weights both
-            # consume this stream).
-            prev_done = job.last_submit.get(worker_id)
-            job.last_submit[worker_id] = now
+            # consume this stream). The previous submission is tracked
+            # ACROSS jobs: a multi-job grant's flush for job B follows
+            # the same worker's flush for job A, and charging B from
+            # its own (older) per-job mark would bill A's compute to
+            # B's stream and skew the placement EWMAs.
+            prev_done = self._note_worker_submit_locked(worker_id, job, now)
+            # a settled tile's retained checkpoint is dead weight:
+            # free its budget share immediately
+            if job.checkpoints:
+                self._take_checkpoints_locked(job, [task_id])
             duplicate = task_id in job.completed
             if not duplicate:
                 # First result wins, and ONLY the winner is journaled:
@@ -672,7 +1031,15 @@ class JobStore:
             raise JobQueueError(f"no such job {job_id!r}")
         now = time.monotonic()
         async with self.lock:
+            # cross-job mark included: the flush interval must start at
+            # the worker's previous submit to ANY job (see
+            # _note_worker_submit_locked)
             prev_done = job.last_submit.get(worker_id)
+            prev_any = self._worker_last_submit.get(worker_id)
+            if prev_any is not None:
+                prev_done = (
+                    prev_any if prev_done is None else max(prev_done, prev_any)
+                )
             starteds = [
                 job.assigned_at.get((worker_id, int(t))) for t in grouped
             ]
@@ -765,6 +1132,16 @@ class JobStore:
             from ..telemetry.events import get_event_bus
 
             get_event_bus().publish("job_complete", job_id=job_id)
+            # preemption seam: a settled premium job lifts the flags it
+            # raised so evicted lower-lane work resumes
+            policy = self.preempt_policy
+            if policy is not None:
+                try:
+                    await policy.on_job_settled(job_id)
+                except Exception as exc:  # noqa: BLE001 - advisory
+                    debug_log(
+                        f"preempt on_job_settled({job_id}) failed: {exc}"
+                    )
 
     # --- lifecycle: cooperative cancel + deadline sweep ---------------------
 
@@ -822,6 +1199,12 @@ class JobStore:
                     in_flight[wid] = incomplete
             job.assigned.clear()
             job.assigned_at.clear()
+            # volatile preemption state dies with the job: retained
+            # checkpoints free, and a preempt flag must not survive
+            # into the terminal accounting
+            job.checkpoints.clear()
+            job.checkpoint_bytes = 0
+            job.preempt_requested = False
             in_flight_refunded = sum(len(v) for v in in_flight.values())
         instruments.jobs_cancelled_total().inc(reason=str(reason))
         if pending_refunded or in_flight_refunded:
@@ -846,6 +1229,13 @@ class JobStore:
             f"{pending_refunded} pending + {in_flight_refunded} in-flight "
             f"tile(s) across {len(in_flight)} worker(s)"
         )
+        # a cancelled premium job lifts the preemption flags it raised
+        policy = self.preempt_policy
+        if policy is not None:
+            try:
+                await policy.on_job_settled(job_id)
+            except Exception as exc:  # noqa: BLE001 - advisory
+                debug_log(f"preempt on_job_settled({job_id}) failed: {exc}")
         return {
             "job_id": job_id,
             "reason": str(reason),
@@ -1058,13 +1448,22 @@ class JobStore:
         worker_id: str,
         task_ids: list[int],
         epoch: Any = None,
+        checkpoints: Optional[dict] = None,
     ) -> list[int]:
         """Voluntarily hand back claimed-but-unprocessed tasks — the
         graceful half of requeue: an interrupted worker returns the
         unprocessed remainder of its in-flight grant so the tiles
         requeue NOW instead of waiting out the heartbeat timeout. Only
         tasks actually assigned to this worker and not yet completed go
-        back (a stale release after a speculative win is a no-op)."""
+        back (a stale release after a speculative win is a no-op).
+
+        ``checkpoints`` (step-level preemption): per-tile encoded
+        sampler state (ops/stepwise codec) retained VOLATILELY and
+        handed back on the tile's next grant so resume skips the
+        already-denoised steps. Only checkpoints of tiles actually
+        released are kept, schema-validated, and bounded by the per-job
+        CDT_PREEMPT_CHECKPOINT_MB budget — beyond any of those the
+        checkpoint drops and that tile recomputes from step 0."""
         self._check_epoch(epoch)
         job = await self.get_tile_job(job_id)
         if job is None or job.cancelled:
@@ -1096,6 +1495,8 @@ class JobStore:
                 job.assigned_at.pop((worker_id, tid), None)
                 job.pending.put_nowait(tid)
                 released.append(tid)
+            if checkpoints:
+                self._retain_checkpoints_locked(job, released, checkpoints)
         if released:
             instruments.store_requeued_tasks_total().inc(
                 len(released), worker_id=worker_id, reason="released"

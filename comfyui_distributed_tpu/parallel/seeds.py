@@ -49,3 +49,27 @@ def participant_keys(key: jax.Array, n_participants: int) -> jax.Array:
 def local_participant_key(key: jax.Array) -> jax.Array:
     """Inside shard_map over the data axis: this chip's key."""
     return jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+
+
+def job_uid(job_id: str) -> int:
+    """Stable 32-bit fold constant derived from a job id string
+    (blake2b — NOT Python's salted hash(), which differs per process
+    and would break cross-participant determinism; 32 bits because
+    ``jax.random.fold_in`` folds uint32 data)."""
+    import hashlib
+
+    digest = hashlib.blake2b(
+        str(job_id).encode("utf-8"), digest_size=4
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+def fold_job_key(key: jax.Array, job_id: str) -> jax.Array:
+    """The cross-job batching tier's per-job root key: the user's base
+    key folded with the job id. Two jobs sharing a user seed (common
+    when tenants sweep templates) still draw independent per-tile
+    streams, and a tile's key stays a pure function of
+    (seed, job id, tile index) — independent of batch composition,
+    which is what makes cross-tenant batch mixing safe by
+    construction (graph/batch_executor.py)."""
+    return jax.random.fold_in(key, job_uid(job_id))

@@ -1864,3 +1864,371 @@ def run_chaos_poison(
         charged_states=charged_states,
         journal_quarantined=journal_quarantined,
     )
+
+
+# --------------------------------------------------------------------------
+# cross-job continuous batching + step-level preemption scenarios
+# --------------------------------------------------------------------------
+
+
+def _stub_stepwise(n_steps: int, signature: tuple = ("chaos-stepwise",)):
+    """Step-resumable stand-in for the jitted stepwise tile processor
+    (ops/stepwise.py): each step adds keyed noise derived from
+    (tile key, step index) — a pure function of per-item inputs, so
+    mixed-batch / preempt-resume runs are bit-identical to solo runs —
+    and finish snaps to the uint8 grid so the PNG envelope is
+    lossless (exactly the `_stub_process` contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params, tile, key):
+        return tile + 0.0
+
+    def step(params, x, key, pos, neg, yx, i):
+        ki = jax.random.fold_in(key, i)
+        return jnp.clip(
+            x + (0.05 / max(1, n_steps)) * jax.random.normal(ki, x.shape),
+            0.0,
+            1.0,
+        )
+
+    def finish(params, x):
+        return jnp.round(jnp.clip(x, 0.0, 1.0) * 255.0) / 255.0
+
+    return types.SimpleNamespace(
+        init=init, step=step, finish=finish, n_steps=int(n_steps),
+        signature=tuple(signature),
+    )
+
+
+class _WideBatches:
+    """Placement stub for xjob scenarios: pulls claim whole grants (the
+    executor shapes its own device batches), and the master id is
+    unused — the executor is the only compute participant."""
+
+    def __init__(self, size: int = 64):
+        self.size = int(size)
+
+    def may_pull(self, worker_id: str, pending: int) -> bool:
+        return True
+
+    def batch_size(self, worker_id: str, pending: int) -> int:
+        return self.size
+
+
+@dataclasses.dataclass
+class XJobResult:
+    """Outcome of one cross-job continuous-batching fleet run."""
+
+    canvases: dict[str, np.ndarray]       # job id -> blended canvas
+    stats: dict                           # executor summary stats
+    fill_ratio: float
+    completion_order: list                # (job_id, tile_idx) in finish order
+    preempted_jobs: list                  # jobs flagged during the run
+    evictions: int
+    resumes_checkpoint: int
+    resumes_recompute: int
+    leaks: dict                           # job id -> leak accounting
+    tiles_by_job: dict                    # job id -> accepted tile count
+
+
+def run_chaos_xjob(
+    seed: int = 0,
+    *,
+    jobs: Optional[Sequence[dict]] = None,
+    k_max: int = 8,
+    bucket_multiple: int = 1,
+    cross_job: bool = True,
+    steps: int = 4,
+    lanes: Sequence[str] = ("premium", "batch"),
+    premium: Optional[dict] = None,
+    drop_checkpoints: bool = False,
+    tile: int = 64,
+    padding: int = 16,
+    upscale_by: float = 2.0,
+    trace_jsonl: Optional[str] = None,
+) -> XJobResult:
+    """One in-process cross-job continuous-batching run: N small jobs
+    (different tenants/images/seeds, same geometry family) drain
+    through ONE CrossJobExecutor against a real JobStore wired to a
+    real PreemptionCoordinator — the production protocol shape with
+    the transports removed.
+
+    `jobs`: per-job specs ``{"job_id", "seed", "tenant", "lane",
+    "image_hw"}``; defaults to four 3-tile jobs across two tenants on
+    the "batch" lane. Each job's tiles blend into its own
+    DeterministicHostCanvas at final flush; the caller compares each
+    canvas against a SOLO run of the same spec (``jobs=[spec]``) —
+    bit-identity is the acceptance bar.
+
+    `premium`: ``{"job_id", "seed", "tenant", "image_hw",
+    "after_tiles": N}`` — injected ON THE EXECUTOR THREAD after the
+    fleet completes N tiles (deterministic, no timing race): the store
+    inits it on the top lane, the coordinator flags every running
+    batch-lane job, the executor checkpoints + releases their
+    in-flight tiles at the next step boundary, the premium job's
+    tiles take the freed slots, and on settle the flags lift and the
+    evicted work resumes from its checkpoints.
+
+    `drop_checkpoints=True` withholds retained checkpoints at re-grant
+    (the master-restart / worker-crash story: checkpoints are volatile
+    by design) so resumed tiles recompute from step 0 — the canvas
+    must STILL be bit-identical.
+
+    `cross_job=False` restricts every device batch to a single job's
+    items: the per-job baseline the fill-ratio A/B (bench
+    `mixed_small_jobs`) compares against.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..graph.batch_executor import CrossJobExecutor, XJobHandle
+    from ..jobs import JobStore
+    from ..ops import tiles as tile_ops
+    from ..ops import upscale as upscale_ops
+    from ..scheduler.preempt import PreemptionCoordinator
+    from ..utils import image as img_utils
+    from ..utils.async_helpers import run_async_in_server_loop
+
+    if jobs is None:
+        jobs = [
+            {
+                "job_id": f"xjob-{i}",
+                "seed": seed + i,
+                "tenant": "tenant-a" if i % 2 == 0 else "tenant-b",
+                "lane": "batch",
+                "image_hw": (32, 96),  # 3 tiles: ragged vs pow2 buckets
+            }
+            for i in range(4)
+        ]
+    proc = _stub_stepwise(steps)
+
+    store = JobStore()
+    store.placement = _WideBatches()
+    coordinator = PreemptionCoordinator(list(lanes), store, enabled=True)
+    store.preempt_policy = coordinator
+    executor = CrossJobExecutor(
+        k_max=k_max,
+        bucket_multiple=bucket_multiple,
+        cross_job=cross_job,
+        preempt_enabled=True,
+    )
+
+    canvases: dict[str, np.ndarray] = {}
+    tiles_by_job: dict[str, int] = {}
+    preempted_jobs: list[str] = []
+
+    def make_handle(spec: dict, lane: str, worker_id: str) -> XJobHandle:
+        job_id = str(spec["job_id"])
+        job_seed = int(spec.get("seed", seed))
+        h, w = spec.get("image_hw", (32, 96))
+        image = jnp.asarray(
+            np.random.default_rng(job_seed).random((1, h, w, 3)), jnp.float32
+        )
+        upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
+            image, upscale_by, tile, padding, "bicubic", None
+        )
+        positions = grid.positions_array()
+        from ..parallel.seeds import fold_job_key
+
+        base_key = fold_job_key(jax.random.key(job_seed), job_id)
+        canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
+        flush_pending: dict[int, list] = {}
+
+        def pull():
+            async def pull_batch():
+                tasks = await store.pull_tasks(job_id, worker_id, timeout=0.05)
+                if not tasks:
+                    return None
+                checkpoints = {}
+                if not drop_checkpoints:
+                    checkpoints = await store.checkpoints_for(job_id, tasks)
+                elif tasks:
+                    # the crash story: retained checkpoints die with the
+                    # volatile store; pop them so recompute is honest
+                    await store.checkpoints_for(job_id, tasks)
+                return {"tile_idxs": tasks, "checkpoints": checkpoints}
+
+            return run_async_in_server_loop(pull_batch(), timeout=10)
+
+        def emit(tile_idx: int, arr) -> None:
+            flush_pending[int(tile_idx)] = [
+                {
+                    "batch_idx": i,
+                    "image": img_utils.encode_image_data_url(arr[i]),
+                }
+                for i in range(arr.shape[0])
+            ]
+            maybe_inject_premium()
+
+        def flush(is_final: bool) -> None:
+            if flush_pending:
+                grouped = dict(flush_pending)
+                flush_pending.clear()
+                accepted = run_async_in_server_loop(
+                    store.submit_flush(job_id, worker_id, grouped), timeout=10
+                )
+                tiles_by_job[job_id] = tiles_by_job.get(job_id, 0) + accepted
+            if is_final:
+                finalize()
+
+        def finalize() -> None:
+            # drain THIS job's accepted results and blend its canvas
+            # (sorted-order deferred compositing — arrival order is
+            # irrelevant), then settle the job at the store so the
+            # coordinator lifts any flags it raised
+            async def drain():
+                job = await store.get_tile_job(job_id)
+                items = []
+                while job is not None and not job.results.empty():
+                    items.append(job.results.get_nowait())
+                return items
+
+            for tile_idx, payload in run_async_in_server_loop(
+                drain(), timeout=10
+            ):
+                batch = [
+                    img_utils.decode_image_data_url(e["image"])
+                    for e in sorted(payload, key=lambda e: e["batch_idx"])
+                ]
+                y, x = grid.positions[tile_idx]
+                canvas.blend(jnp.asarray(np.stack(batch, axis=0)), y, x)
+            canvases[job_id] = np.asarray(canvas.result())
+            run_async_in_server_loop(store.cleanup_tile_job(job_id), timeout=10)
+
+        def release(idxs: list, checkpoints: dict) -> None:
+            if job_id not in preempted_jobs:
+                preempted_jobs.append(job_id)
+            run_async_in_server_loop(
+                store.release_tasks(
+                    job_id, worker_id, idxs, checkpoints=checkpoints
+                ),
+                timeout=10,
+            )
+
+        def preempt_check() -> bool:
+            async def read():
+                job = await store.get_tile_job(job_id)
+                return bool(job is not None and job.preempt_requested)
+
+            return run_async_in_server_loop(read(), timeout=10)
+
+        run_async_in_server_loop(
+            store.init_tile_job(
+                job_id, list(range(grid.num_tiles)), lane=lane,
+                tenant=str(spec.get("tenant", "default")),
+            ),
+            timeout=10,
+        )
+        return XJobHandle(
+            job_id=job_id,
+            proc=proc,
+            params=None,
+            extracted=extracted,
+            positions=positions,
+            pos=jnp.zeros((1,), jnp.float32),
+            neg=jnp.zeros((1,), jnp.float32),
+            base_key=base_key,
+            pull=pull,
+            emit=emit,
+            flush=flush,
+            release=release,
+            preempt_check=preempt_check,
+            tenant=str(spec.get("tenant", "default")),
+            lane=lane,
+            priority=list(lanes).index(lane) if lane in lanes else len(lanes),
+        )
+
+    injected = {"done": premium is None}
+
+    def inject_premium() -> None:
+        injected["done"] = True
+        spec = {
+            "job_id": premium.get("job_id", "xjob-premium"),
+            "seed": premium.get("seed", seed + 1000),
+            "tenant": premium.get("tenant", "tenant-premium"),
+            "image_hw": premium.get("image_hw", (32, 64)),
+        }
+        handle = make_handle(spec, lane=str(lanes[0]), worker_id="xworker")
+        executor.register(handle)
+
+    def maybe_inject_premium() -> None:
+        """Runs on the executor thread (from a batch job's emit): once
+        the fleet has finished `after_tiles` tiles, init + register the
+        premium job — deterministically mid-flight."""
+        if injected["done"] or "after_tiles" not in premium:
+            return
+        if executor.tiles_finished >= int(premium["after_tiles"]):
+            inject_premium()
+
+    if premium is not None and premium.get("after_dispatches"):
+        # inject at a STEP boundary (after the Nth device dispatch),
+        # while the batch jobs' tiles are mid-trajectory — the scenario
+        # that forces checkpointed eviction rather than a clean handoff
+        target = int(premium["after_dispatches"])
+        orig_step_batch = executor._step_batch
+
+        def hooked_step_batch(batch):
+            orig_step_batch(batch)
+            if not injected["done"] and executor.dispatches >= target:
+                inject_premium()
+
+        executor._step_batch = hooked_step_batch
+
+    chaos_tracer = Tracer(clock=FakeClock())
+    previous_tracer = get_tracer()
+    trace_id = f"exec_chaos_xjob_{seed}"
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        stack.enter_context(
+            mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
+        )
+        set_tracer(chaos_tracer)
+        stack.callback(set_tracer, previous_tracer)
+        token = chaos_tracer.activate(trace_id)
+        stack.callback(chaos_tracer.deactivate, token)
+        for spec in jobs:
+            executor.register(
+                make_handle(
+                    spec, lane=str(spec.get("lane", lanes[-1])),
+                    worker_id="xworker",
+                )
+            )
+        with chaos_tracer.span(
+            "chaos_xjob", trace_id=trace_id, seed=seed,
+            cross_job=cross_job,
+        ):
+            stats = executor.run()
+        if trace_jsonl:
+            chaos_tracer.write_jsonl(trace_id, trace_jsonl)
+        # leak accounting BEFORE teardown: every job must have settled
+        # with nothing pending/assigned/checkpointed
+        async def leak_check():
+            out = {}
+            async with store.lock:
+                for job_id in sorted(store.tile_jobs):
+                    job = store.tile_jobs[job_id]
+                    out[job_id] = {
+                        "pending": job.pending.qsize(),
+                        "assigned": sum(
+                            len(v) for v in job.assigned.values()
+                        ),
+                        "checkpoints": len(job.checkpoints),
+                        "completed": len(job.completed),
+                    }
+            return out
+
+        leaks = run_async_in_server_loop(leak_check(), timeout=10)
+
+    return XJobResult(
+        canvases=canvases,
+        stats=stats,
+        fill_ratio=executor.fill_ratio(),
+        completion_order=list(executor.completion_order),
+        preempted_jobs=list(preempted_jobs),
+        evictions=executor.preempt_evictions,
+        resumes_checkpoint=executor.resumes_checkpoint,
+        resumes_recompute=executor.resumes_recompute,
+        leaks=leaks,
+        tiles_by_job=dict(tiles_by_job),
+    )

@@ -94,6 +94,13 @@ class BrownoutController:
         # the stale p95 must not latch the level forever
         self._last_signal: Optional[float] = None
         self.shed_counts: dict[str, int] = {}
+        # Step-level eviction seam (CDT_PREEMPT_BROWNOUT_LEVEL): fired
+        # with (level, shed_lanes) on EVERY level change — a rise lets
+        # the preemption coordinator evict RUNNING work from shed
+        # lanes, and a drop lets it LIFT the brownout flags it raised
+        # so the evicted work resumes. Advisory; must never raise into
+        # the admission path.
+        self.preempt_hook: Optional[Callable[[int, list[str]], None]] = None
 
     # --- signal feeds -----------------------------------------------------
 
@@ -174,6 +181,11 @@ class BrownoutController:
                 f"p95 {sig['journal_p95']:.3f}s); shedding "
                 f"{self.shed_lanes() or 'nothing'}"
             )
+            if self.preempt_hook is not None:
+                try:
+                    self.preempt_hook(self.level, self.shed_lanes())
+                except Exception:  # noqa: BLE001 - eviction is advisory
+                    pass
         return self.level
 
     def shed_lanes(self) -> list[str]:

@@ -581,6 +581,37 @@ def pipeline_batches_total() -> Counter:
     )
 
 
+def batch_fill_ratio() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_batch_fill_ratio",
+        "Real tiles / bucket slots in the most recent cross-job device "
+        "dispatch (graph/batch_executor.py) per role; 1.0 = no padded "
+        "slots",
+        ("role",),
+    )
+
+
+def preempt_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_preempt_total",
+        "Step-level preemption requests raised by the scheduler "
+        "coordinator against running lower-lane jobs, by reason "
+        "(premium_arrival|brownout|manual)",
+        ("reason",),
+    )
+
+
+def preempt_resume_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_preempt_resume_total",
+        "Preempted tiles taken up again by an executor, by mode "
+        "(checkpoint = resumed from mid-trajectory latents; recompute "
+        "= checkpoint lost, replayed from step 0 — the bit-identity "
+        "reference)",
+        ("mode",),
+    )
+
+
 def pipeline_inflight() -> Gauge:
     return get_metrics_registry().gauge(
         "cdt_pipeline_inflight",

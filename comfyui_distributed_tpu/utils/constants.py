@@ -145,6 +145,33 @@ SCHED_TAIL_TILES = _env_int("CDT_SCHED_TAIL_TILES", 2)
 # from the tail (it may still pull while the queue is deep).
 SCHED_TRIM_RATIO = _env_float("CDT_SCHED_TRIM_RATIO", 0.5)
 
+# --- cross-job continuous batching + step-level preemption ----------------
+# CDT_XJOB_BATCH=1 routes the elastic master/worker loops through the
+# cross-job continuous-batching executor (graph/batch_executor.py) when
+# the job's sampler supports step-resumable execution: tiles from
+# different jobs/tenants share shape-bucketed device batches and
+# premium-lane arrivals preempt running lower-lane work at step
+# boundaries. 0 (default) keeps the per-job scan tier exactly.
+def xjob_batch_enabled() -> bool:
+    return _env_int("CDT_XJOB_BATCH", 0) == 1
+
+
+# Step-level preemption master-side: 1 (default) lets the scheduler
+# coordinator flag running lower-lane jobs for eviction when a
+# higher-lane job arrives with outstanding work; executors checkpoint
+# and release at the next step boundary. Inert while every job shares
+# one lane (legacy single-lane deployments see no behavior change).
+PREEMPT_ENABLED = _env_int("CDT_PREEMPT", 1)
+# Brownout integration: at what shed level the brownout controller
+# also EVICTS running work from shed lanes (not just rejects new
+# admissions). 0 = never (default: brownout stays admission-only).
+PREEMPT_BROWNOUT_LEVEL = _env_int("CDT_PREEMPT_BROWNOUT_LEVEL", 0)
+# Per-job byte budget for retained preemption checkpoints on the
+# master (they are volatile and never journaled); beyond it — or on
+# any malformed payload — the tile recomputes from step 0, which is
+# the bit-identity reference anyway.
+PREEMPT_CHECKPOINT_MB = _env_int("CDT_PREEMPT_CHECKPOINT_MB", 64)
+
 # --- request lifecycle armor (deadlines / cancel / poison / brownout) -----
 # Failed delivery attempts (crash/timeout requeues) a single tile may
 # accumulate before it is quarantined out of the pull set as poison —

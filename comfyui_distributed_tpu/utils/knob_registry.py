@@ -152,6 +152,23 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_SCHED_TRIM_RATIO", "0.5", "scheduler",
          "Workers slower than this fraction of fleet mean speed are trimmed "
          "from the job tail."),
+    # --- cross-job batching + step-level preemption ----------------------
+    Knob("CDT_PREEMPT", "1", "scheduler",
+         "Step-level preemption: a premium-lane arrival flags running "
+         "lower-lane jobs for step-boundary eviction (checkpoint + requeue). "
+         "Inert while every job shares one lane; `0` disables entirely."),
+    Knob("CDT_PREEMPT_BROWNOUT_LEVEL", "0", "scheduler",
+         "Brownout shed level at/above which running work in shed lanes is "
+         "also EVICTED (not just refused admission); `0` keeps brownout "
+         "admission-only."),
+    Knob("CDT_PREEMPT_CHECKPOINT_MB", "64", "scheduler",
+         "Per-job byte budget for volatile preemption checkpoints retained "
+         "on the master; beyond it evicted tiles recompute from step 0."),
+    Knob("CDT_XJOB_BATCH", "0", "scheduler",
+         "`1` routes elastic master/worker loops through the cross-job "
+         "continuous-batching executor (tiles from different jobs/tenants "
+         "share shape-bucketed device batches; step-resumable samplers "
+         "only)."),
     # --- tile pipeline ---------------------------------------------------
     Knob("CDT_PIPELINE", "1", "pipeline",
          "`0` replaces the staged tile pipeline with the serial per-tile loop."),

@@ -192,6 +192,45 @@ def pipeline_overlap_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None
     }
 
 
+def batch_fill_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Cross-job continuous-batching fill ratio from the executor's
+    per-dispatch spans (graph/batch_executor.py emits one
+    ``tile.dispatch`` span per device dispatch with ``real`` tiles vs
+    padded ``bucket`` slots). 1.0 = every device slot ran a real tile;
+    lower means slots burned on wraparound padding — the utilization
+    the cross-job tier exists to recover. None when no dispatch spans
+    are present (the scan tier emits none)."""
+    real = 0
+    slots = 0
+    dispatches = 0
+    cross_job_dispatches = 0
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        if attrs.get("stage") != "dispatch":
+            continue
+        try:
+            r = int(attrs.get("real", 0))
+            b = int(attrs.get("bucket", 0))
+        except (TypeError, ValueError):
+            continue
+        if b <= 0:
+            continue
+        dispatches += 1
+        real += r
+        slots += b
+        if int(attrs.get("jobs", 1) or 1) > 1:
+            cross_job_dispatches += 1
+    if dispatches == 0:
+        return None
+    return {
+        "dispatches": dispatches,
+        "cross_job_dispatches": cross_job_dispatches,
+        "real_tiles": real,
+        "slots": slots,
+        "fill": (real / slots) if slots > 0 else 0.0,
+    }
+
+
 def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
     """Aggregate span durations per name → latency stats."""
     by_name: dict[str, list[float]] = {}
@@ -220,6 +259,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "stages": stages,
         "queue_wait": queue_wait_stats(spans),
         "pipeline_overlap": pipeline_overlap_stats(spans),
+        "batch_fill": batch_fill_stats(spans),
     }
 
 
@@ -330,6 +370,22 @@ def compare_reports(
                     "delta_pct": drop_pct,
                 }
             )
+    # batch fill gates inverted too: a DROP in the cross-job fill
+    # ratio means device slots went back to running wraparound padding
+    # instead of other jobs' real tiles.
+    old_bf = old_report.get("batch_fill")
+    new_bf = new_report.get("batch_fill")
+    if old_bf and new_bf and old_bf["fill"] > 0:
+        drop_pct = (1.0 - new_bf["fill"] / old_bf["fill"]) * 100.0
+        if drop_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": "batch_fill",
+                    "old_p95": old_bf["fill"],
+                    "new_p95": new_bf["fill"],
+                    "delta_pct": drop_pct,
+                }
+            )
     return regressions
 
 
@@ -343,6 +399,12 @@ def render_comparison(
         if item["stage"] == "pipeline_overlap":
             lines.append(
                 f"  {item['stage']:28} overlap {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
+        if item["stage"] == "batch_fill":
+            lines.append(
+                f"  {item['stage']:28} fill {item['old_p95']:.3f} -> "
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
             )
             continue
@@ -520,6 +582,16 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"submit): {overlap['overlapped']:.4f}s of "
             f"{overlap['sample_wall']:.4f}s "
             f"(fraction {overlap['fraction']:.3f})"
+        )
+    fill = report.get("batch_fill")
+    if fill:
+        lines.append("")
+        lines.append(
+            "batch fill (real tiles / device slots across "
+            f"{fill['dispatches']} dispatch(es), "
+            f"{fill['cross_job_dispatches']} cross-job): "
+            f"{fill['real_tiles']}/{fill['slots']} "
+            f"(fill {fill['fill']:.3f})"
         )
     if tiles:
         lines.append("")

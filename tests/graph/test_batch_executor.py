@@ -1,0 +1,528 @@
+"""CrossJobExecutor unit suite (graph/batch_executor.py): mixed-batch
+determinism (jitted + eager), fill accounting, signature separation,
+priority ordering, step-boundary preemption with checkpoint/recompute
+resume, and per-job error isolation."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.graph.batch_executor import (
+    CrossJobExecutor,
+    XJobHandle,
+)
+from comfyui_distributed_tpu.ops.stepwise import encode_checkpoint
+from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+
+N_STEPS = 3
+
+
+def _make_proc(n_steps=N_STEPS, signature=("stub",), jit=False):
+    def init(params, tile, key):
+        return tile + 0.0
+
+    def step(params, x, key, pos, neg, yx, i):
+        ki = jax.random.fold_in(key, i)
+        return x + 0.01 * jax.random.normal(ki, x.shape) + 0.001 * pos
+
+    def finish(params, x):
+        return jnp.round(jnp.clip(x, 0.0, 1.0) * 255.0) / 255.0
+
+    return types.SimpleNamespace(
+        init=init,
+        step=jax.jit(step) if jit else step,
+        finish=finish,
+        n_steps=n_steps,
+        signature=tuple(signature),
+    )
+
+
+class _FakeMaster:
+    """Store stand-in for one job: pending queue + checkpoint buffer
+    with the release/pull contract of the real JobStore."""
+
+    def __init__(self, n_tiles, grant_size=64):
+        self.pending = list(range(n_tiles))
+        self.ckpts = {}
+        self.grant_size = grant_size
+        self.released = []  # (idxs, checkpoints) calls, in order
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            if not self.pending:
+                return None
+            grant = self.pending[: self.grant_size]
+            self.pending = self.pending[self.grant_size:]
+            cks = {t: self.ckpts.pop(t) for t in list(self.ckpts) if t in grant}
+            return {"tile_idxs": grant, "checkpoints": cks}
+
+    def release(self, idxs, cks):
+        with self.lock:
+            self.released.append((list(idxs), dict(cks)))
+            self.pending = sorted(set(self.pending) | set(idxs))
+            self.ckpts.update(cks)
+
+
+def _make_job(
+    job_id, n_tiles, seed, *, proc, master=None, priority=0, flag=None,
+    emit_hook=None,
+):
+    master = master or _FakeMaster(n_tiles)
+    rng = np.random.default_rng(seed)
+    extracted = jnp.asarray(rng.random((n_tiles, 4, 4, 3)), jnp.float32)
+    positions = jnp.zeros((n_tiles, 2), jnp.int32)
+    outs = {}
+
+    def emit(idx, arr):
+        outs[int(idx)] = np.asarray(arr)
+        if emit_hook is not None:
+            emit_hook(int(idx))
+
+    handle = XJobHandle(
+        job_id=job_id,
+        proc=proc,
+        params=None,
+        extracted=extracted,
+        positions=positions,
+        pos=jnp.float32(seed),
+        neg=jnp.float32(0),
+        base_key=fold_job_key(jax.random.key(seed), job_id),
+        pull=master.pull,
+        emit=emit,
+        flush=lambda final: None,
+        release=master.release,
+        preempt_check=(lambda: flag.is_set()) if flag is not None else None,
+        priority=priority,
+    )
+    return handle, outs, master
+
+
+def _solo(job_id, n_tiles, seed, *, proc, k_max=8):
+    ex = CrossJobExecutor(k_max=k_max)
+    handle, outs, _ = _make_job(job_id, n_tiles, seed, proc=proc)
+    ex.register(handle)
+    ex.run()
+    return outs
+
+
+# --------------------------------------------------------------------------
+# determinism
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("jit", [False, True], ids=["eager", "jitted"])
+def test_mixed_batch_bit_identical_to_solo(jit):
+    """A tile's output is bit-identical whether sampled alone, batched
+    with its own job, or batched with another tenant's tiles."""
+    proc = _make_proc(jit=jit)
+    ex = CrossJobExecutor(k_max=4)
+    h1, o1, _ = _make_job("job-a", 3, 1, proc=proc)
+    h2, o2, _ = _make_job("job-b", 3, 2, proc=proc)
+    ex.register(h1)
+    ex.register(h2)
+    ex.run()
+    solo_a = _solo("job-a", 3, 1, proc=proc)
+    solo_b = _solo("job-b", 3, 2, proc=proc)
+    for i in range(3):
+        np.testing.assert_array_equal(o1[i], solo_a[i])
+        np.testing.assert_array_equal(o2[i], solo_b[i])
+
+
+def test_same_seed_jobs_diverge_by_job_id():
+    """Two jobs sharing the user seed draw INDEPENDENT streams: the
+    fold key gains the job id (parallel/seeds.fold_job_key)."""
+    proc = _make_proc()
+    a = _solo("job-a", 2, 7, proc=proc)
+    b = _solo("job-b", 2, 7, proc=proc)
+    assert not np.array_equal(a[0], b[0])
+
+
+# --------------------------------------------------------------------------
+# batching / fill accounting
+# --------------------------------------------------------------------------
+
+
+def test_fill_ratio_accounting_cross_vs_per_job():
+    """Two 3-tile jobs, k_max=4: per-job batches pad every dispatch
+    3 → 4 (fill 0.75); cross-job batches keep the 4-slot device full
+    from the combined ready queue (fill 1.0)."""
+    proc = _make_proc()
+    mixed = CrossJobExecutor(k_max=4)
+    for jid, seed in (("a", 1), ("b", 2)):
+        mixed.register(_make_job(jid, 3, seed, proc=proc)[0])
+    stats = mixed.run()
+    assert stats["tiles"] == 6
+    assert stats["slots_real"] == 6 * N_STEPS
+
+    perjob = CrossJobExecutor(k_max=4, cross_job=False)
+    for jid, seed in (("a", 1), ("b", 2)):
+        perjob.register(_make_job(jid, 3, seed, proc=proc)[0])
+    stats_pj = perjob.run()
+    assert stats_pj["tiles"] == 6
+    assert mixed.fill_ratio() > perjob.fill_ratio()
+    assert perjob.fill_ratio() == pytest.approx(0.75)
+    assert mixed.fill_ratio() == pytest.approx(1.0)
+
+
+def test_bucket_multiple_rounds_buckets():
+    ex = CrossJobExecutor(k_max=8, bucket_multiple=4)
+    assert ex.buckets == (4, 8)
+    assert ex._bucket_for(1) == 4
+    assert ex._bucket_for(5) == 8
+
+
+def test_signatures_never_mix_in_one_dispatch():
+    proc_a = _make_proc(signature=("sig-a",))
+    proc_b = _make_proc(signature=("sig-b",))
+    ex = CrossJobExecutor(k_max=8)
+    ex.register(_make_job("a", 2, 1, proc=proc_a)[0])
+    ex.register(_make_job("b", 2, 2, proc=proc_b)[0])
+    seen = []
+    orig = ex._step_batch
+
+    def spy(batch):
+        seen.append({it.job.proc.signature for it in batch})
+        orig(batch)
+
+    ex._step_batch = spy
+    stats = ex.run()
+    assert stats["tiles"] == 4
+    assert seen and all(len(sigs) == 1 for sigs in seen)
+
+
+# --------------------------------------------------------------------------
+# priority + preemption
+# --------------------------------------------------------------------------
+
+
+def test_priority_orders_completions():
+    proc = _make_proc()
+    ex = CrossJobExecutor(k_max=2)
+    ex.register(_make_job("low", 2, 1, proc=proc, priority=5)[0])
+    ex.register(_make_job("high", 2, 2, proc=proc, priority=0)[0])
+    ex.run()
+    order = [jid for jid, _ in ex.completion_order]
+    assert order[:2] == ["high", "high"]
+
+
+def test_preempt_evicts_checkpoints_and_resumes_bit_identical():
+    proc = _make_proc(n_steps=5)
+    flag = threading.Event()
+    master = _FakeMaster(6)
+    ex = CrossJobExecutor(k_max=8)
+    handle, outs, _ = _make_job(
+        "batch", 6, 3, proc=proc, master=master, priority=10, flag=flag
+    )
+    ex.register(handle)
+    count = {"n": 0}
+    orig = ex._step_batch
+
+    def hooked(batch):
+        orig(batch)
+        count["n"] += 1
+        if count["n"] == 2:
+            hp, op, _ = _make_job("prem", 2, 4, proc=proc, priority=0)
+            hooked.prem = op
+
+            def clear_when_prem_done(idx, _op=op):
+                if len(_op) >= 2:
+                    flag.clear()
+
+            hp.emit = _wrap_emit(hp.emit, op, flag)
+            ex.register(hp)
+            flag.set()
+
+    def _wrap_emit(emit, op, flag):
+        def wrapped(idx, arr):
+            emit(idx, arr)
+            if len(op) >= 2:
+                flag.clear()
+        return wrapped
+
+    ex._step_batch = hooked
+    stats = ex.run()
+    assert stats["preempt_evictions"] == 6
+    assert stats["resumes_checkpoint"] == 6
+    assert stats["resumes_recompute"] == 0
+    # the release carried mid-trajectory checkpoints through the
+    # release seam (the real return_tiles path in production)
+    assert master.released and all(
+        cks for _, cks in master.released[:1]
+    )
+    # premium completed before any remaining batch tile
+    order = [jid for jid, _ in ex.completion_order]
+    first_prem = order.index("prem")
+    assert "batch" not in order[first_prem : first_prem + 2]
+    # outputs bit-identical to solo runs despite evict/resume
+    solo_b = _solo("batch", 6, 3, proc=_make_proc(n_steps=5))
+    for i in range(6):
+        np.testing.assert_array_equal(outs[i], solo_b[i])
+
+
+def test_lost_checkpoint_recomputes_from_zero_bit_identical():
+    proc = _make_proc(n_steps=5)
+    flag = threading.Event()
+
+    class _AmnesiacMaster(_FakeMaster):
+        def release(self, idxs, cks):
+            super().release(idxs, {})  # the crash: checkpoints die
+            flag.clear()  # preemption pressure lifts post-eviction
+
+    master = _AmnesiacMaster(4)
+    ex = CrossJobExecutor(k_max=8)
+    handle, outs, _ = _make_job(
+        "batch", 4, 3, proc=proc, master=master, flag=flag
+    )
+    ex.register(handle)
+    count = {"n": 0}
+    orig = ex._step_batch
+
+    def hooked(batch):
+        orig(batch)
+        count["n"] += 1
+        if count["n"] == 2:
+            flag.set()
+
+    ex._step_batch = hooked
+    stats = ex.run()
+    assert stats["preempt_evictions"] == 4
+    assert stats["resumes_recompute"] == 4
+    assert stats["resumes_checkpoint"] == 0
+    solo = _solo("batch", 4, 3, proc=_make_proc(n_steps=5))
+    for i in range(4):
+        np.testing.assert_array_equal(outs[i], solo[i])
+
+
+def test_malformed_checkpoint_drops_to_recompute():
+    proc = _make_proc(n_steps=4)
+    master = _FakeMaster(2, grant_size=2)
+    master.ckpts = {
+        0: {"v": 1, "step": 2, "dtype": "float32", "shape": [1], "data": "x"},
+        1: encode_checkpoint(np.zeros((1, 4, 4, 3), np.float32), 99),  # >= n
+    }
+    ex = CrossJobExecutor(k_max=4)
+    handle, outs, _ = _make_job("j", 2, 5, proc=proc, master=master)
+    ex.register(handle)
+    stats = ex.run()
+    assert stats["tiles"] == 2
+    solo = _solo("j", 2, 5, proc=_make_proc(n_steps=4))
+    for i in range(2):
+        np.testing.assert_array_equal(outs[i], solo[i])
+
+
+# --------------------------------------------------------------------------
+# error isolation
+# --------------------------------------------------------------------------
+
+
+def test_one_jobs_failure_releases_and_spares_others():
+    proc = _make_proc()
+    master_bad = _FakeMaster(2)
+    ex = CrossJobExecutor(k_max=8)
+    bad, _, _ = _make_job("bad", 2, 1, proc=proc, master=master_bad)
+
+    def boom(idx, arr):
+        raise RuntimeError("emit exploded")
+
+    bad.emit = boom
+    good, good_outs, _ = _make_job("good", 2, 2, proc=proc)
+    ex.register(bad)
+    ex.register(good)
+    # per-job isolation: the failure lands on the BAD handle (its
+    # blocking owner re-raises it); the shared driver keeps serving
+    # the other jobs and run() completes
+    ex.run()
+    assert isinstance(bad.error, RuntimeError) and bad.finished.is_set()
+    assert good.done and len(good_outs) == 2
+    # the failed job's claims went back through the release seam
+    assert master_bad.released
+
+
+# --------------------------------------------------------------------------
+# production entries (CDT_XJOB_BATCH wiring)
+# --------------------------------------------------------------------------
+
+
+def test_run_master_xjob_end_to_end_with_stub(monkeypatch):
+    """The delegated master entry drives the shared executor against a
+    real JobStore and blends a complete canvas (stub processor)."""
+    from unittest import mock
+
+    from comfyui_distributed_tpu.graph import ExecutionContext
+    from comfyui_distributed_tpu.graph import batch_executor as bx
+    from comfyui_distributed_tpu.graph import usdu_elastic as elastic
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.resilience.chaos import (
+        _ensure_server_loop,
+        _stub_stepwise,
+    )
+
+    bx._reset_shared_executor_for_tests()
+    monkeypatch.setenv("CDT_XJOB_BATCH", "1")
+    monkeypatch.setenv("CDT_DETERMINISTIC_BLEND", "1")
+    store = JobStore()
+    ctx = ExecutionContext(
+        server=types.SimpleNamespace(job_store=store), config={"workers": []}
+    )
+    bundle = types.SimpleNamespace(params=None)
+    image = jnp.asarray(
+        np.random.default_rng(0).random((1, 32, 96, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    with _ensure_server_loop(), mock.patch(
+        "comfyui_distributed_tpu.ops.stepwise.make_stepwise_tile_processor",
+        lambda *a, **k: _stub_stepwise(2),
+    ), mock.patch.object(
+        elastic.config_mod if hasattr(elastic, "config_mod") else __import__(
+            "comfyui_distributed_tpu.utils.config", fromlist=["x"]
+        ),
+        "get_worker_timeout_seconds",
+        lambda path=None: 1.0,
+    ):
+        # the delegation seam: run_master_elastic routes to the xjob
+        # entry under the knob + a stepwise-capable sampler
+        out = elastic.run_master_elastic(
+            bundle, image, pos, neg,
+            job_id="xjob-e2e",
+            enabled_worker_ids=[],
+            upscale_by=2.0, tile=64, padding=16,
+            steps=2, sampler="euler", scheduler="karras",
+            cfg=1.0, denoise=0.3, seed=0, context=ctx,
+        )
+    out = np.asarray(out)
+    assert out.shape == (1, 64, 192, 3)
+    # the job settled cleanly at the store
+    assert store.tile_jobs == {}
+    bx._reset_shared_executor_for_tests()
+
+
+def test_preempt_learned_from_drained_pull_parks_instead_of_finishing():
+    """HTTP clients learn the preempt flag from the SAME response that
+    reads as drained: the executor must park the job (and resume it
+    when the flag lifts), never final-flush it as complete."""
+    proc = _make_proc(n_steps=2)
+    flag = threading.Event()
+    state = {"phase": "preempted", "beats": 0}
+    outs = {}
+
+    def pull():
+        # while preempted the master answers drained + preempt (the
+        # tiles were evicted); once lifted, the tiles come back
+        if state["phase"] == "preempted":
+            flag.set()  # the client learned preempt from this response
+            return None
+        if state["phase"] == "resumed":
+            state["phase"] = "drained"
+            return {"tile_idxs": [0, 1]}
+        return None
+
+    def heartbeat():
+        # the production side-channel: a parked worker keeps beating,
+        # and the flag lifts from a heartbeat response
+        state["beats"] += 1
+        if state["phase"] == "preempted" and state["beats"] >= 2:
+            state["phase"] = "resumed"
+            flag.clear()
+
+    rng = np.random.default_rng(9)
+    handle = XJobHandle(
+        job_id="parked",
+        proc=proc,
+        params=None,
+        extracted=jnp.asarray(rng.random((2, 4, 4, 3)), jnp.float32),
+        positions=jnp.zeros((2, 2), jnp.int32),
+        pos=jnp.float32(0),
+        neg=jnp.float32(0),
+        base_key=fold_job_key(jax.random.key(9), "parked"),
+        pull=pull,
+        emit=lambda i, a: outs.__setitem__(int(i), np.asarray(a)),
+        flush=lambda final: None,
+        heartbeat=heartbeat,
+        preempt_check=flag.is_set,
+    )
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        # each read advances 0.6s so the 1s heartbeat pacing fires
+        # within a few idle rounds instead of real seconds
+        clock["t"] += 0.6
+        return clock["t"]
+
+    ex = CrossJobExecutor(k_max=4, idle_poll_seconds=0.001, clock=fake_clock)
+    ex.register(handle)
+    stats = ex.run()
+    # the job was NOT finished during the preempt window: it parked,
+    # resumed when the flag lifted, and completed its tiles
+    assert stats["tiles"] == 2
+    assert sorted(outs) == [0, 1]
+    assert handle.done and handle.error is None
+
+
+def test_run_master_xjob_reenters_after_worker_timeout_requeue(monkeypatch):
+    """A worker claims tiles and dies: the requeue lands AFTER the
+    master's executor view drained. The master must re-enter the
+    executor and finish the tiles locally (the run_master_elastic
+    fault-tolerance contract) instead of deadline-breaking with an
+    incomplete canvas."""
+    from unittest import mock
+
+    from comfyui_distributed_tpu.graph import ExecutionContext
+    from comfyui_distributed_tpu.graph import batch_executor as bx
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.resilience.chaos import (
+        _ensure_server_loop,
+        _stub_stepwise,
+    )
+    from comfyui_distributed_tpu.utils import config as config_mod
+
+    bx._reset_shared_executor_for_tests()
+    monkeypatch.setenv("CDT_XJOB_BATCH", "1")
+    monkeypatch.setenv("CDT_DETERMINISTIC_BLEND", "1")
+    store = JobStore()
+    real_pull_tasks = store.pull_tasks
+    state = {"stolen": False}
+
+    async def stealing_pull_tasks(job_id, worker_id, *args, **kwargs):
+        if worker_id == "master" and not state["stolen"]:
+            # the dying worker wins the first grant and never submits
+            state["stolen"] = True
+            await store.pull_task(job_id, "ghost", timeout=0.1)
+            await store.pull_task(job_id, "ghost", timeout=0.1)
+            return []
+        return await real_pull_tasks(job_id, worker_id, *args, **kwargs)
+
+    store.pull_tasks = stealing_pull_tasks
+    ctx = ExecutionContext(
+        server=types.SimpleNamespace(job_store=store), config={"workers": []}
+    )
+    bundle = types.SimpleNamespace(params=None)
+    image = jnp.asarray(
+        np.random.default_rng(0).random((1, 32, 96, 3)), jnp.float32
+    )
+    pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+    from comfyui_distributed_tpu.graph import usdu_elastic as elastic
+
+    with _ensure_server_loop(), mock.patch(
+        "comfyui_distributed_tpu.ops.stepwise.make_stepwise_tile_processor",
+        lambda *a, **k: _stub_stepwise(2),
+    ), mock.patch.object(
+        config_mod, "get_worker_timeout_seconds", lambda path=None: 0.5
+    ):
+        out = elastic.run_master_elastic(
+            bundle, image, pos, neg,
+            job_id="xjob-requeue",
+            enabled_worker_ids=["ghost"],
+            upscale_by=2.0, tile=64, padding=16,
+            steps=2, sampler="euler", scheduler="karras",
+            cfg=1.0, denoise=0.3, seed=0, context=ctx,
+        )
+    out = np.asarray(out)
+    assert out.shape == (1, 64, 192, 3)
+    assert store.tile_jobs == {}  # settled, nothing leaked
+    bx._reset_shared_executor_for_tests()

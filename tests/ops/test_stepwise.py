@@ -1,0 +1,140 @@
+"""Step-resumable sampler seam (ops/stepwise.py): split-run ≡ full-run
+bit-identity, the per-step math vs the scan tier, and the checkpoint
+codec's byte-exactness + rejection surface."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.ops import samplers as smp
+from comfyui_distributed_tpu.ops.stepwise import (
+    MAX_CHECKPOINT_BYTES,
+    CheckpointError,
+    checkpoint_nbytes,
+    decode_checkpoint,
+    encode_checkpoint,
+    euler_ancestral_step,
+    euler_step,
+    stepwise_supported,
+)
+
+
+def _toy_model_fn(x, sigma_batch, cond):
+    # eps model: a fixed contraction so trajectories are non-trivial
+    return 0.3 * x + 0.01
+
+
+# --------------------------------------------------------------------------
+# support gate
+# --------------------------------------------------------------------------
+
+
+def test_supported_samplers_gate():
+    assert stepwise_supported("euler")
+    assert stepwise_supported("ddim")
+    assert stepwise_supported("euler_ancestral")
+    # history-carrying / second-order samplers stay on the scan tier
+    for sampler in ("heun", "dpm_2", "lms", "dpmpp_2m", "dpmpp_sde", "lcm"):
+        assert not stepwise_supported(sampler)
+    # RF models reject VE renoising
+    assert not stepwise_supported("euler_ancestral", flow=True)
+    assert stepwise_supported("euler", flow=True)
+
+
+# --------------------------------------------------------------------------
+# per-step math ≡ the scan tier's step body
+# --------------------------------------------------------------------------
+
+
+def test_euler_steps_match_scan_sampler():
+    """Same math as the scan tier — allclose, not bit-equal: lax.scan
+    always lowers through XLA whose fusion perturbs last ulps vs the
+    eager per-step loop (the documented jit-vs-eager hazard; the xjob
+    tier's bit-identity contract is against its OWN solo runs, which
+    tests below and the chaos suite pin exactly)."""
+    sigmas = smp.get_sigmas("karras", 6)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 4, 4, 3)), jnp.float32
+    )
+    x = x * sigmas[0]
+    scan_out = smp.sample(_toy_model_fn, x, sigmas, None, sampler="euler")
+    stepwise = x
+    for i in range(int(sigmas.shape[0]) - 1):
+        stepwise = euler_step(
+            _toy_model_fn, stepwise, sigmas[i], sigmas[i + 1], None
+        )
+    np.testing.assert_allclose(
+        np.asarray(scan_out), np.asarray(stepwise), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_split_run_resume_is_bit_identical():
+    """Steps [0,k) then — through a host checkpoint round-trip —
+    [k,n) must equal the uninterrupted [0,n) run exactly."""
+    sigmas = smp.get_sigmas("karras", 8)
+    key = jax.random.key(42)
+    x0 = jax.random.normal(key, (1, 4, 4, 3)) * sigmas[0]
+
+    def run(x, start, stop):
+        for i in range(start, stop):
+            step_key = jax.random.fold_in(key, i)
+            x = euler_ancestral_step(
+                _toy_model_fn, x, sigmas[i], sigmas[i + 1], None, step_key
+            )
+        return x
+
+    n = int(sigmas.shape[0]) - 1
+    full = run(x0, 0, n)
+    for k in (1, 3, n - 1):
+        part = run(x0, 0, k)
+        state, step = decode_checkpoint(encode_checkpoint(part, k))
+        assert step == k
+        resumed = run(jnp.asarray(state), k, n)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(resumed))
+
+
+# --------------------------------------------------------------------------
+# checkpoint codec
+# --------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_is_byte_exact():
+    arr = np.random.default_rng(1).normal(size=(2, 8, 8, 4)).astype(np.float32)
+    payload = encode_checkpoint(arr, 5)
+    out, step = decode_checkpoint(payload)
+    assert step == 5
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    assert out.tobytes() == arr.tobytes()
+    # size estimate within b64 rounding of the truth
+    assert abs(checkpoint_nbytes(payload) - arr.nbytes) <= 3
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda p: p.update(v=99),
+        lambda p: p.update(step=-1),
+        lambda p: p.update(data="!!!not-base64!!!"),
+        lambda p: p.update(shape=[3, 3]),  # byte count mismatch
+        lambda p: p.update(dtype="no-such-dtype"),
+        lambda p: p.pop("data"),
+    ],
+)
+def test_checkpoint_rejects_malformed(mutate):
+    payload = encode_checkpoint(np.zeros((2, 2), np.float32), 1)
+    mutate(payload)
+    with pytest.raises(CheckpointError):
+        decode_checkpoint(payload)
+
+
+def test_checkpoint_rejects_non_dict_and_oversize():
+    with pytest.raises(CheckpointError):
+        decode_checkpoint("nope")
+    with pytest.raises(CheckpointError):
+        encode_checkpoint(
+            np.zeros(MAX_CHECKPOINT_BYTES // 4 + 16, np.float32), 0
+        )
+    assert checkpoint_nbytes(None) == 0
+    assert checkpoint_nbytes({"data": 17}) == 0

@@ -1,0 +1,187 @@
+"""PreemptionCoordinator (scheduler/preempt.py): lane-ranked victim
+selection on premium arrival, settle-time flag lifting with overlapping
+claims, the rank-limit band, brownout eviction gating, and the disabled
+path."""
+
+import asyncio
+
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.scheduler.preempt import (
+    UNRANKED,
+    PreemptionCoordinator,
+)
+
+LANES = ["premium", "interactive", "batch"]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _wired(enabled=True, rank_limit=1):
+    store = JobStore()
+    coord = PreemptionCoordinator(
+        LANES, store, enabled=enabled, preempt_rank_limit=rank_limit
+    )
+    store.preempt_policy = coord
+    return store, coord
+
+
+def test_lane_rank_orders_declared_lanes_unknown_last():
+    _, coord = _wired()
+    assert coord.lane_rank("premium") == 0
+    assert coord.lane_rank("batch") == 2
+    assert coord.lane_rank("") == UNRANKED
+    assert coord.lane_rank("typo") == UNRANKED
+
+
+def test_premium_arrival_flags_lower_lanes_only():
+    async def body():
+        store, coord = _wired()
+        await store.init_tile_job("jb", [0, 1], lane="batch")
+        await store.init_tile_job("ji", [0], lane="interactive")
+        await store.init_tile_job("jp", [0], lane="premium")
+        jb = await store.get_tile_job("jb")
+        ji = await store.get_tile_job("ji")
+        jp = await store.get_tile_job("jp")
+        assert jb.preempt_requested and ji.preempt_requested
+        assert not jp.preempt_requested
+        assert jb.preempt_reason == "premium_arrival"
+
+    run(body())
+
+
+def test_mid_tier_arrival_does_not_preempt_by_default():
+    async def body():
+        store, coord = _wired()  # rank_limit=1: only the TOP lane preempts
+        await store.init_tile_job("jb", [0], lane="batch")
+        await store.init_tile_job("ji", [0], lane="interactive")
+        jb = await store.get_tile_job("jb")
+        assert not jb.preempt_requested
+
+    run(body())
+
+
+def test_rank_limit_widens_the_preempting_band():
+    async def body():
+        store, coord = _wired(rank_limit=2)
+        await store.init_tile_job("jb", [0], lane="batch")
+        await store.init_tile_job("ji", [0], lane="interactive")
+        jb = await store.get_tile_job("jb")
+        assert jb.preempt_requested
+
+    run(body())
+
+
+def test_settle_lifts_flags_when_no_other_premium_claims():
+    async def body():
+        store, coord = _wired()
+        await store.init_tile_job("jb", [0, 1], lane="batch")
+        await store.init_tile_job("jp1", [0], lane="premium")
+        jb = await store.get_tile_job("jb")
+        assert jb.preempt_requested
+        await store.cleanup_tile_job("jp1")
+        assert not jb.preempt_requested
+
+    run(body())
+
+
+def test_cancel_of_premium_lifts_flags():
+    async def body():
+        store, coord = _wired()
+        await store.init_tile_job("jb", [0], lane="batch")
+        await store.init_tile_job("jp", [0], lane="premium")
+        jb = await store.get_tile_job("jb")
+        assert jb.preempt_requested
+        await store.cancel_job("jp", reason="client")
+        assert not jb.preempt_requested
+
+    run(body())
+
+
+def test_disabled_coordinator_is_inert():
+    async def body():
+        store, coord = _wired(enabled=False)
+        await store.init_tile_job("jb", [0], lane="batch")
+        await store.init_tile_job("jp", [0], lane="premium")
+        jb = await store.get_tile_job("jb")
+        assert not jb.preempt_requested
+
+    run(body())
+
+
+def test_brownout_eviction_respects_level_knob(monkeypatch):
+    from comfyui_distributed_tpu.utils import constants
+
+    async def body():
+        store, coord = _wired()
+        await store.init_tile_job("jb", [0], lane="batch")
+        # knob 0 (default): brownout stays admission-only
+        monkeypatch.setattr(constants, "PREEMPT_BROWNOUT_LEVEL", 0)
+        assert await coord.on_brownout(2, ["batch"]) == []
+        # at/above the threshold: running shed-lane work is evicted
+        monkeypatch.setattr(constants, "PREEMPT_BROWNOUT_LEVEL", 2)
+        assert await coord.on_brownout(1, ["batch"]) == []
+        flagged = await coord.on_brownout(2, ["batch"])
+        assert flagged == ["jb"]
+        jb = await store.get_tile_job("jb")
+        assert jb.preempt_reason == "brownout"
+        # de-escalation LIFTS the brownout flags (the regression: a
+        # brownout flag must never outlive the brownout)
+        assert await coord.on_brownout(1, []) == []
+        assert not jb.preempt_requested and jb.preempt_reason == ""
+        # a premium_arrival flag is NOT brownout's to lift
+        await store.request_preemption(["jb"], reason="premium_arrival")
+        await coord.on_brownout(0, [])
+        assert jb.preempt_requested
+
+    run(body())
+
+
+def test_brownout_hook_fires_on_level_raise():
+    from comfyui_distributed_tpu.scheduler.brownout import BrownoutController
+
+    clock = {"t": 0.0}
+    controller = BrownoutController(
+        LANES, wait_p95_threshold=1.0, journal_p95_threshold=1.0,
+        window=4, cooldown=5.0, clock=lambda: clock["t"],
+    )
+    calls = []
+    controller.preempt_hook = lambda level, lanes: calls.append(
+        (level, list(lanes))
+    )
+    for _ in range(4):
+        controller.note_queue_wait(5.0)
+    clock["t"] = 6.0
+    controller.evaluate()
+    assert calls == [(1, ["batch"])]
+
+    def boom(level, lanes):
+        raise RuntimeError("hook exploded")
+
+    # a raising hook never breaks the admission path
+    controller.preempt_hook = boom
+    for _ in range(4):
+        controller.note_queue_wait(5.0)
+    clock["t"] = 12.0
+    assert controller.evaluate() == 2
+
+
+def test_overlapping_premiums_keep_victims_flagged():
+    """P1 flags the batch job; P2 arrives while it is still flagged
+    (claiming it even though nothing NEW flags); P1's settle must NOT
+    lift the flag while P2 is outstanding — only P2's settle does."""
+
+    async def body():
+        store, coord = _wired()
+        await store.init_tile_job("jb", [0, 1], lane="batch")
+        await store.init_tile_job("jp1", [0], lane="premium")
+        jb = await store.get_tile_job("jb")
+        assert jb.preempt_requested
+        await store.init_tile_job("jp2", [0], lane="premium")
+        await store.cleanup_tile_job("jp1")
+        assert jb.preempt_requested  # P2 still claims jb
+        await store.cleanup_tile_job("jp2")
+        assert not jb.preempt_requested
+
+    run(body())

@@ -1,0 +1,160 @@
+"""Chaos acceptance for cross-job continuous batching + step-level
+preemption (resilience/chaos.run_chaos_xjob):
+
+- a fleet of many small concurrent jobs achieves a STRICTLY higher
+  batch-fill ratio under cross-job batching than per-job batching,
+  with every canvas bit-identical to its solo-run baseline;
+- a premium-lane job admitted mid-flight preempts a running batch-lane
+  grant at a step boundary (its first tile completes before the batch
+  job's remaining tiles), with both canvases bit-identical and zero
+  capacity leaks across preempt/requeue/resume;
+- preempt → checkpoint-loss (worker crash / master restart) →
+  recompute-from-0 is bit-identical to both.
+"""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.resilience.chaos import run_chaos_xjob
+
+pytestmark = pytest.mark.chaos
+
+FLEET = [
+    {
+        "job_id": f"xjob-{i}",
+        "seed": 3 + i,
+        "tenant": "tenant-a" if i % 2 == 0 else "tenant-b",
+        "lane": "batch",
+        "image_hw": (32, 96),  # 3 tiles each: ragged vs the pow2 buckets
+    }
+    for i in range(4)
+]
+
+BATCH_SPEC = {
+    "job_id": "xjob-batch", "seed": 7, "tenant": "tenant-a",
+    "lane": "batch", "image_hw": (32, 160),  # 5 tiles
+}
+PREMIUM = {
+    "job_id": "xjob-prem", "seed": 99, "tenant": "tenant-b",
+    "image_hw": (32, 64), "after_dispatches": 2,
+}
+
+
+def _solo(spec, **kwargs):
+    return run_chaos_xjob(seed=0, jobs=[dict(spec)], **kwargs)
+
+
+# --------------------------------------------------------------------------
+# mixed small jobs: fill-ratio win + cross-tenant determinism
+# --------------------------------------------------------------------------
+
+
+def test_cross_job_fill_beats_per_job_with_bit_identical_canvases():
+    mixed = run_chaos_xjob(seed=3, jobs=FLEET)
+    perjob = run_chaos_xjob(seed=3, jobs=FLEET, cross_job=False)
+    assert mixed.stats["tiles"] == 12 and perjob.stats["tiles"] == 12
+    # the acceptance bar: strictly fewer padded slots
+    assert mixed.fill_ratio > perjob.fill_ratio
+    assert mixed.stats["slots_padded"] < perjob.stats["slots_padded"]
+    assert not mixed.leaks and not perjob.leaks
+    # every canvas bit-identical whether a tile rode alone, with its
+    # own job, or with another tenant's tiles
+    for spec in FLEET:
+        solo = _solo(spec)
+        jid = spec["job_id"]
+        np.testing.assert_array_equal(
+            solo.canvases[jid], mixed.canvases[jid]
+        )
+        np.testing.assert_array_equal(
+            solo.canvases[jid], perjob.canvases[jid]
+        )
+
+
+def test_mesh_rounded_buckets_keep_identity_and_fill_win():
+    """bucket_multiple=4 (the D=4 mesh rounding): tails under the mesh
+    width pad hard in per-job mode; cross-job still wins and canvases
+    stay bit-identical."""
+    mixed = run_chaos_xjob(seed=5, jobs=FLEET, bucket_multiple=4)
+    perjob = run_chaos_xjob(
+        seed=5, jobs=FLEET, bucket_multiple=4, cross_job=False
+    )
+    assert mixed.fill_ratio > perjob.fill_ratio
+    for spec in FLEET:
+        solo = _solo(spec, bucket_multiple=4)
+        np.testing.assert_array_equal(
+            solo.canvases[spec["job_id"]], mixed.canvases[spec["job_id"]]
+        )
+
+
+# --------------------------------------------------------------------------
+# step-level preemption
+# --------------------------------------------------------------------------
+
+
+def test_premium_preempts_running_batch_grant_at_step_boundary():
+    r = run_chaos_xjob(
+        seed=7, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM
+    )
+    # the eviction actually happened, through the release/requeue path,
+    # and every evicted tile resumed from its checkpoint
+    assert r.preempted_jobs == ["xjob-batch"]
+    assert r.evictions == 5
+    assert r.resumes_checkpoint == 5 and r.resumes_recompute == 0
+    # premium-lane wait bound: the premium job's FIRST tile (indeed,
+    # all of its tiles) completes before any remaining batch tile
+    order = [jid for jid, _ in r.completion_order]
+    first_prem = order.index("xjob-prem")
+    resumed_batch = [
+        i for i, jid in enumerate(order)
+        if jid == "xjob-batch" and i > first_prem
+    ]
+    assert resumed_batch, "batch work must resume after the premium"
+    assert order[first_prem + 1] == "xjob-prem"  # both premium tiles first
+    # zero capacity leaks: every job settled, nothing pending /
+    # assigned / checkpointed left behind
+    assert not r.leaks
+    assert r.tiles_by_job == {"xjob-batch": 5, "xjob-prem": 2}
+    # both canvases bit-identical to their solo baselines
+    solo_batch = _solo(BATCH_SPEC, steps=5)
+    solo_prem = _solo({**PREMIUM, "lane": "batch"}, steps=5)
+    np.testing.assert_array_equal(
+        solo_batch.canvases["xjob-batch"], r.canvases["xjob-batch"]
+    )
+    np.testing.assert_array_equal(
+        solo_prem.canvases["xjob-prem"], r.canvases["xjob-prem"]
+    )
+
+
+def test_preempt_then_checkpoint_loss_recomputes_bit_identical():
+    r = run_chaos_xjob(
+        seed=7, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM,
+        drop_checkpoints=True,
+    )
+    assert r.evictions == 5
+    assert r.resumes_recompute == 5 and r.resumes_checkpoint == 0
+    assert not r.leaks
+    solo_batch = _solo(BATCH_SPEC, steps=5)
+    np.testing.assert_array_equal(
+        solo_batch.canvases["xjob-batch"], r.canvases["xjob-batch"]
+    )
+    # and the checkpoint-resume run equals the recompute run exactly
+    ck = run_chaos_xjob(seed=7, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM)
+    np.testing.assert_array_equal(
+        ck.canvases["xjob-batch"], r.canvases["xjob-batch"]
+    )
+
+
+def test_preemption_instruments_count():
+    from comfyui_distributed_tpu.telemetry.instruments import (
+        batch_fill_ratio,
+        preempt_resume_total,
+        preempt_total,
+    )
+
+    before_req = preempt_total().value(reason="premium_arrival")
+    before_ck = preempt_resume_total().value(mode="checkpoint")
+    run_chaos_xjob(seed=11, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM)
+    assert preempt_total().value(reason="premium_arrival") == before_req + 1
+    assert preempt_resume_total().value(mode="checkpoint") == before_ck + 5
+    # the fill gauge carries the most recent dispatch's ratio
+    assert 0.0 < batch_fill_ratio().value(role="worker") <= 1.0

@@ -1,0 +1,295 @@
+"""JobStore cross-job batching + preemption seams: lane/tenant on
+job_init (live + journal + replica state machine), the preempt pull
+gate, multi-job grants (`pull_tasks_any`), volatile checkpoint
+retention (budget, validation, pop-on-handout/submit/cancel), and the
+cross-job service-time split the placement cost models depend on."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.durability import state as dstate
+from comfyui_distributed_tpu.jobs import JobStore
+from comfyui_distributed_tpu.ops.stepwise import encode_checkpoint
+from comfyui_distributed_tpu.scheduler.preempt import PreemptionCoordinator
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _ck(value: float = 0.0, step: int = 2, shape=(2, 2)):
+    return encode_checkpoint(np.full(shape, value, np.float32), step)
+
+
+# --------------------------------------------------------------------------
+# lane/tenant: init + journal + replica parity
+# --------------------------------------------------------------------------
+
+
+def test_init_stamps_lane_tenant_and_journals_them():
+    async def body():
+        records = []
+        store = JobStore()
+        store.journal_sink = records.append
+        await store.init_tile_job("j", [0, 1], lane="batch", tenant="acme")
+        job = await store.get_tile_job("j")
+        assert job.lane == "batch" and job.tenant == "acme"
+        init = [r for r in records if r["type"] == "job_init"][0]
+        assert init["lane"] == "batch" and init["tenant"] == "acme"
+        # the pure state machine carries them to snapshots + replicas
+        state = dstate.new_state()
+        dstate.apply_record(state, init)
+        assert state["jobs"]["j"]["lane"] == "batch"
+        assert state["jobs"]["j"]["tenant"] == "acme"
+        jobs = dstate.materialize(state)
+        assert jobs["j"].lane == "batch" and jobs["j"].tenant == "acme"
+
+    run(body())
+
+
+def test_note_job_priority_seam_feeds_init():
+    async def body():
+        store = JobStore()
+        store.note_job_priority("j", "premium", "tenant-x")
+        await store.init_tile_job("j", [0])
+        job = await store.get_tile_job("j")
+        assert job.lane == "premium" and job.tenant == "tenant-x"
+        # the note is consumed exactly once
+        await store.init_tile_job("j2", [0])
+        job2 = await store.get_tile_job("j2")
+        assert job2.lane == "" and job2.tenant == "default"
+
+    run(body())
+
+
+def test_old_journal_without_lane_fields_still_replays():
+    state = dstate.new_state()
+    dstate.apply_record(
+        state, {"type": "job_init", "job": "j", "tasks": [0, 1]}
+    )
+    jobs = dstate.materialize(state)
+    assert jobs["j"].lane == "" and jobs["j"].tenant == "default"
+
+
+# --------------------------------------------------------------------------
+# preemption flags at the store
+# --------------------------------------------------------------------------
+
+
+def test_preempted_job_pulls_read_drained_until_cleared():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0, 1, 2])
+        assert await store.pull_task("j", "w1") == 0
+        flagged = await store.request_preemption(["j"], reason="manual")
+        assert flagged == ["j"]
+        assert await store.pull_task("j", "w1", timeout=0.01) is None
+        assert await store.pull_tasks_any("w1", limit=4) == []
+        # idempotent: already-flagged jobs don't re-flag
+        assert await store.request_preemption(["j"]) == []
+        assert await store.clear_preemption(["j"]) == ["j"]
+        assert await store.pull_task("j", "w1", timeout=0.1) == 1
+
+    run(body())
+
+
+def test_request_preemption_skips_cancelled_and_unknown():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0])
+        await store.cancel_job("j")
+        assert await store.request_preemption(["j", "ghost"]) == []
+
+    run(body())
+
+
+# --------------------------------------------------------------------------
+# multi-job grants
+# --------------------------------------------------------------------------
+
+
+def test_pull_tasks_any_orders_by_lane_rank_and_journals_per_job():
+    async def body():
+        records = []
+        store = JobStore()
+        coord = PreemptionCoordinator(
+            ["premium", "batch"], store, enabled=False
+        )
+        store.preempt_policy = coord
+        store.journal_sink = records.append
+        await store.init_tile_job("jb", [0, 1, 2], lane="batch")
+        await store.init_tile_job("jp", [0, 1], lane="premium")
+        grants = await store.pull_tasks_any("w1", limit=4)
+        # premium lane drains first; remainder comes from batch
+        assert [g["job"] for g in grants] == ["jp", "jb"]
+        assert grants[0]["tile_idxs"] == [0, 1]
+        assert grants[1]["tile_idxs"] == [0, 1]
+        pulls = [r for r in records if r["type"] == "pull"]
+        assert len(pulls) == 2  # ONE record per touched job
+        assert {p["job"] for p in pulls} == {"jb", "jp"}
+        # claims are real assignments (requeue/timeout machinery sees them)
+        jb = await store.get_tile_job("jb")
+        assert jb.assigned["w1"] == {0, 1}
+
+    run(body())
+
+
+def test_pull_tasks_any_skips_quarantined_and_cancelled():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("ja", [0, 1])
+        await store.init_tile_job("jc", [0])
+        await store.cancel_job("jc")
+        ja = await store.get_tile_job("ja")
+        ja.quarantined_tiles.add(0)
+        grants = await store.pull_tasks_any("w1", limit=8)
+        assert grants == [{"job": "ja", "tile_idxs": [1], "checkpoints": {}}]
+
+    run(body())
+
+
+# --------------------------------------------------------------------------
+# checkpoint retention
+# --------------------------------------------------------------------------
+
+
+def test_release_retains_validated_checkpoints_and_handout_pops():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0, 1, 2])
+        tasks = []
+        for _ in range(3):
+            tasks.append(await store.pull_task("j", "w1"))
+        cks = {
+            0: _ck(0.5),
+            1: {"v": 1, "step": 1, "dtype": "float32",
+                "shape": [9], "data": "AA=="},  # byte-count mismatch
+            2: _ck(1.0),
+            7: _ck(2.0),  # never released: must not be retained
+        }
+        released = await store.release_tasks(
+            "j", "w1", [0, 1, 2], checkpoints=cks
+        )
+        assert released == [0, 1, 2]
+        job = await store.get_tile_job("j")
+        assert sorted(job.checkpoints) == [0, 2]
+        assert job.checkpoint_bytes > 0
+        # hand-out pops (the re-granted tile carries its state exactly once)
+        out = await store.checkpoints_for("j", [0, 2])
+        assert sorted(out) == [0, 2]
+        assert job.checkpoints == {} and job.checkpoint_bytes == 0
+
+    run(body())
+
+
+def test_checkpoint_budget_bounds_retention(monkeypatch):
+    from comfyui_distributed_tpu.utils import constants
+
+    monkeypatch.setattr(constants, "PREEMPT_CHECKPOINT_MB", 0)
+
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0])
+        await store.pull_task("j", "w1")
+        await store.release_tasks("j", "w1", [0], checkpoints={0: _ck()})
+        job = await store.get_tile_job("j")
+        assert job.checkpoints == {}  # budget 0: everything recomputes
+
+    run(body())
+
+
+def test_submit_and_cancel_drop_checkpoints():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("j", [0, 1])
+        for _ in (0, 1):
+            await store.pull_task("j", "w1")
+        await store.release_tasks(
+            "j", "w1", [0, 1], checkpoints={0: _ck(), 1: _ck()}
+        )
+        job = await store.get_tile_job("j")
+        assert sorted(job.checkpoints) == [0, 1]
+        # a settled tile's checkpoint is dead weight
+        await store.pull_task("j", "w1")
+        await store.pull_task("j", "w1")
+        job.checkpoints[0] = _ck()  # simulate an un-popped leftover
+        await store.submit_result("j", "w1", 0, None)
+        assert 0 not in job.checkpoints
+        # terminal cancel frees the rest
+        await store.cancel_job("j")
+        assert job.checkpoints == {} and job.checkpoint_bytes == 0
+
+    run(body())
+
+
+# --------------------------------------------------------------------------
+# cross-job service-time split (the placement cost-model satellite)
+# --------------------------------------------------------------------------
+
+
+def test_flush_interval_counts_from_previous_submit_across_jobs():
+    """A worker finishing job A's tile then flushing job B must charge
+    B only the interval SINCE A's submit — not since B's (much older)
+    assignment, which would bill A's compute to B's stream."""
+
+    async def body():
+        seen = []
+        store = JobStore()
+        store.latency_sink = lambda wid, sec: seen.append((wid, sec))
+        await store.init_tile_job("ja", [0])
+        await store.init_tile_job("jb", [0])
+        assert await store.pull_task("jb", "w1") == 0  # B assigned FIRST
+        await asyncio.sleep(0.15)  # ... then A occupies the worker
+        assert await store.pull_task("ja", "w1") == 0
+        await store.submit_result("ja", "w1", 0, None)
+        t_a = time.monotonic()
+        await asyncio.sleep(0.02)
+        await store.submit_flush("jb", "w1", {0: None})
+        elapsed_since_a = time.monotonic() - t_a
+        assert len(seen) == 2
+        b_latency = seen[1][1]
+        # honest: bounded by the gap since A's submit, NOT the 0.15s+
+        # window since B's assignment
+        assert b_latency <= elapsed_since_a + 0.05
+        assert b_latency < 0.1
+
+    run(body())
+
+
+def test_single_job_latency_semantics_unchanged():
+    async def body():
+        seen = []
+        store = JobStore()
+        store.latency_sink = lambda wid, sec: seen.append(sec)
+        await store.init_tile_job("j", [0, 1])
+        await store.pull_task("j", "w1")
+        await store.pull_task("j", "w1")
+        await asyncio.sleep(0.05)
+        await store.submit_result("j", "w1", 0, None)
+        await asyncio.sleep(0.05)
+        await store.submit_result("j", "w1", 1, None)
+        # tile 1's service time starts at tile 0's submit (the pinned
+        # batched-pull amortization), exactly as before this PR
+        assert seen[1] == pytest.approx(0.05, abs=0.04)
+
+    run(body())
+
+
+def test_pull_tasks_any_skips_image_jobs_and_expires_deadlines():
+    async def body():
+        store = JobStore()
+        await store.init_tile_job("jt", [0, 1])
+        await store.init_tile_job("ji", [0, 1], batched=False, kind="image")
+        await store.init_tile_job("jd", [0], deadline_s=0.01)
+        await asyncio.sleep(0.05)  # jd's deadline passes
+        grants = await store.pull_tasks_any("w1", limit=8)
+        # image-job indices never masquerade as tile grants, and the
+        # overdue job is lazily cancelled instead of granted
+        assert [g["job"] for g in grants] == ["jt"]
+        jd = await store.get_tile_job("jd")
+        assert jd.cancelled and jd.cancel_reason == "deadline"
+
+    run(body())

@@ -60,6 +60,14 @@ DETERMINISM_PATHS = (
     # wall-clock lease file is the one sanctioned clock read and is
     # noqa'd at its call sites.
     "comfyui_distributed_tpu/api/standby.py",
+    # the cross-job continuous-batching tier is production hot path:
+    # batch composition order, checkpoint adoption, and the stepwise
+    # sampler seam all back the mixed-batch / preempt-resume
+    # bit-identity guarantee — unsorted iteration or ambient entropy
+    # here would make a tile's output depend on its batch-mates
+    "comfyui_distributed_tpu/graph/batch_executor.py",
+    "comfyui_distributed_tpu/ops/stepwise.py",
+    "comfyui_distributed_tpu/scheduler/preempt.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
