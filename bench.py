@@ -822,6 +822,45 @@ def _measure_mixed_small_jobs(
             )
             elapsed = time_mod.perf_counter() - started
             tiles = result.stats["tiles"]
+            rate = round(tiles / elapsed, 3) if elapsed > 0 else None
+            # usage block (usage-metering PR satellite): the run-local
+            # meter's per-tenant chip-seconds + waste shares, and the
+            # fill-adjusted rate — tiles/sec/chip discounted by the
+            # attributed share of measured dispatch time, so modes with
+            # different padding burn compare on USEFUL chip throughput
+            usage_roll = (result.usage or {}).get("rollup", {})
+            totals = usage_roll.get("totals", {})
+            chip_s = totals.get("chip_s", 0.0)
+            waste_s = totals.get("waste_s", {})
+            attributed_share = (
+                totals.get("attributed_s", 0.0) / chip_s if chip_s else 1.0
+            )
+            usage_block = {
+                "tenants": {
+                    tenant: {
+                        "chip_s": round(stats["chip_s"], 6),
+                        "tiles": stats["tiles"],
+                        "chip_share": stats.get("chip_share", 0.0),
+                    }
+                    for tenant, stats in sorted(
+                        usage_roll.get("tenants", {}).items()
+                    )
+                },
+                "chip_s": round(chip_s, 6),
+                "waste_shares": {
+                    r: round(s / chip_s, 6) if chip_s else 0.0
+                    for r, s in sorted(waste_s.items())
+                },
+                "attributed_share": round(attributed_share, 6),
+                "conserved": (result.usage or {})
+                .get("totals", {})
+                .get("conserved"),
+                "tiles_per_sec_chip_effective": (
+                    round(rate * attributed_share, 3)
+                    if rate is not None
+                    else None
+                ),
+            }
             return result, {
                 "fill_ratio": round(result.fill_ratio, 4),
                 "padded_slots": result.stats["slots_padded"],
@@ -831,9 +870,8 @@ def _measure_mixed_small_jobs(
                 "elapsed_s": round(elapsed, 4),
                 # ONE host drives the harness executor, so per-chip ==
                 # per-run here; real fleets scale by topology.chips
-                "tiles_per_sec_chip": round(tiles / elapsed, 3)
-                if elapsed > 0
-                else None,
+                "tiles_per_sec_chip": rate,
+                "usage": usage_block,
             }
 
         # solo baseline FIRST: it doubles as the jax dispatch warmup so
@@ -1265,10 +1303,52 @@ def _emit(result: dict) -> None:
     incidents = _incident_stamp(out["probe"])
     if incidents is not None:
         out["incidents"] = incidents
+    usage = _usage_stamp()
+    if usage is not None:
+        out["usage"] = usage
     if _TIMELINE:
         out["timeline"] = list(_TIMELINE)
     _BEST = out
     print(json.dumps(out), flush=True)
+
+
+def _usage_stamp() -> dict | None:
+    """Chip-time attribution stamp for every datum (usage-metering PR
+    satellite): this process's cumulative per-tenant chip-seconds,
+    the waste breakdown with each bucket's share of measured dispatch
+    time, and the conservation verdict — so BENCH_* rounds are
+    cost-comparable across fleet shapes (a 4-chip round that burned
+    30% padding is NOT cheaper than a 1-chip round at 2%). Zeroes on
+    paths that bypass the metered samplers; never raises."""
+    try:
+        from comfyui_distributed_tpu.telemetry.usage import get_usage_meter
+
+        rollup = get_usage_meter().rollup()
+        totals = rollup["totals"]
+        chip_s = totals["chip_s"]
+        waste = totals["waste_s"]
+        return {
+            "tenants": {
+                tenant: {
+                    "chip_s": round(stats["chip_s"], 6),
+                    "tiles": stats["tiles"],
+                    "chip_share": stats.get("chip_share", 0.0),
+                }
+                for tenant, stats in sorted(rollup["tenants"].items())
+            },
+            "chip_s": round(chip_s, 6),
+            "attributed_s": round(totals["attributed_s"], 6),
+            "waste_s": {r: round(s, 6) for r, s in sorted(waste.items())},
+            "waste_shares": {
+                r: round(s / chip_s, 6) if chip_s else 0.0
+                for r, s in sorted(waste.items())
+            },
+            "dispatches": totals["dispatches"],
+            "conserved": totals["conserved"],
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"usage stamp failed: {exc}", file=sys.stderr)
+        return None
 
 
 # one manual capture per process for a failed probe: the bundle trail

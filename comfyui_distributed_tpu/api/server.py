@@ -189,6 +189,10 @@ class DistributedServer:
             # registry forgets a worker, its fleet series depart too
             self.scheduler.placement.on_forget = self.fleet.forget_worker
             get_health_registry().on_forget = self.fleet.forget_worker
+            # measured-cost admission (CDT_USAGE_COST=1): DRR cost
+            # multiplies by the tenant's metered chip-s-per-tile ratio
+            if self.fleet.usage is not None:
+                self.scheduler.usage_cost = self.fleet.usage.cost_ratio
         # Durable control plane (durability/): enabled by setting
         # CDT_JOURNAL_DIR on a master. Construction is cheap and
         # file-free; recovery + the write-ahead seam attach in start(),

@@ -66,6 +66,7 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/durability", routes.durability)
     app.router.add_get("/distributed/fleet", routes.fleet)
     app.router.add_get("/distributed/alerts", routes.alerts)
+    app.router.add_get("/distributed/usage", routes.usage)
 
 
 class TelemetryRoutes:
@@ -149,6 +150,47 @@ class TelemetryRoutes:
             since_s=since_s, worker=request.query.get("worker")
         )
         payload["enabled"] = True
+        return web.json_response(payload)
+
+    async def usage(self, request: web.Request) -> web.Response:
+        """Tenant usage metering & chip-time attribution
+        (docs/observability.md §Usage metering): fleet rollup
+        (per-tenant/per-lane/per-job chip-seconds, tiles, steps), the
+        full waste breakdown (padding | preempt_recompute | speculation
+        | poison_retry), the conservation identity, and the measured
+        cost model. Query params:
+
+        - ``since=SECONDS`` — adds windowed history for the retained
+          per-tenant/waste series (two-tier retention, like the fleet
+          route);
+        - ``tenant=NAME`` — scopes drill-down + history to one tenant.
+        """
+        fleet = getattr(self.server, "fleet", None)
+        aggregator = getattr(fleet, "usage", None) if fleet else None
+        if aggregator is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "usage metering runs on masters with CDT_FLEET=1 "
+                         "and CDT_USAGE=1"}
+            )
+        since_param = request.query.get("since")
+        since_s: float | None = None
+        if since_param is not None:
+            try:
+                since_s = float(since_param)
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "since must be a number of seconds"},
+                    status=400,
+                )
+            if not math.isfinite(since_s) or since_s < 0:
+                return web.json_response(
+                    {"error": "since must be a finite number >= 0"},
+                    status=400,
+                )
+        payload = aggregator.status(
+            since_s=since_s, tenant=request.query.get("tenant")
+        )
         return web.json_response(payload)
 
     async def alerts(self, request: web.Request) -> web.Response:

@@ -64,6 +64,12 @@ from ..telemetry.instruments import (
     preempt_resume_total,
     tiles_processed_total,
 )
+from ..telemetry.usage import (
+    SLOT_PADDING,
+    SLOT_REAL,
+    SLOT_RECOMPUTE,
+    get_usage_meter,
+)
 from ..utils.logging import debug_log
 from .tile_pipeline import stage_span
 
@@ -147,7 +153,10 @@ class _Item:
     """One tile's position in the executor: job, index, step cursor,
     and (after init / checkpoint adoption) its latent state."""
 
-    __slots__ = ("job", "tile_idx", "step", "x", "key", "seq", "resumed")
+    __slots__ = (
+        "job", "tile_idx", "step", "x", "key", "seq", "resumed",
+        "recompute_until",
+    )
 
     def __init__(self, job: XJobHandle, tile_idx: int, seq: int):
         self.job = job
@@ -157,6 +166,10 @@ class _Item:
         self.key = None
         self.seq = seq  # arrival order; ties in priority break on this
         self.resumed = False
+        # steps below this index are RE-RUNS of work a preemption
+        # eviction already paid for (checkpoint lost → recompute): the
+        # usage meter charges them to waste{preempt_recompute}
+        self.recompute_until = 0
 
     def order(self) -> tuple[int, int, int]:
         return (self.job.priority, self.job.seq, self.seq)
@@ -188,8 +201,10 @@ class CrossJobExecutor:
         preempt_enabled: bool = True,
         idle_poll_seconds: float = 0.02,
         clock: Callable[[], float] = time.monotonic,
+        usage_meter: Any = None,
     ) -> None:
         from ..ops.upscale import grant_buckets
+        from ..utils.constants import USAGE_ENABLED
 
         self.k_max = max(1, int(k_max))
         self.mesh = mesh
@@ -223,9 +238,20 @@ class CrossJobExecutor:
         self._sig_order: list[tuple] = []  # first-seen signature order
         self._vstep_cache: dict[tuple, Any] = {}
         self._shardings: dict[int, Any] = {}
-        # (job_id, tile_idx) pairs this executor evicted: a later
-        # arrival without a checkpoint is a recompute-from-0 resume
-        self._evicted: set[tuple[str, int]] = set()
+        # (job_id, tile_idx) -> step reached when this executor evicted
+        # the tile: a later arrival without a checkpoint is a
+        # recompute-from-0 resume, and the usage meter charges its
+        # re-run steps (below that mark) to waste{preempt_recompute}
+        self._evicted: dict[tuple[str, int], int] = {}
+        # chip-time attribution (telemetry/usage.py); None = disabled
+        self.usage = usage_meter if usage_meter is not None else (
+            get_usage_meter() if USAGE_ENABLED else None
+        )
+        self._chips = 1
+        if mesh is not None:
+            from ..parallel.mesh import data_axis_size as _das
+
+            self._chips = max(1, _das(mesh))
         self._stop = threading.Event()
         # --- accounting (read by bench + chaos assertions) ---------------
         self.dispatches = 0
@@ -255,6 +281,10 @@ class CrossJobExecutor:
             if sig not in self._items:
                 self._items[sig] = []
                 self._sig_order.append(sig)
+        if self.usage is not None:
+            # advisory attrs (the store's init path lands the
+            # authoritative tenant/lane on masters)
+            self.usage.note_job_attrs(job.job_id, job.tenant, job.lane)
         return job
 
     def stop(self) -> None:
@@ -337,7 +367,8 @@ class CrossJobExecutor:
             item = _Item(job, tile_idx, self._item_seq)
             item.key = self._tile_key(job, tile_idx)
             payload = checkpoints.get(tile_idx, checkpoints.get(str(tile_idx)))
-            evicted_here = (job.job_id, tile_idx) in self._evicted
+            evicted_step = self._evicted.get((job.job_id, tile_idx))
+            evicted_here = evicted_step is not None
             if payload is not None:
                 try:
                     import jax.numpy as jnp
@@ -357,7 +388,10 @@ class CrossJobExecutor:
             if not item.resumed and evicted_here:
                 self.resumes_recompute += 1
                 preempt_resume_total().inc(mode="recompute")
-            self._evicted.discard((job.job_id, tile_idx))
+                # the steps it re-runs up to the eviction mark were
+                # already paid for once: waste, not tenant time
+                item.recompute_until = int(evicted_step)
+            self._evicted.pop((job.job_id, tile_idx), None)
             job.claimed.add(tile_idx)
             self._items.setdefault(sig, []).append(item)
             added += 1
@@ -433,7 +467,7 @@ class CrossJobExecutor:
         checkpoints: dict[int, Any] = {}
         for item in sorted(items, key=lambda it: it.tile_idx):
             idxs.append(item.tile_idx)
-            self._evicted.add((job.job_id, item.tile_idx))
+            self._evicted[(job.job_id, item.tile_idx)] = int(item.step)
             if item.x is not None and 0 < item.step < job.proc.n_steps:
                 try:
                     checkpoints[item.tile_idx] = encode_checkpoint(
@@ -464,7 +498,8 @@ class CrossJobExecutor:
         process-shared executor — drop them so the set stays bounded
         by live in-flight work."""
         self._evicted = {
-            mark for mark in self._evicted if mark[0] != job_id
+            mark: step for mark, step in self._evicted.items()
+            if mark[0] != job_id
         }
 
     def _prune_signature(self, sig: tuple) -> None:
@@ -600,15 +635,50 @@ class CrossJobExecutor:
             (xs, keys, poss, negs, yxs, steps)
         )
         fn = self._vstep(sig, batch[0].job.proc.step)
+        # slot-exact attribution: one entry per device slot of the
+        # padded bucket, classified BEFORE the step advances — a real
+        # item re-running steps below its eviction mark is recompute
+        # waste, a wraparound duplicate is padding
+        slots = [
+            {
+                "job_id": it.job.job_id,
+                "kind": (
+                    SLOT_RECOMPUTE
+                    if it.step < it.recompute_until
+                    else SLOT_REAL
+                ),
+            }
+            for it in batch
+        ] + [{"job_id": "", "kind": SLOT_PADDING}] * (bucket - n)
+        slot_tenants: dict[str, int] = {}
+        slot_jobs: dict[str, int] = {}
+        for it in batch:
+            slot_tenants[it.job.tenant] = slot_tenants.get(it.job.tenant, 0) + 1
+            slot_jobs[it.job.job_id] = slot_jobs.get(it.job.job_id, 0) + 1
         # one span per DEVICE DISPATCH with its fill accounting —
         # perf_report's batch-fill column reconstructs the ratio from
-        # exactly these attrs (real tiles vs bucket slots)
+        # exactly these attrs (real tiles vs bucket slots), and the
+        # --usage column splits the span's wall across slot_jobs /
+        # slot_tenants / padding the same way the meter does
+        started = time.monotonic()
         with stage_span(
             "dispatch", self.role, batch[0].tile_idx,
             real=n, bucket=int(bucket),
             jobs=len({it.job.job_id for it in batch}),
+            slot_jobs=slot_jobs, slot_tenants=slot_tenants,
+            recompute=sum(
+                1 for s in slots if s["kind"] == SLOT_RECOMPUTE
+            ),
         ):
             out = fn(params, xs, keys, poss, negs, yxs, steps)
+        if self.usage is not None:
+            self.usage.note_dispatch(
+                tier="xjob",
+                role=self.role,
+                elapsed_s=time.monotonic() - started,
+                chips=self._chips,
+                slots=slots,
+            )
         self.dispatches += 1
         self.steps_run += n
         self.slots_real += n
@@ -643,6 +713,8 @@ class CrossJobExecutor:
                     job.claimed.discard(item.tile_idx)
                     job.tiles_done += 1
                     self.tiles_finished += 1
+                    if self.usage is not None:
+                        self.usage.note_tiles(self.role, job.job_id, 1)
                     self.completion_order.append((job.job_id, item.tile_idx))
                     if len(self.completion_order) > self._max_completion_order:
                         del self.completion_order[

@@ -124,10 +124,14 @@ class GrantSampler:
         k_max: int = 1,
         role: str = "worker",
         mesh: Any = None,
+        job_id: str = "",
+        tenant: str = "",
+        usage_meter: Any = None,
     ) -> None:
         import jax
 
         from ..ops.upscale import grant_buckets
+        from ..utils.constants import USAGE_ENABLED
 
         self.process = process
         self.params = params
@@ -139,6 +143,19 @@ class GrantSampler:
         self.k_max = max(1, int(k_max))
         self.role = role
         self.mesh = mesh
+        # chip-time attribution (telemetry/usage.py): every sample()
+        # dispatch emits a slot-exact usage record charging this job
+        # (None = metering disabled)
+        self.job_id = str(job_id)
+        self.tenant = str(tenant)
+        if usage_meter is not None:
+            self.usage = usage_meter
+        elif USAGE_ENABLED:
+            from ..telemetry.usage import get_usage_meter
+
+            self.usage = get_usage_meter()
+        else:
+            self.usage = None
         self.data_parallel = 1
         self._data_shardings: Optional[tuple] = None
         if mesh is not None:
@@ -257,6 +274,43 @@ class GrantSampler:
         )
         return host
 
+    # --- usage attribution ------------------------------------------------
+
+    def _dispatch_span(self, idxs: Sequence[int], real: int, bucket: int):
+        """One ``tile.dispatch`` span per device dispatch — the same
+        vocabulary the cross-job executor emits, so perf_report's
+        batch-fill and --usage columns read both tiers uniformly."""
+        attrs: dict[str, Any] = {
+            "real": int(real), "bucket": int(bucket), "jobs": 1,
+        }
+        if self.job_id:
+            attrs["slot_jobs"] = {self.job_id: int(real)}
+        if self.tenant:
+            attrs["slot_tenants"] = {self.tenant: int(real)}
+        return stage_span("dispatch", self.role, int(idxs[0]), **attrs)
+
+    def _note_usage(self, elapsed_s: float, real: int, bucket: int) -> None:
+        """Slot-exact attribution record for one dispatch: ``real``
+        slots charge this job (a scan-tier slot runs a full
+        trajectory), wraparound-padding slots charge the padding waste
+        bucket; the scan tier has no step granularity, so tiles count
+        here too (each real slot IS a finished tile)."""
+        if self.usage is None:
+            return
+        from ..telemetry.usage import SLOT_PADDING, SLOT_REAL
+
+        slots = [{"job_id": self.job_id, "kind": SLOT_REAL}] * int(real) + [
+            {"job_id": "", "kind": SLOT_PADDING}
+        ] * int(bucket - real)
+        self.usage.note_dispatch(
+            tier="scan",
+            role=self.role,
+            elapsed_s=elapsed_s,
+            chips=self.data_parallel,
+            slots=slots,
+        )
+        self.usage.note_tiles(self.role, self.job_id, int(real))
+
     # --- execution --------------------------------------------------------
 
     def sample(self, idxs: Sequence[int]):
@@ -275,17 +329,20 @@ class GrantSampler:
             pipeline_batches_total().inc(n, role=self.role, bucket="1")
             # direct fold_in (not the vmapped form): byte-identical to
             # the historical serial loop's key derivation
-            outs = [
-                self.process(
-                    self.params,
-                    self.extracted[i],
-                    jax.random.fold_in(self.base_key, i),
-                    self.pos,
-                    self.neg,
-                    self.positions[i],
-                )
-                for i in idxs
-            ]
+            started = time.monotonic()
+            with self._dispatch_span(idxs, real=n, bucket=n):
+                outs = [
+                    self.process(
+                        self.params,
+                        self.extracted[i],
+                        jax.random.fold_in(self.base_key, i),
+                        self.pos,
+                        self.neg,
+                        self.positions[i],
+                    )
+                    for i in idxs
+                ]
+            self._note_usage(time.monotonic() - started, real=n, bucket=n)
             self.buckets_used.add(1)
             return jnp.stack(outs, axis=0)
         bucket = self._bucket_for(n)
@@ -296,7 +353,12 @@ class GrantSampler:
         keys = self._keys_for(padded)
         yxs = jnp.take(self.positions, sel, axis=0)
         tiles, keys, yxs = self._place(tiles, keys, yxs)
-        out = self._batched(self.params, tiles, keys, self.pos, self.neg, yxs)
+        started = time.monotonic()
+        with self._dispatch_span(idxs, real=n, bucket=bucket):
+            out = self._batched(
+                self.params, tiles, keys, self.pos, self.neg, yxs
+            )
+        self._note_usage(time.monotonic() - started, real=n, bucket=bucket)
         self.buckets_used.add(bucket)
         pipeline_batches_total().inc(role=self.role, bucket=str(bucket))
         if self.data_parallel > 1:

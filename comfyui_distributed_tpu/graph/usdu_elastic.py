@@ -726,6 +726,7 @@ def run_worker_loop(
     grant_sampler = GrantSampler(
         process, params, extracted, key, positions, pos, neg,
         k_max=tile_scan_batch() * data_width, role="worker", mesh=mesh,
+        job_id=job_id,
     )
 
     # Warm the tile-processor compile while the ready poll waits on the
@@ -1070,7 +1071,7 @@ def run_master_elastic(
     grant_sampler = GrantSampler(
         process, bundle.params, extracted, key, positions, pos, neg,
         k_max=tile_scan_batch() * master_data_width, role="master",
-        mesh=mesh,
+        mesh=mesh, job_id=job_id,
     )
     empty_pulls = 0
     while empty_pulls < 2:

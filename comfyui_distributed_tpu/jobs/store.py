@@ -46,6 +46,41 @@ from ..utils.logging import debug_log, log
 from .models import CollectorJob, ImageJob, TileJob
 
 
+def _note_usage_waste(
+    reason: str, seconds: float, job_id: Optional[str] = None
+) -> None:
+    """Charge a store-family usage waste bucket (telemetry/usage.py):
+    the speculative race's losing submit and the failed delivery
+    attempts behind a requeue/quarantine are measured work the fleet
+    burned without advancing any canvas. Advisory — metering must
+    never fail a store mutation."""
+    from ..utils.constants import USAGE_ENABLED
+
+    if not USAGE_ENABLED or seconds <= 0:
+        return
+    try:
+        from ..telemetry.usage import get_usage_meter
+
+        get_usage_meter().note_waste("master", reason, seconds, job_id=job_id)
+    except Exception as exc:  # noqa: BLE001 - observability only
+        debug_log(f"usage waste note failed: {exc}")
+
+
+def _note_usage_job_attrs(job_id: str, tenant: str, lane: str) -> None:
+    """Feed the usage meter's job → (tenant, lane) attribution map at
+    the moment the store learns a job's admission identity."""
+    from ..utils.constants import USAGE_ENABLED
+
+    if not USAGE_ENABLED:
+        return
+    try:
+        from ..telemetry.usage import get_usage_meter
+
+        get_usage_meter().note_job_attrs(job_id, tenant, lane)
+    except Exception as exc:  # noqa: BLE001 - observability only
+        debug_log(f"usage attrs note failed: {exc}")
+
+
 class JobStore:
     def __init__(
         self,
@@ -436,6 +471,9 @@ class JobStore:
         from ..telemetry.events import get_event_bus
 
         get_event_bus().publish("job_ready", job_id=job_id, tasks=len(task_ids))
+        # authoritative tenant/lane for the attribution plane (lands on
+        # top of the executors' advisory registration attrs)
+        _note_usage_job_attrs(job_id, job.tenant, job.lane)
         self._notify_grants(job_id, len(task_ids))
         # Preemption seam: a premium-lane arrival may evict running
         # lower-lane work. Awaited AFTER the init committed (the
@@ -981,6 +1019,7 @@ class JobStore:
                 # poison-quarantined settles the tile for real — drop
                 # the quarantine so accounting counts it exactly once
                 job.quarantined_tiles.discard(task_id)
+        elapsed: Optional[float] = None
         if started is not None or service_seconds is not None:
             # duplicates still carry a real latency measurement: the
             # losing worker DID the work, and its speed is exactly what
@@ -1000,6 +1039,11 @@ class JobStore:
                     debug_log(f"latency sink failed for {worker_id}: {exc}")
         if duplicate:
             debug_log(f"duplicate result for {job_id}:{task_id} from {worker_id}")
+            if elapsed is not None and task_id in job.speculated:
+                # the losing side of a speculative race: measured work
+                # the fleet burned on a tile that was already won —
+                # charged to the speculation waste bucket
+                _note_usage_waste("speculation", elapsed, job_id=job_id)
             instruments.store_submits_total().inc(
                 worker_id=worker_id, outcome="duplicate"
             )
@@ -1335,8 +1379,21 @@ class JobStore:
         if job.cancelled:
             return []  # terminal: there is nothing left to requeue
         tasks = job.assigned.pop(worker_id, set())
+        attempt_waste = 0.0
+        requeue_now = time.monotonic()
         for tid in sorted(tasks):
-            job.assigned_at.pop((worker_id, tid), None)
+            assigned_at = job.assigned_at.pop((worker_id, tid), None)
+            if (
+                assigned_at is not None
+                and reason in self._ATTEMPT_REASONS
+                and tid not in job.completed
+            ):
+                # a failed delivery attempt (dead worker / quarantine —
+                # the poison-retry path): the assignment window is
+                # measured fleet time that produced nothing
+                attempt_waste += max(0.0, requeue_now - assigned_at)
+        if attempt_waste > 0:
+            _note_usage_waste("poison_retry", attempt_waste, job_id=job.job_id)
         incomplete = sorted(t for t in tasks if t not in job.completed)
         if not incomplete:
             return incomplete

@@ -114,6 +114,9 @@ class ChaosResult:
     # alert inside the debounce window ("debounced" when a capture
     # happened; "" when no alert fired)
     incident_retrigger: str = ""
+    # chip-time attribution captured on a run-local UsageMeter:
+    # {"rollup": per-tenant/lane/job view, "totals": exact ns identity}
+    usage: dict = dataclasses.field(default_factory=dict)
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -436,6 +439,7 @@ def run_chaos_usdu(
         sampler = GrantSampler(
             _stub_process, None, extracted, key, grid.positions_array(),
             None, None, k_max=tile_batch, role="worker", mesh=mesh,
+            job_id=job_id,
         )
         flush_pending: dict[int, list] = {}
 
@@ -535,9 +539,17 @@ def run_chaos_usdu(
         # except arm that stops it (no leaked tap/thread)
         incident_manager.start()
     set_tracer(chaos_tracer)
+    from ..telemetry.usage import UsageMeter, set_usage_meter
+
+    usage_meter = UsageMeter()
     try:
         with contextlib.ExitStack() as stack:
             stack.enter_context(_ensure_server_loop())
+            # run-local chip-time attribution: master loop, worker
+            # threads, and store waste notes all meter into this
+            # swapped-in meter (restored on stack exit); the result's
+            # usage block is exactly this run's burn
+            stack.callback(set_usage_meter, set_usage_meter(usage_meter))
             if wd is not None:
                 # start after the loop exists (speculation round-trips
                 # through it); stop (LIFO) before the loop shuts down
@@ -660,6 +672,10 @@ def run_chaos_usdu(
         incidents=incident_list,
         incident_dir=str(incidents["dir"]) if incidents else "",
         incident_retrigger=incident_retrigger,
+        usage={
+            "rollup": usage_meter.rollup(),
+            "totals": usage_meter.totals(),
+        },
     )
 
 
@@ -1930,6 +1946,9 @@ class XJobResult:
     resumes_recompute: int
     leaks: dict                           # job id -> leak accounting
     tiles_by_job: dict                    # job id -> accepted tile count
+    # chip-time attribution captured on a run-local UsageMeter:
+    # {"rollup": per-tenant/lane/job view, "totals": exact ns identity}
+    usage: dict = dataclasses.field(default_factory=dict)
 
 
 def run_chaos_xjob(
@@ -2007,11 +2026,19 @@ def run_chaos_xjob(
     store.placement = _WideBatches()
     coordinator = PreemptionCoordinator(list(lanes), store, enabled=True)
     store.preempt_policy = coordinator
+    # run-local chip-time attribution: the executor meters into this
+    # meter (and it is swapped in as the process global below so the
+    # store's attrs/waste notes land in the same place), so the
+    # result's usage block is exactly THIS run's burn
+    from ..telemetry.usage import UsageMeter, set_usage_meter
+
+    usage_meter = UsageMeter()
     executor = CrossJobExecutor(
         k_max=k_max,
         bucket_multiple=bucket_multiple,
         cross_job=cross_job,
         preempt_enabled=True,
+        usage_meter=usage_meter,
     )
 
     canvases: dict[str, np.ndarray] = {}
@@ -2183,6 +2210,7 @@ def run_chaos_xjob(
         stack.enter_context(
             mock.patch.dict(os.environ, {"CDT_DETERMINISTIC_BLEND": "1"})
         )
+        stack.callback(set_usage_meter, set_usage_meter(usage_meter))
         set_tracer(chaos_tracer)
         stack.callback(set_tracer, previous_tracer)
         token = chaos_tracer.activate(trace_id)
@@ -2231,4 +2259,8 @@ def run_chaos_xjob(
         resumes_recompute=executor.resumes_recompute,
         leaks=leaks,
         tiles_by_job=dict(tiles_by_job),
+        usage={
+            "rollup": usage_meter.rollup(),
+            "totals": usage_meter.totals(),
+        },
     )

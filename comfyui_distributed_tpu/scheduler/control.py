@@ -45,6 +45,12 @@ class SchedulerControl:
             self.queue.lane_order, clock=clock
         )
         self.queue.wait_sink = self.brownout.note_queue_wait
+        # Measured-cost seam (CDT_USAGE_COST=1): the server wires this
+        # to UsageAggregator.cost_ratio — a tenant's measured
+        # chip-seconds-per-tile relative to the fleet mean — so DRR
+        # admission cost meters what the tenant actually burns, not
+        # just the client's estimated_tiles.
+        self.usage_cost: Optional[Callable[[str], float]] = None
 
     # --- payload mapping --------------------------------------------------
 
@@ -102,12 +108,32 @@ class SchedulerControl:
                 cost = float(estimated_tiles)
         except (TypeError, ValueError):
             pass
+        cost *= self._measured_cost_ratio(payload.tenant)
         return self.queue.submit(
             tenant=payload.tenant,
             lane=payload.lane,
             cost=cost,
             trace_id=payload.trace_id,
         )
+
+    def _measured_cost_ratio(self, tenant: str) -> float:
+        """The CDT_USAGE_COST multiplier: the tenant's measured
+        chip-seconds-per-tile relative to the fleet mean (clamped by
+        the aggregator). 1.0 when the knob is off, the seam is unwired,
+        or the ratio is degenerate — the static cost is the fallback,
+        never a failure."""
+        from ..utils import constants
+
+        if not constants.USAGE_COST_ENABLED or self.usage_cost is None:
+            return 1.0
+        try:
+            ratio = float(self.usage_cost(tenant))
+        except Exception as exc:  # noqa: BLE001 - advisory model
+            log(f"scheduler: usage cost ratio for {tenant!r} failed: {exc}")
+            return 1.0
+        if not (ratio > 0.0) or ratio != ratio:
+            return 1.0
+        return ratio
 
     # --- state machine ----------------------------------------------------
 

@@ -25,7 +25,12 @@ The observability subsystem the ROADMAP's perf work hangs off:
   `GET /distributed/fleet`;
 - `slo`: declarative SLOs with multi-window burn-rate alerting —
   `alert_fired`/`alert_resolved` bus events, `GET /distributed/alerts`,
-  and the `cdt_alert_active` scrape gauge.
+  and the `cdt_alert_active` scrape gauge;
+- `usage`: chip-time attribution — both execution tiers emit
+  slot-exact timed records per device dispatch (tenant/job/lane
+  charges + padding/recompute/speculation/poison waste buckets, exact
+  conservation), worker meters merge into the master by riding the
+  fleet snapshot, served by `GET /distributed/usage`.
 
 All clocks are injectable so tier-1 tests run deterministically on
 CPU. See docs/observability.md for the operator-facing story.
@@ -62,6 +67,7 @@ from .flight import (
 from .incidents import IncidentManager, validate_bundle
 from .slo import BurnRule, SLOEngine, SLOSpec, default_slos
 from .timeseries import SeriesStore
+from .usage import UsageAggregator, UsageMeter, get_usage_meter
 from .watchdog import Watchdog
 
 __all__ = [
@@ -82,6 +88,8 @@ __all__ = [
     "Span",
     "TRACE_HEADER",
     "Tracer",
+    "UsageAggregator",
+    "UsageMeter",
     "Watchdog",
     "default_slos",
     "local_snapshot",
@@ -91,6 +99,7 @@ __all__ = [
     "get_flight_recorder",
     "get_metrics_registry",
     "get_tracer",
+    "get_usage_meter",
     "peek_flight_recorder",
     "reset_event_bus",
     "reset_flight_recorder",

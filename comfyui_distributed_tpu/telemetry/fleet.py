@@ -54,7 +54,11 @@ from .timeseries import SeriesStore
 # Snapshot wire-format version: the master ignores snapshots whose
 # major version it does not speak (a newer worker against an older
 # master degrades to "no fleet telemetry", never to a parse error).
-SNAPSHOT_VERSION = 1
+# v2 (usage-metering PR) adds the cumulative `usage` block; v1
+# snapshots stay accepted — the merge is version-gated, so an older
+# worker degrades to "no usage telemetry", never to a drop.
+SNAPSHOT_VERSION = 2
+ACCEPTED_SNAPSHOT_VERSIONS = (1, 2)
 
 # Same bound the placement policy applies to advertised capacity
 # (scheduler/placement.py): snapshots arrive on unauthenticated RPCs.
@@ -152,6 +156,15 @@ def local_snapshot(role: str = "worker") -> dict[str, Any]:
     except Exception:  # noqa: BLE001 - mesh resolution is advisory
         snap["mesh"] = {}
         snap["devices"] = 1
+    # v2: this process's cumulative chip-time attribution (the master
+    # adopts it by delta with a counter-reset clamp)
+    if constants.USAGE_ENABLED:
+        try:
+            from .usage import get_usage_meter
+
+            snap["usage"] = get_usage_meter().snapshot(role=role)
+        except Exception:  # noqa: BLE001 - usage block is advisory
+            pass
     return snap
 
 
@@ -172,6 +185,18 @@ class FleetRegistry:
         self.store = store if store is not None else SeriesStore(clock=clock)
         self.ttl = ttl if ttl is not None else constants.FLEET_TTL_SECONDS
         self.max_workers = int(max_workers)
+        # chip-time attribution plane (telemetry/usage.py): adopts the
+        # v2 snapshots' usage blocks, retains per-tenant series in the
+        # SAME store, and serves GET /distributed/usage. None when
+        # CDT_USAGE=0.
+        self.usage: Optional[Any] = None
+        if constants.USAGE_ENABLED:
+            from .usage import UsageAggregator
+
+            self.usage = UsageAggregator(store=self.store, clock=clock)
+            self.usage.on_evict_tenant = (
+                lambda tenant: self.store.evict_label("tenant", tenant)
+            )
         self._lock = threading.Lock()
         # worker_id -> {"snap", "seen", "rate", "prev_tiles", "prev_ts"}
         self._workers: dict[str, dict[str, Any]] = {}
@@ -212,7 +237,7 @@ class FleetRegistry:
             version = int(snap.get("v"))
         except (TypeError, ValueError):
             version = -1
-        if version != SNAPSHOT_VERSION:
+        if version not in ACCEPTED_SNAPSHOT_VERSIONS:
             instruments.fleet_snapshots_total().inc(outcome="bad_version")
             return False
         now = self.clock()
@@ -248,6 +273,10 @@ class FleetRegistry:
         if evicted is not None:
             self._drop_series(evicted, reason="capacity")
         instruments.fleet_snapshots_total().inc(outcome="accepted")
+        # v2: adopt the worker's cumulative usage meter by delta
+        # (counter-reset clamped inside the aggregator)
+        if version >= 2 and self.usage is not None and "usage" in snap:
+            self.usage.adopt(worker_id, snap.get("usage"))
         # per-worker retained series (master clock, bounded vocabulary)
         rate = entry["rate"]
         self.store.record(S_WORKER_TILES_PER_S, rate, worker_id=worker_id)
@@ -264,6 +293,8 @@ class FleetRegistry:
         worker_id = str(worker_id)
         with self._lock:
             self._workers.pop(worker_id, None)
+        if self.usage is not None:
+            self.usage.forget_worker(worker_id)
         self._drop_series(worker_id, reason=reason)
 
     def _drop_series(self, worker_id: str, reason: str) -> None:
@@ -368,7 +399,8 @@ class FleetRegistry:
         return rollup
 
     def step(self) -> dict[str, Any]:
-        """sweep + sample + publish one `fleet_rollup` event."""
+        """sweep + sample + publish one `fleet_rollup` event (and one
+        `usage_rollup` when the attribution plane is on)."""
         self.sweep()
         rollup = self.sample()
         from .events import get_event_bus
@@ -377,6 +409,15 @@ class FleetRegistry:
             get_event_bus().publish("fleet_rollup", **rollup)
         except Exception:  # noqa: BLE001 - push side is best effort
             pass
+        if self.usage is not None:
+            try:
+                # one aggregation pass: tenant cost EWMAs, retained
+                # per-tenant/waste series, idle-entry sweep — then the
+                # web panel's usage card refreshes off the event
+                usage_rollup = self.usage.sample()
+                get_event_bus().publish("usage_rollup", **usage_rollup)
+            except Exception as exc:  # noqa: BLE001 - best effort
+                debug_log(f"fleet: usage sample failed: {exc}")
         return rollup
 
     # --- rollups / surfaces --------------------------------------------------

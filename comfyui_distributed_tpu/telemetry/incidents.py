@@ -181,6 +181,14 @@ class IncidentManager:
             self.sources["fleet"] = (
                 lambda: fleet.status(since_s=self.window_s)
             )
+            usage = getattr(fleet, "usage", None)
+            if usage is not None:
+                # chip-time attribution at capture time: per-tenant
+                # burn + waste breakdown + the conservation identity —
+                # "who was burning the fleet when this fired"
+                self.sources["usage"] = (
+                    lambda: usage.status(since_s=self.window_s)
+                )
         slo = getattr(server, "slo", None)
         if slo is not None:
             self.sources["slo"] = slo.status

@@ -457,6 +457,36 @@ def fleet_series() -> Gauge:
     )
 
 
+# --- usage metering / chip-time attribution (telemetry/usage.py) ----------
+
+def usage_chip_seconds_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_usage_chip_seconds_total",
+        "Measured chip-seconds attributed to each (tenant, lane) by the "
+        "usage meter's dispatch records (mirrored from the aggregator "
+        "at scrape time; cardinality bounded by the usage key cap)",
+        ("tenant", "lane"),
+    )
+
+
+def usage_tiles_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_usage_tiles_total",
+        "Tiles finished per (tenant, lane) as metered by the usage "
+        "attribution plane",
+        ("tenant", "lane"),
+    )
+
+
+def usage_waste_seconds_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_usage_waste_seconds_total",
+        "Measured chip-seconds charged to waste buckets by reason "
+        "(padding|preempt_recompute|speculation|poison_retry)",
+        ("reason",),
+    )
+
+
 # --- incident plane (telemetry/flight.py, telemetry/incidents.py) ---------
 
 def incidents_total() -> Counter:
@@ -732,6 +762,10 @@ def bind_server_collectors(server) -> Callable[[], None]:
         fleet_series()
         alert_active()
         slo_burn_rate()
+        if getattr(server.fleet, "usage", None) is not None:
+            usage_chip_seconds_total()
+            usage_tiles_total()
+            usage_waste_seconds_total()
     # Incident-plane instruments present from the first scrape: the
     # flight drop counter whenever a recorder exists, the capture
     # instruments on masters running an incident manager.
@@ -837,6 +871,41 @@ def bind_server_collectors(server) -> Callable[[], None]:
                 if delta > 0:
                     drop_counter.inc(delta, stream=stream)
                     recorder.scrape_mirrored[stream] = dropped
+        # Usage attribution counters mirror the aggregator's cumulative
+        # rollup by DELTA against its own high-water marks (the flight-
+        # recorder idiom: co-hosted servers' collectors share the marks
+        # so a chip-second is counted exactly once).
+        fleet = getattr(server, "fleet", None)
+        usage = getattr(fleet, "usage", None) if fleet is not None else None
+        if usage is not None:
+            rollup = usage.rollup()
+            chip_counter = usage_chip_seconds_total()
+            tiles_counter = usage_tiles_total()
+            waste_counter = usage_waste_seconds_total()
+            marks = usage.scrape_mirrored
+            # exact (tenant, lane) slices from the aggregator's
+            # MONOTONIC pair view (live + retired — a TTL-swept job's
+            # chip time stays in its pair, so the high-water deltas
+            # never undercount after eviction)
+            by_pair = usage.pair_totals()
+            for (tenant, lane) in sorted(by_pair):
+                stats = by_pair[(tenant, lane)]
+                chip_key = f"chip:{tenant}:{lane}"
+                delta = stats["chip_s"] - marks.get(chip_key, 0.0)
+                if delta > 0:
+                    chip_counter.inc(delta, tenant=tenant, lane=lane)
+                    marks[chip_key] = stats["chip_s"]
+                tile_key = f"tiles:{tenant}:{lane}"
+                delta = stats["tiles"] - marks.get(tile_key, 0.0)
+                if delta > 0:
+                    tiles_counter.inc(delta, tenant=tenant, lane=lane)
+                    marks[tile_key] = stats["tiles"]
+            for reason in sorted(rollup["totals"]["waste_s"]):
+                value = rollup["totals"]["waste_s"][reason]
+                delta = value - marks.get(f"waste:{reason}", 0.0)
+                if delta > 0:
+                    waste_counter.inc(delta, reason=reason)
+                    marks[f"waste:{reason}"] = value
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at

@@ -282,6 +282,21 @@ FLEET_TTL_SECONDS = _env_float("CDT_FLEET_TTL", 120.0)
 SLO_TILE_P95_SECONDS = _env_float("CDT_SLO_TILE_P95", 5.0)
 SLO_JOURNAL_P95_SECONDS = _env_float("CDT_SLO_JOURNAL_P95", 0.25)
 
+# --- usage metering / chip-time attribution (telemetry/usage.py) ----------
+# Master toggle for the attribution plane: 0 disables dispatch
+# attribution records on both execution tiers and the master-side
+# aggregation (the usage route then answers enabled=false).
+USAGE_ENABLED = os.environ.get("CDT_USAGE", "1") != "0"
+# Closing the loop into admission: 1 multiplies a request's DRR cost by
+# the tenant's MEASURED chip-seconds-per-tile ratio (vs the fleet
+# mean), so fair share meters what tenants actually burn instead of
+# the client's estimated_tiles alone.
+USAGE_COST_ENABLED = _env_int("CDT_USAGE_COST", 0) == 1
+# Idle usage entries (jobs/tenants with no attribution activity for
+# this long) fold into retired aggregates and their retained series
+# evict — tenant-id churn must not grow master memory.
+USAGE_TTL_SECONDS = _env_float("CDT_USAGE_TTL", 3600.0)
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

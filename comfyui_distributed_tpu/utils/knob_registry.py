@@ -246,6 +246,17 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_SLO_JOURNAL_P95", "0.25", "telemetry",
          "Journal-append latency target the journal_latency SLO "
          "classifies samples against (seconds)."),
+    Knob("CDT_USAGE", "1", "telemetry",
+         "`0` disables chip-time attribution records on both execution "
+         "tiers and the master-side usage aggregation "
+         "(GET /distributed/usage answers enabled=false)."),
+    Knob("CDT_USAGE_COST", "0", "telemetry",
+         "`1` multiplies DRR admission cost by the tenant's measured "
+         "chip-seconds-per-tile ratio vs the fleet mean (clamped to "
+         "[0.1, 10]), replacing the static estimated_tiles-only cost."),
+    Knob("CDT_USAGE_TTL", "3600.0", "telemetry",
+         "Seconds of inactivity before a job/tenant usage entry folds "
+         "into retired aggregates and its retained series evict."),
     # --- incident plane --------------------------------------------------
     Knob("CDT_FLIGHT", "1", "incidents",
          "`0` disables the always-on flight recorder (the bus tap that "

@@ -49,6 +49,7 @@ import {
   renderWorkflowNodes,
   schedulerHtml,
   topologyHtml,
+  usageHtml,
   WORKER_FORM_FIELDS,
   workerFormHtml,
 } from "./modules/render.js";
@@ -99,6 +100,7 @@ async function refreshStatus() {
   refreshPipeline();
   refreshDurability();
   refreshFleet();
+  refreshUsage();
   refreshIncidents();
   schedulePoll();
 }
@@ -153,6 +155,17 @@ async function refreshFleet() {
     container.innerHTML = fleetHtml(fleet, alerts);
   } catch {
     container.textContent = "fleet status unreachable";
+  }
+}
+
+// ---------- usage / chip-time attribution card ----------
+
+async function refreshUsage() {
+  const container = document.getElementById("usage");
+  try {
+    container.innerHTML = usageHtml(await api("/distributed/usage"));
+  } catch {
+    container.textContent = "usage status unreachable";
   }
 }
 
@@ -227,6 +240,11 @@ function startEventStream() {
         // the fleet card is stream-fed: each pushed rollup / alert
         // transition refreshes it without waiting for the slow poll
         refreshFleet();
+      } else if (event.type === "usage_rollup") {
+        // the attribution card is stream-fed: render the pushed rollup
+        // directly (no extra fetch — the event IS the payload)
+        const container = document.getElementById("usage");
+        if (container) container.innerHTML = usageHtml(event.data);
       } else if (event.type === "incident_captured") {
         // a bundle just landed; show it without waiting for the poll
         refreshIncidents();

@@ -23,6 +23,7 @@ export const EVENT_TYPES = [
   "shed",
   "brownout_level",
   "fleet_rollup",
+  "usage_rollup",
   "alert_fired",
   "alert_resolved",
   "incident_captured",
@@ -41,6 +42,7 @@ export function reduceLiveStatus(prev, event) {
     breakers: { ...(prev?.breakers || {}) },
     events: [...(prev?.events || [])],
     fleet: prev?.fleet || null,
+    usage: prev?.usage || null,
     alerts: new Set(prev?.alerts || []),
   };
   if (event.type === "hello") {
@@ -54,6 +56,9 @@ export function reduceLiveStatus(prev, event) {
   }
   if (event.type === "fleet_rollup") {
     next.fleet = event.data; // latest rollup wins; the card re-renders
+  }
+  if (event.type === "usage_rollup") {
+    next.usage = event.data; // latest attribution rollup wins
   }
   if (event.type === "alert_fired") next.alerts.add(event.data.slo);
   if (event.type === "alert_resolved") next.alerts.delete(event.data.slo);
@@ -110,6 +115,8 @@ export function eventLabel(event) {
       })`;
     case "fleet_rollup":
       return null; // rendered as the fleet card, not an event line
+    case "usage_rollup":
+      return null; // rendered as the usage card, not an event line
     case "events_dropped":
       return `stream dropped ${d.count} event(s) (slow consumer)`;
     default:

@@ -275,6 +275,56 @@ export function fleetHtml(fleet, alerts) {
   );
 }
 
+/** Usage card (pure; app.js refreshUsage applies it): per-tenant
+ * chip-second attribution + the waste breakdown from
+ * GET /distributed/usage; pushed `usage_rollup` events refresh the
+ * same card between polls. */
+export function usageHtml(usage) {
+  if (!usage) return '<span class="meta">usage status unavailable</span>';
+  if (usage.enabled === false) {
+    return '<span class="meta">usage metering off — masters with CDT_USAGE=1 serve it</span>';
+  }
+  const roll = usage.rollup || usage; // route payload vs pushed event
+  const totals = roll.totals || {};
+  const waste = totals.waste_s || {};
+  const wasteTotal = Object.values(waste).reduce(
+    (a, v) => a + Number(v || 0), 0
+  );
+  const header =
+    `<div class="row">chips burned <b>${Number(totals.chip_s ?? 0).toFixed(2)}s</b>` +
+    ` · attributed ${Number(totals.attributed_s ?? 0).toFixed(2)}s` +
+    ` · waste ${wasteTotal.toFixed(2)}s` +
+    ` (${(Number(totals.waste_share ?? 0) * 100).toFixed(1)}% dispatch)` +
+    ` · ${totals.dispatches ?? 0} dispatch(es)</div>`;
+  const tenants = Object.entries(roll.tenants || {})
+    .sort(([, a], [, b]) => Number(b.chip_s || 0) - Number(a.chip_s || 0))
+    .slice(0, 8)
+    .map(
+      ([tenant, t]) =>
+        `<div class="row"><strong>${escapeHtml(tenant)}</strong>` +
+        `<span class="meta">${Number(t.chip_s ?? 0).toFixed(2)} chip-s` +
+        ` (${(Number(t.chip_share ?? 0) * 100).toFixed(1)}%)` +
+        ` · ${t.tiles ?? 0} tile(s)` +
+        `${Number(t.waste_s ?? 0) ? ` · waste ${Number(t.waste_s).toFixed(2)}s` : ""}` +
+        `</span></div>`
+    )
+    .join("");
+  const wasteLine = Object.keys(waste).length
+    ? `<div class="row"><span class="meta">waste: ` +
+      Object.keys(waste)
+        .sort()
+        .map((r) => `${escapeHtml(r)} ${Number(waste[r]).toFixed(2)}s`)
+        .join(" · ") +
+      `</span></div>`
+    : "";
+  return (
+    header +
+    (tenants ||
+      '<div class="row"><span class="meta">no attributed chip time yet</span></div>') +
+    wasteLine
+  );
+}
+
 /** Incidents card (pure; app.js refreshIncidents applies it): the
  * newest-first bundle listing from GET /distributed/incidents plus
  * flight-recorder accounting; pushed `incident_captured` events

@@ -186,6 +186,17 @@ test("eventLabel: alert transitions readable, fleet_rollup silent", () => {
     "resolved"
   );
   assertEqual(eventLabel({ type: "fleet_rollup", data: {} }), null);
+  assertEqual(eventLabel({ type: "usage_rollup", data: {} }), null);
+});
+
+test("reduceLiveStatus: usage rollups tracked for the usage card", () => {
+  const status = reduceLiveStatus(undefined, {
+    type: "usage_rollup",
+    data: { tenants: { "tenant-a": { chip_s: 1.5 } }, totals: { chip_s: 2 } },
+  });
+  assertEqual(status.usage.totals.chip_s, 2);
+  const next = reduceLiveStatus(status, { type: "hello", data: {} });
+  assertEqual(next.usage.totals.chip_s, 2, "rollup survives a hello frame");
 });
 
 test("eventLabel: incident captures render with trigger and key", () => {

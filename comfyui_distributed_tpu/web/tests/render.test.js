@@ -13,6 +13,7 @@ import {
   pipelineHtml,
   schedulerHtml,
   topologyHtml,
+  usageHtml,
   valueNodeHtml,
   vocabBannerHtml,
   workerCardHtml,
@@ -266,6 +267,35 @@ test("fleetHtml: disabled / rollup + workers / alert strip", () => {
   const burning = fleetHtml(fleet, { active: ["tile_latency"] });
   assertIncludes(burning, "ALERT");
   assertIncludes(burning, "tile_latency");
+});
+
+test("usageHtml: disabled / tenant rows / waste breakdown", () => {
+  assertIncludes(usageHtml(null), "unavailable");
+  assertIncludes(usageHtml({ enabled: false }), "CDT_USAGE=1");
+  const usage = {
+    enabled: true,
+    rollup: {
+      tenants: {
+        "tenant-a": { chip_s: 3.5, chip_share: 0.7, tiles: 12, waste_s: 0.2 },
+        "tenant-b": { chip_s: 1.5, chip_share: 0.3, tiles: 4, waste_s: 0 },
+      },
+      totals: {
+        chip_s: 5.0, attributed_s: 4.4, dispatches: 20, waste_share: 0.12,
+        waste_s: { padding: 0.4, preempt_recompute: 0.2 },
+      },
+    },
+  };
+  const html = usageHtml(usage);
+  assertIncludes(html, "chips burned <b>5.00s</b>");
+  assertIncludes(html, "tenant-a");
+  assertIncludes(html, "3.50 chip-s");
+  assertIncludes(html, "(70.0%)");
+  assertIncludes(html, "12 tile(s)");
+  assertIncludes(html, "padding 0.40s");
+  assertIncludes(html, "preempt_recompute 0.20s");
+  // a pushed usage_rollup event IS the rollup (no wrapper): same card
+  const pushed = usageHtml(usage.rollup);
+  assertIncludes(pushed, "tenant-b");
 });
 
 test("incidentsHtml: disabled / flight accounting / bundle rows", () => {

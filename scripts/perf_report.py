@@ -231,6 +231,129 @@ def batch_fill_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def usage_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Chip-second attribution from the per-dispatch spans both
+    execution tiers emit (``tile.dispatch`` with ``real``/``bucket``
+    slot counts plus ``slot_jobs``/``slot_tenants`` breakdowns —
+    graph/batch_executor.py and graph/tile_pipeline.py): each span's
+    wall splits evenly across its bucket slots exactly like the live
+    usage meter, so per-tenant/per-job shares and the waste share
+    (padding + recompute slots) are reconstructable offline from a
+    trace alone. ``recompute`` slots stay inside their job's slot count
+    (the job caused the re-run) but count toward waste. None when no
+    dispatch spans are present."""
+    per_job: dict[str, float] = {}
+    per_tenant: dict[str, float] = {}
+    total = 0.0
+    waste = 0.0
+    dispatches = 0
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        if attrs.get("stage") != "dispatch":
+            continue
+        duration = span.get("duration")
+        if duration is None:
+            continue
+        try:
+            bucket = int(attrs.get("bucket", 0))
+            real = int(attrs.get("real", 0) or 0)
+            recompute = int(attrs.get("recompute", 0) or 0)
+        except (TypeError, ValueError):
+            continue
+        if bucket <= 0:
+            continue
+        dispatches += 1
+        share = float(duration) / bucket
+        total += float(duration)
+        waste += share * (max(0, bucket - real) + max(0, recompute))
+        for job, n in (attrs.get("slot_jobs") or {}).items():
+            try:
+                per_job[str(job)] = per_job.get(str(job), 0.0) + share * int(n)
+            except (TypeError, ValueError):
+                continue
+        for tenant, n in (attrs.get("slot_tenants") or {}).items():
+            try:
+                per_tenant[str(tenant)] = (
+                    per_tenant.get(str(tenant), 0.0) + share * int(n)
+                )
+            except (TypeError, ValueError):
+                continue
+    if dispatches == 0 or total <= 0:
+        return None
+    return {
+        "dispatches": dispatches,
+        "total_s": total,
+        "waste_s": waste,
+        "waste_share": waste / total,
+        "tenants": {
+            t: {"chip_s": s, "share": s / total}
+            for t, s in sorted(per_tenant.items())
+        },
+        "jobs": {
+            j: {"chip_s": s, "share": s / total}
+            for j, s in sorted(per_job.items())
+        },
+    }
+
+
+def usage_regressions(
+    old_usage: dict[str, Any] | None,
+    new_usage: dict[str, Any] | None,
+    regress_pct: float,
+) -> list[dict[str, Any]]:
+    """The --usage gate: waste share (padding + recompute fraction of
+    dispatch chip time) growing by more than `regress_pct` percent
+    relative fails --compare — device slots went back to burning
+    wraparound padding or redundant recompute. Old waste below 1% is
+    gated on absolute growth of more than one percentage point instead
+    (relative growth on a near-zero base is noise — 0.99% -> 1.01%
+    must pass, 0% -> 3% must fail)."""
+    if not old_usage or not new_usage:
+        return []
+    old_share = old_usage["waste_share"]
+    new_share = new_usage["waste_share"]
+    if old_share < 0.01:
+        if new_share - old_share <= 0.01:
+            return []
+        delta_pct = (new_share - old_share) * 100.0  # absolute points
+    else:
+        delta_pct = (new_share / old_share - 1.0) * 100.0
+        if delta_pct <= regress_pct:
+            return []
+    return [
+        {
+            "stage": "usage_waste_share",
+            # shares, not seconds — old_p95/new_p95 keep the comparison
+            # machinery uniform (the critical_path convention)
+            "old_p95": old_share,
+            "new_p95": new_share,
+            "old_share": old_share,
+            "new_share": new_share,
+            "delta_pct": delta_pct,
+        }
+    ]
+
+
+def render_usage(usage: dict[str, Any]) -> str:
+    lines = [
+        "usage (chip-second attribution across "
+        f"{usage['dispatches']} dispatch(es)): "
+        f"{usage['total_s']:.4f}s total, waste share "
+        f"{usage['waste_share'] * 100:.1f}%"
+    ]
+    for tenant, stats in usage["tenants"].items():
+        lines.append(
+            f"  tenant {tenant:24} {stats['chip_s']:>10.4f}s "
+            f"({stats['share'] * 100:5.1f}%)"
+        )
+    for job, stats in usage["jobs"].items():
+        lines.append(
+            f"  job    {job:24} {stats['chip_s']:>10.4f}s "
+            f"({stats['share'] * 100:5.1f}%)"
+        )
+    return "\n".join(lines)
+
+
 def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
     """Aggregate span durations per name → latency stats."""
     by_name: dict[str, list[float]] = {}
@@ -406,6 +529,13 @@ def render_comparison(
             lines.append(
                 f"  {item['stage']:28} fill {item['old_p95']:.3f} -> "
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
+        if item["stage"] == "usage_waste_share":
+            # waste SHARES (unitless fractions of dispatch chip time)
+            lines.append(
+                f"  {item['stage']:28} share {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (+{item['delta_pct']:.1f}%)"
             )
             continue
         if item["stage"].startswith("critical_path:"):
@@ -644,6 +774,14 @@ def main(argv: list[str] | None = None) -> int:
         "aggregate stage-share regressions join the exit-3 gate",
     )
     parser.add_argument(
+        "--usage",
+        action="store_true",
+        help="chip-second attribution from tile.dispatch spans: "
+        "per-tenant chip-second shares, per-job shares, and the waste "
+        "share (padding + recompute slots); with --compare, waste-share "
+        "growth beyond --regress-pct joins the exit-3 gate",
+    )
+    parser.add_argument(
         "--slo",
         action="append",
         default=[],
@@ -675,6 +813,7 @@ def main(argv: list[str] | None = None) -> int:
     problems = incomplete_tiles(tiles)
 
     critical = critical_path_report(spans) if args.critical_path else None
+    usage = usage_stats(spans) if args.usage else None
 
     regressions = None
     if args.compare:
@@ -693,6 +832,12 @@ def main(argv: list[str] | None = None) -> int:
                     args.regress_pct,
                 )
             )
+        if args.usage:
+            regressions.extend(
+                usage_regressions(
+                    usage_stats(old_spans), usage, args.regress_pct
+                )
+            )
 
     violations = slo_violations(report, slo_budgets) if slo_budgets else None
 
@@ -704,6 +849,8 @@ def main(argv: list[str] | None = None) -> int:
         }
         if critical is not None:
             payload["critical_path"] = critical
+        if usage is not None:
+            payload["usage"] = usage
         if regressions is not None:
             payload["regressions"] = regressions
         if violations is not None:
@@ -714,6 +861,9 @@ def main(argv: list[str] | None = None) -> int:
         if critical is not None:
             print()
             print(render_critical_path(critical))
+        if usage is not None:
+            print()
+            print(render_usage(usage))
         if regressions is not None:
             print()
             print(render_comparison(regressions, args.regress_pct))

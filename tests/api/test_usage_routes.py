@@ -1,0 +1,287 @@
+"""GET /distributed/usage over real HTTP: worker usage blocks riding
+the v2 telemetry piggyback, per-tenant attribution resolved through
+the store's job attrs, windowed history, the scrape-counter mirror,
+and the disabled path."""
+
+import asyncio
+import json
+import socket
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.telemetry.fleet import SNAPSHOT_VERSION
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+pytestmark = pytest.mark.fast
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _get_json(url: str, timeout=10):
+    status, body = _get(url, timeout)
+    return status, json.loads(body)
+
+
+def _post_json(url: str, payload: dict, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+WORKER_USAGE = {
+    "jobs": {
+        "job-u": {"chip_s": 3.0, "steps": 60, "tiles": 12, "waste_s": 0.25}
+    },
+    "waste_s": {"padding": 0.5, "preempt_recompute": 0.25},
+    "dispatch_chip_s": 3.75,
+    "attributed_chip_s": 3.0,
+    "overhead_s": 0.0,
+    "dispatches": 20,
+}
+
+
+@pytest.fixture()
+def server(tmp_config_path):
+    loop_thread = ServerLoopThread()
+    loop_thread.start()
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop_thread.loop).result(
+        timeout=30
+    )
+    yield srv, port, loop_thread
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop_thread.loop).result(
+        timeout=30
+    )
+    loop_thread.stop()
+
+
+def _init_job(srv, loop_thread, job_id="job-u", tenant="tenant-u",
+              lane="batch"):
+    async def make_job():
+        await srv.job_store.init_tile_job(
+            job_id, [0, 1], tenant=tenant, lane=lane
+        )
+
+    asyncio.run_coroutine_threadsafe(make_job(), loop_thread.loop).result(
+        timeout=10
+    )
+
+
+def test_heartbeat_usage_block_lands_on_usage_route(server):
+    srv, port, loop_thread = server
+    _init_job(srv, loop_thread)
+    status, _ = _post_json(
+        f"http://127.0.0.1:{port}/distributed/heartbeat",
+        {
+            "job_id": "job-u",
+            "worker_id": "w-usage",
+            "telemetry": {
+                "v": SNAPSHOT_VERSION,
+                "tiles_total": 12,
+                "usage": WORKER_USAGE,
+            },
+        },
+    )
+    assert status == 200
+    status, body = _get_json(f"http://127.0.0.1:{port}/distributed/usage")
+    assert status == 200 and body["enabled"] is True
+    rollup = body["rollup"]
+    # the store's init attrs resolve the adopted job to its tenant/lane
+    tenant = rollup["tenants"]["tenant-u"]
+    assert tenant["chip_s"] == pytest.approx(3.0)
+    assert tenant["tiles"] == 12
+    assert rollup["jobs"]["job-u"]["lane"] == "batch"
+    assert rollup["totals"]["waste_s"]["padding"] == pytest.approx(0.5)
+    assert rollup["totals"]["waste_s"]["preempt_recompute"] == (
+        pytest.approx(0.25)
+    )
+    # the conservation surface reports the exact ns identity
+    assert body["conservation"]["conserved"] is True
+    # cost model present (cold until a sample pass has deltas)
+    assert "cost_model" in body
+
+    # ?tenant= scopes the drill-down
+    status, scoped = _get_json(
+        f"http://127.0.0.1:{port}/distributed/usage?tenant=tenant-u"
+    )
+    assert status == 200
+    assert list(scoped["rollup"]["tenants"]) == ["tenant-u"]
+    status, other = _get_json(
+        f"http://127.0.0.1:{port}/distributed/usage?tenant=nobody"
+    )
+    assert other["rollup"]["tenants"] == {}
+
+    # ?since= serves windowed history once a sample pass retained it
+    srv.fleet.step()
+    status, windowed = _get_json(
+        f"http://127.0.0.1:{port}/distributed/usage?since=600"
+    )
+    assert status == 200
+    assert windowed["since_seconds"] == 600.0
+    tenants_hist = windowed["history"]["tenants"]
+    assert "tenant-u" in tenants_hist
+    assert tenants_hist["tenant-u"]["usage_tenant_chip_s"], tenants_hist
+    assert "padding" in windowed["history"]["waste"]
+
+
+def test_usage_since_validation(server):
+    _, port, _ = server
+    for bad in ("abc", "-1", "inf", "nan"):
+        try:
+            status, _ = _get_json(
+                f"http://127.0.0.1:{port}/distributed/usage?since={bad}"
+            )
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+        assert status == 400, bad
+
+
+def test_usage_scrape_counters_mirror_rollup(server):
+    srv, port, loop_thread = server
+    _init_job(srv, loop_thread, job_id="job-m", tenant="tenant-m",
+              lane="premium")
+    _post_json(
+        f"http://127.0.0.1:{port}/distributed/heartbeat",
+        {
+            "job_id": "job-m",
+            "worker_id": "w-m",
+            "telemetry": {
+                "v": SNAPSHOT_VERSION,
+                "tiles_total": 12,
+                "usage": {
+                    **WORKER_USAGE,
+                    "jobs": {"job-m": WORKER_USAGE["jobs"]["job-u"]},
+                },
+            },
+        },
+    )
+    status, text = _get(f"http://127.0.0.1:{port}/distributed/metrics")
+    assert status == 200
+    assert (
+        'cdt_usage_chip_seconds_total{lane="premium",tenant="tenant-m"}'
+        in text
+        or 'cdt_usage_chip_seconds_total{tenant="tenant-m",lane="premium"}'
+        in text
+    ), text[text.find("cdt_usage"):][:400]
+    assert 'cdt_usage_waste_seconds_total{reason="padding"}' in text
+    assert "cdt_usage_tiles_total" in text
+    # the delta mirror never double-counts: a second scrape with no new
+    # usage must not grow the counter
+    first = [
+        line for line in text.splitlines()
+        if line.startswith("cdt_usage_chip_seconds_total{")
+    ]
+    _, text2 = _get(f"http://127.0.0.1:{port}/distributed/metrics")
+    second = [
+        line for line in text2.splitlines()
+        if line.startswith("cdt_usage_chip_seconds_total{")
+    ]
+    assert first == second
+
+
+def test_usage_rollup_event_rides_fleet_step(server):
+    srv, port, loop_thread = server
+    _init_job(srv, loop_thread, job_id="job-e", tenant="tenant-e")
+    _post_json(
+        f"http://127.0.0.1:{port}/distributed/heartbeat",
+        {
+            "job_id": "job-e",
+            "worker_id": "w-e",
+            "telemetry": {
+                "v": SNAPSHOT_VERSION,
+                "tiles_total": 1,
+                "usage": {
+                    **WORKER_USAGE,
+                    "jobs": {"job-e": WORKER_USAGE["jobs"]["job-u"]},
+                },
+            },
+        },
+    )
+    from comfyui_distributed_tpu.telemetry.events import get_event_bus
+
+    seen: list[dict] = []
+    bus = get_event_bus()
+    remove = bus.add_tap(
+        lambda event: seen.append(event)
+        if event.get("type") == "usage_rollup" else None,
+        name="usage-test",
+    )
+    try:
+        srv.fleet.step()
+    finally:
+        remove()
+    assert seen, "fleet step must publish a usage_rollup event"
+    data = seen[-1]["data"]
+    assert "tenant-e" in data["tenants"]
+    assert data["totals"]["chip_s"] > 0
+
+
+def test_usage_disabled_answers_enabled_false(monkeypatch, tmp_config_path):
+    monkeypatch.setenv("CDT_FLEET", "0")
+    import importlib
+
+    from comfyui_distributed_tpu.utils import constants
+
+    importlib.reload(constants)
+    try:
+        srv = DistributedServer(port=_free_port(), is_worker=False)
+        assert srv.fleet is None
+        from comfyui_distributed_tpu.api.telemetry_routes import (
+            TelemetryRoutes,
+        )
+
+        routes = TelemetryRoutes(srv)
+        request = types.SimpleNamespace(query={})
+        body = json.loads(
+            asyncio.run(routes.usage(request)).body.decode()
+        )
+        assert body["enabled"] is False
+        assert "CDT_USAGE" in body["hint"]
+    finally:
+        monkeypatch.delenv("CDT_FLEET")
+        importlib.reload(constants)
+
+
+def test_usage_off_knob_disables_aggregator(monkeypatch, tmp_config_path):
+    monkeypatch.setenv("CDT_USAGE", "0")
+    import importlib
+
+    from comfyui_distributed_tpu.utils import constants
+
+    importlib.reload(constants)
+    try:
+        from comfyui_distributed_tpu.telemetry.fleet import FleetRegistry
+
+        registry = FleetRegistry()
+        assert registry.usage is None
+        srv = types.SimpleNamespace(fleet=registry)
+        from comfyui_distributed_tpu.api.telemetry_routes import (
+            TelemetryRoutes,
+        )
+
+        routes = TelemetryRoutes(srv)
+        request = types.SimpleNamespace(query={})
+        body = json.loads(
+            asyncio.run(routes.usage(request)).body.decode()
+        )
+        assert body["enabled"] is False
+    finally:
+        monkeypatch.delenv("CDT_USAGE")
+        importlib.reload(constants)

@@ -99,6 +99,9 @@ def _reset_resilience_state():
     telemetry.reset_tracer()
     telemetry.reset_flight_recorder()
     telemetry.reset_event_bus()
+    from comfyui_distributed_tpu.telemetry import usage as usage_mod
+
+    usage_mod._reset_usage_meter_for_tests()
 
 
 @pytest.fixture()

@@ -158,3 +158,38 @@ def test_preemption_instruments_count():
     assert preempt_resume_total().value(mode="checkpoint") == before_ck + 5
     # the fill gauge carries the most recent dispatch's ratio
     assert 0.0 < batch_fill_ratio().value(role="worker") <= 1.0
+
+
+# --------------------------------------------------------------------------
+# chip-time attribution (usage-metering PR acceptance)
+# --------------------------------------------------------------------------
+
+
+def test_mixed_tenant_usage_attribution_conserves_and_splits():
+    """The usage plane's acceptance bar on the xjob tier: a
+    mixed-tenant run attributes nonzero chip-seconds to EVERY tenant,
+    the conservation identity holds exactly (integer ns), and metering
+    never touches numerics (canvas bit-identical to solo)."""
+    mixed = run_chaos_xjob(seed=3, jobs=FLEET)
+    totals = mixed.usage["totals"]
+    assert totals["conserved"] is True
+    assert (
+        totals["attributed_ns"]
+        + totals["dispatch_waste_ns"]
+        + totals["overhead_ns"]
+        == totals["dispatch_chip_ns"]
+    )
+    tenants = mixed.usage["rollup"]["tenants"]
+    assert tenants["tenant-a"]["chip_s"] > 0
+    assert tenants["tenant-b"]["chip_s"] > 0
+    assert tenants["tenant-a"]["tiles"] == 6
+    assert tenants["tenant-b"]["tiles"] == 6
+    # shares are a partition of attributed time
+    assert (
+        tenants["tenant-a"]["chip_s"] + tenants["tenant-b"]["chip_s"]
+    ) == pytest.approx(totals["attributed_ns"] / 1e9)
+    spec = FLEET[0]
+    solo = _solo(spec)
+    np.testing.assert_array_equal(
+        solo.canvases[spec["job_id"]], mixed.canvases[spec["job_id"]]
+    )

@@ -464,3 +464,109 @@ def test_cli_fails_on_missing_or_empty_input(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode != 0
+
+
+# --------------------------------------------------------------------------
+# --usage: chip-second attribution from dispatch spans
+# --------------------------------------------------------------------------
+
+
+def _dispatch_span(duration, real, bucket, jobs=None, tenants=None,
+                   recompute=0, start=0.0):
+    return {
+        "trace_id": "t", "span_id": f"d{start}", "name": "tile.dispatch",
+        "start": start, "duration": duration,
+        "attrs": {
+            "stage": "dispatch", "role": "worker", "real": real,
+            "bucket": bucket, "jobs": len(jobs or {"j": real}),
+            "slot_jobs": jobs or {"j": real},
+            "slot_tenants": tenants or {},
+            "recompute": recompute,
+        },
+    }
+
+
+def test_usage_stats_splits_span_wall_across_slots():
+    spans = [
+        # 1.0s over 4 slots: 3 real (2 t-a, 1 t-b) + 1 padding
+        _dispatch_span(1.0, 3, 4, jobs={"ja": 2, "jb": 1},
+                       tenants={"t-a": 2, "t-b": 1}),
+        # 0.5s fully real, one recompute slot counted as waste
+        _dispatch_span(0.5, 2, 2, jobs={"ja": 2}, tenants={"t-a": 2},
+                       recompute=1, start=2.0),
+    ]
+    usage = perf_report.usage_stats(spans)
+    assert usage["dispatches"] == 2
+    assert usage["total_s"] == pytest.approx(1.5)
+    # waste: 1 padding slot x 0.25 + 1 recompute slot x 0.25
+    assert usage["waste_s"] == pytest.approx(0.5)
+    assert usage["waste_share"] == pytest.approx(0.5 / 1.5)
+    assert usage["tenants"]["t-a"]["chip_s"] == pytest.approx(
+        2 * 0.25 + 2 * 0.25
+    )
+    assert usage["tenants"]["t-b"]["chip_s"] == pytest.approx(0.25)
+    assert usage["jobs"]["jb"]["share"] == pytest.approx(0.25 / 1.5)
+    # no dispatch spans -> None (a scan trace predating the column)
+    assert perf_report.usage_stats([{"name": "tile.sample"}]) is None
+
+
+def test_usage_waste_share_growth_rides_the_compare_gate(tmp_path):
+    old = [_dispatch_span(1.0, 4, 4)]  # no waste
+    new = [_dispatch_span(1.0, 2, 4)]  # 50% padding
+    regressions = perf_report.usage_regressions(
+        perf_report.usage_stats(old), perf_report.usage_stats(new), 25.0
+    )
+    assert regressions and regressions[0]["stage"] == "usage_waste_share"
+    assert regressions[0]["new_share"] == pytest.approx(0.5)
+    # unchanged waste passes
+    assert not perf_report.usage_regressions(
+        perf_report.usage_stats(new), perf_report.usage_stats(new), 25.0
+    )
+    rendered = perf_report.render_comparison(regressions, 25.0)
+    assert "usage_waste_share" in rendered and "share" in rendered
+    # CLI round trip: exit 3 on the waste growth, 0 against itself
+    old_path, new_path = tmp_path / "old.jsonl", tmp_path / "new.jsonl"
+    old_path.write_text("\n".join(json.dumps(s) for s in old))
+    new_path.write_text("\n".join(json.dumps(s) for s in new))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            str(new_path), "--usage", "--compare", str(old_path),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "usage_waste_share" in proc.stdout
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+            str(new_path), "--usage", "--compare", str(new_path), "--json",
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode in (0, 2), proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["usage"]["waste_share"] == pytest.approx(0.5)
+
+
+def test_scan_tier_chaos_trace_carries_dispatch_spans(tmp_path):
+    """Both tiers emit tile.dispatch now: a scan-tier chaos trace must
+    feed the --usage column (job attribution via slot_jobs)."""
+    trace = tmp_path / "scan.jsonl"
+    run_chaos_usdu(seed=5, tile_batch=2, trace_jsonl=str(trace))
+    spans = perf_report.load_spans(str(trace))
+    usage = perf_report.usage_stats(spans)
+    assert usage is not None and usage["dispatches"] > 0
+    assert "chaos-job" in usage["jobs"]
+
+
+def test_usage_waste_gate_tolerates_near_zero_noise():
+    """0.99% -> 1.01% is jitter, not a regression; 0% -> 3% fails on
+    absolute growth past one point."""
+    base = perf_report.usage_stats([_dispatch_span(1.0, 4, 4)])
+    noisy_old = dict(base, waste_share=0.0099)
+    noisy_new = dict(base, waste_share=0.0101)
+    assert not perf_report.usage_regressions(noisy_old, noisy_new, 25.0)
+    grown = dict(base, waste_share=0.03)
+    hits = perf_report.usage_regressions(noisy_old, grown, 25.0)
+    assert hits and hits[0]["stage"] == "usage_waste_share"

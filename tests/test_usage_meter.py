@@ -1,0 +1,625 @@
+"""Usage metering & chip-time attribution (telemetry/usage.py).
+
+Pins the plane's load-bearing properties:
+
+- the conservation identity is EXACT (integer ns) per record and
+  cumulatively, on BOTH execution tiers, jitted and eager-stub;
+- slot classification: padding and recompute slots charge waste
+  buckets, real slots charge their owning (job → tenant, lane);
+- store-family waste (speculation losers, poison retries) lands in its
+  buckets without touching the dispatch identity;
+- worker-snapshot adoption is delta-based with a counter-reset clamp
+  (a restarted worker can never produce a negative delta);
+- idle jobs/tenants evict (flat memory under churn) and fire the
+  series-eviction seam;
+- the measured cost model (chip-s-per-tile EWMA ratio) feeds DRR
+  admission cost behind CDT_USAGE_COST;
+- rollups are replay-stable (byte-identical for the same record
+  sequence — the CDT004 scope's point).
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.telemetry.usage import (
+    SLOT_PADDING,
+    SLOT_REAL,
+    SLOT_RECOMPUTE,
+    UsageAggregator,
+    UsageMeter,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def _dispatch(meter, *, role="worker", elapsed=0.0101, chips=1, slots=None):
+    return meter.note_dispatch(
+        tier="xjob", role=role, elapsed_s=elapsed, chips=chips,
+        slots=slots or [{"job_id": "j", "kind": SLOT_REAL}],
+    )
+
+
+# --------------------------------------------------------------------------
+# conservation: exact, per record and cumulative
+# --------------------------------------------------------------------------
+
+
+def test_record_conservation_exact_with_integer_remainder():
+    meter = UsageMeter()
+    # 0.0101 s x 3 chips = 30_300_000 ns over 7 slots -> remainder 5 ns
+    slots = (
+        [{"job_id": "a", "kind": SLOT_REAL}] * 3
+        + [{"job_id": "b", "kind": SLOT_RECOMPUTE}] * 2
+        + [{"job_id": "", "kind": SLOT_PADDING}] * 2
+    )
+    rec = _dispatch(meter, elapsed=0.0101, chips=3, slots=slots)
+    assert rec["chip_ns"] == 30_300_000
+    assert (
+        rec["attributed_ns"] + rec["waste_ns"] + rec["overhead_ns"]
+        == rec["chip_ns"]
+    )
+    assert rec["overhead_ns"] == 30_300_000 - (30_300_000 // 7) * 7
+    totals = meter.totals()
+    assert totals["conserved"] is True
+    assert totals["waste_ns"]["padding"] == 2 * (30_300_000 // 7)
+    assert totals["waste_ns"]["preempt_recompute"] == 2 * (30_300_000 // 7)
+
+
+def test_cumulative_conservation_over_many_records():
+    meter = UsageMeter()
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        n_real = int(rng.integers(1, 5))
+        n_pad = int(rng.integers(0, 3))
+        n_rec = int(rng.integers(0, 2))
+        slots = (
+            [{"job_id": f"j{i % 7}", "kind": SLOT_REAL}] * n_real
+            + [{"job_id": f"j{i % 7}", "kind": SLOT_RECOMPUTE}] * n_rec
+            + [{"job_id": "", "kind": SLOT_PADDING}] * n_pad
+        )
+        _dispatch(
+            meter, elapsed=float(rng.random()) * 0.01,
+            chips=int(rng.integers(1, 5)), slots=slots,
+        )
+    totals = meter.totals()
+    assert totals["conserved"] is True
+    # the identity the CI smoke also pins, spelled out:
+    assert (
+        totals["attributed_ns"]
+        + totals["dispatch_waste_ns"]
+        + totals["overhead_ns"]
+        == totals["dispatch_chip_ns"]
+    )
+
+
+def test_store_family_waste_outside_dispatch_identity():
+    meter = UsageMeter()
+    _dispatch(meter, role="master")
+    meter.note_waste("master", "speculation", 0.5, job_id="j")
+    meter.note_waste("master", "poison_retry", 0.25)
+    totals = meter.totals()
+    assert totals["conserved"] is True  # dispatch family untouched
+    assert totals["waste_ns"]["speculation"] == 500_000_000
+    assert totals["waste_ns"]["poison_retry"] == 250_000_000
+    assert totals["dispatch_waste_ns"] == 0
+
+
+# --------------------------------------------------------------------------
+# tier conservation: scan (GrantSampler) and xjob (CrossJobExecutor),
+# jitted and eager-stub
+# --------------------------------------------------------------------------
+
+
+def _stub(params, tile, key, pos, neg, yx):
+    return tile * 2.0 + 1.0
+
+
+@pytest.mark.parametrize("jit", [False, True], ids=["eager", "jitted"])
+def test_scan_tier_conservation_and_padding(jit):
+    from comfyui_distributed_tpu.graph.tile_pipeline import GrantSampler
+
+    meter = UsageMeter()
+    process = jax.jit(_stub) if jit else _stub
+    sampler = GrantSampler(
+        process, None, jnp.ones((3, 4, 4, 3), jnp.float32),
+        jax.random.key(0), jnp.zeros((3, 2), jnp.int32), None, None,
+        k_max=4, job_id="scan-job", tenant="tenant-s", usage_meter=meter,
+    )
+    out = sampler.sample([0, 1, 2])  # ragged: pads to the 4-bucket
+    assert out.shape[0] == 3
+    totals = meter.totals()
+    assert totals["conserved"] is True
+    assert totals["dispatch_chip_ns"] > 0
+    assert totals["waste_ns"]["padding"] > 0
+    snap = meter.snapshot("worker")
+    assert snap["jobs"]["scan-job"]["tiles"] == 3
+    # serial (k_max=1) reference path meters too, without padding
+    serial_meter = UsageMeter()
+    serial = GrantSampler(
+        process, None, jnp.ones((3, 4, 4, 3), jnp.float32),
+        jax.random.key(0), jnp.zeros((3, 2), jnp.int32), None, None,
+        k_max=1, job_id="scan-job", usage_meter=serial_meter,
+    )
+    serial.sample([0, 1])
+    serial_totals = serial_meter.totals()
+    assert serial_totals["conserved"] is True
+    assert "padding" not in serial_totals["waste_ns"]
+
+
+@pytest.mark.parametrize("jit", [False, True], ids=["eager", "jitted"])
+def test_xjob_tier_conservation_and_attribution(jit):
+    from comfyui_distributed_tpu.graph.batch_executor import (
+        CrossJobExecutor,
+        XJobHandle,
+    )
+    from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+
+    def init(params, tile, key):
+        return tile + 0.0
+
+    def step(params, x, key, pos, neg, yx, i):
+        return x + 0.25
+
+    def finish(params, x):
+        return x
+
+    proc = types.SimpleNamespace(
+        init=init, step=jax.jit(step) if jit else step, finish=finish,
+        n_steps=2, signature=("usage-stub",),
+    )
+    meter = UsageMeter()
+    executor = CrossJobExecutor(k_max=8, usage_meter=meter)
+    outs: dict[str, dict] = {}
+    for job_id, tenant in (("uj-a", "tenant-a"), ("uj-b", "tenant-b")):
+        pending = [list(range(3))]  # one 3-tile grant, then drained
+
+        def pull(pending=pending):
+            if pending:
+                return {"tile_idxs": pending.pop(), "checkpoints": {}}
+            return None
+
+        outs[job_id] = {}
+
+        def emit(idx, arr, sink=outs[job_id]):
+            sink[int(idx)] = np.asarray(arr)
+
+        executor.register(
+            XJobHandle(
+                job_id=job_id,
+                proc=proc,
+                params=None,
+                extracted=jnp.ones((3, 4, 4, 3), jnp.float32),
+                positions=jnp.zeros((3, 2), jnp.int32),
+                pos=jnp.float32(0),
+                neg=jnp.float32(0),
+                base_key=fold_job_key(jax.random.key(1), job_id),
+                pull=pull,
+                emit=emit,
+                flush=lambda final: None,
+                tenant=tenant,
+                lane="batch",
+            )
+        )
+    executor.run()
+    assert all(len(v) == 3 for v in outs.values())
+    totals = meter.totals()
+    assert totals["conserved"] is True
+    assert totals["dispatch_chip_ns"] > 0
+    rollup = meter.rollup()
+    # both tenants charged; the 6-tile cross-job batches pad to 8
+    assert rollup["tenants"]["tenant-a"]["chip_s"] > 0
+    assert rollup["tenants"]["tenant-b"]["chip_s"] > 0
+    assert rollup["tenants"]["tenant-a"]["tiles"] == 3
+    assert totals["waste_ns"].get("padding", 0) > 0
+    assert rollup["lanes"]["batch"]["tiles"] == 6
+
+
+def test_xjob_recompute_slots_charge_waste_not_tenant():
+    """A tile evicted at step S and re-adopted WITHOUT a checkpoint
+    re-runs steps < S as waste{preempt_recompute}; its remaining steps
+    charge the tenant."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_xjob
+
+    spec = {
+        "job_id": "u-batch", "seed": 7, "tenant": "tenant-a",
+        "lane": "batch", "image_hw": (32, 160),
+    }
+    premium = {
+        "job_id": "u-prem", "seed": 99, "tenant": "tenant-p",
+        "image_hw": (32, 64), "after_dispatches": 2,
+    }
+    r = run_chaos_xjob(
+        seed=7, jobs=[spec], steps=5, premium=premium,
+        drop_checkpoints=True,
+    )
+    assert r.resumes_recompute > 0
+    totals = r.usage["totals"]
+    assert totals["conserved"] is True
+    assert totals["waste_ns"].get("preempt_recompute", 0) > 0
+    # checkpoint resume re-runs nothing: no recompute waste
+    ck = run_chaos_xjob(seed=7, jobs=[dict(spec)], steps=5,
+                        premium=dict(premium))
+    assert ck.resumes_checkpoint > 0
+    assert ck.usage["totals"]["waste_ns"].get("preempt_recompute", 0) == 0
+
+
+# --------------------------------------------------------------------------
+# replay stability (the CDT004 scope's point)
+# --------------------------------------------------------------------------
+
+
+def test_rollup_replay_stable_for_same_record_sequence():
+    def feed(meter):
+        meter.note_job_attrs("j1", "t-b", "batch")
+        meter.note_job_attrs("j2", "t-a", "premium")
+        for chips in (1, 2, 4):
+            _dispatch(
+                meter, chips=chips, elapsed=0.003,
+                slots=[
+                    {"job_id": "j2", "kind": SLOT_REAL},
+                    {"job_id": "j1", "kind": SLOT_REAL},
+                    {"job_id": "", "kind": SLOT_PADDING},
+                ],
+            )
+        meter.note_tiles("worker", "j1", 2)
+        meter.note_waste("worker", "speculation", 0.01, job_id="j2")
+
+    a, b = UsageMeter(), UsageMeter()
+    feed(a)
+    feed(b)
+    assert json.dumps(a.rollup(), sort_keys=False) == json.dumps(
+        b.rollup(), sort_keys=False
+    )
+    assert json.dumps(a.snapshot("worker")) == json.dumps(
+        b.snapshot("worker")
+    )
+
+
+# --------------------------------------------------------------------------
+# adoption: delta merge + counter-reset clamp
+# --------------------------------------------------------------------------
+
+
+def _worker_snapshot(scale=1.0):
+    return {
+        "jobs": {
+            "wj": {
+                "chip_s": 2.0 * scale, "steps": 10 * scale,
+                "tiles": 4 * scale, "waste_s": 0.5 * scale,
+            }
+        },
+        "waste_s": {"padding": 0.5 * scale},
+        "dispatch_chip_s": 2.5 * scale,
+        "attributed_chip_s": 2.0 * scale,
+        "overhead_s": 0.0,
+        "dispatches": 5 * scale,
+    }
+
+
+def test_adoption_delta_and_counter_reset_clamp():
+    agg = UsageAggregator(meter=UsageMeter(), ttl=10_000)
+    assert agg.adopt("w1", _worker_snapshot(1.0))
+    assert agg.adopt("w1", _worker_snapshot(2.0))  # grew: delta = +1x
+    roll = agg.rollup()
+    assert roll["totals"]["chip_s"] == pytest.approx(5.0)
+    assert roll["jobs"]["wj"]["tiles"] == 8
+    # RESTART: totals collapse below the last seen value — the smaller
+    # snapshot adopts as a fresh baseline, never a negative delta
+    assert agg.adopt("w1", _worker_snapshot(0.5))
+    after_reset = agg.rollup()
+    assert after_reset["totals"]["chip_s"] == pytest.approx(5.0 + 1.25)
+    assert after_reset["jobs"]["wj"]["tiles"] == 8 + 2
+    for stats in after_reset["tenants"].values():
+        assert stats["chip_s"] >= 0
+    # and growth from the new baseline counts normally again
+    assert agg.adopt("w1", _worker_snapshot(1.0))
+    assert agg.rollup()["totals"]["chip_s"] == pytest.approx(5.0 + 2.5)
+
+
+def test_adoption_malformed_and_forget_worker():
+    agg = UsageAggregator(meter=UsageMeter(), ttl=10_000)
+    assert agg.adopt("w1", "not-a-dict") is False
+    assert agg.adopt("w1", {"jobs": "nope", "waste_s": None}) is True
+    assert agg.rollup()["totals"]["chip_s"] == 0.0
+    agg.adopt("w1", _worker_snapshot(1.0))
+    agg.forget_worker("w1")
+    # baselines dropped: the same cumulative snapshot re-adopts in full
+    # (a re-registered worker is a new counter lineage)
+    agg.adopt("w1", _worker_snapshot(1.0))
+    assert agg.rollup()["totals"]["chip_s"] == pytest.approx(5.0)
+
+
+def test_master_attrs_resolve_adopted_jobs():
+    meter = UsageMeter()
+    meter.note_job_attrs("wj", "tenant-x", "premium")
+    agg = UsageAggregator(meter=meter, ttl=10_000)
+    agg.adopt("w1", _worker_snapshot(1.0))
+    roll = agg.rollup()
+    assert roll["tenants"]["tenant-x"]["chip_s"] == pytest.approx(2.0)
+    assert roll["jobs"]["wj"]["lane"] == "premium"
+
+
+def test_role_separation_prevents_cohosted_double_count():
+    """A co-hosted worker's local records (role=worker) are excluded
+    from the aggregator's local contribution — they arrive through its
+    adopted snapshots instead, so one burn counts once."""
+    meter = UsageMeter()
+    _dispatch(meter, role="worker", elapsed=0.002)
+    _dispatch(meter, role="master", elapsed=0.004)
+    agg = UsageAggregator(meter=meter, ttl=10_000)
+    roll = agg.rollup()
+    assert roll["totals"]["chip_s"] == pytest.approx(0.004)
+    agg.adopt("w1", meter.snapshot("worker"))
+    assert agg.rollup()["totals"]["chip_s"] == pytest.approx(0.006)
+
+
+# --------------------------------------------------------------------------
+# eviction: flat memory under churn + the tenant series seam
+# --------------------------------------------------------------------------
+
+
+def test_tenant_churn_stays_bounded_and_fires_eviction_seam():
+    from comfyui_distributed_tpu.telemetry.timeseries import SeriesStore
+
+    clock = {"now": 1000.0}
+    store = SeriesStore(clock=lambda: clock["now"])
+    meter = UsageMeter(clock=lambda: clock["now"], max_keys=64)
+    agg = UsageAggregator(
+        meter=meter, store=store, clock=lambda: clock["now"], ttl=50.0,
+        max_keys=64,
+    )
+    evicted: list[str] = []
+    agg.on_evict_tenant = lambda tenant: (
+        evicted.append(tenant), store.evict_label("tenant", tenant),
+    )
+    # churn 4x the cap of one-job tenants through meter + adoption
+    for i in range(256):
+        tenant = f"churn-{i}"
+        job = f"cj-{i}"
+        meter.note_job_attrs(job, tenant, "batch")
+        _dispatch(
+            meter, role="master", elapsed=0.001,
+            slots=[{"job_id": job, "kind": SLOT_REAL}],
+        )
+        meter.note_tiles("master", job, 1)
+        agg.adopt(f"w-{i % 8}", {
+            "jobs": {job: {"chip_s": 0.1, "steps": 1, "tiles": 1,
+                           "waste_s": 0.0}},
+            "waste_s": {}, "dispatch_chip_s": 0.1,
+            "attributed_chip_s": 0.1, "overhead_s": 0.0, "dispatches": 1,
+        })
+        agg.sample()
+        clock["now"] += 60.0  # every entry idles past the 50 s TTL
+    # bounded key maps: live jobs/tenants never exceed the cap
+    assert len(meter._jobs.get("master", {})) <= 64
+    assert len(agg._adopted_jobs) <= 64
+    assert len(agg._cost) <= 66  # live window + default
+    assert evicted, "idle tenants must depart through the seam"
+    # departed tenants' series are evicted: the store stays bounded by
+    # the cardinality cap, not by churn volume
+    assert store.series_count() <= store.max_series * 3 + 8
+    # totals stay conserved through all the folding
+    assert meter.totals()["conserved"] is True
+
+
+def test_meter_sweep_folds_idle_jobs_into_retired():
+    clock = {"now": 0.0}
+    meter = UsageMeter(clock=lambda: clock["now"])
+    meter.note_job_attrs("old", "t", "batch")
+    _dispatch(meter, slots=[{"job_id": "old", "kind": SLOT_REAL}])
+    meter.note_tiles("worker", "old", 5)
+    clock["now"] = 100.0
+    assert meter.sweep(ttl_s=50.0) == ["old"]
+    roll = meter.rollup()
+    assert "old" not in roll["jobs"]
+    # retired counters fold under the tenant/lane resolved AT eviction
+    # time — the tenant view stays honest, not lumped into default
+    assert roll["tenants"]["t"]["tiles"] == 5
+    assert roll["lanes"]["batch"]["tiles"] == 5
+    assert meter.totals()["conserved"] is True
+
+
+def test_retired_fold_is_role_filtered():
+    """A swept WORKER-role job must not leak into a master-filtered
+    rollup — the role-separation rule survives eviction (a co-hosted
+    worker's burn counts once, through its adopted snapshots)."""
+    clock = {"now": 0.0}
+    meter = UsageMeter(clock=lambda: clock["now"])
+    meter.note_job_attrs("wj", "t-w", "")
+    _dispatch(meter, role="worker", elapsed=0.1,
+              slots=[{"job_id": "wj", "kind": SLOT_REAL}])
+    _dispatch(meter, role="master", elapsed=0.05,
+              slots=[{"job_id": "mj", "kind": SLOT_REAL}])
+    clock["now"] = 100.0
+    meter.sweep(ttl_s=50.0)
+    master_roll = meter.rollup(roles=("master",))
+    assert "t-w" not in master_roll["tenants"]
+    assert master_roll["totals"]["chip_s"] == pytest.approx(0.05)
+    # the all-roles view still carries the worker-role retired fold
+    assert meter.rollup()["tenants"]["t-w"]["chip_s"] == pytest.approx(0.1)
+
+
+def test_pair_totals_monotonic_across_eviction():
+    """The scrape mirror deltas against pair_totals: TTL-sweeping a
+    job must not shrink its (tenant, lane) pair."""
+    clock = {"now": 0.0}
+    meter = UsageMeter(clock=lambda: clock["now"])
+    agg = UsageAggregator(meter=meter, clock=lambda: clock["now"], ttl=50.0)
+    meter.note_job_attrs("pj", "t-p", "batch")
+    agg.adopt("w1", {
+        "jobs": {"pj": {"chip_s": 2.0, "steps": 10, "tiles": 4,
+                        "waste_s": 0.0}},
+        "waste_s": {}, "dispatch_chip_s": 2.0, "attributed_chip_s": 2.0,
+        "overhead_s": 0.0, "dispatches": 1,
+    })
+    before = agg.pair_totals()[("t-p", "batch")]
+    clock["now"] = 100.0
+    agg.sample()  # sweeps the idle adopted job into the retired fold
+    after = agg.pair_totals()[("t-p", "batch")]
+    assert after["chip_s"] == pytest.approx(before["chip_s"])
+    assert after["tiles"] == before["tiles"]
+    # and the tenant rollup keeps the eviction-time resolution too
+    assert agg.rollup()["tenants"]["t-p"]["chip_s"] == pytest.approx(2.0)
+
+
+def test_worker_prev_baselines_pruned_with_job_churn():
+    """The reset-clamp baseline map must track the worker's OWN
+    (bounded) meter, not every job id it ever served."""
+    agg = UsageAggregator(meter=UsageMeter(), ttl=10_000)
+    for i in range(300):
+        agg.adopt("w1", {
+            "jobs": {f"churn-{i}": {"chip_s": 1.0, "steps": 1,
+                                    "tiles": 1, "waste_s": 0.0}},
+            "waste_s": {}, "dispatch_chip_s": 1.0,
+            "attributed_chip_s": 1.0, "overhead_s": 0.0, "dispatches": 1,
+        })
+    job_paths = [
+        p for p in agg._worker_prev["w1"] if p.startswith("job:")
+    ]
+    # only the latest snapshot's job survives (4 paths per job)
+    assert len(job_paths) == 4, job_paths
+
+
+# --------------------------------------------------------------------------
+# the measured cost model + the DRR admission hook
+# --------------------------------------------------------------------------
+
+
+def _feed_cost(agg, meter, tenant, job, chip_s, tiles):
+    meter.note_job_attrs(job, tenant, "batch")
+    _dispatch(
+        meter, role="master", elapsed=chip_s,
+        slots=[{"job_id": job, "kind": SLOT_REAL}],
+    )
+    meter.note_tiles("master", job, tiles)
+
+
+def test_cost_ratio_ewma_heavy_vs_light_tenant():
+    meter = UsageMeter()
+    agg = UsageAggregator(meter=meter, ttl=10_000)
+    assert agg.cost_ratio("anyone") == 1.0  # cold model
+    _feed_cost(agg, meter, "heavy", "jh", chip_s=0.9, tiles=1)
+    _feed_cost(agg, meter, "light", "jl", chip_s=0.1, tiles=1)
+    agg.sample()
+    assert agg.cost_ratio("heavy") > 1.0
+    assert agg.cost_ratio("light") < 1.0
+    assert agg.cost_ratio("unknown") == 1.0
+    # clamp: an extreme tenant cannot weigh more than 10x / less 0.1x
+    assert 0.1 <= agg.cost_ratio("heavy") <= 10.0
+    assert 0.1 <= agg.cost_ratio("light") <= 10.0
+
+
+def test_scheduler_usage_cost_hook(monkeypatch):
+    from comfyui_distributed_tpu.scheduler.control import SchedulerControl
+    from comfyui_distributed_tpu.utils import constants
+
+    control = SchedulerControl()
+    payload = types.SimpleNamespace(
+        tenant="heavy", lane=None, trace_id=None, deadline_s=None,
+        extra={"estimated_tiles": 10},
+    )
+    # knob off: static cost regardless of the seam
+    control.usage_cost = lambda tenant: 3.0
+    monkeypatch.setattr(constants, "USAGE_COST_ENABLED", False)
+    ticket = control.submit_payload(payload)
+    assert ticket.cost == pytest.approx(10.0)
+    control.queue.release(ticket)
+    # knob on: measured ratio multiplies the estimate
+    monkeypatch.setattr(constants, "USAGE_COST_ENABLED", True)
+    ticket = control.submit_payload(payload)
+    assert ticket.cost == pytest.approx(30.0)
+    control.queue.release(ticket)
+    # a raising/degenerate seam falls back to the static cost
+    control.usage_cost = lambda tenant: (_ for _ in ()).throw(RuntimeError())
+    ticket = control.submit_payload(payload)
+    assert ticket.cost == pytest.approx(10.0)
+    control.queue.release(ticket)
+    control.usage_cost = lambda tenant: float("nan")
+    ticket = control.submit_payload(payload)
+    assert ticket.cost == pytest.approx(10.0)
+
+
+# --------------------------------------------------------------------------
+# store-side waste hooks (speculation loser, poison retry)
+# --------------------------------------------------------------------------
+
+
+def test_store_speculation_loser_and_poison_retry_charge_waste(server_loop):
+    import asyncio
+
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.telemetry.usage import get_usage_meter
+
+    async def scenario():
+        store = JobStore()
+        await store.init_tile_job("uw-job", [0], tenant="t-w",
+                                  lane="batch")
+        # w1 claims the tile; the watchdog speculates it; w2 claims the
+        # copy; both submit — w2's (second) result drops as the loser
+        first = await store.pull_tasks("uw-job", "w1", timeout=0.1)
+        assert first == [0]
+        await store.speculate_in_flight("uw-job")
+        second = await store.pull_tasks("uw-job", "w2", timeout=0.1)
+        assert second == [0]
+        assert await store.submit_result("uw-job", "w1", 0, {"p": 1})
+        assert not await store.submit_result("uw-job", "w2", 0, {"p": 1})
+        # quarantine-class requeue: w3 claims a fresh job's tile and
+        # "dies" (breaker quarantine path)
+        await store.init_tile_job("uw-job2", [0], tenant="t-w")
+        third = await store.pull_tasks("uw-job2", "w3", timeout=0.1)
+        assert third == [0]
+        await store.requeue_worker_tasks("w3")
+
+    asyncio.run_coroutine_threadsafe(
+        scenario(), server_loop.loop
+    ).result(timeout=30)
+    totals = get_usage_meter().totals()
+    assert totals["waste_ns"].get("speculation", 0) > 0
+    assert totals["waste_ns"].get("poison_retry", 0) > 0
+    # attrs landed from init_tile_job: the waste resolves to the tenant
+    assert get_usage_meter().job_attrs("uw-job") == ("t-w", "batch")
+
+
+# --------------------------------------------------------------------------
+# snapshot wire format (v2) + fleet adoption
+# --------------------------------------------------------------------------
+
+
+def test_local_snapshot_v2_carries_usage_block():
+    from comfyui_distributed_tpu.telemetry.fleet import (
+        SNAPSHOT_VERSION,
+        local_snapshot,
+    )
+    from comfyui_distributed_tpu.telemetry.usage import get_usage_meter
+
+    _dispatch(get_usage_meter(), role="worker", elapsed=0.002)
+    snap = local_snapshot(role="worker")
+    assert snap["v"] == SNAPSHOT_VERSION == 2
+    assert snap["usage"]["dispatch_chip_s"] > 0
+    assert snap["usage"]["dispatches"] == 1
+
+
+def test_fleet_registry_gates_usage_on_version():
+    from comfyui_distributed_tpu.telemetry.fleet import FleetRegistry
+
+    registry = FleetRegistry()
+    assert registry.usage is not None
+    usage_block = _worker_snapshot(1.0)
+    # v1 (old worker): accepted, usage ignored
+    assert registry.note_snapshot(
+        "w-old", {"v": 1, "tiles_total": 3, "usage": usage_block}
+    )
+    assert registry.usage.rollup()["totals"]["chip_s"] == 0.0
+    # v2: usage adopted
+    assert registry.note_snapshot(
+        "w-new", {"v": 2, "tiles_total": 3, "usage": usage_block}
+    )
+    assert registry.usage.rollup()["totals"]["chip_s"] == pytest.approx(2.5)
+    # unknown version: dropped entirely
+    assert not registry.note_snapshot("w-future", {"v": 9})

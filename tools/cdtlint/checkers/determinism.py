@@ -68,6 +68,13 @@ DETERMINISM_PATHS = (
     "comfyui_distributed_tpu/graph/batch_executor.py",
     "comfyui_distributed_tpu/ops/stepwise.py",
     "comfyui_distributed_tpu/scheduler/preempt.py",
+    # the usage-metering plane: attribution order must be a pure
+    # function of the dispatch slot sequence, and every exported
+    # mapping must be sorted, or two replays of the same dispatch
+    # stream would produce different rollups (billing surfaces must be
+    # replay-stable — the conservation identity is only auditable if
+    # the numbers it sums are)
+    "comfyui_distributed_tpu/telemetry/usage.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
