@@ -53,6 +53,16 @@ accelerators — measured best on v5e, BENCH_NOTES r5 A/B),
 BENCH_TERM_GRACE_S (SIGTERM->SIGKILL harvest window on
 probe timeout), BENCH_PROBE_PLATFORM (pin the probe child's backend
 via the config API — the env var is overridden by hosted plugins),
+BENCH_PROBE_BACKENDS (ordered comma list of platforms, each probed in
+its OWN subprocess — first healthy backend wins and is pinned for the
+measurement; a wedged plugin cannot mask the next backend's health),
+BENCH_PROBE_STAGE_TIMEOUT (s; per-stage probe budget measured from
+the child's last phase marker — a hang is killed seconds after the
+stage stalls and the datum names the stage, instead of riding out the
+global BENCH_PROBE_TIMEOUT), BENCH_PROBE_PIN ("dist=version,..."
+plugin version pins checked before `import jax`; a drifted
+libtpu/jaxlib pair fails instantly with the mismatch named instead of
+wedging for the full probe window),
 CDT_PARAMS_DTYPE (weight storage dtype; the orchestrator sets
 bfloat16 for accelerator children — halves HBM, the fix for the
 18.5G/15.75G SDXL OOM — and pins f32 for the golden-comparable tiny
@@ -157,7 +167,7 @@ def _probe_block() -> dict:
             stage_timings[name] = float(at[:-1])
         except ValueError:
             continue
-    return {
+    block = {
         "outcome": outcome,
         "attempts": len(_PROBE_ATTEMPTS),
         "timeout_s": last.get("timeout_s"),
@@ -166,6 +176,14 @@ def _probe_block() -> dict:
         "stderr_tail": str(last.get("diagnostics", ""))[-2048:],
         "history": list(_PROBE_ATTEMPTS),
     }
+    # per-backend isolation forensics: which backend the last attempt
+    # pinned, the stage a timeout died in, and the plugin versions the
+    # child reported before init — the triple that names a wedged
+    # plugin from the JSON alone
+    for key in ("backend", "timed_out_stage", "timeout_kind", "plugin_versions"):
+        if last.get(key) is not None:
+            block[key] = last[key]
+    return block
 
 def _probe_child() -> None:
     """BENCH_MODE=probe child: staged backend init with forensics.
@@ -237,6 +255,27 @@ def _probe_child() -> None:
         plugins = [f"entry-point enumeration failed: {exc}"]
     mark("versions", json.dumps({"dists": vers, "jax_plugins": plugins}))
 
+    pin = os.environ.get("BENCH_PROBE_PIN", "")
+    if pin:
+        # plugin version pinning: refuse to init a backend whose dist
+        # versions drifted from what the operator validated — a
+        # mismatched libtpu/jaxlib pair is the classic silent-wedge
+        # combination, and failing here (before `import jax`) turns a
+        # 600 s hang into an instant, named crash datum
+        mismatches = {}
+        for spec in pin.split(","):
+            spec = spec.strip()
+            if not spec or "=" not in spec:
+                continue
+            dist, want = spec.split("=", 1)
+            have = vers.get(dist.strip())
+            if have != want.strip():
+                mismatches[dist.strip()] = {"want": want.strip(), "have": have}
+        if mismatches:
+            mark("version pin violated", json.dumps(mismatches))
+            sys.exit(3)
+        mark("version pin ok", pin)
+
     import logging
     logging.basicConfig(level=logging.DEBUG)
     if os.environ.get("BENCH_PROBE_HANG") == "1":
@@ -300,21 +339,56 @@ def _decode_tail(raw, limit: int) -> str:
     return raw[-limit:].strip()
 
 
-def _probe_accelerator(timeout_s: float) -> str:
+def _probe_candidates() -> list:
+    """Backends to probe, each in its OWN subprocess. BENCH_PROBE_BACKENDS
+    is an ordered comma list of platform names ("tpu,cpu"); unset means
+    one un-pinned probe of the default platform resolution — exactly
+    the pre-region behavior."""
+    raw = os.environ.get("BENCH_PROBE_BACKENDS", "")
+    names = [b.strip() for b in raw.split(",") if b.strip()]
+    return names or [None]
+
+
+def _probe_backends(timeout_s: float) -> tuple:
+    """Per-backend subprocess isolation: probe each candidate in its
+    own child, first healthy backend wins. A wedged PJRT plugin burns
+    only its own attempt — it cannot mask the health of the next
+    backend in line, because nothing is shared between attempts (each
+    child owns its plugin registration, PJRT client, and process
+    group). Returns (status, backend): the winner's 'ok' plus the
+    platform to pin, or the LAST attempt's failure with backend None."""
+    status = "failed"
+    for backend in _probe_candidates():
+        status = _probe_accelerator(timeout_s, backend=backend)
+        if status == "ok":
+            return status, backend
+    return status, None
+
+
+def _probe_accelerator(timeout_s: float, backend=None) -> str:
     """ONE probe of backend init in a subprocess: a hung/unreachable
     TPU tunnel would otherwise hang the whole bench (backend init is
-    not interruptible in-process). No retry ladder — a second, longer
-    attempt is exactly what starved round 3 of any datum; a fast
-    deterministic failure would be re-run for no benefit either.
+    not interruptible in-process). No retry ladder for a given backend
+    — a second, longer attempt is exactly what starved round 3 of any
+    datum; a fast deterministic failure would be re-run for no benefit
+    either. (Probing a DIFFERENT backend after a failure is fine — see
+    _probe_backends — because that is new information, not a retry.)
 
     The child is the staged BENCH_MODE=probe mode (phase markers +
-    faulthandler watchdog). On timeout the parent escalates gently:
-    SIGTERM first — the child's registered faulthandler dumps every
-    thread's stack to stderr — and SIGKILL only if the dump doesn't
-    flush within 15s. Returns 'ok' | 'failed' | 'timeout'; diagnostics
-    (including the staged phase ledger and any stack dump) are recorded
-    in _PROBE_ATTEMPTS either way."""
+    faulthandler watchdog); `backend` pins its platform via
+    BENCH_PROBE_PLATFORM. The parent streams the child's stderr and
+    enforces two timeouts: the global `timeout_s`, and — when
+    BENCH_PROBE_STAGE_TIMEOUT is set — a per-stage budget measured
+    from the last phase marker, so a hang 5 s into `jax.devices()` is
+    killed in seconds instead of riding out the full global window.
+    On timeout the parent escalates gently: SIGTERM first — the
+    child's registered faulthandler dumps every thread's stack to
+    stderr — and SIGKILL only if the dump doesn't flush within 15s.
+    Returns 'ok' | 'failed' | 'timeout'; diagnostics (staged phase
+    ledger, the stage a timeout died in, parsed plugin versions, any
+    stack dump) are recorded in _PROBE_ATTEMPTS either way."""
     import signal
+    import threading
 
     t0 = time.perf_counter()
     env = dict(
@@ -322,29 +396,58 @@ def _probe_accelerator(timeout_s: float) -> str:
         BENCH_MODE="probe",
         BENCH_PROBE_DEADLINE_S=str(timeout_s),
     )
+    if backend:
+        env["BENCH_PROBE_PLATFORM"] = backend
+    stage_budget = float(os.environ.get("BENCH_PROBE_STAGE_TIMEOUT", "0"))
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         env=env, start_new_session=True,
     )
     _LIVE_CHILDREN.append(proc)
+
+    stdout_chunks: list = []
+    stderr_chunks: list = []
+    # updated by the stderr reader on every phase marker: the staged
+    # clock restarts when the child proves it reached the next stage
+    last_mark = [time.perf_counter()]
+
+    def _drain(stream, chunks, watch_marks):
+        for line in iter(stream.readline, b""):
+            chunks.append(line)
+            if watch_marks and b"probe phase: " in line:
+                last_mark[0] = time.perf_counter()
+        stream.close()
+
+    t_err = threading.Thread(
+        target=_drain, args=(proc.stderr, stderr_chunks, True), daemon=True
+    )
+    t_out = threading.Thread(
+        target=_drain, args=(proc.stdout, stdout_chunks, False), daemon=True
+    )
+    t_err.start()
+    t_out.start()
+
+    status = "ok"
+    timeout_kind = None
     try:
-        try:
-            stdout, stderr = proc.communicate(timeout=timeout_s)
-            status = (
-                "ok"
-                if proc.returncode == 0 and b"probe-ok" in stdout
-                else "failed"
-            )
-        except subprocess.TimeoutExpired:
-            status = "timeout"
+        while proc.poll() is None:
+            now = time.perf_counter()
+            if now - t0 > timeout_s:
+                status, timeout_kind = "timeout", "global"
+                break
+            if stage_budget > 0 and now - last_mark[0] > stage_budget:
+                status, timeout_kind = "timeout", "stage_budget"
+                break
+            time.sleep(0.05)
+        if status == "timeout":
             try:
                 os.killpg(proc.pid, signal.SIGTERM)
             except (ProcessLookupError, OSError):
                 pass
             try:
                 # give faulthandler time to write the all-thread dump
-                stdout, stderr = proc.communicate(
+                proc.wait(
                     timeout=float(os.environ.get("BENCH_TERM_GRACE_S", 15))
                 )
             except subprocess.TimeoutExpired:
@@ -352,18 +455,46 @@ def _probe_accelerator(timeout_s: float) -> str:
                     os.killpg(proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, OSError):
                     pass
-                stdout, stderr = proc.communicate()
+                proc.wait()
+        else:
+            stdout_so_far = b"".join(stdout_chunks)
+            status = (
+                "ok"
+                if proc.returncode == 0 and b"probe-ok" in stdout_so_far
+                else "failed"
+            )
+        t_err.join(timeout=5)
+        t_out.join(timeout=5)
     finally:
         _LIVE_CHILDREN.remove(proc)
-    stderr_text = _decode_tail(stderr, 16384)
-    diag = (_decode_tail(stdout, 512) + "\n" + stderr_text).strip()
+    stderr_text = _decode_tail(b"".join(stderr_chunks), 16384)
+    diag = (
+        _decode_tail(b"".join(stdout_chunks), 512) + "\n" + stderr_text
+    ).strip()
+    phases = _probe_phase_ledger(stderr_text)
     attempt = {
         "timeout_s": round(timeout_s, 1),
         "elapsed_s": round(time.perf_counter() - t0, 1),
         "status": status,
-        "phases": _probe_phase_ledger(stderr_text),
+        "backend": backend or "default",
+        "phases": phases,
         "diagnostics": diag if status != "ok" else diag[-2048:],
     }
+    for entry in phases:
+        head, sep, detail = entry.partition(" | ")
+        if head.startswith("versions at ") and sep:
+            try:
+                attempt["plugin_versions"] = json.loads(detail)
+            except ValueError:
+                pass
+            break
+    if status == "timeout":
+        attempt["timeout_kind"] = timeout_kind
+        last_stage = "spawn"
+        if phases:
+            head = phases[-1].split(" | ", 1)[0]
+            last_stage = head.rpartition(" at ")[0] or head
+        attempt["timed_out_stage"] = last_stage
     if status != "ok" and "Current thread" not in diag and "Thread 0x" not in diag:
         attempt["note"] = (
             "no faulthandler stack dump captured — the hang is likely "
@@ -469,12 +600,19 @@ def _init_jax() -> tuple:
     # trusted-healthy hosts: skip the duplicate backend init it costs)
     if probe_timeout <= 0:
         _PROBE_SKIP_REASON = "disabled_by_env"
-    status = "ok" if probe_timeout <= 0 else _probe_accelerator(probe_timeout)
+    status, backend = (
+        ("ok", None) if probe_timeout <= 0 else _probe_backends(probe_timeout)
+    )
     if status != "ok":
         _warn_probe_failure(status, probe_timeout)
         os.environ.setdefault("BENCH_TINY", "1")
         jax.config.update("jax_platforms", "cpu")
         return jax, "cpu_fallback"
+    if backend:
+        # commit to the backend whose isolated probe passed, so the
+        # measurement process cannot drift onto a sibling plugin the
+        # probe never validated
+        jax.config.update("jax_platforms", backend)
     return jax, "accelerator"
 
 

@@ -1,0 +1,89 @@
+"""Region control-plane routes: shard map, lease view, autoscaler.
+
+- ``GET  /distributed/region`` — the shard router's job→master map
+  (per-shard addresses, endpoint health, highest fencing epoch) plus
+  this master's lease view (file or quorum; the quorum view includes
+  every peer's register, the operator's split-brain forensic);
+- ``GET  /distributed/autoscale`` — the autoscaler's bounds and its
+  recent decisions, each carrying the chip-second demand/capacity
+  window that justified it and the measured delta the action bought;
+- ``POST /distributed/autoscale/step`` — force one evaluation NOW
+  (the soak harness and operators use it instead of waiting out the
+  interval; answers 409 when the controller is disabled).
+
+Registered unconditionally — on an unsharded, non-autoscaled master
+the region route answers ``enabled: false`` everywhere so dashboards
+can probe capability without 404 special-casing.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .server import DistributedServer
+
+
+class RegionRoutes:
+    def __init__(self, server: "DistributedServer") -> None:
+        self.server = server
+
+    async def handle_region(self, request: web.Request) -> web.Response:
+        server = self.server
+        router = getattr(server, "router", None)
+        lease_view = None
+        manager = server.durability
+        if manager is not None and manager.lease is not None:
+            lease = manager.lease
+            status_fn = getattr(lease, "status", None)
+            if callable(status_fn):
+                lease_view = status_fn()
+            else:
+                lease_view = {
+                    "backend": "file",
+                    "owner": lease.owner,
+                    "epoch": getattr(lease, "epoch", None),
+                    "ttl_seconds": getattr(lease, "ttl", None),
+                }
+        body = {
+            "enabled": bool(router is not None and router.enabled),
+            "deposed": server.deposed,
+            "shards": router.status() if router is not None else {
+                "enabled": False, "shards": {},
+            },
+            "lease": lease_view,
+        }
+        return web.json_response(body)
+
+    async def handle_autoscale(self, request: web.Request) -> web.Response:
+        controller = getattr(self.server, "autoscale", None)
+        if controller is None:
+            return web.json_response({"enabled": False, "decisions": []})
+        return web.json_response(controller.status())
+
+    async def handle_autoscale_step(
+        self, request: web.Request
+    ) -> web.Response:
+        controller = getattr(self.server, "autoscale", None)
+        if controller is None:
+            return web.json_response(
+                {"error": "autoscaler disabled (CDT_AUTOSCALE=0)"},
+                status=409,
+            )
+        import asyncio
+
+        record = await asyncio.get_running_loop().run_in_executor(
+            None, controller.step
+        )
+        return web.json_response({"decision": record})
+
+
+def register(app: web.Application, server: "DistributedServer") -> None:
+    routes = RegionRoutes(server)
+    app.router.add_get("/distributed/region", routes.handle_region)
+    app.router.add_get("/distributed/autoscale", routes.handle_autoscale)
+    app.router.add_post(
+        "/distributed/autoscale/step", routes.handle_autoscale_step
+    )

@@ -193,6 +193,36 @@ class DistributedServer:
             # multiplies by the tenant's metered chip-s-per-tile ratio
             if self.fleet.usage is not None:
                 self.scheduler.usage_cost = self.fleet.usage.cost_ratio
+        # Region control plane (scheduler/router.py + autoscale.py):
+        # CDT_SHARDS gives this master the job→shard map the region
+        # route serves (workers compute the same map from the same
+        # spec — consistent hashing needs no coordination), and
+        # CDT_AUTOSCALE=1 starts the usage-driven scale loop: SLO burn
+        # alerts + metered chip-second demand in, managed-worker
+        # launches / SIGTERM drains out, every decision recorded with
+        # its measured chip-second cost/benefit.
+        from ..scheduler.autoscale import (
+            AutoscaleController,
+            managed_worker_actuators,
+        )
+        from ..scheduler.router import ShardRouter
+        from ..utils.constants import AUTOSCALE_ENABLED
+
+        self.router: Optional[ShardRouter] = None
+        self.autoscale: Optional[AutoscaleController] = None
+        if not self.is_worker:
+            self.router = ShardRouter.from_env()
+            if AUTOSCALE_ENABLED:
+                launcher, drainer, capacity_fn = managed_worker_actuators(
+                    self.config_path
+                )
+                self.autoscale = AutoscaleController(
+                    slo=self.slo,
+                    usage=self.fleet.usage if self.fleet is not None else None,
+                    launcher=launcher,
+                    drainer=drainer,
+                    capacity_fn=capacity_fn,
+                )
         # Durable control plane (durability/): enabled by setting
         # CDT_JOURNAL_DIR on a master. Construction is cheap and
         # file-free; recovery + the write-ahead seam attach in start(),
@@ -314,6 +344,7 @@ class DistributedServer:
             config_routes,
             incident_routes,
             job_routes,
+            region_routes,
             replication_routes,
             scheduler_routes,
             telemetry_routes,
@@ -337,6 +368,7 @@ class DistributedServer:
         tunnel_routes.register(self.app, self)
         web_routes.register(self.app, self)
         replication_routes.register(self.app, self)
+        region_routes.register(self.app, self)
 
     # --- prompt queue ----------------------------------------------------
 
@@ -521,11 +553,15 @@ class DistributedServer:
             # journaled before it is acknowledged. Admission lanes come
             # back PAUSED when jobs were recovered and resume on the
             # first worker heartbeat (durability/recovery.py).
-            from ..durability import Lease
+            # CDT_LEASE_PEERS swaps the arbitration medium: a quorum
+            # of off-node peer registers instead of a flock'd file on
+            # a shared filesystem — same interface, same epoch fencing,
+            # same FencedOut seam downstream.
+            from ..durability import Lease, quorum_lease_from_env
 
-            lease = Lease(
-                self.durability.directory,
-                owner=f"master:{self.host}:{self.port}:{os.getpid()}",
+            owner = f"master:{self.host}:{self.port}:{os.getpid()}"
+            lease = quorum_lease_from_env(owner) or Lease(
+                self.durability.directory, owner=owner
             )
             epoch = await self.loop.run_in_executor(
                 None, lambda: lease.acquire(force=True)
@@ -551,6 +587,8 @@ class DistributedServer:
             self.watchdog.start()
         if self._fleet_monitor is not None:
             self._fleet_monitor.start()
+        if self.autoscale is not None:
+            self.autoscale.start()
         self._executor_thread = threading.Thread(
             target=self._executor_loop, name="cdt-executor", daemon=True
         )
@@ -644,6 +682,12 @@ class DistributedServer:
             # pure thread join: the monitor's step touches only the
             # series store and the bus (non-blocking), never this loop
             self._fleet_monitor.stop()
+        if self.autoscale is not None:
+            # off-loop: a step in flight may be mid-drain (stop_worker
+            # blocks through the SIGTERM grace window)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.autoscale.stop
+            )
         if self.incidents is not None:
             # off-loop: stop joins the writer thread, which may be
             # mid-fsync on a capture

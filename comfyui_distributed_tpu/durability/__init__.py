@@ -17,12 +17,20 @@ protocol (lease, epoch fencing, replication lag).
     manager.py   — DurabilityManager: the JobStore's journal_sink,
                    replication tee, and promotion adopter
     lease.py     — epoch-numbered master lease + FencedOut fencing
+    quorum.py    — quorum lease backend (region mode: no shared fs)
     replicate.py — replication subscriptions + the standby replica
 """
 
 from .journal import Journal, JournalCorruption, replay_journal
 from .lease import FencedOut, Lease, LeaseHeld, LeaseLost, read_lease
 from .manager import DurabilityManager, journal_dir_from_env
+from .quorum import (
+    FileLeasePeer,
+    LeasePeerError,
+    MemoryLeasePeer,
+    QuorumLease,
+    quorum_lease_from_env,
+)
 from .recovery import RecoveryReport, recover, recover_state
 from .replicate import ReplicationSubscription, StandbyReplica
 from .state import SnapshotVersionMismatch
@@ -30,16 +38,21 @@ from .state import SnapshotVersionMismatch
 __all__ = [
     "DurabilityManager",
     "FencedOut",
+    "FileLeasePeer",
     "Journal",
     "JournalCorruption",
     "Lease",
     "LeaseHeld",
     "LeaseLost",
+    "LeasePeerError",
+    "MemoryLeasePeer",
+    "QuorumLease",
     "RecoveryReport",
     "ReplicationSubscription",
     "SnapshotVersionMismatch",
     "StandbyReplica",
     "journal_dir_from_env",
+    "quorum_lease_from_env",
     "read_lease",
     "recover",
     "recover_state",

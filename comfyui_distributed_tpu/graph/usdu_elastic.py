@@ -101,10 +101,16 @@ class HTTPWorkClient:
 
     - `master_url` may be a comma-separated address list (active first,
       standbys after). `CDT_FAILOVER_AFTER` consecutive transport/5xx
-      failures against the current address rotate to the next — the
+      failures against the current address re-point to another — the
       re-pointed worker's next pull/heartbeat re-advertises its
       capacity, so the promoted master's placement policy re-learns the
-      fleet with no extra registration RPC;
+      fleet with no extra registration RPC. Address health is tracked
+      PER URL (scheduler/router.EndpointRotation): a failed address
+      sits out an exponential backoff window and re-pointing prefers
+      the address that last reported the highest fencing epoch, so a
+      dead/lagging shard address can't throttle pulls against healthy
+      ones — the old single rotation cursor punished the whole list
+      for one address's outage;
     - every RPC response carries the master's fencing `epoch`; the
       client remembers the highest seen and stamps it on every mutating
       RPC. A 409 `stale_epoch` rejection (our authority predates a
@@ -117,8 +123,20 @@ class HTTPWorkClient:
     def __init__(
         self, master_url: str, job_id: str, worker_id: str, devices: int = 1
     ):
+        from ..scheduler.router import EndpointRotation, ShardRouter
+
         self.urls = parse_master_urls(master_url) or [str(master_url)]
-        self._url_idx = 0
+        # Region mode (CDT_SHARDS on the worker): this job's shard is a
+        # pure function of its id, so the client re-binds to the shard's
+        # own address list (active + standby) — a worker running jobs
+        # from different shards multiplexes pulls across masters, and
+        # one shard's outage backs off only that shard's endpoints.
+        shard_router = ShardRouter.from_env()
+        if shard_router.enabled:
+            self.urls = parse_master_urls(
+                shard_router.addresses_for(job_id)
+            ) or self.urls
+        self._endpoints = EndpointRotation(self.urls)
         self.job_id = job_id
         self.worker_id = worker_id
         # Advertised grant capacity (the worker mesh's data-axis width):
@@ -148,7 +166,6 @@ class HTTPWorkClient:
         # response; None = no deadline on this job.
         self.deadline_remaining: Optional[float] = None
         self.failovers = 0
-        self._consecutive_errors = 0
         # Heartbeat backoff state (consecutive failures → suppression
         # window); guarded by nothing — heartbeats run on one thread
         # (the pipeline's I/O stage).
@@ -163,7 +180,7 @@ class HTTPWorkClient:
 
     @property
     def master_url(self) -> str:
-        return self.urls[self._url_idx % len(self.urls)]
+        return self._endpoints.current
 
     def _maybe_telemetry(self) -> Optional[dict]:
         """The fleet snapshot to piggyback on this RPC, or None when
@@ -190,7 +207,12 @@ class HTTPWorkClient:
             epoch = int(value)
         except (TypeError, ValueError):
             return
-        if epoch > 0 and (self.epoch is None or epoch > self.epoch):
+        if epoch <= 0:
+            return
+        # per-URL: the rotation remembers which address reported which
+        # epoch, so re-pointing prefers the freshest (promoted) master
+        self._endpoints.learn_epoch(epoch)
+        if self.epoch is None or epoch > self.epoch:
             self.epoch = epoch
 
     def _learn_preempt(self, out: dict) -> None:
@@ -202,23 +224,18 @@ class HTTPWorkClient:
 
     def _count_error(self, op: str) -> None:
         """One master-RPC failure: counted per operation, and after
-        CDT_FAILOVER_AFTER consecutive failures the client re-points to
-        the next address in its list (no-op with a single address)."""
+        CDT_FAILOVER_AFTER consecutive failures against the current
+        address the rotation re-points (no-op with a single address).
+        The failed address enters its per-URL backoff window, so the
+        rotation won't land back on it while a healthy address exists."""
         from ..telemetry.instruments import (
             failover_total,
             worker_master_errors_total,
         )
-        from ..utils.constants import FAILOVER_AFTER_ERRORS
 
         worker_master_errors_total().inc(op=op)
-        self._consecutive_errors += 1
-        if (
-            len(self.urls) > 1
-            and self._consecutive_errors >= max(1, FAILOVER_AFTER_ERRORS)
-        ):
-            previous = self.master_url
-            self._url_idx = (self._url_idx + 1) % len(self.urls)
-            self._consecutive_errors = 0
+        previous = self.master_url
+        if self._endpoints.note_failure():
             self.failovers += 1
             failover_total().inc(role="worker")
             log(
@@ -244,8 +261,9 @@ class HTTPWorkClient:
                     except Exception:  # noqa: BLE001 - non-JSON 409
                         body = {}
                     if body.get("error") == "stale_epoch":
+                        # the address answered: healthy, just ahead of us
+                        self._endpoints.note_success()
                         self._learn_epoch(body.get("current_epoch"))
-                        self._consecutive_errors = 0
                         raise TransientServerError(
                             f"{path} -> stale epoch (refreshed to "
                             f"{self.epoch})", self.worker_id,
@@ -264,7 +282,7 @@ class HTTPWorkClient:
         except transport_errors() as exc:
             self._count_error(op)
             raise exc
-        self._consecutive_errors = 0
+        self._endpoints.note_success()
         if isinstance(out, dict):
             self._learn_epoch(out.get("epoch"))
         return out

@@ -952,6 +952,8 @@ def run_chaos_failover(
     snapshot_every: int = 4,
     lease_ttl: float = 0.3,
     push_grants: bool = False,
+    quorum_peers: Optional[Sequence[Any]] = None,
+    peer_crash: Optional[str] = None,
 ) -> FailoverResult:
     """Kill-the-active-master failover, in process and deterministic.
 
@@ -997,6 +999,16 @@ def run_chaos_failover(
     PlacementPolicy (the production push publisher) on both stores —
     the pushed-grant path must survive the same failover the pull
     fallback does.
+
+    `quorum_peers` swaps the arbitration medium: both claimants run a
+    ``QuorumLease`` over the given shared peer registers instead of a
+    flock'd file on `journal_dir` — region mode, where no shared
+    filesystem arbitrates. The protocol downstream is identical (epoch
+    fencing, ``FencedOut``, ``StaleEpoch``), which is exactly what the
+    scenario proves. `peer_crash` ("before" / "after") additionally
+    crashes one peer mid-way through the standby's acquire — the
+    mid-acquire peer-crash case: a majority of the survivors still
+    elects, epochs stay monotonic, and the canvas stays bit-identical.
     """
     import jax.numpy as jnp
 
@@ -1004,8 +1016,9 @@ def run_chaos_failover(
         DurabilityManager,
         FencedOut,
         Lease,
+        LeaseHeld,
+        QuorumLease,
         StandbyReplica,
-        read_lease,
     )
     from ..graph import ExecutionContext
     from ..graph import usdu_elastic as elastic
@@ -1186,7 +1199,15 @@ def run_chaos_failover(
         manager1 = DurabilityManager(
             journal_dir, snapshot_every=snapshot_every, fsync_every=0
         )
-        lease1 = Lease(journal_dir, owner="chaos-active", ttl=lease_ttl)
+
+        def make_lease(owner: str) -> Any:
+            if quorum_peers is not None:
+                return QuorumLease(
+                    list(quorum_peers), owner=owner, ttl=lease_ttl
+                )
+            return Lease(journal_dir, owner=owner, ttl=lease_ttl)
+
+        lease1 = make_lease("chaos-active")
         epoch1 = lease1.acquire(force=True)
         manager1.lease = lease1
         store1.journal_sink = manager1.record
@@ -1241,14 +1262,25 @@ def run_chaos_failover(
             orphan_tile = None
 
         # --- takeover: wait out the TTL, then promote the standby --------
+        # NOT forced: the standby promotion gate — the acquire succeeds
+        # only once the dead active's lease has expired. `peer_crash`
+        # arms a one-shot peer crash on the quorum path so the election
+        # itself runs through a mid-acquire failure.
+        lease2 = make_lease("chaos-standby")
+        if peer_crash is not None and quorum_peers is not None:
+            quorum_peers[-1].crash_next_propose = peer_crash
         deadline = time.monotonic() + max(5.0, lease_ttl * 20)
+        epoch2: Optional[int] = None
         while time.monotonic() < deadline:
-            state = read_lease(journal_dir)
-            if state is None or state.expires_at <= time.time():
+            try:
+                epoch2 = lease2.acquire()
                 break
-            time.sleep(lease_ttl / 10)
-        lease2 = Lease(journal_dir, owner="chaos-standby", ttl=lease_ttl)
-        epoch2 = lease2.acquire()  # NOT forced: the standby promotion gate
+            except LeaseHeld:
+                time.sleep(lease_ttl / 10)  # the dead active's TTL
+            except OSError:
+                time.sleep(lease_ttl / 10)  # indeterminate quorum read
+        if epoch2 is None:
+            raise RuntimeError("standby never won the lease")
         # final drain: post-takeover the ex-active is fenced, so no
         # record can land after this
         for record in sub.pop(max_items=100000):
@@ -1319,6 +1351,206 @@ def run_chaos_failover(
         zombie_journaled_records=zombie_journaled,
         repointed_workers=sorted(repointed),
         orphan_tile=orphan_tile,
+    )
+
+
+def run_chaos_quorum_failover(
+    seed: int = 0,
+    crash_plan: str = "crash@store:pull:master#2;crash@chaos:w1:pulled#2",
+    *,
+    journal_dir: str,
+    n_peers: int = 3,
+    peer_crash: Optional[str] = None,
+    **kwargs: Any,
+) -> FailoverResult:
+    """Region-mode failover: the same kill-the-active scenario as
+    ``run_chaos_failover``, arbitrated by a ``QuorumLease`` over
+    ``n_peers`` in-memory peer registers instead of a shared-filesystem
+    flock. `peer_crash` ("before"/"after") crashes one peer mid-way
+    through the standby's acquire. The caller asserts the canvas is
+    bit-identical to the fault-free run — the acceptance that quorum
+    leasing changes the arbitration medium and nothing else."""
+    from ..durability import MemoryLeasePeer
+
+    peers = [MemoryLeasePeer(f"peer{i}") for i in range(n_peers)]
+    return run_chaos_failover(
+        seed,
+        crash_plan,
+        journal_dir=journal_dir,
+        quorum_peers=peers,
+        peer_crash=peer_crash,
+        **kwargs,
+    )
+
+
+@dataclasses.dataclass
+class RegionResult:
+    """Outcome of a two-shard region run with one shard failing over."""
+
+    placements: dict          # job id -> shard name (the ring's map)
+    shard0: FailoverResult    # the shard that lost its master mid-job
+    shard1_tiles_completed: int  # the untouched shard's job, tile-complete
+    shard1_epoch: int          # must still be its own epoch 1
+    shard1_journal_appends: int
+    placement_drift: int       # ring placements changed by the failover (0!)
+    autoscale_decisions: list  # the controller's ledger across the run
+
+
+def run_chaos_region(
+    seed: int = 0,
+    *,
+    journal_root: str,
+    crash_plan: str = "crash@store:pull:master#2;crash@chaos:w1:pulled#2",
+    peer_crash: Optional[str] = None,
+    probe_jobs: int = 64,
+) -> RegionResult:
+    """Two master shards, one region: shard0's master is killed mid-job
+    and fails over through the quorum lease while shard1's job — opened
+    BEFORE the crash and completed after — never loses a tile.
+
+    What it proves, in one deterministic in-process run:
+
+    - **placement is coordination-free**: the consistent-hash ring maps
+      every probe job to the same shard before and after the failover
+      (membership never changed, so zero keys move);
+    - **shard isolation**: shard1's journal, lease epoch, and job state
+      are untouched by shard0's crash/promotion — separate WALs,
+      separate leases, zero cross-shard job loss;
+    - **the failed shard recovers bit-identically** (delegated to
+      ``run_chaos_quorum_failover``: zombie fenced, stale submits
+      journal nothing, canvas equals the fault-free run);
+    - **the autoscaler observes the region**: its step ledger across
+      the run records each decision with the chip-second demand /
+      capacity window that justified it (a burn alert during the
+      outage forces a scale-up whose cost is measured on the next
+      evaluation).
+    """
+    from ..durability import DurabilityManager, Lease
+    from ..jobs import JobStore
+    from ..scheduler.autoscale import AutoscaleController
+    from ..scheduler.router import ShardRouter
+    from ..utils.async_helpers import run_async_in_server_loop
+
+    router = ShardRouter(
+        {"shard0": ["http://s0:8188"], "shard1": ["http://s1:8188"]},
+        vnodes=32,
+    )
+    placements = {
+        f"region-job-{i}": router.shard_for(f"region-job-{i}")
+        for i in range(probe_jobs)
+    }
+    job1 = next(j for j, s in placements.items() if s == "shard1")
+
+    # The autoscaler watching the region: a burn alert flips during the
+    # outage window; demand is the chip-seconds the shards burn.
+    burn: set = set()
+    usage_counter = {"chip_s": 0.0}
+    pool = {"workers": 2}
+    slo = types.SimpleNamespace(is_active=lambda name: name in burn)
+    usage = types.SimpleNamespace(
+        rollup=lambda: {"totals": {"chip_s": usage_counter["chip_s"]}}
+    )
+    controller = AutoscaleController(
+        slo=slo,
+        usage=usage,
+        launcher=lambda: (
+            pool.__setitem__("workers", pool["workers"] + 1)
+            or f"w{pool['workers']}"
+        ),
+        drainer=None,
+        capacity_fn=lambda: (pool["workers"], float(pool["workers"])),
+        interval=1.0,
+        min_workers=1,
+        max_workers=4,
+        target_util=0.7,
+        down_hold=3600.0,
+    )
+    controller.step()  # baseline window
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(_ensure_server_loop())
+        # --- shard1: open its job BEFORE shard0's crash ----------------
+        shard1_dir = os.path.join(journal_root, "shard1")
+        store_s1 = JobStore()
+        manager_s1 = DurabilityManager(
+            shard1_dir, snapshot_every=4, fsync_every=0
+        )
+        lease_s1 = Lease(shard1_dir, owner="shard1-master", ttl=30.0)
+        epoch_s1 = lease_s1.acquire(force=True)
+        manager_s1.lease = lease_s1
+        store_s1.journal_sink = manager_s1.record
+        store_s1.set_epoch(epoch_s1)
+        tiles_s1 = list(range(4))
+        run_async_in_server_loop(
+            store_s1.init_tile_job(job1, tiles_s1), timeout=10
+        )
+        first = run_async_in_server_loop(
+            store_s1.pull_task(job1, "s1-w1", timeout=0.2, epoch=epoch_s1),
+            timeout=10,
+        )
+        in_flight = [first] if first is not None else []
+
+        # --- shard0: the full quorum-lease failover mid-job ------------
+        usage_counter["chip_s"] += 1.4   # the window's measured demand
+        burn.add("availability")          # the outage fires the SLO
+        controller.step()                 # decision: scale_up (burn)
+        shard0_result = run_chaos_quorum_failover(
+            seed,
+            crash_plan,
+            journal_dir=os.path.join(journal_root, "shard0"),
+            peer_crash=peer_crash,
+        )
+        burn.clear()
+        usage_counter["chip_s"] += 0.4
+        controller.step()                 # settles the scale_up's cost
+
+        # --- shard1 again: finish the job it held across the outage ----
+        for t in in_flight:
+            run_async_in_server_loop(
+                store_s1.submit_result(
+                    job1, "s1-w1", int(t), None, epoch=epoch_s1
+                ),
+                timeout=10,
+            )
+        while True:
+            t = run_async_in_server_loop(
+                store_s1.pull_task(
+                    job1, "s1-w1", timeout=0.05, epoch=epoch_s1
+                ),
+                timeout=10,
+            )
+            if t is None:
+                break
+            run_async_in_server_loop(
+                store_s1.submit_result(
+                    job1, "s1-w1", int(t), None, epoch=epoch_s1
+                ),
+                timeout=10,
+            )
+        job_state = store_s1.tile_jobs[job1]
+        completed = len(job_state.completed)
+        shard1_appends = manager_s1.head_lsn()
+        manager_s1.close()
+        lease_s1.release()
+
+    drift = sum(
+        1
+        for j, s in placements.items()
+        if router.shard_for(j) != s
+    )
+    if completed != len(tiles_s1):
+        raise RuntimeError(
+            f"cross-shard job loss: shard1 completed {completed}/"
+            f"{len(tiles_s1)} tiles across shard0's failover"
+        )
+    return RegionResult(
+        placements=placements,
+        shard0=shard0_result,
+        shard1_tiles_completed=completed,
+        shard1_epoch=epoch_s1,
+        shard1_journal_appends=shard1_appends,
+        placement_drift=drift,
+        autoscale_decisions=list(controller.decisions),
     )
 
 

@@ -31,16 +31,20 @@ from .queue import (
     SchedulerSaturated,
     Ticket,
 )
+from .router import EndpointRotation, ShardRing, ShardRouter
 
 __all__ = [
     "AdmissionClosed",
     "AdmissionQueue",
     "BrownoutController",
     "DeadlineUnmeetable",
+    "EndpointRotation",
     "PlacementPolicy",
     "SchedulerControl",
     "SchedulerOverloaded",
     "SchedulerSaturated",
     "SchedulerState",
+    "ShardRing",
+    "ShardRouter",
     "Ticket",
 ]

@@ -1,0 +1,379 @@
+"""Usage-driven autoscaler: SLO burn + chip-second demand → fleet size.
+
+The controller closes the loop between the two measurement planes this
+repo already runs and the one actuator it already has:
+
+- **signals** — SLO burn-rate alerts (telemetry/slo.SLOEngine, PR 12):
+  an active burn on availability / tile_latency / deadline_miss means
+  the fleet is failing users NOW; and measured chip-second demand
+  (telemetry/usage.UsageAggregator, PR 15): the delta of attributed
+  chip-seconds per evaluation window is the fleet's *actual* load in
+  the only unit that survives heterogeneous chips;
+- **actuation** — launch one managed local worker through
+  workers/process_manager (the workers/startup.py launch path), or
+  drain one via its SIGTERM graceful-drain path (PR 10: the in-flight
+  grant returns to the master before the process dies).
+
+Policy (deliberately boring — a thermostat, not an optimizer):
+
+- utilization = demand chip-seconds / capacity chip-seconds over the
+  window. Above ``CDT_AUTOSCALE_TARGET_UTIL`` (or any burn alert
+  active) and below the max: **scale up** immediately.
+- Below half the target for ``CDT_AUTOSCALE_DOWN_HOLD`` seconds and
+  above the min: **scale down** one worker. Up is twitchy, down is
+  patient — the asymmetry is the thrash guard.
+
+Every decision is recorded with its **measured chip-second
+cost/benefit**: the demand and capacity chip-seconds of the window
+that justified it, and — settled on the NEXT evaluation — the
+capacity and demand deltas the action actually bought. An operator
+reading ``GET /distributed/autoscale`` sees what each decision cost
+and returned in the same unit the tenants are billed in
+(docs/operator-runbook.md §autoscaler triage).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..utils.constants import (
+    AUTOSCALE_DOWN_HOLD_SECONDS,
+    AUTOSCALE_INTERVAL_SECONDS,
+    AUTOSCALE_MAX_WORKERS,
+    AUTOSCALE_MIN_WORKERS,
+    AUTOSCALE_TARGET_UTILIZATION,
+)
+from ..utils.logging import debug_log, log
+
+# burn alerts that indicate capacity pressure (journal_latency burns
+# point at the disk, not the fleet — more workers would make it worse)
+SCALE_UP_ALERTS = ("availability", "tile_latency", "deadline_miss")
+DECISION_HISTORY = 256
+
+
+class AutoscaleController:
+    """One master's scale-up/down loop.
+
+    ``launcher()`` brings up one worker and returns its id (None when
+    nothing launchable remains); ``drainer()`` drains one worker and
+    returns its id (None when nothing drainable). ``capacity_fn()``
+    returns (worker_count, chip_count) — the denominator of
+    utilization in chips. All three are injected so the chaos suite
+    and unit tests can run the policy against fakes with a fake
+    clock."""
+
+    def __init__(
+        self,
+        *,
+        slo: Any = None,
+        usage: Any = None,
+        launcher: Optional[Callable[[], Optional[str]]] = None,
+        drainer: Optional[Callable[[], Optional[str]]] = None,
+        capacity_fn: Optional[Callable[[], tuple[int, float]]] = None,
+        clock: Callable[[], float] = time.time,
+        interval: Optional[float] = None,
+        min_workers: Optional[int] = None,
+        max_workers: Optional[int] = None,
+        target_util: Optional[float] = None,
+        down_hold: Optional[float] = None,
+    ) -> None:
+        self.slo = slo
+        self.usage = usage
+        self.launcher = launcher
+        self.drainer = drainer
+        self.capacity_fn = capacity_fn
+        self.clock = clock
+        self.interval = (
+            float(interval) if interval is not None
+            else AUTOSCALE_INTERVAL_SECONDS
+        )
+        self.min_workers = (
+            int(min_workers) if min_workers is not None
+            else AUTOSCALE_MIN_WORKERS
+        )
+        self.max_workers = (
+            int(max_workers) if max_workers is not None
+            else AUTOSCALE_MAX_WORKERS
+        )
+        self.target_util = (
+            float(target_util) if target_util is not None
+            else AUTOSCALE_TARGET_UTILIZATION
+        )
+        self.down_hold = (
+            float(down_hold) if down_hold is not None
+            else AUTOSCALE_DOWN_HOLD_SECONDS
+        )
+        self._lock = threading.Lock()
+        self.decisions: deque[dict[str, Any]] = deque(maxlen=DECISION_HISTORY)
+        self._prev_demand_total: Optional[float] = None
+        self._prev_step_at: Optional[float] = None
+        self._low_util_since: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- signal reads -----------------------------------------------------
+
+    def _demand_total_chip_s(self) -> float:
+        """Cumulative attributed chip-seconds, fleet-wide (monotonic:
+        deltas between evaluations are the window's demand)."""
+        if self.usage is None:
+            return 0.0
+        try:
+            return float(self.usage.rollup()["totals"]["chip_s"])
+        except Exception as exc:  # noqa: BLE001 - a signal, not a fault
+            debug_log(f"autoscale: usage read failed: {exc}")
+            return 0.0
+
+    def _burn_alerts(self) -> list[str]:
+        if self.slo is None:
+            return []
+        try:
+            return [
+                name for name in SCALE_UP_ALERTS if self.slo.is_active(name)
+            ]
+        except Exception as exc:  # noqa: BLE001
+            debug_log(f"autoscale: slo read failed: {exc}")
+            return []
+
+    def _capacity(self) -> tuple[int, float]:
+        if self.capacity_fn is not None:
+            try:
+                workers, chips = self.capacity_fn()
+                return int(workers), float(chips)
+            except Exception as exc:  # noqa: BLE001
+                debug_log(f"autoscale: capacity read failed: {exc}")
+        return 0, 0.0
+
+    # --- the evaluation ----------------------------------------------------
+
+    def step(self) -> dict[str, Any]:
+        """One evaluation: read signals, decide, actuate, record. The
+        record's ``measured`` block for the PREVIOUS decision is
+        settled here — cost/benefit in chip-seconds is only knowable
+        one window later."""
+        now = self.clock()
+        demand_total = self._demand_total_chip_s()
+        workers, chips = self._capacity()
+        elapsed = (
+            now - self._prev_step_at
+            if self._prev_step_at is not None
+            else self.interval
+        )
+        elapsed = max(elapsed, 1e-9)
+        demand_chip_s = (
+            max(0.0, demand_total - self._prev_demand_total)
+            if self._prev_demand_total is not None
+            else 0.0
+        )
+        capacity_chip_s = max(chips, 0.0) * elapsed
+        utilization = (
+            demand_chip_s / capacity_chip_s if capacity_chip_s > 0 else 0.0
+        )
+        burn = self._burn_alerts()
+
+        action, reason, target = self._decide(
+            now, workers, utilization, burn
+        )
+        record: dict[str, Any] = {
+            "ts": now,
+            "action": action,
+            "reason": reason,
+            "worker": target,
+            "workers": workers,
+            "chips": chips,
+            "window_s": round(elapsed, 3),
+            "demand_chip_s": round(demand_chip_s, 6),
+            "capacity_chip_s": round(capacity_chip_s, 6),
+            "utilization": round(utilization, 4),
+            "burn_alerts": burn,
+            # settled by the NEXT step: what the action actually bought
+            "measured": None,
+        }
+        with self._lock:
+            if self.decisions:
+                prev = self.decisions[-1]
+                prev["measured"] = {
+                    "capacity_delta_chip_s": round(
+                        capacity_chip_s - prev["capacity_chip_s"], 6
+                    ),
+                    "demand_delta_chip_s": round(
+                        demand_chip_s - prev["demand_chip_s"], 6
+                    ),
+                    "utilization_after": round(utilization, 4),
+                }
+            self.decisions.append(record)
+        self._prev_demand_total = demand_total
+        self._prev_step_at = now
+        if action != "hold":
+            log(
+                f"autoscale: {action} ({reason}) — util "
+                f"{utilization:.2f}, demand {demand_chip_s:.2f} chip-s / "
+                f"capacity {capacity_chip_s:.2f} chip-s, "
+                f"burn={burn or 'none'}"
+            )
+        return record
+
+    def _decide(
+        self,
+        now: float,
+        workers: int,
+        utilization: float,
+        burn: list[str],
+    ) -> tuple[str, str, Optional[str]]:
+        pressured = bool(burn) or utilization > self.target_util
+        if pressured:
+            self._low_util_since = None
+            if workers >= self.max_workers:
+                return "hold", "pressure at max_workers", None
+            if self.launcher is None:
+                return "hold", "pressure but no launcher", None
+            target = self._actuate(self.launcher, "launch")
+            if target is None:
+                return "hold", "pressure but nothing launchable", None
+            reason = (
+                f"burn:{','.join(burn)}" if burn
+                else f"utilization {utilization:.2f} > {self.target_util:.2f}"
+            )
+            return "scale_up", reason, target
+        if utilization < self.target_util / 2.0 and workers > self.min_workers:
+            if self._low_util_since is None:
+                self._low_util_since = now
+            held = now - self._low_util_since
+            if held < self.down_hold:
+                return (
+                    "hold",
+                    f"low utilization held {held:.0f}s/"
+                    f"{self.down_hold:.0f}s",
+                    None,
+                )
+            if self.drainer is None:
+                return "hold", "idle but no drainer", None
+            target = self._actuate(self.drainer, "drain")
+            if target is None:
+                return "hold", "idle but nothing drainable", None
+            self._low_util_since = None
+            return (
+                "scale_down",
+                f"utilization {utilization:.2f} < "
+                f"{self.target_util / 2.0:.2f} for {self.down_hold:.0f}s",
+                target,
+            )
+        self._low_util_since = None
+        return "hold", "within band", None
+
+    @staticmethod
+    def _actuate(fn: Callable[[], Optional[str]], what: str) -> Optional[str]:
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 - actuation is best effort
+            log(f"autoscale: {what} failed: {exc}")
+            return None
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.wait(self.interval):
+                try:
+                    self.step()
+                except Exception as exc:  # noqa: BLE001 - keep looping
+                    debug_log(f"autoscale step failed: {exc}")
+
+        self._thread = threading.Thread(
+            target=run, name="cdt-autoscale", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # --- introspection -----------------------------------------------------
+
+    def status(self, limit: int = 32) -> dict[str, Any]:
+        with self._lock:
+            recent = list(self.decisions)[-max(1, int(limit)):]
+        workers, chips = self._capacity()
+        return {
+            "enabled": True,
+            "interval_s": self.interval,
+            "bounds": {"min": self.min_workers, "max": self.max_workers},
+            "target_utilization": self.target_util,
+            "down_hold_s": self.down_hold,
+            "workers": workers,
+            "chips": chips,
+            "decisions": recent,
+        }
+
+
+def managed_worker_actuators(
+    config_path: Optional[str] = None,
+) -> tuple[Callable[[], Optional[str]], Callable[[], Optional[str]],
+           Callable[[], tuple[int, float]]]:
+    """(launcher, drainer, capacity_fn) over the managed local-worker
+    pool: launch the first enabled-but-not-running local config entry,
+    drain (SIGTERM → graceful drain → stop) the most recently launched
+    one, count capacity as running workers × their configured chips."""
+    from ..utils import config as config_mod
+    from .. import workers as _workers  # noqa: F401 - package anchor
+    from ..workers.process_manager import get_worker_manager
+
+    def _entries() -> list[dict[str, Any]]:
+        config = config_mod.load_config(config_path)
+        return [
+            w for w in config.get("workers", [])
+            if w.get("type") in ("local",)
+        ]
+
+    def _running() -> dict[str, Any]:
+        manager = get_worker_manager()
+        return manager.managed_processes(config_path)
+
+    def launcher() -> Optional[str]:
+        manager = get_worker_manager()
+        running = _running()
+        for worker in _entries():
+            worker_id = str(worker.get("id") or worker.get("name") or "")
+            if not worker_id or worker_id in running:
+                continue
+            if not worker.get("enabled"):
+                continue
+            manager.launch_worker(worker, config_path)
+            return worker_id
+        return None
+
+    def drainer() -> Optional[str]:
+        manager = get_worker_manager()
+        running = _running()
+        if not running:
+            return None
+        worker_id = sorted(running)[-1]
+        # stop_worker's kill tree leads with SIGTERM: the worker's
+        # registered drain handler (workers/startup.py) finishes the
+        # in-flight device batch and returns unprocessed tiles first
+        manager.stop_worker(worker_id, config_path)
+        return worker_id
+
+    def capacity_fn() -> tuple[int, float]:
+        running = _running()
+        chips_by_id = {
+            str(w.get("id") or w.get("name") or ""):
+                max(1, len(w.get("tpu_chips") or [0]))
+            for w in _entries()
+        }
+        chips = sum(chips_by_id.get(wid, 1) for wid in running)
+        return len(running), float(chips)
+
+    return launcher, drainer, capacity_fn
+
+
+__all__ = ["AutoscaleController", "managed_worker_actuators"]

@@ -262,6 +262,47 @@ PUSH_GRANTS_ENABLED = os.environ.get("CDT_PUSH_GRANTS", "1") != "0"
 # pull protocol's immediate exit).
 PUSH_WAIT_SECONDS = _env_float("CDT_PUSH_WAIT", 1.0)
 
+# --- region mode: quorum lease, sharded masters, autoscaler ---------------
+# Quorum lease peers (durability/quorum.py): a comma-separated list of
+# peer register directories (one per lease-holder node). Non-empty
+# switches the master lease from the shared-filesystem flock sidecar
+# to majority agreement across these registers — the standby then
+# needs no shared filesystem at all. Empty keeps the file lease.
+LEASE_PEERS = [
+    p.strip() for p in os.environ.get("CDT_LEASE_PEERS", "").split(",")
+    if p.strip()
+]
+# Shard map for region mode (scheduler/router.py): shards separated by
+# ';', each shard a comma-separated master address list (active first,
+# standbys after), e.g. "http://a:8188,http://a2:8188;http://b:8188".
+# Empty = unsharded (single master, the pre-region topology).
+SHARDS_SPEC = os.environ.get("CDT_SHARDS", "")
+# Virtual nodes per shard on the consistent-hash ring: more vnodes =
+# smoother job spread and smaller reshuffle when a shard joins/leaves.
+SHARD_VNODES = _env_int("CDT_SHARD_VNODES", 64)
+# Per-URL backoff for the worker client's master endpoints: after a
+# failure burst an address sits out base*2^k seconds (capped) so a
+# dead/lagging shard address can't throttle pulls against healthy
+# ones; any response resets its schedule.
+ROUTER_BACKOFF_BASE_SECONDS = _env_float("CDT_ROUTER_BACKOFF_BASE", 0.5)
+ROUTER_BACKOFF_CAP_SECONDS = _env_float("CDT_ROUTER_BACKOFF_CAP", 30.0)
+# Usage-driven autoscaler (scheduler/autoscale.py): 1 starts the
+# control loop on masters — SLO burn alerts + measured chip-second
+# demand drive launch/drain of managed local workers.
+AUTOSCALE_ENABLED = _env_int("CDT_AUTOSCALE", 0) == 1
+# Seconds between autoscaler evaluations (each evaluation emits one
+# decision record with measured chip-second cost/benefit).
+AUTOSCALE_INTERVAL_SECONDS = _env_float("CDT_AUTOSCALE_INTERVAL", 15.0)
+# Managed-worker count bounds the controller may scale between.
+AUTOSCALE_MIN_WORKERS = _env_int("CDT_AUTOSCALE_MIN", 1)
+AUTOSCALE_MAX_WORKERS = _env_int("CDT_AUTOSCALE_MAX", 8)
+# Demand/capacity ratio the controller steers toward: above it scale
+# up, below half of it (sustained for the hold window) scale down.
+AUTOSCALE_TARGET_UTILIZATION = _env_float("CDT_AUTOSCALE_TARGET_UTIL", 0.70)
+# Low utilization must persist this long before a scale-down drains a
+# worker — scale-up is immediate, scale-down is patient (thrash guard).
+AUTOSCALE_DOWN_HOLD_SECONDS = _env_float("CDT_AUTOSCALE_DOWN_HOLD", 120.0)
+
 # --- fleet observability plane (telemetry/fleet.py, telemetry/slo.py) -----
 # Master toggle for the fleet plane: 0 disables the monitor thread,
 # master-side sampling, and SLO evaluation entirely (the routes then

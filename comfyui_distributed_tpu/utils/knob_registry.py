@@ -219,6 +219,54 @@ KNOBS: tuple[Knob, ...] = (
          "run this master as a warm standby tailing the journal stream."),
     Knob("CDT_STANDBY_POLL", "1.0", "ha",
          "Standby reconnect/lease-poll cadence in seconds."),
+    # --- region control plane (quorum lease / shards / autoscaler) -------
+    Knob("CDT_AUTOSCALE", "0", "region",
+         "`1` starts the usage-driven autoscaler loop on masters "
+         "(scheduler/autoscale.py): SLO burn-rate alerts and measured "
+         "chip-second demand drive launch/drain of managed local workers, "
+         "each decision journaled with its chip-second cost/benefit."),
+    Knob("CDT_AUTOSCALE_DOWN_HOLD", "120.0", "region",
+         "Seconds utilization must stay below half the target before a "
+         "scale-down drains a worker; scale-up is immediate, scale-down "
+         "is patient (thrash guard)."),
+    Knob("CDT_AUTOSCALE_INTERVAL", "15.0", "region",
+         "Seconds between autoscaler evaluations; each evaluation emits "
+         "one decision record and settles the previous decision's "
+         "measured capacity/demand deltas."),
+    Knob("CDT_AUTOSCALE_MAX", "8", "region",
+         "Upper bound on managed worker count; pressure at the bound "
+         "holds with `reason=pressure at max_workers` instead of "
+         "launching."),
+    Knob("CDT_AUTOSCALE_MIN", "1", "region",
+         "Lower bound on managed worker count; scale-down never drains "
+         "below it."),
+    Knob("CDT_AUTOSCALE_TARGET_UTIL", "0.70", "region",
+         "Demand/capacity chip-second ratio the controller steers "
+         "toward: above it scale up, below half of it (sustained for "
+         "the hold window) scale down."),
+    Knob("CDT_LEASE_PEERS", "empty", "region",
+         "Comma-separated lease-peer register directories; non-empty "
+         "switches the master lease from the shared-filesystem flock "
+         "sidecar to majority agreement across these registers "
+         "(durability/quorum.py) — epoch fencing and FencedOut "
+         "semantics carry over unchanged."),
+    Knob("CDT_ROUTER_BACKOFF_BASE", "0.5", "region",
+         "Base of the per-URL exponential backoff window "
+         "(base*2^bursts seconds) a master address sits out after a "
+         "failure burst trips the rotation threshold."),
+    Knob("CDT_ROUTER_BACKOFF_CAP", "30.0", "region",
+         "Ceiling in seconds on the per-URL backoff window so a "
+         "long-dead address is still re-probed at a bounded cadence."),
+    Knob("CDT_SHARDS", "empty", "region",
+         "Region shard map: shards separated by `;`, each a "
+         "comma-separated master address list (active first, standbys "
+         "after). Non-empty enables consistent-hash job routing "
+         "(scheduler/router.py); empty keeps the single-master "
+         "topology."),
+    Knob("CDT_SHARD_VNODES", "64", "region",
+         "Virtual nodes per shard on the consistent-hash ring: more "
+         "vnodes = smoother job spread and smaller reshuffle when a "
+         "shard joins or leaves."),
     # --- telemetry -------------------------------------------------------
     Knob("CDT_METRIC_MAX_SERIES", "128", "telemetry",
          "Per-metric label-series cap; excess series collapse into `_overflow`."),

@@ -44,6 +44,7 @@ import {
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
+  regionHtml,
   renderVocabBanner,
   renderWorkers,
   renderWorkflowNodes,
@@ -99,6 +100,7 @@ async function refreshStatus() {
   refreshScheduler();
   refreshPipeline();
   refreshDurability();
+  refreshRegion();
   refreshFleet();
   refreshUsage();
   refreshIncidents();
@@ -140,6 +142,21 @@ async function refreshDurability() {
     container.innerHTML = durabilityHtml(await api("/distributed/durability"));
   } catch {
     container.textContent = "durability status unreachable";
+  }
+}
+
+// ---------- region control-plane card ----------
+
+async function refreshRegion() {
+  const container = document.getElementById("region");
+  try {
+    const [region, autoscale] = await Promise.all([
+      api("/distributed/region"),
+      api("/distributed/autoscale").catch(() => null),
+    ]);
+    container.innerHTML = regionHtml(region, autoscale);
+  } catch {
+    container.textContent = "region status unreachable";
   }
 }
 

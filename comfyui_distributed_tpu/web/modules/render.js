@@ -426,6 +426,87 @@ export function durabilityHtml(info) {
   return rows.join("");
 }
 
+/** Region card (pure; app.js refreshRegion applies it): the shard
+ * map + per-endpoint health from GET /distributed/region, the lease
+ * view (file or quorum with every peer's register), and the
+ * autoscaler's latest decisions with their chip-second cost lines
+ * from GET /distributed/autoscale. */
+export function regionHtml(region, autoscale) {
+  if (!region) return '<span class="meta">region status unavailable</span>';
+  const rows = [];
+  if (!region.enabled) {
+    rows.push(
+      '<div class="row"><span class="meta">unsharded — set CDT_SHARDS ' +
+        "for a multi-master region</span></div>"
+    );
+  } else {
+    const shards = (region.shards || {}).shards || {};
+    for (const name of Object.keys(shards).sort()) {
+      const shard = shards[name];
+      const endpoints = (shard.endpoints || [])
+        .map((e) => {
+          const backoff = Number(e.backoff_remaining_s || 0);
+          return (
+            `${e.current ? "<b>" : ""}${escapeHtml(e.url)}` +
+            `${e.current ? "</b>" : ""}` +
+            (backoff > 0 ? ` (backoff ${backoff.toFixed(1)}s)` : "")
+          );
+        })
+        .join(" · ");
+      rows.push(
+        `<div class="row"><strong>${escapeHtml(name)}</strong>` +
+          `<span class="meta">epoch ${shard.epoch ?? "?"} · ` +
+          `${endpoints}</span></div>`
+      );
+    }
+  }
+  const lease = region.lease;
+  if (lease) {
+    const peers = (lease.peers || [])
+      .map((p) => {
+        if (p.error) return `${escapeHtml(p.name)}:ERR`;
+        const peerEpoch = (p.state || {}).epoch ?? "-";
+        return `${escapeHtml(p.name)}:e${peerEpoch}`;
+      })
+      .join(" ");
+    rows.push(
+      `<div class="row"><strong>lease</strong><span class="meta">` +
+        `${escapeHtml(lease.backend || "file")} · epoch ${lease.epoch ?? 0}` +
+        (lease.quorum ? ` · quorum ${lease.quorum}` : "") +
+        (peers ? ` · ${peers}` : "") +
+        (region.deposed ? ' · <span class="busy">DEPOSED</span>' : "") +
+        `</span></div>`
+    );
+  }
+  if (autoscale && autoscale.enabled) {
+    const bounds = autoscale.bounds || {};
+    const last = (autoscale.decisions || []).slice(-3).reverse();
+    const lines = last
+      .map(
+        (d) =>
+          `<div class="row"><strong>${escapeHtml(d.action)}</strong>` +
+          `<span class="meta">${escapeHtml(d.reason || "")} · ` +
+          `util ${(Number(d.utilization ?? 0) * 100).toFixed(0)}% · ` +
+          `${Number(d.demand_chip_s ?? 0).toFixed(1)}/` +
+          `${Number(d.capacity_chip_s ?? 0).toFixed(1)} chip-s</span></div>`
+      )
+      .join("");
+    rows.push(
+      `<div class="row"><strong>autoscale</strong><span class="meta">` +
+        `${autoscale.workers ?? 0} worker(s) / ${autoscale.chips ?? 0} ` +
+        `chip(s) · bounds ${bounds.min ?? "?"}–${bounds.max ?? "?"} · target ` +
+        `${(Number(autoscale.target_utilization ?? 0) * 100).toFixed(0)}%` +
+        `</span></div>` + lines
+    );
+  } else {
+    rows.push(
+      '<div class="row"><span class="meta">autoscaler off — set ' +
+        "CDT_AUTOSCALE=1 to enable</span></div>"
+    );
+  }
+  return rows.join("");
+}
+
 /** Topology summary line (pure; app.js renderTopology applies it). */
 export function topologyHtml(info) {
   const topo = info.topology || {};

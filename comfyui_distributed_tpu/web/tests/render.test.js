@@ -11,6 +11,7 @@ import {
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
+  regionHtml,
   schedulerHtml,
   topologyHtml,
   usageHtml,
@@ -328,5 +329,69 @@ test("incidentsHtml: disabled / flight accounting / bundle rows", () => {
   assertIncludes(
     incidentsHtml({ enabled: true, incidents: [] }),
     "no incident bundles"
+  );
+});
+
+test("regionHtml: unsharded / shard map / quorum lease / autoscale", () => {
+  assertIncludes(regionHtml(null), "unavailable");
+  const off = regionHtml({ enabled: false, shards: { shards: {} } }, null);
+  assertIncludes(off, "CDT_SHARDS");
+  assertIncludes(off, "CDT_AUTOSCALE=1");
+  const region = {
+    enabled: true,
+    deposed: false,
+    shards: {
+      shards: {
+        shard0: {
+          epoch: 4,
+          urls: ["http://a:8188", "http://a2:8188"],
+          endpoints: [
+            { url: "http://a:8188", current: true, backoff_remaining_s: 0 },
+            { url: "http://a2:8188", current: false, backoff_remaining_s: 2.5 },
+          ],
+        },
+      },
+    },
+    lease: {
+      backend: "quorum",
+      epoch: 4,
+      quorum: 2,
+      peers: [
+        { name: "peer0", state: { epoch: 4 } },
+        { name: "peer1", error: "EIO" },
+      ],
+    },
+  };
+  const autoscale = {
+    enabled: true,
+    workers: 3,
+    chips: 3,
+    bounds: { min: 1, max: 8 },
+    target_utilization: 0.7,
+    decisions: [
+      {
+        action: "scale_up",
+        reason: "burn:tile_latency",
+        utilization: 0.91,
+        demand_chip_s: 18.2,
+        capacity_chip_s: 20.0,
+      },
+    ],
+  };
+  const html = regionHtml(region, autoscale);
+  assertIncludes(html, "shard0");
+  assertIncludes(html, "epoch 4");
+  assertIncludes(html, "backoff 2.5s");
+  assertIncludes(html, "quorum 2");
+  assertIncludes(html, "peer0:e4");
+  assertIncludes(html, "peer1:ERR");
+  assertIncludes(html, "scale_up");
+  assertIncludes(html, "burn:tile_latency");
+  assertIncludes(html, "18.2/20.0 chip-s");
+  assertIncludes(html, "bounds 1–8");
+  // a deposed master is loudly flagged
+  assertIncludes(
+    regionHtml({ ...region, deposed: true }, null),
+    "DEPOSED"
   );
 });

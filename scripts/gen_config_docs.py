@@ -34,6 +34,9 @@ _SUBSYSTEM_TITLES = {
     "resilience": "Resilience & fault injection",
     "lifecycle": "Request lifecycle (deadlines, cancel, poison, brownout)",
     "watchdog": "Watchdog",
+    "ha": "High availability (failover, push grants)",
+    "region": "Region control plane (quorum lease, shards, autoscaler)",
+    "incidents": "Incident plane",
     "scheduler": "Scheduler control plane",
     "durability": "Durable control plane",
     "pipeline": "Tile pipeline & compile cache",

@@ -1,0 +1,274 @@
+#!/usr/bin/env python3
+"""Region soak: rotating SIGKILLs of shard masters and lease peers.
+
+Two phases (CI job `region-soak` runs this and uploads the JSON report
+as an artifact):
+
+1. **quorum failover cycles** — `--cycles` in-process kill-the-active
+   scenarios (resilience/chaos.run_chaos_failover) arbitrated by ONE
+   shared set of quorum lease peers and ONE shared journal directory,
+   so each promoted master is the active the NEXT cycle kills and the
+   lease epoch must climb strictly across the whole ladder. The kill
+   rotation covers both faces of the control plane: the shard master
+   (after a pull, after a partial submit) and the lease peers
+   themselves (a peer crashing mid-acquire before/after applying the
+   proposal; a peer dead for an entire cycle — the SIGKILL'd-register
+   case, survivable because any minority of dead peers still leaves an
+   electing majority). Every cycle must (a) fire its crash, (b) elect
+   exactly one new master through the surviving majority, (c) produce
+   a canvas bit-identical to the uninterrupted baseline, and (d) prove
+   fencing: the zombie's journal append raises, stale-epoch RPCs are
+   rejected, and the zombie journals zero records.
+
+2. **region cycles** — `--region-cycles` two-shard region runs
+   (resilience/chaos.run_chaos_region): shard0's master dies mid-job
+   and fails over through the quorum lease while shard1's job — open
+   across the whole outage — completes with zero tile loss on its own
+   epoch, the consistent-hash placement map never moves, and the
+   autoscaler's decision ledger spans the outage with measured
+   chip-second cost/benefit.
+
+    python scripts/region_soak.py [--out region_soak.json]
+        [--cycles 6] [--region-cycles 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+SEED = 11
+N_PEERS = 3
+
+# Rotating kill points: (name, crash plan, peer_crash mode, index of a
+# peer held dead for the whole cycle). The master plans are the same
+# guaranteed-to-fire store-RPC faults failover_soak uses; the peer
+# faults exercise the quorum medium itself.
+KILL_POINTS = [
+    ("master_after_pull", "crash@store:pull:master#2", None, None),
+    ("master_after_partial_submit",
+     "latency(1.0)@store:pull:w1#1;latency(1.0)@store:pull:w2#1;"
+     "crash@store:submit:master#1", None, None),
+    ("peer_crash_mid_acquire_write_lost",
+     "crash@store:pull:master#2", "before", None),
+    ("peer_crash_mid_acquire_ack_lost",
+     "crash@store:pull:master#2", "after", None),
+    ("lease_peer_down_all_cycle", "crash@store:pull:master#2", None, 0),
+]
+
+
+def run_quorum_cycles(cycles: int) -> dict:
+    import numpy as np
+
+    from comfyui_distributed_tpu.durability import MemoryLeasePeer
+    from comfyui_distributed_tpu.resilience.chaos import (
+        run_chaos_failover,
+        run_chaos_usdu,
+    )
+
+    baseline = run_chaos_usdu(seed=SEED).output
+    # ONE peer set for the whole ladder: the registers carry the epoch
+    # across cycles, exactly as region peers would across failovers.
+    peers = [MemoryLeasePeer(f"soak-peer{i}") for i in range(N_PEERS)]
+    results = []
+    last_epoch = 0
+    with tempfile.TemporaryDirectory(prefix="cdt-region-soak-") as journal_dir:
+        for cycle in range(cycles):
+            name, plan, peer_crash, dead_peer = (
+                KILL_POINTS[cycle % len(KILL_POINTS)]
+            )
+            # rotate WHICH peer dies so every register gets its turn
+            if dead_peer is not None:
+                dead_peer = cycle % N_PEERS
+                peers[dead_peer].crashed = True
+            started = time.perf_counter()
+            entry = {
+                "cycle": cycle,
+                "kill_point": name,
+                "peer_crash": peer_crash,
+                "dead_peer": dead_peer,
+            }
+            try:
+                result = run_chaos_failover(
+                    seed=SEED,
+                    crash_plan=plan,
+                    journal_dir=journal_dir,
+                    quorum_peers=peers,
+                    peer_crash=peer_crash,
+                    job_id=f"soak-region-{cycle}",
+                )
+                identical = bool(np.array_equal(baseline, result.output))
+                epoch_climbed = (
+                    result.epochs[1] > result.epochs[0] > last_epoch
+                )
+                entry.update(
+                    {
+                        "crash_fired": "crash" in result.fired_kinds(),
+                        "epochs": list(result.epochs),
+                        "epoch_climbed": epoch_climbed,
+                        "bit_identical": identical,
+                        "zombie_fenced": result.zombie_fenced,
+                        "stale_pull_rejected": result.stale_pull_rejected,
+                        "stale_submit_rejected": result.stale_submit_rejected,
+                        "zombie_journaled_records":
+                            result.zombie_journaled_records,
+                        "jobs_recovered": result.report["jobs_recovered"],
+                        "seconds": round(time.perf_counter() - started, 2),
+                    }
+                )
+                entry["ok"] = (
+                    entry["crash_fired"]
+                    and epoch_climbed
+                    and identical
+                    and result.zombie_fenced
+                    and result.stale_pull_rejected
+                    and result.stale_submit_rejected
+                    and result.zombie_journaled_records == 0
+                )
+                last_epoch = result.epochs[1]
+            except Exception as exc:  # noqa: BLE001 - reported per cycle
+                entry.update(
+                    {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+                )
+            finally:
+                if dead_peer is not None:
+                    peers[dead_peer].crashed = False
+            results.append(entry)
+            status = "ok" if entry["ok"] else "FAIL"
+            print(
+                f"cycle {cycle} [{name}]: {status} "
+                f"(epochs {entry.get('epochs')})"
+            )
+    return {
+        "ok": all(r["ok"] for r in results),
+        "cycles": cycles,
+        "final_epoch": last_epoch,
+        "peer_epochs": [
+            getattr(p.read(), "epoch", None) for p in peers
+        ],
+        "results": results,
+    }
+
+
+def run_region_cycles(cycles: int) -> dict:
+    import numpy as np
+
+    from comfyui_distributed_tpu.resilience.chaos import (
+        run_chaos_region,
+        run_chaos_usdu,
+    )
+
+    baseline = run_chaos_usdu(seed=SEED).output
+    peer_modes = [None, "before", "after"]
+    results = []
+    for cycle in range(cycles):
+        peer_crash = peer_modes[cycle % len(peer_modes)]
+        started = time.perf_counter()
+        entry = {"cycle": cycle, "peer_crash": peer_crash}
+        try:
+            with tempfile.TemporaryDirectory(
+                prefix="cdt-region-soak-shards-"
+            ) as root:
+                result = run_chaos_region(
+                    seed=SEED,
+                    journal_root=root,
+                    peer_crash=peer_crash,
+                )
+            ups = [
+                d for d in result.autoscale_decisions
+                if d["action"] == "scale_up"
+            ]
+            entry.update(
+                {
+                    "shard0_bit_identical": bool(
+                        np.array_equal(baseline, result.shard0.output)
+                    ),
+                    "shard0_epochs": list(result.shard0.epochs),
+                    "shard0_zombie_fenced": result.shard0.zombie_fenced,
+                    "shard1_tiles_completed": result.shard1_tiles_completed,
+                    "shard1_epoch": result.shard1_epoch,
+                    "placement_drift": result.placement_drift,
+                    "autoscale_decisions": len(result.autoscale_decisions),
+                    "scale_up_measured": bool(
+                        ups and ups[0].get("measured")
+                    ),
+                    "seconds": round(time.perf_counter() - started, 2),
+                }
+            )
+            entry["ok"] = (
+                entry["shard0_bit_identical"]
+                and result.shard0.zombie_fenced
+                and result.shard0.zombie_journaled_records == 0
+                and result.shard1_tiles_completed == 4
+                and result.shard1_epoch == 1
+                and result.placement_drift == 0
+                and entry["scale_up_measured"]
+            )
+        except Exception as exc:  # noqa: BLE001 - reported per cycle
+            entry.update(
+                {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            )
+        results.append(entry)
+        status = "ok" if entry["ok"] else "FAIL"
+        print(
+            f"region cycle {cycle} [peer_crash={peer_crash}]: {status} "
+            f"(drift {entry.get('placement_drift')}, "
+            f"shard1 {entry.get('shard1_tiles_completed')}/4 tiles)"
+        )
+    return {
+        "ok": all(r["ok"] for r in results),
+        "cycles": cycles,
+        "results": results,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="region_soak.json")
+    parser.add_argument("--cycles", type=int, default=6)
+    parser.add_argument(
+        "--region-cycles", type=int, default=2,
+        help="two-shard region runs (0 skips the phase)",
+    )
+    args = parser.parse_args(argv)
+
+    quorum = run_quorum_cycles(args.cycles)
+    region = (
+        {"ok": True, "skipped": True}
+        if args.region_cycles <= 0
+        else run_region_cycles(args.region_cycles)
+    )
+    report = {
+        "ok": quorum["ok"] and region["ok"],
+        "quorum_cycles": quorum,
+        "region_cycles": region,
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    passed = sum(1 for r in quorum["results"] if r.get("ok"))
+    print(
+        f"quorum cycles: {passed}/{quorum['cycles']} elected "
+        f"bit-identical with fencing (final epoch "
+        f"{quorum['final_epoch']}) -> {'OK' if quorum['ok'] else 'FAIL'}"
+    )
+    if not region.get("skipped"):
+        rpassed = sum(1 for r in region["results"] if r.get("ok"))
+        print(
+            f"region cycles: {rpassed}/{region['cycles']} zero "
+            f"cross-shard loss, zero placement drift -> "
+            f"{'OK' if region['ok'] else 'FAIL'}"
+        )
+    print(f"report written to {args.out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,188 @@
+"""Region control-plane routes over real HTTP: the shard map, the
+quorum-lease view (a master arbitrating through off-node peer
+registers instead of a shared-filesystem flock), and the autoscaler's
+decision ledger."""
+
+import asyncio
+import json
+import socket
+import urllib.error
+import urllib.request
+from unittest import mock
+
+import pytest
+
+from comfyui_distributed_tpu.api.server import DistributedServer
+from comfyui_distributed_tpu.utils.async_helpers import ServerLoopThread
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _post_json(url: str, timeout=10):
+    req = urllib.request.Request(url, data=b"{}", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _run(loop_thread, coro, timeout=30):
+    return asyncio.run_coroutine_threadsafe(coro, loop_thread.loop).result(
+        timeout=timeout
+    )
+
+
+@pytest.fixture()
+def loop_thread():
+    thread = ServerLoopThread()
+    thread.start()
+    yield thread
+    thread.stop()
+
+
+def _start_server(loop_thread):
+    port = _free_port()
+    srv = DistributedServer(port=port, is_worker=False)
+    _run(loop_thread, srv.start())
+    return srv, port
+
+
+def test_region_route_reports_unsharded_default(
+    tmp_config_path, loop_thread, monkeypatch
+):
+    monkeypatch.delenv("CDT_JOURNAL_DIR", raising=False)
+    srv, port = _start_server(loop_thread)
+    try:
+        status, body = _get_json(f"http://127.0.0.1:{port}/distributed/region")
+        assert status == 200
+        assert body["enabled"] is False
+        assert body["shards"]["shards"] == {}
+        assert body["lease"] is None
+        status, body = _get_json(
+            f"http://127.0.0.1:{port}/distributed/autoscale"
+        )
+        assert status == 200
+        assert body["enabled"] is False
+    finally:
+        _run(loop_thread, srv.stop())
+
+
+def test_region_route_serves_shard_map(
+    tmp_config_path, loop_thread, monkeypatch
+):
+    from comfyui_distributed_tpu.utils import constants
+
+    monkeypatch.delenv("CDT_JOURNAL_DIR", raising=False)
+    monkeypatch.setattr(
+        constants, "SHARDS_SPEC",
+        "http://a:8188,http://a2:8188;http://b:8188",
+    )
+    srv, port = _start_server(loop_thread)
+    try:
+        status, body = _get_json(f"http://127.0.0.1:{port}/distributed/region")
+        assert status == 200
+        assert body["enabled"] is True
+        shards = body["shards"]["shards"]
+        assert sorted(shards) == ["shard0", "shard1"]
+        assert shards["shard0"]["urls"] == ["http://a:8188", "http://a2:8188"]
+        assert shards["shard1"]["endpoints"][0]["url"] == "http://b:8188"
+    finally:
+        _run(loop_thread, srv.stop())
+
+
+def test_quorum_leased_master_journals_and_reports(
+    tmp_config_path, tmp_path, loop_thread, monkeypatch
+):
+    """CDT_LEASE_PEERS swaps the file lease for the quorum backend: the
+    master acquires epoch 1 through a majority of peer registers, the
+    journal seam works unchanged, and the region route exposes every
+    peer's register for split-brain forensics."""
+    from comfyui_distributed_tpu.utils import constants
+
+    peers = [str(tmp_path / f"peer{i}") for i in range(3)]
+    monkeypatch.setattr(constants, "LEASE_PEERS", peers)
+    env = {
+        "CDT_JOURNAL_DIR": str(tmp_path / "wal"),
+        "CDT_JOURNAL_FSYNC": "0",
+    }
+    with mock.patch.dict("os.environ", env):
+        srv, port = _start_server(loop_thread)
+        try:
+            from comfyui_distributed_tpu.durability import QuorumLease
+
+            assert isinstance(srv.durability.lease, QuorumLease)
+            assert srv.job_store.epoch == 1
+
+            async def mutate():
+                await srv.job_store.init_tile_job("job-r", [0, 1])
+
+            _run(loop_thread, mutate())
+            assert srv.durability._appends == 1
+
+            status, body = _get_json(
+                f"http://127.0.0.1:{port}/distributed/region"
+            )
+            assert status == 200
+            lease = body["lease"]
+            assert lease["backend"] == "quorum"
+            assert lease["epoch"] == 1
+            assert lease["quorum"] == 2
+            assert len(lease["peers"]) == 3
+            assert all(
+                p["state"]["owner"].startswith("master:")
+                for p in lease["peers"]
+            )
+        finally:
+            _run(loop_thread, srv.stop())
+
+
+def test_autoscale_route_disabled_step_answers_409(
+    tmp_config_path, loop_thread, monkeypatch
+):
+    monkeypatch.delenv("CDT_JOURNAL_DIR", raising=False)
+    srv, port = _start_server(loop_thread)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_json(
+                f"http://127.0.0.1:{port}/distributed/autoscale/step"
+            )
+        assert err.value.code == 409
+    finally:
+        _run(loop_thread, srv.stop())
+
+
+def test_autoscale_route_reports_decisions(
+    tmp_config_path, loop_thread, monkeypatch
+):
+    monkeypatch.delenv("CDT_JOURNAL_DIR", raising=False)
+    monkeypatch.setenv("CDT_AUTOSCALE", "1")
+    from comfyui_distributed_tpu.utils import constants
+
+    monkeypatch.setattr(constants, "AUTOSCALE_ENABLED", True)
+    # a long interval so only the forced steps below evaluate
+    monkeypatch.setattr(constants, "AUTOSCALE_INTERVAL_SECONDS", 3600.0)
+    srv, port = _start_server(loop_thread)
+    try:
+        assert srv.autoscale is not None
+        status, body = _post_json(
+            f"http://127.0.0.1:{port}/distributed/autoscale/step"
+        )
+        assert status == 200
+        decision = body["decision"]
+        assert decision["action"] == "hold"
+        assert "demand_chip_s" in decision and "capacity_chip_s" in decision
+        status, body = _get_json(
+            f"http://127.0.0.1:{port}/distributed/autoscale"
+        )
+        assert status == 200
+        assert body["enabled"] is True
+        assert len(body["decisions"]) >= 1
+    finally:
+        _run(loop_thread, srv.stop())

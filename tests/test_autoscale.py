@@ -1,0 +1,252 @@
+"""Autoscale policy units (scheduler/autoscale.py): burn-alert and
+utilization triggers, patient scale-down, bounds, and the measured
+chip-second cost/benefit ledger every decision must carry."""
+
+import pytest
+
+from comfyui_distributed_tpu.scheduler.autoscale import AutoscaleController
+
+pytestmark = pytest.mark.fast
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class FakeSLO:
+    def __init__(self):
+        self.burning = set()
+
+    def is_active(self, name):
+        return name in self.burning
+
+
+class FakeUsage:
+    """Cumulative chip-second counter, like UsageAggregator.rollup()."""
+
+    def __init__(self):
+        self.chip_s = 0.0
+
+    def rollup(self):
+        return {"totals": {"chip_s": self.chip_s}}
+
+
+class Fleet:
+    """Launcher/drainer/capacity over an in-memory worker pool."""
+
+    def __init__(self, workers=2, chips_each=1.0):
+        self.workers = workers
+        self.chips_each = chips_each
+        self.launched = []
+        self.drained = []
+
+    def launcher(self):
+        self.workers += 1
+        wid = f"w{self.workers}"
+        self.launched.append(wid)
+        return wid
+
+    def drainer(self):
+        if self.workers <= 0:
+            return None
+        wid = f"w{self.workers}"
+        self.workers -= 1
+        self.drained.append(wid)
+        return wid
+
+    def capacity(self):
+        return self.workers, self.workers * self.chips_each
+
+
+def controller(clock, fleet, slo=None, usage=None, **kw):
+    kw.setdefault("interval", 10.0)
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 4)
+    kw.setdefault("target_util", 0.70)
+    kw.setdefault("down_hold", 60.0)
+    return AutoscaleController(
+        slo=slo, usage=usage,
+        launcher=fleet.launcher, drainer=fleet.drainer,
+        capacity_fn=fleet.capacity, clock=clock, **kw,
+    )
+
+
+def test_burn_alert_forces_scale_up():
+    clock, fleet, slo = Clock(), Fleet(workers=2), FakeSLO()
+    ctrl = controller(clock, fleet, slo=slo, usage=FakeUsage())
+    slo.burning.add("tile_latency")
+    record = ctrl.step()
+    assert record["action"] == "scale_up"
+    assert "burn:tile_latency" in record["reason"]
+    assert record["burn_alerts"] == ["tile_latency"]
+    assert fleet.launched == ["w3"]
+
+
+def test_journal_latency_burn_is_not_a_scale_signal():
+    """journal_latency burns point at the disk — more workers would
+    add journal load, not relieve it."""
+    clock, fleet, slo = Clock(), Fleet(workers=2), FakeSLO()
+    ctrl = controller(clock, fleet, slo=slo, usage=FakeUsage())
+    slo.burning.add("journal_latency")
+    assert ctrl.step()["action"] == "hold"
+    assert fleet.launched == []
+
+
+def test_high_utilization_scales_up():
+    clock, fleet, usage = Clock(), Fleet(workers=2), FakeUsage()
+    ctrl = controller(clock, fleet, usage=usage)
+    ctrl.step()  # baseline: establishes the cumulative counter
+    # window: 10s elapsed, 2 chips => 20 chip-s capacity; demand 18
+    clock.advance(10.0)
+    usage.chip_s += 18.0
+    record = ctrl.step()
+    assert record["action"] == "scale_up"
+    assert record["utilization"] == pytest.approx(0.9)
+    assert record["demand_chip_s"] == pytest.approx(18.0)
+    assert record["capacity_chip_s"] == pytest.approx(20.0)
+
+
+def test_scale_up_is_bounded_by_max_workers():
+    clock, fleet, slo = Clock(), Fleet(workers=4), FakeSLO()
+    ctrl = controller(clock, fleet, slo=slo, usage=FakeUsage(), max_workers=4)
+    slo.burning.add("availability")
+    record = ctrl.step()
+    assert record["action"] == "hold"
+    assert "max_workers" in record["reason"]
+    assert fleet.launched == []
+
+
+def test_scale_down_waits_out_the_hold_window():
+    clock, fleet, usage = Clock(), Fleet(workers=3), FakeUsage()
+    ctrl = controller(clock, fleet, usage=usage, down_hold=60.0)
+    ctrl.step()  # baseline
+    for _ in range(5):  # 50s of near-idle: still held
+        clock.advance(10.0)
+        usage.chip_s += 0.5
+        record = ctrl.step()
+        assert record["action"] == "hold", record
+    clock.advance(10.0)
+    usage.chip_s += 0.5
+    record = ctrl.step()
+    assert record["action"] == "scale_down"
+    assert fleet.drained == ["w3"]
+    assert fleet.workers == 2
+
+
+def test_pressure_resets_the_scale_down_hold():
+    clock, fleet, usage = Clock(), Fleet(workers=3), FakeUsage()
+    slo = FakeSLO()
+    ctrl = controller(clock, fleet, slo=slo, usage=usage, down_hold=30.0,
+                      max_workers=3)
+    ctrl.step()
+    clock.advance(10.0); ctrl.step()          # idle 10s
+    clock.advance(10.0); ctrl.step()          # idle 20s
+    slo.burning.add("deadline_miss")          # pressure: window resets
+    clock.advance(10.0); ctrl.step()
+    slo.burning.clear()
+    clock.advance(10.0)
+    record = ctrl.step()                      # idle again, held from zero
+    assert record["action"] == "hold"
+    assert fleet.drained == []
+
+
+def test_scale_down_respects_min_workers():
+    clock, fleet, usage = Clock(), Fleet(workers=1), FakeUsage()
+    ctrl = controller(clock, fleet, usage=usage, min_workers=1, down_hold=0.0)
+    ctrl.step()
+    clock.advance(10.0)
+    assert ctrl.step()["action"] == "hold"
+    assert fleet.drained == []
+
+
+def test_decisions_carry_measured_cost_benefit():
+    """The record settled one window later must show the chip-second
+    capacity delta the action bought — the operator's cost line."""
+    clock, fleet, usage, slo = Clock(), Fleet(workers=2), FakeUsage(), FakeSLO()
+    ctrl = controller(clock, fleet, slo=slo, usage=usage)
+    ctrl.step()  # baseline hold
+    slo.burning.add("tile_latency")
+    clock.advance(10.0)
+    usage.chip_s += 19.0
+    up = ctrl.step()  # scale_up: fleet 2 -> 3 chips
+    assert up["action"] == "scale_up" and up["measured"] is None
+    slo.burning.clear()
+    clock.advance(10.0)
+    usage.chip_s += 19.0
+    ctrl.step()
+    # the scale_up record is now settled with what the action bought
+    assert up["measured"] is not None
+    # capacity went from 2 chips x 10s to 3 chips x 10s = +10 chip-s
+    assert up["measured"]["capacity_delta_chip_s"] == pytest.approx(10.0)
+    assert up["measured"]["utilization_after"] == pytest.approx(19.0 / 30.0,
+                                                                abs=1e-3)
+
+
+def test_actuation_failure_degrades_to_hold():
+    clock, slo = Clock(), FakeSLO()
+    slo.burning.add("availability")
+
+    def broken_launcher():
+        raise RuntimeError("node pool exhausted")
+
+    ctrl = AutoscaleController(
+        slo=slo, usage=FakeUsage(), launcher=broken_launcher,
+        capacity_fn=lambda: (1, 1.0), clock=clock,
+        interval=10.0, min_workers=1, max_workers=4,
+        target_util=0.7, down_hold=60.0,
+    )
+    record = ctrl.step()
+    assert record["action"] == "hold"
+    assert "nothing launchable" in record["reason"]
+
+
+def test_signal_failures_never_crash_the_step():
+    class BrokenUsage:
+        def rollup(self):
+            raise OSError("metrics store down")
+
+    class BrokenSLO:
+        def is_active(self, name):
+            raise RuntimeError("slo engine down")
+
+    clock, fleet = Clock(), Fleet(workers=2)
+    ctrl = controller(clock, fleet, slo=BrokenSLO(), usage=BrokenUsage())
+    record = ctrl.step()
+    assert record["action"] == "hold"
+    assert record["burn_alerts"] == []
+
+
+def test_status_surfaces_bounds_and_recent_decisions():
+    clock, fleet = Clock(), Fleet(workers=2)
+    ctrl = controller(clock, fleet, usage=FakeUsage())
+    for _ in range(3):
+        clock.advance(10.0)
+        ctrl.step()
+    status = ctrl.status(limit=2)
+    assert status["enabled"] is True
+    assert status["bounds"] == {"min": 1, "max": 4}
+    assert len(status["decisions"]) == 2
+    assert status["workers"] == 2
+
+
+def test_background_loop_runs_and_stops():
+    clock, fleet = Clock(), Fleet(workers=2)
+    ctrl = controller(clock, fleet, usage=FakeUsage(), interval=0.02)
+    ctrl.start()
+    try:
+        import time as _time
+
+        deadline = _time.time() + 5.0
+        while not ctrl.decisions and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert ctrl.decisions, "loop never evaluated"
+    finally:
+        ctrl.stop()
+    assert ctrl._thread is None

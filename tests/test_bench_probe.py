@@ -91,6 +91,89 @@ def test_probe_block_skipped_carries_reason():
     assert bench._probe_block()["skip_reason"] == "disabled_by_env"
 
 
+@pytest.mark.fast
+def test_probe_candidates_env_list(monkeypatch):
+    """BENCH_PROBE_BACKENDS is an ordered platform list; unset means
+    one un-pinned probe of the default resolution (pre-region shape)."""
+    bench = _load_bench()
+    monkeypatch.delenv("BENCH_PROBE_BACKENDS", raising=False)
+    assert bench._probe_candidates() == [None]
+    monkeypatch.setenv("BENCH_PROBE_BACKENDS", "tpu, cpu")
+    assert bench._probe_candidates() == ["tpu", "cpu"]
+    monkeypatch.setenv("BENCH_PROBE_BACKENDS", " ,, ")
+    assert bench._probe_candidates() == [None]
+
+
+@pytest.mark.fast
+def test_probe_backends_wedged_plugin_cannot_mask_the_next(monkeypatch):
+    """Per-backend subprocess isolation: the first backend timing out
+    burns only its own attempt — the orchestrator moves on and the
+    next backend's health is judged in a fresh process."""
+    bench = _load_bench()
+    calls = []
+
+    def fake_probe(timeout_s, backend=None):
+        calls.append(backend)
+        status = "timeout" if backend == "tpu" else "ok"
+        bench._PROBE_ATTEMPTS.append(
+            {"timeout_s": timeout_s, "elapsed_s": 0.1, "status": status,
+             "backend": backend or "default", "phases": [],
+             "diagnostics": ""}
+        )
+        return status
+
+    monkeypatch.setattr(bench, "_probe_accelerator", fake_probe)
+    monkeypatch.setenv("BENCH_PROBE_BACKENDS", "tpu,cpu")
+    assert bench._probe_backends(9.0) == ("ok", "cpu")
+    assert calls == ["tpu", "cpu"]
+    # both attempts are in the history: the datum shows the wedged
+    # backend AND the healthy one that won
+    assert [a["backend"] for a in bench._PROBE_ATTEMPTS] == ["tpu", "cpu"]
+
+
+@pytest.mark.fast
+def test_probe_backends_all_failed_reports_last(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(
+        bench, "_probe_accelerator", lambda t, backend=None: "failed"
+    )
+    monkeypatch.setenv("BENCH_PROBE_BACKENDS", "tpu,axon")
+    assert bench._probe_backends(9.0) == ("failed", None)
+
+
+@pytest.mark.fast
+def test_probe_block_surfaces_backend_stage_and_versions():
+    """A staged timeout's datum names the backend, the stage it died
+    in, which clock killed it, and the plugin versions the child
+    reported before init — a wedged plugin is diagnosable from the
+    JSON alone."""
+    bench = _load_bench()
+    bench._PROBE_ATTEMPTS.append(
+        {
+            "timeout_s": 60.0,
+            "elapsed_s": 7.2,
+            "status": "timeout",
+            "backend": "tpu",
+            "timeout_kind": "stage_budget",
+            "timed_out_stage":
+                "backend init (plugin discovery + PJRT client + jax.devices)",
+            "plugin_versions": {
+                "dists": {"jax": "0.4.35", "libtpu": "0.0.1"},
+                "jax_plugins": ["libtpu=jax_plugins.libtpu"],
+            },
+            "phases": ["env at 0.0s | {}", "versions at 0.1s"],
+            "diagnostics": "",
+        }
+    )
+    block = bench._probe_block()
+    assert block["outcome"] == "timeout"
+    assert block["backend"] == "tpu"
+    assert block["timeout_kind"] == "stage_budget"
+    assert block["timed_out_stage"].startswith("backend init")
+    assert block["plugin_versions"]["dists"]["libtpu"] == "0.0.1"
+    json.dumps(block)
+
+
 @pytest.mark.slow
 def test_probe_child_ok_on_cpu():
     """The staged child reaches every phase and prints probe-ok when
@@ -137,8 +220,62 @@ def test_probe_timeout_harvests_stack_dump():
     attempt = bench._PROBE_ATTEMPTS[-1]
     assert status == "timeout"
     assert attempt["status"] == "timeout"
+    assert attempt["timeout_kind"] == "global"
     assert any(p.startswith("test hang hook") for p in attempt["phases"])
+    # the staged ledger names the stage the timeout died in
+    assert attempt["timed_out_stage"] == "test hang hook"
     # the SIGTERM-registered faulthandler names the hung frame
     assert "thread 0x" in attempt["diagnostics"].lower()
     assert "in _probe_child" in attempt["diagnostics"]
     json.dumps(attempt)  # must be JSON-serializable for BENCH_r05.json
+
+
+@pytest.mark.slow
+def test_probe_stage_budget_kills_a_stalled_stage_early():
+    """BENCH_PROBE_STAGE_TIMEOUT: the parent watches the child's phase
+    markers and kills a stage that stalls, long before the global
+    window — and the attempt names the stage and the clock that fired."""
+    bench = _load_bench()
+    os.environ["BENCH_PROBE_HANG"] = "1"
+    os.environ["BENCH_PROBE_STAGE_TIMEOUT"] = "2"
+    os.environ["BENCH_TERM_GRACE_S"] = "5"
+    try:
+        status = bench._probe_accelerator(120, backend="cpu")
+    finally:
+        del os.environ["BENCH_PROBE_HANG"]
+        del os.environ["BENCH_PROBE_STAGE_TIMEOUT"]
+        del os.environ["BENCH_TERM_GRACE_S"]
+    attempt = bench._PROBE_ATTEMPTS[-1]
+    assert status == "timeout"
+    assert attempt["timeout_kind"] == "stage_budget"
+    assert attempt["timed_out_stage"] == "test hang hook"
+    assert attempt["backend"] == "cpu"
+    # killed on the stage clock, nowhere near the 120 s global window
+    assert attempt["elapsed_s"] < 60
+    # the versions stage ran before the hang: the datum carries the
+    # parsed plugin versions even though the probe died
+    assert "dists" in attempt.get("plugin_versions", {})
+    json.dumps(attempt)
+
+
+@pytest.mark.slow
+def test_probe_version_pin_mismatch_fails_before_plugin_init():
+    """BENCH_PROBE_PIN: a drifted dist version is an instant, named
+    crash — the child exits before `import jax`, so a mismatched
+    plugin never gets the chance to wedge."""
+    env = dict(
+        os.environ,
+        BENCH_MODE="probe",
+        BENCH_PROBE_PLATFORM="cpu",
+        BENCH_PROBE_PIN="jax=0.0.0-never-shipped",
+        BENCH_PROBE_DEADLINE_S="120",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True,
+        text=True, timeout=180,
+    )
+    assert proc.returncode == 3
+    assert "version pin violated" in proc.stderr
+    assert "0.0.0-never-shipped" in proc.stderr
+    # fail-fast: the plugin was never imported
+    assert "probe phase: import jax" not in proc.stderr

@@ -1,0 +1,221 @@
+"""Adversarial lease cases (durability/lease.py): claimant races under
+the flock sidecar, indeterminate I/O during quorum-backend fallback,
+and clock-skewed held() verdicts. The invariant under attack is always
+the same one: two processes must never both believe they may journal
+under the same epoch."""
+
+import errno
+import threading
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.durability import lease as lease_mod
+from comfyui_distributed_tpu.durability.lease import (
+    Lease,
+    LeaseHeld,
+    LeaseState,
+)
+from comfyui_distributed_tpu.durability.quorum import (
+    MemoryLeasePeer,
+    QuorumLease,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def test_three_claimants_racing_an_expired_lease(tmp_path):
+    """Three threads race acquire() on the same expired lease: the
+    flock sidecar serializes the read-modify-write cycles, so exactly
+    one claimant takes epoch+1 and the other two re-read its fresh
+    lease and raise LeaseHeld — never a duplicated epoch."""
+    directory = str(tmp_path)
+    # an expired previous incarnation at epoch 5
+    old = Lease(directory, owner="old", ttl=0.05)
+    for _ in range(5):
+        old.acquire(force=True)
+    time.sleep(0.1)  # let epoch 5 expire
+
+    barrier = threading.Barrier(3)
+    outcomes: dict[str, object] = {}
+
+    def claim(name: str) -> None:
+        contender = Lease(directory, owner=name, ttl=10.0)
+        barrier.wait()
+        try:
+            outcomes[name] = contender.acquire()
+        except LeaseHeld as exc:
+            outcomes[name] = exc
+
+    threads = [
+        threading.Thread(target=claim, args=(f"claimant-{i}",))
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    wins = [v for v in outcomes.values() if isinstance(v, int)]
+    losses = [v for v in outcomes.values() if isinstance(v, LeaseHeld)]
+    assert len(wins) == 1, outcomes
+    assert len(losses) == 2, outcomes
+    assert wins[0] == 6  # exactly one epoch bump past the expired 5
+    final = lease_mod.read_lease(directory)
+    assert final.epoch == 6
+
+
+def test_indeterminate_reads_never_depose_file_holder(tmp_path, monkeypatch):
+    """EIO/ESTALE on the strict lease read is *indeterminate*: held()
+    keeps the cached verdict without advancing the trust window, and
+    renew() surfaces OSError (retry) rather than LeaseLost."""
+    holder = Lease(str(tmp_path), owner="active", ttl=8.0)
+    holder.acquire(force=True)
+    verified_at = holder._last_verified
+
+    real_read = lease_mod.read_lease
+    blips = {"n": 0}
+
+    def flaky_read(directory, strict=False):
+        if blips["n"] > 0:
+            blips["n"] -= 1
+            err = OSError(errno.ESTALE if blips["n"] % 2 else errno.EIO,
+                          "injected NFS blip")
+            if strict:
+                raise err
+            return None
+        return real_read(directory, strict=strict)
+
+    monkeypatch.setattr(lease_mod, "read_lease", flaky_read)
+    # also patch the bound path used by Lease.read
+    monkeypatch.setattr(Lease, "read",
+                        lambda self, strict=False:
+                        flaky_read(self.directory, strict=strict))
+
+    blips["n"] = 1
+    assert holder.held(verify=True)  # blip: cached verdict survives
+    assert holder._last_verified == verified_at  # window NOT advanced
+    blips["n"] = 1
+    with pytest.raises(OSError):
+        holder.renew()  # retryable, NOT LeaseLost
+    holder.renew()  # blip cleared: renewal heals
+    assert holder.held(verify=True)
+
+
+def test_indeterminate_reads_during_quorum_fallback(tmp_path, monkeypatch):
+    """The quorum-backend fallback path: a region master whose
+    CDT_LEASE_PEERS quorum goes dark falls back to its cached verdict
+    exactly like the file lease under EIO — and when a *file* lease
+    is used as the co-located fallback arbitration medium, the same
+    blip classification applies. Neither backend may turn a blip into
+    a takeover verdict."""
+    peers = [MemoryLeasePeer(f"p{i}") for i in range(3)]
+    quorum = QuorumLease(peers, owner="active", ttl=8.0,
+                         clock=lambda: time.time())
+    quorum.acquire()
+    file_lease = Lease(str(tmp_path), owner="active", ttl=8.0)
+    file_lease.acquire(force=True)
+
+    # quorum backend: majority dark -> cached verdict
+    peers[0].fail_reads = 1
+    peers[1].fail_reads = 1
+    assert quorum.held(verify=True)
+    # file fallback: strict read raises EIO -> cached verdict
+    def eio_read(self, strict=False):
+        raise OSError(errno.EIO, "injected")
+
+    monkeypatch.setattr(Lease, "read", eio_read)
+    assert file_lease.held(verify=True)
+    monkeypatch.undo()
+    # both backends still verify cleanly after the blips
+    assert quorum.held(verify=True)
+    assert file_lease.held(verify=True)
+
+
+def test_clock_skewed_holder_is_still_fenced_by_epoch(tmp_path):
+    """Fencing is epoch-based, not wall-clock-based: a holder whose
+    clock is far BEHIND (it believes its TTL is still live) is fenced
+    the moment a verified read sees the usurper's epoch bump."""
+    slow_clock = {"now": 1000.0}
+    holder = Lease(str(tmp_path), owner="active", ttl=10.0,
+                   clock=lambda: slow_clock["now"])
+    holder.acquire(force=True)
+    # usurper with a real (far ahead) clock forces a takeover
+    usurper = Lease(str(tmp_path), owner="usurper", ttl=10.0,
+                    clock=lambda: 99999.0)
+    usurper.acquire(force=True)
+    # the holder's own clock says the lease is fresh — irrelevant:
+    slow_clock["now"] += 1.0
+    assert not holder.held(verify=True)
+
+
+def test_fast_clock_claimant_cannot_create_split_brain(tmp_path):
+    """A claimant whose clock runs FAST takes over 'early' (it sees
+    the active's expires_at in its past). That is a liveness hazard,
+    not a safety one: the epoch bump fences the deposed active, so at
+    no point may both journal."""
+    active = Lease(str(tmp_path), owner="active", ttl=10.0,
+                   clock=lambda: 1000.0)
+    active.acquire(force=True)
+    # claimant clock is 20s ahead: the active's lease looks expired
+    claimant = Lease(str(tmp_path), owner="claimant", ttl=10.0,
+                     clock=lambda: 1020.0)
+    epoch = claimant.acquire()  # unforced: succeeds due to skew
+    assert epoch == 2
+    # safety holds: the deposed active fails its verified check
+    assert not active.held(verify=True)
+    assert claimant.held(verify=True)
+    # exactly one of the two may pass the journal seam's gate
+    assert [active.held(verify=True),
+            claimant.held(verify=True)].count(True) == 1
+
+
+def test_holder_trust_window_bounds_the_zombie_interval(tmp_path):
+    """Within ttl/4 of the last verification held() answers from
+    cache — the documented zombie bound. The cached verdict must
+    expire on schedule even when the file already carries a usurper."""
+    clock = {"now": 1000.0}
+    holder = Lease(str(tmp_path), owner="active", ttl=8.0,
+                   clock=lambda: clock["now"])
+    holder.acquire(force=True)
+    usurper = Lease(str(tmp_path), owner="usurper", ttl=8.0,
+                    clock=lambda: clock["now"])
+    usurper.acquire(force=True)
+    # inside the trust window: the zombie still answers True from cache
+    clock["now"] += 1.0
+    assert holder.held()
+    # one tick past ttl/4: the re-read fires and the zombie is fenced
+    clock["now"] += 1.1
+    assert not holder.held()
+
+
+def test_expired_own_lease_file_still_held_until_superseded(tmp_path):
+    """An active whose renewals stalled past its own TTL but whose
+    (epoch, owner) is still in the file has NOT been superseded:
+    held() answers True (nobody took over — there is nothing to fence
+    against), and the next renew() extends the same epoch."""
+    clock = {"now": 1000.0}
+    holder = Lease(str(tmp_path), owner="active", ttl=4.0,
+                   clock=lambda: clock["now"])
+    epoch = holder.acquire(force=True)
+    clock["now"] += 60.0  # far past expiry, no takeover happened
+    assert holder.held(verify=True)
+    holder.renew()
+    assert holder.epoch == epoch
+    state = lease_mod.read_lease(str(tmp_path))
+    assert state.expires_at == clock["now"] + 4.0
+
+
+def test_corrupt_lease_file_arbitration_stays_monotonic(tmp_path):
+    """A torn/corrupt lease.json reads as free; the epoch restarts
+    from the corrupt read's value only via acquire's read — which sees
+    None — so the NEXT incarnation starts at 1. The flock sidecar
+    still serializes the claimants, so no two take the same epoch even
+    across the corruption."""
+    directory = str(tmp_path)
+    a = Lease(directory, owner="a", ttl=10.0)
+    a.acquire(force=True)
+    (tmp_path / "lease.json").write_text("{torn")
+    b = Lease(directory, owner="b", ttl=10.0)
+    assert b.acquire() == 1  # corrupt == free: epoch restarts
+    # the old holder is deposed regardless (owner mismatch on re-read)
+    assert not a.held(verify=True)

@@ -1,0 +1,121 @@
+"""Region-mode chaos: quorum-lease failover (no shared filesystem
+arbitrating) and the two-shard region run with one shard dying mid-job.
+
+The acceptance bundle this file proves:
+
+- a shard master SIGKILL'd mid-job fails over through the quorum lease
+  and the canvas is BIT-IDENTICAL to the fault-free run;
+- a lease peer crashing mid-acquire (both halves: write lost, ack
+  lost) still elects exactly one new master and changes nothing else;
+- the fenced zombie's stale submit journals NOTHING;
+- the other shard's job — open across the whole outage — loses zero
+  tiles, keeps its own epoch, and the consistent-hash placement map
+  never moves;
+- the autoscaler's decision ledger spans the outage with measured
+  chip-second demand/capacity windows and a settled cost line.
+"""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.resilience.chaos import (
+    run_chaos_quorum_failover,
+    run_chaos_region,
+    run_chaos_usdu,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    result = run_chaos_usdu(seed=11)
+    return result.output
+
+
+def _assert_quorum_failover_invariants(baseline, result):
+    assert "crash" in result.fired_kinds()
+    assert result.epochs[1] > result.epochs[0]
+    assert result.zombie_fenced, "ex-active journal append was not fenced"
+    assert result.stale_pull_rejected
+    assert result.stale_submit_rejected
+    assert result.zombie_journaled_records == 0
+    assert result.report["jobs_recovered"] == 1
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_quorum_failover_master_sigkill_bit_identical(baseline, tmp_path):
+    """The region acceptance scenario: the shard master dies mid-job
+    with the lease arbitrated by a majority of off-node peer registers
+    — no flock, no shared lease file — and everything downstream of
+    the epoch (fencing, StaleEpoch, the canvas) behaves exactly as the
+    file-lease failover does."""
+    result = run_chaos_quorum_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#2",
+        journal_dir=str(tmp_path / "wal"),
+    )
+    _assert_quorum_failover_invariants(baseline, result)
+    # unforced takeover of an expired quorum lease: exactly epoch+1
+    assert result.epochs == (1, 2)
+
+
+@pytest.mark.parametrize("mode", ["before", "after"])
+def test_quorum_failover_survives_peer_crash_mid_acquire(
+    baseline, tmp_path, mode
+):
+    """One lease peer crashes in the middle of the standby's acquire —
+    before applying the proposal (the write is lost) or after (the ack
+    is lost). A majority of the survivors still elects, the epoch
+    stays monotonic, and the canvas stays bit-identical."""
+    result = run_chaos_quorum_failover(
+        seed=11,
+        crash_plan="crash@store:pull:master#2",
+        journal_dir=str(tmp_path / "wal"),
+        peer_crash=mode,
+    )
+    _assert_quorum_failover_invariants(baseline, result)
+
+
+def test_region_shard_failover_leaves_other_shard_untouched(
+    baseline, tmp_path
+):
+    """Two shards, one region: shard0's master is killed mid-job and
+    fails over through the quorum lease; shard1's job — opened before
+    the crash, finished after — completes with zero tile loss on its
+    own epoch, and the ring's placement map is identical before and
+    after (membership never changed)."""
+    result = run_chaos_region(
+        seed=11, journal_root=str(tmp_path / "region")
+    )
+    # the failed shard recovered bit-identically, fully fenced
+    _assert_quorum_failover_invariants(baseline, result.shard0)
+    # zero cross-shard loss: the untouched shard kept every tile
+    assert result.shard1_tiles_completed == 4
+    assert result.shard1_epoch == 1
+    assert result.shard1_journal_appends > 0
+    # coordination-free placement: no key moved
+    assert result.placement_drift == 0
+    assert set(result.placements.values()) == {"shard0", "shard1"}
+
+
+def test_region_autoscaler_records_measured_decisions(tmp_path):
+    """The autoscaler's ledger across the outage: the burn alert
+    during the crash forces a scale_up carrying the chip-second
+    demand/capacity window that justified it, and the next evaluation
+    settles the decision with the measured capacity delta it bought."""
+    result = run_chaos_region(
+        seed=11, journal_root=str(tmp_path / "region")
+    )
+    decisions = result.autoscale_decisions
+    assert len(decisions) >= 3
+    ups = [d for d in decisions if d["action"] == "scale_up"]
+    assert ups, f"no scale_up in {[d['action'] for d in decisions]}"
+    up = ups[0]
+    assert up["reason"].startswith("burn:")
+    assert up["demand_chip_s"] > 0
+    assert up["capacity_chip_s"] > 0
+    # settled one window later: the measured cost/benefit line
+    assert up["measured"] is not None
+    assert up["measured"]["capacity_delta_chip_s"] != 0
+    assert "utilization_after" in up["measured"]

@@ -1,0 +1,144 @@
+"""Shard router units (scheduler/router.py): per-URL backoff, epoch-
+preferred re-pointing, consistent-hash stability, and the shard map
+the region routes serve."""
+
+import pytest
+
+from comfyui_distributed_tpu.scheduler.router import (
+    EndpointRotation,
+    ShardRing,
+    ShardRouter,
+)
+
+pytestmark = pytest.mark.fast
+
+
+class Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def rotation(urls, clock, threshold=2, base=0.5, cap=30.0):
+    return EndpointRotation(
+        urls, threshold=threshold, backoff_base=base, backoff_cap=cap,
+        clock=clock,
+    )
+
+
+def test_failure_threshold_repoints_and_backs_off_the_dead_address():
+    clock = Clock()
+    rot = rotation(["http://a:1", "http://b:2", "http://c:3"], clock)
+    assert rot.current == "http://a:1"
+    assert not rot.note_failure()  # one failure is a blip
+    assert rot.note_failure()      # threshold: re-point
+    assert rot.current == "http://b:2"
+    # the dead address carries a backoff window
+    snap = {e["url"]: e for e in rot.snapshot()}
+    assert snap["http://a:1"]["backoff_remaining_s"] > 0
+    assert snap["http://b:2"]["current"]
+
+
+def test_rotation_skips_backed_off_addresses():
+    """b dying right after a must not rotate BACK to a (still backing
+    off) when a healthy c exists — the old global cursor did exactly
+    that."""
+    clock = Clock()
+    rot = rotation(["http://a:1", "http://b:2", "http://c:3"], clock)
+    rot.note_failure(); rot.note_failure()   # a -> backoff, now on b
+    rot.note_failure(); rot.note_failure()   # b -> backoff
+    assert rot.current == "http://c:3"
+
+
+def test_all_backed_off_picks_earliest_expiry_never_stalls():
+    clock = Clock()
+    rot = rotation(["http://a:1", "http://b:2"], clock)
+    rot.note_failure(); rot.note_failure()   # a backed off, on b
+    rot.note_failure(); rot.note_failure()   # b backed off too
+    # both dark: the rotation still points somewhere (earliest expiry)
+    assert rot.current == "http://a:1"
+
+
+def test_backoff_grows_exponentially_and_success_resets():
+    clock = Clock()
+    rot = rotation(["http://a:1", "http://b:2"], clock, base=1.0, cap=30.0)
+    rot.note_failure(); rot.note_failure()   # a: first burst -> 1s
+    first = rot._states["http://a:1"].backoff_until - clock()
+    rot.note_failure(); rot.note_failure()   # b bursts; back on a
+    assert rot.current == "http://a:1"
+    rot.note_failure(); rot.note_failure()   # a: second burst -> 2s
+    second = rot._states["http://a:1"].backoff_until - clock()
+    assert second == pytest.approx(2 * first)
+    # a response wipes the schedule for the answering address
+    rot._idx = rot.urls.index("http://a:1")
+    rot.note_success()
+    state = rot._states["http://a:1"]
+    assert state.bursts == 0 and state.backoff_until == 0.0
+
+
+def test_repoint_prefers_highest_epoch_address():
+    """Re-pointing goes to the address that last reported the highest
+    fencing epoch — the promoted master — not blindly next-in-list."""
+    clock = Clock()
+    rot = rotation(["http://a:1", "http://b:2", "http://c:3"], clock)
+    # c answered with epoch 7 at some point (e.g. a prior rotation)
+    rot._states["http://c:3"].epoch = 7
+    rot._states["http://b:2"].epoch = 3
+    rot.note_failure(); rot.note_failure()
+    assert rot.current == "http://c:3"
+
+
+def test_ring_is_stable_and_reasonably_balanced():
+    ring = ShardRing(["s0", "s1", "s2"], vnodes=64)
+    placed = {f"job-{i}": ring.shard_for(f"job-{i}") for i in range(300)}
+    # stable: same answer on a fresh ring (md5, not salted hash)
+    ring2 = ShardRing(["s2", "s0", "s1"], vnodes=64)
+    assert all(ring2.shard_for(k) == v for k, v in placed.items())
+    # balanced-ish: every shard owns a meaningful share
+    counts = {s: list(placed.values()).count(s) for s in ("s0", "s1", "s2")}
+    assert all(c > 30 for c in counts.values()), counts
+
+
+def test_ring_membership_change_moves_bounded_share():
+    ring = ShardRing(["s0", "s1", "s2"], vnodes=64)
+    before = {f"job-{i}": ring.shard_for(f"job-{i}") for i in range(300)}
+    ring.remove("s2")
+    moved = sum(
+        1 for k, v in before.items()
+        if v != "s2" and ring.shard_for(k) != v
+    )
+    assert moved == 0  # keys not on the removed shard never move
+
+
+def test_router_spec_parsing_and_addressing():
+    router = ShardRouter.from_spec(
+        "http://a:1,http://a2:1; http://b:1", vnodes=16
+    )
+    assert router.enabled
+    assert sorted(router.shards) == ["shard0", "shard1"]
+    assert router.shards["shard0"].urls == ["http://a:1", "http://a2:1"]
+    job = "job-abc"
+    shard = router.shard_for(job)
+    assert router.addresses_for(job) == ",".join(router.shards[shard].urls)
+    # epoch learning surfaces in status
+    router.note_epoch(shard, 5)
+    router.note_epoch(shard, 3)  # monotonic
+    status = router.status()
+    assert status["shards"][shard]["epoch"] == 5
+
+
+def test_empty_spec_is_unsharded():
+    router = ShardRouter.from_spec("")
+    assert not router.enabled
+    assert router.status()["shards"] == {}
+
+
+def test_rebalance_add_remove():
+    router = ShardRouter({"shard0": ["http://a:1"]}, vnodes=8)
+    router.rebalance("shard1", ["http://b:1"])
+    assert "shard1" in router.shards
+    assert router.ring.shards == ["shard0", "shard1"]
+    router.rebalance("shard0", None)
+    assert router.shard_for("anything") == "shard1"
