@@ -67,6 +67,8 @@ def register(app: web.Application, server) -> None:
     app.router.add_get("/distributed/fleet", routes.fleet)
     app.router.add_get("/distributed/alerts", routes.alerts)
     app.router.add_get("/distributed/usage", routes.usage)
+    app.router.add_get("/distributed/cache", routes.cache)
+    app.router.add_post("/distributed/cache/clear", routes.cache_clear)
 
 
 class TelemetryRoutes:
@@ -191,6 +193,40 @@ class TelemetryRoutes:
         payload = aggregator.status(
             since_s=since_s, tenant=request.query.get("tenant")
         )
+        return web.json_response(payload)
+
+    async def cache(self, request: web.Request) -> web.Response:
+        """Content-addressed tile cache stats (docs/caching.md): tier
+        sizes, hit/miss/corrupt counters, and the derived hit rate the
+        panel's Cache card renders."""
+        from ..cache.store import get_tile_cache
+
+        tile_cache = get_tile_cache()
+        if tile_cache is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "tile result cache runs on masters with "
+                         "CDT_CACHE=1 (CDT_CACHE_DIR adds the disk tier)"}
+            )
+        payload = tile_cache.stats()
+        payload["enabled"] = True
+        return web.json_response(payload)
+
+    async def cache_clear(self, request: web.Request) -> web.Response:
+        """Drop both cache tiers (runbook §cache triage: the recovery
+        lever for a suspected-stale cache — e.g. after an undeclared
+        model weight edit in place). Returns what was dropped."""
+        from ..cache.store import get_tile_cache
+
+        tile_cache = get_tile_cache()
+        if tile_cache is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "tile result cache runs on masters with "
+                         "CDT_CACHE=1"}
+            )
+        payload = tile_cache.clear()
+        payload["enabled"] = True
         return web.json_response(payload)
 
     async def alerts(self, request: web.Request) -> web.Response:

@@ -1,0 +1,48 @@
+"""Content-addressed tile result cache.
+
+Tile work in this repo is a pure function of its sampler inputs — the
+bit-identical-canvas invariant makes every result perfectly cacheable.
+`keys.py` canonicalizes the exact sampler inputs into a content hash;
+`store.py` holds the two-tier (host-RAM LRU + CRC-checked disk) store
+and the process-global accessor the master consults at grant time.
+"""
+
+from .keys import (
+    KEY_VERSION,
+    JobKeyContext,
+    adapter_fingerprint,
+    base_key_hex,
+    cond_fingerprint,
+    params_fingerprint,
+    tile_key,
+)
+from .store import (
+    TileResultCache,
+    get_tile_cache,
+    set_tile_cache,
+    _reset_tile_cache_for_tests,
+)
+from .integration import (
+    JobCacheBinding,
+    bind_job_cache,
+    job_key_context,
+    tile_keys_for,
+)
+
+__all__ = [
+    "KEY_VERSION",
+    "JobKeyContext",
+    "adapter_fingerprint",
+    "base_key_hex",
+    "cond_fingerprint",
+    "params_fingerprint",
+    "tile_key",
+    "JobCacheBinding",
+    "bind_job_cache",
+    "job_key_context",
+    "tile_keys_for",
+    "TileResultCache",
+    "get_tile_cache",
+    "set_tile_cache",
+    "_reset_tile_cache_for_tests",
+]

@@ -1,0 +1,138 @@
+"""Master-side cache wiring shared by both execution tiers.
+
+The elastic master (graph/usdu_elastic.py) and the xjob executor
+master (graph/batch_executor.py) consume the cache identically: build
+the job's :class:`~.keys.JobKeyContext` once, derive one key per tile,
+probe, settle the hits into the job store (so workers never pull
+them), and blend the cached pixels locally. The only tier difference
+is the base RNG key handed in — ``jax.random.key(seed)`` for the
+elastic tier (cross-job dedup: two jobs with identical inputs share
+results) versus ``fold_job_key(key, job_id)`` for the xjob tier
+(whose tile outputs fold the job id and so can only dedup within the
+same job's retries).
+
+Everything here is best-effort around the cache only: key derivation
+runs exactly once per job, and a disabled cache costs one ``None``
+check.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from .keys import (
+    JobKeyContext,
+    adapter_fingerprint,
+    base_key_hex,
+    cond_fingerprint,
+    params_fingerprint,
+    tile_key,
+)
+from .store import TileResultCache, get_tile_cache
+
+
+def job_key_context(
+    params: Any,
+    pos: Any,
+    neg: Any,
+    base_key: Any,
+    grid: Any,
+    *,
+    steps: int,
+    sampler: str,
+    scheduler: str,
+    cfg: float,
+    denoise: float,
+    upscale_by: float = 1.0,
+    upscale_method: str = "",
+    mask_blur: int = 0,
+    uniform: bool = False,
+    tiled_decode: bool = False,
+    adapter: Any = None,
+) -> JobKeyContext:
+    """The canonical per-job key context for a prepared tile run.
+
+    ``pos``/``neg`` must be the PREPPED conds (the exact sampler
+    inputs, post ``prep_cond_for_tiles``); ``params`` the exact bundle
+    params the processor closes over (LoRA-merged weights hash
+    differently than base weights by construction).
+    """
+    return JobKeyContext(
+        weights_fp=params_fingerprint(params),
+        cond_fp=cond_fingerprint(pos, neg),
+        base_key=base_key_hex(base_key),
+        steps=int(steps),
+        sampler=str(sampler),
+        scheduler=str(scheduler),
+        cfg=float(cfg),
+        denoise=float(denoise),
+        adapter_fp=adapter_fingerprint(adapter),
+        upscale_by=float(upscale_by),
+        upscale_method=str(upscale_method),
+        mask_blur=int(mask_blur),
+        uniform=bool(uniform),
+        tiled_decode=bool(tiled_decode),
+        tile_w=int(grid.tile_w),
+        tile_h=int(grid.tile_h),
+        padding=int(grid.padding),
+        grid_w=int(grid.cols),
+        grid_h=int(grid.rows),
+        num_tiles=int(grid.num_tiles),
+    )
+
+
+def tile_keys_for(ctx: JobKeyContext, extracted: Any, grid: Any) -> list[str]:
+    """One content key per tile index. ``extracted`` is the full
+    prepared tile stack ``[T, B, th, tw, C]`` (device or host); it is
+    materialised host-side ONCE here — the same transfer the blend
+    path pays anyway."""
+    host = np.asarray(extracted)
+    return [
+        tile_key(ctx, idx, host[idx], *grid.positions[idx])
+        for idx in range(grid.num_tiles)
+    ]
+
+
+class JobCacheBinding:
+    """Per-job view over the global cache for one master run.
+
+    ``probe()`` collects hits; the caller settles them in the store
+    and blends via ``hits`` (tile_idx -> frozen host array).
+    ``populate(tile_idx, arr)`` writes back a computed tile unless the
+    tile was itself served from the cache (re-putting a hit would just
+    churn the LRU order with identical bytes).
+    """
+
+    def __init__(self, cache: TileResultCache, keys: list[str]) -> None:
+        self.cache = cache
+        self.keys = keys
+        self.hits: dict[int, np.ndarray] = {}
+
+    def probe(self) -> dict[int, np.ndarray]:
+        for idx, key in enumerate(self.keys):
+            arr = self.cache.get(key)
+            if arr is not None:
+                self.hits[idx] = arr
+        return self.hits
+
+    def populate(self, tile_idx: int, arr: Any) -> None:
+        if tile_idx in self.hits:
+            return
+        if 0 <= tile_idx < len(self.keys):
+            self.cache.put(self.keys[tile_idx], arr)
+
+
+def bind_job_cache(
+    build_keys: Callable[[], list[str]],
+) -> JobCacheBinding | None:
+    """A :class:`JobCacheBinding` when CDT_CACHE=1, else None.
+
+    ``build_keys`` is deferred so a disabled cache never pays the
+    params-fingerprint/host-transfer cost of key derivation.
+    """
+    cache = get_tile_cache()
+    if cache is None:
+        return None
+    return JobCacheBinding(cache, build_keys())

@@ -1,0 +1,197 @@
+"""Canonical cache keys for tile results.
+
+A tile result is a pure function of (model weights, tile pixels, folded
+RNG key, sampler config, adapter, geometry). The cache key must change
+whenever ANY input that can change one output bit changes, and must NOT
+change on inputs that cannot (job id on the elastic tier, tenant,
+worker placement, pipeline depth, ...). The golden suite in
+tests/test_cache_keys.py enforces both directions.
+
+Canonicalization rules (the consistency argument in docs/caching.md):
+
+- Every field is serialized as ``name=value\\n`` into one blake2b-256
+  stream — named fields mean two adjacent values can never collide by
+  concatenation ambiguity.
+- Arrays contribute dtype + shape + raw bytes (C-order). A dtype or
+  shape change with identical bytes changes the key.
+- Floats are serialized via ``float.hex()`` — exact, no repr rounding.
+- The RNG enters as the *folded base key's* raw key-data bits, not the
+  integer seed: on the elastic tier the base key is
+  ``jax.random.key(seed)`` (same seed across jobs/tenants → same key →
+  cross-job dedup), while the xjob tier folds the job id into the base
+  key (``parallel.seeds.fold_job_key``) — its outputs genuinely depend
+  on the job id, so its cache keys do too. Hashing the folded bits
+  makes both behaviors fall out of one rule.
+- ``KEY_VERSION`` is the first field: any semantic change to sampler
+  numerics or serialization bumps it and cleanly cold-starts the cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+KEY_VERSION = 1
+
+_DIGEST_BYTES = 32
+
+
+def _hasher() -> "hashlib._Hash":
+    return hashlib.blake2b(digest_size=_DIGEST_BYTES)
+
+
+def _feed(h: "hashlib._Hash", name: str, value: Any) -> None:
+    """Append one named field to the hash stream canonically."""
+    h.update(name.encode("utf-8"))
+    h.update(b"=")
+    if isinstance(value, bool):
+        h.update(b"true" if value else b"false")
+    elif isinstance(value, int):
+        h.update(str(value).encode("ascii"))
+    elif isinstance(value, float):
+        h.update(value.hex().encode("ascii"))
+    elif isinstance(value, str):
+        h.update(value.encode("utf-8"))
+    elif isinstance(value, bytes):
+        h.update(value)
+    elif value is None:
+        h.update(b"none")
+    else:
+        raise TypeError(f"unsupported key field type for {name}: {type(value)}")
+    h.update(b"\n")
+
+
+def _feed_array(h: "hashlib._Hash", name: str, arr: Any) -> None:
+    """Arrays hash as dtype + shape + C-order bytes (host-materialized)."""
+    host = np.asarray(arr)
+    _feed(h, name + ".dtype", str(host.dtype))
+    _feed(h, name + ".shape", ",".join(str(d) for d in host.shape))
+    _feed(h, name + ".data", np.ascontiguousarray(host).tobytes())
+
+
+def _pytree_fingerprint(tree: Any) -> str:
+    """Hex digest over a pytree: structure paths + every leaf array.
+
+    Uses key-paths so a structural rename (a different param name with
+    the same bytes) changes the fingerprint — weights drift of any kind
+    must never alias.
+    """
+    import jax
+
+    h = _hasher()
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    _feed(h, "leaves", len(leaves))
+    for path, leaf in leaves:
+        label = jax.tree_util.keystr(path)
+        if hasattr(leaf, "dtype") or isinstance(leaf, (np.ndarray, np.generic)):
+            _feed_array(h, "leaf:" + label, leaf)
+        elif isinstance(leaf, (bool, int, float, str, bytes)) or leaf is None:
+            _feed(h, "leaf:" + label, leaf)
+        else:
+            _feed(h, "leaf:" + label, repr(leaf))
+    return h.hexdigest()
+
+
+def params_fingerprint(params: Any) -> str:
+    """Fingerprint of the model weights pytree (compute once per job)."""
+    return _pytree_fingerprint(params)
+
+
+def cond_fingerprint(pos: Any, neg: Any) -> str:
+    """Fingerprint of the positive/negative conditioning pytrees."""
+    h = _hasher()
+    _feed(h, "pos", _pytree_fingerprint(pos))
+    _feed(h, "neg", _pytree_fingerprint(neg))
+    return h.hexdigest()
+
+
+def adapter_fingerprint(adapter: Any = None) -> str:
+    """Fingerprint of per-job adapter deltas ("" base model = no adapter).
+
+    LoRA merging happens at load time today so merged weights already
+    show up in params_fingerprint; this field exists so the per-tile
+    adapter work (ROADMAP) joins the key without a version bump.
+    """
+    if adapter is None:
+        return ""
+    return _pytree_fingerprint(adapter)
+
+
+def base_key_hex(key: Any) -> str:
+    """Raw key-data bits of a (possibly folded) jax PRNG key, as hex."""
+    import jax
+
+    data = np.asarray(jax.random.key_data(key))
+    return data.tobytes().hex()
+
+
+@dataclass(frozen=True)
+class JobKeyContext:
+    """Per-job invariants of the cache key, computed once at job start.
+
+    The expensive fingerprints (weights, conditioning) and the sampler/
+    geometry scalars live here; `tile_key` adds only the per-tile
+    variables (index, pixels, position).
+    """
+
+    weights_fp: str
+    cond_fp: str
+    base_key: str  # hex of the base (elastic) / job-folded (xjob) key bits
+    steps: int
+    sampler: str
+    scheduler: str
+    cfg: float
+    denoise: float
+    adapter_fp: str = ""
+    # geometry: everything about the grid that shapes extraction/blend
+    upscale_by: float = 1.0
+    upscale_method: str = ""
+    mask_blur: int = 0
+    uniform: bool = False
+    tiled_decode: bool = False
+    tile_w: int = 0
+    tile_h: int = 0
+    padding: int = 0
+    grid_w: int = 0
+    grid_h: int = 0
+    num_tiles: int = 0
+
+
+def tile_key(ctx: JobKeyContext, tile_idx: int, tile: Any, y: int, x: int) -> str:
+    """Canonical content key for one tile's result.
+
+    ``tile`` is the extracted (pre-sampling) tile pixels exactly as fed
+    to the processor; ``y``/``x`` are the tile's canvas position (they
+    reach the sampler through positional conditioning, so they are
+    output-affecting).
+    """
+    h = _hasher()
+    _feed(h, "v", KEY_VERSION)
+    _feed(h, "weights", ctx.weights_fp)
+    _feed(h, "cond", ctx.cond_fp)
+    _feed(h, "base_key", ctx.base_key)
+    _feed(h, "steps", ctx.steps)
+    _feed(h, "sampler", ctx.sampler)
+    _feed(h, "scheduler", ctx.scheduler)
+    _feed(h, "cfg", float(ctx.cfg))
+    _feed(h, "denoise", float(ctx.denoise))
+    _feed(h, "adapter", ctx.adapter_fp)
+    _feed(h, "upscale_by", float(ctx.upscale_by))
+    _feed(h, "upscale_method", ctx.upscale_method)
+    _feed(h, "mask_blur", int(ctx.mask_blur))
+    _feed(h, "uniform", bool(ctx.uniform))
+    _feed(h, "tiled_decode", bool(ctx.tiled_decode))
+    _feed(h, "tile_w", int(ctx.tile_w))
+    _feed(h, "tile_h", int(ctx.tile_h))
+    _feed(h, "padding", int(ctx.padding))
+    _feed(h, "grid_w", int(ctx.grid_w))
+    _feed(h, "grid_h", int(ctx.grid_h))
+    _feed(h, "num_tiles", int(ctx.num_tiles))
+    _feed(h, "tile_idx", int(tile_idx))
+    _feed(h, "y", int(y))
+    _feed(h, "x", int(x))
+    _feed_array(h, "pixels", tile)
+    return h.hexdigest()

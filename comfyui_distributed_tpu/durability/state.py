@@ -23,6 +23,11 @@ Record vocabulary (emitted by ``JobStore`` — docs/durability.md):
                     counter — the poison budget replays exactly
     tile_quarantine {job, tasks}                   tasks leave the pull
                     set for good (settled degraded)
+    cache_settle    {job, tasks}                   tasks completed from
+                    the content-addressed tile cache (payload volatile:
+                    the canvas pixels live in the master's cache, so a
+                    restarted master recomputes OR re-settles from the
+                    cache — both bit-identical by the key contract)
     cancel          {job, reason}                  terminal: pending
                     drained, assignments revoked, later records no-op
     speculate       {job, tasks}
@@ -78,6 +83,7 @@ def _new_job(
         "cancel_reason": "",
         "attempts": {},     # str(task id) -> failed delivery attempts
         "quarantined": [],  # task ids settled degraded (poison)
+        "cached": [],       # task ids settled from the tile cache
         # --- xjob tier: admission lane/tenant ride job_init so a
         # recovered master can rank recovered jobs for preemption
         # (checkpoints do NOT — they are volatile; recompute covers)
@@ -172,6 +178,23 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
                 job["pending"] = [t for t in job["pending"] if t != tid]
             if str(tid) not in job["completed"] and tid not in quarantined:
                 quarantined.append(tid)
+    elif rtype == "cache_settle":
+        # tiles settled straight from the tile cache: completed with a
+        # VOLATILE payload (the pixels live in the master's cache, not
+        # the journal) and removed from the pull set — the shadow must
+        # track the live store's shrunken queue exactly
+        cached = job.setdefault("cached", [])
+        quarantined = job.get("quarantined") or []
+        for tid in record.get("tasks", []):
+            tid = int(tid)
+            key = str(tid)
+            if key in job["completed"] or tid in quarantined:
+                continue
+            job["completed"][key] = None
+            if tid in job["pending"]:
+                job["pending"] = [t for t in job["pending"] if t != tid]
+            if tid not in cached:
+                cached.append(tid)
     elif rtype == "cancel":
         # terminal: the whole refund happens here, so crash-after-cancel
         # replay reaches the same drained state the live store had
@@ -260,6 +283,10 @@ def prepare_for_restart(state: dict[str, Any]) -> dict[str, int]:
         ]
         job["pending"] = pending + additions
         job["speculated"] = []
+        # cache-settlement marks reset with the demotion: the restarted
+        # master re-consults the cache at grant time and re-settles (or
+        # recomputes on a cold cache) — bit-identical either way
+        job["cached"] = []
         requeued += len(additions)
     return {
         "tasks_requeued": requeued,
@@ -303,6 +330,7 @@ def materialize(state: dict[str, Any]):
         job.quarantined_tiles = {
             int(t) for t in spec.get("quarantined", [])
         }
+        job.cached_tiles = {int(t) for t in spec.get("cached", [])}
         job.lane = str(spec.get("lane", "") or "")
         job.tenant = str(spec.get("tenant", "default") or "default")
         deadline_s = spec.get("deadline_s")

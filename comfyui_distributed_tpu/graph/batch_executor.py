@@ -1139,9 +1139,6 @@ def run_master_xjob(
         store.note_worker_capacity("master", master_width)
 
     run_async_in_server_loop(_note_master_capacity())
-    run_async_in_server_loop(
-        store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
-    )
     if _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
         canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
     else:
@@ -1149,11 +1146,74 @@ def run_master_xjob(
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 
+    # --- content-addressed tile cache (cache/), CDT_CACHE=1 ----------
+    # The xjob tier keys on the JOB-FOLDED base key (_prep_xjob's
+    # fold_job_key): its tile outputs depend on job_id, so entries can
+    # only dedup a re-run of the SAME job (crash/requeue/retry) —
+    # never across jobs. The per-tile key derivation is otherwise
+    # identical to the elastic tier's.
+    from ..cache import bind_job_cache, job_key_context, tile_keys_for
+    from ..utils.constants import USAGE_ENABLED
+
+    cache_binding = bind_job_cache(
+        lambda: tile_keys_for(
+            job_key_context(
+                bundle.params, pos, neg, base_key, grid,
+                steps=steps, sampler=sampler, scheduler=scheduler,
+                cfg=cfg, denoise=denoise, upscale_by=upscale_by,
+                upscale_method=upscale_method, mask_blur=mask_blur,
+                uniform=uniform, tiled_decode=tiled_decode,
+            ),
+            extracted, grid,
+        )
+    )
+
     def blend_local(tile_idx: int, result) -> None:
         with stage_span("blend", "master", tile_idx):
             y, x = grid.positions[tile_idx]
+            if cache_binding is not None:
+                result = np.asarray(result)
+                cache_binding.populate(tile_idx, result)
             canvas.blend(result, y, x)
             done_tiles.add(tile_idx)
+
+    # Probe BEFORE the job exists, settle ATOMICALLY with its creation
+    # (init_tile_job's cache_settled): hits are journaled
+    # (`cache_settle`) with the pending queue shrunken under the same
+    # lock hold, so no puller or batch-mate ever burns a slot on them
+    # and a warm run's settled count is deterministic. On a
+    # pre-existing job (recovery re-entry) creation ignored the list —
+    # fall back to the standalone op, which excludes tiles workers
+    # already completed (those must not be re-blended).
+    cached_hits: dict = {}
+    if cache_binding is not None:
+        with stage_span("cache.probe", "master") as probe_span:
+            cached_hits = cache_binding.probe()
+            probe_span.attrs["hits"] = len(cached_hits)
+    job = run_async_in_server_loop(
+        store.init_tile_job(
+            job_id, list(range(grid.num_tiles)),
+            cache_settled=sorted(cached_hits) if cached_hits else None,
+        ),
+        timeout=30,
+    )
+    if cached_hits:
+        settled = [t for t in sorted(cached_hits) if t in job.cached_tiles]
+        if not settled:
+            settled = run_async_in_server_loop(
+                store.settle_cached(job_id, sorted(cached_hits)), timeout=30
+            )
+        for tile_idx in settled:
+            with stage_span("cache.hit", "master", tile_idx):
+                y, x = grid.positions[tile_idx]
+                canvas.blend(cached_hits[tile_idx], y, x)
+                done_tiles.add(tile_idx)
+        if settled:
+            cache_binding.cache.note_settled(len(settled))
+            if USAGE_ENABLED:
+                get_usage_meter().note_cached(
+                    "master", job_id, len(settled)
+                )
 
     def drain_results() -> None:
         async def drain():

@@ -1010,9 +1010,6 @@ def run_master_elastic(
     key = jax.random.key(seed)
     positions = grid.positions_array()
 
-    run_async_in_server_loop(
-        store.init_tile_job(job_id, list(range(grid.num_tiles))), timeout=30
-    )
     # HTTP-tier tiles arrive host-side; the native feathered-blend
     # canvas avoids a device round-trip per tile. CDT_DETERMINISTIC_BLEND
     # defers compositing to sorted tile order so the blended output is
@@ -1027,11 +1024,78 @@ def run_master_elastic(
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 
+    # --- content-addressed tile cache (cache/), CDT_CACHE=1 ----------
+    # The elastic tier keys on the UNFOLDED base key jax.random.key(seed):
+    # per-tile keys fold only the global tile index, so two jobs (any
+    # tenant) with identical sampler inputs dedup against each other.
+    from ..cache import bind_job_cache, job_key_context, tile_keys_for
+    from ..utils.constants import USAGE_ENABLED
+
+    cache_binding = bind_job_cache(
+        lambda: tile_keys_for(
+            job_key_context(
+                bundle.params, pos, neg, key, grid,
+                steps=steps, sampler=sampler, scheduler=scheduler,
+                cfg=cfg, denoise=denoise, upscale_by=upscale_by,
+                upscale_method=upscale_method, mask_blur=mask_blur,
+                uniform=uniform, tiled_decode=tiled_decode,
+            ),
+            extracted, grid,
+        )
+    )
+
     def blend_local(tile_idx: int, result) -> None:
         with _stage("blend", "master", tile_idx):
             y, x = grid.positions[tile_idx]
+            if cache_binding is not None:
+                # one host materialisation serves both the write-back
+                # and the host canvas blend below
+                result = np.asarray(result)
+                cache_binding.populate(tile_idx, result)
             canvas.blend(result, y, x)
             done_tiles.add(tile_idx)
+
+    # Probe BEFORE the job exists, settle ATOMICALLY with its creation
+    # (init_tile_job's cache_settled): hits complete in the store
+    # (journaled `cache_settle`, pending queue shrunken under the same
+    # lock hold) before any puller can observe the job — a warm run's
+    # settled count is deterministic, never a race the master usually
+    # wins. Hits blend from cached pixels at ~zero chip-time. On a
+    # pre-existing job (recovery re-entry) creation ignored the list,
+    # so fall back to the standalone op, which excludes tiles workers
+    # already completed — those must NOT be blended again (the canvas
+    # accumulates weight).
+    cached_hits: dict[int, Any] = {}
+    if cache_binding is not None:
+        with _stage("cache.probe", "master") as probe_span:
+            cached_hits = cache_binding.probe()
+            probe_span.attrs["hits"] = len(cached_hits)
+    job = run_async_in_server_loop(
+        store.init_tile_job(
+            job_id, list(range(grid.num_tiles)),
+            cache_settled=sorted(cached_hits) if cached_hits else None,
+        ),
+        timeout=30,
+    )
+    if cached_hits:
+        settled = [t for t in sorted(cached_hits) if t in job.cached_tiles]
+        if not settled:
+            settled = run_async_in_server_loop(
+                store.settle_cached(job_id, sorted(cached_hits)), timeout=30
+            )
+        for tile_idx in settled:
+            with _stage("cache.hit", "master", tile_idx):
+                y, x = grid.positions[tile_idx]
+                canvas.blend(cached_hits[tile_idx], y, x)
+                done_tiles.add(tile_idx)
+        if settled:
+            cache_binding.cache.note_settled(len(settled))
+            if USAGE_ENABLED:
+                from ..telemetry.usage import get_usage_meter
+
+                get_usage_meter().note_cached(
+                    "master", job_id, len(settled)
+                )
 
     def drain_results() -> None:
         async def drain():

@@ -79,6 +79,11 @@ class TileJob:
     # budget; the job completes degraded (or fails, per policy) with
     # these counted as settled
     quarantined_tiles: set[int] = dataclasses.field(default_factory=set)
+    # tasks settled straight from the content-addressed tile cache at
+    # grant time (cache/): completed without ever entering the pull
+    # set — journaled as `cache_settle` so replay reconstructs the
+    # same shrunken queue
+    cached_tiles: set[int] = dataclasses.field(default_factory=set)
     # --- cross-job batching + step-level preemption (xjob tier) ----------
     # Admission lane / tenant this job was queued under (journaled with
     # job_init): the preemption coordinator ranks jobs by lane and the

@@ -426,9 +426,18 @@ class JobStore:
         self, job_id: str, task_ids: list[int], batched: bool = True,
         kind: str = "tile", deadline_s: Optional[float] = None,
         lane: Optional[str] = None, tenant: Optional[str] = None,
+        cache_settled: Optional[list[int]] = None,
     ) -> TileJob:
+        """Create the job. ``cache_settled`` settles those tiles from
+        the content-addressed cache ATOMICALLY with creation (same lock
+        hold): no puller can ever observe the pre-settle pending queue,
+        so a warm job's settled count is deterministic, not a race the
+        master usually wins. Ignored when the job already exists (a
+        recovered job's settle goes through ``settle_cached``, which
+        excludes tiles workers already completed)."""
         from ..utils.constants import JOB_DEADLINE_DEFAULT_SECONDS
 
+        settled_at_init: list[int] = []
         async with self.lock:
             if job_id in self.tile_jobs:
                 return self.tile_jobs[job_id]
@@ -463,6 +472,10 @@ class JobStore:
             for tid in task_ids:
                 job.pending.put_nowait(tid)
             self.tile_jobs[job_id] = job
+            if cache_settled:
+                settled_at_init = self._settle_cached_locked(
+                    job, job_id, cache_settled
+                )
             self._wake(self._tile_waiters.pop(job_id, []))
         # Outside the lock: lifecycle + grant pushes are observability/
         # wakeup signals, not state. job_ready lets push-mode workers
@@ -471,10 +484,12 @@ class JobStore:
         from ..telemetry.events import get_event_bus
 
         get_event_bus().publish("job_ready", job_id=job_id, tasks=len(task_ids))
+        if settled_at_init:
+            instruments.cache_settled_total().inc(len(settled_at_init))
         # authoritative tenant/lane for the attribution plane (lands on
         # top of the executors' advisory registration attrs)
         _note_usage_job_attrs(job_id, job.tenant, job.lane)
-        self._notify_grants(job_id, len(task_ids))
+        self._notify_grants(job_id, len(task_ids) - len(settled_at_init))
         # Preemption seam: a premium-lane arrival may evict running
         # lower-lane work. Awaited AFTER the init committed (the
         # coordinator re-enters the store lock); advisory — a broken
@@ -1102,6 +1117,72 @@ class JobStore:
             ):
                 accepted += 1
         return accepted
+
+    async def settle_cached(
+        self, job_id: str, task_ids: list[int]
+    ) -> list[int]:
+        """Settle tiles whose results came from the content-addressed
+        cache (cache/): they complete WITHOUT ever entering the pull
+        set, shrinking what workers can claim. Journaled as ONE
+        `cache_settle` record under the lock before acknowledgement so
+        recovery replays the same shrunken queue — a crash between the
+        settle and job completion must not resurrect the tiles for
+        recompute (the warm canvas would still be correct, but the
+        usage attribution and dispatch counts would drift from what
+        was acknowledged. The master blends the pixel data from the
+        cache itself; the store only records settlement (payload None,
+        exactly like master-local submits). Returns the ids that
+        actually settled — a tile a racing worker already completed is
+        excluded, and the caller must not blend its cached copy on top."""
+        job = await self.get_tile_job(job_id)
+        if job is None:
+            raise JobQueueError(f"no such job {job_id!r}")
+        async with self.lock:
+            settled = self._settle_cached_locked(job, job_id, task_ids)
+        if settled:
+            instruments.cache_settled_total().inc(len(settled))
+        return settled
+
+    def _settle_cached_locked(
+        self, job: TileJob, job_id: str, task_ids: list[int]
+    ) -> list[int]:
+        """The settle itself, under ``self.lock`` (shared by
+        ``settle_cached`` and the atomic-at-creation path in
+        ``init_tile_job``)."""
+        if job.cancelled:
+            return []
+        settled = [
+            int(t)
+            for t in task_ids
+            if int(t) not in job.completed
+            and int(t) not in job.quarantined_tiles
+        ]
+        if not settled:
+            return []
+        self._journal(
+            {"type": "cache_settle", "job": job_id, "tasks": settled}
+        )
+        settled_set = set(settled)
+        for tid in settled:
+            job.completed[tid] = None
+            job.cached_tiles.add(tid)
+        # asyncio.Queue has no removal: drain and re-put survivors.
+        # Under self.lock no puller can interleave (pull_task's
+        # get() path re-checks under the lock after popping).
+        survivors: list[int] = []
+        while True:
+            try:
+                tid = job.pending.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if tid not in settled_set:
+                survivors.append(tid)
+        for tid in survivors:
+            job.pending.put_nowait(tid)
+        # a settled tile's retained checkpoint is dead weight
+        if job.checkpoints:
+            self._take_checkpoints_locked(job, settled)
+        return settled
 
     async def mark_worker_done(
         self, job_id: str, worker_id: str, epoch: Any = None

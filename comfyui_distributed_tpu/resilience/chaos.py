@@ -117,6 +117,9 @@ class ChaosResult:
     # chip-time attribution captured on a run-local UsageMeter:
     # {"rollup": per-tenant/lane/job view, "totals": exact ns identity}
     usage: dict = dataclasses.field(default_factory=dict)
+    # tile-result-cache counters for the run (populated when
+    # run_chaos_usdu(cache=...)): TileResultCache.stats() after the run
+    cache: dict = dataclasses.field(default_factory=dict)
 
     def fired_kinds(self) -> set[str]:
         return {a.kind for a in self.fired}
@@ -173,6 +176,7 @@ def run_chaos_usdu(
     mesh_devices: int = 0,
     slo: Optional[dict] = None,
     incidents: Optional[dict] = None,
+    cache=None,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -245,6 +249,16 @@ def run_chaos_usdu(
     ChaosResult.incidents (+ .incident_dir) — the chaos acceptance
     asserts the bundle holds the firing evaluation AND the straggler's
     fleet series while the canvas stays bit-identical.
+
+    `cache`: pass a TileResultCache to install it run-locally (the
+    process global is swapped in and restored like the usage meter) —
+    the master probes it at grant time and settles hits straight into
+    the job, so a warm re-run with the same cache serves tiles without
+    dispatching them to workers. Counters land in ChaosResult.cache
+    (TileResultCache.stats() after the run); the cache acceptance
+    asserts warm output is BIT-IDENTICAL to the cold reference, under
+    faults included — a cache may only change WHO computes a tile
+    (nobody), never WHAT lands on the canvas.
 
     `tile_batch`/`pipeline`/`prefetch`: the batched-pipelined data path
     (graph/tile_pipeline.py). Worker threads ALWAYS run the production
@@ -550,6 +564,13 @@ def run_chaos_usdu(
             # swapped-in meter (restored on stack exit); the result's
             # usage block is exactly this run's burn
             stack.callback(set_usage_meter, set_usage_meter(usage_meter))
+            if cache is not None:
+                # run-local tile result cache, same swap/restore idiom:
+                # explicit set wins over the CDT_CACHE gate, so the
+                # master's grant-time probe sees exactly this instance
+                from ..cache.store import set_tile_cache
+
+                stack.callback(set_tile_cache, set_tile_cache(cache))
             if wd is not None:
                 # start after the loop exists (speculation round-trips
                 # through it); stop (LIFO) before the loop shuts down
@@ -676,6 +697,7 @@ def run_chaos_usdu(
             "rollup": usage_meter.rollup(),
             "totals": usage_meter.totals(),
         },
+        cache=cache.stats() if cache is not None else {},
     )
 
 

@@ -418,6 +418,16 @@ class FleetRegistry:
                 get_event_bus().publish("usage_rollup", **usage_rollup)
             except Exception as exc:  # noqa: BLE001 - best effort
                 debug_log(f"fleet: usage sample failed: {exc}")
+        # the tile result cache (cache/) feeds the panel's Cache card
+        # the same push-side way; absent cache (CDT_CACHE=0) = no event
+        try:
+            from ..cache.store import get_tile_cache
+
+            tile_cache = get_tile_cache()
+            if tile_cache is not None:
+                get_event_bus().publish("cache_stats", **tile_cache.stats())
+        except Exception as exc:  # noqa: BLE001 - best effort
+            debug_log(f"fleet: cache stats publish failed: {exc}")
         return rollup
 
     # --- rollups / surfaces --------------------------------------------------

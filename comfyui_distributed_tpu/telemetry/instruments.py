@@ -487,6 +487,59 @@ def usage_waste_seconds_total() -> Counter:
     )
 
 
+def usage_cached_tiles_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_usage_cached_tiles_total",
+        "Tiles settled from the content-addressed tile cache per "
+        "(tenant, lane) — the `cached` attribution bucket: they count "
+        "toward the tenant's tiles at ~zero chip-time",
+        ("tenant", "lane"),
+    )
+
+
+# --- content-addressed tile result cache (cache/) -------------------------
+
+def cache_lookups_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_cache_lookups_total",
+        "Tile-cache lookups by outcome (hit_ram|hit_disk|miss) — "
+        "mirrored by delta from the store's cumulative stats at scrape "
+        "time",
+        ("outcome",),
+    )
+
+
+def cache_settled_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_cache_settled_total",
+        "Tiles settled into jobs straight from the tile cache at grant "
+        "time (they completed without ever entering the pull set)",
+    )
+
+
+def cache_corrupt_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_cache_corrupt_total",
+        "Disk-tier cache entries that failed CRC/format validation on "
+        "read (deleted and degraded to a miss, never a wrong canvas)",
+    )
+
+
+def cache_bytes() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_cache_bytes",
+        "Bytes resident per tile-cache tier (ram|disk) at scrape time",
+        ("tier",),
+    )
+
+
+def cache_hit_ratio() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_cache_hit_ratio",
+        "Lifetime tile-cache hit rate (hits / lookups) at scrape time",
+    )
+
+
 # --- incident plane (telemetry/flight.py, telemetry/incidents.py) ---------
 
 def incidents_total() -> Counter:
@@ -766,6 +819,7 @@ def bind_server_collectors(server) -> Callable[[], None]:
             usage_chip_seconds_total()
             usage_tiles_total()
             usage_waste_seconds_total()
+            usage_cached_tiles_total()
     # Incident-plane instruments present from the first scrape: the
     # flight drop counter whenever a recorder exists, the capture
     # instruments on masters running an incident manager.
@@ -773,6 +827,17 @@ def bind_server_collectors(server) -> Callable[[], None]:
 
     if peek_flight_recorder() is not None:
         flight_dropped_total()
+    # Tile-cache instruments present from the first scrape whenever the
+    # cache is live in this process (CDT_CACHE=1 or a harness-installed
+    # instance) — the panel's cache card parses them before any lookup.
+    from ..cache.store import get_tile_cache as _get_tile_cache
+
+    if _get_tile_cache() is not None:
+        cache_lookups_total()
+        cache_settled_total()
+        cache_corrupt_total()
+        cache_bytes()
+        cache_hit_ratio()
     if getattr(server, "incidents", None) is not None:
         incidents_total()
         incident_capture_seconds()
@@ -900,12 +965,45 @@ def bind_server_collectors(server) -> Callable[[], None]:
                 if delta > 0:
                     tiles_counter.inc(delta, tenant=tenant, lane=lane)
                     marks[tile_key] = stats["tiles"]
+                cached_value = stats.get("cached", 0.0)
+                cached_key = f"cached:{tenant}:{lane}"
+                delta = cached_value - marks.get(cached_key, 0.0)
+                if delta > 0:
+                    usage_cached_tiles_total().inc(
+                        delta, tenant=tenant, lane=lane
+                    )
+                    marks[cached_key] = cached_value
             for reason in sorted(rollup["totals"]["waste_s"]):
                 value = rollup["totals"]["waste_s"][reason]
                 delta = value - marks.get(f"waste:{reason}", 0.0)
                 if delta > 0:
                     waste_counter.inc(delta, reason=reason)
                     marks[f"waste:{reason}"] = value
+        # Tile-cache stats ride the scrape the same way: gauges set
+        # directly, counters mirrored by DELTA against the cache's own
+        # high-water marks (shared across co-hosted collectors so a
+        # lookup is counted exactly once).
+        tile_cache = _get_tile_cache()
+        if tile_cache is not None:
+            cstats = tile_cache.stats()
+            cache_bytes().set(cstats["ram_bytes"], tier="ram")
+            cache_bytes().set(cstats["disk_bytes"], tier="disk")
+            cache_hit_ratio().set(cstats["hit_rate"])
+            cache_marks = tile_cache.scrape_mirrored
+            lookup_counter = cache_lookups_total()
+            for outcome, value in (
+                ("hit_ram", cstats["hits_ram"]),
+                ("hit_disk", cstats["hits_disk"]),
+                ("miss", cstats["misses"]),
+            ):
+                delta = value - cache_marks.get(outcome, 0)
+                if delta > 0:
+                    lookup_counter.inc(delta, outcome=outcome)
+                    cache_marks[outcome] = value
+            delta = cstats["corrupt"] - cache_marks.get("corrupt", 0)
+            if delta > 0:
+                cache_corrupt_total().inc(delta)
+                cache_marks["corrupt"] = cstats["corrupt"]
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at

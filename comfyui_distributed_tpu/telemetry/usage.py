@@ -108,7 +108,10 @@ def _s(ns: int) -> float:
 class _JobUsage:
     """Cumulative counters for one (role, job): integer chip-ns."""
 
-    __slots__ = ("chip_ns", "steps", "tiles", "waste_ns", "last_active")
+    __slots__ = (
+        "chip_ns", "steps", "tiles", "waste_ns", "cached_tiles",
+        "cached_ns", "last_active",
+    )
 
     def __init__(self) -> None:
         self.chip_ns = 0
@@ -116,6 +119,14 @@ class _JobUsage:
         self.tiles = 0
         # recompute/store waste charged against this job's tiles
         self.waste_ns = 0
+        # tiles settled from the content-addressed cache (a subset of
+        # `tiles` — they bump the cost denominator at near-zero chip
+        # time) and the measured lookup/settle time charged for them
+        # (the `cached` bucket: OUTSIDE the dispatch conservation
+        # identity, like the store-family waste — no device dispatch
+        # happened)
+        self.cached_tiles = 0
+        self.cached_ns = 0
         self.last_active = 0.0
 
     def as_dict(self) -> dict[str, Any]:
@@ -124,6 +135,8 @@ class _JobUsage:
             "steps": self.steps,
             "tiles": self.tiles,
             "waste_s": _s(self.waste_ns),
+            "cached_tiles": self.cached_tiles,
+            "cached_s": _s(self.cached_ns),
         }
 
 
@@ -148,6 +161,11 @@ class UsageMeter:
         self._attrs: dict[str, tuple[str, str]] = {}
         # role -> reason -> ns
         self._waste: dict[str, dict[str, int]] = {}
+        # the `cached` bucket: tiles settled from the tile cache and the
+        # (near-zero) measured settle time charged for them, per role —
+        # outside the dispatch conservation identity by construction
+        self._cached_tiles: dict[str, int] = {}
+        self._cached_ns: dict[str, int] = {}
         # exact dispatch-family totals per role (the conservation set)
         self._dispatch_ns: dict[str, int] = {}
         self._attributed_ns: dict[str, int] = {}
@@ -207,12 +225,15 @@ class UsageMeter:
         if key not in self._retired and len(self._retired) >= self.max_keys:
             key = (role, DEFAULT_TENANT, "")
         bucket = self._retired.setdefault(
-            key, {"chip_ns": 0, "tiles": 0, "steps": 0, "waste_ns": 0}
+            key, {"chip_ns": 0, "tiles": 0, "steps": 0, "waste_ns": 0,
+                  "cached_tiles": 0, "cached_ns": 0},
         )
         bucket["chip_ns"] += entry.chip_ns
         bucket["tiles"] += entry.tiles
         bucket["steps"] += entry.steps
         bucket["waste_ns"] += entry.waste_ns
+        bucket["cached_tiles"] += entry.cached_tiles
+        bucket["cached_ns"] += entry.cached_ns
 
     def note_dispatch(
         self,
@@ -281,6 +302,30 @@ class UsageMeter:
         now = self.clock()
         with self._lock:
             self._job(str(role), str(job_id), now).tiles += int(n)
+
+    def note_cached(
+        self, role: str, job_id: str, tiles: int, seconds: float = 0.0
+    ) -> None:
+        """Charge cache-settled tiles to the ``cached`` bucket: they
+        count toward the job's finished ``tiles`` (the cost-model
+        denominator — this is what makes likely-hit jobs admit as
+        near-free under the DRR measured-cost hook) at the near-zero
+        measured lookup/settle time, which rides OUTSIDE the dispatch
+        conservation identity exactly like the store-family waste — no
+        device dispatch happened."""
+        n = int(tiles)
+        if n <= 0:
+            return
+        ns = _to_ns(seconds)
+        now = self.clock()
+        with self._lock:
+            entry = self._job(str(role), str(job_id), now)
+            entry.tiles += n
+            entry.cached_tiles += n
+            entry.cached_ns += ns
+            role = str(role)
+            self._cached_tiles[role] = self._cached_tiles.get(role, 0) + n
+            self._cached_ns[role] = self._cached_ns.get(role, 0) + ns
 
     def note_waste(
         self, role: str, reason: str, seconds: float,
@@ -351,6 +396,8 @@ class UsageMeter:
                 "attributed_chip_s": _s(self._attributed_ns.get(role, 0)),
                 "overhead_s": _s(self._overhead_ns.get(role, 0)),
                 "dispatches": self._dispatches.get(role, 0),
+                "cached_tiles": self._cached_tiles.get(role, 0),
+                "cached_s": _s(self._cached_ns.get(role, 0)),
             }
 
     def totals(
@@ -391,6 +438,15 @@ class UsageMeter:
                 "dispatches": sum(
                     n for r, n in self._dispatches.items() if _keep(r)
                 ),
+                # the cached bucket rides OUTSIDE the conservation set:
+                # no dispatch happened for these tiles, so adding them
+                # to the identity would un-balance it by construction
+                "cached_tiles": sum(
+                    n for r, n in self._cached_tiles.items() if _keep(r)
+                ),
+                "cached_ns": sum(
+                    ns for r, ns in self._cached_ns.items() if _keep(r)
+                ),
                 "conserved": (
                     attributed_ns + dispatch_waste_ns + overhead_ns
                     == dispatch_ns
@@ -406,12 +462,15 @@ class UsageMeter:
         is what the scrape-mirror counters delta against."""
         out: dict[tuple[str, str], dict[str, float]] = {}
 
-        def add(tenant: str, lane: str, chip_ns: int, tiles: int) -> None:
+        def add(
+            tenant: str, lane: str, chip_ns: int, tiles: int, cached: int
+        ) -> None:
             agg = out.setdefault(
-                (tenant, lane), {"chip_s": 0.0, "tiles": 0.0}
+                (tenant, lane), {"chip_s": 0.0, "tiles": 0.0, "cached": 0.0}
             )
             agg["chip_s"] += _s(chip_ns)
             agg["tiles"] += tiles
+            agg["cached"] += cached
 
         with self._lock:
             for role in sorted(self._jobs):
@@ -422,12 +481,18 @@ class UsageMeter:
                     tenant, lane = self._attrs.get(
                         job_id, (DEFAULT_TENANT, "")
                     )
-                    add(tenant, lane, entry.chip_ns, entry.tiles)
+                    add(
+                        tenant, lane, entry.chip_ns, entry.tiles,
+                        entry.cached_tiles,
+                    )
             for (role, tenant, lane) in sorted(self._retired):
                 if roles is not None and role not in roles:
                     continue
                 bucket = self._retired[(role, tenant, lane)]
-                add(tenant, lane, bucket["chip_ns"], bucket["tiles"])
+                add(
+                    tenant, lane, bucket["chip_ns"], bucket["tiles"],
+                    bucket.get("cached_tiles", 0),
+                )
         return out
 
     def rollup(
@@ -453,12 +518,13 @@ class UsageMeter:
                     )
                     t = tenants.setdefault(
                         tenant, {"chip_s": 0.0, "tiles": 0, "steps": 0,
-                                 "waste_s": 0.0}
+                                 "waste_s": 0.0, "cached_tiles": 0}
                     )
                     t["chip_s"] += _s(entry.chip_ns)
                     t["tiles"] += entry.tiles
                     t["steps"] += entry.steps
                     t["waste_s"] += _s(entry.waste_ns)
+                    t["cached_tiles"] += entry.cached_tiles
                     ln = lanes.setdefault(
                         lane, {"chip_s": 0.0, "tiles": 0}
                     )
@@ -468,12 +534,13 @@ class UsageMeter:
                         job_id,
                         {"tenant": tenant, "lane": lane, "chip_s": 0.0,
                          "tiles": 0, "steps": 0, "waste_s": 0.0,
-                         "roles": []},
+                         "cached_tiles": 0, "roles": []},
                     )
                     job_out["chip_s"] += _s(entry.chip_ns)
                     job_out["tiles"] += entry.tiles
                     job_out["steps"] += entry.steps
                     job_out["waste_s"] += _s(entry.waste_ns)
+                    job_out["cached_tiles"] += entry.cached_tiles
                     job_out["roles"].append(role)
             for (role, tenant, lane) in sorted(self._retired):
                 if roles is not None and role not in roles:
@@ -481,12 +548,14 @@ class UsageMeter:
                 bucket = self._retired[(role, tenant, lane)]
                 t = tenants.setdefault(
                     tenant,
-                    {"chip_s": 0.0, "tiles": 0, "steps": 0, "waste_s": 0.0},
+                    {"chip_s": 0.0, "tiles": 0, "steps": 0, "waste_s": 0.0,
+                     "cached_tiles": 0},
                 )
                 t["chip_s"] += _s(bucket["chip_ns"])
                 t["tiles"] += bucket["tiles"]
                 t["steps"] += bucket["steps"]
                 t["waste_s"] += _s(bucket["waste_ns"])
+                t["cached_tiles"] += bucket.get("cached_tiles", 0)
                 ln = lanes.setdefault(lane, {"chip_s": 0.0, "tiles": 0})
                 ln["chip_s"] += _s(bucket["chip_ns"])
                 ln["tiles"] += bucket["tiles"]
@@ -508,6 +577,8 @@ class UsageMeter:
                     r: _s(ns) for r, ns in totals["waste_ns"].items()
                 },
                 "dispatches": totals["dispatches"],
+                "cached_tiles": totals["cached_tiles"],
+                "cached_s": _s(totals["cached_ns"]),
                 "conserved": totals["conserved"],
             },
         }
@@ -561,13 +632,18 @@ _EWMA_ALPHA = 0.3
 
 
 class _AdoptedJob:
-    __slots__ = ("chip_ns", "steps", "tiles", "waste_ns", "last_active")
+    __slots__ = (
+        "chip_ns", "steps", "tiles", "waste_ns", "cached_tiles",
+        "cached_ns", "last_active",
+    )
 
     def __init__(self) -> None:
         self.chip_ns = 0
         self.steps = 0
         self.tiles = 0
         self.waste_ns = 0
+        self.cached_tiles = 0
+        self.cached_ns = 0
         self.last_active = 0.0
 
 
@@ -680,6 +756,16 @@ class UsageAggregator:
                         prev, f"job:{job_id}:tiles",
                         _as_float(stats.get("tiles")),
                     ))
+                    # version-tolerant: a pre-cache worker's snapshot
+                    # simply lacks the fields (delta from 0 of 0)
+                    entry.cached_tiles += int(self._delta(
+                        prev, f"job:{job_id}:cached_tiles",
+                        _as_float(stats.get("cached_tiles")),
+                    ))
+                    entry.cached_ns += _to_ns(self._delta(
+                        prev, f"job:{job_id}:cached_s",
+                        _as_float(stats.get("cached_s")),
+                    ))
             waste = usage.get("waste_s")
             if isinstance(waste, dict):
                 for reason in sorted(waste):
@@ -726,12 +812,15 @@ class UsageAggregator:
         if key not in self._retired and len(self._retired) >= self.max_keys:
             key = (DEFAULT_TENANT, "")
         bucket = self._retired.setdefault(
-            key, {"chip_ns": 0, "tiles": 0, "steps": 0, "waste_ns": 0}
+            key, {"chip_ns": 0, "tiles": 0, "steps": 0, "waste_ns": 0,
+                  "cached_tiles": 0, "cached_ns": 0},
         )
         bucket["chip_ns"] += entry.chip_ns
         bucket["tiles"] += entry.tiles
         bucket["steps"] += entry.steps
         bucket["waste_ns"] += entry.waste_ns
+        bucket["cached_tiles"] += entry.cached_tiles
+        bucket["cached_ns"] += entry.cached_ns
 
     def forget_worker(self, worker_id: str) -> None:
         """Drop a departed worker's reset-clamp baselines (its adopted
@@ -880,35 +969,44 @@ class UsageAggregator:
             tenant, lane = self.meter.job_attrs(job_id)
             t = tenants.setdefault(
                 tenant, {"chip_s": 0.0, "tiles": 0, "steps": 0,
-                         "waste_s": 0.0}
+                         "waste_s": 0.0, "cached_tiles": 0}
             )
             t["chip_s"] += _s(entry.chip_ns)
             t["tiles"] += entry.tiles
             t["steps"] += entry.steps
             t["waste_s"] += _s(entry.waste_ns)
+            t["cached_tiles"] = t.get("cached_tiles", 0) + entry.cached_tiles
             ln = lanes.setdefault(lane, {"chip_s": 0.0, "tiles": 0})
             ln["chip_s"] += _s(entry.chip_ns)
             ln["tiles"] += entry.tiles
             job_out = jobs.setdefault(
                 job_id,
                 {"tenant": tenant, "lane": lane, "chip_s": 0.0, "tiles": 0,
-                 "steps": 0, "waste_s": 0.0, "roles": []},
+                 "steps": 0, "waste_s": 0.0, "cached_tiles": 0,
+                 "roles": []},
             )
             job_out["chip_s"] += _s(entry.chip_ns)
             job_out["tiles"] += entry.tiles
             job_out["steps"] += entry.steps
             job_out["waste_s"] += _s(entry.waste_ns)
+            job_out["cached_tiles"] = (
+                job_out.get("cached_tiles", 0) + entry.cached_tiles
+            )
             if "worker(adopted)" not in job_out["roles"]:
                 job_out["roles"].append("worker(adopted)")
         for (tenant, lane), bucket in adopted_retired.items():
             t = tenants.setdefault(
                 tenant,
-                {"chip_s": 0.0, "tiles": 0, "steps": 0, "waste_s": 0.0},
+                {"chip_s": 0.0, "tiles": 0, "steps": 0, "waste_s": 0.0,
+                 "cached_tiles": 0},
             )
             t["chip_s"] += _s(bucket["chip_ns"])
             t["tiles"] += bucket["tiles"]
             t["steps"] += bucket["steps"]
             t["waste_s"] += _s(bucket["waste_ns"])
+            t["cached_tiles"] = (
+                t.get("cached_tiles", 0) + bucket.get("cached_tiles", 0)
+            )
             ln = lanes.setdefault(lane, {"chip_s": 0.0, "tiles": 0})
             ln["chip_s"] += _s(bucket["chip_ns"])
             ln["tiles"] += bucket["tiles"]
@@ -917,6 +1015,18 @@ class UsageAggregator:
         totals["attributed_s"] += _s(adopted["attributed_ns"])
         totals["overhead_s"] += _s(adopted["overhead_ns"])
         totals["dispatches"] += adopted["dispatches"]
+        totals["cached_tiles"] = totals.get("cached_tiles", 0) + sum(
+            entry.cached_tiles for _, entry in adopted_jobs
+        ) + sum(
+            bucket.get("cached_tiles", 0)
+            for bucket in adopted_retired.values()
+        )
+        totals["cached_s"] = totals.get("cached_s", 0.0) + _s(sum(
+            entry.cached_ns for _, entry in adopted_jobs
+        ) + sum(
+            bucket.get("cached_ns", 0)
+            for bucket in adopted_retired.values()
+        ))
         waste_all = dict(totals["waste_s"])
         for reason, ns in sorted(adopted_waste.items()):
             waste_all[reason] = waste_all.get(reason, 0.0) + _s(ns)
@@ -948,22 +1058,29 @@ class UsageAggregator:
         out = self.meter.pair_totals(roles=("master",))
         with self._lock:
             live = [
-                (job_id, entry.chip_ns, entry.tiles)
+                (job_id, entry.chip_ns, entry.tiles, entry.cached_tiles)
                 for job_id, entry in sorted(self._adopted_jobs.items())
             ]
             retired = [
-                (key, bucket["chip_ns"], bucket["tiles"])
+                (key, bucket["chip_ns"], bucket["tiles"],
+                 bucket.get("cached_tiles", 0))
                 for key, bucket in sorted(self._retired.items())
             ]
-        for job_id, chip_ns, tiles in live:
+        for job_id, chip_ns, tiles, cached in live:
             pair = self.meter.job_attrs(job_id)
-            agg = out.setdefault(pair, {"chip_s": 0.0, "tiles": 0.0})
+            agg = out.setdefault(
+                pair, {"chip_s": 0.0, "tiles": 0.0, "cached": 0.0}
+            )
             agg["chip_s"] += _s(chip_ns)
             agg["tiles"] += tiles
-        for pair, chip_ns, tiles in retired:
-            agg = out.setdefault(pair, {"chip_s": 0.0, "tiles": 0.0})
+            agg["cached"] = agg.get("cached", 0.0) + cached
+        for pair, chip_ns, tiles, cached in retired:
+            agg = out.setdefault(
+                pair, {"chip_s": 0.0, "tiles": 0.0, "cached": 0.0}
+            )
             agg["chip_s"] += _s(chip_ns)
             agg["tiles"] += tiles
+            agg["cached"] = agg.get("cached", 0.0) + cached
         return out
 
     def cost_snapshot(self) -> dict[str, Any]:

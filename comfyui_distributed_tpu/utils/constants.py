@@ -338,6 +338,36 @@ USAGE_COST_ENABLED = _env_int("CDT_USAGE_COST", 0) == 1
 # evict — tenant-id churn must not grow master memory.
 USAGE_TTL_SECONDS = _env_float("CDT_USAGE_TTL", 3600.0)
 
+# --- content-addressed tile result cache (cache/) -------------------------
+# CDT_CACHE=1 consults the master-side tile result cache at grant time
+# (hits settle straight into the job — they never ship to a worker) and
+# populates it at blend/submit on both execution tiers. 0 (default)
+# keeps the cache entirely out of the data path; chaos suites that
+# count worker dispatches rely on the default staying off.
+def cache_enabled() -> bool:
+    return _env_int("CDT_CACHE", 0) == 1
+
+
+# Host-RAM LRU budget for decoded tile results, in MB. Eviction is
+# strict LRU by bytes; an entry larger than the whole budget is never
+# RAM-resident (it still lands on disk when the disk tier is on).
+CACHE_RAM_MB = _env_float("CDT_CACHE_RAM_MB", 256.0)
+# Disk tier byte budget (prune-oldest by mtime past it; 0 = unbounded).
+CACHE_DISK_MB = _env_float("CDT_CACHE_DISK_MB", 1024.0)
+# Disk tier location; "0"/"off"/"none"/empty disables the disk tier
+# (RAM-only cache). Follows the compile-cache dir idiom: resolved at
+# call time so tests can monkeypatch the env.
+CACHE_DIR_DISABLED_VALUES = ("0", "off", "none")
+
+
+def cache_dir() -> str | None:
+    """Resolved disk-tier directory for the tile cache (None = RAM-only)."""
+    raw = os.environ.get("CDT_CACHE_DIR", "").strip()
+    if not raw or raw.lower() in CACHE_DIR_DISABLED_VALUES:
+        return None
+    return raw
+
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

@@ -305,6 +305,20 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_USAGE_TTL", "3600.0", "telemetry",
          "Seconds of inactivity before a job/tenant usage entry folds "
          "into retired aggregates and its retained series evict."),
+    # --- tile result cache -----------------------------------------------
+    Knob("CDT_CACHE", "0", "cache",
+         "`1` enables the master-side content-addressed tile result "
+         "cache: hits settle into the job at grant time (journaled, "
+         "never dispatched) and blend from cached pixels."),
+    Knob("CDT_CACHE_DIR", "unset", "cache",
+         "Directory for the CRC-checked disk tier; unset/`0`/`off`/"
+         "`none` keeps the cache RAM-only (the CDT_JOURNAL_DIR idiom)."),
+    Knob("CDT_CACHE_DISK_MB", "1024.0", "cache",
+         "Disk-tier byte budget in MB (oldest entries pruned beyond it; "
+         "0 = unbounded)."),
+    Knob("CDT_CACHE_RAM_MB", "256.0", "cache",
+         "Host-RAM LRU byte budget in MB; an entry larger than the "
+         "whole budget is stored disk-only."),
     # --- incident plane --------------------------------------------------
     Knob("CDT_FLIGHT", "1", "incidents",
          "`0` disables the always-on flight recorder (the bus tap that "

@@ -38,6 +38,7 @@ import {
   patchWorkflowText,
 } from "./modules/widgets.js";
 import {
+  cacheHtml,
   durabilityHtml,
   fleetHtml,
   incidentsHtml,
@@ -103,6 +104,7 @@ async function refreshStatus() {
   refreshRegion();
   refreshFleet();
   refreshUsage();
+  refreshCache();
   refreshIncidents();
   schedulePoll();
 }
@@ -186,6 +188,17 @@ async function refreshUsage() {
   }
 }
 
+// ---------- tile result cache card ----------
+
+async function refreshCache() {
+  const container = document.getElementById("cache");
+  try {
+    container.innerHTML = cacheHtml(await api("/distributed/cache"));
+  } catch {
+    container.textContent = "cache status unreachable";
+  }
+}
+
 // ---------- incidents card ----------
 
 async function refreshIncidents() {
@@ -262,6 +275,11 @@ function startEventStream() {
         // directly (no extra fetch — the event IS the payload)
         const container = document.getElementById("usage");
         if (container) container.innerHTML = usageHtml(event.data);
+      } else if (event.type === "cache_stats") {
+        // the cache card is stream-fed: the pushed stats snapshot IS
+        // the GET /distributed/cache payload minus the enabled flag
+        const container = document.getElementById("cache");
+        if (container) container.innerHTML = cacheHtml(event.data);
       } else if (event.type === "incident_captured") {
         // a bundle just landed; show it without waiting for the poll
         refreshIncidents();

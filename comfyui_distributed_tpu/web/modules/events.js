@@ -24,6 +24,7 @@ export const EVENT_TYPES = [
   "brownout_level",
   "fleet_rollup",
   "usage_rollup",
+  "cache_stats",
   "alert_fired",
   "alert_resolved",
   "incident_captured",
@@ -43,6 +44,7 @@ export function reduceLiveStatus(prev, event) {
     events: [...(prev?.events || [])],
     fleet: prev?.fleet || null,
     usage: prev?.usage || null,
+    cache: prev?.cache || null,
     alerts: new Set(prev?.alerts || []),
   };
   if (event.type === "hello") {
@@ -59,6 +61,9 @@ export function reduceLiveStatus(prev, event) {
   }
   if (event.type === "usage_rollup") {
     next.usage = event.data; // latest attribution rollup wins
+  }
+  if (event.type === "cache_stats") {
+    next.cache = event.data; // latest tile-cache snapshot wins
   }
   if (event.type === "alert_fired") next.alerts.add(event.data.slo);
   if (event.type === "alert_resolved") next.alerts.delete(event.data.slo);
@@ -117,6 +122,8 @@ export function eventLabel(event) {
       return null; // rendered as the fleet card, not an event line
     case "usage_rollup":
       return null; // rendered as the usage card, not an event line
+    case "cache_stats":
+      return null; // rendered as the cache card, not an event line
     case "events_dropped":
       return `stream dropped ${d.count} event(s) (slow consumer)`;
     default:

@@ -325,6 +325,36 @@ export function usageHtml(usage) {
   );
 }
 
+/** Cache card (pure; app.js refreshCache applies it): tile result
+ * cache tiers + hit rate from GET /distributed/cache; pushed
+ * `cache_stats` events refresh the same card between polls. */
+export function cacheHtml(stats) {
+  if (!stats) return '<span class="meta">cache status unavailable</span>';
+  if (stats.enabled === false) {
+    return '<span class="meta">tile cache off — masters with CDT_CACHE=1 serve it</span>';
+  }
+  const mib = (n) => (Number(n ?? 0) / (1024 * 1024)).toFixed(1);
+  const hits = Number(stats.hits ?? 0);
+  const misses = Number(stats.misses ?? 0);
+  const header =
+    `<div class="row">hit rate <b>${(Number(stats.hit_rate ?? 0) * 100).toFixed(1)}%</b>` +
+    ` · ${hits} hit(s) / ${misses} miss(es)` +
+    ` · ${Number(stats.settled ?? 0)} tile(s) settled from cache</div>`;
+  const tiers =
+    `<div class="row"><span class="meta">ram ${Number(stats.ram_entries ?? 0)} entries` +
+    ` / ${mib(stats.ram_bytes)} MiB` +
+    (stats.disk_tier
+      ? ` · disk ${mib(stats.disk_bytes)} MiB (${Number(stats.hits_disk ?? 0)} hit(s))`
+      : " · disk tier off") +
+    `</span></div>`;
+  const churn =
+    `<div class="row"><span class="meta">${Number(stats.puts ?? 0)} put(s)` +
+    ` · ${Number(stats.evictions ?? 0)} eviction(s)` +
+    `${Number(stats.corrupt ?? 0) ? ` · <b>${Number(stats.corrupt)} corrupt entr(ies) dropped</b>` : ""}` +
+    `</span></div>`;
+  return header + tiers + churn;
+}
+
 /** Incidents card (pure; app.js refreshIncidents applies it): the
  * newest-first bundle listing from GET /distributed/incidents plus
  * flight-recorder accounting; pushed `incident_captured` events

@@ -187,6 +187,17 @@ test("eventLabel: alert transitions readable, fleet_rollup silent", () => {
   );
   assertEqual(eventLabel({ type: "fleet_rollup", data: {} }), null);
   assertEqual(eventLabel({ type: "usage_rollup", data: {} }), null);
+  assertEqual(eventLabel({ type: "cache_stats", data: {} }), null);
+});
+
+test("reduceLiveStatus: cache stats tracked for the cache card", () => {
+  const status = reduceLiveStatus(undefined, {
+    type: "cache_stats",
+    data: { hits: 4, misses: 1, hit_rate: 0.8 },
+  });
+  assertEqual(status.cache.hit_rate, 0.8);
+  const next = reduceLiveStatus(status, { type: "hello", data: {} });
+  assertEqual(next.cache.hits, 4, "snapshot survives a hello frame");
 });
 
 test("reduceLiveStatus: usage rollups tracked for the usage card", () => {

@@ -5,6 +5,7 @@
 
 import { assert, assertEqual, assertIncludes, test } from "./harness.js";
 import {
+  cacheHtml,
   dividerNodeHtml,
   fleetHtml,
   incidentsHtml,
@@ -297,6 +298,45 @@ test("usageHtml: disabled / tenant rows / waste breakdown", () => {
   // a pushed usage_rollup event IS the rollup (no wrapper): same card
   const pushed = usageHtml(usage.rollup);
   assertIncludes(pushed, "tenant-b");
+});
+
+test("cacheHtml: disabled / tiers / corrupt emphasis", () => {
+  assertIncludes(cacheHtml(null), "unavailable");
+  assertIncludes(cacheHtml({ enabled: false }), "CDT_CACHE=1");
+  const stats = {
+    enabled: true,
+    hits: 9,
+    hits_ram: 7,
+    hits_disk: 2,
+    misses: 3,
+    hit_rate: 0.75,
+    puts: 3,
+    evictions: 1,
+    corrupt: 0,
+    settled: 9,
+    ram_entries: 4,
+    ram_bytes: 4 * 1024 * 1024,
+    disk_bytes: 12 * 1024 * 1024,
+    disk_tier: true,
+  };
+  const html = cacheHtml(stats);
+  assertIncludes(html, "hit rate <b>75.0%</b>");
+  assertIncludes(html, "9 hit(s) / 3 miss(es)");
+  assertIncludes(html, "9 tile(s) settled from cache");
+  assertIncludes(html, "ram 4 entries / 4.0 MiB");
+  assertIncludes(html, "disk 12.0 MiB (2 hit(s))");
+  assertIncludes(html, "3 put(s)");
+  // corrupt entries are loud; a clean cache never mentions them
+  if (html.includes("corrupt")) {
+    throw new Error("clean cache must not render a corrupt line");
+  }
+  const corrupt = cacheHtml({ ...stats, corrupt: 2 });
+  assertIncludes(corrupt, "<b>2 corrupt entr(ies) dropped</b>");
+  // RAM-only cache labels the disk tier off
+  const ramOnly = cacheHtml({ ...stats, disk_tier: false });
+  assertIncludes(ramOnly, "disk tier off");
+  // a pushed cache_stats event IS the stats payload (no wrapper)
+  assertIncludes(cacheHtml({ hits: 0, misses: 0, hit_rate: 0 }), "hit rate");
 });
 
 test("incidentsHtml: disabled / flight accounting / bundle rows", () => {

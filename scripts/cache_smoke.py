@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Tile-result-cache smoke: the CI `cache-smoke` job's driver.
+
+One cold->warm pass per tier of the content-addressed tile cache
+(docs/caching.md) asserting its load-bearing properties:
+
+1. **near-free warm serving** — the warm re-run of an identical
+   elastic request probes once, hits every tile, settles them all at
+   grant time, and dispatches ZERO tiles to workers (the
+   accepted-submission ledger shows every tile on the master);
+2. **bit-identity, always** — the cold run, the warm run, and every
+   degraded run below produce a canvas bit-identical to the
+   cache-free reference. A cache may change WHO computes a tile,
+   never WHAT lands on the canvas;
+3. **disk tier survives restarts** — a fresh cache instance on the
+   same directory (empty RAM) serves every tile from disk;
+4. **corruption degrades to recompute** — flipping one byte of a
+   disk entry's body makes its CRC check fail: the entry is counted
+   corrupt, unlinked, recomputed, re-put — and the canvas is still
+   bit-identical (a corrupt read is a miss, never a wrong canvas);
+5. **cached chip-time is metered, not hidden** — the warm run's
+   usage rollup shows the `cached` bucket carrying the settled tiles
+   at ~zero chip-time.
+
+Writes the combined stats JSON (uploaded as a CI artifact) to the
+path given as argv[1] (default: cache-smoke.json). Exit 0 = every
+assertion held. Runs on CPU with the stubbed diffusion core.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check(condition: bool, label: str, detail=None) -> None:
+    if not condition:
+        raise SystemExit(f"cache-smoke FAILED: {label}: {detail!r}")
+    print(f"  ok: {label}")
+
+
+def _assert_dispatch_free(result, n: int, label: str) -> None:
+    workers = {
+        k: v for k, v in result.tiles_by_worker.items() if k != "master"
+    }
+    check(
+        all(v == 0 for v in workers.values())
+        and result.tiles_by_worker["master"] == n,
+        f"{label}: zero worker dispatches ({n} tiles settled on master)",
+        result.tiles_by_worker,
+    )
+
+
+def ram_tier(baseline: np.ndarray) -> dict:
+    from comfyui_distributed_tpu.cache.store import TileResultCache
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+    print("RAM tier: cold populate -> warm serve")
+    cache = TileResultCache(ram_mb=128)
+    cold = run_chaos_usdu(seed=11, cache=cache)
+    check(
+        np.array_equal(baseline, cold.output),
+        "cold canvas bit-identical to cache-free reference",
+    )
+    n = cold.cache["puts"]
+    check(n > 0 and cold.cache["hits"] == 0, "cold run populated the cache",
+          cold.cache)
+
+    warm = run_chaos_usdu(seed=11, cache=cache)
+    check(
+        np.array_equal(baseline, warm.output),
+        "warm canvas bit-identical to cache-free reference",
+    )
+    hits = warm.cache["hits"] - cold.cache["hits"]
+    check(hits == n, "warm run: 100% probe hits", warm.cache)
+    check(
+        warm.cache["settled"] - cold.cache["settled"] == n,
+        "warm run: every tile settled from cache at grant time",
+        warm.cache,
+    )
+    _assert_dispatch_free(warm, n, "warm run")
+    totals = warm.usage["totals"]
+    check(totals["conserved"], "warm usage rollup still conserves exactly",
+          totals)
+    check(
+        totals["cached_tiles"] - cold.usage["totals"]["cached_tiles"] == n,
+        "every warm tile charged to the `cached` bucket", totals,
+    )
+    print(f"  info: cached bucket: {totals['cached_tiles']} tiles, "
+          f"{totals['cached_ns']} ns")
+    return {"tiles": n, "cold": cold.cache, "warm": warm.cache}
+
+
+def disk_tier(baseline: np.ndarray) -> dict:
+    from comfyui_distributed_tpu.cache.store import TileResultCache
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+    print("disk tier: restart -> corrupt entry -> recompute")
+    with tempfile.TemporaryDirectory(prefix="cdt-cache-smoke-") as tmp:
+        disk = os.path.join(tmp, "tile-cache")
+
+        def fresh():
+            return TileResultCache(ram_mb=64, disk_dir=disk, disk_mb=64)
+
+        cold = run_chaos_usdu(seed=11, cache=fresh())
+        check(np.array_equal(baseline, cold.output),
+              "disk cold canvas bit-identical")
+        n = cold.cache["puts"]
+
+        warm = run_chaos_usdu(seed=11, cache=fresh())
+        check(np.array_equal(baseline, warm.output),
+              "disk warm canvas bit-identical after 'restart'")
+        check(
+            warm.cache["hits_disk"] == n and warm.cache["hits_ram"] == 0,
+            "restart: every tile served from the disk tier", warm.cache,
+        )
+        _assert_dispatch_free(warm, n, "disk warm run")
+
+        victims = []
+        for root, _dirs, files in os.walk(disk):
+            victims += [os.path.join(root, f) for f in files
+                        if f.endswith(".tile")]
+        victim = sorted(victims)[0]
+        blob = bytearray(open(victim, "rb").read())
+        blob[-1] ^= 0xFF
+        with open(victim, "wb") as fh:
+            fh.write(bytes(blob))
+
+        hurt = run_chaos_usdu(seed=11, cache=fresh())
+        check(np.array_equal(baseline, hurt.output),
+              "corrupt entry: canvas STILL bit-identical")
+        check(hurt.cache["corrupt"] == 1,
+              "corrupt entry detected by CRC and dropped", hurt.cache)
+        check(
+            hurt.cache["settled"] == n - 1 and hurt.cache["puts"] == 1,
+            "corrupt tile recomputed and written back", hurt.cache,
+        )
+        return {"tiles": n, "warm": warm.cache, "corrupt": hurt.cache}
+
+
+def main() -> int:
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "cache-smoke.json"
+    print("reference: cache-free chaos run")
+    baseline = run_chaos_usdu(seed=11).output
+    report = {
+        "ram_tier": ram_tier(baseline),
+        "disk_tier": disk_tier(baseline),
+    }
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(f"cache-smoke OK; stats written to {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ _SUBSYSTEM_TITLES = {
     "durability": "Durable control plane",
     "pipeline": "Tile pipeline & compile cache",
     "telemetry": "Telemetry",
+    "cache": "Tile result cache",
     "jobs": "Job store",
     "workers": "Worker lifecycle",
     "network": "Network & config",

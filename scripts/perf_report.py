@@ -37,9 +37,21 @@ from typing import Any
 # (the successful attempt closes it), and the deadline fallback (the
 # master samples un-pulled tiles directly). Per-tile submit spans are
 # optional — the production worker flushes submits in batches without
-# a tile_idx, while the chaos harness records them per tile.
+# a tile_idx, while the chaos harness records them per tile. The ONE
+# legitimate path with neither sample nor blend is a cache settle: a
+# `tile.cache.hit` span on the master means the content-addressed
+# cache served the tile and nobody computed it this run.
 REQUIRED_ANY_ROLE = "sample"
 REQUIRED_MASTER = "blend"
+REQUIRED_CACHED = "cache.hit"
+
+# Cache serving reconstruction: the master opens one `tile.cache.probe`
+# span per job (attrs: `hits`) and one `tile.cache.hit` span per tile
+# it settles from the cache; `tile.dispatch` spans carry the `real`
+# tiles that DID burn device slots. hits / (hits + dispatched real) is
+# the offline cache hit rate for the trace.
+CACHE_HIT_STAGE = "cache.hit"
+CACHE_PROBE_STAGE = "cache.probe"
 
 # Scheduler queue-wait reconstruction: the admission gate opens a
 # `sched.wait` span when a request is admitted (api/job_routes.py);
@@ -231,6 +243,38 @@ def batch_fill_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def cache_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Tile-cache serving rate from the master's probe/hit spans vs
+    the dispatch spans: what fraction of this trace's tiles were
+    settled straight from the content-addressed cache instead of
+    burning a device slot. None when the trace recorded no probe (a
+    cache-off run stays comparable — absence is not a 0% hit rate)."""
+    probes = 0
+    hits = 0
+    dispatched = 0
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        stage = attrs.get("stage")
+        if stage == CACHE_HIT_STAGE:
+            hits += 1
+        elif stage == CACHE_PROBE_STAGE:
+            probes += 1
+        elif stage == "dispatch":
+            try:
+                dispatched += int(attrs.get("real", 0) or 0)
+            except (TypeError, ValueError):
+                continue
+    if probes == 0 and hits == 0:
+        return None
+    served = hits + dispatched
+    return {
+        "probes": probes,
+        "hits": hits,
+        "dispatched_tiles": dispatched,
+        "hit_rate": (hits / served) if served > 0 else 0.0,
+    }
+
+
 def usage_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     """Chip-second attribution from the per-dispatch spans both
     execution tiers emit (``tile.dispatch`` with ``real``/``bucket``
@@ -383,6 +427,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "queue_wait": queue_wait_stats(spans),
         "pipeline_overlap": pipeline_overlap_stats(spans),
         "batch_fill": batch_fill_stats(spans),
+        "cache": cache_stats(spans),
     }
 
 
@@ -426,7 +471,8 @@ def incomplete_tiles(tiles: dict[int, list[dict[str, Any]]]) -> dict[int, str]:
             seen.setdefault(stage["role"], set()).add(stage["stage"])
         sampled = any(REQUIRED_ANY_ROLE in st for st in seen.values())
         blended = REQUIRED_MASTER in seen.get("master", set())
-        if not (sampled and blended):
+        cached = REQUIRED_CACHED in seen.get("master", set())
+        if not (cached or (sampled and blended)):
             problems[tile_idx] = (
                 "stages seen: "
                 + "; ".join(
@@ -509,6 +555,23 @@ def compare_reports(
                     "delta_pct": drop_pct,
                 }
             )
+    # cache hit rate gates inverted too: a DROP means tiles the old
+    # trace settled near-free from the content-addressed cache went
+    # back to burning device slots (a key-schema change that silently
+    # misses everything is exactly this regression).
+    old_cache = old_report.get("cache")
+    new_cache = new_report.get("cache")
+    if old_cache and new_cache and old_cache["hit_rate"] > 0:
+        drop_pct = (1.0 - new_cache["hit_rate"] / old_cache["hit_rate"]) * 100.0
+        if drop_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": "cache_hit_rate",
+                    "old_p95": old_cache["hit_rate"],
+                    "new_p95": new_cache["hit_rate"],
+                    "delta_pct": drop_pct,
+                }
+            )
     return regressions
 
 
@@ -528,6 +591,12 @@ def render_comparison(
         if item["stage"] == "batch_fill":
             lines.append(
                 f"  {item['stage']:28} fill {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
+        if item["stage"] == "cache_hit_rate":
+            lines.append(
+                f"  {item['stage']:28} hit rate {item['old_p95']:.3f} -> "
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
             )
             continue
@@ -722,6 +791,15 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"{fill['cross_job_dispatches']} cross-job): "
             f"{fill['real_tiles']}/{fill['slots']} "
             f"(fill {fill['fill']:.3f})"
+        )
+    cache = report.get("cache")
+    if cache:
+        lines.append("")
+        lines.append(
+            f"tile cache ({cache['probes']} probe(s)): "
+            f"{cache['hits']} settled from cache vs "
+            f"{cache['dispatched_tiles']} dispatched "
+            f"(hit rate {cache['hit_rate']:.3f})"
         )
     if tiles:
         lines.append("")

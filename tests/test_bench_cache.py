@@ -1,0 +1,42 @@
+"""The bench harness's cold->warm tile-cache A/B: the `cache` block
+stamped into tiny datums must prove the cached serving floor is real
+(warm strictly faster, 100% probe hits, zero worker dispatches) and
+honest (bit-identity verdict, no effective-rate fantasy at miss share
+zero)."""
+
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+def test_cache_ab_block_shape_and_verdicts():
+    bench = _load_bench()
+    block = bench._measure_cache_ab()
+    assert block is not None
+    assert block["tiles"] > 0
+    assert block["bit_identical"] is True
+    # warm run: every probe hit, every tile settled from cache, no
+    # worker ever dispatched — the near-free serving path end to end
+    assert block["warm"]["hit_rate"] == 1.0
+    assert block["warm"]["settled"] == block["tiles"]
+    assert block["warm"]["worker_tiles"] == 0
+    # the headline: cached serving is strictly faster than recompute
+    assert block["warm"]["elapsed_s"] < block["cold"]["elapsed_s"]
+    assert block["speedup"] > 1.0
+    # honesty rule: miss share 0 makes the amortized rate unbounded —
+    # it must be null, never a fantasy number
+    assert block["tiles_per_sec_chip_effective"] is None
+    assert block["ram_bytes"] > 0

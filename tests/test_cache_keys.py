@@ -1,0 +1,229 @@
+"""Golden suite for cache key canonicalization.
+
+The contract (docs/caching.md): any sampler input that can change one
+output bit must change the key; inputs that cannot affect output bits
+(job id on the elastic tier, tenant, placement) must NOT. Both
+directions are enforced here field by field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cache import keys as cache_keys
+from comfyui_distributed_tpu.cache.keys import (
+    JobKeyContext,
+    adapter_fingerprint,
+    base_key_hex,
+    cond_fingerprint,
+    params_fingerprint,
+    tile_key,
+)
+from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+
+
+def _tile(shape=(8, 8, 3), dtype=np.float32, bump=0.0):
+    arr = np.linspace(0.0, 1.0, int(np.prod(shape)), dtype=np.float64)
+    arr = arr.reshape(shape).astype(dtype)
+    if bump:
+        arr = arr.copy()
+        arr.flat[0] += dtype(bump) if not isinstance(bump, float) else bump
+    return arr
+
+
+def _params(scale=1.0):
+    return {
+        "unet": {"w": np.full((4, 4), scale, dtype=np.float32)},
+        "vae": {"b": np.arange(8, dtype=np.float32)},
+    }
+
+
+def _ctx(**overrides) -> JobKeyContext:
+    base = dict(
+        weights_fp=params_fingerprint(_params()),
+        cond_fp=cond_fingerprint(
+            {"emb": np.ones(4, dtype=np.float32)},
+            {"emb": np.zeros(4, dtype=np.float32)},
+        ),
+        base_key=base_key_hex(jax.random.key(7)),
+        steps=4,
+        sampler="euler",
+        scheduler="normal",
+        cfg=7.0,
+        denoise=0.5,
+        adapter_fp="",
+        upscale_by=2.0,
+        upscale_method="lanczos",
+        mask_blur=8,
+        uniform=False,
+        tiled_decode=False,
+        tile_w=512,
+        tile_h=512,
+        padding=32,
+        grid_w=1024,
+        grid_h=1024,
+        num_tiles=4,
+    )
+    base.update(overrides)
+    return JobKeyContext(**base)
+
+
+BASE_TILE = _tile()
+
+
+def _key(ctx=None, tile=None, tile_idx=0, y=0, x=0):
+    return tile_key(ctx or _ctx(), tile_idx, BASE_TILE if tile is None else tile, y, x)
+
+
+class TestIdentity:
+    def test_same_inputs_same_key(self):
+        assert _key() == _key()
+
+    def test_key_is_stable_across_context_rebuilds(self):
+        # Fingerprints recomputed from equal inputs canonicalize equally.
+        assert _key(_ctx()) == _key(_ctx())
+
+    def test_elastic_base_key_identical_across_jobs_and_tenants(self):
+        # The elastic tier's base key is jax.random.key(seed): neither
+        # job id nor tenant is a key field, so identical submissions
+        # from different jobs/tenants dedup to the same entry.
+        a = base_key_hex(jax.random.key(7))
+        b = base_key_hex(jax.random.key(7))
+        assert a == b
+        assert _key(_ctx(base_key=a)) == _key(_ctx(base_key=b))
+
+    def test_int_and_float_cfg_canonicalize_equal(self):
+        assert _key(_ctx(cfg=7)) == _key(_ctx(cfg=7.0))
+
+
+class TestPerturbations:
+    """Every output-affecting field flips the key."""
+
+    @pytest.mark.parametrize(
+        "field,value",
+        [
+            ("weights_fp", params_fingerprint(_params(scale=1.0000001))),
+            ("cond_fp", cond_fingerprint({"emb": np.ones(4, np.float32) * 2}, {})),
+            ("base_key", base_key_hex(jax.random.key(8))),
+            ("steps", 5),
+            ("sampler", "euler_a"),
+            ("scheduler", "karras"),
+            ("cfg", 7.5),
+            ("denoise", 0.51),
+            ("adapter_fp", adapter_fingerprint({"lora": np.ones(2, np.float32)})),
+            ("upscale_by", 2.5),
+            ("upscale_method", "bicubic"),
+            ("mask_blur", 9),
+            ("uniform", True),
+            ("tiled_decode", True),
+            ("tile_w", 256),
+            ("tile_h", 256),
+            ("padding", 16),
+            ("grid_w", 2048),
+            ("grid_h", 2048),
+            ("num_tiles", 8),
+        ],
+    )
+    def test_context_field_changes_key(self, field, value):
+        base = _ctx()
+        assert getattr(base, field) != value, f"perturbation for {field} is a no-op"
+        assert _key(base) != _key(_ctx(**{field: value}))
+
+    def test_every_context_field_is_covered(self):
+        # If JobKeyContext grows a field, this suite must grow with it.
+        covered = {
+            "weights_fp", "cond_fp", "base_key", "steps", "sampler",
+            "scheduler", "cfg", "denoise", "adapter_fp", "upscale_by",
+            "upscale_method", "mask_blur", "uniform", "tiled_decode",
+            "tile_w", "tile_h", "padding", "grid_w", "grid_h", "num_tiles",
+        }
+        actual = {f.name for f in dataclasses.fields(JobKeyContext)}
+        assert actual == covered
+
+    def test_single_pixel_bit_changes_key(self):
+        bumped = BASE_TILE.copy()
+        bumped[0, 0, 0] += np.float32(1.0 / 255.0)
+        assert _key(tile=bumped) != _key()
+
+    def test_dtype_changes_key(self):
+        assert _key(tile=BASE_TILE.astype(np.float64)) != _key()
+
+    def test_dtype_changes_key_even_with_identical_bytes(self):
+        z32 = np.zeros(16, dtype=np.float32)
+        z_i32 = np.zeros(16, dtype=np.int32)
+        assert z32.tobytes() == z_i32.tobytes()
+        assert _key(tile=z32) != _key(tile=z_i32)
+
+    def test_shape_changes_key_with_identical_bytes(self):
+        flat = BASE_TILE.reshape(-1)
+        assert flat.tobytes() == BASE_TILE.tobytes()
+        assert _key(tile=flat) != _key()
+
+    def test_tile_idx_changes_key(self):
+        assert _key(tile_idx=1) != _key(tile_idx=0)
+
+    def test_position_changes_key(self):
+        assert _key(y=512) != _key()
+        assert _key(x=512) != _key()
+
+    def test_key_version_changes_key(self, monkeypatch):
+        before = _key()
+        monkeypatch.setattr(cache_keys, "KEY_VERSION", cache_keys.KEY_VERSION + 1)
+        assert _key() != before
+
+    def test_adjacent_string_fields_never_collide_by_concatenation(self):
+        a = _ctx(sampler="eu", scheduler="ler")
+        b = _ctx(sampler="eule", scheduler="r")
+        assert _key(a) != _key(b)
+
+
+class TestFingerprints:
+    def test_params_fingerprint_deterministic(self):
+        assert params_fingerprint(_params()) == params_fingerprint(_params())
+
+    def test_params_single_element_drift(self):
+        drifted = _params()
+        drifted["unet"]["w"] = drifted["unet"]["w"].copy()
+        drifted["unet"]["w"][0, 0] += np.float32(1e-7)
+        assert params_fingerprint(drifted) != params_fingerprint(_params())
+
+    def test_params_structural_rename_changes_fingerprint(self):
+        renamed = {"unet": {"w2": _params()["unet"]["w"]}, "vae": _params()["vae"]}
+        assert params_fingerprint(renamed) != params_fingerprint(_params())
+
+    def test_params_dtype_drift_with_identical_bytes(self):
+        a = {"w": np.zeros(4, dtype=np.float32)}
+        b = {"w": np.zeros(4, dtype=np.int32)}
+        assert params_fingerprint(a) != params_fingerprint(b)
+
+    def test_cond_sides_do_not_alias(self):
+        pos = {"emb": np.ones(4, dtype=np.float32)}
+        neg = {"emb": np.zeros(4, dtype=np.float32)}
+        assert cond_fingerprint(pos, neg) != cond_fingerprint(neg, pos)
+
+    def test_adapter_none_is_empty(self):
+        assert adapter_fingerprint(None) == ""
+
+
+class TestSeedFold:
+    def test_xjob_fold_differs_per_job(self):
+        # fold_job_key mixes job_uid(job_id) into the base key: xjob
+        # outputs depend on the job id, so xjob cache keys must too.
+        base = jax.random.key(7)
+        a = base_key_hex(fold_job_key(base, "job-a"))
+        b = base_key_hex(fold_job_key(base, "job-b"))
+        assert a != b
+        assert _key(_ctx(base_key=a)) != _key(_ctx(base_key=b))
+
+    def test_xjob_fold_deterministic_for_same_job(self):
+        base = jax.random.key(7)
+        assert base_key_hex(fold_job_key(base, "job-a")) == base_key_hex(
+            fold_job_key(base, "job-a")
+        )
+
+    def test_seed_changes_fold(self):
+        assert base_key_hex(jax.random.key(1)) != base_key_hex(jax.random.key(2))

@@ -1,0 +1,185 @@
+"""Unit tests for the two-tier tile result cache store."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cache.store import (
+    TileResultCache,
+    _reset_tile_cache_for_tests,
+    get_tile_cache,
+    set_tile_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_cache():
+    _reset_tile_cache_for_tests()
+    yield
+    _reset_tile_cache_for_tests()
+
+
+def _arr(seed=0, shape=(4, 4, 3)):
+    rng = np.random.default_rng(seed)
+    return rng.random(shape).astype(np.float32)
+
+
+class TestRamTier:
+    def test_put_get_roundtrip(self):
+        cache = TileResultCache(ram_mb=1, disk_dir=None)
+        a = _arr(1)
+        cache.put("k1", a)
+        got = cache.get("k1")
+        np.testing.assert_array_equal(got, a)
+        assert got.flags.writeable is False
+
+    def test_put_copies_caller_mutation_invisible(self):
+        cache = TileResultCache(ram_mb=1, disk_dir=None)
+        a = _arr(1)
+        cache.put("k1", a)
+        a[0, 0, 0] = 99.0
+        assert cache.get("k1")[0, 0, 0] != 99.0
+
+    def test_miss(self):
+        cache = TileResultCache(ram_mb=1, disk_dir=None)
+        assert cache.get("absent") is None
+        assert cache.stats()["misses"] == 1
+
+    def test_lru_eviction_by_bytes(self):
+        entry = _arr(0, shape=(64, 64, 3))  # 48 KiB
+        budget_mb = (2 * entry.nbytes + entry.nbytes // 2) / (1024 * 1024)
+        cache = TileResultCache(ram_mb=budget_mb, disk_dir=None)
+        cache.put("a", _arr(1, shape=(64, 64, 3)))
+        cache.put("b", _arr(2, shape=(64, 64, 3)))
+        cache.get("a")  # touch: b becomes LRU
+        cache.put("c", _arr(3, shape=(64, 64, 3)))
+        assert cache.get("b") is None
+        assert cache.get("a") is not None
+        assert cache.get("c") is not None
+        assert cache.stats()["evictions"] == 1
+
+    def test_oversized_entry_skips_ram(self):
+        cache = TileResultCache(ram_mb=0.00001, disk_dir=None)
+        cache.put("big", _arr(1, shape=(64, 64, 3)))
+        assert cache.stats()["ram_entries"] == 0
+
+
+class TestDiskTier:
+    def test_disk_roundtrip_and_promotion(self, tmp_path):
+        d = str(tmp_path / "cache")
+        a = _arr(5)
+        writer = TileResultCache(ram_mb=1, disk_dir=d)
+        writer.put("kd", a)
+        # A fresh instance (cold RAM) must hit via disk.
+        reader = TileResultCache(ram_mb=1, disk_dir=d)
+        got = reader.get("kd")
+        np.testing.assert_array_equal(got, a)
+        stats = reader.stats()
+        assert stats["hits_disk"] == 1
+        # Promotion: the second get is a RAM hit.
+        reader.get("kd")
+        assert reader.stats()["hits_ram"] == 1
+
+    def test_corrupt_body_is_miss_and_deleted(self, tmp_path):
+        d = str(tmp_path / "cache")
+        cache = TileResultCache(ram_mb=1, disk_dir=d)
+        cache.put("kc", _arr(6))
+        path = cache._disk_path("kc")
+        blob = bytearray(open(path, "rb").read())
+        blob[-1] ^= 0xFF  # flip one pixel byte
+        with open(path, "wb") as fh:
+            fh.write(bytes(blob))
+        reader = TileResultCache(ram_mb=1, disk_dir=d)
+        assert reader.get("kc") is None
+        assert reader.stats()["corrupt"] == 1
+        assert not os.path.exists(path)
+        # Re-read is a clean miss, not another corruption event.
+        assert reader.get("kc") is None
+        assert reader.stats()["corrupt"] == 1
+
+    def test_truncated_and_garbage_files_are_misses(self, tmp_path):
+        d = str(tmp_path / "cache")
+        cache = TileResultCache(ram_mb=1, disk_dir=d)
+        cache.put("kt", _arr(7))
+        path = cache._disk_path("kt")
+        with open(path, "wb") as fh:
+            fh.write(b"CDTC")  # truncated header
+        reader = TileResultCache(ram_mb=1, disk_dir=d)
+        assert reader.get("kt") is None
+        garbage = os.path.join(d, "aa", "a" * 64 + ".tile")
+        os.makedirs(os.path.dirname(garbage), exist_ok=True)
+        with open(garbage, "wb") as fh:
+            fh.write(b"not a cache entry at all")
+        assert reader.get("a" * 64) is None
+        assert reader.stats()["corrupt"] == 2
+
+    def test_disk_prune_oldest_past_budget(self, tmp_path):
+        d = str(tmp_path / "cache")
+        entry_bytes = _arr(0, shape=(64, 64, 3)).nbytes
+        cache = TileResultCache(
+            ram_mb=1, disk_dir=d, disk_mb=(2.5 * entry_bytes) / (1024 * 1024)
+        )
+        for i, key in enumerate(["old", "mid", "new"]):
+            cache.put(key, _arr(i, shape=(64, 64, 3)))
+            os.utime(cache._disk_path(key), (1000 + i, 1000 + i))
+        cache.put("newest", _arr(9, shape=(64, 64, 3)))
+        assert not os.path.exists(cache._disk_path("old"))
+        assert os.path.exists(cache._disk_path("newest"))
+
+    def test_ram_disabled_still_serves_from_disk(self, tmp_path):
+        d = str(tmp_path / "cache")
+        cache = TileResultCache(ram_mb=0, disk_dir=d)
+        a = _arr(8)
+        cache.put("k0", a)
+        np.testing.assert_array_equal(cache.get("k0"), a)
+        assert cache.stats()["hits_disk"] == 1
+
+
+class TestManagement:
+    def test_clear_drops_both_tiers(self, tmp_path):
+        d = str(tmp_path / "cache")
+        cache = TileResultCache(ram_mb=1, disk_dir=d)
+        cache.put("k1", _arr(1))
+        cache.put("k2", _arr(2))
+        dropped = cache.clear()
+        assert dropped["dropped_entries"] >= 2
+        assert cache.get("k1") is None
+        assert cache.stats()["ram_entries"] == 0
+        assert cache.stats()["disk_bytes"] == 0
+
+    def test_stats_hit_rate(self):
+        cache = TileResultCache(ram_mb=1, disk_dir=None)
+        cache.put("k", _arr(1))
+        cache.get("k")
+        cache.get("absent")
+        stats = cache.stats()
+        assert stats["hit_rate"] == pytest.approx(0.5)
+        assert stats["puts"] == 1
+
+    def test_note_settled(self):
+        cache = TileResultCache(ram_mb=1, disk_dir=None)
+        cache.note_settled(3)
+        assert cache.stats()["settled"] == 3
+
+
+class TestGlobalAccessor:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("CDT_CACHE", raising=False)
+        assert get_tile_cache() is None
+
+    def test_enabled_constructs_and_memoizes(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CDT_CACHE", "1")
+        monkeypatch.setenv("CDT_CACHE_DIR", str(tmp_path / "d"))
+        cache = get_tile_cache()
+        assert isinstance(cache, TileResultCache)
+        assert get_tile_cache() is cache
+
+    def test_set_returns_previous(self):
+        mine = TileResultCache(ram_mb=1, disk_dir=None)
+        prev = set_tile_cache(mine)
+        assert prev is None
+        assert get_tile_cache() is mine
+        assert set_tile_cache(None) is mine

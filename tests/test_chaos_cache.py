@@ -1,0 +1,231 @@
+"""Chaos acceptance for the content-addressed tile result cache.
+
+The bar is the same as every other chaos family: a cache may only
+change WHO computes a tile (ideally nobody), never WHAT lands on the
+canvas. Each scenario compares a warm (cache-served) run bit-for-bit
+against the cache-free reference — under faults included — and
+asserts the warm run dispatched nothing to workers.
+
+Tier separation is part of the contract and is asserted here too: the
+elastic tier keys on the unfolded base key (cross-job dedup), the xjob
+tier on the job-folded key (same-job-only dedup).
+
+These are tier-1 tests: CPU-only, stubbed diffusion, a few seconds
+each. `pytest -m chaos` selects the chaos families.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from comfyui_distributed_tpu.cache.store import (
+    TileResultCache,
+    set_tile_cache,
+)
+from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+
+pytestmark = pytest.mark.chaos
+
+# Same construction as test_chaos_usdu: slow the master's first pulls
+# so worker threads deterministically win tiles on COLD runs — the
+# faults below must actually fire while the cache is being populated.
+SLOW_MASTER = "latency(0.15)@store:pull:master#1-3"
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The cache-free reference canvas every scenario compares against."""
+    result = run_chaos_usdu(seed=11)
+    assert result.output.shape == (1, 128, 128, 3)
+    return result.output
+
+
+def _assert_warm_dispatch_free(warm, n_tiles: int) -> None:
+    """A fully warm run serves every tile from the cache: the store's
+    pending queue is emptied by settle_cached before any worker pulls,
+    so the accepted-submission ledger shows zero worker tiles."""
+    workers = {k: v for k, v in warm.tiles_by_worker.items() if k != "master"}
+    assert all(v == 0 for v in workers.values()), warm.tiles_by_worker
+    assert warm.tiles_by_worker["master"] == n_tiles
+
+
+def test_cold_then_warm_bit_identical_and_dispatch_free(baseline):
+    """The headline A/B: a cold run populates the cache (output already
+    bit-identical to the cache-free reference), the warm re-run serves
+    every tile from RAM without dispatching a single one."""
+    cache = TileResultCache(ram_mb=64)
+    cold = run_chaos_usdu(seed=11, cache=cache)
+    np.testing.assert_array_equal(baseline, cold.output)
+    n = cold.cache["puts"]
+    assert n > 0 and cold.cache["hits"] == 0
+    assert cold.cache["misses"] == n  # every tile probed, none present
+
+    warm = run_chaos_usdu(seed=11, cache=cache)
+    np.testing.assert_array_equal(baseline, warm.output)
+    assert warm.cache["hits"] - cold.cache["hits"] == n
+    assert warm.cache["settled"] - cold.cache["settled"] == n
+    assert warm.cache["puts"] == n  # populate skips tiles served as hits
+    _assert_warm_dispatch_free(warm, n)
+
+
+def test_populate_under_crash_after_pull_then_warm_bit_identical(baseline):
+    """Crash-after-pull during the POPULATING run: w1 dies with a tile
+    assigned, the heartbeat requeue recomputes it, and the cache ends
+    up with exactly the accepted (first-wins) results — the warm rerun
+    is bit-identical and dispatch-free."""
+    cache = TileResultCache(ram_mb=64)
+    cold = run_chaos_usdu(
+        seed=11,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+        cache=cache,
+    )
+    assert "w1" in cold.crashed_workers  # the fault actually fired
+    np.testing.assert_array_equal(baseline, cold.output)
+    n = cold.cache["puts"]
+
+    warm = run_chaos_usdu(seed=11, cache=cache)
+    np.testing.assert_array_equal(baseline, warm.output)
+    assert warm.cache["settled"] - cold.cache["settled"] == n
+    _assert_warm_dispatch_free(warm, n)
+
+
+def test_populate_under_speculative_race_then_warm_bit_identical(baseline):
+    """The speculative-race scenario with a cache attached: the
+    watchdog re-dispatches a straggler's in-flight tile, so the SAME
+    tile is computed twice — the store accepts one (first wins), the
+    duplicate is dropped before it ever reaches blend_local, and the
+    cache holds exactly one copy. Warm rerun: bit-identical,
+    dispatch-free."""
+    cache = TileResultCache(ram_mb=64)
+    cold = run_chaos_usdu(
+        seed=11,
+        fault_plan=(
+            f"seed=11;{SLOW_MASTER};latency(0.4)@chaos:w1:pulled#*;"
+            "crash@chaos:w2:pulled#1"
+        ),
+        worker_timeout=10.0,  # heartbeat requeue never fires
+        watchdog={},
+        cache=cache,
+    )
+    assert any(cold.speculated.values()), cold.speculated
+    np.testing.assert_array_equal(baseline, cold.output)
+    n = cold.cache["puts"]
+    # first-wins at the store: the speculated duplicate never blended,
+    # so it never populated — one put per tile, exactly
+    assert n == cold.cache["misses"]
+
+    warm = run_chaos_usdu(seed=11, cache=cache)
+    np.testing.assert_array_equal(baseline, warm.output)
+    assert warm.cache["settled"] - cold.cache["settled"] == n
+    _assert_warm_dispatch_free(warm, n)
+
+
+def test_disk_tier_warm_restart_and_corrupt_entry_degrade(tmp_path, baseline):
+    """The disk tier across 'process restarts' (fresh cache instances
+    on the same directory): a clean restart serves every tile from
+    disk; a corrupted entry is detected by CRC, dropped, recomputed —
+    and the canvas is STILL bit-identical (a corrupt read must be a
+    miss, never a wrong canvas)."""
+    disk = str(tmp_path / "tile-cache")
+    cold = run_chaos_usdu(
+        seed=11, cache=TileResultCache(ram_mb=64, disk_dir=disk, disk_mb=64)
+    )
+    np.testing.assert_array_equal(baseline, cold.output)
+    n = cold.cache["puts"]
+
+    # clean restart: empty RAM, warm disk
+    warm = run_chaos_usdu(
+        seed=11, cache=TileResultCache(ram_mb=64, disk_dir=disk, disk_mb=64)
+    )
+    np.testing.assert_array_equal(baseline, warm.output)
+    assert warm.cache["hits_disk"] == n and warm.cache["hits_ram"] == 0
+    assert warm.cache["settled"] == n
+    _assert_warm_dispatch_free(warm, n)
+
+    # corrupt ONE entry's body (CRC now wrong), restart again
+    victim = sorted((tmp_path / "tile-cache").rglob("*.tile"))[0]
+    blob = bytearray(victim.read_bytes())
+    blob[-1] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+    hurt = run_chaos_usdu(
+        seed=11, cache=TileResultCache(ram_mb=64, disk_dir=disk, disk_mb=64)
+    )
+    np.testing.assert_array_equal(baseline, hurt.output)
+    assert hurt.cache["corrupt"] == 1
+    assert hurt.cache["settled"] == n - 1  # the corrupt tile recomputed
+    assert hurt.cache["puts"] == 1  # ...and was written back
+
+
+def test_xjob_tier_warm_rerun_same_job_only(monkeypatch):
+    """The xjob tier keys on the JOB-FOLDED base key: a re-run of the
+    SAME job is served entirely from cache (bit-identical, zero
+    executor tiles), while a different job_id with otherwise identical
+    inputs misses everything — folded keys make cross-job reuse
+    impossible by construction."""
+    from unittest import mock
+
+    from comfyui_distributed_tpu.graph import ExecutionContext
+    from comfyui_distributed_tpu.graph import batch_executor as bx
+    from comfyui_distributed_tpu.graph import usdu_elastic as elastic
+    from comfyui_distributed_tpu.jobs import JobStore
+    from comfyui_distributed_tpu.resilience.chaos import (
+        _ensure_server_loop,
+        _stub_stepwise,
+    )
+
+    monkeypatch.setenv("CDT_XJOB_BATCH", "1")
+    monkeypatch.setenv("CDT_DETERMINISTIC_BLEND", "1")
+
+    def one_run(job_id: str) -> np.ndarray:
+        bx._reset_shared_executor_for_tests()
+        store = JobStore()
+        ctx = ExecutionContext(
+            server=types.SimpleNamespace(job_store=store),
+            config={"workers": []},
+        )
+        bundle = types.SimpleNamespace(params=None)
+        image = jnp.asarray(
+            np.random.default_rng(0).random((1, 32, 96, 3)), jnp.float32
+        )
+        pos = neg = jnp.zeros((1, 4, 8), jnp.float32)
+        with _ensure_server_loop(), mock.patch(
+            "comfyui_distributed_tpu.ops.stepwise.make_stepwise_tile_processor",
+            lambda *a, **k: _stub_stepwise(2),
+        ):
+            out = elastic.run_master_elastic(
+                bundle, image, pos, neg,
+                job_id=job_id,
+                enabled_worker_ids=[],
+                upscale_by=2.0, tile=64, padding=16,
+                steps=2, sampler="euler", scheduler="karras",
+                cfg=1.0, denoise=0.3, seed=0, context=ctx,
+            )
+        assert store.tile_jobs == {}  # settled cleanly either way
+        return np.asarray(out)
+
+    cache = TileResultCache(ram_mb=64)
+    prev = set_tile_cache(cache)
+    try:
+        cold = one_run("xjob-cache")
+        s_cold = cache.stats()
+        n = s_cold["puts"]
+        assert n > 0 and s_cold["hits"] == 0
+
+        warm = one_run("xjob-cache")  # same job -> full hit
+        s_warm = cache.stats()
+        np.testing.assert_array_equal(cold, warm)
+        assert s_warm["hits"] - s_cold["hits"] == n
+        assert s_warm["settled"] - s_cold["settled"] == n
+        assert s_warm["puts"] == n  # nothing recomputed, nothing re-put
+
+        one_run("xjob-other")  # same inputs, different job -> no reuse
+        s_other = cache.stats()
+        assert s_other["hits"] == s_warm["hits"]  # zero extra hits
+        assert s_other["puts"] - s_warm["puts"] == n
+    finally:
+        set_tile_cache(prev)
+        bx._reset_shared_executor_for_tests()

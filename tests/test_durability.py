@@ -435,3 +435,69 @@ def test_manager_status_shape(tmp_path):
     assert status["recovery"]["performed"] is False
     assert status["jobs_tracked"] == 1
     manager.close()
+
+
+def test_cache_settle_replays_as_volatile_completion(tmp_path):
+    """A `cache_settle` record replays as a volatile completion:
+    recovery demotes the settled tiles back to pending (payload None —
+    the pixels lived only in the dead master's canvas), so the
+    restarted master re-consults the cache and re-settles or
+    recomputes — bit-identical either way (docs/caching.md)."""
+    manager, store = _journaled_store(tmp_path)
+
+    async def phase_one():
+        await store.init_tile_job("j", [0, 1, 2])
+        settled = await store.settle_cached("j", [0, 2])
+        assert settled == [0, 2]
+        t1 = await store.pull_task("j", "w1")
+        await store.submit_result(
+            "j", "w1", t1, [{"batch_idx": 0, "image": "data:png"}]
+        )
+
+    run(phase_one())
+    manager.close()
+
+    store2 = JobStore()
+    manager2 = DurabilityManager(str(tmp_path), fsync_every=0)
+    report = manager2.recover(store2)
+    job = store2.tile_jobs["j"]
+    assert report.tasks_restored == 1          # w1's durable payload
+    assert report.tasks_requeued == 2          # both cache-settled tiles
+    assert job.completed == {1: [{"batch_idx": 0, "image": "data:png"}]}
+    assert job.cached_tiles == set()           # restart clears the mark
+    assert job.pending.qsize() == 2
+    manager2.close()
+
+    # the restarted master re-settles from the cache through the
+    # normal store op, bringing the job back to complete
+    store2.journal_sink = manager2.record
+
+    async def phase_two():
+        assert await store2.settle_cached("j", [0, 2]) == [0, 2]
+        assert await store2.is_complete("j")
+
+    run(phase_two())
+
+
+def test_cache_settle_shrinks_shadow_pull_set(tmp_path):
+    """Within one epoch (no restart) a replayed cache_settle keeps the
+    settled tiles OUT of the shadow pending set — apply_record's view
+    matches the live store's shrunken queue."""
+    from comfyui_distributed_tpu.durability.state import (
+        new_state,
+        replay_into,
+    )
+
+    state = new_state()
+    replay_into(
+        state,
+        [
+            {"type": "job_init", "job": "j", "kind": "tile",
+             "batched": True, "tasks": [0, 1, 2]},
+            {"type": "cache_settle", "job": "j", "tasks": [0, 2]},
+        ],
+    )
+    job = state["jobs"]["j"]
+    assert job["pending"] == [1]
+    assert set(job["cached"]) == {0, 2}
+    assert job["completed"]["0"] is None and job["completed"]["2"] is None

@@ -398,3 +398,86 @@ def test_store_fault_injection_drop_and_crash():
         assert "wdead" in job.worker_status
 
     run(scenario())
+
+
+def test_settle_cached_completes_without_dispatch():
+    """Cache-settled tiles complete (payload None), leave the pending
+    queue, and never reach a puller; already-completed and quarantined
+    tiles are excluded from the settled list."""
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1, 2, 3])
+        # a racing worker completes tile 1 first
+        t = await store.pull_task("t", "w1")
+        assert t == 0
+        await store.submit_result("t", "w1", 0, "payload")
+        job = await store.get_tile_job("t")
+        job.quarantined_tiles.add(3)
+
+        settled = await store.settle_cached("t", [0, 1, 2, 3])
+        assert settled == [1, 2]
+        assert job.cached_tiles == {1, 2}
+        assert job.completed[1] is None and job.completed[2] is None
+        # only tile 3 remains (quarantined by hand, so it never left
+        # the raw queue); the settled tiles left the pull set
+        assert await store.remaining("t") == 1
+        # settle is idempotent
+        assert await store.settle_cached("t", [1, 2]) == []
+
+    run(scenario())
+
+
+def test_settle_cached_cancelled_job_is_noop():
+    store = JobStore()
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1])
+        await store.cancel_job("t", reason="client")
+        assert await store.settle_cached("t", [0, 1]) == []
+        job = await store.get_tile_job("t")
+        assert job.cached_tiles == set()
+
+    run(scenario())
+
+
+def test_settle_cached_journals_one_record():
+    store = JobStore()
+    records = []
+    store.journal_sink = records.append
+
+    async def scenario():
+        await store.init_tile_job("t", [0, 1, 2])
+        await store.settle_cached("t", [0, 2])
+
+    run(scenario())
+    assert [r["type"] for r in records] == ["job_init", "cache_settle"]
+    assert records[1]["job"] == "t"
+    assert records[1]["tasks"] == [0, 2]
+
+
+def test_init_tile_job_settles_cached_atomically():
+    """cache_settled settles under the SAME lock hold as creation: no
+    puller can ever observe the pre-settle pending queue, the journal
+    carries job_init then cache_settle, and a second init (job already
+    exists) ignores the list."""
+    store = JobStore()
+    records = []
+    store.journal_sink = records.append
+
+    async def scenario():
+        job = await store.init_tile_job("t", [0, 1, 2, 3], cache_settled=[0, 2])
+        assert job.cached_tiles == {0, 2}
+        assert job.completed[0] is None and job.completed[2] is None
+        assert job.pending.qsize() == 2
+        # pullers only ever see the survivors
+        assert await store.pull_task("t", "w1") == 1
+        assert await store.pull_task("t", "w1") == 3
+        assert await store.pull_task("t", "w1") is None
+        # idempotent re-init: the settle list is NOT re-applied
+        again = await store.init_tile_job("t", [0, 1, 2, 3], cache_settled=[1])
+        assert again is job and 1 not in job.cached_tiles
+
+    run(scenario())
+    assert [r["type"] for r in records][:2] == ["job_init", "cache_settle"]
+    assert records[1]["tasks"] == [0, 2]

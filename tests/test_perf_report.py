@@ -570,3 +570,89 @@ def test_usage_waste_gate_tolerates_near_zero_noise():
     grown = dict(base, waste_share=0.03)
     hits = perf_report.usage_regressions(noisy_old, grown, 25.0)
     assert hits and hits[0]["stage"] == "usage_waste_share"
+
+
+# --------------------------------------------------------------------------
+# tile-cache serving reconstruction (content-addressed cache PR)
+# --------------------------------------------------------------------------
+
+
+def _cache_span(stage, idx=0, attrs=None):
+    span_attrs = {"stage": stage, "role": "master"}
+    if stage == "cache.hit":
+        span_attrs["tile_idx"] = idx
+    span_attrs.update(attrs or {})
+    return {
+        "trace_id": "t", "span_id": f"c{stage}{idx}", "parent_id": None,
+        "name": f"tile.{stage}", "start": 0.0, "end": 0.001,
+        "duration": 0.001, "attrs": span_attrs, "events": [], "status": "ok",
+    }
+
+
+def test_warm_cache_trace_hit_rate_and_complete_lifecycles(tmp_path):
+    """A warm (fully cache-served) chaos trace: the report's cache
+    column reads 100% hits with zero dispatched tiles, and every tile's
+    lifecycle is complete even though NOBODY sampled or blended it —
+    the master's tile.cache.hit span closes it."""
+    from comfyui_distributed_tpu.cache.store import TileResultCache
+
+    cache = TileResultCache(ram_mb=64)
+    run_chaos_usdu(seed=11, cache=cache)  # cold populate
+    path = str(tmp_path / "warm.jsonl")
+    result = run_chaos_usdu(seed=11, cache=cache, trace_jsonl=path)
+    assert result.cache["settled"] == 4
+    spans = perf_report.load_spans(path)
+    report = perf_report.build_report(spans)
+    assert report["cache"] == {
+        "probes": 1, "hits": 4, "dispatched_tiles": 0, "hit_rate": 1.0,
+    }
+    tiles = perf_report.tile_lifecycle(spans)
+    assert sorted(tiles) == [0, 1, 2, 3]
+    assert perf_report.incomplete_tiles(tiles) == {}
+    # the text report surfaces the serving rate
+    rendered = perf_report.render_text(
+        report, tiles, perf_report.incomplete_tiles(tiles)
+    )
+    assert "hit rate 1.000" in rendered
+
+
+def test_cache_off_trace_reports_no_cache_column(chaos_trace):
+    """Absence is not a 0% hit rate: a cache-off trace must have no
+    cache block at all (old traces stay comparable)."""
+    _result, path = chaos_trace
+    report = perf_report.build_report(perf_report.load_spans(path))
+    assert report["cache"] is None
+
+
+def test_cache_hit_rate_drop_rides_the_compare_gate():
+    """The inverted gate: tiles the old trace settled near-free going
+    back to burning device slots fails --compare."""
+    old = perf_report.build_report(
+        [_cache_span("cache.probe", attrs={"hits": 4})]
+        + [_cache_span("cache.hit", i) for i in range(4)]
+    )
+    new = perf_report.build_report(
+        [_cache_span("cache.probe", attrs={"hits": 1}),
+         _cache_span("cache.hit", 0),
+         _dispatch_span(1.0, 3, 4)]
+    )
+    assert old["cache"]["hit_rate"] == 1.0
+    assert new["cache"]["hit_rate"] == 0.25
+    regressions = perf_report.compare_reports(old, new, 25.0)
+    hits = [r for r in regressions if r["stage"] == "cache_hit_rate"]
+    assert hits and hits[0]["delta_pct"] == pytest.approx(75.0)
+    rendered = perf_report.render_comparison(regressions, 25.0)
+    assert "cache_hit_rate" in rendered
+    # no gate when the old trace had no cache activity (new
+    # instrumentation is not a regression), nor when rates held
+    no_cache_old = perf_report.build_report([_dispatch_span(1.0, 4, 4)])
+    assert not [
+        r
+        for r in perf_report.compare_reports(no_cache_old, new, 25.0)
+        if r["stage"] == "cache_hit_rate"
+    ]
+    assert not [
+        r
+        for r in perf_report.compare_reports(old, old, 25.0)
+        if r["stage"] == "cache_hit_rate"
+    ]

@@ -482,8 +482,9 @@ def test_worker_prev_baselines_pruned_with_job_churn():
     job_paths = [
         p for p in agg._worker_prev["w1"] if p.startswith("job:")
     ]
-    # only the latest snapshot's job survives (4 paths per job)
-    assert len(job_paths) == 4, job_paths
+    # only the latest snapshot's job survives (6 paths per job:
+    # chip_s / waste_s / steps / tiles / cached_tiles / cached_s)
+    assert len(job_paths) == 6, job_paths
 
 
 # --------------------------------------------------------------------------
@@ -623,3 +624,79 @@ def test_fleet_registry_gates_usage_on_version():
     assert registry.usage.rollup()["totals"]["chip_s"] == pytest.approx(2.5)
     # unknown version: dropped entirely
     assert not registry.note_snapshot("w-future", {"v": 9})
+
+
+# --------------------------------------------------------------------------
+# the `cached` bucket (content-addressed tile cache settlements)
+# --------------------------------------------------------------------------
+
+
+def test_note_cached_outside_identity_and_in_cost_denominator():
+    """Cache settlements ride OUTSIDE the dispatch conservation
+    identity (no dispatch happened) but count in the job's finished
+    tiles — the cost-model denominator — so a tenant whose jobs mostly
+    hit the cache admits near-free under the DRR measured-cost hook."""
+    meter = UsageMeter()
+    agg = UsageAggregator(meter=meter, ttl=10_000)
+    # identical real burn for both tenants...
+    _feed_cost(agg, meter, "cold", "jc", chip_s=0.5, tiles=5)
+    _feed_cost(agg, meter, "warm", "jw", chip_s=0.5, tiles=5)
+    # ...but warm's job settles 45 more tiles straight from the cache
+    meter.note_cached("master", "jw", 45)
+    totals = meter.totals()
+    assert totals["conserved"] is True  # identity untouched
+    assert totals["cached_tiles"] == 45
+    assert (
+        totals["attributed_ns"]
+        + totals["dispatch_waste_ns"]
+        + totals["overhead_ns"]
+        == totals["dispatch_chip_ns"]
+    )
+    roll = meter.rollup()
+    assert roll["tenants"]["warm"]["cached_tiles"] == 45
+    assert roll["jobs"]["jw"]["cached_tiles"] == 45
+    assert roll["tenants"]["cold"]["cached_tiles"] == 0
+    agg.sample()
+    assert agg.cost_ratio("warm") < agg.cost_ratio("cold")
+
+
+def test_note_cached_zero_or_negative_is_noop():
+    meter = UsageMeter()
+    meter.note_cached("master", "j", 0)
+    meter.note_cached("master", "j", -3)
+    assert meter.totals()["cached_tiles"] == 0
+    assert meter.rollup()["jobs"] == {}
+
+
+def test_cached_bucket_adopts_and_survives_retirement():
+    """Worker-snapshot adoption deltas the cached bucket (version
+    tolerant: a pre-cache snapshot reads as 0) and the retired fold
+    keeps pair_totals monotonic across eviction."""
+    clock = {"now": 0.0}
+    meter = UsageMeter(clock=lambda: clock["now"])
+    agg = UsageAggregator(meter=meter, clock=lambda: clock["now"], ttl=50.0)
+    meter.note_job_attrs("cj", "t-c", "batch")
+    snap = {
+        "jobs": {"cj": {"chip_s": 1.0, "steps": 4, "tiles": 8,
+                        "waste_s": 0.0, "cached_tiles": 6,
+                        "cached_s": 0.001}},
+        "waste_s": {}, "dispatch_chip_s": 1.0, "attributed_chip_s": 1.0,
+        "overhead_s": 0.0, "dispatches": 1,
+    }
+    agg.adopt("w1", snap)
+    assert agg.rollup()["tenants"]["t-c"]["cached_tiles"] == 6
+    before = agg.pair_totals()[("t-c", "batch")]
+    assert before["cached"] == 6
+    clock["now"] = 100.0
+    agg.sample()  # sweeps the idle adopted job into the retired fold
+    after = agg.pair_totals()[("t-c", "batch")]
+    assert after["cached"] == before["cached"]
+    assert agg.rollup()["totals"]["cached_tiles"] == 6
+    # a snapshot WITHOUT the cached fields adopts cleanly (delta 0)
+    agg.adopt("w2", {
+        "jobs": {"old": {"chip_s": 0.5, "steps": 1, "tiles": 1,
+                         "waste_s": 0.0}},
+        "waste_s": {}, "dispatch_chip_s": 0.5, "attributed_chip_s": 0.5,
+        "overhead_s": 0.0, "dispatches": 1,
+    })
+    assert agg.rollup()["totals"]["cached_tiles"] == 6
