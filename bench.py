@@ -185,6 +185,55 @@ def _probe_block() -> dict:
             block[key] = last[key]
     return block
 
+def _persist_probe_report(block: dict) -> None:
+    """Atomically write the probe block where the serving process can
+    find it (CDT_PROBE_REPORT, default .cdt/bench_probe.json): the
+    `GET /distributed/system_info` route serves it under `probe` so
+    operators see WHY accelerators fell back to CPU without digging
+    through BENCH notes. Best effort — a read-only workdir must not
+    cost the datum."""
+    try:
+        from comfyui_distributed_tpu.utils.constants import probe_report_path
+
+        path = probe_report_path()
+        if path is None:
+            return
+        payload = dict(block)
+        payload["written_at"] = time.time()
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception as exc:  # noqa: BLE001 - forensics only
+        print(f"probe report persist failed: {exc}", file=sys.stderr)
+
+
+def _profiling_stamp() -> dict | None:
+    """The process transfer ledger's cumulative totals (None when the
+    plane is off or nothing was recorded)."""
+    try:
+        from comfyui_distributed_tpu.telemetry.profiling import (
+            peek_transfer_ledger,
+        )
+
+        ledger = peek_transfer_ledger()
+        if ledger is None:
+            return None
+        totals = ledger.totals()
+        if not (
+            totals.get("device_ns")
+            or totals.get("host_total_ns")
+            or totals.get("tiles")
+        ):
+            return None
+        return totals
+    except Exception:  # noqa: BLE001 - forensics only
+        return None
+
+
 def _probe_child() -> None:
     """BENCH_MODE=probe child: staged backend init with forensics.
 
@@ -1974,9 +2023,16 @@ def main() -> None:
         )
         _apply_scaling(result, scaling)
     result["probe"] = _probe_block()
+    _persist_probe_report(result["probe"])
     incidents = _incident_stamp(result["probe"])
     if incidents is not None:
         result["incidents"] = incidents
+    # transfer-ledger stamp (telemetry/profiling.py): device/host ns
+    # split + bytes moved + host-tax ratio, so the next accelerator
+    # round separates "chips are slow" from "we're paying host tax"
+    profiling = _profiling_stamp()
+    if profiling is not None:
+        result["profiling"] = profiling
     print(json.dumps(result))
 
 

@@ -1,0 +1,91 @@
+"""Device-profiler capture routes + transfer-ledger surface.
+
+    GET  /distributed/profile        — ledger totals + capture status
+                                       + retained trace index
+    POST /distributed/profile/start  — begin a bounded jax.profiler
+                                       trace (single-flight, duration
+                                       capped, auto-stops)
+    POST /distributed/profile/stop   — stop the active trace early
+
+Capture routes are enabled when ``CDT_PROFILE_DIR`` is set; otherwise
+they answer ``enabled: false`` with a hint (the journal-dir idiom).
+The ledger block is served regardless — it is in-memory and rides the
+fleet snapshot anyway. Trace start/stop touch the filesystem and the
+profiler runtime, so they run off the event loop via ``run_blocking``.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..telemetry.profiling import get_profiler_capture, peek_transfer_ledger
+from ..utils.async_helpers import run_blocking
+
+DISABLED_HINT = {
+    "enabled": False,
+    "hint": "set CDT_PROFILE_DIR to enable device trace capture",
+}
+
+
+def register(app: web.Application, server) -> None:
+    routes = ProfileRoutes(server)
+    app.router.add_get("/distributed/profile", routes.status)
+    app.router.add_post("/distributed/profile/start", routes.start)
+    app.router.add_post("/distributed/profile/stop", routes.stop)
+
+
+class ProfileRoutes:
+    def __init__(self, server):
+        self.server = server
+
+    async def status(self, request: web.Request) -> web.Response:
+        ledger = peek_transfer_ledger()
+        capture = get_profiler_capture()
+        role = "worker" if getattr(self.server, "is_worker", False) else "master"
+        payload: dict = {
+            "ledger": ledger.totals(role) if ledger is not None else None,
+        }
+        if capture is None:
+            payload.update(DISABLED_HINT)
+        else:
+            payload["enabled"] = True
+            payload["capture"] = await run_blocking(capture.status)
+            payload["captures"] = await run_blocking(capture.captures)
+        return web.json_response(payload)
+
+    async def start(self, request: web.Request) -> web.Response:
+        """Begin a capture. Optional JSON body:
+        ``{"duration_s": <float>, "tag": <str>}``; the duration is
+        clamped to CDT_PROFILE_MAX_SECONDS and the trace auto-stops."""
+        capture = get_profiler_capture()
+        if capture is None:
+            return web.json_response(DISABLED_HINT, status=400)
+        duration = None
+        tag = "manual"
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001 - empty/invalid body is fine
+                body = None
+            if isinstance(body, dict):
+                try:
+                    if body.get("duration_s") is not None:
+                        duration = float(body["duration_s"])
+                except (TypeError, ValueError):
+                    return web.json_response(
+                        {"error": "duration_s must be a number"}, status=400
+                    )
+                if body.get("tag"):
+                    tag = str(body["tag"])
+        result = await run_blocking(
+            lambda: capture.start(duration_s=duration, tag=tag)
+        )
+        status = 200 if result.get("started") else 409
+        return web.json_response(result, status=status)
+
+    async def stop(self, request: web.Request) -> web.Response:
+        capture = get_profiler_capture()
+        if capture is None:
+            return web.json_response(DISABLED_HINT, status=400)
+        result = await run_blocking(capture.stop)
+        return web.json_response(result)

@@ -157,6 +157,10 @@ class DistributedServer:
                 sink(worker_id, seconds)
 
         self.job_store.latency_sink = _latency_fan_out
+        # admission-gap accounting: every cache settle tells the DRR
+        # scheduler how much admitted cost never burned chip time
+        # (surfaced as cdt_cache_unsettled_admission_cost at scrape)
+        self.job_store.settle_sink = self.scheduler.note_cache_settled
         # Fleet observability plane (telemetry/fleet.py + slo.py):
         # masters aggregate worker snapshots piggybacked on the
         # heartbeat/request_image RPCs, retain the load-bearing series,
@@ -344,6 +348,7 @@ class DistributedServer:
             config_routes,
             incident_routes,
             job_routes,
+            profile_routes,
             region_routes,
             replication_routes,
             scheduler_routes,
@@ -362,6 +367,7 @@ class DistributedServer:
         scheduler_routes.register(self.app, self)
         telemetry_routes.register(self.app, self)
         incident_routes.register(self.app, self)
+        profile_routes.register(self.app, self)
         usdu_routes.register(self.app, self)
         config_routes.register(self.app, self)
         worker_routes.register(self.app, self)

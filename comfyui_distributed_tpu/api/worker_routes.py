@@ -339,6 +339,24 @@ class WorkerRoutes:
         except Exception as exc:  # noqa: BLE001 - best effort
             info["t5_vocab_canonical"] = None
             info["t5_vocab_error"] = str(exc)
+        # Last bench accelerator-probe report (scripts/bench_probe via
+        # bench.py writes CDT_PROBE_REPORT): backend/stage/versions so
+        # operators see WHY accelerators fell back to CPU without
+        # digging through BENCH notes. Absent file = key omitted.
+        try:
+            from ..utils.constants import probe_report_path
+
+            probe_path = probe_report_path()
+            if probe_path is not None and os.path.exists(probe_path):
+                import json as json_mod
+
+                def _read_probe() -> Any:
+                    with open(probe_path, "r", encoding="utf-8") as fh:
+                        return json_mod.load(fh)
+
+                info["probe"] = await run_blocking(_read_probe)
+        except Exception as exc:  # noqa: BLE001 - best effort
+            info["probe"] = {"error": str(exc)}
         return web.json_response(info)
 
 

@@ -64,6 +64,12 @@ from ..telemetry.instruments import (
     preempt_resume_total,
     tiles_processed_total,
 )
+from ..telemetry.profiling import (
+    D2H,
+    H2D,
+    ledger_if_enabled,
+    transfer_nbytes,
+)
 from ..telemetry.usage import (
     SLOT_PADDING,
     SLOT_REAL,
@@ -344,9 +350,19 @@ class CrossJobExecutor:
                 self._shardings[ndim] = sharding
             return jax.device_put(leaf, sharding)
 
-        return tuple(
+        started = time.monotonic()
+        placed = tuple(
             jax.tree_util.tree_map(shard_leaf, part) for part in batched
         )
+        ledger = ledger_if_enabled()
+        if ledger is not None:
+            nbytes = sum(
+                transfer_nbytes(leaf)
+                for part in batched
+                for leaf in jax.tree_util.tree_leaves(part)
+            )
+            ledger.note_transfer(H2D, nbytes, time.monotonic() - started)
+        return placed
 
     # --- grant intake -----------------------------------------------------
 
@@ -660,24 +676,40 @@ class CrossJobExecutor:
         # exactly these attrs (real tiles vs bucket slots), and the
         # --usage column splits the span's wall across slot_jobs /
         # slot_tenants / padding the same way the meter does
+        # compiled-vs-eager split for the transfer ledger (same rule as
+        # _vstep's jit gate): only compiled programs count device time
+        device = hasattr(batch[0].job.proc.step, "lower")
+        ledger = ledger_if_enabled()
         started = time.monotonic()
         with stage_span(
             "dispatch", self.role, batch[0].tile_idx,
             real=n, bucket=int(bucket),
             jobs=len({it.job.job_id for it in batch}),
             slot_jobs=slot_jobs, slot_tenants=slot_tenants,
+            device=device,
             recompute=sum(
                 1 for s in slots if s["kind"] == SLOT_RECOMPUTE
             ),
         ):
             out = fn(params, xs, keys, poss, negs, yxs, steps)
+            if device and ledger is not None:
+                # profiling wants honest device-execute wall: JAX
+                # dispatch is async, so block inside the bracket
+                import jax
+
+                out = jax.block_until_ready(out)
+        elapsed = time.monotonic() - started
         if self.usage is not None:
             self.usage.note_dispatch(
                 tier="xjob",
                 role=self.role,
-                elapsed_s=time.monotonic() - started,
+                elapsed_s=elapsed,
                 chips=self._chips,
                 slots=slots,
+            )
+        if ledger is not None:
+            ledger.note_dispatch(
+                elapsed, tier="xjob", role=self.role, device=device
             )
         self.dispatches += 1
         self.steps_run += n
@@ -704,7 +736,16 @@ class CrossJobExecutor:
                     "sample", self.role, item.tile_idx, job_id=job.job_id
                 ):
                     out = job.proc.finish(job.params, item.x)
+                readback_started = time.monotonic()
                 host = self._to_host(out)
+                ledger = ledger_if_enabled()
+                if ledger is not None:
+                    ledger.note_transfer(
+                        D2H,
+                        int(getattr(host, "nbytes", 0)),
+                        time.monotonic() - readback_started,
+                    )
+                    ledger.note_tiles(1)
                 try:
                     with stage_span(
                         "encode", self.role, item.tile_idx, job_id=job.job_id

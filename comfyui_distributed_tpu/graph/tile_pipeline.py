@@ -51,6 +51,13 @@ from ..telemetry.instruments import (
     pipeline_padded_tiles_total,
     tile_stage_seconds,
 )
+from ..telemetry.profiling import (
+    D2H,
+    H2D,
+    STAGE_HOST_BUCKETS,
+    ledger_if_enabled,
+    transfer_nbytes,
+)
 from ..utils.constants import (
     HEARTBEAT_INTERVAL_SECONDS,
     PIPELINE_DEPTH,
@@ -81,9 +88,17 @@ def stage_span(stage: str, role: str, tile_idx: int | None = None, **attrs):
             yield span
     finally:
         if span is None or span.attrs.get("outcome") != "empty":
-            tile_stage_seconds().observe(
-                time.monotonic() - started, stage=stage, role=role
-            )
+            elapsed = time.monotonic() - started
+            tile_stage_seconds().observe(elapsed, stage=stage, role=role)
+            # host-tax attribution: readback/encode/submit wall rides
+            # into the transfer ledger's gather/encode/ship buckets —
+            # ONE seam instruments both execution tiers (the cross-job
+            # executor emits the same stage vocabulary)
+            bucket = STAGE_HOST_BUCKETS.get(stage)
+            if bucket is not None:
+                ledger = ledger_if_enabled()
+                if ledger is not None:
+                    ledger.note_host(bucket, elapsed)
 
 
 class GrantSampler:
@@ -201,6 +216,11 @@ class GrantSampler:
         # this job actually exercised, and how much padding it cost
         self.buckets_used: set[int] = set()
         self.padded_tiles = 0
+        # device/host attribution (telemetry/profiling.py): a compiled
+        # processor's dispatch is device-execute time; an eager stub
+        # (chaos harness) never touched a chip, so its dispatches stay
+        # out of device_ns and the run's host-tax reads 1.0
+        self._device = hasattr(process, "lower")
         self._batched = None
         if self.k_max > 1:
             vmapped = jax.vmap(process, in_axes=(None, 0, 0, None, None, 0))
@@ -249,25 +269,42 @@ class GrantSampler:
         import jax
 
         tile_s, key_s, yx_s = self._data_shardings
-        return (
+        started = time.monotonic()
+        placed = (
             jax.device_put(tiles, tile_s),
             jax.device_put(keys, key_s),
             jax.device_put(yxs, yx_s),
         )
+        ledger = ledger_if_enabled()
+        if ledger is not None:
+            nbytes = sum(transfer_nbytes(a) for a in (tiles, keys, yxs))
+            ledger.note_transfer(H2D, nbytes, time.monotonic() - started)
+        return placed
 
     def collect(self, result):
         """Materialise a sample() result on the host. Sharded results
         gather via parallel/collective.host_collect (cross-device over
         ICI, cross-process over DCN); unsharded results take the plain
         numpy path. Wired as the TilePipeline's ``to_host`` stage."""
+        ledger = ledger_if_enabled()
         if self.data_parallel <= 1:
             from ..utils import image as img_utils
 
-            return img_utils.ensure_numpy(result)
+            started = time.monotonic()
+            host = img_utils.ensure_numpy(result)
+            if ledger is not None:
+                ledger.note_transfer(
+                    D2H,
+                    int(getattr(host, "nbytes", 0)),
+                    time.monotonic() - started,
+                )
+            return host
         from ..parallel.collective import host_collect
         from ..telemetry.instruments import mesh_gather_seconds
 
         started = time.monotonic()
+        # host_collect notes the d2h transfer on the ledger itself (the
+        # seam is shared with nodes_distributed) — no second note here.
         host = host_collect(result)
         mesh_gather_seconds().observe(
             time.monotonic() - started, role=self.role
@@ -282,6 +319,7 @@ class GrantSampler:
         batch-fill and --usage columns read both tiers uniformly."""
         attrs: dict[str, Any] = {
             "real": int(real), "bucket": int(bucket), "jobs": 1,
+            "device": bool(self._device),
         }
         if self.job_id:
             attrs["slot_jobs"] = {self.job_id: int(real)}
@@ -310,6 +348,18 @@ class GrantSampler:
             slots=slots,
         )
         self.usage.note_tiles(self.role, self.job_id, int(real))
+
+    def _note_profiling(self, elapsed_s: float, real: int) -> None:
+        """Feed the transfer ledger: dispatch wall goes to device time
+        only when a compiled program ran — eager stubs (chaos harness)
+        are host work, so they honestly read host_tax = 1.0."""
+        ledger = ledger_if_enabled()
+        if ledger is None:
+            return
+        ledger.note_dispatch(
+            elapsed_s, tier="scan", role=self.role, device=self._device
+        )
+        ledger.note_tiles(int(real))
 
     # --- execution --------------------------------------------------------
 
@@ -342,7 +392,13 @@ class GrantSampler:
                     )
                     for i in idxs
                 ]
-            self._note_usage(time.monotonic() - started, real=n, bucket=n)
+                if self._device and ledger_if_enabled() is not None:
+                    # profiling wants honest device-execute wall: JAX
+                    # dispatch is async, so block inside the bracket
+                    outs = jax.block_until_ready(outs)
+            elapsed = time.monotonic() - started
+            self._note_usage(elapsed, real=n, bucket=n)
+            self._note_profiling(elapsed, real=n)
             self.buckets_used.add(1)
             return jnp.stack(outs, axis=0)
         bucket = self._bucket_for(n)
@@ -358,7 +414,13 @@ class GrantSampler:
             out = self._batched(
                 self.params, tiles, keys, self.pos, self.neg, yxs
             )
-        self._note_usage(time.monotonic() - started, real=n, bucket=bucket)
+            if self._device and ledger_if_enabled() is not None:
+                import jax
+
+                out = jax.block_until_ready(out)
+        elapsed = time.monotonic() - started
+        self._note_usage(elapsed, real=n, bucket=bucket)
+        self._note_profiling(elapsed, real=n)
         self.buckets_used.add(bucket)
         pipeline_batches_total().inc(role=self.role, bucket=str(bucket))
         if self.data_parallel > 1:

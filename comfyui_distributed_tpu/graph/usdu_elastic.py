@@ -1182,9 +1182,13 @@ def run_master_elastic(
                 context.check_interrupted()
             with _stage("sample", "master", chunk[0], batch=list(chunk)):
                 result = grant_sampler.sample(chunk)
-                if grant_sampler.data_parallel > 1:
-                    # gather the sharded batch host-side before blending
-                    result = grant_sampler.collect(result)
+            with _stage("readback", "master", chunk[0], batch=list(chunk)):
+                # materialise host-side before blending — sharded
+                # results gather across the mesh, single-device ones
+                # take the numpy path; either way the d2h transfer is
+                # attributed (ledger gather bucket) instead of hiding
+                # inside the first blend's implicit conversion
+                result = grant_sampler.collect(result)
             run_async_in_server_loop(
                 store.submit_flush(
                     job_id, "master",

@@ -141,6 +141,11 @@ class JobStore:
         # and the placement policy's speed model (the server wires this
         # to a fan-out over both).
         self.latency_sink: Optional[Callable[[str, float], None]] = None
+        # Optional (tenant, n_tiles) callback fed every cache settle —
+        # the scheduler's admission-gap accounting (DRR charged full
+        # cost at admission; settled tiles never burned chip time). The
+        # server wires this to SchedulerControl.note_cache_settled.
+        self.settle_sink: Optional[Callable[[str, int], None]] = None
         # Optional placement hook (scheduler/placement.PlacementPolicy):
         # consulted by pull_task (may_pull → tail trimming) and
         # pull_tasks (batch_size → speed-weighted batches). None keeps
@@ -486,6 +491,7 @@ class JobStore:
         get_event_bus().publish("job_ready", job_id=job_id, tasks=len(task_ids))
         if settled_at_init:
             instruments.cache_settled_total().inc(len(settled_at_init))
+            self._note_settle_sink(job.tenant, len(settled_at_init))
         # authoritative tenant/lane for the attribution plane (lands on
         # top of the executors' advisory registration attrs)
         _note_usage_job_attrs(job_id, job.tenant, job.lane)
@@ -1141,7 +1147,16 @@ class JobStore:
             settled = self._settle_cached_locked(job, job_id, task_ids)
         if settled:
             instruments.cache_settled_total().inc(len(settled))
+            self._note_settle_sink(job.tenant, len(settled))
         return settled
+
+    def _note_settle_sink(self, tenant: str, count: int) -> None:
+        if self.settle_sink is None:
+            return
+        try:
+            self.settle_sink(tenant, count)
+        except Exception as exc:  # noqa: BLE001 - accounting is advisory
+            debug_log(f"jobs: settle sink failed: {exc}")
 
     def _settle_cached_locked(
         self, job: TileJob, job_id: str, task_ids: list[int]

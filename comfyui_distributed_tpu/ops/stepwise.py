@@ -224,7 +224,19 @@ def encode_checkpoint(x, step: int) -> dict[str, Any]:
     """Serialize a mid-trajectory latent + step index into a JSON-able
     dict. ``x`` may be a device array or ndarray; bytes are preserved
     exactly (C-order ``tobytes``)."""
+    import time
+
+    from ..telemetry.profiling import D2H, ledger_if_enabled
+
+    started = time.monotonic()
     arr = np.ascontiguousarray(np.asarray(x))
+    ledger = ledger_if_enabled()
+    if ledger is not None:
+        # np.asarray on a device array is the d2h materialization; the
+        # ship cost (b64 + RPC) is charged by the submit stage span
+        ledger.note_transfer(
+            D2H, int(arr.nbytes), time.monotonic() - started
+        )
     if arr.nbytes > MAX_CHECKPOINT_BYTES:
         raise CheckpointError(
             f"checkpoint latent is {arr.nbytes} bytes "

@@ -36,11 +36,23 @@ def host_collect(sharded: jax.Array) -> np.ndarray:
     multihost meshes should keep outputs replicated or use
     multihost_utils.process_allgather (gated: not needed single-host).
     """
+    import time
+
+    from ..telemetry.profiling import D2H, ledger_if_enabled
+
+    started = time.monotonic()
     if not sharded.is_fully_addressable:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(sharded, tiled=True))
-    return np.asarray(jax.device_get(sharded))
+        host = np.asarray(multihost_utils.process_allgather(sharded, tiled=True))
+    else:
+        host = np.asarray(jax.device_get(sharded))
+    ledger = ledger_if_enabled()
+    if ledger is not None:
+        ledger.note_transfer(
+            D2H, int(host.nbytes), time.monotonic() - started
+        )
+    return host
 
 
 def reorder_participant_first(

@@ -51,6 +51,18 @@ class SchedulerControl:
         # admission cost meters what the tenant actually burns, not
         # just the client's estimated_tiles.
         self.usage_cost: Optional[Callable[[str], float]] = None
+        # Admission-gap accounting (the DRR full-cost-until-settle
+        # leftover): admission charges the FULL estimated-tiles cost,
+        # but tiles later settled from the content-addressed cache
+        # never burn chip time — the fair-share meter over-charged the
+        # tenant by (settled tiles x per-tile admitted cost). The gap
+        # accumulates here (cumulative cost units) and is surfaced as
+        # `cdt_cache_unsettled_admission_cost` at scrape. Per-tile cost
+        # is the tenant's LAST admitted per-tile cost — bounded map,
+        # oldest-admitted evicted (tenant ids arrive from the network).
+        self.unsettled_admission_cost = 0.0
+        self._tenant_tile_cost: dict[str, float] = {}
+        self._max_tenant_tile_cost = 1024
 
     # --- payload mapping --------------------------------------------------
 
@@ -102,19 +114,45 @@ class SchedulerControl:
                     estimated_wait=estimated,
                 )
         cost = 1.0
+        tiles = 1.0
         estimated_tiles = payload.extra.get("estimated_tiles")
         try:
             if estimated_tiles is not None and float(estimated_tiles) > 0:
                 cost = float(estimated_tiles)
+                tiles = float(estimated_tiles)
         except (TypeError, ValueError):
             pass
         cost *= self._measured_cost_ratio(payload.tenant)
+        self._note_admitted_cost(payload.tenant, cost / tiles)
         return self.queue.submit(
             tenant=payload.tenant,
             lane=payload.lane,
             cost=cost,
             trace_id=payload.trace_id,
         )
+
+    def _note_admitted_cost(self, tenant: str, per_tile_cost: float) -> None:
+        tenant = str(tenant)
+        self._tenant_tile_cost.pop(tenant, None)
+        while len(self._tenant_tile_cost) >= self._max_tenant_tile_cost:
+            self._tenant_tile_cost.pop(next(iter(self._tenant_tile_cost)))
+        self._tenant_tile_cost[tenant] = float(per_tile_cost)
+
+    def note_cache_settled(self, tenant: str, tiles: int) -> float:
+        """One cache settle's contribution to the admission gap:
+        ``tiles`` of this tenant completed from the tile cache after
+        admission charged their full per-tile cost. Returns the gap
+        added (cost units). Fed by JobStore.settle_sink; an unknown
+        tenant (admitted before this process started, or a direct
+        executor call that bypassed admission) charges the static 1.0
+        per-tile cost — the same fallback admission itself uses."""
+        tiles = int(tiles)
+        if tiles <= 0:
+            return 0.0
+        per_tile = self._tenant_tile_cost.get(str(tenant), 1.0)
+        gap = tiles * per_tile
+        self.unsettled_admission_cost += gap
+        return gap
 
     def _measured_cost_ratio(self, tenant: str) -> float:
         """The CDT_USAGE_COST multiplier: the tenant's measured
@@ -220,4 +258,7 @@ class SchedulerControl:
             "placement": self.placement.snapshot(),
             "worker_weights": self.placement.weights(),
             "brownout": self.brownout.snapshot(),
+            "unsettled_admission_cost": round(
+                self.unsettled_admission_cost, 6
+            ),
         }

@@ -57,8 +57,10 @@ from .timeseries import SeriesStore
 # v2 (usage-metering PR) adds the cumulative `usage` block; v1
 # snapshots stay accepted — the merge is version-gated, so an older
 # worker degrades to "no usage telemetry", never to a drop.
-SNAPSHOT_VERSION = 2
-ACCEPTED_SNAPSHOT_VERSIONS = (1, 2)
+# v3 (profiling PR) adds the cumulative `profiling` transfer-ledger
+# block; same degradation rule (older worker = no host-tax telemetry).
+SNAPSHOT_VERSION = 3
+ACCEPTED_SNAPSHOT_VERSIONS = (1, 2, 3)
 
 # Same bound the placement policy applies to advertised capacity
 # (scheduler/placement.py): snapshots arrive on unauthenticated RPCs.
@@ -164,6 +166,16 @@ def local_snapshot(role: str = "worker") -> dict[str, Any]:
 
             snap["usage"] = get_usage_meter().snapshot(role=role)
         except Exception:  # noqa: BLE001 - usage block is advisory
+            pass
+    # v3: this process's cumulative transfer ledger (device/host split
+    # + bytes moved); rollup sums the raw cumulative blocks — host-tax
+    # is recomputed fleet-wide from the summed ns, not averaged.
+    if constants.PROFILING_ENABLED:
+        try:
+            from .profiling import get_transfer_ledger
+
+            snap["profiling"] = get_transfer_ledger().snapshot(role=role)
+        except Exception:  # noqa: BLE001 - profiling block is advisory
             pass
     return snap
 
@@ -467,6 +479,23 @@ class FleetRegistry:
             mem = snap.get("mem") or {}
             hbm_peak = max(hbm_peak, int(_as_float(mem.get("hbm_peak_bytes")) or 0))
             rss_max = max(rss_max, int(_as_float(mem.get("rss_bytes")) or 0))
+        # v3: sum worker transfer-ledger blocks + the master's own
+        # local ledger; host_tax recomputed from summed integer ns
+        profiling = None
+        try:
+            from .profiling import merge_profiling_blocks, peek_transfer_ledger
+
+            blocks = [
+                entry["snap"].get("profiling") for entry in entries.values()
+            ]
+            local = peek_transfer_ledger()
+            if local is not None:
+                blocks.append(local.snapshot(role="master"))
+            blocks = [b for b in blocks if b]
+            if blocks:
+                profiling = merge_profiling_blocks(blocks)
+        except Exception as exc:  # noqa: BLE001 - rollup is advisory
+            debug_log(f"fleet: profiling rollup failed: {exc}")
         return {
             "workers": len(entries),
             "devices": devices,
@@ -476,6 +505,7 @@ class FleetRegistry:
             "stages": stages,
             "jax": {k: v for k, v in jax_tallies.items()},
             "mem": {"hbm_peak_bytes": hbm_peak, "rss_max_bytes": rss_max},
+            "profiling": profiling,
             "alerts_active": (
                 sorted(self._slo.active()) if self._slo is not None else []
             ),

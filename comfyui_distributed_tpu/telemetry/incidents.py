@@ -453,7 +453,36 @@ class IncidentManager:
                 bundle[name] = source()
             except Exception as exc:  # noqa: BLE001 - degrade per section
                 bundle[name] = {"error": f"{type(exc).__name__}: {exc}"}
+        profile = self._profile_section(kind_safe)
+        if profile is not None:
+            bundle["profile"] = profile
         return bundle
+
+    def _profile_section(self, kind_safe: str) -> Optional[dict[str, Any]]:
+        """Auto device-trace capture riding the incident (CDT_PROFILE_AUTO):
+        grab a short bounded jax.profiler trace on the writer thread so
+        the bundle points at a device-level view of the bad moment.
+        Requires CDT_PROFILE_DIR; a busy profiler (operator capture in
+        flight) degrades to the refusal record, never an error."""
+        if not constants.PROFILE_AUTO_ENABLED:
+            return None
+        try:
+            from .profiling import get_profiler_capture
+
+            capture = get_profiler_capture()
+            if capture is None:
+                return {"error": "CDT_PROFILE_AUTO set without CDT_PROFILE_DIR"}
+            started = capture.start(
+                duration_s=constants.PROFILE_AUTO_SECONDS,
+                tag=f"auto-{kind_safe}",
+            )
+            if not started.get("started"):
+                return {"skipped": started.get("reason", "unavailable")}
+            time.sleep(constants.PROFILE_AUTO_SECONDS)
+            stopped = capture.stop()
+            return {"started": started, "stopped": stopped}
+        except Exception as exc:  # noqa: BLE001 - degrade per section
+            return {"error": f"{type(exc).__name__}: {exc}"}
 
     def _flight_section(self) -> dict[str, Any]:
         from .flight import peek_flight_recorder

@@ -540,6 +540,61 @@ def cache_hit_ratio() -> Gauge:
     )
 
 
+def cache_unsettled_admission_cost() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_cache_unsettled_admission_cost",
+        "Cumulative DRR admission cost charged for tiles that later "
+        "settled free from the tile cache at grant time — the PR-17 "
+        "full-cost-until-settle gap, surfaced so operators can see how "
+        "much fair-share weight cached tenants are over-paying "
+        "(docs/operator-runbook.md §cache triage)",
+        ("server",),
+    )
+
+
+# --- device-time profiling plane (telemetry/profiling.py) ------------------
+
+def transfer_bytes_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_transfer_bytes_total",
+        "Bytes moved across the device↔host boundary by direction "
+        "(h2d|d2h), mirrored by delta from the transfer ledger at "
+        "scrape time",
+        ("direction",),
+    )
+
+
+def device_execute_seconds() -> Histogram:
+    return get_metrics_registry().histogram(
+        "cdt_device_execute_seconds",
+        "Bracketed wall time of one compiled device dispatch (the "
+        "transfer ledger's device side; eager/stub dispatches are "
+        "excluded by construction)",
+        ("role", "tier"),
+    )
+
+
+def host_tax_ratio() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_host_tax_ratio",
+        "host_ns / (host_ns + device_ns) from the transfer ledger at "
+        "scrape time — the fraction of attributable wall time spent on "
+        "host gather/encode/ship instead of device execution (1.0 when "
+        "no device time was observed)",
+        ("role",),
+    )
+
+
+def profile_captures_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_profile_captures_total",
+        "On-demand jax.profiler captures by outcome "
+        "(started|stopped|busy|errors|auto_stopped), mirrored by delta "
+        "from the capture manager's counters at scrape time",
+        ("outcome",),
+    )
+
+
 # --- incident plane (telemetry/flight.py, telemetry/incidents.py) ---------
 
 def incidents_total() -> Counter:
@@ -841,6 +896,24 @@ def bind_server_collectors(server) -> Callable[[], None]:
     if getattr(server, "incidents", None) is not None:
         incidents_total()
         incident_capture_seconds()
+    # Profiling-plane instruments present from the first scrape when
+    # the transfer ledger is on (CDT_PROFILING, default-enabled) — the
+    # panel's profiling card parses host-tax before any dispatch ran.
+    from ..utils.constants import PROFILING_ENABLED as _PROFILING_ENABLED
+
+    if _PROFILING_ENABLED:
+        transfer_bytes_total()
+        device_execute_seconds()
+        host_tax_ratio()
+    from .profiling import get_profiler_capture as _get_profiler_capture
+
+    if _get_profiler_capture() is not None:
+        profile_captures_total()
+    # The admission-cost gap gauge rides on masters with both a
+    # scheduler (DRR admission) and a live tile cache — the only
+    # configuration where settle-after-charge can happen.
+    if getattr(server, "scheduler", None) is not None:
+        cache_unsettled_admission_cost()
 
     label = f"{'worker' if server.is_worker else 'master'}:{server.port}"
     # worker ids this server's placement policy last reported: stale
@@ -1004,6 +1077,47 @@ def bind_server_collectors(server) -> Callable[[], None]:
             if delta > 0:
                 cache_corrupt_total().inc(delta)
                 cache_marks["corrupt"] = cstats["corrupt"]
+        # Transfer-ledger mirroring: the direction byte counters move
+        # by DELTA against the ledger's own high-water marks (shared
+        # across co-hosted collectors), the host-tax gauge reads the
+        # live ratio directly.
+        from .profiling import (
+            get_profiler_capture as _peek_capture,
+            peek_transfer_ledger as _peek_ledger,
+        )
+
+        ledger = _peek_ledger()
+        if ledger is not None:
+            lsnap = ledger.snapshot()
+            bytes_counter = transfer_bytes_total()
+            for direction in sorted(lsnap["transfer"]):
+                value = lsnap["transfer"][direction]["bytes"]
+                mark_key = f"bytes:{direction}"
+                delta = value - ledger.scrape_mirrored.get(mark_key, 0)
+                if delta > 0:
+                    bytes_counter.inc(delta, direction=direction)
+                    ledger.scrape_mirrored[mark_key] = value
+            host_tax_ratio().set(
+                lsnap["host_tax"],
+                role="worker" if server.is_worker else "master",
+            )
+        capture = _peek_capture()
+        if capture is not None:
+            capture_counter = profile_captures_total()
+            for outcome in sorted(capture.counters):
+                value = capture.counters[outcome]
+                delta = value - capture.scrape_mirrored.get(outcome, 0)
+                if delta > 0:
+                    capture_counter.inc(delta, outcome=outcome)
+                    capture.scrape_mirrored[outcome] = value
+        # The DRR admission-cost gap: cumulative cost charged at
+        # admission for tiles the cache later settled free (the PR-17
+        # full-cost-until-settle behavior, made observable).
+        if scheduler is not None:
+            cache_unsettled_admission_cost().set(
+                float(getattr(scheduler, "unsettled_admission_cost", 0.0)),
+                server=label,
+            )
         gauge = breaker_state()
         # Clear-then-refill: a worker removed from the registry
         # (config delete / reset) must drop its series, not freeze at
@@ -1020,6 +1134,8 @@ def bind_server_collectors(server) -> Callable[[], None]:
         unregister()
         for accessor in _LIVE_GAUGES:
             accessor().remove(server=label)
+        if getattr(server, "scheduler", None) is not None:
+            cache_unsettled_admission_cost().remove(server=label)
         event_subscriber_queue_depth().clear()
         event_subscriber_dropped().clear()
         slo = getattr(server, "slo", None)

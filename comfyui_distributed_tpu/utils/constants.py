@@ -338,6 +338,43 @@ USAGE_COST_ENABLED = _env_int("CDT_USAGE_COST", 0) == 1
 # evict — tenant-id churn must not grow master memory.
 USAGE_TTL_SECONDS = _env_float("CDT_USAGE_TTL", 3600.0)
 
+# --- device-time profiling plane (telemetry/profiling.py) -----------------
+# Master toggle for the transfer ledger: 0 disables the per-dispatch
+# device/host split, transfer byte accounting, and the host-tax rollup
+# (the profile route then answers ledger enabled=false).
+PROFILING_ENABLED = os.environ.get("CDT_PROFILING", "1") != "0"
+# On-demand jax.profiler capture cap: a start request asking for more
+# than this many seconds is clamped (an unstopped capture auto-stops).
+PROFILE_MAX_SECONDS = _env_float("CDT_PROFILE_MAX_SECONDS", 30.0)
+# Capture retention under CDT_PROFILE_DIR: prune-oldest beyond this
+# many trace dirs or this many MB (never the newest capture).
+PROFILE_MAX_CAPTURES = _env_int("CDT_PROFILE_MAX", 8)
+PROFILE_MAX_MB = _env_float("CDT_PROFILE_MAX_MB", 512.0)
+# Auto-capture: 1 lets an incident trigger (deadline / alert / poison)
+# grab a short device trace alongside the debug bundle; the capture
+# lasts CDT_PROFILE_AUTO_SECONDS and rides the incident writer thread.
+PROFILE_AUTO_ENABLED = _env_int("CDT_PROFILE_AUTO", 0) == 1
+PROFILE_AUTO_SECONDS = _env_float("CDT_PROFILE_AUTO_SECONDS", 2.0)
+
+
+def profile_dir_from_env() -> str | None:
+    """CDT_PROFILE_DIR resolved at call time (tests monkeypatch the
+    env); empty/unset disables on-demand profiler capture — the
+    incident-dir idiom."""
+    raw = os.environ.get("CDT_PROFILE_DIR", "").strip()
+    return raw or None
+
+
+def probe_report_path() -> str | None:
+    """Where bench.py persists its last accelerator-probe report (and
+    GET /distributed/system_info reads it back). Resolved at call time;
+    empty/"0"/"off"/"none" disables the handoff."""
+    raw = os.environ.get("CDT_PROBE_REPORT", ".cdt/bench_probe.json").strip()
+    if not raw or raw.lower() in CACHE_DIR_DISABLED_VALUES:
+        return None
+    return raw
+
+
 # --- content-addressed tile result cache (cache/) -------------------------
 # CDT_CACHE=1 consults the master-side tile result cache at grant time
 # (hits settle straight into the job — they never ship to a worker) and

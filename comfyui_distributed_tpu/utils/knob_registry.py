@@ -288,6 +288,31 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_FLEET_TTL", "120.0", "telemetry",
          "Seconds without a snapshot before a worker is evicted from the "
          "fleet view (all its retained series drop)."),
+    Knob("CDT_PROBE_REPORT", "./.cdt/bench_probe.json", "telemetry",
+         "Path bench.py persists its backend probe report (backend, stage, "
+         "library versions) to; `GET /distributed/system_info` serves it "
+         "under `probe`. `0`/`off`/`none` disables persistence."),
+    Knob("CDT_PROFILE_AUTO", "0", "telemetry",
+         "`1` makes every incident bundle capture a short device trace "
+         "(requires CDT_PROFILE_DIR; the bundle records the capture ids)."),
+    Knob("CDT_PROFILE_AUTO_SECONDS", "2.0", "telemetry",
+         "Duration in seconds of the automatic incident-triggered trace."),
+    Knob("CDT_PROFILE_DIR", "unset", "telemetry",
+         "Directory retained jax.profiler traces are captured into; unset "
+         "disables the /distributed/profile capture routes (the "
+         "CDT_JOURNAL_DIR idiom). The transfer ledger works without it."),
+    Knob("CDT_PROFILE_MAX", "8", "telemetry",
+         "Retained trace capture count; oldest captures pruned beyond it."),
+    Knob("CDT_PROFILE_MAX_MB", "512.0", "telemetry",
+         "Total on-disk trace budget in MB; oldest captures pruned beyond it."),
+    Knob("CDT_PROFILE_MAX_SECONDS", "30.0", "telemetry",
+         "Ceiling clamped onto any requested capture duration; every "
+         "capture auto-stops at this bound even if /profile/stop never "
+         "arrives."),
+    Knob("CDT_PROFILING", "1", "telemetry",
+         "`0` disables the transfer ledger (device/host time split, "
+         "host-tax ratio, h2d/d2h byte accounting) on both execution "
+         "tiers and its fleet-snapshot piggyback."),
     Knob("CDT_SLO_TILE_P95", "5.0", "telemetry",
          "Tile pull-to-submit latency target the tile_latency SLO "
          "classifies samples against (seconds)."),

@@ -45,6 +45,7 @@ import {
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
+  profilingHtml,
   regionHtml,
   renderVocabBanner,
   renderWorkers,
@@ -106,6 +107,7 @@ async function refreshStatus() {
   refreshUsage();
   refreshCache();
   refreshIncidents();
+  refreshProfiling();
   schedulePoll();
 }
 
@@ -197,6 +199,26 @@ async function refreshCache() {
   } catch {
     container.textContent = "cache status unreachable";
   }
+}
+
+// ---------- profiling card ----------
+
+async function refreshProfiling() {
+  const container = document.getElementById("profiling");
+  try {
+    container.innerHTML = profilingHtml(await api("/distributed/profile"));
+  } catch {
+    container.textContent = "profiling status unreachable";
+  }
+}
+
+async function profileAction(path) {
+  try {
+    await api(path, { method: "POST" });
+  } catch (err) {
+    alert(`profiler: ${err.message}`);
+  }
+  refreshProfiling();
 }
 
 // ---------- incidents card ----------
@@ -623,6 +645,10 @@ document.getElementById("sched-resume").addEventListener("click", () =>
   schedulerAction("/distributed/scheduler/resume"));
 document.getElementById("sched-drain").addEventListener("click", () =>
   schedulerAction("/distributed/scheduler/drain"));
+document.getElementById("profile-start").addEventListener("click", () =>
+  profileAction("/distributed/profile/start"));
+document.getElementById("profile-stop").addEventListener("click", () =>
+  profileAction("/distributed/profile/stop"));
 document.getElementById("add-worker").addEventListener("click", () => workerForm(null));
 document.getElementById("modal-close").addEventListener("click", hideModal);
 document.getElementById("queue-btn").addEventListener("click", queueWorkflow);

@@ -355,6 +355,64 @@ export function cacheHtml(stats) {
   return header + tiers + churn;
 }
 
+/** Profiling card (pure; app.js refreshProfiling applies it): the
+ * transfer ledger's device/host split + host-tax ratio, plus the
+ * jax.profiler capture state and retained trace index from
+ * GET /distributed/profile. */
+export function profilingHtml(info) {
+  if (!info) return '<span class="meta">profiling status unavailable</span>';
+  const secs = (ns) => (Number(ns ?? 0) / 1e9).toFixed(3);
+  const mib = (n) => (Number(n ?? 0) / (1024 * 1024)).toFixed(1);
+  const ledger = info.ledger;
+  let ledgerLines;
+  if (!ledger) {
+    ledgerLines =
+      '<div class="row"><span class="meta">transfer ledger off — CDT_PROFILING=0</span></div>';
+  } else {
+    const hostNs = Object.values(ledger.host_ns || {}).reduce(
+      (a, v) => a + Number(v || 0), 0
+    );
+    const transfer = ledger.transfer || {};
+    const h2d = transfer.h2d || {};
+    const d2h = transfer.d2h || {};
+    ledgerLines =
+      `<div class="row">host tax <b>${(Number(ledger.host_tax ?? 0) * 100).toFixed(1)}%</b>` +
+      ` · device ${secs(ledger.device_ns)}s` +
+      ` · host ${secs(hostNs)}s` +
+      ` · ${Number(ledger.tiles ?? 0)} tile(s)</div>` +
+      `<div class="row"><span class="meta">h2d ${mib(h2d.bytes)} MiB (${Number(h2d.count ?? 0)})` +
+      ` · d2h ${mib(d2h.bytes)} MiB (${Number(d2h.count ?? 0)})` +
+      `${Number(ledger.eager_ns ?? 0) ? ` · eager ${secs(ledger.eager_ns)}s` : ""}` +
+      `</span></div>`;
+  }
+  if (info.enabled === false) {
+    return (
+      ledgerLines +
+      '<div class="row"><span class="meta">trace capture off — set CDT_PROFILE_DIR to enable</span></div>'
+    );
+  }
+  const capture = info.capture || {};
+  // the route serves active as {id, elapsed_s, ...}; older shapes a bare id
+  const activeId = capture.active && (capture.active.id || capture.active);
+  const captureLine = activeId
+    ? `<div class="row"><strong>capturing</strong><span class="meta mono">${escapeHtml(activeId)}</span></div>`
+    : '<div class="row"><span class="meta">no capture in flight</span></div>';
+  const traces = (info.captures || [])
+    .slice(0, 8)
+    .map(
+      (c) =>
+        `<div class="row"><span class="meta mono">${escapeHtml(c.id || "")}` +
+        ` · ${mib(c.bytes)} MiB</span></div>`
+    )
+    .join("");
+  return (
+    ledgerLines +
+    captureLine +
+    (traces ||
+      '<div class="row"><span class="meta">no retained traces</span></div>')
+  );
+}
+
 /** Incidents card (pure; app.js refreshIncidents applies it): the
  * newest-first bundle listing from GET /distributed/incidents plus
  * flight-recorder accounting; pushed `incident_captured` events

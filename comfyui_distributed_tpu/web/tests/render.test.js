@@ -12,6 +12,7 @@ import {
   networkInfoHtml,
   parsePipelineMetrics,
   pipelineHtml,
+  profilingHtml,
   regionHtml,
   schedulerHtml,
   topologyHtml,
@@ -337,6 +338,56 @@ test("cacheHtml: disabled / tiers / corrupt emphasis", () => {
   assertIncludes(ramOnly, "disk tier off");
   // a pushed cache_stats event IS the stats payload (no wrapper)
   assertIncludes(cacheHtml({ hits: 0, misses: 0, hit_rate: 0 }), "hit rate");
+});
+
+test("profilingHtml: ledger / capture states / trace index", () => {
+  assertIncludes(profilingHtml(null), "unavailable");
+  // ledger off (CDT_PROFILING=0) but capture enabled
+  assertIncludes(profilingHtml({ enabled: true, ledger: null }), "CDT_PROFILING=0");
+  const ledger = {
+    host_tax: 0.25,
+    device_ns: 3e9,
+    eager_ns: 0,
+    host_ns: { gather: 5e8, encode: 3e8, ship: 2e8 },
+    tiles: 4,
+    transfer: {
+      h2d: { bytes: 2 * 1024 * 1024, count: 3 },
+      d2h: { bytes: 1024 * 1024, count: 4 },
+    },
+  };
+  // capture disabled: ledger still renders, with the enable hint
+  const disabled = profilingHtml({ enabled: false, ledger });
+  assertIncludes(disabled, "25.0%");
+  assertIncludes(disabled, "device 3.000s");
+  assertIncludes(disabled, "host 1.000s");
+  assertIncludes(disabled, "CDT_PROFILE_DIR");
+  // idle capture + retained trace index
+  const idle = profilingHtml({
+    enabled: true,
+    ledger,
+    capture: { active: null },
+    captures: [{ id: "trace-0002-drill", bytes: 3 * 1024 * 1024 }],
+  });
+  assertIncludes(idle, "no capture in flight");
+  assertIncludes(idle, "trace-0002-drill");
+  assertIncludes(idle, "3.0 MiB");
+  // in-flight capture: the route serves active as {id, ...}
+  const busy = profilingHtml({
+    enabled: true,
+    ledger,
+    capture: { active: { id: "trace-0003-smoke", elapsed_s: 1.2 } },
+    captures: [],
+  });
+  assertIncludes(busy, "capturing");
+  assertIncludes(busy, "trace-0003-smoke");
+  assertIncludes(busy, "no retained traces");
+  // eager-only ledger surfaces the eager bucket
+  const eager = profilingHtml({
+    enabled: false,
+    ledger: { ...ledger, device_ns: 0, eager_ns: 5e8, host_tax: 1.0 },
+  });
+  assertIncludes(eager, "eager 0.500s");
+  assertIncludes(eager, "100.0%");
 });
 
 test("incidentsHtml: disabled / flight accounting / bundle rows", () => {

@@ -70,6 +70,22 @@ PULL_SPAN_NAMES = ("tile.pull", "rpc.request_image")
 SAMPLE_STAGE = "sample"
 IO_STAGES = ("readback", "encode", "submit")
 
+# Host-tax reconstruction (telemetry/profiling.py offline counterpart):
+# `tile.dispatch` spans carry a `device` attr — True when a COMPILED
+# program ran (device time), False/absent for the eager-stub tier
+# (host time: Python ran the math). Host-bucket stages are the
+# gather/encode/ship work between dispatches. The ratio
+# host_ns / (host_ns + device_ns) is the host tax; a zero-device trace
+# (eager chaos run) honestly reads 1.0, never NaN.
+HOST_TAX_STAGES = ("readback", "encode", "decode", "submit")
+_NS = 1_000_000_000
+
+
+def _to_ns(seconds: Any) -> int:
+    """PR-15 conservation idiom: one float->int rounding at ingest,
+    integer arithmetic after — sums are exact, never float-drifty."""
+    return int(round(float(seconds) * _NS))
+
 
 def load_spans(path: str) -> list[dict[str, Any]]:
     spans = []
@@ -275,6 +291,189 @@ def cache_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def host_tax_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Device/host time split from the span stream alone.
+
+    Device ns: dispatch spans whose `device` attr is truthy (a
+    compiled program ran — graph/tile_pipeline.py and
+    graph/batch_executor.py stamp the attr from the same
+    ``hasattr(step, "lower")`` gate the jit decision uses). Eager
+    dispatches (chaos stubs) are host work — Python executed the
+    math — so they join the host side; that is what makes a
+    zero-device run read host_tax = 1.0 instead of NaN. None when the
+    trace has neither dispatches nor host-bucket stages (nothing to
+    attribute)."""
+    device_ns = 0
+    eager_ns = 0
+    host_ns = 0
+    dispatches = 0
+    device_dispatches = 0
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        stage = attrs.get("stage")
+        duration = span.get("duration")
+        if stage is None or duration is None:
+            continue
+        try:
+            ns = _to_ns(duration)
+        except (TypeError, ValueError):
+            continue
+        if stage == "dispatch":
+            dispatches += 1
+            if attrs.get("device"):
+                device_dispatches += 1
+                device_ns += ns
+            else:
+                eager_ns += ns
+        elif stage in HOST_TAX_STAGES:
+            host_ns += ns
+    if dispatches == 0 and host_ns == 0:
+        return None
+    total_host = host_ns + eager_ns
+    if device_ns <= 0:
+        tax = 1.0
+    else:
+        tax = total_host / (total_host + device_ns)
+    return {
+        "dispatches": dispatches,
+        "device_dispatches": device_dispatches,
+        "device_ns": device_ns,
+        "eager_ns": eager_ns,
+        "host_ns": host_ns,
+        "host_tax": tax,
+    }
+
+
+def host_tax_regressions(
+    old_ht: dict[str, Any] | None,
+    new_ht: dict[str, Any] | None,
+    regress_pct: float,
+) -> list[dict[str, Any]]:
+    """The host-tax gate: the device-resident PRs must show the ratio
+    FALLING, so growth beyond `regress_pct` percent relative fails
+    --compare — host work crept back between device dispatches. Old
+    tax below 1% gates on absolute growth of more than one percentage
+    point (the usage_waste_share near-zero-base rule)."""
+    if not old_ht or not new_ht:
+        return []
+    old_tax = old_ht["host_tax"]
+    new_tax = new_ht["host_tax"]
+    if old_tax < 0.01:
+        if new_tax - old_tax <= 0.01:
+            return []
+        delta_pct = (new_tax - old_tax) * 100.0  # absolute points
+    else:
+        delta_pct = (new_tax / old_tax - 1.0) * 100.0
+        if delta_pct <= regress_pct:
+            return []
+    return [
+        {
+            "stage": "host_tax",
+            # shares, not seconds — old_p95/new_p95 keep the comparison
+            # machinery uniform (the usage_waste_share convention)
+            "old_p95": old_tax,
+            "new_p95": new_tax,
+            "old_share": old_tax,
+            "new_share": new_tax,
+            "delta_pct": delta_pct,
+        }
+    ]
+
+
+def waterfall_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Per-tile lifecycle waterfall with EXACT integer-ns conservation.
+
+    Each tile's spans (batched spans credit every tile in their
+    ``batch`` attr) become an ordered sequence of stage segments on the
+    span clock. Overlap is clipped with a cursor — a segment only
+    credits the part past the furthest point already attributed — and
+    gaps become explicit ``wait`` segments, so
+
+        sum(stage_ns) + wait_ns == wall_ns   (exactly, per tile)
+
+    where wall_ns is the tile's measured first-span-start to
+    last-span-end. That telescoping identity is the acceptance check
+    (`conserved` per tile, `all_conserved` for the run) — the PR-13
+    analyzer's conservation rule at per-tile granularity."""
+    segments: dict[int, list[tuple[int, int, str]]] = {}
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        tile_idx = attrs.get("tile_idx")
+        stage = attrs.get("stage")
+        start = span.get("start")
+        duration = span.get("duration")
+        if tile_idx is None or stage is None or start is None:
+            continue
+        if duration is None:
+            continue
+        try:
+            start_ns = _to_ns(start)
+            end_ns = start_ns + _to_ns(duration)
+        except (TypeError, ValueError):
+            continue
+        for idx in attrs.get("batch") or [tile_idx]:
+            segments.setdefault(int(idx), []).append(
+                (start_ns, end_ns, str(stage))
+            )
+    tiles: dict[int, dict[str, Any]] = {}
+    all_conserved = True
+    for tile_idx in sorted(segments):
+        segs = sorted(segments[tile_idx])
+        first = segs[0][0]
+        last = max(end for _start, end, _stage in segs)
+        wall_ns = last - first
+        stages: dict[str, int] = {}
+        timeline: list[dict[str, Any]] = []
+        wait_ns = 0
+        cursor = first
+        for start_ns, end_ns, stage in segs:
+            if start_ns > cursor:
+                gap = start_ns - cursor
+                wait_ns += gap
+                timeline.append(
+                    {"stage": "wait", "start_ns": cursor, "ns": gap}
+                )
+                cursor = start_ns
+            seg_start = max(cursor, start_ns)
+            if end_ns > seg_start:
+                credited = end_ns - seg_start
+                stages[stage] = stages.get(stage, 0) + credited
+                timeline.append(
+                    {"stage": stage, "start_ns": seg_start, "ns": credited}
+                )
+                cursor = end_ns
+        attributed = sum(stages.values()) + wait_ns
+        conserved = attributed == wall_ns
+        all_conserved = all_conserved and conserved
+        tiles[tile_idx] = {
+            "wall_ns": wall_ns,
+            "wait_ns": wait_ns,
+            "stages": stages,
+            "timeline": timeline,
+            "conserved": conserved,
+        }
+    return {"tiles": tiles, "all_conserved": all_conserved}
+
+
+def render_waterfall(waterfall: dict[str, Any]) -> str:
+    tiles = waterfall["tiles"]
+    lines = [
+        f"waterfall ({len(tiles)} tile(s), conservation "
+        f"{'OK' if waterfall['all_conserved'] else 'VIOLATED'}):"
+    ]
+    for tile_idx, tile in tiles.items():
+        flow = " -> ".join(
+            f"{seg['stage']}({seg['ns'] / _NS:.4f}s)"
+            for seg in tile["timeline"]
+        )
+        verdict = "" if tile["conserved"] else "  [NOT CONSERVED]"
+        lines.append(
+            f"  tile {tile_idx:>3}: wall {tile['wall_ns'] / _NS:.4f}s = "
+            f"{flow}{verdict}"
+        )
+    return "\n".join(lines)
+
+
 def usage_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     """Chip-second attribution from the per-dispatch spans both
     execution tiers emit (``tile.dispatch`` with ``real``/``bucket``
@@ -428,6 +627,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "pipeline_overlap": pipeline_overlap_stats(spans),
         "batch_fill": batch_fill_stats(spans),
         "cache": cache_stats(spans),
+        "host_tax": host_tax_stats(spans),
     }
 
 
@@ -572,6 +772,14 @@ def compare_reports(
                     "delta_pct": drop_pct,
                 }
             )
+    # host tax gates on GROWTH: the device-resident PRs must show the
+    # host share of every (host + device) nanosecond falling.
+    regressions.extend(
+        host_tax_regressions(
+            old_report.get("host_tax"), new_report.get("host_tax"),
+            regress_pct,
+        )
+    )
     return regressions
 
 
@@ -598,6 +806,13 @@ def render_comparison(
             lines.append(
                 f"  {item['stage']:28} hit rate {item['old_p95']:.3f} -> "
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
+        if item["stage"] == "host_tax":
+            # host SHARE of (host + device) time, unitless
+            lines.append(
+                f"  {item['stage']:28} tax {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (+{item['delta_pct']:.1f}%)"
             )
             continue
         if item["stage"] == "usage_waste_share":
@@ -801,6 +1016,16 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"{cache['dispatched_tiles']} dispatched "
             f"(hit rate {cache['hit_rate']:.3f})"
         )
+    host_tax = report.get("host_tax")
+    if host_tax:
+        lines.append("")
+        lines.append(
+            f"host tax ({host_tax['dispatches']} dispatch(es), "
+            f"{host_tax['device_dispatches']} on device): "
+            f"device {host_tax['device_ns'] / _NS:.4f}s, host "
+            f"{(host_tax['host_ns'] + host_tax['eager_ns']) / _NS:.4f}s "
+            f"(tax {host_tax['host_tax']:.3f})"
+        )
     if tiles:
         lines.append("")
         lines.append(f"tile lifecycles: {len(tiles)} tile(s)")
@@ -860,6 +1085,14 @@ def main(argv: list[str] | None = None) -> int:
         "growth beyond --regress-pct joins the exit-3 gate",
     )
     parser.add_argument(
+        "--waterfall",
+        action="store_true",
+        help="per-tile lifecycle waterfall: ordered stage segments + "
+        "explicit waits on the span clock, with EXACT integer-ns "
+        "conservation (stage sums + waits == tile wall); exit 5 when "
+        "any tile's attribution fails to conserve",
+    )
+    parser.add_argument(
         "--slo",
         action="append",
         default=[],
@@ -892,6 +1125,7 @@ def main(argv: list[str] | None = None) -> int:
 
     critical = critical_path_report(spans) if args.critical_path else None
     usage = usage_stats(spans) if args.usage else None
+    waterfall = waterfall_report(spans) if args.waterfall else None
 
     regressions = None
     if args.compare:
@@ -929,6 +1163,13 @@ def main(argv: list[str] | None = None) -> int:
             payload["critical_path"] = critical
         if usage is not None:
             payload["usage"] = usage
+        if waterfall is not None:
+            payload["waterfall"] = {
+                "all_conserved": waterfall["all_conserved"],
+                "tiles": {
+                    str(k): v for k, v in waterfall["tiles"].items()
+                },
+            }
         if regressions is not None:
             payload["regressions"] = regressions
         if violations is not None:
@@ -942,6 +1183,9 @@ def main(argv: list[str] | None = None) -> int:
         if usage is not None:
             print()
             print(render_usage(usage))
+        if waterfall is not None:
+            print()
+            print(render_waterfall(waterfall))
         if regressions is not None:
             print()
             print(render_comparison(regressions, args.regress_pct))
@@ -952,6 +1196,8 @@ def main(argv: list[str] | None = None) -> int:
         return 3
     if violations:
         return 4
+    if waterfall is not None and not waterfall["all_conserved"]:
+        return 5
     return 2 if problems else 0
 
 

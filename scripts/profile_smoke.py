@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Profiling smoke: the CI `profile-smoke` job's driver.
+
+One tiny elastic chaos run with the transfer ledger on, asserting the
+device-time attribution plane's load-bearing properties
+(docs/observability.md §Profiling):
+
+1. **ledger populated** — the chaos run's dispatches/readbacks land in
+   the process ledger (tiles, host buckets, dispatch counts nonzero);
+2. **honest eager tax** — a zero-device run (CPU eager stubs) reports
+   host_tax exactly 1.0, never NaN;
+3. **compiled split** — a jitted GrantSampler dispatch credits
+   device_ns, drops the tax below 1.0, and the integer-ns totals obey
+   host_total_ns == sum(buckets);
+4. **waterfall conservation, exact** — `perf_report --waterfall` on the
+   exported trace attributes every tile's wall time to stages + wait
+   with zero remainder (`all_conserved`; exit 5 would mean an
+   attribution bug);
+5. **capture round-trip** — /distributed/profile's ProfilerCapture
+   start/stop works on CPU (jax.profiler), retains the capture on
+   disk, and is single-flight;
+6. **the compare gate fires** — a fabricated trace whose host tax grew
+   past --regress-pct makes `perf_report --compare` exit 3.
+
+Writes a combined JSON report (uploaded as a CI artifact) to the path
+given as argv[1] (default: profile-smoke.json). Exit 0 = every
+assertion held. Runs on CPU; the CI job forces 4 host devices.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PERF_REPORT = os.path.join(REPO, "scripts", "perf_report.py")
+
+
+def check(condition: bool, label: str, detail=None) -> None:
+    if not condition:
+        raise SystemExit(f"profile-smoke FAILED: {label}: {detail!r}")
+    print(f"  ok: {label}")
+
+
+def eager_run(trace_path: str) -> dict:
+    """Chaos USDU (eager stubs): ledger populated, host_tax == 1.0."""
+    from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+    from comfyui_distributed_tpu.telemetry.profiling import (
+        _reset_transfer_ledger_for_tests,
+        get_transfer_ledger,
+    )
+
+    print("scan tier: eager chaos run, ledger on")
+    _reset_transfer_ledger_for_tests()
+    run_chaos_usdu(seed=13, tile_batch=2, image_hw=(64, 96),
+                   trace_jsonl=trace_path)
+    totals = get_transfer_ledger().totals()
+    check(totals["tiles"] > 0, "ledger counted tiles", totals)
+    check(
+        totals["eager_dispatches"] > 0 and totals["device_dispatches"] == 0,
+        "eager stubs dispatch on the host side", totals,
+    )
+    check(totals["host_total_ns"] > 0, "host buckets populated", totals)
+    check(
+        totals["host_tax"] == 1.0,
+        "zero-device run reads host_tax exactly 1.0", totals["host_tax"],
+    )
+    check(
+        totals["host_total_ns"] == sum(totals["host_ns"].values()),
+        "integer-ns bucket sum identity", totals,
+    )
+    return totals
+
+
+def compiled_split() -> dict:
+    """A jitted GrantSampler dispatch must credit device time."""
+    from comfyui_distributed_tpu.graph.tile_pipeline import GrantSampler
+    from comfyui_distributed_tpu.telemetry.profiling import (
+        _reset_transfer_ledger_for_tests,
+        get_transfer_ledger,
+    )
+    import jax
+    import jax.numpy as jnp
+
+    print("scan tier: jitted dispatch (device split)")
+    _reset_transfer_ledger_for_tests()
+
+    @jax.jit
+    def step(params, tile, key, pos, neg, yx):
+        return tile * 2.0
+
+    sampler = GrantSampler(
+        step, None, jnp.ones((3, 4, 4, 3), jnp.float32),
+        jax.random.key(0), jnp.zeros((3, 2), jnp.int32), None, None,
+        k_max=4, job_id="profile-jit", tenant="tenant-a",
+    )
+    check(sampler._device, "jit gate detected the compiled step")
+    out = sampler.sample([0, 1, 2])
+    sampler.collect(out)
+    totals = get_transfer_ledger().totals()
+    check(totals["device_ns"] > 0, "device dispatch credited device_ns",
+          totals)
+    check(totals["host_tax"] < 1.0, "compiled run drops the tax below 1.0",
+          totals["host_tax"])
+    check(
+        totals["transfer"]["d2h"]["bytes"] > 0,
+        "readback recorded d2h bytes", totals["transfer"],
+    )
+    return totals
+
+
+def waterfall_gate(trace_path: str) -> dict:
+    """perf_report --waterfall: exact per-tile conservation, not exit 5."""
+    print("perf_report: waterfall conservation")
+    proc = subprocess.run(
+        [sys.executable, PERF_REPORT, trace_path, "--waterfall", "--json"],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode != 5, "waterfall conservation held (exit != 5)",
+          proc.stdout[-2000:])
+    check(proc.returncode in (0, 2), "perf_report ran clean",
+          (proc.returncode, proc.stderr[-2000:]))
+    payload = json.loads(proc.stdout)
+    waterfall = payload.get("waterfall")
+    check(bool(waterfall), "waterfall present in --json payload")
+    check(waterfall["all_conserved"], "every tile's stages sum to wall",
+          waterfall)
+    check(len(waterfall["tiles"]) > 0, "waterfall reconstructed tiles",
+          len(waterfall["tiles"]))
+    host_tax = payload["report"].get("host_tax")
+    check(
+        host_tax is not None and host_tax["host_tax"] == 1.0,
+        "offline host_tax agrees with the eager ledger (1.0)", host_tax,
+    )
+    return {"tiles": len(waterfall["tiles"]),
+            "all_conserved": waterfall["all_conserved"],
+            "host_tax": host_tax}
+
+
+def capture_roundtrip(profile_dir: str) -> dict:
+    """jax.profiler start/stop round-trip on CPU + single-flight."""
+    from comfyui_distributed_tpu.telemetry.profiling import ProfilerCapture
+
+    print("profiler capture: start/stop round-trip")
+    capture = ProfilerCapture(profile_dir, max_seconds=30.0)
+    started = capture.start(duration_s=10.0, tag="smoke")
+    check(started.get("started") is True, "capture started", started)
+    busy = capture.start(duration_s=10.0)
+    check(busy.get("started") is False and busy.get("reason") == "busy",
+          "second start answers busy (single-flight)", busy)
+    stopped = capture.stop()
+    check(stopped.get("stopped") is True, "capture stopped", stopped)
+    again = capture.stop()
+    check(again.get("stopped") is False, "stop is idempotent", again)
+    entries = capture.captures()
+    check(len(entries) == 1, "one capture retained", entries)
+    check(os.path.isdir(os.path.join(profile_dir, entries[0]["id"])),
+          "capture directory exists on disk", entries)
+    counters = dict(capture.counters)
+    check(counters["started"] == 1 and counters["stopped"] == 1
+          and counters["busy"] == 1, "lifecycle counters exact", counters)
+    return {"captures": entries, "counters": counters}
+
+
+def _write_spans(path: str, host_s: float) -> None:
+    """A minimal trace: one compiled dispatch + one readback of
+    `host_s` — the host tax is host_s / (host_s + 1.0)."""
+    spans = [
+        {"name": "tile.dispatch", "start": 1.0, "duration": 1.0,
+         "attrs": {"stage": "dispatch", "role": "master", "tile_idx": 0,
+                   "real": 1, "bucket": 1, "device": True}},
+        {"name": "tile.readback", "start": 2.0, "duration": host_s,
+         "attrs": {"stage": "readback", "role": "master", "tile_idx": 0}},
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        for span in spans:
+            fh.write(json.dumps(span) + "\n")
+
+
+def compare_gate(workdir: str) -> dict:
+    """A regressed host tax must fail --compare with exit 3."""
+    print("perf_report: host-tax compare gate")
+    old_path = os.path.join(workdir, "old.jsonl")
+    new_path = os.path.join(workdir, "new.jsonl")
+    _write_spans(old_path, host_s=0.10)   # tax ~0.091
+    _write_spans(new_path, host_s=0.50)   # tax ~0.333 (+266%)
+    proc = subprocess.run(
+        [sys.executable, PERF_REPORT, new_path, "--compare", old_path],
+        capture_output=True, text=True,
+    )
+    check(proc.returncode == 3, "regressed host tax exits 3",
+          (proc.returncode, proc.stdout[-2000:]))
+    check("host_tax" in proc.stdout, "regression names host_tax",
+          proc.stdout[-2000:])
+    ok = subprocess.run(
+        [sys.executable, PERF_REPORT, old_path, "--compare", old_path],
+        capture_output=True, text=True,
+    )
+    check(ok.returncode != 3, "identical traces pass the gate",
+          (ok.returncode, ok.stdout[-2000:]))
+    return {"regress_rc": proc.returncode, "self_rc": ok.returncode}
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "profile-smoke.json"
+    trace_path = os.environ.get(
+        "PROFILE_SMOKE_TRACE", os.path.join(tempfile.gettempdir(),
+                                            "profile-smoke-trace.jsonl")
+    )
+    report: dict = {}
+    report["eager_ledger"] = eager_run(trace_path)
+    report["compiled_ledger"] = compiled_split()
+    report["waterfall"] = waterfall_gate(trace_path)
+    with tempfile.TemporaryDirectory(prefix="cdt-profile-smoke-") as tmp:
+        report["capture"] = capture_roundtrip(os.path.join(tmp, "traces"))
+        report["compare_gate"] = compare_gate(tmp)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True, default=str)
+    print(f"profile-smoke OK; report written to {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,92 @@
+"""The DRR admission-cost gap (full-cost-until-settle, made
+observable): admission charges the full estimated-tiles cost up front,
+and tiles the content-addressed cache later settles never burn chip
+time — `SchedulerControl.note_cache_settled` accumulates that
+over-charge so `cdt_cache_unsettled_admission_cost` can surface it
+(docs/observability.md, runbook §4n step 6)."""
+
+import types
+
+import pytest
+
+from comfyui_distributed_tpu.scheduler.control import SchedulerControl
+
+pytestmark = pytest.mark.fast
+
+
+def _payload(tenant="tenant-a", tiles=None):
+    extra = {} if tiles is None else {"estimated_tiles": tiles}
+    return types.SimpleNamespace(
+        tenant=tenant, lane=None, trace_id=None, deadline_s=None, extra=extra,
+    )
+
+
+def test_settle_charges_last_admitted_per_tile_cost(monkeypatch):
+    from comfyui_distributed_tpu.utils import constants
+
+    control = SchedulerControl()
+    monkeypatch.setattr(constants, "USAGE_COST_ENABLED", True)
+    control.usage_cost = lambda tenant: 2.0  # measured 2x per tile
+    ticket = control.submit_payload(_payload("heavy", tiles=10))
+    assert ticket.cost == pytest.approx(20.0)
+    # 3 of those 10 tiles settled from the cache: the admission meter
+    # over-charged 3 x 2.0 cost units
+    assert control.note_cache_settled("heavy", 3) == pytest.approx(6.0)
+    assert control.unsettled_admission_cost == pytest.approx(6.0)
+    # the gap is cumulative — a second settle adds, never resets
+    control.note_cache_settled("heavy", 1)
+    assert control.unsettled_admission_cost == pytest.approx(8.0)
+
+
+def test_unknown_tenant_falls_back_to_static_unit_cost():
+    control = SchedulerControl()
+    # never admitted in this process: the same 1.0/tile fallback
+    # admission itself uses
+    assert control.note_cache_settled("stranger", 4) == pytest.approx(4.0)
+    assert control.unsettled_admission_cost == pytest.approx(4.0)
+
+
+def test_zero_and_negative_tile_counts_are_noops():
+    control = SchedulerControl()
+    assert control.note_cache_settled("t", 0) == 0.0
+    assert control.note_cache_settled("t", -3) == 0.0
+    assert control.unsettled_admission_cost == 0.0
+
+
+def test_status_surfaces_the_gap():
+    control = SchedulerControl()
+    control.note_cache_settled("t", 2)
+    assert control.status()["unsettled_admission_cost"] == pytest.approx(2.0)
+
+
+def test_per_tile_cost_map_is_bounded_oldest_evicted():
+    control = SchedulerControl()
+    cap = control._max_tenant_tile_cost
+    for i in range(cap + 10):
+        control._note_admitted_cost(f"tenant-{i}", 5.0)
+    assert len(control._tenant_tile_cost) == cap
+    # tenant-0 was evicted -> static fallback; the newest survives
+    assert control.note_cache_settled("tenant-0", 1) == pytest.approx(1.0)
+    assert control.note_cache_settled(f"tenant-{cap + 9}", 1) == (
+        pytest.approx(5.0)
+    )
+
+
+def test_job_store_settle_sink_is_advisory():
+    """The JobStore seam the server wires to note_cache_settled: fed
+    tenant+count, and a raising sink never breaks settle itself."""
+    from comfyui_distributed_tpu.jobs import JobStore
+
+    store = JobStore()
+    calls = []
+    store.settle_sink = lambda tenant, count: calls.append((tenant, count))
+    store._note_settle_sink("tenant-a", 3)
+    assert calls == [("tenant-a", 3)]
+
+    def boom(tenant, count):
+        raise RuntimeError("accounting down")
+
+    store.settle_sink = boom
+    store._note_settle_sink("tenant-a", 1)  # must not raise
+    store.settle_sink = None
+    store._note_settle_sink("tenant-a", 1)  # unwired: no-op

@@ -656,3 +656,186 @@ def test_cache_hit_rate_drop_rides_the_compare_gate():
         for r in perf_report.compare_reports(old, old, 25.0)
         if r["stage"] == "cache_hit_rate"
     ]
+
+
+# --------------------------------------------------------------------------
+# host tax + per-tile waterfall (device-time attribution PR)
+# --------------------------------------------------------------------------
+
+
+def _tile_span(stage, tile_idx, start, duration, batch=None, device=None,
+                role="worker"):
+    attrs = {"stage": stage, "role": role, "tile_idx": tile_idx}
+    if batch is not None:
+        attrs["batch"] = list(batch)
+    if device is not None:
+        attrs["device"] = device
+    return {
+        "trace_id": "t", "span_id": f"s{stage}{tile_idx}{start}",
+        "name": f"tile.{stage}", "start": start, "end": start + duration,
+        "duration": duration, "attrs": attrs, "events": [], "status": "ok",
+    }
+
+
+def test_host_tax_zero_device_reads_one_not_nan():
+    """An eager-stub trace has no device time; the tax must be exactly
+    1.0 — all attributable time was host time — never NaN/ZeroDivision."""
+    spans = [
+        _tile_span("dispatch", 0, 0.0, 0.5, device=False),
+        _tile_span("readback", 0, 0.5, 0.1),
+        _tile_span("encode", 0, 0.6, 0.1),
+    ]
+    ht = perf_report.host_tax_stats(spans)
+    assert ht["device_ns"] == 0
+    assert ht["eager_ns"] == perf_report._to_ns(0.5)
+    assert ht["host_tax"] == 1.0
+
+
+def test_host_tax_device_eager_split():
+    spans = [
+        _tile_span("dispatch", 0, 0.0, 3.0, device=True),
+        _tile_span("dispatch", 1, 3.0, 0.5, device=False),
+        _tile_span("readback", 0, 3.5, 0.25),
+        _tile_span("submit", 0, 3.75, 0.25),
+    ]
+    ht = perf_report.host_tax_stats(spans)
+    assert ht["dispatches"] == 2
+    assert ht["device_dispatches"] == 1
+    # host side = eager 0.5 + stages 0.5 = 1.0s against 3.0s device
+    assert ht["host_tax"] == pytest.approx(0.25)
+
+
+def test_host_tax_none_without_signal():
+    assert perf_report.host_tax_stats([]) is None
+    assert perf_report.host_tax_stats(
+        [_tile_span("pull", 0, 0.0, 1.0)]
+    ) is None
+
+
+def test_host_tax_regression_rides_the_compare_gate():
+    old = perf_report.build_report([
+        _tile_span("dispatch", 0, 0.0, 1.0, device=True),
+        _tile_span("readback", 0, 1.0, 0.1),
+    ])
+    new = perf_report.build_report([
+        _tile_span("dispatch", 0, 0.0, 1.0, device=True),
+        _tile_span("readback", 0, 1.0, 0.5),
+    ])
+    assert old["host_tax"]["host_tax"] < new["host_tax"]["host_tax"]
+    regressions = perf_report.compare_reports(old, new, 25.0)
+    hits = [r for r in regressions if r["stage"] == "host_tax"]
+    assert len(hits) == 1
+    assert hits[0]["new_share"] == pytest.approx(1.0 / 3.0)
+    rendered = perf_report.render_comparison(regressions, 25.0)
+    assert "host_tax" in rendered
+    # identical traces pass; absence of old signal is not a regression
+    assert not [
+        r for r in perf_report.compare_reports(old, old, 25.0)
+        if r["stage"] == "host_tax"
+    ]
+    no_signal = perf_report.build_report([_tile_span("pull", 0, 0.0, 1.0)])
+    assert not [
+        r for r in perf_report.compare_reports(no_signal, new, 25.0)
+        if r["stage"] == "host_tax"
+    ]
+
+
+def test_host_tax_near_zero_base_gates_on_absolute_points():
+    """0.1% -> 0.9% is noise (sub-point), 0.1% -> 5% is a regression —
+    relative growth alone would flag both at +800%/+4900%."""
+    base = {"dispatches": 1, "device_dispatches": 1, "device_ns": 10**9,
+            "eager_ns": 0, "host_ns": 0, "host_tax": 0.001}
+    noisy = dict(base, host_tax=0.009)
+    grown = dict(base, host_tax=0.05)
+    assert not perf_report.host_tax_regressions(base, noisy, 25.0)
+    hits = perf_report.host_tax_regressions(base, grown, 25.0)
+    assert hits and hits[0]["delta_pct"] == pytest.approx(4.9)
+
+
+def test_waterfall_conserves_exactly_with_explicit_waits():
+    spans = [
+        _tile_span("pull", 0, 0.0, 0.1),
+        # 0.1..0.3 gap -> wait
+        _tile_span("sample", 0, 0.3, 0.5),
+        _tile_span("blend", 0, 0.8, 0.2),
+    ]
+    wf = perf_report.waterfall_report(spans)
+    assert wf["all_conserved"] is True
+    tile = wf["tiles"][0]
+    assert tile["wall_ns"] == perf_report._to_ns(1.0)
+    assert tile["wait_ns"] == perf_report._to_ns(0.2)
+    assert sum(tile["stages"].values()) + tile["wait_ns"] == tile["wall_ns"]
+    assert [seg["stage"] for seg in tile["timeline"]] == [
+        "pull", "wait", "sample", "blend",
+    ]
+
+
+def test_waterfall_batched_spans_credit_every_tile():
+    """A batched sample span (batch=[0,1,2]) is every member tile's
+    sample segment — tiles 1 and 2 must not read as all-wait."""
+    spans = [
+        _tile_span("sample", 0, 0.0, 1.0, batch=[0, 1, 2]),
+        _tile_span("readback", 0, 1.0, 0.2, batch=[0, 1, 2]),
+        _tile_span("encode", 1, 1.2, 0.1),
+    ]
+    wf = perf_report.waterfall_report(spans)
+    assert sorted(wf["tiles"]) == [0, 1, 2]
+    assert wf["all_conserved"] is True
+    for idx in (0, 1, 2):
+        assert wf["tiles"][idx]["stages"]["sample"] == perf_report._to_ns(1.0)
+    assert wf["tiles"][1]["stages"]["encode"] == perf_report._to_ns(0.1)
+    assert wf["tiles"][2]["wait_ns"] == 0
+
+
+def test_waterfall_overlap_clipped_not_double_counted():
+    """Pipelined d2h/encode overlap: the encode span starts while the
+    readback still runs. The overlapped window must be credited ONCE
+    (cursor clip), or the stage sum would exceed wall time."""
+    spans = [
+        _tile_span("readback", 0, 0.0, 0.6),
+        _tile_span("encode", 0, 0.4, 0.4),  # 0.4..0.8, overlaps 0.2
+        _tile_span("submit", 0, 0.3, 0.2),  # fully inside readback
+    ]
+    wf = perf_report.waterfall_report(spans)
+    tile = wf["tiles"][0]
+    assert tile["conserved"] is True
+    assert tile["wall_ns"] == perf_report._to_ns(0.8)
+    assert tile["stages"]["readback"] == perf_report._to_ns(0.6)
+    assert tile["stages"]["encode"] == perf_report._to_ns(0.2)  # clipped
+    assert "submit" not in tile["stages"]  # fully shadowed
+    assert tile["wait_ns"] == 0
+
+
+def test_waterfall_chaos_trace_conserves_and_renders(chaos_trace, tmp_path):
+    """End-to-end: every tile of a real chaos trace conserves exactly,
+    --waterfall --json carries the block, and the CLI exit code is
+    clean (5 would mean the attribution broke)."""
+    _result, path = chaos_trace
+    wf = perf_report.waterfall_report(perf_report.load_spans(path))
+    assert sorted(wf["tiles"]) == [0, 1, 2, 3]
+    assert wf["all_conserved"] is True
+    for tile in wf["tiles"].values():
+        assert sum(tile["stages"].values()) + tile["wait_ns"] == tile["wall_ns"]
+    rendered = perf_report.render_waterfall(wf)
+    assert "conservation OK" in rendered
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_report.py"),
+         path, "--waterfall", "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["waterfall"]["all_conserved"] is True
+    assert payload["report"]["host_tax"]["host_tax"] == 1.0  # eager chaos
+
+
+def test_host_tax_rendered_in_text_report(chaos_trace):
+    _result, path = chaos_trace
+    spans = perf_report.load_spans(path)
+    report = perf_report.build_report(spans)
+    tiles = perf_report.tile_lifecycle(spans)
+    rendered = perf_report.render_text(
+        report, tiles, perf_report.incomplete_tiles(tiles)
+    )
+    assert "host tax" in rendered
+    assert "tax 1.000" in rendered

@@ -601,7 +601,8 @@ def test_local_snapshot_v2_carries_usage_block():
 
     _dispatch(get_usage_meter(), role="worker", elapsed=0.002)
     snap = local_snapshot(role="worker")
-    assert snap["v"] == SNAPSHOT_VERSION == 2
+    # v3 added the profiling block; the usage block rides unchanged
+    assert snap["v"] == SNAPSHOT_VERSION == 3
     assert snap["usage"]["dispatch_chip_s"] > 0
     assert snap["usage"]["dispatches"] == 1
 

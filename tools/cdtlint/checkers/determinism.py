@@ -75,6 +75,12 @@ DETERMINISM_PATHS = (
     # replay-stable — the conservation identity is only auditable if
     # the numbers it sums are)
     "comfyui_distributed_tpu/telemetry/usage.py",
+    # the transfer ledger / profiler capture plane: capture ids and
+    # every exported mapping must be pure functions of the observation
+    # sequence (injectable clock only — wall-clock in keys or readdir
+    # order in the seq scan would make two identical runs produce
+    # different waterfalls, breaking the conservation audit)
+    "comfyui_distributed_tpu/telemetry/profiling.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
