@@ -1168,6 +1168,231 @@ def _measure_cache_ab(seed: int = 17) -> dict | None:
         return None
 
 
+def _measure_adapter_churn(
+    n_jobs: int = 6, steps: int = 4, k_max: int = 8
+) -> dict | None:
+    """Adapter-churn mixed-tenant scenario (adapter-plane PR
+    satellite): `n_jobs` concurrent jobs across two tenants, each
+    wearing a DIFFERENT LoRA adapter, plus one adapter-less job, drain
+    through one CrossJobExecutor in two waves. The cold wave pays
+    operand decode + the (single) extended-signature compile; the warm
+    wave re-requests every adapter at a different strength and must
+    serve all operands from the run-local LRU (strength is a traced
+    scalar, not a cache key). Stamps per-wave fill/throughput, the
+    compiled-program count (one adapter program serves all N distinct
+    adapters + one base program — the plane's compile contract), the
+    operand-cache hit/miss ledger, and two bit-identity verdicts (worn
+    job and adapter-less job, wave vs solo) into the datum as
+    `adapter_churn`. Returns None (never raises) when the measurement
+    can't run — losing the stamp must not cost the datum."""
+    try:
+        import time as time_mod
+        import types as types_mod
+
+        import numpy as _np
+
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_distributed_tpu.adapters import AdapterSpec
+        from comfyui_distributed_tpu.adapters.cache import (
+            AdapterOperandCache,
+            operands_for_plan,
+        )
+        from comfyui_distributed_tpu.adapters.registry import AdapterCatalog
+        from comfyui_distributed_tpu.graph.batch_executor import (
+            CrossJobExecutor,
+            XJobHandle,
+        )
+        from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+
+        dim = 3
+        rank = 2
+        target_map = {"lora_unet_dense": ("unet/dense/kernel", (dim, dim))}
+        params = {
+            "unet": {
+                "dense": {"kernel": jnp.eye(dim, dtype=jnp.float32) * 0.9}
+            }
+        }
+
+        # run-local catalog + operand cache: one distinct tiny kohya
+        # adapter per job (distinct bytes → distinct content hashes)
+        catalog = AdapterCatalog()
+        for i in range(n_jobs):
+            rng = _np.random.default_rng(1000 + i)
+            catalog.register_memory(
+                f"bench-style-{i}",
+                {
+                    "lora_unet_dense.lora_down.weight": (
+                        0.1 * rng.normal(size=(rank, dim))
+                    ).astype(_np.float32),
+                    "lora_unet_dense.lora_up.weight": (
+                        0.1 * rng.normal(size=(dim, rank))
+                    ).astype(_np.float32),
+                    "lora_unet_dense.alpha": _np.float32(rank),
+                },
+            )
+        op_cache = AdapterOperandCache()
+
+        trace_log: list[int] = []
+
+        def step(p, x, key, pos, neg, yx, i):
+            trace_log.append(1)
+            w = p["unet"]["dense"]["kernel"]
+            ki = jax.random.fold_in(key, i)
+            return (
+                jnp.einsum("hwc,cd->hwd", x, w)
+                + 0.01 * jax.random.normal(ki, x.shape)
+                + 0.001 * pos
+            )
+
+        proc = types_mod.SimpleNamespace(
+            init=lambda p, tile, key: tile + 0.0,
+            step=jax.jit(step),
+            finish=lambda p, x: jnp.clip(x, -10.0, 10.0),
+            n_steps=steps,
+            signature=("bench-adapter-stub",),
+        )
+
+        class _Master:
+            def __init__(self, n_tiles):
+                self.pending = list(range(n_tiles))
+
+            def pull(self):
+                if not self.pending:
+                    return None
+                grant, self.pending = self.pending, []
+                return {"tile_idxs": grant, "checkpoints": {}}
+
+            def release(self, idxs, cks):
+                self.pending = sorted(set(self.pending) | set(idxs))
+
+        def make_job(job_id, n_tiles, seed, tenant, adapter):
+            master = _Master(n_tiles)
+            rng = _np.random.default_rng(seed)
+            outs: dict[int, _np.ndarray] = {}
+            handle = XJobHandle(
+                job_id=job_id,
+                proc=proc,
+                params=params,
+                extracted=jnp.asarray(
+                    rng.random((n_tiles, 4, 4, dim)), jnp.float32
+                ),
+                positions=jnp.zeros((n_tiles, 2), jnp.int32),
+                pos=jnp.float32(seed),
+                neg=jnp.float32(0),
+                base_key=fold_job_key(jax.random.key(seed), job_id),
+                pull=master.pull,
+                emit=lambda idx, arr, outs=outs: outs.__setitem__(
+                    int(idx), _np.asarray(arr)
+                ),
+                flush=lambda final: None,
+                release=master.release,
+                tenant=tenant,
+                adapter=adapter,
+            )
+            return handle, outs
+
+        def ops_for(i, strength):
+            (resolved,) = catalog.resolve(
+                [AdapterSpec(f"bench-style-{i}", strength)]
+            )
+            return operands_for_plan(
+                [resolved], target_map, catalog=catalog, cache=op_cache
+            )
+
+        def one_wave(strength):
+            ex = CrossJobExecutor(k_max=k_max)
+            canvases = {}
+            sigs = set()
+            traces_before = len(trace_log)
+            started = time_mod.perf_counter()
+            for i in range(n_jobs):
+                handle, outs = make_job(
+                    f"bench-adapter-{i}",
+                    2,
+                    100 + i,
+                    "tenant-a" if i % 2 == 0 else "tenant-b",
+                    ops_for(i, strength),
+                )
+                ex.register(handle)
+                canvases[handle.job_id] = outs
+                sigs.add(handle.sig)
+            base_handle, base_outs = make_job(
+                "bench-adapter-base", 2, 900, "tenant-a", None
+            )
+            ex.register(base_handle)
+            canvases[base_handle.job_id] = base_outs
+            sigs.add(base_handle.sig)
+            stats = ex.run()
+            elapsed = time_mod.perf_counter() - started
+            tiles = stats["tiles"]
+            return canvases, {
+                "fill_ratio": round(stats["fill_ratio"], 4),
+                "dispatches": stats["dispatches"],
+                "tiles": tiles,
+                "elapsed_s": round(elapsed, 4),
+                # ONE host drives the harness executor, so per-chip ==
+                # per-run here; real fleets scale by topology.chips
+                "tiles_per_sec_chip": (
+                    round(tiles / elapsed, 3) if elapsed > 0 else None
+                ),
+                # one device program per distinct signature; the
+                # contract is 2 (one extended-sig program shared by
+                # all N distinct adapters + one untouched base
+                # program), never a function of n_jobs
+                "device_programs": len(sigs),
+                # step-BODY traces this wave (0 = everything served
+                # from jit caches, e.g. the warm wave)
+                "step_traces": len(trace_log) - traces_before,
+            }
+
+        cold_canvases, cold = one_wave(strength=1.0)
+        # warm wave sweeps strength: operands must still all hit
+        warm_canvases, warm = one_wave(strength=0.5)
+        del warm_canvases
+
+        # bit-identity: wave output == solo output, worn AND base
+        def solo(job_id, n_tiles, seed, adapter):
+            ex = CrossJobExecutor(k_max=k_max)
+            handle, outs = make_job(job_id, n_tiles, seed, "tenant-a", adapter)
+            ex.register(handle)
+            ex.run()
+            return outs
+
+        worn_solo = solo("bench-adapter-0", 2, 100, ops_for(0, 1.0))
+        base_solo = solo("bench-adapter-base", 2, 900, None)
+        bit_identical = bool(
+            all(
+                _np.array_equal(worn_solo[i], cold_canvases["bench-adapter-0"][i])
+                for i in range(2)
+            )
+        )
+        base_bit_identical = bool(
+            all(
+                _np.array_equal(
+                    base_solo[i], cold_canvases["bench-adapter-base"][i]
+                )
+                for i in range(2)
+            )
+        )
+        return {
+            "jobs": n_jobs + 1,
+            "adapters": n_jobs,
+            "tenants": 2,
+            "steps": steps,
+            "k_max": k_max,
+            "cold": cold,
+            "warm": warm,
+            "operand_cache": op_cache.stats(),
+            "bit_identical": bit_identical,
+            "base_bit_identical": base_bit_identical,
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"adapter-churn measurement failed: {exc}", file=sys.stderr)
+        return None
+
+
 def _measure_grant_ab(
     waves: int = 6,
     wave_tiles: int = 2,
@@ -2009,6 +2234,14 @@ def main() -> None:
         cache_ab = _measure_cache_ab()
         if cache_ab is not None:
             result["cache"] = cache_ab
+    # adapter-churn mixed-tenant scenario: N distinct same-rank LoRAs
+    # + one base job sharing 2 compiled programs, cold->warm operand
+    # cache, strength sweep, bit-identity (the adapter plane's
+    # batching win as a measured datum)
+    if tiny and os.environ.get("BENCH_ADAPTER", "1") != "0":
+        adapter_churn = _measure_adapter_churn()
+        if adapter_churn is not None:
+            result["adapter_churn"] = adapter_churn
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

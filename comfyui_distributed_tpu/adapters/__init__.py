@@ -1,0 +1,46 @@
+"""The adapter plane: per-request LoRA personalization (docs/personalization.md).
+
+Three layers, bottom-up:
+
+- ``registry``  — the adapter catalog: name → kohya safetensors source
+  with a blake2b content-hash identity. Requests name adapters; every
+  downstream surface (cache keys, batch signatures, usage attribution,
+  worker-side verification) speaks the hash.
+- ``segmented`` — S-LoRA/Punica-style segmented batched application:
+  per-slot ``(down, up, scale)`` operands, rank-padded to a bounded
+  rank-bucket set, so tiles wearing *different* adapters share ONE
+  compiled program per (signature, rank bucket) inside the cross-job
+  executor; plus the whole-grant params patch the scan tier uses.
+- ``cache``     — the host-side LRU over decoded tensors → device-ready
+  operands (byte budget, hit/miss/eviction metrics) and the
+  adapter-miss cold-cost seam DRR admission consults.
+"""
+
+from .registry import (  # noqa: F401
+    AdapterError,
+    AdapterSpec,
+    MAX_ADAPTERS_PER_REQUEST,
+    adapter_plan_key,
+    get_adapter_catalog,
+    parse_adapter_specs,
+    specs_from_wire,
+    specs_to_wire,
+)
+from .segmented import (  # noqa: F401
+    SegmentOperands,
+    adapter_signature,
+    apply_segment_delta,
+    build_operands,
+    bundle_target_map,
+    compose_operands,
+    make_adapter_step,
+    patch_params,
+    rank_bucket_for,
+    rank_buckets,
+)
+from .cache import (  # noqa: F401
+    AdapterOperandCache,
+    adapter_admission_cost,
+    get_adapter_cache,
+    operands_for_plan,
+)

@@ -1,0 +1,225 @@
+"""Host-side adapter operand cache + the DRR admission cold-cost seam.
+
+Decoding a kohya safetensors file and laying out rank-bucketed
+operands is host work worth hundreds of ms on real adapters — far too
+slow to redo per job at million-user churn. This LRU holds
+device-ready ``SegmentOperands`` per (content hash, target map, rank
+bucket set) under a byte budget (``CDT_ADAPTER_CACHE_MB``), feeding
+``cdt_adapter_cache_*`` metrics the runbook's thrashing triage reads.
+
+Scheduler awareness: ``adapter_admission_cost`` answers "would this
+plan's operands come warm?" — a miss multiplies the job's DRR
+admission cost by ``CDT_ADAPTER_COLD_COST`` (the PR-15 measured-cost
+seam's shape: advisory, multiplicative, default 1.0 = off), so a
+tenant thrashing the adapter cache pays for its churn instead of
+taxing warm tenants' fair share.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+from .registry import AdapterError, AdapterSpec, get_adapter_catalog
+from .segmented import SegmentOperands, build_operands, compose_operands
+
+
+def _metrics():
+    from ..telemetry.instruments import (
+        adapter_cache_bytes,
+        adapter_cache_evictions_total,
+        adapter_cache_lookups_total,
+    )
+
+    return (
+        adapter_cache_lookups_total(),
+        adapter_cache_evictions_total(),
+        adapter_cache_bytes(),
+    )
+
+
+class AdapterOperandCache:
+    """Byte-budgeted LRU: plan-part key → SegmentOperands.
+
+    Keys carry the content hash, the target-map digest, and the active
+    rank-bucket set — flipping any knob or file content can never serve
+    stale operands. ``contains_hash`` is the admission-time peek (no
+    LRU promotion: admission must not distort eviction order)."""
+
+    def __init__(self, budget_bytes: int | None = None) -> None:
+        if budget_bytes is None:
+            from ..utils.constants import adapter_cache_mb
+
+            budget_bytes = int(adapter_cache_mb() * 1024 * 1024)
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._lock = threading.Lock()
+        # key → (operands, content hashes backing the entry)
+        self._entries: "OrderedDict[tuple, tuple[SegmentOperands, tuple[str, ...]]]" = (
+            OrderedDict()
+        )
+        # content hash → resident entry count (admission peek)
+        self._hash_refs: dict[str, int] = {}
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _note_bytes(self) -> None:
+        lookups, evictions, gauge = _metrics()
+        del lookups, evictions
+        gauge.set(float(self.bytes))
+
+    def _evict_until_fits(self) -> None:
+        while self.bytes > self.budget_bytes and len(self._entries) > 1:
+            _, (ops, hashes) = self._entries.popitem(last=False)
+            self.bytes -= ops.nbytes
+            self.evictions += 1
+            for digest in hashes:
+                refs = self._hash_refs.get(digest, 0) - 1
+                if refs <= 0:
+                    self._hash_refs.pop(digest, None)
+                else:
+                    self._hash_refs[digest] = refs
+            _metrics()[1].inc()
+
+    def get_or_build(
+        self,
+        key: tuple,
+        hashes: tuple[str, ...],
+        builder: Callable[[], SegmentOperands],
+    ) -> tuple[SegmentOperands, bool]:
+        """Return (operands, was_hit). The builder runs OUTSIDE the
+        lock (safetensors decode can take a while; concurrent jobs for
+        other adapters must not serialize behind it) — a racing build
+        of the same key keeps the first inserted entry."""
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                _metrics()[0].inc(outcome="hit")
+                return cached[0], True
+        ops = builder()
+        lookups, _, _ = _metrics()
+        with self._lock:
+            raced = self._entries.get(key)
+            if raced is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                lookups.inc(outcome="hit")
+                return raced[0], True
+            self.misses += 1
+            lookups.inc(outcome="miss")
+            if ops.nbytes <= self.budget_bytes:
+                self._entries[key] = (ops, tuple(hashes))
+                self.bytes += ops.nbytes
+                for digest in hashes:
+                    self._hash_refs[digest] = self._hash_refs.get(digest, 0) + 1
+                self._evict_until_fits()
+            self._note_bytes()
+        return ops, False
+
+    def contains_hash(self, content_hash: str) -> bool:
+        with self._lock:
+            return self._hash_refs.get(content_hash, 0) > 0
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": int(self.bytes),
+                "budget_bytes": int(self.budget_bytes),
+                "hits": int(self.hits),
+                "misses": int(self.misses),
+                "evictions": int(self.evictions),
+            }
+
+
+_CACHE: AdapterOperandCache | None = None
+_CACHE_LOCK = threading.Lock()
+
+
+def get_adapter_cache() -> AdapterOperandCache:
+    global _CACHE
+    with _CACHE_LOCK:
+        if _CACHE is None:
+            _CACHE = AdapterOperandCache()
+        return _CACHE
+
+
+def _reset_adapter_cache_for_tests() -> None:
+    global _CACHE
+    with _CACHE_LOCK:
+        _CACHE = None
+
+
+def _paths_digest(target_map: dict) -> tuple[str, ...]:
+    return tuple(sorted(path for path, _ in target_map.values()))
+
+
+def operands_for_plan(
+    specs: list[AdapterSpec],
+    target_map: dict,
+    *,
+    catalog: Any = None,
+    cache: AdapterOperandCache | None = None,
+) -> SegmentOperands:
+    """Resolved plan → device-ready operands, through the cache.
+
+    Per-adapter operands cache under (hash, target map, bucket set) —
+    strength-INDEPENDENT, so a tenant sweeping strengths reuses one
+    entry. A single adapter rides its strength as the traced scale; a
+    stack folds strengths at compose time (scale 1.0) — either way the
+    compiled program is the same."""
+    if not specs:
+        raise AdapterError("operands_for_plan: empty plan")
+    catalog = catalog or get_adapter_catalog()
+    cache = cache or get_adapter_cache()
+    from .segmented import rank_buckets
+
+    buckets = rank_buckets()
+    digest = _paths_digest(target_map)
+    parts: list[SegmentOperands] = []
+    for spec in specs:
+        if not spec.content_hash:
+            raise AdapterError(
+                f"adapter {spec.name!r} has no content hash (unresolved plan)"
+            )
+        key = ("one", spec.content_hash, digest, buckets)
+        ops, _ = cache.get_or_build(
+            key,
+            (spec.content_hash,),
+            lambda spec=spec: build_operands(
+                catalog.load_state_dict(spec.name),
+                target_map,
+                fingerprint=spec.content_hash,
+            ),
+        )
+        parts.append(ops)
+    if len(parts) == 1:
+        return parts[0]._replace(scale=float(specs[0].strength))
+    return compose_operands(parts, [float(s.strength) for s in specs])
+
+
+def adapter_admission_cost(hashes: Any) -> float:
+    """DRR admission multiplier for a plan's content hashes: 1.0 when
+    the knob is off, the plan is empty, or every adapter's operands
+    are resident; CDT_ADAPTER_COLD_COST otherwise. Advisory — errors
+    here must never block admission (same contract as the PR-15
+    measured-cost seam)."""
+    try:
+        hashes = tuple(hashes or ())
+        if not hashes:
+            return 1.0
+        from ..utils.constants import adapter_cold_cost
+
+        cost = float(adapter_cold_cost())
+        if cost == 1.0:
+            return 1.0
+        cache = get_adapter_cache()
+        if all(cache.contains_hash(h) for h in hashes):
+            return 1.0
+        return cost
+    except Exception:  # noqa: BLE001 - advisory seam
+        return 1.0

@@ -1,0 +1,276 @@
+"""Adapter catalog + identity: request names → content-hashed sources.
+
+Requests name adapters (``adapters: [{name, strength}]`` in the queue
+payload); everything downstream of admission speaks the blake2b
+content hash instead. The hash is the identity that joins the PR-17
+tile cache key, the xjob batch signature, and usage attribution — two
+files with the same *name* but different bytes must never alias, and a
+renamed copy of the same bytes must (operand-cache-wise) dedup.
+
+Resolution follows the LoraLoader convention (graph/nodes_core):
+absolute path, or ``CDT_LORA_DIR/<name>[.safetensors]``. Tests, chaos
+drivers and the smoke job register in-memory state dicts instead
+(``register_memory``) so no real checkpoint files are needed.
+
+Workers re-resolve names against their OWN catalog and verify the
+master-stamped hash matches before sampling: a fleet with divergent
+adapter files fails loudly (AdapterError) instead of producing wrong
+pixels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+import threading
+from dataclasses import dataclass, replace
+from typing import Any
+
+import numpy as np
+
+# Bound per-request adapter stacks: operand concat grows the effective
+# rank additively, and MAX * largest-rank-bucket must stay inside the
+# bucket set (segmented.compose_operands re-buckets the concat).
+MAX_ADAPTERS_PER_REQUEST = 4
+
+_HASH_BYTES = 16  # 32 hex chars; short enough for wire + signatures
+
+
+class AdapterError(ValueError):
+    """Invalid adapter request (unknown name, bad spec, hash mismatch,
+    unsupported rank). Routes map it to HTTP 400 at admission; workers
+    treat a mid-job instance as a hard job failure — an unresolved
+    adapter must never silently sample the base model."""
+
+
+@dataclass(frozen=True)
+class AdapterSpec:
+    """One requested adapter: the wire-level unit of the plan.
+
+    ``content_hash`` is empty until the catalog stamps it (``resolve``);
+    every surface past admission requires it stamped.
+    """
+
+    name: str
+    strength: float = 1.0
+    content_hash: str = ""
+
+
+def parse_adapter_specs(raw: Any) -> list[AdapterSpec]:
+    """Validate the request-payload ``adapters`` field → specs.
+
+    Accepts None/[] (no adapters), a list of ``{"name": ..,
+    "strength": ..}`` dicts, or bare name strings (strength 1.0).
+    Raises AdapterError naming the offending field — the queue route
+    surfaces it as a 400.
+    """
+    if raw is None:
+        return []
+    if not isinstance(raw, (list, tuple)):
+        raise AdapterError("adapters must be a list of {name, strength}")
+    if len(raw) > MAX_ADAPTERS_PER_REQUEST:
+        raise AdapterError(
+            f"adapters lists at most {MAX_ADAPTERS_PER_REQUEST} entries "
+            f"(got {len(raw)})"
+        )
+    specs: list[AdapterSpec] = []
+    seen: set[str] = set()
+    for i, entry in enumerate(raw):
+        if isinstance(entry, str):
+            entry = {"name": entry}
+        if not isinstance(entry, dict):
+            raise AdapterError(f"adapters[{i}] must be an object or string")
+        name = entry.get("name")
+        if not isinstance(name, str) or not name.strip():
+            raise AdapterError(f"adapters[{i}].name must be a non-empty string")
+        name = name.strip()
+        if name in seen:
+            raise AdapterError(f"adapters[{i}].name {name!r} repeats")
+        seen.add(name)
+        strength = entry.get("strength", 1.0)
+        if isinstance(strength, bool) or not isinstance(strength, (int, float)):
+            raise AdapterError(f"adapters[{i}].strength must be a number")
+        strength = float(strength)
+        if not math.isfinite(strength):
+            raise AdapterError(f"adapters[{i}].strength must be finite")
+        content_hash = entry.get("content_hash", "")
+        if not isinstance(content_hash, str):
+            raise AdapterError(f"adapters[{i}].content_hash must be a string")
+        specs.append(AdapterSpec(name, strength, content_hash))
+    return specs
+
+
+def specs_to_wire(specs: list[AdapterSpec]) -> list[dict[str, Any]]:
+    """Specs → JSON-able wire form (job journal, job_status response)."""
+    return [
+        {
+            "name": s.name,
+            "strength": float(s.strength),
+            "content_hash": s.content_hash,
+        }
+        for s in specs
+    ]
+
+
+def specs_from_wire(raw: Any) -> list[AdapterSpec]:
+    """Wire form → specs. Same validation as the request parser (the
+    journal and the master's job_status answer both replay through
+    here), so a corrupt record raises instead of sampling wrong."""
+    return parse_adapter_specs(raw)
+
+
+def adapter_plan_key(specs: list[AdapterSpec]) -> tuple:
+    """The canonical content identity of a RESOLVED plan:
+    ``((content_hash, strength), ...)`` in request order. This exact
+    tuple is what joins the PR-17 cache key (``adapter_fingerprint``)
+    and the operand-cache key — strength is output-affecting, order is
+    output-affecting (stacked adapters do not commute bit-wise), both
+    are in. Empty tuple = no adapters = legacy key."""
+    for s in specs:
+        if not s.content_hash:
+            raise AdapterError(
+                f"adapter {s.name!r} has no content hash (unresolved plan)"
+            )
+    return tuple((s.content_hash, float(s.strength)) for s in specs)
+
+
+def _hash_state_dict(state: dict[str, np.ndarray]) -> str:
+    """Canonical content hash of an in-memory kohya state dict: sorted
+    key order, dtype + shape + C-order bytes per tensor — the same
+    identity a safetensors round-trip of the dict would produce
+    byte-wise, without depending on file framing."""
+    h = hashlib.blake2b(digest_size=_HASH_BYTES)
+    for key in sorted(state):
+        arr = np.asarray(state[key])
+        h.update(key.encode("utf-8"))
+        h.update(b"\x00")
+        h.update(str(arr.dtype).encode("ascii"))
+        h.update(b"\x00")
+        h.update(",".join(str(d) for d in arr.shape).encode("ascii"))
+        h.update(b"\x00")
+        h.update(np.ascontiguousarray(arr).tobytes())
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+def _hash_file(path: str) -> str:
+    h = hashlib.blake2b(digest_size=_HASH_BYTES)
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class AdapterCatalog:
+    """name → source registry with cached content hashes.
+
+    Explicit registrations (file or memory) win over the implicit
+    ``CDT_LORA_DIR`` scan; the scan itself is sorted (CDT004: listing
+    order must never reach behavior)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> ("file", path) | ("memory", state_dict)
+        self._entries: dict[str, tuple[str, Any]] = {}
+        self._hashes: dict[str, str] = {}
+
+    # --- registration -------------------------------------------------
+
+    def register_file(self, name: str, path: str) -> None:
+        if not os.path.exists(path):
+            raise AdapterError(f"adapter file not found: {path}")
+        with self._lock:
+            self._entries[str(name)] = ("file", str(path))
+            self._hashes.pop(str(name), None)
+
+    def register_memory(self, name: str, state: dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._entries[str(name)] = ("memory", dict(state))
+            self._hashes.pop(str(name), None)
+
+    def names(self) -> list[str]:
+        """Sorted catalog listing: explicit registrations + the
+        CDT_LORA_DIR scan (stems of *.safetensors)."""
+        found = set()
+        root = os.environ.get("CDT_LORA_DIR", "")
+        if root and os.path.isdir(root):
+            for entry in sorted(os.listdir(root)):
+                if entry.endswith(".safetensors"):
+                    found.add(entry[: -len(".safetensors")])
+        with self._lock:
+            found.update(self._entries)
+        return sorted(found)
+
+    # --- resolution ---------------------------------------------------
+
+    def _source(self, name: str) -> tuple[str, Any]:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is not None:
+            return entry
+        # LoraLoader path convention (graph/nodes_core)
+        path = name
+        if not os.path.isabs(path):
+            root = os.environ.get("CDT_LORA_DIR", "")
+            candidate = os.path.join(root, path) if root else path
+            if not os.path.exists(candidate) and not candidate.endswith(
+                ".safetensors"
+            ):
+                candidate += ".safetensors"
+            path = candidate
+        if not os.path.exists(path):
+            raise AdapterError(f"unknown adapter {name!r}")
+        return ("file", path)
+
+    def content_hash(self, name: str) -> str:
+        with self._lock:
+            cached = self._hashes.get(name)
+        if cached is not None:
+            return cached
+        kind, source = self._source(name)
+        digest = (
+            _hash_file(source) if kind == "file" else _hash_state_dict(source)
+        )
+        with self._lock:
+            self._hashes[name] = digest
+        return digest
+
+    def load_state_dict(self, name: str) -> dict[str, np.ndarray]:
+        kind, source = self._source(name)
+        if kind == "memory":
+            return dict(source)
+        from ..models.lora import read_lora
+
+        return read_lora(source)
+
+    def resolve(self, specs: list[AdapterSpec]) -> list[AdapterSpec]:
+        """Stamp content hashes onto specs. A spec arriving WITH a hash
+        (worker side: the master stamped it) is verified against the
+        local resolution — a mismatch means this host's file differs
+        from the master's and the job must fail, not sample wrong."""
+        resolved: list[AdapterSpec] = []
+        for spec in specs:
+            digest = self.content_hash(spec.name)
+            if spec.content_hash and spec.content_hash != digest:
+                raise AdapterError(
+                    f"adapter {spec.name!r} content mismatch: master has "
+                    f"{spec.content_hash}, this host resolved {digest}"
+                )
+            resolved.append(replace(spec, content_hash=digest))
+        return resolved
+
+
+_CATALOG = AdapterCatalog()
+
+
+def get_adapter_catalog() -> AdapterCatalog:
+    return _CATALOG
+
+
+def _reset_adapter_catalog_for_tests() -> None:
+    global _CATALOG
+    _CATALOG = AdapterCatalog()

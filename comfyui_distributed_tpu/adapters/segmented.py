@@ -1,0 +1,325 @@
+"""Segmented batched LoRA application (S-LoRA / Punica transplanted).
+
+``models/lora.py`` merges an adapter into the weights at load time —
+correct, but it forks one params tree (and on the xjob tier one
+compiled program) per adapter. This module instead carries the adapter
+as per-slot *operands*: for every targetable kernel ``W`` ([I, O]
+layout) a pair ``down`` [r_b, I] / ``up`` [O, r_b] with the kohya
+``alpha/rank`` scale pre-folded into ``down``, so one denoise step
+computes
+
+    x @ (W + scale * down.T @ up.T)  ==  x@W + scale * (x@down.T)@up.T
+
+— the S-LoRA identity. Operands are zero-padded to a small bounded
+rank-bucket set (``CDT_ADAPTER_RANK_BUCKETS``) and cover the FULL
+target map (zeros where the adapter doesn't touch), which makes the
+operand pytree structure a pure function of (model config, rank
+bucket): tiles wearing *different* adapters stack into one vmapped
+device batch and share ONE compiled program per
+(stepwise signature, rank bucket). Zero padding is exact — a padded
+rank row contributes ``0·(x@0)`` — so bucketing never changes numerics.
+
+Adapter-less jobs never enter this path at all: their signature (and
+program) is the unmodified stepwise one, which is what keeps them
+bit-identical to the pre-adapter repo end-to-end.
+
+Scope: the diffusion backbone (``unet`` part) only. Text-encoder
+conditioning is computed upstream of the USDU tile loop, so ``lora_te*``
+components cannot act on the batched tier; they are skipped here
+(callers log the skip) and remain the merged loader's job.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from .registry import AdapterError
+
+_DEFAULT_RANK_BUCKETS = "4,8,16,32,64"
+
+
+def rank_buckets() -> tuple[int, ...]:
+    """The bounded rank-bucket set (CDT_ADAPTER_RANK_BUCKETS). One
+    compiled program exists per (signature, bucket) — the set is the
+    compile-count bound, exactly like ops/upscale.grant_buckets is for
+    batch widths."""
+    import os
+
+    raw = os.environ.get("CDT_ADAPTER_RANK_BUCKETS", _DEFAULT_RANK_BUCKETS)
+    try:
+        vals = sorted({int(v) for v in raw.split(",") if v.strip()})
+    except ValueError as exc:
+        raise AdapterError(
+            f"CDT_ADAPTER_RANK_BUCKETS must be comma-separated ints: {raw!r}"
+        ) from exc
+    if not vals or vals[0] <= 0:
+        raise AdapterError(
+            f"CDT_ADAPTER_RANK_BUCKETS must be positive ints: {raw!r}"
+        )
+    return tuple(vals)
+
+
+def rank_bucket_for(rank: int, buckets: tuple[int, ...] | None = None) -> int:
+    """Smallest bucket >= rank; AdapterError past the largest (an
+    unsupported rank must fail at admission, not at trace time)."""
+    buckets = rank_buckets() if buckets is None else buckets
+    for b in buckets:
+        if rank <= b:
+            return b
+    raise AdapterError(
+        f"adapter rank {rank} exceeds the largest rank bucket "
+        f"{buckets[-1]} (CDT_ADAPTER_RANK_BUCKETS)"
+    )
+
+
+class SegmentOperands(NamedTuple):
+    """One resolved plan's device-ready operands.
+
+    ``paths``/``downs``/``ups`` are index-aligned; paths are sorted
+    full param paths (``unet/params/.../kernel``) spanning the WHOLE
+    target map so the pytree structure is adapter-independent.
+    ``scale`` is the strength that rides as a traced per-slot scalar
+    (1.0 when strengths were folded in by ``compose_operands``)."""
+
+    paths: tuple[str, ...]
+    downs: tuple[np.ndarray, ...]  # each [rank_bucket, I], float32
+    ups: tuple[np.ndarray, ...]  # each [O, rank_bucket], float32
+    scale: float
+    rank_bucket: int
+    nbytes: int
+    fingerprint: str
+
+
+def bundle_target_map(bundle: Any) -> dict[str, tuple[str, tuple[int, int]]]:
+    """{kohya module name: (full param path, (I, O))} for every
+    backbone kernel a LoRA can target on this bundle. Derived from the
+    same ``lora_target_map`` schedule the merged loader uses (one
+    naming source of truth), filtered to leaves actually present in
+    ``bundle.params['unet']`` with 2-D kernels."""
+    from ..models import get_config
+    from ..models.lora import _flatten_leaves, lora_target_map
+
+    try:
+        targets = lora_target_map(get_config(bundle.model_name))
+    except ValueError as exc:
+        raise AdapterError(str(exc)) from exc
+    flat: dict[str, Any] = {}
+    _flatten_leaves(bundle.params.get("unet", {}), flat)
+    out: dict[str, tuple[str, tuple[int, int]]] = {}
+    for name in sorted(targets):
+        part, path = targets[name]
+        if part != "unet":
+            continue
+        leaf = flat.get(path)
+        if leaf is None or len(getattr(leaf, "shape", ())) != 2:
+            continue
+        out[name] = (f"unet/{path}", (int(leaf.shape[0]), int(leaf.shape[1])))
+    return out
+
+
+def build_operands(
+    state_dict: dict[str, np.ndarray],
+    target_map: dict[str, tuple[str, tuple[int, int]]],
+    bucket: int | None = None,
+    *,
+    fingerprint: str = "",
+) -> SegmentOperands:
+    """Decode one kohya state dict into rank-bucketed operands.
+
+    ``alpha/rank`` folds into ``down`` here (operand build is per
+    adapter, cached) so the traced step multiplies by strength only.
+    Modules outside the target map (``lora_te*``, unknown names, shape
+    mismatches) are skipped — the batched tier is backbone-only; the
+    count is logged by callers via the returned zero rows being absent.
+    """
+    from ..models.lora import parse_lora
+    from ..utils.logging import debug_log
+
+    modules = parse_lora(state_dict)
+    per_path: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    skipped: list[str] = []
+    max_rank = 0
+    for name in sorted(modules):
+        payload = modules[name]
+        target = target_map.get(name)
+        if target is None or "down" not in payload or "up" not in payload:
+            skipped.append(name)
+            continue
+        path, (dim_in, dim_out) = target
+        down = np.asarray(payload["down"], np.float32)
+        up = np.asarray(payload["up"], np.float32)
+        if down.ndim == 4:  # conv1x1-style LoRA on projection layers
+            down = down[:, :, 0, 0]
+            up = up[:, :, 0, 0]
+        rank = int(down.shape[0])
+        if (
+            down.ndim != 2
+            or up.ndim != 2
+            or down.shape[1] != dim_in
+            or up.shape != (dim_out, rank)
+        ):
+            skipped.append(name)
+            continue
+        alpha = float(payload.get("alpha", rank))
+        per_path[path] = ((alpha / rank) * down, up)
+        max_rank = max(max_rank, rank)
+    if skipped:
+        debug_log(
+            f"adapter operands: skipped {len(skipped)} non-backbone/"
+            f"mismatched module(s) (first: {skipped[0]})"
+        )
+    if bucket is None:
+        bucket = rank_bucket_for(max(1, max_rank))
+    elif max_rank > bucket:
+        raise AdapterError(
+            f"adapter rank {max_rank} exceeds requested bucket {bucket}"
+        )
+    paths = tuple(sorted(path for path, _ in target_map.values()))
+    shapes = {path: shape for path, shape in target_map.values()}
+    downs: list[np.ndarray] = []
+    ups: list[np.ndarray] = []
+    for path in paths:
+        dim_in, dim_out = shapes[path]
+        pair = per_path.get(path)
+        down = np.zeros((bucket, dim_in), np.float32)
+        up = np.zeros((dim_out, bucket), np.float32)
+        if pair is not None:
+            down[: pair[0].shape[0]] = pair[0]
+            up[:, : pair[1].shape[1]] = pair[1]
+        downs.append(down)
+        ups.append(up)
+    nbytes = sum(a.nbytes for a in downs) + sum(a.nbytes for a in ups)
+    return SegmentOperands(
+        paths=paths,
+        downs=tuple(downs),
+        ups=tuple(ups),
+        scale=1.0,
+        rank_bucket=int(bucket),
+        nbytes=int(nbytes),
+        fingerprint=str(fingerprint),
+    )
+
+
+def compose_operands(
+    parts: list[SegmentOperands], strengths: list[float]
+) -> SegmentOperands:
+    """Stack multiple adapters into ONE operand pair per path by
+    concatenating along the rank axis with each adapter's strength
+    folded into its ``down`` slice:
+
+        up_cat @ diag-free concat(down_i * s_i)  ==  Σ s_i · up_i @ down_i
+
+    so the traced step stays the single-pair program (scale rides 1.0).
+    The concat re-buckets to cover the summed rank."""
+    if not parts:
+        raise AdapterError("compose_operands needs at least one adapter")
+    if len(parts) != len(strengths):
+        raise AdapterError("compose_operands: strengths/parts length mismatch")
+    paths = parts[0].paths
+    for ops in parts[1:]:
+        if ops.paths != paths:
+            raise AdapterError(
+                "compose_operands: adapters were built against different "
+                "target maps"
+            )
+    total = sum(ops.rank_bucket for ops in parts)
+    bucket = rank_bucket_for(total)
+    downs: list[np.ndarray] = []
+    ups: list[np.ndarray] = []
+    for i, path in enumerate(paths):
+        down = np.concatenate(
+            [float(s) * ops.downs[i] for ops, s in zip(parts, strengths)],
+            axis=0,
+        )
+        up = np.concatenate([ops.ups[i] for ops in parts], axis=1)
+        pad = bucket - down.shape[0]
+        if pad:
+            down = np.concatenate(
+                [down, np.zeros((pad, down.shape[1]), np.float32)], axis=0
+            )
+            up = np.concatenate(
+                [up, np.zeros((up.shape[0], pad), np.float32)], axis=1
+            )
+        downs.append(np.ascontiguousarray(down, np.float32))
+        ups.append(np.ascontiguousarray(up, np.float32))
+    nbytes = sum(a.nbytes for a in downs) + sum(a.nbytes for a in ups)
+    return SegmentOperands(
+        paths=paths,
+        downs=tuple(downs),
+        ups=tuple(ups),
+        scale=1.0,
+        rank_bucket=int(bucket),
+        nbytes=int(nbytes),
+        fingerprint="+".join(ops.fingerprint for ops in parts),
+    )
+
+
+def _with_leaf(tree: Any, parts: tuple[str, ...], leaf: Any) -> Any:
+    """Copy-on-write nested dict update (shares every untouched
+    subtree — a few-leaf patch neither copies nor re-uploads the rest)."""
+    if not parts:
+        return leaf
+    new = dict(tree)
+    new[parts[0]] = _with_leaf(tree[parts[0]], parts[1:], leaf)
+    return new
+
+
+def apply_segment_delta(params, paths, downs, ups, scale):
+    """``W ← (W_f32 + scale · down.T @ up.T).astype(W.dtype)`` on each
+    targeted leaf. Pure (copy-on-write), jnp-traceable: inside the
+    executor's vmapped step the operands are per-lane (in_axes=0) while
+    ``params`` stays broadcast, so only the targeted leaves batch."""
+    import jax.numpy as jnp
+
+    patched = params
+    for path, down, up in zip(paths, downs, ups):
+        parts = tuple(path.split("/"))
+        leaf = params
+        for part in parts:
+            leaf = leaf[part]
+        delta = jnp.matmul(down.T, up.T)  # [I, O] kernel layout
+        new = (leaf.astype(jnp.float32) + scale * delta).astype(leaf.dtype)
+        patched = _with_leaf(patched, parts, new)
+    return patched
+
+
+def make_adapter_step(step_one, paths: tuple[str, ...]):
+    """Adapter-aware arity of a stepwise ``step``: 3 extra traced
+    operands (downs, ups, scale) patch the targeted leaves before the
+    base step runs. ``paths`` is static — it is part of the extended
+    batch signature, so one wrapped program per (signature, bucket)."""
+
+    def step(params, x, key, pos, neg, yx, i, downs, ups, scale):
+        return step_one(
+            apply_segment_delta(params, paths, downs, ups, scale),
+            x, key, pos, neg, yx, i,
+        )
+
+    return step
+
+
+def patch_params(params, operands: SegmentOperands, scale: float | None = None):
+    """Whole-grant eager variant (the elastic scan tier): every tile of
+    the grant wears the same plan, so patch once and sample with the
+    unchanged compiled process (same shapes → no recompile)."""
+    s = float(operands.scale if scale is None else scale)
+    return apply_segment_delta(
+        params, operands.paths, operands.downs, operands.ups, s
+    )
+
+
+def adapter_signature(base_signature: tuple, operands: SegmentOperands) -> tuple:
+    """Extend a stepwise batching signature with the adapter plane's
+    compile-relevant identity: rank bucket + target-path-set digest.
+    Strength and adapter CONTENT are absent by design — they are traced
+    operands, which is exactly why N distinct same-rank adapters share
+    one program."""
+    digest = hashlib.blake2b(
+        "\n".join(operands.paths).encode("utf-8"), digest_size=8
+    ).hexdigest()
+    return tuple(base_signature) + (
+        ("adapter", int(operands.rank_bucket), digest),
+    )

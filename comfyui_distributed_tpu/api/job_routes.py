@@ -66,6 +66,28 @@ class JobRoutes:
         except QueueRequestError as exc:
             return web.json_response({"error": str(exc)}, status=400)
 
+        if payload.adapters:
+            # Resolve adapter names → content hashes NOW, against the
+            # master's catalog: an unknown adapter is a client error at
+            # admission, never a mid-job worker failure. The stamped
+            # hashes are the identity every downstream surface keys on.
+            from ..adapters import AdapterError, get_adapter_catalog
+
+            try:
+                payload.adapters = get_adapter_catalog().resolve(
+                    payload.adapters
+                )
+            except AdapterError as exc:
+                return web.json_response({"error": str(exc)}, status=400)
+        if payload.lane is None:
+            # Budget tenants with no explicit lane ride the cheap lane
+            # (CDT_CHEAP_LANE — the GGUF-quantized tier's admission
+            # class, models/gguf.quantized_lane_info).
+            from ..utils.constants import budget_tenants, cheap_lane
+
+            if payload.tenant in budget_tenants():
+                payload.lane = cheap_lane()
+
         import asyncio
 
         from ..scheduler import (

@@ -126,6 +126,16 @@ async def _orchestrate(
             else payload.lane
         )
         server.job_store.note_job_priority(job_id, lane, payload.tenant)
+        if payload.adapters:
+            # the API→store adapter seam (same shape as deadline/
+            # priority above): the resolved plan parks until the
+            # executor's init_tile_job stamps it onto the job, from
+            # where job_status serves it to pulling workers
+            from ...adapters import specs_to_wire
+
+            server.job_store.note_job_adapters(
+                job_id, specs_to_wire(payload.adapters)
+            )
 
     enabled_ids = [str(w.get("id")) for w in active]
     prep_sem = asyncio.Semaphore(settings.get("prep_concurrency", 4))

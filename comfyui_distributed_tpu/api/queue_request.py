@@ -38,6 +38,11 @@ class QueueRequestPayload:
     # (body field `deadline_s` or the `X-CDT-Deadline` header): gates
     # admission, rides the job record, and expires overdue work.
     deadline_s: float | None = None
+    # Adapter plan: [{"name", "strength"}] — per-request LoRA
+    # personalization (adapters/). Validated here; the queue route
+    # resolves names to content hashes against the catalog before the
+    # plan rides the job record (docs/personalization.md).
+    adapters: list[Any] = dataclasses.field(default_factory=list)
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
@@ -96,6 +101,13 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
 
     deadline_s = parse_deadline_seconds(body.get("deadline_s"))
 
+    from ..adapters import AdapterError, parse_adapter_specs
+
+    try:
+        adapter_specs = parse_adapter_specs(body.get("adapters"))
+    except AdapterError as exc:
+        raise QueueRequestError(str(exc)) from exc
+
     return QueueRequestPayload(
         prompt=prompt,
         client_id=client_id,
@@ -104,6 +116,7 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
         tenant=tenant,
         lane=lane,
         deadline_s=deadline_s,
+        adapters=adapter_specs,
         extra={
             k: v
             for k, v in body.items()
@@ -117,6 +130,7 @@ def parse_queue_request_payload(body: Any) -> QueueRequestPayload:
                 "tenant",
                 "lane",
                 "deadline_s",
+                "adapters",
             )
         },
     )

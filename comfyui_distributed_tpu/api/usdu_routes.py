@@ -434,6 +434,10 @@ class UsduRoutes:
                 # preemption; `preempt` mirrors the pull-path flag
                 "lane": job.lane,
                 "tenant": job.tenant,
+                # adapter plane: the resolved wire plan ([{name,
+                # strength, content_hash}]) — pulling workers resolve
+                # it against their local catalog and hash-verify
+                "adapters": job.adapters,
                 "preempt": job.preempt_requested,
             }
         )

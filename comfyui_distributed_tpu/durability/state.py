@@ -63,6 +63,7 @@ def new_state() -> dict[str, Any]:
 def _new_job(
     kind: str, batched: bool, tasks: list[int],
     deadline_s: Any = None, lane: Any = "", tenant: Any = "default",
+    adapters: Any = None,
 ) -> dict[str, Any]:
     try:
         deadline_s = float(deadline_s) if deadline_s else None
@@ -89,6 +90,10 @@ def _new_job(
         # (checkpoints do NOT — they are volatile; recompute covers)
         "lane": str(lane or ""),
         "tenant": str(tenant or "default"),
+        # --- adapter plane: the resolved wire plan rides job_init so a
+        # recovered master re-serves the exact personalization from
+        # job_status (content hashes included — workers re-verify)
+        "adapters": list(adapters or []),
     }
 
 
@@ -111,6 +116,7 @@ def apply_record(state: dict[str, Any], record: dict[str, Any]) -> None:
                 deadline_s=record.get("deadline_s"),
                 lane=record.get("lane", ""),
                 tenant=record.get("tenant", "default"),
+                adapters=record.get("adapters", []),
             )
         return
     job = jobs.get(str(record.get("job", "")))
@@ -333,6 +339,7 @@ def materialize(state: dict[str, Any]):
         job.cached_tiles = {int(t) for t in spec.get("cached", [])}
         job.lane = str(spec.get("lane", "") or "")
         job.tenant = str(spec.get("tenant", "default") or "default")
+        job.adapters = list(spec.get("adapters", []) or [])
         deadline_s = spec.get("deadline_s")
         if deadline_s:
             import time as _time

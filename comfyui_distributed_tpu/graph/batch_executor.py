@@ -121,9 +121,23 @@ class XJobHandle:
         tenant: str = "default",
         lane: str = "",
         priority: int = 0,
+        adapter: Any = None,
     ) -> None:
         self.job_id = str(job_id)
         self.proc = proc
+        # Adapter plane (adapters/segmented.SegmentOperands | None):
+        # when set, this job's tiles sample with the per-slot low-rank
+        # patch and batch under the EXTENDED signature — rank bucket +
+        # target-path digest — so same-bucket jobs wearing *different*
+        # adapters still share one compiled program, while adapter-less
+        # jobs keep the unmodified signature (and bit-identity).
+        self.adapter = adapter
+        if adapter is not None:
+            from ..adapters import adapter_signature
+
+            self.sig = adapter_signature(proc.signature, adapter)
+        else:
+            self.sig = proc.signature
         self.params = params
         self.extracted = extracted
         self.positions = positions
@@ -283,7 +297,7 @@ class CrossJobExecutor:
             self._job_seq += 1
             job.seq = self._job_seq
             self._jobs[job.job_id] = job
-            sig = job.proc.signature
+            sig = job.sig
             if sig not in self._items:
                 self._items[sig] = []
                 self._sig_order.append(sig)
@@ -307,19 +321,38 @@ class CrossJobExecutor:
 
     # --- device programs --------------------------------------------------
 
-    def _vstep(self, sig: tuple, step_one: Callable) -> Callable:
+    def _vstep(
+        self,
+        sig: tuple,
+        step_one: Callable,
+        adapter_paths: Optional[tuple] = None,
+    ) -> Callable:
         """The batched one-step program for a signature: vmapped over
         (x, key, pos, neg, yx, i) with params shared. Jitted only when
         the per-item step is itself compiled (production) — raw Python
         stubs stay eager so the chaos parity suite's bit-identity
         against the serial path survives XLA's batch-size-specific
-        rewrites (the PR 5 jit-vs-eager ulp hazard)."""
+        rewrites (the PR 5 jit-vs-eager ulp hazard).
+
+        ``adapter_paths`` (adapter-extended signatures only) grows the
+        arity by per-slot (downs, ups, scale) operands applied as a
+        low-rank weight patch inside each lane: params broadcast, only
+        the targeted leaves batch. The jit gate stays on the UNDERLYING
+        step — the adapter wrapper is plain Python on top of it."""
         cached = self._vstep_cache.get(sig)
         if cached is not None:
             return cached
         import jax
 
-        vmapped = jax.vmap(step_one, in_axes=(None, 0, 0, 0, 0, 0, 0))
+        if adapter_paths is not None:
+            from ..adapters import make_adapter_step
+
+            wrapped = make_adapter_step(step_one, adapter_paths)
+            vmapped = jax.vmap(
+                wrapped, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+            )
+        else:
+            vmapped = jax.vmap(step_one, in_axes=(None, 0, 0, 0, 0, 0, 0))
         fn = jax.jit(vmapped) if hasattr(step_one, "lower") else vmapped
         self._vstep_cache[sig] = fn
         return fn
@@ -377,7 +410,7 @@ class CrossJobExecutor:
         idxs = [int(t) for t in (grant.get("tile_idxs") or [])]
         checkpoints = grant.get("checkpoints") or {}
         added = 0
-        sig = job.proc.signature
+        sig = job.sig
         for tile_idx in idxs:
             self._item_seq += 1
             item = _Item(job, tile_idx, self._item_seq)
@@ -474,7 +507,7 @@ class CrossJobExecutor:
         uninitialized items release bare. The release callback routes
         through the master's requeue path, so the tiles are pullable
         by (or after) the premium work immediately."""
-        sig = job.proc.signature
+        sig = job.sig
         items = [it for it in self._items.get(sig, []) if it.job is job]
         if not items:
             return
@@ -531,9 +564,7 @@ class CrossJobExecutor:
         them — never lose its _items list to a prune that decided
         before it registered."""
         with self._lock:
-            alive = any(
-                j.proc.signature == sig for j in self._jobs.values()
-            )
+            alive = any(j.sig == sig for j in self._jobs.values())
             if alive or self._items.get(sig):
                 return
             self._items.pop(sig, None)
@@ -550,7 +581,7 @@ class CrossJobExecutor:
         with self._lock:
             self._jobs.pop(job.job_id, None)
         self._drop_job_eviction_marks(job.job_id)
-        self._prune_signature(job.proc.signature)
+        self._prune_signature(job.sig)
         job.finished.set()
 
     def _fail_job(self, job: XJobHandle, exc: BaseException) -> None:
@@ -559,7 +590,7 @@ class CrossJobExecutor:
         it from the executor; other jobs keep batching."""
         job.error = exc
         debug_log(f"xjob job {job.job_id} failed: {exc!r}")
-        sig = job.proc.signature
+        sig = job.sig
         items = [it for it in self._items.get(sig, []) if it.job is job]
         self._items[sig] = [it for it in self._items.get(sig, []) if it.job is not job]
         orphaned = sorted({it.tile_idx for it in items} | set(job.claimed))
@@ -570,7 +601,7 @@ class CrossJobExecutor:
         with self._lock:
             self._jobs.pop(job.job_id, None)
         self._drop_job_eviction_marks(job.job_id)
-        self._prune_signature(job.proc.signature)
+        self._prune_signature(job.sig)
         job.finished.set()
 
     # --- the scheduling round ---------------------------------------------
@@ -627,7 +658,7 @@ class CrossJobExecutor:
         import jax.numpy as jnp
         import jax.tree_util as jtu
 
-        sig = batch[0].job.proc.signature
+        sig = batch[0].job.sig
         n = len(batch)
         bucket = self._bucket_for(n)
         padded = [batch[i % n] for i in range(bucket)]
@@ -647,10 +678,42 @@ class CrossJobExecutor:
             axis=0,
         )
         steps = jnp.asarray([it.step for it in padded], jnp.int32)
-        xs, keys, poss, negs, yxs, steps = self._place(
-            (xs, keys, poss, negs, yxs, steps)
+        # Adapter plane: every batch-mate shares the extended signature
+        # (same rank bucket + target-path set), so per-slot operands
+        # stack into [B, r_b, I] / [B, O, r_b] stacks per targeted leaf
+        # — each lane samples under ITS OWN job's low-rank patch while
+        # the base params stay a single broadcast copy. Adapter-less
+        # batches never reach this branch (their signature is the
+        # unmodified stepwise tuple).
+        adapter = batch[0].job.adapter
+        if adapter is not None:
+            downs = tuple(
+                jnp.stack(
+                    [it.job.adapter.downs[k] for it in padded], axis=0
+                )
+                for k in range(len(adapter.paths))
+            )
+            ups = tuple(
+                jnp.stack([it.job.adapter.ups[k] for it in padded], axis=0)
+                for k in range(len(adapter.paths))
+            )
+            scales = jnp.asarray(
+                [it.job.adapter.scale for it in padded], jnp.float32
+            )
+            xs, keys, poss, negs, yxs, steps, downs, ups, scales = (
+                self._place(
+                    (xs, keys, poss, negs, yxs, steps, downs, ups, scales)
+                )
+            )
+        else:
+            xs, keys, poss, negs, yxs, steps = self._place(
+                (xs, keys, poss, negs, yxs, steps)
+            )
+        fn = self._vstep(
+            sig,
+            batch[0].job.proc.step,
+            adapter.paths if adapter is not None else None,
         )
-        fn = self._vstep(sig, batch[0].job.proc.step)
         # slot-exact attribution: one entry per device slot of the
         # padded bucket, classified BEFORE the step advances — a real
         # item re-running steps below its eviction mark is recompute
@@ -690,8 +753,15 @@ class CrossJobExecutor:
             recompute=sum(
                 1 for s in slots if s["kind"] == SLOT_RECOMPUTE
             ),
+            adapter=adapter is not None,
         ):
-            out = fn(params, xs, keys, poss, negs, yxs, steps)
+            if adapter is not None:
+                out = fn(
+                    params, xs, keys, poss, negs, yxs, steps,
+                    downs, ups, scales,
+                )
+            else:
+                out = fn(params, xs, keys, poss, negs, yxs, steps)
             if device and ledger is not None:
                 # profiling wants honest device-execute wall: JAX
                 # dispatch is async, so block inside the bracket
@@ -716,6 +786,10 @@ class CrossJobExecutor:
         self.slots_real += n
         self.slots_padded += bucket - n
         batch_fill_ratio().set(n / bucket, role=self.role)
+        if adapter is not None:
+            from ..telemetry.instruments import adapter_slots_total
+
+            adapter_slots_total().inc(n, role=self.role)
         pipeline_batches_total().inc(role=self.role, bucket=str(bucket))
         if bucket > n:
             pipeline_padded_tiles_total().inc(bucket - n, role=self.role)
@@ -766,7 +840,7 @@ class CrossJobExecutor:
                 except BaseException as exc:  # noqa: BLE001 - per-job isolation
                     self._fail_job(job, exc)
             else:
-                self._items.setdefault(job.proc.signature, []).append(item)
+                self._items.setdefault(job.sig, []).append(item)
 
     @staticmethod
     def _to_host(result):
@@ -1020,6 +1094,27 @@ def run_worker_xjob(
     if not client.poll_ready():
         raise WorkerError(f"job {job_id} never became ready", worker_id)
 
+    # Adapter plane: the readiness poll carried the job's resolved wire
+    # plan. Re-resolve against the LOCAL catalog — resolve() verifies
+    # the master-stamped content hashes against local bytes, so a
+    # divergent checkpoint fails loudly here instead of sampling wrong
+    # pixels — then build the rank-bucketed per-slot operands (served
+    # from the process adapter cache).
+    adapter = None
+    adapter_wire = getattr(client, "adapters", None) or []
+    if adapter_wire:
+        from ..adapters import (
+            bundle_target_map,
+            get_adapter_catalog,
+            operands_for_plan,
+            specs_from_wire,
+        )
+        from ..telemetry.instruments import adapter_jobs_total
+
+        specs = get_adapter_catalog().resolve(specs_from_wire(adapter_wire))
+        adapter = operands_for_plan(specs, bundle_target_map(bundle))
+        adapter_jobs_total().inc(tier="xjob")
+
     pending: list[dict] = []
     pending_bytes = 0
 
@@ -1094,6 +1189,7 @@ def run_worker_xjob(
         preempt_check=lambda: bool(getattr(client, "preempt_requested", False)),
         heartbeat=client.heartbeat,
         check_interrupted=check_abort,
+        adapter=adapter,
     )
     shared = get_shared_executor()
     executor = shared.executor(
@@ -1187,12 +1283,41 @@ def run_master_xjob(
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 
+    # Adapter plane: the orchestration parked the resolved wire plan in
+    # the store (note_job_adapters) — peek it (non-destructive; the
+    # init below pops + journals it) and build this master's own
+    # operands. The plan key joins the cache key: flipping ONLY the
+    # adapter hash or strength must flip every tile key.
+    adapter = None
+    adapter_key = None
+    adapter_wire = run_async_in_server_loop(
+        store.peek_job_adapters(job_id), timeout=30
+    )
+    if adapter_wire:
+        from ..adapters import (
+            adapter_plan_key,
+            bundle_target_map,
+            get_adapter_catalog,
+            operands_for_plan,
+            specs_from_wire,
+        )
+        from ..telemetry.instruments import adapter_jobs_total
+
+        adapter_specs = get_adapter_catalog().resolve(
+            specs_from_wire(adapter_wire)
+        )
+        adapter_key = adapter_plan_key(adapter_specs)
+        adapter = operands_for_plan(adapter_specs, bundle_target_map(bundle))
+        adapter_jobs_total().inc(tier="xjob")
+
     # --- content-addressed tile cache (cache/), CDT_CACHE=1 ----------
     # The xjob tier keys on the JOB-FOLDED base key (_prep_xjob's
     # fold_job_key): its tile outputs depend on job_id, so entries can
     # only dedup a re-run of the SAME job (crash/requeue/retry) —
     # never across jobs. The per-tile key derivation is otherwise
-    # identical to the elastic tier's.
+    # identical to the elastic tier's. UNPATCHED params on purpose:
+    # the adapter's identity enters through `adapter=` (the plan key),
+    # so the params fingerprint stays one hash per checkpoint.
     from ..cache import bind_job_cache, job_key_context, tile_keys_for
     from ..utils.constants import USAGE_ENABLED
 
@@ -1204,6 +1329,7 @@ def run_master_xjob(
                 cfg=cfg, denoise=denoise, upscale_by=upscale_by,
                 upscale_method=upscale_method, mask_blur=mask_blur,
                 uniform=uniform, tiled_decode=tiled_decode,
+                adapter=adapter_key,
             ),
             extracted, grid,
         )
@@ -1338,6 +1464,7 @@ def run_master_xjob(
             release=release,
             preempt_check=preempt_check,
             check_interrupted=check_abort,
+            adapter=adapter,
         )
 
     shared = get_shared_executor()

@@ -165,6 +165,11 @@ class HTTPWorkClient:
         # Remaining end-to-end deadline (seconds) as of the last pull
         # response; None = no deadline on this job.
         self.deadline_remaining: Optional[float] = None
+        # Adapter plane: the job's resolved wire plan ([{name, strength,
+        # content_hash}]) captured from the readiness poll. The worker
+        # re-resolves it against its local catalog (hash-verified) and
+        # samples with the segmented/patched params. [] = base model.
+        self.adapters: list = []
         self.failovers = 0
         # Heartbeat backoff state (consecutive failures → suppression
         # window); guarded by nothing — heartbeats run on one thread
@@ -296,6 +301,7 @@ class HTTPWorkClient:
             )
             if not out.get("ready"):
                 raise WorkerError(f"job {self.job_id} not ready", self.worker_id)
+            self.adapters = list(out.get("adapters") or [])
             return True
 
         async def poll():
@@ -762,6 +768,37 @@ def run_worker_loop(
     if warm is not None:
         warm.join()
 
+    # Adapter plane (whole-grant variant): the readiness poll carried
+    # the job's resolved wire plan. Re-resolve against the LOCAL
+    # catalog — resolve() hash-verifies master-stamped hashes against
+    # local bytes, failing loudly on divergence — then patch the
+    # weights once and rebuild the sampler around them. Shapes/dtypes
+    # are unchanged, so the warmup's compiled processor is reused.
+    adapter_wire = getattr(client, "adapters", None) or []
+    if adapter_wire:
+        from ..adapters import (
+            bundle_target_map,
+            get_adapter_catalog,
+            operands_for_plan,
+            patch_params as _adapter_patch,
+            specs_from_wire,
+        )
+        from ..telemetry.instruments import adapter_jobs_total
+
+        adapter_specs = get_adapter_catalog().resolve(
+            specs_from_wire(adapter_wire)
+        )
+        adapter_ops = operands_for_plan(
+            adapter_specs, bundle_target_map(bundle)
+        )
+        params = _adapter_patch(params, adapter_ops)
+        grant_sampler = GrantSampler(
+            process, params, extracted, key, positions, pos, neg,
+            k_max=tile_scan_batch() * data_width, role="worker", mesh=mesh,
+            job_id=job_id,
+        )
+        adapter_jobs_total().inc(tier="elastic")
+
     pending: list[dict] = []
     pending_bytes = 0
 
@@ -1024,10 +1061,42 @@ def run_master_elastic(
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 
+    # Adapter plane: the orchestration parked the resolved wire plan in
+    # the store — peek it (non-destructive; init_tile_job pops +
+    # journals it) and build the whole-grant operands for this master's
+    # own sampling. The plan key joins the cache key below; the PATCHED
+    # params feed only the GrantSampler.
+    adapter_ops = None
+    adapter_key = None
+    adapter_wire = run_async_in_server_loop(
+        store.peek_job_adapters(job_id), timeout=30
+    )
+    if adapter_wire:
+        from ..adapters import (
+            adapter_plan_key,
+            bundle_target_map,
+            get_adapter_catalog,
+            operands_for_plan,
+            specs_from_wire,
+        )
+        from ..telemetry.instruments import adapter_jobs_total
+
+        adapter_specs = get_adapter_catalog().resolve(
+            specs_from_wire(adapter_wire)
+        )
+        adapter_key = adapter_plan_key(adapter_specs)
+        adapter_ops = operands_for_plan(
+            adapter_specs, bundle_target_map(bundle)
+        )
+        adapter_jobs_total().inc(tier="elastic")
+
     # --- content-addressed tile cache (cache/), CDT_CACHE=1 ----------
     # The elastic tier keys on the UNFOLDED base key jax.random.key(seed):
     # per-tile keys fold only the global tile index, so two jobs (any
     # tenant) with identical sampler inputs dedup against each other.
+    # UNPATCHED params on purpose: the adapter's identity enters
+    # through `adapter=` (the plan key), keeping one params fingerprint
+    # per checkpoint while flipping every tile key per plan.
     from ..cache import bind_job_cache, job_key_context, tile_keys_for
     from ..utils.constants import USAGE_ENABLED
 
@@ -1039,6 +1108,7 @@ def run_master_elastic(
                 cfg=cfg, denoise=denoise, upscale_by=upscale_by,
                 upscale_method=upscale_method, mask_blur=mask_blur,
                 uniform=uniform, tiled_decode=tiled_decode,
+                adapter=adapter_key,
             ),
             extracted, grid,
         )
@@ -1150,8 +1220,17 @@ def run_master_elastic(
         store.note_worker_capacity("master", master_data_width)
 
     run_async_in_server_loop(_note_master_capacity())
+    # Whole-grant adapter application (the scan tier's simpler variant):
+    # every tile of every grant wears the same plan, so patch the
+    # weights ONCE — same shapes/dtypes, so the compiled tile processor
+    # is reused — and sample with the unchanged program.
+    master_params = bundle.params
+    if adapter_ops is not None:
+        from ..adapters import patch_params as _adapter_patch
+
+        master_params = _adapter_patch(master_params, adapter_ops)
     grant_sampler = GrantSampler(
-        process, bundle.params, extracted, key, positions, pos, neg,
+        process, master_params, extracted, key, positions, pos, neg,
         k_max=tile_scan_batch() * master_data_width, role="master",
         mesh=mesh, job_id=job_id,
     )

@@ -90,6 +90,12 @@ class TileJob:
     # fair-share satellite splits worker service time by owning job.
     lane: str = ""
     tenant: str = "default"
+    # Resolved adapter plan (wire form: [{"name", "strength",
+    # "content_hash"}], adapters/registry.specs_to_wire). Journaled
+    # with job_init and served verbatim from job_status so pulling
+    # workers learn — and hash-verify — the personalization this job
+    # must sample with. Empty list = base model (the legacy path).
+    adapters: list = dataclasses.field(default_factory=list)
     # Preemption request raised by the scheduler coordinator: pulls for
     # this job read as drained (outcome="preempted") and executors
     # evict its in-flight tiles at the next step boundary, requeueing

@@ -81,6 +81,26 @@ def _note_usage_job_attrs(job_id: str, tenant: str, lane: str) -> None:
         debug_log(f"usage attrs note failed: {exc}")
 
 
+def _note_usage_job_adapter(job_id: str, adapters: list) -> None:
+    """Feed the usage meter's job → adapter-plan attribution map. The
+    plan id is the compact ``hash:strength`` join — stable across the
+    job's lifetime, human-greppable in usage reports."""
+    from ..utils.constants import USAGE_ENABLED
+
+    if not USAGE_ENABLED or not adapters:
+        return
+    try:
+        from ..telemetry.usage import get_usage_meter
+
+        plan_id = "+".join(
+            f"{a.get('content_hash', '')}:{float(a.get('strength', 1.0)):g}"
+            for a in adapters
+        )
+        get_usage_meter().note_job_adapter(job_id, plan_id)
+    except Exception as exc:  # noqa: BLE001 - observability only
+        debug_log(f"usage adapter note failed: {exc}")
+
+
 class JobStore:
     def __init__(
         self,
@@ -124,6 +144,10 @@ class JobStore:
         # (the API-to-store priority seam for the preemption
         # coordinator); same bound discipline.
         self._pending_priorities: dict[str, tuple[str, str]] = {}
+        # job_id → resolved adapter plan (wire form) noted by
+        # orchestration the same way (the API-to-store adapter seam,
+        # adapters/); same bound discipline.
+        self._pending_adapters: dict[str, list] = {}
         # Preemption coordinator (scheduler/preempt.py): consulted
         # AFTER init/cleanup/cancel commit (awaited outside the journal
         # emission, inside the server loop). None = no preemption.
@@ -427,11 +451,47 @@ class JobStore:
             self._pending_priorities.pop(next(iter(self._pending_priorities)))
         self._pending_priorities[job_id] = (lane, tenant)
 
+    def note_job_adapters(self, job_id: str, adapters: Any) -> None:
+        """Record a resolved adapter plan (wire form) for a job that
+        has not been initialized yet — the orchestration seam, exactly
+        like ``note_job_deadline``. Malformed plans are dropped here
+        (the route already validated; this guards direct callers) so a
+        bad record can never reach a worker."""
+        from ..adapters import AdapterError, specs_from_wire
+
+        try:
+            specs = specs_from_wire(adapters)
+        except AdapterError as exc:
+            debug_log(f"note_job_adapters({job_id}) rejected: {exc}")
+            return
+        if not specs:
+            self._pending_adapters.pop(job_id, None)
+            return
+        self._pending_adapters.pop(job_id, None)
+        while len(self._pending_adapters) >= self._max_pending_deadlines:
+            self._pending_adapters.pop(next(iter(self._pending_adapters)))
+        from ..adapters import specs_to_wire
+
+        self._pending_adapters[job_id] = specs_to_wire(specs)
+
+    async def peek_job_adapters(self, job_id: str) -> list:
+        """Non-destructive read of a job's adapter plan: the stamped
+        job record when it exists, else the pending note. Master
+        entries consult this BEFORE init_tile_job (they need operands
+        and the cache key up front); init still pops the pending map
+        atomically with creation."""
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is not None:
+                return list(job.adapters)
+            return list(self._pending_adapters.get(job_id, []))
+
     async def init_tile_job(
         self, job_id: str, task_ids: list[int], batched: bool = True,
         kind: str = "tile", deadline_s: Optional[float] = None,
         lane: Optional[str] = None, tenant: Optional[str] = None,
         cache_settled: Optional[list[int]] = None,
+        adapters: Optional[list] = None,
     ) -> TileJob:
         """Create the job. ``cache_settled`` settles those tiles from
         the content-addressed cache ATOMICALLY with creation (same lock
@@ -455,10 +515,14 @@ class JobStore:
             )
             lane = str(lane) if lane is not None else noted_lane
             tenant = str(tenant) if tenant is not None else noted_tenant
+            noted_adapters = self._pending_adapters.pop(job_id, [])
+            if adapters is None:
+                adapters = noted_adapters
             cls = TileJob if kind == "tile" else ImageJob
             job = cls(job_id=job_id, total_tasks=len(task_ids), batched=batched)
             job.lane = lane
             job.tenant = tenant or "default"
+            job.adapters = list(adapters or [])
             if deadline_s is not None and deadline_s > 0:
                 job.deadline_s = float(deadline_s)
                 job.deadline_at = time.monotonic() + float(deadline_s)
@@ -472,6 +536,7 @@ class JobStore:
                     "deadline_s": job.deadline_s,
                     "lane": job.lane,
                     "tenant": job.tenant,
+                    "adapters": job.adapters,
                 }
             )
             for tid in task_ids:
@@ -495,6 +560,7 @@ class JobStore:
         # authoritative tenant/lane for the attribution plane (lands on
         # top of the executors' advisory registration attrs)
         _note_usage_job_attrs(job_id, job.tenant, job.lane)
+        _note_usage_job_adapter(job_id, job.adapters)
         self._notify_grants(job_id, len(task_ids) - len(settled_at_init))
         # Preemption seam: a premium-lane arrival may evict running
         # lower-lane work. Awaited AFTER the init committed (the

@@ -197,6 +197,48 @@ def read_gguf(path: str) -> dict[str, np.ndarray]:
     return out
 
 
+# --- quantized cheap lane (budget tenants) --------------------------------
+#
+# The adapter plane's budget story: tenants named in CDT_BUDGET_TENANTS
+# are routed to CDT_CHEAP_LANE at the queue route (api/job_routes.py),
+# and the checkpoints registered here are the quantized variants that
+# lane is expected to serve — smaller HBM footprint, cheaper per-tile,
+# same key schedules as the full-precision files (GGUF tensor names are
+# the original state-dict names).
+
+_QUANTIZED_CHECKPOINTS: dict[str, str] = {}
+
+
+def register_quantized_checkpoint(name: str, path: str) -> None:
+    """Register a GGUF-quantized checkpoint under a model name so the
+    cheap lane's loaders (and the `quantized_lane_info` surface) can
+    find it. Re-registering a name overwrites (latest wins)."""
+    _QUANTIZED_CHECKPOINTS[str(name)] = str(path)
+
+
+def quantized_checkpoint_path(name: str) -> str | None:
+    return _QUANTIZED_CHECKPOINTS.get(str(name))
+
+
+def quantized_lane_info() -> dict[str, Any]:
+    """The budget-routing surface: which lane budget tenants land on,
+    which tenants are routed, and which quantized checkpoints are
+    registered to serve them. Consumed by the queue route's lane
+    resolution (api/job_routes.py) and by docs/observability — pure
+    read, never raises."""
+    from ..utils.constants import budget_tenants, cheap_lane
+
+    return {
+        "lane": cheap_lane(),
+        "tenants": list(budget_tenants()),
+        "checkpoints": dict(sorted(_QUANTIZED_CHECKPOINTS.items())),
+    }
+
+
+def _reset_quantized_registry_for_tests() -> None:
+    _QUANTIZED_CHECKPOINTS.clear()
+
+
 # --- writer (tests / export) ---------------------------------------------
 
 def _quantize(arr: np.ndarray, gtype: int) -> bytes:

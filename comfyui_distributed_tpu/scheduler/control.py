@@ -123,6 +123,7 @@ class SchedulerControl:
         except (TypeError, ValueError):
             pass
         cost *= self._measured_cost_ratio(payload.tenant)
+        cost *= self._adapter_cost(payload)
         self._note_admitted_cost(payload.tenant, cost / tiles)
         return self.queue.submit(
             tenant=payload.tenant,
@@ -153,6 +154,23 @@ class SchedulerControl:
         gap = tiles * per_tile
         self.unsettled_admission_cost += gap
         return gap
+
+    def _adapter_cost(self, payload: Any) -> float:
+        """The CDT_ADAPTER_COLD_COST multiplier: a request whose
+        adapter plan is NOT resident in the host operand cache pays a
+        cold surcharge at DRR admission — the decode + operand build
+        it will trigger is real work the fair-share meter should see.
+        1.0 (off by default) when the knob is unset, the request wears
+        no adapters, or every adapter is warm. Advisory: a broken
+        cache peek must never fail admission."""
+        from ..adapters import adapter_admission_cost
+
+        specs = getattr(payload, "adapters", None) or []
+        hashes = [
+            getattr(s, "content_hash", "") for s in specs
+            if getattr(s, "content_hash", "")
+        ]
+        return adapter_admission_cost(hashes)
 
     def _measured_cost_ratio(self, tenant: str) -> float:
         """The CDT_USAGE_COST multiplier: the tenant's measured

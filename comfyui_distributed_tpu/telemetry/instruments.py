@@ -552,6 +552,52 @@ def cache_unsettled_admission_cost() -> Gauge:
     )
 
 
+# --- adapter plane (adapters/) ---------------------------------------------
+
+def adapter_cache_lookups_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_adapter_cache_lookups_total",
+        "Adapter operand-cache lookups by outcome (hit|miss) — a miss "
+        "means a safetensors decode + operand layout ran on the host "
+        "(docs/operator-runbook.md §adapter thrashing)",
+        ("outcome",),
+    )
+
+
+def adapter_cache_evictions_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_adapter_cache_evictions_total",
+        "Adapter operand entries evicted by the byte-budget LRU "
+        "(CDT_ADAPTER_CACHE_MB); sustained growth alongside misses = "
+        "the working set exceeds the budget (thrashing)",
+    )
+
+
+def adapter_cache_bytes() -> Gauge:
+    return get_metrics_registry().gauge(
+        "cdt_adapter_cache_bytes",
+        "Resident bytes of decoded adapter operands in the host LRU",
+    )
+
+
+def adapter_slots_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_adapter_slots_total",
+        "Real device-batch slots that ran wearing an adapter "
+        "(segmented application); ratio against cdt_tiles_processed "
+        "slots is perf_report's segmented-slot share",
+        ("role",),
+    )
+
+
+def adapter_jobs_total() -> Counter:
+    return get_metrics_registry().counter(
+        "cdt_adapter_jobs_total",
+        "Jobs admitted carrying a non-empty adapter plan",
+        ("tier",),
+    )
+
+
 # --- device-time profiling plane (telemetry/profiling.py) ------------------
 
 def transfer_bytes_total() -> Counter:

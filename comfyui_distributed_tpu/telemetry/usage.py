@@ -159,6 +159,10 @@ class UsageMeter:
         # job_id -> (tenant, lane): fed by the store (authoritative) and
         # the executors (advisory); bounded like the job maps
         self._attrs: dict[str, tuple[str, str]] = {}
+        # job_id -> adapter plan id ("hash@strength[+...]"): the
+        # adapter plane's attribution join — metering a personalized
+        # job must say WHICH personalization burned the chip time
+        self._adapter_attrs: dict[str, str] = {}
         # role -> reason -> ns
         self._waste: dict[str, dict[str, int]] = {}
         # the `cached` bucket: tiles settled from the tile cache and the
@@ -199,6 +203,27 @@ class UsageMeter:
     def job_attrs(self, job_id: str) -> tuple[str, str]:
         with self._lock:
             return self._attrs.get(str(job_id), (DEFAULT_TENANT, ""))
+
+    def note_job_adapter(self, job_id: str, adapter_id: Any) -> None:
+        """Record a job's adapter plan id (adapters/registry
+        ``adapter_plan_key`` rendered compactly); "" clears. Bounded
+        with the same oldest-inserted rule as the attrs map."""
+        job_id = str(job_id)
+        adapter_id = str(adapter_id or "")
+        with self._lock:
+            if not adapter_id:
+                self._adapter_attrs.pop(job_id, None)
+                return
+            if (
+                job_id not in self._adapter_attrs
+                and len(self._adapter_attrs) >= self.max_keys
+            ):
+                self._adapter_attrs.pop(next(iter(self._adapter_attrs)))
+            self._adapter_attrs[job_id] = adapter_id
+
+    def job_adapter(self, job_id: str) -> str:
+        with self._lock:
+            return self._adapter_attrs.get(str(job_id), "")
 
     # --- recording --------------------------------------------------------
 
@@ -370,6 +395,7 @@ class UsageMeter:
             for job_id in sorted(set(evicted)):
                 if job_id not in live:
                     self._attrs.pop(job_id, None)
+                    self._adapter_attrs.pop(job_id, None)
         return evicted
 
     # --- export -----------------------------------------------------------
@@ -507,6 +533,7 @@ class UsageMeter:
         with self._lock:
             tenants: dict[str, dict[str, Any]] = {}
             lanes: dict[str, dict[str, Any]] = {}
+            adapters: dict[str, dict[str, Any]] = {}
             jobs_out: dict[str, dict[str, Any]] = {}
             for role in sorted(self._jobs):
                 if roles is not None and role not in roles:
@@ -516,6 +543,13 @@ class UsageMeter:
                     tenant, lane = self._attrs.get(
                         job_id, (DEFAULT_TENANT, "")
                     )
+                    adapter_id = self._adapter_attrs.get(job_id, "")
+                    if adapter_id:
+                        ad = adapters.setdefault(
+                            adapter_id, {"chip_s": 0.0, "tiles": 0}
+                        )
+                        ad["chip_s"] += _s(entry.chip_ns)
+                        ad["tiles"] += entry.tiles
                     t = tenants.setdefault(
                         tenant, {"chip_s": 0.0, "tiles": 0, "steps": 0,
                                  "waste_s": 0.0, "cached_tiles": 0}
@@ -532,7 +566,8 @@ class UsageMeter:
                     ln["tiles"] += entry.tiles
                     job_out = jobs_out.setdefault(
                         job_id,
-                        {"tenant": tenant, "lane": lane, "chip_s": 0.0,
+                        {"tenant": tenant, "lane": lane,
+                         "adapter": adapter_id, "chip_s": 0.0,
                          "tiles": 0, "steps": 0, "waste_s": 0.0,
                          "cached_tiles": 0, "roles": []},
                     )
@@ -568,6 +603,7 @@ class UsageMeter:
         return {
             "tenants": {t: tenants[t] for t in sorted(tenants)},
             "lanes": {ln: lanes[ln] for ln in sorted(lanes)},
+            "adapters": {a: adapters[a] for a in sorted(adapters)},
             "jobs": jobs_out,
             "totals": {
                 "chip_s": total_chip,

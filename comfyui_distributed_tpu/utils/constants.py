@@ -405,6 +405,38 @@ def cache_dir() -> str | None:
     return raw
 
 
+# --- adapter plane (adapters/) --------------------------------------------
+# All resolved at CALL time (tests monkeypatch the env). The rank
+# bucket set itself lives in adapters/segmented.rank_buckets (it
+# validates + sorts); these are the cache/cost readers.
+
+
+def adapter_cache_mb() -> float:
+    """Host-RAM byte budget (MB) for decoded adapter operands
+    (adapters/cache.AdapterOperandCache); strict LRU past it."""
+    return _env_float("CDT_ADAPTER_CACHE_MB", 256.0)
+
+
+def adapter_cold_cost() -> float:
+    """DRR admission cost multiplier charged when a job's adapter
+    operands are NOT resident in the operand cache. 1.0 (default)
+    disables the seam — admission cost is unchanged."""
+    return _env_float("CDT_ADAPTER_COLD_COST", 1.0)
+
+
+def budget_tenants() -> tuple[str, ...]:
+    """Comma-separated tenant list routed to the cheap lane when their
+    request names no explicit lane (models/gguf quantized tiers are
+    the cheap lane's intended capacity)."""
+    raw = os.environ.get("CDT_BUDGET_TENANTS", "")
+    return tuple(sorted({t.strip() for t in raw.split(",") if t.strip()}))
+
+
+def cheap_lane() -> str:
+    """Lane name budget tenants route to (default: background)."""
+    return os.environ.get("CDT_CHEAP_LANE", "background").strip() or "background"
+
+
 # --- live event stream (telemetry/events.py) ------------------------------
 # Per-subscriber bounded queue size for /distributed/events; a consumer
 # slower than the event rate loses its OLDEST events (drop-oldest) and

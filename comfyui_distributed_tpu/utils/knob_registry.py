@@ -344,6 +344,24 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_CACHE_RAM_MB", "256.0", "cache",
          "Host-RAM LRU byte budget in MB; an entry larger than the "
          "whole budget is stored disk-only."),
+    # --- adapter plane ---------------------------------------------------
+    Knob("CDT_ADAPTER_CACHE_MB", "256.0", "adapters",
+         "Host-RAM LRU byte budget in MB for decoded adapter operands "
+         "(per-adapter rank-bucketed down/up pairs)."),
+    Knob("CDT_ADAPTER_COLD_COST", "1.0", "adapters",
+         "DRR admission cost multiplier for requests whose adapter plan "
+         "is not resident in the operand cache; 1.0 disables the cold "
+         "surcharge."),
+    Knob("CDT_ADAPTER_RANK_BUCKETS", "4,8,16,32,64", "adapters",
+         "Comma-separated rank-bucket set adapters zero-pad to; one "
+         "compiled program exists per (batch signature, bucket), so the "
+         "set bounds adapter-induced compile count."),
+    Knob("CDT_BUDGET_TENANTS", "empty", "adapters",
+         "Comma-separated tenant ids routed to the cheap lane at the "
+         "queue route when their request names no explicit lane."),
+    Knob("CDT_CHEAP_LANE", "background", "adapters",
+         "The lane CDT_BUDGET_TENANTS route to (the lane GGUF-quantized "
+         "checkpoints are registered to serve)."),
     # --- incident plane --------------------------------------------------
     Knob("CDT_FLIGHT", "1", "incidents",
          "`0` disables the always-on flight recorder (the bus tap that "

@@ -1,0 +1,322 @@
+#!/usr/bin/env python3
+"""Adapter-plane smoke: the CI `adapter-smoke` job's driver.
+
+One mixed-tenant pass through the adapter plane (docs/personalization.md)
+asserting its load-bearing properties:
+
+1. **slot isolation, bit-exact** — three jobs wearing three DIFFERENT
+   LoRA adapters plus one adapter-less job share the cross-job
+   executor's batches, and every job's tiles are bit-identical to
+   sampling that job alone;
+2. **one program per rank bucket** — all three adapter jobs carry the
+   SAME extended signature (content is a traced operand, not a compile
+   key), the adapter-less job keeps the unmodified base signature, so
+   the whole fleet compiles exactly two device programs;
+3. **adapter-less jobs are untouched** — the base job's batched canvas
+   equals a run on a fleet with no adapter anywhere (the plane adds
+   zero risk to jobs that don't opt in);
+4. **tier parity** — the elastic tier's whole-grant `patch_params`
+   application produces the same samples as the xjob tier's segmented
+   per-slot patch for the same adapter + strength;
+5. **conservation holds under personalization** — the run's usage
+   meter attributes every dispatch nanosecond (attributed + waste +
+   overhead == measured, `totals.conserved`) and each adapter plan
+   shows up in the rollup's adapters section;
+6. **operand cache behaves** — first resolution decodes (3 misses),
+   a strength sweep re-resolves every plan from the LRU (operands are
+   strength-independent), and the `cdt_adapter_*` instruments are
+   live in the metrics registry after the run.
+
+Writes the stats JSON (uploaded as a CI artifact) to the path given
+as argv[1] (default: adapter-smoke.json). Exit 0 = every assertion
+held. Runs on CPU; forcing multiple host devices is fine but not
+required — the executor batches on one device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_ADAPTERS = 3
+N_TILES = 2
+STEPS = 4
+DIM = 3
+RANK = 2
+
+
+def check(condition: bool, label: str, detail=None) -> None:
+    if not condition:
+        raise SystemExit(f"adapter-smoke FAILED: {label}: {detail!r}")
+    print(f"  ok: {label}")
+
+
+def build_fixtures():
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.adapters.registry import AdapterCatalog
+
+    target_map = {"lora_unet_dense": ("unet/dense/kernel", (DIM, DIM))}
+    params = {
+        "unet": {"dense": {"kernel": jnp.eye(DIM, dtype=jnp.float32) * 0.9}}
+    }
+    catalog = AdapterCatalog()
+    for i in range(N_ADAPTERS):
+        rng = np.random.default_rng(2000 + i)
+        catalog.register_memory(
+            f"smoke-style-{i}",
+            {
+                "lora_unet_dense.lora_down.weight": (
+                    0.1 * rng.normal(size=(RANK, DIM))
+                ).astype(np.float32),
+                "lora_unet_dense.lora_up.weight": (
+                    0.1 * rng.normal(size=(DIM, RANK))
+                ).astype(np.float32),
+                "lora_unet_dense.alpha": np.float32(RANK),
+            },
+        )
+
+    def step(p, x, key, pos, neg, yx, i):
+        w = p["unet"]["dense"]["kernel"]
+        ki = jax.random.fold_in(key, i)
+        return (
+            jnp.einsum("hwc,cd->hwd", x, w)
+            + 0.01 * jax.random.normal(ki, x.shape)
+            + 0.001 * pos
+        )
+
+    proc = types.SimpleNamespace(
+        init=lambda p, tile, key: tile + 0.0,
+        step=jax.jit(step),
+        finish=lambda p, x: jnp.clip(x, -10.0, 10.0),
+        n_steps=STEPS,
+        signature=("adapter-smoke-stub",),
+    )
+    return target_map, params, catalog, proc
+
+
+class _Master:
+    def __init__(self, n_tiles):
+        self.pending = list(range(n_tiles))
+
+    def pull(self):
+        if not self.pending:
+            return None
+        grant, self.pending = self.pending, []
+        return {"tile_idxs": grant, "checkpoints": {}}
+
+    def release(self, idxs, cks):
+        self.pending = sorted(set(self.pending) | set(idxs))
+
+
+def make_job(job_id, seed, tenant, *, proc, params, adapter):
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.graph.batch_executor import XJobHandle
+    from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+
+    master = _Master(N_TILES)
+    rng = np.random.default_rng(seed)
+    outs: dict[int, np.ndarray] = {}
+    handle = XJobHandle(
+        job_id=job_id,
+        proc=proc,
+        params=params,
+        extracted=jnp.asarray(rng.random((N_TILES, 4, 4, DIM)), jnp.float32),
+        positions=jnp.zeros((N_TILES, 2), jnp.int32),
+        pos=jnp.float32(seed),
+        neg=jnp.float32(0),
+        base_key=fold_job_key(jax.random.key(seed), job_id),
+        pull=master.pull,
+        emit=lambda idx, arr: outs.__setitem__(int(idx), np.asarray(arr)),
+        flush=lambda final: None,
+        release=master.release,
+        tenant=tenant,
+        adapter=adapter,
+    )
+    return handle, outs
+
+
+def solo(job_id, seed, *, proc, params, adapter):
+    from comfyui_distributed_tpu.graph.batch_executor import CrossJobExecutor
+
+    ex = CrossJobExecutor(k_max=8)
+    handle, outs = make_job(
+        job_id, seed, "tenant-a", proc=proc, params=params, adapter=adapter
+    )
+    ex.register(handle)
+    ex.run()
+    return outs
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "adapter-smoke.json"
+
+    from comfyui_distributed_tpu.adapters import AdapterSpec
+    from comfyui_distributed_tpu.adapters.cache import (
+        AdapterOperandCache,
+        operands_for_plan,
+    )
+    from comfyui_distributed_tpu.adapters.segmented import patch_params
+    from comfyui_distributed_tpu.graph.batch_executor import CrossJobExecutor
+    from comfyui_distributed_tpu.telemetry.metrics import get_metrics_registry
+    from comfyui_distributed_tpu.telemetry.usage import UsageMeter
+
+    target_map, params, catalog, proc = build_fixtures()
+    op_cache = AdapterOperandCache()
+
+    def ops_for(i, strength):
+        (resolved,) = catalog.resolve(
+            [AdapterSpec(f"smoke-style-{i}", strength)]
+        )
+        return operands_for_plan(
+            [resolved], target_map, catalog=catalog, cache=op_cache
+        )
+
+    print("xjob tier: 3 distinct adapters + 1 base job, one batch pool")
+    meter = UsageMeter()
+    ex = CrossJobExecutor(k_max=8, usage_meter=meter)
+    fleet = {}
+    sigs = set()
+    for i in range(N_ADAPTERS):
+        handle, outs = make_job(
+            f"smoke-adapter-{i}",
+            300 + i,
+            "tenant-a" if i % 2 == 0 else "tenant-b",
+            proc=proc,
+            params=params,
+            adapter=ops_for(i, 1.0),
+        )
+        ex.register(handle)
+        meter.note_job_adapter(
+            handle.job_id, catalog.content_hash(f"smoke-style-{i}")
+        )
+        fleet[handle.job_id] = (handle, outs)
+        sigs.add(handle.sig)
+    base_handle, base_outs = make_job(
+        "smoke-base", 900, "tenant-b", proc=proc, params=params, adapter=None
+    )
+    ex.register(base_handle)
+    fleet[base_handle.job_id] = (base_handle, base_outs)
+    sigs.add(base_handle.sig)
+    stats = ex.run()
+
+    check(len(sigs) == 2, "two device programs for the whole fleet",
+          sorted(sigs))
+    check(
+        stats["tiles"] == (N_ADAPTERS + 1) * N_TILES,
+        "every tile finished",
+        stats,
+    )
+    first_misses = op_cache.stats()["misses"]
+    check(first_misses == N_ADAPTERS, "one operand decode per adapter",
+          op_cache.stats())
+
+    rollup = meter.rollup()
+    totals = rollup["totals"]
+    check(totals["conserved"], "conservation (exact ns identity)", totals)
+    check(totals["chip_s"] > 0, "nonzero measured chip time", totals)
+    check(
+        len(rollup["adapters"]) == N_ADAPTERS
+        and all(a["tiles"] == N_TILES for a in rollup["adapters"].values()),
+        "every adapter plan attributed in the rollup",
+        rollup["adapters"],
+    )
+
+    for i in range(N_ADAPTERS):
+        jid = f"smoke-adapter-{i}"
+        ref = solo(jid, 300 + i, proc=proc, params=params,
+                   adapter=ops_for(i, 1.0))
+        for t in range(N_TILES):
+            if not np.array_equal(ref[t], fleet[jid][1][t]):
+                raise SystemExit(
+                    f"adapter-smoke FAILED: slot isolation broken: {jid} "
+                    f"tile {t} diverges from its solo run"
+                )
+    print("  ok: slot isolation bit-exact (each worn job == its solo run)")
+
+    base_ref = solo("smoke-base", 900, proc=proc, params=params, adapter=None)
+    for t in range(N_TILES):
+        if not np.array_equal(base_ref[t], base_outs[t]):
+            raise SystemExit(
+                "adapter-smoke FAILED: adapter-less job perturbed by "
+                f"sharing the pool (tile {t})"
+            )
+    print("  ok: adapter-less job bit-identical to a plane-free run")
+
+    print("elastic tier: whole-grant patch_params parity")
+    ops0 = ops_for(0, 0.8)
+    patched = patch_params(params, ops0._replace(scale=1.0), scale=0.8)
+    merged = solo("smoke-adapter-0", 300, proc=proc, params=patched,
+                  adapter=None)
+    segmented = solo("smoke-adapter-0", 300, proc=proc, params=params,
+                     adapter=ops_for(0, 0.8))
+    for t in range(N_TILES):
+        np.testing.assert_allclose(
+            merged[t], segmented[t], rtol=1e-5, atol=1e-6,
+            err_msg=f"tier parity diverged on tile {t}",
+        )
+    print("  ok: merged (elastic) == segmented (xjob) samples")
+
+    print("operand cache: strength sweep must serve from the LRU")
+    before = op_cache.stats()
+    for i in range(N_ADAPTERS):
+        ops_for(i, 0.25)  # new strength, same content → hit
+    after = op_cache.stats()
+    check(after["misses"] == before["misses"],
+          "strength sweep decodes nothing", after)
+    check(after["hits"] >= before["hits"] + N_ADAPTERS,
+          "strength sweep hits per adapter", after)
+
+    rendered = get_metrics_registry().render()
+    for metric in (
+        "cdt_adapter_cache_lookups_total",
+        "cdt_adapter_cache_bytes",
+        "cdt_adapter_slots_total",
+    ):
+        check(metric in rendered, f"{metric} live in the registry")
+
+    report = {
+        "fleet": {
+            "adapters": N_ADAPTERS,
+            "tiles_per_job": N_TILES,
+            "steps": STEPS,
+            "tenants": 2,
+            "device_programs": len(sigs),
+        },
+        "executor": {
+            "dispatches": stats["dispatches"],
+            "tiles": stats["tiles"],
+            "fill_ratio": round(stats["fill_ratio"], 4),
+            "slots_real": stats["slots_real"],
+            "slots_padded": stats["slots_padded"],
+        },
+        "operand_cache": after,
+        "usage": {
+            "conserved": totals["conserved"],
+            "chip_s": totals["chip_s"],
+            "adapters": rollup["adapters"],
+            "tenants": {
+                t: {"chip_s": s["chip_s"], "tiles": s["tiles"]}
+                for t, s in rollup["tenants"].items()
+            },
+        },
+        "bit_identical": True,
+        "tier_parity": True,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(f"adapter smoke OK -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -259,6 +259,49 @@ def batch_fill_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def adapter_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Adapter-plane dispatch share from the executor's per-dispatch
+    spans (graph/batch_executor.py stamps ``adapter=True`` on batches
+    running the segmented per-slot LoRA patch). Reports how many
+    dispatches/slots wore adapters and the fill ratio INSIDE those
+    batches — personalized batches under-filling while base batches
+    stay full is the adapter-thrashing signature (runbook §4p). None
+    when the trace has no adapter dispatches (an adapter-less run
+    stays comparable — absence is not a 0% share)."""
+    dispatches = 0
+    adapter_dispatches = 0
+    adapter_real = 0
+    adapter_slots = 0
+    for span in spans:
+        attrs = span.get("attrs") or {}
+        if attrs.get("stage") != "dispatch":
+            continue
+        try:
+            r = int(attrs.get("real", 0))
+            b = int(attrs.get("bucket", 0))
+        except (TypeError, ValueError):
+            continue
+        if b <= 0:
+            continue
+        dispatches += 1
+        if attrs.get("adapter"):
+            adapter_dispatches += 1
+            adapter_real += r
+            adapter_slots += b
+    if adapter_dispatches == 0:
+        return None
+    return {
+        "dispatches": dispatches,
+        "adapter_dispatches": adapter_dispatches,
+        "adapter_real_tiles": adapter_real,
+        "adapter_slots": adapter_slots,
+        "dispatch_share": adapter_dispatches / dispatches,
+        "adapter_fill": (
+            (adapter_real / adapter_slots) if adapter_slots > 0 else 0.0
+        ),
+    }
+
+
 def cache_stats(spans: list[dict[str, Any]]) -> dict[str, Any] | None:
     """Tile-cache serving rate from the master's probe/hit spans vs
     the dispatch spans: what fraction of this trace's tiles were
@@ -626,6 +669,7 @@ def build_report(spans: list[dict[str, Any]]) -> dict[str, Any]:
         "queue_wait": queue_wait_stats(spans),
         "pipeline_overlap": pipeline_overlap_stats(spans),
         "batch_fill": batch_fill_stats(spans),
+        "adapter": adapter_stats(spans),
         "cache": cache_stats(spans),
         "host_tax": host_tax_stats(spans),
     }
@@ -755,6 +799,25 @@ def compare_reports(
                     "delta_pct": drop_pct,
                 }
             )
+    # adapter fill gates inverted like batch fill, but scoped to the
+    # personalized batches: a DROP means adapter-wearing tiles stopped
+    # sharing programs/batches (a signature or rank-bucket change that
+    # splinters the segmented tier shows up exactly here).
+    old_ad = old_report.get("adapter")
+    new_ad = new_report.get("adapter")
+    if old_ad and new_ad and old_ad["adapter_fill"] > 0:
+        drop_pct = (
+            1.0 - new_ad["adapter_fill"] / old_ad["adapter_fill"]
+        ) * 100.0
+        if drop_pct > regress_pct:
+            regressions.append(
+                {
+                    "stage": "adapter_fill",
+                    "old_p95": old_ad["adapter_fill"],
+                    "new_p95": new_ad["adapter_fill"],
+                    "delta_pct": drop_pct,
+                }
+            )
     # cache hit rate gates inverted too: a DROP means tiles the old
     # trace settled near-free from the content-addressed cache went
     # back to burning device slots (a key-schema change that silently
@@ -797,6 +860,12 @@ def render_comparison(
             )
             continue
         if item["stage"] == "batch_fill":
+            lines.append(
+                f"  {item['stage']:28} fill {item['old_p95']:.3f} -> "
+                f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
+            )
+            continue
+        if item["stage"] == "adapter_fill":
             lines.append(
                 f"  {item['stage']:28} fill {item['old_p95']:.3f} -> "
                 f"{item['new_p95']:.3f} (-{item['delta_pct']:.1f}%)"
@@ -1006,6 +1075,17 @@ def render_text(report: dict[str, Any], tiles, problems) -> str:
             f"{fill['cross_job_dispatches']} cross-job): "
             f"{fill['real_tiles']}/{fill['slots']} "
             f"(fill {fill['fill']:.3f})"
+        )
+    adapter = report.get("adapter")
+    if adapter:
+        lines.append("")
+        lines.append(
+            "adapter plane "
+            f"({adapter['adapter_dispatches']}/{adapter['dispatches']} "
+            f"dispatch(es) personalized, share "
+            f"{adapter['dispatch_share']:.3f}): "
+            f"{adapter['adapter_real_tiles']}/{adapter['adapter_slots']} "
+            f"slots real (fill {adapter['adapter_fill']:.3f})"
         )
     cache = report.get("cache")
     if cache:

@@ -244,6 +244,48 @@ def test_flux_lora_targets_and_apply():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_merged_vs_segmented_parity():
+    """The adapter plane's segmented application (adapters/segmented)
+    must land on the same kernels as the merged loader — the two are
+    interchangeable implementations of the same kohya math. Families
+    covered per-target in tests/test_adapters.py; this pins the
+    cross-module contract from the loader's side with a LoRA touching
+    a Dense attention target and a proj target at once."""
+    from comfyui_distributed_tpu.adapters.segmented import (
+        build_operands,
+        bundle_target_map,
+        patch_params,
+    )
+    from comfyui_distributed_tpu.models.io import flatten_params
+
+    bundle = pl.load_pipeline("tiny-unet", seed=0)
+    target_map = bundle_target_map(bundle)
+    dense = "lora_unet_input_blocks_1_1_transformer_blocks_0_attn1_to_q"
+    proj = "lora_unet_input_blocks_1_1_proj_in"
+    sd = {}
+    for i, name in enumerate((dense, proj)):
+        _, (dim_in, dim_out) = target_map[name]
+        down, up, alpha = _make_lora((dim_in, dim_out), seed=10 + i)
+        sd[f"{name}.lora_down.weight"] = down
+        sd[f"{name}.lora_up.weight"] = up
+        sd[f"{name}.alpha"] = np.float32(alpha)
+    merged, unmatched = lora_mod.apply_lora(
+        {"unet": bundle.params["unet"]}, sd, get_config("tiny-unet"),
+        strength=0.6,
+    )
+    assert unmatched == []
+    patched = patch_params(
+        bundle.params, build_operands(sd, target_map), scale=0.6
+    )
+    merged_flat = flatten_params(jax.device_get(merged["unet"]))
+    patched_flat = flatten_params(jax.device_get(patched["unet"]))
+    for name in (dense, proj):
+        path = target_map[name][0][len("unet/"):]
+        np.testing.assert_allclose(
+            patched_flat[path], merged_flat[path], rtol=1e-5
+        )
+
+
 def test_lora_loader_rejects_non_unet(tmp_path):
     from safetensors.numpy import save_file
 

@@ -209,6 +209,46 @@ class TestFingerprints:
         assert adapter_fingerprint(None) == ""
 
 
+class TestAdapterPlanKey:
+    """The adapter plane's key contract (docs/personalization.md):
+    flipping ONLY an adapter's content hash or strength flips the tile
+    key, and a no-adapter request keys byte-identically to the legacy
+    (pre-adapter-plane) key."""
+
+    @staticmethod
+    def _plan_ctx(plan):
+        # run_master_xjob / run_master_elastic pass adapter_plan_key's
+        # ((content_hash, strength), ...) tuple as `adapter=`; mirror
+        # that exact shape here.
+        return _ctx(adapter_fp=adapter_fingerprint(plan))
+
+    def test_adapter_hash_flip_flips_key(self):
+        a = self._plan_ctx((("aa" * 16, 1.0),))
+        b = self._plan_ctx((("bb" * 16, 1.0),))
+        assert _key(a) != _key(b)
+
+    def test_adapter_strength_flip_flips_key(self):
+        a = self._plan_ctx((("aa" * 16, 1.0),))
+        b = self._plan_ctx((("aa" * 16, 1.25),))
+        assert _key(a) != _key(b)
+
+    def test_plan_order_flips_key(self):
+        # stacked adapters do not commute bit-wise → order is identity
+        a = self._plan_ctx((("aa" * 16, 1.0), ("bb" * 16, 1.0)))
+        b = self._plan_ctx((("bb" * 16, 1.0), ("aa" * 16, 1.0)))
+        assert _key(a) != _key(b)
+
+    def test_same_plan_same_key(self):
+        plan = (("aa" * 16, 0.5), ("bb" * 16, 1.5))
+        assert _key(self._plan_ctx(plan)) == _key(self._plan_ctx(plan))
+
+    def test_no_adapter_key_is_byte_identical_to_legacy(self):
+        # adapter=None (the master passes None for plan-less jobs)
+        # produces the SAME key bytes as the pre-adapter-plane context
+        assert _key(self._plan_ctx(None)) == _key(_ctx())
+        assert _key(self._plan_ctx(None)) == _key(_ctx(adapter_fp=""))
+
+
 class TestSeedFold:
     def test_xjob_fold_differs_per_job(self):
         # fold_job_key mixes job_uid(job_id) into the base key: xjob

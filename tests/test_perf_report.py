@@ -472,7 +472,7 @@ def test_cli_fails_on_missing_or_empty_input(tmp_path):
 
 
 def _dispatch_span(duration, real, bucket, jobs=None, tenants=None,
-                   recompute=0, start=0.0):
+                   recompute=0, start=0.0, adapter=False):
     return {
         "trace_id": "t", "span_id": f"d{start}", "name": "tile.dispatch",
         "start": start, "duration": duration,
@@ -482,8 +482,43 @@ def _dispatch_span(duration, real, bucket, jobs=None, tenants=None,
             "slot_jobs": jobs or {"j": real},
             "slot_tenants": tenants or {},
             "recompute": recompute,
+            "adapter": adapter,
         },
     }
+
+
+def test_adapter_stats_scope_and_share():
+    spans = [
+        _dispatch_span(1.0, 4, 4),                              # base batch
+        _dispatch_span(1.0, 3, 4, start=1.0, adapter=True),     # worn
+        _dispatch_span(1.0, 2, 4, start=2.0, adapter=True),     # worn
+    ]
+    stats = perf_report.adapter_stats(spans)
+    assert stats["dispatches"] == 3
+    assert stats["adapter_dispatches"] == 2
+    assert stats["dispatch_share"] == pytest.approx(2 / 3)
+    assert stats["adapter_fill"] == pytest.approx(5 / 8)
+    # an adapter-less trace stays comparable: absence is None, not 0
+    assert perf_report.adapter_stats([_dispatch_span(1.0, 4, 4)]) is None
+
+
+def test_adapter_fill_drop_rides_the_compare_gate():
+    old = perf_report.build_report([_dispatch_span(1.0, 4, 4, adapter=True)])
+    new = perf_report.build_report([_dispatch_span(1.0, 1, 4, adapter=True)])
+    regressions = perf_report.compare_reports(old, new, regress_pct=25.0)
+    assert any(r["stage"] == "adapter_fill" for r in regressions)
+    rendered = perf_report.render_comparison(regressions, 25.0)
+    assert "adapter_fill" in rendered
+    # unchanged fill passes; missing on either side is not a regression
+    assert not any(
+        r["stage"] == "adapter_fill"
+        for r in perf_report.compare_reports(new, new, regress_pct=25.0)
+    )
+    base = perf_report.build_report([_dispatch_span(1.0, 4, 4)])
+    assert not any(
+        r["stage"] == "adapter_fill"
+        for r in perf_report.compare_reports(base, new, regress_pct=25.0)
+    )
 
 
 def test_usage_stats_splits_span_wall_across_slots():

@@ -81,6 +81,13 @@ DETERMINISM_PATHS = (
     # order in the seq scan would make two identical runs produce
     # different waterfalls, breaking the conservation audit)
     "comfyui_distributed_tpu/telemetry/profiling.py",
+    # the adapter plane: operand build order, target-map iteration, and
+    # catalog scans feed the batch signature and the tile cache key —
+    # unsorted iteration or ambient entropy here would make two builds
+    # of the SAME adapter plan produce different operands/signatures,
+    # breaking both the slot-isolation bit-identity guarantee and
+    # cache-key stability
+    "comfyui_distributed_tpu/adapters/*.py",
 )
 
 _LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
