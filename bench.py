@@ -1168,6 +1168,178 @@ def _measure_cache_ab(seed: int = 17) -> dict | None:
         return None
 
 
+def _measure_canvas_ab(seed: int = 19) -> dict | None:
+    """Host-canvas vs device-canvas A/B (device-resident hot path):
+    the same elastic USDU run on the in-process chaos harness, once
+    through the deterministic host canvas and once with
+    CDT_DEVICE_CANVAS routing master-local tiles through the on-device
+    DeviceCanvas (one composited d2h per flush instead of one readback
+    per tile). Each run gets a fresh TransferLedger so the stamp
+    carries measured d2h bytes/tile for both sides, the rate, the
+    reduction ratio, and the bit-identity verdict (hard gate: the
+    device canvas must not change the image). Returns None (never
+    raises) when the measurement can't run."""
+    try:
+        import time as time_mod
+
+        import numpy as _np
+
+        from comfyui_distributed_tpu.resilience.chaos import run_chaos_usdu
+        from comfyui_distributed_tpu.telemetry.profiling import (
+            D2H,
+            TransferLedger,
+            set_transfer_ledger,
+        )
+
+        def one_run(device: bool):
+            ledger = TransferLedger()
+            prev = set_transfer_ledger(ledger)
+            try:
+                started = time_mod.perf_counter()
+                # no remote workers: the device canvas targets the
+                # MASTER-LOCAL readback seam (remote tiles keep the
+                # PNG path by design), so the A/B isolates it
+                result = run_chaos_usdu(
+                    seed=seed, workers=(), device_canvas=device
+                )
+                elapsed = time_mod.perf_counter() - started
+            finally:
+                set_transfer_ledger(prev)
+            snap = ledger.totals()
+            tiles = sum(result.tiles_by_worker.values()) or 1
+            d2h = snap["transfer"].get(D2H, {})
+            return {
+                "result": result,
+                "elapsed_s": elapsed,
+                "tiles": tiles,
+                "d2h_bytes": int(d2h.get("bytes", 0)),
+                "d2h_transfers": int(d2h.get("count", 0)),
+            }
+
+        # one untimed warmup so one-time costs (native blend kernel
+        # compile, jit warming) don't bias whichever side runs first
+        run_chaos_usdu(seed=seed, workers=())
+        host = one_run(False)
+        device = one_run(True)
+        if host["elapsed_s"] <= 0 or device["elapsed_s"] <= 0:
+            return None
+
+        def side(run):
+            return {
+                "elapsed_s": round(run["elapsed_s"], 4),
+                "tiles_per_sec": round(run["tiles"] / run["elapsed_s"], 3),
+                "d2h_bytes_per_tile": round(run["d2h_bytes"] / run["tiles"]),
+                "d2h_transfers": run["d2h_transfers"],
+            }
+
+        host_bpt = host["d2h_bytes"] / host["tiles"]
+        device_bpt = device["d2h_bytes"] / device["tiles"]
+        return {
+            "tiles": host["tiles"],
+            "bit_identical": bool(
+                _np.array_equal(host["result"].output, device["result"].output)
+            ),
+            "host": side(host),
+            "device": side(device),
+            # the win condition: strictly fewer d2h bytes per tile
+            "d2h_bytes_per_tile_ratio": (
+                round(device_bpt / host_bpt, 4) if host_bpt > 0 else None
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"canvas A/B measurement failed: {exc}", file=sys.stderr)
+        return None
+
+
+def _measure_precision_ab(
+    steps: int = 16, shape: tuple = (4, 32, 32, 4)
+) -> dict | None:
+    """bf16-lane vs f32 A/B (device-resident hot path's budget tier):
+    the production lane semantics exactly — step math upcast to f32,
+    the latent CARRY quantized to bf16 between steps — on a jitted
+    donated euler step over a toy score model. Stamps steps/sec for
+    both lanes, the speedup, and PSNR of the bf16 trajectory vs the
+    f32 reference (the quality cost a budget tenant buys into).
+    Returns None (never raises) when the measurement can't run."""
+    try:
+        import time as time_mod
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+
+        from comfyui_distributed_tpu.ops import samplers as smp
+        from comfyui_distributed_tpu.ops.stepwise import euler_step
+
+        sigmas = jnp.asarray(smp.get_sigmas("karras", steps))
+        n = int(sigmas.shape[0]) - 1
+
+        def model_fn(x, sigma, cond):
+            # cheap non-linear surrogate so quantization error actually
+            # propagates through the trajectory
+            return 0.3 * x + 0.01 * jnp.tanh(x)
+
+        def make_step(bf16: bool):
+            def _step(x, i):
+                if bf16:
+                    x = x.astype(jnp.float32)
+                out = euler_step(
+                    model_fn, x, jnp.take(sigmas, i),
+                    jnp.take(sigmas, i + 1), None,
+                )
+                return out.astype(jnp.bfloat16) if bf16 else out
+
+            return jax.jit(_step, donate_argnums=(0,))
+
+        x0 = jax.random.normal(jax.random.key(3), shape) * sigmas[0]
+
+        def run(bf16: bool):
+            step = make_step(bf16)
+
+            def fresh():
+                x = x0 + 0.0  # a copy: the step donates its operand
+                return x.astype(jnp.bfloat16) if bf16 else x
+
+            # warm the (single, step-index-traced) compile
+            jax.block_until_ready(step(fresh(), jnp.int32(0)))
+            x = fresh()
+            started = time_mod.perf_counter()
+            for i in range(n):
+                x = step(x, jnp.int32(i))
+            x = jax.block_until_ready(x)
+            elapsed = time_mod.perf_counter() - started
+            return _np.asarray(x.astype(jnp.float32)), elapsed
+
+        ref, f32_s = run(False)
+        quant, bf16_s = run(True)
+        if f32_s <= 0 or bf16_s <= 0:
+            return None
+        mse = float(_np.mean((ref - quant) ** 2))
+        peak = float(_np.max(_np.abs(ref))) or 1.0
+        psnr = (
+            round(10.0 * _np.log10(peak * peak / mse), 2)
+            if mse > 0
+            else None  # bit-identical: infinite PSNR
+        )
+        return {
+            "steps": n,
+            "shape": list(shape),
+            "f32": {
+                "elapsed_s": round(f32_s, 4),
+                "steps_per_sec": round(n / f32_s, 3),
+            },
+            "bf16": {
+                "elapsed_s": round(bf16_s, 4),
+                "steps_per_sec": round(n / bf16_s, 3),
+            },
+            "speedup": round(f32_s / bf16_s, 3),
+            "psnr_db_vs_f32": psnr,
+        }
+    except Exception as exc:  # noqa: BLE001 - the stamp is optional
+        print(f"precision A/B measurement failed: {exc}", file=sys.stderr)
+        return None
+
+
 def _measure_adapter_churn(
     n_jobs: int = 6, steps: int = 4, k_max: int = 8
 ) -> dict | None:
@@ -2242,6 +2414,20 @@ def main() -> None:
         adapter_churn = _measure_adapter_churn()
         if adapter_churn is not None:
             result["adapter_churn"] = adapter_churn
+    # host-vs-device canvas A/B: tiles/sec + measured d2h bytes/tile
+    # both ways + bit-identity (the device-resident hot path's canvas
+    # win as a measured datum)
+    if tiny and os.environ.get("BENCH_CANVAS_AB", "1") != "0":
+        canvas_ab = _measure_canvas_ab()
+        if canvas_ab is not None:
+            result["canvas_ab"] = canvas_ab
+    # bf16-vs-f32 lane A/B: steps/sec both lanes + PSNR of the bf16
+    # trajectory against the f32 reference (the budget tier's
+    # speed/quality trade as a measured datum)
+    if tiny and os.environ.get("BENCH_PRECISION_AB", "1") != "0":
+        precision_ab = _measure_precision_ab()
+        if precision_ab is not None:
+            result["precision_ab"] = precision_ab
     if flash_info:
         result.update(flash_info)
     if os.environ.get("BENCH_ATTEMPT"):

@@ -56,6 +56,7 @@ from ..ops.stepwise import (
     CheckpointError,
     decode_checkpoint,
     encode_checkpoint,
+    validate_checkpoint_meta,
 )
 from ..telemetry.instruments import (
     batch_fill_ratio,
@@ -122,6 +123,7 @@ class XJobHandle:
         lane: str = "",
         priority: int = 0,
         adapter: Any = None,
+        device_emit: bool = False,
     ) -> None:
         self.job_id = str(job_id)
         self.proc = proc
@@ -153,6 +155,10 @@ class XJobHandle:
         self.check_interrupted = check_interrupted
         self.tenant = str(tenant)
         self.lane = str(lane)
+        # device_emit: this job's emit() accepts DEVICE arrays — the
+        # executor skips the per-tile host readback and the consumer
+        # (a DeviceCanvas master) owns the single composited d2h
+        self.device_emit = bool(device_emit)
         # lower = more urgent; ties broken by registration order so
         # scheduling is a pure function of the registered sequence
         self.priority = int(priority)
@@ -263,6 +269,15 @@ class CrossJobExecutor:
         # recompute-from-0 resume, and the usage meter charges its
         # re-run steps (below that mark) to waste{preempt_recompute}
         self._evicted: dict[tuple[str, int], int] = {}
+        # Device-resident latent stash (CDT_XJOB_DEVICE_RESIDENT):
+        # (job_id, tile_idx) -> (device latent, step) kept at eviction
+        # so a re-grant on THIS executor resumes without re-uploading
+        # the host checkpoint (the host copy becomes the lazy spill —
+        # written at the preemption boundary, read only when the tile
+        # lands elsewhere or the stash was evicted). Insertion-ordered
+        # dict = deterministic FIFO eviction under the byte budget.
+        self._device_stash: dict[tuple[str, int], tuple[Any, int]] = {}
+        self._device_stash_bytes = 0
         # chip-time attribution (telemetry/usage.py); None = disabled
         self.usage = usage_meter if usage_meter is not None else (
             get_usage_meter() if USAGE_ENABLED else None
@@ -282,6 +297,7 @@ class CrossJobExecutor:
         self.preempt_evictions = 0
         self.resumes_checkpoint = 0
         self.resumes_recompute = 0
+        self.resumes_device = 0
         # completion order for scheduling assertions: (job_id, tile_idx).
         # Bounded: the PROCESS-shared executor outlives jobs, so an
         # unbounded list would grow one entry per tile served forever.
@@ -353,7 +369,19 @@ class CrossJobExecutor:
             )
         else:
             vmapped = jax.vmap(step_one, in_axes=(None, 0, 0, 0, 0, 0, 0))
-        fn = jax.jit(vmapped) if hasattr(step_one, "lower") else vmapped
+        # donate the stacked latents (arg 1): XLA aliases the input
+        # batch buffer into the output, so the per-step loop holds ONE
+        # batch-of-latents allocation instead of two. Safe because
+        # _step_batch stacks xs fresh per dispatch (the stack is a
+        # copy; per-item latents are never themselves donated), and
+        # nothing reads xs after the call — outputs scatter back to
+        # item.x. Raw Python stubs stay eager AND undonated (donation
+        # is a jit concept).
+        fn = (
+            jax.jit(vmapped, donate_argnums=(1,))
+            if hasattr(step_one, "lower")
+            else vmapped
+        )
         self._vstep_cache[sig] = fn
         return fn
 
@@ -404,6 +432,53 @@ class CrossJobExecutor:
 
         return jax.random.fold_in(job.base_key, int(tile_idx))
 
+    # --- device-resident latent stash --------------------------------------
+
+    def _stash_put(self, job_id: str, tile_idx: int, x: Any, step: int) -> None:
+        """Park an evicted tile's device latent for re-grant on this
+        executor. Bounded by CDT_XJOB_DEVICE_RESIDENT_MB with FIFO
+        eviction (insertion order — deterministic); a latent larger
+        than the whole budget is never stashed."""
+        from ..utils.constants import (
+            xjob_device_resident_budget_bytes,
+            xjob_device_resident_enabled,
+        )
+
+        if not xjob_device_resident_enabled():
+            return
+        nbytes = int(getattr(x, "nbytes", 0))
+        budget = xjob_device_resident_budget_bytes()
+        if nbytes <= 0 or nbytes > budget:
+            return
+        stale = self._device_stash.pop((job_id, tile_idx), None)
+        if stale is not None:
+            self._device_stash_bytes -= int(getattr(stale[0], "nbytes", 0))
+        self._device_stash[(job_id, tile_idx)] = (x, int(step))
+        self._device_stash_bytes += nbytes
+        while self._device_stash_bytes > budget and len(self._device_stash) > 1:
+            mark = next(iter(self._device_stash))
+            old, _ = self._device_stash.pop(mark)
+            self._device_stash_bytes -= int(getattr(old, "nbytes", 0))
+
+    def _stash_take(self, job_id: str, tile_idx: int, step: int) -> Any:
+        """Pop the stashed latent iff its step matches the checkpoint's
+        resume step (the checkpoint payload stays the authoritative
+        resume instruction; the stash only elides its decode + H2D).
+        Returns None on miss or step mismatch."""
+        entry = self._device_stash.pop((job_id, tile_idx), None)
+        if entry is None:
+            return None
+        self._device_stash_bytes -= int(getattr(entry[0], "nbytes", 0))
+        if entry[1] != int(step):
+            return None
+        return entry[0]
+
+    def _drop_job_stash(self, job_id: str) -> None:
+        dead = [mark for mark in self._device_stash if mark[0] == job_id]
+        for mark in dead:
+            x, _ = self._device_stash.pop(mark)
+            self._device_stash_bytes -= int(getattr(x, "nbytes", 0))
+
     def _adopt_grant(self, job: XJobHandle, grant: dict) -> int:
         """Turn one pull answer into ready items; returns item count.
         Checkpoints that fail to decode are dropped (recompute)."""
@@ -422,13 +497,43 @@ class CrossJobExecutor:
                 try:
                     import jax.numpy as jnp
 
-                    state, step = decode_checkpoint(payload)
-                    if 0 < step < job.proc.n_steps:
-                        item.x = jnp.asarray(state)
-                        item.step = step
+                    # Device-resident fast path: this executor evicted
+                    # the tile and still holds its latent on device.
+                    # The checkpoint stays the authority on WHICH step
+                    # to resume at (validated structurally, cheap); the
+                    # stash elides the b64 decode + H2D re-upload.
+                    # Byte-exact equivalence with the host decode is
+                    # pinned by tests — the checkpoint was encoded FROM
+                    # this very latent at eviction.
+                    step_hint = None
+                    if isinstance(payload, dict):
+                        try:
+                            step_hint = int(payload.get("step"))
+                        except (TypeError, ValueError):
+                            step_hint = None
+                    stashed = None
+                    if (
+                        step_hint is not None
+                        and 0 < step_hint < job.proc.n_steps
+                    ):
+                        validate_checkpoint_meta(payload)
+                        stashed = self._stash_take(
+                            job.job_id, tile_idx, step_hint
+                        )
+                    if stashed is not None:
+                        item.x = stashed
+                        item.step = step_hint
                         item.resumed = True
-                        self.resumes_checkpoint += 1
-                        preempt_resume_total().inc(mode="checkpoint")
+                        self.resumes_device += 1
+                        preempt_resume_total().inc(mode="device")
+                    else:
+                        state, step = decode_checkpoint(payload)
+                        if 0 < step < job.proc.n_steps:
+                            item.x = jnp.asarray(state)
+                            item.step = step
+                            item.resumed = True
+                            self.resumes_checkpoint += 1
+                            preempt_resume_total().inc(mode="checkpoint")
                 except CheckpointError as exc:
                     debug_log(
                         f"xjob {job.job_id}:{tile_idx} checkpoint rejected "
@@ -527,6 +632,12 @@ class CrossJobExecutor:
                         f"xjob {job.job_id}:{item.tile_idx} checkpoint "
                         f"encode failed ({exc}); releasing bare"
                     )
+                else:
+                    # the encoded host copy is the SPILL; the live
+                    # device latent stays parked for re-grant here
+                    self._stash_put(
+                        job.job_id, item.tile_idx, item.x, item.step
+                    )
             job.claimed.discard(item.tile_idx)
         self.preempt_evictions += len(idxs)
         debug_log(
@@ -581,6 +692,7 @@ class CrossJobExecutor:
         with self._lock:
             self._jobs.pop(job.job_id, None)
         self._drop_job_eviction_marks(job.job_id)
+        self._drop_job_stash(job.job_id)
         self._prune_signature(job.sig)
         job.finished.set()
 
@@ -601,6 +713,7 @@ class CrossJobExecutor:
         with self._lock:
             self._jobs.pop(job.job_id, None)
         self._drop_job_eviction_marks(job.job_id)
+        self._drop_job_stash(job.job_id)
         self._prune_signature(job.sig)
         job.finished.set()
 
@@ -767,7 +880,7 @@ class CrossJobExecutor:
                 # dispatch is async, so block inside the bracket
                 import jax
 
-                out = jax.block_until_ready(out)
+                out = jax.block_until_ready(out)  # cdt: noqa[CDT007]
         elapsed = time.monotonic() - started
         if self.usage is not None:
             self.usage.note_dispatch(
@@ -810,16 +923,24 @@ class CrossJobExecutor:
                     "sample", self.role, item.tile_idx, job_id=job.job_id
                 ):
                     out = job.proc.finish(job.params, item.x)
-                readback_started = time.monotonic()
-                host = self._to_host(out)
                 ledger = ledger_if_enabled()
-                if ledger is not None:
-                    ledger.note_transfer(
-                        D2H,
-                        int(getattr(host, "nbytes", 0)),
-                        time.monotonic() - readback_started,
-                    )
-                    ledger.note_tiles(1)
+                if job.device_emit:
+                    # device-canvas consumer: the tile stays on device;
+                    # the canvas flush pays ONE composited d2h instead
+                    # of one per tile
+                    host = out
+                    if ledger is not None:
+                        ledger.note_tiles(1)
+                else:
+                    readback_started = time.monotonic()
+                    host = self._to_host(out)
+                    if ledger is not None:
+                        ledger.note_transfer(
+                            D2H,
+                            int(getattr(host, "nbytes", 0)),
+                            time.monotonic() - readback_started,
+                        )
+                        ledger.note_tiles(1)
                 try:
                     with stage_span(
                         "encode", self.role, item.tile_idx, job_id=job.job_id
@@ -846,7 +967,9 @@ class CrossJobExecutor:
     def _to_host(result):
         from ..utils import image as img_utils
 
-        return img_utils.ensure_numpy(result)
+        # the _retire readback: ledger-bracketed (D2H note) at the one
+        # call site, skipped entirely for device_emit jobs
+        return img_utils.ensure_numpy(result)  # cdt: noqa[CDT007]
 
     # --- driver -----------------------------------------------------------
 
@@ -928,6 +1051,7 @@ class CrossJobExecutor:
             "preempt_evictions": self.preempt_evictions,
             "resumes_checkpoint": self.resumes_checkpoint,
             "resumes_recompute": self.resumes_recompute,
+            "resumes_device": self.resumes_device,
         }
         if errors:
             raise errors[0]
@@ -1003,18 +1127,25 @@ def _reset_shared_executor_for_tests() -> None:
 def _prep_xjob(
     bundle, image, pos, neg, upscale_by, tile, padding, upscale_method,
     tile_h, mask_blur, uniform, steps, sampler, scheduler, cfg, denoise,
-    tiled_decode, seed, job_id,
+    tiled_decode, seed, job_id, precision=None, lane="",
 ):
     """Shared prep for the xjob master/worker entries: tile extraction,
     per-tile conditioning, the step-resumable processor, and the
     job-folded base key (parallel/seeds.fold_job_key — the key gains
-    the job id so cross-tenant batch-mates can never correlate)."""
+    the job id so cross-tenant batch-mates can never correlate).
+
+    ``precision`` (None = resolve from the lane via CDT_BF16_LANES)
+    picks the latent-carry lane; it joins the processor signature, so
+    f32 and bf16 jobs never share a device batch."""
     import jax
 
     from ..ops import upscale as upscale_ops
     from ..ops.stepwise import make_stepwise_tile_processor
     from ..parallel.seeds import fold_job_key
+    from ..utils.constants import precision_for_lane
 
+    if precision is None:
+        precision = precision_for_lane(lane)
     upscaled, grid, extracted = upscale_ops.prepare_upscaled_tiles(
         image, upscale_by, tile, padding, upscale_method, tile_h,
         mask_blur=mask_blur, uniform=uniform,
@@ -1022,7 +1153,8 @@ def _prep_xjob(
     pos = upscale_ops.prep_cond_for_tiles(pos, grid)
     neg = upscale_ops.prep_cond_for_tiles(neg, grid)
     proc = make_stepwise_tile_processor(
-        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode
+        bundle, grid, steps, sampler, scheduler, cfg, denoise, tiled_decode,
+        precision=precision,
     )
     base_key = fold_job_key(jax.random.key(seed), job_id)
     return upscaled, grid, extracted, pos, neg, proc, base_key
@@ -1053,6 +1185,8 @@ def run_worker_xjob(
     context=None,
     client: Any = None,
     mesh: Any = None,
+    lane: str = "",
+    precision: str | None = None,
 ) -> None:
     """CDT_XJOB_BATCH worker entry (same signature as
     ``run_worker_loop``): registers this job with the process-shared
@@ -1083,7 +1217,7 @@ def run_worker_xjob(
     _, grid, extracted, pos, neg, proc, base_key = _prep_xjob(
         bundle, image, pos, neg, upscale_by, tile, padding, upscale_method,
         tile_h, mask_blur, uniform, steps, sampler, scheduler, cfg, denoise,
-        tiled_decode, seed, job_id,
+        tiled_decode, seed, job_id, precision=precision, lane=lane,
     )
     from .usdu_elastic import HTTPWorkClient, _flush_threshold_bytes
 
@@ -1236,6 +1370,8 @@ def run_master_xjob(
     tiled_decode: bool = False,
     tile_h: int | None = None,
     context=None,
+    lane: str = "",
+    precision: str | None = None,
 ):
     """CDT_XJOB_BATCH master entry (same signature/contract as
     ``run_master_elastic``): the master participates through the shared
@@ -1267,7 +1403,7 @@ def run_master_xjob(
     upscaled, grid, extracted, pos, neg, proc, base_key = _prep_xjob(
         bundle, image, pos, neg, upscale_by, tile, padding, upscale_method,
         tile_h, mask_blur, uniform, steps, sampler, scheduler, cfg, denoise,
-        tiled_decode, seed, job_id,
+        tiled_decode, seed, job_id, precision=precision, lane=lane,
     )
     note_serving_mesh(mesh)
     master_width = data_axis_size(mesh) if mesh is not None else 1
@@ -1276,10 +1412,6 @@ def run_master_xjob(
         store.note_worker_capacity("master", master_width)
 
     run_async_in_server_loop(_note_master_capacity())
-    if _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
-        canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
-    else:
-        canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
     done_tiles: set[int] = set()
     timeout = get_worker_timeout_seconds()
 
@@ -1335,11 +1467,32 @@ def run_master_xjob(
         )
     )
 
+    # Canvas routing rule (see docs/performance.md): the on-device
+    # canvas takes master-local tiles when CDT_DEVICE_CANVAS=1 AND the
+    # tile result cache is off — cache population needs host tile
+    # bytes, so with the cache on the per-tile materialization happens
+    # regardless and the device canvas buys nothing. Remote workers
+    # keep the PNG path either way (their tiles arrive host-side by
+    # construction and are uploaded once into the device canvas).
+    # Sorted compositing keeps the device canvas deterministic — and
+    # bit-identical to DeterministicHostCanvas, a hard test gate.
+    from ..utils.constants import device_canvas_enabled
+
+    device_canvas = device_canvas_enabled() and cache_binding is None
+    if device_canvas:
+        canvas = tile_ops.DeviceCanvas(upscaled, grid)
+    elif _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
+        canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
+    else:
+        canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
+
     def blend_local(tile_idx: int, result) -> None:
         with stage_span("blend", "master", tile_idx):
             y, x = grid.positions[tile_idx]
             if cache_binding is not None:
-                result = np.asarray(result)
+                # one host materialisation serves both the cache
+                # write-back and the host canvas blend
+                result = np.asarray(result)  # cdt: noqa[CDT007]
                 cache_binding.populate(tile_idx, result)
             canvas.blend(result, y, x)
             done_tiles.add(tile_idx)
@@ -1398,7 +1551,9 @@ def run_master_xjob(
                     img_utils.decode_image_data_url(e["image"])
                     for e in sorted(payload, key=lambda e: e["batch_idx"])
                 ]
-            blend_local(tile_idx, jnp.asarray(np.stack(batch, axis=0)))
+            # remote PNG tiles are ALREADY host bytes — stacking them
+            # pulls nothing off a device
+            blend_local(tile_idx, jnp.asarray(np.stack(batch, axis=0)))  # cdt: noqa[CDT007]
 
     # --- master's own compute rides the shared executor ------------------
     def pull() -> Optional[dict]:
@@ -1465,6 +1620,7 @@ def run_master_xjob(
             preempt_check=preempt_check,
             check_interrupted=check_abort,
             adapter=adapter,
+            device_emit=device_canvas,
         )
 
     shared = get_shared_executor()
@@ -1539,4 +1695,18 @@ def run_master_xjob(
             f"USDU xjob: job {job_id} completes DEGRADED: tile(s) "
             f"{poisoned} quarantined"
         )
+    if device_canvas:
+        # ONE composited d2h per flush — the whole point. Ledger-noted
+        # here so perf_report's d2h-bytes/tile column sees the canvas
+        # transfer instead of per-tile readbacks.
+        with stage_span("readback", "master", tiles=canvas.tile_count):
+            started = _time.monotonic()
+            composited = canvas.result()
+            host = np.asarray(composited)  # cdt: noqa[CDT007]
+            ledger = ledger_if_enabled()
+            if ledger is not None:
+                ledger.note_transfer(
+                    D2H, int(host.nbytes), _time.monotonic() - started
+                )
+        return jnp.asarray(host)
     return canvas.result()

@@ -281,17 +281,25 @@ class GrantSampler:
             ledger.note_transfer(H2D, nbytes, time.monotonic() - started)
         return placed
 
-    def collect(self, result):
+    def collect(self, result, keep_device: bool = False):
         """Materialise a sample() result on the host. Sharded results
         gather via parallel/collective.host_collect (cross-device over
         ICI, cross-process over DCN); unsharded results take the plain
-        numpy path. Wired as the TilePipeline's ``to_host`` stage."""
+        numpy path. Wired as the TilePipeline's ``to_host`` stage.
+
+        ``keep_device=True`` is the device-canvas route (master-local
+        grants composite on-device; the flush pays ONE composited d2h
+        instead of one readback per tile): the device array is handed
+        straight back. Only honoured for unsharded results — a sharded
+        result must gather across the mesh regardless."""
+        if keep_device and self.data_parallel <= 1:
+            return result
         ledger = ledger_if_enabled()
         if self.data_parallel <= 1:
             from ..utils import image as img_utils
 
             started = time.monotonic()
-            host = img_utils.ensure_numpy(result)
+            host = img_utils.ensure_numpy(result)  # cdt: noqa[CDT007] - the ledger-bracketed readback seam
             if ledger is not None:
                 ledger.note_transfer(
                     D2H,
@@ -395,7 +403,7 @@ class GrantSampler:
                 if self._device and ledger_if_enabled() is not None:
                     # profiling wants honest device-execute wall: JAX
                     # dispatch is async, so block inside the bracket
-                    outs = jax.block_until_ready(outs)
+                    outs = jax.block_until_ready(outs)  # cdt: noqa[CDT007]
             elapsed = time.monotonic() - started
             self._note_usage(elapsed, real=n, bucket=n)
             self._note_profiling(elapsed, real=n)
@@ -417,7 +425,7 @@ class GrantSampler:
             if self._device and ledger_if_enabled() is not None:
                 import jax
 
-                out = jax.block_until_ready(out)
+                out = jax.block_until_ready(out)  # cdt: noqa[CDT007]
         elapsed = time.monotonic() - started
         self._note_usage(elapsed, real=n, bucket=bucket)
         self._note_profiling(elapsed, real=n)
@@ -559,7 +567,9 @@ class TilePipeline:
     def _default_to_host(result):
         from ..utils import image as img_utils
 
-        return img_utils.ensure_numpy(result)
+        # the I/O stage's readback — bracketed by _drain_item's
+        # stage_span("readback"), which rides the ledger's host buckets
+        return img_utils.ensure_numpy(result)  # cdt: noqa[CDT007]
 
     def _record_error(self, exc: BaseException) -> None:
         with self._error_lock:

@@ -1052,9 +1052,23 @@ def run_master_elastic(
     # defers compositing to sorted tile order so the blended output is
     # bit-identical regardless of which participant finished first
     # (chaos tests assert fault-free vs fault-recovered runs equal).
+    # Routing rule (CDT_DEVICE_CANVAS=1): master-local grants skip the
+    # per-tile readback entirely and composite on-device — one d2h for
+    # the whole composited canvas at the end of the run. Remote worker
+    # tiles keep the PNG path and upload once into the device canvas.
+    # Cache population needs host tile bytes at blend time, so the
+    # device canvas only engages while the tile cache is off.
     import os as _os
 
-    if _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
+    from ..cache import get_tile_cache as _get_tile_cache
+    from ..utils.constants import device_canvas_enabled as _device_canvas_enabled
+
+    # get_tile_cache (not the env knob alone) so a run-locally
+    # installed cache — the chaos harness's swap — also disables it
+    device_canvas = _device_canvas_enabled() and _get_tile_cache() is None
+    if device_canvas:
+        canvas = tile_ops.DeviceCanvas(upscaled, grid)
+    elif _os.environ.get("CDT_DETERMINISTIC_BLEND") == "1":
         canvas = tile_ops.DeterministicHostCanvas(upscaled, grid)
     else:
         canvas = tile_ops.HostIncrementalCanvas(upscaled, grid)
@@ -1266,8 +1280,13 @@ def run_master_elastic(
                 # results gather across the mesh, single-device ones
                 # take the numpy path; either way the d2h transfer is
                 # attributed (ledger gather bucket) instead of hiding
-                # inside the first blend's implicit conversion
-                result = grant_sampler.collect(result)
+                # inside the first blend's implicit conversion. With
+                # the device canvas on, unsharded master-local grants
+                # stay device-resident (keep_device) and the span reads
+                # ~0 — honestly: no readback happened.
+                result = grant_sampler.collect(
+                    result, keep_device=device_canvas
+                )
             run_async_in_server_loop(
                 store.submit_flush(
                     job_id, "master",
@@ -1393,6 +1412,23 @@ def run_master_elastic(
             f"USDU: job {job_id} completes DEGRADED: tile(s) {poisoned} "
             "quarantined (region blended from the base image)"
         )
+    if device_canvas:
+        # the job's entire master-side pixel traffic rides this ONE
+        # composited readback (ledger-attributed); bit-identical to
+        # DeterministicHostCanvas by the sorted-compositing guarantee
+        from ..telemetry.profiling import D2H as _D2H
+        from ..telemetry.profiling import ledger_if_enabled as _ledger_if
+
+        with _stage("readback", "master", tiles=canvas.tile_count):
+            started = time.monotonic()
+            composited = canvas.result()
+            host = np.asarray(composited)  # cdt: noqa[CDT007] - the single composited flush
+            ledger = _ledger_if()
+            if ledger is not None:
+                ledger.note_transfer(
+                    _D2H, int(host.nbytes), time.monotonic() - started
+                )
+        return jnp.asarray(host)
     return canvas.result()
 
 

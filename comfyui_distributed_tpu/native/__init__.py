@@ -36,17 +36,27 @@ def _build_dir() -> str:
     )
 
 
+# -ffp-contract=off: GCC's default contraction may fuse the blend lerp
+# (`region*inv + tile*m`) into an FMA, which rounds once instead of
+# twice — ulp-different from the numpy fallback and from eager XLA CPU.
+# The device-canvas bit-identity gate (DeviceCanvas ≡
+# DeterministicHostCanvas) requires all three paths to round alike.
+_CXX_FLAGS = ("-O3", "-march=native", "-ffp-contract=off", "-shared", "-fPIC")
+
+
 def _compile() -> Optional[str]:
     src = _source_path()
     out_dir = _build_dir()
     os.makedirs(out_dir, exist_ok=True)
-    # cache key: source digest, so edits rebuild
+    # cache key: source + flags digest, so edits OR flag changes rebuild
     with open(src, "rb") as fh:
-        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+        hasher = hashlib.sha256(fh.read())
+    hasher.update(" ".join(_CXX_FLAGS).encode())
+    digest = hasher.hexdigest()[:16]
     so_path = os.path.join(out_dir, f"blendlib_{digest}.so")
     if os.path.isfile(so_path):
         return so_path
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", src, "-o", so_path]
+    cmd = ["g++", *_CXX_FLAGS, src, "-o", so_path]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return so_path

@@ -109,6 +109,15 @@ def euler_ancestral_step(model_fn, x, sigma, sigma_next, cond, step_key):
     return x + jax.random.normal(step_key, x.shape) * sigma_up
 
 
+# Precision lanes for the latent carry. ``bf16`` quantizes the latent
+# BETWEEN steps (storage / checkpoint / transfer precision — halves
+# checkpoint and d2h bytes); the per-step model math still runs in the
+# model's parameter dtype via promotion, so the lane is a bounded
+# quality trade (bench stamps PSNR-vs-f32 into precision_ab), not an
+# unbounded one.
+PRECISION_LANES = ("f32", "bf16")
+
+
 def make_stepwise_tile_processor(
     bundle,
     grid,
@@ -118,13 +127,25 @@ def make_stepwise_tile_processor(
     cfg: float,
     denoise: float,
     tiled_decode: bool = False,
+    precision: str = "f32",
 ) -> StepwiseProcessor:
     """Build the production step-resumable tile processor: the same
     VAE-encode → noise → per-step denoise → VAE-decode pipeline as
     ``_jit_tile_processor``, factored at step boundaries. All three
     programs are jitted; the step program takes the step index as a
     TRACED scalar (sigma pair via ``jnp.take``) so every step of the
-    trajectory shares ONE compiled program per batch shape."""
+    trajectory shares ONE compiled program per batch shape.
+
+    The jitted step DONATES its latent operand (``donate_argnums=(1,)``,
+    the seam parallel/training.py uses for train state): XLA aliases
+    the input latent buffer into the output, so the per-step loop holds
+    ONE latent allocation instead of two. Callers must treat the passed
+    ``x`` as consumed (the executor rebinds ``item.x`` from the output;
+    checkpoints encode BEFORE the next step call).
+
+    ``precision`` selects the latent-carry lane (``PRECISION_LANES``);
+    it joins the batching signature so f32 and bf16 tiles never share a
+    device batch."""
     import jax
     import jax.numpy as jnp
 
@@ -139,6 +160,12 @@ def make_stepwise_tile_processor(
             f"sampler {sampler!r} (flow={flow}) has cross-step state and "
             "cannot run on the step-resumable tier; use the scan tier"
         )
+    if precision not in PRECISION_LANES:
+        raise StepwiseUnsupported(
+            f"unknown precision lane {precision!r} (choose from "
+            f"{PRECISION_LANES})"
+        )
+    bf16 = precision == "bf16"
     sigmas = smp.get_model_sigmas(
         param, scheduler, int(steps), denoise=float(denoise), flow_shift=shift
     )
@@ -149,12 +176,14 @@ def make_stepwise_tile_processor(
     def init(params, tile, key):
         z = bundle.vae.apply(params["vae"], tile, method="encode")
         noise_key, _ = jax.random.split(key)
-        return smp.noise_latents(
+        x = smp.noise_latents(
             param, z, jax.random.normal(noise_key, z.shape), sigmas[0]
         )
+        return x.astype(jnp.bfloat16) if bf16 else x
 
-    @jax.jit
-    def step(params, x, key, pos, neg, yx, i):
+    def _step(params, x, key, pos, neg, yx, i):
+        if bf16:
+            x = x.astype(jnp.float32)
         pos_t = upscale_ops.tile_cond(pos, yx[0], yx[1], grid)
         neg_t = upscale_ops.tile_cond(neg, yx[0], yx[1], grid)
         model_fn = pl.guided_model(bundle, params, float(cfg))
@@ -164,15 +193,21 @@ def make_stepwise_tile_processor(
         if sampler == "euler_ancestral":
             _, anc_key = jax.random.split(key)
             step_key = jax.random.fold_in(anc_key, i)
-            return euler_ancestral_step(
+            out = euler_ancestral_step(
                 model_fn, x, sigma, sigma_next, cond, step_key
             )
-        # euler and (eta=0) ddim share the same sigma-space update
-        # (see ops/samplers._sample_ddim's derivation note)
-        return euler_step(model_fn, x, sigma, sigma_next, cond)
+        else:
+            # euler and (eta=0) ddim share the same sigma-space update
+            # (see ops/samplers._sample_ddim's derivation note)
+            out = euler_step(model_fn, x, sigma, sigma_next, cond)
+        return out.astype(jnp.bfloat16) if bf16 else out
+
+    step = jax.jit(_step, donate_argnums=(1,))
 
     @jax.jit
     def finish(params, x):
+        if bf16:
+            x = x.astype(jnp.float32)
         if tiled_decode:
             from .tiled_vae import decode_tiled
 
@@ -190,6 +225,7 @@ def make_stepwise_tile_processor(
         round(float(cfg), 6),
         round(float(denoise), 6),
         bool(tiled_decode),
+        str(precision),
     )
     return StepwiseProcessor(init, step, finish, n_steps, signature)
 
@@ -229,7 +265,10 @@ def encode_checkpoint(x, step: int) -> dict[str, Any]:
     from ..telemetry.profiling import D2H, ledger_if_enabled
 
     started = time.monotonic()
-    arr = np.ascontiguousarray(np.asarray(x))
+    # the checkpoint spill IS the sanctioned d2h boundary (written only
+    # at preemption/checkpoint time, never per step) and the ledger
+    # note below brackets it
+    arr = np.ascontiguousarray(np.asarray(x))  # cdt: noqa[CDT007]
     ledger = ledger_if_enabled()
     if ledger is not None:
         # np.asarray on a device array is the d2h materialization; the
@@ -274,9 +313,12 @@ def validate_checkpoint_meta(payload: Any) -> int:
         raise CheckpointError(f"malformed checkpoint: {exc}") from exc
     if step < 0:
         raise CheckpointError(f"negative checkpoint step {step}")
-    if dtype.kind not in "fiub":
+    if dtype.kind not in "fiub" and dtype.name != "bfloat16":
         # object/str/void dtypes could smuggle arbitrary Python state
-        # (and crash frombuffer); latents are numeric by construction
+        # (and crash frombuffer); latents are numeric by construction.
+        # bfloat16 (ml_dtypes) registers with kind 'V' but is a plain
+        # 2-byte numeric dtype — the bf16 lane's checkpoints round-trip
+        # byte-exactly through it, so it is explicitly allowlisted.
         raise CheckpointError(f"non-numeric checkpoint dtype {dtype!r}")
     if not isinstance(data, str):
         raise CheckpointError("checkpoint data must be a base64 string")

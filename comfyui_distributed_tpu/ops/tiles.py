@@ -411,6 +411,70 @@ class DeterministicHostCanvas:
         return inner.result()
 
 
+class DeviceCanvas:
+    """Device-resident twin of DeterministicHostCanvas.
+
+    Master-local tiles never leave the device: each blended tile is
+    buffered as a device float32 array and composited in sorted (y, x)
+    order at `result()` with the same feathered lerp the host canvas
+    uses, so the flush transfers ONE composited canvas instead of one
+    image per tile (the d2h seam the transfer ledger attributes per
+    tile today). Compositing runs eagerly (op-by-op) on purpose: each
+    primitive rounds individually, exactly like the numpy / native
+    (-ffp-contract=off) host path, so DeviceCanvas ≡
+    DeterministicHostCanvas is a BIT-IDENTITY guarantee, not a
+    tolerance — pinned by test and by the chaos harness.
+
+    `sharding` optionally places the padded canvas (batch-axis sharding
+    is the safe choice: the per-tile dynamic slices span full H/W rows
+    so only the batch dim may be split without cross-shard gathers).
+    Enabled per-run via CDT_DEVICE_CANVAS=1 on the master-local grant
+    path; remote workers keep the PNG path (their tiles arrive
+    host-side by construction).
+    """
+
+    def __init__(self, images: jax.Array, grid: TileGrid, sharding=None):
+        self.grid = grid
+        base = jnp.asarray(images, dtype=jnp.float32)
+        if sharding is not None:
+            base = jax.device_put(base, sharding)
+        self._base = base
+        self._sharding = sharding
+        self._tiles: dict[tuple[int, int], jax.Array] = {}
+
+    def blend(self, tile, y, x) -> None:
+        # (y, x) is unique per tile in the grid: the dict deduplicates
+        # a tile blended twice (last write wins; identical payloads —
+        # the determinism invariant — make the choice immaterial).
+        t = jnp.asarray(tile, dtype=jnp.float32)
+        if self._sharding is not None:
+            t = jax.device_put(t, self._sharding)
+        self._tiles[(int(y), int(x))] = t
+
+    @property
+    def tile_count(self) -> int:
+        return len(self._tiles)
+
+    def result(self) -> jax.Array:
+        """Composite buffered tiles in sorted order; stays on device.
+
+        The caller owns the single d2h transfer (and its ledger note).
+        """
+        grid = self.grid
+        padded = pad_image_for_grid(self._base, grid)
+        mask = feather_mask(grid, dtype=jnp.float32)[None, :, :, None]
+        inv = 1.0 - mask
+        b, c = padded.shape[0], padded.shape[3]
+        for (y, x), tile in sorted(self._tiles.items()):
+            region = jax.lax.dynamic_slice(
+                padded, (0, y, x, 0), (b, grid.padded_h, grid.padded_w, c)
+            )
+            blended = region * inv + tile * mask
+            padded = jax.lax.dynamic_update_slice(padded, blended, (0, y, x, 0))
+        p = grid.padding
+        return padded[:, p : p + grid.image_h, p : p + grid.image_w, :]
+
+
 def blend_single_tile(
     canvas: jax.Array, tile: jax.Array, y: int, x: int, grid: TileGrid
 ) -> jax.Array:

@@ -177,6 +177,7 @@ def run_chaos_usdu(
     slo: Optional[dict] = None,
     incidents: Optional[dict] = None,
     cache=None,
+    device_canvas: bool = False,
 ) -> ChaosResult:
     """One in-process elastic USDU run under `fault_plan`; returns the
     blended [B, H, W, C] image plus the faults that actually fired.
@@ -270,6 +271,13 @@ def run_chaos_usdu(
     keep claim timing deterministic (no prefetch) so scripted fault
     schedules fire on the same tiles every run. All combinations must
     produce the bit-identical canvas — that is the point.
+
+    `device_canvas`: route the master's blend through the on-device
+    DeviceCanvas (CDT_DEVICE_CANVAS=1, the device-resident hot path's
+    one-flush compositing) instead of the deterministic host canvas.
+    DeviceCanvas ≡ DeterministicHostCanvas is a BIT-IDENTITY contract,
+    so every scenario must match the host baseline exactly — under
+    crashes, speculation, and batched grants included.
     """
     import jax
     import jax.numpy as jnp
@@ -595,6 +603,7 @@ def run_chaos_usdu(
                         # master loop + any nested tile_scan_batch()
                         # read share the harness's batching knob
                         "CDT_TILE_BATCH": str(max(1, int(tile_batch))),
+                        "CDT_DEVICE_CANVAS": "1" if device_canvas else "0",
                     },
                 )
             )
@@ -2196,6 +2205,7 @@ class XJobResult:
     completion_order: list                # (job_id, tile_idx) in finish order
     preempted_jobs: list                  # jobs flagged during the run
     evictions: int
+    resumes_device: int
     resumes_checkpoint: int
     resumes_recompute: int
     leaks: dict                           # job id -> leak accounting
@@ -2509,6 +2519,7 @@ def run_chaos_xjob(
         completion_order=list(executor.completion_order),
         preempted_jobs=list(preempted_jobs),
         evictions=executor.preempt_evictions,
+        resumes_device=executor.resumes_device,
         resumes_checkpoint=executor.resumes_checkpoint,
         resumes_recompute=executor.resumes_recompute,
         leaks=leaks,

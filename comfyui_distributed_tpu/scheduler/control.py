@@ -63,6 +63,17 @@ class SchedulerControl:
         self.unsettled_admission_cost = 0.0
         self._tenant_tile_cost: dict[str, float] = {}
         self._max_tenant_tile_cost = 1024
+        # Cache-hit admission discount (CDT_CACHE_COST=1): per-tenant
+        # admitted-vs-settled tile counters feed a bounded multiplier —
+        # a tenant whose recent tiles mostly settle from the tile cache
+        # pays proportionally less at admission, floored by
+        # CDT_CACHE_COST_FLOOR so a cold burst can't ride an unbounded
+        # discount. Counters halve past the window so the hit share
+        # tracks RECENT behavior, not all-time history; both maps are
+        # bounded like _tenant_tile_cost (tenant ids are network input).
+        self._tenant_admitted_tiles: dict[str, float] = {}
+        self._tenant_settled_tiles: dict[str, float] = {}
+        self._cache_hit_window = 4096.0
 
     # --- payload mapping --------------------------------------------------
 
@@ -124,7 +135,9 @@ class SchedulerControl:
             pass
         cost *= self._measured_cost_ratio(payload.tenant)
         cost *= self._adapter_cost(payload)
+        cost *= self._cache_cost(payload.tenant)
         self._note_admitted_cost(payload.tenant, cost / tiles)
+        self._note_admitted_tiles(payload.tenant, tiles)
         return self.queue.submit(
             tenant=payload.tenant,
             lane=payload.lane,
@@ -146,14 +159,62 @@ class SchedulerControl:
         added (cost units). Fed by JobStore.settle_sink; an unknown
         tenant (admitted before this process started, or a direct
         executor call that bypassed admission) charges the static 1.0
-        per-tile cost — the same fallback admission itself uses."""
+        per-tile cost — the same fallback admission itself uses.
+
+        With the CDT_CACHE_COST discount on, the recorded per-tile
+        admitted cost already carries the discount, so each settle
+        lands a strictly smaller gap on the
+        `cdt_cache_unsettled_admission_cost` gauge — admission
+        pre-paying the expected hits IS what drops the gauge."""
         tiles = int(tiles)
         if tiles <= 0:
             return 0.0
         per_tile = self._tenant_tile_cost.get(str(tenant), 1.0)
         gap = tiles * per_tile
         self.unsettled_admission_cost += gap
+        self._note_settled_tiles(tenant, tiles)
         return gap
+
+    def _cache_cost(self, tenant: str) -> float:
+        """The CDT_CACHE_COST multiplier: 1 - (tenant's recent cache-hit
+        share), floored by CDT_CACHE_COST_FLOOR. Tiles the cache index
+        keeps settling never burn chip time, so charging full freight
+        for them at DRR admission double-bills the tenant. 1.0 when the
+        knob is off or the tenant has no settle history yet."""
+        from ..utils import constants
+
+        if not constants.cache_cost_enabled():
+            return 1.0
+        admitted = self._tenant_admitted_tiles.get(str(tenant), 0.0)
+        settled = self._tenant_settled_tiles.get(str(tenant), 0.0)
+        if admitted <= 0.0 or settled <= 0.0:
+            return 1.0
+        hit_share = min(1.0, settled / admitted)
+        return max(constants.cache_cost_floor(), 1.0 - hit_share)
+
+    def _note_admitted_tiles(self, tenant: str, tiles: float) -> None:
+        tenant = str(tenant)
+        adm = self._tenant_admitted_tiles
+        prev = adm.pop(tenant, 0.0)
+        while len(adm) >= self._max_tenant_tile_cost:
+            adm.pop(next(iter(adm)))
+        total = prev + float(tiles)
+        if total > self._cache_hit_window:
+            # halve BOTH counters so the hit share tracks recent
+            # behavior instead of freezing on all-time history
+            total *= 0.5
+            settled = self._tenant_settled_tiles.get(tenant, 0.0)
+            if settled:
+                self._tenant_settled_tiles[tenant] = settled * 0.5
+        adm[tenant] = total
+
+    def _note_settled_tiles(self, tenant: str, tiles: float) -> None:
+        tenant = str(tenant)
+        st = self._tenant_settled_tiles
+        prev = st.pop(tenant, 0.0)
+        while len(st) >= self._max_tenant_tile_cost:
+            st.pop(next(iter(st)))
+        st[tenant] = prev + float(tiles)
 
     def _adapter_cost(self, payload: Any) -> float:
         """The CDT_ADAPTER_COLD_COST multiplier: a request whose

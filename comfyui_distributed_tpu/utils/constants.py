@@ -172,6 +172,50 @@ PREEMPT_BROWNOUT_LEVEL = _env_int("CDT_PREEMPT_BROWNOUT_LEVEL", 0)
 # the bit-identity reference anyway.
 PREEMPT_CHECKPOINT_MB = _env_int("CDT_PREEMPT_CHECKPOINT_MB", 64)
 
+
+# --- device-resident hot path ---------------------------------------------
+# All resolved at CALL time (tests monkeypatch the env).
+
+
+def xjob_device_resident_enabled() -> bool:
+    """1 (default) parks evicted batch latents on-device in the
+    cross-job executor: the host checkpoint becomes a lazy spill and a
+    re-grant whose payload step matches the parked latent skips the
+    b64 decode + H2D re-upload entirely. 0 restores decode-from-host
+    on every resume (the bit-identity reference path — the parked
+    latent IS the array the checkpoint was encoded from, so both
+    resume modes are byte-identical by construction)."""
+    return _env_int("CDT_XJOB_DEVICE_RESIDENT", 1) == 1
+
+
+def xjob_device_resident_budget_bytes() -> int:
+    """Byte budget for parked device latents (CDT_XJOB_DEVICE_RESIDENT_MB,
+    default 256). Past it the stash evicts oldest-first; an evicted
+    entry just means that tile resumes from its host spill."""
+    return _env_int("CDT_XJOB_DEVICE_RESIDENT_MB", 256) * 1024 * 1024
+
+
+def device_canvas_enabled() -> bool:
+    """CDT_DEVICE_CANVAS=1 routes master-local tiles through the
+    on-device canvas (ops/tiles.DeviceCanvas): one composited d2h per
+    flush instead of one readback per tile. Only engages when the tile
+    result cache is off — cache population needs host tile bytes at
+    blend time. 0 (default) keeps the host canvas paths exactly."""
+    return _env_int("CDT_DEVICE_CANVAS", 0) == 1
+
+
+def precision_for_lane(lane: str) -> str:
+    """Precision lane for a scheduler lane: CDT_BF16_LANES is a
+    comma-separated list of lane names whose jobs carry their latents
+    in bfloat16 between steps ("*" = every lane). Precision joins the
+    cross-job batch signature, so bf16 and f32 tiles never share a
+    device batch. Default: empty (everything f32)."""
+    raw = os.environ.get("CDT_BF16_LANES", "")
+    lanes = {part.strip() for part in raw.split(",") if part.strip()}
+    if "*" in lanes or (lane and lane in lanes):
+        return "bf16"
+    return "f32"
+
 # --- request lifecycle armor (deadlines / cancel / poison / brownout) -----
 # Failed delivery attempts (crash/timeout requeues) a single tile may
 # accumulate before it is quarantined out of the pull set as poison —
@@ -403,6 +447,25 @@ def cache_dir() -> str | None:
     if not raw or raw.lower() in CACHE_DIR_DISABLED_VALUES:
         return None
     return raw
+
+
+def cache_cost_enabled() -> bool:
+    """CDT_CACHE_COST=1 discounts a job's DRR admission cost by its
+    tenant's measured cache-hit share: tiles the cache index says are
+    likely hits never reach a device, so charging full freight for
+    them double-bills the tenant (the settle path already refunds the
+    admission gap — this closes it at admission time). 0 (default)
+    keeps admission cost hit-blind."""
+    return _env_int("CDT_CACHE_COST", 0) == 1
+
+
+def cache_cost_floor() -> float:
+    """Lower bound on the cache-hit admission discount multiplier
+    (default 0.25): even a tenant whose recent tiles all settled from
+    cache pays at least this fraction of full cost, so a cold-cache
+    burst can never ride an unbounded discount into the queue."""
+    floor = _env_float("CDT_CACHE_COST_FLOOR", 0.25)
+    return min(1.0, max(0.0, floor))
 
 
 # --- adapter plane (adapters/) --------------------------------------------

@@ -169,6 +169,22 @@ KNOBS: tuple[Knob, ...] = (
          "continuous-batching executor (tiles from different jobs/tenants "
          "share shape-bucketed device batches; step-resumable samplers "
          "only)."),
+    Knob("CDT_XJOB_DEVICE_RESIDENT", "1", "scheduler",
+         "`1` parks evicted batch latents on-device in the cross-job "
+         "executor: the host checkpoint becomes a lazy spill and a "
+         "matching re-grant resumes without the b64 decode + h2d "
+         "re-upload. `0` decodes every resume from the host checkpoint "
+         "(both modes are byte-identical by construction)."),
+    Knob("CDT_XJOB_DEVICE_RESIDENT_MB", "256", "scheduler",
+         "Byte budget (MB) for parked device latents; past it the stash "
+         "evicts oldest-first and the evicted tile resumes from its "
+         "host spill."),
+    Knob("CDT_BF16_LANES", "empty", "scheduler",
+         "Comma-separated scheduler lane names whose jobs carry latents "
+         "in bfloat16 between steps (`*` = every lane): halves "
+         "checkpoint/transfer bytes; step math stays in the model's "
+         "param dtype. Precision joins the batch signature, so bf16 "
+         "and f32 tiles never share a device batch."),
     # --- tile pipeline ---------------------------------------------------
     Knob("CDT_PIPELINE", "1", "pipeline",
          "`0` replaces the staged tile pipeline with the serial per-tile loop."),
@@ -344,6 +360,13 @@ KNOBS: tuple[Knob, ...] = (
     Knob("CDT_CACHE_RAM_MB", "256.0", "cache",
          "Host-RAM LRU byte budget in MB; an entry larger than the "
          "whole budget is stored disk-only."),
+    Knob("CDT_CACHE_COST", "0", "cache",
+         "`1` discounts DRR admission cost by the tenant's measured "
+         "cache-hit share (tiles that settle from cache never burn "
+         "chip time); bounded below by CDT_CACHE_COST_FLOOR."),
+    Knob("CDT_CACHE_COST_FLOOR", "0.25", "cache",
+         "Lower bound on the cache-hit admission discount multiplier: "
+         "even an all-hits tenant pays this fraction of full cost."),
     # --- adapter plane ---------------------------------------------------
     Knob("CDT_ADAPTER_CACHE_MB", "256.0", "adapters",
          "Host-RAM LRU byte budget in MB for decoded adapter operands "
@@ -445,6 +468,12 @@ KNOBS: tuple[Knob, ...] = (
          "Flash-attention key block size (MXU-aligned)."),
     Knob("CDT_BLEND", "unset", "ops",
          "`segment` selects segment-sum canvas blending for large grids."),
+    Knob("CDT_DEVICE_CANVAS", "0", "ops",
+         "`1` composites master-local tiles on-device (ops/tiles."
+         "DeviceCanvas): one composited d2h per flush instead of a "
+         "readback per tile; bit-identical to the deterministic host "
+         "canvas. Engages only while the tile cache is off; remote "
+         "worker tiles keep the PNG path."),
     # --- parallel --------------------------------------------------------
     Knob("CDT_MESH_SHAPE", "unset", "parallel",
          "Local device mesh axis sizes as `data,model` (e.g. `4,1`, `-1,2`; "

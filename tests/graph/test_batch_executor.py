@@ -211,7 +211,13 @@ def test_priority_orders_completions():
     assert order[:2] == ["high", "high"]
 
 
-def test_preempt_evicts_checkpoints_and_resumes_bit_identical():
+@pytest.mark.parametrize("device_resident", [True, False], ids=["device", "host"])
+def test_preempt_evicts_checkpoints_and_resumes_bit_identical(
+    monkeypatch, device_resident
+):
+    monkeypatch.setenv(
+        "CDT_XJOB_DEVICE_RESIDENT", "1" if device_resident else "0"
+    )
     proc = _make_proc(n_steps=5)
     flag = threading.Event()
     master = _FakeMaster(6)
@@ -248,7 +254,14 @@ def test_preempt_evicts_checkpoints_and_resumes_bit_identical():
     ex._step_batch = hooked
     stats = ex.run()
     assert stats["preempt_evictions"] == 6
-    assert stats["resumes_checkpoint"] == 6
+    if device_resident:
+        # parked device latents served every resume; the host
+        # checkpoint stayed a cold spill (never decoded)
+        assert stats["resumes_device"] == 6
+        assert stats["resumes_checkpoint"] == 0
+    else:
+        assert stats["resumes_checkpoint"] == 6
+        assert stats["resumes_device"] == 0
     assert stats["resumes_recompute"] == 0
     # the release carried mid-trajectory checkpoints through the
     # release seam (the real return_tiles path in production)
@@ -349,9 +362,9 @@ def test_one_jobs_failure_releases_and_spares_others():
 # --------------------------------------------------------------------------
 
 
-def test_run_master_xjob_end_to_end_with_stub(monkeypatch):
-    """The delegated master entry drives the shared executor against a
-    real JobStore and blends a complete canvas (stub processor)."""
+def _run_xjob_e2e(monkeypatch, job_id, *, device_canvas=False):
+    """One delegated-master xjob run against a real JobStore with the
+    stub processor; returns the blended canvas as ndarray."""
     from unittest import mock
 
     from comfyui_distributed_tpu.graph import ExecutionContext
@@ -366,6 +379,7 @@ def test_run_master_xjob_end_to_end_with_stub(monkeypatch):
     bx._reset_shared_executor_for_tests()
     monkeypatch.setenv("CDT_XJOB_BATCH", "1")
     monkeypatch.setenv("CDT_DETERMINISTIC_BLEND", "1")
+    monkeypatch.setenv("CDT_DEVICE_CANVAS", "1" if device_canvas else "0")
     store = JobStore()
     ctx = ExecutionContext(
         server=types.SimpleNamespace(job_store=store), config={"workers": []}
@@ -389,17 +403,36 @@ def test_run_master_xjob_end_to_end_with_stub(monkeypatch):
         # entry under the knob + a stepwise-capable sampler
         out = elastic.run_master_elastic(
             bundle, image, pos, neg,
-            job_id="xjob-e2e",
+            job_id=job_id,
             enabled_worker_ids=[],
             upscale_by=2.0, tile=64, padding=16,
             steps=2, sampler="euler", scheduler="karras",
             cfg=1.0, denoise=0.3, seed=0, context=ctx,
         )
     out = np.asarray(out)
-    assert out.shape == (1, 64, 192, 3)
     # the job settled cleanly at the store
     assert store.tile_jobs == {}
     bx._reset_shared_executor_for_tests()
+    return out
+
+
+def test_run_master_xjob_end_to_end_with_stub(monkeypatch):
+    """The delegated master entry drives the shared executor against a
+    real JobStore and blends a complete canvas (stub processor)."""
+    out = _run_xjob_e2e(monkeypatch, "xjob-e2e")
+    assert out.shape == (1, 64, 192, 3)
+
+
+def test_run_master_xjob_device_canvas_bit_identical(monkeypatch):
+    """CDT_DEVICE_CANVAS=1 on the xjob tier: master-local tiles stay
+    device-resident (device_emit) and composite on-device with ONE d2h
+    flush — bit-identical to the host-canvas run."""
+    # same job id both runs: the per-tile noise keys fold it, so the
+    # tiles themselves are identical and only the canvas path differs
+    host = _run_xjob_e2e(monkeypatch, "xjob-ab")
+    device = _run_xjob_e2e(monkeypatch, "xjob-ab", device_canvas=True)
+    assert device.shape == (1, 64, 192, 3)
+    np.testing.assert_array_equal(host, device)
 
 
 def test_preempt_learned_from_drained_pull_parks_instead_of_finishing():

@@ -1,0 +1,337 @@
+"""Device-resident hot path (graph/batch_executor.py): buffer donation
+on the batched step program, the persistent-latent stash that serves
+preemption resumes without a host round-trip, and the precision-lane
+knob. The bit-identity contract: resume-from-device ≡ resume-from-host
+≡ uninterrupted, for jitted AND eager processors."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.graph.batch_executor import (
+    CrossJobExecutor,
+    XJobHandle,
+)
+from comfyui_distributed_tpu.parallel.seeds import fold_job_key
+from comfyui_distributed_tpu.utils.constants import precision_for_lane
+
+N_STEPS = 4
+
+
+def _make_proc(n_steps=N_STEPS, signature=("stub",), jit=False):
+    def init(params, tile, key):
+        return tile + 0.0
+
+    def step(params, x, key, pos, neg, yx, i):
+        ki = jax.random.fold_in(key, i)
+        return x + 0.01 * jax.random.normal(ki, x.shape) + 0.001 * pos
+
+    def finish(params, x):
+        return jnp.round(jnp.clip(x, 0.0, 1.0) * 255.0) / 255.0
+
+    return types.SimpleNamespace(
+        init=init,
+        step=jax.jit(step) if jit else step,
+        finish=finish,
+        n_steps=n_steps,
+        signature=tuple(signature),
+    )
+
+
+class _FakeMaster:
+    def __init__(self, n_tiles, grant_size=64):
+        self.pending = list(range(n_tiles))
+        self.ckpts = {}
+        self.grant_size = grant_size
+        self.released = []
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            if not self.pending:
+                return None
+            grant = self.pending[: self.grant_size]
+            self.pending = self.pending[self.grant_size:]
+            cks = {t: self.ckpts.pop(t) for t in list(self.ckpts) if t in grant}
+            return {"tile_idxs": grant, "checkpoints": cks}
+
+    def release(self, idxs, cks):
+        with self.lock:
+            self.released.append((list(idxs), dict(cks)))
+            self.pending = sorted(set(self.pending) | set(idxs))
+            self.ckpts.update(cks)
+
+
+def _make_job(job_id, n_tiles, seed, *, proc, master=None, priority=0, flag=None):
+    master = master or _FakeMaster(n_tiles)
+    rng = np.random.default_rng(seed)
+    extracted = jnp.asarray(rng.random((n_tiles, 4, 4, 3)), jnp.float32)
+    positions = jnp.zeros((n_tiles, 2), jnp.int32)
+    outs = {}
+
+    def emit(idx, arr):
+        outs[int(idx)] = np.asarray(arr)
+
+    handle = XJobHandle(
+        job_id=job_id,
+        proc=proc,
+        params=None,
+        extracted=extracted,
+        positions=positions,
+        pos=jnp.float32(seed),
+        neg=jnp.float32(0),
+        base_key=fold_job_key(jax.random.key(seed), job_id),
+        pull=master.pull,
+        emit=emit,
+        flush=lambda final: None,
+        release=master.release,
+        preempt_check=(lambda: flag.is_set()) if flag is not None else None,
+        priority=priority,
+    )
+    return handle, outs, master
+
+
+def _solo(job_id, n_tiles, seed, *, proc, k_max=8):
+    ex = CrossJobExecutor(k_max=k_max)
+    handle, outs, _ = _make_job(job_id, n_tiles, seed, proc=proc)
+    ex.register(handle)
+    ex.run()
+    return outs
+
+
+def _batch_inputs(n, shape=(4, 4, 3)):
+    xs = jnp.asarray(np.random.default_rng(0).random((n, *shape)), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), n)
+    poss = jnp.zeros((n,), jnp.float32)
+    negs = jnp.zeros((n,), jnp.float32)
+    yxs = jnp.zeros((n, 2), jnp.int32)
+    steps = jnp.zeros((n,), jnp.int32)
+    return xs, keys, poss, negs, yxs, steps
+
+
+# --------------------------------------------------------------------------
+# buffer donation
+# --------------------------------------------------------------------------
+
+
+def test_vstep_jitted_program_aliases_and_consumes_latents():
+    """The batched step must carry an input_output_alias for the
+    stacked latents (XLA reuses the buffer) and DELETE the donated
+    input after the call — the one-allocation-per-step invariant."""
+    ex = CrossJobExecutor(k_max=4)
+    proc = _make_proc(jit=True)
+    fn = ex._vstep(("sig-jit",), proc.step)
+    xs, keys, poss, negs, yxs, steps = _batch_inputs(2)
+    lowered = fn.lower(None, xs, keys, poss, negs, yxs, steps)
+    assert "input_output_alias" in lowered.compile().as_text()
+    out = jax.block_until_ready(fn(None, xs, keys, poss, negs, yxs, steps))
+    assert xs.is_deleted()
+    assert out.shape == (2, 4, 4, 3)
+
+
+def test_vstep_compiles_once_across_steps():
+    """One compiled program per batch shape: the traced step index
+    (jnp.take on sigmas in production) means step 0..n share it."""
+    ex = CrossJobExecutor(k_max=4)
+    proc = _make_proc(jit=True)
+    fn = ex._vstep(("sig-count",), proc.step)
+    for i in range(3):
+        xs, keys, poss, negs, yxs, _ = _batch_inputs(2)
+        steps = jnp.full((2,), i, jnp.int32)
+        jax.block_until_ready(fn(None, xs, keys, poss, negs, yxs, steps))
+    assert fn._cache_size() == 1
+    # the executor-level cache hands back the same program object
+    assert ex._vstep(("sig-count",), proc.step) is fn
+
+
+def test_vstep_eager_stub_stays_undonated():
+    """Raw Python stubs (the chaos parity suite) must not be donated:
+    donation is a jit concept, and the stub's inputs stay readable."""
+    ex = CrossJobExecutor(k_max=4)
+    proc = _make_proc(jit=False)
+    fn = ex._vstep(("sig-eager",), proc.step)
+    assert not hasattr(fn, "lower")
+    xs, keys, poss, negs, yxs, steps = _batch_inputs(2)
+    jax.block_until_ready(fn(None, xs, keys, poss, negs, yxs, steps))
+    assert not xs.is_deleted()
+
+
+# --------------------------------------------------------------------------
+# persistent-latent stash: resume bit-identity
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("jit", [False, True], ids=["eager", "jitted"])
+@pytest.mark.parametrize("device_resident", [True, False], ids=["device", "host"])
+def test_resume_modes_bit_identical_to_uninterrupted(
+    monkeypatch, device_resident, jit
+):
+    """Evict mid-trajectory, resume, and compare against the
+    uninterrupted solo run: byte-equal in BOTH resume modes. The
+    device stash serves the resume when enabled (the checkpoint stays
+    a cold spill); disabling it falls back to checkpoint decode."""
+    monkeypatch.setenv(
+        "CDT_XJOB_DEVICE_RESIDENT", "1" if device_resident else "0"
+    )
+    proc = _make_proc(n_steps=5, jit=jit)
+    flag = threading.Event()
+
+    class _RelentingMaster(_FakeMaster):
+        def release(self, idxs, cks):
+            super().release(idxs, cks)
+            flag.clear()  # pressure lifts once the eviction lands
+
+    master = _RelentingMaster(4)
+    ex = CrossJobExecutor(k_max=8)
+    handle, outs, _ = _make_job(
+        "job", 4, 3, proc=proc, master=master, flag=flag
+    )
+    ex.register(handle)
+    count = {"n": 0}
+    orig = ex._step_batch
+
+    def hooked(batch):
+        orig(batch)
+        count["n"] += 1
+        if count["n"] == 2:
+            flag.set()
+
+    ex._step_batch = hooked
+    stats = ex.run()
+    assert stats["preempt_evictions"] == 4
+    if device_resident:
+        assert stats["resumes_device"] == 4
+        assert stats["resumes_checkpoint"] == 0
+    else:
+        assert stats["resumes_device"] == 0
+        assert stats["resumes_checkpoint"] == 4
+    assert stats["resumes_recompute"] == 0
+    solo = _solo("job", 4, 3, proc=_make_proc(n_steps=5, jit=jit))
+    for i in range(4):
+        np.testing.assert_array_equal(outs[i], solo[i])
+
+
+def test_device_and_host_resume_agree(monkeypatch):
+    """resume-from-device ≡ resume-from-host directly (not only via
+    the solo reference): the stash latent IS the array the checkpoint
+    was encoded from, so the two modes cannot diverge."""
+
+    def run(mode):
+        monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", mode)
+        proc = _make_proc(n_steps=5)
+        flag = threading.Event()
+
+        class _RelentingMaster(_FakeMaster):
+            def release(self, idxs, cks):
+                super().release(idxs, cks)
+                flag.clear()
+
+        ex = CrossJobExecutor(k_max=8)
+        handle, outs, _ = _make_job(
+            "job", 3, 7, proc=proc, master=_RelentingMaster(3), flag=flag
+        )
+        ex.register(handle)
+        count = {"n": 0}
+        orig = ex._step_batch
+
+        def hooked(batch):
+            orig(batch)
+            count["n"] += 1
+            if count["n"] == 2:
+                flag.set()
+
+        ex._step_batch = hooked
+        ex.run()
+        return outs
+
+    device_outs = run("1")
+    host_outs = run("0")
+    assert set(device_outs) == set(host_outs) == {0, 1, 2}
+    for i in device_outs:
+        np.testing.assert_array_equal(device_outs[i], host_outs[i])
+
+
+# --------------------------------------------------------------------------
+# stash mechanics: budget, FIFO eviction, step guard
+# --------------------------------------------------------------------------
+
+
+def _half_mb():
+    return jnp.zeros((131072,), jnp.float32)  # 512 KiB
+
+
+def test_stash_budget_evicts_fifo(monkeypatch):
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", "1")
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT_MB", "1")
+    ex = CrossJobExecutor(k_max=2)
+    ex._stash_put("job", 0, _half_mb(), 2)
+    ex._stash_put("job", 1, _half_mb(), 2)
+    assert ex._device_stash_bytes == 2 * 524288
+    # the third entry exceeds the 1 MiB budget: the OLDEST goes
+    ex._stash_put("job", 2, _half_mb(), 2)
+    assert ex._stash_take("job", 0, 2) is None
+    assert ex._stash_take("job", 1, 2) is not None
+    assert ex._stash_take("job", 2, 2) is not None
+    assert ex._device_stash_bytes == 0
+
+
+def test_stash_step_mismatch_misses(monkeypatch):
+    """A stale stash entry (checkpoint advanced past it) must MISS —
+    the checkpoint payload is the authoritative resume instruction."""
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", "1")
+    ex = CrossJobExecutor(k_max=2)
+    ex._stash_put("job", 0, _half_mb(), 2)
+    assert ex._stash_take("job", 0, 3) is None
+    # the mismatched entry is consumed, not retried
+    assert ex._device_stash == {}
+    assert ex._device_stash_bytes == 0
+
+
+def test_stash_oversized_latent_never_parked(monkeypatch):
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", "1")
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT_MB", "1")
+    ex = CrossJobExecutor(k_max=2)
+    ex._stash_put("job", 0, jnp.zeros((524288,), jnp.float32), 1)  # 2 MiB
+    assert ex._device_stash == {}
+
+
+def test_stash_knob_off_is_noop(monkeypatch):
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", "0")
+    ex = CrossJobExecutor(k_max=2)
+    ex._stash_put("job", 0, _half_mb(), 1)
+    assert ex._device_stash == {}
+    assert ex._stash_take("job", 0, 1) is None
+
+
+def test_job_failure_drops_stash(monkeypatch):
+    monkeypatch.setenv("CDT_XJOB_DEVICE_RESIDENT", "1")
+    ex = CrossJobExecutor(k_max=2)
+    ex._stash_put("a", 0, _half_mb(), 1)
+    ex._stash_put("a", 1, _half_mb(), 1)
+    ex._stash_put("b", 0, _half_mb(), 1)
+    ex._drop_job_stash("a")
+    assert list(ex._device_stash) == [("b", 0)]
+    assert ex._device_stash_bytes == 524288
+
+
+# --------------------------------------------------------------------------
+# precision lane routing
+# --------------------------------------------------------------------------
+
+
+def test_precision_for_lane_routing(monkeypatch):
+    monkeypatch.delenv("CDT_BF16_LANES", raising=False)
+    assert precision_for_lane("background") == "f32"
+    monkeypatch.setenv("CDT_BF16_LANES", "background, batch")
+    assert precision_for_lane("background") == "bf16"
+    assert precision_for_lane("batch") == "bf16"
+    assert precision_for_lane("interactive") == "f32"
+    assert precision_for_lane("") == "f32"
+    monkeypatch.setenv("CDT_BF16_LANES", "*")
+    assert precision_for_lane("interactive") == "bf16"
+    assert precision_for_lane("") == "bf16"

@@ -1,0 +1,16 @@
+"""CDT007 true negatives: device-side math and host-side byte plumbing
+that never pulls a device array."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def blend(region, tile, mask):
+    # device-resident compositing: jnp ops stay on-device
+    out = region * (1.0 - mask) + tile * mask
+    return jnp.asarray(out, dtype=jnp.float32)
+
+
+def decode(meta, raw):
+    # frombuffer/dtype work on host bytes, not device arrays
+    dtype = np.dtype(meta["dtype"])
+    return np.frombuffer(raw, dtype=dtype)

@@ -1,0 +1,15 @@
+"""CDT007 true positives: host syncs inside the device-resident hot
+path (fixture is mounted at a HOT_PATH_PATHS location by the test)."""
+import jax
+import numpy as np
+
+
+def retire(out, ensure_numpy):
+    host = np.asarray(out)  # implicit __array__ d2h
+    contig = np.ascontiguousarray(out)  # same pull, contiguous
+    stacked = np.stack([out, out])  # stack forces __array__ per item
+    pulled = jax.device_get(out)  # explicit d2h
+    out.block_until_ready()  # method-form host sync barrier
+    jax.block_until_ready(out)  # functional-form sync barrier
+    mat = ensure_numpy(out)  # the repo's materialization helper
+    return host, contig, stacked, pulled, mat

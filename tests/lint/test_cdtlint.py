@@ -25,6 +25,9 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
 # fixtures mount at one of those paths inside the synthetic tree.
 DETERMINISM_MOUNT = "comfyui_distributed_tpu/ops/tiles.py"
 
+# CDT007 only fires on the device-resident hot-path modules.
+HOT_PATH_MOUNT = "comfyui_distributed_tpu/graph/tile_pipeline.py"
+
 
 def lint_fixture(tmp_path, mapping: dict[str, str], select: set[str]):
     """Copy fixture files into a synthetic tree and lint it."""
@@ -285,6 +288,42 @@ def test_cdt006_noqa_suppression(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# CDT007 host-sync-hot-path
+# --------------------------------------------------------------------------
+
+def test_cdt007_true_positives(tmp_path):
+    result = lint_fixture(tmp_path, {HOT_PATH_MOUNT: "cdt007_tp.py"}, {"CDT007"})
+    assert all(f.code == "CDT007" for f in result.findings)
+    messages = "\n".join(f.message for f in result.findings)
+    assert "`np.asarray(...)`" in messages
+    assert "`np.ascontiguousarray(...)`" in messages
+    assert "`np.stack(...)`" in messages
+    assert "`jax.device_get(...)`" in messages
+    assert "block_until_ready" in messages
+    assert "`ensure_numpy(...)`" in messages
+    # asarray + ascontiguousarray + stack + device_get, two sync
+    # barriers (method + functional form), one materialization helper
+    assert len(result.findings) == 7
+
+
+def test_cdt007_outside_hot_path_is_silent(tmp_path):
+    # same host pulls mounted OUTSIDE the hot-path module list: silent
+    result = lint_fixture(tmp_path, {"pkg/free_module.py": "cdt007_tp.py"}, {"CDT007"})
+    assert result.findings == []
+
+
+def test_cdt007_true_negatives(tmp_path):
+    result = lint_fixture(tmp_path, {HOT_PATH_MOUNT: "cdt007_tn.py"}, {"CDT007"})
+    assert result.findings == []
+
+
+def test_cdt007_noqa_suppression(tmp_path):
+    result = lint_fixture(tmp_path, {HOT_PATH_MOUNT: "cdt007_noqa.py"}, {"CDT007"})
+    assert result.findings == []
+    assert len(result.suppressed) == 1
+
+
+# --------------------------------------------------------------------------
 # framework: noqa parsing, baseline drift, CLI
 # --------------------------------------------------------------------------
 
@@ -306,7 +345,9 @@ def test_parse_noqa_forms():
 
 def test_every_checker_registered_has_fixture_coverage():
     codes = set(all_checkers())
-    assert codes == {"CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006"}
+    assert codes == {
+        "CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006", "CDT007",
+    }
     for code in codes:
         n = code[-3:].lstrip("0")
         named = [f for f in os.listdir(FIXTURES) if f.startswith(f"cdt00{n}")]
@@ -355,7 +396,9 @@ def test_cli_json_format():
 def test_cli_list_checkers():
     proc = _run_cli("--list-checkers")
     assert proc.returncode == 0
-    for code in ("CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006"):
+    for code in (
+        "CDT001", "CDT002", "CDT003", "CDT004", "CDT005", "CDT006", "CDT007",
+    ):
         assert code in proc.stdout
 
 

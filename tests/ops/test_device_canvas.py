@@ -1,0 +1,133 @@
+"""DeviceCanvas ≡ DeterministicHostCanvas bit-identity.
+
+The device-resident hot path routes master-local grants through
+DeviceCanvas so only ONE composited canvas crosses d2h per flush. The
+swap is only sound if the composite is bit-identical to the
+deterministic host canvas on every grid shape the elastic tier can
+produce — these tests pin exact equality (assert_array_equal, no
+tolerance), including ragged/non-uniform grids and shuffled arrival
+order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.ops import tiles as tile_ops
+
+pytestmark = pytest.mark.fast
+
+
+def _random_tiles(grid, batch=1, channels=3, seed=7):
+    """One random processed tile per grid position, keyed by origin."""
+    out = {}
+    for idx, (y, x) in enumerate(grid.positions):
+        out[(y, x)] = jax.random.uniform(
+            jax.random.key(seed + idx),
+            (batch, grid.padded_h, grid.padded_w, channels),
+        )
+    return out
+
+
+def _parity_case(grid, batch=1, seed=3, order=None):
+    base = jax.random.uniform(jax.random.key(seed), (batch, grid.image_h, grid.image_w, 3))
+    tiles = _random_tiles(grid, batch=batch, seed=seed + 11)
+    device = tile_ops.DeviceCanvas(base, grid)
+    host = tile_ops.DeterministicHostCanvas(base, grid)
+    positions = list(tiles)
+    if order is not None:
+        positions = [positions[i] for i in order]
+    for y, x in positions:
+        device.blend(tiles[(y, x)], y, x)
+        host.blend(np.asarray(tiles[(y, x)]), y, x)
+    return np.asarray(device.result()), np.asarray(host.result())
+
+
+@pytest.mark.parametrize(
+    "h,w,tile,pad",
+    [
+        (96, 96, 48, 8),     # even grid, overlap ring
+        (100, 140, 64, 8),   # ragged: last row/col shifted (uniform)
+        (300, 500, 128, 16), # larger ragged grid
+        (64, 64, 128, 8),    # single tile smaller than requested
+    ],
+)
+def test_device_canvas_bit_identical_to_host(h, w, tile, pad):
+    grid = tile_ops.calculate_tiles(h, w, tile, tile, padding=pad)
+    dev, host = _parity_case(grid)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_device_canvas_bit_identical_non_uniform_grid():
+    """Non-uniform seam positions overhang the image; the padded canvas
+    grows an edge strip. Device and host must crop identically."""
+    grid = tile_ops.calculate_tiles(100, 140, 64, 64, padding=8, uniform=False)
+    dev, host = _parity_case(grid, seed=5)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_device_canvas_bit_identical_with_mask_blur():
+    grid = tile_ops.calculate_tiles(96, 96, 48, 48, padding=16, mask_blur=4)
+    dev, host = _parity_case(grid, seed=9)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_device_canvas_arrival_order_is_immaterial():
+    """Sorted compositing makes arrival order irrelevant — the chaos
+    property (crash/speculation reorder grants) reduced to its core."""
+    grid = tile_ops.calculate_tiles(96, 160, 64, 64, padding=8)
+    rng = np.random.default_rng(17)
+    order = list(rng.permutation(grid.num_tiles))
+    dev_shuffled, host_sorted = _parity_case(grid, seed=13, order=order)
+    dev_inorder, _ = _parity_case(grid, seed=13)
+    np.testing.assert_array_equal(dev_shuffled, host_sorted)
+    np.testing.assert_array_equal(dev_shuffled, dev_inorder)
+
+
+def test_device_canvas_last_write_wins_dedup():
+    """Re-blending a tile (speculation / duplicate grant) keeps the
+    last payload and does not double-composite."""
+    grid = tile_ops.calculate_tiles(96, 96, 48, 48, padding=8)
+    base = jax.random.uniform(jax.random.key(21), (1, 96, 96, 3))
+    tiles = _random_tiles(grid, seed=23)
+    canvas = tile_ops.DeviceCanvas(base, grid)
+    reference = tile_ops.DeviceCanvas(base, grid)
+    first = True
+    for (y, x), tile in tiles.items():
+        if first:
+            # a stale speculative payload, later overwritten
+            canvas.blend(jnp.zeros_like(tile), y, x)
+            first = False
+        canvas.blend(tile, y, x)
+        reference.blend(tile, y, x)
+    assert canvas.tile_count == grid.num_tiles
+    np.testing.assert_array_equal(
+        np.asarray(canvas.result()), np.asarray(reference.result())
+    )
+
+
+def test_device_canvas_result_stays_on_device():
+    """result() must hand back a jax.Array (the caller owns the single
+    d2h transfer and its ledger note) and accept host tiles too —
+    remote PNG tiles upload once at blend()."""
+    grid = tile_ops.calculate_tiles(64, 64, 32, 32, padding=8)
+    base = jnp.zeros((1, 64, 64, 3), dtype=jnp.float32)
+    canvas = tile_ops.DeviceCanvas(base, grid)
+    for y, x in grid.positions:
+        host_tile = np.ones((1, grid.padded_h, grid.padded_w, 3), dtype=np.float32)
+        canvas.blend(host_tile, y, x)
+    out = canvas.result()
+    assert isinstance(out, jax.Array)
+    assert out.shape == (1, 64, 64, 3)
+    assert out.dtype == jnp.float32
+
+
+def test_device_canvas_empty_flush_returns_base():
+    grid = tile_ops.calculate_tiles(64, 64, 32, 32, padding=8)
+    base = jax.random.uniform(jax.random.key(29), (1, 64, 64, 3))
+    canvas = tile_ops.DeviceCanvas(base, grid)
+    assert canvas.tile_count == 0
+    np.testing.assert_array_equal(
+        np.asarray(canvas.result()), np.asarray(base, dtype=np.float32)
+    )

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from comfyui_distributed_tpu.ops import samplers as smp
 from comfyui_distributed_tpu.ops.stepwise import (
     MAX_CHECKPOINT_BYTES,
+    PRECISION_LANES,
     CheckpointError,
     checkpoint_nbytes,
     decode_checkpoint,
@@ -109,6 +110,34 @@ def test_checkpoint_roundtrip_is_byte_exact():
     assert out.tobytes() == arr.tobytes()
     # size estimate within b64 rounding of the truth
     assert abs(checkpoint_nbytes(payload) - arr.nbytes) <= 3
+
+
+def test_checkpoint_roundtrip_bf16_byte_exact():
+    """The bf16 lane's checkpoints travel the same codec. ml_dtypes
+    bfloat16 registers with numpy dtype.kind 'V' (the kind the codec
+    otherwise rejects) but is explicitly allowlisted by name, and the
+    round trip stays byte-exact — resume ≡ uninterrupted holds on the
+    budget lane too."""
+    assert PRECISION_LANES == ("f32", "bf16")
+    arr = np.asarray(
+        jax.random.normal(jax.random.key(2), (2, 8, 8, 4)).astype(jnp.bfloat16)
+    )
+    payload = encode_checkpoint(arr, 3)
+    assert payload["dtype"] == "bfloat16"
+    out, step = decode_checkpoint(payload)
+    assert step == 3
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    assert out.tobytes() == arr.tobytes()
+
+
+def test_bf16_carry_quantization_is_idempotent():
+    """The lane quantizes the latent carry BETWEEN steps (step math
+    upcasts to f32): re-quantizing an already-quantized carry must be
+    the identity, so checkpoint/resume does not re-round."""
+    x = jax.random.normal(jax.random.key(5), (4, 4, 3))
+    carried = x.astype(jnp.bfloat16)
+    again = carried.astype(jnp.float32).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(carried), np.asarray(again))
 
 
 @pytest.mark.parametrize(

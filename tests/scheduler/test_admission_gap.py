@@ -90,3 +90,79 @@ def test_job_store_settle_sink_is_advisory():
     store._note_settle_sink("tenant-a", 1)  # must not raise
     store.settle_sink = None
     store._note_settle_sink("tenant-a", 1)  # unwired: no-op
+
+
+# --------------------------------------------------------------------------
+# CDT_CACHE_COST: cache-hit admission discount
+# --------------------------------------------------------------------------
+
+
+def test_cache_cost_discount_shrinks_admission_and_gap(monkeypatch):
+    """With the knob on, a tenant whose tiles keep settling from the
+    cache pays less at DRR admission — and because note_cache_settled
+    charges the DISCOUNTED per-tile admitted cost, every subsequent
+    settle lands a strictly smaller gap on the
+    cdt_cache_unsettled_admission_cost gauge."""
+    monkeypatch.setenv("CDT_CACHE_COST", "1")
+    control = SchedulerControl()
+    # no settle history yet: full freight
+    first = control.submit_payload(_payload("hit-heavy", tiles=10))
+    assert first.cost == pytest.approx(10.0)
+    # 8 of those 10 settled from the cache: hit share 0.8, so the next
+    # admission is discounted to max(floor=0.25, 1 - 0.8) = 0.25/tile
+    gap_full = control.note_cache_settled("hit-heavy", 8)
+    assert gap_full == pytest.approx(8.0)
+    second = control.submit_payload(_payload("hit-heavy", tiles=10))
+    assert second.cost == pytest.approx(2.5)
+    # the gauge now grows by the discounted per-tile cost — strictly
+    # less than the undiscounted 4.0 the same settle used to add
+    before = control.unsettled_admission_cost
+    gap_discounted = control.note_cache_settled("hit-heavy", 4)
+    assert gap_discounted == pytest.approx(1.0)
+    assert gap_discounted < 4.0
+    assert control.unsettled_admission_cost == pytest.approx(before + 1.0)
+
+
+def test_cache_cost_floor_bounds_the_discount(monkeypatch):
+    """The multiplier never goes below CDT_CACHE_COST_FLOOR: even a
+    100%-hit tenant keeps a real admission footprint (the bound that
+    stops a hot tenant from riding the queue for free)."""
+    monkeypatch.setenv("CDT_CACHE_COST", "1")
+    monkeypatch.setenv("CDT_CACHE_COST_FLOOR", "0.5")
+    control = SchedulerControl()
+    control.submit_payload(_payload("all-hits", tiles=10))
+    control.note_cache_settled("all-hits", 10)
+    ticket = control.submit_payload(_payload("all-hits", tiles=10))
+    assert ticket.cost == pytest.approx(5.0)
+
+
+def test_cache_cost_knob_off_is_identity(monkeypatch):
+    monkeypatch.delenv("CDT_CACHE_COST", raising=False)
+    control = SchedulerControl()
+    control.submit_payload(_payload("hit-heavy", tiles=10))
+    control.note_cache_settled("hit-heavy", 8)
+    ticket = control.submit_payload(_payload("hit-heavy", tiles=10))
+    assert ticket.cost == pytest.approx(10.0)
+
+
+def test_cache_cost_window_halves_both_counters(monkeypatch):
+    """Past the hit-share window, both counters halve so the discount
+    tracks recent behavior instead of all-time history."""
+    monkeypatch.setenv("CDT_CACHE_COST", "1")
+    control = SchedulerControl()
+    control._note_admitted_tiles("t", 4000.0)
+    control._note_settled_tiles("t", 1000.0)
+    control._note_admitted_tiles("t", 2000.0)  # crosses the 4096 window
+    assert control._tenant_admitted_tiles["t"] == pytest.approx(3000.0)
+    assert control._tenant_settled_tiles["t"] == pytest.approx(500.0)
+
+
+def test_cache_cost_counters_are_bounded(monkeypatch):
+    monkeypatch.setenv("CDT_CACHE_COST", "1")
+    control = SchedulerControl()
+    cap = control._max_tenant_tile_cost
+    for i in range(cap + 10):
+        control._note_admitted_tiles(f"tenant-{i}", 1.0)
+        control._note_settled_tiles(f"tenant-{i}", 1.0)
+    assert len(control._tenant_admitted_tiles) == cap
+    assert len(control._tenant_settled_tiles) == cap

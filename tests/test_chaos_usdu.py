@@ -335,6 +335,42 @@ def test_speculative_redispatch_with_pipelined_batched_grants(baseline):
     np.testing.assert_array_equal(baseline, result.output)
 
 
+def test_device_canvas_fault_free_bit_identical(baseline):
+    """On-device compositing (CDT_DEVICE_CANVAS=1) vs the deterministic
+    host canvas: same tiles, sorted order on both sides — the output
+    must be bit-identical, which is what licenses the one-flush d2h."""
+    result = run_chaos_usdu(seed=11, device_canvas=True)
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_device_canvas_crash_recovery_bit_identical(baseline):
+    result = run_chaos_usdu(
+        seed=11,
+        device_canvas=True,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+    )
+    assert "w1" in result.crashed_workers
+    np.testing.assert_array_equal(baseline, result.output)
+
+
+def test_device_canvas_speculation_bit_identical(baseline):
+    """Speculative re-dispatch lands duplicate tiles out of order; the
+    device canvas's last-write-wins buffer plus sorted compositing must
+    still match the host baseline exactly."""
+    result = run_chaos_usdu(
+        seed=11,
+        device_canvas=True,
+        fault_plan=f"seed=11;{SLOW_MASTER};crash@chaos:w1:pulled#1",
+        worker_timeout=10.0,
+        watchdog={},
+        tile_batch=4,
+        pipeline=True,
+    )
+    assert "w1" in result.crashed_workers
+    assert any(result.speculated.values()), "no speculative re-dispatch"
+    np.testing.assert_array_equal(baseline, result.output)
+
+
 def test_prefetch_crash_requeues_prefetched_grant(baseline):
     """With pull prefetch on, a crashing worker strands BOTH its
     in-flight grant and the prefetched one; heartbeat-timeout requeue

@@ -96,10 +96,14 @@ def test_premium_preempts_running_batch_grant_at_step_boundary():
         seed=7, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM
     )
     # the eviction actually happened, through the release/requeue path,
-    # and every evicted tile resumed from its checkpoint
+    # and every evicted tile resumed from its parked device latent
+    # (the stash entry IS the array the checkpoint was encoded from,
+    # so either mode is bit-exact; with CDT_XJOB_DEVICE_RESIDENT=0
+    # the same tiles resume from checkpoint bytes instead)
     assert r.preempted_jobs == ["xjob-batch"]
     assert r.evictions == 5
-    assert r.resumes_checkpoint == 5 and r.resumes_recompute == 0
+    assert r.resumes_device + r.resumes_checkpoint == 5
+    assert r.resumes_recompute == 0
     # premium-lane wait bound: the premium job's FIRST tile (indeed,
     # all of its tiles) completes before any remaining batch tile
     order = [jid for jid, _ in r.completion_order]
@@ -131,7 +135,10 @@ def test_preempt_then_checkpoint_loss_recomputes_bit_identical():
         drop_checkpoints=True,
     )
     assert r.evictions == 5
-    assert r.resumes_recompute == 5 and r.resumes_checkpoint == 0
+    # drop_checkpoints drops the device stash too: a lost checkpoint
+    # means the latent is gone everywhere, so every tile recomputes
+    assert r.resumes_recompute == 5
+    assert r.resumes_checkpoint == 0 and r.resumes_device == 0
     assert not r.leaks
     solo_batch = _solo(BATCH_SPEC, steps=5)
     np.testing.assert_array_equal(
@@ -151,11 +158,20 @@ def test_preemption_instruments_count():
         preempt_total,
     )
 
+    def resumed():
+        # device-resident stash hits count under mode="device"; the
+        # checkpoint-bytes path under mode="checkpoint" — either way
+        # the 5 evicted tiles must all land in a non-recompute mode
+        return (preempt_resume_total().value(mode="device")
+                + preempt_resume_total().value(mode="checkpoint"))
+
     before_req = preempt_total().value(reason="premium_arrival")
-    before_ck = preempt_resume_total().value(mode="checkpoint")
+    before_res = resumed()
+    before_rec = preempt_resume_total().value(mode="recompute")
     run_chaos_xjob(seed=11, jobs=[BATCH_SPEC], steps=5, premium=PREMIUM)
     assert preempt_total().value(reason="premium_arrival") == before_req + 1
-    assert preempt_resume_total().value(mode="checkpoint") == before_ck + 5
+    assert resumed() == before_res + 5
+    assert preempt_resume_total().value(mode="recompute") == before_rec
     # the fill gauge carries the most recent dispatch's ratio
     assert 0.0 < batch_fill_ratio().value(role="worker") <= 1.0
 

@@ -6,3 +6,4 @@ from . import tracing_hygiene  # noqa: F401  CDT003
 from . import determinism  # noqa: F401  CDT004
 from . import registry_consistency  # noqa: F401  CDT005
 from . import instrument_registry  # noqa: F401  CDT006
+from . import host_sync  # noqa: F401  CDT007

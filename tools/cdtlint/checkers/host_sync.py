@@ -1,0 +1,132 @@
+"""CDT007: host synchronization in the device-resident hot path.
+
+The device-resident hot path (buffer donation, persistent latents,
+on-device canvas) exists to keep the per-step loop off the host: its
+win condition is a measured drop in ``cdt_host_tax_ratio`` and d2h
+bytes/tile. That win erodes silently the moment someone adds an
+``np.asarray`` on a device array (an implicit ``__array__`` d2h pull),
+a ``.block_until_ready()`` outside a ledger bracket, or a
+``jax.device_get`` inside the dispatch path — each one is a host sync
+the transfer ledger never sees and perf_report cannot attribute.
+
+This checker runs only on the hot-path modules (``HOT_PATH_PATHS``):
+``ops/stepwise.py`` (the per-step sampler seam),
+``graph/batch_executor.py`` (cross-job dispatch/retire), and
+``graph/tile_pipeline.py`` (elastic sampling/readback stages). The
+sanctioned readback sites — checkpoint spills, the canvas flush, the
+ledger-bracketed ``collect``/``to_host`` stages — carry
+``# cdt: noqa[CDT007]`` so the ONLY host pulls in these files are the
+ones the ledger accounts for.
+
+Checks:
+
+- ``np.asarray`` / ``np.array`` / ``np.ascontiguousarray`` /
+  ``np.stack`` / ``np.concatenate`` calls (each forces ``__array__``
+  on a device operand — a blocking d2h);
+- ``jax.device_get(...)`` (an explicit d2h);
+- ``.block_until_ready()`` calls, whether method
+  (``x.block_until_ready()``) or functional
+  (``jax.block_until_ready(x)``) — a host sync barrier;
+- ``ensure_numpy(...)`` (the repo's own materialization helper).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterator
+
+from ..core import FileContext, Finding, Severity, call_name
+from ..registry import checker
+
+# The dispatch-path modules the device-resident guarantee covers.
+# Additions here are deliberate API, not a config knob (the
+# DETERMINISM_PATHS idiom).
+HOT_PATH_PATHS = (
+    "comfyui_distributed_tpu/ops/stepwise.py",
+    "comfyui_distributed_tpu/graph/batch_executor.py",
+    "comfyui_distributed_tpu/graph/tile_pipeline.py",
+)
+
+# Calls that force an implicit __array__ materialization (blocking d2h
+# when handed a device array).
+_HOST_PULL_CALLS = {
+    "np.asarray", "numpy.asarray",
+    "np.array", "numpy.array",
+    "np.ascontiguousarray", "numpy.ascontiguousarray",
+    "np.stack", "numpy.stack",
+    "np.concatenate", "numpy.concatenate",
+    "jax.device_get",
+}
+
+# Attribute-call names that are host syncs regardless of receiver.
+_HOST_SYNC_METHODS = {"block_until_ready"}
+
+# The repo's own materialization helper (utils/image.ensure_numpy):
+# matched by trailing attribute so both `ensure_numpy(x)` and
+# `img_utils.ensure_numpy(x)` are caught.
+_MATERIALIZE_HELPERS = {"ensure_numpy"}
+
+
+def applies_to(path: str) -> bool:
+    return any(fnmatch.fnmatch(path, pat) for pat in HOT_PATH_PATHS)
+
+
+@checker(
+    "CDT007",
+    "host-sync-hot-path",
+    "np.asarray / block_until_ready / device_get host pulls inside the "
+    "device-resident dispatch-path modules (sanctioned ledger-bracketed "
+    "readback sites carry `# cdt: noqa[CDT007]`)",
+)
+def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    if not applies_to(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+        bare = node.func.id if isinstance(node.func, ast.Name) else None
+        if name in _HOST_PULL_CALLS:
+            yield Finding(
+                code="CDT007",
+                message=(
+                    f"`{name}(...)` forces a host materialization "
+                    "(implicit `__array__` d2h) in the device-resident hot "
+                    "path; route readbacks through a ledger-bracketed seam "
+                    "or mark the sanctioned site `# cdt: noqa[CDT007]`"
+                ),
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                severity=Severity.ERROR,
+            )
+            continue
+        if attr in _HOST_SYNC_METHODS or bare in _HOST_SYNC_METHODS:
+            yield Finding(
+                code="CDT007",
+                message=(
+                    "`block_until_ready()` is a host sync barrier in the "
+                    "device-resident hot path; only ledger-bracketed timing "
+                    "sites may sync (mark them `# cdt: noqa[CDT007]`)"
+                ),
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                severity=Severity.ERROR,
+            )
+            continue
+        if attr in _MATERIALIZE_HELPERS or bare in _MATERIALIZE_HELPERS:
+            yield Finding(
+                code="CDT007",
+                message=(
+                    "`ensure_numpy(...)` materializes a device array "
+                    "host-side in the device-resident hot path; sanctioned "
+                    "readback seams carry `# cdt: noqa[CDT007]`"
+                ),
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                severity=Severity.ERROR,
+            )
